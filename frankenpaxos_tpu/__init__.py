@@ -1,0 +1,20 @@
+"""frankenpaxos_tpu: a TPU-native framework for implementing, simulating,
+property-testing, and benchmarking state-machine-replication protocols.
+
+Capability parity target: mwhittaker/frankenpaxos (see SURVEY.md). Protocols
+are written once against a small actor/transport abstraction and run on
+interchangeable backends:
+
+  * ``core.SimTransport``   — deterministic in-process simulation used for
+    randomized invariant testing with counterexample shrinking (the
+    reference's ``FakeTransport``/``JsTransport`` roles, merged).
+  * ``core.TcpTransport``   — asyncio TCP deployment backend (the reference's
+    ``NettyTcpTransport`` role).
+  * ``tpu.TpuSimTransport`` — the new, TPU-native backend: per-actor protocol
+    state flattened into batched JAX arrays, handlers ``jax.vmap``'d over a
+    replica axis, quorum/ballot aggregation compiled to XLA segmented
+    reductions, whole-cluster ticks under ``jax.lax.scan`` and sharded over a
+    ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"

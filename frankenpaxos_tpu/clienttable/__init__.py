@@ -1,0 +1,120 @@
+"""Exactly-once semantics for out-of-order executors.
+
+Capability parity with the reference ``clienttable`` package
+(``clienttable/ClientTable.scala:9-110``). Protocols like EPaxos/BPaxos may
+execute a client's commands out of client-id order, so a simple
+largest-id-per-client table is wrong. This table caches the output of the
+*largest* executed id per client and an :class:`IntPrefixSet` of *all*
+executed ids, so "was id i executed?" is exact while old outputs can be
+dropped. Serializable (the analog of ``ClientTable.proto``) because
+reconfiguration/state-transfer paths ship it between replicas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Generic, Optional, Tuple, TypeVar
+
+from frankenpaxos_tpu.compact import IntPrefixSet, IntPrefixSetProto
+from frankenpaxos_tpu.core import wire
+
+ClientAddress = TypeVar("ClientAddress")
+Output = TypeVar("Output")
+
+
+class NotExecuted:
+    def __repr__(self) -> str:
+        return "NotExecuted"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NotExecuted)
+
+    def __hash__(self):
+        return hash("NotExecuted")
+
+
+@dataclasses.dataclass(frozen=True)
+class Executed(Generic[Output]):
+    """The command was executed; ``output`` is cached only if it was the
+    client's latest command."""
+
+    output: Optional[Output]
+
+
+@dataclasses.dataclass
+class ClientState(Generic[Output]):
+    largest_id: int
+    largest_output: Output
+    executed_ids: IntPrefixSet
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ClientTableProto:
+    entries: tuple  # of (address_bytes, largest_id, output_bytes, prefix_proto)
+
+
+class ClientTable(Generic[ClientAddress, Output]):
+    def __init__(self) -> None:
+        self.states: Dict[ClientAddress, ClientState[Output]] = {}
+
+    def __repr__(self) -> str:
+        return f"ClientTable({self.states!r})"
+
+    def executed(self, client: ClientAddress, client_id: int):
+        """NotExecuted | Executed(Some(output)) | Executed(None)
+        (ClientTable.scala:60-85)."""
+        state = self.states.get(client)
+        if state is None or not state.executed_ids.contains(client_id):
+            return NotExecuted()
+        if client_id == state.largest_id:
+            return Executed(state.largest_output)
+        return Executed(None)
+
+    def execute(self, client: ClientAddress, client_id: int, output: Output) -> None:
+        """Record that ``client_id`` was executed with ``output``
+        (ClientTable.scala:87-110). Must not already be executed."""
+        state = self.states.get(client)
+        if state is None:
+            state = ClientState(
+                largest_id=client_id,
+                largest_output=output,
+                executed_ids=IntPrefixSet(),
+            )
+            self.states[client] = state
+        if state.executed_ids.contains(client_id):
+            raise ValueError(f"client {client!r} id {client_id} executed twice")
+        state.executed_ids.add(client_id)
+        if client_id >= state.largest_id:
+            state.largest_id = client_id
+            state.largest_output = output
+
+    # -- Serialization (ClientTable.proto analog) ---------------------------
+
+    def to_proto(self, address_to_bytes, output_to_bytes) -> ClientTableProto:
+        entries = []
+        for client, state in sorted(
+            self.states.items(), key=lambda kv: address_to_bytes(kv[0])
+        ):
+            entries.append(
+                (
+                    address_to_bytes(client),
+                    state.largest_id,
+                    output_to_bytes(state.largest_output),
+                    state.executed_ids.to_proto(),
+                )
+            )
+        return ClientTableProto(tuple(entries))
+
+    @staticmethod
+    def from_proto(
+        proto: ClientTableProto, address_from_bytes, output_from_bytes
+    ) -> "ClientTable":
+        table: ClientTable = ClientTable()
+        for addr_bytes, largest_id, output_bytes, prefix in proto.entries:
+            table.states[address_from_bytes(addr_bytes)] = ClientState(
+                largest_id=largest_id,
+                largest_output=output_from_bytes(output_bytes),
+                executed_ids=IntPrefixSet.from_proto(prefix),
+            )
+        return table

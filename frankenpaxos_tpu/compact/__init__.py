@@ -1,0 +1,242 @@
+"""Watermark-compressed add-only sets.
+
+Capability parity with the reference ``compact`` package:
+``CompactSet`` trait (``compact/CompactSet.scala:24-80``) and
+``IntPrefixSet`` (``compact/IntPrefixSet.scala``) — an add-only set of
+non-negative ints represented as a watermark plus an overflow set: the set
+is {x | 0 <= x < watermark} ∪ values. Also ``FakeCompactSet`` for tests.
+Proto round-tripping mirrors ``IntPrefixSet.toProto/fromProto``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import FrozenSet, Iterable, Iterator, Set
+
+from frankenpaxos_tpu.core import wire
+
+
+class CompactSet:
+    """Add-only set with best-effort O(1) compaction (CompactSet.scala:24-80)."""
+
+    def add(self, x) -> bool:
+        """Add x; returns True if x was newly added."""
+        raise NotImplementedError
+
+    def contains(self, x) -> bool:
+        raise NotImplementedError
+
+    def union(self, other: "CompactSet") -> "CompactSet":
+        raise NotImplementedError
+
+    def diff(self, other: "CompactSet") -> "CompactSet":
+        raise NotImplementedError
+
+    def diff_iterator(self, other: "CompactSet") -> Iterator:
+        return iter(self.diff(other).materialize())
+
+    def add_all(self, other: "CompactSet") -> "CompactSet":
+        raise NotImplementedError
+
+    def subtract_all(self, other: "CompactSet") -> "CompactSet":
+        raise NotImplementedError
+
+    def subtract_one(self, x) -> "CompactSet":
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def uncompacted_size(self) -> int:
+        raise NotImplementedError
+
+    def subset(self) -> "CompactSet":
+        """A monotone, especially-compact subset of self."""
+        raise NotImplementedError
+
+    def materialize(self) -> Set:
+        raise NotImplementedError
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class IntPrefixSetProto:
+    watermark: int
+    values: tuple
+
+
+class IntPrefixSet(CompactSet):
+    """{0..watermark-1} ∪ values, with values kept disjoint from the prefix
+    and the watermark advanced greedily on add (IntPrefixSet.scala)."""
+
+    def __init__(self, watermark: int = 0, values: Iterable[int] = ()):
+        self.watermark = watermark
+        self.values: Set[int] = {x for x in values if x >= watermark}
+        self._compact()
+
+    @staticmethod
+    def from_watermark(watermark: int) -> "IntPrefixSet":
+        return IntPrefixSet(watermark)
+
+    @staticmethod
+    def from_set(values: Iterable[int]) -> "IntPrefixSet":
+        return IntPrefixSet(0, values)
+
+    def _compact(self) -> None:
+        while self.watermark in self.values:
+            self.values.discard(self.watermark)
+            self.watermark += 1
+
+    def __repr__(self) -> str:
+        return f"IntPrefixSet(watermark={self.watermark}, values={sorted(self.values)})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, IntPrefixSet)
+            and self.watermark == other.watermark
+            and self.values == other.values
+        )
+
+    def __hash__(self):
+        return hash((self.watermark, frozenset(self.values)))
+
+    def add(self, x: int) -> bool:
+        if x < 0:
+            raise ValueError(f"IntPrefixSet holds non-negative ints, got {x}")
+        if self.contains(x):
+            return False
+        self.values.add(x)
+        self._compact()
+        return True
+
+    def contains(self, x: int) -> bool:
+        return x < self.watermark or x in self.values
+
+    def union(self, other: "IntPrefixSet") -> "IntPrefixSet":
+        w = max(self.watermark, other.watermark)
+        return IntPrefixSet(w, self.values | other.values)
+
+    def diff(self, other: "IntPrefixSet") -> "IntPrefixSet":
+        """Set difference; the result is a plain overflow set (watermark 0
+        unless 0 is in the result, then compaction applies)."""
+        mine = self.materialize()
+        return IntPrefixSet(0, {x for x in mine if not other.contains(x)})
+
+    def materialized_diff(self, other: "IntPrefixSet") -> Iterable[int]:
+        for x in range(other.watermark if other.watermark < self.watermark else 0,
+                       self.watermark):
+            if not other.contains(x):
+                yield x
+        for x in sorted(self.values):
+            if not other.contains(x):
+                yield x
+
+    def diff_iterator(self, other: "IntPrefixSet") -> Iterator[int]:
+        return iter(self.materialized_diff(other))
+
+    def add_all(self, other: "IntPrefixSet") -> "IntPrefixSet":
+        self.watermark = max(self.watermark, other.watermark)
+        self.values |= other.values
+        self.values = {x for x in self.values if x >= self.watermark}
+        self._compact()
+        return self
+
+    def subtract_all(self, other: "IntPrefixSet") -> "IntPrefixSet":
+        result = self.diff(other)
+        self.watermark = result.watermark
+        self.values = result.values
+        return self
+
+    def subtract_one(self, x: int) -> "IntPrefixSet":
+        if x >= self.watermark:
+            self.values.discard(x)
+            return self
+        # Un-compact the prefix, drop x, re-compact.
+        self.values.update(range(self.watermark))
+        self.watermark = 0
+        self.values.discard(x)
+        self._compact()
+        return self
+
+    @property
+    def size(self) -> int:
+        return self.watermark + len(self.values)
+
+    @property
+    def uncompacted_size(self) -> int:
+        return len(self.values)
+
+    def subset(self) -> "IntPrefixSet":
+        # The especially-compact monotone subset: the watermark prefix.
+        return IntPrefixSet(self.watermark)
+
+    def materialize(self) -> Set[int]:
+        return set(range(self.watermark)) | self.values
+
+    # -- proto ---------------------------------------------------------------
+
+    def to_proto(self) -> IntPrefixSetProto:
+        return IntPrefixSetProto(self.watermark, tuple(sorted(self.values)))
+
+    @staticmethod
+    def from_proto(proto: IntPrefixSetProto) -> "IntPrefixSet":
+        return IntPrefixSet(proto.watermark, set(proto.values))
+
+
+class FakeCompactSet(CompactSet):
+    """An uncompacted CompactSet for tests (FakeCompactSet.scala)."""
+
+    def __init__(self, values: Iterable = ()):
+        self._values: Set = set(values)
+
+    def __repr__(self) -> str:
+        return f"FakeCompactSet({sorted(self._values)})"
+
+    def __eq__(self, other):
+        return isinstance(other, FakeCompactSet) and self._values == other._values
+
+    def __hash__(self):
+        return hash(frozenset(self._values))
+
+    def add(self, x) -> bool:
+        if x in self._values:
+            return False
+        self._values.add(x)
+        return True
+
+    def contains(self, x) -> bool:
+        return x in self._values
+
+    def union(self, other: "FakeCompactSet") -> "FakeCompactSet":
+        return FakeCompactSet(self._values | other._values)
+
+    def diff(self, other: "FakeCompactSet") -> "FakeCompactSet":
+        return FakeCompactSet(self._values - other._values)
+
+    def add_all(self, other: "FakeCompactSet") -> "FakeCompactSet":
+        self._values |= other._values
+        return self
+
+    def subtract_all(self, other: "FakeCompactSet") -> "FakeCompactSet":
+        self._values -= other._values
+        return self
+
+    def subtract_one(self, x) -> "FakeCompactSet":
+        self._values.discard(x)
+        return self
+
+    @property
+    def size(self) -> int:
+        return len(self._values)
+
+    @property
+    def uncompacted_size(self) -> int:
+        return len(self._values)
+
+    def subset(self) -> "FakeCompactSet":
+        return FakeCompactSet(self._values)
+
+    def materialize(self) -> Set:
+        return set(self._values)

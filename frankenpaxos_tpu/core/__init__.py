@@ -1,0 +1,57 @@
+from frankenpaxos_tpu.core.address import Address, HostPort, SimAddress
+from frankenpaxos_tpu.core.actor import Actor
+from frankenpaxos_tpu.core.channel import Chan
+from frankenpaxos_tpu.core.logger import (
+    FakeLogger,
+    FileLogger,
+    LogLevel,
+    Logger,
+    PrintLogger,
+    RingLogger,
+)
+from frankenpaxos_tpu.core.serializer import (
+    BytesSerializer,
+    IntSerializer,
+    Serializer,
+    StringSerializer,
+    WireSerializer,
+)
+from frankenpaxos_tpu.core.sim_transport import (
+    DeliverMessage,
+    QueuedMessage,
+    SimCommand,
+    SimTimer,
+    SimTransport,
+    TriggerTimer,
+)
+from frankenpaxos_tpu.core.timer import Timer
+from frankenpaxos_tpu.core.transport import Transport
+from frankenpaxos_tpu.core import wire
+
+__all__ = [
+    "Actor",
+    "Address",
+    "BytesSerializer",
+    "Chan",
+    "DeliverMessage",
+    "FakeLogger",
+    "FileLogger",
+    "HostPort",
+    "IntSerializer",
+    "LogLevel",
+    "Logger",
+    "PrintLogger",
+    "QueuedMessage",
+    "RingLogger",
+    "Serializer",
+    "SimAddress",
+    "SimCommand",
+    "SimTimer",
+    "SimTransport",
+    "StringSerializer",
+    "Timer",
+    "Transport",
+    "TriggerTimer",
+    "WireSerializer",
+    "wire",
+]

@@ -1,0 +1,52 @@
+"""Actor base class, mirroring the reference ``Actor[Transport]``
+(``shared/src/main/scala/frankenpaxos/Actor.scala:7-51``): constructed with
+(address, transport, logger), self-registers, declares a serializer, and
+implements ``receive(src, msg)``. Outbound communication via typed ``chan``s
+or raw ``send``/``send_no_flush``/``flush``; timers via ``timer``.
+
+Protocol roles subclass this for the Python execution backends (sim + TCP).
+The TPU backend does not use this class: there, roles are pure step
+functions over batched array state (see ``frankenpaxos_tpu.tpu``), and the
+sim tests cross-validate the two.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from frankenpaxos_tpu.core.address import Address
+from frankenpaxos_tpu.core.channel import Chan
+from frankenpaxos_tpu.core.logger import Logger
+from frankenpaxos_tpu.core.serializer import Serializer, WireSerializer
+from frankenpaxos_tpu.core.timer import Timer
+from frankenpaxos_tpu.core.transport import Transport
+
+_WIRE = WireSerializer()
+
+
+class Actor:
+    serializer: Serializer = _WIRE
+
+    def __init__(self, address: Address, transport: Transport, logger: Logger):
+        self.address = address
+        self.transport = transport
+        self.logger = logger
+        transport.register(address, self)  # Actor.scala:19-20
+
+    def receive(self, src: Address, msg: Any) -> None:
+        raise NotImplementedError
+
+    def chan(self, dst: Address, serializer: Serializer = _WIRE) -> Chan:
+        return Chan(self.transport, self.address, dst, serializer)
+
+    def send(self, dst: Address, data: bytes) -> None:
+        self.transport.send(self.address, dst, data)
+
+    def send_no_flush(self, dst: Address, data: bytes) -> None:
+        self.transport.send_no_flush(self.address, dst, data)
+
+    def flush(self, dst: Address) -> None:
+        self.transport.flush(self.address, dst)
+
+    def timer(self, name: str, delay: float, f: Callable[[], None]) -> Timer:
+        return self.transport.timer(self.address, name, delay, f)

@@ -1,0 +1,39 @@
+"""Transport addresses.
+
+Mirrors the reference's ``Address`` marker trait
+(``shared/src/main/scala/frankenpaxos/Address.scala:1-3``) and the Netty
+transport's host/port addresses (``NettyTcpTransport.scala:39-41``).
+Addresses must be hashable and totally ordered so deterministic simulations
+can sort actors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class Address:
+    """Marker base class for transport addresses."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class SimAddress(Address):
+    """A string address used by simulated transports (cf. JsTransport's
+    string addresses, ``JsTransport.scala:10``)."""
+
+    name: str
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class HostPort(Address):
+    """A (host, port) address used by the TCP deployment transport
+    (cf. ``NettyTcpTransport.scala:39-41`` / ``NettyTcpTransport.proto``)."""
+
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"

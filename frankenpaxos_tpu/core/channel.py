@@ -1,0 +1,36 @@
+"""Typed outbound channel, mirroring ``Chan[Transport, DstActor]``
+(``shared/src/main/scala/frankenpaxos/Chan.scala:3-17``): serializes the
+destination actor's inbound message type and forwards to the transport."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from frankenpaxos_tpu.core.address import Address
+from frankenpaxos_tpu.core.serializer import Serializer
+from frankenpaxos_tpu.core.transport import Transport
+
+
+class Chan:
+    def __init__(
+        self,
+        transport: Transport,
+        src: Address,
+        dst: Address,
+        serializer: Serializer,
+    ):
+        self.transport = transport
+        self.src = src
+        self.dst = dst
+        self.serializer = serializer
+
+    def send(self, msg: Any) -> None:
+        self.transport.send(self.src, self.dst, self.serializer.to_bytes(msg))
+
+    def send_no_flush(self, msg: Any) -> None:
+        self.transport.send_no_flush(
+            self.src, self.dst, self.serializer.to_bytes(msg)
+        )
+
+    def flush(self) -> None:
+        self.transport.flush(self.src, self.dst)

@@ -1,0 +1,138 @@
+"""Leveled logging with fatal-checked assertions.
+
+Mirrors the reference ``Logger``
+(``shared/src/main/scala/frankenpaxos/Logger.scala:5-118``): five levels,
+lazy message arguments, and ``check*`` helpers that raise on violation
+(the reference's ``fatal`` returns ``Nothing``; ours raises
+``FatalError``). Implementations mirror ``PrintLogger``, ``FileLogger``,
+``JsLogger`` (ring buffer, for the viz), and ``FakeLogger`` (tests).
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import sys
+import time
+from typing import Any, Callable, List, Optional, Union
+
+LazyMsg = Union[str, Callable[[], str]]
+
+
+def _force(msg: LazyMsg) -> str:
+    return msg() if callable(msg) else msg
+
+
+class LogLevel(enum.IntEnum):
+    DEBUG = 0
+    INFO = 1
+    WARN = 2
+    ERROR = 3
+    FATAL = 4
+
+
+class FatalError(AssertionError):
+    """Raised by Logger.fatal; the sim harness treats it as an invariant
+    violation, deployment mains exit."""
+
+
+class Logger:
+    def __init__(self, level: LogLevel = LogLevel.DEBUG):
+        self.level = level
+
+    # Subclass hook.
+    def emit(self, level: LogLevel, message: str) -> None:
+        raise NotImplementedError
+
+    def _log(self, level: LogLevel, message: LazyMsg) -> None:
+        if level >= self.level:
+            self.emit(level, _force(message))
+
+    def debug(self, message: LazyMsg) -> None:
+        self._log(LogLevel.DEBUG, message)
+
+    def info(self, message: LazyMsg) -> None:
+        self._log(LogLevel.INFO, message)
+
+    def warn(self, message: LazyMsg) -> None:
+        self._log(LogLevel.WARN, message)
+
+    def error(self, message: LazyMsg) -> None:
+        self._log(LogLevel.ERROR, message)
+
+    def fatal(self, message: LazyMsg) -> "NoReturn":  # noqa: F821
+        text = _force(message)
+        self.emit(LogLevel.FATAL, text)
+        raise FatalError(text)
+
+    # Assertion helpers (Logger.scala:77-117).
+    def check(self, condition: bool, message: LazyMsg = "check failed") -> None:
+        if not condition:
+            self.fatal(message)
+
+    def check_eq(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if a != b:
+            self.fatal(message or (lambda: f"check_eq failed: {a!r} != {b!r}"))
+
+    def check_ne(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if a == b:
+            self.fatal(message or (lambda: f"check_ne failed: {a!r} == {b!r}"))
+
+    def check_lt(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if not a < b:
+            self.fatal(message or (lambda: f"check_lt failed: {a!r} >= {b!r}"))
+
+    def check_le(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if not a <= b:
+            self.fatal(message or (lambda: f"check_le failed: {a!r} > {b!r}"))
+
+    def check_gt(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if not a > b:
+            self.fatal(message or (lambda: f"check_gt failed: {a!r} <= {b!r}"))
+
+    def check_ge(self, a: Any, b: Any, message: Optional[LazyMsg] = None) -> None:
+        if not a >= b:
+            self.fatal(message or (lambda: f"check_ge failed: {a!r} < {b!r}"))
+
+
+class PrintLogger(Logger):
+    def __init__(self, level: LogLevel = LogLevel.DEBUG, prefix: str = ""):
+        super().__init__(level)
+        self.prefix = prefix
+
+    def emit(self, level: LogLevel, message: str) -> None:
+        ts = time.strftime("%H:%M:%S")
+        print(f"[{level.name:5s}] {ts} {self.prefix}{message}", file=sys.stderr)
+
+
+class FileLogger(Logger):
+    def __init__(self, path: str, level: LogLevel = LogLevel.DEBUG):
+        super().__init__(level)
+        self._f = open(path, "a")
+
+    def emit(self, level: LogLevel, message: str) -> None:
+        self._f.write(f"[{level.name}] {message}\n")
+        self._f.flush()
+
+
+class RingLogger(Logger):
+    """Keeps the last ``capacity`` records; used by the interactive viz
+    (cf. JsLogger's ring buffer, ``JsLogger.scala``)."""
+
+    def __init__(self, capacity: int = 1000, level: LogLevel = LogLevel.DEBUG):
+        super().__init__(level)
+        self.records: collections.deque = collections.deque(maxlen=capacity)
+
+    def emit(self, level: LogLevel, message: str) -> None:
+        self.records.append((level, message))
+
+
+class FakeLogger(Logger):
+    """Records everything; silent. For tests (cf. FakeLogger.scala)."""
+
+    def __init__(self, level: LogLevel = LogLevel.DEBUG):
+        super().__init__(level)
+        self.records: List[tuple] = []
+
+    def emit(self, level: LogLevel, message: str) -> None:
+        self.records.append((level, message))

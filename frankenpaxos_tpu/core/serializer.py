@@ -1,0 +1,66 @@
+"""Byte-serialization typeclass.
+
+Mirrors the reference's ``Serializer[A]`` trait
+(``shared/src/main/scala/frankenpaxos/Serializer.scala:5-10``) with
+``to_bytes``/``from_bytes``/``to_pretty_string`` and the standard instances
+(int/string/bytes, ``Serializer.scala:12-53``). ``WireSerializer`` plays the
+role of ``ProtoSerializer`` (``ProtoSerializer.scala:1-11``): the default
+serializer for protocol messages, backed by the :mod:`wire` codec.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Generic, TypeVar
+
+from frankenpaxos_tpu.core import wire
+
+A = TypeVar("A")
+
+
+class Serializer(Generic[A]):
+    def to_bytes(self, x: A) -> bytes:
+        raise NotImplementedError
+
+    def from_bytes(self, data: bytes) -> A:
+        raise NotImplementedError
+
+    def to_pretty_string(self, x: A) -> str:
+        return repr(x)
+
+
+class WireSerializer(Serializer[Any]):
+    """Serializer for any @wire.message dataclass (the ProtoSerializer
+    analog). A single instance serializes every registered message type, so
+    role ``InboundMessage`` wrapper types are just unions of message
+    classes."""
+
+    def to_bytes(self, x: Any) -> bytes:
+        return wire.encode(x)
+
+    def from_bytes(self, data: bytes) -> Any:
+        return wire.decode(data)
+
+
+class IntSerializer(Serializer[int]):
+    def to_bytes(self, x: int) -> bytes:
+        return struct.pack(">q", x)
+
+    def from_bytes(self, data: bytes) -> int:
+        return struct.unpack(">q", data)[0]
+
+
+class StringSerializer(Serializer[str]):
+    def to_bytes(self, x: str) -> bytes:
+        return x.encode("utf-8")
+
+    def from_bytes(self, data: bytes) -> str:
+        return data.decode("utf-8")
+
+
+class BytesSerializer(Serializer[bytes]):
+    def to_bytes(self, x: bytes) -> bytes:
+        return x
+
+    def from_bytes(self, data: bytes) -> bytes:
+        return data

@@ -1,0 +1,212 @@
+"""Deterministic in-process simulation transport.
+
+This is the reference's ``FakeTransport``
+(``shared/src/main/scala/frankenpaxos/FakeTransport.scala:64-240``) merged
+with the interactive capabilities of ``JsTransport``
+(``JsTransport.scala:60-299``):
+
+  * every ``send`` queues a :class:`QueuedMessage`; nothing is delivered
+    until the driver (a test, the simulator, or the viz) says so;
+  * timers never fire on their own; the driver triggers them;
+  * messages can be delivered, dropped, or duplicated in any order, and
+    actors can be partitioned (inbound+outbound drops) — message loss and
+    delay are therefore implicit in the scheduling model
+    (SURVEY.md §4: delivery can be postponed indefinitely);
+  * the full command history is recorded so an interactive session can be
+    exported as a regression test (cf. ``JsTransport.scala:260-298``).
+
+Commands (:class:`DeliverMessage` / :class:`TriggerTimer`) mirror the
+``FakeTransport.Command`` ADT (``FakeTransport.scala:185-193``). Messages
+hold bytes, so command equality is structural and delivery-by-value is
+well-defined under shrinking (``FakeTransport.scala:54-62``): delivering a
+message that is no longer pending is a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple, Union
+
+from frankenpaxos_tpu.core.address import Address
+from frankenpaxos_tpu.core.logger import Logger, PrintLogger
+from frankenpaxos_tpu.core.timer import Timer
+from frankenpaxos_tpu.core.transport import Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class QueuedMessage:
+    src: Address
+    dst: Address
+    data: bytes
+
+
+class SimTimer(Timer):
+    def __init__(
+        self,
+        transport: "SimTransport",
+        address: Address,
+        name: str,
+        delay: float,
+        f: Callable[[], None],
+    ):
+        super().__init__(name, delay, f)
+        self.transport = transport
+        self.address = address
+        self.running = False
+
+    def start(self) -> None:
+        if not self.running:
+            self.running = True
+
+    def stop(self) -> None:
+        self.running = False
+
+    def run(self) -> None:
+        # Mirrors FakeTransport timer semantics: a timer stops itself before
+        # running its callback so the callback can restart it.
+        if self.running:
+            self.running = False
+            self.f()
+
+
+@dataclasses.dataclass(frozen=True)
+class DeliverMessage:
+    msg: QueuedMessage
+
+
+@dataclasses.dataclass(frozen=True)
+class TriggerTimer:
+    address: Address
+    name: str
+
+
+SimCommand = Union[DeliverMessage, TriggerTimer]
+
+
+class SimTransport(Transport):
+    def __init__(self, logger: Optional[Logger] = None):
+        self.logger = logger or PrintLogger()
+        self.actors: Dict[Address, Any] = {}
+        self.messages: List[QueuedMessage] = []
+        self.timers: List[SimTimer] = []
+        self.partitioned: Set[Address] = set()
+        self.history: List[SimCommand] = []
+        # Per-(src,dst) buffers for send_no_flush/flush batching semantics.
+        self._unflushed: Dict[Tuple[Address, Address], List[bytes]] = {}
+
+    # -- Transport interface -------------------------------------------------
+
+    def register(self, address: Address, actor: Any) -> None:
+        if address in self.actors:
+            self.logger.fatal(f"duplicate actor registration at {address}")
+        self.actors[address] = actor
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        self.send_no_flush(src, dst, data)
+        self.flush(src, dst)
+
+    def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
+        if src in self.partitioned or dst in self.partitioned:
+            return
+        self._unflushed.setdefault((src, dst), []).append(data)
+
+    def flush(self, src: Address, dst: Address) -> None:
+        for data in self._unflushed.pop((src, dst), []):
+            self.messages.append(QueuedMessage(src, dst, data))
+
+    def flush_all(self) -> None:
+        for (src, dst) in list(self._unflushed):
+            self.flush(src, dst)
+
+    def timer(
+        self, address: Address, name: str, delay: float, f: Callable[[], None]
+    ) -> SimTimer:
+        t = SimTimer(self, address, name, delay, f)
+        self.timers.append(t)
+        return t
+
+    # -- Driver interface ----------------------------------------------------
+
+    def running_timers(self) -> List[SimTimer]:
+        return [t for t in self.timers if t.running]
+
+    def deliver_message(self, msg: QueuedMessage, record: bool = True) -> None:
+        """Deliver (and remove) the first pending message structurally equal
+        to ``msg`` (FakeTransport.scala:142-159). No-op if absent or if an
+        endpoint is partitioned — no-op semantics make command histories
+        shrinkable."""
+        if record:
+            self.history.append(DeliverMessage(msg))
+        try:
+            self.messages.remove(msg)
+        except ValueError:
+            return
+        if msg.src in self.partitioned or msg.dst in self.partitioned:
+            return
+        actor = self.actors.get(msg.dst)
+        if actor is None:
+            return
+        actor.receive(msg.src, actor.serializer.from_bytes(msg.data))
+        self.flush_all()
+
+    def drop_message(self, msg: QueuedMessage) -> None:
+        try:
+            self.messages.remove(msg)
+        except ValueError:
+            pass
+
+    def duplicate_message(self, msg: QueuedMessage) -> None:
+        if msg in self.messages:
+            self.messages.append(msg)
+
+    def trigger_timer(self, address: Address, name: str, record: bool = True) -> None:
+        """Fire the first running timer with this (address, name)
+        (FakeTransport.scala:161-179). No-op if none is running."""
+        if record:
+            self.history.append(TriggerTimer(address, name))
+        if address in self.partitioned:
+            return
+        for t in self.timers:
+            if t.running and t.address == address and t._name == name:
+                t.run()
+                self.flush_all()
+                return
+
+    def partition_actor(self, address: Address) -> None:
+        """Drop all traffic to/from ``address`` and all its pending messages
+        (JsTransport.scala:246-258)."""
+        self.partitioned.add(address)
+        self.messages = [
+            m
+            for m in self.messages
+            if m.src != address and m.dst != address
+        ]
+
+    def unpartition_actor(self, address: Address) -> None:
+        self.partitioned.discard(address)
+
+    # -- Random command generation (FakeTransport.scala:196-231) -------------
+
+    def generate_command(self, rng: random.Random) -> Optional[SimCommand]:
+        """Pick a random pending message or running timer, weighted by
+        count — this IS the network-nondeterminism model for property
+        testing."""
+        n_msgs = len(self.messages)
+        running = self.running_timers()
+        total = n_msgs + len(running)
+        if total == 0:
+            return None
+        i = rng.randrange(total)
+        if i < n_msgs:
+            return DeliverMessage(self.messages[i])
+        t = running[i - n_msgs]
+        return TriggerTimer(t.address, t._name)
+
+    def run_command(self, cmd: SimCommand, record: bool = True) -> None:
+        if isinstance(cmd, DeliverMessage):
+            self.deliver_message(cmd.msg, record=record)
+        elif isinstance(cmd, TriggerTimer):
+            self.trigger_timer(cmd.address, cmd.name, record=record)
+        else:
+            raise TypeError(f"unknown sim command {cmd!r}")

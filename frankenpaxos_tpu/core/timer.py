@@ -1,0 +1,28 @@
+"""Named restartable one-shot timer, mirroring the reference ``Timer``
+(``shared/src/main/scala/frankenpaxos/Timer.scala:23-42``): ``start``,
+``stop``, ``reset`` (= stop; start). Concrete transports subclass and
+implement the scheduling."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class Timer:
+    def __init__(self, name: str, delay: float, f: Callable[[], None]):
+        self._name = name
+        self.delay = delay
+        self.f = f
+
+    def name(self) -> str:
+        return self._name
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        self.stop()
+        self.start()

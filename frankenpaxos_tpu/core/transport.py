@@ -1,0 +1,59 @@
+"""The transport abstraction.
+
+Mirrors the reference's ``Transport[Self]`` trait
+(``shared/src/main/scala/frankenpaxos/Transport.scala:44-99``): actor
+registration, point-to-point sends with optional flush batching, and named
+one-shot timers.
+
+THE LOAD-BEARING CONTRACT (Transport.scala:37-39): every transport is a
+single-threaded event loop. ``Actor.receive`` calls and timer callbacks run
+serially, never concurrently. Protocol code therefore needs no locks, the
+sim transport is deterministic, and — the point of this project — each
+``receive`` is a pure-ish state transition that the TPU backend can batch
+and ``jax.vmap``.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Optional
+
+from frankenpaxos_tpu.core.address import Address
+
+if TYPE_CHECKING:
+    from frankenpaxos_tpu.core.actor import Actor
+    from frankenpaxos_tpu.core.timer import Timer
+
+
+class Transport:
+    def register(self, address: Address, actor: "Actor") -> None:
+        """Register an actor at an address (Transport.scala:58-61). At most
+        one actor per address."""
+        raise NotImplementedError
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        """Send bytes from src to dst and flush (Transport.scala:65-69)."""
+        raise NotImplementedError
+
+    def send_no_flush(self, src: Address, dst: Address, data: bytes) -> None:
+        """Buffer bytes for dst without flushing (Transport.scala:71-78).
+        Transports without write batching may treat this as send."""
+        self.send(src, dst, data)
+
+    def flush(self, src: Address, dst: Address) -> None:
+        """Flush buffered messages to dst (Transport.scala:80-84)."""
+
+    def timer(
+        self,
+        address: Address,
+        name: str,
+        delay: float,
+        f: Callable[[], None],
+    ) -> "Timer":
+        """Create a stopped one-shot timer owned by the actor at ``address``
+        (Transport.scala:88-93). ``delay`` is in seconds; the sim transports
+        interpret it as relative priority only. Names are non-unique; they
+        exist for debugging and test addressing (Timer.scala:1-22)."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        """Stop event loops / close sockets (NettyTcpTransport.scala:502)."""

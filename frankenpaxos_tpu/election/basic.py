@@ -1,0 +1,155 @@
+"""Basic (f+1-node) leader election.
+
+Capability parity with ``election/basic/Participant.scala``: Raft-style
+rounds WITHOUT the at-most-one-leader-per-round guarantee — multiple nodes
+may consider themselves leader of the same round, so only f+1 participants
+are needed to tolerate f faults. A leader pings periodically; a follower
+that misses pings for a randomized timeout bumps the round and becomes
+leader; a leader seeing a larger (round, leaderIndex) ballot steps down.
+``ForceNoPing`` forces a follower to immediately stand for election (used
+by chaos drivers). Callbacks fire on this participant's own
+leader/follower transitions (Participant.scala:149-164).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import random
+from typing import Callable, List, Optional, Sequence
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.util import random_duration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ElectionPing:
+    round: int
+    leader_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ForceNoPing:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ElectionOptions:
+    ping_period: float = 30.0
+    no_ping_timeout_min: float = 60.0
+    no_ping_timeout_max: float = 120.0
+
+
+class State(enum.Enum):
+    LEADER = "leader"
+    FOLLOWER = "follower"
+
+
+class Participant(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        addresses: Sequence[Address],
+        initial_leader_index: int = 0,
+        options: ElectionOptions = ElectionOptions(),
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        logger.check(address in addresses)
+        logger.check_le(options.no_ping_timeout_min, options.no_ping_timeout_max)
+        logger.check_le(0, initial_leader_index)
+        logger.check_lt(initial_leader_index, len(addresses))
+        self.addresses = list(addresses)
+        self.options = options
+        self.index = self.addresses.index(address)
+        self.others = [self.chan(a) for a in self.addresses if a != address]
+        self.callbacks: List[Callable[[int], None]] = []
+        self.round = 0
+        self.leader_index = initial_leader_index
+        rng = random.Random(seed)
+
+        def on_ping_timer() -> None:
+            self._ping(self.round, self.index)
+            self.ping_timer.start()
+
+        def on_no_ping() -> None:
+            self.round += 1
+            self.leader_index = self.index
+            self._change_state(State.LEADER)
+
+        self.ping_timer = self.timer("pingTimer", options.ping_period, on_ping_timer)
+        self.no_ping_timer = self.timer(
+            "noPingTimer",
+            random_duration(
+                rng, options.no_ping_timeout_min, options.no_ping_timeout_max
+            ),
+            on_no_ping,
+        )
+        if self.index == initial_leader_index:
+            self.state = State.LEADER
+            self.ping_timer.start()
+        else:
+            self.state = State.FOLLOWER
+            self.no_ping_timer.start()
+
+    def _ping(self, round: int, leader_index: int) -> None:
+        for ch in self.others:
+            ch.send(ElectionPing(round=round, leader_index=leader_index))
+
+    def _change_state(self, new_state: State) -> None:
+        if self.state == new_state:
+            return
+        if new_state == State.LEADER:  # follower -> leader
+            self.no_ping_timer.stop()
+            self.ping_timer.start()
+            self.state = State.LEADER
+            self._ping(self.round, self.index)
+        else:  # leader -> follower
+            self.ping_timer.stop()
+            self.no_ping_timer.start()
+            self.state = State.FOLLOWER
+        for callback in self.callbacks:
+            callback(self.leader_index)
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ElectionPing):
+            self._handle_ping(msg)
+        elif isinstance(msg, ForceNoPing):
+            self._handle_force_no_ping()
+        else:
+            self.logger.fatal(f"unknown election message {msg!r}")
+
+    def _handle_ping(self, ping: ElectionPing) -> None:
+        ping_ballot = (ping.round, ping.leader_index)
+        ballot = (self.round, self.leader_index)
+        if self.state == State.FOLLOWER:
+            if ping_ballot < ballot:
+                return  # stale
+            if ping_ballot == ballot:
+                self.no_ping_timer.reset()
+            else:
+                self.round = ping.round
+                self.leader_index = ping.leader_index
+                self.no_ping_timer.reset()
+        else:  # LEADER
+            if ping_ballot <= ballot:
+                return  # stale
+            self.round = ping.round
+            self.leader_index = ping.leader_index
+            self._change_state(State.FOLLOWER)
+
+    def _handle_force_no_ping(self) -> None:
+        if self.state == State.LEADER:
+            return
+        self.round += 1
+        self.leader_index = self.index
+        self._change_state(State.LEADER)
+
+    def register(self, callback: Callable[[int], None]) -> None:
+        """Register a callback fired with the leader index on this node's
+        own leader/follower transitions."""
+        self.callbacks.append(callback)

@@ -1,0 +1,246 @@
+"""Raft-style leader election (at most one leader per round; 2f+1 nodes).
+
+Capability parity with ``election/raft/Participant.scala:37-330``: states
+LeaderlessFollower / Follower / Candidate / Leader; randomized no-ping and
+not-enough-votes timeouts; a candidate collects majority votes to become
+leader; larger-round pings/vote-requests demote immediately. Callbacks fire
+with the new leader's address on follower transitions and on winning an
+election.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.util import random_duration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class RaftPing:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VoteRequest:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Vote:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ElectionOptions:
+    ping_period: float = 1.0
+    no_ping_timeout_min: float = 10.0
+    no_ping_timeout_max: float = 12.0
+    not_enough_votes_timeout_min: float = 10.0
+    not_enough_votes_timeout_max: float = 12.0
+
+
+@dataclasses.dataclass
+class LeaderlessFollower:
+    no_ping_timer: object
+
+
+@dataclasses.dataclass
+class Follower:
+    no_ping_timer: object
+    leader: Address
+
+
+@dataclasses.dataclass
+class Candidate:
+    not_enough_votes_timer: object
+    votes: Set[Address]
+
+
+@dataclasses.dataclass
+class Leader:
+    ping_timer: object
+
+
+class Participant(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        addresses: Sequence[Address],
+        leader: Optional[Address] = None,
+        options: ElectionOptions = ElectionOptions(),
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        logger.check(address in addresses)
+        logger.check_le(options.no_ping_timeout_min, options.no_ping_timeout_max)
+        logger.check_le(
+            options.not_enough_votes_timeout_min,
+            options.not_enough_votes_timeout_max,
+        )
+        if leader is not None:
+            logger.check(leader in addresses)
+        self.addresses = list(addresses)
+        self.options = options
+        self.rng = random.Random(seed)
+        self.nodes = {a: self.chan(a) for a in self.addresses}
+        self.callbacks: List[Callable[[Address], None]] = []
+        self.round = 0
+        if leader is not None:
+            if address == leader:
+                t = self._ping_timer()
+                t.start()
+                self.state = Leader(t)
+            else:
+                t = self._no_ping_timer()
+                t.start()
+                self.state = Follower(t, leader)
+        else:
+            t = self._no_ping_timer()
+            t.start()
+            self.state = LeaderlessFollower(t)
+
+    def register(self, callback: Callable[[Address], None]) -> None:
+        self.callbacks.append(callback)
+
+    # -- Timers --------------------------------------------------------------
+
+    def _ping_timer(self):
+        def fire() -> None:
+            for ch in self.nodes.values():
+                ch.send(RaftPing(round=self.round))
+            timer.start()
+
+        timer = self.timer("pingTimer", self.options.ping_period, fire)
+        return timer
+
+    def _no_ping_timer(self):
+        def fire() -> None:
+            self._become_candidate()
+
+        return self.timer(
+            "noPingTimer",
+            random_duration(
+                self.rng,
+                self.options.no_ping_timeout_min,
+                self.options.no_ping_timeout_max,
+            ),
+            fire,
+        )
+
+    def _not_enough_votes_timer(self):
+        def fire() -> None:
+            self._become_candidate()
+
+        return self.timer(
+            "notEnoughVotesTimer",
+            random_duration(
+                self.rng,
+                self.options.not_enough_votes_timeout_min,
+                self.options.not_enough_votes_timeout_max,
+            ),
+            fire,
+        )
+
+    def _stop_timer(self) -> None:
+        s = self.state
+        if isinstance(s, LeaderlessFollower):
+            s.no_ping_timer.stop()
+        elif isinstance(s, Follower):
+            s.no_ping_timer.stop()
+        elif isinstance(s, Candidate):
+            s.not_enough_votes_timer.stop()
+        elif isinstance(s, Leader):
+            s.ping_timer.stop()
+
+    # -- Transitions ---------------------------------------------------------
+
+    def _become_candidate(self) -> None:
+        self._stop_timer()
+        self.round += 1
+        t = self._not_enough_votes_timer()
+        t.start()
+        self.state = Candidate(t, set())
+        for ch in self.nodes.values():
+            ch.send(VoteRequest(round=self.round))
+
+    def _transition_to_follower(self, new_round: int, leader: Address) -> None:
+        self._stop_timer()
+        self.round = new_round
+        t = self._no_ping_timer()
+        t.start()
+        self.state = Follower(t, leader)
+        for callback in self.callbacks:
+            callback(leader)
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, RaftPing):
+            self._handle_ping(src, msg)
+        elif isinstance(msg, VoteRequest):
+            self._handle_vote_request(src, msg)
+        elif isinstance(msg, Vote):
+            self._handle_vote(src, msg)
+        else:
+            self.logger.fatal(f"unknown raft election message {msg!r}")
+
+    def _handle_ping(self, src: Address, ping: RaftPing) -> None:
+        if ping.round < self.round:
+            return
+        if ping.round > self.round:
+            self._transition_to_follower(ping.round, src)
+            return
+        s = self.state
+        if isinstance(s, (LeaderlessFollower, Candidate)):
+            self._transition_to_follower(ping.round, src)
+        elif isinstance(s, Follower):
+            s.no_ping_timer.reset()
+        # Leader: ping from ourselves; ignore.
+
+    def _handle_vote_request(self, src: Address, req: VoteRequest) -> None:
+        if req.round < self.round:
+            return
+        if req.round > self.round:
+            self._stop_timer()
+            self.round = req.round
+            t = self._no_ping_timer()
+            t.start()
+            self.state = LeaderlessFollower(t)
+            self.nodes[src].send(Vote(round=self.round))
+            return
+        if isinstance(self.state, Candidate) and src == self.address:
+            self.nodes[src].send(Vote(round=self.round))
+
+    def _handle_vote(self, src: Address, vote: Vote) -> None:
+        if vote.round < self.round:
+            return
+        if vote.round > self.round:
+            self.logger.fatal(
+                f"received a vote for round {vote.round} but only in round "
+                f"{self.round}"
+            )
+        s = self.state
+        if isinstance(s, LeaderlessFollower):
+            self.logger.fatal(
+                f"received a vote in round {vote.round} as a leaderless follower"
+            )
+        elif isinstance(s, Candidate):
+            s.votes.add(src)
+            if len(s.votes) >= len(self.addresses) // 2 + 1:
+                self._stop_timer()
+                t = self._ping_timer()
+                t.start()
+                self.state = Leader(t)
+                for ch in self.nodes.values():
+                    ch.send(RaftPing(round=self.round))
+                for callback in self.callbacks:
+                    callback(self.address)
+        # Follower/Leader: late votes; ignore.

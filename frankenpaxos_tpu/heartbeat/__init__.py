@@ -1,0 +1,126 @@
+"""All-pairs heartbeat failure detector.
+
+Capability parity with ``heartbeat/Participant.scala:39-209``: every
+participant pings every other; a missing pong within ``fail_period``
+triggers a retry; ``num_retries`` consecutive misses mark the peer dead; a
+pong revives it and feeds an EWMA estimate of one-way network delay.
+Options mimic TCP keepalive (:39-60). ``unsafe_alive()`` /
+``unsafe_network_delay()`` must only be called from the same transport's
+event loop (:189-208).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+
+INFINITE_DELAY = float("inf")
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HeartbeatPing:
+    index: int  # the destination's index in the *sender's* address list
+    clock: float  # sender's clock at send time
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HeartbeatPong:
+    index: int
+    clock: float  # echoed
+
+
+@dataclasses.dataclass(frozen=True)
+class HeartbeatOptions:
+    fail_period: float = 5.0
+    success_period: float = 10.0
+    num_retries: int = 3
+    network_delay_alpha: float = 0.9
+
+
+class Participant(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        addresses: Sequence[Address],
+        options: HeartbeatOptions = HeartbeatOptions(),
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__(address, transport, logger)
+        logger.check_le(0, options.network_delay_alpha)
+        logger.check_le(options.network_delay_alpha, 1)
+        self.addresses = list(addresses)
+        self.options = options
+        self.clock = clock
+        self.chans = [self.chan(a) for a in self.addresses]
+        self.fail_timers = [
+            self.timer(f"failTimer{a}", options.fail_period, self._fail_fn(i))
+            for i, a in enumerate(self.addresses)
+        ]
+        self.success_timers = [
+            self.timer(f"successTimer{a}", options.success_period, self._succeed_fn(i))
+            for i, a in enumerate(self.addresses)
+        ]
+        self.num_retries: List[int] = [0] * len(self.addresses)
+        self.network_delay: Dict[int, float] = {}
+        self.alive: Set[Address] = set(self.addresses)
+        for i, ch in enumerate(self.chans):
+            ch.send(HeartbeatPing(index=i, clock=self.clock()))
+            self.fail_timers[i].start()
+
+    def _fail_fn(self, index: int) -> Callable[[], None]:
+        def fail() -> None:
+            self.num_retries[index] += 1
+            if self.num_retries[index] >= self.options.num_retries:
+                self.alive.discard(self.addresses[index])
+            self.chans[index].send(HeartbeatPing(index=index, clock=self.clock()))
+            self.fail_timers[index].start()
+
+        return fail
+
+    def _succeed_fn(self, index: int) -> Callable[[], None]:
+        def succeed() -> None:
+            self.chans[index].send(HeartbeatPing(index=index, clock=self.clock()))
+            self.fail_timers[index].start()
+
+        return succeed
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, HeartbeatPing):
+            self.chan(src).send(HeartbeatPong(index=msg.index, clock=msg.clock))
+        elif isinstance(msg, HeartbeatPong):
+            self._handle_pong(msg)
+        else:
+            self.logger.fatal(f"unknown heartbeat message {msg!r}")
+
+    def _handle_pong(self, pong: HeartbeatPong) -> None:
+        delay = (self.clock() - pong.clock) / 2
+        alpha = self.options.network_delay_alpha
+        prev = self.network_delay.get(pong.index)
+        self.network_delay[pong.index] = (
+            delay if prev is None else alpha * delay + (1 - alpha) * prev
+        )
+        self.alive.add(self.addresses[pong.index])
+        self.num_retries[pong.index] = 0
+        self.fail_timers[pong.index].stop()
+        self.success_timers[pong.index].start()
+
+    # -- Same-transport-only accessors (Participant.scala:189-208) -----------
+
+    def unsafe_alive(self) -> Set[Address]:
+        return set(self.alive)
+
+    def unsafe_network_delay(self) -> Dict[Address, float]:
+        out = {}
+        for i, a in enumerate(self.addresses):
+            if a in self.alive and i in self.network_delay:
+                out[a] = self.network_delay[i]
+            else:
+                out[a] = INFINITE_DELAY
+        return out

@@ -1,0 +1,194 @@
+"""Prometheus-shaped metrics facade.
+
+Mirrors the reference ``monitoring`` package
+(``shared/src/main/scala/frankenpaxos/monitoring/Collectors.scala:6-15``):
+a ``Collectors`` interface providing Counter/Gauge/Summary builders, with a
+real Prometheus-style implementation for deployments and a no-op/fake for
+simulation and tests. Dependency-free: we keep our own registry and emit
+Prometheus text exposition format, served by a tiny HTTP exporter thread
+(the analog of ``jvm/.../PrometheusUtil.scala:6-15``).
+"""
+
+from __future__ import annotations
+
+import http.server
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.label_names = label_names
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+
+    def labels(self, *values: str) -> "_Metric":
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} labels, got {values}"
+            )
+        child = self._children.get(values)
+        if child is None:
+            child = type(self)(self.name, self.help, ())
+            self._children[values] = child
+        return child
+
+    def _label_str(self, values: Tuple[str, ...]) -> str:
+        if not values:
+            return ""
+        pairs = ",".join(
+            f'{k}="{v}"' for k, v in zip(self.label_names, values)
+        )
+        return "{" + pairs + "}"
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...] = ()):
+        super().__init__(name, help, label_names)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def get(self) -> float:
+        return self.value
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        if self._children:
+            for values, child in self._children.items():
+                lines.append(f"{self.name}{self._label_str(values)} {child.value}")
+        else:
+            lines.append(f"{self.name} {self.value}")
+        return lines
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...] = ()):
+        super().__init__(name, help, label_names)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+    def get(self) -> float:
+        return self.value
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        if self._children:
+            for values, child in self._children.items():
+                lines.append(f"{self.name}{self._label_str(values)} {child.value}")
+        else:
+            lines.append(f"{self.name} {self.value}")
+        return lines
+
+
+class Summary(_Metric):
+    """Count/sum summary with streaming reservoir-free quantile estimates
+    (p50/p90/p99 over a bounded ring of recent observations)."""
+
+    RING = 4096
+
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...] = ()):
+        super().__init__(name, help, label_names)
+        self.count = 0
+        self.sum = 0.0
+        self._ring: List[float] = []
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if len(self._ring) < self.RING:
+            self._ring.append(v)
+        else:
+            self._ring[self.count % self.RING] = v
+
+    def quantile(self, q: float) -> float:
+        if not self._ring:
+            return math.nan
+        s = sorted(self._ring)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        targets = self._children.items() if self._children else [((), self)]
+        for values, child in targets:
+            base = self._label_str(values)
+            lines.append(f"{self.name}_count{base} {child.count}")
+            lines.append(f"{self.name}_sum{base} {child.sum}")
+        return lines
+
+
+class Collectors:
+    """Factory + registry for metrics (Collectors.scala:6-15). Use
+    ``PrometheusCollectors`` in deployments and ``FakeCollectors`` in
+    sims/tests; both share this implementation — Fake simply never exposes."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+
+    def counter(self, name: str, help: str = "", labels: Tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels: Tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def summary(self, name: str, help: str = "", labels: Tuple[str, ...] = ()) -> Summary:
+        return self._get_or_create(Summary, name, help, labels)
+
+    def _get_or_create(self, cls, name, help, labels):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, help, tuple(labels))
+            self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name} re-registered as different type")
+        return m
+
+    def expose_text(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class FakeCollectors(Collectors):
+    """No-op-exposure collectors for tests/sims (FakeCollectors.scala); the
+    metrics still record values so tests can assert on them."""
+
+
+class PrometheusCollectors(Collectors):
+    """Collectors with an HTTP /metrics exporter
+    (PrometheusUtil.scala:6-15). ``port=-1`` disables the server."""
+
+    def start_http_server(self, port: int, host: str = "0.0.0.0"):
+        if port == -1:
+            return None
+        collectors = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = collectors.expose_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence
+                pass
+
+        server = http.server.ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server

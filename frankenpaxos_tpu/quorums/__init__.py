@@ -1,0 +1,202 @@
+"""Read-write quorum systems for Flexible Paxos.
+
+Capability parity with the reference ``quorums`` package
+(``shared/src/main/scala/frankenpaxos/quorums/QuorumSystem.scala:16-24``):
+``SimpleMajority`` (``SimpleMajority.scala:19-56``), ``UnanimousWrites``
+(``UnanimousWrites.scala:17-``), and ``Grid`` (rows are read quorums, one
+element per row is a write quorum; ``Grid.scala:5-57``), plus wire
+round-tripping (the analog of ``QuorumSystem.toProto/fromProto``,
+``QuorumSystem.scala:26-61``).
+
+A read-write quorum system over a node set X is two families R, W of
+subsets of X such that every r in R intersects every w in W. MultiPaxos
+needs only this (Flexible Paxos); simple majorities are the special case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import FrozenSet, Generic, List, Sequence, Set, Tuple, TypeVar
+
+from frankenpaxos_tpu.core import wire
+
+T = TypeVar("T")
+
+
+class QuorumSystem(Generic[T]):
+    def nodes(self) -> FrozenSet[T]:
+        raise NotImplementedError
+
+    def random_read_quorum(self) -> Set[T]:
+        raise NotImplementedError
+
+    def random_write_quorum(self) -> Set[T]:
+        raise NotImplementedError
+
+    def is_read_quorum(self, xs: Set[T]) -> bool:
+        raise NotImplementedError
+
+    def is_write_quorum(self, xs: Set[T]) -> bool:
+        raise NotImplementedError
+
+    def is_superset_of_read_quorum(self, xs: Set[T]) -> bool:
+        raise NotImplementedError
+
+    def is_superset_of_write_quorum(self, xs: Set[T]) -> bool:
+        raise NotImplementedError
+
+
+class SimpleMajority(QuorumSystem[T]):
+    """Every majority is both a read and a write quorum."""
+
+    def __init__(self, members: Set[T], seed: int = 0):
+        if not members:
+            raise ValueError("SimpleMajority requires at least one member")
+        self.members = frozenset(members)
+        self._rand = random.Random(seed)
+        self.quorum_size = len(self.members) // 2 + 1
+
+    def __repr__(self) -> str:
+        return f"SimpleMajority(members={sorted(self.members)})"
+
+    def nodes(self) -> FrozenSet[T]:
+        return self.members
+
+    def random_read_quorum(self) -> Set[T]:
+        return set(self._rand.sample(sorted(self.members), self.quorum_size))
+
+    def random_write_quorum(self) -> Set[T]:
+        return self.random_read_quorum()
+
+    def is_read_quorum(self, xs: Set[T]) -> bool:
+        if not xs <= self.members:
+            raise ValueError(f"{xs} is not a subset of {self.members}")
+        return len(xs) >= self.quorum_size
+
+    def is_write_quorum(self, xs: Set[T]) -> bool:
+        return self.is_read_quorum(xs)
+
+    def is_superset_of_read_quorum(self, xs: Set[T]) -> bool:
+        return len(xs & self.members) >= self.quorum_size
+
+    def is_superset_of_write_quorum(self, xs: Set[T]) -> bool:
+        return self.is_superset_of_read_quorum(xs)
+
+
+class UnanimousWrites(QuorumSystem[T]):
+    """The single write quorum is all members; any non-empty subset reads."""
+
+    def __init__(self, members: Set[T], seed: int = 0):
+        if not members:
+            raise ValueError("UnanimousWrites requires at least one member")
+        self.members = frozenset(members)
+        self._rand = random.Random(seed)
+
+    def __repr__(self) -> str:
+        return f"UnanimousWrites(members={sorted(self.members)})"
+
+    def nodes(self) -> FrozenSet[T]:
+        return self.members
+
+    def random_read_quorum(self) -> Set[T]:
+        return {self._rand.choice(sorted(self.members))}
+
+    def random_write_quorum(self) -> Set[T]:
+        return set(self.members)
+
+    def is_read_quorum(self, xs: Set[T]) -> bool:
+        if not xs <= self.members:
+            raise ValueError(f"{xs} is not a subset of {self.members}")
+        return len(xs) > 0
+
+    def is_write_quorum(self, xs: Set[T]) -> bool:
+        if not xs <= self.members:
+            raise ValueError(f"{xs} is not a subset of {self.members}")
+        return xs == self.members
+
+    def is_superset_of_read_quorum(self, xs: Set[T]) -> bool:
+        return bool(xs & self.members)
+
+    def is_superset_of_write_quorum(self, xs: Set[T]) -> bool:
+        return self.members <= xs
+
+
+class Grid(QuorumSystem[T]):
+    """Nodes in an n x m grid; each row is a read quorum, each one-per-row
+    transversal (in practice, each column) is a write quorum."""
+
+    def __init__(self, grid: Sequence[Sequence[T]], seed: int = 0):
+        if not grid:
+            raise ValueError("Grid requires a non-empty grid")
+        if any(len(row) != len(grid[0]) for row in grid):
+            raise ValueError("Grid requires equal-sized rows")
+        self.grid: List[List[T]] = [list(row) for row in grid]
+        self._rows = [frozenset(row) for row in self.grid]
+        self._rand = random.Random(seed)
+        self._nodes = frozenset(x for row in self._rows for x in row)
+
+    def __repr__(self) -> str:
+        return f"Grid(grid={self.grid})"
+
+    def nodes(self) -> FrozenSet[T]:
+        return self._nodes
+
+    def random_read_quorum(self) -> Set[T]:
+        return set(self.grid[self._rand.randrange(len(self.grid))])
+
+    def random_write_quorum(self) -> Set[T]:
+        i = self._rand.randrange(len(self.grid[0]))
+        return {row[i] for row in self.grid}
+
+    def is_read_quorum(self, xs: Set[T]) -> bool:
+        if not xs <= self._nodes:
+            raise ValueError(f"{xs} is not a subset of {self._nodes}")
+        return any(row <= xs for row in self._rows)
+
+    def is_write_quorum(self, xs: Set[T]) -> bool:
+        if not xs <= self._nodes:
+            raise ValueError(f"{xs} is not a subset of {self._nodes}")
+        return all(row & xs for row in self._rows)
+
+    def is_superset_of_read_quorum(self, xs: Set[T]) -> bool:
+        return any(row <= xs for row in self._rows)
+
+    def is_superset_of_write_quorum(self, xs: Set[T]) -> bool:
+        return all(row & xs for row in self._rows)
+
+
+# -- Wire round-tripping (QuorumSystem.scala:26-61) --------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class QuorumSystemProto:
+    kind: str  # "simple_majority" | "unanimous_writes" | "grid"
+    members: tuple  # flat members, or row tuples for grid
+    num_cols: int
+
+
+def to_proto(qs: QuorumSystem[int]) -> QuorumSystemProto:
+    if isinstance(qs, SimpleMajority):
+        return QuorumSystemProto("simple_majority", tuple(sorted(qs.members)), 0)
+    if isinstance(qs, UnanimousWrites):
+        return QuorumSystemProto("unanimous_writes", tuple(sorted(qs.members)), 0)
+    if isinstance(qs, Grid):
+        flat = tuple(x for row in qs.grid for x in row)
+        return QuorumSystemProto("grid", flat, len(qs.grid[0]))
+    raise TypeError(f"unserializable quorum system {qs!r}")
+
+
+def from_proto(proto: QuorumSystemProto, seed: int = 0) -> QuorumSystem[int]:
+    if proto.kind == "simple_majority":
+        return SimpleMajority(set(proto.members), seed)
+    if proto.kind == "unanimous_writes":
+        return UnanimousWrites(set(proto.members), seed)
+    if proto.kind == "grid":
+        m = proto.num_cols
+        rows = [
+            list(proto.members[i : i + m]) for i in range(0, len(proto.members), m)
+        ]
+        return Grid(rows, seed)
+    raise ValueError(f"unknown quorum system kind {proto.kind!r}")

@@ -1,0 +1,219 @@
+"""Round systems: assignment of integer rounds to leaders, each round
+classic or fast.
+
+Capability parity with
+``shared/src/main/scala/frankenpaxos/roundsystem/RoundSystem.scala``:
+``ClassicRoundRobin`` (:60-87), ``ClassicStutteredRoundRobin`` (:118-167),
+``RoundZeroFast`` (:183-212), ``MixedRoundRobin`` (:229-264),
+``RenamedRoundSystem``/``RotatedRoundSystem`` and the rotated convenience
+classes (:291-424). Every leader owns infinitely many classic rounds;
+``next_classic_round(leader, round)`` is the smallest classic round for
+``leader`` strictly greater than ``round`` (or the first one if round < 0).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+
+class RoundType(enum.Enum):
+    CLASSIC = "classic"
+    FAST = "fast"
+
+
+class RoundSystem:
+    def num_leaders(self) -> int:
+        raise NotImplementedError
+
+    def leader(self, round: int) -> int:
+        raise NotImplementedError
+
+    def round_type(self, round: int) -> RoundType:
+        raise NotImplementedError
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        raise NotImplementedError
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        raise NotImplementedError
+
+
+class ClassicRoundRobin(RoundSystem):
+    """Classic rounds assigned round-robin; no fast rounds."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __repr__(self) -> str:
+        return f"ClassicRoundRobin({self.n})"
+
+    def num_leaders(self) -> int:
+        return self.n
+
+    def leader(self, round: int) -> int:
+        return round % self.n
+
+    def round_type(self, round: int) -> RoundType:
+        return RoundType.CLASSIC
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        if round < 0:
+            return leader_index
+        base = self.n * (round // self.n)
+        offset = leader_index % self.n
+        return base + offset if base + offset > round else base + self.n + offset
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        return None
+
+
+class ClassicStutteredRoundRobin(RoundSystem):
+    """Round-robin in stutters of ``stutter_length`` (a leader owns runs of
+    consecutive rounds); no fast rounds."""
+
+    def __init__(self, n: int, stutter_length: int):
+        if n <= 1:
+            raise ValueError("n must be > 1")
+        if stutter_length < 1:
+            raise ValueError("stutter_length must be >= 1")
+        self.n = n
+        self.stutter = stutter_length
+
+    def __repr__(self) -> str:
+        return f"ClassicStutteredRoundRobin(n={self.n}, stutter={self.stutter})"
+
+    def num_leaders(self) -> int:
+        return self.n
+
+    def leader(self, round: int) -> int:
+        return (round // self.stutter) % self.n
+
+    def round_type(self, round: int) -> RoundType:
+        return RoundType.CLASSIC
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        if round < 0:
+            return leader_index * self.stutter
+        if self.leader(round + 1) == leader_index:
+            return round + 1
+        chunk = self.n * self.stutter
+        start_of_chunk = chunk * (round // chunk)
+        start_of_stutter = start_of_chunk + leader_index * self.stutter
+        if self.leader(round) < leader_index:
+            return start_of_stutter
+        return start_of_stutter + chunk
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        return None
+
+
+class RoundZeroFast(RoundSystem):
+    """Round 0 is fast (leader 0); rounds 1, 2, ... are classic round-robin.
+    Used by BPaxos and implicitly EPaxos."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __repr__(self) -> str:
+        return f"RoundZeroFast({self.n})"
+
+    def num_leaders(self) -> int:
+        return self.n
+
+    def leader(self, round: int) -> int:
+        return 0 if round == 0 else (round - 1) % self.n
+
+    def round_type(self, round: int) -> RoundType:
+        return RoundType.FAST if round == 0 else RoundType.CLASSIC
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        return 1 + ClassicRoundRobin(self.n).next_classic_round(
+            leader_index, round - 1
+        )
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        return 0 if leader_index == 0 and round < 0 else None
+
+
+class MixedRoundRobin(RoundSystem):
+    """Contiguous (fast, classic) round pairs assigned round-robin."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __repr__(self) -> str:
+        return f"MixedRoundRobin({self.n})"
+
+    def num_leaders(self) -> int:
+        return self.n
+
+    def leader(self, round: int) -> int:
+        return (round // 2) % self.n
+
+    def round_type(self, round: int) -> RoundType:
+        return RoundType.FAST if round % 2 == 0 else RoundType.CLASSIC
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        if round >= 0 and (round // 2) % self.n == leader_index and round % 2 == 0:
+            return round + 1
+        return self.next_fast_round(leader_index, round) + 1
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        if round < 0:
+            return leader_index * 2
+        return ClassicRoundRobin(self.n).next_classic_round(
+            leader_index, round // 2
+        ) * 2
+
+
+class RenamedRoundSystem(RoundSystem):
+    """Adapts a round system by permuting leader identities."""
+
+    def __init__(self, round_system: RoundSystem, renaming: Dict[int, int]):
+        self.rs = round_system
+        self.renaming = dict(renaming)
+        self.unrenaming = {v: k for k, v in renaming.items()}
+
+    def __repr__(self) -> str:
+        return f"Renamed({self.rs!r}, {self.renaming})"
+
+    def num_leaders(self) -> int:
+        return self.rs.num_leaders()
+
+    def leader(self, round: int) -> int:
+        return self.renaming[self.rs.leader(round)]
+
+    def round_type(self, round: int) -> RoundType:
+        return self.rs.round_type(round)
+
+    def next_classic_round(self, leader_index: int, round: int) -> int:
+        return self.rs.next_classic_round(self.unrenaming[leader_index], round)
+
+    def next_fast_round(self, leader_index: int, round: int) -> Optional[int]:
+        return self.rs.next_fast_round(self.unrenaming[leader_index], round)
+
+
+class RotatedRoundSystem(RenamedRoundSystem):
+    """Renamed round system with leaders rotated by ``rotation``."""
+
+    def __init__(self, round_system: RoundSystem, rotation: int):
+        n = round_system.num_leaders()
+        super().__init__(round_system, {i: (i + rotation) % n for i in range(n)})
+        self.rotation = rotation
+
+
+class RotatedClassicRoundRobin(RotatedRoundSystem):
+    def __init__(self, n: int, first_leader: int):
+        super().__init__(ClassicRoundRobin(n), first_leader)
+
+    def __repr__(self) -> str:
+        return f"RotatedClassicRoundRobin({self.rs.n}, {self.rotation})"
+
+
+class RotatedRoundZeroFast(RotatedRoundSystem):
+    def __init__(self, n: int, first_leader: int):
+        super().__init__(RoundZeroFast(n), first_leader)
+
+    def __repr__(self) -> str:
+        return f"RotatedRoundZeroFast({self.rs.n}, {self.rotation})"

@@ -1,0 +1,399 @@
+"""Pluggable deterministic state machines with conflict detection and
+snapshots.
+
+Capability parity with the reference ``statemachine`` package:
+``StateMachine`` trait (``statemachine/StateMachine.scala:11-46``: ``run``,
+``conflicts``, ``to_bytes``/``from_bytes`` snapshots, ``conflict_index``,
+``top_k_conflict_index``), the registry-by-name used by CLI flags
+(:48-59), and the implementations ``Noop``, ``Register``, ``AppendLog``,
+``ReadableAppendLog``, and ``KeyValueStore`` (get/set over a string map;
+two commands conflict iff their key sets intersect and at least one
+writes, ``KeyValueStore.scala:77-96``; inverted-index ConflictIndex
+:112-217 and TopK variant :219-383). ``TypedStateMachine`` adapts
+struct-typed SMs to the bytes interface (``TypedStateMachine.scala``).
+
+Commands and outputs are bytes at the framework boundary (what protocols
+replicate); typed SMs use the wire codec for their inputs/outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Generic, List, Optional, Set, Tuple, TypeVar
+
+from frankenpaxos_tpu.core import wire
+from frankenpaxos_tpu.util import TopK, TopOne, VertexIdLike
+
+Key = TypeVar("Key")
+
+
+class ConflictIndex(Generic[Key]):
+    """Tracks put commands by key and answers "which commands conflict with
+    this one" (``statemachine/ConflictIndex.scala``)."""
+
+    def put(self, key: Key, command: bytes) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: Key) -> None:
+        raise NotImplementedError
+
+    def get_conflicts(self, command: bytes) -> Set[Key]:
+        raise NotImplementedError
+
+    def put_snapshot(self, key: Key) -> None:
+        """Record a snapshot command, which conflicts with everything."""
+        raise NotImplementedError
+
+
+class NaiveConflictIndex(ConflictIndex[Key]):
+    """O(n) conflict index valid for any state machine
+    (StateMachine.scala's default conflictIndex)."""
+
+    def __init__(self, conflicts):
+        self._conflicts = conflicts
+        self.commands: Dict[Key, bytes] = {}
+        self.snapshots: Set[Key] = set()
+
+    def put(self, key: Key, command: bytes) -> None:
+        self.commands[key] = command
+
+    def remove(self, key: Key) -> None:
+        self.commands.pop(key, None)
+        self.snapshots.discard(key)
+
+    def put_snapshot(self, key: Key) -> None:
+        self.snapshots.add(key)
+
+    def get_conflicts(self, command: bytes) -> Set[Key]:
+        out = {
+            k for k, cmd in self.commands.items() if self._conflicts(cmd, command)
+        }
+        return out | set(self.snapshots)
+
+
+class StateMachine:
+    """A deterministic state machine (StateMachine.scala:11-46)."""
+
+    def run(self, input: bytes) -> bytes:
+        raise NotImplementedError
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        raise NotImplementedError
+
+    def to_bytes(self) -> bytes:
+        """Snapshot the state (does not mutate)."""
+        raise NotImplementedError
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        """Replace state with a snapshot produced by to_bytes."""
+        raise NotImplementedError
+
+    def conflict_index(self) -> ConflictIndex:
+        return NaiveConflictIndex(self.conflicts)
+
+    def top_k_conflict_index(
+        self, k: int, num_leaders: int, like: VertexIdLike
+    ) -> ConflictIndex:
+        return TopKConflictIndexAdapter(self, k, num_leaders, like)
+
+
+class TopKConflictIndexAdapter(ConflictIndex):
+    """Generic top-k conflict index: instead of exact conflict sets, keeps
+    the top-k conflicting vertex ids per leader (the compression EPaxos-family
+    protocols use for dependency sets; KeyValueStore.scala:219-383)."""
+
+    def __init__(self, sm: StateMachine, k: int, num_leaders: int, like: VertexIdLike):
+        self.sm = sm
+        self.like = like
+        self.k = k
+        self.num_leaders = num_leaders
+        self.commands: Dict[Any, bytes] = {}
+        self.snapshot_top = TopK(k, num_leaders, like) if k > 1 else None
+        self.snapshot_top_one = TopOne(num_leaders, like) if k == 1 else None
+
+    def put(self, key, command: bytes) -> None:
+        self.commands[key] = command
+
+    def remove(self, key) -> None:
+        self.commands.pop(key, None)
+
+    def put_snapshot(self, key) -> None:
+        if self.k == 1:
+            self.snapshot_top_one.put(key)
+        else:
+            self.snapshot_top.put(key)
+
+    def get_top_k_conflicts(self, command: bytes) -> List[Set[int]]:
+        """Per-leader top-k conflicting ids (including snapshots)."""
+        top = TopK(self.k, self.num_leaders, self.like)
+        for key, cmd in self.commands.items():
+            if self.sm.conflicts(cmd, command):
+                top.put(key)
+        if self.k == 1 and self.snapshot_top_one is not None:
+            for i, frontier in enumerate(self.snapshot_top_one.get()):
+                if frontier > 0:
+                    top.put(self.like.make(i, frontier - 1))
+        elif self.snapshot_top is not None:
+            merged = TopK(self.k, self.num_leaders, self.like)
+            merged.merge_equals(self.snapshot_top)
+            merged.merge_equals(top)
+            top = merged
+        return top.get()
+
+    def get_conflicts(self, command: bytes) -> Set:
+        return {
+            self.like.make(i, id_)
+            for i, ids in enumerate(self.get_top_k_conflicts(command))
+            for id_ in ids
+        }
+
+
+# -- Implementations ---------------------------------------------------------
+
+
+class Noop(StateMachine):
+    """Ignores inputs, outputs empty bytes (Noop.scala)."""
+
+    def run(self, input: bytes) -> bytes:
+        return b""
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        return False
+
+    def to_bytes(self) -> bytes:
+        return b""
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        return "Noop"
+
+
+class Register(StateMachine):
+    """A single register; every write conflicts (Register.scala)."""
+
+    def __init__(self) -> None:
+        self.x = b""
+
+    def run(self, input: bytes) -> bytes:
+        self.x = input
+        return self.x
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        return True
+
+    def to_bytes(self) -> bytes:
+        return self.x
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        self.x = snapshot
+
+    def __repr__(self) -> str:
+        return f"Register({self.x!r})"
+
+
+class AppendLog(StateMachine):
+    """Append-only log; returns the index of the appended entry
+    (AppendLog.scala)."""
+
+    def __init__(self) -> None:
+        self.log: List[bytes] = []
+
+    def run(self, input: bytes) -> bytes:
+        self.log.append(input)
+        return wire.encode(len(self.log) - 1)
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        return True
+
+    def to_bytes(self) -> bytes:
+        return wire.encode(self.log)
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        self.log = wire.decode(snapshot)
+
+    def __repr__(self) -> str:
+        return f"AppendLog({self.log!r})"
+
+
+class ReadableAppendLog(StateMachine):
+    """Append log whose outputs embed the full log so tests can inspect
+    results (ReadableAppendLog.scala)."""
+
+    def __init__(self) -> None:
+        self.log: List[bytes] = []
+
+    def run(self, input: bytes) -> bytes:
+        self.log.append(input)
+        return wire.encode((len(self.log) - 1, list(self.log)))
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        return True
+
+    def to_bytes(self) -> bytes:
+        return wire.encode(self.log)
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        self.log = wire.decode(snapshot)
+
+    def get(self) -> List[bytes]:
+        return list(self.log)
+
+    def __repr__(self) -> str:
+        return f"ReadableAppendLog({self.log!r})"
+
+
+# -- KeyValueStore -----------------------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class KVGetRequest:
+    keys: tuple  # of str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class KVSetRequest:
+    key_values: tuple  # of (key, value) str pairs
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class KVGetReply:
+    key_values: tuple  # of (key, Optional[value]) pairs
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class KVSetReply:
+    pass
+
+
+def kv_get(*keys: str) -> bytes:
+    return wire.encode(KVGetRequest(tuple(keys)))
+
+
+def kv_set(*key_values: Tuple[str, str]) -> bytes:
+    return wire.encode(KVSetRequest(tuple(key_values)))
+
+
+class KeyValueStore(StateMachine):
+    """String-keyed KV store over get/set batches. Two commands conflict iff
+    their key sets intersect and at least one is a set
+    (KeyValueStore.scala:77-96)."""
+
+    def __init__(self) -> None:
+        self.kvs: Dict[str, str] = {}
+
+    def __repr__(self) -> str:
+        return f"KeyValueStore({self.kvs!r})"
+
+    def get(self) -> Dict[str, str]:
+        return dict(self.kvs)
+
+    def typed_run(self, input: Any) -> Any:
+        if isinstance(input, KVGetRequest):
+            return KVGetReply(
+                tuple((k, self.kvs.get(k)) for k in input.keys)
+            )
+        if isinstance(input, KVSetRequest):
+            for k, v in input.key_values:
+                self.kvs[k] = v
+            return KVSetReply()
+        raise TypeError(f"bad KeyValueStore input {input!r}")
+
+    def run(self, input: bytes) -> bytes:
+        return wire.encode(self.typed_run(wire.decode(input)))
+
+    @staticmethod
+    def _keys(input: Any) -> Set[str]:
+        if isinstance(input, KVGetRequest):
+            return set(input.keys)
+        if isinstance(input, KVSetRequest):
+            return {k for k, _ in input.key_values}
+        raise TypeError(f"bad KeyValueStore input {input!r}")
+
+    def conflicts(self, first: bytes, second: bytes) -> bool:
+        a, b = wire.decode(first), wire.decode(second)
+        if isinstance(a, KVGetRequest) and isinstance(b, KVGetRequest):
+            return False
+        return bool(self._keys(a) & self._keys(b))
+
+    def to_bytes(self) -> bytes:
+        return wire.encode(self.kvs)
+
+    def from_bytes(self, snapshot: bytes) -> None:
+        self.kvs = wire.decode(snapshot)
+
+    def conflict_index(self) -> "KeyValueStoreConflictIndex":
+        return KeyValueStoreConflictIndex()
+
+
+class KeyValueStoreConflictIndex(ConflictIndex):
+    """Inverted-index conflict index: per-key sets of getter and setter
+    command keys (KeyValueStore.scala:112-217)."""
+
+    def __init__(self) -> None:
+        self.commands: Dict[Any, bytes] = {}
+        self.gets: Dict[str, Set] = {}
+        self.sets: Dict[str, Set] = {}
+        self.snapshots: Set = set()
+
+    def put(self, key, command: bytes) -> None:
+        self.remove(key)
+        self.commands[key] = command
+        decoded = wire.decode(command)
+        index = self.gets if isinstance(decoded, KVGetRequest) else self.sets
+        for k in KeyValueStore._keys(decoded):
+            index.setdefault(k, set()).add(key)
+
+    def remove(self, key) -> None:
+        command = self.commands.pop(key, None)
+        self.snapshots.discard(key)
+        if command is None:
+            return
+        decoded = wire.decode(command)
+        index = self.gets if isinstance(decoded, KVGetRequest) else self.sets
+        for k in KeyValueStore._keys(decoded):
+            keys = index.get(k)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del index[k]
+
+    def put_snapshot(self, key) -> None:
+        self.snapshots.add(key)
+
+    def get_conflicts(self, command: bytes) -> Set:
+        decoded = wire.decode(command)
+        out: Set = set(self.snapshots)
+        if isinstance(decoded, KVGetRequest):
+            for k in decoded.keys:
+                out |= self.sets.get(k, set())
+        else:
+            for k in KeyValueStore._keys(decoded):
+                out |= self.gets.get(k, set())
+                out |= self.sets.get(k, set())
+        return out
+
+
+# -- Registry (StateMachine.scala:48-59) -------------------------------------
+
+REGISTRY = {
+    "AppendLog": AppendLog,
+    "KeyValueStore": KeyValueStore,
+    "Noop": Noop,
+    "Register": Register,
+    "ReadableAppendLog": ReadableAppendLog,
+}
+
+
+def from_name(name: str) -> StateMachine:
+    try:
+        return REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"{name} is not one of {', '.join(sorted(REGISTRY))}."
+        ) from None

@@ -1,0 +1,70 @@
+"""Thriftiness policies: which quorum members to message.
+
+Capability parity with ``thrifty/ThriftySystem.scala:29-80``: ``NotThrifty``
+(message everyone), ``Random`` (a random minimal subset), and ``Closest``
+(the nearest by heartbeat-measured network delay).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, Sequence, Set, TypeVar
+
+T = TypeVar("T")
+
+INFINITE_DELAY = float("inf")
+
+
+class ThriftySystem:
+    def choose(
+        self,
+        delays: Dict[T, float],
+        min_size: int,
+        rng: random.Random,
+    ) -> Set[T]:
+        """Choose which of ``delays.keys()`` to message such that at least
+        ``min_size`` are chosen."""
+        raise NotImplementedError
+
+
+class NotThrifty(ThriftySystem):
+    def choose(self, delays, min_size, rng) -> Set:
+        return set(delays.keys())
+
+    def __repr__(self) -> str:
+        return "NotThrifty"
+
+
+class RandomThrifty(ThriftySystem):
+    def choose(self, delays, min_size, rng) -> Set:
+        nodes = sorted(delays.keys())
+        return set(rng.sample(nodes, min(min_size, len(nodes))))
+
+    def __repr__(self) -> str:
+        return "Random"
+
+
+class Closest(ThriftySystem):
+    """Pick the min_size nodes with smallest measured delay (ties broken by
+    node order for determinism)."""
+
+    def choose(self, delays, min_size, rng) -> Set:
+        ranked = sorted(delays.items(), key=lambda kv: (kv[1], kv[0]))
+        return {node for node, _ in ranked[:min_size]}
+
+    def __repr__(self) -> str:
+        return "Closest"
+
+
+REGISTRY = {
+    "NotThrifty": NotThrifty,
+    "Random": RandomThrifty,
+    "Closest": Closest,
+}
+
+
+def from_name(name: str) -> ThriftySystem:
+    try:
+        return REGISTRY[name]()
+    except KeyError:
+        raise ValueError(f"{name} is not one of {', '.join(sorted(REGISTRY))}.") from None

@@ -1,0 +1,242 @@
+"""Small perf-critical data structures.
+
+Capability parity with the reference ``util`` package and ``Util.scala``:
+
+  * :class:`BufferMap` — watermark-offset growable log with GC
+    (``util/BufferMap.scala:8-100``);
+  * :class:`QuorumWatermark` — the largest k-of-n frontier
+    (``util/QuorumWatermark.scala:31-48``);
+  * :class:`QuorumWatermarkVector` (``util/QuorumWatermarkVector.scala``);
+  * :class:`TopOne` / :class:`TopK` — per-leader max / top-k dependency
+    compression (``util/TopOne.scala``, ``util/TopK.scala:6-33``);
+  * :class:`VertexIdLike` — (leader_index, id) typeclass
+    (``util/VertexIdLike.scala``);
+  * ``histogram`` / ``popular_items`` / ``random_duration`` helpers
+    (``Util.scala:5-60``).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import (
+    Callable,
+    Dict,
+    Generic,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+    TypeVar,
+)
+
+V = TypeVar("V")
+T = TypeVar("T")
+
+
+class BufferMap(Generic[V]):
+    """A map from int keys >= a GC watermark to values, backed by a growable
+    buffer so gets/puts are O(1) and GC is a prefix drop
+    (BufferMap.scala:8-100). Keys below the watermark read as None and puts
+    to them are ignored."""
+
+    def __init__(self, grow_size: int = 5000):
+        self.grow_size = grow_size
+        self.buffer: List[Optional[V]] = [None] * grow_size
+        self.watermark = 0
+        self.largest_key = -1
+
+    def __repr__(self) -> str:
+        return f"BufferMap(watermark={self.watermark}, {self.to_map()!r})"
+
+    def _normalize(self, key: int) -> int:
+        return key - self.watermark
+
+    def get(self, key: int) -> Optional[V]:
+        i = self._normalize(key)
+        if i < 0 or i >= len(self.buffer):
+            return None
+        return self.buffer[i]
+
+    def put(self, key: int, value: V) -> None:
+        self.largest_key = max(self.largest_key, key)
+        i = self._normalize(key)
+        if i < 0:
+            return
+        if i >= len(self.buffer):
+            self.buffer.extend([None] * (i + 1 + self.grow_size - len(self.buffer)))
+        self.buffer[i] = value
+
+    def contains(self, key: int) -> bool:
+        return self.get(key) is not None
+
+    def garbage_collect(self, watermark: int) -> None:
+        if watermark <= self.watermark:
+            return
+        drop = min(watermark - self.watermark, len(self.buffer))
+        del self.buffer[:drop]
+        self.watermark = watermark
+
+    def items(self) -> Iterator[Tuple[int, V]]:
+        return self.items_from(self.watermark)
+
+    def items_from(self, key: int) -> Iterator[Tuple[int, V]]:
+        for k in range(max(key, self.watermark), self.largest_key + 1):
+            v = self.get(k)
+            if v is not None:
+                yield (k, v)
+
+    def to_map(self) -> Dict[int, V]:
+        return {
+            i + self.watermark: v
+            for i, v in enumerate(self.buffer)
+            if v is not None
+        }
+
+
+class QuorumWatermark:
+    """Given n monotonically-increasing watermarks, ``watermark(k)`` is the
+    largest w such that >= k watermarks are >= w — i.e. the k'th largest
+    (QuorumWatermark.scala:31-48)."""
+
+    def __init__(self, num_watermarks: int):
+        self.watermarks = [0] * num_watermarks
+
+    def __repr__(self) -> str:
+        return f"[{','.join(map(str, self.watermarks))}]"
+
+    def update(self, index: int, watermark: int) -> None:
+        self.watermarks[index] = max(self.watermarks[index], watermark)
+
+    def watermark(self, quorum_size: int) -> int:
+        n = len(self.watermarks)
+        if not 1 <= quorum_size <= n:
+            raise ValueError(f"quorum_size {quorum_size} not in [1, {n}]")
+        return sorted(self.watermarks)[n - quorum_size]
+
+
+class QuorumWatermarkVector:
+    """n watermark vectors of depth d; each column is an independent
+    QuorumWatermark (QuorumWatermarkVector.scala)."""
+
+    def __init__(self, n: int, depth: int):
+        self.columns = [QuorumWatermark(n) for _ in range(depth)]
+
+    def __repr__(self) -> str:
+        return "\n".join(repr(c) for c in self.columns)
+
+    def update(self, index: int, watermark: Sequence[int]) -> None:
+        for w, col in zip(watermark, self.columns):
+            col.update(index, w)
+
+    def watermark(self, quorum_size: int) -> List[int]:
+        return [col.watermark(quorum_size) for col in self.columns]
+
+
+class VertexIdLike(Generic[V]):
+    """Typeclass viewing V as a (leader_index, id) vertex id
+    (util/VertexIdLike.scala)."""
+
+    def leader_index(self, v: V) -> int:
+        raise NotImplementedError
+
+    def id(self, v: V) -> int:
+        raise NotImplementedError
+
+    def make(self, leader_index: int, id: int) -> V:
+        raise NotImplementedError
+
+
+class TupleVertexIdLike(VertexIdLike[Tuple[int, int]]):
+    def leader_index(self, v: Tuple[int, int]) -> int:
+        return v[0]
+
+    def id(self, v: Tuple[int, int]) -> int:
+        return v[1]
+
+    def make(self, leader_index: int, id: int) -> Tuple[int, int]:
+        return (leader_index, id)
+
+
+class TopOne(Generic[V]):
+    """Per-leader max id + 1 (an exclusive frontier), mergeable
+    (TopOne.scala)."""
+
+    def __init__(self, num_leaders: int, like: VertexIdLike[V]):
+        self.like = like
+        self.top_ones = [0] * num_leaders
+
+    def put(self, x: V) -> None:
+        i = self.like.leader_index(x)
+        self.top_ones[i] = max(self.top_ones[i], self.like.id(x) + 1)
+
+    def get(self) -> List[int]:
+        return self.top_ones
+
+    def merge_equals(self, other: "TopOne[V]") -> None:
+        for i in range(len(self.top_ones)):
+            self.top_ones[i] = max(self.top_ones[i], other.top_ones[i])
+
+
+class TopK(Generic[V]):
+    """Per-leader top-k ids, mergeable (TopK.scala:6-33)."""
+
+    def __init__(self, k: int, num_leaders: int, like: VertexIdLike[V]):
+        self.k = k
+        self.like = like
+        self.top: List[Set[int]] = [set() for _ in range(num_leaders)]
+
+    def put(self, x: V) -> None:
+        ids = self.top[self.like.leader_index(x)]
+        ids.add(self.like.id(x))
+        if len(ids) > self.k:
+            ids.discard(min(ids))
+
+    def get(self) -> List[Set[int]]:
+        return self.top
+
+    def merge_equals(self, other: "TopK[V]") -> None:
+        for i in range(len(self.top)):
+            ids = self.top[i]
+            ids |= other.top[i]
+            while len(ids) > self.k:
+                ids.discard(min(ids))
+
+
+# -- Util.scala helpers ------------------------------------------------------
+
+
+def histogram(xs: Iterable[T]) -> Dict[T, int]:
+    h: Dict[T, int] = {}
+    for x in xs:
+        h[x] = h.get(x, 0) + 1
+    return h
+
+
+def popular_items(xs: Iterable[T], n: int) -> Set[T]:
+    """The items with the n largest counts (ties included at the cutoff's
+    count, as in Util.popularItems)."""
+    h = histogram(xs)
+    if not h:
+        return set()
+    counts = sorted(h.values(), reverse=True)
+    cutoff = counts[min(n, len(counts)) - 1] if n >= 1 else float("inf")
+    return {x for x, c in h.items() if c >= cutoff}
+
+
+def random_duration(rng: _random.Random, min_s: float, max_s: float) -> float:
+    """Uniform duration in [min_s, max_s] (Util.randomDuration)."""
+    return min_s + rng.random() * (max_s - min_s)
+
+
+def merge_maps_with(
+    a: Dict, b: Dict, merge: Callable[[V, V], V]
+) -> Dict:
+    """Merge two maps, combining values under ``merge`` on key collision
+    (Util.scala map merge)."""
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = merge(out[k], v) if k in out else v
+    return out

@@ -1,0 +1,61 @@
+import random
+
+import pytest
+
+from frankenpaxos_tpu.clienttable import ClientTable, Executed, NotExecuted
+from frankenpaxos_tpu.thrifty import Closest, NotThrifty, RandomThrifty, from_name
+
+
+def test_client_table_in_order():
+    t = ClientTable()
+    assert t.executed("c", 0) == NotExecuted()
+    t.execute("c", 0, b"out0")
+    assert t.executed("c", 0) == Executed(b"out0")
+    t.execute("c", 1, b"out1")
+    assert t.executed("c", 1) == Executed(b"out1")
+    assert t.executed("c", 0) == Executed(None)  # old id: executed, no cache
+    assert t.executed("c", 2) == NotExecuted()
+
+
+def test_client_table_out_of_order():
+    # The EPaxos scenario from ClientTable.scala:44-60: replica executes
+    # id 1 before id 0.
+    t = ClientTable()
+    t.execute("c", 1, b"y")
+    assert t.executed("c", 1) == Executed(b"y")
+    assert t.executed("c", 0) == NotExecuted()  # still executable!
+    t.execute("c", 0, b"x")
+    assert t.executed("c", 0) == Executed(None)  # not the largest -> no cache
+    assert t.executed("c", 1) == Executed(b"y")
+
+
+def test_client_table_double_execute_rejected():
+    t = ClientTable()
+    t.execute("c", 0, b"x")
+    with pytest.raises(ValueError):
+        t.execute("c", 0, b"x")
+
+
+def test_client_table_proto_roundtrip():
+    t = ClientTable()
+    t.execute("alice", 0, b"a")
+    t.execute("alice", 1, b"b")
+    t.execute("bob", 5, b"c")
+    proto = t.to_proto(lambda a: a.encode(), lambda o: o)
+    t2 = ClientTable.from_proto(proto, lambda b: b.decode(), lambda b: b)
+    assert t2.executed("alice", 1) == Executed(b"b")
+    assert t2.executed("alice", 0) == Executed(None)
+    assert t2.executed("bob", 5) == Executed(b"c")
+    assert t2.executed("bob", 4) == NotExecuted()
+
+
+def test_thrifty():
+    rng = random.Random(0)
+    delays = {"a": 3.0, "b": 1.0, "c": 2.0, "d": float("inf")}
+    assert NotThrifty().choose(delays, 2, rng) == {"a", "b", "c", "d"}
+    picked = RandomThrifty().choose(delays, 2, rng)
+    assert len(picked) == 2 and picked <= set(delays)
+    assert Closest().choose(delays, 2, rng) == {"b", "c"}
+    assert isinstance(from_name("Closest"), Closest)
+    with pytest.raises(ValueError):
+        from_name("nope")

@@ -1,0 +1,197 @@
+import random
+
+import pytest
+
+from frankenpaxos_tpu.compact import FakeCompactSet, IntPrefixSet
+from frankenpaxos_tpu.util import (
+    BufferMap,
+    QuorumWatermark,
+    QuorumWatermarkVector,
+    TopK,
+    TopOne,
+    TupleVertexIdLike,
+    histogram,
+    merge_maps_with,
+    popular_items,
+)
+
+
+class TestIntPrefixSet:
+    def test_add_and_compact(self):
+        s = IntPrefixSet()
+        assert s.add(1)
+        assert s.add(0)
+        assert s.watermark == 2 and s.values == set()
+        assert not s.add(1)
+        assert s.add(5)
+        assert s.watermark == 2 and s.values == {5}
+        s.add(2)
+        s.add(3)
+        s.add(4)
+        assert s.watermark == 6 and s.values == set()
+
+    def test_contains_size(self):
+        s = IntPrefixSet(3, {5, 7})
+        assert all(s.contains(x) for x in [0, 1, 2, 5, 7])
+        assert not s.contains(3) and not s.contains(6)
+        assert s.size == 5
+        assert s.uncompacted_size == 2
+        assert s.materialize() == {0, 1, 2, 5, 7}
+
+    def test_constructor_compacts(self):
+        s = IntPrefixSet(2, {2, 3, 5})
+        assert s.watermark == 4 and s.values == {5}
+
+    def test_union_diff(self):
+        a = IntPrefixSet(3, {5})
+        b = IntPrefixSet(1, {2, 7})
+        u = a.union(b)
+        assert u.materialize() == {0, 1, 2, 5, 7}
+        d = a.diff(b)
+        assert d.materialize() == {1, 5}  # a={0,1,2,5}; b={0,2,7}
+        assert d.contains(5)
+        assert list(a.diff_iterator(b)) == [1, 5]
+
+    def test_add_subtract_all(self):
+        a = IntPrefixSet(2, {4})
+        a.add_all(IntPrefixSet(4, {6}))
+        # {0,1,4} ∪ {0,1,2,3,6} = {0..4, 6}; prefix compacts to watermark 5.
+        assert a.materialize() == {0, 1, 2, 3, 4, 6}
+        assert a.watermark == 5
+        a.subtract_all(IntPrefixSet(0, {6}))
+        assert a.materialize() == {0, 1, 2, 3, 4}
+        assert a.watermark == 5
+
+    def test_subtract_one(self):
+        a = IntPrefixSet(3, {5})
+        a.subtract_one(5)
+        assert a.materialize() == {0, 1, 2}
+        a.subtract_one(1)
+        assert a.materialize() == {0, 2}
+        assert a.watermark == 1 and a.values == {2}
+
+    def test_subset_monotone(self):
+        a = IntPrefixSet(3, {5})
+        sub = a.subset()
+        assert sub.materialize() <= a.materialize()
+        a.add(3)
+        a.add(4)  # now watermark 6
+        assert sub.materialize() <= a.subset().materialize()
+
+    def test_proto_roundtrip(self):
+        a = IntPrefixSet(3, {5, 9})
+        assert IntPrefixSet.from_proto(a.to_proto()) == a
+
+    def test_randomized_against_model(self):
+        rng = random.Random(0)
+        s = IntPrefixSet()
+        model = set()
+        for _ in range(500):
+            x = rng.randrange(40)
+            assert s.add(x) == (x not in model)
+            model.add(x)
+            assert s.materialize() == model
+            assert s.size == len(model)
+
+
+def test_fake_compact_set():
+    s = FakeCompactSet([1, 2])
+    assert s.add(3) and not s.add(1)
+    assert s.contains(2)
+    assert s.union(FakeCompactSet([9])).materialize() == {1, 2, 3, 9}
+    assert s.diff(FakeCompactSet([1])).materialize() == {2, 3}
+    assert s.size == 3
+
+
+class TestBufferMap:
+    def test_put_get(self):
+        m = BufferMap(grow_size=4)
+        m.put(0, "a")
+        m.put(10, "b")  # forces growth
+        assert m.get(0) == "a" and m.get(10) == "b"
+        assert m.get(5) is None
+        assert m.contains(10) and not m.contains(3)
+
+    def test_gc(self):
+        m = BufferMap(grow_size=4)
+        for i in range(8):
+            m.put(i, f"v{i}")
+        m.garbage_collect(5)
+        assert m.get(4) is None  # below watermark
+        assert m.get(5) == "v5"
+        m.put(3, "stale")  # put below watermark ignored
+        assert m.get(3) is None
+        m.garbage_collect(3)  # lower watermark ignored
+        assert m.watermark == 5
+
+    def test_iterate(self):
+        m = BufferMap(grow_size=2)
+        m.put(1, "a")
+        m.put(4, "b")
+        assert list(m.items()) == [(1, "a"), (4, "b")]
+        assert list(m.items_from(2)) == [(4, "b")]
+        assert m.to_map() == {1: "a", 4: "b"}
+        m.garbage_collect(2)
+        assert m.to_map() == {4: "b"}
+
+
+def test_quorum_watermark():
+    # Example from QuorumWatermark.scala doc: 4, 3, 6, 2.
+    qw = QuorumWatermark(4)
+    for i, w in enumerate([4, 3, 6, 2]):
+        qw.update(i, w)
+    assert qw.watermark(4) == 2
+    assert qw.watermark(3) == 3
+    assert qw.watermark(2) == 4
+    assert qw.watermark(1) == 6
+    qw.update(3, 1)  # watermarks never decrease
+    assert qw.watermark(4) == 2
+    with pytest.raises(ValueError):
+        qw.watermark(5)
+
+
+def test_quorum_watermark_vector():
+    qwv = QuorumWatermarkVector(n=4, depth=3)
+    qwv.update(0, [1, 2, 3])
+    qwv.update(1, [3, 2, 1])
+    qwv.update(2, [2, 4, 6])
+    qwv.update(3, [7, 5, 3])
+    assert qwv.watermark(2) == [3, 4, 3]
+    assert qwv.watermark(4) == [1, 2, 1]
+
+
+def test_top_one():
+    like = TupleVertexIdLike()
+    t = TopOne(3, like)
+    t.put((0, 4))
+    t.put((0, 2))
+    t.put((2, 0))
+    assert t.get() == [5, 0, 1]
+    other = TopOne(3, like)
+    other.put((1, 9))
+    t.merge_equals(other)
+    assert t.get() == [5, 10, 1]
+
+
+def test_top_k():
+    like = TupleVertexIdLike()
+    t = TopK(2, 2, like)
+    for id_ in [1, 5, 3, 4]:
+        t.put((0, id_))
+    assert t.get()[0] == {4, 5}
+    other = TopK(2, 2, like)
+    other.put((0, 9))
+    other.put((1, 1))
+    t.merge_equals(other)
+    assert t.get()[0] == {5, 9}
+    assert t.get()[1] == {1}
+
+
+def test_util_helpers():
+    assert histogram("abca") == {"a": 2, "b": 1, "c": 1}
+    assert popular_items("aabbc", 1) == {"a", "b"}  # ties included
+    assert popular_items([], 2) == set()
+    assert merge_maps_with({"a": 1}, {"a": 2, "b": 3}, lambda x, y: x + y) == {
+        "a": 3,
+        "b": 3,
+    }

@@ -1,0 +1,171 @@
+"""Sim-transport-driven tests of the heartbeat failure detector and the two
+leader-election protocols."""
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.election import basic, raft
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions
+from frankenpaxos_tpu.heartbeat import Participant as HeartbeatParticipant
+
+
+def drain(t, max_steps=10000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps, "message storm"
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_heartbeat(n=3):
+    t = SimTransport(FakeLogger())
+    addrs = [SimAddress(f"hb{i}") for i in range(n)]
+    clock = FakeClock()
+    parts = [
+        HeartbeatParticipant(
+            a, t, FakeLogger(), addrs,
+            HeartbeatOptions(num_retries=2), clock,
+        )
+        for a in addrs
+    ]
+    return t, addrs, parts, clock
+
+
+def test_heartbeat_alive_and_delay():
+    t, addrs, parts, clock = make_heartbeat()
+    clock.now = 1.0
+    drain(t)
+    p0 = parts[0]
+    assert p0.unsafe_alive() == set(addrs)
+    delays = p0.unsafe_network_delay()
+    assert all(d < float("inf") for d in delays.values())
+
+
+def test_heartbeat_detects_failure():
+    t, addrs, parts, clock = make_heartbeat()
+    drain(t)
+    p0 = parts[0]
+    dead = addrs[2]
+    t.partition_actor(dead)
+    # After the initial pong the fail timer is stopped and the success timer
+    # armed; fire the success timer to restart the ping/fail cycle, then let
+    # the fail timer expire num_retries times.
+    t.trigger_timer(addrs[0], f"successTimer{dead}")
+    drain(t)
+    for _ in range(2):
+        t.trigger_timer(addrs[0], f"failTimer{dead}")
+        drain(t)
+    assert dead not in p0.unsafe_alive()
+    assert p0.unsafe_network_delay()[dead] == float("inf")
+    # Revive: unpartition, ping again via success/fail timer.
+    t.unpartition_actor(dead)
+    t.trigger_timer(addrs[0], f"failTimer{dead}")
+    drain(t)
+    assert dead in p0.unsafe_alive()
+
+
+def make_basic_election(n=3):
+    t = SimTransport(FakeLogger())
+    addrs = [SimAddress(f"e{i}") for i in range(n)]
+    parts = [
+        basic.Participant(a, t, FakeLogger(), addrs, initial_leader_index=0, seed=i)
+        for i, a in enumerate(addrs)
+    ]
+    return t, addrs, parts
+
+
+def test_basic_election_initial_leader_pings():
+    t, addrs, parts = make_basic_election()
+    assert parts[0].state == basic.State.LEADER
+    assert parts[1].state == basic.State.FOLLOWER
+    t.trigger_timer(addrs[0], "pingTimer")
+    assert len(t.messages) == 2  # pings to the other two
+    drain(t)
+    assert parts[1].leader_index == 0
+
+
+def test_basic_election_failover():
+    t, addrs, parts = make_basic_election()
+    changes = []
+    parts[1].register(lambda li: changes.append(li))
+    t.partition_actor(addrs[0])
+    # Follower 1 times out and becomes leader of round 1.
+    t.trigger_timer(addrs[1], "noPingTimer")
+    assert parts[1].state == basic.State.LEADER
+    assert parts[1].round == 1
+    assert changes == [1]
+    drain(t)
+    assert parts[2].leader_index == 1  # learned the new leader
+
+    # Old leader comes back, hears the bigger ballot, steps down.
+    t.unpartition_actor(addrs[0])
+    t.trigger_timer(addrs[1], "pingTimer")
+    drain(t)
+    assert parts[0].state == basic.State.FOLLOWER
+    assert parts[0].leader_index == 1
+
+
+def test_basic_election_force_no_ping():
+    t, addrs, parts = make_basic_election()
+    ch = parts[0].chan(addrs[2])
+    ch.send(basic.ForceNoPing())
+    drain(t)
+    assert parts[2].state == basic.State.LEADER
+    assert parts[2].round >= 1
+
+
+def make_raft_election(n=3, with_leader=True):
+    t = SimTransport(FakeLogger())
+    addrs = [SimAddress(f"r{i}") for i in range(n)]
+    parts = [
+        raft.Participant(
+            a, t, FakeLogger(), addrs,
+            leader=addrs[0] if with_leader else None, seed=i,
+        )
+        for i, a in enumerate(addrs)
+    ]
+    return t, addrs, parts
+
+
+def test_raft_initial_roles():
+    t, addrs, parts = make_raft_election()
+    assert isinstance(parts[0].state, raft.Leader)
+    assert isinstance(parts[1].state, raft.Follower)
+
+
+def test_raft_election_from_scratch():
+    t, addrs, parts = make_raft_election(with_leader=False)
+    assert all(isinstance(p.state, raft.LeaderlessFollower) for p in parts)
+    elected = []
+    parts[1].register(lambda a: elected.append(a))
+    # Node 1 times out and stands for election.
+    t.trigger_timer(addrs[1], "noPingTimer")
+    assert isinstance(parts[1].state, raft.Candidate)
+    drain(t)
+    assert isinstance(parts[1].state, raft.Leader)
+    assert elected and elected[0] == addrs[1]
+    assert all(
+        isinstance(p.state, raft.Follower) for p in (parts[0], parts[2])
+    )
+    assert parts[0].state.leader == addrs[1]
+
+
+def test_raft_failover_and_step_down():
+    t, addrs, parts = make_raft_election()
+    t.partition_actor(addrs[0])
+    t.trigger_timer(addrs[2], "noPingTimer")
+    drain(t)
+    assert isinstance(parts[2].state, raft.Leader)
+    assert parts[2].round == 1
+    # The old leader reappears; new leader's ping demotes it.
+    t.unpartition_actor(addrs[0])
+    t.trigger_timer(addrs[2], "pingTimer")
+    drain(t)
+    assert isinstance(parts[0].state, raft.Follower)
+    assert parts[0].state.leader == addrs[2]

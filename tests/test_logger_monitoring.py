@@ -1,0 +1,72 @@
+import pytest
+
+from frankenpaxos_tpu.core.logger import FakeLogger, FatalError, LogLevel
+from frankenpaxos_tpu.monitoring import (
+    FakeCollectors,
+    PrometheusCollectors,
+)
+
+
+def test_logger_levels_and_lazy():
+    log = FakeLogger(level=LogLevel.WARN)
+    forced = []
+
+    def lazy():
+        forced.append(1)
+        return "expensive"
+
+    log.debug(lazy)
+    assert forced == []  # below level: not forced
+    log.warn(lazy)
+    assert forced == [1]
+    assert log.records == [(LogLevel.WARN, "expensive")]
+
+
+def test_checks():
+    log = FakeLogger()
+    log.check(True)
+    log.check_eq(1, 1)
+    log.check_lt(1, 2)
+    log.check_ge(2, 2)
+    with pytest.raises(FatalError):
+        log.check(False)
+    with pytest.raises(FatalError):
+        log.check_eq(1, 2)
+    with pytest.raises(FatalError):
+        log.check_ne("a", "a")
+
+
+def test_counter_gauge_summary():
+    c = FakeCollectors()
+    ctr = c.counter("requests_total", "reqs")
+    ctr.inc()
+    ctr.inc(2)
+    assert ctr.get() == 3
+    g = c.gauge("depth", "queue depth")
+    g.set(5)
+    g.dec()
+    assert g.get() == 4
+    s = c.summary("latency", "ms")
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        s.observe(v)
+    assert s.count == 4 and s.sum == 10.0
+    assert 1.0 <= s.quantile(0.5) <= 4.0
+
+
+def test_labels_and_exposition():
+    c = PrometheusCollectors()
+    ctr = c.counter("msgs_total", "messages", labels=("type",))
+    ctr.labels("ping").inc()
+    ctr.labels("ping").inc()
+    ctr.labels("pong").inc()
+    text = c.expose_text()
+    assert 'msgs_total{type="ping"} 2' in text
+    assert 'msgs_total{type="pong"} 1' in text
+    assert "# TYPE msgs_total counter" in text
+
+
+def test_same_metric_returned():
+    c = FakeCollectors()
+    assert c.counter("x", "") is c.counter("x", "")
+    with pytest.raises(TypeError):
+        c.gauge("x", "")

@@ -1,0 +1,77 @@
+import pytest
+
+from frankenpaxos_tpu.quorums import (
+    Grid,
+    SimpleMajority,
+    UnanimousWrites,
+    from_proto,
+    to_proto,
+)
+
+
+def test_simple_majority():
+    qs = SimpleMajority({1, 2, 3, 4, 5}, seed=0)
+    assert qs.quorum_size == 3
+    assert qs.is_read_quorum({1, 2, 3})
+    assert not qs.is_read_quorum({1, 2})
+    assert qs.is_write_quorum({3, 4, 5})
+    with pytest.raises(ValueError):
+        qs.is_read_quorum({1, 9})
+    assert qs.is_superset_of_read_quorum({1, 2, 3, 99})
+    assert not qs.is_superset_of_read_quorum({1, 99})
+    rq = qs.random_read_quorum()
+    assert len(rq) == 3 and rq <= qs.nodes()
+
+
+def test_unanimous_writes():
+    qs = UnanimousWrites({1, 2, 3}, seed=0)
+    assert qs.is_read_quorum({2})
+    assert not qs.is_write_quorum({1, 2})
+    assert qs.is_write_quorum({1, 2, 3})
+    assert qs.random_write_quorum() == {1, 2, 3}
+    assert len(qs.random_read_quorum()) == 1
+    assert qs.is_superset_of_write_quorum({1, 2, 3, 4})
+    assert not qs.is_superset_of_write_quorum({1, 2})
+
+
+def test_grid():
+    qs = Grid([[1, 2, 3], [4, 5, 6]], seed=0)
+    # Rows are read quorums.
+    assert qs.is_read_quorum({1, 2, 3})
+    assert qs.is_read_quorum({4, 5, 6})
+    assert not qs.is_read_quorum({1, 2, 4})
+    # One element per row is a write quorum.
+    assert qs.is_write_quorum({1, 4})
+    assert qs.is_write_quorum({2, 6})
+    assert not qs.is_write_quorum({1, 2})
+    # Read/write quorums intersect.
+    assert qs.random_read_quorum() & qs.random_write_quorum()
+    with pytest.raises(ValueError):
+        Grid([[1, 2], [3]])
+
+
+@pytest.mark.parametrize(
+    "qs",
+    [
+        SimpleMajority({1, 2, 3}),
+        UnanimousWrites({4, 5}),
+        Grid([[1, 2], [3, 4]]),
+    ],
+)
+def test_proto_roundtrip(qs):
+    qs2 = from_proto(to_proto(qs))
+    assert type(qs2) is type(qs)
+    assert qs2.nodes() == qs.nodes()
+    if isinstance(qs, Grid):
+        assert qs2.grid == qs.grid
+
+
+def test_read_write_intersection_property():
+    # Every read quorum must intersect every write quorum.
+    for qs in [
+        SimpleMajority(set(range(7)), seed=1),
+        UnanimousWrites(set(range(4)), seed=1),
+        Grid([[0, 1, 2], [3, 4, 5], [6, 7, 8]], seed=1),
+    ]:
+        for _ in range(50):
+            assert qs.random_read_quorum() & qs.random_write_quorum()

@@ -1,0 +1,108 @@
+import pytest
+
+from frankenpaxos_tpu.roundsystem import (
+    ClassicRoundRobin,
+    ClassicStutteredRoundRobin,
+    MixedRoundRobin,
+    RenamedRoundSystem,
+    RotatedClassicRoundRobin,
+    RotatedRoundZeroFast,
+    RoundType,
+    RoundZeroFast,
+)
+
+ALL = [
+    ClassicRoundRobin(3),
+    ClassicStutteredRoundRobin(3, 2),
+    ClassicStutteredRoundRobin(3, 3),
+    RoundZeroFast(3),
+    MixedRoundRobin(3),
+    RotatedClassicRoundRobin(3, 1),
+    RotatedRoundZeroFast(3, 2),
+    RenamedRoundSystem(ClassicRoundRobin(3), {0: 0, 1: 2, 2: 1}),
+]
+
+
+def test_classic_round_robin_table():
+    rs = ClassicRoundRobin(3)
+    assert [rs.leader(r) for r in range(7)] == [0, 1, 2, 0, 1, 2, 0]
+    assert all(rs.round_type(r) == RoundType.CLASSIC for r in range(7))
+    assert rs.next_classic_round(1, -1) == 1
+    assert rs.next_classic_round(0, 0) == 3
+    assert rs.next_classic_round(2, 0) == 2
+    assert rs.next_classic_round(2, 2) == 5
+
+
+def test_stuttered_table():
+    rs = ClassicStutteredRoundRobin(3, 2)
+    assert [rs.leader(r) for r in range(7)] == [0, 0, 1, 1, 2, 2, 0]
+    rs3 = ClassicStutteredRoundRobin(3, 3)
+    assert [rs3.leader(r) for r in range(7)] == [0, 0, 0, 1, 1, 1, 2]
+    assert rs.next_classic_round(0, -5) == 0
+    assert rs.next_classic_round(1, -5) == 2
+    assert rs.next_classic_round(0, 0) == 1  # still own next round
+    assert rs.next_classic_round(0, 1) == 6
+    assert rs.next_classic_round(2, 1) == 4
+
+
+def test_round_zero_fast_table():
+    rs = RoundZeroFast(3)
+    assert [rs.leader(r) for r in range(7)] == [0, 0, 1, 2, 0, 1, 2]
+    assert rs.round_type(0) == RoundType.FAST
+    assert rs.round_type(1) == RoundType.CLASSIC
+    assert rs.next_fast_round(0, -1) == 0
+    assert rs.next_fast_round(0, 0) is None
+    assert rs.next_fast_round(1, -1) is None
+
+
+def test_mixed_round_robin_table():
+    rs = MixedRoundRobin(3)
+    assert [rs.leader(r) for r in range(10)] == [0, 0, 1, 1, 2, 2, 0, 0, 1, 1]
+    assert [rs.round_type(r) for r in range(4)] == [
+        RoundType.FAST,
+        RoundType.CLASSIC,
+        RoundType.FAST,
+        RoundType.CLASSIC,
+    ]
+    assert rs.next_fast_round(0, -1) == 0
+    assert rs.next_classic_round(0, 0) == 1
+    assert rs.next_classic_round(1, 0) == 3
+
+
+def test_rotated_tables():
+    rs = RotatedClassicRoundRobin(3, 1)
+    assert [rs.leader(r) for r in range(7)] == [1, 2, 0, 1, 2, 0, 1]
+    rs2 = RotatedClassicRoundRobin(3, 2)
+    assert [rs2.leader(r) for r in range(7)] == [2, 0, 1, 2, 0, 1, 2]
+    rz = RotatedRoundZeroFast(3, 1)
+    assert [rz.leader(r) for r in range(7)] == [1, 1, 2, 0, 1, 2, 0]
+    assert rz.round_type(0) == RoundType.FAST
+
+
+@pytest.mark.parametrize("rs", ALL, ids=repr)
+def test_next_classic_round_properties(rs):
+    """next_classic_round(l, r) is the smallest classic round of l > r."""
+    for leader in range(rs.num_leaders()):
+        for r in range(-2, 30):
+            nxt = rs.next_classic_round(leader, r)
+            assert nxt > r or r < 0
+            assert rs.leader(nxt) == leader
+            assert rs.round_type(nxt) == RoundType.CLASSIC
+            lo = 0 if r < 0 else r + 1
+            for between in range(lo, nxt):
+                assert not (
+                    rs.leader(between) == leader
+                    and rs.round_type(between) == RoundType.CLASSIC
+                ), f"{rs!r}: {between} is an earlier classic round of {leader}"
+
+
+@pytest.mark.parametrize("rs", ALL, ids=repr)
+def test_next_fast_round_properties(rs):
+    for leader in range(rs.num_leaders()):
+        for r in range(-2, 20):
+            nxt = rs.next_fast_round(leader, r)
+            if nxt is None:
+                continue
+            assert nxt > r or r < 0
+            assert rs.leader(nxt) == leader
+            assert rs.round_type(nxt) == RoundType.FAST

@@ -1,0 +1,160 @@
+import dataclasses
+import random
+
+from frankenpaxos_tpu.core import (
+    Actor,
+    DeliverMessage,
+    FakeLogger,
+    SimAddress,
+    SimTransport,
+    TriggerTimer,
+    wire,
+)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Ping:
+    n: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Pong:
+    n: int
+
+
+class Pinger(Actor):
+    def __init__(self, address, transport, logger, peer):
+        super().__init__(address, transport, logger)
+        self.peer = peer
+        self.got = []
+        self.timer_fired = 0
+        self.t = self.timer("resend", 1.0, self._on_timer)
+        self.t.start()
+
+    def _on_timer(self):
+        self.timer_fired += 1
+        self.chan(self.peer).send(Ping(self.timer_fired))
+
+    def receive(self, src, msg):
+        self.got.append(msg)
+
+
+class Ponger(Actor):
+    def receive(self, src, msg):
+        self.chan(src).send(Pong(msg.n + 100))
+
+
+def make():
+    t = SimTransport(FakeLogger())
+    a, b = SimAddress("pinger"), SimAddress("ponger")
+    pinger = Pinger(a, t, FakeLogger(), b)
+    ponger = Ponger(b, t, FakeLogger())
+    return t, a, b, pinger, ponger
+
+
+def test_timer_then_message_roundtrip():
+    t, a, b, pinger, ponger = make()
+    assert t.messages == []
+    t.trigger_timer(a, "resend")
+    assert len(t.messages) == 1
+    ping = t.messages[0]
+    assert (ping.src, ping.dst) == (a, b)
+    t.deliver_message(ping)
+    # Ponger replied; deliver the reply.
+    assert len(t.messages) == 1
+    t.deliver_message(t.messages[0])
+    assert pinger.got == [Pong(101)]
+
+
+def test_deliver_absent_message_is_noop():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    msg = t.messages[0]
+    t.deliver_message(msg)
+    t.deliver_message(msg)  # already delivered: no-op
+    assert len(t.messages) == 1  # just the pong
+
+
+def test_trigger_stopped_timer_is_noop():
+    t, a, b, pinger, ponger = make()
+    pinger.t.stop()
+    t.trigger_timer(a, "resend")
+    assert pinger.timer_fired == 0
+    assert t.messages == []
+
+
+def test_timer_stops_itself_but_can_restart():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    assert not pinger.t.running
+    t.trigger_timer(a, "resend")  # no-op: not running
+    assert pinger.timer_fired == 1
+    pinger.t.reset()
+    t.trigger_timer(a, "resend")
+    assert pinger.timer_fired == 2
+
+
+def test_duplicate_and_drop():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    msg = t.messages[0]
+    t.duplicate_message(msg)
+    assert t.messages.count(msg) == 2
+    t.drop_message(msg)
+    assert t.messages.count(msg) == 1
+    t.drop_message(msg)
+    assert t.messages == []
+
+
+def test_partition():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    t.partition_actor(b)
+    assert t.messages == []  # pending messages to b dropped
+    pinger.t.start()
+    t.trigger_timer(a, "resend")
+    assert t.messages == []  # sends to b dropped
+    t.unpartition_actor(b)
+    pinger.t.start()
+    t.trigger_timer(a, "resend")
+    assert len(t.messages) == 1
+
+
+def test_generate_command_deterministic_and_weighted():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    pinger.t.start()
+    rng1, rng2 = random.Random(7), random.Random(7)
+    cmds1 = [t.generate_command(rng1) for _ in range(20)]
+    cmds2 = [t.generate_command(rng2) for _ in range(20)]
+    assert cmds1 == cmds2
+    kinds = {type(c) for c in cmds1}
+    assert kinds <= {DeliverMessage, TriggerTimer}
+
+
+def test_history_recorded():
+    t, a, b, pinger, ponger = make()
+    t.trigger_timer(a, "resend")
+    t.deliver_message(t.messages[0])
+    assert len(t.history) == 2
+    assert isinstance(t.history[0], TriggerTimer)
+    assert isinstance(t.history[1], DeliverMessage)
+
+
+def test_send_no_flush_buffers_until_flush():
+    t = SimTransport(FakeLogger())
+    a, b = SimAddress("x"), SimAddress("y")
+
+    class Silent(Actor):
+        def receive(self, src, msg):
+            pass
+
+    x = Silent(a, t, FakeLogger())
+    Silent(b, t, FakeLogger())
+    x.chan(b).send_no_flush(Ping(1))
+    x.chan(b).send_no_flush(Ping(2))
+    assert t.messages == []
+    x.chan(b).flush()
+    assert len(t.messages) == 2

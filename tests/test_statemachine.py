@@ -1,0 +1,129 @@
+import pytest
+
+from frankenpaxos_tpu.core import wire
+from frankenpaxos_tpu.statemachine import (
+    AppendLog,
+    KeyValueStore,
+    KVGetReply,
+    KVGetRequest,
+    KVSetReply,
+    Noop,
+    ReadableAppendLog,
+    Register,
+    from_name,
+    kv_get,
+    kv_set,
+)
+from frankenpaxos_tpu.util import TupleVertexIdLike
+
+
+def test_noop():
+    sm = Noop()
+    assert sm.run(b"anything") == b""
+    assert not sm.conflicts(b"a", b"b")
+    sm.from_bytes(sm.to_bytes())
+
+
+def test_register():
+    sm = Register()
+    assert sm.run(b"x") == b"x"
+    assert sm.conflicts(b"a", b"b")
+    snap = sm.to_bytes()
+    sm.run(b"y")
+    sm.from_bytes(snap)
+    assert sm.x == b"x"
+
+
+def test_append_log():
+    sm = AppendLog()
+    assert wire.decode(sm.run(b"a")) == 0
+    assert wire.decode(sm.run(b"b")) == 1
+    snap = sm.to_bytes()
+    sm2 = AppendLog()
+    sm2.from_bytes(snap)
+    assert sm2.log == [b"a", b"b"]
+
+
+def test_readable_append_log():
+    sm = ReadableAppendLog()
+    idx, log = wire.decode(sm.run(b"a"))
+    assert idx == 0 and log == [b"a"]
+    idx, log = wire.decode(sm.run(b"b"))
+    assert idx == 1 and log == [b"a", b"b"]
+    assert sm.get() == [b"a", b"b"]
+
+
+def test_kv_store_run():
+    sm = KeyValueStore()
+    assert wire.decode(sm.run(kv_set(("x", "1"), ("y", "2")))) == KVSetReply()
+    reply = wire.decode(sm.run(kv_get("x", "z")))
+    assert reply == KVGetReply((("x", "1"), ("z", None)))
+    assert sm.get() == {"x": "1", "y": "2"}
+
+
+def test_kv_store_conflicts():
+    sm = KeyValueStore()
+    get_x, get_y = kv_get("x"), kv_get("y")
+    set_x, set_xy = kv_set(("x", "1")), kv_set(("x", "1"), ("y", "2"))
+    assert not sm.conflicts(get_x, get_x)  # gets never conflict
+    assert sm.conflicts(get_x, set_x)
+    assert sm.conflicts(set_x, get_x)
+    assert sm.conflicts(set_x, set_xy)
+    assert not sm.conflicts(get_x, kv_set(("y", "2")))
+
+
+def test_kv_store_snapshot():
+    sm = KeyValueStore()
+    sm.run(kv_set(("a", "1")))
+    snap = sm.to_bytes()
+    sm.run(kv_set(("a", "2")))
+    sm.from_bytes(snap)
+    assert sm.get() == {"a": "1"}
+
+
+def test_kv_conflict_index():
+    sm = KeyValueStore()
+    ci = sm.conflict_index()
+    ci.put(1, kv_get("x", "y"))
+    ci.put(2, kv_set(("y", "1"), ("z", "1")))
+    # A set of x conflicts with command 1 (gets x).
+    assert ci.get_conflicts(kv_set(("x", "0"))) == {1}
+    # A get of z conflicts with command 2 (sets z).
+    assert ci.get_conflicts(kv_get("z")) == {2}
+    # A set of y conflicts with both.
+    assert ci.get_conflicts(kv_set(("y", "9"))) == {1, 2}
+    # A get of y conflicts only with the setter.
+    assert ci.get_conflicts(kv_get("y")) == {2}
+    ci.remove(1)
+    assert ci.get_conflicts(kv_set(("x", "0"))) == set()
+    ci.put_snapshot(77)
+    assert ci.get_conflicts(kv_get("q")) == {77}
+
+
+def test_naive_conflict_index():
+    sm = Register()
+    ci = sm.conflict_index()
+    ci.put("a", b"1")
+    ci.put("b", b"2")
+    assert ci.get_conflicts(b"x") == {"a", "b"}  # register: all conflict
+    ci.remove("a")
+    assert ci.get_conflicts(b"x") == {"b"}
+
+
+def test_top_k_conflict_index():
+    sm = KeyValueStore()
+    like = TupleVertexIdLike()
+    ci = sm.top_k_conflict_index(k=1, num_leaders=2, like=like)
+    ci.put((0, 3), kv_set(("x", "1")))
+    ci.put((0, 5), kv_set(("x", "2")))
+    ci.put((1, 2), kv_get("x"))
+    tops = ci.get_top_k_conflicts(kv_set(("x", "9")))
+    assert tops[0] == {5}  # only the top-1 per leader
+    assert tops[1] == {2}
+
+
+def test_registry():
+    assert isinstance(from_name("KeyValueStore"), KeyValueStore)
+    assert isinstance(from_name("Noop"), Noop)
+    with pytest.raises(ValueError):
+        from_name("Nope")

@@ -1,0 +1,54 @@
+"""Echo smoke over the asyncio TCP transport (the analog of the reference's
+benchmark smoke of NettyTcpTransport)."""
+
+import dataclasses
+
+from frankenpaxos_tpu.core import Actor, FakeLogger, HostPort, wire
+from frankenpaxos_tpu.core.tcp_transport import TcpTransport
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class TcpEchoReq:
+    text: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class TcpEchoReply:
+    text: str
+
+
+class EchoServer(Actor):
+    def receive(self, src, msg):
+        self.chan(src).send(TcpEchoReply(msg.text))
+
+
+class EchoClient(Actor):
+    def __init__(self, address, transport, logger, server, n):
+        super().__init__(address, transport, logger)
+        self.server = server
+        self.n = n
+        self.replies = []
+
+    def kick(self):
+        for i in range(self.n):
+            self.chan(self.server).send(TcpEchoReq(f"m{i}"))
+
+    def receive(self, src, msg):
+        self.replies.append(msg.text)
+        if len(self.replies) == self.n:
+            self.transport.shutdown()
+
+
+def test_tcp_echo_roundtrip():
+    t = TcpTransport(FakeLogger())
+    saddr = HostPort("127.0.0.1", 18571)
+    caddr = HostPort("127.0.0.1", 18572)
+    EchoServer(saddr, t, FakeLogger())
+    client = EchoClient(caddr, t, FakeLogger(), saddr, 5)
+    # Failsafe so a bug can't hang the test forever.
+    failsafe = t.timer(caddr, "failsafe", 10.0, t.shutdown)
+    failsafe.start()
+    t.run(on_start=client.kick)
+    assert client.replies == [f"m{i}" for i in range(5)]

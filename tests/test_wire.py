@@ -1,0 +1,81 @@
+import dataclasses
+
+import pytest
+
+from frankenpaxos_tpu.core import wire
+from frankenpaxos_tpu.core.serializer import (
+    BytesSerializer,
+    IntSerializer,
+    StringSerializer,
+    WireSerializer,
+)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    x: int
+    tag: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Outer:
+    inner: Inner
+    blob: bytes
+    items: list
+    maybe: object
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        True,
+        False,
+        0,
+        -1,
+        2**62,
+        -(2**62),
+        2**100,
+        -(2**100),
+        1.5,
+        "",
+        "héllo",
+        b"",
+        b"\x00\xff",
+        [1, "a", b"b", None],
+        (1, 2),
+        {"k": 1, "j": [2, 3]},
+        frozenset([3, 1, 2]),
+        Inner(7, "t"),
+        Outer(Inner(1, "i"), b"xyz", [Inner(2, "j"), 5], None),
+    ],
+)
+def test_roundtrip(value):
+    assert wire.decode(wire.encode(value)) == value
+
+
+def test_roundtrip_preserves_type():
+    assert isinstance(wire.decode(wire.encode((1, 2))), tuple)
+    assert isinstance(wire.decode(wire.encode([1, 2])), list)
+    assert isinstance(wire.decode(wire.encode(Inner(0, ""))), Inner)
+
+
+def test_structural_equality_of_bytes():
+    a = wire.encode(Outer(Inner(1, "i"), b"xyz", [1], None))
+    b = wire.encode(Outer(Inner(1, "i"), b"xyz", [1], None))
+    assert a == b
+
+
+def test_trailing_bytes_rejected():
+    with pytest.raises(ValueError):
+        wire.decode(wire.encode(1) + b"\x00")
+
+
+def test_basic_serializers():
+    assert IntSerializer().from_bytes(IntSerializer().to_bytes(-42)) == -42
+    assert StringSerializer().from_bytes(StringSerializer().to_bytes("hé")) == "hé"
+    assert BytesSerializer().from_bytes(b"raw") == b"raw"
+    s = WireSerializer()
+    assert s.from_bytes(s.to_bytes(Inner(9, "z"))) == Inner(9, "z")
