@@ -1,0 +1,46 @@
+"""A tiny single-threaded future/promise.
+
+The reference's client APIs return Scala ``Future``s resolved on the
+transport's event loop (``multipaxos/Client.scala:1035-1069``). Since every
+transport here is single-threaded, a minimal callback future suffices."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+
+class Promise:
+    def __init__(self) -> None:
+        self.done = False
+        self.value: Any = None
+        self.exception: Optional[BaseException] = None
+        self._callbacks: List[Callable[["Promise"], None]] = []
+
+    def success(self, value: Any) -> None:
+        if self.done:
+            raise RuntimeError("promise already completed")
+        self.done = True
+        self.value = value
+        for cb in self._callbacks:
+            cb(self)
+
+    def failure(self, exception: BaseException) -> None:
+        if self.done:
+            raise RuntimeError("promise already completed")
+        self.done = True
+        self.exception = exception
+        for cb in self._callbacks:
+            cb(self)
+
+    def on_complete(self, cb: Callable[["Promise"], None]) -> None:
+        if self.done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def result(self) -> Any:
+        if not self.done:
+            raise RuntimeError("promise not completed")
+        if self.exception is not None:
+            raise self.exception
+        return self.value

@@ -58,15 +58,18 @@ class SimTimer(Timer):
     def start(self) -> None:
         if not self.running:
             self.running = True
+            self.transport._running_timers.append(self)
 
     def stop(self) -> None:
-        self.running = False
+        if self.running:
+            self.running = False
+            self.transport._running_timers.remove(self)
 
     def run(self) -> None:
         # Mirrors FakeTransport timer semantics: a timer stops itself before
         # running its callback so the callback can restart it.
         if self.running:
-            self.running = False
+            self.stop()
             self.f()
 
 
@@ -89,7 +92,11 @@ class SimTransport(Transport):
         self.logger = logger or PrintLogger()
         self.actors: Dict[Address, Any] = {}
         self.messages: List[QueuedMessage] = []
-        self.timers: List[SimTimer] = []
+        # Only RUNNING timers are tracked (timers register themselves on
+        # start and deregister on stop/fire). Protocol clients create one
+        # fresh timer per request; tracking stopped timers would leak them
+        # and make every scheduling step O(total timers ever created).
+        self._running_timers: List[SimTimer] = []
         self.partitioned: Set[Address] = set()
         self.history: List[SimCommand] = []
         # Per-(src,dst) buffers for send_no_flush/flush batching semantics.
@@ -122,14 +129,20 @@ class SimTransport(Transport):
     def timer(
         self, address: Address, name: str, delay: float, f: Callable[[], None]
     ) -> SimTimer:
-        t = SimTimer(self, address, name, delay, f)
-        self.timers.append(t)
-        return t
+        return SimTimer(self, address, name, delay, f)
+
+    def address_to_bytes(self, address: Address) -> bytes:
+        return address.name.encode("utf-8")
+
+    def address_from_bytes(self, data: bytes) -> Address:
+        from frankenpaxos_tpu.core.address import SimAddress
+
+        return SimAddress(data.decode("utf-8"))
 
     # -- Driver interface ----------------------------------------------------
 
     def running_timers(self) -> List[SimTimer]:
-        return [t for t in self.timers if t.running]
+        return list(self._running_timers)
 
     def deliver_message(self, msg: QueuedMessage, record: bool = True) -> None:
         """Deliver (and remove) the first pending message structurally equal
@@ -167,8 +180,8 @@ class SimTransport(Transport):
             self.history.append(TriggerTimer(address, name))
         if address in self.partitioned:
             return
-        for t in self.timers:
-            if t.running and t.address == address and t._name == name:
+        for t in list(self._running_timers):
+            if t.address == address and t._name == name:
                 t.run()
                 self.flush_all()
                 return

@@ -130,6 +130,17 @@ class TcpTransport(Transport):
     ) -> TcpTimer:
         return TcpTimer(self, name, delay, f)
 
+    def address_to_bytes(self, address: Address) -> bytes:
+        from frankenpaxos_tpu.core import wire
+
+        return wire.encode((address.host, address.port))
+
+    def address_from_bytes(self, data: bytes) -> Address:
+        from frankenpaxos_tpu.core import wire
+
+        host, port = wire.decode(data)
+        return HostPort(host, port)
+
     def shutdown(self) -> None:
         self._stopping = True
         self.loop.call_soon(self.loop.stop)

@@ -57,3 +57,13 @@ class Transport:
 
     def shutdown(self) -> None:
         """Stop event loops / close sockets (NettyTcpTransport.scala:502)."""
+
+    # Address serialization (the analog of the reference's
+    # transport.addressSerializer, used to embed client addresses in
+    # CommandIds so any node can open a channel back to the client).
+
+    def address_to_bytes(self, address: Address) -> bytes:
+        raise NotImplementedError
+
+    def address_from_bytes(self, data: bytes) -> Address:
+        raise NotImplementedError

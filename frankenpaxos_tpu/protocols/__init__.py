@@ -1,0 +1,8 @@
+"""Protocol implementations.
+
+Each protocol package mirrors one of the reference's protocol packages
+under ``shared/src/main/scala/frankenpaxos/`` (see SURVEY.md §2.3): a set of
+role actors parameterized by transport, a ``Config`` of role addresses with
+``check_valid()``, per-role ``Options`` dataclasses with defaults, and
+per-role metrics built against the ``monitoring`` facade.
+"""

@@ -1,0 +1,56 @@
+"""Echo — the tutorial protocol (reference ``echo/``: Server echoes every
+request; the client pings on a timer and counts replies)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EchoRequest:
+    msg: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EchoReply:
+    msg: str
+
+
+class EchoServer(Actor):
+    def __init__(self, address, transport, logger, collectors=None):
+        super().__init__(address, transport, logger)
+        collectors = collectors or FakeCollectors()
+        self.num_messages_received = 0
+        self.requests_total = collectors.counter(
+            "echo_requests_total", "Total echo requests."
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        self.num_messages_received += 1
+        self.requests_total.inc()
+        self.chan(src).send(EchoReply(msg.msg))
+
+
+class EchoClient(Actor):
+    def __init__(self, address, transport, logger, server: Address,
+                 ping_period: float = 1.0):
+        super().__init__(address, transport, logger)
+        self.server = server
+        self.num_messages_received = 0
+        self.ping_timer = self.timer("pingTimer", ping_period, self._ping)
+        self.ping_timer.start()
+
+    def _ping(self) -> None:
+        self.chan(self.server).send(EchoRequest("ping"))
+        self.ping_timer.start()
+
+    def echo(self, msg: str) -> None:
+        self.chan(self.server).send(EchoRequest(msg))
+
+    def receive(self, src: Address, msg) -> None:
+        self.num_messages_received += 1

@@ -1,0 +1,34 @@
+"""Compartmentalized MultiPaxos — the flagship protocol (reference
+``multipaxos/``, ~4,900 LoC Scala; see SURVEY.md §3.2-3.4 for the call
+stacks this package reproduces).
+
+Roles: Client, Batcher, ReadBatcher, Leader (+ co-located election
+Participant), ProxyLeader, Acceptor (round-robin groups or one flexible
+grid), Replica, ProxyReplica. Regular MultiPaxos is the Colocated
+distribution scheme of the decoupled protocol
+(``DistributionScheme.scala``). Reads are linearizable (quorum max-slot
+reads), sequential, or eventual ("Evelyn Paxos").
+"""
+
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    Config,
+    DistributionScheme,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import *  # noqa: F401,F403
+from frankenpaxos_tpu.protocols.multipaxos.acceptor import Acceptor, AcceptorOptions
+from frankenpaxos_tpu.protocols.multipaxos.batcher import Batcher, BatcherOptions
+from frankenpaxos_tpu.protocols.multipaxos.client import Client, ClientOptions
+from frankenpaxos_tpu.protocols.multipaxos.leader import Leader, LeaderOptions
+from frankenpaxos_tpu.protocols.multipaxos.proxy_leader import (
+    ProxyLeader,
+    ProxyLeaderOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.proxy_replica import (
+    ProxyReplica,
+    ProxyReplicaOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.read_batcher import (
+    ReadBatcher,
+    ReadBatcherOptions,
+)
+from frankenpaxos_tpu.protocols.multipaxos.replica import Replica, ReplicaOptions

@@ -1,0 +1,148 @@
+"""MultiPaxos Acceptor (reference ``multipaxos/Acceptor.scala:122-237``).
+
+One round per acceptor (not per slot); votes stored per slot in a sorted
+map; Phase1b returns votes at or above the leader's chosen watermark;
+MaxSlot requests serve linearizable reads with the largest voted slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import Config
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    BatchMaxSlotReply,
+    BatchMaxSlotRequest,
+    CommandBatchOrNoop,
+    MaxSlotReply,
+    MaxSlotRequest,
+    Nack,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
+    Phase2a,
+    Phase2b,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceptorOptions:
+    measure_latencies: bool = True
+
+
+@dataclasses.dataclass
+class _SlotState:
+    vote_round: int
+    vote_value: CommandBatchOrNoop
+
+
+class Acceptor(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: AcceptorOptions = AcceptorOptions(),
+        collectors: Optional[Collectors] = None,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        collectors = collectors or FakeCollectors()
+        self.requests_total = collectors.counter(
+            "multipaxos_acceptor_requests_total", "requests", labels=("type",)
+        )
+        self.group_index = next(
+            i for i, g in enumerate(config.acceptor_addresses) if address in g
+        )
+        self.index = config.acceptor_addresses[self.group_index].index(address)
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = -1
+        # slot -> _SlotState (the analog of the mutable.SortedMap; BufferMap
+        # semantics are unnecessary here because Phase1b iterates from the
+        # chosen watermark).
+        self.states: Dict[int, _SlotState] = {}
+        self.max_voted_slot = -1
+
+    def receive(self, src: Address, msg) -> None:
+        self.requests_total.labels(type(msg).__name__).inc()
+        if isinstance(msg, Phase1a):
+            self._handle_phase1a(src, msg)
+        elif isinstance(msg, Phase2a):
+            self._handle_phase2a(src, msg)
+        elif isinstance(msg, MaxSlotRequest):
+            self._handle_max_slot_request(src, msg)
+        elif isinstance(msg, BatchMaxSlotRequest):
+            self._handle_batch_max_slot_request(src, msg)
+        else:
+            self.logger.fatal(f"unknown acceptor message {msg!r}")
+
+    def _handle_phase1a(self, src: Address, phase1a: Phase1a) -> None:
+        if phase1a.round < self.round:
+            self.chan(src).send(Nack(round=self.round))
+            return
+        self.round = phase1a.round
+        info = tuple(
+            Phase1bSlotInfo(slot=slot, vote_round=s.vote_round, vote_value=s.vote_value)
+            for slot, s in sorted(self.states.items())
+            if slot >= phase1a.chosen_watermark
+        )
+        self.chan(src).send(
+            Phase1b(
+                group_index=self.group_index,
+                acceptor_index=self.index,
+                round=self.round,
+                info=info,
+            )
+        )
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        if phase2a.round < self.round:
+            # Nack goes to the round's leader, not the proxy leader
+            # (Acceptor.scala:184-199).
+            leader = self.config.leader_addresses[
+                self.round_system.leader(phase2a.round)
+            ]
+            self.chan(leader).send(Nack(round=self.round))
+            return
+        self.round = phase2a.round
+        self.states[phase2a.slot] = _SlotState(
+            vote_round=self.round, vote_value=phase2a.value
+        )
+        self.max_voted_slot = max(self.max_voted_slot, phase2a.slot)
+        self.chan(src).send(
+            Phase2b(
+                group_index=self.group_index,
+                acceptor_index=self.index,
+                slot=phase2a.slot,
+                round=self.round,
+            )
+        )
+
+    def _handle_max_slot_request(self, src: Address, req: MaxSlotRequest) -> None:
+        self.chan(src).send(
+            MaxSlotReply(
+                command_id=req.command_id,
+                group_index=self.group_index,
+                acceptor_index=self.index,
+                slot=self.max_voted_slot,
+            )
+        )
+
+    def _handle_batch_max_slot_request(
+        self, src: Address, req: BatchMaxSlotRequest
+    ) -> None:
+        self.chan(src).send(
+            BatchMaxSlotReply(
+                read_batcher_index=req.read_batcher_index,
+                read_batcher_id=req.read_batcher_id,
+                acceptor_index=self.index,
+                slot=self.max_voted_slot,
+            )
+        )

@@ -1,0 +1,95 @@
+"""MultiPaxos Batcher (reference ``multipaxos/Batcher.scala:148-200``):
+accumulates client commands into batches of ``batch_size`` and forwards
+them to the current round's leader; on NotLeaderBatcher it polls leaders
+for the round and resends pending batches to the new leader."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import List, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import Config
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ClientRequest,
+    ClientRequestBatch,
+    Command,
+    CommandBatch,
+    LeaderInfoReplyBatcher,
+    LeaderInfoRequestBatcher,
+    NotLeaderBatcher,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherOptions:
+    batch_size: int = 100
+    measure_latencies: bool = True
+
+
+class Batcher(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: BatcherOptions = BatcherOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.batches_sent = collectors.counter(
+            "multipaxos_batcher_batches_sent", "batches sent"
+        )
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = 0
+        self.growing_batch: List[Command] = []
+        self.pending_resend_batches: List[ClientRequestBatch] = []
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ClientRequest):
+            self._handle_client_request(msg)
+        elif isinstance(msg, NotLeaderBatcher):
+            self._handle_not_leader(msg)
+        elif isinstance(msg, LeaderInfoReplyBatcher):
+            self._handle_leader_info(msg)
+        else:
+            self.logger.fatal(f"unknown batcher message {msg!r}")
+
+    def _handle_client_request(self, msg: ClientRequest) -> None:
+        self.growing_batch.append(msg.command)
+        if len(self.growing_batch) >= self.options.batch_size:
+            leader = self.config.leader_addresses[
+                self.round_system.leader(self.round)
+            ]
+            self.chan(leader).send(
+                ClientRequestBatch(CommandBatch(tuple(self.growing_batch)))
+            )
+            self.growing_batch.clear()
+            self.batches_sent.inc()
+
+    def _handle_not_leader(self, msg: NotLeaderBatcher) -> None:
+        self.pending_resend_batches.append(msg.client_request_batch)
+        for leader in self.config.leader_addresses:
+            self.chan(leader).send(LeaderInfoRequestBatcher())
+
+    def _handle_leader_info(self, msg: LeaderInfoReplyBatcher) -> None:
+        if msg.round <= self.round:
+            return
+        old_round, self.round = self.round, msg.round
+        if self.round_system.leader(old_round) != self.round_system.leader(msg.round):
+            leader = self.config.leader_addresses[
+                self.round_system.leader(msg.round)
+            ]
+            for batch in self.pending_resend_batches:
+                self.chan(leader).send(batch)
+        self.pending_resend_batches.clear()

@@ -1,0 +1,425 @@
+"""MultiPaxos Client (reference ``multipaxos/Client.scala``).
+
+A client multiplexes virtual clients ("pseudonyms"), each with at most one
+outstanding request. Writes go to the round's leader (or a batcher) with a
+resend timer (Client.scala:1035-1051); linearizable reads first collect
+MaxSlotReplies from f+1 acceptors of a random group (or a grid read
+quorum), compute the read slot, then send a ReadRequest to a random
+replica (Client.scala:851-933; the "Evelyn Paxos" quorum read); sequential
+reads reuse the largest seen slot; eventual reads go straight to a
+replica. NotLeaderClient triggers leader-info polling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    Config,
+    DistributionScheme,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ClientReply,
+    ClientRequest,
+    Command,
+    CommandId,
+    EventualReadRequest,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestClient,
+    MaxSlotReply,
+    MaxSlotRequest,
+    NotLeaderClient,
+    ReadReply,
+    ReadRequest,
+    SequentialReadRequest,
+)
+from frankenpaxos_tpu.quorums import Grid
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientOptions:
+    resend_client_request_period: float = 10.0
+    resend_max_slot_requests_period: float = 10.0
+    resend_read_request_period: float = 10.0
+    resend_sequential_read_request_period: float = 10.0
+    resend_eventual_read_request_period: float = 10.0
+    unsafe_read_at_first_slot: bool = False
+    unsafe_read_at_i: bool = False
+    flush_writes_every_n: int = 1
+    flush_reads_every_n: int = 1
+    measure_latencies: bool = True
+
+
+@dataclasses.dataclass
+class _PendingWrite:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+@dataclasses.dataclass
+class _MaxSlot:
+    id: int
+    command: bytes
+    result: Promise
+    max_slot_replies: Dict[Tuple[int, int], MaxSlotReply]
+    resend: object
+
+
+@dataclasses.dataclass
+class _PendingRead:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+@dataclasses.dataclass
+class _PendingSequentialRead:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+@dataclasses.dataclass
+class _PendingEventualRead:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class Client(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: ClientOptions = ClientOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.requests_sent_total = collectors.counter(
+            "multipaxos_client_client_requests_sent_total", "requests sent"
+        )
+        self.replies_received_total = collectors.counter(
+            "multipaxos_client_replies_received_total", "replies received"
+        )
+        self.address_bytes = transport.address_to_bytes(address)
+        self.grid = Grid(
+            [
+                [(row, col) for col in range(len(config.acceptor_addresses[row]))]
+                for row in range(config.num_acceptor_groups)
+            ],
+            seed=seed,
+        )
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = 0
+        self.ids: Dict[int, int] = {}
+        self.largest_seen_slots: Dict[int, int] = {}
+        self.states: Dict[int, object] = {}
+
+    # -- Send helpers --------------------------------------------------------
+
+    def _leader(self) -> Address:
+        return self.config.leader_addresses[self.round_system.leader(self.round)]
+
+    def _batcher(self) -> Address:
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.batcher_addresses[
+                self.rng.randrange(self.config.num_batchers)
+            ]
+        return self.config.batcher_addresses[self.round_system.leader(self.round)]
+
+    def _random_replica(self) -> Address:
+        return self.config.replica_addresses[
+            self.rng.randrange(self.config.num_replicas)
+        ]
+
+    def _random_read_batcher(self) -> Address:
+        return self.config.read_batcher_addresses[
+            self.rng.randrange(self.config.num_read_batchers)
+        ]
+
+    def _send_client_request(self, request: ClientRequest) -> None:
+        if self.config.num_batchers == 0:
+            self.chan(self._leader()).send(request)
+        else:
+            self.chan(self._batcher()).send(request)
+
+    def _command(self, pseudonym: int, id: int, command: bytes) -> Command:
+        return Command(
+            command_id=CommandId(
+                client_address=self.address_bytes,
+                client_pseudonym=pseudonym,
+                client_id=id,
+            ),
+            command=command,
+        )
+
+    def _make_resend_timer(self, name: str, period: float, fire_once):
+        def fire() -> None:
+            fire_once()
+            timer.start()
+
+        timer = self.timer(name, period, fire)
+        timer.start()
+        return timer
+
+    # -- Public API (Client.scala:1035-1110) ---------------------------------
+
+    def write(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.states:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} has a pending request"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        request = ClientRequest(self._command(pseudonym, id, command))
+        self._send_client_request(request)
+        self.states[pseudonym] = _PendingWrite(
+            id=id,
+            command=command,
+            result=promise,
+            resend=self._make_resend_timer(
+                f"resendClientRequest[{pseudonym};{id}]",
+                self.options.resend_client_request_period,
+                lambda: self._send_client_request(request),
+            ),
+        )
+        self.ids[pseudonym] = id + 1
+        self.requests_sent_total.inc()
+        return promise
+
+    def read(self, pseudonym: int, command: bytes) -> Promise:
+        """Linearizable quorum read."""
+        promise = Promise()
+        if pseudonym in self.states:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} has a pending request"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        if self.config.num_read_batchers == 0:
+            if not self.config.flexible:
+                group_index = self.rng.randrange(self.config.num_acceptor_groups)
+                group = self.config.acceptor_addresses[group_index]
+                quorum = [
+                    group[i]
+                    for i in self.rng.sample(range(len(group)), self.config.f + 1)
+                ]
+                resend_to = list(group)
+            else:
+                quorum = [
+                    self.config.acceptor_addresses[row][col]
+                    for (row, col) in self.grid.random_read_quorum()
+                ]
+                resend_to = [a for g in self.config.acceptor_addresses for a in g]
+            request = MaxSlotRequest(
+                command_id=CommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=id,
+                )
+            )
+            for acceptor in quorum:
+                self.chan(acceptor).send(request)
+
+            def resend() -> None:
+                for acceptor in resend_to:
+                    self.chan(acceptor).send(request)
+
+            self.states[pseudonym] = _MaxSlot(
+                id=id,
+                command=command,
+                result=promise,
+                max_slot_replies={},
+                resend=self._make_resend_timer(
+                    f"resendMaxSlotRequest[{pseudonym};{id}]",
+                    self.options.resend_max_slot_requests_period,
+                    resend,
+                ),
+            )
+        else:
+            request = ReadRequest(slot=-1, command=self._command(pseudonym, id, command))
+            self.chan(self._random_read_batcher()).send(request)
+            self.states[pseudonym] = _PendingRead(
+                id=id,
+                command=command,
+                result=promise,
+                resend=self._make_resend_timer(
+                    f"resendReadRequest[{pseudonym};{id}]",
+                    self.options.resend_read_request_period,
+                    lambda: self.chan(self._random_read_batcher()).send(request),
+                ),
+            )
+        self.ids[pseudonym] = id + 1
+        return promise
+
+    def sequential_read(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.states:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} has a pending request"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        request = SequentialReadRequest(
+            slot=self.largest_seen_slots.get(pseudonym, -1),
+            command=self._command(pseudonym, id, command),
+        )
+        self._send_sequential_read(request)
+        self.states[pseudonym] = _PendingSequentialRead(
+            id=id,
+            command=command,
+            result=promise,
+            resend=self._make_resend_timer(
+                f"resendSequentialReadRequest[{pseudonym};{id}]",
+                self.options.resend_sequential_read_request_period,
+                lambda: self._send_sequential_read(request),
+            ),
+        )
+        self.ids[pseudonym] = id + 1
+        return promise
+
+    def _send_sequential_read(self, request: SequentialReadRequest) -> None:
+        if self.config.num_read_batchers == 0:
+            self.chan(self._random_replica()).send(request)
+        else:
+            self.chan(self._random_read_batcher()).send(request)
+
+    def eventual_read(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.states:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} has a pending request"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        request = EventualReadRequest(self._command(pseudonym, id, command))
+        self._send_eventual_read(request)
+        self.states[pseudonym] = _PendingEventualRead(
+            id=id,
+            command=command,
+            result=promise,
+            resend=self._make_resend_timer(
+                f"resendEventualReadRequest[{pseudonym};{id}]",
+                self.options.resend_eventual_read_request_period,
+                lambda: self._send_eventual_read(request),
+            ),
+        )
+        self.ids[pseudonym] = id + 1
+        return promise
+
+    def _send_eventual_read(self, request: EventualReadRequest) -> None:
+        if self.config.num_read_batchers == 0:
+            self.chan(self._random_replica()).send(request)
+        else:
+            self.chan(self._random_read_batcher()).send(request)
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ClientReply):
+            self._handle_client_reply(msg)
+        elif isinstance(msg, MaxSlotReply):
+            self._handle_max_slot_reply(msg)
+        elif isinstance(msg, ReadReply):
+            self._handle_read_reply(msg)
+        elif isinstance(msg, NotLeaderClient):
+            for leader in self.config.leader_addresses:
+                self.chan(leader).send(LeaderInfoRequestClient())
+        elif isinstance(msg, LeaderInfoReplyClient):
+            if msg.round > self.round:
+                self.round = msg.round
+        else:
+            self.logger.fatal(f"unknown client message {msg!r}")
+
+    def _handle_client_reply(self, reply: ClientReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if not isinstance(state, _PendingWrite):
+            return
+        if reply.command_id.client_id != state.id:
+            return
+        state.resend.stop()
+        self.largest_seen_slots[pseudonym] = max(
+            self.largest_seen_slots.get(pseudonym, -1), reply.slot
+        )
+        del self.states[pseudonym]
+        self.replies_received_total.inc()
+        state.result.success(reply.result)
+
+    def _handle_max_slot_reply(self, reply: MaxSlotReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if not isinstance(state, _MaxSlot):
+            return
+        if reply.command_id.client_id != state.id:
+            return
+        state.max_slot_replies[(reply.group_index, reply.acceptor_index)] = reply
+        if (
+            not self.config.flexible
+            and len(state.max_slot_replies) < self.config.f + 1
+        ):
+            return
+        if self.config.flexible and not self.grid.is_read_quorum(
+            set(state.max_slot_replies.keys())
+        ):
+            return
+        # Compute the read slot (Client.scala:912-920): with round-robin
+        # groups the global slot bound is max voted slot in ONE group plus
+        # numGroups - 1 (other groups may own later slots).
+        max_slot = max(r.slot for r in state.max_slot_replies.values())
+        if self.options.unsafe_read_at_first_slot:
+            slot = 0
+        elif self.config.flexible or self.options.unsafe_read_at_i:
+            slot = max_slot
+        else:
+            slot = max_slot + self.config.num_acceptor_groups - 1
+        request = ReadRequest(
+            slot=slot, command=self._command(pseudonym, state.id, state.command)
+        )
+        self.chan(self._random_replica()).send(request)
+        state.resend.stop()
+
+        def resend() -> None:
+            self.chan(self._random_replica()).send(request)
+
+        self.states[pseudonym] = _PendingRead(
+            id=state.id,
+            command=state.command,
+            result=state.result,
+            resend=self._make_resend_timer(
+                f"resendReadRequest[{pseudonym};{state.id}]",
+                self.options.resend_read_request_period,
+                resend,
+            ),
+        )
+
+    def _handle_read_reply(self, reply: ReadReply) -> None:
+        pseudonym = reply.command_id.client_pseudonym
+        state = self.states.get(pseudonym)
+        if isinstance(state, (_PendingRead, _PendingSequentialRead)):
+            if reply.command_id.client_id != state.id:
+                return
+            state.resend.stop()
+            self.largest_seen_slots[pseudonym] = max(
+                self.largest_seen_slots.get(pseudonym, -1), reply.slot
+            )
+            del self.states[pseudonym]
+            state.result.success(reply.result)
+        elif isinstance(state, _PendingEventualRead):
+            if reply.command_id.client_id != state.id:
+                return
+            state.resend.stop()
+            del self.states[pseudonym]
+            state.result.success(reply.result)

@@ -1,0 +1,122 @@
+"""MultiPaxos cluster configuration (the analog of
+``multipaxos/Config.scala:6-148`` and ``DistributionScheme.scala``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence, Tuple
+
+from frankenpaxos_tpu.core import Address
+
+
+class DistributionScheme(enum.Enum):
+    """Hash = spread work over decoupled roles; Colocated = co-locate one
+    batcher/proxy-leader per leader and one proxy-replica per replica to
+    simulate coupled MultiPaxos (DistributionScheme.scala)."""
+
+    HASH = "hash"
+    COLOCATED = "colocated"
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    f: int
+    batcher_addresses: Tuple[Address, ...]
+    read_batcher_addresses: Tuple[Address, ...]
+    leader_addresses: Tuple[Address, ...]
+    leader_election_addresses: Tuple[Address, ...]
+    proxy_leader_addresses: Tuple[Address, ...]
+    # Non-flexible: each inner tuple is one 2f+1 acceptor group and slots are
+    # round-robined over groups. Flexible: the inner tuples are the rows of
+    # one grid quorum system (rows = phase-1 read quorums, columns = phase-2
+    # write quorums).
+    acceptor_addresses: Tuple[Tuple[Address, ...], ...]
+    replica_addresses: Tuple[Address, ...]
+    proxy_replica_addresses: Tuple[Address, ...]
+    flexible: bool = False
+    distribution_scheme: DistributionScheme = DistributionScheme.HASH
+
+    @property
+    def num_batchers(self) -> int:
+        return len(self.batcher_addresses)
+
+    @property
+    def num_read_batchers(self) -> int:
+        return len(self.read_batcher_addresses)
+
+    @property
+    def num_leaders(self) -> int:
+        return len(self.leader_addresses)
+
+    @property
+    def num_proxy_leaders(self) -> int:
+        return len(self.proxy_leader_addresses)
+
+    @property
+    def num_acceptor_groups(self) -> int:
+        return len(self.acceptor_addresses)
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_addresses)
+
+    @property
+    def num_proxy_replicas(self) -> int:
+        return len(self.proxy_replica_addresses)
+
+    def check_valid(self) -> None:
+        """Mirror of Config.checkValid (Config.scala:32-148)."""
+        f = self.f
+        if f < 1:
+            raise ValueError(f"f must be >= 1. It's {f}.")
+        if self.distribution_scheme == DistributionScheme.HASH:
+            if not (self.num_batchers == 0 or self.num_batchers >= f + 1):
+                raise ValueError("numBatchers must be 0 or >= f + 1.")
+        else:
+            if not (
+                self.num_batchers == 0 or self.num_batchers == self.num_leaders
+            ):
+                raise ValueError("numBatchers must be 0 or equal numLeaders.")
+        if not (self.num_read_batchers == 0 or self.num_read_batchers >= f + 1):
+            raise ValueError("numReadBatchers must be 0 or >= f + 1.")
+        if self.num_leaders < f + 1:
+            raise ValueError("numLeaders must be >= f + 1.")
+        if len(self.leader_election_addresses) != self.num_leaders:
+            raise ValueError("need one election address per leader.")
+        if self.num_proxy_leaders < f + 1:
+            raise ValueError("numProxyLeaders must be >= f + 1.")
+        if (
+            self.distribution_scheme == DistributionScheme.COLOCATED
+            and self.num_proxy_leaders != self.num_leaders
+        ):
+            raise ValueError("Colocated: numProxyLeaders must equal numLeaders.")
+        if self.num_acceptor_groups < 1:
+            raise ValueError("numAcceptorGroups must be >= 1.")
+        if not self.flexible:
+            for group in self.acceptor_addresses:
+                if len(group) != 2 * f + 1:
+                    raise ValueError(
+                        f"acceptor group size must be 2f+1 ({2 * f + 1}); "
+                        f"it's {len(group)}."
+                    )
+        else:
+            m = len(self.acceptor_addresses[0])
+            for row in self.acceptor_addresses:
+                if len(row) != m:
+                    raise ValueError("grid rows must be the same size.")
+            n = self.num_acceptor_groups
+            if min(n, m) - 1 < f:
+                raise ValueError(
+                    f"a {n}x{m} grid tolerates {min(n, m) - 1} failures < f={f}."
+                )
+        if self.num_replicas < f + 1:
+            raise ValueError("numReplicas must be >= f + 1.")
+        if not (self.num_proxy_replicas == 0 or self.num_proxy_replicas >= f + 1):
+            raise ValueError("numProxyReplicas must be 0 or >= f + 1.")
+        if (
+            self.distribution_scheme == DistributionScheme.COLOCATED
+            and self.num_proxy_replicas != 0
+            and self.num_proxy_replicas != self.num_replicas
+        ):
+            raise ValueError("Colocated: numProxyReplicas must equal numReplicas.")

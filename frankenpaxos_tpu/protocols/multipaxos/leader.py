@@ -1,0 +1,365 @@
+"""MultiPaxos Leader (reference ``multipaxos/Leader.scala``).
+
+State machine: Inactive | Phase1 | Phase2 (Leader.scala:107-127). Phase 1
+reads f+1 of every acceptor group (or a grid read quorum) from the chosen
+watermark up, repairs the log with safe values (max vote round, else noop;
+Leader.scala:314-329, 504-577), then streams Phase2as round-robin over
+proxy leaders (Leader.scala:331-407). Leader election is a co-located
+``election.basic.Participant`` whose callback drives ``leader_change``
+(Leader.scala:192-203, 432-459). Nacks fast-forward the round
+(Leader.scala:672-697); Recover re-runs phase 1 (Leader.scala:706-722).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.election import basic as election
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    Config,
+    DistributionScheme,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ChosenWatermark,
+    ClientRequest,
+    ClientRequestBatch,
+    CommandBatch,
+    CommandBatchOrNoop,
+    LeaderInfoReplyBatcher,
+    LeaderInfoReplyClient,
+    LeaderInfoRequestBatcher,
+    LeaderInfoRequestClient,
+    Nack,
+    NotLeaderBatcher,
+    NotLeaderClient,
+    Phase1a,
+    Phase1b,
+    Phase2a,
+    Recover,
+)
+from frankenpaxos_tpu.quorums import Grid
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderOptions:
+    resend_phase1as_period: float = 5.0
+    flush_phase2as_every_n: int = 1
+    noop_flush_period: float = 0.0  # 0 disables
+    election_options: election.ElectionOptions = election.ElectionOptions()
+    measure_latencies: bool = True
+
+
+_INACTIVE = "inactive"
+
+
+@dataclasses.dataclass
+class _Phase1:
+    # One vote map per acceptor group: acceptor index -> Phase1b.
+    phase1bs: List[Dict[int, Phase1b]]
+    phase1b_acceptors: set
+    pending_client_request_batches: List[ClientRequestBatch]
+    resend_phase1as: object
+
+
+@dataclasses.dataclass
+class _Phase2:
+    noop_flush: Optional[object]
+
+
+class Leader(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: LeaderOptions = LeaderOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.requests_total = collectors.counter(
+            "multipaxos_leader_requests_total", "requests", labels=("type",)
+        )
+        self.leader_changes_total = collectors.counter(
+            "multipaxos_leader_leader_changes_total", "leader changes"
+        )
+        self.index = config.leader_addresses.index(address)
+        self.grid = Grid(
+            [
+                [(row, col) for col in range(len(config.acceptor_addresses[row]))]
+                for row in range(config.num_acceptor_groups)
+            ],
+            seed=seed,
+        )
+        self.round_system = ClassicRoundRobin(config.num_leaders)
+        self.round = self.round_system.next_classic_round(0, -1)
+        self.next_slot = 0
+        self.chosen_watermark = 0
+        self._current_proxy_leader = 0
+        self._unflushed_phase2as = 0
+        # Co-located election participant (Leader.scala:160-203).
+        self.election = election.Participant(
+            config.leader_election_addresses[self.index],
+            transport,
+            logger,
+            config.leader_election_addresses,
+            initial_leader_index=0,
+            options=options.election_options,
+            seed=seed,
+        )
+        self.election.register(
+            lambda leader_index: self.leader_change(leader_index == self.index)
+        )
+        self.state = (
+            self._start_phase1(self.round, self.chosen_watermark)
+            if self.index == 0
+            else _INACTIVE
+        )
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _all_acceptors(self):
+        for group in self.config.acceptor_addresses:
+            yield from group
+
+    def _make_resend_phase1as_timer(self, phase1a: Phase1a):
+        def fire() -> None:
+            for acceptor in self._all_acceptors():
+                self.chan(acceptor).send(phase1a)
+            timer.start()
+
+        timer = self.timer(
+            "resendPhase1as", self.options.resend_phase1as_period, fire
+        )
+        timer.start()
+        return timer
+
+    def _make_noop_flush_timer(self):
+        if self.config.flexible or self.options.noop_flush_period == 0.0:
+            return None
+
+        def fire() -> None:
+            if not isinstance(self.state, _Phase2):
+                self.logger.fatal("noop flush fired outside Phase2")
+            self.chan(self._proxy_leader()).send(
+                Phase2a(
+                    slot=self.next_slot,
+                    round=self.round,
+                    value=CommandBatchOrNoop.noop(),
+                )
+            )
+            self.next_slot += 1
+            self._bump_proxy_leader()
+            timer.start()
+
+        timer = self.timer("noopFlush", self.options.noop_flush_period, fire)
+        timer.start()
+        return timer
+
+    def _proxy_leader(self) -> Address:
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.proxy_leader_addresses[self._current_proxy_leader]
+        return self.config.proxy_leader_addresses[self.index]
+
+    def _bump_proxy_leader(self) -> None:
+        self._current_proxy_leader += 1
+        if self._current_proxy_leader >= self.config.num_proxy_leaders:
+            self._current_proxy_leader = 0
+
+    @staticmethod
+    def _max_phase1b_slot(phase1b: Phase1b) -> int:
+        return max((info.slot for info in phase1b.info), default=-1)
+
+    @staticmethod
+    def _safe_value(phase1bs, slot: int) -> CommandBatchOrNoop:
+        """Max-vote-round value at this slot, else noop (Leader.scala:314-329)."""
+        infos = [
+            info
+            for phase1b in phase1bs
+            for info in phase1b.info
+            if info.slot == slot
+        ]
+        if not infos:
+            return CommandBatchOrNoop.noop()
+        return max(infos, key=lambda i: i.vote_round).vote_value
+
+    def _process_client_request_batch(self, batch: ClientRequestBatch) -> None:
+        if not isinstance(self.state, _Phase2):
+            self.logger.fatal(
+                "tried to process a client request batch outside Phase 2"
+            )
+        proxy_leader = self._proxy_leader()
+        phase2a = Phase2a(
+            slot=self.next_slot,
+            round=self.round,
+            value=CommandBatchOrNoop(batch.batch),
+        )
+        if self.options.flush_phase2as_every_n == 1:
+            self.chan(proxy_leader).send(phase2a)
+            self._bump_proxy_leader()
+        else:
+            self.chan(proxy_leader).send_no_flush(phase2a)
+            self._unflushed_phase2as += 1
+            if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+                self.flush(proxy_leader)
+                self._unflushed_phase2as = 0
+                self._bump_proxy_leader()
+        self.next_slot += 1
+
+    def _start_phase1(self, round: int, chosen_watermark: int) -> _Phase1:
+        phase1a = Phase1a(round=round, chosen_watermark=chosen_watermark)
+        if not self.config.flexible:
+            for group in self.config.acceptor_addresses:
+                quorum = self.rng.sample(range(len(group)), self.config.f + 1)
+                for i in quorum:
+                    self.chan(group[i]).send(phase1a)
+        else:
+            for (row, col) in self.grid.random_read_quorum():
+                self.chan(self.config.acceptor_addresses[row][col]).send(phase1a)
+        return _Phase1(
+            phase1bs=[{} for _ in range(self.config.num_acceptor_groups)],
+            phase1b_acceptors=set(),
+            pending_client_request_batches=[],
+            resend_phase1as=self._make_resend_phase1as_timer(phase1a),
+        )
+
+    def leader_change(self, is_new_leader: bool) -> None:
+        self.leader_changes_total.inc()
+        if isinstance(self.state, _Phase1):
+            self.state.resend_phase1as.stop()
+        elif isinstance(self.state, _Phase2) and self.state.noop_flush is not None:
+            self.state.noop_flush.stop()
+        if not is_new_leader:
+            self.state = _INACTIVE
+        else:
+            self.round = self.round_system.next_classic_round(self.index, self.round)
+            self.state = self._start_phase1(self.round, self.chosen_watermark)
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        self.requests_total.labels(type(msg).__name__).inc()
+        if isinstance(msg, Phase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, ClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, ClientRequestBatch):
+            self._handle_client_request_batch(src, msg)
+        elif isinstance(msg, LeaderInfoRequestClient):
+            if self.state != _INACTIVE:
+                self.chan(src).send(LeaderInfoReplyClient(round=self.round))
+        elif isinstance(msg, LeaderInfoRequestBatcher):
+            if self.state != _INACTIVE:
+                self.chan(src).send(LeaderInfoReplyBatcher(round=self.round))
+        elif isinstance(msg, Nack):
+            self._handle_nack(msg)
+        elif isinstance(msg, ChosenWatermark):
+            self.chosen_watermark = max(self.chosen_watermark, msg.slot)
+        elif isinstance(msg, Recover):
+            if self.state != _INACTIVE:
+                self.leader_change(is_new_leader=True)
+        else:
+            self.logger.fatal(f"unknown leader message {msg!r}")
+
+    def _handle_phase1b(self, phase1b: Phase1b) -> None:
+        if not isinstance(self.state, _Phase1):
+            return
+        if phase1b.round != self.round:
+            self.logger.check_lt(phase1b.round, self.round)
+            return
+        phase1 = self.state
+        phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] = phase1b
+        if not self.config.flexible and any(
+            len(group) < self.config.f + 1 for group in phase1.phase1bs
+        ):
+            return
+        if self.config.flexible:
+            phase1.phase1b_acceptors.add(
+                (phase1b.group_index, phase1b.acceptor_index)
+            )
+            if not self.grid.is_read_quorum(set(phase1.phase1b_acceptors)):
+                return
+
+        max_slot = max(
+            (
+                self._max_phase1b_slot(b)
+                for group in phase1.phase1bs
+                for b in group.values()
+            ),
+            default=-1,
+        )
+        # Log repair: re-propose safe values for every unchosen slot
+        # (Leader.scala:541-575). In flexible mode every phase1b vote is
+        # usable for any slot (a superset of a read quorum is still a read
+        # quorum), so flatten once outside the loop.
+        flexible_votes = (
+            [b for g in phase1.phase1bs for b in g.values()]
+            if self.config.flexible
+            else None
+        )
+        for slot in range(self.chosen_watermark, max_slot + 1):
+            if flexible_votes is not None:
+                votes = flexible_votes
+            else:
+                votes = list(
+                    phase1.phase1bs[slot % self.config.num_acceptor_groups].values()
+                )
+            self.chan(self._proxy_leader()).send(
+                Phase2a(
+                    slot=slot,
+                    round=self.round,
+                    value=self._safe_value(votes, slot),
+                )
+            )
+        # Deliberate divergence from Leader.scala:566 (`nextSlot = maxSlot+1`):
+        # when acceptors report no votes above the chosen watermark, maxSlot+1
+        # would regress next_slot below chosen_watermark and a new leader
+        # would propose fresh values in already-chosen slots.
+        self.next_slot = max(self.chosen_watermark, max_slot + 1)
+        phase1.resend_phase1as.stop()
+        self.state = _Phase2(self._make_noop_flush_timer())
+        for batch in phase1.pending_client_request_batches:
+            self._process_client_request_batch(batch)
+
+    def _handle_client_request(self, src: Address, msg: ClientRequest) -> None:
+        if self.state == _INACTIVE:
+            self.chan(src).send(NotLeaderClient())
+        elif isinstance(self.state, _Phase1):
+            self.state.pending_client_request_batches.append(
+                ClientRequestBatch(CommandBatch((msg.command,)))
+            )
+        else:
+            self._process_client_request_batch(
+                ClientRequestBatch(CommandBatch((msg.command,)))
+            )
+
+    def _handle_client_request_batch(
+        self, src: Address, msg: ClientRequestBatch
+    ) -> None:
+        if self.state == _INACTIVE:
+            self.chan(src).send(NotLeaderBatcher(client_request_batch=msg))
+        elif isinstance(self.state, _Phase1):
+            self.state.pending_client_request_batches.append(msg)
+        else:
+            self._process_client_request_batch(msg)
+
+    def _handle_nack(self, msg: Nack) -> None:
+        if msg.round <= self.round:
+            return
+        if self.state == _INACTIVE:
+            self.round = msg.round
+        else:
+            self.round = self.round_system.next_classic_round(self.index, msg.round)
+            self.leader_change(is_new_leader=True)

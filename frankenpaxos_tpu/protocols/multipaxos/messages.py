@@ -1,0 +1,272 @@
+"""MultiPaxos message schemas (the analog of
+``multipaxos/MultiPaxos.proto``). The wire codec dispatches on message
+class, so the per-role ``<Role>Inbound`` oneof wrappers of the reference
+are unnecessary; ``receive`` dispatches on isinstance."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from frankenpaxos_tpu.core import wire
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CommandId:
+    """Uniquely identifies a command: (client address bytes, pseudonym, id)."""
+
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Command:
+    command_id: CommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CommandBatch:
+    commands: tuple  # of Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CommandBatchOrNoop:
+    """batch=None means noop (the analog of the CommandBatchOrNoop oneof)."""
+
+    batch: Optional[CommandBatch]
+
+    @staticmethod
+    def noop() -> "CommandBatchOrNoop":
+        return CommandBatchOrNoop(None)
+
+    @property
+    def is_noop(self) -> bool:
+        return self.batch is None
+
+
+# -- Write path --------------------------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ClientRequest:
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ClientRequestBatch:
+    batch: CommandBatch
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Phase1a:
+    round: int
+    chosen_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Phase1bSlotInfo:
+    slot: int
+    vote_round: int
+    vote_value: CommandBatchOrNoop
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Phase1b:
+    group_index: int
+    acceptor_index: int
+    round: int
+    info: tuple  # of Phase1bSlotInfo
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Phase2a:
+    slot: int
+    round: int
+    value: CommandBatchOrNoop
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Phase2b:
+    group_index: int
+    acceptor_index: int
+    slot: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Chosen:
+    slot: int
+    value: CommandBatchOrNoop
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Nack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ChosenWatermark:
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class Recover:
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ClientReply:
+    command_id: CommandId
+    slot: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ClientReplyBatch:
+    batch: tuple  # of ClientReply
+
+
+# -- Leader info / redirection -----------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class NotLeaderClient:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestClient:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyClient:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class NotLeaderBatcher:
+    client_request_batch: ClientRequestBatch
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoRequestBatcher:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class LeaderInfoReplyBatcher:
+    round: int
+
+
+# -- Read path ---------------------------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MaxSlotRequest:
+    command_id: CommandId
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MaxSlotReply:
+    command_id: CommandId
+    group_index: int
+    acceptor_index: int
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BatchMaxSlotRequest:
+    read_batcher_index: int
+    read_batcher_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BatchMaxSlotReply:
+    read_batcher_index: int
+    read_batcher_id: int
+    acceptor_index: int
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ReadRequest:
+    slot: int
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class SequentialReadRequest:
+    slot: int
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EventualReadRequest:
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ReadRequestBatch:
+    slot: int
+    commands: tuple  # of Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class SequentialReadRequestBatch:
+    slot: int
+    commands: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EventualReadRequestBatch:
+    commands: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ReadReply:
+    command_id: CommandId
+    slot: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ReadReplyBatch:
+    batch: tuple  # of ReadReply

@@ -1,0 +1,131 @@
+"""MultiPaxos ProxyLeader (reference ``multipaxos/ProxyLeader.scala:175-258``).
+
+Relieves the leader of phase-2 broadcast/collect: forwards each Phase2a to
+a write quorum (f+1 random members of the slot's acceptor group, or a grid
+write quorum in flexible mode), counts Phase2bs, and broadcasts Chosen to
+all replicas on quorum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import Config
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Chosen,
+    Phase2a,
+    Phase2b,
+)
+from frankenpaxos_tpu.quorums import Grid
+
+
+@dataclasses.dataclass(frozen=True)
+class ProxyLeaderOptions:
+    flush_phase2as_every_n: int = 1
+    measure_latencies: bool = True
+
+
+_DONE = "done"
+
+
+@dataclasses.dataclass
+class _Pending:
+    phase2a: Phase2a
+    phase2bs: Dict[Tuple[int, int], Phase2b]
+
+
+class ProxyLeader(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: ProxyLeaderOptions = ProxyLeaderOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.requests_total = collectors.counter(
+            "multipaxos_proxy_leader_requests_total", "requests", labels=("type",)
+        )
+        self.grid = Grid(
+            [
+                [(row, col) for col in range(len(config.acceptor_addresses[row]))]
+                for row in range(config.num_acceptor_groups)
+            ],
+            seed=seed,
+        )
+        # (slot, round) -> _Pending | _DONE
+        self.states: Dict[Tuple[int, int], object] = {}
+        self._unflushed_phase2as = 0
+
+    def _acceptor(self, group: int, index: int) -> Address:
+        return self.config.acceptor_addresses[group][index]
+
+    def receive(self, src: Address, msg) -> None:
+        self.requests_total.labels(type(msg).__name__).inc()
+        if isinstance(msg, Phase2a):
+            self._handle_phase2a(src, msg)
+        elif isinstance(msg, Phase2b):
+            self._handle_phase2b(src, msg)
+        else:
+            self.logger.fatal(f"unknown proxy leader message {msg!r}")
+
+    def _handle_phase2a(self, src: Address, phase2a: Phase2a) -> None:
+        key = (phase2a.slot, phase2a.round)
+        if key in self.states:
+            return  # duplicate Phase2a
+        if not self.config.flexible:
+            group_index = phase2a.slot % self.config.num_acceptor_groups
+            group = self.config.acceptor_addresses[group_index]
+            quorum = self.rng.sample(range(len(group)), self.config.f + 1)
+            targets = [group[i] for i in quorum]
+        else:
+            targets = [
+                self._acceptor(row, col)
+                for (row, col) in self.grid.random_write_quorum()
+            ]
+        if self.options.flush_phase2as_every_n == 1:
+            for t in targets:
+                self.chan(t).send(phase2a)
+        else:
+            for t in targets:
+                self.chan(t).send_no_flush(phase2a)
+            self._unflushed_phase2as += 1
+            if self._unflushed_phase2as >= self.options.flush_phase2as_every_n:
+                for group in self.config.acceptor_addresses:
+                    for a in group:
+                        self.flush(a)
+                self._unflushed_phase2as = 0
+        self.states[key] = _Pending(phase2a=phase2a, phase2bs={})
+
+    def _handle_phase2b(self, src: Address, phase2b: Phase2b) -> None:
+        key = (phase2b.slot, phase2b.round)
+        state = self.states.get(key)
+        if state is None:
+            self.logger.fatal(
+                f"ProxyLeader got Phase2b for {key} without sending a Phase2a"
+            )
+        if state == _DONE:
+            return
+        state.phase2bs[(phase2b.group_index, phase2b.acceptor_index)] = phase2b
+        if not self.config.flexible and len(state.phase2bs) < self.config.f + 1:
+            return
+        if self.config.flexible and not self.grid.is_write_quorum(
+            set(state.phase2bs.keys())
+        ):
+            return
+        chosen = Chosen(slot=phase2b.slot, value=state.phase2a.value)
+        for replica in self.config.replica_addresses:
+            self.chan(replica).send(chosen)
+        self.states[key] = _DONE

@@ -1,0 +1,84 @@
+"""MultiPaxos ProxyReplica (reference ``multipaxos/ProxyReplica.scala``):
+fans replica output (client replies, read replies) out to clients, and
+forwards ChosenWatermark/Recover notifications to all leaders."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import Config
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ChosenWatermark,
+    ClientReplyBatch,
+    ReadReplyBatch,
+    Recover,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProxyReplicaOptions:
+    flush_every_n: int = 1
+    batch_flush: bool = False
+    measure_latencies: bool = True
+
+
+class ProxyReplica(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: ProxyReplicaOptions = ProxyReplicaOptions(),
+        collectors: Optional[Collectors] = None,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        collectors = collectors or FakeCollectors()
+        self.requests_total = collectors.counter(
+            "multipaxos_proxy_replica_requests_total", "requests", labels=("type",)
+        )
+        self._num_unflushed = 0
+        self._client_addrs: Dict[bytes, Address] = {}
+
+    def _client(self, client_address_bytes: bytes) -> Address:
+        addr = self._client_addrs.get(client_address_bytes)
+        if addr is None:
+            addr = self.transport.address_from_bytes(client_address_bytes)
+            self._client_addrs[client_address_bytes] = addr
+        return addr
+
+    def receive(self, src: Address, msg) -> None:
+        self.requests_total.labels(type(msg).__name__).inc()
+        if isinstance(msg, ClientReplyBatch):
+            self._fan_out(msg.batch)
+        elif isinstance(msg, ReadReplyBatch):
+            self._fan_out(msg.batch)
+        elif isinstance(msg, (ChosenWatermark, Recover)):
+            for leader in self.config.leader_addresses:
+                self.chan(leader).send(msg)
+        else:
+            self.logger.fatal(f"unknown proxy replica message {msg!r}")
+
+    def _fan_out(self, replies) -> None:
+        for reply in replies:
+            client = self._client(reply.command_id.client_address)
+            if self.options.batch_flush:
+                self.chan(client).send_no_flush(reply)
+            elif self.options.flush_every_n == 1:
+                self.chan(client).send(reply)
+            else:
+                self.chan(client).send_no_flush(reply)
+                self._num_unflushed += 1
+                if self._num_unflushed >= self.options.flush_every_n:
+                    for addr in self._client_addrs.values():
+                        self.flush(addr)
+                    self._num_unflushed = 0
+        if self.options.batch_flush:
+            for addr in self._client_addrs.values():
+                self.flush(addr)

@@ -1,0 +1,266 @@
+"""MultiPaxos ReadBatcher (reference ``multipaxos/ReadBatcher.scala``).
+
+Batches linearizable reads: accumulates commands, sends one
+BatchMaxSlotRequest to f+1 acceptors of a random group per batch, and on a
+quorum of BatchMaxSlotReplies forwards the batch to a random replica at
+the computed slot. Sequential/eventual reads batch straight to replicas.
+Batching schemes: size (flush at N or on timeout), time (timeout only),
+adaptive (a new batch round-trip starts as soon as the previous returns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Union
+
+import random
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import Config
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    BatchMaxSlotReply,
+    BatchMaxSlotRequest,
+    Command,
+    EventualReadRequest,
+    EventualReadRequestBatch,
+    ReadRequest,
+    ReadRequestBatch,
+    SequentialReadRequest,
+    SequentialReadRequestBatch,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SizeScheme:
+    batch_size: int = 100
+    timeout: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeScheme:
+    timeout: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveScheme:
+    pass
+
+
+ReadBatchingScheme = Union[SizeScheme, TimeScheme, AdaptiveScheme]
+
+
+def scheme_from_string(s: str) -> ReadBatchingScheme:
+    """Parse 'size,100,1.0' | 'time,1.0' | 'adaptive' (the analog of the
+    scopt reader, ReadBatcher.scala:25-49)."""
+    parts = [p.strip() for p in s.split(",")]
+    if parts[0] == "size":
+        return SizeScheme(int(parts[1]), float(parts[2]))
+    if parts[0] == "time":
+        return TimeScheme(float(parts[1]))
+    if parts[0] == "adaptive":
+        return AdaptiveScheme()
+    raise ValueError(f"{s} must look like 'size,1,1.0', 'time,1.0' or 'adaptive'.")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadBatcherOptions:
+    read_batching_scheme: ReadBatchingScheme = SizeScheme()
+    unsafe_read_at_first_slot: bool = False
+    unsafe_read_at_i: bool = False
+    measure_latencies: bool = True
+
+
+class ReadBatcher(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        config: Config,
+        options: ReadBatcherOptions = ReadBatcherOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.read_batches_sent_total = collectors.counter(
+            "multipaxos_read_batcher_read_batches_sent_total", "read batches"
+        )
+        self.index = config.read_batcher_addresses.index(address)
+        self.linearizable_id = 0
+        self.linearizable_batch: List[Command] = []
+        self.pending_linearizable_batches: Dict[int, List[Command]] = {}
+        self.batch_max_slot_replies: Dict[int, Dict[int, BatchMaxSlotReply]] = {}
+        self.sequential_slot = -1
+        self.sequential_batch: List[Command] = []
+        self.eventual_batch: List[Command] = []
+        scheme = options.read_batching_scheme
+        if isinstance(scheme, (SizeScheme, TimeScheme)):
+            self.linearizable_timer = self._make_timer(
+                "linearizableTimer", scheme.timeout, self._flush_linearizable
+            )
+            self.sequential_timer = self._make_timer(
+                "sequentialTimer", scheme.timeout, self._flush_sequential
+            )
+            self.eventual_timer = self._make_timer(
+                "eventualTimer", scheme.timeout, self._flush_eventual
+            )
+        else:  # Adaptive: kick off the max-slot pipeline immediately.
+            self.linearizable_timer = None
+            self.sequential_timer = None
+            self.eventual_timer = None
+            self._send_batch_max_slot_request(-1)
+
+    def _make_timer(self, name: str, timeout: float, flush):
+        def fire() -> None:
+            flush()
+            timer.start()
+
+        timer = self.timer(name, timeout, fire)
+        timer.start()
+        return timer
+
+    def _random_replica(self) -> Address:
+        return self.config.replica_addresses[
+            self.rng.randrange(self.config.num_replicas)
+        ]
+
+    def _send_batch_max_slot_request(self, read_batcher_id: int) -> None:
+        if not self.config.flexible:
+            group = self.config.acceptor_addresses[
+                self.rng.randrange(self.config.num_acceptor_groups)
+            ]
+            quorum = [
+                group[i]
+                for i in self.rng.sample(range(len(group)), self.config.f + 1)
+            ]
+        else:
+            # Flexible mode: a grid read quorum is a FULL row; f+1 of a wider
+            # row would not intersect write quorums (columns).
+            quorum = list(
+                self.config.acceptor_addresses[
+                    self.rng.randrange(self.config.num_acceptor_groups)
+                ]
+            )
+        request = BatchMaxSlotRequest(
+            read_batcher_index=self.index, read_batcher_id=read_batcher_id
+        )
+        for acceptor in quorum:
+            self.chan(acceptor).send(request)
+        self.batch_max_slot_replies[read_batcher_id] = {}
+
+    def _flush_linearizable(self) -> None:
+        if not self.linearizable_batch:
+            return
+        self._send_batch_max_slot_request(self.linearizable_id)
+        self.pending_linearizable_batches[self.linearizable_id] = (
+            self.linearizable_batch
+        )
+        self.linearizable_id += 1
+        self.linearizable_batch = []
+
+    def _flush_sequential(self) -> None:
+        if not self.sequential_batch:
+            return
+        self.chan(self._random_replica()).send(
+            SequentialReadRequestBatch(
+                slot=self.sequential_slot, commands=tuple(self.sequential_batch)
+            )
+        )
+        self.sequential_slot = -1
+        self.sequential_batch = []
+
+    def _flush_eventual(self) -> None:
+        if not self.eventual_batch:
+            return
+        self.chan(self._random_replica()).send(
+            EventualReadRequestBatch(commands=tuple(self.eventual_batch))
+        )
+        self.eventual_batch = []
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ReadRequest):
+            self._handle_read_request(msg)
+        elif isinstance(msg, SequentialReadRequest):
+            self._handle_sequential_read_request(msg)
+        elif isinstance(msg, EventualReadRequest):
+            self._handle_eventual_read_request(msg)
+        elif isinstance(msg, BatchMaxSlotReply):
+            self._handle_batch_max_slot_reply(msg)
+        else:
+            self.logger.fatal(f"unknown read batcher message {msg!r}")
+
+    def _handle_read_request(self, msg: ReadRequest) -> None:
+        self.linearizable_batch.append(msg.command)
+        scheme = self.options.read_batching_scheme
+        if isinstance(scheme, SizeScheme):
+            if len(self.linearizable_batch) < scheme.batch_size:
+                return
+            self._flush_linearizable()
+            self.linearizable_timer.reset()
+
+    def _handle_sequential_read_request(self, msg: SequentialReadRequest) -> None:
+        scheme = self.options.read_batching_scheme
+        if isinstance(scheme, AdaptiveScheme):
+            self.logger.fatal("adaptive batching incompatible with sequential reads")
+        self.sequential_slot = max(self.sequential_slot, msg.slot)
+        self.sequential_batch.append(msg.command)
+        if isinstance(scheme, SizeScheme):
+            if len(self.sequential_batch) < scheme.batch_size:
+                return
+            self._flush_sequential()
+            self.sequential_timer.reset()
+
+    def _handle_eventual_read_request(self, msg: EventualReadRequest) -> None:
+        scheme = self.options.read_batching_scheme
+        if isinstance(scheme, AdaptiveScheme):
+            self.logger.fatal("adaptive batching incompatible with eventual reads")
+        self.eventual_batch.append(msg.command)
+        if isinstance(scheme, SizeScheme):
+            if len(self.eventual_batch) < scheme.batch_size:
+                return
+            self._flush_eventual()
+            self.eventual_timer.reset()
+
+    def _handle_batch_max_slot_reply(self, msg: BatchMaxSlotReply) -> None:
+        replies = self.batch_max_slot_replies.get(msg.read_batcher_id)
+        if replies is None:
+            return  # duplicate
+        replies[msg.acceptor_index] = msg
+        quorum_size = (
+            len(self.config.acceptor_addresses[0])  # full grid row
+            if self.config.flexible
+            else self.config.f + 1
+        )
+        if len(replies) < quorum_size:
+            return
+        max_slot = max(r.slot for r in replies.values())
+        if self.options.unsafe_read_at_first_slot:
+            slot = 0
+        elif self.config.flexible or self.options.unsafe_read_at_i:
+            # Grids don't round-robin slots over groups; no slot inflation.
+            slot = max_slot
+        else:
+            slot = max_slot + self.config.num_acceptor_groups - 1
+        del self.batch_max_slot_replies[msg.read_batcher_id]
+
+        batch = self.pending_linearizable_batches.pop(msg.read_batcher_id, None)
+        if batch is not None:
+            self.chan(self._random_replica()).send(
+                ReadRequestBatch(slot=slot, commands=tuple(batch))
+            )
+            self.read_batches_sent_total.inc()
+
+        if isinstance(self.options.read_batching_scheme, AdaptiveScheme):
+            self._send_batch_max_slot_request(self.linearizable_id)
+            if self.linearizable_batch:
+                self.pending_linearizable_batches[self.linearizable_id] = (
+                    self.linearizable_batch
+                )
+            self.linearizable_id += 1
+            self.linearizable_batch = []

@@ -1,0 +1,284 @@
+"""MultiPaxos Replica (reference ``multipaxos/Replica.scala``).
+
+Stores chosen entries in a watermark-GC'd BufferMap log
+(Replica.scala:168-170); ``execute_log`` executes entries in slot order
+from the executed watermark (the hot loop, Replica.scala:394-453), dedupes
+via a largest-id client table (Replica.scala:305-344), drains deferred
+reads at each slot, and periodically broadcasts ChosenWatermark. A
+randomized recover timer fires when the log has a hole and asks leaders to
+re-run phase 1 (Replica.scala:239-260). Read handling implements
+linearizable (deferrable, Replica.scala:455-529), sequential, and eventual
+reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport
+from frankenpaxos_tpu.monitoring import Collectors, FakeCollectors
+from frankenpaxos_tpu.protocols.multipaxos.config import (
+    Config,
+    DistributionScheme,
+)
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Chosen,
+    ChosenWatermark,
+    ClientReply,
+    ClientReplyBatch,
+    Command,
+    CommandBatchOrNoop,
+    EventualReadRequest,
+    EventualReadRequestBatch,
+    ReadReply,
+    ReadReplyBatch,
+    ReadRequest,
+    ReadRequestBatch,
+    Recover,
+    SequentialReadRequest,
+    SequentialReadRequestBatch,
+)
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, random_duration
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaOptions:
+    log_grow_size: int = 5000
+    unsafe_dont_use_client_table: bool = False
+    send_chosen_watermark_every_n_entries: int = 1000
+    recover_log_entry_min_period: float = 5.0
+    recover_log_entry_max_period: float = 10.0
+    unsafe_dont_recover: bool = False
+    measure_latencies: bool = True
+
+
+class Replica(Actor):
+    def __init__(
+        self,
+        address: Address,
+        transport: Transport,
+        logger: Logger,
+        state_machine: StateMachine,
+        config: Config,
+        options: ReplicaOptions = ReplicaOptions(),
+        collectors: Optional[Collectors] = None,
+        seed: int = 0,
+    ):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        collectors = collectors or FakeCollectors()
+        self.requests_total = collectors.counter(
+            "multipaxos_replica_requests_total", "requests", labels=("type",)
+        )
+        self.executed_commands_total = collectors.counter(
+            "multipaxos_replica_executed_commands_total", "executed commands"
+        )
+        self.index = config.replica_addresses.index(address)
+        self.log: BufferMap[CommandBatchOrNoop] = BufferMap(options.log_grow_size)
+        self.deferred_reads: BufferMap[List[Command]] = BufferMap(
+            options.log_grow_size
+        )
+        self.executed_watermark = 0
+        self.num_chosen = 0
+        # (client address bytes, pseudonym) -> (largest executed id, output).
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+        self.recover_timer = (
+            None
+            if options.unsafe_dont_recover
+            else self.timer(
+                "recover",
+                random_duration(
+                    self.rng,
+                    options.recover_log_entry_min_period,
+                    options.recover_log_entry_max_period,
+                ),
+                self._recover,
+            )
+        )
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _recover(self) -> None:
+        recover = Recover(slot=self.executed_watermark)
+        proxy = self._proxy_replica()
+        if proxy is not None:
+            self.chan(proxy).send(recover)
+        else:
+            for leader in self.config.leader_addresses:
+                self.chan(leader).send(recover)
+
+    def _proxy_replica(self) -> Optional[Address]:
+        if self.config.num_proxy_replicas == 0:
+            return None
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            return self.config.proxy_replica_addresses[
+                self.rng.randrange(self.config.num_proxy_replicas)
+            ]
+        return self.config.proxy_replica_addresses[self.index]
+
+    def _client_addr(self, command_id) -> Address:
+        return self.transport.address_from_bytes(command_id.client_address)
+
+    def _execute_command(
+        self, slot: int, command: Command, client_replies: List[ClientReply]
+    ) -> None:
+        cid = command.command_id
+        key = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(key)
+        if cached is not None and cid.client_id < cached[0]:
+            return  # redundantly chosen; already executed
+        if cached is not None and cid.client_id == cached[0]:
+            client_replies.append(
+                ClientReply(command_id=cid, slot=slot, result=cached[1])
+            )
+            return
+        result = self.state_machine.run(command.command)
+        if not self.options.unsafe_dont_use_client_table:
+            self.client_table[key] = (cid.client_id, result)
+        # Replies are striped over replicas so only one replica replies per
+        # slot (Replica.scala:323-327).
+        if slot % self.config.num_replicas == self.index:
+            client_replies.append(
+                ClientReply(command_id=cid, slot=slot, result=result)
+            )
+        self.executed_commands_total.inc()
+
+    def _execute_log(self) -> List[ClientReply]:
+        client_replies: List[ClientReply] = []
+        while True:
+            value = self.log.get(self.executed_watermark)
+            if value is None:
+                return client_replies
+            slot = self.executed_watermark
+            if not value.is_noop:
+                for command in value.batch.commands:
+                    self._execute_command(slot, command, client_replies)
+            reads = self.deferred_reads.get(slot)
+            if reads is not None:
+                self._process_deferred_reads(reads)
+            self.executed_watermark += 1
+            n = self.options.send_chosen_watermark_every_n_entries
+            mod, div = self.executed_watermark % n, self.executed_watermark // n
+            if mod == 0 and div % self.config.num_replicas == self.index:
+                watermark = ChosenWatermark(slot=self.executed_watermark)
+                proxy = self._proxy_replica()
+                if proxy is not None:
+                    self.chan(proxy).send(watermark)
+                else:
+                    for leader in self.config.leader_addresses:
+                        self.chan(leader).send(watermark)
+
+    def _execute_read(self, command: Command) -> ReadReply:
+        result = self.state_machine.run(command.command)
+        return ReadReply(
+            command_id=command.command_id,
+            slot=self.executed_watermark - 1,
+            result=result,
+        )
+
+    def _process_deferred_reads(self, reads: List[Command]) -> None:
+        proxy = self._proxy_replica()
+        if len(reads) == 1 or proxy is None:
+            for command in reads:
+                self.chan(self._client_addr(command.command_id)).send(
+                    self._execute_read(command)
+                )
+        else:
+            self.chan(proxy).send(
+                ReadReplyBatch(tuple(self._execute_read(c) for c in reads))
+            )
+
+    def _handle_deferrable_read(
+        self, src: Address, slot: int, command: Command
+    ) -> None:
+        if slot >= self.executed_watermark:
+            reads = self.deferred_reads.get(slot)
+            if reads is None:
+                self.deferred_reads.put(slot, [command])
+            else:
+                reads.append(command)
+            return
+        self.chan(src).send(self._execute_read(command))
+
+    def _handle_deferrable_reads(self, slot: int, commands) -> None:
+        if slot >= self.executed_watermark:
+            reads = self.deferred_reads.get(slot)
+            if reads is None:
+                self.deferred_reads.put(slot, list(commands))
+            else:
+                reads.extend(commands)
+            return
+        proxy = self._proxy_replica()
+        if proxy is not None:
+            self.chan(proxy).send(
+                ReadReplyBatch(tuple(self._execute_read(c) for c in commands))
+            )
+        else:
+            for command in commands:
+                self.chan(self._client_addr(command.command_id)).send(
+                    self._execute_read(command)
+                )
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        self.requests_total.labels(type(msg).__name__).inc()
+        if isinstance(msg, Chosen):
+            self._handle_chosen(msg)
+        elif isinstance(msg, ReadRequest):
+            self._handle_deferrable_read(src, msg.slot, msg.command)
+        elif isinstance(msg, SequentialReadRequest):
+            self._handle_deferrable_read(src, msg.slot, msg.command)
+        elif isinstance(msg, EventualReadRequest):
+            self.chan(src).send(self._execute_read(msg.command))
+        elif isinstance(msg, ReadRequestBatch):
+            self._handle_deferrable_reads(msg.slot, msg.commands)
+        elif isinstance(msg, SequentialReadRequestBatch):
+            self._handle_deferrable_reads(msg.slot, msg.commands)
+        elif isinstance(msg, EventualReadRequestBatch):
+            replies = tuple(self._execute_read(c) for c in msg.commands)
+            proxy = self._proxy_replica()
+            if proxy is not None:
+                self.chan(proxy).send(ReadReplyBatch(replies))
+            else:
+                for reply in replies:
+                    self.chan(self._client_addr(reply.command_id)).send(reply)
+        else:
+            self.logger.fatal(f"unknown replica message {msg!r}")
+
+    def _handle_chosen(self, chosen: Chosen) -> None:
+        was_recovering = self.num_chosen != self.executed_watermark
+        old_watermark = self.executed_watermark
+        if self.log.get(chosen.slot) is not None:
+            return  # redundantly chosen
+        self.log.put(chosen.slot, chosen.value)
+        self.num_chosen += 1
+        client_replies = self._execute_log()
+        if client_replies:
+            proxy = self._proxy_replica()
+            if proxy is not None:
+                self.chan(proxy).send(ClientReplyBatch(tuple(client_replies)))
+            else:
+                for reply in client_replies:
+                    self.chan(self._client_addr(reply.command_id)).send(reply)
+        # Recover timer bookkeeping (Replica.scala:514-527): run it exactly
+        # when there is a hole (some chosen entry is not yet executable).
+        if self.recover_timer is None:
+            return
+        should_run = self.num_chosen != self.executed_watermark
+        advanced = old_watermark != self.executed_watermark
+        if was_recovering:
+            if should_run and advanced:
+                self.recover_timer.reset()
+            elif not should_run:
+                self.recover_timer.stop()
+        elif should_run:
+            self.recover_timer.start()

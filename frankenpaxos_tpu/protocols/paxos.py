@@ -1,0 +1,294 @@
+"""Single-decree Paxos — the pedagogical protocol (reference ``paxos/``:
+Client, Leader, Acceptor choosing exactly one value).
+
+Leaders own rounds via ClassicRoundRobin; phase 1 collects promises from a
+majority (with prior votes), phase 2 proposes the safe value (highest vote
+round, else the client's), and a majority of phase-2b votes chooses it.
+Nacks fast-forward a leader to a later round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Sequence
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosProposeRequest:
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosProposeReply:
+    chosen: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosPhase1a:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosPhase1b:
+    round: int
+    acceptor_index: int
+    vote_round: int
+    vote_value: Optional[str]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosPhase2a:
+    round: int
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosPhase2b:
+    round: int
+    acceptor_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosChosen:
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class PaxosNack:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PaxosConfig:
+    f: int
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+    client_addresses: tuple = ()
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError(f"f must be >= 1, got {self.f}")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need at least f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+
+
+class PaxosClient(Actor):
+    def __init__(self, address, transport, logger, config: PaxosConfig,
+                 resend_period: float = 10.0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.chosen: Optional[str] = None
+        self.promise: Optional[Promise] = None
+        self._request: Optional[PaxosProposeRequest] = None
+        self.resend_timer = self.timer(
+            "resendProposeRequest", resend_period, self._resend
+        )
+
+    def propose(self, value: str) -> Promise:
+        promise = Promise()
+        if self.chosen is not None:
+            promise.success(self.chosen)
+            return promise
+        if self.promise is not None:
+            promise.failure(RuntimeError("propose already pending"))
+            return promise
+        self.promise = promise
+        self._request = PaxosProposeRequest(value)
+        self.chan(self.config.leader_addresses[0]).send(self._request)
+        self.resend_timer.start()
+        return promise
+
+    def _resend(self) -> None:
+        if self.chosen is None and self._request is not None:
+            for leader in self.config.leader_addresses:
+                self.chan(leader).send(self._request)
+            self.resend_timer.start()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, PaxosChosen):
+            if self.chosen is None:
+                self.chosen = msg.value
+                self.resend_timer.stop()
+                if self.promise is not None:
+                    self.promise.success(self.chosen)
+                    self.promise = None
+        else:
+            self.logger.fatal(f"unknown client message {msg!r}")
+
+
+@dataclasses.dataclass
+class _Phase1:
+    value: str  # the client value we want chosen
+    phase1bs: Dict[int, PaxosPhase1b]
+
+
+@dataclasses.dataclass
+class _Phase2:
+    value: str
+    phase2bs: Dict[int, PaxosPhase2b]
+
+
+class PaxosLeader(Actor):
+    def __init__(self, address, transport, logger, config: PaxosConfig,
+                 seed: int = 0, resend_period: float = 5.0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.leader_addresses).index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = -1
+        self.state = None  # None | _Phase1 | _Phase2
+        self.chosen: Optional[str] = None
+        self.clients: List[Address] = []
+        self.rng = random.Random(seed)
+        self.resend_timer = self.timer(
+            "resendPhase1a", resend_period, self._resend_phase
+        )
+
+    def _acceptor_chans(self):
+        return [self.chan(a) for a in self.config.acceptor_addresses]
+
+    def _resend_phase(self) -> None:
+        if self.chosen is not None:
+            return
+        if isinstance(self.state, _Phase1):
+            for ch in self._acceptor_chans():
+                ch.send(PaxosPhase1a(self.round))
+            self.resend_timer.start()
+        elif isinstance(self.state, _Phase2):
+            for ch in self._acceptor_chans():
+                ch.send(PaxosPhase2a(self.round, self.state.value))
+            self.resend_timer.start()
+
+    def _start_phase1(self, value: str) -> None:
+        self.round = self.round_system.next_classic_round(self.index, self.round)
+        self.state = _Phase1(value=value, phase1bs={})
+        for ch in self._acceptor_chans():
+            ch.send(PaxosPhase1a(self.round))
+        self.resend_timer.reset()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, PaxosProposeRequest):
+            self._handle_propose(src, msg)
+        elif isinstance(msg, PaxosPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, PaxosPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, PaxosNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, PaxosChosen):
+            self._handle_chosen(msg)
+        else:
+            self.logger.fatal(f"unknown leader message {msg!r}")
+
+    def _handle_propose(self, src: Address, msg: PaxosProposeRequest) -> None:
+        if src not in self.clients:
+            self.clients.append(src)
+        if self.chosen is not None:
+            self.chan(src).send(PaxosChosen(self.chosen))
+            return
+        if self.state is None:
+            self._start_phase1(msg.value)
+
+    def _handle_phase1b(self, msg: PaxosPhase1b) -> None:
+        if not isinstance(self.state, _Phase1) or msg.round != self.round:
+            return
+        self.state.phase1bs[msg.acceptor_index] = msg
+        if len(self.state.phase1bs) < self.config.f + 1:
+            return
+        # Choose the safe value: highest vote round's value, else ours.
+        votes = [b for b in self.state.phase1bs.values() if b.vote_value is not None]
+        value = (
+            max(votes, key=lambda b: b.vote_round).vote_value
+            if votes
+            else self.state.value
+        )
+        self.state = _Phase2(value=value, phase2bs={})
+        for ch in self._acceptor_chans():
+            ch.send(PaxosPhase2a(self.round, value))
+        self.resend_timer.reset()
+
+    def _handle_phase2b(self, msg: PaxosPhase2b) -> None:
+        if not isinstance(self.state, _Phase2) or msg.round != self.round:
+            return
+        self.state.phase2bs[msg.acceptor_index] = msg
+        if len(self.state.phase2bs) < self.config.f + 1:
+            return
+        self.chosen = self.state.value
+        self.state = None
+        self.resend_timer.stop()
+        for client in self.clients:
+            self.chan(client).send(PaxosChosen(self.chosen))
+        for leader in self.config.leader_addresses:
+            if leader != self.address:
+                self.chan(leader).send(PaxosChosen(self.chosen))
+
+    def _handle_nack(self, msg: PaxosNack) -> None:
+        if msg.round <= self.round or self.chosen is not None:
+            return
+        value = self.state.value if self.state is not None else None
+        self.round = msg.round
+        if value is not None:
+            self._start_phase1(value)
+
+    def _handle_chosen(self, msg: PaxosChosen) -> None:
+        if self.chosen is None:
+            self.chosen = msg.value
+            self.state = None
+            self.resend_timer.stop()
+            for client in self.clients:
+                self.chan(client).send(PaxosChosen(self.chosen))
+
+
+class PaxosAcceptor(Actor):
+    def __init__(self, address, transport, logger, config: PaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = list(config.acceptor_addresses).index(address)
+        self.round = -1
+        self.vote_round = -1
+        self.vote_value: Optional[str] = None
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, PaxosPhase1a):
+            if msg.round < self.round:
+                self.chan(src).send(PaxosNack(self.round))
+                return
+            self.round = msg.round
+            self.chan(src).send(
+                PaxosPhase1b(
+                    round=self.round,
+                    acceptor_index=self.index,
+                    vote_round=self.vote_round,
+                    vote_value=self.vote_value,
+                )
+            )
+        elif isinstance(msg, PaxosPhase2a):
+            if msg.round < self.round:
+                self.chan(src).send(PaxosNack(self.round))
+                return
+            self.round = msg.round
+            self.vote_round = msg.round
+            self.vote_value = msg.value
+            self.chan(src).send(
+                PaxosPhase2b(round=msg.round, acceptor_index=self.index)
+            )
+        else:
+            self.logger.fatal(f"unknown acceptor message {msg!r}")

@@ -1,0 +1,139 @@
+"""Unreplicated state machine — the performance-ceiling baseline
+(reference ``unreplicated/``): one server runs a state machine; clients
+send commands with (pseudonym, id) exactly-once bookkeeping and resend
+timers; the server keeps a simple largest-id client table."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.statemachine import StateMachine
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UnrepCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UnrepClientRequest:
+    command_id: UnrepCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UnrepClientReply:
+    command_id: UnrepCommandId
+    result: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerOptions:
+    flush_every_n: int = 1
+
+
+class Server(Actor):
+    def __init__(self, address, transport, logger,
+                 state_machine: StateMachine,
+                 options: ServerOptions = ServerOptions()):
+        super().__init__(address, transport, logger)
+        self.state_machine = state_machine
+        self.options = options
+        # (client address bytes, pseudonym) -> (largest id, cached output).
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+        self._unflushed = 0
+        self._clients: set = set()
+
+    def receive(self, src: Address, msg) -> None:
+        cid = msg.command_id
+        key = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(key)
+        if cached is not None and cid.client_id < cached[0]:
+            return  # stale
+        if cached is not None and cid.client_id == cached[0]:
+            result = cached[1]  # resend cached reply
+        else:
+            result = self.state_machine.run(msg.command)
+            self.client_table[key] = (cid.client_id, result)
+        reply = UnrepClientReply(command_id=cid, result=result)
+        if self.options.flush_every_n == 1:
+            self.chan(src).send(reply)
+        else:
+            self._clients.add(src)
+            self.chan(src).send_no_flush(reply)
+            self._unflushed += 1
+            if self._unflushed >= self.options.flush_every_n:
+                # Flush EVERY client channel, not just the current sender's
+                # (cf. unreplicated/Server.scala: clients.values.foreach(flush)).
+                for client in self._clients:
+                    self.flush(client)
+                self._unflushed = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientOptions:
+    resend_client_request_period: float = 10.0
+
+
+@dataclasses.dataclass
+class PendingWrite:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class Client(Actor):
+    def __init__(self, address, transport, logger, server: Address,
+                 options: ClientOptions = ClientOptions()):
+        super().__init__(address, transport, logger)
+        self.server = server
+        self.options = options
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, PendingWrite] = {}
+        self.address_bytes = transport.address_to_bytes(address)
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(
+                f"pseudonym {pseudonym} already has a pending request"
+            ))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        request = UnrepClientRequest(
+            command_id=UnrepCommandId(self.address_bytes, pseudonym, id),
+            command=command,
+        )
+        self.chan(self.server).send(request)
+
+        def resend() -> None:
+            self.chan(self.server).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"resendClientRequest{pseudonym}",
+            self.options.resend_client_request_period,
+            resend,
+        )
+        timer.start()
+        self.pending[pseudonym] = PendingWrite(id, command, promise, timer)
+        self.ids[pseudonym] = id + 1
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        pseudonym = msg.command_id.client_pseudonym
+        pending = self.pending.get(pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return  # stale
+        pending.resend.stop()
+        del self.pending[pseudonym]
+        pending.result.success(msg.result)

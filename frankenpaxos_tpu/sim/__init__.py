@@ -1,0 +1,222 @@
+"""Randomized simulation / property testing harness.
+
+Capability parity with the reference's test harness
+(``shared/src/test/scala/simulator/SimulatedSystem.scala:152-200`` and
+``Simulator.scala:28-70``): a :class:`SimulatedSystem` supplies
+``new_system(seed)``, ``get_state``, ``generate_command``, ``run_command``
+and three invariant kinds — *state* (every state), *step* (consecutive
+pairs), *history* (whole run). :func:`simulate` runs seeded random command
+histories checking invariants after every command; on failure it returns a
+:class:`BadHistory` and :func:`minimize` shrink-searches sub-histories
+(random-subset sampling à la ScalaCheck ``Gen.someOf`` plus greedy
+delta-debugging) for a minimal counterexample.
+
+Design notes vs the reference: ``generate_command`` receives an explicit
+``random.Random`` (the reference uses ScalaCheck generators with ambient
+randomness), so whole runs — including scheduling — are replayable from
+``(seed, history)`` alone. Command replay must be tolerant of stale
+commands (e.g. delivering an already-delivered message is a no-op), which
+is exactly the contract of ``SimTransport``; that is what makes arbitrary
+subsequences of a bad history executable during shrinking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import traceback
+from typing import Any, Generic, List, Optional, Sequence, TypeVar
+
+System = TypeVar("System")
+State = TypeVar("State")
+Command = TypeVar("Command")
+
+
+class InvariantViolated(Exception):
+    pass
+
+
+class SimulatedSystem(Generic[System, State, Command]):
+    def new_system(self, seed: int) -> System:
+        """Create a fresh system; all randomness must derive from seed."""
+        raise NotImplementedError
+
+    def get_state(self, system: System) -> State:
+        """Extract the (immutable) state the invariants talk about."""
+        raise NotImplementedError
+
+    def generate_command(
+        self, system: System, rng: random.Random
+    ) -> Optional[Command]:
+        """Generate the next random command, or None if the system halted."""
+        raise NotImplementedError
+
+    def run_command(self, system: System, command: Command) -> System:
+        """Run a command (stale commands must be no-ops for shrinkability)."""
+        raise NotImplementedError
+
+    # Invariants: return None if the invariant holds, else an explanation
+    # string (the analog of InvariantHolds/InvariantViolated).
+
+    def state_invariant(self, state: State) -> Optional[str]:
+        return None
+
+    def step_invariant(self, old: State, new: State) -> Optional[str]:
+        return None
+
+    def history_invariant(self, history: Sequence[State]) -> Optional[str]:
+        return None
+
+
+@dataclasses.dataclass
+class BadHistory(Generic[Command]):
+    seed: int
+    history: List[Command]
+    error: str
+
+    def __str__(self) -> str:
+        lines = [f"BadHistory(seed={self.seed}):", f"  error: {self.error}"]
+        for i, cmd in enumerate(self.history):
+            lines.append(f"  [{i}] {cmd!r}")
+        return "\n".join(lines)
+
+
+def _check_invariants(sim: SimulatedSystem, states: List[Any]) -> Optional[str]:
+    """Check state on the last state, step on the last pair, history on all
+    (Simulator.scala:checkInvariants)."""
+    if not states:
+        return None
+    err = sim.state_invariant(states[-1])
+    if err is not None:
+        return err
+    if len(states) >= 2:
+        err = sim.step_invariant(states[-2], states[-1])
+        if err is not None:
+            return err
+    return sim.history_invariant(states)
+
+
+def run_history(
+    sim: SimulatedSystem, seed: int, history: Sequence[Any]
+) -> Optional[str]:
+    """Replay a command history on a fresh system; return an error string if
+    an invariant is violated or an exception is raised."""
+    try:
+        system = sim.new_system(seed)
+        states = [sim.get_state(system)]
+        err = _check_invariants(sim, states)
+        if err is not None:
+            return err
+        for command in history:
+            system = sim.run_command(system, command)
+            states.append(sim.get_state(system))
+            err = _check_invariants(sim, states)
+            if err is not None:
+                return err
+        return None
+    except Exception:
+        return traceback.format_exc()
+
+
+def _simulate_one(
+    sim: SimulatedSystem, seed: int, run_length: int
+) -> Optional[BadHistory]:
+    rng = random.Random(seed ^ 0x5EED)
+    history: List[Any] = []
+    try:
+        system = sim.new_system(seed)
+        states = [sim.get_state(system)]
+        err = _check_invariants(sim, states)
+        if err is not None:
+            return BadHistory(seed, history, err)
+        for _ in range(run_length):
+            command = sim.generate_command(system, rng)
+            if command is None:
+                return None
+            history.append(command)
+            system = sim.run_command(system, command)
+            states.append(sim.get_state(system))
+            err = _check_invariants(sim, states)
+            if err is not None:
+                return BadHistory(seed, history, err)
+        return None
+    except Exception:
+        return BadHistory(seed, history, traceback.format_exc())
+
+
+def simulate(
+    sim: SimulatedSystem,
+    run_length: int,
+    num_runs: int,
+    seed: int = 0,
+) -> Optional[BadHistory]:
+    """Run ``num_runs`` seeded simulations of length <= ``run_length``,
+    checking invariants after every command (Simulator.scala:28-41). Returns
+    the first (un-minimized) BadHistory, or None."""
+    for i in range(num_runs):
+        bad = _simulate_one(sim, seed + i, run_length)
+        if bad is not None:
+            return bad
+    return None
+
+
+def minimize(
+    sim: SimulatedSystem,
+    seed: int,
+    history: Sequence[Any],
+    num_trials: int = 1500,
+) -> BadHistory:
+    """Find a small sub-history of a bad history that still fails
+    (Simulator.scala:43-70). Greedy delta-debugging (try dropping chunks,
+    halving chunk size) followed by random-subset probing."""
+    err = run_history(sim, seed, history)
+    if err is None:
+        raise ValueError("minimize() called with a good history")
+    best = list(history)
+
+    # Greedy chunk removal (ddmin-flavored).
+    trials = 0
+    chunk = max(1, len(best) // 2)
+    while chunk >= 1 and trials < num_trials:
+        i = 0
+        shrunk = False
+        while i < len(best) and trials < num_trials:
+            candidate = best[:i] + best[i + chunk :]
+            trials += 1
+            cand_err = run_history(sim, seed, candidate)
+            if cand_err is not None:
+                best = candidate
+                err = cand_err
+                shrunk = True
+            else:
+                i += chunk
+        if not shrunk or chunk > len(best):
+            chunk //= 2
+
+    # Random subset probing to escape greedy local minima.
+    rng = random.Random(seed ^ 0xD1CE)
+    while trials < num_trials and len(best) > 1:
+        k = rng.randrange(1, len(best))
+        idx = sorted(rng.sample(range(len(best)), k))
+        candidate = [best[i] for i in idx]
+        trials += 1
+        cand_err = run_history(sim, seed, candidate)
+        if cand_err is not None and len(candidate) < len(best):
+            best = candidate
+            err = cand_err
+    return BadHistory(seed, best, err)
+
+
+def simulate_and_minimize(
+    sim: SimulatedSystem,
+    run_length: int,
+    num_runs: int,
+    seed: int = 0,
+    num_trials: int = 1500,
+) -> Optional[BadHistory]:
+    bad = simulate(sim, run_length, num_runs, seed)
+    if bad is None:
+        return None
+    if not bad.history:
+        return bad
+    return minimize(sim, bad.seed, bad.history, num_trials)

@@ -218,15 +218,19 @@ class AppendLog(StateMachine):
 
 
 class ReadableAppendLog(StateMachine):
-    """Append log whose outputs embed the full log so tests can inspect
-    results (ReadableAppendLog.scala)."""
+    """Append log with a built-in read: a non-empty input is appended (the
+    reply is its index); an EMPTY input is a pure read returning the latest
+    entry (ReadableAppendLog.scala:20-31 — "a little janky, but it keeps
+    testing simple")."""
 
     def __init__(self) -> None:
         self.log: List[bytes] = []
 
     def run(self, input: bytes) -> bytes:
-        self.log.append(input)
-        return wire.encode((len(self.log) - 1, list(self.log)))
+        if len(input) > 0:
+            self.log.append(input)
+            return wire.encode(len(self.log) - 1)
+        return self.log[-1] if self.log else b""
 
     def conflicts(self, first: bytes, second: bytes) -> bool:
         return True

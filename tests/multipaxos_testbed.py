@@ -1,0 +1,260 @@
+"""MultiPaxos simulation testbed (the analog of
+``shared/src/test/scala/multipaxos/MultiPaxos.scala``): a full cluster on
+one SimTransport plus a SimulatedSystem whose invariants check that replica
+executed logs are pairwise prefix-compatible and grow monotonically."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from frankenpaxos_tpu.core import (
+    DeliverMessage,
+    FakeLogger,
+    SimAddress,
+    SimTransport,
+    TriggerTimer,
+)
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import multipaxos as mp
+from frankenpaxos_tpu.protocols.multipaxos.read_batcher import SizeScheme
+from frankenpaxos_tpu.sim import SimulatedSystem
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+@dataclasses.dataclass(frozen=True)
+class Write:
+    client_index: int
+    pseudonym: int
+    value: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Read:
+    client_index: int
+    pseudonym: int
+    kind: str  # "linearizable" | "sequential" | "eventual"
+
+
+class MultiPaxosCluster:
+    def __init__(self, seed: int, f: int, batched: bool, flexible: bool,
+                 read_batched: bool = False, num_clients: int = 2):
+        logger = FakeLogger(LogLevel.FATAL)
+        self.transport = SimTransport(logger)
+
+        num_leaders = f + 1
+        if not flexible:
+            acceptors = tuple(
+                tuple(SimAddress(f"acceptor_{g}_{i}") for i in range(2 * f + 1))
+                for g in range(2)
+            )
+        else:
+            # An (f+1) x (f+1) grid tolerates f failures.
+            acceptors = tuple(
+                tuple(SimAddress(f"acceptor_{g}_{i}") for i in range(f + 1))
+                for g in range(f + 1)
+            )
+        self.config = mp.Config(
+            f=f,
+            batcher_addresses=(
+                tuple(SimAddress(f"batcher_{i}") for i in range(f + 1))
+                if batched
+                else ()
+            ),
+            read_batcher_addresses=(
+                tuple(SimAddress(f"read_batcher_{i}") for i in range(f + 1))
+                if read_batched
+                else ()
+            ),
+            leader_addresses=tuple(
+                SimAddress(f"leader_{i}") for i in range(num_leaders)
+            ),
+            leader_election_addresses=tuple(
+                SimAddress(f"election_{i}") for i in range(num_leaders)
+            ),
+            proxy_leader_addresses=tuple(
+                SimAddress(f"proxy_leader_{i}") for i in range(f + 1)
+            ),
+            acceptor_addresses=acceptors,
+            replica_addresses=tuple(
+                SimAddress(f"replica_{i}") for i in range(f + 1)
+            ),
+            proxy_replica_addresses=tuple(
+                SimAddress(f"proxy_replica_{i}") for i in range(f + 1)
+            ),
+            flexible=flexible,
+            distribution_scheme=mp.DistributionScheme.HASH,
+        )
+
+        def mklogger():
+            return FakeLogger(LogLevel.FATAL)
+
+        seeds = iter(range(seed * 1000, seed * 1000 + 999))
+        self.clients = [
+            mp.Client(
+                SimAddress(f"client_{i}"), self.transport, mklogger(),
+                self.config, seed=next(seeds),
+            )
+            for i in range(num_clients)
+        ]
+        self.batchers = [
+            mp.Batcher(
+                a, self.transport, mklogger(), self.config,
+                mp.BatcherOptions(batch_size=2), seed=next(seeds),
+            )
+            for a in self.config.batcher_addresses
+        ]
+        self.read_batchers = [
+            mp.ReadBatcher(
+                a, self.transport, mklogger(), self.config,
+                mp.ReadBatcherOptions(
+                    read_batching_scheme=SizeScheme(batch_size=2, timeout=1.0)
+                ),
+                seed=next(seeds),
+            )
+            for a in self.config.read_batcher_addresses
+        ]
+        self.leaders = [
+            mp.Leader(a, self.transport, mklogger(), self.config, seed=next(seeds))
+            for a in self.config.leader_addresses
+        ]
+        self.proxy_leaders = [
+            mp.ProxyLeader(
+                a, self.transport, mklogger(), self.config, seed=next(seeds)
+            )
+            for a in self.config.proxy_leader_addresses
+        ]
+        self.acceptors = [
+            mp.Acceptor(a, self.transport, mklogger(), self.config)
+            for group in self.config.acceptor_addresses
+            for a in group
+        ]
+        self.replicas = [
+            mp.Replica(
+                a, self.transport, mklogger(), ReadableAppendLog(), self.config,
+                mp.ReplicaOptions(send_chosen_watermark_every_n_entries=5),
+                seed=next(seeds),
+            )
+            for a in self.config.replica_addresses
+        ]
+        self.proxy_replicas = [
+            mp.ProxyReplica(a, self.transport, mklogger(), self.config)
+            for a in self.config.proxy_replica_addresses
+        ]
+        # Liveness signals (the valueChosen flag of MultiPaxosTest.scala:36-40).
+        self.writes_completed = 0
+        self.reads_completed = 0
+        self.read_results = []
+        self.values_written = set()
+        # Set when a completed read returns a value that was never written —
+        # checked by SimulatedMultiPaxos.state_invariant.
+        self.bogus_read = None
+
+    def on_write_done(self, promise) -> None:
+        if promise.exception is None:
+            self.writes_completed += 1
+
+    def on_read_done(self, promise) -> None:
+        if promise.exception is None:
+            self.reads_completed += 1
+            self.read_results.append(promise.value)
+            # Reads use the empty command, which ReadableAppendLog answers
+            # with its latest entry (or b"" for an empty log). Any other
+            # result is fabricated state.
+            if promise.value != b"" and promise.value not in self.values_written:
+                self.bogus_read = promise.value
+
+
+class SimulatedMultiPaxos(SimulatedSystem):
+    """State = tuple of per-replica executed command tuples (AppendLog)."""
+
+    def __init__(self, f: int, batched: bool, flexible: bool,
+                 read_batched: bool = False, workload=("write",)):
+        self.f = f
+        self.batched = batched
+        self.flexible = flexible
+        self.read_batched = read_batched
+        self.workload = workload
+        self._last_system: Optional[MultiPaxosCluster] = None
+
+    def new_system(self, seed: int) -> MultiPaxosCluster:
+        self._last_system = MultiPaxosCluster(
+            seed, self.f, self.batched, self.flexible, self.read_batched
+        )
+        return self._last_system
+
+    def get_state(self, system: MultiPaxosCluster):
+        return tuple(tuple(r.state_machine.log) for r in system.replicas)
+
+    def generate_command(self, system: MultiPaxosCluster, rng: random.Random):
+        choices = []
+        for i, client in enumerate(system.clients):
+            for pseudonym in (0, 1):
+                if pseudonym in client.states:
+                    continue
+                if "write" in self.workload:
+                    choices.append(
+                        (1, Write(i, pseudonym, f"v{rng.randrange(100)}".encode()))
+                    )
+                for kind in ("linearizable", "sequential", "eventual"):
+                    if kind in self.workload:
+                        choices.append((1, Read(i, pseudonym, kind)))
+        t = system.transport
+        if t.messages:
+            choices.append((len(t.messages), "deliver"))
+        running = t.running_timers()
+        if running:
+            choices.append((len(running), "timer"))
+        if not choices:
+            return None
+        total = sum(w for w, _ in choices)
+        pick = rng.randrange(total)
+        for w, choice in choices:
+            if pick < w:
+                break
+            pick -= w
+        if choice == "deliver":
+            return DeliverMessage(t.messages[rng.randrange(len(t.messages))])
+        if choice == "timer":
+            timer = running[rng.randrange(len(running))]
+            return TriggerTimer(timer.address, timer.name())
+        return choice
+
+    def run_command(self, system: MultiPaxosCluster, command):
+        if isinstance(command, Write):
+            system.values_written.add(command.value)
+            promise = system.clients[command.client_index].write(
+                command.pseudonym, command.value
+            )
+            promise.on_complete(system.on_write_done)
+        elif isinstance(command, Read):
+            client = system.clients[command.client_index]
+            method = {
+                "linearizable": client.read,
+                "sequential": client.sequential_read,
+                "eventual": client.eventual_read,
+            }[command.kind]
+            method(command.pseudonym, b"").on_complete(system.on_read_done)
+        else:
+            system.transport.run_command(command, record=False)
+        return system
+
+    # Invariants (multipaxos/MultiPaxos.scala:285-320).
+
+    def state_invariant(self, state):
+        if self._last_system is not None and self._last_system.bogus_read:
+            return f"read returned a never-written value: {self._last_system.bogus_read!r}"
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"replica logs not prefix-compatible: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"replica log shrank or changed: {o!r} -> {n!r}"
+        return None

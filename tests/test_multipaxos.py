@@ -1,0 +1,157 @@
+"""MultiPaxos simulation tests (the analog of
+``shared/src/test/scala/multipaxos/MultiPaxosTest.scala``): sweep
+(batched, flexible) x f, run randomized histories, check replica-log
+prefix compatibility + monotone growth, and report a liveness signal."""
+
+import pytest
+
+from frankenpaxos_tpu.sim import simulate, simulate_and_minimize
+from multipaxos_testbed import MultiPaxosCluster, SimulatedMultiPaxos
+
+
+@pytest.mark.parametrize("batched", [False, True])
+@pytest.mark.parametrize("flexible", [False, True])
+@pytest.mark.parametrize("f", [1, 2])
+def test_multipaxos_write_safety(batched, flexible, f):
+    sim = SimulatedMultiPaxos(f=f, batched=batched, flexible=flexible)
+    bad = simulate_and_minimize(sim, run_length=120, num_runs=12, seed=f)
+    assert bad is None, f"\n{bad}"
+
+
+def drain(system, max_steps=50000):
+    t = system.transport
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps, "message storm"
+
+
+def test_multipaxos_liveness_writes_complete():
+    """Under a fair (deliver-everything) schedule, writes must finish — the
+    valueChosen liveness smoke of MultiPaxosTest.scala:36-40. (Under fully
+    adversarial random scheduling liveness is not guaranteed: elections can
+    churn forever, which is why the reference only *reports* valueChosen.)"""
+    sim = SimulatedMultiPaxos(f=1, batched=False, flexible=False)
+    system = sim.new_system(seed=7)
+    from multipaxos_testbed import Write
+
+    for i in range(5):
+        sim.run_command(system, Write(0, 0, f"w{i}".encode()))
+        sim.run_command(system, Write(1, 1, f"x{i}".encode()))
+        drain(system)
+    assert system.writes_completed == 10
+    # All replicas executed all ten commands, identically ordered.
+    logs = {tuple(r.state_machine.log) for r in system.replicas}
+    assert len(logs) == 1
+    assert len(next(iter(logs))) == 10
+
+
+@pytest.mark.parametrize(
+    "workload",
+    [("write", "linearizable"), ("write", "sequential"), ("write", "eventual")],
+)
+def test_multipaxos_reads_safety(workload):
+    sim = SimulatedMultiPaxos(
+        f=1, batched=False, flexible=False, workload=workload
+    )
+    bad = simulate_and_minimize(sim, run_length=120, num_runs=8, seed=3)
+    assert bad is None, f"\n{bad}"
+
+
+def test_multipaxos_read_batcher_path():
+    sim = SimulatedMultiPaxos(
+        f=1,
+        batched=True,
+        flexible=False,
+        read_batched=True,
+        workload=("write", "linearizable", "sequential", "eventual"),
+    )
+    bad = simulate_and_minimize(sim, run_length=150, num_runs=6, seed=11)
+    assert bad is None, f"\n{bad}"
+
+
+def test_multipaxos_liveness_reads_complete():
+    sim = SimulatedMultiPaxos(
+        f=1, batched=False, flexible=False, workload=("write", "linearizable")
+    )
+    system = sim.new_system(seed=21)
+    from multipaxos_testbed import Read, Write
+
+    sim.run_command(system, Write(0, 0, b"w"))
+    drain(system)
+    # A linearizable read may defer at slot maxVotedSlot + numGroups - 1,
+    # waiting for that slot to execute (Replica.scala:455-529) — in real
+    # deployments the leader's noop-flush timer unblocks it; here a
+    # subsequent write does.
+    for i, kind in enumerate(("linearizable", "sequential", "eventual")):
+        sim.run_command(system, Read(0, pseudonym := i % 2, kind))
+        drain(system)
+        sim.run_command(system, Write(1, 0, f"w{i}".encode()))
+        drain(system)
+        sim.run_command(system, Write(1, 1, f"x{i}".encode()))
+        drain(system)
+    assert system.writes_completed == 7
+    assert system.reads_completed == 3
+    # Every read returned a genuinely-written value (the first, linearizable
+    # read was issued after b"w" completed, so it must not be empty).
+    assert system.read_results[0] in system.values_written
+    for result in system.read_results:
+        assert result in system.values_written | {b""}
+    assert system.bogus_read is None
+
+
+def test_multipaxos_leader_failover_and_log_repair():
+    """Kill leader 0 mid-stream; leader 1 takes over via election, repairs
+    the log with phase 1 (Leader.scala:504-577), and new writes complete."""
+    from frankenpaxos_tpu.election.basic import State as ElectionState
+    from multipaxos_testbed import Write
+
+    sim = SimulatedMultiPaxos(f=1, batched=False, flexible=False)
+    system = sim.new_system(seed=3)
+    t = system.transport
+    config = system.config
+
+    sim.run_command(system, Write(0, 0, b"before"))
+    drain(system)
+    assert system.writes_completed == 1
+
+    # Partition leader 0 and its election participant.
+    t.partition_actor(config.leader_addresses[0])
+    t.partition_actor(config.leader_election_addresses[0])
+    # A client writes; request goes to the dead leader and is dropped.
+    sim.run_command(system, Write(0, 0, b"after"))
+    drain(system)
+    assert system.writes_completed == 1
+
+    # Election participant 1 times out and becomes leader; the callback
+    # fires leader 1's leaderChange -> phase 1.
+    t.trigger_timer(config.leader_election_addresses[1], "noPingTimer")
+    drain(system)
+    assert system.leaders[1].election.state == ElectionState.LEADER
+    from frankenpaxos_tpu.protocols.multipaxos.leader import _Phase2
+
+    assert isinstance(system.leaders[1].state, _Phase2)
+
+    # The client's resend timer redirects the write: leader 0 is dead, so
+    # the resend goes to it and is dropped; the client must learn the new
+    # round. NotLeaderClient can't arrive (leader 0 is partitioned), so
+    # deliver a LeaderInfo poll: fire resend until the new leader replies.
+    client = system.clients[0]
+    from frankenpaxos_tpu.protocols.multipaxos.messages import (
+        LeaderInfoRequestClient,
+    )
+
+    for leader in config.leader_addresses:
+        client.chan(leader).send(LeaderInfoRequestClient())
+    drain(system)
+    assert client.round == system.leaders[1].round
+    # Now the resend timer sends to the new leader.
+    pseudonym_state = client.states[0]
+    t.trigger_timer(client.address, f"resendClientRequest[0;{pseudonym_state.id}]")
+    drain(system)
+    assert system.writes_completed == 2
+    logs = {tuple(r.state_machine.log) for r in system.replicas}
+    assert len(logs) == 1
+    final = next(iter(logs))
+    assert final.count(b"before") == 1 and final.count(b"after") == 1

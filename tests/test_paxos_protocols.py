@@ -1,0 +1,184 @@
+"""Sim tests of echo, unreplicated, and single-decree paxos."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import (
+    DeliverMessage,
+    FakeLogger,
+    SimAddress,
+    SimTransport,
+    TriggerTimer,
+)
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import paxos as px
+from frankenpaxos_tpu.protocols import unreplicated as unrep
+from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
+from frankenpaxos_tpu.sim import SimulatedSystem, simulate_and_minimize
+from frankenpaxos_tpu.statemachine import AppendLog
+
+
+def drain(t, max_steps=50000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_echo():
+    t = SimTransport(FakeLogger())
+    server_addr, client_addr = SimAddress("server"), SimAddress("client")
+    server = EchoServer(server_addr, t, FakeLogger())
+    client = EchoClient(client_addr, t, FakeLogger(), server_addr)
+    client.echo("hello")
+    t.trigger_timer(client_addr, "pingTimer")
+    drain(t)
+    assert server.num_messages_received == 2
+    assert client.num_messages_received == 2
+
+
+def test_unreplicated_exactly_once():
+    t = SimTransport(FakeLogger())
+    server_addr, client_addr = SimAddress("server"), SimAddress("client")
+    sm = AppendLog()
+    unrep.Server(server_addr, t, FakeLogger(), sm)
+    client = unrep.Client(client_addr, t, FakeLogger(), server_addr)
+    p1 = client.propose(0, b"a")
+    p2 = client.propose(1, b"b")
+    # Force a resend (duplicates the request in flight).
+    t.trigger_timer(client_addr, "resendClientRequest0")
+    drain(t)
+    assert p1.done and p2.done
+    assert sm.log == [b"a", b"b"] or sm.log == [b"b", b"a"]  # executed once each
+    # A second write on pseudonym 0 works after the first completes.
+    p3 = client.propose(0, b"c")
+    drain(t)
+    assert p3.done and sm.log.count(b"c") == 1
+
+
+def make_paxos(f=1, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    config = px.PaxosConfig(
+        f=f,
+        leader_addresses=tuple(SimAddress(f"leader{i}") for i in range(f + 1)),
+        acceptor_addresses=tuple(
+            SimAddress(f"acceptor{i}") for i in range(2 * f + 1)
+        ),
+    )
+    leaders = [
+        px.PaxosLeader(a, t, FakeLogger(LogLevel.FATAL), config, seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    acceptors = [
+        px.PaxosAcceptor(a, t, FakeLogger(LogLevel.FATAL), config)
+        for a in config.acceptor_addresses
+    ]
+    clients = [
+        px.PaxosClient(SimAddress(f"client{i}"), t, FakeLogger(LogLevel.FATAL), config)
+        for i in range(2)
+    ]
+    return t, config, leaders, acceptors, clients
+
+
+def test_paxos_chooses_one_value_happy_path():
+    t, config, leaders, acceptors, clients = make_paxos()
+    p = clients[0].propose("apple")
+    drain(t)
+    assert p.done and p.result() == "apple"
+    assert clients[0].chosen == "apple"
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    value: str
+
+
+class SimulatedPaxos(SimulatedSystem):
+    """Invariant: every chosen value across clients+leaders is the same."""
+
+    def __init__(self, f=1):
+        self.f = f
+
+    def new_system(self, seed):
+        return make_paxos(self.f, seed)
+
+    def get_state(self, system):
+        t, config, leaders, acceptors, clients = system
+        return tuple(c.chosen for c in clients) + tuple(l.chosen for l in leaders)
+
+    def generate_command(self, system, rng):
+        t, config, leaders, acceptors, clients = system
+        choices = []
+        for i, c in enumerate(clients):
+            if c.promise is None and c.chosen is None:
+                choices.append((1, Propose(i, f"value{i}")))
+        if t.messages:
+            choices.append((len(t.messages), "deliver"))
+        running = t.running_timers()
+        if running:
+            choices.append((len(running), "timer"))
+        if not choices:
+            return None
+        total = sum(w for w, _ in choices)
+        pick = rng.randrange(total)
+        for w, choice in choices:
+            if pick < w:
+                break
+            pick -= w
+        if choice == "deliver":
+            return DeliverMessage(t.messages[rng.randrange(len(t.messages))])
+        if choice == "timer":
+            timer = running[rng.randrange(len(running))]
+            return TriggerTimer(timer.address, timer.name())
+        return choice
+
+    def run_command(self, system, command):
+        t, config, leaders, acceptors, clients = system
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(command.value)
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        chosen = {v for v in state if v is not None}
+        if len(chosen) > 1:
+            return f"multiple values chosen: {chosen}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if o is not None and n != o:
+                return f"chosen value changed from {o!r} to {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_paxos_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedPaxos(f), run_length=100, num_runs=30, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_paxos_liveness_with_contention():
+    """Two clients propose different values; after enough scheduling, one
+    value is chosen everywhere."""
+    rng = random.Random(5)
+    sim = SimulatedPaxos(1)
+    system = sim.new_system(5)
+    t, config, leaders, acceptors, clients = system
+    sim.run_command(system, Propose(0, "a"))
+    sim.run_command(system, Propose(1, "b"))
+    for _ in range(500):
+        cmd = sim.generate_command(system, rng)
+        if cmd is None:
+            break
+        sim.run_command(system, cmd)
+    drain(t)
+    chosen = {c.chosen for c in clients}
+    assert len(chosen) == 1 and None not in chosen

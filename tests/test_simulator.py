@@ -1,0 +1,194 @@
+"""Tests of the simulation harness, using the reference's two pedagogical
+systems: a bank account (shared/src/test/scala/bankaccount) and the Die Hard
+water-jug puzzle (shared/src/test/scala/diehard), which demonstrates that
+the simulator can *find* states."""
+
+import dataclasses
+import random
+
+from frankenpaxos_tpu.sim import (
+    BadHistory,
+    SimulatedSystem,
+    minimize,
+    run_history,
+    simulate,
+    simulate_and_minimize,
+)
+
+
+class BankAccount:
+    """Deliberately buggy: withdraw doesn't check the balance."""
+
+    def __init__(self):
+        self.balance = 0
+
+    def deposit(self, amount):
+        self.balance += amount
+
+    def withdraw(self, amount):
+        self.balance -= amount  # BUG: can go negative
+
+
+@dataclasses.dataclass(frozen=True)
+class Deposit:
+    amount: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Withdraw:
+    amount: int
+
+
+class SimulatedBankAccount(SimulatedSystem):
+    def new_system(self, seed):
+        return BankAccount()
+
+    def get_state(self, system):
+        return system.balance
+
+    def generate_command(self, system, rng):
+        if rng.random() < 0.5:
+            return Deposit(rng.randrange(0, 100))
+        return Withdraw(rng.randrange(0, 100))
+
+    def run_command(self, system, command):
+        if isinstance(command, Deposit):
+            system.deposit(command.amount)
+        else:
+            system.withdraw(command.amount)
+        return system
+
+    def state_invariant(self, state):
+        if state < 0:
+            return f"balance {state} is negative"
+        return None
+
+
+def test_finds_bank_account_bug_and_minimizes():
+    bad = simulate_and_minimize(
+        SimulatedBankAccount(), run_length=50, num_runs=20, seed=0
+    )
+    assert bad is not None
+    assert "negative" in bad.error
+    # Minimal counterexample: a single withdraw.
+    assert len(bad.history) == 1
+    assert isinstance(bad.history[0], Withdraw)
+    # The bad history replays deterministically.
+    assert run_history(SimulatedBankAccount(), bad.seed, bad.history) is not None
+
+
+class SafeBankAccount(SimulatedBankAccount):
+    def run_command(self, system, command):
+        if isinstance(command, Deposit):
+            system.deposit(command.amount)
+        elif system.balance - command.amount >= 0:
+            system.withdraw(command.amount)
+        return system
+
+
+def test_safe_system_passes():
+    assert simulate(SafeBankAccount(), run_length=100, num_runs=50, seed=0) is None
+
+
+# -- Die Hard puzzle: 3-gallon and 5-gallon jugs; reach exactly 4 -----------
+
+
+@dataclasses.dataclass(frozen=True)
+class Fill:
+    jug: int  # 0 = small(3), 1 = big(5)
+
+
+@dataclasses.dataclass(frozen=True)
+class Empty:
+    jug: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Pour:
+    src: int
+    dst: int
+
+
+class SimulatedDieHard(SimulatedSystem):
+    CAP = (3, 5)
+
+    def new_system(self, seed):
+        return [0, 0]
+
+    def get_state(self, system):
+        return tuple(system)
+
+    def generate_command(self, system, rng):
+        choices = [Fill(0), Fill(1), Empty(0), Empty(1), Pour(0, 1), Pour(1, 0)]
+        return rng.choice(choices)
+
+    def run_command(self, system, command):
+        if isinstance(command, Fill):
+            system[command.jug] = self.CAP[command.jug]
+        elif isinstance(command, Empty):
+            system[command.jug] = 0
+        else:
+            amount = min(system[command.src], self.CAP[command.dst] - system[command.dst])
+            system[command.src] -= amount
+            system[command.dst] += amount
+        return system
+
+    def state_invariant(self, state):
+        # "Invariant": big jug never holds exactly 4 gallons. The simulator
+        # violating this = solving the puzzle.
+        if state[1] == 4:
+            return "big jug holds 4 gallons: puzzle solved"
+        return None
+
+
+def test_simulator_solves_diehard():
+    bad = simulate_and_minimize(
+        SimulatedDieHard(), run_length=30, num_runs=200, seed=0
+    )
+    assert bad is not None
+    assert "solved" in bad.error
+    # The optimal solution takes 6 steps; shrinking should get close.
+    assert len(bad.history) <= 8
+    # Replaying the minimized history ends with big jug at 4.
+    sim = SimulatedDieHard()
+    system = sim.new_system(bad.seed)
+    for cmd in bad.history:
+        system = sim.run_command(system, cmd)
+    assert system[1] == 4
+
+
+def test_minimize_requires_bad_history():
+    import pytest
+
+    with pytest.raises(ValueError):
+        minimize(SafeBankAccount(), 0, [Deposit(5)])
+
+
+def test_step_and_history_invariants():
+    class Monotone(SimulatedSystem):
+        def new_system(self, seed):
+            return [0]
+
+        def get_state(self, system):
+            return system[0]
+
+        def generate_command(self, system, rng):
+            return rng.choice([1, -1])
+
+        def run_command(self, system, command):
+            system[0] += command
+            return system
+
+        def step_invariant(self, old, new):
+            if new < old:
+                return f"decreased from {old} to {new}"
+            return None
+
+        def history_invariant(self, history):
+            if len(history) > 3 and history[-1] == 0:
+                return "returned to zero late"
+            return None
+
+    bad = simulate(Monotone(), run_length=20, num_runs=5, seed=0)
+    assert bad is not None
+    assert "decreased" in bad.error or "zero" in bad.error

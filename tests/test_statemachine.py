@@ -46,10 +46,12 @@ def test_append_log():
 
 def test_readable_append_log():
     sm = ReadableAppendLog()
-    idx, log = wire.decode(sm.run(b"a"))
-    assert idx == 0 and log == [b"a"]
-    idx, log = wire.decode(sm.run(b"b"))
-    assert idx == 1 and log == [b"a", b"b"]
+    assert sm.run(b"") == b""  # read of empty log
+    assert wire.decode(sm.run(b"a")) == 0
+    assert wire.decode(sm.run(b"b")) == 1
+    # Empty input is a pure read: returns the latest entry, no mutation.
+    assert sm.run(b"") == b"b"
+    assert sm.run(b"") == b"b"
     assert sm.get() == [b"a", b"b"]
 
 
