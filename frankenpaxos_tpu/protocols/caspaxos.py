@@ -1,0 +1,376 @@
+"""CASPaxos — replicated register without a log (reference ``caspaxos/``:
+Client, Leader, Acceptor over an int-set register whose change function is
+set union).
+
+Leaders cycle Idle → Phase1 → Phase2 → Idle per request batch
+(``caspaxos/Leader.scala`` state ADT); acceptors keep (round, voteRound,
+voteValue) (``caspaxos/Acceptor.scala``). On a nack the leader backs off
+for a randomized period before retrying in a higher round
+(WaitingToRecover). Deliberate divergence: we select the phase-1 value
+from the HIGHEST vote round (classic CASPaxos safety); the reference's
+``minBy(_.voteRound)`` (caspaxos/Leader.scala:318) appears to be a bug and
+is only shielded there by the commutative union change function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.util import random_duration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasClientRequest:
+    client_address: bytes
+    client_id: int
+    int_set: frozenset
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasClientReply:
+    client_id: int
+    value: frozenset
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasPhase1a:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasPhase1b:
+    round: int
+    acceptor_index: int
+    vote_round: int
+    vote_value: Optional[frozenset]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasPhase2a:
+    round: int
+    value: frozenset
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasPhase2b:
+    round: int
+    acceptor_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CasNack:
+    higher_round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CasPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderOptions:
+    resend_phase1as_period: float = 5.0
+    resend_phase2as_period: float = 5.0
+    min_nack_sleep_period: float = 0.5
+    max_nack_sleep_period: float = 1.0
+
+
+@dataclasses.dataclass
+class _Idle:
+    round: int
+
+
+@dataclasses.dataclass
+class _Phase1:
+    client_requests: List[CasClientRequest]
+    round: int
+    phase1bs: Dict[int, CasPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Phase2:
+    client_requests: List[CasClientRequest]
+    round: int
+    value: frozenset
+    phase2bs: Dict[int, CasPhase2b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _WaitingToRecover:
+    client_requests: List[CasClientRequest]
+    round: int
+    recover_timer: object
+
+
+class CasLeader(Actor):
+    def __init__(self, address, transport, logger, config: CasPaxosConfig,
+                 options: LeaderOptions = LeaderOptions(), seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.state = _Idle(self.round_system.next_classic_round(self.index, -1))
+
+    def _broadcast(self, msg) -> None:
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(msg)
+
+    def _make_resend(self, name: str, period: float, msg):
+        def fire() -> None:
+            self._broadcast(msg)
+            timer.start()
+
+        timer = self.timer(name, period, fire)
+        timer.start()
+        return timer
+
+    def _transition_to_phase1(self, round: int, client_requests) -> None:
+        phase1a = CasPhase1a(round=round)
+        self._broadcast(phase1a)
+        self.state = _Phase1(
+            client_requests=list(client_requests),
+            round=round,
+            phase1bs={},
+            resend=self._make_resend(
+                "resendPhase1as", self.options.resend_phase1as_period, phase1a
+            ),
+        )
+
+    def _stop_timers(self) -> None:
+        s = self.state
+        if isinstance(s, _Phase1):
+            s.resend.stop()
+        elif isinstance(s, _Phase2):
+            s.resend.stop()
+        elif isinstance(s, _WaitingToRecover):
+            s.recover_timer.stop()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, CasClientRequest):
+            self._handle_client_request(msg)
+        elif isinstance(msg, CasPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, CasPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, CasNack):
+            self._handle_nack(msg)
+        else:
+            self.logger.fatal(f"unknown caspaxos leader message {msg!r}")
+
+    def _handle_client_request(self, msg: CasClientRequest) -> None:
+        if isinstance(self.state, _Idle):
+            self._transition_to_phase1(self.state.round, [msg])
+        else:
+            self.state.client_requests.append(msg)
+
+    def _handle_phase1b(self, msg: CasPhase1b) -> None:
+        if not isinstance(self.state, _Phase1):
+            return
+        phase1 = self.state
+        if msg.round != phase1.round:
+            self.logger.check_lt(msg.round, phase1.round)
+            return
+        phase1.phase1bs[msg.acceptor_index] = msg
+        if len(phase1.phase1bs) < self.config.quorum_size:
+            return
+        top = max(phase1.phase1bs.values(), key=lambda b: b.vote_round)
+        previous = (
+            frozenset() if top.vote_round == -1 else top.vote_value
+        )
+        new_value = frozenset(previous | phase1.client_requests[0].int_set)
+        phase2a = CasPhase2a(round=phase1.round, value=new_value)
+        self._broadcast(phase2a)
+        phase1.resend.stop()
+        self.state = _Phase2(
+            client_requests=phase1.client_requests,
+            round=phase1.round,
+            value=new_value,
+            phase2bs={},
+            resend=self._make_resend(
+                "resendPhase2as", self.options.resend_phase2as_period, phase2a
+            ),
+        )
+
+    def _handle_phase2b(self, msg: CasPhase2b) -> None:
+        if not isinstance(self.state, _Phase2):
+            return
+        phase2 = self.state
+        if msg.round != phase2.round:
+            self.logger.check_lt(msg.round, phase2.round)
+            return
+        phase2.phase2bs[msg.acceptor_index] = msg
+        if len(phase2.phase2bs) < self.config.quorum_size:
+            return
+        request = phase2.client_requests[0]
+        client = self.transport.address_from_bytes(request.client_address)
+        self.chan(client).send(
+            CasClientReply(client_id=request.client_id, value=phase2.value)
+        )
+        phase2.resend.stop()
+        round = self.round_system.next_classic_round(self.index, phase2.round)
+        if len(phase2.client_requests) == 1:
+            self.state = _Idle(round=round)
+        else:
+            self._transition_to_phase1(round, phase2.client_requests[1:])
+
+    def _handle_nack(self, msg: CasNack) -> None:
+        round = self.state.round
+        if msg.higher_round <= round:
+            return
+        new_round = self.round_system.next_classic_round(
+            self.index, msg.higher_round
+        )
+        self._stop_timers()
+        if isinstance(self.state, _Idle):
+            self.state = _Idle(round=new_round)
+            return
+        requests = list(self.state.client_requests)
+
+        def recover() -> None:
+            self._transition_to_phase1(new_round, requests)
+
+        timer = self.timer(
+            "recover",
+            random_duration(
+                self.rng,
+                self.options.min_nack_sleep_period,
+                self.options.max_nack_sleep_period,
+            ),
+            recover,
+        )
+        timer.start()
+        self.state = _WaitingToRecover(
+            client_requests=requests, round=new_round, recover_timer=timer
+        )
+
+
+class CasAcceptor(Actor):
+    def __init__(self, address, transport, logger, config: CasPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = -1
+        self.vote_round = -1
+        self.vote_value: Optional[frozenset] = None
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, CasPhase1a):
+            if msg.round <= self.round:
+                self.chan(src).send(CasNack(higher_round=self.round))
+                return
+            self.round = msg.round
+            self.chan(src).send(
+                CasPhase1b(
+                    round=msg.round,
+                    acceptor_index=self.index,
+                    vote_round=self.vote_round,
+                    vote_value=self.vote_value,
+                )
+            )
+        elif isinstance(msg, CasPhase2a):
+            if msg.round < self.round:
+                self.chan(src).send(CasNack(higher_round=self.round))
+                return
+            self.round = msg.round
+            self.vote_round = msg.round
+            self.vote_value = msg.value
+            self.chan(src).send(
+                CasPhase2b(round=msg.round, acceptor_index=self.index)
+            )
+        else:
+            self.logger.fatal(f"unknown caspaxos acceptor message {msg!r}")
+
+
+@dataclasses.dataclass
+class _PendingCas:
+    id: int
+    result: Promise
+    resend: object
+
+
+class CasClient(Actor):
+    def __init__(self, address, transport, logger, config: CasPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.next_id = 0
+        self.pending: Optional[_PendingCas] = None
+
+    def propose(self, int_set) -> Promise:
+        """Union int_set into the register; resolves with the new value."""
+        promise = Promise()
+        if self.pending is not None:
+            promise.failure(RuntimeError("a proposal is already pending"))
+            return promise
+        id = self.next_id
+        self.next_id += 1
+        request = CasClientRequest(
+            client_address=self.address_bytes,
+            client_id=id,
+            int_set=frozenset(int_set),
+        )
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))
+        ]
+        self.chan(leader).send(request)
+
+        def resend() -> None:
+            # Retry with any leader.
+            target = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendCas{id}", self.resend_period, resend)
+        timer.start()
+        self.pending = _PendingCas(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, CasClientReply):
+            self.logger.fatal(f"unknown caspaxos client message {msg!r}")
+        if self.pending is None or msg.client_id != self.pending.id:
+            return
+        pending = self.pending
+        pending.resend.stop()
+        self.pending = None
+        pending.result.success(msg.value)

@@ -1,0 +1,250 @@
+"""CRAQ — chain replication with apportioned queries (reference ``craq/``:
+ChainNode, Client).
+
+Writes enter at the head and flow down the chain; the tail applies and
+replies, then acks flow back up and each node applies on ack
+(``craq/ChainNode.scala:120-299``). Reads go to ANY node: if none of the
+read keys have writes pending at that node the read is served locally
+("clean"); otherwise it is forwarded to the tail ("dirty"), preserving
+linearizability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqWrite:
+    command_id: CraqCommandId
+    key: str
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqWriteBatch:
+    writes: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqRead:
+    command_id: CraqCommandId
+    key: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqReadBatch:
+    reads: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqAck:
+    write_batch: CraqWriteBatch
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqTailRead:
+    read_batch: CraqReadBatch
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqClientReply:
+    command_id: CraqCommandId
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class CraqReadReply:
+    command_id: CraqCommandId
+    value: str
+
+
+DEFAULT = "default"  # value of unwritten keys (ChainNode.scala:163)
+
+
+@dataclasses.dataclass(frozen=True)
+class CraqConfig:
+    f: int
+    chain_node_addresses: tuple
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.chain_node_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 chain nodes")
+
+
+class ChainNode(Actor):
+    def __init__(self, address, transport, logger, config: CraqConfig,
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.chain_node_addresses.index(address)
+        self.is_head = self.index == 0
+        self.is_tail = self.index == len(config.chain_node_addresses) - 1
+        self.pending_writes: List[CraqWriteBatch] = []
+        self.state_machine: Dict[str, str] = {}
+        self.versions = 0
+
+    def _client(self, command_id: CraqCommandId) -> Address:
+        return self.transport.address_from_bytes(command_id.client_address)
+
+    def _process_write_batch(self, batch: CraqWriteBatch) -> None:
+        self.pending_writes.append(batch)
+        if not self.is_tail:
+            nxt = self.config.chain_node_addresses[self.index + 1]
+            self.chan(nxt).send(batch)
+            return
+        # Tail: apply, reply, ack back up the chain.
+        for write in batch.writes:
+            self.state_machine[write.key] = write.value
+            self.chan(self._client(write.command_id)).send(
+                CraqClientReply(command_id=write.command_id)
+            )
+            self.versions += 1
+        self.pending_writes.remove(batch)
+        if not self.is_head:
+            prev = self.config.chain_node_addresses[self.index - 1]
+            self.chan(prev).send(CraqAck(write_batch=batch))
+
+    def _process_read_batch(self, batch: CraqReadBatch) -> None:
+        dirty_keys = {
+            w.key for pw in self.pending_writes for w in pw.writes
+        }
+        dirty_reads = []
+        for read in batch.reads:
+            if read.key in dirty_keys:
+                dirty_reads.append(read)
+            else:
+                value = self.state_machine.get(read.key, DEFAULT)
+                self.chan(self._client(read.command_id)).send(
+                    CraqReadReply(command_id=read.command_id, value=value)
+                )
+                self.versions += 1
+        if dirty_reads:
+            tail = self.config.chain_node_addresses[-1]
+            self.chan(tail).send(
+                CraqTailRead(read_batch=CraqReadBatch(tuple(dirty_reads)))
+            )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, CraqWrite):
+            self._process_write_batch(CraqWriteBatch((msg,)))
+        elif isinstance(msg, CraqWriteBatch):
+            self._process_write_batch(msg)
+        elif isinstance(msg, CraqRead):
+            self._process_read_batch(CraqReadBatch((msg,)))
+        elif isinstance(msg, CraqReadBatch):
+            self._process_read_batch(msg)
+        elif isinstance(msg, CraqTailRead):
+            for read in msg.read_batch.reads:
+                value = self.state_machine.get(read.key, DEFAULT)
+                self.chan(self._client(read.command_id)).send(
+                    CraqReadReply(command_id=read.command_id, value=value)
+                )
+                self.versions += 1
+        elif isinstance(msg, CraqAck):
+            self._handle_ack(msg)
+        else:
+            self.logger.fatal(f"unknown chain node message {msg!r}")
+
+    def _handle_ack(self, ack: CraqAck) -> None:
+        if ack.write_batch in self.pending_writes:
+            self.pending_writes.remove(ack.write_batch)
+        for write in ack.write_batch.writes:
+            self.state_machine[write.key] = write.value
+        if not self.is_head:
+            prev = self.config.chain_node_addresses[self.index - 1]
+            self.chan(prev).send(ack)
+
+
+@dataclasses.dataclass
+class _CraqPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class CraqClient(Actor):
+    def __init__(self, address, transport, logger, config: CraqConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _CraqPending] = {}
+
+    def _start(self, pseudonym: int, send) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        command_id = CraqCommandId(self.address_bytes, pseudonym, id)
+        send(command_id)
+
+        def resend() -> None:
+            send(command_id)
+            timer.start()
+
+        timer = self.timer(f"resend[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _CraqPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def write(self, pseudonym: int, key: str, value: str) -> Promise:
+        head = self.config.chain_node_addresses[0]
+        return self._start(
+            pseudonym,
+            lambda cid: self.chan(head).send(
+                CraqWrite(command_id=cid, key=key, value=value)
+            ),
+        )
+
+    def read(self, pseudonym: int, key: str) -> Promise:
+        node = self.config.chain_node_addresses[
+            self.rng.randrange(len(self.config.chain_node_addresses))
+        ]
+        return self._start(
+            pseudonym,
+            lambda cid: self.chan(node).send(CraqRead(command_id=cid, key=key)),
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        pseudonym = msg.command_id.client_pseudonym
+        pending = self.pending.get(pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[pseudonym]
+        if isinstance(msg, CraqClientReply):
+            pending.result.success(None)
+        elif isinstance(msg, CraqReadReply):
+            pending.result.success(msg.value)
+        else:
+            self.logger.fatal(f"unknown craq client message {msg!r}")

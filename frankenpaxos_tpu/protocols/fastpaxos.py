@@ -1,0 +1,303 @@
+"""Fast Paxos — single-decree with a fast round (reference ``fastpaxos/``:
+Client, Leader, Acceptor).
+
+Clients propose straight to acceptors in fast round 0 and count Phase2bs
+themselves; a fast quorum (f + ⌊(f+1)/2⌋ + 1 of n = 2f+1) chooses the
+value (``fastpaxos/Client.scala:118-135``). On timeout the client falls
+back to leaders, which run classic rounds (round += n keeps ownership,
+``fastpaxos/Leader.scala``): phase 1 collects a classic quorum and picks
+the value by max vote round; for round-0 votes the value must be one
+voted by a majority-of-quorum (``Util.popularItems``), else any value is
+safe. Deliberate divergence: where the reference proposes ``None`` when no
+round-0 value is popular (stalling), we propose the leader's own value —
+the standard coordinated-recovery rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.util import popular_items
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpProposeRequest:
+    v: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpProposeReply:
+    chosen: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpPhase1a:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpPhase1b:
+    round: int
+    acceptor_id: int
+    vote_round: int
+    vote_value: Optional[str]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpPhase2a:
+    round: int
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FpPhase2b:
+    acceptor_id: int
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FastPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def classic_quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def quorum_majority_size(self) -> int:
+        return (self.f + 1) // 2 + 1
+
+    @property
+    def fast_quorum_size(self) -> int:
+        return self.f + self.quorum_majority_size
+
+    def check_valid(self) -> None:
+        if not self.f + 1 <= len(self.leader_addresses) <= self.n:
+            # Upper bound matters: classic rounds stride by n from a start
+            # of the leader index, so indices must be unique mod n or two
+            # leaders would own the same rounds.
+            raise ValueError(f"need between f+1 and {self.n} leaders")
+        if len(self.acceptor_addresses) != self.n:
+            raise ValueError(f"need exactly {self.n} acceptors")
+
+
+class FpClient(Actor):
+    def __init__(self, address, transport, logger, config: FastPaxosConfig,
+                 repropose_period: float = 5.0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.proposed_value: Optional[str] = None
+        self.chosen_value: Optional[str] = None
+        self.phase2bs: Set[FpPhase2b] = set()
+        self.promises: List[Promise] = []
+        self.repropose_timer = self.timer(
+            "reproposeTimer", repropose_period, self._repropose
+        )
+
+    def _repropose(self) -> None:
+        # Fall back to the classic path through the leaders.
+        for leader in self.config.leader_addresses:
+            self.chan(leader).send(FpProposeRequest(v=self.proposed_value))
+        self.repropose_timer.start()
+
+    def propose(self, v: str) -> Promise:
+        promise = Promise()
+        if self.chosen_value is not None:
+            promise.success(self.chosen_value)
+            return promise
+        if self.proposed_value is not None:
+            self.promises.append(promise)
+            return promise
+        self.proposed_value = v
+        # Fast path: straight to the acceptors in round 0.
+        for acceptor in self.config.acceptor_addresses:
+            self.chan(acceptor).send(FpProposeRequest(v=v))
+        self.repropose_timer.start()
+        self.promises.append(promise)
+        return promise
+
+    def _choose(self, chosen: str) -> None:
+        if self.chosen_value is not None:
+            self.logger.check_eq(self.chosen_value, chosen)
+            return
+        self.chosen_value = chosen
+        for promise in self.promises:
+            promise.success(chosen)
+        self.promises.clear()
+        self.repropose_timer.stop()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FpProposeReply):
+            self._choose(msg.chosen)
+        elif isinstance(msg, FpPhase2b):
+            self.logger.check_eq(msg.round, 0)
+            self.phase2bs.add(msg)
+            if len(self.phase2bs) >= self.config.fast_quorum_size:
+                self._choose(self.proposed_value)
+        else:
+            self.logger.fatal(f"unknown fastpaxos client message {msg!r}")
+
+
+class FpLeader(Actor):
+    IDLE, PHASE1, PHASE2, CHOSEN = range(4)
+
+    def __init__(self, address, transport, logger, config: FastPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.leader_addresses.index(address)
+        self.round = self.index  # rounds advance by n, keeping ownership
+        self.status = self.IDLE
+        self.proposed_value: Optional[str] = None
+        self.chosen_value: Optional[str] = None
+        self.phase1bs: Dict[int, FpPhase1b] = {}
+        self.phase2bs: Dict[int, FpPhase2b] = {}
+        self.clients: List[Address] = []
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FpProposeRequest):
+            self._handle_propose(src, msg)
+        elif isinstance(msg, FpPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, FpPhase2b):
+            self._handle_phase2b(msg)
+        else:
+            self.logger.fatal(f"unknown fastpaxos leader message {msg!r}")
+
+    def _handle_propose(self, src: Address, msg: FpProposeRequest) -> None:
+        if self.chosen_value is not None:
+            self.chan(src).send(FpProposeReply(chosen=self.chosen_value))
+            return
+        self.round += self.config.n
+        self.proposed_value = msg.v
+        self.status = self.PHASE1
+        self.phase1bs.clear()
+        self.phase2bs.clear()
+        for acceptor in self.config.acceptor_addresses:
+            self.chan(acceptor).send(FpPhase1a(round=self.round))
+        if src not in self.clients:
+            self.clients.append(src)
+
+    def _handle_phase1b(self, msg: FpPhase1b) -> None:
+        if self.status != self.PHASE1 or msg.round != self.round:
+            return
+        self.phase1bs[msg.acceptor_id] = msg
+        if len(self.phase1bs) < self.config.classic_quorum_size:
+            return
+        k = max(b.vote_round for b in self.phase1bs.values())
+        if k == -1:
+            v = self.proposed_value
+        elif k > 0:
+            vs = {
+                b.vote_value
+                for b in self.phase1bs.values()
+                if b.vote_round == k
+            }
+            self.logger.check_eq(len(vs), 1)
+            v = next(iter(vs))
+            self.proposed_value = v
+        else:  # k == 0: fast-round votes; a majority-of-quorum value binds.
+            votes = [
+                b.vote_value
+                for b in self.phase1bs.values()
+                if b.vote_round == 0
+            ]
+            popular = popular_items(votes, self.config.quorum_majority_size)
+            popular = {
+                x
+                for x in popular
+                if votes.count(x) >= self.config.quorum_majority_size
+            }
+            if popular:
+                self.logger.check_eq(len(popular), 1)
+                v = next(iter(popular))
+                self.proposed_value = v
+            else:
+                v = self.proposed_value  # free choice (see module docstring)
+        for acceptor in self.config.acceptor_addresses:
+            self.chan(acceptor).send(FpPhase2a(round=self.round, value=v))
+        self.status = self.PHASE2
+
+    def _handle_phase2b(self, msg: FpPhase2b) -> None:
+        if self.status != self.PHASE2 or msg.round != self.round:
+            return
+        self.phase2bs[msg.acceptor_id] = msg
+        if len(self.phase2bs) < self.config.classic_quorum_size:
+            return
+        chosen = self.proposed_value
+        if self.chosen_value is not None:
+            self.logger.check_eq(self.chosen_value, chosen)
+        self.chosen_value = chosen
+        self.status = self.CHOSEN
+        for client in self.clients:
+            self.chan(client).send(FpProposeReply(chosen=chosen))
+        self.clients.clear()
+
+
+class FpAcceptor(Actor):
+    def __init__(self, address, transport, logger, config: FastPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = 0
+        self.vote_round = -1
+        self.vote_value: Optional[str] = None
+        # Fast voting is enabled for round 0 until a classic round begins
+        # (the reference's voteValue._2 flag).
+        self.fast_round: Optional[int] = 0
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FpProposeRequest):
+            # Fast-path vote (at most one per fast round).
+            if self.fast_round is not None:
+                r = self.fast_round
+                if self.round <= r and self.vote_round < r:
+                    self.round = r
+                    self.vote_round = r
+                    self.vote_value = msg.v
+                    self.chan(src).send(
+                        FpPhase2b(acceptor_id=self.index, round=r)
+                    )
+        elif isinstance(msg, FpPhase1a):
+            if msg.round <= self.round:
+                return
+            self.round = msg.round
+            self.fast_round = None  # classic rounds disable fast voting
+            self.chan(src).send(
+                FpPhase1b(
+                    round=msg.round,
+                    acceptor_id=self.index,
+                    vote_round=self.vote_round,
+                    vote_value=self.vote_value,
+                )
+            )
+        elif isinstance(msg, FpPhase2a):
+            if msg.round < self.round:
+                return
+            if msg.round == self.round and msg.round == self.vote_round:
+                return  # already voted this round
+            self.round = msg.round
+            self.vote_round = msg.round
+            self.vote_value = msg.value
+            self.chan(src).send(
+                FpPhase2b(acceptor_id=self.index, round=msg.round)
+            )
+        else:
+            self.logger.fatal(f"unknown fastpaxos acceptor message {msg!r}")

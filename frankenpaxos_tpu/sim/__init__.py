@@ -220,3 +220,44 @@ def simulate_and_minimize(
     if not bad.history:
         return bad
     return minimize(sim, bad.seed, bad.history, num_trials)
+
+
+# -- Command-generation helpers for protocol testbeds ------------------------
+
+
+def weighted_choice(rng: random.Random, choices):
+    """Pick from [(weight, value), ...] proportionally to weight; None if
+    empty."""
+    total = sum(w for w, _ in choices)
+    if total == 0:
+        return None
+    pick = rng.randrange(total)
+    for w, value in choices:
+        if pick < w:
+            return value
+        pick -= w
+    raise AssertionError("unreachable")
+
+
+def mixed_command(rng: random.Random, transport, op_choices):
+    """The standard testbed command generator: client operations (given as
+    [(weight, command), ...]) mixed with transport deliveries and timer
+    firings weighted by queue sizes — the FakeTransport.generateCommand
+    model with protocol-specific operations layered on top."""
+    from frankenpaxos_tpu.core import DeliverMessage, TriggerTimer
+
+    choices = list(op_choices)
+    if transport.messages:
+        choices.append((len(transport.messages), "__deliver__"))
+    running = transport.running_timers()
+    if running:
+        choices.append((len(running), "__timer__"))
+    choice = weighted_choice(rng, choices)
+    if choice == "__deliver__":
+        return DeliverMessage(
+            transport.messages[rng.randrange(len(transport.messages))]
+        )
+    if choice == "__timer__":
+        timer = running[rng.randrange(len(running))]
+        return TriggerTimer(timer.address, timer.name())
+    return choice

@@ -1,0 +1,31 @@
+"""The TPU-native simulation backend.
+
+This package is the point of the project (BASELINE.json north star): a
+fourth-style transport backend where per-actor protocol state is flattened
+into batched JAX arrays, ``Actor.receive`` handlers become vectorized step
+functions over a replica axis, quorum/ballot aggregation compiles to XLA
+reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
+``lax.scan``, sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
+"""
+
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    BatchedMultiPaxosConfig,
+    BatchedMultiPaxosState,
+    check_invariants,
+    init_state,
+    leader_change,
+    run_ticks,
+    tick,
+)
+from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+__all__ = [
+    "BatchedMultiPaxosConfig",
+    "BatchedMultiPaxosState",
+    "TpuSimTransport",
+    "check_invariants",
+    "init_state",
+    "leader_change",
+    "run_ticks",
+    "tick",
+]

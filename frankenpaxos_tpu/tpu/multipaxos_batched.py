@@ -1,0 +1,376 @@
+"""Batched MultiPaxos as a single XLA program.
+
+The write path of compartmentalized MultiPaxos (SURVEY.md §3.2: Leader →
+ProxyLeader → acceptor group `slot % G` → quorum count → Chosen → replica
+``executeLog``) re-designed TPU-first. Instead of per-actor objects and
+point-to-point messages, the whole cluster is struct-of-arrays state:
+
+  * ``G`` acceptor groups of ``A = 2f+1`` acceptors — the replica axis of
+    the simulation is ``G×A`` acceptors (10k+), vectorized elementwise and
+    shardable over a device mesh along ``G`` (slots are partitioned
+    ``slot % G`` exactly like ProxyLeader.scala:190, so the write path
+    needs NO cross-group communication; only the global executed watermark
+    is a collective).
+  * Each group owns a ring of ``W`` in-flight slots (the BufferMap /
+    in-flight-window of the reference, with backpressure).
+  * "The network" is device memory: a message send is a write of an
+    arrival tick into an array; delivery is an equality test against the
+    tick counter; message loss and latency are PRNG-sampled per message
+    (the FakeTransport nondeterminism model, massively parallel).
+  * Quorum counting (ProxyLeader.handlePhase2b, f+1-of-A) is a sum over
+    the acceptor axis; thrifty quorum choice is a top-(f+1) selection of
+    PRNG scores; ballot checks compare per-acceptor round arrays.
+  * Replica execution (Replica.executeLog's contiguous-prefix hot loop)
+    is a cumulative-product prefix scan over the ring.
+
+One ``tick`` is a pure function ``(state, t, key) -> state`` compiled once;
+``run_ticks`` wraps it in ``lax.scan``. Multi-seed property testing = vmap
+over a seed axis; multi-chip = shard_map over the group axis (see
+``frankenpaxos_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.int32(2**30)
+
+# Slot status codes.
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+
+LAT_BINS = 64  # histogram bins for commit latency (in ticks)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedMultiPaxosConfig:
+    """Static (compile-time) simulation parameters."""
+
+    f: int = 1
+    num_groups: int = 4  # G: acceptor groups; total acceptors = G * (2f+1)
+    window: int = 32  # W: in-flight slots per group (ring capacity)
+    slots_per_tick: int = 4  # K: new proposals per group per tick
+    lat_min: int = 1  # message latency in ticks (uniform sample)
+    lat_max: int = 3
+    drop_rate: float = 0.0  # per-message Bernoulli loss
+    retry_timeout: int = 16  # re-send Phase2a to the FULL group after this
+    thrifty: bool = True  # send Phase2a to f+1 random acceptors, else all
+
+    @property
+    def group_size(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def num_acceptors(self) -> int:
+        return self.num_groups * self.group_size
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.window >= 2 * self.slots_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.drop_rate < 1.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedMultiPaxosState:
+    """Struct-of-arrays cluster state. Shapes: [G] groups, [G, W] ring
+    slots, [G, W, A] per-acceptor votes, [G, A] acceptors."""
+
+    # Leader / proposer.
+    leader_round: jnp.ndarray  # [G] current round (shared leader, per group)
+    next_slot: jnp.ndarray  # [G] next per-group slot sequence number
+    head: jnp.ndarray  # [G] lowest non-retired per-group slot number
+
+    # Ring slots.
+    status: jnp.ndarray  # [G, W] EMPTY | PROPOSED | CHOSEN
+    propose_tick: jnp.ndarray  # [G, W] first proposal tick (for latency)
+    last_send: jnp.ndarray  # [G, W] last Phase2a send tick (for retries)
+    chosen_tick: jnp.ndarray  # [G, W] tick the quorum formed (INF if not)
+    chosen_round: jnp.ndarray  # [G, W] round the quorum formed in (-1 if not)
+    replica_arrival: jnp.ndarray  # [G, W] tick Chosen reaches replicas
+
+    # Acceptors.
+    acc_round: jnp.ndarray  # [G, A] per-acceptor promised round
+    p2a_arrival: jnp.ndarray  # [G, W, A] Phase2a arrival tick (INF = never)
+    p2b_arrival: jnp.ndarray  # [G, W, A] Phase2b arrival tick at counter
+    vote_round: jnp.ndarray  # [G, W, A] round of the vote (-1 = none)
+
+    # Execution / stats.
+    executed: jnp.ndarray  # [G] per-group retired (executed) slot count
+    committed: jnp.ndarray  # [] total slots chosen (cumulative)
+    retired: jnp.ndarray  # [] total slots executed+retired (cumulative)
+    lat_sum: jnp.ndarray  # [] sum of commit latencies (ticks)
+    lat_hist: jnp.ndarray  # [LAT_BINS] commit latency histogram
+
+
+def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
+    G, W, A = cfg.num_groups, cfg.window, cfg.group_size
+    return BatchedMultiPaxosState(
+        leader_round=jnp.zeros((G,), jnp.int32),
+        next_slot=jnp.zeros((G,), jnp.int32),
+        head=jnp.zeros((G,), jnp.int32),
+        status=jnp.zeros((G, W), jnp.int32),
+        propose_tick=jnp.full((G, W), INF, jnp.int32),
+        last_send=jnp.full((G, W), INF, jnp.int32),
+        chosen_tick=jnp.full((G, W), INF, jnp.int32),
+        chosen_round=jnp.full((G, W), -1, jnp.int32),
+        replica_arrival=jnp.full((G, W), INF, jnp.int32),
+        acc_round=jnp.zeros((G, A), jnp.int32),
+        p2a_arrival=jnp.full((G, W, A), INF, jnp.int32),
+        p2b_arrival=jnp.full((G, W, A), INF, jnp.int32),
+        vote_round=jnp.full((G, W, A), -1, jnp.int32),
+        executed=jnp.zeros((G,), jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        retired=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _sample_latency(cfg, key, shape):
+    if cfg.lat_min == cfg.lat_max:
+        return jnp.full(shape, cfg.lat_min, jnp.int32)
+    return jax.random.randint(key, shape, cfg.lat_min, cfg.lat_max + 1)
+
+
+def _sample_delivered(cfg, key, shape):
+    if cfg.drop_rate == 0.0:
+        return jnp.ones(shape, bool)
+    return jax.random.uniform(key, shape) >= cfg.drop_rate
+
+
+def tick(
+    cfg: BatchedMultiPaxosConfig,
+    state: BatchedMultiPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedMultiPaxosState:
+    """One simulation tick: acceptors vote on arrivals, quorums form,
+    replicas retire contiguous chosen prefixes, the leader proposes new
+    slots and retries timed-out ones."""
+    G, W, A = cfg.num_groups, cfg.window, cfg.group_size
+    f = cfg.f
+    k_quorum, k_lat1, k_lat2, k_lat3, k_drop1, k_drop2, k_retry = (
+        jax.random.split(key, 7)
+    )
+
+    status = state.status
+    w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
+
+    # ---- 1. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
+    # Acceptor.scala:184-220): vote iff the message round >= promised round;
+    # on vote, promise the round and schedule the Phase2b arrival.
+    arrived = state.p2a_arrival == t  # [G, W, A]
+    msg_round = state.leader_round[:, None, None]  # one leader round in flight
+    may_vote = arrived & (msg_round >= state.acc_round[:, None, :])
+    new_acc_round = jnp.maximum(
+        state.acc_round, jnp.max(jnp.where(may_vote, msg_round, -1), axis=1)
+    )
+    vote_round = jnp.where(may_vote, msg_round, state.vote_round)
+    p2b_lat = _sample_latency(cfg, k_lat1, (G, W, A))
+    p2b_delivered = _sample_delivered(cfg, k_drop1, (G, W, A))
+    p2b_arrival = jnp.where(
+        may_vote & p2b_delivered,
+        jnp.minimum(state.p2b_arrival, t + p2b_lat),
+        state.p2b_arrival,
+    )
+
+    # ---- 2. Quorum counting (ProxyLeader.handlePhase2b,
+    # ProxyLeader.scala:217-258): a slot is chosen when f+1 Phase2bs for the
+    # current round have arrived. Sum over the acceptor axis.
+    votes_in = (p2b_arrival <= t) & (
+        vote_round == state.leader_round[:, None, None]
+    )
+    nvotes = jnp.sum(votes_in, axis=2)  # [G, W]
+    newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+    chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
+    chosen_round = jnp.where(
+        newly_chosen, state.leader_round[:, None], state.chosen_round
+    )
+    rep_lat = _sample_latency(cfg, k_lat3, (G, W))
+    replica_arrival = jnp.where(
+        newly_chosen, t + rep_lat, state.replica_arrival
+    )
+    status = jnp.where(newly_chosen, CHOSEN, status)
+
+    # Commit latency stats.
+    latency = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    n_new = jnp.sum(newly_chosen)
+    committed = state.committed + n_new
+    lat_sum = state.lat_sum + jnp.sum(latency)
+    bins = jnp.clip(latency, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 3. Replica execution (Replica.executeLog, Replica.scala:394-453):
+    # retire the contiguous prefix of chosen slots whose Chosen has reached
+    # the replicas. Ring order: position of per-group slot s is s % W.
+    slot_of_ord = state.head[:, None] + w_iota[None, :]  # [G, W] slot nums
+    pos_of_ord = slot_of_ord % W
+    executable = (
+        (jnp.take_along_axis(status, pos_of_ord, axis=1) == CHOSEN)
+        & (jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1) <= t)
+        & (slot_of_ord < state.next_slot[:, None])
+    )
+    n_retire = jnp.sum(jnp.cumprod(executable.astype(jnp.int32), axis=1), axis=1)
+    # A ring position retires iff its ordinal from head is < n_retire.
+    ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
+    retire_mask = ord_of_pos < n_retire[:, None]
+    head = state.head + n_retire
+    executed = state.executed + n_retire
+    retired_total = state.retired + jnp.sum(n_retire)
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
+    chosen_round = jnp.where(retire_mask, -1, chosen_round)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
+    last_send = jnp.where(retire_mask, INF, state.last_send)
+    p2a_arrival = jnp.where(retire_mask[:, :, None], INF, state.p2a_arrival)
+    p2b_arrival = jnp.where(retire_mask[:, :, None], INF, p2b_arrival)
+    vote_round = jnp.where(retire_mask[:, :, None], -1, vote_round)
+
+    # ---- 4. Leader proposes new slots (Leader.processClientRequestBatch,
+    # Leader.scala:331-407): fill up to K fresh ring slots if the window
+    # has room. Positions are (next_slot + i) % W; computed elementwise.
+    space = W - (state.next_slot - head)  # [G]
+    count = jnp.minimum(cfg.slots_per_tick, space)  # [G]
+    delta = (w_iota[None, :] - state.next_slot[:, None]) % W  # [G, W]
+    is_new = delta < count[:, None]  # [G, W]
+    next_slot = state.next_slot + count
+
+    status = jnp.where(is_new, PROPOSED, status)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+
+    # Thrifty quorum selection (ThriftySystem / ProxyLeader.scala:187-197):
+    # Phase2a goes to f+1 random acceptors of the slot's group.
+    if cfg.thrifty:
+        scores = jax.random.uniform(k_quorum, (G, W, A))
+        kth = jnp.sort(scores, axis=2)[:, :, f : f + 1]  # (f+1)-th smallest
+        in_quorum = scores <= kth
+    else:
+        in_quorum = jnp.ones((G, W, A), bool)
+    p2a_lat = _sample_latency(cfg, k_lat2, (G, W, A))
+    p2a_delivered = _sample_delivered(cfg, k_drop2, (G, W, A))
+    send_p2a = is_new[:, :, None] & in_quorum & p2a_delivered
+    p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
+
+    # ---- 5. Retries (the resend timers of the reference): a slot still
+    # PROPOSED after retry_timeout re-sends Phase2a to the FULL group —
+    # including acceptors that already voted: their Phase2b may have been
+    # the dropped message, and re-voting (step 1) re-samples its delivery.
+    timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
+    retry_lat = _sample_latency(cfg, k_retry, (G, W, A))
+    resend = timed_out[:, :, None]
+    p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return BatchedMultiPaxosState(
+        leader_round=state.leader_round,
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        chosen_tick=chosen_tick,
+        chosen_round=chosen_round,
+        replica_arrival=replica_arrival,
+        acc_round=new_acc_round,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        vote_round=vote_round,
+        executed=executed,
+        committed=committed,
+        retired=retired_total,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+def leader_change(
+    cfg: BatchedMultiPaxosConfig,
+    state: BatchedMultiPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedMultiPaxosState:
+    """A new leader takes over in a higher round (Leader.leaderChange +
+    startPhase1, Leader.scala:409-459): bump the round, invalidate pending
+    votes of older rounds at the counter, and re-propose every in-flight
+    slot in the new round to the full group (phase-1 repair collapses to
+    re-proposal here because the batched model tracks votes, not values —
+    the safe value IS the slot's value)."""
+    G, W, A = cfg.num_groups, cfg.window, cfg.group_size
+    new_round = state.leader_round + 1
+    in_flight = state.status == PROPOSED
+    lat = _sample_latency(cfg, key, (G, W, A))
+    p2a_arrival = jnp.where(in_flight[:, :, None], t + lat, state.p2a_arrival)
+    return dataclasses.replace(
+        state,
+        leader_round=new_round,
+        p2a_arrival=p2a_arrival,
+        last_send=jnp.where(in_flight, t, state.last_send),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedMultiPaxosConfig,
+    state: BatchedMultiPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedMultiPaxosState, jnp.ndarray]:
+    """Run ``num_ticks`` ticks under lax.scan; returns (state, t0+num_ticks)."""
+
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedMultiPaxosConfig, state: BatchedMultiPaxosState, t
+) -> dict:
+    """Device-side safety checks (the batched analog of the sim invariants).
+    Returns a dict of boolean scalars; all must be True."""
+    f = cfg.f
+    chosen = state.status == CHOSEN
+    # Chosen slots have a quorum of votes at (or, after a repair
+    # re-proposal bumped vote_round, above) the round they were chosen in.
+    votes = (state.p2b_arrival <= t) & (
+        state.vote_round >= state.chosen_round[:, :, None]
+    )
+    quorum_ok = jnp.all(jnp.where(chosen, jnp.sum(votes, axis=2) >= f + 1, True))
+    # Heads never pass next_slot; windows never overfill.
+    window_ok = jnp.all(
+        (state.head <= state.next_slot)
+        & (state.next_slot - state.head <= cfg.window)
+    )
+    # Retired + in-flight bookkeeping is conserved.
+    conserved = jnp.sum(state.executed) == state.retired
+    # Acceptors never promised below the leader round they voted in.
+    round_ok = jnp.all(
+        state.acc_round[:, None, :] >= jnp.where(
+            state.vote_round >= 0, state.vote_round, 0
+        )
+    )
+    return {
+        "quorum_ok": quorum_ok,
+        "window_ok": window_ok,
+        "conserved": conserved,
+        "round_ok": round_ok,
+    }

@@ -1,0 +1,111 @@
+"""TpuSimTransport: the user-facing handle on the batched TPU simulation.
+
+The analog of constructing a cluster on a transport (SURVEY.md §1 L0):
+where ``SimTransport`` delivers one message at a time under a Python
+scheduler, ``TpuSimTransport`` advances the WHOLE cluster one tick at a
+time as a compiled XLA program, with PRNG-sampled message latency and loss
+standing in for the scheduler's nondeterminism. Exposes:
+
+  * ``run(num_ticks)`` — advance the simulation (jit + lax.scan);
+  * ``stats()`` — committed/executed counts, commit-latency p50/mean;
+  * ``leader_change()`` — inject a leader failover (round bump + repair);
+  * ``check_invariants()`` — device-side safety checks;
+  * sharding over a device mesh via ``frankenpaxos_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    LAT_BINS,
+    BatchedMultiPaxosConfig,
+    BatchedMultiPaxosState,
+    check_invariants,
+    init_state,
+    leader_change,
+    run_ticks,
+)
+
+
+class TpuSimTransport:
+    def __init__(
+        self,
+        config: BatchedMultiPaxosConfig,
+        seed: int = 0,
+        mesh: Optional[jax.sharding.Mesh] = None,
+    ):
+        self.config = config
+        self.key = jax.random.PRNGKey(seed)
+        self.t = jnp.zeros((), jnp.int32)
+        self._epoch = 0
+        self.mesh = mesh
+        state = init_state(config)
+        if mesh is not None:
+            from frankenpaxos_tpu.parallel import shard_state
+
+            state = shard_state(state, mesh)
+        self.state = state
+
+    def run(self, num_ticks: int) -> None:
+        key = jax.random.fold_in(self.key, self._epoch)
+        self._epoch += 1
+        if self.mesh is not None:
+            from frankenpaxos_tpu.parallel import run_ticks_sharded
+
+            self.state, self.t = run_ticks_sharded(
+                self.config, self.mesh, self.state, self.t, num_ticks, key
+            )
+        else:
+            self.state, self.t = run_ticks(
+                self.config, self.state, self.t, num_ticks, key
+            )
+
+    def leader_change(self) -> None:
+        key = jax.random.fold_in(self.key, 10_000_000 + self._epoch)
+        self._epoch += 1
+        self.state = leader_change(self.config, self.state, self.t, key)
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.state)
+
+    # -- Observability -------------------------------------------------------
+
+    def committed(self) -> int:
+        return int(self.state.committed)
+
+    def executed(self) -> int:
+        return int(self.state.retired)
+
+    def stats(self) -> dict:
+        committed = int(self.state.committed)
+        lat_hist = jax.device_get(self.state.lat_hist)
+        cum = lat_hist.cumsum()
+        p50 = int((cum >= max(1, (committed + 1) // 2)).argmax()) if committed else -1
+        p99 = (
+            int((cum >= max(1, -(-committed * 99 // 100))).argmax())
+            if committed
+            else -1
+        )
+        return {
+            "ticks": int(self.t),
+            "committed": committed,
+            "executed": int(self.state.retired),
+            "commit_latency_mean_ticks": (
+                float(self.state.lat_sum) / committed if committed else float("nan")
+            ),
+            "commit_latency_p50_ticks": p50,
+            "commit_latency_p99_ticks": p99,
+            "round": int(jax.device_get(self.state.leader_round).max()),
+            "num_acceptors": self.config.num_acceptors,
+        }
+
+    def check_invariants(self) -> dict:
+        return {
+            k: bool(v)
+            for k, v in check_invariants(self.config, self.state, self.t).items()
+        }

@@ -1,7 +1,9 @@
 """Test configuration.
 
 JAX tests run on a virtual 8-device CPU mesh (multi-chip hardware is not
-available in CI); set the XLA flags BEFORE jax is imported anywhere.
+available in CI). The TPU plugin in this environment overrides
+``JAX_PLATFORMS``, so forcing CPU requires BOTH the XLA flag (before
+import) and ``jax.config.update`` (after import).
 """
 
 import os
@@ -12,6 +14,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

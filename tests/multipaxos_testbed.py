@@ -19,7 +19,7 @@ from frankenpaxos_tpu.core import (
 from frankenpaxos_tpu.core.logger import LogLevel
 from frankenpaxos_tpu.protocols import multipaxos as mp
 from frankenpaxos_tpu.protocols.multipaxos.read_batcher import SizeScheme
-from frankenpaxos_tpu.sim import SimulatedSystem
+from frankenpaxos_tpu.sim import SimulatedSystem, mixed_command
 from frankenpaxos_tpu.statemachine import ReadableAppendLog
 
 
@@ -188,38 +188,19 @@ class SimulatedMultiPaxos(SimulatedSystem):
         return tuple(tuple(r.state_machine.log) for r in system.replicas)
 
     def generate_command(self, system: MultiPaxosCluster, rng: random.Random):
-        choices = []
+        ops = []
         for i, client in enumerate(system.clients):
             for pseudonym in (0, 1):
                 if pseudonym in client.states:
                     continue
                 if "write" in self.workload:
-                    choices.append(
+                    ops.append(
                         (1, Write(i, pseudonym, f"v{rng.randrange(100)}".encode()))
                     )
                 for kind in ("linearizable", "sequential", "eventual"):
                     if kind in self.workload:
-                        choices.append((1, Read(i, pseudonym, kind)))
-        t = system.transport
-        if t.messages:
-            choices.append((len(t.messages), "deliver"))
-        running = t.running_timers()
-        if running:
-            choices.append((len(running), "timer"))
-        if not choices:
-            return None
-        total = sum(w for w, _ in choices)
-        pick = rng.randrange(total)
-        for w, choice in choices:
-            if pick < w:
-                break
-            pick -= w
-        if choice == "deliver":
-            return DeliverMessage(t.messages[rng.randrange(len(t.messages))])
-        if choice == "timer":
-            timer = running[rng.randrange(len(running))]
-            return TriggerTimer(timer.address, timer.name())
-        return choice
+                        ops.append((1, Read(i, pseudonym, kind)))
+        return mixed_command(rng, system.transport, ops)
 
     def run_command(self, system: MultiPaxosCluster, command):
         if isinstance(command, Write):

@@ -1,0 +1,176 @@
+"""CASPaxos sim tests (the analog of shared/src/test/scala/caspaxos)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import (
+    DeliverMessage,
+    FakeLogger,
+    SimAddress,
+    SimTransport,
+    TriggerTimer,
+)
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols.caspaxos import (
+    CasAcceptor,
+    CasClient,
+    CasLeader,
+    CasPaxosConfig,
+)
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+
+
+def make(f=1, seed=0, num_clients=2):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    config = CasPaxosConfig(
+        f=f,
+        leader_addresses=tuple(SimAddress(f"leader{i}") for i in range(f + 1)),
+        acceptor_addresses=tuple(
+            SimAddress(f"acceptor{i}") for i in range(2 * f + 1)
+        ),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        CasLeader(a, t, log(), config, seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    acceptors = [CasAcceptor(a, t, log(), config) for a in config.acceptor_addresses]
+    clients = [
+        CasClient(SimAddress(f"client{i}"), t, log(), config, seed=seed + 50 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, leaders, acceptors, clients
+
+
+def drain(t, max_steps=50000):
+    """Deliver all messages; when the network is quiet, fire recover/resend
+    timers (nacked leaders back off on a timer) until nothing is left."""
+    steps = 0
+    for _ in range(50):
+        while t.messages and steps < max_steps:
+            t.deliver_message(t.messages[0])
+            steps += 1
+        assert steps < max_steps
+        recover = [x for x in t.running_timers() if x.name() == "recover"]
+        if not recover:
+            return
+        t.trigger_timer(recover[0].address, "recover")
+
+
+def test_caspaxos_single_proposal():
+    t, config, leaders, acceptors, clients = make()
+    p = clients[0].propose({1, 2})
+    drain(t)
+    assert p.done and p.result() == frozenset({1, 2})
+
+
+def test_caspaxos_sequential_unions():
+    t, config, leaders, acceptors, clients = make()
+    p1 = clients[0].propose({1})
+    drain(t)
+    p2 = clients[0].propose({2})
+    drain(t)
+    p3 = clients[1].propose({3})
+    drain(t)
+    assert p1.result() == frozenset({1})
+    assert p2.result() == frozenset({1, 2})
+    assert p3.result() == frozenset({1, 2, 3})
+
+
+def test_caspaxos_contending_leaders_converge():
+    """Two clients hit two different leaders; nack/backoff resolves it."""
+    t, config, leaders, acceptors, clients = make(seed=3)
+    p1 = clients[0].propose({1})
+    p2 = clients[1].propose({2})
+    rng = random.Random(0)
+    for _ in range(3000):
+        cmd = t.generate_command(rng)
+        if cmd is None:
+            break
+        t.run_command(cmd, record=False)
+    assert p1.done and p2.done
+    # Both results contain the client's own element; the later one contains
+    # both (register grows monotonically).
+    assert 1 in p1.result() and 2 in p2.result()
+    union = p1.result() | p2.result()
+    assert union == frozenset({1, 2})
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    x: int
+
+
+class SimulatedCasPaxos(SimulatedSystem):
+    """Linearizability of the union register, real-time fragment: if
+    operation B is INVOKED after operation A COMPLETED, then B's result
+    must contain everything A's result contained (overlapping operations
+    may linearize in either order, so only non-overlapping pairs are
+    constrained)."""
+
+    def __init__(self, f=1):
+        self.f = f
+        self.violation = None
+        self.completed_union = frozenset()
+        self.n_completed = 0
+
+    def new_system(self, seed):
+        self.violation = None
+        self.completed_union = frozenset()
+        self.n_completed = 0
+        system = make(self.f, seed)
+        self._next_x = iter(range(1, 10_000))
+        return system
+
+    def get_state(self, system):
+        return (self.n_completed, self.violation)
+
+    def generate_command(self, system, rng):
+        t, config, leaders, acceptors, clients = system
+        ops = [
+            (1, Propose(i, next(self._next_x)))
+            for i, c in enumerate(clients)
+            if c.pending is None
+        ]
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t, config, leaders, acceptors, clients = system
+        if isinstance(command, Propose):
+            promise = clients[command.client_index].propose({command.x})
+            # Snapshot what was already completed when this op was invoked.
+            seen_at_invocation = self.completed_union
+
+            def on_done(p):
+                if p.exception is not None:
+                    return
+                if not seen_at_invocation <= p.value:
+                    self.violation = (
+                        f"op invoked after {sorted(seen_at_invocation)} "
+                        f"completed, but returned {sorted(p.value)}"
+                    )
+                self.completed_union = self.completed_union | p.value
+                self.n_completed += 1
+
+            promise.on_complete(on_done)
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        return state[1]
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_caspaxos_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedCasPaxos(f), run_length=150, num_runs=15, seed=f
+    )
+    assert bad is None, f"\n{bad}"

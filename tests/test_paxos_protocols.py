@@ -16,7 +16,11 @@ from frankenpaxos_tpu.core.logger import LogLevel
 from frankenpaxos_tpu.protocols import paxos as px
 from frankenpaxos_tpu.protocols import unreplicated as unrep
 from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
-from frankenpaxos_tpu.sim import SimulatedSystem, simulate_and_minimize
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
 from frankenpaxos_tpu.statemachine import AppendLog
 
 
@@ -112,29 +116,12 @@ class SimulatedPaxos(SimulatedSystem):
 
     def generate_command(self, system, rng):
         t, config, leaders, acceptors, clients = system
-        choices = []
-        for i, c in enumerate(clients):
-            if c.promise is None and c.chosen is None:
-                choices.append((1, Propose(i, f"value{i}")))
-        if t.messages:
-            choices.append((len(t.messages), "deliver"))
-        running = t.running_timers()
-        if running:
-            choices.append((len(running), "timer"))
-        if not choices:
-            return None
-        total = sum(w for w, _ in choices)
-        pick = rng.randrange(total)
-        for w, choice in choices:
-            if pick < w:
-                break
-            pick -= w
-        if choice == "deliver":
-            return DeliverMessage(t.messages[rng.randrange(len(t.messages))])
-        if choice == "timer":
-            timer = running[rng.randrange(len(running))]
-            return TriggerTimer(timer.address, timer.name())
-        return choice
+        ops = [
+            (1, Propose(i, f"value{i}"))
+            for i, c in enumerate(clients)
+            if c.promise is None and c.chosen is None
+        ]
+        return mixed_command(rng, t, ops)
 
     def run_command(self, system, command):
         t, config, leaders, acceptors, clients = system

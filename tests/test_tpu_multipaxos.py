@@ -1,0 +1,210 @@
+"""Tests of the batched TPU simulation backend (CPU backend, 8 virtual
+devices via conftest)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from frankenpaxos_tpu.parallel import (
+    make_mesh,
+    run_ticks_sharded,
+    shard_state,
+)
+from frankenpaxos_tpu.tpu import (
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    check_invariants,
+    init_state,
+    leader_change,
+    run_ticks,
+    tick,
+)
+
+
+def make(drop=0.0, **kw):
+    defaults = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, drop_rate=drop,
+    )
+    defaults.update(kw)
+    return BatchedMultiPaxosConfig(**defaults)
+
+
+def test_happy_path_commits_and_executes():
+    sim = TpuSimTransport(make(), seed=0)
+    sim.run(60)
+    stats = sim.stats()
+    # Steady state: K slots per group per tick commit; pipeline depth only
+    # affects the warmup.
+    max_possible = 4 * 2 * 60
+    assert stats["committed"] > max_possible * 0.8
+    assert 0 < stats["executed"] <= stats["committed"]
+    assert stats["commit_latency_p50_ticks"] >= 2  # two message hops minimum
+    assert all(sim.check_invariants().values())
+
+
+def test_progress_is_monotone_and_window_bounded():
+    sim = TpuSimTransport(make(), seed=1)
+    prev_committed, prev_executed = 0, 0
+    for _ in range(5):
+        sim.run(20)
+        s = sim.stats()
+        assert s["committed"] >= prev_committed
+        assert s["executed"] >= prev_executed
+        prev_committed, prev_executed = s["committed"], s["executed"]
+        assert all(sim.check_invariants().values())
+
+
+def test_drops_recovered_by_retries():
+    cfg = make(drop=0.3, retry_timeout=8)
+    sim = TpuSimTransport(cfg, seed=2)
+    sim.run(400)
+    stats1 = sim.stats()
+    assert stats1["committed"] > 0
+    assert stats1["executed"] > 0
+    # Progress must be SUSTAINED: retries re-send to the full group,
+    # including already-voted acceptors whose Phase2b may have been the
+    # dropped message, so no slot can deadlock and stall its window.
+    sim.run(400)
+    stats2 = sim.stats()
+    assert stats2["committed"] > stats1["committed"] + 100, (
+        "commit progress stalled under loss: windows deadlocked"
+    )
+    assert stats2["executed"] > stats1["executed"] + 100
+    # (Windows may well be full here — that is backpressure behind a slow
+    # head slot, not deadlock; sustained executed growth is the liveness
+    # signal.)
+    assert all(sim.check_invariants().values())
+    # Latency under loss must exceed the lossless latency.
+    lossless = TpuSimTransport(make(), seed=2)
+    lossless.run(400)
+    assert (
+        stats2["commit_latency_mean_ticks"]
+        > lossless.stats()["commit_latency_mean_ticks"]
+    )
+
+
+def test_thrifty_vs_full_broadcast():
+    thrifty = TpuSimTransport(make(thrifty=True), seed=3)
+    full = TpuSimTransport(make(thrifty=False), seed=3)
+    thrifty.run(100)
+    full.run(100)
+    assert thrifty.stats()["committed"] > 0
+    assert full.stats()["committed"] > 0
+    assert all(thrifty.check_invariants().values())
+    assert all(full.check_invariants().values())
+
+
+def test_leader_change_keeps_safety_and_liveness():
+    sim = TpuSimTransport(make(), seed=4)
+    sim.run(30)
+    before = sim.stats()["committed"]
+    sim.leader_change()
+    sim.run(60)
+    stats = sim.stats()
+    assert stats["round"] == 1
+    assert stats["committed"] > before  # in-flight slots repaired + new ones
+    assert all(sim.check_invariants().values())
+
+
+def test_leader_change_under_loss():
+    sim = TpuSimTransport(make(drop=0.2, retry_timeout=6), seed=5)
+    sim.run(50)
+    sim.leader_change()
+    sim.run(200)
+    stats = sim.stats()
+    assert stats["executed"] > 0
+    assert all(sim.check_invariants().values())
+
+
+def test_stale_round_votes_not_counted():
+    """After a leader change, votes from the old round must not form
+    quorums in the new round (ballot safety)."""
+    cfg = make(lat_min=3, lat_max=3)  # long latency: votes in flight
+    sim = TpuSimTransport(cfg, seed=6)
+    sim.run(4)  # phase2as in flight, few votes landed
+    sim.leader_change()
+    sim.run(100)
+    assert all(sim.check_invariants().values())
+
+
+def test_vmap_over_seeds():
+    """Massively parallel property testing: S independent simulations with
+    different PRNG schedules as one vmapped program."""
+    cfg = make(drop=0.1, retry_timeout=6)
+    S = 8
+    states = jax.vmap(lambda _: init_state(cfg))(jnp.arange(S))
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(S))
+
+    def run_one(state, key):
+        def step(carry, i):
+            st, t = carry
+            st = tick(cfg, st, t, jax.random.fold_in(key, i))
+            return (st, t + 1), ()
+
+        (state, t), _ = jax.lax.scan(
+            step, (state, jnp.zeros((), jnp.int32)), jnp.arange(200)
+        )
+        return state, t
+
+    states, ts = jax.vmap(run_one)(states, keys)
+    committed = jax.device_get(states.committed)
+    assert (committed > 0).all()
+    # Different seeds → different schedules → (almost surely) different
+    # commit counts under loss.
+    assert len(set(committed.tolist())) > 1
+    for s in range(S):
+        one = jax.tree.map(lambda x: x[s], states)
+        inv = check_invariants(cfg, one, ts[s])
+        assert all(bool(v) for v in inv.values()), (s, inv)
+
+
+def test_sharded_run_matches_unsharded():
+    """The same simulation, sharded over an 8-device CPU mesh along the
+    group axis, produces the exact same results."""
+    cfg = make(num_groups=8, drop=0.1, retry_timeout=6)
+    key = jax.random.PRNGKey(7)
+    t0 = jnp.zeros((), jnp.int32)
+
+    plain_state, plain_t = run_ticks(cfg, init_state(cfg), t0, 150, key)
+
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    sharded0 = shard_state(init_state(cfg), mesh)
+    sharded_state, sharded_t = run_ticks_sharded(cfg, mesh, sharded0, t0, 150, key)
+
+    assert int(plain_t) == int(sharded_t)
+    for field in (
+        "committed", "retired", "lat_sum", "next_slot", "head", "executed",
+    ):
+        a = jax.device_get(getattr(plain_state, field))
+        b = jax.device_get(getattr(sharded_state, field))
+        assert (a == b).all(), field
+    assert (
+        jax.device_get(plain_state.lat_hist)
+        == jax.device_get(sharded_state.lat_hist)
+    ).all()
+
+
+def test_transport_with_mesh():
+    cfg = make(num_groups=8)
+    sim = TpuSimTransport(cfg, seed=8, mesh=make_mesh())
+    sim.run(50)
+    assert sim.stats()["committed"] > 0
+    assert all(sim.check_invariants().values())
+
+
+def test_invariant_checker_has_teeth():
+    """Corrupt the state (a chosen slot without quorum) and the checker
+    must flag it."""
+    cfg = make()
+    state = init_state(cfg)
+    state, t = run_ticks(cfg, state, jnp.zeros((), jnp.int32), 30, jax.random.PRNGKey(9))
+    bad = dataclasses.replace(
+        state, status=state.status.at[0, 0].set(2),  # CHOSEN
+        p2b_arrival=jnp.full_like(state.p2b_arrival, 2**30),
+    )
+    inv = check_invariants(cfg, bad, t)
+    assert not bool(inv["quorum_ok"])
