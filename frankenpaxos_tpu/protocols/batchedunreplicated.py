@@ -1,0 +1,205 @@
+"""Batched unreplicated state machine (reference ``batchedunreplicated/``:
+Client, Batcher, Server, ProxyServer) — the decoupled-batching pattern in
+its simplest setting: batchers accumulate commands into batches, one
+server executes batches, and proxy servers fan the replies back out to
+clients."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.statemachine import StateMachine
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuCommand:
+    command_id: BuCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuClientRequest:
+    command: BuCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuClientRequestBatch:
+    commands: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuClientReply:
+    command_id: BuCommandId
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BuClientReplyBatch:
+    replies: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedUnreplicatedConfig:
+    batcher_addresses: tuple
+    server_address: object
+    proxy_server_addresses: tuple
+
+    def check_valid(self) -> None:
+        if not self.batcher_addresses:
+            raise ValueError("need at least one batcher")
+        if not self.proxy_server_addresses:
+            raise ValueError("need at least one proxy server")
+
+
+@dataclasses.dataclass(frozen=True)
+class BuBatcherOptions:
+    batch_size: int = 100
+
+
+class BuBatcher(Actor):
+    def __init__(self, address, transport, logger,
+                 config: BatchedUnreplicatedConfig,
+                 options: BuBatcherOptions = BuBatcherOptions()):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.growing_batch: List[BuCommand] = []
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BuClientRequest):
+            self.logger.fatal(f"unknown batcher message {msg!r}")
+        self.growing_batch.append(msg.command)
+        if len(self.growing_batch) >= self.options.batch_size:
+            self.chan(self.config.server_address).send(
+                BuClientRequestBatch(tuple(self.growing_batch))
+            )
+            self.growing_batch.clear()
+
+
+class BuServer(Actor):
+    def __init__(self, address, transport, logger,
+                 config: BatchedUnreplicatedConfig,
+                 state_machine: StateMachine, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self._current_proxy = 0
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BuClientRequestBatch):
+            self.logger.fatal(f"unknown server message {msg!r}")
+        replies = tuple(
+            BuClientReply(
+                command_id=c.command_id,
+                result=self.state_machine.run(c.command),
+            )
+            for c in msg.commands
+        )
+        # Round-robin over proxy servers (the compartmentalized fan-out).
+        proxy = self.config.proxy_server_addresses[self._current_proxy]
+        self._current_proxy = (
+            self._current_proxy + 1
+        ) % len(self.config.proxy_server_addresses)
+        self.chan(proxy).send(BuClientReplyBatch(replies))
+
+
+class BuProxyServer(Actor):
+    def __init__(self, address, transport, logger,
+                 config: BatchedUnreplicatedConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self._clients: Dict[bytes, Address] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BuClientReplyBatch):
+            self.logger.fatal(f"unknown proxy server message {msg!r}")
+        for reply in msg.replies:
+            addr_bytes = reply.command_id.client_address
+            client = self._clients.get(addr_bytes)
+            if client is None:
+                client = self.transport.address_from_bytes(addr_bytes)
+                self._clients[addr_bytes] = client
+            self.chan(client).send(reply)
+
+
+@dataclasses.dataclass
+class _BuPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class BuClient(Actor):
+    def __init__(self, address, transport, logger,
+                 config: BatchedUnreplicatedConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _BuPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = BuClientRequest(
+            BuCommand(
+                command_id=BuCommandId(self.address_bytes, pseudonym, id),
+                command=command,
+            )
+        )
+        batcher = self.config.batcher_addresses[
+            self.rng.randrange(len(self.config.batcher_addresses))
+        ]
+        self.chan(batcher).send(request)
+
+        def resend() -> None:
+            target = self.config.batcher_addresses[
+                self.rng.randrange(len(self.config.batcher_addresses))
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendBu[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _BuPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BuClientReply):
+            self.logger.fatal(f"unknown client message {msg!r}")
+        pseudonym = msg.command_id.client_pseudonym
+        pending = self.pending.get(pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[pseudonym]
+        pending.result.success(msg.result)

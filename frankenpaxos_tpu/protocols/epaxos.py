@@ -1,0 +1,921 @@
+"""EPaxos — leaderless SMR with dependency tracking (reference ``epaxos/``:
+an all-in-one Replica actor plus a thin Client).
+
+Every replica leads its own instances (replica_index, instance_number).
+PreAccept computes dependencies from a conflict index; a fast quorum
+(n-1 = 2f of n = 2f+1) agreeing on identical (seq, deps) commits on the
+fast path (Replica.scala handlePreAcceptOk); otherwise the slow path runs
+Paxos-Accept with f+1. Committed instances enter a dependency graph and
+execute as eligible SCCs in deterministic order. Recovery: a recover timer
+on a blocking instance runs Prepare in a higher ballot
+(Replica.scala:1121-1560) — on a quorum of PrepareOks the new leader
+adopts an Accepted triple if any, else a triple pre-accepted by f
+non-leader replicas in the default ballot, else restarts PreAccept
+(avoiding the fast path), else pre-accepts a noop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.clienttable import ClientTable, Executed, NotExecuted
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import popular_items, random_duration
+
+# Instances are (replica_index, instance_number) tuples; ballots are
+# (ordering, replica_index) tuples ordered lexicographically; NULL_BALLOT
+# sorts below every real ballot. Dependencies travel as sorted tuples of
+# instances and are handled as frozensets internally.
+NULL_BALLOT = (-1, -1)
+
+NOT_SEEN, PRE_ACCEPTED, ACCEPTED, COMMITTED = range(4)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpCommand:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpClientRequest:
+    command: EpCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpPreAccept:
+    instance: tuple
+    ballot: tuple
+    command: Optional[EpCommand]  # None = noop
+    sequence_number: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpPreAcceptOk:
+    instance: tuple
+    ballot: tuple
+    replica_index: int
+    sequence_number: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpAccept:
+    instance: tuple
+    ballot: tuple
+    command: Optional[EpCommand]
+    sequence_number: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpAcceptOk:
+    instance: tuple
+    ballot: tuple
+    replica_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpCommit:
+    instance: tuple
+    command: Optional[EpCommand]
+    sequence_number: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpPrepare:
+    instance: tuple
+    ballot: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpPrepareOk:
+    ballot: tuple
+    instance: tuple
+    replica_index: int
+    vote_ballot: tuple
+    status: int
+    command: Optional[EpCommand]
+    sequence_number: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpNack:
+    instance: tuple
+    largest_ballot: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class EPaxosConfig:
+    f: int
+    replica_addresses: tuple
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def fast_quorum_size(self) -> int:
+        return self.n - 1
+
+    @property
+    def slow_quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.replica_addresses) != self.n:
+            raise ValueError(f"need exactly {self.n} replicas")
+
+
+@dataclasses.dataclass(frozen=True)
+class EPaxosReplicaOptions:
+    resend_pre_accepts_period: float = 5.0
+    default_to_slow_path_period: float = 5.0
+    resend_accepts_period: float = 5.0
+    resend_prepares_period: float = 5.0
+    recover_instance_min_period: float = 5.0
+    recover_instance_max_period: float = 10.0
+    execute_graph_batch_size: int = 1
+    execute_graph_timer_period: float = 1.0  # flushes partial batches
+    unsafe_skip_graph_execution: bool = False
+
+
+@dataclasses.dataclass
+class _Triple:
+    command: Optional[EpCommand]
+    sequence_number: int
+    dependencies: FrozenSet[tuple]
+
+
+@dataclasses.dataclass
+class _NoCommandEntry:
+    ballot: tuple
+
+
+@dataclasses.dataclass
+class _PreAcceptedEntry:
+    ballot: tuple
+    vote_ballot: tuple
+    triple: _Triple
+
+
+@dataclasses.dataclass
+class _AcceptedEntry:
+    ballot: tuple
+    vote_ballot: tuple
+    triple: _Triple
+
+
+@dataclasses.dataclass
+class _CommittedEntry:
+    triple: _Triple
+
+
+@dataclasses.dataclass
+class _PreAccepting:
+    ballot: tuple
+    command: Optional[EpCommand]
+    responses: Dict[int, EpPreAcceptOk]
+    avoid_fast_path: bool
+    resend_timer: object
+    slow_path_timer: Optional[object]
+
+
+@dataclasses.dataclass
+class _Accepting:
+    ballot: tuple
+    triple: _Triple
+    responses: Dict[int, EpAcceptOk]
+    resend_timer: object
+
+
+@dataclasses.dataclass
+class _Preparing:
+    ballot: tuple
+    responses: Dict[int, EpPrepareOk]
+    resend_timer: object
+
+
+class EpReplica(Actor):
+    def __init__(self, address, transport, logger, config: EPaxosConfig,
+                 state_machine: StateMachine,
+                 options: EPaxosReplicaOptions = EPaxosReplicaOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.replica_addresses.index(address)
+        self.other_addresses = [
+            a for a in config.replica_addresses if a != address
+        ]
+        self.cmd_log: Dict[tuple, object] = {}
+        self.next_available_instance = 0
+        self.default_ballot = (0, self.index)
+        self.largest_ballot = (0, self.index)
+        self.dependency_graph = TarjanDependencyGraph()
+        self.client_table: ClientTable = ClientTable()
+        self.conflict_index = state_machine.conflict_index()
+        self.leader_states: Dict[tuple, object] = {}
+        self.recover_timers: Dict[tuple, object] = {}
+        self._pending_committed = 0
+        # With batched graph execution, a flush timer guarantees a tail of
+        # commits below the batch size still executes (the analog of the
+        # reference's executeGraphTimerPeriod timer).
+        if (
+            options.execute_graph_batch_size > 1
+            and not options.unsafe_skip_graph_execution
+        ):
+            def flush() -> None:
+                self._execute_graph()
+                self._pending_committed = 0
+                self.execute_graph_timer.start()
+
+            self.execute_graph_timer = self.timer(
+                "executeGraphTimer", options.execute_graph_timer_period, flush
+            )
+            self.execute_graph_timer.start()
+        else:
+            self.execute_graph_timer = None
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _leader_ballot(self, state) -> tuple:
+        return state.ballot
+
+    def _thrifty_others(self, n: int) -> List[Address]:
+        return [
+            self.other_addresses[i]
+            for i in self.rng.sample(range(len(self.other_addresses)), n)
+        ]
+
+    def _compute_seq_deps(
+        self, instance: tuple, command: Optional[EpCommand]
+    ) -> Tuple[int, FrozenSet[tuple]]:
+        """Dependencies = conflicting instances from the conflict index
+        (Replica.scala computeSequenceNumberAndDependencies — note the
+        reference also returns sequence number 0: Tarjan's deterministic
+        in-component order makes seq numbers unnecessary)."""
+        if command is None:
+            return 0, frozenset()
+        deps = set(self.conflict_index.get_conflicts(command.command))
+        deps.discard(instance)
+        return 0, frozenset(deps)
+
+    def _update_conflict_index(self, instance, command) -> None:
+        if command is not None:
+            self.conflict_index.put(instance, command.command)
+
+    def _stop_timers(self, instance) -> None:
+        state = self.leader_states.get(instance)
+        if isinstance(state, _PreAccepting):
+            state.resend_timer.stop()
+            if state.slow_path_timer is not None:
+                state.slow_path_timer.stop()
+        elif isinstance(state, (_Accepting, _Preparing)):
+            state.resend_timer.stop()
+
+    def _make_resend_timer(self, name, period, send_once):
+        def fire() -> None:
+            send_once()
+            timer.start()
+
+        timer = self.timer(name, period, fire)
+        timer.start()
+        return timer
+
+    def _check_ballot_le(self, instance, ballot) -> None:
+        entry = self.cmd_log.get(instance)
+        if isinstance(entry, _CommittedEntry):
+            self.logger.fatal(f"instance {instance} is already committed")
+        if isinstance(entry, _NoCommandEntry):
+            self.logger.check_le(entry.ballot, ballot)
+        elif isinstance(entry, (_PreAcceptedEntry, _AcceptedEntry)):
+            self.logger.check_le(entry.ballot, ballot)
+            self.logger.check_le(entry.vote_ballot, ballot)
+
+    # -- Phase transitions ---------------------------------------------------
+
+    def _transition_to_pre_accept(
+        self, instance, ballot, command, avoid_fast_path: bool
+    ) -> None:
+        seq, deps = self._compute_seq_deps(instance, command)
+        self._check_ballot_le(instance, ballot)
+        self.cmd_log[instance] = _PreAcceptedEntry(
+            ballot=ballot, vote_ballot=ballot,
+            triple=_Triple(command, seq, deps),
+        )
+        self._update_conflict_index(instance, command)
+        pre_accept = EpPreAccept(
+            instance=instance, ballot=ballot, command=command,
+            sequence_number=seq, dependencies=tuple(sorted(deps)),
+        )
+        for a in self._thrifty_others(self.config.fast_quorum_size - 1):
+            self.chan(a).send(pre_accept)
+        self._stop_timers(instance)
+        self.leader_states[instance] = _PreAccepting(
+            ballot=ballot,
+            command=command,
+            responses={
+                self.index: EpPreAcceptOk(
+                    instance=instance, ballot=ballot,
+                    replica_index=self.index, sequence_number=seq,
+                    dependencies=tuple(sorted(deps)),
+                )
+            },
+            avoid_fast_path=avoid_fast_path,
+            resend_timer=self._make_resend_timer(
+                f"resendPreAccepts{instance}",
+                self.options.resend_pre_accepts_period,
+                lambda: [self.chan(a).send(pre_accept) for a in self.other_addresses],
+            ),
+            slow_path_timer=None,
+        )
+
+    def _transition_to_accept(self, instance, ballot, triple: _Triple) -> None:
+        self._check_ballot_le(instance, ballot)
+        self.cmd_log[instance] = _AcceptedEntry(
+            ballot=ballot, vote_ballot=ballot, triple=triple
+        )
+        self._update_conflict_index(instance, triple.command)
+        accept = EpAccept(
+            instance=instance, ballot=ballot, command=triple.command,
+            sequence_number=triple.sequence_number,
+            dependencies=tuple(sorted(triple.dependencies)),
+        )
+        for a in self._thrifty_others(self.config.slow_quorum_size - 1):
+            self.chan(a).send(accept)
+        self._stop_timers(instance)
+        self.leader_states[instance] = _Accepting(
+            ballot=ballot,
+            triple=triple,
+            responses={
+                self.index: EpAcceptOk(
+                    instance=instance, ballot=ballot, replica_index=self.index
+                )
+            },
+            resend_timer=self._make_resend_timer(
+                f"resendAccepts{instance}",
+                self.options.resend_accepts_period,
+                lambda: [self.chan(a).send(accept) for a in self.other_addresses],
+            ),
+        )
+
+    def _transition_to_prepare(self, instance) -> None:
+        self._stop_timers(instance)
+        self.largest_ballot = (self.largest_ballot[0] + 1, self.index)
+        ballot = self.largest_ballot
+        prepare = EpPrepare(instance=instance, ballot=ballot)
+        targets = self._thrifty_others(self.config.slow_quorum_size - 1)
+        for a in targets:
+            self.chan(a).send(prepare)
+        self.chan(self.address).send(prepare)  # include self
+        self.leader_states[instance] = _Preparing(
+            ballot=ballot,
+            responses={},
+            resend_timer=self._make_resend_timer(
+                f"resendPrepares{instance}",
+                self.options.resend_prepares_period,
+                lambda: [
+                    self.chan(a).send(prepare)
+                    for a in self.config.replica_addresses
+                ],
+            ),
+        )
+
+    def _pre_accepting_slow_path(self, instance, state: _PreAccepting) -> None:
+        seq = max(ok.sequence_number for ok in state.responses.values())
+        deps = frozenset(
+            d for ok in state.responses.values() for d in ok.dependencies
+        )
+        self._transition_to_accept(
+            instance, state.ballot, _Triple(state.command, seq, deps)
+        )
+
+    def _commit(self, instance, triple: _Triple, inform_others: bool) -> None:
+        self._stop_timers(instance)
+        self.cmd_log[instance] = _CommittedEntry(triple)
+        self._update_conflict_index(instance, triple.command)
+        self.leader_states.pop(instance, None)
+        if inform_others:
+            commit = EpCommit(
+                instance=instance, command=triple.command,
+                sequence_number=triple.sequence_number,
+                dependencies=tuple(sorted(triple.dependencies)),
+            )
+            for a in self.other_addresses:
+                self.chan(a).send(commit)
+        timer = self.recover_timers.pop(instance, None)
+        if timer is not None:
+            timer.stop()
+        if self.options.unsafe_skip_graph_execution:
+            self._execute_command(instance, triple.command)
+            return
+        self.dependency_graph.commit(
+            instance, triple.sequence_number, set(triple.dependencies)
+        )
+        self._pending_committed += 1
+        if self._pending_committed % self.options.execute_graph_batch_size == 0:
+            self._execute_graph()
+            self._pending_committed = 0
+            if self.execute_graph_timer is not None:
+                self.execute_graph_timer.reset()
+
+    def _execute_graph(self) -> None:
+        executables, blockers = self.dependency_graph.execute()
+        for instance in blockers:
+            if instance not in self.recover_timers:
+                self.recover_timers[instance] = self._make_recover_timer(instance)
+        for instance in executables:
+            entry = self.cmd_log.get(instance)
+            if not isinstance(entry, _CommittedEntry):
+                self.logger.fatal(
+                    f"instance {instance} executable but not committed"
+                )
+            self._execute_command(instance, entry.triple.command)
+
+    def _make_recover_timer(self, instance):
+        def fire() -> None:
+            self._transition_to_prepare(instance)
+            timer.start()
+
+        timer = self.timer(
+            f"recoverInstance{instance}",
+            random_duration(
+                self.rng,
+                self.options.recover_instance_min_period,
+                self.options.recover_instance_max_period,
+            ),
+            fire,
+        )
+        timer.start()
+        return timer
+
+    def _execute_command(self, instance, command: Optional[EpCommand]) -> None:
+        if command is None:
+            return  # noop
+        identity = (command.client_address, command.client_pseudonym)
+        result = self.client_table.executed(identity, command.client_id)
+        if isinstance(result, Executed):
+            return
+        output = self.state_machine.run(command.command)
+        self.client_table.execute(identity, command.client_id, output)
+        # Only the instance's home replica replies (Replica.scala:738-744).
+        if self.index == instance[0]:
+            client = self.transport.address_from_bytes(command.client_address)
+            self.chan(client).send(
+                EpClientReply(
+                    client_pseudonym=command.client_pseudonym,
+                    client_id=command.client_id,
+                    result=output,
+                )
+            )
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, EpClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, EpPreAccept):
+            self._handle_pre_accept(src, msg)
+        elif isinstance(msg, EpPreAcceptOk):
+            self._handle_pre_accept_ok(msg)
+        elif isinstance(msg, EpAccept):
+            self._handle_accept(src, msg)
+        elif isinstance(msg, EpAcceptOk):
+            self._handle_accept_ok(msg)
+        elif isinstance(msg, EpCommit):
+            self._handle_commit(msg)
+        elif isinstance(msg, EpNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, EpPrepare):
+            self._handle_prepare(src, msg)
+        elif isinstance(msg, EpPrepareOk):
+            self._handle_prepare_ok(msg)
+        else:
+            self.logger.fatal(f"unknown epaxos message {msg!r}")
+
+    def _handle_client_request(self, src: Address, msg: EpClientRequest) -> None:
+        command = msg.command
+        identity = (command.client_address, command.client_pseudonym)
+        result = self.client_table.executed(identity, command.client_id)
+        if isinstance(result, Executed):
+            if result.output is not None:
+                client = self.transport.address_from_bytes(command.client_address)
+                self.chan(client).send(
+                    EpClientReply(
+                        client_pseudonym=command.client_pseudonym,
+                        client_id=command.client_id,
+                        result=result.output,
+                    )
+                )
+            return
+        instance = (self.index, self.next_available_instance)
+        self.next_available_instance += 1
+        self._transition_to_pre_accept(
+            instance, self.default_ballot, command, avoid_fast_path=False
+        )
+
+    def _handle_pre_accept(self, src: Address, msg: EpPreAccept) -> None:
+        entry = self.cmd_log.get(msg.instance)
+        nack = EpNack(instance=msg.instance, largest_ballot=self.largest_ballot)
+        if isinstance(entry, _NoCommandEntry):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+        elif isinstance(entry, _PreAcceptedEntry):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+            if msg.ballot == entry.vote_ballot:
+                self.chan(src).send(
+                    EpPreAcceptOk(
+                        instance=msg.instance, ballot=msg.ballot,
+                        replica_index=self.index,
+                        sequence_number=entry.triple.sequence_number,
+                        dependencies=tuple(sorted(entry.triple.dependencies)),
+                    )
+                )
+                return
+        elif isinstance(entry, _AcceptedEntry):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+            if msg.ballot == entry.vote_ballot:
+                return
+        elif isinstance(entry, _CommittedEntry):
+            self.chan(src).send(
+                EpCommit(
+                    instance=msg.instance, command=entry.triple.command,
+                    sequence_number=entry.triple.sequence_number,
+                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                )
+            )
+            return
+
+        state = self.leader_states.get(msg.instance)
+        if state is not None and msg.ballot > self._leader_ballot(state):
+            self._stop_timers(msg.instance)
+            del self.leader_states[msg.instance]
+        self.largest_ballot = max(self.largest_ballot, msg.ballot)
+        timer = self.recover_timers.get(msg.instance)
+        if timer is not None:
+            timer.reset()
+
+        seq, deps = self._compute_seq_deps(msg.instance, msg.command)
+        seq = max(seq, msg.sequence_number)
+        deps = frozenset(deps | set(msg.dependencies))
+        self.cmd_log[msg.instance] = _PreAcceptedEntry(
+            ballot=msg.ballot, vote_ballot=msg.ballot,
+            triple=_Triple(msg.command, seq, deps),
+        )
+        self._update_conflict_index(msg.instance, msg.command)
+        self.chan(src).send(
+            EpPreAcceptOk(
+                instance=msg.instance, ballot=msg.ballot,
+                replica_index=self.index, sequence_number=seq,
+                dependencies=tuple(sorted(deps)),
+            )
+        )
+
+    def _handle_pre_accept_ok(self, msg: EpPreAcceptOk) -> None:
+        state = self.leader_states.get(msg.instance)
+        if not isinstance(state, _PreAccepting):
+            return
+        if msg.ballot != state.ballot:
+            self.logger.check_lt(msg.ballot, state.ballot)
+            return
+        old_n = len(state.responses)
+        state.responses[msg.replica_index] = msg
+        new_n = len(state.responses)
+        if new_n < self.config.slow_quorum_size:
+            return
+        if (
+            not state.avoid_fast_path
+            and old_n < self.config.slow_quorum_size <= new_n
+            and self.config.slow_quorum_size < self.config.fast_quorum_size
+        ):
+            # A slow quorum formed; wait a beat for the fast quorum.
+            state.slow_path_timer = self.timer(
+                f"defaultToSlowPath{msg.instance}",
+                self.options.default_to_slow_path_period,
+                lambda: self._pre_accepting_slow_path(msg.instance, state),
+            )
+            state.slow_path_timer.start()
+            return
+        if state.avoid_fast_path and new_n >= self.config.slow_quorum_size:
+            self._pre_accepting_slow_path(msg.instance, state)
+            return
+        if new_n >= self.config.fast_quorum_size:
+            seq_deps = [
+                (ok.sequence_number, ok.dependencies)
+                for i, ok in state.responses.items()
+                if i != self.index
+            ]
+            candidates = popular_items(
+                seq_deps, self.config.fast_quorum_size - 1
+            )
+            if candidates:
+                self.logger.check_eq(len(candidates), 1)
+                seq, deps = next(iter(candidates))
+                self._commit(
+                    msg.instance,
+                    _Triple(state.command, seq, frozenset(deps)),
+                    inform_others=True,
+                )
+            else:
+                self._pre_accepting_slow_path(msg.instance, state)
+
+    def _handle_accept(self, src: Address, msg: EpAccept) -> None:
+        entry = self.cmd_log.get(msg.instance)
+        nack = EpNack(instance=msg.instance, largest_ballot=self.largest_ballot)
+        if isinstance(entry, (_NoCommandEntry, _PreAcceptedEntry)):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+        elif isinstance(entry, _AcceptedEntry):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+            if msg.ballot == entry.vote_ballot:
+                self.chan(src).send(
+                    EpAcceptOk(
+                        instance=msg.instance, ballot=msg.ballot,
+                        replica_index=self.index,
+                    )
+                )
+                return
+        elif isinstance(entry, _CommittedEntry):
+            self.chan(src).send(
+                EpCommit(
+                    instance=msg.instance, command=entry.triple.command,
+                    sequence_number=entry.triple.sequence_number,
+                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                )
+            )
+            return
+        state = self.leader_states.get(msg.instance)
+        if state is not None and msg.ballot > self._leader_ballot(state):
+            self._stop_timers(msg.instance)
+            del self.leader_states[msg.instance]
+        self.largest_ballot = max(self.largest_ballot, msg.ballot)
+        timer = self.recover_timers.get(msg.instance)
+        if timer is not None:
+            timer.reset()
+        self.cmd_log[msg.instance] = _AcceptedEntry(
+            ballot=msg.ballot, vote_ballot=msg.ballot,
+            triple=_Triple(
+                msg.command, msg.sequence_number, frozenset(msg.dependencies)
+            ),
+        )
+        self._update_conflict_index(msg.instance, msg.command)
+        self.chan(src).send(
+            EpAcceptOk(
+                instance=msg.instance, ballot=msg.ballot,
+                replica_index=self.index,
+            )
+        )
+
+    def _handle_accept_ok(self, msg: EpAcceptOk) -> None:
+        state = self.leader_states.get(msg.instance)
+        if not isinstance(state, _Accepting):
+            return
+        if msg.ballot != state.ballot:
+            self.logger.check_lt(msg.ballot, state.ballot)
+            return
+        state.responses[msg.replica_index] = msg
+        if len(state.responses) < self.config.slow_quorum_size:
+            return
+        self._commit(msg.instance, state.triple, inform_others=True)
+
+    def _handle_commit(self, msg: EpCommit) -> None:
+        if isinstance(self.cmd_log.get(msg.instance), _CommittedEntry):
+            return
+        self._commit(
+            msg.instance,
+            _Triple(msg.command, msg.sequence_number, frozenset(msg.dependencies)),
+            inform_others=False,
+        )
+
+    def _handle_nack(self, msg: EpNack) -> None:
+        self.largest_ballot = max(self.largest_ballot, msg.largest_ballot)
+        state = self.leader_states.get(msg.instance)
+        if state is None or state.ballot >= msg.largest_ballot:
+            return
+        timer = self.recover_timers.get(msg.instance)
+        if timer is not None:
+            timer.reset()
+        else:
+            self.recover_timers[msg.instance] = self._make_recover_timer(
+                msg.instance
+            )
+
+    def _handle_prepare(self, src: Address, msg: EpPrepare) -> None:
+        self.largest_ballot = max(self.largest_ballot, msg.ballot)
+        timer = self.recover_timers.get(msg.instance)
+        if timer is not None:
+            timer.reset()
+        state = self.leader_states.get(msg.instance)
+        if (
+            state is not None
+            and msg.ballot > self._leader_ballot(state)
+            and src != self.address
+        ):
+            self._stop_timers(msg.instance)
+            del self.leader_states[msg.instance]
+        entry = self.cmd_log.get(msg.instance)
+        nack = EpNack(instance=msg.instance, largest_ballot=self.largest_ballot)
+        if entry is None or isinstance(entry, _NoCommandEntry):
+            if entry is not None and msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+            self.chan(src).send(
+                EpPrepareOk(
+                    ballot=msg.ballot, instance=msg.instance,
+                    replica_index=self.index, vote_ballot=NULL_BALLOT,
+                    status=NOT_SEEN, command=None, sequence_number=0,
+                    dependencies=(),
+                )
+            )
+            self.cmd_log[msg.instance] = _NoCommandEntry(msg.ballot)
+        elif isinstance(entry, (_PreAcceptedEntry, _AcceptedEntry)):
+            if msg.ballot < entry.ballot:
+                self.chan(src).send(nack)
+                return
+            status = (
+                PRE_ACCEPTED if isinstance(entry, _PreAcceptedEntry) else ACCEPTED
+            )
+            self.chan(src).send(
+                EpPrepareOk(
+                    ballot=msg.ballot, instance=msg.instance,
+                    replica_index=self.index, vote_ballot=entry.vote_ballot,
+                    status=status, command=entry.triple.command,
+                    sequence_number=entry.triple.sequence_number,
+                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                )
+            )
+            entry.ballot = msg.ballot
+        elif isinstance(entry, _CommittedEntry):
+            self.chan(src).send(
+                EpCommit(
+                    instance=msg.instance, command=entry.triple.command,
+                    sequence_number=entry.triple.sequence_number,
+                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                )
+            )
+
+    def _handle_prepare_ok(self, msg: EpPrepareOk) -> None:
+        state = self.leader_states.get(msg.instance)
+        if not isinstance(state, _Preparing):
+            return
+        if msg.ballot != state.ballot:
+            self.logger.check_lt(msg.ballot, state.ballot)
+            return
+        state.responses[msg.replica_index] = msg
+        if len(state.responses) < self.config.slow_quorum_size:
+            return
+        max_vote = max(ok.vote_ballot for ok in state.responses.values())
+        top = [
+            ok for ok in state.responses.values() if ok.vote_ballot == max_vote
+        ]
+        accepted = next((ok for ok in top if ok.status == ACCEPTED), None)
+        if accepted is not None:
+            self._transition_to_accept(
+                msg.instance, state.ballot,
+                _Triple(
+                    accepted.command, accepted.sequence_number,
+                    frozenset(accepted.dependencies),
+                ),
+            )
+            return
+        # Triples pre-accepted in the instance leader's DEFAULT ballot by f
+        # replicas other than the recovering leader bind the value
+        # (Replica.scala:1496-1520).
+        default = (0, msg.instance[0])
+        candidates = popular_items(
+            [
+                (ok.command, ok.sequence_number, ok.dependencies)
+                for ok in top
+                if ok.status == PRE_ACCEPTED
+                and ok.vote_ballot == default
+                and ok.replica_index != self.index
+            ],
+            self.config.f,
+        )
+        if candidates:
+            self.logger.check_eq(len(candidates), 1)
+            command, seq, deps = next(iter(candidates))
+            self._transition_to_accept(
+                msg.instance, state.ballot,
+                _Triple(command, seq, frozenset(deps)),
+            )
+            return
+        pre_accepted = next(
+            (ok for ok in top if ok.status == PRE_ACCEPTED), None
+        )
+        if pre_accepted is not None:
+            self._transition_to_pre_accept(
+                msg.instance, state.ballot, pre_accepted.command,
+                avoid_fast_path=True,
+            )
+        else:
+            self._transition_to_pre_accept(
+                msg.instance, state.ballot, None, avoid_fast_path=True
+            )
+
+
+@dataclasses.dataclass
+class _EpPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class EpClient(Actor):
+    def __init__(self, address, transport, logger, config: EPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _EpPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = EpClientRequest(
+            EpCommand(
+                client_address=self.address_bytes,
+                client_pseudonym=pseudonym,
+                client_id=id,
+                command=command,
+            )
+        )
+        replica = self.config.replica_addresses[
+            self.rng.randrange(len(self.config.replica_addresses))
+        ]
+        self.chan(replica).send(request)
+
+        def resend() -> None:
+            target = self.config.replica_addresses[
+                self.rng.randrange(len(self.config.replica_addresses))
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"resendEp[{pseudonym};{id}]", self.resend_period, resend
+        )
+        timer.start()
+        self.pending[pseudonym] = _EpPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, EpClientReply):
+            self.logger.fatal(f"unknown epaxos client message {msg!r}")
+        pending = self.pending.get(msg.client_pseudonym)
+        if pending is None or msg.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[msg.client_pseudonym]
+        pending.result.success(msg.result)

@@ -219,11 +219,6 @@ class FpLeader(Actor):
                 if b.vote_round == 0
             ]
             popular = popular_items(votes, self.config.quorum_majority_size)
-            popular = {
-                x
-                for x in popular
-                if votes.count(x) >= self.config.quorum_majority_size
-            }
             if popular:
                 self.logger.check_eq(len(popular), 1)
                 v = next(iter(popular))

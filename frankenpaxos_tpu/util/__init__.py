@@ -216,14 +216,9 @@ def histogram(xs: Iterable[T]) -> Dict[T, int]:
 
 
 def popular_items(xs: Iterable[T], n: int) -> Set[T]:
-    """The items with the n largest counts (ties included at the cutoff's
-    count, as in Util.popularItems)."""
-    h = histogram(xs)
-    if not h:
-        return set()
-    counts = sorted(h.values(), reverse=True)
-    cutoff = counts[min(n, len(counts)) - 1] if n >= 1 else float("inf")
-    return {x for x, c in h.items() if c >= cutoff}
+    """The elements appearing n or more times (Util.popularItems:
+    popularItems(Seq('a','a','a','b','b','c'), 2) == Set('a','b'))."""
+    return {x for x, c in histogram(xs).items() if c >= n}
 
 
 def random_duration(rng: _random.Random, min_s: float, max_s: float) -> float:

@@ -189,7 +189,8 @@ def test_top_k():
 
 def test_util_helpers():
     assert histogram("abca") == {"a": 2, "b": 1, "c": 1}
-    assert popular_items("aabbc", 1) == {"a", "b"}  # ties included
+    assert popular_items("aaabbc", 2) == {"a", "b"}  # count >= n
+    assert popular_items("aabbc", 1) == {"a", "b", "c"}
     assert popular_items([], 2) == set()
     assert merge_maps_with({"a": 1}, {"a": 2, "b": 3}, lambda x, y: x + y) == {
         "a": 3,

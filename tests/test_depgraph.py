@@ -1,0 +1,187 @@
+"""Dependency graph tests (the analog of depgraph/DependencyGraphTest.scala
++ TarjanDependencyGraphTest cases)."""
+
+import pytest
+
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph, from_name
+
+
+def make():
+    return TarjanDependencyGraph()
+
+
+def test_empty_graph():
+    g = make()
+    assert g.execute() == ([], set())
+    assert g.num_vertices == 0
+
+
+def test_single_vertex_no_deps():
+    g = make()
+    g.commit("a", 0, set())
+    assert g.execute() == (["a"], set())
+    # Never returned twice.
+    assert g.execute() == ([], set())
+
+
+def test_chain_executes_in_dependency_order():
+    g = make()
+    g.commit("a", 0, set())
+    g.commit("b", 1, {"a"})
+    g.commit("c", 2, {"b"})
+    executed, blockers = g.execute()
+    assert executed.index("a") < executed.index("b") < executed.index("c")
+    assert blockers == set()
+
+
+def test_missing_dependency_blocks():
+    g = make()
+    g.commit("b", 1, {"a"})
+    executed, blockers = g.execute()
+    assert executed == []
+    assert blockers == {"a"}
+    # Committing the dependency unblocks.
+    g.commit("a", 0, set())
+    executed, blockers = g.execute()
+    assert executed == ["a", "b"]
+    assert blockers == set()
+
+
+def test_transitive_missing_dependency_blocks():
+    g = make()
+    g.commit("c", 2, {"b"})
+    g.commit("b", 1, {"a"})
+    executed, blockers = g.execute()
+    assert executed == []
+    assert blockers == {"a"}
+
+
+def test_cycle_executes_as_component_in_seq_order():
+    g = make()
+    g.commit("b", 5, {"a"})
+    g.commit("a", 9, {"b"})
+    components, blockers = g.execute_by_component()
+    assert blockers == set()
+    assert components == [["b", "a"]]  # sorted by (seq, key): (5,b) < (9,a)
+
+
+def test_cycle_with_equal_seq_sorts_by_key():
+    g = make()
+    g.commit("b", 1, {"a"})
+    g.commit("a", 1, {"b"})
+    components, _ = g.execute_by_component()
+    assert components == [["a", "b"]]
+
+
+def test_cycle_blocked_by_external_dep():
+    g = make()
+    g.commit("a", 0, {"b", "x"})
+    g.commit("b", 1, {"a"})
+    executed, blockers = g.execute()
+    assert executed == []
+    assert blockers == {"x"}
+    g.commit("x", 2, set())
+    executed, blockers = g.execute()
+    assert set(executed) == {"a", "b", "x"}
+    assert executed.index("x") < executed.index("a")
+
+
+def test_components_in_reverse_topological_order():
+    g = make()
+    g.commit("a", 0, set())
+    g.commit("b", 1, {"a"})
+    g.commit("c", 2, {"b"})
+    g.commit("d", 3, {"c", "a"})
+    components, _ = g.execute_by_component()
+    flat = [k for comp in components for k in comp]
+    assert flat.index("a") < flat.index("b") < flat.index("c") < flat.index("d")
+
+
+def test_two_cycles_chain():
+    # {a,b} <- {c,d}: the ab component must execute before the cd one.
+    g = make()
+    g.commit("a", 0, {"b"})
+    g.commit("b", 1, {"a"})
+    g.commit("c", 2, {"d", "a"})
+    g.commit("d", 3, {"c"})
+    components, blockers = g.execute_by_component()
+    assert blockers == set()
+    assert components == [["a", "b"], ["c", "d"]]
+
+
+def test_self_loop():
+    g = make()
+    g.commit("a", 0, {"a"})
+    assert g.execute() == (["a"], set())
+
+
+def test_update_executed_skips_and_unblocks():
+    g = make()
+    g.update_executed({"a"})
+    g.commit("b", 1, {"a"})
+    assert g.execute() == (["b"], set())
+    # Committing an executed key is ignored.
+    g.commit("a", 0, set())
+    assert g.num_vertices == 0
+    assert g.execute() == ([], set())
+
+
+def test_num_blockers_early_return():
+    g = make()
+    for i in range(10):
+        g.commit(f"v{i}", i, {f"missing{i}"})
+    executed, blockers = g.execute(num_blockers=1)
+    assert executed == []
+    assert len(blockers) >= 1  # stopped early rather than scanning all
+
+
+def test_deep_chain_no_recursion_limit():
+    g = make()
+    n = 50_000
+    g.commit(0, 0, set())
+    for i in range(1, n):
+        g.commit(i, i, {i - 1})
+    executed, blockers = g.execute()
+    assert len(executed) == n
+    assert blockers == set()
+    assert executed == sorted(executed)
+
+
+def test_interleaved_commit_execute():
+    g = make()
+    g.commit("a", 0, set())
+    assert g.execute() == (["a"], set())
+    g.commit("b", 1, {"a"})  # a already executed
+    assert g.execute() == (["b"], set())
+    g.commit("d", 3, {"c"})
+    assert g.execute() == ([], {"c"})
+    g.commit("c", 2, {"b", "a"})
+    executed, blockers = g.execute()
+    assert executed == ["c", "d"]
+
+
+def test_registry():
+    assert isinstance(from_name("Tarjan"), TarjanDependencyGraph)
+    with pytest.raises(ValueError):
+        from_name("Jgrapht")
+
+
+def test_abandoned_stack_does_not_leak_executions():
+    """Regression: a vertex closed under an ineligible root (via a cycle
+    whose ineligibility it can't see) must NOT be treated as executed by a
+    later root in the same pass."""
+    g = make()
+    g.commit(0, 0, {1})
+    g.commit(1, 1, {2, 4})  # 4 is uncommitted
+    g.commit(2, 2, {0})
+    g.commit(3, 3, {2})
+    executed, blockers = g.execute()
+    assert executed == [], f"executed {executed} despite uncommitted blocker"
+    assert blockers == {4}
+    # Committing 4 releases everything in one consistent order.
+    g.commit(4, 4, set())
+    executed, blockers = g.execute()
+    assert set(executed) == {0, 1, 2, 3, 4}
+    assert blockers == set()
+    assert executed.index(4) < executed.index(1)
+    assert executed.index(2) > executed.index(1) or executed.index(2) > 0

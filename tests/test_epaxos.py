@@ -1,0 +1,275 @@
+"""EPaxos sim tests (the analog of shared/src/test/scala/epaxos): replicas
+may execute non-conflicting commands in different orders, but conflicting
+commands must execute in the same relative order everywhere."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import epaxos as ep
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+
+class RecordingKv(KeyValueStore):
+    """KeyValueStore that records executed commands for invariants."""
+
+    def __init__(self):
+        super().__init__()
+        self.executed_commands = []
+
+    def run(self, input: bytes) -> bytes:
+        self.executed_commands.append(input)
+        return super().run(input)
+
+
+def make(f=1, num_clients=2, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    config = ep.EPaxosConfig(
+        f=f,
+        replica_addresses=tuple(
+            SimAddress(f"replica{i}") for i in range(2 * f + 1)
+        ),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    replicas = [
+        ep.EpReplica(a, t, log(), config, RecordingKv(), seed=seed + i)
+        for i, a in enumerate(config.replica_addresses)
+    ]
+    clients = [
+        ep.EpClient(SimAddress(f"client{i}"), t, log(), config, seed=seed + 20 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, replicas, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_epaxos_single_command():
+    t, config, replicas, clients = make()
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    drain(t)
+    assert p.done
+    # Every replica executed it (commit broadcast + graph execution).
+    for r in replicas:
+        assert r.state_machine.get() == {"x": "1"}
+
+
+def test_epaxos_fast_path_uncontended():
+    """An uncontended command commits without any Accept messages."""
+    t, config, replicas, clients = make()
+    clients[0].propose(0, kv_set(("x", "1")))
+    accepts_seen = []
+    while t.messages:
+        m = t.messages[0]
+        decoded = wire.decode(m.data)
+        if isinstance(decoded, ep.EpAccept):
+            accepts_seen.append(decoded)
+        t.deliver_message(m)
+    assert accepts_seen == []
+
+
+def test_epaxos_sequential_conflicting_commands():
+    t, config, replicas, clients = make()
+    for i in range(5):
+        p = clients[0].propose(0, kv_set(("x", f"{i}")))
+        drain(t)
+        assert p.done
+    for r in replicas:
+        assert r.state_machine.get() == {"x": "4"}
+
+
+def test_epaxos_concurrent_conflicting_commands_converge():
+    t, config, replicas, clients = make(seed=5)
+    p1 = clients[0].propose(0, kv_set(("x", "a")))
+    p2 = clients[1].propose(0, kv_set(("x", "b")))
+    rng = random.Random(3)
+    for _ in range(4000):
+        cmd = t.generate_command(rng)
+        if cmd is None:
+            break
+        t.run_command(cmd, record=False)
+    drain(t)
+    assert p1.done and p2.done
+    finals = {tuple(sorted(r.state_machine.get().items())) for r in replicas}
+    assert len(finals) == 1, f"replicas diverged: {finals}"
+
+
+def _conflicting_order_violation(replicas, conflicts):
+    """Check every pair of replicas executed conflicting commands in the
+    same relative order; returns an explanation or None."""
+    logs = [r.state_machine.executed_commands for r in replicas]
+    for i in range(len(logs)):
+        for j in range(i + 1, len(logs)):
+            a, b = logs[i], logs[j]
+            both = [c for c in a if c in b]
+            pos_b = {}
+            for idx, c in enumerate(b):
+                pos_b.setdefault(c, idx)
+            for x_idx in range(len(both)):
+                for y_idx in range(x_idx + 1, len(both)):
+                    x, y = both[x_idx], both[y_idx]
+                    if not conflicts(x, y):
+                        continue
+                    if pos_b[x] > pos_b[y]:
+                        return (
+                            f"replicas {i} and {j} executed conflicting "
+                            f"commands in different orders: {x!r} vs {y!r}"
+                        )
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    key: str
+    value: str
+
+
+class SimulatedEPaxos(SimulatedSystem):
+    def __init__(self, f=1):
+        self.f = f
+        self._kv = KeyValueStore()
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        t, config, replicas, clients = system
+        return tuple(
+            tuple(r.state_machine.executed_commands) for r in replicas
+        )
+
+    def generate_command(self, system, rng):
+        t, config, replicas, clients = system
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"k{rng.randrange(2)}",
+                                    f"v{rng.randrange(50)}"))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t, config, replicas, clients = system
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(
+                command.pseudonym, kv_set((command.key, command.value))
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        class _Fake:
+            executed_commands: list
+
+        fakes = []
+        for log in state:
+            fake = _Fake()
+            sm = _Fake()
+            sm.executed_commands = list(log)
+            fake.state_machine = sm
+            fakes.append(fake)
+        return _conflicting_order_violation(fakes, self._kv.conflicts)
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_epaxos_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedEPaxos(f), run_length=120, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_epaxos_recovery_after_leader_failure():
+    """A replica pre-accepts then its leader dies; the recover timer on a
+    blocking instance runs Prepare and the instance eventually commits."""
+    t, config, replicas, clients = make(seed=9)
+    # Client proposes to replica 0.
+    class _R0:
+        def randrange(self, n):
+            return 0
+
+    clients[0].rng = _R0()
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    # Deliver the request and the PreAccepts, but DROP all PreAcceptOks and
+    # kill replica 0 (the instance leader).
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), ep.EpPreAcceptOk):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    t.partition_actor(config.replica_addresses[0])
+    # A second, conflicting command from another client commits and depends
+    # on the stuck instance, making it a blocker.
+    class _R1:
+        def randrange(self, n):
+            return 1
+
+    clients[1].rng = _R1()
+    p2 = clients[1].propose(0, kv_set(("x", "2")))
+    drain(t)
+    # Let time pass: fire every running timer on the surviving replicas
+    # (PreAccept resends reach the live replica; the dep graph blocks on
+    # replica 0's instance; recover timers then run Prepare).
+    recover_fired = 0
+    alive = {r.address for r in replicas[1:]}
+    for _ in range(8):
+        for timer in list(t.running_timers()):
+            if timer.address in alive:
+                if timer.name().startswith("recoverInstance"):
+                    recover_fired += 1
+                t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert recover_fired > 0, "no recover timer ever armed"
+    assert p2.done, "recovery did not unblock the dependent command"
+    # Replicas 1 and 2 agree.
+    finals = {
+        tuple(sorted(r.state_machine.get().items())) for r in replicas[1:]
+    }
+    assert len(finals) == 1
+
+
+def test_execute_graph_flush_timer():
+    """Regression: with execute_graph_batch_size > 1, a single commit (a
+    partial batch) must still execute via the flush timer."""
+    t, config, replicas, clients = make()
+    # Rebuild replicas with batching enabled.
+    for r in replicas:
+        del t.actors[r.address]
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    replicas = [
+        ep.EpReplica(
+            a, t, log(), config, RecordingKv(),
+            ep.EPaxosReplicaOptions(execute_graph_batch_size=4),
+            seed=100 + i,
+        )
+        for i, a in enumerate(config.replica_addresses)
+    ]
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    drain(t)
+    assert not p.done  # committed but batched: not yet executed
+    for r in replicas:
+        t.trigger_timer(r.address, "executeGraphTimer")
+    drain(t)
+    assert p.done
+    for r in replicas:
+        assert r.state_machine.get() == {"x": "1"}
