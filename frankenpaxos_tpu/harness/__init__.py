@@ -1,0 +1,34 @@
+"""Benchmark orchestration harness (the analog of the reference's
+``benchmarks/`` Python package, SURVEY.md §2.6)."""
+
+from frankenpaxos_tpu.harness.benchmark import (
+    BenchmarkDirectory,
+    Reaped,
+    Suite,
+    SuiteDirectory,
+)
+from frankenpaxos_tpu.harness.cluster import Cluster
+from frankenpaxos_tpu.harness.proc import PopenProc, Proc, SshProc
+from frankenpaxos_tpu.harness.workload import (
+    BernoulliSingleKeyWorkload,
+    ReadWriteWorkload,
+    StringWorkload,
+    UniformSingleKeyWorkload,
+    workload_from_dict,
+)
+
+__all__ = [
+    "BenchmarkDirectory",
+    "BernoulliSingleKeyWorkload",
+    "Cluster",
+    "PopenProc",
+    "Proc",
+    "ReadWriteWorkload",
+    "Reaped",
+    "SshProc",
+    "StringWorkload",
+    "Suite",
+    "SuiteDirectory",
+    "UniformSingleKeyWorkload",
+    "workload_from_dict",
+]

@@ -1,0 +1,223 @@
+"""The Suite runner (the analog of ``benchmarks/benchmark.py``):
+
+  * :class:`SuiteDirectory` — a timestamped directory per suite run;
+  * :class:`BenchmarkDirectory` — one numbered subdirectory per input,
+    holding ``input.json``, per-process cmd/stdout/stderr/returncode
+    captures, and arbitrary benchmark files;
+  * ``results.csv`` — appended incrementally, one flattened row per
+    benchmark, so partial suites still leave usable data;
+  * :class:`Reaped` — a context manager guaranteeing child processes are
+    killed even when a benchmark raises (benchmark.py:49-67);
+  * :class:`Suite` — subclass with ``inputs()``/``run_benchmark()`` and
+    call ``run_suite()``.
+
+Latency/throughput summarization of client recorder CSVs mirrors
+benchmark.py:310-455: percentiles of request latency and a windowed
+throughput series.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import datetime
+import json
+import os
+import statistics
+from typing import Any, Dict, Generic, List, Optional, Sequence, TypeVar
+
+from frankenpaxos_tpu.harness.proc import PopenProc, Proc
+
+Input = TypeVar("Input")
+Output = TypeVar("Output")
+
+
+def flatten(value: Any, prefix: str = "") -> Dict[str, Any]:
+    """Flatten dataclasses/dicts into dotted csv columns
+    (benchmark.py:267-279)."""
+    out: Dict[str, Any] = {}
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        value = dataclasses.asdict(value)
+    if isinstance(value, dict):
+        for k, v in value.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, key))
+        return out
+    out[prefix or "value"] = value
+    return out
+
+
+class Reaped:
+    """Kill every registered proc on exit, exception or not."""
+
+    def __init__(self) -> None:
+        self.procs: List[Proc] = []
+
+    def register(self, proc: Proc) -> Proc:
+        self.procs.append(proc)
+        return proc
+
+    def __enter__(self) -> "Reaped":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for proc in self.procs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
+
+class BenchmarkDirectory:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.reaped = Reaped()
+        self._proc_count = 0
+
+    def abspath(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    def write_string(self, name: str, contents: str) -> str:
+        path = self.abspath(name)
+        with open(path, "w") as f:
+            f.write(contents)
+        return path
+
+    def write_json(self, name: str, value: Any) -> str:
+        if dataclasses.is_dataclass(value) and not isinstance(value, type):
+            value = dataclasses.asdict(value)
+        return self.write_string(name, json.dumps(value, indent=2, default=str))
+
+    def popen(
+        self, label: str, args: Sequence[str], env: Optional[Dict[str, str]] = None
+    ) -> PopenProc:
+        """Launch a labeled local process capturing cmd/stdout/stderr
+        (benchmark.py:183-206)."""
+        self._proc_count += 1
+        label = f"{self._proc_count:03}_{label}"
+        self.write_string(f"{label}_cmd.txt", " ".join(args))
+        proc = PopenProc(
+            args,
+            stdout=self.abspath(f"{label}_stdout.txt"),
+            stderr=self.abspath(f"{label}_stderr.txt"),
+            env=env,
+        )
+        self.reaped.register(proc)
+        return proc
+
+    def __enter__(self) -> "BenchmarkDirectory":
+        self.reaped.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.reaped.__exit__(*exc)
+
+
+class SuiteDirectory:
+    def __init__(self, root: str, name: str):
+        ts = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+        self.path = os.path.join(root, f"{ts}_{name}")
+        os.makedirs(self.path, exist_ok=True)
+        self._benchmark_count = 0
+
+    def write_json(self, name: str, value: Any) -> str:
+        path = os.path.join(self.path, name)
+        with open(path, "w") as f:
+            json.dump(value, f, indent=2, default=str)
+        return path
+
+    def benchmark_directory(self) -> BenchmarkDirectory:
+        self._benchmark_count += 1
+        return BenchmarkDirectory(
+            os.path.join(self.path, f"{self._benchmark_count:03}")
+        )
+
+
+class Suite(Generic[Input, Output]):
+    def args(self) -> Dict[str, Any]:
+        return {}
+
+    def inputs(self) -> List[Input]:
+        raise NotImplementedError
+
+    def summary(self, input: Input, output: Output) -> str:
+        return str(output)
+
+    def run_benchmark(
+        self, bench: BenchmarkDirectory, args: Dict[str, Any], input: Input
+    ) -> Output:
+        raise NotImplementedError
+
+    def run_suite(self, root: str, name: str) -> SuiteDirectory:
+        suite_dir = SuiteDirectory(root, name)
+        suite_dir.write_json("args.json", self.args())
+        results_path = os.path.join(suite_dir.path, "results.csv")
+        # The whole file is rewritten after every benchmark: partial suites
+        # still leave usable data, and rows with new columns (e.g. an
+        # optional 'error' field on a failed run) widen the schema instead
+        # of raising.
+        rows: List[Dict[str, Any]] = []
+        fieldnames: List[str] = []
+
+        def write_results() -> None:
+            with open(results_path, "w", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=fieldnames, restval="")
+                writer.writeheader()
+                writer.writerows(rows)
+
+        for input in self.inputs():
+            with suite_dir.benchmark_directory() as bench:
+                bench.write_json("input.json", input)
+                output = self.run_benchmark(bench, self.args(), input)
+                bench.write_json("output.json", output)
+                row = {
+                    **flatten(input, "input"),
+                    **flatten(output, "output"),
+                }
+                rows.append(row)
+                for key in row:
+                    if key not in fieldnames:
+                        fieldnames.append(key)
+                write_results()
+                print(f"[{bench.path}] {self.summary(input, output)}")
+        return suite_dir
+
+
+# -- Recorder-CSV summarization (benchmark.py:310-455) -----------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySummary:
+    count: int
+    mean_ms: float
+    median_ms: float
+    p90_ms: float
+    p99_ms: float
+    throughput_per_s: float
+
+
+def summarize_latency_throughput(
+    rows: List[Dict[str, float]]
+) -> Optional[LatencySummary]:
+    """rows: dicts with 'start' (seconds), 'latency_nanos'."""
+    if not rows:
+        return None
+    lat_ms = sorted(r["latency_nanos"] / 1e6 for r in rows)
+    starts = [r["start"] for r in rows]
+    duration = max(starts) - min(starts)
+
+    def pct(p: float) -> float:
+        # Nearest-rank percentile: ceil(p*n)-1, so p99 of 100 samples is
+        # rank 99 (index 98), not the maximum.
+        rank = max(1, -(-p * len(lat_ms) // 1))
+        return lat_ms[min(len(lat_ms) - 1, int(rank) - 1)]
+
+    return LatencySummary(
+        count=len(rows),
+        mean_ms=statistics.fmean(lat_ms),
+        median_ms=pct(0.5),
+        p90_ms=pct(0.9),
+        p99_ms=pct(0.99),
+        throughput_per_s=len(rows) / duration if duration > 0 else float("nan"),
+    )

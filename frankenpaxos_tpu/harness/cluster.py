@@ -1,0 +1,48 @@
+"""Cluster topology files (the analog of ``benchmarks/cluster.py``): a JSON
+file maps role -> str(f) -> list of host addresses; ``.f(n)`` selects the
+sub-cluster for a given fault tolerance."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+
+class Cluster:
+    def __init__(self, mapping: Dict[str, Dict[str, List[str]]]):
+        self._mapping = mapping
+
+    @staticmethod
+    def from_json_file(path: str) -> "Cluster":
+        with open(path) as f:
+            return Cluster(json.load(f))
+
+    @staticmethod
+    def from_json(data: Dict) -> "Cluster":
+        return Cluster(data)
+
+    def f(self, n: int) -> "SubCluster":
+        return SubCluster(
+            {
+                role: by_f[str(n)]
+                for role, by_f in self._mapping.items()
+                if str(n) in by_f
+            }
+        )
+
+    def roles(self) -> List[str]:
+        return sorted(self._mapping)
+
+
+class SubCluster:
+    def __init__(self, mapping: Dict[str, List[str]]):
+        self._mapping = mapping
+
+    def __getitem__(self, role: str) -> List[str]:
+        return self._mapping[role]
+
+    def get(self, role: str, default=None):
+        return self._mapping.get(role, default)
+
+    def roles(self) -> List[str]:
+        return sorted(self._mapping)

@@ -1,0 +1,126 @@
+"""Process management (the analog of ``benchmarks/proc.py``): a ``Proc``
+abstraction over local subprocesses and remote SSH processes, with
+guaranteed cleanup and captured output."""
+
+from __future__ import annotations
+
+import shlex
+import signal
+import subprocess
+from typing import IO, List, Optional, Sequence, Union
+
+
+class Proc:
+    def cmd(self) -> List[str]:
+        raise NotImplementedError
+
+    def pid(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+
+class PopenProc(Proc):
+    """A local subprocess (benchmarks/proc.py PopenProc)."""
+
+    def __init__(
+        self,
+        args: Sequence[str],
+        stdout: Union[str, IO, None] = None,
+        stderr: Union[str, IO, None] = None,
+        env: Optional[dict] = None,
+    ):
+        self._args = list(args)
+        self._files = []
+        if isinstance(stdout, str):
+            stdout = open(stdout, "w")
+            self._files.append(stdout)
+        if isinstance(stderr, str):
+            stderr = open(stderr, "w")
+            self._files.append(stderr)
+        self._popen = subprocess.Popen(
+            self._args, stdout=stdout, stderr=stderr, env=env
+        )
+
+    def cmd(self) -> List[str]:
+        return list(self._args)
+
+    def pid(self) -> Optional[int]:
+        return self._popen.pid
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        try:
+            return self._popen.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def kill(self) -> None:
+        if self._popen.poll() is None:
+            self._popen.send_signal(signal.SIGTERM)
+            try:
+                self._popen.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                self._popen.kill()
+        for f in self._files:
+            f.close()
+
+    def returncode(self) -> Optional[int]:
+        return self._popen.poll()
+
+
+class SshProc(Proc):
+    """A remote process over the system ssh binary (the analog of the
+    reference's ParamikoProc, including its nonce trick: the command is
+    tagged with a unique nonce environment variable so ``kill`` can
+    pkill exactly this process on the remote host even though ssh gives
+    us no remote pid; benchmarks/proc.py:88-110)."""
+
+    _nonce_counter = 0
+
+    def __init__(
+        self,
+        host: str,
+        args: Sequence[str],
+        stdout: Union[str, IO, None] = None,
+        stderr: Union[str, IO, None] = None,
+        ssh_args: Sequence[str] = (),
+    ):
+        SshProc._nonce_counter += 1
+        self.host = host
+        self.nonce = f"fptpu_nonce_{SshProc._nonce_counter}"
+        self._args = list(args)
+        # The nonce must appear in a REMOTE process's /proc cmdline for
+        # pkill -f to find it. `env NONCE=1 cmd` exec-replaces, losing the
+        # nonce, so instead run the command as a child of a nonce-tagged
+        # shell (the nonce lives in the shell's -c string).
+        remote = f"bash -c ': {self.nonce}; {shlex.join(args)}'"
+        self._proc = PopenProc(
+            ["ssh", *ssh_args, host, remote], stdout=stdout, stderr=stderr
+        )
+        self._ssh_args = list(ssh_args)
+
+    def cmd(self) -> List[str]:
+        return list(self._args)
+
+    def pid(self) -> Optional[int]:
+        return self._proc.pid()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        return self._proc.wait(timeout=timeout)
+
+    def kill(self) -> None:
+        # Kill the command (a child of the nonce-tagged shell), then the
+        # shell itself.
+        subprocess.run(
+            [
+                "ssh", *self._ssh_args, self.host,
+                f"pkill -TERM -P $(pgrep -f {self.nonce} | head -1); "
+                f"pkill -TERM -f {self.nonce}",
+            ],
+            check=False,
+        )
+        self._proc.kill()

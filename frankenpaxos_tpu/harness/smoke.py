@@ -1,0 +1,407 @@
+"""Per-protocol smoke runs (the analog of ``benchmarks/<proto>/smoke.py``
+x18 + ``scripts/benchmark_smoke.sh``):
+
+    python -m frankenpaxos_tpu.harness.smoke            # all
+    python -m frankenpaxos_tpu.harness.smoke multipaxos # one
+
+``multipaxos`` runs a REAL localhost deployment: every role is its own OS
+process launched through the role mains, a closed-loop client drives it,
+and the recorder CSV is summarized. The other protocols smoke in-process
+on the sim transport (their deployment mains land with their nets in a
+later round); ``tpu`` smokes the batched backend.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import random
+import sys
+import tempfile
+import time
+
+from frankenpaxos_tpu.harness.benchmark import (
+    BenchmarkDirectory,
+    summarize_latency_throughput,
+)
+
+
+def _base_port() -> int:
+    # Per-process port block so overlapping smoke runs don't collide on
+    # EADDRINUSE (each deployment uses offsets 0-50 within its block).
+    import os
+
+    return 20000 + (os.getpid() % 400) * 60
+
+
+def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
+    port = _base_port()
+
+    def hp(i):
+        return f"127.0.0.1:{port + i}"
+
+    config = {
+        "f": 1,
+        "batchers": [],
+        "read_batchers": [],
+        "leaders": [hp(0), hp(1)],
+        "leader_elections": [hp(2), hp(3)],
+        "proxy_leaders": [hp(4), hp(5)],
+        "acceptors": [[hp(6), hp(7), hp(8)], [hp(9), hp(10), hp(11)]],
+        "replicas": [hp(12), hp(13)],
+        "proxy_replicas": [],
+        "flexible": False,
+        "distribution_scheme": "hash",
+    }
+    config_path = bench.write_string("config.json", json.dumps(config, indent=2))
+
+    # Role processes don't touch accelerators; strip any env hooks that
+    # would import heavyweight ML stacks into every subprocess (14
+    # concurrent jax imports starve a small machine for >30s).
+    import os
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS",)
+    }
+
+    def role(label, *extra):
+        return bench.popen(label, [
+            sys.executable, "-m", "frankenpaxos_tpu.mains.multipaxos",
+            "--config", config_path, "--log_level", "error", *extra,
+        ], env=env)
+
+    # Dependency order: a leader runs phase 1 at startup, so its acceptors
+    # must already be listening (first-connection failures drop messages
+    # until the 5s phase-1 resend, which would eat the whole smoke window).
+    for g in range(2):
+        for i in range(3):
+            role(f"acceptor_{g}_{i}", "--role", "acceptor",
+                 "--group_index", str(g), "--index", str(i))
+    for i in range(2):
+        role(f"replica_{i}", "--role", "replica", "--index", str(i))
+    for i in range(2):
+        role(f"proxy_leader_{i}", "--role", "proxy_leader", "--index", str(i))
+    time.sleep(1.0)
+    for i in range(2):
+        role(f"leader_{i}", "--role", "leader", "--index", str(i))
+    time.sleep(1.5)  # client lag (the reference's client_lag)
+
+    recorder = bench.abspath("recorder.csv")
+    client = role(
+        "client", "--role", "client", "--listen", hp(50),
+        "--duration", str(duration), "--num_pseudonyms", "3",
+        "--workload", '{"type": "read_write", "read_fraction": 0.25}',
+        "--output", recorder,
+    )
+    code = client.wait(timeout=duration + 30)
+    assert code == 0, f"client exited with {code}"
+    with open(recorder) as f:
+        rows = [
+            {"start": float(r["start"]), "latency_nanos": float(r["latency_nanos"])}
+            for r in csv.DictReader(f)
+        ]
+    summary = summarize_latency_throughput(rows)
+    assert summary is not None and summary.count > 0, "no requests completed"
+    return {
+        "requests": summary.count,
+        "throughput_per_s": round(summary.throughput_per_s, 1),
+        "median_ms": round(summary.median_ms, 2),
+        "p99_ms": round(summary.p99_ms, 2),
+    }
+
+
+def _drain(t, max_steps=200000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+
+
+def _sim_smoke(build, operate) -> dict:
+    """Generic in-process smoke: construct a cluster, run the ops, count
+    completions."""
+    from frankenpaxos_tpu.core import FakeLogger, SimTransport
+    from frankenpaxos_tpu.core.logger import LogLevel
+
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    ctx = build(t)
+    promises = operate(t, ctx)
+    _drain(t)
+    for _ in range(6):
+        if all(p.done for p in promises):
+            break
+        for timer in list(t.running_timers()):
+            t.trigger_timer(timer.address, timer.name())
+        _drain(t)
+    done = sum(p.done for p in promises)
+    assert done == len(promises), f"only {done}/{len(promises)} completed"
+    return {"requests": len(promises)}
+
+
+def smoke_unreplicated(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import unreplicated as unrep
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        server = SimAddress("server")
+        unrep.Server(server, t, FakeLogger(LogLevel.FATAL), KeyValueStore())
+        return unrep.Client(
+            SimAddress("client"), t, FakeLogger(LogLevel.FATAL), server
+        )
+
+    def operate(t, client):
+        return [
+            client.propose(i, kv_set((f"k{i}", "v"))) for i in range(5)
+        ]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_batchedunreplicated(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import batchedunreplicated as bu
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = bu.BatchedUnreplicatedConfig(
+            batcher_addresses=(SimAddress("b0"), SimAddress("b1")),
+            server_address=SimAddress("server"),
+            proxy_server_addresses=(SimAddress("p0"),),
+        )
+        for a in config.batcher_addresses:
+            bu.BuBatcher(a, t, log(), config, bu.BuBatcherOptions(batch_size=2))
+        bu.BuServer(config.server_address, t, log(), config, KeyValueStore())
+        for a in config.proxy_server_addresses:
+            bu.BuProxyServer(a, t, log(), config)
+        return [
+            bu.BuClient(SimAddress(f"c{i}"), t, log(), config, seed=i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [
+            c.propose(p, kv_set((f"k{i}{p}", "v")))
+            for i, c in enumerate(clients)
+            for p in range(2)
+        ]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_paxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import paxos as px
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = px.PaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("leader0"), SimAddress("leader1")),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(3)
+            ),
+        )
+        for a in config.leader_addresses:
+            px.PaxosLeader(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            px.PaxosAcceptor(a, t, log(), config)
+        return px.PaxosClient(SimAddress("client"), t, log(), config)
+
+    def operate(t, client):
+        return [client.propose("smoke")]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_fastpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import fastpaxos as fp
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = fp.FastPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("leader0"), SimAddress("leader1")),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(3)
+            ),
+        )
+        for a in config.leader_addresses:
+            fp.FpLeader(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            fp.FpAcceptor(a, t, log(), config)
+        return fp.FpClient(SimAddress("client"), t, log(), config)
+
+    def operate(t, client):
+        return [client.propose("smoke")]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_caspaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import caspaxos as cas
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = cas.CasPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("leader0"), SimAddress("leader1")),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(3)
+            ),
+        )
+        for a in config.leader_addresses:
+            cas.CasLeader(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            cas.CasAcceptor(a, t, log(), config)
+        return cas.CasClient(SimAddress("client"), t, log(), config)
+
+    def operate(t, client):
+        return [client.propose({1, 2, 3})]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_craq(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import craq as cq
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = cq.CraqConfig(
+            f=1,
+            chain_node_addresses=tuple(
+                SimAddress(f"node{i}") for i in range(3)
+            ),
+        )
+        for i, a in enumerate(config.chain_node_addresses):
+            cq.ChainNode(a, t, log(), config, seed=i)
+        return cq.CraqClient(SimAddress("client"), t, log(), config)
+
+    def operate(t, client):
+        return [client.write(0, "x", "1"), client.read(1, "x")]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_epaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import epaxos as ep
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = ep.EPaxosConfig(
+            f=1,
+            replica_addresses=tuple(
+                SimAddress(f"replica{i}") for i in range(3)
+            ),
+        )
+        for i, a in enumerate(config.replica_addresses):
+            ep.EpReplica(a, t, log(), config, KeyValueStore(), seed=i)
+        return [
+            ep.EpClient(SimAddress(f"client{i}"), t, log(), config, seed=10 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [
+            c.propose(0, kv_set((f"k{i}", "v"))) for i, c in enumerate(clients)
+        ]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_echo(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
+
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    server = SimAddress("server")
+    EchoServer(server, t, FakeLogger(LogLevel.FATAL))
+    client = EchoClient(SimAddress("client"), t, FakeLogger(LogLevel.FATAL), server)
+    client.echo("smoke")
+    _drain(t)
+    assert client.num_messages_received == 1
+    return {"requests": 1}
+
+
+def smoke_tpu(bench=None) -> dict:
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        # No accelerator available (or its plugin can't initialize): the
+        # smoke only checks correctness, so fall back to CPU.
+        jax.config.update("jax_platforms", "cpu")
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, lat_min=1, lat_max=2
+    )
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(100)
+    stats = sim.stats()
+    assert stats["committed"] > 0
+    assert all(sim.check_invariants().values())
+    return {
+        "committed": stats["committed"],
+        "p50_latency_ticks": stats["commit_latency_p50_ticks"],
+    }
+
+
+SMOKES = {
+    "echo": smoke_echo,
+    "unreplicated": smoke_unreplicated,
+    "batchedunreplicated": smoke_batchedunreplicated,
+    "paxos": smoke_paxos,
+    "fastpaxos": smoke_fastpaxos,
+    "caspaxos": smoke_caspaxos,
+    "craq": smoke_craq,
+    "epaxos": smoke_epaxos,
+    "multipaxos": smoke_multipaxos,
+    "tpu": smoke_tpu,
+}
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(SMOKES)
+    unknown = [n for n in names if n not in SMOKES]
+    if unknown:
+        print(
+            f"unknown protocol(s) {', '.join(unknown)}; "
+            f"choose from: {', '.join(SMOKES)}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    failures = []
+    for name in names:
+        bench = BenchmarkDirectory(tempfile.mkdtemp(prefix=f"smoke_{name}_"))
+        try:
+            with bench:
+                result = SMOKES[name](bench)
+            print(f"smoke {name}: OK {result}")
+        except Exception as e:  # noqa: BLE001
+            failures.append(name)
+            print(f"smoke {name}: FAILED ({e!r}); logs in {bench.path}")
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Workload generators (the analog of ``jvm/.../Workload.scala`` and
+``benchmarks/workload.py``): each workload produces state-machine command
+bytes; parsed from JSON dicts the way the reference parses pbtxt."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import string
+from typing import Dict
+
+from frankenpaxos_tpu.statemachine import kv_get, kv_set
+
+
+@dataclasses.dataclass
+class StringWorkload:
+    """Random strings of a given size for AppendLog-style SMs."""
+
+    size_mean: int = 8
+    size_std: int = 0
+
+    def get(self, rng: random.Random) -> bytes:
+        n = max(1, int(rng.gauss(self.size_mean, self.size_std)))
+        return "".join(
+            rng.choice(string.ascii_lowercase) for _ in range(n)
+        ).encode()
+
+    def to_dict(self) -> Dict:
+        return {
+            "type": "string",
+            "size_mean": self.size_mean,
+            "size_std": self.size_std,
+        }
+
+
+@dataclasses.dataclass
+class UniformSingleKeyWorkload:
+    """KV sets over a uniform choice of num_keys keys."""
+
+    num_keys: int = 100
+    size_mean: int = 8
+
+    def get(self, rng: random.Random) -> bytes:
+        key = f"k{rng.randrange(self.num_keys)}"
+        value = "".join(
+            rng.choice(string.ascii_lowercase) for _ in range(self.size_mean)
+        )
+        return kv_set((key, value))
+
+    def to_dict(self) -> Dict:
+        return {
+            "type": "uniform_single_key",
+            "num_keys": self.num_keys,
+            "size_mean": self.size_mean,
+        }
+
+
+@dataclasses.dataclass
+class BernoulliSingleKeyWorkload:
+    """With probability conflict_rate touch a single hot key, else a fresh
+    key (the reference's conflict-rate knob for EPaxos-style protocols)."""
+
+    conflict_rate: float = 0.1
+    size_mean: int = 8
+
+    def __post_init__(self):
+        self._fresh = 0
+
+    def get(self, rng: random.Random) -> bytes:
+        if rng.random() < self.conflict_rate:
+            key = "hot"
+        else:
+            self._fresh += 1
+            key = f"fresh{self._fresh}"
+        return kv_set((key, "x" * self.size_mean))
+
+    def to_dict(self) -> Dict:
+        return {
+            "type": "bernoulli_single_key",
+            "conflict_rate": self.conflict_rate,
+            "size_mean": self.size_mean,
+        }
+
+
+@dataclasses.dataclass
+class ReadWriteWorkload:
+    """Mixed reads/writes with a fixed read fraction over num_keys keys
+    (the analog of multipaxos/ReadWriteWorkload.scala)."""
+
+    read_fraction: float = 0.5
+    num_keys: int = 100
+    size_mean: int = 8
+
+    def get(self, rng: random.Random) -> bytes:
+        key = f"k{rng.randrange(self.num_keys)}"
+        if rng.random() < self.read_fraction:
+            return kv_get(key)
+        return kv_set((key, "x" * self.size_mean))
+
+    def is_read(self, command: bytes) -> bool:
+        from frankenpaxos_tpu.core import wire
+        from frankenpaxos_tpu.statemachine import KVGetRequest
+
+        return isinstance(wire.decode(command), KVGetRequest)
+
+    def to_dict(self) -> Dict:
+        return {
+            "type": "read_write",
+            "read_fraction": self.read_fraction,
+            "num_keys": self.num_keys,
+            "size_mean": self.size_mean,
+        }
+
+
+def workload_from_dict(data: Dict):
+    kind = data.get("type")
+    data = {k: v for k, v in data.items() if k != "type"}
+    if kind == "string":
+        return StringWorkload(**data)
+    if kind == "uniform_single_key":
+        return UniformSingleKeyWorkload(**data)
+    if kind == "bernoulli_single_key":
+        return BernoulliSingleKeyWorkload(**data)
+    if kind == "read_write":
+        return ReadWriteWorkload(**data)
+    raise ValueError(f"unknown workload type {kind!r}")

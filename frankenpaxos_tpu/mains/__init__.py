@@ -1,0 +1,5 @@
+"""Deployment entry points (the analog of the reference's
+``jvm/src/main/scala/frankenpaxos/<proto>/<Role>Main.scala`` layer):
+per-role CLI mains over the real TCP transport, JSON cluster configs (the
+pbtxt analog), Prometheus metrics exporters, and closed-loop benchmark
+clients writing recorder CSVs (the BenchmarkUtil analog)."""

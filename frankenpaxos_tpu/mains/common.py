@@ -1,0 +1,47 @@
+"""Shared plumbing for role mains: address parsing, logging flags, and the
+Prometheus exporter (the analog of jvm ConfigUtil/PrometheusUtil/Flags)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List
+
+from frankenpaxos_tpu.core import HostPort, PrintLogger
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.monitoring import PrometheusCollectors
+
+
+def host_port(s: str) -> HostPort:
+    host, port = s.rsplit(":", 1)
+    return HostPort(host, int(port))
+
+
+def host_ports(items) -> tuple:
+    return tuple(host_port(x) for x in items)
+
+
+def add_common_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--log_level", default="warn",
+                        choices=["debug", "info", "warn", "error", "fatal"])
+    parser.add_argument(
+        "--prometheus_port", type=int, default=-1,
+        help="metrics exporter port; -1 disables (PrometheusUtil.scala)",
+    )
+    parser.add_argument("--prometheus_host", default="0.0.0.0")
+
+
+def make_logger(args) -> PrintLogger:
+    return PrintLogger(LogLevel[args.log_level.upper()])
+
+
+def make_collectors(args) -> PrometheusCollectors:
+    collectors = PrometheusCollectors()
+    if args.prometheus_port != -1:
+        collectors.start_http_server(args.prometheus_port, args.prometheus_host)
+    return collectors
+
+
+def load_config_json(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

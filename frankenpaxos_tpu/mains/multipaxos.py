@@ -1,0 +1,204 @@
+"""MultiPaxos role mains (the analog of
+``jvm/.../multipaxos/<Role>Main.scala``):
+
+    python -m frankenpaxos_tpu.mains.multipaxos \\
+        --role replica --index 0 --config cluster.json \\
+        --state_machine KeyValueStore
+
+The config JSON (the pbtxt analog) looks like::
+
+    {"f": 1,
+     "batchers": [], "read_batchers": [],
+     "leaders": ["127.0.0.1:10000", ...],
+     "leader_elections": ["127.0.0.1:10010", ...],
+     "proxy_leaders": [...],
+     "acceptors": [["127.0.0.1:10030", ...], [...]],
+     "replicas": [...], "proxy_replicas": [...],
+     "flexible": false, "distribution_scheme": "hash"}
+
+The client role runs closed-loop benchmark clients (BenchmarkUtil.scala
+runFor/timed): each pseudonym keeps one outstanding request; every
+completion appends ``start,stop,latency_nanos,label`` to the recorder CSV.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+
+from frankenpaxos_tpu.core.tcp_transport import TcpTransport
+from frankenpaxos_tpu.harness.workload import (
+    ReadWriteWorkload,
+    workload_from_dict,
+)
+from frankenpaxos_tpu.mains.common import (
+    add_common_args,
+    host_port,
+    host_ports,
+    load_config_json,
+    make_collectors,
+    make_logger,
+)
+from frankenpaxos_tpu.protocols import multipaxos as mp
+from frankenpaxos_tpu.statemachine import from_name as sm_from_name
+
+
+def load_config(path: str) -> mp.Config:
+    data = load_config_json(path)
+    return mp.Config(
+        f=data["f"],
+        batcher_addresses=host_ports(data.get("batchers", [])),
+        read_batcher_addresses=host_ports(data.get("read_batchers", [])),
+        leader_addresses=host_ports(data["leaders"]),
+        leader_election_addresses=host_ports(data["leader_elections"]),
+        proxy_leader_addresses=host_ports(data["proxy_leaders"]),
+        acceptor_addresses=tuple(
+            host_ports(group) for group in data["acceptors"]
+        ),
+        replica_addresses=host_ports(data["replicas"]),
+        proxy_replica_addresses=host_ports(data.get("proxy_replicas", [])),
+        flexible=data.get("flexible", False),
+        distribution_scheme=mp.DistributionScheme(
+            data.get("distribution_scheme", "hash")
+        ),
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="multipaxos")
+    parser.add_argument("--role", required=True, choices=[
+        "batcher", "read_batcher", "leader", "proxy_leader", "acceptor",
+        "replica", "proxy_replica", "client",
+    ])
+    parser.add_argument("--index", type=int, default=0)
+    parser.add_argument("--group_index", type=int, default=0,
+                        help="acceptor group (acceptor role only)")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--state_machine", default="KeyValueStore")
+    parser.add_argument("--seed", type=int, default=0)
+    # Options overrides (the --options.<x> analog).
+    parser.add_argument("--batch_size", type=int, default=10)
+    parser.add_argument("--noop_flush_period", type=float, default=0.1)
+    # Client-role flags (ClientMain.scala:24-79).
+    parser.add_argument("--listen", help="client listen address host:port")
+    parser.add_argument("--duration", type=float, default=5.0)
+    parser.add_argument("--warmup", type=float, default=0.5)
+    parser.add_argument("--num_pseudonyms", type=int, default=1)
+    parser.add_argument("--workload", default='{"type": "read_write", "read_fraction": 0.0}')
+    parser.add_argument("--read_consistency", default="linearizable",
+                        choices=["linearizable", "sequential", "eventual"])
+    parser.add_argument("--resend_period", type=float, default=1.0,
+                        help="client request resend period (seconds)")
+    parser.add_argument("--output", default="recorder.csv")
+    add_common_args(parser)
+    args = parser.parse_args()
+
+    config = load_config(args.config)
+    logger = make_logger(args)
+    collectors = make_collectors(args)
+    transport = TcpTransport(logger)
+
+    if args.role == "client":
+        run_client(args, config, logger, transport)
+        return
+
+    if args.role == "batcher":
+        mp.Batcher(config.batcher_addresses[args.index], transport, logger,
+                   config, mp.BatcherOptions(batch_size=args.batch_size),
+                   collectors=collectors, seed=args.seed)
+    elif args.role == "read_batcher":
+        mp.ReadBatcher(config.read_batcher_addresses[args.index], transport,
+                       logger, config, collectors=collectors, seed=args.seed)
+    elif args.role == "leader":
+        mp.Leader(config.leader_addresses[args.index], transport, logger,
+                  config,
+                  mp.LeaderOptions(noop_flush_period=args.noop_flush_period),
+                  collectors=collectors, seed=args.seed)
+    elif args.role == "proxy_leader":
+        mp.ProxyLeader(config.proxy_leader_addresses[args.index], transport,
+                       logger, config, collectors=collectors, seed=args.seed)
+    elif args.role == "acceptor":
+        mp.Acceptor(
+            config.acceptor_addresses[args.group_index][args.index],
+            transport, logger, config, collectors=collectors,
+        )
+    elif args.role == "replica":
+        mp.Replica(config.replica_addresses[args.index], transport, logger,
+                   sm_from_name(args.state_machine), config,
+                   collectors=collectors, seed=args.seed)
+    elif args.role == "proxy_replica":
+        mp.ProxyReplica(config.proxy_replica_addresses[args.index], transport,
+                        logger, config, collectors=collectors)
+    transport.run()
+
+
+def run_client(args, config, logger, transport) -> None:
+    """Closed-loop clients: BenchmarkUtil.runFor + LabeledRecorder."""
+    client = mp.Client(
+        host_port(args.listen), transport, logger, config,
+        mp.ClientOptions(
+            resend_client_request_period=args.resend_period,
+            resend_max_slot_requests_period=args.resend_period,
+            resend_read_request_period=args.resend_period,
+            resend_sequential_read_request_period=args.resend_period,
+            resend_eventual_read_request_period=args.resend_period,
+        ),
+        seed=args.seed,
+    )
+    workload = workload_from_dict(json.loads(args.workload))
+    rng = random.Random(args.seed)
+    out = open(args.output, "w")
+    out.write("start,stop,latency_nanos,label\n")
+    stop_at = None
+
+    def issue(pseudonym: int) -> None:
+        command = workload.get(rng)
+        is_read = (
+            isinstance(workload, ReadWriteWorkload)
+            and workload.is_read(command)
+        )
+        start = time.time()
+        if is_read:
+            method = {
+                "linearizable": client.read,
+                "sequential": client.sequential_read,
+                "eventual": client.eventual_read,
+            }[args.read_consistency]
+            label = args.read_consistency
+            promise = method(pseudonym, command)
+        else:
+            label = "write"
+            promise = client.write(pseudonym, command)
+
+        def done(p) -> None:
+            stop = time.time()
+            if p.exception is None and stop_at is not None and stop < stop_at:
+                if stop - start >= 0 and time.time() >= warmup_until:
+                    out.write(
+                        f"{start},{stop},{int((stop - start) * 1e9)},{label}\n"
+                    )
+                issue(pseudonym)
+
+        promise.on_complete(done)
+
+    def kick() -> None:
+        nonlocal stop_at, warmup_until
+        stop_at = time.time() + args.duration
+        warmup_until = time.time() + args.warmup
+        for pseudonym in range(args.num_pseudonyms):
+            issue(pseudonym)
+
+    warmup_until = 0.0
+    shutdown = transport.timer(
+        host_port(args.listen), "shutdown", args.duration + 1.0,
+        transport.shutdown,
+    )
+    shutdown.start()
+    transport.run(on_start=kick)
+    out.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Harness self-tests (the analog of benchmarks/cluster_test.py and
+proc_test.py)."""
+
+import csv
+import dataclasses
+import os
+import random
+import sys
+
+import pytest
+
+from frankenpaxos_tpu.harness import (
+    Cluster,
+    PopenProc,
+    Reaped,
+    Suite,
+    workload_from_dict,
+)
+from frankenpaxos_tpu.harness.benchmark import (
+    flatten,
+    summarize_latency_throughput,
+)
+from frankenpaxos_tpu.harness.workload import (
+    BernoulliSingleKeyWorkload,
+    ReadWriteWorkload,
+    StringWorkload,
+    UniformSingleKeyWorkload,
+)
+
+
+def test_cluster_json(tmp_path):
+    path = tmp_path / "cluster.json"
+    path.write_text(
+        '{"leaders": {"1": ["a", "b"], "2": ["a", "b", "c"]},'
+        ' "acceptors": {"1": ["x", "y", "z"]}}'
+    )
+    cluster = Cluster.from_json_file(str(path))
+    assert cluster.roles() == ["acceptors", "leaders"]
+    sub = cluster.f(1)
+    assert sub["leaders"] == ["a", "b"]
+    assert sub["acceptors"] == ["x", "y", "z"]
+    assert cluster.f(2)["leaders"] == ["a", "b", "c"]
+    assert cluster.f(2).get("acceptors") is None
+
+
+def test_popen_proc_capture(tmp_path):
+    out = tmp_path / "out.txt"
+    proc = PopenProc(
+        [sys.executable, "-c", "print('hello from proc')"], stdout=str(out)
+    )
+    assert proc.wait(timeout=30) == 0
+    proc.kill()
+    assert "hello from proc" in out.read_text()
+
+
+def test_reaped_kills_on_exception(tmp_path):
+    with pytest.raises(RuntimeError):
+        with Reaped() as reaped:
+            proc = reaped.register(
+                PopenProc([sys.executable, "-c", "import time; time.sleep(60)"])
+            )
+            raise RuntimeError("boom")
+    assert proc.wait(timeout=10) is not None  # killed, not still sleeping
+
+
+def test_flatten():
+    @dataclasses.dataclass
+    class Inner:
+        x: int
+
+    @dataclasses.dataclass
+    class Outer:
+        inner: Inner
+        name: str
+
+    assert flatten(Outer(Inner(3), "n"), "input") == {
+        "input.inner.x": 3,
+        "input.name": "n",
+    }
+    assert flatten(5, "v") == {"v": 5}
+
+
+def test_workloads_roundtrip_and_generate():
+    rng = random.Random(0)
+    for workload in [
+        StringWorkload(size_mean=6),
+        UniformSingleKeyWorkload(num_keys=3),
+        BernoulliSingleKeyWorkload(conflict_rate=0.5),
+        ReadWriteWorkload(read_fraction=0.5, num_keys=4),
+    ]:
+        again = workload_from_dict(workload.to_dict())
+        assert type(again) is type(workload)
+        for _ in range(10):
+            assert isinstance(workload.get(rng), bytes)
+    rw = ReadWriteWorkload(read_fraction=1.0)
+    assert rw.is_read(rw.get(rng))
+    rw0 = ReadWriteWorkload(read_fraction=0.0)
+    assert not rw0.is_read(rw0.get(rng))
+    with pytest.raises(ValueError):
+        workload_from_dict({"type": "nope"})
+
+
+def test_percentiles_nearest_rank():
+    rows = [
+        {"start": float(i), "latency_nanos": 2e6} for i in range(99)
+    ] + [{"start": 99.0, "latency_nanos": 5000e6}]
+    s = summarize_latency_throughput(rows)
+    assert s.p99_ms == 2.0  # rank 99 of 100, NOT the outlier max
+    assert s.p90_ms == 2.0
+
+
+def test_suite_widening_schema(tmp_path):
+    class WideningSuite(Suite):
+        def inputs(self):
+            return [1, 2]
+
+        def run_benchmark(self, bench, args, input):
+            return {"ok": 1} if input == 1 else {"ok": 0, "error": "boom"}
+
+    suite_dir = WideningSuite().run_suite(str(tmp_path), "widening")
+    with open(os.path.join(suite_dir.path, "results.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["output.error"] == ""
+    assert rows[1]["output.error"] == "boom"
+
+
+def test_summarize():
+    rows = [
+        {"start": float(i), "latency_nanos": (i + 1) * 1e6} for i in range(10)
+    ]
+    s = summarize_latency_throughput(rows)
+    assert s.count == 10
+    assert s.median_ms == 5.0  # nearest-rank: ceil(0.5*10)-1 = index 4
+    assert round(s.throughput_per_s, 2) == round(10 / 9.0, 2)
+    assert summarize_latency_throughput([]) is None
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleInput:
+    x: int
+
+
+class DoublingSuite(Suite):
+    def inputs(self):
+        return [DoubleInput(1), DoubleInput(2), DoubleInput(3)]
+
+    def run_benchmark(self, bench, args, input):
+        bench.write_string("scratch.txt", "hi")
+        return {"doubled": input.x * 2}
+
+
+def test_suite_run(tmp_path):
+    suite_dir = DoublingSuite().run_suite(str(tmp_path), "doubling")
+    assert os.path.exists(os.path.join(suite_dir.path, "args.json"))
+    with open(os.path.join(suite_dir.path, "results.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert [r["input.x"] for r in rows] == ["1", "2", "3"]
+    assert [r["output.doubled"] for r in rows] == ["2", "4", "6"]
+    for i in (1, 2, 3):
+        bench = os.path.join(suite_dir.path, f"{i:03}")
+        assert os.path.exists(os.path.join(bench, "input.json"))
+        assert os.path.exists(os.path.join(bench, "scratch.txt"))
+
+
+def test_in_process_smokes():
+    from frankenpaxos_tpu.harness import smoke
+
+    for name in [
+        "echo", "unreplicated", "batchedunreplicated", "paxos",
+        "fastpaxos", "caspaxos", "craq", "epaxos",
+    ]:
+        result = smoke.SMOKES[name](None)
+        assert result["requests"] > 0, name
