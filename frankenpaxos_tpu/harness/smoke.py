@@ -339,6 +339,68 @@ def smoke_echo(bench=None) -> dict:
     return {"requests": 1}
 
 
+def smoke_simplebpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import simplebpaxos as bpx
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = bpx.SimpleBPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("bpl0"), SimAddress("bpl1")),
+            proposer_addresses=(SimAddress("bpp0"), SimAddress("bpp1")),
+            dep_service_node_addresses=tuple(
+                SimAddress(f"bpd{i}") for i in range(3)
+            ),
+            acceptor_addresses=tuple(SimAddress(f"bpa{i}") for i in range(3)),
+            replica_addresses=(SimAddress("bpr0"), SimAddress("bpr1")),
+        )
+        for a in config.leader_addresses:
+            bpx.BpLeader(a, t, log(), config)
+        for a in config.proposer_addresses:
+            bpx.BpProposer(a, t, log(), config)
+        for a in config.dep_service_node_addresses:
+            bpx.BpDepServiceNode(a, t, log(), config, KeyValueStore())
+        for a in config.acceptor_addresses:
+            bpx.BpAcceptor(a, t, log(), config)
+        for a in config.replica_addresses:
+            bpx.BpReplica(a, t, log(), config, KeyValueStore())
+        return bpx.BpClient(SimAddress("bpc"), t, log(), config)
+
+    def operate(t, client):
+        return [client.propose(0, kv_set(("x", "1")))]
+
+    return _sim_smoke(build, operate)
+
+
+def smoke_vanillamencius(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import vanillamencius as vmn
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = vmn.VanillaMenciusConfig(
+            f=1,
+            server_addresses=tuple(SimAddress(f"vms{i}") for i in range(3)),
+            heartbeat_addresses=tuple(SimAddress(f"vmh{i}") for i in range(3)),
+        )
+        for i, a in enumerate(config.server_addresses):
+            vmn.VmServer(a, t, log(), config, ReadableAppendLog(), seed=i)
+        return [
+            vmn.VmClient(SimAddress(f"vmc{i}"), t, log(), config, seed=10 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [c.propose(0, f"cmd{i}".encode()) for i, c in enumerate(clients)]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_tpu(bench=None) -> dict:
     import jax
 
@@ -374,6 +436,8 @@ SMOKES = {
     "caspaxos": smoke_caspaxos,
     "craq": smoke_craq,
     "epaxos": smoke_epaxos,
+    "simplebpaxos": smoke_simplebpaxos,
+    "vanillamencius": smoke_vanillamencius,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,
 }

@@ -1,0 +1,637 @@
+"""Simple BPaxos — modular, disaggregated EPaxos (reference
+``simplebpaxos/``; NSDI '21 "Bipartisan Paxos"): the roles EPaxos fuses
+into one replica are separate actors.
+
+  * Leader: assigns a vertex id, collects dependencies from f+1 of 2f+1
+    DepServiceNodes, unions them, hands off to its co-located Proposer
+    (``simplebpaxos/Leader.scala``).
+  * DepServiceNode: conflict-index lookup per command, with a
+    per-vertex cache so retransmits get identical answers
+    (``DepServiceNode.scala:152-215``).
+  * Proposer: per-vertex Paxos over the acceptors. Round 0 belongs to the
+    vertex's own leader (RotatedClassicRoundRobin), so the first proposal
+    skips phase 1 (``Proposer.scala:155-195``). On Recover from a replica
+    it proposes a noop for the stuck vertex.
+  * Acceptor: per-vertex (round, voteRound, voteValue)
+    (``Acceptor.scala``).
+  * Replica: commits (command, deps) vertices into a dependency graph and
+    executes eligible components, with client table and recover timers
+    (``Replica.scala``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, FrozenSet, Optional
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.clienttable import ClientTable, Executed
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import random_duration
+
+# Vertex ids are (leader_index, id) tuples.
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpCommand:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpClientRequest:
+    command: BpCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpDependencyRequest:
+    vertex_id: tuple
+    command: BpCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpDependencyReply:
+    vertex_id: tuple
+    dep_service_node_index: int
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpPropose:
+    vertex_id: tuple
+    command: BpCommand
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpPhase1a:
+    vertex_id: tuple
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpPhase1b:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+    vote_round: int
+    vote_value: Optional[tuple]  # (command|None, dependencies)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpPhase2a:
+    vertex_id: tuple
+    round: int
+    vote_value: tuple  # (command|None, dependencies)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpPhase2b:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpCommit:
+    vertex_id: tuple
+    value: tuple  # (command|None, dependencies)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpNack:
+    vertex_id: tuple
+    higher_round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class BpRecover:
+    vertex_id: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleBPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    proposer_addresses: tuple
+    dep_service_node_addresses: tuple
+    acceptor_addresses: tuple
+    replica_addresses: tuple
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.proposer_addresses) != len(self.leader_addresses):
+            raise ValueError("one proposer per leader")
+        if len(self.dep_service_node_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 dep service nodes")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+class BpLeader(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig,
+                 resend_period: float = 5.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.index = config.leader_addresses.index(address)
+        self.proposer = config.proposer_addresses[self.index]
+        self.next_vertex_id = 0
+        # vertex -> dict of dep replies, or "proposed"
+        self.states: Dict[tuple, object] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, BpClientRequest):
+            self._handle_client_request(msg)
+        elif isinstance(msg, BpDependencyReply):
+            self._handle_dependency_reply(msg)
+        else:
+            self.logger.fatal(f"unknown bpaxos leader message {msg!r}")
+
+    def _handle_client_request(self, msg: BpClientRequest) -> None:
+        vertex_id = (self.index, self.next_vertex_id)
+        self.next_vertex_id += 1
+        request = BpDependencyRequest(vertex_id=vertex_id, command=msg.command)
+        nodes = self.config.dep_service_node_addresses
+        quorum = [
+            nodes[i]
+            for i in self.rng.sample(range(len(nodes)), self.config.quorum_size)
+        ]
+        for node in quorum:
+            self.chan(node).send(request)
+
+        def resend() -> None:
+            for node in self.config.dep_service_node_addresses:
+                self.chan(node).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"resendDeps{vertex_id}", self.resend_period, resend
+        )
+        timer.start()
+        self.states[vertex_id] = {"command": msg.command, "replies": {},
+                                  "timer": timer}
+
+    def _handle_dependency_reply(self, msg: BpDependencyReply) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, dict):
+            return
+        state["replies"][msg.dep_service_node_index] = msg
+        if len(state["replies"]) < self.config.quorum_size:
+            return
+        dependencies = frozenset(
+            d for reply in state["replies"].values() for d in reply.dependencies
+        )
+        state["timer"].stop()
+        self.chan(self.proposer).send(
+            BpPropose(
+                vertex_id=msg.vertex_id,
+                command=state["command"],
+                dependencies=tuple(sorted(dependencies)),
+            )
+        )
+        self.states[msg.vertex_id] = "proposed"
+
+
+class BpDepServiceNode(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig,
+                 state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.dep_service_node_addresses)
+        self.config = config
+        self.index = config.dep_service_node_addresses.index(address)
+        self.conflict_index = state_machine.conflict_index()
+        # Retransmitted requests must get IDENTICAL dependencies
+        # (DepServiceNode.scala dependenciesCache).
+        self.dependencies_cache: Dict[tuple, tuple] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BpDependencyRequest):
+            self.logger.fatal(f"unknown dep service message {msg!r}")
+        deps = self.dependencies_cache.get(msg.vertex_id)
+        if deps is None:
+            conflicts = set(self.conflict_index.get_conflicts(msg.command.command))
+            conflicts.discard(msg.vertex_id)
+            deps = tuple(sorted(conflicts))
+            self.conflict_index.put(msg.vertex_id, msg.command.command)
+            self.dependencies_cache[msg.vertex_id] = deps
+        self.chan(src).send(
+            BpDependencyReply(
+                vertex_id=msg.vertex_id,
+                dep_service_node_index=self.index,
+                dependencies=deps,
+            )
+        )
+
+
+@dataclasses.dataclass
+class _BpPhase1:
+    round: int
+    value: tuple
+    phase1bs: Dict[int, BpPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _BpPhase2:
+    round: int
+    value: tuple
+    phase2bs: Dict[int, BpPhase2b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _BpChosen:
+    value: tuple
+
+
+class BpProposer(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig,
+                 resend_period: float = 5.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.proposer_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.index = config.proposer_addresses.index(address)
+        self.states: Dict[tuple, object] = {}
+
+    def _round_system(self, vertex_id: tuple):
+        # Round 0 of a vertex belongs to the vertex's own leader
+        # (Proposer.scala roundSystem).
+        return RotatedClassicRoundRobin(
+            len(self.config.leader_addresses), vertex_id[0]
+        )
+
+    def _thrifty_acceptors(self, n: int):
+        acceptors = self.config.acceptor_addresses
+        return [
+            acceptors[i] for i in self.rng.sample(range(len(acceptors)), n)
+        ]
+
+    def _make_resend(self, name, msg):
+        def fire() -> None:
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(msg)
+            timer.start()
+
+        timer = self.timer(name, self.resend_period, fire)
+        timer.start()
+        return timer
+
+    def _propose_impl(self, vertex_id, command: Optional[BpCommand],
+                      dependencies: tuple) -> None:
+        if vertex_id in self.states:
+            return
+        value = (command, dependencies)
+        round = self._round_system(vertex_id).next_classic_round(self.index, -1)
+        if round == 0:
+            phase2a = BpPhase2a(vertex_id=vertex_id, round=0, vote_value=value)
+            for a in self._thrifty_acceptors(self.config.quorum_size):
+                self.chan(a).send(phase2a)
+            self.states[vertex_id] = _BpPhase2(
+                round=0, value=value, phase2bs={},
+                resend=self._make_resend(f"resendPhase2a{vertex_id}", phase2a),
+            )
+        else:
+            phase1a = BpPhase1a(vertex_id=vertex_id, round=round)
+            for a in self._thrifty_acceptors(self.config.quorum_size):
+                self.chan(a).send(phase1a)
+            self.states[vertex_id] = _BpPhase1(
+                round=round, value=value, phase1bs={},
+                resend=self._make_resend(f"resendPhase1a{vertex_id}", phase1a),
+            )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, BpPropose):
+            self._propose_impl(msg.vertex_id, msg.command, msg.dependencies)
+        elif isinstance(msg, BpPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, BpPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, BpNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, BpRecover):
+            self._handle_recover(msg)
+        else:
+            self.logger.fatal(f"unknown proposer message {msg!r}")
+
+    def _handle_phase1b(self, msg: BpPhase1b) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _BpPhase1):
+            return
+        if msg.round != state.round:
+            return
+        state.phase1bs[msg.acceptor_id] = msg
+        if len(state.phase1bs) < self.config.quorum_size:
+            return
+        max_vote = max(b.vote_round for b in state.phase1bs.values())
+        if max_vote == -1:
+            proposal = state.value
+        else:
+            proposal = next(
+                b.vote_value
+                for b in state.phase1bs.values()
+                if b.vote_round == max_vote
+            )
+        phase2a = BpPhase2a(
+            vertex_id=msg.vertex_id, round=state.round, vote_value=proposal
+        )
+        for a in self._thrifty_acceptors(self.config.quorum_size):
+            self.chan(a).send(phase2a)
+        state.resend.stop()
+        self.states[msg.vertex_id] = _BpPhase2(
+            round=state.round, value=proposal, phase2bs={},
+            resend=self._make_resend(f"resendPhase2a{msg.vertex_id}", phase2a),
+        )
+
+    def _handle_phase2b(self, msg: BpPhase2b) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _BpPhase2):
+            return
+        if msg.round != state.round:
+            return
+        state.phase2bs[msg.acceptor_id] = msg
+        if len(state.phase2bs) < self.config.quorum_size:
+            return
+        state.resend.stop()
+        self.states[msg.vertex_id] = _BpChosen(state.value)
+        commit = BpCommit(vertex_id=msg.vertex_id, value=state.value)
+        for replica in self.config.replica_addresses:
+            self.chan(replica).send(commit)
+
+    def _handle_nack(self, msg: BpNack) -> None:
+        state = self.states.get(msg.vertex_id)
+        if state is None or isinstance(state, _BpChosen):
+            return
+        if msg.higher_round <= state.round:
+            return
+        value = state.value
+        state.resend.stop()
+        round = self._round_system(msg.vertex_id).next_classic_round(
+            self.index, msg.higher_round
+        )
+        phase1a = BpPhase1a(vertex_id=msg.vertex_id, round=round)
+        for a in self._thrifty_acceptors(self.config.quorum_size):
+            self.chan(a).send(phase1a)
+        self.states[msg.vertex_id] = _BpPhase1(
+            round=round, value=value, phase1bs={},
+            resend=self._make_resend(f"resendPhase1a{msg.vertex_id}", phase1a),
+        )
+
+    def _handle_recover(self, msg: BpRecover) -> None:
+        state = self.states.get(msg.vertex_id)
+        if isinstance(state, _BpChosen):
+            # Already chosen: re-broadcast the commit.
+            commit = BpCommit(vertex_id=msg.vertex_id, value=state.value)
+            for replica in self.config.replica_addresses:
+                self.chan(replica).send(commit)
+            return
+        if state is not None:
+            return  # already proposing
+        # Propose a noop to fill the stuck vertex (Proposer.handleRecover).
+        self._propose_impl(msg.vertex_id, None, ())
+
+
+class BpAcceptor(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        # vertex -> [round, vote_round, vote_value]
+        self.states: Dict[tuple, list] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, BpPhase1a):
+            state = self.states.setdefault(msg.vertex_id, [-1, -1, None])
+            if msg.round <= state[0]:
+                self.chan(src).send(
+                    BpNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            self.chan(src).send(
+                BpPhase1b(
+                    vertex_id=msg.vertex_id, acceptor_id=self.index,
+                    round=msg.round, vote_round=state[1], vote_value=state[2],
+                )
+            )
+        elif isinstance(msg, BpPhase2a):
+            state = self.states.setdefault(msg.vertex_id, [-1, -1, None])
+            if msg.round < state[0]:
+                self.chan(src).send(
+                    BpNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            state[1] = msg.round
+            state[2] = msg.vote_value
+            self.chan(src).send(
+                BpPhase2b(
+                    vertex_id=msg.vertex_id, acceptor_id=self.index,
+                    round=msg.round,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown bpaxos acceptor message {msg!r}")
+
+
+class BpReplica(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig,
+                 state_machine: StateMachine,
+                 recover_min_period: float = 5.0,
+                 recover_max_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.recover_min_period = recover_min_period
+        self.recover_max_period = recover_max_period
+        self.index = config.replica_addresses.index(address)
+        self.dependency_graph = TarjanDependencyGraph()
+        self.client_table: ClientTable = ClientTable()
+        self.committed: Dict[tuple, tuple] = {}
+        self.recover_timers: Dict[tuple, object] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BpCommit):
+            self.logger.fatal(f"unknown bpaxos replica message {msg!r}")
+        if msg.vertex_id in self.committed:
+            return
+        self.committed[msg.vertex_id] = msg.value
+        timer = self.recover_timers.pop(msg.vertex_id, None)
+        if timer is not None:
+            timer.stop()
+        command, dependencies = msg.value
+        self.dependency_graph.commit(msg.vertex_id, 0, set(dependencies))
+        executables, blockers = self.dependency_graph.execute()
+        for vertex in blockers:
+            if vertex not in self.recover_timers:
+                self.recover_timers[vertex] = self._make_recover_timer(vertex)
+        for vertex in executables:
+            self._execute(vertex)
+
+    def _make_recover_timer(self, vertex_id: tuple):
+        def fire() -> None:
+            # Ask the vertex's own proposer first; any proposer can recover.
+            proposer = self.config.proposer_addresses[
+                self.rng.randrange(len(self.config.proposer_addresses))
+            ]
+            self.chan(proposer).send(BpRecover(vertex_id=vertex_id))
+            timer.start()
+
+        timer = self.timer(
+            f"recoverVertex{vertex_id}",
+            random_duration(
+                self.rng, self.recover_min_period, self.recover_max_period
+            ),
+            fire,
+        )
+        timer.start()
+        return timer
+
+    def _execute(self, vertex_id: tuple) -> None:
+        command, _ = self.committed[vertex_id]
+        if command is None:
+            return  # noop
+        identity = (command.client_address, command.client_pseudonym)
+        executed = self.client_table.executed(identity, command.client_id)
+        if isinstance(executed, Executed):
+            # A client retransmit got a fresh vertex for an already-executed
+            # command (there is no leader-side dedup in SimpleBPaxos): don't
+            # re-execute, but DO resend the cached reply — the original
+            # striped reply may be the very message that was lost.
+            if (
+                executed.output is not None
+                and hash(vertex_id) % len(self.config.replica_addresses)
+                == self.index
+            ):
+                client = self.transport.address_from_bytes(
+                    command.client_address
+                )
+                self.chan(client).send(
+                    BpClientReply(
+                        client_pseudonym=command.client_pseudonym,
+                        client_id=command.client_id,
+                        result=executed.output,
+                    )
+                )
+            return
+        output = self.state_machine.run(command.command)
+        self.client_table.execute(identity, command.client_id, output)
+        # Replies striped over replicas by vertex hash (Replica.scala).
+        if hash(vertex_id) % len(self.config.replica_addresses) == self.index:
+            client = self.transport.address_from_bytes(command.client_address)
+            self.chan(client).send(
+                BpClientReply(
+                    client_pseudonym=command.client_pseudonym,
+                    client_id=command.client_id,
+                    result=output,
+                )
+            )
+
+
+@dataclasses.dataclass
+class _BpPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class BpClient(Actor):
+    def __init__(self, address, transport, logger, config: SimpleBPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _BpPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = BpClientRequest(
+            BpCommand(
+                client_address=self.address_bytes,
+                client_pseudonym=pseudonym,
+                client_id=id,
+                command=command,
+            )
+        )
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))
+        ]
+        self.chan(leader).send(request)
+
+        def resend() -> None:
+            target = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendBp[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _BpPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, BpClientReply):
+            self.logger.fatal(f"unknown bpaxos client message {msg!r}")
+        pending = self.pending.get(msg.client_pseudonym)
+        if pending is None or msg.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[msg.client_pseudonym]
+        pending.result.success(msg.result)

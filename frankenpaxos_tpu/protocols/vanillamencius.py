@@ -1,0 +1,604 @@
+"""Vanilla Mencius — classic multi-leader Paxos with round-robin slot
+ownership (reference ``vanillamencius/``: Client, Server).
+
+Server i owns slots ≡ i (mod n) and coordinates them in round 0. All
+servers are also the acceptors and replicas. Three mechanisms from the
+reference (``vanillamencius/Server.scala``):
+
+  * SKIPS: when a server observes a Phase2a for a slot ahead of its own
+    next slot, it fills its intervening owned slots with noops so the
+    global log doesn't stall behind idle leaders (flushed in ranges by a
+    timer). Skips here are quorum-voted noop Phase2as batched as a range
+    (safe under revocation races; the reference's unacked skip fast path
+    is an optimization on top).
+  * REVOCATION: a heartbeat failure detector watches the other servers; a
+    randomized revocation timer runs phase 1 in a higher round over a dead
+    server's slot range (up to ``beta`` slots ahead) and fills unchosen
+    slots with noops (``Server.scala`` makeRevocationTimer /
+    handlePhase1a/b).
+  * Execution: chosen entries retire through a BufferMap log in global
+    slot order; the slot's owner replies to the client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions
+from frankenpaxos_tpu.heartbeat import Participant as HeartbeatParticipant
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, random_duration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmClientRequest:
+    command_id: VmCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmClientReply:
+    command_id: VmCommandId
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmPhase1a:
+    slot_start: int  # revocation runs phase 1 over a whole range
+    slot_end: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmPhase1b:
+    server_index: int
+    slot_start: int
+    slot_end: int
+    round: int
+    votes: tuple  # of (slot, vote_round, command|None)
+    # Slots in the range this acceptor already knows are chosen, with their
+    # values; the revoker must adopt these, not re-propose over them.
+    chosen: tuple  # of (slot, command|None)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmPhase2a:
+    slot: int
+    round: int
+    value: Optional[VmClientRequest]  # None = noop
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmSkipRange:
+    """Noop Phase2as for every owned slot in [start, end), batched."""
+
+    owner: int
+    start: int
+    end: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmPhase2b:
+    server_index: int
+    slot: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmSkipRange2b:
+    server_index: int
+    owner: int
+    start: int
+    end: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmChosen:
+    slot: int
+    value: Optional[VmClientRequest]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmChosenRange:
+    owner: int
+    start: int
+    end: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmNack:
+    slot: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class VmPhase1Nack:
+    slot_start: int
+    slot_end: int
+    round: int
+    higher_round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class VanillaMenciusConfig:
+    f: int
+    server_addresses: tuple
+    heartbeat_addresses: tuple
+
+    @property
+    def n(self) -> int:
+        return len(self.server_addresses)
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if self.n != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 servers")
+        if len(self.heartbeat_addresses) != self.n:
+            raise ValueError("one heartbeat address per server")
+
+
+@dataclasses.dataclass(frozen=True)
+class VmServerOptions:
+    beta: int = 100  # revoke this many slots ahead of the dead server
+    revoke_min_period: float = 1.0
+    revoke_max_period: float = 5.0
+    resend_phase1as_period: float = 5.0
+    log_grow_size: int = 1000
+    heartbeat_options: HeartbeatOptions = HeartbeatOptions()
+
+
+@dataclasses.dataclass
+class _VmSlotState:
+    round: int = 0
+    vote_round: int = -1
+    vote_value: Optional[VmClientRequest] = None
+
+
+class VmServer(Actor):
+    def __init__(self, address, transport, logger,
+                 config: VanillaMenciusConfig, state_machine: StateMachine,
+                 options: VmServerOptions = VmServerOptions(), seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.server_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.server_addresses.index(address)
+        self.heartbeat = HeartbeatParticipant(
+            config.heartbeat_addresses[self.index], transport, logger,
+            config.heartbeat_addresses, options.heartbeat_options,
+        )
+        # Global log of chosen entries; acceptor state per slot.
+        self.log: BufferMap[Tuple[Optional[VmClientRequest]]] = BufferMap(
+            options.log_grow_size
+        )
+        self.acceptor_states: Dict[int, _VmSlotState] = {}
+        self.executed_watermark = 0
+        self.next_slot = self.index  # next OWNED slot (stride n)
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+        # Coordinator state: slot -> {round, value, votes}
+        self.phase2s: Dict[int, dict] = {}
+        # Revocation (phase 1) state per (owner): range + votes.
+        self.phase1s: Dict[int, dict] = {}
+        # Randomized revocation timers: each periodically checks the
+        # heartbeat's alive set and revokes dead peers' slots
+        # (Server.scala makeRevocationTimer).
+        self.revocation_timers: Dict[int, object] = {}
+        for peer in range(self.config.n):
+            if peer != self.index:
+                self.revocation_timers[peer] = self._make_revocation_timer(peer)
+
+    def _make_revocation_timer(self, peer: int):
+        def fire() -> None:
+            dead = (
+                self.config.heartbeat_addresses[peer]
+                not in self.heartbeat.unsafe_alive()
+            )
+            if dead:
+                self.start_revocation(peer)
+            timer.start()
+
+        timer = self.timer(
+            f"revoke{peer}",
+            random_duration(
+                self.rng,
+                self.options.revoke_min_period,
+                self.options.revoke_max_period,
+            ),
+            fire,
+        )
+        timer.start()
+        return timer
+
+    # -- Helpers -------------------------------------------------------------
+
+    def owner(self, slot: int) -> int:
+        return slot % self.config.n
+
+    def _broadcast(self, msg) -> None:
+        for a in self.config.server_addresses:
+            self.chan(a).send(msg)
+
+    def _acceptor_state(self, slot: int) -> _VmSlotState:
+        return self.acceptor_states.setdefault(slot, _VmSlotState())
+
+    # -- Execution -----------------------------------------------------------
+
+    def _execute_log(self) -> None:
+        while True:
+            entry = self.log.get(self.executed_watermark)
+            if entry is None:
+                return
+            (value,) = entry
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            if value is None:
+                continue  # noop / skip
+            cid = value.command_id
+            key = (cid.client_address, cid.client_pseudonym)
+            cached = self.client_table.get(key)
+            if cached is not None and cid.client_id < cached[0]:
+                continue
+            if cached is not None and cid.client_id == cached[0]:
+                result = cached[1]
+            else:
+                result = self.state_machine.run(value.command)
+                self.client_table[key] = (cid.client_id, result)
+            if self.owner(slot) == self.index:
+                client = self.transport.address_from_bytes(cid.client_address)
+                self.chan(client).send(
+                    VmClientReply(command_id=cid, result=result)
+                )
+
+    def _choose(self, slot: int, value: Optional[VmClientRequest]) -> None:
+        if self.log.get(slot) is None:
+            self.log.put(slot, (value,))
+        self.acceptor_states.pop(slot, None)
+        self.phase2s.pop(slot, None)
+        self._execute_log()
+
+    # -- Skips ---------------------------------------------------------------
+
+    def _maybe_skip_to(self, observed_slot: int) -> None:
+        """Another server reached observed_slot; fill our owned slots below
+        it with noops so the global log doesn't stall on us."""
+        if self.owner(observed_slot) == self.index:
+            return
+        if self.next_slot >= observed_slot:
+            return
+        start, end = self.next_slot, observed_slot
+        self.next_slot = end + ((self.index - end) % self.config.n)
+        self._broadcast(
+            VmSkipRange(owner=self.index, start=start, end=end, round=0)
+        )
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, VmClientRequest):
+            self._handle_client_request(msg)
+        elif isinstance(msg, VmPhase2a):
+            self._handle_phase2a(src, msg)
+        elif isinstance(msg, VmSkipRange):
+            self._handle_skip_range(src, msg)
+        elif isinstance(msg, VmPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, VmSkipRange2b):
+            self._handle_skip_range_2b(msg)
+        elif isinstance(msg, VmChosen):
+            self._choose(msg.slot, msg.value)
+        elif isinstance(msg, VmChosenRange):
+            for slot in range(msg.start, msg.end):
+                if self.owner(slot) == msg.owner:
+                    self._choose(slot, None)
+        elif isinstance(msg, VmPhase1a):
+            self._handle_phase1a(src, msg)
+        elif isinstance(msg, VmPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, VmPhase1Nack):
+            self._handle_phase1_nack(msg)
+        elif isinstance(msg, VmNack):
+            pass  # a revocation beat us; the revoker re-runs phase 1
+        else:
+            self.logger.fatal(f"unknown mencius message {msg!r}")
+
+    def _handle_client_request(self, msg: VmClientRequest) -> None:
+        cid = msg.command_id
+        key = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(key)
+        if cached is not None and cid.client_id == cached[0]:
+            client = self.transport.address_from_bytes(cid.client_address)
+            self.chan(client).send(
+                VmClientReply(command_id=cid, result=cached[1])
+            )
+            return
+        slot = self.next_slot
+        self.next_slot += self.config.n
+        self.phase2s[slot] = {"round": 0, "value": msg, "votes": set()}
+        self._broadcast(VmPhase2a(slot=slot, round=0, value=msg))
+
+    def _handle_phase2a(self, src: Address, msg: VmPhase2a) -> None:
+        if self.log.get(msg.slot) is not None:
+            return  # already chosen
+        state = self._acceptor_state(msg.slot)
+        if msg.round < state.round:
+            self.chan(src).send(VmNack(slot=msg.slot, round=state.round))
+            return
+        state.round = msg.round
+        state.vote_round = msg.round
+        state.vote_value = msg.value
+        self.chan(src).send(
+            VmPhase2b(server_index=self.index, slot=msg.slot, round=msg.round)
+        )
+        self._maybe_skip_to(msg.slot)
+
+    def _handle_skip_range(self, src: Address, msg: VmSkipRange) -> None:
+        # Vote noop for every owned slot in the range (batched Phase2a).
+        for slot in range(msg.start, msg.end):
+            if self.owner(slot) != msg.owner:
+                continue
+            if self.log.get(slot) is not None:
+                continue
+            state = self._acceptor_state(slot)
+            if msg.round < state.round:
+                continue
+            state.round = msg.round
+            state.vote_round = msg.round
+            state.vote_value = None
+        self.chan(src).send(
+            VmSkipRange2b(
+                server_index=self.index, owner=msg.owner,
+                start=msg.start, end=msg.end, round=msg.round,
+            )
+        )
+
+    def _handle_phase2b(self, msg: VmPhase2b) -> None:
+        phase2 = self.phase2s.get(msg.slot)
+        if phase2 is None or msg.round != phase2["round"]:
+            return
+        phase2["votes"].add(msg.server_index)
+        if len(phase2["votes"]) < self.config.quorum_size:
+            return
+        value = phase2["value"]
+        self._broadcast(VmChosen(slot=msg.slot, value=value))
+        self._choose(msg.slot, value)
+
+    def _handle_skip_range_2b(self, msg: VmSkipRange2b) -> None:
+        key = -(msg.start + 1)  # range phase2s keyed negatively
+        phase2 = self.phase2s.setdefault(
+            key, {"round": msg.round, "votes": set(), "range": (msg.owner, msg.start, msg.end)}
+        )
+        phase2["votes"].add(msg.server_index)
+        if len(phase2["votes"]) < self.config.quorum_size:
+            return
+        owner, start, end = phase2["range"]
+        self.phase2s.pop(key, None)
+        self._broadcast(VmChosenRange(owner=owner, start=start, end=end))
+        for slot in range(start, end):
+            if self.owner(slot) == owner:
+                self._choose(slot, None)
+
+    # -- Revocation ----------------------------------------------------------
+
+    def _revocation_round(self, min_round: int) -> int:
+        """A round > min_round owned by this server: rounds r > 0 with
+        r ≡ index+1 (mod n) belong to server `index`, so concurrent
+        revokers never collide (round 0 is the slot owner's)."""
+        r = self.index + 1
+        while r <= min_round:
+            r += self.config.n
+        return r
+
+    def start_revocation(self, dead_index: int) -> None:
+        """Run phase 1 over the dead server's unchosen slots up to beta
+        ahead of our executed watermark (makeRevocationTimer)."""
+        if dead_index in self.phase1s:
+            return  # already revoking this server
+        start = self.executed_watermark
+        end = start + self.options.beta
+        self._start_phase1(dead_index, start, end, min_round=0)
+
+    def _start_phase1(self, owner: int, start: int, end: int,
+                      min_round: int) -> None:
+        round = self._revocation_round(min_round)
+        phase1a = VmPhase1a(slot_start=start, slot_end=end, round=round)
+
+        def resend() -> None:
+            self._broadcast(phase1a)
+            timer.start()
+
+        timer = self.timer(
+            f"resendPhase1a[{owner};{round}]",
+            self.options.resend_phase1as_period, resend,
+        )
+        timer.start()
+        self.phase1s[owner] = {
+            "round": round, "start": start, "end": end, "votes": {},
+            "resend": timer,
+        }
+        self._broadcast(phase1a)
+
+    def _handle_phase1a(self, src: Address, msg: VmPhase1a) -> None:
+        # All-or-nothing range promise: a Phase1b counts toward a full-range
+        # quorum, so if ANY slot in the range has promised a higher round we
+        # must nack the whole range rather than silently skip that slot
+        # (otherwise the revoker could choose a noop over a chosen value).
+        chosen = []
+        unchosen = []
+        for slot in range(msg.slot_start, msg.slot_end):
+            entry = self.log.get(slot)
+            if entry is not None:
+                chosen.append((slot, entry[0]))
+            else:
+                unchosen.append(slot)
+        higher = max(
+            (self._acceptor_state(s).round for s in unchosen), default=-1
+        )
+        if higher > msg.round:
+            self.chan(src).send(
+                VmPhase1Nack(
+                    slot_start=msg.slot_start, slot_end=msg.slot_end,
+                    round=msg.round, higher_round=higher,
+                )
+            )
+            return
+        votes = []
+        for slot in unchosen:
+            state = self._acceptor_state(slot)
+            state.round = msg.round
+            if state.vote_round >= 0:
+                votes.append((slot, state.vote_round, state.vote_value))
+        self.chan(src).send(
+            VmPhase1b(
+                server_index=self.index, slot_start=msg.slot_start,
+                slot_end=msg.slot_end, round=msg.round, votes=tuple(votes),
+                chosen=tuple(chosen),
+            )
+        )
+
+    def _handle_phase1b(self, msg: VmPhase1b) -> None:
+        # Adopt chosen slots the acceptor told us about, regardless of any
+        # ongoing phase 1.
+        for slot, value in msg.chosen:
+            self._broadcast(VmChosen(slot=slot, value=value))
+            self._choose(slot, value)
+        phase1_key = None
+        for key, state in self.phase1s.items():
+            if (
+                state["round"] == msg.round
+                and state["start"] == msg.slot_start
+                and state["end"] == msg.slot_end
+            ):
+                phase1_key = key
+        if phase1_key is None:
+            return
+        phase1 = self.phase1s[phase1_key]
+        phase1["votes"][msg.server_index] = msg.votes
+        if len(phase1["votes"]) < self.config.quorum_size:
+            return
+        # Quorum reached: finish phase 1 EXACTLY once (a late Phase1b must
+        # not re-run phase 2 with a different value in the same round).
+        del self.phase1s[phase1_key]
+        phase1["resend"].stop()
+        # Safe value per slot: highest vote round's value, else noop.
+        best: Dict[int, Tuple[int, Optional[VmClientRequest]]] = {}
+        for votes in phase1["votes"].values():
+            for slot, vote_round, value in votes:
+                if slot not in best or vote_round > best[slot][0]:
+                    best[slot] = (vote_round, value)
+        for slot in range(phase1["start"], phase1["end"]):
+            if self.log.get(slot) is not None:
+                continue
+            value = best.get(slot, (-1, None))[1]
+            self.phase2s[slot] = {
+                "round": phase1["round"], "value": value, "votes": set(),
+            }
+            self._broadcast(
+                VmPhase2a(slot=slot, round=phase1["round"], value=value)
+            )
+
+    def _handle_phase1_nack(self, msg: VmPhase1Nack) -> None:
+        for key, state in list(self.phase1s.items()):
+            if (
+                state["round"] == msg.round
+                and state["start"] == msg.slot_start
+                and state["end"] == msg.slot_end
+            ):
+                state["resend"].stop()
+                del self.phase1s[key]
+                # Retry in a round above the nacked one, still unique to us.
+                self._start_phase1(
+                    key, state["start"], state["end"], msg.higher_round
+                )
+
+
+@dataclasses.dataclass
+class _VmPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class VmClient(Actor):
+    def __init__(self, address, transport, logger,
+                 config: VanillaMenciusConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _VmPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = VmClientRequest(
+            command_id=VmCommandId(self.address_bytes, pseudonym, id),
+            command=command,
+        )
+        server = self.config.server_addresses[
+            self.rng.randrange(self.config.n)
+        ]
+        self.chan(server).send(request)
+
+        def resend() -> None:
+            target = self.config.server_addresses[
+                self.rng.randrange(self.config.n)
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendVm[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _VmPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, VmClientReply):
+            self.logger.fatal(f"unknown mencius client message {msg!r}")
+        pending = self.pending.get(msg.command_id.client_pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[msg.command_id.client_pseudonym]
+        pending.result.success(msg.result)

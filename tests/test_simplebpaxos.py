@@ -1,0 +1,244 @@
+"""SimpleBPaxos sim tests (the analog of shared/src/test/scala/simplebpaxos)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import simplebpaxos as bp
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+from test_epaxos import RecordingKv, _conflicting_order_violation
+
+
+def make(f=1, num_clients=2, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    n = 2 * f + 1
+    config = bp.SimpleBPaxosConfig(
+        f=f,
+        leader_addresses=tuple(SimAddress(f"leader{i}") for i in range(f + 1)),
+        proposer_addresses=tuple(
+            SimAddress(f"proposer{i}") for i in range(f + 1)
+        ),
+        dep_service_node_addresses=tuple(
+            SimAddress(f"dep{i}") for i in range(n)
+        ),
+        acceptor_addresses=tuple(SimAddress(f"acceptor{i}") for i in range(n)),
+        replica_addresses=tuple(SimAddress(f"replica{i}") for i in range(f + 1)),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        bp.BpLeader(a, t, log(), config, seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    proposers = [
+        bp.BpProposer(a, t, log(), config, seed=seed + 10 + i)
+        for i, a in enumerate(config.proposer_addresses)
+    ]
+    deps = [
+        bp.BpDepServiceNode(a, t, log(), config, KeyValueStore())
+        for a in config.dep_service_node_addresses
+    ]
+    acceptors = [
+        bp.BpAcceptor(a, t, log(), config) for a in config.acceptor_addresses
+    ]
+    replicas = [
+        bp.BpReplica(a, t, log(), config, RecordingKv(), seed=seed + 30 + i)
+        for i, a in enumerate(config.replica_addresses)
+    ]
+    clients = [
+        bp.BpClient(SimAddress(f"client{i}"), t, log(), config, seed=seed + 50 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, leaders, proposers, deps, acceptors, replicas, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_simplebpaxos_single_command():
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make()
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    drain(t)
+    assert p.done
+    for r in replicas:
+        assert r.state_machine.get() == {"x": "1"}
+
+
+def test_simplebpaxos_round_zero_skips_phase1():
+    """A vertex's own proposer owns round 0, so no Phase1a hits the wire."""
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make()
+    clients[0].propose(0, kv_set(("x", "1")))
+    phase1as = 0
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), bp.BpPhase1a):
+            phase1as += 1
+        t.deliver_message(m)
+    assert phase1as == 0
+
+
+def test_simplebpaxos_conflicting_commands_converge():
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make(seed=4)
+    p1 = clients[0].propose(0, kv_set(("x", "a")))
+    p2 = clients[1].propose(0, kv_set(("x", "b")))
+    rng = random.Random(5)
+    for _ in range(4000):
+        cmd = t.generate_command(rng)
+        if cmd is None:
+            break
+        t.run_command(cmd, record=False)
+    drain(t)
+    assert p1.done and p2.done
+    finals = {tuple(sorted(r.state_machine.get().items())) for r in replicas}
+    assert len(finals) == 1, finals
+
+
+def test_simplebpaxos_recovery_fills_stuck_vertex_with_noop():
+    """Kill a leader after its dep requests go out; the dependent command's
+    replica recovers the stuck vertex via the proposer (noop)."""
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make(seed=7)
+
+    class _L0:
+        def randrange(self, n):
+            return 0
+
+    clients[0].rng = _L0()
+    p1 = clients[0].propose(0, kv_set(("x", "1")))
+    # Deliver the client request so leader 0 creates vertex (0, 0) and sends
+    # dependency requests; deliver those so the dep service learns the
+    # vertex; then the leader dies before seeing any replies.
+    t.deliver_message(t.messages[0])
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), bp.BpDependencyRequest):
+            t.deliver_message(m)
+        elif m.dst == config.leader_addresses[0]:
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    t.partition_actor(config.leader_addresses[0])
+    t.partition_actor(config.proposer_addresses[0])
+
+    # A conflicting command through leader 1 picks up vertex (0,0) as a
+    # dependency and blocks on it.
+    class _L1:
+        def randrange(self, n):
+            return 1
+
+    clients[1].rng = _L1()
+    p2 = clients[1].propose(0, kv_set(("x", "2")))
+    drain(t)
+    assert not p2.done  # blocked on the stuck vertex
+    # Fire recover timers on live replicas until proposer 1 fills the hole.
+    for _ in range(6):
+        for timer in list(t.running_timers()):
+            if timer.address in (
+                config.replica_addresses + (config.proposer_addresses[1],)
+            ):
+                t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert p2.done, "recovery did not unblock the dependent command"
+    finals = {tuple(sorted(r.state_machine.get().items())) for r in replicas}
+    assert len(finals) == 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    key: str
+    value: str
+
+
+class SimulatedSimpleBPaxos(SimulatedSystem):
+    def __init__(self, f=1):
+        self.f = f
+        self._kv = KeyValueStore()
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        replicas = system[6]
+        return tuple(
+            tuple(r.state_machine.executed_commands) for r in replicas
+        )
+
+    def generate_command(self, system, rng):
+        t = system[0]
+        clients = system[7]
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"k{rng.randrange(2)}",
+                                    f"v{rng.randrange(50)}"))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t = system[0]
+        clients = system[7]
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(
+                command.pseudonym, kv_set((command.key, command.value))
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        class _Holder:
+            pass
+
+        fakes = []
+        for log in state:
+            sm = _Holder()
+            sm.executed_commands = list(log)
+            holder = _Holder()
+            holder.state_machine = sm
+            fakes.append(holder)
+        return _conflicting_order_violation(fakes, self._kv.conflicts)
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_simplebpaxos_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedSimpleBPaxos(f), run_length=120, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_simplebpaxos_lost_reply_retry_gets_cached_reply():
+    """A client whose reply is lost retries; the command is NOT re-executed
+    and the cached reply is resent (review regression)."""
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make(seed=13)
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    # Deliver everything except client-bound replies (drop them).
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), bp.BpClientReply):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert not p.done
+    execs_before = [len(r.state_machine.executed_commands) for r in replicas]
+    # The client's resend timer fires; this time let replies through.
+    t.trigger_timer(clients[0].address, "resendBp[0;0]")
+    drain(t)
+    assert p.done, "retry after lost reply never completed"
+    execs_after = [len(r.state_machine.executed_commands) for r in replicas]
+    assert execs_after == execs_before, "command was re-executed on retry"

@@ -1,0 +1,210 @@
+"""Vanilla Mencius sim tests (the analog of
+shared/src/test/scala/vanillamencius)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import vanillamencius as vm
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+def make(f=1, num_clients=2, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    n = 2 * f + 1
+    config = vm.VanillaMenciusConfig(
+        f=f,
+        server_addresses=tuple(SimAddress(f"server{i}") for i in range(n)),
+        heartbeat_addresses=tuple(SimAddress(f"hb{i}") for i in range(n)),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    servers = [
+        vm.VmServer(a, t, log(), config, ReadableAppendLog(), seed=seed + i)
+        for i, a in enumerate(config.server_addresses)
+    ]
+    clients = [
+        vm.VmClient(SimAddress(f"client{i}"), t, log(), config, seed=seed + 20 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, servers, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_mencius_single_write():
+    t, config, servers, clients = make()
+    p = clients[0].propose(0, b"hello")
+    drain(t)
+    assert p.done
+
+
+def test_mencius_multi_leader_skips_keep_log_moving():
+    """Writes through different servers interleave; skips fill the gaps so
+    every server's executed log converges."""
+    t, config, servers, clients = make(seed=2)
+    promises = []
+    for round_ in range(4):
+        for i, c in enumerate(clients):
+            promises.append(c.propose(round_, f"r{round_}c{i}".encode()))
+        drain(t)
+    assert all(p.done for p in promises)
+    logs = {tuple(s.state_machine.get()) for s in servers}
+    assert len(logs) == 1, f"server logs diverged: {logs}"
+    assert len(next(iter(logs))) == len(promises)
+
+
+def test_mencius_revocation_unsticks_dead_leader():
+    """Kill a server; another server revokes its slots so the global log
+    can execute past them."""
+    t, config, servers, clients = make(seed=3)
+    # A write through server 0 commits normally.
+    class _S0:
+        def randrange(self, n):
+            return 0
+
+    clients[0].rng = _S0()
+    p1 = clients[0].propose(0, b"ok")
+    drain(t)
+    assert p1.done
+
+    # Server 1 dies. A write through server 2 lands in a slot AFTER server
+    # 1's unused slots, so execution stalls waiting for them.
+    t.partition_actor(config.server_addresses[1])
+    t.partition_actor(config.heartbeat_addresses[1])
+
+    class _S2:
+        def randrange(self, n):
+            return 2
+
+    clients[1].rng = _S2()
+    p2 = clients[1].propose(0, b"after")
+    drain(t)
+    # The write is chosen but can't execute until server 1's slots fill.
+    assert not p2.done
+    # Server 2 revokes server 1's slots.
+    servers[2].start_revocation(1)
+    drain(t)
+    assert p2.done, "revocation did not unstick the log"
+    live_logs = {
+        tuple(s.state_machine.get()) for s in (servers[0], servers[2])
+    }
+    assert len(live_logs) == 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    value: bytes
+
+
+class SimulatedMencius(SimulatedSystem):
+    """Invariant: server executed logs are pairwise prefix-compatible and
+    grow monotonically (same as MultiPaxos — Mencius's global log is
+    totally ordered)."""
+
+    def __init__(self, f=1):
+        self.f = f
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        t, config, servers, clients = system
+        return tuple(tuple(s.state_machine.get()) for s in servers)
+
+    def generate_command(self, system, rng):
+        t, config, servers, clients = system
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"v{rng.randrange(50)}".encode()))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t, config, servers, clients = system
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(
+                command.pseudonym, command.value
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"server logs not prefix-compatible: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"server log shrank or changed: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_mencius_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedMencius(f), run_length=120, num_runs=12, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_mencius_auto_revocation_via_heartbeat():
+    """The revocation timer consults the heartbeat and revokes a dead peer
+    automatically (no manual start_revocation)."""
+    t, config, servers, clients = make(seed=9)
+
+    class _S2:
+        def randrange(self, n):
+            return 2
+
+    clients[0].rng = _S2()
+    p0 = clients[0].propose(0, b"warm")
+    drain(t)
+    assert p0.done
+
+    # Server 1 dies; make server 2's heartbeat notice (success then fail
+    # timers expire num_retries times).
+    dead_hb = config.heartbeat_addresses[1]
+    t.partition_actor(config.server_addresses[1])
+    t.partition_actor(dead_hb)
+    hb2 = config.heartbeat_addresses[2]
+    t.trigger_timer(hb2, f"successTimer{dead_hb}")
+    drain(t)
+    for _ in range(servers[2].options.heartbeat_options.num_retries):
+        t.trigger_timer(hb2, f"failTimer{dead_hb}")
+        drain(t)
+    assert dead_hb not in servers[2].heartbeat.unsafe_alive()
+
+    # A new write through server 2 may stall behind server 1's slots.
+    p1 = clients[0].propose(1, b"post-death")
+    drain(t)
+    # Fire server 2's revocation timer for peer 1: heartbeat says dead, so
+    # revocation starts and fills the holes.
+    for _ in range(3):
+        t.trigger_timer(config.server_addresses[2], "revoke1")
+        drain(t)
+    assert p1.done
