@@ -84,7 +84,34 @@ class TriggerTimer:
     name: str
 
 
-SimCommand = Union[DeliverMessage, TriggerTimer]
+@dataclasses.dataclass(frozen=True)
+class DropMessage:
+    msg: QueuedMessage
+
+
+@dataclasses.dataclass(frozen=True)
+class DuplicateMessage:
+    msg: QueuedMessage
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionActor:
+    address: Address
+
+
+@dataclasses.dataclass(frozen=True)
+class UnpartitionActor:
+    address: Address
+
+
+SimCommand = Union[
+    DeliverMessage,
+    TriggerTimer,
+    DropMessage,
+    DuplicateMessage,
+    PartitionActor,
+    UnpartitionActor,
+]
 
 
 class SimTransport(Transport):
@@ -163,13 +190,17 @@ class SimTransport(Transport):
         actor.receive(msg.src, actor.serializer.from_bytes(msg.data))
         self.flush_all()
 
-    def drop_message(self, msg: QueuedMessage) -> None:
+    def drop_message(self, msg: QueuedMessage, record: bool = True) -> None:
+        if record:
+            self.history.append(DropMessage(msg))
         try:
             self.messages.remove(msg)
         except ValueError:
             pass
 
-    def duplicate_message(self, msg: QueuedMessage) -> None:
+    def duplicate_message(self, msg: QueuedMessage, record: bool = True) -> None:
+        if record:
+            self.history.append(DuplicateMessage(msg))
         if msg in self.messages:
             self.messages.append(msg)
 
@@ -186,9 +217,11 @@ class SimTransport(Transport):
                 self.flush_all()
                 return
 
-    def partition_actor(self, address: Address) -> None:
+    def partition_actor(self, address: Address, record: bool = True) -> None:
         """Drop all traffic to/from ``address`` and all its pending messages
         (JsTransport.scala:246-258)."""
+        if record:
+            self.history.append(PartitionActor(address))
         self.partitioned.add(address)
         self.messages = [
             m
@@ -196,7 +229,9 @@ class SimTransport(Transport):
             if m.src != address and m.dst != address
         ]
 
-    def unpartition_actor(self, address: Address) -> None:
+    def unpartition_actor(self, address: Address, record: bool = True) -> None:
+        if record:
+            self.history.append(UnpartitionActor(address))
         self.partitioned.discard(address)
 
     # -- Random command generation (FakeTransport.scala:196-231) -------------
@@ -221,5 +256,13 @@ class SimTransport(Transport):
             self.deliver_message(cmd.msg, record=record)
         elif isinstance(cmd, TriggerTimer):
             self.trigger_timer(cmd.address, cmd.name, record=record)
+        elif isinstance(cmd, DropMessage):
+            self.drop_message(cmd.msg, record=record)
+        elif isinstance(cmd, DuplicateMessage):
+            self.duplicate_message(cmd.msg, record=record)
+        elif isinstance(cmd, PartitionActor):
+            self.partition_actor(cmd.address, record=record)
+        elif isinstance(cmd, UnpartitionActor):
+            self.unpartition_actor(cmd.address, record=record)
         else:
             raise TypeError(f"unknown sim command {cmd!r}")

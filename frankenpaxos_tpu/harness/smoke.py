@@ -401,6 +401,34 @@ def smoke_vanillamencius(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_matchmakerpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import matchmakerpaxos as mmx
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = mmx.MatchmakerPaxosConfig(
+            f=1,
+            client_addresses=(SimAddress("mmc0"),),
+            leader_addresses=(SimAddress("mml0"), SimAddress("mml1")),
+            matchmaker_addresses=tuple(SimAddress(f"mmm{i}") for i in range(3)),
+            acceptor_addresses=tuple(SimAddress(f"mma{i}") for i in range(4)),
+        )
+        for a in config.leader_addresses:
+            mmx.MmLeader(a, t, log(), config)
+        for a in config.matchmaker_addresses:
+            mmx.MmMatchmaker(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            mmx.MmAcceptor(a, t, log(), config)
+        return mmx.MmClient(config.client_addresses[0], t, log(), config)
+
+    def operate(t, client):
+        return [client.propose("smoke")]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_tpu(bench=None) -> dict:
     import jax
 
@@ -438,6 +466,7 @@ SMOKES = {
     "epaxos": smoke_epaxos,
     "simplebpaxos": smoke_simplebpaxos,
     "vanillamencius": smoke_vanillamencius,
+    "matchmakerpaxos": smoke_matchmakerpaxos,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,
 }

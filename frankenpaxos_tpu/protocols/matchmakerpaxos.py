@@ -1,0 +1,469 @@
+"""Matchmaker Paxos — the reconfiguration core (reference
+``matchmakerpaxos/``: Client, Leader, Matchmaker, Acceptor; VLDB '20).
+
+Single-decree Paxos where each round's acceptor configuration (a whole
+read-write quorum system) is chosen ON THE FLY and registered with a
+quorum of matchmakers. A leader starting round r sends its proposed
+quorum system to the matchmakers (MatchRequest); a matchmaker replies
+with every configuration it has seen for earlier rounds (MatchReply) and
+refuses stale rounds (MatchmakerNack, Matchmaker.scala:116-170). The
+leader then runs phase 1 against a read quorum OF EVERY prior
+configuration (Leader.handleMatchReply/handlePhase1b: pendingRounds
+empties as read quorums complete), picks the max-vote-round value, and
+runs phase 2 against a write quorum of its own new configuration. This is
+the machinery Matchmaker MultiPaxos reconfigures acceptor sets with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Set
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.quorums import (
+    QuorumSystemProto,
+    SimpleMajority,
+    UnanimousWrites,
+    from_proto,
+    to_proto,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmClientRequest:
+    v: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmClientReply:
+    chosen: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmAcceptorGroup:
+    round: int
+    quorum_system: QuorumSystemProto
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmMatchRequest:
+    acceptor_group: MmAcceptorGroup
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmMatchReply:
+    round: int
+    matchmaker_index: int
+    acceptor_groups: tuple  # every MmAcceptorGroup seen for earlier rounds
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmMatchmakerNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmPhase1a:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmPhase1b:
+    round: int
+    acceptor_index: int
+    vote_round: int
+    vote_value: Optional[str]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmPhase2a:
+    round: int
+    value: str
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmPhase2b:
+    round: int
+    acceptor_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmAcceptorNack:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerPaxosConfig:
+    f: int
+    client_addresses: tuple
+    leader_addresses: tuple
+    matchmaker_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def num_acceptors(self) -> int:
+        return len(self.acceptor_addresses)
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.matchmaker_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 matchmakers")
+        if self.num_acceptors < self.f + 1:
+            raise ValueError("need >= f+1 acceptors")
+
+
+_INACTIVE = "inactive"
+
+
+@dataclasses.dataclass
+class _Matchmaking:
+    v: str
+    quorum_system: object
+    match_replies: Dict[int, MmMatchReply]
+
+
+@dataclasses.dataclass
+class _MmPhase1:
+    v: str
+    quorum_system: object
+    previous_quorum_systems: Dict[int, object]
+    acceptor_to_rounds: Dict[int, Set[int]]
+    pending_rounds: Set[int]
+    phase1bs: Dict[int, MmPhase1b]
+
+
+@dataclasses.dataclass
+class _MmPhase2:
+    v: str
+    quorum_system: object
+    phase2bs: Dict[int, MmPhase2b]
+
+
+@dataclasses.dataclass
+class _MmChosen:
+    v: str
+
+
+class MmLeader(Actor):
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerPaxosConfig, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = -1
+        self.state = _INACTIVE
+        self.clients: List[Address] = []
+
+    def _random_quorum_system(self):
+        """A fresh configuration over a random subset of the acceptors
+        (Leader.getRandomQuorumSystem): either a simple majority over
+        2f+1 of them or unanimous writes over f+1."""
+        n = self.config.num_acceptors
+        indices = list(range(n))
+        self.rng.shuffle(indices)
+        if n >= 2 * self.config.f + 1 and self.rng.random() < 0.5:
+            qs = SimpleMajority(
+                set(indices[: 2 * self.config.f + 1]),
+                seed=self.rng.randrange(2**31),
+            )
+        else:
+            qs = UnanimousWrites(
+                set(indices[: self.config.quorum_size]),
+                seed=self.rng.randrange(2**31),
+            )
+        return qs, to_proto(qs)
+
+    def _start_matchmaking(self, new_round: int, v: str) -> None:
+        self.round = new_round
+        qs, qs_proto = self._random_quorum_system()
+        request = MmMatchRequest(
+            acceptor_group=MmAcceptorGroup(
+                round=self.round, quorum_system=qs_proto
+            )
+        )
+        for matchmaker in self.config.matchmaker_addresses:
+            self.chan(matchmaker).send(request)
+        self.state = _Matchmaking(v=v, quorum_system=qs, match_replies={})
+
+    def _handle_nack_round(self, nack_round: int) -> None:
+        if nack_round <= self.round:
+            return
+        if self.state == _INACTIVE or isinstance(self.state, _MmChosen):
+            return
+        v = self.state.v
+        self._start_matchmaking(
+            self.round_system.next_classic_round(self.index, nack_round), v
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, MmMatchReply):
+            self._handle_match_reply(msg)
+        elif isinstance(msg, MmPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, MmPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, (MmMatchmakerNack, MmAcceptorNack)):
+            self._handle_nack_round(msg.round)
+        else:
+            self.logger.fatal(f"unknown matchmaker leader message {msg!r}")
+
+    def _handle_client_request(self, src: Address, msg: MmClientRequest) -> None:
+        if isinstance(self.state, _MmChosen):
+            self.chan(src).send(MmClientReply(chosen=self.state.v))
+            return
+        if src not in self.clients:
+            self.clients.append(src)
+        self._start_matchmaking(
+            self.round_system.next_classic_round(self.index, self.round), msg.v
+        )
+
+    def _handle_match_reply(self, msg: MmMatchReply) -> None:
+        if not isinstance(self.state, _Matchmaking):
+            return
+        if msg.round != self.round:
+            self.logger.check_lt(msg.round, self.round)
+            return
+        matchmaking = self.state
+        matchmaking.match_replies[msg.matchmaker_index] = msg
+        if len(matchmaking.match_replies) < self.config.quorum_size:
+            return
+        # Union of all previously-registered configurations: phase 1 must
+        # read a quorum of EVERY one of them.
+        pending_rounds: Set[int] = set()
+        previous: Dict[int, object] = {}
+        acceptor_indices: Set[int] = set()
+        acceptor_to_rounds: Dict[int, Set[int]] = {}
+        for reply in matchmaking.match_replies.values():
+            for group in reply.acceptor_groups:
+                pending_rounds.add(group.round)
+                qs = from_proto(group.quorum_system)
+                previous[group.round] = qs
+                acceptor_indices |= qs.random_read_quorum()
+                for index in qs.nodes():
+                    acceptor_to_rounds.setdefault(index, set()).add(group.round)
+        if not pending_rounds:
+            # First configuration ever: straight to phase 2.
+            for index in matchmaking.quorum_system.random_write_quorum():
+                self.chan(self.config.acceptor_addresses[index]).send(
+                    MmPhase2a(round=self.round, value=matchmaking.v)
+                )
+            self.state = _MmPhase2(
+                v=matchmaking.v,
+                quorum_system=matchmaking.quorum_system,
+                phase2bs={},
+            )
+        else:
+            for index in acceptor_indices:
+                self.chan(self.config.acceptor_addresses[index]).send(
+                    MmPhase1a(round=self.round)
+                )
+            self.state = _MmPhase1(
+                v=matchmaking.v,
+                quorum_system=matchmaking.quorum_system,
+                previous_quorum_systems=previous,
+                acceptor_to_rounds=acceptor_to_rounds,
+                pending_rounds=pending_rounds,
+                phase1bs={},
+            )
+
+    def _handle_phase1b(self, msg: MmPhase1b) -> None:
+        if not isinstance(self.state, _MmPhase1):
+            return
+        if msg.round != self.round:
+            self.logger.check_lt(msg.round, self.round)
+            return
+        phase1 = self.state
+        phase1.phase1bs[msg.acceptor_index] = msg
+        responded = set(phase1.phase1bs.keys())
+        for round in list(phase1.acceptor_to_rounds.get(msg.acceptor_index, ())):
+            if round in phase1.pending_rounds and phase1.previous_quorum_systems[
+                round
+            ].is_superset_of_read_quorum(responded):
+                phase1.pending_rounds.discard(round)
+        if phase1.pending_rounds:
+            return
+        votes = [
+            b for b in phase1.phase1bs.values() if b.vote_value is not None
+        ]
+        v = (
+            max(votes, key=lambda b: b.vote_round).vote_value
+            if votes
+            else phase1.v
+        )
+        for index in phase1.quorum_system.random_write_quorum():
+            self.chan(self.config.acceptor_addresses[index]).send(
+                MmPhase2a(round=self.round, value=v)
+            )
+        self.state = _MmPhase2(
+            v=v, quorum_system=phase1.quorum_system, phase2bs={}
+        )
+
+    def _handle_phase2b(self, msg: MmPhase2b) -> None:
+        if not isinstance(self.state, _MmPhase2):
+            return
+        if msg.round != self.round:
+            self.logger.check_lt(msg.round, self.round)
+            return
+        phase2 = self.state
+        phase2.phase2bs[msg.acceptor_index] = msg
+        if not phase2.quorum_system.is_write_quorum(set(phase2.phase2bs.keys())):
+            return
+        for client in self.clients:
+            self.chan(client).send(MmClientReply(chosen=phase2.v))
+        self.state = _MmChosen(v=phase2.v)
+
+
+class MmMatchmaker(Actor):
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.matchmaker_addresses)
+        self.config = config
+        self.index = config.matchmaker_addresses.index(address)
+        # round -> MmAcceptorGroup, insertion-ordered by round.
+        self.acceptor_groups: Dict[int, MmAcceptorGroup] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, MmMatchRequest):
+            self.logger.fatal(f"unknown matchmaker message {msg!r}")
+        if (
+            self.acceptor_groups
+            and msg.acceptor_group.round <= max(self.acceptor_groups)
+        ):
+            self.chan(src).send(
+                MmMatchmakerNack(round=max(self.acceptor_groups))
+            )
+            return
+        self.chan(src).send(
+            MmMatchReply(
+                round=msg.acceptor_group.round,
+                matchmaker_index=self.index,
+                acceptor_groups=tuple(
+                    self.acceptor_groups[r]
+                    for r in sorted(self.acceptor_groups)
+                ),
+            )
+        )
+        self.acceptor_groups[msg.acceptor_group.round] = msg.acceptor_group
+
+
+class MmAcceptor(Actor):
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = -1
+        self.vote_round = -1
+        self.vote_value: Optional[str] = None
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmPhase1a):
+            if msg.round < self.round:
+                self.chan(src).send(MmAcceptorNack(round=self.round))
+                return
+            self.round = msg.round
+            self.chan(src).send(
+                MmPhase1b(
+                    round=msg.round,
+                    acceptor_index=self.index,
+                    vote_round=self.vote_round,
+                    vote_value=self.vote_value,
+                )
+            )
+        elif isinstance(msg, MmPhase2a):
+            if msg.round < self.round:
+                self.chan(src).send(MmAcceptorNack(round=self.round))
+                return
+            self.round = msg.round
+            self.vote_round = msg.round
+            self.vote_value = msg.value
+            self.chan(src).send(
+                MmPhase2b(round=msg.round, acceptor_index=self.index)
+            )
+        else:
+            self.logger.fatal(f"unknown matchmaker acceptor message {msg!r}")
+
+
+class MmClient(Actor):
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.chosen: Optional[str] = None
+        self.promise: Optional[Promise] = None
+        self._request: Optional[MmClientRequest] = None
+        self.resend_timer = self.timer(
+            "resendClientRequest", resend_period, self._resend
+        )
+
+    def propose(self, v: str) -> Promise:
+        promise = Promise()
+        if self.chosen is not None:
+            promise.success(self.chosen)
+            return promise
+        if self.promise is not None:
+            promise.failure(RuntimeError("proposal already pending"))
+            return promise
+        self.promise = promise
+        self._request = MmClientRequest(v=v)
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))
+        ]
+        self.chan(leader).send(self._request)
+        self.resend_timer.start()
+        return promise
+
+    def _resend(self) -> None:
+        if self.chosen is None and self._request is not None:
+            leader = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))
+            ]
+            self.chan(leader).send(self._request)
+            self.resend_timer.start()
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, MmClientReply):
+            self.logger.fatal(f"unknown matchmaker client message {msg!r}")
+        if self.chosen is None:
+            self.chosen = msg.chosen
+            self.resend_timer.stop()
+            if self.promise is not None:
+                self.promise.success(self.chosen)
+                self.promise = None
+        else:
+            self.logger.check_eq(self.chosen, msg.chosen)

@@ -446,7 +446,10 @@ class BpAcceptor(Actor):
     def receive(self, src: Address, msg) -> None:
         if isinstance(msg, BpPhase1a):
             state = self.states.setdefault(msg.vertex_id, [-1, -1, None])
-            if msg.round <= state[0]:
+            # Strictly less only (Acceptor.scala:125): an EQUAL round must
+            # re-send the Phase1b, or a lost reply could never be recovered
+            # by the proposer's resend timer.
+            if msg.round < state[0]:
                 self.chan(src).send(
                     BpNack(vertex_id=msg.vertex_id, higher_round=state[0])
                 )

@@ -59,6 +59,7 @@ class VmClientReply:
 @wire.message
 @dataclasses.dataclass(frozen=True)
 class VmPhase1a:
+    owner: int  # revocation targets ONE server's slots in the range
     slot_start: int  # revocation runs phase 1 over a whole range
     slot_end: int
     round: int
@@ -213,6 +214,8 @@ class VmServer(Actor):
         self.phase2s: Dict[int, dict] = {}
         # Revocation (phase 1) state per (owner): range + votes.
         self.phase1s: Dict[int, dict] = {}
+        # Monotone lower bound on this server's revocation rounds.
+        self.recover_round = 0
         # Randomized revocation timers: each periodically checks the
         # heartbeat's alive set and revokes dead peers' slots
         # (Server.scala makeRevocationTimer).
@@ -345,8 +348,14 @@ class VmServer(Actor):
                 VmClientReply(command_id=cid, result=cached[1])
             )
             return
+        # Advance past slots already chosen (e.g. noop-filled by a
+        # revocation that falsely suspected us) or already in flight —
+        # proposing into a chosen slot would be silently black-holed
+        # (cf. Server.scala's check that the log doesn't contain nextSlot).
         slot = self.next_slot
-        self.next_slot += self.config.n
+        while self.log.get(slot) is not None or slot in self.phase2s:
+            slot += self.config.n
+        self.next_slot = slot + self.config.n
         self.phase2s[slot] = {"round": 0, "value": msg, "votes": set()}
         self._broadcast(VmPhase2a(slot=slot, round=0, value=msg))
 
@@ -414,12 +423,18 @@ class VmServer(Actor):
     # -- Revocation ----------------------------------------------------------
 
     def _revocation_round(self, min_round: int) -> int:
-        """A round > min_round owned by this server: rounds r > 0 with
-        r ≡ index+1 (mod n) belong to server `index`, so concurrent
-        revokers never collide (round 0 is the slot owner's)."""
+        """A FRESH round > min_round owned by this server: rounds r > 0
+        with r ≡ index+1 (mod n) belong to server `index`, so concurrent
+        revokers never collide (round 0 is the slot owner's). Rounds are
+        also monotone across this server's own revocations
+        (self.recover_round), so re-revoking the same peer never reuses a
+        round — reusing one would let stale Phase2bs from the previous
+        attempt count toward a different value's quorum."""
+        min_round = max(min_round, self.recover_round)
         r = self.index + 1
         while r <= min_round:
             r += self.config.n
+        self.recover_round = r
         return r
 
     def start_revocation(self, dead_index: int) -> None:
@@ -434,7 +449,9 @@ class VmServer(Actor):
     def _start_phase1(self, owner: int, start: int, end: int,
                       min_round: int) -> None:
         round = self._revocation_round(min_round)
-        phase1a = VmPhase1a(slot_start=start, slot_end=end, round=round)
+        phase1a = VmPhase1a(
+            owner=owner, slot_start=start, slot_end=end, round=round
+        )
 
         def resend() -> None:
             self._broadcast(phase1a)
@@ -459,6 +476,8 @@ class VmServer(Actor):
         chosen = []
         unchosen = []
         for slot in range(msg.slot_start, msg.slot_end):
+            if self.owner(slot) != msg.owner:
+                continue  # only the revoked server's slots are touched
             entry = self.log.get(slot)
             if entry is not None:
                 chosen.append((slot, entry[0]))
@@ -513,13 +532,16 @@ class VmServer(Actor):
         # not re-run phase 2 with a different value in the same round).
         del self.phase1s[phase1_key]
         phase1["resend"].stop()
-        # Safe value per slot: highest vote round's value, else noop.
+        # Safe value per slot: highest vote round's value, else noop. Only
+        # the revoked server's slots are proposed (phase1_key is the owner).
         best: Dict[int, Tuple[int, Optional[VmClientRequest]]] = {}
         for votes in phase1["votes"].values():
             for slot, vote_round, value in votes:
                 if slot not in best or vote_round > best[slot][0]:
                     best[slot] = (vote_round, value)
         for slot in range(phase1["start"], phase1["end"]):
+            if self.owner(slot) != phase1_key:
+                continue
             if self.log.get(slot) is not None:
                 continue
             value = best.get(slot, (-1, None))[1]

@@ -1,0 +1,144 @@
+"""Interactive step-through simulation driver — the capability analog of
+the reference's browser visualizations (``js/``: Vue + snap.svg apps over
+``JsTransport``), reimagined as a terminal/notebook tool.
+
+A :class:`Stepper` wraps any cluster built on a :class:`SimTransport` and
+exposes what the browser UI exposed (JsTransport.scala:175-298):
+
+  * inspect pending messages (decoded) and running timers;
+  * deliver / drop / duplicate any message, fire any timer;
+  * partition and unpartition actors;
+  * inspect live actor state;
+  * export the session's command history as a runnable regression test
+    (the analog of ``JsTransport.commandToUnitTest``).
+
+Use it interactively (``python -m frankenpaxos_tpu.viz.repl``), from a
+notebook, or programmatically in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from frankenpaxos_tpu.core import SimTransport, wire
+from frankenpaxos_tpu.core.sim_transport import (
+    DeliverMessage,
+    DropMessage,
+    DuplicateMessage,
+    PartitionActor,
+    TriggerTimer,
+    UnpartitionActor,
+)
+
+
+class Stepper:
+    def __init__(self, transport: SimTransport):
+        self.transport = transport
+
+    # -- Inspection ----------------------------------------------------------
+
+    def messages(self) -> List[str]:
+        """Numbered, decoded pending messages."""
+        out = []
+        for i, m in enumerate(self.transport.messages):
+            try:
+                decoded = wire.decode(m.data)
+            except Exception:  # noqa: BLE001 — raw transports
+                decoded = m.data
+            out.append(f"[{i}] {m.src} -> {m.dst}: {decoded!r}")
+        return out
+
+    def timers(self) -> List[str]:
+        return [
+            f"[{i}] {t.address}: {t.name()}"
+            for i, t in enumerate(self.transport.running_timers())
+        ]
+
+    def actors(self) -> List[str]:
+        return sorted(str(a) for a in self.transport.actors)
+
+    def state(self, address) -> Dict[str, Any]:
+        """A live actor's public state (the @JSExport fields analog)."""
+        actor = self._resolve_actor(address)
+        return {
+            k: v
+            for k, v in vars(actor).items()
+            if not k.startswith("_")
+            and k not in ("transport", "logger", "serializer")
+        }
+
+    def _resolve_actor(self, address):
+        for a, actor in self.transport.actors.items():
+            if a == address or str(a) == str(address):
+                return actor
+        raise KeyError(f"no actor at {address!r}; actors: {self.actors()}")
+
+    # -- Stepping ------------------------------------------------------------
+
+    def deliver(self, i: int) -> None:
+        self.transport.deliver_message(self.transport.messages[i])
+
+    def drop(self, i: int) -> None:
+        self.transport.drop_message(self.transport.messages[i])
+
+    def duplicate(self, i: int) -> None:
+        self.transport.duplicate_message(self.transport.messages[i])
+
+    def fire(self, i: int) -> None:
+        timer = self.transport.running_timers()[i]
+        self.transport.trigger_timer(timer.address, timer.name())
+
+    def partition(self, address) -> None:
+        self.transport.partition_actor(self._resolve_actor(address).address)
+
+    def unpartition(self, address) -> None:
+        self.transport.unpartition_actor(self._resolve_actor(address).address)
+
+    def deliver_all(self, max_steps: int = 100000) -> int:
+        steps = 0
+        while self.transport.messages and steps < max_steps:
+            self.transport.deliver_message(self.transport.messages[0])
+            steps += 1
+        return steps
+
+    # -- History export (JsTransport.scala:260-298) --------------------------
+
+    def export_test(self, test_name: str, setup_code: str) -> str:
+        """Generate a pytest function replaying the recorded history.
+        ``setup_code`` must define a variable ``t`` (the SimTransport) with
+        the same actors and seeds as this session."""
+        lines = [
+            f"def {test_name}():",
+        ]
+        for line in setup_code.strip().splitlines():
+            lines.append(f"    {line}")
+        lines.append("    from frankenpaxos_tpu.core import QueuedMessage, SimAddress")
+
+        def msg_expr(m) -> str:
+            return (
+                f"QueuedMessage(SimAddress({m.src.name!r}), "
+                f"SimAddress({m.dst.name!r}), {m.data!r})"
+            )
+
+        for cmd in self.transport.history:
+            if isinstance(cmd, DeliverMessage):
+                lines.append(f"    t.deliver_message({msg_expr(cmd.msg)})")
+            elif isinstance(cmd, TriggerTimer):
+                lines.append(
+                    f"    t.trigger_timer(SimAddress({cmd.address.name!r}), "
+                    f"{cmd.name!r})"
+                )
+            elif isinstance(cmd, DropMessage):
+                lines.append(f"    t.drop_message({msg_expr(cmd.msg)})")
+            elif isinstance(cmd, DuplicateMessage):
+                lines.append(f"    t.duplicate_message({msg_expr(cmd.msg)})")
+            elif isinstance(cmd, PartitionActor):
+                lines.append(
+                    f"    t.partition_actor(SimAddress({cmd.address.name!r}))"
+                )
+            elif isinstance(cmd, UnpartitionActor):
+                lines.append(
+                    f"    t.unpartition_actor(SimAddress({cmd.address.name!r}))"
+                )
+        lines.append("    # TODO: add assertions about the final state.")
+        return "\n".join(lines) + "\n"

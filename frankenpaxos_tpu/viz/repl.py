@@ -1,0 +1,99 @@
+"""Terminal REPL over a Stepper (the browser-viz analog):
+
+    python -m frankenpaxos_tpu.viz.repl [protocol]
+
+Commands: msgs | timers | actors | state <actor> | deliver <i> | drop <i> |
+dup <i> | fire <i> | partition <actor> | unpartition <actor> | run |
+export <test_name> | quit
+"""
+
+from __future__ import annotations
+
+import sys
+
+from frankenpaxos_tpu.viz import Stepper
+
+
+def build_cluster(protocol: str):
+    """Build a small demo cluster; returns (transport, description)."""
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+    from frankenpaxos_tpu.core.logger import LogLevel
+
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    if protocol == "paxos":
+        from frankenpaxos_tpu.protocols import paxos as px
+
+        config = px.PaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("leader0"), SimAddress("leader1")),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(3)
+            ),
+        )
+        for a in config.leader_addresses:
+            px.PaxosLeader(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            px.PaxosAcceptor(a, t, log(), config)
+        clients = [
+            px.PaxosClient(SimAddress(f"client{i}"), t, log(), config)
+            for i in range(2)
+        ]
+        clients[0].propose("apple")
+        clients[1].propose("banana")
+        return t, "paxos: 2 clients proposed 'apple' and 'banana'"
+    raise SystemExit(f"unknown protocol {protocol!r}; try: paxos")
+
+
+def main() -> None:
+    protocol = sys.argv[1] if len(sys.argv) > 1 else "paxos"
+    transport, description = build_cluster(protocol)
+    stepper = Stepper(transport)
+    print(description)
+    print("commands: msgs timers actors state deliver drop dup fire "
+          "partition unpartition run export quit")
+    while True:
+        try:
+            line = input("viz> ").strip()
+        except EOFError:
+            return
+        if not line:
+            continue
+        cmd, *args = line.split()
+        try:
+            if cmd == "quit":
+                return
+            elif cmd == "msgs":
+                print("\n".join(stepper.messages()) or "(none)")
+            elif cmd == "timers":
+                print("\n".join(stepper.timers()) or "(none)")
+            elif cmd == "actors":
+                print("\n".join(stepper.actors()))
+            elif cmd == "state":
+                for k, v in stepper.state(args[0]).items():
+                    print(f"  {k} = {v!r}")
+            elif cmd == "deliver":
+                stepper.deliver(int(args[0]))
+            elif cmd == "drop":
+                stepper.drop(int(args[0]))
+            elif cmd == "dup":
+                stepper.duplicate(int(args[0]))
+            elif cmd == "fire":
+                stepper.fire(int(args[0]))
+            elif cmd == "partition":
+                stepper.partition(args[0])
+            elif cmd == "unpartition":
+                stepper.unpartition(args[0])
+            elif cmd == "run":
+                print(f"delivered {stepper.deliver_all()} messages")
+            elif cmd == "export":
+                name = args[0] if args else "test_replay"
+                print(stepper.export_test(name, "# setup: rebuild the cluster here\nt = ..."))
+            else:
+                print(f"unknown command {cmd!r}")
+        except (IndexError, KeyError, ValueError) as e:
+            print(f"error: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Matchmaker Paxos sim tests (the analog of
+shared/src/test/scala/matchmakerpaxos)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import matchmakerpaxos as mm
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+
+
+def make(f=1, num_clients=2, num_acceptors=None, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    num_acceptors = num_acceptors or (2 * f + 2)  # spare acceptors to rotate
+    config = mm.MatchmakerPaxosConfig(
+        f=f,
+        client_addresses=tuple(
+            SimAddress(f"client{i}") for i in range(num_clients)
+        ),
+        leader_addresses=tuple(SimAddress(f"leader{i}") for i in range(f + 1)),
+        matchmaker_addresses=tuple(
+            SimAddress(f"matchmaker{i}") for i in range(2 * f + 1)
+        ),
+        acceptor_addresses=tuple(
+            SimAddress(f"acceptor{i}") for i in range(num_acceptors)
+        ),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        mm.MmLeader(a, t, log(), config, seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    matchmakers = [
+        mm.MmMatchmaker(a, t, log(), config)
+        for a in config.matchmaker_addresses
+    ]
+    acceptors = [
+        mm.MmAcceptor(a, t, log(), config) for a in config.acceptor_addresses
+    ]
+    clients = [
+        mm.MmClient(a, t, log(), config, seed=seed + 40 + i)
+        for i, a in enumerate(config.client_addresses)
+    ]
+    return t, config, leaders, matchmakers, acceptors, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_matchmaker_single_proposal():
+    t, config, leaders, matchmakers, acceptors, clients = make()
+    p = clients[0].propose("apple")
+    drain(t)
+    assert p.done and p.result() == "apple"
+
+
+def test_matchmaker_contending_leaders_choose_one_value():
+    """Two clients through two leaders: matchmaker nacks + acceptor nacks
+    retry until one value is chosen, consistently."""
+    t, config, leaders, matchmakers, acceptors, clients = make(seed=3)
+    p1 = clients[0].propose("a")
+    p2 = clients[1].propose("b")
+    rng = random.Random(2)
+    for _ in range(3000):
+        cmd = t.generate_command(rng)
+        if cmd is None:
+            break
+        t.run_command(cmd, record=False)
+    drain(t)
+    for _ in range(6):
+        if p1.done and p2.done:
+            break
+        for timer in list(t.running_timers()):
+            t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert p1.done and p2.done
+    assert p1.result() == p2.result()
+
+
+def test_matchmaker_configs_rotate_across_rounds():
+    """Each round registers a fresh quorum system with the matchmakers."""
+    t, config, leaders, matchmakers, acceptors, clients = make(seed=5)
+    p = clients[0].propose("x")
+    drain(t)
+    assert p.done
+    rounds_registered = {
+        r for m in matchmakers for r in m.acceptor_groups.keys()
+    }
+    assert len(rounds_registered) >= 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+
+
+class SimulatedMatchmakerPaxos(SimulatedSystem):
+    """Invariant: at most one value ever chosen (consensus), and chosen
+    values never change."""
+
+    def __init__(self, f=1):
+        self.f = f
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        t, config, leaders, matchmakers, acceptors, clients = system
+        chosen_leaders = tuple(
+            l.state.v if isinstance(l.state, mm._MmChosen) else None
+            for l in leaders
+        )
+        return tuple(c.chosen for c in clients) + chosen_leaders
+
+    def generate_command(self, system, rng):
+        t, config, leaders, matchmakers, acceptors, clients = system
+        ops = [
+            (1, Propose(i))
+            for i, c in enumerate(clients)
+            if c.promise is None and c.chosen is None
+        ]
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t, config, leaders, matchmakers, acceptors, clients = system
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(f"v{command.client_index}")
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        chosen = {v for v in state if v is not None}
+        if len(chosen) > 1:
+            return f"multiple values chosen: {chosen}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if o is not None and n != o:
+                return f"chosen value changed: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_matchmaker_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedMatchmakerPaxos(f), run_length=120, num_runs=20, seed=f
+    )
+    assert bad is None, f"\n{bad}"

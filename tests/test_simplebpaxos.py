@@ -242,3 +242,26 @@ def test_simplebpaxos_lost_reply_retry_gets_cached_reply():
     assert p.done, "retry after lost reply never completed"
     execs_after = [len(r.state_machine.executed_commands) for r in replicas]
     assert execs_after == execs_before, "command was re-executed on retry"
+
+
+def test_simplebpaxos_lost_phase1b_recovered_by_resend():
+    """An equal-round Phase1a resend must get a fresh Phase1b, not a nack
+    (review regression: lost Phase1bs stalled recovery forever)."""
+    t, config, leaders, proposers, deps, acceptors, replicas, clients = make(seed=17)
+    # Proposer 1 recovers a stuck vertex owned by leader 0 => phase 1.
+    vertex = (0, 0)
+    proposers[1]._propose_impl(vertex, None, ())
+    # Drop ALL Phase1bs, deliver everything else.
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), bp.BpPhase1b):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert vertex in proposers[1].states
+    # Fire the resendPhase1a timer; acceptors must answer again.
+    t.trigger_timer(proposers[1].address, f"resendPhase1a{vertex}")
+    drain(t)
+    from frankenpaxos_tpu.protocols.simplebpaxos import _BpChosen
+
+    assert isinstance(proposers[1].states[vertex], _BpChosen)

@@ -208,3 +208,60 @@ def test_mencius_auto_revocation_via_heartbeat():
         t.trigger_timer(config.server_addresses[2], "revoke1")
         drain(t)
     assert p1.done
+
+
+def test_mencius_repeated_revocation_uses_fresh_rounds():
+    """Re-revoking the same peer must use a strictly larger round
+    (review regression: round reuse let stale Phase2bs cross proposals)."""
+    t, config, servers, clients = make(seed=11)
+    t.partition_actor(config.server_addresses[1])
+    servers[2].start_revocation(1)
+    r1 = servers[2].recover_round
+    drain(t)
+    servers[2].start_revocation(1)
+    r2 = servers[2].recover_round
+    assert r2 > r1
+    drain(t)
+
+
+def test_mencius_false_revocation_does_not_stomp_inflight_writes():
+    """A (false) revocation of server 1 proposes ONLY into server 1's
+    slots, so server 0's concurrent in-flight write survives with its
+    value, and writes through the falsely-suspected server itself advance
+    past their noop-filled slots (review regressions)."""
+    t, config, servers, clients = make(seed=12)
+
+    # Server 0 has an IN-FLIGHT write: Phase2as delivered, 2bs pending.
+    class _S0:
+        def randrange(self, n):
+            return 0
+
+    clients[0].rng = _S0()
+    p0 = clients[0].propose(0, b"precious")
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == config.server_addresses[0] and m.src != clients[0].address:
+            break  # hold the 2bs back
+        t.deliver_message(m)
+
+    # Concurrent false revocation of server 1 (everyone actually alive).
+    servers[2].start_revocation(1)
+    drain(t)
+    # The in-flight write survives with its VALUE (the revocation never
+    # proposed into server 0's slots).
+    assert p0.done
+    logs = {tuple(s.state_machine.get()) for s in servers}
+    assert len(logs) == 1
+    assert b"precious" in next(iter(logs))
+
+    # Writes through the falsely-suspected server still work: its own
+    # slots were noop-filled up to beta, and the request must advance past
+    # them rather than black-holing.
+    class _S1:
+        def randrange(self, n):
+            return 1
+
+    clients[1].rng = _S1()
+    p1 = clients[1].propose(0, b"still-alive")
+    drain(t)
+    assert p1.done
