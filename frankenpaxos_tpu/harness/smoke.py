@@ -401,6 +401,66 @@ def smoke_vanillamencius(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_mencius(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import mencius as mnc
+    from frankenpaxos_tpu.protocols import multipaxos as mpx
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = mnc.MenciusConfig(
+            f=1,
+            batcher_addresses=(),
+            leader_groups=tuple(
+                tuple(SimAddress(f"mnl_{g}_{m}") for m in range(2))
+                for g in range(3)
+            ),
+            leader_election_groups=tuple(
+                tuple(SimAddress(f"mne_{g}_{m}") for m in range(2))
+                for g in range(3)
+            ),
+            proxy_leader_addresses=(SimAddress("mnp0"), SimAddress("mnp1")),
+            acceptor_addresses=tuple(
+                tuple(SimAddress(f"mna_{g}_{i}") for i in range(3))
+                for g in range(2)
+            ),
+            replica_addresses=(SimAddress("mnr0"), SimAddress("mnr1")),
+            proxy_replica_addresses=(),
+        )
+        leaders = [
+            mnc.MenciusLeader(a, t, log(), config, seed=i)
+            for i, a in enumerate(config.leader_addresses)
+        ]
+        for i, a in enumerate(config.proxy_leader_addresses):
+            mpx.ProxyLeader(a, t, log(), config, seed=10 + i)
+        for group in config.acceptor_addresses:
+            for a in group:
+                mnc.MenciusAcceptor(a, t, log(), config)
+        for i, a in enumerate(config.replica_addresses):
+            mpx.Replica(a, t, log(), ReadableAppendLog(), config, seed=20 + i)
+        clients = [
+            mnc.MenciusClient(SimAddress(f"mnc{i}"), t, log(), config, seed=40 + i)
+            for i in range(2)
+        ]
+        return clients, leaders
+
+    def operate(t, ctx):
+        clients, leaders = ctx
+        promises = [
+            c.write(p, f"c{i}p{p}".encode())
+            for i, c in enumerate(clients)
+            for p in range(2)
+        ]
+        _drain(t)
+        for leader in leaders:
+            leader._broadcast_watermark()
+        return promises
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_matchmakerpaxos(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -466,6 +526,7 @@ SMOKES = {
     "epaxos": smoke_epaxos,
     "simplebpaxos": smoke_simplebpaxos,
     "vanillamencius": smoke_vanillamencius,
+    "mencius": smoke_mencius,
     "matchmakerpaxos": smoke_matchmakerpaxos,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,

@@ -1,0 +1,527 @@
+"""Compartmentalized Mencius (reference ``mencius/``: Client, Batcher,
+Leader, ProxyLeader, Acceptor, Replica, ProxyReplica).
+
+Mencius is MultiPaxos with the log striped round-robin across MULTIPLE
+active leaders: leader i owns global slots ≡ i (mod numLeaders), so
+every leader proposes concurrently without contention. Two Mencius-
+specific mechanisms (``mencius/Leader.scala`` options doc):
+
+  * lagging leaders keep the global log executable by noop-filling their
+    owned slots up to the highest slot they observe from other leaders —
+    leaders broadcast HighWatermark messages every ``send_watermark_every_n``
+    proposals, and a leader behind a watermark proposes noop ranges;
+  * per-leader-index failover: each leader index has a co-located
+    election; a replacement leader bumps the round FOR ITS INDEX ONLY
+    (acceptors track one round per leader index, so other leaders' round-0
+    proposals are unaffected) and phase-1-repairs its owned slots.
+
+The compartmentalized machinery is shared with MultiPaxos: this module
+reuses ``multipaxos``'s ProxyLeader, Replica, ProxyReplica, Batcher
+message types and role implementations via a structurally-compatible
+config (same fields; slots route to acceptor groups by ``slot % G`` and
+Chosen fan-out is identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.election import basic as election
+from frankenpaxos_tpu.protocols.multipaxos.config import DistributionScheme
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ChosenWatermark,
+    ClientRequest,
+    ClientRequestBatch,
+    ClientReply,
+    Command,
+    CommandBatch,
+    CommandBatchOrNoop,
+    CommandId,
+    Nack,
+    Phase1a,
+    Phase1b,
+    Phase1bSlotInfo,
+    Phase2a,
+    Phase2b,
+    Recover,
+)
+from frankenpaxos_tpu.core.promise import Promise
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MenciusHighWatermark:
+    """Leader ``leader_index`` has proposed up to (exclusive) ``slot``."""
+
+    leader_index: int
+    slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MenciusConfig:
+    """Structurally compatible with multipaxos.Config so ProxyLeader,
+    Replica, and ProxyReplica work unchanged."""
+
+    f: int
+    batcher_addresses: tuple
+    # Each log stripe (leader index) is a GROUP of f+1 leader processes
+    # with its own election; the elected member actively runs the stripe
+    # (mencius/Config.scala: leaderAddresses: Seq[Seq[Address]]).
+    leader_groups: tuple  # of tuples of addresses
+    leader_election_groups: tuple  # of tuples of addresses
+    proxy_leader_addresses: tuple
+    acceptor_addresses: tuple  # groups of 2f+1; slot % G routing
+    replica_addresses: tuple
+    proxy_replica_addresses: tuple
+    flexible: bool = False  # grid quorums are a MultiPaxos-only feature
+    distribution_scheme: DistributionScheme = DistributionScheme.HASH
+
+    @property
+    def num_batchers(self) -> int:
+        return len(self.batcher_addresses)
+
+    @property
+    def num_leaders(self) -> int:
+        """Number of log stripes (leader groups)."""
+        return len(self.leader_groups)
+
+    @property
+    def leader_addresses(self) -> tuple:
+        """Flattened leader processes — the broadcast targets for the
+        reused MultiPaxos Replica/ProxyReplica (ChosenWatermark/Recover
+        go to every leader process; each filters by stripe ownership)."""
+        return tuple(a for group in self.leader_groups for a in group)
+
+    @property
+    def num_proxy_leaders(self) -> int:
+        return len(self.proxy_leader_addresses)
+
+    @property
+    def num_acceptor_groups(self) -> int:
+        return len(self.acceptor_addresses)
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_addresses)
+
+    @property
+    def num_proxy_replicas(self) -> int:
+        return len(self.proxy_replica_addresses)
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if self.flexible:
+            raise ValueError("mencius uses round-robin groups, not grids")
+        if self.num_leaders < 1:
+            raise ValueError("need at least one leader group")
+        if len(self.leader_election_groups) != self.num_leaders:
+            raise ValueError("one election group per leader group")
+        for lg, eg in zip(self.leader_groups, self.leader_election_groups):
+            if len(lg) != len(eg):
+                raise ValueError("election group size must match leader group")
+        if self.num_proxy_leaders < 1:
+            raise ValueError("need at least one proxy leader")
+        for group in self.acceptor_addresses:
+            if len(group) != 2 * self.f + 1:
+                raise ValueError("acceptor groups must be 2f+1")
+        if self.num_replicas < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+@dataclasses.dataclass(frozen=True)
+class MenciusLeaderOptions:
+    send_watermark_every_n: int = 4
+    resend_phase1as_period: float = 5.0
+    election_options: election.ElectionOptions = election.ElectionOptions()
+
+
+_INACTIVE = "inactive"
+
+
+@dataclasses.dataclass
+class _MnPhase1:
+    phase1bs: List[Dict[int, Phase1b]]  # per acceptor group
+    pending_batches: List[ClientRequestBatch]
+    resend: object
+
+
+_PHASE2 = "phase2"
+
+
+class MenciusLeader(Actor):
+    """One member of the leader GROUP that owns one log stripe. Within a
+    stripe, round r belongs to group member r % group_size; the group's
+    election picks the active member (mencius/Leader.scala:244-262), and a
+    replacement bumps the stripe's round and phase-1-repairs its slots."""
+
+    def __init__(self, address, transport, logger, config: MenciusConfig,
+                 options: MenciusLeaderOptions = MenciusLeaderOptions(),
+                 collectors=None, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.group_index = next(
+            i for i, g in enumerate(config.leader_groups) if address in g
+        )
+        self.index = config.leader_groups[self.group_index].index(address)
+        self.group_size = len(config.leader_groups[self.group_index])
+        self.round = 0
+        # The stripe's owned slots stride num_leaders (= num stripes).
+        self.next_slot = self.group_index
+        self.chosen_watermark = 0
+        self._proposals_since_watermark = 0
+        self._current_proxy_leader = 0
+        # Highest owned slot a replica asked us to recover; phase-1 repair
+        # must propose (noop) at least up to here even if no votes exist.
+        self._recover_slot = -1
+        # The group's election decides which member actively runs the
+        # stripe (the analog of Leader.scala:250-262).
+        self.election = election.Participant(
+            config.leader_election_groups[self.group_index][self.index],
+            transport,
+            logger,
+            config.leader_election_groups[self.group_index],
+            initial_leader_index=0,
+            options=options.election_options,
+            seed=seed,
+        )
+        self.election.register(
+            lambda leader_index: self.leader_change(leader_index == self.index)
+        )
+        self.state = _PHASE2 if self.index == 0 else _INACTIVE
+
+    def _next_owned_round(self, min_round: int) -> int:
+        """The smallest round > min_round owned by this group member:
+        exactly ClassicRoundRobin over the group (round r belongs to
+        member r % group_size)."""
+        from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+
+        return ClassicRoundRobin(self.group_size).next_classic_round(
+            self.index, min_round
+        )
+
+    def leader_change(self, is_new_leader: bool) -> None:
+        if is_new_leader:
+            self.round = self._next_owned_round(self.round)
+            self._start_phase1()
+        else:
+            if isinstance(self.state, _MnPhase1):
+                self.state.resend.stop()
+            self.state = _INACTIVE
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _proxy_leader(self) -> Address:
+        if self.config.distribution_scheme == DistributionScheme.HASH:
+            addr = self.config.proxy_leader_addresses[self._current_proxy_leader]
+            self._current_proxy_leader = (
+                self._current_proxy_leader + 1
+            ) % self.config.num_proxy_leaders
+            return addr
+        return self.config.proxy_leader_addresses[
+            self.index % self.config.num_proxy_leaders
+        ]
+
+    def _propose(self, slot: int, value: CommandBatchOrNoop) -> None:
+        self.chan(self._proxy_leader()).send(
+            Phase2a(slot=slot, round=self.round, value=value)
+        )
+
+    def _broadcast_watermark(self) -> None:
+        watermark = MenciusHighWatermark(
+            leader_index=self.group_index, slot=self.next_slot
+        )
+        for i, group in enumerate(self.config.leader_groups):
+            if i != self.group_index:
+                for leader in group:
+                    self.chan(leader).send(watermark)
+
+    def _skip_to(self, observed_slot: int) -> None:
+        """Noop-fill our owned slots below another leader's watermark."""
+        while self.next_slot < observed_slot:
+            self._propose(self.next_slot, CommandBatchOrNoop.noop())
+            self.next_slot += self.config.num_leaders
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, (ClientRequest, ClientRequestBatch)):
+            if self.state == _INACTIVE:
+                # Forward to the member the election currently favors.
+                active = self.config.leader_groups[self.group_index][
+                    self.election.leader_index % self.group_size
+                ]
+                self.chan(active).send(msg)
+                return
+            if isinstance(msg, ClientRequest):
+                msg = ClientRequestBatch(CommandBatch((msg.command,)))
+            self._handle_batch(msg)
+        elif isinstance(msg, MenciusHighWatermark):
+            if self.state == _PHASE2:
+                self._skip_to(msg.slot)
+        elif isinstance(msg, Phase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, Nack):
+            if msg.round > self.round and self.state != _INACTIVE:
+                self.round = self._next_owned_round(msg.round)
+                self._start_phase1()
+        elif isinstance(msg, ChosenWatermark):
+            self.chosen_watermark = max(self.chosen_watermark, msg.slot)
+        elif isinstance(msg, Recover):
+            # A replica is stuck at msg.slot; if our stripe owns it, re-run
+            # phase 1 covering it; otherwise noop-fill our residue past it.
+            if self.state == _PHASE2:
+                if msg.slot % self.config.num_leaders == self.group_index:
+                    self._recover_slot = max(self._recover_slot, msg.slot)
+                    self.round = self._next_owned_round(self.round)
+                    self._start_phase1()
+                else:
+                    self._skip_to(msg.slot + 1)
+        else:
+            self.logger.fatal(f"unknown mencius leader message {msg!r}")
+
+    def _handle_batch(self, batch: ClientRequestBatch) -> None:
+        if isinstance(self.state, _MnPhase1):
+            self.state.pending_batches.append(batch)
+            return
+        slot = self.next_slot
+        self.next_slot += self.config.num_leaders
+        self._propose(slot, CommandBatchOrNoop(batch.batch))
+        self._proposals_since_watermark += 1
+        if self._proposals_since_watermark >= self.options.send_watermark_every_n:
+            self._broadcast_watermark()
+            self._proposals_since_watermark = 0
+
+    # -- Failover: phase 1 over OWNED slots ----------------------------------
+
+    def _start_phase1(self) -> None:
+        # A phase 1 may replace a still-running phase 1 (nack-driven round
+        # bump): stop its resend timer or it re-broadcasts the stale-round
+        # Phase1a forever.
+        if isinstance(self.state, _MnPhase1):
+            self.state.resend.stop()
+        phase1a = Phase1a(round=self.round, chosen_watermark=self.chosen_watermark)
+
+        def resend() -> None:
+            for group in self.config.acceptor_addresses:
+                for a in group:
+                    self.chan(a).send(phase1a)
+            timer.start()
+
+        timer = self.timer(
+            "resendPhase1as", self.options.resend_phase1as_period, resend
+        )
+        timer.start()
+        for group in self.config.acceptor_addresses:
+            quorum = self.rng.sample(range(len(group)), self.config.f + 1)
+            for i in quorum:
+                self.chan(group[i]).send(phase1a)
+        self.state = _MnPhase1(
+            phase1bs=[{} for _ in range(self.config.num_acceptor_groups)],
+            pending_batches=[],
+            resend=timer,
+        )
+
+    def _handle_phase1b(self, phase1b: Phase1b) -> None:
+        if not isinstance(self.state, _MnPhase1):
+            return
+        if phase1b.round != self.round:
+            return
+        phase1 = self.state
+        phase1.phase1bs[phase1b.group_index][phase1b.acceptor_index] = phase1b
+        if any(len(g) < self.config.f + 1 for g in phase1.phase1bs):
+            return
+        # Repair OWNED slots only: max voted owned slot across groups.
+        owned = [
+            info
+            for group in phase1.phase1bs
+            for b in group.values()
+            for info in b.info
+            if info.slot % self.config.num_leaders == self.group_index
+        ]
+        max_slot = max(
+            (info.slot for info in owned), default=-1
+        )
+        max_slot = max(max_slot, self._recover_slot)
+        start = self.chosen_watermark + (
+            (self.group_index - self.chosen_watermark) % self.config.num_leaders
+        )
+        for slot in range(start, max_slot + 1, self.config.num_leaders):
+            infos = [i for i in owned if i.slot == slot]
+            value = (
+                max(infos, key=lambda i: i.vote_round).vote_value
+                if infos
+                else CommandBatchOrNoop.noop()
+            )
+            self._propose(slot, value)
+        # Advance next_slot just past the repaired range, staying on this
+        # stripe's residue: with no votes at all, the next proposal is the
+        # FIRST owned slot at the watermark (`start`), not a stride past it
+        # (a raw max_slot+n would both drift off-residue and leave a
+        # permanent hole at `start`).
+        if max_slot < start:
+            candidate = start
+        else:
+            candidate = max_slot + self.config.num_leaders
+        self.next_slot = max(self.next_slot, candidate)
+        phase1.resend.stop()
+        pending = phase1.pending_batches
+        self.state = _PHASE2
+        for batch in pending:
+            self._handle_batch(batch)
+
+
+class MenciusAcceptor(Actor):
+    """Acceptor with ONE round per leader index: leader i's failover bumps
+    rounds[i] without disturbing other leaders' round-0 fast path."""
+
+    def __init__(self, address, transport, logger, config: MenciusConfig,
+                 collectors=None):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.group_index = next(
+            i for i, g in enumerate(config.acceptor_addresses) if address in g
+        )
+        self.index = config.acceptor_addresses[self.group_index].index(address)
+        self.rounds: List[int] = [-1] * config.num_leaders
+        # slot -> (vote_round, value)
+        self.votes: Dict[int, Tuple[int, CommandBatchOrNoop]] = {}
+        self.max_voted_slot = -1
+
+    def _owner(self, slot: int) -> int:
+        return slot % self.config.num_leaders
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, Phase2a):
+            owner = self._owner(msg.slot)
+            if msg.round < self.rounds[owner]:
+                # Nack the slot's OWNER group (src is a proxy leader,
+                # which doesn't handle nacks — cf. Acceptor.scala:184-199);
+                # inactive members ignore stale rounds.
+                for leader in self.config.leader_groups[owner]:
+                    self.chan(leader).send(Nack(round=self.rounds[owner]))
+                return
+            self.rounds[owner] = msg.round
+            self.votes[msg.slot] = (msg.round, msg.value)
+            self.max_voted_slot = max(self.max_voted_slot, msg.slot)
+            self.chan(src).send(
+                Phase2b(
+                    group_index=self.group_index,
+                    acceptor_index=self.index,
+                    slot=msg.slot,
+                    round=msg.round,
+                )
+            )
+        elif isinstance(msg, Phase1a):
+            # The sender is a (new) leader for ITS index; promise that
+            # index's round and report votes for its owned slots.
+            owner = next(
+                (
+                    i
+                    for i, g in enumerate(self.config.leader_groups)
+                    if src in g
+                ),
+                None,
+            )
+            if owner is None:
+                return
+            if msg.round < self.rounds[owner]:
+                self.chan(src).send(Nack(round=self.rounds[owner]))
+                return
+            self.rounds[owner] = msg.round
+            info = tuple(
+                Phase1bSlotInfo(slot=slot, vote_round=vr, vote_value=value)
+                for slot, (vr, value) in sorted(self.votes.items())
+                if slot >= msg.chosen_watermark and self._owner(slot) == owner
+            )
+            self.chan(src).send(
+                Phase1b(
+                    group_index=self.group_index,
+                    acceptor_index=self.index,
+                    round=msg.round,
+                    info=info,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown mencius acceptor message {msg!r}")
+
+
+@dataclasses.dataclass
+class _MnPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class MenciusClient(Actor):
+    """Client spreading writes across the active leaders (each leader owns
+    its own slot residue, so any leader serves any write)."""
+
+    def __init__(self, address, transport, logger, config: MenciusConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _MnPending] = {}
+
+    def _target(self) -> Address:
+        if self.config.num_batchers > 0:
+            return self.config.batcher_addresses[
+                self.rng.randrange(self.config.num_batchers)
+            ]
+        group = self.config.leader_groups[
+            self.rng.randrange(self.config.num_leaders)
+        ]
+        return group[self.rng.randrange(len(group))]
+
+    def write(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = ClientRequest(
+            Command(
+                command_id=CommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=id,
+                ),
+                command=command,
+            )
+        )
+        self.chan(self._target()).send(request)
+
+        def resend() -> None:
+            self.chan(self._target()).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"resendMencius[{pseudonym};{id}]", self.resend_period, resend
+        )
+        timer.start()
+        self.pending[pseudonym] = _MnPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, ClientReply):
+            self.logger.fatal(f"unknown mencius client message {msg!r}")
+        pseudonym = msg.command_id.client_pseudonym
+        pending = self.pending.get(pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[pseudonym]
+        pending.result.success(msg.result)

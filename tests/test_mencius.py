@@ -1,0 +1,347 @@
+"""Compartmentalized Mencius sim tests (the analog of
+shared/src/test/scala/mencius), reusing the MultiPaxos ProxyLeader,
+Replica, and ProxyReplica roles."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import mencius as mn
+from frankenpaxos_tpu.protocols import multipaxos as mp
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+class _PickGroup:
+    """rng stub: first randrange picks the leader group, second the member
+    (always the initially-active member 0)."""
+
+    def __init__(self, group):
+        self.group = group
+        self._calls = 0
+
+    def randrange(self, n):
+        self._calls += 1
+        return self.group if self._calls % 2 == 1 else 0
+
+
+def make(f=1, num_leaders=3, num_clients=2, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    config = mn.MenciusConfig(
+        f=f,
+        batcher_addresses=(),
+        leader_groups=tuple(
+            tuple(SimAddress(f"leader_{g}_{m}") for m in range(f + 1))
+            for g in range(num_leaders)
+        ),
+        leader_election_groups=tuple(
+            tuple(SimAddress(f"election_{g}_{m}") for m in range(f + 1))
+            for g in range(num_leaders)
+        ),
+        proxy_leader_addresses=tuple(
+            SimAddress(f"proxy_leader{i}") for i in range(f + 1)
+        ),
+        acceptor_addresses=tuple(
+            tuple(SimAddress(f"acceptor_{g}_{i}") for i in range(2 * f + 1))
+            for g in range(2)
+        ),
+        replica_addresses=tuple(SimAddress(f"replica{i}") for i in range(f + 1)),
+        proxy_replica_addresses=(),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        mn.MenciusLeader(a, t, log(), config, seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    # leaders[2 * g] is group g's initially-active member; leaders[2*g+1]
+    # its standby (f=1 -> group size 2).
+    active = [leaders[i] for i in range(0, len(leaders), f + 1)]
+    proxy_leaders = [
+        mp.ProxyLeader(a, t, log(), config, seed=seed + 10 + i)
+        for i, a in enumerate(config.proxy_leader_addresses)
+    ]
+    acceptors = [
+        mn.MenciusAcceptor(a, t, log(), config)
+        for group in config.acceptor_addresses
+        for a in group
+    ]
+    replicas = [
+        mp.Replica(
+            a, t, log(), ReadableAppendLog(), config,
+            mp.ReplicaOptions(send_chosen_watermark_every_n_entries=5),
+            seed=seed + 30 + i,
+        )
+        for i, a in enumerate(config.replica_addresses)
+    ]
+    clients = [
+        mn.MenciusClient(
+            SimAddress(f"client{i}"), t, log(), config, seed=seed + 50 + i
+        )
+        for i in range(num_clients)
+    ]
+    return t, config, active, proxy_leaders, acceptors, replicas, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_mencius_single_write():
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make()
+    p = clients[0].write(0, b"hello")
+    drain(t)
+    # The write is chosen at some leader's first owned slot; replicas may
+    # need earlier residues noop-filled before executing. The proposing
+    # leader broadcasts watermarks only every N proposals, so nudge via
+    # another write if needed.
+    if not p.done:
+        p2 = clients[1].write(0, b"second")
+        drain(t)
+    assert p.done
+
+
+def test_mencius_multi_leader_interleaving_converges():
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=2)
+    promises = []
+    for round_ in range(6):
+        for i, c in enumerate(clients):
+            promises.append(c.write(round_, f"r{round_}c{i}".encode()))
+        drain(t)
+    # Force watermark broadcasts + skips so stragglers fill.
+    for leader in leaders:
+        leader._broadcast_watermark()
+    drain(t)
+    done = sum(p.done for p in promises)
+    assert done == len(promises), f"{done}/{len(promises)}"
+    logs = {tuple(r.state_machine.get()) for r in replicas}
+    assert len(logs) == 1, "replica logs diverged"
+    assert len([e for e in next(iter(logs))]) == len(promises)
+
+
+def test_mencius_skips_unblock_lagging_leaders():
+    """All writes via leader 0: its watermarks make leaders 1 and 2 skip,
+    so the global log executes."""
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=3)
+
+    clients[0].rng = _PickGroup(0)
+    promises = [clients[0].write(i, f"w{i}".encode()) for i in range(8)]
+    drain(t)
+    assert all(p.done for p in promises)
+    logs = {tuple(r.state_machine.get()) for r in replicas}
+    assert len(logs) == 1
+
+
+def test_mencius_leader_failover_phase1_repairs_owned_slots():
+    """Leader 1 dies mid-stream; a Recover drives its round bump + phase 1
+    repair of its residue, and other leaders' round-0 path is unaffected."""
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=4)
+
+    clients[0].rng = _PickGroup(1)
+    p1 = clients[0].write(0, b"doomed?")
+    # Deliver the request + phase2as, drop the 2bs so the slot hangs.
+    t.deliver_message(t.messages[0])  # request -> leader1
+    while t.messages:
+        m = t.messages[0]
+        from frankenpaxos_tpu.core import wire
+        from frankenpaxos_tpu.protocols.multipaxos.messages import Phase2b
+
+        if isinstance(wire.decode(m.data), Phase2b):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    # Recovery is driven end-to-end: the client resends (leader 1 proposes
+    # the command again at a later slot), replicas now see a hole and their
+    # recover timers fire Recover at the executed watermark; non-owner
+    # leaders skip past it and the owner re-runs phase 1, repairing the
+    # stuck slot with its original vote. Repeat until unblocked.
+    t.trigger_timer(clients[0].address, "resendMencius[0;0]")
+    drain(t)
+    for _ in range(8):
+        if p1.done:
+            break
+        for r in replicas:
+            t.trigger_timer(r.address, "recover")
+        drain(t)
+    assert p1.done  # repaired with the original value
+    # Other leaders still work in round 0.
+    clients[1].rng = _PickGroup(2)
+    p2 = clients[1].write(0, b"unaffected")
+    drain(t)
+    for leader in leaders:
+        leader._broadcast_watermark()
+    drain(t)
+    assert p2.done
+    logs = {tuple(r.state_machine.get()) for r in replicas}
+    assert len(logs) == 1
+    final = next(iter(logs))
+    assert b"doomed?" in final and b"unaffected" in final
+
+
+@dataclasses.dataclass(frozen=True)
+class Write:
+    client_index: int
+    pseudonym: int
+    value: bytes
+
+
+class SimulatedCompartmentalizedMencius(SimulatedSystem):
+    def __init__(self, f=1):
+        self.f = f
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        replicas = system[5]
+        return tuple(tuple(r.state_machine.get()) for r in replicas)
+
+    def generate_command(self, system, rng):
+        t = system[0]
+        clients = system[6]
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Write(i, pseudonym, f"v{rng.randrange(50)}".encode()))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t = system[0]
+        clients = system[6]
+        if isinstance(command, Write):
+            clients[command.client_index].write(
+                command.pseudonym, command.value
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"replica logs not prefix-compatible: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"replica log shrank or changed"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_mencius_compartmentalized_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedCompartmentalizedMencius(f), run_length=120, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_mencius_standby_takes_over_dead_stripe():
+    """The active member of group 2 dies entirely; its standby wins the
+    group election, phase-1-repairs the stripe, and the cluster keeps
+    committing (the reference's per-group leaderChange)."""
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=6)
+    # Warm up: one write through each stripe, then converge.
+    for g in range(3):
+        clients[0].rng = _PickGroup(g)
+        clients[0].write(g, f"warm{g}".encode())
+        drain(t)
+    for leader in leaders:
+        leader._broadcast_watermark()
+    drain(t)
+
+    # Kill group 2's ACTIVE member and its election participant.
+    dead_leader = config.leader_groups[2][0]
+    dead_election = config.leader_election_groups[2][0]
+    t.partition_actor(dead_leader)
+    t.partition_actor(dead_election)
+
+    # The standby's election times out and it becomes the stripe leader.
+    standby_election = config.leader_election_groups[2][1]
+    t.trigger_timer(standby_election, "noPingTimer")
+    drain(t)
+
+    # New writes through a live group land in slots AFTER stripe 2's
+    # holes; execution requires the standby to keep its stripe moving
+    # (repair + skips on watermarks).
+    clients[1].rng = _PickGroup(0)
+    p = clients[1].write(0, b"takeover")
+    drain(t)
+    for _ in range(8):
+        if p.done:
+            break
+        for leader in leaders[:2] + [t.actors[config.leader_groups[2][1]]]:
+            leader._broadcast_watermark()
+        for timer in list(t.running_timers()):
+            if timer.address not in (dead_leader, dead_election):
+                t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert p.done, "log stalled: standby did not keep stripe 2 moving"
+    live_logs = {tuple(r.state_machine.get()) for r in replicas}
+    assert len(live_logs) == 1
+    assert b"takeover" in next(iter(live_logs))
+
+
+def test_mencius_phase1_preserves_slot_residue():
+    """Regression: a phase-1 repair with no prior votes must not drift
+    next_slot off the stripe's residue (it drifted to max_slot+n = 2 for
+    stripe 1, making it propose into stripe 2's slots)."""
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=8)
+    g1 = leaders[1]
+    assert g1.next_slot % 3 == 1
+    # Force a fresh phase 1 with no votes anywhere.
+    g1.round = g1._next_owned_round(g1.round)
+    g1._start_phase1()
+    drain(t)
+    assert g1.state == "phase2"
+    assert g1.next_slot % 3 == 1, f"next_slot {g1.next_slot} off residue"
+    # And every subsequent proposal stays on the stripe.
+    clients[0].rng = _PickGroup(1)
+    clients[0].write(0, b"x")
+    drain(t)
+    assert g1.next_slot % 3 == 1
+
+
+def test_mencius_no_vote_phase1_leaves_no_hole_and_no_timer_leak():
+    """Regressions: (a) a no-vote repair resumes at the FIRST owned slot —
+    no permanent hole; (b) a nack-driven phase-1 restart stops the old
+    resend timer."""
+    t, config, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=9)
+    g1 = leaders[1]
+    g1.round = g1._next_owned_round(g1.round)
+    g1._start_phase1()
+    # Restart phase 1 again before the first completes (nack-style).
+    g1.round = g1._next_owned_round(g1.round)
+    g1._start_phase1()
+    resends = [
+        x for x in t.running_timers() if x.name() == "resendPhase1as"
+    ]
+    assert len(resends) == 1, "stale phase-1 resend timer leaked"
+    drain(t)
+    assert g1.next_slot == 1  # first owned slot, not a stride past it
+    # A write through stripe 1 lands at slot 1 and executes once stripes
+    # 0/2 fill slot 0 and 2 (watermarks drive the skips).
+    clients[0].rng = _PickGroup(1)
+    p = clients[0].write(0, b"no-hole")
+    drain(t)
+    for leader in leaders:
+        leader._broadcast_watermark()
+    drain(t)
+    assert p.done
