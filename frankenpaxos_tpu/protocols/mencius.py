@@ -16,10 +16,12 @@ specific mechanisms (``mencius/Leader.scala`` options doc):
     proposals are unaffected) and phase-1-repairs its owned slots.
 
 The compartmentalized machinery is shared with MultiPaxos: this module
-reuses ``multipaxos``'s ProxyLeader, Replica, ProxyReplica, Batcher
-message types and role implementations via a structurally-compatible
-config (same fields; slots route to acceptor groups by ``slot % G`` and
-Chosen fan-out is identical).
+reuses ``multipaxos``'s ProxyLeader, Replica, and ProxyReplica role
+implementations and message schemas via a structurally compatible config
+(slots route to acceptor groups by ``slot % G`` and Chosen fan-out is
+identical). The Batcher is Mencius-specific (``MenciusBatcher``): batches
+spread across leader GROUPS rather than following a single leader's
+round.
 """
 
 from __future__ import annotations
@@ -118,6 +120,8 @@ class MenciusConfig:
             raise ValueError("mencius uses round-robin groups, not grids")
         if self.num_leaders < 1:
             raise ValueError("need at least one leader group")
+        if self.num_acceptor_groups < 1:
+            raise ValueError("need at least one acceptor group")
         if len(self.leader_election_groups) != self.num_leaders:
             raise ValueError("one election group per leader group")
         for lg, eg in zip(self.leader_groups, self.leader_election_groups):
@@ -348,11 +352,17 @@ class MenciusLeader(Actor):
         max_slot = max(
             (info.slot for info in owned), default=-1
         )
-        max_slot = max(max_slot, self._recover_slot)
+        # Repair every owned slot we might ever have proposed: up to the
+        # max VOTED slot, up to any slot a replica asked us to recover, and
+        # up to our own previous next_slot — in-flight proposals whose
+        # round-0 Phase2as got nacked away have no votes, and skipping them
+        # here would leave one slow Recover cycle per hole.
+        top = max(max_slot, self._recover_slot,
+                  self.next_slot - self.config.num_leaders)
         start = self.chosen_watermark + (
             (self.group_index - self.chosen_watermark) % self.config.num_leaders
         )
-        for slot in range(start, max_slot + 1, self.config.num_leaders):
+        for slot in range(start, top + 1, self.config.num_leaders):
             infos = [i for i in owned if i.slot == slot]
             value = (
                 max(infos, key=lambda i: i.vote_round).vote_value
@@ -360,15 +370,13 @@ class MenciusLeader(Actor):
                 else CommandBatchOrNoop.noop()
             )
             self._propose(slot, value)
-        # Advance next_slot just past the repaired range, staying on this
-        # stripe's residue: with no votes at all, the next proposal is the
-        # FIRST owned slot at the watermark (`start`), not a stride past it
-        # (a raw max_slot+n would both drift off-residue and leave a
-        # permanent hole at `start`).
-        if max_slot < start:
+        # Resume proposing just past the repaired range, staying on this
+        # stripe's residue (with nothing to repair, at the first owned slot
+        # from the watermark).
+        if top < start:
             candidate = start
         else:
-            candidate = max_slot + self.config.num_leaders
+            candidate = top + self.config.num_leaders
         self.next_slot = max(self.next_slot, candidate)
         phase1.resend.stop()
         pending = phase1.pending_batches
@@ -393,7 +401,6 @@ class MenciusAcceptor(Actor):
         self.rounds: List[int] = [-1] * config.num_leaders
         # slot -> (vote_round, value)
         self.votes: Dict[int, Tuple[int, CommandBatchOrNoop]] = {}
-        self.max_voted_slot = -1
 
     def _owner(self, slot: int) -> int:
         return slot % self.config.num_leaders
@@ -410,7 +417,6 @@ class MenciusAcceptor(Actor):
                 return
             self.rounds[owner] = msg.round
             self.votes[msg.slot] = (msg.round, msg.value)
-            self.max_voted_slot = max(self.max_voted_slot, msg.slot)
             self.chan(src).send(
                 Phase2b(
                     group_index=self.group_index,
@@ -525,3 +531,40 @@ class MenciusClient(Actor):
         pending.resend.stop()
         del self.pending[pseudonym]
         pending.result.success(msg.result)
+
+
+@dataclasses.dataclass(frozen=True)
+class MenciusBatcherOptions:
+    batch_size: int = 100
+
+
+class MenciusBatcher(Actor):
+    """Accumulates client commands and spreads full batches round-robin
+    over the leader GROUPS (any stripe serves any write; the multipaxos
+    Batcher would pin every batch to one leader's round)."""
+
+    def __init__(self, address, transport, logger, config: MenciusConfig,
+                 options: MenciusBatcherOptions = MenciusBatcherOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.growing_batch: List[Command] = []
+        self._next_group = 0
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, ClientRequest):
+            self.logger.fatal(f"unknown mencius batcher message {msg!r}")
+        self.growing_batch.append(msg.command)
+        if len(self.growing_batch) < self.options.batch_size:
+            return
+        group = self.config.leader_groups[self._next_group]
+        self._next_group = (self._next_group + 1) % self.config.num_leaders
+        # Any member: inactive members forward to the elected one.
+        target = group[self.rng.randrange(len(group))]
+        self.chan(target).send(
+            ClientRequestBatch(CommandBatch(tuple(self.growing_batch)))
+        )
+        self.growing_batch.clear()

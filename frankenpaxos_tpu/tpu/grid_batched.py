@@ -1,0 +1,294 @@
+"""Batched flexible-quorum MultiPaxos: one grid (or majority) quorum
+system over ALL acceptors, as a single XLA program.
+
+The BASELINE "100k-acceptor flexible-quorum sweep (grid vs majority)"
+configuration: instead of round-robin acceptor groups (see
+``multipaxos_batched``), the whole cluster is ONE quorum system over
+N = rows x cols acceptors (the flexible mode of ``multipaxos/Config.scala``
+:19-25, quorums/Grid.scala):
+
+  * grid mode: a phase-2 write quorum is one acceptor per row (a random
+    "column transversal", Grid.randomWriteQuorum); a slot is chosen when
+    EVERY row has at least one vote in — computed as a per-row any-vote
+    reduction followed by an all-rows reduction;
+  * majority mode: a write quorum is any ceil((N+1)/2) acceptors — a flat
+    sum reduction (SimpleMajority).
+
+State is [W, R, C]: W in-flight slots over the R x C acceptor grid.
+Messages are PRNG-stamped arrival ticks exactly as in the grouped
+backend; retries re-send to the full grid. The acceptor axes shard over a
+device mesh by rows: a write quorum touches every row, so each tick's
+quorum check is a tiny cross-device reduction over ICI (the grouped
+backend's zero-communication property does not hold for grids —
+that IS the flexible-quorum trade-off being measured).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    CHOSEN,
+    EMPTY,
+    INF,
+    LAT_BINS,
+    PROPOSED,
+    _sample_delivered as _delivered,
+    _sample_latency as _lat,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GridBatchedConfig:
+    rows: int = 4
+    cols: int = 4
+    mode: str = "grid"  # "grid" | "majority"
+    window: int = 32
+    slots_per_tick: int = 4
+    lat_min: int = 1
+    lat_max: int = 3
+    drop_rate: float = 0.0
+    retry_timeout: int = 16
+
+    @property
+    def num_acceptors(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def majority_size(self) -> int:
+        return self.num_acceptors // 2 + 1
+
+    def __post_init__(self):
+        assert self.mode in ("grid", "majority")
+        assert self.rows >= 1 and self.cols >= 1
+        assert self.window >= 2 * self.slots_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.drop_rate < 1.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GridBatchedState:
+    next_slot: jnp.ndarray  # [] next slot sequence number
+    head: jnp.ndarray  # [] lowest non-retired slot
+    status: jnp.ndarray  # [W]
+    propose_tick: jnp.ndarray  # [W]
+    last_send: jnp.ndarray  # [W]
+    chosen_tick: jnp.ndarray  # [W]
+    replica_arrival: jnp.ndarray  # [W]
+    p2a_arrival: jnp.ndarray  # [W, R, C]
+    p2b_arrival: jnp.ndarray  # [W, R, C]
+    committed: jnp.ndarray  # []
+    retired: jnp.ndarray  # []
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
+    W, R, C = cfg.window, cfg.rows, cfg.cols
+    return GridBatchedState(
+        next_slot=jnp.zeros((), jnp.int32),
+        head=jnp.zeros((), jnp.int32),
+        status=jnp.zeros((W,), jnp.int32),
+        propose_tick=jnp.full((W,), INF, jnp.int32),
+        last_send=jnp.full((W,), INF, jnp.int32),
+        chosen_tick=jnp.full((W,), INF, jnp.int32),
+        replica_arrival=jnp.full((W,), INF, jnp.int32),
+        p2a_arrival=jnp.full((W, R, C), INF, jnp.int32),
+        p2b_arrival=jnp.full((W, R, C), INF, jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        retired=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
+    W, R, C = cfg.window, cfg.rows, cfg.cols
+    k_col, k_lat1, k_lat2, k_lat3, k_drop1, k_drop2, k_retry = (
+        jax.random.split(key, 7)
+    )
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    status = state.status
+
+    # 1. Acceptors vote on Phase2a arrivals.
+    arrived = state.p2a_arrival == t
+    p2b_arrival = jnp.where(
+        arrived & _delivered(cfg, k_drop1, (W, R, C)),
+        jnp.minimum(state.p2b_arrival, t + _lat(cfg, k_lat1, (W, R, C))),
+        state.p2b_arrival,
+    )
+
+    # 2. Quorum check.
+    votes_in = p2b_arrival <= t  # [W, R, C]
+    if cfg.mode == "grid":
+        # Write quorum = every ROW has a vote in (Grid.isWriteQuorum).
+        row_has_vote = jnp.any(votes_in, axis=2)  # [W, R]
+        quorum = jnp.all(row_has_vote, axis=1)  # [W]
+    else:
+        quorum = jnp.sum(votes_in, axis=(1, 2)) >= cfg.majority_size
+    newly_chosen = (status == PROPOSED) & quorum
+    chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
+    replica_arrival = jnp.where(
+        newly_chosen, t + _lat(cfg, k_lat3, (W,)), state.replica_arrival
+    )
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    latency = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    committed = state.committed + jnp.sum(newly_chosen)
+    lat_sum = state.lat_sum + jnp.sum(latency)
+    bins = jnp.clip(latency, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_chosen.astype(jnp.int32), bins, LAT_BINS
+    )
+
+    # 3. Retire the contiguous chosen prefix that reached the replicas.
+    slot_of_ord = state.head + w_iota
+    pos_of_ord = slot_of_ord % W
+    executable = (
+        (status[pos_of_ord] == CHOSEN)
+        & (replica_arrival[pos_of_ord] <= t)
+        & (slot_of_ord < state.next_slot)
+    )
+    n_retire = jnp.sum(jnp.cumprod(executable.astype(jnp.int32)))
+    ord_of_pos = (w_iota - state.head) % W
+    retire = ord_of_pos < n_retire
+    head = state.head + n_retire
+    retired = state.retired + n_retire
+    status = jnp.where(retire, EMPTY, status)
+    chosen_tick = jnp.where(retire, INF, chosen_tick)
+    replica_arrival = jnp.where(retire, INF, replica_arrival)
+    propose_tick = jnp.where(retire, INF, state.propose_tick)
+    last_send = jnp.where(retire, INF, state.last_send)
+    p2a_arrival = jnp.where(retire[:, None, None], INF, state.p2a_arrival)
+    p2b_arrival = jnp.where(retire[:, None, None], INF, p2b_arrival)
+
+    # 4. Propose up to K new slots.
+    space = W - (state.next_slot - head)
+    count = jnp.minimum(cfg.slots_per_tick, space)
+    delta = (w_iota - state.next_slot) % W
+    is_new = delta < count
+    next_slot = state.next_slot + count
+    status = jnp.where(is_new, PROPOSED, status)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    if cfg.mode == "grid":
+        # Thrifty write quorum: one random column per (slot, row)
+        # (Grid.randomWriteQuorum generalized to per-row choices).
+        col = jax.random.randint(k_col, (W, R), 0, C)
+        in_quorum = jnp.arange(C)[None, None, :] == col[:, :, None]
+    else:
+        # Majority mode: thrifty = a random majority. Rank a PRNG score.
+        scores = jax.random.uniform(k_col, (W, R * C))
+        kth = jnp.sort(scores, axis=1)[:, cfg.majority_size - 1 : cfg.majority_size]
+        in_quorum = (scores <= kth).reshape(W, R, C)
+    send = is_new[:, None, None] & in_quorum
+    p2a_arrival = jnp.where(
+        send & _delivered(cfg, k_drop2, (W, R, C)),
+        t + _lat(cfg, k_lat2, (W, R, C)),
+        p2a_arrival,
+    )
+
+    # 5. Retry to the FULL grid on timeout.
+    timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
+    p2a_arrival = jnp.where(
+        timed_out[:, None, None], t + _lat(cfg, k_retry, (W, R, C)), p2a_arrival
+    )
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return GridBatchedState(
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        chosen_tick=chosen_tick,
+        replica_arrival=replica_arrival,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        committed=committed,
+        retired=retired,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(cfg, state, t0, num_ticks: int, key):
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(cfg: GridBatchedConfig, state: GridBatchedState, t) -> dict:
+    votes_in = state.p2b_arrival <= t
+    chosen = state.status == CHOSEN
+    if cfg.mode == "grid":
+        quorum = jnp.all(jnp.any(votes_in, axis=2), axis=1)
+    else:
+        quorum = jnp.sum(votes_in, axis=(1, 2)) >= cfg.majority_size
+    return {
+        "quorum_ok": bool(jnp.all(jnp.where(chosen, quorum, True))),
+        "window_ok": bool(
+            (state.head <= state.next_slot)
+            & (state.next_slot - state.head <= cfg.window)
+        ),
+        "conserved": bool(state.retired <= state.committed),
+    }
+
+
+def sweep(configs, num_ticks: int = 300, seed: int = 0):
+    """Run several quorum configurations and report committed/sec-style
+    stats for comparison (the grid-vs-majority sweep)."""
+    results = []
+    for cfg in configs:
+        state = init_state(cfg)
+        state, t = run_ticks(
+            cfg, state, jnp.zeros((), jnp.int32), num_ticks, jax.random.PRNGKey(seed)
+        )
+        jax.block_until_ready(state)
+        committed = int(state.committed)
+        lat_hist = jax.device_get(state.lat_hist)
+        cum = lat_hist.cumsum()
+        p50 = int((cum >= max(1, (committed + 1) // 2)).argmax()) if committed else -1
+        results.append(
+            {
+                "mode": cfg.mode,
+                "acceptors": cfg.num_acceptors,
+                "committed": committed,
+                "p50_latency_ticks": p50,
+                "invariants": check_invariants(cfg, state, t),
+            }
+        )
+    return results
+
+
+def main() -> None:
+    """CLI: the flexible-quorum sweep (grid vs majority at increasing
+    scale). Scale via argv: `python -m frankenpaxos_tpu.tpu.grid_batched
+    [rows cols]` (defaults 10 10; the 100k-acceptor point is rows=cols=316
+    on real TPU)."""
+    import json
+    import sys
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    cols = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    results = sweep(
+        [
+            GridBatchedConfig(rows=rows, cols=cols, mode="grid"),
+            GridBatchedConfig(rows=rows, cols=cols, mode="majority"),
+        ]
+    )
+    print(json.dumps(results, default=str))
+
+
+if __name__ == "__main__":
+    main()

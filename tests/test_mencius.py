@@ -345,3 +345,41 @@ def test_mencius_no_vote_phase1_leaves_no_hole_and_no_timer_leak():
         leader._broadcast_watermark()
     drain(t)
     assert p.done
+
+
+def test_mencius_batcher_spreads_across_groups():
+    """MenciusBatcher round-robins full batches over leader GROUPS (the
+    multipaxos Batcher would pin everything to one leader's round)."""
+    t, config0, leaders, proxy_leaders, acceptors, replicas, clients = make(seed=10)
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+
+    batcher_addr = SimAddress("mencius_batcher0")
+    config = dataclasses.replace(config0, batcher_addresses=(batcher_addr,))
+    batcher = mn.MenciusBatcher(
+        batcher_addr, t, FakeLogger(LogLevel.FATAL), config,
+        mn.MenciusBatcherOptions(batch_size=2), seed=3,
+    )
+    # New clients bound to the batched config.
+    bclients = [
+        mn.MenciusClient(SimAddress(f"bclient{i}"), t,
+                         FakeLogger(LogLevel.FATAL), config, seed=60 + i)
+        for i in range(2)
+    ]
+    promises = []
+    for r in range(4):
+        for i, c in enumerate(bclients):
+            promises.append(c.write(r, f"b{r}c{i}".encode()))
+        drain(t)
+    for leader in leaders:
+        leader._broadcast_watermark()
+    drain(t)
+    assert all(p.done for p in promises)
+    # Batches landed on more than one stripe.
+    used_stripes = {
+        slot % 3
+        for rep in replicas
+        for slot, entry in rep.log.to_map().items()
+        if not entry.is_noop
+    }
+    assert len(used_stripes) > 1, f"all batches pinned to {used_stripes}"
