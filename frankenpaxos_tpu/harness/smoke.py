@@ -461,6 +461,36 @@ def smoke_mencius(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_unanimousbpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import unanimousbpaxos as ubx
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = ubx.UnanimousBPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("ubl0"), SimAddress("ubl1")),
+            dep_service_node_addresses=tuple(
+                SimAddress(f"ubd{i}") for i in range(3)
+            ),
+            acceptor_addresses=tuple(SimAddress(f"uba{i}") for i in range(3)),
+        )
+        for a in config.leader_addresses:
+            ubx.UbLeader(a, t, log(), config, KeyValueStore())
+        for a in config.dep_service_node_addresses:
+            ubx.UbDepServiceNode(a, t, log(), config, KeyValueStore())
+        for a in config.acceptor_addresses:
+            ubx.UbAcceptor(a, t, log(), config)
+        return ubx.UbClient(SimAddress("ubc"), t, log(), config)
+
+    def operate(t, client):
+        return [client.propose(0, kv_set(("x", "1")))]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_matchmakerpaxos(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -527,6 +557,7 @@ SMOKES = {
     "simplebpaxos": smoke_simplebpaxos,
     "vanillamencius": smoke_vanillamencius,
     "mencius": smoke_mencius,
+    "unanimousbpaxos": smoke_unanimousbpaxos,
     "matchmakerpaxos": smoke_matchmakerpaxos,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,

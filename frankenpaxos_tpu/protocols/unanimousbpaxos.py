@@ -1,0 +1,670 @@
+"""Unanimous BPaxos (reference ``unanimousbpaxos/``: Client, Leader,
+DepServiceNode, Acceptor).
+
+The fast-path variant of BPaxos: each dependency service node is
+co-located with an acceptor; on a DependencyRequest it computes the
+command's dependencies and hands its acceptor a fast proposal, which the
+acceptor fast-votes in round 0 and reports straight to the vertex's
+leader (Phase2bFast). If ALL n acceptors report IDENTICAL dependency sets
+(fastQuorumSize = n — unanimity), the vertex commits in one round trip;
+otherwise the leader, who owns classic round 1, proposes the UNION of the
+reported sets in round 1 (Leader.handlePhase2bFast). Recovery of stuck
+vertices runs classic rounds with the standard value-selection rule: a
+unique max-round vote wins; divergent round-0 votes recover as noop
+(Leader.handlePhase1b). Committed vertices execute through a dependency
+graph at the leaders with an exactly-once client table.
+
+Deliberate divergence from Leader.scala:745-756: a round-0 value is
+adopted during recovery only when EVERY sampled acceptor fast-voted it —
+a quorum containing an abstention (a promise with no round-0 vote)
+recovers as noop, because the abstention proves unanimity is impossible
+and the reference's rule of adopting the partial voters' value also
+adopts their possibly-stale dependency sets, which we observed committing
+two conflicting commands with no dependency edge between them (divergent
+execution orders across leaders).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, FrozenSet, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.clienttable import ClientTable, Executed
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.roundsystem import RotatedRoundZeroFast
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import random_duration
+
+# Vote values are (command | None, deps tuple) pairs; vertex ids are
+# (leader_index, id) tuples.
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbCommand:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbClientRequest:
+    command: UbCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbDependencyRequest:
+    vertex_id: tuple
+    command: UbCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbFastProposal:
+    vertex_id: tuple
+    value: tuple  # (command, deps)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbPhase2bFast:
+    vertex_id: tuple
+    acceptor_id: int
+    value: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbPhase1a:
+    vertex_id: tuple
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbPhase1b:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+    vote_round: int
+    vote_value: Optional[tuple]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbPhase2a:
+    vertex_id: tuple
+    round: int
+    vote_value: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbPhase2bClassic:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbNack:
+    vertex_id: tuple
+    higher_round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class UbCommit:
+    vertex_id: tuple
+    value: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class UnanimousBPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    dep_service_node_addresses: tuple
+    acceptor_addresses: tuple
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def classic_quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def fast_quorum_size(self) -> int:
+        return self.n  # unanimity
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.dep_service_node_addresses) != self.n:
+            raise ValueError(f"need exactly {self.n} dep service nodes")
+        if len(self.acceptor_addresses) != self.n:
+            raise ValueError(f"need exactly {self.n} acceptors")
+
+
+@dataclasses.dataclass
+class _UbPhase2Fast:
+    command: UbCommand
+    phase2b_fasts: Dict[int, UbPhase2bFast]
+    resend: object
+
+
+@dataclasses.dataclass
+class _UbPhase1:
+    round: int
+    phase1bs: Dict[int, UbPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _UbPhase2Classic:
+    round: int
+    value: tuple
+    phase2bs: Dict[int, UbPhase2bClassic]
+    resend: object
+
+
+@dataclasses.dataclass
+class _UbCommitted:
+    value: tuple
+
+
+class UbLeader(Actor):
+    def __init__(self, address, transport, logger,
+                 config: UnanimousBPaxosConfig, state_machine: StateMachine,
+                 resend_period: float = 5.0,
+                 recover_min_period: float = 5.0,
+                 recover_max_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.recover_min_period = recover_min_period
+        self.recover_max_period = recover_max_period
+        self.index = config.leader_addresses.index(address)
+        self.next_vertex_id = 0
+        self.states: Dict[tuple, object] = {}
+        self.dependency_graph = TarjanDependencyGraph()
+        self.client_table: ClientTable = ClientTable()
+        self.recover_timers: Dict[tuple, object] = {}
+
+    def _round_system(self, vertex_id: tuple):
+        # Round 0 is the FAST round; classic rounds rotate starting from
+        # the vertex's own leader, so round 1 (the first classic round)
+        # belongs to the owner — which is what lets the owner jump
+        # straight to round 1 on fast-path disagreement
+        # (Leader.scala roundSystem + the checkEq at Leader.scala:664).
+        return RotatedRoundZeroFast(
+            len(self.config.leader_addresses), vertex_id[0]
+        )
+
+    def _make_resend(self, name: str, send_once):
+        def fire() -> None:
+            send_once()
+            timer.start()
+
+        timer = self.timer(name, self.resend_period, fire)
+        timer.start()
+        return timer
+
+    def _stop_timers(self, vertex_id) -> None:
+        state = self.states.get(vertex_id)
+        if isinstance(state, (_UbPhase2Fast, _UbPhase1, _UbPhase2Classic)):
+            state.resend.stop()
+
+    def _will_be_committed(self, vertex_id) -> bool:
+        return isinstance(
+            self.states.get(vertex_id), (_UbPhase1, _UbPhase2Classic, _UbCommitted)
+        )
+
+    def _make_recover_timer(self, vertex_id):
+        def fire() -> None:
+            if not self._will_be_committed(vertex_id):
+                self._recover(vertex_id, nack_round=-1)
+
+        timer = self.timer(
+            f"recoverVertex{vertex_id}",
+            random_duration(
+                self.rng, self.recover_min_period, self.recover_max_period
+            ),
+            fire,
+        )
+        timer.start()
+        return timer
+
+    def _recover(self, vertex_id, nack_round: int) -> None:
+        state = self.states.get(vertex_id)
+        if isinstance(state, _UbCommitted):
+            return
+        current = 0
+        if isinstance(state, (_UbPhase1, _UbPhase2Classic)):
+            current = state.round
+        round = self._round_system(vertex_id).next_classic_round(
+            self.index, max(nack_round, current)
+        )
+        self._stop_timers(vertex_id)
+        phase1a = UbPhase1a(vertex_id=vertex_id, round=round)
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase1a)
+        self.states[vertex_id] = _UbPhase1(
+            round=round,
+            phase1bs={},
+            resend=self._make_resend(
+                f"resendPhase1a{vertex_id}",
+                lambda: [
+                    self.chan(a).send(phase1a)
+                    for a in self.config.acceptor_addresses
+                ],
+            ),
+        )
+        timer = self.recover_timers.pop(vertex_id, None)
+        if timer is not None:
+            timer.stop()
+
+    def _commit(self, vertex_id, value: tuple, inform_others: bool) -> None:
+        if isinstance(self.states.get(vertex_id), _UbCommitted):
+            return
+        self._stop_timers(vertex_id)
+        self.states[vertex_id] = _UbCommitted(value)
+        if inform_others:
+            commit = UbCommit(vertex_id=vertex_id, value=value)
+            for leader in self.config.leader_addresses:
+                if leader != self.address:
+                    self.chan(leader).send(commit)
+        timer = self.recover_timers.pop(vertex_id, None)
+        if timer is not None:
+            timer.stop()
+        command, dependencies = value
+        # Arm recovery for uncommitted dependencies (Leader.commit).
+        for dep in dependencies:
+            if not self._will_be_committed(dep) and dep not in self.recover_timers:
+                self.recover_timers[dep] = self._make_recover_timer(dep)
+        self.dependency_graph.commit(vertex_id, 0, set(dependencies))
+        executables, _blockers = self.dependency_graph.execute()
+        for v in executables:
+            committed = self.states.get(v)
+            if not isinstance(committed, _UbCommitted):
+                self.logger.fatal(f"vertex {v} executable but not committed")
+            self._execute(v, committed.value)
+
+    def _execute(self, vertex_id, value: tuple) -> None:
+        command, _ = value
+        if command is None:
+            return  # noop
+        identity = (command.client_address, command.client_pseudonym)
+        if isinstance(self.client_table.executed(identity, command.client_id),
+                      Executed):
+            return
+        output = self.state_machine.run(command.command)
+        self.client_table.execute(identity, command.client_id, output)
+        if self.index == vertex_id[0]:
+            client = self.transport.address_from_bytes(command.client_address)
+            self.chan(client).send(
+                UbClientReply(
+                    client_pseudonym=command.client_pseudonym,
+                    client_id=command.client_id,
+                    result=output,
+                )
+            )
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, UbClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, UbPhase2bFast):
+            self._handle_phase2b_fast(msg)
+        elif isinstance(msg, UbPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, UbPhase2bClassic):
+            self._handle_phase2b_classic(msg)
+        elif isinstance(msg, UbNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, UbCommit):
+            self._commit(msg.vertex_id, msg.value, inform_others=False)
+        else:
+            self.logger.fatal(f"unknown ubpaxos leader message {msg!r}")
+
+    def _handle_client_request(self, src: Address, msg: UbClientRequest) -> None:
+        command = msg.command
+        identity = (command.client_address, command.client_pseudonym)
+        executed = self.client_table.executed(identity, command.client_id)
+        if isinstance(executed, Executed):
+            if executed.output is not None:
+                client = self.transport.address_from_bytes(command.client_address)
+                self.chan(client).send(
+                    UbClientReply(
+                        client_pseudonym=command.client_pseudonym,
+                        client_id=command.client_id,
+                        result=executed.output,
+                    )
+                )
+            return
+        vertex_id = (self.index, self.next_vertex_id)
+        self.next_vertex_id += 1
+        request = UbDependencyRequest(vertex_id=vertex_id, command=command)
+        for node in self.config.dep_service_node_addresses:
+            self.chan(node).send(request)
+        self.states[vertex_id] = _UbPhase2Fast(
+            command=command,
+            phase2b_fasts={},
+            resend=self._make_resend(
+                f"resendDeps{vertex_id}",
+                lambda: [
+                    self.chan(node).send(request)
+                    for node in self.config.dep_service_node_addresses
+                ],
+            ),
+        )
+        self.recover_timers[vertex_id] = self._make_recover_timer(vertex_id)
+
+    def _handle_phase2b_fast(self, msg: UbPhase2bFast) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _UbPhase2Fast):
+            return
+        state.phase2b_fasts[msg.acceptor_id] = msg
+        if len(state.phase2b_fasts) < self.config.fast_quorum_size:
+            return
+        dep_sets = {
+            tuple(sorted(b.value[1])) for b in state.phase2b_fasts.values()
+        }
+        if len(dep_sets) == 1:
+            # Unanimous fast path: one round trip.
+            self._commit(
+                msg.vertex_id,
+                (state.command, next(iter(dep_sets))),
+                inform_others=True,
+            )
+            return
+        # Disagreement: this leader owns round 1 (the first classic round
+        # of the rotated-round-zero-fast system) — propose the UNION.
+        self.logger.check_eq(
+            self._round_system(msg.vertex_id).leader(1), self.index
+        )
+        union = tuple(
+            sorted({d for b in state.phase2b_fasts.values() for d in b.value[1]})
+        )
+        value = (state.command, union)
+        state.resend.stop()
+        phase2a = UbPhase2a(vertex_id=msg.vertex_id, round=1, vote_value=value)
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase2a)
+        self.states[msg.vertex_id] = _UbPhase2Classic(
+            round=1,
+            value=value,
+            phase2bs={},
+            resend=self._make_resend(
+                f"resendPhase2a{msg.vertex_id}",
+                lambda: [
+                    self.chan(a).send(phase2a)
+                    for a in self.config.acceptor_addresses
+                ],
+            ),
+        )
+        timer = self.recover_timers.pop(msg.vertex_id, None)
+        if timer is not None:
+            timer.stop()
+
+    def _handle_phase1b(self, msg: UbPhase1b) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _UbPhase1):
+            return
+        if msg.round != state.round:
+            return
+        state.phase1bs[msg.acceptor_id] = msg
+        if len(state.phase1bs) < self.config.classic_quorum_size:
+            return
+        max_vote = max(b.vote_round for b in state.phase1bs.values())
+        if max_vote == -1:
+            proposal = (None, ())  # noop
+        else:
+            values = {
+                b.vote_value
+                for b in state.phase1bs.values()
+                if b.vote_round == max_vote
+            }
+            all_voted = all(
+                b.vote_round == max_vote for b in state.phase1bs.values()
+            )
+            if max_vote > 0:
+                self.logger.check_eq(len(values), 1)
+                proposal = next(iter(values))
+            elif len(values) == 1 and all_voted:
+                # Every sampled acceptor fast-voted the SAME value: round 0
+                # may have chosen it, so it must be adopted.
+                proposal = next(iter(values))
+            else:
+                # Divergent fast-round votes — or an ABSTENTION among the
+                # sampled promises. An abstaining acceptor that promised a
+                # classic round can never fast-vote, so unanimity is
+                # impossible and nothing was (or can be) chosen at round 0.
+                # Recover as noop: adopting the partial voters' value here
+                # would also adopt their possibly-stale DEPENDENCY sets,
+                # which can leave two committed conflicting commands with
+                # no edge between them (divergent execution orders). The
+                # command itself survives via the client's resend, which
+                # gets a fresh vertex with fresh dependencies.
+                proposal = (None, ())
+        phase2a = UbPhase2a(
+            vertex_id=msg.vertex_id, round=state.round, vote_value=proposal
+        )
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase2a)
+        state.resend.stop()
+        self.states[msg.vertex_id] = _UbPhase2Classic(
+            round=state.round,
+            value=proposal,
+            phase2bs={},
+            resend=self._make_resend(
+                f"resendPhase2a{msg.vertex_id}",
+                lambda: [
+                    self.chan(a).send(phase2a)
+                    for a in self.config.acceptor_addresses
+                ],
+            ),
+        )
+
+    def _handle_phase2b_classic(self, msg: UbPhase2bClassic) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _UbPhase2Classic):
+            return
+        if msg.round != state.round:
+            return
+        state.phase2bs[msg.acceptor_id] = msg
+        if len(state.phase2bs) < self.config.classic_quorum_size:
+            return
+        self._commit(msg.vertex_id, state.value, inform_others=True)
+
+    def _handle_nack(self, msg: UbNack) -> None:
+        self._recover(msg.vertex_id, nack_round=msg.higher_round)
+
+
+class UbDepServiceNode(Actor):
+    """Computes dependencies and hands its CO-LOCATED acceptor a fast
+    proposal (DepServiceNode.handleDependencyRequest)."""
+
+    def __init__(self, address, transport, logger,
+                 config: UnanimousBPaxosConfig, state_machine: StateMachine):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.dep_service_node_addresses.index(address)
+        self.acceptor = config.acceptor_addresses[self.index]
+        self.conflict_index = state_machine.conflict_index()
+        self.dependencies_cache: Dict[tuple, tuple] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, UbDependencyRequest):
+            self.logger.fatal(f"unknown dep service message {msg!r}")
+        deps = self.dependencies_cache.get(msg.vertex_id)
+        if deps is None:
+            conflicts = set(self.conflict_index.get_conflicts(msg.command.command))
+            conflicts.discard(msg.vertex_id)
+            deps = tuple(sorted(conflicts))
+            self.conflict_index.put(msg.vertex_id, msg.command.command)
+            self.dependencies_cache[msg.vertex_id] = deps
+        self.chan(self.acceptor).send(
+            UbFastProposal(
+                vertex_id=msg.vertex_id, value=(msg.command, deps)
+            )
+        )
+
+
+class UbAcceptor(Actor):
+    def __init__(self, address, transport, logger,
+                 config: UnanimousBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        # vertex -> [round, vote_round, vote_value]
+        self.states: Dict[tuple, list] = {}
+
+    def _leader_for(self, vertex_id: tuple) -> Address:
+        return self.config.leader_addresses[vertex_id[0]]
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, UbFastProposal):
+            state = self.states.setdefault(msg.vertex_id, [0, -1, None])
+            if state[0] > 0:
+                # A classic round already started: nack so the owner stops
+                # waiting on the fast path (Acceptor.scala:155-164).
+                self.chan(self._leader_for(msg.vertex_id)).send(
+                    UbNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            if state[1] >= 0:
+                return  # already fast-voted; duplicates are ignored
+            state[1] = 0
+            state[2] = msg.value
+            self.chan(self._leader_for(msg.vertex_id)).send(
+                UbPhase2bFast(
+                    vertex_id=msg.vertex_id,
+                    acceptor_id=self.index,
+                    value=msg.value,
+                )
+            )
+        elif isinstance(msg, UbPhase1a):
+            state = self.states.setdefault(msg.vertex_id, [0, -1, None])
+            if msg.round < state[0]:
+                self.chan(src).send(
+                    UbNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            self.chan(src).send(
+                UbPhase1b(
+                    vertex_id=msg.vertex_id,
+                    acceptor_id=self.index,
+                    round=msg.round,
+                    vote_round=state[1],
+                    vote_value=state[2],
+                )
+            )
+        elif isinstance(msg, UbPhase2a):
+            state = self.states.setdefault(msg.vertex_id, [0, -1, None])
+            if msg.round < state[0]:
+                self.chan(src).send(
+                    UbNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            state[1] = msg.round
+            state[2] = msg.vote_value
+            self.chan(src).send(
+                UbPhase2bClassic(
+                    vertex_id=msg.vertex_id,
+                    acceptor_id=self.index,
+                    round=msg.round,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown ubpaxos acceptor message {msg!r}")
+
+
+@dataclasses.dataclass
+class _UbPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class UbClient(Actor):
+    def __init__(self, address, transport, logger,
+                 config: UnanimousBPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _UbPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = UbClientRequest(
+            UbCommand(
+                client_address=self.address_bytes,
+                client_pseudonym=pseudonym,
+                client_id=id,
+                command=command,
+            )
+        )
+        leader = self.config.leader_addresses[
+            self.rng.randrange(len(self.config.leader_addresses))
+        ]
+        self.chan(leader).send(request)
+
+        def resend() -> None:
+            target = self.config.leader_addresses[
+                self.rng.randrange(len(self.config.leader_addresses))
+            ]
+            self.chan(target).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendUb[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _UbPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, UbClientReply):
+            self.logger.fatal(f"unknown ubpaxos client message {msg!r}")
+        pending = self.pending.get(msg.client_pseudonym)
+        if pending is None or msg.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[msg.client_pseudonym]
+        pending.result.success(msg.result)

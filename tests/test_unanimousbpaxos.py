@@ -1,0 +1,241 @@
+"""Unanimous BPaxos sim tests (the analog of
+shared/src/test/scala/unanimousbpaxos)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import unanimousbpaxos as ub
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+from test_epaxos import RecordingKv, _conflicting_order_violation
+
+
+def make(f=1, num_clients=2, seed=0):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    n = 2 * f + 1
+    config = ub.UnanimousBPaxosConfig(
+        f=f,
+        leader_addresses=tuple(SimAddress(f"leader{i}") for i in range(f + 1)),
+        dep_service_node_addresses=tuple(
+            SimAddress(f"dep{i}") for i in range(n)
+        ),
+        acceptor_addresses=tuple(SimAddress(f"acceptor{i}") for i in range(n)),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        ub.UbLeader(a, t, log(), config, RecordingKv(), seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    deps = [
+        ub.UbDepServiceNode(a, t, log(), config, KeyValueStore())
+        for a in config.dep_service_node_addresses
+    ]
+    acceptors = [
+        ub.UbAcceptor(a, t, log(), config) for a in config.acceptor_addresses
+    ]
+    clients = [
+        ub.UbClient(SimAddress(f"client{i}"), t, log(), config, seed=seed + 40 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, leaders, deps, acceptors, clients
+
+
+def drain(t, max_steps=100000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def test_ub_single_command_fast_path():
+    """An uncontended command commits via the unanimous fast path — zero
+    classic-phase messages on the wire."""
+    t, config, leaders, deps, acceptors, clients = make()
+    p = clients[0].propose(0, kv_set(("x", "1")))
+    classic = 0
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), (ub.UbPhase1a, ub.UbPhase2a)):
+            classic += 1
+        t.deliver_message(m)
+    assert p.done
+    assert classic == 0
+    # The proposing leader executed it.
+    assert leaders[0].state_machine.get() == {"x": "1"} or \
+        leaders[1].state_machine.get() == {"x": "1"}
+
+
+def test_ub_conflict_falls_back_to_classic_round_1():
+    """Interleaved conflicting commands make dep sets diverge; the leader
+    proposes the union in classic round 1 and both commit."""
+    t, config, leaders, deps, acceptors, clients = make(seed=3)
+    p1 = clients[0].propose(0, kv_set(("x", "a")))
+    p2 = clients[1].propose(0, kv_set(("x", "b")))
+    rng = random.Random(1)
+    for _ in range(4000):
+        cmd = t.generate_command(rng)
+        if cmd is None:
+            break
+        t.run_command(cmd, record=False)
+    drain(t)
+    for _ in range(6):
+        if p1.done and p2.done:
+            break
+        for timer in list(t.running_timers()):
+            t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert p1.done and p2.done
+    finals = {
+        tuple(sorted(l.state_machine.get().items())) for l in leaders
+    }
+    assert len(finals) == 1, finals
+
+
+def test_ub_recovery_after_leader_death():
+    t, config, leaders, deps, acceptors, clients = make(seed=5)
+
+    class _L0:
+        def randrange(self, n):
+            return 0
+
+    clients[0].rng = _L0()
+    p1 = clients[0].propose(0, kv_set(("x", "1")))
+    # Deliver dep requests + fast proposals, but kill leader 0 before it
+    # sees any Phase2bFast.
+    t.deliver_message(t.messages[0])  # request -> leader0
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == config.leader_addresses[0]:
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    t.partition_actor(config.leader_addresses[0])
+
+    # A conflicting command through leader 1 depends on the stuck vertex.
+    class _L1:
+        def randrange(self, n):
+            return 1
+
+    clients[1].rng = _L1()
+    p2 = clients[1].propose(0, kv_set(("x", "2")))
+    drain(t)
+    assert not p2.done
+    # Leader 1's recover timers run classic rounds on the stuck vertex.
+    for _ in range(6):
+        if p2.done:
+            break
+        for timer in list(t.running_timers()):
+            if timer.address != config.leader_addresses[0]:
+                t.trigger_timer(timer.address, timer.name())
+        drain(t)
+    assert p2.done, "recovery did not unblock the dependent command"
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    key: str
+    value: str
+
+
+class SimulatedUbPaxos(SimulatedSystem):
+    def __init__(self, f=1):
+        self.f = f
+        self._kv = KeyValueStore()
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed)
+
+    def get_state(self, system):
+        leaders = system[2]
+        return tuple(
+            tuple(l.state_machine.executed_commands) for l in leaders
+        )
+
+    def generate_command(self, system, rng):
+        t = system[0]
+        clients = system[5]
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"k{rng.randrange(2)}",
+                                    f"v{rng.randrange(50)}"))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t = system[0]
+        clients = system[5]
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(
+                command.pseudonym, kv_set((command.key, command.value))
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        class _H:
+            pass
+
+        fakes = []
+        for log in state:
+            sm = _H()
+            sm.executed_commands = list(log)
+            h = _H()
+            h.state_machine = sm
+            fakes.append(h)
+        return _conflicting_order_violation(fakes, self._kv.conflicts)
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_ub_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedUbPaxos(f), run_length=120, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_ub_recovery_abstention_recovers_noop():
+    """Regression: recovering a round-0 value from a quorum containing an
+    ABSTENTION must produce noop — the abstainer's classic promise makes
+    unanimity impossible, and adopting the partial voters' value would
+    adopt stale dependency sets (observed as divergent execution orders
+    of conflicting commands)."""
+    t, config, leaders, deps, acceptors, clients = make(seed=19)
+    vertex = (0, 0)
+    leader = leaders[1]
+    # Build a phase-1 state with one round-0 vote and one abstention.
+    leader._recover(vertex, nack_round=-1)
+    drain_limit = 0
+    while t.messages and drain_limit < 1000:
+        m = t.messages[0]
+        t.drop_message(m)  # discard the real phase1as/bs
+        drain_limit += 1
+    state = leader.states[vertex]
+    assert isinstance(state, ub._UbPhase1)
+    cmd = ub.UbCommand(b"addr", 0, 0, kv_set(("x", "1")))
+    leader._handle_phase1b(ub.UbPhase1b(
+        vertex_id=vertex, acceptor_id=0, round=state.round,
+        vote_round=0, vote_value=(cmd, ((1, 7),)),
+    ))
+    leader._handle_phase1b(ub.UbPhase1b(
+        vertex_id=vertex, acceptor_id=1, round=state.round,
+        vote_round=-1, vote_value=None,
+    ))
+    # The leader moved to classic phase 2 proposing NOOP, not the command.
+    phase2 = leader.states[vertex]
+    assert isinstance(phase2, ub._UbPhase2Classic)
+    assert phase2.value == (None, ()), phase2.value
