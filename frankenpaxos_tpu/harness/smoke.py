@@ -519,6 +519,54 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_scalog(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import scalog as scx
+    from frankenpaxos_tpu.protocols.multipaxos.replica import Replica
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = scx.ScalogConfig(
+            f=1,
+            server_addresses=(
+                (SimAddress("scs_0_0"), SimAddress("scs_0_1")),
+                (SimAddress("scs_1_0"), SimAddress("scs_1_1")),
+            ),
+            aggregator_address=SimAddress("scagg"),
+            leader_addresses=(SimAddress("scl0"), SimAddress("scl1")),
+            acceptor_addresses=tuple(SimAddress(f"sca{i}") for i in range(3)),
+            replica_addresses=(SimAddress("scr0"), SimAddress("scr1")),
+        )
+        for i, a in enumerate(config.flat_servers):
+            scx.ScServer(
+                a, t, log(), config, scx.ScServerOptions(push_size=1), seed=i
+            )
+        scx.ScAggregator(
+            config.aggregator_address, t, log(), config,
+            scx.ScAggregatorOptions(num_shard_cuts_per_proposal=1),
+        )
+        for i, a in enumerate(config.leader_addresses):
+            scx.ScLeader(a, t, log(), config, seed=10 + i)
+        for a in config.acceptor_addresses:
+            scx.ScAcceptor(a, t, log(), config)
+        for i, a in enumerate(config.replica_addresses):
+            Replica(
+                a, t, log(), ReadableAppendLog(),
+                scx.replica_config(config), seed=20 + i,
+            )
+        return [
+            scx.ScClient(SimAddress(f"scc{i}"), t, log(), config, seed=40 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [c.write(0, f"cmd{i}".encode()) for i, c in enumerate(clients)]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_tpu(bench=None) -> dict:
     import jax
 
@@ -559,6 +607,7 @@ SMOKES = {
     "mencius": smoke_mencius,
     "unanimousbpaxos": smoke_unanimousbpaxos,
     "matchmakerpaxos": smoke_matchmakerpaxos,
+    "scalog": smoke_scalog,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,
 }

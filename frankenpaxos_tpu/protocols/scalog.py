@@ -1,0 +1,949 @@
+"""Scalog (reference ``scalog/``: Client, Server, Aggregator, Leader,
+Acceptor, Replica, ProxyReplica).
+
+Scalog decouples ordering from replication: clients append to any shard
+server's LOCAL log (backed up to the shard's other servers); servers
+periodically push their log watermarks (ShardInfo) to the Aggregator,
+which assembles a global CUT — a vector of per-server watermarks — and
+has a small Paxos group (Leader + 2f+1 Acceptors) choose a log of cuts.
+Chosen cuts flow back (RawCutChosen) to the Aggregator, which orders and
+prunes non-monotone cuts, then broadcasts CutChosen to the servers. Each
+server PROJECTS the delta between consecutive cuts onto the global log
+(``Server.scala:30-60``'s worked example: global order is server-major
+within a cut delta) and sends its own segment to the replicas as ordinary
+Chosen(globalSlot, batch) messages — so the replica layer is EXACTLY the
+MultiPaxos replica (reused here), with holes recovered through the
+Aggregator, which locates the server owning a global slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.protocols.multipaxos.config import DistributionScheme
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    ChosenWatermark,
+    Chosen,
+    Command,
+    CommandBatch,
+    CommandBatchOrNoop,
+    CommandId,
+    Recover,
+)
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.util import BufferMap
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScClientRequest:
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScBackup:
+    server_index: int  # GLOBAL server index
+    slot: int
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScBackupAck:
+    server_index: int  # GLOBAL index of the ORIGINATING server
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScShardInfo:
+    shard_index: int
+    server_index: int  # index within the shard
+    watermark: tuple  # per-server local-log watermarks within the shard
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScProposeCut:
+    cut: tuple  # flattened per-(global)server watermarks
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScPhase1a:
+    round: int
+    chosen_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScPhase1b:
+    acceptor_index: int
+    round: int
+    votes: tuple  # of (slot, vote_round, cut|None)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScPhase2a:
+    slot: int
+    round: int
+    cut: Optional[tuple]  # None = noop
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScPhase2b:
+    acceptor_index: int
+    slot: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScRawCutChosen:
+    slot: int
+    cut: Optional[tuple]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScCutChosen:
+    slot: int
+    cut: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScRecoverRawCut:
+    # The aggregator's raw-cut watermark: the first cut-log slot it is
+    # missing. Doubles as a GC hint — leaders prune cached cuts below it.
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScCutChosenAck:
+    # A server acknowledges it stored cut-log slot ``slot``; the
+    # aggregator stops re-broadcasting the newest cut to it.
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScRawWatermark:
+    # The aggregator's processed raw-cut watermark, pushed periodically to
+    # leaders and acceptors: raw slots below it can never be requested
+    # again, so vote state and cut caches below it are garbage.
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class ScLeaderInfo:
+    # A leader announces it finished phase 1 and owns this round, so the
+    # aggregator routes future ScProposeCuts to it instead of a dead
+    # predecessor.
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalogConfig:
+    f: int
+    # servers grouped into shards; each shard has f+1 servers.
+    server_addresses: tuple  # of tuples (shards)
+    aggregator_address: object
+    leader_addresses: tuple  # the cut-ordering Paxos leaders
+    acceptor_addresses: tuple  # 2f+1 cut acceptors
+    replica_addresses: tuple
+    proxy_replica_addresses: tuple = ()
+    distribution_scheme: DistributionScheme = DistributionScheme.HASH
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.server_addresses)
+
+    @property
+    def flat_servers(self) -> tuple:
+        return tuple(a for shard in self.server_addresses for a in shard)
+
+    @property
+    def num_servers(self) -> int:
+        return len(self.flat_servers)
+
+    def shard_of(self, global_index: int) -> int:
+        base = 0
+        for s, shard in enumerate(self.server_addresses):
+            if global_index < base + len(shard):
+                return s
+            base += len(shard)
+        raise IndexError(global_index)
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_addresses)
+
+    @property
+    def num_proxy_replicas(self) -> int:
+        return len(self.proxy_replica_addresses)
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if self.num_shards < 1:
+            raise ValueError("need at least one shard")
+        for shard in self.server_addresses:
+            if len(shard) < self.f + 1:
+                raise ValueError("each shard needs >= f+1 servers")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+        if self.num_replicas < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+# The replica layer reuses multipaxos.Replica, which broadcasts its
+# Recover/ChosenWatermark to config.leader_addresses — for Scalog those
+# must reach the AGGREGATOR (which locates the server owning a slot), so
+# the replica-facing config exposes the aggregator as the sole "leader".
+def replica_config(config: ScalogConfig):
+    return _ScReplicaConfig(config)
+
+
+class _ScReplicaConfig:
+    def __init__(self, config: ScalogConfig):
+        self._c = config
+        self.f = config.f
+        self.leader_addresses = (config.aggregator_address,)
+        self.replica_addresses = config.replica_addresses
+        self.proxy_replica_addresses = config.proxy_replica_addresses
+        self.distribution_scheme = config.distribution_scheme
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_addresses)
+
+    @property
+    def num_proxy_replicas(self) -> int:
+        return len(self.proxy_replica_addresses)
+
+    def check_valid(self) -> None:
+        self._c.check_valid()
+
+
+@dataclasses.dataclass(frozen=True)
+class ScServerOptions:
+    push_size: int = 1  # push watermarks after this many appends
+    push_period: float = 1.0
+
+
+class ScServer(Actor):
+    def __init__(self, address, transport, logger, config: ScalogConfig,
+                 options: ScServerOptions = ScServerOptions(), seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.shard_index = next(
+            i for i, s in enumerate(config.server_addresses) if address in s
+        )
+        self.index = config.server_addresses[self.shard_index].index(address)
+        self.shard = config.server_addresses[self.shard_index]
+        # Global index of this shard's first server in the flattened order.
+        self.global_base = sum(
+            len(s) for s in config.server_addresses[: self.shard_index]
+        )
+        # Local logs for every server IN THIS SHARD (own + backups).
+        self.logs: List[BufferMap] = [BufferMap() for _ in self.shard]
+        self.watermarks: List[int] = [0] * len(self.shard)
+        # Chosen cuts: cut-slot -> flattened watermark vector.
+        self.cuts: Dict[int, tuple] = {}
+        # Cut slots below this are fully executed and GC'd: never
+        # re-projected (their log prefixes are gone).
+        self.min_cut_slot = 0
+        self._pushed_since = 0
+        # Per shard member (local index): backed-up entries not yet acked,
+        # re-sent on every push tick so one lost ScBackup can't freeze the
+        # min-cut below the entry forever.
+        self._backup_unacked: List[Dict[int, Command]] = [
+            {} for _ in self.shard
+        ]
+
+        def push() -> None:
+            self.push()
+            for local, unacked in enumerate(self._backup_unacked):
+                for slot, command in unacked.items():
+                    self.chan(self.shard[local]).send(
+                        ScBackup(
+                            server_index=self.global_base + self.index,
+                            slot=slot,
+                            command=command,
+                        )
+                    )
+            self.push_timer.start()
+
+        self.push_timer = self.timer("push", options.push_period, push)
+        self.push_timer.start()
+
+    def push(self) -> None:
+        self.chan(self.config.aggregator_address).send(
+            ScShardInfo(
+                shard_index=self.shard_index,
+                server_index=self.index,
+                watermark=tuple(self.watermarks),
+            )
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ScClientRequest):
+            self._handle_client_request(msg)
+        elif isinstance(msg, ScBackup):
+            local = msg.server_index - self.global_base
+            self.logs[local].put(msg.slot, msg.command)
+            self.watermarks[local] = self._watermark(local)
+            self.chan(src).send(
+                ScBackupAck(server_index=msg.server_index, slot=msg.slot)
+            )
+            # Cuts only cover fully-replicated prefixes (element-wise MIN
+            # at the aggregator), so a backed-up entry can't enter a cut
+            # until the backups' views reach the aggregator — push.
+            self._maybe_push()
+        elif isinstance(msg, ScBackupAck):
+            local = self.shard.index(src)
+            self._backup_unacked[local].pop(msg.slot, None)
+        elif isinstance(msg, ScCutChosen):
+            self._handle_cut_chosen(msg)
+        elif isinstance(msg, Recover):
+            self._handle_recover(src, msg)
+        elif isinstance(msg, ChosenWatermark):
+            self._garbage_collect(msg.slot)
+        else:
+            self.logger.fatal(f"unknown scalog server message {msg!r}")
+
+    def _watermark(self, local: int) -> int:
+        w = self.watermarks[local]
+        while self.logs[local].get(w) is not None:
+            w += 1
+        return w
+
+    def _handle_client_request(self, msg: ScClientRequest) -> None:
+        slot = self.watermarks[self.index]
+        self.logs[self.index].put(slot, msg.command)
+        self.watermarks[self.index] = self._watermark(self.index)
+        for i, server in enumerate(self.shard):
+            if i != self.index:
+                self._backup_unacked[i][slot] = msg.command
+                self.chan(server).send(
+                    ScBackup(
+                        server_index=self.global_base + self.index,
+                        slot=slot,
+                        command=msg.command,
+                    )
+                )
+        self._maybe_push()
+
+    def _maybe_push(self) -> None:
+        self._pushed_since += 1
+        if self.options.push_size > 0 and self._pushed_since >= self.options.push_size:
+            self.push()
+            self._pushed_since = 0
+            self.push_timer.reset()
+
+    def _project(self, cut_slot: int) -> Optional[List[Tuple[int, List[Command]]]]:
+        """The global-log segments this server's OWN log contributes for
+        the delta between cut cut_slot-1 and cut_slot (Server.projectCut).
+        Global order within a delta is server-major by global index."""
+        cut = self.cuts.get(cut_slot)
+        if cut is None:
+            return None
+        prev = self.cuts.get(cut_slot - 1)
+        if prev is None:
+            if cut_slot != 0:
+                return None
+            prev = tuple([0] * self.config.num_servers)
+        my_global = self.global_base + self.index
+        global_start = sum(prev) + sum(
+            cut[i] - prev[i] for i in range(my_global)
+        )
+        lo, hi = prev[my_global], cut[my_global]
+        commands = []
+        for slot in range(lo, hi):
+            command = self.logs[self.index].get(slot)
+            if command is None:
+                self.logger.fatal(
+                    f"server {my_global} missing local slot {slot} chosen in a cut"
+                )
+            commands.append(command)
+        return [(global_start, commands)] if commands else []
+
+    def _handle_cut_chosen(self, msg: ScCutChosen) -> None:
+        self.chan(self.config.aggregator_address).send(
+            ScCutChosenAck(slot=msg.slot)
+        )
+        if msg.slot < self.min_cut_slot:
+            return  # duplicate of a fully-executed, GC'd cut
+        already = msg.slot in self.cuts
+        self.cuts[msg.slot] = msg.cut
+        slots = [msg.slot] if already else [msg.slot, msg.slot + 1]
+        for s in slots:
+            if s < self.min_cut_slot:
+                continue
+            segments = self._project(s)
+            if not segments:
+                continue
+            for global_start, commands in segments:
+                # One Chosen per command keeps the replica's contiguous
+                # BufferMap semantics simple (a batch per global slot).
+                for replica in self.config.replica_addresses:
+                    for i, command in enumerate(commands):
+                        self.chan(replica).send(
+                            Chosen(
+                                slot=global_start + i,
+                                value=CommandBatchOrNoop(
+                                    CommandBatch((command,))
+                                ),
+                            )
+                        )
+
+    def _locate(self, global_slot: int) -> Optional[Tuple[int, int, int]]:
+        """Map a global-log slot to (cut_slot, owner_global_index,
+        owner_local_log_slot) from the retained cut history; None if the
+        covering cut (or its predecessor, needed for the delta) is
+        missing."""
+        for cut_slot in sorted(self.cuts):
+            cut = self.cuts[cut_slot]
+            prev = self.cuts.get(cut_slot - 1)
+            if prev is None:
+                if cut_slot != 0:
+                    continue
+                prev = tuple([0] * self.config.num_servers)
+            if not (sum(prev) <= global_slot < sum(cut)):
+                continue
+            offset = global_slot - sum(prev)
+            for i in range(self.config.num_servers):
+                delta = cut[i] - prev[i]
+                if offset < delta:
+                    return (cut_slot, i, prev[i] + offset)
+                offset -= delta
+        return None
+
+    def _handle_recover(self, src: Address, msg: Recover) -> None:
+        """The aggregator located this server's SHARD as the owner of a
+        global slot; any member holding the entry (the owner or a backup)
+        re-sends it to EVERY replica (the Recover was relayed, so src is
+        the aggregator, not the stuck replica)."""
+        located = self._locate(msg.slot)
+        if located is None:
+            return
+        _, owner, local_slot = located
+        local = owner - self.global_base
+        if not (0 <= local < len(self.shard)):
+            return
+        command = self.logs[local].get(local_slot)
+        if command is None:
+            return
+        chosen = Chosen(
+            slot=msg.slot,
+            value=CommandBatchOrNoop(CommandBatch((command,))),
+        )
+        for replica in self.config.replica_addresses:
+            self.chan(replica).send(chosen)
+
+    def _garbage_collect(self, executed: int) -> None:
+        """All replicas executed global slots < ``executed``: drop local
+        log prefixes and cut history that only cover executed deltas.
+        The newest fully-executed cut is RETAINED — it is the ``prev`` of
+        the next delta's projection."""
+        newest_done = None
+        for cut_slot in sorted(self.cuts):
+            if sum(self.cuts[cut_slot]) <= executed:
+                newest_done = cut_slot
+            else:
+                break
+        if newest_done is None:
+            return
+        cut = self.cuts[newest_done]
+        for local in range(len(self.shard)):
+            self.logs[local].garbage_collect(cut[self.global_base + local])
+        for cut_slot in [s for s in self.cuts if s < newest_done]:
+            del self.cuts[cut_slot]
+        self.min_cut_slot = max(self.min_cut_slot, newest_done + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScAggregatorOptions:
+    num_shard_cuts_per_proposal: int = 2
+    recover_period: float = 1.0
+
+
+class ScAggregator(Actor):
+    def __init__(self, address, transport, logger, config: ScalogConfig,
+                 options: ScAggregatorOptions = ScAggregatorOptions()):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.options = options
+        # Per shard, per server-in-shard: that server's view of the shard's
+        # watermark vector; the shard cut is the pairwise max.
+        self.shard_cuts: List[List[tuple]] = [
+            [tuple([0] * len(shard)) for _ in shard]
+            for shard in config.server_addresses
+        ]
+        self.round = 0
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        # Out-of-order chosen raw cuts waiting to be processed; entries are
+        # popped as the watermark advances, so a non-empty dict means a
+        # HOLE — a lost RawCutChosen — which the recover timer re-requests
+        # from the leaders (they cache chosen cuts for exactly this).
+        self.raw_cuts: Dict[int, Optional[tuple]] = {}
+        self.raw_cuts_watermark = 0
+        self.raw_cuts_processed = 0
+        # The ordered, pruned cut log. GC (driven by replica
+        # ChosenWatermarks) drops fully-executed cuts; the newest dropped
+        # cut is retained as ``cuts_base_prev`` — it is the delta
+        # predecessor of cuts[0] — and ``cuts_base_slot`` is cuts[0]'s
+        # absolute slot in the pruned cut log.
+        self.cuts: List[tuple] = []
+        self.cuts_base_slot = 0
+        self.cuts_base_prev = tuple([0] * config.num_servers)
+        self._since_proposal = 0
+        self.replica_watermarks: Dict[object, int] = {}
+        self._forwarded_watermark = 0
+        # Per server: the newest cut-log slot it has acknowledged.
+        self.server_cut_acks: Dict[object, int] = {}
+
+        def recover() -> None:
+            # A hole in the raw cut log (a lost leader->aggregator
+            # RawCutChosen): re-request it from the leaders' caches.
+            if self.raw_cuts:
+                msg = ScRecoverRawCut(slot=self.raw_cuts_watermark)
+                for leader in self.config.leader_addresses:
+                    self.chan(leader).send(msg)
+            # Re-broadcast the NEWEST cut to servers that haven't acked
+            # it: a trailing lost ScCutChosen has no later cut to chain
+            # from and no replica hole to trigger recovery, so this
+            # periodic nudge is its only repair path. Once every server
+            # acks, the quiescent system sends nothing.
+            if self.cuts:
+                slot = self.cuts_base_slot + len(self.cuts) - 1
+                chosen = ScCutChosen(slot=slot, cut=self.cuts[-1])
+                for server in self.config.flat_servers:
+                    if self.server_cut_acks.get(server, -1) < slot:
+                        self.chan(server).send(chosen)
+            # Push the processed raw-cut watermark so leaders/acceptors
+            # can drop vote state and cut caches that can never be
+            # requested again.
+            if self.raw_cuts_watermark > 0:
+                wm = ScRawWatermark(slot=self.raw_cuts_watermark)
+                for leader in self.config.leader_addresses:
+                    self.chan(leader).send(wm)
+                for acceptor in self.config.acceptor_addresses:
+                    self.chan(acceptor).send(wm)
+            self.recover_timer.start()
+
+        self.recover_timer = self.timer(
+            "recoverRawCut", options.recover_period, recover
+        )
+        self.recover_timer.start()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ScShardInfo):
+            self._handle_shard_info(msg)
+        elif isinstance(msg, ScRawCutChosen):
+            self._handle_raw_cut_chosen(msg)
+        elif isinstance(msg, Recover):
+            self._handle_recover(src, msg)
+        elif isinstance(msg, ChosenWatermark):
+            self._handle_chosen_watermark(src, msg)
+        elif isinstance(msg, ScCutChosenAck):
+            self.server_cut_acks[src] = max(
+                self.server_cut_acks.get(src, -1), msg.slot
+            )
+        elif isinstance(msg, ScLeaderInfo):
+            if msg.round > self.round:
+                self.round = msg.round
+        else:
+            self.logger.fatal(f"unknown aggregator message {msg!r}")
+
+    def _handle_shard_info(self, msg: ScShardInfo) -> None:
+        current = self.shard_cuts[msg.shard_index][msg.server_index]
+        self.shard_cuts[msg.shard_index][msg.server_index] = tuple(
+            max(a, b) for a, b in zip(current, msg.watermark)
+        )
+        self._since_proposal += 1
+        if self._since_proposal >= self.options.num_shard_cuts_per_proposal:
+            # A shard's cut entry is the element-wise MIN over its members'
+            # views: only a fully-replicated log prefix may enter a cut, so
+            # losing any single server never loses a chosen entry.
+            cut = tuple(
+                x
+                for shard in self.shard_cuts
+                for x in (tuple(min(v) for v in zip(*shard)))
+            )
+            # Only propose cuts that would ADVANCE the newest chosen cut:
+            # gating on the chosen (not the last proposed) cut means a
+            # lost proposal is re-proposed on the next ShardInfo tick, but
+            # a quiescent system runs no Paxos rounds at all.
+            newest = self.cuts[-1] if self.cuts else self.cuts_base_prev
+            if any(a > b for a, b in zip(cut, newest)):
+                leader = self.config.leader_addresses[
+                    self.round_system.leader(self.round)
+                ]
+                self.chan(leader).send(ScProposeCut(cut=cut))
+            self._since_proposal = 0
+
+    def _handle_raw_cut_chosen(self, msg: ScRawCutChosen) -> None:
+        if msg.slot < self.raw_cuts_watermark or msg.slot in self.raw_cuts:
+            return
+        self.raw_cuts[msg.slot] = msg.cut
+        while self.raw_cuts_watermark in self.raw_cuts:
+            cut = self.raw_cuts.pop(self.raw_cuts_watermark)
+            self.raw_cuts_processed += 1
+            if cut is not None:
+                # Order and prune: only strictly-monotone cuts advance the
+                # global log (Aggregator.handleRawCutChosen).
+                last = self.cuts[-1] if self.cuts else self.cuts_base_prev
+                if all(a <= b for a, b in zip(last, cut)) and last != cut:
+                    slot = self.cuts_base_slot + len(self.cuts)
+                    self.cuts.append(cut)
+                    chosen = ScCutChosen(slot=slot, cut=cut)
+                    for server in self.config.flat_servers:
+                        self.chan(server).send(chosen)
+            self.raw_cuts_watermark += 1
+
+    def _handle_recover(self, src: Address, msg: Recover) -> None:
+        """A replica is missing global slot msg.slot: find the owning
+        server from the cut log (Aggregator.findSlot) and ask its WHOLE
+        shard to re-send — any member (owner or backup) holds the entry,
+        so a crashed owner doesn't wedge recovery. The covering cut and
+        its predecessor are re-sent too, in case the hole exists because
+        the ScCutChosen itself was lost."""
+        prev = self.cuts_base_prev
+        for idx, cut in enumerate(self.cuts):
+            if not (sum(prev) <= msg.slot < sum(cut)):
+                prev = cut
+                continue
+            offset = msg.slot - sum(prev)
+            for i in range(self.config.num_servers):
+                delta = cut[i] - prev[i]
+                if offset < delta:
+                    shard = self.config.server_addresses[
+                        self.config.shard_of(i)
+                    ]
+                    slot = self.cuts_base_slot + idx
+                    for server in shard:
+                        if slot > 0:
+                            self.chan(server).send(
+                                ScCutChosen(slot=slot - 1, cut=prev)
+                            )
+                        self.chan(server).send(ScCutChosen(slot=slot, cut=cut))
+                        self.chan(server).send(Recover(slot=msg.slot))
+                    return
+                offset -= delta
+            return
+
+    def _handle_chosen_watermark(self, src: Address, msg: ChosenWatermark) -> None:
+        """Replicas broadcast their executed watermark; once EVERY replica
+        has executed past a cut, that cut's entries can never be recovered
+        again, so servers may drop the covered log prefixes and the
+        aggregator may prune its own cut history."""
+        self.replica_watermarks[src] = max(
+            self.replica_watermarks.get(src, 0), msg.slot
+        )
+        if len(self.replica_watermarks) < self.config.num_replicas:
+            return
+        executed = min(self.replica_watermarks.values())
+        if executed <= self._forwarded_watermark:
+            return
+        self._forwarded_watermark = executed
+        for server in self.config.flat_servers:
+            self.chan(server).send(ChosenWatermark(slot=executed))
+        newest_done = None
+        for idx, cut in enumerate(self.cuts):
+            if sum(cut) <= executed:
+                newest_done = idx
+            else:
+                break
+        if newest_done is not None:
+            self.cuts_base_prev = self.cuts[newest_done]
+            self.cuts_base_slot += newest_done + 1
+            del self.cuts[: newest_done + 1]
+
+
+class ScLeader(Actor):
+    """The cut-ordering Paxos leader: a log of cuts chosen with 2f+1
+    acceptors, ClassicRoundRobin rounds, phase-1 repair on failover."""
+
+    def __init__(self, address, transport, logger, config: ScalogConfig,
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = 0 if self.index == 0 else -1
+        self.active = self.index == 0
+        self.next_slot = 0
+        # slot -> {round, cut, votes}
+        self.phase2s: Dict[int, dict] = {}
+        # In-flight phase 1 responses; None when no phase 1 is running.
+        self.phase1bs: Optional[Dict[int, ScPhase1b]] = None
+        # Aggregator-reported processed watermark: phase 1 on failover
+        # skips raw slots below it (the aggregator discards them anyway).
+        self.raw_watermark = 0
+        # Chosen cuts cached so a lost RawCutChosen can be re-sent when the
+        # aggregator asks (ScRecoverRawCut); GC'd below its watermark.
+        self.chosen_cuts: Dict[int, Optional[tuple]] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ScProposeCut):
+            self._handle_propose_cut(msg)
+        elif isinstance(msg, ScPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, ScPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, ScNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, ScRawCutChosen):
+            self.chosen_cuts[msg.slot] = msg.cut
+            if msg.slot >= self.next_slot:
+                self.next_slot = msg.slot + 1
+        elif isinstance(msg, ScRecoverRawCut):
+            self._handle_recover_raw_cut(msg)
+        elif isinstance(msg, ScRawWatermark):
+            self.raw_watermark = max(self.raw_watermark, msg.slot)
+            for slot in [s for s in self.chosen_cuts if s < msg.slot]:
+                del self.chosen_cuts[slot]
+        else:
+            self.logger.fatal(f"unknown scalog leader message {msg!r}")
+
+    def _handle_recover_raw_cut(self, msg: ScRecoverRawCut) -> None:
+        for slot in [s for s in self.chosen_cuts if s < msg.slot]:
+            del self.chosen_cuts[slot]
+        if msg.slot in self.chosen_cuts:
+            self.chan(self.config.aggregator_address).send(
+                ScRawCutChosen(slot=msg.slot, cut=self.chosen_cuts[msg.slot])
+            )
+        elif self.active:
+            # Not chosen yet — lost Phase2a/2bs stalled the slot. Re-drive
+            # phase 2 in our CURRENT round: a cached phase-2 round may be
+            # stale after preemption + re-election, and acceptors would
+            # nack it forever. Phase 1 of the current round guarantees no
+            # value was chosen at this slot in any lower round, so
+            # re-proposing the cached cut — or a noop when we have no
+            # record (we were re-elected with no vote history) — is safe.
+            if msg.slot in self.phase2s:
+                cut = self.phase2s[msg.slot]["cut"]
+            elif msg.slot < self.next_slot:
+                cut = None
+            else:
+                return  # normal proposals will reach this slot
+            self.phase2s[msg.slot] = {
+                "round": self.round, "cut": cut, "votes": set()
+            }
+            phase2a = ScPhase2a(slot=msg.slot, round=self.round, cut=cut)
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(phase2a)
+
+    def _handle_propose_cut(self, msg: ScProposeCut) -> None:
+        if not self.active:
+            return
+        slot = self.next_slot
+        self.next_slot += 1
+        self.phase2s[slot] = {"round": self.round, "cut": msg.cut, "votes": set()}
+        phase2a = ScPhase2a(slot=slot, round=self.round, cut=msg.cut)
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase2a)
+
+    def _handle_phase2b(self, msg: ScPhase2b) -> None:
+        phase2 = self.phase2s.get(msg.slot)
+        if phase2 is None or msg.round != phase2["round"]:
+            return
+        phase2["votes"].add(msg.acceptor_index)
+        if len(phase2["votes"]) < self.config.f + 1:
+            return
+        del self.phase2s[msg.slot]
+        self.chosen_cuts[msg.slot] = phase2["cut"]
+        raw = ScRawCutChosen(slot=msg.slot, cut=phase2["cut"])
+        self.chan(self.config.aggregator_address).send(raw)
+        for leader in self.config.leader_addresses:
+            if leader != self.address:
+                self.chan(leader).send(raw)
+
+    def become_leader(self) -> None:
+        """Failover entry point: take over the cut log in a higher round.
+        The leader stays INACTIVE (drops ScProposeCuts) until phase 1
+        completes — proposing fresh cuts at slots the old leader may have
+        already gotten chosen would violate Paxos."""
+        self.round = self.round_system.next_classic_round(self.index, self.round)
+        self.active = False
+        self.phase1bs = {}
+        phase1a = ScPhase1a(
+            round=self.round, chosen_watermark=self.raw_watermark
+        )
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase1a)
+
+    def _handle_phase1b(self, msg: ScPhase1b) -> None:
+        if self.phase1bs is None or msg.round != self.round:
+            return
+        self.phase1bs[msg.acceptor_index] = msg
+        if len(self.phase1bs) < self.config.f + 1:
+            return
+        best: Dict[int, Tuple[int, Optional[tuple]]] = {}
+        for b in self.phase1bs.values():
+            for slot, vote_round, cut in b.votes:
+                if slot not in best or vote_round > best[slot][0]:
+                    best[slot] = (vote_round, cut)
+        max_slot = max(best, default=-1)
+        for slot in range(self.raw_watermark, max_slot + 1):
+            cut = best.get(slot, (-1, None))[1]
+            self.phase2s[slot] = {"round": self.round, "cut": cut, "votes": set()}
+            phase2a = ScPhase2a(slot=slot, round=self.round, cut=cut)
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(phase2a)
+        self.next_slot = max(self.next_slot, max_slot + 1)
+        self.phase1bs = None
+        self.active = True
+        # Route the aggregator's future proposals to this leader.
+        self.chan(self.config.aggregator_address).send(
+            ScLeaderInfo(round=self.round)
+        )
+
+    def _handle_nack(self, msg: ScNack) -> None:
+        if msg.round <= self.round:
+            return
+        if self.active or self.phase1bs is not None:
+            # Adopt the nacked round, then advance once to our own next
+            # round (become_leader does the single advance).
+            self.round = msg.round
+            self.become_leader()
+
+
+class ScAcceptor(Actor):
+    def __init__(self, address, transport, logger, config: ScalogConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = -1
+        # slot -> (vote_round, cut)
+        self.votes: Dict[int, Tuple[int, Optional[tuple]]] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, ScPhase1a):
+            if msg.round < self.round:
+                self.chan(src).send(ScNack(round=self.round))
+                return
+            self.round = msg.round
+            self.chan(src).send(
+                ScPhase1b(
+                    acceptor_index=self.index,
+                    round=msg.round,
+                    votes=tuple(
+                        (slot, vr, cut)
+                        for slot, (vr, cut) in sorted(self.votes.items())
+                        if slot >= msg.chosen_watermark
+                    ),
+                )
+            )
+        elif isinstance(msg, ScPhase2a):
+            if msg.round < self.round:
+                self.chan(src).send(ScNack(round=self.round))
+                return
+            self.round = msg.round
+            self.votes[msg.slot] = (msg.round, msg.cut)
+            self.chan(src).send(
+                ScPhase2b(
+                    acceptor_index=self.index, slot=msg.slot, round=msg.round
+                )
+            )
+        elif isinstance(msg, ScRawWatermark):
+            # The aggregator processed raw slots below msg.slot and will
+            # discard any re-choice of them: the votes are garbage.
+            for slot in [s for s in self.votes if s < msg.slot]:
+                del self.votes[slot]
+        else:
+            self.logger.fatal(f"unknown scalog acceptor message {msg!r}")
+
+
+@dataclasses.dataclass
+class _ScPending:
+    id: int
+    result: Promise
+    resend: object
+
+
+class ScClient(Actor):
+    def __init__(self, address, transport, logger, config: ScalogConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _ScPending] = {}
+
+    def _server(self) -> Address:
+        servers = self.config.flat_servers
+        return servers[self.rng.randrange(len(servers))]
+
+    def write(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = ScClientRequest(
+            Command(
+                command_id=CommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=id,
+                ),
+                command=command,
+            )
+        )
+        self.chan(self._server()).send(request)
+
+        def resend() -> None:
+            self.chan(self._server()).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendSc[{pseudonym};{id}]", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _ScPending(id=id, result=promise, resend=timer)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        # Replies come from the reused multipaxos Replica (ClientReply) or
+        # its ReadReply; only ClientReply occurs in Scalog.
+        from frankenpaxos_tpu.protocols.multipaxos.messages import ClientReply
+
+        if not isinstance(msg, ClientReply):
+            self.logger.fatal(f"unknown scalog client message {msg!r}")
+        pseudonym = msg.command_id.client_pseudonym
+        pending = self.pending.get(pseudonym)
+        if pending is None or msg.command_id.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[pseudonym]
+        pending.result.success(msg.result)

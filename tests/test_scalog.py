@@ -1,0 +1,469 @@
+"""Scalog sim tests (the analog of the reference's scalog unit/sim
+coverage): shard-local appends, aggregator cuts, the cut-ordering Paxos
+group, projection onto the global log, recovery of dropped entries, and
+leader failover — all on one SimTransport."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import scalog as sc
+from frankenpaxos_tpu.protocols.multipaxos.messages import Chosen
+from frankenpaxos_tpu.protocols.multipaxos.replica import (
+    Replica,
+    ReplicaOptions,
+)
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+class ScalogCluster:
+    def __init__(self, seed=0, f=1, num_shards=2, num_clients=2):
+        logger = FakeLogger(LogLevel.FATAL)
+        self.transport = SimTransport(logger)
+        t = self.transport
+        self.config = sc.ScalogConfig(
+            f=f,
+            server_addresses=tuple(
+                tuple(SimAddress(f"server_{s}_{i}") for i in range(f + 1))
+                for s in range(num_shards)
+            ),
+            aggregator_address=SimAddress("aggregator"),
+            leader_addresses=tuple(
+                SimAddress(f"leader{i}") for i in range(f + 1)
+            ),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(2 * f + 1)
+            ),
+            replica_addresses=tuple(
+                SimAddress(f"replica{i}") for i in range(f + 1)
+            ),
+        )
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        self.servers = [
+            sc.ScServer(
+                a, t, log(), self.config,
+                sc.ScServerOptions(push_size=1), seed=seed + 100 + i,
+            )
+            for i, a in enumerate(self.config.flat_servers)
+        ]
+        self.aggregator = sc.ScAggregator(
+            self.config.aggregator_address, t, log(), self.config,
+            sc.ScAggregatorOptions(num_shard_cuts_per_proposal=1),
+        )
+        self.leaders = [
+            sc.ScLeader(a, t, log(), self.config, seed=seed + 200 + i)
+            for i, a in enumerate(self.config.leader_addresses)
+        ]
+        self.acceptors = [
+            sc.ScAcceptor(a, t, log(), self.config)
+            for a in self.config.acceptor_addresses
+        ]
+        self.replicas = [
+            Replica(
+                a, t, log(), ReadableAppendLog(),
+                sc.replica_config(self.config),
+                ReplicaOptions(
+                    log_grow_size=100,
+                    send_chosen_watermark_every_n_entries=10,
+                ),
+                seed=seed + 300 + i,
+            )
+            for i, a in enumerate(self.config.replica_addresses)
+        ]
+        self.clients = [
+            sc.ScClient(
+                SimAddress(f"client{i}"), t, log(), self.config,
+                seed=seed + 400 + i,
+            )
+            for i in range(num_clients)
+        ]
+
+    def drain(self, max_steps=200000):
+        steps = 0
+        t = self.transport
+        while t.messages and steps < max_steps:
+            t.deliver_message(t.messages[0])
+            steps += 1
+        assert steps < max_steps, "message drain did not terminate"
+
+    def pump(self, rounds=8, skip=lambda timer: False):
+        """Drain, then alternate timer firings and drains — the sim analog
+        of letting push/resend/recover timers make progress."""
+        self.drain()
+        for _ in range(rounds):
+            for timer in list(self.transport.running_timers()):
+                if not skip(timer):
+                    self.transport.trigger_timer(timer.address, timer.name())
+            self.drain()
+
+
+def test_scalog_single_write():
+    """One write lands in every replica's log and the client's promise
+    resolves with the append index."""
+    cluster = ScalogCluster()
+    p = cluster.clients[0].write(0, b"hello")
+    cluster.pump()
+    assert p.done
+    for replica in cluster.replicas:
+        assert replica.state_machine.log == [b"hello"]
+
+
+def test_scalog_multi_shard_total_order():
+    """Writes spread over both shards end with identical replica logs
+    (the global log is a total order, not per-shard)."""
+    cluster = ScalogCluster(seed=7, num_clients=3)
+    promises = []
+    for i, client in enumerate(cluster.clients):
+        for pseudonym in (0, 1):
+            promises.append(client.write(pseudonym, f"w{i}.{pseudonym}".encode()))
+    cluster.pump()
+    assert all(p.done for p in promises)
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    assert len(logs) == 1, logs
+    (log,) = logs
+    assert sorted(log) == sorted(
+        f"w{i}.{p}".encode() for i in range(3) for p in (0, 1)
+    )
+
+
+def test_scalog_servers_route_through_both_shards():
+    """Sanity: with enough writes, the chosen cuts credit servers in BOTH
+    shards (clients pick a uniformly random server, and any server — not
+    just a designated primary — accepts appends)."""
+    cluster = ScalogCluster(seed=3, num_clients=4)
+    for rnd in range(4):
+        for i, client in enumerate(cluster.clients):
+            client.write(rnd, f"r{rnd}c{i}".encode())
+        cluster.pump()
+    final = cluster.aggregator.cuts[-1]
+    assert sum(final) == 16
+    shard0, shard1 = final[:2], final[2:]
+    assert sum(shard0) > 0 and sum(shard1) > 0, final
+
+
+def test_scalog_dropped_chosen_recovered_via_aggregator():
+    """A replica that misses a Chosen has a log hole; its recover timer
+    sends Recover to the aggregator, which locates the owning server from
+    the cut log and has it re-send (Aggregator.findSlot path)."""
+    cluster = ScalogCluster(seed=11)
+    t = cluster.transport
+    victim = cluster.config.replica_addresses[1]
+    p = cluster.clients[0].write(0, b"lost")
+    # Drop every Chosen headed at replica 1; deliver everything else.
+    while t.messages:
+        m = t.messages[0]
+        from frankenpaxos_tpu.core import wire
+        if m.dst == victim and isinstance(wire.decode(m.data), Chosen):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert p.done  # replica 0 executed and replied
+    assert cluster.replicas[1].state_machine.log == []
+    # Second write creates a hole AFTER the missing slot so the recover
+    # timer (which fires on executed_watermark) targets slot 0.
+    p2 = cluster.clients[0].write(0, b"next")
+    cluster.pump()
+    assert p2.done
+    assert cluster.replicas[1].state_machine.log == [b"lost", b"next"]
+
+
+def test_scalog_leader_failover_repairs_cut_log():
+    """Kill leader 0 mid-slot, have leader 1 take over: phase 1 re-chooses
+    the in-flight cut in the higher round and the write completes."""
+    cluster = ScalogCluster(seed=13)
+    t = cluster.transport
+    dead = cluster.config.leader_addresses[0]
+    p = cluster.clients[0].write(0, b"failover")
+    # Deliver everything except the Phase2bs headed back at leader 0: the
+    # acceptors have voted, but the leader dies before learning it.
+    from frankenpaxos_tpu.core import wire
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == dead and isinstance(wire.decode(m.data), sc.ScPhase2b):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    t.partition_actor(dead)
+    assert all(r.state_machine.log == [] for r in cluster.replicas)
+    cluster.leaders[1].become_leader()
+    cluster.pump(skip=lambda timer: timer.address == dead)
+    assert p.done
+    for replica in cluster.replicas:
+        assert replica.state_machine.log == [b"failover"]
+    # The new leader announced itself to the aggregator, so proposals
+    # reroute and writes issued AFTER the failover also commit.
+    p2 = cluster.clients[1].write(0, b"post-failover")
+    cluster.pump(skip=lambda timer: timer.address == dead)
+    assert p2.done
+    for replica in cluster.replicas:
+        assert replica.state_machine.log == [b"failover", b"post-failover"]
+
+
+def test_scalog_nonmonotone_cuts_pruned():
+    """Duplicate or stale chosen cuts must not double-count entries: the
+    aggregator proposes only cuts that ADVANCE the newest chosen cut, and
+    any non-monotone raw cut that still gets chosen (in-flight races) is
+    pruned from the ordered cut log."""
+    cluster = ScalogCluster(seed=17)
+    agg = cluster.aggregator
+    p = cluster.clients[0].write(0, b"once")
+    cluster.pump()
+    assert p.done
+    processed_before = agg.raw_cuts_processed
+    # Re-pushing unchanged watermarks proposes NOTHING (no Paxos rounds).
+    for server in cluster.servers:
+        server.push()
+    cluster.pump()
+    assert agg.raw_cuts_processed == processed_before
+    # A raced duplicate of an already-chosen cut at a later raw slot is
+    # ordered but PRUNED (not appended to the cut log).
+    stale = agg.cuts[-1]
+    agg.receive(
+        cluster.config.leader_addresses[0],
+        sc.ScRawCutChosen(slot=agg.raw_cuts_watermark, cut=stale),
+    )
+    cluster.drain()
+    assert agg.raw_cuts_processed == processed_before + 1
+    assert list(agg.cuts) == [stale]
+    for replica in cluster.replicas:
+        assert replica.state_machine.log == [b"once"]
+
+
+def test_scalog_lost_raw_cut_chosen_recovered():
+    """A lost leader->aggregator RawCutChosen leaves a hole in the raw cut
+    log; without recovery the watermark wedges and NO later write can ever
+    commit. The aggregator's recover timer re-requests the slot from the
+    leaders' chosen-cut caches."""
+    from frankenpaxos_tpu.core import wire
+
+    cluster = ScalogCluster(seed=23)
+    t = cluster.transport
+    p = cluster.clients[0].write(0, b"wedge?")
+    dropped = 0
+    while t.messages:
+        m = t.messages[0]
+        if (
+            dropped == 0
+            and m.dst == cluster.config.aggregator_address
+            and isinstance(wire.decode(m.data), sc.ScRawCutChosen)
+        ):
+            t.drop_message(m)
+            dropped += 1
+        else:
+            t.deliver_message(m)
+    assert dropped == 1
+    assert not p.done
+    # A later write chooses a HIGHER raw slot; the aggregator must detect
+    # the hole below it and recover. Everything then commits in order.
+    p2 = cluster.clients[1].write(0, b"after")
+    cluster.pump()
+    assert p.done and p2.done
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    assert logs == {(b"wedge?", b"after")}, logs
+
+
+def test_scalog_backup_serves_recovery_after_owner_crash():
+    """Cuts only cover fully-replicated prefixes, so when the server that
+    ORIGINATED an entry crashes, its in-shard backup can serve recovery:
+    the aggregator routes Recover to the whole owning shard."""
+    from frankenpaxos_tpu.core import wire
+
+    cluster = ScalogCluster(seed=29)
+    t = cluster.transport
+    owner = cluster.config.flat_servers[0]
+
+    class _Pick0:
+        def randrange(self, n):
+            return 0
+
+    cluster.clients[0].rng = _Pick0()
+    victim = cluster.config.replica_addresses[1]
+    p = cluster.clients[0].write(0, b"backed-up")
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == victim and isinstance(wire.decode(m.data), Chosen):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert p.done
+    assert cluster.replicas[1].state_machine.log == []
+    # The originating server dies. Its backup (same shard) holds the entry.
+    t.partition_actor(owner)
+
+    class _Pick2:
+        def randrange(self, n):
+            return 2  # a server in the OTHER shard
+
+    cluster.clients[1].rng = _Pick2()
+    p2 = cluster.clients[1].write(0, b"later")
+    cluster.pump(skip=lambda timer: timer.address == owner)
+    assert p2.done
+    assert cluster.replicas[1].state_machine.log == [b"backed-up", b"later"]
+
+
+def test_scalog_garbage_collection():
+    """Replica ChosenWatermarks flow through the aggregator to the
+    servers: fully-executed cuts are pruned everywhere and local log
+    prefixes are dropped."""
+    cluster = ScalogCluster(seed=31)
+    for rnd in range(12):
+        ps = [c.write(rnd, f"r{rnd}c{i}".encode())
+              for i, c in enumerate(cluster.clients)]
+        cluster.pump()
+        assert all(p.done for p in ps)
+    # 24 entries total; watermark broadcasts are round-robin sharded over
+    # replicas every 10 executions, so by now EVERY replica has reported
+    # to the aggregator and min-over-reports allows GC.
+    assert cluster.aggregator.cuts_base_slot > 0
+    assert all(len(s.cuts) < cluster.aggregator.raw_cuts_processed
+               for s in cluster.servers)
+    assert any(
+        log.watermark > 0 for s in cluster.servers for log in s.logs
+    )
+    # And the system still works after pruning.
+    ps = [c.write(9, b"post-gc") for c in cluster.clients[:1]]
+    cluster.pump()
+    assert all(p.done for p in ps)
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    assert len(logs) == 1
+
+
+def test_scalog_recover_raw_cut_after_reelection():
+    """Regression: a leader preempted and RE-elected holds a stale
+    phase-2 round for a stalled slot. Recovery must re-propose in the
+    CURRENT round — replaying the cached round draws equal-round nacks
+    forever and wedges the cut log on the hole."""
+    from frankenpaxos_tpu.core import wire
+
+    cluster = ScalogCluster(seed=41)
+    t = cluster.transport
+    p = cluster.clients[0].write(0, b"stuck")
+    # Slot 0's Phase2as all vanish: proposed, never voted.
+    while t.messages:
+        m = t.messages[0]
+        if isinstance(wire.decode(m.data), sc.ScPhase2a):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert 0 in cluster.leaders[0].phase2s
+    # Leader 1 takes over (round 1), then leader 0 re-takes (round 2);
+    # neither phase 1 sees any vote for slot 0, and leader 0 keeps
+    # next_slot=1, so slot 0 stays a permanent hole without recovery.
+    cluster.leaders[1].become_leader()
+    cluster.drain()
+    cluster.leaders[0].become_leader()
+    cluster.drain()
+    assert cluster.leaders[0].active and cluster.leaders[0].round == 2
+    p2 = cluster.clients[1].write(0, b"later")
+    cluster.pump(rounds=12)
+    assert p.done and p2.done
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    assert len(logs) == 1, logs
+
+
+def test_scalog_chaos_converges():
+    """Liveness under lossy chaos: 10% drops + 5% duplicates across ALL
+    message types, then a fault-free repair phase. Every retransmission
+    path (client resend, backup acks, phase-2 re-drive, raw-cut recovery,
+    newest-cut re-broadcast, replica hole recovery) must cooperate for
+    all writes to commit."""
+    cluster = ScalogCluster(seed=37, num_clients=3)
+    t = cluster.transport
+    rng = random.Random(99)
+    promises = []
+    for burst in range(5):
+        for i, client in enumerate(cluster.clients):
+            promises.append(client.write(burst, f"b{burst}c{i}".encode()))
+        steps = 0
+        while t.messages and steps < 5000:
+            m = t.messages[0]
+            r = rng.random()
+            if r < 0.10:
+                t.drop_message(m)
+            elif r < 0.15:
+                t.duplicate_message(m)
+            else:
+                t.deliver_message(m)
+            steps += 1
+    cluster.pump(rounds=30)
+    assert all(p.done for p in promises)
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    assert len(logs) == 1
+    assert len(next(iter(logs))) == len(promises)
+
+
+# -- Randomized safety --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WriteCmd:
+    client_index: int
+    pseudonym: int
+    value: bytes
+
+
+class SimulatedScalog(SimulatedSystem):
+    def __init__(self, f=1, num_shards=2):
+        self.f = f
+        self.num_shards = num_shards
+
+    def new_system(self, seed):
+        return ScalogCluster(seed=seed, f=self.f, num_shards=self.num_shards)
+
+    def get_state(self, system):
+        return tuple(
+            tuple(r.state_machine.log) for r in system.replicas
+        )
+
+    def generate_command(self, system, rng):
+        ops = []
+        for i, client in enumerate(system.clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in client.pending:
+                    ops.append(
+                        (1, WriteCmd(i, pseudonym, f"v{rng.randrange(100)}".encode()))
+                    )
+        return mixed_command(rng, system.transport, ops)
+
+    def run_command(self, system, command):
+        if isinstance(command, WriteCmd):
+            system.clients[command.client_index].write(
+                command.pseudonym, command.value
+            )
+        else:
+            system.transport.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return (
+                        f"replica logs not prefix-compatible: {a!r} vs {b!r}"
+                    )
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"replica log shrank or changed: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f,num_shards", [(1, 1), (1, 2), (2, 2)])
+def test_scalog_safety_randomized(f, num_shards):
+    bad = simulate_and_minimize(
+        SimulatedScalog(f, num_shards), run_length=150, num_runs=10,
+        seed=10 * f + num_shards,
+    )
+    assert bad is None, f"\n{bad}"
