@@ -519,6 +519,46 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_fastmultipaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import fastmultipaxos as fmx
+    from frankenpaxos_tpu.roundsystem import MixedRoundRobin
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = fmx.FastMultiPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("fml0"), SimAddress("fml1")),
+            leader_election_addresses=(
+                SimAddress("fme0"), SimAddress("fme1"),
+            ),
+            leader_heartbeat_addresses=(
+                SimAddress("fmh0"), SimAddress("fmh1"),
+            ),
+            acceptor_addresses=tuple(SimAddress(f"fma{i}") for i in range(3)),
+            acceptor_heartbeat_addresses=tuple(
+                SimAddress(f"fmah{i}") for i in range(3)
+            ),
+            round_system=MixedRoundRobin(2),
+        )
+        for i, a in enumerate(config.leader_addresses):
+            fmx.FmpLeader(a, t, log(), config, ReadableAppendLog(), seed=i)
+        for i, a in enumerate(config.acceptor_addresses):
+            fmx.FmpAcceptor(a, t, log(), config, seed=10 + i)
+        _drain(t)  # finish phase 1 + any-suffix before clients write
+        return [
+            fmx.FmpClient(SimAddress(f"fmc{i}"), t, log(), config, seed=40 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [c.propose(0, f"cmd{i}".encode()) for i, c in enumerate(clients)]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_scalog(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -607,6 +647,7 @@ SMOKES = {
     "mencius": smoke_mencius,
     "unanimousbpaxos": smoke_unanimousbpaxos,
     "matchmakerpaxos": smoke_matchmakerpaxos,
+    "fastmultipaxos": smoke_fastmultipaxos,
     "scalog": smoke_scalog,
     "multipaxos": smoke_multipaxos,
     "tpu": smoke_tpu,

@@ -1,0 +1,927 @@
+"""Fast MultiPaxos (reference ``fastmultipaxos/``: Client, Leader,
+Acceptor; protocol cheatsheet in ``FastMultiPaxos.proto``).
+
+In a FAST round, clients send commands straight to the acceptors, which
+vote for them in the next free slot if they previously received the
+leader's distinguished "any" value for that slot — saving a message
+delay versus classic Paxos. The leader collects phase 2b votes: a value
+with ``fast_quorum_size`` (= f + majority-of-f+1) identical votes is
+chosen; if no value can still reach a fast quorum the slot is STUCK and
+the leader bumps to a higher round (``Leader.scala:692-737``). Classic
+rounds work like ordinary MultiPaxos with the leader proposing. Phase 1
+repair picks, per slot, the highest-vote-round values and applies the
+O4 "popular item" rule from the Fast Paxos paper
+(``Leader.scala:506-572``). The leader executes chosen commands itself
+(there is no separate replica role) and replies with its current round
+so clients learn whether to go fast (``Leader.scala:923-976``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.election import basic as election
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions, Participant
+from frankenpaxos_tpu.protocols.multipaxos.messages import Command, CommandId
+from frankenpaxos_tpu.roundsystem import RoundSystem, RoundType
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.thrifty import ThriftySystem, NotThrifty
+from frankenpaxos_tpu.util import histogram, popular_items
+
+# Value kinds carried by phase 2a messages (FastMultiPaxos.proto's
+# oneof {Command, Noop, AnyVal, AnyValSuffix}).
+COMMAND = "command"
+NOOP = "noop"
+ANY = "any"
+ANY_SUFFIX = "any_suffix"
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpProposeRequest:
+    round: int  # the round the CLIENT believes is current
+    command: Command
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpProposeReply:
+    round: int
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpLeaderInfo:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase1a:
+    round: int
+    chosen_watermark: int
+    chosen_slots: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase1b:
+    acceptor_id: int
+    round: int
+    votes: tuple  # of (slot, vote_round, kind, command|None)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase1bNack:
+    acceptor_id: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase2a:
+    slot: int  # for ANY_SUFFIX: the first slot of the infinite suffix
+    round: int
+    kind: str
+    command: Optional[Command] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase2aBuffer:
+    phase2as: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase2b:
+    acceptor_id: int
+    slot: int
+    round: int
+    kind: str  # COMMAND or NOOP
+    command: Optional[Command] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpPhase2bBuffer:
+    phase2bs: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpValueChosen:
+    slot: int
+    kind: str
+    command: Optional[Command] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FmpValueChosenBuffer:
+    values: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FastMultiPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    leader_election_addresses: tuple
+    leader_heartbeat_addresses: tuple
+    acceptor_addresses: tuple
+    acceptor_heartbeat_addresses: tuple
+    round_system: RoundSystem
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def classic_quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def quorum_majority_size(self) -> int:
+        # A majority of a classic quorum (Config.scala:19).
+        return (self.f + 1) // 2 + 1
+
+    @property
+    def fast_quorum_size(self) -> int:
+        return self.f + self.quorum_majority_size
+
+    def check_valid(self) -> None:
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.acceptor_addresses) != self.n:
+            raise ValueError("need exactly 2f+1 acceptors")
+        if len(self.leader_election_addresses) != len(self.leader_addresses):
+            raise ValueError("one election address per leader")
+        if len(self.leader_heartbeat_addresses) != len(self.leader_addresses):
+            raise ValueError("one heartbeat address per leader")
+        if len(self.acceptor_heartbeat_addresses) != self.n:
+            raise ValueError("one heartbeat address per acceptor")
+
+
+# -- Acceptor -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _AcceptorEntry:
+    vote_round: int
+    kind: Optional[str]  # COMMAND, NOOP, or None (= voted for nothing)
+    command: Optional[Command]
+    any_round: Optional[int]
+
+
+class FmpAcceptor(Actor):
+    """``fastmultipaxos/Acceptor.scala``. One round per acceptor (not per
+    slot); a log of votes; ``tail_any`` models the reference's
+    ``putTail`` — an infinite suffix of "any" grants starting at a slot
+    (Acceptor.scala:316-331)."""
+
+    def __init__(self, address, transport, logger,
+                 config: FastMultiPaxosConfig, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.rng = random.Random(seed)
+        self.round = -1
+        self.log: Dict[int, _AcceptorEntry] = {}
+        self.tail_any: Optional[Tuple[int, int]] = None  # (start, round)
+        self.next_slot = 0
+        # Heartbeat participant so leaders can track liveness
+        # (Acceptor.scala:120-131).
+        self.heartbeat = Participant(
+            config.acceptor_heartbeat_addresses[self.index],
+            transport, logger, [],
+        )
+
+    def _get(self, slot: int) -> Optional[_AcceptorEntry]:
+        entry = self.log.get(slot)
+        if entry is not None:
+            return entry
+        if self.tail_any is not None and slot >= self.tail_any[0]:
+            return _AcceptorEntry(-1, None, None, self.tail_any[1])
+        return None
+
+    def _leader_chan(self):
+        return self.chan(
+            self.config.leader_addresses[
+                self.config.round_system.leader(max(self.round, 0))
+            ]
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FmpProposeRequest):
+            self._handle_propose(msg)
+        elif isinstance(msg, FmpPhase1a):
+            self._handle_phase1a(src, msg)
+        elif isinstance(msg, FmpPhase2a):
+            phase2b = self._process_phase2a(msg)
+            if phase2b is not None:
+                self._leader_chan().send(phase2b)
+        elif isinstance(msg, FmpPhase2aBuffer):
+            phase2bs = tuple(
+                b for b in map(self._process_phase2a, msg.phase2as)
+                if b is not None
+            )
+            if phase2bs:
+                self._leader_chan().send(FmpPhase2bBuffer(phase2bs))
+        else:
+            self.logger.fatal(f"unknown fmp acceptor message {msg!r}")
+
+    def _handle_propose(self, msg: FmpProposeRequest) -> None:
+        """A client proposes directly (fast round): vote in next_slot iff
+        we hold an "any" grant for our current round there and haven't
+        voted in it yet (Acceptor.scala:225-248)."""
+        entry = self._get(self.next_slot)
+        if (
+            entry is not None
+            and entry.any_round == self.round
+            and entry.vote_round < self.round
+        ):
+            self.log[self.next_slot] = _AcceptorEntry(
+                self.round, COMMAND, msg.command, None
+            )
+            phase2b = FmpPhase2b(
+                acceptor_id=self.index,
+                slot=self.next_slot,
+                round=self.round,
+                kind=COMMAND,
+                command=msg.command,
+            )
+            self.next_slot += 1
+            self._leader_chan().send(FmpPhase2bBuffer((phase2b,)))
+        # Without an "any" grant the request is ignored; the client's
+        # repropose timer reroutes it via the leaders.
+
+    def _handle_phase1a(self, src: Address, msg: FmpPhase1a) -> None:
+        if msg.round <= self.round:
+            self.chan(src).send(
+                FmpPhase1bNack(acceptor_id=self.index, round=self.round)
+            )
+            return
+        self.round = msg.round
+        votes = []
+        for slot in sorted(self.log):
+            if slot < msg.chosen_watermark or slot in msg.chosen_slots:
+                continue
+            entry = self.log[slot]
+            if entry.kind is None:
+                continue  # an "any" grant without a vote
+            votes.append((slot, entry.vote_round, entry.kind, entry.command))
+        self.chan(src).send(
+            FmpPhase1b(
+                acceptor_id=self.index, round=msg.round, votes=tuple(votes)
+            )
+        )
+
+    def _process_phase2a(self, msg: FmpPhase2a) -> Optional[FmpPhase2b]:
+        entry = self._get(msg.slot) or _AcceptorEntry(-1, None, None, None)
+        if msg.round < self.round:
+            return None
+        if msg.round == entry.vote_round:
+            # Already voted this round: relay the vote again for liveness
+            # (Acceptor.scala:267-283).
+            return FmpPhase2b(
+                acceptor_id=self.index, slot=msg.slot,
+                round=entry.vote_round, kind=entry.kind,
+                command=entry.command,
+            )
+        self.round = msg.round
+        if msg.kind in (COMMAND, NOOP):
+            self.log[msg.slot] = _AcceptorEntry(
+                msg.round, msg.kind, msg.command, None
+            )
+            if msg.slot >= self.next_slot:
+                self.next_slot = msg.slot + 1
+            return FmpPhase2b(
+                acceptor_id=self.index, slot=msg.slot, round=msg.round,
+                kind=msg.kind, command=msg.command,
+            )
+        if msg.kind == ANY:
+            self.log[msg.slot] = _AcceptorEntry(
+                entry.vote_round, entry.kind, entry.command, msg.round
+            )
+            return None
+        if msg.kind == ANY_SUFFIX:
+            # Grant "any" to every voted slot >= msg.slot and to the
+            # infinite unvoted suffix (Acceptor.scala:316-331). Fast
+            # voting resumes at the suffix start: slots below msg.slot
+            # are settled or under repair by the leader, and leaving
+            # next_slot pointing at an ungranted gap slot would silently
+            # drop every fast-path proposal.
+            if msg.slot > self.next_slot:
+                self.next_slot = msg.slot
+            for slot in list(self.log):
+                if slot >= msg.slot:
+                    e = self.log[slot]
+                    self.log[slot] = _AcceptorEntry(
+                        e.vote_round, e.kind, e.command, msg.round
+                    )
+            if not self.log:
+                self.tail_any = (msg.slot, msg.round)
+            else:
+                start = max(msg.slot, max(self.log) + 1)
+                self.tail_any = (start, msg.round)
+                # Unvoted gap slots in [msg.slot, start) get explicit
+                # grant entries so the suffix truly covers [slot, inf).
+                for slot in range(msg.slot, start):
+                    if slot not in self.log:
+                        self.log[slot] = _AcceptorEntry(
+                            -1, None, None, msg.round
+                        )
+            return None
+        self.logger.fatal(f"unknown phase2a kind {msg.kind}")
+
+
+# -- Leader -------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FmpLeaderOptions:
+    thrifty_system: ThriftySystem = NotThrifty()
+    resend_phase1as_period: float = 5.0
+    resend_phase2as_period: float = 5.0
+    phase2a_max_buffer_size: int = 1
+    phase2a_buffer_flush_period: float = 0.1
+    value_chosen_max_buffer_size: int = 1
+    value_chosen_buffer_flush_period: float = 5.0
+    election_options: election.ElectionOptions = election.ElectionOptions()
+    heartbeat_options: HeartbeatOptions = HeartbeatOptions()
+
+
+_INACTIVE = "inactive"
+
+
+@dataclasses.dataclass
+class _Phase1:
+    phase1bs: Dict[int, FmpPhase1b]
+    pending_proposals: List[Tuple[Address, FmpProposeRequest]]
+
+
+@dataclasses.dataclass
+class _Phase2:
+    # slot -> (kind, command) proposed in this round but not yet chosen.
+    pending_entries: Dict[int, Tuple[str, Optional[Command]]]
+    # slot -> acceptor_id -> phase2b.
+    phase2bs: Dict[int, Dict[int, FmpPhase2b]]
+    phase2a_buffer: List[FmpPhase2a]
+    value_chosen_buffer: List[FmpValueChosen]
+
+
+class FmpLeader(Actor):
+    """``fastmultipaxos/Leader.scala``. Executes the log itself and
+    answers clients with its round (there is no replica role)."""
+
+    def __init__(self, address, transport, logger,
+                 config: FastMultiPaxosConfig, state_machine: StateMachine,
+                 options: FmpLeaderOptions = FmpLeaderOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round = 0 if config.round_system.leader(0) == self.index else -1
+        self.log: Dict[int, Tuple[str, Optional[Command]]] = {}
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+        self.chosen_watermark = 0
+        self.next_slot = 0
+
+        # Election among leaders (Leader.scala:313-337).
+        self.election = election.Participant(
+            config.leader_election_addresses[self.index],
+            transport, logger, config.leader_election_addresses,
+            initial_leader_index=config.round_system.leader(0),
+            options=options.election_options, seed=seed,
+        )
+        self.election.register(
+            lambda leader_index: self.leader_change(
+                leader_index == self.index, self.round
+            )
+        )
+        # Heartbeats monitoring acceptor liveness: a fast round is only
+        # attempted if a fast quorum looks alive (Leader.scala:842-858).
+        self.heartbeat = Participant(
+            config.leader_heartbeat_addresses[self.index],
+            transport, logger, config.acceptor_heartbeat_addresses,
+            options=options.heartbeat_options,
+        )
+
+        def resend_phase1as() -> None:
+            if isinstance(self.state, _Phase1):
+                self._send_phase1as(thrifty=False)
+            self.resend_phase1as_timer.start()
+
+        def resend_phase2as() -> None:
+            self._resend_phase2as()
+            self.resend_phase2as_timer.start()
+
+        def flush_phase2as() -> None:
+            self.flush_phase2a_buffer()
+            self.phase2a_flush_timer.start()
+
+        def flush_value_chosen() -> None:
+            self.flush_value_chosen_buffer()
+            self.value_chosen_flush_timer.start()
+
+        self.resend_phase1as_timer = self.timer(
+            "resendPhase1as", options.resend_phase1as_period, resend_phase1as
+        )
+        self.resend_phase2as_timer = self.timer(
+            "resendPhase2as", options.resend_phase2as_period, resend_phase2as
+        )
+        self.phase2a_flush_timer = self.timer(
+            "phase2aBufferFlush", options.phase2a_buffer_flush_period,
+            flush_phase2as,
+        )
+        self.value_chosen_flush_timer = self.timer(
+            "valueChosenBufferFlush", options.value_chosen_buffer_flush_period,
+            flush_value_chosen,
+        )
+
+        if self.round == 0:
+            self._send_phase1as(thrifty=True)
+            self.resend_phase1as_timer.start()
+            self.state: object = _Phase1({}, [])
+        else:
+            self.state = _INACTIVE
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _quorum_size(self, round: int) -> int:
+        if self.config.round_system.round_type(round) == RoundType.FAST:
+            return self.config.fast_quorum_size
+        return self.config.classic_quorum_size
+
+    def _thrifty_acceptors(self, min_size: int):
+        chosen = self.options.thrifty_system.choose(
+            {a: 0.0 for a in self.config.acceptor_addresses},
+            min_size, self.rng,
+        )
+        return [self.chan(a) for a in chosen]
+
+    def _send_phase1as(self, thrifty: bool) -> None:
+        targets = (
+            self._thrifty_acceptors(self.config.classic_quorum_size)
+            if thrifty
+            else [self.chan(a) for a in self.config.acceptor_addresses]
+        )
+        phase1a = FmpPhase1a(
+            round=self.round,
+            chosen_watermark=self.chosen_watermark,
+            chosen_slots=tuple(
+                s for s in self.log if s >= self.chosen_watermark
+            ),
+        )
+        for chan in targets:
+            chan.send(phase1a)
+
+    def _choose_proposal(
+        self, votes: Dict[int, Dict[int, Tuple[int, str, Optional[Command]]]],
+        slot: int,
+    ) -> Tuple[Tuple[str, Optional[Command]], Set[Command]]:
+        """The Fast Paxos value-selection rule (Leader.scala:506-572):
+        among the highest-vote-round values V, a singleton or an O4
+        (majority-popular) value MUST be proposed; otherwise anything in
+        V may be, and the rest are returned for later proposal."""
+        in_slot = [
+            votes[a].get(slot, (-1, None, None)) for a in votes
+        ]
+        k = max(vr for vr, _, _ in in_slot)
+        if k == -1:
+            return (NOOP, None), set()
+        V = [(kind, cmd) for vr, kind, cmd in in_slot if vr == k]
+        if len(set(V)) == 1:
+            return V[0], set()
+        o4 = popular_items(V, self.config.quorum_majority_size)
+        if o4:
+            self.logger.check_eq(len(o4), 1)
+            return next(iter(o4)), set()
+        rest = {cmd for kind, cmd in V if kind == COMMAND}
+        first = V[0]
+        if first[0] == COMMAND:
+            rest.discard(first[1])
+        return first, rest
+
+    def _phase2b_result(
+        self, phase2: _Phase2, slot: int
+    ) -> Tuple[str, Optional[Tuple[str, Optional[Command]]]]:
+        """("nothing"|"ready"|"stuck", entry) — fast rounds need
+        fast_quorum_size IDENTICAL votes and may get irrecoverably stuck
+        (Leader.scala:692-737)."""
+        in_slot = phase2.phase2bs[slot]
+        if self.config.round_system.round_type(self.round) == RoundType.CLASSIC:
+            if len(in_slot) >= self.config.classic_quorum_size:
+                return "ready", phase2.pending_entries[slot]
+            return "nothing", None
+        if len(in_slot) < self.config.classic_quorum_size:
+            return "nothing", None
+        counts = histogram(
+            (b.kind, b.command) for b in in_slot.values()
+        )
+        votes_left = self.config.n - len(in_slot)
+        if not any(
+            c + votes_left >= self.config.fast_quorum_size
+            for c in counts.values()
+        ):
+            return "stuck", None
+        for value, count in counts.items():
+            if count >= self.config.fast_quorum_size:
+                return "ready", value
+        return "nothing", None
+
+    def flush_phase2a_buffer(self) -> None:
+        if not isinstance(self.state, _Phase2):
+            return
+        if self.state.phase2a_buffer:
+            buffer = FmpPhase2aBuffer(tuple(self.state.phase2a_buffer))
+            for chan in self._thrifty_acceptors(self._quorum_size(self.round)):
+                chan.send(buffer)
+            self.state.phase2a_buffer.clear()
+
+    def flush_value_chosen_buffer(self) -> None:
+        if not isinstance(self.state, _Phase2):
+            return
+        if self.state.value_chosen_buffer:
+            buffer = FmpValueChosenBuffer(tuple(self.state.value_chosen_buffer))
+            for a in self.config.leader_addresses:
+                if a != self.address:
+                    self.chan(a).send(buffer)
+            self.state.value_chosen_buffer.clear()
+
+    def _resend_phase2as(self) -> None:
+        if not isinstance(self.state, _Phase2):
+            return
+        for slot, (kind, command) in self.state.pending_entries.items():
+            phase2a = FmpPhase2a(
+                slot=slot, round=self.round, kind=kind, command=command
+            )
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(phase2a)
+
+    def _buffer_phase2a(self, phase2a: FmpPhase2a) -> None:
+        state = self.state
+        state.phase2a_buffer.append(phase2a)
+        if len(state.phase2a_buffer) >= self.options.phase2a_max_buffer_size:
+            self.flush_phase2a_buffer()
+
+    def leader_change(self, is_new_leader: bool, higher_than: int) -> None:
+        """(Leader.scala:842-923) — go fast if a fast quorum of acceptors
+        looks alive, else classic."""
+        self.logger.check_ge(higher_than, self.round)
+        rs = self.config.round_system
+        alive = len(self.heartbeat.unsafe_alive())
+        if alive >= self.config.fast_quorum_size:
+            next_round = rs.next_fast_round(self.index, higher_than)
+            if next_round is None:
+                next_round = rs.next_classic_round(self.index, higher_than)
+        else:
+            next_round = rs.next_classic_round(self.index, higher_than)
+
+        if is_new_leader:
+            self.round = next_round
+            self._send_phase1as(thrifty=True)
+            self.resend_phase1as_timer.reset()
+            self.resend_phase2as_timer.stop()
+            self.phase2a_flush_timer.stop()
+            self.value_chosen_flush_timer.stop()
+            self.state = _Phase1({}, [])
+        else:
+            self.resend_phase1as_timer.stop()
+            self.resend_phase2as_timer.stop()
+            self.phase2a_flush_timer.stop()
+            self.value_chosen_flush_timer.stop()
+            self.state = _INACTIVE
+
+    def _execute_log(self) -> None:
+        while self.chosen_watermark in self.log:
+            kind, command = self.log[self.chosen_watermark]
+            if kind == COMMAND:
+                cid = command.command_id
+                key = (cid.client_address, cid.client_pseudonym)
+                cached = self.client_table.get(key)
+                if cached is None or cid.client_id > cached[0]:
+                    output = self.state_machine.run(command.command)
+                    self.client_table[key] = (cid.client_id, output)
+                    if self.state != _INACTIVE:
+                        client = self.transport.address_from_bytes(
+                            cid.client_address
+                        )
+                        self.chan(client).send(
+                            FmpProposeReply(
+                                round=self.round,
+                                client_pseudonym=cid.client_pseudonym,
+                                client_id=cid.client_id,
+                                result=output,
+                            )
+                        )
+            self.chosen_watermark += 1
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FmpProposeRequest):
+            self._handle_propose(src, msg)
+        elif isinstance(msg, FmpPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, FmpPhase1bNack):
+            self._handle_phase1b_nack(msg)
+        elif isinstance(msg, FmpPhase2b):
+            self._process_phase2b(msg)
+        elif isinstance(msg, FmpPhase2bBuffer):
+            for phase2b in msg.phase2bs:
+                self._process_phase2b(phase2b)
+        elif isinstance(msg, FmpValueChosen):
+            self._handle_value_chosen(msg)
+        elif isinstance(msg, FmpValueChosenBuffer):
+            for value in msg.values:
+                self._handle_value_chosen(value)
+        else:
+            self.logger.fatal(f"unknown fmp leader message {msg!r}")
+
+    def _handle_propose(self, src: Address, msg: FmpProposeRequest) -> None:
+        cid = msg.command.command_id
+        cached = self.client_table.get(
+            (cid.client_address, cid.client_pseudonym)
+        )
+        if cached is not None:
+            if cid.client_id == cached[0] and self.state != _INACTIVE:
+                self.chan(src).send(
+                    FmpProposeReply(
+                        round=self.round,
+                        client_pseudonym=cid.client_pseudonym,
+                        client_id=cached[0],
+                        result=cached[1],
+                    )
+                )
+                return
+            if cid.client_id < cached[0]:
+                return
+
+        if self.state == _INACTIVE:
+            return
+        if isinstance(self.state, _Phase1):
+            if msg.round != self.round:
+                self.chan(src).send(FmpLeaderInfo(round=self.round))
+            else:
+                self.state.pending_proposals.append((src, msg))
+            return
+
+        # Phase 2.
+        if msg.round != self.round:
+            self.chan(src).send(FmpLeaderInfo(round=self.round))
+            return
+        if self.config.round_system.round_type(self.round) == RoundType.FAST:
+            # The client knows it's a fast round yet came to us: the fast
+            # path failed for it, so move to a fresh round
+            # (Leader.scala:1110-1121).
+            self.leader_change(True, self.round)
+            return
+        self.state.pending_entries[self.next_slot] = (COMMAND, msg.command)
+        self.state.phase2bs[self.next_slot] = {}
+        self._buffer_phase2a(
+            FmpPhase2a(
+                slot=self.next_slot, round=self.round,
+                kind=COMMAND, command=msg.command,
+            )
+        )
+        self.next_slot += 1
+
+    def _handle_phase1b(self, msg: FmpPhase1b) -> None:
+        if not isinstance(self.state, _Phase1) or msg.round != self.round:
+            return
+        state = self.state
+        state.phase1bs[msg.acceptor_id] = msg
+        if len(state.phase1bs) < self.config.classic_quorum_size:
+            return
+        self.resend_phase1as_timer.stop()
+
+        votes: Dict[int, Dict[int, Tuple[int, str, Optional[Command]]]] = {
+            a: {s: (vr, kind, cmd) for s, vr, kind, cmd in b.votes}
+            for a, b in state.phase1bs.items()
+        }
+        end_slot = max(
+            [s for by_slot in votes.values() for s in by_slot]
+            + [s for s in self.log]
+            + [-1]
+        )
+
+        phase2 = _Phase2({}, {}, [], [])
+        proposed: Set[Command] = set()
+        yet_to_propose: Set[Command] = set()
+        for slot in range(self.chosen_watermark, end_slot + 1):
+            if slot in self.log:
+                continue
+            (kind, command), rest = self._choose_proposal(votes, slot)
+            yet_to_propose |= rest
+            if kind == COMMAND:
+                proposed.add(command)
+            phase2.pending_entries[slot] = (kind, command)
+            phase2.phase2bs[slot] = {}
+            phase2.phase2a_buffer.append(
+                FmpPhase2a(slot=slot, round=self.round, kind=kind,
+                           command=command)
+            )
+
+        self.state = phase2
+        self.resend_phase2as_timer.start()
+        self.phase2a_flush_timer.start()
+        self.value_chosen_flush_timer.start()
+
+        self.next_slot = end_slot + 1
+        for _, proposal in state.pending_proposals:
+            phase2.pending_entries[self.next_slot] = (
+                COMMAND, proposal.command
+            )
+            phase2.phase2bs[self.next_slot] = {}
+            phase2.phase2a_buffer.append(
+                FmpPhase2a(slot=self.next_slot, round=self.round,
+                           kind=COMMAND, command=proposal.command)
+            )
+            self.next_slot += 1
+        for command in yet_to_propose - proposed:
+            phase2.pending_entries[self.next_slot] = (COMMAND, command)
+            phase2.phase2bs[self.next_slot] = {}
+            phase2.phase2a_buffer.append(
+                FmpPhase2a(slot=self.next_slot, round=self.round,
+                           kind=COMMAND, command=command)
+            )
+            self.next_slot += 1
+
+        if self.config.round_system.round_type(self.round) == RoundType.FAST:
+            # Open the infinite fast-path suffix (Leader.scala:1262-1267).
+            phase2.phase2a_buffer.append(
+                FmpPhase2a(slot=self.next_slot, round=self.round,
+                           kind=ANY_SUFFIX)
+            )
+        self.flush_phase2a_buffer()
+
+    def _handle_phase1b_nack(self, msg: FmpPhase1bNack) -> None:
+        if isinstance(self.state, _Phase1) and msg.round > self.round:
+            self.leader_change(True, msg.round)
+
+    def _process_phase2b(self, msg: FmpPhase2b) -> None:
+        if not isinstance(self.state, _Phase2):
+            return
+        if msg.round != self.round or msg.slot in self.log:
+            return
+        phase2 = self.state
+        phase2.phase2bs.setdefault(msg.slot, {})[msg.acceptor_id] = msg
+        if (
+            self.config.round_system.round_type(self.round)
+            == RoundType.CLASSIC
+            and msg.slot not in phase2.pending_entries
+        ):
+            return
+        status, entry = self._phase2b_result(phase2, msg.slot)
+        if status == "nothing":
+            return
+        if status == "stuck":
+            self.leader_change(True, self.round)
+            return
+        kind, command = entry
+        self.log[msg.slot] = entry
+        phase2.pending_entries.pop(msg.slot, None)
+        phase2.phase2bs.pop(msg.slot, None)
+        self._execute_log()
+        value_chosen = FmpValueChosen(slot=msg.slot, kind=kind,
+                                      command=command)
+        if self.options.value_chosen_max_buffer_size == 1:
+            for a in self.config.leader_addresses:
+                if a != self.address:
+                    self.chan(a).send(value_chosen)
+        else:
+            phase2.value_chosen_buffer.append(value_chosen)
+            if (
+                len(phase2.value_chosen_buffer)
+                >= self.options.value_chosen_max_buffer_size
+            ):
+                self.flush_value_chosen_buffer()
+
+    def _handle_value_chosen(self, msg: FmpValueChosen) -> None:
+        existing = self.log.get(msg.slot)
+        entry = (msg.kind, msg.command)
+        if existing is not None:
+            self.logger.check_eq(existing, entry)
+        else:
+            self.log[msg.slot] = entry
+        self._execute_log()
+
+
+# -- Client -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FmpPending:
+    id: int
+    command: bytes
+    result: Promise
+    repropose: object
+
+
+class FmpClient(Actor):
+    """``fastmultipaxos/Client.scala``: tracks its best guess of the
+    round; fast rounds go straight to ALL acceptors, classic rounds to
+    the round's leader; a repropose timer falls back to every leader."""
+
+    def __init__(self, address, transport, logger,
+                 config: FastMultiPaxosConfig,
+                 repropose_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.repropose_period = repropose_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.round = 0
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _FmpPending] = {}
+
+    def _request(self, pseudonym: int, pending: _FmpPending):
+        return FmpProposeRequest(
+            round=self.round,
+            command=Command(
+                command_id=CommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=pending.id,
+                ),
+                command=pending.command,
+            ),
+        )
+
+    def _send(self, pseudonym: int, pending: _FmpPending) -> None:
+        request = self._request(pseudonym, pending)
+        if (
+            self.config.round_system.round_type(self.round) == RoundType.FAST
+        ):
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(request)
+        else:
+            leader = self.config.leader_addresses[
+                self.config.round_system.leader(self.round)
+            ]
+            self.chan(leader).send(request)
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+
+        def repropose() -> None:
+            # Fall back through every leader (Client.scala:233-254).
+            pending = self.pending.get(pseudonym)
+            if pending is not None:
+                request = self._request(pseudonym, pending)
+                for a in self.config.leader_addresses:
+                    self.chan(a).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"repropose{pseudonym}", self.repropose_period, repropose
+        )
+        pending = _FmpPending(
+            id=id, command=command, result=promise, repropose=timer
+        )
+        self.pending[pseudonym] = pending
+        self._send(pseudonym, pending)
+        timer.start()
+        return promise
+
+    def _process_new_round(self, new_round: int) -> None:
+        if new_round <= self.round:
+            return
+        self.round = new_round
+        for pseudonym, pending in self.pending.items():
+            self._send(pseudonym, pending)
+            pending.repropose.reset()
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FmpLeaderInfo):
+            self._process_new_round(msg.round)
+        elif isinstance(msg, FmpProposeReply):
+            pending = self.pending.get(msg.client_pseudonym)
+            if pending is not None and msg.client_id == pending.id:
+                pending.repropose.stop()
+                del self.pending[msg.client_pseudonym]
+                pending.result.success(msg.result)
+            self._process_new_round(msg.round)
+        else:
+            self.logger.fatal(f"unknown fmp client message {msg!r}")

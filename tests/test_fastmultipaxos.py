@@ -1,0 +1,352 @@
+"""Fast MultiPaxos sim tests (the analog of
+shared/src/test/scala/fastmultipaxos)."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import fastmultipaxos as fmp
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin, MixedRoundRobin
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+def make(f=1, num_clients=2, seed=0, round_system=None):
+    t = SimTransport(FakeLogger(LogLevel.FATAL))
+    n = 2 * f + 1
+    num_leaders = f + 1
+    config = fmp.FastMultiPaxosConfig(
+        f=f,
+        leader_addresses=tuple(
+            SimAddress(f"leader{i}") for i in range(num_leaders)
+        ),
+        leader_election_addresses=tuple(
+            SimAddress(f"election{i}") for i in range(num_leaders)
+        ),
+        leader_heartbeat_addresses=tuple(
+            SimAddress(f"lheartbeat{i}") for i in range(num_leaders)
+        ),
+        acceptor_addresses=tuple(SimAddress(f"acceptor{i}") for i in range(n)),
+        acceptor_heartbeat_addresses=tuple(
+            SimAddress(f"aheartbeat{i}") for i in range(n)
+        ),
+        round_system=round_system or MixedRoundRobin(num_leaders),
+    )
+    log = lambda: FakeLogger(LogLevel.FATAL)
+    leaders = [
+        fmp.FmpLeader(a, t, log(), config, ReadableAppendLog(), seed=seed + i)
+        for i, a in enumerate(config.leader_addresses)
+    ]
+    acceptors = [
+        fmp.FmpAcceptor(a, t, log(), config, seed=seed + 10 + i)
+        for i, a in enumerate(config.acceptor_addresses)
+    ]
+    clients = [
+        fmp.FmpClient(SimAddress(f"client{i}"), t, log(), config,
+                      seed=seed + 40 + i)
+        for i in range(num_clients)
+    ]
+    return t, config, leaders, acceptors, clients
+
+
+def drain(t, max_steps=200000):
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps
+
+
+def pump(t, config, rounds=8, skip=lambda timer: False):
+    """Fire protocol timers but NOT election/heartbeat infrastructure
+    timers — firing those repeatedly churns leadership (safe, but it
+    makes deterministic liveness assertions meaningless)."""
+    infra = (
+        set(config.leader_election_addresses)
+        | set(config.leader_heartbeat_addresses)
+        | set(config.acceptor_heartbeat_addresses)
+    )
+    drain(t)
+    for _ in range(rounds):
+        for timer in list(t.running_timers()):
+            if timer.address not in infra and not skip(timer):
+                t.trigger_timer(timer.address, timer.name())
+        drain(t)
+
+
+def chosen_logs_compatible(leaders):
+    """Every pair of leaders must agree on every slot chosen by both."""
+    for i in range(len(leaders)):
+        for j in range(i + 1, len(leaders)):
+            a, b = leaders[i].log, leaders[j].log
+            for slot in set(a) & set(b):
+                if a[slot] != b[slot]:
+                    return f"slot {slot}: {a[slot]!r} != {b[slot]!r}"
+    return None
+
+
+def test_fmp_fast_path_single_client():
+    """An uncontended command in fast round 0 commits with the client
+    writing straight to acceptors — the leader proposes no command
+    phase2as, only the any-suffix."""
+    t, config, leaders, acceptors, clients = make()
+    drain(t)  # leader 0's phase 1 + any-suffix
+    command_phase2as = 0
+    p = clients[0].propose(0, b"fast!")
+    while t.messages:
+        m = t.messages[0]
+        decoded = wire.decode(m.data)
+        if isinstance(decoded, fmp.FmpPhase2a) and decoded.kind == fmp.COMMAND:
+            command_phase2as += 1
+        if isinstance(decoded, fmp.FmpPhase2aBuffer):
+            command_phase2as += sum(
+                1 for x in decoded.phase2as if x.kind == fmp.COMMAND
+            )
+        t.deliver_message(m)
+    assert p.done
+    assert command_phase2as == 0
+    assert leaders[0].log[0][0] == fmp.COMMAND
+    assert leaders[0].state_machine.log == [b"fast!"]
+
+
+def test_fmp_sequential_fast_commands():
+    t, config, leaders, acceptors, clients = make()
+    drain(t)
+    for i in range(5):
+        p = clients[i % 2].propose(i // 2, f"c{i}".encode())
+        drain(t)
+        assert p.done, i
+    assert leaders[0].state_machine.log == [b"c0", b"c1", b"c2", b"c3", b"c4"]
+    assert chosen_logs_compatible(leaders) is None
+
+
+def test_fmp_conflict_degrades_to_classic():
+    """Two clients race in the fast round with interleaved delivery, so
+    acceptors vote in different orders; the stuck slot forces the leader
+    into a (classic) higher round and both commands still commit."""
+    t, config, leaders, acceptors, clients = make(seed=3)
+    drain(t)
+    p1 = clients[0].propose(0, b"a")
+    p2 = clients[1].propose(0, b"b")
+    # Interleave: acceptor 0 sees a,b; acceptors 1..2 see b,a.
+    rng = random.Random(5)
+    while t.messages:
+        idx = rng.randrange(len(t.messages))
+        t.deliver_message(t.messages[idx])
+    pump(t, config, rounds=10)
+    assert p1.done and p2.done
+    assert chosen_logs_compatible(leaders) is None
+    sm = leaders[0].state_machine.log
+    assert sorted(sm) == [b"a", b"b"]
+
+
+def test_fmp_classic_round_system():
+    """With a purely classic round system the protocol runs like
+    MultiPaxos: clients go through the leader."""
+    t, config, leaders, acceptors, clients = make(
+        round_system=ClassicRoundRobin(2)
+    )
+    drain(t)
+    p = clients[0].propose(0, b"classic")
+    drain(t)
+    assert p.done
+    assert leaders[0].state_machine.log == [b"classic"]
+
+
+def test_fmp_client_round_catchup():
+    """A client stuck in an old round learns the current round from
+    LeaderInfo and reroutes (fast -> classic after a leader bump)."""
+    t, config, leaders, acceptors, clients = make(seed=7)
+    drain(t)
+    # Force the leader into a higher classic round: with fewer than a
+    # fast quorum of acceptors alive, leader_change goes classic.
+    leaders[0].heartbeat.alive = set()
+    leaders[0].leader_change(True, 0)
+    drain(t)
+    assert config.round_system.round_type(leaders[0].round).value == "classic"
+    # The client still thinks round 0 (fast): its direct proposals are
+    # dead ends; the repropose timer reaches the leaders, which reply
+    # with LeaderInfo, and the client reroutes.
+    p = clients[0].propose(0, b"catchup")
+    pump(t, config, rounds=6)
+    assert p.done
+    assert clients[0].round == leaders[0].round
+
+
+def test_fmp_leader_failover():
+    """Partition leader 0; leader 1 takes over via leader_change and
+    repairs: in-flight and new commands commit."""
+    t, config, leaders, acceptors, clients = make(seed=9)
+    drain(t)
+    p = clients[0].propose(0, b"before")
+    drain(t)
+    assert p.done
+    dead = config.leader_addresses[0]
+    t.partition_actor(dead)
+    t.partition_actor(config.leader_election_addresses[0])
+    t.partition_actor(config.leader_heartbeat_addresses[0])
+    leaders[1].leader_change(True, leaders[1].round)
+    pump(t, config, rounds=6, skip=lambda tm: tm.address == dead)
+    p2 = clients[1].propose(0, b"after")
+    pump(t, config, rounds=8, skip=lambda tm: tm.address == dead)
+    assert p2.done
+    assert leaders[1].state_machine.log == [b"before", b"after"]
+
+
+def test_fmp_duplicate_request_replays_cached_reply():
+    t, config, leaders, acceptors, clients = make(seed=11)
+    drain(t)
+    p = clients[0].propose(0, b"dup")
+    drain(t)
+    assert p.done
+    # Re-deliver the same command id straight to the leader.
+    pending = fmp._FmpPending(id=0, command=b"dup", result=None, repropose=None)
+    request = clients[0]._request(0, pending)
+    leaders[0].receive(clients[0].address, request)
+    drain(t)
+    # Executed once, not twice.
+    assert leaders[0].state_machine.log == [b"dup"]
+
+
+def test_fmp_lagging_acceptor_rejoins_fast_path_after_failover():
+    """Regression: an acceptor that missed the vote on a trailing chosen
+    slot has next_slot inside the [old log end, any-suffix start) gap
+    after failover. The ANY_SUFFIX must advance its next_slot, or it
+    silently drops every fast proposal and (with f=1, where the fast
+    quorum is ALL acceptors) no command can ever commit fast again."""
+    t, config, leaders, acceptors, clients = make(seed=13)
+    drain(t)
+    # Commit the first command in a CLASSIC round (quorum f+1 = 2) while
+    # hiding the phase2as from acceptor 2: it lags behind the log end.
+    leaders[0].heartbeat.alive = set()
+    leaders[0].leader_change(True, 0)
+    drain(t)
+    lagger = config.acceptor_addresses[2]
+
+    def drain_without_lagger():
+        while t.messages:
+            m = t.messages[0]
+            if m.dst == lagger:
+                t.drop_message(m)
+            else:
+                t.deliver_message(m)
+
+    p = clients[0].propose(0, b"first")
+    drain_without_lagger()
+    for _ in range(3):
+        if p.done:
+            break
+        # Only the client's repropose timer (its direct-to-acceptor fast
+        # attempt was ignored by the classic-round acceptors).
+        for timer in list(t.running_timers()):
+            if timer.address == clients[0].address:
+                t.trigger_timer(timer.address, timer.name())
+        drain_without_lagger()
+    assert p.done
+    assert acceptors[2].next_slot < acceptors[0].next_slot
+    # Fast-round failover: leader 1 takes over and opens a new suffix.
+    leaders[1].leader_change(True, leaders[1].round)
+    drain(t)
+    assert config.round_system.round_type(leaders[1].round).value == "fast"
+    # The lagger's next_slot must have jumped into the new suffix so the
+    # next fast command gets all three votes.
+    assert acceptors[2].next_slot == acceptors[0].next_slot
+    p2 = clients[1].propose(0, b"second")
+    drain(t)
+    assert p2.done
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    value: str
+
+
+class SimulatedFmp(SimulatedSystem):
+    def __init__(self, f=1, round_system=None):
+        self.f = f
+        self.round_system = round_system
+
+    def new_system(self, seed):
+        return make(self.f, seed=seed, round_system=self.round_system)
+
+    def get_state(self, system):
+        leaders = system[2]
+        return (
+            tuple(dict(l.log) for l in leaders),
+            tuple(tuple(l.state_machine.log) for l in leaders),
+        )
+
+    def generate_command(self, system, rng):
+        t, clients = system[0], system[4]
+        ops = []
+        for i, c in enumerate(clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"v{rng.randrange(100)}"))
+                    )
+        return mixed_command(rng, t, ops)
+
+    def run_command(self, system, command):
+        t, clients = system[0], system[4]
+        if isinstance(command, Propose):
+            clients[command.client_index].propose(
+                command.pseudonym, command.value.encode()
+            )
+        else:
+            t.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        logs, machines = state
+        # Chosen-value agreement across leaders.
+        for i in range(len(logs)):
+            for j in range(i + 1, len(logs)):
+                for slot in set(logs[i]) & set(logs[j]):
+                    if logs[i][slot] != logs[j][slot]:
+                        return (
+                            f"leaders disagree at slot {slot}: "
+                            f"{logs[i][slot]!r} != {logs[j][slot]!r}"
+                        )
+        # Executed logs are prefix-compatible.
+        for i in range(len(machines)):
+            for j in range(i + 1, len(machines)):
+                a, b = machines[i], machines[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"executions diverge: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        old_logs, _ = old
+        new_logs, _ = new
+        for o, n in zip(old_logs, new_logs):
+            for slot in set(o) & set(n):
+                if o[slot] != n[slot]:
+                    return f"chosen value changed at slot {slot}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_fmp_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedFmp(f), run_length=120, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_fmp_safety_randomized_classic():
+    bad = simulate_and_minimize(
+        SimulatedFmp(1, round_system=ClassicRoundRobin(2)),
+        run_length=120, num_runs=5, seed=77,
+    )
+    assert bad is None, f"\n{bad}"
