@@ -272,8 +272,9 @@ class FmpAcceptor(Actor):
             return
         self.round = msg.round
         votes = []
+        chosen = set(msg.chosen_slots)
         for slot in sorted(self.log):
-            if slot < msg.chosen_watermark or slot in msg.chosen_slots:
+            if slot < msg.chosen_watermark or slot in chosen:
                 continue
             entry = self.log[slot]
             if entry.kind is None:
@@ -564,9 +565,34 @@ class FmpLeader(Actor):
             self.state.value_chosen_buffer.clear()
 
     def _resend_phase2as(self) -> None:
+        """No slot may stay unchosen forever (Leader.scala:787-837): besides
+        re-proposing pending entries, drive every partially-voted slot below
+        next_slot to a decision — propose the most-voted value there, or a
+        noop if nothing was voted (a fast-path slot where some acceptors
+        missed the client's direct send can otherwise never reach its
+        all-acceptor fast quorum)."""
         if not isinstance(self.state, _Phase2):
             return
+        sent: Set[int] = set()
         for slot, (kind, command) in self.state.pending_entries.items():
+            sent.add(slot)
+            phase2a = FmpPhase2a(
+                slot=slot, round=self.round, kind=kind, command=command
+            )
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(phase2a)
+        end_slot = max(
+            list(self.state.phase2bs) + list(self.log) + [-1]
+        )
+        for slot in range(self.chosen_watermark, end_slot + 1):
+            if slot in sent or slot in self.log:
+                continue
+            votes = self.state.phase2bs.get(slot, {})
+            if votes:
+                counts = histogram((b.kind, b.command) for b in votes.values())
+                (kind, command), _ = max(counts.items(), key=lambda kv: kv[1])
+            else:
+                kind, command = NOOP, None
             phase2a = FmpPhase2a(
                 slot=slot, round=self.round, kind=kind, command=command
             )

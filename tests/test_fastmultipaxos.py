@@ -215,6 +215,33 @@ def test_fmp_duplicate_request_replays_cached_reply():
     assert leaders[0].state_machine.log == [b"dup"]
 
 
+def test_fmp_partial_fast_vote_driven_to_choice_by_resend():
+    """Regression: with f=1 the fast quorum is ALL acceptors, so a slot
+    where one acceptor missed the client's direct send sits at 2/3
+    identical votes — not chosen, and never 'stuck' either (the missing
+    vote could still complete it). The leader's phase2a resend timer must
+    drive such slots to a decision by proposing the most-voted value."""
+    t, config, leaders, acceptors, clients = make(seed=15)
+    drain(t)
+    lagger = config.acceptor_addresses[2]
+    p = clients[0].propose(0, b"partial")
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == lagger and isinstance(
+            wire.decode(m.data), fmp.FmpProposeRequest
+        ):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert not p.done
+    for timer in list(t.running_timers()):
+        if timer.name() == "resendPhase2as":
+            t.trigger_timer(timer.address, timer.name())
+    drain(t)
+    assert p.done
+    assert leaders[0].state_machine.log == [b"partial"]
+
+
 def test_fmp_lagging_acceptor_rejoins_fast_path_after_failover():
     """Regression: an acceptor that missed the vote on a trailing chosen
     slot has next_slot inside the [old log end, any-suffix start) gap
