@@ -519,6 +519,52 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_simplegcbpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import simplegcbpaxos as gcb
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = gcb.SimpleGcBPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("gbl0"), SimAddress("gbl1")),
+            proposer_addresses=(SimAddress("gbp0"), SimAddress("gbp1")),
+            dep_service_node_addresses=tuple(
+                SimAddress(f"gbd{i}") for i in range(3)
+            ),
+            acceptor_addresses=tuple(SimAddress(f"gba{i}") for i in range(3)),
+            replica_addresses=(SimAddress("gbr0"), SimAddress("gbr1")),
+            garbage_collector_addresses=(
+                SimAddress("gbg0"), SimAddress("gbg1"),
+            ),
+        )
+        for i, a in enumerate(config.leader_addresses):
+            gcb.GcLeader(a, t, log(), config, seed=i)
+        for i, a in enumerate(config.proposer_addresses):
+            gcb.GcProposer(a, t, log(), config, seed=10 + i)
+        for a in config.dep_service_node_addresses:
+            gcb.GcDepServiceNode(a, t, log(), config, KeyValueStore())
+        for a in config.acceptor_addresses:
+            gcb.GcAcceptor(a, t, log(), config)
+        for i, a in enumerate(config.replica_addresses):
+            gcb.GcReplica(a, t, log(), config, KeyValueStore(), seed=30 + i)
+        for a in config.garbage_collector_addresses:
+            gcb.GcGarbageCollector(a, t, log(), config)
+        return [
+            gcb.GcClient(SimAddress(f"gbc{i}"), t, log(), config, seed=50 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [
+            c.propose(0, kv_set((f"k{i}", "v"))) for i, c in enumerate(clients)
+        ]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_fastmultipaxos(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -643,6 +689,7 @@ SMOKES = {
     "craq": smoke_craq,
     "epaxos": smoke_epaxos,
     "simplebpaxos": smoke_simplebpaxos,
+    "simplegcbpaxos": smoke_simplegcbpaxos,
     "vanillamencius": smoke_vanillamencius,
     "mencius": smoke_mencius,
     "unanimousbpaxos": smoke_unanimousbpaxos,

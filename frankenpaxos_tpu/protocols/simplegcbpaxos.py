@@ -1,0 +1,1177 @@
+"""Simple GC BPaxos — Simple BPaxos with garbage collection and
+snapshots (reference ``simplegcbpaxos/``; protocol cheatsheet in
+``SimpleGcBPaxos.proto``).
+
+The problem with (Simple)BPaxos is that every piece of state — the
+replica's command log, the dependency service's conflict index, the
+proposers' vertex states, dependency sets themselves — grows forever.
+This variant compacts all of it:
+
+  * Dependency sets are ``VertexIdPrefixSet``s: per-leader watermark +
+    overflow (``VertexIdPrefixSet.scala``). Leaders assign vertex ids
+    CONTIGUOUSLY so prefixes compress well.
+  * Replicas store commands in a ``VertexIdBufferMap`` and periodically
+    broadcast their committed frontier through a co-located
+    GarbageCollector, which relays to proposers and acceptors
+    (``GarbageCollector.scala:99-120``); those drop state below the
+    f+1-quorum watermark (``Proposer.scala:594-627``).
+  * Dependency service nodes keep a two-generation
+    ``CompactConflictIndex`` whose GC'd prefix is folded into every
+    dependency answer (``CompactConflictIndex.scala``).
+  * Replicas periodically have a leader choose a SNAPSHOT vertex that
+    depends on everything; executing it snapshots the state machine +
+    client table. Recovery of a GC'd vertex is answered with
+    ``CommitSnapshot`` instead (``Replica.scala:739-877``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.clienttable import ClientTable, Executed
+from frankenpaxos_tpu.compact import IntPrefixSet
+from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
+from frankenpaxos_tpu.roundsystem import RotatedClassicRoundRobin
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, QuorumWatermarkVector, random_duration
+
+# Vertex ids are (leader_index, id) tuples; ids are assigned contiguously
+# per leader, which is what makes prefix compression effective.
+
+COMMAND = "command"
+NOOP = "noop"
+SNAPSHOT = "snapshot"
+
+
+class VertexIdPrefixSet:
+    """A compact set of vertex ids: one IntPrefixSet per leader
+    (``VertexIdPrefixSet.scala``)."""
+
+    def __init__(self, num_leaders: int,
+                 sets: Optional[List[IntPrefixSet]] = None):
+        self.num_leaders = num_leaders
+        self.sets = sets if sets is not None else [
+            IntPrefixSet() for _ in range(num_leaders)
+        ]
+
+    @staticmethod
+    def from_vertices(num_leaders: int, vertex_ids) -> "VertexIdPrefixSet":
+        out = VertexIdPrefixSet(num_leaders)
+        for leader_index, id in vertex_ids:
+            out.sets[leader_index].add(id)
+        return out
+
+    @staticmethod
+    def from_watermarks(watermarks) -> "VertexIdPrefixSet":
+        return VertexIdPrefixSet(
+            len(watermarks),
+            [IntPrefixSet.from_watermark(w) for w in watermarks],
+        )
+
+    def __repr__(self) -> str:
+        return f"VertexIdPrefixSet({self.sets!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, VertexIdPrefixSet) and self.sets == other.sets
+        )
+
+    def clone(self) -> "VertexIdPrefixSet":
+        return VertexIdPrefixSet(
+            self.num_leaders,
+            [IntPrefixSet(s.watermark, set(s.values)) for s in self.sets],
+        )
+
+    def add(self, vertex_id) -> bool:
+        return self.sets[vertex_id[0]].add(vertex_id[1])
+
+    def contains(self, vertex_id) -> bool:
+        return self.sets[vertex_id[0]].contains(vertex_id[1])
+
+    def union(self, other: "VertexIdPrefixSet") -> "VertexIdPrefixSet":
+        return VertexIdPrefixSet(
+            self.num_leaders,
+            [a.union(b) for a, b in zip(self.sets, other.sets)],
+        )
+
+    def add_all(self, other: "VertexIdPrefixSet") -> "VertexIdPrefixSet":
+        for a, b in zip(self.sets, other.sets):
+            a.add_all(b)
+        return self
+
+    def subtract_one(self, vertex_id) -> "VertexIdPrefixSet":
+        self.sets[vertex_id[0]].subtract_one(vertex_id[1])
+        return self
+
+    def get_watermark(self) -> List[int]:
+        return [s.watermark for s in self.sets]
+
+    @property
+    def size(self) -> int:
+        return sum(s.size for s in self.sets)
+
+    def materialize(self) -> Set[tuple]:
+        return {
+            (i, id)
+            for i, s in enumerate(self.sets)
+            for id in s.materialize()
+        }
+
+    def materialized_diff(self, other: "VertexIdPrefixSet") -> Set[tuple]:
+        """self - other, materialized. Cost is proportional to the DIFF,
+        not to the full prefix — the point of compact sets."""
+        return {
+            (i, id)
+            for i, (mine, theirs) in enumerate(zip(self.sets, other.sets))
+            for id in mine.materialized_diff(theirs)
+        }
+
+    # Wire form: tuple of (watermark, sorted-overflow-tuple) per leader.
+    def to_tuple(self) -> tuple:
+        return tuple(
+            (s.watermark, tuple(sorted(s.values))) for s in self.sets
+        )
+
+    @staticmethod
+    def from_tuple(data: tuple) -> "VertexIdPrefixSet":
+        return VertexIdPrefixSet(
+            len(data),
+            [IntPrefixSet(w, set(values)) for w, values in data],
+        )
+
+
+class VertexIdBufferMap:
+    """One watermark-GC'd BufferMap per leader
+    (``VertexIdBufferMap.scala``)."""
+
+    def __init__(self, num_leaders: int, grow_size: int = 5000):
+        self.maps = [BufferMap(grow_size) for _ in range(num_leaders)]
+
+    def get(self, vertex_id):
+        return self.maps[vertex_id[0]].get(vertex_id[1])
+
+    def put(self, vertex_id, value) -> None:
+        self.maps[vertex_id[0]].put(vertex_id[1], value)
+
+    def garbage_collect(self, watermark: List[int]) -> None:
+        for m, w in zip(self.maps, watermark):
+            m.garbage_collect(w)
+
+
+class CompactConflictIndex:
+    """Two-generation conflict index with a GC watermark folded into
+    every answer (``CompactConflictIndex.scala``). ``garbage_collect``
+    retires the old generation: everything it covered is answered via
+    the watermark from then on."""
+
+    def __init__(self, num_leaders: int, state_machine: StateMachine):
+        self.num_leaders = num_leaders
+        self.state_machine = state_machine
+        self.new_index = state_machine.conflict_index()
+        self.new_watermark = [0] * num_leaders
+        self.old_index = state_machine.conflict_index()
+        self.old_watermark = [0] * num_leaders
+        self.gc_watermark = [0] * num_leaders
+
+    def put(self, vertex_id, command: bytes) -> None:
+        self.new_index.put(vertex_id, command)
+        leader_index, id = vertex_id
+        self.new_watermark[leader_index] = max(
+            self.new_watermark[leader_index], id + 1
+        )
+
+    def put_snapshot(self, vertex_id) -> None:
+        self.new_index.put_snapshot(vertex_id)
+        leader_index, id = vertex_id
+        self.new_watermark[leader_index] = max(
+            self.new_watermark[leader_index], id + 1
+        )
+
+    def get_conflicts(self, command: bytes) -> VertexIdPrefixSet:
+        conflicts = VertexIdPrefixSet.from_vertices(
+            self.num_leaders,
+            set(self.new_index.get_conflicts(command))
+            | set(self.old_index.get_conflicts(command)),
+        )
+        return conflicts.add_all(
+            VertexIdPrefixSet.from_watermarks(self.gc_watermark)
+        )
+
+    def garbage_collect(self) -> None:
+        for i in range(self.num_leaders):
+            self.gc_watermark[i] = max(self.gc_watermark[i],
+                                       self.old_watermark[i])
+            self.old_watermark[i] = self.new_watermark[i]
+            self.new_watermark[i] = 0
+        self.old_index = self.new_index
+        self.new_index = self.state_machine.conflict_index()
+
+    def high_watermark(self) -> VertexIdPrefixSet:
+        return VertexIdPrefixSet.from_watermarks([
+            max(self.gc_watermark[i], self.old_watermark[i],
+                self.new_watermark[i])
+            for i in range(self.num_leaders)
+        ])
+
+
+# -- Messages -----------------------------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcCommand:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcClientRequest:
+    command: GcCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcClientReply:
+    client_pseudonym: int
+    client_id: int
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcSnapshotRequest:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcDependencyRequest:
+    vertex_id: tuple
+    kind: str  # COMMAND or SNAPSHOT
+    command: Optional[GcCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcDependencyReply:
+    vertex_id: tuple
+    dep_service_node_index: int
+    dependencies: tuple  # VertexIdPrefixSet.to_tuple()
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcPropose:
+    vertex_id: tuple
+    kind: str
+    command: Optional[GcCommand]
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcPhase1a:
+    vertex_id: tuple
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcPhase1b:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+    vote_round: int
+    vote_value: Optional[tuple]  # (kind, command|None, dependencies)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcPhase2a:
+    vertex_id: tuple
+    round: int
+    vote_value: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcPhase2b:
+    vertex_id: tuple
+    acceptor_id: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcNack:
+    vertex_id: tuple
+    higher_round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcCommit:
+    vertex_id: tuple
+    kind: str
+    command: Optional[GcCommand]
+    dependencies: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcRecover:
+    vertex_id: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcCommitSnapshot:
+    id: int
+    watermark: tuple  # VertexIdPrefixSet.to_tuple()
+    state_machine: bytes
+    client_table: tuple  # of (client_address, pseudonym, client_id, output)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class GcGarbageCollect:
+    replica_index: int
+    frontier: tuple  # per-leader committed watermark
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleGcBPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    proposer_addresses: tuple  # co-located with leaders, same length
+    dep_service_node_addresses: tuple  # 2f+1
+    acceptor_addresses: tuple  # 2f+1
+    replica_addresses: tuple  # f+1
+    garbage_collector_addresses: tuple  # co-located with replicas
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    @property
+    def num_leaders(self) -> int:
+        return len(self.leader_addresses)
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.proposer_addresses) != len(self.leader_addresses):
+            raise ValueError("one proposer per leader")
+        if len(self.dep_service_node_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 dep service nodes")
+        if len(self.acceptor_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+        if len(self.garbage_collector_addresses) != len(self.replica_addresses):
+            raise ValueError("one garbage collector per replica")
+
+
+# -- Leader -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _GcLeaderState:
+    kind: str
+    command: Optional[GcCommand]
+    replies: Dict[int, GcDependencyReply]
+    resend: object
+
+
+class GcLeader(Actor):
+    """``simplegcbpaxos/Leader.scala``: contiguous vertex ids, dependency
+    aggregation by prefix-set union, hand-off to the co-located
+    proposer. Also accepts SnapshotRequests from replicas."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig,
+                 resend_period: float = 5.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.index = config.leader_addresses.index(address)
+        self.next_vertex_id = 0
+        self.states: Dict[tuple, _GcLeaderState] = {}
+
+    def _thrifty_dep_nodes(self):
+        nodes = self.config.dep_service_node_addresses
+        return [
+            nodes[i]
+            for i in self.rng.sample(range(len(nodes)), self.config.quorum_size)
+        ]
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, GcClientRequest):
+            self._handle_request(COMMAND, msg.command)
+        elif isinstance(msg, GcSnapshotRequest):
+            self._handle_request(SNAPSHOT, None)
+        elif isinstance(msg, GcDependencyReply):
+            self._handle_dependency_reply(msg)
+        else:
+            self.logger.fatal(f"unknown gc leader message {msg!r}")
+
+    def _handle_request(self, kind: str, command: Optional[GcCommand]) -> None:
+        vertex_id = (self.index, self.next_vertex_id)
+        self.next_vertex_id += 1
+        request = GcDependencyRequest(
+            vertex_id=vertex_id, kind=kind, command=command
+        )
+        # Thrifty first send to a random quorum (Leader.scala
+        # thriftyDepServiceNodes); the resend timer goes wide.
+        for a in self._thrifty_dep_nodes():
+            self.chan(a).send(request)
+
+        def resend() -> None:
+            for a in self.config.dep_service_node_addresses:
+                self.chan(a).send(request)
+            timer.start()
+
+        timer = self.timer(
+            f"resendDeps{vertex_id}", self.resend_period, resend
+        )
+        timer.start()
+        self.states[vertex_id] = _GcLeaderState(
+            kind=kind, command=command, replies={}, resend=timer
+        )
+
+    def _handle_dependency_reply(self, msg: GcDependencyReply) -> None:
+        state = self.states.get(msg.vertex_id)
+        if state is None:
+            return
+        state.replies[msg.dep_service_node_index] = msg
+        if len(state.replies) < self.config.quorum_size:
+            return
+        dependencies = VertexIdPrefixSet(self.config.num_leaders)
+        for reply in state.replies.values():
+            dependencies.add_all(
+                VertexIdPrefixSet.from_tuple(reply.dependencies)
+            )
+        state.resend.stop()
+        del self.states[msg.vertex_id]
+        self.chan(self.config.proposer_addresses[self.index]).send(
+            GcPropose(
+                vertex_id=msg.vertex_id,
+                kind=state.kind,
+                command=state.command,
+                dependencies=dependencies.to_tuple(),
+            )
+        )
+
+
+# -- Dependency service -------------------------------------------------------
+
+
+class GcDepServiceNode(Actor):
+    """``simplegcbpaxos/DepServiceNode.scala`` with the compacted
+    conflict index: every answer folds in the GC watermark, and every
+    ``garbage_collect_every_n_commands`` commands the old generation is
+    retired. Snapshot requests depend on EVERYTHING seen so far (the
+    index's high watermark)."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig, state_machine: StateMachine,
+                 garbage_collect_every_n_commands: int = 100):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.dep_service_node_addresses)
+        self.config = config
+        self.index = config.dep_service_node_addresses.index(address)
+        self.conflict_index = CompactConflictIndex(
+            config.num_leaders, state_machine
+        )
+        self.garbage_collect_every_n_commands = garbage_collect_every_n_commands
+        self._commands_since_gc = 0
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, GcDependencyRequest):
+            self.logger.fatal(f"unknown dep service message {msg!r}")
+        if msg.kind == SNAPSHOT:
+            dependencies = self.conflict_index.high_watermark()
+            dependencies.subtract_one(msg.vertex_id)
+            self.conflict_index.put_snapshot(msg.vertex_id)
+        else:
+            dependencies = self.conflict_index.get_conflicts(
+                msg.command.command
+            )
+            dependencies.subtract_one(msg.vertex_id)
+            self.conflict_index.put(msg.vertex_id, msg.command.command)
+        self.chan(src).send(
+            GcDependencyReply(
+                vertex_id=msg.vertex_id,
+                dep_service_node_index=self.index,
+                dependencies=dependencies.to_tuple(),
+            )
+        )
+        self._commands_since_gc += 1
+        if self._commands_since_gc >= self.garbage_collect_every_n_commands:
+            self.conflict_index.garbage_collect()
+            self._commands_since_gc = 0
+
+
+# -- Proposer -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _GcPhase1:
+    round: int
+    value: tuple
+    phase1bs: Dict[int, GcPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _GcPhase2:
+    round: int
+    value: tuple
+    phase2bs: Dict[int, GcPhase2b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _GcChosen:
+    value: tuple
+
+
+class GcProposer(Actor):
+    """``simplegcbpaxos/Proposer.scala``: per-vertex Paxos with a
+    GC watermark — any message about a vertex below the f+1-quorum
+    replica frontier is dropped, and chosen state below it is
+    discarded."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig,
+                 resend_period: float = 5.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.proposer_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.index = config.proposer_addresses.index(address)
+        self.states: Dict[tuple, object] = {}
+        self.gc_vector = QuorumWatermarkVector(
+            n=len(config.replica_addresses), depth=config.num_leaders
+        )
+        self.gc_watermark: List[int] = self.gc_vector.watermark(
+            quorum_size=config.f + 1
+        )
+
+    def _gcd(self, vertex_id: tuple) -> bool:
+        return vertex_id[1] < self.gc_watermark[vertex_id[0]]
+
+    def _round_system(self, vertex_id: tuple):
+        return RotatedClassicRoundRobin(
+            self.config.num_leaders, vertex_id[0]
+        )
+
+    def _thrifty_acceptors(self):
+        acceptors = self.config.acceptor_addresses
+        return [
+            acceptors[i]
+            for i in self.rng.sample(
+                range(len(acceptors)), self.config.quorum_size
+            )
+        ]
+
+    def _make_resend(self, name, msg):
+        def fire() -> None:
+            for a in self.config.acceptor_addresses:
+                self.chan(a).send(msg)
+            timer.start()
+
+        timer = self.timer(name, self.resend_period, fire)
+        timer.start()
+        return timer
+
+    def _propose_impl(self, vertex_id: tuple, value: tuple) -> None:
+        if vertex_id in self.states:
+            return
+        round = self._round_system(vertex_id).next_classic_round(
+            self.index, -1
+        )
+        if round == 0:
+            phase2a = GcPhase2a(vertex_id=vertex_id, round=0, vote_value=value)
+            for a in self._thrifty_acceptors():
+                self.chan(a).send(phase2a)
+            self.states[vertex_id] = _GcPhase2(
+                round=0, value=value, phase2bs={},
+                resend=self._make_resend(f"resendPhase2a{vertex_id}", phase2a),
+            )
+        else:
+            phase1a = GcPhase1a(vertex_id=vertex_id, round=round)
+            for a in self._thrifty_acceptors():
+                self.chan(a).send(phase1a)
+            self.states[vertex_id] = _GcPhase1(
+                round=round, value=value, phase1bs={},
+                resend=self._make_resend(f"resendPhase1a{vertex_id}", phase1a),
+            )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, GcGarbageCollect):
+            self._handle_garbage_collect(msg)
+            return
+        if hasattr(msg, "vertex_id") and self._gcd(msg.vertex_id):
+            return  # below the GC watermark: ignore (Proposer.scala:312)
+        if isinstance(msg, GcPropose):
+            self._propose_impl(
+                msg.vertex_id, (msg.kind, msg.command, msg.dependencies)
+            )
+        elif isinstance(msg, GcPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, GcPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, GcNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, GcRecover):
+            self._handle_recover(src, msg)
+        else:
+            self.logger.fatal(f"unknown gc proposer message {msg!r}")
+
+    def _handle_phase1b(self, msg: GcPhase1b) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _GcPhase1) or msg.round != state.round:
+            return
+        state.phase1bs[msg.acceptor_id] = msg
+        if len(state.phase1bs) < self.config.quorum_size:
+            return
+        max_vote_round = max(b.vote_round for b in state.phase1bs.values())
+        if max_vote_round == -1:
+            value = state.value
+        else:
+            value = next(
+                b.vote_value for b in state.phase1bs.values()
+                if b.vote_round == max_vote_round
+            )
+        phase2a = GcPhase2a(
+            vertex_id=msg.vertex_id, round=state.round, vote_value=value
+        )
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase2a)
+        state.resend.stop()
+        self.states[msg.vertex_id] = _GcPhase2(
+            round=state.round, value=value, phase2bs={},
+            resend=self._make_resend(f"resendPhase2a{msg.vertex_id}", phase2a),
+        )
+
+    def _handle_phase2b(self, msg: GcPhase2b) -> None:
+        state = self.states.get(msg.vertex_id)
+        if not isinstance(state, _GcPhase2) or msg.round != state.round:
+            return
+        state.phase2bs[msg.acceptor_id] = msg
+        if len(state.phase2bs) < self.config.quorum_size:
+            return
+        state.resend.stop()
+        self.states[msg.vertex_id] = _GcChosen(value=state.value)
+        kind, command, dependencies = state.value
+        commit = GcCommit(
+            vertex_id=msg.vertex_id, kind=kind, command=command,
+            dependencies=dependencies,
+        )
+        for replica in self.config.replica_addresses:
+            self.chan(replica).send(commit)
+
+    def _handle_nack(self, msg: GcNack) -> None:
+        state = self.states.get(msg.vertex_id)
+        if state is None or isinstance(state, _GcChosen):
+            return
+        if msg.higher_round <= state.round:
+            return
+        round = self._round_system(msg.vertex_id).next_classic_round(
+            self.index, msg.higher_round
+        )
+        phase1a = GcPhase1a(vertex_id=msg.vertex_id, round=round)
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(phase1a)
+        state.resend.stop()
+        self.states[msg.vertex_id] = _GcPhase1(
+            round=round, value=state.value, phase1bs={},
+            resend=self._make_resend(f"resendPhase1a{msg.vertex_id}", phase1a),
+        )
+
+    def _handle_recover(self, src: Address, msg: GcRecover) -> None:
+        state = self.states.get(msg.vertex_id)
+        if state is None:
+            # Propose a noop with no dependencies to fill the hole.
+            self._propose_impl(
+                msg.vertex_id,
+                (NOOP, None,
+                 VertexIdPrefixSet(self.config.num_leaders).to_tuple()),
+            )
+        elif isinstance(state, _GcChosen):
+            kind, command, dependencies = state.value
+            self.chan(src).send(
+                GcCommit(
+                    vertex_id=msg.vertex_id, kind=kind, command=command,
+                    dependencies=dependencies,
+                )
+            )
+
+    def _handle_garbage_collect(self, msg: GcGarbageCollect) -> None:
+        self.gc_vector.update(msg.replica_index, list(msg.frontier))
+        self.gc_watermark = self.gc_vector.watermark(
+            quorum_size=self.config.f + 1
+        )
+        # Drop (and silence) all state below the watermark. NOTE: the
+        # reference stops timers for vertices ABOVE the watermark
+        # (Proposer.scala:612-620), which looks inverted; we stop timers
+        # for the vertices being dropped.
+        for vertex_id in [v for v in self.states if self._gcd(v)]:
+            state = self.states.pop(vertex_id)
+            if isinstance(state, (_GcPhase1, _GcPhase2)):
+                state.resend.stop()
+
+
+# -- Acceptor -----------------------------------------------------------------
+
+
+class GcAcceptor(Actor):
+    """Per-vertex (round, voteRound, voteValue), with GC
+    (``simplegcbpaxos/Acceptor.scala``)."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        # vertex -> [round, vote_round, vote_value]
+        self.states: Dict[tuple, list] = {}
+        self.gc_vector = QuorumWatermarkVector(
+            n=len(config.replica_addresses), depth=config.num_leaders
+        )
+        self.gc_watermark: List[int] = self.gc_vector.watermark(
+            quorum_size=config.f + 1
+        )
+
+    def _gcd(self, vertex_id: tuple) -> bool:
+        return vertex_id[1] < self.gc_watermark[vertex_id[0]]
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, GcGarbageCollect):
+            self.gc_vector.update(msg.replica_index, list(msg.frontier))
+            self.gc_watermark = self.gc_vector.watermark(
+                quorum_size=self.config.f + 1
+            )
+            for vertex_id in [v for v in self.states if self._gcd(v)]:
+                del self.states[vertex_id]
+            return
+        if self._gcd(msg.vertex_id):
+            return
+        if isinstance(msg, GcPhase1a):
+            state = self.states.setdefault(msg.vertex_id, [-1, -1, None])
+            if msg.round < state[0]:
+                self.chan(src).send(
+                    GcNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            self.chan(src).send(
+                GcPhase1b(
+                    vertex_id=msg.vertex_id, acceptor_id=self.index,
+                    round=msg.round, vote_round=state[1], vote_value=state[2],
+                )
+            )
+        elif isinstance(msg, GcPhase2a):
+            state = self.states.setdefault(msg.vertex_id, [-1, -1, None])
+            if msg.round < state[0]:
+                self.chan(src).send(
+                    GcNack(vertex_id=msg.vertex_id, higher_round=state[0])
+                )
+                return
+            state[0] = msg.round
+            state[1] = msg.round
+            state[2] = msg.vote_value
+            self.chan(src).send(
+                GcPhase2b(
+                    vertex_id=msg.vertex_id, acceptor_id=self.index,
+                    round=msg.round,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown gc acceptor message {msg!r}")
+
+
+# -- Replica ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _GcSnapshot:
+    id: int
+    watermark: VertexIdPrefixSet
+    state_machine: bytes
+    client_table: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GcReplicaOptions:
+    send_watermark_every_n_commands: int = 10
+    send_snapshot_every_n_commands: int = 100
+    recover_min_period: float = 5.0
+    recover_max_period: float = 10.0
+    commands_grow_size: int = 5000
+
+
+class GcReplica(Actor):
+    """``simplegcbpaxos/Replica.scala``: committed commands live in a
+    GC'd VertexIdBufferMap; ``committed_vertices`` / ``executed_vertices``
+    prefix sets never forget. Executing a SNAPSHOT vertex captures the
+    state machine + client table; recovery of a GC'd vertex is served
+    from the snapshot (CommitSnapshot)."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig, state_machine: StateMachine,
+                 options: GcReplicaOptions = GcReplicaOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.replica_addresses.index(address)
+        self.dependency_graph = TarjanDependencyGraph()
+        self.commands = VertexIdBufferMap(
+            config.num_leaders, options.commands_grow_size
+        )
+        self.committed_vertices = VertexIdPrefixSet(config.num_leaders)
+        self.executed_vertices = VertexIdPrefixSet(config.num_leaders)
+        self.snapshot: Optional[_GcSnapshot] = None
+        self.history: List[tuple] = []
+        self.client_table: ClientTable = ClientTable()
+        self.recover_timers: Dict[tuple, object] = {}
+        self._pending_watermark = 0
+        # Stagger snapshot requests across replicas (Replica.scala:278).
+        self._pending_snapshot = options.send_snapshot_every_n_commands * \
+            self.index
+
+    # -- Execution ------------------------------------------------------------
+
+    def _execute(self) -> None:
+        executables, blockers = self.dependency_graph.execute()
+        for vertex_id in blockers:
+            if vertex_id not in self.recover_timers:
+                self.recover_timers[vertex_id] = self._make_recover_timer(
+                    vertex_id
+                )
+        for vertex_id in executables:
+            committed = self.commands.get(vertex_id)
+            if committed is None:
+                self.logger.fatal(
+                    f"vertex {vertex_id} executable but not present"
+                )
+            self._execute_proposal(vertex_id, committed[0], committed[1])
+
+    def _execute_proposal(self, vertex_id: tuple, kind: str,
+                          command: Optional[GcCommand]) -> None:
+        self.executed_vertices.add(vertex_id)
+        if kind == NOOP:
+            return
+        if kind == SNAPSHOT:
+            self.snapshot = _GcSnapshot(
+                id=(self.snapshot.id + 1) if self.snapshot else 0,
+                watermark=self.executed_vertices.clone(),
+                state_machine=self.state_machine.to_bytes(),
+                client_table=self._client_table_tuple(),
+            )
+            self.history.clear()
+            self.commands.garbage_collect(
+                self.executed_vertices.get_watermark()
+            )
+            return
+        # COMMAND
+        identity = (command.client_address, command.client_pseudonym)
+        cached = self.client_table.executed(identity, command.client_id)
+        if isinstance(cached, Executed):
+            if cached.output is not None and self._replies(vertex_id):
+                self._reply(command, cached.output)
+            return
+        output = self.state_machine.run(command.command)
+        self.client_table.execute(identity, command.client_id, output)
+        self.history.append(vertex_id)
+        if self._replies(vertex_id):
+            self._reply(command, output)
+
+    def _replies(self, vertex_id: tuple) -> bool:
+        # One designated replier per leader index (Replica.scala:573).
+        return self.index == vertex_id[0] % len(self.config.replica_addresses)
+
+    def _reply(self, command: GcCommand, output: bytes) -> None:
+        client = self.transport.address_from_bytes(command.client_address)
+        self.chan(client).send(
+            GcClientReply(
+                client_pseudonym=command.client_pseudonym,
+                client_id=command.client_id,
+                result=output,
+            )
+        )
+
+    def _client_table_tuple(self):
+        # Identities are (address_bytes, pseudonym); encode via the wire
+        # codec so the full table (incl. executed-id prefix sets) survives.
+        return self.client_table.to_proto(
+            address_to_bytes=lambda ident: wire.encode(ident),
+            output_to_bytes=lambda output: output,
+        )
+
+    def _client_table_from_tuple(self, proto) -> ClientTable:
+        return ClientTable.from_proto(
+            proto,
+            address_from_bytes=lambda data: tuple(wire.decode(data)),
+            output_from_bytes=lambda output: output,
+        )
+
+    # -- GC / snapshot triggers ----------------------------------------------
+
+    def _send_watermark_if_needed(self) -> None:
+        self._pending_watermark += 1
+        if self._pending_watermark % \
+                self.options.send_watermark_every_n_commands == 0:
+            self.chan(
+                self.config.garbage_collector_addresses[self.index]
+            ).send(
+                GcGarbageCollect(
+                    replica_index=self.index,
+                    frontier=tuple(self.committed_vertices.get_watermark()),
+                )
+            )
+            self._pending_watermark = 0
+
+    def _send_snapshot_if_needed(self) -> None:
+        self._pending_snapshot += 1
+        n = self.options.send_snapshot_every_n_commands * \
+            len(self.config.replica_addresses)
+        if self._pending_snapshot % n == 0:
+            leader = self.config.leader_addresses[
+                self.rng.randrange(self.config.num_leaders)
+            ]
+            self.chan(leader).send(GcSnapshotRequest())
+            self._pending_snapshot = 0
+
+    # -- Timers ---------------------------------------------------------------
+
+    def _make_recover_timer(self, vertex_id: tuple):
+        def fire() -> None:
+            proposer = self.config.proposer_addresses[
+                self.rng.randrange(len(self.config.proposer_addresses))
+            ]
+            self.chan(proposer).send(GcRecover(vertex_id=vertex_id))
+            # Proposers may have GC'd the vertex; replicas haven't
+            # (Replica.scala:640-646).
+            for replica in self.config.replica_addresses:
+                if replica != self.address:
+                    self.chan(replica).send(GcRecover(vertex_id=vertex_id))
+            timer.start()
+
+        timer = self.timer(
+            f"recoverVertex{vertex_id}",
+            random_duration(
+                self.rng, self.options.recover_min_period,
+                self.options.recover_max_period,
+            ),
+            fire,
+        )
+        timer.start()
+        return timer
+
+    # -- Handlers -------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, GcCommit):
+            self._handle_commit(msg)
+        elif isinstance(msg, GcRecover):
+            self._handle_recover(src, msg)
+        elif isinstance(msg, GcCommitSnapshot):
+            self._handle_commit_snapshot(msg)
+        else:
+            self.logger.fatal(f"unknown gc replica message {msg!r}")
+
+    def _handle_commit(self, msg: GcCommit) -> None:
+        if self.committed_vertices.contains(msg.vertex_id):
+            return
+        dependencies = VertexIdPrefixSet.from_tuple(msg.dependencies)
+        self.commands.put(msg.vertex_id, (msg.kind, msg.command, dependencies))
+        self.committed_vertices.add(msg.vertex_id)
+        timer = self.recover_timers.pop(msg.vertex_id, None)
+        if timer is not None:
+            timer.stop()
+        # Only the NOT-yet-executed dependencies matter to the graph
+        # (executed ones are already ordered before us), and the diff
+        # against the executed prefix stays small even though the folded
+        # GC watermark makes the full dependency set O(history).
+        self.dependency_graph.commit(
+            msg.vertex_id, 0,
+            dependencies.materialized_diff(self.executed_vertices),
+        )
+        self._execute()
+        self._send_watermark_if_needed()
+        self._send_snapshot_if_needed()
+
+    def _handle_recover(self, src: Address, msg: GcRecover) -> None:
+        if (
+            self.snapshot is not None
+            and self.snapshot.watermark.contains(msg.vertex_id)
+        ):
+            self.chan(src).send(
+                GcCommitSnapshot(
+                    id=self.snapshot.id,
+                    watermark=self.snapshot.watermark.to_tuple(),
+                    state_machine=self.snapshot.state_machine,
+                    client_table=self.snapshot.client_table,
+                )
+            )
+            return
+        committed = self.commands.get(msg.vertex_id)
+        if committed is not None:
+            kind, command, dependencies = committed
+            self.chan(src).send(
+                GcCommit(
+                    vertex_id=msg.vertex_id, kind=kind, command=command,
+                    dependencies=dependencies.to_tuple(),
+                )
+            )
+
+    def _handle_commit_snapshot(self, msg: GcCommitSnapshot) -> None:
+        if self.snapshot is not None and msg.id <= self.snapshot.id:
+            return
+        self.state_machine.from_bytes(msg.state_machine)
+        self.client_table = self._client_table_from_tuple(msg.client_table)
+        watermark = VertexIdPrefixSet.from_tuple(msg.watermark)
+        newly_executed = watermark.materialized_diff(self.executed_vertices)
+        self.commands.garbage_collect(watermark.get_watermark())
+        self.committed_vertices.add_all(watermark)
+        self.executed_vertices.add_all(watermark)
+        self.snapshot = _GcSnapshot(
+            id=msg.id, watermark=watermark,
+            state_machine=msg.state_machine, client_table=msg.client_table,
+        )
+        for vertex_id in [
+            v for v in self.recover_timers if watermark.contains(v)
+        ]:
+            self.recover_timers.pop(vertex_id).stop()
+        # Re-execute unsnapshotted history on top of the snapshot state
+        # (Replica.scala:820-850). Detach first: _execute_proposal appends
+        # to self.history, so iterating it in place would double entries
+        # (and re-send cached replies) on every install.
+        old_history, self.history = self.history, []
+        for vertex_id in old_history:
+            if watermark.contains(vertex_id):
+                continue
+            committed = self.commands.get(vertex_id)
+            self.logger.check(committed is not None)
+            self._execute_proposal(vertex_id, committed[0], committed[1])
+        self.dependency_graph.update_executed(newly_executed)
+        self._execute()
+
+
+# -- Garbage collector --------------------------------------------------------
+
+
+class GcGarbageCollector(Actor):
+    """``simplegcbpaxos/GarbageCollector.scala``: relays a replica's
+    committed frontier to every proposer and acceptor."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, GcGarbageCollect):
+            self.logger.fatal(f"unknown garbage collector message {msg!r}")
+        for a in self.config.proposer_addresses:
+            self.chan(a).send(msg)
+        for a in self.config.acceptor_addresses:
+            self.chan(a).send(msg)
+
+
+# -- Client -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _GcPending:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class GcClient(Actor):
+    """``simplegcbpaxos/Client.scala``: proposes through a random
+    leader; a fresh vertex id is assigned on every retransmission, so
+    replica-side dedup (client table) provides at-most-once."""
+
+    def __init__(self, address, transport, logger,
+                 config: SimpleGcBPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _GcPending] = {}
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+        request = GcClientRequest(
+            command=GcCommand(
+                client_address=self.address_bytes,
+                client_pseudonym=pseudonym,
+                client_id=id,
+                command=command,
+            )
+        )
+
+        def send() -> None:
+            leader = self.config.leader_addresses[
+                self.rng.randrange(self.config.num_leaders)
+            ]
+            self.chan(leader).send(request)
+
+        def resend() -> None:
+            send()
+            timer.start()
+
+        timer = self.timer(f"resendGc{pseudonym}", self.resend_period, resend)
+        timer.start()
+        self.pending[pseudonym] = _GcPending(
+            id=id, command=command, result=promise, resend=timer
+        )
+        send()
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if not isinstance(msg, GcClientReply):
+            self.logger.fatal(f"unknown gc client message {msg!r}")
+        pending = self.pending.get(msg.client_pseudonym)
+        if pending is None or msg.client_id != pending.id:
+            return
+        pending.resend.stop()
+        del self.pending[msg.client_pseudonym]
+        pending.result.success(msg.result)
