@@ -519,6 +519,60 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_matchmakermultipaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import matchmakermultipaxos as mmx
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = mmx.MatchmakerMultiPaxosConfig(
+            f=1,
+            leader_addresses=(SimAddress("mxl0"), SimAddress("mxl1")),
+            leader_election_addresses=(
+                SimAddress("mxe0"), SimAddress("mxe1"),
+            ),
+            reconfigurer_addresses=(SimAddress("mxr0"), SimAddress("mxr1")),
+            matchmaker_addresses=tuple(
+                SimAddress(f"mxm{i}") for i in range(4)
+            ),
+            acceptor_addresses=tuple(SimAddress(f"mxa{i}") for i in range(4)),
+            replica_addresses=(SimAddress("mxrep0"), SimAddress("mxrep1")),
+        )
+        for i, a in enumerate(config.leader_addresses):
+            mmx.MmmLeader(a, t, log(), config, seed=i)
+        for i, a in enumerate(config.reconfigurer_addresses):
+            mmx.MmmReconfigurer(a, t, log(), config, seed=10 + i)
+        for a in config.matchmaker_addresses:
+            mmx.MmmMatchmaker(a, t, log(), config)
+        for a in config.acceptor_addresses:
+            mmx.MmmAcceptor(a, t, log(), config)
+        for i, a in enumerate(config.replica_addresses):
+            mmx.MmmReplica(a, t, log(), config, ReadableAppendLog(),
+                           seed=30 + i)
+        _drain(t)  # leader 0's matchmaking + phase 1
+        driver = mmx.MmmDriver(
+            SimAddress("mxd"), t, log(), config, mmx.DoNothing(), seed=99
+        )
+        clients = [
+            mmx.MmmClient(SimAddress(f"mxc{i}"), t, log(), config, seed=50 + i)
+            for i in range(2)
+        ]
+        return driver, clients
+
+    def operate(t, ctx):
+        driver, clients = ctx
+        promises = [clients[0].propose(0, b"cmd0")]
+        _drain(t)
+        # Exercise an acceptor reconfiguration mid-smoke.
+        driver.force_reconfiguration(members=(1, 2, 3))
+        promises.append(clients[1].propose(0, b"cmd1"))
+        return promises
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_simplegcbpaxos(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -694,6 +748,7 @@ SMOKES = {
     "mencius": smoke_mencius,
     "unanimousbpaxos": smoke_unanimousbpaxos,
     "matchmakerpaxos": smoke_matchmakerpaxos,
+    "matchmakermultipaxos": smoke_matchmakermultipaxos,
     "fastmultipaxos": smoke_fastmultipaxos,
     "scalog": smoke_scalog,
     "multipaxos": smoke_multipaxos,

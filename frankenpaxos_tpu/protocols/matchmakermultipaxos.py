@@ -1,0 +1,2159 @@
+"""Matchmaker MultiPaxos — MultiPaxos with online reconfiguration of the
+acceptor set (reference ``matchmakermultipaxos/``; protocol cheatsheet
+in ``MatchmakerMultiPaxos.proto``; VLDB '21 "Matchmaker Paxos").
+
+Every round has its own acceptor quorum system. A leader entering round
+i sends its chosen configuration to the MATCHMAKERS (MatchRequest); f+1
+MatchReplies return every configuration used in earlier rounds, and the
+leader runs phase 1 against a read quorum of EACH prior configuration
+before running phase 2 in its own (``Leader.scala:1020-1238``).
+
+  * i/i+1 reconfiguration: an active leader swaps acceptor sets without
+    stalling — phase 2 of round i keeps running while the leader
+    matchmakes and phase-1s round i+1 (states Phase2Matchmaking →
+    Phase212 → Phase22, ``Leader.scala:454-487``).
+  * Matchmakers themselves are reconfigured by RECONFIGURERS: stop the
+    old epoch, bootstrap the new one with the merged configuration log,
+    then choose the new MatchmakerConfiguration with a Paxos round over
+    the OLD epoch's matchmakers (``Reconfigurer.scala``).
+  * GC pipeline: once f+1 replicas have executed a prefix, the leader
+    persists that watermark on a write quorum of acceptors (which then
+    answer phase 2 for those slots with ``persisted=true``) and finally
+    has the matchmakers drop configurations below its round
+    (``Leader.scala:360-419``).
+  * The Driver injects failures/reconfigurations on a schedule for
+    chaos benchmarks (``matchmakermultipaxos/Driver.scala``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.election import basic as election
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.roundsystem import (
+    ClassicRoundRobin,
+    ClassicStutteredRoundRobin,
+)
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, random_duration
+
+COMMAND = "command"
+NOOP = "noop"
+
+
+# -- Messages -----------------------------------------------------------------
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmCommand:
+    command_id: MmmCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmConfiguration:
+    round: int
+    # SimpleMajority member indices (the reference also hard-codes
+    # SimpleMajority quorum systems, Leader.scala:976).
+    members: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchmakerConfiguration:
+    epoch: int
+    reconfigurer_index: int
+    matchmaker_indices: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchRequest:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    configuration: MmmConfiguration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchReply:
+    epoch: int
+    round: int
+    matchmaker_index: int
+    gc_watermark: int
+    configurations: tuple  # of MmmConfiguration with round < request round
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPhase1a:
+    round: int
+    chosen_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPhase1b:
+    round: int
+    acceptor_index: int
+    persisted_watermark: int
+    info: tuple  # of (slot, vote_round, kind, command|None)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmClientRequest:
+    command: MmmCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPhase2a:
+    slot: int
+    round: int
+    kind: str
+    command: Optional[MmmCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPhase2b:
+    slot: int
+    round: int
+    acceptor_index: int
+    persisted: bool
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmChosen:
+    slot: int
+    kind: str
+    command: Optional[MmmCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmChosenWatermark:
+    watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmClientReply:
+    command_id: MmmCommandId
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmNotLeader:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmLeaderInfoRequest:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmLeaderInfoReply:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchmakerNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmAcceptorNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmRecover:
+    slot: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmExecutedWatermarkRequest:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmExecutedWatermarkReply:
+    replica_index: int
+    executed_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPersisted:
+    persisted_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmPersistedAck:
+    acceptor_index: int
+    persisted_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmGarbageCollect:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    gc_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmGarbageCollectAck:
+    epoch: int
+    matchmaker_index: int
+    gc_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmStopped:
+    epoch: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmReconfigure:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    new_matchmaker_indices: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmStop:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmStopAck:
+    epoch: int
+    matchmaker_index: int
+    gc_watermark: int
+    configurations: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmBootstrap:
+    epoch: int
+    reconfigurer_index: int
+    gc_watermark: int
+    configurations: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmBootstrapAck:
+    epoch: int
+    matchmaker_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchPhase1a:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchPhase1b:
+    epoch: int
+    round: int
+    matchmaker_index: int
+    vote_round: int  # -1 = no vote
+    vote_value: Optional[MmmMatchmakerConfiguration]
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchPhase2a:
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    round: int
+    value: MmmMatchmakerConfiguration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchPhase2b:
+    epoch: int
+    round: int
+    matchmaker_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchChosen:
+    value: MmmMatchmakerConfiguration
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmMatchNack:
+    epoch: int
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmForceReconfiguration:
+    acceptor_indices: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class MmmForceMatchmakerReconfiguration:
+    matchmaker_indices: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerMultiPaxosConfig:
+    f: int
+    leader_addresses: tuple
+    leader_election_addresses: tuple
+    reconfigurer_addresses: tuple  # f+1
+    matchmaker_addresses: tuple  # >= 2f+1; first 2f+1 form epoch 0
+    acceptor_addresses: tuple  # >= 2f+1
+    replica_addresses: tuple  # >= f+1
+
+    @property
+    def quorum_size(self) -> int:
+        return self.f + 1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.leader_election_addresses) != len(self.leader_addresses):
+            raise ValueError("one election address per leader")
+        if len(self.reconfigurer_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 reconfigurers")
+        if len(self.matchmaker_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 matchmakers")
+        if len(self.acceptor_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+def initial_matchmaker_configuration(
+    config: MatchmakerMultiPaxosConfig,
+) -> MmmMatchmakerConfiguration:
+    # The first 2f+1 matchmakers form epoch 0 (Matchmaker.scala:179-188).
+    return MmmMatchmakerConfiguration(
+        epoch=0,
+        reconfigurer_index=0,
+        matchmaker_indices=tuple(range(2 * config.f + 1)),
+    )
+
+
+# -- Leader -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Matchmaking:
+    round: int
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    quorum_members: tuple
+    match_replies: Dict[int, MmmMatchReply]
+    pending_requests: List[MmmClientRequest]
+    resend: object
+
+
+@dataclasses.dataclass
+class _WaitingForNewMatchmakers:
+    round: int
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    quorum_members: tuple
+    pending_requests: List[MmmClientRequest]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Phase1:
+    round: int
+    quorum_members: tuple
+    previous_quorums: Dict[int, SimpleMajority]  # round -> quorum system
+    acceptor_to_rounds: Dict[int, Set[int]]
+    pending_rounds: Set[int]
+    phase1bs: Dict[int, MmmPhase1b]
+    pending_requests: List[MmmClientRequest]
+    resend: object
+
+
+# GC sub-states of Phase2 (Leader.scala:360-419).
+@dataclasses.dataclass
+class _QueryingReplicas:
+    chosen_watermark: int
+    max_slot: int
+    replies: Set[int]
+    resend: object
+
+
+@dataclasses.dataclass
+class _PushingToAcceptors:
+    chosen_watermark: int
+    max_slot: int
+    quorum: SimpleMajority
+    acks: Set[int]
+    resend: object
+
+
+@dataclasses.dataclass
+class _WaitingForLargerChosenWatermark:
+    chosen_watermark: int
+    max_slot: int
+
+
+@dataclasses.dataclass
+class _GarbageCollecting:
+    gc_watermark: int
+    matchmaker_configuration: MmmMatchmakerConfiguration
+    acks: Set[int]
+    resend: object
+
+
+_GC_DONE = "gc_done"
+_GC_CANCELLED = "gc_cancelled"
+
+
+@dataclasses.dataclass
+class _Phase2:
+    round: int
+    next_slot: int
+    quorum: SimpleMajority
+    values: Dict[int, Tuple[str, Optional[MmmCommand]]]
+    phase2bs: Dict[int, Dict[int, MmmPhase2b]]
+    chosen: Set[int]
+    num_chosen_since_watermark_send: int
+    resend: object
+    gc: object
+
+
+@dataclasses.dataclass
+class _Phase2Matchmaking:
+    phase2: _Phase2
+    matchmaking: _Matchmaking
+
+
+@dataclasses.dataclass
+class _Phase212:
+    old_phase2: _Phase2
+    new_phase1: _Phase1
+    new_phase2: _Phase2
+
+
+@dataclasses.dataclass
+class _Phase22:
+    old_phase2: _Phase2
+    new_phase2: _Phase2
+
+
+@dataclasses.dataclass
+class _Inactive:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MmmLeaderOptions:
+    resend_period: float = 5.0
+    send_chosen_watermark_every_n: int = 100
+    # Each leader owns `stutter` CONSECUTIVE rounds (Leader.scala:516-519):
+    # i/i+1 reconfiguration requires the leader to own round i+1 too.
+    stutter: int = 1000
+    election_options: election.ElectionOptions = election.ElectionOptions()
+
+
+class MmmLeader(Actor):
+    """``matchmakermultipaxos/Leader.scala``."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig,
+                 options: MmmLeaderOptions = MmmLeaderOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round_system = ClassicStutteredRoundRobin(
+            len(config.leader_addresses), options.stutter
+        )
+        self.matchmaker_configuration = initial_matchmaker_configuration(
+            config
+        )
+        self.chosen_watermark = 0
+        self.election = election.Participant(
+            config.leader_election_addresses[self.index],
+            transport, logger, config.leader_election_addresses,
+            initial_leader_index=0,
+            options=options.election_options, seed=seed,
+        )
+        self.election.register(self._on_election)
+        self.state: object = _Inactive(round=-1)
+        if self.index == 0:
+            self.become_leader(
+                self.round_system.next_classic_round(self.index, -1)
+            )
+
+    # -- Timers / small helpers ----------------------------------------------
+
+    def _on_election(self, leader_index: int) -> None:
+        if leader_index == self.index:
+            if isinstance(self.state, _Inactive):
+                self.become_leader(
+                    self.round_system.next_classic_round(
+                        self.index, self._get_round(self.state)
+                    )
+                )
+        else:
+            self.stop_being_leader()
+
+    def _make_resend(self, name: str, fire) -> object:
+        def cb() -> None:
+            fire()
+            timer.start()
+
+        timer = self.timer(name, self.options.resend_period, cb)
+        timer.start()
+        return timer
+
+    def _get_round(self, state) -> int:
+        if isinstance(state, _Inactive):
+            return state.round
+        if isinstance(state, (_Matchmaking, _WaitingForNewMatchmakers,
+                              _Phase1, _Phase2)):
+            return state.round
+        if isinstance(state, _Phase2Matchmaking):
+            return state.matchmaking.round
+        if isinstance(state, _Phase212):
+            return state.new_phase2.round
+        if isinstance(state, _Phase22):
+            return state.new_phase2.round
+        raise AssertionError(state)
+
+    def _pending_requests(self, state) -> List[MmmClientRequest]:
+        if isinstance(state, (_Matchmaking, _WaitingForNewMatchmakers,
+                              _Phase1)):
+            return state.pending_requests
+        return []
+
+    def _stop_gc_timers(self, gc) -> None:
+        if isinstance(gc, (_QueryingReplicas, _PushingToAcceptors,
+                           _GarbageCollecting)):
+            gc.resend.stop()
+
+    def _stop_timers(self, state) -> None:
+        if isinstance(state, (_Matchmaking, _WaitingForNewMatchmakers,
+                              _Phase1)):
+            state.resend.stop()
+        elif isinstance(state, _Phase2):
+            state.resend.stop()
+            self._stop_gc_timers(state.gc)
+        elif isinstance(state, _Phase2Matchmaking):
+            self._stop_timers(state.phase2)
+            self._stop_timers(state.matchmaking)
+        elif isinstance(state, _Phase212):
+            self._stop_timers(state.old_phase2)
+            self._stop_timers(state.new_phase1)
+            self._stop_timers(state.new_phase2)
+        elif isinstance(state, _Phase22):
+            self._stop_timers(state.old_phase2)
+            self._stop_timers(state.new_phase2)
+
+    def _make_phase2(self, round: int, next_slot: int,
+                     quorum: SimpleMajority, gc) -> _Phase2:
+        phase2 = _Phase2(
+            round=round, next_slot=next_slot, quorum=quorum, values={},
+            phase2bs={}, chosen=set(), num_chosen_since_watermark_send=0,
+            resend=None, gc=gc,
+        )
+
+        def fire() -> None:
+            # Resend phase2as for the SMALLEST pending slot only
+            # (Leader.scala:632-678): driving the log's first hole is
+            # what unblocks execution.
+            pending = [s for s in phase2.values if s >= self.chosen_watermark]
+            if pending:
+                slot = min(pending)
+                kind, command = phase2.values[slot]
+                phase2a = MmmPhase2a(
+                    slot=slot, round=phase2.round, kind=kind, command=command
+                )
+                for i in phase2.quorum.nodes():
+                    self.chan(self.config.acceptor_addresses[i]).send(phase2a)
+
+        phase2.resend = self._make_resend(f"resendPhase2as{round}", fire)
+        return phase2
+
+    # -- Matchmaking ----------------------------------------------------------
+
+    def _start_matchmaking(self, round: int,
+                           pending: List[MmmClientRequest],
+                           quorum_members: tuple) -> _Matchmaking:
+        request = MmmMatchRequest(
+            matchmaker_configuration=self.matchmaker_configuration,
+            configuration=MmmConfiguration(
+                round=round, members=quorum_members
+            ),
+        )
+        mc = self.matchmaker_configuration
+
+        def send() -> None:
+            for i in mc.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(request)
+
+        send()
+        return _Matchmaking(
+            round=round,
+            matchmaker_configuration=mc,
+            quorum_members=quorum_members,
+            match_replies={},
+            pending_requests=pending,
+            resend=self._make_resend(f"resendMatchRequests{round}", send),
+        )
+
+    def become_leader(self, new_round: int) -> None:
+        self.logger.check_gt(new_round, self._get_round(self.state))
+        self.logger.check_eq(self.round_system.leader(new_round), self.index)
+        self._stop_timers(self.state)
+        members = tuple(range(2 * self.config.f + 1))
+        self.state = self._start_matchmaking(
+            new_round, self._pending_requests(self.state), members
+        )
+
+    def stop_being_leader(self) -> None:
+        self._stop_timers(self.state)
+        self.state = _Inactive(round=self._get_round(self.state))
+
+    def become_i_i_plus_one_leader(self, members: tuple) -> None:
+        """Reconfigure to a new acceptor set without stalling phase 2
+        (Leader.scala:976-1018)."""
+        state = self.state
+        if isinstance(state, _Phase2) and self.round_system.leader(
+            state.round + 1
+        ) == self.index:
+            matchmaking = self._start_matchmaking(
+                state.round + 1, [], members
+            )
+            self.state = _Phase2Matchmaking(
+                phase2=state, matchmaking=matchmaking
+            )
+        else:
+            self.become_leader(
+                self.round_system.next_classic_round(
+                    self.index, self._get_round(state)
+                )
+            )
+
+    def _process_match_reply(self, matchmaking: _Matchmaking,
+                             msg: MmmMatchReply):
+        """Returns None (keep waiting), a _Phase1, or a _Phase2
+        (Leader.scala:1020-1177)."""
+        if msg.epoch != matchmaking.matchmaker_configuration.epoch:
+            return None
+        if msg.round != matchmaking.round:
+            return None
+        matchmaking.match_replies[msg.matchmaker_index] = msg
+        if len(matchmaking.match_replies) < self.config.quorum_size:
+            return None
+        matchmaking.resend.stop()
+
+        gc_watermark = max(
+            r.gc_watermark for r in matchmaking.match_replies.values()
+        )
+        pending_rounds: Set[int] = set()
+        previous_quorums: Dict[int, SimpleMajority] = {}
+        acceptor_to_rounds: Dict[int, Set[int]] = {}
+        for reply in matchmaking.match_replies.values():
+            for configuration in reply.configurations:
+                if configuration.round < gc_watermark:
+                    continue
+                if configuration.round in pending_rounds:
+                    continue
+                pending_rounds.add(configuration.round)
+                qs = SimpleMajority(set(configuration.members))
+                previous_quorums[configuration.round] = qs
+                for i in qs.nodes():
+                    acceptor_to_rounds.setdefault(i, set()).add(
+                        configuration.round
+                    )
+
+        if not pending_rounds:
+            return self._make_phase2(
+                round=matchmaking.round,
+                next_slot=self.chosen_watermark,
+                quorum=SimpleMajority(set(matchmaking.quorum_members)),
+                gc=_GC_DONE,
+            )
+
+        phase1a = MmmPhase1a(
+            round=matchmaking.round, chosen_watermark=self.chosen_watermark
+        )
+
+        def send() -> None:
+            for i in acceptor_to_rounds:
+                self.chan(self.config.acceptor_addresses[i]).send(phase1a)
+
+        send()
+        return _Phase1(
+            round=matchmaking.round,
+            quorum_members=matchmaking.quorum_members,
+            previous_quorums=previous_quorums,
+            acceptor_to_rounds=acceptor_to_rounds,
+            pending_rounds=pending_rounds,
+            phase1bs={},
+            pending_requests=matchmaking.pending_requests,
+            resend=self._make_resend(
+                f"resendPhase1as{matchmaking.round}", send
+            ),
+        )
+
+    # -- Phase 1 --------------------------------------------------------------
+
+    def _safe_value(self, phase1bs, slot: int):
+        infos = [
+            info
+            for b in phase1bs
+            for info in b.info
+            if info[0] == slot
+        ]
+        if not infos:
+            return (NOOP, None)
+        best = max(infos, key=lambda info: info[1])
+        return (best[2], best[3])
+
+    def _process_phase1b(self, phase1: _Phase1, msg: MmmPhase1b):
+        """Returns None or {slot: value} to propose
+        (Leader.scala:1178-1238)."""
+        if msg.round != phase1.round:
+            return None
+        phase1.phase1bs[msg.acceptor_index] = msg
+        for round in list(phase1.acceptor_to_rounds.get(msg.acceptor_index,
+                                                        ())):
+            if round in phase1.pending_rounds and phase1.previous_quorums[
+                round
+            ].is_superset_of_read_quorum(set(phase1.phase1bs)):
+                phase1.pending_rounds.discard(round)
+        if phase1.pending_rounds:
+            return None
+        phase1.resend.stop()
+
+        max_persisted = max(
+            b.persisted_watermark for b in phase1.phase1bs.values()
+        )
+        self.chosen_watermark = max(self.chosen_watermark, max_persisted)
+        slots = [
+            info[0] for b in phase1.phase1bs.values() for info in b.info
+        ]
+        max_slot = max(slots, default=-1)
+        values = {}
+        for slot in range(self.chosen_watermark, max_slot + 1):
+            values[slot] = self._safe_value(phase1.phase1bs.values(), slot)
+        return values
+
+    def _send_phase2a(self, quorum: SimpleMajority, slot: int, round: int,
+                      value) -> None:
+        kind, command = value
+        phase2a = MmmPhase2a(slot=slot, round=round, kind=kind,
+                             command=command)
+        for i in quorum.nodes():
+            self.chan(self.config.acceptor_addresses[i]).send(phase2a)
+
+    def _start_gc_query(self, chosen_watermark: int,
+                        max_slot: int) -> _QueryingReplicas:
+        def send() -> None:
+            for a in self.config.replica_addresses:
+                self.chan(a).send(MmmExecutedWatermarkRequest())
+
+        send()
+        return _QueryingReplicas(
+            chosen_watermark=chosen_watermark, max_slot=max_slot,
+            replies=set(),
+            resend=self._make_resend("resendExecutedWatermarkRequests", send),
+        )
+
+    # -- Phase 2 --------------------------------------------------------------
+
+    def _process_client_request(self, phase2: _Phase2,
+                                msg: MmmClientRequest) -> None:
+        slot = phase2.next_slot
+        phase2.next_slot += 1
+        value = (COMMAND, msg.command)
+        phase2.values[slot] = value
+        phase2.phase2bs[slot] = {}
+        self._send_phase2a(phase2.quorum, slot, phase2.round, value)
+
+    def _process_phase2b(self, phase2: _Phase2, msg: MmmPhase2b) -> None:
+        """(Leader.scala:1239-1352)"""
+        if msg.round != phase2.round:
+            return
+        if msg.slot < self.chosen_watermark or msg.slot in phase2.chosen:
+            return
+        if not msg.persisted:
+            in_slot = phase2.phase2bs.setdefault(msg.slot, {})
+            in_slot[msg.acceptor_index] = msg
+            if not phase2.quorum.is_superset_of_write_quorum(set(in_slot)):
+                return
+            kind, command = phase2.values[msg.slot]
+            chosen = MmmChosen(slot=msg.slot, kind=kind, command=command)
+            for a in self.config.replica_addresses:
+                self.chan(a).send(chosen)
+        phase2.values.pop(msg.slot, None)
+        phase2.phase2bs.pop(msg.slot, None)
+        phase2.chosen.add(msg.slot)
+        old_watermark = self.chosen_watermark
+        while self.chosen_watermark in phase2.chosen:
+            phase2.chosen.discard(self.chosen_watermark)
+            self.chosen_watermark += 1
+        if old_watermark != self.chosen_watermark:
+            phase2.resend.reset()
+        phase2.num_chosen_since_watermark_send += 1
+        if (
+            phase2.num_chosen_since_watermark_send
+            >= self.options.send_chosen_watermark_every_n
+        ):
+            for a in self.config.leader_addresses:
+                if a != self.address:
+                    self.chan(a).send(
+                        MmmChosenWatermark(watermark=self.chosen_watermark)
+                    )
+            phase2.num_chosen_since_watermark_send = 0
+        # GC: waiting for the watermark to pass maxSlot?
+        gc = phase2.gc
+        if (
+            isinstance(gc, _WaitingForLargerChosenWatermark)
+            and self.chosen_watermark > gc.max_slot
+        ):
+            self._start_garbage_collecting(phase2)
+
+    def _start_garbage_collecting(self, phase2: _Phase2) -> None:
+        mc = self.matchmaker_configuration
+        garbage_collect = MmmGarbageCollect(
+            matchmaker_configuration=mc, gc_watermark=phase2.round
+        )
+
+        def send() -> None:
+            for i in mc.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(
+                    garbage_collect
+                )
+
+        send()
+        phase2.gc = _GarbageCollecting(
+            gc_watermark=phase2.round,
+            matchmaker_configuration=mc,
+            acks=set(),
+            resend=self._make_resend("resendGarbageCollects", send),
+        )
+
+    # -- Handlers -------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmMatchReply):
+            self._handle_match_reply(msg)
+        elif isinstance(msg, MmmPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, MmmClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, MmmPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, MmmLeaderInfoRequest):
+            if not isinstance(self.state, _Inactive):
+                self.chan(src).send(
+                    MmmLeaderInfoReply(round=self._get_round(self.state))
+                )
+        elif isinstance(msg, MmmChosenWatermark):
+            if isinstance(self.state, _Inactive):
+                self.chosen_watermark = max(
+                    self.chosen_watermark, msg.watermark
+                )
+        elif isinstance(msg, MmmMatchmakerNack):
+            self._handle_matchmaker_nack(msg)
+        elif isinstance(msg, MmmAcceptorNack):
+            self._handle_acceptor_nack(msg)
+        elif isinstance(msg, MmmRecover):
+            self._handle_recover(msg)
+        elif isinstance(msg, MmmExecutedWatermarkReply):
+            self._handle_executed_watermark_reply(msg)
+        elif isinstance(msg, MmmPersistedAck):
+            self._handle_persisted_ack(msg)
+        elif isinstance(msg, MmmGarbageCollectAck):
+            self._handle_garbage_collect_ack(msg)
+        elif isinstance(msg, MmmStopped):
+            self._handle_stopped(msg)
+        elif isinstance(msg, MmmMatchChosen):
+            self._handle_match_chosen(msg)
+        elif isinstance(msg, MmmForceReconfiguration):
+            self.become_i_i_plus_one_leader(tuple(msg.acceptor_indices))
+        else:
+            self.logger.fatal(f"unknown mmm leader message {msg!r}")
+
+    def _handle_match_reply(self, msg: MmmMatchReply) -> None:
+        state = self.state
+        if isinstance(state, _Matchmaking):
+            result = self._process_match_reply(state, msg)
+            if result is None:
+                return
+            self.state = result
+            if isinstance(result, _Phase2):
+                for request in state.pending_requests:
+                    self._process_client_request(result, request)
+        elif isinstance(state, _Phase2Matchmaking):
+            result = self._process_match_reply(state.matchmaking, msg)
+            if result is None:
+                return
+            if isinstance(result, _Phase2):
+                # No prior configurations at all is impossible here: round
+                # i's own configuration must come back.
+                self.logger.fatal(
+                    "i/i+1 matchmaking returned no configurations"
+                )
+            # Transition to Phase212: phase 2 of round i keeps going; we
+            # are in phase 1 AND phase 2 of round i+1 simultaneously.
+            self._stop_timers(state.phase2)
+            state.phase2.gc = _GC_CANCELLED
+            new_phase2 = self._make_phase2(
+                round=state.matchmaking.round,
+                next_slot=state.phase2.next_slot,
+                quorum=SimpleMajority(set(state.matchmaking.quorum_members)),
+                gc=_GC_CANCELLED,
+            )
+            self.state = _Phase212(
+                old_phase2=state.phase2,
+                new_phase1=result,
+                new_phase2=new_phase2,
+            )
+
+    def _handle_phase1b(self, msg: MmmPhase1b) -> None:
+        state = self.state
+        if isinstance(state, _Phase1):
+            values = self._process_phase1b(state, msg)
+            if values is None:
+                return
+            max_slot = max(values, default=-1)
+            phase2 = self._make_phase2(
+                round=state.round,
+                next_slot=max(self.chosen_watermark, max_slot + 1),
+                quorum=SimpleMajority(set(state.quorum_members)),
+                gc=self._start_gc_query(self.chosen_watermark, max_slot),
+            )
+            for slot, value in values.items():
+                phase2.values[slot] = value
+                phase2.phase2bs[slot] = {}
+                self._send_phase2a(phase2.quorum, slot, state.round, value)
+            self.state = phase2
+            for request in state.pending_requests:
+                self._process_client_request(phase2, request)
+        elif isinstance(state, _Phase212):
+            values = self._process_phase1b(state.new_phase1, msg)
+            if values is None:
+                return
+            max_slot = max(values, default=-1)
+            new_phase2 = state.new_phase2
+            for slot, value in values.items():
+                new_phase2.values[slot] = value
+                new_phase2.phase2bs[slot] = {}
+                self._send_phase2a(
+                    new_phase2.quorum, slot, new_phase2.round, value
+                )
+            # Fill [max_slot+1, old.next_slot) with noops in round i+1
+            # (Leader.scala:1622-1642).
+            for slot in range(
+                max(max_slot + 1, self.chosen_watermark),
+                state.old_phase2.next_slot,
+            ):
+                if slot in new_phase2.values:
+                    continue
+                new_phase2.values[slot] = (NOOP, None)
+                new_phase2.phase2bs[slot] = {}
+                self._send_phase2a(
+                    new_phase2.quorum, slot, new_phase2.round, (NOOP, None)
+                )
+            new_phase2.next_slot = max(
+                new_phase2.next_slot, state.old_phase2.next_slot
+            )
+            if self.chosen_watermark >= state.old_phase2.next_slot:
+                self._stop_timers(state.old_phase2)
+                new_phase2.gc = self._start_gc_query(
+                    self.chosen_watermark, max_slot
+                )
+                self.state = new_phase2
+            else:
+                self.state = _Phase22(
+                    old_phase2=state.old_phase2, new_phase2=new_phase2
+                )
+
+    def _handle_client_request(self, src: Address,
+                               msg: MmmClientRequest) -> None:
+        state = self.state
+        if isinstance(state, _Inactive):
+            self.chan(src).send(MmmNotLeader())
+        elif isinstance(state, (_Matchmaking, _WaitingForNewMatchmakers,
+                                _Phase1)):
+            state.pending_requests.append(msg)
+        elif isinstance(state, _Phase2):
+            self._process_client_request(state, msg)
+        elif isinstance(state, _Phase2Matchmaking):
+            self._process_client_request(state.phase2, msg)
+        elif isinstance(state, _Phase212):
+            self._process_client_request(state.new_phase2, msg)
+        elif isinstance(state, _Phase22):
+            self._process_client_request(state.new_phase2, msg)
+
+    def _handle_phase2b(self, msg: MmmPhase2b) -> None:
+        state = self.state
+        if isinstance(state, _Phase2):
+            self._process_phase2b(state, msg)
+        elif isinstance(state, _Phase2Matchmaking):
+            self._process_phase2b(state.phase2, msg)
+        elif isinstance(state, _Phase212):
+            if msg.round == state.old_phase2.round:
+                self._process_phase2b(state.old_phase2, msg)
+            elif msg.round == state.new_phase2.round:
+                self._process_phase2b(state.new_phase2, msg)
+        elif isinstance(state, _Phase22):
+            if msg.round == state.old_phase2.round:
+                self._process_phase2b(state.old_phase2, msg)
+            elif msg.round == state.new_phase2.round:
+                self._process_phase2b(state.new_phase2, msg)
+            if self.chosen_watermark >= state.old_phase2.next_slot:
+                self._stop_timers(state.old_phase2)
+                new_phase2 = state.new_phase2
+                new_phase2.gc = self._start_gc_query(
+                    state.old_phase2.next_slot, state.old_phase2.next_slot
+                )
+                self.state = new_phase2
+
+    def _handle_matchmaker_nack(self, msg: MmmMatchmakerNack) -> None:
+        if msg.round < self._get_round(self.state):
+            return
+        state = self.state
+        if isinstance(state, _Inactive):
+            state.round = msg.round
+        elif isinstance(state, (_Matchmaking, _Phase2Matchmaking)):
+            self.become_leader(
+                self.round_system.next_classic_round(self.index, msg.round)
+            )
+
+    def _handle_acceptor_nack(self, msg: MmmAcceptorNack) -> None:
+        state = self.state
+        if isinstance(state, _Inactive):
+            if msg.round > state.round:
+                state.round = msg.round
+            return
+        smaller = (
+            state.phase2.round if isinstance(state, _Phase2Matchmaking)
+            else state.old_phase2.round
+            if isinstance(state, (_Phase212, _Phase22))
+            else state.round
+        )
+        if msg.round < smaller:
+            return
+        if isinstance(state, (_Phase1, _Phase2, _Phase2Matchmaking,
+                              _Phase212, _Phase22)):
+            self.become_leader(
+                self.round_system.next_classic_round(
+                    self.index, max(msg.round, self._get_round(state))
+                )
+            )
+
+    def _handle_recover(self, msg: MmmRecover) -> None:
+        if isinstance(self.state, _Inactive):
+            return
+        # Heavy-handed but rare: lower the watermark and run a full leader
+        # change so the slot gets re-chosen (Leader.scala:2006-2028).
+        if self.chosen_watermark > msg.slot:
+            self.chosen_watermark = msg.slot
+        self.become_leader(
+            self.round_system.next_classic_round(
+                self.index, self._get_round(self.state)
+            )
+        )
+
+    def _handle_executed_watermark_reply(
+        self, msg: MmmExecutedWatermarkReply
+    ) -> None:
+        state = self.state
+        if not isinstance(state, _Phase2):
+            return
+        gc = state.gc
+        if not isinstance(gc, _QueryingReplicas):
+            return
+        if msg.executed_watermark < gc.chosen_watermark:
+            return
+        gc.replies.add(msg.replica_index)
+        if len(gc.replies) < self.config.f + 1:
+            return
+        gc.resend.stop()
+        persisted = MmmPersisted(persisted_watermark=gc.chosen_watermark)
+        quorum = state.quorum
+
+        def send() -> None:
+            for i in quorum.nodes():
+                self.chan(self.config.acceptor_addresses[i]).send(persisted)
+
+        send()
+        state.gc = _PushingToAcceptors(
+            chosen_watermark=gc.chosen_watermark, max_slot=gc.max_slot,
+            quorum=quorum, acks=set(),
+            resend=self._make_resend("resendPersisted", send),
+        )
+
+    def _handle_persisted_ack(self, msg: MmmPersistedAck) -> None:
+        state = self.state
+        if not isinstance(state, _Phase2):
+            return
+        gc = state.gc
+        if not isinstance(gc, _PushingToAcceptors):
+            return
+        if msg.persisted_watermark < gc.chosen_watermark:
+            return
+        gc.acks.add(msg.acceptor_index)
+        if not gc.quorum.is_superset_of_write_quorum(gc.acks):
+            return
+        gc.resend.stop()
+        if self.chosen_watermark <= gc.max_slot:
+            state.gc = _WaitingForLargerChosenWatermark(
+                chosen_watermark=gc.chosen_watermark, max_slot=gc.max_slot
+            )
+            return
+        self._start_garbage_collecting(state)
+
+    def _handle_garbage_collect_ack(self, msg: MmmGarbageCollectAck) -> None:
+        state = self.state
+        if not isinstance(state, _Phase2):
+            return
+        gc = state.gc
+        if not isinstance(gc, _GarbageCollecting):
+            return
+        if msg.epoch != gc.matchmaker_configuration.epoch:
+            return
+        if msg.gc_watermark < gc.gc_watermark:
+            return
+        gc.acks.add(msg.matchmaker_index)
+        if len(gc.acks) < self.config.f + 1:
+            return
+        gc.resend.stop()
+        state.gc = _GC_DONE
+
+    def _handle_stopped(self, msg: MmmStopped) -> None:
+        state = self.state
+        if isinstance(state, _Phase2Matchmaking):
+            # Give up and retry the whole round (Leader.scala:2237-2239).
+            self.become_leader(
+                self.round_system.next_classic_round(
+                    self.index, self._get_round(state)
+                )
+            )
+        elif isinstance(state, _Matchmaking):
+            if msg.epoch != state.matchmaker_configuration.epoch:
+                return
+            state.resend.stop()
+            reconfigure = MmmReconfigure(
+                matchmaker_configuration=state.matchmaker_configuration,
+                new_matchmaker_indices=tuple(
+                    self.rng.sample(
+                        range(len(self.config.matchmaker_addresses)),
+                        2 * self.config.f + 1,
+                    )
+                ),
+            )
+            reconfigurer = self.config.reconfigurer_addresses[
+                self.rng.randrange(len(self.config.reconfigurer_addresses))
+            ]
+
+            def send() -> None:
+                self.chan(reconfigurer).send(reconfigure)
+
+            send()
+            self.state = _WaitingForNewMatchmakers(
+                round=state.round,
+                matchmaker_configuration=state.matchmaker_configuration,
+                quorum_members=state.quorum_members,
+                pending_requests=state.pending_requests,
+                resend=self._make_resend("resendReconfigure", send),
+            )
+        elif isinstance(state, _Phase2):
+            if isinstance(state.gc, _GarbageCollecting):
+                if msg.epoch != state.gc.matchmaker_configuration.epoch:
+                    return
+                state.gc.resend.stop()
+                state.gc = _GC_CANCELLED
+
+    def _handle_match_chosen(self, msg: MmmMatchChosen) -> None:
+        if msg.value.epoch <= self.matchmaker_configuration.epoch:
+            return
+        self.matchmaker_configuration = msg.value
+        state = self.state
+        if isinstance(state, _Matchmaking):
+            state.resend.stop()
+            self.state = self._start_matchmaking(
+                state.round, state.pending_requests, state.quorum_members
+            )
+        elif isinstance(state, _WaitingForNewMatchmakers):
+            state.resend.stop()
+            self.state = self._start_matchmaking(
+                state.round, state.pending_requests, state.quorum_members
+            )
+
+
+# -- Matchmaker ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _MmPending:
+    logs: Dict[int, Tuple[int, Dict[int, MmmConfiguration]]]
+
+
+@dataclasses.dataclass
+class _MmNormal:
+    gc_watermark: int
+    configurations: Dict[int, MmmConfiguration]
+
+
+@dataclasses.dataclass
+class _MmHasStopped:
+    gc_watermark: int
+    configurations: Dict[int, MmmConfiguration]
+
+
+@dataclasses.dataclass
+class _MmAcceptorState:
+    round: int
+    vote_round: int
+    vote_value: Optional[MmmMatchmakerConfiguration]
+
+
+class MmmMatchmaker(Actor):
+    """``matchmakermultipaxos/Matchmaker.scala``: one PHYSICAL matchmaker
+    plays a logical matchmaker in many epochs; per epoch it is Pending →
+    Normal → HasStopped, and doubles as an acceptor for choosing the next
+    epoch's MatchmakerConfiguration."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.matchmaker_addresses)
+        self.config = config
+        self.index = config.matchmaker_addresses.index(address)
+        self.states: Dict[int, object] = {}
+        self.acceptor_states: Dict[int, _MmAcceptorState] = {}
+        if self.index < 2 * config.f + 1:
+            self.states[0] = _MmNormal(gc_watermark=0, configurations={})
+            self.acceptor_states[0] = _MmAcceptorState(-1, -1, None)
+
+    def _to_stopped(self, epoch: int, reconfigurer_index: int) -> _MmHasStopped:
+        state = self.states[epoch]
+        if isinstance(state, _MmPending):
+            gc_watermark, configurations = state.logs[reconfigurer_index]
+            stopped = _MmHasStopped(gc_watermark, dict(configurations))
+        elif isinstance(state, _MmNormal):
+            stopped = _MmHasStopped(state.gc_watermark, state.configurations)
+        else:
+            return state
+        self.states[epoch] = stopped
+        return stopped
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmMatchRequest):
+            self._handle_match_request(src, msg)
+        elif isinstance(msg, MmmGarbageCollect):
+            self._handle_garbage_collect(src, msg)
+        elif isinstance(msg, MmmStop):
+            self._handle_stop(src, msg)
+        elif isinstance(msg, MmmBootstrap):
+            self._handle_bootstrap(src, msg)
+        elif isinstance(msg, MmmMatchPhase1a):
+            self._handle_match_phase1a(src, msg)
+        elif isinstance(msg, MmmMatchPhase2a):
+            self._handle_match_phase2a(src, msg)
+        elif isinstance(msg, MmmMatchChosen):
+            self._handle_match_chosen(msg)
+        else:
+            self.logger.fatal(f"unknown matchmaker message {msg!r}")
+
+    def _normal_or_stopped(self, src, configuration):
+        """Resolve the state for an epoch, promoting Pending; replies
+        Stopped and returns None if the epoch has stopped."""
+        epoch = configuration.epoch
+        self.logger.check(epoch in self.states)
+        state = self.states[epoch]
+        if isinstance(state, _MmPending):
+            gc_watermark, configurations = state.logs[
+                configuration.reconfigurer_index
+            ]
+            state = _MmNormal(gc_watermark, dict(configurations))
+            self.states[epoch] = state
+        if isinstance(state, _MmHasStopped):
+            self.chan(src).send(MmmStopped(epoch=epoch))
+            return None
+        return state
+
+    def _handle_match_request(self, src, msg: MmmMatchRequest) -> None:
+        normal = self._normal_or_stopped(src, msg.matchmaker_configuration)
+        if normal is None:
+            return
+        round = msg.configuration.round
+        if round < normal.gc_watermark:
+            self.chan(src).send(
+                MmmMatchmakerNack(round=normal.gc_watermark - 1)
+            )
+            return
+        if normal.configurations and round <= max(normal.configurations):
+            self.chan(src).send(
+                MmmMatchmakerNack(round=max(normal.configurations))
+            )
+            return
+        self.chan(src).send(
+            MmmMatchReply(
+                epoch=msg.matchmaker_configuration.epoch,
+                round=round,
+                matchmaker_index=self.index,
+                gc_watermark=normal.gc_watermark,
+                configurations=tuple(
+                    normal.configurations[r]
+                    for r in sorted(normal.configurations)
+                    if r < round
+                ),
+            )
+        )
+        normal.configurations[round] = msg.configuration
+
+    def _handle_garbage_collect(self, src, msg: MmmGarbageCollect) -> None:
+        if msg.matchmaker_configuration.epoch not in self.states:
+            return
+        normal = self._normal_or_stopped(src, msg.matchmaker_configuration)
+        if normal is None:
+            return
+        gc_watermark = max(normal.gc_watermark, msg.gc_watermark)
+        self.chan(src).send(
+            MmmGarbageCollectAck(
+                epoch=msg.matchmaker_configuration.epoch,
+                matchmaker_index=self.index,
+                gc_watermark=gc_watermark,
+            )
+        )
+        normal.gc_watermark = gc_watermark
+        for round in [r for r in normal.configurations if r < gc_watermark]:
+            del normal.configurations[round]
+
+    def _handle_stop(self, src, msg: MmmStop) -> None:
+        epoch = msg.matchmaker_configuration.epoch
+        self.logger.check(epoch in self.states)
+        stopped = self._to_stopped(
+            epoch, msg.matchmaker_configuration.reconfigurer_index
+        )
+        self.chan(src).send(
+            MmmStopAck(
+                epoch=epoch,
+                matchmaker_index=self.index,
+                gc_watermark=stopped.gc_watermark,
+                configurations=tuple(
+                    stopped.configurations[r]
+                    for r in sorted(stopped.configurations)
+                ),
+            )
+        )
+
+    def _handle_bootstrap(self, src, msg: MmmBootstrap) -> None:
+        state = self.states.get(msg.epoch)
+        log = (
+            msg.gc_watermark,
+            {c.round: c for c in msg.configurations},
+        )
+        if state is None:
+            self.states[msg.epoch] = _MmPending(
+                logs={msg.reconfigurer_index: log}
+            )
+            self.acceptor_states[msg.epoch] = _MmAcceptorState(-1, -1, None)
+        elif isinstance(state, _MmPending):
+            state.logs[msg.reconfigurer_index] = log
+        self.chan(src).send(
+            MmmBootstrapAck(epoch=msg.epoch, matchmaker_index=self.index)
+        )
+
+    def _handle_match_phase1a(self, src, msg: MmmMatchPhase1a) -> None:
+        epoch = msg.matchmaker_configuration.epoch
+        self.logger.check(epoch in self.states)
+        self._to_stopped(epoch, msg.matchmaker_configuration.reconfigurer_index)
+        acceptor = self.acceptor_states[epoch]
+        if msg.round < acceptor.round:
+            self.chan(src).send(MmmMatchNack(epoch=epoch,
+                                             round=acceptor.round))
+            return
+        acceptor.round = msg.round
+        self.chan(src).send(
+            MmmMatchPhase1b(
+                epoch=epoch, round=msg.round, matchmaker_index=self.index,
+                vote_round=acceptor.vote_round,
+                vote_value=acceptor.vote_value,
+            )
+        )
+
+    def _handle_match_phase2a(self, src, msg: MmmMatchPhase2a) -> None:
+        epoch = msg.matchmaker_configuration.epoch
+        self.logger.check(epoch in self.states)
+        self._to_stopped(epoch, msg.matchmaker_configuration.reconfigurer_index)
+        acceptor = self.acceptor_states[epoch]
+        if msg.round < acceptor.round:
+            self.chan(src).send(MmmMatchNack(epoch=epoch,
+                                             round=acceptor.round))
+            return
+        acceptor.round = msg.round
+        acceptor.vote_round = msg.round
+        acceptor.vote_value = msg.value
+        self.chan(src).send(
+            MmmMatchPhase2b(
+                epoch=epoch, round=msg.round, matchmaker_index=self.index
+            )
+        )
+
+    def _handle_match_chosen(self, msg: MmmMatchChosen) -> None:
+        epoch = msg.value.epoch
+        state = self.states.get(epoch)
+        if isinstance(state, _MmPending):
+            gc_watermark, configurations = state.logs[
+                msg.value.reconfigurer_index
+            ]
+            self.states[epoch] = _MmNormal(gc_watermark, dict(configurations))
+
+
+# -- Reconfigurer -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _RcIdle:
+    configuration: MmmMatchmakerConfiguration
+
+
+@dataclasses.dataclass
+class _RcStopping:
+    configuration: MmmMatchmakerConfiguration
+    new_configuration: MmmMatchmakerConfiguration
+    stop_acks: Dict[int, MmmStopAck]
+    resend: object
+
+
+@dataclasses.dataclass
+class _RcBootstrapping:
+    configuration: MmmMatchmakerConfiguration
+    new_configuration: MmmMatchmakerConfiguration
+    bootstrap_acks: Dict[int, MmmBootstrapAck]
+    resend: object
+
+
+@dataclasses.dataclass
+class _RcPhase1:
+    configuration: MmmMatchmakerConfiguration
+    new_configuration: MmmMatchmakerConfiguration
+    round: int
+    phase1bs: Dict[int, MmmMatchPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _RcPhase2:
+    configuration: MmmMatchmakerConfiguration
+    new_configuration: MmmMatchmakerConfiguration
+    round: int
+    phase2bs: Dict[int, MmmMatchPhase2b]
+    resend: object
+
+
+class MmmReconfigurer(Actor):
+    """``matchmakermultipaxos/Reconfigurer.scala``: stop the old epoch's
+    matchmakers, bootstrap the new ones with the merged configuration
+    log, then run a Paxos round over the OLD epoch to choose the new
+    MatchmakerConfiguration."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig,
+                 resend_period: float = 5.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.reconfigurer_addresses)
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.index = config.reconfigurer_addresses.index(address)
+        self.round_system = ClassicRoundRobin(
+            len(config.reconfigurer_addresses)
+        )
+        self.state: object = _RcIdle(
+            configuration=initial_matchmaker_configuration(config)
+        )
+
+    def _make_resend(self, name, fire):
+        def cb() -> None:
+            fire()
+            timer.start()
+
+        timer = self.timer(name, self.resend_period, cb)
+        timer.start()
+        return timer
+
+    def _start_stopping(self, configuration, new_indices: tuple) -> None:
+        stop = MmmStop(matchmaker_configuration=configuration)
+
+        def send() -> None:
+            for i in configuration.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(stop)
+
+        send()
+        self.state = _RcStopping(
+            configuration=configuration,
+            new_configuration=MmmMatchmakerConfiguration(
+                epoch=configuration.epoch + 1,
+                reconfigurer_index=self.index,
+                matchmaker_indices=new_indices,
+            ),
+            stop_acks={},
+            resend=self._make_resend("resendStops", send),
+        )
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmReconfigure):
+            self._handle_reconfigure(src, msg)
+        elif isinstance(msg, MmmStopAck):
+            self._handle_stop_ack(msg)
+        elif isinstance(msg, MmmBootstrapAck):
+            self._handle_bootstrap_ack(msg)
+        elif isinstance(msg, MmmMatchPhase1b):
+            self._handle_match_phase1b(msg)
+        elif isinstance(msg, MmmMatchPhase2b):
+            self._handle_match_phase2b(msg)
+        elif isinstance(msg, MmmMatchChosen):
+            self._handle_match_chosen(msg)
+        elif isinstance(msg, MmmMatchNack):
+            self._handle_match_nack(msg)
+        elif isinstance(msg, MmmForceMatchmakerReconfiguration):
+            if isinstance(self.state, _RcIdle):
+                self._start_stopping(
+                    self.state.configuration, tuple(msg.matchmaker_indices)
+                )
+        else:
+            self.logger.fatal(f"unknown reconfigurer message {msg!r}")
+
+    def _handle_reconfigure(self, src, msg: MmmReconfigure) -> None:
+        state = self.state
+        if not isinstance(state, _RcIdle):
+            return
+        if msg.matchmaker_configuration.epoch < state.configuration.epoch:
+            # Stale: tell the leader about the newer configuration.
+            self.chan(src).send(MmmMatchChosen(value=state.configuration))
+            return
+        self._start_stopping(
+            msg.matchmaker_configuration, tuple(msg.new_matchmaker_indices)
+        )
+
+    def _handle_stop_ack(self, msg: MmmStopAck) -> None:
+        state = self.state
+        if not isinstance(state, _RcStopping):
+            return
+        if msg.epoch != state.configuration.epoch:
+            return
+        state.stop_acks[msg.matchmaker_index] = msg
+        if len(state.stop_acks) < self.config.f + 1:
+            return
+        state.resend.stop()
+        gc_watermark = max(a.gc_watermark for a in state.stop_acks.values())
+        merged: Dict[int, MmmConfiguration] = {}
+        for ack in state.stop_acks.values():
+            for configuration in ack.configurations:
+                if configuration.round >= gc_watermark:
+                    merged[configuration.round] = configuration
+        bootstrap = MmmBootstrap(
+            epoch=state.new_configuration.epoch,
+            reconfigurer_index=self.index,
+            gc_watermark=gc_watermark,
+            configurations=tuple(
+                merged[r] for r in sorted(merged)
+            ),
+        )
+        new_configuration = state.new_configuration
+
+        def send() -> None:
+            for i in new_configuration.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(bootstrap)
+
+        send()
+        self.state = _RcBootstrapping(
+            configuration=state.configuration,
+            new_configuration=new_configuration,
+            bootstrap_acks={},
+            resend=self._make_resend("resendBootstraps", send),
+        )
+
+    def _handle_bootstrap_ack(self, msg: MmmBootstrapAck) -> None:
+        state = self.state
+        if not isinstance(state, _RcBootstrapping):
+            return
+        if msg.epoch != state.new_configuration.epoch:
+            return
+        state.bootstrap_acks[msg.matchmaker_index] = msg
+        # ALL new matchmakers must be bootstrapped before the epoch can be
+        # chosen (Reconfigurer.scala:497-500).
+        if len(state.bootstrap_acks) < 2 * self.config.f + 1:
+            return
+        state.resend.stop()
+        self._start_phase1(
+            state.configuration, state.new_configuration,
+            self.round_system.next_classic_round(self.index, -1),
+        )
+
+    def _start_phase1(self, configuration, new_configuration,
+                      round: int) -> None:
+        phase1a = MmmMatchPhase1a(
+            matchmaker_configuration=configuration, round=round
+        )
+
+        def send() -> None:
+            for i in configuration.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(phase1a)
+
+        send()
+        self.state = _RcPhase1(
+            configuration=configuration,
+            new_configuration=new_configuration,
+            round=round, phase1bs={},
+            resend=self._make_resend("resendMatchPhase1as", send),
+        )
+
+    def _handle_match_phase1b(self, msg: MmmMatchPhase1b) -> None:
+        state = self.state
+        if not isinstance(state, _RcPhase1):
+            return
+        if msg.epoch != state.configuration.epoch:
+            return
+        if msg.round != state.round:
+            return
+        state.phase1bs[msg.matchmaker_index] = msg
+        if len(state.phase1bs) < self.config.f + 1:
+            return
+        state.resend.stop()
+        votes = [
+            b for b in state.phase1bs.values() if b.vote_round >= 0
+        ]
+        if votes:
+            value = max(votes, key=lambda b: b.vote_round).vote_value
+        else:
+            value = state.new_configuration
+        phase2a = MmmMatchPhase2a(
+            matchmaker_configuration=state.configuration,
+            round=state.round, value=value,
+        )
+        configuration = state.configuration
+
+        def send() -> None:
+            for i in configuration.matchmaker_indices:
+                self.chan(self.config.matchmaker_addresses[i]).send(phase2a)
+
+        send()
+        self.state = _RcPhase2(
+            configuration=configuration,
+            new_configuration=value,
+            round=state.round, phase2bs={},
+            resend=self._make_resend("resendMatchPhase2as", send),
+        )
+
+    def _handle_match_phase2b(self, msg: MmmMatchPhase2b) -> None:
+        state = self.state
+        if not isinstance(state, _RcPhase2):
+            return
+        if msg.epoch != state.configuration.epoch:
+            return
+        if msg.round != state.round:
+            return
+        state.phase2bs[msg.matchmaker_index] = msg
+        if len(state.phase2bs) < self.config.f + 1:
+            return
+        state.resend.stop()
+        chosen = MmmMatchChosen(value=state.new_configuration)
+        for a in self.config.leader_addresses:
+            self.chan(a).send(chosen)
+        for a in self.config.reconfigurer_addresses:
+            if a != self.address:
+                self.chan(a).send(chosen)
+        for i in state.new_configuration.matchmaker_indices:
+            self.chan(self.config.matchmaker_addresses[i]).send(chosen)
+        self.state = _RcIdle(configuration=state.new_configuration)
+
+    def _handle_match_chosen(self, msg: MmmMatchChosen) -> None:
+        state = self.state
+        epoch = state.configuration.epoch
+        if msg.value.epoch <= epoch:
+            return
+        if isinstance(state, (_RcStopping, _RcBootstrapping, _RcPhase1,
+                              _RcPhase2)):
+            state.resend.stop()
+        self.state = _RcIdle(configuration=msg.value)
+
+    def _handle_match_nack(self, msg: MmmMatchNack) -> None:
+        state = self.state
+        if not isinstance(state, (_RcPhase1, _RcPhase2)):
+            return
+        if msg.epoch != state.configuration.epoch or msg.round <= state.round:
+            return
+        state.resend.stop()
+        self._start_phase1(
+            state.configuration, state.new_configuration,
+            self.round_system.next_classic_round(self.index, msg.round),
+        )
+
+
+# -- Acceptor -----------------------------------------------------------------
+
+
+class MmmAcceptor(Actor):
+    """``matchmakermultipaxos/Acceptor.scala``: per-slot votes with a
+    persisted watermark — slots below it answer phase 2 with
+    persisted=true and are garbage collected."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = -1
+        self.persisted_watermark = 0
+        # slot -> (vote_round, kind, command)
+        self.states: Dict[int, Tuple[int, str, Optional[MmmCommand]]] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmPhase1a):
+            self._handle_phase1a(src, msg)
+        elif isinstance(msg, MmmPhase2a):
+            self._handle_phase2a(src, msg)
+        elif isinstance(msg, MmmPersisted):
+            self.persisted_watermark = max(
+                self.persisted_watermark, msg.persisted_watermark
+            )
+            for slot in [
+                s for s in self.states if s < self.persisted_watermark
+            ]:
+                del self.states[slot]
+            self.chan(src).send(
+                MmmPersistedAck(
+                    acceptor_index=self.index,
+                    persisted_watermark=self.persisted_watermark,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown mmm acceptor message {msg!r}")
+
+    def _handle_phase1a(self, src, msg: MmmPhase1a) -> None:
+        if msg.round < self.round:
+            self.chan(src).send(MmmAcceptorNack(round=self.round))
+            return
+        self.round = msg.round
+        info = []
+        start = max(self.persisted_watermark, msg.chosen_watermark)
+        for slot in sorted(self.states):
+            if slot < start:
+                continue
+            vote_round, kind, command = self.states[slot]
+            # Subtle i/i+1 case: don't return votes cast in the CURRENT
+            # round — the leader already proposed those safely
+            # (Acceptor.scala:225-236).
+            if vote_round < self.round:
+                info.append((slot, vote_round, kind, command))
+        self.chan(src).send(
+            MmmPhase1b(
+                round=self.round, acceptor_index=self.index,
+                persisted_watermark=self.persisted_watermark,
+                info=tuple(info),
+            )
+        )
+
+    def _handle_phase2a(self, src, msg: MmmPhase2a) -> None:
+        if msg.slot < self.persisted_watermark:
+            self.chan(src).send(
+                MmmPhase2b(slot=msg.slot, round=msg.round,
+                           acceptor_index=self.index, persisted=True)
+            )
+            return
+        if msg.round < self.round:
+            self.chan(src).send(MmmAcceptorNack(round=self.round))
+            return
+        self.round = msg.round
+        self.states[msg.slot] = (msg.round, msg.kind, msg.command)
+        self.chan(src).send(
+            MmmPhase2b(slot=msg.slot, round=msg.round,
+                       acceptor_index=self.index, persisted=False)
+        )
+
+
+# -- Replica ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MmmReplicaOptions:
+    log_grow_size: int = 5000
+    recover_min_period: float = 10.0
+    recover_max_period: float = 20.0
+    unsafe_dont_recover: bool = False
+
+
+class MmmReplica(Actor):
+    """``matchmakermultipaxos/Replica.scala``: executes the chosen log in
+    prefix order, answers ExecutedWatermarkRequests (the GC pipeline's
+    first stage), and recovers holes via other replicas then leaders."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig,
+                 state_machine: StateMachine,
+                 options: MmmReplicaOptions = MmmReplicaOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.replica_addresses.index(address)
+        self.log: BufferMap = BufferMap(options.log_grow_size)
+        self.executed_watermark = 0
+        self.num_chosen = 0
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+
+        def recover() -> None:
+            recover_msg = MmmRecover(slot=self.executed_watermark)
+            for a in self.config.replica_addresses:
+                if a != self.address:
+                    self.chan(a).send(recover_msg)
+            for a in self.config.leader_addresses:
+                self.chan(a).send(recover_msg)
+            self.recover_timer.start()
+
+        self.recover_timer = self.timer(
+            "recover",
+            random_duration(self.rng, options.recover_min_period,
+                            options.recover_max_period),
+            recover,
+        )
+
+    def _execute_command(self, slot: int, command: MmmCommand) -> None:
+        cid = command.command_id
+        identity = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(identity)
+        client = self.transport.address_from_bytes(cid.client_address)
+        if cached is not None:
+            if cid.client_id < cached[0]:
+                return
+            if cid.client_id == cached[0]:
+                self.chan(client).send(
+                    MmmClientReply(command_id=cid, result=cached[1])
+                )
+                return
+        result = self.state_machine.run(command.command)
+        self.client_table[identity] = (cid.client_id, result)
+        if slot % len(self.config.replica_addresses) == self.index:
+            self.chan(client).send(
+                MmmClientReply(command_id=cid, result=result)
+            )
+
+    def _execute_log(self) -> None:
+        while True:
+            entry = self.log.get(self.executed_watermark)
+            if entry is None:
+                return
+            kind, command = entry
+            if kind == COMMAND:
+                self._execute_command(self.executed_watermark, command)
+            self.executed_watermark += 1
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmChosen):
+            self._handle_chosen(msg)
+        elif isinstance(msg, MmmRecover):
+            entry = self.log.get(msg.slot)
+            if entry is not None:
+                self.chan(src).send(
+                    MmmChosen(slot=msg.slot, kind=entry[0], command=entry[1])
+                )
+        elif isinstance(msg, MmmExecutedWatermarkRequest):
+            self.chan(src).send(
+                MmmExecutedWatermarkReply(
+                    replica_index=self.index,
+                    executed_watermark=self.executed_watermark,
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown mmm replica message {msg!r}")
+
+    def _handle_chosen(self, msg: MmmChosen) -> None:
+        was_running = self.num_chosen != self.executed_watermark
+        old_watermark = self.executed_watermark
+        if self.log.get(msg.slot) is not None:
+            return
+        self.log.put(msg.slot, (msg.kind, msg.command))
+        self.num_chosen += 1
+        self._execute_log()
+        if self.options.unsafe_dont_recover:
+            return
+        should_run = self.num_chosen != self.executed_watermark
+        moved = old_watermark != self.executed_watermark
+        if was_running:
+            if should_run and moved:
+                self.recover_timer.reset()
+            elif not should_run:
+                self.recover_timer.stop()
+        elif should_run:
+            self.recover_timer.start()
+
+
+# -- Client -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _MmmPending:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class MmmClient(Actor):
+    """``matchmakermultipaxos/Client.scala``: tracks the leader's round;
+    NotLeader triggers LeaderInfoRequests to every leader."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig,
+                 resend_period: float = 10.0, stutter: int = 1000,
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        # The SAME stuttered round system as the leaders (Client.scala:
+        # 107-109) — a plain round-robin would compute the wrong leader
+        # for every round inside a stutter run.
+        self.round_system = ClassicStutteredRoundRobin(
+            len(config.leader_addresses), stutter
+        )
+        self.round = 0
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _MmmPending] = {}
+
+    def _leader_chan(self):
+        return self.chan(
+            self.config.leader_addresses[
+                self.round_system.leader(self.round)
+            ]
+        )
+
+    def _request(self, pseudonym: int, pending: _MmmPending):
+        return MmmClientRequest(
+            command=MmmCommand(
+                command_id=MmmCommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=pending.id,
+                ),
+                command=pending.command,
+            )
+        )
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+
+        def resend() -> None:
+            pending = self.pending.get(pseudonym)
+            if pending is not None:
+                # Broadcast to every leader: our round guess may be stale.
+                request = self._request(pseudonym, pending)
+                for a in self.config.leader_addresses:
+                    self.chan(a).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendMmm{pseudonym}", self.resend_period, resend)
+        timer.start()
+        pending = _MmmPending(
+            id=id, command=command, result=promise, resend=timer
+        )
+        self.pending[pseudonym] = pending
+        self._leader_chan().send(self._request(pseudonym, pending))
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, MmmClientReply):
+            pending = self.pending.get(msg.command_id.client_pseudonym)
+            if pending is None or msg.command_id.client_id != pending.id:
+                return
+            pending.resend.stop()
+            del self.pending[msg.command_id.client_pseudonym]
+            pending.result.success(msg.result)
+        elif isinstance(msg, MmmNotLeader):
+            request = MmmLeaderInfoRequest()
+            for a in self.config.leader_addresses:
+                self.chan(a).send(request)
+        elif isinstance(msg, MmmLeaderInfoReply):
+            if msg.round > self.round:
+                self.round = msg.round
+                for pseudonym, pending in self.pending.items():
+                    self._leader_chan().send(
+                        self._request(pseudonym, pending)
+                    )
+        else:
+            self.logger.fatal(f"unknown mmm client message {msg!r}")
+
+
+# -- Driver -------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DoNothing:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RepeatedLeaderReconfiguration:
+    period: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerReconfigurationWorkload:
+    period: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderFailure:
+    failure_delay: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Chaos:
+    period: float = 1.0
+
+
+class MmmDriver(Actor):
+    """``matchmakermultipaxos/Driver.scala``: an ACTOR that injects
+    failures and reconfigurations on a schedule. Sim tests fire its
+    timers deterministically; real deployments let them run."""
+
+    def __init__(self, address, transport, logger,
+                 config: MatchmakerMultiPaxosConfig, workload,
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.workload = workload
+        self.rng = random.Random(seed)
+        self.num_acceptors = len(config.acceptor_addresses)
+        self.num_matchmakers = len(config.matchmaker_addresses)
+
+        def reconfigure() -> None:
+            self.force_reconfiguration()
+            self.reconfigure_timer.start()
+
+        def matchmaker_reconfigure() -> None:
+            self.force_matchmaker_reconfiguration()
+            self.matchmaker_reconfigure_timer.start()
+
+        def fail_leader() -> None:
+            self.force_leader_change()
+
+        self.reconfigure_timer = self.timer(
+            "driverReconfigure", getattr(workload, "period", 1.0), reconfigure
+        )
+        self.matchmaker_reconfigure_timer = self.timer(
+            "driverMatchmakerReconfigure", getattr(workload, "period", 1.0),
+            matchmaker_reconfigure,
+        )
+        self.leader_failure_timer = self.timer(
+            "driverLeaderFailure",
+            getattr(workload, "failure_delay", 5.0), fail_leader,
+        )
+        if isinstance(workload, (RepeatedLeaderReconfiguration, Chaos)):
+            self.reconfigure_timer.start()
+        if isinstance(workload, (MatchmakerReconfigurationWorkload, Chaos)):
+            self.matchmaker_reconfigure_timer.start()
+        if isinstance(workload, (LeaderFailure, Chaos)):
+            self.leader_failure_timer.start()
+
+    def receive(self, src: Address, msg) -> None:
+        self.logger.fatal("the driver does not receive messages")
+
+    def force_reconfiguration(self, members: Optional[tuple] = None,
+                              leader_index: int = 0) -> None:
+        # One SPECIFIC leader (Driver.scala reconfigure(leader, ...)):
+        # broadcasting would make inactive leaders grab leadership.
+        if members is None:
+            members = tuple(
+                self.rng.sample(range(self.num_acceptors),
+                                2 * self.config.f + 1)
+            )
+        self.chan(self.config.leader_addresses[leader_index]).send(
+            MmmForceReconfiguration(acceptor_indices=members)
+        )
+
+    def force_matchmaker_reconfiguration(
+        self, members: Optional[tuple] = None
+    ) -> None:
+        if members is None:
+            members = tuple(
+                self.rng.sample(range(self.num_matchmakers),
+                                2 * self.config.f + 1)
+            )
+        reconfigurer = self.config.reconfigurer_addresses[
+            self.rng.randrange(len(self.config.reconfigurer_addresses))
+        ]
+        self.chan(reconfigurer).send(
+            MmmForceMatchmakerReconfiguration(matchmaker_indices=members)
+        )
+
+    def force_leader_change(self, leader_index: Optional[int] = None) -> None:
+        if leader_index is None:
+            leader_index = self.rng.randrange(
+                len(self.config.leader_election_addresses)
+            )
+        self.chan(
+            self.config.leader_election_addresses[leader_index]
+        ).send(election.ForceNoPing())

@@ -1,0 +1,411 @@
+"""Matchmaker MultiPaxos sim tests: normal-case MultiPaxos, i/i+1
+acceptor reconfiguration, matchmaker reconfiguration via reconfigurers,
+the GC pipeline, driver-injected chaos, and randomized safety."""
+
+import dataclasses
+import random
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import matchmakermultipaxos as mmm
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+class Cluster:
+    def __init__(self, seed=0, f=1, num_clients=2, num_acceptors=None,
+                 num_matchmakers=None, watermark_every=100):
+        self.transport = SimTransport(FakeLogger(LogLevel.FATAL))
+        t = self.transport
+        n = 2 * f + 1
+        num_acceptors = num_acceptors or n + 1  # spares for reconfiguration
+        num_matchmakers = num_matchmakers or n + 1
+        self.config = mmm.MatchmakerMultiPaxosConfig(
+            f=f,
+            leader_addresses=tuple(
+                SimAddress(f"leader{i}") for i in range(f + 1)
+            ),
+            leader_election_addresses=tuple(
+                SimAddress(f"election{i}") for i in range(f + 1)
+            ),
+            reconfigurer_addresses=tuple(
+                SimAddress(f"reconfigurer{i}") for i in range(f + 1)
+            ),
+            matchmaker_addresses=tuple(
+                SimAddress(f"matchmaker{i}") for i in range(num_matchmakers)
+            ),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(num_acceptors)
+            ),
+            replica_addresses=tuple(
+                SimAddress(f"replica{i}") for i in range(f + 1)
+            ),
+        )
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        options = mmm.MmmLeaderOptions(
+            send_chosen_watermark_every_n=watermark_every
+        )
+        self.leaders = [
+            mmm.MmmLeader(a, t, log(), self.config, options, seed=seed + i)
+            for i, a in enumerate(self.config.leader_addresses)
+        ]
+        self.reconfigurers = [
+            mmm.MmmReconfigurer(a, t, log(), self.config, seed=seed + 10 + i)
+            for i, a in enumerate(self.config.reconfigurer_addresses)
+        ]
+        self.matchmakers = [
+            mmm.MmmMatchmaker(a, t, log(), self.config)
+            for a in self.config.matchmaker_addresses
+        ]
+        self.acceptors = [
+            mmm.MmmAcceptor(a, t, log(), self.config)
+            for a in self.config.acceptor_addresses
+        ]
+        self.replicas = [
+            mmm.MmmReplica(a, t, log(), self.config, ReadableAppendLog(),
+                           seed=seed + 30 + i)
+            for i, a in enumerate(self.config.replica_addresses)
+        ]
+        self.clients = [
+            mmm.MmmClient(SimAddress(f"client{i}"), t, log(), self.config,
+                          seed=seed + 50 + i)
+            for i in range(num_clients)
+        ]
+        self.driver = mmm.MmmDriver(
+            SimAddress("driver"), t, log(), self.config, mmm.DoNothing(),
+            seed=seed + 99,
+        )
+
+    def drain(self, max_steps=300000):
+        steps = 0
+        t = self.transport
+        while t.messages and steps < max_steps:
+            t.deliver_message(t.messages[0])
+            steps += 1
+        assert steps < max_steps
+
+    def pump(self, rounds=8, skip=lambda timer: False):
+        infra = set(self.config.leader_election_addresses)
+        self.drain()
+        for _ in range(rounds):
+            for timer in list(self.transport.running_timers()):
+                if timer.address not in infra and not skip(timer):
+                    self.transport.trigger_timer(timer.address, timer.name())
+            self.drain()
+
+
+def test_mmm_single_command():
+    cluster = Cluster()
+    cluster.drain()  # leader 0's matchmaking + phase 1
+    p = cluster.clients[0].propose(0, b"hello")
+    cluster.drain()
+    assert p.done
+    for r in cluster.replicas:
+        assert r.state_machine.log == [b"hello"]
+
+
+def test_mmm_sequential_commands():
+    cluster = Cluster(seed=3)
+    cluster.drain()
+    for i in range(10):
+        p = cluster.clients[i % 2].propose(i // 2, f"c{i}".encode())
+        cluster.drain()
+        assert p.done, i
+    for r in cluster.replicas:
+        assert r.state_machine.log == [f"c{i}".encode() for i in range(10)]
+
+
+def test_mmm_acceptor_reconfiguration_mid_stream():
+    """ForceReconfiguration mid-stream swaps the acceptor set via the
+    i/i+1 pipeline; commands before, during, and after all commit."""
+    cluster = Cluster(seed=5)
+    cluster.drain()
+    p1 = cluster.clients[0].propose(0, b"before")
+    cluster.drain()
+    assert p1.done
+    old_round = cluster.leaders[0]._get_round(cluster.leaders[0].state)
+    # Swap to acceptors {1, 2, 3} (dropping 0, adding the spare 3).
+    cluster.driver.force_reconfiguration(members=(1, 2, 3))
+    p2 = cluster.clients[1].propose(0, b"during")
+    cluster.pump()
+    assert p2.done
+    leader = cluster.leaders[0]
+    assert isinstance(leader.state, mmm._Phase2)
+    assert leader.state.round == old_round + 1
+    assert leader.state.quorum.nodes() == frozenset({1, 2, 3})
+    p3 = cluster.clients[0].propose(1, b"after")
+    cluster.drain()
+    assert p3.done
+    for r in cluster.replicas:
+        assert r.state_machine.log == [b"before", b"during", b"after"]
+    # The new round's phase 2 must not involve acceptor 0 at all: every
+    # vote it holds is from the old round.
+    assert all(
+        v[0] <= old_round for v in cluster.acceptors[0].states.values()
+    )
+
+
+def test_mmm_repeated_reconfigurations():
+    cluster = Cluster(seed=7)
+    cluster.drain()
+    rng = random.Random(11)
+    for i in range(6):
+        members = tuple(rng.sample(range(4), 3))
+        cluster.driver.force_reconfiguration(members=members)
+        p = cluster.clients[0].propose(0, f"r{i}".encode())
+        cluster.pump(rounds=6)
+        assert p.done, (i, members)
+    for r in cluster.replicas:
+        assert r.state_machine.log == [f"r{i}".encode() for i in range(6)]
+
+
+def test_mmm_matchmaker_reconfiguration():
+    """Reconfigurers stop the old epoch, bootstrap new matchmakers, and
+    choose the new configuration; the leader picks it up and future
+    leader changes matchmake against the NEW epoch."""
+    cluster = Cluster(seed=9)
+    cluster.drain()
+    p1 = cluster.clients[0].propose(0, b"epoch0")
+    cluster.drain()
+    assert p1.done
+    cluster.driver.force_matchmaker_reconfiguration(members=(1, 2, 3))
+    cluster.pump()
+    assert all(
+        leader.matchmaker_configuration.epoch == 1
+        for leader in cluster.leaders
+    )
+    assert cluster.leaders[0].matchmaker_configuration.matchmaker_indices \
+        == (1, 2, 3)
+    # A reconfiguration (requiring fresh matchmaking in epoch 1) works.
+    cluster.driver.force_reconfiguration(members=(0, 1, 2))
+    p2 = cluster.clients[1].propose(0, b"epoch1")
+    cluster.pump()
+    assert p2.done
+    for r in cluster.replicas:
+        assert r.state_machine.log == [b"epoch0", b"epoch1"]
+
+
+def test_mmm_leader_failover_intersects_prior_configs():
+    """Leader 1 takes over after a reconfiguration history: matchmakers
+    report every prior configuration and phase 1 reads a quorum of each,
+    so chosen values survive the failover."""
+    cluster = Cluster(seed=13)
+    cluster.drain()
+    p1 = cluster.clients[0].propose(0, b"one")
+    cluster.drain()
+    assert p1.done
+    cluster.driver.force_reconfiguration(members=(1, 2, 3))
+    cluster.pump()
+    p2 = cluster.clients[0].propose(1, b"two")
+    cluster.drain()
+    assert p2.done
+    # Kill leader 0; leader 1 must matchmake and see BOTH configurations.
+    dead = cluster.config.leader_addresses[0]
+    cluster.transport.partition_actor(dead)
+    cluster.transport.partition_actor(
+        cluster.config.leader_election_addresses[0]
+    )
+    cluster.leaders[1]._on_election(1)
+    cluster.pump(skip=lambda tm: tm.address == dead)
+    p3 = cluster.clients[1].propose(0, b"three")
+    cluster.pump(skip=lambda tm: tm.address == dead)
+    assert p3.done
+    assert cluster.replicas[0].state_machine.log == [b"one", b"two", b"three"]
+
+
+def test_mmm_client_routes_to_stuttered_round_leader():
+    """Regression: leaders own STUTTERED round runs (leader 1 starts at
+    round 1000). After a leadership change the client must map the
+    learned round to the right leader immediately — with a plain
+    round-robin mapping, leader(1000) = 0 and every request would stall
+    on the inactive leader until the 10s resend broadcast."""
+    cluster = Cluster(seed=25)
+    cluster.drain()
+    cluster.leaders[0]._on_election(1)  # leader 0 steps down
+    cluster.leaders[1]._on_election(1)  # leader 1 takes over (round 1000)
+    cluster.pump()
+    assert cluster.leaders[1]._get_round(cluster.leaders[1].state) == 1000
+    # NO timer pumps below: the commit must flow purely through
+    # NotLeader -> LeaderInfoRequest -> LeaderInfoReply rerouting.
+    p = cluster.clients[0].propose(0, b"routed")
+    cluster.drain()
+    assert p.done
+    assert cluster.clients[0].round == 1000
+
+
+def test_mmm_gc_pipeline_persists_and_prunes():
+    """The full GC pipeline: replicas report execution, acceptors learn
+    the persisted watermark (pruning their vote state), and matchmakers
+    drop configurations below the leader's round."""
+    cluster = Cluster(seed=17)
+    cluster.drain()
+    for i in range(5):
+        p = cluster.clients[0].propose(0, f"c{i}".encode())
+        cluster.drain()
+        assert p.done
+    # Reconfigure so a SECOND configuration lands at the matchmakers,
+    # then let the new round's GC pipeline run via timer pumps.
+    cluster.driver.force_reconfiguration(members=(1, 2, 3))
+    cluster.pump(rounds=10)
+    leader = cluster.leaders[0]
+    assert isinstance(leader.state, mmm._Phase2)
+    assert leader.state.gc in (mmm._GC_DONE,) or isinstance(
+        leader.state.gc, mmm._GarbageCollecting
+    ), leader.state.gc
+    cluster.pump(rounds=4)
+    assert leader.state.gc == mmm._GC_DONE
+    # Acceptors in the new quorum pruned persisted slots.
+    assert any(a.persisted_watermark > 0 for a in cluster.acceptors)
+    for a in cluster.acceptors:
+        for slot in a.states:
+            assert slot >= a.persisted_watermark
+    # Matchmakers GC'd configurations below the leader's round.
+    round = leader.state.round
+    for m in cluster.matchmakers:
+        state = m.states.get(0)
+        if isinstance(state, mmm._MmNormal):
+            assert all(r >= state.gc_watermark for r in state.configurations)
+            assert state.gc_watermark == round
+    # And the system still works.
+    p = cluster.clients[1].propose(0, b"post-gc")
+    cluster.drain()
+    assert p.done
+
+
+def test_mmm_driver_chaos_converges():
+    """Chaos: random acceptor + matchmaker reconfigurations interleaved
+    with writes and message loss; after repair everything commits and
+    replicas agree."""
+    cluster = Cluster(seed=19, num_clients=3)
+    cluster.drain()
+    rng = random.Random(23)
+    promises = []
+    for burst in range(5):
+        if burst % 2 == 0:
+            cluster.driver.force_reconfiguration()
+        else:
+            cluster.driver.force_matchmaker_reconfiguration()
+        for i, client in enumerate(cluster.clients):
+            promises.append(client.propose(burst, f"b{burst}c{i}".encode()))
+        steps = 0
+        t = cluster.transport
+        while t.messages and steps < 8000:
+            m = t.messages[0]
+            r = rng.random()
+            if r < 0.05:
+                t.drop_message(m)
+            else:
+                t.deliver_message(m)
+            steps += 1
+    cluster.pump(rounds=40)
+    assert all(p.done for p in promises), (
+        f"{sum(p.done for p in promises)}/{len(promises)}"
+    )
+    logs = {tuple(r.state_machine.log) for r in cluster.replicas}
+    shortest = min(logs, key=len)
+    for log in logs:
+        assert log[: len(shortest)] == shortest
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Reconfigure:
+    members: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchmakerReconfigure:
+    members: tuple
+
+
+class SimulatedMmm(SimulatedSystem):
+    def __init__(self, f=1, reconfigure=True):
+        self.f = f
+        self.reconfigure = reconfigure
+
+    def new_system(self, seed):
+        cluster = Cluster(seed=seed, f=self.f)
+        cluster.drain()
+        return cluster
+
+    def get_state(self, system):
+        return tuple(
+            tuple(r.state_machine.log) for r in system.replicas
+        )
+
+    def generate_command(self, system, rng):
+        ops = []
+        for i, c in enumerate(system.clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (2, Propose(i, pseudonym, f"v{rng.randrange(100)}"))
+                    )
+        if self.reconfigure:
+            n_acc = len(system.config.acceptor_addresses)
+            n_mm = len(system.config.matchmaker_addresses)
+            ops.append((1, Reconfigure(
+                tuple(rng.sample(range(n_acc), 2 * self.f + 1))
+            )))
+            ops.append((1, MatchmakerReconfigure(
+                tuple(rng.sample(range(n_mm), 2 * self.f + 1))
+            )))
+        return mixed_command(rng, system.transport, ops)
+
+    def run_command(self, system, command):
+        if isinstance(command, Propose):
+            system.clients[command.client_index].propose(
+                command.pseudonym, command.value.encode()
+            )
+        elif isinstance(command, Reconfigure):
+            system.driver.force_reconfiguration(members=command.members)
+        elif isinstance(command, MatchmakerReconfigure):
+            system.driver.force_matchmaker_reconfiguration(
+                members=command.members
+            )
+        else:
+            system.transport.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"replica logs diverge: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"replica log rewrote history: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_mmm_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedMmm(f), run_length=150, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_mmm_safety_randomized_no_reconfig():
+    bad = simulate_and_minimize(
+        SimulatedMmm(1, reconfigure=False), run_length=120, num_runs=5,
+        seed=55,
+    )
+    assert bad is None, f"\n{bad}"
