@@ -519,6 +519,50 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_horizontal(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import horizontal as hzx
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = hzx.HorizontalConfig(
+            f=1,
+            leader_addresses=(SimAddress("hzl0"), SimAddress("hzl1")),
+            leader_election_addresses=(
+                SimAddress("hze0"), SimAddress("hze1"),
+            ),
+            acceptor_addresses=tuple(SimAddress(f"hza{i}") for i in range(4)),
+            replica_addresses=(SimAddress("hzr0"), SimAddress("hzr1")),
+        )
+        for i, a in enumerate(config.leader_addresses):
+            hzx.HzLeader(a, t, log(), config, seed=i)
+        for a in config.acceptor_addresses:
+            hzx.HzAcceptor(a, t, log(), config)
+        for i, a in enumerate(config.replica_addresses):
+            hzx.HzReplica(a, t, log(), config, ReadableAppendLog(),
+                          seed=30 + i)
+        _drain(t)  # initial chunk phase 1
+        driver = hzx.HzDriver(SimAddress("hzd"), t, log(), config, seed=99)
+        clients = [
+            hzx.HzClient(SimAddress(f"hzc{i}"), t, log(), config, seed=50 + i)
+            for i in range(2)
+        ]
+        return driver, clients
+
+    def operate(t, ctx):
+        driver, clients = ctx
+        promises = [clients[0].propose(0, b"cmd0")]
+        _drain(t)
+        # An in-log reconfiguration mid-smoke.
+        driver.force_reconfiguration(members=(1, 2, 3))
+        promises.append(clients[1].propose(0, b"cmd1"))
+        return promises
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_matchmakermultipaxos(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -749,6 +793,7 @@ SMOKES = {
     "unanimousbpaxos": smoke_unanimousbpaxos,
     "matchmakerpaxos": smoke_matchmakerpaxos,
     "matchmakermultipaxos": smoke_matchmakermultipaxos,
+    "horizontal": smoke_horizontal,
     "fastmultipaxos": smoke_fastmultipaxos,
     "scalog": smoke_scalog,
     "multipaxos": smoke_multipaxos,

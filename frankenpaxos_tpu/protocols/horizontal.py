@@ -1,0 +1,890 @@
+"""Horizontal MultiPaxos — log-segmented ("horizontal") reconfiguration
+(reference ``horizontal/``; protocol cheatsheet in ``Horizontal.proto``).
+
+The log is divided into CHUNKS, each owned by one acceptor
+configuration. Reconfiguration is just another log value: choosing a
+``Configuration`` at slot s creates a new chunk starting at slot
+s + alpha (the pipeline depth), so at most alpha commands can be in
+flight past the chosen watermark and every slot's owning configuration
+is determined by the log itself (``Leader.scala:216-247, 575-640``).
+The active leader runs phase 1 per chunk and phase 2 into the first
+chunk with vacant slots; a chunk whose last slot is chosen becomes
+defunct and is pruned. Replicas execute commands (skipping noops and
+configurations) and recover holes through other replicas, then leaders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.election import basic as election
+from frankenpaxos_tpu.quorums import SimpleMajority
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, random_duration
+
+COMMAND = "command"
+NOOP = "noop"
+CONFIGURATION = "configuration"
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzCommand:
+    command_id: HzCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzValue:
+    kind: str
+    command: Optional[HzCommand] = None
+    members: Optional[tuple] = None  # CONFIGURATION: SimpleMajority members
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzPhase1a:
+    round: int
+    first_slot: int
+    chosen_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzPhase1b:
+    round: int
+    first_slot: int
+    acceptor_index: int
+    info: tuple  # of (slot, vote_round, HzValue)
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzClientRequest:
+    command: HzCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzPhase2a:
+    slot: int
+    round: int
+    first_slot: int
+    value: HzValue
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzPhase2b:
+    slot: int
+    round: int
+    acceptor_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzChosen:
+    slot: int
+    value: HzValue
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzClientReply:
+    command_id: HzCommandId
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzReconfigure:
+    members: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzNotLeader:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzLeaderInfoRequest:
+    pass
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzLeaderInfoReply:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class HzRecover:
+    slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HorizontalConfig:
+    f: int
+    leader_addresses: tuple
+    leader_election_addresses: tuple
+    acceptor_addresses: tuple  # >= 2f+1 (spares allow reconfiguration)
+    replica_addresses: tuple  # >= f+1
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.leader_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 leaders")
+        if len(self.leader_election_addresses) != len(self.leader_addresses):
+            raise ValueError("one election address per leader")
+        if len(self.acceptor_addresses) < 2 * self.f + 1:
+            raise ValueError("need >= 2f+1 acceptors")
+        if len(self.replica_addresses) < self.f + 1:
+            raise ValueError("need >= f+1 replicas")
+
+
+# -- Leader -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _HzPhase1:
+    phase1bs: Dict[int, HzPhase1b]
+    resend: object
+
+
+@dataclasses.dataclass
+class _HzPhase2:
+    next_slot: Optional[int]  # None = chunk is out of slots
+    values: Dict[int, HzValue]
+    phase2bs: Dict[int, Dict[int, HzPhase2b]]
+    resend: object
+
+
+@dataclasses.dataclass
+class _Chunk:
+    first_slot: int
+    last_slot: Optional[int]
+    quorum: SimpleMajority
+    phase: object
+
+
+@dataclasses.dataclass
+class _HzActive:
+    round: int
+    chunks: List[_Chunk]
+
+
+@dataclasses.dataclass
+class _HzInactive:
+    round: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HzLeaderOptions:
+    # A chosen configuration at slot s takes effect at slot s + alpha; at
+    # most alpha commands may be pending past the chosen watermark
+    # (Leader.scala options).
+    alpha: int = 16
+    resend_phase1as_period: float = 5.0
+    resend_phase2as_period: float = 5.0
+    log_grow_size: int = 5000
+    election_options: election.ElectionOptions = election.ElectionOptions()
+
+
+class HzLeader(Actor):
+    """``horizontal/Leader.scala``."""
+
+    def __init__(self, address, transport, logger, config: HorizontalConfig,
+                 options: HzLeaderOptions = HzLeaderOptions(), seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.leader_addresses)
+        self.config = config
+        self.options = options
+        self.rng = random.Random(seed)
+        self.index = config.leader_addresses.index(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.log: BufferMap = BufferMap(options.log_grow_size)
+        self.chosen_watermark = 0
+        # The first slots of all active chunks; maintained by active AND
+        # inactive leaders (Leader.scala:289-296).
+        self.active_first_slots: List[int] = [0]
+        self.election = election.Participant(
+            config.leader_election_addresses[self.index],
+            transport, logger, config.leader_election_addresses,
+            initial_leader_index=0,
+            options=options.election_options, seed=seed,
+        )
+        self.election.register(self._on_election)
+        if self.index == 0:
+            quorum = SimpleMajority(set(range(2 * config.f + 1)))
+            self.state: object = _HzActive(
+                round=0,
+                chunks=[self._make_chunk(0, 0, quorum)],
+            )
+        else:
+            self.state = _HzInactive(round=-1)
+
+    def _on_election(self, leader_index: int) -> None:
+        if leader_index == self.index:
+            if isinstance(self.state, _HzInactive):
+                self.become_leader(self._next_round())
+        else:
+            self.stop_being_leader()
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _get_round(self) -> int:
+        return self.state.round
+
+    def _next_round(self) -> int:
+        return self.round_system.next_classic_round(
+            self.index, self._get_round()
+        )
+
+    def _get_chunk(self, chunks: List[_Chunk],
+                   slot: int) -> Optional[Tuple[int, _Chunk]]:
+        for i in range(len(chunks) - 1, -1, -1):
+            if slot >= chunks[i].first_slot:
+                return (i, chunks[i])
+        return None
+
+    def _stop_phase_timers(self, phase) -> None:
+        phase.resend.stop()
+
+    def _stop_timers(self, state) -> None:
+        if isinstance(state, _HzActive):
+            for chunk in state.chunks:
+                self._stop_phase_timers(chunk.phase)
+
+    def _make_chunk(self, round: int, first_slot: int,
+                    quorum: SimpleMajority) -> _Chunk:
+        phase1a = HzPhase1a(
+            round=round, first_slot=first_slot,
+            chosen_watermark=self.chosen_watermark,
+        )
+
+        def send() -> None:
+            for i in quorum.nodes():
+                self.chan(self.config.acceptor_addresses[i]).send(phase1a)
+
+        send()
+
+        def resend() -> None:
+            send()
+            timer.start()
+
+        timer = self.timer(
+            f"resendPhase1as{first_slot}",
+            self.options.resend_phase1as_period, resend,
+        )
+        timer.start()
+        return _Chunk(
+            first_slot=first_slot, last_slot=None, quorum=quorum,
+            phase=_HzPhase1(phase1bs={}, resend=timer),
+        )
+
+    def _make_phase2_timer(self, chunk_first_slot: int,
+                           quorum: SimpleMajority, values: Dict[int, HzValue]):
+        def resend() -> None:
+            # Drive the first few unchosen slots (Leader.scala:358-394).
+            for slot in range(self.chosen_watermark,
+                              self.chosen_watermark + 10):
+                value = values.get(slot)
+                if value is None:
+                    continue
+                phase2a = HzPhase2a(
+                    slot=slot, round=self._get_round(),
+                    first_slot=chunk_first_slot, value=value,
+                )
+                for i in quorum.nodes():
+                    self.chan(self.config.acceptor_addresses[i]).send(phase2a)
+            timer.start()
+
+        timer = self.timer(
+            f"resendPhase2as{chunk_first_slot}",
+            self.options.resend_phase2as_period, resend,
+        )
+        timer.start()
+        return timer
+
+    def _safe_value(self, phase1bs, slot: int) -> HzValue:
+        infos = [
+            info for b in phase1bs for info in b.info if info[0] == slot
+        ]
+        if not infos:
+            return HzValue(kind=NOOP)
+        return max(infos, key=lambda info: info[1])[2]
+
+    def _choose(self, slot: int, value: HzValue) -> List[Tuple[int, tuple]]:
+        """Record a chosen value and advance the watermark; returns any
+        newly chosen configurations as (slot, members)
+        (Leader.scala:460-505)."""
+        self.log.put(slot, value)
+        configurations = []
+        while True:
+            entry = self.log.get(self.chosen_watermark)
+            if entry is None:
+                return configurations
+            s = self.chosen_watermark
+            self.chosen_watermark += 1
+            if entry.kind == CONFIGURATION:
+                self.active_first_slots.append(s + self.options.alpha)
+                configurations.append((s, entry.members))
+            if (
+                len(self.active_first_slots) >= 2
+                and s == self.active_first_slots[1]
+            ):
+                self.active_first_slots.pop(0)
+
+    def stop_being_leader(self) -> None:
+        self._stop_timers(self.state)
+        self.state = _HzInactive(round=self._get_round())
+
+    def become_leader(self, new_round: int) -> None:
+        self.logger.check_gt(new_round, self._get_round())
+        self.logger.check_eq(self.round_system.leader(new_round), self.index)
+        self._stop_timers(self.state)
+        first_slot = self.active_first_slots[0]
+        if first_slot == 0:
+            quorum = SimpleMajority(set(range(2 * self.config.f + 1)))
+        else:
+            # The chunk's configuration was chosen at first_slot - alpha.
+            entry = self.log.get(first_slot - self.options.alpha)
+            self.logger.check(entry is not None)
+            self.logger.check_eq(entry.kind, CONFIGURATION)
+            quorum = SimpleMajority(set(entry.members))
+        self.state = _HzActive(
+            round=new_round,
+            chunks=[self._make_chunk(new_round, first_slot, quorum)],
+        )
+
+    def _propose(self, active: _HzActive, value: HzValue) -> None:
+        """Propose into the first phase-2 chunk with a vacant slot,
+        respecting the alpha pipeline bound (Leader.scala:575-640)."""
+        for chunk in active.chunks:
+            phase = chunk.phase
+            if not isinstance(phase, _HzPhase2):
+                continue
+            if phase.next_slot is None:
+                continue
+            next_slot = phase.next_slot
+            if next_slot >= self.chosen_watermark + self.options.alpha:
+                return  # alpha overflow: drop (client resends)
+            phase2a = HzPhase2a(
+                slot=next_slot, round=active.round,
+                first_slot=chunk.first_slot, value=value,
+            )
+            for i in chunk.quorum.nodes():
+                self.chan(self.config.acceptor_addresses[i]).send(phase2a)
+            phase.values[next_slot] = value
+            phase.phase2bs[next_slot] = {}
+            if chunk.last_slot is not None and next_slot == chunk.last_slot:
+                phase.next_slot = None
+            else:
+                phase.next_slot = next_slot + 1
+            return
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, HzPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, HzClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, HzPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, HzChosen):
+            if isinstance(self.state, _HzInactive):
+                self._choose(msg.slot, msg.value)
+        elif isinstance(msg, HzReconfigure):
+            if isinstance(self.state, _HzActive):
+                self._propose(
+                    self.state,
+                    HzValue(kind=CONFIGURATION, members=tuple(msg.members)),
+                )
+        elif isinstance(msg, HzLeaderInfoRequest):
+            if isinstance(self.state, _HzActive):
+                self.chan(src).send(
+                    HzLeaderInfoReply(round=self.state.round)
+                )
+        elif isinstance(msg, HzNack):
+            self._handle_nack(msg)
+        elif isinstance(msg, HzRecover):
+            self._handle_recover(msg)
+        else:
+            self.logger.fatal(f"unknown horizontal leader message {msg!r}")
+
+    def _handle_phase1b(self, msg: HzPhase1b) -> None:
+        state = self.state
+        if not isinstance(state, _HzActive) or msg.round != state.round:
+            return
+        found = self._get_chunk(state.chunks, msg.first_slot)
+        if found is None:
+            return
+        chunk_index, chunk = found
+        if chunk.first_slot != msg.first_slot:
+            return  # stale: from a chunk that no longer exists
+        phase = chunk.phase
+        if not isinstance(phase, _HzPhase1):
+            return
+        phase.phase1bs[msg.acceptor_index] = msg
+        if not chunk.quorum.is_superset_of_read_quorum(set(phase.phase1bs)):
+            return
+        self._stop_phase_timers(phase)
+        slots = [
+            info[0] for b in phase.phase1bs.values() for info in b.info
+        ]
+        max_slot = max(slots, default=-1)
+        values: Dict[int, HzValue] = {}
+        phase2bs: Dict[int, Dict[int, HzPhase2b]] = {}
+        for slot in range(max(msg.first_slot, self.chosen_watermark),
+                          max_slot + 1):
+            value = self._safe_value(phase.phase1bs.values(), slot)
+            phase2a = HzPhase2a(
+                slot=slot, round=state.round,
+                first_slot=chunk.first_slot, value=value,
+            )
+            for i in chunk.quorum.nodes():
+                self.chan(self.config.acceptor_addresses[i]).send(phase2a)
+            values[slot] = value
+            phase2bs[slot] = {}
+        s = max(msg.first_slot, self.chosen_watermark, max_slot + 1)
+        next_slot: Optional[int] = s
+        if chunk.last_slot is not None and s > chunk.last_slot:
+            next_slot = None
+        chunk.phase = _HzPhase2(
+            next_slot=next_slot, values=values, phase2bs=phase2bs,
+            resend=self._make_phase2_timer(
+                chunk.first_slot, chunk.quorum, values
+            ),
+        )
+
+    def _handle_client_request(self, src: Address,
+                               msg: HzClientRequest) -> None:
+        if isinstance(self.state, _HzInactive):
+            self.chan(src).send(HzNotLeader())
+            return
+        self._propose(self.state, HzValue(kind=COMMAND, command=msg.command))
+
+    def _handle_phase2b(self, msg: HzPhase2b) -> None:
+        state = self.state
+        if not isinstance(state, _HzActive) or msg.round != state.round:
+            return
+        if msg.slot < self.chosen_watermark or self.log.get(msg.slot) is not None:
+            return
+        found = self._get_chunk(state.chunks, msg.slot)
+        if found is None:
+            return
+        _, chunk = found
+        phase = chunk.phase
+        if not isinstance(phase, _HzPhase2):
+            return
+        in_slot = phase.phase2bs.setdefault(msg.slot, {})
+        in_slot[msg.acceptor_index] = msg
+        if not chunk.quorum.is_superset_of_write_quorum(set(in_slot)):
+            return
+        value = phase.values.get(msg.slot)
+        if value is None:
+            return
+        chosen = HzChosen(slot=msg.slot, value=value)
+        for a in self.config.replica_addresses:
+            self.chan(a).send(chosen)
+        for a in self.config.leader_addresses:
+            if a != self.address:
+                self.chan(a).send(chosen)
+        phase.values.pop(msg.slot, None)
+        phase.phase2bs.pop(msg.slot, None)
+        old_watermark = self.chosen_watermark
+        configurations = self._choose(msg.slot, value)
+        if old_watermark != self.chosen_watermark:
+            phase.resend.reset()
+        # Open a new chunk per newly chosen configuration
+        # (Leader.scala:930-975).
+        for slot, members in configurations:
+            last_slot = slot + self.options.alpha - 1
+            previous = state.chunks[-1]
+            previous.last_slot = last_slot
+            if isinstance(previous.phase, _HzPhase2):
+                if (
+                    previous.phase.next_slot is not None
+                    and previous.phase.next_slot > last_slot
+                ):
+                    previous.phase.next_slot = None
+            state.chunks.append(
+                self._make_chunk(
+                    state.round, slot + self.options.alpha,
+                    SimpleMajority(set(members)),
+                )
+            )
+        # Prune defunct chunks.
+        while state.chunks:
+            chunk = state.chunks[0]
+            if (
+                chunk.last_slot is not None
+                and chunk.last_slot < self.chosen_watermark
+            ):
+                self._stop_phase_timers(chunk.phase)
+                state.chunks.pop(0)
+            else:
+                break
+
+    def _handle_nack(self, msg: HzNack) -> None:
+        if msg.round < self._get_round():
+            return
+        state = self.state
+        if isinstance(state, _HzInactive):
+            state.round = msg.round
+        else:
+            self.become_leader(
+                self.round_system.next_classic_round(
+                    self.index, max(msg.round, state.round)
+                )
+            )
+
+    def _handle_recover(self, msg: HzRecover) -> None:
+        state = self.state
+        if isinstance(state, _HzInactive):
+            return
+        # Unlike Matchmaker MultiPaxos we cannot lower chosen_watermark
+        # (active_first_slots and alpha depend on it); slots below it were
+        # chosen and replicas recover them from each other
+        # (Leader.scala:1069-1100).
+        if self.chosen_watermark > msg.slot:
+            return
+        self.become_leader(self._next_round())
+
+
+# -- Acceptor -----------------------------------------------------------------
+
+
+class HzAcceptor(Actor):
+    """``horizontal/Acceptor.scala``: one round across all slots; each
+    vote remembers the first slot of its owning chunk so phase 1 only
+    reports votes belonging to the requested chunk."""
+
+    def __init__(self, address, transport, logger, config: HorizontalConfig):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.acceptor_addresses)
+        self.config = config
+        self.index = config.acceptor_addresses.index(address)
+        self.round = -1
+        # slot -> (first_slot, vote_round, value)
+        self.states: Dict[int, Tuple[int, int, HzValue]] = {}
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, HzPhase1a):
+            if msg.round < self.round:
+                self.chan(src).send(HzNack(round=self.round))
+                return
+            self.round = msg.round
+            start = max(msg.first_slot, msg.chosen_watermark)
+            info = tuple(
+                (slot, vote_round, value)
+                for slot, (first_slot, vote_round, value) in sorted(
+                    self.states.items()
+                )
+                if slot >= start and first_slot == msg.first_slot
+            )
+            self.chan(src).send(
+                HzPhase1b(
+                    round=self.round, first_slot=msg.first_slot,
+                    acceptor_index=self.index, info=info,
+                )
+            )
+        elif isinstance(msg, HzPhase2a):
+            if msg.round < self.round:
+                self.chan(src).send(HzNack(round=self.round))
+                return
+            self.round = msg.round
+            self.states[msg.slot] = (msg.first_slot, msg.round, msg.value)
+            self.chan(src).send(
+                HzPhase2b(
+                    slot=msg.slot, round=msg.round, acceptor_index=self.index
+                )
+            )
+        else:
+            self.logger.fatal(f"unknown horizontal acceptor message {msg!r}")
+
+
+# -- Replica ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HzReplicaOptions:
+    log_grow_size: int = 5000
+    recover_min_period: float = 10.0
+    recover_max_period: float = 20.0
+    unsafe_dont_recover: bool = False
+
+
+class HzReplica(Actor):
+    """``horizontal/Replica.scala``: executes commands in prefix order
+    (noops and configurations are skipped), recovers holes via other
+    replicas then leaders."""
+
+    def __init__(self, address, transport, logger, config: HorizontalConfig,
+                 state_machine: StateMachine,
+                 options: HzReplicaOptions = HzReplicaOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.replica_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.replica_addresses.index(address)
+        self.log: BufferMap = BufferMap(options.log_grow_size)
+        self.executed_watermark = 0
+        self.num_chosen = 0
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+
+        def recover() -> None:
+            recover_msg = HzRecover(slot=self.executed_watermark)
+            for a in self.config.replica_addresses:
+                if a != self.address:
+                    self.chan(a).send(recover_msg)
+            for a in self.config.leader_addresses:
+                self.chan(a).send(recover_msg)
+            self.recover_timer.start()
+
+        self.recover_timer = self.timer(
+            "recover",
+            random_duration(self.rng, options.recover_min_period,
+                            options.recover_max_period),
+            recover,
+        )
+
+    def _execute_command(self, slot: int, command: HzCommand) -> None:
+        cid = command.command_id
+        identity = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(identity)
+        client = self.transport.address_from_bytes(cid.client_address)
+        if cached is not None:
+            if cid.client_id < cached[0]:
+                return
+            if cid.client_id == cached[0]:
+                self.chan(client).send(
+                    HzClientReply(command_id=cid, result=cached[1])
+                )
+                return
+        result = self.state_machine.run(command.command)
+        self.client_table[identity] = (cid.client_id, result)
+        if slot % len(self.config.replica_addresses) == self.index:
+            self.chan(client).send(
+                HzClientReply(command_id=cid, result=result)
+            )
+
+    def _execute_log(self) -> None:
+        while True:
+            value = self.log.get(self.executed_watermark)
+            if value is None:
+                return
+            if value.kind == COMMAND:
+                self._execute_command(self.executed_watermark, value.command)
+            self.executed_watermark += 1
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, HzChosen):
+            self._handle_chosen(msg)
+        elif isinstance(msg, HzRecover):
+            value = self.log.get(msg.slot)
+            if value is not None:
+                self.chan(src).send(HzChosen(slot=msg.slot, value=value))
+        else:
+            self.logger.fatal(f"unknown horizontal replica message {msg!r}")
+
+    def _handle_chosen(self, msg: HzChosen) -> None:
+        was_running = self.num_chosen != self.executed_watermark
+        old_watermark = self.executed_watermark
+        if self.log.get(msg.slot) is not None:
+            return
+        self.log.put(msg.slot, msg.value)
+        self.num_chosen += 1
+        self._execute_log()
+        if self.options.unsafe_dont_recover:
+            return
+        should_run = self.num_chosen != self.executed_watermark
+        moved = old_watermark != self.executed_watermark
+        if was_running:
+            if should_run and moved:
+                self.recover_timer.reset()
+            elif not should_run:
+                self.recover_timer.stop()
+        elif should_run:
+            self.recover_timer.start()
+
+
+# -- Client -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _HzPending:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class HzClient(Actor):
+    """``horizontal/Client.scala``."""
+
+    def __init__(self, address, transport, logger, config: HorizontalConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.round_system = ClassicRoundRobin(len(config.leader_addresses))
+        self.round = 0
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _HzPending] = {}
+
+    def _request(self, pseudonym: int, pending: _HzPending):
+        return HzClientRequest(
+            command=HzCommand(
+                command_id=HzCommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=pending.id,
+                ),
+                command=pending.command,
+            )
+        )
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+
+        def resend() -> None:
+            pending = self.pending.get(pseudonym)
+            if pending is not None:
+                request = self._request(pseudonym, pending)
+                for a in self.config.leader_addresses:
+                    self.chan(a).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendHz{pseudonym}", self.resend_period, resend)
+        timer.start()
+        pending = _HzPending(
+            id=id, command=command, result=promise, resend=timer
+        )
+        self.pending[pseudonym] = pending
+        leader = self.config.leader_addresses[
+            self.round_system.leader(self.round)
+        ]
+        self.chan(leader).send(self._request(pseudonym, pending))
+        return promise
+
+    def reconfigure(self, members: tuple) -> None:
+        """Ask the current leader to reconfigure to a new acceptor set."""
+        leader = self.config.leader_addresses[
+            self.round_system.leader(self.round)
+        ]
+        self.chan(leader).send(HzReconfigure(members=tuple(members)))
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, HzClientReply):
+            pending = self.pending.get(msg.command_id.client_pseudonym)
+            if pending is None or msg.command_id.client_id != pending.id:
+                return
+            pending.resend.stop()
+            del self.pending[msg.command_id.client_pseudonym]
+            pending.result.success(msg.result)
+        elif isinstance(msg, HzNotLeader):
+            request = HzLeaderInfoRequest()
+            for a in self.config.leader_addresses:
+                self.chan(a).send(request)
+        elif isinstance(msg, HzLeaderInfoReply):
+            if msg.round > self.round:
+                self.round = msg.round
+                for pseudonym, pending in self.pending.items():
+                    leader = self.config.leader_addresses[
+                        self.round_system.leader(self.round)
+                    ]
+                    self.chan(leader).send(
+                        self._request(pseudonym, pending)
+                    )
+        else:
+            self.logger.fatal(f"unknown horizontal client message {msg!r}")
+
+
+# -- Driver -------------------------------------------------------------------
+
+
+class HzDriver(Actor):
+    """``horizontal/Driver.scala``: injects reconfigurations and leader
+    failures — on a repeating schedule when ``schedule=True`` (the
+    reference's RepeatedReconfiguration workload), or manually via
+    ``force_reconfiguration`` / ``force_leader_change``."""
+
+    def __init__(self, address, transport, logger, config: HorizontalConfig,
+                 period: float = 1.0, schedule: bool = False, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+
+        def fire() -> None:
+            self.force_reconfiguration()
+            self.reconfigure_timer.start()
+
+        self.reconfigure_timer = self.timer(
+            "driverReconfigure", period, fire
+        )
+        if schedule:
+            self.reconfigure_timer.start()
+
+    def receive(self, src: Address, msg) -> None:
+        self.logger.fatal("the driver does not receive messages")
+
+    def force_reconfiguration(self, members: Optional[tuple] = None,
+                              leader_index: int = 0) -> None:
+        if members is None:
+            members = tuple(
+                self.rng.sample(range(len(self.config.acceptor_addresses)),
+                                2 * self.config.f + 1)
+            )
+        self.chan(self.config.leader_addresses[leader_index]).send(
+            HzReconfigure(members=members)
+        )
+
+    def force_leader_change(self, leader_index: Optional[int] = None) -> None:
+        if leader_index is None:
+            leader_index = self.rng.randrange(
+                len(self.config.leader_election_addresses)
+            )
+        self.chan(
+            self.config.leader_election_addresses[leader_index]
+        ).send(election.ForceNoPing())

@@ -1,0 +1,285 @@
+"""Horizontal MultiPaxos sim tests: chunked log, in-log reconfiguration
+taking effect at slot + alpha, failover across chunk boundaries, and
+randomized safety."""
+
+import dataclasses
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import horizontal as hz
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+class Cluster:
+    def __init__(self, seed=0, f=1, num_clients=2, num_acceptors=None,
+                 alpha=4):
+        num_acceptors = num_acceptors or 2 * f + 2  # one spare
+        self.transport = SimTransport(FakeLogger(LogLevel.FATAL))
+        t = self.transport
+        self.config = hz.HorizontalConfig(
+            f=f,
+            leader_addresses=tuple(
+                SimAddress(f"leader{i}") for i in range(f + 1)
+            ),
+            leader_election_addresses=tuple(
+                SimAddress(f"election{i}") for i in range(f + 1)
+            ),
+            acceptor_addresses=tuple(
+                SimAddress(f"acceptor{i}") for i in range(num_acceptors)
+            ),
+            replica_addresses=tuple(
+                SimAddress(f"replica{i}") for i in range(f + 1)
+            ),
+        )
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        options = hz.HzLeaderOptions(alpha=alpha)
+        self.leaders = [
+            hz.HzLeader(a, t, log(), self.config, options, seed=seed + i)
+            for i, a in enumerate(self.config.leader_addresses)
+        ]
+        self.acceptors = [
+            hz.HzAcceptor(a, t, log(), self.config)
+            for a in self.config.acceptor_addresses
+        ]
+        self.replicas = [
+            hz.HzReplica(a, t, log(), self.config, ReadableAppendLog(),
+                         seed=seed + 30 + i)
+            for i, a in enumerate(self.config.replica_addresses)
+        ]
+        self.clients = [
+            hz.HzClient(SimAddress(f"client{i}"), t, log(), self.config,
+                        seed=seed + 50 + i)
+            for i in range(num_clients)
+        ]
+        self.driver = hz.HzDriver(
+            SimAddress("driver"), t, log(), self.config, seed=seed + 99
+        )
+
+    def drain(self, max_steps=300000):
+        steps = 0
+        t = self.transport
+        while t.messages and steps < max_steps:
+            t.deliver_message(t.messages[0])
+            steps += 1
+        assert steps < max_steps
+
+    def pump(self, rounds=8, skip=lambda timer: False):
+        infra = set(self.config.leader_election_addresses)
+        self.drain()
+        for _ in range(rounds):
+            for timer in list(self.transport.running_timers()):
+                if timer.address not in infra and not skip(timer):
+                    self.transport.trigger_timer(timer.address, timer.name())
+            self.drain()
+
+
+def test_hz_single_command():
+    cluster = Cluster()
+    cluster.drain()  # leader 0's initial chunk phase 1
+    p = cluster.clients[0].propose(0, b"hello")
+    cluster.drain()
+    assert p.done
+    for r in cluster.replicas:
+        assert r.state_machine.log == [b"hello"]
+
+
+def test_hz_sequential_commands():
+    cluster = Cluster(seed=3, alpha=8)
+    cluster.drain()
+    for i in range(10):
+        p = cluster.clients[i % 2].propose(i // 2, f"c{i}".encode())
+        cluster.drain()
+        assert p.done, i
+    for r in cluster.replicas:
+        assert r.state_machine.log == [f"c{i}".encode() for i in range(10)]
+
+
+def test_hz_reconfiguration_takes_effect_at_alpha():
+    """A chosen Configuration at slot s opens a new chunk at s + alpha;
+    commands keep flowing across the chunk boundary on the new quorum."""
+    cluster = Cluster(seed=5, alpha=4)
+    cluster.drain()
+    p = cluster.clients[0].propose(0, b"w0")
+    cluster.drain()
+    assert p.done
+    # Reconfigure to {1, 2, 3}; chosen at slot 1 -> new chunk at slot 5.
+    cluster.driver.force_reconfiguration(members=(1, 2, 3))
+    cluster.drain()
+    leader = cluster.leaders[0]
+    assert leader.active_first_slots[-1] == 1 + 4
+    assert len(leader.state.chunks) == 2
+    assert leader.state.chunks[1].quorum.nodes() == frozenset({1, 2, 3})
+    assert leader.state.chunks[0].last_slot == 1 + 4 - 1
+    # Fill the boundary: slots 2-4 in the old chunk, 5+ in the new one.
+    for i in range(6):
+        p = cluster.clients[i % 2].propose(1 + i // 2, f"x{i}".encode())
+        cluster.drain()
+        assert p.done, i
+    # The old chunk is now defunct and pruned.
+    assert len(leader.state.chunks) == 1
+    assert leader.state.chunks[0].first_slot == 5
+    # Votes for slots >= 5 live only on the new quorum members.
+    for slot, (first_slot, _, _) in cluster.acceptors[0].states.items():
+        assert slot < 5, "acceptor 0 voted in the new chunk"
+    for r in cluster.replicas:
+        assert len(r.state_machine.log) == 7
+
+
+def test_hz_alpha_bounds_pipeline():
+    """At most alpha commands may sit past the chosen watermark: extra
+    proposals are dropped and recovered by client resends."""
+    cluster = Cluster(seed=7, alpha=2)
+    cluster.drain()
+    # Propose 4 commands without delivering anything: only 2 slots may
+    # receive phase2as.
+    ps = [cluster.clients[0].propose(i, f"c{i}".encode()) for i in range(4)]
+    leader = cluster.leaders[0]
+    chunk = leader.state.chunks[0]
+    assert len(chunk.phase.values) <= 2
+    cluster.pump(rounds=6)
+    assert all(p.done for p in ps)
+
+
+def test_hz_failover_into_current_chunk():
+    """After a reconfiguration, a new leader starts its chunk at the
+    FIRST ACTIVE chunk's slot with that chunk's configuration — chosen
+    commands survive, and new commands commit on the new quorum."""
+    cluster = Cluster(seed=9, alpha=4)
+    cluster.drain()
+    p = cluster.clients[0].propose(0, b"pre")
+    cluster.drain()
+    assert p.done
+    cluster.driver.force_reconfiguration(members=(1, 2, 3))
+    cluster.drain()
+    # Choose enough commands to pass the boundary (slot 5).
+    for i in range(5):
+        p = cluster.clients[0].propose(1 + i, f"f{i}".encode())
+        cluster.drain()
+        assert p.done
+    # Leader 0 dies; leader 1 takes over.
+    dead = cluster.config.leader_addresses[0]
+    cluster.transport.partition_actor(dead)
+    cluster.transport.partition_actor(
+        cluster.config.leader_election_addresses[0]
+    )
+    cluster.leaders[1]._on_election(1)
+    cluster.pump(skip=lambda tm: tm.address == dead)
+    leader1 = cluster.leaders[1]
+    assert isinstance(leader1.state, hz._HzActive)
+    assert leader1.state.chunks[0].quorum.nodes() == frozenset({1, 2, 3})
+    p2 = cluster.clients[1].propose(0, b"post")
+    cluster.pump(skip=lambda tm: tm.address == dead)
+    assert p2.done
+    assert cluster.replicas[0].state_machine.log[-1] == b"post"
+
+
+def test_hz_dropped_chosen_recovered_by_replicas():
+    cluster = Cluster(seed=11)
+    cluster.drain()
+    victim = cluster.config.replica_addresses[1]
+    t = cluster.transport
+    p = cluster.clients[0].propose(0, b"lost")
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == victim:
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert p.done
+    assert cluster.replicas[1].state_machine.log == []
+    p2 = cluster.clients[0].propose(0, b"next")
+    cluster.pump(rounds=6)
+    assert p2.done
+    assert cluster.replicas[1].state_machine.log == [b"lost", b"next"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Reconfigure:
+    members: tuple
+
+
+class SimulatedHz(SimulatedSystem):
+    def __init__(self, f=1, reconfigure=True, alpha=4):
+        self.f = f
+        self.reconfigure = reconfigure
+        self.alpha = alpha
+
+    def new_system(self, seed):
+        cluster = Cluster(seed=seed, f=self.f, alpha=self.alpha)
+        cluster.drain()
+        return cluster
+
+    def get_state(self, system):
+        return tuple(
+            tuple(r.state_machine.log) for r in system.replicas
+        )
+
+    def generate_command(self, system, rng):
+        ops = []
+        for i, c in enumerate(system.clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (2, Propose(i, pseudonym, f"v{rng.randrange(100)}"))
+                    )
+        if self.reconfigure:
+            n = len(system.config.acceptor_addresses)
+            ops.append((1, Reconfigure(
+                tuple(rng.sample(range(n), 2 * self.f + 1))
+            )))
+        return mixed_command(rng, system.transport, ops)
+
+    def run_command(self, system, command):
+        if isinstance(command, Propose):
+            system.clients[command.client_index].propose(
+                command.pseudonym, command.value.encode()
+            )
+        elif isinstance(command, Reconfigure):
+            system.driver.force_reconfiguration(members=command.members)
+        else:
+            system.transport.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"replica logs diverge: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"replica log rewrote history: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_hz_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedHz(f), run_length=150, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_hz_safety_randomized_small_alpha():
+    bad = simulate_and_minimize(
+        SimulatedHz(1, alpha=2), run_length=150, num_runs=8, seed=31
+    )
+    assert bad is None, f"\n{bad}"
