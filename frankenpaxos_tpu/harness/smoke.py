@@ -519,6 +519,33 @@ def smoke_matchmakerpaxos(bench=None) -> dict:
     return _sim_smoke(build, operate)
 
 
+def smoke_fasterpaxos(bench=None) -> dict:
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import fasterpaxos as fpx
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    def build(t):
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        config = fpx.FasterPaxosConfig(
+            f=1,
+            server_addresses=tuple(SimAddress(f"fps{i}") for i in range(3)),
+            heartbeat_addresses=tuple(SimAddress(f"fph{i}") for i in range(3)),
+        )
+        for i, a in enumerate(config.server_addresses):
+            fpx.FprServer(a, t, log(), config, ReadableAppendLog(), seed=i)
+        _drain(t)  # round 0 phase 1 + Phase2aAny
+        return [
+            fpx.FprClient(SimAddress(f"fpc{i}"), t, log(), config, seed=50 + i)
+            for i in range(2)
+        ]
+
+    def operate(t, clients):
+        return [c.propose(0, f"cmd{i}".encode()) for i, c in enumerate(clients)]
+
+    return _sim_smoke(build, operate)
+
+
 def smoke_horizontal(bench=None) -> dict:
     from frankenpaxos_tpu.core import FakeLogger, SimAddress
     from frankenpaxos_tpu.core.logger import LogLevel
@@ -794,6 +821,7 @@ SMOKES = {
     "matchmakerpaxos": smoke_matchmakerpaxos,
     "matchmakermultipaxos": smoke_matchmakermultipaxos,
     "horizontal": smoke_horizontal,
+    "fasterpaxos": smoke_fasterpaxos,
     "fastmultipaxos": smoke_fastmultipaxos,
     "scalog": smoke_scalog,
     "multipaxos": smoke_multipaxos,

@@ -1,0 +1,974 @@
+"""Faster Paxos — delegate-based multi-leader MultiPaxos (reference
+``fasterpaxos/``; protocol cheatsheet in ``FasterPaxos.proto``).
+
+There are only CLIENTS and 2f+1 SERVERS. In round r, the round's owner
+is the LEADER; it picks f+1 DELEGATES (including itself). After phase 1,
+the leader sends Phase2aAny granting the delegates the open log suffix
+past ``any_watermark``; delegates round-robin-partition those slots and
+accept client commands DIRECTLY — a delegate proposes in a slot it owns,
+the other delegates vote, and f+1 votes choose the value without the
+leader in the loop. Noop back-filling covers skipped slots; a delegate
+that voted noop re-votes for a command on receipt (safe here, unlike
+classic Paxos: noops only fill slots their owner will never propose a
+command in), and with ``ack_noops_with_commands`` a delegate answers a
+noop Phase2a for an already-commanded slot with the command's Phase2b.
+All-to-all heartbeats detect dead delegates: any server noticing one
+starts phase 1 in its own next round (``Server.scala:497-530``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.core import Actor, Address, wire
+from frankenpaxos_tpu.core.promise import Promise
+from frankenpaxos_tpu.heartbeat import HeartbeatOptions, Participant
+from frankenpaxos_tpu.roundsystem import ClassicRoundRobin
+from frankenpaxos_tpu.statemachine import StateMachine
+from frankenpaxos_tpu.util import BufferMap, random_duration
+
+COMMAND = "command"
+NOOP = "noop"
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprCommandId:
+    client_address: bytes
+    client_pseudonym: int
+    client_id: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprCommand:
+    command_id: FprCommandId
+    command: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprClientRequest:
+    round: int
+    command: FprCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprClientReply:
+    command_id: FprCommandId
+    result: bytes
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase1a:
+    round: int
+    chosen_watermark: int
+    delegates: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase1b:
+    server_index: int
+    round: int
+    # (slot, "pending", vote_round, kind, command) or
+    # (slot, "chosen", -1, kind, command)
+    info: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase2a:
+    slot: int
+    round: int
+    kind: str
+    command: Optional[FprCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase2b:
+    server_index: int
+    slot: int
+    round: int
+    # ack_noops_with_commands: the non-noop value this server already
+    # voted for in the slot (see module docstring).
+    command: Optional[FprCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase2aAny:
+    round: int
+    delegates: tuple
+    any_watermark: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase2aAnyAck:
+    round: int
+    server_index: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprPhase3a:
+    slot: int
+    kind: str
+    command: Optional[FprCommand] = None
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprRoundInfo:
+    round: int
+    delegates: tuple
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprNack:
+    round: int
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class FprRecover:
+    slot: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FasterPaxosConfig:
+    f: int
+    server_addresses: tuple  # 2f+1
+    heartbeat_addresses: tuple  # one per server
+
+    def check_valid(self) -> None:
+        if self.f < 1:
+            raise ValueError("f must be >= 1")
+        if len(self.server_addresses) != 2 * self.f + 1:
+            raise ValueError("need exactly 2f+1 servers")
+        if len(self.heartbeat_addresses) != len(self.server_addresses):
+            raise ValueError("one heartbeat address per server")
+
+
+# -- Server -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FprPhase1:
+    round: int
+    delegates: tuple  # server indices, f+1 of them
+    phase1bs: Dict[int, FprPhase1b]
+    pending_requests: List[FprClientRequest]
+    resend: object
+
+
+@dataclasses.dataclass
+class _FprPhase2:
+    round: int
+    delegates: tuple
+    delegate_index: int
+    any_watermark: int
+    next_slot: int
+    pending_values: Dict[int, Tuple[str, Optional[FprCommand]]]
+    phase2bs: Dict[int, Dict[int, FprPhase2b]]
+    waiting_acks: set
+    resend: object
+
+
+@dataclasses.dataclass
+class _FprDelegate:
+    round: int
+    delegates: tuple
+    delegate_index: int
+    any_watermark: int
+    next_slot: int
+    pending_values: Dict[int, Tuple[str, Optional[FprCommand]]]
+    phase2bs: Dict[int, Dict[int, FprPhase2b]]
+
+
+@dataclasses.dataclass
+class _FprIdle:
+    round: int
+    delegates: tuple
+
+
+# Log entries: ("pending", vote_round, kind, command) or
+# ("chosen", kind, command).
+@dataclasses.dataclass(frozen=True)
+class FprServerOptions:
+    log_grow_size: int = 5000
+    resend_phase1as_period: float = 5.0
+    resend_phase2a_anys_period: float = 5.0
+    recover_min_period: float = 10.0
+    recover_max_period: float = 20.0
+    leader_change_min_period: float = 60.0
+    leader_change_max_period: float = 120.0
+    ack_noops_with_commands: bool = True
+    use_f1_optimization: bool = True
+    unsafe_dont_recover: bool = False
+    heartbeat_options: HeartbeatOptions = HeartbeatOptions()
+
+
+class FprServer(Actor):
+    """``fasterpaxos/Server.scala``: leader, delegate, acceptor, and
+    replica in one actor, switching roles per round."""
+
+    def __init__(self, address, transport, logger, config: FasterPaxosConfig,
+                 state_machine: StateMachine,
+                 options: FprServerOptions = FprServerOptions(),
+                 seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        logger.check(address in config.server_addresses)
+        self.config = config
+        self.options = options
+        self.state_machine = state_machine
+        self.rng = random.Random(seed)
+        self.index = config.server_addresses.index(address)
+        self.round_system = ClassicRoundRobin(len(config.server_addresses))
+        # Delegates round-robin-partition slots among the f+1 of them.
+        self.slot_system = ClassicRoundRobin(config.f + 1)
+        self.log: BufferMap = BufferMap(options.log_grow_size)
+        self.executed_watermark = 0
+        self.num_chosen = 0
+        self.client_table: Dict[Tuple[bytes, int], Tuple[int, bytes]] = {}
+        self.heartbeat = Participant(
+            config.heartbeat_addresses[self.index], transport, logger,
+            [a for a in config.heartbeat_addresses
+             if a != config.heartbeat_addresses[self.index]],
+            options=options.heartbeat_options,
+        )
+
+        def recover() -> None:
+            recover_msg = FprRecover(slot=self.executed_watermark)
+            for a in self.config.server_addresses:
+                if a != self.address:
+                    self.chan(a).send(recover_msg)
+            self.recover_timer.start()
+
+        self.recover_timer = self.timer(
+            "recover",
+            random_duration(self.rng, options.recover_min_period,
+                            options.recover_max_period),
+            recover,
+        )
+
+        def leader_change() -> None:
+            self.check_delegates_alive()
+            self.leader_change_timer.start()
+
+        self.leader_change_timer = self.timer(
+            "leaderChange",
+            random_duration(self.rng, options.leader_change_min_period,
+                            options.leader_change_max_period),
+            leader_change,
+        )
+        self.leader_change_timer.start()
+
+        initial_delegates = tuple(range(config.f + 1))
+        self.state: object = _FprIdle(round=0, delegates=initial_delegates)
+        if self.index == 0:
+            self.start_phase1(0, initial_delegates)
+
+    # -- Helpers -------------------------------------------------------------
+
+    def _round_info(self) -> Tuple[int, tuple]:
+        return self.state.round, self.state.delegates
+
+    def _stop_timers(self, state) -> None:
+        if isinstance(state, _FprPhase1):
+            state.resend.stop()
+        elif isinstance(state, _FprPhase2):
+            state.resend.stop()
+
+    def pick_delegates(self) -> tuple:
+        """Ourselves plus f servers that look alive
+        (Server.scala:609-618)."""
+        alive = self.heartbeat.unsafe_alive()
+        alive_indices = [
+            i for i, a in enumerate(self.config.heartbeat_addresses)
+            if a in alive and i != self.index
+        ]
+        self.rng.shuffle(alive_indices)
+        others = alive_indices[: self.config.f]
+        # Fall back to arbitrary servers if too few look alive.
+        i = 0
+        while len(others) < self.config.f:
+            if i != self.index and i not in others:
+                others.append(i)
+            i += 1
+        return tuple([self.index] + sorted(others))
+
+    def check_delegates_alive(self) -> None:
+        """If any delegate looks dead, grab leadership in our next round
+        (Server.scala:497-530)."""
+        round, delegates = self._round_info()
+        delegate_addresses = {
+            self.config.heartbeat_addresses[i] for i in delegates
+        }
+        alive = self.heartbeat.unsafe_alive() | {
+            self.config.heartbeat_addresses[self.index]
+        }
+        if not delegate_addresses <= alive:
+            self._stop_timers(self.state)
+            self.start_phase1(
+                self.round_system.next_classic_round(self.index, round),
+                self.pick_delegates(),
+            )
+
+    def _get_next_slot(self, delegate_index: int, slot: int) -> int:
+        next_slot = self.slot_system.next_classic_round(delegate_index, slot)
+        while self.log.get(next_slot) is not None:
+            next_slot = self.slot_system.next_classic_round(
+                delegate_index, next_slot
+            )
+        return next_slot
+
+    def _choose(self, slot: int, kind: str,
+                command: Optional[FprCommand]) -> None:
+        entry = self.log.get(slot)
+        if entry is None or entry[0] == "pending":
+            self.num_chosen += 1
+            self.log.put(slot, ("chosen", kind, command))
+        else:
+            self.logger.check_eq(entry[1:], (kind, command))
+        state = self.state
+        if isinstance(state, (_FprPhase2, _FprDelegate)):
+            if slot == state.next_slot:
+                state.next_slot = self._get_next_slot(
+                    state.delegate_index, slot
+                )
+            state.pending_values.pop(slot, None)
+            state.phase2bs.pop(slot, None)
+
+    def _owns_slot(self, state, slot: int) -> bool:
+        if isinstance(state, _FprPhase2):
+            return (
+                slot < state.any_watermark
+                or self.slot_system.leader(slot) == state.delegate_index
+            )
+        if isinstance(state, _FprDelegate):
+            return (
+                slot >= state.any_watermark
+                and self.slot_system.leader(slot) == state.delegate_index
+            )
+        return False
+
+    def _log_info(self, start: int) -> tuple:
+        info = []
+        for slot in range(start, self.log.largest_key + 1):
+            entry = self.log.get(slot)
+            if entry is None:
+                continue
+            if entry[0] == "pending":
+                info.append((slot, "pending", entry[1], entry[2], entry[3]))
+            else:
+                info.append((slot, "chosen", -1, entry[1], entry[2]))
+        return tuple(info)
+
+    def start_phase1(self, round: int, delegates: tuple) -> None:
+        phase1a = FprPhase1a(
+            round=round, chosen_watermark=self.executed_watermark,
+            delegates=delegates,
+        )
+
+        def send() -> None:
+            for a in self.config.server_addresses:
+                if a != self.address:
+                    self.chan(a).send(phase1a)
+
+        send()
+
+        def resend() -> None:
+            send()
+            timer.start()
+
+        timer = self.timer(
+            f"resendPhase1as{round}", self.options.resend_phase1as_period,
+            resend,
+        )
+        timer.start()
+        # Answer our own phase 1a.
+        phase1b = FprPhase1b(
+            server_index=self.index, round=round,
+            info=self._log_info(self.executed_watermark),
+        )
+        self.state = _FprPhase1(
+            round=round, delegates=delegates,
+            phase1bs={self.index: phase1b},
+            pending_requests=[], resend=timer,
+        )
+
+    def _propose_single(self, state, slot: int, kind: str,
+                        command: Optional[FprCommand]) -> None:
+        """Vote for (kind, command) in slot ourselves and Phase2a the
+        other delegates (Server.scala:728-767)."""
+        self.logger.check(self.log.get(slot) is None)
+        phase2a = FprPhase2a(
+            slot=slot, round=state.round, kind=kind, command=command
+        )
+        for i in state.delegates:
+            if i != self.index:
+                self.chan(self.config.server_addresses[i]).send(phase2a)
+        self.log.put(slot, ("pending", state.round, kind, command))
+        state.pending_values[slot] = (kind, command)
+        state.phase2bs[slot] = {
+            self.index: FprPhase2b(
+                server_index=self.index, slot=slot, round=state.round
+            )
+        }
+
+    def _propose(self, state, kind: str,
+                 command: Optional[FprCommand]) -> None:
+        """Noop-fill the covered gap then propose in our next owned slot
+        (Server.scala:808-856)."""
+        slot = state.next_slot
+        for previous in range(
+            max(state.any_watermark, slot - len(state.delegates) + 1), slot
+        ):
+            if self.log.get(previous) is None:
+                self._propose_single(state, previous, NOOP, None)
+        self._propose_single(state, slot, kind, command)
+        state.next_slot = self._get_next_slot(state.delegate_index, slot)
+
+    def _repropose_single(self, state, slot: int) -> None:
+        """Re-drive a slot we own: resend our pending value, or propose a
+        noop if we have nothing (Server.scala:768-807). NOTE: unlike
+        _propose_single, the log may already hold a PENDING entry here —
+        we may have voted for another delegate's noop-fill without being
+        the proposer — and overwriting it with our own same-round noop
+        proposal is exactly what the reference does."""
+        pending = state.pending_values.get(slot)
+        if pending is None:
+            phase2a = FprPhase2a(
+                slot=slot, round=state.round, kind=NOOP, command=None
+            )
+            for i in state.delegates:
+                if i != self.index:
+                    self.chan(self.config.server_addresses[i]).send(phase2a)
+            self.log.put(slot, ("pending", state.round, NOOP, None))
+            state.pending_values[slot] = (NOOP, None)
+            state.phase2bs[slot] = {
+                self.index: FprPhase2b(
+                    server_index=self.index, slot=slot, round=state.round
+                )
+            }
+        else:
+            phase2a = FprPhase2a(
+                slot=slot, round=state.round, kind=pending[0],
+                command=pending[1],
+            )
+            for i in state.delegates:
+                if i != self.index:
+                    self.chan(self.config.server_addresses[i]).send(phase2a)
+
+    def _execute_command(self, command: FprCommand,
+                         reply: bool) -> None:
+        cid = command.command_id
+        identity = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(identity)
+        client = self.transport.address_from_bytes(cid.client_address)
+        if cached is not None:
+            if cid.client_id < cached[0]:
+                return
+            if cid.client_id == cached[0]:
+                # Always resend the cached reply, for liveness.
+                self.chan(client).send(
+                    FprClientReply(command_id=cid, result=cached[1])
+                )
+                return
+        result = self.state_machine.run(command.command)
+        self.client_table[identity] = (cid.client_id, result)
+        if reply:
+            self.chan(client).send(
+                FprClientReply(command_id=cid, result=result)
+            )
+
+    def _execute_log(self, reply_if) -> None:
+        while True:
+            entry = self.log.get(self.executed_watermark)
+            if entry is None or entry[0] == "pending":
+                if (
+                    not self.options.unsafe_dont_recover
+                    and self.num_chosen != self.executed_watermark
+                ):
+                    self.recover_timer.start()
+                return
+            slot = self.executed_watermark
+            self.executed_watermark += 1
+            self.recover_timer.stop()
+            _, kind, command = entry
+            if kind == COMMAND:
+                self._execute_command(command, reply_if(slot))
+
+    def _process_phase2b(self, state, msg: FprPhase2b) -> None:
+        entry = self.log.get(msg.slot)
+        self.logger.check(entry is not None)
+        if entry[0] == "chosen":
+            return
+        if msg.slot not in state.phase2bs or msg.slot not in state.pending_values:
+            return  # duplicate delivery after the slot was resolved
+        if not self.options.ack_noops_with_commands:
+            state.phase2bs[msg.slot][msg.server_index] = msg
+        else:
+            pending = state.pending_values[msg.slot]
+            owns = self._owns_slot(state, msg.slot)
+            if owns and pending[0] == COMMAND and msg.command is not None:
+                self.logger.fatal("nack for an owned slot is impossible")
+            elif (
+                (owns and pending[0] == COMMAND and msg.command is None)
+                or (not owns and pending[0] == COMMAND
+                    and msg.command is not None)
+                or (pending[0] == NOOP and msg.command is None)
+            ):
+                state.phase2bs[msg.slot][msg.server_index] = msg
+            elif not owns and pending[0] == COMMAND and msg.command is None:
+                # A Phase2b for our older noop, not the newer command.
+                return
+            else:
+                # We proposed a noop; another delegate already voted a
+                # command there. Switch to the command and start over.
+                command = msg.command
+                self.log.put(
+                    msg.slot, ("pending", msg.round, COMMAND, command)
+                )
+                state.pending_values[msg.slot] = (COMMAND, command)
+                state.phase2bs[msg.slot] = {
+                    msg.server_index: msg,
+                    self.index: FprPhase2b(
+                        server_index=self.index, slot=msg.slot,
+                        round=msg.round,
+                    ),
+                }
+        if len(state.phase2bs[msg.slot]) < self.config.f + 1:
+            return
+        kind, command = state.pending_values[msg.slot]
+        self._choose(msg.slot, kind, command)
+        phase3a = FprPhase3a(slot=msg.slot, kind=kind, command=command)
+        for a in self.config.server_addresses:
+            if a != self.address:
+                self.chan(a).send(phase3a)
+        self._execute_log(lambda slot: self._owns_slot(self.state, slot))
+
+    # -- Handlers ------------------------------------------------------------
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FprClientRequest):
+            self._handle_client_request(src, msg)
+        elif isinstance(msg, FprPhase1a):
+            self._handle_phase1a(src, msg)
+        elif isinstance(msg, FprPhase1b):
+            self._handle_phase1b(msg)
+        elif isinstance(msg, FprPhase2a):
+            self._handle_phase2a(src, msg)
+        elif isinstance(msg, FprPhase2b):
+            self._handle_phase2b(msg)
+        elif isinstance(msg, FprPhase2aAny):
+            self._handle_phase2a_any(src, msg)
+        elif isinstance(msg, FprPhase2aAnyAck):
+            self._handle_phase2a_any_ack(msg)
+        elif isinstance(msg, FprPhase3a):
+            self._choose(msg.slot, msg.kind, msg.command)
+            self._execute_log(lambda slot: self._owns_slot(self.state, slot))
+        elif isinstance(msg, FprRecover):
+            self._handle_recover(src, msg)
+        elif isinstance(msg, FprNack):
+            self._handle_nack(msg)
+        else:
+            self.logger.fatal(f"unknown fasterpaxos server message {msg!r}")
+
+    def _handle_client_request(self, src: Address,
+                               msg: FprClientRequest) -> None:
+        cid = msg.command.command_id
+        identity = (cid.client_address, cid.client_pseudonym)
+        cached = self.client_table.get(identity)
+        if cached is not None:
+            if cid.client_id < cached[0]:
+                return
+            if cid.client_id == cached[0]:
+                self.chan(src).send(
+                    FprClientReply(command_id=cid, result=cached[1])
+                )
+                return
+        round, delegates = self._round_info()
+        if msg.round < round:
+            self.chan(src).send(
+                FprRoundInfo(round=round, delegates=delegates)
+            )
+            return
+        if msg.round > round:
+            return
+        state = self.state
+        if isinstance(state, _FprPhase1):
+            state.pending_requests.append(msg)
+        elif isinstance(state, (_FprPhase2, _FprDelegate)):
+            self._propose(state, COMMAND, msg.command)
+        else:
+            # Idle in the same round as the client: the client should only
+            # talk to delegates; tell it who they are.
+            self.chan(src).send(
+                FprRoundInfo(round=round, delegates=delegates)
+            )
+
+    def _handle_phase1a(self, src: Address, msg: FprPhase1a) -> None:
+        round, _ = self._round_info()
+        if msg.round < round:
+            self.chan(src).send(FprNack(round=round))
+            return
+        if msg.round == round:
+            if not isinstance(self.state, _FprIdle):
+                return  # stale or impossible (Server.scala:1306-1333)
+        else:
+            self._stop_timers(self.state)
+            self.state = _FprIdle(
+                round=msg.round, delegates=tuple(msg.delegates)
+            )
+        self.chan(src).send(
+            FprPhase1b(
+                server_index=self.index, round=self.state.round,
+                info=self._log_info(msg.chosen_watermark),
+            )
+        )
+
+    def _safe_value(self, infos) -> Tuple[str, Tuple[str, Optional[FprCommand]]]:
+        """("safe"|"chosen", value) per Server.scala:861-901."""
+        if not infos:
+            return ("safe", (NOOP, None))
+        for info in infos:
+            if info[1] == "chosen":
+                return ("chosen", (info[3], info[4]))
+        largest = max(info[2] for info in infos)
+        for info in infos:
+            if info[2] == largest and info[3] == COMMAND:
+                return ("safe", (COMMAND, info[4]))
+        return ("safe", (NOOP, None))
+
+    def _handle_phase1b(self, msg: FprPhase1b) -> None:
+        state = self.state
+        if not isinstance(state, _FprPhase1) or msg.round != state.round:
+            return
+        state.phase1bs[msg.server_index] = msg
+        if len(state.phase1bs) < self.config.f + 1:
+            return
+        state.resend.stop()
+        round = state.round
+        infos_by_slot: Dict[int, list] = {}
+        for b in state.phase1bs.values():
+            for info in b.info:
+                infos_by_slot.setdefault(info[0], []).append(info)
+        max_slot = max(infos_by_slot, default=-1)
+        pending_values: Dict[int, Tuple[str, Optional[FprCommand]]] = {}
+        phase2bs: Dict[int, Dict[int, FprPhase2b]] = {}
+        for slot in range(self.executed_watermark, max_slot + 1):
+            entry = self.log.get(slot)
+            if entry is not None and entry[0] == "chosen":
+                continue  # a Phase3a landed while we ran phase 1
+            status, value = self._safe_value(infos_by_slot.get(slot, []))
+            if status == "chosen":
+                self._choose(slot, value[0], value[1])
+                continue
+            phase2a = FprPhase2a(
+                slot=slot, round=round, kind=value[0], command=value[1]
+            )
+            for a in self.config.server_addresses:
+                if a != self.address:
+                    self.chan(a).send(phase2a)
+            self.log.put(slot, ("pending", round, value[0], value[1]))
+            pending_values[slot] = value
+            phase2bs[slot] = {
+                self.index: FprPhase2b(
+                    server_index=self.index, slot=slot, round=round
+                )
+            }
+        self._execute_log(lambda slot: False)
+        slot = max_slot
+        # Propose the buffered client requests right after max_slot,
+        # skipping any slots a concurrent Phase3a already chose.
+        for request in state.pending_requests:
+            slot += 1
+            while (entry := self.log.get(slot)) is not None \
+                    and entry[0] == "chosen":
+                slot += 1
+            value = (COMMAND, request.command)
+            phase2a = FprPhase2a(
+                slot=slot, round=round, kind=COMMAND, command=request.command
+            )
+            for a in self.config.server_addresses:
+                if a != self.address:
+                    self.chan(a).send(phase2a)
+            self.log.put(slot, ("pending", round, COMMAND, request.command))
+            pending_values[slot] = value
+            phase2bs[slot] = {
+                self.index: FprPhase2b(
+                    server_index=self.index, slot=slot, round=round
+                )
+            }
+        # Hand the open log suffix to the delegates.
+        any_watermark = max(max_slot, slot) + 1
+        phase2a_any = FprPhase2aAny(
+            round=round, delegates=state.delegates,
+            any_watermark=any_watermark,
+        )
+
+        def send_anys() -> None:
+            for i in state.delegates:
+                if i != self.index:
+                    self.chan(self.config.server_addresses[i]).send(
+                        phase2a_any
+                    )
+
+        send_anys()
+
+        def resend() -> None:
+            send_anys()
+            timer.start()
+
+        timer = self.timer(
+            f"resendPhase2aAnys{round}",
+            self.options.resend_phase2a_anys_period, resend,
+        )
+        timer.start()
+        delegate_index = state.delegates.index(self.index)
+        self.state = _FprPhase2(
+            round=round, delegates=state.delegates,
+            delegate_index=delegate_index,
+            any_watermark=any_watermark,
+            next_slot=self._get_next_slot(delegate_index, any_watermark - 1),
+            pending_values=pending_values, phase2bs=phase2bs,
+            waiting_acks={i for i in state.delegates if i != self.index},
+            resend=timer,
+        )
+
+    def _handle_phase2a(self, src: Address, msg: FprPhase2a) -> None:
+        round, _ = self._round_info()
+        if msg.round < round:
+            self.chan(src).send(FprNack(round=round))
+            return
+        if msg.round > round:
+            return  # wait for the Phase2aAny (Server.scala:1519-1533)
+        state = self.state
+        # DELIBERATE divergence from Server.scala:1536-1540, which treats a
+        # same-round Phase2a at a Phase1/Idle server as impossible: the
+        # new leader's phase-1 REPAIR proposals go to arbitrary servers,
+        # which are Idle until the Phase2aAny arrives. Voting while Idle is
+        # always safe — acceptors need no delegate state.
+        phase2b = FprPhase2b(
+            server_index=self.index, slot=msg.slot, round=round
+        )
+        entry = self.log.get(msg.slot)
+        if entry is not None and entry[0] == "chosen":
+            self.chan(src).send(
+                FprPhase3a(slot=msg.slot, kind=entry[1], command=entry[2])
+            )
+        elif entry is None or entry[2] == NOOP:
+            # Nothing voted, or noop voted: vote for what we received
+            # (re-voting a command over our noop is safe in Faster Paxos).
+            if self.config.f == 1 and self.options.use_f1_optimization:
+                self._choose(msg.slot, msg.kind, msg.command)
+                self._execute_log(
+                    lambda slot: self._owns_slot(self.state, slot)
+                )
+            else:
+                self.log.put(
+                    msg.slot, ("pending", round, msg.kind, msg.command)
+                )
+            self.chan(src).send(phase2b)
+        else:
+            # We voted for a command.
+            if msg.kind == COMMAND:
+                self.logger.check_eq(msg.command, entry[3])
+                self.chan(src).send(phase2b)
+            elif self.options.ack_noops_with_commands:
+                # Answer the noop with our command's Phase2b.
+                self.chan(src).send(
+                    FprPhase2b(
+                        server_index=self.index, slot=msg.slot, round=round,
+                        command=entry[3],
+                    )
+                )
+        if isinstance(state, (_FprPhase2, _FprDelegate)):
+            if msg.slot == state.next_slot:
+                state.next_slot = self._get_next_slot(
+                    state.delegate_index, msg.slot
+                )
+
+    def _handle_phase2b(self, msg: FprPhase2b) -> None:
+        round, _ = self._round_info()
+        if msg.round < round:
+            return
+        self.logger.check_eq(msg.round, round)
+        state = self.state
+        if not isinstance(state, (_FprPhase2, _FprDelegate)):
+            self.logger.fatal("Phase2b while Phase1/Idle")
+        if msg.slot not in state.phase2bs:
+            entry = self.log.get(msg.slot)
+            if entry is not None and entry[0] == "chosen":
+                return
+        self._process_phase2b(state, msg)
+
+    def _handle_phase2a_any(self, src: Address, msg: FprPhase2aAny) -> None:
+        round, _ = self._round_info()
+        if msg.round < round:
+            return
+        state = self.state
+        if isinstance(state, _FprDelegate) and msg.round == round:
+            self.chan(src).send(
+                FprPhase2aAnyAck(round=round, server_index=self.index)
+            )
+            return
+        self._stop_timers(state)
+        delegate_index = msg.delegates.index(self.index)
+        self.state = _FprDelegate(
+            round=msg.round, delegates=tuple(msg.delegates),
+            delegate_index=delegate_index,
+            any_watermark=msg.any_watermark,
+            next_slot=self._get_next_slot(
+                delegate_index, msg.any_watermark - 1
+            ),
+            pending_values={}, phase2bs={},
+        )
+        self.chan(src).send(
+            FprPhase2aAnyAck(round=msg.round, server_index=self.index)
+        )
+
+    def _handle_phase2a_any_ack(self, msg: FprPhase2aAnyAck) -> None:
+        round, _ = self._round_info()
+        if msg.round != round:
+            return
+        state = self.state
+        if not isinstance(state, _FprPhase2):
+            return
+        state.waiting_acks.discard(msg.server_index)
+        if not state.waiting_acks:
+            state.resend.stop()
+
+    def _handle_recover(self, src: Address, msg: FprRecover) -> None:
+        entry = self.log.get(msg.slot)
+        if entry is not None and entry[0] == "chosen":
+            self.chan(src).send(
+                FprPhase3a(slot=msg.slot, kind=entry[1], command=entry[2])
+            )
+            return
+        state = self.state
+        if not isinstance(state, (_FprPhase2, _FprDelegate)):
+            return
+        if not self._owns_slot(state, msg.slot):
+            return
+        if msg.slot > state.next_slot:
+            return
+        self._repropose_single(state, msg.slot)
+        if msg.slot == state.next_slot:
+            state.next_slot = self._get_next_slot(
+                state.delegate_index, state.next_slot
+            )
+
+    def _handle_nack(self, msg: FprNack) -> None:
+        round, _ = self._round_info()
+        if msg.round <= round:
+            return
+        self._stop_timers(self.state)
+        self.start_phase1(
+            self.round_system.next_classic_round(self.index, msg.round),
+            self.pick_delegates(),
+        )
+
+
+# -- Client -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FprPending:
+    id: int
+    command: bytes
+    result: Promise
+    resend: object
+
+
+class FprClient(Actor):
+    """``fasterpaxos/Client.scala``: sends to a random delegate of the
+    round it believes current; RoundInfo refreshes round + delegates."""
+
+    def __init__(self, address, transport, logger,
+                 config: FasterPaxosConfig,
+                 resend_period: float = 10.0, seed: int = 0):
+        super().__init__(address, transport, logger)
+        config.check_valid()
+        self.config = config
+        self.rng = random.Random(seed)
+        self.resend_period = resend_period
+        self.address_bytes = transport.address_to_bytes(address)
+        self.round = 0
+        self.delegates: tuple = tuple(range(config.f + 1))
+        self.ids: Dict[int, int] = {}
+        self.pending: Dict[int, _FprPending] = {}
+
+    def _request(self, pseudonym: int, pending: _FprPending):
+        return FprClientRequest(
+            round=self.round,
+            command=FprCommand(
+                command_id=FprCommandId(
+                    client_address=self.address_bytes,
+                    client_pseudonym=pseudonym,
+                    client_id=pending.id,
+                ),
+                command=pending.command,
+            ),
+        )
+
+    def _send(self, pseudonym: int, pending: _FprPending) -> None:
+        delegate = self.delegates[self.rng.randrange(len(self.delegates))]
+        self.chan(self.config.server_addresses[delegate]).send(
+            self._request(pseudonym, pending)
+        )
+
+    def propose(self, pseudonym: int, command: bytes) -> Promise:
+        promise = Promise()
+        if pseudonym in self.pending:
+            promise.failure(RuntimeError(f"pseudonym {pseudonym} busy"))
+            return promise
+        id = self.ids.get(pseudonym, 0)
+        self.ids[pseudonym] = id + 1
+
+        def resend() -> None:
+            pending = self.pending.get(pseudonym)
+            if pending is not None:
+                # Broadcast: our round/delegate guess may be stale.
+                request = self._request(pseudonym, pending)
+                for a in self.config.server_addresses:
+                    self.chan(a).send(request)
+            timer.start()
+
+        timer = self.timer(f"resendFpr{pseudonym}", self.resend_period, resend)
+        timer.start()
+        pending = _FprPending(
+            id=id, command=command, result=promise, resend=timer
+        )
+        self.pending[pseudonym] = pending
+        self._send(pseudonym, pending)
+        return promise
+
+    def receive(self, src: Address, msg) -> None:
+        if isinstance(msg, FprClientReply):
+            pending = self.pending.get(msg.command_id.client_pseudonym)
+            if pending is None or msg.command_id.client_id != pending.id:
+                return
+            pending.resend.stop()
+            del self.pending[msg.command_id.client_pseudonym]
+            pending.result.success(msg.result)
+        elif isinstance(msg, FprRoundInfo):
+            if msg.round <= self.round:
+                return
+            self.round = msg.round
+            self.delegates = tuple(msg.delegates)
+            for pseudonym, pending in self.pending.items():
+                self._send(pseudonym, pending)
+                pending.resend.reset()
+        else:
+            self.logger.fatal(f"unknown fasterpaxos client message {msg!r}")

@@ -1,0 +1,343 @@
+"""Faster Paxos sim tests: delegate fast path without the leader,
+noop back-filling, noop-vs-command races, leader change on delegate
+death, hole recovery, and randomized safety."""
+
+import dataclasses
+
+import pytest
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.protocols import fasterpaxos as fpr
+from frankenpaxos_tpu.sim import (
+    SimulatedSystem,
+    mixed_command,
+    simulate_and_minimize,
+)
+from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+
+class Cluster:
+    def __init__(self, seed=0, f=1, num_clients=2, options=None):
+        self.transport = SimTransport(FakeLogger(LogLevel.FATAL))
+        t = self.transport
+        n = 2 * f + 1
+        self.config = fpr.FasterPaxosConfig(
+            f=f,
+            server_addresses=tuple(
+                SimAddress(f"server{i}") for i in range(n)
+            ),
+            heartbeat_addresses=tuple(
+                SimAddress(f"heartbeat{i}") for i in range(n)
+            ),
+        )
+        log = lambda: FakeLogger(LogLevel.FATAL)
+        self.servers = [
+            fpr.FprServer(a, t, log(), self.config, ReadableAppendLog(),
+                          options or fpr.FprServerOptions(), seed=seed + i)
+            for i, a in enumerate(self.config.server_addresses)
+        ]
+        self.clients = [
+            fpr.FprClient(SimAddress(f"client{i}"), t, log(), self.config,
+                          seed=seed + 50 + i)
+            for i in range(num_clients)
+        ]
+
+    def drain(self, max_steps=300000):
+        steps = 0
+        t = self.transport
+        while t.messages and steps < max_steps:
+            t.deliver_message(t.messages[0])
+            steps += 1
+        assert steps < max_steps
+
+    def pump(self, rounds=8, skip=lambda timer: False):
+        infra = set(self.config.heartbeat_addresses)
+        self.drain()
+        for _ in range(rounds):
+            for timer in list(self.transport.running_timers()):
+                if (
+                    timer.address not in infra
+                    and timer.name() != "leaderChange"
+                    and not skip(timer)
+                ):
+                    self.transport.trigger_timer(timer.address, timer.name())
+            self.drain()
+
+
+def test_fpr_single_command():
+    cluster = Cluster()
+    cluster.drain()  # round 0 phase 1 + Phase2aAny
+    p = cluster.clients[0].propose(0, b"hello")
+    cluster.drain()
+    assert p.done
+    for s in cluster.servers:
+        assert s.state_machine.log == [b"hello"]
+
+
+def test_fpr_delegate_commits_without_leader():
+    """A client command sent to a non-leader delegate commits with NO
+    message through the leader (the delegate proposes in its own slot)."""
+    cluster = Cluster(seed=3)
+    cluster.drain()
+    # Delegates in round 0 are servers {0, 1}; 0 is the leader. Pin the
+    # client to delegate 1.
+    class _Pick1:
+        def randrange(self, n):
+            return 1
+
+    cluster.clients[0].rng = _Pick1()
+    leader = cluster.config.server_addresses[0]
+    p = cluster.clients[0].propose(0, b"direct")
+    t = cluster.transport
+    leader_got_proposal_traffic = False
+    while t.messages:
+        m = t.messages[0]
+        decoded = wire.decode(m.data)
+        if m.dst == leader and isinstance(
+            decoded, (fpr.FprClientRequest, fpr.FprPhase2a)
+        ):
+            # The delegate DOES send the leader a Phase2a: the leader is
+            # also a delegate and must vote. What we check below is that
+            # the client never talked to the leader.
+            if isinstance(decoded, fpr.FprClientRequest):
+                leader_got_proposal_traffic = True
+        t.deliver_message(m)
+    assert p.done
+    assert not leader_got_proposal_traffic
+
+
+def test_fpr_interleaved_delegates_noop_fill():
+    """Two delegates own alternating slots; a command through one
+    delegate noop-fills the other's skipped slots so execution never
+    blocks."""
+    cluster = Cluster(seed=5)
+    cluster.drain()
+
+    class _Pick(int):
+        def randrange(self, n):
+            return int(self)
+
+    for i in range(6):
+        cluster.clients[0].rng = _Pick(i % 2)
+        p = cluster.clients[0].propose(i, f"c{i}".encode())
+        cluster.drain()
+        assert p.done, i
+    logs = {tuple(s.state_machine.log) for s in cluster.servers}
+    assert len(logs) == 1
+    assert sorted(next(iter(logs))) == [f"c{i}".encode() for i in range(6)]
+
+
+def test_fpr_noop_command_race_resolves_to_command():
+    """Delegate A noop-fills a slot owned by B at the same time B
+    proposes a command there: ack_noops_with_commands makes A adopt the
+    command, and the command (not the noop) is chosen."""
+    cluster = Cluster(seed=7)
+    cluster.drain()
+    t = cluster.transport
+
+    class _Pick(int):
+        def randrange(self, n):
+            return int(self)
+
+    # Client 0 -> delegate 1 (owns slot 1 in round 0's suffix); hold the
+    # messages. Client 1 -> delegate 0 proposes later, noop-filling.
+    cluster.clients[0].rng = _Pick(1)
+    cluster.clients[1].rng = _Pick(0)
+    p1 = cluster.clients[0].propose(0, b"cmd-b")
+    p2 = cluster.clients[1].propose(0, b"cmd-a")
+    # Random-ish interleaving via FIFO drain is enough: both proposals
+    # are in flight before any Phase2a lands.
+    cluster.pump(rounds=6)
+    assert p1.done and p2.done
+    logs = {tuple(s.state_machine.log) for s in cluster.servers}
+    assert len(logs) == 1
+    assert sorted(next(iter(logs))) == [b"cmd-a", b"cmd-b"]
+
+
+def test_fpr_leader_change_on_delegate_death():
+    """Killing a delegate and firing another server's leaderChange timer
+    moves the system to a new round with live delegates."""
+    cluster = Cluster(seed=9)
+    cluster.drain()
+    p = cluster.clients[0].propose(0, b"before")
+    cluster.drain()
+    assert p.done
+    # Server 1 (a delegate) dies.
+    dead = cluster.config.server_addresses[1]
+    cluster.transport.partition_actor(dead)
+    cluster.transport.partition_actor(cluster.config.heartbeat_addresses[1])
+    # Server 2 notices: mark the delegate dead in its heartbeat view and
+    # fire its leaderChange timer.
+    cluster.servers[2].heartbeat.alive.discard(
+        cluster.config.heartbeat_addresses[1]
+    )
+    cluster.servers[2].check_delegates_alive()
+    cluster.pump(rounds=8, skip=lambda tm: tm.address == dead)
+    server2 = cluster.servers[2]
+    round, delegates = server2._round_info()
+    assert round > 0
+    assert 1 not in delegates
+    p2 = cluster.clients[1].propose(0, b"after")
+    cluster.pump(rounds=8, skip=lambda tm: tm.address == dead)
+    assert p2.done
+    assert cluster.servers[2].state_machine.log[-1] == b"after"
+
+
+def test_fpr_client_round_catchup_via_round_info():
+    cluster = Cluster(seed=11)
+    cluster.drain()
+    # Move the system to a higher round.
+    cluster.servers[1].start_phase1(
+        cluster.servers[1].round_system.next_classic_round(1, 0),
+        (1, 2),
+    )
+    cluster.drain()
+    # A client stuck in round 0 proposes; servers answer RoundInfo and
+    # the client reroutes to the new delegates.
+    p = cluster.clients[0].propose(0, b"catchup")
+    cluster.pump(rounds=6)
+    assert p.done
+    assert cluster.clients[0].round > 0
+    assert set(cluster.clients[0].delegates) == {1, 2}
+
+
+def test_fpr_hole_recovery():
+    """A server whose Phase3a was lost recovers the chosen value from
+    the other servers via Recover."""
+    cluster = Cluster(seed=13)
+    cluster.drain()
+    t = cluster.transport
+    victim = cluster.config.server_addresses[2]
+    p = cluster.clients[0].propose(0, b"lost")
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == victim and isinstance(wire.decode(m.data), fpr.FprPhase3a):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert p.done
+    assert cluster.servers[2].state_machine.log == []
+    p2 = cluster.clients[0].propose(0, b"next")
+    cluster.pump(rounds=6)
+    assert p2.done
+    assert cluster.servers[2].state_machine.log == [b"lost", b"next"]
+
+
+def test_fpr_recover_on_voted_but_not_proposed_slot():
+    """Regression: a server can OWN a slot it only voted in (another
+    delegate noop-filled it). Recovery of that slot must re-propose a
+    noop over the existing pending entry, not crash on the proposer-path
+    assertion that the log is empty."""
+    cluster = Cluster(
+        seed=15, f=2,
+        options=fpr.FprServerOptions(use_f1_optimization=False),
+    )
+    cluster.drain()
+
+    class _P2:
+        def randrange(self, n):
+            return 2
+
+    cluster.clients[0].rng = _P2()
+    p = cluster.clients[0].propose(0, b"cmd")
+    t = cluster.transport
+    proposer = cluster.config.server_addresses[2]
+    while t.messages:
+        m = t.messages[0]
+        if m.dst == proposer and isinstance(
+            wire.decode(m.data), fpr.FprPhase2b
+        ):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert not p.done
+    # Server 0 voted for delegate 2's noop-fill at slot 0 without being
+    # its proposer.
+    assert cluster.servers[0].log.get(0)[0] == "pending"
+    assert 0 not in cluster.servers[0].state.pending_values
+    cluster.servers[0].receive(
+        cluster.config.server_addresses[1], fpr.FprRecover(slot=0)
+    )
+    cluster.pump(rounds=8)
+    assert p.done
+    logs = {tuple(s.state_machine.log) for s in cluster.servers}
+    assert logs == {(b"cmd",)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Propose:
+    client_index: int
+    pseudonym: int
+    value: str
+
+
+class SimulatedFpr(SimulatedSystem):
+    def __init__(self, f=1, ack_noops=True):
+        self.f = f
+        self.ack_noops = ack_noops
+
+    def new_system(self, seed):
+        cluster = Cluster(
+            seed=seed, f=self.f,
+            options=fpr.FprServerOptions(
+                ack_noops_with_commands=self.ack_noops,
+                use_f1_optimization=(self.f == 1),
+            ),
+        )
+        cluster.drain()
+        return cluster
+
+    def get_state(self, system):
+        return tuple(
+            tuple(s.state_machine.log) for s in system.servers
+        )
+
+    def generate_command(self, system, rng):
+        ops = []
+        for i, c in enumerate(system.clients):
+            for pseudonym in (0, 1):
+                if pseudonym not in c.pending:
+                    ops.append(
+                        (1, Propose(i, pseudonym, f"v{rng.randrange(100)}"))
+                    )
+        return mixed_command(rng, system.transport, ops)
+
+    def run_command(self, system, command):
+        if isinstance(command, Propose):
+            system.clients[command.client_index].propose(
+                command.pseudonym, command.value.encode()
+            )
+        else:
+            system.transport.run_command(command, record=False)
+        return system
+
+    def state_invariant(self, state):
+        for i in range(len(state)):
+            for j in range(i + 1, len(state)):
+                a, b = state[i], state[j]
+                shorter, longer = (a, b) if len(a) <= len(b) else (b, a)
+                if longer[: len(shorter)] != shorter:
+                    return f"server logs diverge: {a!r} vs {b!r}"
+        return None
+
+    def step_invariant(self, old, new):
+        for o, n in zip(old, new):
+            if n[: len(o)] != o:
+                return f"server log rewrote history: {o!r} -> {n!r}"
+        return None
+
+
+@pytest.mark.parametrize("f", [1, 2])
+def test_fpr_safety_randomized(f):
+    bad = simulate_and_minimize(
+        SimulatedFpr(f), run_length=150, num_runs=10, seed=f
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_fpr_safety_randomized_no_ack_noops():
+    bad = simulate_and_minimize(
+        SimulatedFpr(1, ack_noops=False), run_length=120, num_runs=5, seed=41
+    )
+    assert bad is None, f"\n{bad}"
