@@ -10,12 +10,21 @@ transitively depends on is committed. ``execute`` never returns a vertex
 twice; ``update_executed`` teaches the graph about externally executed
 vertices (e.g. from a snapshot).
 
-Implementations: :class:`TarjanDependencyGraph` — the reference's fast
-implementation (``TarjanDependencyGraph.scala:149-``, a Tarjan SCC variant
-with eligibility short-circuiting and blocker reporting). The reference's
-Jgrapht/ScalaGraph/Incremental/Zigzag variants exist for JVM-library
-comparison and GC-striping; here one canonical implementation plus the
-same test battery covers the capability.
+Implementations:
+
+  * :class:`TarjanDependencyGraph` — the reference's fast implementation
+    (``TarjanDependencyGraph.scala:149-``): Tarjan SCC with eligibility
+    short-circuiting and blocker reporting.
+  * :class:`ZigzagTarjanDependencyGraph` — the GC'd, leader-striped
+    variant (``ZigzagTarjanDependencyGraph.scala:135-``): vertices live
+    in per-leader BufferMaps, execution zigzags across the leaders'
+    watermark frontiers, and executed prefixes are compacted and
+    garbage collected — bounded memory for long-running deployments.
+  * :class:`NaiveDependencyGraph` — an oracle built from DIFFERENT
+    algorithms (Kosaraju SCC + Kahn toposort + BFS eligibility), the
+    analog of the reference's library-backed Jgrapht/ScalaGraph
+    implementations: slow but obviously correct, used to cross-check
+    the fast ones.
 """
 
 from __future__ import annotations
@@ -194,9 +203,322 @@ class TarjanDependencyGraph(DependencyGraph[Key, Seq]):
         components.append(component)
 
 
+class NaiveDependencyGraph(DependencyGraph[Key, Seq]):
+    """Obviously-correct oracle: BFS eligibility closure, Kosaraju SCC,
+    Kahn topological order of the condensation — deliberately different
+    algorithms from the Tarjan implementations so tests cross-check them
+    (the role of JgraphtDependencyGraph/ScalaGraphDependencyGraph)."""
+
+    def __init__(self) -> None:
+        self.vertices: Dict[Key, _Vertex] = {}
+        self.executed: Set[Key] = set()
+
+    def commit(self, key, sequence_number, dependencies) -> None:
+        if key in self.vertices or key in self.executed:
+            return
+        self.vertices[key] = _Vertex(key, sequence_number, set(dependencies))
+
+    def update_executed(self, keys) -> None:
+        self.executed |= set(keys)
+        for key in list(self.vertices):
+            if key in self.executed:
+                del self.vertices[key]
+
+    @property
+    def num_vertices(self) -> int:
+        return len(self.vertices)
+
+    def execute_by_component(self, num_blockers=None):
+        # 1. Eligibility: a vertex is INELIGIBLE iff it can reach an
+        #    uncommitted dependency. Find them by reverse BFS from the
+        #    uncommitted frontier.
+        blockers: Set[Key] = set()
+        reverse: Dict[Key, Set[Key]] = {}
+        for key, vertex in self.vertices.items():
+            for dep in vertex.dependencies:
+                if dep in self.executed:
+                    continue
+                if dep not in self.vertices:
+                    blockers.add(dep)
+                reverse.setdefault(dep, set()).add(key)
+        ineligible: Set[Key] = set()
+        frontier = list(blockers)
+        while frontier:
+            missing = frontier.pop()
+            for parent in reverse.get(missing, ()):
+                if parent not in ineligible:
+                    ineligible.add(parent)
+                    frontier.append(parent)
+        eligible = {
+            k for k in self.vertices if k not in ineligible
+        }
+
+        # 2. Kosaraju SCC on the eligible subgraph.
+        order: List[Key] = []
+        seen: Set[Key] = set()
+        for start in sorted(eligible):
+            if start in seen:
+                continue
+            stack = [(start, iter(self._deps(start, eligible)))]
+            seen.add(start)
+            while stack:
+                node, it = stack[-1]
+                for child in it:
+                    if child not in seen:
+                        seen.add(child)
+                        stack.append(
+                            (child, iter(self._deps(child, eligible)))
+                        )
+                        break
+                else:
+                    order.append(node)
+                    stack.pop()
+        reverse_eligible: Dict[Key, List[Key]] = {}
+        for key in eligible:
+            for dep in self._deps(key, eligible):
+                reverse_eligible.setdefault(dep, []).append(key)
+        component_of: Dict[Key, int] = {}
+        components: List[List[Key]] = []
+        for start in reversed(order):
+            if start in component_of:
+                continue
+            component = []
+            stack2 = [start]
+            component_of[start] = len(components)
+            while stack2:
+                node = stack2.pop()
+                component.append(node)
+                for parent in reverse_eligible.get(node, ()):
+                    if parent not in component_of:
+                        component_of[parent] = len(components)
+                        stack2.append(parent)
+            components.append(component)
+
+        # 3. Kahn toposort of the condensation: dependencies first.
+        edges: Dict[int, Set[int]] = {i: set() for i in range(len(components))}
+        indegree = [0] * len(components)
+        for key in eligible:
+            for dep in self._deps(key, eligible):
+                a, b = component_of[dep], component_of[key]
+                if a != b and b not in edges[a]:
+                    edges[a].add(b)
+                    indegree[b] += 1
+        ready = sorted(i for i in range(len(components)) if indegree[i] == 0)
+        ordered: List[List[Key]] = []
+        while ready:
+            i = ready.pop(0)
+            component = components[i]
+            component.sort(
+                key=lambda k: (self.vertices[k].sequence_number, k)
+            )
+            ordered.append(component)
+            for j in sorted(edges[i]):
+                indegree[j] -= 1
+                if indegree[j] == 0:
+                    ready.append(j)
+        for component in ordered:
+            for key in component:
+                del self.vertices[key]
+                self.executed.add(key)
+        return ordered, blockers
+
+    def _deps(self, key, eligible):
+        return sorted(
+            d for d in self.vertices[key].dependencies
+            if d in eligible and d not in self.executed
+        )
+
+
+class ZigzagTarjanDependencyGraph(DependencyGraph[tuple, Seq]):
+    """GC'd, leader-striped Tarjan (ZigzagTarjanDependencyGraph.scala):
+    keys are (leader_index, id) with ids contiguous per leader. Vertices
+    live in per-leader BufferMaps; execution walks the per-leader
+    watermark frontiers round-robin ("zigzag"), and executed prefixes
+    compact into per-leader IntPrefixSets whose watermarks drive
+    BufferMap garbage collection — memory stays bounded by the frontier,
+    not by history."""
+
+    def __init__(self, num_leaders: int, vertices_grow_size: int = 1000,
+                 garbage_collect_every_n_commands: int = 1000):
+        from frankenpaxos_tpu.compact import IntPrefixSet
+        from frankenpaxos_tpu.util import BufferMap
+
+        self.num_leaders = num_leaders
+        self.gc_every = garbage_collect_every_n_commands
+        self.vertices = [
+            BufferMap(vertices_grow_size) for _ in range(num_leaders)
+        ]
+        self.executed_watermark = [0] * num_leaders
+        self.executed = [IntPrefixSet() for _ in range(num_leaders)]
+        self._count = 0
+        self._since_gc = 0
+
+    def _get(self, key):
+        return self.vertices[key[0]].get(key[1])
+
+    def _executed_contains(self, key) -> bool:
+        return self.executed[key[0]].contains(key[1])
+
+    def _executed_add(self, key) -> None:
+        self.executed[key[0]].add(key[1])
+
+    def commit(self, key, sequence_number, dependencies) -> None:
+        if self._get(key) is not None or self._executed_contains(key):
+            return
+        self.vertices[key[0]].put(
+            key[1], _Vertex(key, sequence_number, set(dependencies))
+        )
+        self._count += 1
+
+    def update_executed(self, keys) -> None:
+        for key in keys:
+            if not self._executed_contains(key):
+                self._executed_add(key)
+                if self._get(key) is not None:
+                    # Evict the now-dead vertex (BufferMap treats a None
+                    # value as absent) and let GC reclaim the prefix.
+                    self.vertices[key[0]].put(key[1], None)
+                    self._count -= 1
+                    self._since_gc += 1
+
+    @property
+    def num_vertices(self) -> int:
+        return self._count
+
+    def execute_by_component(self, num_blockers=None):
+        metadatas: Dict[tuple, _Meta] = {}
+        stack: List[tuple] = []
+        components: List[List[tuple]] = []
+        blockers: Set[tuple] = set()
+
+        columns = list(range(self.num_leaders))
+        index = 0
+        while columns:
+            leader = columns[index]
+            key = (leader, self.executed_watermark[leader])
+            if self._execute_key(
+                key, metadatas, stack, components, blockers
+            ):
+                self.executed_watermark[leader] = max(
+                    self.executed_watermark[leader] + 1,
+                    self.executed[leader].watermark,
+                )
+                index += 1
+                if index >= len(columns):
+                    index = 0
+            else:
+                columns.pop(index)
+                if index >= len(columns):
+                    index = 0
+            if num_blockers is not None and len(blockers) >= num_blockers:
+                break
+
+        executed_count = sum(len(c) for c in components)
+        self._count -= executed_count
+        self._since_gc += executed_count
+        if self._since_gc >= self.gc_every:
+            for i in range(self.num_leaders):
+                self.vertices[i].garbage_collect(self.executed[i].watermark)
+            self._since_gc = 0
+        return components, blockers
+
+    def _execute_key(self, key, metadatas, stack, components,
+                     blockers) -> bool:
+        vertex = self._get(key)
+        if vertex is None:
+            if not self._executed_contains(key):
+                blockers.add(key)
+                return False
+            return True  # executed in an earlier invocation
+        if self._executed_contains(key):
+            return True
+        meta = metadatas.get(key)
+        if meta is not None:
+            return meta.eligible
+        meta = self._strong_connect(
+            key, vertex, metadatas, stack, components, blockers
+        )
+        if not meta.eligible:
+            # Abandon the stack: everything on it is ineligible this
+            # round (ZigzagTarjanDependencyGraph.scala:385-393).
+            for v in stack:
+                metadatas[v].eligible = False
+                metadatas[v].stack_index = -1
+            stack.clear()
+            return False
+        return True
+
+    def _strong_connect(self, root_key, root_vertex, metadatas, stack,
+                        components, blockers):
+        def open_frame(key, vertex):
+            meta = _Meta(number=len(metadatas), stack_index=len(stack))
+            metadatas[key] = meta
+            stack.append(key)
+            children = iter(sorted(
+                d for d in vertex.dependencies
+                if not self._executed_contains(d)
+            ))
+            return [key, children]
+
+        frames = [open_frame(root_key, root_vertex)]
+        while frames:
+            key, children = frames[-1]
+            meta = metadatas[key]
+            advanced = False
+            failed = False
+            for w in children:
+                wertex = self._get(w)
+                if wertex is None:
+                    meta.eligible = False
+                    meta.stack_index = -1
+                    blockers.add(w)
+                    failed = True
+                    break
+                wm = metadatas.get(w)
+                if wm is None:
+                    frames.append(open_frame(w, wertex))
+                    advanced = True
+                    break
+                if not wm.eligible:
+                    meta.eligible = False
+                    meta.stack_index = -1
+                    failed = True
+                    break
+                if wm.stack_index != -1:
+                    meta.low_link = min(meta.low_link, wm.number)
+            else:
+                frames.pop()
+                if meta.low_link == meta.number and meta.stack_index != -1:
+                    component = stack[meta.stack_index:]
+                    del stack[meta.stack_index:]
+                    for w in component:
+                        metadatas[w].stack_index = -1
+                        self._executed_add(w)
+                    if len(component) > 1:
+                        component.sort(key=lambda k: (
+                            self._get(k).sequence_number, k
+                        ))
+                    components.append(component)
+                if frames:
+                    parent = metadatas[frames[-1][0]]
+                    parent.low_link = min(parent.low_link, meta.low_link)
+                continue
+            if advanced:
+                continue
+            if failed:
+                frames.pop()
+                while frames:
+                    k2, _ = frames.pop()
+                    m2 = metadatas[k2]
+                    m2.eligible = False
+                    m2.stack_index = -1
+        return metadatas[root_key]
+
+
 # Registry mirroring DependencyGraph.scala's DependencyGraphType.
 REGISTRY = {
     "Tarjan": TarjanDependencyGraph,
+    "Naive": NaiveDependencyGraph,
 }
 
 
