@@ -25,7 +25,11 @@ from frankenpaxos_tpu.core.promise import Promise
 from frankenpaxos_tpu.clienttable import ClientTable, Executed, NotExecuted
 from frankenpaxos_tpu.depgraph import TarjanDependencyGraph
 from frankenpaxos_tpu.statemachine import StateMachine
-from frankenpaxos_tpu.util import popular_items, random_duration
+from frankenpaxos_tpu.util import (
+    TupleVertexIdLike,
+    popular_items,
+    random_duration,
+)
 
 # Instances are (replica_index, instance_number) tuples; ballots are
 # (ordering, replica_index) tuples ordered lexicographically; NULL_BALLOT
@@ -168,6 +172,17 @@ class EPaxosReplicaOptions:
     execute_graph_batch_size: int = 1
     execute_graph_timer_period: float = 1.0  # flushes partial batches
     unsafe_skip_graph_execution: bool = False
+    # When set, dependency sets are PREFIX-SHAPED: the top-k conflict
+    # index tracks each replica column's newest conflicting instance,
+    # and the dependency set is the whole column prefix up to that
+    # frontier (the reference expands top-k the same way via
+    # InstancePrefixSet.fromTopOne/fromTopK, Replica.scala:578-589 —
+    # raw frontier ids alone would be UNSAFE: a multi-key command can
+    # conflict with two mutually non-conflicting instances in one
+    # column, and only the newer would make it into the dep set).
+    # Prefix-shaped sets trade extra (harmless) ordering edges for
+    # O(columns) compressibility.
+    top_k_dependencies: int = 0  # 0 = exact conflict sets
 
 
 @dataclasses.dataclass
@@ -248,7 +263,14 @@ class EpReplica(Actor):
         self.largest_ballot = (0, self.index)
         self.dependency_graph = TarjanDependencyGraph()
         self.client_table: ClientTable = ClientTable()
-        self.conflict_index = state_machine.conflict_index()
+        if options.top_k_dependencies > 0:
+            self.conflict_index = state_machine.top_k_conflict_index(
+                options.top_k_dependencies,
+                len(config.replica_addresses),
+                TupleVertexIdLike(),
+            )
+        else:
+            self.conflict_index = state_machine.conflict_index()
         self.leader_states: Dict[tuple, object] = {}
         self.recover_timers: Dict[tuple, object] = {}
         self._pending_committed = 0
@@ -291,7 +313,17 @@ class EpReplica(Actor):
         in-component order makes seq numbers unnecessary)."""
         if command is None:
             return 0, frozenset()
-        deps = set(self.conflict_index.get_conflicts(command.command))
+        if self.options.top_k_dependencies > 0:
+            # Expand each column's conflict frontier into the full prefix
+            # (see EPaxosReplicaOptions.top_k_dependencies).
+            tops = self.conflict_index.get_top_k_conflicts(command.command)
+            deps = {
+                (col, id)
+                for col, ids in enumerate(tops)
+                for id in range(max(ids, default=-1) + 1)
+            }
+        else:
+            deps = set(self.conflict_index.get_conflicts(command.command))
         deps.discard(instance)
         return 0, frozenset(deps)
 

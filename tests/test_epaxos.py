@@ -30,7 +30,8 @@ class RecordingKv(KeyValueStore):
         return super().run(input)
 
 
-def make(f=1, num_clients=2, seed=0):
+def make(f=1, num_clients=2, seed=0,
+         options=ep.EPaxosReplicaOptions()):
     t = SimTransport(FakeLogger(LogLevel.FATAL))
     config = ep.EPaxosConfig(
         f=f,
@@ -40,7 +41,8 @@ def make(f=1, num_clients=2, seed=0):
     )
     log = lambda: FakeLogger(LogLevel.FATAL)
     replicas = [
-        ep.EpReplica(a, t, log(), config, RecordingKv(), seed=seed + i)
+        ep.EpReplica(a, t, log(), config, RecordingKv(), options,
+                     seed=seed + i)
         for i, a in enumerate(config.replica_addresses)
     ]
     clients = [
@@ -141,12 +143,15 @@ class Propose:
 
 
 class SimulatedEPaxos(SimulatedSystem):
-    def __init__(self, f=1):
+    def __init__(self, f=1, top_k=0):
         self.f = f
+        self.top_k = top_k
         self._kv = KeyValueStore()
 
     def new_system(self, seed):
-        return make(self.f, seed=seed)
+        return make(self.f, seed=seed, options=ep.EPaxosReplicaOptions(
+            top_k_dependencies=self.top_k
+        ))
 
     def get_state(self, system):
         t, config, replicas, clients = system
@@ -160,8 +165,12 @@ class SimulatedEPaxos(SimulatedSystem):
         for i, c in enumerate(clients):
             for pseudonym in (0, 1):
                 if pseudonym not in c.pending:
+                    # Single- AND multi-key commands: multi-key writes
+                    # conflict with instances that don't conflict with
+                    # each other, the case that breaks naive top-k deps.
+                    keys = "k0" if rng.random() < 0.5 else "k0,k1"
                     ops.append(
-                        (1, Propose(i, pseudonym, f"k{rng.randrange(2)}",
+                        (1, Propose(i, pseudonym, keys,
                                     f"v{rng.randrange(50)}"))
                     )
         return mixed_command(rng, t, ops)
@@ -170,7 +179,8 @@ class SimulatedEPaxos(SimulatedSystem):
         t, config, replicas, clients = system
         if isinstance(command, Propose):
             clients[command.client_index].propose(
-                command.pseudonym, kv_set((command.key, command.value))
+                command.pseudonym,
+                kv_set(*[(k, command.value) for k in command.key.split(",")]),
             )
         else:
             t.run_command(command, record=False)
@@ -196,6 +206,41 @@ def test_epaxos_safety_randomized(f):
         SimulatedEPaxos(f), run_length=120, num_runs=10, seed=f
     )
     assert bad is None, f"\n{bad}"
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_epaxos_safety_randomized_top_k_dependencies(top_k):
+    """Top-k dependency compression (only the k most recent conflicting
+    instances per replica column) preserves execution-order agreement:
+    the dropped older conflicts are transitively covered by the newer
+    ones."""
+    bad = simulate_and_minimize(
+        SimulatedEPaxos(1, top_k=top_k), run_length=150, num_runs=10,
+        seed=60 + top_k,
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_epaxos_top_k_deps_are_prefix_shaped():
+    """With top_k=1, dependency sets are contiguous per-column prefixes
+    (compressible to one watermark per replica) and cover EVERY
+    conflicting instance, not just the newest per column."""
+    t, config, replicas, clients = make(
+        seed=71, options=ep.EPaxosReplicaOptions(top_k_dependencies=1)
+    )
+    for i in range(12):
+        p = clients[i % 2].propose(i // 2, kv_set(("hot", f"v{i}")))
+        drain(t)
+        assert p.done
+    _, deps = replicas[0]._compute_seq_deps(
+        (0, 999), ep.EpCommand(b"x", 0, 0, kv_set(("hot", "probe")))
+    )
+    assert deps
+    by_col = {}
+    for col, id in deps:
+        by_col.setdefault(col, set()).add(id)
+    for col, ids in by_col.items():
+        assert ids == set(range(max(ids) + 1)), (col, sorted(ids))
 
 
 def test_epaxos_recovery_after_leader_failure():
