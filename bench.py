@@ -5,22 +5,44 @@ entries/sec @ 10k replicas; p50 commit latency (sim ticks)").
 
 Prints exactly ONE JSON line to stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Robustness contract (the driver records this script's stdout verbatim):
+the orchestrating process imports no jax. It probes the TPU backend in a
+subprocess with a hard timeout; if the probe fails or the TPU run dies, it
+re-runs the measurement on the CPU backend in a clean environment (the
+sitecustomize gated on PALLAS_AXON_POOL_IPS would otherwise import the TPU
+plugin at interpreter start). Every path ends in a one-line JSON on stdout
+and exit code 0, with an honest "device" field.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
-import time
 
-import jax
-
-from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
-
+_REPO = os.path.dirname(os.path.abspath(__file__))
 TARGET = 1_000_000.0  # committed entries/sec (BASELINE.json north star)
+METRIC = "committed log entries/sec @ 10k simulated MultiPaxos acceptors"
+UNIT = "entries/sec"
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "x = jnp.ones((256, 256), jnp.float32); "
+    "jax.block_until_ready(x @ x); "
+    "print('PROBE_OK', jax.devices()[0].platform)"
+)
 
 
-def main() -> None:
+def _inner_main() -> None:
+    """The actual measurement; runs in a subprocess with jax importable."""
+    import time
+
+    import jax
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
     # 3334 groups x 3 acceptors = 10,002 simulated acceptors (f=1).
     cfg = BatchedMultiPaxosConfig(
         f=1,
@@ -60,9 +82,9 @@ def main() -> None:
     throughput = committed / elapsed
     ticks = segments * ticks_per_segment
     result = {
-        "metric": "committed log entries/sec @ 10k simulated MultiPaxos acceptors",
+        "metric": METRIC,
         "value": round(throughput, 1),
-        "unit": "entries/sec",
+        "unit": UNIT,
         "vs_baseline": round(throughput / TARGET, 3),
         "p50_commit_latency_ticks": stats["commit_latency_p50_ticks"],
         "num_acceptors": cfg.num_acceptors,
@@ -71,8 +93,104 @@ def main() -> None:
         "wall_seconds": round(elapsed, 3),
         "device": str(jax.devices()[0]),
     }
+    print("BENCH_JSON " + json.dumps(result))
+
+
+def _cpu_env() -> dict:
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _probe_tpu(timeout: float = 60.0) -> bool:
+    """True iff the ambient (TPU) backend can run a tiny matmul in time."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            env=_tpu_env(),
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode != 0:
+        return False
+    # The accelerator platform on this box registers as "axon", not "tpu";
+    # accept any non-CPU platform so a healthy tunnel is actually used.
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE_OK "):
+            return line.split()[1].lower() not in ("cpu", "")
+    return False
+
+
+def _run_inner(env: dict, timeout: float):
+    """Run the measurement subprocess; return (result dict | None, note)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=env,
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout:.0f}s"
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_JSON "):
+            try:
+                return json.loads(line[len("BENCH_JSON "):]), ""
+            except json.JSONDecodeError:
+                break
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
+
+
+def main() -> None:
+    notes = []
+    result = None
+
+    if _probe_tpu():
+        result, note = _run_inner(_tpu_env(), timeout=900.0)
+        if result is None:
+            notes.append(f"tpu run failed ({note})")
+    else:
+        notes.append("tpu probe failed or timed out; falling back to cpu")
+
+    if result is None:
+        result, note = _run_inner(_cpu_env(), timeout=900.0)
+        if result is None:
+            notes.append(f"cpu run failed ({note})")
+
+    if result is None:
+        result = {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "device": "none",
+        }
+    if notes:
+        result["notes"] = "; ".join(notes)
     print(json.dumps(result))
+    sys.exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        _inner_main()
+    else:
+        main()

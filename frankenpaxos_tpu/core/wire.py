@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import zlib
 from typing import Any, Dict, Tuple, Type
 
 # Type tags.
@@ -38,7 +39,7 @@ _BYTES = 6  # u32 length + raw
 _LIST = 7  # u32 count + items
 _TUPLE = 8  # u32 count + items
 _DICT = 9  # u32 count + alternating key/value
-_MSG = 10  # u16 registry id + u32 field count + field values in order
+_MSG = 10  # u16 registry id + u16 name hash + u32 field count + field values
 _BIGINT = 11  # u32 length + signed big-endian bytes (ints beyond 64 bits)
 _FROZENSET = 12  # u32 count + items (sorted for determinism)
 
@@ -57,16 +58,17 @@ def message(cls: Type[Any]) -> Type[Any]:
     # Stable ids: assigned in registration order. All processes must import
     # protocol modules in the same order; registration happens at module
     # import, and modules register messages top-to-bottom, so any two
-    # processes importing the same protocol module agree. Cross-protocol
-    # traffic never mixes, so global order differences are harmless as long
-    # as the per-module order matches — nevertheless we key decode by id AND
-    # verify the name on the handshake-free path via a name hash.
+    # processes importing the same protocol module agree. Because two
+    # processes with different import sets could still map the same id to
+    # different classes, every _MSG header also carries a 16-bit hash of the
+    # qualified class name, verified on decode.
     msg_id = len(_registry_by_id)
     _registry_by_name[name] = cls
     _registry_by_id[msg_id] = cls
     _ids_by_type[cls] = msg_id
     cls.__wire_name__ = name
     cls.__wire_id__ = msg_id
+    cls.__wire_hash__ = zlib.crc32(name.encode("utf-8")) & 0xFFFF
     cls.__wire_fields__ = tuple(f.name for f in dataclasses.fields(cls))
     return cls
 
@@ -101,7 +103,12 @@ def _encode_value(value: Any, out: bytearray) -> None:
         out += value
     elif type(value) in _ids_by_type:
         out.append(_MSG)
-        out += struct.pack(">HI", _ids_by_type[type(value)], len(value.__wire_fields__))
+        out += struct.pack(
+            ">HHI",
+            _ids_by_type[type(value)],
+            value.__wire_hash__,
+            len(value.__wire_fields__),
+        )
         for fname in value.__wire_fields__:
             _encode_value(getattr(value, fname), out)
     elif isinstance(value, list):
@@ -176,11 +183,18 @@ def _decode_value(data: bytes, pos: int) -> Tuple[Any, int]:
             d[k] = v
         return d, pos
     if tag == _MSG:
-        msg_id, nfields = struct.unpack_from(">HI", data, pos)
-        pos += 6
+        msg_id, name_hash, nfields = struct.unpack_from(">HHI", data, pos)
+        pos += 8
         cls = _registry_by_id.get(msg_id)
         if cls is None:
             raise ValueError(f"unknown wire message id {msg_id}")
+        if name_hash != cls.__wire_hash__:
+            raise ValueError(
+                f"wire name-hash mismatch for id {msg_id}: local class "
+                f"{cls.__wire_name__} (hash {cls.__wire_hash__:#06x}) vs "
+                f"wire hash {name_hash:#06x}; the peer registered a "
+                f"different message under this id (import-order skew?)"
+            )
         if nfields != len(cls.__wire_fields__):
             raise ValueError(
                 f"field count mismatch for {cls.__wire_name__}: "

@@ -17,8 +17,9 @@ non-leader replicas in the default ballot, else restarts PreAccept
 from __future__ import annotations
 
 import dataclasses
+import functools
 import random
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple, Union
 
 from frankenpaxos_tpu.core import Actor, Address, Logger, Transport, wire
 from frankenpaxos_tpu.core.promise import Promise
@@ -34,7 +35,10 @@ from frankenpaxos_tpu.util import (
 # Instances are (replica_index, instance_number) tuples; ballots are
 # (ordering, replica_index) tuples ordered lexicographically; NULL_BALLOT
 # sorts below every real ballot. Dependencies travel as sorted tuples of
-# instances and are handled as frozensets internally.
+# instances (exact mode) or as compact EpPrefixDeps watermark vectors
+# (top_k_dependencies mode) and are handled via the _deps_* helpers
+# internally; they materialize into explicit instance sets only at the
+# dependency-graph boundary.
 NULL_BALLOT = (-1, -1)
 
 NOT_SEEN, PRE_ACCEPTED, ACCEPTED, COMMITTED = range(4)
@@ -53,6 +57,82 @@ class EpCommand:
 @dataclasses.dataclass(frozen=True)
 class EpClientRequest:
     command: EpCommand
+
+
+@wire.message
+@dataclasses.dataclass(frozen=True)
+class EpPrefixDeps:
+    """A prefix-shaped dependency set compressed to per-column watermarks:
+    {(col, i) : i < watermarks[col]} minus the optional ``exclude``
+    instance (the instance whose dependencies these are, when it falls
+    inside its own column's prefix). O(replicas) in state and on the wire
+    regardless of instance history — the analog of the reference's
+    InstancePrefixSet (epaxos/InstancePrefixSet.scala,
+    Replica.scala:578-589)."""
+
+    watermarks: tuple
+    exclude: Optional[tuple]
+
+
+Deps = Union[FrozenSet[tuple], EpPrefixDeps]
+
+
+def _normalize_prefix_deps(watermarks: List[int], exclude) -> EpPrefixDeps:
+    """Canonicalize so that equal sets compare equal on the fast path: an
+    exclusion outside the prefix is dropped, and one at the very top of
+    its column is folded into the watermark."""
+    if exclude is not None:
+        col, i = exclude
+        if col >= len(watermarks) or i >= watermarks[col]:
+            exclude = None
+        elif i == watermarks[col] - 1:
+            watermarks = list(watermarks)
+            watermarks[col] = i
+            exclude = None
+    return EpPrefixDeps(watermarks=tuple(watermarks), exclude=exclude)
+
+
+def _deps_union(a: Deps, b: Deps) -> Deps:
+    if isinstance(a, EpPrefixDeps) and isinstance(b, EpPrefixDeps):
+        wa, wb = a.watermarks, b.watermarks
+        n = max(len(wa), len(wb))
+        wa = wa + (0,) * (n - len(wa))
+        wb = wb + (0,) * (n - len(wb))
+        # Both sides describe the deps of the same instance, so when the
+        # instance lies inside either prefix that side excluded it; union
+        # therefore excludes it too.
+        return _normalize_prefix_deps(
+            [max(x, y) for x, y in zip(wa, wb)], a.exclude or b.exclude
+        )
+    if isinstance(a, frozenset) and isinstance(b, frozenset):
+        return a | b
+    # Mixed representations (heterogeneously configured cluster): fall
+    # back to an exact set.
+    return frozenset(_deps_materialize(a)) | frozenset(_deps_materialize(b))
+
+
+def _deps_materialize(deps: Deps) -> set:
+    """Expand to an explicit set of instances (dependency-graph boundary)."""
+    if isinstance(deps, EpPrefixDeps):
+        out = {
+            (col, i) for col, w in enumerate(deps.watermarks) for i in range(w)
+        }
+        out.discard(deps.exclude)
+        return out
+    return set(deps)
+
+
+def _deps_wire(deps: Deps):
+    """Wire form: compact message in top-k mode, sorted tuple otherwise."""
+    if isinstance(deps, EpPrefixDeps):
+        return deps
+    return tuple(sorted(deps))
+
+
+def _deps_from_wire(w) -> Deps:
+    if isinstance(w, EpPrefixDeps):
+        return w
+    return frozenset(w)
 
 
 @wire.message
@@ -312,18 +392,20 @@ class EpReplica(Actor):
         reference also returns sequence number 0: Tarjan's deterministic
         in-component order makes seq numbers unnecessary)."""
         if command is None:
+            if self.options.top_k_dependencies > 0:
+                return 0, _normalize_prefix_deps([0] * self.config.n, None)
             return 0, frozenset()
         if self.options.top_k_dependencies > 0:
-            # Expand each column's conflict frontier into the full prefix
-            # (see EPaxosReplicaOptions.top_k_dependencies).
+            # Keep deps compact: each column's conflict frontier IS the
+            # dependency set (the whole prefix up to it), so state and
+            # wire carry only the O(columns) watermark vector (see
+            # EPaxosReplicaOptions.top_k_dependencies).
             tops = self.conflict_index.get_top_k_conflicts(command.command)
-            deps = {
-                (col, id)
-                for col, ids in enumerate(tops)
-                for id in range(max(ids, default=-1) + 1)
-            }
-        else:
-            deps = set(self.conflict_index.get_conflicts(command.command))
+            watermarks = [max(ids, default=-1) + 1 for ids in tops]
+            while len(watermarks) < self.config.n:
+                watermarks.append(0)
+            return 0, _normalize_prefix_deps(watermarks, instance)
+        deps = set(self.conflict_index.get_conflicts(command.command))
         deps.discard(instance)
         return 0, frozenset(deps)
 
@@ -373,7 +455,7 @@ class EpReplica(Actor):
         self._update_conflict_index(instance, command)
         pre_accept = EpPreAccept(
             instance=instance, ballot=ballot, command=command,
-            sequence_number=seq, dependencies=tuple(sorted(deps)),
+            sequence_number=seq, dependencies=_deps_wire(deps),
         )
         for a in self._thrifty_others(self.config.fast_quorum_size - 1):
             self.chan(a).send(pre_accept)
@@ -385,7 +467,7 @@ class EpReplica(Actor):
                 self.index: EpPreAcceptOk(
                     instance=instance, ballot=ballot,
                     replica_index=self.index, sequence_number=seq,
-                    dependencies=tuple(sorted(deps)),
+                    dependencies=_deps_wire(deps),
                 )
             },
             avoid_fast_path=avoid_fast_path,
@@ -406,7 +488,7 @@ class EpReplica(Actor):
         accept = EpAccept(
             instance=instance, ballot=ballot, command=triple.command,
             sequence_number=triple.sequence_number,
-            dependencies=tuple(sorted(triple.dependencies)),
+            dependencies=_deps_wire(triple.dependencies),
         )
         for a in self._thrifty_others(self.config.slow_quorum_size - 1):
             self.chan(a).send(accept)
@@ -450,8 +532,9 @@ class EpReplica(Actor):
 
     def _pre_accepting_slow_path(self, instance, state: _PreAccepting) -> None:
         seq = max(ok.sequence_number for ok in state.responses.values())
-        deps = frozenset(
-            d for ok in state.responses.values() for d in ok.dependencies
+        deps = functools.reduce(
+            _deps_union,
+            (_deps_from_wire(ok.dependencies) for ok in state.responses.values()),
         )
         self._transition_to_accept(
             instance, state.ballot, _Triple(state.command, seq, deps)
@@ -466,7 +549,7 @@ class EpReplica(Actor):
             commit = EpCommit(
                 instance=instance, command=triple.command,
                 sequence_number=triple.sequence_number,
-                dependencies=tuple(sorted(triple.dependencies)),
+                dependencies=_deps_wire(triple.dependencies),
             )
             for a in self.other_addresses:
                 self.chan(a).send(commit)
@@ -477,7 +560,7 @@ class EpReplica(Actor):
             self._execute_command(instance, triple.command)
             return
         self.dependency_graph.commit(
-            instance, triple.sequence_number, set(triple.dependencies)
+            instance, triple.sequence_number, _deps_materialize(triple.dependencies)
         )
         self._pending_committed += 1
         if self._pending_committed % self.options.execute_graph_batch_size == 0:
@@ -598,7 +681,7 @@ class EpReplica(Actor):
                         instance=msg.instance, ballot=msg.ballot,
                         replica_index=self.index,
                         sequence_number=entry.triple.sequence_number,
-                        dependencies=tuple(sorted(entry.triple.dependencies)),
+                        dependencies=_deps_wire(entry.triple.dependencies),
                     )
                 )
                 return
@@ -613,7 +696,7 @@ class EpReplica(Actor):
                 EpCommit(
                     instance=msg.instance, command=entry.triple.command,
                     sequence_number=entry.triple.sequence_number,
-                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                    dependencies=_deps_wire(entry.triple.dependencies),
                 )
             )
             return
@@ -629,7 +712,7 @@ class EpReplica(Actor):
 
         seq, deps = self._compute_seq_deps(msg.instance, msg.command)
         seq = max(seq, msg.sequence_number)
-        deps = frozenset(deps | set(msg.dependencies))
+        deps = _deps_union(deps, _deps_from_wire(msg.dependencies))
         self.cmd_log[msg.instance] = _PreAcceptedEntry(
             ballot=msg.ballot, vote_ballot=msg.ballot,
             triple=_Triple(msg.command, seq, deps),
@@ -639,7 +722,7 @@ class EpReplica(Actor):
             EpPreAcceptOk(
                 instance=msg.instance, ballot=msg.ballot,
                 replica_index=self.index, sequence_number=seq,
-                dependencies=tuple(sorted(deps)),
+                dependencies=_deps_wire(deps),
             )
         )
 
@@ -685,7 +768,7 @@ class EpReplica(Actor):
                 seq, deps = next(iter(candidates))
                 self._commit(
                     msg.instance,
-                    _Triple(state.command, seq, frozenset(deps)),
+                    _Triple(state.command, seq, _deps_from_wire(deps)),
                     inform_others=True,
                 )
             else:
@@ -715,7 +798,7 @@ class EpReplica(Actor):
                 EpCommit(
                     instance=msg.instance, command=entry.triple.command,
                     sequence_number=entry.triple.sequence_number,
-                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                    dependencies=_deps_wire(entry.triple.dependencies),
                 )
             )
             return
@@ -730,7 +813,7 @@ class EpReplica(Actor):
         self.cmd_log[msg.instance] = _AcceptedEntry(
             ballot=msg.ballot, vote_ballot=msg.ballot,
             triple=_Triple(
-                msg.command, msg.sequence_number, frozenset(msg.dependencies)
+                msg.command, msg.sequence_number, _deps_from_wire(msg.dependencies)
             ),
         )
         self._update_conflict_index(msg.instance, msg.command)
@@ -758,7 +841,9 @@ class EpReplica(Actor):
             return
         self._commit(
             msg.instance,
-            _Triple(msg.command, msg.sequence_number, frozenset(msg.dependencies)),
+            _Triple(
+                msg.command, msg.sequence_number, _deps_from_wire(msg.dependencies)
+            ),
             inform_others=False,
         )
 
@@ -816,7 +901,7 @@ class EpReplica(Actor):
                     replica_index=self.index, vote_ballot=entry.vote_ballot,
                     status=status, command=entry.triple.command,
                     sequence_number=entry.triple.sequence_number,
-                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                    dependencies=_deps_wire(entry.triple.dependencies),
                 )
             )
             entry.ballot = msg.ballot
@@ -825,7 +910,7 @@ class EpReplica(Actor):
                 EpCommit(
                     instance=msg.instance, command=entry.triple.command,
                     sequence_number=entry.triple.sequence_number,
-                    dependencies=tuple(sorted(entry.triple.dependencies)),
+                    dependencies=_deps_wire(entry.triple.dependencies),
                 )
             )
 
@@ -849,7 +934,7 @@ class EpReplica(Actor):
                 msg.instance, state.ballot,
                 _Triple(
                     accepted.command, accepted.sequence_number,
-                    frozenset(accepted.dependencies),
+                    _deps_from_wire(accepted.dependencies),
                 ),
             )
             return
@@ -872,7 +957,7 @@ class EpReplica(Actor):
             command, seq, deps = next(iter(candidates))
             self._transition_to_accept(
                 msg.instance, state.ballot,
-                _Triple(command, seq, frozenset(deps)),
+                _Triple(command, seq, _deps_from_wire(deps)),
             )
             return
         pre_accepted = next(

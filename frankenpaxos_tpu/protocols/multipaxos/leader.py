@@ -361,5 +361,8 @@ class Leader(Actor):
         if self.state == _INACTIVE:
             self.round = msg.round
         else:
-            self.round = self.round_system.next_classic_round(self.index, msg.round)
+            # Fast-forward to the nacked round; leader_change performs the
+            # single next_classic_round bump (Leader.scala:672-697 applies
+            # it once via leaderChange(nack.round)).
+            self.round = msg.round
             self.leader_change(is_new_leader=True)

@@ -221,6 +221,35 @@ def test_epaxos_safety_randomized_top_k_dependencies(top_k):
     assert bad is None, f"\n{bad}"
 
 
+def test_epaxos_prefix_deps_algebra():
+    """EpPrefixDeps union/normalize agree with materialized-set semantics,
+    and equal sets have equal canonical forms (fast-path equality)."""
+    import itertools
+    import random as _random
+
+    rng = _random.Random(7)
+    instance = (1, 2)
+    for _ in range(200):
+        wm_a = [rng.randrange(0, 5) for _ in range(3)]
+        wm_b = [rng.randrange(0, 5) for _ in range(3)]
+        a = ep._normalize_prefix_deps(
+            list(wm_a), instance if instance[1] < wm_a[instance[0]] else None
+        )
+        b = ep._normalize_prefix_deps(
+            list(wm_b), instance if instance[1] < wm_b[instance[0]] else None
+        )
+        u = ep._deps_union(a, b)
+        assert isinstance(u, ep.EpPrefixDeps)
+        assert ep._deps_materialize(u) == (
+            ep._deps_materialize(a) | ep._deps_materialize(b)
+        )
+        assert instance not in ep._deps_materialize(u)
+    # Canonicalization: top-of-column exclusion folds into the watermark.
+    folded = ep._normalize_prefix_deps([3, 0, 0], (0, 2))
+    plain = ep._normalize_prefix_deps([2, 0, 0], None)
+    assert folded == plain
+
+
 def test_epaxos_top_k_deps_are_prefix_shaped():
     """With top_k=1, dependency sets are contiguous per-column prefixes
     (compressible to one watermark per replica) and cover EVERY
@@ -235,9 +264,14 @@ def test_epaxos_top_k_deps_are_prefix_shaped():
     _, deps = replicas[0]._compute_seq_deps(
         (0, 999), ep.EpCommand(b"x", 0, 0, kv_set(("hot", "probe")))
     )
-    assert deps
+    # State/wire form is the compact O(columns) watermark vector, not a
+    # materialized set (ADVICE r1: deps must not be O(instance history)).
+    assert isinstance(deps, ep.EpPrefixDeps)
+    assert len(deps.watermarks) == config.n
+    materialized = ep._deps_materialize(deps)
+    assert materialized
     by_col = {}
-    for col, id in deps:
+    for col, id in materialized:
         by_col.setdefault(col, set()).add(id)
     for col, ids in by_col.items():
         assert ids == set(range(max(ids) + 1)), (col, sorted(ids))
