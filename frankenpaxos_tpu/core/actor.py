@@ -6,8 +6,9 @@ or raw ``send``/``send_no_flush``/``flush``; timers via ``timer``.
 
 Protocol roles subclass this for the Python execution backends (sim + TCP).
 The TPU backend does not use this class: there, roles are pure step
-functions over batched array state (see ``frankenpaxos_tpu.tpu``), and the
-sim tests cross-validate the two.
+functions over batched array state (see ``frankenpaxos_tpu.tpu``);
+``tests/test_tpu_cross_validation.py`` checks that the two produce the
+same per-slot chosen values on aligned scenarios.
 """
 
 from __future__ import annotations

@@ -45,6 +45,13 @@ EMPTY = 0
 PROPOSED = 1
 CHOSEN = 2
 
+# Value ids. Real values are >= 0 (the global command sequence number
+# ``slot * G + group``); NOOP_VALUE marks a slot repaired to a noop by a
+# new leader (Leader.scala:314-329 safeValue returns Noop when no acceptor
+# voted); NO_VALUE marks unset.
+NO_VALUE = -1
+NOOP_VALUE = -2
+
 LAT_BINS = 64  # histogram bins for commit latency (in ticks)
 
 
@@ -61,6 +68,9 @@ class BatchedMultiPaxosConfig:
     drop_rate: float = 0.0  # per-message Bernoulli loss
     retry_timeout: int = 16  # re-send Phase2a to the FULL group after this
     thrifty: bool = True  # send Phase2a to f+1 random acceptors, else all
+    # Closed workload: stop proposing once each group has allocated this
+    # many slots (None = open workload, propose forever).
+    max_slots_per_group: Optional[int] = None
 
     @property
     def group_size(self) -> int:
@@ -90,10 +100,12 @@ class BatchedMultiPaxosState:
 
     # Ring slots.
     status: jnp.ndarray  # [G, W] EMPTY | PROPOSED | CHOSEN
+    slot_value: jnp.ndarray  # [G, W] value proposed for the slot (NO_VALUE)
     propose_tick: jnp.ndarray  # [G, W] first proposal tick (for latency)
     last_send: jnp.ndarray  # [G, W] last Phase2a send tick (for retries)
     chosen_tick: jnp.ndarray  # [G, W] tick the quorum formed (INF if not)
     chosen_round: jnp.ndarray  # [G, W] round the quorum formed in (-1 if not)
+    chosen_value: jnp.ndarray  # [G, W] value the quorum chose (NO_VALUE)
     replica_arrival: jnp.ndarray  # [G, W] tick Chosen reaches replicas
 
     # Acceptors.
@@ -101,6 +113,7 @@ class BatchedMultiPaxosState:
     p2a_arrival: jnp.ndarray  # [G, W, A] Phase2a arrival tick (INF = never)
     p2b_arrival: jnp.ndarray  # [G, W, A] Phase2b arrival tick at counter
     vote_round: jnp.ndarray  # [G, W, A] round of the vote (-1 = none)
+    vote_value: jnp.ndarray  # [G, W, A] value of the vote (NO_VALUE = none)
 
     # Execution / stats.
     executed: jnp.ndarray  # [G] per-group retired (executed) slot count
@@ -117,15 +130,18 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         next_slot=jnp.zeros((G,), jnp.int32),
         head=jnp.zeros((G,), jnp.int32),
         status=jnp.zeros((G, W), jnp.int32),
+        slot_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((G, W), INF, jnp.int32),
         last_send=jnp.full((G, W), INF, jnp.int32),
         chosen_tick=jnp.full((G, W), INF, jnp.int32),
         chosen_round=jnp.full((G, W), -1, jnp.int32),
+        chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         replica_arrival=jnp.full((G, W), INF, jnp.int32),
         acc_round=jnp.zeros((G, A), jnp.int32),
         p2a_arrival=jnp.full((G, W, A), INF, jnp.int32),
         p2b_arrival=jnp.full((G, W, A), INF, jnp.int32),
         vote_round=jnp.full((G, W, A), -1, jnp.int32),
+        vote_value=jnp.full((G, W, A), NO_VALUE, jnp.int32),
         executed=jnp.zeros((G,), jnp.int32),
         committed=jnp.zeros((), jnp.int32),
         retired=jnp.zeros((), jnp.int32),
@@ -174,6 +190,11 @@ def tick(
         state.acc_round, jnp.max(jnp.where(may_vote, msg_round, -1), axis=1)
     )
     vote_round = jnp.where(may_vote, msg_round, state.vote_round)
+    # The vote carries the slot's currently proposed value
+    # (Acceptor.scala:184-220 votes for the Phase2a's value).
+    vote_value = jnp.where(
+        may_vote, state.slot_value[:, :, None], state.vote_value
+    )
     p2b_lat = _sample_latency(cfg, k_lat1, (G, W, A))
     p2b_delivered = _sample_delivered(cfg, k_drop1, (G, W, A))
     p2b_arrival = jnp.where(
@@ -194,6 +215,7 @@ def tick(
     chosen_round = jnp.where(
         newly_chosen, state.leader_round[:, None], state.chosen_round
     )
+    chosen_value = jnp.where(newly_chosen, state.slot_value, state.chosen_value)
     rep_lat = _sample_latency(cfg, k_lat3, (G, W))
     replica_arrival = jnp.where(
         newly_chosen, t + rep_lat, state.replica_arrival
@@ -229,25 +251,44 @@ def tick(
     retired_total = state.retired + jnp.sum(n_retire)
 
     status = jnp.where(retire_mask, EMPTY, status)
+    slot_value = jnp.where(retire_mask, NO_VALUE, state.slot_value)
     chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
     chosen_round = jnp.where(retire_mask, -1, chosen_round)
+    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
     replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
     propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
     last_send = jnp.where(retire_mask, INF, state.last_send)
     p2a_arrival = jnp.where(retire_mask[:, :, None], INF, state.p2a_arrival)
     p2b_arrival = jnp.where(retire_mask[:, :, None], INF, p2b_arrival)
     vote_round = jnp.where(retire_mask[:, :, None], -1, vote_round)
+    vote_value = jnp.where(retire_mask[:, :, None], NO_VALUE, vote_value)
 
     # ---- 4. Leader proposes new slots (Leader.processClientRequestBatch,
     # Leader.scala:331-407): fill up to K fresh ring slots if the window
     # has room. Positions are (next_slot + i) % W; computed elementwise.
     space = W - (state.next_slot - head)  # [G]
     count = jnp.minimum(cfg.slots_per_tick, space)  # [G]
+    if cfg.max_slots_per_group is not None:
+        count = jnp.minimum(
+            count,
+            jnp.maximum(cfg.max_slots_per_group - state.next_slot, 0),
+        )
     delta = (w_iota[None, :] - state.next_slot[:, None]) % W  # [G, W]
     is_new = delta < count[:, None]  # [G, W]
     next_slot = state.next_slot + count
 
     status = jnp.where(is_new, PROPOSED, status)
+    # The value is the global command sequence number: group g's slot s
+    # carries command s*G + g, mirroring a leader assigning arriving
+    # commands to slots round-robin over groups (slot % G partitioning).
+    # Masked into [0, 2^31) so an open-workload run that overflows int32
+    # wraps to a non-negative id instead of aliasing the NO_VALUE/
+    # NOOP_VALUE sentinels (ids stay unique across any in-flight window).
+    group_ids = jnp.arange(G, dtype=jnp.int32)[:, None]  # [G, 1]
+    new_value = ((state.next_slot[:, None] + delta) * G + group_ids) & jnp.int32(
+        0x7FFFFFFF
+    )
+    slot_value = jnp.where(is_new, new_value, slot_value)
     propose_tick = jnp.where(is_new, t, propose_tick)
     last_send = jnp.where(is_new, t, last_send)
 
@@ -279,15 +320,18 @@ def tick(
         next_slot=next_slot,
         head=head,
         status=status,
+        slot_value=slot_value,
         propose_tick=propose_tick,
         last_send=last_send,
         chosen_tick=chosen_tick,
         chosen_round=chosen_round,
+        chosen_value=chosen_value,
         replica_arrival=replica_arrival,
         acc_round=new_acc_round,
         p2a_arrival=p2a_arrival,
         p2b_arrival=p2b_arrival,
         vote_round=vote_round,
+        vote_value=vote_value,
         executed=executed,
         committed=committed,
         retired=retired_total,
@@ -303,19 +347,36 @@ def leader_change(
     key: jnp.ndarray,
 ) -> BatchedMultiPaxosState:
     """A new leader takes over in a higher round (Leader.leaderChange +
-    startPhase1, Leader.scala:409-459): bump the round, invalidate pending
-    votes of older rounds at the counter, and re-propose every in-flight
-    slot in the new round to the full group (phase-1 repair collapses to
-    re-proposal here because the batched model tracks votes, not values —
-    the safe value IS the slot's value)."""
+    startPhase1, Leader.scala:409-459): bump the round, run phase-1 log
+    repair, and re-propose every in-flight slot in the new round to the
+    full group.
+
+    Phase 1 is modeled synchronously: the new leader reads every
+    acceptor's (vote_round, vote_value) — a superset of any f+1 read
+    quorum, so every possibly-chosen value is visible — and per slot
+    adopts the value of the maximum vote round as an argmax reduction
+    over the acceptor axis (safeValue, Leader.scala:314-329). In-flight
+    slots with no votes anywhere are re-proposed as noops
+    (Leader.scala:541-575 proposes Noop for unvoted repair slots)."""
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     new_round = state.leader_round + 1
     in_flight = state.status == PROPOSED
+    # safeValue: per slot, the value of the max-round vote (all votes in
+    # one round carry the same value, so any argmax tie-break is safe).
+    has_vote = state.vote_round >= 0  # [G, W, A]
+    best = jnp.argmax(state.vote_round, axis=2)  # vote_round is -1 when unvoted
+    voted_value = jnp.take_along_axis(
+        state.vote_value, best[:, :, None], axis=2
+    )[:, :, 0]
+    any_vote = jnp.any(has_vote, axis=2)  # [G, W]
+    safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
+    slot_value = jnp.where(in_flight, safe_value, state.slot_value)
     lat = _sample_latency(cfg, key, (G, W, A))
     p2a_arrival = jnp.where(in_flight[:, :, None], t + lat, state.p2a_arrival)
     return dataclasses.replace(
         state,
         leader_round=new_round,
+        slot_value=slot_value,
         p2a_arrival=p2a_arrival,
         last_send=jnp.where(in_flight, t, state.last_send),
     )
@@ -368,9 +429,28 @@ def check_invariants(
             state.vote_round >= 0, state.vote_round, 0
         )
     )
+    # Values: chosen slots carry a real value or a repair noop, never
+    # unset; and every vote in the chosen round is for the chosen value
+    # (one leader proposes one value per (round, slot)).
+    value_set_ok = jnp.all(
+        jnp.where(chosen, state.chosen_value != NO_VALUE, True)
+    )
+    vote_in_chosen_round = (
+        chosen[:, :, None]
+        & (state.vote_round == state.chosen_round[:, :, None])
+    )
+    vote_value_ok = jnp.all(
+        jnp.where(
+            vote_in_chosen_round,
+            state.vote_value == state.chosen_value[:, :, None],
+            True,
+        )
+    )
     return {
         "quorum_ok": quorum_ok,
         "window_ok": window_ok,
         "conserved": conserved,
         "round_ok": round_ok,
+        "value_set_ok": value_set_ok,
+        "vote_value_ok": vote_value_ok,
     }

@@ -1,0 +1,239 @@
+"""Cross-validation of the batched TPU MultiPaxos model against the
+per-actor sim (SURVEY.md §4, implication (b)): on aligned scenarios, both
+executions must map the same command-arrival sequence to the same per-slot
+chosen values — including phase-1 safe-value repair after a leader change
+(Leader.scala:314-329, 504-577).
+
+Alignment model: batched value id v corresponds to the v-th command to
+arrive at the per-actor leader; group g's per-group slot s is global slot
+s*G + g (the ``slot % G`` partitioning of ProxyLeader.scala:190). A slot
+repaired to a noop is NOOP in both representations.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.core import wire
+from frankenpaxos_tpu.protocols.multipaxos.messages import (
+    Phase2a,
+    Phase2b,
+)
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    CHOSEN,
+    INF,
+    NOOP_VALUE,
+    BatchedMultiPaxosConfig,
+    check_invariants,
+    init_state,
+    leader_change,
+    tick,
+)
+from multipaxos_testbed import SimulatedMultiPaxos, Write
+
+NOOP = "noop"
+
+
+# -- Batched-side driver ------------------------------------------------------
+
+
+def run_batched_collecting(cfg, state, t0, num_ticks, key, log):
+    """Advance tick-by-tick, recording every chosen slot's value into
+    ``log`` (global slot -> value). A chosen slot survives at least one
+    tick before retiring (replica_arrival > chosen tick), so per-tick
+    observation sees every chosen value exactly."""
+    G, W = cfg.num_groups, cfg.window
+    t = t0
+    for i in range(num_ticks):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        status = np.asarray(state.status)
+        chosen_value = np.asarray(state.chosen_value)
+        head = np.asarray(state.head)
+        next_slot = np.asarray(state.next_slot)
+        for g in range(G):
+            for s in range(int(head[g]), int(next_slot[g])):
+                if status[g, s % W] == CHOSEN:
+                    global_slot = s * G + g
+                    value = int(chosen_value[g, s % W])
+                    if global_slot in log:
+                        assert log[global_slot] == value, (
+                            f"slot {global_slot} changed value: "
+                            f"{log[global_slot]} -> {value}"
+                        )
+                    log[global_slot] = value
+        t += 1
+    return state, t
+
+
+def batched_symbols(log, n):
+    assert set(log.keys()) == set(range(n)), sorted(log)
+    return [NOOP if log[s] == NOOP_VALUE else log[s] for s in range(n)]
+
+
+# -- Per-actor-side drivers ---------------------------------------------------
+
+
+def drain(system, max_steps=50_000):
+    t = system.transport
+    steps = 0
+    while t.messages and steps < max_steps:
+        t.deliver_message(t.messages[0])
+        steps += 1
+    assert steps < max_steps, "message storm"
+
+
+def sim_symbols(system, n):
+    """Per-slot values from the replicas' logs, as arrival indices."""
+    out = []
+    logs = []
+    for replica in system.replicas:
+        entries = []
+        for s in range(n):
+            entry = replica.log.get(s)
+            assert entry is not None, f"slot {s} missing at {replica.address}"
+            if entry.is_noop:
+                entries.append(NOOP)
+            else:
+                (command,) = entry.batch.commands
+                # Commands were written as b"c<k>" with k = arrival index.
+                entries.append(int(command.command[1:]))
+        logs.append(entries)
+    assert all(l == logs[0] for l in logs), f"replica logs diverge: {logs}"
+    return logs[0]
+
+
+# -- Tests --------------------------------------------------------------------
+
+
+def test_cross_validation_happy_path():
+    """Same command sequence, no failures: identical per-slot logs."""
+    n = 10
+    # Per-actor: 10 sequential writes; the leader assigns slot k to the
+    # k-th arriving command.
+    sim = SimulatedMultiPaxos(f=1, batched=False, flexible=False)
+    system = sim.new_system(seed=5)
+    for k in range(n):
+        sim.run_command(system, Write(0, 0, f"c{k}".encode()))
+        drain(system)
+    assert system.writes_completed == n
+
+    # Batched: closed workload of 10 commands over G=2 groups.
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=2,
+        window=8,
+        slots_per_tick=1,
+        lat_min=1,
+        lat_max=1,
+        drop_rate=0.0,
+        retry_timeout=64,
+        thrifty=False,
+        max_slots_per_group=n // 2,
+    )
+    state = init_state(cfg)
+    log = {}
+    state, t = run_batched_collecting(
+        cfg, state, 0, 40, jax.random.PRNGKey(0), log
+    )
+    inv = check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+    assert int(state.retired) == n
+
+    assert batched_symbols(log, n) == sim_symbols(system, n) == list(range(n))
+
+
+def test_cross_validation_leader_change_repair():
+    """Aligned failover scenario: six in-flight slots, votes exist for
+    slots {0, 2, 5} only, nothing chosen; the leader fails; the new
+    leader's phase-1 repair must keep the voted values and noopify slots
+    {1, 3, 4} — in BOTH executions, yielding identical logs."""
+    n = 6
+    voted = {0, 2, 5}
+
+    # ---- Per-actor side.
+    sim = SimulatedMultiPaxos(f=1, batched=False, flexible=False)
+    system = sim.new_system(seed=7)
+    t = system.transport
+    config = system.config
+    acceptor_addrs = {
+        a for group in config.acceptor_addresses for a in group
+    }
+
+    # Six concurrent writes (distinct pseudonyms), arriving in order.
+    for k in range(n):
+        sim.run_command(system, Write(0, k, f"c{k}".encode()))
+
+    # Pump the write path, but: drop acceptor-bound Phase2as for unvoted
+    # slots, and drop every Phase2b so nothing is chosen.
+    steps = 0
+    while t.messages and steps < 10_000:
+        steps += 1
+        m = t.messages[0]
+        decoded = wire.decode(m.data)
+        if isinstance(decoded, Phase2a) and m.dst in acceptor_addrs:
+            if decoded.slot in voted:
+                t.deliver_message(m)
+            else:
+                t.drop_message(m)
+        elif isinstance(decoded, Phase2b):
+            t.drop_message(m)
+        else:
+            t.deliver_message(m)
+    assert system.writes_completed == 0
+
+    # Kill leader 0; leader 1 takes over and repairs the log.
+    t.partition_actor(config.leader_addresses[0])
+    t.partition_actor(config.leader_election_addresses[0])
+    t.trigger_timer(config.leader_election_addresses[1], "noPingTimer")
+    drain(system)
+
+    from frankenpaxos_tpu.protocols.multipaxos.leader import _Phase2
+
+    assert isinstance(system.leaders[1].state, _Phase2)
+
+    # ---- Batched side: the same scenario.
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=2,
+        window=8,
+        slots_per_tick=3,
+        lat_min=1,
+        lat_max=1,
+        drop_rate=0.0,
+        retry_timeout=100,
+        thrifty=False,
+        max_slots_per_group=3,
+    )
+    key = jax.random.PRNGKey(1)
+    state = init_state(cfg)
+    # t=0: propose all six slots; Phase2as arrive at t=1.
+    state = tick(cfg, state, jnp.int32(0), jax.random.fold_in(key, 0))
+    # Align the vote pattern: unvoted slots lose all their Phase2as;
+    # voted slots keep one acceptor's (below quorum, so nothing is
+    # chosen — the repair read covers all acceptors, so one voter
+    # preserves the value exactly like the per-actor read-quorum
+    # intersection does).
+    p2a = np.asarray(state.p2a_arrival).copy()  # [G, W, A]
+    for global_slot in range(n):
+        g, s = global_slot % 2, global_slot // 2
+        if global_slot in voted:
+            p2a[g, s % cfg.window, 1:] = INF
+        else:
+            p2a[g, s % cfg.window, :] = INF
+    state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
+    # t=1: the surviving Phase2as arrive; single votes are recorded.
+    state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
+    assert int(state.committed) == 0
+    # Leader change at t=2: phase-1 repair + re-proposal in round 1.
+    state = leader_change(cfg, state, jnp.int32(2), jax.random.fold_in(key, 99))
+    log = {}
+    state, tend = run_batched_collecting(cfg, state, 2, 10, key, log)
+    inv = check_invariants(cfg, state, jnp.int32(tend))
+    assert all(bool(v) for v in inv.values()), inv
+    assert int(state.retired) == n
+
+    expected = [0, NOOP, 2, NOOP, NOOP, 5]
+    assert batched_symbols(log, n) == expected
+    assert sim_symbols(system, n) == expected
