@@ -8,6 +8,11 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 ``lax.scan``, sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
 """
 
+from frankenpaxos_tpu.tpu import epaxos_batched
+from frankenpaxos_tpu.tpu.epaxos_batched import (
+    BatchedEPaxosConfig,
+    BatchedEPaxosState,
+)
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     BatchedMultiPaxosConfig,
     BatchedMultiPaxosState,
@@ -20,10 +25,13 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
 from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 
 __all__ = [
+    "BatchedEPaxosConfig",
+    "BatchedEPaxosState",
     "BatchedMultiPaxosConfig",
     "BatchedMultiPaxosState",
     "TpuSimTransport",
     "check_invariants",
+    "epaxos_batched",
     "init_state",
     "leader_change",
     "run_ticks",

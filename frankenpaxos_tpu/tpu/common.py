@@ -1,0 +1,45 @@
+"""Shared primitives of the batched simulation backends."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.int32(2**30)
+
+LAT_BINS = 64  # histogram bins for latency stats (in ticks)
+
+
+def sample_latency(lat_min: int, lat_max: int, key, shape) -> jnp.ndarray:
+    """Uniform per-message latency in ticks."""
+    if lat_min == lat_max:
+        return jnp.full(shape, lat_min, jnp.int32)
+    return jax.random.randint(key, shape, lat_min, lat_max + 1)
+
+
+def sample_delivered(drop_rate: float, key, shape) -> jnp.ndarray:
+    """Per-message Bernoulli delivery mask."""
+    if drop_rate == 0.0:
+        return jnp.ones(shape, bool)
+    return jax.random.uniform(key, shape) >= drop_rate
+
+
+def ring_retire(
+    retire_ord: jnp.ndarray,  # [G, W] bool, in absolute order from head
+    head: jnp.ndarray,  # [G]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Retire the contiguous leading run of ``retire_ord`` per ring.
+
+    Returns ``(n_retire [G], retire_mask [G, W])`` where the mask is in
+    RING-POSITION space (a position retires iff its ordinal from head is
+    below the run length) — the batched form of the replica's contiguous
+    prefix execution (Replica.scala:394-453) and the dependency-graph GC.
+    """
+    G, W = retire_ord.shape
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    n_retire = jnp.sum(jnp.cumprod(retire_ord.astype(jnp.int32), axis=1), axis=1)
+    ord_of_pos = (w_iota[None, :] - head[:, None]) % W
+    retire_mask = ord_of_pos < n_retire[:, None]
+    return n_retire, retire_mask

@@ -1,0 +1,351 @@
+"""Batched EPaxos / Simple BPaxos as a single XLA program (BASELINE
+config 3: dependency-graph protocols at scale).
+
+The reference's hot loop for the EPaxos family is commit-then-execute
+through a dependency graph: committed instances execute as eligible
+strongly-connected components in reverse topological order
+(``depgraph/TarjanDependencyGraph.scala:149``, ``epaxos/Replica.scala``).
+Re-designed TPU-first:
+
+  * ``C`` columns (one per replica/instance leader, the (replica, i)
+    instance space of ``epaxos/Replica.scala``), each owning a ring of
+    ``W`` in-flight instances — struct-of-arrays state, shardable over a
+    device mesh along ``C``.
+  * Dependency sets are PREFIX-SHAPED per column — exactly the
+    ``InstancePrefixSet`` / top-k compression of the reference
+    (``epaxos/InstancePrefixSet.scala``) — so an instance's deps are a
+    single watermark vector ``dep[v] in Z^C``: v depends on every
+    ``(d, j)`` with ``j < dep[v][d]``. Dependency checks become prefix-sum
+    lookups instead of set operations.
+  * The dependency-graph execute pass is an ELIGIBILITY CLOSURE computed
+    with array ops: start from all committed-unexecuted instances and
+    iteratively remove any whose dep watermark is not fully covered by
+    (executed | candidate) — a per-column cumulative-sum plus gather,
+    iterated under ``lax.while_loop`` to the greatest fixpoint. The fixed
+    point IS the set of eligible vertices (all transitive deps committed),
+    cycles included, so one pass executes exactly what
+    ``TarjanDependencyGraph.execute()`` would (see
+    ``tests/test_tpu_epaxos.py`` for the per-tick set equivalence).
+  * Commit latency models the protocol phases: PreAccept out + PreAcceptOk
+    back (one RTT) on the fast path, + Accept/AcceptOk (second RTT) on the
+    slow path, sampled per instance (``epaxos/Replica.scala``
+    handlePreAcceptOk). ``simplebpaxos=True`` adds the disaggregated
+    proposer->depservice->acceptor hop of Simple BPaxos
+    (``simplebpaxos/``), which costs one extra RTT before commit.
+  * Cycles arise exactly as in the real protocol: two instances proposed
+    concurrently in different columns can each include the other in their
+    dependency snapshot (Bernoulli ``peer_visibility``), forming SCCs that
+    the closure executes together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    ring_retire,
+    sample_latency,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedEPaxosConfig:
+    """Static (compile-time) simulation parameters."""
+
+    num_columns: int = 5  # C: instance leaders (BASELINE config 3 uses 5)
+    window: int = 64  # W: in-flight instances per column (ring capacity)
+    instances_per_tick: int = 2  # K: new proposals per column per tick
+    lat_min: int = 1  # one-way message latency in ticks (uniform sample)
+    lat_max: int = 3
+    slow_path_rate: float = 0.2  # P(instance takes the Accept round trip)
+    # P(a same-tick proposal in another column lands in the dependency
+    # snapshot) — mutual visibility is what creates SCCs.
+    see_same_tick_rate: float = 0.5
+    simplebpaxos: bool = False  # +1 RTT: proposer -> depservice -> acceptors
+    # Closed workload: stop proposing once each column has allocated this
+    # many instances (None = open workload).
+    max_instances_per_column: Optional[int] = None
+
+    @property
+    def num_replicas(self) -> int:
+        return self.num_columns
+
+    def __post_init__(self):
+        assert self.num_columns >= 2
+        assert self.window >= 2 * self.instances_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.slow_path_rate <= 1.0
+        assert 0.0 <= self.see_same_tick_rate <= 1.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedEPaxosState:
+    """Struct-of-arrays instance state. Shapes: [C] columns, [C, W] ring
+    instances, [C, W, C] per-instance dependency watermarks."""
+
+    next_instance: jnp.ndarray  # [C] next per-column instance number
+    head: jnp.ndarray  # [C] lowest non-retired per-column instance number
+
+    proposed: jnp.ndarray  # [C, W] ring slot holds a live instance
+    propose_tick: jnp.ndarray  # [C, W] proposal tick (INF = empty)
+    commit_tick: jnp.ndarray  # [C, W] tick the commit lands (INF = empty)
+    committed: jnp.ndarray  # [C, W] bool: commit has landed
+    executed: jnp.ndarray  # [C, W] bool: executed by the dep-graph pass
+    dep: jnp.ndarray  # [C, W, C] dependency watermarks (absolute indices)
+
+    # Stats.
+    committed_total: jnp.ndarray  # [] cumulative commits
+    executed_total: jnp.ndarray  # [] cumulative executions
+    retired_total: jnp.ndarray  # [] cumulative retired (GC'd) instances
+    coexecuted: jnp.ndarray  # [] executed in the same pass as one of its
+    # dependencies (dependency chains committed together AND SCC members
+    # both batch into one closure pass; true SCC detection is checked
+    # against TarjanDependencyGraph in tests/test_tpu_epaxos.py)
+    lat_sum: jnp.ndarray  # [] sum of propose->execute latencies
+    lat_hist: jnp.ndarray  # [LAT_BINS] execute latency histogram
+
+
+def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
+    C, W = cfg.num_columns, cfg.window
+    return BatchedEPaxosState(
+        next_instance=jnp.zeros((C,), jnp.int32),
+        head=jnp.zeros((C,), jnp.int32),
+        proposed=jnp.zeros((C, W), bool),
+        propose_tick=jnp.full((C, W), INF, jnp.int32),
+        commit_tick=jnp.full((C, W), INF, jnp.int32),
+        committed=jnp.zeros((C, W), bool),
+        executed=jnp.zeros((C, W), bool),
+        dep=jnp.zeros((C, W, C), jnp.int32),
+        committed_total=jnp.zeros((), jnp.int32),
+        executed_total=jnp.zeros((), jnp.int32),
+        retired_total=jnp.zeros((), jnp.int32),
+        coexecuted=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _prefix_counts(bm: jnp.ndarray, head: jnp.ndarray) -> jnp.ndarray:
+    """P[c, r] = how many of column c's first r in-ring instances (in
+    absolute order from head) are set in ``bm``. Shape [C, W+1]."""
+    C, W = bm.shape
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    pos_of_ord = (head[:, None] + w_iota[None, :]) % W
+    bm_ord = jnp.take_along_axis(bm, pos_of_ord, axis=1).astype(jnp.int32)
+    cum = jnp.cumsum(bm_ord, axis=1)
+    return jnp.concatenate([jnp.zeros((C, 1), jnp.int32), cum], axis=1)
+
+
+def _deps_satisfied_by(
+    dep: jnp.ndarray,  # [C, W, C] absolute watermarks
+    base: jnp.ndarray,  # [C, W] bool: instances counted as executed
+    head: jnp.ndarray,  # [C]
+) -> jnp.ndarray:
+    """[C, W] bool: every dependency of the slot's instance is in ``base``
+    (instances below head count as executed — they retired)."""
+    C, W = base.shape
+    P = _prefix_counts(base, head)  # [C, W+1]
+    r = jnp.clip(dep - head[None, None, :], 0, W)  # [C, W, C] relative
+    gathered = P[jnp.arange(C)[None, None, :], r]  # [C, W, C]
+    return jnp.all((r <= 0) | (gathered == r), axis=2)
+
+
+def eligible_closure(
+    committed: jnp.ndarray,  # [C, W]
+    executed: jnp.ndarray,  # [C, W]
+    dep: jnp.ndarray,  # [C, W, C]
+    head: jnp.ndarray,  # [C]
+) -> jnp.ndarray:
+    """The dependency-graph execute pass as a greatest fixpoint: the
+    largest set E of committed-unexecuted instances whose dependencies all
+    lie in (executed | E). This is exactly the set of ELIGIBLE vertices of
+    ``DependencyGraph.scala:8-125`` — vertices all of whose transitive
+    dependencies are committed — including whole SCCs, which the reference
+    executes together in one component."""
+
+    def body(carry):
+        E, _ = carry
+        ok = _deps_satisfied_by(dep, executed | E, head)
+        newE = E & ok
+        return newE, jnp.any(newE != E)
+
+    def cond(carry):
+        return carry[1]
+
+    E0 = committed & ~executed
+    E, _ = jax.lax.while_loop(cond, body, (E0, jnp.bool_(True)))
+    return E
+
+
+def tick(
+    cfg: BatchedEPaxosConfig,
+    state: BatchedEPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedEPaxosState:
+    """One simulation tick: commits land, the dependency-graph pass
+    executes every eligible instance (SCCs included), fully-executed
+    column prefixes retire, and columns propose new instances with
+    PRNG-sampled dependency snapshots and commit latencies."""
+    C, W = cfg.num_columns, cfg.window
+    k_vis, k_slow, k_lat = jax.random.split(key, 3)
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+
+    # ---- 1. Commits land (EpCommit arrival at the replica).
+    landing = state.commit_tick <= t
+    committed = state.committed | (state.proposed & landing)
+    n_new_commits = jnp.sum(committed & ~state.committed)
+
+    # ---- 2. Dependency-graph execute pass (TarjanDependencyGraph
+    # execute: all eligible vertices, SCCs together).
+    newly = eligible_closure(committed, state.executed, state.dep, state.head)
+    executed = state.executed | newly
+    # Co-execution accounting: a newly executed instance whose deps were
+    # not all executed BEFORE this pass executed together with at least
+    # one dependency (a same-pass chain or an SCC).
+    dep_pre_ok = _deps_satisfied_by(state.dep, state.executed, state.head)
+    coexecuted = state.coexecuted + jnp.sum(newly & ~dep_pre_ok)
+    lat = jnp.where(newly, t - state.propose_tick, 0)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+    executed_total = state.executed_total + jnp.sum(newly)
+
+    # ---- 3. Retire the contiguous executed prefix of each column (the
+    # ring GC; executed-out-of-order instances wait for their column hole).
+    pos_of_ord = (state.head[:, None] + w_iota[None, :]) % W
+    exec_ord = jnp.take_along_axis(executed, pos_of_ord, axis=1)
+    in_ring = w_iota[None, :] < (state.next_instance - state.head)[:, None]
+    retire_ord = exec_ord & in_ring
+    n_retire, retire_mask = ring_retire(retire_ord, state.head)
+    head = state.head + n_retire
+    retired_total = state.retired_total + jnp.sum(n_retire)
+
+    proposed = state.proposed & ~retire_mask
+    committed = committed & ~retire_mask
+    executed = executed & ~retire_mask
+    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
+    commit_tick = jnp.where(retire_mask, INF, state.commit_tick)
+
+    # ---- 4. Propose new instances (EpReplica handleClientRequest): up to
+    # K per column if the window has room. The dependency snapshot is the
+    # per-column proposal frontier; a Bernoulli per (instance, column)
+    # decides whether SAME-TICK proposals of other columns are visible —
+    # mutual visibility creates cycles, exactly like concurrent
+    # conflicting PreAccepts in the real protocol.
+    space = W - (state.next_instance - head)
+    count = jnp.minimum(cfg.instances_per_tick, space)
+    if cfg.max_instances_per_column is not None:
+        count = jnp.minimum(
+            count, jnp.maximum(cfg.max_instances_per_column - state.next_instance, 0)
+        )
+    delta = (w_iota[None, :] - state.next_instance[:, None]) % W
+    is_new = delta < count[:, None]
+    next_instance = state.next_instance + count
+
+    # Dependency watermarks: before-this-tick frontier of every column,
+    # optionally extended to the after-this-tick frontier of OTHER columns
+    # (same-tick visibility); own column = own index (a replica serializes
+    # its own instances, InstanceHelpers/own-column conflicts).
+    own_index = state.next_instance[:, None] + delta  # [C, W] absolute
+    base_frontier = state.next_instance[None, None, :]  # [1, 1, C] pre-tick
+    after_frontier = next_instance[None, None, :]  # [1, 1, C] post-tick
+    sees = (
+        jax.random.uniform(k_vis, (C, W, C)) < cfg.see_same_tick_rate
+        if cfg.see_same_tick_rate > 0.0
+        else jnp.zeros((C, W, C), bool)
+    )
+    dep_new = jnp.where(sees, after_frontier, base_frontier)
+    dep_new = jnp.broadcast_to(dep_new, (C, W, C))
+    own_col = jnp.arange(C)[:, None, None] == jnp.arange(C)[None, None, :]
+    dep_new = jnp.where(own_col, own_index[:, :, None], dep_new)
+    dep = jnp.where(is_new[:, :, None], dep_new, state.dep)
+
+    # Commit latency: PreAccept RTT (2 one-way hops), + Accept RTT on the
+    # slow path, + the proposer->depservice hop pair for Simple BPaxos.
+    hops = 2 + (2 if cfg.simplebpaxos else 0)
+    rtt = jnp.sum(
+        sample_latency(cfg.lat_min, cfg.lat_max, k_lat, (hops + 2, C, W)), axis=0
+    )  # [C, W]: hops+2 one-way samples; the last 2 are the slow path
+    fast = jnp.sum(
+        sample_latency(cfg.lat_min, cfg.lat_max, jax.random.fold_in(k_lat, 1), (hops, C, W)), axis=0
+    )
+    slow = jax.random.uniform(k_slow, (C, W)) < cfg.slow_path_rate
+    commit_lat = jnp.where(slow, rtt, fast)
+    proposed = proposed | is_new
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    commit_tick = jnp.where(is_new, t + commit_lat, commit_tick)
+
+    return BatchedEPaxosState(
+        next_instance=next_instance,
+        head=head,
+        proposed=proposed,
+        propose_tick=propose_tick,
+        commit_tick=commit_tick,
+        committed=committed,
+        executed=executed,
+        dep=dep,
+        committed_total=state.committed_total + n_new_commits,
+        executed_total=executed_total,
+        retired_total=retired_total,
+        coexecuted=coexecuted,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedEPaxosConfig,
+    state: BatchedEPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedEPaxosState, jnp.ndarray]:
+    """Run ``num_ticks`` ticks under lax.scan; returns (state, t0+num_ticks)."""
+
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedEPaxosConfig, state: BatchedEPaxosState, t
+) -> dict:
+    """Device-side safety checks; all returned booleans must be True."""
+    # Executed implies committed (only committed vertices are eligible,
+    # DependencyGraph.scala:8-125).
+    exec_committed = jnp.all(~state.executed | state.committed)
+    # Every executed instance's dependencies are executed or retired (the
+    # closure never executes a vertex whose deps aren't in the closure).
+    deps_ok = jnp.all(
+        ~state.executed
+        | _deps_satisfied_by(state.dep, state.executed, state.head)
+    )
+    # Window bookkeeping.
+    window_ok = jnp.all(
+        (state.head <= state.next_instance)
+        & (state.next_instance - state.head <= cfg.window)
+    )
+    # Conservation: everything retired was executed first.
+    conserved = state.retired_total <= state.executed_total
+    return {
+        "exec_committed": exec_committed,
+        "deps_ok": deps_ok,
+        "window_ok": window_ok,
+        "conserved": conserved,
+    }

@@ -32,15 +32,21 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from frankenpaxos_tpu.tpu.multipaxos_batched import (
-    CHOSEN,
-    EMPTY,
+from frankenpaxos_tpu.tpu.common import (
     INF,
     LAT_BINS,
-    PROPOSED,
-    _sample_delivered as _delivered,
-    _sample_latency as _lat,
+    sample_delivered,
+    sample_latency,
 )
+from frankenpaxos_tpu.tpu.multipaxos_batched import CHOSEN, EMPTY, PROPOSED
+
+
+def _delivered(cfg, key, shape):
+    return sample_delivered(cfg.drop_rate, key, shape)
+
+
+def _lat(cfg, key, shape):
+    return sample_latency(cfg.lat_min, cfg.lat_max, key, shape)
 
 
 @dataclasses.dataclass(frozen=True)

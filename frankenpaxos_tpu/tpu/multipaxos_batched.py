@@ -38,7 +38,13 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-INF = jnp.int32(2**30)
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    ring_retire,
+    sample_delivered,
+    sample_latency,
+)
 
 # Slot status codes.
 EMPTY = 0
@@ -51,8 +57,6 @@ CHOSEN = 2
 # voted); NO_VALUE marks unset.
 NO_VALUE = -1
 NOOP_VALUE = -2
-
-LAT_BINS = 64  # histogram bins for commit latency (in ticks)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,18 +154,6 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
     )
 
 
-def _sample_latency(cfg, key, shape):
-    if cfg.lat_min == cfg.lat_max:
-        return jnp.full(shape, cfg.lat_min, jnp.int32)
-    return jax.random.randint(key, shape, cfg.lat_min, cfg.lat_max + 1)
-
-
-def _sample_delivered(cfg, key, shape):
-    if cfg.drop_rate == 0.0:
-        return jnp.ones(shape, bool)
-    return jax.random.uniform(key, shape) >= cfg.drop_rate
-
-
 def tick(
     cfg: BatchedMultiPaxosConfig,
     state: BatchedMultiPaxosState,
@@ -195,8 +187,8 @@ def tick(
     vote_value = jnp.where(
         may_vote, state.slot_value[:, :, None], state.vote_value
     )
-    p2b_lat = _sample_latency(cfg, k_lat1, (G, W, A))
-    p2b_delivered = _sample_delivered(cfg, k_drop1, (G, W, A))
+    p2b_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat1, (G, W, A))
+    p2b_delivered = sample_delivered(cfg.drop_rate, k_drop1, (G, W, A))
     p2b_arrival = jnp.where(
         may_vote & p2b_delivered,
         jnp.minimum(state.p2b_arrival, t + p2b_lat),
@@ -216,7 +208,7 @@ def tick(
         newly_chosen, state.leader_round[:, None], state.chosen_round
     )
     chosen_value = jnp.where(newly_chosen, state.slot_value, state.chosen_value)
-    rep_lat = _sample_latency(cfg, k_lat3, (G, W))
+    rep_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat3, (G, W))
     replica_arrival = jnp.where(
         newly_chosen, t + rep_lat, state.replica_arrival
     )
@@ -242,10 +234,7 @@ def tick(
         & (jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1) <= t)
         & (slot_of_ord < state.next_slot[:, None])
     )
-    n_retire = jnp.sum(jnp.cumprod(executable.astype(jnp.int32), axis=1), axis=1)
-    # A ring position retires iff its ordinal from head is < n_retire.
-    ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
-    retire_mask = ord_of_pos < n_retire[:, None]
+    n_retire, retire_mask = ring_retire(executable, state.head)
     head = state.head + n_retire
     executed = state.executed + n_retire
     retired_total = state.retired + jnp.sum(n_retire)
@@ -300,8 +289,8 @@ def tick(
         in_quorum = scores <= kth
     else:
         in_quorum = jnp.ones((G, W, A), bool)
-    p2a_lat = _sample_latency(cfg, k_lat2, (G, W, A))
-    p2a_delivered = _sample_delivered(cfg, k_drop2, (G, W, A))
+    p2a_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat2, (G, W, A))
+    p2a_delivered = sample_delivered(cfg.drop_rate, k_drop2, (G, W, A))
     send_p2a = is_new[:, :, None] & in_quorum & p2a_delivered
     p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
 
@@ -310,7 +299,7 @@ def tick(
     # including acceptors that already voted: their Phase2b may have been
     # the dropped message, and re-voting (step 1) re-samples its delivery.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    retry_lat = _sample_latency(cfg, k_retry, (G, W, A))
+    retry_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_retry, (G, W, A))
     resend = timed_out[:, :, None]
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
@@ -371,13 +360,20 @@ def leader_change(
     any_vote = jnp.any(has_vote, axis=2)  # [G, W]
     safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
     slot_value = jnp.where(in_flight, safe_value, state.slot_value)
-    lat = _sample_latency(cfg, key, (G, W, A))
+    lat = sample_latency(cfg.lat_min, cfg.lat_max, key, (G, W, A))
     p2a_arrival = jnp.where(in_flight[:, :, None], t + lat, state.p2a_arrival)
+    # Clear stale Phase2bs of the in-flight slots: old-round votes no
+    # longer count, and keeping their arrival ticks would let a re-vote in
+    # the new round piggyback on a PAST arrival via the jnp.minimum dedup
+    # in tick step 1 (counting the same tick it is cast, biasing commit
+    # latency low).
+    p2b_arrival = jnp.where(in_flight[:, :, None], INF, state.p2b_arrival)
     return dataclasses.replace(
         state,
         leader_round=new_round,
         slot_value=slot_value,
         p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
         last_send=jnp.where(in_flight, t, state.last_send),
     )
 
