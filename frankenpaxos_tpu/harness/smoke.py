@@ -4,11 +4,15 @@ x18 + ``scripts/benchmark_smoke.sh``):
     python -m frankenpaxos_tpu.harness.smoke            # all
     python -m frankenpaxos_tpu.harness.smoke multipaxos # one
 
-``multipaxos`` runs a REAL localhost deployment: every role is its own OS
-process launched through the role mains, a closed-loop client drives it,
-and the recorder CSV is summarized. The other protocols smoke in-process
-on the sim transport (their deployment mains land with their nets in a
-later round); ``tpu`` smokes the batched backend.
+By default protocols smoke in-process on the sim transport (fast) and
+``tpu`` smokes the batched backend. With ``--deploy``, EVERY protocol runs
+a REAL localhost deployment: each role is its own OS process launched
+through the role mains (``frankenpaxos_tpu.mains.run``, or the dedicated
+multipaxos main), a closed-loop client process drives it, and the
+recorder CSV is summarized:
+
+    python -m frankenpaxos_tpu.harness.smoke --deploy            # all 20
+    python -m frankenpaxos_tpu.harness.smoke --deploy epaxos
 """
 
 from __future__ import annotations
@@ -34,6 +38,33 @@ def _base_port() -> int:
     return 20000 + (os.getpid() % 400) * 60
 
 
+def _role_env() -> dict:
+    """Role processes don't touch accelerators; strip env hooks that would
+    import heavyweight ML stacks into every subprocess (14 concurrent jax
+    imports starve a small machine for >30s)."""
+    import os
+
+    return {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+
+
+def _summarize_recorder(path: str) -> dict:
+    with open(path) as f:
+        rows = [
+            {"start": float(r["start"]), "latency_nanos": float(r["latency_nanos"])}
+            for r in csv.DictReader(f)
+        ]
+    assert rows, "no requests completed"
+    summary = summarize_latency_throughput(rows)
+    return {
+        "requests": len(rows),
+        "throughput_per_s": (
+            round(summary.throughput_per_s, 1) if summary else None
+        ),
+        "median_ms": round(summary.median_ms, 2) if summary else None,
+        "p99_ms": round(summary.p99_ms, 2) if summary else None,
+    }
+
+
 def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
     port = _base_port()
 
@@ -55,16 +86,7 @@ def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
     }
     config_path = bench.write_string("config.json", json.dumps(config, indent=2))
 
-    # Role processes don't touch accelerators; strip any env hooks that
-    # would import heavyweight ML stacks into every subprocess (14
-    # concurrent jax imports starve a small machine for >30s).
-    import os
-
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("PALLAS_AXON_POOL_IPS",)
-    }
+    env = _role_env()
 
     def role(label, *extra):
         return bench.popen(label, [
@@ -97,19 +119,70 @@ def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
     )
     code = client.wait(timeout=duration + 30)
     assert code == 0, f"client exited with {code}"
-    with open(recorder) as f:
-        rows = [
-            {"start": float(r["start"]), "latency_nanos": float(r["latency_nanos"])}
-            for r in csv.DictReader(f)
-        ]
-    summary = summarize_latency_throughput(rows)
-    assert summary is not None and summary.count > 0, "no requests completed"
-    return {
-        "requests": summary.count,
-        "throughput_per_s": round(summary.throughput_per_s, 1),
-        "median_ms": round(summary.median_ms, 2),
-        "p99_ms": round(summary.p99_ms, 2),
-    }
+    return _summarize_recorder(recorder)
+
+
+def deploy_smoke(name: str, bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
+    """A real localhost deployment of ``name``: every role is its own OS
+    process launched via the generic role main
+    (``frankenpaxos_tpu.mains.run``), driven by a closed-loop client
+    process, summarized from the recorder CSV — the analog of the
+    reference's per-protocol ``benchmarks/<proto>/smoke.py`` deployments
+    (``scripts/benchmark_smoke.sh:5-20``)."""
+    from frankenpaxos_tpu.mains.registry import REGISTRY
+
+    if name == "multipaxos":
+        return smoke_multipaxos(bench, duration)
+    spec = REGISTRY[name]
+    port = _base_port()
+
+    def hp(i):
+        return f"127.0.0.1:{port + i}"
+
+    config_dict = spec.local_config(hp)
+    config_path = bench.write_string(
+        "config.json", json.dumps(config_dict, indent=2)
+    )
+    config = spec.parse_config(config_dict)
+    env = _role_env()
+
+    def role_proc(label, *extra):
+        return bench.popen(label, [
+            sys.executable, "-m", "frankenpaxos_tpu.mains.run",
+            "--protocol", name, "--config", config_path,
+            "--log_level", "error", *extra,
+        ], env=env)
+
+    role_items = list(spec.roles.items())
+    for tier, (role_name, role) in enumerate(role_items):
+        cnt = role.count(config)
+        if role.grouped:
+            groups, per_group = cnt
+            for g in range(groups):
+                for i in range(per_group):
+                    role_proc(f"{role_name}_{g}_{i}", "--role", role_name,
+                              "--group_index", str(g), "--index", str(i))
+        else:
+            for i in range(cnt):
+                role_proc(f"{role_name}_{i}", "--role", role_name,
+                          "--index", str(i))
+        # Later tiers may run startup phases against earlier ones (e.g. a
+        # leader's phase 1 against its acceptors): let listeners bind.
+        if tier < len(role_items) - 1:
+            time.sleep(0.4)
+        else:
+            time.sleep(1.0)
+
+    time.sleep(spec.client_lag)
+    recorder = bench.abspath("recorder.csv")
+    client = role_proc(
+        "client", "--role", "client", "--listen", hp(50),
+        "--duration", str(duration), "--num_pseudonyms", "2",
+        "--warmup", "0", "--output", recorder,
+    )
+    code = client.wait(timeout=duration + 30)
+    assert code == 0, f"client exited with {code}"
+    return _summarize_recorder(recorder)
 
 
 def _drain(t, max_steps=200000):
@@ -830,25 +903,39 @@ SMOKES = {
 
 
 def main() -> None:
-    names = sys.argv[1:] or list(SMOKES)
-    unknown = [n for n in names if n not in SMOKES]
+    argv = sys.argv[1:]
+    deploy = "--deploy" in argv
+    names = [a for a in argv if a != "--deploy"]
+    from frankenpaxos_tpu.mains.registry import REGISTRY
+
+    deployable = sorted(REGISTRY) + ["multipaxos"]
+    if deploy:
+        names = names or deployable
+        unknown = [n for n in names if n not in deployable]
+    else:
+        names = names or list(SMOKES)
+        unknown = [n for n in names if n not in SMOKES]
     if unknown:
+        valid = deployable if deploy else list(SMOKES)
         print(
             f"unknown protocol(s) {', '.join(unknown)}; "
-            f"choose from: {', '.join(SMOKES)}",
+            f"choose from: {', '.join(valid)}",
             file=sys.stderr,
         )
         sys.exit(2)
     failures = []
     for name in names:
+        kind = "deploy" if deploy else "smoke"
         bench = BenchmarkDirectory(tempfile.mkdtemp(prefix=f"smoke_{name}_"))
         try:
             with bench:
-                result = SMOKES[name](bench)
-            print(f"smoke {name}: OK {result}")
+                result = (
+                    deploy_smoke(name, bench) if deploy else SMOKES[name](bench)
+                )
+            print(f"{kind} {name}: OK {result}")
         except Exception as e:  # noqa: BLE001
             failures.append(name)
-            print(f"smoke {name}: FAILED ({e!r}); logs in {bench.path}")
+            print(f"{kind} {name}: FAILED ({e!r}); logs in {bench.path}")
     if failures:
         sys.exit(1)
 

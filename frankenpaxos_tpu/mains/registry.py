@@ -1,0 +1,1240 @@
+"""The deployment registry: every protocol's role set, config codec, and
+client driver, consumed by the generic role main
+(``frankenpaxos_tpu.mains.run``) and the deployment smokes
+(``frankenpaxos_tpu.harness.smoke --deploy``).
+
+The reference ships ~60 per-role main objects
+(``jvm/src/main/scala/frankenpaxos/<proto>/<Role>Main.scala``); the
+idiomatic Python re-design is one data-driven registry: a
+``ProtocolSpec`` declares how to parse the cluster JSON into the
+protocol's Config, how to construct each role (in dependency-safe start
+order), and how a closed-loop benchmark client issues operations
+(``jvm/.../ClientMain.scala`` + ``BenchmarkUtil.scala``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from frankenpaxos_tpu.mains.common import host_port, host_ports
+
+
+def _hp_groups(groups) -> tuple:
+    return tuple(host_ports(g) for g in groups)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoleDef:
+    """One deployable role of a protocol."""
+
+    # config -> flat count, or (num_groups, group_size) when grouped.
+    count: Callable[[object], object]
+    # (config, index, group_index, transport, logger, seed) -> actor(s).
+    build: Callable
+    grouped: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    name: str
+    # hp(i) -> "127.0.0.1:<port+i>"; returns the cluster JSON dict.
+    local_config: Callable[[Callable[[int], str]], dict]
+    parse_config: Callable[[dict], object]
+    roles: Dict[str, RoleDef]  # insertion order = start order
+    # (config, listen_addr, transport, logger, seed) -> client actor.
+    make_client: Optional[Callable] = None
+    # (client, pseudonym, counter) -> Promise. None => echo-style client
+    # with no promises (completion observed via counters).
+    issue: Optional[Callable] = None
+    client_lag: float = 1.5
+    # Cap on total ops per client process (single-decree protocols resolve
+    # repeat proposes synchronously from the learned value; a closed loop
+    # would spin). None = run for the full duration.
+    max_ops: Optional[int] = None
+
+
+REGISTRY: Dict[str, ProtocolSpec] = {}
+
+
+def register(spec: ProtocolSpec) -> ProtocolSpec:
+    assert spec.name not in REGISTRY, spec.name
+    REGISTRY[spec.name] = spec
+    return spec
+
+
+# --------------------------------------------------------------------------
+# echo
+# --------------------------------------------------------------------------
+
+
+def _echo_local(hp):
+    return {"server": hp(0)}
+
+
+def _echo_parse(data):
+    return host_port(data["server"])
+
+
+def _echo_build_server(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols.echo import EchoServer
+
+    return EchoServer(config, t, logger)
+
+
+def _echo_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols.echo import EchoClient
+
+    return EchoClient(listen, t, logger, config, ping_period=0.05)
+
+
+register(ProtocolSpec(
+    name="echo",
+    local_config=_echo_local,
+    parse_config=_echo_parse,
+    roles={"server": RoleDef(count=lambda c: 1, build=_echo_build_server)},
+    make_client=_echo_client,
+    issue=None,  # ping timer drives itself; completion = replies received
+    client_lag=0.5,
+))
+
+
+# --------------------------------------------------------------------------
+# unreplicated
+# --------------------------------------------------------------------------
+
+
+def _unrep_local(hp):
+    return {"server": hp(0)}
+
+
+def _unrep_parse(data):
+    return host_port(data["server"])
+
+
+def _unrep_build_server(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols import unreplicated as unrep
+    from frankenpaxos_tpu.statemachine import KeyValueStore
+
+    return unrep.Server(config, t, logger, KeyValueStore())
+
+
+def _unrep_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import unreplicated as unrep
+
+    return unrep.Client(listen, t, logger, config)
+
+
+def _kv_issue(client, pseudonym, counter):
+    from frankenpaxos_tpu.statemachine import kv_set
+
+    return client.propose(pseudonym, kv_set((f"k{counter % 16}", f"v{counter}")))
+
+
+register(ProtocolSpec(
+    name="unreplicated",
+    local_config=_unrep_local,
+    parse_config=_unrep_parse,
+    roles={"server": RoleDef(count=lambda c: 1, build=_unrep_build_server)},
+    make_client=_unrep_client,
+    issue=_kv_issue,
+    client_lag=0.5,
+))
+
+
+# --------------------------------------------------------------------------
+# batchedunreplicated
+# --------------------------------------------------------------------------
+
+
+def _bu_local(hp):
+    return {
+        "batchers": [hp(0), hp(1)],
+        "server": hp(2),
+        "proxy_servers": [hp(3)],
+    }
+
+
+def _bu_parse(data):
+    from frankenpaxos_tpu.protocols import batchedunreplicated as bu
+
+    return bu.BatchedUnreplicatedConfig(
+        batcher_addresses=host_ports(data["batchers"]),
+        server_address=host_port(data["server"]),
+        proxy_server_addresses=host_ports(data["proxy_servers"]),
+    )
+
+
+def _bu_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import batchedunreplicated as bu
+        from frankenpaxos_tpu.statemachine import KeyValueStore
+
+        if role == "server":
+            return bu.BuServer(config.server_address, t, logger, config,
+                               KeyValueStore())
+        if role == "batcher":
+            return bu.BuBatcher(config.batcher_addresses[index], t, logger,
+                                config, bu.BuBatcherOptions(batch_size=2))
+        return bu.BuProxyServer(config.proxy_server_addresses[index], t,
+                                logger, config)
+
+    return build
+
+
+def _bu_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import batchedunreplicated as bu
+
+    return bu.BuClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="batchedunreplicated",
+    local_config=_bu_local,
+    parse_config=_bu_parse,
+    roles={
+        "server": RoleDef(count=lambda c: 1, build=_bu_build("server")),
+        "proxy_server": RoleDef(
+            count=lambda c: len(c.proxy_server_addresses),
+            build=_bu_build("proxy_server"),
+        ),
+        "batcher": RoleDef(
+            count=lambda c: len(c.batcher_addresses),
+            build=_bu_build("batcher"),
+        ),
+    },
+    make_client=_bu_client,
+    issue=_kv_issue,
+))
+
+
+# --------------------------------------------------------------------------
+# paxos / fastpaxos / caspaxos (leader+acceptor protocols)
+# --------------------------------------------------------------------------
+
+
+def _la_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "acceptors": [hp(2), hp(3), hp(4)],
+    }
+
+
+def _paxos_parse(data):
+    from frankenpaxos_tpu.protocols import paxos as px
+
+    return px.PaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+    )
+
+
+def _paxos_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import paxos as px
+
+        if role == "leader":
+            return px.PaxosLeader(config.leader_addresses[index], t, logger,
+                                  config)
+        return px.PaxosAcceptor(config.acceptor_addresses[index], t, logger,
+                                config)
+
+    return build
+
+
+def _paxos_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import paxos as px
+
+    return px.PaxosClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="paxos",
+    local_config=_la_local,
+    parse_config=_paxos_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_paxos_build("acceptor")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_paxos_build("leader")),
+    },
+    make_client=_paxos_client,
+    # Single-decree: repeated proposes re-learn the one chosen value.
+    issue=lambda client, pseudonym, counter: client.propose(f"v{counter}"),
+    max_ops=20,
+))
+
+
+def _fastpaxos_parse(data):
+    from frankenpaxos_tpu.protocols import fastpaxos as fp
+
+    return fp.FastPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+    )
+
+
+def _fastpaxos_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import fastpaxos as fp
+
+        if role == "leader":
+            return fp.FpLeader(config.leader_addresses[index], t, logger,
+                               config)
+        return fp.FpAcceptor(config.acceptor_addresses[index], t, logger,
+                             config)
+
+    return build
+
+
+def _fastpaxos_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import fastpaxos as fp
+
+    return fp.FpClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="fastpaxos",
+    local_config=_la_local,
+    parse_config=_fastpaxos_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_fastpaxos_build("acceptor")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_fastpaxos_build("leader")),
+    },
+    make_client=_fastpaxos_client,
+    issue=lambda client, pseudonym, counter: client.propose(f"v{counter}"),
+    max_ops=20,
+))
+
+
+def _caspaxos_parse(data):
+    from frankenpaxos_tpu.protocols import caspaxos as cas
+
+    return cas.CasPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+    )
+
+
+def _caspaxos_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import caspaxos as cas
+
+        if role == "leader":
+            return cas.CasLeader(config.leader_addresses[index], t, logger,
+                                 config)
+        return cas.CasAcceptor(config.acceptor_addresses[index], t, logger,
+                               config)
+
+    return build
+
+
+def _caspaxos_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import caspaxos as cas
+
+    return cas.CasClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="caspaxos",
+    local_config=_la_local,
+    parse_config=_caspaxos_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_caspaxos_build("acceptor")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_caspaxos_build("leader")),
+    },
+    make_client=_caspaxos_client,
+    issue=lambda client, pseudonym, counter: client.propose({counter}),
+    max_ops=20,
+))
+
+
+# --------------------------------------------------------------------------
+# craq
+# --------------------------------------------------------------------------
+
+
+def _craq_local(hp):
+    return {"f": 1, "chain_nodes": [hp(0), hp(1), hp(2)]}
+
+
+def _craq_parse(data):
+    from frankenpaxos_tpu.protocols import craq as cq
+
+    return cq.CraqConfig(
+        f=data["f"], chain_node_addresses=host_ports(data["chain_nodes"])
+    )
+
+
+def _craq_build(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols import craq as cq
+
+    return cq.ChainNode(config.chain_node_addresses[index], t, logger,
+                        config, seed=seed + index)
+
+
+def _craq_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import craq as cq
+
+    return cq.CraqClient(listen, t, logger, config)
+
+
+def _craq_issue(client, pseudonym, counter):
+    if counter % 4 == 3:
+        return client.read(pseudonym, f"k{counter % 8}")
+    return client.write(pseudonym, f"k{counter % 8}", f"v{counter}")
+
+
+register(ProtocolSpec(
+    name="craq",
+    local_config=_craq_local,
+    parse_config=_craq_parse,
+    roles={
+        "chain_node": RoleDef(count=lambda c: len(c.chain_node_addresses),
+                              build=_craq_build),
+    },
+    make_client=_craq_client,
+    issue=_craq_issue,
+))
+
+
+# --------------------------------------------------------------------------
+# epaxos
+# --------------------------------------------------------------------------
+
+
+def _epaxos_local(hp):
+    return {"f": 1, "replicas": [hp(0), hp(1), hp(2)]}
+
+
+def _epaxos_parse(data):
+    from frankenpaxos_tpu.protocols import epaxos as ep
+
+    return ep.EPaxosConfig(
+        f=data["f"], replica_addresses=host_ports(data["replicas"])
+    )
+
+
+def _epaxos_build(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols import epaxos as ep
+    from frankenpaxos_tpu.statemachine import KeyValueStore
+
+    return ep.EpReplica(config.replica_addresses[index], t, logger, config,
+                        KeyValueStore(), seed=seed + index)
+
+
+def _epaxos_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import epaxos as ep
+
+    return ep.EpClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="epaxos",
+    local_config=_epaxos_local,
+    parse_config=_epaxos_parse,
+    roles={
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_epaxos_build),
+    },
+    make_client=_epaxos_client,
+    issue=_kv_issue,
+))
+
+
+# --------------------------------------------------------------------------
+# simplebpaxos / unanimousbpaxos / simplegcbpaxos
+# --------------------------------------------------------------------------
+
+
+def _sbp_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "proposers": [hp(2), hp(3)],
+        "dep_service_nodes": [hp(4), hp(5), hp(6)],
+        "acceptors": [hp(7), hp(8), hp(9)],
+        "replicas": [hp(10), hp(11)],
+    }
+
+
+def _sbp_parse(data):
+    from frankenpaxos_tpu.protocols import simplebpaxos as bpx
+
+    return bpx.SimpleBPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        proposer_addresses=host_ports(data["proposers"]),
+        dep_service_node_addresses=host_ports(data["dep_service_nodes"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+    )
+
+
+def _sbp_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import simplebpaxos as bpx
+        from frankenpaxos_tpu.statemachine import KeyValueStore
+
+        if role == "leader":
+            return bpx.BpLeader(config.leader_addresses[index], t, logger,
+                                config)
+        if role == "proposer":
+            return bpx.BpProposer(config.proposer_addresses[index], t,
+                                  logger, config)
+        if role == "dep_service_node":
+            return bpx.BpDepServiceNode(
+                config.dep_service_node_addresses[index], t, logger, config,
+                KeyValueStore())
+        if role == "acceptor":
+            return bpx.BpAcceptor(config.acceptor_addresses[index], t,
+                                  logger, config)
+        return bpx.BpReplica(config.replica_addresses[index], t, logger,
+                             config, KeyValueStore())
+
+    return build
+
+
+def _sbp_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import simplebpaxos as bpx
+
+    return bpx.BpClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="simplebpaxos",
+    local_config=_sbp_local,
+    parse_config=_sbp_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_sbp_build("acceptor")),
+        "dep_service_node": RoleDef(
+            count=lambda c: len(c.dep_service_node_addresses),
+            build=_sbp_build("dep_service_node")),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_sbp_build("replica")),
+        "proposer": RoleDef(count=lambda c: len(c.proposer_addresses),
+                            build=_sbp_build("proposer")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_sbp_build("leader")),
+    },
+    make_client=_sbp_client,
+    issue=_kv_issue,
+))
+
+
+def _ubp_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "dep_service_nodes": [hp(2), hp(3), hp(4)],
+        "acceptors": [hp(5), hp(6), hp(7)],
+    }
+
+
+def _ubp_parse(data):
+    from frankenpaxos_tpu.protocols import unanimousbpaxos as ubx
+
+    return ubx.UnanimousBPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        dep_service_node_addresses=host_ports(data["dep_service_nodes"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+    )
+
+
+def _ubp_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import unanimousbpaxos as ubx
+        from frankenpaxos_tpu.statemachine import KeyValueStore
+
+        if role == "leader":
+            return ubx.UbLeader(config.leader_addresses[index], t, logger,
+                                config, KeyValueStore())
+        if role == "dep_service_node":
+            return ubx.UbDepServiceNode(
+                config.dep_service_node_addresses[index], t, logger, config,
+                KeyValueStore())
+        return ubx.UbAcceptor(config.acceptor_addresses[index], t, logger,
+                              config)
+
+    return build
+
+
+def _ubp_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import unanimousbpaxos as ubx
+
+    return ubx.UbClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="unanimousbpaxos",
+    local_config=_ubp_local,
+    parse_config=_ubp_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_ubp_build("acceptor")),
+        "dep_service_node": RoleDef(
+            count=lambda c: len(c.dep_service_node_addresses),
+            build=_ubp_build("dep_service_node")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_ubp_build("leader")),
+    },
+    make_client=_ubp_client,
+    issue=_kv_issue,
+))
+
+
+def _gcb_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "proposers": [hp(2), hp(3)],
+        "dep_service_nodes": [hp(4), hp(5), hp(6)],
+        "acceptors": [hp(7), hp(8), hp(9)],
+        "replicas": [hp(10), hp(11)],
+        "garbage_collectors": [hp(12), hp(13)],
+    }
+
+
+def _gcb_parse(data):
+    from frankenpaxos_tpu.protocols import simplegcbpaxos as gcb
+
+    return gcb.SimpleGcBPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        proposer_addresses=host_ports(data["proposers"]),
+        dep_service_node_addresses=host_ports(data["dep_service_nodes"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+        garbage_collector_addresses=host_ports(data["garbage_collectors"]),
+    )
+
+
+def _gcb_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import simplegcbpaxos as gcb
+        from frankenpaxos_tpu.statemachine import KeyValueStore
+
+        if role == "leader":
+            return gcb.GcLeader(config.leader_addresses[index], t, logger,
+                                config, seed=seed + index)
+        if role == "proposer":
+            return gcb.GcProposer(config.proposer_addresses[index], t,
+                                  logger, config, seed=seed + 10 + index)
+        if role == "dep_service_node":
+            return gcb.GcDepServiceNode(
+                config.dep_service_node_addresses[index], t, logger, config,
+                KeyValueStore())
+        if role == "acceptor":
+            return gcb.GcAcceptor(config.acceptor_addresses[index], t,
+                                  logger, config)
+        if role == "replica":
+            return gcb.GcReplica(config.replica_addresses[index], t, logger,
+                                 config, KeyValueStore(), seed=seed + 30 + index)
+        return gcb.GcGarbageCollector(
+            config.garbage_collector_addresses[index], t, logger, config)
+
+    return build
+
+
+def _gcb_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import simplegcbpaxos as gcb
+
+    return gcb.GcClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="simplegcbpaxos",
+    local_config=_gcb_local,
+    parse_config=_gcb_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_gcb_build("acceptor")),
+        "dep_service_node": RoleDef(
+            count=lambda c: len(c.dep_service_node_addresses),
+            build=_gcb_build("dep_service_node")),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_gcb_build("replica")),
+        "garbage_collector": RoleDef(
+            count=lambda c: len(c.garbage_collector_addresses),
+            build=_gcb_build("garbage_collector")),
+        "proposer": RoleDef(count=lambda c: len(c.proposer_addresses),
+                            build=_gcb_build("proposer")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_gcb_build("leader")),
+    },
+    make_client=_gcb_client,
+    issue=_kv_issue,
+))
+
+
+# --------------------------------------------------------------------------
+# vanillamencius / fasterpaxos (server-only protocols w/ heartbeats)
+# --------------------------------------------------------------------------
+
+
+def _vm_local(hp):
+    return {
+        "f": 1,
+        "servers": [hp(0), hp(1), hp(2)],
+        "heartbeats": [hp(3), hp(4), hp(5)],
+    }
+
+
+def _vm_parse(data):
+    from frankenpaxos_tpu.protocols import vanillamencius as vmn
+
+    return vmn.VanillaMenciusConfig(
+        f=data["f"],
+        server_addresses=host_ports(data["servers"]),
+        heartbeat_addresses=host_ports(data["heartbeats"]),
+    )
+
+
+def _vm_build(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols import vanillamencius as vmn
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    return vmn.VmServer(config.server_addresses[index], t, logger, config,
+                        ReadableAppendLog(), seed=seed + index)
+
+
+def _vm_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import vanillamencius as vmn
+
+    return vmn.VmClient(listen, t, logger, config, seed=seed)
+
+
+def _bytes_issue(client, pseudonym, counter):
+    return client.propose(pseudonym, f"cmd{counter}".encode())
+
+
+register(ProtocolSpec(
+    name="vanillamencius",
+    local_config=_vm_local,
+    parse_config=_vm_parse,
+    roles={
+        "server": RoleDef(count=lambda c: len(c.server_addresses),
+                          build=_vm_build),
+    },
+    make_client=_vm_client,
+    issue=_bytes_issue,
+))
+
+
+def _fpr_local(hp):
+    return {
+        "f": 1,
+        "servers": [hp(0), hp(1), hp(2)],
+        "heartbeats": [hp(3), hp(4), hp(5)],
+    }
+
+
+def _fpr_parse(data):
+    from frankenpaxos_tpu.protocols import fasterpaxos as fpx
+
+    return fpx.FasterPaxosConfig(
+        f=data["f"],
+        server_addresses=host_ports(data["servers"]),
+        heartbeat_addresses=host_ports(data["heartbeats"]),
+    )
+
+
+def _fpr_build(config, index, group, t, logger, seed):
+    from frankenpaxos_tpu.protocols import fasterpaxos as fpx
+    from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+    # Server 0 runs phase 1 + Phase2aAny at startup, racing its peers'
+    # socket binds; a short resend converges the startup handshake fast.
+    return fpx.FprServer(config.server_addresses[index], t, logger, config,
+                         ReadableAppendLog(),
+                         fpx.FprServerOptions(resend_phase1as_period=0.5,
+                                              resend_phase2a_anys_period=0.5),
+                         seed=seed + index)
+
+
+def _fpr_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import fasterpaxos as fpx
+
+    return fpx.FprClient(listen, t, logger, config, resend_period=1.0,
+                         seed=seed)
+
+
+register(ProtocolSpec(
+    name="fasterpaxos",
+    local_config=_fpr_local,
+    parse_config=_fpr_parse,
+    roles={
+        "server": RoleDef(count=lambda c: len(c.server_addresses),
+                          build=_fpr_build),
+    },
+    make_client=_fpr_client,
+    issue=_bytes_issue,
+    client_lag=2.5,  # server 0 runs phase 1 + Phase2aAny at startup
+))
+
+
+# --------------------------------------------------------------------------
+# mencius (compartmentalized)
+# --------------------------------------------------------------------------
+
+
+def _mnc_local(hp):
+    return {
+        "f": 1,
+        "batchers": [],
+        "leader_groups": [[hp(0), hp(1)], [hp(2), hp(3)], [hp(4), hp(5)]],
+        "leader_election_groups": [
+            [hp(6), hp(7)], [hp(8), hp(9)], [hp(10), hp(11)],
+        ],
+        "proxy_leaders": [hp(12), hp(13)],
+        "acceptors": [[hp(14), hp(15), hp(16)], [hp(17), hp(18), hp(19)]],
+        "replicas": [hp(20), hp(21)],
+        "proxy_replicas": [],
+    }
+
+
+def _mnc_parse(data):
+    from frankenpaxos_tpu.protocols import mencius as mnc
+
+    return mnc.MenciusConfig(
+        f=data["f"],
+        batcher_addresses=host_ports(data.get("batchers", [])),
+        leader_groups=_hp_groups(data["leader_groups"]),
+        leader_election_groups=_hp_groups(data["leader_election_groups"]),
+        proxy_leader_addresses=host_ports(data["proxy_leaders"]),
+        acceptor_addresses=_hp_groups(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+        proxy_replica_addresses=host_ports(data.get("proxy_replicas", [])),
+    )
+
+
+def _mnc_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import mencius as mnc
+        from frankenpaxos_tpu.protocols import multipaxos as mpx
+        from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+        if role == "leader":
+            # Flat index over the leader groups (a member per process).
+            return mnc.MenciusLeader(
+                config.leader_addresses[index], t, logger, config,
+                mnc.MenciusLeaderOptions(send_watermark_every_n=1),
+                seed=seed + index)
+        if role == "proxy_leader":
+            return mpx.ProxyLeader(config.proxy_leader_addresses[index], t,
+                                   logger, config, seed=seed + 10 + index)
+        if role == "acceptor":
+            return mnc.MenciusAcceptor(
+                config.acceptor_addresses[group][index], t, logger, config)
+        return mpx.Replica(config.replica_addresses[index], t, logger,
+                           ReadableAppendLog(), config, seed=seed + 20 + index)
+
+    return build
+
+
+def _mnc_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import mencius as mnc
+
+    return mnc.MenciusClient(listen, t, logger, config, seed=seed)
+
+
+def _write_issue(client, pseudonym, counter):
+    return client.write(pseudonym, f"cmd{counter}".encode())
+
+
+register(ProtocolSpec(
+    name="mencius",
+    local_config=_mnc_local,
+    parse_config=_mnc_parse,
+    roles={
+        "acceptor": RoleDef(
+            count=lambda c: (len(c.acceptor_addresses),
+                             len(c.acceptor_addresses[0])),
+            build=_mnc_build("acceptor"), grouped=True),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_mnc_build("replica")),
+        "proxy_leader": RoleDef(count=lambda c: len(c.proxy_leader_addresses),
+                                build=_mnc_build("proxy_leader")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_mnc_build("leader")),
+    },
+    make_client=_mnc_client,
+    issue=_write_issue,
+    client_lag=2.5,
+))
+
+
+# --------------------------------------------------------------------------
+# fastmultipaxos
+# --------------------------------------------------------------------------
+
+
+def _fmx_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "leader_elections": [hp(2), hp(3)],
+        "leader_heartbeats": [hp(4), hp(5)],
+        "acceptors": [hp(6), hp(7), hp(8)],
+        "acceptor_heartbeats": [hp(9), hp(10), hp(11)],
+    }
+
+
+def _fmx_parse(data):
+    from frankenpaxos_tpu.protocols import fastmultipaxos as fmx
+    from frankenpaxos_tpu.roundsystem import MixedRoundRobin
+
+    return fmx.FastMultiPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        leader_election_addresses=host_ports(data["leader_elections"]),
+        leader_heartbeat_addresses=host_ports(data["leader_heartbeats"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        acceptor_heartbeat_addresses=host_ports(data["acceptor_heartbeats"]),
+        round_system=MixedRoundRobin(len(data["leaders"])),
+    )
+
+
+def _fmx_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import fastmultipaxos as fmx
+        from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+        if role == "leader":
+            return fmx.FmpLeader(config.leader_addresses[index], t, logger,
+                                 config, ReadableAppendLog(),
+                                 seed=seed + index)
+        return fmx.FmpAcceptor(config.acceptor_addresses[index], t, logger,
+                               config, seed=seed + 10 + index)
+
+    return build
+
+
+def _fmx_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import fastmultipaxos as fmx
+
+    return fmx.FmpClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="fastmultipaxos",
+    local_config=_fmx_local,
+    parse_config=_fmx_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_fmx_build("acceptor")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_fmx_build("leader")),
+    },
+    make_client=_fmx_client,
+    issue=_bytes_issue,
+    client_lag=2.5,  # leader 0 finishes phase 1 + any-suffix first
+))
+
+
+# --------------------------------------------------------------------------
+# matchmakerpaxos / matchmakermultipaxos / horizontal
+# --------------------------------------------------------------------------
+
+
+def _mmp_local(hp):
+    # The client's listen address is part of the config; the deployment
+    # smoke's client listens on hp(50) (see harness.smoke.deploy_smoke).
+    return {
+        "f": 1,
+        "clients": [hp(50)],
+        "leaders": [hp(1), hp(2)],
+        "matchmakers": [hp(3), hp(4), hp(5)],
+        "acceptors": [hp(6), hp(7), hp(8), hp(9)],
+    }
+
+
+def _mmp_parse(data):
+    from frankenpaxos_tpu.protocols import matchmakerpaxos as mmx
+
+    return mmx.MatchmakerPaxosConfig(
+        f=data["f"],
+        client_addresses=host_ports(data["clients"]),
+        leader_addresses=host_ports(data["leaders"]),
+        matchmaker_addresses=host_ports(data["matchmakers"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+    )
+
+
+def _mmp_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import matchmakerpaxos as mmx
+
+        if role == "leader":
+            return mmx.MmLeader(config.leader_addresses[index], t, logger,
+                                config)
+        if role == "matchmaker":
+            return mmx.MmMatchmaker(config.matchmaker_addresses[index], t,
+                                    logger, config)
+        return mmx.MmAcceptor(config.acceptor_addresses[index], t, logger,
+                              config)
+
+    return build
+
+
+def _mmp_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import matchmakerpaxos as mmx
+
+    return mmx.MmClient(listen, t, logger, config)
+
+
+register(ProtocolSpec(
+    name="matchmakerpaxos",
+    local_config=_mmp_local,
+    parse_config=_mmp_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_mmp_build("acceptor")),
+        "matchmaker": RoleDef(count=lambda c: len(c.matchmaker_addresses),
+                              build=_mmp_build("matchmaker")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_mmp_build("leader")),
+    },
+    make_client=_mmp_client,
+    issue=lambda client, pseudonym, counter: client.propose(f"v{counter}"),
+    max_ops=20,
+))
+
+
+def _mxm_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "leader_elections": [hp(2), hp(3)],
+        "reconfigurers": [hp(4), hp(5)],
+        "matchmakers": [hp(6), hp(7), hp(8), hp(9)],
+        "acceptors": [hp(10), hp(11), hp(12), hp(13)],
+        "replicas": [hp(14), hp(15)],
+    }
+
+
+def _mxm_parse(data):
+    from frankenpaxos_tpu.protocols import matchmakermultipaxos as mmx
+
+    return mmx.MatchmakerMultiPaxosConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        leader_election_addresses=host_ports(data["leader_elections"]),
+        reconfigurer_addresses=host_ports(data["reconfigurers"]),
+        matchmaker_addresses=host_ports(data["matchmakers"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+    )
+
+
+def _mxm_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import matchmakermultipaxos as mmx
+        from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+        if role == "leader":
+            return mmx.MmmLeader(config.leader_addresses[index], t, logger,
+                                 config, seed=seed + index)
+        if role == "reconfigurer":
+            return mmx.MmmReconfigurer(config.reconfigurer_addresses[index],
+                                       t, logger, config,
+                                       seed=seed + 10 + index)
+        if role == "matchmaker":
+            return mmx.MmmMatchmaker(config.matchmaker_addresses[index], t,
+                                     logger, config)
+        if role == "acceptor":
+            return mmx.MmmAcceptor(config.acceptor_addresses[index], t,
+                                   logger, config)
+        return mmx.MmmReplica(config.replica_addresses[index], t, logger,
+                              config, ReadableAppendLog(),
+                              seed=seed + 30 + index)
+
+    return build
+
+
+def _mxm_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import matchmakermultipaxos as mmx
+
+    return mmx.MmmClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="matchmakermultipaxos",
+    local_config=_mxm_local,
+    parse_config=_mxm_parse,
+    roles={
+        "matchmaker": RoleDef(count=lambda c: len(c.matchmaker_addresses),
+                              build=_mxm_build("matchmaker")),
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_mxm_build("acceptor")),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_mxm_build("replica")),
+        "reconfigurer": RoleDef(count=lambda c: len(c.reconfigurer_addresses),
+                                build=_mxm_build("reconfigurer")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_mxm_build("leader")),
+    },
+    make_client=_mxm_client,
+    issue=_bytes_issue,
+    client_lag=2.5,  # leader 0 matchmakes + runs phase 1 at startup
+))
+
+
+def _hzx_local(hp):
+    return {
+        "f": 1,
+        "leaders": [hp(0), hp(1)],
+        "leader_elections": [hp(2), hp(3)],
+        "acceptors": [hp(4), hp(5), hp(6), hp(7)],
+        "replicas": [hp(8), hp(9)],
+    }
+
+
+def _hzx_parse(data):
+    from frankenpaxos_tpu.protocols import horizontal as hzx
+
+    return hzx.HorizontalConfig(
+        f=data["f"],
+        leader_addresses=host_ports(data["leaders"]),
+        leader_election_addresses=host_ports(data["leader_elections"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+    )
+
+
+def _hzx_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import horizontal as hzx
+        from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+        if role == "leader":
+            return hzx.HzLeader(config.leader_addresses[index], t, logger,
+                                config, seed=seed + index)
+        if role == "acceptor":
+            return hzx.HzAcceptor(config.acceptor_addresses[index], t,
+                                  logger, config)
+        return hzx.HzReplica(config.replica_addresses[index], t, logger,
+                             config, ReadableAppendLog(),
+                             seed=seed + 30 + index)
+
+    return build
+
+
+def _hzx_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import horizontal as hzx
+
+    return hzx.HzClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="horizontal",
+    local_config=_hzx_local,
+    parse_config=_hzx_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_hzx_build("acceptor")),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_hzx_build("replica")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_hzx_build("leader")),
+    },
+    make_client=_hzx_client,
+    issue=_bytes_issue,
+    client_lag=2.5,  # leader 0 runs the initial chunk's phase 1
+))
+
+
+# --------------------------------------------------------------------------
+# scalog
+# --------------------------------------------------------------------------
+
+
+def _scx_local(hp):
+    return {
+        "f": 1,
+        "servers": [[hp(0), hp(1)], [hp(2), hp(3)]],
+        "aggregator": hp(4),
+        "leaders": [hp(5), hp(6)],
+        "acceptors": [hp(7), hp(8), hp(9)],
+        "replicas": [hp(10), hp(11)],
+    }
+
+
+def _scx_parse(data):
+    from frankenpaxos_tpu.protocols import scalog as scx
+
+    return scx.ScalogConfig(
+        f=data["f"],
+        server_addresses=_hp_groups(data["servers"]),
+        aggregator_address=host_port(data["aggregator"]),
+        leader_addresses=host_ports(data["leaders"]),
+        acceptor_addresses=host_ports(data["acceptors"]),
+        replica_addresses=host_ports(data["replicas"]),
+    )
+
+
+def _scx_build(role):
+    def build(config, index, group, t, logger, seed):
+        from frankenpaxos_tpu.protocols import scalog as scx
+        from frankenpaxos_tpu.protocols.multipaxos.replica import Replica
+        from frankenpaxos_tpu.statemachine import ReadableAppendLog
+
+        if role == "server":
+            return scx.ScServer(
+                config.server_addresses[group][index], t, logger, config,
+                scx.ScServerOptions(push_size=1), seed=seed + index)
+        if role == "aggregator":
+            return scx.ScAggregator(
+                config.aggregator_address, t, logger, config,
+                scx.ScAggregatorOptions(num_shard_cuts_per_proposal=1))
+        if role == "leader":
+            return scx.ScLeader(config.leader_addresses[index], t, logger,
+                                config, seed=seed + 10 + index)
+        if role == "acceptor":
+            return scx.ScAcceptor(config.acceptor_addresses[index], t,
+                                  logger, config)
+        return Replica(config.replica_addresses[index], t, logger,
+                       ReadableAppendLog(), scx.replica_config(config),
+                       seed=seed + 20 + index)
+
+    return build
+
+
+def _scx_client(config, listen, t, logger, seed):
+    from frankenpaxos_tpu.protocols import scalog as scx
+
+    return scx.ScClient(listen, t, logger, config, seed=seed)
+
+
+register(ProtocolSpec(
+    name="scalog",
+    local_config=_scx_local,
+    parse_config=_scx_parse,
+    roles={
+        "acceptor": RoleDef(count=lambda c: len(c.acceptor_addresses),
+                            build=_scx_build("acceptor")),
+        "replica": RoleDef(count=lambda c: len(c.replica_addresses),
+                           build=_scx_build("replica")),
+        "leader": RoleDef(count=lambda c: len(c.leader_addresses),
+                          build=_scx_build("leader")),
+        "aggregator": RoleDef(count=lambda c: 1,
+                              build=_scx_build("aggregator")),
+        "server": RoleDef(
+            count=lambda c: (len(c.server_addresses),
+                             len(c.server_addresses[0])),
+            build=_scx_build("server"), grouped=True),
+    },
+    make_client=_scx_client,
+    issue=_write_issue,
+    client_lag=2.5,
+))

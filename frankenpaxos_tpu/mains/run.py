@@ -1,0 +1,156 @@
+"""Generic role main over the deployment registry (the analog of the
+reference's ~60 per-role mains, ``jvm/.../<proto>/<Role>Main.scala``):
+
+    python -m frankenpaxos_tpu.mains.run --protocol epaxos \\
+        --role replica --index 0 --config cluster.json
+
+    python -m frankenpaxos_tpu.mains.run --protocol epaxos \\
+        --role client --listen 127.0.0.1:19050 --config cluster.json \\
+        --duration 5 --num_pseudonyms 3 --output recorder.csv
+
+MultiPaxos keeps its dedicated main (``frankenpaxos_tpu.mains.multipaxos``)
+for its read-consistency and workload flags; every other protocol deploys
+through this one. The client role runs closed-loop benchmark clients
+(BenchmarkUtil.scala runFor/timed): each pseudonym keeps one outstanding
+operation, and completions append ``start,stop,latency_nanos,label`` rows
+to the recorder CSV.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from frankenpaxos_tpu.core.tcp_transport import TcpTransport
+from frankenpaxos_tpu.mains.common import (
+    add_common_args,
+    host_port,
+    load_config_json,
+    make_logger,
+)
+from frankenpaxos_tpu.mains.registry import REGISTRY
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu.mains.run")
+    parser.add_argument("--protocol", required=True, choices=sorted(REGISTRY))
+    parser.add_argument("--role", required=True)
+    parser.add_argument("--index", type=int, default=0)
+    parser.add_argument("--group_index", type=int, default=0)
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--seed", type=int, default=0)
+    # Client-role flags (ClientMain.scala:24-79).
+    parser.add_argument("--listen", help="client listen address host:port")
+    parser.add_argument("--duration", type=float, default=5.0)
+    parser.add_argument("--warmup", type=float, default=0.5)
+    parser.add_argument("--num_pseudonyms", type=int, default=1)
+    parser.add_argument("--output", default="recorder.csv")
+    add_common_args(parser)
+    args = parser.parse_args()
+
+    spec = REGISTRY[args.protocol]
+    config = spec.parse_config(load_config_json(args.config))
+    logger = make_logger(args)
+    transport = TcpTransport(logger)
+
+    if args.role == "client":
+        if not args.listen:
+            parser.error("--listen is required for --role client")
+        run_client(spec, args, config, logger, transport)
+        return
+
+    if args.role not in spec.roles:
+        parser.error(
+            f"unknown role {args.role!r} for {spec.name}; "
+            f"choose from {sorted(spec.roles)} or 'client'"
+        )
+    spec.roles[args.role].build(
+        config, args.index, args.group_index, transport, logger, args.seed
+    )
+    transport.run()
+
+
+def run_client(spec, args, config, logger, transport) -> None:
+    listen = host_port(args.listen)
+    client = spec.make_client(config, listen, transport, logger, args.seed)
+    out = open(args.output, "w")
+    out.write("start,stop,latency_nanos,label\n")
+    stop_at = None
+    warmup_until = 0.0
+    counter = [0]
+
+    def issue(pseudonym: int) -> None:
+        # Trampoline: a promise that resolves synchronously (e.g. a
+        # single-decree client answering from its learned value) must not
+        # recurse through its completion callback.
+        again = True
+        while again:
+            again = False
+            if spec.max_ops is not None and counter[0] >= spec.max_ops:
+                return
+            n = counter[0]
+            counter[0] += 1
+            start = time.time()
+            promise = spec.issue(client, pseudonym, n)
+            in_call = [True]
+            sync = [False]
+
+            def done(p, n=n, start=start, in_call=in_call, sync=sync) -> None:
+                stop = time.time()
+                if stop_at is None or stop >= stop_at:
+                    return
+                if p.exception is not None:
+                    # Don't let one failed op silently kill this
+                    # pseudonym's loop (e.g. a single-pending client
+                    # rejecting a concurrent propose): log and retry
+                    # shortly — never synchronously, or a persistent
+                    # failure would spin.
+                    print(f"op {n} failed: {p.exception!r}", file=sys.stderr)
+                    retry = transport.timer(
+                        listen, f"retryOp{n}", 0.25, lambda: issue(pseudonym)
+                    )
+                    retry.start()
+                    return
+                if time.time() >= warmup_until:
+                    out.write(
+                        f"{start},{stop},{int((stop - start) * 1e9)},op\n"
+                    )
+                if in_call[0]:
+                    sync[0] = True
+                else:
+                    issue(pseudonym)
+
+            promise.on_complete(done)
+            in_call[0] = False
+            again = sync[0]
+
+    def kick() -> None:
+        nonlocal stop_at, warmup_until
+        stop_at = time.time() + args.duration
+        warmup_until = time.time() + args.warmup
+        if spec.issue is not None:
+            for pseudonym in range(args.num_pseudonyms):
+                issue(pseudonym)
+        # else: an echo-style client drives itself on its ping timer.
+
+    shutdown = transport.timer(
+        listen, "shutdown", args.duration + 1.0, transport.shutdown
+    )
+    shutdown.start()
+    transport.run(on_start=kick)
+
+    if spec.issue is None:
+        # Echo-style: completions are reply counts, not promises.
+        n = getattr(client, "num_messages_received", 0)
+        now = time.time()
+        for _ in range(n):
+            out.write(f"{now},{now},0,op\n")
+        if n == 0:
+            out.close()
+            raise SystemExit(f"no replies received by {spec.name} client")
+    out.close()
+
+
+if __name__ == "__main__":
+    main()

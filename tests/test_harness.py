@@ -171,3 +171,37 @@ def test_in_process_smokes():
     ]:
         result = smoke.SMOKES[name](None)
         assert result["requests"] > 0, name
+
+
+def test_deployment_registry_consistent():
+    """Every protocol spec's generated local config parses into a valid
+    Config whose role counts are well-formed (each role constructible)."""
+    from frankenpaxos_tpu.mains.registry import REGISTRY
+
+    assert len(REGISTRY) == 19  # all protocols except multipaxos (own main)
+    for name, spec in REGISTRY.items():
+        hp = lambda i: f"127.0.0.1:{19000 + i}"
+        data = spec.local_config(hp)
+        config = spec.parse_config(data)
+        for role_name, role in spec.roles.items():
+            cnt = role.count(config)
+            if role.grouped:
+                groups, per_group = cnt
+                assert groups > 0 and per_group > 0, (name, role_name)
+            else:
+                assert cnt > 0, (name, role_name)
+        assert spec.make_client is not None, name
+
+
+def test_deploy_smokes_sample(tmp_path):
+    """Real multi-process TCP deployments of a leader-based and a
+    leaderless protocol (the full set runs via
+    ``python -m frankenpaxos_tpu.harness.smoke --deploy``)."""
+    from frankenpaxos_tpu.harness.benchmark import BenchmarkDirectory
+    from frankenpaxos_tpu.harness import smoke
+
+    for name in ["paxos", "epaxos"]:
+        bench = BenchmarkDirectory(str(tmp_path / name))
+        with bench:
+            result = smoke.deploy_smoke(name, bench, duration=2.0)
+        assert result["requests"] > 0, name
