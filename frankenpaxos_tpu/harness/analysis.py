@@ -1,0 +1,185 @@
+"""The analysis layer (L6): recorder CSVs -> pandas -> summaries/plots.
+
+The reference's benchmark results are analyzed with a small pandas
+toolbox (``benchmarks/pd_util.py``: concatenated CSV loading, outlier
+pruning, rolling-window throughput, counter rates) feeding matplotlib
+plot scripts (``benchmarks/plot_latency_and_throughput.py`` and the
+per-paper figure directories). This module provides the same capability
+surface over this framework's recorder CSVs (``start,stop,
+latency_nanos,label`` rows with unix-epoch float timestamps, written by
+the closed-loop client mains) and over Suite ``results.csv`` tables.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+def read_recorder_csvs(paths: Iterable[str]) -> pd.DataFrame:
+    """Load one or more recorder CSVs into a single frame indexed by start
+    time (datetime), with a ``latency_ms`` column (pd_util.read_csvs)."""
+    frames = [pd.read_csv(p, header=0) for p in paths]
+    df = pd.concat(frames, ignore_index=True)
+    df["start"] = pd.to_datetime(df["start"], unit="s")
+    df["stop"] = pd.to_datetime(df["stop"], unit="s")
+    df["latency_ms"] = df["latency_nanos"] / 1e6
+    df = df.sort_values("start")
+    df.index = df["start"]
+    return df
+
+
+def outliers(s: pd.Series, n_std: float) -> pd.Series:
+    """Boolean mask of values >= n_std standard deviations from the mean
+    (pd_util.outliers); prune with ``s[~outliers(s, n)]``."""
+    return (s - s.mean()).abs() >= n_std * s.std()
+
+
+def rolling_throughput(
+    timestamps: pd.Series, window_ms: float = 1000.0, trim: bool = True
+) -> pd.Series:
+    """Events/second over rolling windows whose right edges are the given
+    timestamps (pd_util.throughput). ``trim`` drops the first window,
+    whose left edge precedes the data."""
+    ticks = pd.Series(1.0, index=pd.DatetimeIndex(timestamps).sort_values())
+    tp = ticks.rolling(f"{int(window_ms)}ms").count() / (window_ms / 1000.0)
+    if trim and len(tp):
+        cutoff = tp.index[0] + pd.Timedelta(milliseconds=window_ms)
+        tp = tp[tp.index >= cutoff]
+    return tp
+
+
+def weighted_throughput(
+    counts: pd.Series, window_ms: float = 1000.0
+) -> pd.Series:
+    """Like rolling_throughput but each timestamped measurement carries a
+    count (pd_util.weighted_throughput) — e.g. batch sizes."""
+    counts = counts.sort_index()
+    tp = counts.rolling(f"{int(window_ms)}ms").sum() / (window_ms / 1000.0)
+    if len(tp):
+        cutoff = tp.index[0] + pd.Timedelta(milliseconds=window_ms)
+        tp = tp[tp.index >= cutoff]
+    return tp
+
+
+def rate(s: pd.Series, window_ms: float = 1000.0) -> pd.Series:
+    """Rate of change of a monotone counter over rolling windows
+    (pd_util.rate; the PromQL ``rate()`` analog for scraped counters)."""
+
+    def dxdt(win: pd.Series) -> float:
+        dt = (win.index[-1] - win.index[0]).total_seconds()
+        if dt == 0:
+            return np.nan
+        return (win.iloc[-1] - win.iloc[0]) / dt
+
+    return s.sort_index().rolling(f"{int(window_ms)}ms", min_periods=2).apply(
+        dxdt, raw=False
+    )
+
+
+def rolling_latency_quantiles(
+    df: pd.DataFrame,
+    window_ms: float = 500.0,
+    quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+) -> Dict[float, pd.Series]:
+    """Per-quantile rolling latency series from a recorder frame."""
+    lat = df["latency_ms"]
+    return {
+        q: lat.rolling(f"{int(window_ms)}ms").quantile(q) for q in quantiles
+    }
+
+
+def summarize(df: pd.DataFrame, drop_seconds: float = 0.0) -> dict:
+    """One-row summary of a recorder frame: count, duration, mean
+    throughput, latency percentiles (benchmark.py's percentile
+    summarization, as a DataFrame-level operation)."""
+    if drop_seconds and len(df):
+        cutoff = df.index[0] + pd.Timedelta(seconds=drop_seconds)
+        df = df[df.index >= cutoff]
+    if not len(df):
+        return {"count": 0}
+    duration_s = (df["stop"].max() - df["start"].min()).total_seconds()
+    lat = df["latency_ms"]
+    return {
+        "count": int(len(df)),
+        "duration_s": round(duration_s, 3),
+        "throughput_per_s": (
+            round(len(df) / duration_s, 1) if duration_s > 0 else float("nan")
+        ),
+        "latency_mean_ms": round(float(lat.mean()), 3),
+        "latency_p50_ms": round(float(lat.quantile(0.5)), 3),
+        "latency_p90_ms": round(float(lat.quantile(0.9)), 3),
+        "latency_p99_ms": round(float(lat.quantile(0.99)), 3),
+        "latency_max_ms": round(float(lat.max()), 3),
+    }
+
+
+def suite_results(suite_dir: str) -> pd.DataFrame:
+    """Load a Suite directory's ``results.csv`` (one row per benchmark,
+    flattened input/output columns) into a DataFrame."""
+    return pd.read_csv(os.path.join(suite_dir, "results.csv"), header=0)
+
+
+def plot_latency_and_throughput(
+    df: pd.DataFrame,
+    output: str,
+    drop_seconds: float = 0.0,
+    window_ms: float = 500.0,
+    tp_window_ms: float = 1000.0,
+) -> str:
+    """The plot_latency_and_throughput.py analog: a two-panel figure of
+    rolling latency quantiles and rolling start/stop throughput."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if drop_seconds and len(df):
+        cutoff = df.index[0] + pd.Timedelta(seconds=drop_seconds)
+        df = df[df.index >= cutoff]
+
+    fig, (ax_lat, ax_tp) = plt.subplots(2, 1, figsize=(6.4, 9.6))
+    for q, series in rolling_latency_quantiles(df, window_ms).items():
+        ax_lat.plot(series.index, series.values, label=f"p{int(q * 100)}")
+    ax_lat.set_title(f"Latency (rolling {int(window_ms)}ms)")
+    ax_lat.set_ylabel("latency (ms)")
+
+    tp_start = rolling_throughput(df["start"], tp_window_ms)
+    tp_stop = rolling_throughput(df["stop"], tp_window_ms)
+    ax_tp.plot(tp_start.index, tp_start.values, label="start")
+    ax_tp.plot(tp_stop.index, tp_stop.values, label="stop", alpha=0.7)
+    ax_tp.set_title(f"Throughput (rolling {int(tp_window_ms)}ms)")
+    ax_tp.set_ylabel("ops/s")
+
+    for ax in (ax_lat, ax_tp):
+        ax.grid(True)
+        ax.legend(loc="best")
+        for label in ax.get_xticklabels():
+            label.set_rotation(20)
+            label.set_ha("right")
+    fig.tight_layout()
+    fig.savefig(output)
+    plt.close(fig)
+    return output
+
+
+def analyze_benchmark_dir(
+    bench_dir: str, output: Optional[str] = None, drop_seconds: float = 0.0
+) -> dict:
+    """One command for one benchmark directory: find recorder CSVs, write
+    the latency/throughput plot next to them, return the summary."""
+    recorders: List[str] = []
+    for name in sorted(os.listdir(bench_dir)):
+        if name.endswith(".csv") and "recorder" in name:
+            recorders.append(os.path.join(bench_dir, name))
+    if not recorders:
+        raise FileNotFoundError(f"no recorder CSVs in {bench_dir}")
+    df = read_recorder_csvs(recorders)
+    output = output or os.path.join(bench_dir, "latency_and_throughput.png")
+    plot_latency_and_throughput(df, output, drop_seconds=drop_seconds)
+    summary = summarize(df, drop_seconds=drop_seconds)
+    summary["plot"] = output
+    return summary

@@ -1,0 +1,59 @@
+"""Analysis CLI: turn a recorder CSV, a benchmark directory, or a whole
+Suite directory into plots and a summary table — the entry point of the
+L6 layer (reference: ``benchmarks/plot_latency_and_throughput.py`` and
+the per-paper plot scripts).
+
+    python -m frankenpaxos_tpu.harness.analyze recorder.csv
+    python -m frankenpaxos_tpu.harness.analyze /path/to/benchmark_dir
+    python -m frankenpaxos_tpu.harness.analyze /path/to/suite_dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from frankenpaxos_tpu.harness.analysis import (
+    analyze_benchmark_dir,
+    plot_latency_and_throughput,
+    read_recorder_csvs,
+    suite_results,
+    summarize,
+)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu.harness.analyze")
+    parser.add_argument("path", help="recorder CSV, benchmark dir, or suite dir")
+    parser.add_argument("-o", "--output", default=None, help="plot filename")
+    parser.add_argument(
+        "-d", "--drop", type=float, default=0.0,
+        help="drop this many seconds from the start of the run",
+    )
+    args = parser.parse_args()
+
+    if os.path.isfile(args.path):
+        df = read_recorder_csvs([args.path])
+        output = args.output or os.path.splitext(args.path)[0] + ".png"
+        plot_latency_and_throughput(df, output, drop_seconds=args.drop)
+        summary = summarize(df, drop_seconds=args.drop)
+        summary["plot"] = output
+        print(json.dumps(summary))
+        return
+
+    if os.path.exists(os.path.join(args.path, "results.csv")):
+        df = suite_results(args.path)
+        # The summary table: one row per benchmark, all flattened columns.
+        print(df.to_string(index=False))
+        return
+
+    summary = analyze_benchmark_dir(
+        args.path, output=args.output, drop_seconds=args.drop
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Latency/throughput sweep driven by the Suite runner: deploy a protocol
+at increasing client counts, record each point's recorder CSV, and leave
+a suite directory with ``results.csv`` + per-point plots — the analog of
+the reference's latency-throughput benchmark suites whose committed
+result CSVs back its paper figures (``benchmarks/eurosys/``,
+``benchmarks/nsdi/fig1_lt_*``).
+
+    python -m frankenpaxos_tpu.harness.lt_sweep --protocol epaxos \\
+        --clients 1,2,4 --duration 3 --root /tmp/sweeps
+
+Afterwards: ``python -m frankenpaxos_tpu.harness.analyze <suite_dir>``
+prints the summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+from frankenpaxos_tpu.harness.analysis import analyze_benchmark_dir
+from frankenpaxos_tpu.harness.benchmark import Suite
+from frankenpaxos_tpu.harness.smoke import deploy_smoke
+
+
+@dataclasses.dataclass(frozen=True)
+class LtInput:
+    protocol: str
+    num_clients: int
+    duration: float
+
+
+class LtSweepSuite(Suite):
+    def __init__(self, protocol: str, client_counts, duration: float):
+        self.protocol = protocol
+        self.client_counts = client_counts
+        self.duration = duration
+
+    def args(self):
+        return {
+            "protocol": self.protocol,
+            "clients": list(self.client_counts),
+            "duration": self.duration,
+        }
+
+    def inputs(self):
+        return [
+            LtInput(self.protocol, n, self.duration)
+            for n in self.client_counts
+        ]
+
+    def run_benchmark(self, bench, args, input: LtInput):
+        deploy_smoke(
+            input.protocol,
+            bench,
+            duration=input.duration,
+            num_pseudonyms=input.num_clients,
+        )
+        summary = analyze_benchmark_dir(bench.path)
+        summary.pop("plot", None)
+        return summary
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu.harness.lt_sweep")
+    parser.add_argument("--protocol", required=True)
+    parser.add_argument("--clients", default="1,2,4",
+                        help="comma-separated closed-loop client counts")
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--root", default=".",
+                        help="directory to create the suite dir in")
+    args = parser.parse_args()
+
+    counts = [int(x) for x in args.clients.split(",") if x]
+    suite = LtSweepSuite(args.protocol, counts, args.duration)
+    suite_dir = suite.run_suite(args.root, f"lt_{args.protocol}")
+    print(f"suite directory: {suite_dir.path}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

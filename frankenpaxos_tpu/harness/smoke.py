@@ -65,7 +65,11 @@ def _summarize_recorder(path: str) -> dict:
     }
 
 
-def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
+def smoke_multipaxos(
+    bench: BenchmarkDirectory,
+    duration: float = 3.0,
+    num_pseudonyms: int = 3,
+) -> dict:
     port = _base_port()
 
     def hp(i):
@@ -113,7 +117,8 @@ def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
     recorder = bench.abspath("recorder.csv")
     client = role(
         "client", "--role", "client", "--listen", hp(50),
-        "--duration", str(duration), "--num_pseudonyms", "3",
+        "--duration", str(duration),
+        "--num_pseudonyms", str(num_pseudonyms),
         "--workload", '{"type": "read_write", "read_fraction": 0.25}',
         "--output", recorder,
     )
@@ -122,7 +127,12 @@ def smoke_multipaxos(bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
     return _summarize_recorder(recorder)
 
 
-def deploy_smoke(name: str, bench: BenchmarkDirectory, duration: float = 3.0) -> dict:
+def deploy_smoke(
+    name: str,
+    bench: BenchmarkDirectory,
+    duration: float = 3.0,
+    num_pseudonyms: int = 2,
+) -> dict:
     """A real localhost deployment of ``name``: every role is its own OS
     process launched via the generic role main
     (``frankenpaxos_tpu.mains.run``), driven by a closed-loop client
@@ -132,7 +142,7 @@ def deploy_smoke(name: str, bench: BenchmarkDirectory, duration: float = 3.0) ->
     from frankenpaxos_tpu.mains.registry import REGISTRY
 
     if name == "multipaxos":
-        return smoke_multipaxos(bench, duration)
+        return smoke_multipaxos(bench, duration, num_pseudonyms=num_pseudonyms)
     spec = REGISTRY[name]
     port = _base_port()
 
@@ -177,7 +187,8 @@ def deploy_smoke(name: str, bench: BenchmarkDirectory, duration: float = 3.0) ->
     recorder = bench.abspath("recorder.csv")
     client = role_proc(
         "client", "--role", "client", "--listen", hp(50),
-        "--duration", str(duration), "--num_pseudonyms", "2",
+        "--duration", str(duration),
+        "--num_pseudonyms", str(num_pseudonyms),
         "--warmup", "0", "--output", recorder,
     )
     code = client.wait(timeout=duration + 30)

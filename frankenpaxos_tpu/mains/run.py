@@ -138,17 +138,21 @@ def run_client(spec, args, config, logger, transport) -> None:
         listen, "shutdown", args.duration + 1.0, transport.shutdown
     )
     shutdown.start()
+    run_started = time.time()
     transport.run(on_start=kick)
 
     if spec.issue is None:
-        # Echo-style: completions are reply counts, not promises.
+        # Echo-style: completions are reply counts, not promises. Spread
+        # the rows over the actual run window so downstream throughput
+        # math sees the real duration instead of a zero-length burst.
         n = getattr(client, "num_messages_received", 0)
-        now = time.time()
-        for _ in range(n):
-            out.write(f"{now},{now},0,op\n")
         if n == 0:
             out.close()
             raise SystemExit(f"no replies received by {spec.name} client")
+        elapsed = max(time.time() - run_started, 1e-3)
+        for i in range(n):
+            ts = run_started + (i + 1) * elapsed / n
+            out.write(f"{ts},{ts},0,op\n")
     out.close()
 
 

@@ -1,0 +1,106 @@
+"""L6 analysis-layer tests (pd_util / plot_latency_and_throughput
+analogs): rolling throughput and latency math, outlier pruning, counter
+rates, plotting, and the one-command benchmark-dir analyzer."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from frankenpaxos_tpu.harness import analysis
+
+
+def make_recorder_csv(path, n=200, base=1_700_000_000.0, spacing=0.01):
+    with open(path, "w") as f:
+        f.write("start,stop,latency_nanos,label\n")
+        for i in range(n):
+            start = base + i * spacing
+            latency = 0.002 if i % 50 else 0.050  # periodic slow outlier
+            f.write(f"{start},{start + latency},{int(latency * 1e9)},op\n")
+    return path
+
+
+def test_read_and_summarize(tmp_path):
+    path = make_recorder_csv(str(tmp_path / "recorder.csv"))
+    df = analysis.read_recorder_csvs([path])
+    assert len(df) == 200
+    s = analysis.summarize(df)
+    assert s["count"] == 200
+    # 200 ops over ~199 * 10ms ~= 2 seconds -> ~100/s.
+    assert 90 <= s["throughput_per_s"] <= 110
+    assert s["latency_p50_ms"] == pytest.approx(2.0, abs=0.5)
+    assert s["latency_max_ms"] == pytest.approx(50.0, abs=1.0)
+    # Dropping the first second halves the count (approximately).
+    s2 = analysis.summarize(df, drop_seconds=1.0)
+    assert 90 <= s2["count"] <= 110
+
+
+def test_rolling_throughput_constant_rate(tmp_path):
+    path = make_recorder_csv(str(tmp_path / "recorder.csv"))
+    df = analysis.read_recorder_csvs([path])
+    tp = analysis.rolling_throughput(df["start"], window_ms=1000.0)
+    # Steady 100/s arrival: full windows must report ~100.
+    assert tp.iloc[-1] == pytest.approx(100.0, rel=0.05)
+    # Trimming removed the partial first window.
+    assert tp.index[0] >= df.index[0] + pd.Timedelta(seconds=1)
+
+
+def test_outliers_and_quantiles(tmp_path):
+    path = make_recorder_csv(str(tmp_path / "recorder.csv"))
+    df = analysis.read_recorder_csvs([path])
+    mask = analysis.outliers(df["latency_ms"], 3.0)
+    assert int(mask.sum()) == 4  # the periodic 50ms spikes
+    qs = analysis.rolling_latency_quantiles(df, window_ms=500.0)
+    assert set(qs) == {0.5, 0.9, 0.99}
+    assert float(qs[0.5].iloc[-1]) == pytest.approx(2.0, abs=0.5)
+
+
+def test_counter_rate():
+    idx = pd.to_datetime(
+        [1_700_000_000.0 + i * 0.25 for i in range(9)], unit="s"
+    )
+    counter = pd.Series([i * 10.0 for i in range(9)], index=idx)
+    r = analysis.rate(counter, window_ms=1000.0)
+    # 10 per 0.25s -> 40/s within every full window.
+    assert float(r.iloc[-1]) == pytest.approx(40.0, rel=0.01)
+    assert np.isnan(r.iloc[0])  # single-point window has no rate
+
+
+def test_weighted_throughput():
+    idx = pd.to_datetime([1_700_000_000.0 + i for i in range(5)], unit="s")
+    counts = pd.Series([10.0] * 5, index=idx)
+    tp = analysis.weighted_throughput(counts, window_ms=2000.0)
+    assert float(tp.iloc[-1]) == pytest.approx(10.0, rel=0.01)
+
+
+def test_plot_and_analyze_dir(tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    make_recorder_csv(str(bench / "recorder.csv"))
+    summary = analysis.analyze_benchmark_dir(str(bench))
+    assert summary["count"] == 200
+    assert os.path.exists(summary["plot"])
+    assert os.path.getsize(summary["plot"]) > 1000  # a real image
+
+
+def test_suite_results_roundtrip(tmp_path):
+    (tmp_path / "results.csv").write_text(
+        "input.x,output.throughput_per_s\n1,100.0\n2,180.0\n"
+    )
+    df = analysis.suite_results(str(tmp_path))
+    assert list(df["input.x"]) == [1, 2]
+
+
+def test_lt_sweep_suite(tmp_path):
+    """The sweep driver end-to-end on the fastest protocol: two points,
+    real deployments, a results.csv with per-point summaries."""
+    from frankenpaxos_tpu.harness.analysis import suite_results
+    from frankenpaxos_tpu.harness.lt_sweep import LtSweepSuite
+
+    suite = LtSweepSuite("unreplicated", [1, 2], duration=1.5)
+    suite_dir = suite.run_suite(str(tmp_path), "lt_unreplicated")
+    df = suite_results(suite_dir.path)
+    assert len(df) == 2
+    assert (df["output.count"] > 0).all()
+    assert (df["output.throughput_per_s"] > 0).all()
