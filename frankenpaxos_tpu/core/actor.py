@@ -37,6 +37,40 @@ class Actor:
     def receive(self, src: Address, msg: Any) -> None:
         raise NotImplementedError
 
+    def enable_metrics(self, collectors, role: str) -> None:
+        """Instrument this actor with per-message-type request counts and
+        handler-latency summaries — the analog of the reference's per-role
+        Metrics classes and its ``timed(label){...}`` handler wrapper
+        (``multipaxos/Acceptor.scala:107-119``), applied at the actor
+        boundary so every role of every protocol gets the same
+        observability without hand-rolled Metrics classes. Called by the
+        deployment mains after construction; roles with richer
+        domain-specific metrics (e.g. multipaxos) add them on top."""
+        import time as _time
+
+        requests_total = collectors.counter(
+            f"{role}_requests_total",
+            f"Total messages received by {role}, by type.",
+            labels=("type",),
+        )
+        latency = collectors.summary(
+            f"{role}_handler_latency_seconds",
+            f"Receive-handler latency of {role}, by message type.",
+            labels=("type",),
+        )
+        inner = self.receive
+
+        def timed_receive(src: Address, msg: Any) -> None:
+            label = type(msg).__name__
+            t0 = _time.perf_counter()
+            inner(src, msg)
+            elapsed = _time.perf_counter() - t0
+            requests_total.labels(label).inc()
+            latency.labels(label).observe(elapsed)
+
+        # Instance attribute shadows the method for transport dispatch.
+        self.receive = timed_receive
+
     def chan(self, dst: Address, serializer: Serializer = _WIRE) -> Chan:
         return Chan(self.transport, self.address, dst, serializer)
 

@@ -17,6 +17,7 @@ recorder CSV is summarized:
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import json
 import random
@@ -32,10 +33,11 @@ from frankenpaxos_tpu.harness.benchmark import (
 
 def _base_port() -> int:
     # Per-process port block so overlapping smoke runs don't collide on
-    # EADDRINUSE (each deployment uses offsets 0-50 within its block).
+    # EADDRINUSE (each deployment uses role ports at offsets 0-50, the
+    # client at 50, and per-role /metrics exporters at 100+).
     import os
 
-    return 20000 + (os.getpid() % 400) * 60
+    return 20000 + (os.getpid() % 200) * 150
 
 
 def _role_env() -> dict:
@@ -69,7 +71,10 @@ def smoke_multipaxos(
     bench: BenchmarkDirectory,
     duration: float = 3.0,
     num_pseudonyms: int = 3,
+    capture_metrics: bool = True,
 ) -> dict:
+    from frankenpaxos_tpu.monitoring.scrape import MetricsScraper, scrape_config
+
     port = _base_port()
 
     def hp(i):
@@ -98,31 +103,52 @@ def smoke_multipaxos(
             "--config", config_path, "--log_level", "error", *extra,
         ], env=env)
 
+    jobs = {}
+    metrics_port = [port + 100]
+
+    def metrics_args(role_name):
+        if not capture_metrics:
+            return ()
+        p = metrics_port[0]
+        metrics_port[0] += 1
+        jobs.setdefault(role_name, []).append(f"127.0.0.1:{p}")
+        return ("--prometheus_port", str(p), "--prometheus_host", "127.0.0.1")
+
     # Dependency order: a leader runs phase 1 at startup, so its acceptors
     # must already be listening (first-connection failures drop messages
     # until the 5s phase-1 resend, which would eat the whole smoke window).
     for g in range(2):
         for i in range(3):
             role(f"acceptor_{g}_{i}", "--role", "acceptor",
-                 "--group_index", str(g), "--index", str(i))
+                 "--group_index", str(g), "--index", str(i),
+                 *metrics_args("acceptor"))
     for i in range(2):
-        role(f"replica_{i}", "--role", "replica", "--index", str(i))
+        role(f"replica_{i}", "--role", "replica", "--index", str(i),
+             *metrics_args("replica"))
     for i in range(2):
-        role(f"proxy_leader_{i}", "--role", "proxy_leader", "--index", str(i))
+        role(f"proxy_leader_{i}", "--role", "proxy_leader", "--index", str(i),
+             *metrics_args("proxy_leader"))
     time.sleep(1.0)
     for i in range(2):
-        role(f"leader_{i}", "--role", "leader", "--index", str(i))
+        role(f"leader_{i}", "--role", "leader", "--index", str(i),
+             *metrics_args("leader"))
     time.sleep(1.5)  # client lag (the reference's client_lag)
 
     recorder = bench.abspath("recorder.csv")
-    client = role(
-        "client", "--role", "client", "--listen", hp(50),
-        "--duration", str(duration),
-        "--num_pseudonyms", str(num_pseudonyms),
-        "--workload", '{"type": "read_write", "read_fraction": 0.25}',
-        "--output", recorder,
-    )
-    code = client.wait(timeout=duration + 30)
+    with contextlib.ExitStack() as stack:
+        if capture_metrics:
+            bench.write_json("prometheus.json", scrape_config(200, jobs))
+            stack.enter_context(
+                MetricsScraper(jobs, bench.abspath("metrics.csv"))
+            )
+        client = role(
+            "client", "--role", "client", "--listen", hp(50),
+            "--duration", str(duration),
+            "--num_pseudonyms", str(num_pseudonyms),
+            "--workload", '{"type": "read_write", "read_fraction": 0.25}',
+            "--output", recorder,
+        )
+        code = client.wait(timeout=duration + 30)
     assert code == 0, f"client exited with {code}"
     return _summarize_recorder(recorder)
 
@@ -132,17 +158,27 @@ def deploy_smoke(
     bench: BenchmarkDirectory,
     duration: float = 3.0,
     num_pseudonyms: int = 2,
+    capture_metrics: bool = True,
 ) -> dict:
     """A real localhost deployment of ``name``: every role is its own OS
     process launched via the generic role main
     (``frankenpaxos_tpu.mains.run``), driven by a closed-loop client
     process, summarized from the recorder CSV — the analog of the
     reference's per-protocol ``benchmarks/<proto>/smoke.py`` deployments
-    (``scripts/benchmark_smoke.sh:5-20``)."""
+    (``scripts/benchmark_smoke.sh:5-20``). With ``capture_metrics`` each
+    role exposes /metrics and a scraper captures samples into the bench
+    dir's ``metrics.csv``, queryable via ``monitoring.scrape
+    .MetricsCapture`` (the per-benchmark Prometheus of
+    ``benchmarks/prometheus.py``)."""
     from frankenpaxos_tpu.mains.registry import REGISTRY
+    from frankenpaxos_tpu.monitoring.scrape import MetricsScraper, scrape_config
 
     if name == "multipaxos":
-        return smoke_multipaxos(bench, duration, num_pseudonyms=num_pseudonyms)
+        return smoke_multipaxos(
+            bench, duration,
+            num_pseudonyms=num_pseudonyms,
+            capture_metrics=capture_metrics,
+        )
     spec = REGISTRY[name]
     port = _base_port()
 
@@ -163,6 +199,17 @@ def deploy_smoke(
             "--log_level", "error", *extra,
         ], env=env)
 
+    jobs = {}
+    metrics_port = [port + 100]
+
+    def metrics_args(role_name):
+        if not capture_metrics:
+            return ()
+        p = metrics_port[0]
+        metrics_port[0] += 1
+        jobs.setdefault(role_name, []).append(f"127.0.0.1:{p}")
+        return ("--prometheus_port", str(p), "--prometheus_host", "127.0.0.1")
+
     role_items = list(spec.roles.items())
     for tier, (role_name, role) in enumerate(role_items):
         cnt = role.count(config)
@@ -171,11 +218,12 @@ def deploy_smoke(
             for g in range(groups):
                 for i in range(per_group):
                     role_proc(f"{role_name}_{g}_{i}", "--role", role_name,
-                              "--group_index", str(g), "--index", str(i))
+                              "--group_index", str(g), "--index", str(i),
+                              *metrics_args(role_name))
         else:
             for i in range(cnt):
                 role_proc(f"{role_name}_{i}", "--role", role_name,
-                          "--index", str(i))
+                          "--index", str(i), *metrics_args(role_name))
         # Later tiers may run startup phases against earlier ones (e.g. a
         # leader's phase 1 against its acceptors): let listeners bind.
         if tier < len(role_items) - 1:
@@ -185,13 +233,19 @@ def deploy_smoke(
 
     time.sleep(spec.client_lag)
     recorder = bench.abspath("recorder.csv")
-    client = role_proc(
-        "client", "--role", "client", "--listen", hp(50),
-        "--duration", str(duration),
-        "--num_pseudonyms", str(num_pseudonyms),
-        "--warmup", "0", "--output", recorder,
-    )
-    code = client.wait(timeout=duration + 30)
+    with contextlib.ExitStack() as stack:
+        if capture_metrics:
+            bench.write_json("prometheus.json", scrape_config(200, jobs))
+            stack.enter_context(
+                MetricsScraper(jobs, bench.abspath("metrics.csv"))
+            )
+        client = role_proc(
+            "client", "--role", "client", "--listen", hp(50),
+            "--duration", str(duration),
+            "--num_pseudonyms", str(num_pseudonyms),
+            "--warmup", "0", "--output", recorder,
+        )
+        code = client.wait(timeout=duration + 30)
     assert code == 0, f"client exited with {code}"
     return _summarize_recorder(recorder)
 

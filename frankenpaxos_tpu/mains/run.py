@@ -27,6 +27,7 @@ from frankenpaxos_tpu.mains.common import (
     add_common_args,
     host_port,
     load_config_json,
+    make_collectors,
     make_logger,
 )
 from frankenpaxos_tpu.mains.registry import REGISTRY
@@ -65,9 +66,14 @@ def main() -> None:
             f"unknown role {args.role!r} for {spec.name}; "
             f"choose from {sorted(spec.roles)} or 'client'"
         )
-    spec.roles[args.role].build(
+    actor = spec.roles[args.role].build(
         config, args.index, args.group_index, transport, logger, args.seed
     )
+    if args.prometheus_port != -1 and actor is not None:
+        # Per-message-type counts + handler latency summaries, exposed on
+        # the /metrics endpoint (PrometheusUtil.scala:6-15 analog).
+        collectors = make_collectors(args)
+        actor.enable_metrics(collectors, f"{spec.name}_{args.role}")
     transport.run()
 
 

@@ -1,0 +1,179 @@
+"""Per-benchmark metrics capture and post-hoc querying (the analog of
+``benchmarks/prometheus.py``: scrape-config generation, a per-benchmark
+metrics store, and PromQL-into-pandas queries).
+
+The reference launches a real Prometheus server per benchmark and later
+re-launches one over the captured tsdb to run PromQL
+(``prometheus.py:10-135``). The re-design keeps the capability without
+the external binary: a ``MetricsScraper`` thread polls each role's
+``/metrics`` endpoint (the text exposition format served by
+``PrometheusCollectors``) on an interval and appends samples to a CSV;
+``MetricsCapture`` loads the CSV into pandas and answers the queries the
+analysis layer needs — instant vectors, range series per labelset, and
+counter rates (``analysis.rate`` is the PromQL ``rate()`` analog).
+"""
+
+from __future__ import annotations
+
+import csv
+import re
+import threading
+import time
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def scrape_config(scrape_interval_ms: int, jobs: Dict[str, List[str]]) -> dict:
+    """A prometheus.yml-shaped dict (prometheus.py:10-25), kept for config
+    parity: jobs maps job names to host:port targets."""
+    return {
+        "global": {"scrape_interval": f"{scrape_interval_ms}ms"},
+        "scrape_configs": [
+            {
+                "job_name": job,
+                "static_configs": [{"targets": targets}],
+            }
+            for job, targets in jobs.items()
+        ],
+    }
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
+    """Parse the Prometheus text exposition format into
+    ``(name, sorted label pairs, value)`` samples."""
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            metric, value_str = line.rsplit(None, 1)
+            value = float(value_str)
+        except ValueError:
+            continue
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            label_str = rest.rsplit("}", 1)[0]
+            labels = []
+            for pair in filter(None, label_str.split(",")):
+                k, v = pair.split("=", 1)
+                labels.append((k.strip(), v.strip().strip('"')))
+            samples.append((name, tuple(sorted(labels)), value))
+        else:
+            samples.append((metric, (), value))
+    return samples
+
+
+class MetricsScraper:
+    """Polls each job's targets and appends samples to a CSV with columns
+    ``ts,job,instance,name,labels,value`` (labels as ``k=v;k=v``)."""
+
+    def __init__(
+        self,
+        jobs: Dict[str, List[str]],
+        output_path: str,
+        scrape_interval_ms: int = 200,
+        timeout_s: float = 1.0,
+    ):
+        self.jobs = jobs
+        self.output_path = output_path
+        self.interval_s = scrape_interval_ms / 1000.0
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "MetricsScraper":
+        self._file = open(self.output_path, "w", newline="")
+        self._writer = csv.writer(self._file)
+        self._writer.writerow(["ts", "job", "instance", "name", "labels", "value"])
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # Wait for the worker to actually exit before touching the
+            # shared csv writer or closing the file: a sweep over many
+            # hung targets can outlast any single join timeout.
+            while self._thread.is_alive():
+                self._thread.join(timeout=5.0)
+            self._thread = None
+            self._scrape_once()  # one final sample after the run
+            self._file.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._scrape_once()
+            self._stop.wait(self.interval_s)
+
+    def _scrape_once(self) -> None:
+        now = time.time()
+        rows = []
+        for job, targets in self.jobs.items():
+            for target in targets:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{target}/metrics", timeout=self.timeout_s
+                    ) as resp:
+                        text = resp.read().decode()
+                except OSError:
+                    continue  # role not up yet / already gone
+                for name, labels, value in parse_exposition(text):
+                    label_str = ";".join(f"{k}={v}" for k, v in labels)
+                    rows.append([now, job, target, name, label_str, value])
+        self._writer.writerows(rows)
+        self._file.flush()
+
+
+class MetricsCapture:
+    """Post-hoc queries over a scraper CSV, into pandas (the
+    PrometheusQueryer analog, prometheus.py:28-135)."""
+
+    def __init__(self, path: str):
+        import pandas as pd
+
+        self.df = pd.read_csv(path, header=0)
+        if len(self.df):
+            self.df["ts"] = pd.to_datetime(self.df["ts"], unit="s")
+
+    def names(self) -> List[str]:
+        return sorted(self.df["name"].unique())
+
+    def query(self, name: str, **label_filters: str):
+        """Range series for one metric: a DataFrame indexed by scrape
+        time with one column per (instance, labelset)."""
+        import pandas as pd
+
+        df = self.df[self.df["name"] == name]
+        if label_filters:
+            for k, v in label_filters.items():
+                # Anchored per-label match: 'type=ClientRequest' must not
+                # also match 'type=ClientRequestBatch'.
+                pattern = f"(^|;){re.escape(k)}={re.escape(str(v))}(;|$)"
+                df = df[df["labels"].fillna("").str.contains(pattern)]
+        if not len(df):
+            return pd.DataFrame()
+        df = df.copy()
+        df["series"] = df["instance"] + "{" + df["labels"].fillna("") + "}"
+        return df.pivot_table(
+            index="ts", columns="series", values="value", aggfunc="last"
+        )
+
+    def rate(self, name: str, window_ms: float = 1000.0, **label_filters):
+        """Counter rate per series (PromQL ``rate()``), via the analysis
+        layer's rolling-window derivative."""
+        from frankenpaxos_tpu.harness.analysis import rate as _rate
+
+        wide = self.query(name, **label_filters)
+        return wide.apply(lambda col: _rate(col.dropna(), window_ms))
+
+    def total(self, name: str, **label_filters) -> float:
+        """Sum of each series' final sample (e.g. total requests)."""
+        wide = self.query(name, **label_filters)
+        if not len(wide):
+            return 0.0
+        return float(wide.ffill().iloc[-1].sum())

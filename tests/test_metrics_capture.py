@@ -1,0 +1,83 @@
+"""Metrics capture tests: actor-boundary instrumentation
+(Actor.enable_metrics), exposition parsing, the scraper, and post-hoc
+pandas queries (the benchmarks/prometheus.py capability)."""
+
+import time
+
+from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+from frankenpaxos_tpu.monitoring import FakeCollectors, PrometheusCollectors
+from frankenpaxos_tpu.monitoring.scrape import (
+    MetricsCapture,
+    MetricsScraper,
+    parse_exposition,
+    scrape_config,
+)
+from frankenpaxos_tpu.protocols.echo import EchoClient, EchoServer
+
+
+def test_enable_metrics_counts_and_times():
+    t = SimTransport(FakeLogger())
+    server_addr = SimAddress("server")
+    server = EchoServer(server_addr, t, FakeLogger())
+    collectors = FakeCollectors()
+    server.enable_metrics(collectors, "echo_server")
+    client = EchoClient(SimAddress("client"), t, FakeLogger(), server_addr)
+    for _ in range(5):
+        client.echo("hi")
+    while t.messages:
+        t.deliver_message(t.messages[0])
+    counter = collectors.counter("echo_server_requests_total", labels=("type",))
+    assert counter.labels("EchoRequest").value == 5
+    summary = collectors.summary(
+        "echo_server_handler_latency_seconds", labels=("type",)
+    )
+    assert summary.labels("EchoRequest").count == 5
+    assert summary.labels("EchoRequest").sum >= 0
+
+
+def test_parse_exposition():
+    text = (
+        "# HELP x_total help\n"
+        "# TYPE x_total counter\n"
+        'x_total{type="A"} 3\n'
+        'x_total{type="B"} 4\n'
+        "plain_gauge 1.5\n"
+        "garbage line without value x\n"
+    )
+    samples = parse_exposition(text)
+    assert ("x_total", (("type", "A"),), 3.0) in samples
+    assert ("plain_gauge", (), 1.5) in samples
+    assert len(samples) == 3
+
+
+def test_scrape_config_shape():
+    cfg = scrape_config(200, {"acceptor": ["127.0.0.1:1", "127.0.0.1:2"]})
+    assert cfg["global"]["scrape_interval"] == "200ms"
+    assert cfg["scrape_configs"][0]["job_name"] == "acceptor"
+    assert cfg["scrape_configs"][0]["static_configs"][0]["targets"] == [
+        "127.0.0.1:1", "127.0.0.1:2",
+    ]
+
+
+def test_scraper_and_capture_roundtrip(tmp_path):
+    collectors = PrometheusCollectors()
+    counter = collectors.counter("demo_total", "d", labels=("kind",))
+    port = 23987
+    server = collectors.start_http_server(port, host="127.0.0.1")
+    try:
+        path = str(tmp_path / "metrics.csv")
+        with MetricsScraper(
+            {"demo": [f"127.0.0.1:{port}"]}, path, scrape_interval_ms=50
+        ):
+            counter.labels("a").inc(3)
+            time.sleep(0.15)
+            counter.labels("a").inc(7)
+            time.sleep(0.15)
+        cap = MetricsCapture(path)
+        assert "demo_total" in cap.names()
+        assert cap.total("demo_total", kind="a") == 10.0
+        wide = cap.query("demo_total")
+        assert wide.shape[1] == 1  # one labelset series
+        assert float(wide.ffill().iloc[-1].iloc[0]) == 10.0
+    finally:
+        server.shutdown()
