@@ -1,0 +1,217 @@
+"""Data-structure microbenchmarks (the analog of ``jvm/src/bench/scala``:
+DependencyGraphBench, IntPrefixSetBench, BufferMapBench,
+CompactConflictIndexBench — scalameter replaced by a simple
+timeit-style harness):
+
+    python -m frankenpaxos_tpu.harness.microbench            # all
+    python -m frankenpaxos_tpu.harness.microbench depgraph
+
+Each benchmark prints ``name,case,ops,seconds,ops_per_sec`` rows; these
+guard the perf of the Python hot paths the same way the reference's
+scalameter suite guards its JVM ones.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+
+def _timed(fn: Callable[[], int]) -> Tuple[int, float]:
+    start = time.perf_counter()
+    ops = fn()
+    return ops, time.perf_counter() - start
+
+
+def _report(name: str, case: str, ops: int, seconds: float) -> dict:
+    row = {
+        "name": name,
+        "case": case,
+        "ops": ops,
+        "seconds": round(seconds, 4),
+        "ops_per_sec": round(ops / seconds) if seconds > 0 else 0,
+    }
+    print(
+        f"{row['name']},{row['case']},{row['ops']},{row['seconds']},"
+        f"{row['ops_per_sec']}"
+    )
+    return row
+
+
+def bench_depgraph(num_commands: int = 5_000, num_leaders: int = 5) -> List[dict]:
+    """Commit+execute through every dependency-graph variant on the same
+    EPaxos-shaped workload (DependencyGraphBench.scala)."""
+    from frankenpaxos_tpu.depgraph import (
+        IncrementalTarjanDependencyGraph,
+        NaiveDependencyGraph,
+        TarjanDependencyGraph,
+        ZigzagTarjanDependencyGraph,
+    )
+
+    rng = random.Random(0)
+    # A conflict-heavy stream: each command depends on the previous few
+    # commands of every leader column (prefix-shaped).
+    commands = []
+    next_id = [0] * num_leaders
+    frontier = [0] * num_leaders
+    for _ in range(num_commands):
+        leader = rng.randrange(num_leaders)
+        key = (leader, next_id[leader])
+        next_id[leader] += 1
+        deps = {
+            (col, i)
+            for col in range(num_leaders)
+            for i in range(max(0, frontier[col] - 2), frontier[col])
+        }
+        deps.discard(key)
+        frontier[leader] = next_id[leader]
+        commands.append((key, deps))
+
+    rows = []
+    variants: Dict[str, Callable[[], object]] = {
+        "Tarjan": TarjanDependencyGraph,
+        "IncrementalTarjan": IncrementalTarjanDependencyGraph,
+        "Naive": NaiveDependencyGraph,
+        "Zigzag": lambda: ZigzagTarjanDependencyGraph(
+            num_leaders, garbage_collect_every_n_commands=100
+        ),
+    }
+    for case, make in variants.items():
+        graph = make()
+
+        def run() -> int:
+            executed = 0
+            for seq, (key, deps) in enumerate(commands):
+                graph.commit(key, seq, deps)
+                if seq % 10 == 9:
+                    keys, _ = graph.execute()
+                    executed += len(keys)
+            for _ in range(num_commands):
+                keys, _ = graph.execute()
+                executed += len(keys)
+                if not keys:
+                    break
+            # Variants must do the SAME work for ops/sec to compare.
+            assert executed == num_commands, (case, executed)
+            return executed
+
+        ops, seconds = _timed(run)
+        rows.append(_report("depgraph", case, ops, seconds))
+    return rows
+
+
+def bench_int_prefix_set(num_ops: int = 200_000) -> List[dict]:
+    """add/contains on the watermark-compressed set
+    (IntPrefixSetBench.scala)."""
+    from frankenpaxos_tpu.compact import IntPrefixSet
+
+    rows = []
+
+    def sequential() -> int:
+        s = IntPrefixSet()
+        for i in range(num_ops):
+            s.add(i)
+        return num_ops
+
+    def scattered() -> int:
+        rng = random.Random(1)
+        s = IntPrefixSet()
+        for _ in range(num_ops):
+            s.add(rng.randrange(num_ops * 2))
+        return num_ops
+
+    def contains() -> int:
+        s = IntPrefixSet()
+        for i in range(1000):
+            s.add(i)
+        hits = 0
+        for i in range(num_ops):
+            hits += s.contains(i % 2000)
+        return num_ops
+
+    for case, fn in [
+        ("add_sequential", sequential),
+        ("add_scattered", scattered),
+        ("contains", contains),
+    ]:
+        ops, seconds = _timed(fn)
+        rows.append(_report("int_prefix_set", case, ops, seconds))
+    return rows
+
+
+def bench_buffer_map(num_ops: int = 200_000) -> List[dict]:
+    """put/get/garbage_collect on the watermarked log (BufferMapBench)."""
+    from frankenpaxos_tpu.util import BufferMap
+
+    rows = []
+
+    def put_get() -> int:
+        m = BufferMap(grow_size=1024)
+        for i in range(num_ops):
+            m.put(i, i)
+            m.get(i)
+        return num_ops
+
+    def put_gc() -> int:
+        m = BufferMap(grow_size=1024)
+        for i in range(num_ops):
+            m.put(i, i)
+            if i % 1000 == 999:
+                m.garbage_collect(i - 500)
+        return num_ops
+
+    for case, fn in [("put_get", put_get), ("put_gc", put_gc)]:
+        ops, seconds = _timed(fn)
+        rows.append(_report("buffer_map", case, ops, seconds))
+    return rows
+
+
+def bench_conflict_index(num_ops: int = 20_000) -> List[dict]:
+    """KeyValueStore conflict-index puts + conflict queries
+    (CompactConflictIndexBench)."""
+    from frankenpaxos_tpu.statemachine import KeyValueStore, kv_set
+
+    rows = []
+
+    def run() -> int:
+        index = KeyValueStore().conflict_index()
+        rng = random.Random(2)
+        for i in range(num_ops):
+            cmd = kv_set((f"k{rng.randrange(64)}", "v"))
+            index.put(("c", i), cmd)
+            if i % 4 == 3:
+                index.get_conflicts(cmd)
+        return num_ops
+
+    ops, seconds = _timed(run)
+    rows.append(_report("conflict_index", "kv_put_conflicts", ops, seconds))
+    return rows
+
+
+BENCHES = {
+    "depgraph": bench_depgraph,
+    "int_prefix_set": bench_int_prefix_set,
+    "buffer_map": bench_buffer_map,
+    "conflict_index": bench_conflict_index,
+}
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(BENCHES)
+    unknown = [n for n in names if n not in BENCHES]
+    if unknown:
+        print(
+            f"unknown bench(es) {', '.join(unknown)}; "
+            f"choose from: {', '.join(BENCHES)}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    print("name,case,ops,seconds,ops_per_sec")
+    for name in names:
+        BENCHES[name]()
+
+
+if __name__ == "__main__":
+    main()

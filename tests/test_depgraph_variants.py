@@ -8,6 +8,7 @@ import random
 import pytest
 
 from frankenpaxos_tpu.depgraph import (
+    IncrementalTarjanDependencyGraph,
     NaiveDependencyGraph,
     TarjanDependencyGraph,
     ZigzagTarjanDependencyGraph,
@@ -58,6 +59,7 @@ def test_depgraph_implementations_agree(seed):
     num_leaders = 3
     graphs = {
         "tarjan": TarjanDependencyGraph(),
+        "incremental": IncrementalTarjanDependencyGraph(),
         "naive": NaiveDependencyGraph(),
         "zigzag": ZigzagTarjanDependencyGraph(
             num_leaders, garbage_collect_every_n_commands=20
@@ -131,9 +133,9 @@ def test_depgraph_implementations_agree(seed):
             pytest.fail(f"{name} never quiesced")
 
     sets = {name: set(keys) for name, keys in executed.items()}
-    assert sets["tarjan"] == sets["naive"] == sets["zigzag"], {
-        name: len(s) for name, s in sets.items()
-    }
+    assert (
+        sets["tarjan"] == sets["incremental"] == sets["naive"] == sets["zigzag"]
+    ), {name: len(s) for name, s in sets.items()}
     for name in graphs:
         assert len(executed[name]) == len(sets[name]), (
             f"{name} executed a vertex twice"
@@ -197,3 +199,52 @@ def test_naive_matches_tarjan_on_cycles():
         keys, blockers = graph.execute()
         # c first (dependency), then the {a, b} component sorted by seq.
         assert keys == [c, a, b]
+
+
+def test_incremental_tarjan_pauses_and_resumes():
+    """The incremental variant suspends on an uncommitted dependency,
+    reports exactly that blocker, and resumes mid-pass once it commits
+    (IncrementalTarjanDependencyGraph.scala: Paused/Success)."""
+    g = IncrementalTarjanDependencyGraph()
+    # a -> b -> c(uncommitted); d independent.
+    g.commit("a", 0, {"b"})
+    g.commit("b", 1, {"c"})
+    g.commit("d", 2, set())
+    components, blockers = g.execute_by_component()
+    executed = {k for comp in components for k in comp}
+    assert blockers == {"c"}
+    assert "a" not in executed and "b" not in executed
+    # The pass is suspended: metadata persists between calls.
+    assert g.callstack, "expected a suspended pass"
+    # Committing c unblocks the suspended chain; the resumed pass
+    # executes c, b, a in dependency order.
+    g.commit("c", 3, set())
+    components, blockers = g.execute_by_component()
+    order = [k for comp in components for k in comp]
+    assert blockers == set()
+    for k in ("a", "b", "c"):
+        assert k in order
+    assert order.index("c") < order.index("b") < order.index("a")
+    # Everything executed exactly once across both calls.
+    all_executed = [k for comp in components for k in comp] + sorted(executed)
+    assert sorted(all_executed) == ["a", "b", "c", "d"]
+    assert g.num_vertices == 0
+
+
+def test_incremental_tarjan_cycle_executes_together():
+    g = IncrementalTarjanDependencyGraph()
+    g.commit("x", 0, {"y"})
+    g.commit("y", 1, {"x"})
+    components, blockers = g.execute_by_component()
+    assert blockers == set()
+    assert [sorted(c) for c in components] == [["x", "y"]]
+    # Sequence-number order within the component.
+    assert components[0] == ["x", "y"]
+
+
+def test_incremental_tarjan_update_executed_guard():
+    g = IncrementalTarjanDependencyGraph()
+    g.commit("a", 0, {"missing"})
+    g.execute_by_component()  # pauses
+    with pytest.raises(NotImplementedError):
+        g.update_executed({"other"})

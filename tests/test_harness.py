@@ -205,3 +205,22 @@ def test_deploy_smokes_sample(tmp_path):
         with bench:
             result = smoke.deploy_smoke(name, bench, duration=2.0)
         assert result["requests"] > 0, name
+
+
+def test_microbench_smoke():
+    """Every microbenchmark runs and reports sane rows (the scalameter
+    suite analog, jvm/src/bench/scala)."""
+    from frankenpaxos_tpu.harness import microbench
+
+    rows = []
+    rows += microbench.bench_depgraph(num_commands=300)
+    rows += microbench.bench_int_prefix_set(num_ops=2000)
+    rows += microbench.bench_buffer_map(num_ops=2000)
+    rows += microbench.bench_conflict_index(num_ops=500)
+    assert {r["name"] for r in rows} == {
+        "depgraph", "int_prefix_set", "buffer_map", "conflict_index",
+    }
+    assert {r["case"] for r in rows if r["name"] == "depgraph"} == {
+        "Tarjan", "IncrementalTarjan", "Naive", "Zigzag",
+    }
+    assert all(r["ops_per_sec"] > 0 for r in rows)
