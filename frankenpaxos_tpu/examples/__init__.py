@@ -1,0 +1,153 @@
+"""Pedagogical simulated systems (the analog of the reference's
+``shared/src/test/scala/bankaccount`` and ``diehard`` examples): tiny
+state machines demonstrating how the property-testing simulator explores
+state spaces — and, for Die Hard, that it can *find* target states via
+invariant violations, exactly like Lamport's TLA+ water-jug example.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from frankenpaxos_tpu.sim import SimulatedSystem
+
+
+# -- Bank account (BankAccount.scala) ---------------------------------------
+
+
+class BankAccount:
+    """Deposits and guarded withdrawals; the balance must never go
+    negative."""
+
+    def __init__(self) -> None:
+        self.balance = 0
+
+    def deposit(self, amount: int) -> None:
+        self.balance += amount
+
+    def withdraw(self, amount: int) -> None:
+        if self.balance - amount < 0:
+            return
+        self.balance -= amount
+
+
+@dataclasses.dataclass(frozen=True)
+class Deposit:
+    amount: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Withdraw:
+    amount: int
+
+
+class SimulatedBankAccount(SimulatedSystem):
+    """State = the balance; invariant: never negative
+    (BankAccountTest.scala: "A bank account should always be positive")."""
+
+    def new_system(self, seed: int) -> BankAccount:
+        return BankAccount()
+
+    def get_state(self, system: BankAccount) -> int:
+        return system.balance
+
+    def generate_command(self, system: BankAccount, rng: random.Random):
+        if rng.random() < 0.5:
+            return Deposit(rng.randrange(0, 101))
+        return Withdraw(rng.randrange(0, 101))
+
+    def run_command(self, system: BankAccount, command) -> BankAccount:
+        if isinstance(command, Deposit):
+            system.deposit(command.amount)
+        else:
+            system.withdraw(command.amount)
+        return system
+
+    def state_invariant(self, state: int) -> Optional[str]:
+        if state < 0:
+            return f"balance went negative: {state}"
+        return None
+
+
+class BuggyBankAccount(BankAccount):
+    """An unguarded withdraw — the simulator must catch the overdraft."""
+
+    def withdraw(self, amount: int) -> None:
+        self.balance -= amount
+
+
+class SimulatedBuggyBankAccount(SimulatedBankAccount):
+    def new_system(self, seed: int) -> BankAccount:
+        return BuggyBankAccount()
+
+
+# -- Die Hard (DieHard.scala / Lamport's TLA+ course) -----------------------
+
+
+class DieHard:
+    """The 3- and 5-gallon jug puzzle: measure exactly 4 gallons."""
+
+    def __init__(self) -> None:
+        self.small = 0  # 3-gallon jug
+        self.big = 0  # 5-gallon jug
+
+    def fill_small(self) -> None:
+        self.small = 3
+
+    def fill_big(self) -> None:
+        self.big = 5
+
+    def empty_small(self) -> None:
+        self.small = 0
+
+    def empty_big(self) -> None:
+        self.big = 0
+
+    def small_to_big(self) -> None:
+        poured = min(self.small, 5 - self.big)
+        self.small -= poured
+        self.big += poured
+
+    def big_to_small(self) -> None:
+        poured = min(self.big, 3 - self.small)
+        self.big -= poured
+        self.small += poured
+
+
+DIE_HARD_COMMANDS = (
+    "fill_small",
+    "fill_big",
+    "empty_small",
+    "empty_big",
+    "small_to_big",
+    "big_to_small",
+)
+
+
+class SimulatedDieHard(SimulatedSystem):
+    """State = (small, big). The "invariant" big != 4 is deliberately
+    falsifiable: a violating history IS a solution to the puzzle, showing
+    the simulator finds states, not just checks them."""
+
+    def new_system(self, seed: int) -> DieHard:
+        return DieHard()
+
+    def get_state(self, system: DieHard):
+        return (system.small, system.big)
+
+    def generate_command(self, system: DieHard, rng: random.Random) -> str:
+        return rng.choice(DIE_HARD_COMMANDS)
+
+    def run_command(self, system: DieHard, command: str) -> DieHard:
+        getattr(system, command)()
+        return system
+
+    def state_invariant(self, state) -> Optional[str]:
+        small, big = state
+        if big == 4:
+            return f"big jug holds exactly 4 gallons (small={small})"
+        if not (0 <= small <= 3 and 0 <= big <= 5):
+            return f"jug over/underflow: {state}"
+        return None

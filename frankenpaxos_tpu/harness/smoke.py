@@ -170,7 +170,10 @@ def deploy_smoke(
     dir's ``metrics.csv``, queryable via ``monitoring.scrape
     .MetricsCapture`` (the per-benchmark Prometheus of
     ``benchmarks/prometheus.py``)."""
-    from frankenpaxos_tpu.mains.registry import REGISTRY
+    from frankenpaxos_tpu.mains.registry import (
+        REGISTRY,
+        iter_role_instances,
+    )
     from frankenpaxos_tpu.monitoring.scrape import MetricsScraper, scrape_config
 
     if name == "multipaxos":
@@ -210,26 +213,19 @@ def deploy_smoke(
         jobs.setdefault(role_name, []).append(f"127.0.0.1:{p}")
         return ("--prometheus_port", str(p), "--prometheus_host", "127.0.0.1")
 
-    role_items = list(spec.roles.items())
-    for tier, (role_name, role) in enumerate(role_items):
-        cnt = role.count(config)
-        if role.grouped:
-            groups, per_group = cnt
-            for g in range(groups):
-                for i in range(per_group):
-                    role_proc(f"{role_name}_{g}_{i}", "--role", role_name,
-                              "--group_index", str(g), "--index", str(i),
-                              *metrics_args(role_name))
-        else:
-            for i in range(cnt):
-                role_proc(f"{role_name}_{i}", "--role", role_name,
-                          "--index", str(i), *metrics_args(role_name))
-        # Later tiers may run startup phases against earlier ones (e.g. a
-        # leader's phase 1 against its acceptors): let listeners bind.
-        if tier < len(role_items) - 1:
+    prev_role = None
+    for role_name, role, g, i in iter_role_instances(spec, config):
+        if prev_role is not None and role_name != prev_role:
+            # A new tier may run startup phases against earlier ones (e.g.
+            # a leader's phase 1 against its acceptors): let the previous
+            # tier's listeners bind first.
             time.sleep(0.4)
-        else:
-            time.sleep(1.0)
+        prev_role = role_name
+        label = f"{role_name}_{g}_{i}" if role.grouped else f"{role_name}_{i}"
+        extra = ("--group_index", str(g)) if role.grouped else ()
+        role_proc(label, "--role", role_name, "--index", str(i), *extra,
+                  *metrics_args(role_name))
+    time.sleep(1.0)  # let the last tier (usually leaders) finish startup
 
     time.sleep(spec.client_lag)
     recorder = bench.abspath("recorder.csv")

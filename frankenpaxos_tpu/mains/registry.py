@@ -57,6 +57,22 @@ class ProtocolSpec:
 REGISTRY: Dict[str, ProtocolSpec] = {}
 
 
+def iter_role_instances(spec: ProtocolSpec, config):
+    """Yield ``(role_name, role, group_index, index)`` for every process
+    of every role, in the spec start order — shared by the deployment
+    smokes and the viz cluster builder."""
+    for role_name, role in spec.roles.items():
+        cnt = role.count(config)
+        if role.grouped:
+            groups, per_group = cnt
+            for g in range(groups):
+                for i in range(per_group):
+                    yield role_name, role, g, i
+        else:
+            for i in range(cnt):
+                yield role_name, role, 0, i
+
+
 def register(spec: ProtocolSpec) -> ProtocolSpec:
     assert spec.name not in REGISTRY, spec.name
     REGISTRY[spec.name] = spec

@@ -1,0 +1,326 @@
+"""Browser visualization over the Stepper (the analog of the reference's
+24 Vue/snap.svg apps, ``js/src/main/js`` + ``JsTransport.scala:175-298``):
+
+    python -m frankenpaxos_tpu.viz.web --protocol paxos --port 8765
+
+builds the chosen protocol's cluster on a SimTransport (via the same
+deployment registry the TCP mains use, so EVERY registered protocol is
+viewable), serves a self-contained HTML page that renders the actors on
+an SVG ring with in-flight messages between them, and exposes the
+Stepper's controls: click a message to deliver it (buttons drop or
+duplicate it), fire timers, partition actors, inspect live actor state,
+and issue client operations. All mutations run on the single HTTP
+thread, preserving the single-threaded event-loop contract
+(Transport.scala:37-39).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import sys
+import urllib.parse
+from typing import Optional
+
+from frankenpaxos_tpu.core import FakeLogger, SimTransport, wire
+from frankenpaxos_tpu.core.logger import LogLevel
+from frankenpaxos_tpu.viz import Stepper
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>frankenpaxos_tpu viz</title>
+<style>
+ body { font-family: monospace; margin: 0; display: flex; height: 100vh; }
+ #left { flex: 1; position: relative; }
+ #right { width: 420px; overflow-y: auto; border-left: 1px solid #ccc;
+          padding: 8px; background: #fafafa; }
+ svg { width: 100%; height: 100%; }
+ .actor circle { fill: #4a90d9; cursor: pointer; }
+ .actor.partitioned circle { fill: #d94a4a; }
+ .actor.selected circle { stroke: #222; stroke-width: 3; }
+ .actor text { font-size: 11px; text-anchor: middle; pointer-events: none; }
+ .msg line { stroke: #999; stroke-width: 1.5; marker-end: url(#arrow); }
+ .msg circle { fill: #e8a33d; cursor: pointer; }
+ .msg:hover circle { fill: #d9534a; }
+ h3 { margin: 6px 0; }
+ button { margin: 1px; font-family: monospace; }
+ pre { background: #fff; border: 1px solid #ddd; padding: 6px;
+       white-space: pre-wrap; word-break: break-all; }
+ .row { margin: 2px 0; }
+</style></head>
+<body>
+<div id="left"><svg id="svg" viewBox="0 0 800 800">
+ <defs><marker id="arrow" viewBox="0 0 10 10" refX="9" refY="5"
+   markerWidth="6" markerHeight="6" orient="auto-start-reverse">
+   <path d="M 0 0 L 10 5 L 0 10 z" fill="#999"/></marker></defs>
+ <g id="links"></g><g id="actors"></g>
+</svg></div>
+<div id="right">
+ <h3 id="title"></h3>
+ <div class="row">
+  <button onclick="api('op')">client op</button>
+  <button onclick="api('deliver_all')">deliver all</button>
+ </div>
+ <h3>in-flight messages</h3><div id="msgs"></div>
+ <h3>timers</h3><div id="timers"></div>
+ <h3>actor state</h3><div id="state"><i>click an actor</i></div>
+</div>
+<script>
+let selected = null;
+async function api(path, params) {
+  const q = params ? '?' + new URLSearchParams(params) : '';
+  await fetch('/api/' + path + q, {method: 'POST'});
+  refresh();
+}
+function positions(names) {
+  const cx = 400, cy = 400, r = 320, pos = {};
+  names.forEach((name, i) => {
+    const a = 2 * Math.PI * i / names.length - Math.PI / 2;
+    pos[name] = [cx + r * Math.cos(a), cy + r * Math.sin(a)];
+  });
+  return pos;
+}
+async function refresh() {
+  const s = await (await fetch('/api/state')).json();
+  document.getElementById('title').textContent =
+    s.protocol + ' — ' + s.messages.length + ' in flight';
+  const pos = positions(s.actors.map(a => a.name));
+  const actors = s.actors.map(a => {
+    const [x, y] = pos[a.name];
+    const cls = 'actor' + (a.partitioned ? ' partitioned' : '')
+      + (a.name === selected ? ' selected' : '');
+    return `<g class="${cls}" onclick="select('${a.name}')">
+      <circle cx="${x}" cy="${y}" r="26"></circle>
+      <text x="${x}" y="${y + 44}">${a.name}</text></g>`;
+  }).join('');
+  document.getElementById('actors').innerHTML = actors;
+  const links = s.messages.map((m, j) => {
+    const [x1, y1] = pos[m.src] || [400, 400];
+    const [x2, y2] = pos[m.dst] || [400, 400];
+    // Spread concurrent messages along their line.
+    const t = 0.35 + 0.3 * ((j * 37) % 100) / 100;
+    const mx = x1 + (x2 - x1) * t, my = y1 + (y2 - y1) * t;
+    return `<g class="msg"><line x1="${x1}" y1="${y1}" x2="${x2}" y2="${y2}"/>
+      <circle cx="${mx}" cy="${my}" r="8"
+        onclick="api('deliver', {tok: '${m.tok}'})"><title>${m.desc}</title>
+      </circle></g>`;
+  }).join('');
+  document.getElementById('links').innerHTML = links;
+  document.getElementById('msgs').innerHTML = s.messages.map(m =>
+    `<div class="row">${m.desc}
+     <button onclick="api('deliver', {tok: '${m.tok}'})">deliver</button>
+     <button onclick="api('drop', {tok: '${m.tok}'})">drop</button>
+     <button onclick="api('duplicate', {tok: '${m.tok}'})">dup</button></div>`
+  ).join('') || '<i>none</i>';
+  document.getElementById('timers').innerHTML = s.timers.map(t =>
+    `<div class="row">${t.desc}
+     <button onclick="api('fire', {tok: '${t.tok}'})">fire</button></div>`
+  ).join('') || '<i>none</i>';
+  if (selected) {
+    const st = s.states[selected] || {};
+    const a = s.actors.find(a => a.name === selected) || {};
+    document.getElementById('state').innerHTML =
+      `<div class="row"><b>${selected}</b>
+       <button onclick="api('${a.partitioned ? 'unpartition' : 'partition'}',
+         {addr: '${selected}'})">${a.partitioned ? 'heal' : 'partition'}
+       </button></div><pre>${JSON.stringify(st, null, 1)}</pre>`;
+  }
+}
+function select(name) { selected = name; refresh(); }
+refresh();
+setInterval(refresh, 1000);
+</script></body></html>
+"""
+
+
+class VizServer:
+    """Serves the page + a JSON API over a Stepper. Single-threaded: the
+    HTTP server IS the event loop, so handler mutations are serial."""
+
+    def __init__(self, protocol: str, stepper: Stepper, client, issue):
+        self.protocol = protocol
+        self.stepper = stepper
+        self.client = client
+        self.issue = issue
+        self.op_counter = 0
+
+    def _message_tokens(self):
+        """Stable per-message tokens: object identity plus an occurrence
+        ordinal (duplicate_message re-queues the SAME object). Clicks act
+        on tokens, not list positions, so a click racing a state change
+        becomes a reported no-op instead of acting on the wrong
+        message."""
+        tokens = []
+        seen = {}
+        for m in self.stepper.transport.messages:
+            n = seen.get(id(m), 0)
+            seen[id(m)] = n + 1
+            tokens.append(f"{id(m)}.{n}")
+        return tokens
+
+    def _resolve_message(self, token: str) -> int:
+        for i, tok in enumerate(self._message_tokens()):
+            if tok == token:
+                return i
+        raise KeyError(f"stale message token {token!r}")
+
+    def _resolve_timer(self, token: str) -> int:
+        for i, t in enumerate(self.stepper.transport.running_timers()):
+            if f"{t.address}|{t.name()}" == token:
+                return i
+        raise KeyError(f"stale timer token {token!r}")
+
+    def snapshot(self) -> dict:
+        t = self.stepper.transport
+        partitioned = {str(a) for a in getattr(t, "partitioned", ())}
+        actors = []
+        states = {}
+        for name in self.stepper.actors():
+            actors.append({"name": name, "partitioned": name in partitioned})
+            try:
+                states[name] = {
+                    k: repr(v)[:400]
+                    for k, v in self.stepper.state(name).items()
+                }
+            except Exception as e:  # noqa: BLE001 - viz must not crash
+                states[name] = {"error": repr(e)}
+        messages = []
+        for m, tok in zip(t.messages, self._message_tokens()):
+            try:
+                desc = repr(wire.decode(m.data))[:120]
+            except Exception:  # noqa: BLE001
+                desc = f"<{len(m.data)} bytes>"
+            messages.append({
+                "tok": tok, "src": str(m.src), "dst": str(m.dst), "desc": desc,
+            })
+        timers = [
+            {"tok": f"{t_.address}|{t_.name()}", "desc": desc}
+            for t_, desc in zip(t.running_timers(), self.stepper.timers())
+        ]
+        return {
+            "protocol": self.protocol,
+            "actors": actors,
+            "states": states,
+            "messages": messages,
+            "timers": timers,
+        }
+
+    def handle(self, path: str, params: dict) -> Optional[dict]:
+        s = self.stepper
+        if path == "state":
+            return self.snapshot()
+        if path == "deliver":
+            s.deliver(self._resolve_message(params["tok"]))
+        elif path == "drop":
+            s.drop(self._resolve_message(params["tok"]))
+        elif path == "duplicate":
+            s.duplicate(self._resolve_message(params["tok"]))
+        elif path == "fire":
+            s.fire(self._resolve_timer(params["tok"]))
+        elif path == "partition":
+            s.partition(params["addr"])
+        elif path == "unpartition":
+            s.unpartition(params["addr"])
+        elif path == "deliver_all":
+            s.deliver_all()
+        elif path == "op":
+            if self.issue is not None:
+                self.issue(self.client, 0, self.op_counter)
+                self.op_counter += 1
+        else:
+            return None
+        return {"ok": True}
+
+    def serve(self, port: int, host: str = "127.0.0.1") -> None:
+        viz = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/":
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parsed.path == "/api/state":
+                    self._json(viz.snapshot())
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                params = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                if not parsed.path.startswith("/api/"):
+                    self._json({"error": "not found"}, 404)
+                    return
+                try:
+                    result = viz.handle(parsed.path[len("/api/"):], params)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 400)
+                    return
+                if result is None:
+                    self._json({"error": "unknown action"}, 404)
+                else:
+                    self._json(result)
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.HTTPServer((host, port), Handler)
+        print(f"viz: http://{host}:{port}/ ({self.protocol})")
+        server.serve_forever()
+
+
+def build_cluster(protocol: str):
+    """Build the protocol's standard small cluster on a SimTransport via
+    the deployment registry (the Scala.js wrapper analog,
+    ``js/src/main/scala/frankenpaxos/<proto>/<Proto>.scala``)."""
+    from frankenpaxos_tpu.mains.registry import REGISTRY
+
+    spec = REGISTRY[protocol]
+    transport = SimTransport(FakeLogger(LogLevel.FATAL))
+    config = spec.parse_config(spec.local_config(lambda i: f"n{i}:0"))
+    logger = FakeLogger(LogLevel.FATAL)
+    from frankenpaxos_tpu.mains.registry import iter_role_instances
+
+    for role_name, role, g, i in iter_role_instances(spec, config):
+        role.build(config, i, g, transport, logger, 0)
+    from frankenpaxos_tpu.core import SimAddress
+
+    # Protocols whose config lists client addresses (e.g. matchmakerpaxos)
+    # expect the client to live at one of them.
+    listed = getattr(config, "client_addresses", None)
+    listen = listed[0] if listed else SimAddress("client")
+    client = spec.make_client(config, listen, transport, logger, 99)
+    return transport, client, spec.issue
+
+
+def main() -> None:
+    from frankenpaxos_tpu.mains.registry import REGISTRY
+
+    parser = argparse.ArgumentParser(prog="frankenpaxos_tpu.viz.web")
+    parser.add_argument("--protocol", default="paxos", choices=sorted(REGISTRY))
+    parser.add_argument("--port", type=int, default=8765)
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args()
+
+    transport, client, issue = build_cluster(args.protocol)
+    viz = VizServer(args.protocol, Stepper(transport), client, issue)
+    viz.serve(args.port, args.host)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

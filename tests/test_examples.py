@@ -1,0 +1,60 @@
+"""Pedagogical simulator examples (bankaccount + diehard, the analog of
+``shared/src/test/scala/{bankaccount,diehard}``): the harness both
+verifies invariants that hold and FINDS states that violate falsifiable
+ones — the Die Hard water-jug solution drops out as a minimized
+counterexample history."""
+
+from frankenpaxos_tpu.examples import (
+    DieHard,
+    SimulatedBankAccount,
+    SimulatedBuggyBankAccount,
+    SimulatedDieHard,
+)
+from frankenpaxos_tpu.sim import simulate, simulate_and_minimize
+
+
+def test_bank_account_always_positive():
+    """BankAccountTest.scala: the guarded account never goes negative."""
+    bad = simulate_and_minimize(
+        SimulatedBankAccount(), run_length=100, num_runs=100, seed=0
+    )
+    assert bad is None, f"\n{bad}"
+
+
+def test_buggy_bank_account_caught_and_shrunk():
+    """Removing the withdraw guard must be caught, and the minimized
+    counterexample is a single unfunded withdrawal."""
+    bad = simulate_and_minimize(
+        SimulatedBuggyBankAccount(), run_length=100, num_runs=100, seed=0
+    )
+    assert bad is not None
+    assert "negative" in bad.error
+    # Shrinking should reduce the history to just one withdraw (possibly
+    # preceded by deposits smaller than it — but a lone withdraw suffices
+    # and ddmin finds it).
+    assert len(bad.history) == 1, bad
+    assert type(bad.history[0]).__name__ == "Withdraw"
+
+
+def test_diehard_finds_the_solution():
+    """The simulator solves the water-jug puzzle: the minimized violating
+    history of the "big != 4" invariant is a valid pouring sequence
+    ending with exactly 4 gallons in the 5-gallon jug (DieHard.scala,
+    Lamport's TLA+ example)."""
+    sim = SimulatedDieHard()
+    bad = simulate(sim, run_length=60, num_runs=200, seed=0)
+    assert bad is not None, "simulator never measured 4 gallons"
+
+    from frankenpaxos_tpu.sim import minimize
+
+    shrunk = minimize(sim, bad.seed, bad.history)
+    assert "4 gallons" in shrunk.error
+
+    # Replay the minimized history on a fresh puzzle: it must genuinely
+    # end with big == 4, and the classic solution takes 6 steps, so the
+    # shrunk history can't beat that.
+    jugs = DieHard()
+    for command in shrunk.history:
+        getattr(jugs, command)()
+    assert jugs.big == 4
+    assert 6 <= len(shrunk.history) <= 12, shrunk
