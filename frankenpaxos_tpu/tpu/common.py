@@ -26,6 +26,41 @@ def sample_delivered(drop_rate: float, key, shape) -> jnp.ndarray:
     return jax.random.uniform(key, shape) >= drop_rate
 
 
+def bit_latency(
+    bits: jnp.ndarray, shift: int, lat_min: int, lat_max: int
+) -> jnp.ndarray:
+    """Uniform latency in [lat_min, lat_max] from an 8-bit field of a
+    shared random-bits array.
+
+    Drawing independent randint arrays per message kind costs one full
+    PRNG sweep each and dominates the tick on every backend (5+ sweeps
+    over [G, W, A] per tick); disjoint bit fields of ONE threefry draw
+    are independent, so one sweep feeds every sample. The modulo carries
+    a <=1/256 bias per value — immaterial for a latency model."""
+    if lat_min == lat_max:
+        return jnp.full(bits.shape, lat_min, jnp.int32)
+    span = lat_max - lat_min + 1
+    assert span <= 256, (
+        f"latency span {span} exceeds the 8-bit sample field; use "
+        f"sample_latency for spans beyond 256 ticks"
+    )
+    field = ((bits >> shift) & jnp.uint32(0xFF)).astype(jnp.int32)
+    return lat_min + field % span
+
+
+def bit_delivered(
+    bits: jnp.ndarray, shift: int, drop_rate: float
+) -> jnp.ndarray:
+    """Bernoulli delivery mask from an 8-bit field (loss quantized to
+    multiples of 1/256 — a sim parameter, not a measured quantity)."""
+    if drop_rate == 0.0:
+        return jnp.ones(bits.shape, bool)
+    # Never round a requested nonzero loss down to zero loss.
+    threshold = max(1, int(round(drop_rate * 256)))
+    field = (bits >> shift) & jnp.uint32(0xFF)
+    return field >= threshold
+
+
 def ring_retire(
     retire_ord: jnp.ndarray,  # [G, W] bool, in absolute order from head
     head: jnp.ndarray,  # [G]

@@ -41,8 +41,9 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.tpu.common import (
     INF,
     LAT_BINS,
+    bit_delivered,
+    bit_latency,
     ring_retire,
-    sample_delivered,
     sample_latency,
 )
 
@@ -165,9 +166,28 @@ def tick(
     slots and retries timed-out ones."""
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     f = cfg.f
-    k_quorum, k_lat1, k_lat2, k_lat3, k_drop1, k_drop2, k_retry = (
-        jax.random.split(key, 7)
+    # One random-bits sweep per shape feeds every sample via disjoint bit
+    # fields (see common.bit_latency) — drawing separate randint/uniform
+    # arrays per message kind made PRNG generation dominate the tick.
+    k3, k2, k_extra = jax.random.split(key, 3)
+    bits3 = jax.random.bits(k3, (G, W, A))  # [0:8) p2b lat, [8:16) p2a lat,
+    #                                         [16:24) retry lat, [24:32) p2b drop
+    bits2 = jax.random.bits(k2, (G, W))  # [0:8) replica lat, [8:16) thrifty
+    p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p2a_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    retry_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
+    p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
+    # The extra sweep (drawn only when some feature needs it) feeds the
+    # p2a drop field [0:8) AND, for general-f thrifty, the quorum ranking
+    # scores [8:32) — disjoint fields, one generation.
+    need_extra = cfg.drop_rate > 0.0 or (cfg.thrifty and cfg.f > 1)
+    bits_extra = (
+        jax.random.bits(k_extra, (G, W, A))
+        if need_extra
+        else jnp.zeros((G, W, A), jnp.uint32)
     )
+    p2a_delivered = bit_delivered(bits_extra, 0, cfg.drop_rate)
 
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
@@ -187,8 +207,6 @@ def tick(
     vote_value = jnp.where(
         may_vote, state.slot_value[:, :, None], state.vote_value
     )
-    p2b_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat1, (G, W, A))
-    p2b_delivered = sample_delivered(cfg.drop_rate, k_drop1, (G, W, A))
     p2b_arrival = jnp.where(
         may_vote & p2b_delivered,
         jnp.minimum(state.p2b_arrival, t + p2b_lat),
@@ -208,7 +226,6 @@ def tick(
         newly_chosen, state.leader_round[:, None], state.chosen_round
     )
     chosen_value = jnp.where(newly_chosen, state.slot_value, state.chosen_value)
-    rep_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat3, (G, W))
     replica_arrival = jnp.where(
         newly_chosen, t + rep_lat, state.replica_arrival
     )
@@ -283,14 +300,22 @@ def tick(
 
     # Thrifty quorum selection (ThriftySystem / ProxyLeader.scala:187-197):
     # Phase2a goes to f+1 random acceptors of the slot's group.
-    if cfg.thrifty:
-        scores = jax.random.uniform(k_quorum, (G, W, A))
+    if cfg.thrifty and f == 1:
+        # f+1 of 2f+1 = all but one: exclude one uniformly random member
+        # (A = 3 divides 255+1? no — modulo bias <= 1/256, see
+        # common.bit_latency).
+        excluded = (
+            ((bits2 >> 8) & jnp.uint32(0xFF)).astype(jnp.int32) % A
+        )  # [G, W]
+        in_quorum = jnp.arange(A)[None, None, :] != excluded[:, :, None]
+    elif cfg.thrifty:
+        # General f: rank the extra sweep's high bits (disjoint from the
+        # p2a drop field, uncorrelated with the latency fields).
+        scores = bits_extra >> 8
         kth = jnp.sort(scores, axis=2)[:, :, f : f + 1]  # (f+1)-th smallest
         in_quorum = scores <= kth
     else:
         in_quorum = jnp.ones((G, W, A), bool)
-    p2a_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_lat2, (G, W, A))
-    p2a_delivered = sample_delivered(cfg.drop_rate, k_drop2, (G, W, A))
     send_p2a = is_new[:, :, None] & in_quorum & p2a_delivered
     p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
 
@@ -299,7 +324,6 @@ def tick(
     # including acceptors that already voted: their Phase2b may have been
     # the dropped message, and re-voting (step 1) re-samples its delivery.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    retry_lat = sample_latency(cfg.lat_min, cfg.lat_max, k_retry, (G, W, A))
     resend = timed_out[:, :, None]
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
