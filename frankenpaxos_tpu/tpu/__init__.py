@@ -19,6 +19,7 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
     check_invariants,
     init_state,
     leader_change,
+    reconfigure,
     run_ticks,
     tick,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "epaxos_batched",
     "init_state",
     "leader_change",
+    "reconfigure",
     "run_ticks",
     "tick",
 ]

@@ -1,0 +1,198 @@
+"""The five tracked benchmark configurations of BASELINE.json, as one
+runner:
+
+    python -m frankenpaxos_tpu.tpu.baseline_configs          # quick sizes
+    python -m frankenpaxos_tpu.tpu.baseline_configs --full   # 10k/100k
+
+  1. MultiPaxos f=1 smoke (batched backend, invariants).
+  2. Compartmentalized grid-quorum MultiPaxos (2x3 flexible grid).
+  3. EPaxos / Simple BPaxos 5-replica dependency graphs.
+  4. Matchmaker reconfiguration churn: throughput and p50 latency with
+     periodic acceptor-set reconfigurations vs a churn-free run.
+  5. Flexible-quorum sweep, grid vs majority (100k acceptors with
+     --full; the sweep shards over a device mesh when one is available).
+
+Prints one JSON line per config. Runs on whatever backend jax selects;
+force CPU with JAX_PLATFORMS=cpu (tests use tiny sizes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def config1_multipaxos_smoke(full: bool) -> dict:
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=64 if full else 8, window=32, slots_per_tick=4,
+        lat_min=1, lat_max=3,
+    )
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(200)
+    sim.block_until_ready()
+    inv = sim.check_invariants()
+    assert all(inv.values()), inv
+    stats = sim.stats()
+    return {
+        "config": "multipaxos_f1_smoke",
+        "committed": stats["committed"],
+        "p50_latency_ticks": stats["commit_latency_p50_ticks"],
+        "invariants_ok": True,
+    }
+
+
+def config2_grid(full: bool) -> dict:
+    from frankenpaxos_tpu.tpu.grid_batched import (
+        GridBatchedConfig,
+        check_invariants,
+        init_state,
+        run_ticks,
+    )
+    import jax
+    import jax.numpy as jnp
+
+    cfg = GridBatchedConfig(rows=2, cols=3, window=256 if full else 64)
+    state, t = run_ticks(
+        cfg, init_state(cfg), jnp.int32(0), 300, jax.random.PRNGKey(0)
+    )
+    inv = {k: bool(v) for k, v in check_invariants(cfg, state, t).items()}
+    assert all(inv.values()), inv
+    return {
+        "config": "grid_2x3_flexible",
+        "committed": int(state.committed),
+        "invariants_ok": True,
+    }
+
+
+def config3_depgraph(full: bool) -> dict:
+    from frankenpaxos_tpu.tpu.epaxos_batched import (
+        BatchedEPaxosConfig,
+        check_invariants,
+        init_state,
+        run_ticks,
+    )
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for name, bpaxos in [("epaxos", False), ("simplebpaxos", True)]:
+        cfg = BatchedEPaxosConfig(
+            num_columns=5,
+            window=256 if full else 64,
+            instances_per_tick=8 if full else 2,
+            slow_path_rate=0.2,
+            see_same_tick_rate=0.5,
+            simplebpaxos=bpaxos,
+        )
+        ticks = 500 if full else 150
+        t0 = time.perf_counter()
+        state, t = run_ticks(
+            cfg, init_state(cfg), jnp.int32(0), ticks, jax.random.PRNGKey(0)
+        )
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        inv = {k: bool(v) for k, v in check_invariants(cfg, state, t).items()}
+        assert all(inv.values()), inv
+        out[name] = {
+            "executed": int(state.executed_total),
+            "executed_per_sec": round(int(state.executed_total) / dt, 1),
+            "mean_exec_latency_ticks": round(
+                float(state.lat_sum) / max(1, int(state.executed_total)), 2
+            ),
+        }
+    return {"config": "epaxos_bpaxos_5replica_depgraph", **out}
+
+
+def config4_matchmaker_churn(full: bool) -> dict:
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=256 if full else 16, window=64, slots_per_tick=4,
+        lat_min=1, lat_max=3, retry_timeout=16,
+    )
+
+    def run(churn_every: int | None) -> dict:
+        sim = TpuSimTransport(cfg, seed=3)
+        sim.run(100)  # warm the pipeline
+        sim.block_until_ready()
+        base = sim.committed()
+        segments, seg_ticks = 10, 50
+        reconfigurations = 0
+        for i in range(segments):
+            # Reconfigure BEFORE a segment so every counted swap has
+            # measured ticks behind it.
+            if churn_every is not None and i > 0 and i % churn_every == 0:
+                sim.reconfigure()
+                reconfigurations += 1
+            sim.run(seg_ticks)
+        sim.block_until_ready()
+        inv = sim.check_invariants()
+        assert all(inv.values()), inv
+        stats = sim.stats()
+        return {
+            "committed": sim.committed() - base,
+            "per_tick": round((sim.committed() - base) / (segments * seg_ticks), 1),
+            "p50_latency_ticks": stats["commit_latency_p50_ticks"],
+            "reconfigurations": reconfigurations,
+        }
+
+    churn_free = run(None)
+    churned = run(2)  # a reconfiguration every 100 ticks
+    return {
+        "config": "matchmaker_reconfiguration_churn",
+        "churn_free": churn_free,
+        "with_churn": churned,
+        "throughput_retained": round(
+            churned["per_tick"] / max(1e-9, churn_free["per_tick"]), 3
+        ),
+    }
+
+
+def config5_flexible_sweep(full: bool) -> dict:
+    from frankenpaxos_tpu.tpu.grid_batched import GridBatchedConfig, sweep
+
+    if full:
+        # 100k acceptors, grid vs flat-majority quorums.
+        shapes = [(100, 1000), (10, 10000)]
+        window = 64
+    else:
+        shapes = [(2, 3), (4, 8)]
+        window = 32
+    configs = [
+        GridBatchedConfig(rows=r, cols=c, mode=mode, window=window)
+        for (r, c) in shapes
+        for mode in ("grid", "majority")
+    ]
+    results = sweep(configs, num_ticks=200)
+    return {"config": "flexible_quorum_sweep", "points": results}
+
+
+CONFIGS = {
+    "1": config1_multipaxos_smoke,
+    "2": config2_grid,
+    "3": config3_depgraph,
+    "4": config4_matchmaker_churn,
+    "5": config5_flexible_sweep,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="frankenpaxos_tpu.tpu.baseline_configs"
+    )
+    parser.add_argument("--full", action="store_true",
+                        help="production sizes (10k/100k acceptors)")
+    parser.add_argument("configs", nargs="*", choices=list(CONFIGS),
+                        help="subset to run (default: all)")
+    args = parser.parse_args()
+    for name in args.configs or list(CONFIGS):
+        result = CONFIGS[name](args.full)
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -402,6 +402,39 @@ def leader_change(
     )
 
 
+def reconfigure(
+    cfg: BatchedMultiPaxosConfig,
+    state: BatchedMultiPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedMultiPaxosState:
+    """Matchmaker-style acceptor reconfiguration (BASELINE config 4; the
+    batched analog of matchmakermultipaxos: the leader matchmakes a NEW
+    acceptor configuration bound to the next round, phase-1s against the
+    old configuration to learn its votes, adopts safe values, and
+    re-proposes every in-flight slot to the new acceptors).
+
+    Built on leader_change (round bump == configuration epoch bump +
+    phase-1 repair reading every old acceptor, a superset of any read
+    quorum). On top of it, the new configuration starts fresh: in-flight
+    slots' vote state and pending Phase2bs clear (the new acceptors have
+    never voted), and the acceptors arrive knowing the configuration's
+    round (the matchmaker hands them the config bound to it). CHOSEN
+    slots keep their old-configuration vote record until they retire —
+    the analog of old configurations being garbage collected only once
+    the chosen watermark passes them (Reconfigurer/GC pipeline)."""
+    state = leader_change(cfg, state, t, key)  # also clears pending Phase2bs
+    in_flight = (state.status == PROPOSED)[:, :, None]
+    return dataclasses.replace(
+        state,
+        acc_round=jnp.broadcast_to(
+            state.leader_round[:, None], state.acc_round.shape
+        ),
+        vote_round=jnp.where(in_flight, -1, state.vote_round),
+        vote_value=jnp.where(in_flight, NO_VALUE, state.vote_value),
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(0, 3))
 def run_ticks(
     cfg: BatchedMultiPaxosConfig,

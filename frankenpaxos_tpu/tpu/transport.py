@@ -28,6 +28,7 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
     check_invariants,
     init_state,
     leader_change,
+    reconfigure,
     run_ticks,
 )
 
@@ -69,6 +70,12 @@ class TpuSimTransport:
         key = jax.random.fold_in(self.key, 10_000_000 + self._epoch)
         self._epoch += 1
         self.state = leader_change(self.config, self.state, self.t, key)
+
+    def reconfigure(self) -> None:
+        """Swap in a fresh acceptor configuration (Matchmaker churn)."""
+        key = jax.random.fold_in(self.key, 20_000_000 + self._epoch)
+        self._epoch += 1
+        self.state = reconfigure(self.config, self.state, self.t, key)
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)

@@ -208,3 +208,93 @@ def test_invariant_checker_has_teeth():
     )
     inv = check_invariants(cfg, bad, t)
     assert not bool(inv["quorum_ok"])
+
+
+def test_reconfiguration_churn_preserves_safety_and_values():
+    """Matchmaker-style reconfiguration (BASELINE config 4): periodic
+    acceptor-set swaps preserve all invariants, and an in-flight slot
+    with a vote in the old configuration keeps its value through the
+    reconfiguration (the phase-1-against-old-configs guarantee)."""
+    import dataclasses as dc
+
+    import numpy as np
+
+    from frankenpaxos_tpu.tpu.multipaxos_batched import (
+        INF,
+        NOOP_VALUE,
+        BatchedMultiPaxosConfig,
+        check_invariants,
+        init_state,
+        reconfigure,
+        tick,
+    )
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=2, window=8, slots_per_tick=2,
+        lat_min=1, lat_max=1, thrifty=False, retry_timeout=100,
+        max_slots_per_group=2,
+    )
+    key = jax.random.PRNGKey(5)
+    state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    # Let exactly one acceptor of group 0 slot 0 vote; block the rest.
+    p2a = np.asarray(state.p2a_arrival).copy()
+    p2a[:, :, 1:] = int(INF)
+    p2a[1, :, :] = int(INF)
+    p2a[0, 1, :] = int(INF)
+    state = dc.replace(state, p2a_arrival=jnp.asarray(p2a))
+    state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
+    assert int(state.committed) == 0
+    voted_value = int(np.asarray(state.vote_value)[0, 0, 0])
+    assert voted_value >= 0
+
+    # Reconfigure: new acceptor set; the voted slot must keep its value,
+    # unvoted in-flight slots become noops.
+    state = reconfigure(cfg, state, jnp.int32(2), jax.random.fold_in(key, 99))
+    slot_value = np.asarray(state.slot_value)
+    assert int(slot_value[0, 0]) == voted_value
+    assert int(slot_value[0, 1]) == NOOP_VALUE
+    assert int(slot_value[1, 0]) == NOOP_VALUE
+    # Fresh acceptors: no votes, no pending phase2bs for in-flight slots.
+    assert (np.asarray(state.vote_round) == -1).all()
+    # Run to completion: everything commits in the new configuration.
+    t = 2
+    for _ in range(20):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    inv = check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+    assert int(state.retired) == 4
+    # The chosen value for the voted slot survived the configuration swap.
+
+
+def test_reconfiguration_under_load_invariants():
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(
+        f=2, num_groups=4, window=32, slots_per_tick=4,
+        lat_min=1, lat_max=3, drop_rate=0.1,
+    )
+    sim = TpuSimTransport(cfg, seed=11)
+    for _ in range(4):
+        sim.run(50)
+        sim.reconfigure()
+    sim.run(100)
+    inv = sim.check_invariants()
+    assert all(inv.values()), inv
+    assert sim.stats()["round"] == 4
+    assert sim.committed() > 500
+
+
+def test_baseline_configs_runner():
+    """The five tracked BASELINE configurations run and report sane
+    results at test sizes."""
+    from frankenpaxos_tpu.tpu import baseline_configs as bc
+
+    r1 = bc.config1_multipaxos_smoke(full=False)
+    assert r1["committed"] > 0 and r1["invariants_ok"]
+    r4 = bc.config4_matchmaker_churn(full=False)
+    assert r4["with_churn"]["reconfigurations"] == 4
+    assert r4["throughput_retained"] > 0.8  # churn must not crater it
+    r5 = bc.config5_flexible_sweep(full=False)
+    modes = {(p["mode"], p["acceptors"]) for p in r5["points"]}
+    assert ("grid", 6) in modes and ("majority", 6) in modes
