@@ -1,0 +1,114 @@
+"""Pallas kernel tests (interpret mode on CPU): the fused acceptor-step
+kernel must match its pure-jnp specification bit for bit, and the spec
+must match the live tick's vote/quorum phase."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.ops import (
+    INF,
+    fused_vote_quorum,
+    reference_vote_quorum,
+)
+
+
+def random_state(key, A=3, G=8, W=16, t=7):
+    ks = jax.random.split(key, 8)
+    p2a = jnp.where(
+        jax.random.uniform(ks[0], (A, G, W)) < 0.3,
+        jax.random.randint(ks[1], (A, G, W), t - 2, t + 3),
+        INF,
+    )
+    acc_round = jax.random.randint(ks[2], (A, G), 0, 3)
+    leader_round = jax.random.randint(ks[3], (G,), 0, 3)
+    slot_value = jax.random.randint(ks[4], (G, W), 0, 1000)
+    vote_round = jax.random.randint(ks[5], (A, G, W), -1, 3)
+    vote_value = jnp.where(
+        vote_round >= 0, jax.random.randint(ks[6], (A, G, W), 0, 1000), -1
+    )
+    p2b = jnp.where(
+        vote_round >= 0,
+        jax.random.randint(ks[7], (A, G, W), t - 3, t + 4),
+        INF,
+    )
+    lat = jax.random.randint(jax.random.fold_in(key, 9), (A, G, W), 1, 4)
+    delivered = jax.random.uniform(jax.random.fold_in(key, 10), (A, G, W)) < 0.9
+    return (
+        p2a, acc_round, leader_round, slot_value,
+        vote_round, vote_value, p2b, lat, delivered, jnp.int32(t),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shape", [(3, 8, 16), (5, 4, 32)])
+def test_fused_vote_quorum_matches_reference(seed, shape):
+    A, G, W = shape
+    args = random_state(jax.random.PRNGKey(seed), A=A, G=G, W=W)
+    ref = reference_vote_quorum(*args)
+    got = fused_vote_quorum(*args, block_g=G // 2, interpret=True)
+    names = ["vote_round", "vote_value", "p2b_arrival", "acc_round", "nvotes"]
+    for name, r, g in zip(names, ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g), err_msg=name)
+
+
+def test_reference_matches_tick_phase():
+    """The acceptor-major spec equals the tick's group-major vote/count
+    phase, replicating the tick's OWN bit-derived latency and drop
+    samples so every spec output (votes, phase2b schedule, promised
+    rounds, quorum counts) is compared."""
+    from frankenpaxos_tpu.tpu.common import bit_delivered, bit_latency
+    from frankenpaxos_tpu.tpu.multipaxos_batched import (
+        CHOSEN,
+        PROPOSED,
+        BatchedMultiPaxosConfig,
+        init_state,
+        tick,
+    )
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=8, slots_per_tick=2,
+        lat_min=1, lat_max=3, drop_rate=0.2, thrifty=False,
+    )
+    key = jax.random.PRNGKey(2)
+    state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    # Recompute the tick's own per-message samples for t=1 (same key
+    # derivation as multipaxos_batched.tick steps 0-1).
+    tkey = jax.random.fold_in(key, 1)
+    k3, k2, k_extra = jax.random.split(tkey, 3)
+    G, W, A = cfg.num_groups, cfg.window, cfg.group_size
+    bits3 = jax.random.bits(k3, (G, W, A))
+    p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
+
+    am = lambda x: jnp.transpose(x, (2, 0, 1))  # [G,W,A] -> [A,G,W]
+    vr, vv, p2b, accr, nvotes = reference_vote_quorum(
+        am(state.p2a_arrival),
+        jnp.transpose(state.acc_round, (1, 0)),
+        state.leader_round,
+        state.slot_value,
+        am(state.vote_round),
+        am(state.vote_value),
+        am(state.p2b_arrival),
+        am(p2b_lat),
+        am(p2b_delivered),
+        jnp.int32(1),
+    )
+    after = tick(cfg, state, jnp.int32(1), tkey)
+    gm = lambda x: jnp.transpose(x, (1, 2, 0))  # [A,G,W] -> [G,W,A]
+    np.testing.assert_array_equal(np.asarray(gm(vr)), np.asarray(after.vote_round))
+    np.testing.assert_array_equal(np.asarray(gm(vv)), np.asarray(after.vote_value))
+    np.testing.assert_array_equal(
+        np.asarray(gm(p2b)), np.asarray(after.p2b_arrival)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jnp.transpose(accr, (1, 0))), np.asarray(after.acc_round)
+    )
+    # nvotes drives chosen-ness: slots the spec counts to quorum are
+    # exactly the slots the tick marked CHOSEN this tick (no prior
+    # chosen at t=1; status is PROPOSED or CHOSEN only).
+    chosen = np.asarray(after.status) == CHOSEN
+    proposed_before = np.asarray(state.status) == PROPOSED
+    expect_chosen = proposed_before & (np.asarray(nvotes) >= cfg.f + 1)
+    np.testing.assert_array_equal(expect_chosen, chosen)
