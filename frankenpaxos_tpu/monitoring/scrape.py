@@ -153,7 +153,7 @@ class MetricsCapture:
             for k, v in label_filters.items():
                 # Anchored per-label match: 'type=ClientRequest' must not
                 # also match 'type=ClientRequestBatch'.
-                pattern = f"(^|;){re.escape(k)}={re.escape(str(v))}(;|$)"
+                pattern = f"(?:^|;){re.escape(k)}={re.escape(str(v))}(?:;|$)"
                 df = df[df["labels"].fillna("").str.contains(pattern)]
         if not len(df):
             return pd.DataFrame()
