@@ -159,6 +159,7 @@ def deploy_smoke(
     duration: float = 3.0,
     num_pseudonyms: int = 2,
     capture_metrics: bool = True,
+    profile_role: str | None = None,
 ) -> dict:
     """A real localhost deployment of ``name``: every role is its own OS
     process launched via the generic role main
@@ -169,7 +170,10 @@ def deploy_smoke(
     role exposes /metrics and a scraper captures samples into the bench
     dir's ``metrics.csv``, queryable via ``monitoring.scrape
     .MetricsCapture`` (the per-benchmark Prometheus of
-    ``benchmarks/prometheus.py``)."""
+    ``benchmarks/prometheus.py``). ``profile_role`` wraps the first
+    process of that role with cProfile (the perf-record/flame-graph
+    capability of ``benchmarks/perf_util.py:37-96``); the pstats dump
+    lands in the bench dir as ``profile_<role>.pstats``."""
     from frankenpaxos_tpu.mains.registry import (
         REGISTRY,
         iter_role_instances,
@@ -177,6 +181,11 @@ def deploy_smoke(
     from frankenpaxos_tpu.monitoring.scrape import MetricsScraper, scrape_config
 
     if name == "multipaxos":
+        if profile_role is not None:
+            raise ValueError(
+                "profile_role is not supported for the multipaxos smoke "
+                "(it deploys via its dedicated main)"
+            )
         return smoke_multipaxos(
             bench, duration,
             num_pseudonyms=num_pseudonyms,
@@ -195,12 +204,38 @@ def deploy_smoke(
     config = spec.parse_config(config_dict)
     env = _role_env()
 
+    profiled_proc = [None]
+
     def role_proc(label, *extra):
-        return bench.popen(label, [
-            sys.executable, "-m", "frankenpaxos_tpu.mains.run",
+        prefix = [sys.executable]
+        run_for = ()
+        profiling = (
+            profile_role is not None
+            and label.startswith(profile_role)
+            and profiled_proc[0] is None
+        )
+        if profiling:
+            prefix = [
+                sys.executable, "-m", "cProfile",
+                "-o", bench.abspath(f"profile_{profile_role}.pstats"),
+            ]
+            # The profiler only dumps on clean interpreter exit; schedule
+            # the role's shutdown just past the client's run, and the
+            # smoke waits for it below (the process reaper would
+            # otherwise SIGKILL it before the dump).
+            # Generous slack past the client's window: tier sleeps,
+            # process spawns, and load can push the client's finish well
+            # past its nominal schedule, and an early exit of a singleton
+            # role would hang the client.
+            run_for = ("--run_for", str(spec.client_lag + duration + 15.0))
+        proc = bench.popen(label, [
+            *prefix, "-m", "frankenpaxos_tpu.mains.run",
             "--protocol", name, "--config", config_path,
-            "--log_level", "error", *extra,
+            "--log_level", "error", *extra, *run_for,
         ], env=env)
+        if profiling:
+            profiled_proc[0] = proc
+        return proc
 
     jobs = {}
     metrics_port = [port + 100]
@@ -227,6 +262,11 @@ def deploy_smoke(
                   *metrics_args(role_name))
     time.sleep(1.0)  # let the last tier (usually leaders) finish startup
 
+    if profile_role is not None and profiled_proc[0] is None:
+        raise ValueError(
+            f"profile_role {profile_role!r} matched no role of {name}; "
+            f"roles: {sorted(spec.roles)}"
+        )
     time.sleep(spec.client_lag)
     recorder = bench.abspath("recorder.csv")
     with contextlib.ExitStack() as stack:
@@ -242,6 +282,10 @@ def deploy_smoke(
             "--warmup", "0", "--output", recorder,
         )
         code = client.wait(timeout=duration + 30)
+        if profiled_proc[0] is not None:
+            # Let the profiled role hit its clean-exit timer and write
+            # the pstats dump before the reaper kills everything.
+            profiled_proc[0].wait(timeout=30)
     assert code == 0, f"client exited with {code}"
     return _summarize_recorder(recorder)
 

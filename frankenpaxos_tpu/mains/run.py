@@ -41,6 +41,10 @@ def main() -> None:
     parser.add_argument("--group_index", type=int, default=0)
     parser.add_argument("--config", required=True)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--run_for", type=float, default=0.0,
+                        help="non-client roles: exit cleanly after this many "
+                             "seconds (0 = run forever); needed for "
+                             "profilers that dump at interpreter exit")
     # Client-role flags (ClientMain.scala:24-79).
     parser.add_argument("--listen", help="client listen address host:port")
     parser.add_argument("--duration", type=float, default=5.0)
@@ -74,6 +78,11 @@ def main() -> None:
         # the /metrics endpoint (PrometheusUtil.scala:6-15 analog).
         collectors = make_collectors(args)
         actor.enable_metrics(collectors, f"{spec.name}_{args.role}")
+    if args.run_for > 0 and actor is not None:
+        shutdown = transport.timer(
+            actor.address, "shutdown", args.run_for, transport.shutdown
+        )
+        shutdown.start()
     transport.run()
 
 

@@ -1,0 +1,102 @@
+"""Dashboard rendering from a metrics capture (the analog of the
+reference's 15 Grafana dashboards, ``grafana/dashboards/*.json``): one
+command turns a benchmark's ``metrics.csv`` into a multi-panel figure of
+per-role request rates and handler latencies.
+
+    python -m frankenpaxos_tpu.monitoring.dashboard <bench_dir_or_csv> \\
+        [-o dashboard.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+from frankenpaxos_tpu.monitoring.scrape import MetricsCapture
+
+
+def render_dashboard(
+    capture: MetricsCapture,
+    output: str,
+    window_ms: float = 1000.0,
+) -> Optional[str]:
+    """One panel per *_requests_total metric (rate per series) plus one
+    per *_handler_latency_seconds (mean latency per series). Returns the
+    output path, or None if the capture holds no plottable metrics."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rate_metrics = [n for n in capture.names() if n.endswith("_requests_total")]
+    lat_counts = [
+        n for n in capture.names()
+        if n.endswith("_handler_latency_seconds_count")
+    ]
+    panels = []
+    for name in rate_metrics:
+        panels.append(("rate", name))
+    for count_name in lat_counts:
+        base = count_name[: -len("_count")]
+        if f"{base}_sum" in capture.names():
+            panels.append(("latency", base))
+    if not panels:
+        return None
+
+    fig, axes = plt.subplots(
+        len(panels), 1, figsize=(9, 3 * len(panels)), squeeze=False
+    )
+    for ax_row, (kind, name) in zip(axes, panels):
+        ax = ax_row[0]
+        if kind == "rate":
+            wide = capture.rate(name, window_ms=window_ms)
+            title = f"{name} (rate/s, {int(window_ms)}ms windows)"
+        else:
+            # Mean handler latency = d(sum)/d(count) over the window.
+            total = capture.query(f"{name}_sum")
+            count = capture.query(f"{name}_count")
+            wide = (
+                total.ffill().diff().sum(axis=1)
+                / count.ffill().diff().sum(axis=1).replace(0, float("nan"))
+            ).to_frame("mean_s") * 1000.0
+            title = f"{name} (mean ms between scrapes)"
+        for col in wide.columns:
+            series = wide[col].dropna()
+            # Aggregate labelled series lightly: plot each, thin legend.
+            ax.plot(series.index, series.values, label=str(col)[:60])
+        ax.set_title(title, fontsize=9)
+        ax.grid(True)
+        if 0 < len(wide.columns) <= 8:
+            ax.legend(fontsize=6, loc="best")
+    fig.tight_layout()
+    fig.savefig(output)
+    plt.close(fig)
+    return output
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="frankenpaxos_tpu.monitoring.dashboard"
+    )
+    parser.add_argument("path", help="metrics.csv or a benchmark directory")
+    parser.add_argument("-o", "--output", default=None)
+    args = parser.parse_args()
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.csv")
+    capture = MetricsCapture(path)
+    output = args.output or os.path.join(
+        os.path.dirname(os.path.abspath(path)), "dashboard.png"
+    )
+    result = render_dashboard(capture, output)
+    if result is None:
+        print("no plottable metrics in capture", file=sys.stderr)
+        sys.exit(1)
+    print(result)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

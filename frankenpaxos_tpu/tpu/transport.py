@@ -80,6 +80,21 @@ class TpuSimTransport:
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)
 
+    def profile(self, num_ticks: int, trace_dir: str) -> str:
+        """Run ``num_ticks`` under jax.profiler and write a trace into
+        ``trace_dir`` (viewable in TensorBoard/Perfetto) — the device-side
+        profiling capability the reference gets from perf-record flame
+        graphs (``benchmarks/perf_util.py:37-96``)."""
+        # Warm up with the SAME segment length: run_ticks specializes on
+        # num_ticks, so a different warmup length would leave compilation
+        # inside the trace.
+        self.run(num_ticks)
+        self.block_until_ready()
+        with jax.profiler.trace(trace_dir):
+            self.run(num_ticks)
+            self.block_until_ready()
+        return trace_dir
+
     # -- Observability -------------------------------------------------------
 
     def committed(self) -> int:

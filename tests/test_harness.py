@@ -224,3 +224,24 @@ def test_microbench_smoke():
         "Tarjan", "IncrementalTarjan", "Naive", "Zigzag",
     }
     assert all(r["ops_per_sec"] > 0 for r in rows)
+
+
+def test_deploy_smoke_profiles_a_role(tmp_path):
+    """profile_role wraps one role with cProfile and the pstats dump
+    lands in the bench dir (perf_util.py capability)."""
+    import pstats
+
+    from frankenpaxos_tpu.harness.benchmark import BenchmarkDirectory
+    from frankenpaxos_tpu.harness import smoke
+
+    bench = BenchmarkDirectory(str(tmp_path / "prof"))
+    with bench:
+        result = smoke.deploy_smoke(
+            "unreplicated", bench, duration=1.5, profile_role="server"
+        )
+    assert result["requests"] > 0
+    stats = pstats.Stats(bench.abspath("profile_server.pstats"))
+    assert len(stats.stats) > 50
+
+    with pytest.raises(ValueError):
+        smoke.deploy_smoke("unreplicated", bench, profile_role="bogus")

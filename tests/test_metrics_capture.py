@@ -81,3 +81,35 @@ def test_scraper_and_capture_roundtrip(tmp_path):
         assert float(wide.ffill().iloc[-1].iloc[0]) == 10.0
     finally:
         server.shutdown()
+
+
+def test_dashboard_renders(tmp_path):
+    """metrics.csv -> multi-panel dashboard figure (the Grafana-dashboard
+    capability, grafana/dashboards/)."""
+    import time as _time
+
+    from frankenpaxos_tpu.monitoring.dashboard import render_dashboard
+
+    collectors = PrometheusCollectors()
+    counter = collectors.counter("demo_requests_total", "d", labels=("type",))
+    lat = collectors.summary(
+        "demo_handler_latency_seconds", "d", labels=("type",)
+    )
+    port = 23991
+    server = collectors.start_http_server(port, host="127.0.0.1")
+    try:
+        path = str(tmp_path / "metrics.csv")
+        with MetricsScraper(
+            {"demo": [f"127.0.0.1:{port}"]}, path, scrape_interval_ms=50
+        ):
+            for i in range(4):
+                counter.labels("A").inc(5)
+                lat.labels("A").observe(0.001 * (i + 1))
+                _time.sleep(0.08)
+        out = render_dashboard(MetricsCapture(path), str(tmp_path / "dash.png"))
+        assert out is not None
+        import os
+
+        assert os.path.getsize(out) > 1000
+    finally:
+        server.shutdown()

@@ -298,3 +298,21 @@ def test_baseline_configs_runner():
     r5 = bc.config5_flexible_sweep(full=False)
     modes = {(p["mode"], p["acceptors"]) for p in r5["points"]}
     assert ("grid", 6) in modes and ("majority", 6) in modes
+
+
+def test_tpu_profile_writes_trace(tmp_path):
+    """TpuSimTransport.profile captures a jax.profiler trace of a run
+    segment (the perf_util.py flame-graph capability, device-side)."""
+    import os
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(f=1, num_groups=4, window=16, slots_per_tick=2)
+    sim = TpuSimTransport(cfg, seed=0)
+    trace_dir = str(tmp_path / "trace")
+    sim.profile(20, trace_dir)
+    assert sim.committed() > 0
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += files
+    assert found, "profiler wrote no trace files"
