@@ -192,6 +192,11 @@ def deploy_smoke(
             capture_metrics=capture_metrics,
         )
     spec = REGISTRY[name]
+    if profile_role is not None and profile_role not in spec.roles:
+        raise ValueError(
+            f"profile_role {profile_role!r} is not a role of {name}; "
+            f"roles: {sorted(spec.roles)}"
+        )
     port = _base_port()
 
     def hp(i):
@@ -262,11 +267,6 @@ def deploy_smoke(
                   *metrics_args(role_name))
     time.sleep(1.0)  # let the last tier (usually leaders) finish startup
 
-    if profile_role is not None and profiled_proc[0] is None:
-        raise ValueError(
-            f"profile_role {profile_role!r} matched no role of {name}; "
-            f"roles: {sorted(spec.roles)}"
-        )
     time.sleep(spec.client_lag)
     recorder = bench.abspath("recorder.csv")
     with contextlib.ExitStack() as stack:
@@ -284,8 +284,14 @@ def deploy_smoke(
         code = client.wait(timeout=duration + 30)
         if profiled_proc[0] is not None:
             # Let the profiled role hit its clean-exit timer and write
-            # the pstats dump before the reaper kills everything.
-            profiled_proc[0].wait(timeout=30)
+            # the pstats dump before the reaper kills everything; a None
+            # result is a timeout (PopenProc.wait doesn't raise), which
+            # would mean no dump was written.
+            rc = profiled_proc[0].wait(timeout=30)
+            assert rc is not None, (
+                f"profiled {profile_role} role did not exit in time; "
+                f"no pstats dump was written"
+            )
     assert code == 0, f"client exited with {code}"
     return _summarize_recorder(recorder)
 

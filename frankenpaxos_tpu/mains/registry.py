@@ -201,7 +201,11 @@ def _bu_build(role):
 def _bu_client(config, listen, t, logger, seed):
     from frankenpaxos_tpu.protocols import batchedunreplicated as bu
 
-    return bu.BuClient(listen, t, logger, config, seed=seed)
+    # Batchers flush only on a full batch (Batcher.scala:128); at smoke
+    # load a half-full batch strands until the client's resend lands in a
+    # batcher with room, so resend briskly.
+    return bu.BuClient(listen, t, logger, config, resend_period=0.3,
+                       seed=seed)
 
 
 register(ProtocolSpec(

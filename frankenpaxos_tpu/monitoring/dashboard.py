@@ -30,17 +30,16 @@ def render_dashboard(
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    rate_metrics = [n for n in capture.names() if n.endswith("_requests_total")]
-    lat_counts = [
-        n for n in capture.names()
-        if n.endswith("_handler_latency_seconds_count")
-    ]
+    names = set(capture.names())
     panels = []
-    for name in rate_metrics:
-        panels.append(("rate", name))
-    for count_name in lat_counts:
+    for name in sorted(names):
+        if name.endswith("_requests_total"):
+            panels.append(("rate", name))
+    for count_name in sorted(names):
+        if not count_name.endswith("_handler_latency_seconds_count"):
+            continue
         base = count_name[: -len("_count")]
-        if f"{base}_sum" in capture.names():
+        if f"{base}_sum" in names:
             panels.append(("latency", base))
     if not panels:
         return None
