@@ -182,4 +182,15 @@ def analyze_benchmark_dir(
     plot_latency_and_throughput(df, output, drop_seconds=drop_seconds)
     summary = summarize(df, drop_seconds=drop_seconds)
     summary["plot"] = output
+    metrics_csv = os.path.join(bench_dir, "metrics.csv")
+    if os.path.exists(metrics_csv):
+        from frankenpaxos_tpu.monitoring.dashboard import render_dashboard
+        from frankenpaxos_tpu.monitoring.scrape import MetricsCapture
+
+        dash = render_dashboard(
+            MetricsCapture(metrics_csv),
+            os.path.join(bench_dir, "dashboard.png"),
+        )
+        if dash:
+            summary["dashboard"] = dash
     return summary
