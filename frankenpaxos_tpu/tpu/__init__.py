@@ -8,10 +8,14 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 ``lax.scan``, sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
 """
 
-from frankenpaxos_tpu.tpu import epaxos_batched
+from frankenpaxos_tpu.tpu import epaxos_batched, mencius_batched
 from frankenpaxos_tpu.tpu.epaxos_batched import (
     BatchedEPaxosConfig,
     BatchedEPaxosState,
+)
+from frankenpaxos_tpu.tpu.mencius_batched import (
+    BatchedMenciusConfig,
+    BatchedMenciusState,
 )
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     BatchedMultiPaxosConfig,
@@ -28,6 +32,8 @@ from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 __all__ = [
     "BatchedEPaxosConfig",
     "BatchedEPaxosState",
+    "BatchedMenciusConfig",
+    "BatchedMenciusState",
     "BatchedMultiPaxosConfig",
     "BatchedMultiPaxosState",
     "TpuSimTransport",
@@ -35,6 +41,7 @@ __all__ = [
     "epaxos_batched",
     "init_state",
     "leader_change",
+    "mencius_batched",
     "reconfigure",
     "run_ticks",
     "tick",

@@ -1,0 +1,348 @@
+"""Batched Mencius as a single XLA program (the reference's second
+headline protocol: compartmentalized Mencius, 803,881 cmd/s in
+BASELINE.md).
+
+Mencius stripes one GLOBAL log round-robin across ``L`` leaders: leader
+``l`` owns slots ``{q*L + l}`` (``mencius/``, ``vanillamencius/``). Three
+mechanisms distinguish it from the batched MultiPaxos model:
+
+  * **Heterogeneous load**: any leader may be idle in a tick (Bernoulli
+    ``idle_rate``), so stripes advance at different speeds.
+  * **Skips**: a leader that falls behind the fastest stripe by more
+    than ``skip_threshold`` noop-fills its owned slots up to the
+    broadcast high watermark (``MenciusHighWatermark`` /
+    ``Leader.scala`` skip logic) — modeled as noop proposals through the
+    normal quorum path.
+  * **Global execution watermark**: replicas execute the longest
+    contiguous GLOBAL prefix. With per-stripe contiguous commit prefixes
+    ``c_l`` (slots ``l, l+L, ..., l+(c_l-1)L``), the global prefix
+    length is ``min over l of (c_l * L + l)`` — a single min-reduction
+    across the leader axis (the cross-shard collective when leaders are
+    sharded over a device mesh; SURVEY §2.7 "log partitioning ->
+    static index maps; cut prefix-sums").
+
+Everything else (votes, quorums, ring windows, retry, PRNG bit-field
+sampling) reuses the batched MultiPaxos machinery's design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_delivered,
+    bit_latency,
+    ring_retire,
+)
+
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+
+NO_VALUE = -1
+NOOP_VALUE = -2  # a skip (Leader.scala noop range fill)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedMenciusConfig:
+    """Static simulation parameters. Each leader stripe has its own
+    2f+1-acceptor group (colocated deployment)."""
+
+    f: int = 1
+    num_leaders: int = 4  # L: stripes of the global log
+    window: int = 32  # W: in-flight owned slots per leader
+    slots_per_tick: int = 4  # K: proposals per ACTIVE leader per tick
+    idle_rate: float = 0.0  # P(a leader proposes nothing this tick)
+    # Leaders 0..num_idle_leaders-1 carry NO client load at all (an
+    # unloaded or partitioned stripe) — without skips they pin the
+    # global watermark at zero.
+    num_idle_leaders: int = 0
+    skip_threshold: int = 16  # lag (in owned slots) that triggers skips
+    lat_min: int = 1
+    lat_max: int = 3
+    drop_rate: float = 0.0
+    retry_timeout: int = 16
+    max_slots_per_leader: Optional[int] = None
+
+    @property
+    def group_size(self) -> int:
+        return 2 * self.f + 1
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.num_leaders >= 2
+        assert self.window >= 2 * self.slots_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.idle_rate < 1.0
+        assert 0 <= self.num_idle_leaders < self.num_leaders
+        assert self.skip_threshold >= 1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedMenciusState:
+    """Shapes: [L] leaders, [L, W] owned-slot rings, [L, W, A] votes."""
+
+    next_slot: jnp.ndarray  # [L] next OWNED slot ordinal (global = o*L + l)
+    head: jnp.ndarray  # [L] lowest non-retired owned ordinal
+
+    status: jnp.ndarray  # [L, W]
+    slot_value: jnp.ndarray  # [L, W] value id or NOOP_VALUE for skips
+    propose_tick: jnp.ndarray  # [L, W]
+    last_send: jnp.ndarray  # [L, W]
+    chosen_tick: jnp.ndarray  # [L, W]
+    replica_arrival: jnp.ndarray  # [L, W]
+    committed_prefix: jnp.ndarray  # [L] contiguous committed owned ordinals
+
+    p2a_arrival: jnp.ndarray  # [L, W, A]
+    p2b_arrival: jnp.ndarray  # [L, W, A]
+    voted: jnp.ndarray  # [L, W, A] bool
+
+    executed_global: jnp.ndarray  # [] global contiguous prefix length
+    committed: jnp.ndarray  # [] cumulative chosen slots (incl. skips)
+    skips: jnp.ndarray  # [] cumulative noop skip proposals
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
+    L, W, A = cfg.num_leaders, cfg.window, cfg.group_size
+    return BatchedMenciusState(
+        next_slot=jnp.zeros((L,), jnp.int32),
+        head=jnp.zeros((L,), jnp.int32),
+        status=jnp.zeros((L, W), jnp.int32),
+        slot_value=jnp.full((L, W), NO_VALUE, jnp.int32),
+        propose_tick=jnp.full((L, W), INF, jnp.int32),
+        last_send=jnp.full((L, W), INF, jnp.int32),
+        chosen_tick=jnp.full((L, W), INF, jnp.int32),
+        replica_arrival=jnp.full((L, W), INF, jnp.int32),
+        committed_prefix=jnp.zeros((L,), jnp.int32),
+        p2a_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        p2b_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        voted=jnp.zeros((L, W, A), bool),
+        executed_global=jnp.zeros((), jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        skips=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def tick(
+    cfg: BatchedMenciusConfig,
+    state: BatchedMenciusState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedMenciusState:
+    """One tick: acceptors vote, quorums form, the global prefix
+    advances, active leaders propose, lagging leaders skip-fill."""
+    L, W, A = cfg.num_leaders, cfg.window, cfg.group_size
+    f = cfg.f
+    k3, k2, k_extra = jax.random.split(key, 3)
+    bits3 = jax.random.bits(k3, (L, W, A))
+    bits2 = jax.random.bits(k2, (L, W))
+    bits1 = jax.random.bits(jax.random.fold_in(k_extra, 2), (L,))
+    p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p2a_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    retry_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
+    p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
+    if cfg.drop_rate > 0.0:
+        p2a_delivered = bit_delivered(
+            jax.random.bits(jax.random.fold_in(k_extra, 0), (L, W, A)),
+            0,
+            cfg.drop_rate,
+        )
+    else:
+        p2a_delivered = jnp.ones((L, W, A), bool)
+
+    status = state.status
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+
+    # ---- 1. Acceptors vote on Phase2a arrivals (no competing rounds in
+    # the steady-state Mencius write path: each leader owns its stripe).
+    arrived = state.p2a_arrival == t
+    voted = state.voted | arrived
+    p2b_arrival = jnp.where(
+        arrived & p2b_delivered,
+        jnp.minimum(state.p2b_arrival, t + p2b_lat),
+        state.p2b_arrival,
+    )
+
+    # ---- 2. Quorum counting (f+1 of the stripe's group).
+    nvotes = jnp.sum((p2b_arrival <= t) & voted, axis=2)
+    newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+    chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
+    replica_arrival = jnp.where(newly_chosen, t + rep_lat, state.replica_arrival)
+    status = jnp.where(newly_chosen, CHOSEN, status)
+
+    latency = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    committed = state.committed + jnp.sum(newly_chosen)
+    lat_sum = state.lat_sum + jnp.sum(latency)
+    bins = jnp.clip(latency, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 3. Per-stripe contiguous commit prefix, then the GLOBAL
+    # execution watermark: executed_global = min_l (c_l * L + l). Retire
+    # owned slots whose Chosen reached the replicas AND whose global slot
+    # is below the watermark.
+    slot_of_ord = state.head[:, None] + w_iota[None, :]
+    pos_of_ord = slot_of_ord % W
+    chosen_ord = (
+        (jnp.take_along_axis(status, pos_of_ord, axis=1) == CHOSEN)
+        & (slot_of_ord < state.next_slot[:, None])
+    )
+    # c_l: committed prefix in owned ordinals (head-based contiguity).
+    n_contig = jnp.sum(jnp.cumprod(chosen_ord.astype(jnp.int32), axis=1), axis=1)
+    committed_prefix = state.head + n_contig  # [L] owned ordinals
+    stripe_ids = jnp.arange(L, dtype=jnp.int32)
+    executed_global = jnp.min(committed_prefix * L + stripe_ids)
+
+    # Retire: chosen, replica-visible, and globally executable.
+    arrival_ord = jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1)
+    global_of_ord = slot_of_ord * L + stripe_ids[:, None]
+    retire_ord = (
+        chosen_ord & (arrival_ord <= t) & (global_of_ord < executed_global)
+    )
+    n_retire, retire_mask = ring_retire(retire_ord, state.head)
+    head = state.head + n_retire
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    slot_value = jnp.where(retire_mask, NO_VALUE, state.slot_value)
+    chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
+    last_send = jnp.where(retire_mask, INF, state.last_send)
+    p2a_arrival = jnp.where(retire_mask[:, :, None], INF, state.p2a_arrival)
+    p2b_arrival = jnp.where(retire_mask[:, :, None], INF, p2b_arrival)
+    voted = jnp.where(retire_mask[:, :, None], False, voted)
+
+    # ---- 4. Proposals. An idle leader proposes nothing; a LAGGING
+    # leader (more than skip_threshold owned slots behind the fastest
+    # stripe) noop-fills its backlog this tick (the high-watermark skip,
+    # Leader.scala _skip_to) — skips flow through the normal quorum path.
+    # Reuse the guarded 8-bit Bernoulli (a tiny nonzero idle_rate must
+    # not quantize to never-idle).
+    idle = ~bit_delivered(bits1, 0, cfg.idle_rate)
+    if cfg.num_idle_leaders:
+        idle = idle | (jnp.arange(L) < cfg.num_idle_leaders)
+    max_next = jnp.max(state.next_slot)
+    lag = max_next - state.next_slot  # [L] owned-slot lag
+    skipping = lag > cfg.skip_threshold
+
+    space = W - (state.next_slot - head)
+    want = jnp.where(
+        skipping,
+        jnp.minimum(lag, W),  # fill the backlog with noops
+        jnp.where(idle, 0, cfg.slots_per_tick),
+    )
+    count = jnp.minimum(want, space)
+    if cfg.max_slots_per_leader is not None:
+        count = jnp.minimum(
+            count, jnp.maximum(cfg.max_slots_per_leader - state.next_slot, 0)
+        )
+    delta = (w_iota[None, :] - state.next_slot[:, None]) % W
+    is_new = delta < count[:, None]
+    next_slot = state.next_slot + count
+    skips = state.skips + jnp.sum(jnp.where(skipping, count, 0))
+
+    new_ord = state.next_slot[:, None] + delta
+    new_value = jnp.where(
+        skipping[:, None],
+        NOOP_VALUE,
+        (new_ord * L + stripe_ids[:, None]) & jnp.int32(0x7FFFFFFF),
+    )
+    status = jnp.where(is_new, PROPOSED, status)
+    slot_value = jnp.where(is_new, new_value, slot_value)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    p2a_arrival = jnp.where(
+        is_new[:, :, None] & p2a_delivered, t + p2a_lat, p2a_arrival
+    )
+
+    # ---- 5. Retries.
+    timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
+    p2a_arrival = jnp.where(timed_out[:, :, None], t + retry_lat, p2a_arrival)
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return BatchedMenciusState(
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        slot_value=slot_value,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        chosen_tick=chosen_tick,
+        replica_arrival=replica_arrival,
+        committed_prefix=committed_prefix,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        voted=voted,
+        executed_global=jnp.maximum(state.executed_global, executed_global),
+        committed=committed,
+        skips=skips,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedMenciusConfig,
+    state: BatchedMenciusState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedMenciusState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedMenciusConfig, state: BatchedMenciusState, t
+) -> dict:
+    """Device-side safety checks; all booleans must be True."""
+    L = cfg.num_leaders
+    stripe_ids = jnp.arange(L, dtype=jnp.int32)
+    # The global watermark never exceeds the min-stripe formula.
+    watermark_ok = state.executed_global <= jnp.min(
+        state.committed_prefix * L + stripe_ids
+    )
+    # Window bookkeeping.
+    window_ok = jnp.all(
+        (state.head <= state.next_slot)
+        & (state.next_slot - state.head <= cfg.window)
+    )
+    # Chosen slots have a quorum of votes.
+    chosen = state.status == CHOSEN
+    quorum_ok = jnp.all(
+        jnp.where(
+            chosen,
+            jnp.sum(state.voted & (state.p2b_arrival <= t), axis=2)
+            >= cfg.f + 1,
+            True,
+        )
+    )
+    # Retired slots were globally executable: heads never pass the
+    # committed prefix.
+    head_ok = jnp.all(state.head <= state.committed_prefix)
+    return {
+        "watermark_ok": watermark_ok,
+        "window_ok": window_ok,
+        "quorum_ok": quorum_ok,
+        "head_ok": head_ok,
+    }

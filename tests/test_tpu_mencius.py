@@ -1,0 +1,96 @@
+"""Batched Mencius tests: invariants under load skew, the skip mechanism
+(a permanently slow leader must NOT stall the global log once skips kick
+in), and the global execution watermark formula."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.tpu.mencius_batched import (
+    NOOP_VALUE,
+    BatchedMenciusConfig,
+    check_invariants,
+    init_state,
+    run_ticks,
+)
+
+
+def run(cfg, ticks, seed=0):
+    state, t = run_ticks(
+        cfg, init_state(cfg), jnp.int32(0), ticks, jax.random.PRNGKey(seed)
+    )
+    jax.block_until_ready(state)
+    inv = {k: bool(v) for k, v in check_invariants(cfg, state, t).items()}
+    assert all(inv.values()), inv
+    return state
+
+
+def test_balanced_load_executes_globally():
+    cfg = BatchedMenciusConfig(
+        f=1, num_leaders=4, window=32, slots_per_tick=4,
+        lat_min=1, lat_max=2,
+    )
+    state = run(cfg, 100)
+    assert int(state.committed) > 1000
+    # Balanced stripes: the global prefix tracks total commits closely.
+    assert int(state.executed_global) > 800
+    assert int(state.skips) == 0  # nobody lags enough to skip
+
+
+def test_skew_triggers_skips_and_global_progress():
+    """idle_rate makes stripes advance unevenly; skips must fill the
+    slow stripes so the GLOBAL watermark keeps advancing."""
+    cfg = BatchedMenciusConfig(
+        f=1, num_leaders=4, window=64, slots_per_tick=4,
+        idle_rate=0.6, skip_threshold=8, lat_min=1, lat_max=2,
+    )
+    state = run(cfg, 200, seed=3)
+    assert int(state.skips) > 0, "no skips despite 60% idle ticks"
+    # The global log advances far beyond what the slowest unskipped
+    # stripe would allow.
+    assert int(state.executed_global) > 1000
+
+
+def test_no_skips_stalls_global_log():
+    """The control: a permanently unloaded stripe pins the global
+    watermark at ZERO when skips are disabled — the exact problem
+    Mencius's high-watermark skips exist to solve — and skips restore
+    full global progress."""
+    base = dict(
+        f=1, num_leaders=4, window=64, slots_per_tick=4,
+        num_idle_leaders=1, lat_min=1, lat_max=2,
+    )
+    without = run(
+        BatchedMenciusConfig(skip_threshold=10**6, **base), 200, seed=5
+    )
+    assert int(without.executed_global) == 0  # stripe 0 never commits
+    with_skips = run(
+        BatchedMenciusConfig(skip_threshold=8, **base), 200, seed=5
+    )
+    assert int(with_skips.executed_global) > 1000
+    assert int(with_skips.skips) > 0
+
+
+def test_global_watermark_formula():
+    """executed_global == min over stripes of (c_l * L + l)."""
+    cfg = BatchedMenciusConfig(
+        f=1, num_leaders=3, window=16, slots_per_tick=2,
+        idle_rate=0.3, skip_threshold=6, lat_min=1, lat_max=3,
+    )
+    state = run(cfg, 120, seed=7)
+    L = cfg.num_leaders
+    prefix = np.asarray(state.committed_prefix)
+    expect = min(int(prefix[l]) * L + l for l in range(L))
+    assert int(state.executed_global) == expect
+
+
+def test_closed_workload_drains():
+    cfg = BatchedMenciusConfig(
+        f=1, num_leaders=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=1, max_slots_per_leader=10,
+    )
+    state = run(cfg, 60)
+    # All 40 slots chosen and the whole global log executable.
+    assert int(state.committed) == 40
+    assert int(state.executed_global) == 40
