@@ -8,7 +8,11 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 ``lax.scan``, sharded over a ``jax.sharding.Mesh`` for multi-chip scale.
 """
 
-from frankenpaxos_tpu.tpu import epaxos_batched, mencius_batched
+from frankenpaxos_tpu.tpu import (
+    epaxos_batched,
+    mencius_batched,
+    scalog_batched,
+)
 from frankenpaxos_tpu.tpu.epaxos_batched import (
     BatchedEPaxosConfig,
     BatchedEPaxosState,
@@ -43,6 +47,7 @@ __all__ = [
     "leader_change",
     "mencius_batched",
     "reconfigure",
+    "scalog_batched",
     "run_ticks",
     "tick",
 ]

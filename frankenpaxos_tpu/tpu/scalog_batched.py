@@ -1,0 +1,293 @@
+"""Batched Scalog as a single XLA program.
+
+Scalog (``scalog/``) decouples ordering from replication: shard servers
+append client records to LOCAL logs and report their lengths; the
+aggregator assembles the per-shard length vector into a CUT; a Paxos
+layer orders cuts; and every replica projects committed cuts onto one
+global log. The projection is pure index arithmetic — exactly the
+"Scalog cuts -> cut prefix-sums" row of SURVEY §2.7:
+
+  * A committed cut ``c`` is a vector of per-shard log lengths
+    (monotone in every coordinate).
+  * The records of shard ``s`` between consecutive cuts ``c'`` and
+    ``c`` occupy global indices starting at
+    ``sum(c') + prefix_sum(c - c')[s]`` — one cumulative sum across the
+    shard axis per cut.
+
+The batched model advances all of it elementwise: shard log lengths
+grow stochastically per tick, the aggregator snapshots a cut every
+``cut_every`` ticks, cuts commit after a sampled Paxos round trip, and
+the global log length is the sum of the newest committed cut. Record
+latency (append -> globally ordered) is tracked per shard via the
+cut-lag histogram.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedScalogConfig:
+    """Static parameters: S shards, a cut pipeline of depth P."""
+
+    num_shards: int = 4
+    max_inflight_cuts: int = 8  # P: cut-ordering pipeline depth
+    cut_every: int = 2  # aggregator snapshot period (ticks)
+    appends_per_tick: int = 4  # mean records appended per shard per tick
+    append_jitter: int = 3  # uniform jitter on appends (load skew)
+    lat_min: int = 1  # one-way latency in ticks
+    lat_max: int = 3
+    max_records_per_shard: Optional[int] = None
+
+    def __post_init__(self):
+        assert self.num_shards >= 2
+        assert self.max_inflight_cuts >= 2
+        assert self.cut_every >= 1
+        assert self.appends_per_tick >= 1
+        assert 0 <= self.append_jitter <= self.appends_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedScalogState:
+    """Shapes: [S] shards, [P, S] in-flight cut ring."""
+
+    local_len: jnp.ndarray  # [S] records appended to each shard's log
+
+    cut_vec: jnp.ndarray  # [P, S] in-flight/committed cut vectors
+    cut_commit_tick: jnp.ndarray  # [P] when the cut commits (INF = empty)
+    cut_snap_tick: jnp.ndarray  # [P] when the cut was snapshotted
+    cut_prev_snap: jnp.ndarray  # [P] the PREVIOUS cut's snapshot tick
+    last_snap_tick: jnp.ndarray  # [] newest snapshot tick issued
+    next_cut: jnp.ndarray  # [] cuts issued so far
+    committed_cuts: jnp.ndarray  # [] cuts committed so far
+
+    global_len: jnp.ndarray  # [] committed global log length (sum of cut)
+    last_committed_cut: jnp.ndarray  # [S] the newest committed cut vector
+    lat_sum: jnp.ndarray  # [] sum of record ordering latencies (ticks)
+    lat_count: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedScalogConfig) -> BatchedScalogState:
+    S, P = cfg.num_shards, cfg.max_inflight_cuts
+    return BatchedScalogState(
+        local_len=jnp.zeros((S,), jnp.int32),
+        cut_vec=jnp.zeros((P, S), jnp.int32),
+        cut_commit_tick=jnp.full((P,), INF, jnp.int32),
+        cut_snap_tick=jnp.full((P,), INF, jnp.int32),
+        cut_prev_snap=jnp.zeros((P,), jnp.int32),
+        last_snap_tick=jnp.zeros((), jnp.int32),
+        next_cut=jnp.zeros((), jnp.int32),
+        committed_cuts=jnp.zeros((), jnp.int32),
+        global_len=jnp.zeros((), jnp.int32),
+        last_committed_cut=jnp.zeros((S,), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_count=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def global_indices_of_cut(
+    prev_cut: jnp.ndarray, cut: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The cut projection (Server.scala's cut -> global-log doc): for
+    each shard, the [start, end) global index range of its records
+    between ``prev_cut`` and ``cut`` — base ``sum(prev_cut)`` plus the
+    EXCLUSIVE prefix sum of the per-shard deltas."""
+    delta = cut - prev_cut
+    base = jnp.sum(prev_cut)
+    starts = base + jnp.cumsum(delta) - delta  # exclusive prefix sum
+    return starts, starts + delta
+
+
+def tick(
+    cfg: BatchedScalogConfig,
+    state: BatchedScalogState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedScalogState:
+    """One tick: shards append, the aggregator snapshots a cut on its
+    period, in-flight cuts commit after their Paxos round trip, and the
+    global log extends to the newest committed cut."""
+    S, P = cfg.num_shards, cfg.max_inflight_cuts
+    bits = jax.random.bits(key, (S,))
+
+    # ---- 1. Shards append records (stochastic load skew).
+    appends = cfg.appends_per_tick - cfg.append_jitter + bit_latency(
+        bits, 0, 0, 2 * cfg.append_jitter
+    ) if cfg.append_jitter else jnp.full((S,), cfg.appends_per_tick, jnp.int32)
+    if cfg.max_records_per_shard is not None:
+        appends = jnp.minimum(
+            appends,
+            jnp.maximum(cfg.max_records_per_shard - state.local_len, 0),
+        )
+    local_len = state.local_len + appends
+
+    # ---- 2. Cuts commit: any in-flight cut whose Paxos decision has
+    # landed. Commit ORDER is cut-issue order (the Paxos log of cuts),
+    # so a cut only commits once all earlier cuts have; model: a cut's
+    # effective commit tick is the max over itself and predecessors
+    # (cumulative max over the ring in issue order).
+    # Live cut ids are [committed_cuts, next_cut); the slot of cut k is
+    # k % P. Walk them in ascending id order: a cut's EFFECTIVE commit
+    # tick is the running max of its own and every predecessor's
+    # (associative cumulative max — the Paxos log of cuts commits in
+    # order).
+    ids_asc = state.committed_cuts + jnp.arange(P, dtype=jnp.int32)
+    live = ids_asc < state.next_cut
+    slots_asc = ids_asc % P
+    ticks_asc = jnp.where(live, state.cut_commit_tick[slots_asc], INF)
+    eff_asc = jax.lax.associative_scan(jnp.maximum, ticks_asc)
+    committed_now_asc = live & (eff_asc <= t)
+    n_new_commits = jnp.sum(committed_now_asc.astype(jnp.int32))
+    committed_cuts = state.committed_cuts + n_new_commits
+
+    # Newest committed cut vector (if any committed this tick).
+    any_commit = n_new_commits > 0
+    newest_idx = jnp.clip(n_new_commits - 1, 0, P - 1)
+    newest_slot = slots_asc[newest_idx]
+    new_cut = jnp.where(
+        any_commit, state.cut_vec[newest_slot], state.last_committed_cut
+    )
+    global_len = jnp.sum(new_cut)
+
+    # Record-ordering latency, PER CUT: each committing cut's records
+    # (its vector minus its predecessor's) waited from that cut's own
+    # snapshot — attributing everything to the newest cut would hide
+    # exactly the head-of-line blocking the cumulative-max models.
+    vec_asc = state.cut_vec[slots_asc]  # [P, S] in issue order
+    prev_vec_asc = jnp.concatenate(
+        [state.last_committed_cut[None, :], vec_asc[:-1]], axis=0
+    )
+    recs_asc = jnp.where(
+        committed_now_asc, jnp.sum(vec_asc - prev_vec_asc, axis=1), 0
+    )
+    # A record's append->ordered latency = wait for its cut's snapshot
+    # (uniform over the snapshot interval: half of it in expectation)
+    # + the cut's snapshot->commit lag.
+    snap_wait_asc = (
+        state.cut_snap_tick[slots_asc] - state.cut_prev_snap[slots_asc] + 1
+    ) // 2
+    lag_asc = jnp.where(
+        committed_now_asc,
+        (t - state.cut_snap_tick[slots_asc]) + snap_wait_asc,
+        0,
+    )
+    lat_sum = state.lat_sum + jnp.sum(lag_asc * recs_asc)
+    lat_count = state.lat_count + jnp.sum(recs_asc)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        recs_asc, jnp.clip(lag_asc, 0, LAT_BINS - 1), LAT_BINS
+    )
+
+    # Free committed slots.
+    slot_committed = jnp.zeros((P,), bool)
+    slot_committed = slot_committed.at[slots_asc].set(committed_now_asc)
+    cut_commit_tick = jnp.where(slot_committed, INF, state.cut_commit_tick)
+    cut_snap_tick = jnp.where(slot_committed, INF, state.cut_snap_tick)
+
+    # ---- 3. Aggregator snapshots a new cut on its period, if the
+    # pipeline has room (ShardInfo -> proposed cut -> Paxos; commit after
+    # 2 one-way hops to the ordering layer and back plus jitter). Shard
+    # length reports piggyback every tick, so the snapshot sees the
+    # current local lengths.
+    room = (state.next_cut - committed_cuts) < P
+    due = (t % cfg.cut_every) == 0
+    issue = room & due
+    slot = state.next_cut % P
+    paxos_lat = bit_latency(jax.random.bits(jax.random.fold_in(key, 1), ()), 0,
+                            2 * cfg.lat_min, 2 * cfg.lat_max + 2)
+    cut_vec = jnp.where(
+        issue,
+        state.cut_vec.at[slot].set(local_len),
+        state.cut_vec,
+    )
+    cut_commit_tick = jnp.where(
+        issue, cut_commit_tick.at[slot].set(t + paxos_lat), cut_commit_tick
+    )
+    cut_snap_tick = jnp.where(
+        issue, cut_snap_tick.at[slot].set(t), cut_snap_tick
+    )
+    cut_prev_snap = jnp.where(
+        issue,
+        state.cut_prev_snap.at[slot].set(state.last_snap_tick),
+        state.cut_prev_snap,
+    )
+    last_snap_tick = jnp.where(issue, t, state.last_snap_tick)
+    next_cut = state.next_cut + jnp.where(issue, 1, 0)
+
+    return BatchedScalogState(
+        local_len=local_len,
+        cut_vec=cut_vec,
+        cut_commit_tick=cut_commit_tick,
+        cut_snap_tick=cut_snap_tick,
+        cut_prev_snap=cut_prev_snap,
+        last_snap_tick=last_snap_tick,
+        next_cut=next_cut,
+        committed_cuts=committed_cuts,
+        global_len=global_len,
+        last_committed_cut=new_cut,
+        lat_sum=lat_sum,
+        lat_count=lat_count,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedScalogConfig,
+    state: BatchedScalogState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedScalogState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedScalogConfig, state: BatchedScalogState, t
+) -> dict:
+    """Device-side safety checks; all booleans must be True."""
+    # The committed cut never exceeds what shards actually appended, and
+    # the global log is exactly its sum.
+    cut_le_local = jnp.all(state.last_committed_cut <= state.local_len)
+    global_is_sum = state.global_len == jnp.sum(state.last_committed_cut)
+    # Cut pipeline bookkeeping.
+    pipeline_ok = (
+        (state.committed_cuts <= state.next_cut)
+        & (state.next_cut - state.committed_cuts <= cfg.max_inflight_cuts)
+    )
+    # Cut monotonicity: every live in-flight cut was snapshotted after
+    # the newest committed one, so its vector dominates it coordinate-
+    # wise; a newest-slot indexing regression would break this.
+    P = cfg.max_inflight_cuts
+    ids = state.committed_cuts + jnp.arange(P, dtype=jnp.int32)
+    live = ids < state.next_cut
+    monotone = jnp.all(
+        jnp.where(
+            live[:, None],
+            state.cut_vec[ids % P] >= state.last_committed_cut[None, :],
+            True,
+        )
+    )
+    return {
+        "cut_le_local": cut_le_local,
+        "global_is_sum": global_is_sum,
+        "pipeline_ok": pipeline_ok,
+        "monotone": monotone,
+    }
