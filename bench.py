@@ -37,6 +37,7 @@ _PROBE_CODE = (
 
 def _inner_main() -> None:
     """The actual measurement; runs in a subprocess with jax importable."""
+    import dataclasses
     import time
 
     import jax
@@ -92,6 +93,31 @@ def _inner_main() -> None:
         "ticks_per_sec": round(ticks / elapsed, 1),
         "wall_seconds": round(elapsed, 3),
         "device": str(jax.devices()[0]),
+    }
+
+    # Secondary: the same cluster serving linearizable quorum reads
+    # alongside writes (the flagship Evelyn read path; Client.scala:
+    # 1053-1069). Reported inside the same JSON line.
+    rcfg = dataclasses.replace(
+        cfg, reads_per_tick=8, read_window=64, read_mode="linearizable"
+    )
+    rsim = TpuSimTransport(rcfg, seed=0)
+    rsim.run(ticks_per_segment)
+    rsim.block_until_ready()
+    rc0, rr0 = rsim.committed(), int(rsim.state.reads_done)
+    r_start = time.perf_counter()
+    rsim.run(ticks_per_segment)
+    rsim.block_until_ready()
+    r_elapsed = time.perf_counter() - r_start
+    rstats = rsim.stats()
+    result["read_variant"] = {
+        "mode": "linearizable",
+        "committed_per_sec": round((rsim.committed() - rc0) / r_elapsed, 1),
+        "reads_per_sec": round(
+            (int(rsim.state.reads_done) - rr0) / r_elapsed, 1
+        ),
+        "read_latency_p50_ticks": rstats["read_latency_p50_ticks"],
+        "invariants_ok": all(rsim.check_invariants().values()),
     }
     print("BENCH_JSON " + json.dumps(result))
 

@@ -124,8 +124,30 @@ def fused_vote_quorum(
     from jax.experimental import pallas as pl
 
     A, G, W = p2a_arrival.shape
-    bg = min(block_g, G)
-    assert G % bg == 0, f"num_groups {G} must divide into blocks of {bg}"
+    # Balanced blocks: bg = ceil(G / nblocks) for the smallest nblocks
+    # with bg <= block_g, so padding waste is bounded by one block's
+    # remainder (min(block_g, G) would pad G=257 up to 512).
+    nblocks = -(-G // block_g)
+    bg = -(-G // nblocks)
+    # Pad the group axis up to a block multiple; padded groups compute
+    # garbage that is sliced off (no cross-group dataflow exists).
+    pad = (-G) % bg
+    if pad:
+        def pad_g(x, axis):
+            widths = [(0, 0)] * x.ndim
+            widths[axis] = (0, pad)
+            return jnp.pad(x, widths)
+
+        p2a_arrival = pad_g(p2a_arrival, 1)
+        acc_round = pad_g(acc_round, 1)
+        leader_round = pad_g(leader_round, 0)
+        slot_value = pad_g(slot_value, 0)
+        vote_round = pad_g(vote_round, 1)
+        vote_value = pad_g(vote_value, 1)
+        p2b_arrival = pad_g(p2b_arrival, 1)
+        p2b_lat = pad_g(p2b_lat, 1)
+        p2b_delivered = pad_g(p2b_delivered, 1)
+    Gp = G + pad
 
     from jax.experimental.pallas import tpu as pltpu
 
@@ -139,7 +161,7 @@ def fused_vote_quorum(
     # accepts the same spec.
     t_space = None if interpret else pltpu.SMEM
     grid_spec = pl.GridSpec(
-        grid=(G // bg,),
+        grid=(Gp // bg,),
         in_specs=[
             pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space),  # t
             spec3,  # p2a
@@ -155,13 +177,13 @@ def fused_vote_quorum(
         out_specs=[spec3, spec3, spec3, spec2, spec_gw],
     )
     out_shape = [
-        jax.ShapeDtypeStruct((A, G, W), jnp.int32),  # vote_round
-        jax.ShapeDtypeStruct((A, G, W), jnp.int32),  # vote_value
-        jax.ShapeDtypeStruct((A, G, W), jnp.int32),  # p2b_arrival
-        jax.ShapeDtypeStruct((A, G), jnp.int32),  # acc_round
-        jax.ShapeDtypeStruct((G, W), jnp.int32),  # nvotes
+        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # vote_round
+        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # vote_value
+        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # p2b_arrival
+        jax.ShapeDtypeStruct((A, Gp), jnp.int32),  # acc_round
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nvotes
     ]
-    return pl.pallas_call(
+    vr, vv, p2b, accr, nv = pl.pallas_call(
         _vote_quorum_kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -178,3 +200,7 @@ def fused_vote_quorum(
         p2b_lat.astype(jnp.int32),
         p2b_delivered.astype(jnp.int8),
     )
+    if pad:
+        vr, vv, p2b = vr[:, :G], vv[:, :G], p2b[:, :G]
+        accr, nv = accr[:, :G], nv[:G]
+    return vr, vv, p2b, accr, nv

@@ -38,9 +38,27 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
     scalars and the latency histogram replicate."""
 
     def spec_for(leaf_name: str):
-        scalar_or_global = {"committed", "retired", "lat_sum", "lat_hist"}
+        # Scalars, stats, and the GLOBAL read ring ([RW]-shaped: reads fan
+        # out to every group, so their per-read state replicates; the
+        # per-acceptor request/response arrays below still shard).
+        scalar_or_global = {
+            "committed", "retired", "lat_sum", "lat_hist",
+            "max_chosen_global", "client_watermark", "read_status",
+            "read_issue", "read_target", "read_floor", "reply_arrival",
+            "reads_done", "read_lat_sum", "read_lat_hist",
+            "read_lin_violations",
+        }
+        # Acceptor-major arrays ([A, G, W] / [A, G] / [A, G, RW]) carry
+        # the group axis second; everything else ([G, W] / [G]) first.
+        acceptor_major = {
+            "acc_round", "p2a_arrival", "p2b_arrival", "vote_round",
+            "vote_value", "acc_max_slot", "req_arrival", "resp_slot",
+            "resp_arrival",
+        }
         if leaf_name in scalar_or_global:
             return NamedSharding(mesh, P())
+        if leaf_name in acceptor_major:
+            return NamedSharding(mesh, P(None, GROUP_AXIS))
         return NamedSharding(mesh, P(GROUP_AXIS))
 
     import dataclasses as _dc
@@ -81,11 +99,17 @@ def _run_ticks_sharded(
     num_ticks: int,
     key: jnp.ndarray,
 ):
-    # The tick is elementwise over groups; with the G axis sharded, XLA
-    # partitions the whole scan with no communication except the scalar
-    # stat reductions (psum over ICI). We rely on GSPMD propagation from
-    # the input shardings rather than hand-writing shard_map: the program
-    # has no cross-group contractions, so propagation is exact.
+    # The write path is elementwise over groups; with the G axis sharded,
+    # XLA partitions the whole scan and the only cross-device traffic is
+    # scalar/ring-stat reductions (psum over ICI): commit stats, and —
+    # when reads are enabled — the read path's global reductions (the
+    # executed-watermark min over G, the bind max over (A, G), and the
+    # chosen-floor max), all of which land on the replicated [RW]/scalar
+    # read arrays. We rely on GSPMD propagation from the input shardings
+    # rather than hand-writing shard_map: every contraction either stays
+    # within a group or reduces to a replicated scalar/ring, so
+    # propagation is exact (test_reads_sharded_matches_unsharded pins
+    # bit-identity).
     return run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
 
 

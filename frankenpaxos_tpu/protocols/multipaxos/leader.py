@@ -361,8 +361,12 @@ class Leader(Actor):
         if self.state == _INACTIVE:
             self.round = msg.round
         else:
-            # Fast-forward to the nacked round; leader_change performs the
-            # single next_classic_round bump (Leader.scala:672-697 applies
-            # it once via leaderChange(nack.round)).
+            # Fast-forward to the nacked round, then let leader_change
+            # apply one next_classic_round bump. Deliberate deviation: the
+            # reference advances TWICE (Leader.scala:676-697 handleNack
+            # computes nextClassicRound from nack.round AND leaderChange
+            # bumps again from that), landing one classic round higher.
+            # One bump already guarantees round > nack.round and
+            # self-ownership; the second only burns round space faster.
             self.round = msg.round
             self.leader_change(is_new_leader=True)

@@ -61,6 +61,61 @@ def bit_delivered(
     return field >= threshold
 
 
+def sample_quorum(
+    bits: jnp.ndarray,  # [A, ...] uint32 (f==1 reads only row 0)
+    shift: int,
+    f: int,
+    group_size: int,
+) -> jnp.ndarray:
+    """Uniform random (f+1)-of-(2f+1) member selection over the leading
+    acceptor axis, from bit fields of a shared random sweep (the batched
+    ThriftySystem.Random / randomReadQuorum, ThriftySystem.scala /
+    QuorumSystem.scala:16-24).
+
+    f == 1: f+1 of 3 = all but one — exclude one uniform member using the
+    8-bit field of row 0 (``bits`` may then have a size-1 leading axis).
+    General f: rank 16-bit score fields with the acceptor index mixed into
+    the low bits, so score ties break deterministically and the quorum is
+    exactly f+1 — never more.
+    """
+    A = group_size
+    a_iota = jnp.arange(A, dtype=jnp.int32).reshape(
+        (A,) + (1,) * (bits.ndim - 1)
+    )
+    if f == 1:
+        excl = (
+            ((bits[0] >> shift) & jnp.uint32(0xFF)).astype(jnp.int32) % A
+        )
+        return a_iota != excl[None]
+    assert bits.shape[0] == A
+    assert A <= 32, "quorum ranking packs the acceptor index in 5 bits"
+    scores = ((bits >> shift) & jnp.uint32(0xFFFF)) << 5 | a_iota.astype(
+        jnp.uint32
+    )
+    kth = jnp.sort(scores, axis=0)[f : f + 1]  # (f+1)-th smallest
+    return scores <= kth
+
+
+def ring_retire_pos(
+    executable: jnp.ndarray,  # [G, W] bool, in RING-POSITION space
+    ord_of_pos: jnp.ndarray,  # [G, W] ordinal of each position from head
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Retire the contiguous executable run starting at the ring head,
+    computed entirely in position space: the run length is the minimum
+    ordinal among non-executable positions (W if all are executable) — a
+    masked min-reduction instead of a gather + prefix scan. The batched
+    form of the replica's contiguous prefix execution
+    (Replica.scala:394-453).
+
+    Returns ``(n_retire [G], retire_mask [G, W])``.
+    """
+    W = executable.shape[-1]
+    blocked = jnp.where(executable, W, ord_of_pos)
+    n_retire = jnp.min(blocked, axis=-1)
+    retire_mask = ord_of_pos < n_retire[..., None]
+    return n_retire, retire_mask
+
+
 def ring_retire(
     retire_ord: jnp.ndarray,  # [G, W] bool, in absolute order from head
     head: jnp.ndarray,  # [G]

@@ -107,6 +107,7 @@ class BatchedMenciusState:
 
     executed_global: jnp.ndarray  # [] global contiguous prefix length
     committed: jnp.ndarray  # [] cumulative chosen slots (incl. skips)
+    committed_real: jnp.ndarray  # [] cumulative chosen REAL commands
     skips: jnp.ndarray  # [] cumulative noop skip proposals
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
@@ -129,6 +130,7 @@ def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
         voted=jnp.zeros((L, W, A), bool),
         executed_global=jnp.zeros((), jnp.int32),
         committed=jnp.zeros((), jnp.int32),
+        committed_real=jnp.zeros((), jnp.int32),
         skips=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
@@ -183,12 +185,21 @@ def tick(
     replica_arrival = jnp.where(newly_chosen, t + rep_lat, state.replica_arrival)
     status = jnp.where(newly_chosen, CHOSEN, status)
 
-    latency = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    # Latency/throughput stats count REAL commands only: noop skip fills
+    # flow through the same quorum path (they are chosen slots), but they
+    # carry no client command, so mixing them in would inflate the
+    # headline committed rate and dilute the latency distribution on
+    # idle-skewed runs. ``committed`` counts all chosen slots (incl.
+    # skips, tracked separately in ``skips``); ``committed_real`` and the
+    # histogram cover commands only.
+    real_chosen = newly_chosen & (state.slot_value != NOOP_VALUE)
+    latency = jnp.where(real_chosen, t - state.propose_tick, 0)
     committed = state.committed + jnp.sum(newly_chosen)
+    committed_real = state.committed_real + jnp.sum(real_chosen)
     lat_sum = state.lat_sum + jnp.sum(latency)
     bins = jnp.clip(latency, 0, LAT_BINS - 1)
     lat_hist = state.lat_hist + jax.ops.segment_sum(
-        newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+        real_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
     )
 
     # ---- 3. Per-stripe contiguous commit prefix, then the GLOBAL
@@ -289,6 +300,7 @@ def tick(
         voted=voted,
         executed_global=jnp.maximum(state.executed_global, executed_global),
         committed=committed,
+        committed_real=committed_real,
         skips=skips,
         lat_sum=lat_sum,
         lat_hist=lat_hist,

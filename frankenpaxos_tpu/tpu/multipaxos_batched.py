@@ -21,7 +21,18 @@ point-to-point messages, the whole cluster is struct-of-arrays state:
     the acceptor axis; thrifty quorum choice is a top-(f+1) selection of
     PRNG scores; ballot checks compare per-acceptor round arrays.
   * Replica execution (Replica.executeLog's contiguous-prefix hot loop)
-    is a cumulative-product prefix scan over the ring.
+    is a masked min-reduction over the ring (no gather, no prefix scan).
+
+Array layout is ACCEPTOR-MAJOR: per-acceptor-per-slot arrays are
+``[A, G, W]`` (and per-acceptor arrays ``[A, G]``), NOT ``[G, W, A]``.
+XLA tiles the two minor-most dims of an int32 array to (8, 128) sublanes ×
+lanes on TPU; a minor acceptor axis of size ``A = 2f+1 = 3`` would be
+padded 3 → 128 — a ~42× physical-memory and HBM-bandwidth blowup on the
+four largest state arrays. Acceptor-major puts (G, W) minor, which tiles
+densely, and makes the acceptor axis a tiny static leading loop — exactly
+the layout :func:`frankenpaxos_tpu.ops.fused_vote_quorum` (the Pallas
+fused kernel for tick steps 1-2, enabled by ``use_pallas``) wants, so the
+kernel boundary needs no transposes.
 
 One ``tick`` is a pure function ``(state, t, key) -> state`` compiled once;
 ``run_ticks`` wraps it in ``lax.scan``. Multi-seed property testing = vmap
@@ -43,8 +54,9 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_delivered,
     bit_latency,
-    ring_retire,
+    ring_retire_pos,
     sample_latency,
+    sample_quorum,
 )
 
 # Slot status codes.
@@ -58,6 +70,14 @@ CHOSEN = 2
 # voted); NO_VALUE marks unset.
 NO_VALUE = -1
 NOOP_VALUE = -2
+
+# Read op status codes (the read ring; see the "Reads" section of tick).
+R_EMPTY = 0
+R_WAIT = 1  # linearizable: MaxSlotRequest quorum outstanding
+R_BOUND = 2  # target slot known; waiting for the executed watermark
+R_SENT = 3  # watermark passed; reply in flight to the client
+
+READ_MODES = ("linearizable", "sequential", "eventual")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +96,23 @@ class BatchedMultiPaxosConfig:
     # Closed workload: stop proposing once each group has allocated this
     # many slots (None = open workload, propose forever).
     max_slots_per_group: Optional[int] = None
+    # Route tick steps 1-2 (acceptor votes + quorum count) through the
+    # fused Pallas kernel (ops.fused_vote_quorum). On non-TPU backends the
+    # kernel runs in interpret mode (slow but bit-identical), keeping CPU
+    # tests meaningful.
+    use_pallas: bool = False
+    pallas_block_g: int = 256  # group-axis block per kernel invocation
+    # The read path ("Evelyn Paxos", Client.scala:1053-1069 /
+    # Acceptor.scala:222-237 / Replica.scala:455-529): reads_per_tick
+    # GLOBAL read ops are issued per tick into a ring of read_window
+    # outstanding reads. Modes: "linearizable" (MaxSlotRequest to a
+    # random f+1 read quorum of EVERY group, bind to the max global voted
+    # slot, wait for the global executed watermark), "sequential" (bind
+    # to the client's largest-seen slot, Client.scala:300-305), and
+    # "eventual" (execute immediately, Replica.scala:645-654).
+    reads_per_tick: int = 0
+    read_window: int = 0  # outstanding-read ring size (0 = reads off)
+    read_mode: str = "linearizable"
 
     @property
     def group_size(self) -> int:
@@ -90,13 +127,19 @@ class BatchedMultiPaxosConfig:
         assert self.window >= 2 * self.slots_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
+        assert self.read_mode in READ_MODES
+        if self.reads_per_tick:
+            assert self.read_window >= 2 * self.reads_per_tick, (
+                "read_window must leave room for in-flight reads"
+            )
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class BatchedMultiPaxosState:
-    """Struct-of-arrays cluster state. Shapes: [G] groups, [G, W] ring
-    slots, [G, W, A] per-acceptor votes, [G, A] acceptors."""
+    """Struct-of-arrays cluster state, acceptor-major (module docstring).
+    Shapes: [G] groups, [G, W] ring slots, [A, G, W] per-acceptor votes,
+    [A, G] acceptors."""
 
     # Leader / proposer.
     leader_round: jnp.ndarray  # [G] current round (shared leader, per group)
@@ -114,11 +157,11 @@ class BatchedMultiPaxosState:
     replica_arrival: jnp.ndarray  # [G, W] tick Chosen reaches replicas
 
     # Acceptors.
-    acc_round: jnp.ndarray  # [G, A] per-acceptor promised round
-    p2a_arrival: jnp.ndarray  # [G, W, A] Phase2a arrival tick (INF = never)
-    p2b_arrival: jnp.ndarray  # [G, W, A] Phase2b arrival tick at counter
-    vote_round: jnp.ndarray  # [G, W, A] round of the vote (-1 = none)
-    vote_value: jnp.ndarray  # [G, W, A] value of the vote (NO_VALUE = none)
+    acc_round: jnp.ndarray  # [A, G] per-acceptor promised round
+    p2a_arrival: jnp.ndarray  # [A, G, W] Phase2a arrival tick (INF = never)
+    p2b_arrival: jnp.ndarray  # [A, G, W] Phase2b arrival tick at counter
+    vote_round: jnp.ndarray  # [A, G, W] round of the vote (-1 = none)
+    vote_value: jnp.ndarray  # [A, G, W] value of the vote (NO_VALUE = none)
 
     # Execution / stats.
     executed: jnp.ndarray  # [G] per-group retired (executed) slot count
@@ -127,9 +170,28 @@ class BatchedMultiPaxosState:
     lat_sum: jnp.ndarray  # [] sum of commit latencies (ticks)
     lat_hist: jnp.ndarray  # [LAT_BINS] commit latency histogram
 
+    # Read path (all zero-sized when cfg.read_window == 0). RW = ring of
+    # outstanding GLOBAL read ops; global slot numbering is s*G + g.
+    acc_max_slot: jnp.ndarray  # [A, G] max per-group slot this acceptor voted
+    max_chosen_global: jnp.ndarray  # [] max global slot ever chosen (-1)
+    client_watermark: jnp.ndarray  # [] client's largest-seen global slot (-1)
+    read_status: jnp.ndarray  # [RW] R_EMPTY | R_WAIT | R_BOUND | R_SENT
+    read_issue: jnp.ndarray  # [RW] issue tick
+    read_target: jnp.ndarray  # [RW] bound global slot (-1 = none yet)
+    read_floor: jnp.ndarray  # [RW] max_chosen_global at issue (lin check)
+    req_arrival: jnp.ndarray  # [A, G, RW] MaxSlotRequest arrival (INF)
+    resp_slot: jnp.ndarray  # [A, G, RW] MaxSlotReply payload (global, -1)
+    resp_arrival: jnp.ndarray  # [A, G, RW] MaxSlotReply arrival (INF)
+    reply_arrival: jnp.ndarray  # [RW] final read-reply arrival (INF)
+    reads_done: jnp.ndarray  # [] completed reads (cumulative)
+    read_lat_sum: jnp.ndarray  # [] sum of read latencies (ticks)
+    read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
+    read_lin_violations: jnp.ndarray  # [] reads bound below their floor
+
 
 def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
+    RW = cfg.read_window
     return BatchedMultiPaxosState(
         leader_round=jnp.zeros((G,), jnp.int32),
         next_slot=jnp.zeros((G,), jnp.int32),
@@ -142,16 +204,31 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         chosen_round=jnp.full((G, W), -1, jnp.int32),
         chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         replica_arrival=jnp.full((G, W), INF, jnp.int32),
-        acc_round=jnp.zeros((G, A), jnp.int32),
-        p2a_arrival=jnp.full((G, W, A), INF, jnp.int32),
-        p2b_arrival=jnp.full((G, W, A), INF, jnp.int32),
-        vote_round=jnp.full((G, W, A), -1, jnp.int32),
-        vote_value=jnp.full((G, W, A), NO_VALUE, jnp.int32),
+        acc_round=jnp.zeros((A, G), jnp.int32),
+        p2a_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        p2b_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        vote_round=jnp.full((A, G, W), -1, jnp.int32),
+        vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
         executed=jnp.zeros((G,), jnp.int32),
         committed=jnp.zeros((), jnp.int32),
         retired=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        acc_max_slot=jnp.full((A, G), -1, jnp.int32),
+        max_chosen_global=jnp.full((), -1, jnp.int32),
+        client_watermark=jnp.full((), -1, jnp.int32),
+        read_status=jnp.zeros((RW,), jnp.int32),
+        read_issue=jnp.full((RW,), INF, jnp.int32),
+        read_target=jnp.full((RW,), -1, jnp.int32),
+        read_floor=jnp.full((RW,), -1, jnp.int32),
+        req_arrival=jnp.full((A, G, RW), INF, jnp.int32),
+        resp_slot=jnp.full((A, G, RW), -1, jnp.int32),
+        resp_arrival=jnp.full((A, G, RW), INF, jnp.int32),
+        reply_arrival=jnp.full((RW,), INF, jnp.int32),
+        reads_done=jnp.zeros((), jnp.int32),
+        read_lat_sum=jnp.zeros((), jnp.int32),
+        read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        read_lin_violations=jnp.zeros((), jnp.int32),
     )
 
 
@@ -169,8 +246,8 @@ def tick(
     # One random-bits sweep per shape feeds every sample via disjoint bit
     # fields (see common.bit_latency) — drawing separate randint/uniform
     # arrays per message kind made PRNG generation dominate the tick.
-    k3, k2, k_extra = jax.random.split(key, 3)
-    bits3 = jax.random.bits(k3, (G, W, A))  # [0:8) p2b lat, [8:16) p2a lat,
+    k3, k2, k_extra, k_read = jax.random.split(key, 4)
+    bits3 = jax.random.bits(k3, (A, G, W))  # [0:8) p2b lat, [8:16) p2a lat,
     #                                         [16:24) retry lat, [24:32) p2b drop
     bits2 = jax.random.bits(k2, (G, W))  # [0:8) replica lat, [8:16) thrifty
     p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
@@ -183,43 +260,71 @@ def tick(
     # scores [8:32) — disjoint fields, one generation.
     need_extra = cfg.drop_rate > 0.0 or (cfg.thrifty and cfg.f > 1)
     bits_extra = (
-        jax.random.bits(k_extra, (G, W, A))
+        jax.random.bits(k_extra, (A, G, W))
         if need_extra
-        else jnp.zeros((G, W, A), jnp.uint32)
+        else jnp.zeros((A, G, W), jnp.uint32)
     )
     p2a_delivered = bit_delivered(bits_extra, 0, cfg.drop_rate)
 
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
 
-    # ---- 1. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
+    # ---- 1+2. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
     # Acceptor.scala:184-220): vote iff the message round >= promised round;
-    # on vote, promise the round and schedule the Phase2b arrival.
-    arrived = state.p2a_arrival == t  # [G, W, A]
-    msg_round = state.leader_round[:, None, None]  # one leader round in flight
-    may_vote = arrived & (msg_round >= state.acc_round[:, None, :])
-    new_acc_round = jnp.maximum(
-        state.acc_round, jnp.max(jnp.where(may_vote, msg_round, -1), axis=1)
-    )
-    vote_round = jnp.where(may_vote, msg_round, state.vote_round)
-    # The vote carries the slot's currently proposed value
-    # (Acceptor.scala:184-220 votes for the Phase2a's value).
-    vote_value = jnp.where(
-        may_vote, state.slot_value[:, :, None], state.vote_value
-    )
-    p2b_arrival = jnp.where(
-        may_vote & p2b_delivered,
-        jnp.minimum(state.p2b_arrival, t + p2b_lat),
-        state.p2b_arrival,
-    )
+    # on vote, promise the round and schedule the Phase2b arrival. Then
+    # quorum counting (ProxyLeader.handlePhase2b, ProxyLeader.scala:217-258):
+    # a slot is chosen when f+1 Phase2bs for the current round have arrived
+    # — a sum over the acceptor axis.
+    if cfg.use_pallas:
+        # One fused VMEM-resident pass: every [A, G, W] array is read from
+        # HBM exactly once for the whole vote + quorum-count phase.
+        from frankenpaxos_tpu import ops
 
-    # ---- 2. Quorum counting (ProxyLeader.handlePhase2b,
-    # ProxyLeader.scala:217-258): a slot is chosen when f+1 Phase2bs for the
-    # current round have arrived. Sum over the acceptor axis.
-    votes_in = (p2b_arrival <= t) & (
-        vote_round == state.leader_round[:, None, None]
-    )
-    nvotes = jnp.sum(votes_in, axis=2)  # [G, W]
+        (
+            vote_round,
+            vote_value,
+            p2b_arrival,
+            new_acc_round,
+            nvotes,
+        ) = ops.fused_vote_quorum(
+            state.p2a_arrival,
+            state.acc_round,
+            state.leader_round,
+            state.slot_value,
+            state.vote_round,
+            state.vote_value,
+            state.p2b_arrival,
+            p2b_lat,
+            p2b_delivered,
+            t,
+            block_g=cfg.pallas_block_g,
+            # Compile for real TPU backends ("tpu", or "axon" on tunneled
+            # v5e pods); interpret everywhere else (CPU CI, GPU).
+            interpret=jax.default_backend() not in ("tpu", "axon"),
+        )
+    else:
+        arrived = state.p2a_arrival == t  # [A, G, W]
+        msg_round = state.leader_round[None, :, None]  # one round in flight
+        may_vote = arrived & (msg_round >= state.acc_round[:, :, None])
+        new_acc_round = jnp.maximum(
+            state.acc_round, jnp.max(jnp.where(may_vote, msg_round, -1), axis=2)
+        )
+        vote_round = jnp.where(may_vote, msg_round, state.vote_round)
+        # The vote carries the slot's currently proposed value
+        # (Acceptor.scala:184-220 votes for the Phase2a's value).
+        vote_value = jnp.where(
+            may_vote, state.slot_value[None, :, :], state.vote_value
+        )
+        p2b_arrival = jnp.where(
+            may_vote & p2b_delivered,
+            jnp.minimum(state.p2b_arrival, t + p2b_lat),
+            state.p2b_arrival,
+        )
+        votes_in = (p2b_arrival <= t) & (
+            vote_round == state.leader_round[None, :, None]
+        )
+        nvotes = jnp.sum(votes_in, axis=0)  # [G, W]
+
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
     chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
     chosen_round = jnp.where(
@@ -243,15 +348,16 @@ def tick(
 
     # ---- 3. Replica execution (Replica.executeLog, Replica.scala:394-453):
     # retire the contiguous prefix of chosen slots whose Chosen has reached
-    # the replicas. Ring order: position of per-group slot s is s % W.
-    slot_of_ord = state.head[:, None] + w_iota[None, :]  # [G, W] slot nums
-    pos_of_ord = slot_of_ord % W
+    # the replicas. Computed entirely in RING-POSITION space — a position's
+    # ordinal from head is (pos - head) % W, and the run length is the
+    # minimum ordinal whose slot is not yet executable (no gather).
+    ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
     executable = (
-        (jnp.take_along_axis(status, pos_of_ord, axis=1) == CHOSEN)
-        & (jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1) <= t)
-        & (slot_of_ord < state.next_slot[:, None])
+        (status == CHOSEN)
+        & (replica_arrival <= t)
+        & (ord_of_pos < (state.next_slot - state.head)[:, None])
     )
-    n_retire, retire_mask = ring_retire(executable, state.head)
+    n_retire, retire_mask = ring_retire_pos(executable, ord_of_pos)
     head = state.head + n_retire
     executed = state.executed + n_retire
     retired_total = state.retired + jnp.sum(n_retire)
@@ -264,10 +370,10 @@ def tick(
     replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
     propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
     last_send = jnp.where(retire_mask, INF, state.last_send)
-    p2a_arrival = jnp.where(retire_mask[:, :, None], INF, state.p2a_arrival)
-    p2b_arrival = jnp.where(retire_mask[:, :, None], INF, p2b_arrival)
-    vote_round = jnp.where(retire_mask[:, :, None], -1, vote_round)
-    vote_value = jnp.where(retire_mask[:, :, None], NO_VALUE, vote_value)
+    p2a_arrival = jnp.where(retire_mask[None, :, :], INF, state.p2a_arrival)
+    p2b_arrival = jnp.where(retire_mask[None, :, :], INF, p2b_arrival)
+    vote_round = jnp.where(retire_mask[None, :, :], -1, vote_round)
+    vote_value = jnp.where(retire_mask[None, :, :], NO_VALUE, vote_value)
 
     # ---- 4. Leader proposes new slots (Leader.processClientRequestBatch,
     # Leader.scala:331-407): fill up to K fresh ring slots if the window
@@ -299,24 +405,16 @@ def tick(
     last_send = jnp.where(is_new, t, last_send)
 
     # Thrifty quorum selection (ThriftySystem / ProxyLeader.scala:187-197):
-    # Phase2a goes to f+1 random acceptors of the slot's group.
-    if cfg.thrifty and f == 1:
-        # f+1 of 2f+1 = all but one: exclude one uniformly random member
-        # (A = 3 divides 255+1? no — modulo bias <= 1/256, see
-        # common.bit_latency).
-        excluded = (
-            ((bits2 >> 8) & jnp.uint32(0xFF)).astype(jnp.int32) % A
-        )  # [G, W]
-        in_quorum = jnp.arange(A)[None, None, :] != excluded[:, :, None]
-    elif cfg.thrifty:
-        # General f: rank the extra sweep's high bits (disjoint from the
-        # p2a drop field, uncorrelated with the latency fields).
-        scores = bits_extra >> 8
-        kth = jnp.sort(scores, axis=2)[:, :, f : f + 1]  # (f+1)-th smallest
-        in_quorum = scores <= kth
+    # Phase2a goes to f+1 random acceptors of the slot's group. f==1 draws
+    # from the always-generated bits2 sweep (bits_extra is all-zeros when
+    # drop_rate == 0 and f == 1); general f ranks bits_extra fields [8:24)
+    # (disjoint from its p2a drop field [0:8)).
+    if cfg.thrifty:
+        bits_q = bits2[None] if f == 1 else bits_extra
+        in_quorum = sample_quorum(bits_q, 8, f, A)
     else:
-        in_quorum = jnp.ones((G, W, A), bool)
-    send_p2a = is_new[:, :, None] & in_quorum & p2a_delivered
+        in_quorum = jnp.ones((A, G, W), bool)
+    send_p2a = is_new[None, :, :] & in_quorum & p2a_delivered
     p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
 
     # ---- 5. Retries (the resend timers of the reference): a slot still
@@ -324,9 +422,149 @@ def tick(
     # including acceptors that already voted: their Phase2b may have been
     # the dropped message, and re-voting (step 1) re-samples its delivery.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    resend = timed_out[:, :, None]
+    resend = timed_out[None, :, :]
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
+
+    # ---- 6. Reads (Evelyn Paxos; Client.scala:1053-1069 read fan-out,
+    # Acceptor.scala:222-237 handleMaxSlotRequest, Replica.scala:455-529
+    # deferred reads draining behind the executed watermark). Global slot
+    # numbering is s*G + g; the global contiguous executed watermark is
+    # min_g(head_g*G + g). Reads are modeled lossless (the reference
+    # retries them like writes; a dropped-read model adds nothing the
+    # write path doesn't already exercise).
+    acc_max_slot = state.acc_max_slot
+    max_chosen_global = state.max_chosen_global
+    client_watermark = state.client_watermark
+    read_status = state.read_status
+    read_issue = state.read_issue
+    read_target = state.read_target
+    read_floor = state.read_floor
+    req_arrival = state.req_arrival
+    resp_slot = state.resp_slot
+    resp_arrival = state.resp_arrival
+    reply_arrival = state.reply_arrival
+    reads_done = state.reads_done
+    read_lat_sum = state.read_lat_sum
+    read_lat_hist = state.read_lat_hist
+    read_lin_violations = state.read_lin_violations
+    if cfg.reads_per_tick:
+        RW = cfg.read_window
+        kr_a, kr_b = jax.random.split(k_read)
+        bits_r = jax.random.bits(kr_a, (A, G, RW))  # [0:8) req lat,
+        #                       [8:16) resp lat, [16:32) quorum sampling
+        bits_r1 = jax.random.bits(kr_b, (RW,))  # [0:8) reply lat
+        req_lat = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max)
+        resp_lat = bit_latency(bits_r, 8, cfg.lat_min, cfg.lat_max)
+        reply_lat = bit_latency(bits_r1, 0, cfg.lat_min, cfg.lat_max)
+
+        # (a) Acceptor bookkeeping: a vote on per-group slot s raises that
+        # acceptor's maxVotedSlot (Acceptor.scala:222-237 serves it from
+        # vote state). Votes happened against the PRE-retire ring —
+        # ord_of_pos from step 3 is exactly that (it uses state.head).
+        may_vote_r = (state.p2a_arrival == t) & (
+            state.leader_round[None, :, None] >= state.acc_round[:, :, None]
+        )
+        slot_of_pos = state.head[:, None] + ord_of_pos  # [G, W] per-group slot
+        acc_max_slot = jnp.maximum(
+            acc_max_slot,
+            jnp.max(jnp.where(may_vote_r, slot_of_pos[None, :, :], -1), axis=2),
+        )
+        # Global floor for the linearizability check: the largest global
+        # slot chosen so far (any read issued after this point must bind
+        # at or above it — read/write quorum intersection).
+        max_chosen_global = jnp.maximum(
+            max_chosen_global,
+            jnp.max(jnp.where(newly_chosen, slot_of_pos * G + group_ids, -1)),
+        )
+
+        # (b) MaxSlotReplies: requests arriving now read the acceptor's
+        # updated max voted slot in GLOBAL numbering; replies travel back.
+        req_now = req_arrival == t  # [A, G, RW]
+        g_row = jnp.arange(G, dtype=jnp.int32)[None, :]  # [1, G]
+        global_acc = jnp.where(
+            acc_max_slot >= 0, acc_max_slot * G + g_row, -1
+        )  # [A, G]
+        resp_slot = jnp.where(req_now, global_acc[:, :, None], resp_slot)
+        resp_arrival = jnp.where(req_now, t + resp_lat, resp_arrival)
+        req_arrival = jnp.where(req_now, INF, req_arrival)  # consumed
+
+        # (c) Bind: a waiting read whose every sampled acceptor has replied
+        # adopts the max reply as its target (Client.handleMaxSlotReply,
+        # Client.scala:851-933 waits a quorum per group and maxes).
+        any_outstanding = jnp.any(req_arrival < INF, axis=(0, 1))  # [RW]
+        any_pending = jnp.any(
+            (resp_arrival < INF) & (resp_arrival > t), axis=(0, 1)
+        )
+        ready = (read_status == R_WAIT) & ~any_outstanding & ~any_pending
+        target = jnp.max(
+            jnp.where(resp_arrival < INF, resp_slot, -1), axis=(0, 1)
+        )  # [RW]
+        read_target = jnp.where(ready, target, read_target)
+        read_lin_violations = read_lin_violations + jnp.sum(
+            ready & (target < read_floor)
+        )
+        read_status = jnp.where(ready, R_BOUND, read_status)
+
+        # (d) Completion: the reply leaves once the executed watermark
+        # passes the target (Replica.scala:407-412 drains deferred reads
+        # inside executeLog). The reply carries the slot the read actually
+        # EXECUTED at (watermark-1, >= target) — the client's
+        # largestSeenSlots updates from executed slots, not requested
+        # targets (Client.scala:300-305), which is what lets sequential
+        # reads advance behind concurrent writes.
+        watermark = jnp.min(head * G + jnp.arange(G, dtype=jnp.int32))
+        can_send = (read_status == R_BOUND) & (watermark > read_target)
+        # After the floor check at bind, read_target's only remaining
+        # consumer is the client watermark update below, so it can carry
+        # the executed slot from here on.
+        read_target = jnp.where(can_send, watermark - 1, read_target)
+        reply_arrival = jnp.where(can_send, t + reply_lat, reply_arrival)
+        read_status = jnp.where(can_send, R_SENT, read_status)
+        done = (read_status == R_SENT) & (reply_arrival <= t)
+        n_done = jnp.sum(done)
+        rlat = jnp.where(done, t - read_issue, 0)
+        reads_done = reads_done + n_done
+        read_lat_sum = read_lat_sum + jnp.sum(rlat)
+        rbins = jnp.clip(rlat, 0, LAT_BINS - 1)
+        read_lat_hist = read_lat_hist + jax.ops.segment_sum(
+            done.astype(jnp.int32), rbins, LAT_BINS
+        )
+        client_watermark = jnp.maximum(
+            client_watermark, jnp.max(jnp.where(done, read_target, -1))
+        )
+        read_status = jnp.where(done, R_EMPTY, read_status)
+        read_target = jnp.where(done, -1, read_target)
+        read_floor = jnp.where(done, -1, read_floor)
+        read_issue = jnp.where(done, INF, read_issue)
+        reply_arrival = jnp.where(done, INF, reply_arrival)
+        resp_slot = jnp.where(done[None, None, :], -1, resp_slot)
+        resp_arrival = jnp.where(done[None, None, :], INF, resp_arrival)
+
+        # (e) Issue new reads into empty ring slots.
+        empty = read_status == R_EMPTY
+        rank = jnp.cumsum(empty.astype(jnp.int32))
+        is_issue = empty & (rank <= cfg.reads_per_tick)
+        read_issue = jnp.where(is_issue, t, read_issue)
+        read_floor = jnp.where(is_issue, max_chosen_global, read_floor)
+        if cfg.read_mode == "linearizable":
+            # Random f+1 read quorum of EVERY group (randomReadQuorum,
+            # QuorumSystem.scala:16-24; same selection scheme as the
+            # thrifty write quorum above).
+            in_rq = sample_quorum(bits_r, 16, f, A)
+            send_req = is_issue[None, None, :] & in_rq
+            req_arrival = jnp.where(send_req, t + req_lat, req_arrival)
+            read_status = jnp.where(is_issue, R_WAIT, read_status)
+        elif cfg.read_mode == "sequential":
+            # The client's largest-seen slot (Client.scala:300-305). The
+            # batched client is a read-only observer: its watermark
+            # advances from its own completed reads (writes belong to
+            # other, anonymous clients).
+            read_target = jnp.where(is_issue, client_watermark, read_target)
+            read_status = jnp.where(is_issue, R_BOUND, read_status)
+        else:  # eventual: execute immediately (Replica.scala:645-654)
+            read_target = jnp.where(is_issue, -1, read_target)
+            read_status = jnp.where(is_issue, R_BOUND, read_status)
 
     return BatchedMultiPaxosState(
         leader_round=state.leader_round,
@@ -350,6 +588,21 @@ def tick(
         retired=retired_total,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        acc_max_slot=acc_max_slot,
+        max_chosen_global=max_chosen_global,
+        client_watermark=client_watermark,
+        read_status=read_status,
+        read_issue=read_issue,
+        read_target=read_target,
+        read_floor=read_floor,
+        req_arrival=req_arrival,
+        resp_slot=resp_slot,
+        resp_arrival=resp_arrival,
+        reply_arrival=reply_arrival,
+        reads_done=reads_done,
+        read_lat_sum=read_lat_sum,
+        read_lat_hist=read_lat_hist,
+        read_lin_violations=read_lin_violations,
     )
 
 
@@ -376,22 +629,22 @@ def leader_change(
     in_flight = state.status == PROPOSED
     # safeValue: per slot, the value of the max-round vote (all votes in
     # one round carry the same value, so any argmax tie-break is safe).
-    has_vote = state.vote_round >= 0  # [G, W, A]
-    best = jnp.argmax(state.vote_round, axis=2)  # vote_round is -1 when unvoted
+    has_vote = state.vote_round >= 0  # [A, G, W]
+    best = jnp.argmax(state.vote_round, axis=0)  # vote_round is -1 when unvoted
     voted_value = jnp.take_along_axis(
-        state.vote_value, best[:, :, None], axis=2
-    )[:, :, 0]
-    any_vote = jnp.any(has_vote, axis=2)  # [G, W]
+        state.vote_value, best[None, :, :], axis=0
+    )[0]
+    any_vote = jnp.any(has_vote, axis=0)  # [G, W]
     safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
     slot_value = jnp.where(in_flight, safe_value, state.slot_value)
-    lat = sample_latency(cfg.lat_min, cfg.lat_max, key, (G, W, A))
-    p2a_arrival = jnp.where(in_flight[:, :, None], t + lat, state.p2a_arrival)
+    lat = sample_latency(cfg.lat_min, cfg.lat_max, key, (A, G, W))
+    p2a_arrival = jnp.where(in_flight[None, :, :], t + lat, state.p2a_arrival)
     # Clear stale Phase2bs of the in-flight slots: old-round votes no
     # longer count, and keeping their arrival ticks would let a re-vote in
     # the new round piggyback on a PAST arrival via the jnp.minimum dedup
     # in tick step 1 (counting the same tick it is cast, biasing commit
     # latency low).
-    p2b_arrival = jnp.where(in_flight[:, :, None], INF, state.p2b_arrival)
+    p2b_arrival = jnp.where(in_flight[None, :, :], INF, state.p2b_arrival)
     return dataclasses.replace(
         state,
         leader_round=new_round,
@@ -424,11 +677,11 @@ def reconfigure(
     the analog of old configurations being garbage collected only once
     the chosen watermark passes them (Reconfigurer/GC pipeline)."""
     state = leader_change(cfg, state, t, key)  # also clears pending Phase2bs
-    in_flight = (state.status == PROPOSED)[:, :, None]
+    in_flight = (state.status == PROPOSED)[None, :, :]
     return dataclasses.replace(
         state,
         acc_round=jnp.broadcast_to(
-            state.leader_round[:, None], state.acc_round.shape
+            state.leader_round[None, :], state.acc_round.shape
         ),
         vote_round=jnp.where(in_flight, -1, state.vote_round),
         vote_value=jnp.where(in_flight, NO_VALUE, state.vote_value),
@@ -466,9 +719,9 @@ def check_invariants(
     # Chosen slots have a quorum of votes at (or, after a repair
     # re-proposal bumped vote_round, above) the round they were chosen in.
     votes = (state.p2b_arrival <= t) & (
-        state.vote_round >= state.chosen_round[:, :, None]
+        state.vote_round >= state.chosen_round[None, :, :]
     )
-    quorum_ok = jnp.all(jnp.where(chosen, jnp.sum(votes, axis=2) >= f + 1, True))
+    quorum_ok = jnp.all(jnp.where(chosen, jnp.sum(votes, axis=0) >= f + 1, True))
     # Heads never pass next_slot; windows never overfill.
     window_ok = jnp.all(
         (state.head <= state.next_slot)
@@ -478,7 +731,7 @@ def check_invariants(
     conserved = jnp.sum(state.executed) == state.retired
     # Acceptors never promised below the leader round they voted in.
     round_ok = jnp.all(
-        state.acc_round[:, None, :] >= jnp.where(
+        state.acc_round[:, :, None] >= jnp.where(
             state.vote_round >= 0, state.vote_round, 0
         )
     )
@@ -489,15 +742,30 @@ def check_invariants(
         jnp.where(chosen, state.chosen_value != NO_VALUE, True)
     )
     vote_in_chosen_round = (
-        chosen[:, :, None]
-        & (state.vote_round == state.chosen_round[:, :, None])
+        chosen[None, :, :]
+        & (state.vote_round == state.chosen_round[None, :, :])
     )
     vote_value_ok = jnp.all(
         jnp.where(
             vote_in_chosen_round,
-            state.vote_value == state.chosen_value[:, :, None],
+            state.vote_value == state.chosen_value[None, :, :],
             True,
         )
+    )
+    # Reads: no read may bind below the chosen floor recorded at its issue
+    # (read-quorum/write-quorum intersection — the linearizability
+    # guarantee of the Evelyn read path); ring states stay in range.
+    # Trivially true when reads are off (empty arrays).
+    read_lin_ok = state.read_lin_violations == 0
+    read_ring_ok = jnp.all(
+        (state.read_status >= R_EMPTY) & (state.read_status <= R_SENT)
+    )
+    # Global slot numbering (s*G + g) is int32: it overflows once any
+    # group's head passes 2^31/G (~644k slots at G=3334), after which the
+    # watermark comparison would silently stall reads. Fail LOUDLY here
+    # instead — runs needing a longer horizon must rebase the numbering.
+    slot_horizon_ok = jnp.max(state.head) < jnp.int32(0x7FFFFFFF) // jnp.int32(
+        max(cfg.num_groups, 1)
     )
     return {
         "quorum_ok": quorum_ok,
@@ -506,4 +774,7 @@ def check_invariants(
         "round_ok": round_ok,
         "value_set_ok": value_set_ok,
         "vote_value_ok": vote_value_ok,
+        "read_lin_ok": read_lin_ok,
+        "read_ring_ok": read_ring_ok,
+        "slot_horizon_ok": slot_horizon_ok,
     }

@@ -113,7 +113,7 @@ class TpuSimTransport:
             if committed
             else -1
         )
-        return {
+        out = {
             "ticks": int(self.t),
             "committed": committed,
             "executed": int(self.state.retired),
@@ -125,6 +125,19 @@ class TpuSimTransport:
             "round": int(jax.device_get(self.state.leader_round).max()),
             "num_acceptors": self.config.num_acceptors,
         }
+        if self.config.reads_per_tick:
+            reads = int(self.state.reads_done)
+            rhist = jax.device_get(self.state.read_lat_hist)
+            rcum = rhist.cumsum()
+            out["reads_done"] = reads
+            out["read_mode"] = self.config.read_mode
+            out["read_latency_mean_ticks"] = (
+                float(self.state.read_lat_sum) / reads if reads else float("nan")
+            )
+            out["read_latency_p50_ticks"] = (
+                int((rcum >= max(1, (reads + 1) // 2)).argmax()) if reads else -1
+            )
+        return out
 
     def check_invariants(self) -> dict:
         return {

@@ -164,9 +164,23 @@ class VizServer:
                 return i
         raise KeyError(f"stale message token {token!r}")
 
+    def _timer_tokens(self):
+        """Stable per-timer tokens: address|name plus an occurrence
+        ordinal — an actor may run several timers under one name (e.g.
+        per-op retry timers), and without the ordinal a 'fire' click
+        could fire a different timer than the one displayed."""
+        seen = {}
+        tokens = []
+        for t in self.stepper.transport.running_timers():
+            base = f"{t.address}|{t.name()}"
+            n = seen.get(base, 0)
+            seen[base] = n + 1
+            tokens.append(f"{base}.{n}")
+        return tokens
+
     def _resolve_timer(self, token: str) -> int:
-        for i, t in enumerate(self.stepper.transport.running_timers()):
-            if f"{t.address}|{t.name()}" == token:
+        for i, tok in enumerate(self._timer_tokens()):
+            if tok == token:
                 return i
         raise KeyError(f"stale timer token {token!r}")
 
@@ -194,8 +208,8 @@ class VizServer:
                 "tok": tok, "src": str(m.src), "dst": str(m.dst), "desc": desc,
             })
         timers = [
-            {"tok": f"{t_.address}|{t_.name()}", "desc": desc}
-            for t_, desc in zip(t.running_timers(), self.stepper.timers())
+            {"tok": tok, "desc": desc}
+            for tok, desc in zip(self._timer_tokens(), self.stepper.timers())
         ]
         return {
             "protocol": self.protocol,

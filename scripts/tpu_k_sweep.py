@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""One-off: proposal-rate sweep on the live TPU — is committed/sec limited
+by bandwidth-per-window (flat in K) or fixed overheads (rises with K)?
+Writes results/tpu_k_sweep_r03.json incrementally after each row."""
+import json
+import time
+
+import jax
+
+from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+rows = []
+
+
+def save():
+    with open("results/tpu_k_sweep_r03.json", "w") as f:
+        json.dump({"device": str(jax.devices()[0]), "rows": rows}, f, indent=1)
+
+
+for K, W, reads in [(8, 64, 0), (16, 128, 0), (32, 256, 0), (8, 64, 2)]:
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=3334, window=W, slots_per_tick=K,
+        lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,
+        reads_per_tick=reads, read_window=4 * reads,
+    )
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(200); sim.block_until_ready()
+    c0 = sim.committed()
+    r0 = int(sim.state.reads_done) if reads else 0
+    t0 = time.perf_counter()
+    sim.run(600); sim.block_until_ready()
+    dt = time.perf_counter() - t0
+    row = {
+        "K": K, "W": W, "reads_per_tick": reads,
+        "ticks_per_sec": round(600 / dt, 1),
+        "committed_per_sec": round((sim.committed() - c0) / dt, 1),
+        "p50_ticks": sim.stats()["commit_latency_p50_ticks"],
+        "invariants_ok": all(sim.check_invariants().values()),
+    }
+    if reads:
+        row["reads_per_sec"] = round((int(sim.state.reads_done) - r0) / dt, 1)
+        row["read_p50_ticks"] = sim.stats()["read_latency_p50_ticks"]
+    print(row, flush=True)
+    rows.append(row)
+    save()

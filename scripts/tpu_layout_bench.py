@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""One-off: measure the acceptor-major layout + Pallas kernel on the live
+TPU at the headline config. Appends rows to results/tpu_layout_r03.json."""
+import json
+import time
+
+import jax
+
+from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+rows = []
+for name, kw in [
+    ("xla_W64", dict(window=64, use_pallas=False)),
+    ("xla_W128", dict(window=128, use_pallas=False)),
+    ("pallas_W64", dict(window=64, use_pallas=True)),
+    ("pallas_W128", dict(window=128, use_pallas=True)),
+]:
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=3334, slots_per_tick=8,
+        lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,
+        **kw,
+    )
+    try:
+        sim = TpuSimTransport(cfg, seed=0)
+        sim.run(200); sim.block_until_ready()
+        c0 = sim.committed()
+        t0 = time.perf_counter()
+        sim.run(1000); sim.block_until_ready()
+        dt = time.perf_counter() - t0
+        row = {
+            "variant": name, "ticks_per_sec": round(1000 / dt, 1),
+            "committed_per_sec": round((sim.committed() - c0) / dt, 1),
+            "p50_ticks": sim.stats()["commit_latency_p50_ticks"],
+            "invariants_ok": all(sim.check_invariants().values()),
+        }
+    except Exception as e:  # record compile failures instead of dying
+        row = {"variant": name, "error": repr(e)[:500]}
+    print(row, flush=True)
+    rows.append(row)
+
+with open("results/tpu_layout_r03.json", "w") as f:
+    json.dump({"device": str(jax.devices()[0]), "rows": rows}, f, indent=1)

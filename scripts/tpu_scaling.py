@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""One-off: ticks/sec scaling sweep G in {334, 3334, 33334} on the live TPU,
+plus a jax.profiler trace at the headline config. Writes
+results/tpu_scaling_r03.json and results/tpu_trace_r03/."""
+import json
+import time
+
+import jax
+
+from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+out = {"device": str(jax.devices()[0]), "sweep": []}
+for G in (334, 3334, 33334):
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=G, window=64, slots_per_tick=8,
+        lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,
+    )
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(200); sim.block_until_ready()  # compile + ramp
+    c0 = sim.committed()
+    t0 = time.perf_counter()
+    sim.run(500); sim.block_until_ready()
+    dt = time.perf_counter() - t0
+    committed = sim.committed() - c0
+    row = {
+        "num_groups": G, "num_acceptors": cfg.num_acceptors,
+        "ticks_per_sec": round(500 / dt, 1),
+        "committed_per_sec": round(committed / dt, 1),
+        "wall_seconds": round(dt, 3),
+    }
+    print(row)
+    out["sweep"].append(row)
+
+# Profile the headline config.
+cfg = BatchedMultiPaxosConfig(
+    f=1, num_groups=3334, window=64, slots_per_tick=8,
+    lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,
+)
+sim = TpuSimTransport(cfg, seed=0)
+sim.profile(500, "results/tpu_trace_r03")
+print("trace written")
+
+with open("results/tpu_scaling_r03.json", "w") as f:
+    json.dump(out, f, indent=1)

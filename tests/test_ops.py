@@ -54,10 +54,10 @@ def test_fused_vote_quorum_matches_reference(seed, shape):
 
 
 def test_reference_matches_tick_phase():
-    """The acceptor-major spec equals the tick's group-major vote/count
-    phase, replicating the tick's OWN bit-derived latency and drop
-    samples so every spec output (votes, phase2b schedule, promised
-    rounds, quorum counts) is compared."""
+    """The spec equals the tick's vote/count phase (both acceptor-major),
+    replicating the tick's OWN bit-derived latency and drop samples so
+    every spec output (votes, phase2b schedule, promised rounds, quorum
+    counts) is compared."""
     from frankenpaxos_tpu.tpu.common import bit_delivered, bit_latency
     from frankenpaxos_tpu.tpu.multipaxos_batched import (
         CHOSEN,
@@ -78,32 +78,30 @@ def test_reference_matches_tick_phase():
     tkey = jax.random.fold_in(key, 1)
     k3, k2, k_extra = jax.random.split(tkey, 3)
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
-    bits3 = jax.random.bits(k3, (G, W, A))
+    bits3 = jax.random.bits(k3, (A, G, W))
     p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
     p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
 
-    am = lambda x: jnp.transpose(x, (2, 0, 1))  # [G,W,A] -> [A,G,W]
     vr, vv, p2b, accr, nvotes = reference_vote_quorum(
-        am(state.p2a_arrival),
-        jnp.transpose(state.acc_round, (1, 0)),
+        state.p2a_arrival,
+        state.acc_round,
         state.leader_round,
         state.slot_value,
-        am(state.vote_round),
-        am(state.vote_value),
-        am(state.p2b_arrival),
-        am(p2b_lat),
-        am(p2b_delivered),
+        state.vote_round,
+        state.vote_value,
+        state.p2b_arrival,
+        p2b_lat,
+        p2b_delivered,
         jnp.int32(1),
     )
     after = tick(cfg, state, jnp.int32(1), tkey)
-    gm = lambda x: jnp.transpose(x, (1, 2, 0))  # [A,G,W] -> [G,W,A]
-    np.testing.assert_array_equal(np.asarray(gm(vr)), np.asarray(after.vote_round))
-    np.testing.assert_array_equal(np.asarray(gm(vv)), np.asarray(after.vote_value))
+    np.testing.assert_array_equal(np.asarray(vr), np.asarray(after.vote_round))
+    np.testing.assert_array_equal(np.asarray(vv), np.asarray(after.vote_value))
     np.testing.assert_array_equal(
-        np.asarray(gm(p2b)), np.asarray(after.p2b_arrival)
+        np.asarray(p2b), np.asarray(after.p2b_arrival)
     )
     np.testing.assert_array_equal(
-        np.asarray(jnp.transpose(accr, (1, 0))), np.asarray(after.acc_round)
+        np.asarray(accr), np.asarray(after.acc_round)
     )
     # nvotes drives chosen-ness: slots the spec counts to quorum are
     # exactly the slots the tick marked CHOSEN this tick (no prior
@@ -112,3 +110,38 @@ def test_reference_matches_tick_phase():
     proposed_before = np.asarray(state.status) == PROPOSED
     expect_chosen = proposed_before & (np.asarray(nvotes) >= cfg.f + 1)
     np.testing.assert_array_equal(expect_chosen, chosen)
+
+
+@pytest.mark.parametrize("drop", [0.0, 0.2])
+def test_tick_with_use_pallas_is_bit_identical(drop):
+    """The whole simulation with tick steps 1-2 routed through the fused
+    Pallas kernel (interpret mode on CPU) equals the XLA path bit for bit
+    — state arrays, stats, and invariants."""
+    import dataclasses as dc
+
+    from frankenpaxos_tpu.tpu.multipaxos_batched import (
+        BatchedMultiPaxosConfig,
+        check_invariants,
+        init_state,
+        run_ticks,
+    )
+
+    # num_groups NOT divisible by pallas_block_g exercises the padding.
+    base = dict(
+        f=1, num_groups=3, window=8, slots_per_tick=2,
+        lat_min=1, lat_max=3, drop_rate=drop, retry_timeout=6,
+        pallas_block_g=2,
+    )
+    key = jax.random.PRNGKey(5)
+    t0 = jnp.zeros((), jnp.int32)
+    cfg_x = BatchedMultiPaxosConfig(**base, use_pallas=False)
+    cfg_p = BatchedMultiPaxosConfig(**base, use_pallas=True)
+    sx, tx = run_ticks(cfg_x, init_state(cfg_x), t0, 40, key)
+    sp, tp = run_ticks(cfg_p, init_state(cfg_p), t0, 40, key)
+    assert int(sx.committed) > 0
+    for field in dc.fields(sx):
+        a = np.asarray(getattr(sx, field.name))
+        b = np.asarray(getattr(sp, field.name))
+        np.testing.assert_array_equal(a, b, err_msg=field.name)
+    inv = check_invariants(cfg_p, sp, tp)
+    assert all(bool(v) for v in inv.values()), inv

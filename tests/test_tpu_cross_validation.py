@@ -215,13 +215,13 @@ def test_cross_validation_leader_change_repair():
     # chosen — the repair read covers all acceptors, so one voter
     # preserves the value exactly like the per-actor read-quorum
     # intersection does).
-    p2a = np.asarray(state.p2a_arrival).copy()  # [G, W, A]
+    p2a = np.asarray(state.p2a_arrival).copy()  # [A, G, W]
     for global_slot in range(n):
         g, s = global_slot % 2, global_slot // 2
         if global_slot in voted:
-            p2a[g, s % cfg.window, 1:] = INF
+            p2a[1:, g, s % cfg.window] = INF
         else:
-            p2a[g, s % cfg.window, :] = INF
+            p2a[:, g, s % cfg.window] = INF
     state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
     # t=1: the surviving Phase2as arrive; single votes are recorded.
     state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))

@@ -36,6 +36,8 @@ def test_balanced_load_executes_globally():
     # Balanced stripes: the global prefix tracks total commits closely.
     assert int(state.executed_global) > 800
     assert int(state.skips) == 0  # nobody lags enough to skip
+    # No skips -> every chosen slot is a real command.
+    assert int(state.committed_real) == int(state.committed)
 
 
 def test_skew_triggers_skips_and_global_progress():
@@ -50,6 +52,9 @@ def test_skew_triggers_skips_and_global_progress():
     # The global log advances far beyond what the slowest unskipped
     # stripe would allow.
     assert int(state.executed_global) > 1000
+    # Noop fills are chosen slots but NOT real commands: the headline
+    # command rate must exclude them (advisor round 2).
+    assert 0 < int(state.committed_real) < int(state.committed)
 
 
 def test_no_skips_stalls_global_log():

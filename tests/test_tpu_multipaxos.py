@@ -237,10 +237,11 @@ def test_reconfiguration_churn_preserves_safety_and_values():
     key = jax.random.PRNGKey(5)
     state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
     # Let exactly one acceptor of group 0 slot 0 vote; block the rest.
+    # Layout: [A, G, W].
     p2a = np.asarray(state.p2a_arrival).copy()
-    p2a[:, :, 1:] = int(INF)
-    p2a[1, :, :] = int(INF)
-    p2a[0, 1, :] = int(INF)
+    p2a[1:, :, :] = int(INF)  # acceptors 1.. never hear the Phase2a
+    p2a[:, 1, :] = int(INF)  # group 1 blocked entirely
+    p2a[:, 0, 1] = int(INF)  # group 0 slot 1 blocked
     state = dc.replace(state, p2a_arrival=jnp.asarray(p2a))
     state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
     assert int(state.committed) == 0

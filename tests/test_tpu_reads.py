@@ -1,0 +1,182 @@
+"""Tests of the batched read path (Evelyn Paxos reads in the TPU backend):
+linearizable quorum reads, sequential and eventual modes, device-side
+linearizability invariant, and sharded equality (conftest: CPU, 8 virtual
+devices)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.parallel import make_mesh, run_ticks_sharded, shard_state
+from frankenpaxos_tpu.tpu import (
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    check_invariants,
+    init_state,
+    leader_change,
+    run_ticks,
+    tick,
+)
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    INF,
+    R_BOUND,
+    R_EMPTY,
+    R_SENT,
+    R_WAIT,
+)
+
+
+def make(mode="linearizable", **kw):
+    defaults = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, reads_per_tick=2, read_window=8,
+        read_mode=mode,
+    )
+    defaults.update(kw)
+    return BatchedMultiPaxosConfig(**defaults)
+
+
+@pytest.mark.parametrize("mode", ["linearizable", "sequential", "eventual"])
+def test_reads_complete_and_invariants_hold(mode):
+    sim = TpuSimTransport(make(mode), seed=0)
+    sim.run(80)
+    stats = sim.stats()
+    assert stats["committed"] > 0
+    assert stats["reads_done"] > 0
+    assert stats["read_latency_mean_ticks"] > 0
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_linearizable_reads_slower_than_eventual():
+    """A linearizable read pays the MaxSlot quorum round-trip plus the
+    watermark wait; an eventual read pays one hop. The model must show
+    the ordering the reference's consistency modes exist to trade."""
+    lin = TpuSimTransport(make("linearizable"), seed=1)
+    ev = TpuSimTransport(make("eventual"), seed=1)
+    lin.run(200)
+    ev.run(200)
+    assert (
+        lin.stats()["read_latency_mean_ticks"]
+        > ev.stats()["read_latency_mean_ticks"]
+    )
+    assert ev.stats()["reads_done"] >= lin.stats()["reads_done"]
+
+
+def test_reads_under_loss_and_failover():
+    sim = TpuSimTransport(
+        make("linearizable", drop_rate=0.2, retry_timeout=6), seed=2
+    )
+    sim.run(60)
+    sim.leader_change()
+    sim.run(200)
+    stats = sim.stats()
+    assert stats["reads_done"] > 0
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_linearizability_floor_is_enforced_by_construction():
+    """Every bound read's target must be >= the max globally chosen slot
+    at its issue tick (read/write quorum intersection). The invariant
+    counter must stay zero over a long, lossy, failover-heavy run."""
+    cfg = make("linearizable", drop_rate=0.1, retry_timeout=6, f=2)
+    sim = TpuSimTransport(cfg, seed=3)
+    for _ in range(4):
+        sim.run(60)
+        sim.leader_change()
+    sim.run(100)
+    inv = sim.check_invariants()
+    assert inv["read_lin_ok"], "a read bound below its issue-time floor"
+    assert all(inv.values()), inv
+
+
+def test_lin_violation_detector_has_teeth():
+    """Corrupt a bound read's target below its floor and run a tick: the
+    device-side check must already have counted honest binds, so instead
+    verify the counter wiring by forcing a bind with a floor above any
+    possible target."""
+    cfg = make("linearizable")
+    key = jax.random.PRNGKey(4)
+    state = init_state(cfg)
+    t = 0
+    for _ in range(12):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    # Find a waiting read and fake an impossible floor: any later bind
+    # must then increment the violation counter.
+    status = np.asarray(state.read_status)
+    assert (status == R_WAIT).any() or (status == R_SENT).any()
+    floor = np.asarray(state.read_floor).copy()
+    floor[:] = 10**9
+    state = dataclasses.replace(
+        state,
+        read_floor=jnp.asarray(floor),
+        read_status=jnp.where(state.read_status == R_WAIT, R_WAIT, R_EMPTY),
+    )
+    for _ in range(12):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    assert int(state.read_lin_violations) > 0
+    inv = check_invariants(cfg, state, jnp.int32(t))
+    assert not bool(inv["read_lin_ok"])
+
+
+def test_read_target_tracks_committed_writes():
+    """After the cluster commits for a while, linearizable reads bind to
+    recent targets (close to the global watermark), and completed reads
+    advance the client watermark monotonically."""
+    sim = TpuSimTransport(make("linearizable"), seed=5)
+    prev_wm = -1
+    for _ in range(6):
+        sim.run(40)
+        wm = int(jax.device_get(sim.state.client_watermark))
+        assert wm >= prev_wm
+        prev_wm = wm
+    assert prev_wm > 0  # reads saw real committed state
+
+
+def test_sequential_reads_bound_by_own_history():
+    sim = TpuSimTransport(make("sequential"), seed=6)
+    sim.run(120)
+    stats = sim.stats()
+    assert stats["reads_done"] > 0
+    # Sequential targets come from the client's own watermark, which only
+    # moves forward; the ring must fully recycle (no stuck reads).
+    status = np.asarray(sim.state.read_status)
+    assert ((status == R_EMPTY) | (status == R_BOUND) | (status == R_SENT)).all()
+    assert all(sim.check_invariants().values())
+
+
+def test_reads_sharded_matches_unsharded():
+    """Reads fan out to every group (the one cross-device pattern); the
+    sharded run must still be bit-identical to the unsharded one."""
+    cfg = make("linearizable", num_groups=8, drop_rate=0.1, retry_timeout=6)
+    key = jax.random.PRNGKey(7)
+    t0 = jnp.zeros((), jnp.int32)
+    plain, plain_t = run_ticks(cfg, init_state(cfg), t0, 120, key)
+    mesh = make_mesh()
+    sharded0 = shard_state(init_state(cfg), mesh)
+    sharded, sharded_t = run_ticks_sharded(cfg, mesh, sharded0, t0, 120, key)
+    for field in (
+        "reads_done", "read_lat_sum", "read_lin_violations", "committed",
+        "retired", "client_watermark", "max_chosen_global",
+    ):
+        a = jax.device_get(getattr(plain, field))
+        b = jax.device_get(getattr(sharded, field))
+        assert (a == b).all(), field
+    assert int(jax.device_get(plain.reads_done)) > 0
+
+
+def test_reads_off_state_is_empty_and_cheap():
+    """reads_per_tick=0 keeps every read array zero-sized — the write-only
+    model's compiled program carries no read traffic."""
+    cfg = make(reads_per_tick=0, read_window=0)
+    state = init_state(cfg)
+    assert state.req_arrival.size == 0
+    assert state.read_status.size == 0
+    sim = TpuSimTransport(cfg, seed=8)
+    sim.run(30)
+    assert "reads_done" not in sim.stats()
+    assert all(sim.check_invariants().values())
