@@ -204,18 +204,29 @@ class SimTransport(Transport):
         if msg in self.messages:
             self.messages.append(msg)
 
-    def trigger_timer(self, address: Address, name: str, record: bool = True) -> None:
-        """Fire the first running timer with this (address, name)
-        (FakeTransport.scala:161-179). No-op if none is running."""
+    def trigger_timer(
+        self,
+        address: Address,
+        name: str,
+        record: bool = True,
+        occurrence: int = 0,
+    ) -> None:
+        """Fire the ``occurrence``-th running timer with this
+        (address, name) (FakeTransport.scala:161-179; an actor may run
+        several timers under one name, e.g. per-op retry timers). No-op
+        if none is running at that occurrence."""
         if record:
             self.history.append(TriggerTimer(address, name))
         if address in self.partitioned:
             return
+        seen = 0
         for t in list(self._running_timers):
             if t.address == address and t._name == name:
-                t.run()
-                self.flush_all()
-                return
+                if seen == occurrence:
+                    t.run()
+                    self.flush_all()
+                    return
+                seen += 1
 
     def partition_actor(self, address: Address, record: bool = True) -> None:
         """Drop all traffic to/from ``address`` and all its pending messages

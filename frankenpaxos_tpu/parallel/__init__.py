@@ -46,14 +46,17 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
             "max_chosen_global", "client_watermark", "read_status",
             "read_issue", "read_target", "read_floor", "reply_arrival",
             "reads_done", "read_lat_sum", "read_lat_hist",
-            "read_lin_violations",
+            "read_lin_violations", "elections", "reconfigs", "configs_gcd",
         }
         # Acceptor-major arrays ([A, G, W] / [A, G] / [A, G, RW]) carry
         # the group axis second; everything else ([G, W] / [G]) first.
         acceptor_major = {
             "acc_round", "p2a_arrival", "p2b_arrival", "vote_round",
             "vote_value", "acc_max_slot", "req_arrival", "resp_slot",
-            "resp_arrival",
+            "resp_arrival", "leader_alive",  # [C, G] candidates
+            # [M, G] matchmakers / [A, G] old-config phase-1 exchanges.
+            "mm_epoch", "matcha_arrival", "matchb_arrival",
+            "rc_p1a_arrival", "rc_p1b_arrival",
         }
         if leaf_name in scalar_or_global:
             return NamedSharding(mesh, P())

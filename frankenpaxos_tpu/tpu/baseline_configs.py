@@ -108,40 +108,45 @@ def config3_depgraph(full: bool) -> dict:
 
 
 def config4_matchmaker_churn(full: bool) -> dict:
+    """Matchmaker churn on the DEVICE-SIDE path: reconfigurations run
+    inside the compiled scan (MatchA/MatchB quorum + phase-1 read quorum
+    against the old config, multipaxos_batched tick step 0.5), not as
+    host injections. A per-segment committed timeline exposes the
+    dip/recovery signature (vldb20_matchmaker lt figure)."""
     from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
 
-    cfg = BatchedMultiPaxosConfig(
-        f=1, num_groups=256 if full else 16, window=64, slots_per_tick=4,
-        lat_min=1, lat_max=3, retry_timeout=16,
-    )
-
-    def run(churn_every: int | None) -> dict:
+    def run(churn_every) -> dict:
+        cfg = BatchedMultiPaxosConfig(
+            f=1, num_groups=256 if full else 16, window=64, slots_per_tick=4,
+            lat_min=1, lat_max=3, retry_timeout=16,
+            reconfigure_every=churn_every or 0,
+        )
         sim = TpuSimTransport(cfg, seed=3)
         sim.run(100)  # warm the pipeline
         sim.block_until_ready()
         base = sim.committed()
-        segments, seg_ticks = 10, 50
-        reconfigurations = 0
-        for i in range(segments):
-            # Reconfigure BEFORE a segment so every counted swap has
-            # measured ticks behind it.
-            if churn_every is not None and i > 0 and i % churn_every == 0:
-                sim.reconfigure()
-                reconfigurations += 1
+        timeline = []
+        segments, seg_ticks = 20, 25
+        for _ in range(segments):
+            before = sim.committed()
             sim.run(seg_ticks)
+            timeline.append(sim.committed() - before)
         sim.block_until_ready()
         inv = sim.check_invariants()
         assert all(inv.values()), inv
         stats = sim.stats()
-        return {
+        out = {
             "committed": sim.committed() - base,
             "per_tick": round((sim.committed() - base) / (segments * seg_ticks), 1),
             "p50_latency_ticks": stats["commit_latency_p50_ticks"],
-            "reconfigurations": reconfigurations,
+            "reconfigurations": stats.get("reconfigurations", 0),
+            "old_configs_gcd": stats.get("old_configs_gcd", 0),
+            "timeline_committed_per_segment": timeline,
         }
+        return out
 
     churn_free = run(None)
-    churned = run(2)  # a reconfiguration every 100 ticks
+    churned = run(100)  # a reconfiguration wave every 100 ticks
     return {
         "config": "matchmaker_reconfiguration_churn",
         "churn_free": churn_free,
@@ -162,10 +167,17 @@ def config5_flexible_sweep(full: bool) -> dict:
     else:
         shapes = [(2, 3), (4, 8)]
         window = 32
+    # Lossless + lossy points: exact thrifty quorums have zero loss
+    # margin, so drops expose the modes' different retry economics
+    # (grid: R re-sends wasted per lost transversal member; majority:
+    # N/2+1 — the message-cost/robustness trade-off the sweep measures).
     configs = [
-        GridBatchedConfig(rows=r, cols=c, mode=mode, window=window)
+        GridBatchedConfig(
+            rows=r, cols=c, mode=mode, window=window, drop_rate=d
+        )
         for (r, c) in shapes
         for mode in ("grid", "majority")
+        for d in ((0.0, 0.05) if not full else (0.0, 0.02))
     ]
     results = sweep(configs, num_ticks=200)
     return {"config": "flexible_quorum_sweep", "points": results}

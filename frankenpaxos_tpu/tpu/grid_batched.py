@@ -93,6 +93,12 @@ class GridBatchedState:
     retired: jnp.ndarray  # []
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    # Phase2a messages sent (thrifty first sends + full-grid retries).
+    # THE quorum-system trade-off: a grid write quorum costs R messages,
+    # a majority costs N/2+1 — but an exact thrifty quorum has zero loss
+    # margin, so under drops the modes also diverge in retry traffic and
+    # commit latency. int32: fine below ~2G sends per run.
+    msgs_sent: jnp.ndarray  # []
 
 
 def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
@@ -111,6 +117,7 @@ def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
         retired=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        msgs_sent=jnp.zeros((), jnp.int32),
     )
 
 
@@ -205,6 +212,9 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         timed_out[:, None, None], t + _lat(cfg, k_retry, (W, R, C)), p2a_arrival
     )
     last_send = jnp.where(timed_out, t, last_send)
+    msgs_sent = (
+        state.msgs_sent + jnp.sum(send) + jnp.sum(timed_out) * (R * C)
+    )
 
     return GridBatchedState(
         next_slot=next_slot,
@@ -220,6 +230,7 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         retired=retired,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        msgs_sent=msgs_sent,
     )
 
 
@@ -265,12 +276,30 @@ def sweep(configs, num_ticks: int = 300, seed: int = 0):
         lat_hist = jax.device_get(state.lat_hist)
         cum = lat_hist.cumsum()
         p50 = int((cum >= max(1, (committed + 1) // 2)).argmax()) if committed else -1
+        p99 = (
+            int((cum >= max(1, -(-committed * 99 // 100))).argmax())
+            if committed
+            else -1
+        )
         results.append(
             {
                 "mode": cfg.mode,
                 "acceptors": cfg.num_acceptors,
+                "drop_rate": cfg.drop_rate,
                 "committed": committed,
                 "p50_latency_ticks": p50,
+                "p99_latency_ticks": p99,
+                "mean_latency_ticks": (
+                    round(float(state.lat_sum) / committed, 2)
+                    if committed
+                    else -1.0
+                ),
+                "msgs_sent": int(state.msgs_sent),
+                "msgs_per_commit": (
+                    round(int(state.msgs_sent) / committed, 1)
+                    if committed
+                    else -1.0
+                ),
                 "invariants": check_invariants(cfg, state, t),
             }
         )
@@ -287,10 +316,13 @@ def main() -> None:
 
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     cols = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    # Lossless AND lossy points: exact thrifty quorums have zero loss
+    # margin, so drops expose the modes' different retry economics.
     results = sweep(
         [
-            GridBatchedConfig(rows=rows, cols=cols, mode="grid"),
-            GridBatchedConfig(rows=rows, cols=cols, mode="majority"),
+            GridBatchedConfig(rows=rows, cols=cols, mode=m, drop_rate=d)
+            for m in ("grid", "majority")
+            for d in (0.0, 0.05)
         ]
     )
     print(json.dumps(results, default=str))

@@ -79,6 +79,11 @@ R_SENT = 3  # watermark passed; reply in flight to the client
 
 READ_MODES = ("linearizable", "sequential", "eventual")
 
+# Matchmaker reconfiguration phases (per group).
+RC_NORMAL = 0
+RC_MATCHING = 1  # MatchA sent; awaiting an f+1 MatchB quorum
+RC_PHASE1 = 2  # Phase1a sent to the OLD config; awaiting f+1 Phase1bs
+
 
 @dataclasses.dataclass(frozen=True)
 class BatchedMultiPaxosConfig:
@@ -113,6 +118,38 @@ class BatchedMultiPaxosConfig:
     reads_per_tick: int = 0
     read_window: int = 0  # outstanding-read ring size (0 = reads off)
     read_mode: str = "linearizable"
+    # Device-side failure detection + elections (heartbeat/Participant.
+    # scala:72-209, election round-robin of roundsystem ClassicRoundRobin):
+    # each group has C leader candidates; round r is owned by candidate
+    # r % C. With fail_rate > 0, alive candidates die (and dead ones
+    # revive at revive_rate) by PRNG inside the tick; followers count
+    # ticks of owner silence in a heartbeat-miss counter and, at
+    # heartbeat_timeout, elect the next alive candidate — round bump plus
+    # phase-1 repair happen INSIDE the compiled scan, no host injection.
+    fail_rate: float = 0.0  # per-candidate per-tick death probability
+    revive_rate: float = 0.05  # per-dead-candidate per-tick revival prob
+    heartbeat_timeout: int = 8  # silent ticks before an election
+    num_leader_candidates: int = 3  # C
+    # Enable the election machinery without PRNG fault injection (for
+    # deterministic tests that kill candidates by editing leader_alive).
+    device_elections: bool = False
+    # Device-side Matchmaker reconfiguration (BASELINE config 4;
+    # matchmakermultipaxos/Matchmaker.scala + Reconfigurer.scala): every
+    # reconfigure_every ticks each group swaps in a fresh acceptor
+    # configuration bound to the next round (the i/i+1 semantics) via a
+    # REAL message exchange inside the compiled scan: MatchA/MatchB to a
+    # 2f+1 matchmaker group (f+1 quorum), then Phase1a/Phase1b against
+    # the OLD configuration — safe values come from the first f+1
+    # Phase1bs to arrive (a true read quorum, not an oracle read of all
+    # acceptors). Proposals stall while a reconfiguration is in flight
+    # (the throughput dip the churn sweep measures); the old
+    # configuration is retained until the executed watermark passes the
+    # slots it may have chosen (the GC pipeline).
+    reconfigure_every: int = 0  # 0 = off
+
+    @property
+    def num_matchmakers(self) -> int:
+        return 2 * self.f + 1
 
     @property
     def group_size(self) -> int:
@@ -170,6 +207,26 @@ class BatchedMultiPaxosState:
     lat_sum: jnp.ndarray  # [] sum of commit latencies (ticks)
     lat_hist: jnp.ndarray  # [LAT_BINS] commit latency histogram
 
+    # Failure detection / elections (inert while cfg.fail_rate == 0).
+    leader_alive: jnp.ndarray  # [C, G] candidate liveness
+    heartbeat_miss: jnp.ndarray  # [G] ticks of owner silence
+    elections: jnp.ndarray  # [] device-side elections (cumulative)
+
+    # Matchmaker reconfiguration (inert while cfg.reconfigure_every == 0).
+    # RC_NORMAL -> RC_MATCHING (MatchA/MatchB quorum) -> RC_PHASE1
+    # (Phase1a/Phase1b quorum against the old config) -> RC_NORMAL.
+    recon_phase: jnp.ndarray  # [G] RC_* phase
+    config_epoch: jnp.ndarray  # [G] completed reconfigurations
+    mm_epoch: jnp.ndarray  # [M, G] matchmaker's recorded epoch
+    matcha_arrival: jnp.ndarray  # [M, G] MatchA arrival tick (INF)
+    matchb_arrival: jnp.ndarray  # [M, G] MatchB arrival tick (INF)
+    rc_p1a_arrival: jnp.ndarray  # [A, G] Phase1a arrival at OLD acceptors
+    rc_p1b_arrival: jnp.ndarray  # [A, G] Phase1b arrival back at leader
+    gc_watermark: jnp.ndarray  # [G] old config retired once head >= this
+    old_live: jnp.ndarray  # [G] old configuration not yet GCd
+    reconfigs: jnp.ndarray  # [] completed reconfigurations (cumulative)
+    configs_gcd: jnp.ndarray  # [] old configs garbage-collected
+
     # Read path (all zero-sized when cfg.read_window == 0). RW = ring of
     # outstanding GLOBAL read ops; global slot numbering is s*G + g.
     acc_max_slot: jnp.ndarray  # [A, G] max per-group slot this acceptor voted
@@ -214,6 +271,20 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         retired=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        leader_alive=jnp.ones((cfg.num_leader_candidates, G), bool),
+        heartbeat_miss=jnp.zeros((G,), jnp.int32),
+        elections=jnp.zeros((), jnp.int32),
+        recon_phase=jnp.zeros((G,), jnp.int32),
+        config_epoch=jnp.zeros((G,), jnp.int32),
+        mm_epoch=jnp.zeros((cfg.num_matchmakers, G), jnp.int32),
+        matcha_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
+        matchb_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
+        rc_p1a_arrival=jnp.full((A, G), INF, jnp.int32),
+        rc_p1b_arrival=jnp.full((A, G), INF, jnp.int32),
+        gc_watermark=jnp.full((G,), -1, jnp.int32),
+        old_live=jnp.zeros((G,), bool),
+        reconfigs=jnp.zeros((), jnp.int32),
+        configs_gcd=jnp.zeros((), jnp.int32),
         acc_max_slot=jnp.full((A, G), -1, jnp.int32),
         max_chosen_global=jnp.full((), -1, jnp.int32),
         client_watermark=jnp.full((), -1, jnp.int32),
@@ -246,7 +317,7 @@ def tick(
     # One random-bits sweep per shape feeds every sample via disjoint bit
     # fields (see common.bit_latency) — drawing separate randint/uniform
     # arrays per message kind made PRNG generation dominate the tick.
-    k3, k2, k_extra, k_read = jax.random.split(key, 4)
+    k3, k2, k_extra, k_read, k_fail = jax.random.split(key, 5)
     bits3 = jax.random.bits(k3, (A, G, W))  # [0:8) p2b lat, [8:16) p2a lat,
     #                                         [16:24) retry lat, [24:32) p2b drop
     bits2 = jax.random.bits(k2, (G, W))  # [0:8) replica lat, [8:16) thrifty
@@ -269,6 +340,162 @@ def tick(
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
 
+    # ---- 0. Device-side failure detection + election (Participant.scala:
+    # 72-209 heartbeat silence detection; ClassicRoundRobin round
+    # ownership: round r belongs to candidate r % C). Everything below —
+    # deaths, revivals, miss counters, the election, and the phase-1
+    # repair — happens inside the compiled tick; no host involvement.
+    leader_round = state.leader_round
+    slot_value_in = state.slot_value
+    p2a_in = state.p2a_arrival
+    p2b_in = state.p2b_arrival
+    last_send_in = state.last_send
+    leader_alive = state.leader_alive
+    heartbeat_miss = state.heartbeat_miss
+    elections = state.elections
+    owner_alive_now = None  # None = feature off, everyone alive
+    if cfg.fail_rate > 0.0 or cfg.device_elections:
+        C = cfg.num_leader_candidates
+        if cfg.fail_rate > 0.0:
+            bits_f = jax.random.bits(k_fail, (C, G))  # [0:8) death, [8:16) rev
+            dies = ~bit_delivered(bits_f, 0, cfg.fail_rate)
+            revives = ~bit_delivered(bits_f, 8, cfg.revive_rate)
+            leader_alive = jnp.where(leader_alive, ~dies, revives)
+        owner = leader_round % C
+        owner_alive = jnp.take_along_axis(leader_alive, owner[None, :], axis=0)[0]
+        heartbeat_miss = jnp.where(owner_alive, 0, heartbeat_miss + 1)
+        # Next alive candidate in round-robin order (C is tiny and
+        # static: an unrolled first-match scan).
+        delta = jnp.zeros((G,), jnp.int32)
+        found = jnp.zeros((G,), bool)
+        for d in range(1, C + 1):
+            idx = (leader_round + d) % C
+            cand = jnp.take_along_axis(leader_alive, idx[None, :], axis=0)[0]
+            delta = jnp.where(~found & cand, d, delta)
+            found = found | cand
+        elect = (heartbeat_miss >= cfg.heartbeat_timeout) & found
+        leader_round = leader_round + jnp.where(elect, delta, 0)
+        heartbeat_miss = jnp.where(elect, 0, heartbeat_miss)
+        elections = elections + jnp.sum(elect)
+        # Phase-1 repair for elected groups. Latency reuses the retry bit
+        # field: repair and retry are both Phase2a re-sends and a repaired
+        # slot (last_send = t) cannot also time out this tick.
+        retry_lat_bits = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+        slot_value_in, p2a_in, p2b_in, last_send_in = _phase1_repair(
+            state, elect, t, retry_lat_bits
+        )
+        # Post-election owner liveness gates proposals and retries below
+        # (a dead leader proposes nothing; Leader.scala inactive state).
+        owner2 = leader_round % C
+        owner_alive_now = jnp.take_along_axis(
+            leader_alive, owner2[None, :], axis=0
+        )[0]
+
+    # ---- 0.5 Matchmaker reconfiguration (Matchmaker.scala handleMatchA,
+    # Reconfigurer.scala; see the config docstring). All message
+    # exchanges are modeled arrivals inside this compiled tick.
+    acc_round_in = state.acc_round
+    vote_round_in = state.vote_round
+    vote_value_in = state.vote_value
+    recon_phase = state.recon_phase
+    config_epoch = state.config_epoch
+    mm_epoch = state.mm_epoch
+    matcha_arrival = state.matcha_arrival
+    matchb_arrival = state.matchb_arrival
+    rc_p1a = state.rc_p1a_arrival
+    rc_p1b = state.rc_p1b_arrival
+    gc_watermark = state.gc_watermark
+    old_live = state.old_live
+    reconfigs = state.reconfigs
+    configs_gcd = state.configs_gcd
+    if cfg.reconfigure_every:
+        M = cfg.num_matchmakers
+        k_rc = jax.random.fold_in(k_fail, 1)
+        bits_m = jax.random.bits(k_rc, (M, G))  # [0:8) MatchA, [8:16) MatchB
+        bits_a2 = jax.random.bits(
+            jax.random.fold_in(k_rc, 1), (A, G)
+        )  # [0:8) Phase1a lat, [8:16) Phase1b lat
+        ma_lat = bit_latency(bits_m, 0, cfg.lat_min, cfg.lat_max)
+        mb_lat = bit_latency(bits_m, 8, cfg.lat_min, cfg.lat_max)
+        p1a_lat = bit_latency(bits_a2, 0, cfg.lat_min, cfg.lat_max)
+        p1b_lat = bit_latency(bits_a2, 8, cfg.lat_min, cfg.lat_max)
+
+        # (a) On schedule, the leader matchmakes the next configuration:
+        # MatchA(epoch+1) to every matchmaker.
+        due = (
+            (recon_phase == RC_NORMAL)
+            & ((t % cfg.reconfigure_every) == 0)
+            & (t > 0)
+        )
+        matcha_arrival = jnp.where(due[None, :], t + ma_lat, matcha_arrival)
+        recon_phase = jnp.where(due, RC_MATCHING, recon_phase)
+
+        # (b) Matchmakers process MatchA: record the new epoch, reply
+        # MatchB carrying the prior configuration (Matchmaker.scala
+        # handleMatchA stores the config bound to the round).
+        ma_now = matcha_arrival == t
+        mm_epoch = jnp.where(ma_now, config_epoch[None, :] + 1, mm_epoch)
+        matchb_arrival = jnp.where(ma_now, t + mb_lat, matchb_arrival)
+        matcha_arrival = jnp.where(ma_now, INF, matcha_arrival)
+
+        # (c) An f+1 MatchB quorum starts phase 1 against the OLD
+        # configuration (Reconfigurer: the new config is bound to round
+        # i+1; the old one must be drained first).
+        nmb = jnp.sum(matchb_arrival <= t, axis=0)
+        mm_done = (recon_phase == RC_MATCHING) & (nmb >= f + 1)
+        matchb_arrival = jnp.where(mm_done[None, :], INF, matchb_arrival)
+        rc_p1a = jnp.where(mm_done[None, :], t + p1a_lat, rc_p1a)
+        recon_phase = jnp.where(mm_done, RC_PHASE1, recon_phase)
+
+        # (d) Old acceptors process Phase1a: PROMISE the next round —
+        # they stop voting in the old one (the safety half of phase 1) —
+        # and reply with their vote state.
+        p1a_now = rc_p1a == t
+        acc_round_in = jnp.maximum(
+            acc_round_in,
+            jnp.where(p1a_now, leader_round[None, :] + 1, -1),
+        )
+        rc_p1b = jnp.where(p1a_now, t + p1b_lat, rc_p1b)
+        rc_p1a = jnp.where(p1a_now, INF, rc_p1a)
+
+        # (e) The first f+1 Phase1bs form a TRUE read quorum: safe values
+        # come from the learned acceptors only (they intersect every f+1
+        # write quorum, so every chosen value is visible). Install the
+        # new configuration: bump round+epoch, re-propose in-flight slots
+        # to the fresh acceptors, clear their (never-cast) votes, and arm
+        # the GC watermark.
+        learned = rc_p1b <= t  # [A, G]
+        np1b = jnp.sum(learned, axis=0)
+        p1_done = (recon_phase == RC_PHASE1) & (np1b >= f + 1)
+        rc_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+        (
+            slot_value_in,
+            p2a_in,
+            p2b_in,
+            last_send_in,
+        ) = _phase1_repair_arrays(
+            status, vote_round_in, vote_value_in, slot_value_in,
+            p2a_in, p2b_in, last_send_in, p1_done, t, rc_lat,
+            learned=learned,
+        )
+        in_flight_rc = (status == PROPOSED) & p1_done[:, None]  # [G, W]
+        vote_round_in = jnp.where(in_flight_rc[None, :, :], -1, vote_round_in)
+        vote_value_in = jnp.where(
+            in_flight_rc[None, :, :], NO_VALUE, vote_value_in
+        )
+        acc_round_in = jnp.where(
+            p1_done[None, :], leader_round[None, :] + 1, acc_round_in
+        )
+        leader_round = leader_round + p1_done.astype(jnp.int32)
+        config_epoch = config_epoch + p1_done
+        reconfigs = reconfigs + jnp.sum(p1_done)
+        rc_p1b = jnp.where(p1_done[None, :], INF, rc_p1b)
+        # The old configuration survives until every slot it may have
+        # chosen retires (the Reconfigurer GC pipeline).
+        gc_watermark = jnp.where(p1_done, state.next_slot, gc_watermark)
+        old_live = old_live | p1_done
+        recon_phase = jnp.where(p1_done, RC_NORMAL, recon_phase)
+
     # ---- 1+2. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
     # Acceptor.scala:184-220): vote iff the message round >= promised round;
     # on vote, promise the round and schedule the Phase2b arrival. Then
@@ -287,13 +514,13 @@ def tick(
             new_acc_round,
             nvotes,
         ) = ops.fused_vote_quorum(
-            state.p2a_arrival,
-            state.acc_round,
-            state.leader_round,
-            state.slot_value,
-            state.vote_round,
-            state.vote_value,
-            state.p2b_arrival,
+            p2a_in,
+            acc_round_in,
+            leader_round,
+            slot_value_in,
+            vote_round_in,
+            vote_value_in,
+            p2b_in,
             p2b_lat,
             p2b_delivered,
             t,
@@ -303,34 +530,34 @@ def tick(
             interpret=jax.default_backend() not in ("tpu", "axon"),
         )
     else:
-        arrived = state.p2a_arrival == t  # [A, G, W]
-        msg_round = state.leader_round[None, :, None]  # one round in flight
-        may_vote = arrived & (msg_round >= state.acc_round[:, :, None])
+        arrived = p2a_in == t  # [A, G, W]
+        msg_round = leader_round[None, :, None]  # one round in flight
+        may_vote = arrived & (msg_round >= acc_round_in[:, :, None])
         new_acc_round = jnp.maximum(
-            state.acc_round, jnp.max(jnp.where(may_vote, msg_round, -1), axis=2)
+            acc_round_in, jnp.max(jnp.where(may_vote, msg_round, -1), axis=2)
         )
-        vote_round = jnp.where(may_vote, msg_round, state.vote_round)
+        vote_round = jnp.where(may_vote, msg_round, vote_round_in)
         # The vote carries the slot's currently proposed value
         # (Acceptor.scala:184-220 votes for the Phase2a's value).
         vote_value = jnp.where(
-            may_vote, state.slot_value[None, :, :], state.vote_value
+            may_vote, slot_value_in[None, :, :], vote_value_in
         )
         p2b_arrival = jnp.where(
             may_vote & p2b_delivered,
-            jnp.minimum(state.p2b_arrival, t + p2b_lat),
-            state.p2b_arrival,
+            jnp.minimum(p2b_in, t + p2b_lat),
+            p2b_in,
         )
         votes_in = (p2b_arrival <= t) & (
-            vote_round == state.leader_round[None, :, None]
+            vote_round == leader_round[None, :, None]
         )
         nvotes = jnp.sum(votes_in, axis=0)  # [G, W]
 
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
     chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
     chosen_round = jnp.where(
-        newly_chosen, state.leader_round[:, None], state.chosen_round
+        newly_chosen, leader_round[:, None], state.chosen_round
     )
-    chosen_value = jnp.where(newly_chosen, state.slot_value, state.chosen_value)
+    chosen_value = jnp.where(newly_chosen, slot_value_in, state.chosen_value)
     replica_arrival = jnp.where(
         newly_chosen, t + rep_lat, state.replica_arrival
     )
@@ -362,15 +589,22 @@ def tick(
     executed = state.executed + n_retire
     retired_total = state.retired + jnp.sum(n_retire)
 
+    if cfg.reconfigure_every:
+        # GC: once the executed watermark passes every slot the old
+        # configuration may have chosen, it retires (Reconfigurer GC).
+        gc_now = old_live & (head >= gc_watermark)
+        configs_gcd = configs_gcd + jnp.sum(gc_now)
+        old_live = old_live & ~gc_now
+
     status = jnp.where(retire_mask, EMPTY, status)
-    slot_value = jnp.where(retire_mask, NO_VALUE, state.slot_value)
+    slot_value = jnp.where(retire_mask, NO_VALUE, slot_value_in)
     chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
     chosen_round = jnp.where(retire_mask, -1, chosen_round)
     chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
     replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
     propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
-    last_send = jnp.where(retire_mask, INF, state.last_send)
-    p2a_arrival = jnp.where(retire_mask[None, :, :], INF, state.p2a_arrival)
+    last_send = jnp.where(retire_mask, INF, last_send_in)
+    p2a_arrival = jnp.where(retire_mask[None, :, :], INF, p2a_in)
     p2b_arrival = jnp.where(retire_mask[None, :, :], INF, p2b_arrival)
     vote_round = jnp.where(retire_mask[None, :, :], -1, vote_round)
     vote_value = jnp.where(retire_mask[None, :, :], NO_VALUE, vote_value)
@@ -385,6 +619,14 @@ def tick(
             count,
             jnp.maximum(cfg.max_slots_per_group - state.next_slot, 0),
         )
+    if owner_alive_now is not None:
+        # A dead leader proposes nothing (Leader.scala inactive state);
+        # the group resumes once an election installs a live owner.
+        count = jnp.where(owner_alive_now, count, 0)
+    if cfg.reconfigure_every:
+        # A reconfiguring group stalls new proposals until the new
+        # configuration is installed (the churn throughput dip).
+        count = jnp.where(recon_phase == RC_NORMAL, count, 0)
     delta = (w_iota[None, :] - state.next_slot[:, None]) % W  # [G, W]
     is_new = delta < count[:, None]  # [G, W]
     next_slot = state.next_slot + count
@@ -422,6 +664,11 @@ def tick(
     # including acceptors that already voted: their Phase2b may have been
     # the dropped message, and re-voting (step 1) re-samples its delivery.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
+    if owner_alive_now is not None:
+        timed_out = timed_out & owner_alive_now[:, None]  # dead: no resends
+    if cfg.reconfigure_every:
+        # No old-round resends while phase 1 drains the old config.
+        timed_out = timed_out & (recon_phase == RC_NORMAL)[:, None]
     resend = timed_out[None, :, :]
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
@@ -462,8 +709,13 @@ def tick(
         # acceptor's maxVotedSlot (Acceptor.scala:222-237 serves it from
         # vote state). Votes happened against the PRE-retire ring —
         # ord_of_pos from step 3 is exactly that (it uses state.head).
-        may_vote_r = (state.p2a_arrival == t) & (
-            state.leader_round[None, :, None] >= state.acc_round[:, :, None]
+        # NOTE: under use_pallas this recomputes the vote predicate
+        # outside the kernel (one extra HBM pass over p2a_arrival when
+        # reads are on); folding acc_max_slot into the kernel outputs
+        # would restore the single-pass property — XLA-path runs (the
+        # production path here) fuse this with step 3 anyway.
+        may_vote_r = (p2a_in == t) & (
+            leader_round[None, :, None] >= acc_round_in[:, :, None]
         )
         slot_of_pos = state.head[:, None] + ord_of_pos  # [G, W] per-group slot
         acc_max_slot = jnp.maximum(
@@ -567,7 +819,7 @@ def tick(
             read_status = jnp.where(is_issue, R_BOUND, read_status)
 
     return BatchedMultiPaxosState(
-        leader_round=state.leader_round,
+        leader_round=leader_round,
         next_slot=next_slot,
         head=head,
         status=status,
@@ -588,6 +840,20 @@ def tick(
         retired=retired_total,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        leader_alive=leader_alive,
+        heartbeat_miss=heartbeat_miss,
+        elections=elections,
+        recon_phase=recon_phase,
+        config_epoch=config_epoch,
+        mm_epoch=mm_epoch,
+        matcha_arrival=matcha_arrival,
+        matchb_arrival=matchb_arrival,
+        rc_p1a_arrival=rc_p1a,
+        rc_p1b_arrival=rc_p1b,
+        gc_watermark=gc_watermark,
+        old_live=old_live,
+        reconfigs=reconfigs,
+        configs_gcd=configs_gcd,
         acc_max_slot=acc_max_slot,
         max_chosen_global=max_chosen_global,
         client_watermark=client_watermark,
@@ -606,52 +872,95 @@ def tick(
     )
 
 
+def _phase1_repair_arrays(
+    status: jnp.ndarray,  # [G, W]
+    vote_round: jnp.ndarray,  # [A, G, W]
+    vote_value: jnp.ndarray,  # [A, G, W]
+    slot_value: jnp.ndarray,  # [G, W]
+    p2a_arrival: jnp.ndarray,  # [A, G, W]
+    p2b_arrival: jnp.ndarray,  # [A, G, W]
+    last_send: jnp.ndarray,  # [G, W]
+    mask: jnp.ndarray,  # [G] bool: groups whose new leader repairs now
+    t: jnp.ndarray,
+    lat: jnp.ndarray,  # [A, G, W] Phase2a re-send latencies
+    learned=None,  # [A, G] bool: acceptors whose Phase1b the leader HAS
+):
+    """Masked phase-1 log repair (startPhase1, Leader.scala:409-459): for
+    every in-flight slot of a masked group, adopt the safe value and
+    re-propose it to the full group in the (already bumped) new round.
+
+    With ``learned=None`` phase 1 is an oracle read of every acceptor —
+    a superset of any f+1 read quorum, so every possibly-chosen value is
+    visible (the host leader_change / election model). With a ``learned``
+    mask, only the acceptors whose Phase1b actually arrived contribute —
+    a TRUE read quorum (the Matchmaker path); the caller must guarantee
+    ``learned`` covers >= f+1 acceptors per masked group, which
+    intersects every f+1 write quorum, so every chosen value is still
+    seen (Leader.scala:314-329 safeValue). In-flight slots with no
+    visible votes are re-proposed as noops (Leader.scala:541-575).
+
+    Returns ``(slot_value, p2a_arrival, p2b_arrival, last_send)``."""
+    in_flight = (status == PROPOSED) & mask[:, None]  # [G, W]
+    vr = (
+        vote_round
+        if learned is None
+        else jnp.where(learned[:, :, None], vote_round, -1)
+    )
+    # safeValue: per slot, the value of the max-round visible vote (all
+    # votes in one round carry the same value, so any argmax tie-break is
+    # safe).
+    best = jnp.argmax(vr, axis=0)  # vote_round is -1 when unvoted
+    voted_value = jnp.take_along_axis(vote_value, best[None, :, :], axis=0)[0]
+    any_vote = jnp.any(vr >= 0, axis=0)  # [G, W]
+    safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
+    slot_value = jnp.where(in_flight, safe_value, slot_value)
+    p2a_arrival = jnp.where(in_flight[None, :, :], t + lat, p2a_arrival)
+    # Clear stale Phase2bs of the in-flight slots: old-round votes no
+    # longer count, and keeping their arrival ticks would let a re-vote in
+    # the new round piggyback on a PAST arrival via the jnp.minimum dedup
+    # in tick step 1 (counting the same tick it is cast, biasing commit
+    # latency low).
+    p2b_arrival = jnp.where(in_flight[None, :, :], INF, p2b_arrival)
+    last_send = jnp.where(in_flight, t, last_send)
+    return slot_value, p2a_arrival, p2b_arrival, last_send
+
+
+def _phase1_repair(
+    state: BatchedMultiPaxosState,
+    mask: jnp.ndarray,
+    t: jnp.ndarray,
+    lat: jnp.ndarray,
+):
+    return _phase1_repair_arrays(
+        state.status, state.vote_round, state.vote_value, state.slot_value,
+        state.p2a_arrival, state.p2b_arrival, state.last_send, mask, t, lat,
+    )
+
+
 def leader_change(
     cfg: BatchedMultiPaxosConfig,
     state: BatchedMultiPaxosState,
     t: jnp.ndarray,
     key: jnp.ndarray,
 ) -> BatchedMultiPaxosState:
-    """A new leader takes over in a higher round (Leader.leaderChange +
-    startPhase1, Leader.scala:409-459): bump the round, run phase-1 log
-    repair, and re-propose every in-flight slot in the new round to the
-    full group.
-
-    Phase 1 is modeled synchronously: the new leader reads every
-    acceptor's (vote_round, vote_value) — a superset of any f+1 read
-    quorum, so every possibly-chosen value is visible — and per slot
-    adopts the value of the maximum vote round as an argmax reduction
-    over the acceptor axis (safeValue, Leader.scala:314-329). In-flight
-    slots with no votes anywhere are re-proposed as noops
-    (Leader.scala:541-575 proposes Noop for unvoted repair slots)."""
+    """Host-injected leader takeover (Leader.leaderChange + startPhase1,
+    Leader.scala:409-459): bump every group's round and run phase-1 log
+    repair via :func:`_phase1_repair`. The device-side analog — failure
+    injection, heartbeat-miss detection, and election — runs inside
+    ``tick`` when ``cfg.fail_rate > 0``; this host API remains for tests
+    and crafted cross-validation scenarios."""
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
-    new_round = state.leader_round + 1
-    in_flight = state.status == PROPOSED
-    # safeValue: per slot, the value of the max-round vote (all votes in
-    # one round carry the same value, so any argmax tie-break is safe).
-    has_vote = state.vote_round >= 0  # [A, G, W]
-    best = jnp.argmax(state.vote_round, axis=0)  # vote_round is -1 when unvoted
-    voted_value = jnp.take_along_axis(
-        state.vote_value, best[None, :, :], axis=0
-    )[0]
-    any_vote = jnp.any(has_vote, axis=0)  # [G, W]
-    safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
-    slot_value = jnp.where(in_flight, safe_value, state.slot_value)
     lat = sample_latency(cfg.lat_min, cfg.lat_max, key, (A, G, W))
-    p2a_arrival = jnp.where(in_flight[None, :, :], t + lat, state.p2a_arrival)
-    # Clear stale Phase2bs of the in-flight slots: old-round votes no
-    # longer count, and keeping their arrival ticks would let a re-vote in
-    # the new round piggyback on a PAST arrival via the jnp.minimum dedup
-    # in tick step 1 (counting the same tick it is cast, biasing commit
-    # latency low).
-    p2b_arrival = jnp.where(in_flight[None, :, :], INF, state.p2b_arrival)
+    slot_value, p2a_arrival, p2b_arrival, last_send = _phase1_repair(
+        state, jnp.ones((G,), bool), t, lat
+    )
     return dataclasses.replace(
         state,
-        leader_round=new_round,
+        leader_round=state.leader_round + 1,
         slot_value=slot_value,
         p2a_arrival=p2a_arrival,
         p2b_arrival=p2b_arrival,
-        last_send=jnp.where(in_flight, t, state.last_send),
+        last_send=last_send,
     )
 
 
@@ -767,6 +1076,30 @@ def check_invariants(
     slot_horizon_ok = jnp.max(state.head) < jnp.int32(0x7FFFFFFF) // jnp.int32(
         max(cfg.num_groups, 1)
     )
+    # Matchmaker bookkeeping: phases stay in range, every live old config
+    # has an armed GC watermark, and per-group epochs sum to the global
+    # reconfiguration counter. Trivially true when the feature is off.
+    recon_ok = jnp.all(
+        (state.recon_phase >= RC_NORMAL) & (state.recon_phase <= RC_PHASE1)
+    )
+    rc_books_ok = (jnp.sum(state.config_epoch) == state.reconfigs) & jnp.all(
+        ~state.old_live | (state.gc_watermark >= 0)
+    )
+    # Matchmakers record epochs monotonically, never ahead of the one
+    # reconfiguration that may be in flight; once a group is back in
+    # RC_NORMAL, an f+1 matchmaker quorum knows its current epoch (the
+    # Matchmaker.scala:handleMatchA guarantee that lets the NEXT
+    # reconfigurer learn the configuration).
+    mm_ok = jnp.all(
+        state.mm_epoch <= state.config_epoch[None, :] + 1
+    ) & jnp.all(
+        jnp.where(
+            state.recon_phase == RC_NORMAL,
+            jnp.sum(state.mm_epoch >= state.config_epoch[None, :], axis=0)
+            >= jnp.where(state.config_epoch > 0, f + 1, 0),
+            True,
+        )
+    )
     return {
         "quorum_ok": quorum_ok,
         "window_ok": window_ok,
@@ -777,4 +1110,7 @@ def check_invariants(
         "read_lin_ok": read_lin_ok,
         "read_ring_ok": read_ring_ok,
         "slot_horizon_ok": slot_horizon_ok,
+        "recon_ok": recon_ok,
+        "rc_books_ok": rc_books_ok,
+        "mm_ok": mm_ok,
     }

@@ -118,13 +118,27 @@ class TpuSimTransport:
             "committed": committed,
             "executed": int(self.state.retired),
             "commit_latency_mean_ticks": (
-                float(self.state.lat_sum) / committed if committed else float("nan")
+                float(self.state.lat_sum) / committed if committed else -1.0
             ),
             "commit_latency_p50_ticks": p50,
             "commit_latency_p99_ticks": p99,
             "round": int(jax.device_get(self.state.leader_round).max()),
             "num_acceptors": self.config.num_acceptors,
         }
+        if self.config.fail_rate > 0.0 or self.config.device_elections:
+            out["elections"] = int(self.state.elections)
+            out["alive_leaders"] = int(
+                jax.device_get(self.state.leader_alive).sum()
+            )
+        if self.config.reconfigure_every:
+            out["reconfigurations"] = int(self.state.reconfigs)
+            out["old_configs_gcd"] = int(self.state.configs_gcd)
+            out["old_configs_live"] = int(
+                jax.device_get(self.state.old_live).sum()
+            )
+            out["config_epoch_max"] = int(
+                jax.device_get(self.state.config_epoch).max()
+            )
         if self.config.reads_per_tick:
             reads = int(self.state.reads_done)
             rhist = jax.device_get(self.state.read_lat_hist)
@@ -132,7 +146,7 @@ class TpuSimTransport:
             out["reads_done"] = reads
             out["read_mode"] = self.config.read_mode
             out["read_latency_mean_ticks"] = (
-                float(self.state.read_lat_sum) / reads if reads else float("nan")
+                float(self.state.read_lat_sum) / reads if reads else -1.0
             )
             out["read_latency_p50_ticks"] = (
                 int((rcum >= max(1, (reads + 1) // 2)).argmax()) if reads else -1

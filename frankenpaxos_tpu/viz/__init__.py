@@ -86,7 +86,16 @@ class Stepper:
 
     def fire(self, i: int) -> None:
         timer = self.transport.running_timers()[i]
-        self.transport.trigger_timer(timer.address, timer.name())
+        # The i-th running timer may share (address, name) with earlier
+        # ones; fire THAT instance, not the first name match.
+        occurrence = sum(
+            1
+            for t in self.transport.running_timers()[:i]
+            if t.address == timer.address and t.name() == timer.name()
+        )
+        self.transport.trigger_timer(
+            timer.address, timer.name(), occurrence=occurrence
+        )
 
     def partition(self, address) -> None:
         self.transport.partition_actor(self._resolve_actor(address).address)

@@ -60,6 +60,7 @@ _PAGE = """<!DOCTYPE html>
  <div class="row">
   <button onclick="api('op')">client op</button>
   <button onclick="api('deliver_all')">deliver all</button>
+  <button onclick="exportTest()">export as test</button>
  </div>
  <h3>in-flight messages</h3><div id="msgs"></div>
  <h3>timers</h3><div id="timers"></div>
@@ -127,6 +128,12 @@ async function refresh() {
   }
 }
 function select(name) { selected = name; refresh(); }
+async function exportTest() {
+  const r = await (await fetch('/api/export', {method: 'POST'})).json();
+  const esc = r.code.replace(/&/g, '&amp;').replace(/</g, '&lt;');
+  document.getElementById('state').innerHTML =
+    '<b>replay test (copy into tests/)</b><pre>' + esc + '</pre>';
+}
 refresh();
 setInterval(refresh, 1000);
 </script></body></html>
@@ -143,6 +150,7 @@ class VizServer:
         self.client = client
         self.issue = issue
         self.op_counter = 0
+        self.trace: list = []  # replayable code lines (export_test)
 
     def _message_tokens(self):
         """Stable per-message tokens: object identity plus an occurrence
@@ -219,26 +227,89 @@ class VizServer:
             "timers": timers,
         }
 
+    @staticmethod
+    def _addr_expr(a) -> str:
+        # Viz clusters built from the deployment registry use HostPort
+        # role addresses on the SimTransport; sessions may mix both kinds.
+        if hasattr(a, "name"):
+            return f"SimAddress({a.name!r})"
+        return f"HostPort({a.host!r}, {a.port!r})"
+
+    def _msg_expr(self, i: int) -> str:
+        m = self.stepper.transport.messages[i]
+        return (
+            f"QueuedMessage({self._addr_expr(m.src)}, "
+            f"{self._addr_expr(m.dst)}, {m.data!r})"
+        )
+
+    def export_test(self, test_name: str = "test_replay") -> str:
+        """A runnable pytest function replaying this browser session —
+        the JsTransport.scala:260-298 export-as-unit-test capability,
+        from the web UI. Setup is real code: build_cluster is
+        deterministic, so the replayed deliveries match."""
+        lines = [
+            f"def {test_name}():",
+            "    from frankenpaxos_tpu.core import (",
+            "        HostPort, QueuedMessage, SimAddress,",
+            "    )",
+            "    from frankenpaxos_tpu.viz.web import build_cluster",
+            f"    t, client, issue = build_cluster({self.protocol!r})",
+        ]
+        lines += [f"    {line}" for line in self.trace]
+        lines.append("    # assert on final actor/client state here")
+        return "\n".join(lines)
+
     def handle(self, path: str, params: dict) -> Optional[dict]:
         s = self.stepper
         if path == "state":
             return self.snapshot()
+        if path == "export":
+            return {"code": self.export_test(params.get("name", "test_replay"))}
         if path == "deliver":
-            s.deliver(self._resolve_message(params["tok"]))
+            i = self._resolve_message(params["tok"])
+            self.trace.append(f"t.deliver_message({self._msg_expr(i)})")
+            s.deliver(i)
         elif path == "drop":
-            s.drop(self._resolve_message(params["tok"]))
+            i = self._resolve_message(params["tok"])
+            self.trace.append(f"t.drop_message({self._msg_expr(i)})")
+            s.drop(i)
         elif path == "duplicate":
-            s.duplicate(self._resolve_message(params["tok"]))
+            i = self._resolve_message(params["tok"])
+            self.trace.append(f"t.duplicate_message({self._msg_expr(i)})")
+            s.duplicate(i)
         elif path == "fire":
-            s.fire(self._resolve_timer(params["tok"]))
+            i = self._resolve_timer(params["tok"])
+            timer = s.transport.running_timers()[i]
+            occurrence = sum(
+                1
+                for t_ in s.transport.running_timers()[:i]
+                if t_.address == timer.address and t_.name() == timer.name()
+            )
+            self.trace.append(
+                f"t.trigger_timer({self._addr_expr(timer.address)}, "
+                f"{timer.name()!r}, occurrence={occurrence})"
+            )
+            s.fire(i)
         elif path == "partition":
+            addr = s._resolve_actor(params["addr"]).address
+            self.trace.append(
+                f"t.partition_actor({self._addr_expr(addr)})"
+            )
             s.partition(params["addr"])
         elif path == "unpartition":
+            addr = s._resolve_actor(params["addr"]).address
+            self.trace.append(
+                f"t.unpartition_actor({self._addr_expr(addr)})"
+            )
             s.unpartition(params["addr"])
         elif path == "deliver_all":
+            self.trace.append(
+                "while t.messages: t.deliver_message(t.messages[0])"
+            )
             s.deliver_all()
         elif path == "op":
             if self.issue is not None:
+                self.trace.append(f"issue(client, 0, {self.op_counter})")
                 self.issue(self.client, 0, self.op_counter)
                 self.op_counter += 1
         else:

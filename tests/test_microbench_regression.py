@@ -1,0 +1,39 @@
+"""Regression teeth for the microbenchmark suite: every hot path must
+stay within a generous factor of the baselines pinned in
+``results/microbench_baseline.json`` (the jvm/src/bench scalameter
+culture: committed numbers, not just a runnable harness). The 5x margin
+absorbs CI noise; a real algorithmic regression (e.g. the round-1
+O(history) dependency-set bug) blows far past it."""
+
+import json
+import os
+
+import pytest
+
+from frankenpaxos_tpu.harness import microbench
+
+_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "results", "microbench_baseline.json",
+)
+MARGIN = 5.0
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    with open(_BASELINE_PATH) as f:
+        return json.load(f)["ops_per_sec"]
+
+
+@pytest.mark.parametrize("bench", sorted(microbench.BENCHES))
+def test_hot_paths_within_margin_of_pinned_baseline(bench, baseline):
+    rows = microbench.BENCHES[bench]()
+    assert rows, f"bench {bench} produced no rows"
+    for row in rows:
+        key = f"{row['name']}.{row['case']}"
+        assert key in baseline, f"unpinned microbench case {key}"
+        floor = baseline[key] / MARGIN
+        assert row["ops_per_sec"] >= floor, (
+            f"{key}: {row['ops_per_sec']:.0f} ops/s is below the "
+            f"regression floor {floor:.0f} (pinned {baseline[key]})"
+        )

@@ -25,7 +25,8 @@ from frankenpaxos_tpu.statemachine import ReadableAppendLog
 
 
 class ScalogCluster:
-    def __init__(self, seed=0, f=1, num_shards=2, num_clients=2):
+    def __init__(self, seed=0, f=1, num_shards=2, num_clients=2,
+                 push_size=1, cuts_per_proposal=1):
         logger = FakeLogger(LogLevel.FATAL)
         self.transport = SimTransport(logger)
         t = self.transport
@@ -50,13 +51,15 @@ class ScalogCluster:
         self.servers = [
             sc.ScServer(
                 a, t, log(), self.config,
-                sc.ScServerOptions(push_size=1), seed=seed + 100 + i,
+                sc.ScServerOptions(push_size=push_size), seed=seed + 100 + i,
             )
             for i, a in enumerate(self.config.flat_servers)
         ]
         self.aggregator = sc.ScAggregator(
             self.config.aggregator_address, t, log(), self.config,
-            sc.ScAggregatorOptions(num_shard_cuts_per_proposal=1),
+            sc.ScAggregatorOptions(
+                num_shard_cuts_per_proposal=cuts_per_proposal
+            ),
         )
         self.leaders = [
             sc.ScLeader(a, t, log(), self.config, seed=seed + 200 + i)

@@ -237,3 +237,192 @@ def test_cross_validation_leader_change_repair():
     expected = [0, NOOP, 2, NOOP, NOOP, 5]
     assert batched_symbols(log, n) == expected
     assert sim_symbols(system, n) == expected
+
+
+# -- Mencius: batched model vs per-actor vanillamencius -----------------------
+
+
+def test_cross_validation_mencius_skips():
+    """Aligned skip scenario (vanillamencius Server._maybe_skip_to /
+    Server.scala skip semantics): one active server, the others idle.
+    Both executions must produce the SAME global log — real commands on
+    the active stripe's slots, noop skips filling the idle stripes up to
+    the watermark — and the same executed watermark."""
+    import frankenpaxos_tpu.tpu.mencius_batched as mb
+    from frankenpaxos_tpu.protocols import vanillamencius as vm
+    from test_vanillamencius import drain as vm_drain, make as vm_make
+
+    n_writes = 4
+    L = 3  # stripes / servers; active index 2
+
+    # ---- Per-actor side: all writes routed to server 2.
+    t, config, servers, clients = vm_make(f=1, num_clients=1, seed=9)
+
+    class _Pick2:
+        def randrange(self, n):
+            return 2
+
+    clients[0].rng = _Pick2()
+    promises = []
+    for k in range(n_writes):
+        promises.append(clients[0].propose(k, f"w{k}".encode()))
+        vm_drain(t)
+    assert all(p.done for p in promises)
+    watermark = {s.executed_watermark for s in servers}
+    assert watermark == {n_writes * L}, watermark
+    sim_log = []
+    for slot in range(n_writes * L):
+        entry = servers[0].log.get(slot)
+        assert entry is not None, f"slot {slot} missing"
+        (value,) = entry
+        if value is None:
+            sim_log.append(NOOP)
+        else:
+            sim_log.append(int(value.command[1:]))  # b"w<k>" -> k
+
+    # ---- Batched side: stripes 0,1 idle, stripe 2 active, skip fill at
+    # threshold 1 (the per-actor skip fires on ANY observed gap).
+    cfg = mb.BatchedMenciusConfig(
+        f=1, num_leaders=L, window=16, slots_per_tick=1,
+        num_idle_leaders=2, skip_threshold=1, lat_min=1, lat_max=1,
+        max_slots_per_leader=n_writes,
+    )
+    key = jax.random.PRNGKey(3)
+    state = mb.init_state(cfg)
+    blog = {}
+    t_ = 0
+    for _ in range(30):
+        state = mb.tick(cfg, state, jnp.int32(t_), jax.random.fold_in(key, t_))
+        ct = np.asarray(state.chosen_tick)
+        head = np.asarray(state.head)
+        sv = np.asarray(state.slot_value)
+        for l in range(L):
+            for pos in range(cfg.window):
+                if ct[l, pos] == t_:
+                    o = int(head[l]) + ((pos - int(head[l])) % cfg.window)
+                    blog[o * L + l] = int(sv[l, pos])
+        t_ += 1
+    inv = mb.check_invariants(cfg, state, jnp.int32(t_))
+    assert all(bool(v) for v in inv.values()), inv
+
+    # The batched model idles stripes 0..1 and is active on stripe 2 —
+    # the same ownership layout as the per-actor run. Batched real value
+    # ids are the global slot numbers themselves; translate to write
+    # indices (slot // L) for comparison.
+    assert set(blog.keys()) == set(range(n_writes * L)), sorted(blog)
+    batched_log = [
+        NOOP if blog[s] == mb.NOOP_VALUE else blog[s] // L
+        for s in range(n_writes * L)
+    ]
+    assert batched_log == sim_log, (batched_log, sim_log)
+    assert int(state.executed_global) == n_writes * L
+    assert int(state.committed_real) == n_writes
+    assert int(state.skips) == n_writes * (L - 1)
+
+
+# -- Scalog: batched model vs per-actor cut projection ------------------------
+
+
+def test_cross_validation_scalog_cuts():
+    """Same append stream -> identical cut sequence and identical
+    global-log projection (scalog Server._project / the cut prefix-sum
+    doc). The per-actor cluster runs real messages (appends, backups,
+    ShardInfo, a Paxos round per cut); the batched model is driven with
+    the same per-shard lengths at the same snapshot points."""
+    import frankenpaxos_tpu.tpu.scalog_batched as sb
+    from test_scalog import ScalogCluster
+
+    # Cumulative per-shard lengths at each of the 3 cut points.
+    cum = [(2, 1), (3, 3), (6, 3)]
+
+    # ---- Per-actor side: pinned routing (client k -> shard k's first
+    # server), manual pushes per interval, one combined cut per interval.
+    # cuts_per_proposal=4: one combined proposal per interval, after ALL
+    # four servers (owners AND backups — a cut covers only the
+    # fully-replicated prefix, the element-wise MIN of members' views)
+    # have pushed their ShardInfo.
+    cluster = ScalogCluster(
+        seed=21, num_clients=2, push_size=10**6, cuts_per_proposal=4
+    )
+
+    class _PickFlat:
+        def __init__(self, flat):
+            self.flat = flat
+
+        def randrange(self, n):
+            return self.flat
+
+    cluster.clients[0].rng = _PickFlat(0)  # shard 0, server 0
+    cluster.clients[1].rng = _PickFlat(2)  # shard 1, server 0
+    seqs = [0, 0]
+    prev = (0, 0)
+    for r, target in enumerate(cum):
+        for shard in (0, 1):
+            for _ in range(target[shard] - prev[shard]):
+                cluster.clients[shard].write(
+                    seqs[shard], f"s{shard}-{seqs[shard]}".encode()
+                )
+                seqs[shard] += 1
+        cluster.drain()  # appends + backups settle; no cuts yet
+        for server in cluster.servers:
+            server.push()
+        cluster.drain()  # ShardInfo x4 -> one raw cut -> Paxos -> commit
+        prev = target
+    cuts = [tuple(c) for c in cluster.aggregator.cuts]
+    assert [(c[0], c[2]) for c in cuts] == cum, cuts
+    assert all(c[1] == 0 and c[3] == 0 for c in cuts)  # backups idle
+    replica_log = [
+        bytes(v) for v in cluster.replicas[0].state_machine.log
+    ]
+    assert len(replica_log) == sum(cum[-1])
+
+    # ---- Batched side: inject the same append stream (local_len held to
+    # the same cumulative trajectory), snapshot on the same period.
+    cfg = sb.BatchedScalogConfig(
+        num_shards=2, max_inflight_cuts=4, cut_every=4,
+        appends_per_tick=1, append_jitter=0, lat_min=1, lat_max=1,
+    )
+    key = jax.random.PRNGKey(7)
+    state = sb.init_state(cfg)
+    committed_cuts_seen = []
+    prev_committed = 0
+    for t_ in range(20):
+        interval = min(t_ // 4, len(cum) - 1)
+        want = cum[interval]
+        # The tick's own append adds exactly 1 per shard; pre-set so the
+        # snapshot (and everything after) sees the planned trajectory.
+        state = dataclasses.replace(
+            state,
+            local_len=jnp.asarray([want[0] - 1, want[1] - 1], jnp.int32),
+        )
+        state = sb.tick(cfg, state, jnp.int32(t_), jax.random.fold_in(key, t_))
+        if int(state.committed_cuts) > prev_committed:
+            assert int(state.committed_cuts) == prev_committed + 1
+            committed_cuts_seen.append(
+                tuple(np.asarray(state.last_committed_cut).tolist())
+            )
+            prev_committed += 1
+        if prev_committed == len(cum):
+            break
+    assert committed_cuts_seen == cum, committed_cuts_seen
+    inv = sb.check_invariants(cfg, state, jnp.int32(t_))
+    assert all(bool(v) for v in inv.values()), inv
+    assert int(state.global_len) == sum(cum[-1])
+
+    # ---- Projection: the batched cut prefix-sum arithmetic must place
+    # every per-actor record at exactly the global index the real system
+    # executed it at.
+    predicted = [None] * sum(cum[-1])
+    prev_vec = jnp.zeros((2,), jnp.int32)
+    for cut in committed_cuts_seen:
+        cut_vec = jnp.asarray(cut, jnp.int32)
+        starts, ends = sb.global_indices_of_cut(prev_vec, cut_vec)
+        starts, ends = np.asarray(starts), np.asarray(ends)
+        base = np.asarray(prev_vec)
+        for shard in (0, 1):
+            for j in range(ends[shard] - starts[shard]):
+                predicted[starts[shard] + j] = (
+                    f"s{shard}-{base[shard] + j}".encode()
+                )
+        prev_vec = cut_vec
+    assert predicted == replica_log, (predicted, replica_log)

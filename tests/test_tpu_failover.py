@@ -1,0 +1,203 @@
+"""Device-side failure detection + elections in the batched backend:
+leader deaths, heartbeat-miss detection, round-robin elections, and
+phase-1 repair all happen INSIDE the compiled lax.scan — no host
+injection (SURVEY §2.7 'heartbeat/elections → timer-counter arrays +
+vmapped transitions'; heartbeat/Participant.scala:72-209)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.parallel import make_mesh, run_ticks_sharded, shard_state
+from frankenpaxos_tpu.tpu import (
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    check_invariants,
+    init_state,
+    run_ticks,
+    tick,
+)
+from frankenpaxos_tpu.tpu.multipaxos_batched import INF, NOOP_VALUE, PROPOSED
+
+
+def make(**kw):
+    defaults = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2,
+    )
+    defaults.update(kw)
+    return BatchedMultiPaxosConfig(**defaults)
+
+
+def test_prng_failures_trigger_elections_inside_scan():
+    """A single run_ticks scan with fail_rate > 0 must elect new leaders
+    on-device and keep committing — the whole failure/recovery loop
+    compiles into one XLA program."""
+    cfg = make(fail_rate=0.01, revive_rate=0.1, heartbeat_timeout=4)
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(400)  # ONE compiled scan segment; no host between ticks
+    s = sim.stats()
+    assert s["elections"] > 0, "no device-side elections despite failures"
+    assert s["committed"] > 1000
+    assert s["round"] > 0
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_failover_latency_cost_visible():
+    """Failures must cost throughput (repair + silent windows) but not
+    break liveness: the failing run commits less than the healthy run,
+    and still grows monotonically."""
+    healthy = TpuSimTransport(make(), seed=1)
+    failing = TpuSimTransport(
+        make(fail_rate=0.02, revive_rate=0.1, heartbeat_timeout=4), seed=1
+    )
+    healthy.run(300)
+    failing.run(300)
+    assert 0 < failing.stats()["committed"] < healthy.stats()["committed"]
+    assert all(failing.check_invariants().values())
+
+
+def test_deterministic_kill_elects_and_preserves_voted_value():
+    """Kill group 0's round-0 owner after one acceptor voted: the
+    device-side election must install candidate 1 and repair the slot to
+    the voted value (never a noop, never a lost value)."""
+    cfg = make(
+        num_groups=2, window=8, slots_per_tick=1, lat_min=1, lat_max=1,
+        thrifty=False, retry_timeout=100, max_slots_per_group=1,
+        device_elections=True, heartbeat_timeout=3,
+    )
+    key = jax.random.PRNGKey(2)
+    state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    # Let exactly acceptor 0 of group 0 receive the Phase2a; block others.
+    p2a = np.asarray(state.p2a_arrival).copy()
+    p2a[1:, :, :] = int(INF)
+    p2a[:, 1, :] = int(INF)
+    state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
+    state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
+    assert int(state.committed) == 0
+    voted_value = int(np.asarray(state.vote_value)[0, 0, 0])
+    assert voted_value >= 0
+
+    # Kill candidate 0 (round 0's owner) of BOTH groups.
+    alive = np.asarray(state.leader_alive).copy()
+    alive[0, :] = False
+    state = dataclasses.replace(state, leader_alive=jnp.asarray(alive))
+
+    t = 2
+    for _ in range(30):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    assert int(state.elections) == 2  # one election per group
+    rounds = np.asarray(state.leader_round)
+    assert (rounds == 1).all()  # candidate 1 owns round 1
+    # Group 0's voted slot kept its value; group 1's unvoted slot became
+    # a noop repair.
+    assert int(state.retired) == 2
+    inv = check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+    # The committed value survived: chosen_value was consumed by retire,
+    # so check via the executed latency histogram being non-trivial and
+    # via a fresh run asserting before retirement instead:
+    state2 = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    p2a = np.asarray(state2.p2a_arrival).copy()
+    p2a[1:, :, :] = int(INF)
+    p2a[:, 1, :] = int(INF)
+    state2 = dataclasses.replace(state2, p2a_arrival=jnp.asarray(p2a))
+    state2 = tick(cfg, state2, jnp.int32(1), jax.random.fold_in(key, 1))
+    alive = np.asarray(state2.leader_alive).copy()
+    alive[0, :] = False
+    state2 = dataclasses.replace(
+        state2,
+        leader_alive=jnp.asarray(alive),
+        # Freeze replica delivery so chosen slots stay in the ring.
+        replica_arrival=jnp.full_like(state2.replica_arrival, int(INF)),
+    )
+    t = 2
+    for _ in range(20):
+        state2 = tick(cfg, state2, jnp.int32(t), jax.random.fold_in(key, t))
+        state2 = dataclasses.replace(
+            state2,
+            replica_arrival=jnp.full_like(state2.replica_arrival, int(INF)),
+        )
+        t += 1
+    chosen_value = np.asarray(state2.chosen_value)
+    assert int(chosen_value[0, 0]) == voted_value, "repair lost the voted value"
+    assert int(chosen_value[1, 0]) == NOOP_VALUE  # unvoted -> noop repair
+
+
+def test_all_candidates_dead_stalls_until_revival():
+    cfg = make(
+        num_groups=2, device_elections=True, heartbeat_timeout=3,
+    )
+    sim = TpuSimTransport(cfg, seed=3)
+    sim.run(20)
+    c0 = sim.committed()
+    # Kill every candidate of group 0; group 1 stays healthy.
+    alive = np.asarray(sim.state.leader_alive).copy()
+    alive[:, 0] = False
+    sim.state = dataclasses.replace(sim.state, leader_alive=jnp.asarray(alive))
+    sim.run(60)
+    mid = sim.stats()
+    head_stalled = int(jax.device_get(sim.state.next_slot)[0])
+    assert mid["committed"] > c0  # group 1 alone still commits
+    sim.run(30)
+    assert int(jax.device_get(sim.state.next_slot)[0]) == head_stalled, (
+        "a group with no live leader candidates must not propose"
+    )
+    # Revive candidate 2: election fires, the group resumes.
+    alive = np.asarray(sim.state.leader_alive).copy()
+    alive[2, 0] = True
+    sim.state = dataclasses.replace(sim.state, leader_alive=jnp.asarray(alive))
+    sim.run(40)
+    assert int(jax.device_get(sim.state.next_slot)[0]) > head_stalled
+    assert all(sim.check_invariants().values())
+
+
+def test_failover_with_reads_and_loss():
+    """The full stack in one compiled program: writes under loss, device
+    elections, and linearizable reads — safety invariants (including the
+    read floor) hold throughout."""
+    cfg = make(
+        fail_rate=0.01, revive_rate=0.2, heartbeat_timeout=4,
+        drop_rate=0.1, retry_timeout=6,
+        reads_per_tick=2, read_window=8, read_mode="linearizable",
+    )
+    sim = TpuSimTransport(cfg, seed=4)
+    sim.run(400)
+    s = sim.stats()
+    assert s["elections"] > 0
+    assert s["reads_done"] > 0
+    assert s["committed"] > 500
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_failover_sharded_matches_unsharded():
+    cfg = make(
+        num_groups=8, fail_rate=0.02, revive_rate=0.1, heartbeat_timeout=4
+    )
+    key = jax.random.PRNGKey(5)
+    t0 = jnp.zeros((), jnp.int32)
+    plain, _ = run_ticks(cfg, init_state(cfg), t0, 200, key)
+    mesh = make_mesh()
+    sharded, _ = run_ticks_sharded(
+        cfg, mesh, shard_state(init_state(cfg), mesh), t0, 200, key
+    )
+    for field in ("committed", "retired", "elections", "lat_sum"):
+        assert int(jax.device_get(getattr(plain, field))) == int(
+            jax.device_get(getattr(sharded, field))
+        ), field
+    assert int(jax.device_get(plain.elections)) > 0
+    a = jax.device_get(plain.leader_alive)
+    b = jax.device_get(sharded.leader_alive)
+    assert (a == b).all()
+
+
+def test_feature_off_is_inert():
+    sim = TpuSimTransport(make(), seed=6)
+    sim.run(50)
+    assert jax.device_get(sim.state.leader_alive).all()
+    assert int(sim.state.elections) == 0
+    assert "elections" not in sim.stats()
+    assert all(sim.check_invariants().values())

@@ -1,0 +1,165 @@
+"""Device-side Matchmaker reconfiguration in the batched backend
+(BASELINE config 4): MatchA/MatchB quorums, phase-1 against the OLD
+configuration via real message arrivals (a true f+1 read quorum, not an
+oracle), i/i+1 round-config binding, proposal stalls (the churn dip),
+and the old-config GC pipeline — all inside the compiled lax.scan."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.parallel import make_mesh, run_ticks_sharded, shard_state
+from frankenpaxos_tpu.tpu import (
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    check_invariants,
+    init_state,
+    run_ticks,
+    tick,
+)
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    INF,
+    RC_NORMAL,
+    CHOSEN,
+    PROPOSED,
+)
+
+
+def make(**kw):
+    defaults = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, reconfigure_every=40,
+    )
+    defaults.update(kw)
+    return BatchedMultiPaxosConfig(**defaults)
+
+
+def test_churn_runs_inside_one_scan_with_invariants():
+    """>= 10 configuration changes inside ONE compiled scan: progress
+    continues, invariants hold, every group reaches the same epoch."""
+    sim = TpuSimTransport(make(reconfigure_every=30), seed=0)
+    sim.run(330)  # one scan segment; reconfigs at t=30,60,...,330
+    s = sim.stats()
+    assert s["reconfigurations"] >= 10 * sim.config.num_groups
+    assert s["config_epoch_max"] >= 10
+    assert s["round"] == s["config_epoch_max"]  # i/i+1 round-config binding
+    assert s["committed"] > 1000
+    assert s["old_configs_gcd"] > 0  # the GC pipeline retires old configs
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_churn_dips_and_recovers():
+    """The churn sweep signal: ticks during a reconfiguration commit less
+    than steady-state ticks, and throughput recovers after (the
+    vldb20_matchmaker lt dip/recovery figure)."""
+    cfg = make(num_groups=8, reconfigure_every=50, window=32, slots_per_tick=4)
+    sim = TpuSimTransport(cfg, seed=1)
+    rates = []
+    for _ in range(25):  # 10-tick segments over 250 ticks: reconfigs at 50k
+        before = sim.committed()
+        sim.run(10)
+        rates.append(sim.committed() - before)
+    # Segments containing the reconfiguration exchange (indices 5, 10, ...)
+    # must commit less than the steady-state segments around them.
+    dips = [rates[i] for i in (5, 10, 15, 20)]
+    steady = [rates[i] for i in (3, 8, 13, 18, 23)]
+    assert min(steady) > max(dips), (dips, steady)
+    # And it RECOVERS: the segment after each dip is back near steady.
+    post = [rates[i + 1] for i in (5, 10, 15, 20)]
+    assert min(post) > max(dips), (post, dips)
+    assert all(sim.check_invariants().values())
+
+
+def test_possibly_chosen_value_survives_via_quorum_intersection():
+    """A value voted by a full write quorum (f+1 acceptors) but never
+    LEARNED as chosen must survive reconfiguration: phase 1 reads only
+    the first f+1 Phase1bs, and ANY f+1 read quorum intersects the
+    {0, 1} write quorum — the safety property the Matchmaker path exists
+    to preserve (Reconfigurer.scala's phase-1-against-old-configs)."""
+    cfg = make(
+        num_groups=2, window=8, slots_per_tick=1, lat_min=1, lat_max=1,
+        thrifty=False, retry_timeout=100, max_slots_per_group=1,
+        reconfigure_every=4,
+    )
+    key = jax.random.PRNGKey(2)
+    state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    # Phase2as reach acceptors 0 and 1 only (a full f+1 write quorum:
+    # the value is possibly-chosen); acceptor 2 never hears of it.
+    p2a = np.asarray(state.p2a_arrival).copy()
+    p2a[2, :, :] = int(INF)
+    state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
+    values = {}
+    epoch1 = False
+    for t in range(1, 30):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        if not epoch1:
+            # Block every Phase2b until the reconfiguration completes:
+            # the slot is voted-but-never-chosen in the old config.
+            state = dataclasses.replace(
+                state,
+                p2b_arrival=jnp.full_like(state.p2b_arrival, int(INF)),
+            )
+            if t == 1:
+                vr = np.asarray(state.vote_round)
+                assert (vr[:2, :, 0] == 0).all()  # quorum {0,1} voted
+                assert (vr[2, :, 0] == -1).all()
+                values = np.asarray(state.vote_value)[0, :, 0].copy()
+                assert (values >= 0).all()
+            if int(np.asarray(state.config_epoch).max()) == 1:
+                epoch1 = True
+        elif (np.asarray(state.status)[:, 0] == CHOSEN).all():
+            break
+    assert epoch1, "reconfiguration never completed"
+    # Committed in the NEW configuration with the ORIGINAL values — the
+    # learned read quorum intersected the {0,1} write quorum.
+    assert (np.asarray(state.status)[:, 0] == CHOSEN).all()
+    assert (np.asarray(state.chosen_value)[:, 0] == values).all(), (
+        np.asarray(state.chosen_value)[:, 0], values,
+    )
+    assert int(np.asarray(state.chosen_round).max()) == 1  # new round
+    inv = check_invariants(cfg, state, jnp.int32(t + 1))
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_matchmaker_with_reads_failover_and_loss():
+    """Everything at once in one compiled program: churn + device
+    elections + linearizable reads + message loss."""
+    cfg = make(
+        num_groups=4, reconfigure_every=60, drop_rate=0.1, retry_timeout=6,
+        fail_rate=0.005, revive_rate=0.2, heartbeat_timeout=5,
+        reads_per_tick=2, read_window=8, read_mode="linearizable",
+    )
+    sim = TpuSimTransport(cfg, seed=3)
+    sim.run(400)
+    s = sim.stats()
+    assert s["reconfigurations"] > 0
+    assert s["reads_done"] > 0
+    assert s["committed"] > 500
+    assert all(sim.check_invariants().values()), sim.check_invariants()
+
+
+def test_matchmaker_sharded_matches_unsharded():
+    cfg = make(num_groups=8, reconfigure_every=40)
+    key = jax.random.PRNGKey(4)
+    t0 = jnp.zeros((), jnp.int32)
+    plain, _ = run_ticks(cfg, init_state(cfg), t0, 150, key)
+    mesh = make_mesh()
+    sharded, _ = run_ticks_sharded(
+        cfg, mesh, shard_state(init_state(cfg), mesh), t0, 150, key
+    )
+    for field in ("committed", "retired", "reconfigs", "configs_gcd"):
+        assert int(jax.device_get(getattr(plain, field))) == int(
+            jax.device_get(getattr(sharded, field))
+        ), field
+    assert int(jax.device_get(plain.reconfigs)) > 0
+
+
+def test_feature_off_is_inert():
+    sim = TpuSimTransport(make(reconfigure_every=0), seed=5)
+    sim.run(60)
+    assert int(sim.state.reconfigs) == 0
+    assert int(jax.device_get(sim.state.recon_phase).max()) == RC_NORMAL
+    assert "reconfigurations" not in sim.stats()
+    assert all(sim.check_invariants().values())

@@ -294,8 +294,13 @@ def test_baseline_configs_runner():
     r1 = bc.config1_multipaxos_smoke(full=False)
     assert r1["committed"] > 0 and r1["invariants_ok"]
     r4 = bc.config4_matchmaker_churn(full=False)
-    assert r4["with_churn"]["reconfigurations"] == 4
+    # Device-side churn: every group reconfigures on each 100-tick wave.
+    assert r4["with_churn"]["reconfigurations"] >= 4 * 16
+    assert r4["with_churn"]["old_configs_gcd"] > 0
     assert r4["throughput_retained"] > 0.8  # churn must not crater it
+    # The timeline carries the dip/recovery signature.
+    tl = r4["with_churn"]["timeline_committed_per_segment"]
+    assert min(tl) < max(tl)
     r5 = bc.config5_flexible_sweep(full=False)
     modes = {(p["mode"], p["acceptors"]) for p in r5["points"]}
     assert ("grid", 6) in modes and ("majority", 6) in modes
