@@ -44,23 +44,48 @@ def _inner_main() -> None:
 
     from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
 
-    # 3334 groups x 3 acceptors = 10,002 simulated acceptors (f=1).
-    cfg = BatchedMultiPaxosConfig(
-        f=1,
-        num_groups=3334,
-        window=64,
-        slots_per_tick=8,
-        lat_min=1,
-        lat_max=3,
-        drop_rate=0.0,
-        retry_timeout=16,
-        thrifty=True,
-    )
-    sim = TpuSimTransport(cfg, seed=0)
+    def make_cfg(K: int, W: int) -> BatchedMultiPaxosConfig:
+        # 3334 groups x 3 acceptors = 10,002 simulated acceptors (f=1).
+        return BatchedMultiPaxosConfig(
+            f=1,
+            num_groups=3334,
+            window=W,
+            slots_per_tick=K,
+            lat_min=1,
+            lat_max=3,
+            drop_rate=0.0,
+            retry_timeout=16,
+            thrifty=True,
+        )
 
-    # Warmup + calibration: compile the segment program, ramp the pipeline,
-    # and size the measured run to a sane wall-clock budget on any backend
-    # (TPU ticks are microseconds; a CPU fallback is ~50ms/tick).
+    # Calibrate over (K, W): ticks/s is set by the window-sized fusions
+    # (W), not the proposal rate (K), so committed/s rises with K until
+    # K * commit-latency exceeds W (results/tpu_perf_analysis_r03.md).
+    # The best point differs between backends (VPU vs host SIMD), so
+    # measure a short segment per candidate and keep the winner warm.
+    candidates = [(8, 64), (16, 128), (32, 256)]
+    calib_rows = []
+    best = None  # (rate, K, W, sim)
+    for K, W in candidates:
+        c_sim = TpuSimTransport(make_cfg(K, W), seed=0)
+        c_sim.run(150)  # compile + ramp the pipeline
+        c_sim.block_until_ready()
+        c0 = c_sim.committed()
+        c_start = time.perf_counter()
+        c_sim.run(150)
+        c_sim.block_until_ready()
+        c_dt = time.perf_counter() - c_start
+        rate = (c_sim.committed() - c0) / c_dt
+        calib_rows.append(
+            {"K": K, "W": W, "committed_per_sec": round(rate, 1)}
+        )
+        if best is None or rate > best[0]:
+            best = (rate, K, W, c_sim)
+    _, bK, bW, sim = best
+    cfg = make_cfg(bK, bW)
+
+    # Size the measured run to a sane wall-clock budget on any backend
+    # (TPU ticks are ~5ms at this model size; a CPU fallback is ~50ms).
     ticks_per_segment = 500
     sim.run(ticks_per_segment)
     sim.block_until_ready()
@@ -93,6 +118,8 @@ def _inner_main() -> None:
         "ticks_per_sec": round(ticks / elapsed, 1),
         "wall_seconds": round(elapsed, 3),
         "device": str(jax.devices()[0]),
+        "config": {"K": bK, "W": bW, "num_groups": cfg.num_groups},
+        "calibration": calib_rows,
     }
 
     # Secondary: the same cluster serving linearizable quorum reads

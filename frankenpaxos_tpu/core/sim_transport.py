@@ -82,6 +82,9 @@ class DeliverMessage:
 class TriggerTimer:
     address: Address
     name: str
+    # Which of the running timers sharing (address, name) to fire; an
+    # actor may run several timers under one name (per-op retries).
+    occurrence: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -216,7 +219,7 @@ class SimTransport(Transport):
         several timers under one name, e.g. per-op retry timers). No-op
         if none is running at that occurrence."""
         if record:
-            self.history.append(TriggerTimer(address, name))
+            self.history.append(TriggerTimer(address, name, occurrence))
         if address in self.partitioned:
             return
         seen = 0
@@ -260,13 +263,21 @@ class SimTransport(Transport):
         if i < n_msgs:
             return DeliverMessage(self.messages[i])
         t = running[i - n_msgs]
-        return TriggerTimer(t.address, t._name)
+        occ = sum(
+            1
+            for u in running[: i - n_msgs]
+            if u.address == t.address and u._name == t._name
+        )
+        return TriggerTimer(t.address, t._name, occ)
 
     def run_command(self, cmd: SimCommand, record: bool = True) -> None:
         if isinstance(cmd, DeliverMessage):
             self.deliver_message(cmd.msg, record=record)
         elif isinstance(cmd, TriggerTimer):
-            self.trigger_timer(cmd.address, cmd.name, record=record)
+            self.trigger_timer(
+                cmd.address, cmd.name, record=record,
+                occurrence=cmd.occurrence,
+            )
         elif isinstance(cmd, DropMessage):
             self.drop_message(cmd.msg, record=record)
         elif isinstance(cmd, DuplicateMessage):

@@ -47,6 +47,7 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
             "read_issue", "read_target", "read_floor", "reply_arrival",
             "reads_done", "read_lat_sum", "read_lat_hist",
             "read_lin_violations", "elections", "reconfigs", "configs_gcd",
+            "sm_applied", "dups_filtered", "dups_seen",
         }
         # Acceptor-major arrays ([A, G, W] / [A, G] / [A, G, RW]) carry
         # the group axis second; everything else ([G, W] / [G]) first.

@@ -258,6 +258,12 @@ def mixed_command(rng: random.Random, transport, op_choices):
             transport.messages[rng.randrange(len(transport.messages))]
         )
     if choice == "__timer__":
-        timer = running[rng.randrange(len(running))]
-        return TriggerTimer(timer.address, timer.name())
+        i = rng.randrange(len(running))
+        timer = running[i]
+        occ = sum(
+            1
+            for u in running[:i]
+            if u.address == timer.address and u.name() == timer.name()
+        )
+        return TriggerTimer(timer.address, timer.name(), occ)
     return choice

@@ -9,9 +9,14 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 """
 
 from frankenpaxos_tpu.tpu import (
+    craq_batched,
     epaxos_batched,
     mencius_batched,
     scalog_batched,
+)
+from frankenpaxos_tpu.tpu.craq_batched import (
+    BatchedCraqConfig,
+    BatchedCraqState,
 )
 from frankenpaxos_tpu.tpu.epaxos_batched import (
     BatchedEPaxosConfig,
@@ -34,6 +39,9 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
 from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 
 __all__ = [
+    "BatchedCraqConfig",
+    "BatchedCraqState",
+    "craq_batched",
     "BatchedEPaxosConfig",
     "BatchedEPaxosState",
     "BatchedMenciusConfig",

@@ -1,0 +1,449 @@
+"""Batched CRAQ as a single XLA program.
+
+CRAQ — chain replication with apportioned queries (reference ``craq/
+ChainNode.scala:120-299``): writes enter at the head and flow down the
+chain, the tail applies and replies, acks flow back up and every node
+applies on ack; reads go to ANY node and are served locally iff the key
+has no pending writes at that node ("clean"), otherwise forwarded to the
+tail ("dirty") — apportioning read load across the whole chain while
+staying linearizable.
+
+TPU-first design: ``N`` independent chains of ``L`` nodes are the
+replica axis (vectorized elementwise, shardable along ``N`` — a chain
+never talks to another chain). "The network" is device memory:
+
+  * In-flight writes live in a per-chain ring of ``W`` slots; a write's
+    position in the chain is a (direction, node, arrival-tick) triple,
+    and one tick moves every write at most one hop (a masked scatter
+    into the per-node state — no per-message objects).
+  * Per-node CRAQ state is two ``[N, L, KV]`` arrays: ``node_dirty``
+    (pending-write counts per key — the ``pending_writes`` set of
+    ChainNode.scala, reduced to what reads need: a count) and
+    ``node_version`` (the version each node has applied).
+  * Versions are a per-chain monotone sequence; nodes and the tail
+    apply by scatter-MAX, so a later write overtaking an earlier one on
+    the (non-FIFO) simulated links still resolves last-writer-wins —
+    the batched analog of the FIFO-link assumption the reference
+    inherits from TCP, made explicit and order-insensitive.
+  * Reads ride their own ring: issue -> node (clean check = one gather
+    of ``node_dirty``) -> optional tail hop -> reply, with the
+    linearizability floor (the tail's committed version at issue)
+    checked on completion, exactly like the batched MultiPaxos read
+    invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+
+# Write slot status.
+W_EMPTY = 0
+W_DOWN = 1  # propagating head -> tail
+W_UP = 2  # ack propagating tail -> head
+
+# Read slot status.
+R_EMPTY = 0
+R_AT_NODE = 1  # request in flight to the chosen node
+R_TAIL = 2  # dirty: version query in flight to the tail
+R_REPLY = 3  # reply in flight to the client
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedCraqConfig:
+    """Static parameters: N chains x L nodes, KV keys per chain."""
+
+    num_chains: int = 4
+    chain_len: int = 3  # L >= 2 (head + tail at minimum)
+    num_keys: int = 16  # KV: key space per chain
+    window: int = 16  # W: in-flight writes per chain
+    writes_per_tick: int = 2  # K
+    reads_per_tick: int = 2  # R
+    read_window: int = 16  # RW: outstanding reads per chain
+    lat_min: int = 1
+    lat_max: int = 3
+
+    def __post_init__(self):
+        assert self.num_chains >= 1
+        assert self.chain_len >= 2
+        assert self.num_keys >= 1
+        assert self.window >= 2 * self.writes_per_tick
+        if self.reads_per_tick:
+            assert self.read_window >= 2 * self.reads_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedCraqState:
+    """Shapes: [N] chains, [N, W] write ring, [N, L, KV] node state,
+    [N, RW] read ring."""
+
+    # Write ring.
+    w_status: jnp.ndarray  # [N, W] W_EMPTY | W_DOWN | W_UP
+    w_key: jnp.ndarray  # [N, W]
+    w_version: jnp.ndarray  # [N, W] per-chain monotone version
+    w_node: jnp.ndarray  # [N, W] node the write/ack is heading to
+    w_arrival: jnp.ndarray  # [N, W] tick it arrives there (INF = idle)
+    w_issue: jnp.ndarray  # [N, W] issue tick (write latency)
+
+    # Per-node CRAQ state.
+    node_dirty: jnp.ndarray  # [N, L, KV] pending-write count per key
+    node_version: jnp.ndarray  # [N, L, KV] applied version (-1 = none)
+    next_version: jnp.ndarray  # [N] per-chain version counter
+
+    # Read ring.
+    r_status: jnp.ndarray  # [N, RW]
+    r_key: jnp.ndarray  # [N, RW]
+    r_node: jnp.ndarray  # [N, RW] chosen node
+    r_arrival: jnp.ndarray  # [N, RW] next event tick (INF = idle)
+    r_issue: jnp.ndarray  # [N, RW]
+    r_floor: jnp.ndarray  # [N, RW] tail version at issue (lin floor)
+    r_version: jnp.ndarray  # [N, RW] served version
+
+    # Stats.
+    writes_done: jnp.ndarray  # [] writes applied at the tail (replied)
+    write_lat_sum: jnp.ndarray  # []
+    write_lat_hist: jnp.ndarray  # [LAT_BINS]
+    reads_done: jnp.ndarray  # []
+    reads_clean: jnp.ndarray  # [] served locally at the chosen node
+    reads_dirty: jnp.ndarray  # [] forwarded to the tail
+    read_lat_sum: jnp.ndarray  # []
+    read_lat_hist: jnp.ndarray  # [LAT_BINS]
+    read_lin_violations: jnp.ndarray  # [] reads below their floor
+
+
+def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
+    N, L, KV = cfg.num_chains, cfg.chain_len, cfg.num_keys
+    W, RW = cfg.window, cfg.read_window
+    return BatchedCraqState(
+        w_status=jnp.zeros((N, W), jnp.int32),
+        w_key=jnp.zeros((N, W), jnp.int32),
+        w_version=jnp.full((N, W), -1, jnp.int32),
+        w_node=jnp.zeros((N, W), jnp.int32),
+        w_arrival=jnp.full((N, W), INF, jnp.int32),
+        w_issue=jnp.full((N, W), INF, jnp.int32),
+        node_dirty=jnp.zeros((N, L, KV), jnp.int32),
+        node_version=jnp.full((N, L, KV), -1, jnp.int32),
+        next_version=jnp.zeros((N,), jnp.int32),
+        r_status=jnp.zeros((N, RW), jnp.int32),
+        r_key=jnp.zeros((N, RW), jnp.int32),
+        r_node=jnp.zeros((N, RW), jnp.int32),
+        r_arrival=jnp.full((N, RW), INF, jnp.int32),
+        r_issue=jnp.full((N, RW), INF, jnp.int32),
+        r_floor=jnp.full((N, RW), -1, jnp.int32),
+        r_version=jnp.full((N, RW), -1, jnp.int32),
+        writes_done=jnp.zeros((), jnp.int32),
+        write_lat_sum=jnp.zeros((), jnp.int32),
+        write_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        reads_done=jnp.zeros((), jnp.int32),
+        reads_clean=jnp.zeros((), jnp.int32),
+        reads_dirty=jnp.zeros((), jnp.int32),
+        read_lat_sum=jnp.zeros((), jnp.int32),
+        read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        read_lin_violations=jnp.zeros((), jnp.int32),
+    )
+
+
+def tick(
+    cfg: BatchedCraqConfig,
+    state: BatchedCraqState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedCraqState:
+    """One tick: writes/acks advance one hop, the tail applies+replies,
+    reads route (clean local / dirty via tail) and complete."""
+    N, L, KV = cfg.num_chains, cfg.chain_len, cfg.num_keys
+    W, RW = cfg.window, cfg.read_window
+    tail = L - 1
+    kw, kr = jax.random.split(key)
+    bits_w = jax.random.bits(kw, (N, W))  # [0:8) hop lat, [8:24) new key
+    bits_r = jax.random.bits(kr, (N, RW))  # [0:8) hop lat, [8:20) key,
+    #                                        [20:28) node choice
+    hop_lat_w = bit_latency(bits_w, 0, cfg.lat_min, cfg.lat_max)
+    hop_lat_r = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max)
+
+    n_rows_w = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.int32)[:, None], (N, W)
+    )
+    n_rows_r = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.int32)[:, None], (N, RW)
+    )
+
+    w_status = state.w_status
+    w_node = state.w_node
+    w_arrival = state.w_arrival
+    node_dirty_flat = state.node_dirty.reshape(N, L * KV)
+    node_version_flat = state.node_version.reshape(N, L * KV)
+    writes_done = state.writes_done
+    write_lat_sum = state.write_lat_sum
+    write_lat_hist = state.write_lat_hist
+
+    # ---- 1. DOWN arrivals (ChainNode._process_write_batch): a non-tail
+    # node adds the write to its pending set (dirty count) and forwards;
+    # the tail applies, replies to the client, and starts the ack.
+    arrive_down = (w_status == W_DOWN) & (w_arrival == t)
+    at_mid = arrive_down & (w_node < tail)
+    at_tail = arrive_down & (w_node == tail)
+    wslot = w_node * KV + state.w_key  # [N, W] flattened (node, key)
+    node_dirty_flat = node_dirty_flat.at[n_rows_w, wslot].add(
+        at_mid.astype(jnp.int32)
+    )
+    node_version_flat = node_version_flat.at[n_rows_w, wslot].max(
+        jnp.where(at_tail, state.w_version, -1)
+    )
+    # Tail reply: the write is done from the client's view one hop later.
+    wlat = jnp.where(at_tail, t + hop_lat_w - state.w_issue, 0)
+    writes_done = writes_done + jnp.sum(at_tail)
+    write_lat_sum = write_lat_sum + jnp.sum(wlat)
+    wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
+    write_lat_hist = write_lat_hist + jax.ops.segment_sum(
+        at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
+    )
+    # Advance: mid-chain writes head to the next node; the tail's ack
+    # heads back to node L-2.
+    w_node = jnp.where(at_mid, w_node + 1, w_node)
+    w_node = jnp.where(at_tail, tail - 1, w_node)
+    w_status = jnp.where(at_tail, W_UP, w_status)
+    w_arrival = jnp.where(arrive_down, t + hop_lat_w, w_arrival)
+
+    # ---- 2. UP (ack) arrivals (ChainNode._handle_ack): apply the write
+    # locally, drop it from the pending set, and keep propagating; the
+    # ack reaching the head retires the ring slot.
+    arrive_up = (w_status == W_UP) & (w_arrival == t)
+    uslot = w_node * KV + state.w_key
+    node_version_flat = node_version_flat.at[n_rows_w, uslot].max(
+        jnp.where(arrive_up, state.w_version, -1)
+    )
+    node_dirty_flat = node_dirty_flat.at[n_rows_w, uslot].add(
+        -arrive_up.astype(jnp.int32)
+    )
+    retire = arrive_up & (w_node == 0)
+    w_status = jnp.where(retire, W_EMPTY, w_status)
+    w_arrival = jnp.where(retire, INF, w_arrival)
+    keep_up = arrive_up & ~retire
+    w_node = jnp.where(keep_up, w_node - 1, w_node)
+    w_arrival = jnp.where(keep_up, t + hop_lat_w, w_arrival)
+
+    # ---- 3. Reads (apportioned queries, ChainNode._process_read_batch).
+    r_status = state.r_status
+    r_key = state.r_key
+    r_node = state.r_node
+    r_arrival = state.r_arrival
+    r_issue = state.r_issue
+    r_floor = state.r_floor
+    r_version = state.r_version
+    reads_done = state.reads_done
+    reads_clean = state.reads_clean
+    reads_dirty = state.reads_dirty
+    read_lat_sum = state.read_lat_sum
+    read_lat_hist = state.read_lat_hist
+    read_lin_violations = state.read_lin_violations
+    # Gate on the ring EXISTING (not on the issue rate): tests inject
+    # reads by hand with reads_per_tick == 0 and still need routing.
+    if cfg.read_window:
+        # (a) Completions free their slots (and check the lin floor).
+        done = (r_status == R_REPLY) & (r_arrival <= t)
+        rlat = jnp.where(done, t - r_issue, 0)
+        reads_done = reads_done + jnp.sum(done)
+        read_lat_sum = read_lat_sum + jnp.sum(rlat)
+        rbins = jnp.clip(rlat, 0, LAT_BINS - 1)
+        read_lat_hist = read_lat_hist + jax.ops.segment_sum(
+            done.astype(jnp.int32).ravel(), rbins.ravel(), LAT_BINS
+        )
+        read_lin_violations = read_lin_violations + jnp.sum(
+            done & (r_version < r_floor)
+        )
+        r_status = jnp.where(done, R_EMPTY, r_status)
+        r_arrival = jnp.where(done, INF, r_arrival)
+
+        # (b) Node arrivals: one gather answers "is the key dirty here".
+        at_node = (r_status == R_AT_NODE) & (r_arrival == t)
+        rslot = r_node * KV + r_key
+        dirty_here = (
+            jnp.take_along_axis(node_dirty_flat, rslot, axis=1) > 0
+        )
+        clean = at_node & ~dirty_here
+        dirty = at_node & dirty_here
+        local_ver = jnp.take_along_axis(node_version_flat, rslot, axis=1)
+        r_version = jnp.where(clean, local_ver, r_version)
+        r_status = jnp.where(clean, R_REPLY, r_status)
+        r_status = jnp.where(dirty, R_TAIL, r_status)
+        r_arrival = jnp.where(at_node, t + hop_lat_r, r_arrival)
+        reads_clean = reads_clean + jnp.sum(clean)
+        reads_dirty = reads_dirty + jnp.sum(dirty)
+
+        # (c) Tail arrivals (CraqTailRead): serve the tail's version.
+        at_tail_r = (r_status == R_TAIL) & (r_arrival == t)
+        tslot = tail * KV + r_key
+        tail_ver = jnp.take_along_axis(node_version_flat, tslot, axis=1)
+        r_version = jnp.where(at_tail_r, tail_ver, r_version)
+        r_status = jnp.where(at_tail_r, R_REPLY, r_status)
+        r_arrival = jnp.where(at_tail_r, t + hop_lat_r, r_arrival)
+
+        # (d) Issue new reads at a PRNG node/key; the floor is the tail's
+        # committed version for the key right now.
+        empty_r = r_status == R_EMPTY
+        rank_r = jnp.cumsum(empty_r.astype(jnp.int32), axis=1)
+        issue_r = empty_r & (rank_r <= cfg.reads_per_tick)
+        new_key_r = (
+            ((bits_r >> 8) & jnp.uint32(0xFFF)).astype(jnp.int32) % KV
+        )
+        new_node = (
+            ((bits_r >> 20) & jnp.uint32(0xFF)).astype(jnp.int32) % L
+        )
+        floor_slot = tail * KV + new_key_r
+        floor_now = jnp.take_along_axis(
+            node_version_flat, floor_slot, axis=1
+        )
+        r_key = jnp.where(issue_r, new_key_r, r_key)
+        r_node = jnp.where(issue_r, new_node, r_node)
+        r_floor = jnp.where(issue_r, floor_now, r_floor)
+        r_issue = jnp.where(issue_r, t, r_issue)
+        r_version = jnp.where(issue_r, -1, r_version)
+        r_status = jnp.where(issue_r, R_AT_NODE, r_status)
+        r_arrival = jnp.where(issue_r, t + hop_lat_r, r_arrival)
+
+    # ---- 4. New writes into empty ring slots (CraqClient.write -> head).
+    empty_w = w_status == W_EMPTY
+    rank_w = jnp.cumsum(empty_w.astype(jnp.int32), axis=1)
+    issue_w = empty_w & (rank_w <= cfg.writes_per_tick)
+    count_w = jnp.sum(issue_w, axis=1)  # [N]
+    new_key_w = (
+        ((bits_w >> 8) & jnp.uint32(0xFFFF)).astype(jnp.int32) % KV
+    )
+    new_version = state.next_version[:, None] + rank_w - 1
+    w_key = jnp.where(issue_w, new_key_w, state.w_key)
+    w_version = jnp.where(issue_w, new_version, state.w_version)
+    w_node = jnp.where(issue_w, 0, w_node)
+    w_status = jnp.where(issue_w, W_DOWN, w_status)
+    w_arrival = jnp.where(issue_w, t + hop_lat_w, w_arrival)
+    w_issue = jnp.where(issue_w, t, state.w_issue)
+    next_version = state.next_version + count_w
+
+    return BatchedCraqState(
+        w_status=w_status,
+        w_key=w_key,
+        w_version=w_version,
+        w_node=w_node,
+        w_arrival=w_arrival,
+        w_issue=w_issue,
+        node_dirty=node_dirty_flat.reshape(N, L, KV),
+        node_version=node_version_flat.reshape(N, L, KV),
+        next_version=next_version,
+        r_status=r_status,
+        r_key=r_key,
+        r_node=r_node,
+        r_arrival=r_arrival,
+        r_issue=r_issue,
+        r_floor=r_floor,
+        r_version=r_version,
+        writes_done=writes_done,
+        write_lat_sum=write_lat_sum,
+        write_lat_hist=write_lat_hist,
+        reads_done=reads_done,
+        reads_clean=reads_clean,
+        reads_dirty=reads_dirty,
+        read_lat_sum=read_lat_sum,
+        read_lat_hist=read_lat_hist,
+        read_lin_violations=read_lin_violations,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedCraqConfig,
+    state: BatchedCraqState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedCraqState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedCraqConfig, state: BatchedCraqState, t
+) -> dict:
+    """Device-side safety checks."""
+    L, KV = cfg.chain_len, cfg.num_keys
+    down = state.w_status == W_DOWN
+    up = state.w_status == W_UP
+    # Pending-set conservation: a DOWN write heading to node m is pending
+    # at nodes 0..m-1 (m entries); an UP ack heading to node m has been
+    # acked at m+1..L-2, so the write is still pending at 0..m (m+1).
+    expected_dirty = jnp.sum(
+        jnp.where(down, state.w_node, 0) + jnp.where(up, state.w_node + 1, 0)
+    )
+    dirty_conserved = jnp.sum(state.node_dirty) == expected_dirty
+    dirty_nonneg = jnp.all(state.node_dirty >= 0)
+    # A node never applies ahead of the tail (acks follow the tail apply).
+    tail_ver = state.node_version[:, L - 1 : L, :]
+    node_behind_tail = jnp.all(state.node_version <= tail_ver)
+    # Versions applied anywhere were actually issued.
+    ver_issued = jnp.all(
+        state.node_version < state.next_version[:, None, None]
+    )
+    # Write accounting: every issued write is in flight or done.
+    in_flight = jnp.sum(state.w_status != W_EMPTY)
+    # writes_done counts tail applies; UP acks are done-but-in-flight.
+    acked_in_flight = jnp.sum(up)
+    write_books = (
+        jnp.sum(state.next_version) == state.writes_done + in_flight
+        - acked_in_flight
+    )
+    # Apportioned reads stay linearizable.
+    read_lin_ok = state.read_lin_violations == 0
+    read_books = state.reads_clean + state.reads_dirty >= state.reads_done
+    return {
+        "dirty_conserved": dirty_conserved,
+        "dirty_nonneg": dirty_nonneg,
+        "node_behind_tail": node_behind_tail,
+        "ver_issued": ver_issued,
+        "write_books": write_books,
+        "read_lin_ok": read_lin_ok,
+        "read_books": read_books,
+    }
+
+
+def stats(cfg: BatchedCraqConfig, state: BatchedCraqState, t) -> dict:
+    """Host-side summary (mirrors TpuSimTransport.stats)."""
+    writes = int(state.writes_done)
+    reads = int(state.reads_done)
+    whist = jax.device_get(state.write_lat_hist)
+    rhist = jax.device_get(state.read_lat_hist)
+
+    def p50(hist, n):
+        if not n:
+            return -1
+        return int((hist.cumsum() >= max(1, (n + 1) // 2)).argmax())
+
+    clean = int(state.reads_clean)
+    dirty = int(state.reads_dirty)
+    return {
+        "ticks": int(t),
+        "writes_done": writes,
+        "write_latency_p50_ticks": p50(whist, writes),
+        "write_latency_mean_ticks": (
+            float(state.write_lat_sum) / writes if writes else -1.0
+        ),
+        "reads_done": reads,
+        "read_latency_p50_ticks": p50(rhist, reads),
+        "reads_clean": clean,
+        "reads_dirty": dirty,
+        "clean_fraction": clean / max(1, clean + dirty),
+        "read_lin_violations": int(state.read_lin_violations),
+    }

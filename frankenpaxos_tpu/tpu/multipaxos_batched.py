@@ -133,6 +133,21 @@ class BatchedMultiPaxosConfig:
     # Enable the election machinery without PRNG fault injection (for
     # deterministic tests that kill candidates by editing leader_alive).
     device_elections: bool = False
+    # Device-side replica state machine + client table (the batched
+    # Replica.executeCommand, Replica.scala:305-344: client-table dedup,
+    # then stateMachine.run; KeyValueStore.scala + ClientTable.scala).
+    # "kv": each group's replica applies its retired commands to a
+    # per-group KV shard (key = id % kv_keys, last-writer-wins — ids are
+    # slot-monotone so the winner is a scatter-max) with per-client
+    # exactly-once dedup. Slots round-robin over num_clients pseudonyms
+    # (client of per-group slot s is s % num_clients); with dup_rate > 0
+    # a newly proposed slot re-issues its client's LATEST command id (a
+    # client re-sending an un-acked op) and the client table must filter
+    # the re-execution.
+    state_machine: str = "none"  # "none" | "kv"
+    kv_keys: int = 64  # keys per group's KV shard
+    num_clients: int = 8  # client pseudonyms per group
+    dup_rate: float = 0.0  # P(a fresh slot re-issues its client's last id)
     # Device-side Matchmaker reconfiguration (BASELINE config 4;
     # matchmakermultipaxos/Matchmaker.scala + Reconfigurer.scala): every
     # reconfigure_every ticks each group swaps in a fresh acceptor
@@ -165,6 +180,16 @@ class BatchedMultiPaxosConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
         assert self.read_mode in READ_MODES
+        assert self.state_machine in ("none", "kv")
+        if self.state_machine == "kv":
+            assert self.kv_keys >= 1 and self.num_clients >= 1
+            assert self.window % self.num_clients == 0, (
+                "the per-client within-batch dedup reshapes the ring to "
+                "[G, W/NC, NC]; pick num_clients dividing window"
+            )
+            assert 0.0 <= self.dup_rate < 1.0
+        else:
+            assert self.dup_rate == 0.0, "dup_rate needs state_machine='kv'"
         if self.reads_per_tick:
             assert self.read_window >= 2 * self.reads_per_tick, (
                 "read_window must leave room for in-flight reads"
@@ -217,6 +242,11 @@ class BatchedMultiPaxosState:
     # (Phase1a/Phase1b quorum against the old config) -> RC_NORMAL.
     recon_phase: jnp.ndarray  # [G] RC_* phase
     config_epoch: jnp.ndarray  # [G] completed reconfigurations
+    # Round/epoch the in-flight reconfiguration installs, CAPTURED when
+    # the exchange starts: stragglers processed after p1_done must use
+    # the values their messages were sent with, not the bumped ones.
+    rc_round: jnp.ndarray  # [G]
+    rc_epoch: jnp.ndarray  # [G]
     mm_epoch: jnp.ndarray  # [M, G] matchmaker's recorded epoch
     matcha_arrival: jnp.ndarray  # [M, G] MatchA arrival tick (INF)
     matchb_arrival: jnp.ndarray  # [M, G] MatchB arrival tick (INF)
@@ -226,6 +256,16 @@ class BatchedMultiPaxosState:
     old_live: jnp.ndarray  # [G] old configuration not yet GCd
     reconfigs: jnp.ndarray  # [] completed reconfigurations (cumulative)
     configs_gcd: jnp.ndarray  # [] old configs garbage-collected
+
+    # Replica state machine + client table (zero-width when
+    # cfg.state_machine == "none"). KV = kv_keys, NC = num_clients.
+    kv_val: jnp.ndarray  # [G, KV] id of the last write to the key (NO_VALUE)
+    ct_last: jnp.ndarray  # [G, NC] client table: largest executed id (-1)
+    client_last_issued: jnp.ndarray  # [G, NC] client's latest issued id (-1)
+    slot_is_dup: jnp.ndarray  # [G, W] provenance: slot re-issues a prior id
+    sm_applied: jnp.ndarray  # [] commands applied to the state machine
+    dups_filtered: jnp.ndarray  # [] re-executions the client table filtered
+    dups_seen: jnp.ndarray  # [] retired real slots flagged as duplicates
 
     # Read path (all zero-sized when cfg.read_window == 0). RW = ring of
     # outstanding GLOBAL read ops; global slot numbering is s*G + g.
@@ -276,6 +316,8 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         elections=jnp.zeros((), jnp.int32),
         recon_phase=jnp.zeros((G,), jnp.int32),
         config_epoch=jnp.zeros((G,), jnp.int32),
+        rc_round=jnp.zeros((G,), jnp.int32),
+        rc_epoch=jnp.zeros((G,), jnp.int32),
         mm_epoch=jnp.zeros((cfg.num_matchmakers, G), jnp.int32),
         matcha_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
         matchb_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
@@ -285,6 +327,27 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         old_live=jnp.zeros((G,), bool),
         reconfigs=jnp.zeros((), jnp.int32),
         configs_gcd=jnp.zeros((), jnp.int32),
+        kv_val=jnp.full(
+            (G, cfg.kv_keys if cfg.state_machine == "kv" else 0),
+            NO_VALUE,
+            jnp.int32,
+        ),
+        ct_last=jnp.full(
+            (G, cfg.num_clients if cfg.state_machine == "kv" else 0),
+            -1,
+            jnp.int32,
+        ),
+        client_last_issued=jnp.full(
+            (G, cfg.num_clients if cfg.state_machine == "kv" else 0),
+            -1,
+            jnp.int32,
+        ),
+        slot_is_dup=jnp.zeros(
+            (G, W if cfg.state_machine == "kv" else 0), bool
+        ),
+        sm_applied=jnp.zeros((), jnp.int32),
+        dups_filtered=jnp.zeros((), jnp.int32),
+        dups_seen=jnp.zeros((), jnp.int32),
         acc_max_slot=jnp.full((A, G), -1, jnp.int32),
         max_chosen_global=jnp.full((), -1, jnp.int32),
         client_watermark=jnp.full((), -1, jnp.int32),
@@ -320,7 +383,8 @@ def tick(
     k3, k2, k_extra, k_read, k_fail = jax.random.split(key, 5)
     bits3 = jax.random.bits(k3, (A, G, W))  # [0:8) p2b lat, [8:16) p2a lat,
     #                                         [16:24) retry lat, [24:32) p2b drop
-    bits2 = jax.random.bits(k2, (G, W))  # [0:8) replica lat, [8:16) thrifty
+    bits2 = jax.random.bits(k2, (G, W))  # [0:8) replica lat, [8:16) thrifty,
+    #                                      [16:24) dup-injection draw
     p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
     p2a_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
     retry_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
@@ -377,12 +441,12 @@ def tick(
         leader_round = leader_round + jnp.where(elect, delta, 0)
         heartbeat_miss = jnp.where(elect, 0, heartbeat_miss)
         elections = elections + jnp.sum(elect)
-        # Phase-1 repair for elected groups. Latency reuses the retry bit
-        # field: repair and retry are both Phase2a re-sends and a repaired
-        # slot (last_send = t) cannot also time out this tick.
-        retry_lat_bits = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+        # Phase-1 repair for elected groups. Latency reuses the retry
+        # draw (retry_lat): repair and retry are both Phase2a re-sends
+        # and a repaired slot (last_send = t) cannot also time out this
+        # tick.
         slot_value_in, p2a_in, p2b_in, last_send_in = _phase1_repair(
-            state, elect, t, retry_lat_bits
+            state, elect, t, retry_lat
         )
         # Post-election owner liveness gates proposals and retries below
         # (a dead leader proposes nothing; Leader.scala inactive state).
@@ -399,6 +463,8 @@ def tick(
     vote_value_in = state.vote_value
     recon_phase = state.recon_phase
     config_epoch = state.config_epoch
+    rc_round = state.rc_round
+    rc_epoch = state.rc_epoch
     mm_epoch = state.mm_epoch
     matcha_arrival = state.matcha_arrival
     matchb_arrival = state.matchb_arrival
@@ -421,20 +487,29 @@ def tick(
         p1b_lat = bit_latency(bits_a2, 8, cfg.lat_min, cfg.lat_max)
 
         # (a) On schedule, the leader matchmakes the next configuration:
-        # MatchA(epoch+1) to every matchmaker.
+        # MatchA(epoch+1) to every matchmaker. The round/epoch this
+        # exchange installs are CAPTURED here — stragglers of this wave
+        # processed after p1_done must not read the bumped values — and
+        # any straggler MatchB/Phase1b replies of the PREVIOUS wave are
+        # discarded so they can't count toward this wave's quorums.
         due = (
             (recon_phase == RC_NORMAL)
             & ((t % cfg.reconfigure_every) == 0)
             & (t > 0)
         )
+        rc_round = jnp.where(due, leader_round + 1, rc_round)
+        rc_epoch = jnp.where(due, config_epoch + 1, rc_epoch)
+        matchb_arrival = jnp.where(due[None, :], INF, matchb_arrival)
+        rc_p1b = jnp.where(due[None, :], INF, rc_p1b)
         matcha_arrival = jnp.where(due[None, :], t + ma_lat, matcha_arrival)
         recon_phase = jnp.where(due, RC_MATCHING, recon_phase)
 
-        # (b) Matchmakers process MatchA: record the new epoch, reply
-        # MatchB carrying the prior configuration (Matchmaker.scala
-        # handleMatchA stores the config bound to the round).
+        # (b) Matchmakers process MatchA: record the epoch THE MESSAGE
+        # CARRIES, reply MatchB carrying the prior configuration
+        # (Matchmaker.scala handleMatchA stores the config bound to the
+        # round).
         ma_now = matcha_arrival == t
-        mm_epoch = jnp.where(ma_now, config_epoch[None, :] + 1, mm_epoch)
+        mm_epoch = jnp.where(ma_now, rc_epoch[None, :], mm_epoch)
         matchb_arrival = jnp.where(ma_now, t + mb_lat, matchb_arrival)
         matcha_arrival = jnp.where(ma_now, INF, matcha_arrival)
 
@@ -447,13 +522,16 @@ def tick(
         rc_p1a = jnp.where(mm_done[None, :], t + p1a_lat, rc_p1a)
         recon_phase = jnp.where(mm_done, RC_PHASE1, recon_phase)
 
-        # (d) Old acceptors process Phase1a: PROMISE the next round —
-        # they stop voting in the old one (the safety half of phase 1) —
-        # and reply with their vote state.
+        # (d) Old acceptors process Phase1a: PROMISE the round the
+        # message was sent for (rc_round, captured at (a) — reading the
+        # live leader_round here would over-promise a straggler past the
+        # bumped round and lock it out of voting) — they stop voting in
+        # the old round (the safety half of phase 1) — and reply with
+        # their vote state.
         p1a_now = rc_p1a == t
         acc_round_in = jnp.maximum(
             acc_round_in,
-            jnp.where(p1a_now, leader_round[None, :] + 1, -1),
+            jnp.where(p1a_now, rc_round[None, :], -1),
         )
         rc_p1b = jnp.where(p1a_now, t + p1b_lat, rc_p1b)
         rc_p1a = jnp.where(p1a_now, INF, rc_p1a)
@@ -467,7 +545,9 @@ def tick(
         learned = rc_p1b <= t  # [A, G]
         np1b = jnp.sum(learned, axis=0)
         p1_done = (recon_phase == RC_PHASE1) & (np1b >= f + 1)
-        rc_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+        # Latency reuses the retry bit field (retry_lat above): repair
+        # re-sends and retries are both Phase2a sends, and a repaired
+        # slot (last_send = t) cannot also time out this tick.
         (
             slot_value_in,
             p2a_in,
@@ -475,7 +555,7 @@ def tick(
             last_send_in,
         ) = _phase1_repair_arrays(
             status, vote_round_in, vote_value_in, slot_value_in,
-            p2a_in, p2b_in, last_send_in, p1_done, t, rc_lat,
+            p2a_in, p2b_in, last_send_in, p1_done, t, retry_lat,
             learned=learned,
         )
         in_flight_rc = (status == PROPOSED) & p1_done[:, None]  # [G, W]
@@ -484,10 +564,14 @@ def tick(
             in_flight_rc[None, :, :], NO_VALUE, vote_value_in
         )
         acc_round_in = jnp.where(
-            p1_done[None, :], leader_round[None, :] + 1, acc_round_in
+            p1_done[None, :], rc_round[None, :], acc_round_in
         )
-        leader_round = leader_round + p1_done.astype(jnp.int32)
-        config_epoch = config_epoch + p1_done
+        # max() keeps the round monotone if a device-side election bumped
+        # it past rc_round while this exchange was in flight.
+        leader_round = jnp.where(
+            p1_done, jnp.maximum(rc_round, leader_round), leader_round
+        )
+        config_epoch = jnp.where(p1_done, rc_epoch, config_epoch)
         reconfigs = reconfigs + jnp.sum(p1_done)
         rc_p1b = jnp.where(p1_done[None, :], INF, rc_p1b)
         # The old configuration survives until every slot it may have
@@ -596,6 +680,68 @@ def tick(
         configs_gcd = configs_gcd + jnp.sum(gc_now)
         old_live = old_live & ~gc_now
 
+    # ---- 3.5 Replica state machine + client table (Replica.executeCommand,
+    # Replica.scala:305-344: client-table dedup, THEN stateMachine.run).
+    # Runs on the pre-clear ring: ``chosen_value`` still holds this tick's
+    # retiring commands. A command executes iff its id exceeds everything
+    # its client executed before (ct_last, ClientTable.scala executed(),
+    # plus an exact within-batch running max — see below); execution
+    # applies it to the group's KV shard. Ids are valid only below the
+    # slot_horizon_ok int32 bound (like the read path's global slot
+    # numbering): past it the & 0x7FFFFFFF wrap breaks id monotonicity
+    # and the invariant fails loudly rather than silently mis-deduping.
+    kv_val = state.kv_val
+    ct_last = state.ct_last
+    client_last_issued = state.client_last_issued
+    slot_is_dup = state.slot_is_dup
+    sm_applied = state.sm_applied
+    dups_filtered = state.dups_filtered
+    dups_seen = state.dups_seen
+    if cfg.state_machine == "kv":
+        NC, KV = cfg.num_clients, cfg.kv_keys
+        cmd = chosen_value  # [G, W] pre-clear ring values
+        real = retire_mask & (cmd >= 0)  # noops don't touch the SM
+        client = jnp.where(real, (cmd // G) % NC, 0)
+        last = jnp.take_along_axis(ct_last, client, axis=1)
+        # A command executes iff its id exceeds everything its client has
+        # executed before — in an earlier tick (ct_last) OR earlier in
+        # this tick's contiguous batch. The within-batch part must handle
+        # CHAINED re-issues (two dup slots carrying the same id can
+        # retire together after a failover noop-repaired the original),
+        # so it is an exact per-client exclusive running max over the
+        # batch in execution order: slots at ordinals o and o+NC belong
+        # to the same client (clients are slot % NC), so reshaping the
+        # ordinal-ordered ids to [G, W/NC, NC] puts each client in a
+        # column and the running max is a cummax down the rows.
+        pos_of_ord = (state.head[:, None] + w_iota[None, :]) % W  # [G, W]
+        ids_by_ord = jnp.take_along_axis(
+            jnp.where(real, cmd, -1), pos_of_ord, axis=1
+        )
+        seq = ids_by_ord.reshape(G, W // NC, NC)
+        run_max = jax.lax.cummax(seq, axis=1)
+        prev_by_ord = jnp.concatenate(
+            [jnp.full((G, 1, NC), -1, jnp.int32), run_max[:, :-1]], axis=1
+        ).reshape(G, W)
+        prev_same_client = jnp.take_along_axis(
+            prev_by_ord, ord_of_pos, axis=1
+        )
+        executes = real & (cmd > jnp.maximum(last, prev_same_client))
+        filtered = real & ~executes
+        g_mat = jnp.broadcast_to(
+            jnp.arange(G, dtype=jnp.int32)[:, None], (G, W)
+        )
+        ct_last = ct_last.at[g_mat, client].max(
+            jnp.where(executes, cmd, -1)
+        )
+        key_of = jnp.where(executes, cmd % KV, 0)
+        kv_val = kv_val.at[g_mat, key_of].max(
+            jnp.where(executes, cmd, NO_VALUE)
+        )
+        sm_applied = sm_applied + jnp.sum(executes)
+        dups_filtered = dups_filtered + jnp.sum(filtered)
+        dups_seen = dups_seen + jnp.sum(retire_mask & slot_is_dup & (cmd >= 0))
+        slot_is_dup = slot_is_dup & ~retire_mask
+
     status = jnp.where(retire_mask, EMPTY, status)
     slot_value = jnp.where(retire_mask, NO_VALUE, slot_value_in)
     chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
@@ -642,6 +788,30 @@ def tick(
     new_value = ((state.next_slot[:, None] + delta) * G + group_ids) & jnp.int32(
         0x7FFFFFFF
     )
+    if cfg.state_machine == "kv":
+        # Commands round-robin over client pseudonyms; a dup proposal
+        # re-issues the client's LATEST id (the reference client re-sends
+        # its one outstanding op, ClientMain.scala:190-323 pseudonyms) as
+        # of the last tick boundary. last_issued advances only on fresh
+        # proposals, so chained retries keep re-issuing the same id.
+        NC = cfg.num_clients
+        new_client = jnp.where(
+            is_new, (state.next_slot[:, None] + delta) % NC, 0
+        )
+        prior = jnp.take_along_axis(client_last_issued, new_client, axis=1)
+        if cfg.dup_rate > 0.0:
+            dup_draw = ~bit_delivered(bits2, 16, cfg.dup_rate)
+            is_dup = is_new & dup_draw & (prior >= 0)
+        else:
+            is_dup = jnp.zeros((G, W), bool)
+        new_value = jnp.where(is_dup, prior, new_value)
+        slot_is_dup = jnp.where(is_new, is_dup, slot_is_dup)
+        g_mat4 = jnp.broadcast_to(
+            jnp.arange(G, dtype=jnp.int32)[:, None], (G, W)
+        )
+        client_last_issued = client_last_issued.at[g_mat4, new_client].max(
+            jnp.where(is_new & ~is_dup, new_value, -1)
+        )
     slot_value = jnp.where(is_new, new_value, slot_value)
     propose_tick = jnp.where(is_new, t, propose_tick)
     last_send = jnp.where(is_new, t, last_send)
@@ -845,6 +1015,8 @@ def tick(
         elections=elections,
         recon_phase=recon_phase,
         config_epoch=config_epoch,
+        rc_round=rc_round,
+        rc_epoch=rc_epoch,
         mm_epoch=mm_epoch,
         matcha_arrival=matcha_arrival,
         matchb_arrival=matchb_arrival,
@@ -854,6 +1026,13 @@ def tick(
         old_live=old_live,
         reconfigs=reconfigs,
         configs_gcd=configs_gcd,
+        kv_val=kv_val,
+        ct_last=ct_last,
+        client_last_issued=client_last_issued,
+        slot_is_dup=slot_is_dup,
+        sm_applied=sm_applied,
+        dups_filtered=dups_filtered,
+        dups_seen=dups_seen,
         acc_max_slot=acc_max_slot,
         max_chosen_global=max_chosen_global,
         client_watermark=client_watermark,
@@ -1076,6 +1255,17 @@ def check_invariants(
     slot_horizon_ok = jnp.max(state.head) < jnp.int32(0x7FFFFFFF) // jnp.int32(
         max(cfg.num_groups, 1)
     )
+    # Outside an in-flight reconfiguration, no acceptor is promised past
+    # the leader round — an over-promise (e.g. a straggler Phase1a
+    # processed with a post-bump round) would silently lock the acceptor
+    # out of voting until the next round bump (Acceptor.scala
+    # handlePhase2a's round check). During RC_PHASE1 acceptors are
+    # legitimately one round ahead (they promised the incoming round).
+    rc_promise_ok = jnp.all(
+        state.acc_round
+        <= state.leader_round[None, :]
+        + (state.recon_phase != RC_NORMAL).astype(jnp.int32)[None, :]
+    )
     # Matchmaker bookkeeping: phases stay in range, every live old config
     # has an armed GC watermark, and per-group epochs sum to the global
     # reconfiguration counter. Trivially true when the feature is off.
@@ -1100,6 +1290,40 @@ def check_invariants(
             True,
         )
     )
+    # State machine + client table (trivially true when the SM is off —
+    # zero-width arrays, zero counters). Exactly-once: only re-issued ids
+    # are ever filtered (a fresh command always executes), so filtered <=
+    # flagged; equality holds in noop-free runs, but a failover can
+    # repair a dup's ORIGINAL slot to a noop (Leader.scala:541-575), in
+    # which case the retry legitimately executes — that is exactly-once
+    # working as intended, not a missed dedup (the host-replay test pins
+    # the exact decision per command). Residency: stored ids belong to
+    # the right group/key/client; and no client ever executes an id it
+    # never issued.
+    sm_dedup_ok = state.dups_filtered <= state.dups_seen
+    G_ = max(cfg.num_groups, 1)
+    g_col = jnp.arange(cfg.num_groups, dtype=jnp.int32)[:, None]
+    k_row = jnp.arange(state.kv_val.shape[1], dtype=jnp.int32)[None, :]
+    kv_ok = jnp.all(
+        jnp.where(
+            state.kv_val >= 0,
+            (state.kv_val % max(cfg.kv_keys, 1) == k_row)
+            & (state.kv_val % G_ == g_col),
+            True,
+        )
+    )
+    c_row = jnp.arange(state.ct_last.shape[1], dtype=jnp.int32)[None, :]
+    ct_ok = (
+        jnp.all(
+            jnp.where(
+                state.ct_last >= 0,
+                ((state.ct_last // G_) % max(cfg.num_clients, 1) == c_row)
+                & (state.ct_last % G_ == g_col),
+                True,
+            )
+        )
+        & jnp.all(state.ct_last <= state.client_last_issued)
+    )
     return {
         "quorum_ok": quorum_ok,
         "window_ok": window_ok,
@@ -1109,8 +1333,12 @@ def check_invariants(
         "vote_value_ok": vote_value_ok,
         "read_lin_ok": read_lin_ok,
         "read_ring_ok": read_ring_ok,
+        "sm_dedup_ok": sm_dedup_ok,
+        "kv_ok": kv_ok,
+        "ct_ok": ct_ok,
         "slot_horizon_ok": slot_horizon_ok,
         "recon_ok": recon_ok,
+        "rc_promise_ok": rc_promise_ok,
         "rc_books_ok": rc_books_ok,
         "mm_ok": mm_ok,
     }

@@ -139,6 +139,12 @@ class TpuSimTransport:
             out["config_epoch_max"] = int(
                 jax.device_get(self.state.config_epoch).max()
             )
+        if self.config.state_machine != "none":
+            out["sm_applied"] = int(self.state.sm_applied)
+            out["dups_filtered"] = int(self.state.dups_filtered)
+            out["kv_keys_set"] = int(
+                (jax.device_get(self.state.kv_val) >= 0).sum()
+            )
         if self.config.reads_per_tick:
             reads = int(self.state.reads_done)
             rhist = jax.device_get(self.state.read_lat_hist)

@@ -84,17 +84,23 @@ class Stepper:
     def duplicate(self, i: int) -> None:
         self.transport.duplicate_message(self.transport.messages[i])
 
-    def fire(self, i: int) -> None:
+    def occurrence_of(self, i: int) -> int:
+        """Occurrence ordinal of the i-th running timer among earlier
+        running timers sharing its (address, name) — an actor may run
+        several timers under one name (per-op retries)."""
         timer = self.transport.running_timers()[i]
-        # The i-th running timer may share (address, name) with earlier
-        # ones; fire THAT instance, not the first name match.
-        occurrence = sum(
+        return sum(
             1
             for t in self.transport.running_timers()[:i]
             if t.address == timer.address and t.name() == timer.name()
         )
+
+    def fire(self, i: int) -> None:
+        # The i-th running timer may share (address, name) with earlier
+        # ones; fire THAT instance, not the first name match.
+        timer = self.transport.running_timers()[i]
         self.transport.trigger_timer(
-            timer.address, timer.name(), occurrence=occurrence
+            timer.address, timer.name(), occurrence=self.occurrence_of(i)
         )
 
     def partition(self, address) -> None:
@@ -121,12 +127,22 @@ class Stepper:
         ]
         for line in setup_code.strip().splitlines():
             lines.append(f"    {line}")
-        lines.append("    from frankenpaxos_tpu.core import QueuedMessage, SimAddress")
+        lines.append(
+            "    from frankenpaxos_tpu.core import ("
+            "HostPort, QueuedMessage, SimAddress)"
+        )
+
+        def addr_expr(a) -> str:
+            # Clusters built from the deployment registry use HostPort
+            # role addresses on the SimTransport; sessions may mix kinds.
+            if hasattr(a, "name"):
+                return f"SimAddress({a.name!r})"
+            return f"HostPort({a.host!r}, {a.port!r})"
 
         def msg_expr(m) -> str:
             return (
-                f"QueuedMessage(SimAddress({m.src.name!r}), "
-                f"SimAddress({m.dst.name!r}), {m.data!r})"
+                f"QueuedMessage({addr_expr(m.src)}, "
+                f"{addr_expr(m.dst)}, {m.data!r})"
             )
 
         for cmd in self.transport.history:
@@ -134,8 +150,8 @@ class Stepper:
                 lines.append(f"    t.deliver_message({msg_expr(cmd.msg)})")
             elif isinstance(cmd, TriggerTimer):
                 lines.append(
-                    f"    t.trigger_timer(SimAddress({cmd.address.name!r}), "
-                    f"{cmd.name!r})"
+                    f"    t.trigger_timer({addr_expr(cmd.address)}, "
+                    f"{cmd.name!r}, occurrence={cmd.occurrence})"
                 )
             elif isinstance(cmd, DropMessage):
                 lines.append(f"    t.drop_message({msg_expr(cmd.msg)})")
@@ -143,11 +159,11 @@ class Stepper:
                 lines.append(f"    t.duplicate_message({msg_expr(cmd.msg)})")
             elif isinstance(cmd, PartitionActor):
                 lines.append(
-                    f"    t.partition_actor(SimAddress({cmd.address.name!r}))"
+                    f"    t.partition_actor({addr_expr(cmd.address)})"
                 )
             elif isinstance(cmd, UnpartitionActor):
                 lines.append(
-                    f"    t.unpartition_actor(SimAddress({cmd.address.name!r}))"
+                    f"    t.unpartition_actor({addr_expr(cmd.address)})"
                 )
         lines.append("    # TODO: add assertions about the final state.")
         return "\n".join(lines) + "\n"

@@ -280,14 +280,9 @@ class VizServer:
         elif path == "fire":
             i = self._resolve_timer(params["tok"])
             timer = s.transport.running_timers()[i]
-            occurrence = sum(
-                1
-                for t_ in s.transport.running_timers()[:i]
-                if t_.address == timer.address and t_.name() == timer.name()
-            )
             self.trace.append(
                 f"t.trigger_timer({self._addr_expr(timer.address)}, "
-                f"{timer.name()!r}, occurrence={occurrence})"
+                f"{timer.name()!r}, occurrence={s.occurrence_of(i)})"
             )
             s.fire(i)
         elif path == "partition":
@@ -304,7 +299,11 @@ class VizServer:
             s.unpartition(params["addr"])
         elif path == "deliver_all":
             self.trace.append(
-                "while t.messages: t.deliver_message(t.messages[0])"
+                # Bounded like the live Stepper.deliver_all: a retrans-
+                # mitting protocol must not turn the replay into a hang.
+                "for _ in range(100000):\n"
+                "        if not t.messages: break\n"
+                "        t.deliver_message(t.messages[0])"
             )
             s.deliver_all()
         elif path == "op":

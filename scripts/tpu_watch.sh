@@ -1,0 +1,25 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; when it comes back, run the queued perf work.
+# Writes a status line per probe to results/tpu_watch_r03.log and exits
+# after the sweep completes (or keeps polling on failure).
+cd /root/repo
+LOG=results/tpu_watch_r03.log
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert 'cpu' not in str(d).lower(), d
+x = jnp.ones((256, 256))
+(x @ x).block_until_ready()
+print(d)
+" >>"$LOG" 2>&1; then
+    echo "$ts PROBE OK - running k sweep" >>"$LOG"
+    timeout 3000 python scripts/tpu_k_sweep.py >>"$LOG" 2>&1
+    echo "$ts k sweep rc=$?" >>"$LOG"
+    exit 0
+  else
+    echo "$ts probe failed/hung" >>"$LOG"
+  fi
+  sleep 600
+done

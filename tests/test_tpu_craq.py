@@ -1,0 +1,226 @@
+"""Tests of the batched CRAQ backend (craq_batched.py) including
+cross-validation against the per-actor CRAQ protocol
+(craq/ChainNode.scala:120-299 semantics)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.tpu import craq_batched as cb
+
+
+def run_random(cfg, seed, ticks):
+    key = jax.random.PRNGKey(seed)
+    state, t = cb.run_ticks(cfg, cb.init_state(cfg), jnp.int32(0), ticks, key)
+    return state, t
+
+
+def test_craq_progress_and_invariants():
+    cfg = cb.BatchedCraqConfig(
+        num_chains=8, chain_len=4, num_keys=16, window=16,
+        writes_per_tick=2, reads_per_tick=3, read_window=16,
+        lat_min=1, lat_max=3,
+    )
+    state, t = run_random(cfg, seed=0, ticks=200)
+    inv = cb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+    s = cb.stats(cfg, state, t)
+    assert s["writes_done"] > 8 * 100  # pipeline saturates well below cap
+    assert s["reads_done"] > 8 * 100
+    # Apportioned queries: most reads are clean, some hit dirty keys.
+    assert 0.5 < s["clean_fraction"] <= 1.0
+    assert s["reads_dirty"] > 0
+    assert s["read_lin_violations"] == 0
+    # A write crosses L-1=3 hops down + 1 reply hop minimum.
+    assert s["write_latency_p50_ticks"] >= 4
+
+
+def test_craq_more_nodes_fewer_dirty_reads_per_node():
+    """The apportioned-queries payoff: read capacity spreads over the
+    chain; the dirty (tail-forwarded) fraction stays bounded as load
+    grows because only keys with in-flight writes are dirty."""
+    cfg = cb.BatchedCraqConfig(
+        num_chains=4, chain_len=3, num_keys=64, window=8,
+        writes_per_tick=1, reads_per_tick=4, read_window=32,
+        lat_min=1, lat_max=2,
+    )
+    state, t = run_random(cfg, seed=1, ticks=200)
+    s = cb.stats(cfg, state, t)
+    # 64 keys, <=8 in flight per chain: most sampled keys are clean.
+    assert s["clean_fraction"] > 0.7
+    inv = cb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def _inject_write(state, slot, key_id, version, t):
+    return dataclasses.replace(
+        state,
+        w_status=state.w_status.at[0, slot].set(cb.W_DOWN),
+        w_key=state.w_key.at[0, slot].set(key_id),
+        w_version=state.w_version.at[0, slot].set(version),
+        w_node=state.w_node.at[0, slot].set(0),
+        w_arrival=state.w_arrival.at[0, slot].set(t + 1),
+        w_issue=state.w_issue.at[0, slot].set(t),
+        next_version=state.next_version.at[0].set(version + 1),
+    )
+
+
+def _inject_read(state, slot, key_id, node, t, floor):
+    return dataclasses.replace(
+        state,
+        r_status=state.r_status.at[0, slot].set(cb.R_AT_NODE),
+        r_key=state.r_key.at[0, slot].set(key_id),
+        r_node=state.r_node.at[0, slot].set(node),
+        r_arrival=state.r_arrival.at[0, slot].set(t + 1),
+        r_issue=state.r_issue.at[0, slot].set(t),
+        r_floor=state.r_floor.at[0, slot].set(floor),
+        r_version=state.r_version.at[0, slot].set(-1),
+    )
+
+
+def test_cross_validation_craq_dirty_routing():
+    """Aligned scenario against the per-actor protocol: (1) write v0 to
+    key x and let it fully ack; (2) start write v1 and stall it at the
+    head; (3) a read at the MID node is clean and serves v0 locally;
+    (4) a read at the HEAD is dirty and is forwarded to the tail, which
+    serves v0; (5) release the write; (6) a head read is clean and
+    serves v1. Both executions must make identical routing decisions
+    and return identical values (version k <-> "v<k>")."""
+    from frankenpaxos_tpu.core import FakeLogger, SimAddress, SimTransport
+    from frankenpaxos_tpu.core.logger import LogLevel
+    from frankenpaxos_tpu.protocols import craq as cq
+    from test_fastpaxos_craq import drain, make_craq
+
+    # ---- Per-actor side.
+    t, config, nodes, clients = make_craq(n=3, num_clients=2)
+    head_addr = config.chain_node_addresses[0]
+    mid_addr = config.chain_node_addresses[1]
+    tail_addr = config.chain_node_addresses[-1]
+
+    clients[0].write(0, "x", "v0")
+    drain(t)
+    assert all(n.state_machine.get("x") == "v0" for n in nodes)
+
+    clients[0].write(0, "x", "v1")  # deliver only to the head: stalled
+    for m in [m for m in t.messages if m.dst == head_addr]:
+        t.deliver_message(m)
+    assert nodes[0].pending_writes and not nodes[1].pending_writes
+    stalled = [m for m in t.messages if m.dst == mid_addr]  # v1 -> mid
+
+    class _Pick:
+        def __init__(self, n):
+            self.n = n
+
+        def randrange(self, _):
+            return self.n
+
+    def drain_except_stalled(t):
+        for _ in range(1000):
+            pend = [m for m in t.messages if m not in stalled]
+            if not pend:
+                return
+            t.deliver_message(pend[0])
+        raise AssertionError("drain did not quiesce")
+
+    # (3) Clean read at the mid node.
+    clients[1].rng = _Pick(1)
+    r_mid = clients[1].read(0, "x")
+    drain_except_stalled(t)
+    assert r_mid.result() == "v0"
+
+    # (4) Dirty read at the head: forwarded to the tail.
+    clients[1].rng = _Pick(0)
+    r_head = clients[1].read(1, "x")
+    for m in [m for m in t.messages if m.dst == head_addr and m not in stalled]:
+        t.deliver_message(m)
+    assert any(
+        m.dst == tail_addr for m in t.messages if m not in stalled
+    ), "head must forward the dirty read to the tail"
+    drain_except_stalled(t)
+    assert r_head.result() == "v0"
+
+    # (5)+(6) Release v1; a head read is clean and serves v1.
+    drain(t)
+    assert all(n.state_machine.get("x") == "v1" for n in nodes)
+    assert not nodes[0].pending_writes
+    clients[1].rng = _Pick(0)
+    r_final = clients[1].read(2, "x")
+    drain(t)
+    assert r_final.result() == "v1"
+
+    # ---- Batched side: same chain, deterministic 1-tick hops, manual
+    # injections, no PRNG traffic.
+    cfg = cb.BatchedCraqConfig(
+        num_chains=1, chain_len=3, num_keys=2, window=4,
+        writes_per_tick=0, reads_per_tick=0, read_window=4,
+        lat_min=1, lat_max=1,
+    )
+    key = jax.random.PRNGKey(0)
+    state = cb.init_state(cfg)
+    tt = 0
+
+    def run(state, tt, n):
+        for _ in range(n):
+            state = cb.tick(cfg, state, jnp.int32(tt), jax.random.fold_in(key, tt))
+            tt += 1
+        return state, tt
+
+    # (1) Write v0 (version 0) to key 0; let it fully ack.
+    state = _inject_write(state, slot=0, key_id=0, version=0, t=tt)
+    state, tt = run(state, tt, 8)
+    assert int(state.w_status[0, 0]) == cb.W_EMPTY
+    assert np.all(np.asarray(state.node_version[0, :, 0]) == 0)
+
+    # (2) Write v1 (version 1); stall it after it passes the head.
+    state = _inject_write(state, slot=1, key_id=0, version=1, t=tt)
+    state, tt = run(state, tt, 2)  # arrives at head, marked dirty there
+    assert int(state.node_dirty[0, 0, 0]) == 1
+    assert int(state.node_dirty[0, 1, 0]) == 0
+    state = dataclasses.replace(
+        state, w_arrival=state.w_arrival.at[0, 1].set(tt + 1000)
+    )
+
+    # (3) Clean read at the mid node serves version 0 locally.
+    state = _inject_read(state, slot=0, key_id=0, node=1, t=tt,
+                         floor=int(state.node_version[0, 2, 0]))
+    state, tt = run(state, tt, 3)
+    assert int(state.reads_clean) == 1 and int(state.reads_dirty) == 0
+    assert int(state.reads_done) == 1
+    # The completed read slot recorded the served version before clearing.
+    # (r_version persists after completion until slot reuse.)
+    assert int(state.r_version[0, 0]) == 0
+
+    # (4) Dirty read at the head goes via the tail, serves version 0.
+    state = _inject_read(state, slot=1, key_id=0, node=0, t=tt,
+                         floor=int(state.node_version[0, 2, 0]))
+    state, tt = run(state, tt, 4)
+    assert int(state.reads_dirty) == 1
+    assert int(state.reads_done) == 2
+    assert int(state.r_version[0, 1]) == 0
+
+    # (5) Release v1 and let it commit + ack everywhere.
+    state = dataclasses.replace(
+        state, w_arrival=state.w_arrival.at[0, 1].set(tt + 1)
+    )
+    state, tt = run(state, tt, 8)
+    assert int(state.w_status[0, 1]) == cb.W_EMPTY
+    assert np.all(np.asarray(state.node_version[0, :, 0]) == 1)
+    assert int(state.node_dirty[0, 0, 0]) == 0
+
+    # (6) Head read is clean now and serves version 1.
+    state = _inject_read(state, slot=2, key_id=0, node=0, t=tt,
+                         floor=int(state.node_version[0, 2, 0]))
+    state, tt = run(state, tt, 3)
+    assert int(state.reads_clean) == 2
+    assert int(state.r_version[0, 2]) == 1
+
+    inv = cb.check_invariants(cfg, state, jnp.int32(tt))
+    assert all(bool(v) for v in inv.values()), inv
+
+    # Alignment: per-actor returned (v0, v0, v1); batched returned
+    # versions (0, 0, 1) with identical clean/dirty routing at each step.
+    assert [r_mid.result(), r_head.result(), r_final.result()] == [
+        "v0", "v0", "v1"
+    ]

@@ -163,3 +163,27 @@ def test_feature_off_is_inert():
     assert int(jax.device_get(sim.state.recon_phase).max()) == RC_NORMAL
     assert "reconfigurations" not in sim.stats()
     assert all(sim.check_invariants().values())
+
+
+def test_straggler_messages_with_wide_latency_spread():
+    """lat_max=3 (the bench/config4 setting) makes some Phase1a/MatchB
+    messages arrive AFTER their reconfiguration wave completes. A
+    straggler must promise the round its message was sent for (not the
+    live, already-bumped round — which would lock it out of voting,
+    starving thrifty quorums for retry_timeout ticks), and stale replies
+    must never count toward the NEXT wave's quorums."""
+    sim = TpuSimTransport(
+        make(lat_min=1, lat_max=3, reconfigure_every=12, retry_timeout=16),
+        seed=2,
+    )
+    committed_prev = 0
+    for _ in range(8):
+        sim.run(30)
+        inv = sim.check_invariants()
+        assert all(inv.values()), inv
+        s = sim.stats()
+        # Progress continues across every wave (no locked-out acceptors
+        # starving the thrifty quorums).
+        assert s["committed"] > committed_prev
+        committed_prev = s["committed"]
+    assert sim.stats()["reconfigurations"] >= 15

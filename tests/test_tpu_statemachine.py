@@ -1,0 +1,189 @@
+"""Tests of the device-side replica state machine + client table in the
+batched MultiPaxos backend (Replica.executeCommand, Replica.scala:305-344:
+client-table dedup then stateMachine.run; ClientTable.scala;
+KeyValueStore.scala). CPU backend, 8 virtual devices via conftest."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from frankenpaxos_tpu.parallel import make_mesh, run_ticks_sharded, shard_state
+from frankenpaxos_tpu.tpu import (
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    init_state,
+    run_ticks,
+)
+
+
+def make(**kw):
+    defaults = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=3, retry_timeout=4,
+        state_machine="kv", kv_keys=8, num_clients=4, dup_rate=0.4,
+    )
+    defaults.update(kw)
+    return BatchedMultiPaxosConfig(**defaults)
+
+
+def test_sm_counters_conserve_and_dups_filtered():
+    sim = TpuSimTransport(make(), seed=0)
+    sim.run(100)
+    stats = sim.stats()
+    inv = sim.check_invariants()
+    assert all(inv.values()), inv
+    # With no failovers there are no noops: every retired slot is a real
+    # command, so it either applied to the SM or was filtered as a dup.
+    assert stats["sm_applied"] + stats["dups_filtered"] == stats["executed"]
+    assert stats["dups_filtered"] > 0  # dup_rate=0.4 must actually inject
+    assert stats["sm_applied"] > 0
+    assert 0 < stats["kv_keys_set"] <= 4 * 8
+
+
+def test_sm_off_is_inert_and_asserts_dup_rate():
+    sim = TpuSimTransport(
+        BatchedMultiPaxosConfig(
+            f=1, num_groups=4, window=16, slots_per_tick=2
+        ),
+        seed=0,
+    )
+    sim.run(30)
+    assert int(sim.state.sm_applied) == 0
+    assert sim.state.kv_val.shape == (4, 0)
+    assert all(sim.check_invariants().values())
+    try:
+        BatchedMultiPaxosConfig(
+            f=1, num_groups=4, window=16, slots_per_tick=2, dup_rate=0.1
+        )
+        assert False, "dup_rate without state_machine must be rejected"
+    except AssertionError:
+        pass
+
+
+def test_sm_host_replay_is_exact():
+    """Reconstruct every retired command from tick-by-tick snapshots and
+    replay the client-table + KV semantics in plain Python (an independent
+    implementation of ClientTable.executed + KeyValueStore.run); the
+    device state must match field-for-field."""
+    cfg = make(num_groups=3, window=16, slots_per_tick=2, kv_keys=8,
+               num_clients=4, dup_rate=0.4)
+    G, W, NC, KV = 3, 16, 4, 8
+    sim = TpuSimTransport(cfg, seed=3)
+
+    ct = np.full((G, NC), -1, np.int64)
+    kv = np.full((G, KV), -1, np.int64)
+    applied = 0
+    filtered = 0
+    for _ in range(80):
+        head_b = np.asarray(jax.device_get(sim.state.head), np.int64)
+        chosen_b = np.asarray(jax.device_get(sim.state.chosen_value), np.int64)
+        sim.run(1)
+        head_a = np.asarray(jax.device_get(sim.state.head), np.int64)
+        for g in range(G):
+            for s in range(head_b[g], head_a[g]):
+                cmd = chosen_b[g, s % W]
+                assert cmd >= 0, "no noops in a failure-free run"
+                client = (cmd // G) % NC
+                if cmd > ct[g, client]:
+                    ct[g, client] = cmd
+                    kv[g, cmd % KV] = max(kv[g, cmd % KV], cmd)
+                    applied += 1
+                else:
+                    filtered += 1
+
+    assert applied == int(sim.state.sm_applied)
+    assert filtered == int(sim.state.dups_filtered)
+    # kv stores NO_VALUE=-2 for never-written keys; the replay used -1.
+    dev_kv = np.asarray(jax.device_get(sim.state.kv_val), np.int64)
+    assert np.array_equal(np.where(dev_kv < 0, -1, dev_kv), kv)
+    assert np.array_equal(
+        np.asarray(jax.device_get(sim.state.ct_last), np.int64), ct
+    )
+    assert filtered > 0  # the scenario actually exercised dedup
+    assert all(sim.check_invariants().values())
+
+
+def test_sm_host_replay_with_failovers_is_exact():
+    """The adversarial version of the replay test: repeated failovers
+    noop-repair unvoted slots, so a client's retry can EXECUTE (its
+    original was lost) and chained retries of the same id can retire in
+    one batch. The sequential Python replay is the ground truth for
+    exactly-once under all of it."""
+    cfg = make(num_groups=3, window=16, slots_per_tick=2, kv_keys=8,
+               num_clients=4, dup_rate=0.5, drop_rate=0.15,
+               retry_timeout=12, lat_min=2, lat_max=4)
+    G, W, NC, KV = 3, 16, 4, 8
+    sim = TpuSimTransport(cfg, seed=11)
+
+    ct = np.full((G, NC), -1, np.int64)
+    kv = np.full((G, KV), -1, np.int64)
+    applied = 0
+    filtered = 0
+    noops = 0
+    for step in range(140):
+        head_b = np.asarray(jax.device_get(sim.state.head), np.int64)
+        chosen_b = np.asarray(jax.device_get(sim.state.chosen_value), np.int64)
+        if step % 20 == 19:
+            sim.leader_change()
+        sim.run(1)
+        head_a = np.asarray(jax.device_get(sim.state.head), np.int64)
+        for g in range(G):
+            for s in range(head_b[g], head_a[g]):
+                cmd = chosen_b[g, s % W]
+                if cmd < 0:  # noop-repaired slot: the SM skips it
+                    noops += 1
+                    continue
+                client = (cmd // G) % NC
+                if cmd > ct[g, client]:
+                    ct[g, client] = cmd
+                    kv[g, cmd % KV] = max(kv[g, cmd % KV], cmd)
+                    applied += 1
+                else:
+                    filtered += 1
+
+    assert applied == int(sim.state.sm_applied)
+    assert filtered == int(sim.state.dups_filtered)
+    dev_kv = np.asarray(jax.device_get(sim.state.kv_val), np.int64)
+    assert np.array_equal(np.where(dev_kv < 0, -1, dev_kv), kv)
+    assert np.array_equal(
+        np.asarray(jax.device_get(sim.state.ct_last), np.int64), ct
+    )
+    assert noops > 0, "the scenario must actually produce noop repairs"
+    assert filtered > 0
+    assert all(sim.check_invariants().values())
+
+
+def test_sm_survives_failover_noops():
+    """Leader failover repairs unvoted slots to noops; the SM must skip
+    them (noops don't touch the KV store) and exactly-once bookkeeping
+    must still balance."""
+    sim = TpuSimTransport(make(drop_rate=0.05), seed=5)
+    sim.run(25)
+    sim.leader_change()
+    sim.run(25)
+    sim.leader_change()
+    sim.run(40)
+    stats = sim.stats()
+    inv = sim.check_invariants()
+    assert all(inv.values()), inv
+    # Noops retire without applying, so applied + filtered <= executed.
+    assert stats["sm_applied"] + stats["dups_filtered"] <= stats["executed"]
+    assert stats["sm_applied"] > 0
+
+
+def test_sm_sharded_matches_unsharded():
+    cfg = make(num_groups=8, window=16, slots_per_tick=2)
+    key = jax.random.PRNGKey(7)
+    t0 = jax.numpy.zeros((), jax.numpy.int32)
+    plain_state, plain_t = run_ticks(cfg, init_state(cfg), t0, 100, key)
+    mesh = make_mesh()
+    sharded0 = shard_state(init_state(cfg), mesh)
+    sharded_state, sharded_t = run_ticks_sharded(
+        cfg, mesh, sharded0, t0, 100, key
+    )
+    assert int(plain_t) == int(sharded_t)
+    for field in dataclasses.fields(plain_state):
+        a = jax.device_get(getattr(plain_state, field.name))
+        b = jax.device_get(getattr(sharded_state, field.name))
+        assert np.array_equal(a, b), field.name
