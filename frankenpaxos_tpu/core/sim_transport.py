@@ -174,6 +174,20 @@ class SimTransport(Transport):
     def running_timers(self) -> List[SimTimer]:
         return list(self._running_timers)
 
+    def timer_occurrence(self, i: int) -> int:
+        """Occurrence ordinal of the i-th running timer among earlier
+        running timers sharing its (address, name) — an actor may run
+        several timers under one name (per-op retries). The single
+        source of truth for occurrence numbering (command generation,
+        the Stepper, and replay all use it)."""
+        running = self.running_timers()
+        timer = running[i]
+        return sum(
+            1
+            for u in running[:i]
+            if u.address == timer.address and u._name == timer._name
+        )
+
     def deliver_message(self, msg: QueuedMessage, record: bool = True) -> None:
         """Deliver (and remove) the first pending message structurally equal
         to ``msg`` (FakeTransport.scala:142-159). No-op if absent or if an
@@ -263,12 +277,9 @@ class SimTransport(Transport):
         if i < n_msgs:
             return DeliverMessage(self.messages[i])
         t = running[i - n_msgs]
-        occ = sum(
-            1
-            for u in running[: i - n_msgs]
-            if u.address == t.address and u._name == t._name
+        return TriggerTimer(
+            t.address, t._name, self.timer_occurrence(i - n_msgs)
         )
-        return TriggerTimer(t.address, t._name, occ)
 
     def run_command(self, cmd: SimCommand, record: bool = True) -> None:
         if isinstance(cmd, DeliverMessage):

@@ -260,10 +260,7 @@ def mixed_command(rng: random.Random, transport, op_choices):
     if choice == "__timer__":
         i = rng.randrange(len(running))
         timer = running[i]
-        occ = sum(
-            1
-            for u in running[:i]
-            if u.address == timer.address and u.name() == timer.name()
+        return TriggerTimer(
+            timer.address, timer.name(), transport.timer_occurrence(i)
         )
-        return TriggerTimer(timer.address, timer.name(), occ)
     return choice

@@ -85,15 +85,9 @@ class Stepper:
         self.transport.duplicate_message(self.transport.messages[i])
 
     def occurrence_of(self, i: int) -> int:
-        """Occurrence ordinal of the i-th running timer among earlier
-        running timers sharing its (address, name) — an actor may run
-        several timers under one name (per-op retries)."""
-        timer = self.transport.running_timers()[i]
-        return sum(
-            1
-            for t in self.transport.running_timers()[:i]
-            if t.address == timer.address and t.name() == timer.name()
-        )
+        """Occurrence ordinal of the i-th running timer (see
+        SimTransport.timer_occurrence, the single source of truth)."""
+        return self.transport.timer_occurrence(i)
 
     def fire(self, i: int) -> None:
         # The i-th running timer may share (address, name) with earlier

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Refresh results/batched_backends_cpu.json: per-family throughput
+snapshots of every batched backend (one in-session process, so numbers
+are conservative vs bench.py's clean-subprocess measurement). Warmup
+segments use the SAME tick count as measured segments: run_ticks
+specializes on num_ticks, so a different length would recompile inside
+the timed region."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu import (
+    BatchedCraqConfig,
+    BatchedEPaxosConfig,
+    BatchedMenciusConfig,
+    BatchedMultiPaxosConfig,
+    TpuSimTransport,
+    craq_batched,
+    epaxos_batched,
+    mencius_batched,
+    scalog_batched,
+)
+from frankenpaxos_tpu.tpu.scalog_batched import BatchedScalogConfig
+
+out = {
+    "device": str(jax.devices()[0]),
+    "note": "per-family batched-backend throughput snapshots",
+}
+
+
+def timed(warm, measure):
+    warm()
+    t0 = time.perf_counter()
+    n = measure()
+    return n, time.perf_counter() - t0
+
+
+# MultiPaxos @ 10k acceptors (write path only, the bench.py headline).
+mp = TpuSimTransport(
+    BatchedMultiPaxosConfig(
+        f=1, num_groups=3334, window=64, slots_per_tick=8,
+        lat_min=1, lat_max=3, retry_timeout=16,
+    ),
+    seed=0,
+)
+mp.run(400); mp.block_until_ready()
+c0 = mp.committed()
+t0 = time.perf_counter()
+mp.run(400); mp.block_until_ready()
+dt = time.perf_counter() - t0
+out["multipaxos_10k_acceptors"] = {
+    "committed_per_sec": int((mp.committed() - c0) / dt),
+    "ticks_per_sec": round(400 / dt, 1),
+}
+
+# MultiPaxos + device-side SM + client table (the full SMR pipeline).
+sm = TpuSimTransport(
+    BatchedMultiPaxosConfig(
+        f=1, num_groups=3334, window=64, slots_per_tick=8,
+        lat_min=1, lat_max=3, retry_timeout=16,
+        state_machine="kv", kv_keys=64, num_clients=8, dup_rate=0.02,
+    ),
+    seed=0,
+)
+sm.run(400); sm.block_until_ready()
+a0 = int(sm.state.sm_applied)
+t0 = time.perf_counter()
+sm.run(400); sm.block_until_ready()
+dt = time.perf_counter() - t0
+out["multipaxos_10k_acceptors_with_smr"] = {
+    "sm_applied_per_sec": int((int(sm.state.sm_applied) - a0) / dt),
+    "dups_filtered": int(sm.state.dups_filtered),
+}
+
+# EPaxos @ 64 columns.
+ecfg = BatchedEPaxosConfig(num_columns=64)
+estate = epaxos_batched.init_state(ecfg)
+estate, _ = epaxos_batched.run_ticks(
+    ecfg, estate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(estate)
+e0 = int(estate.executed_total)
+t0 = time.perf_counter()
+estate, _ = epaxos_batched.run_ticks(
+    ecfg, estate, jnp.int32(200), 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(estate)
+dt = time.perf_counter() - t0
+out["epaxos_64_columns"] = {
+    "executed_per_sec": int((int(estate.executed_total) - e0) / dt)
+}
+
+# Mencius @ 256 leaders.
+mcfg = BatchedMenciusConfig(
+    f=1, num_leaders=256, window=32, slots_per_tick=4, num_idle_leaders=64
+)
+mstate = mencius_batched.init_state(mcfg)
+mstate, _ = mencius_batched.run_ticks(
+    mcfg, mstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(mstate)
+m0 = int(mstate.executed_global)
+t0 = time.perf_counter()
+mstate, _ = mencius_batched.run_ticks(
+    mcfg, mstate, jnp.int32(200), 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(mstate)
+dt = time.perf_counter() - t0
+out["mencius_256_leaders"] = {
+    "globally_executed_per_sec": int((int(mstate.executed_global) - m0) / dt),
+    "skips": int(mstate.skips),
+}
+
+# Scalog @ 256 shards.
+scfg = BatchedScalogConfig(num_shards=256, appends_per_tick=8)
+sstate = scalog_batched.init_state(scfg)
+sstate, _ = scalog_batched.run_ticks(
+    scfg, sstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(sstate)
+g0 = int(sstate.global_len)
+t0 = time.perf_counter()
+sstate, _ = scalog_batched.run_ticks(
+    scfg, sstate, jnp.int32(200), 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(sstate)
+dt = time.perf_counter() - t0
+out["scalog_256_shards"] = {
+    "ordered_records_per_sec": int((int(sstate.global_len) - g0) / dt),
+    "mean_ordering_lag_ticks": round(
+        float(sstate.lat_sum) / max(1, int(sstate.lat_count)), 2
+    ),
+}
+
+# CRAQ @ 256 chains of 4 (apportioned reads).
+ccfg = BatchedCraqConfig(
+    num_chains=256, chain_len=4, num_keys=64, window=16,
+    writes_per_tick=2, reads_per_tick=4, read_window=32,
+)
+cstate = craq_batched.init_state(ccfg)
+cstate, ct = craq_batched.run_ticks(
+    ccfg, cstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(cstate)
+w0, r0 = int(cstate.writes_done), int(cstate.reads_done)
+t0 = time.perf_counter()
+cstate, ct = craq_batched.run_ticks(
+    ccfg, cstate, ct, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(cstate)
+dt = time.perf_counter() - t0
+cs = craq_batched.stats(ccfg, cstate, ct)
+out["craq_256_chains_of_4"] = {
+    "writes_per_sec": int((int(cstate.writes_done) - w0) / dt),
+    "reads_per_sec": int((int(cstate.reads_done) - r0) / dt),
+    "clean_read_fraction": round(cs["clean_fraction"], 3),
+}
+
+with open("results/batched_backends_cpu.json", "w") as f:
+    json.dump(out, f, indent=2)
+print(json.dumps(out, indent=2))

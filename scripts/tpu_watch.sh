@@ -16,8 +16,11 @@ print(d)
 " >>"$LOG" 2>&1; then
     echo "$ts PROBE OK - running k sweep" >>"$LOG"
     timeout 3000 python scripts/tpu_k_sweep.py >>"$LOG" 2>&1
-    echo "$ts k sweep rc=$?" >>"$LOG"
-    exit 0
+    rc=$?
+    echo "$ts k sweep rc=$rc" >>"$LOG"
+    # Only stop once the sweep actually completed; a tunnel drop
+    # mid-sweep goes back to polling.
+    [ "$rc" -eq 0 ] && exit 0
   else
     echo "$ts probe failed/hung" >>"$LOG"
   fi
