@@ -11,8 +11,13 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 from frankenpaxos_tpu.tpu import (
     craq_batched,
     epaxos_batched,
+    fastpaxos_batched,
     mencius_batched,
     scalog_batched,
+)
+from frankenpaxos_tpu.tpu.fastpaxos_batched import (
+    BatchedFastPaxosConfig,
+    BatchedFastPaxosState,
 )
 from frankenpaxos_tpu.tpu.craq_batched import (
     BatchedCraqConfig,
@@ -44,6 +49,9 @@ __all__ = [
     "craq_batched",
     "BatchedEPaxosConfig",
     "BatchedEPaxosState",
+    "BatchedFastPaxosConfig",
+    "BatchedFastPaxosState",
+    "fastpaxos_batched",
     "BatchedMenciusConfig",
     "BatchedMenciusState",
     "BatchedMultiPaxosConfig",

@@ -1,0 +1,474 @@
+"""Batched Fast Paxos as a single XLA program.
+
+Fast Paxos (reference ``fastpaxos/``; per-actor analog
+``protocols/fastpaxos.py``): clients propose straight to the acceptors in
+fast round 0 and count Phase2bs themselves; a FAST quorum of
+``f + ⌊(f+1)/2⌋ + 1`` identical round-0 votes (of ``n = 2f+1``) chooses
+without a leader. Colliding proposals fall back to a classic round: the
+leader runs phase 1, and for round-0 votes the O4 rule applies — a value
+voted by a MAJORITY OF A QUORUM (``⌊(f+1)/2⌋ + 1``) must be picked
+(``fastpaxos/Leader.scala``; ``Util.popularItems``), else any value is
+safe (we use proposer 0's, the leader-default of the per-actor impl).
+
+TPU-first design: ``G x W`` independent single-decree instances are the
+replica axis (each group's ring retires chosen instances and admits new
+ones — consensus instances, not log slots, because Fast Paxos here is
+single-decree). Per instance TWO candidate proposers race; with
+``conflict_rate`` both propose (the collision the fast path cannot
+absorb). Acceptors vote round-0 for the FIRST arrival; simultaneous
+arrivals break toward proposer 0 (a deterministic stand-in for link
+order). A recovery timeout moves a stuck instance to the classic path
+even while round-0 votes are still in flight — the case that makes the
+O4 rule load-bearing: the classic round must re-discover a possibly
+fast-chosen value from the phase-1 vote reports alone.
+
+The safety ledger ``fp_committed_value`` records, per instance, any value
+that ever held a fast quorum of round-0 votes in the acceptor arrays
+(whether or not a counter observed it); ``check_invariants`` asserts the
+finally chosen value never disagrees with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+
+# Instance status.
+I_EMPTY = 0
+I_FAST = 1  # round-0 proposals / votes in flight
+I_REC1 = 2  # classic phase 1 in flight
+I_REC2 = 3  # classic phase 2 in flight
+I_CHOSEN = 4
+
+NO_VALUE = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedFastPaxosConfig:
+    """G groups x W in-flight single-decree instances, n = 2f+1 acceptors
+    per group."""
+
+    f: int = 1
+    num_groups: int = 4
+    window: int = 16  # W: in-flight instances per group
+    instances_per_tick: int = 2  # K: new instances issued per group
+    conflict_rate: float = 0.2  # P(both proposers race on an instance)
+    lat_min: int = 1
+    lat_max: int = 3
+    recovery_timeout: int = 12  # ticks in I_FAST before classic recovery
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def classic_quorum(self) -> int:
+        return self.f + 1
+
+    @property
+    def quorum_majority(self) -> int:
+        return (self.f + 1) // 2 + 1
+
+    @property
+    def fast_quorum(self) -> int:
+        return self.f + self.quorum_majority
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.window >= 2 * self.instances_per_tick
+        assert 0.0 <= self.conflict_rate <= 1.0
+        assert 1 <= self.lat_min <= self.lat_max
+        assert self.recovery_timeout >= 2 * self.lat_max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedFastPaxosState:
+    """Shapes: [G, W] instances, [A, G, W] per-acceptor."""
+
+    status: jnp.ndarray  # [G, W] I_*
+    conflicted: jnp.ndarray  # [G, W] both proposers raced
+    issue_tick: jnp.ndarray  # [G, W]
+    rec_value: jnp.ndarray  # [G, W] value the classic round proposes
+    chosen_value: jnp.ndarray  # [G, W] (NO_VALUE until chosen)
+    chosen_fast: jnp.ndarray  # [G, W] chosen on the fast path
+    retire_at: jnp.ndarray  # [G, W] tick a chosen instance leaves the ring
+    next_inst: jnp.ndarray  # [G] per-group instance sequence number
+    inst_id: jnp.ndarray  # [G, W] instance sequence number in the slot
+
+    # Acceptors (per instance: single-decree state).
+    acc_round: jnp.ndarray  # [A, G, W] 0 = fast round, 1 = classic
+    vote_round: jnp.ndarray  # [A, G, W] -1 = none
+    vote_value: jnp.ndarray  # [A, G, W]
+    p0_arrival: jnp.ndarray  # [A, G, W] proposer-0 round-0 proposal
+    p1_arrival: jnp.ndarray  # [A, G, W] proposer-1 round-0 proposal
+    dn_arrival: jnp.ndarray  # [A, G, W] classic-phase message to acceptor
+    up_arrival: jnp.ndarray  # [A, G, W] reply back to the counter
+
+    # Safety ledger: any value that ever held a fast quorum of round-0
+    # votes (set once, device-side).
+    fp_committed_value: jnp.ndarray  # [G, W]
+
+    # Stats.
+    chosen_total: jnp.ndarray  # []
+    chosen_fast_total: jnp.ndarray  # []
+    conflicts_total: jnp.ndarray  # []
+    recoveries: jnp.ndarray  # []
+    safety_violations: jnp.ndarray  # [] chosen != fp_committed ledger
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
+    G, W, A = cfg.num_groups, cfg.window, cfg.n
+    return BatchedFastPaxosState(
+        status=jnp.zeros((G, W), jnp.int32),
+        conflicted=jnp.zeros((G, W), bool),
+        issue_tick=jnp.full((G, W), INF, jnp.int32),
+        rec_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        chosen_fast=jnp.zeros((G, W), bool),
+        retire_at=jnp.full((G, W), INF, jnp.int32),
+        next_inst=jnp.zeros((G,), jnp.int32),
+        inst_id=jnp.full((G, W), -1, jnp.int32),
+        acc_round=jnp.zeros((A, G, W), jnp.int32),
+        vote_round=jnp.full((A, G, W), -1, jnp.int32),
+        vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
+        p0_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        p1_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        dn_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        up_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        fp_committed_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        chosen_total=jnp.zeros((), jnp.int32),
+        chosen_fast_total=jnp.zeros((), jnp.int32),
+        conflicts_total=jnp.zeros((), jnp.int32),
+        recoveries=jnp.zeros((), jnp.int32),
+        safety_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _values_of(inst_id: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The two candidate values of an instance: 2*id and 2*id+1 (globally
+    distinct, parity = proposer)."""
+    return inst_id * 2, inst_id * 2 + 1
+
+
+def tick(
+    cfg: BatchedFastPaxosConfig,
+    state: BatchedFastPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedFastPaxosState:
+    G, W, A = cfg.num_groups, cfg.window, cfg.n
+    FQ, CQ, MAJ = cfg.fast_quorum, cfg.classic_quorum, cfg.quorum_majority
+    k3, k2 = jax.random.split(key)
+    bits3 = jax.random.bits(k3, (A, G, W))  # [0:8) p0 lat, [8:16) p1 lat,
+    #                                         [16:24) dn lat, [24:32) up lat
+    bits2 = jax.random.bits(k2, (G, W))  # [0:8) conflict, [8:16) retire lat
+    p0_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p1_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    dn_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    up_lat = bit_latency(bits3, 24, cfg.lat_min, cfg.lat_max)
+    ret_lat = bit_latency(bits2, 8, cfg.lat_min, cfg.lat_max)
+
+    status = state.status
+    v0, v1 = _values_of(state.inst_id)
+
+    # ---- 1. Acceptors process round-0 proposals (FpAcceptor: vote iff
+    # still in round 0 and unvoted; first arrival wins, simultaneous
+    # arrivals break toward proposer 0).
+    p0_now = state.p0_arrival == t
+    p1_now = state.p1_arrival == t
+    can_fast = (state.acc_round == 0) & (state.vote_round < 0)
+    take0 = p0_now & can_fast
+    take1 = p1_now & can_fast & ~take0
+    voted = take0 | take1
+    vote_round = jnp.where(voted, 0, state.vote_round)
+    vote_value = jnp.where(
+        take0, v0[None, :, :], jnp.where(take1, v1[None, :, :], state.vote_value)
+    )
+    up_arrival = jnp.where(voted, t + up_lat, state.up_arrival)
+    # A second proposal arriving later at a voted/promoted acceptor is
+    # simply dropped (the acceptor nacks in the reference; the counter
+    # here never needs the nack — timeouts cover it).
+    p0_arrival = jnp.where(p0_now, INF, state.p0_arrival)
+    p1_arrival = jnp.where(p1_now, INF, state.p1_arrival)
+
+    # ---- 2. Classic-phase messages at acceptors (dn_arrival): phase 1a
+    # promotes to round 1 and reports votes; phase 2a (status I_REC2 at
+    # the counter by the time it was sent) casts a round-1 vote.
+    dn_now = state.dn_arrival == t
+    p1a_now = dn_now & (status == I_REC1)[None, :, :]
+    p2a_now = dn_now & (status == I_REC2)[None, :, :]
+    acc_round = jnp.where(p1a_now | p2a_now, 1, state.acc_round)
+    vote_round = jnp.where(p2a_now, 1, vote_round)
+    vote_value = jnp.where(p2a_now, state.rec_value[None, :, :], vote_value)
+    up_arrival = jnp.where(p1a_now | p2a_now, t + up_lat, up_arrival)
+    dn_arrival = jnp.where(dn_now, INF, state.dn_arrival)
+
+    # ---- 3. Safety ledger: a value holding a FAST quorum of round-0
+    # votes in the acceptor arrays is committed, observed or not.
+    n_v0 = jnp.sum((vote_round == 0) & (vote_value == v0[None, :, :]), axis=0)
+    n_v1 = jnp.sum((vote_round == 0) & (vote_value == v1[None, :, :]), axis=0)
+    fast_committed = jnp.where(
+        n_v0 >= FQ, v0, jnp.where(n_v1 >= FQ, v1, NO_VALUE)
+    )
+    fp_committed_value = jnp.where(
+        (state.fp_committed_value == NO_VALUE) & (fast_committed >= 0),
+        fast_committed,
+        state.fp_committed_value,
+    )
+
+    # ---- 4. Counters observe replies. Replies carry the acceptor's
+    # (vote_round, vote_value); an arrived reply is up_arrival <= t.
+    arrived = up_arrival <= t
+
+    # (a) Fast path (FpClient.handlePhase2b): FQ identical round-0 votes
+    # among arrived replies choose the value.
+    a_v0 = jnp.sum(
+        arrived & (vote_round == 0) & (vote_value == v0[None, :, :]), axis=0
+    )
+    a_v1 = jnp.sum(
+        arrived & (vote_round == 0) & (vote_value == v1[None, :, :]), axis=0
+    )
+    fast_ok = (status == I_FAST) & ((a_v0 >= FQ) | (a_v1 >= FQ))
+    fast_val = jnp.where(a_v0 >= FQ, v0, v1)
+
+    # (b) Fast-path exhaustion or timeout -> classic recovery
+    # (FpLeader.leaderChange / repropose): all n replies arrived with no
+    # fast quorum, or the instance sat in I_FAST for recovery_timeout.
+    n_arrived = jnp.sum(arrived, axis=0)
+    stuck = (status == I_FAST) & ~fast_ok & (
+        (n_arrived >= A)
+        | (t - state.issue_tick >= cfg.recovery_timeout)
+    )
+
+    # (c) Phase-1 completion (FpLeader.handlePhase1b): a classic quorum
+    # of replies; k = max vote round among them; k == 1 -> that value;
+    # k == 0 -> the O4 rule (a popular value — MAJ votes — must be
+    # picked; argmax count is safe because a fast-committed value
+    # dominates every other); no votes -> proposer 0's value.
+    rec1_done = (status == I_REC1) & (n_arrived >= CQ)
+    any_r1 = jnp.any(arrived & (vote_round == 1), axis=0)
+    # All round-1 votes in an instance carry rec_value, so "the value of
+    # the max-round vote" is rec_value itself when any round-1 vote is
+    # visible.
+    popular = jnp.where(
+        (a_v0 >= MAJ) | ((a_v0 >= a_v1) & (a_v0 > 0)), v0,
+        jnp.where(a_v1 > 0, v1, v0),
+    )
+    # Exact O4: prefer the value meeting the majority-of-quorum bound;
+    # among values below it any pick is safe (nothing can be committed).
+    popular = jnp.where(
+        a_v1 >= MAJ, jnp.where(a_v0 >= jnp.maximum(a_v1, MAJ), v0, v1), popular
+    )
+    rec_value = jnp.where(
+        rec1_done,
+        jnp.where(any_r1, state.rec_value, popular),
+        state.rec_value,
+    )
+
+    # (d) Phase-2 completion: CQ round-1 votes for rec_value.
+    a_r1 = jnp.sum(
+        arrived
+        & (vote_round == 1)
+        & (vote_value == state.rec_value[None, :, :]),
+        axis=0,
+    )
+    rec2_done = (status == I_REC2) & (a_r1 >= CQ)
+
+    # ---- 5. Transitions.
+    newly_chosen = fast_ok | rec2_done
+    chosen_value = jnp.where(
+        fast_ok, fast_val,
+        jnp.where(rec2_done, state.rec_value, state.chosen_value),
+    )
+    chosen_fast = jnp.where(newly_chosen, fast_ok, state.chosen_fast)
+    safety_violations = state.safety_violations + jnp.sum(
+        newly_chosen
+        & (fp_committed_value >= 0)
+        & (chosen_value != fp_committed_value)
+    )
+    retire_at = jnp.where(newly_chosen, t + ret_lat, state.retire_at)
+    status = jnp.where(newly_chosen, I_CHOSEN, status)
+
+    # Recovery kickoff: clear stale round-0 replies, send phase 1a.
+    status = jnp.where(stuck, I_REC1, status)
+    up_arrival = jnp.where(stuck[None, :, :], INF, up_arrival)
+    dn_arrival = jnp.where(stuck[None, :, :], t + dn_lat, dn_arrival)
+    recoveries = state.recoveries + jnp.sum(stuck)
+
+    # Phase 1 -> phase 2: clear phase-1 replies, send phase 2a.
+    status = jnp.where(rec1_done, I_REC2, status)
+    up_arrival = jnp.where(rec1_done[None, :, :], INF, up_arrival)
+    dn_arrival = jnp.where(rec1_done[None, :, :], t + dn_lat, dn_arrival)
+
+    # Stats at choice.
+    lat = jnp.where(newly_chosen, t - state.issue_tick, 0)
+    chosen_total = state.chosen_total + jnp.sum(newly_chosen)
+    chosen_fast_total = state.chosen_fast_total + jnp.sum(fast_ok)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 6. Retire chosen instances whose decision reached the learner.
+    retire = (status == I_CHOSEN) & (retire_at <= t)
+    status = jnp.where(retire, I_EMPTY, status)
+    clear3 = retire[None, :, :]
+    acc_round = jnp.where(clear3, 0, acc_round)
+    vote_round = jnp.where(clear3, -1, vote_round)
+    vote_value = jnp.where(clear3, NO_VALUE, vote_value)
+    up_arrival = jnp.where(clear3, INF, up_arrival)
+    dn_arrival = jnp.where(clear3, INF, dn_arrival)
+    # Also discard the retired instance's still-in-flight round-0
+    # proposals: a slow proposal firing into the slot's NEXT instance
+    # would be a phantom vote for a value nobody proposed.
+    p0_arrival = jnp.where(clear3, INF, p0_arrival)
+    p1_arrival = jnp.where(clear3, INF, p1_arrival)
+    issue_tick = jnp.where(retire, INF, state.issue_tick)
+    rec_value = jnp.where(retire, NO_VALUE, rec_value)
+    chosen_value_r = jnp.where(retire, NO_VALUE, chosen_value)
+    chosen_fast = jnp.where(retire, False, chosen_fast)
+    retire_at = jnp.where(retire, INF, retire_at)
+    fp_committed_value = jnp.where(retire, NO_VALUE, fp_committed_value)
+    inst_id = jnp.where(retire, -1, state.inst_id)
+
+    # ---- 7. Issue new instances (K per group) into empty slots; with
+    # conflict_rate both proposers race, else proposer 0 alone.
+    empty = status == I_EMPTY
+    rank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
+    issue = empty & (rank <= cfg.instances_per_tick)
+    count = jnp.sum(issue, axis=1)
+    # Globally unique id: (per-group sequence number) * G + group.
+    new_id = (state.next_inst[:, None] + rank - 1) * G + jnp.arange(
+        G, dtype=jnp.int32
+    )[:, None]
+    inst_id = jnp.where(issue, new_id, inst_id)
+    conflict_field = ((bits2 >> 0) & jnp.uint32(0xFF)).astype(jnp.int32)
+    threshold = int(round(cfg.conflict_rate * 256))
+    is_conflict = issue & (conflict_field < threshold)
+    conflicted = jnp.where(issue, is_conflict, state.conflicted)
+    conflicts_total = state.conflicts_total + jnp.sum(is_conflict)
+    status = jnp.where(issue, I_FAST, status)
+    issue_tick = jnp.where(issue, t, issue_tick)
+    p0_arrival = jnp.where(issue[None, :, :], t + p0_lat, p0_arrival)
+    p1_arrival = jnp.where(
+        (issue & is_conflict)[None, :, :], t + p1_lat, p1_arrival
+    )
+    next_inst = state.next_inst + count
+
+    return BatchedFastPaxosState(
+        status=status,
+        conflicted=conflicted,
+        issue_tick=issue_tick,
+        rec_value=rec_value,
+        chosen_value=chosen_value_r,
+        chosen_fast=chosen_fast,
+        retire_at=retire_at,
+        next_inst=next_inst,
+        inst_id=inst_id,
+        acc_round=acc_round,
+        vote_round=vote_round,
+        vote_value=vote_value,
+        p0_arrival=p0_arrival,
+        p1_arrival=p1_arrival,
+        dn_arrival=dn_arrival,
+        up_arrival=up_arrival,
+        fp_committed_value=fp_committed_value,
+        chosen_total=chosen_total,
+        chosen_fast_total=chosen_fast_total,
+        conflicts_total=conflicts_total,
+        recoveries=recoveries,
+        safety_violations=safety_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedFastPaxosConfig,
+    state: BatchedFastPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedFastPaxosState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedFastPaxosConfig, state: BatchedFastPaxosState, t
+) -> dict:
+    status = state.status
+    # THE Fast Paxos safety property: a value that ever held a fast
+    # quorum of round-0 votes is the only choosable value.
+    safety_ok = state.safety_violations == 0
+    # Chosen instances carry one of their two candidate values.
+    v0, v1 = _values_of(state.inst_id)
+    chosen = status == I_CHOSEN
+    value_ok = jnp.all(
+        jnp.where(
+            chosen,
+            (state.chosen_value == v0) | (state.chosen_value == v1),
+            True,
+        )
+    )
+    # A non-conflicted instance never needs recovery... unless its
+    # timeout fired; it still must choose proposer 0's value.
+    clean_value_ok = jnp.all(
+        jnp.where(
+            chosen & ~state.conflicted, state.chosen_value == v0, True
+        )
+    )
+    # Vote sanity: round-1 votes only for the recovery value; acceptor
+    # rounds within {0, 1}; fast counts can never choose two values.
+    round_ok = jnp.all((state.acc_round >= 0) & (state.acc_round <= 1))
+    books_ok = state.chosen_fast_total <= state.chosen_total
+    return {
+        "safety_ok": safety_ok,
+        "value_ok": value_ok,
+        "clean_value_ok": clean_value_ok,
+        "round_ok": round_ok,
+        "books_ok": books_ok,
+    }
+
+
+def stats(cfg: BatchedFastPaxosConfig, state: BatchedFastPaxosState, t) -> dict:
+    chosen = int(state.chosen_total)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (chosen + 1) // 2)).argmax())
+        if chosen
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "chosen": chosen,
+        "chosen_fast": int(state.chosen_fast_total),
+        "fast_fraction": int(state.chosen_fast_total) / max(1, chosen),
+        "conflicts": int(state.conflicts_total),
+        "recoveries": int(state.recoveries),
+        "latency_p50_ticks": p50,
+        "latency_mean_ticks": (
+            float(state.lat_sum) / chosen if chosen else -1.0
+        ),
+        "safety_violations": int(state.safety_violations),
+    }

@@ -30,13 +30,6 @@ out = {
 }
 
 
-def timed(warm, measure):
-    warm()
-    t0 = time.perf_counter()
-    n = measure()
-    return n, time.perf_counter() - t0
-
-
 # MultiPaxos @ 10k acceptors (write path only, the bench.py headline).
 mp = TpuSimTransport(
     BatchedMultiPaxosConfig(

@@ -1,0 +1,224 @@
+"""Tests of the batched Fast Paxos backend (fastpaxos_batched.py):
+fast-path quorums, classic recovery with the O4 majority-of-quorum rule,
+the fast-committed safety ledger, and cross-validation against the
+per-actor protocol (protocols/fastpaxos.py; fastpaxos/Leader.scala)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.tpu import fastpaxos_batched as fb
+
+
+def run_random(cfg, seed, ticks):
+    key = jax.random.PRNGKey(seed)
+    state, t = fb.run_ticks(cfg, fb.init_state(cfg), jnp.int32(0), ticks, key)
+    return state, t
+
+
+def test_progress_and_invariants_under_conflicts():
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=8, window=16, instances_per_tick=2,
+        conflict_rate=0.3, lat_min=1, lat_max=3, recovery_timeout=8,
+    )
+    state, t = run_random(cfg, seed=0, ticks=200)
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+    s = fb.stats(cfg, state, t)
+    assert s["chosen"] > 8 * 100
+    assert s["recoveries"] > 0  # conflicts force classic recoveries
+    assert 0.0 < s["fast_fraction"] < 1.0
+    assert s["safety_violations"] == 0
+
+
+def test_no_conflicts_is_all_fast_path():
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=4, window=8, instances_per_tick=2,
+        conflict_rate=0.0, lat_min=1, lat_max=2, recovery_timeout=10,
+    )
+    state, t = run_random(cfg, seed=1, ticks=100)
+    s = fb.stats(cfg, state, t)
+    assert s["chosen"] > 0
+    assert s["fast_fraction"] == 1.0
+    assert s["recoveries"] == 0
+    # Fast path = one client->acceptor hop + one reply hop.
+    assert s["latency_p50_ticks"] <= 2 * 2
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def _inject_instance(cfg, state, votes, t, conflicted=True):
+    """Place instance id=5 in slot (0, 0) in I_FAST with the given
+    round-0 acceptor votes (list of 0 -> v0, 1 -> v1, None -> unvoted)
+    and replies too slow for the fast counter to act before the
+    recovery timeout."""
+    v0, v1 = 10, 11  # _values_of(5)
+    st = dataclasses.replace(
+        state,
+        status=state.status.at[0, 0].set(fb.I_FAST),
+        conflicted=state.conflicted.at[0, 0].set(conflicted),
+        issue_tick=state.issue_tick.at[0, 0].set(t),
+        inst_id=state.inst_id.at[0, 0].set(5),
+        next_inst=state.next_inst.at[0].set(6),
+    )
+    for a, v in enumerate(votes):
+        if v is None:
+            continue
+        st = dataclasses.replace(
+            st,
+            vote_round=st.vote_round.at[a, 0, 0].set(0),
+            vote_value=st.vote_value.at[a, 0, 0].set(v0 if v == 0 else v1),
+            up_arrival=st.up_arrival.at[a, 0, 0].set(t + 1000),
+        )
+    return st
+
+
+def _run_manual(cfg, state, t0, n, seed=0):
+    key = jax.random.PRNGKey(seed)
+    t = t0
+    for _ in range(n):
+        state = fb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    return state, t
+
+
+def test_o4_recovery_picks_popular_value():
+    """Votes (v0, v0, v1) with no fast quorum: the classic round's O4
+    rule must pick v0 (2 >= majority-of-quorum) — matching
+    FpLeader._handle_phase1b's popular_items branch."""
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    state = _inject_instance(cfg, fb.init_state(cfg), [0, 0, 1], t=0)
+    state, t = _run_manual(cfg, state, 0, 30)
+    s = fb.stats(cfg, state, t)
+    assert s["recoveries"] == 1
+    assert s["chosen"] == 1
+    assert s["chosen_fast"] == 0
+    assert s["safety_violations"] == 0
+    # The instance retired; its choice was v0 — visible via the counters
+    # and the clean ledger (no violation despite v0 never fast-committed).
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_fast_committed_value_survives_unobserved():
+    """All n acceptors voted v0 (v0 IS fast-committed) but every reply is
+    too slow for the counter: the timeout triggers recovery, and phase 1
+    must re-discover v0 from the vote reports — the safety ledger
+    asserts the recovery chose the committed value."""
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    state = _inject_instance(cfg, fb.init_state(cfg), [0, 0, 0], t=0)
+    state, t = _run_manual(cfg, state, 0, 30)
+    s = fb.stats(cfg, state, t)
+    assert s["recoveries"] == 1
+    assert s["chosen"] == 1
+    assert s["safety_violations"] == 0  # THE assertion: v0 was chosen
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_recovery_with_no_votes_picks_proposer0():
+    """Timeout with no votes at all (proposals still in flight): phase 1
+    sees an empty vote set and proposes proposer 0's value —
+    FpLeader._handle_phase1b's k == -1 branch."""
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    state = _inject_instance(
+        cfg, fb.init_state(cfg), [None, None, None], t=0, conflicted=False
+    )
+    state, t = _run_manual(cfg, state, 0, 30)
+    s = fb.stats(cfg, state, t)
+    assert s["chosen"] == 1 and s["recoveries"] == 1
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv  # incl. clean_value_ok
+
+
+def test_cross_validation_fastpaxos_o4():
+    """Aligned conflict scenario against the per-actor protocol: client 0
+    ("a") wins acceptors 0-1, client 1 ("b") wins acceptor 2; no fast
+    quorum (needs 3); the classic fallback's phase-1 quorum sees
+    {a, a} and the O4 rule picks "a". The batched execution of the same
+    vote split (test_o4_recovery_picks_popular_value's injection) picks
+    v0 — both resolve the collision toward the popular value."""
+    from test_fastpaxos_craq import drain, make_fp
+
+    t, config, leaders, acceptors, clients = make_fp()
+    clients[0].propose("a")
+    clients[1].propose("b")
+    acc = config.acceptor_addresses
+
+    def deliver_where(pred):
+        for m in [m for m in t.messages if pred(m)]:
+            t.deliver_message(m)
+
+    # Client 0's proposal reaches acceptors 0 and 1 first; client 1's
+    # reaches acceptor 2 first. The losers' copies arrive after and are
+    # ignored (the acceptor has already cast its one fast vote).
+    c0, c1 = clients[0].address, clients[1].address
+    deliver_where(lambda m: m.src == c0 and m.dst in (acc[0], acc[1]))
+    deliver_where(lambda m: m.src == c1 and m.dst == acc[2])
+    deliver_where(lambda m: m.dst in acc)
+    assert [a.vote_value for a in acceptors] == ["a", "a", "b"]
+    # Phase2bs reach the clients: 2 < fast quorum (3) for "a", 1 for "b".
+    deliver_where(lambda m: m.dst in (c0, c1))
+    assert clients[0].chosen_value is None and clients[1].chosen_value is None
+
+    # Client 0 times out and falls back through leader 0 only.
+    t.trigger_timer(c0, "reproposeTimer")
+    deliver_where(lambda m: m.dst == leaders[0].address)
+    # Phase 1a to the acceptors; the phase-1 quorum is acceptors 0, 1.
+    deliver_where(lambda m: m.src == leaders[0].address and m.dst in acc)
+    deliver_where(
+        lambda m: m.src in (acc[0], acc[1]) and m.dst == leaders[0].address
+    )
+    # Phase 2 completes and the choice propagates.
+    deliver_where(lambda m: m.src == leaders[0].address and m.dst in acc)
+    deliver_where(lambda m: m.dst == leaders[0].address)
+    deliver_where(lambda m: m.dst in (c0, c1))
+    assert leaders[0].chosen_value == "a"
+    assert clients[0].chosen_value == "a"
+
+    # Batched side: the identical vote split resolves to v0 (proposer 0)
+    # via the same rule — proven by test_o4_recovery_picks_popular_value;
+    # here we assert the decision agrees with the per-actor outcome.
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    state = _inject_instance(cfg, fb.init_state(cfg), [0, 0, 1], t=0)
+    # Observe the choice before retirement: run tick-by-tick and capture
+    # the chosen value when it appears.
+    key = jax.random.PRNGKey(0)
+    chosen_seen = None
+    tt = 0
+    for _ in range(30):
+        state = fb.tick(cfg, state, jnp.int32(tt), jax.random.fold_in(key, tt))
+        tt += 1
+        if int(state.status[0, 0]) == fb.I_CHOSEN and chosen_seen is None:
+            chosen_seen = int(state.chosen_value[0, 0])
+    assert chosen_seen == 10  # v0 — proposer 0's value, same as "a"
+
+
+def test_wide_latency_spread_no_phantom_votes():
+    """lat_max >> lat_min: a conflicted instance can be fast-chosen and
+    retired while a slow round-0 proposal is still in flight. The
+    proposal must die with its instance — firing into the slot's next
+    instance would be a phantom vote (caught by clean_value_ok)."""
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=8, window=8, instances_per_tick=2,
+        conflict_rate=0.5, lat_min=1, lat_max=4, recovery_timeout=8,
+    )
+    state, t = run_random(cfg, seed=3, ticks=300)
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+    s = fb.stats(cfg, state, t)
+    assert s["chosen"] > 0 and s["safety_violations"] == 0
