@@ -261,15 +261,16 @@ def tick(
     # All round-1 votes in an instance carry rec_value, so "the value of
     # the max-round vote" is rec_value itself when any round-1 vote is
     # visible.
-    popular = jnp.where(
-        (a_v0 >= MAJ) | ((a_v0 >= a_v1) & (a_v0 > 0)), v0,
-        jnp.where(a_v1 > 0, v1, v0),
-    )
-    # Exact O4: prefer the value meeting the majority-of-quorum bound;
-    # among values below it any pick is safe (nothing can be committed).
-    popular = jnp.where(
-        a_v1 >= MAJ, jnp.where(a_v0 >= jnp.maximum(a_v1, MAJ), v0, v1), popular
-    )
+    # Exact O4 (popular_items + the leader-default branch of
+    # FpLeader._handle_phase1b): pick the value with >= MAJ votes among
+    # the observed round-0 votes; if NO value is popular, the leader
+    # proposes its own value — proposer 0's here, since the fallback
+    # runs through proposer 0 (any pick is safe: nothing can have been
+    # fast-committed). Both values popular is only possible when more
+    # than a bare quorum of replies arrived (then neither is committed);
+    # prefer the larger count, ties toward v0.
+    pick_v1 = (a_v1 >= MAJ) & ((a_v0 < MAJ) | (a_v1 > a_v0))
+    popular = jnp.where(pick_v1, v1, v0)
     rec_value = jnp.where(
         rec1_done,
         jnp.where(any_r1, state.rec_value, popular),

@@ -149,7 +149,7 @@ def test_cross_validation_fastpaxos_o4():
     {a, a} and the O4 rule picks "a". The batched execution of the same
     vote split (test_o4_recovery_picks_popular_value's injection) picks
     v0 — both resolve the collision toward the popular value."""
-    from test_fastpaxos_craq import drain, make_fp
+    from test_fastpaxos_craq import make_fp
 
     t, config, leaders, acceptors, clients = make_fp()
     clients[0].propose("a")

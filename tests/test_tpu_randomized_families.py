@@ -2,9 +2,11 @@
 the batched TPU model and the per-actor sim from the same randomly drawn
 scenario, asserting identical logs — the batched analog of the
 reference's ``Simulator.simulate(runs=500)`` sweeps (Simulator.scala:
-28-41). Three families: MultiPaxos repair (random per-slot fate +
+28-41). Four families: MultiPaxos repair (random per-slot fate +
 failover), Mencius skips (random active stripe + write count), Scalog
-cuts (random append schedules)."""
+cuts (random append schedules), Fast Paxos O4 recovery (random vote
+splits + random phase-1 quorums; the per-actor leader fallback is the
+ground truth)."""
 
 import dataclasses
 import random
@@ -287,3 +289,113 @@ def test_scalog_cut_family(seed):
                 )
         prev_vec = cut_vec
     assert predicted == replica_log, (predicted, replica_log)
+
+
+# -- Family 4: Fast Paxos O4 recovery -----------------------------------------
+
+from frankenpaxos_tpu.tpu import fastpaxos_batched as _fb
+
+fb_jit_tick = jax.jit(_fb.tick, static_argnums=0)
+
+
+def _fastpaxos_scenario(seed):
+    """Random scenario: f, a round-0 vote split over the 2f+1 acceptors
+    (proposer 0 / proposer 1 / unvoted), and a random classic-quorum
+    subset whose Phase1bs the recovery observes."""
+    rng = random.Random(1000 + seed)
+    f = rng.choice([1, 2])
+    n = 2 * f + 1
+    votes = [rng.choice([0, 1, None]) for _ in range(n)]
+    quorum = sorted(rng.sample(range(n), f + 1))
+    return f, n, votes, quorum
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_fastpaxos_o4_family(seed):
+    """Drive the SAME vote split + phase-1 quorum through the per-actor
+    protocol's leader fallback (ground truth) and the batched model's
+    timeout recovery; both must choose the same value — including when
+    the split holds an unobserved fast quorum (the O4 safety case)."""
+    from frankenpaxos_tpu.tpu import fastpaxos_batched as fb
+    from test_fastpaxos_craq import make_fp
+    from test_tpu_fastpaxos import _inject_instance
+
+    f, n, votes, quorum = _fastpaxos_scenario(seed)
+
+    # ---- Per-actor side.
+    t, config, leaders, acceptors, clients = make_fp(f=f)
+    clients[0].propose("a")
+    clients[1].propose("b")
+    acc = config.acceptor_addresses
+    c0, c1 = clients[0].address, clients[1].address
+
+    def deliver_where(pred):
+        for m in [m for m in t.messages if pred(m)]:
+            t.deliver_message(m)
+
+    for i, v in enumerate(votes):
+        if v == 0:
+            deliver_where(lambda m, i=i: m.src == c0 and m.dst == acc[i])
+        elif v == 1:
+            deliver_where(lambda m, i=i: m.src == c1 and m.dst == acc[i])
+    assert [a.vote_value for a in acceptors] == [
+        {0: "a", 1: "b", None: None}[v] for v in votes
+    ]
+    # No Phase2bs reach the clients: the fast path stalls and client 0
+    # falls back through leader 0 (the batched model's proposer-0-default
+    # alignment).
+    t.trigger_timer(c0, "reproposeTimer")
+    deliver_where(lambda m: m.dst == leaders[0].address)
+    deliver_where(lambda m: m.src == leaders[0].address and m.dst in acc)
+    for i in quorum:
+        deliver_where(
+            lambda m, i=i: m.src == acc[i] and m.dst == leaders[0].address
+        )
+    deliver_where(lambda m: m.src == leaders[0].address and m.dst in acc)
+    deliver_where(lambda m: m.dst == leaders[0].address)
+    expected = leaders[0].chosen_value
+    assert expected in ("a", "b")
+    # Test-guard: a fast-committed value must win (quorum intersection).
+    fb_cfg_probe = fb.BatchedFastPaxosConfig(f=f, num_groups=1)
+    for val, name in ((0, "a"), (1, "b")):
+        if votes.count(val) >= fb_cfg_probe.fast_quorum:
+            assert expected == name
+
+    # ---- Batched side: same votes in the acceptor arrays (replies too
+    # slow to observe), timeout recovery, and the same phase-1 quorum
+    # (non-quorum Phase1bs delayed past the horizon).
+    cfg = fb.BatchedFastPaxosConfig(
+        f=f, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    v0, v1 = 10, 11  # _values_of(5), the id _inject_instance uses
+    state = _inject_instance(cfg, fb.init_state(cfg), votes, t=0)
+    key = jax.random.PRNGKey(seed)
+    tt = 0
+    overrode = False
+    chosen_seen = None
+    for _ in range(40):
+        state = fb_jit_tick(
+            cfg, state, jnp.int32(tt), jax.random.fold_in(key, tt)
+        )
+        tt += 1
+        st = int(state.status[0, 0])
+        if st == fb.I_REC1 and not overrode:
+            up = np.asarray(state.up_arrival[:, 0, 0])
+            if np.all(up < int(INF)):  # every Phase1b reply scheduled
+                for a in range(n):
+                    if a not in quorum:
+                        state = dataclasses.replace(
+                            state,
+                            up_arrival=state.up_arrival.at[a, 0, 0].set(1000),
+                        )
+                overrode = True
+        if st == fb.I_CHOSEN and chosen_seen is None:
+            chosen_seen = int(state.chosen_value[0, 0])
+    assert overrode, "recovery never scheduled its phase-1 replies"
+    assert chosen_seen is not None, "batched instance never chose"
+    inv = fb.check_invariants(cfg, state, jnp.int32(tt))
+    assert all(bool(x) for x in inv.values()), inv
+    assert chosen_seen == {"a": v0, "b": v1}[expected], (
+        seed, f, votes, quorum, expected, chosen_seen
+    )
