@@ -14,11 +14,13 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.tpu import (
     BatchedCraqConfig,
     BatchedEPaxosConfig,
+    BatchedFastPaxosConfig,
     BatchedMenciusConfig,
     BatchedMultiPaxosConfig,
     TpuSimTransport,
     craq_batched,
     epaxos_batched,
+    fastpaxos_batched,
     mencius_batched,
     scalog_batched,
 )
@@ -149,6 +151,30 @@ out["craq_256_chains_of_4"] = {
     "writes_per_sec": int((int(cstate.writes_done) - w0) / dt),
     "reads_per_sec": int((int(cstate.reads_done) - r0) / dt),
     "clean_read_fraction": round(cs["clean_fraction"], 3),
+}
+
+# Fast Paxos @ 512 groups (fast path + O4 recovery under conflicts).
+fcfg = BatchedFastPaxosConfig(
+    f=1, num_groups=512, window=16, instances_per_tick=2,
+    conflict_rate=0.2, lat_min=1, lat_max=3, recovery_timeout=8,
+)
+fstate = fastpaxos_batched.init_state(fcfg)
+fstate, ft = fastpaxos_batched.run_ticks(
+    fcfg, fstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(fstate)
+f0 = int(fstate.chosen_total)
+t0 = time.perf_counter()
+fstate, ft = fastpaxos_batched.run_ticks(
+    fcfg, fstate, ft, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(fstate)
+dt = time.perf_counter() - t0
+fs = fastpaxos_batched.stats(fcfg, fstate, ft)
+out["fastpaxos_512_groups"] = {
+    "chosen_per_sec": int((int(fstate.chosen_total) - f0) / dt),
+    "fast_fraction": round(fs["fast_fraction"], 3),
+    "safety_violations": fs["safety_violations"],
 }
 
 with open("results/batched_backends_cpu.json", "w") as f:

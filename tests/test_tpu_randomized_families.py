@@ -293,9 +293,9 @@ def test_scalog_cut_family(seed):
 
 # -- Family 4: Fast Paxos O4 recovery -----------------------------------------
 
-from frankenpaxos_tpu.tpu import fastpaxos_batched as _fb
+from frankenpaxos_tpu.tpu import fastpaxos_batched as fb
 
-fb_jit_tick = jax.jit(_fb.tick, static_argnums=0)
+fb_jit_tick = jax.jit(fb.tick, static_argnums=0)
 
 
 def _fastpaxos_scenario(seed):
@@ -316,7 +316,6 @@ def test_fastpaxos_o4_family(seed):
     protocol's leader fallback (ground truth) and the batched model's
     timeout recovery; both must choose the same value — including when
     the split holds an unobserved fast quorum (the O4 safety case)."""
-    from frankenpaxos_tpu.tpu import fastpaxos_batched as fb
     from test_fastpaxos_craq import make_fp
     from test_tpu_fastpaxos import _inject_instance
 
