@@ -9,11 +9,16 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 """
 
 from frankenpaxos_tpu.tpu import (
+    caspaxos_batched,
     craq_batched,
     epaxos_batched,
     fastpaxos_batched,
     mencius_batched,
     scalog_batched,
+)
+from frankenpaxos_tpu.tpu.caspaxos_batched import (
+    BatchedCasPaxosConfig,
+    BatchedCasPaxosState,
 )
 from frankenpaxos_tpu.tpu.fastpaxos_batched import (
     BatchedFastPaxosConfig,
@@ -44,6 +49,9 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
 from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 
 __all__ = [
+    "BatchedCasPaxosConfig",
+    "BatchedCasPaxosState",
+    "caspaxos_batched",
     "BatchedCraqConfig",
     "BatchedCraqState",
     "craq_batched",

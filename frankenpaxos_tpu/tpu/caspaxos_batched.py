@@ -1,0 +1,470 @@
+"""Batched CASPaxos as a single XLA program.
+
+CASPaxos (reference ``caspaxos/``; per-actor analog
+``protocols/caspaxos.py``): a replicated register WITHOUT a log. Leaders
+cycle Idle -> Phase1 -> Phase2 -> Idle per request batch
+(caspaxos/Leader.scala state ADT); acceptors keep (round, voteRound,
+voteValue); a nack sends the leader into a randomized backoff before it
+retries in a higher owned round (WaitingToRecover); phase 1 adopts the
+value of the HIGHEST vote round and applies the change function to it.
+
+TPU-first design: ``G`` independent registers are the replica axis, each
+with ``L`` competing leaders (rounds owned round-robin: leader l owns
+rounds r == l mod L, the ClassicRoundRobin of the reference) and
+``2f+1`` acceptors. The reference's int-set register with set-union
+change function becomes a 32-bit mask with OR — the same commutative
+idempotent monoid, exactly representable on device: clients add single
+bits, phase 2 proposes ``safe_value | pending_bits``, and the register's
+whole history is auditable from the masks.
+
+Message discipline learned from the other backends: every in-flight
+message CARRIES its round and phase (captured at send), so stragglers
+processed after a leader moved on are tagged stale and dropped rather
+than misread against live state; within a tick an acceptor processes
+only its highest-round arrival and nacks the rest (a deterministic
+serialization of same-tick deliveries).
+
+THE CASPaxos safety property — all chosen register values form a chain
+under set inclusion — is checked on device at every commit
+(``chain_violations``), including the same-tick multi-leader commit race
+(the higher-round value must contain every lower-round one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+
+# Leader status.
+L_IDLE = 0
+L_P1 = 1
+L_P2 = 2
+L_BACK = 3  # randomized backoff after a nack (WaitingToRecover)
+
+NBITS = 32  # register width (bits = client ops)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedCasPaxosConfig:
+    """G registers x L leaders x (2f+1) acceptors."""
+
+    f: int = 1
+    num_registers: int = 4  # G
+    num_leaders: int = 2  # L: competing proposers per register
+    op_rate: float = 0.25  # P(a new client bit arrives per leader per tick)
+    lat_min: int = 1
+    lat_max: int = 3
+    backoff_min: int = 2  # nack backoff (uniform, in ticks)
+    backoff_max: int = 10
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def quorum(self) -> int:
+        return self.f + 1
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.num_leaders >= 1
+        assert 0.0 <= self.op_rate <= 1.0
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 1 <= self.backoff_min <= self.backoff_max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedCasPaxosState:
+    """Shapes: [G] registers, [L, G] leaders, [A, G] acceptors,
+    [A, L, G] messages, [G, NBITS] per-bit bookkeeping."""
+
+    # Leaders.
+    l_status: jnp.ndarray  # [L, G]
+    l_round: jnp.ndarray  # [L, G] current round (owned: r % L == l)
+    l_value: jnp.ndarray  # [L, G] value proposed in phase 2 (uint32 mask)
+    l_pending: jnp.ndarray  # [L, G] client bits not yet chosen (uint32)
+    l_seen_round: jnp.ndarray  # [L, G] max round seen in nacks
+    backoff_until: jnp.ndarray  # [L, G]
+
+    # Acceptors.
+    a_round: jnp.ndarray  # [A, G] promised round
+    a_vote_round: jnp.ndarray  # [A, G] (-1 = none)
+    a_vote_value: jnp.ndarray  # [A, G] uint32 mask
+
+    # Messages (payloads captured at send/processing time).
+    dn_arrival: jnp.ndarray  # [A, L, G] leader -> acceptor
+    dn_round: jnp.ndarray  # [A, L, G]
+    dn_phase: jnp.ndarray  # [A, L, G] 1 | 2
+    dn_value: jnp.ndarray  # [A, L, G] uint32 (phase 2)
+    up_arrival: jnp.ndarray  # [A, L, G] acceptor -> leader
+    up_round: jnp.ndarray  # [A, L, G] round the reply answers
+    up_nack: jnp.ndarray  # [A, L, G] bool
+    up_nack_round: jnp.ndarray  # [A, L, G] acceptor's round (fast-forward)
+    up_vote_round: jnp.ndarray  # [A, L, G] phase-1b payload
+    up_vote_value: jnp.ndarray  # [A, L, G] uint32
+
+    # Register + per-bit bookkeeping.
+    last_chosen: jnp.ndarray  # [G] uint32: newest chosen register value
+    bit_issue: jnp.ndarray  # [G, NBITS] issue tick (INF = never issued)
+    bit_done: jnp.ndarray  # [G, NBITS] bool: bit visible in a chosen value
+
+    # Stats.
+    commits: jnp.ndarray  # [] successful CAS round trips
+    bits_issued: jnp.ndarray  # []
+    bits_chosen: jnp.ndarray  # []
+    nacks: jnp.ndarray  # []
+    backoffs: jnp.ndarray  # []
+    chain_violations: jnp.ndarray  # [] THE safety counter
+    lat_sum: jnp.ndarray  # [] per-bit issue -> chosen latency
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
+    G, L, A = cfg.num_registers, cfg.num_leaders, cfg.n
+    u0 = jnp.zeros((L, G), jnp.uint32)
+    return BatchedCasPaxosState(
+        l_status=jnp.zeros((L, G), jnp.int32),
+        l_round=jnp.arange(L, dtype=jnp.int32)[:, None]
+        - jnp.int32(L) * jnp.ones((L, G), jnp.int32),
+        l_value=u0,
+        l_pending=u0,
+        l_seen_round=jnp.full((L, G), -1, jnp.int32),
+        backoff_until=jnp.full((L, G), INF, jnp.int32),
+        a_round=jnp.full((A, G), -1, jnp.int32),
+        a_vote_round=jnp.full((A, G), -1, jnp.int32),
+        a_vote_value=jnp.zeros((A, G), jnp.uint32),
+        dn_arrival=jnp.full((A, L, G), INF, jnp.int32),
+        dn_round=jnp.full((A, L, G), -1, jnp.int32),
+        dn_phase=jnp.zeros((A, L, G), jnp.int32),
+        dn_value=jnp.zeros((A, L, G), jnp.uint32),
+        up_arrival=jnp.full((A, L, G), INF, jnp.int32),
+        up_round=jnp.full((A, L, G), -1, jnp.int32),
+        up_nack=jnp.zeros((A, L, G), bool),
+        up_nack_round=jnp.full((A, L, G), -1, jnp.int32),
+        up_vote_round=jnp.full((A, L, G), -1, jnp.int32),
+        up_vote_value=jnp.zeros((A, L, G), jnp.uint32),
+        last_chosen=jnp.zeros((G,), jnp.uint32),
+        bit_issue=jnp.full((G, NBITS), INF, jnp.int32),
+        bit_done=jnp.zeros((G, NBITS), bool),
+        commits=jnp.zeros((), jnp.int32),
+        bits_issued=jnp.zeros((), jnp.int32),
+        bits_chosen=jnp.zeros((), jnp.int32),
+        nacks=jnp.zeros((), jnp.int32),
+        backoffs=jnp.zeros((), jnp.int32),
+        chain_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def tick(
+    cfg: BatchedCasPaxosConfig,
+    state: BatchedCasPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedCasPaxosState:
+    G, L, A = cfg.num_registers, cfg.num_leaders, cfg.n
+    Q = cfg.quorum
+    k3, k2 = jax.random.split(key)
+    bits3 = jax.random.bits(k3, (A, L, G))  # [0:8) dn lat, [8:16) up lat
+    bits2 = jax.random.bits(k2, (L, G))  # [0:8) backoff, [8:16) op draw,
+    #                                      [16:21) new-bit index
+    dn_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    up_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    backoff = bit_latency(bits2, 0, cfg.backoff_min, cfg.backoff_max)
+
+    # ---- 1. Acceptors process dn arrivals (CasAcceptor.receive). Within
+    # a tick an acceptor takes only its HIGHEST-round arrival and nacks
+    # the rest — a deterministic serialization of same-tick deliveries
+    # (rounds are unique across leaders: r % L == l).
+    arr = state.dn_arrival == t  # [A, L, G]
+    best_round = jnp.max(jnp.where(arr, state.dn_round, -1), axis=1)  # [A, G]
+    winner = arr & (state.dn_round == best_round[:, None, :])
+    p1_win = winner & (state.dn_phase == 1)
+    p2_win = winner & (state.dn_phase == 2)
+
+    # Phase 1a: promise iff round > promised round, reply votes; else nack
+    # (CasAcceptor: msg.round > self.round).
+    p1_ok = p1_win & (state.dn_round > state.a_round[:, None, :])
+    # Phase 2a: vote iff round >= promised round.
+    p2_ok = p2_win & (state.dn_round >= state.a_round[:, None, :])
+    ok = p1_ok | p2_ok
+    new_round = jnp.max(
+        jnp.where(ok, state.dn_round, -1), axis=1
+    )  # [A, G] (at most one ok per acceptor: the winner)
+    a_round = jnp.maximum(state.a_round, new_round)
+    vote_now = jnp.any(p2_ok, axis=1)  # [A, G]
+    voted_round = jnp.max(jnp.where(p2_ok, state.dn_round, -1), axis=1)
+    voted_value = jnp.max(jnp.where(p2_ok, state.dn_value, 0), axis=1)
+    a_vote_round = jnp.where(vote_now, voted_round, state.a_vote_round)
+    a_vote_value = jnp.where(vote_now, voted_value, state.a_vote_value)
+
+    # Replies: every arrival gets one (ack with payload, or nack). The
+    # phase-1b vote payload is captured AFTER this tick's vote (an
+    # acceptor that just voted reports that vote — same-tick accuracy).
+    nack = arr & ~ok
+    up_arrival = jnp.where(arr, t + up_lat, state.up_arrival)
+    up_round = jnp.where(arr, state.dn_round, state.up_round)
+    up_nack = jnp.where(arr, nack, state.up_nack)
+    up_nack_round = jnp.where(arr, a_round[:, None, :], state.up_nack_round)
+    up_vote_round = jnp.where(
+        arr, a_vote_round[:, None, :], state.up_vote_round
+    )
+    up_vote_value = jnp.where(
+        arr, a_vote_value[:, None, :], state.up_vote_value
+    )
+    dn_arrival = jnp.where(arr, INF, state.dn_arrival)
+
+    # ---- 2. Leaders process up arrivals. Replies for a round other than
+    # the leader's current round are stale — dropped (the reference
+    # leader's `msg.round != round` guards).
+    got = (up_arrival <= t) & (up_round == state.l_round[None, :, :])
+    got_nack = got & up_nack
+    got_ack = got & ~up_nack
+
+    # Nacks: back off with a randomized timer, remember the round to
+    # jump past (CasLeader._handle_nack -> WaitingToRecover).
+    nacked = (
+        ((state.l_status == L_P1) | (state.l_status == L_P2))
+        & jnp.any(got_nack, axis=0)
+    )
+    l_seen_round = jnp.maximum(
+        state.l_seen_round, jnp.max(jnp.where(got, up_nack_round, -1), axis=0)
+    )
+    nacks = state.nacks + jnp.sum(got_nack)
+    backoffs = state.backoffs + jnp.sum(nacked)
+
+    # Phase-1 completion: a quorum of acks; adopt the HIGHEST vote round's
+    # value (classic CASPaxos safety; the module docstring of the
+    # per-actor impl documents the deliberate divergence from the
+    # reference's minBy), apply the change function (OR the pending
+    # bits), move to phase 2.
+    ack_count = jnp.sum(got_ack, axis=0)  # [L, G]
+    p1_done = (state.l_status == L_P1) & ~nacked & (ack_count >= Q)
+    best_vr = jnp.max(jnp.where(got_ack, up_vote_round, -1), axis=0)
+    safe = jnp.max(
+        jnp.where(
+            got_ack & (up_vote_round == best_vr[None, :, :]),
+            up_vote_value,
+            0,
+        ),
+        axis=0,
+    )  # [L, G] (all max-round votes carry the same value)
+    new_value = safe | state.l_pending
+    l_value = jnp.where(p1_done, new_value, state.l_value)
+
+    # Phase-2 completion: a quorum of acks chooses the value.
+    p2_done = (state.l_status == L_P2) & ~nacked & (ack_count >= Q)
+
+    # ---- 3. Commit: update the register, check the chain property. Two
+    # leaders of one register may commit in the same tick (in different
+    # rounds); the higher-round value must contain every lower-round one
+    # AND the previous register value.
+    committed_mask = p2_done  # [L, G]
+    commit_round = jnp.where(committed_mask, state.l_round, -1)
+    max_cr = jnp.max(commit_round, axis=0)  # [G]
+    any_commit = max_cr >= 0
+    final_value = jnp.max(
+        jnp.where(commit_round == max_cr[None, :], state.l_value, 0), axis=0
+    )  # [G] value of the max-round commit
+    contains_prev = (
+        state.l_value & state.last_chosen[None, :]
+    ) == state.last_chosen[None, :]
+    contained_in_final = (
+        state.l_value & final_value[None, :]
+    ) == state.l_value
+    chain_violations = state.chain_violations + jnp.sum(
+        committed_mask & ~(contains_prev & contained_in_final)
+    )
+    last_chosen = jnp.where(any_commit, final_value, state.last_chosen)
+    commits = state.commits + jnp.sum(committed_mask)
+
+    # Per-bit latency: bits newly visible in the register.
+    bit_mat = jnp.uint32(1) << jnp.arange(NBITS, dtype=jnp.uint32)  # [NBITS]
+    now_set = (last_chosen[:, None] & bit_mat[None, :]) != 0  # [G, NBITS]
+    newly_done = now_set & ~state.bit_done
+    bit_done = state.bit_done | now_set
+    blat = jnp.where(newly_done, t - state.bit_issue, 0)
+    bits_chosen = state.bits_chosen + jnp.sum(newly_done)
+    lat_sum = state.lat_sum + jnp.sum(blat)
+    bbins = jnp.clip(blat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_done.astype(jnp.int32).ravel(), bbins.ravel(), LAT_BINS
+    )
+
+    # Committed pending bits retire (idempotent union: anything of ours
+    # now in the register needs no re-proposal).
+    l_pending = state.l_pending & ~jnp.where(
+        committed_mask, state.l_value, jnp.uint32(0)
+    )
+
+    # ---- 4. Leader transitions.
+    l_status = state.l_status
+    l_round = state.l_round
+    backoff_until = state.backoff_until
+    # Nack -> backoff.
+    l_status = jnp.where(nacked, L_BACK, l_status)
+    backoff_until = jnp.where(nacked, t + backoff, backoff_until)
+    # P1 -> P2: send phase 2a to every acceptor.
+    send_p2 = p1_done[None, :, :]
+    dn_arrival = jnp.where(send_p2, t + dn_lat, dn_arrival)
+    dn_round = jnp.where(send_p2, state.l_round[None, :, :], state.dn_round)
+    dn_phase = jnp.where(send_p2, 2, state.dn_phase)
+    dn_value = jnp.where(send_p2, l_value[None, :, :], state.dn_value)
+    l_status = jnp.where(p1_done, L_P2, l_status)
+    # P2 -> idle.
+    l_status = jnp.where(p2_done, L_IDLE, l_status)
+    # Clear replies of settled leaders (their round is over).
+    settled = (nacked | p1_done | p2_done)[None, :, :]
+    up_arrival = jnp.where(settled, INF, up_arrival)
+
+    # ---- 5. New client ops: each leader receives a PRNG bit with
+    # probability op_rate (CasClient.propose: a singleton int-set).
+    op_draw = ((bits2 >> 8) & jnp.uint32(0xFF)).astype(jnp.int32)
+    # Like common.bit_delivered: never quantize a nonzero rate to zero.
+    op_threshold = (
+        0 if cfg.op_rate == 0.0 else max(1, int(round(cfg.op_rate * 256)))
+    )
+    new_op = op_draw < jnp.int32(op_threshold)
+    new_bit_idx = ((bits2 >> 16) & jnp.uint32(0x1F)).astype(jnp.uint32)
+    new_bit = jnp.where(new_op, jnp.uint32(1) << new_bit_idx, jnp.uint32(0))
+    l_pending = l_pending | new_bit
+    # Per-bit issue bookkeeping (first issue wins).
+    issued_now = jnp.zeros((G, NBITS), bool)
+    for l in range(L):  # L is tiny and static
+        m = (new_bit[l][:, None] & bit_mat[None, :]) != 0
+        issued_now = issued_now | m
+    first_issue = issued_now & (state.bit_issue == INF) & ~bit_done
+    bit_issue = jnp.where(first_issue, t, state.bit_issue)
+    bits_issued = state.bits_issued + jnp.sum(first_issue)
+
+    # ---- 6. Start/retry phase 1: an idle leader with pending bits, or a
+    # backoff that expired, picks its next owned round above everything
+    # it has seen and sends phase 1a to every acceptor
+    # (CasLeader._transition_to_phase1; ClassicRoundRobin ownership).
+    ready = (
+        ((l_status == L_IDLE) & (l_pending != 0))
+        | ((l_status == L_BACK) & (t >= backoff_until))
+    )
+    l_iota = jnp.arange(L, dtype=jnp.int32)[:, None]
+    floor = jnp.maximum(l_round, l_seen_round)
+    # Smallest r > floor with r % L == l.
+    next_round = floor + ((l_iota - floor) % L)
+    next_round = jnp.where(next_round <= floor, next_round + L, next_round)
+    l_round = jnp.where(ready, next_round, l_round)
+    send_p1 = ready[None, :, :]
+    dn_arrival = jnp.where(send_p1, t + dn_lat, dn_arrival)
+    dn_round = jnp.where(send_p1, l_round[None, :, :], dn_round)
+    dn_phase = jnp.where(send_p1, 1, dn_phase)
+    l_status = jnp.where(ready, L_P1, l_status)
+    backoff_until = jnp.where(ready, INF, backoff_until)
+    up_arrival = jnp.where(send_p1, INF, up_arrival)  # drop stale replies
+
+    return BatchedCasPaxosState(
+        l_status=l_status,
+        l_round=l_round,
+        l_value=l_value,
+        l_pending=l_pending,
+        l_seen_round=l_seen_round,
+        backoff_until=backoff_until,
+        a_round=a_round,
+        a_vote_round=a_vote_round,
+        a_vote_value=a_vote_value,
+        dn_arrival=dn_arrival,
+        dn_round=dn_round,
+        dn_phase=dn_phase,
+        dn_value=dn_value,
+        up_arrival=up_arrival,
+        up_round=up_round,
+        up_nack=up_nack,
+        up_nack_round=up_nack_round,
+        up_vote_round=up_vote_round,
+        up_vote_value=up_vote_value,
+        last_chosen=last_chosen,
+        bit_issue=bit_issue,
+        bit_done=bit_done,
+        commits=commits,
+        bits_issued=bits_issued,
+        bits_chosen=bits_chosen,
+        nacks=nacks,
+        backoffs=backoffs,
+        chain_violations=chain_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedCasPaxosConfig,
+    state: BatchedCasPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedCasPaxosState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedCasPaxosConfig, state: BatchedCasPaxosState, t
+) -> dict:
+    L = cfg.num_leaders
+    # THE CASPaxos safety property: chosen values chain under inclusion.
+    chain_ok = state.chain_violations == 0
+    # Round ownership: leaders only use rounds r == l (mod L).
+    l_iota = jnp.arange(L, dtype=jnp.int32)[:, None]
+    owned_ok = jnp.all(state.l_round % L == (l_iota % L))
+    # Acceptors never vote above their promise.
+    promise_ok = jnp.all(state.a_vote_round <= state.a_round)
+    # The register contains exactly the bits accounted as chosen.
+    bit_mat = jnp.uint32(1) << jnp.arange(NBITS, dtype=jnp.uint32)
+    reg_bits = (state.last_chosen[:, None] & bit_mat[None, :]) != 0
+    books_ok = jnp.all(reg_bits <= state.bit_done) & (
+        state.bits_chosen == jnp.sum(state.bit_done)
+    )
+    # A vote's value is always a superset of no chosen value? (Votes may
+    # run ahead of commits; the enforceable direction is that the
+    # REGISTER never loses bits, covered by chain_ok.) Statuses in range.
+    status_ok = jnp.all((state.l_status >= L_IDLE) & (state.l_status <= L_BACK))
+    return {
+        "chain_ok": chain_ok,
+        "owned_ok": owned_ok,
+        "promise_ok": promise_ok,
+        "books_ok": books_ok,
+        "status_ok": status_ok,
+    }
+
+
+def stats(cfg: BatchedCasPaxosConfig, state: BatchedCasPaxosState, t) -> dict:
+    done = int(state.bits_chosen)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (done + 1) // 2)).argmax())
+        if done
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "commits": int(state.commits),
+        "bits_issued": int(state.bits_issued),
+        "bits_chosen": done,
+        "nacks": int(state.nacks),
+        "backoffs": int(state.backoffs),
+        "bit_latency_p50_ticks": p50,
+        "chain_violations": int(state.chain_violations),
+    }
