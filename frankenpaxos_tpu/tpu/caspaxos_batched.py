@@ -39,7 +39,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_delivered,
+    bit_latency,
+)
 
 # Leader status.
 L_IDLE = 0
@@ -112,6 +117,7 @@ class BatchedCasPaxosState:
 
     # Register + per-bit bookkeeping.
     last_chosen: jnp.ndarray  # [G] uint32: newest chosen register value
+    last_round: jnp.ndarray  # [G] round that chose last_chosen (-1)
     bit_issue: jnp.ndarray  # [G, NBITS] issue tick (INF = never issued)
     bit_done: jnp.ndarray  # [G, NBITS] bool: bit visible in a chosen value
 
@@ -151,6 +157,7 @@ def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
         up_vote_round=jnp.full((A, L, G), -1, jnp.int32),
         up_vote_value=jnp.zeros((A, L, G), jnp.uint32),
         last_chosen=jnp.zeros((G,), jnp.uint32),
+        last_round=jnp.full((G,), -1, jnp.int32),
         bit_issue=jnp.full((G, NBITS), INF, jnp.int32),
         bit_done=jnp.zeros((G, NBITS), bool),
         commits=jnp.zeros((), jnp.int32),
@@ -263,27 +270,36 @@ def tick(
     # Phase-2 completion: a quorum of acks chooses the value.
     p2_done = (state.l_status == L_P2) & ~nacked & (ack_count >= Q)
 
-    # ---- 3. Commit: update the register, check the chain property. Two
-    # leaders of one register may commit in the same tick (in different
-    # rounds); the higher-round value must contain every lower-round one
-    # AND the previous register value.
+    # ---- 3. Commit: update the register, check the chain property.
+    # Commits arrive out of round order: a slow quorum can complete a
+    # LOWER round after a higher one already advanced the register (its
+    # value is then guaranteed contained — the higher round's phase-1
+    # quorum intersected its votes). Track the register's round and only
+    # advance on a strictly higher one; the chain checks are therefore
+    # DIRECTIONAL: newer-than-register commits must contain the register,
+    # and every commit must be contained in the newest value standing
+    # after this tick.
     committed_mask = p2_done  # [L, G]
     commit_round = jnp.where(committed_mask, state.l_round, -1)
     max_cr = jnp.max(commit_round, axis=0)  # [G]
-    any_commit = max_cr >= 0
+    advance = max_cr > state.last_round
     final_value = jnp.max(
         jnp.where(commit_round == max_cr[None, :], state.l_value, 0), axis=0
-    )  # [G] value of the max-round commit
+    )  # [G] value of the max-round commit this tick
+    newest = jnp.where(advance, final_value, state.last_chosen)  # [G]
+    newer = committed_mask & (commit_round > state.last_round[None, :])
     contains_prev = (
         state.l_value & state.last_chosen[None, :]
     ) == state.last_chosen[None, :]
-    contained_in_final = (
-        state.l_value & final_value[None, :]
+    contained_in_newest = (
+        state.l_value & newest[None, :]
     ) == state.l_value
     chain_violations = state.chain_violations + jnp.sum(
-        committed_mask & ~(contains_prev & contained_in_final)
+        (newer & ~contains_prev)
+        | (committed_mask & ~contained_in_newest)
     )
-    last_chosen = jnp.where(any_commit, final_value, state.last_chosen)
+    last_chosen = newest
+    last_round = jnp.where(advance, max_cr, state.last_round)
     commits = state.commits + jnp.sum(committed_mask)
 
     # Per-bit latency: bits newly visible in the register.
@@ -327,12 +343,9 @@ def tick(
 
     # ---- 5. New client ops: each leader receives a PRNG bit with
     # probability op_rate (CasClient.propose: a singleton int-set).
-    op_draw = ((bits2 >> 8) & jnp.uint32(0xFF)).astype(jnp.int32)
-    # Like common.bit_delivered: never quantize a nonzero rate to zero.
-    op_threshold = (
-        0 if cfg.op_rate == 0.0 else max(1, int(round(cfg.op_rate * 256)))
-    )
-    new_op = op_draw < jnp.int32(op_threshold)
+    # The shared never-quantize-nonzero-to-zero rule, via the shared
+    # helper (bit_delivered returns True w.p. 1 - rate).
+    new_op = ~bit_delivered(bits2, 8, cfg.op_rate)
     new_bit_idx = ((bits2 >> 16) & jnp.uint32(0x1F)).astype(jnp.uint32)
     new_bit = jnp.where(new_op, jnp.uint32(1) << new_bit_idx, jnp.uint32(0))
     l_pending = l_pending | new_bit
@@ -388,6 +401,7 @@ def tick(
         up_vote_round=up_vote_round,
         up_vote_value=up_vote_value,
         last_chosen=last_chosen,
+        last_round=last_round,
         bit_issue=bit_issue,
         bit_done=bit_done,
         commits=commits,

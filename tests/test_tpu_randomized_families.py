@@ -398,3 +398,168 @@ def test_fastpaxos_o4_family(seed):
     assert chosen_seen == {"a": v0, "b": v1}[expected], (
         seed, f, votes, quorum, expected, chosen_seen
     )
+
+
+# -- Family 5: CRAQ apportioned-read routing ----------------------------------
+
+
+def _craq_scenario(seed):
+    """Random op schedule over a 3-node chain with 3 keys: full writes,
+    one optional stalled write (delivered to the head only), and reads
+    at random nodes. Every read's routing decision (clean-local vs
+    dirty-via-tail) and returned version must agree across executions."""
+    rng = random.Random(2000 + seed)
+    n_ops = rng.randint(5, 9)
+    ops = []
+    stalled_at = rng.randrange(n_ops) if rng.random() < 0.7 else None
+    for i in range(n_ops):
+        if rng.random() < 0.5:
+            ops.append(("write", rng.randrange(3), i == stalled_at))
+        else:
+            ops.append(("read", rng.randrange(3), rng.randrange(3)))
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_craq_routing_family(seed):
+    import frankenpaxos_tpu.tpu.craq_batched as cb
+    from frankenpaxos_tpu.protocols import craq as cq
+    from test_fastpaxos_craq import make_craq
+    from test_tpu_craq import _inject_read, _inject_write
+
+    ops = _craq_scenario(seed)
+
+    # ---- Per-actor side. Writes use increasing values "v0", "v1", ...;
+    # a stalled write is delivered to the head only and released at the
+    # end. Reads route deterministically; record (was_dirty, value).
+    t, config, nodes, clients = make_craq(n=3, num_clients=2)
+    acc = config.chain_node_addresses
+    stalled_msgs = []
+    stalled_key = None
+    wseq = 0
+    actor_reads = []
+
+    def drain_except_stalled():
+        for _ in range(2000):
+            pend = [m for m in t.messages if m not in stalled_msgs]
+            if not pend:
+                return
+            t.deliver_message(pend[0])
+        raise AssertionError("no quiesce")
+
+    class _Pick:
+        def __init__(self, n):
+            self.n = n
+
+        def randrange(self, _):
+            return self.n
+
+    pseud = 0
+    for op in ops:
+        if op[0] == "write":
+            _, key, stall = op
+            clients[0].write(pseud, f"k{key}", f"v{wseq}")
+            pseud += 1
+            if stall and stalled_key is None:
+                # Deliver to the head only; hold the forward to node 1.
+                for m in [m for m in t.messages if m.dst == acc[0]]:
+                    t.deliver_message(m)
+                stalled_msgs = [m for m in t.messages if m.dst == acc[1]]
+                stalled_key = key
+            else:
+                drain_except_stalled()
+            wseq += 1
+        else:
+            _, node, key = op
+            clients[1].rng = _Pick(node)
+            r = clients[1].read(pseud, f"k{key}")
+            pseud += 1
+            # OBSERVE the routing decision: deliver the read to its node,
+            # then check whether the node forwarded a CraqTailRead.
+            for m in [m for m in t.messages
+                      if m.dst == acc[node] and m not in stalled_msgs]:
+                t.deliver_message(m)
+            from frankenpaxos_tpu.core import wire as _wire
+            was_dirty = any(
+                isinstance(_wire.decode(m.data), cq.CraqTailRead)
+                for m in t.messages
+                if m not in stalled_msgs
+            )
+            drain_except_stalled()
+            assert r.done
+            actor_reads.append((was_dirty, r.result()))
+    # Release the stalled write and quiesce.
+    for m in list(stalled_msgs):
+        t.deliver_message(m)
+    stalled_msgs = []
+    drain_except_stalled()
+
+    # ---- Batched side: same schedule by injection; versions are the
+    # write sequence numbers. Record (routed_dirty, version).
+    cfg = cb.BatchedCraqConfig(
+        num_chains=1, chain_len=3, num_keys=3, window=16,
+        writes_per_tick=0, reads_per_tick=0, read_window=16,
+        lat_min=1, lat_max=1,
+    )
+    key_ = jax.random.PRNGKey(seed)
+    state = cb.init_state(cfg)
+    tt = 0
+
+    def run(state, tt, n):
+        for _ in range(n):
+            state = cb.tick(
+                cfg, state, jnp.int32(tt), jax.random.fold_in(key_, tt)
+            )
+            tt += 1
+        return state, tt
+
+    wslot = 0
+    rslot = 0
+    b_stalled_slot = None
+    bseq = 0
+    batched_reads = []
+    for op in ops:
+        if op[0] == "write":
+            _, key, stall = op
+            assert wslot < 16, 'scenario exceeds the write ring'
+            state = _inject_write(state, wslot, key, bseq, tt)
+            if stall and b_stalled_slot is None:
+                state, tt = run(state, tt, 2)  # reaches the head: dirty
+                assert int(state.node_dirty[0, 0, key]) >= 1
+                state = dataclasses.replace(
+                    state,
+                    w_arrival=state.w_arrival.at[0, wslot].set(tt + 5000),
+                )
+                b_stalled_slot = wslot
+            else:
+                state, tt = run(state, tt, 10)  # fully acked
+            wslot += 1
+            bseq += 1
+        else:
+            _, node, key = op
+            floor = int(state.node_version[0, 2, key])
+            dirty0 = int(state.reads_dirty)
+            assert rslot < 16, 'scenario exceeds the read ring'
+            state = _inject_read(state, rslot, key, node, tt, floor)
+            state, tt = run(state, tt, 5)
+            routed_dirty = int(state.reads_dirty) > dirty0
+            batched_reads.append(
+                (routed_dirty, int(state.r_version[0, rslot]))
+            )
+            rslot += 1
+    if b_stalled_slot is not None:
+        state = dataclasses.replace(
+            state,
+            w_arrival=state.w_arrival.at[0, b_stalled_slot].set(tt + 1),
+        )
+        state, tt = run(state, tt, 10)
+    inv = cb.check_invariants(cfg, state, jnp.int32(tt))
+    assert all(bool(v) for v in inv.values()), inv
+
+    # ---- Alignment: same routing decisions; values map version k <->
+    # "v<k>" (unwritten keys: batched -1 <-> per-actor DEFAULT).
+    assert len(actor_reads) == len(batched_reads)
+    for (a_dirty, a_val), (b_dirty, b_ver) in zip(actor_reads, batched_reads):
+        assert a_dirty == b_dirty, (seed, ops, actor_reads, batched_reads)
+        expect = cq.DEFAULT if b_ver < 0 else f"v{b_ver}"
+        assert a_val == expect, (seed, ops, actor_reads, batched_reads)
