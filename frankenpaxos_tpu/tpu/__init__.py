@@ -15,6 +15,7 @@ from frankenpaxos_tpu.tpu import (
     fastpaxos_batched,
     mencius_batched,
     scalog_batched,
+    unreplicated_batched,
 )
 from frankenpaxos_tpu.tpu.caspaxos_batched import (
     BatchedCasPaxosConfig,
@@ -72,6 +73,7 @@ __all__ = [
     "mencius_batched",
     "reconfigure",
     "scalog_batched",
+    "unreplicated_batched",
     "run_ticks",
     "tick",
 ]

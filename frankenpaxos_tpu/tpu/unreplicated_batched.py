@@ -1,0 +1,164 @@
+"""Batched unreplicated state machine — the throughput CEILING baseline.
+
+The reference's headline figure (eurosys fig1) frames compartmentalized
+MultiPaxos against an UNREPLICATED state machine: one server, no
+consensus, just client -> server -> client round trips — the ceiling any
+replication protocol is measured against (878k vs 983k cmd/s there,
+89%). This is that baseline for the batched world: ``G`` independent
+servers, a ring of ``W`` in-flight ops each, an op is one request hop +
+execute-on-arrival + one reply hop (``unreplicated/Server.scala``;
+per-actor analog ``protocols/unreplicated.py``). Everything else (PRNG
+latencies, ring accounting, stats) matches the consensus backends, so
+``ceiling_fraction = multipaxos committed/s / unreplicated ops/s`` is an
+apples-to-apples number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+
+U_EMPTY = 0
+U_REQ = 1  # request in flight to the server
+U_REP = 2  # reply in flight to the client
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedUnreplicatedConfig:
+    num_servers: int = 4  # G
+    window: int = 32  # W in-flight ops per server
+    ops_per_tick: int = 4  # K new ops per server per tick
+    lat_min: int = 1
+    lat_max: int = 3
+
+    def __post_init__(self):
+        assert self.window >= 2 * self.ops_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedUnreplicatedState:
+    status: jnp.ndarray  # [G, W]
+    issue: jnp.ndarray  # [G, W]
+    arrival: jnp.ndarray  # [G, W] next event tick
+    executed: jnp.ndarray  # [G] per-server executed ops
+    done: jnp.ndarray  # [] completed round trips
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedUnreplicatedConfig) -> BatchedUnreplicatedState:
+    G, W = cfg.num_servers, cfg.window
+    return BatchedUnreplicatedState(
+        status=jnp.zeros((G, W), jnp.int32),
+        issue=jnp.full((G, W), INF, jnp.int32),
+        arrival=jnp.full((G, W), INF, jnp.int32),
+        executed=jnp.zeros((G,), jnp.int32),
+        done=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def tick(
+    cfg: BatchedUnreplicatedConfig,
+    state: BatchedUnreplicatedState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedUnreplicatedState:
+    G, W = cfg.num_servers, cfg.window
+    bits = jax.random.bits(key, (G, W))  # [0:8) req lat, [8:16) rep lat
+    req_lat = bit_latency(bits, 0, cfg.lat_min, cfg.lat_max)
+    rep_lat = bit_latency(bits, 8, cfg.lat_min, cfg.lat_max)
+
+    # Server executes on arrival and replies (Server.scala handleRequest).
+    at_server = (state.status == U_REQ) & (state.arrival == t)
+    executed = state.executed + jnp.sum(at_server, axis=1)
+    status = jnp.where(at_server, U_REP, state.status)
+    arrival = jnp.where(at_server, t + rep_lat, state.arrival)
+
+    # Client receives the reply.
+    done_now = (status == U_REP) & (arrival <= t)
+    lat = jnp.where(done_now, t - state.issue, 0)
+    done = state.done + jnp.sum(done_now)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        done_now.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+    status = jnp.where(done_now, U_EMPTY, status)
+    arrival = jnp.where(done_now, INF, arrival)
+    issue = jnp.where(done_now, INF, state.issue)
+
+    # New ops.
+    empty = status == U_EMPTY
+    rank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
+    new = empty & (rank <= cfg.ops_per_tick)
+    status = jnp.where(new, U_REQ, status)
+    issue = jnp.where(new, t, issue)
+    arrival = jnp.where(new, t + req_lat, arrival)
+
+    return BatchedUnreplicatedState(
+        status=status,
+        issue=issue,
+        arrival=arrival,
+        executed=executed,
+        done=done,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedUnreplicatedConfig,
+    state: BatchedUnreplicatedState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedUnreplicatedState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedUnreplicatedConfig, state: BatchedUnreplicatedState, t
+) -> dict:
+    return {
+        "status_ok": jnp.all(
+            (state.status >= U_EMPTY) & (state.status <= U_REP)
+        ),
+        # Executed counts every request arrival; done lags by in-flight
+        # replies.
+        "books_ok": state.done <= jnp.sum(state.executed),
+    }
+
+
+def stats(cfg, state, t) -> dict:
+    done = int(state.done)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (done + 1) // 2)).argmax())
+        if done
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "done": done,
+        "latency_p50_ticks": p50,
+        "latency_mean_ticks": float(state.lat_sum) / done if done else -1.0,
+    }

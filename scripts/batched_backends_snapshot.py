@@ -12,17 +12,23 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu import (
+    BatchedCasPaxosConfig,
     BatchedCraqConfig,
     BatchedEPaxosConfig,
     BatchedFastPaxosConfig,
     BatchedMenciusConfig,
     BatchedMultiPaxosConfig,
     TpuSimTransport,
+    caspaxos_batched,
     craq_batched,
     epaxos_batched,
     fastpaxos_batched,
     mencius_batched,
     scalog_batched,
+    unreplicated_batched,
+)
+from frankenpaxos_tpu.tpu.unreplicated_batched import (
+    BatchedUnreplicatedConfig,
 )
 from frankenpaxos_tpu.tpu.scalog_batched import BatchedScalogConfig
 
@@ -49,6 +55,29 @@ out["multipaxos_10k_acceptors"] = {
     "committed_per_sec": int((mp.committed() - c0) / dt),
     "ticks_per_sec": round(400 / dt, 1),
 }
+
+# Unreplicated ceiling at the same scale (the eurosys-fig1 framing:
+# consensus throughput as a fraction of the no-replication ceiling).
+ucfg = BatchedUnreplicatedConfig(
+    num_servers=3334, window=64, ops_per_tick=8, lat_min=1, lat_max=3
+)
+ustate = unreplicated_batched.init_state(ucfg)
+ustate, ut = unreplicated_batched.run_ticks(
+    ucfg, ustate, jnp.int32(0), 400, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(ustate)
+u0 = int(ustate.done)
+t0 = time.perf_counter()
+ustate, ut = unreplicated_batched.run_ticks(
+    ucfg, ustate, ut, 400, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(ustate)
+dt = time.perf_counter() - t0
+ceiling = int((int(ustate.done) - u0) / dt)
+out["unreplicated_ceiling_3334_servers"] = {"ops_per_sec": ceiling}
+out["multipaxos_10k_acceptors"]["ceiling_fraction"] = round(
+    out["multipaxos_10k_acceptors"]["committed_per_sec"] / max(1, ceiling), 3
+)
 
 # MultiPaxos + device-side SM + client table (the full SMR pipeline).
 sm = TpuSimTransport(
@@ -175,6 +204,30 @@ out["fastpaxos_512_groups"] = {
     "chosen_per_sec": int((int(fstate.chosen_total) - f0) / dt),
     "fast_fraction": round(fs["fast_fraction"], 3),
     "safety_violations": fs["safety_violations"],
+}
+
+# CASPaxos @ 1024 registers, 2 contending leaders each.
+cscfg = BatchedCasPaxosConfig(
+    f=1, num_registers=1024, num_leaders=2, op_rate=0.3,
+    lat_min=1, lat_max=3, backoff_min=2, backoff_max=8,
+)
+csstate = caspaxos_batched.init_state(cscfg)
+csstate, cst = caspaxos_batched.run_ticks(
+    cscfg, csstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(csstate)
+cs0 = int(csstate.commits)
+t0 = time.perf_counter()
+csstate, cst = caspaxos_batched.run_ticks(
+    cscfg, csstate, cst, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(csstate)
+dt = time.perf_counter() - t0
+css = caspaxos_batched.stats(cscfg, csstate, cst)
+out["caspaxos_1024_registers"] = {
+    "commits_per_sec": int((int(csstate.commits) - cs0) / dt),
+    "nacks": css["nacks"],
+    "chain_violations": css["chain_violations"],
 }
 
 with open("results/batched_backends_cpu.json", "w") as f:

@@ -18,6 +18,17 @@ print(d)
     timeout 3000 python scripts/tpu_k_sweep.py >>"$LOG" 2>&1
     rc=$?
     echo "$ts k sweep rc=$rc" >>"$LOG"
+    # Also capture a full calibrated bench on the live chip, so a TPU
+    # number exists even if the tunnel wedges again before round end.
+    # Write via a temp file: a mid-bench tunnel drop must never truncate
+    # an earlier good capture.
+    if timeout 1800 python bench.py >results/.bench_tpu_tmp.json 2>>"$LOG"; then
+      mv results/.bench_tpu_tmp.json results/bench_tpu_recovered_r03.json
+      echo "$ts bench captured" >>"$LOG"
+    else
+      rm -f results/.bench_tpu_tmp.json
+      echo "$ts bench failed" >>"$LOG"
+    fi
     # Only stop once the sweep actually completed; a tunnel drop
     # mid-sweep goes back to polling.
     [ "$rc" -eq 0 ] && exit 0
