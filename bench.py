@@ -237,6 +237,87 @@ def _run_inner(env: dict, timeout: float):
     return None, f"rc={proc.returncode}: " + " | ".join(tail)
 
 
+_LAST_GOOD = os.path.join(_REPO, "results", "bench_tpu_last_good.json")
+
+
+def _is_tpu_result(result: dict) -> bool:
+    dev = str(result.get("device", "")).lower()
+    return bool(dev) and "cpu" not in dev and dev != "none"
+
+
+def _save_last_good(result: dict) -> None:
+    """Persist a live-TPU capture so later CPU-fallback runs can still
+    report a real-TPU headline (with honest staleness). Temp-file + mv:
+    a crash mid-write must never truncate an earlier good capture."""
+    import datetime
+
+    payload = dict(result)
+    payload["captured_at"] = datetime.datetime.now(
+        datetime.timezone.utc
+    ).isoformat(timespec="seconds")
+    tmp = _LAST_GOOD + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, _LAST_GOOD)
+    except OSError as e:
+        # stdout must stay one JSON line; a silent failure here would
+        # quietly disable the whole last-known-good mechanism.
+        print(f"warning: could not persist last-good capture: {e}",
+              file=sys.stderr)
+
+
+def _load_last_good() -> dict | None:
+    try:
+        with open(_LAST_GOOD) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return payload if _is_tpu_result(payload) else None
+
+
+def _staleness_hours(captured_at: str) -> float:
+    import datetime
+
+    try:
+        then = datetime.datetime.fromisoformat(captured_at)
+        if then.tzinfo is None:  # older/hand-edited captures: assume UTC
+            then = then.replace(tzinfo=datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return round((now - then).total_seconds() / 3600.0, 2)
+    except (ValueError, TypeError):
+        return -1.0
+
+
+def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
+    """A live CPU measurement is in hand. If a real-TPU capture of this
+    same benchmark exists from earlier (the tunnel wedges for hours at a
+    time), report THAT as the headline — honestly labelled with when it
+    was captured and how stale it is — with the live CPU number attached."""
+    last_good = _load_last_good()
+    if last_good is None:
+        return cpu_live
+    result = dict(last_good)
+    result["measured_live"] = False
+    result["staleness_hours"] = _staleness_hours(
+        result.get("captured_at", "")
+    )
+    result["live_cpu_fallback"] = {
+        "value": cpu_live.get("value"),
+        "unit": cpu_live.get("unit"),
+        "device": cpu_live.get("device"),
+        "p50_commit_latency_ticks": cpu_live.get(
+            "p50_commit_latency_ticks"
+        ),
+        "config": cpu_live.get("config"),
+    }
+    notes.append(
+        "headline is the last-known-good real-TPU capture; "
+        "live run this invocation was the attached CPU fallback"
+    )
+    return result
+
+
 def main() -> None:
     notes = []
     result = None
@@ -245,6 +326,18 @@ def main() -> None:
         result, note = _run_inner(_tpu_env(), timeout=900.0)
         if result is None:
             notes.append(f"tpu run failed ({note})")
+        elif _is_tpu_result(result):
+            result["measured_live"] = True
+            _save_last_good(result)
+        else:
+            # The probe saw the accelerator but JAX inside the inner run
+            # landed on CPU (the tunnel wedged in between): this is a CPU
+            # fallback, not a TPU headline.
+            notes.append(
+                "tpu probe ok but the measurement ran on "
+                f"{result.get('device')}; treating as cpu fallback"
+            )
+            result = _prefer_last_good(result, notes)
     else:
         notes.append("tpu probe failed or timed out; falling back to cpu")
 
@@ -252,6 +345,8 @@ def main() -> None:
         result, note = _run_inner(_cpu_env(), timeout=900.0)
         if result is None:
             notes.append(f"cpu run failed ({note})")
+        else:
+            result = _prefer_last_good(result, notes)
 
     if result is None:
         result = {

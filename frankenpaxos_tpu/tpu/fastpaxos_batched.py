@@ -109,6 +109,12 @@ class BatchedFastPaxosState:
     p0_arrival: jnp.ndarray  # [A, G, W] proposer-0 round-0 proposal
     p1_arrival: jnp.ndarray  # [A, G, W] proposer-1 round-0 proposal
     dn_arrival: jnp.ndarray  # [A, G, W] classic-phase message to acceptor
+    # The phase the classic message was sent FOR (1 = Phase1a, 2 =
+    # Phase2a), captured at send time — the message carries its phase,
+    # matching the captured-at-send discipline of caspaxos_batched,
+    # instead of inferring it from the counter's live status at delivery
+    # (which would misread stragglers under resends/multiple rounds).
+    dn_phase: jnp.ndarray  # [A, G, W] 0 = none
     up_arrival: jnp.ndarray  # [A, G, W] reply back to the counter
 
     # Safety ledger: any value that ever held a fast quorum of round-0
@@ -143,6 +149,7 @@ def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
         p0_arrival=jnp.full((A, G, W), INF, jnp.int32),
         p1_arrival=jnp.full((A, G, W), INF, jnp.int32),
         dn_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        dn_phase=jnp.zeros((A, G, W), jnp.int32),
         up_arrival=jnp.full((A, G, W), INF, jnp.int32),
         fp_committed_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         chosen_total=jnp.zeros((), jnp.int32),
@@ -202,17 +209,19 @@ def tick(
     p0_arrival = jnp.where(p0_now, INF, state.p0_arrival)
     p1_arrival = jnp.where(p1_now, INF, state.p1_arrival)
 
-    # ---- 2. Classic-phase messages at acceptors (dn_arrival): phase 1a
-    # promotes to round 1 and reports votes; phase 2a (status I_REC2 at
-    # the counter by the time it was sent) casts a round-1 vote.
+    # ---- 2. Classic-phase messages at acceptors (dn_arrival): the phase
+    # each message carries was captured at SEND time (dn_phase) — phase
+    # 1a promotes to round 1 and reports votes; phase 2a casts a round-1
+    # vote.
     dn_now = state.dn_arrival == t
-    p1a_now = dn_now & (status == I_REC1)[None, :, :]
-    p2a_now = dn_now & (status == I_REC2)[None, :, :]
+    p1a_now = dn_now & (state.dn_phase == 1)
+    p2a_now = dn_now & (state.dn_phase == 2)
     acc_round = jnp.where(p1a_now | p2a_now, 1, state.acc_round)
     vote_round = jnp.where(p2a_now, 1, vote_round)
     vote_value = jnp.where(p2a_now, state.rec_value[None, :, :], vote_value)
     up_arrival = jnp.where(p1a_now | p2a_now, t + up_lat, up_arrival)
     dn_arrival = jnp.where(dn_now, INF, state.dn_arrival)
+    dn_phase = jnp.where(dn_now, 0, state.dn_phase)
 
     # ---- 3. Safety ledger: a value holding a FAST quorum of round-0
     # votes in the acceptor arrays is committed, observed or not.
@@ -301,16 +310,19 @@ def tick(
     retire_at = jnp.where(newly_chosen, t + ret_lat, state.retire_at)
     status = jnp.where(newly_chosen, I_CHOSEN, status)
 
-    # Recovery kickoff: clear stale round-0 replies, send phase 1a.
+    # Recovery kickoff: clear stale round-0 replies, send phase 1a (the
+    # message carries its phase, captured here at send time).
     status = jnp.where(stuck, I_REC1, status)
     up_arrival = jnp.where(stuck[None, :, :], INF, up_arrival)
     dn_arrival = jnp.where(stuck[None, :, :], t + dn_lat, dn_arrival)
+    dn_phase = jnp.where(stuck[None, :, :], 1, dn_phase)
     recoveries = state.recoveries + jnp.sum(stuck)
 
     # Phase 1 -> phase 2: clear phase-1 replies, send phase 2a.
     status = jnp.where(rec1_done, I_REC2, status)
     up_arrival = jnp.where(rec1_done[None, :, :], INF, up_arrival)
     dn_arrival = jnp.where(rec1_done[None, :, :], t + dn_lat, dn_arrival)
+    dn_phase = jnp.where(rec1_done[None, :, :], 2, dn_phase)
 
     # Stats at choice.
     lat = jnp.where(newly_chosen, t - state.issue_tick, 0)
@@ -331,6 +343,7 @@ def tick(
     vote_value = jnp.where(clear3, NO_VALUE, vote_value)
     up_arrival = jnp.where(clear3, INF, up_arrival)
     dn_arrival = jnp.where(clear3, INF, dn_arrival)
+    dn_phase = jnp.where(clear3, 0, dn_phase)
     # Also discard the retired instance's still-in-flight round-0
     # proposals: a slow proposal firing into the slot's NEXT instance
     # would be a phantom vote for a value nobody proposed.
@@ -384,6 +397,7 @@ def tick(
         p0_arrival=p0_arrival,
         p1_arrival=p1_arrival,
         dn_arrival=dn_arrival,
+        dn_phase=dn_phase,
         up_arrival=up_arrival,
         fp_committed_value=fp_committed_value,
         chosen_total=chosen_total,

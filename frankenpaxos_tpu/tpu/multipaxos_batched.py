@@ -563,8 +563,14 @@ def tick(
         vote_value_in = jnp.where(
             in_flight_rc[None, :, :], NO_VALUE, vote_value_in
         )
+        # max(), not overwrite: a device-side election can bump acceptors
+        # past rc_round (via repair-Phase2a promises) while this exchange
+        # is in flight; regressing acc_round below vote_round would break
+        # promise monotonicity.
         acc_round_in = jnp.where(
-            p1_done[None, :], rc_round[None, :], acc_round_in
+            p1_done[None, :],
+            jnp.maximum(acc_round_in, rc_round[None, :]),
+            acc_round_in,
         )
         # max() keeps the round monotone if a device-side election bumped
         # it past rc_round while this exchange was in flight.
@@ -733,10 +739,30 @@ def tick(
         ct_last = ct_last.at[g_mat, client].max(
             jnp.where(executes, cmd, -1)
         )
+        # KV write is log-order last-writer-wins, NOT id-max: a chained
+        # re-issue can execute an OLD id at a LATER log position than a
+        # different client's newer id on the same key (the dup re-issued
+        # after its original was noop-repaired), and sequential execution
+        # keeps the later-in-log value. Per key the winner is the
+        # executing command at the highest ordinal this tick — unique per
+        # (group, key), so a scatter-max over winners-only is an exact
+        # "set". Ticks retire in head order, so the cross-tick overwrite
+        # is log-ordered too.
         key_of = jnp.where(executes, cmd % KV, 0)
-        kv_val = kv_val.at[g_mat, key_of].max(
-            jnp.where(executes, cmd, NO_VALUE)
+        win_ord = (
+            jnp.full((G, KV), -1, jnp.int32)
+            .at[g_mat, key_of]
+            .max(jnp.where(executes, ord_of_pos, -1))
         )
+        is_winner = executes & (
+            ord_of_pos == jnp.take_along_axis(win_ord, key_of, axis=1)
+        )
+        new_val = (
+            jnp.full((G, KV), NO_VALUE, jnp.int32)
+            .at[g_mat, key_of]
+            .max(jnp.where(is_winner, cmd, NO_VALUE))
+        )
+        kv_val = jnp.where(win_ord >= 0, new_val, kv_val)
         sm_applied = sm_applied + jnp.sum(executes)
         dups_filtered = dups_filtered + jnp.sum(filtered)
         dups_seen = dups_seen + jnp.sum(retire_mask & slot_is_dup & (cmd >= 0))

@@ -1,23 +1,63 @@
 #!/usr/bin/env python
-"""One-off: proposal-rate sweep on the live TPU — is committed/sec limited
-by bandwidth-per-window (flat in K) or fixed overheads (rises with K)?
-Writes results/tpu_k_sweep_r03.json incrementally after each row."""
+"""Proposal-rate sweep on the live TPU: cash the round-3 prediction that
+committed/sec rises ~linearly in K at fixed ticks/s until the in-flight
+window saturates (results/tpu_perf_analysis_r03.md: K=16/W=128 ~ 8M/s).
+
+Refuses to run on a CPU fallback (exit 2) so the watcher never marks a
+CPU sweep as the round's TPU sweep. Resumes from the incremental JSON:
+rows completed by an earlier partial run are kept and their points
+skipped, so a tunnel drop mid-sweep never loses measured rows."""
 import json
+import os
+import sys
 import time
 
 import jax
 
-from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+OUT = "results/tpu_k_sweep_r04.json"
+
+device = jax.devices()[0]
+if "cpu" in str(device).lower():
+    print(f"refusing to sweep on {device}; this sweep is TPU-only")
+    sys.exit(2)
 
 rows = []
+if os.path.exists(OUT):
+    # The exit-2 guard above means any existing file is TPU-measured.
+    try:
+        with open(OUT) as f:
+            rows = json.load(f).get("rows", [])
+    except (OSError, json.JSONDecodeError):
+        rows = []
+done = {(r["K"], r["W"], r["reads_per_tick"]) for r in rows}
 
 
 def save():
-    with open("results/tpu_k_sweep_r03.json", "w") as f:
-        json.dump({"device": str(jax.devices()[0]), "rows": rows}, f, indent=1)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"device": str(device), "rows": rows}, f, indent=1)
+    os.replace(tmp, OUT)
 
 
-for K, W, reads in [(8, 64, 0), (16, 128, 0), (32, 256, 0), (8, 64, 2)]:
+from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+# (K, W, reads): the r03 baseline point first (reproducibility anchor),
+# then the predicted optimum K=16/W=128 and its neighbours, then the
+# saturation probes.
+POINTS = [
+    (8, 64, 0),
+    (16, 128, 0),
+    (16, 96, 0),
+    (24, 128, 0),
+    (32, 128, 0),
+    (32, 256, 0),
+    (16, 128, 8),
+]
+
+for K, W, reads in POINTS:
+    if (K, W, reads) in done:
+        print(f"skip completed ({K}, {W}, {reads})", flush=True)
+        continue
     cfg = BatchedMultiPaxosConfig(
         f=1, num_groups=3334, window=W, slots_per_tick=K,
         lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,

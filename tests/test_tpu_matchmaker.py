@@ -187,3 +187,96 @@ def test_straggler_messages_with_wide_latency_spread():
         assert s["committed"] > committed_prev
         committed_prev = s["committed"]
     assert sim.stats()["reconfigurations"] >= 15
+
+
+def test_randomized_elections_with_reconfiguration_churn():
+    """Device-side elections RACING matchmaker reconfigurations: leader
+    deaths (fail_rate) bump leader_round past an in-flight rc_round, so
+    the p1_done install must jnp.maximum acc_round rather than overwrite
+    it (overwriting would regress acceptors below their vote_round and
+    break promise monotonicity / round_ok). Randomized over seeds so the
+    interleaving space — elections landing before, during, and after
+    each reconfiguration wave — is actually explored."""
+    total_elections = 0
+    for seed in range(6):
+        cfg = make(
+            num_groups=4, reconfigure_every=15, lat_min=1, lat_max=3,
+            fail_rate=0.03, revive_rate=0.15, heartbeat_timeout=3,
+            device_elections=True, retry_timeout=8,
+        )
+        sim = TpuSimTransport(cfg, seed=seed)
+        sim.run(300)
+        s = sim.stats()
+        inv = sim.check_invariants()
+        assert all(inv.values()), (seed, inv)
+        assert s["committed"] > 100, (seed, s["committed"])
+        assert s["reconfigurations"] > 0, seed
+        total_elections += s["elections"]
+    # The seeds must actually interleave elections with the churn
+    # (otherwise this test exercises nothing new).
+    assert total_elections > 0
+
+
+def test_election_midflight_reconfiguration_keeps_promises_monotone():
+    """Deterministic interleaving of ADVICE r03 (medium): an election
+    bumps leader_round PAST an in-flight reconfiguration's rc_round
+    (candidate 1 also dead -> delta 2), the repair re-proposal makes
+    acceptors vote at the election round, and only then does the
+    reconfiguration's p1_done install fire. The install must jnp.maximum
+    acc_round with rc_round, not overwrite — overwriting regresses
+    acceptors below their vote_round (round_ok / promise monotonicity)."""
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=1, window=8, slots_per_tick=1, lat_min=1, lat_max=1,
+        device_elections=True, heartbeat_timeout=3, reconfigure_every=20,
+        retry_timeout=100,
+    )
+    key = jax.random.PRNGKey(0)
+
+    def freeze(st):
+        # Chosen slots must stay in the ring (with their votes) so the
+        # invariant can see them at p1_done time.
+        return dataclasses.replace(
+            st, replica_arrival=jnp.full_like(st.replica_arrival, int(INF))
+        )
+
+    state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
+    # Slot 0's Phase2a reaches only acceptor 0: it stays PROPOSED with a
+    # single round-0 vote, so the election's phase-1 repair later
+    # re-proposes it at the election round.
+    p2a = np.asarray(state.p2a_arrival).copy()
+    p2a[1:, :, 0] = int(INF)
+    state = freeze(dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a)))
+
+    injected = False
+    saw_vote_at_election_round = False
+    for t in range(1, 60):
+        state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        state = freeze(state)
+        if not injected and (np.asarray(state.rc_p1b_arrival) < int(INF)).any():
+            # The wave is mid-phase-1: hold its Phase1b replies until
+            # t=45 and kill candidates 0 AND 1 (so the election's round
+            # delta is 2, overtaking rc_round = 1).
+            p1b = np.asarray(state.rc_p1b_arrival).copy()
+            p1b[p1b < int(INF)] = 45
+            alive = np.asarray(state.leader_alive).copy()
+            alive[0, :] = False
+            alive[1, :] = False
+            state = dataclasses.replace(
+                state,
+                rc_p1b_arrival=jnp.asarray(p1b),
+                leader_alive=jnp.asarray(alive),
+            )
+            injected = True
+        inv = check_invariants(cfg, state, jnp.int32(t))
+        assert all(bool(v) for v in inv.values()), (t, inv)
+        if int(np.asarray(state.vote_round).max()) == 2:
+            saw_vote_at_election_round = True
+    assert injected
+    assert int(state.elections) == 1
+    assert saw_vote_at_election_round, (
+        "scenario must actually vote at the election round mid-flight"
+    )
+    # The install completed (phase back to normal) without regressing
+    # any acceptor below its votes.
+    assert int(np.asarray(state.recon_phase)[0]) == RC_NORMAL
+    assert int(np.asarray(state.acc_round).min()) == 2

@@ -87,7 +87,7 @@ def test_sm_host_replay_is_exact():
                 client = (cmd // G) % NC
                 if cmd > ct[g, client]:
                     ct[g, client] = cmd
-                    kv[g, cmd % KV] = max(kv[g, cmd % KV], cmd)
+                    kv[g, cmd % KV] = cmd  # log-order last-writer-wins
                     applied += 1
                 else:
                     filtered += 1
@@ -137,7 +137,7 @@ def test_sm_host_replay_with_failovers_is_exact():
                 client = (cmd // G) % NC
                 if cmd > ct[g, client]:
                     ct[g, client] = cmd
-                    kv[g, cmd % KV] = max(kv[g, cmd % KV], cmd)
+                    kv[g, cmd % KV] = cmd  # log-order last-writer-wins
                     applied += 1
                 else:
                     filtered += 1
@@ -187,3 +187,45 @@ def test_sm_sharded_matches_unsharded():
         a = jax.device_get(getattr(plain_state, field.name))
         b = jax.device_get(getattr(sharded_state, field.name))
         assert np.array_equal(a, b), field.name
+
+
+def test_sm_kv_is_log_order_not_id_max():
+    """Crafted divergence (ADVICE r03): two clients write the SAME key in
+    one retiring batch, and the LATER-in-log command carries the SMALLER
+    id (a chained re-issue executing after its original slot was
+    noop-repaired). Sequential log-order execution keeps the later value;
+    a scatter-max on raw id would keep the earlier one."""
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import tick
+    from frankenpaxos_tpu.tpu.multipaxos_batched import CHOSEN
+
+    # G=1, NC=2, KV=3: client = cmd % 2, key = cmd % 3 — decoupled.
+    cfg = make(num_groups=1, window=8, slots_per_tick=1,
+               kv_keys=3, num_clients=2, dup_rate=0.0)
+    state = init_state(cfg)
+    # Slot 0 (client 0): cmd 8, key 2. Slot 1 (client 1): cmd 5, key 2.
+    # Both execute (fresh client table); log order says key 2 ends at 5.
+    status = np.asarray(state.status).copy()
+    status[0, 0] = CHOSEN
+    status[0, 1] = CHOSEN
+    chosen_value = np.asarray(state.chosen_value).copy()
+    chosen_value[0, 0] = 8
+    chosen_value[0, 1] = 5
+    replica_arrival = np.asarray(state.replica_arrival).copy()
+    replica_arrival[0, 0] = 0
+    replica_arrival[0, 1] = 0
+    next_slot = np.asarray(state.next_slot).copy()
+    next_slot[0] = 2
+    state = dataclasses.replace(
+        state,
+        status=jnp.asarray(status),
+        chosen_value=jnp.asarray(chosen_value),
+        replica_arrival=jnp.asarray(replica_arrival),
+        next_slot=jnp.asarray(next_slot),
+    )
+    state = tick(cfg, state, jnp.int32(0), jax.random.PRNGKey(9))
+    assert int(state.sm_applied) == 2
+    assert int(np.asarray(state.kv_val)[0, 2]) == 5, (
+        "KV must follow log order (last writer), not id-max"
+    )
