@@ -245,11 +245,31 @@ def _is_tpu_result(result: dict) -> bool:
     return bool(dev) and "cpu" not in dev and dev != "none"
 
 
+def _invariants_ok(result: dict) -> bool:
+    """True iff no attached variant reported a failed invariant check."""
+    checks = [
+        variant.get("invariants_ok")
+        for variant in result.values()
+        if isinstance(variant, dict) and "invariants_ok" in variant
+    ]
+    return all(c is not False for c in checks)
+
+
 def _save_last_good(result: dict) -> None:
     """Persist a live-TPU capture so later CPU-fallback runs can still
     report a real-TPU headline (with honest staleness). Temp-file + mv:
-    a crash mid-write must never truncate an earlier good capture."""
+    a crash mid-write must never truncate an earlier good capture.
+    A run whose variants failed invariants is never persisted — it must
+    not be replayed as the real-TPU headline by later invocations."""
     import datetime
+
+    if not _invariants_ok(result):
+        print(
+            "warning: live TPU run had failed invariants; "
+            "not persisting as last-known-good",
+            file=sys.stderr,
+        )
+        return
 
     payload = dict(result)
     payload["captured_at"] = datetime.datetime.now(
@@ -272,6 +292,12 @@ def _load_last_good() -> dict | None:
         with open(_LAST_GOOD) as f:
             payload = json.load(f)
     except (OSError, json.JSONDecodeError):
+        return None
+    # Only a capture of THIS benchmark may become the headline: a stale
+    # or hand-seeded capture of a different metric must not be promoted,
+    # and neither may a capture (e.g. written by an older bench.py) whose
+    # variants failed invariants.
+    if payload.get("metric") != METRIC or not _invariants_ok(payload):
         return None
     return payload if _is_tpu_result(payload) else None
 
@@ -328,6 +354,11 @@ def main() -> None:
             notes.append(f"tpu run failed ({note})")
         elif _is_tpu_result(result):
             result["measured_live"] = True
+            if not _invariants_ok(result):
+                notes.append(
+                    "live run reported FAILED invariants (see variant "
+                    "fields); not persisted as last-known-good"
+                )
             _save_last_good(result)
         else:
             # The probe saw the accelerator but JAX inside the inner run

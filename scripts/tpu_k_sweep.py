@@ -14,7 +14,7 @@ import time
 
 import jax
 
-OUT = "results/tpu_k_sweep_r04.json"
+OUT = "results/tpu_k_sweep_r05.json"
 
 device = jax.devices()[0]
 if "cpu" in str(device).lower():

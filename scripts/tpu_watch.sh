@@ -2,9 +2,9 @@
 # Poll the axon TPU tunnel all round; whenever it is up, refresh the
 # last-known-good TPU bench capture so the end-of-round bench.py always
 # has a recent real-TPU artifact even if the tunnel wedges again.
-# One status line per event in results/tpu_watch_r04.log.
+# One status line per event in results/tpu_watch_r05.log.
 cd /root/repo
-LOG=results/tpu_watch_r04.log
+LOG=results/tpu_watch_r05.log
 log() { echo "$(date -u +%H:%M:%S) $*" >>"$LOG"; }
 while true; do
   if timeout 90 python -c "
@@ -19,9 +19,9 @@ print(d)
     # K sweep once per round (cash the ~8M/s prediction). The sweep
     # refuses CPU fallbacks (exit 2) and resumes completed rows, so
     # gating the marker on exit 0 is exact.
-    if [ ! -f results/.tpu_k_sweep_r04.done ]; then
+    if [ ! -f results/.tpu_k_sweep_r05.done ]; then
       if timeout 3000 python scripts/tpu_k_sweep.py >>"$LOG" 2>&1; then
-        touch results/.tpu_k_sweep_r04.done
+        touch results/.tpu_k_sweep_r05.done
         log "k sweep complete"
       else
         log "k sweep incomplete (rc=$?)"
@@ -31,7 +31,7 @@ print(d)
     # last-known-good TPU artifact (results/bench_tpu_last_good.json)
     # on every successful live-TPU run.
     if timeout 1800 python bench.py >results/.bench_tpu_tmp.json 2>>"$LOG"; then
-      mv results/.bench_tpu_tmp.json results/bench_tpu_recovered_r04.json
+      mv results/.bench_tpu_tmp.json results/bench_tpu_recovered_r05.json
       log "bench captured"
     else
       rm -f results/.bench_tpu_tmp.json

@@ -222,3 +222,51 @@ def test_wide_latency_spread_no_phantom_votes():
     assert all(bool(v) for v in inv.values()), inv
     s = fb.stats(cfg, state, t)
     assert s["chosen"] > 0 and s["safety_violations"] == 0
+
+
+def test_straggler_phase1a_reports_vote_instead_of_casting():
+    """Regression for the dn_phase captured-at-send fix
+    (fastpaxos_batched.py BatchedFastPaxosState.dn_phase): a Phase1a
+    message that delivers AFTER the counter has already advanced to
+    I_REC2 must still act as a Phase1a — promote the acceptor and make
+    it report its existing round-0 vote — NOT be misread (from the
+    counter's live status) as a Phase2a casting a round-1 vote for the
+    recovery value. Under the old live-status inference the acceptor
+    below would end the tick with vote_round == 1 / vote_value == v1."""
+    cfg = fb.BatchedFastPaxosConfig(
+        f=1, num_groups=1, window=4, instances_per_tick=0,
+        conflict_rate=0.0, lat_min=1, lat_max=1, recovery_timeout=4,
+    )
+    v0, v1 = 10, 11  # _values_of(5)
+    t = 7
+    state = _inject_instance(cfg, fb.init_state(cfg), [0, None, None], t=0)
+    # Counter already in classic phase 2 proposing v1; acceptor 0 holds a
+    # round-0 vote for v0 and a STRAGGLER Phase1a (sent during I_REC1,
+    # phase captured at send) delivering this tick. Acceptors 1-2 already
+    # saw their Phase2as and voted round-1 v1 (replies still in flight so
+    # nothing is chosen during the distinguishing tick).
+    st = dataclasses.replace(
+        state,
+        status=state.status.at[0, 0].set(fb.I_REC2),
+        rec_value=state.rec_value.at[0, 0].set(v1),
+        dn_arrival=state.dn_arrival.at[0, 0, 0].set(t),
+        dn_phase=state.dn_phase.at[0, 0, 0].set(1),
+        acc_round=state.acc_round.at[1, 0, 0].set(1)
+        .at[2, 0, 0].set(1),
+        vote_round=state.vote_round.at[1, 0, 0].set(1)
+        .at[2, 0, 0].set(1),
+        vote_value=state.vote_value.at[1, 0, 0].set(v1)
+        .at[2, 0, 0].set(v1),
+        up_arrival=state.up_arrival.at[1, 0, 0].set(t + 1000)
+        .at[2, 0, 0].set(t + 1000),
+    )
+    st = fb.tick(cfg, st, jnp.int32(t), jax.random.PRNGKey(0))
+    # The Phase1a was consumed: acceptor 0 promoted to the classic round
+    # and scheduled a reply...
+    assert int(st.acc_round[0, 0, 0]) == 1
+    assert int(st.dn_arrival[0, 0, 0]) == fb.INF
+    assert int(st.up_arrival[0, 0, 0]) == t + 1  # reply sent (lat == 1)
+    # ...and that reply REPORTS the round-0 vote for v0 — it does not
+    # cast a round-1 vote for the recovery value.
+    assert int(st.vote_round[0, 0, 0]) == 0
+    assert int(st.vote_value[0, 0, 0]) == v0
