@@ -38,14 +38,15 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
     scalars and the latency histogram replicate."""
 
     def spec_for(leaf_name: str):
-        # Scalars, stats, and the GLOBAL read ring ([RW]-shaped: reads fan
-        # out to every group, so their per-read state replicates; the
-        # per-acceptor request/response arrays below still shard).
+        # Scalars, stats, and the shared wave clock ([NW] wave_issue —
+        # one probe wave per tick is global by construction). The
+        # per-group batcher rings (rb_*: [G, NW]) and the wave's
+        # per-acceptor request/response arrays ([A, G, NW]) SHARD with
+        # the group axis: read state lives with the groups it serves.
         scalar_or_global = {
             "committed", "retired", "lat_sum", "lat_hist",
-            "max_chosen_global", "client_watermark", "read_status",
-            "read_issue", "read_target", "read_floor", "reply_arrival",
-            "reads_done", "read_lat_sum", "read_lat_hist",
+            "max_chosen_global", "client_watermark", "wave_issue",
+            "reads_done", "reads_shed", "read_lat_sum", "read_lat_hist",
             "read_lin_violations", "elections", "reconfigs", "configs_gcd",
             "sm_applied", "dups_filtered", "dups_seen",
         }

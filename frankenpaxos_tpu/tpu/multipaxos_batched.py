@@ -107,16 +107,25 @@ class BatchedMultiPaxosConfig:
     # tests meaningful.
     use_pallas: bool = False
     pallas_block_g: int = 256  # group-axis block per kernel invocation
-    # The read path ("Evelyn Paxos", Client.scala:1053-1069 /
-    # Acceptor.scala:222-237 / Replica.scala:455-529): reads_per_tick
-    # GLOBAL read ops are issued per tick into a ring of read_window
-    # outstanding reads. Modes: "linearizable" (MaxSlotRequest to a
-    # random f+1 read quorum of EVERY group, bind to the max global voted
-    # slot, wait for the global executed watermark), "sequential" (bind
-    # to the client's largest-seen slot, Client.scala:300-305), and
-    # "eventual" (execute immediately, Replica.scala:645-654).
-    reads_per_tick: int = 0
-    read_window: int = 0  # outstanding-read ring size (0 = reads off)
+    # The read path: device-resident ReadBatchers (ReadBatcher.scala:
+    # 239-338 Size/Adaptive batching, Acceptor.scala:222-237
+    # handleBatchMaxSlotRequest, Replica.scala:455-529 deferred batches).
+    # Every group hosts a read batcher; each tick, read_rate client reads
+    # arrive at EACH group's batcher and form one batch (so read load
+    # scales with G, the way the reference adds ReadBatcher nodes).
+    # Linearizable batches ride a shared per-tick MaxSlot probe WAVE —
+    # one random f+1 read quorum of every group, the reference's Adaptive
+    # scheme ("when we receive a BatchMaxSlotReply, we'll trigger the
+    # batch") collapsed onto the device: all batchers reuse the same
+    # quorum round, and the whole batch binds to the max global voted
+    # slot the wave observed, then drains behind the executed watermark.
+    # One wave amortizes over G * read_rate reads — the batching
+    # economics that let ReadBatcher.scala scale reads past writes.
+    # Modes: "linearizable" (wave + watermark), "sequential" (bind to the
+    # client's largest-seen slot, Client.scala:300-305), "eventual"
+    # (execute immediately, Replica.scala:645-654).
+    read_rate: int = 0  # client reads per GROUP per tick (0 = reads off)
+    read_window: int = 0  # batch/wave ring slots (NW; 0 = reads off)
     read_mode: str = "linearizable"
     # Device-side failure detection + elections (heartbeat/Participant.
     # scala:72-209, election round-robin of roundsystem ClassicRoundRobin):
@@ -190,9 +199,13 @@ class BatchedMultiPaxosConfig:
             assert 0.0 <= self.dup_rate < 1.0
         else:
             assert self.dup_rate == 0.0, "dup_rate needs state_machine='kv'"
-        if self.reads_per_tick:
-            assert self.read_window >= 2 * self.reads_per_tick, (
-                "read_window must leave room for in-flight reads"
+        if self.read_rate:
+            # A wave slot is reused every read_window ticks; a wave lives
+            # at most 2*lat_max ticks (request leg + reply leg), so the
+            # ring must outlast it.
+            assert self.read_window >= 2 * self.lat_max + 2, (
+                "read_window must exceed a wave round-trip "
+                f"(need >= {2 * self.lat_max + 2})"
             )
 
 
@@ -267,21 +280,27 @@ class BatchedMultiPaxosState:
     dups_filtered: jnp.ndarray  # [] re-executions the client table filtered
     dups_seen: jnp.ndarray  # [] retired real slots flagged as duplicates
 
-    # Read path (all zero-sized when cfg.read_window == 0). RW = ring of
-    # outstanding GLOBAL read ops; global slot numbering is s*G + g.
+    # Read path (all zero-sized when cfg.read_window == 0). NW = wave /
+    # batch ring slots; global slot numbering is s*G + g. Per-group
+    # ReadBatchers ([G, NW] rb_* arrays, sharded with the group axis)
+    # ride a shared MaxSlot probe wave ([NW] + [A, G, NW] arrays).
     acc_max_slot: jnp.ndarray  # [A, G] max per-group slot this acceptor voted
     max_chosen_global: jnp.ndarray  # [] max global slot ever chosen (-1)
     client_watermark: jnp.ndarray  # [] client's largest-seen global slot (-1)
-    read_status: jnp.ndarray  # [RW] R_EMPTY | R_WAIT | R_BOUND | R_SENT
-    read_issue: jnp.ndarray  # [RW] issue tick
-    read_target: jnp.ndarray  # [RW] bound global slot (-1 = none yet)
-    read_floor: jnp.ndarray  # [RW] max_chosen_global at issue (lin check)
-    req_arrival: jnp.ndarray  # [A, G, RW] MaxSlotRequest arrival (INF)
-    resp_slot: jnp.ndarray  # [A, G, RW] MaxSlotReply payload (global, -1)
-    resp_arrival: jnp.ndarray  # [A, G, RW] MaxSlotReply arrival (INF)
-    reply_arrival: jnp.ndarray  # [RW] final read-reply arrival (INF)
+    wave_issue: jnp.ndarray  # [NW] wave launch tick (INF = slot free)
+    req_arrival: jnp.ndarray  # [A, G, NW] BatchMaxSlotRequest arrival (INF)
+    resp_slot: jnp.ndarray  # [A, G, NW] BatchMaxSlotReply payload (global)
+    resp_arrival: jnp.ndarray  # [A, G, NW] BatchMaxSlotReply arrival (INF)
+    rb_status: jnp.ndarray  # [G, NW] R_EMPTY | R_WAIT | R_BOUND | R_SENT
+    rb_count: jnp.ndarray  # [G, NW] client reads carried by the batch
+    rb_wave: jnp.ndarray  # [G, NW] wave ring slot the batch rides (-1)
+    rb_issue: jnp.ndarray  # [G, NW] batch formation tick (INF)
+    rb_target: jnp.ndarray  # [G, NW] bound global slot (-1 = none yet)
+    rb_floor: jnp.ndarray  # [G, NW] max_chosen_global at issue (lin check)
+    rb_reply_arrival: jnp.ndarray  # [G, NW] batch reply arrival (INF)
     reads_done: jnp.ndarray  # [] completed reads (cumulative)
-    read_lat_sum: jnp.ndarray  # [] sum of read latencies (ticks)
+    reads_shed: jnp.ndarray  # [] reads dropped by batcher backpressure
+    read_lat_sum: jnp.ndarray  # [] sum of read latencies (read-weighted)
     read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
     read_lin_violations: jnp.ndarray  # [] reads bound below their floor
 
@@ -351,15 +370,19 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         acc_max_slot=jnp.full((A, G), -1, jnp.int32),
         max_chosen_global=jnp.full((), -1, jnp.int32),
         client_watermark=jnp.full((), -1, jnp.int32),
-        read_status=jnp.zeros((RW,), jnp.int32),
-        read_issue=jnp.full((RW,), INF, jnp.int32),
-        read_target=jnp.full((RW,), -1, jnp.int32),
-        read_floor=jnp.full((RW,), -1, jnp.int32),
+        wave_issue=jnp.full((RW,), INF, jnp.int32),
         req_arrival=jnp.full((A, G, RW), INF, jnp.int32),
         resp_slot=jnp.full((A, G, RW), -1, jnp.int32),
         resp_arrival=jnp.full((A, G, RW), INF, jnp.int32),
-        reply_arrival=jnp.full((RW,), INF, jnp.int32),
+        rb_status=jnp.zeros((G, RW), jnp.int32),
+        rb_count=jnp.zeros((G, RW), jnp.int32),
+        rb_wave=jnp.full((G, RW), -1, jnp.int32),
+        rb_issue=jnp.full((G, RW), INF, jnp.int32),
+        rb_target=jnp.full((G, RW), -1, jnp.int32),
+        rb_floor=jnp.full((G, RW), -1, jnp.int32),
+        rb_reply_arrival=jnp.full((G, RW), INF, jnp.int32),
         reads_done=jnp.zeros((), jnp.int32),
+        reads_shed=jnp.zeros((), jnp.int32),
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         read_lin_violations=jnp.zeros((), jnp.int32),
@@ -869,37 +892,46 @@ def tick(
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
 
-    # ---- 6. Reads (Evelyn Paxos; Client.scala:1053-1069 read fan-out,
-    # Acceptor.scala:222-237 handleMaxSlotRequest, Replica.scala:455-529
-    # deferred reads draining behind the executed watermark). Global slot
-    # numbering is s*G + g; the global contiguous executed watermark is
-    # min_g(head_g*G + g). Reads are modeled lossless (the reference
-    # retries them like writes; a dropped-read model adds nothing the
-    # write path doesn't already exercise).
+    # ---- 6. Reads: device-resident ReadBatchers (ReadBatcher.scala:
+    # 239-338 batching, Acceptor.scala:239-252 handleBatchMaxSlotRequest,
+    # Replica.scala:455-529 deferred read batches draining behind the
+    # executed watermark). Global slot numbering is s*G + g; the global
+    # contiguous executed watermark is min_g(head_g*G + g). Each group
+    # hosts a batcher; each tick every batcher forms one batch of
+    # cfg.read_rate reads, and all linearizable batches ride the tick's
+    # shared MaxSlot probe wave (one random f+1 read quorum of EVERY
+    # group — Client.scala:851-933 semantics, so the bind is provably
+    # linearizable, unlike the reference ReadBatcher's one-random-group
+    # "+ numGroups - 1" heuristic with its own safety TODO). Reads are
+    # modeled lossless (the reference retries them like writes).
     acc_max_slot = state.acc_max_slot
     max_chosen_global = state.max_chosen_global
     client_watermark = state.client_watermark
-    read_status = state.read_status
-    read_issue = state.read_issue
-    read_target = state.read_target
-    read_floor = state.read_floor
+    wave_issue = state.wave_issue
     req_arrival = state.req_arrival
     resp_slot = state.resp_slot
     resp_arrival = state.resp_arrival
-    reply_arrival = state.reply_arrival
+    rb_status = state.rb_status
+    rb_count = state.rb_count
+    rb_wave = state.rb_wave
+    rb_issue = state.rb_issue
+    rb_target = state.rb_target
+    rb_floor = state.rb_floor
+    rb_reply_arrival = state.rb_reply_arrival
     reads_done = state.reads_done
+    reads_shed = state.reads_shed
     read_lat_sum = state.read_lat_sum
     read_lat_hist = state.read_lat_hist
     read_lin_violations = state.read_lin_violations
-    if cfg.reads_per_tick:
-        RW = cfg.read_window
+    if cfg.read_rate:
+        NW = cfg.read_window
         kr_a, kr_b = jax.random.split(k_read)
-        bits_r = jax.random.bits(kr_a, (A, G, RW))  # [0:8) req lat,
+        bits_r = jax.random.bits(kr_a, (A, G, NW))  # [0:8) req lat,
         #                       [8:16) resp lat, [16:32) quorum sampling
-        bits_r1 = jax.random.bits(kr_b, (RW,))  # [0:8) reply lat
+        bits_rg = jax.random.bits(kr_b, (G, NW))  # [0:8) batch reply lat
         req_lat = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max)
         resp_lat = bit_latency(bits_r, 8, cfg.lat_min, cfg.lat_max)
-        reply_lat = bit_latency(bits_r1, 0, cfg.lat_min, cfg.lat_max)
+        reply_lat = bit_latency(bits_rg, 0, cfg.lat_min, cfg.lat_max)
 
         # (a) Acceptor bookkeeping: a vote on per-group slot s raises that
         # acceptor's maxVotedSlot (Acceptor.scala:222-237 serves it from
@@ -926,9 +958,10 @@ def tick(
             jnp.max(jnp.where(newly_chosen, slot_of_pos * G + group_ids, -1)),
         )
 
-        # (b) MaxSlotReplies: requests arriving now read the acceptor's
-        # updated max voted slot in GLOBAL numbering; replies travel back.
-        req_now = req_arrival == t  # [A, G, RW]
+        # (b) BatchMaxSlotReplies: requests arriving now read the
+        # acceptor's updated max voted slot in GLOBAL numbering; replies
+        # travel back (Acceptor.scala:239-252).
+        req_now = req_arrival == t  # [A, G, NW]
         g_row = jnp.arange(G, dtype=jnp.int32)[None, :]  # [1, G]
         global_acc = jnp.where(
             acc_max_slot >= 0, acc_max_slot * G + g_row, -1
@@ -937,82 +970,109 @@ def tick(
         resp_arrival = jnp.where(req_now, t + resp_lat, resp_arrival)
         req_arrival = jnp.where(req_now, INF, req_arrival)  # consumed
 
-        # (c) Bind: a waiting read whose every sampled acceptor has replied
-        # adopts the max reply as its target (Client.handleMaxSlotReply,
-        # Client.scala:851-933 waits a quorum per group and maxes).
-        any_outstanding = jnp.any(req_arrival < INF, axis=(0, 1))  # [RW]
+        # (c) Wave completion + bind: once every sampled acceptor of a
+        # wave has replied, ALL batches riding that wave bind to the max
+        # reply (the shared Adaptive-scheme quorum round; the max over a
+        # quorum per group is Client.scala:851-933's bind rule). The
+        # wave slot frees immediately — its lifetime is <= 2*lat_max,
+        # which __post_init__ guarantees is under the ring period.
+        any_outstanding = jnp.any(req_arrival < INF, axis=(0, 1))  # [NW]
         any_pending = jnp.any(
             (resp_arrival < INF) & (resp_arrival > t), axis=(0, 1)
         )
-        ready = (read_status == R_WAIT) & ~any_outstanding & ~any_pending
-        target = jnp.max(
+        wave_ready = (wave_issue < INF) & ~any_outstanding & ~any_pending
+        wave_val = jnp.max(
             jnp.where(resp_arrival < INF, resp_slot, -1), axis=(0, 1)
-        )  # [RW]
-        read_target = jnp.where(ready, target, read_target)
+        )  # [NW]
+        # Batches ride the wave recorded at their formation (rb_wave);
+        # batch ring rows and wave ring slots are decoupled so a batch
+        # stalled behind the watermark doesn't block the row its tick's
+        # wave index happens to map to.
+        wv = jnp.clip(rb_wave, 0, NW - 1)
+        bind_now = (rb_status == R_WAIT) & jnp.take(wave_ready, wv)
+        batch_val = jnp.take(wave_val, wv)  # [G, NW]
+        rb_target = jnp.where(bind_now, batch_val, rb_target)
         read_lin_violations = read_lin_violations + jnp.sum(
-            ready & (target < read_floor)
+            jnp.where(bind_now & (batch_val < rb_floor), rb_count, 0)
         )
-        read_status = jnp.where(ready, R_BOUND, read_status)
+        rb_status = jnp.where(bind_now, R_BOUND, rb_status)
+        wave_issue = jnp.where(wave_ready, INF, wave_issue)
+        resp_slot = jnp.where(wave_ready[None, None, :], -1, resp_slot)
+        resp_arrival = jnp.where(wave_ready[None, None, :], INF, resp_arrival)
 
-        # (d) Completion: the reply leaves once the executed watermark
-        # passes the target (Replica.scala:407-412 drains deferred reads
-        # inside executeLog). The reply carries the slot the read actually
-        # EXECUTED at (watermark-1, >= target) — the client's
-        # largestSeenSlots updates from executed slots, not requested
-        # targets (Client.scala:300-305), which is what lets sequential
-        # reads advance behind concurrent writes.
+        # (d) Completion: a batch's reply leaves once the executed
+        # watermark passes its target (Replica.scala:407-412 drains
+        # deferred reads inside executeLog). The reply carries the slot
+        # the batch actually EXECUTED at (watermark-1, >= target) — the
+        # client's largestSeenSlots updates from executed slots, not
+        # requested targets (Client.scala:300-305), which is what lets
+        # sequential reads advance behind concurrent writes.
         watermark = jnp.min(head * G + jnp.arange(G, dtype=jnp.int32))
-        can_send = (read_status == R_BOUND) & (watermark > read_target)
-        # After the floor check at bind, read_target's only remaining
+        can_send = (rb_status == R_BOUND) & (watermark > rb_target)
+        # After the floor check at bind, rb_target's only remaining
         # consumer is the client watermark update below, so it can carry
         # the executed slot from here on.
-        read_target = jnp.where(can_send, watermark - 1, read_target)
-        reply_arrival = jnp.where(can_send, t + reply_lat, reply_arrival)
-        read_status = jnp.where(can_send, R_SENT, read_status)
-        done = (read_status == R_SENT) & (reply_arrival <= t)
-        n_done = jnp.sum(done)
-        rlat = jnp.where(done, t - read_issue, 0)
-        reads_done = reads_done + n_done
-        read_lat_sum = read_lat_sum + jnp.sum(rlat)
+        rb_target = jnp.where(can_send, watermark - 1, rb_target)
+        rb_reply_arrival = jnp.where(can_send, t + reply_lat, rb_reply_arrival)
+        rb_status = jnp.where(can_send, R_SENT, rb_status)
+        done = (rb_status == R_SENT) & (rb_reply_arrival <= t)
+        done_count = jnp.where(done, rb_count, 0)
+        rlat = jnp.where(done, t - rb_issue, 0)
+        reads_done = reads_done + jnp.sum(done_count)
+        read_lat_sum = read_lat_sum + jnp.sum(rlat * done_count)
         rbins = jnp.clip(rlat, 0, LAT_BINS - 1)
         read_lat_hist = read_lat_hist + jax.ops.segment_sum(
-            done.astype(jnp.int32), rbins, LAT_BINS
+            done_count.ravel(), rbins.ravel(), LAT_BINS
         )
         client_watermark = jnp.maximum(
-            client_watermark, jnp.max(jnp.where(done, read_target, -1))
+            client_watermark, jnp.max(jnp.where(done, rb_target, -1))
         )
-        read_status = jnp.where(done, R_EMPTY, read_status)
-        read_target = jnp.where(done, -1, read_target)
-        read_floor = jnp.where(done, -1, read_floor)
-        read_issue = jnp.where(done, INF, read_issue)
-        reply_arrival = jnp.where(done, INF, reply_arrival)
-        resp_slot = jnp.where(done[None, None, :], -1, resp_slot)
-        resp_arrival = jnp.where(done[None, None, :], INF, resp_arrival)
+        rb_status = jnp.where(done, R_EMPTY, rb_status)
+        rb_count = jnp.where(done, 0, rb_count)
+        rb_target = jnp.where(done, -1, rb_target)
+        rb_floor = jnp.where(done, -1, rb_floor)
+        rb_issue = jnp.where(done, INF, rb_issue)
+        rb_wave = jnp.where(done, -1, rb_wave)
+        rb_reply_arrival = jnp.where(done, INF, rb_reply_arrival)
 
-        # (e) Issue new reads into empty ring slots.
-        empty = read_status == R_EMPTY
-        rank = jnp.cumsum(empty.astype(jnp.int32))
-        is_issue = empty & (rank <= cfg.reads_per_tick)
-        read_issue = jnp.where(is_issue, t, read_issue)
-        read_floor = jnp.where(is_issue, max_chosen_global, read_floor)
+        # (e) Issue. Wave ring slot w = t mod NW hosts this tick's probe
+        # wave; each group's batcher forms a batch of read_rate reads in
+        # its FIRST free row (rows and wave slots are decoupled). A
+        # group with every row occupied (watermark lag) sheds its reads —
+        # batcher backpressure, counted honestly instead of silently
+        # queued.
+        wslot = (
+            jnp.arange(NW, dtype=jnp.int32) == jnp.mod(t, NW)
+        )  # [NW] one-hot
+        empty_rb = rb_status == R_EMPTY  # [G, NW]
+        rank_rb = jnp.cumsum(empty_rb.astype(jnp.int32), axis=1)
+        can_batch = empty_rb & (rank_rb == 1)  # first free row per group
+        reads_shed = reads_shed + cfg.read_rate * (
+            G - jnp.sum(can_batch)
+        )
+        rb_count = jnp.where(can_batch, cfg.read_rate, rb_count)
+        rb_issue = jnp.where(can_batch, t, rb_issue)
+        rb_floor = jnp.where(can_batch, max_chosen_global, rb_floor)
         if cfg.read_mode == "linearizable":
-            # Random f+1 read quorum of EVERY group (randomReadQuorum,
-            # QuorumSystem.scala:16-24; same selection scheme as the
-            # thrifty write quorum above).
+            # Launch the shared wave: one random f+1 read quorum of
+            # EVERY group (randomReadQuorum, QuorumSystem.scala:16-24).
+            launch = wslot & (wave_issue == INF)  # [NW]
             in_rq = sample_quorum(bits_r, 16, f, A)
-            send_req = is_issue[None, None, :] & in_rq
+            send_req = launch[None, None, :] & in_rq
             req_arrival = jnp.where(send_req, t + req_lat, req_arrival)
-            read_status = jnp.where(is_issue, R_WAIT, read_status)
+            wave_issue = jnp.where(launch, t, wave_issue)
+            rb_wave = jnp.where(can_batch, jnp.mod(t, NW), rb_wave)
+            rb_status = jnp.where(can_batch, R_WAIT, rb_status)
         elif cfg.read_mode == "sequential":
             # The client's largest-seen slot (Client.scala:300-305). The
             # batched client is a read-only observer: its watermark
             # advances from its own completed reads (writes belong to
             # other, anonymous clients).
-            read_target = jnp.where(is_issue, client_watermark, read_target)
-            read_status = jnp.where(is_issue, R_BOUND, read_status)
+            rb_target = jnp.where(can_batch, client_watermark, rb_target)
+            rb_status = jnp.where(can_batch, R_BOUND, rb_status)
         else:  # eventual: execute immediately (Replica.scala:645-654)
-            read_target = jnp.where(is_issue, -1, read_target)
-            read_status = jnp.where(is_issue, R_BOUND, read_status)
+            rb_target = jnp.where(can_batch, -1, rb_target)
+            rb_status = jnp.where(can_batch, R_BOUND, rb_status)
 
     return BatchedMultiPaxosState(
         leader_round=leader_round,
@@ -1062,15 +1122,19 @@ def tick(
         acc_max_slot=acc_max_slot,
         max_chosen_global=max_chosen_global,
         client_watermark=client_watermark,
-        read_status=read_status,
-        read_issue=read_issue,
-        read_target=read_target,
-        read_floor=read_floor,
+        wave_issue=wave_issue,
         req_arrival=req_arrival,
         resp_slot=resp_slot,
         resp_arrival=resp_arrival,
-        reply_arrival=reply_arrival,
+        rb_status=rb_status,
+        rb_count=rb_count,
+        rb_wave=rb_wave,
+        rb_issue=rb_issue,
+        rb_target=rb_target,
+        rb_floor=rb_floor,
+        rb_reply_arrival=rb_reply_arrival,
         reads_done=reads_done,
+        reads_shed=reads_shed,
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
         read_lin_violations=read_lin_violations,
@@ -1271,8 +1335,15 @@ def check_invariants(
     # guarantee of the Evelyn read path); ring states stay in range.
     # Trivially true when reads are off (empty arrays).
     read_lin_ok = state.read_lin_violations == 0
-    read_ring_ok = jnp.all(
-        (state.read_status >= R_EMPTY) & (state.read_status <= R_SENT)
+    read_ring_ok = (
+        jnp.all(
+            (state.rb_status >= R_EMPTY) & (state.rb_status <= R_SENT)
+        )
+        # A batch carries reads iff it exists (count bookkeeping).
+        & jnp.all((state.rb_count == 0) == (state.rb_status == R_EMPTY))
+        & jnp.all(state.rb_count >= 0)
+        # A waiting batch always references the wave it rides.
+        & jnp.all(jnp.where(state.rb_status == R_WAIT, state.rb_wave >= 0, True))
     )
     # Global slot numbering (s*G + g) is int32: it overflows once any
     # group's head passes 2^31/G (~644k slots at G=3334), after which the

@@ -145,7 +145,7 @@ class TpuSimTransport:
             out["kv_keys_set"] = int(
                 (jax.device_get(self.state.kv_val) >= 0).sum()
             )
-        if self.config.reads_per_tick:
+        if self.config.read_rate:
             reads = int(self.state.reads_done)
             rhist = jax.device_get(self.state.read_lat_hist)
             rcum = rhist.cumsum()
@@ -157,6 +157,7 @@ class TpuSimTransport:
             out["read_latency_p50_ticks"] = (
                 int((rcum >= max(1, (reads + 1) // 2)).argmax()) if reads else -1
             )
+            out["reads_shed"] = int(self.state.reads_shed)
         return out
 
     def check_invariants(self) -> dict:

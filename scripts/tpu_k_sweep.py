@@ -29,7 +29,7 @@ if os.path.exists(OUT):
             rows = json.load(f).get("rows", [])
     except (OSError, json.JSONDecodeError):
         rows = []
-done = {(r["K"], r["W"], r["reads_per_tick"]) for r in rows}
+done = {(r["K"], r["W"], r.get("read_rate", r.get("reads_per_tick", 0))) for r in rows}
 
 
 def save():
@@ -51,7 +51,7 @@ POINTS = [
     (24, 128, 0),
     (32, 128, 0),
     (32, 256, 0),
-    (16, 128, 8),
+    (16, 128, 1),  # 1 read per group per tick = G reads/tick
 ]
 
 for K, W, reads in POINTS:
@@ -61,7 +61,7 @@ for K, W, reads in POINTS:
     cfg = BatchedMultiPaxosConfig(
         f=1, num_groups=3334, window=W, slots_per_tick=K,
         lat_min=1, lat_max=3, drop_rate=0.0, retry_timeout=16, thrifty=True,
-        reads_per_tick=reads, read_window=4 * reads,
+        read_rate=reads, read_window=16 if reads else 0,
     )
     sim = TpuSimTransport(cfg, seed=0)
     sim.run(200); sim.block_until_ready()
@@ -71,7 +71,7 @@ for K, W, reads in POINTS:
     sim.run(600); sim.block_until_ready()
     dt = time.perf_counter() - t0
     row = {
-        "K": K, "W": W, "reads_per_tick": reads,
+        "K": K, "W": W, "read_rate": reads,
         "ticks_per_sec": round(600 / dt, 1),
         "committed_per_sec": round((sim.committed() - c0) / dt, 1),
         "p50_ticks": sim.stats()["commit_latency_p50_ticks"],

@@ -71,7 +71,7 @@ def test_grouped_backend_with_reads_reduces_only_read_state():
     all-gather of sharded state."""
     cfg = BatchedMultiPaxosConfig(
         f=1, num_groups=8, window=16, slots_per_tick=2,
-        reads_per_tick=2, read_window=8, read_mode="linearizable",
+        read_rate=2, read_window=8, read_mode="linearizable",
     )
     txt = _compiled_text(cfg, make_mesh())
     for op in ("all-gather", "all-to-all"):

@@ -162,7 +162,7 @@ def test_failover_with_reads_and_loss():
     cfg = make(
         fail_rate=0.01, revive_rate=0.2, heartbeat_timeout=4,
         drop_rate=0.1, retry_timeout=6,
-        reads_per_tick=2, read_window=8, read_mode="linearizable",
+        read_rate=2, read_window=8, read_mode="linearizable",
     )
     sim = TpuSimTransport(cfg, seed=4)
     sim.run(400)

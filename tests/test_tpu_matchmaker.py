@@ -129,7 +129,7 @@ def test_matchmaker_with_reads_failover_and_loss():
     cfg = make(
         num_groups=4, reconfigure_every=60, drop_rate=0.1, retry_timeout=6,
         fail_rate=0.005, revive_rate=0.2, heartbeat_timeout=5,
-        reads_per_tick=2, read_window=8, read_mode="linearizable",
+        read_rate=2, read_window=8, read_mode="linearizable",
     )
     sim = TpuSimTransport(cfg, seed=3)
     sim.run(400)

@@ -1,7 +1,9 @@
-"""Tests of the batched read path (Evelyn Paxos reads in the TPU backend):
-linearizable quorum reads, sequential and eventual modes, device-side
-linearizability invariant, and sharded equality (conftest: CPU, 8 virtual
-devices)."""
+"""Tests of the batched read path: device-resident ReadBatchers
+(ReadBatcher.scala:239-338) whose per-group batches ride a shared
+MaxSlot probe wave — linearizable/sequential/eventual modes, the
+device-side linearizability floor, read conservation, throughput
+scaling with the group count, and sharded equality (conftest: CPU, 8
+virtual devices)."""
 
 import dataclasses
 
@@ -32,7 +34,7 @@ from frankenpaxos_tpu.tpu.multipaxos_batched import (
 def make(mode="linearizable", **kw):
     defaults = dict(
         f=1, num_groups=4, window=16, slots_per_tick=2,
-        lat_min=1, lat_max=2, reads_per_tick=2, read_window=8,
+        lat_min=1, lat_max=2, read_rate=2, read_window=8,
         read_mode=mode,
     )
     defaults.update(kw)
@@ -51,8 +53,8 @@ def test_reads_complete_and_invariants_hold(mode):
 
 
 def test_linearizable_reads_slower_than_eventual():
-    """A linearizable read pays the MaxSlot quorum round-trip plus the
-    watermark wait; an eventual read pays one hop. The model must show
+    """A linearizable batch pays the MaxSlot wave round-trip plus the
+    watermark wait; an eventual batch pays one hop. The model must show
     the ordering the reference's consistency modes exist to trade."""
     lin = TpuSimTransport(make("linearizable"), seed=1)
     ev = TpuSimTransport(make("eventual"), seed=1)
@@ -78,7 +80,7 @@ def test_reads_under_loss_and_failover():
 
 
 def test_linearizability_floor_is_enforced_by_construction():
-    """Every bound read's target must be >= the max globally chosen slot
+    """Every bound batch's target must be >= the max globally chosen slot
     at its issue tick (read/write quorum intersection). The invariant
     counter must stay zero over a long, lossy, failover-heavy run."""
     cfg = make("linearizable", drop_rate=0.1, retry_timeout=6, f=2)
@@ -93,10 +95,9 @@ def test_linearizability_floor_is_enforced_by_construction():
 
 
 def test_lin_violation_detector_has_teeth():
-    """Corrupt a bound read's target below its floor and run a tick: the
-    device-side check must already have counted honest binds, so instead
-    verify the counter wiring by forcing a bind with a floor above any
-    possible target."""
+    """Force an impossible floor under every outstanding batch: any later
+    bind must then increment the violation counter (weighted by the
+    batch's read count), and read_lin_ok must trip."""
     cfg = make("linearizable")
     key = jax.random.PRNGKey(4)
     state = init_state(cfg)
@@ -104,16 +105,10 @@ def test_lin_violation_detector_has_teeth():
     for _ in range(12):
         state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
         t += 1
-    # Find a waiting read and fake an impossible floor: any later bind
-    # must then increment the violation counter.
-    status = np.asarray(state.read_status)
-    assert (status == R_WAIT).any() or (status == R_SENT).any()
-    floor = np.asarray(state.read_floor).copy()
-    floor[:] = 10**9
+    status = np.asarray(state.rb_status)
+    assert (status == R_WAIT).any()  # waves keep batches in flight
     state = dataclasses.replace(
-        state,
-        read_floor=jnp.asarray(floor),
-        read_status=jnp.where(state.read_status == R_WAIT, R_WAIT, R_EMPTY),
+        state, rb_floor=jnp.full_like(state.rb_floor, 10**9)
     )
     for _ in range(12):
         state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
@@ -124,9 +119,9 @@ def test_lin_violation_detector_has_teeth():
 
 
 def test_read_target_tracks_committed_writes():
-    """After the cluster commits for a while, linearizable reads bind to
-    recent targets (close to the global watermark), and completed reads
-    advance the client watermark monotonically."""
+    """After the cluster commits for a while, linearizable batches bind
+    to recent targets (close to the global watermark), and completed
+    reads advance the client watermark monotonically."""
     sim = TpuSimTransport(make("linearizable"), seed=5)
     prev_wm = -1
     for _ in range(6):
@@ -143,15 +138,46 @@ def test_sequential_reads_bound_by_own_history():
     stats = sim.stats()
     assert stats["reads_done"] > 0
     # Sequential targets come from the client's own watermark, which only
-    # moves forward; the ring must fully recycle (no stuck reads).
-    status = np.asarray(sim.state.read_status)
+    # moves forward; batches never wait on a wave (no R_WAIT).
+    status = np.asarray(sim.state.rb_status)
     assert ((status == R_EMPTY) | (status == R_BOUND) | (status == R_SENT)).all()
     assert all(sim.check_invariants().values())
 
 
+def test_read_conservation():
+    """Every read the workload offers is accounted for exactly once:
+    done + shed + still-in-flight == G * read_rate * ticks."""
+    cfg = make("linearizable")
+    sim = TpuSimTransport(cfg, seed=7)
+    sim.run(150)
+    offered = cfg.num_groups * cfg.read_rate * 150
+    done = int(sim.state.reads_done)
+    shed = int(sim.state.reads_shed)
+    in_flight = int(jax.device_get(sim.state.rb_count).sum())
+    assert done + shed + in_flight == offered
+    assert done > 0
+
+
+def test_read_throughput_scales_with_groups():
+    """The whole point of the batcher redesign: read throughput is
+    proportional to the cluster size (each group's batcher carries
+    read_rate reads per tick), not a fixed global trickle."""
+    small = TpuSimTransport(make("linearizable", num_groups=4), seed=8)
+    big = TpuSimTransport(make("linearizable", num_groups=16), seed=8)
+    small.run(200)
+    big.run(200)
+    r_small = small.stats()["reads_done"]
+    r_big = big.stats()["reads_done"]
+    assert r_small > 0
+    # 4x the groups must give ~4x the reads (allow slack for shedding).
+    assert r_big > 3 * r_small
+    assert all(big.check_invariants().values())
+
+
 def test_reads_sharded_matches_unsharded():
-    """Reads fan out to every group (the one cross-device pattern); the
-    sharded run must still be bit-identical to the unsharded one."""
+    """Read batches ride a wave that fans out to every group (the one
+    cross-device pattern); the sharded run must still be bit-identical
+    to the unsharded one."""
     cfg = make("linearizable", num_groups=8, drop_rate=0.1, retry_timeout=6)
     key = jax.random.PRNGKey(7)
     t0 = jnp.zeros((), jnp.int32)
@@ -160,8 +186,8 @@ def test_reads_sharded_matches_unsharded():
     sharded0 = shard_state(init_state(cfg), mesh)
     sharded, sharded_t = run_ticks_sharded(cfg, mesh, sharded0, t0, 120, key)
     for field in (
-        "reads_done", "read_lat_sum", "read_lin_violations", "committed",
-        "retired", "client_watermark", "max_chosen_global",
+        "reads_done", "reads_shed", "read_lat_sum", "read_lin_violations",
+        "committed", "retired", "client_watermark", "max_chosen_global",
     ):
         a = jax.device_get(getattr(plain, field))
         b = jax.device_get(getattr(sharded, field))
@@ -170,12 +196,12 @@ def test_reads_sharded_matches_unsharded():
 
 
 def test_reads_off_state_is_empty_and_cheap():
-    """reads_per_tick=0 keeps every read array zero-sized — the write-only
+    """read_rate=0 keeps every read array zero-sized — the write-only
     model's compiled program carries no read traffic."""
-    cfg = make(reads_per_tick=0, read_window=0)
+    cfg = make(read_rate=0, read_window=0)
     state = init_state(cfg)
     assert state.req_arrival.size == 0
-    assert state.read_status.size == 0
+    assert state.rb_status.size == 0
     sim = TpuSimTransport(cfg, seed=8)
     sim.run(30)
     assert "reads_done" not in sim.stats()
