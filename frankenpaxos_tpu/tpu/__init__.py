@@ -13,6 +13,7 @@ from frankenpaxos_tpu.tpu import (
     craq_batched,
     epaxos_batched,
     fastpaxos_batched,
+    horizontal_batched,
     mencius_batched,
     scalog_batched,
     unreplicated_batched,
@@ -70,6 +71,7 @@ __all__ = [
     "epaxos_batched",
     "init_state",
     "leader_change",
+    "horizontal_batched",
     "mencius_batched",
     "reconfigure",
     "scalog_batched",

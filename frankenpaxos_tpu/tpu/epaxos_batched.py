@@ -11,20 +11,30 @@ Re-designed TPU-first:
     instance space of ``epaxos/Replica.scala``), each owning a ring of
     ``W`` in-flight instances — struct-of-arrays state, shardable over a
     device mesh along ``C``.
-  * Dependency sets are PREFIX-SHAPED per column — exactly the
+  * Dependency sets are PREFIX-SHAPED per column — the
     ``InstancePrefixSet`` / top-k compression of the reference
-    (``epaxos/InstancePrefixSet.scala``) — so an instance's deps are a
-    single watermark vector ``dep[v] in Z^C``: v depends on every
-    ``(d, j)`` with ``j < dep[v][d]``. Dependency checks become prefix-sum
-    lookups instead of set operations.
-  * The dependency-graph execute pass is an ELIGIBILITY CLOSURE computed
-    with array ops: start from all committed-unexecuted instances and
-    iteratively remove any whose dep watermark is not fully covered by
-    (executed | candidate) — a per-column cumulative-sum plus gather,
-    iterated under ``lax.while_loop`` to the greatest fixpoint. The fixed
-    point IS the set of eligible vertices (all transitive deps committed),
-    cycles included, so one pass executes exactly what
-    ``TarjanDependencyGraph.execute()`` would (see
+    (``epaxos/InstancePrefixSet.scala``). Rather than storing a [C, W, C]
+    watermark matrix (quadratic in C — the round-3 backend's scaling
+    blocker), an instance's dependency vector is FACTORED: it equals the
+    global proposal frontier at its propose tick (``fpre[t]``), bumped to
+    the post-tick frontier (``fpost[t]``) for the peer columns whose
+    same-tick proposals it saw. Per instance that leaves one tick index
+    and a C-bit visibility mask packed into ``ceil(C/32)`` uint32 words:
+    O(C*W*C/32) memory instead of O(C*W*C*4) bytes.
+  * Every instance depends on all its own-column predecessors (a replica
+    serializes its own instances), so execution within a column is in
+    order and the executed set is always a contiguous per-column prefix —
+    the ``executed`` bitmap of the round-3 backend is replaced by the
+    ``head`` watermark itself (slots retire the tick they execute).
+  * The dependency-graph execute pass is a GREATEST-FIXPOINT over the
+    per-column watermark vector ``m``: the largest ``m >= head`` such
+    that every instance below ``m`` is committed and its dependency
+    vector lies below ``m``. Because dependency vectors are factored
+    through the frontier history, each fixpoint iteration costs
+    O(H*C) to score the ticks plus O(C*W*C/32) of bitmask ANDs —
+    no [C, W, C] gather. The fixpoint IS the set of eligible vertices
+    (all transitive deps committed), cycles included, so one pass
+    executes exactly what ``TarjanDependencyGraph.execute()`` would (see
     ``tests/test_tpu_epaxos.py`` for the per-tick set equivalence).
   * Commit latency models the protocol phases: PreAccept out + PreAcceptOk
     back (one RTT) on the fast path, + Accept/AcceptOk (second RTT) on the
@@ -34,8 +44,9 @@ Re-designed TPU-first:
     (``simplebpaxos/``), which costs one extra RTT before commit.
   * Cycles arise exactly as in the real protocol: two instances proposed
     concurrently in different columns can each include the other in their
-    dependency snapshot (Bernoulli ``peer_visibility``), forming SCCs that
-    the closure executes together.
+    dependency snapshot (Bernoulli ``see_same_tick_rate``, quantized to
+    16ths by the bit-sliced sampler), forming SCCs that the closure
+    executes together.
 """
 
 from __future__ import annotations
@@ -50,9 +61,14 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.tpu.common import (
     INF,
     LAT_BINS,
-    ring_retire,
     sample_latency,
 )
+
+_LANES = 32  # columns per packed visibility word
+
+
+def _num_words(C: int) -> int:
+    return -(-C // _LANES)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,12 +82,18 @@ class BatchedEPaxosConfig:
     lat_max: int = 3
     slow_path_rate: float = 0.2  # P(instance takes the Accept round trip)
     # P(a same-tick proposal in another column lands in the dependency
-    # snapshot) — mutual visibility is what creates SCCs.
+    # snapshot) — mutual visibility is what creates SCCs. Quantized to
+    # multiples of 1/16 by the bit-sliced Bernoulli sampler.
     see_same_tick_rate: float = 0.5
     simplebpaxos: bool = False  # +1 RTT: proposer -> depservice -> acceptors
     # Closed workload: stop proposing once each column has allocated this
     # many instances (None = open workload).
     max_instances_per_column: Optional[int] = None
+    # Frontier-history ring length H: an in-flight instance must execute
+    # within H ticks of its proposal or the age_ok invariant trips (its
+    # factored dependency row would be overwritten). Lifetimes are
+    # commit latency + chain depth (tens of ticks); 256 is a wide margin.
+    frontier_history: int = 256
 
     @property
     def num_replicas(self) -> int:
@@ -83,23 +105,41 @@ class BatchedEPaxosConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.slow_path_rate <= 1.0
         assert 0.0 <= self.see_same_tick_rate <= 1.0
+        # The bit-sliced sampler quantizes to 16ths; a rate that silently
+        # degrades to 0 or 1 would simulate a different protocol regime.
+        k16 = round(self.see_same_tick_rate * 16)
+        assert (k16 == 0) == (self.see_same_tick_rate == 0.0) and (
+            k16 == 16
+        ) == (self.see_same_tick_rate == 1.0), (
+            f"see_same_tick_rate={self.see_same_tick_rate} quantizes to "
+            f"{k16}/16; pick a multiple of 1/16 (or >= 1/32) instead"
+        )
+        assert self.frontier_history >= 8 * self.lat_max, (
+            "frontier_history must comfortably exceed instance lifetimes"
+        )
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class BatchedEPaxosState:
     """Struct-of-arrays instance state. Shapes: [C] columns, [C, W] ring
-    instances, [C, W, C] per-instance dependency watermarks."""
+    instances, [C, W, CW] packed visibility bitmasks (CW = ceil(C/32)),
+    [H, C] frontier history."""
 
     next_instance: jnp.ndarray  # [C] next per-column instance number
-    head: jnp.ndarray  # [C] lowest non-retired per-column instance number
+    head: jnp.ndarray  # [C] lowest non-executed per-column instance number
+    # (execution is in column order, so head IS the executed watermark)
 
     proposed: jnp.ndarray  # [C, W] ring slot holds a live instance
     propose_tick: jnp.ndarray  # [C, W] proposal tick (INF = empty)
     commit_tick: jnp.ndarray  # [C, W] tick the commit lands (INF = empty)
     committed: jnp.ndarray  # [C, W] bool: commit has landed
-    executed: jnp.ndarray  # [C, W] bool: executed by the dep-graph pass
-    dep: jnp.ndarray  # [C, W, C] dependency watermarks (absolute indices)
+    # Factored dependency snapshot: instance (c, i) at slot w depends on
+    # fpre[propose_tick % H][e] of every column e, bumped to fpost[...][e]
+    # where bit e of vis_bits[c, w] is set, and on all own predecessors.
+    vis_bits: jnp.ndarray  # [C, W, CW] uint32 same-tick visibility mask
+    fpre: jnp.ndarray  # [H, C] frontier BEFORE tick h's proposals
+    fpost: jnp.ndarray  # [H, C] frontier AFTER tick h's proposals
 
     # Stats.
     committed_total: jnp.ndarray  # [] cumulative commits
@@ -114,7 +154,8 @@ class BatchedEPaxosState:
 
 
 def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
-    C, W = cfg.num_columns, cfg.window
+    C, W, H = cfg.num_columns, cfg.window, cfg.frontier_history
+    CW = _num_words(C)
     return BatchedEPaxosState(
         next_instance=jnp.zeros((C,), jnp.int32),
         head=jnp.zeros((C,), jnp.int32),
@@ -122,8 +163,9 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         propose_tick=jnp.full((C, W), INF, jnp.int32),
         commit_tick=jnp.full((C, W), INF, jnp.int32),
         committed=jnp.zeros((C, W), bool),
-        executed=jnp.zeros((C, W), bool),
-        dep=jnp.zeros((C, W, C), jnp.int32),
+        vis_bits=jnp.zeros((C, W, CW), jnp.uint32),
+        fpre=jnp.zeros((H, C), jnp.int32),
+        fpost=jnp.zeros((H, C), jnp.int32),
         committed_total=jnp.zeros((), jnp.int32),
         executed_total=jnp.zeros((), jnp.int32),
         retired_total=jnp.zeros((), jnp.int32),
@@ -133,56 +175,139 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
     )
 
 
-def _prefix_counts(bm: jnp.ndarray, head: jnp.ndarray) -> jnp.ndarray:
-    """P[c, r] = how many of column c's first r in-ring instances (in
-    absolute order from head) are set in ``bm``. Shape [C, W+1]."""
-    C, W = bm.shape
-    w_iota = jnp.arange(W, dtype=jnp.int32)
-    pos_of_ord = (head[:, None] + w_iota[None, :]) % W
-    bm_ord = jnp.take_along_axis(bm, pos_of_ord, axis=1).astype(jnp.int32)
-    cum = jnp.cumsum(bm_ord, axis=1)
-    return jnp.concatenate([jnp.zeros((C, 1), jnp.int32), cum], axis=1)
+def _pack_bool(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., C] bool -> [..., CW] uint32 (column e -> word e//32, lane
+    e%32). The shared packing convention of vis_bits and the closure's
+    bad-column masks."""
+    C = b.shape[-1]
+    CW = _num_words(C)
+    pad = CW * _LANES - C
+    if pad:
+        b = jnp.concatenate(
+            [b, jnp.zeros(b.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    lanes = (
+        jnp.uint32(1) << jnp.arange(_LANES, dtype=jnp.uint32)
+    )
+    words = b.reshape(b.shape[:-1] + (CW, _LANES))
+    return jnp.sum(words.astype(jnp.uint32) * lanes, axis=-1)
 
 
-def _deps_satisfied_by(
-    dep: jnp.ndarray,  # [C, W, C] absolute watermarks
-    base: jnp.ndarray,  # [C, W] bool: instances counted as executed
-    head: jnp.ndarray,  # [C]
+def _bernoulli_words(
+    key: jnp.ndarray, p: float, shape: Tuple[int, ...]
 ) -> jnp.ndarray:
-    """[C, W] bool: every dependency of the slot's instance is in ``base``
-    (instances below head count as executed — they retired)."""
-    C, W = base.shape
-    P = _prefix_counts(base, head)  # [C, W+1]
-    r = jnp.clip(dep - head[None, None, :], 0, W)  # [C, W, C] relative
-    gathered = P[jnp.arange(C)[None, None, :], r]  # [C, W, C]
-    return jnp.all((r <= 0) | (gathered == r), axis=2)
+    """Per-BIT Bernoulli(p) over packed uint32 words of the given shape,
+    p quantized to k/16, via a bit-sliced 4-bit comparator (each of the 4
+    random planes is one bit of a per-lane 4-bit value; lane set iff
+    value < k). One random sweep of 4 words replaces 32 uniform draws."""
+    k = int(round(p * 16))
+    if k <= 0:
+        return jnp.zeros(shape, jnp.uint32)
+    if k >= 16:
+        return jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+    planes = jax.random.bits(key, (4,) + shape)  # uint32
+    lt = jnp.zeros(shape, jnp.uint32)
+    eq = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+    for i in (3, 2, 1, 0):  # MSB -> LSB of the 4-bit value
+        b = planes[i]
+        if (k >> i) & 1:
+            lt = lt | (eq & ~b)
+            eq = eq & b
+        else:
+            eq = eq & ~b
+    return lt
+
+
+def _tick_scores(
+    m: jnp.ndarray, fpre: jnp.ndarray, fpost: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Score every history tick against the watermark vector ``m``:
+    (ok_pre [H] — the tick's pre-frontier lies fully below m;
+    bad_post [H, CW] — packed mask of columns whose post-frontier
+    exceeds m). O(H*C)."""
+    ok_pre = jnp.all(fpre <= m[None, :], axis=1)  # [H]
+    bad_post = _pack_bool(fpost > m[None, :])  # [H, CW]
+    return ok_pre, bad_post
+
+
+def _instance_ok(
+    ok_pre: jnp.ndarray,  # [H]
+    bad_post: jnp.ndarray,  # [H, CW] — MUST be materialized (see note)
+    h_idx: jnp.ndarray,  # [C, W] propose tick mod H (0 where empty)
+    vis_bits: jnp.ndarray,  # [C, W, CW]
+) -> jnp.ndarray:
+    """[C, W] bool: the slot's dependency vector lies at or below the
+    watermark the scores were computed for, for every PEER column
+    (own-column order is enforced structurally by the contiguous-run
+    scan). NOTE: callers must pass ``bad_post`` through a materialization
+    point (a loop carry here) — XLA CPU otherwise fuses the packing
+    reduction INTO the row gather and recomputes the 32-lane pack for
+    every gathered element, a ~40x slowdown at C=1024."""
+    okp = jnp.take(ok_pre, h_idx)  # [C, W]
+    conflict = jnp.any(
+        (vis_bits & jnp.take(bad_post, h_idx, axis=0)) != jnp.uint32(0),
+        axis=2,
+    )
+    return okp & ~conflict
 
 
 def eligible_closure(
     committed: jnp.ndarray,  # [C, W]
-    executed: jnp.ndarray,  # [C, W]
-    dep: jnp.ndarray,  # [C, W, C]
+    proposed: jnp.ndarray,  # [C, W]
+    propose_tick: jnp.ndarray,  # [C, W]
+    vis_bits: jnp.ndarray,  # [C, W, CW]
+    fpre: jnp.ndarray,  # [H, C]
+    fpost: jnp.ndarray,  # [H, C]
     head: jnp.ndarray,  # [C]
-) -> jnp.ndarray:
-    """The dependency-graph execute pass as a greatest fixpoint: the
-    largest set E of committed-unexecuted instances whose dependencies all
-    lie in (executed | E). This is exactly the set of ELIGIBLE vertices of
-    ``DependencyGraph.scala:8-125`` — vertices all of whose transitive
-    dependencies are committed — including whole SCCs, which the reference
-    executes together in one component."""
+    next_instance: jnp.ndarray,  # [C]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The dependency-graph execute pass as a greatest fixpoint over
+    per-column watermarks: the largest ``m`` (head <= m <= next_instance)
+    such that every instance below ``m`` is committed and its dependency
+    vector lies below ``m``. This is exactly the set of ELIGIBLE vertices
+    of ``DependencyGraph.scala:8-125`` — vertices all of whose transitive
+    dependencies are committed — including whole SCCs, which the
+    reference executes together in one component.
 
+    Returns (newly [C, W] bool — slots to execute, run [C] — per-column
+    executed count; head + run is the fixpoint watermark)."""
+    C, W = committed.shape
+    H = fpre.shape[0]
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    h_idx = jnp.where(proposed, jnp.mod(propose_tick, H), 0)
+    ordinal = jnp.mod(w_iota[None, :] - head[:, None], W)  # [C, W]
+    in_ring = ordinal < (next_instance - head)[:, None]
+    cand = committed & proposed & in_ring
+    pos_of_ord = jnp.mod(head[:, None] + w_iota[None, :], W)
+
+    def run_of(ok_pre, bad_post):
+        ok = _instance_ok(ok_pre, bad_post, h_idx, vis_bits) & cand
+        ok_ord = jnp.take_along_axis(ok, pos_of_ord, axis=1)
+        return jnp.sum(
+            jnp.cumprod(ok_ord.astype(jnp.int32), axis=1), axis=1
+        )
+
+    # The tick scores ride the while-loop CARRY so the packed bad_post
+    # mask is materialized at the loop boundary (see _instance_ok note).
     def body(carry):
-        E, _ = carry
-        ok = _deps_satisfied_by(dep, executed | E, head)
-        newE = E & ok
-        return newE, jnp.any(newE != E)
+        m, ok_pre, bad_post, _ = carry
+        m_new = head + run_of(ok_pre, bad_post)
+        ok_pre2, bad_post2 = _tick_scores(m_new, fpre, fpost)
+        return m_new, ok_pre2, bad_post2, jnp.any(m_new != m)
 
     def cond(carry):
-        return carry[1]
+        return carry[3]
 
-    E0 = committed & ~executed
-    E, _ = jax.lax.while_loop(cond, body, (E0, jnp.bool_(True)))
-    return E
+    # Start from the most permissive watermark; the update is monotone in
+    # m, so iterating downward converges to the GREATEST fixpoint
+    # (Tarski).
+    ok_pre0, bad_post0 = _tick_scores(next_instance, fpre, fpost)
+    m, _, _, _ = jax.lax.while_loop(
+        cond, body, (next_instance, ok_pre0, bad_post0, jnp.bool_(True))
+    )
+    run = m - head
+    newly = in_ring & (ordinal < run[:, None])
+    return newly, run
 
 
 def tick(
@@ -192,10 +317,11 @@ def tick(
     key: jnp.ndarray,
 ) -> BatchedEPaxosState:
     """One simulation tick: commits land, the dependency-graph pass
-    executes every eligible instance (SCCs included), fully-executed
-    column prefixes retire, and columns propose new instances with
-    PRNG-sampled dependency snapshots and commit latencies."""
-    C, W = cfg.num_columns, cfg.window
+    executes every eligible instance (SCCs included) and retires it, and
+    columns propose new instances with PRNG-sampled factored dependency
+    snapshots and commit latencies."""
+    C, W, H = cfg.num_columns, cfg.window, cfg.frontier_history
+    CW = _num_words(C)
     k_vis, k_slow, k_lat = jax.random.split(key, 3)
     w_iota = jnp.arange(W, dtype=jnp.int32)
 
@@ -205,13 +331,36 @@ def tick(
     n_new_commits = jnp.sum(committed & ~state.committed)
 
     # ---- 2. Dependency-graph execute pass (TarjanDependencyGraph
-    # execute: all eligible vertices, SCCs together).
-    newly = eligible_closure(committed, state.executed, state.dep, state.head)
-    executed = state.executed | newly
+    # execute: all eligible vertices, SCCs together), then retire —
+    # execution is in column order, so the executed set is exactly the
+    # advance of the head watermark.
+    newly, run = eligible_closure(
+        committed, state.proposed, state.propose_tick, state.vis_bits,
+        state.fpre, state.fpost, state.head, state.next_instance,
+    )
+    n_exec = jnp.sum(run)
     # Co-execution accounting: a newly executed instance whose deps were
-    # not all executed BEFORE this pass executed together with at least
-    # one dependency (a same-pass chain or an SCC).
-    dep_pre_ok = _deps_satisfied_by(state.dep, state.executed, state.head)
+    # not all executed BEFORE this pass (i.e. not a head instance with
+    # its whole dependency vector already below the old heads) executed
+    # together with at least one dependency — a same-pass chain or SCC.
+    ordinal = jnp.mod(w_iota[None, :] - state.head[:, None], W)
+    ok_pre_h, bad_post_h = _tick_scores(state.head, state.fpre, state.fpost)
+    # Only the head instance of a column can have had its whole
+    # dependency vector below the old heads, so evaluate just that one
+    # slot per column ([C, CW] work — no ring-wide gather).
+    head_pos = jnp.mod(state.head, W)  # [C]
+    c_iota = jnp.arange(C, dtype=jnp.int32)
+    h0 = jnp.where(
+        state.proposed[c_iota, head_pos],
+        jnp.mod(state.propose_tick[c_iota, head_pos], H),
+        0,
+    )  # [C]
+    vis0 = state.vis_bits[c_iota, head_pos]  # [C, CW]
+    conflict0 = jnp.any(
+        (vis0 & jnp.take(bad_post_h, h0, axis=0)) != jnp.uint32(0), axis=1
+    )
+    ok0 = jnp.take(ok_pre_h, h0) & ~conflict0  # [C]
+    dep_pre_ok = (ordinal == 0) & ok0[:, None]
     coexecuted = state.coexecuted + jnp.sum(newly & ~dep_pre_ok)
     lat = jnp.where(newly, t - state.propose_tick, 0)
     lat_sum = state.lat_sum + jnp.sum(lat)
@@ -219,72 +368,73 @@ def tick(
     lat_hist = state.lat_hist + jax.ops.segment_sum(
         newly.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
     )
-    executed_total = state.executed_total + jnp.sum(newly)
+    executed_total = state.executed_total + n_exec
+    retired_total = state.retired_total + n_exec
+    head = state.head + run
 
-    # ---- 3. Retire the contiguous executed prefix of each column (the
-    # ring GC; executed-out-of-order instances wait for their column hole).
-    pos_of_ord = (state.head[:, None] + w_iota[None, :]) % W
-    exec_ord = jnp.take_along_axis(executed, pos_of_ord, axis=1)
-    in_ring = w_iota[None, :] < (state.next_instance - state.head)[:, None]
-    retire_ord = exec_ord & in_ring
-    n_retire, retire_mask = ring_retire(retire_ord, state.head)
-    head = state.head + n_retire
-    retired_total = state.retired_total + jnp.sum(n_retire)
+    proposed = state.proposed & ~newly
+    committed = committed & ~newly
+    propose_tick = jnp.where(newly, INF, state.propose_tick)
+    commit_tick = jnp.where(newly, INF, state.commit_tick)
+    vis_bits = jnp.where(newly[:, :, None], jnp.uint32(0), state.vis_bits)
 
-    proposed = state.proposed & ~retire_mask
-    committed = committed & ~retire_mask
-    executed = executed & ~retire_mask
-    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
-    commit_tick = jnp.where(retire_mask, INF, state.commit_tick)
-
-    # ---- 4. Propose new instances (EpReplica handleClientRequest): up to
-    # K per column if the window has room. The dependency snapshot is the
-    # per-column proposal frontier; a Bernoulli per (instance, column)
-    # decides whether SAME-TICK proposals of other columns are visible —
-    # mutual visibility creates cycles, exactly like concurrent
-    # conflicting PreAccepts in the real protocol.
+    # ---- 3. Propose new instances (EpReplica handleClientRequest): up
+    # to K per column if the window has room. The dependency snapshot is
+    # factored: this tick's pre/post frontiers land in the history ring
+    # at row t % H, and a bit-sliced Bernoulli decides which SAME-TICK
+    # peer proposals are visible — mutual visibility creates cycles,
+    # exactly like concurrent conflicting PreAccepts in the real
+    # protocol. Own-column bits are masked off (own-column order is the
+    # ring structure itself).
     space = W - (state.next_instance - head)
     count = jnp.minimum(cfg.instances_per_tick, space)
     if cfg.max_instances_per_column is not None:
         count = jnp.minimum(
-            count, jnp.maximum(cfg.max_instances_per_column - state.next_instance, 0)
+            count,
+            jnp.maximum(cfg.max_instances_per_column - state.next_instance, 0),
         )
-    delta = (w_iota[None, :] - state.next_instance[:, None]) % W
+    delta = jnp.mod(w_iota[None, :] - state.next_instance[:, None], W)
     is_new = delta < count[:, None]
     next_instance = state.next_instance + count
 
-    # Dependency watermarks: before-this-tick frontier of every column,
-    # optionally extended to the after-this-tick frontier of OTHER columns
-    # (same-tick visibility); own column = own index (a replica serializes
-    # its own instances, InstanceHelpers/own-column conflicts).
-    own_index = state.next_instance[:, None] + delta  # [C, W] absolute
-    base_frontier = state.next_instance[None, None, :]  # [1, 1, C] pre-tick
-    after_frontier = next_instance[None, None, :]  # [1, 1, C] post-tick
-    sees = (
-        jax.random.uniform(k_vis, (C, W, C)) < cfg.see_same_tick_rate
-        if cfg.see_same_tick_rate > 0.0
-        else jnp.zeros((C, W, C), bool)
-    )
-    dep_new = jnp.where(sees, after_frontier, base_frontier)
-    dep_new = jnp.broadcast_to(dep_new, (C, W, C))
-    own_col = jnp.arange(C)[:, None, None] == jnp.arange(C)[None, None, :]
-    dep_new = jnp.where(own_col, own_index[:, :, None], dep_new)
-    dep = jnp.where(is_new[:, :, None], dep_new, state.dep)
+    h_row = jnp.mod(t, H)
+    fpre = state.fpre.at[h_row].set(state.next_instance)
+    fpost = state.fpost.at[h_row].set(next_instance)
+
+    # Fresh visibility bits only for the K new slots per column (the
+    # full-ring draw would make threefry generation the dominant tick
+    # cost at wide C), gathered back onto ring positions via delta.
+    K = cfg.instances_per_tick
+    sees_k = _bernoulli_words(k_vis, cfg.see_same_tick_rate, (C, K, CW))
+    col = jnp.arange(C, dtype=jnp.int32)
+    own_mask = _pack_bool(col[:, None] == col[None, :])  # [C, CW]
+    valid_mask = _pack_bool(jnp.ones((C,), bool))  # [CW] lanes < C
+    sees_k = sees_k & ~own_mask[:, None, :] & valid_mask[None, None, :]
+    sees = jnp.take_along_axis(
+        sees_k, jnp.clip(delta, 0, K - 1)[:, :, None], axis=1
+    )  # [C, W, CW]
+    vis_bits = jnp.where(is_new[:, :, None], sees, vis_bits)
 
     # Commit latency: PreAccept RTT (2 one-way hops), + Accept RTT on the
     # slow path, + the proposer->depservice hop pair for Simple BPaxos.
     hops = 2 + (2 if cfg.simplebpaxos else 0)
     rtt = jnp.sum(
-        sample_latency(cfg.lat_min, cfg.lat_max, k_lat, (hops + 2, C, W)), axis=0
+        sample_latency(cfg.lat_min, cfg.lat_max, k_lat, (hops + 2, C, W)),
+        axis=0,
     )  # [C, W]: hops+2 one-way samples; the last 2 are the slow path
     fast = jnp.sum(
-        sample_latency(cfg.lat_min, cfg.lat_max, jax.random.fold_in(k_lat, 1), (hops, C, W)), axis=0
+        sample_latency(
+            cfg.lat_min, cfg.lat_max, jax.random.fold_in(k_lat, 1),
+            (hops, C, W),
+        ),
+        axis=0,
     )
     slow = jax.random.uniform(k_slow, (C, W)) < cfg.slow_path_rate
     commit_lat = jnp.where(slow, rtt, fast)
     proposed = proposed | is_new
     propose_tick = jnp.where(is_new, t, propose_tick)
     commit_tick = jnp.where(is_new, t + commit_lat, commit_tick)
+    committed = committed & ~is_new
 
     return BatchedEPaxosState(
         next_instance=next_instance,
@@ -293,8 +443,9 @@ def tick(
         propose_tick=propose_tick,
         commit_tick=commit_tick,
         committed=committed,
-        executed=executed,
-        dep=dep,
+        vis_bits=vis_bits,
+        fpre=fpre,
+        fpost=fpost,
         committed_total=state.committed_total + n_new_commits,
         executed_total=executed_total,
         retired_total=retired_total,
@@ -327,25 +478,28 @@ def check_invariants(
     cfg: BatchedEPaxosConfig, state: BatchedEPaxosState, t
 ) -> dict:
     """Device-side safety checks; all returned booleans must be True."""
-    # Executed implies committed (only committed vertices are eligible,
-    # DependencyGraph.scala:8-125).
-    exec_committed = jnp.all(~state.executed | state.committed)
-    # Every executed instance's dependencies are executed or retired (the
-    # closure never executes a vertex whose deps aren't in the closure).
-    deps_ok = jnp.all(
-        ~state.executed
-        | _deps_satisfied_by(state.dep, state.executed, state.head)
-    )
+    # The execution counter is exactly the total head advance (execution
+    # is in column order and retires the same tick) — ties the cumulative
+    # stat to live state, so a miscounted closure pass fails here.
+    conserved = state.executed_total == jnp.sum(state.head)
+    books_ok = state.executed_total <= state.committed_total
     # Window bookkeeping.
     window_ok = jnp.all(
         (state.head <= state.next_instance)
         & (state.next_instance - state.head <= cfg.window)
     )
-    # Conservation: everything retired was executed first.
-    conserved = state.retired_total <= state.executed_total
+    # Committed implies proposed (a commit can only land on a live slot).
+    ring_ok = jnp.all(~state.committed | state.proposed)
+    # Frontier-history residency: every live instance's factored
+    # dependency row is still in the ring (age < H). A violation means
+    # frontier_history is too small for this workload — fail LOUDLY.
+    age_ok = jnp.all(
+        ~state.proposed | (t - state.propose_tick < cfg.frontier_history)
+    )
     return {
-        "exec_committed": exec_committed,
-        "deps_ok": deps_ok,
-        "window_ok": window_ok,
         "conserved": conserved,
+        "books_ok": books_ok,
+        "window_ok": window_ok,
+        "ring_ok": ring_ok,
+        "age_ok": age_ok,
     }

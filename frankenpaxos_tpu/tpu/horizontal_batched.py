@@ -1,0 +1,498 @@
+"""Batched Horizontal MultiPaxos as a single XLA program: configuration
+reconfiguration AS A LOG VALUE with the s+alpha chunk-activation pipeline
+(reference ``horizontal/Leader.scala:216-250`` Chunk, ``:459-498`` choose
+-> ``activeFirstSlots += slot + alpha``, ``:920-960`` chunk split;
+per-actor analog ``protocols/horizontal.py``).
+
+The defining mechanism of the horizontal family: the log is divided into
+CHUNKS, each owned by one acceptor configuration. To reconfigure, the
+leader proposes a ``Configuration`` value into the log like any command;
+when it is chosen at slot ``s`` and the chosen watermark executes past
+it, a new chunk activates at ``firstSlot = s + alpha`` (the old chunk's
+``lastSlot`` becomes ``s + alpha - 1``), and the new configuration runs
+phase 1 before its chunk may choose anything. The ``alpha`` pipeline
+bound (``Leader.scala:638-646``: never more than alpha slots past the
+watermark) is what makes ``s + alpha`` safe: no old-chunk proposal can
+exist at or beyond the new chunk's first slot.
+
+TPU-first layout: ``G`` independent horizontal logs (groups) advance in
+lockstep arrays. Each group owns an acceptor pool of ``2n`` rows
+(``n = 2f+1``) — two BANKS that alternate as the active configuration
+(epoch parity selects the bank), which models "reconfigure to a fresh
+set of acceptors" with static shapes. One reconfiguration may be in
+flight per group at a time (the reference supports a chunk list; the
+periodic driver here never needs more than two live chunks).
+
+Safety is checked device-side: every chosen slot holds an f+1 vote
+quorum INSIDE the bank its chunk stamped on it and ZERO votes in the
+other bank (bank isolation — the horizontal analog of "no value chosen
+by the wrong configuration"), the alpha bound never overflows, and
+chunk boundaries never interleave epochs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_latency,
+    ring_retire,
+)
+
+# Slot status.
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+
+NO_VALUE = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedHorizontalConfig:
+    """Static (compile-time) simulation parameters."""
+
+    f: int = 1
+    num_groups: int = 8  # G: independent horizontal logs
+    window: int = 32  # W: ring capacity (>= alpha)
+    slots_per_tick: int = 2  # K: new proposals per group per tick
+    alpha: int = 16  # pipeline bound: next_slot - watermark <= alpha
+    lat_min: int = 1
+    lat_max: int = 3
+    retry_timeout: int = 16  # re-send Phase2a to the full bank after this
+    # Propose a Configuration value into each group's log every this many
+    # ticks (0 = never reconfigure). Groups are staggered by index so the
+    # whole fleet doesn't reconfigure on the same tick.
+    reconfigure_every: int = 0
+    # Closed workload: stop proposing once each group allocated this many
+    # slots (None = open).
+    max_slots_per_group: Optional[int] = None
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def pool(self) -> int:
+        return 2 * self.n  # two banks
+
+    @property
+    def quorum(self) -> int:
+        return self.f + 1
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.window >= 2 * self.slots_per_tick
+        assert 2 <= self.alpha <= self.window, (
+            "the ring must hold the full alpha pipeline"
+        )
+        assert 1 <= self.lat_min <= self.lat_max
+        if self.reconfigure_every:
+            assert self.reconfigure_every >= 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedHorizontalState:
+    """Shapes: [G] groups, [G, W] ring slots, [P, G, W] per-acceptor
+    (P = 2n pool rows: bank 0 = rows [0, n), bank 1 = rows [n, 2n))."""
+
+    next_slot: jnp.ndarray  # [G] next slot to allocate
+    head: jnp.ndarray  # [G] chosen watermark (contiguous chosen prefix)
+
+    status: jnp.ndarray  # [G, W] EMPTY | PROPOSED | CHOSEN
+    is_config: jnp.ndarray  # [G, W] slot carries a Configuration value
+    slot_epoch: jnp.ndarray  # [G, W] chunk epoch stamped at proposal (-1)
+    propose_tick: jnp.ndarray  # [G, W] (INF = empty)
+    last_send: jnp.ndarray  # [G, W] last Phase2a send tick
+    p2a_arrival: jnp.ndarray  # [P, G, W] Phase2a arrival (INF)
+    p2b_arrival: jnp.ndarray  # [P, G, W] Phase2b arrival at leader (INF)
+    voted: jnp.ndarray  # [P, G, W] acceptor voted for the slot
+    vote_epoch: jnp.ndarray  # [P, G, W] epoch the vote was cast under (-1)
+
+    # Chunk machinery (one pending reconfiguration per group).
+    epoch: jnp.ndarray  # [G] epoch of the OLDEST live chunk
+    boundary: jnp.ndarray  # [G] firstSlot of the pending chunk (INF none)
+    p1_done: jnp.ndarray  # [G] new bank finished phase 1
+    p1a_arrival: jnp.ndarray  # [P, G] Phase1a arrival at new bank (INF)
+    p1b_arrival: jnp.ndarray  # [P, G] Phase1b arrival at leader (INF)
+
+    # Stats.
+    committed: jnp.ndarray  # [] slots chosen (cumulative)
+    executed: jnp.ndarray  # [] slots past the watermark (cumulative)
+    reconfigs_proposed: jnp.ndarray  # [] Configuration values proposed
+    reconfigs_done: jnp.ndarray  # [] chunks fully handed over
+    alpha_stalls: jnp.ndarray  # [] proposal slots dropped by the alpha gate
+    boundary_stalls: jnp.ndarray  # [] proposals stalled awaiting phase 1
+    bank_violations: jnp.ndarray  # [] votes observed in the WRONG bank
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
+    G, W, P = cfg.num_groups, cfg.window, cfg.pool
+    return BatchedHorizontalState(
+        next_slot=jnp.zeros((G,), jnp.int32),
+        head=jnp.zeros((G,), jnp.int32),
+        status=jnp.zeros((G, W), jnp.int32),
+        is_config=jnp.zeros((G, W), bool),
+        slot_epoch=jnp.full((G, W), -1, jnp.int32),
+        propose_tick=jnp.full((G, W), INF, jnp.int32),
+        last_send=jnp.full((G, W), INF, jnp.int32),
+        p2a_arrival=jnp.full((P, G, W), INF, jnp.int32),
+        p2b_arrival=jnp.full((P, G, W), INF, jnp.int32),
+        voted=jnp.zeros((P, G, W), bool),
+        vote_epoch=jnp.full((P, G, W), -1, jnp.int32),
+        epoch=jnp.zeros((G,), jnp.int32),
+        boundary=jnp.full((G,), INF, jnp.int32),
+        p1_done=jnp.zeros((G,), bool),
+        p1a_arrival=jnp.full((P, G), INF, jnp.int32),
+        p1b_arrival=jnp.full((P, G), INF, jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        executed=jnp.zeros((), jnp.int32),
+        reconfigs_proposed=jnp.zeros((), jnp.int32),
+        reconfigs_done=jnp.zeros((), jnp.int32),
+        alpha_stalls=jnp.zeros((), jnp.int32),
+        boundary_stalls=jnp.zeros((), jnp.int32),
+        bank_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _bank_rows(cfg: BatchedHorizontalConfig) -> jnp.ndarray:
+    """[P] bank index of each pool row (0 or 1)."""
+    return (jnp.arange(cfg.pool, dtype=jnp.int32) >= cfg.n).astype(
+        jnp.int32
+    )
+
+
+def tick(
+    cfg: BatchedHorizontalConfig,
+    state: BatchedHorizontalState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedHorizontalState:
+    G, W, P, n = cfg.num_groups, cfg.window, cfg.pool, cfg.n
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    g_iota = jnp.arange(G, dtype=jnp.int32)
+    bank_of_row = _bank_rows(cfg)  # [P]
+
+    k_slot, k_p1 = jax.random.split(key)
+    bits3 = jax.random.bits(k_slot, (P, G, W))  # [0:8) p2a lat,
+    #                            [8:16) p2b lat, [16:24) retry lat
+    bits1 = jax.random.bits(k_p1, (P, G))  # [0:8) p1a lat, [8:16) p1b lat
+    p2a_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p2b_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    retry_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    p1a_lat = bit_latency(bits1, 0, cfg.lat_min, cfg.lat_max)
+    p1b_lat = bit_latency(bits1, 8, cfg.lat_min, cfg.lat_max)
+
+    # ---- 1. Acceptors vote on arriving Phase2as — but ONLY rows in the
+    # bank the slot's chunk owns (Acceptor.scala votes only for chunks it
+    # belongs to; a Phase2a is only ever SENT to the right bank, so the
+    # mask is defense in depth feeding the bank_violations check).
+    slot_bank = jnp.mod(state.slot_epoch, 2)  # [G, W] (-1 stays -1)
+    row_matches = bank_of_row[:, None, None] == slot_bank[None, :, :]
+    p2a_now = state.p2a_arrival == t
+    may_vote = p2a_now & row_matches & (state.status == PROPOSED)[None, :, :]
+    voted = state.voted | may_vote
+    vote_epoch = jnp.where(
+        may_vote, state.slot_epoch[None, :, :], state.vote_epoch
+    )
+    p2b_arrival = jnp.where(may_vote, t + p2b_lat, state.p2b_arrival)
+    p2a_arrival = jnp.where(p2a_now, INF, state.p2a_arrival)
+
+    # ---- 2. Quorums form: f+1 arrived Phase2bs within the slot's bank.
+    arrived = (p2b_arrival <= t) & voted & row_matches
+    votes_in_bank = jnp.sum(arrived, axis=0)  # [G, W]
+    newly_chosen = (state.status == PROPOSED) & (
+        votes_in_bank >= cfg.quorum
+    )
+    status = jnp.where(newly_chosen, CHOSEN, state.status)
+    committed = state.committed + jnp.sum(newly_chosen)
+    lat = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+    # Bank isolation ledger: any vote not in the slot's bank is a safety
+    # violation (can only happen through a bug — the check has teeth via
+    # tests that forge votes).
+    bank_violations = state.bank_violations + jnp.sum(
+        voted & ~row_matches & (state.slot_epoch >= 0)[None, :, :]
+    )
+
+    # ---- 3. Watermark advance (choose(), Leader.scala:459-498): walk
+    # the contiguous CHOSEN prefix. A Configuration value crossing the
+    # watermark schedules the next chunk at slot + alpha and launches
+    # phase 1 against the new bank. One pending reconfiguration per
+    # group: the proposal driver (step 5) never issues a second while
+    # boundary is armed, so at most one config slot crosses per walk.
+    pos_of_ord = jnp.mod(state.head[:, None] + w_iota[None, :], W)
+    chosen_ord = jnp.take_along_axis(status == CHOSEN, pos_of_ord, axis=1)
+    size = state.next_slot - state.head  # [G]
+    in_ring_ord = w_iota[None, :] < size[:, None]  # ordinal-indexed
+    # run [G] = slots the watermark advances; crossing [G, W] = the
+    # position-indexed executed mask (shared ring-GC helper).
+    run, crossing = ring_retire(chosen_ord & in_ring_ord, state.head)
+    ordinal = jnp.mod(w_iota[None, :] - state.head[:, None], W)
+    executed = state.executed + jnp.sum(run)
+    # Config slot crossing: arm the boundary and start phase 1.
+    config_cross = crossing & state.is_config
+    cross_slot = jnp.max(
+        jnp.where(config_cross, state.head[:, None] + ordinal, -1), axis=1
+    )  # [G] (-1 = none; at most one by construction)
+    arm = cross_slot >= 0
+    boundary = jnp.where(arm, cross_slot + cfg.alpha, state.boundary)
+    # Phase 1 to the NEW bank (epoch+1's rows).
+    new_bank = jnp.mod(state.epoch + 1, 2)  # [G]
+    in_new_bank = bank_of_row[:, None] == new_bank[None, :]  # [P, G]
+    p1a_arrival = jnp.where(
+        arm[None, :] & in_new_bank, t + p1a_lat, state.p1a_arrival
+    )
+    p1_done = jnp.where(arm, False, state.p1_done)
+
+    head = state.head + run
+    # Retire executed slots (free ring capacity).
+    status = jnp.where(crossing, EMPTY, status)
+    is_config = jnp.where(crossing, False, state.is_config)
+    slot_epoch = jnp.where(crossing, -1, state.slot_epoch)
+    propose_tick = jnp.where(crossing, INF, state.propose_tick)
+    last_send = jnp.where(crossing, INF, state.last_send)
+    clear3 = crossing[None, :, :]
+    p2a_arrival = jnp.where(clear3, INF, p2a_arrival)
+    p2b_arrival = jnp.where(clear3, INF, p2b_arrival)
+    voted = jnp.where(clear3, False, voted)
+    vote_epoch = jnp.where(clear3, -1, vote_epoch)
+
+    # ---- 4. Phase 1 completes on f+1 Phase1bs from the new bank; the
+    # old chunk hands over once the watermark reaches the boundary.
+    p1a_now = state.p1a_arrival == t
+    p1b_arrival = jnp.where(p1a_now, t + p1b_lat, state.p1b_arrival)
+    p1a_arrival = jnp.where(p1a_now, INF, p1a_arrival)
+    p1b_in = jnp.sum(
+        (p1b_arrival <= t)
+        & (bank_of_row[:, None] == jnp.mod(state.epoch + 1, 2)[None, :]),
+        axis=0,
+    )
+    p1_done = p1_done | (
+        (state.boundary < INF) & (p1b_in >= cfg.quorum)
+    )
+    # Handover needs BOTH: the watermark consumed the old chunk AND the
+    # new bank finished phase 1 (the old chunk can drain fast when alpha
+    # is small — the new chunk still may not choose before its phase 1).
+    handover = (state.boundary < INF) & (head >= state.boundary) & p1_done
+    epoch = jnp.where(handover, state.epoch + 1, state.epoch)
+    boundary = jnp.where(handover, INF, boundary)
+    reconfigs_done = state.reconfigs_done + jnp.sum(handover)
+    p1b_arrival = jnp.where(handover[None, :], INF, p1b_arrival)
+
+    # ---- 5. Propose (propose(), Leader.scala:617-660). Candidate slots
+    # are the next K; each is gated by (a) the alpha pipeline bound, (b)
+    # chunk ownership: slots below the boundary belong to the current
+    # chunk (epoch), at/above it to the NEW chunk (epoch+1), which may
+    # only propose once phase 1 is done. Periodically one slot carries a
+    # Configuration value instead of a command (config-as-log-value).
+    # Candidate gating runs in DELTA space (candidate j = slot
+    # next_slot + j): proposals are contiguous in slot order, so a
+    # blocked candidate blocks everything after it — and the ring wraps,
+    # so a w-axis scan would visit candidates out of order.
+    K = cfg.slots_per_tick
+    k_iota = jnp.arange(K, dtype=jnp.int32)
+    abs_k = state.next_slot[:, None] + k_iota[None, :]  # [G, K]
+    want_k = jnp.ones((G, K), bool)
+    if cfg.max_slots_per_group is not None:
+        want_k = want_k & (abs_k < cfg.max_slots_per_group)
+    alpha_ok_k = abs_k < (head + cfg.alpha)[:, None]
+    past_boundary_k = abs_k >= boundary[:, None]
+    chunk_ok_k = jnp.where(past_boundary_k, p1_done[:, None], True)
+    ok_k = want_k & alpha_ok_k & chunk_ok_k
+    count = jnp.sum(
+        jnp.cumprod(ok_k.astype(jnp.int32), axis=1), axis=1
+    )  # [G] contiguous admitted prefix
+    alpha_stalls = state.alpha_stalls + jnp.sum(want_k & ~alpha_ok_k)
+    boundary_stalls = state.boundary_stalls + jnp.sum(
+        want_k & alpha_ok_k & ~chunk_ok_k
+    )
+    delta = jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
+    abs_slot = state.next_slot[:, None] + delta  # [G, W]
+    is_new = delta < count[:, None]
+    new_epoch = jnp.where(
+        abs_slot >= boundary[:, None], epoch[:, None] + 1, epoch[:, None]
+    )  # [G, W]
+    # Reconfiguration driver: group g proposes a Configuration value at
+    # tick t iff reconfigure_every divides t + g's stagger, no boundary
+    # is armed, no earlier Configuration is still in flight in the ring,
+    # and the slot is a fresh FIRST candidate (delta == 0).
+    if cfg.reconfigure_every:
+        fire = (
+            (jnp.mod(t + g_iota * 7, cfg.reconfigure_every) == 0)
+            & (boundary == INF)
+            & ~jnp.any(is_config, axis=1)
+        )
+        new_config = is_new & (delta == 0) & fire[:, None]
+        reconfigs_proposed = state.reconfigs_proposed + jnp.sum(
+            jnp.any(new_config, axis=1)
+        )
+    else:
+        new_config = jnp.zeros((G, W), bool)
+        reconfigs_proposed = state.reconfigs_proposed
+
+    status = jnp.where(is_new, PROPOSED, status)
+    is_config = jnp.where(is_new, new_config, is_config)
+    slot_epoch = jnp.where(is_new, new_epoch, slot_epoch)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    next_slot = state.next_slot + count
+    # Send Phase2as to the slot's bank (full bank; thriftiness is the
+    # flagship's dimension, not this family's).
+    send_bank = jnp.mod(new_epoch, 2)
+    send_rows = bank_of_row[:, None, None] == send_bank[None, :, :]
+    p2a_arrival = jnp.where(
+        is_new[None, :, :] & send_rows, t + p2a_lat, p2a_arrival
+    )
+
+    # ---- 6. Retries (resendPhase2as, Leader.scala:206-213).
+    timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
+    resend_rows = (
+        bank_of_row[:, None, None] == jnp.mod(slot_epoch, 2)[None, :, :]
+    )
+    p2a_arrival = jnp.where(
+        timed_out[None, :, :] & resend_rows, t + retry_lat, p2a_arrival
+    )
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return BatchedHorizontalState(
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        is_config=is_config,
+        slot_epoch=slot_epoch,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        voted=voted,
+        vote_epoch=vote_epoch,
+        epoch=epoch,
+        boundary=boundary,
+        p1_done=p1_done,
+        p1a_arrival=p1a_arrival,
+        p1b_arrival=p1b_arrival,
+        committed=committed,
+        executed=executed,
+        reconfigs_proposed=reconfigs_proposed,
+        reconfigs_done=reconfigs_done,
+        alpha_stalls=alpha_stalls,
+        boundary_stalls=boundary_stalls,
+        bank_violations=bank_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedHorizontalConfig,
+    state: BatchedHorizontalState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedHorizontalState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedHorizontalConfig, state: BatchedHorizontalState, t
+) -> dict:
+    W = cfg.window
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    bank_of_row = _bank_rows(cfg)
+    # THE horizontal safety property: every vote sits in the bank of the
+    # epoch stamped on its slot (no cross-configuration quorums), and the
+    # device-side ledger observed no violation.
+    slot_bank = jnp.mod(state.slot_epoch, 2)
+    row_matches = bank_of_row[:, None, None] == slot_bank[None, :, :]
+    votes_in_place = jnp.all(~state.voted | row_matches)
+    ledger_ok = state.bank_violations == 0
+    # Vote epochs match their slot's stamp (a vote never outlives the
+    # chunk that solicited it).
+    vote_epoch_ok = jnp.all(
+        ~state.voted | (state.vote_epoch == state.slot_epoch[None, :, :])
+    )
+    # Alpha pipeline bound (Leader.scala:638-646).
+    alpha_ok = jnp.all(state.next_slot - state.head <= cfg.alpha)
+    window_ok = jnp.all(
+        (state.head <= state.next_slot)
+        & (state.next_slot - state.head <= cfg.window)
+    )
+    # Chunk discipline: in-ring slots below an armed boundary carry the
+    # current epoch; slots at/past it carry epoch+1.
+    abs_slot = state.head[:, None] + jnp.mod(
+        w_iota[None, :] - state.head[:, None], W
+    )
+    in_ring = (
+        jnp.mod(w_iota[None, :] - state.head[:, None], W)
+        < (state.next_slot - state.head)[:, None]
+    )
+    live = in_ring & (state.status != EMPTY)
+    below = live & (abs_slot < state.boundary[:, None])
+    above = live & (abs_slot >= state.boundary[:, None])
+    chunk_ok = jnp.all(
+        jnp.where(below, state.slot_epoch == state.epoch[:, None], True)
+    ) & jnp.all(
+        jnp.where(above, state.slot_epoch == state.epoch[:, None] + 1, True)
+    )
+    # Books.
+    books_ok = (state.executed <= state.committed) & (
+        state.reconfigs_done <= state.reconfigs_proposed
+    )
+    return {
+        "votes_in_place": votes_in_place,
+        "ledger_ok": ledger_ok,
+        "vote_epoch_ok": vote_epoch_ok,
+        "alpha_ok": alpha_ok,
+        "window_ok": window_ok,
+        "chunk_ok": chunk_ok,
+        "books_ok": books_ok,
+    }
+
+
+def stats(
+    cfg: BatchedHorizontalConfig, state: BatchedHorizontalState, t
+) -> dict:
+    committed = int(state.committed)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (committed + 1) // 2)).argmax())
+        if committed
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "committed": committed,
+        "executed": int(state.executed),
+        "reconfigs_proposed": int(state.reconfigs_proposed),
+        "reconfigs_done": int(state.reconfigs_done),
+        "alpha_stalls": int(state.alpha_stalls),
+        "boundary_stalls": int(state.boundary_stalls),
+        "commit_latency_p50_ticks": p50,
+        "commit_latency_mean_ticks": (
+            float(state.lat_sum) / committed if committed else -1.0
+        ),
+        "bank_violations": int(state.bank_violations),
+    }

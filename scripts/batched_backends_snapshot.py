@@ -98,23 +98,30 @@ out["multipaxos_10k_acceptors_with_smr"] = {
     "dups_filtered": int(sm.state.dups_filtered),
 }
 
-# EPaxos @ 64 columns.
-ecfg = BatchedEPaxosConfig(num_columns=64)
-estate = epaxos_batched.init_state(ecfg)
-estate, _ = epaxos_batched.run_ticks(
-    ecfg, estate, jnp.int32(0), 200, jax.random.PRNGKey(0)
-)
-jax.block_until_ready(estate)
-e0 = int(estate.executed_total)
-t0 = time.perf_counter()
-estate, _ = epaxos_batched.run_ticks(
-    ecfg, estate, jnp.int32(200), 200, jax.random.PRNGKey(1)
-)
-jax.block_until_ready(estate)
-dt = time.perf_counter() - t0
-out["epaxos_64_columns"] = {
-    "executed_per_sec": int((int(estate.executed_total) - e0) / dt)
-}
+# EPaxos @ 64 and 1024 columns (the factored-dependency closure scales
+# past the round-3 backend's 64-column ceiling).
+for ecols, ekw in [
+    (64, dict()),
+    (1024, dict(window=64, instances_per_tick=4, frontier_history=128)),
+]:
+    ecfg = BatchedEPaxosConfig(num_columns=ecols, **ekw)
+    estate = epaxos_batched.init_state(ecfg)
+    estate, _ = epaxos_batched.run_ticks(
+        ecfg, estate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+    )
+    jax.block_until_ready(estate)
+    e0 = int(estate.executed_total)
+    t0 = time.perf_counter()
+    estate, _ = epaxos_batched.run_ticks(
+        ecfg, estate, jnp.int32(200), 200, jax.random.PRNGKey(1)
+    )
+    jax.block_until_ready(estate)
+    dt = time.perf_counter() - t0
+    inv = epaxos_batched.check_invariants(ecfg, estate, jnp.int32(400))
+    out[f"epaxos_{ecols}_columns"] = {
+        "executed_per_sec": int((int(estate.executed_total) - e0) / dt),
+        "invariants_ok": all(bool(v) for v in inv.values()),
+    }
 
 # Mencius @ 256 leaders.
 mcfg = BatchedMenciusConfig(
@@ -228,6 +235,32 @@ out["caspaxos_1024_registers"] = {
     "commits_per_sec": int((int(csstate.commits) - cs0) / dt),
     "nacks": css["nacks"],
     "chain_violations": css["chain_violations"],
+}
+
+# Horizontal @ 128 groups with config-as-log-value churn.
+from frankenpaxos_tpu.tpu import horizontal_batched
+hcfg = horizontal_batched.BatchedHorizontalConfig(
+    f=1, num_groups=128, window=32, slots_per_tick=2, alpha=16,
+    reconfigure_every=50,
+)
+hstate = horizontal_batched.init_state(hcfg)
+hstate, ht = horizontal_batched.run_ticks(
+    hcfg, hstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(hstate)
+h0 = int(hstate.committed)
+t0 = time.perf_counter()
+hstate, ht = horizontal_batched.run_ticks(
+    hcfg, hstate, ht, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(hstate)
+dt = time.perf_counter() - t0
+hs = horizontal_batched.stats(hcfg, hstate, ht)
+hinv = horizontal_batched.check_invariants(hcfg, hstate, ht)
+out["horizontal_128_groups_churning"] = {
+    "committed_per_sec": int((int(hstate.committed) - h0) / dt),
+    "reconfigs_done": hs["reconfigs_done"],
+    "invariants_ok": all(bool(v) for v in hinv.values()),
 }
 
 with open("results/batched_backends_cpu.json", "w") as f:

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Multi-device scaling measurement on the virtual CPU mesh: fixed
+total work, D in {1, 2, 4, 8} devices, three paths —
+
+  * flagship write path (group-local: XLA partitions the scan with no
+    cross-device traffic beyond scalar stat reductions),
+  * flagship + device-side ReadBatcher reads (adds the wave's
+    cross-device max/min reductions — the ICI-analog cost),
+  * grid quorums (quorums SPAN devices: cross-device reductions on the
+    hot path).
+
+This box exposes ONE physical core, so a virtual mesh cannot show
+wall-clock speedup; what the curve measures honestly is the SPMD
+PARTITIONING + COLLECTIVE OVERHEAD of each path — ticks/s at D devices
+relative to D=1 for identical total work. Group-local paths should hold
+near 1.0 (partitioning is ~free, validating the sharding design);
+collective-bearing paths pay for their reductions. Real-chip speedup is
+the TPU watcher's job when the tunnel cooperates; correctness of the
+same sharded program is pinned by tests/test_hlo_sharding.py and the
+driver's dryrun_multichip.
+
+Run with:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+Writes results/multichip_scaling_r05.json + results/multichip_scaling_r05.png.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from frankenpaxos_tpu.parallel import make_mesh, run_ticks_sharded, shard_state
+from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, init_state
+from frankenpaxos_tpu.tpu import grid_batched as gb
+
+devices = jax.devices()
+assert len(devices) >= 8, (
+    "need 8 virtual devices: set JAX_PLATFORMS=cpu "
+    "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+)
+
+G_TOTAL = 512  # divisible by 8; fixed TOTAL work at every D
+TICKS = 200
+DS = (1, 2, 4, 8)
+
+
+def measure_flagship(n_dev, reads):
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=G_TOTAL, window=32, slots_per_tick=4,
+        lat_min=1, lat_max=3, retry_timeout=16,
+        read_rate=8 if reads else 0, read_window=32 if reads else 0,
+    )
+    mesh = make_mesh(devices[:n_dev])
+    state = shard_state(init_state(cfg), mesh)
+    key = jax.random.PRNGKey(0)
+    t0j = jnp.zeros((), jnp.int32)
+    state, t = run_ticks_sharded(cfg, mesh, state, t0j, TICKS, key)
+    jax.block_until_ready(state)  # compile + ramp
+    c0 = int(state.committed)
+    r0 = int(state.reads_done) if reads else 0
+    t0 = time.perf_counter()
+    # Fresh key: run_ticks folds by loop index from 0, so reusing the
+    # warmup key would replay its random stream in the timed segment.
+    state, t = run_ticks_sharded(
+        cfg, mesh, state, t, TICKS, jax.random.fold_in(key, 1)
+    )
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    row = {
+        "devices": n_dev,
+        "ticks_per_sec": round(TICKS / dt, 2),
+        "committed_per_sec": round((int(state.committed) - c0) / dt, 1),
+    }
+    if reads:
+        row["reads_per_sec"] = round((int(state.reads_done) - r0) / dt, 1)
+    return row
+
+
+def measure_grid(n_dev):
+    cfg = gb.GridBatchedConfig(
+        rows=8, cols=4, mode="majority", window=8, slots_per_tick=2
+    )
+    mesh = make_mesh(devices[:n_dev])
+    state = gb.init_state(cfg)
+    specs = {"p2a_arrival": P(None, "groups", None),
+             "p2b_arrival": P(None, "groups", None)}
+    import dataclasses as dc
+
+    placed = {}
+    for f_ in dc.fields(state):
+        arr = getattr(state, f_.name)
+        spec = specs.get(f_.name, P())
+        placed[f_.name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    state = type(state)(**placed)
+    key = jax.random.PRNGKey(0)
+    run = jax.jit(gb.run_ticks.__wrapped__, static_argnums=(0, 3))
+    state, t = run(cfg, state, jnp.zeros((), jnp.int32), TICKS, key)
+    jax.block_until_ready(state)
+    c0 = int(state.committed)
+    t0 = time.perf_counter()
+    state, t = run(cfg, state, t, TICKS, jax.random.fold_in(key, 1))
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return {
+        "devices": n_dev,
+        "ticks_per_sec": round(TICKS / dt, 2),
+        "committed_per_sec": round(
+            (int(state.committed) - c0) / dt, 1
+        ),
+    }
+
+
+out = {
+    "device": str(devices[0]),
+    "physical_cores": 1,
+    "note": (
+        "fixed total work, virtual mesh on one physical core: the curve "
+        "measures SPMD partitioning + collective overhead (ticks/s vs "
+        "D=1), not speedup — see module docstring"
+    ),
+    "write_path": [],
+    "read_path": [],
+    "grid": [],
+}
+for d in DS:
+    out["write_path"].append(measure_flagship(d, reads=False))
+    print("write", out["write_path"][-1], flush=True)
+for d in DS:
+    out["read_path"].append(measure_flagship(d, reads=True))
+    print("read", out["read_path"][-1], flush=True)
+for d in DS:
+    out["grid"].append(measure_grid(d))
+    print("grid", out["grid"][-1], flush=True)
+
+for series in ("write_path", "read_path", "grid"):
+    base = out[series][0]["ticks_per_sec"]
+    for row in out[series]:
+        row["efficiency_vs_1dev"] = round(row["ticks_per_sec"] / base, 3)
+
+with open("results/multichip_scaling_r05.json", "w") as f:
+    json.dump(out, f, indent=1)
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+fig, ax = plt.subplots(figsize=(6.4, 3.4), dpi=150)
+for series, label, marker in [
+    ("write_path", "write path (group-local)", "o"),
+    ("read_path", "write + batched reads (wave collectives)", "s"),
+    ("grid", "grid quorums (cross-device quorums)", "^"),
+]:
+    xs = [r["devices"] for r in out[series]]
+    ys = [r["efficiency_vs_1dev"] for r in out[series]]
+    ax.plot(xs, ys, marker=marker, ms=4, lw=1.3, label=label)
+ax.axhline(1.0, color="gray", lw=0.8, ls="--", alpha=0.6)
+ax.set_xscale("log", base=2)
+ax.set_xticks(list(DS))
+ax.set_xticklabels([str(d) for d in DS])
+ax.set_xlabel("devices (virtual 8-CPU mesh, 1 physical core)")
+ax.set_ylabel("ticks/s vs 1 device")
+ax.set_title("SPMD partitioning overhead, fixed total work")
+ax.grid(True, alpha=0.3)
+ax.legend(frameon=False, fontsize=8)
+fig.tight_layout()
+fig.savefig("results/multichip_scaling_r05.png")
+print("results/multichip_scaling_r05.png")

@@ -6,6 +6,12 @@ the Tarjan graph executes, tick for tick — SCCs included
 (``depgraph/TarjanDependencyGraph.scala:149`` semantics: execute eligible
 components in reverse topological order; per tick the union of executed
 components is the eligible set, which is what the closure computes).
+
+The batched backend factors each instance's dependency vector through
+the frontier history (fpre/fpost rows + packed same-tick visibility
+bits); the oracle below MATERIALIZES those factored rows back into the
+explicit instance sets the per-actor depgraph consumes, so the
+equivalence check also pins the factored representation itself.
 """
 
 import dataclasses
@@ -38,6 +44,24 @@ def materialize_deps(dep_row, column, index):
     return deps
 
 
+def dep_row_of(state, cfg, c, s, t):
+    """Materialize the factored dependency vector of instance (c, s)
+    proposed at tick t: fpre[t % H] bumped to fpost[t % H] for visible
+    peers, own column = own index (all own predecessors)."""
+    H = cfg.frontier_history
+    W = cfg.window
+    C = cfg.num_columns
+    fpre = np.asarray(state.fpre[t % H])
+    fpost = np.asarray(state.fpost[t % H])
+    bits = np.asarray(state.vis_bits[c, s % W])
+    row = fpre.copy()
+    for e in range(C):
+        if (int(bits[e // 32]) >> (e % 32)) & 1:
+            row[e] = fpost[e]
+    row[c] = s
+    return row
+
+
 def run_cross_validation(cfg, seed, num_ticks):
     """Step the batched sim tick-by-tick; mirror every commit into a
     TarjanDependencyGraph and compare per-tick executed sets."""
@@ -49,43 +73,35 @@ def run_cross_validation(cfg, seed, num_ticks):
     tarjan_executed = set()
     scc_events = 0
     # Dep rows snapshotted at PROPOSAL time: the live ring row is
-    # overwritten when a slot retires and is re-proposed, so reading it at
-    # commit-mirroring time is only safe via this snapshot.
+    # overwritten when a slot retires and is re-proposed, so reading it
+    # at commit-mirroring time is only safe via this snapshot.
     dep_snapshot = {}
 
     C, W = cfg.num_columns, cfg.window
     for t in range(num_ticks):
-        prev_executed = np.asarray(state.executed).copy()
         prev_head = np.asarray(state.head).copy()
         prev_next = np.asarray(state.next_instance).copy()
         state = tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
 
         committed = np.asarray(state.committed)
-        executed = np.asarray(state.executed)
-        dep = np.asarray(state.dep)
         head = np.asarray(state.head)
         next_instance = np.asarray(state.next_instance)
 
         for c in range(C):
             for s in range(int(prev_next[c]), int(next_instance[c])):
-                dep_snapshot[(c, s)] = dep[c, s % W].copy()
+                dep_snapshot[(c, s)] = dep_row_of(state, cfg, c, s, t)
 
-        # Newly executed this tick, in absolute coordinates. Retired slots
-        # are handled by comparing in absolute instance space: anything at
-        # or above prev_head that became executed (including instances
-        # that retired this very tick — they were executed first, and
-        # retirement only advances over executed instances).
-        new_exec = set()
-        for c in range(C):
-            for s in range(int(prev_head[c]), int(next_instance[c])):
-                was = s < prev_head[c] or (
-                    prev_executed[c, s % W] and s >= prev_head[c]
-                )
-                now = s < head[c] or executed[c, s % W]
-                if now and not was:
-                    new_exec.add((c, s))
+        # Newly executed this tick, in absolute coordinates: execution is
+        # in column order and retires immediately, so the executed set is
+        # exactly the head advance.
+        new_exec = {
+            (c, s)
+            for c in range(C)
+            for s in range(int(prev_head[c]), int(head[c]))
+        }
 
-        # Mirror this tick's NEW commits into the Tarjan graph.
+        # Mirror this tick's NEW commits into the Tarjan graph (anything
+        # at or below the head executed, hence committed, first).
         for c in range(C):
             for s in range(int(prev_head[c]), int(next_instance[c])):
                 v = (c, s)
@@ -125,13 +141,48 @@ def test_batched_epaxos_matches_tarjan(seed, window):
         lat_min=1,
         lat_max=3,
         slow_path_rate=0.3,
-        see_same_tick_rate=0.6,
+        see_same_tick_rate=0.625,
     )
     executed, scc_events = run_cross_validation(cfg, seed=seed, num_ticks=40)
     assert executed > 30
     # The run must actually exercise the cycle path: mutual same-tick
     # visibility guarantees SCCs of size > 1 appear.
     assert scc_events > 0, "no SCC formed; the test lost its teeth"
+
+
+def test_batched_epaxos_matches_tarjan_wide():
+    """Cross-column chains at C=5 (single visibility word)."""
+    cfg = BatchedEPaxosConfig(
+        num_columns=5,
+        window=8,
+        instances_per_tick=1,
+        lat_min=1,
+        lat_max=2,
+        slow_path_rate=0.2,
+        see_same_tick_rate=0.5,
+    )
+    executed, scc_events = run_cross_validation(cfg, seed=2, num_ticks=40)
+    assert executed > 50
+    assert scc_events > 0
+
+
+def test_batched_epaxos_matches_tarjan_multiword():
+    """C=40 > 32 lanes: the packed visibility mask spans TWO uint32
+    words, so a word-index/lane-order bug in _pack_bool or _instance_ok
+    (e.g. for columns >= 32) would execute instances before their
+    cross-column deps commit — exactly what the Tarjan oracle catches."""
+    cfg = BatchedEPaxosConfig(
+        num_columns=40,
+        window=8,
+        instances_per_tick=1,
+        lat_min=1,
+        lat_max=2,
+        slow_path_rate=0.2,
+        see_same_tick_rate=0.25,
+    )
+    executed, scc_events = run_cross_validation(cfg, seed=3, num_ticks=30)
+    assert executed > 400
+    assert scc_events > 0
 
 
 def test_batched_epaxos_simplebpaxos_latency():
@@ -173,34 +224,79 @@ def test_batched_epaxos_invariants_random():
         slow_path_rate=0.25,
         see_same_tick_rate=0.5,
     )
-    state, t = run_ticks(cfg, init_state(cfg), jnp.int32(0), 200, jax.random.PRNGKey(7))
+    state, t = run_ticks(
+        cfg, init_state(cfg), jnp.int32(0), 200, jax.random.PRNGKey(7)
+    )
     inv = check_invariants(cfg, state, t)
     assert all(bool(v) for v in inv.values()), inv
     assert int(state.executed_total) > 1000
     assert int(state.coexecuted) > 0  # chains/components co-executed
 
 
+def test_batched_epaxos_wide_columns():
+    """The factored representation's reason to exist: >=1024 columns
+    (multi-word visibility masks) run with healthy throughput and clean
+    invariants."""
+    cfg = BatchedEPaxosConfig(
+        num_columns=1024,
+        window=32,
+        instances_per_tick=2,
+        lat_min=1,
+        lat_max=3,
+        slow_path_rate=0.2,
+        see_same_tick_rate=0.5,
+        frontier_history=64,
+    )
+    state, t = run_ticks(
+        cfg, init_state(cfg), jnp.int32(0), 60, jax.random.PRNGKey(9)
+    )
+    inv = check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+    # 1024 columns x 2/tick x 60 ticks = 122,880 offered; the pipeline
+    # must execute the bulk of them (ramp-up and in-flight tail allowed).
+    assert int(state.executed_total) > 80_000
+    assert int(state.coexecuted) > 0
+
+
 def test_eligible_closure_blocks_on_uncommitted():
     """A committed instance whose dependency is uncommitted must not
-    execute (it is a blocker, DependencyGraph.scala execute())."""
-    cfg = BatchedEPaxosConfig(num_columns=2, window=4, instances_per_tick=1)
-    C, W = 2, 4
+    execute (it is a blocker, DependencyGraph.scala execute()); a
+    committed mutual 2-cycle executes together. Dependencies are built
+    through the factored representation (frontier rows + vis bits)."""
+    C, W, H = 2, 4, 8
+    head = jnp.zeros((C,), jnp.int32)
+    w_iota_zeros = jnp.zeros((C, W), jnp.int32)
+
+    def closure(committed, proposed, propose_tick, vis, fpre, fpost, nxt):
+        return eligible_closure(
+            committed, proposed, propose_tick, vis, fpre, fpost, head, nxt
+        )
+
+    # Scenario: both columns proposed instance 0 at tick 0 (fpre row 0 =
+    # [0, 0], fpost row 0 = [1, 1]). (0,0) SEES (1,0) — depends on it —
+    # but only (0,0) is committed: blocked.
+    proposed = jnp.array([[True, False, False, False]] * 2)
+    propose_tick = jnp.where(proposed, 0, 10**9)
     committed = jnp.array(
         [[True, False, False, False], [False, False, False, False]]
     )
-    executed = jnp.zeros((C, W), bool)
-    # (0,0) depends on (1,0), which is uncommitted: (0,0) is blocked.
-    dep = jnp.zeros((C, W, C), jnp.int32)
-    dep = dep.at[0, 0, 1].set(1)  # (0,0) -> {(1,0)}
-    head = jnp.zeros((C,), jnp.int32)
-    E = eligible_closure(committed, executed, dep, head)
-    assert not bool(E[0, 0])  # blocked
-    assert not bool(E[1, 0])  # uncommitted
+    fpre = jnp.zeros((H, C), jnp.int32)
+    fpost = jnp.zeros((H, C), jnp.int32).at[0].set(jnp.array([1, 1]))
+    nxt = jnp.array([1, 1], jnp.int32)
+    vis = jnp.zeros((C, W, 1), jnp.uint32)
+    vis = vis.at[0, 0, 0].set(jnp.uint32(0b10))  # (0,0) sees column 1
+    newly, run = closure(
+        committed, proposed, propose_tick, vis, fpre, fpost, nxt
+    )
+    assert not bool(newly[0, 0])  # blocked on uncommitted (1,0)
+    assert not bool(newly[1, 0])  # uncommitted
+    assert int(run.sum()) == 0
 
     # Mutual 2-cycle, both committed: both execute together.
     committed = jnp.array([[True, False, False, False]] * 2)
-    dep = jnp.zeros((C, W, C), jnp.int32)
-    dep = dep.at[0, 0, 1].set(1)
-    dep = dep.at[1, 0, 0].set(1)
-    E = eligible_closure(committed, executed, dep, head)
-    assert bool(E[0, 0]) and bool(E[1, 0])
+    vis = vis.at[1, 0, 0].set(jnp.uint32(0b01))  # (1,0) sees column 0
+    newly, run = closure(
+        committed, proposed, propose_tick, vis, fpre, fpost, nxt
+    )
+    assert bool(newly[0, 0]) and bool(newly[1, 0])
+    assert int(run.sum()) == 2

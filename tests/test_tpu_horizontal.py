@@ -1,0 +1,184 @@
+"""Tests of the batched Horizontal MultiPaxos backend
+(tpu/horizontal_batched.py): config-as-log-value reconfiguration with
+the s+alpha chunk pipeline (horizontal/Leader.scala:459-498, 920-960),
+bank isolation safety, alpha pipeline bound, handover discipline, and a
+deterministic single-group walkthrough."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.tpu import horizontal_batched as hb
+
+
+def run_random(cfg, seed, ticks):
+    key = jax.random.PRNGKey(seed)
+    state, t = hb.run_ticks(cfg, hb.init_state(cfg), jnp.int32(0), ticks, key)
+    return state, t
+
+
+def test_progress_without_reconfiguration():
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=8, window=32, slots_per_tick=2, alpha=16,
+        lat_min=1, lat_max=3,
+    )
+    state, t = run_random(cfg, seed=0, ticks=200)
+    s = hb.stats(cfg, state, t)
+    assert s["committed"] > 8 * 150
+    assert s["executed"] > 0
+    assert s["reconfigs_done"] == 0
+    inv = hb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_reconfiguration_churn_progress_and_safety():
+    """Open workload with periodic config-as-log-value reconfigurations:
+    chunks hand over, banks alternate, and every safety check holds."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=8, window=32, slots_per_tick=2, alpha=16,
+        lat_min=1, lat_max=3, reconfigure_every=30,
+    )
+    state, t = run_random(cfg, seed=1, ticks=400)
+    s = hb.stats(cfg, state, t)
+    assert s["committed"] > 8 * 200
+    assert s["reconfigs_proposed"] >= 8  # every group reconfigured
+    assert s["reconfigs_done"] >= 8
+    assert s["bank_violations"] == 0
+    inv = hb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+    # Epochs actually advanced (banks alternated).
+    assert int(jax.device_get(state.epoch).min()) >= 1
+
+
+def test_small_alpha_stalls_at_boundary():
+    """With a tight alpha the old chunk drains before the new bank's
+    phase 1 completes, so proposals must stall at the boundary (the
+    throughput dip the churn timeline measures) — and never violate the
+    alpha bound while doing so."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, alpha=4,
+        lat_min=2, lat_max=4, reconfigure_every=25,
+    )
+    state, t = run_random(cfg, seed=2, ticks=300)
+    s = hb.stats(cfg, state, t)
+    assert s["reconfigs_done"] > 0
+    assert s["boundary_stalls"] > 0  # phase 1 gated the new chunk
+    inv = hb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_alpha_bound_is_tight():
+    """next_slot - watermark never exceeds alpha, even under load."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=4, window=32, slots_per_tick=8, alpha=8,
+        lat_min=2, lat_max=4,
+    )
+    key = jax.random.PRNGKey(3)
+    state = hb.init_state(cfg)
+    t = 0
+    for _ in range(80):
+        state = hb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+        gap = np.asarray(state.next_slot) - np.asarray(state.head)
+        assert (gap <= cfg.alpha).all(), gap
+    assert int(state.alpha_stalls) > 0  # the gate actually fired
+
+
+def test_bank_isolation_detector_has_teeth():
+    """Forge a vote in the WRONG bank: the device-side ledger must count
+    it and the votes_in_place invariant must trip."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=2, window=8, slots_per_tick=1, alpha=4,
+        lat_min=1, lat_max=1,
+    )
+    key = jax.random.PRNGKey(4)
+    state = hb.init_state(cfg)
+    for t in range(10):
+        state = hb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+    live = np.asarray(state.status) == hb.PROPOSED
+    assert live.any()
+    g, w = map(int, np.argwhere(live)[0])
+    # The slot's bank is epoch%2 = 0 (rows 0..n); forge row n (bank 1).
+    state = dataclasses.replace(
+        state, voted=state.voted.at[cfg.n, g, w].set(True)
+    )
+    inv = hb.check_invariants(cfg, state, jnp.int32(10))
+    assert not bool(inv["votes_in_place"])
+    state = hb.tick(cfg, state, jnp.int32(10), jax.random.fold_in(key, 10))
+    assert int(state.bank_violations) > 0
+
+
+def test_deterministic_chunk_walkthrough():
+    """Single group, lat=1, K=1: follow one reconfiguration end to end —
+    config proposed, chosen, crosses the watermark, boundary armed at
+    s+alpha, phase 1 runs against bank 1, handover bumps the epoch, and
+    post-handover slots are chosen by bank 1 only."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=1, window=16, slots_per_tick=1, alpha=6,
+        lat_min=1, lat_max=1, reconfigure_every=1000,  # manual firing
+    )
+    key = jax.random.PRNGKey(5)
+    state = hb.init_state(cfg)
+    t = 1  # start past t=0 so the periodic driver can't fire in warm-up
+    # Warm up: a few command slots flow through bank 0.
+    for _ in range(8):
+        state = hb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    assert int(state.epoch[0]) == 0
+    # reconfigure_every=1000 with stagger 7*0: fires at t % 1000 == 0 —
+    # force a config proposal by replacing the next tick's t with 1000.
+    state = hb.tick(cfg, state, jnp.int32(1000), jax.random.fold_in(key, t))
+    assert int(state.reconfigs_proposed) == 1
+    config_slot = int(state.next_slot[0]) - 1
+    t = 1001  # time continues from the forced tick (arrivals are exact)
+    # Run until handover.
+    for _ in range(60):
+        if int(state.epoch[0]) == 1:
+            break
+        state = hb.tick(
+            cfg, state, jnp.int32(t), jax.random.fold_in(key, t)
+        )
+        t += 1
+    assert int(state.epoch[0]) == 1, "handover never happened"
+    assert int(state.boundary[0]) == hb.INF
+    assert int(state.reconfigs_done) == 1
+    # Watermark passed the boundary (= config_slot + alpha).
+    assert int(state.head[0]) >= config_slot + cfg.alpha
+    # Post-handover: run on, then check every live vote sits in bank 1.
+    for _ in range(10):
+        state = hb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    voted = np.asarray(state.voted)  # [P, G, W]
+    live = np.asarray(state.status) != hb.EMPTY
+    n = cfg.n
+    assert not voted[:n, 0, live[0]].any(), "bank-0 votes after handover"
+    inv = hb.check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_throughput_dip_visible_in_timeline():
+    """Per-tick committed counts around a reconfiguration show the
+    boundary stall (the artifact scripts/horizontal_churn.py plots)."""
+    cfg = hb.BatchedHorizontalConfig(
+        f=1, num_groups=16, window=16, slots_per_tick=2, alpha=4,
+        lat_min=2, lat_max=3, reconfigure_every=40,
+    )
+    key = jax.random.PRNGKey(6)
+    state = hb.init_state(cfg)
+    committed = []
+    t = 0
+    for _ in range(200):
+        before = int(state.committed)
+        state = hb.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        committed.append(int(state.committed) - before)
+        t += 1
+    inv = hb.check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+    # Steady state exists and the dip exists: some tick commits far less
+    # than the steady rate while reconfigurations churn.
+    steady = sorted(committed[50:])[len(committed[50:]) // 2]
+    assert steady >= 8  # alpha=4 throttles below K*G, but flow persists
+    assert min(committed[50:]) <= steady // 2  # the reconfiguration dip
