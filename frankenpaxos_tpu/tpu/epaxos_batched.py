@@ -94,6 +94,22 @@ class BatchedEPaxosConfig:
     # factored dependency row would be overwritten). Lifetimes are
     # commit latency + chain depth (tens of ticks); 256 is a wide margin.
     frontier_history: int = 256
+    # Device-side GC / bounded state (simplegcbpaxos semantics:
+    # GarbageCollector.scala:99-120 watermark broadcast,
+    # Replica.scala:317-363 snapshots). When num_exec_replicas > 0, the
+    # backend models R executing replicas whose per-column executed
+    # watermarks lag the dep-graph pass; ring slots are pruned only
+    # below the SNAPSHOT BARRIER (the quorum watermark captured by the
+    # latest periodic snapshot), so state stays bounded exactly as far
+    # as GC keeps up — and a crashed replica reviving behind the pruned
+    # prefix recovers from the snapshot, not by replay. 0 = GC layer off
+    # (slots prune the tick they execute).
+    num_exec_replicas: int = 0  # R (use 2f+1-style odd counts)
+    replica_lag: int = 2  # mean ticks between a replica's watermark pulls
+    rep_crash_rate: float = 0.0  # per-replica per-tick crash probability
+    rep_revive_rate: float = 0.1  # per-crashed-replica revival probability
+    snapshot_every: int = 32  # ticks between snapshot-barrier captures
+    gc_quorum: int = 2  # replicas that must have executed before pruning
 
     @property
     def num_replicas(self) -> int:
@@ -117,6 +133,11 @@ class BatchedEPaxosConfig:
         assert self.frontier_history >= 8 * self.lat_max, (
             "frontier_history must comfortably exceed instance lifetimes"
         )
+        if self.num_exec_replicas:
+            assert 1 <= self.gc_quorum <= self.num_exec_replicas
+            assert self.replica_lag >= 1
+            assert self.snapshot_every >= 1
+            assert 0.0 <= self.rep_crash_rate <= 1.0
 
 
 @jax.tree_util.register_dataclass
@@ -140,6 +161,17 @@ class BatchedEPaxosState:
     vis_bits: jnp.ndarray  # [C, W, CW] uint32 same-tick visibility mask
     fpre: jnp.ndarray  # [H, C] frontier BEFORE tick h's proposals
     fpost: jnp.ndarray  # [H, C] frontier AFTER tick h's proposals
+
+    # GC layer (zero-width when cfg.num_exec_replicas == 0). With GC on,
+    # ``head`` is the SNAPSHOT BARRIER (= prune watermark / ring base —
+    # GC prunes exactly up to the latest periodic snapshot) while
+    # ``exec_wm`` is the dep-graph execution watermark;
+    # head <= quorum watermark <= exec_wm.
+    exec_wm: jnp.ndarray  # [C] dep-graph executed watermark
+    rep_exec: jnp.ndarray  # [R, C] per-replica executed watermark
+    rep_down: jnp.ndarray  # [R] replica crashed
+    snapshots_served: jnp.ndarray  # [] recoveries served from a snapshot
+    rep_crashes: jnp.ndarray  # [] crash events (cumulative)
 
     # Stats.
     committed_total: jnp.ndarray  # [] cumulative commits
@@ -166,6 +198,11 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         vis_bits=jnp.zeros((C, W, CW), jnp.uint32),
         fpre=jnp.zeros((H, C), jnp.int32),
         fpost=jnp.zeros((H, C), jnp.int32),
+        exec_wm=jnp.zeros((C if cfg.num_exec_replicas else 0,), jnp.int32),
+        rep_exec=jnp.zeros((cfg.num_exec_replicas, C), jnp.int32),
+        rep_down=jnp.zeros((cfg.num_exec_replicas,), bool),
+        snapshots_served=jnp.zeros((), jnp.int32),
+        rep_crashes=jnp.zeros((), jnp.int32),
         committed_total=jnp.zeros((), jnp.int32),
         executed_total=jnp.zeros((), jnp.int32),
         retired_total=jnp.zeros((), jnp.int32),
@@ -258,27 +295,31 @@ def eligible_closure(
     vis_bits: jnp.ndarray,  # [C, W, CW]
     fpre: jnp.ndarray,  # [H, C]
     fpost: jnp.ndarray,  # [H, C]
-    head: jnp.ndarray,  # [C]
+    base: jnp.ndarray,  # [C] executed watermark the pass starts from
     next_instance: jnp.ndarray,  # [C]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The dependency-graph execute pass as a greatest fixpoint over
-    per-column watermarks: the largest ``m`` (head <= m <= next_instance)
+    per-column watermarks: the largest ``m`` (base <= m <= next_instance)
     such that every instance below ``m`` is committed and its dependency
     vector lies below ``m``. This is exactly the set of ELIGIBLE vertices
     of ``DependencyGraph.scala:8-125`` — vertices all of whose transitive
     dependencies are committed — including whole SCCs, which the
     reference executes together in one component.
 
+    ``base`` is the ring head without the GC layer, or the execution
+    watermark ``exec_wm`` with it (executed-but-unpruned slots then sit
+    below base and fall outside the candidate window).
+
     Returns (newly [C, W] bool — slots to execute, run [C] — per-column
-    executed count; head + run is the fixpoint watermark)."""
+    executed count; base + run is the fixpoint watermark)."""
     C, W = committed.shape
     H = fpre.shape[0]
     w_iota = jnp.arange(W, dtype=jnp.int32)
     h_idx = jnp.where(proposed, jnp.mod(propose_tick, H), 0)
-    ordinal = jnp.mod(w_iota[None, :] - head[:, None], W)  # [C, W]
-    in_ring = ordinal < (next_instance - head)[:, None]
+    ordinal = jnp.mod(w_iota[None, :] - base[:, None], W)  # [C, W]
+    in_ring = ordinal < (next_instance - base)[:, None]
     cand = committed & proposed & in_ring
-    pos_of_ord = jnp.mod(head[:, None] + w_iota[None, :], W)
+    pos_of_ord = jnp.mod(base[:, None] + w_iota[None, :], W)
 
     def run_of(ok_pre, bad_post):
         ok = _instance_ok(ok_pre, bad_post, h_idx, vis_bits) & cand
@@ -291,7 +332,7 @@ def eligible_closure(
     # mask is materialized at the loop boundary (see _instance_ok note).
     def body(carry):
         m, ok_pre, bad_post, _ = carry
-        m_new = head + run_of(ok_pre, bad_post)
+        m_new = base + run_of(ok_pre, bad_post)
         ok_pre2, bad_post2 = _tick_scores(m_new, fpre, fpost)
         return m_new, ok_pre2, bad_post2, jnp.any(m_new != m)
 
@@ -305,7 +346,7 @@ def eligible_closure(
     m, _, _, _ = jax.lax.while_loop(
         cond, body, (next_instance, ok_pre0, bad_post0, jnp.bool_(True))
     )
-    run = m - head
+    run = m - base
     newly = in_ring & (ordinal < run[:, None])
     return newly, run
 
@@ -331,31 +372,34 @@ def tick(
     n_new_commits = jnp.sum(committed & ~state.committed)
 
     # ---- 2. Dependency-graph execute pass (TarjanDependencyGraph
-    # execute: all eligible vertices, SCCs together), then retire —
-    # execution is in column order, so the executed set is exactly the
-    # advance of the head watermark.
+    # execute: all eligible vertices, SCCs together). Without the GC
+    # layer the pass ALSO retires (head is the executed watermark); with
+    # it, execution advances exec_wm and pruning waits for the quorum
+    # watermark's snapshot barrier in step 2b.
+    exec_base = state.exec_wm if cfg.num_exec_replicas else state.head
     newly, run = eligible_closure(
         committed, state.proposed, state.propose_tick, state.vis_bits,
-        state.fpre, state.fpost, state.head, state.next_instance,
+        state.fpre, state.fpost, exec_base, state.next_instance,
     )
     n_exec = jnp.sum(run)
     # Co-execution accounting: a newly executed instance whose deps were
-    # not all executed BEFORE this pass (i.e. not a head instance with
-    # its whole dependency vector already below the old heads) executed
-    # together with at least one dependency — a same-pass chain or SCC.
-    ordinal = jnp.mod(w_iota[None, :] - state.head[:, None], W)
-    ok_pre_h, bad_post_h = _tick_scores(state.head, state.fpre, state.fpost)
-    # Only the head instance of a column can have had its whole
-    # dependency vector below the old heads, so evaluate just that one
-    # slot per column ([C, CW] work — no ring-wide gather).
-    head_pos = jnp.mod(state.head, W)  # [C]
+    # not all executed BEFORE this pass (i.e. not a base instance with
+    # its whole dependency vector already below the old watermarks)
+    # executed together with at least one dependency — a same-pass chain
+    # or SCC.
+    ordinal = jnp.mod(w_iota[None, :] - exec_base[:, None], W)
+    ok_pre_h, bad_post_h = _tick_scores(exec_base, state.fpre, state.fpost)
+    # Only the base instance of a column can have had its whole
+    # dependency vector below the old watermarks, so evaluate just that
+    # one slot per column ([C, CW] work — no ring-wide gather).
+    base_pos = jnp.mod(exec_base, W)  # [C]
     c_iota = jnp.arange(C, dtype=jnp.int32)
     h0 = jnp.where(
-        state.proposed[c_iota, head_pos],
-        jnp.mod(state.propose_tick[c_iota, head_pos], H),
+        state.proposed[c_iota, base_pos],
+        jnp.mod(state.propose_tick[c_iota, base_pos], H),
         0,
     )  # [C]
-    vis0 = state.vis_bits[c_iota, head_pos]  # [C, CW]
+    vis0 = state.vis_bits[c_iota, base_pos]  # [C, CW]
     conflict0 = jnp.any(
         (vis0 & jnp.take(bad_post_h, h0, axis=0)) != jnp.uint32(0), axis=1
     )
@@ -369,14 +413,74 @@ def tick(
         newly.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
     )
     executed_total = state.executed_total + n_exec
-    retired_total = state.retired_total + n_exec
-    head = state.head + run
 
-    proposed = state.proposed & ~newly
-    committed = committed & ~newly
-    propose_tick = jnp.where(newly, INF, state.propose_tick)
-    commit_tick = jnp.where(newly, INF, state.commit_tick)
-    vis_bits = jnp.where(newly[:, :, None], jnp.uint32(0), state.vis_bits)
+    if cfg.num_exec_replicas:
+        # ---- 2b. GC layer (simplegcbpaxos): executing replicas pull
+        # the execution watermark with lag (and crash/revive); the
+        # gc_quorum-th largest replica watermark is the quorum
+        # watermark (GarbageCollector.scala:99-120 — prune only what a
+        # quorum has executed); periodic snapshots capture it as the
+        # SNAPSHOT BARRIER, and the ring prunes exactly to the barrier.
+        # A live replica whose watermark fell below the pruned prefix
+        # cannot replay it — it recovers from the snapshot
+        # (Replica.scala:317-363), counted in snapshots_served.
+        R = cfg.num_exec_replicas
+        exec_wm = exec_base + run
+        k_pull, k_crash, k_revive = jax.random.split(
+            jax.random.fold_in(key, 1), 3
+        )
+        crash = ~state.rep_down & (
+            jax.random.uniform(k_crash, (R,)) < cfg.rep_crash_rate
+        )
+        revive = state.rep_down & (
+            jax.random.uniform(k_revive, (R,)) < cfg.rep_revive_rate
+        )
+        rep_down = (state.rep_down | crash) & ~revive
+        rep_crashes = state.rep_crashes + jnp.sum(crash)
+        quorum_wm = jnp.sort(state.rep_exec, axis=0)[
+            R - cfg.gc_quorum
+        ]  # [C]
+        # Periodic snapshot at the quorum watermark; the barrier IS the
+        # prune base (GC prunes exactly up to the latest snapshot).
+        snap_now = jnp.mod(t, cfg.snapshot_every) == 0
+        head = jnp.where(
+            snap_now, jnp.maximum(state.head, quorum_wm), state.head
+        )
+        run_gc = head - state.head
+        retired_total = state.retired_total + jnp.sum(run_gc)
+        ordinal_h = jnp.mod(w_iota[None, :] - state.head[:, None], W)
+        clear = ordinal_h < run_gc[:, None]  # pruned slots
+        # Snapshot recovery FIRST: a live replica behind the pruned
+        # prefix cannot replay it — it jumps to the snapshot barrier
+        # (and only resumes ordinary replay next tick). Replay (the
+        # watermark pull) is gated on NOT being lost: executing up to
+        # exec_wm requires every instance from the replica's watermark
+        # upward to still be in the ring.
+        lost = ~rep_down[:, None] & (state.rep_exec < head[None, :])
+        snapshots_served = state.snapshots_served + jnp.sum(
+            jnp.any(lost, axis=1)
+        )
+        rep_exec = jnp.where(lost, head[None, :], state.rep_exec)
+        pull = (
+            (jax.random.uniform(k_pull, (R, C)) < 1.0 / cfg.replica_lag)
+            & ~rep_down[:, None]
+            & ~lost
+        )
+        rep_exec = jnp.where(pull, exec_wm[None, :], rep_exec)
+    else:
+        exec_wm = state.exec_wm  # zero-width
+        rep_exec, rep_down = state.rep_exec, state.rep_down
+        snapshots_served = state.snapshots_served
+        rep_crashes = state.rep_crashes
+        retired_total = state.retired_total + n_exec
+        head = state.head + run
+        clear = newly
+
+    proposed = state.proposed & ~clear
+    committed = committed & ~clear
+    propose_tick = jnp.where(clear, INF, state.propose_tick)
+    commit_tick = jnp.where(clear, INF, state.commit_tick)
+    vis_bits = jnp.where(clear[:, :, None], jnp.uint32(0), state.vis_bits)
 
     # ---- 3. Propose new instances (EpReplica handleClientRequest): up
     # to K per column if the window has room. The dependency snapshot is
@@ -446,6 +550,11 @@ def tick(
         vis_bits=vis_bits,
         fpre=fpre,
         fpost=fpost,
+        exec_wm=exec_wm,
+        rep_exec=rep_exec,
+        rep_down=rep_down,
+        snapshots_served=snapshots_served,
+        rep_crashes=rep_crashes,
         committed_total=state.committed_total + n_new_commits,
         executed_total=executed_total,
         retired_total=retired_total,
@@ -478,28 +587,45 @@ def check_invariants(
     cfg: BatchedEPaxosConfig, state: BatchedEPaxosState, t
 ) -> dict:
     """Device-side safety checks; all returned booleans must be True."""
-    # The execution counter is exactly the total head advance (execution
-    # is in column order and retires the same tick) — ties the cumulative
-    # stat to live state, so a miscounted closure pass fails here.
-    conserved = state.executed_total == jnp.sum(state.head)
+    # The execution counter is exactly the total watermark advance
+    # (execution is in column order) — ties the cumulative stat to live
+    # state, so a miscounted closure pass fails here.
+    exec_base = state.exec_wm if cfg.num_exec_replicas else state.head
+    conserved = state.executed_total == jnp.sum(exec_base)
     books_ok = state.executed_total <= state.committed_total
-    # Window bookkeeping.
+    # Window bookkeeping: bounded state. With the GC layer this is THE
+    # claim — the ring never outgrows W even though pruning waits for
+    # the quorum watermark's snapshot barrier.
     window_ok = jnp.all(
         (state.head <= state.next_instance)
         & (state.next_instance - state.head <= cfg.window)
     )
     # Committed implies proposed (a commit can only land on a live slot).
     ring_ok = jnp.all(~state.committed | state.proposed)
-    # Frontier-history residency: every live instance's factored
-    # dependency row is still in the ring (age < H). A violation means
+    # Frontier-history residency: every live UNEXECUTED instance's
+    # factored dependency row is still in the ring (age < H); executed
+    # slots awaiting GC no longer need their row. A violation means
     # frontier_history is too small for this workload — fail LOUDLY.
-    age_ok = jnp.all(
-        ~state.proposed | (t - state.propose_tick < cfg.frontier_history)
+    W = cfg.window
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    abs_slot = state.head[:, None] + jnp.mod(
+        w_iota[None, :] - state.head[:, None], W
     )
-    return {
+    unexecuted = state.proposed & (abs_slot >= exec_base[:, None])
+    age_ok = jnp.all(
+        ~unexecuted | (t - state.propose_tick < cfg.frontier_history)
+    )
+    out = {
         "conserved": conserved,
         "books_ok": books_ok,
         "window_ok": window_ok,
         "ring_ok": ring_ok,
         "age_ok": age_ok,
     }
+    if cfg.num_exec_replicas:
+        # GC ordering: prune base (= snapshot barrier) never passes the
+        # execution watermark, and no replica is ever ahead of execution.
+        out["gc_ok"] = jnp.all(state.head <= state.exec_wm) & jnp.all(
+            state.rep_exec <= state.exec_wm[None, :]
+        )
+    return out
