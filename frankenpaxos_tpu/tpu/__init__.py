@@ -12,11 +12,14 @@ from frankenpaxos_tpu.tpu import (
     caspaxos_batched,
     craq_batched,
     epaxos_batched,
+    fasterpaxos_batched,
+    fastmultipaxos_batched,
     fastpaxos_batched,
     horizontal_batched,
     mencius_batched,
     scalog_batched,
     unreplicated_batched,
+    vanillamencius_batched,
 )
 from frankenpaxos_tpu.tpu.caspaxos_batched import (
     BatchedCasPaxosConfig,
@@ -61,6 +64,8 @@ __all__ = [
     "BatchedEPaxosState",
     "BatchedFastPaxosConfig",
     "BatchedFastPaxosState",
+    "fasterpaxos_batched",
+    "fastmultipaxos_batched",
     "fastpaxos_batched",
     "BatchedMenciusConfig",
     "BatchedMenciusState",
@@ -76,6 +81,7 @@ __all__ = [
     "reconfigure",
     "scalog_batched",
     "unreplicated_batched",
+    "vanillamencius_batched",
     "run_ticks",
     "tick",
 ]

@@ -86,6 +86,18 @@ class BatchedEPaxosConfig:
     # multiples of 1/16 by the bit-sliced Bernoulli sampler.
     see_same_tick_rate: float = 0.5
     simplebpaxos: bool = False  # +1 RTT: proposer -> depservice -> acceptors
+    # Unanimous BPaxos (unanimousbpaxos/Leader.scala fast/classic paths):
+    # the leader takes the FAST path only when every dep-service node
+    # reports the SAME dependency set — possible only when the instance
+    # saw no same-tick concurrency, or when all nodes happened to observe
+    # the concurrency identically (probability unanimity_rate). A failed
+    # fast path falls back to a classic round: +1 RTT AND the dependency
+    # set is widened to the UNION of node reports (here: every same-tick
+    # peer — the superset the coordinator must adopt to be safe).
+    # NOTE: unanimous_mode supersedes slow_path_rate (the fast/classic
+    # decision is driven by unanimity, not the Bernoulli coin).
+    unanimous_mode: bool = False
+    unanimity_rate: float = 0.5  # P(nodes agree despite seen concurrency)
     # Closed workload: stop proposing once each column has allocated this
     # many instances (None = open workload).
     max_instances_per_column: Optional[int] = None
@@ -133,6 +145,7 @@ class BatchedEPaxosConfig:
         assert self.frontier_history >= 8 * self.lat_max, (
             "frontier_history must comfortably exceed instance lifetimes"
         )
+        assert 0.0 <= self.unanimity_rate <= 1.0
         if self.num_exec_replicas:
             assert 1 <= self.gc_quorum <= self.num_exec_replicas
             assert self.replica_lag >= 1
@@ -175,6 +188,7 @@ class BatchedEPaxosState:
 
     # Stats.
     committed_total: jnp.ndarray  # [] cumulative commits
+    fast_path_total: jnp.ndarray  # [] proposals that took the fast path
     executed_total: jnp.ndarray  # [] cumulative executions
     retired_total: jnp.ndarray  # [] cumulative retired (GC'd) instances
     coexecuted: jnp.ndarray  # [] executed in the same pass as one of its
@@ -204,6 +218,7 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         snapshots_served=jnp.zeros((), jnp.int32),
         rep_crashes=jnp.zeros((), jnp.int32),
         committed_total=jnp.zeros((), jnp.int32),
+        fast_path_total=jnp.zeros((), jnp.int32),
         executed_total=jnp.zeros((), jnp.int32),
         retired_total=jnp.zeros((), jnp.int32),
         coexecuted=jnp.zeros((), jnp.int32),
@@ -514,6 +529,19 @@ def tick(
     own_mask = _pack_bool(col[:, None] == col[None, :])  # [C, CW]
     valid_mask = _pack_bool(jnp.ones((C,), bool))  # [CW] lanes < C
     sees_k = sees_k & ~own_mask[:, None, :] & valid_mask[None, None, :]
+    if cfg.unanimous_mode:
+        # Unanimous BPaxos fast/classic paths: seen concurrency breaks
+        # dep-service unanimity with probability 1 - unanimity_rate; a
+        # broken fast path widens the dependency set to the UNION (every
+        # same-tick peer) and pays the classic round below.
+        saw_any_k = jnp.any(sees_k != jnp.uint32(0), axis=2)  # [C, K]
+        lucky_k = (
+            jax.random.uniform(jax.random.fold_in(k_slow, 7), (C, K))
+            < cfg.unanimity_rate
+        )
+        slow_k = saw_any_k & ~lucky_k
+        full_k = ~own_mask[:, None, :] & valid_mask[None, None, :]
+        sees_k = jnp.where(slow_k[:, :, None], full_k, sees_k)
     sees = jnp.take_along_axis(
         sees_k, jnp.clip(delta, 0, K - 1)[:, :, None], axis=1
     )  # [C, W, CW]
@@ -533,7 +561,13 @@ def tick(
         ),
         axis=0,
     )
-    slow = jax.random.uniform(k_slow, (C, W)) < cfg.slow_path_rate
+    if cfg.unanimous_mode:
+        slow = jnp.take_along_axis(
+            slow_k, jnp.clip(delta, 0, K - 1), axis=1
+        )  # [C, W]
+    else:
+        slow = jax.random.uniform(k_slow, (C, W)) < cfg.slow_path_rate
+    fast_path_total = state.fast_path_total + jnp.sum(is_new & ~slow)
     commit_lat = jnp.where(slow, rtt, fast)
     proposed = proposed | is_new
     propose_tick = jnp.where(is_new, t, propose_tick)
@@ -556,6 +590,7 @@ def tick(
         snapshots_served=snapshots_served,
         rep_crashes=rep_crashes,
         committed_total=state.committed_total + n_new_commits,
+        fast_path_total=fast_path_total,
         executed_total=executed_total,
         retired_total=retired_total,
         coexecuted=coexecuted,

@@ -1,0 +1,530 @@
+"""Batched Faster Paxos as a single XLA program: DELEGATE
+slot-partitioning (reference ``fasterpaxos/Server.scala:315-340``
+delegate indexes, ``:497-530`` dead-delegate leader change; per-actor
+analog ``protocols/fasterpaxos.py``).
+
+The defining mechanism: after phase 1, the leader grants ``f + 1``
+DELEGATES proposal rights over the log, partitioned round-robin — seat
+``d`` owns slots ``{o : o mod D == d}`` — so clients commit through
+their delegate in one round trip without the leader on the critical
+path (Phase2aAny). The cost: a dead delegate stalls its stripe of the
+log (the execution watermark is the min over seats), and the repair is
+a LEADER CHANGE — a higher round, phase 1 against the servers, a fresh
+delegate seating that excludes the dead server, and re-proposal of
+everything in flight.
+
+TPU-first layout: ``G`` independent groups, each with ``S = 2f+1``
+servers (the acceptors) and ``D = f+1`` delegate seats; seat ``d`` of
+group ``g`` is served by server ``(d + seat_epoch[g]) mod S`` — a dead
+server triggers a leader change that bumps the round AND the seating
+rotation. Per-seat slot rings are ``[G, D, W]`` (owned ordinals; global
+slot = ordinal * D + seat, the mencius-style stripe formula inside the
+group); acceptor vote state is ``[A, G, D, W]`` with per-group promised
+rounds. Phase-1 repair re-proposes in-flight slots with their original
+values in the new round (full-information repair — the batched
+convention also used by the flagship's oracle leader_change; the
+matchmaker path there shows the true-quorum variant). The choose-once
+ledger guards value stability across leader changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_delivered,
+    bit_latency,
+    ring_retire,
+)
+
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+
+# Group phase.
+PH_NORMAL = 0
+PH_P1 = 1  # leader change: phase 1 in flight
+
+NO_VALUE = -1
+NOOP_VALUE = -2
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedFasterPaxosConfig:
+    f: int = 1
+    num_groups: int = 8  # G
+    window: int = 16  # W: in-flight owned ordinals per seat
+    slots_per_tick: int = 2  # K: proposals per live seat per tick
+    lat_min: int = 1
+    lat_max: int = 3
+    drop_rate: float = 0.0
+    retry_timeout: int = 16
+    fail_rate: float = 0.0  # per-server per-tick death probability
+    revive_rate: float = 0.05
+    detect_timeout: int = 6  # ticks a seat is dead before leader change
+
+    @property
+    def num_servers(self) -> int:
+        return 2 * self.f + 1  # S (also the acceptor count A)
+
+    @property
+    def num_delegates(self) -> int:
+        return self.f + 1  # D seats
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.window >= 2 * self.slots_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.drop_rate < 1.0
+        assert self.detect_timeout >= 1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedFasterPaxosState:
+    """Shapes: [G] groups, [G, D, W] per-seat rings, [A, G, D, W]
+    acceptor votes, [S, G] server liveness."""
+
+    round: jnp.ndarray  # [G] current round
+    seat_epoch: jnp.ndarray  # [G] delegate seating rotation
+    phase: jnp.ndarray  # [G] PH_*
+    dead_ticks: jnp.ndarray  # [G] consecutive ticks with a dead seat
+    leader_changes: jnp.ndarray  # []
+
+    next_ord: jnp.ndarray  # [G, D] next owned ordinal per seat
+    head: jnp.ndarray  # [G, D] lowest non-retired owned ordinal
+
+    status: jnp.ndarray  # [G, D, W]
+    slot_value: jnp.ndarray  # [G, D, W]
+    propose_tick: jnp.ndarray  # [G, D, W]
+    last_send: jnp.ndarray  # [G, D, W]
+    replica_arrival: jnp.ndarray  # [G, D, W]
+    chosen_value: jnp.ndarray  # [G, D, W] choose-once ledger
+
+    acc_round: jnp.ndarray  # [A, G] per-group promised round
+    vote_round: jnp.ndarray  # [A, G, D, W] (-1 = none)
+    p2a_arrival: jnp.ndarray  # [A, G, D, W]
+    p2a_round: jnp.ndarray  # [A, G, D, W] round the Phase2a carries
+    p2b_arrival: jnp.ndarray  # [A, G, D, W]
+
+    server_alive: jnp.ndarray  # [S, G]
+    p1a_arrival: jnp.ndarray  # [A, G] leader-change Phase1a
+    p1b_arrival: jnp.ndarray  # [A, G]
+
+    committed: jnp.ndarray  # []
+    committed_real: jnp.ndarray  # []
+    group_wm: jnp.ndarray  # [G] per-group execution watermark (monotone)
+    noop_fills: jnp.ndarray  # [] stalled slots noop-filled at recovery
+    deaths: jnp.ndarray  # []
+    choose_violations: jnp.ndarray  # []
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(cfg: BatchedFasterPaxosConfig) -> BatchedFasterPaxosState:
+    G, D, W = cfg.num_groups, cfg.num_delegates, cfg.window
+    A = S = cfg.num_servers
+    return BatchedFasterPaxosState(
+        round=jnp.zeros((G,), jnp.int32),
+        seat_epoch=jnp.zeros((G,), jnp.int32),
+        phase=jnp.zeros((G,), jnp.int32),
+        dead_ticks=jnp.zeros((G,), jnp.int32),
+        leader_changes=jnp.zeros((), jnp.int32),
+        next_ord=jnp.zeros((G, D), jnp.int32),
+        head=jnp.zeros((G, D), jnp.int32),
+        status=jnp.zeros((G, D, W), jnp.int32),
+        slot_value=jnp.full((G, D, W), NO_VALUE, jnp.int32),
+        propose_tick=jnp.full((G, D, W), INF, jnp.int32),
+        last_send=jnp.full((G, D, W), INF, jnp.int32),
+        replica_arrival=jnp.full((G, D, W), INF, jnp.int32),
+        chosen_value=jnp.full((G, D, W), NO_VALUE, jnp.int32),
+        acc_round=jnp.zeros((A, G), jnp.int32),
+        vote_round=jnp.full((A, G, D, W), -1, jnp.int32),
+        p2a_arrival=jnp.full((A, G, D, W), INF, jnp.int32),
+        p2a_round=jnp.zeros((A, G, D, W), jnp.int32),
+        p2b_arrival=jnp.full((A, G, D, W), INF, jnp.int32),
+        server_alive=jnp.ones((S, G), bool),
+        p1a_arrival=jnp.full((A, G), INF, jnp.int32),
+        p1b_arrival=jnp.full((A, G), INF, jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        committed_real=jnp.zeros((), jnp.int32),
+        group_wm=jnp.zeros((G,), jnp.int32),
+        noop_fills=jnp.zeros((), jnp.int32),
+        deaths=jnp.zeros((), jnp.int32),
+        choose_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _seat_server(cfg, seat_epoch):
+    """[G, D] server index serving each delegate seat."""
+    D, S = cfg.num_delegates, cfg.num_servers
+    d_iota = jnp.arange(D, dtype=jnp.int32)[None, :]
+    return jnp.mod(d_iota + seat_epoch[:, None], S)
+
+
+def tick(
+    cfg: BatchedFasterPaxosConfig,
+    state: BatchedFasterPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedFasterPaxosState:
+    G, D, W = cfg.num_groups, cfg.num_delegates, cfg.window
+    A = S = cfg.num_servers
+    f = cfg.f
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    d_iota = jnp.arange(D, dtype=jnp.int32)
+
+    k4, k2, k1, kg = jax.random.split(key, 4)
+    bits4 = jax.random.bits(k4, (A, G, D, W))  # [0:8) fwd, [8:16) bwd,
+    #                                  [16:24) retry, [24:32) drop
+    bits2 = jax.random.bits(k2, (G, D, W))  # [0:8) replica lat
+    bits1 = jax.random.bits(k1, (S, G))  # [0:8) fail, [8:16) revive
+    bitsg = jax.random.bits(kg, (A, G))  # [0:8) p1a, [8:16) p1b lat
+    fwd_lat = bit_latency(bits4, 0, cfg.lat_min, cfg.lat_max)
+    bwd_lat = bit_latency(bits4, 8, cfg.lat_min, cfg.lat_max)
+    retry_lat = bit_latency(bits4, 16, cfg.lat_min, cfg.lat_max)
+    rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
+    p1a_lat = bit_latency(bitsg, 0, cfg.lat_min, cfg.lat_max)
+    p1b_lat = bit_latency(bitsg, 8, cfg.lat_min, cfg.lat_max)
+    delivered = bit_delivered(bits4, 24, cfg.drop_rate)
+
+    status = state.status
+    chosen_value = state.chosen_value
+
+    # ---- 0. Server liveness churn.
+    die = state.server_alive & ~bit_delivered(bits1, 0, cfg.fail_rate)
+    revive = ~state.server_alive & ~bit_delivered(bits1, 8, cfg.revive_rate)
+    server_alive = (state.server_alive & ~die) | revive
+    deaths = state.deaths + jnp.sum(die)
+
+    # ---- 1. Acceptors vote on Phase2as carrying a round >= their
+    # group promise (stale-round stragglers from before a leader change
+    # are rejected — Server.scala's round checks).
+    p2a_now = state.p2a_arrival == t
+    may_vote = p2a_now & (
+        state.p2a_round >= state.acc_round[:, :, None, None]
+    )
+    vote_round = jnp.where(may_vote, state.p2a_round, state.vote_round)
+    p2b_arrival = jnp.where(may_vote, t + bwd_lat, state.p2b_arrival)
+    p2a_arrival = jnp.where(p2a_now, INF, state.p2a_arrival)
+
+    # ---- 2. Choose: f+1 current-round Phase2bs.
+    n_votes = jnp.sum(
+        (p2b_arrival <= t)
+        & (vote_round == state.round[None, :, None, None]),
+        axis=0,
+    )
+    newly_chosen = (
+        (status == PROPOSED)
+        & (state.phase == PH_NORMAL)[:, None, None]
+        & (n_votes >= f + 1)
+    )
+    choose_violations = state.choose_violations + jnp.sum(
+        newly_chosen
+        & (chosen_value != NO_VALUE)
+        & (chosen_value != state.slot_value)
+    )
+    chosen_value = jnp.where(
+        newly_chosen & (chosen_value == NO_VALUE),
+        state.slot_value,
+        chosen_value,
+    )
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    replica_arrival = jnp.where(
+        newly_chosen, t + rep_lat, state.replica_arrival
+    )
+    real_chosen = newly_chosen & (state.slot_value != NOOP_VALUE)
+    latency = jnp.where(real_chosen, t - state.propose_tick, 0)
+    committed = state.committed + jnp.sum(newly_chosen)
+    committed_real = state.committed_real + jnp.sum(real_chosen)
+    lat_sum = state.lat_sum + jnp.sum(latency)
+    bins = jnp.clip(latency, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        real_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 3. Per-group execution watermark (min over seats of the
+    # stripe formula) + retire.
+    pos_of_ord = jnp.mod(state.head[:, :, None] + w_iota[None, None, :], W)
+    ord_of_pos = state.head[:, :, None] + w_iota[None, None, :]
+    chosen_ord = (
+        jnp.take_along_axis(status, pos_of_ord, axis=2) == CHOSEN
+    ) & (ord_of_pos < state.next_ord[:, :, None])
+    n_contig = jnp.sum(
+        jnp.cumprod(chosen_ord.astype(jnp.int32), axis=2), axis=2
+    )  # [G, D]
+    prefix = state.head + n_contig
+    group_wm = jnp.min(prefix * D + d_iota[None, :], axis=1)  # [G]
+    arrival_ord = jnp.take_along_axis(replica_arrival, pos_of_ord, axis=2)
+    global_of_ord = ord_of_pos * D + d_iota[None, :, None]
+    retire_ord = (
+        chosen_ord
+        & (arrival_ord <= t)
+        & (global_of_ord < group_wm[:, None, None])
+    )
+    GD = G * D
+    n_retire, retire_mask = ring_retire(
+        retire_ord.reshape(GD, W), state.head.reshape(GD)
+    )
+    head = state.head + n_retire.reshape(G, D)
+    retire_mask = retire_mask.reshape(G, D, W)
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    slot_value = jnp.where(retire_mask, NO_VALUE, state.slot_value)
+    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
+    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
+    last_send = jnp.where(retire_mask, INF, state.last_send)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    clear4 = retire_mask[None, :, :, :]
+    vote_round = jnp.where(clear4, -1, vote_round)
+    p2a_arrival = jnp.where(clear4, INF, p2a_arrival)
+    p2b_arrival = jnp.where(clear4, INF, p2b_arrival)
+
+    # ---- 4. Dead-seat detection -> leader change (Server.scala:
+    # 497-530 leaderChangeTimer): when a seat's server has been dead for
+    # detect_timeout ticks, bump the round, start phase 1, and rotate
+    # the seating until every seat lands on a live server.
+    seat_server = _seat_server(cfg, state.seat_epoch)  # [G, D]
+    seat_alive = jnp.take_along_axis(
+        server_alive.T, seat_server, axis=1
+    )  # [G, D]
+    any_dead = ~jnp.all(seat_alive, axis=1)  # [G]
+    dead_ticks = jnp.where(
+        any_dead & (state.phase == PH_NORMAL), state.dead_ticks + 1, 0
+    )
+    start_lc = dead_ticks >= cfg.detect_timeout
+    # New seating: try successive rotations; pick the first (cyclic)
+    # rotation whose seats are all alive. With S = 2f+1 servers, D = f+1
+    # seats and at most f dead, some rotation works; if none (transient
+    # mass failure), keep rotating next time.
+    def seating_ok(epoch):
+        srv = jnp.mod(
+            d_iota[None, :] + epoch[:, None], S
+        )
+        return jnp.all(
+            jnp.take_along_axis(server_alive.T, srv, axis=1), axis=1
+        )
+
+    new_epoch = state.seat_epoch
+    chosen_rotation = jnp.zeros((G,), bool)
+    for shift in range(1, S + 1):
+        cand = state.seat_epoch + shift
+        ok = seating_ok(cand) & ~chosen_rotation
+        new_epoch = jnp.where(ok, cand, new_epoch)
+        chosen_rotation = chosen_rotation | ok
+    seat_epoch = jnp.where(start_lc, new_epoch, state.seat_epoch)
+    round_ = jnp.where(start_lc, state.round + 1, state.round)
+    phase = jnp.where(start_lc, PH_P1, state.phase)
+    leader_changes = state.leader_changes + jnp.sum(start_lc)
+    dead_ticks = jnp.where(start_lc, 0, dead_ticks)
+    p1a_arrival = jnp.where(
+        start_lc[None, :], t + p1a_lat, state.p1a_arrival
+    )
+
+    # ---- 5. Phase 1: acceptors promise the new round; f+1 Phase1bs
+    # complete it. Repair is full-information (see module docstring):
+    # every in-flight PROPOSED slot is re-proposed with its ORIGINAL
+    # value in the new round; owned-but-never-proposed stalled slots of
+    # the OLD seating below the group's allocation frontier are
+    # noop-filled (the Recover path for holes).
+    p1a_now = state.p1a_arrival == t
+    acc_round = jnp.maximum(
+        state.acc_round, jnp.where(p1a_now, round_[None, :], 0)
+    )
+    p1b_arrival = jnp.where(p1a_now, t + p1b_lat, state.p1b_arrival)
+    p1a_arrival = jnp.where(p1a_now, INF, p1a_arrival)
+    p1_done = (state.phase == PH_P1) & (
+        jnp.sum(p1b_arrival <= t, axis=0) >= f + 1
+    )
+    phase = jnp.where(p1_done, PH_NORMAL, phase)
+    p1b_arrival = jnp.where(p1_done[None, :], INF, p1b_arrival)
+    # Repair: re-send Phase2as (new round) for in-flight slots.
+    repair = p1_done[:, None, None] & (status == PROPOSED)
+    # Noop-fill holes: seats whose next_ord lags the group's max seat
+    # frontier get their missing ordinals allocated as noops (below the
+    # frontier nothing new will arrive for them — they stall the
+    # watermark otherwise).
+    max_ord = jnp.max(state.next_ord, axis=1)  # [G]
+    lag = jnp.maximum(max_ord[:, None] - state.next_ord, 0)  # [G, D]
+    space = W - (state.next_ord - head)
+    fill = jnp.where(
+        p1_done[:, None], jnp.minimum(lag, space), 0
+    )  # [G, D]
+    delta = jnp.mod(
+        w_iota[None, None, :] - state.next_ord[:, :, None], W
+    )
+    is_fill = delta < fill[:, :, None]
+    next_ord = state.next_ord + fill
+    noop_fills = state.noop_fills + jnp.sum(fill)
+    status = jnp.where(is_fill, PROPOSED, status)
+    slot_value = jnp.where(is_fill, NOOP_VALUE, slot_value)
+    propose_tick = jnp.where(is_fill, t, propose_tick)
+    send_now = repair | is_fill
+    last_send = jnp.where(send_now, t, last_send)
+    p2a_arrival = jnp.where(
+        send_now[None, :, :, :] & delivered, t + fwd_lat, p2a_arrival
+    )
+    p2a_round = jnp.where(
+        send_now[None, :, :, :],
+        round_[None, :, None, None],
+        state.p2a_round,
+    )
+
+    # ---- 6. Delegate proposals (PH_NORMAL, live seats): K owned
+    # ordinals per seat per tick, proposed directly in the current round
+    # (the Phase2aAny grant — no leader hop).
+    seat_server2 = _seat_server(cfg, seat_epoch)
+    seat_alive2 = jnp.take_along_axis(server_alive.T, seat_server2, axis=1)
+    space2 = W - (next_ord - head)
+    can = (
+        (phase == PH_NORMAL)[:, None] & seat_alive2
+    )
+    count = jnp.where(
+        can, jnp.minimum(cfg.slots_per_tick, space2), 0
+    )
+    delta2 = jnp.mod(w_iota[None, None, :] - next_ord[:, :, None], W)
+    is_new = delta2 < count[:, :, None]
+    new_ord = next_ord[:, :, None] + delta2
+    g_ids = jnp.arange(G, dtype=jnp.int32)[:, None, None]
+    new_val = (
+        (new_ord * D + d_iota[None, :, None]) * G + g_ids
+    ) & jnp.int32(0x7FFFFFFF)
+    next_ord = next_ord + count
+    status = jnp.where(is_new, PROPOSED, status)
+    slot_value = jnp.where(is_new, new_val, slot_value)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    p2a_arrival = jnp.where(
+        is_new[None, :, :, :] & delivered, t + fwd_lat, p2a_arrival
+    )
+    p2a_round = jnp.where(
+        is_new[None, :, :, :], round_[None, :, None, None], p2a_round
+    )
+
+    # ---- 7. Retries (live seats, normal phase).
+    timed_out = (
+        (status == PROPOSED)
+        & (phase == PH_NORMAL)[:, None, None]
+        & seat_alive2[:, :, None]
+        & (t - last_send >= cfg.retry_timeout)
+    )
+    p2a_arrival = jnp.where(
+        timed_out[None, :, :, :], t + retry_lat, p2a_arrival
+    )
+    p2a_round = jnp.where(
+        timed_out[None, :, :, :], round_[None, :, None, None], p2a_round
+    )
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return BatchedFasterPaxosState(
+        round=round_,
+        seat_epoch=seat_epoch,
+        phase=phase,
+        dead_ticks=dead_ticks,
+        leader_changes=leader_changes,
+        next_ord=next_ord,
+        head=head,
+        status=status,
+        slot_value=slot_value,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        replica_arrival=replica_arrival,
+        chosen_value=chosen_value,
+        acc_round=acc_round,
+        vote_round=vote_round,
+        p2a_arrival=p2a_arrival,
+        p2a_round=p2a_round,
+        p2b_arrival=p2b_arrival,
+        server_alive=server_alive,
+        p1a_arrival=p1a_arrival,
+        p1b_arrival=p1b_arrival,
+        committed=committed,
+        committed_real=committed_real,
+        group_wm=jnp.maximum(state.group_wm, group_wm),
+        noop_fills=noop_fills,
+        deaths=deaths,
+        choose_violations=choose_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedFasterPaxosConfig,
+    state: BatchedFasterPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedFasterPaxosState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedFasterPaxosConfig, state: BatchedFasterPaxosState, t
+) -> dict:
+    # THE delegate-repartitioning safety property: a chosen slot's value
+    # never changes across leader changes.
+    choose_once = state.choose_violations == 0
+    window_ok = jnp.all(
+        (state.head <= state.next_ord)
+        & (state.next_ord - state.head <= cfg.window)
+    )
+    # Acceptor promises never fall behind the group round the leader
+    # reached phase-2 in... (promises are bumped by phase 1; during PH_P1
+    # some acceptors may still lag).
+    round_ok = jnp.all(
+        jnp.where(
+            state.phase == PH_NORMAL,
+            jnp.max(state.acc_round, axis=0) >= state.round,
+            True,
+        )
+    )
+    # Votes only in rounds the group actually ran.
+    vote_ok = jnp.all(state.vote_round <= state.round[None, :, None, None])
+    books_ok = state.committed_real <= state.committed
+    return {
+        "choose_once": choose_once,
+        "window_ok": window_ok,
+        "round_ok": round_ok,
+        "vote_ok": vote_ok,
+        "books_ok": books_ok,
+    }
+
+
+def stats(
+    cfg: BatchedFasterPaxosConfig, state: BatchedFasterPaxosState, t
+) -> dict:
+    real = int(state.committed_real)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (real + 1) // 2)).argmax())
+        if real
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "committed": int(state.committed),
+        "committed_real": real,
+        "executed_global": int(jax.device_get(state.group_wm).sum()),
+        "leader_changes": int(state.leader_changes),
+        "noop_fills": int(state.noop_fills),
+        "deaths": int(state.deaths),
+        "choose_violations": int(state.choose_violations),
+        "latency_p50_ticks": p50,
+    }

@@ -1,0 +1,507 @@
+"""Batched Fast MultiPaxos as a single XLA program: LOG-STRUCTURED fast
+rounds (reference ``fastmultipaxos/Acceptor.scala:183-238`` — every
+acceptor keeps its OWN ``nextSlot`` and votes arriving client commands
+into it directly; ``Leader.scala:545, 721-730`` — a fast quorum of
+identical votes per slot chooses, conflicts resolve by the O4
+popular-items rule in a classic round; per-actor analog
+``protocols/fastmultipaxos.py``).
+
+This differs from single-decree ``fastpaxos_batched.py`` exactly where
+the reference family differs: the fast path here is a LOG — clients
+broadcast commands straight to the acceptors, each acceptor appends to
+its own next free slot in arrival order, and the SAME command can land
+in DIFFERENT slots at different acceptors (arrival-order divergence is
+the conflict source). A slot whose full acceptor census is visible
+without a fast quorum goes to classic recovery; a command whose votes
+all lost their slots is re-broadcast by its client (and may then be
+chosen twice — the execution layer dedups, counted here as ``dups``).
+
+TPU-first layout: [G] groups, [G, W] slot rings, [A, G, W] per-acceptor
+vote state (dense: acceptor ``a`` voted EVERY slot below its
+``acc_next[a]``), [G, CW] client-command rings with [A, G, CW]
+broadcast arrival arrays. The fast-committed ledger records any value
+that ever held a fast quorum of slot votes; choices must never
+contradict it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_latency,
+    ring_retire,
+)
+
+# Slot status.
+S_OPEN = 0
+S_RECOVER = 1  # classic round in flight
+S_CHOSEN = 2
+
+# Command status.
+C_EMPTY = 0
+C_PENDING = 1
+C_CHOSEN = 2
+
+NO_VALUE = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedFastMultiPaxosConfig:
+    f: int = 1
+    num_groups: int = 8  # G
+    window: int = 32  # W: slot ring capacity
+    cmd_window: int = 32  # CW: in-flight client commands per group
+    cmds_per_tick: int = 2  # K: new client commands per group per tick
+    lat_min: int = 1
+    lat_max: int = 3
+    # Extra per-acceptor arrival jitter (0..jitter ticks, uniform): the
+    # arrival-order divergence that creates slot conflicts.
+    jitter: int = 2
+    recovery_timeout: int = 10  # slot age before timeout-based recovery
+    retry_timeout: int = 12  # command re-broadcast period
+
+    @property
+    def n(self) -> int:
+        return 2 * self.f + 1
+
+    @property
+    def quorum_majority(self) -> int:
+        return (self.f + 1) // 2 + 1
+
+    @property
+    def fast_quorum(self) -> int:
+        return self.f + self.quorum_majority
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.window >= 4
+        assert self.cmd_window >= 2 * self.cmds_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert self.jitter >= 0
+        assert self.recovery_timeout >= 2 * (self.lat_max + self.jitter)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedFastMultiPaxosState:
+    """Shapes: [G] groups, [G, W] slots, [A, G, W] votes, [G, CW] cmds."""
+
+    head: jnp.ndarray  # [G] lowest non-retired slot
+    acc_next: jnp.ndarray  # [A, G] each acceptor's nextSlot
+    cmd_seq: jnp.ndarray  # [G] next command id (global = seq * G + g)
+
+    # Slots.
+    status: jnp.ndarray  # [G, W] S_*
+    open_tick: jnp.ndarray  # [G, W] first visible vote tick (INF)
+    chosen_value: jnp.ndarray  # [G, W]
+    replica_arrival: jnp.ndarray  # [G, W]
+    fast_committed: jnp.ndarray  # [G, W] ledger: value with an FQ of votes
+
+    # Acceptor votes (dense below acc_next; ring-indexed by slot % W).
+    vote_value: jnp.ndarray  # [A, G, W] fast-round vote (NO_VALUE none)
+    vote_seen: jnp.ndarray  # [A, G, W] tick the leader sees the vote (INF)
+    # Classic recovery round (round 1).
+    rv_value: jnp.ndarray  # [G, W] value the classic round proposes
+    rv_p2a_arrival: jnp.ndarray  # [A, G, W]
+    rv_p2b_arrival: jnp.ndarray  # [A, G, W]
+    rv_voted: jnp.ndarray  # [A, G, W]
+
+    # Client commands.
+    cmd_status: jnp.ndarray  # [G, CW] C_*
+    cmd_id: jnp.ndarray  # [G, CW] command id (-1)
+    cmd_issue: jnp.ndarray  # [G, CW] first broadcast tick
+    cmd_last_send: jnp.ndarray  # [G, CW]
+    cmd_arrival: jnp.ndarray  # [A, G, CW] broadcast arrival (INF)
+    cmd_done_at: jnp.ndarray  # [G, CW] reply arrival after choose (INF)
+
+    committed_slots: jnp.ndarray  # [] slots chosen
+    fast_chosen: jnp.ndarray  # [] slots chosen on the fast path
+    recoveries: jnp.ndarray  # [] classic recoveries started
+    cmds_done: jnp.ndarray  # [] commands completed
+    dups: jnp.ndarray  # [] commands chosen in more than one slot
+    dropped_votes: jnp.ndarray  # [] acceptor-side ring backpressure
+    safety_violations: jnp.ndarray  # [] choice contradicted the ledger
+    lat_sum: jnp.ndarray  # [] command issue -> done
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(
+    cfg: BatchedFastMultiPaxosConfig,
+) -> BatchedFastMultiPaxosState:
+    G, W, CW, A = cfg.num_groups, cfg.window, cfg.cmd_window, cfg.n
+    return BatchedFastMultiPaxosState(
+        head=jnp.zeros((G,), jnp.int32),
+        acc_next=jnp.zeros((A, G), jnp.int32),
+        cmd_seq=jnp.zeros((G,), jnp.int32),
+        status=jnp.zeros((G, W), jnp.int32),
+        open_tick=jnp.full((G, W), INF, jnp.int32),
+        chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        replica_arrival=jnp.full((G, W), INF, jnp.int32),
+        fast_committed=jnp.full((G, W), NO_VALUE, jnp.int32),
+        vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
+        vote_seen=jnp.full((A, G, W), INF, jnp.int32),
+        rv_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        rv_p2a_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        rv_p2b_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        rv_voted=jnp.zeros((A, G, W), bool),
+        cmd_status=jnp.zeros((G, CW), jnp.int32),
+        cmd_id=jnp.full((G, CW), -1, jnp.int32),
+        cmd_issue=jnp.full((G, CW), INF, jnp.int32),
+        cmd_last_send=jnp.full((G, CW), INF, jnp.int32),
+        cmd_arrival=jnp.full((A, G, CW), INF, jnp.int32),
+        cmd_done_at=jnp.full((G, CW), INF, jnp.int32),
+        committed_slots=jnp.zeros((), jnp.int32),
+        fast_chosen=jnp.zeros((), jnp.int32),
+        recoveries=jnp.zeros((), jnp.int32),
+        cmds_done=jnp.zeros((), jnp.int32),
+        dups=jnp.zeros((), jnp.int32),
+        dropped_votes=jnp.zeros((), jnp.int32),
+        safety_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def tick(
+    cfg: BatchedFastMultiPaxosConfig,
+    state: BatchedFastMultiPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedFastMultiPaxosState:
+    G, W, CW, A = cfg.num_groups, cfg.window, cfg.cmd_window, cfg.n
+    f = cfg.f
+    FQ, MAJ = cfg.fast_quorum, cfg.quorum_majority
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    a_iota = jnp.arange(A, dtype=jnp.int32)
+
+    k3, k2 = jax.random.split(key)
+    bits3 = jax.random.bits(k3, (A, G, CW))  # [0:8) bcast lat,
+    #                                [8:16) jitter, [16:24) seen lat
+    bits2 = jax.random.bits(k2, (G, W))  # [0:8) rv lat, [8:16) reply lat
+    bcast_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    jit_lat = (
+        ((bits3 >> 8) & jnp.uint32(0xFF)).astype(jnp.int32)
+        % (cfg.jitter + 1)
+        if cfg.jitter
+        else jnp.zeros((A, G, CW), jnp.int32)
+    )
+    seen_lat_c = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    rv_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
+    reply_lat = bit_latency(bits2, 8, cfg.lat_min, cfg.lat_max)
+
+    status = state.status
+    vote_value = state.vote_value
+    vote_seen = state.vote_seen
+
+    # ---- 1. Acceptors append pending command arrivals to their own
+    # nextSlot in command-ring order (Acceptor.scala:229-238). Ring
+    # backpressure: an acceptor whose nextSlot would overrun head + W
+    # defers the arrival (it stays pending).
+    pending = state.cmd_arrival <= t  # [A, G, CW]
+    rank = jnp.cumsum(pending.astype(jnp.int32), axis=2)  # arrival order
+    room = jnp.maximum(
+        state.head[None, :] + W - state.acc_next, 0
+    )  # [A, G]
+    take = pending & (rank <= room[:, :, None])
+    slot_of = state.acc_next[:, :, None] + rank - 1  # [A, G, CW]
+    dropped_votes = state.dropped_votes + jnp.sum(pending & ~take)
+    # Scatter each taken command's id into the acceptor's vote arrays.
+    aa = jnp.broadcast_to(a_iota[:, None, None], (A, G, CW))
+    gg = jnp.broadcast_to(jnp.arange(G)[None, :, None], (A, G, CW))
+    ss = jnp.where(take, jnp.mod(slot_of, W), W)  # W = out of range
+    cmd_ids3 = jnp.broadcast_to(state.cmd_id[None, :, :], (A, G, CW))
+    vote_value = vote_value.at[aa, gg, ss].set(
+        jnp.where(take, cmd_ids3, NO_VALUE), mode="drop"
+    )
+    vote_seen = vote_seen.at[aa, gg, ss].set(
+        jnp.where(take, t + seen_lat_c, INF), mode="drop"
+    )
+    acc_next = state.acc_next + jnp.sum(take, axis=2)
+    cmd_arrival = jnp.where(take, INF, state.cmd_arrival)
+
+    # ---- 2. Leader observes votes per slot. A slot EXISTS once any
+    # acceptor's vote is visible; census = votes visible among acceptors
+    # whose nextSlot passed the slot.
+    visible = vote_seen <= t  # [A, G, W]
+    n_visible = jnp.sum(visible, axis=0)
+    open_tick = jnp.where(
+        (state.open_tick == INF) & (n_visible > 0) & (status == S_OPEN),
+        t,
+        state.open_tick,
+    )
+    # Pairwise same-value counts (A is tiny).
+    same = (
+        (vote_value[:, None] == vote_value[None, :])
+        & (vote_value[None, :] != NO_VALUE)
+        & visible[:, None]
+        & visible[None, :]
+    )  # [A, A, G, W]
+    match_count = jnp.sum(same, axis=1)  # [A, G, W] per acceptor's value
+    best_count = jnp.max(match_count, axis=0)  # [G, W]
+    best_a = jnp.argmax(match_count, axis=0)  # [G, W]
+    best_value = jnp.take_along_axis(
+        vote_value, best_a[None, :, :], axis=0
+    )[0]  # [G, W]
+
+    # Fast-committed ledger (unobserved quorums included): a value with
+    # FQ actual votes, visible or not.
+    same_all = (
+        (vote_value[:, None] == vote_value[None, :])
+        & (vote_value[None, :] != NO_VALUE)
+    )
+    full_count = jnp.max(jnp.sum(same_all, axis=1), axis=0)
+    full_a = jnp.argmax(jnp.sum(same_all, axis=1), axis=0)
+    full_value = jnp.take_along_axis(
+        vote_value, full_a[None, :, :], axis=0
+    )[0]
+    fast_committed = jnp.where(
+        (state.fast_committed == NO_VALUE) & (full_count >= FQ),
+        full_value,
+        state.fast_committed,
+    )
+
+    # (a) Fast choose: FQ identical visible votes.
+    fast_ok = (status == S_OPEN) & (best_count >= FQ)
+    # (b) Recovery trigger: full census visible with no fast quorum, or
+    # the slot timed out (Leader.scala phase2b waiting logic).
+    census_full = n_visible >= A
+    # Timeout recovery additionally needs a QUORUM of the census visible
+    # (n_visible >= A - f): that guarantees at least quorum_majority
+    # votes of any unobserved fast-committed value are visible, so the
+    # O4 argmax below cannot contradict it.
+    timed_out = (
+        (open_tick < INF)
+        & (t - open_tick >= cfg.recovery_timeout)
+        & (n_visible >= A - f)
+    )
+    start_rec = (
+        (status == S_OPEN) & ~fast_ok & (census_full | timed_out)
+    )
+    # O4: a popular value (>= MAJ among visible votes) must be picked;
+    # best_count >= MAJ implies best_value is it (a fast-committed value
+    # dominates all others). With no votes visible... recovery only
+    # starts when votes exist (open_tick set), so best_value is real.
+    rv_value = jnp.where(start_rec, best_value, state.rv_value)
+    status = jnp.where(start_rec, S_RECOVER, status)
+    recoveries = state.recoveries + jnp.sum(start_rec)
+    rv_p2a_arrival = jnp.where(
+        start_rec[None, :, :],
+        t + jnp.broadcast_to(rv_lat[None], (A, G, W)),
+        state.rv_p2a_arrival,
+    )
+
+    # ---- 3. Classic round at acceptors + choose.
+    rv_now = rv_p2a_arrival == t
+    rv_voted = state.rv_voted | rv_now
+    rv_p2b_arrival = jnp.where(rv_now, t + rv_lat[None], state.rv_p2b_arrival)
+    rv_p2a_arrival = jnp.where(rv_now, INF, rv_p2a_arrival)
+    n_rv = jnp.sum(rv_voted & (rv_p2b_arrival <= t), axis=0)
+    rec_ok = (status == S_RECOVER) & (n_rv >= f + 1)
+
+    newly_chosen = fast_ok | rec_ok
+    value_now = jnp.where(fast_ok, best_value, state.rv_value)
+    safety_violations = state.safety_violations + jnp.sum(
+        newly_chosen
+        & (fast_committed != NO_VALUE)
+        & (value_now != fast_committed)
+    )
+    chosen_value = jnp.where(newly_chosen, value_now, state.chosen_value)
+    status = jnp.where(newly_chosen, S_CHOSEN, status)
+    replica_arrival = jnp.where(
+        newly_chosen, t + reply_lat, state.replica_arrival
+    )
+    committed_slots = state.committed_slots + jnp.sum(newly_chosen)
+    fast_chosen = state.fast_chosen + jnp.sum(fast_ok)
+
+    # ---- 4. Command completion: a chosen slot completes its command
+    # (value id -> command ring position = id // G mod CW; id = seq*G+g).
+    # A second choose of the SAME id is a dup (client retry chosen
+    # twice — the execution layer dedups; Leader repeated_commands).
+    # For each command ring position, was it chosen this tick?
+    hit = (
+        newly_chosen[:, :, None]
+        & (chosen_value[:, :, None] == state.cmd_id[:, None, :])
+    )  # [G, W, CW]
+    chosen_cmd = jnp.any(hit, axis=1)  # [G, CW]
+    was_pending = state.cmd_status == C_PENDING
+    newly_done = chosen_cmd & was_pending
+    dups = state.dups + jnp.sum(
+        chosen_cmd & (state.cmd_status == C_CHOSEN)
+    )
+    cmd_reply_lat = bit_latency(bits3[0], 24, cfg.lat_min, cfg.lat_max)
+    cmd_status = jnp.where(newly_done, C_CHOSEN, state.cmd_status)
+    cmd_done_at = jnp.where(newly_done, t + cmd_reply_lat, state.cmd_done_at)
+    done_now = (cmd_status == C_CHOSEN) & (state.cmd_done_at <= t)
+    cmds_done = state.cmds_done + jnp.sum(done_now)
+    lat = jnp.where(done_now, t - state.cmd_issue, 0)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        done_now.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+    cmd_status = jnp.where(done_now, C_EMPTY, cmd_status)
+    cmd_id = jnp.where(done_now, -1, state.cmd_id)
+    cmd_issue = jnp.where(done_now, INF, state.cmd_issue)
+    cmd_last_send = jnp.where(done_now, INF, state.cmd_last_send)
+    cmd_done_at = jnp.where(done_now, INF, cmd_done_at)
+    cmd_arrival = jnp.where(done_now[None, :, :], INF, cmd_arrival)
+
+    # ---- 5. Retire the contiguous chosen prefix (all acceptor votes
+    # and recovery state cleared; acc_next never decreases).
+    pos_of_ord = jnp.mod(state.head[:, None] + w_iota[None, :], W)
+    chosen_ord = (
+        jnp.take_along_axis(status, pos_of_ord, axis=1) == S_CHOSEN
+    ) & (
+        jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1) <= t
+    )
+    n_retire, retire_mask = ring_retire(chosen_ord, state.head)
+    head = state.head + n_retire
+    status = jnp.where(retire_mask, S_OPEN, status)
+    open_tick = jnp.where(retire_mask, INF, open_tick)
+    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    fast_committed = jnp.where(retire_mask, NO_VALUE, fast_committed)
+    rv_value = jnp.where(retire_mask, NO_VALUE, rv_value)
+    clear3 = retire_mask[None, :, :]
+    vote_value = jnp.where(clear3, NO_VALUE, vote_value)
+    vote_seen = jnp.where(clear3, INF, vote_seen)
+    rv_p2a_arrival = jnp.where(clear3, INF, rv_p2a_arrival)
+    rv_p2b_arrival = jnp.where(clear3, INF, rv_p2b_arrival)
+    rv_voted = jnp.where(clear3, False, rv_voted)
+
+    # ---- 6. New client commands (K per group into free ring slots) +
+    # retries of long-pending commands (re-broadcast; the retry may be
+    # chosen in a second slot — the dup path).
+    empty = cmd_status == C_EMPTY
+    crank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
+    is_new = empty & (crank <= cfg.cmds_per_tick)
+    n_new = jnp.sum(is_new, axis=1)
+    new_id = (state.cmd_seq[:, None] + crank - 1) * G + jnp.arange(
+        G, dtype=jnp.int32
+    )[:, None]
+    cmd_seq = state.cmd_seq + n_new
+    cmd_status = jnp.where(is_new, C_PENDING, cmd_status)
+    cmd_id = jnp.where(is_new, new_id, cmd_id)
+    cmd_issue = jnp.where(is_new, t, cmd_issue)
+    retry = (
+        (cmd_status == C_PENDING)
+        & ~is_new
+        & (t - cmd_last_send >= cfg.retry_timeout)
+    )
+    send = is_new | retry
+    cmd_last_send = jnp.where(send, t, cmd_last_send)
+    cmd_arrival = jnp.where(
+        send[None, :, :], t + bcast_lat + jit_lat, cmd_arrival
+    )
+
+    return BatchedFastMultiPaxosState(
+        head=head,
+        acc_next=acc_next,
+        cmd_seq=cmd_seq,
+        status=status,
+        open_tick=open_tick,
+        chosen_value=chosen_value,
+        replica_arrival=replica_arrival,
+        fast_committed=fast_committed,
+        vote_value=vote_value,
+        vote_seen=vote_seen,
+        rv_value=rv_value,
+        rv_p2a_arrival=rv_p2a_arrival,
+        rv_p2b_arrival=rv_p2b_arrival,
+        rv_voted=rv_voted,
+        cmd_status=cmd_status,
+        cmd_id=cmd_id,
+        cmd_issue=cmd_issue,
+        cmd_last_send=cmd_last_send,
+        cmd_arrival=cmd_arrival,
+        cmd_done_at=cmd_done_at,
+        committed_slots=committed_slots,
+        fast_chosen=fast_chosen,
+        recoveries=recoveries,
+        cmds_done=cmds_done,
+        dups=dups,
+        dropped_votes=dropped_votes,
+        safety_violations=safety_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedFastMultiPaxosConfig,
+    state: BatchedFastMultiPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedFastMultiPaxosState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedFastMultiPaxosConfig,
+    state: BatchedFastMultiPaxosState,
+    t,
+) -> dict:
+    # THE Fast MultiPaxos safety property: a value that ever held a fast
+    # quorum of votes in a slot is the only choosable value there.
+    safety_ok = state.safety_violations == 0
+    # Acceptors fill densely: nextSlot never exceeds head + W.
+    window_ok = jnp.all(
+        (state.acc_next >= state.head[None, :])
+        & (state.acc_next - state.head[None, :] <= cfg.window)
+    )
+    # Chosen slots carry a real command id.
+    chosen = state.status == S_CHOSEN
+    value_ok = jnp.all(
+        jnp.where(chosen, state.chosen_value != NO_VALUE, True)
+    )
+    books_ok = (state.fast_chosen <= state.committed_slots) & (
+        state.cmds_done <= state.committed_slots
+    )
+    return {
+        "safety_ok": safety_ok,
+        "window_ok": window_ok,
+        "value_ok": value_ok,
+        "books_ok": books_ok,
+    }
+
+
+def stats(
+    cfg: BatchedFastMultiPaxosConfig,
+    state: BatchedFastMultiPaxosState,
+    t,
+) -> dict:
+    done = int(state.cmds_done)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (done + 1) // 2)).argmax())
+        if done
+        else -1
+    )
+    committed = int(state.committed_slots)
+    return {
+        "ticks": int(t),
+        "committed_slots": committed,
+        "fast_fraction": int(state.fast_chosen) / max(1, committed),
+        "recoveries": int(state.recoveries),
+        "cmds_done": done,
+        "dups": int(state.dups),
+        "dropped_votes": int(state.dropped_votes),
+        "safety_violations": int(state.safety_violations),
+        "cmd_latency_p50_ticks": p50,
+    }

@@ -1,0 +1,527 @@
+"""Batched Vanilla Mencius as a single XLA program: the REVOCATION
+mechanic (reference ``vanillamencius/Server.scala`` — a live server
+revokes a dead peer's owned slots by running full Paxos at a higher
+round on them; per-actor analog ``protocols/vanillamencius.py``).
+
+Mencius stripes one global log round-robin over ``L`` servers. Plain
+Mencius lets a LIVE laggard noop-fill its own stripe (skips,
+``mencius_batched.py``); Vanilla Mencius's defining extra is what
+happens when the owner is DEAD: it cannot skip, its stripe pins the
+global execution watermark, and a live peer must take the owner's slots
+away — phase 1 at round 1 against the stripe's acceptor group, then
+phase 2 proposing the SAFE value (the owner's value if phase 1 reveals
+a round-0 vote — the owner may have gotten a quorum before dying — else
+a noop). A promise at round 1 makes acceptors reject the dead owner's
+straggling round-0 Phase2as, which is the safety teeth of the
+mechanism.
+
+TPU-first layout mirrors ``mencius_batched.py``: [L] stripes, [L, W]
+owned-slot rings, [L, W, A] per-acceptor arrays, global watermark =
+min over stripes of (contiguous prefix * L + l). Revocation state rides
+the same ring (rv_phase/rv_value + phase-1/2 message arrays). The
+choose-once ledger counts any slot chosen twice with different values —
+the invariant revocation must preserve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    LAT_BINS,
+    bit_delivered,
+    bit_latency,
+    ring_retire,
+)
+
+EMPTY = 0
+PROPOSED = 1  # owner's round-0 proposal in flight
+CHOSEN = 2
+
+# Revocation phase (independent of status: revocation may target both
+# EMPTY owned slots — claimed fresh — and PROPOSED-but-unchosen ones).
+RV_NONE = 0
+RV_P1 = 1  # round-1 Phase1a in flight
+RV_P2 = 2  # round-1 Phase2a in flight
+
+NO_VALUE = -1
+NOOP_VALUE = -2
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedVanillaMenciusConfig:
+    """Static simulation parameters. Each stripe has its own
+    2f+1-acceptor group; servers die/revive by PRNG."""
+
+    f: int = 1
+    num_servers: int = 4  # L: stripes of the global log
+    window: int = 32  # W: in-flight owned slots per stripe
+    slots_per_tick: int = 2  # K: proposals per LIVE server per tick
+    lat_min: int = 1
+    lat_max: int = 3
+    drop_rate: float = 0.0
+    retry_timeout: int = 16
+    fail_rate: float = 0.0  # per-server per-tick death probability
+    revive_rate: float = 0.05
+    # A dead stripe lagging the fastest frontier by more than this many
+    # owned slots gets revoked by a live peer (Server.scala revocation).
+    revoke_threshold: int = 8
+    revoke_slots_per_tick: int = 8  # revocation batch per stripe per tick
+
+    @property
+    def group_size(self) -> int:
+        return 2 * self.f + 1
+
+    def __post_init__(self):
+        assert self.f >= 1
+        assert self.num_servers >= 2
+        assert self.window >= 2 * self.slots_per_tick
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.drop_rate < 1.0
+        assert self.revoke_threshold >= 1
+        assert self.revoke_slots_per_tick >= 1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedVanillaMenciusState:
+    """Shapes: [L] stripes, [L, W] owned-slot rings, [L, W, A] votes."""
+
+    next_slot: jnp.ndarray  # [L] next OWNED ordinal (global = o*L + l)
+    head: jnp.ndarray  # [L] lowest non-retired owned ordinal
+
+    status: jnp.ndarray  # [L, W]
+    slot_value: jnp.ndarray  # [L, W] proposed/chosen value (NO/NOOP)
+    propose_tick: jnp.ndarray  # [L, W]
+    last_send: jnp.ndarray  # [L, W]
+    replica_arrival: jnp.ndarray  # [L, W]
+    chosen_value: jnp.ndarray  # [L, W] value actually chosen (ledger)
+    committed_prefix: jnp.ndarray  # [L]
+
+    # Acceptors (per slot): promised round + round-0 vote state.
+    acc_round: jnp.ndarray  # [L, W, A] 0 = owner round, 1 = revoked
+    voted: jnp.ndarray  # [L, W, A] voted in round 0 (owner value)
+    voted_r1: jnp.ndarray  # [L, W, A] voted in round 1 (rv_value)
+    p2a_arrival: jnp.ndarray  # [L, W, A] owner round-0 Phase2a
+    p2b_arrival: jnp.ndarray  # [L, W, A] round-0 Phase2b to owner
+
+    # Revocation machinery (round 1).
+    alive: jnp.ndarray  # [L] server liveness
+    rv_phase: jnp.ndarray  # [L, W] RV_*
+    rv_value: jnp.ndarray  # [L, W] value round 1 proposes (after p1)
+    rv_p1a_arrival: jnp.ndarray  # [L, W, A]
+    rv_p1b_arrival: jnp.ndarray  # [L, W, A]
+    rv_p1b_voted: jnp.ndarray  # [L, W, A] p1b reports a round-0 vote
+    rv_p2a_arrival: jnp.ndarray  # [L, W, A]
+    rv_p2b_arrival: jnp.ndarray  # [L, W, A]
+
+    executed_global: jnp.ndarray  # []
+    committed: jnp.ndarray  # [] chosen slots (all)
+    committed_real: jnp.ndarray  # [] chosen real commands
+    revocations: jnp.ndarray  # [] slots revocation claimed
+    revoked_discovered: jnp.ndarray  # [] revocations that found a vote
+    deaths: jnp.ndarray  # []
+    choose_violations: jnp.ndarray  # [] slot re-chosen with a new value
+    lat_sum: jnp.ndarray  # []
+    lat_hist: jnp.ndarray  # [LAT_BINS]
+
+
+def init_state(
+    cfg: BatchedVanillaMenciusConfig,
+) -> BatchedVanillaMenciusState:
+    L, W, A = cfg.num_servers, cfg.window, cfg.group_size
+    return BatchedVanillaMenciusState(
+        next_slot=jnp.zeros((L,), jnp.int32),
+        head=jnp.zeros((L,), jnp.int32),
+        status=jnp.zeros((L, W), jnp.int32),
+        slot_value=jnp.full((L, W), NO_VALUE, jnp.int32),
+        propose_tick=jnp.full((L, W), INF, jnp.int32),
+        last_send=jnp.full((L, W), INF, jnp.int32),
+        replica_arrival=jnp.full((L, W), INF, jnp.int32),
+        chosen_value=jnp.full((L, W), NO_VALUE, jnp.int32),
+        committed_prefix=jnp.zeros((L,), jnp.int32),
+        acc_round=jnp.zeros((L, W, A), jnp.int32),
+        voted=jnp.zeros((L, W, A), bool),
+        voted_r1=jnp.zeros((L, W, A), bool),
+        p2a_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        p2b_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        alive=jnp.ones((L,), bool),
+        rv_phase=jnp.zeros((L, W), jnp.int32),
+        rv_value=jnp.full((L, W), NO_VALUE, jnp.int32),
+        rv_p1a_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        rv_p1b_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        rv_p1b_voted=jnp.zeros((L, W, A), bool),
+        rv_p2a_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        rv_p2b_arrival=jnp.full((L, W, A), INF, jnp.int32),
+        executed_global=jnp.zeros((), jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        committed_real=jnp.zeros((), jnp.int32),
+        revocations=jnp.zeros((), jnp.int32),
+        revoked_discovered=jnp.zeros((), jnp.int32),
+        deaths=jnp.zeros((), jnp.int32),
+        choose_violations=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+    )
+
+
+def _owner_value(ord_, l, L):
+    return (ord_ * L + l) & jnp.int32(0x7FFFFFFF)
+
+
+def tick(
+    cfg: BatchedVanillaMenciusConfig,
+    state: BatchedVanillaMenciusState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedVanillaMenciusState:
+    L, W, A = cfg.num_servers, cfg.window, cfg.group_size
+    f = cfg.f
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    stripe_ids = jnp.arange(L, dtype=jnp.int32)
+
+    k3, k2, k1 = jax.random.split(key, 3)
+    bits3 = jax.random.bits(k3, (L, W, A))  # [0:8) p2a/p1a lat,
+    #                      [8:16) p2b/p1b lat, [16:24) rv lat, [24:32) drop
+    bits2 = jax.random.bits(k2, (L, W))  # [0:8) replica lat
+    bits1 = jax.random.bits(k1, (L,))  # [0:8) fail, [8:16) revive
+    fwd_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    bwd_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
+    rv_lat = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
+    rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
+    delivered = bit_delivered(bits3, 24, cfg.drop_rate)
+
+    status = state.status
+    chosen_value = state.chosen_value
+
+    # ---- 0. Liveness churn (Server failure model; ~bit_delivered(x, p)
+    # is True with probability p — the guarded 8-bit Bernoulli).
+    die = state.alive & ~bit_delivered(bits1, 0, cfg.fail_rate)
+    revive = ~state.alive & ~bit_delivered(bits1, 8, cfg.revive_rate)
+    alive = (state.alive & ~die) | revive
+    deaths = state.deaths + jnp.sum(die)
+
+    # ---- 1. Acceptors. Round-0 Phase2as (owner) vote ONLY if the
+    # acceptor has not promised round 1 (the revocation promise rejects
+    # the dead owner's stragglers — Acceptor round check).
+    p2a_now = state.p2a_arrival == t
+    vote0 = p2a_now & (state.acc_round == 0)
+    voted = state.voted | vote0
+    p2b_arrival = jnp.where(vote0, t + bwd_lat, state.p2b_arrival)
+    p2a_arrival = jnp.where(p2a_now, INF, state.p2a_arrival)
+
+    # Round-1 Phase1as: promise round 1, report any round-0 vote.
+    p1a_now = state.rv_p1a_arrival == t
+    acc_round = jnp.where(p1a_now, 1, state.acc_round)
+    rv_p1b_voted = jnp.where(p1a_now, voted, state.rv_p1b_voted)
+    rv_p1b_arrival = jnp.where(p1a_now, t + bwd_lat, state.rv_p1b_arrival)
+    rv_p1a_arrival = jnp.where(p1a_now, INF, state.rv_p1a_arrival)
+
+    # Round-1 Phase2as: vote (acc_round is already 1 — only sent after
+    # the p1 quorum; a higher-round message also bumps the promise).
+    rv_p2a_now = state.rv_p2a_arrival == t
+    acc_round = jnp.where(rv_p2a_now, 1, acc_round)
+    voted_r1 = state.voted_r1 | rv_p2a_now
+    rv_p2b_arrival = jnp.where(rv_p2a_now, t + bwd_lat, state.rv_p2b_arrival)
+    rv_p2a_arrival = jnp.where(rv_p2a_now, INF, state.rv_p2a_arrival)
+
+    # ---- 2. Choose. Round 0: f+1 round-0 Phase2bs at the owner. The
+    # owner must be ALIVE to count them (a dead owner learns nothing);
+    # the votes still exist at the acceptors — which is exactly what
+    # revocation's phase 1 must discover.
+    n0 = jnp.sum((p2b_arrival <= t) & voted, axis=2)
+    chosen0 = (
+        (status == PROPOSED)
+        & alive[:, None]
+        & (state.rv_phase == RV_NONE)
+        & (n0 >= f + 1)
+    )
+    # Round 1: f+1 round-1 Phase2bs at the revoker.
+    n1 = jnp.sum((rv_p2b_arrival <= t) & voted_r1, axis=2)
+    chosen1 = (state.rv_phase == RV_P2) & (n1 >= f + 1) & (status != CHOSEN)
+    newly_chosen = chosen0 | chosen1
+    value_now = jnp.where(chosen1, state.rv_value, state.slot_value)
+    # Choose-once ledger: a slot re-chosen with a DIFFERENT value is a
+    # safety violation (revocation must have discovered the round-0
+    # choice).
+    choose_violations = state.choose_violations + jnp.sum(
+        newly_chosen
+        & (chosen_value != NO_VALUE)
+        & (chosen_value != value_now)
+    )
+    chosen_value = jnp.where(
+        newly_chosen & (chosen_value == NO_VALUE), value_now, chosen_value
+    )
+    slot_value = jnp.where(chosen1, state.rv_value, state.slot_value)
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    replica_arrival = jnp.where(
+        newly_chosen, t + rep_lat, state.replica_arrival
+    )
+    rv_phase = jnp.where(chosen1, RV_NONE, state.rv_phase)
+
+    real_chosen = newly_chosen & (slot_value != NOOP_VALUE)
+    latency = jnp.where(real_chosen, t - state.propose_tick, 0)
+    committed = state.committed + jnp.sum(newly_chosen)
+    committed_real = state.committed_real + jnp.sum(real_chosen)
+    lat_sum = state.lat_sum + jnp.sum(latency)
+    bins = jnp.clip(latency, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        real_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 3. Revocation progress: a phase-1 quorum binds rv_value (the
+    # discovered owner value if ANY reported round-0 vote, else noop)
+    # and launches round-1 Phase2as.
+    p1_in = jnp.sum(rv_p1b_arrival <= t, axis=2)
+    p1_done = (state.rv_phase == RV_P1) & (p1_in >= f + 1)
+    any_vote = jnp.any((rv_p1b_arrival <= t) & rv_p1b_voted, axis=2)
+    ord_of_pos = state.head[:, None] + jnp.mod(
+        w_iota[None, :] - state.head[:, None], W
+    )
+    owner_val = _owner_value(ord_of_pos, stripe_ids[:, None], L)
+    rv_value = jnp.where(
+        p1_done,
+        jnp.where(any_vote, owner_val, NOOP_VALUE),
+        state.rv_value,
+    )
+    revoked_discovered = state.revoked_discovered + jnp.sum(
+        p1_done & any_vote
+    )
+    rv_phase = jnp.where(p1_done, RV_P2, rv_phase)
+    rv_p2a_arrival = jnp.where(
+        p1_done[:, :, None] & delivered, t + rv_lat, rv_p2a_arrival
+    )
+    rv_p1b_arrival = jnp.where(p1_done[:, :, None], INF, rv_p1b_arrival)
+
+    # ---- 4. Global watermark + retire (same formula as Mencius).
+    pos_of_ord = jnp.mod(state.head[:, None] + w_iota[None, :], W)
+    slot_of_ord = state.head[:, None] + w_iota[None, :]
+    chosen_ord = (
+        jnp.take_along_axis(status, pos_of_ord, axis=1) == CHOSEN
+    ) & (slot_of_ord < state.next_slot[:, None])
+    n_contig = jnp.sum(
+        jnp.cumprod(chosen_ord.astype(jnp.int32), axis=1), axis=1
+    )
+    committed_prefix = state.head + n_contig
+    executed_global = jnp.min(committed_prefix * L + stripe_ids)
+    arrival_ord = jnp.take_along_axis(replica_arrival, pos_of_ord, axis=1)
+    global_of_ord = slot_of_ord * L + stripe_ids[:, None]
+    retire_ord = (
+        chosen_ord & (arrival_ord <= t) & (global_of_ord < executed_global)
+    )
+    n_retire, retire_mask = ring_retire(retire_ord, state.head)
+    head = state.head + n_retire
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    slot_value = jnp.where(retire_mask, NO_VALUE, slot_value)
+    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
+    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
+    last_send = jnp.where(retire_mask, INF, state.last_send)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    rv_phase = jnp.where(retire_mask, RV_NONE, rv_phase)
+    rv_value = jnp.where(retire_mask, NO_VALUE, rv_value)
+    clear3 = retire_mask[:, :, None]
+    acc_round = jnp.where(clear3, 0, acc_round)
+    voted = jnp.where(clear3, False, voted)
+    voted_r1 = jnp.where(clear3, False, voted_r1)
+    p2a_arrival = jnp.where(clear3, INF, p2a_arrival)
+    p2b_arrival = jnp.where(clear3, INF, p2b_arrival)
+    rv_p1a_arrival = jnp.where(clear3, INF, rv_p1a_arrival)
+    rv_p1b_arrival = jnp.where(clear3, INF, rv_p1b_arrival)
+    rv_p1b_voted = jnp.where(clear3, False, rv_p1b_voted)
+    rv_p2a_arrival = jnp.where(clear3, INF, rv_p2a_arrival)
+    rv_p2b_arrival = jnp.where(clear3, INF, rv_p2b_arrival)
+
+    # ---- 5. Owner proposals (LIVE owners only; K per tick).
+    space = W - (state.next_slot - head)
+    count = jnp.where(
+        alive, jnp.minimum(cfg.slots_per_tick, space), 0
+    )
+    delta = jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
+    is_new = delta < count[:, None]
+    new_ord = state.next_slot[:, None] + delta
+    next_slot = state.next_slot + count
+    status = jnp.where(is_new, PROPOSED, status)
+    slot_value = jnp.where(
+        is_new, _owner_value(new_ord, stripe_ids[:, None], L), slot_value
+    )
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    p2a_arrival = jnp.where(
+        is_new[:, :, None] & delivered, t + fwd_lat, p2a_arrival
+    )
+
+    # ---- 6. Revocation launch: a DEAD stripe lagging the fastest
+    # frontier by more than revoke_threshold gets its stalled slots
+    # claimed by a live peer (any exists — the revoker identity doesn't
+    # change the message pattern at this abstraction): round-1 Phase1as
+    # on up to revoke_slots_per_tick in-ring, unchosen, not-yet-revoking
+    # slots, EXTENDING next_slot over empty ones so the stripe's ring
+    # covers the needed range.
+    max_next = jnp.max(jnp.where(alive, next_slot, 0))
+    lag = max_next - next_slot
+    revoking_stripe = (
+        ~alive & (lag > cfg.revoke_threshold) & jnp.any(alive)
+    )  # [L]
+    # Extend the dead stripe's ring with fresh (EMPTY) slots to revoke.
+    ext_space = W - (next_slot - head)
+    ext = jnp.where(
+        revoking_stripe,
+        jnp.minimum(jnp.minimum(lag, cfg.revoke_slots_per_tick), ext_space),
+        0,
+    )
+    ext_new = (delta >= count[:, None]) & (
+        delta < (count + ext)[:, None]
+    )  # positions allocated for revocation this tick
+    # NOTE: delta was computed against pre-extension next_slot shared
+    # with step 5; count covers owner proposals (0 for dead stripes).
+    next_slot = next_slot + ext
+    status = jnp.where(ext_new, PROPOSED, status)  # claimed by revoker
+    slot_value = jnp.where(ext_new, NOOP_VALUE, slot_value)
+    propose_tick = jnp.where(ext_new, t, propose_tick)
+    last_send = jnp.where(ext_new, t, last_send)
+    # Target set: in-ring, not chosen, not already under revocation.
+    in_ring_now = (
+        jnp.mod(w_iota[None, :] - head[:, None], W)
+        < (next_slot - head)[:, None]
+    )
+    target = (
+        revoking_stripe[:, None]
+        & in_ring_now
+        & (status != CHOSEN)
+        & (rv_phase == RV_NONE)
+    )
+    rank = jnp.cumsum(target.astype(jnp.int32), axis=1)
+    target = target & (rank <= cfg.revoke_slots_per_tick)
+    revocations = state.revocations + jnp.sum(target)
+    rv_phase = jnp.where(target, RV_P1, rv_phase)
+    rv_p1a_arrival = jnp.where(
+        target[:, :, None] & delivered, t + rv_lat, rv_p1a_arrival
+    )
+
+    # ---- 7. Owner retries (live owners, round-0 slots not revoked).
+    timed_out = (
+        (status == PROPOSED)
+        & alive[:, None]
+        & (rv_phase == RV_NONE)
+        & (t - last_send >= cfg.retry_timeout)
+    )
+    p2a_arrival = jnp.where(
+        timed_out[:, :, None], t + rv_lat, p2a_arrival
+    )
+    last_send = jnp.where(timed_out, t, last_send)
+
+    return BatchedVanillaMenciusState(
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        slot_value=slot_value,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        replica_arrival=replica_arrival,
+        chosen_value=chosen_value,
+        committed_prefix=committed_prefix,
+        acc_round=acc_round,
+        voted=voted,
+        voted_r1=voted_r1,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        alive=alive,
+        rv_phase=rv_phase,
+        rv_value=rv_value,
+        rv_p1a_arrival=rv_p1a_arrival,
+        rv_p1b_arrival=rv_p1b_arrival,
+        rv_p1b_voted=rv_p1b_voted,
+        rv_p2a_arrival=rv_p2a_arrival,
+        rv_p2b_arrival=rv_p2b_arrival,
+        executed_global=jnp.maximum(state.executed_global, executed_global),
+        committed=committed,
+        committed_real=committed_real,
+        revocations=revocations,
+        revoked_discovered=revoked_discovered,
+        deaths=deaths,
+        choose_violations=choose_violations,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(
+    cfg: BatchedVanillaMenciusConfig,
+    state: BatchedVanillaMenciusState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedVanillaMenciusState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedVanillaMenciusConfig,
+    state: BatchedVanillaMenciusState,
+    t,
+) -> dict:
+    L = cfg.num_servers
+    stripe_ids = jnp.arange(L, dtype=jnp.int32)
+    # THE revocation safety property: no slot ever chosen twice with
+    # different values (the device-side ledger).
+    choose_once = state.choose_violations == 0
+    # Promise discipline: an acceptor that voted round 1 promised round 1.
+    promise_ok = jnp.all(~state.voted_r1 | (state.acc_round == 1))
+    watermark_ok = state.executed_global <= jnp.min(
+        state.committed_prefix * L + stripe_ids
+    )
+    window_ok = jnp.all(
+        (state.head <= state.next_slot)
+        & (state.next_slot - state.head <= cfg.window)
+    )
+    head_ok = jnp.all(state.head <= state.committed_prefix)
+    books_ok = (
+        state.committed_real <= state.committed
+    ) & (state.revoked_discovered <= state.revocations)
+    return {
+        "choose_once": choose_once,
+        "promise_ok": promise_ok,
+        "watermark_ok": watermark_ok,
+        "window_ok": window_ok,
+        "head_ok": head_ok,
+        "books_ok": books_ok,
+    }
+
+
+def stats(
+    cfg: BatchedVanillaMenciusConfig,
+    state: BatchedVanillaMenciusState,
+    t,
+) -> dict:
+    real = int(state.committed_real)
+    hist = jax.device_get(state.lat_hist)
+    p50 = (
+        int((hist.cumsum() >= max(1, (real + 1) // 2)).argmax())
+        if real
+        else -1
+    )
+    return {
+        "ticks": int(t),
+        "committed": int(state.committed),
+        "committed_real": real,
+        "executed_global": int(state.executed_global),
+        "revocations": int(state.revocations),
+        "revoked_discovered": int(state.revoked_discovered),
+        "deaths": int(state.deaths),
+        "choose_violations": int(state.choose_violations),
+        "latency_p50_ticks": p50,
+    }

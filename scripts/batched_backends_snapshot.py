@@ -263,6 +263,86 @@ out["horizontal_128_groups_churning"] = {
     "invariants_ok": all(bool(v) for v in hinv.values()),
 }
 
+# Vanilla Mencius @ 64 servers with failure churn + revocation.
+from frankenpaxos_tpu.tpu import vanillamencius_batched
+vmcfg = vanillamencius_batched.BatchedVanillaMenciusConfig(
+    f=1, num_servers=64, window=32, slots_per_tick=2,
+    fail_rate=0.005, revive_rate=0.1, revoke_threshold=8,
+)
+vmstate = vanillamencius_batched.init_state(vmcfg)
+vmstate, vmt = vanillamencius_batched.run_ticks(
+    vmcfg, vmstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(vmstate)
+vm0 = int(vmstate.committed_real)
+t0 = time.perf_counter()
+vmstate, vmt = vanillamencius_batched.run_ticks(
+    vmcfg, vmstate, vmt, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(vmstate)
+dt = time.perf_counter() - t0
+vms = vanillamencius_batched.stats(vmcfg, vmstate, vmt)
+vminv = vanillamencius_batched.check_invariants(vmcfg, vmstate, vmt)
+out["vanillamencius_64_servers_churning"] = {
+    "committed_real_per_sec": int((int(vmstate.committed_real) - vm0) / dt),
+    "revocations": vms["revocations"],
+    "invariants_ok": all(bool(v) for v in vminv.values()),
+}
+
+# Faster Paxos @ 64 groups with delegate churn.
+from frankenpaxos_tpu.tpu import fasterpaxos_batched
+fpcfg = fasterpaxos_batched.BatchedFasterPaxosConfig(
+    f=1, num_groups=64, window=16, slots_per_tick=2,
+    fail_rate=0.005, revive_rate=0.15, detect_timeout=4,
+)
+fpstate = fasterpaxos_batched.init_state(fpcfg)
+fpstate, fpt = fasterpaxos_batched.run_ticks(
+    fpcfg, fpstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(fpstate)
+fp0 = int(fpstate.committed_real)
+t0 = time.perf_counter()
+fpstate, fpt = fasterpaxos_batched.run_ticks(
+    fpcfg, fpstate, fpt, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(fpstate)
+dt = time.perf_counter() - t0
+fps = fasterpaxos_batched.stats(fpcfg, fpstate, fpt)
+fpinv = fasterpaxos_batched.check_invariants(fpcfg, fpstate, fpt)
+out["fasterpaxos_64_groups_churning"] = {
+    "committed_real_per_sec": int(
+        (int(fpstate.committed_real) - fp0) / dt
+    ),
+    "leader_changes": fps["leader_changes"],
+    "invariants_ok": all(bool(v) for v in fpinv.values()),
+}
+
+# Fast MultiPaxos @ 64 groups (log-structured fast rounds).
+from frankenpaxos_tpu.tpu import fastmultipaxos_batched
+fmcfg = fastmultipaxos_batched.BatchedFastMultiPaxosConfig(
+    f=1, num_groups=64, window=32, cmd_window=16, cmds_per_tick=2,
+    lat_min=2, lat_max=2, jitter=1,
+)
+fmstate = fastmultipaxos_batched.init_state(fmcfg)
+fmstate, fmt = fastmultipaxos_batched.run_ticks(
+    fmcfg, fmstate, jnp.int32(0), 200, jax.random.PRNGKey(0)
+)
+jax.block_until_ready(fmstate)
+fm0 = int(fmstate.cmds_done)
+t0 = time.perf_counter()
+fmstate, fmt = fastmultipaxos_batched.run_ticks(
+    fmcfg, fmstate, fmt, 200, jax.random.PRNGKey(1)
+)
+jax.block_until_ready(fmstate)
+dt = time.perf_counter() - t0
+fms = fastmultipaxos_batched.stats(fmcfg, fmstate, fmt)
+fminv = fastmultipaxos_batched.check_invariants(fmcfg, fmstate, fmt)
+out["fastmultipaxos_64_groups"] = {
+    "cmds_done_per_sec": int((int(fmstate.cmds_done) - fm0) / dt),
+    "fast_fraction": round(fms["fast_fraction"], 3),
+    "invariants_ok": all(bool(v) for v in fminv.values()),
+}
+
 with open("results/batched_backends_cpu.json", "w") as f:
     json.dump(out, f, indent=2)
 print(json.dumps(out, indent=2))

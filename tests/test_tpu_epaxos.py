@@ -414,3 +414,53 @@ def test_gc_execution_matches_tarjan():
     )
     assert executed > 30
     assert scc_events > 0
+
+
+def test_unanimous_bpaxos_matches_tarjan():
+    """Unanimous BPaxos mode: failed fast paths widen deps to the union
+    of dep-service reports; the closure must still execute exactly the
+    Tarjan-eligible set over the widened graph."""
+    cfg = BatchedEPaxosConfig(
+        num_columns=3,
+        window=16,
+        instances_per_tick=1,
+        lat_min=1,
+        lat_max=3,
+        see_same_tick_rate=0.5,
+        unanimous_mode=True,
+        unanimity_rate=0.5,
+    )
+    executed, scc_events = run_cross_validation(cfg, seed=21, num_ticks=40)
+    assert executed > 30
+    assert scc_events > 0
+
+
+def test_unanimous_fast_path_fraction_tracks_unanimity():
+    """unanimity_rate=1.0 -> every proposal is fast; 0.0 -> every
+    proposal that saw concurrency pays the classic round, and the mean
+    commit->execute latency is strictly worse."""
+    common = dict(
+        num_columns=8,
+        window=32,
+        instances_per_tick=2,
+        lat_min=2,
+        lat_max=2,
+        see_same_tick_rate=1.0,  # every instance sees its peers
+        unanimous_mode=True,
+    )
+    key = jax.random.PRNGKey(22)
+    out = {}
+    for rate in (1.0, 0.0):
+        cfg = BatchedEPaxosConfig(unanimity_rate=rate, **common)
+        state, t = run_ticks(cfg, init_state(cfg), jnp.int32(0), 120, key)
+        total = int(state.next_instance.sum())
+        out[rate] = {
+            "fast_fraction": int(state.fast_path_total) / max(1, total),
+            "mean_lat": float(state.lat_sum)
+            / max(1, int(state.executed_total)),
+        }
+        inv = check_invariants(cfg, state, t)
+        assert all(bool(v) for v in inv.values()), inv
+    assert out[1.0]["fast_fraction"] > 0.99
+    assert out[0.0]["fast_fraction"] < 0.01
+    assert out[0.0]["mean_lat"] > out[1.0]["mean_lat"] + 3  # +1 RTT at lat=2

@@ -1,0 +1,154 @@
+"""Tests of the batched Vanilla Mencius backend
+(tpu/vanillamencius_batched.py): revocation of dead servers' stripes
+(vanillamencius/Server.scala), the choose-once safety ledger, phase-1
+discovery of a dead owner's possibly-chosen value, and promise-based
+rejection of owner stragglers."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frankenpaxos_tpu.tpu import vanillamencius_batched as vm
+
+
+def run_random(cfg, seed, ticks):
+    key = jax.random.PRNGKey(seed)
+    state, t = vm.run_ticks(cfg, vm.init_state(cfg), jnp.int32(0), ticks, key)
+    return state, t
+
+
+def test_progress_without_failures():
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=8, window=32, slots_per_tick=2,
+        lat_min=1, lat_max=3,
+    )
+    state, t = run_random(cfg, seed=0, ticks=200)
+    s = vm.stats(cfg, state, t)
+    assert s["committed_real"] > 8 * 150
+    assert s["revocations"] == 0
+    assert s["choose_violations"] == 0
+    inv = vm.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_dead_stripe_stalls_without_revocation():
+    """Kill one server with revocation effectively disabled (huge
+    threshold): the global watermark pins at its stripe."""
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, revoke_threshold=10**6, revive_rate=0.0,
+    )
+    key = jax.random.PRNGKey(1)
+    state = vm.init_state(cfg)
+    state = dataclasses.replace(state, alive=state.alive.at[0].set(False))
+    t = 0
+    for _ in range(120):
+        state = vm.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    # Stripe 0 never proposes; global watermark stuck at 0 (slot 0
+    # belongs to server 0 and is never chosen).
+    assert int(state.executed_global) == 0
+    assert int(state.revocations) == 0
+
+
+def test_revocation_unsticks_the_global_watermark():
+    """Same dead server, revocation enabled: live peers claim its slots
+    as noops and the global log flows past the dead stripe."""
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, revoke_threshold=4, revive_rate=0.0,
+    )
+    key = jax.random.PRNGKey(2)
+    state = vm.init_state(cfg)
+    state = dataclasses.replace(state, alive=state.alive.at[0].set(False))
+    t = 0
+    for _ in range(200):
+        state = vm.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    s = vm.stats(cfg, state, jnp.int32(t))
+    assert s["revocations"] > 0
+    assert s["executed_global"] > 100  # the log flows past stripe 0
+    assert s["choose_violations"] == 0
+    inv = vm.check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_revocation_discovers_dead_owners_choice():
+    """The safety case revocation exists for: the owner proposed, a full
+    round-0 vote quorum formed at the acceptors, but the owner died
+    before counting the Phase2bs. Revocation's phase 1 must DISCOVER the
+    vote and re-propose the owner's value — not a noop — and the
+    choose-once ledger stays clean."""
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=2, window=8, slots_per_tick=1,
+        lat_min=1, lat_max=1, revoke_threshold=2, revive_rate=0.0,
+    )
+    key = jax.random.PRNGKey(3)
+    state = vm.init_state(cfg)
+    t = 0
+    # Tick 0: both servers propose slot ordinal 0; Phase2as land at t=1
+    # (lat=1), votes cast, Phase2bs due t=2.
+    state = vm.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+    t += 1
+    state = vm.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+    t += 1
+    # Votes exist at server 0's acceptors for ordinal 0; kill server 0
+    # BEFORE it can count the Phase2bs arriving this tick.
+    assert bool(np.asarray(state.voted)[0].any())
+    owner_val = int(np.asarray(state.slot_value)[0, 0])
+    assert owner_val >= 0
+    state = dataclasses.replace(state, alive=state.alive.at[0].set(False))
+    # Run on: server 1 races ahead, triggers revocation of stripe 0;
+    # phase 1 must discover the round-0 votes.
+    for _ in range(80):
+        state = vm.tick(cfg, state, jnp.int32(t), jax.random.fold_in(key, t))
+        t += 1
+    s = vm.stats(cfg, state, jnp.int32(t))
+    assert s["revocations"] > 0
+    assert s["revoked_discovered"] > 0, "phase 1 never discovered a vote"
+    assert s["choose_violations"] == 0
+    assert s["executed_global"] > 0
+    inv = vm.check_invariants(cfg, state, jnp.int32(t))
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_promise_rejects_owner_straggler():
+    """After a revocation promise (round 1), a dead owner's straggling
+    round-0 Phase2a must NOT produce a vote."""
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=2, window=8, slots_per_tick=1,
+        lat_min=1, lat_max=1,
+    )
+    state = vm.init_state(cfg)
+    # Hand-craft: slot (0,0) PROPOSED, acceptor 0 already promised round
+    # 1, owner Phase2a arriving now.
+    state = dataclasses.replace(
+        state,
+        status=state.status.at[0, 0].set(vm.PROPOSED),
+        slot_value=state.slot_value.at[0, 0].set(0),
+        next_slot=state.next_slot.at[0].set(1),
+        acc_round=state.acc_round.at[0, 0, 0].set(1),
+        p2a_arrival=state.p2a_arrival.at[0, 0, 0].set(5),
+    )
+    state = vm.tick(cfg, state, jnp.int32(5), jax.random.PRNGKey(4))
+    assert not bool(state.voted[0, 0, 0])  # rejected
+    assert int(state.p2a_arrival[0, 0, 0]) == vm.INF  # consumed
+
+
+def test_churn_invariants_random():
+    """Continuous die/revive churn with revocation: safety ledger clean,
+    watermark monotone, books balanced."""
+    cfg = vm.BatchedVanillaMenciusConfig(
+        f=1, num_servers=16, window=32, slots_per_tick=2,
+        lat_min=1, lat_max=3, fail_rate=0.01, revive_rate=0.1,
+        revoke_threshold=6, drop_rate=0.05,
+    )
+    state, t = run_random(cfg, seed=5, ticks=400)
+    s = vm.stats(cfg, state, t)
+    assert s["deaths"] > 0
+    assert s["committed_real"] > 1000
+    assert s["choose_violations"] == 0
+    inv = vm.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
