@@ -32,9 +32,11 @@ configs = [
     for drop in (0.0, 0.01, 0.03)
 ]
 
-rows = gb.sweep(configs, num_ticks=TICKS, seed=0)
-for r in rows:
+rows = []
+for cfg in configs:
+    (r,) = gb.sweep([cfg], num_ticks=TICKS, seed=0)
     r["invariants"] = {k: bool(v) for k, v in r["invariants"].items()}
+    rows.append(r)
     print(r, flush=True)
 
 out = {
