@@ -74,25 +74,32 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
     return {name: spec_for(name) for name in fields}
 
 
-def shard_state(
-    state: BatchedMultiPaxosState, mesh: Mesh
-) -> BatchedMultiPaxosState:
-    """Place the state on the mesh with the group axis sharded."""
+def _shard_dataclass(state, specs, mesh: Mesh, axis_len: int, what: str):
+    """Place a struct-of-arrays state dataclass on the mesh per-field;
+    the sharded axis length must divide evenly over the devices."""
     import dataclasses as _dc
 
-    num_groups = state.leader_round.shape[-1]
     n_devices = mesh.devices.size
-    if num_groups % n_devices != 0:
+    if axis_len % n_devices != 0:
         raise ValueError(
-            f"num_groups ({num_groups}) must be divisible by the mesh size "
-            f"({n_devices}) to shard the group axis; pick num_groups as a "
-            f"multiple of the device count."
+            f"{what} ({axis_len}) must be divisible by the mesh size "
+            f"({n_devices}) to shard that axis; pick a multiple of the "
+            f"device count."
         )
-    specs = state_shardings(mesh)
     out = {}
     for f in _dc.fields(state):
         out[f.name] = jax.device_put(getattr(state, f.name), specs[f.name])
     return type(state)(**out)
+
+
+def shard_state(
+    state: BatchedMultiPaxosState, mesh: Mesh
+) -> BatchedMultiPaxosState:
+    """Place the state on the mesh with the group axis sharded."""
+    return _shard_dataclass(
+        state, state_shardings(mesh), mesh,
+        state.leader_round.shape[-1], "num_groups",
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 4))
@@ -127,3 +134,53 @@ def run_ticks_sharded(
     key,
 ) -> Tuple[BatchedMultiPaxosState, jnp.ndarray]:
     return _run_ticks_sharded(cfg, mesh, state, t0, num_ticks, key)
+
+
+def epaxos_shardings(mesh: Mesh):
+    """NamedShardings for the batched EPaxos state: every [C, ...] array
+    shards along the column axis (the docstring's "shardable over a
+    device mesh along C"); the frontier history ([H, C]) and per-replica
+    GC watermarks ([R, C]) shard on their SECOND axis; scalars and the
+    latency histogram replicate. The closure's only cross-device traffic
+    is the [H]-sized tick scores and scalar stats (all-reduces over the
+    column axis)."""
+    import dataclasses as _dc
+
+    from frankenpaxos_tpu.tpu import epaxos_batched as eb
+
+    second_axis = {"fpre", "fpost", "rep_exec"}
+    replicated = {
+        "committed_total", "fast_path_total", "executed_total",
+        "retired_total", "coexecuted", "lat_sum", "lat_hist",
+        "snapshots_served", "rep_crashes", "rep_down",
+    }
+    specs = {}
+    for f in _dc.fields(eb.BatchedEPaxosState):
+        if f.name in replicated:
+            specs[f.name] = NamedSharding(mesh, P())
+        elif f.name in second_axis:
+            specs[f.name] = NamedSharding(mesh, P(None, GROUP_AXIS))
+        else:
+            specs[f.name] = NamedSharding(mesh, P(GROUP_AXIS))
+    return specs
+
+
+def shard_epaxos_state(state, mesh: Mesh):
+    """Place batched EPaxos state on the mesh, columns sharded."""
+    return _shard_dataclass(
+        state, epaxos_shardings(mesh), mesh,
+        state.head.shape[0], "num_columns",
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 4))
+def _run_epaxos_sharded(cfg, mesh, state, t0, num_ticks, key):
+    from frankenpaxos_tpu.tpu import epaxos_batched as eb
+
+    return eb.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+
+
+def run_epaxos_ticks_sharded(cfg, mesh, state, t0, num_ticks: int, key):
+    """Sharded batched-EPaxos run (GSPMD propagation from the input
+    shardings, like run_ticks_sharded for the flagship)."""
+    return _run_epaxos_sharded(cfg, mesh, state, t0, num_ticks, key)
