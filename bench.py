@@ -13,6 +13,11 @@ re-runs the measurement on the CPU backend in a clean environment (the
 sitecustomize gated on PALLAS_AXON_POOL_IPS would otherwise import the TPU
 plugin at interpreter start). Every path ends in a one-line JSON on stdout
 and exit code 0, with an honest "device" field.
+
+``--live-only`` disables the last-known-good replay: the headline is
+whatever ran live this invocation, never a stale TPU capture. The JSON
+also carries a "memory" block (device peak_bytes_in_use when the
+backend's allocator reports it, plus the dtype-policy state footprint).
 """
 
 from __future__ import annotations
@@ -36,13 +41,25 @@ _PROBE_CODE = (
 
 
 def _inner_main() -> None:
-    """The actual measurement; runs in a subprocess with jax importable."""
+    """The actual measurement; runs in a subprocess with jax importable.
+
+    Soft deadline: the subprocess has a 900s hard timeout, after which
+    the WHOLE run (headline included) is lost. On slow machines the
+    secondary variants (read modes, SMR) can push past it, so each
+    checks a soft budget first and is skipped — recorded honestly in
+    the JSON — rather than silently destroying the headline."""
     import dataclasses
     import time
 
     import jax
 
     from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+
+    inner_start = time.perf_counter()
+    soft_budget = float(os.environ.get("BENCH_INNER_BUDGET_S", "700"))
+
+    def over_budget() -> bool:
+        return time.perf_counter() - inner_start > soft_budget
 
     def make_cfg(K: int, W: int) -> BatchedMultiPaxosConfig:
         # 3334 groups x 3 acceptors = 10,002 simulated acceptors (f=1).
@@ -107,6 +124,18 @@ def _inner_main() -> None:
     stats = sim.stats()
     throughput = committed / elapsed
     ticks = segments * ticks_per_segment
+    # Device memory accounting for the HBM-bandwidth pass: peak bytes in
+    # use as the device runtime reports them (None on backends without
+    # an allocator stats API, e.g. CPU — reported honestly as null), plus
+    # the dtype-policy state footprint computed from the live state.
+    mem_stats = jax.devices()[0].memory_stats() or {}
+    from frankenpaxos_tpu.tpu.common import state_nbytes
+
+    memory = {
+        "peak_bytes_in_use": mem_stats.get("peak_bytes_in_use"),
+        "bytes_in_use": mem_stats.get("bytes_in_use"),
+        "state_bytes": state_nbytes(sim.state),
+    }
     result = {
         "metric": METRIC,
         "value": round(throughput, 1),
@@ -120,6 +149,7 @@ def _inner_main() -> None:
         "device": str(jax.devices()[0]),
         "config": {"K": bK, "W": bW, "num_groups": cfg.num_groups},
         "calibration": calib_rows,
+        "memory": memory,
     }
 
     # Secondary: the same cluster serving reads alongside writes through
@@ -129,6 +159,11 @@ def _inner_main() -> None:
     # All three consistency modes are measured; "linearizable" is the
     # headline read_variant.
     for mode in ("linearizable", "sequential", "eventual"):
+        if over_budget():
+            result.setdefault("skipped_variants", []).append(
+                f"read_{mode} (soft budget {soft_budget:.0f}s exceeded)"
+            )
+            continue
         rcfg = dataclasses.replace(
             cfg, read_rate=8, read_window=32, read_mode=mode
         )
@@ -175,6 +210,12 @@ def _inner_main() -> None:
     # injected client re-sends (Replica.executeCommand,
     # Replica.scala:305-344) — i.e. commands ACTUALLY EXECUTING, not just
     # committing.
+    if over_budget():
+        result.setdefault("skipped_variants", []).append(
+            f"smr (soft budget {soft_budget:.0f}s exceeded)"
+        )
+        print("BENCH_JSON " + json.dumps(result))
+        return
     scfg = dataclasses.replace(
         cfg, state_machine="kv", kv_keys=64, num_clients=8, dup_rate=0.02
     )
@@ -378,6 +419,11 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
 
 
 def main() -> None:
+    # --live-only: this invocation must measure, not replay. A stale
+    # last-known-good TPU capture is never promoted to the headline;
+    # whatever ran live THIS invocation (TPU or the honest CPU fallback)
+    # is the result, with a note recording that the replay was refused.
+    live_only = "--live-only" in sys.argv
     notes = []
     result = None
 
@@ -401,7 +447,14 @@ def main() -> None:
                 "tpu probe ok but the measurement ran on "
                 f"{result.get('device')}; treating as cpu fallback"
             )
-            result = _prefer_last_good(result, notes)
+            if live_only:
+                result["measured_live"] = True
+                notes.append(
+                    "--live-only: refusing to headline a stale "
+                    "last-known-good TPU capture"
+                )
+            else:
+                result = _prefer_last_good(result, notes)
     else:
         notes.append("tpu probe failed or timed out; falling back to cpu")
 
@@ -409,6 +462,12 @@ def main() -> None:
         result, note = _run_inner(_cpu_env(), timeout=900.0)
         if result is None:
             notes.append(f"cpu run failed ({note})")
+        elif live_only:
+            result["measured_live"] = True
+            notes.append(
+                "--live-only: refusing to headline a stale "
+                "last-known-good TPU capture"
+            )
         else:
             result = _prefer_last_good(result, notes)
 

@@ -190,6 +190,139 @@ def bench_conflict_index(num_ops: int = 20_000) -> List[dict]:
     return rows
 
 
+def compiled_memory_stats(runner, cfg, state, ticks: int) -> dict:
+    """XLA's compiled memory accounting for one ``run_ticks``-shaped
+    jit (``runner(cfg, state, t0, ticks, key)``): argument/output/temp/
+    alias bytes plus ``peak_bytes`` = arg + out + temp - alias (what
+    donation removes). An executable deserialized from the persistent
+    compilation cache reports NO aliasing, which would zero the
+    donation accounting, so the disk cache is detached for this compile
+    (dir=None + reset_cache; flipping jax_enable_compilation_cache
+    alone does not stop reads once the cache is initialized) and
+    restored afterwards. Shared by the hbm bench below and
+    scripts/tpu_layout_bench.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        cc.reset_cache()
+        ma = runner.lower(
+            cfg, state, jnp.zeros((), jnp.int32), ticks,
+            jax.random.PRNGKey(0),
+        ).compile().memory_analysis()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        cc.reset_cache()
+    arg_b = int(ma.argument_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    tmp_b = int(ma.temp_size_in_bytes)
+    alias_b = int(ma.alias_size_in_bytes)
+    return {
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "alias_bytes": alias_b,
+        "peak_bytes": arg_b + out_b + tmp_b - alias_b,
+    }
+
+
+def bench_hbm(
+    num_groups: int = 3334,
+    window: int = 64,
+    slots_per_tick: int = 8,
+    ticks: int = 200,
+    cases: "tuple | None" = None,
+) -> List[dict]:
+    """The HBM-bandwidth pass, measured: the flagship 10k-acceptor
+    batched-MultiPaxos config under four (dtype x donation) variants —
+
+      * ``int32_nodonate``  — the pre-pass baseline: widened (int32)
+        state, no buffer donation (a fresh non-donating jit of the same
+        tick program);
+      * ``int32_donate``    — donation alone;
+      * ``narrow_nodonate`` — the dtype policy alone;
+      * ``narrow_donate``   — the shipped configuration.
+
+    Each row reports ticks/sec (ops = ticks) plus a ``HBM_JSON`` line
+    with the state footprint and XLA's own compiled memory analysis
+    (argument/output/temp/alias bytes): ``peak_bytes`` = arguments +
+    outputs + temps - aliased, which is exactly what donation removes —
+    the measured-peak-HBM number of the acceptance criteria, reported by
+    the compiler rather than asserted. ``bytes_per_tick`` is the
+    elementwise-sweep traffic bound 2 x state_bytes (each tick reads and
+    rewrites the whole state).
+    """
+    import functools
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+    from frankenpaxos_tpu.tpu.common import state_nbytes, widen_state
+
+    cfg = mb.BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=num_groups,
+        window=window,
+        slots_per_tick=slots_per_tick,
+        lat_min=1,
+        lat_max=3,
+        drop_rate=0.0,
+        retry_timeout=16,
+        thrifty=True,
+    )
+    nodonate = jax.jit(mb.run_ticks.__wrapped__, static_argnums=(0, 3))
+    variants = [
+        ("int32_nodonate", True, nodonate),
+        ("int32_donate", True, mb.run_ticks),
+        ("narrow_nodonate", False, nodonate),
+        ("narrow_donate", False, mb.run_ticks),
+    ]
+    if cases is not None:  # e.g. the smoke test's before/after pair
+        variants = [v for v in variants if v[0] in cases]
+    key = jax.random.PRNGKey(0)
+    t0 = jnp.zeros((), jnp.int32)
+    rows = []
+    for case, widen, runner in variants:
+        make = (
+            (lambda: widen_state(mb.init_state(cfg)))
+            if widen
+            else (lambda: mb.init_state(cfg))
+        )
+        state = make()
+        sbytes = state_nbytes(state)
+        mem = compiled_memory_stats(runner, cfg, state, ticks)
+        # Warm up (compile + one segment), then time one segment.
+        state, t = runner(cfg, state, t0, ticks, key)
+        jax.block_until_ready(state)
+        state = make()
+
+        def run() -> int:
+            out, _ = runner(cfg, state, t0, ticks, key)
+            jax.block_until_ready(out)
+            return ticks
+
+        ops, seconds = _timed(run)
+        row = _report("hbm", case, ops, seconds)
+        row.update(
+            {
+                "state_bytes": sbytes,
+                "bytes_per_tick": 2 * sbytes,
+                **mem,
+                "num_acceptors": cfg.num_acceptors,
+                "device": str(jax.devices()[0]),
+            }
+        )
+        print("HBM_JSON " + json.dumps(row))
+        rows.append(row)
+    return rows
+
+
 BENCHES = {
     "depgraph": bench_depgraph,
     "int_prefix_set": bench_int_prefix_set,
@@ -197,20 +330,29 @@ BENCHES = {
     "conflict_index": bench_conflict_index,
 }
 
+# Device benchmarks live in their own registry: they need jax + minutes
+# of wall clock at the flagship model size, so the pinned-baseline
+# regression test (tests/test_microbench_regression.py) must not sweep
+# them up with the Python hot-path benches.
+DEVICE_BENCHES = {
+    "hbm": bench_hbm,
+}
+
 
 def main() -> None:
+    all_benches = {**BENCHES, **DEVICE_BENCHES}
     names = sys.argv[1:] or list(BENCHES)
-    unknown = [n for n in names if n not in BENCHES]
+    unknown = [n for n in names if n not in all_benches]
     if unknown:
         print(
             f"unknown bench(es) {', '.join(unknown)}; "
-            f"choose from: {', '.join(BENCHES)}",
+            f"choose from: {', '.join(all_benches)}",
             file=sys.stderr,
         )
         sys.exit(2)
     print("name,case,ops,seconds,ops_per_sec")
     for name in names:
-        BENCHES[name]()
+        all_benches[name]()
 
 
 if __name__ == "__main__":

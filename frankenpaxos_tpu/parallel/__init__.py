@@ -102,7 +102,7 @@ def shard_state(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 4), donate_argnums=(2,))
 def _run_ticks_sharded(
     cfg: BatchedMultiPaxosConfig,
     mesh: Mesh,
@@ -111,6 +111,9 @@ def _run_ticks_sharded(
     num_ticks: int,
     key: jnp.ndarray,
 ):
+    # ``state`` is donated (single-buffered per shard), mirroring
+    # run_ticks: callers rebind the returned state and must not reuse
+    # the argument.
     # The write path is elementwise over groups; with the G axis sharded,
     # XLA partitions the whole scan and the only cross-device traffic is
     # scalar/ring-stat reductions (psum over ICI): commit stats, and —
@@ -173,8 +176,9 @@ def shard_epaxos_state(state, mesh: Mesh):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 4), donate_argnums=(2,))
 def _run_epaxos_sharded(cfg, mesh, state, t0, num_ticks, key):
+    # ``state`` is donated; rebind the result, never reuse the argument.
     from frankenpaxos_tpu.tpu import epaxos_batched as eb
 
     return eb.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)

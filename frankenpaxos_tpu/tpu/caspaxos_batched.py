@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_ROUND,
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_delivered,
@@ -134,30 +136,33 @@ class BatchedCasPaxosState:
 
 def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
     G, L, A = cfg.num_registers, cfg.num_leaders, cfg.n
-    u0 = jnp.zeros((L, G), jnp.uint32)
     return BatchedCasPaxosState(
-        l_status=jnp.zeros((L, G), jnp.int32),
-        l_round=jnp.arange(L, dtype=jnp.int32)[:, None]
-        - jnp.int32(L) * jnp.ones((L, G), jnp.int32),
-        l_value=u0,
-        l_pending=u0,
-        l_seen_round=jnp.full((L, G), -1, jnp.int32),
+        l_status=jnp.zeros((L, G), DTYPE_STATUS),
+        l_round=(
+            jnp.arange(L, dtype=DTYPE_ROUND)[:, None]
+            - DTYPE_ROUND(L) * jnp.ones((L, G), DTYPE_ROUND)
+        ),
+        # Distinct buffers (not one shared array): run_ticks donates the
+        # state, and XLA rejects a donated buffer appearing twice.
+        l_value=jnp.zeros((L, G), jnp.uint32),
+        l_pending=jnp.zeros((L, G), jnp.uint32),
+        l_seen_round=jnp.full((L, G), -1, DTYPE_ROUND),
         backoff_until=jnp.full((L, G), INF, jnp.int32),
-        a_round=jnp.full((A, G), -1, jnp.int32),
-        a_vote_round=jnp.full((A, G), -1, jnp.int32),
+        a_round=jnp.full((A, G), -1, DTYPE_ROUND),
+        a_vote_round=jnp.full((A, G), -1, DTYPE_ROUND),
         a_vote_value=jnp.zeros((A, G), jnp.uint32),
         dn_arrival=jnp.full((A, L, G), INF, jnp.int32),
-        dn_round=jnp.full((A, L, G), -1, jnp.int32),
-        dn_phase=jnp.zeros((A, L, G), jnp.int32),
+        dn_round=jnp.full((A, L, G), -1, DTYPE_ROUND),
+        dn_phase=jnp.zeros((A, L, G), DTYPE_STATUS),
         dn_value=jnp.zeros((A, L, G), jnp.uint32),
         up_arrival=jnp.full((A, L, G), INF, jnp.int32),
-        up_round=jnp.full((A, L, G), -1, jnp.int32),
+        up_round=jnp.full((A, L, G), -1, DTYPE_ROUND),
         up_nack=jnp.zeros((A, L, G), bool),
-        up_nack_round=jnp.full((A, L, G), -1, jnp.int32),
-        up_vote_round=jnp.full((A, L, G), -1, jnp.int32),
+        up_nack_round=jnp.full((A, L, G), -1, DTYPE_ROUND),
+        up_vote_round=jnp.full((A, L, G), -1, DTYPE_ROUND),
         up_vote_value=jnp.zeros((A, L, G), jnp.uint32),
         last_chosen=jnp.zeros((G,), jnp.uint32),
-        last_round=jnp.full((G,), -1, jnp.int32),
+        last_round=jnp.full((G,), -1, DTYPE_ROUND),
         bit_issue=jnp.full((G, NBITS), INF, jnp.int32),
         bit_done=jnp.zeros((G, NBITS), bool),
         commits=jnp.zeros((), jnp.int32),
@@ -366,7 +371,7 @@ def tick(
         ((l_status == L_IDLE) & (l_pending != 0))
         | ((l_status == L_BACK) & (t >= backoff_until))
     )
-    l_iota = jnp.arange(L, dtype=jnp.int32)[:, None]
+    l_iota = jnp.arange(L, dtype=l_round.dtype)[:, None]
     floor = jnp.maximum(l_round, l_seen_round)
     # Smallest r > floor with r % L == l.
     next_round = floor + ((l_iota - floor) % L)
@@ -415,7 +420,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedCasPaxosConfig,
     state: BatchedCasPaxosState,

@@ -11,6 +11,70 @@ INF = jnp.int32(2**30)
 
 LAT_BINS = 64  # histogram bins for latency stats (in ticks)
 
+# ---------------------------------------------------------------------------
+# Dtype policy (the HBM-bandwidth pass). The tick loops are elementwise
+# sweeps over the whole state, so simulator throughput is set by bytes
+# moved per tick, not FLOPs; arrays whose values are structurally tiny
+# carry narrow dtypes so each sweep moves fewer bytes:
+#
+#   * DTYPE_STATUS (int8)  — slot/ring status codes and tiny phase enums
+#     (a handful of named values each).
+#   * DTYPE_ROUND  (int16) — ballot rounds, configuration epochs, and
+#     other monotone counters that advance only on rare control events
+#     (elections, reconfigurations). 32k of those per run is far beyond
+#     any simulated horizon; check_invariants trips loudly before wrap
+#     matters because promise monotonicity breaks first.
+#   * DTYPE_COUNT  (int16) — small bounded counters (heartbeat-miss
+#     ticks, clamped at their timeout by construction).
+#
+# Everything else keeps its width: tick/arrival clocks and INF sentinels
+# are int32 (t grows without bound), value/command ids are int32 (global
+# sequence numbers masked into [0, 2^31)), bool masks stay bool, and the
+# stats accumulators (lat_sum, histograms, committed counters) are int32
+# — narrow-dtype arithmetic widens AT the accumulation point, never
+# before.
+#
+# The tick functions are dtype-polymorphic: they preserve whatever
+# dtypes the state carries (update sites use weakly-typed Python
+# scalars, never hard casts), so running the SAME tick on a
+# widen_state()-upcast state reproduces the pre-narrowing int32
+# semantics bit for bit — that is the reference path the dtype
+# cross-validation tests pin against.
+# ---------------------------------------------------------------------------
+DTYPE_STATUS = jnp.int8
+DTYPE_ROUND = jnp.int16
+DTYPE_COUNT = jnp.int16
+
+
+def widen_state(state):
+    """The int32 reference view of a (possibly narrowed) state pytree:
+    every signed sub-32-bit integer leaf upcasts to int32; bool, uint32,
+    and int32 leaves pass through. Running the same tick on the widened
+    state replays the pre-policy semantics (values are unchanged — the
+    policy only narrows storage), so
+    ``widen_state(run(narrow)) == run(widen_state(narrow))`` bit for bit."""
+
+    def widen(leaf):
+        if (
+            hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.signedinteger)
+            and leaf.dtype.itemsize < 4
+        ):
+            return leaf.astype(jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map(widen, state)
+
+
+def state_nbytes(state) -> int:
+    """Total bytes of device memory the state pytree occupies — the
+    bytes one full elementwise sweep of a tick reads (and writes)."""
+    return sum(
+        leaf.nbytes
+        for leaf in jax.tree_util.tree_leaves(state)
+        if hasattr(leaf, "nbytes")
+    )
+
 
 def sample_latency(lat_min: int, lat_max: int, key, shape) -> jnp.ndarray:
     """Uniform per-message latency in ticks."""

@@ -41,7 +41,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu.common import (
+    DTYPE_STATUS,
+    INF,
+    LAT_BINS,
+    bit_latency,
+)
 
 # Write slot status.
 W_EMPTY = 0
@@ -123,7 +128,7 @@ def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
     N, L, KV = cfg.num_chains, cfg.chain_len, cfg.num_keys
     W, RW = cfg.window, cfg.read_window
     return BatchedCraqState(
-        w_status=jnp.zeros((N, W), jnp.int32),
+        w_status=jnp.zeros((N, W), DTYPE_STATUS),
         w_key=jnp.zeros((N, W), jnp.int32),
         w_version=jnp.full((N, W), -1, jnp.int32),
         w_node=jnp.zeros((N, W), jnp.int32),
@@ -132,7 +137,7 @@ def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
         node_dirty=jnp.zeros((N, L, KV), jnp.int32),
         node_version=jnp.full((N, L, KV), -1, jnp.int32),
         next_version=jnp.zeros((N,), jnp.int32),
-        r_status=jnp.zeros((N, RW), jnp.int32),
+        r_status=jnp.zeros((N, RW), DTYPE_STATUS),
         r_key=jnp.zeros((N, RW), jnp.int32),
         r_node=jnp.zeros((N, RW), jnp.int32),
         r_arrival=jnp.full((N, RW), INF, jnp.int32),
@@ -356,7 +361,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedCraqConfig,
     state: BatchedCraqState,

@@ -599,7 +599,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedEPaxosConfig,
     state: BatchedEPaxosState,

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_ROUND,
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_delivered,
@@ -132,23 +134,23 @@ def init_state(cfg: BatchedFasterPaxosConfig) -> BatchedFasterPaxosState:
     G, D, W = cfg.num_groups, cfg.num_delegates, cfg.window
     A = S = cfg.num_servers
     return BatchedFasterPaxosState(
-        round=jnp.zeros((G,), jnp.int32),
-        seat_epoch=jnp.zeros((G,), jnp.int32),
-        phase=jnp.zeros((G,), jnp.int32),
+        round=jnp.zeros((G,), DTYPE_ROUND),
+        seat_epoch=jnp.zeros((G,), DTYPE_ROUND),
+        phase=jnp.zeros((G,), DTYPE_STATUS),
         dead_ticks=jnp.zeros((G,), jnp.int32),
         leader_changes=jnp.zeros((), jnp.int32),
         next_ord=jnp.zeros((G, D), jnp.int32),
         head=jnp.zeros((G, D), jnp.int32),
-        status=jnp.zeros((G, D, W), jnp.int32),
+        status=jnp.zeros((G, D, W), DTYPE_STATUS),
         slot_value=jnp.full((G, D, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((G, D, W), INF, jnp.int32),
         last_send=jnp.full((G, D, W), INF, jnp.int32),
         replica_arrival=jnp.full((G, D, W), INF, jnp.int32),
         chosen_value=jnp.full((G, D, W), NO_VALUE, jnp.int32),
-        acc_round=jnp.zeros((A, G), jnp.int32),
-        vote_round=jnp.full((A, G, D, W), -1, jnp.int32),
+        acc_round=jnp.zeros((A, G), DTYPE_ROUND),
+        vote_round=jnp.full((A, G, D, W), -1, DTYPE_ROUND),
         p2a_arrival=jnp.full((A, G, D, W), INF, jnp.int32),
-        p2a_round=jnp.zeros((A, G, D, W), jnp.int32),
+        p2a_round=jnp.zeros((A, G, D, W), DTYPE_ROUND),
         p2b_arrival=jnp.full((A, G, D, W), INF, jnp.int32),
         server_alive=jnp.ones((S, G), bool),
         p1a_arrival=jnp.full((A, G), INF, jnp.int32),
@@ -458,7 +460,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedFasterPaxosConfig,
     state: BatchedFasterPaxosState,

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_latency,
@@ -141,7 +142,7 @@ def init_state(
         head=jnp.zeros((G,), jnp.int32),
         acc_next=jnp.zeros((A, G), jnp.int32),
         cmd_seq=jnp.zeros((G,), jnp.int32),
-        status=jnp.zeros((G, W), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
         open_tick=jnp.full((G, W), INF, jnp.int32),
         chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         replica_arrival=jnp.full((G, W), INF, jnp.int32),
@@ -152,7 +153,7 @@ def init_state(
         rv_p2a_arrival=jnp.full((A, G, W), INF, jnp.int32),
         rv_p2b_arrival=jnp.full((A, G, W), INF, jnp.int32),
         rv_voted=jnp.zeros((A, G, W), bool),
-        cmd_status=jnp.zeros((G, CW), jnp.int32),
+        cmd_status=jnp.zeros((G, CW), DTYPE_STATUS),
         cmd_id=jnp.full((G, CW), -1, jnp.int32),
         cmd_issue=jnp.full((G, CW), INF, jnp.int32),
         cmd_last_send=jnp.full((G, CW), INF, jnp.int32),
@@ -435,7 +436,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedFastMultiPaxosConfig,
     state: BatchedFastMultiPaxosState,

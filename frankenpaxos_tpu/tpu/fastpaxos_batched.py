@@ -37,7 +37,13 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu.common import (
+    DTYPE_ROUND,
+    DTYPE_STATUS,
+    INF,
+    LAT_BINS,
+    bit_latency,
+)
 
 # Instance status.
 I_EMPTY = 0
@@ -134,7 +140,7 @@ class BatchedFastPaxosState:
 def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
     G, W, A = cfg.num_groups, cfg.window, cfg.n
     return BatchedFastPaxosState(
-        status=jnp.zeros((G, W), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
         conflicted=jnp.zeros((G, W), bool),
         issue_tick=jnp.full((G, W), INF, jnp.int32),
         rec_value=jnp.full((G, W), NO_VALUE, jnp.int32),
@@ -143,13 +149,13 @@ def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
         retire_at=jnp.full((G, W), INF, jnp.int32),
         next_inst=jnp.zeros((G,), jnp.int32),
         inst_id=jnp.full((G, W), -1, jnp.int32),
-        acc_round=jnp.zeros((A, G, W), jnp.int32),
-        vote_round=jnp.full((A, G, W), -1, jnp.int32),
+        acc_round=jnp.zeros((A, G, W), DTYPE_ROUND),
+        vote_round=jnp.full((A, G, W), -1, DTYPE_ROUND),
         vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
         p0_arrival=jnp.full((A, G, W), INF, jnp.int32),
         p1_arrival=jnp.full((A, G, W), INF, jnp.int32),
         dn_arrival=jnp.full((A, G, W), INF, jnp.int32),
-        dn_phase=jnp.zeros((A, G, W), jnp.int32),
+        dn_phase=jnp.zeros((A, G, W), DTYPE_STATUS),
         up_arrival=jnp.full((A, G, W), INF, jnp.int32),
         fp_committed_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         chosen_total=jnp.zeros((), jnp.int32),
@@ -410,7 +416,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedFastPaxosConfig,
     state: BatchedFastPaxosState,

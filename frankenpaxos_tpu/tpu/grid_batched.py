@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     sample_delivered,
@@ -106,7 +107,7 @@ def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
     return GridBatchedState(
         next_slot=jnp.zeros((), jnp.int32),
         head=jnp.zeros((), jnp.int32),
-        status=jnp.zeros((W,), jnp.int32),
+        status=jnp.zeros((W,), DTYPE_STATUS),
         propose_tick=jnp.full((W,), INF, jnp.int32),
         last_send=jnp.full((W,), INF, jnp.int32),
         chosen_tick=jnp.full((W,), INF, jnp.int32),
@@ -234,7 +235,7 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(cfg, state, t0, num_ticks: int, key):
     def step(carry, i):
         st, t = carry

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_ROUND,
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_latency,
@@ -140,16 +142,16 @@ def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
     return BatchedHorizontalState(
         next_slot=jnp.zeros((G,), jnp.int32),
         head=jnp.zeros((G,), jnp.int32),
-        status=jnp.zeros((G, W), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
         is_config=jnp.zeros((G, W), bool),
-        slot_epoch=jnp.full((G, W), -1, jnp.int32),
+        slot_epoch=jnp.full((G, W), -1, DTYPE_ROUND),
         propose_tick=jnp.full((G, W), INF, jnp.int32),
         last_send=jnp.full((G, W), INF, jnp.int32),
         p2a_arrival=jnp.full((P, G, W), INF, jnp.int32),
         p2b_arrival=jnp.full((P, G, W), INF, jnp.int32),
         voted=jnp.zeros((P, G, W), bool),
-        vote_epoch=jnp.full((P, G, W), -1, jnp.int32),
-        epoch=jnp.zeros((G,), jnp.int32),
+        vote_epoch=jnp.full((P, G, W), -1, DTYPE_ROUND),
+        epoch=jnp.zeros((G,), DTYPE_ROUND),
         boundary=jnp.full((G,), INF, jnp.int32),
         p1_done=jnp.zeros((G,), bool),
         p1a_arrival=jnp.full((P, G), INF, jnp.int32),
@@ -399,7 +401,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedHorizontalConfig,
     state: BatchedHorizontalState,

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_delivered,
@@ -118,7 +119,7 @@ def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
     return BatchedMenciusState(
         next_slot=jnp.zeros((L,), jnp.int32),
         head=jnp.zeros((L,), jnp.int32),
-        status=jnp.zeros((L, W), jnp.int32),
+        status=jnp.zeros((L, W), DTYPE_STATUS),
         slot_value=jnp.full((L, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((L, W), INF, jnp.int32),
         last_send=jnp.full((L, W), INF, jnp.int32),
@@ -307,7 +308,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedMenciusConfig,
     state: BatchedMenciusState,

@@ -50,6 +50,9 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_COUNT,
+    DTYPE_ROUND,
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_delivered,
@@ -186,6 +189,9 @@ class BatchedMultiPaxosConfig:
     def __post_init__(self):
         assert self.f >= 1
         assert self.window >= 2 * self.slots_per_tick
+        # heartbeat_miss saturates at the timeout in DTYPE_COUNT (int16);
+        # miss + 1 must also fit, so the bound is 2**15 - 1 exclusive.
+        assert self.heartbeat_timeout < 2**15 - 1
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
         assert self.read_mode in READ_MODES
@@ -309,21 +315,21 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     RW = cfg.read_window
     return BatchedMultiPaxosState(
-        leader_round=jnp.zeros((G,), jnp.int32),
+        leader_round=jnp.zeros((G,), DTYPE_ROUND),
         next_slot=jnp.zeros((G,), jnp.int32),
         head=jnp.zeros((G,), jnp.int32),
-        status=jnp.zeros((G, W), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
         slot_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((G, W), INF, jnp.int32),
         last_send=jnp.full((G, W), INF, jnp.int32),
         chosen_tick=jnp.full((G, W), INF, jnp.int32),
-        chosen_round=jnp.full((G, W), -1, jnp.int32),
+        chosen_round=jnp.full((G, W), -1, DTYPE_ROUND),
         chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         replica_arrival=jnp.full((G, W), INF, jnp.int32),
-        acc_round=jnp.zeros((A, G), jnp.int32),
+        acc_round=jnp.zeros((A, G), DTYPE_ROUND),
         p2a_arrival=jnp.full((A, G, W), INF, jnp.int32),
         p2b_arrival=jnp.full((A, G, W), INF, jnp.int32),
-        vote_round=jnp.full((A, G, W), -1, jnp.int32),
+        vote_round=jnp.full((A, G, W), -1, DTYPE_ROUND),
         vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
         executed=jnp.zeros((G,), jnp.int32),
         committed=jnp.zeros((), jnp.int32),
@@ -331,13 +337,13 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         leader_alive=jnp.ones((cfg.num_leader_candidates, G), bool),
-        heartbeat_miss=jnp.zeros((G,), jnp.int32),
+        heartbeat_miss=jnp.zeros((G,), DTYPE_COUNT),
         elections=jnp.zeros((), jnp.int32),
-        recon_phase=jnp.zeros((G,), jnp.int32),
-        config_epoch=jnp.zeros((G,), jnp.int32),
-        rc_round=jnp.zeros((G,), jnp.int32),
-        rc_epoch=jnp.zeros((G,), jnp.int32),
-        mm_epoch=jnp.zeros((cfg.num_matchmakers, G), jnp.int32),
+        recon_phase=jnp.zeros((G,), DTYPE_STATUS),
+        config_epoch=jnp.zeros((G,), DTYPE_ROUND),
+        rc_round=jnp.zeros((G,), DTYPE_ROUND),
+        rc_epoch=jnp.zeros((G,), DTYPE_ROUND),
+        mm_epoch=jnp.zeros((cfg.num_matchmakers, G), DTYPE_ROUND),
         matcha_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
         matchb_arrival=jnp.full((cfg.num_matchmakers, G), INF, jnp.int32),
         rc_p1a_arrival=jnp.full((A, G), INF, jnp.int32),
@@ -374,7 +380,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         req_arrival=jnp.full((A, G, RW), INF, jnp.int32),
         resp_slot=jnp.full((A, G, RW), -1, jnp.int32),
         resp_arrival=jnp.full((A, G, RW), INF, jnp.int32),
-        rb_status=jnp.zeros((G, RW), jnp.int32),
+        rb_status=jnp.zeros((G, RW), DTYPE_STATUS),
         rb_count=jnp.zeros((G, RW), jnp.int32),
         rb_wave=jnp.full((G, RW), -1, jnp.int32),
         rb_issue=jnp.full((G, RW), INF, jnp.int32),
@@ -450,10 +456,18 @@ def tick(
             leader_alive = jnp.where(leader_alive, ~dies, revives)
         owner = leader_round % C
         owner_alive = jnp.take_along_axis(leader_alive, owner[None, :], axis=0)[0]
-        heartbeat_miss = jnp.where(owner_alive, 0, heartbeat_miss + 1)
+        # Clamped at the timeout: only miss >= timeout is ever tested, so
+        # the counter saturating there is observably identical to counting
+        # forever — and it keeps DTYPE_COUNT overflow-safe through
+        # arbitrarily long all-candidates-dead stretches.
+        heartbeat_miss = jnp.where(
+            owner_alive,
+            0,
+            jnp.minimum(heartbeat_miss + 1, cfg.heartbeat_timeout),
+        )
         # Next alive candidate in round-robin order (C is tiny and
         # static: an unrolled first-match scan).
-        delta = jnp.zeros((G,), jnp.int32)
+        delta = jnp.zeros((G,), leader_round.dtype)
         found = jnp.zeros((G,), bool)
         for d in range(1, C + 1):
             idx = (leader_round + d) % C
@@ -620,6 +634,10 @@ def tick(
         # HBM exactly once for the whole vote + quorum-count phase.
         from frankenpaxos_tpu import ops
 
+        # The kernel is int32-only; round arrays widen at this boundary
+        # and narrow back on the way out (values are unchanged — rounds
+        # fit DTYPE_ROUND by policy), keeping the XLA and Pallas paths
+        # bit-identical.
         (
             vote_round,
             vote_value,
@@ -628,10 +646,10 @@ def tick(
             nvotes,
         ) = ops.fused_vote_quorum(
             p2a_in,
-            acc_round_in,
-            leader_round,
+            acc_round_in.astype(jnp.int32),
+            leader_round.astype(jnp.int32),
             slot_value_in,
-            vote_round_in,
+            vote_round_in.astype(jnp.int32),
             vote_value_in,
             p2b_in,
             p2b_lat,
@@ -642,6 +660,8 @@ def tick(
             # v5e pods); interpret everywhere else (CPU CI, GPU).
             interpret=jax.default_backend() not in ("tpu", "axon"),
         )
+        vote_round = vote_round.astype(vote_round_in.dtype)
+        new_acc_round = new_acc_round.astype(acc_round_in.dtype)
     else:
         arrived = p2a_in == t  # [A, G, W]
         msg_round = leader_round[None, :, None]  # one round in flight
@@ -1266,7 +1286,7 @@ def reconfigure(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedMultiPaxosConfig,
     state: BatchedMultiPaxosState,
@@ -1274,7 +1294,13 @@ def run_ticks(
     num_ticks: int,
     key: jnp.ndarray,
 ) -> Tuple[BatchedMultiPaxosState, jnp.ndarray]:
-    """Run ``num_ticks`` ticks under lax.scan; returns (state, t0+num_ticks)."""
+    """Run ``num_ticks`` ticks under lax.scan; returns (state, t0+num_ticks).
+
+    ``state`` is DONATED: its buffers alias the output state, so the
+    whole cluster state is single-buffered in device memory across
+    segments instead of double-buffered. Callers must not touch the
+    passed-in state afterwards — rebind it (``state, t = run_ticks(cfg,
+    state, ...)``) like every call site in the repo does."""
 
     def step(carry, i):
         st, t = carry

@@ -242,7 +242,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedScalogConfig,
     state: BatchedScalogState,

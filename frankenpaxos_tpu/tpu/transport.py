@@ -53,6 +53,10 @@ class TpuSimTransport:
         self.state = state
 
     def run(self, num_ticks: int) -> None:
+        # run_ticks DONATES the state argument (single-buffered in device
+        # memory); rebinding self.state to the returned state is the
+        # donation contract — any alias of the previous self.state is
+        # dead after this call.
         key = jax.random.fold_in(self.key, self._epoch)
         self._epoch += 1
         if self.mesh is not None:

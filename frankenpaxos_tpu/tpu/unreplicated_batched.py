@@ -22,7 +22,12 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu.common import (
+    DTYPE_STATUS,
+    INF,
+    LAT_BINS,
+    bit_latency,
+)
 
 U_EMPTY = 0
 U_REQ = 1  # request in flight to the server
@@ -57,7 +62,7 @@ class BatchedUnreplicatedState:
 def init_state(cfg: BatchedUnreplicatedConfig) -> BatchedUnreplicatedState:
     G, W = cfg.num_servers, cfg.window
     return BatchedUnreplicatedState(
-        status=jnp.zeros((G, W), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
         issue=jnp.full((G, W), INF, jnp.int32),
         arrival=jnp.full((G, W), INF, jnp.int32),
         executed=jnp.zeros((G,), jnp.int32),
@@ -116,7 +121,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedUnreplicatedConfig,
     state: BatchedUnreplicatedState,

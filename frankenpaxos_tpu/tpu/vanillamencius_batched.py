@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_ROUND,
+    DTYPE_STATUS,
     INF,
     LAT_BINS,
     bit_delivered,
@@ -139,20 +141,20 @@ def init_state(
     return BatchedVanillaMenciusState(
         next_slot=jnp.zeros((L,), jnp.int32),
         head=jnp.zeros((L,), jnp.int32),
-        status=jnp.zeros((L, W), jnp.int32),
+        status=jnp.zeros((L, W), DTYPE_STATUS),
         slot_value=jnp.full((L, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((L, W), INF, jnp.int32),
         last_send=jnp.full((L, W), INF, jnp.int32),
         replica_arrival=jnp.full((L, W), INF, jnp.int32),
         chosen_value=jnp.full((L, W), NO_VALUE, jnp.int32),
         committed_prefix=jnp.zeros((L,), jnp.int32),
-        acc_round=jnp.zeros((L, W, A), jnp.int32),
+        acc_round=jnp.zeros((L, W, A), DTYPE_ROUND),
         voted=jnp.zeros((L, W, A), bool),
         voted_r1=jnp.zeros((L, W, A), bool),
         p2a_arrival=jnp.full((L, W, A), INF, jnp.int32),
         p2b_arrival=jnp.full((L, W, A), INF, jnp.int32),
         alive=jnp.ones((L,), bool),
-        rv_phase=jnp.zeros((L, W), jnp.int32),
+        rv_phase=jnp.zeros((L, W), DTYPE_STATUS),
         rv_value=jnp.full((L, W), NO_VALUE, jnp.int32),
         rv_p1a_arrival=jnp.full((L, W, A), INF, jnp.int32),
         rv_p1b_arrival=jnp.full((L, W, A), INF, jnp.int32),
@@ -452,7 +454,7 @@ def tick(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
 def run_ticks(
     cfg: BatchedVanillaMenciusConfig,
     state: BatchedVanillaMenciusState,

@@ -1,12 +1,29 @@
 #!/usr/bin/env python
-"""One-off: measure the acceptor-major layout + Pallas kernel on the live
-TPU at the headline config. Appends rows to results/tpu_layout_r03.json."""
+"""One-off: measure the acceptor-major layout + Pallas kernel + the
+HBM-bandwidth pass (dtype narrowing + buffer donation) on the live TPU
+at the headline config. Appends rows to results/tpu_layout_r03.json.
+
+Each row carries ticks/sec AND the memory side of the story:
+``state_bytes`` (the dtype-policy footprint), ``bytes_per_tick`` (the
+2 x state elementwise-sweep bound), and XLA's compiled memory analysis
+(argument/output/temp/alias bytes — donation shows up as alias bytes,
+and ``peak_bytes`` = arg + out + temp - alias is the measured peak the
+acceptance criteria track). The measurement logic itself lives in
+frankenpaxos_tpu.harness.microbench (compiled_memory_stats / bench_hbm)
+so this script and the CPU tier-1 bench cannot drift apart.
+"""
 import json
 import time
 
 import jax
 
+from frankenpaxos_tpu.harness.microbench import (
+    bench_hbm,
+    compiled_memory_stats,
+)
 from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig, TpuSimTransport
+from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+from frankenpaxos_tpu.tpu.common import state_nbytes
 
 rows = []
 for name, kw in [
@@ -27,16 +44,38 @@ for name, kw in [
         t0 = time.perf_counter()
         sim.run(1000); sim.block_until_ready()
         dt = time.perf_counter() - t0
+        state0 = mb.init_state(cfg)
         row = {
             "variant": name, "ticks_per_sec": round(1000 / dt, 1),
             "committed_per_sec": round((sim.committed() - c0) / dt, 1),
             "p50_ticks": sim.stats()["commit_latency_p50_ticks"],
             "invariants_ok": all(sim.check_invariants().values()),
+            "state_bytes": state_nbytes(state0),
+            "bytes_per_tick": 2 * state_nbytes(state0),
         }
+        row.update(compiled_memory_stats(mb.run_ticks, cfg, state0, 1000))
     except Exception as e:  # record compile failures instead of dying
         row = {"variant": name, "error": repr(e)[:500]}
     print(row, flush=True)
     rows.append(row)
+
+# The HBM pass isolated at the W64 XLA config: before (int32, no
+# donation) vs after (narrow dtypes, donated state) — the same
+# measurement the CPU tier-1 microbench records, at TPU scale.
+try:
+    for r in bench_hbm(
+        num_groups=3334, window=64, slots_per_tick=8, ticks=1000,
+        cases=("int32_nodonate", "narrow_donate"),
+    ):
+        label = {
+            "int32_nodonate": "hbm_before_int32_nodonate",
+            "narrow_donate": "hbm_after_narrow_donate",
+        }[r["case"]]
+        row = dict(r, variant=label)
+        print(row, flush=True)
+        rows.append(row)
+except Exception as e:
+    rows.append({"variant": "hbm_before_after", "error": repr(e)[:500]})
 
 with open("results/tpu_layout_r03.json", "w") as f:
     json.dump({"device": str(jax.devices()[0]), "rows": rows}, f, indent=1)

@@ -19,6 +19,28 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite's wall clock is dominated
+# by compiling the batched backends (each test file's configs compile
+# fresh programs); with the cache warm, repeated tier-1 runs skip most
+# of that. Keyed by program + flags, so correctness is unaffected; the
+# first run pays full price and fills the cache.
+_CACHE_DIR = os.environ.get(
+    "FRANKENPAXOS_JAX_CACHE", "/tmp/frankenpaxos_jax_cache"
+)
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass  # older jax without the persistent cache: run uncached
+
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy cases excluded from the tier-1 budget "
+        "(run with -m slow or no marker filter)",
+    )

@@ -226,6 +226,30 @@ def test_microbench_smoke():
     assert all(r["ops_per_sec"] > 0 for r in rows)
 
 
+def test_microbench_hbm_smoke():
+    """The HBM-bandwidth device bench at toy size, before/after pair
+    only (each variant costs two XLA compiles; the two intermediate
+    variants are CLI-only). The shipped variant (narrow+donate) must
+    measure a strictly smaller state footprint than the int32 reference
+    AND a nonzero aliased (donated) size; the non-donating baseline
+    aliases nothing."""
+    from frankenpaxos_tpu.harness import microbench
+
+    rows = microbench.bench_hbm(
+        num_groups=8, window=16, slots_per_tick=2, ticks=10,
+        cases=("int32_nodonate", "narrow_donate"),
+    )
+    by_case = {r["case"]: r for r in rows}
+    assert set(by_case) == {"int32_nodonate", "narrow_donate"}
+    before = by_case["int32_nodonate"]
+    after = by_case["narrow_donate"]
+    assert after["state_bytes"] < before["state_bytes"]
+    assert before["alias_bytes"] == 0
+    assert after["alias_bytes"] > 0
+    assert after["peak_bytes"] < before["peak_bytes"]
+    assert all(r["ops_per_sec"] > 0 for r in rows)
+
+
 def test_deploy_smoke_profiles_a_role(tmp_path):
     """profile_role wraps one role with cProfile and the pstats dump
     lands in the bench dir (perf_util.py capability)."""

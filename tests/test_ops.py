@@ -76,7 +76,11 @@ def test_reference_matches_tick_phase():
     # Recompute the tick's own per-message samples for t=1 (same key
     # derivation as multipaxos_batched.tick steps 0-1).
     tkey = jax.random.fold_in(key, 1)
-    k3, k2, k_extra = jax.random.split(tkey, 3)
+    # Split into FIVE like tick does: threefry split derives key i from
+    # counters (i, num+i), so split(key, 3)[0] != split(key, 5)[0] — a
+    # 3-way split here would replay different latency/drop bits than the
+    # tick actually used.
+    k3, k2, k_extra, k_read, k_fail = jax.random.split(tkey, 5)
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     bits3 = jax.random.bits(k3, (A, G, W))
     p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)

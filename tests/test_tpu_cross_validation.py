@@ -426,3 +426,168 @@ def test_cross_validation_scalog_cuts():
                 )
         prev_vec = cut_vec
     assert predicted == replica_log, (predicted, replica_log)
+
+
+# -- Dtype policy: narrowed state vs the int32 reference path -----------------
+#
+# The HBM-bandwidth pass stores status codes in int8 and ballot rounds /
+# epochs in int16 (tpu/common.py dtype policy). The tick functions are
+# dtype-polymorphic, so running the SAME tick on a widen_state()-upcast
+# int32 state replays the pre-narrowing semantics — the narrowed run must
+# match it BIT FOR BIT: every state field (after widening), stats(), and
+# check_invariants(), across multiple seeds.
+
+import pytest
+
+from frankenpaxos_tpu.tpu.common import widen_state
+
+DTYPE_SEEDS = [0, 1, 2]
+
+
+def _assert_states_bit_identical(narrow_final, wide_final, what):
+    assert type(narrow_final) is type(wide_final)
+    for f in dataclasses.fields(narrow_final):
+        a = np.asarray(getattr(widen_state(narrow_final), f.name))
+        b = np.asarray(getattr(wide_final, f.name))
+        assert a.dtype == b.dtype, (what, f.name, a.dtype, b.dtype)
+        np.testing.assert_array_equal(a, b, err_msg=f"{what}.{f.name}")
+
+
+@pytest.mark.parametrize("seed", DTYPE_SEEDS)
+def test_dtype_narrowing_multipaxos_flagship(seed):
+    """Flagship backend, base config: the narrowed run equals the int32
+    reference run bit for bit — state, stats(), and invariants."""
+    from frankenpaxos_tpu.tpu.multipaxos_batched import run_ticks
+    from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=3, drop_rate=0.05, retry_timeout=8,
+    )
+    sim = TpuSimTransport(cfg, seed=seed)
+    ref = TpuSimTransport(cfg, seed=seed)
+    ref.state = widen_state(ref.state)  # the int32 reference path
+    sim.run(120)
+    ref.run(120)
+    assert sim.stats() == ref.stats()
+    inv_n, inv_w = sim.check_invariants(), ref.check_invariants()
+    assert inv_n == inv_w
+    assert all(inv_n.values()), inv_n
+    _assert_states_bit_identical(sim.state, ref.state, "multipaxos")
+    # The reference path really is wider: same values, more bytes.
+    from frankenpaxos_tpu.tpu.common import state_nbytes
+
+    assert state_nbytes(ref.state) > state_nbytes(sim.state)
+
+
+@pytest.mark.parametrize("seed", DTYPE_SEEDS)
+def test_dtype_narrowing_multipaxos_full_feature(seed):
+    """Flagship backend with every optional subsystem live — device
+    elections + fault injection, matchmaker reconfiguration, the KV state
+    machine with injected duplicates, and linearizable reads — so every
+    narrowed field (rounds, epochs, phases, heartbeat counters, read-ring
+    statuses) is exercised."""
+    from frankenpaxos_tpu.tpu.multipaxos_batched import (
+        init_state as mp_init,
+        run_ticks as mp_run,
+    )
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=2, drop_rate=0.02, retry_timeout=8,
+        fail_rate=0.02, revive_rate=0.2, heartbeat_timeout=4,
+        reconfigure_every=25,
+        state_machine="kv", kv_keys=16, num_clients=4, dup_rate=0.05,
+        read_rate=2, read_window=8,
+    )
+    key = jax.random.PRNGKey(seed)
+    t0 = jnp.zeros((), jnp.int32)
+    narrow, tn = mp_run(cfg, mp_init(cfg), t0, 100, key)
+    wide, tw = mp_run(cfg, widen_state(mp_init(cfg)), t0, 100, key)
+    _assert_states_bit_identical(narrow, wide, "multipaxos-full")
+    inv = check_invariants(cfg, narrow, tn)
+    assert all(bool(v) for v in inv.values()), {
+        k: bool(v) for k, v in inv.items()
+    }
+
+
+@pytest.mark.parametrize("seed", DTYPE_SEEDS)
+@pytest.mark.parametrize(
+    "family",
+    # Tier-1 keeps one family per narrowed-dtype class (rounds-heavy
+    # caspaxos, phase/seat-epoch fasterpaxos, chunk-epoch horizontal,
+    # status-ring craq, and the cheap unreplicated ceiling) plus the two
+    # flagship tests above; the rest ride the full suite as slow — each
+    # family costs two fresh XLA compiles (narrow + wide reference) and
+    # tier-1 has a hard wall-clock budget.
+    ["caspaxos", "fasterpaxos", "horizontal", "craq", "unreplicated"]
+    + [
+        pytest.param(f, marks=pytest.mark.slow)
+        for f in ("mencius", "fastpaxos", "fastmultipaxos",
+                  "vanillamencius", "grid")
+    ],
+)
+def test_dtype_narrowing_families(seed, family):
+    """Every narrowed backend: the run on the narrowed state equals the
+    run on the widened (int32) state bit for bit."""
+    if family == "mencius":
+        import frankenpaxos_tpu.tpu.mencius_batched as m
+
+        cfg = m.BatchedMenciusConfig(
+            f=1, num_leaders=4, window=16, slots_per_tick=2,
+            idle_rate=0.2, skip_threshold=4, drop_rate=0.05,
+        )
+    elif family == "caspaxos":
+        import frankenpaxos_tpu.tpu.caspaxos_batched as m
+
+        cfg = m.BatchedCasPaxosConfig(num_registers=8, num_leaders=2)
+    elif family == "fastpaxos":
+        import frankenpaxos_tpu.tpu.fastpaxos_batched as m
+
+        cfg = m.BatchedFastPaxosConfig(
+            f=1, num_groups=4, window=8, conflict_rate=0.3
+        )
+    elif family == "fasterpaxos":
+        import frankenpaxos_tpu.tpu.fasterpaxos_batched as m
+
+        cfg = m.BatchedFasterPaxosConfig(
+            f=1, num_groups=4, window=16, fail_rate=0.02, revive_rate=0.2
+        )
+    elif family == "horizontal":
+        import frankenpaxos_tpu.tpu.horizontal_batched as m
+
+        cfg = m.BatchedHorizontalConfig(
+            f=1, num_groups=4, window=16, reconfigure_every=20
+        )
+    elif family == "craq":
+        import frankenpaxos_tpu.tpu.craq_batched as m
+
+        cfg = m.BatchedCraqConfig(num_chains=4)
+    elif family == "fastmultipaxos":
+        import frankenpaxos_tpu.tpu.fastmultipaxos_batched as m
+
+        cfg = m.BatchedFastMultiPaxosConfig(f=1, num_groups=4)
+    elif family == "vanillamencius":
+        import frankenpaxos_tpu.tpu.vanillamencius_batched as m
+
+        cfg = m.BatchedVanillaMenciusConfig(
+            f=1, num_servers=3, window=16, fail_rate=0.02, revive_rate=0.2
+        )
+    elif family == "unreplicated":
+        import frankenpaxos_tpu.tpu.unreplicated_batched as m
+
+        cfg = m.BatchedUnreplicatedConfig(num_servers=4)
+    else:
+        import frankenpaxos_tpu.tpu.grid_batched as m
+
+        cfg = m.GridBatchedConfig(rows=3, cols=3, drop_rate=0.05)
+
+    key = jax.random.PRNGKey(seed)
+    t0 = jnp.zeros((), jnp.int32)
+    narrow, tn = m.run_ticks(cfg, m.init_state(cfg), t0, 80, key)
+    wide, tw = m.run_ticks(cfg, widen_state(m.init_state(cfg)), t0, 80, key)
+    _assert_states_bit_identical(narrow, wide, family)
+    inv = m.check_invariants(cfg, narrow, tn)
+    assert all(bool(v) for v in inv.values()), {
+        k: bool(v) for k, v in inv.items()
+    }
