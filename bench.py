@@ -17,7 +17,13 @@ and exit code 0, with an honest "device" field.
 ``--live-only`` disables the last-known-good replay: the headline is
 whatever ran live this invocation, never a stale TPU capture. The JSON
 also carries a "memory" block (device peak_bytes_in_use when the
-backend's allocator reports it, plus the dtype-policy state footprint).
+backend's allocator reports it — process peak RSS labeled source="rss"
+otherwise — plus the dtype-policy state footprint).
+
+``--telemetry`` additionally measures the device-side metric ring's
+overhead head-to-head (default ring vs zero-width ring) against the <2%
+ticks/sec budget, and embeds the captured ring (renderable by
+``python -m frankenpaxos_tpu.monitoring.dashboard <result.json>``).
 """
 
 from __future__ import annotations
@@ -125,14 +131,27 @@ def _inner_main() -> None:
     throughput = committed / elapsed
     ticks = segments * ticks_per_segment
     # Device memory accounting for the HBM-bandwidth pass: peak bytes in
-    # use as the device runtime reports them (None on backends without
-    # an allocator stats API, e.g. CPU — reported honestly as null), plus
-    # the dtype-policy state footprint computed from the live state.
+    # use as the device runtime reports them, plus the dtype-policy state
+    # footprint computed from the live state. Backends without an
+    # allocator stats API (CPU) fall back to the process's peak RSS so
+    # CPU runs report a real number — labeled by source ("xla" vs "rss";
+    # RSS covers the whole process, not just simulation state, so the
+    # two are comparable only within a source).
     mem_stats = jax.devices()[0].memory_stats() or {}
     from frankenpaxos_tpu.tpu.common import state_nbytes
 
+    peak = mem_stats.get("peak_bytes_in_use")
+    if peak is not None:
+        mem_source = "xla"
+    else:
+        import resource
+
+        # ru_maxrss is KiB on Linux (bytes on macOS — not this box).
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        mem_source = "rss"
     memory = {
-        "peak_bytes_in_use": mem_stats.get("peak_bytes_in_use"),
+        "peak_bytes_in_use": peak,
+        "source": mem_source,
         "bytes_in_use": mem_stats.get("bytes_in_use"),
         "state_bytes": state_nbytes(sim.state),
     }
@@ -151,6 +170,50 @@ def _inner_main() -> None:
         "calibration": calib_rows,
         "memory": memory,
     }
+
+    # Telemetry overhead budget (--telemetry): the device-side metric
+    # ring (tpu/telemetry.py) must cost <2% ticks/sec on this flagship
+    # config. Measured head-to-head: the shipped default ring vs a
+    # ZERO-WIDTH ring (record() no-ops at trace time, so XLA removes
+    # every telemetry computation — the true without-telemetry
+    # baseline). Both numbers land in the results JSON; the budget
+    # verdict is `overhead_ok` (ticks/sec with >= 0.98x without). The
+    # hard `assert` is opt-in via BENCH_STRICT_TELEMETRY=1 because this
+    # script's stdout contract ("every path ends in a one-line JSON,
+    # exit 0") outranks failing the whole bench on a noisy-box blip.
+    if "--telemetry" in sys.argv:
+        if over_budget():
+            result.setdefault("skipped_variants", []).append(
+                f"telemetry (soft budget {soft_budget:.0f}s exceeded)"
+            )
+        else:
+            from frankenpaxos_tpu.harness.microbench import (
+                measure_telemetry_overhead,
+            )
+
+            measured = measure_telemetry_overhead(cfg, ticks=300)
+            ratio = measured["ratio"]
+            result["telemetry"] = {
+                "ticks_per_sec_with": round(measured["rates"]["ring_on"], 1),
+                "ticks_per_sec_without": round(
+                    measured["rates"]["ring_off"], 1
+                ),
+                "ratio": round(ratio, 4),
+                "overhead_ok": ratio >= 0.98,
+                # The captured ring: feed this JSON straight to
+                # `python -m frankenpaxos_tpu.monitoring.dashboard`.
+                **measured["sim_on"].telemetry_dict(),
+            }
+            if ratio < 0.98:
+                print(
+                    f"warning: telemetry overhead budget MISSED "
+                    f"(ratio {ratio:.4f} < 0.98)",
+                    file=sys.stderr,
+                )
+            if os.environ.get("BENCH_STRICT_TELEMETRY"):
+                assert ratio >= 0.98, (
+                    f"telemetry overhead over budget: {ratio:.4f} < 0.98"
+                )
 
     # Secondary: the same cluster serving reads alongside writes through
     # the device-resident ReadBatchers (ReadBatcher.scala:239-338;
@@ -279,10 +342,14 @@ def _probe_tpu(timeout: float = 60.0) -> bool:
 
 
 def _run_inner(env: dict, timeout: float):
-    """Run the measurement subprocess; return (result dict | None, note)."""
+    """Run the measurement subprocess; return (result dict | None, note).
+    Pass-through flags (--telemetry) ride along to the inner process."""
+    argv = [sys.executable, os.path.abspath(__file__), "--inner"]
+    if "--telemetry" in sys.argv:
+        argv.append("--telemetry")
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--inner"],
+            argv,
             env=env,
             cwd=_REPO,
             capture_output=True,

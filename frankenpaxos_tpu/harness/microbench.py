@@ -323,6 +323,109 @@ def bench_hbm(
     return rows
 
 
+def measure_telemetry_overhead(cfg, ticks: int, rounds: int = 3) -> dict:
+    """Head-to-head telemetry-ring overhead on one config: ``ring_off``
+    (zero-width ring — record() no-ops at trace time, XLA removes every
+    telemetry computation) vs ``ring_on`` (the shipped default ring).
+
+    INTERLEAVED best-of-``rounds`` segments after a warm/compile
+    segment each: a 2% budget question cannot survive sequential
+    per-variant timing on a shared box (observed ±30% between
+    back-to-back identical segments); interleaving makes both variants
+    sample the same noise environment. Shared by the ``telemetry``
+    device bench below and ``bench.py --telemetry``.
+
+    Returns ``{"seconds": {case: best}, "rates": {case: ticks/sec},
+    "ratio": on/off, "sim_on": <the ring_on transport>}`` (``sim_on``
+    has run ``(rounds + 1) * ticks`` ticks — its ring feeds the
+    per-phase breakdown)."""
+    import time
+
+    from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+    sims = {}
+    best = {}
+    for case, tel_window in (("ring_off", 0), ("ring_on", None)):
+        sims[case] = TpuSimTransport(cfg, seed=0, telemetry_window=tel_window)
+        sims[case].run(ticks)  # compile + warm
+        sims[case].block_until_ready()
+        best[case] = float("inf")
+    for _ in range(rounds):
+        for case in ("ring_off", "ring_on"):
+            start = time.perf_counter()
+            sims[case].run(ticks)
+            sims[case].block_until_ready()
+            best[case] = min(best[case], time.perf_counter() - start)
+    rates = {case: ticks / s for case, s in best.items()}
+    return {
+        "seconds": best,
+        "rates": rates,
+        "ratio": rates["ring_on"] / rates["ring_off"],
+        "sim_on": sims["ring_on"],
+        "total_ticks_on": (rounds + 1) * ticks,
+    }
+
+
+def bench_telemetry(
+    num_groups: int = 3334,
+    window: int = 64,
+    slots_per_tick: int = 8,
+    ticks: int = 200,
+) -> List[dict]:
+    """The device-telemetry pass, measured on the flagship 10k-acceptor
+    config: ``ring_off`` (zero-width ring — record() no-ops at trace
+    time, XLA removes every telemetry computation) vs ``ring_on`` (the
+    shipped default ring). Each row reports ticks/sec; the on-row's
+    ``TELEM_JSON`` line adds the per-phase throughput breakdown read
+    FROM the ring itself (commits/executes/proposals/phase-plane
+    messages per second) alongside the overhead ratio — the per-phase
+    accounting the hbm block can't see."""
+    import json
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig
+    from frankenpaxos_tpu.tpu.telemetry import COUNTER_FIELDS
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=num_groups,
+        window=window,
+        slots_per_tick=slots_per_tick,
+        lat_min=1,
+        lat_max=3,
+        drop_rate=0.0,
+        retry_timeout=16,
+        thrifty=True,
+    )
+    measured = measure_telemetry_overhead(cfg, ticks)
+    rows = []
+    for case in ("ring_off", "ring_on"):
+        seconds = measured["seconds"][case]
+        row = _report("telemetry", case, ticks, seconds)
+        if case == "ring_on":
+            summary = measured["sim_on"].telemetry_summary()
+            # events/sec = (events/tick over the whole run) x (ticks/sec
+            # of the best measured segment).
+            ticks_run = measured["total_ticks_on"]
+            per_phase = {
+                f"{name}_per_sec": round(
+                    summary[f"{name}_total"] / ticks_run * (ticks / seconds),
+                    1,
+                )
+                for name in COUNTER_FIELDS
+                if name != "queue_depth"
+            }
+            row.update(
+                {
+                    "overhead_ratio": round(measured["ratio"], 4),
+                    "num_acceptors": cfg.num_acceptors,
+                    **per_phase,
+                }
+            )
+            print("TELEM_JSON " + json.dumps(row))
+        rows.append(row)
+    return rows
+
+
 BENCHES = {
     "depgraph": bench_depgraph,
     "int_prefix_set": bench_int_prefix_set,
@@ -336,6 +439,7 @@ BENCHES = {
 # them up with the Python hot-path benches.
 DEVICE_BENCHES = {
     "hbm": bench_hbm,
+    "telemetry": bench_telemetry,
 }
 
 

@@ -1,15 +1,21 @@
 """Dashboard rendering from a metrics capture (the analog of the
 reference's 15 Grafana dashboards, ``grafana/dashboards/*.json``): one
 command turns a benchmark's ``metrics.csv`` into a multi-panel figure of
-per-role request rates and handler latencies.
+per-role request rates and handler latencies — or a DEVICE-SIDE
+telemetry capture (``tpu/telemetry.py`` ``to_dict()`` JSON, e.g. the
+``telemetry`` block of ``bench.py --telemetry`` results) into
+commit-rate, phase-mix, latency-histogram, and queue-depth panels.
 
     python -m frankenpaxos_tpu.monitoring.dashboard <bench_dir_or_csv> \\
+        [-o dashboard.png]
+    python -m frankenpaxos_tpu.monitoring.dashboard telemetry.json \\
         [-o dashboard.png]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Optional
@@ -75,22 +81,124 @@ def render_dashboard(
     return output
 
 
+def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
+    """Render a device-side telemetry capture (``tpu/telemetry.py``
+    ``to_dict()`` shape: ``{"series": {...}, "lat_hist": [...],
+    "queue_hist": [...], ...}``) as a four-panel figure:
+
+      1. commit/execute/proposal rate per tick over the retained ring
+         (the commit-rate panel of the acceptance criteria);
+      2. phase message mix per tick (phase1/phase2/retries/drops);
+      3. the commit-latency histogram (fixed LAT_BINS tick bins);
+      4. queue depth per tick + the occupancy-fraction histogram.
+
+    Returns the output path, or None when the capture holds no ticks."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    series = capture.get("series", {})
+    ticks = series.get("tick", [])
+    if not len(ticks):
+        return None
+
+    fig, axes = plt.subplots(4, 1, figsize=(9, 12))
+
+    ax = axes[0]
+    for name in ("commits", "executes", "proposals"):
+        ax.plot(ticks, series.get(name, []), label=name)
+    ax.set_title(
+        f"device commit rate per tick (last {len(ticks)} of "
+        f"{capture.get('ticks', '?')} ticks)",
+        fontsize=9,
+    )
+    ax.set_ylabel("events/tick")
+    ax.legend(fontsize=7)
+    ax.grid(True)
+
+    ax = axes[1]
+    for name in ("phase1_msgs", "phase2_msgs", "retries", "drops",
+                 "leader_changes"):
+        vals = series.get(name, [])
+        if any(vals):
+            ax.plot(ticks, vals, label=name)
+    ax.set_title("phase message mix per tick", fontsize=9)
+    ax.set_ylabel("messages/tick")
+    ax.legend(fontsize=7)
+    ax.grid(True)
+
+    ax = axes[2]
+    lat_hist = capture.get("lat_hist", [])
+    ax.bar(range(len(lat_hist)), lat_hist, width=1.0)
+    ax.set_title("commit latency histogram (ticks)", fontsize=9)
+    ax.set_xlabel("latency (ticks)")
+    ax.set_ylabel("commits")
+    ax.grid(True)
+
+    ax = axes[3]
+    ax.plot(ticks, series.get("queue_depth", []), label="queue depth")
+    ax.set_title("in-flight queue depth per tick", fontsize=9)
+    ax.set_xlabel("tick")
+    ax.set_ylabel("slots")
+    ax.grid(True)
+    qh = capture.get("queue_hist", [])
+    if any(qh):
+        inset = ax.inset_axes([0.7, 0.55, 0.28, 0.4])
+        inset.bar(range(len(qh)), qh, width=1.0)
+        inset.set_title("occupancy hist", fontsize=6)
+        inset.tick_params(labelsize=5)
+
+    fig.tight_layout()
+    fig.savefig(output)
+    plt.close(fig)
+    return output
+
+
+def _load_telemetry_capture(path: str) -> Optional[dict]:
+    """The telemetry dict if ``path`` is a telemetry JSON capture (bare
+    ``to_dict()`` output, or any JSON object carrying one under a
+    ``"telemetry"`` key, e.g. a bench.py --telemetry result)."""
+    if not path.endswith(".json"):
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if "series" in payload and "lat_hist" in payload:
+        return payload
+    nested = payload.get("telemetry")
+    if isinstance(nested, dict) and "series" in nested:
+        return nested
+    return None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         prog="frankenpaxos_tpu.monitoring.dashboard"
     )
-    parser.add_argument("path", help="metrics.csv or a benchmark directory")
+    parser.add_argument(
+        "path",
+        help="metrics.csv, a benchmark directory, or a telemetry JSON "
+        "capture (tpu/telemetry.py to_dict / bench.py --telemetry)",
+    )
     parser.add_argument("-o", "--output", default=None)
     args = parser.parse_args()
 
     path = args.path
     if os.path.isdir(path):
         path = os.path.join(path, "metrics.csv")
-    capture = MetricsCapture(path)
     output = args.output or os.path.join(
         os.path.dirname(os.path.abspath(path)), "dashboard.png"
     )
-    result = render_dashboard(capture, output)
+    telemetry = _load_telemetry_capture(path)
+    if telemetry is not None:
+        result = render_telemetry_dashboard(telemetry, output)
+    else:
+        result = render_dashboard(MetricsCapture(path), output)
     if result is None:
         print("no plottable metrics in capture", file=sys.stderr)
         sys.exit(1)

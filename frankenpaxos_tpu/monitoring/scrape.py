@@ -64,6 +64,78 @@ def parse_exposition(text: str) -> List[Tuple[str, Tuple[Tuple[str, str], ...], 
     return samples
 
 
+def append_device_samples(
+    csv_path: str,
+    telemetry,
+    job: str = "device",
+    instance: str = "sim",
+    ts: Optional[float] = None,
+) -> int:
+    """Append one scrape of a device-side Telemetry ring
+    (``tpu/telemetry.py``) to a scraper CSV, unifying device metrics
+    with the host scraper's schema (``ts,job,instance,name,labels,
+    value``) so ``MetricsCapture`` / the dashboard query both under the
+    one ``fpx_*`` naming scheme. Accepts a live/fetched Telemetry (its
+    exposition lines are rendered here) and returns the number of
+    samples appended. Creates the file with a header when absent."""
+    import os
+
+    from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+
+    text = "\n".join(telemetry_mod.exposition_lines(telemetry))
+    samples = parse_exposition(text)
+    ts = time.time() if ts is None else ts
+    new_file = not os.path.exists(csv_path)
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(
+                ["ts", "job", "instance", "name", "labels", "value"]
+            )
+        for name, labels, value in samples:
+            label_str = ";".join(f"{k}={v}" for k, v in labels)
+            writer.writerow([ts, job, instance, name, label_str, value])
+    return len(samples)
+
+
+def append_host_spans(
+    csv_path: str,
+    spans: List[dict],
+    job: str = "host",
+    instance: str = "transport",
+) -> int:
+    """Append a transport's host-side trace spans (``TpuSimTransport.
+    trace()``) to the same scraper CSV as ``fpx_host_span_seconds``
+    samples (labels: span name + compile flag), stamped with each
+    span's own wall clock — the host half of the unified scheme."""
+    import os
+
+    new_file = not os.path.exists(csv_path)
+    n = 0
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(
+                ["ts", "job", "instance", "name", "labels", "value"]
+            )
+        for span in spans:
+            labels = f"span={span['name']}"
+            if span.get("compile"):
+                labels += ";compile=true"
+            writer.writerow(
+                [
+                    span["start_unix"],
+                    job,
+                    instance,
+                    "fpx_host_span_seconds",
+                    labels,
+                    span["duration_s"],
+                ]
+            )
+            n += 1
+    return n
+
+
 class MetricsScraper:
     """Polls each job's targets and appends samples to a CSV with columns
     ``ts,job,instance,name,labels,value`` (labels as ``k=v;k=v``)."""

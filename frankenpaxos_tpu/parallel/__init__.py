@@ -49,6 +49,10 @@ def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
             "reads_done", "reads_shed", "read_lat_sum", "read_lat_hist",
             "read_lin_violations", "elections", "reconfigs", "configs_gcd",
             "sm_applied", "dups_filtered", "dups_seen",
+            # The telemetry ring holds cluster-wide per-tick reductions
+            # ([K, NUM_COLS] + histograms) — replicated; device_put
+            # broadcasts the spec over the nested pytree's leaves.
+            "telemetry",
         }
         # Acceptor-major arrays ([A, G, W] / [A, G] / [A, G, RW]) carry
         # the group axis second; everything else ([G, W] / [G]) first.
@@ -155,7 +159,7 @@ def epaxos_shardings(mesh: Mesh):
     replicated = {
         "committed_total", "fast_path_total", "executed_total",
         "retired_total", "coexecuted", "lat_sum", "lat_hist",
-        "snapshots_served", "rep_crashes", "rep_down",
+        "snapshots_served", "rep_crashes", "rep_down", "telemetry",
     }
     specs = {}
     for f in _dc.fields(eb.BatchedEPaxosState):

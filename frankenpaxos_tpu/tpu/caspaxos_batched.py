@@ -47,6 +47,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_delivered,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Leader status.
 L_IDLE = 0
@@ -132,6 +133,7 @@ class BatchedCasPaxosState:
     chain_violations: jnp.ndarray  # [] THE safety counter
     lat_sum: jnp.ndarray  # [] per-bit issue -> chosen latency
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
@@ -173,6 +175,7 @@ def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
         chain_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -385,6 +388,24 @@ def tick(
     backoff_until = jnp.where(ready, INF, backoff_until)
     up_arrival = jnp.where(send_p1, INF, up_arrival)  # drop stale replies
 
+    # Telemetry: newly issued register bits are "proposals", CAS round
+    # trips "commits", bits first visible in a chosen value "executes";
+    # nacked leaders re-entering phase 1 are the retry plane.
+    tel = record(
+        state.telemetry,
+        proposals=bits_issued - state.bits_issued,
+        phase1_msgs=A * jnp.sum(ready),
+        phase2_msgs=A * jnp.sum(p1_done),
+        commits=commits - state.commits,
+        executes=bits_chosen - state.bits_chosen,
+        retries=backoffs - state.backoffs,
+        queue_depth=jnp.sum(
+            (state.bit_issue < INF) & ~bit_done
+        ),
+        queue_capacity=G * NBITS,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedCasPaxosState(
         l_status=l_status,
         l_round=l_round,
@@ -417,6 +438,7 @@ def tick(
         chain_violations=chain_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

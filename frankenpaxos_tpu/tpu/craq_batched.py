@@ -47,6 +47,7 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Write slot status.
 W_EMPTY = 0
@@ -122,6 +123,7 @@ class BatchedCraqState:
     read_lat_sum: jnp.ndarray  # []
     read_lat_hist: jnp.ndarray  # [LAT_BINS]
     read_lin_violations: jnp.ndarray  # [] reads below their floor
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
@@ -153,6 +155,7 @@ def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         read_lin_violations=jnp.zeros((), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -332,6 +335,20 @@ def tick(
     w_issue = jnp.where(issue_w, t, state.w_issue)
     next_version = state.next_version + count_w
 
+    # Telemetry: writes entering the head are "proposals", tail applies
+    # are "commits", completed reads "executes"; dirty reads forwarded
+    # to the tail are the chain's extra message plane.
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(issue_w),
+        phase2_msgs=reads_dirty - state.reads_dirty,
+        commits=writes_done - state.writes_done,
+        executes=reads_done - state.reads_done,
+        queue_depth=jnp.sum(w_status != W_EMPTY),
+        queue_capacity=N * W,
+        lat_hist_delta=write_lat_hist - state.write_lat_hist,
+    )
+
     return BatchedCraqState(
         w_status=w_status,
         w_key=w_key,
@@ -358,6 +375,7 @@ def tick(
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
         read_lin_violations=read_lin_violations,
+        telemetry=tel,
     )
 
 

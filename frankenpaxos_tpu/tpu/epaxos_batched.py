@@ -63,6 +63,7 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     sample_latency,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 _LANES = 32  # columns per packed visibility word
 
@@ -197,6 +198,7 @@ class BatchedEPaxosState:
     # against TarjanDependencyGraph in tests/test_tpu_epaxos.py)
     lat_sum: jnp.ndarray  # [] sum of propose->execute latencies
     lat_hist: jnp.ndarray  # [LAT_BINS] execute latency histogram
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
@@ -224,6 +226,7 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         coexecuted=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -574,6 +577,22 @@ def tick(
     commit_tick = jnp.where(is_new, t + commit_lat, commit_tick)
     committed = committed & ~is_new
 
+    # Telemetry: PreAccept fan-outs are the phase-2 plane; slow-path
+    # Accept rounds show up as "retries" (the extra RTT the fast path
+    # avoids); replica crash events land in leader_changes.
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase2_msgs=(C - 1) * jnp.sum(is_new),
+        commits=n_new_commits,
+        executes=n_exec,
+        retries=jnp.sum(is_new & slow),
+        leader_changes=rep_crashes - state.rep_crashes,
+        queue_depth=jnp.sum(next_instance - head),
+        queue_capacity=C * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedEPaxosState(
         next_instance=next_instance,
         head=head,
@@ -596,6 +615,7 @@ def tick(
         coexecuted=coexecuted,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

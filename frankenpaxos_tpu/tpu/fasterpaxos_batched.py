@@ -45,6 +45,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
 PROPOSED = 1
@@ -128,6 +129,7 @@ class BatchedFasterPaxosState:
     choose_violations: jnp.ndarray  # []
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedFasterPaxosConfig) -> BatchedFasterPaxosState:
@@ -163,6 +165,7 @@ def init_state(cfg: BatchedFasterPaxosConfig) -> BatchedFasterPaxosState:
         choose_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -427,6 +430,23 @@ def tick(
     )
     last_send = jnp.where(timed_out, t, last_send)
 
+    new_group_wm = jnp.maximum(state.group_wm, group_wm)
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase1_msgs=A * (leader_changes - state.leader_changes),
+        phase2_msgs=jnp.sum(is_new[None, :, :, :] & delivered)
+        + A * jnp.sum(timed_out),
+        commits=committed - state.committed,
+        executes=jnp.sum(new_group_wm - state.group_wm),
+        drops=jnp.sum(is_new[None, :, :, :] & ~delivered),
+        retries=jnp.sum(timed_out),
+        leader_changes=leader_changes - state.leader_changes,
+        queue_depth=jnp.sum(next_ord - head),
+        queue_capacity=G * D * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedFasterPaxosState(
         round=round_,
         seat_epoch=seat_epoch,
@@ -451,12 +471,13 @@ def tick(
         p1b_arrival=p1b_arrival,
         committed=committed,
         committed_real=committed_real,
-        group_wm=jnp.maximum(state.group_wm, group_wm),
+        group_wm=new_group_wm,
         noop_fills=noop_fills,
         deaths=deaths,
         choose_violations=choose_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

@@ -40,6 +40,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
 S_OPEN = 0
@@ -132,6 +133,7 @@ class BatchedFastMultiPaxosState:
     safety_violations: jnp.ndarray  # [] choice contradicted the ledger
     lat_sum: jnp.ndarray  # [] command issue -> done
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(
@@ -168,6 +170,7 @@ def init_state(
         safety_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -403,6 +406,23 @@ def tick(
         send[None, :, :], t + bcast_lat + jit_lat, cmd_arrival
     )
 
+    # Telemetry: client broadcasts straight to acceptors ARE the fast
+    # (phase-2) plane; classic recoveries the phase-1 plane; acceptor
+    # ring backpressure the drop counter.
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(n_new),
+        phase1_msgs=A * (recoveries - state.recoveries),
+        phase2_msgs=A * jnp.sum(send),
+        commits=committed_slots - state.committed_slots,
+        executes=cmds_done - state.cmds_done,
+        drops=dropped_votes - state.dropped_votes,
+        retries=jnp.sum(retry),
+        queue_depth=jnp.sum(cmd_status != C_EMPTY),
+        queue_capacity=G * CW,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedFastMultiPaxosState(
         head=head,
         acc_next=acc_next,
@@ -433,6 +453,7 @@ def tick(
         safety_violations=safety_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

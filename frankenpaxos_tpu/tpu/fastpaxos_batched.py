@@ -44,6 +44,7 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Instance status.
 I_EMPTY = 0
@@ -135,6 +136,7 @@ class BatchedFastPaxosState:
     safety_violations: jnp.ndarray  # [] chosen != fp_committed ledger
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
@@ -165,6 +167,7 @@ def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
         safety_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -387,6 +390,23 @@ def tick(
     )
     next_inst = state.next_inst + count
 
+    # Telemetry: round-0 proposal fan-outs are the phase-2 plane (fast
+    # rounds ARE phase 2); classic recoveries are the phase-1 plane.
+    # "executes" counts instances leaving the ring (decision learned).
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase1_msgs=A * jnp.sum(stuck),
+        phase2_msgs=A * (jnp.sum(issue) + jnp.sum(is_conflict))
+        + A * jnp.sum(rec1_done),
+        commits=chosen_total - state.chosen_total,
+        executes=jnp.sum(retire),
+        retries=recoveries - state.recoveries,
+        queue_depth=jnp.sum(status != I_EMPTY),
+        queue_capacity=G * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedFastPaxosState(
         status=status,
         conflicted=conflicted,
@@ -413,6 +433,7 @@ def tick(
         safety_violations=safety_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

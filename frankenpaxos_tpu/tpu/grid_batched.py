@@ -40,6 +40,7 @@ from frankenpaxos_tpu.tpu.common import (
     sample_latency,
 )
 from frankenpaxos_tpu.tpu.multipaxos_batched import CHOSEN, EMPTY, PROPOSED
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 
 def _delivered(cfg, key, shape):
@@ -100,6 +101,7 @@ class GridBatchedState:
     # margin, so under drops the modes also diverge in retry traffic and
     # commit latency. int32: fine below ~2G sends per run.
     msgs_sent: jnp.ndarray  # []
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
@@ -119,6 +121,7 @@ def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -217,6 +220,18 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         state.msgs_sent + jnp.sum(send) + jnp.sum(timed_out) * (R * C)
     )
 
+    tel = record(
+        state.telemetry,
+        proposals=count,
+        phase2_msgs=msgs_sent - state.msgs_sent,
+        commits=committed - state.committed,
+        executes=n_retire,
+        retries=jnp.sum(timed_out),
+        queue_depth=next_slot - head,
+        queue_capacity=W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return GridBatchedState(
         next_slot=next_slot,
         head=head,
@@ -232,6 +247,7 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         lat_sum=lat_sum,
         lat_hist=lat_hist,
         msgs_sent=msgs_sent,
+        telemetry=tel,
     )
 
 

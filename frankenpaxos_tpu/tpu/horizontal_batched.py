@@ -47,6 +47,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
 EMPTY = 0
@@ -135,6 +136,7 @@ class BatchedHorizontalState:
     bank_violations: jnp.ndarray  # [] votes observed in the WRONG bank
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
@@ -165,6 +167,7 @@ def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
         bank_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -372,6 +375,27 @@ def tick(
     )
     last_send = jnp.where(timed_out, t, last_send)
 
+    # Telemetry: phase-1 traffic is the new-bank handover exchange;
+    # alpha/boundary stalls are backpressure drops (proposal slots the
+    # gates refused this tick); leader_changes counts chunk handovers.
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase1_msgs=jnp.sum(arm[None, :] & in_new_bank)
+        + jnp.sum(p1a_now),
+        phase2_msgs=jnp.sum(is_new[None, :, :] & send_rows)
+        + jnp.sum(timed_out[None, :, :] & resend_rows),
+        commits=committed - state.committed,
+        executes=executed - state.executed,
+        drops=(alpha_stalls - state.alpha_stalls)
+        + (boundary_stalls - state.boundary_stalls),
+        retries=jnp.sum(timed_out),
+        leader_changes=reconfigs_done - state.reconfigs_done,
+        queue_depth=next_slot.sum() - head.sum(),
+        queue_capacity=G * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedHorizontalState(
         next_slot=next_slot,
         head=head,
@@ -398,6 +422,7 @@ def tick(
         bank_violations=bank_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

@@ -42,6 +42,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
 PROPOSED = 1
@@ -112,6 +113,7 @@ class BatchedMenciusState:
     skips: jnp.ndarray  # [] cumulative noop skip proposals
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
@@ -135,6 +137,7 @@ def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
         skips=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -286,6 +289,21 @@ def tick(
     p2a_arrival = jnp.where(timed_out[:, :, None], t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
 
+    new_executed_global = jnp.maximum(state.executed_global, executed_global)
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase2_msgs=jnp.sum(is_new[:, :, None] & p2a_delivered)
+        + A * jnp.sum(timed_out),
+        commits=committed - state.committed,
+        executes=new_executed_global - state.executed_global,
+        drops=jnp.sum(is_new[:, :, None] & ~p2a_delivered),
+        retries=jnp.sum(timed_out),
+        queue_depth=jnp.sum(next_slot - head),
+        queue_capacity=L * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedMenciusState(
         next_slot=next_slot,
         head=head,
@@ -299,12 +317,13 @@ def tick(
         p2a_arrival=p2a_arrival,
         p2b_arrival=p2b_arrival,
         voted=voted,
-        executed_global=jnp.maximum(state.executed_global, executed_global),
+        executed_global=new_executed_global,
         committed=committed,
         committed_real=committed_real,
         skips=skips,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

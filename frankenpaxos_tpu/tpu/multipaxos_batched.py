@@ -61,6 +61,7 @@ from frankenpaxos_tpu.tpu.common import (
     sample_latency,
     sample_quorum,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status codes.
 EMPTY = 0
@@ -310,6 +311,9 @@ class BatchedMultiPaxosState:
     read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
     read_lin_violations: jnp.ndarray  # [] reads bound below their floor
 
+    # Device-side per-tick metric ring (tpu/telemetry.py contract).
+    telemetry: Telemetry
+
 
 def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
@@ -392,6 +396,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         read_lin_violations=jnp.zeros((), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -511,6 +516,7 @@ def tick(
     old_live = state.old_live
     reconfigs = state.reconfigs
     configs_gcd = state.configs_gcd
+    telem_phase1 = jnp.int32(0)  # phase-1-plane messages sent this tick
     if cfg.reconfigure_every:
         M = cfg.num_matchmakers
         k_rc = jax.random.fold_in(k_fail, 1)
@@ -622,6 +628,14 @@ def tick(
         gc_watermark = jnp.where(p1_done, state.next_slot, gc_watermark)
         old_live = old_live | p1_done
         recon_phase = jnp.where(p1_done, RC_NORMAL, recon_phase)
+        # Phase-1-plane traffic this tick: MatchA fan-outs, MatchB
+        # replies, Phase1a fan-outs to the old config, Phase1b replies.
+        telem_phase1 = (
+            M * jnp.sum(due)
+            + jnp.sum(ma_now)
+            + A * jnp.sum(mm_done)
+            + jnp.sum(p1a_now)
+        )
 
     # ---- 1+2. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
     # Acceptor.scala:184-220): vote iff the message round >= promised round;
@@ -1094,6 +1108,43 @@ def tick(
             rb_target = jnp.where(can_batch, -1, rb_target)
             rb_status = jnp.where(can_batch, R_BOUND, rb_status)
 
+    # ---- 7. Telemetry (tpu/telemetry.py contract): every count is an
+    # int32 reduction of a mask/counter the tick already computed for
+    # its own bookkeeping, so with the default ring this adds register
+    # adds plus one ring-row write; with a zero-width ring XLA removes
+    # it all. Identical under use_pallas: only pre-kernel masks are
+    # counted (the vote predicate stays kernel-internal).
+    n_proposed = jnp.sum(count)  # [G]-space
+    n_retries = jnp.sum(timed_out)
+    if cfg.drop_rate > 0.0:
+        phase2_sends = jnp.sum(send_p2a)
+        p2a_drops = jnp.sum(
+            is_new[None, :, :] & in_quorum & ~p2a_delivered
+        )
+    else:
+        # Lossless path: sample_quorum selects EXACTLY f+1 members (A
+        # when non-thrifty) and every send is delivered, so the mask
+        # sum equals quorum_size * proposals — counted in [G] space,
+        # keeping the <2% overhead budget free of extra [A, G, W]
+        # reductions on the flagship config.
+        quorum_size = (f + 1) if cfg.thrifty else A
+        phase2_sends = quorum_size * n_proposed
+        p2a_drops = 0
+    tel = record(
+        state.telemetry,
+        proposals=n_proposed,
+        phase1_msgs=telem_phase1,
+        phase2_msgs=phase2_sends + A * n_retries,
+        commits=n_new,
+        executes=retired_total - state.retired,
+        drops=p2a_drops,
+        retries=n_retries,
+        leader_changes=elections - state.elections,
+        queue_depth=jnp.sum(next_slot - head),
+        queue_capacity=G * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedMultiPaxosState(
         leader_round=leader_round,
         next_slot=next_slot,
@@ -1158,6 +1209,7 @@ def tick(
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
         read_lin_violations=read_lin_violations,
+        telemetry=tel,
     )
 
 

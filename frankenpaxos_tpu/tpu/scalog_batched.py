@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +77,7 @@ class BatchedScalogState:
     lat_sum: jnp.ndarray  # [] sum of record ordering latencies (ticks)
     lat_count: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedScalogConfig) -> BatchedScalogState:
@@ -94,6 +96,7 @@ def init_state(cfg: BatchedScalogConfig) -> BatchedScalogState:
         lat_sum=jnp.zeros((), jnp.int32),
         lat_count=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -225,6 +228,21 @@ def tick(
     last_snap_tick = jnp.where(issue, t, state.last_snap_tick)
     next_cut = state.next_cut + jnp.where(issue, 1, 0)
 
+    # Telemetry: cut issues are the "proposals", committed cuts the
+    # "commits", newly ordered records the "executes"; phase2 traffic is
+    # the Paxos round per issued cut; the queue gauge is the uncommitted
+    # append backlog relative to the committed log.
+    tel = record(
+        state.telemetry,
+        proposals=next_cut - state.next_cut,
+        phase2_msgs=jnp.where(issue, 2, 0),
+        commits=n_new_commits,
+        executes=lat_count - state.lat_count,
+        queue_depth=state.next_cut - committed_cuts,
+        queue_capacity=P,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedScalogState(
         local_len=local_len,
         cut_vec=cut_vec,
@@ -239,6 +257,7 @@ def tick(
         lat_sum=lat_sum,
         lat_count=lat_count,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

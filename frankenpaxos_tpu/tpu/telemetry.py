@@ -1,0 +1,314 @@
+"""Device-side telemetry: per-tick metric rings that live INSIDE the
+compiled ``lax.scan``.
+
+The only visibility into a compiled tick loop used to be host-side
+``stats()`` pulls between ``run()`` segments — the loop itself was a
+black box. Compartmentalized MultiPaxos (PAPERS: arxiv 2012.15762) makes
+the case that *finding the bottleneck component is the optimization
+method* for SMR; that needs per-phase counters with per-tick resolution,
+not end-of-segment totals. This module is the repo-wide contract for
+that: one metrics struct, one ring-buffer idiom, one exposition format.
+
+Design:
+
+  * :class:`Telemetry` is a pytree carried in every batched backend's
+    ``*State`` dataclass, so it threads through ``run_ticks``'s scan
+    carry (and through donation, sharding, vmap, and ``widen_state``)
+    with no signature changes anywhere.
+  * Each ``tick`` calls :func:`record` with per-tick event counts that
+    the tick has ALREADY computed for its own bookkeeping (quorum sums,
+    retire counts, cumulative-counter deltas) — int32 adds on values
+    resident in registers, plus ONE dynamic-update-slice of a
+    ``[NUM_COLS]`` row into the ``[K, NUM_COLS]`` ring per tick. All
+    leaves are int32 (the dtype policy's accumulator width), so
+    ``widen_state`` is a no-op and narrowed/widened runs stay
+    bit-identical.
+  * The ring keeps the last ``K`` ticks (slot ``= ticks % K``), so a
+    single coalesced ``jax.device_get`` at an epoch boundary yields a
+    full per-tick time series with zero host sync inside the hot loop
+    (the pull itself still waits for in-flight device work, like any
+    transfer — the point is the LOOP never syncs).
+    Ring contents are invariant to K where windows overlap: the value
+    recorded for tick t is the same regardless of window size.
+  * ``window = 0`` disables telemetry STRUCTURALLY: :func:`record`
+    no-ops at trace time (K is a static shape), so XLA dead-code
+    eliminates every count that feeds only telemetry — the zero-overhead
+    baseline the ``bench.py --telemetry`` budget check compares against.
+
+Exposition naming scheme (host + device metrics unify under it):
+``fpx_device_*`` for in-graph metrics (this module), ``fpx_host_*`` for
+transport-level trace spans; counters end in ``_total``, histograms use
+Prometheus cumulative ``_bucket{le=...}`` lines. Rendered by
+:func:`exposition_lines` and consumed by ``monitoring/scrape.py`` /
+``monitoring/dashboard.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import LAT_BINS
+
+# Per-tick ring columns. The first eight are event counters (events that
+# happened THIS tick); queue_depth is a gauge sampled at tick end
+# (in-flight work items — ring occupancy / window backlog, per backend).
+COUNTER_FIELDS = (
+    "proposals",
+    "phase1_msgs",
+    "phase2_msgs",
+    "commits",
+    "executes",
+    "drops",
+    "retries",
+    "leader_changes",
+    "queue_depth",
+)
+NUM_COLS = len(COUNTER_FIELDS)
+COL = {name: i for i, name in enumerate(COUNTER_FIELDS)}
+
+TELEM_WINDOW = 128  # default ring size K (ticks)
+QUEUE_BINS = 32  # queue-depth histogram bins (occupancy fractions)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Telemetry:
+    """Device-resident metric ring. All leaves int32 (accumulator width
+    under the dtype policy — never narrowed, never widened; x64 is off
+    in this runtime). ``totals`` therefore wraps mod 2^32 on very long
+    runs — the busiest flagship column (phase2_msgs, ~50k/tick) wraps
+    after ~80k ticks, ~10x a full bench.py run. Host-side views
+    (:func:`summary`, :func:`exposition_lines`) reinterpret the totals
+    as unsigned so a wrapped counter reads as a Prometheus counter
+    reset (which ``rate()`` handles), never as a negative sample."""
+
+    ticks: jnp.ndarray  # [] ticks recorded since creation
+    counters: jnp.ndarray  # [K, NUM_COLS] per-tick ring (slot = t % K)
+    totals: jnp.ndarray  # [NUM_COLS] cumulative sums of every column
+    lat_hist: jnp.ndarray  # [LAT_BINS] commit-latency histogram (ticks)
+    queue_hist: jnp.ndarray  # [QUEUE_BINS] occupancy-fraction histogram
+
+
+def make_telemetry(window: int = TELEM_WINDOW) -> Telemetry:
+    """A zeroed telemetry ring of ``window`` ticks; ``window=0`` turns
+    the subsystem off structurally (record() becomes a trace-time
+    no-op and XLA removes the feeding computations)."""
+    assert window >= 0
+    return Telemetry(
+        ticks=jnp.zeros((), jnp.int32),
+        counters=jnp.zeros((window, NUM_COLS), jnp.int32),
+        totals=jnp.zeros((NUM_COLS,), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        queue_hist=jnp.zeros((QUEUE_BINS,), jnp.int32),
+    )
+
+
+def window(tel: Telemetry) -> int:
+    """The ring size K — a static shape, readable at trace time."""
+    return tel.counters.shape[0]
+
+
+def record(
+    tel: Telemetry,
+    *,
+    proposals=0,
+    phase1_msgs=0,
+    phase2_msgs=0,
+    commits=0,
+    executes=0,
+    drops=0,
+    retries=0,
+    leader_changes=0,
+    queue_depth=0,
+    queue_capacity: int = 0,
+    lat_hist_delta: Optional[jnp.ndarray] = None,
+) -> Telemetry:
+    """Record one tick. Counter args are this tick's event counts
+    (scalars, traced or Python ints); ``queue_depth`` is the end-of-tick
+    backlog gauge, binned into ``queue_hist`` as a fraction of the
+    static ``queue_capacity`` (0 = don't bin). ``lat_hist_delta`` is
+    this tick's [LAT_BINS] commit-latency increment (most backends
+    already compute it as a ``segment_sum``; pass the same array).
+
+    With a zero-width ring this is a trace-time no-op except the tick
+    count — the disabled-telemetry baseline costs nothing."""
+    ticks = tel.ticks + 1
+    if window(tel) == 0:
+        return dataclasses.replace(tel, ticks=ticks)
+    row = jnp.stack(
+        [
+            jnp.asarray(v, jnp.int32).reshape(())
+            for v in (
+                proposals,
+                phase1_msgs,
+                phase2_msgs,
+                commits,
+                executes,
+                drops,
+                retries,
+                leader_changes,
+                queue_depth,
+            )
+        ]
+    )
+    slot = jnp.mod(tel.ticks, window(tel))
+    counters = jax.lax.dynamic_update_slice(
+        tel.counters, row[None, :], (slot, jnp.int32(0))
+    )
+    lat_hist = tel.lat_hist
+    if lat_hist_delta is not None:
+        lat_hist = lat_hist + lat_hist_delta.astype(jnp.int32)
+    queue_hist = tel.queue_hist
+    if queue_capacity > 0:
+        qbin = jnp.clip(
+            jnp.asarray(queue_depth, jnp.int32) * QUEUE_BINS
+            // jnp.int32(queue_capacity),
+            0,
+            QUEUE_BINS - 1,
+        )
+        queue_hist = queue_hist.at[qbin].add(1)
+    return Telemetry(
+        ticks=ticks,
+        counters=counters,
+        totals=tel.totals + row,
+        lat_hist=lat_hist,
+        queue_hist=queue_hist,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host side: one coalesced transfer, then pure-numpy views.
+# ---------------------------------------------------------------------------
+
+
+def fetch(tel: Telemetry) -> Telemetry:
+    """One coalesced ``jax.device_get`` of the whole telemetry pytree —
+    the epoch-boundary pull (never call inside a tick; the lint
+    enforces that)."""
+    return jax.device_get(tel)
+
+
+def series(tel: Telemetry) -> Dict[str, "jnp.ndarray"]:
+    """Unroll the ring into chronological per-tick series.
+
+    Returns ``{"tick": [n], "<counter>": [n], ...}`` covering the last
+    ``min(ticks, K)`` ticks in time order (oldest first). Works on a
+    fetched (host) or device-resident Telemetry."""
+    import numpy as np
+
+    tel = jax.device_get(tel)
+    K = tel.counters.shape[0]
+    total = int(tel.ticks)
+    n = min(total, K)
+    if n == 0:
+        return {name: np.zeros((0,), np.int32) for name in
+                ("tick",) + COUNTER_FIELDS}
+    # Oldest retained tick sits at slot ticks % K once the ring wrapped.
+    order = (int(tel.ticks) - n + np.arange(n)) % K
+    out = {"tick": np.arange(total - n, total, dtype=np.int64)}
+    rows = np.asarray(tel.counters)[order]
+    for name, col in COL.items():
+        out[name] = rows[:, col]
+    return out
+
+
+def _unsigned_total(value) -> int:
+    """Host view of an int32 cumulative counter: reinterpret as
+    unsigned so a wrapped counter reads as a reset, never negative."""
+    return int(value) & 0xFFFFFFFF
+
+
+def summary(tel: Telemetry) -> dict:
+    """Scalar roll-up: cumulative totals plus windowed per-tick rates
+    over the retained ring."""
+    import numpy as np
+
+    tel = jax.device_get(tel)
+    s = series(tel)
+    n = len(s["tick"])
+    out = {"ticks": int(tel.ticks), "window": int(tel.counters.shape[0])}
+    for i, name in enumerate(COUNTER_FIELDS):
+        out[f"{name}_total"] = _unsigned_total(tel.totals[i])
+        out[f"{name}_per_tick_windowed"] = (
+            float(np.mean(s[name])) if n else 0.0
+        )
+    return out
+
+
+def to_dict(tel: Telemetry) -> dict:
+    """JSON-serializable capture of the whole telemetry state — the
+    interchange format between a run (``bench.py --telemetry``,
+    ``TpuSimTransport.telemetry()``) and the dashboard."""
+    tel = jax.device_get(tel)
+    s = series(tel)
+    return {
+        "ticks": int(tel.ticks),
+        "window": int(tel.counters.shape[0]),
+        "series": {k: [int(v) for v in vals] for k, vals in s.items()},
+        "totals": {
+            name: _unsigned_total(tel.totals[i])
+            for i, name in enumerate(COUNTER_FIELDS)
+        },
+        "lat_hist": [int(v) for v in tel.lat_hist],
+        "queue_hist": [int(v) for v in tel.queue_hist],
+    }
+
+
+def exposition_lines(
+    tel: Telemetry, labels: Optional[Dict[str, str]] = None
+) -> List[str]:
+    """Render the telemetry as Prometheus text exposition under the
+    unified ``fpx_device_*`` naming scheme (parseable by
+    ``monitoring.scrape.parse_exposition``): cumulative ``_total``
+    counters, and cumulative-bucket histograms for commit latency
+    (ticks) and queue occupancy (fraction of capacity)."""
+    tel = jax.device_get(tel)
+    label_str = ""
+    if labels:
+        pairs = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        label_str = "{" + pairs + "}"
+
+    def labeled(extra: Dict[str, str]) -> str:
+        merged = dict(labels or {})
+        merged.update(extra)
+        pairs = ",".join(f'{k}="{v}"' for k, v in sorted(merged.items()))
+        return "{" + pairs + "}"
+
+    lines = [
+        "# TYPE fpx_device_ticks_total counter",
+        f"fpx_device_ticks_total{label_str} {int(tel.ticks)}",
+    ]
+    for i, name in enumerate(COUNTER_FIELDS):
+        metric = f"fpx_device_{name}_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric}{label_str} {_unsigned_total(tel.totals[i])}")
+    lines.append("# TYPE fpx_device_commit_latency_ticks histogram")
+    cum = 0
+    for b, count in enumerate(tel.lat_hist):
+        cum += int(count)
+        lines.append(
+            "fpx_device_commit_latency_ticks_bucket"
+            f"{labeled({'le': str(b)})} {cum}"
+        )
+    lines.append(
+        "fpx_device_commit_latency_ticks_bucket"
+        f"{labeled({'le': '+Inf'})} {cum}"
+    )
+    lines.append(f"fpx_device_commit_latency_ticks_count{label_str} {cum}")
+    lines.append("# TYPE fpx_device_queue_occupancy histogram")
+    cum = 0
+    for b, count in enumerate(tel.queue_hist):
+        cum += int(count)
+        le = f"{(b + 1) / QUEUE_BINS:.4f}"
+        lines.append(
+            f"fpx_device_queue_occupancy_bucket{labeled({'le': le})} {cum}"
+        )
+    lines.append(
+        f"fpx_device_queue_occupancy_bucket{labeled({'le': '+Inf'})} {cum}"
+    )
+    lines.append(f"fpx_device_queue_occupancy_count{label_str} {cum}")
+    return lines

@@ -7,7 +7,14 @@ time as a compiled XLA program, with PRNG-sampled message latency and loss
 standing in for the scheduler's nondeterminism. Exposes:
 
   * ``run(num_ticks)`` — advance the simulation (jit + lax.scan);
-  * ``stats()`` — committed/executed counts, commit-latency p50/mean;
+  * ``stats()`` — committed/executed counts, commit-latency p50/mean,
+    pulled as ONE coalesced device transfer;
+  * ``telemetry()`` — the in-graph per-tick metric ring
+    (``tpu/telemetry.py``), one coalesced transfer at epoch boundaries
+    (zero host sync happened inside the tick loop to produce it);
+    ``telemetry_series()/_summary()/_dict()`` host views;
+  * ``trace()`` — host-side wall-clock spans around compile/dispatch/
+    wait/transfer (the ``fpx_host_*`` half of the exposition scheme);
   * ``leader_change()`` — inject a leader failover (round bump + repair);
   * ``check_invariants()`` — device-side safety checks;
   * sharding over a device mesh via ``frankenpaxos_tpu.parallel``.
@@ -15,12 +22,15 @@ standing in for the scheduler's nondeterminism. Exposes:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
-from typing import Optional
+import time
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     LAT_BINS,
     BatchedMultiPaxosConfig,
@@ -39,18 +49,54 @@ class TpuSimTransport:
         config: BatchedMultiPaxosConfig,
         seed: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
+        telemetry_window: Optional[int] = None,
     ):
         self.config = config
         self.key = jax.random.PRNGKey(seed)
         self.t = jnp.zeros((), jnp.int32)
         self._epoch = 0
         self.mesh = mesh
+        # Host-side trace spans (the fpx_host_* half of the unified
+        # naming scheme): wall-clock stamped compile/dispatch/wait/
+        # transfer records, appended by _span below.
+        self.trace_spans: List[dict] = []
+        self._dispatched_lengths: set = set()
         state = init_state(config)
+        if telemetry_window is not None:
+            state = dataclasses.replace(
+                state,
+                telemetry=telemetry_mod.make_telemetry(telemetry_window),
+            )
         if mesh is not None:
             from frankenpaxos_tpu.parallel import shard_state
 
             state = shard_state(state, mesh)
         self.state = state
+
+    @contextlib.contextmanager
+    def _span(self, name: str, **meta):
+        """Record one host-side trace span (unix wall-clock stamped)."""
+        start = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.trace_spans.append(
+                {
+                    "name": name,
+                    "start_unix": start,
+                    "duration_s": time.perf_counter() - t0,
+                    **meta,
+                }
+            )
+
+    def trace(self) -> List[dict]:
+        """The recorded host-side spans; clear with ``trace_spans.clear()``.
+        Dispatch spans on a segment length not seen before include the
+        XLA compile (``compile=True``) — JAX dispatch is async, so
+        device execution itself lands in the following wait/transfer
+        span, not here."""
+        return list(self.trace_spans)
 
     def run(self, num_ticks: int) -> None:
         # run_ticks DONATES the state argument (single-buffered in device
@@ -59,16 +105,22 @@ class TpuSimTransport:
         # dead after this call.
         key = jax.random.fold_in(self.key, self._epoch)
         self._epoch += 1
-        if self.mesh is not None:
-            from frankenpaxos_tpu.parallel import run_ticks_sharded
+        compiling = num_ticks not in self._dispatched_lengths
+        self._dispatched_lengths.add(num_ticks)
+        with self._span(
+            "dispatch", num_ticks=num_ticks, compile=compiling
+        ):
+            if self.mesh is not None:
+                from frankenpaxos_tpu.parallel import run_ticks_sharded
 
-            self.state, self.t = run_ticks_sharded(
-                self.config, self.mesh, self.state, self.t, num_ticks, key
-            )
-        else:
-            self.state, self.t = run_ticks(
-                self.config, self.state, self.t, num_ticks, key
-            )
+                self.state, self.t = run_ticks_sharded(
+                    self.config, self.mesh, self.state, self.t, num_ticks,
+                    key,
+                )
+            else:
+                self.state, self.t = run_ticks(
+                    self.config, self.state, self.t, num_ticks, key
+                )
 
     def leader_change(self) -> None:
         key = jax.random.fold_in(self.key, 10_000_000 + self._epoch)
@@ -82,7 +134,8 @@ class TpuSimTransport:
         self.state = reconfigure(self.config, self.state, self.t, key)
 
     def block_until_ready(self) -> None:
-        jax.block_until_ready(self.state)
+        with self._span("wait"):
+            jax.block_until_ready(self.state)
 
     def profile(self, num_ticks: int, trace_dir: str) -> str:
         """Run ``num_ticks`` under jax.profiler and write a trace into
@@ -108,9 +161,43 @@ class TpuSimTransport:
         return int(self.state.retired)
 
     def stats(self) -> dict:
-        committed = int(self.state.committed)
-        lat_hist = jax.device_get(self.state.lat_hist)
-        cum = lat_hist.cumsum()
+        # ONE coalesced jax.device_get of the stats sub-pytree. The old
+        # implementation issued a separate blocking transfer per field
+        # (each int()/device_get call is its own round trip — a dozen+
+        # host syncs per stats() call); batching them into a single dict
+        # pull makes stats() one transfer regardless of which optional
+        # subsystems are live.
+        st = self.state
+        dev = {
+            "committed": st.committed,
+            "retired": st.retired,
+            "lat_sum": st.lat_sum,
+            "lat_hist": st.lat_hist,
+            "round_max": st.leader_round.max(),
+            "t": self.t,
+        }
+        if self.config.fail_rate > 0.0 or self.config.device_elections:
+            dev["elections"] = st.elections
+            dev["alive_leaders"] = st.leader_alive.sum()
+        if self.config.reconfigure_every:
+            dev["reconfigs"] = st.reconfigs
+            dev["configs_gcd"] = st.configs_gcd
+            dev["old_live"] = st.old_live.sum()
+            dev["config_epoch_max"] = st.config_epoch.max()
+        if self.config.state_machine != "none":
+            dev["sm_applied"] = st.sm_applied
+            dev["dups_filtered"] = st.dups_filtered
+            dev["kv_keys_set"] = (st.kv_val >= 0).sum()
+        if self.config.read_rate:
+            dev["reads_done"] = st.reads_done
+            dev["read_lat_sum"] = st.read_lat_sum
+            dev["read_lat_hist"] = st.read_lat_hist
+            dev["reads_shed"] = st.reads_shed
+        with self._span("transfer", what="stats"):
+            host = jax.device_get(dev)
+
+        committed = int(host["committed"])
+        cum = host["lat_hist"].cumsum()
         p50 = int((cum >= max(1, (committed + 1) // 2)).argmax()) if committed else -1
         p99 = (
             int((cum >= max(1, -(-committed * 99 // 100))).argmax())
@@ -118,51 +205,63 @@ class TpuSimTransport:
             else -1
         )
         out = {
-            "ticks": int(self.t),
+            "ticks": int(host["t"]),
             "committed": committed,
-            "executed": int(self.state.retired),
+            "executed": int(host["retired"]),
             "commit_latency_mean_ticks": (
-                float(self.state.lat_sum) / committed if committed else -1.0
+                float(host["lat_sum"]) / committed if committed else -1.0
             ),
             "commit_latency_p50_ticks": p50,
             "commit_latency_p99_ticks": p99,
-            "round": int(jax.device_get(self.state.leader_round).max()),
+            "round": int(host["round_max"]),
             "num_acceptors": self.config.num_acceptors,
         }
         if self.config.fail_rate > 0.0 or self.config.device_elections:
-            out["elections"] = int(self.state.elections)
-            out["alive_leaders"] = int(
-                jax.device_get(self.state.leader_alive).sum()
-            )
+            out["elections"] = int(host["elections"])
+            out["alive_leaders"] = int(host["alive_leaders"])
         if self.config.reconfigure_every:
-            out["reconfigurations"] = int(self.state.reconfigs)
-            out["old_configs_gcd"] = int(self.state.configs_gcd)
-            out["old_configs_live"] = int(
-                jax.device_get(self.state.old_live).sum()
-            )
-            out["config_epoch_max"] = int(
-                jax.device_get(self.state.config_epoch).max()
-            )
+            out["reconfigurations"] = int(host["reconfigs"])
+            out["old_configs_gcd"] = int(host["configs_gcd"])
+            out["old_configs_live"] = int(host["old_live"])
+            out["config_epoch_max"] = int(host["config_epoch_max"])
         if self.config.state_machine != "none":
-            out["sm_applied"] = int(self.state.sm_applied)
-            out["dups_filtered"] = int(self.state.dups_filtered)
-            out["kv_keys_set"] = int(
-                (jax.device_get(self.state.kv_val) >= 0).sum()
-            )
+            out["sm_applied"] = int(host["sm_applied"])
+            out["dups_filtered"] = int(host["dups_filtered"])
+            out["kv_keys_set"] = int(host["kv_keys_set"])
         if self.config.read_rate:
-            reads = int(self.state.reads_done)
-            rhist = jax.device_get(self.state.read_lat_hist)
-            rcum = rhist.cumsum()
+            reads = int(host["reads_done"])
+            rcum = host["read_lat_hist"].cumsum()
             out["reads_done"] = reads
             out["read_mode"] = self.config.read_mode
             out["read_latency_mean_ticks"] = (
-                float(self.state.read_lat_sum) / reads if reads else -1.0
+                float(host["read_lat_sum"]) / reads if reads else -1.0
             )
             out["read_latency_p50_ticks"] = (
                 int((rcum >= max(1, (reads + 1) // 2)).argmax()) if reads else -1
             )
-            out["reads_shed"] = int(self.state.reads_shed)
+            out["reads_shed"] = int(host["reads_shed"])
         return out
+
+    def telemetry(self) -> "telemetry_mod.Telemetry":
+        """The device-side per-tick metric ring (tpu/telemetry.py), as
+        ONE coalesced transfer at the epoch boundary. Zero host sync
+        happened inside the tick loop to produce it — but this pull
+        itself synchronizes on any in-flight run() (device_get waits
+        for pending work on the state), so call it between segments,
+        not to overlap with one."""
+        with self._span("transfer", what="telemetry"):
+            return telemetry_mod.fetch(self.state.telemetry)
+
+    def telemetry_series(self) -> dict:
+        """Chronological per-tick series over the retained ring."""
+        return telemetry_mod.series(self.telemetry())
+
+    def telemetry_summary(self) -> dict:
+        return telemetry_mod.summary(self.telemetry())
+
+    def telemetry_dict(self) -> dict:
+        """JSON-serializable capture (the dashboard interchange format)."""
+        return telemetry_mod.to_dict(self.telemetry())
 
     def check_invariants(self) -> dict:
         return {

@@ -28,6 +28,7 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 U_EMPTY = 0
 U_REQ = 1  # request in flight to the server
@@ -57,6 +58,7 @@ class BatchedUnreplicatedState:
     done: jnp.ndarray  # [] completed round trips
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(cfg: BatchedUnreplicatedConfig) -> BatchedUnreplicatedState:
@@ -69,6 +71,7 @@ def init_state(cfg: BatchedUnreplicatedConfig) -> BatchedUnreplicatedState:
         done=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -110,6 +113,18 @@ def tick(
     issue = jnp.where(new, t, issue)
     arrival = jnp.where(new, t + req_lat, arrival)
 
+    # Telemetry: request hops are this backend's "phase 2" plane
+    # (client -> server -> client; no consensus phases exist).
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(new),
+        phase2_msgs=jnp.sum(new) + jnp.sum(at_server),
+        commits=done - state.done,
+        executes=jnp.sum(at_server),
+        queue_depth=jnp.sum(status != U_EMPTY),
+        queue_capacity=G * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
     return BatchedUnreplicatedState(
         status=status,
         issue=issue,
@@ -118,6 +133,7 @@ def tick(
         done=done,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

@@ -41,6 +41,7 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
 PROPOSED = 1  # owner's round-0 proposal in flight
@@ -132,6 +133,7 @@ class BatchedVanillaMenciusState:
     choose_violations: jnp.ndarray  # [] slot re-chosen with a new value
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
 def init_state(
@@ -170,6 +172,7 @@ def init_state(
         choose_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
     )
 
 
@@ -419,6 +422,26 @@ def tick(
     )
     last_send = jnp.where(timed_out, t, last_send)
 
+    new_executed_global = jnp.maximum(state.executed_global, executed_global)
+    # Telemetry: revocation Phase1as are the phase-1 plane; owner
+    # proposals + retries the phase-2 plane; leader_changes counts the
+    # slots a revoker claimed from a dead stripe.
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase1_msgs=jnp.sum(target[:, :, None] & delivered),
+        phase2_msgs=jnp.sum(is_new[:, :, None] & delivered)
+        + A * jnp.sum(timed_out),
+        commits=committed - state.committed,
+        executes=new_executed_global - state.executed_global,
+        drops=jnp.sum((is_new | target)[:, :, None] & ~delivered),
+        retries=jnp.sum(timed_out),
+        leader_changes=revocations - state.revocations,
+        queue_depth=jnp.sum(next_slot - head),
+        queue_capacity=L * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
     return BatchedVanillaMenciusState(
         next_slot=next_slot,
         head=head,
@@ -442,7 +465,7 @@ def tick(
         rv_p1b_voted=rv_p1b_voted,
         rv_p2a_arrival=rv_p2a_arrival,
         rv_p2b_arrival=rv_p2b_arrival,
-        executed_global=jnp.maximum(state.executed_global, executed_global),
+        executed_global=new_executed_global,
         committed=committed,
         committed_real=committed_real,
         revocations=revocations,
@@ -451,6 +474,7 @@ def tick(
         choose_violations=choose_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        telemetry=tel,
     )
 
 

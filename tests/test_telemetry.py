@@ -1,0 +1,317 @@
+"""The device-side telemetry subsystem (tpu/telemetry.py): ring
+semantics, the repo-wide dtype bit-identity contract, window-size
+invariance, the coalesced transport pulls, and the exposition layer."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.tpu import telemetry as T
+from frankenpaxos_tpu.tpu.common import widen_state
+from frankenpaxos_tpu.tpu.multipaxos_batched import (
+    BatchedMultiPaxosConfig,
+    init_state,
+    run_ticks,
+)
+from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+SEEDS = [0, 1, 2]
+
+
+def _with_window(state, window):
+    return dataclasses.replace(state, telemetry=T.make_telemetry(window))
+
+
+def _flagship_cfg(**kw):
+    base = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        lat_min=1, lat_max=3, drop_rate=0.05, retry_timeout=8,
+    )
+    base.update(kw)
+    return BatchedMultiPaxosConfig(**base)
+
+
+# -- Ring mechanics -----------------------------------------------------------
+
+
+def test_record_zero_window_is_noop_except_ticks():
+    tel = T.make_telemetry(0)
+    tel = T.record(tel, commits=5, queue_depth=3, queue_capacity=10)
+    assert int(tel.ticks) == 1
+    assert tel.counters.shape == (0, T.NUM_COLS)
+    assert int(tel.totals.sum()) == 0
+    assert int(tel.queue_hist.sum()) == 0
+
+
+def test_series_unrolls_ring_in_time_order():
+    tel = T.make_telemetry(4)
+    for i in range(7):  # wraps: keeps ticks 3..6
+        tel = T.record(tel, commits=i)
+    s = T.series(tel)
+    np.testing.assert_array_equal(s["tick"], [3, 4, 5, 6])
+    np.testing.assert_array_equal(s["commits"], [3, 4, 5, 6])
+    assert T.summary(tel)["commits_total"] == sum(range(7))
+
+
+def test_series_partial_ring():
+    tel = T.make_telemetry(8)
+    for i in range(3):
+        tel = T.record(tel, proposals=10 + i)
+    s = T.series(tel)
+    np.testing.assert_array_equal(s["tick"], [0, 1, 2])
+    np.testing.assert_array_equal(s["proposals"], [10, 11, 12])
+
+
+def test_queue_histogram_bins_by_occupancy_fraction():
+    tel = T.make_telemetry(4)
+    tel = T.record(tel, queue_depth=0, queue_capacity=64)
+    tel = T.record(tel, queue_depth=63, queue_capacity=64)
+    qh = np.asarray(tel.queue_hist)
+    assert qh[0] == 1 and qh[-1] == 1 and qh.sum() == 2
+
+
+# -- The repo-wide contracts on a real backend --------------------------------
+
+
+def test_telemetry_counters_reconcile_with_state():
+    cfg = _flagship_cfg()
+    st, t = run_ticks(
+        cfg, init_state(cfg), jnp.zeros((), jnp.int32), 60,
+        jax.random.PRNGKey(0),
+    )
+    s = T.summary(st.telemetry)
+    assert s["ticks"] == 60
+    assert s["commits_total"] == int(st.committed)
+    assert s["executes_total"] == int(st.retired)
+    # The telemetry latency histogram IS the commit-latency histogram.
+    np.testing.assert_array_equal(
+        np.asarray(st.telemetry.lat_hist), np.asarray(st.lat_hist)
+    )
+    # 60 < default window: the full commit series is retained and sums
+    # to the cumulative counter.
+    assert int(T.series(st.telemetry)["commits"].sum()) == int(st.committed)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_telemetry_bit_identical_between_narrow_and_widened(seed):
+    """Satellite contract: telemetry counters are bit-identical between
+    a narrowed backend run and its widen_state() int32 reference run —
+    the ring must never observe the dtype policy."""
+    cfg = _flagship_cfg()
+    key = jax.random.PRNGKey(seed)
+    t0 = jnp.zeros((), jnp.int32)
+    narrow, _ = run_ticks(cfg, init_state(cfg), t0, 80, key)
+    wide, _ = run_ticks(cfg, widen_state(init_state(cfg)), t0, 80, key)
+    la = jax.tree_util.tree_leaves(narrow.telemetry)
+    lb = jax.tree_util.tree_leaves(wide.telemetry)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        assert a.dtype == b.dtype  # int32 on both paths: never narrowed
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_ring_contents_invariant_to_window_size(seed):
+    """Where two ring windows overlap, they record identical values:
+    the window is a VIEW of the same per-tick series, never an input to
+    the simulation."""
+    cfg = _flagship_cfg()
+    key = jax.random.PRNGKey(seed)
+    t0 = jnp.zeros((), jnp.int32)
+    ticks = 50
+    small, _ = run_ticks(
+        cfg, _with_window(init_state(cfg), 16), t0, ticks, key
+    )
+    big, _ = run_ticks(
+        cfg, _with_window(init_state(cfg), 64), t0, ticks, key
+    )
+    s_small = T.series(small.telemetry)
+    s_big = T.series(big.telemetry)
+    n = len(s_small["tick"])  # 16 retained ticks
+    assert n == 16
+    for name in ("tick",) + T.COUNTER_FIELDS:
+        np.testing.assert_array_equal(
+            s_small[name], s_big[name][-n:], err_msg=name
+        )
+    # Cumulative views are window-independent outright.
+    np.testing.assert_array_equal(
+        np.asarray(small.telemetry.totals), np.asarray(big.telemetry.totals)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(small.telemetry.lat_hist),
+        np.asarray(big.telemetry.lat_hist),
+    )
+
+
+def test_disabled_telemetry_does_not_change_simulation():
+    """The zero-width ring variant must be a pure observer removal: the
+    simulation state itself stays bit-identical."""
+    cfg = _flagship_cfg()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+    on, _ = run_ticks(cfg, init_state(cfg), t0, 40, key)
+    off, _ = run_ticks(cfg, _with_window(init_state(cfg), 0), t0, 40, key)
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(on, f.name)),
+            np.asarray(getattr(off, f.name)),
+            err_msg=f.name,
+        )
+
+
+# -- Transport integration ----------------------------------------------------
+
+
+def test_transport_telemetry_and_trace_spans():
+    sim = TpuSimTransport(_flagship_cfg(), seed=0)
+    sim.run(30)
+    sim.block_until_ready()
+    tel = sim.telemetry()
+    assert int(tel.ticks) == 30
+    summary = sim.telemetry_summary()
+    assert summary["commits_total"] == sim.stats()["committed"]
+    d = sim.telemetry_dict()
+    json.dumps(d)  # must be JSON-serializable as-is
+    assert d["ticks"] == 30
+    assert len(d["series"]["commits"]) == 30
+    # Host-side spans: the first dispatch compiles; wait and transfer
+    # spans carry wall-clock stamps.
+    names = [s["name"] for s in sim.trace()]
+    assert "dispatch" in names and "wait" in names and "transfer" in names
+    first_dispatch = next(s for s in sim.trace() if s["name"] == "dispatch")
+    assert first_dispatch["compile"] is True
+    assert all(s["start_unix"] > 0 and s["duration_s"] >= 0 for s in sim.trace())
+    # A second run of the same length is not a fresh compile.
+    sim.run(30)
+    assert [s for s in sim.trace() if s["name"] == "dispatch"][-1][
+        "compile"
+    ] is False
+
+
+def test_transport_stats_is_one_coalesced_pull(monkeypatch):
+    """The satellite fix: stats() must issue exactly ONE jax.device_get,
+    regardless of which optional subsystems are live."""
+    cfg = _flagship_cfg(
+        fail_rate=0.02, revive_rate=0.2, heartbeat_timeout=4,
+        reconfigure_every=25, state_machine="kv", kv_keys=16,
+        num_clients=4, dup_rate=0.05, read_rate=2, read_window=8,
+    )
+    sim = TpuSimTransport(cfg, seed=0)
+    sim.run(30)
+    sim.block_until_ready()
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    stats = sim.stats()
+    assert len(calls) == 1, f"stats() issued {len(calls)} device pulls"
+    # Every optional block made it into the single pull.
+    for key in ("elections", "reconfigurations", "sm_applied", "reads_done"):
+        assert key in stats
+
+
+# -- Exposition + dashboard ---------------------------------------------------
+
+
+def test_exposition_lines_parse_and_match_totals():
+    from frankenpaxos_tpu.monitoring.scrape import parse_exposition
+
+    cfg = _flagship_cfg()
+    st, _ = run_ticks(
+        cfg, init_state(cfg), jnp.zeros((), jnp.int32), 40,
+        jax.random.PRNGKey(1),
+    )
+    text = "\n".join(
+        T.exposition_lines(st.telemetry, labels={"backend": "multipaxos"})
+    )
+    samples = parse_exposition(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["fpx_device_ticks_total"][0][1] == 40.0
+    (labels, commits) = by_name["fpx_device_commits_total"][0]
+    assert ("backend", "multipaxos") in labels
+    assert commits == float(st.committed)
+    # Histogram buckets are cumulative and end at the total count.
+    buckets = by_name["fpx_device_commit_latency_ticks_bucket"]
+    values = [v for _, v in buckets]
+    assert values == sorted(values)
+    assert values[-1] == float(np.asarray(st.lat_hist).sum())
+
+
+def test_device_samples_roundtrip_into_metrics_capture(tmp_path):
+    from frankenpaxos_tpu.monitoring.scrape import (
+        MetricsCapture,
+        append_device_samples,
+        append_host_spans,
+    )
+
+    sim = TpuSimTransport(_flagship_cfg(), seed=0)
+    csv_path = str(tmp_path / "metrics.csv")
+    for _ in range(3):
+        sim.run(20)
+        sim.block_until_ready()
+        append_device_samples(csv_path, sim.telemetry())
+    append_host_spans(csv_path, sim.trace())
+    cap = MetricsCapture(csv_path)
+    assert "fpx_device_commits_total" in cap.names()
+    assert "fpx_host_span_seconds" in cap.names()
+    # The counter is monotone across scrapes and totals to the state.
+    wide = cap.query("fpx_device_commits_total")
+    col = wide.iloc[:, 0].dropna()
+    assert list(col) == sorted(col)
+    assert cap.total("fpx_device_commits_total") == float(
+        sim.stats()["committed"]
+    )
+
+
+def test_dashboard_renders_telemetry_panels(tmp_path):
+    pytest.importorskip("matplotlib")
+    from frankenpaxos_tpu.monitoring.dashboard import (
+        _load_telemetry_capture,
+        render_telemetry_dashboard,
+    )
+
+    sim = TpuSimTransport(_flagship_cfg(), seed=0)
+    sim.run(40)
+    sim.block_until_ready()
+    capture_path = tmp_path / "telemetry.json"
+    capture_path.write_text(json.dumps({"telemetry": sim.telemetry_dict()}))
+    loaded = _load_telemetry_capture(str(capture_path))
+    assert loaded is not None and loaded["ticks"] == 40
+    out = render_telemetry_dashboard(
+        loaded, str(tmp_path / "dashboard.png")
+    )
+    assert out is not None and os.path.getsize(out) > 0
+
+
+# -- The microbench hook ------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_microbench_telemetry_reports_phase_breakdown(capsys):
+    from frankenpaxos_tpu.harness.microbench import bench_telemetry
+
+    rows = bench_telemetry(
+        num_groups=16, window=16, slots_per_tick=2, ticks=40
+    )
+    cases = {r["case"]: r for r in rows}
+    assert set(cases) == {"ring_off", "ring_on"}
+    on = cases["ring_on"]
+    assert "overhead_ratio" in on and on["overhead_ratio"] > 0
+    assert on["commits_per_sec"] > 0
+    assert any(
+        line.startswith("TELEM_JSON ")
+        for line in capsys.readouterr().out.splitlines()
+    )

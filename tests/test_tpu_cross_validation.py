@@ -446,9 +446,18 @@ DTYPE_SEEDS = [0, 1, 2]
 
 def _assert_states_bit_identical(narrow_final, wide_final, what):
     assert type(narrow_final) is type(wide_final)
+    widened = widen_state(narrow_final)
     for f in dataclasses.fields(narrow_final):
-        a = np.asarray(getattr(widen_state(narrow_final), f.name))
-        b = np.asarray(getattr(wide_final, f.name))
+        a_field = getattr(widened, f.name)
+        b_field = getattr(wide_final, f.name)
+        if dataclasses.is_dataclass(a_field):
+            # Nested pytree field (the Telemetry ring) — recurse.
+            _assert_states_bit_identical(
+                a_field, b_field, f"{what}.{f.name}"
+            )
+            continue
+        a = np.asarray(a_field)
+        b = np.asarray(b_field)
         assert a.dtype == b.dtype, (what, f.name, a.dtype, b.dtype)
         np.testing.assert_array_equal(a, b, err_msg=f"{what}.{f.name}")
 

@@ -184,9 +184,16 @@ def test_sm_sharded_matches_unsharded():
     )
     assert int(plain_t) == int(sharded_t)
     for field in dataclasses.fields(plain_state):
-        a = jax.device_get(getattr(plain_state, field.name))
-        b = jax.device_get(getattr(sharded_state, field.name))
-        assert np.array_equal(a, b), field.name
+        la = jax.tree_util.tree_leaves(
+            jax.device_get(getattr(plain_state, field.name))
+        )
+        lb = jax.tree_util.tree_leaves(
+            jax.device_get(getattr(sharded_state, field.name))
+        )
+        assert len(la) == len(lb), field.name
+        assert all(
+            np.array_equal(a, b) for a, b in zip(la, lb)
+        ), field.name
 
 
 def test_sm_kv_is_log_order_not_id_max():
