@@ -24,6 +24,13 @@ otherwise — plus the dtype-policy state footprint).
 overhead head-to-head (default ring vs zero-width ring) against the <2%
 ticks/sec budget, and embeds the captured ring (renderable by
 ``python -m frankenpaxos_tpu.monitoring.dashboard <result.json>``).
+
+``--faults`` measures degraded mode: the same flagship config healthy vs
+under the standard fault plan (tpu/faults.py; extra drops + duplication
++ jitter + crash/revive driving on-device elections), reporting both
+ticks/sec and committed/sec plus the faulty run's telemetry ring capture
+(drops/retries/leader_changes actually injected). Evidence file:
+results/fault_overhead_r08.json.
 """
 
 from __future__ import annotations
@@ -215,6 +222,46 @@ def _inner_main() -> None:
                     f"telemetry overhead over budget: {ratio:.4f} < 0.98"
                 )
 
+    # Degraded-mode benchmark (--faults): healthy vs faulty ticks/sec on
+    # the winning flagship config under the standard degraded plan, with
+    # the faulty run's telemetry ring embedded so the injected
+    # drops/retries/leader_changes are visible in the artifact.
+    if "--faults" in sys.argv:
+        if over_budget():
+            result.setdefault("skipped_variants", []).append(
+                f"faults (soft budget {soft_budget:.0f}s exceeded)"
+            )
+        else:
+            from frankenpaxos_tpu.harness.microbench import (
+                measure_fault_overhead,
+            )
+            from frankenpaxos_tpu.tpu.telemetry import COL
+
+            measured = measure_fault_overhead(cfg, ticks=300)
+            tel = measured["sim_faulty"].telemetry()
+            result["faults"] = {
+                "plan": measured["plan"],
+                "ticks_per_sec_healthy": round(
+                    measured["rates"]["healthy"], 1
+                ),
+                "ticks_per_sec_faulty": round(
+                    measured["rates"]["faulty"], 1
+                ),
+                "slowdown_ratio": round(measured["ratio"], 4),
+                "committed_healthy": measured["committed"]["healthy"],
+                "committed_faulty": measured["committed"]["faulty"],
+                "drops_total": int(tel.totals[COL["drops"]]),
+                "retries_total": int(tel.totals[COL["retries"]]),
+                "leader_changes_total": int(
+                    tel.totals[COL["leader_changes"]]
+                ),
+                "invariants_ok": all(
+                    measured["sim_faulty"].check_invariants().values()
+                ),
+                # The captured ring (dashboard interchange format).
+                **measured["sim_faulty"].telemetry_dict(),
+            }
+
     # Secondary: the same cluster serving reads alongside writes through
     # the device-resident ReadBatchers (ReadBatcher.scala:239-338;
     # read_rate=1 means one read per group per tick — read load scales
@@ -345,8 +392,9 @@ def _run_inner(env: dict, timeout: float):
     """Run the measurement subprocess; return (result dict | None, note).
     Pass-through flags (--telemetry) ride along to the inner process."""
     argv = [sys.executable, os.path.abspath(__file__), "--inner"]
-    if "--telemetry" in sys.argv:
-        argv.append("--telemetry")
+    for flag in ("--telemetry", "--faults"):
+        if flag in sys.argv:
+            argv.append(flag)
     try:
         proc = subprocess.run(
             argv,
@@ -472,11 +520,14 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
         ),
         "config": cpu_live.get("config"),
         # The live run's secondary measurements (read path lin/seq/
-        # eventual, SMR) travel with the fallback record so the artifact
-        # always carries them even when the headline is a stale capture.
+        # eventual, SMR, telemetry overhead, degraded-mode faults)
+        # travel with the fallback record so the artifact always
+        # carries them even when the headline is a stale capture.
         "read_variant": cpu_live.get("read_variant"),
         "read_modes": cpu_live.get("read_modes"),
         "smr_variant": cpu_live.get("smr_variant"),
+        "telemetry": cpu_live.get("telemetry"),
+        "faults": cpu_live.get("faults"),
     }
     notes.append(
         "headline is the last-known-good real-TPU capture; "

@@ -323,39 +323,48 @@ def bench_hbm(
     return rows
 
 
+def _interleaved_best(sims: dict, ticks: int, rounds: int) -> dict:
+    """The shared overhead-measurement discipline: warm/compile every
+    variant with one segment, then INTERLEAVE ``rounds`` timed segments
+    across the variants and keep each variant's best. A small-percentage
+    budget question cannot survive sequential per-variant timing on a
+    shared box (observed ±30% between back-to-back identical segments);
+    interleaving makes all variants sample the same noise environment.
+    Returns ``{case: best_seconds}`` in ``sims`` insertion order."""
+    import time
+
+    best = {}
+    for case, sim in sims.items():
+        sim.run(ticks)  # compile + warm
+        sim.block_until_ready()
+        best[case] = float("inf")
+    for _ in range(rounds):
+        for case, sim in sims.items():
+            start = time.perf_counter()
+            sim.run(ticks)
+            sim.block_until_ready()
+            best[case] = min(best[case], time.perf_counter() - start)
+    return best
+
+
 def measure_telemetry_overhead(cfg, ticks: int, rounds: int = 3) -> dict:
     """Head-to-head telemetry-ring overhead on one config: ``ring_off``
     (zero-width ring — record() no-ops at trace time, XLA removes every
-    telemetry computation) vs ``ring_on`` (the shipped default ring).
-
-    INTERLEAVED best-of-``rounds`` segments after a warm/compile
-    segment each: a 2% budget question cannot survive sequential
-    per-variant timing on a shared box (observed ±30% between
-    back-to-back identical segments); interleaving makes both variants
-    sample the same noise environment. Shared by the ``telemetry``
+    telemetry computation) vs ``ring_on`` (the shipped default ring),
+    timed via :func:`_interleaved_best`. Shared by the ``telemetry``
     device bench below and ``bench.py --telemetry``.
 
     Returns ``{"seconds": {case: best}, "rates": {case: ticks/sec},
     "ratio": on/off, "sim_on": <the ring_on transport>}`` (``sim_on``
     has run ``(rounds + 1) * ticks`` ticks — its ring feeds the
     per-phase breakdown)."""
-    import time
-
     from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 
-    sims = {}
-    best = {}
-    for case, tel_window in (("ring_off", 0), ("ring_on", None)):
-        sims[case] = TpuSimTransport(cfg, seed=0, telemetry_window=tel_window)
-        sims[case].run(ticks)  # compile + warm
-        sims[case].block_until_ready()
-        best[case] = float("inf")
-    for _ in range(rounds):
-        for case in ("ring_off", "ring_on"):
-            start = time.perf_counter()
-            sims[case].run(ticks)
-            sims[case].block_until_ready()
-            best[case] = min(best[case], time.perf_counter() - start)
+    sims = {
+        case: TpuSimTransport(cfg, seed=0, telemetry_window=tel_window)
+        for case, tel_window in (("ring_off", 0), ("ring_on", None))
+    }
+    best = _interleaved_best(sims, ticks, rounds)
     rates = {case: ticks / s for case, s in best.items()}
     return {
         "seconds": best,
@@ -426,6 +435,99 @@ def bench_telemetry(
     return rows
 
 
+DEGRADED_PLAN_KW = dict(
+    drop_rate=0.05, dup_rate=0.05, jitter=1, crash_rate=0.005,
+    revive_rate=0.1,
+)
+
+
+def measure_fault_overhead(cfg, ticks: int, rounds: int = 3) -> dict:
+    """Degraded-mode benchmark: the SAME config run healthy
+    (``FaultPlan.none()``) vs under a standard degraded plan
+    (``DEGRADED_PLAN_KW``: 5% extra loss, 5% duplication, 1-tick jitter,
+    0.5%/10% crash/revive driving real device-side elections).
+
+    Timed via :func:`_interleaved_best`. Returns
+    ``{"seconds", "rates" (ticks/sec), "ratio" (faulty/healthy),
+    "committed" per case, "sim_faulty"}`` — the faulty transport's
+    telemetry ring shows the drops/retries/leader_changes the plan
+    injected. Shared by the ``faults`` device bench and
+    ``bench.py --faults``."""
+    import dataclasses as _dc
+
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+    plan = FaultPlan(**DEGRADED_PLAN_KW)
+    sims = {
+        case: TpuSimTransport(_dc.replace(cfg, faults=faults), seed=0)
+        for case, faults in (
+            ("healthy", FaultPlan.none()), ("faulty", plan),
+        )
+    }
+    best = _interleaved_best(sims, ticks, rounds)
+    rates = {case: ticks / s for case, s in best.items()}
+    return {
+        "plan": plan.to_dict(),
+        "seconds": best,
+        "rates": rates,
+        "ratio": rates["faulty"] / rates["healthy"],
+        "committed": {case: sims[case].committed() for case in sims},
+        "total_ticks": (rounds + 1) * ticks,
+        "sim_faulty": sims["faulty"],
+    }
+
+
+def bench_faults(
+    num_groups: int = 3334,
+    window: int = 64,
+    slots_per_tick: int = 8,
+    ticks: int = 200,
+) -> List[dict]:
+    """The degraded-mode device bench on the flagship 10k-acceptor
+    config: healthy vs faulty ticks/sec + committed/sec, with the faulty
+    run's telemetry totals (drops/retries/leader_changes actually
+    injected) on a ``FAULTS_JSON`` line."""
+    import json
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=num_groups,
+        window=window,
+        slots_per_tick=slots_per_tick,
+        lat_min=1,
+        lat_max=3,
+        retry_timeout=16,
+        thrifty=True,
+    )
+    measured = measure_fault_overhead(cfg, ticks)
+    rows = []
+    for case in ("healthy", "faulty"):
+        seconds = measured["seconds"][case]
+        row = _report("faults", case, ticks, seconds)
+        row["committed"] = measured["committed"][case]
+        if case == "faulty":
+            tel = measured["sim_faulty"].telemetry()
+            row.update(
+                {
+                    "slowdown_ratio": round(measured["ratio"], 4),
+                    "plan": measured["plan"],
+                    "drops_total": int(tel.totals[COL["drops"]]),
+                    "retries_total": int(tel.totals[COL["retries"]]),
+                    "leader_changes_total": int(
+                        tel.totals[COL["leader_changes"]]
+                    ),
+                    "num_acceptors": cfg.num_acceptors,
+                }
+            )
+            print("FAULTS_JSON " + json.dumps(row))
+        rows.append(row)
+    return rows
+
+
 BENCHES = {
     "depgraph": bench_depgraph,
     "int_prefix_set": bench_int_prefix_set,
@@ -440,6 +542,7 @@ BENCHES = {
 DEVICE_BENCHES = {
     "hbm": bench_hbm,
     "telemetry": bench_telemetry,
+    "faults": bench_faults,
 }
 
 

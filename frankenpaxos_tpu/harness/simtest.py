@@ -1,0 +1,639 @@
+"""Device-scale simulation testing: randomized fault schedules against
+property checks, batched on-device.
+
+This is the ``FakeTransport`` bad-history workflow (SimulatedSystem-style
+tests in the reference) rebuilt for the batched backends: a
+:class:`SimSpec` registry names every ``tpu/*_batched.py`` backend with a
+small config factory, a progress (liveness) counter, and its partition
+axis; the harness then
+
+  * draws randomized :class:`FaultPlan` schedules (:func:`random_plan` —
+    deterministic from a ``random.Random`` seed),
+  * runs them while checking ``check_invariants`` after every segment
+    (:func:`run_schedule`),
+  * fans the SEED axis out on-device: one compiled scan, vmapped over
+    any number of PRNG seeds, returning per-seed invariant verdicts
+    (:func:`run_many_seeds` — the "thousands of randomized schedules
+    per compiled scan" axis; a schedule's rates are static, its
+    randomness is the seed),
+  * asserts liveness resumes after a scheduled partition heal
+    (:func:`check_liveness_after_heal`), and
+  * greedily SHRINKS a failing plan to a minimized reproducer dumped as
+    JSON (:func:`shrink` / :func:`dump_reproducer` /
+    :func:`load_reproducer`) — the counterexample-minimization loop of
+    the reference's simulation tests.
+
+CLI::
+
+    python -m frankenpaxos_tpu.harness.simtest \
+        --backends multipaxos,mencius --schedules 16 --seeds 4 \
+        --out results/simtest_sweep.json
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import random as _random
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu import (
+    caspaxos_batched,
+    craq_batched,
+    epaxos_batched,
+    fasterpaxos_batched,
+    fastmultipaxos_batched,
+    fastpaxos_batched,
+    grid_batched,
+    horizontal_batched,
+    mencius_batched,
+    multipaxos_batched,
+    scalog_batched,
+    unreplicated_batched,
+    vanillamencius_batched,
+)
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+# Segment grid: schedule boundaries (partition start/heal) snap to
+# multiples of this so run_schedule's per-segment compiles are reused
+# across schedules (run_ticks specializes on the tick count).
+SEGMENT = 40
+
+
+@dataclasses.dataclass(frozen=True)
+class SimSpec:
+    """One backend's entry in the simulation-testing registry."""
+
+    name: str
+    module: object  # the tpu/*_batched.py module
+    make_config: Callable[[FaultPlan], object]
+    progress: Callable[[object], jnp.ndarray]  # liveness counter (traced ok)
+    partition_axis: int  # side-bit count random_plan must produce
+    crash_ok: bool = True  # the backend reacts to crash/revive knobs
+    # Liveness-after-heal is asserted only where a healed partition is
+    # guaranteed to resume progress within a recovery segment.
+    liveness: bool = True
+    # Longest partition window random_plan may draw (ticks); None = the
+    # horizon. Backends with ring-residency bounds set this (epaxos: a
+    # cut column's instances must still fit the frontier-history ring
+    # at the heal tick, or its config assertion fires).
+    max_partition_span: Optional[int] = None
+
+
+def _specs() -> Dict[str, SimSpec]:
+    mp = multipaxos_batched
+    me = mencius_batched
+    vm = vanillamencius_batched
+    fx = fasterpaxos_batched
+    hz = horizontal_batched
+    gr = grid_batched
+    fm = fastmultipaxos_batched
+    fpx = fastpaxos_batched
+    cp = caspaxos_batched
+    cr = craq_batched
+    ep = epaxos_batched
+    sc = scalog_batched
+    ur = unreplicated_batched
+    entries = [
+        SimSpec(
+            "multipaxos", mp,
+            lambda f: mp.BatchedMultiPaxosConfig(
+                f=1, num_groups=4, window=16, slots_per_tick=2,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=3,
+        ),
+        SimSpec(
+            "mencius", me,
+            lambda f: me.BatchedMenciusConfig(
+                f=1, num_leaders=4, window=16, slots_per_tick=2,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=3,
+            # A crashed mencius leader pins the global watermark (plain
+            # Mencius has no revocation); commits still advance, but a
+            # crash landing near the end of a run can legitimately hold
+            # the post-heal delta at zero.
+            liveness=False,
+        ),
+        SimSpec(
+            "vanillamencius", vm,
+            lambda f: vm.BatchedVanillaMenciusConfig(
+                num_servers=4, window=16, slots_per_tick=2,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=3,
+        ),
+        SimSpec(
+            "fasterpaxos", fx,
+            lambda f: fx.BatchedFasterPaxosConfig(
+                num_groups=4, window=8, slots_per_tick=2,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=3,
+        ),
+        SimSpec(
+            "horizontal", hz,
+            lambda f: hz.BatchedHorizontalConfig(
+                num_groups=4, window=16, slots_per_tick=2, alpha=8,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=6,
+        ),
+        SimSpec(
+            "grid", gr,
+            lambda f: gr.GridBatchedConfig(
+                rows=3, cols=3, window=16, slots_per_tick=2,
+                retry_timeout=8, faults=f,
+            ),
+            lambda st: st.committed, partition_axis=9, crash_ok=False,
+        ),
+        SimSpec(
+            "fastmultipaxos", fm,
+            lambda f: fm.BatchedFastMultiPaxosConfig(
+                num_groups=4, window=16, cmd_window=16, cmds_per_tick=2,
+                faults=f,
+            ),
+            lambda st: st.committed_slots, partition_axis=3,
+            crash_ok=False,
+        ),
+        SimSpec(
+            "fastpaxos", fpx,
+            lambda f: fpx.BatchedFastPaxosConfig(
+                num_groups=4, window=16, instances_per_tick=2, faults=f,
+            ),
+            lambda st: st.chosen_total, partition_axis=3, crash_ok=False,
+        ),
+        SimSpec(
+            "caspaxos", cp,
+            lambda f: cp.BatchedCasPaxosConfig(
+                num_registers=4, num_leaders=2, op_rate=0.3, faults=f,
+            ),
+            lambda st: st.commits, partition_axis=3, crash_ok=False,
+            # CASPaxos leaders stall while a quorum is cut and their
+            # exchanges buffer to the heal tick; commits resume, but a
+            # backoff can straddle the final segment.
+            liveness=False,
+        ),
+        SimSpec(
+            "craq", cr,
+            lambda f: cr.BatchedCraqConfig(
+                num_chains=4, chain_len=3, num_keys=8, window=8,
+                writes_per_tick=2, reads_per_tick=2, read_window=8,
+                faults=f,
+            ),
+            lambda st: st.writes_done, partition_axis=3, crash_ok=False,
+        ),
+        SimSpec(
+            "epaxos", ep,
+            lambda f: ep.BatchedEPaxosConfig(
+                num_columns=5, window=32, instances_per_tick=2,
+                num_exec_replicas=3, faults=f,
+            ),
+            lambda st: st.committed_total, partition_axis=5,
+            # frontier_history=256, lat_max=3: span + 24 < 256.
+            max_partition_span=200,
+        ),
+        SimSpec(
+            "scalog", sc,
+            lambda f: sc.BatchedScalogConfig(num_shards=4, faults=f),
+            lambda st: st.committed_cuts, partition_axis=4,
+        ),
+        SimSpec(
+            "unreplicated", ur,
+            lambda f: ur.BatchedUnreplicatedConfig(
+                num_servers=4, window=16, ops_per_tick=2, faults=f,
+            ),
+            lambda st: st.done, partition_axis=4, crash_ok=False,
+        ),
+    ]
+    return {s.name: s for s in entries}
+
+
+SPECS: Dict[str, SimSpec] = _specs()
+
+
+# ---------------------------------------------------------------------------
+# Randomized schedules
+# ---------------------------------------------------------------------------
+
+
+def random_plan(
+    rng: _random.Random, spec: SimSpec, horizon: int
+) -> FaultPlan:
+    """One randomized fault schedule, deterministic from ``rng``'s state.
+    Partition heals always land on the SEGMENT grid inside the horizon,
+    so every schedule's liveness-after-heal is checkable and the
+    per-segment compiles are shared across schedules."""
+    kw: dict = {}
+    if rng.random() < 0.7:
+        kw["drop_rate"] = round(rng.uniform(0.02, 0.25), 3)
+    if rng.random() < 0.4:
+        kw["dup_rate"] = round(rng.uniform(0.02, 0.2), 3)
+    if rng.random() < 0.5:
+        kw["jitter"] = rng.randint(1, 3)
+    if spec.crash_ok and rng.random() < 0.35:
+        kw["crash_rate"] = round(rng.uniform(0.005, 0.05), 3)
+        kw["revive_rate"] = round(rng.uniform(0.1, 0.3), 3)
+    if rng.random() < 0.5:
+        n = spec.partition_axis
+        # Cut a strict minority of the replica axis (side 1).
+        cut = rng.sample(range(n), rng.randint(1, max(1, (n - 1) // 2)))
+        side = tuple(1 if i in cut else 0 for i in range(n))
+        n_seg = max(2, horizon // SEGMENT)
+        heal_seg = rng.randint(1, n_seg - 1)
+        heal = heal_seg * SEGMENT
+        start = rng.randint(0, heal - 1)
+        if (
+            spec.max_partition_span is not None
+            and heal - start > spec.max_partition_span
+        ):
+            start = heal - spec.max_partition_span
+        kw["partition"] = side
+        kw["partition_heal"] = heal
+        kw["partition_start"] = start
+    return FaultPlan(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Running schedules
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 5))
+def _run_segment(mod, cfg, state, t0, start, n: int, key):
+    """One scan segment whose per-tick keys fold the GLOBAL tick index
+    (``start + i``) into one run-level key — so a (plan, seed) schedule
+    replays the exact same fault history whether it runs as one vmapped
+    scan (:func:`run_many_seeds`) or as invariant-checked segments
+    (:func:`run_schedule`, :func:`check_liveness_after_heal`). ``start``
+    is traced, so every segment of a given length shares one compile."""
+
+    def step(carry, i):
+        st, t = carry
+        st = mod.tick(cfg, st, t, jax.random.fold_in(key, start + i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(n)
+    )
+    return state, t
+
+
+def run_schedule(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    ticks: int = 3 * SEGMENT,
+    segment: int = SEGMENT,
+) -> dict:
+    """Run one (plan, seed) schedule in segments, checking invariants at
+    every segment boundary. Per-tick keys fold the global tick index, so
+    the history is IDENTICAL to a :func:`run_many_seeds` run of the same
+    (plan, seed) — found counterexamples replay and shrink here 1:1.
+    Returns ``{"ok", "violations", "progress", "plan", "seed",
+    "ticks"}``; ``violations`` maps each failed check to the FIRST
+    segment-end tick it was seen at; ``progress`` is the liveness
+    counter at each boundary."""
+    mod = spec.module
+    cfg = spec.make_config(plan)
+    state = mod.init_state(cfg)
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    violations: Dict[str, int] = {}
+    progress: List[int] = []
+    done = 0
+    while done < ticks:
+        n = min(segment, ticks - done)
+        state, t = _run_segment(
+            mod, cfg, state, t, jnp.int32(done), n, key
+        )
+        done += n
+        inv = mod.check_invariants(cfg, state, t)
+        for k, v in inv.items():
+            if not bool(v):
+                violations.setdefault(k, done)
+        progress.append(int(spec.progress(state)))
+    return {
+        "backend": spec.name,
+        "ok": not violations,
+        "violations": violations,  # first-seen segment-end tick per check
+        "progress": progress,
+        "plan": plan.to_dict(),
+        "seed": seed,
+        "ticks": ticks,
+    }
+
+
+def run_many_seeds(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seeds: Sequence[int],
+    ticks: int = 2 * SEGMENT,
+) -> dict:
+    """The device-scale axis: ONE compiled scan, vmapped over the seed
+    axis, returning per-seed invariant verdicts and progress counters.
+    The plan's rates are compile-time static; the schedule realization
+    (which messages drop, when crashes hit, who duplicates) is entirely
+    seed-driven, so N seeds are N distinct fault histories for one
+    compile."""
+    mod = spec.module
+    cfg = spec.make_config(plan)
+
+    def one(key):
+        def step(carry, i):
+            st, t = carry
+            st = mod.tick(cfg, st, t, jax.random.fold_in(key, i))
+            return (st, t + 1), ()
+
+        (st, t), _ = jax.lax.scan(
+            step,
+            (mod.init_state(cfg), jnp.zeros((), jnp.int32)),
+            jnp.arange(ticks),
+        )
+        inv = mod.check_invariants(cfg, st, t)
+        return (
+            {k: jnp.asarray(v) for k, v in inv.items()},
+            jnp.asarray(spec.progress(st)),
+        )
+
+    keys = jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(list(seeds), jnp.uint32)
+    )
+    invs, progress = jax.jit(jax.vmap(one))(keys)
+    invs = jax.device_get(invs)
+    progress = jax.device_get(progress)
+    per_seed_ok = [
+        all(bool(invs[k][i]) for k in invs) for i in range(len(seeds))
+    ]
+    return {
+        "backend": spec.name,
+        "plan": plan.to_dict(),
+        "seeds": list(seeds),
+        "ticks": ticks,
+        "ok": all(per_seed_ok),
+        "per_seed_ok": per_seed_ok,
+        "failing_seeds": [
+            s for s, ok in zip(seeds, per_seed_ok) if not ok
+        ],
+        "progress": [int(p) for p in progress],
+    }
+
+
+def check_liveness_after_heal(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    recovery: int = 2 * SEGMENT,
+) -> dict:
+    """For a plan with a scheduled heal: progress measured at the heal
+    tick must strictly grow over the recovery window after it."""
+    assert plan.has_partition and plan.partition_heal >= 0, plan
+    mod = spec.module
+    cfg = spec.make_config(plan)
+    state = mod.init_state(cfg)
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    done = 0
+    while done < plan.partition_heal:
+        n = min(SEGMENT, plan.partition_heal - done)
+        state, t = _run_segment(
+            mod, cfg, state, t, jnp.int32(done), n, key
+        )
+        done += n
+    at_heal = int(spec.progress(state))
+    state, t = _run_segment(
+        mod, cfg, state, t, jnp.int32(done), recovery, key
+    )
+    after = int(spec.progress(state))
+    inv = {k: bool(v) for k, v in mod.check_invariants(cfg, state, t).items()}
+    return {
+        "backend": spec.name,
+        "at_heal": at_heal,
+        "after_recovery": after,
+        "resumed": after > at_heal,
+        "invariants_ok": all(inv.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Shrinking
+# ---------------------------------------------------------------------------
+
+
+def _quantize(rate: float) -> float:
+    return 0.0 if rate < 0.004 else round(rate, 3)
+
+
+def _candidates(plan: FaultPlan) -> List[FaultPlan]:
+    """Ordered simplification candidates: whole-knob removals first
+    (biggest steps), then halvings and partition-window shrinks."""
+    out: List[FaultPlan] = []
+
+    def repl(**kw):
+        cand = dataclasses.replace(plan, **kw)
+        if cand != plan:
+            out.append(cand)
+
+    # Remove whole knobs.
+    repl(drop_rate=0.0)
+    repl(dup_rate=0.0)
+    repl(jitter=0)
+    repl(crash_rate=0.0, revive_rate=0.0)
+    repl(partition=(), partition_start=0, partition_heal=-1)
+    # Halve rates / jitter.
+    repl(drop_rate=_quantize(plan.drop_rate / 2))
+    repl(dup_rate=_quantize(plan.dup_rate / 2))
+    repl(crash_rate=_quantize(plan.crash_rate / 2))
+    if plan.jitter > 0:
+        repl(jitter=plan.jitter // 2)
+    # Shrink the partition: fewer cut replicas, narrower window.
+    if plan.has_partition:
+        ones = [i for i, s in enumerate(plan.partition) if s]
+        if len(ones) > 1:
+            smaller = list(plan.partition)
+            smaller[ones[-1]] = 0
+            repl(partition=tuple(smaller))
+        if plan.partition_heal >= 0:
+            span = plan.partition_heal - plan.partition_start
+            # Halve the cut window (floor 8 ticks)...
+            if span > 8:
+                repl(
+                    partition_heal=plan.partition_start + max(8, span // 2)
+                )
+            # ...and slide the whole window toward t=0, span preserved.
+            if plan.partition_start > 0:
+                ns = plan.partition_start // 2
+                repl(partition_start=ns, partition_heal=ns + span)
+        elif plan.partition_start > 0:
+            repl(partition_start=plan.partition_start // 2)
+    return out
+
+
+def shrink(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    ticks: int = 3 * SEGMENT,
+    failing: Optional[Callable[[FaultPlan], bool]] = None,
+    max_steps: int = 64,
+) -> FaultPlan:
+    """Greedy schedule minimization: repeatedly apply the first
+    simplification candidate that still fails, until none does. The
+    default failure predicate is "run_schedule reports an invariant
+    violation"; tests inject their own (e.g. a deliberately-broken
+    invariant) to pin the loop's behavior. ``plan`` must fail."""
+    if failing is None:
+        def failing(p: FaultPlan) -> bool:
+            return not run_schedule(spec, p, seed, ticks)["ok"]
+
+    assert failing(plan), "shrink() needs a failing plan to start from"
+    steps = 0
+    improved = True
+    while improved and steps < max_steps:
+        improved = False
+        for cand in _candidates(plan):
+            steps += 1
+            if failing(cand):
+                plan = cand
+                improved = True
+                break
+            if steps >= max_steps:
+                break
+    return plan
+
+
+def dump_reproducer(
+    path: str,
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    ticks: int,
+    note: str = "",
+) -> dict:
+    """Write a minimized reproducer as JSON (the bad-history artifact):
+    backend + seed + tick horizon + the shrunk FaultPlan."""
+    payload = {
+        "backend": spec.name,
+        "seed": seed,
+        "ticks": ticks,
+        "fault_plan": plan.to_dict(),
+        "note": note,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
+def load_reproducer(path: str):
+    """Load a reproducer JSON: returns ``(spec, plan, seed, ticks)`` —
+    feed straight back into :func:`run_schedule`."""
+    with open(path) as f:
+        payload = json.load(f)
+    spec = SPECS[payload["backend"]]
+    plan = FaultPlan.from_dict(payload["fault_plan"])
+    return spec, plan, int(payload["seed"]), int(payload["ticks"])
+
+
+# ---------------------------------------------------------------------------
+# Sweeps
+# ---------------------------------------------------------------------------
+
+
+def sweep(
+    backends: Optional[Sequence[str]] = None,
+    schedules: int = 16,
+    seeds_per_schedule: int = 4,
+    ticks: int = 3 * SEGMENT,
+    base_seed: int = 0,
+    check_liveness: bool = True,
+) -> dict:
+    """Randomized fault-schedule sweep over the registry: per backend,
+    ``schedules`` random plans x ``seeds_per_schedule`` vmapped seeds,
+    invariants checked on every run; plans with a scheduled heal also
+    get a liveness-after-heal assertion (where the spec supports it).
+    Returns a JSON-ready summary with every failure's (plan, seed)."""
+    names = list(backends) if backends else list(SPECS)
+    out: dict = {"schedules": schedules, "seeds_per_schedule":
+                 seeds_per_schedule, "ticks": ticks, "backends": {}}
+    for name in names:
+        spec = SPECS[name]
+        # crc32, not hash(): Python string hashing is process-randomized
+        # and would make identical sweep invocations non-reproducible.
+        rng = _random.Random(
+            base_seed * 7919 + zlib.crc32(name.encode())
+        )
+        failures: List[dict] = []
+        liveness_rows: List[dict] = []
+        ran = 0
+        for i in range(schedules):
+            plan = random_plan(rng, spec, ticks)
+            seeds = [base_seed + i * seeds_per_schedule + j
+                     for j in range(seeds_per_schedule)]
+            res = run_many_seeds(spec, plan, seeds, ticks)
+            ran += len(seeds)
+            if not res["ok"]:
+                failures.append(
+                    {"plan": plan.to_dict(),
+                     "failing_seeds": res["failing_seeds"]}
+                )
+            if (
+                check_liveness
+                and spec.liveness
+                and plan.has_partition
+                and plan.partition_heal >= 0
+                and not plan.has_crash
+            ):
+                lv = check_liveness_after_heal(spec, plan, seeds[0])
+                liveness_rows.append(lv)
+        resumed = sum(r["resumed"] for r in liveness_rows)
+        out["backends"][name] = {
+            "schedules": schedules,
+            "runs": ran,
+            "failures": failures,
+            # A backend is green only if invariants held on every run
+            # AND every checked heal actually resumed progress.
+            "ok": not failures and resumed == len(liveness_rows),
+            "liveness_checked": len(liveness_rows),
+            "liveness_resumed": resumed,
+        }
+    out["ok"] = all(b["ok"] for b in out["backends"].values())
+    return out
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--backends", default="",
+                   help="comma-separated (default: all)")
+    p.add_argument("--schedules", type=int, default=16)
+    p.add_argument("--seeds", type=int, default=4)
+    p.add_argument("--ticks", type=int, default=3 * SEGMENT)
+    p.add_argument("--base-seed", type=int, default=0)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+    backends = (
+        [b for b in args.backends.split(",") if b] or None
+    )
+    result = sweep(
+        backends=backends,
+        schedules=args.schedules,
+        seeds_per_schedule=args.seeds,
+        ticks=args.ticks,
+        base_seed=args.base_seed,
+    )
+    text = json.dumps(result, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,16 +37,25 @@ def reference_vote_quorum(
     p2b_lat: jnp.ndarray,  # [A, G, W] int32 sampled latencies
     p2b_delivered: jnp.ndarray,  # [A, G, W] bool
     t: jnp.ndarray,  # [] int32 current tick
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+    jnp.ndarray,
+]:
     """The pure-jnp specification (tick steps 1-2 of multipaxos_batched,
-    Acceptor.scala:184-220 + ProxyLeader.scala:217-258), acceptor-major."""
+    Acceptor.scala:184-220 + ProxyLeader.scala:217-258), acceptor-major.
+
+    The sixth output ``nsends`` [G, W] counts the Phase2b messages the
+    acceptors SENT this tick (votes cast whose reply was delivered) —
+    the vote predicate is otherwise kernel-internal, and the telemetry
+    phase-2 message accounting needs it to be exact under use_pallas."""
     lr = leader_round[None, :, None]  # [1, G, 1]
     arrived = p2a_arrival == t
     may_vote = arrived & (lr >= acc_round[:, :, None])
     new_vote_round = jnp.where(may_vote, lr, vote_round)
     new_vote_value = jnp.where(may_vote, slot_value[None, :, :], vote_value)
+    sends = may_vote & p2b_delivered
     new_p2b = jnp.where(
-        may_vote & p2b_delivered,
+        sends,
         jnp.minimum(p2b_arrival, t + p2b_lat),
         p2b_arrival,
     )
@@ -55,7 +64,8 @@ def reference_vote_quorum(
     )
     votes_in = (new_p2b <= t) & (new_vote_round == lr)
     nvotes = jnp.sum(votes_in.astype(jnp.int32), axis=0)  # [G, W]
-    return new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes
+    nsends = jnp.sum(sends.astype(jnp.int32), axis=0)  # [G, W]
+    return new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes, nsends
 
 
 def _vote_quorum_kernel(
@@ -74,12 +84,14 @@ def _vote_quorum_kernel(
     out_p2b_ref,  # [A, BG, W]
     out_accr_ref,  # [A, BG]
     out_nv_ref,  # [BG, W]
+    out_ns_ref,  # [BG, W] Phase2b sends this tick
 ):
     t = t_ref[0]
     A = p2a_ref.shape[0]
     lr = lr_ref[:][:, None]  # [BG, 1]
     sv = sv_ref[:]  # [BG, W]
     nvotes = jnp.zeros(sv.shape, jnp.int32)
+    nsends = jnp.zeros(sv.shape, jnp.int32)
     # The acceptor axis is tiny (2f+1): a static loop keeps every slice a
     # well-tiled [BG, W] block, with values resident in VMEM across the
     # vote update AND the quorum count.
@@ -100,7 +112,9 @@ def _vote_quorum_kernel(
             accr_ref[a], jnp.max(jnp.where(may_vote, lr, -1), axis=1)
         )
         nvotes = nvotes + ((new_p2b <= t) & (new_vr == lr)).astype(jnp.int32)
+        nsends = nsends + deliver.astype(jnp.int32)
     out_nv_ref[:] = nvotes
+    out_ns_ref[:] = nsends
 
 
 @functools.partial(jax.jit, static_argnames=("block_g", "interpret"))
@@ -174,7 +188,7 @@ def fused_vote_quorum(
             spec3,  # p2b_lat
             spec3,  # delivered
         ],
-        out_specs=[spec3, spec3, spec3, spec2, spec_gw],
+        out_specs=[spec3, spec3, spec3, spec2, spec_gw, spec_gw],
     )
     out_shape = [
         jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # vote_round
@@ -182,8 +196,9 @@ def fused_vote_quorum(
         jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # p2b_arrival
         jax.ShapeDtypeStruct((A, Gp), jnp.int32),  # acc_round
         jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nvotes
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # Phase2b sends
     ]
-    vr, vv, p2b, accr, nv = pl.pallas_call(
+    vr, vv, p2b, accr, nv, ns = pl.pallas_call(
         _vote_quorum_kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -202,5 +217,5 @@ def fused_vote_quorum(
     )
     if pad:
         vr, vv, p2b = vr[:, :G], vv[:, :G], p2b[:, :G]
-        accr, nv = accr[:, :G], nv[:G]
-    return vr, vv, p2b, accr, nv
+        accr, nv, ns = accr[:, :G], nv[:G], ns[:G]
+    return vr, vv, p2b, accr, nv, ns

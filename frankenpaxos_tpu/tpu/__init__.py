@@ -15,12 +15,15 @@ from frankenpaxos_tpu.tpu import (
     fasterpaxos_batched,
     fastmultipaxos_batched,
     fastpaxos_batched,
+    faults,
+    grid_batched,
     horizontal_batched,
     mencius_batched,
     scalog_batched,
     unreplicated_batched,
     vanillamencius_batched,
 )
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.caspaxos_batched import (
     BatchedCasPaxosConfig,
     BatchedCasPaxosState,
@@ -71,9 +74,12 @@ __all__ = [
     "BatchedMenciusState",
     "BatchedMultiPaxosConfig",
     "BatchedMultiPaxosState",
+    "FaultPlan",
     "TpuSimTransport",
     "check_invariants",
     "epaxos_batched",
+    "faults",
+    "grid_batched",
     "init_state",
     "leader_change",
     "horizontal_batched",

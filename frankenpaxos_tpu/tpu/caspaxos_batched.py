@@ -47,6 +47,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_delivered,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Leader status.
@@ -70,6 +72,13 @@ class BatchedCasPaxosConfig:
     lat_max: int = 3
     backoff_min: int = 2  # nack backoff (uniform, in ticks)
     backoff_max: int = 10
+    # Unified in-graph fault injection (tpu/faults.py), TCP semantics:
+    # CASPaxos leaders have no phase timeout, so drops become
+    # retransmission penalties and an acceptor-axis partition BUFFERS
+    # the dn/up exchanges until the heal tick (a never-healing cut of a
+    # quorum permanently stalls affected leaders — that is the real
+    # failure mode). FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def n(self) -> int:
@@ -85,6 +94,7 @@ class BatchedCasPaxosConfig:
         assert 0.0 <= self.op_rate <= 1.0
         assert 1 <= self.lat_min <= self.lat_max
         assert 1 <= self.backoff_min <= self.backoff_max
+        self.faults.validate(axis=self.n)
 
 
 @jax.tree_util.register_dataclass
@@ -195,6 +205,25 @@ def tick(
     up_lat = bit_latency(bits3, 8, cfg.lat_min, cfg.lat_max)
     backoff = bit_latency(bits2, 0, cfg.backoff_min, cfg.backoff_max)
 
+    # Unified fault injection (tpu/faults.py), TCP semantics: drops are
+    # retransmission penalties on the leg's latency; a partition of
+    # acceptor rows buffers both legs until the heal tick. The dn/up
+    # arrival offsets below replace every `t + *_lat` write; under a
+    # none plan they ARE `t + *_lat` (structural no-op).
+    fp = cfg.faults
+    if fp.active:
+        kf = faults_mod.fault_key(key)
+        dn_lat = faults_mod.tcp_latency(fp, jax.random.fold_in(kf, 0),
+                                        (A, L, G), dn_lat)
+        up_lat = faults_mod.tcp_latency(fp, jax.random.fold_in(kf, 1),
+                                        (A, L, G), up_lat)
+    dn_arr = t + dn_lat
+    up_arr = t + up_lat
+    if fp.has_partition:
+        cut = ~faults_mod.partition_row(fp, t, A)[:, None, None]
+        dn_arr = faults_mod.defer_to_heal(fp, dn_arr, cut)
+        up_arr = faults_mod.defer_to_heal(fp, up_arr, cut)
+
     # ---- 1. Acceptors process dn arrivals (CasAcceptor.receive). Within
     # a tick an acceptor takes only its HIGHEST-round arrival and nacks
     # the rest — a deterministic serialization of same-tick deliveries
@@ -225,7 +254,7 @@ def tick(
     # phase-1b vote payload is captured AFTER this tick's vote (an
     # acceptor that just voted reports that vote — same-tick accuracy).
     nack = arr & ~ok
-    up_arrival = jnp.where(arr, t + up_lat, state.up_arrival)
+    up_arrival = jnp.where(arr, up_arr, state.up_arrival)
     up_round = jnp.where(arr, state.dn_round, state.up_round)
     up_nack = jnp.where(arr, nack, state.up_nack)
     up_nack_round = jnp.where(arr, a_round[:, None, :], state.up_nack_round)
@@ -338,7 +367,7 @@ def tick(
     backoff_until = jnp.where(nacked, t + backoff, backoff_until)
     # P1 -> P2: send phase 2a to every acceptor.
     send_p2 = p1_done[None, :, :]
-    dn_arrival = jnp.where(send_p2, t + dn_lat, dn_arrival)
+    dn_arrival = jnp.where(send_p2, dn_arr, dn_arrival)
     dn_round = jnp.where(send_p2, state.l_round[None, :, :], state.dn_round)
     dn_phase = jnp.where(send_p2, 2, state.dn_phase)
     dn_value = jnp.where(send_p2, l_value[None, :, :], state.dn_value)
@@ -381,7 +410,7 @@ def tick(
     next_round = jnp.where(next_round <= floor, next_round + L, next_round)
     l_round = jnp.where(ready, next_round, l_round)
     send_p1 = ready[None, :, :]
-    dn_arrival = jnp.where(send_p1, t + dn_lat, dn_arrival)
+    dn_arrival = jnp.where(send_p1, dn_arr, dn_arrival)
     dn_round = jnp.where(send_p1, l_round[None, :, :], dn_round)
     dn_phase = jnp.where(send_p1, 1, dn_phase)
     l_status = jnp.where(ready, L_P1, l_status)

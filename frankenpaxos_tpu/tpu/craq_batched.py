@@ -47,6 +47,8 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Write slot status.
@@ -74,6 +76,14 @@ class BatchedCraqConfig:
     read_window: int = 16  # RW: outstanding reads per chain
     lat_min: int = 1
     lat_max: int = 3
+    # Unified in-graph fault injection (tpu/faults.py), TCP semantics
+    # (the chain runs on reliable links): drops become retransmission
+    # penalties on hop latencies, and a CHAIN-NODE partition (side bits
+    # over the L nodes) buffers hops INTO cut nodes until the heal tick
+    # — writes queue behind the cut and drain afterwards, so the
+    # pending-set conservation invariants hold throughout.
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     def __post_init__(self):
         assert self.num_chains >= 1
@@ -83,6 +93,7 @@ class BatchedCraqConfig:
         if self.reads_per_tick:
             assert self.read_window >= 2 * self.reads_per_tick
         assert 1 <= self.lat_min <= self.lat_max
+        self.faults.validate(axis=self.chain_len)
 
 
 @jax.tree_util.register_dataclass
@@ -177,6 +188,32 @@ def tick(
     hop_lat_w = bit_latency(bits_w, 0, cfg.lat_min, cfg.lat_max)
     hop_lat_r = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max)
 
+    # Unified fault injection (tpu/faults.py), TCP semantics: drops are
+    # retransmission penalties on hop latencies; `_hop(arr, node)` below
+    # buffers hops whose TARGET node sits on the cut side of an active
+    # partition until the heal tick. Under a none plan `_hop` is the
+    # identity and the latencies are untouched (structural no-op).
+    fp = cfg.faults
+    if fp.active:
+        kf = faults_mod.fault_key(key)
+        hop_lat_w = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 0), (N, W), hop_lat_w
+        )
+        hop_lat_r = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 1), (N, RW), hop_lat_r
+        )
+    if fp.has_partition:
+        _side = faults_mod.partition_sides(fp)
+        _cut_live = faults_mod.partition_active(fp, t)
+
+        def _hop(arrival, node):
+            cut = _cut_live & (_side[node] == 1)
+            return faults_mod.defer_to_heal(fp, arrival, cut)
+    else:
+
+        def _hop(arrival, node):
+            return arrival
+
     n_rows_w = jnp.broadcast_to(
         jnp.arange(N, dtype=jnp.int32)[:, None], (N, W)
     )
@@ -219,7 +256,9 @@ def tick(
     w_node = jnp.where(at_mid, w_node + 1, w_node)
     w_node = jnp.where(at_tail, tail - 1, w_node)
     w_status = jnp.where(at_tail, W_UP, w_status)
-    w_arrival = jnp.where(arrive_down, t + hop_lat_w, w_arrival)
+    w_arrival = jnp.where(
+        arrive_down, _hop(t + hop_lat_w, w_node), w_arrival
+    )
 
     # ---- 2. UP (ack) arrivals (ChainNode._handle_ack): apply the write
     # locally, drop it from the pending set, and keep propagating; the
@@ -237,7 +276,7 @@ def tick(
     w_arrival = jnp.where(retire, INF, w_arrival)
     keep_up = arrive_up & ~retire
     w_node = jnp.where(keep_up, w_node - 1, w_node)
-    w_arrival = jnp.where(keep_up, t + hop_lat_w, w_arrival)
+    w_arrival = jnp.where(keep_up, _hop(t + hop_lat_w, w_node), w_arrival)
 
     # ---- 3. Reads (apportioned queries, ChainNode._process_read_batch).
     r_status = state.r_status
@@ -283,7 +322,13 @@ def tick(
         r_version = jnp.where(clean, local_ver, r_version)
         r_status = jnp.where(clean, R_REPLY, r_status)
         r_status = jnp.where(dirty, R_TAIL, r_status)
-        r_arrival = jnp.where(at_node, t + hop_lat_r, r_arrival)
+        # Dirty queries hop to the tail; clean replies hop back over the
+        # serving node's client link.
+        r_arrival = jnp.where(
+            at_node,
+            _hop(t + hop_lat_r, jnp.where(dirty, tail, r_node)),
+            r_arrival,
+        )
         reads_clean = reads_clean + jnp.sum(clean)
         reads_dirty = reads_dirty + jnp.sum(dirty)
 
@@ -293,7 +338,9 @@ def tick(
         tail_ver = jnp.take_along_axis(node_version_flat, tslot, axis=1)
         r_version = jnp.where(at_tail_r, tail_ver, r_version)
         r_status = jnp.where(at_tail_r, R_REPLY, r_status)
-        r_arrival = jnp.where(at_tail_r, t + hop_lat_r, r_arrival)
+        r_arrival = jnp.where(
+            at_tail_r, _hop(t + hop_lat_r, tail), r_arrival
+        )
 
         # (d) Issue new reads at a PRNG node/key; the floor is the tail's
         # committed version for the key right now.
@@ -316,7 +363,9 @@ def tick(
         r_issue = jnp.where(issue_r, t, r_issue)
         r_version = jnp.where(issue_r, -1, r_version)
         r_status = jnp.where(issue_r, R_AT_NODE, r_status)
-        r_arrival = jnp.where(issue_r, t + hop_lat_r, r_arrival)
+        r_arrival = jnp.where(
+            issue_r, _hop(t + hop_lat_r, new_node), r_arrival
+        )
 
     # ---- 4. New writes into empty ring slots (CraqClient.write -> head).
     empty_w = w_status == W_EMPTY
@@ -331,7 +380,7 @@ def tick(
     w_version = jnp.where(issue_w, new_version, state.w_version)
     w_node = jnp.where(issue_w, 0, w_node)
     w_status = jnp.where(issue_w, W_DOWN, w_status)
-    w_arrival = jnp.where(issue_w, t + hop_lat_w, w_arrival)
+    w_arrival = jnp.where(issue_w, _hop(t + hop_lat_w, 0), w_arrival)
     w_issue = jnp.where(issue_w, t, state.w_issue)
     next_version = state.next_version + count_w
 

@@ -63,6 +63,8 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     sample_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 _LANES = 32  # columns per packed visibility word
@@ -123,6 +125,14 @@ class BatchedEPaxosConfig:
     rep_revive_rate: float = 0.1  # per-crashed-replica revival probability
     snapshot_every: int = 32  # ticks between snapshot-barrier captures
     gc_quorum: int = 2  # replicas that must have executed before pruning
+    # Unified in-graph fault injection (tpu/faults.py): the commit round
+    # is modeled end-to-end (PreAccept/Accept RTTs), so drops/jitter
+    # stretch it (TCP retransmit semantics) and a COLUMN-axis partition
+    # defers cut columns' commits to the heal tick (their instances —
+    # and every dependency chain through them — stall until then).
+    # Crash/revive merges into the GC replica churn when that layer is
+    # on. FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def num_replicas(self) -> int:
@@ -152,6 +162,21 @@ class BatchedEPaxosConfig:
             assert self.replica_lag >= 1
             assert self.snapshot_every >= 1
             assert 0.0 <= self.rep_crash_rate <= 1.0
+            assert 0.0 <= self.rep_revive_rate <= 1.0
+        self.faults.validate(axis=self.num_columns)
+        if self.faults.has_partition:
+            # A cut column's instances commit only at the heal tick, and
+            # their factored dependency rows must still be in the
+            # frontier-history ring then (age_ok fails loudly otherwise).
+            assert self.faults.partition_heal >= 0, (
+                "epaxos needs a healing partition: a never-healing cut "
+                "column outlives the frontier-history ring"
+            )
+            span = self.faults.partition_heal - self.faults.partition_start
+            assert span + 8 * self.lat_max < self.frontier_history, (
+                f"partition window {span} too long for "
+                f"frontier_history={self.frontier_history}"
+            )
 
 
 @jax.tree_util.register_dataclass
@@ -383,6 +408,7 @@ def tick(
     CW = _num_words(C)
     k_vis, k_slow, k_lat = jax.random.split(key, 3)
     w_iota = jnp.arange(W, dtype=jnp.int32)
+    fp = cfg.faults  # unified fault plan (tpu/faults.py)
 
     # ---- 1. Commits land (EpCommit arrival at the replica).
     landing = state.commit_tick <= t
@@ -447,11 +473,16 @@ def tick(
         k_pull, k_crash, k_revive = jax.random.split(
             jax.random.fold_in(key, 1), 3
         )
+        # A FaultPlan crash schedule composes with the native GC-replica
+        # churn rates (identity under a none plan).
+        eff_crash, eff_revive = faults_mod.effective_process_rates(
+            fp, cfg.rep_crash_rate, cfg.rep_revive_rate
+        )
         crash = ~state.rep_down & (
-            jax.random.uniform(k_crash, (R,)) < cfg.rep_crash_rate
+            jax.random.uniform(k_crash, (R,)) < eff_crash
         )
         revive = state.rep_down & (
-            jax.random.uniform(k_revive, (R,)) < cfg.rep_revive_rate
+            jax.random.uniform(k_revive, (R,)) < eff_revive
         )
         rep_down = (state.rep_down | crash) & ~revive
         rep_crashes = state.rep_crashes + jnp.sum(crash)
@@ -572,9 +603,22 @@ def tick(
         slow = jax.random.uniform(k_slow, (C, W)) < cfg.slow_path_rate
     fast_path_total = state.fast_path_total + jnp.sum(is_new & ~slow)
     commit_lat = jnp.where(slow, rtt, fast)
+    # Unified fault injection: the commit round is modeled end-to-end,
+    # so drops/jitter stretch it (TCP retransmit semantics) and a cut
+    # column's commits defer to the partition's heal tick. none() skips
+    # this at trace time.
+    commit_arr = t + commit_lat
+    if fp.drop_rate > 0.0 or fp.jitter > 0:
+        commit_lat = faults_mod.tcp_latency(
+            fp, faults_mod.fault_key(key), (C, W), commit_lat
+        )
+        commit_arr = t + commit_lat
+    if fp.has_partition:
+        cut_col = (~faults_mod.partition_row(fp, t, C))[:, None]
+        commit_arr = faults_mod.defer_to_heal(fp, commit_arr, cut_col)
     proposed = proposed | is_new
     propose_tick = jnp.where(is_new, t, propose_tick)
-    commit_tick = jnp.where(is_new, t + commit_lat, commit_tick)
+    commit_tick = jnp.where(is_new, commit_arr, commit_tick)
     committed = committed & ~is_new
 
     # Telemetry: PreAccept fan-outs are the phase-2 plane; slow-path

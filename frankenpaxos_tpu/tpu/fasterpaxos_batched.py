@@ -45,6 +45,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -72,6 +74,12 @@ class BatchedFasterPaxosConfig:
     fail_rate: float = 0.0  # per-server per-tick death probability
     revive_rate: float = 0.05
     detect_timeout: int = 6  # ticks a seat is dead before leader change
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + a server-axis partition on the Phase2a plane
+    # (UDP semantics); crash/revive merges into the native server churn
+    # that drives dead-seat leader changes. FaultPlan.none() is a
+    # structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def num_servers(self) -> int:
@@ -86,7 +94,10 @@ class BatchedFasterPaxosConfig:
         assert self.window >= 2 * self.slots_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
+        assert 0.0 <= self.fail_rate < 1.0
+        assert 0.0 <= self.revive_rate <= 1.0
         assert self.detect_timeout >= 1
+        self.faults.validate(axis=self.num_servers)
 
 
 @jax.tree_util.register_dataclass
@@ -202,12 +213,28 @@ def tick(
     p1b_lat = bit_latency(bitsg, 8, cfg.lat_min, cfg.lat_max)
     delivered = bit_delivered(bits4, 24, cfg.drop_rate)
 
+    # Unified fault injection (tpu/faults.py): the plan folds into the
+    # shared Phase2a delivered plane (partition cuts the server axis);
+    # crash merges into the native churn below. none() skips all of it.
+    fp = cfg.faults
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[:, None, None, None]
+        f_del, fwd_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (A, G, D, W), fwd_lat, link_up
+        )
+        delivered = delivered & f_del
+
     status = state.status
     chosen_value = state.chosen_value
 
-    # ---- 0. Server liveness churn.
-    die = state.server_alive & ~bit_delivered(bits1, 0, cfg.fail_rate)
-    revive = ~state.server_alive & ~bit_delivered(bits1, 8, cfg.revive_rate)
+    # ---- 0. Server liveness churn (a FaultPlan crash schedule composes
+    # with the native rates).
+    eff_fail, eff_revive = faults_mod.effective_process_rates(
+        fp, cfg.fail_rate, cfg.revive_rate
+    )
+    die = state.server_alive & ~bit_delivered(bits1, 0, eff_fail)
+    revive = ~state.server_alive & ~bit_delivered(bits1, 8, eff_revive)
     server_alive = (state.server_alive & ~die) | revive
     deaths = state.deaths + jnp.sum(die)
 

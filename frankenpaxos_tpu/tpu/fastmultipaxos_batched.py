@@ -40,6 +40,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
@@ -69,6 +71,13 @@ class BatchedFastMultiPaxosConfig:
     jitter: int = 2
     recovery_timeout: int = 10  # slot age before timeout-based recovery
     retry_timeout: int = 12  # command re-broadcast period
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + an acceptor-axis partition on the client
+    # broadcast plane (UDP semantics — the command re-broadcast timer
+    # restores liveness after a heal); the classic recovery round is
+    # TCP (delay-only), so a recovering slot cannot deadlock.
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def n(self) -> int:
@@ -89,6 +98,7 @@ class BatchedFastMultiPaxosConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert self.jitter >= 0
         assert self.recovery_timeout >= 2 * (self.lat_max + self.jitter)
+        self.faults.validate(axis=self.n)
 
 
 @jax.tree_util.register_dataclass
@@ -200,6 +210,22 @@ def tick(
     seen_lat_c = bit_latency(bits3, 16, cfg.lat_min, cfg.lat_max)
     rv_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
     reply_lat = bit_latency(bits2, 8, cfg.lat_min, cfg.lat_max)
+
+    # Unified fault injection (tpu/faults.py): UDP semantics on the
+    # client->acceptor broadcast plane (partition cuts acceptor rows;
+    # the re-broadcast timer recovers), TCP delay-only on the classic
+    # recovery round. none() skips all of it at trace time.
+    fp = cfg.faults
+    bcast_delivered = None
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
+        bcast_delivered, bcast_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (A, G, CW), bcast_lat, link_up
+        )
+        rv_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 1), (G, W), rv_lat
+        )
 
     status = state.status
     vote_value = state.vote_value
@@ -402,8 +428,15 @@ def tick(
     )
     send = is_new | retry
     cmd_last_send = jnp.where(send, t, cmd_last_send)
+    bcast_send = send[None, :, :]
+    if bcast_delivered is not None:
+        # Per-acceptor fault drops/cuts on the broadcast: a command all
+        # of whose copies are lost is re-broadcast by its client at the
+        # retry timer (and may then land in a second slot — the dup
+        # path the execution layer already dedups).
+        bcast_send = bcast_send & bcast_delivered
     cmd_arrival = jnp.where(
-        send[None, :, :], t + bcast_lat + jit_lat, cmd_arrival
+        bcast_send, t + bcast_lat + jit_lat, cmd_arrival
     )
 
     # Telemetry: client broadcasts straight to acceptors ARE the fast

@@ -44,6 +44,8 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Instance status.
@@ -69,6 +71,13 @@ class BatchedFastPaxosConfig:
     lat_min: int = 1
     lat_max: int = 3
     recovery_timeout: int = 12  # ticks in I_FAST before classic recovery
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + an acceptor-axis partition on the round-0
+    # proposal planes (UDP semantics — the recovery timeout rescues
+    # stuck instances through the classic round); the classic dn/up
+    # exchange is TCP (delay-only + defer-to-heal), so recovery itself
+    # cannot deadlock. FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def n(self) -> int:
@@ -92,6 +101,7 @@ class BatchedFastPaxosConfig:
         assert 0.0 <= self.conflict_rate <= 1.0
         assert 1 <= self.lat_min <= self.lat_max
         assert self.recovery_timeout >= 2 * self.lat_max
+        self.faults.validate(axis=self.n)
 
 
 @jax.tree_util.register_dataclass
@@ -195,6 +205,35 @@ def tick(
     up_lat = bit_latency(bits3, 24, cfg.lat_min, cfg.lat_max)
     ret_lat = bit_latency(bits2, 8, cfg.lat_min, cfg.lat_max)
 
+    # Unified fault injection (tpu/faults.py): UDP semantics on the
+    # round-0 proposal planes, TCP (delay + defer-to-heal) on the
+    # classic dn/up exchange. none() skips everything at trace time.
+    fp = cfg.faults
+    p0_del = p1_del = None
+    dn_arr = t + dn_lat
+    up_arr = t + up_lat
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
+        p0_del, p0_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (A, G, W), p0_lat, link_up
+        )
+        p1_del, p1_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (A, G, W), p1_lat, link_up
+        )
+        dn_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 2), (A, G, W), dn_lat
+        )
+        up_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 3), (A, G, W), up_lat
+        )
+        dn_arr = t + dn_lat
+        up_arr = t + up_lat
+        if fp.has_partition:
+            cut = ~link_up
+            dn_arr = faults_mod.defer_to_heal(fp, dn_arr, cut)
+            up_arr = faults_mod.defer_to_heal(fp, up_arr, cut)
+
     status = state.status
     v0, v1 = _values_of(state.inst_id)
 
@@ -211,7 +250,7 @@ def tick(
     vote_value = jnp.where(
         take0, v0[None, :, :], jnp.where(take1, v1[None, :, :], state.vote_value)
     )
-    up_arrival = jnp.where(voted, t + up_lat, state.up_arrival)
+    up_arrival = jnp.where(voted, up_arr, state.up_arrival)
     # A second proposal arriving later at a voted/promoted acceptor is
     # simply dropped (the acceptor nacks in the reference; the counter
     # here never needs the nack — timeouts cover it).
@@ -228,7 +267,7 @@ def tick(
     acc_round = jnp.where(p1a_now | p2a_now, 1, state.acc_round)
     vote_round = jnp.where(p2a_now, 1, vote_round)
     vote_value = jnp.where(p2a_now, state.rec_value[None, :, :], vote_value)
-    up_arrival = jnp.where(p1a_now | p2a_now, t + up_lat, up_arrival)
+    up_arrival = jnp.where(p1a_now | p2a_now, up_arr, up_arrival)
     dn_arrival = jnp.where(dn_now, INF, state.dn_arrival)
     dn_phase = jnp.where(dn_now, 0, state.dn_phase)
 
@@ -323,14 +362,14 @@ def tick(
     # message carries its phase, captured here at send time).
     status = jnp.where(stuck, I_REC1, status)
     up_arrival = jnp.where(stuck[None, :, :], INF, up_arrival)
-    dn_arrival = jnp.where(stuck[None, :, :], t + dn_lat, dn_arrival)
+    dn_arrival = jnp.where(stuck[None, :, :], dn_arr, dn_arrival)
     dn_phase = jnp.where(stuck[None, :, :], 1, dn_phase)
     recoveries = state.recoveries + jnp.sum(stuck)
 
     # Phase 1 -> phase 2: clear phase-1 replies, send phase 2a.
     status = jnp.where(rec1_done, I_REC2, status)
     up_arrival = jnp.where(rec1_done[None, :, :], INF, up_arrival)
-    dn_arrival = jnp.where(rec1_done[None, :, :], t + dn_lat, dn_arrival)
+    dn_arrival = jnp.where(rec1_done[None, :, :], dn_arr, dn_arrival)
     dn_phase = jnp.where(rec1_done[None, :, :], 2, dn_phase)
 
     # Stats at choice.
@@ -384,10 +423,16 @@ def tick(
     conflicts_total = state.conflicts_total + jnp.sum(is_conflict)
     status = jnp.where(issue, I_FAST, status)
     issue_tick = jnp.where(issue, t, issue_tick)
-    p0_arrival = jnp.where(issue[None, :, :], t + p0_lat, p0_arrival)
-    p1_arrival = jnp.where(
-        (issue & is_conflict)[None, :, :], t + p1_lat, p1_arrival
-    )
+    p0_send = issue[None, :, :]
+    p1_send = (issue & is_conflict)[None, :, :]
+    if p0_del is not None:
+        # Per-acceptor fault drops/cuts on the round-0 broadcasts; the
+        # recovery timeout routes a starved instance to the classic
+        # (TCP) round, so loss here costs latency, never liveness.
+        p0_send = p0_send & p0_del
+        p1_send = p1_send & p1_del
+    p0_arrival = jnp.where(p0_send, t + p0_lat, p0_arrival)
+    p1_arrival = jnp.where(p1_send, t + p1_lat, p1_arrival)
     next_inst = state.next_inst + count
 
     # Telemetry: round-0 proposal fan-outs are the phase-2 plane (fast

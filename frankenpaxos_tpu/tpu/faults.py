@@ -1,0 +1,331 @@
+"""Unified in-graph fault injection for the batched backends.
+
+The reference framework's core robustness capability is ``FakeTransport``
+simulation testing (FakeTransport.scala): deterministic message drops,
+duplication, reordering, partitions, and crash schedules driven against
+property checks. This module is that capability rebuilt TPU-first: a
+single :class:`FaultPlan` accepted by EVERY ``tpu/*_batched.py`` config,
+applied INSIDE each compiled tick via the shared helpers below, so
+thousands of randomized fault schedules run as vmapped/multi-seed
+compiled scans (``harness/simtest.py`` is the driver).
+
+Fault taxonomy (the failure modes Compartmentalized MultiPaxos, arxiv
+2012.15762, and Bipartisan Paxos, arxiv 2003.00331, decompose their
+protocols around):
+
+  * ``drop_rate`` — extra per-message Bernoulli loss, on top of any
+    backend-native ``drop_rate`` knob.
+  * ``dup_rate`` — an eager duplicate transmission: with this
+    probability a second copy of the message races the first, arriving
+    at least one tick later. Receivers in the arrival-tick encoding
+    dedup identical copies (``jnp.minimum`` / re-vote idempotence), so
+    the observable effects are at-least-once delivery and perturbed
+    arrival order — exactly what duplication exercises in FakeTransport.
+  * ``jitter`` — extra uniform [0, jitter] per-message delivery delay
+    (reordering pressure: messages sent earlier can arrive later).
+  * ``crash_rate`` / ``revive_rate`` — per-process per-tick crash and
+    revival probabilities. Backends with native liveness machinery
+    (multipaxos leader candidates + heartbeat elections, fasterpaxos
+    servers, vanillamencius servers, epaxos GC replicas) merge these
+    into it via :func:`effective_process_rates`; backends without it
+    gate their proposer/aggregator with :func:`crash_step`.
+  * ``partition`` / ``partition_start`` / ``partition_heal`` — a static
+    side assignment over the backend's replica axis (side 0 holds the
+    coordinator — leader / proxy / client / aggregator). While the
+    partition is active (``partition_start <= t < partition_heal``),
+    messages crossing sides are cut. ``partition_heal = -1`` never
+    heals. Two delivery semantics, chosen per message plane:
+
+      - UDP planes (backends with resend timers): crossing messages are
+        DROPPED (:func:`message_faults` ``link_up``); the protocol's own
+        retries restore liveness after the heal tick.
+      - TCP planes (chain/pipeline backends without resend timers):
+        crossing messages are BUFFERED until the heal tick
+        (:func:`defer_to_heal`) — the transport retransmits until the
+        link returns, so conservation invariants survive the cut.
+
+Determinism contract: all fault randomness derives from the tick's own
+threefry key via ``jax.random.fold_in`` with the :data:`FAULT_SALT`
+stream id and per-plane salts, using the repo's bit-packing idiom
+(``common.bit_delivered`` / ``bit_latency``). ``FaultPlan.none()``
+(the default on every config) takes the trace-time no-op path in every
+helper: no extra PRNG sweeps, no extra ops, so XLA emits the exact
+pre-fault program and runs stay bit-identical (pinned by
+``tests/test_faults.py`` golden values).
+
+``FaultPlan`` is a frozen, hashable dataclass living inside the static
+backend config (a ``jax.jit`` static argument): rates are compile-time
+constants (the ``bit_delivered`` 1/256 quantization applies), and a new
+plan compiles a new program. The schedule-randomization axis that must
+be cheap — the SEED — is free: one compile serves any number of seeds,
+vmapped (``harness.simtest.run_many_seeds``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, bit_delivered, bit_latency
+
+# Stream id folded into a tick's key before drawing any fault
+# randomness; per-plane keys fold a small plane salt on top. Distinct
+# from every fold_in constant the backends use for their own sweeps.
+FAULT_SALT = 0x5EED
+
+_RATE_FIELDS = ("drop_rate", "dup_rate", "crash_rate", "revive_rate")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One fault schedule. Frozen + hashable: lives inside the static
+    backend config. See the module docstring for field semantics."""
+
+    drop_rate: float = 0.0  # extra per-message Bernoulli loss
+    dup_rate: float = 0.0  # P(an eager duplicate copy is also sent)
+    jitter: int = 0  # extra uniform [0, jitter] delivery delay (ticks)
+    crash_rate: float = 0.0  # per-process per-tick crash probability
+    revive_rate: float = 0.0  # per-crashed-process revival probability
+    # Side assignment over the backend's replica axis (0 = coordinator
+    # side, 1 = the cut side); empty = no partition.
+    partition: Tuple[int, ...] = ()
+    partition_start: int = 0  # first tick the cut is active
+    partition_heal: int = -1  # scheduled heal tick (-1 = never heals)
+    # TCP-plane retransmission penalty per dropped transmission (ticks);
+    # only read by :func:`tcp_latency`.
+    drop_penalty: int = 6
+
+    # -- structural predicates (all trace-time Python bools) ------------
+
+    @property
+    def has_partition(self) -> bool:
+        return len(self.partition) > 0 and any(self.partition)
+
+    @property
+    def has_crash(self) -> bool:
+        return self.crash_rate > 0.0
+
+    @property
+    def messages_active(self) -> bool:
+        """Any message-plane knob engaged (the send-path helpers draw
+        PRNG sweeps iff this holds)."""
+        return (
+            self.drop_rate > 0.0
+            or self.dup_rate > 0.0
+            or self.jitter > 0
+            or self.has_partition
+        )
+
+    @property
+    def active(self) -> bool:
+        return self.messages_active or self.has_crash
+
+    @classmethod
+    def none(cls) -> "FaultPlan":
+        """The structural no-op plan: every helper compiles to the
+        identity and XLA emits the exact pre-fault program."""
+        return cls()
+
+    def validate(self, axis: Optional[int] = None) -> None:
+        """Config-time validation; every backend's ``__post_init__``
+        calls this with its partition (replica) axis size."""
+        for name in _RATE_FIELDS:
+            rate = getattr(self, name)
+            assert 0.0 <= rate < 1.0, f"faults.{name}={rate} not in [0, 1)"
+        assert self.jitter >= 0, f"faults.jitter={self.jitter} < 0"
+        assert self.drop_penalty >= 1
+        if self.has_crash:
+            assert self.revive_rate > 0.0 or self.crash_rate < 1.0
+        if self.partition:
+            assert all(s in (0, 1) for s in self.partition), (
+                f"faults.partition side bits must be 0/1: {self.partition}"
+            )
+            if axis is not None:
+                assert len(self.partition) == axis, (
+                    f"faults.partition has {len(self.partition)} side "
+                    f"bits; this backend's replica axis is {axis}"
+                )
+            assert self.partition_start >= 0
+            assert (
+                self.partition_heal < 0
+                or self.partition_heal > self.partition_start
+            ), "partition_heal must follow partition_start (or be -1)"
+
+    # -- serialization (the shrinking reproducer format) ----------------
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["partition"] = list(self.partition)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        d = dict(d)
+        d["partition"] = tuple(d.get("partition", ()))
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# PRNG plumbing
+# ---------------------------------------------------------------------------
+
+
+def fault_key(key: jnp.ndarray, salt: int = 0) -> jnp.ndarray:
+    """The per-tick fault stream: fold the shared FAULT_SALT plus a
+    per-plane salt into the tick key. Callers must only derive this when
+    the plan is active so the inactive path touches no keys at all."""
+    return jax.random.fold_in(key, FAULT_SALT + salt)
+
+
+# ---------------------------------------------------------------------------
+# Partition masks
+# ---------------------------------------------------------------------------
+
+
+def partition_active(plan: FaultPlan, t) -> jnp.ndarray:
+    """Traced scalar bool: the cut is live at tick ``t``."""
+    if not plan.has_partition:
+        return jnp.asarray(False)
+    active = t >= jnp.int32(plan.partition_start)
+    if plan.partition_heal >= 0:
+        active = active & (t < jnp.int32(plan.partition_heal))
+    return active
+
+
+def partition_sides(plan: FaultPlan) -> jnp.ndarray:
+    """The plan's side-bit vector as a device constant (for backends
+    that gather per-message target sides, e.g. chain hops)."""
+    return jnp.array(plan.partition, jnp.int32)
+
+
+def partition_row(plan: FaultPlan, t, n: int) -> jnp.ndarray:
+    """[n] bool over the replica axis: True = the link between replica
+    ``i`` and the coordinator (side 0) is usable at tick ``t``. All-True
+    when no partition is configured or outside the active window."""
+    if not plan.has_partition:
+        return jnp.ones((n,), bool)
+    side = partition_sides(plan)
+    assert side.shape == (n,), (side.shape, n)
+    return ~partition_active(plan, t) | (side == 0)
+
+
+def defer_to_heal(plan: FaultPlan, arrival: jnp.ndarray, cut) -> jnp.ndarray:
+    """TCP partition semantics: arrivals flagged ``cut`` (sent across an
+    active cut) are buffered until the heal tick — delivered at
+    ``max(arrival, heal)``, or never (INF) if the partition never
+    heals. Identity when no partition is configured."""
+    if not plan.has_partition:
+        return arrival
+    heal = jnp.int32(
+        plan.partition_heal if plan.partition_heal >= 0 else INF
+    )
+    return jnp.where(cut, jnp.maximum(arrival, heal), arrival)
+
+
+# ---------------------------------------------------------------------------
+# Message planes
+# ---------------------------------------------------------------------------
+
+
+def message_faults(
+    plan: FaultPlan,
+    key: jnp.ndarray,
+    shape: Tuple[int, ...],
+    lat: jnp.ndarray,
+    link_up=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """UDP-plane fault transform for one batch of messages sent this
+    tick with base latency ``lat``: returns ``(delivered, lat')``.
+
+    ``delivered`` folds the extra Bernoulli drop, the eager-duplicate
+    second chance (a message survives if EITHER copy does), and the
+    partition cut (``link_up`` broadcast over ``shape``); ``lat'`` is
+    the earliest surviving copy's latency (base + jitter, or base + 1 +
+    jitter for a duplicate that outlived a dropped original). Callers
+    AND ``delivered`` into their existing send masks and use ``lat'``
+    in place of ``lat`` — the exact idiom the backends already use for
+    their native ``drop_rate``.
+
+    Inactive plan: ``(all-True, lat)`` with no PRNG draw (the
+    structural no-op path)."""
+    if not plan.messages_active:
+        return jnp.ones(shape, bool), lat
+    bits = jax.random.bits(key, shape)
+    # [0:8) drop of the original, [8:16) duplicate decision,
+    # [16:24) jitter of the original.
+    delivered = bit_delivered(bits, 0, plan.drop_rate)
+    lat_eff = (
+        lat + bit_latency(bits, 16, 0, plan.jitter) if plan.jitter else lat
+    )
+    if plan.dup_rate > 0.0:
+        bits2 = jax.random.bits(jax.random.fold_in(key, 1), shape)
+        dup_sent = ~bit_delivered(bits, 8, plan.dup_rate)
+        dup_delivered = dup_sent & bit_delivered(bits2, 0, plan.drop_rate)
+        dup_lat = lat + 1 + (
+            bit_latency(bits2, 8, 0, plan.jitter) if plan.jitter else 0
+        )
+        lat_eff = jnp.where(
+            delivered & dup_delivered,
+            jnp.minimum(lat_eff, dup_lat),
+            jnp.where(delivered, lat_eff, dup_lat),
+        )
+        delivered = delivered | dup_delivered
+    if link_up is not None and plan.has_partition:
+        delivered = delivered & link_up
+    return delivered, lat_eff
+
+
+def tcp_latency(
+    plan: FaultPlan, key: jnp.ndarray, shape: Tuple[int, ...], lat
+) -> jnp.ndarray:
+    """TCP-plane fault transform of a latency array: drops become
+    retransmission penalties (``drop_penalty`` extra ticks — the link
+    redelivers, it never loses), jitter adds its uniform delay, and
+    duplicates are absorbed by the transport. Conservation invariants
+    (chain pending-sets, cut pipelines) survive because every message
+    still arrives exactly once. Identity when neither knob is set."""
+    if plan.drop_rate <= 0.0 and plan.jitter <= 0:
+        return lat
+    bits = jax.random.bits(key, shape)
+    out = lat
+    if plan.jitter:
+        out = out + bit_latency(bits, 8, 0, plan.jitter)
+    if plan.drop_rate > 0.0:
+        lost = ~bit_delivered(bits, 0, plan.drop_rate)
+        out = out + jnp.where(lost, jnp.int32(plan.drop_penalty), 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process crashes
+# ---------------------------------------------------------------------------
+
+
+def crash_step(plan: FaultPlan, key: jnp.ndarray, alive: jnp.ndarray):
+    """One tick of the crash/revive process over a liveness mask (any
+    shape): alive processes die with ``crash_rate``, dead ones revive
+    with ``revive_rate``. Identity (no PRNG) when crash is off."""
+    if not plan.has_crash:
+        return alive
+    bits = jax.random.bits(key, alive.shape)
+    dies = ~bit_delivered(bits, 0, plan.crash_rate)
+    revives = ~bit_delivered(bits, 8, plan.revive_rate)
+    return jnp.where(alive, ~dies, revives)
+
+
+def effective_process_rates(
+    plan: FaultPlan, fail_rate: float, revive_rate: float
+) -> Tuple[float, float]:
+    """Merge the plan's crash knobs into a backend's native
+    fail/revive machinery: independent death sources compose as
+    ``1 - (1-a)(1-b)``; the plan's revive rate (when set) overrides the
+    native one. Returns the native rates unchanged when crash is off,
+    so the merged machinery stays bit-identical under a none plan."""
+    if not plan.has_crash:
+        return fail_rate, revive_rate
+    eff_fail = 1.0 - (1.0 - fail_rate) * (1.0 - plan.crash_rate)
+    eff_revive = plan.revive_rate if plan.revive_rate > 0.0 else revive_rate
+    return eff_fail, eff_revive

@@ -39,6 +39,8 @@ from frankenpaxos_tpu.tpu.common import (
     sample_delivered,
     sample_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.multipaxos_batched import CHOSEN, EMPTY, PROPOSED
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
@@ -62,6 +64,12 @@ class GridBatchedConfig:
     lat_max: int = 3
     drop_rate: float = 0.0
     retry_timeout: int = 16
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + a partition over the flattened acceptor grid
+    # (row-major side bits) on the Phase2a/Phase2b/retry planes; UDP
+    # semantics — the full-grid retries restore liveness after a heal.
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def num_acceptors(self) -> int:
@@ -77,6 +85,7 @@ class GridBatchedConfig:
         assert self.window >= 2 * self.slots_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
+        self.faults.validate(axis=self.num_acceptors)
 
 
 @jax.tree_util.register_dataclass
@@ -133,11 +142,36 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
     w_iota = jnp.arange(W, dtype=jnp.int32)
     status = state.status
 
+    # Per-plane delivery masks and latencies (same keys and draw order
+    # as before), with the unified fault plan (tpu/faults.py) folded in:
+    # partition side bits cover the flattened R*C acceptor grid.
+    p2b_del = _delivered(cfg, k_drop1, (W, R, C))
+    p2b_lat = _lat(cfg, k_lat1, (W, R, C))
+    p2a_del = _delivered(cfg, k_drop2, (W, R, C))
+    p2a_lat = _lat(cfg, k_lat2, (W, R, C))
+    retry_lat = _lat(cfg, k_retry, (W, R, C))
+    fp = cfg.faults
+    retry_del = None
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, R * C).reshape(1, R, C)
+        f_del, p2b_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (W, R, C), p2b_lat, link_up
+        )
+        p2b_del = p2b_del & f_del
+        f_del, p2a_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (W, R, C), p2a_lat, link_up
+        )
+        p2a_del = p2a_del & f_del
+        retry_del, retry_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 2), (W, R, C), retry_lat, link_up
+        )
+
     # 1. Acceptors vote on Phase2a arrivals.
     arrived = state.p2a_arrival == t
     p2b_arrival = jnp.where(
-        arrived & _delivered(cfg, k_drop1, (W, R, C)),
-        jnp.minimum(state.p2b_arrival, t + _lat(cfg, k_lat1, (W, R, C))),
+        arrived & p2b_del,
+        jnp.minimum(state.p2b_arrival, t + p2b_lat),
         state.p2b_arrival,
     )
 
@@ -205,16 +239,17 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         in_quorum = (scores <= kth).reshape(W, R, C)
     send = is_new[:, None, None] & in_quorum
     p2a_arrival = jnp.where(
-        send & _delivered(cfg, k_drop2, (W, R, C)),
-        t + _lat(cfg, k_lat2, (W, R, C)),
+        send & p2a_del,
+        t + p2a_lat,
         p2a_arrival,
     )
 
     # 5. Retry to the FULL grid on timeout.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    p2a_arrival = jnp.where(
-        timed_out[:, None, None], t + _lat(cfg, k_retry, (W, R, C)), p2a_arrival
-    )
+    resend = timed_out[:, None, None]
+    if retry_del is not None:
+        resend = resend & retry_del
+    p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
     msgs_sent = (
         state.msgs_sent + jnp.sum(send) + jnp.sum(timed_out) * (R * C)
@@ -263,6 +298,9 @@ def run_ticks(cfg, state, t0, num_ticks: int, key):
 
 
 def check_invariants(cfg: GridBatchedConfig, state: GridBatchedState, t) -> dict:
+    """Device-side safety checks; returns traced boolean scalars (like
+    every other backend) so the checks also run under jit/vmap — the
+    simtest harness vmaps them over seed axes."""
     votes_in = state.p2b_arrival <= t
     chosen = state.status == CHOSEN
     if cfg.mode == "grid":
@@ -270,12 +308,12 @@ def check_invariants(cfg: GridBatchedConfig, state: GridBatchedState, t) -> dict
     else:
         quorum = jnp.sum(votes_in, axis=(1, 2)) >= cfg.majority_size
     return {
-        "quorum_ok": bool(jnp.all(jnp.where(chosen, quorum, True))),
-        "window_ok": bool(
+        "quorum_ok": jnp.all(jnp.where(chosen, quorum, True)),
+        "window_ok": (
             (state.head <= state.next_slot)
             & (state.next_slot - state.head <= cfg.window)
         ),
-        "conserved": bool(state.retired <= state.committed),
+        "conserved": state.retired <= state.committed,
     }
 
 
@@ -317,7 +355,10 @@ def sweep(configs, num_ticks: int = 300, seed: int = 0):
                     if committed
                     else -1.0
                 ),
-                "invariants": check_invariants(cfg, state, t),
+                "invariants": {
+                    k: bool(v)
+                    for k, v in check_invariants(cfg, state, t).items()
+                },
             }
         )
     return results

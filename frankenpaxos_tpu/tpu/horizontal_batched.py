@@ -47,6 +47,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
@@ -76,6 +78,13 @@ class BatchedHorizontalConfig:
     # Closed workload: stop proposing once each group allocated this many
     # slots (None = open).
     max_slots_per_group: Optional[int] = None
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + a POOL-axis partition (side bits over the 2n
+    # rows — both banks) on the Phase2a/Phase2b/retry planes; UDP
+    # semantics, the full-bank retries restore liveness after a heal.
+    # Crash/revive stalls a group's leader (no proposals while down).
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def n(self) -> int:
@@ -98,6 +107,7 @@ class BatchedHorizontalConfig:
         assert 1 <= self.lat_min <= self.lat_max
         if self.reconfigure_every:
             assert self.reconfigure_every >= 2
+        self.faults.validate(axis=self.pool)
 
 
 @jax.tree_util.register_dataclass
@@ -120,6 +130,10 @@ class BatchedHorizontalState:
     vote_epoch: jnp.ndarray  # [P, G, W] epoch the vote was cast under (-1)
 
     # Chunk machinery (one pending reconfiguration per group).
+    # Leader liveness under a FaultPlan crash schedule (all-True and
+    # untouched otherwise): a down leader proposes nothing.
+    fault_alive: jnp.ndarray  # [G] bool
+
     epoch: jnp.ndarray  # [G] epoch of the OLDEST live chunk
     boundary: jnp.ndarray  # [G] firstSlot of the pending chunk (INF none)
     p1_done: jnp.ndarray  # [G] new bank finished phase 1
@@ -153,6 +167,7 @@ def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
         p2b_arrival=jnp.full((P, G, W), INF, jnp.int32),
         voted=jnp.zeros((P, G, W), bool),
         vote_epoch=jnp.full((P, G, W), -1, DTYPE_ROUND),
+        fault_alive=jnp.ones((G,), bool),
         epoch=jnp.zeros((G,), DTYPE_ROUND),
         boundary=jnp.full((G,), INF, jnp.int32),
         p1_done=jnp.zeros((G,), bool),
@@ -199,6 +214,29 @@ def tick(
     p1a_lat = bit_latency(bits1, 0, cfg.lat_min, cfg.lat_max)
     p1b_lat = bit_latency(bits1, 8, cfg.lat_min, cfg.lat_max)
 
+    # Unified fault injection (tpu/faults.py): per-plane delivery masks
+    # over the POOL axis; crash stalls a group's leader. none() skips
+    # all of it at trace time.
+    fp = cfg.faults
+    p2a_del = p2b_del = retry_del = None
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, P)[:, None, None]
+        p2a_del, p2a_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (P, G, W), p2a_lat, link_up
+        )
+        p2b_del, p2b_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (P, G, W), p2b_lat, link_up
+        )
+        retry_del, retry_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 2), (P, G, W), retry_lat, link_up
+        )
+    fault_alive = state.fault_alive
+    if fp.has_crash:
+        fault_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 9), fault_alive
+        )
+
     # ---- 1. Acceptors vote on arriving Phase2as — but ONLY rows in the
     # bank the slot's chunk owns (Acceptor.scala votes only for chunks it
     # belongs to; a Phase2a is only ever SENT to the right bank, so the
@@ -211,7 +249,10 @@ def tick(
     vote_epoch = jnp.where(
         may_vote, state.slot_epoch[None, :, :], state.vote_epoch
     )
-    p2b_arrival = jnp.where(may_vote, t + p2b_lat, state.p2b_arrival)
+    # Under a fault plan the VOTE lands but the Phase2b reply may be
+    # dropped or cut (the retry plane re-solicits it after a heal).
+    p2b_send = may_vote if p2b_del is None else may_vote & p2b_del
+    p2b_arrival = jnp.where(p2b_send, t + p2b_lat, state.p2b_arrival)
     p2a_arrival = jnp.where(p2a_now, INF, state.p2a_arrival)
 
     # ---- 2. Quorums form: f+1 arrived Phase2bs within the slot's bank.
@@ -314,6 +355,9 @@ def tick(
     k_iota = jnp.arange(K, dtype=jnp.int32)
     abs_k = state.next_slot[:, None] + k_iota[None, :]  # [G, K]
     want_k = jnp.ones((G, K), bool)
+    if fp.has_crash:
+        # A crashed group leader proposes nothing until revival.
+        want_k = want_k & fault_alive[:, None]
     if cfg.max_slots_per_group is not None:
         want_k = want_k & (abs_k < cfg.max_slots_per_group)
     alpha_ok_k = abs_k < (head + cfg.alpha)[:, None]
@@ -361,18 +405,20 @@ def tick(
     # flagship's dimension, not this family's).
     send_bank = jnp.mod(new_epoch, 2)
     send_rows = bank_of_row[:, None, None] == send_bank[None, :, :]
-    p2a_arrival = jnp.where(
-        is_new[None, :, :] & send_rows, t + p2a_lat, p2a_arrival
-    )
+    send_p2a = is_new[None, :, :] & send_rows
+    if p2a_del is not None:
+        send_p2a = send_p2a & p2a_del
+    p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
 
     # ---- 6. Retries (resendPhase2as, Leader.scala:206-213).
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
     resend_rows = (
         bank_of_row[:, None, None] == jnp.mod(slot_epoch, 2)[None, :, :]
     )
-    p2a_arrival = jnp.where(
-        timed_out[None, :, :] & resend_rows, t + retry_lat, p2a_arrival
-    )
+    resend = timed_out[None, :, :] & resend_rows
+    if retry_del is not None:
+        resend = resend & retry_del
+    p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
 
     # Telemetry: phase-1 traffic is the new-bank handover exchange;
@@ -383,8 +429,7 @@ def tick(
         proposals=jnp.sum(count),
         phase1_msgs=jnp.sum(arm[None, :] & in_new_bank)
         + jnp.sum(p1a_now),
-        phase2_msgs=jnp.sum(is_new[None, :, :] & send_rows)
-        + jnp.sum(timed_out[None, :, :] & resend_rows),
+        phase2_msgs=jnp.sum(send_p2a) + jnp.sum(resend),
         commits=committed - state.committed,
         executes=executed - state.executed,
         drops=(alpha_stalls - state.alpha_stalls)
@@ -408,6 +453,7 @@ def tick(
         p2b_arrival=p2b_arrival,
         voted=voted,
         vote_epoch=vote_epoch,
+        fault_alive=fault_alive,
         epoch=epoch,
         boundary=boundary,
         p1_done=p1_done,

@@ -42,6 +42,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -72,6 +74,12 @@ class BatchedMenciusConfig:
     drop_rate: float = 0.0
     retry_timeout: int = 16
     max_slots_per_leader: Optional[int] = None
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + an acceptor-axis partition on the Phase2a/
+    # Phase2b/retry planes (UDP semantics — retries restore liveness
+    # after a heal); crash/revive stops a dead leader's stripe (skips
+    # catch it up after revival). FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def group_size(self) -> int:
@@ -82,9 +90,11 @@ class BatchedMenciusConfig:
         assert self.num_leaders >= 2
         assert self.window >= 2 * self.slots_per_tick
         assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.drop_rate < 1.0
         assert 0.0 <= self.idle_rate < 1.0
         assert 0 <= self.num_idle_leaders < self.num_leaders
         assert self.skip_threshold >= 1
+        self.faults.validate(axis=self.group_size)
 
 
 @jax.tree_util.register_dataclass
@@ -106,6 +116,11 @@ class BatchedMenciusState:
     p2a_arrival: jnp.ndarray  # [L, W, A]
     p2b_arrival: jnp.ndarray  # [L, W, A]
     voted: jnp.ndarray  # [L, W, A] bool
+
+    # Leader liveness under a FaultPlan crash schedule (all-True and
+    # untouched otherwise); a dead leader's stripe stalls the global
+    # watermark until revival, then skips catch it up.
+    fault_alive: jnp.ndarray  # [L] bool
 
     executed_global: jnp.ndarray  # [] global contiguous prefix length
     committed: jnp.ndarray  # [] cumulative chosen slots (incl. skips)
@@ -131,6 +146,7 @@ def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
         p2a_arrival=jnp.full((L, W, A), INF, jnp.int32),
         p2b_arrival=jnp.full((L, W, A), INF, jnp.int32),
         voted=jnp.zeros((L, W, A), bool),
+        fault_alive=jnp.ones((L,), bool),
         executed_global=jnp.zeros((), jnp.int32),
         committed=jnp.zeros((), jnp.int32),
         committed_real=jnp.zeros((), jnp.int32),
@@ -168,6 +184,32 @@ def tick(
         )
     else:
         p2a_delivered = jnp.ones((L, W, A), bool)
+
+    # Unified fault injection (tpu/faults.py): UDP semantics on the
+    # Phase2a/Phase2b/retry planes; partition cuts acceptor links
+    # (minor axis), crash stops a leader's stripe. none() is skipped at
+    # trace time entirely.
+    fp = cfg.faults
+    retry_delivered = None
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[None, None, :]
+        f_del, p2a_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (L, W, A), p2a_lat, link_up
+        )
+        p2a_delivered = p2a_delivered & f_del
+        f_del, p2b_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (L, W, A), p2b_lat, link_up
+        )
+        p2b_delivered = p2b_delivered & f_del
+        retry_delivered, retry_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 2), (L, W, A), retry_lat, link_up
+        )
+    fault_alive = state.fault_alive
+    if fp.has_crash:
+        fault_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 9), fault_alive
+        )
 
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)
@@ -250,9 +292,17 @@ def tick(
     idle = ~bit_delivered(bits1, 0, cfg.idle_rate)
     if cfg.num_idle_leaders:
         idle = idle | (jnp.arange(L) < cfg.num_idle_leaders)
+    if fp.has_crash:
+        # A crashed leader neither proposes nor skips (skipping is the
+        # LIVE laggard's mechanism); its stripe pins the global
+        # watermark until revival — plain Mencius has no revocation
+        # (that is vanillamencius's mechanic).
+        idle = idle | ~fault_alive
     max_next = jnp.max(state.next_slot)
     lag = max_next - state.next_slot  # [L] owned-slot lag
     skipping = lag > cfg.skip_threshold
+    if fp.has_crash:
+        skipping = skipping & fault_alive
 
     space = W - (state.next_slot - head)
     want = jnp.where(
@@ -286,7 +336,10 @@ def tick(
 
     # ---- 5. Retries.
     timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    p2a_arrival = jnp.where(timed_out[:, :, None], t + retry_lat, p2a_arrival)
+    resend = timed_out[:, :, None]
+    if retry_delivered is not None:
+        resend = resend & retry_delivered
+    p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
 
     new_executed_global = jnp.maximum(state.executed_global, executed_global)
@@ -317,6 +370,7 @@ def tick(
         p2a_arrival=p2a_arrival,
         p2b_arrival=p2b_arrival,
         voted=voted,
+        fault_alive=fault_alive,
         executed_global=new_executed_global,
         committed=committed,
         committed_real=committed_real,

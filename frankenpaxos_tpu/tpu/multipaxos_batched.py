@@ -61,6 +61,8 @@ from frankenpaxos_tpu.tpu.common import (
     sample_latency,
     sample_quorum,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status codes.
@@ -174,6 +176,14 @@ class BatchedMultiPaxosConfig:
     # configuration is retained until the executed watermark passes the
     # slots it may have chosen (the GC pipeline).
     reconfigure_every: int = 0  # 0 = off
+    # Unified in-graph fault injection (tpu/faults.py): extra message
+    # drops, eager duplicates, delivery-delay jitter on the Phase2a/
+    # Phase2b/retry planes (UDP semantics — the retry timers restore
+    # liveness), crash/revive merged into the leader-candidate
+    # machinery, and an acceptor-axis partition with a scheduled heal.
+    # FaultPlan.none() is a structural no-op: XLA emits the exact
+    # pre-fault program and runs stay bit-identical.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def num_matchmakers(self) -> int:
@@ -195,6 +205,9 @@ class BatchedMultiPaxosConfig:
         assert self.heartbeat_timeout < 2**15 - 1
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
+        assert 0.0 <= self.fail_rate < 1.0
+        assert 0.0 <= self.revive_rate <= 1.0
+        self.faults.validate(axis=self.group_size)
         assert self.read_mode in READ_MODES
         assert self.state_machine in ("none", "kv")
         if self.state_machine == "kv":
@@ -435,6 +448,30 @@ def tick(
     )
     p2a_delivered = bit_delivered(bits_extra, 0, cfg.drop_rate)
 
+    # Unified fault injection (tpu/faults.py): the plan's extra drops,
+    # eager duplicates, delay jitter, and the acceptor-axis partition
+    # fold into the SAME delivered/latency arrays the native drop_rate
+    # feeds (UDP semantics — retries restore liveness after a heal).
+    # The Chosen->replica broadcast and the read waves stay reliable
+    # (the reference retries them like writes). FaultPlan.none() skips
+    # everything here at trace time: no PRNG draw, no extra ops.
+    fp = cfg.faults
+    retry_delivered = None
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
+        f_del, p2a_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (A, G, W), p2a_lat, link_up
+        )
+        p2a_delivered = p2a_delivered & f_del
+        f_del, p2b_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (A, G, W), p2b_lat, link_up
+        )
+        p2b_delivered = p2b_delivered & f_del
+        retry_delivered, retry_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 2), (A, G, W), retry_lat, link_up
+        )
+
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
 
@@ -452,12 +489,18 @@ def tick(
     heartbeat_miss = state.heartbeat_miss
     elections = state.elections
     owner_alive_now = None  # None = feature off, everyone alive
-    if cfg.fail_rate > 0.0 or cfg.device_elections:
+    # FaultPlan crash/revive merges into the leader-candidate machinery
+    # (independent death sources compose); a none plan returns the
+    # native rates unchanged, keeping this path bit-identical.
+    eff_fail, eff_revive = faults_mod.effective_process_rates(
+        fp, cfg.fail_rate, cfg.revive_rate
+    )
+    if eff_fail > 0.0 or cfg.device_elections:
         C = cfg.num_leader_candidates
-        if cfg.fail_rate > 0.0:
+        if eff_fail > 0.0:
             bits_f = jax.random.bits(k_fail, (C, G))  # [0:8) death, [8:16) rev
-            dies = ~bit_delivered(bits_f, 0, cfg.fail_rate)
-            revives = ~bit_delivered(bits_f, 8, cfg.revive_rate)
+            dies = ~bit_delivered(bits_f, 0, eff_fail)
+            revives = ~bit_delivered(bits_f, 8, eff_revive)
             leader_alive = jnp.where(leader_alive, ~dies, revives)
         owner = leader_round % C
         owner_alive = jnp.take_along_axis(leader_alive, owner[None, :], axis=0)[0]
@@ -658,6 +701,7 @@ def tick(
             p2b_arrival,
             new_acc_round,
             nvotes,
+            ns_kernel,
         ) = ops.fused_vote_quorum(
             p2a_in,
             acc_round_in.astype(jnp.int32),
@@ -676,6 +720,11 @@ def tick(
         )
         vote_round = vote_round.astype(vote_round_in.dtype)
         new_acc_round = new_acc_round.astype(acc_round_in.dtype)
+        # The kernel's Phase2b-send counter (ROADMAP PR 2 follow-up (a)):
+        # the vote predicate is kernel-internal, so without this output
+        # the phase-2 message accounting under use_pallas would miss the
+        # acceptor->leader plane entirely.
+        p2b_sends = jnp.sum(ns_kernel)
     else:
         arrived = p2a_in == t  # [A, G, W]
         msg_round = leader_round[None, :, None]  # one round in flight
@@ -689,8 +738,9 @@ def tick(
         vote_value = jnp.where(
             may_vote, slot_value_in[None, :, :], vote_value_in
         )
+        p2b_send_mask = may_vote & p2b_delivered
         p2b_arrival = jnp.where(
-            may_vote & p2b_delivered,
+            p2b_send_mask,
             jnp.minimum(p2b_in, t + p2b_lat),
             p2b_in,
         )
@@ -698,6 +748,10 @@ def tick(
             vote_round == leader_round[None, :, None]
         )
         nvotes = jnp.sum(votes_in, axis=0)  # [G, W]
+        # Same Phase2b-send count the kernel path reports (masks are
+        # already live here; XLA fuses this into the vote pass), so the
+        # two paths stay bit-identical including telemetry.
+        p2b_sends = jnp.sum(p2b_send_mask)
 
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
     chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
@@ -923,6 +977,11 @@ def tick(
         # No old-round resends while phase 1 drains the old config.
         timed_out = timed_out & (recon_phase == RC_NORMAL)[:, None]
     resend = timed_out[None, :, :]
+    if retry_delivered is not None:
+        # Fault plan: retried Phase2as are individually droppable /
+        # partition-cut too; last_send still advances (the leader SENT —
+        # delivery failed), so the next timeout fires a fresh resend.
+        resend = resend & retry_delivered
     p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
     last_send = jnp.where(timed_out, t, last_send)
 
@@ -1116,7 +1175,7 @@ def tick(
     # counted (the vote predicate stays kernel-internal).
     n_proposed = jnp.sum(count)  # [G]-space
     n_retries = jnp.sum(timed_out)
-    if cfg.drop_rate > 0.0:
+    if cfg.drop_rate > 0.0 or fp.messages_active:
         phase2_sends = jnp.sum(send_p2a)
         p2a_drops = jnp.sum(
             is_new[None, :, :] & in_quorum & ~p2a_delivered
@@ -1134,7 +1193,10 @@ def tick(
         state.telemetry,
         proposals=n_proposed,
         phase1_msgs=telem_phase1,
-        phase2_msgs=phase2_sends + A * n_retries,
+        # Exact phase-2 plane on BOTH kernel paths: Phase2a fan-outs +
+        # full-group retries + the Phase2b replies (kernel output under
+        # use_pallas, the live vote mask otherwise).
+        phase2_msgs=phase2_sends + A * n_retries + p2b_sends,
         commits=n_new,
         executes=retired_total - state.retired,
         drops=p2a_drops,

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 
@@ -47,6 +49,13 @@ class BatchedScalogConfig:
     lat_min: int = 1  # one-way latency in ticks
     lat_max: int = 3
     max_records_per_shard: Optional[int] = None
+    # Unified in-graph fault injection (tpu/faults.py), TCP semantics:
+    # drops/jitter delay the cut-ordering Paxos round; a SHARD-axis
+    # partition stops the aggregator from assembling full cuts (cut
+    # issue pauses — the global log stalls behind the cut side) until
+    # the heal tick; crash/revive flaps the aggregator itself.
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     def __post_init__(self):
         assert self.num_shards >= 2
@@ -55,6 +64,7 @@ class BatchedScalogConfig:
         assert self.appends_per_tick >= 1
         assert 0 <= self.append_jitter <= self.appends_per_tick
         assert 1 <= self.lat_min <= self.lat_max
+        self.faults.validate(axis=self.num_shards)
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +79,9 @@ class BatchedScalogState:
     cut_snap_tick: jnp.ndarray  # [P] when the cut was snapshotted
     cut_prev_snap: jnp.ndarray  # [P] the PREVIOUS cut's snapshot tick
     last_snap_tick: jnp.ndarray  # [] newest snapshot tick issued
+    # Aggregator liveness under a FaultPlan crash schedule (True and
+    # untouched otherwise); a down aggregator issues no cuts.
+    agg_alive: jnp.ndarray  # [] bool
     next_cut: jnp.ndarray  # [] cuts issued so far
     committed_cuts: jnp.ndarray  # [] cuts committed so far
 
@@ -89,6 +102,7 @@ def init_state(cfg: BatchedScalogConfig) -> BatchedScalogState:
         cut_snap_tick=jnp.full((P,), INF, jnp.int32),
         cut_prev_snap=jnp.zeros((P,), jnp.int32),
         last_snap_tick=jnp.zeros((), jnp.int32),
+        agg_alive=jnp.ones((), bool),
         next_cut=jnp.zeros((), jnp.int32),
         committed_cuts=jnp.zeros((), jnp.int32),
         global_len=jnp.zeros((), jnp.int32),
@@ -206,9 +220,26 @@ def tick(
     room = (state.next_cut - committed_cuts) < P
     due = (t % cfg.cut_every) == 0
     issue = room & due
+    # Unified fault injection (tpu/faults.py): a partitioned shard set
+    # starves the aggregator of full length reports (no cut while the
+    # cut is live); a crashed aggregator issues nothing until revival;
+    # drops/jitter stretch the ordering round. none() skips all of it.
+    fp = cfg.faults
+    agg_alive = state.agg_alive
+    if fp.has_partition:
+        issue = issue & ~faults_mod.partition_active(fp, t)
+    if fp.has_crash:
+        agg_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 9), agg_alive
+        )
+        issue = issue & agg_alive
     slot = state.next_cut % P
     paxos_lat = bit_latency(jax.random.bits(jax.random.fold_in(key, 1), ()), 0,
                             2 * cfg.lat_min, 2 * cfg.lat_max + 2)
+    if fp.drop_rate > 0.0 or fp.jitter > 0:
+        paxos_lat = faults_mod.tcp_latency(
+            fp, faults_mod.fault_key(key, 1), (), paxos_lat
+        )
     cut_vec = jnp.where(
         issue,
         state.cut_vec.at[slot].set(local_len),
@@ -250,6 +281,7 @@ def tick(
         cut_snap_tick=cut_snap_tick,
         cut_prev_snap=cut_prev_snap,
         last_snap_tick=last_snap_tick,
+        agg_alive=agg_alive,
         next_cut=next_cut,
         committed_cuts=committed_cuts,
         global_len=global_len,

@@ -176,7 +176,11 @@ class TpuSimTransport:
             "round_max": st.leader_round.max(),
             "t": self.t,
         }
-        if self.config.fail_rate > 0.0 or self.config.device_elections:
+        if (
+            self.config.fail_rate > 0.0
+            or self.config.device_elections
+            or self.config.faults.crash_rate > 0.0
+        ):
             dev["elections"] = st.elections
             dev["alive_leaders"] = st.leader_alive.sum()
         if self.config.reconfigure_every:
@@ -216,7 +220,11 @@ class TpuSimTransport:
             "round": int(host["round_max"]),
             "num_acceptors": self.config.num_acceptors,
         }
-        if self.config.fail_rate > 0.0 or self.config.device_elections:
+        if (
+            self.config.fail_rate > 0.0
+            or self.config.device_elections
+            or self.config.faults.crash_rate > 0.0
+        ):
             out["elections"] = int(host["elections"])
             out["alive_leaders"] = int(host["alive_leaders"])
         if self.config.reconfigure_every:

@@ -28,6 +28,8 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 U_EMPTY = 0
@@ -42,10 +44,19 @@ class BatchedUnreplicatedConfig:
     ops_per_tick: int = 4  # K new ops per server per tick
     lat_min: int = 1
     lat_max: int = 3
+    # Unified in-graph fault injection (tpu/faults.py), TCP semantics:
+    # drops become retransmission penalties on the request/reply hops;
+    # a SERVER-axis partition (side bits over the G servers) buffers
+    # ops to cut servers until the heal tick. The ceiling baseline
+    # degrades under faults exactly like the consensus backends'
+    # message planes, keeping ceiling_fraction apples-to-apples.
+    # FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     def __post_init__(self):
         assert self.window >= 2 * self.ops_per_tick
         assert 1 <= self.lat_min <= self.lat_max
+        self.faults.validate(axis=self.num_servers)
 
 
 @jax.tree_util.register_dataclass
@@ -86,11 +97,32 @@ def tick(
     req_lat = bit_latency(bits, 0, cfg.lat_min, cfg.lat_max)
     rep_lat = bit_latency(bits, 8, cfg.lat_min, cfg.lat_max)
 
+    # Unified fault injection (tpu/faults.py), TCP semantics: drop
+    # penalties + jitter on both hops; a cut server's ops buffer until
+    # the heal tick. none() skips everything at trace time.
+    fp = cfg.faults
+    req_arr = t + req_lat
+    rep_arr = t + rep_lat
+    if fp.active:
+        kf = faults_mod.fault_key(key)
+        req_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 0), (G, W), req_lat
+        )
+        rep_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 1), (G, W), rep_lat
+        )
+        req_arr = t + req_lat
+        rep_arr = t + rep_lat
+        if fp.has_partition:
+            cut = ~faults_mod.partition_row(fp, t, G)[:, None]
+            req_arr = faults_mod.defer_to_heal(fp, req_arr, cut)
+            rep_arr = faults_mod.defer_to_heal(fp, rep_arr, cut)
+
     # Server executes on arrival and replies (Server.scala handleRequest).
     at_server = (state.status == U_REQ) & (state.arrival == t)
     executed = state.executed + jnp.sum(at_server, axis=1)
     status = jnp.where(at_server, U_REP, state.status)
-    arrival = jnp.where(at_server, t + rep_lat, state.arrival)
+    arrival = jnp.where(at_server, rep_arr, state.arrival)
 
     # Client receives the reply.
     done_now = (status == U_REP) & (arrival <= t)
@@ -111,7 +143,7 @@ def tick(
     new = empty & (rank <= cfg.ops_per_tick)
     status = jnp.where(new, U_REQ, status)
     issue = jnp.where(new, t, issue)
-    arrival = jnp.where(new, t + req_lat, arrival)
+    arrival = jnp.where(new, req_arr, arrival)
 
     # Telemetry: request hops are this backend's "phase 2" plane
     # (client -> server -> client; no consensus phases exist).

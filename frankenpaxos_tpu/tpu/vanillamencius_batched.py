@@ -41,6 +41,8 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -76,6 +78,12 @@ class BatchedVanillaMenciusConfig:
     # owned slots gets revoked by a live peer (Server.scala revocation).
     revoke_threshold: int = 8
     revoke_slots_per_tick: int = 8  # revocation batch per stripe per tick
+    # Unified in-graph fault injection (tpu/faults.py): extra drops/
+    # duplicates/jitter + an acceptor-axis partition on the shared
+    # delivered plane (UDP semantics); crash/revive merges into the
+    # native server fail/revive machinery — which is exactly what
+    # drives revocation. FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
 
     @property
     def group_size(self) -> int:
@@ -87,8 +95,11 @@ class BatchedVanillaMenciusConfig:
         assert self.window >= 2 * self.slots_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
+        assert 0.0 <= self.fail_rate < 1.0
+        assert 0.0 <= self.revive_rate <= 1.0
         assert self.revoke_threshold >= 1
         assert self.revoke_slots_per_tick >= 1
+        self.faults.validate(axis=self.group_size)
 
 
 @jax.tree_util.register_dataclass
@@ -202,13 +213,34 @@ def tick(
     rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
     delivered = bit_delivered(bits3, 24, cfg.drop_rate)
 
+    # Unified fault injection (tpu/faults.py): the plan folds into the
+    # shared delivered plane and the revocation-round latency; crash
+    # merges into the native server churn below. none() skips all of it.
+    fp = cfg.faults
+    rv_delivered = delivered  # revocation-plane delivery (same native draw)
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, A)[None, None, :]
+        f_del, fwd_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (L, W, A), fwd_lat, link_up
+        )
+        f_del2, rv_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (L, W, A), rv_lat, link_up
+        )
+        delivered = delivered & f_del
+        rv_delivered = rv_delivered & f_del2
+
     status = state.status
     chosen_value = state.chosen_value
 
     # ---- 0. Liveness churn (Server failure model; ~bit_delivered(x, p)
-    # is True with probability p — the guarded 8-bit Bernoulli).
-    die = state.alive & ~bit_delivered(bits1, 0, cfg.fail_rate)
-    revive = ~state.alive & ~bit_delivered(bits1, 8, cfg.revive_rate)
+    # is True with probability p — the guarded 8-bit Bernoulli). A
+    # FaultPlan crash schedule composes with the native rates.
+    eff_fail, eff_revive = faults_mod.effective_process_rates(
+        fp, cfg.fail_rate, cfg.revive_rate
+    )
+    die = state.alive & ~bit_delivered(bits1, 0, eff_fail)
+    revive = ~state.alive & ~bit_delivered(bits1, 8, eff_revive)
     alive = (state.alive & ~die) | revive
     deaths = state.deaths + jnp.sum(die)
 
@@ -300,7 +332,7 @@ def tick(
     )
     rv_phase = jnp.where(p1_done, RV_P2, rv_phase)
     rv_p2a_arrival = jnp.where(
-        p1_done[:, :, None] & delivered, t + rv_lat, rv_p2a_arrival
+        p1_done[:, :, None] & rv_delivered, t + rv_lat, rv_p2a_arrival
     )
     rv_p1b_arrival = jnp.where(p1_done[:, :, None], INF, rv_p1b_arrival)
 
@@ -407,7 +439,7 @@ def tick(
     revocations = state.revocations + jnp.sum(target)
     rv_phase = jnp.where(target, RV_P1, rv_phase)
     rv_p1a_arrival = jnp.where(
-        target[:, :, None] & delivered, t + rv_lat, rv_p1a_arrival
+        target[:, :, None] & rv_delivered, t + rv_lat, rv_p1a_arrival
     )
 
     # ---- 7. Owner retries (live owners, round-0 slots not revoked).
@@ -429,12 +461,13 @@ def tick(
     tel = record(
         state.telemetry,
         proposals=jnp.sum(count),
-        phase1_msgs=jnp.sum(target[:, :, None] & delivered),
+        phase1_msgs=jnp.sum(target[:, :, None] & rv_delivered),
         phase2_msgs=jnp.sum(is_new[:, :, None] & delivered)
         + A * jnp.sum(timed_out),
         commits=committed - state.committed,
         executes=new_executed_global - state.executed_global,
-        drops=jnp.sum((is_new | target)[:, :, None] & ~delivered),
+        drops=jnp.sum(is_new[:, :, None] & ~delivered)
+        + jnp.sum(target[:, :, None] & ~rv_delivered),
         retries=jnp.sum(timed_out),
         leader_changes=revocations - state.revocations,
         queue_depth=jnp.sum(next_slot - head),

@@ -1,0 +1,445 @@
+"""The unified fault-injection subsystem (tpu/faults.py) + the
+simulation-testing harness (harness/simtest.py).
+
+The load-bearing guarantee first: ``FaultPlan.none()`` is a STRUCTURAL
+no-op. The golden values below were captured from the pre-fault-subsystem
+tree (PR 2 head, commit f899c3f) on fixed configs/seeds — committed
+counters plus a sha256 over the full protocol state arrays — so any
+fault-threading change that perturbs a default run by even one bit fails
+here against the true pre-PR behavior, not against a tautology.
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.harness import simtest
+from frankenpaxos_tpu.tpu import (
+    craq_batched,
+    mencius_batched,
+    multipaxos_batched,
+    unreplicated_batched,
+)
+from frankenpaxos_tpu.tpu.faults import (
+    FaultPlan,
+    effective_process_rates,
+    message_faults,
+    partition_row,
+    tcp_latency,
+)
+
+
+def _hash(state, fields):
+    m = hashlib.sha256()
+    for f in fields:
+        m.update(np.asarray(jax.device_get(getattr(state, f))).tobytes())
+    return m.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# none() bit-identity against pre-PR golden captures (3+ backends x 3 seeds)
+# ---------------------------------------------------------------------------
+
+GOLDEN_MULTIPAXOS = {
+    0: (582, 562, 3426, "dd70eeb17ab45de2"),
+    1: (581, 530, 3487, "c665a10d449618ae"),
+    2: (583, 551, 3340, "ec2d56f23217dda9"),
+}
+GOLDEN_MENCIUS = {
+    0: (629, 629, 0, "43957a3dc956da37"),
+    1: (648, 648, 0, "432e6df357085ede"),
+    2: (654, 654, 0, "7e2bae9c0af561e9"),
+}
+GOLDEN_CRAQ = {
+    0: (374, 743, 251, "b6fe4b6285011bda"),
+    1: (368, 747, 231, "0025adf193587ca4"),
+    2: (370, 750, 219, "d9c0363c64b1db0c"),
+}
+GOLDEN_UNREPLICATED = {
+    0: (929, 3663, "589abaf0933332b2"),
+    1: (929, 3705, "bbd795f9ce1b7c01"),
+    2: (928, 3692, "f8fe3872c1751c1a"),
+}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_multipaxos(seed):
+    mp = multipaxos_batched
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, lat_min=1,
+        lat_max=3, drop_rate=0.05, retry_timeout=8,
+    )
+    assert cfg.faults == FaultPlan.none()
+    st, _ = mp.run_ticks(
+        cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.committed), int(st.retired), int(st.lat_sum),
+        _hash(st, ("status", "slot_value", "chosen_round", "head",
+                   "next_slot", "acc_round", "vote_round", "vote_value")),
+    )
+    assert got == GOLDEN_MULTIPAXOS[seed]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_mencius(seed):
+    me = mencius_batched
+    cfg = me.BatchedMenciusConfig(
+        f=1, num_leaders=4, window=16, slots_per_tick=2, idle_rate=0.1,
+        drop_rate=0.05, retry_timeout=8,
+    )
+    st, _ = me.run_ticks(
+        cfg, me.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.committed), int(st.committed_real), int(st.skips),
+        _hash(st, ("status", "slot_value", "head", "next_slot",
+                   "committed_prefix", "voted")),
+    )
+    assert got == GOLDEN_MENCIUS[seed]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_craq(seed):
+    cr = craq_batched
+    cfg = cr.BatchedCraqConfig(
+        num_chains=4, chain_len=3, num_keys=8, window=8,
+        writes_per_tick=2, reads_per_tick=2, read_window=8,
+    )
+    st, _ = cr.run_ticks(
+        cfg, cr.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.writes_done), int(st.reads_done), int(st.reads_dirty),
+        _hash(st, ("w_status", "w_version", "node_version", "node_dirty",
+                   "r_status")),
+    )
+    assert got == GOLDEN_CRAQ[seed]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_unreplicated(seed):
+    ur = unreplicated_batched
+    cfg = ur.BatchedUnreplicatedConfig(
+        num_servers=4, window=16, ops_per_tick=2,
+    )
+    st, _ = ur.run_ticks(
+        cfg, ur.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.done), int(st.lat_sum),
+        _hash(st, ("status", "issue", "arrival", "executed")),
+    )
+    assert got == GOLDEN_UNREPLICATED[seed]
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_validation_rejects_malformed_plans():
+    with pytest.raises(AssertionError):
+        FaultPlan(drop_rate=1.0).validate()
+    with pytest.raises(AssertionError):
+        FaultPlan(drop_rate=-0.1).validate()
+    with pytest.raises(AssertionError):
+        FaultPlan(jitter=-1).validate()
+    with pytest.raises(AssertionError):
+        FaultPlan(partition=(0, 2, 0)).validate(axis=3)
+    with pytest.raises(AssertionError):
+        FaultPlan(partition=(0, 1)).validate(axis=3)  # wrong axis
+    with pytest.raises(AssertionError):
+        FaultPlan(
+            partition=(0, 1, 0), partition_start=50, partition_heal=40
+        ).validate(axis=3)
+    # And a well-formed plan passes, also via the config path.
+    FaultPlan(
+        drop_rate=0.1, partition=(0, 0, 1), partition_start=10,
+        partition_heal=60,
+    ).validate(axis=3)
+    with pytest.raises(AssertionError):
+        multipaxos_batched.BatchedMultiPaxosConfig(
+            faults=FaultPlan(partition=(0, 1))  # axis is 2f+1 = 3
+        )
+
+
+def test_fault_plan_round_trips_through_json():
+    plan = FaultPlan(
+        drop_rate=0.125, dup_rate=0.05, jitter=2, crash_rate=0.01,
+        revive_rate=0.2, partition=(0, 1, 1), partition_start=8,
+        partition_heal=80,
+    )
+    again = FaultPlan.from_dict(json.loads(json.dumps(plan.to_dict())))
+    assert again == plan
+    assert again.has_partition and again.has_crash and again.active
+
+
+def test_message_faults_inactive_is_identity_and_active_draws():
+    key = jax.random.PRNGKey(0)
+    lat = jnp.full((3, 4, 8), 2, jnp.int32)
+    d, lat2 = message_faults(FaultPlan.none(), key, (3, 4, 8), lat)
+    assert bool(jnp.all(d)) and lat2 is lat
+    d, lat2 = message_faults(
+        FaultPlan(drop_rate=0.5), key, (3, 4, 8), lat
+    )
+    frac = float(jnp.mean(d.astype(jnp.float32)))
+    assert 0.3 < frac < 0.7  # ~half dropped
+    assert bool(jnp.all(lat2 == lat))  # no jitter knob -> untouched
+    # Duplication strictly raises delivery probability under drops.
+    d_dup, _ = message_faults(
+        FaultPlan(drop_rate=0.5, dup_rate=0.9), key, (3, 4, 8), lat
+    )
+    assert int(jnp.sum(d_dup)) > int(jnp.sum(d))
+    # Jitter only delays (never earlier than base latency).
+    d_j, lat_j = message_faults(
+        FaultPlan(jitter=3), key, (3, 4, 8), lat
+    )
+    assert bool(jnp.all(d_j)) and bool(jnp.all(lat_j >= lat))
+    assert int(jnp.max(lat_j)) > 2  # some jitter actually landed
+
+
+def test_tcp_latency_drops_become_penalties():
+    key = jax.random.PRNGKey(1)
+    lat = jnp.full((64,), 2, jnp.int32)
+    out = tcp_latency(FaultPlan.none(), key, (64,), lat)
+    assert out is lat
+    out = tcp_latency(
+        FaultPlan(drop_rate=0.5, drop_penalty=7), key, (64,), lat
+    )
+    assert bool(jnp.all((out == 2) | (out == 9)))  # base or base+penalty
+    assert int(jnp.sum(out == 9)) > 10
+
+
+def test_partition_row_window_semantics():
+    plan = FaultPlan(
+        partition=(0, 1, 1), partition_start=10, partition_heal=20
+    )
+    before = partition_row(plan, jnp.int32(9), 3)
+    during = partition_row(plan, jnp.int32(10), 3)
+    after = partition_row(plan, jnp.int32(20), 3)
+    assert bool(jnp.all(before)) and bool(jnp.all(after))
+    assert [bool(x) for x in during] == [True, False, False]
+    # Never-healing: stays cut forever.
+    never = dataclasses.replace(plan, partition_heal=-1)
+    assert not bool(partition_row(never, jnp.int32(10 ** 6), 3)[1])
+
+
+def test_effective_process_rates_compose():
+    assert effective_process_rates(FaultPlan.none(), 0.02, 0.1) == (0.02, 0.1)
+    f, r = effective_process_rates(
+        FaultPlan(crash_rate=0.5, revive_rate=0.3), 0.5, 0.1
+    )
+    assert abs(f - 0.75) < 1e-9 and r == 0.3
+
+
+# ---------------------------------------------------------------------------
+# Faulted behavior on the flagship
+# ---------------------------------------------------------------------------
+
+
+def _mp_cfg(**kw):
+    base = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=8,
+    )
+    base.update(kw)
+    return multipaxos_batched.BatchedMultiPaxosConfig(**base)
+
+
+def test_drops_cost_throughput_but_not_safety():
+    mp = multipaxos_batched
+    healthy = _mp_cfg()
+    faulty = _mp_cfg(faults=FaultPlan(drop_rate=0.25, dup_rate=0.1, jitter=2))
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    sh, th = mp.run_ticks(healthy, mp.init_state(healthy), t0, 120, key)
+    sf, tf = mp.run_ticks(faulty, mp.init_state(faulty), t0, 120, key)
+    assert 0 < int(sf.committed) < int(sh.committed)
+    inv = mp.check_invariants(faulty, sf, tf)
+    assert all(bool(v) for v in inv.values()), inv
+    # Faults feed the telemetry drops counter for free.
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    assert int(sf.telemetry.totals[COL["drops"]]) > 0
+    assert int(sh.telemetry.totals[COL["drops"]]) == 0
+
+
+def test_crash_plan_drives_device_elections():
+    mp = multipaxos_batched
+    cfg = _mp_cfg(faults=FaultPlan(crash_rate=0.03, revive_rate=0.2))
+    st, t = mp.run_ticks(
+        cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 200,
+        jax.random.PRNGKey(0),
+    )
+    assert int(st.elections) > 0
+    assert int(st.committed) > 0
+    inv = mp.check_invariants(cfg, st, t)
+    assert all(bool(v) for v in inv.values()), inv
+    # And the telemetry leader_changes counter saw them.
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    assert int(st.telemetry.totals[COL["leader_changes"]]) == int(st.elections)
+
+
+# ---------------------------------------------------------------------------
+# simtest harness
+# ---------------------------------------------------------------------------
+
+
+def test_random_plan_is_deterministic_and_well_formed():
+    import random
+
+    spec = simtest.SPECS["multipaxos"]
+    a = [simtest.random_plan(random.Random(7), spec, 120) for _ in range(8)]
+    b = [simtest.random_plan(random.Random(7), spec, 120) for _ in range(8)]
+    assert a == b
+    for plan in a:
+        plan.validate(axis=spec.partition_axis)
+        if plan.has_partition:
+            assert plan.partition_heal % simtest.SEGMENT == 0
+            assert 0 < plan.partition_heal <= 120
+
+
+def test_run_schedule_reports_progress_and_invariants():
+    spec = simtest.SPECS["multipaxos"]
+    res = simtest.run_schedule(
+        spec, FaultPlan(drop_rate=0.1), seed=3, ticks=80, segment=40
+    )
+    assert res["ok"] and not res["violations"]
+    assert len(res["progress"]) == 2
+    assert res["progress"][-1] > 0
+    assert FaultPlan.from_dict(res["plan"]) == FaultPlan(drop_rate=0.1)
+
+
+def test_run_many_seeds_vmaps_invariants_over_the_seed_axis():
+    spec = simtest.SPECS["mencius"]
+    res = simtest.run_many_seeds(
+        spec, FaultPlan(drop_rate=0.15, jitter=1), seeds=[0, 1, 2, 3],
+        ticks=60,
+    )
+    assert res["ok"] and res["per_seed_ok"] == [True] * 4
+    assert all(p > 0 for p in res["progress"])
+
+
+def test_run_schedule_replays_run_many_seeds_histories():
+    """The find-then-shrink contract: a (plan, seed) found by the
+    vmapped device sweep must replay IDENTICALLY under the segmented
+    invariant-checking runner (per-tick keys fold the global tick
+    index in both), or counterexamples could never be minimized."""
+    spec = simtest.SPECS["multipaxos"]
+    plan = FaultPlan(drop_rate=0.15, jitter=1)
+    seg = simtest.run_schedule(spec, plan, seed=3, ticks=80, segment=40)
+    vmapped = simtest.run_many_seeds(spec, plan, seeds=[3], ticks=80)
+    assert seg["progress"][-1] == vmapped["progress"][0]
+
+
+def test_liveness_resumes_after_scheduled_heal():
+    spec = simtest.SPECS["multipaxos"]
+    plan = FaultPlan(
+        partition=(0, 1, 1), partition_start=20,
+        partition_heal=simtest.SEGMENT,
+    )
+    res = simtest.check_liveness_after_heal(spec, plan, seed=0)
+    assert res["resumed"] and res["invariants_ok"]
+
+
+def test_shrink_minimizes_to_a_reproducer_json(tmp_path):
+    """The bad-history workflow end-to-end: a seeded, deliberately-broken
+    invariant ("this run never drops a message") fails under a fat plan;
+    the greedy shrinking loop must strip every irrelevant knob and
+    minimize drop_rate, and the reproducer JSON must round-trip and
+    still fail."""
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    spec = simtest.SPECS["multipaxos"]
+    seed, ticks = 5, 48
+
+    def failing(plan: FaultPlan) -> bool:
+        mp = spec.module
+        cfg = spec.make_config(plan)
+        st, _ = mp.run_ticks(
+            cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), ticks,
+            jax.random.PRNGKey(seed),
+        )
+        return int(st.telemetry.totals[COL["drops"]]) > 0
+
+    fat = FaultPlan(
+        drop_rate=0.2, partition=(0, 0, 1), partition_start=16,
+        partition_heal=40,
+    )
+    small = simtest.shrink(spec, fat, seed, ticks, failing=failing)
+    # Everything irrelevant to "a drop happened" must be gone...
+    assert small.dup_rate == 0.0
+    assert small.jitter == 0
+    assert small.crash_rate == 0.0
+    # ...and exactly ONE drop source survives, minimized. (A partition
+    # cut IS a drop on the multipaxos planes, so the greedy loop keeps
+    # whichever single source it reached first and strips the other.)
+    assert (small.drop_rate > 0.0) != small.has_partition
+    if small.drop_rate:
+        assert small.drop_rate < fat.drop_rate
+    else:
+        assert small.partition_start == 0  # window slid to the left edge
+        span0 = fat.partition_heal - fat.partition_start
+        assert 0 < small.partition_heal - small.partition_start < span0
+    assert failing(small)
+
+    path = tmp_path / "reproducer.json"
+    simtest.dump_reproducer(
+        str(path), spec, small, seed, ticks, note="drops>0 sentinel"
+    )
+    spec2, plan2, seed2, ticks2 = simtest.load_reproducer(str(path))
+    assert spec2 is spec and plan2 == small
+    assert (seed2, ticks2) == (seed, ticks)
+    assert failing(plan2)
+
+
+def test_sweep_smoke():
+    res = simtest.sweep(
+        backends=["unreplicated"], schedules=1, seeds_per_schedule=2,
+        ticks=80, base_seed=1, check_liveness=False,
+    )
+    assert res["ok"], res
+    row = res["backends"]["unreplicated"]
+    assert row["runs"] == 2 and not row["failures"]
+
+
+def test_registry_covers_every_backend_and_reps_run():
+    """Registry sanity, tier-1 sized: all 13 backends are registered
+    with valid config factories (construction exercises every
+    __post_init__ + FaultPlan.validate), and four representative specs
+    run a none-plan schedule with green invariants and progress. The
+    full 13-backend run is the slow-marked test below."""
+    assert len(simtest.SPECS) == 13
+    for spec in simtest.SPECS.values():
+        cfg = spec.make_config(FaultPlan.none())
+        assert cfg.faults == FaultPlan.none()
+    for name in ("multipaxos", "craq", "scalog"):
+        res = simtest.run_schedule(
+            simtest.SPECS[name], FaultPlan.none(), seed=0, ticks=40,
+            segment=40,
+        )
+        assert res["ok"], (name, res["violations"])
+        assert res["progress"][-1] > 0, name
+
+
+@pytest.mark.slow
+def test_every_registered_spec_runs_a_plain_schedule():
+    """Full-fleet variant: all 13 backends run one none-plan schedule
+    with green invariants and nonzero progress."""
+    for name, spec in simtest.SPECS.items():
+        res = simtest.run_schedule(
+            spec, FaultPlan.none(), seed=0, ticks=40, segment=40
+        )
+        assert res["ok"], (name, res["violations"])
+        assert res["progress"][-1] > 0, name

@@ -48,7 +48,11 @@ def test_fused_vote_quorum_matches_reference(seed, shape):
     args = random_state(jax.random.PRNGKey(seed), A=A, G=G, W=W)
     ref = reference_vote_quorum(*args)
     got = fused_vote_quorum(*args, block_g=G // 2, interpret=True)
-    names = ["vote_round", "vote_value", "p2b_arrival", "acc_round", "nvotes"]
+    names = [
+        "vote_round", "vote_value", "p2b_arrival", "acc_round", "nvotes",
+        "nsends",
+    ]
+    assert len(ref) == len(got) == len(names)
     for name, r, g in zip(names, ref, got):
         np.testing.assert_array_equal(np.asarray(r), np.asarray(g), err_msg=name)
 
@@ -86,7 +90,7 @@ def test_reference_matches_tick_phase():
     p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
     p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
 
-    vr, vv, p2b, accr, nvotes = reference_vote_quorum(
+    vr, vv, p2b, accr, nvotes, nsends = reference_vote_quorum(
         state.p2a_arrival,
         state.acc_round,
         state.leader_round,
