@@ -178,6 +178,28 @@ def _inner_main() -> None:
         "memory": memory,
     }
 
+    # Kernel-layer accounting (ops/registry.py): the headline config's
+    # effective KernelPolicy, the per-plane implementation it resolved
+    # to on THIS backend (pallas / interpret / reference), and the
+    # registry's backend -> fused-plane coverage map.
+    from frankenpaxos_tpu.ops import registry as _registry
+
+    _pol = _registry.policy_of(cfg)
+    result["kernel_policy"] = {
+        "mode": _pol.mode,
+        "block": _pol.block,
+        "disable": list(_pol.disable),
+        "resolved": {
+            name: _registry.resolve_mode(name, cfg)
+            for name, plane in _registry.PLANES.items()
+            if plane.backend == "multipaxos"
+        },
+    }
+    result["kernel_coverage"] = {
+        backend: list(planes)
+        for backend, planes in _registry.coverage().items()
+    }
+
     # Telemetry overhead budget (--telemetry): the device-side metric
     # ring (tpu/telemetry.py) must cost <2% ticks/sec on this flagship
     # config. Measured head-to-head: the shipped default ring vs a
@@ -528,6 +550,8 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
         "smr_variant": cpu_live.get("smr_variant"),
         "telemetry": cpu_live.get("telemetry"),
         "faults": cpu_live.get("faults"),
+        "kernel_policy": cpu_live.get("kernel_policy"),
+        "kernel_coverage": cpu_live.get("kernel_coverage"),
     }
     notes.append(
         "headline is the last-known-good real-TPU capture; "

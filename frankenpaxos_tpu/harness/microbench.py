@@ -528,6 +528,248 @@ def bench_faults(
     return rows
 
 
+def _kernel_cases(A=3, G=3334, W=64, N=3334, L=3, KV=16, CW=16, seed=0):
+    """Random dtype-policy-native inputs for every registered kernel
+    plane (flagship-shaped by default): ``{plane: (args, statics)}``.
+    Mirrors the distributions of tests/test_ops.py at benchmark scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.ops import INF, INF16
+
+    I16, I8 = jnp.int16, jnp.int8
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 64))
+
+    def nxt():
+        return next(keys)
+
+    def clock(shape, p=0.35):
+        return jnp.where(
+            jax.random.uniform(nxt(), shape) < p,
+            jax.random.randint(nxt(), shape, -1, 5),
+            INF16,
+        ).astype(I16)
+
+    def lat16(shape):
+        return jax.random.randint(nxt(), shape, 1, 4).astype(I16)
+
+    t = jnp.int32(33)
+    cases: Dict[str, tuple] = {}
+
+    # ---- MultiPaxos planes, acceptor-major [A, G, W].
+    status = jax.random.randint(nxt(), (G, W), 0, 3).astype(I8)
+    slot_value = jnp.where(
+        status > 0, jax.random.randint(nxt(), (G, W), 0, 10000), -1
+    )
+    propose_tick = jnp.where(
+        status > 0, jax.random.randint(nxt(), (G, W), 0, 30), INF
+    )
+    last_send = jnp.where(
+        status > 0, jax.random.randint(nxt(), (G, W), 0, 33), INF
+    )
+    chosen_tick = jnp.where(
+        status == 2, jax.random.randint(nxt(), (G, W), 0, 33), INF
+    )
+    chosen_round = jnp.where(status == 2, 1, -1).astype(I16)
+    chosen_value = jnp.where(status == 2, slot_value, -1)
+    replica_arrival = jnp.where(
+        status == 2, jax.random.randint(nxt(), (G, W), 30, 40), INF
+    )
+    p2a, p2b = clock((A, G, W)), clock((A, G, W))
+    acc_round = jax.random.randint(nxt(), (A, G), 0, 3).astype(I16)
+    leader_round = jax.random.randint(nxt(), (G,), 0, 3).astype(I16)
+    vote_round = jax.random.randint(nxt(), (A, G, W), -1, 3).astype(I16)
+    vote_value = jnp.where(
+        vote_round >= 0, jax.random.randint(nxt(), (A, G, W), 0, 10000), -1
+    )
+    cases["multipaxos_vote_quorum"] = (
+        (
+            p2a, acc_round, leader_round, slot_value, vote_round,
+            vote_value, p2b, lat16((A, G, W)),
+            jax.random.uniform(nxt(), (A, G, W)) < 0.9,
+        ),
+        {},
+    )
+    cases["multipaxos_p1_promise"] = (
+        (
+            status, vote_round, vote_value, slot_value, p2a, p2b,
+            last_send, jax.random.uniform(nxt(), (G,)) < 0.5,
+            jax.random.uniform(nxt(), (A, G)) < 0.7, lat16((A, G, W)), t,
+        ),
+        {},
+    )
+    head = jax.random.randint(nxt(), (G,), 0, 100)
+    cases["multipaxos_dispatch"] = (
+        (
+            status, slot_value, propose_tick, last_send, chosen_tick,
+            chosen_round, chosen_value, replica_arrival, p2a, p2b,
+            vote_round, vote_value,
+            jax.random.randint(nxt(), (G, W), 0, A + 1),  # nvotes
+            head, head + jax.random.randint(nxt(), (G,), 0, W + 1),
+            leader_round, jnp.full((G,), 8, jnp.int32),
+            jnp.ones((G,), bool),
+            jax.random.uniform(nxt(), (A, G, W)) < 0.6,  # send_ok
+            jax.random.uniform(nxt(), (A, G, W)) < 0.9,  # retry_deliv
+            lat16((A, G, W)), lat16((A, G, W)),
+            jax.random.randint(nxt(), (G, W), 1, 4), t,
+        ),
+        dict(f=1, retry_timeout=16, num_groups=G),
+    )
+
+    # ---- Mencius vote plane, leader-major [L, W, A] (L = G stripes).
+    voted = jax.random.uniform(nxt(), (G, W, A)) < 0.3
+    cases["mencius_vote"] = (
+        (
+            jnp.where(
+                jax.random.uniform(nxt(), (G, W, A)) < 0.3,
+                jax.random.randint(nxt(), (G, W, A), 31, 36),
+                INF,
+            ),
+            voted,
+            jnp.where(
+                voted, jax.random.randint(nxt(), (G, W, A), 30, 37), INF
+            ),
+            jax.random.randint(nxt(), (G, W, A), 1, 4),
+            jax.random.uniform(nxt(), (G, W, A)) < 0.9,
+            t,
+        ),
+        {},
+    )
+
+    # ---- CRAQ chain plane, [N, CW] write ring + [N, L*KV] node state.
+    tail = L - 1
+    w_status = jax.random.randint(nxt(), (N, CW), 0, 3).astype(I8)
+    cases["craq_chain"] = (
+        (
+            w_status,
+            jax.random.randint(nxt(), (N, CW), 0, KV),
+            jax.random.randint(nxt(), (N, CW), 0, 50),
+            jnp.where(
+                w_status == 2,
+                jax.random.randint(nxt(), (N, CW), 0, max(tail, 1)),
+                jax.random.randint(nxt(), (N, CW), 0, tail + 1),
+            ),
+            jnp.where(
+                w_status > 0,
+                jax.random.randint(nxt(), (N, CW), 32, 36),
+                INF,
+            ),
+            jax.random.randint(nxt(), (N, CW), 0, 33),
+            jax.random.randint(nxt(), (N, L * KV), 0, 3),
+            jax.random.randint(nxt(), (N, L * KV), -1, 40),
+            jax.random.randint(nxt(), (N, CW), 1, 4),
+            t,
+        ),
+        dict(tail=tail, num_keys=KV),
+    )
+    return cases
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(
+        np.asarray(x).dtype == np.asarray(y).dtype
+        and np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# Pallas block-size sweep per plane on real TPU; the winners land in the
+# checked-in table (ops/autotune.json) under FPX_WRITE_AUTOTUNE=1.
+AUTOTUNE_BLOCKS = (128, 256, 512, 1024)
+
+
+def bench_kernels(iters: int = 20, **sizes) -> List[dict]:
+    """Per-plane kernel microbenchmark + autotuner: the jitted pure-jnp
+    reference of EVERY registered plane is timed at flagship shapes; on
+    real TPU backends the fused Pallas kernel is additionally swept over
+    ``AUTOTUNE_BLOCKS`` and its best block + speedup reported (set
+    ``FPX_WRITE_AUTOTUNE=1`` to persist winners into ops/autotune.json).
+    Elsewhere (CPU CI) the kernel runs once per plane in interpret mode
+    at a reduced shape and is checked for BIT-PARITY with the reference
+    (timing the pallas interpreter is meaningless). A ``KERNELS_JSON``
+    line carries the machine-readable summary."""
+    import functools
+    import json
+    import os
+
+    import jax
+
+    from frankenpaxos_tpu.ops import registry
+
+    on_tpu = jax.default_backend() in registry.TPU_BACKENDS
+    cases = _kernel_cases(**sizes)
+    small = _kernel_cases(A=3, G=48, W=16, N=48, L=3, KV=4, CW=8, seed=1)
+    rows: List[dict] = []
+    summary: Dict[str, dict] = {}
+    winners: Dict[str, int] = {}
+    for name, (args, statics) in cases.items():
+        plane = registry.PLANES[name]
+        ref = jax.jit(functools.partial(plane.reference, **statics))
+        jax.block_until_ready(ref(*args))  # compile
+
+        def run_ref() -> int:
+            out = None
+            for _ in range(iters):
+                out = ref(*args)
+            jax.block_until_ready(out)
+            return iters
+
+        ops, ref_s = _timed(run_ref)
+        rows.append(_report("kernels", f"{name}:reference", ops, ref_s))
+        entry = {"reference_per_sec": round(iters / ref_s, 2)}
+        if on_tpu:
+            fused = functools.partial(plane.kernel, **statics)
+            best = None
+            for blk in AUTOTUNE_BLOCKS:
+                jax.block_until_ready(fused(*args, block=blk))
+
+                def run_fused() -> int:
+                    out = None
+                    for _ in range(iters):
+                        out = fused(*args, block=blk)
+                    jax.block_until_ready(out)
+                    return iters
+
+                _, fs = _timed(run_fused)
+                rows.append(
+                    _report("kernels", f"{name}:fused[b{blk}]", iters, fs)
+                )
+                if best is None or fs < best[1]:
+                    best = (blk, fs)
+            blk, fs = best
+            winners[registry.table_key(name, plane.key_of(args))] = blk
+            entry.update(
+                fused_per_sec=round(iters / fs, 2),
+                speedup=round(ref_s / fs, 3),
+                best_block=blk,
+            )
+        else:
+            s_args, s_statics = small[name]
+            got = plane.kernel(
+                *s_args, block=16, interpret=True, **s_statics
+            )
+            entry["interpret_parity"] = _tree_equal(
+                plane.reference(*s_args, **s_statics), got
+            )
+        summary[name] = entry
+    payload = {
+        "backend": jax.default_backend(),
+        "iters": iters,
+        "planes": summary,
+    }
+    if on_tpu and os.environ.get("FPX_WRITE_AUTOTUNE"):
+        registry.write_table(winners)
+        payload["autotune_written"] = winners
+    print("KERNELS_JSON " + json.dumps(payload))
+    return rows
+
+
 BENCHES = {
     "depgraph": bench_depgraph,
     "int_prefix_set": bench_int_prefix_set,
@@ -543,6 +785,7 @@ DEVICE_BENCHES = {
     "hbm": bench_hbm,
     "telemetry": bench_telemetry,
     "faults": bench_faults,
+    "kernels": bench_kernels,
 }
 
 

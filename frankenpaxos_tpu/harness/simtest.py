@@ -37,7 +37,7 @@ import functools
 import json
 import random as _random
 import zlib
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -475,6 +475,69 @@ def _candidates(plan: FaultPlan) -> List[FaultPlan]:
     return out
 
 
+def _with_cut(plan: FaultPlan, subset) -> FaultPlan:
+    """The plan with its partition cut replaced by exactly ``subset``
+    (replica indices on the cut side)."""
+    side = [0] * len(plan.partition)
+    for i in subset:
+        side[i] = 1
+    return dataclasses.replace(plan, partition=tuple(side))
+
+
+def _ddmin_partition(
+    plan: FaultPlan,
+    failing: Callable[[FaultPlan], bool],
+    budget: int,
+) -> Tuple[FaultPlan, int]:
+    """Delta debugging (Zeller's ddmin) over the partition SIDE-BIT SET.
+
+    The greedy candidate list only ever drops the LAST cut replica, so
+    a multi-replica cut like {0, 1, 2} where only {0, 2} matters stops
+    shrinking the moment dropping replica 2 alone passes. ddmin instead
+    splits the cut set into n chunks and tests each chunk AND each
+    complement, re-splitting finer on failure, which converges to a
+    1-MINIMAL cut (no single replica can be removed) in O(k^2) runs
+    worst case for a k-replica cut.
+
+    Returns ``(plan, tests_used)``; the input plan must fail."""
+    cut = [i for i, s in enumerate(plan.partition) if s]
+    tests = 0
+    if len(cut) <= 1:
+        return plan, tests
+    n = 2
+    while len(cut) >= 2 and tests < budget:
+        bounds = [len(cut) * i // n for i in range(n + 1)]
+        chunks = [
+            cut[bounds[i] : bounds[i + 1]]
+            for i in range(n)
+            if bounds[i] < bounds[i + 1]
+        ]
+        reduced = False
+        for chunk in chunks:  # reduce to subset
+            tests += 1
+            if failing(_with_cut(plan, chunk)):
+                cut, n, reduced = chunk, 2, True
+                break
+            if tests >= budget:
+                break
+        if not reduced and tests < budget:
+            for chunk in chunks:  # reduce to complement
+                comp = [i for i in cut if i not in chunk]
+                if not comp or len(comp) == len(cut):
+                    continue
+                tests += 1
+                if failing(_with_cut(plan, comp)):
+                    cut, n, reduced = comp, max(n - 1, 2), True
+                    break
+                if tests >= budget:
+                    break
+        if not reduced:
+            if n >= len(cut):
+                break  # 1-minimal: no chunk or complement still fails
+            n = min(len(cut), 2 * n)  # split finer
+    return _with_cut(plan, cut), tests
+
+
 def shrink(
     spec: SimSpec,
     plan: FaultPlan,
@@ -483,8 +546,13 @@ def shrink(
     failing: Optional[Callable[[FaultPlan], bool]] = None,
     max_steps: int = 64,
 ) -> FaultPlan:
-    """Greedy schedule minimization: repeatedly apply the first
-    simplification candidate that still fails, until none does. The
+    """Schedule minimization: a greedy first-improvement pass over
+    :func:`_candidates` (whole-knob removals, halvings, window shrinks)
+    interleaved with DELTA DEBUGGING over the partition side-bit set
+    (:func:`_ddmin_partition`) until a joint fixpoint — greedy strips
+    the knobs and the window, ddmin minimizes WHICH replicas the cut
+    needs, and each can unlock further steps for the other (a smaller
+    cut can make a narrower window sufficient and vice versa). The
     default failure predicate is "run_schedule reports an invariant
     violation"; tests inject their own (e.g. a deliberately-broken
     invariant) to pin the loop's behavior. ``plan`` must fail."""
@@ -505,6 +573,12 @@ def shrink(
                 break
             if steps >= max_steps:
                 break
+        if not improved and plan.has_partition and steps < max_steps:
+            smaller, used = _ddmin_partition(plan, failing, max_steps - steps)
+            steps += used
+            if smaller != plan:
+                plan = smaller
+                improved = True  # ddmin may unlock further greedy steps
     return plan
 
 

@@ -1,221 +1,55 @@
-"""Pallas TPU kernels for the batched simulation's hot ops.
+"""Pallas TPU kernel layer for the batched simulation's hot planes.
 
-The tick's hottest phase is the acceptor step: process Phase2a arrivals,
-record votes, schedule Phase2b arrivals, and count the per-slot quorum —
-six elementwise passes plus a reduction over [G, W, A] arrays in the XLA
-version. :func:`fused_vote_quorum` fuses all of it into ONE Pallas kernel
-pass so every array is read from HBM once and stays in VMEM across the
-whole phase.
+What began as one fused kernel (the MultiPaxos acceptor step) is a
+kernel SUITE with a dispatch registry:
 
-Layout: the kernel works on ACCEPTOR-MAJOR ``[A, G, W]`` arrays (last dim
-W maps onto the 128-lane VPU; the tiny acceptor axis A=2f+1 becomes a
-static in-kernel loop) — the layout a real-TPU deployment of the batched
-state would use. :func:`reference_vote_quorum` is the pure-jnp
-specification the kernel is verified against (interpret mode in CI on
-CPU; the compiled path targets a real TPU).
+  * :mod:`frankenpaxos_tpu.ops.registry` — the :class:`KernelPolicy`
+    knob every covered backend config carries, per-plane
+    pallas/interpret/reference dispatch, and the checked-in autotune
+    table (``ops/autotune.json``) block-size lookup.
+  * :mod:`frankenpaxos_tpu.ops.multipaxos` — the MultiPaxos planes:
+    ``multipaxos_vote_quorum`` (acceptor votes + quorum count),
+    ``multipaxos_p1_promise`` (phase-1 safe-value aggregation + re-send),
+    ``multipaxos_dispatch`` (choose + commit-watermark advance +
+    proposals + retries).
+  * :mod:`frankenpaxos_tpu.ops.mencius` — ``mencius_vote`` (per-slot
+    vote/skip aggregation).
+  * :mod:`frankenpaxos_tpu.ops.craq` — ``craq_chain`` (chain
+    propagate/ack with scatter-free pending-set accounting).
+
+Every kernel is dtype-polymorphic (int16 rounds / int16 offset clocks /
+int8 statuses native — no widen/narrow casts at the boundary) and has a
+pure-jnp ``reference_*`` twin with an identical signature, pinned
+bit-identical by ``tests/test_ops.py`` and
+``tests/test_kernel_registry.py``. The AST lint
+(``tests/test_kernel_lint.py``) keeps every ``pallas_call`` inside this
+package and every covered backend dispatching through the registry.
+
+Microbenchmark + autotuner:
+``python -m frankenpaxos_tpu.harness.microbench kernels``.
 """
 
-from __future__ import annotations
+from frankenpaxos_tpu.tpu.common import INF, INF16  # noqa: F401 (re-export)
 
-import functools
-from typing import Tuple
-
-import jax
-import jax.numpy as jnp
-
-from frankenpaxos_tpu.tpu.common import INF
-
-
-def reference_vote_quorum(
-    p2a_arrival: jnp.ndarray,  # [A, G, W] int32 arrival ticks (INF = never)
-    acc_round: jnp.ndarray,  # [A, G] int32 promised rounds
-    leader_round: jnp.ndarray,  # [G] int32
-    slot_value: jnp.ndarray,  # [G, W] int32
-    vote_round: jnp.ndarray,  # [A, G, W] int32 (-1 = no vote)
-    vote_value: jnp.ndarray,  # [A, G, W] int32
-    p2b_arrival: jnp.ndarray,  # [A, G, W] int32 (INF = none pending)
-    p2b_lat: jnp.ndarray,  # [A, G, W] int32 sampled latencies
-    p2b_delivered: jnp.ndarray,  # [A, G, W] bool
-    t: jnp.ndarray,  # [] int32 current tick
-) -> Tuple[
-    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
-    jnp.ndarray,
-]:
-    """The pure-jnp specification (tick steps 1-2 of multipaxos_batched,
-    Acceptor.scala:184-220 + ProxyLeader.scala:217-258), acceptor-major.
-
-    The sixth output ``nsends`` [G, W] counts the Phase2b messages the
-    acceptors SENT this tick (votes cast whose reply was delivered) —
-    the vote predicate is otherwise kernel-internal, and the telemetry
-    phase-2 message accounting needs it to be exact under use_pallas."""
-    lr = leader_round[None, :, None]  # [1, G, 1]
-    arrived = p2a_arrival == t
-    may_vote = arrived & (lr >= acc_round[:, :, None])
-    new_vote_round = jnp.where(may_vote, lr, vote_round)
-    new_vote_value = jnp.where(may_vote, slot_value[None, :, :], vote_value)
-    sends = may_vote & p2b_delivered
-    new_p2b = jnp.where(
-        sends,
-        jnp.minimum(p2b_arrival, t + p2b_lat),
-        p2b_arrival,
-    )
-    new_acc_round = jnp.maximum(
-        acc_round, jnp.max(jnp.where(may_vote, lr, -1), axis=2)
-    )
-    votes_in = (new_p2b <= t) & (new_vote_round == lr)
-    nvotes = jnp.sum(votes_in.astype(jnp.int32), axis=0)  # [G, W]
-    nsends = jnp.sum(sends.astype(jnp.int32), axis=0)  # [G, W]
-    return new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes, nsends
-
-
-def _vote_quorum_kernel(
-    t_ref,  # SMEM (1,) current tick
-    p2a_ref,  # [A, BG, W]
-    accr_ref,  # [A, BG]
-    lr_ref,  # [BG]
-    sv_ref,  # [BG, W]
-    vr_ref,  # [A, BG, W]
-    vv_ref,  # [A, BG, W]
-    p2b_ref,  # [A, BG, W]
-    lat_ref,  # [A, BG, W]
-    deliv_ref,  # [A, BG, W] int8 (0/1)
-    out_vr_ref,  # [A, BG, W]
-    out_vv_ref,  # [A, BG, W]
-    out_p2b_ref,  # [A, BG, W]
-    out_accr_ref,  # [A, BG]
-    out_nv_ref,  # [BG, W]
-    out_ns_ref,  # [BG, W] Phase2b sends this tick
-):
-    t = t_ref[0]
-    A = p2a_ref.shape[0]
-    lr = lr_ref[:][:, None]  # [BG, 1]
-    sv = sv_ref[:]  # [BG, W]
-    nvotes = jnp.zeros(sv.shape, jnp.int32)
-    nsends = jnp.zeros(sv.shape, jnp.int32)
-    # The acceptor axis is tiny (2f+1): a static loop keeps every slice a
-    # well-tiled [BG, W] block, with values resident in VMEM across the
-    # vote update AND the quorum count.
-    for a in range(A):
-        p2a = p2a_ref[a]
-        arrived = p2a == t
-        may_vote = arrived & (lr >= accr_ref[a][:, None])
-        new_vr = jnp.where(may_vote, lr, vr_ref[a])
-        new_vv = jnp.where(may_vote, sv, vv_ref[a])
-        deliver = may_vote & (deliv_ref[a] != 0)
-        new_p2b = jnp.where(
-            deliver, jnp.minimum(p2b_ref[a], t + lat_ref[a]), p2b_ref[a]
-        )
-        out_vr_ref[a] = new_vr
-        out_vv_ref[a] = new_vv
-        out_p2b_ref[a] = new_p2b
-        out_accr_ref[a] = jnp.maximum(
-            accr_ref[a], jnp.max(jnp.where(may_vote, lr, -1), axis=1)
-        )
-        nvotes = nvotes + ((new_p2b <= t) & (new_vr == lr)).astype(jnp.int32)
-        nsends = nsends + deliver.astype(jnp.int32)
-    out_nv_ref[:] = nvotes
-    out_ns_ref[:] = nsends
-
-
-@functools.partial(jax.jit, static_argnames=("block_g", "interpret"))
-def fused_vote_quorum(
-    p2a_arrival,
-    acc_round,
-    leader_round,
-    slot_value,
-    vote_round,
-    vote_value,
-    p2b_arrival,
-    p2b_lat,
-    p2b_delivered,
-    t,
-    block_g: int = 256,
-    interpret: bool = False,
-):
-    """One fused VMEM-resident pass over the acceptor step (see module
-    docstring). Same semantics as :func:`reference_vote_quorum`; gridded
-    over blocks of the group axis."""
-    from jax.experimental import pallas as pl
-
-    A, G, W = p2a_arrival.shape
-    # Balanced blocks: bg = ceil(G / nblocks) for the smallest nblocks
-    # with bg <= block_g, so padding waste is bounded by one block's
-    # remainder (min(block_g, G) would pad G=257 up to 512).
-    nblocks = -(-G // block_g)
-    bg = -(-G // nblocks)
-    # Pad the group axis up to a block multiple; padded groups compute
-    # garbage that is sliced off (no cross-group dataflow exists).
-    pad = (-G) % bg
-    if pad:
-        def pad_g(x, axis):
-            widths = [(0, 0)] * x.ndim
-            widths[axis] = (0, pad)
-            return jnp.pad(x, widths)
-
-        p2a_arrival = pad_g(p2a_arrival, 1)
-        acc_round = pad_g(acc_round, 1)
-        leader_round = pad_g(leader_round, 0)
-        slot_value = pad_g(slot_value, 0)
-        vote_round = pad_g(vote_round, 1)
-        vote_value = pad_g(vote_value, 1)
-        p2b_arrival = pad_g(p2b_arrival, 1)
-        p2b_lat = pad_g(p2b_lat, 1)
-        p2b_delivered = pad_g(p2b_delivered, 1)
-    Gp = G + pad
-
-    from jax.experimental.pallas import tpu as pltpu
-
-    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
-    spec2 = pl.BlockSpec((A, bg), lambda i: (0, i))
-    spec_g = pl.BlockSpec((bg,), lambda i: (i,))
-    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
-    t_arr = jnp.asarray(t, jnp.int32).reshape((1,))
-
-    # Scalars live in SMEM on the compiled TPU path; interpret mode
-    # accepts the same spec.
-    t_space = None if interpret else pltpu.SMEM
-    grid_spec = pl.GridSpec(
-        grid=(Gp // bg,),
-        in_specs=[
-            pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space),  # t
-            spec3,  # p2a
-            spec2,  # acc_round
-            spec_g,  # leader_round
-            spec_gw,  # slot_value
-            spec3,  # vote_round
-            spec3,  # vote_value
-            spec3,  # p2b_arrival
-            spec3,  # p2b_lat
-            spec3,  # delivered
-        ],
-        out_specs=[spec3, spec3, spec3, spec2, spec_gw, spec_gw],
-    )
-    out_shape = [
-        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # vote_round
-        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # vote_value
-        jax.ShapeDtypeStruct((A, Gp, W), jnp.int32),  # p2b_arrival
-        jax.ShapeDtypeStruct((A, Gp), jnp.int32),  # acc_round
-        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nvotes
-        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # Phase2b sends
-    ]
-    vr, vv, p2b, accr, nv, ns = pl.pallas_call(
-        _vote_quorum_kernel,
-        grid_spec=grid_spec,
-        out_shape=out_shape,
-        interpret=interpret,
-    )(
-        t_arr,
-        p2a_arrival.astype(jnp.int32),
-        acc_round.astype(jnp.int32),
-        leader_round.astype(jnp.int32),
-        slot_value.astype(jnp.int32),
-        vote_round.astype(jnp.int32),
-        vote_value.astype(jnp.int32),
-        p2b_arrival.astype(jnp.int32),
-        p2b_lat.astype(jnp.int32),
-        p2b_delivered.astype(jnp.int8),
-    )
-    if pad:
-        vr, vv, p2b = vr[:, :G], vv[:, :G], p2b[:, :G]
-        accr, nv, ns = accr[:, :G], nv[:G], ns[:G]
-    return vr, vv, p2b, accr, nv, ns
+from frankenpaxos_tpu.ops import registry  # noqa: F401
+from frankenpaxos_tpu.ops.registry import (  # noqa: F401
+    KernelPolicy,
+    coverage,
+    dispatch,
+)
+from frankenpaxos_tpu.ops.multipaxos import (  # noqa: F401
+    fused_mp_dispatch,
+    fused_p1_promise,
+    fused_vote_quorum,
+    reference_mp_dispatch,
+    reference_p1_promise,
+    reference_vote_quorum,
+)
+from frankenpaxos_tpu.ops.mencius import (  # noqa: F401
+    fused_mencius_vote,
+    reference_mencius_vote,
+)
+from frankenpaxos_tpu.ops.craq import (  # noqa: F401
+    fused_craq_chain,
+    reference_craq_chain,
+)

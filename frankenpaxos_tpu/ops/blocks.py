@@ -1,0 +1,52 @@
+"""Shared grid/blocking helpers for the fused kernels.
+
+Every plane grids over ONE batch axis (groups / leaders / chains). The
+helpers keep the blocking discipline uniform across kernels:
+``balanced_block`` picks bg = ceil(N / nblocks) for the smallest block
+count with bg <= requested block, bounding padding waste by one block's
+remainder (min(block, N) would pad N=257 up to 512); ``pad_axis`` pads
+the batch axis up to a block multiple (padded rows compute garbage that
+the wrapper slices off — no cross-row dataflow exists in any plane).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# Weakly-typed mirror of common.INF for use INSIDE kernel bodies (the
+# jnp scalar would be a captured constant, which pallas_call rejects).
+INF_I = 2**30
+
+
+def balanced_block(n: int, block: int) -> Tuple[int, int]:
+    """Returns ``(bg, pad)``: the balanced block size and the padding
+    needed to make the axis a block multiple."""
+    block = max(1, min(block, n))
+    nblocks = -(-n // block)
+    bg = -(-n // nblocks)
+    return bg, (-n) % bg
+
+
+def pad_axis(x: jnp.ndarray, axis: int, pad: int) -> jnp.ndarray:
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def t_arr(t) -> jnp.ndarray:
+    """The tick counter as the (1,)-shaped SMEM operand kernels take."""
+    return jnp.asarray(t, jnp.int32).reshape((1,))
+
+
+def t_space(interpret: bool):
+    """Memory space for the tick-counter operand: SMEM on the compiled
+    TPU path; interpret mode accepts the same spec with ``None``."""
+    if interpret:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM

@@ -1,0 +1,274 @@
+"""Fused Pallas kernel for the batched CRAQ chain plane.
+
+``craq_chain`` covers tick steps 1-2 of ``tpu/craq_batched.py``: DOWN
+writes arriving at mid-chain nodes join their pending sets and forward;
+the tail applies + replies + starts the ack; UP acks apply locally,
+leave the pending set, and keep propagating (ChainNode.scala:120-299).
+
+The XLA version's hot ops are four scatters into the flattened
+``[N, L*KV]`` node state. Scatters don't vectorize on the VPU, so the
+kernel recasts them as ONE-HOT ACCUMULATIONS over the (static, small)
+write ring: for each ring slot w, a ``[BN, L*KV]`` equality mask
+scatters its contribution as a masked add/max. Addition and max both
+commute, so the accumulation is bit-identical to the reference's
+scatter order. The whole plane — both scatter families plus the
+advance/retire logic — runs in one VMEM-resident pass per chain block.
+
+Partitions buffer hops until the heal tick (``faults.defer_to_heal``),
+a data-dependent arrival rewrite the kernel does not model: the
+registry routes partitioned configs to the reference
+(``supported=not has_partition``). Drop/jitter fault penalties land in
+``hop_lat`` BEFORE dispatch, so they ride the kernel unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import (
+    INF_I,
+    balanced_block,
+    pad_axis,
+    t_arr,
+    t_space,
+)
+from frankenpaxos_tpu.tpu.common import INF
+
+# Mirrors of the backend's write-slot codes (ops must not import the
+# backend). Cross-checked by tests/test_kernel_registry.
+W_EMPTY = 0
+W_DOWN = 1
+W_UP = 2
+
+
+def reference_craq_chain(
+    w_status: jnp.ndarray,  # [N, W] int8
+    w_key: jnp.ndarray,  # [N, W]
+    w_version: jnp.ndarray,  # [N, W]
+    w_node: jnp.ndarray,  # [N, W]
+    w_arrival: jnp.ndarray,  # [N, W] absolute ticks
+    w_issue: jnp.ndarray,  # [N, W]
+    node_dirty_flat: jnp.ndarray,  # [N, L*KV]
+    node_version_flat: jnp.ndarray,  # [N, L*KV]
+    hop_lat: jnp.ndarray,  # [N, W]
+    t: jnp.ndarray,  # []
+    *,
+    tail: int,
+    num_keys: int,
+):
+    """The pure-jnp specification (tick steps 1-2 of craq_batched,
+    lossless/healed links). Returns ``(w_status', w_node', w_arrival',
+    node_dirty', node_version', at_tail, wlat)`` — ``at_tail`` [N, W]
+    marks tail applies (client-visible write completions) and ``wlat``
+    their latencies, for the stats the tick keeps outside."""
+    N, W = w_status.shape
+    KV = num_keys
+    n_rows = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[:, None], (N, W))
+
+    # ---- DOWN arrivals (ChainNode._process_write_batch).
+    arrive_down = (w_status == W_DOWN) & (w_arrival == t)
+    at_mid = arrive_down & (w_node < tail)
+    at_tail = arrive_down & (w_node == tail)
+    wslot = w_node * KV + w_key
+    node_dirty_flat = node_dirty_flat.at[n_rows, wslot].add(
+        at_mid.astype(jnp.int32)
+    )
+    node_version_flat = node_version_flat.at[n_rows, wslot].max(
+        jnp.where(at_tail, w_version, -1)
+    )
+    wlat = jnp.where(at_tail, t + hop_lat - w_issue, 0)
+    w_node = jnp.where(at_mid, w_node + 1, w_node)
+    w_node = jnp.where(at_tail, tail - 1, w_node)
+    w_status = jnp.where(at_tail, W_UP, w_status)
+    w_arrival = jnp.where(arrive_down, t + hop_lat, w_arrival)
+
+    # ---- UP (ack) arrivals (ChainNode._handle_ack).
+    arrive_up = (w_status == W_UP) & (w_arrival == t)
+    uslot = w_node * KV + w_key
+    node_version_flat = node_version_flat.at[n_rows, uslot].max(
+        jnp.where(arrive_up, w_version, -1)
+    )
+    node_dirty_flat = node_dirty_flat.at[n_rows, uslot].add(
+        -arrive_up.astype(jnp.int32)
+    )
+    retire = arrive_up & (w_node == 0)
+    w_status = jnp.where(retire, W_EMPTY, w_status)
+    w_arrival = jnp.where(retire, INF, w_arrival)
+    keep_up = arrive_up & ~retire
+    w_node = jnp.where(keep_up, w_node - 1, w_node)
+    w_arrival = jnp.where(keep_up, t + hop_lat, w_arrival)
+    return (
+        w_status, w_node, w_arrival, node_dirty_flat, node_version_flat,
+        at_tail, wlat,
+    )
+
+
+def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
+    KV = num_keys
+
+    def kernel(
+        t_ref,  # SMEM (1,)
+        ws_ref, wk_ref, wv_ref, wn_ref, wa_ref, wi_ref, lat_ref,  # [BN, W]
+        dirty_ref, ver_ref,  # [BN, LKV]
+        out_ws, out_wn, out_wa,  # [BN, W]
+        out_dirty, out_ver,  # [BN, LKV]
+        out_at_tail, out_wlat,  # [BN, W]
+    ):
+        import jax.lax as lax
+
+        t = t_ref[0]
+        ws = ws_ref[:]
+        wn = wn_ref[:]
+        wa = wa_ref[:]
+        wk = wk_ref[:]
+        wv = wv_ref[:]
+        lat = lat_ref[:]
+
+        arrive_down = (ws == W_DOWN) & (wa == t)
+        at_mid = arrive_down & (wn < tail)
+        at_tail = arrive_down & (wn == tail)
+        wslot = wn * KV + wk
+        out_at_tail[:] = at_tail.astype(jnp.int8)
+        out_wlat[:] = jnp.where(at_tail, t + lat - wi_ref[:], 0)
+
+        wn1 = jnp.where(at_mid, wn + 1, wn)
+        wn1 = jnp.where(at_tail, tail - 1, wn1)
+        ws1 = jnp.where(at_tail, W_UP, ws)
+        wa1 = jnp.where(arrive_down, t + lat, wa)
+
+        arrive_up = (ws1 == W_UP) & (wa1 == t)
+        uslot = wn1 * KV + wk
+        retire = arrive_up & (wn1 == 0)
+        ws2 = jnp.where(retire, W_EMPTY, ws1)
+        wa2 = jnp.where(retire, INF_I, wa1)
+        keep_up = arrive_up & ~retire
+        wn2 = jnp.where(keep_up, wn1 - 1, wn1)
+        wa2 = jnp.where(keep_up, t + lat, wa2)
+        out_ws[:] = ws2
+        out_wn[:] = wn2
+        out_wa[:] = wa2
+
+        # The scatter families as one-hot accumulations over the static
+        # write ring (adds and maxes commute: bit-identical to the
+        # reference's scatters).
+        bn = dirty_ref.shape[0]
+        j_iota = lax.broadcasted_iota(jnp.int32, (bn, LKV), 1)
+        dirty = dirty_ref[:]
+        ver = ver_ref[:]
+        for w in range(W):
+            eq_w = j_iota == wslot[:, w][:, None]  # [BN, LKV]
+            eq_u = j_iota == uslot[:, w][:, None]
+            dirty = dirty + jnp.where(
+                eq_w & at_mid[:, w][:, None], 1, 0
+            )
+            dirty = dirty - jnp.where(
+                eq_u & arrive_up[:, w][:, None], 1, 0
+            )
+            contrib = jnp.where(
+                eq_w & at_tail[:, w][:, None], wv[:, w][:, None], -1
+            )
+            contrib = jnp.maximum(
+                contrib,
+                jnp.where(
+                    eq_u & arrive_up[:, w][:, None], wv[:, w][:, None], -1
+                ),
+            )
+            ver = jnp.maximum(ver, contrib)
+        out_dirty[:] = dirty
+        out_ver[:] = ver
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "tail", "num_keys")
+)
+def fused_craq_chain(
+    w_status,
+    w_key,
+    w_version,
+    w_node,
+    w_arrival,
+    w_issue,
+    node_dirty_flat,
+    node_version_flat,
+    hop_lat,
+    t,
+    block: int = 256,
+    interpret: bool = False,
+    tail: int = 1,
+    num_keys: int = 1,
+):
+    """Fused :func:`reference_craq_chain`, gridded over chain blocks."""
+    from jax.experimental import pallas as pl
+
+    N, W = w_status.shape
+    LKV = node_dirty_flat.shape[1]
+    bn, pad = balanced_block(N, block)
+    nw = [w_status, w_key, w_version, w_node, w_arrival, w_issue, hop_lat]
+    if pad:
+        nw = [pad_axis(x, 0, pad) for x in nw]
+        node_dirty_flat = pad_axis(node_dirty_flat, 0, pad)
+        node_version_flat = pad_axis(node_version_flat, 0, pad)
+    w_status, w_key, w_version, w_node, w_arrival, w_issue, hop_lat = nw
+    Np = N + pad
+
+    spec_nw = pl.BlockSpec((bn, W), lambda i: (i, 0))
+    spec_nk = pl.BlockSpec((bn, LKV), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Np // bn,),
+        in_specs=(
+            [pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret))]
+            + [spec_nw] * 7
+            + [spec_nk] * 2
+        ),
+        out_specs=[spec_nw] * 3 + [spec_nk] * 2 + [spec_nw] * 2,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((Np, W), w_status.dtype),
+        jax.ShapeDtypeStruct((Np, W), w_node.dtype),
+        jax.ShapeDtypeStruct((Np, W), w_arrival.dtype),
+        jax.ShapeDtypeStruct((Np, LKV), node_dirty_flat.dtype),
+        jax.ShapeDtypeStruct((Np, LKV), node_version_flat.dtype),
+        jax.ShapeDtypeStruct((Np, W), jnp.int8),  # at_tail
+        jax.ShapeDtypeStruct((Np, W), jnp.int32),  # wlat
+    ]
+    kernel = _craq_chain_kernel_factory(tail, num_keys, W, LKV)
+    ws, wn, wa, dirty, ver, at_tail, wlat = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        w_status, w_key, w_version, w_node, w_arrival, w_issue, hop_lat,
+        node_dirty_flat, node_version_flat,
+    )
+    if pad:
+        ws, wn, wa = ws[:N], wn[:N], wa[:N]
+        dirty, ver = dirty[:N], ver[:N]
+        at_tail, wlat = at_tail[:N], wlat[:N]
+    return ws, wn, wa, dirty, ver, at_tail.astype(bool), wlat
+
+
+registry.register(
+    registry.Plane(
+        name="craq_chain",
+        backend="craq",
+        reference=reference_craq_chain,
+        kernel=fused_craq_chain,
+        key_of=lambda args: (
+            args[0].shape[0],  # N
+            args[6].shape[1],  # L*KV
+            args[0].shape[1],  # W
+        ),
+        # Hop deferral to the heal tick is reference-only (module
+        # docstring); everything else rides the kernel.
+        supported=lambda cfg: not cfg.faults.has_partition,
+        default_block=256,
+    )
+)

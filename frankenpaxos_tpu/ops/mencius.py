@@ -1,0 +1,151 @@
+"""Fused Pallas kernel for the batched Mencius vote plane.
+
+``mencius_vote`` covers tick steps 1-2 of ``tpu/mencius_batched.py``:
+acceptors of every stripe process Phase2a arrivals (no competing rounds
+in the steady-state Mencius write path — each leader owns its stripe),
+schedule Phase2b replies, and the per-slot quorum count sums the
+acceptor axis. Skips (noop range fills) flow through this same plane as
+ordinary proposals, so fusing it accelerates both the loaded and the
+catch-up paths. Four elementwise [L, W, A] passes plus a reduction in
+XLA; one VMEM-resident pass here.
+
+Layout note: mencius state is leader-major ``[L, W, A]`` with the tiny
+acceptor axis MINOR (the backend predates the acceptor-major layout
+rework). The kernel therefore blocks over L with full [BL, W, A] blocks
+and reduces over the minor axis — on real TPU the (W, A) tile pads A up
+to the lane width, so this plane's win is fusion (one HBM read per
+array), not layout; the autotune table picks the block accordingly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import balanced_block, pad_axis, t_arr, t_space
+
+
+def reference_mencius_vote(
+    p2a_arrival: jnp.ndarray,  # [L, W, A] absolute arrival ticks
+    voted: jnp.ndarray,  # [L, W, A] bool
+    p2b_arrival: jnp.ndarray,  # [L, W, A] absolute arrival ticks
+    p2b_lat: jnp.ndarray,  # [L, W, A] sampled latencies
+    p2b_delivered: jnp.ndarray,  # [L, W, A] bool
+    t: jnp.ndarray,  # [] current tick
+):
+    """The pure-jnp specification (tick steps 1-2 of mencius_batched).
+    Returns ``(voted', p2b_arrival', nvotes [L, W])``."""
+    arrived = p2a_arrival == t
+    new_voted = voted | arrived
+    new_p2b = jnp.where(
+        arrived & p2b_delivered,
+        jnp.minimum(p2b_arrival, t + p2b_lat),
+        p2b_arrival,
+    )
+    nvotes = jnp.sum(
+        ((new_p2b <= t) & new_voted).astype(jnp.int32), axis=2
+    )
+    return new_voted, new_p2b, nvotes
+
+
+def _mencius_vote_kernel(
+    t_ref,  # SMEM (1,)
+    p2a_ref,  # [BL, W, A]
+    voted_ref,  # [BL, W, A] int8
+    p2b_ref,  # [BL, W, A]
+    lat_ref,  # [BL, W, A]
+    deliv_ref,  # [BL, W, A] int8
+    out_voted_ref,
+    out_p2b_ref,
+    out_nv_ref,  # [BL, W]
+):
+    t = t_ref[0]
+    arrived = p2a_ref[:] == t
+    new_voted = (voted_ref[:] != 0) | arrived
+    new_p2b = jnp.where(
+        arrived & (deliv_ref[:] != 0),
+        jnp.minimum(p2b_ref[:], t + lat_ref[:]),
+        p2b_ref[:],
+    )
+    out_voted_ref[:] = new_voted.astype(jnp.int8)
+    out_p2b_ref[:] = new_p2b
+    out_nv_ref[:] = jnp.sum(
+        ((new_p2b <= t) & new_voted).astype(jnp.int32), axis=2
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_mencius_vote(
+    p2a_arrival,
+    voted,
+    p2b_arrival,
+    p2b_lat,
+    p2b_delivered,
+    t,
+    block: int = 256,
+    interpret: bool = False,
+):
+    """Fused :func:`reference_mencius_vote`, gridded over leader-stripe
+    blocks."""
+    from jax.experimental import pallas as pl
+
+    L, W, A = p2a_arrival.shape
+    bl, pad = balanced_block(L, block)
+    if pad:
+        p2a_arrival = pad_axis(p2a_arrival, 0, pad)
+        voted = pad_axis(voted, 0, pad)
+        p2b_arrival = pad_axis(p2b_arrival, 0, pad)
+        p2b_lat = pad_axis(p2b_lat, 0, pad)
+        p2b_delivered = pad_axis(p2b_delivered, 0, pad)
+    Lp = L + pad
+
+    spec3 = pl.BlockSpec((bl, W, A), lambda i: (i, 0, 0))
+    spec_lw = pl.BlockSpec((bl, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Lp // bl,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret)),
+            spec3,  # p2a
+            spec3,  # voted
+            spec3,  # p2b
+            spec3,  # lat
+            spec3,  # delivered
+        ],
+        out_specs=[spec3, spec3, spec_lw],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((Lp, W, A), jnp.int8),
+        jax.ShapeDtypeStruct((Lp, W, A), p2b_arrival.dtype),
+        jax.ShapeDtypeStruct((Lp, W), jnp.int32),
+    ]
+    voted_out, p2b, nv = pl.pallas_call(
+        _mencius_vote_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        p2a_arrival,
+        voted.astype(jnp.int8),
+        p2b_arrival,
+        p2b_lat,
+        p2b_delivered.astype(jnp.int8),
+    )
+    if pad:
+        voted_out, p2b, nv = voted_out[:L], p2b[:L], nv[:L]
+    return voted_out.astype(bool), p2b, nv
+
+
+registry.register(
+    registry.Plane(
+        name="mencius_vote",
+        backend="mencius",
+        reference=reference_mencius_vote,
+        kernel=fused_mencius_vote,
+        key_of=lambda args: args[0].shape,  # (L, W, A)
+        default_block=256,
+    )
+)

@@ -1,0 +1,766 @@
+"""Fused Pallas kernels for the batched MultiPaxos hot planes.
+
+Three planes of ``tpu/multipaxos_batched.py`` dispatch here (see
+``ops/registry.py`` for the policy machinery):
+
+  * ``multipaxos_vote_quorum`` — tick steps 1-2: acceptors process
+    Phase2a arrivals, record votes, schedule Phase2b arrivals, count
+    per-slot quorums (Acceptor.scala:184-220 + ProxyLeader.scala:
+    217-258). Six elementwise passes plus a reduction over [A, G, W]
+    arrays in the XLA version, ONE VMEM-resident pass here.
+  * ``multipaxos_p1_promise`` — phase-1 promise/max-vote aggregation
+    (startPhase1 / safeValue, Leader.scala:314-329, 409-459): per slot,
+    the max-round visible vote across the acceptor axis decides the
+    safe value; in-flight slots re-propose it to the full group. The
+    argmax + gather + three [A, G, W] re-send writes fuse into one
+    pass.
+  * ``multipaxos_dispatch`` — tick steps 2-5: quorum -> Chosen, the
+    commit-watermark advance (contiguous-prefix retire), the
+    retire-clears of the four [A, G, W] vote/message arrays, leader
+    Phase2a dispatch of fresh slots, and timeout resends. The
+    [G]-space control decisions (proposal caps under elections /
+    reconfiguration / closed workloads, retry gates) stay in XLA and
+    enter as tiny per-group vectors.
+
+All kernels are DTYPE-POLYMORPHIC: they compute in whatever dtypes the
+state carries (int16 rounds, int8 statuses, int16 offset clocks under
+the dtype policy of ``tpu/common.py``; int32 everything on the
+``widen_state()`` reference path), so there are no widen/narrow casts
+at the kernel boundary — ROADMAP PR 1 follow-up (b). Message arrival
+clocks are the DELTA-ENCODED offsets of ``tpu/common.py``: "arrives
+now" is ``offset == 0``, "already arrived" is ``offset <= 0``, and the
+tick counter never enters the arrival math (only absolute bookkeeping
+ticks — propose/chosen/last-send stamps — read the SMEM ``t``).
+
+Layout: acceptor-major ``[A, G, W]`` (see the backend's module
+docstring); the group axis grids, W rides the 128-lane VPU, and the
+tiny acceptor axis A = 2f+1 is a static in-kernel loop. Each kernel's
+``reference_*`` twin is the pure-jnp specification it is verified
+against bit for bit (tests/test_ops.py, tests/test_kernel_registry.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import (
+    INF_I,
+    balanced_block,
+    pad_axis,
+    t_arr,
+    t_space,
+)
+from frankenpaxos_tpu.tpu.common import INF, INF16, ring_retire_pos
+
+# Mirrors of the backend's slot codes (ops must not import the backend:
+# the backend imports ops). Cross-checked by tests/test_kernel_registry.
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+NO_VALUE = -1
+NOOP_VALUE = -2
+
+
+# ---------------------------------------------------------------------------
+# Plane: multipaxos_vote_quorum (tick steps 1-2)
+# ---------------------------------------------------------------------------
+
+
+def reference_vote_quorum(
+    p2a_off: jnp.ndarray,  # [A, G, W] offset clocks (0 = arrives now)
+    acc_round: jnp.ndarray,  # [A, G] promised rounds
+    leader_round: jnp.ndarray,  # [G]
+    slot_value: jnp.ndarray,  # [G, W]
+    vote_round: jnp.ndarray,  # [A, G, W] (-1 = no vote)
+    vote_value: jnp.ndarray,  # [A, G, W]
+    p2b_off: jnp.ndarray,  # [A, G, W] offset clocks (INF16 = none pending)
+    p2b_lat: jnp.ndarray,  # [A, G, W] sampled latencies (clock dtype)
+    p2b_delivered: jnp.ndarray,  # [A, G, W] bool
+):
+    """The pure-jnp specification (tick steps 1-2 of multipaxos_batched,
+    Acceptor.scala:184-220 + ProxyLeader.scala:217-258), acceptor-major.
+
+    The sixth output ``nsends`` [G, W] counts the Phase2b messages the
+    acceptors SENT this tick (votes cast whose reply was delivered) —
+    the vote predicate is otherwise plane-internal, and the telemetry
+    phase-2 message accounting needs it to be exact on every path."""
+    lr = leader_round[None, :, None]  # [1, G, 1]
+    arrived = p2a_off == 0
+    may_vote = arrived & (lr >= acc_round[:, :, None])
+    new_vote_round = jnp.where(may_vote, lr, vote_round)
+    new_vote_value = jnp.where(may_vote, slot_value[None, :, :], vote_value)
+    sends = may_vote & p2b_delivered
+    new_p2b = jnp.where(sends, jnp.minimum(p2b_off, p2b_lat), p2b_off)
+    new_acc_round = jnp.maximum(
+        acc_round, jnp.max(jnp.where(may_vote, lr, -1), axis=2)
+    )
+    votes_in = (new_p2b <= 0) & (new_vote_round == lr)
+    nvotes = jnp.sum(votes_in.astype(jnp.int32), axis=0)  # [G, W]
+    nsends = jnp.sum(sends.astype(jnp.int32), axis=0)  # [G, W]
+    return new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes, nsends
+
+
+def _vote_quorum_kernel(
+    p2a_ref,  # [A, BG, W]
+    accr_ref,  # [A, BG]
+    lr_ref,  # [BG]
+    sv_ref,  # [BG, W]
+    vr_ref,  # [A, BG, W]
+    vv_ref,  # [A, BG, W]
+    p2b_ref,  # [A, BG, W]
+    lat_ref,  # [A, BG, W]
+    deliv_ref,  # [A, BG, W] int8 (0/1)
+    out_vr_ref,
+    out_vv_ref,
+    out_p2b_ref,
+    out_accr_ref,
+    out_nv_ref,  # [BG, W]
+    out_ns_ref,  # [BG, W] Phase2b sends this tick
+):
+    A = p2a_ref.shape[0]
+    lr = lr_ref[:][:, None]  # [BG, 1]
+    sv = sv_ref[:]  # [BG, W]
+    nvotes = jnp.zeros(sv.shape, jnp.int32)
+    nsends = jnp.zeros(sv.shape, jnp.int32)
+    # The acceptor axis is tiny (2f+1): a static loop keeps every slice a
+    # well-tiled [BG, W] block, with values resident in VMEM across the
+    # vote update AND the quorum count.
+    for a in range(A):
+        arrived = p2a_ref[a] == 0
+        may_vote = arrived & (lr >= accr_ref[a][:, None])
+        new_vr = jnp.where(may_vote, lr, vr_ref[a])
+        new_vv = jnp.where(may_vote, sv, vv_ref[a])
+        sends = may_vote & (deliv_ref[a] != 0)
+        new_p2b = jnp.where(
+            sends, jnp.minimum(p2b_ref[a], lat_ref[a]), p2b_ref[a]
+        )
+        out_vr_ref[a] = new_vr
+        out_vv_ref[a] = new_vv
+        out_p2b_ref[a] = new_p2b
+        out_accr_ref[a] = jnp.maximum(
+            accr_ref[a], jnp.max(jnp.where(may_vote, lr, -1), axis=1)
+        )
+        nvotes = nvotes + ((new_p2b <= 0) & (new_vr == lr)).astype(jnp.int32)
+        nsends = nsends + sends.astype(jnp.int32)
+    out_nv_ref[:] = nvotes
+    out_ns_ref[:] = nsends
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_vote_quorum(
+    p2a_off,
+    acc_round,
+    leader_round,
+    slot_value,
+    vote_round,
+    vote_value,
+    p2b_off,
+    p2b_lat,
+    p2b_delivered,
+    block: int = 256,
+    interpret: bool = False,
+):
+    """One fused VMEM-resident pass over the acceptor step. Same
+    semantics (and dtypes) as :func:`reference_vote_quorum`; gridded
+    over blocks of the group axis."""
+    from jax.experimental import pallas as pl
+
+    A, G, W = p2a_off.shape
+    bg, pad = balanced_block(G, block)
+    args3 = [p2a_off, vote_round, vote_value, p2b_off, p2b_lat]
+    if pad:
+        args3 = [pad_axis(x, 1, pad) for x in args3]
+        acc_round = pad_axis(acc_round, 1, pad)
+        leader_round = pad_axis(leader_round, 0, pad)
+        slot_value = pad_axis(slot_value, 0, pad)
+        p2b_delivered = pad_axis(p2b_delivered, 1, pad)
+    p2a_off, vote_round, vote_value, p2b_off, p2b_lat = args3
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
+    spec2 = pl.BlockSpec((A, bg), lambda i: (0, i))
+    spec_g = pl.BlockSpec((bg,), lambda i: (i,))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=[
+            spec3,  # p2a
+            spec2,  # acc_round
+            spec_g,  # leader_round
+            spec_gw,  # slot_value
+            spec3,  # vote_round
+            spec3,  # vote_value
+            spec3,  # p2b
+            spec3,  # p2b_lat
+            spec3,  # delivered
+        ],
+        out_specs=[spec3, spec3, spec3, spec2, spec_gw, spec_gw],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((A, Gp, W), vote_round.dtype),
+        jax.ShapeDtypeStruct((A, Gp, W), vote_value.dtype),
+        jax.ShapeDtypeStruct((A, Gp, W), p2b_off.dtype),
+        jax.ShapeDtypeStruct((A, Gp), acc_round.dtype),
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nvotes
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # Phase2b sends
+    ]
+    vr, vv, p2b, accr, nv, ns = pl.pallas_call(
+        _vote_quorum_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        p2a_off,
+        acc_round,
+        leader_round,
+        slot_value,
+        vote_round,
+        vote_value,
+        p2b_off,
+        p2b_lat,
+        p2b_delivered.astype(jnp.int8),
+    )
+    if pad:
+        vr, vv, p2b = vr[:, :G], vv[:, :G], p2b[:, :G]
+        accr, nv, ns = accr[:, :G], nv[:G], ns[:G]
+    return vr, vv, p2b, accr, nv, ns
+
+
+# ---------------------------------------------------------------------------
+# Plane: multipaxos_p1_promise (phase-1 safe-value aggregation + re-send)
+# ---------------------------------------------------------------------------
+
+
+def reference_p1_promise(
+    status: jnp.ndarray,  # [G, W] int8
+    vote_round: jnp.ndarray,  # [A, G, W]
+    vote_value: jnp.ndarray,  # [A, G, W]
+    slot_value: jnp.ndarray,  # [G, W]
+    p2a_off: jnp.ndarray,  # [A, G, W] offset clocks
+    p2b_off: jnp.ndarray,  # [A, G, W] offset clocks
+    last_send: jnp.ndarray,  # [G, W] absolute ticks
+    mask: jnp.ndarray,  # [G] bool: groups repairing now
+    learned: jnp.ndarray,  # [A, G] bool: acceptors whose Phase1b arrived
+    lat: jnp.ndarray,  # [A, G, W] re-send latencies (clock dtype)
+    t: jnp.ndarray,  # [] current tick
+):
+    """Masked phase-1 log repair (startPhase1, Leader.scala:409-459):
+    for every in-flight slot of a masked group, adopt the safe value —
+    the value of the max-round vote among LEARNED acceptors (safeValue,
+    Leader.scala:314-329; callers guarantee ``learned`` covers an f+1
+    read quorum) — and re-propose it to the full group. Slots with no
+    visible votes repair to noops (Leader.scala:541-575). Stale pending
+    Phase2bs clear so old-round votes can't piggyback on past arrivals.
+
+    Returns ``(slot_value, p2a_off, p2b_off, last_send)``."""
+    in_flight = (status == PROPOSED) & mask[:, None]  # [G, W]
+    vr = jnp.where(learned[:, :, None], vote_round, -1)
+    # safeValue: per slot, the value of the max-round visible vote (all
+    # votes in one round carry the same value, so any argmax tie-break
+    # is safe).
+    best = jnp.argmax(vr, axis=0)
+    voted_value = jnp.take_along_axis(vote_value, best[None, :, :], axis=0)[0]
+    any_vote = jnp.any(vr >= 0, axis=0)  # [G, W]
+    safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
+    new_slot_value = jnp.where(in_flight, safe_value, slot_value)
+    new_p2a = jnp.where(in_flight[None, :, :], lat, p2a_off)
+    new_p2b = jnp.where(in_flight[None, :, :], INF16, p2b_off)
+    new_last_send = jnp.where(in_flight, t, last_send)
+    return new_slot_value, new_p2a, new_p2b, new_last_send
+
+
+def _p1_promise_kernel(
+    t_ref,  # SMEM (1,)
+    status_ref,  # [BG, W] int8
+    vr_ref,  # [A, BG, W]
+    vv_ref,  # [A, BG, W]
+    sv_ref,  # [BG, W]
+    p2a_ref,  # [A, BG, W]
+    p2b_ref,  # [A, BG, W]
+    ls_ref,  # [BG, W]
+    mask_ref,  # [BG] int8
+    learned_ref,  # [A, BG] int8
+    lat_ref,  # [A, BG, W]
+    out_sv_ref,
+    out_p2a_ref,
+    out_p2b_ref,
+    out_ls_ref,
+):
+    t = t_ref[0]
+    A = vr_ref.shape[0]
+    in_flight = (status_ref[:] == PROPOSED) & (mask_ref[:][:, None] != 0)
+    # First-max scan over the tiny acceptor axis: strict > keeps the
+    # FIRST max, matching the reference's argmax tie-break exactly.
+    best_r = jnp.where(
+        learned_ref[0][:, None] != 0, vr_ref[0], -1
+    )
+    best_v = vv_ref[0]
+    for a in range(1, A):
+        vr_a = jnp.where(learned_ref[a][:, None] != 0, vr_ref[a], -1)
+        upd = vr_a > best_r
+        best_r = jnp.where(upd, vr_a, best_r)
+        best_v = jnp.where(upd, vv_ref[a], best_v)
+    safe_value = jnp.where(best_r >= 0, best_v, NOOP_VALUE)
+    out_sv_ref[:] = jnp.where(in_flight, safe_value, sv_ref[:])
+    out_ls_ref[:] = jnp.where(in_flight, t, ls_ref[:])
+    for a in range(A):
+        out_p2a_ref[a] = jnp.where(in_flight, lat_ref[a], p2a_ref[a])
+        out_p2b_ref[a] = jnp.where(in_flight, INF16, p2b_ref[a])
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_p1_promise(
+    status,
+    vote_round,
+    vote_value,
+    slot_value,
+    p2a_off,
+    p2b_off,
+    last_send,
+    mask,
+    learned,
+    lat,
+    t,
+    block: int = 256,
+    interpret: bool = False,
+):
+    """Fused :func:`reference_p1_promise`: the safe-value argmax, the
+    noop fallback, and all three [A, G, W] re-send writes in one
+    VMEM-resident pass."""
+    from jax.experimental import pallas as pl
+
+    A, G, W = vote_round.shape
+    bg, pad = balanced_block(G, block)
+    if pad:
+        status = pad_axis(status, 0, pad)
+        vote_round = pad_axis(vote_round, 1, pad)
+        vote_value = pad_axis(vote_value, 1, pad)
+        slot_value = pad_axis(slot_value, 0, pad)
+        p2a_off = pad_axis(p2a_off, 1, pad)
+        p2b_off = pad_axis(p2b_off, 1, pad)
+        last_send = pad_axis(last_send, 0, pad)
+        mask = pad_axis(mask, 0, pad)
+        learned = pad_axis(learned, 1, pad)
+        lat = pad_axis(lat, 1, pad)
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
+    spec2 = pl.BlockSpec((A, bg), lambda i: (0, i))
+    spec_g = pl.BlockSpec((bg,), lambda i: (i,))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret)),
+            spec_gw,  # status
+            spec3,  # vote_round
+            spec3,  # vote_value
+            spec_gw,  # slot_value
+            spec3,  # p2a
+            spec3,  # p2b
+            spec_gw,  # last_send
+            spec_g,  # mask
+            spec2,  # learned
+            spec3,  # lat
+        ],
+        out_specs=[spec_gw, spec3, spec3, spec_gw],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((Gp, W), slot_value.dtype),
+        jax.ShapeDtypeStruct((A, Gp, W), p2a_off.dtype),
+        jax.ShapeDtypeStruct((A, Gp, W), p2b_off.dtype),
+        jax.ShapeDtypeStruct((Gp, W), last_send.dtype),
+    ]
+    sv, p2a, p2b, ls = pl.pallas_call(
+        _p1_promise_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        status,
+        vote_round,
+        vote_value,
+        slot_value,
+        p2a_off,
+        p2b_off,
+        last_send,
+        mask.astype(jnp.int8),
+        learned.astype(jnp.int8),
+        lat,
+    )
+    if pad:
+        sv, p2a, p2b, ls = sv[:G], p2a[:, :G], p2b[:, :G], ls[:G]
+    return sv, p2a, p2b, ls
+
+
+# ---------------------------------------------------------------------------
+# Plane: multipaxos_dispatch (tick steps 2-5: choose, watermark, propose,
+# retry)
+# ---------------------------------------------------------------------------
+
+
+def reference_mp_dispatch(
+    status,  # [G, W] int8
+    slot_value,  # [G, W]
+    propose_tick,  # [G, W] absolute ticks
+    last_send,  # [G, W] absolute ticks
+    chosen_tick,  # [G, W] absolute ticks
+    chosen_round,  # [G, W] round dtype
+    chosen_value,  # [G, W]
+    replica_arrival,  # [G, W] absolute ticks
+    p2a_off,  # [A, G, W] offset clocks
+    p2b_off,  # [A, G, W] offset clocks
+    vote_round,  # [A, G, W]
+    vote_value,  # [A, G, W]
+    nvotes,  # [G, W] int32 (vote-plane output)
+    head,  # [G]
+    next_slot,  # [G]
+    leader_round,  # [G]
+    cap,  # [G] int32: proposal budget (all gates except window space)
+    retry_ok,  # [G] bool: retries allowed (owner alive, not reconfiguring)
+    send_ok,  # [A, G, W] bool: thrifty quorum member AND delivered
+    retry_deliv,  # [A, G, W] bool: retry fault-delivery mask
+    p2a_lat,  # [A, G, W] clock dtype
+    retry_lat,  # [A, G, W] clock dtype
+    rep_lat,  # [G, W] int32
+    t,  # [] current tick
+    *,
+    f: int,
+    retry_timeout: int,
+    num_groups: int,
+):
+    """Tick steps 2-5 of multipaxos_batched as one plane: quorum ->
+    Chosen (ProxyLeader.handlePhase2b), commit-latency capture, the
+    contiguous-prefix commit-watermark advance (Replica.executeLog,
+    Replica.scala:394-453) with all retire-clears, leader proposals
+    into the freed window (Leader.scala:331-407) with their Phase2a
+    fan-out, and timeout resends. [G]-space control (proposal caps,
+    retry gates) is decided OUTSIDE and enters via ``cap``/``retry_ok``.
+
+    Returns a 21-tuple; see the wrapper for the order."""
+    G, W = num_groups, status.shape[1]
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+    chosen_tick = jnp.where(newly_chosen, t, chosen_tick)
+    chosen_round = jnp.where(newly_chosen, leader_round[:, None], chosen_round)
+    chosen_value = jnp.where(newly_chosen, slot_value, chosen_value)
+    replica_arrival = jnp.where(newly_chosen, t + rep_lat, replica_arrival)
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    latency = jnp.where(newly_chosen, t - propose_tick, 0)
+
+    ord_of_pos = (w_iota[None, :] - head[:, None]) % W  # [G, W]
+    executable = (
+        (status == CHOSEN)
+        & (replica_arrival <= t)
+        & (ord_of_pos < (next_slot - head)[:, None])
+    )
+    n_retire, retire_mask = ring_retire_pos(executable, ord_of_pos)
+    new_head = head + n_retire
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    slot_value = jnp.where(retire_mask, NO_VALUE, slot_value)
+    chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
+    chosen_round = jnp.where(retire_mask, -1, chosen_round)
+    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
+    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
+    propose_tick = jnp.where(retire_mask, INF, propose_tick)
+    last_send = jnp.where(retire_mask, INF, last_send)
+    p2a_off = jnp.where(retire_mask[None, :, :], INF16, p2a_off)
+    p2b_off = jnp.where(retire_mask[None, :, :], INF16, p2b_off)
+    vote_round = jnp.where(retire_mask[None, :, :], -1, vote_round)
+    vote_value = jnp.where(retire_mask[None, :, :], NO_VALUE, vote_value)
+
+    space = W - (next_slot - new_head)  # [G]
+    count = jnp.minimum(cap, space)
+    delta = (w_iota[None, :] - next_slot[:, None]) % W
+    is_new = delta < count[:, None]
+    new_next = next_slot + count
+    status = jnp.where(is_new, PROPOSED, status)
+    g_ids = jnp.arange(G, dtype=jnp.int32)[:, None]
+    new_value = ((next_slot[:, None] + delta) * G + g_ids) & 0x7FFFFFFF
+    slot_value = jnp.where(is_new, new_value, slot_value)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    p2a_off = jnp.where(is_new[None, :, :] & send_ok, p2a_lat, p2a_off)
+
+    timed_out = (
+        (status == PROPOSED)
+        & (t - last_send >= retry_timeout)
+        & retry_ok[:, None]
+    )
+    p2a_off = jnp.where(timed_out[None, :, :] & retry_deliv, retry_lat, p2a_off)
+    last_send = jnp.where(timed_out, t, last_send)
+    return (
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a_off, p2b_off, vote_round, vote_value,
+        new_head, new_next, count, n_retire,
+        newly_chosen, retire_mask, is_new, timed_out, latency,
+    )
+
+
+def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
+    def kernel(
+        t_ref,  # SMEM (1,)
+        status_ref, sv_ref, pt_ref, ls_ref,  # [BG, W]
+        ct_ref, cr_ref, cv_ref, ra_ref,  # [BG, W]
+        p2a_ref, p2b_ref, vr_ref, vv_ref,  # [A, BG, W]
+        nv_ref, rep_lat_ref,  # [BG, W]
+        head_ref, next_ref, lr_ref, cap_ref, rok_ref,  # [BG]
+        sok_ref, rdel_ref, p2a_lat_ref, retry_lat_ref,  # [A, BG, W]
+        out_status, out_sv, out_pt, out_ls,
+        out_ct, out_cr, out_cv, out_ra,
+        out_p2a, out_p2b, out_vr, out_vv,
+        out_head, out_next, out_count, out_nret,
+        out_newly, out_retire, out_isnew, out_timed, out_lat,
+    ):
+        import jax.lax as lax
+        from jax.experimental import pallas as pl
+
+        t = t_ref[0]
+        A = p2a_ref.shape[0]
+        status = status_ref[:]
+        nvotes = nv_ref[:]
+        head = head_ref[:]
+        next_slot = next_ref[:]
+        newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+        ct = jnp.where(newly_chosen, t, ct_ref[:])
+        cr = jnp.where(newly_chosen, lr_ref[:][:, None], cr_ref[:])
+        cv = jnp.where(newly_chosen, sv_ref[:], cv_ref[:])
+        ra = jnp.where(newly_chosen, t + rep_lat_ref[:], ra_ref[:])
+        status = jnp.where(newly_chosen, CHOSEN, status)
+        out_lat[:] = jnp.where(newly_chosen, t - pt_ref[:], 0)
+
+        w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
+        ord_of_pos = (w_iota - head[:, None]) % W
+        executable = (
+            (status == CHOSEN)
+            & (ra <= t)
+            & (ord_of_pos < (next_slot - head)[:, None])
+        )
+        blocked = jnp.where(executable, W, ord_of_pos)
+        n_retire = jnp.min(blocked, axis=1)  # [BG]
+        retire_mask = ord_of_pos < n_retire[:, None]
+        out_nret[:] = n_retire
+        new_head = head + n_retire
+        out_head[:] = new_head
+
+        status = jnp.where(retire_mask, EMPTY, status)
+        sv = jnp.where(retire_mask, NO_VALUE, sv_ref[:])
+        out_ct[:] = jnp.where(retire_mask, INF_I, ct)
+        out_cr[:] = jnp.where(retire_mask, -1, cr)
+        out_cv[:] = jnp.where(retire_mask, NO_VALUE, cv)
+        out_ra[:] = jnp.where(retire_mask, INF_I, ra)
+        pt = jnp.where(retire_mask, INF_I, pt_ref[:])
+        ls = jnp.where(retire_mask, INF_I, ls_ref[:])
+
+        space = W - (next_slot - new_head)
+        count = jnp.minimum(cap_ref[:], space)
+        out_count[:] = count
+        delta = (w_iota - next_slot[:, None]) % W
+        is_new = delta < count[:, None]
+        out_next[:] = next_slot + count
+        status = jnp.where(is_new, PROPOSED, status)
+        base = pl.program_id(0) * bg
+        g_ids = base + lax.broadcasted_iota(jnp.int32, (bg, W), 0)
+        new_value = (
+            (next_slot[:, None] + delta) * num_groups + g_ids
+        ) & 0x7FFFFFFF
+        sv = jnp.where(is_new, new_value, sv)
+        pt = jnp.where(is_new, t, pt)
+        ls = jnp.where(is_new, t, ls)
+
+        timed_out = (
+            (status == PROPOSED)
+            & (t - ls >= retry_timeout)
+            & (rok_ref[:][:, None] != 0)
+        )
+        out_status[:] = status
+        out_sv[:] = sv
+        out_pt[:] = pt
+        out_ls[:] = jnp.where(timed_out, t, ls)
+        out_newly[:] = newly_chosen.astype(jnp.int8)
+        out_retire[:] = retire_mask.astype(jnp.int8)
+        out_isnew[:] = is_new.astype(jnp.int8)
+        out_timed[:] = timed_out.astype(jnp.int8)
+
+        for a in range(A):
+            p2a = jnp.where(retire_mask, INF16, p2a_ref[a])
+            p2a = jnp.where(is_new & (sok_ref[a] != 0), p2a_lat_ref[a], p2a)
+            p2a = jnp.where(
+                timed_out & (rdel_ref[a] != 0), retry_lat_ref[a], p2a
+            )
+            out_p2a[a] = p2a
+            out_p2b[a] = jnp.where(retire_mask, INF16, p2b_ref[a])
+            out_vr[a] = jnp.where(retire_mask, -1, vr_ref[a])
+            out_vv[a] = jnp.where(retire_mask, NO_VALUE, vv_ref[a])
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block", "interpret", "f", "retry_timeout", "num_groups",
+    ),
+)
+def fused_mp_dispatch(
+    status, slot_value, propose_tick, last_send,
+    chosen_tick, chosen_round, chosen_value, replica_arrival,
+    p2a_off, p2b_off, vote_round, vote_value,
+    nvotes, head, next_slot, leader_round, cap, retry_ok,
+    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+    block: int = 256,
+    interpret: bool = False,
+    f: int = 1,
+    retry_timeout: int = 16,
+    num_groups: int = 1,
+):
+    """Fused :func:`reference_mp_dispatch`: choose + watermark + clears
+    + propose + retry in one VMEM-resident pass per group block."""
+    from jax.experimental import pallas as pl
+
+    A, G, W = p2a_off.shape
+    bg, pad = balanced_block(G, block)
+    gw = [
+        status, slot_value, propose_tick, last_send, chosen_tick,
+        chosen_round, chosen_value, replica_arrival, nvotes, rep_lat,
+    ]
+    agw = [
+        p2a_off, p2b_off, vote_round, vote_value, send_ok, retry_deliv,
+        p2a_lat, retry_lat,
+    ]
+    gv = [head, next_slot, leader_round, cap, retry_ok]
+    if pad:
+        gw = [pad_axis(x, 0, pad) for x in gw]
+        agw = [pad_axis(x, 1, pad) for x in agw]
+        gv = [pad_axis(x, 0, pad) for x in gv]
+    (status, slot_value, propose_tick, last_send, chosen_tick,
+     chosen_round, chosen_value, replica_arrival, nvotes, rep_lat) = gw
+    (p2a_off, p2b_off, vote_round, vote_value, send_ok, retry_deliv,
+     p2a_lat, retry_lat) = agw
+    head, next_slot, leader_round, cap, retry_ok = gv
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
+    spec_g = pl.BlockSpec((bg,), lambda i: (i,))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=(
+            [pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret))]
+            + [spec_gw] * 8  # status..replica_arrival
+            + [spec3] * 4  # p2a, p2b, vote_round, vote_value
+            + [spec_gw] * 2  # nvotes, rep_lat
+            + [spec_g] * 5  # head, next_slot, leader_round, cap, retry_ok
+            + [spec3] * 4  # send_ok, retry_deliv, p2a_lat, retry_lat
+        ),
+        out_specs=(
+            [spec_gw] * 8
+            + [spec3] * 4
+            + [spec_g] * 4  # head, next, count, n_retire
+            + [spec_gw] * 5  # newly, retire, is_new, timed_out, latency
+        ),
+    )
+    i8 = jnp.int8
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct((Gp, W), status.dtype),
+            jax.ShapeDtypeStruct((Gp, W), slot_value.dtype),
+            jax.ShapeDtypeStruct((Gp, W), propose_tick.dtype),
+            jax.ShapeDtypeStruct((Gp, W), last_send.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_tick.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_round.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_value.dtype),
+            jax.ShapeDtypeStruct((Gp, W), replica_arrival.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), p2a_off.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), p2b_off.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), vote_round.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), vote_value.dtype),
+            jax.ShapeDtypeStruct((Gp,), head.dtype),
+            jax.ShapeDtypeStruct((Gp,), next_slot.dtype),
+            jax.ShapeDtypeStruct((Gp,), jnp.int32),  # count
+            jax.ShapeDtypeStruct((Gp,), jnp.int32),  # n_retire
+        ]
+        + [jax.ShapeDtypeStruct((Gp, W), i8)] * 4
+        + [jax.ShapeDtypeStruct((Gp, W), jnp.int32)]  # latency
+    )
+    kernel = _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a_off, p2b_off, vote_round, vote_value,
+        nvotes, rep_lat,
+        head, next_slot, leader_round, cap, retry_ok.astype(i8),
+        send_ok.astype(i8), retry_deliv.astype(i8), p2a_lat, retry_lat,
+    )
+    if pad:
+        outs = [
+            x[:, :G] if x.ndim == 3 else x[:G] for x in outs
+        ]
+    (status, slot_value, propose_tick, last_send,
+     chosen_tick, chosen_round, chosen_value, replica_arrival,
+     p2a_off, p2b_off, vote_round, vote_value,
+     new_head, new_next, count, n_retire,
+     newly, retire, is_new, timed, latency) = outs
+    return (
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a_off, p2b_off, vote_round, vote_value,
+        new_head, new_next, count, n_retire,
+        newly.astype(bool), retire.astype(bool), is_new.astype(bool),
+        timed.astype(bool), latency,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Registration
+# ---------------------------------------------------------------------------
+
+registry.register(
+    registry.Plane(
+        name="multipaxos_vote_quorum",
+        backend="multipaxos",
+        reference=reference_vote_quorum,
+        kernel=fused_vote_quorum,
+        key_of=lambda args: args[0].shape,  # (A, G, W)
+        batch_axis=1,  # grids over G
+        default_block=256,
+    )
+)
+
+registry.register(
+    registry.Plane(
+        name="multipaxos_p1_promise",
+        backend="multipaxos",
+        reference=reference_p1_promise,
+        kernel=fused_p1_promise,
+        key_of=lambda args: args[1].shape,  # vote_round: (A, G, W)
+        batch_axis=1,  # grids over G
+        default_block=256,
+    )
+)
+
+registry.register(
+    registry.Plane(
+        name="multipaxos_dispatch",
+        backend="multipaxos",
+        reference=reference_mp_dispatch,
+        kernel=fused_mp_dispatch,
+        key_of=lambda args: args[8].shape,  # p2a_off: (A, G, W)
+        batch_axis=1,  # grids over G
+        default_block=256,
+    )
+)

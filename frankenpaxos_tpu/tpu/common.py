@@ -9,6 +9,12 @@ import jax.numpy as jnp
 
 INF = jnp.int32(2**30)
 
+# Sentinel for int16 DELTA-ENCODED clocks (see DTYPE_CLOCK below): the
+# "never arrives" value of an offset clock. A plain Python int so that
+# comparisons/writes stay weakly typed (bit-identical on the
+# widen_state() int32 reference path).
+INF16 = 2**15 - 1
+
 LAT_BINS = 64  # histogram bins for latency stats (in ticks)
 
 # ---------------------------------------------------------------------------
@@ -44,6 +50,33 @@ LAT_BINS = 64  # histogram bins for latency stats (in ticks)
 DTYPE_STATUS = jnp.int8
 DTYPE_ROUND = jnp.int16
 DTYPE_COUNT = jnp.int16
+#   * DTYPE_CLOCK (int16) — per-message arrival clocks stored as
+#     WRAP-SAFE OFFSETS from the tick counter instead of absolute
+#     ticks. An offset clock holds "arrival - t" (0 = arrives this
+#     tick, positive = future, bounded by lat_max + jitter ≪ 2^15),
+#     INF16 = never. Every tick the whole array ages by one via
+#     age_clock(), saturating at CLOCK_FLOOR so "already arrived"
+#     (offset <= 0) is stable under arbitrarily long runs — the
+#     wrap-safe scheme ROADMAP PR 1 follow-up (a) asked for. This
+#     halves the bytes of the largest [A, G, W] arrival arrays; the
+#     aging pass is one fused elementwise op on a bandwidth-bound
+#     sweep that just got half as many bytes to move.
+DTYPE_CLOCK = jnp.int16
+
+# Offsets of already-arrived messages saturate here (only the sign —
+# "arrived" — is ever tested again; -1 keeps `offset == 0` meaning
+# "arrives exactly now" unambiguous).
+CLOCK_FLOOR = -1
+
+
+def age_clock(off: jnp.ndarray) -> jnp.ndarray:
+    """Advance an offset clock by one tick: real offsets decrement
+    (saturating at CLOCK_FLOOR), the INF16 sentinel is preserved. All
+    arithmetic is weakly typed, so the widen_state() int32 reference
+    path replays bit-identically."""
+    return jnp.where(
+        off == INF16, INF16, jnp.maximum(off - 1, CLOCK_FLOOR)
+    ).astype(off.dtype)
 
 
 def widen_state(state):

@@ -47,6 +47,10 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     bit_latency,
 )
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -84,6 +88,12 @@ class BatchedCraqConfig:
     # pending-set conservation invariants hold throughout.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # Kernel-layer dispatch policy (ops/registry.py): the chain
+    # propagate/ack plane (tick steps 1-2) routes through
+    # ops.registry.dispatch. Partitioned plans keep the in-tick
+    # hop-deferral path (the kernel does not model heal buffering —
+    # see ops/craq.py).
+    kernels: KernelPolicy = KernelPolicy()
 
     def __post_init__(self):
         assert self.num_chains >= 1
@@ -94,6 +104,7 @@ class BatchedCraqConfig:
             assert self.read_window >= 2 * self.reads_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         self.faults.validate(axis=self.chain_len)
+        self.kernels.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -230,53 +241,90 @@ def tick(
     write_lat_sum = state.write_lat_sum
     write_lat_hist = state.write_lat_hist
 
-    # ---- 1. DOWN arrivals (ChainNode._process_write_batch): a non-tail
-    # node adds the write to its pending set (dirty count) and forwards;
-    # the tail applies, replies to the client, and starts the ack.
-    arrive_down = (w_status == W_DOWN) & (w_arrival == t)
-    at_mid = arrive_down & (w_node < tail)
-    at_tail = arrive_down & (w_node == tail)
-    wslot = w_node * KV + state.w_key  # [N, W] flattened (node, key)
-    node_dirty_flat = node_dirty_flat.at[n_rows_w, wslot].add(
-        at_mid.astype(jnp.int32)
-    )
-    node_version_flat = node_version_flat.at[n_rows_w, wslot].max(
-        jnp.where(at_tail, state.w_version, -1)
-    )
-    # Tail reply: the write is done from the client's view one hop later.
-    wlat = jnp.where(at_tail, t + hop_lat_w - state.w_issue, 0)
-    writes_done = writes_done + jnp.sum(at_tail)
-    write_lat_sum = write_lat_sum + jnp.sum(wlat)
-    wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
-    write_lat_hist = write_lat_hist + jax.ops.segment_sum(
-        at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
-    )
-    # Advance: mid-chain writes head to the next node; the tail's ack
-    # heads back to node L-2.
-    w_node = jnp.where(at_mid, w_node + 1, w_node)
-    w_node = jnp.where(at_tail, tail - 1, w_node)
-    w_status = jnp.where(at_tail, W_UP, w_status)
-    w_arrival = jnp.where(
-        arrive_down, _hop(t + hop_lat_w, w_node), w_arrival
-    )
+    # ---- 1+2. The chain propagate/ack plane (ChainNode._process_write_
+    # batch + ChainNode._handle_ack): DOWN writes join pending sets and
+    # forward, the tail applies + replies + starts the ack, UP acks
+    # apply locally and propagate, the head ack retires the ring slot.
+    # One registry plane (ops/craq.py) on lossless/healed links: the
+    # kernel recasts the four scatters as one-hot accumulations in one
+    # VMEM-resident pass. Partitioned plans keep the in-tick path below
+    # — its `_hop` defers hops into cut nodes to the heal tick, a
+    # data-dependent rewrite the kernel does not model.
+    if not fp.has_partition:
+        (
+            w_status,
+            w_node,
+            w_arrival,
+            node_dirty_flat,
+            node_version_flat,
+            at_tail,
+            wlat,
+        ) = ops_registry.dispatch(
+            "craq_chain",
+            cfg,
+            w_status,
+            state.w_key,
+            state.w_version,
+            w_node,
+            w_arrival,
+            state.w_issue,
+            node_dirty_flat,
+            node_version_flat,
+            hop_lat_w,
+            t,
+            tail=tail,
+            num_keys=KV,
+        )
+        writes_done = writes_done + jnp.sum(at_tail)
+        write_lat_sum = write_lat_sum + jnp.sum(wlat)
+        wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
+        write_lat_hist = write_lat_hist + jax.ops.segment_sum(
+            at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
+        )
+    else:
+        arrive_down = (w_status == W_DOWN) & (w_arrival == t)
+        at_mid = arrive_down & (w_node < tail)
+        at_tail = arrive_down & (w_node == tail)
+        wslot = w_node * KV + state.w_key  # [N, W] flattened (node, key)
+        node_dirty_flat = node_dirty_flat.at[n_rows_w, wslot].add(
+            at_mid.astype(jnp.int32)
+        )
+        node_version_flat = node_version_flat.at[n_rows_w, wslot].max(
+            jnp.where(at_tail, state.w_version, -1)
+        )
+        # Tail reply: the write is done for the client one hop later.
+        wlat = jnp.where(at_tail, t + hop_lat_w - state.w_issue, 0)
+        writes_done = writes_done + jnp.sum(at_tail)
+        write_lat_sum = write_lat_sum + jnp.sum(wlat)
+        wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
+        write_lat_hist = write_lat_hist + jax.ops.segment_sum(
+            at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
+        )
+        # Advance: mid-chain writes head to the next node; the tail's
+        # ack heads back to node L-2.
+        w_node = jnp.where(at_mid, w_node + 1, w_node)
+        w_node = jnp.where(at_tail, tail - 1, w_node)
+        w_status = jnp.where(at_tail, W_UP, w_status)
+        w_arrival = jnp.where(
+            arrive_down, _hop(t + hop_lat_w, w_node), w_arrival
+        )
 
-    # ---- 2. UP (ack) arrivals (ChainNode._handle_ack): apply the write
-    # locally, drop it from the pending set, and keep propagating; the
-    # ack reaching the head retires the ring slot.
-    arrive_up = (w_status == W_UP) & (w_arrival == t)
-    uslot = w_node * KV + state.w_key
-    node_version_flat = node_version_flat.at[n_rows_w, uslot].max(
-        jnp.where(arrive_up, state.w_version, -1)
-    )
-    node_dirty_flat = node_dirty_flat.at[n_rows_w, uslot].add(
-        -arrive_up.astype(jnp.int32)
-    )
-    retire = arrive_up & (w_node == 0)
-    w_status = jnp.where(retire, W_EMPTY, w_status)
-    w_arrival = jnp.where(retire, INF, w_arrival)
-    keep_up = arrive_up & ~retire
-    w_node = jnp.where(keep_up, w_node - 1, w_node)
-    w_arrival = jnp.where(keep_up, _hop(t + hop_lat_w, w_node), w_arrival)
+        arrive_up = (w_status == W_UP) & (w_arrival == t)
+        uslot = w_node * KV + state.w_key
+        node_version_flat = node_version_flat.at[n_rows_w, uslot].max(
+            jnp.where(arrive_up, state.w_version, -1)
+        )
+        node_dirty_flat = node_dirty_flat.at[n_rows_w, uslot].add(
+            -arrive_up.astype(jnp.int32)
+        )
+        retire = arrive_up & (w_node == 0)
+        w_status = jnp.where(retire, W_EMPTY, w_status)
+        w_arrival = jnp.where(retire, INF, w_arrival)
+        keep_up = arrive_up & ~retire
+        w_node = jnp.where(keep_up, w_node - 1, w_node)
+        w_arrival = jnp.where(
+            keep_up, _hop(t + hop_lat_w, w_node), w_arrival
+        )
 
     # ---- 3. Reads (apportioned queries, ChainNode._process_read_batch).
     r_status = state.r_status

@@ -42,6 +42,10 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -80,6 +84,11 @@ class BatchedMenciusConfig:
     # after a heal); crash/revive stops a dead leader's stripe (skips
     # catch it up after revival). FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # Kernel-layer dispatch policy (ops/registry.py): the per-slot
+    # vote/skip aggregation plane (tick steps 1-2) routes through
+    # ops.registry.dispatch — fused Pallas on TPU, pure-jnp reference
+    # elsewhere under the default "auto" mode.
+    kernels: KernelPolicy = KernelPolicy()
 
     @property
     def group_size(self) -> int:
@@ -95,6 +104,7 @@ class BatchedMenciusConfig:
         assert 0 <= self.num_idle_leaders < self.num_leaders
         assert self.skip_threshold >= 1
         self.faults.validate(axis=self.group_size)
+        self.kernels.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -214,18 +224,22 @@ def tick(
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)
 
-    # ---- 1. Acceptors vote on Phase2a arrivals (no competing rounds in
-    # the steady-state Mencius write path: each leader owns its stripe).
-    arrived = state.p2a_arrival == t
-    voted = state.voted | arrived
-    p2b_arrival = jnp.where(
-        arrived & p2b_delivered,
-        jnp.minimum(state.p2b_arrival, t + p2b_lat),
+    # ---- 1+2. Acceptors vote on Phase2a arrivals (no competing rounds
+    # in the steady-state Mencius write path: each leader owns its
+    # stripe), Phase2b replies schedule, and the per-slot quorum count
+    # sums the acceptor axis — one registry plane (ops/mencius.py):
+    # fused VMEM-resident Pallas on TPU, the pure-jnp reference (the
+    # exact program this tick ran before the fusion) elsewhere.
+    voted, p2b_arrival, nvotes = ops_registry.dispatch(
+        "mencius_vote",
+        cfg,
+        state.p2a_arrival,
+        state.voted,
         state.p2b_arrival,
+        p2b_lat,
+        p2b_delivered,
+        t,
     )
-
-    # ---- 2. Quorum counting (f+1 of the stripe's group).
-    nvotes = jnp.sum((p2b_arrival <= t) & voted, axis=2)
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
     chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
     replica_arrival = jnp.where(newly_chosen, t + rep_lat, state.replica_arrival)

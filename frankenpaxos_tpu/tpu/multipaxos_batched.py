@@ -50,17 +50,26 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import (
+    DTYPE_CLOCK,
     DTYPE_COUNT,
     DTYPE_ROUND,
     DTYPE_STATUS,
     INF,
+    INF16,
     LAT_BINS,
+    age_clock,
     bit_delivered,
     bit_latency,
-    ring_retire_pos,
     sample_latency,
     sample_quorum,
 )
+# Submodule import (not `from frankenpaxos_tpu.ops import ...` package
+# attrs): ops/__init__ imports tpu.common, whose package init imports
+# the backends — attribute access on the half-initialized ops package
+# would be a circular-import error, while the registry submodule loads
+# cleanly from either entry point.
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -90,6 +99,13 @@ RC_NORMAL = 0
 RC_MATCHING = 1  # MatchA sent; awaiting an f+1 MatchB quorum
 RC_PHASE1 = 2  # Phase1a sent to the OLD config; awaiting f+1 Phase1bs
 
+# Saturation floor of the head-relative acc_max_slot delta (the
+# wrap-safe half of ROADMAP PR 1 follow-up (a)): an acceptor that has
+# not voted within the last 2^14 retired slots of its group
+# reconstructs as head - 2^14 — old enough that the MaxSlot wave max
+# ignores it unless every sampled quorum member is equally stale.
+AMS_FLOOR = -(2**14)
+
 
 @dataclasses.dataclass(frozen=True)
 class BatchedMultiPaxosConfig:
@@ -107,10 +123,16 @@ class BatchedMultiPaxosConfig:
     # Closed workload: stop proposing once each group has allocated this
     # many slots (None = open workload, propose forever).
     max_slots_per_group: Optional[int] = None
-    # Route tick steps 1-2 (acceptor votes + quorum count) through the
-    # fused Pallas kernel (ops.fused_vote_quorum). On non-TPU backends the
-    # kernel runs in interpret mode (slow but bit-identical), keeping CPU
-    # tests meaningful.
+    # Kernel-layer dispatch policy (ops/registry.py): every hot plane of
+    # the tick — vote/quorum, phase-1 promise aggregation, and the
+    # choose/watermark/propose/retry dispatch plane — routes through
+    # ops.dispatch, which picks the fused Pallas kernel, interpret mode,
+    # or the pure-jnp reference per this knob. The default ("auto") is
+    # Pallas on TPU backends and the reference elsewhere.
+    kernels: KernelPolicy = KernelPolicy()
+    # Legacy flags, folded into the policy by ops.registry.policy_of:
+    # use_pallas=True ⇒ mode="on" (kernel on TPU, interpret elsewhere)
+    # with pallas_block_g as the block size.
     use_pallas: bool = False
     pallas_block_g: int = 256  # group-axis block per kernel invocation
     # The read path: device-resident ReadBatchers (ReadBatcher.scala:
@@ -204,10 +226,18 @@ class BatchedMultiPaxosConfig:
         # miss + 1 must also fit, so the bound is 2**15 - 1 exclusive.
         assert self.heartbeat_timeout < 2**15 - 1
         assert 1 <= self.lat_min <= self.lat_max
+        # Offset clocks (DTYPE_CLOCK) must hold any pending arrival:
+        # lat_max plus the fault plan's jitter/penalty is the largest
+        # offset ever written (retries re-write, they don't accumulate).
+        assert (
+            self.lat_max + self.faults.jitter + self.faults.drop_penalty
+            < INF16
+        )
         assert 0.0 <= self.drop_rate < 1.0
         assert 0.0 <= self.fail_rate < 1.0
         assert 0.0 <= self.revive_rate <= 1.0
         self.faults.validate(axis=self.group_size)
+        self.kernels.validate()
         assert self.read_mode in READ_MODES
         assert self.state_machine in ("none", "kv")
         if self.state_machine == "kv":
@@ -251,10 +281,13 @@ class BatchedMultiPaxosState:
     chosen_value: jnp.ndarray  # [G, W] value the quorum chose (NO_VALUE)
     replica_arrival: jnp.ndarray  # [G, W] tick Chosen reaches replicas
 
-    # Acceptors.
+    # Acceptors. The two message planes are OFFSET CLOCKS (DTYPE_CLOCK,
+    # tpu/common.py): "arrival - t", 0 = arrives this tick, INF16 =
+    # never, aged by one each tick via age_clock — the wrap-safe int16
+    # delta encoding of the HBM pass (ROADMAP PR 1 follow-up (a)).
     acc_round: jnp.ndarray  # [A, G] per-acceptor promised round
-    p2a_arrival: jnp.ndarray  # [A, G, W] Phase2a arrival tick (INF = never)
-    p2b_arrival: jnp.ndarray  # [A, G, W] Phase2b arrival tick at counter
+    p2a_arrival: jnp.ndarray  # [A, G, W] Phase2a offset clock (INF16 = never)
+    p2b_arrival: jnp.ndarray  # [A, G, W] Phase2b offset clock at counter
     vote_round: jnp.ndarray  # [A, G, W] round of the vote (-1 = none)
     vote_value: jnp.ndarray  # [A, G, W] value of the vote (NO_VALUE = none)
 
@@ -304,13 +337,17 @@ class BatchedMultiPaxosState:
     # batch ring slots; global slot numbering is s*G + g. Per-group
     # ReadBatchers ([G, NW] rb_* arrays, sharded with the group axis)
     # ride a shared MaxSlot probe wave ([NW] + [A, G, NW] arrays).
-    acc_max_slot: jnp.ndarray  # [A, G] max per-group slot this acceptor voted
+    # acc_max_slot is DELTA-ENCODED relative to the group head (int16:
+    # votes land in [head, head+W), and the delta ages by n_retire as
+    # the head advances, saturating at AMS_FLOOR — wrap-safe like the
+    # offset clocks). Absolute slot = head + delta while unsaturated.
+    acc_max_slot: jnp.ndarray  # [A, G] head-relative max voted slot
     max_chosen_global: jnp.ndarray  # [] max global slot ever chosen (-1)
     client_watermark: jnp.ndarray  # [] client's largest-seen global slot (-1)
     wave_issue: jnp.ndarray  # [NW] wave launch tick (INF = slot free)
-    req_arrival: jnp.ndarray  # [A, G, NW] BatchMaxSlotRequest arrival (INF)
+    req_arrival: jnp.ndarray  # [A, G, NW] MaxSlotRequest offset clock (INF16)
     resp_slot: jnp.ndarray  # [A, G, NW] BatchMaxSlotReply payload (global)
-    resp_arrival: jnp.ndarray  # [A, G, NW] BatchMaxSlotReply arrival (INF)
+    resp_arrival: jnp.ndarray  # [A, G, NW] MaxSlotReply offset clock (INF16)
     rb_status: jnp.ndarray  # [G, NW] R_EMPTY | R_WAIT | R_BOUND | R_SENT
     rb_count: jnp.ndarray  # [G, NW] client reads carried by the batch
     rb_wave: jnp.ndarray  # [G, NW] wave ring slot the batch rides (-1)
@@ -344,8 +381,8 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         replica_arrival=jnp.full((G, W), INF, jnp.int32),
         acc_round=jnp.zeros((A, G), DTYPE_ROUND),
-        p2a_arrival=jnp.full((A, G, W), INF, jnp.int32),
-        p2b_arrival=jnp.full((A, G, W), INF, jnp.int32),
+        p2a_arrival=jnp.full((A, G, W), INF16, DTYPE_CLOCK),
+        p2b_arrival=jnp.full((A, G, W), INF16, DTYPE_CLOCK),
         vote_round=jnp.full((A, G, W), -1, DTYPE_ROUND),
         vote_value=jnp.full((A, G, W), NO_VALUE, jnp.int32),
         executed=jnp.zeros((G,), jnp.int32),
@@ -390,13 +427,13 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         sm_applied=jnp.zeros((), jnp.int32),
         dups_filtered=jnp.zeros((), jnp.int32),
         dups_seen=jnp.zeros((), jnp.int32),
-        acc_max_slot=jnp.full((A, G), -1, jnp.int32),
+        acc_max_slot=jnp.full((A, G), -1, jnp.int16),
         max_chosen_global=jnp.full((), -1, jnp.int32),
         client_watermark=jnp.full((), -1, jnp.int32),
         wave_issue=jnp.full((RW,), INF, jnp.int32),
-        req_arrival=jnp.full((A, G, RW), INF, jnp.int32),
+        req_arrival=jnp.full((A, G, RW), INF16, DTYPE_CLOCK),
         resp_slot=jnp.full((A, G, RW), -1, jnp.int32),
-        resp_arrival=jnp.full((A, G, RW), INF, jnp.int32),
+        resp_arrival=jnp.full((A, G, RW), INF16, DTYPE_CLOCK),
         rb_status=jnp.zeros((G, RW), DTYPE_STATUS),
         rb_count=jnp.zeros((G, RW), jnp.int32),
         rb_wave=jnp.full((G, RW), -1, jnp.int32),
@@ -472,8 +509,28 @@ def tick(
             fp, jax.random.fold_in(kf, 2), (A, G, W), retry_lat, link_up
         )
 
+    # Message-plane latencies are written into OFFSET CLOCKS, so they
+    # carry the clock dtype (values fit by the __post_init__ bound); on
+    # the widen_state() int32 reference path the cast is a no-op, so
+    # both paths replay bit-identically. rep_lat stays int32 — the
+    # Chosen->replica arrival is an absolute tick.
+    clock_dtype = state.p2a_arrival.dtype
+    p2a_lat = p2a_lat.astype(clock_dtype)
+    p2b_lat = p2b_lat.astype(clock_dtype)
+    retry_lat = retry_lat.astype(clock_dtype)
+
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
+
+    # Age the offset clocks ONCE, up front: after aging, an offset is
+    # exactly ``arrival - t`` for the current tick (0 = arrives now),
+    # the invariant every plane below tests against. Writes during this
+    # tick store raw latencies (>= lat_min >= 1), which the next tick's
+    # aging rebases — so a message written with latency L arrives
+    # exactly L ticks later, matching the absolute-clock semantics bit
+    # for bit.
+    p2a_aged = age_clock(state.p2a_arrival)
+    p2b_aged = age_clock(state.p2b_arrival)
 
     # ---- 0. Device-side failure detection + election (Participant.scala:
     # 72-209 heartbeat silence detection; ClassicRoundRobin round
@@ -482,8 +539,8 @@ def tick(
     # repair — happens inside the compiled tick; no host involvement.
     leader_round = state.leader_round
     slot_value_in = state.slot_value
-    p2a_in = state.p2a_arrival
-    p2b_in = state.p2b_arrival
+    p2a_in = p2a_aged
+    p2b_in = p2b_aged
     last_send_in = state.last_send
     leader_alive = state.leader_alive
     heartbeat_miss = state.heartbeat_miss
@@ -526,12 +583,26 @@ def tick(
         leader_round = leader_round + jnp.where(elect, delta, 0)
         heartbeat_miss = jnp.where(elect, 0, heartbeat_miss)
         elections = elections + jnp.sum(elect)
-        # Phase-1 repair for elected groups. Latency reuses the retry
-        # draw (retry_lat): repair and retry are both Phase2a re-sends
-        # and a repaired slot (last_send = t) cannot also time out this
-        # tick.
-        slot_value_in, p2a_in, p2b_in, last_send_in = _phase1_repair(
-            state, elect, t, retry_lat
+        # Phase-1 repair for elected groups — the registry's
+        # multipaxos_p1_promise plane with an all-acceptors read (the
+        # oracle-read election model: a superset of any f+1 read
+        # quorum). Latency reuses the retry draw (retry_lat): repair and
+        # retry are both Phase2a re-sends and a repaired slot
+        # (last_send = t) cannot also time out this tick.
+        slot_value_in, p2a_in, p2b_in, last_send_in = ops_registry.dispatch(
+            "multipaxos_p1_promise",
+            cfg,
+            status,
+            state.vote_round,
+            state.vote_value,
+            slot_value_in,
+            p2a_in,
+            p2b_in,
+            last_send_in,
+            elect,
+            jnp.ones((A, G), bool),
+            retry_lat,
+            t,
         )
         # Post-election owner liveness gates proposals and retries below
         # (a dead leader proposes nothing; Leader.scala inactive state).
@@ -639,10 +710,11 @@ def tick(
             p2a_in,
             p2b_in,
             last_send_in,
-        ) = _phase1_repair_arrays(
+        ) = ops_registry.dispatch(
+            "multipaxos_p1_promise",
+            cfg,
             status, vote_round_in, vote_value_in, slot_value_in,
-            p2a_in, p2b_in, last_send_in, p1_done, t, retry_lat,
-            learned=learned,
+            p2a_in, p2b_in, last_send_in, p1_done, learned, retry_lat, t,
         )
         in_flight_rc = (status == PROPOSED) & p1_done[:, None]  # [G, W]
         vote_round_in = jnp.where(in_flight_rc[None, :, :], -1, vote_round_in)
@@ -685,87 +757,130 @@ def tick(
     # on vote, promise the round and schedule the Phase2b arrival. Then
     # quorum counting (ProxyLeader.handlePhase2b, ProxyLeader.scala:217-258):
     # a slot is chosen when f+1 Phase2bs for the current round have arrived
-    # — a sum over the acceptor axis.
-    if cfg.use_pallas:
-        # One fused VMEM-resident pass: every [A, G, W] array is read from
-        # HBM exactly once for the whole vote + quorum-count phase.
-        from frankenpaxos_tpu import ops
+    # — a sum over the acceptor axis. One registry plane: the fused Pallas
+    # kernel reads every [A, G, W] array from HBM exactly once, dtype-native
+    # (int16 offset clocks, int16 rounds — no boundary casts); the reference
+    # twin is the exact pure-jnp program this tick ran before the fusion.
+    # The sixth output counts the Phase2b sends (the vote predicate is
+    # plane-internal; telemetry needs it exact on every path).
+    (
+        vote_round,
+        vote_value,
+        p2b_arrival,
+        new_acc_round,
+        nvotes,
+        ns_plane,
+    ) = ops_registry.dispatch(
+        "multipaxos_vote_quorum",
+        cfg,
+        p2a_in,
+        acc_round_in,
+        leader_round,
+        slot_value_in,
+        vote_round_in,
+        vote_value_in,
+        p2b_in,
+        p2b_lat,
+        p2b_delivered,
+    )
+    p2b_sends = jnp.sum(ns_plane)
 
-        # The kernel is int32-only; round arrays widen at this boundary
-        # and narrow back on the way out (values are unchanged — rounds
-        # fit DTYPE_ROUND by policy), keeping the XLA and Pallas paths
-        # bit-identical.
-        (
-            vote_round,
-            vote_value,
-            p2b_arrival,
-            new_acc_round,
-            nvotes,
-            ns_kernel,
-        ) = ops.fused_vote_quorum(
-            p2a_in,
-            acc_round_in.astype(jnp.int32),
-            leader_round.astype(jnp.int32),
-            slot_value_in,
-            vote_round_in.astype(jnp.int32),
-            vote_value_in,
-            p2b_in,
-            p2b_lat,
-            p2b_delivered,
-            t,
-            block_g=cfg.pallas_block_g,
-            # Compile for real TPU backends ("tpu", or "axon" on tunneled
-            # v5e pods); interpret everywhere else (CPU CI, GPU).
-            interpret=jax.default_backend() not in ("tpu", "axon"),
+    # ---- 2-5. The dispatch plane (quorum -> Chosen, the commit-watermark
+    # advance with its retire-clears, leader proposals with their Phase2a
+    # fan-out, and timeout resends) fuses into one registry plane. The
+    # [G]-space CONTROL decisions — proposal caps under elections /
+    # reconfiguration / closed workloads, retry gates, thrifty quorum
+    # membership — are decided HERE and enter as tiny per-group vectors,
+    # so every feature composes with the fused kernel unchanged.
+    cap = jnp.full((G,), cfg.slots_per_tick, jnp.int32)
+    if cfg.max_slots_per_group is not None:
+        cap = jnp.minimum(
+            cap, jnp.maximum(cfg.max_slots_per_group - state.next_slot, 0)
         )
-        vote_round = vote_round.astype(vote_round_in.dtype)
-        new_acc_round = new_acc_round.astype(acc_round_in.dtype)
-        # The kernel's Phase2b-send counter (ROADMAP PR 2 follow-up (a)):
-        # the vote predicate is kernel-internal, so without this output
-        # the phase-2 message accounting under use_pallas would miss the
-        # acceptor->leader plane entirely.
-        p2b_sends = jnp.sum(ns_kernel)
+    retry_ok = jnp.ones((G,), bool)
+    if owner_alive_now is not None:
+        # A dead leader proposes nothing and resends nothing
+        # (Leader.scala inactive state) until an election installs a
+        # live owner.
+        cap = jnp.where(owner_alive_now, cap, 0)
+        retry_ok = retry_ok & owner_alive_now
+    if cfg.reconfigure_every:
+        # A reconfiguring group stalls proposals (the churn throughput
+        # dip) and old-round resends while phase 1 drains the old config.
+        rc_normal = recon_phase == RC_NORMAL
+        cap = jnp.where(rc_normal, cap, 0)
+        retry_ok = retry_ok & rc_normal
+    # Thrifty quorum selection (ThriftySystem / ProxyLeader.scala:187-197):
+    # Phase2a goes to f+1 random acceptors of the slot's group. f==1 draws
+    # from the always-generated bits2 sweep (bits_extra is all-zeros when
+    # drop_rate == 0 and f == 1); general f ranks bits_extra fields [8:24)
+    # (disjoint from its p2a drop field [0:8)).
+    if cfg.thrifty:
+        bits_q = bits2[None] if f == 1 else bits_extra
+        in_quorum = sample_quorum(bits_q, 8, f, A)
     else:
-        arrived = p2a_in == t  # [A, G, W]
-        msg_round = leader_round[None, :, None]  # one round in flight
-        may_vote = arrived & (msg_round >= acc_round_in[:, :, None])
-        new_acc_round = jnp.maximum(
-            acc_round_in, jnp.max(jnp.where(may_vote, msg_round, -1), axis=2)
-        )
-        vote_round = jnp.where(may_vote, msg_round, vote_round_in)
-        # The vote carries the slot's currently proposed value
-        # (Acceptor.scala:184-220 votes for the Phase2a's value).
-        vote_value = jnp.where(
-            may_vote, slot_value_in[None, :, :], vote_value_in
-        )
-        p2b_send_mask = may_vote & p2b_delivered
-        p2b_arrival = jnp.where(
-            p2b_send_mask,
-            jnp.minimum(p2b_in, t + p2b_lat),
-            p2b_in,
-        )
-        votes_in = (p2b_arrival <= t) & (
-            vote_round == leader_round[None, :, None]
-        )
-        nvotes = jnp.sum(votes_in, axis=0)  # [G, W]
-        # Same Phase2b-send count the kernel path reports (masks are
-        # already live here; XLA fuses this into the vote pass), so the
-        # two paths stay bit-identical including telemetry.
-        p2b_sends = jnp.sum(p2b_send_mask)
-
-    newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
-    chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
-    chosen_round = jnp.where(
-        newly_chosen, leader_round[:, None], state.chosen_round
+        in_quorum = jnp.ones((A, G, W), bool)
+    send_ok = in_quorum & p2a_delivered
+    retry_deliv = (
+        retry_delivered
+        if retry_delivered is not None
+        else jnp.ones((A, G, W), bool)
     )
-    chosen_value = jnp.where(newly_chosen, slot_value_in, state.chosen_value)
-    replica_arrival = jnp.where(
-        newly_chosen, t + rep_lat, state.replica_arrival
+    (
+        status,
+        slot_value,
+        propose_tick,
+        last_send,
+        chosen_tick,
+        chosen_round,
+        chosen_value,
+        replica_arrival,
+        p2a_arrival,
+        p2b_arrival,
+        vote_round,
+        vote_value,
+        head,
+        next_slot,
+        count,
+        n_retire,
+        newly_chosen,
+        retire_mask,
+        is_new,
+        timed_out,
+        latency,
+    ) = ops_registry.dispatch(
+        "multipaxos_dispatch",
+        cfg,
+        status,
+        slot_value_in,
+        state.propose_tick,
+        last_send_in,
+        state.chosen_tick,
+        state.chosen_round,
+        state.chosen_value,
+        state.replica_arrival,
+        p2a_in,
+        p2b_arrival,
+        vote_round,
+        vote_value,
+        nvotes,
+        state.head,
+        state.next_slot,
+        leader_round,
+        cap,
+        retry_ok,
+        send_ok,
+        retry_deliv,
+        p2a_lat,
+        retry_lat,
+        rep_lat,
+        t,
+        f=f,
+        retry_timeout=cfg.retry_timeout,
+        num_groups=G,
     )
-    status = jnp.where(newly_chosen, CHOSEN, status)
 
-    # Commit latency stats.
-    latency = jnp.where(newly_chosen, t - state.propose_tick, 0)
+    # Commit latency stats (from the plane's newly_chosen/latency masks).
     n_new = jnp.sum(newly_chosen)
     committed = state.committed + n_new
     lat_sum = state.lat_sum + jnp.sum(latency)
@@ -773,20 +888,7 @@ def tick(
     lat_hist = state.lat_hist + jax.ops.segment_sum(
         newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
     )
-
-    # ---- 3. Replica execution (Replica.executeLog, Replica.scala:394-453):
-    # retire the contiguous prefix of chosen slots whose Chosen has reached
-    # the replicas. Computed entirely in RING-POSITION space — a position's
-    # ordinal from head is (pos - head) % W, and the run length is the
-    # minimum ordinal whose slot is not yet executable (no gather).
     ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
-    executable = (
-        (status == CHOSEN)
-        & (replica_arrival <= t)
-        & (ord_of_pos < (state.next_slot - state.head)[:, None])
-    )
-    n_retire, retire_mask = ring_retire_pos(executable, ord_of_pos)
-    head = state.head + n_retire
     executed = state.executed + n_retire
     retired_total = state.retired + jnp.sum(n_retire)
 
@@ -816,7 +918,11 @@ def tick(
     dups_seen = state.dups_seen
     if cfg.state_machine == "kv":
         NC, KV = cfg.num_clients, cfg.kv_keys
-        cmd = chosen_value  # [G, W] pre-clear ring values
+        # The dispatch plane already retire-cleared the ring, so the
+        # retiring commands are reconstructed from its masks: a retired
+        # slot's pre-clear chosen_value is this tick's proposal value if
+        # it was chosen this tick, else the carried chosen_value.
+        cmd = jnp.where(newly_chosen, slot_value_in, state.chosen_value)
         real = retire_mask & (cmd >= 0)  # noops don't touch the SM
         client = jnp.where(real, (cmd // G) % NC, 0)
         last = jnp.take_along_axis(ct_last, client, axis=1)
@@ -879,59 +985,19 @@ def tick(
         dups_seen = dups_seen + jnp.sum(retire_mask & slot_is_dup & (cmd >= 0))
         slot_is_dup = slot_is_dup & ~retire_mask
 
-    status = jnp.where(retire_mask, EMPTY, status)
-    slot_value = jnp.where(retire_mask, NO_VALUE, slot_value_in)
-    chosen_tick = jnp.where(retire_mask, INF, chosen_tick)
-    chosen_round = jnp.where(retire_mask, -1, chosen_round)
-    chosen_value = jnp.where(retire_mask, NO_VALUE, chosen_value)
-    replica_arrival = jnp.where(retire_mask, INF, replica_arrival)
-    propose_tick = jnp.where(retire_mask, INF, state.propose_tick)
-    last_send = jnp.where(retire_mask, INF, last_send_in)
-    p2a_arrival = jnp.where(retire_mask[None, :, :], INF, p2a_in)
-    p2b_arrival = jnp.where(retire_mask[None, :, :], INF, p2b_arrival)
-    vote_round = jnp.where(retire_mask[None, :, :], -1, vote_round)
-    vote_value = jnp.where(retire_mask[None, :, :], NO_VALUE, vote_value)
-
-    # ---- 4. Leader proposes new slots (Leader.processClientRequestBatch,
-    # Leader.scala:331-407): fill up to K fresh ring slots if the window
-    # has room. Positions are (next_slot + i) % W; computed elementwise.
-    space = W - (state.next_slot - head)  # [G]
-    count = jnp.minimum(cfg.slots_per_tick, space)  # [G]
-    if cfg.max_slots_per_group is not None:
-        count = jnp.minimum(
-            count,
-            jnp.maximum(cfg.max_slots_per_group - state.next_slot, 0),
-        )
-    if owner_alive_now is not None:
-        # A dead leader proposes nothing (Leader.scala inactive state);
-        # the group resumes once an election installs a live owner.
-        count = jnp.where(owner_alive_now, count, 0)
-    if cfg.reconfigure_every:
-        # A reconfiguring group stalls new proposals until the new
-        # configuration is installed (the churn throughput dip).
-        count = jnp.where(recon_phase == RC_NORMAL, count, 0)
-    delta = (w_iota[None, :] - state.next_slot[:, None]) % W  # [G, W]
-    is_new = delta < count[:, None]  # [G, W]
-    next_slot = state.next_slot + count
-
-    status = jnp.where(is_new, PROPOSED, status)
-    # The value is the global command sequence number: group g's slot s
-    # carries command s*G + g, mirroring a leader assigning arriving
-    # commands to slots round-robin over groups (slot % G partitioning).
-    # Masked into [0, 2^31) so an open-workload run that overflows int32
-    # wraps to a non-negative id instead of aliasing the NO_VALUE/
-    # NOOP_VALUE sentinels (ids stay unique across any in-flight window).
     group_ids = jnp.arange(G, dtype=jnp.int32)[:, None]  # [G, 1]
-    new_value = ((state.next_slot[:, None] + delta) * G + group_ids) & jnp.int32(
-        0x7FFFFFFF
-    )
     if cfg.state_machine == "kv":
-        # Commands round-robin over client pseudonyms; a dup proposal
-        # re-issues the client's LATEST id (the reference client re-sends
-        # its one outstanding op, ClientMain.scala:190-323 pseudonyms) as
-        # of the last tick boundary. last_issued advances only on fresh
-        # proposals, so chained retries keep re-issuing the same id.
+        # Dup injection rides AFTER the dispatch plane: commands
+        # round-robin over client pseudonyms, and a dup proposal
+        # re-issues the client's LATEST id (the reference client
+        # re-sends its one outstanding op, ClientMain.scala:190-323
+        # pseudonyms) as of the last tick boundary. Only slot_value
+        # changes — the plane's Phase2a sends carry no value, so the
+        # override composes with the fused kernel exactly. last_issued
+        # advances only on fresh proposals, so chained retries keep
+        # re-issuing the same id.
         NC = cfg.num_clients
+        delta = (w_iota[None, :] - state.next_slot[:, None]) % W  # [G, W]
         new_client = jnp.where(
             is_new, (state.next_slot[:, None] + delta) % NC, 0
         )
@@ -941,49 +1007,14 @@ def tick(
             is_dup = is_new & dup_draw & (prior >= 0)
         else:
             is_dup = jnp.zeros((G, W), bool)
-        new_value = jnp.where(is_dup, prior, new_value)
+        slot_value = jnp.where(is_dup, prior, slot_value)
         slot_is_dup = jnp.where(is_new, is_dup, slot_is_dup)
         g_mat4 = jnp.broadcast_to(
             jnp.arange(G, dtype=jnp.int32)[:, None], (G, W)
         )
         client_last_issued = client_last_issued.at[g_mat4, new_client].max(
-            jnp.where(is_new & ~is_dup, new_value, -1)
+            jnp.where(is_new & ~is_dup, slot_value, -1)
         )
-    slot_value = jnp.where(is_new, new_value, slot_value)
-    propose_tick = jnp.where(is_new, t, propose_tick)
-    last_send = jnp.where(is_new, t, last_send)
-
-    # Thrifty quorum selection (ThriftySystem / ProxyLeader.scala:187-197):
-    # Phase2a goes to f+1 random acceptors of the slot's group. f==1 draws
-    # from the always-generated bits2 sweep (bits_extra is all-zeros when
-    # drop_rate == 0 and f == 1); general f ranks bits_extra fields [8:24)
-    # (disjoint from its p2a drop field [0:8)).
-    if cfg.thrifty:
-        bits_q = bits2[None] if f == 1 else bits_extra
-        in_quorum = sample_quorum(bits_q, 8, f, A)
-    else:
-        in_quorum = jnp.ones((A, G, W), bool)
-    send_p2a = is_new[None, :, :] & in_quorum & p2a_delivered
-    p2a_arrival = jnp.where(send_p2a, t + p2a_lat, p2a_arrival)
-
-    # ---- 5. Retries (the resend timers of the reference): a slot still
-    # PROPOSED after retry_timeout re-sends Phase2a to the FULL group —
-    # including acceptors that already voted: their Phase2b may have been
-    # the dropped message, and re-voting (step 1) re-samples its delivery.
-    timed_out = (status == PROPOSED) & (t - last_send >= cfg.retry_timeout)
-    if owner_alive_now is not None:
-        timed_out = timed_out & owner_alive_now[:, None]  # dead: no resends
-    if cfg.reconfigure_every:
-        # No old-round resends while phase 1 drains the old config.
-        timed_out = timed_out & (recon_phase == RC_NORMAL)[:, None]
-    resend = timed_out[None, :, :]
-    if retry_delivered is not None:
-        # Fault plan: retried Phase2as are individually droppable /
-        # partition-cut too; last_send still advances (the leader SENT —
-        # delivery failed), so the next timeout fires a fresh resend.
-        resend = resend & retry_delivered
-    p2a_arrival = jnp.where(resend, t + retry_lat, p2a_arrival)
-    last_send = jnp.where(timed_out, t, last_send)
 
     # ---- 6. Reads: device-resident ReadBatchers (ReadBatcher.scala:
     # 239-338 batching, Acceptor.scala:239-252 handleBatchMaxSlotRequest,
@@ -1018,30 +1049,41 @@ def tick(
     read_lin_violations = state.read_lin_violations
     if cfg.read_rate:
         NW = cfg.read_window
+        # The read-wave planes are offset clocks like the write planes:
+        # age once so 0 means "arrives now".
+        req_arrival = age_clock(req_arrival)
+        resp_arrival = age_clock(resp_arrival)
         kr_a, kr_b = jax.random.split(k_read)
         bits_r = jax.random.bits(kr_a, (A, G, NW))  # [0:8) req lat,
         #                       [8:16) resp lat, [16:32) quorum sampling
         bits_rg = jax.random.bits(kr_b, (G, NW))  # [0:8) batch reply lat
-        req_lat = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max)
-        resp_lat = bit_latency(bits_r, 8, cfg.lat_min, cfg.lat_max)
+        req_lat = bit_latency(bits_r, 0, cfg.lat_min, cfg.lat_max).astype(
+            clock_dtype
+        )
+        resp_lat = bit_latency(bits_r, 8, cfg.lat_min, cfg.lat_max).astype(
+            clock_dtype
+        )
         reply_lat = bit_latency(bits_rg, 0, cfg.lat_min, cfg.lat_max)
 
         # (a) Acceptor bookkeeping: a vote on per-group slot s raises that
         # acceptor's maxVotedSlot (Acceptor.scala:222-237 serves it from
         # vote state). Votes happened against the PRE-retire ring —
-        # ord_of_pos from step 3 is exactly that (it uses state.head).
-        # NOTE: under use_pallas this recomputes the vote predicate
-        # outside the kernel (one extra HBM pass over p2a_arrival when
-        # reads are on); folding acc_max_slot into the kernel outputs
-        # would restore the single-pass property — XLA-path runs (the
-        # production path here) fuse this with step 3 anyway.
-        may_vote_r = (p2a_in == t) & (
+        # ord_of_pos is exactly that (it uses state.head), and the
+        # HEAD-RELATIVE delta of a vote at ordinal o is simply o.
+        # NOTE: this recomputes the vote predicate outside the vote
+        # plane (one extra pass over p2a_arrival when reads are on);
+        # folding acc_max_slot into the kernel outputs would restore the
+        # single-pass property — reference-path runs fuse this anyway.
+        may_vote_r = (p2a_in == 0) & (
             leader_round[None, :, None] >= acc_round_in[:, :, None]
         )
         slot_of_pos = state.head[:, None] + ord_of_pos  # [G, W] per-group slot
         acc_max_slot = jnp.maximum(
             acc_max_slot,
-            jnp.max(jnp.where(may_vote_r, slot_of_pos[None, :, :], -1), axis=2),
+            jnp.max(
+                jnp.where(may_vote_r, ord_of_pos[None, :, :], AMS_FLOOR),
+                axis=2,
+            ).astype(acc_max_slot.dtype),
         )
         # Global floor for the linearizability check: the largest global
         # slot chosen so far (any read issued after this point must bind
@@ -1052,16 +1094,16 @@ def tick(
         )
 
         # (b) BatchMaxSlotReplies: requests arriving now read the
-        # acceptor's updated max voted slot in GLOBAL numbering; replies
-        # travel back (Acceptor.scala:239-252).
-        req_now = req_arrival == t  # [A, G, NW]
+        # acceptor's updated max voted slot in GLOBAL numbering (delta +
+        # the group head it is relative to); replies travel back
+        # (Acceptor.scala:239-252).
+        req_now = req_arrival == 0  # [A, G, NW]
         g_row = jnp.arange(G, dtype=jnp.int32)[None, :]  # [1, G]
-        global_acc = jnp.where(
-            acc_max_slot >= 0, acc_max_slot * G + g_row, -1
-        )  # [A, G]
+        abs_max = acc_max_slot + state.head[None, :]  # [A, G] int32
+        global_acc = jnp.where(abs_max >= 0, abs_max * G + g_row, -1)
         resp_slot = jnp.where(req_now, global_acc[:, :, None], resp_slot)
-        resp_arrival = jnp.where(req_now, t + resp_lat, resp_arrival)
-        req_arrival = jnp.where(req_now, INF, req_arrival)  # consumed
+        resp_arrival = jnp.where(req_now, resp_lat, resp_arrival)
+        req_arrival = jnp.where(req_now, INF16, req_arrival)  # consumed
 
         # (c) Wave completion + bind: once every sampled acceptor of a
         # wave has replied, ALL batches riding that wave bind to the max
@@ -1069,13 +1111,13 @@ def tick(
         # quorum per group is Client.scala:851-933's bind rule). The
         # wave slot frees immediately — its lifetime is <= 2*lat_max,
         # which __post_init__ guarantees is under the ring period.
-        any_outstanding = jnp.any(req_arrival < INF, axis=(0, 1))  # [NW]
+        any_outstanding = jnp.any(req_arrival != INF16, axis=(0, 1))  # [NW]
         any_pending = jnp.any(
-            (resp_arrival < INF) & (resp_arrival > t), axis=(0, 1)
+            (resp_arrival != INF16) & (resp_arrival > 0), axis=(0, 1)
         )
         wave_ready = (wave_issue < INF) & ~any_outstanding & ~any_pending
         wave_val = jnp.max(
-            jnp.where(resp_arrival < INF, resp_slot, -1), axis=(0, 1)
+            jnp.where(resp_arrival != INF16, resp_slot, -1), axis=(0, 1)
         )  # [NW]
         # Batches ride the wave recorded at their formation (rb_wave);
         # batch ring rows and wave ring slots are decoupled so a batch
@@ -1091,7 +1133,9 @@ def tick(
         rb_status = jnp.where(bind_now, R_BOUND, rb_status)
         wave_issue = jnp.where(wave_ready, INF, wave_issue)
         resp_slot = jnp.where(wave_ready[None, None, :], -1, resp_slot)
-        resp_arrival = jnp.where(wave_ready[None, None, :], INF, resp_arrival)
+        resp_arrival = jnp.where(
+            wave_ready[None, None, :], INF16, resp_arrival
+        )
 
         # (d) Completion: a batch's reply leaves once the executed
         # watermark passes its target (Replica.scala:407-412 drains
@@ -1152,7 +1196,7 @@ def tick(
             launch = wslot & (wave_issue == INF)  # [NW]
             in_rq = sample_quorum(bits_r, 16, f, A)
             send_req = launch[None, None, :] & in_rq
-            req_arrival = jnp.where(send_req, t + req_lat, req_arrival)
+            req_arrival = jnp.where(send_req, req_lat, req_arrival)
             wave_issue = jnp.where(launch, t, wave_issue)
             rb_wave = jnp.where(can_batch, jnp.mod(t, NW), rb_wave)
             rb_status = jnp.where(can_batch, R_WAIT, rb_status)
@@ -1167,6 +1211,14 @@ def tick(
             rb_target = jnp.where(can_batch, -1, rb_target)
             rb_status = jnp.where(can_batch, R_BOUND, rb_status)
 
+        # (f) Rebase the head-relative deltas: this tick retired
+        # n_retire slots per group, so every delta shifts down with the
+        # head it is measured from, saturating at AMS_FLOOR (stale
+        # acceptors age out of the MaxSlot max instead of wrapping).
+        acc_max_slot = jnp.maximum(
+            acc_max_slot - n_retire[None, :], AMS_FLOOR
+        ).astype(acc_max_slot.dtype)
+
     # ---- 7. Telemetry (tpu/telemetry.py contract): every count is an
     # int32 reduction of a mask/counter the tick already computed for
     # its own bookkeeping, so with the default ring this adds register
@@ -1176,7 +1228,7 @@ def tick(
     n_proposed = jnp.sum(count)  # [G]-space
     n_retries = jnp.sum(timed_out)
     if cfg.drop_rate > 0.0 or fp.messages_active:
-        phase2_sends = jnp.sum(send_p2a)
+        phase2_sends = jnp.sum(is_new[None, :, :] & send_ok)
         p2a_drops = jnp.sum(
             is_new[None, :, :] & in_quorum & ~p2a_delivered
         )
@@ -1275,71 +1327,6 @@ def tick(
     )
 
 
-def _phase1_repair_arrays(
-    status: jnp.ndarray,  # [G, W]
-    vote_round: jnp.ndarray,  # [A, G, W]
-    vote_value: jnp.ndarray,  # [A, G, W]
-    slot_value: jnp.ndarray,  # [G, W]
-    p2a_arrival: jnp.ndarray,  # [A, G, W]
-    p2b_arrival: jnp.ndarray,  # [A, G, W]
-    last_send: jnp.ndarray,  # [G, W]
-    mask: jnp.ndarray,  # [G] bool: groups whose new leader repairs now
-    t: jnp.ndarray,
-    lat: jnp.ndarray,  # [A, G, W] Phase2a re-send latencies
-    learned=None,  # [A, G] bool: acceptors whose Phase1b the leader HAS
-):
-    """Masked phase-1 log repair (startPhase1, Leader.scala:409-459): for
-    every in-flight slot of a masked group, adopt the safe value and
-    re-propose it to the full group in the (already bumped) new round.
-
-    With ``learned=None`` phase 1 is an oracle read of every acceptor —
-    a superset of any f+1 read quorum, so every possibly-chosen value is
-    visible (the host leader_change / election model). With a ``learned``
-    mask, only the acceptors whose Phase1b actually arrived contribute —
-    a TRUE read quorum (the Matchmaker path); the caller must guarantee
-    ``learned`` covers >= f+1 acceptors per masked group, which
-    intersects every f+1 write quorum, so every chosen value is still
-    seen (Leader.scala:314-329 safeValue). In-flight slots with no
-    visible votes are re-proposed as noops (Leader.scala:541-575).
-
-    Returns ``(slot_value, p2a_arrival, p2b_arrival, last_send)``."""
-    in_flight = (status == PROPOSED) & mask[:, None]  # [G, W]
-    vr = (
-        vote_round
-        if learned is None
-        else jnp.where(learned[:, :, None], vote_round, -1)
-    )
-    # safeValue: per slot, the value of the max-round visible vote (all
-    # votes in one round carry the same value, so any argmax tie-break is
-    # safe).
-    best = jnp.argmax(vr, axis=0)  # vote_round is -1 when unvoted
-    voted_value = jnp.take_along_axis(vote_value, best[None, :, :], axis=0)[0]
-    any_vote = jnp.any(vr >= 0, axis=0)  # [G, W]
-    safe_value = jnp.where(any_vote, voted_value, NOOP_VALUE)
-    slot_value = jnp.where(in_flight, safe_value, slot_value)
-    p2a_arrival = jnp.where(in_flight[None, :, :], t + lat, p2a_arrival)
-    # Clear stale Phase2bs of the in-flight slots: old-round votes no
-    # longer count, and keeping their arrival ticks would let a re-vote in
-    # the new round piggyback on a PAST arrival via the jnp.minimum dedup
-    # in tick step 1 (counting the same tick it is cast, biasing commit
-    # latency low).
-    p2b_arrival = jnp.where(in_flight[None, :, :], INF, p2b_arrival)
-    last_send = jnp.where(in_flight, t, last_send)
-    return slot_value, p2a_arrival, p2b_arrival, last_send
-
-
-def _phase1_repair(
-    state: BatchedMultiPaxosState,
-    mask: jnp.ndarray,
-    t: jnp.ndarray,
-    lat: jnp.ndarray,
-):
-    return _phase1_repair_arrays(
-        state.status, state.vote_round, state.vote_value, state.slot_value,
-        state.p2a_arrival, state.p2b_arrival, state.last_send, mask, t, lat,
-    )
-
-
 def leader_change(
     cfg: BatchedMultiPaxosConfig,
     state: BatchedMultiPaxosState,
@@ -1348,14 +1335,33 @@ def leader_change(
 ) -> BatchedMultiPaxosState:
     """Host-injected leader takeover (Leader.leaderChange + startPhase1,
     Leader.scala:409-459): bump every group's round and run phase-1 log
-    repair via :func:`_phase1_repair`. The device-side analog — failure
-    injection, heartbeat-miss detection, and election — runs inside
-    ``tick`` when ``cfg.fail_rate > 0``; this host API remains for tests
-    and crafted cross-validation scenarios."""
+    repair via the registry's ``multipaxos_p1_promise`` plane with an
+    all-acceptors oracle read (a superset of any f+1 read quorum). The
+    device-side analog — failure injection, heartbeat-miss detection,
+    and election — runs inside ``tick`` when ``cfg.fail_rate > 0``; this
+    host API remains for tests and crafted cross-validation scenarios."""
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
-    lat = sample_latency(cfg.lat_min, cfg.lat_max, key, (A, G, W))
-    slot_value, p2a_arrival, p2b_arrival, last_send = _phase1_repair(
-        state, jnp.ones((G,), bool), t, lat
+    # Host writes land BETWEEN ticks: the at-rest offset clocks are
+    # relative to tick t-1 (the next tick's aging rebases them), so an
+    # arrival at t + lat stores lat + 1 — preserving the absolute-clock
+    # arrival schedule exactly.
+    lat = (
+        sample_latency(cfg.lat_min, cfg.lat_max, key, (A, G, W)) + 1
+    ).astype(state.p2a_arrival.dtype)
+    slot_value, p2a_arrival, p2b_arrival, last_send = ops_registry.dispatch(
+        "multipaxos_p1_promise",
+        cfg,
+        state.status,
+        state.vote_round,
+        state.vote_value,
+        state.slot_value,
+        state.p2a_arrival,
+        state.p2b_arrival,
+        state.last_send,
+        jnp.ones((G,), bool),
+        jnp.ones((A, G), bool),
+        lat,
+        t,
     )
     return dataclasses.replace(
         state,
@@ -1436,7 +1442,8 @@ def check_invariants(
     chosen = state.status == CHOSEN
     # Chosen slots have a quorum of votes at (or, after a repair
     # re-proposal bumped vote_round, above) the round they were chosen in.
-    votes = (state.p2b_arrival <= t) & (
+    # Offset clocks: "arrived" is offset <= 0 (INF16 = never).
+    votes = (state.p2b_arrival <= 0) & (
         state.vote_round >= state.chosen_round[None, :, :]
     )
     quorum_ok = jnp.all(jnp.where(chosen, jnp.sum(votes, axis=0) >= f + 1, True))

@@ -404,6 +404,35 @@ def test_shrink_minimizes_to_a_reproducer_json(tmp_path):
     assert failing(plan2)
 
 
+def test_shrink_ddmin_minimizes_partition_side_bit_sets():
+    """Delta debugging over the cut SET: a failure that needs replicas
+    0 AND 2 cut (1, 3, 4 irrelevant). The greedy candidate list only
+    drops the LAST cut bit, so it strips 4 and 3 but then stalls at
+    {0, 1, 2} (dropping 2 passes); the ddmin pass must minimize the cut
+    to exactly {0, 2}."""
+    spec = simtest.SPECS["multipaxos"]  # predicate never runs the sim
+
+    def failing(plan: FaultPlan) -> bool:
+        ones = {i for i, s in enumerate(plan.partition) if s}
+        return {0, 2} <= ones
+
+    fat = FaultPlan(
+        partition=(1, 1, 1, 1, 1), partition_start=0, partition_heal=20
+    )
+    small = simtest.shrink(spec, fat, 0, 48, failing=failing)
+    assert [i for i, s in enumerate(small.partition) if s] == [0, 2]
+
+    # 1-minimality survives when ONLY ddmin can see it: a predicate
+    # needing the first and last replica ({0, 4}) stalls greedy
+    # immediately (dropping bit 4 passes), ddmin still minimizes.
+    def failing_ends(plan: FaultPlan) -> bool:
+        ones = {i for i, s in enumerate(plan.partition) if s}
+        return {0, 4} <= ones
+
+    small2 = simtest.shrink(spec, fat, 0, 48, failing=failing_ends)
+    assert [i for i, s in enumerate(small2.partition) if s] == [0, 4]
+
+
 def test_sweep_smoke():
     res = simtest.sweep(
         backends=["unreplicated"], schedules=1, seeds_per_schedule=2,

@@ -286,6 +286,30 @@ def test_microbench_faults_smoke():
     assert '"--faults"' in bench_src and '"faults"' in bench_src
 
 
+def test_microbench_kernels_smoke():
+    """The kernel-layer bench at toy size (guards ``microbench
+    kernels``): every registered plane reports a reference timing and —
+    off-TPU — interpret-mode BIT-PARITY with its reference twin; and
+    bench.py surfaces the kernel_policy/coverage fields."""
+    from frankenpaxos_tpu.harness import microbench
+    from frankenpaxos_tpu.ops import registry
+
+    rows = microbench.bench_kernels(
+        iters=2, A=3, G=32, W=16, N=32, L=3, KV=4, CW=8
+    )
+    cases = {r["case"] for r in rows}
+    for name in registry.PLANES:
+        assert f"{name}:reference" in cases
+    assert all(r["ops_per_sec"] > 0 for r in rows)
+
+    import pathlib
+
+    bench_src = (
+        pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    ).read_text()
+    assert '"kernel_policy"' in bench_src and '"kernel_coverage"' in bench_src
+
+
 def test_deploy_smoke_profiles_a_role(tmp_path):
     """profile_role wraps one role with cProfile and the pstats dump
     lands in the bench dir (perf_util.py capability)."""

@@ -1,0 +1,253 @@
+"""The kernel registry contract (ops/registry.py): policy resolution,
+autotune-table lookup, and — load-bearing — BIT-IDENTITY of every
+registry-dispatched backend between kernel (interpret) and reference
+modes: the same run replayed on 3 seeds under
+``KernelPolicy(mode="interpret")`` and ``KernelPolicy.reference()``
+must produce sha256-identical protocol state arrays."""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.tpu import craq_batched, mencius_batched, multipaxos_batched
+
+
+def _hash(state, fields):
+    m = hashlib.sha256()
+    for f in fields:
+        m.update(np.asarray(jax.device_get(getattr(state, f))).tobytes())
+    return m.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# KernelPolicy / registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_policy_of_folds_legacy_use_pallas():
+    cfg = multipaxos_batched.BatchedMultiPaxosConfig(
+        use_pallas=True, pallas_block_g=128
+    )
+    pol = registry.policy_of(cfg)
+    assert pol.mode == "on" and pol.block == 128
+    # Without the legacy flag the config's own policy wins.
+    cfg2 = multipaxos_batched.BatchedMultiPaxosConfig(
+        kernels=KernelPolicy(mode="interpret", block=64)
+    )
+    pol2 = registry.policy_of(cfg2)
+    assert pol2.mode == "interpret" and pol2.block == 64
+
+
+def test_resolve_mode_on_cpu():
+    mk = multipaxos_batched.BatchedMultiPaxosConfig
+    # auto -> reference off-TPU; on -> interpret; reference/off -> reference.
+    assert (
+        registry.resolve_mode("multipaxos_vote_quorum", mk()) == "reference"
+    )
+    assert (
+        registry.resolve_mode("multipaxos_vote_quorum", mk(use_pallas=True))
+        == "interpret"
+    )
+    assert (
+        registry.resolve_mode(
+            "multipaxos_vote_quorum", mk(kernels=KernelPolicy(mode="interpret"))
+        )
+        == "interpret"
+    )
+    assert (
+        registry.resolve_mode(
+            "multipaxos_vote_quorum", mk(kernels=KernelPolicy.reference())
+        )
+        == "reference"
+    )
+    # Per-plane disable forces the reference even under mode="interpret".
+    cfg = mk(
+        kernels=KernelPolicy(
+            mode="interpret", disable=("multipaxos_vote_quorum",)
+        )
+    )
+    assert registry.resolve_mode("multipaxos_vote_quorum", cfg) == "reference"
+    assert registry.resolve_mode("multipaxos_dispatch", cfg) == "interpret"
+
+
+def test_policy_validation_rejects_bad_values():
+    with pytest.raises(AssertionError):
+        multipaxos_batched.BatchedMultiPaxosConfig(
+            kernels=KernelPolicy(mode="sometimes")
+        )
+    with pytest.raises(AssertionError):
+        multipaxos_batched.BatchedMultiPaxosConfig(
+            kernels=KernelPolicy(disable=("no_such_plane",))
+        )
+
+
+def test_registry_coverage_names_all_backends():
+    cov = registry.coverage()
+    assert set(cov["multipaxos"]) == {
+        "multipaxos_vote_quorum",
+        "multipaxos_p1_promise",
+        "multipaxos_dispatch",
+    }
+    assert cov["mencius"] == ("mencius_vote",)
+    assert cov["craq"] == ("craq_chain",)
+
+
+def test_block_for_exact_nearest_and_default():
+    name = "multipaxos_vote_quorum"
+    table = registry._table()
+    exact_key = (3, 3334, 64)  # checked-in flagship entry
+    assert registry.table_key(name, exact_key) in table
+    assert registry.block_for(name, exact_key) == table[
+        registry.table_key(name, exact_key)
+    ]
+    # Nearest-G fallback: an unseen G resolves to some recorded entry,
+    # never to a crash; an unseen plane shape falls back to the default.
+    got = registry.block_for(name, (3, 3000, 64))
+    assert got > 0
+    assert (
+        registry.block_for("craq_chain", (7, 7, 7, 7))
+        == registry.PLANES["craq_chain"].default_block
+    )
+
+
+def test_write_table_merges(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    payload = registry.write_table({"x|1|2|3": 128}, path=path)
+    assert payload["blocks"]["x|1|2|3"] == 128
+    # Existing (checked-in) entries survive the merge.
+    assert any(k.startswith("multipaxos_vote_quorum|") for k in payload["blocks"])
+    registry._table.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# Mirror constants: ops must not import the backends, so their slot/value
+# codes are mirrored — pin the mirrors to the backends' truth.
+# ---------------------------------------------------------------------------
+
+
+def test_ops_constant_mirrors_match_backends():
+    from frankenpaxos_tpu.ops import craq as ops_craq
+    from frankenpaxos_tpu.ops import multipaxos as ops_mp
+    from frankenpaxos_tpu.tpu.common import INF
+
+    assert ops_mp.EMPTY == multipaxos_batched.EMPTY
+    assert ops_mp.PROPOSED == multipaxos_batched.PROPOSED
+    assert ops_mp.CHOSEN == multipaxos_batched.CHOSEN
+    assert ops_mp.NO_VALUE == multipaxos_batched.NO_VALUE
+    assert ops_mp.NOOP_VALUE == multipaxos_batched.NOOP_VALUE
+    assert ops_mp.INF_I == int(INF)
+    assert ops_craq.W_EMPTY == craq_batched.W_EMPTY
+    assert ops_craq.W_DOWN == craq_batched.W_DOWN
+    assert ops_craq.W_UP == craq_batched.W_UP
+    assert ops_craq.INF_I == int(INF)
+
+
+# ---------------------------------------------------------------------------
+# Interpret-vs-reference bit-identity per dispatched backend (3 seeds,
+# sha256 over the protocol state arrays)
+# ---------------------------------------------------------------------------
+
+MP_FIELDS = (
+    "status", "slot_value", "chosen_round", "chosen_value", "head",
+    "next_slot", "acc_round", "vote_round", "vote_value", "p2a_arrival",
+    "p2b_arrival", "committed", "retired", "lat_sum", "lat_hist",
+)
+MENCIUS_FIELDS = (
+    "status", "slot_value", "head", "next_slot", "committed_prefix",
+    "voted", "p2a_arrival", "p2b_arrival", "committed", "skips",
+)
+CRAQ_FIELDS = (
+    "w_status", "w_node", "w_arrival", "w_version", "node_dirty",
+    "node_version", "writes_done", "reads_done", "r_status",
+)
+
+
+def _run_both(mod, make_cfg, ticks, seed, fields):
+    hashes = {}
+    for pol in (KernelPolicy(mode="interpret"), KernelPolicy.reference()):
+        cfg = make_cfg(pol)
+        st, _ = mod.run_ticks(
+            cfg, mod.init_state(cfg), jnp.zeros((), jnp.int32), ticks,
+            jax.random.PRNGKey(seed),
+        )
+        hashes[pol.mode] = _hash(st, fields)
+    return hashes
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_multipaxos_interpret_matches_reference(seed):
+    mp = multipaxos_batched
+
+    def make_cfg(pol):
+        # Elections + drops exercise all three planes (vote/quorum,
+        # p1 repair, dispatch) through the registry.
+        return mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=3, window=8, slots_per_tick=2, lat_min=1,
+            lat_max=3, drop_rate=0.1, retry_timeout=6,
+            device_elections=True, fail_rate=0.02, heartbeat_timeout=4,
+            kernels=pol,
+        )
+
+    hashes = _run_both(mp, make_cfg, 30, seed, MP_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_mencius_interpret_matches_reference(seed):
+    me = mencius_batched
+
+    def make_cfg(pol):
+        return me.BatchedMenciusConfig(
+            f=1, num_leaders=3, window=8, slots_per_tick=2, idle_rate=0.2,
+            drop_rate=0.1, retry_timeout=6, kernels=pol,
+        )
+
+    hashes = _run_both(me, make_cfg, 30, seed, MENCIUS_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_craq_interpret_matches_reference(seed):
+    cr = craq_batched
+
+    def make_cfg(pol):
+        return cr.BatchedCraqConfig(
+            num_chains=3, chain_len=3, num_keys=4, window=8,
+            writes_per_tick=2, reads_per_tick=2, read_window=8,
+            kernels=pol,
+        )
+
+    hashes = _run_both(cr, make_cfg, 30, seed, CRAQ_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+def test_craq_partitioned_plan_routes_to_reference():
+    """A partition plan must not reach the kernel (it does not model
+    heal deferral): the registry reports reference mode, and the run
+    matches the same config in explicit reference mode bit for bit."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    cr = craq_batched
+    plan = FaultPlan(
+        partition=(0, 0, 1), partition_start=5, partition_heal=15
+    )
+
+    def make_cfg(pol):
+        return cr.BatchedCraqConfig(
+            num_chains=3, chain_len=3, num_keys=4, window=8,
+            writes_per_tick=2, reads_per_tick=0, read_window=8,
+            faults=plan, kernels=pol,
+        )
+
+    assert (
+        registry.resolve_mode("craq_chain", make_cfg(KernelPolicy("interpret")))
+        == "reference"
+    )
+    hashes = _run_both(cr, make_cfg, 25, 0, CRAQ_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]

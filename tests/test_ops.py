@@ -1,6 +1,7 @@
-"""Pallas kernel tests (interpret mode on CPU): the fused acceptor-step
-kernel must match its pure-jnp specification bit for bit, and the spec
-must match the live tick's vote/quorum phase."""
+"""Kernel-suite tests (interpret mode on CPU): every fused Pallas
+kernel must match its pure-jnp reference twin bit for bit on random
+dtype-policy states, and the vote/quorum reference must match the live
+tick's vote phase."""
 
 import jax
 import jax.numpy as jnp
@@ -9,35 +10,60 @@ import pytest
 
 from frankenpaxos_tpu.ops import (
     INF,
+    INF16,
+    fused_craq_chain,
+    fused_mencius_vote,
+    fused_mp_dispatch,
+    fused_p1_promise,
     fused_vote_quorum,
+    reference_craq_chain,
+    reference_mencius_vote,
+    reference_mp_dispatch,
+    reference_p1_promise,
     reference_vote_quorum,
 )
 
+I16 = jnp.int16
+I8 = jnp.int8
 
-def random_state(key, A=3, G=8, W=16, t=7):
-    ks = jax.random.split(key, 8)
-    p2a = jnp.where(
-        jax.random.uniform(ks[0], (A, G, W)) < 0.3,
-        jax.random.randint(ks[1], (A, G, W), t - 2, t + 3),
-        INF,
-    )
-    acc_round = jax.random.randint(ks[2], (A, G), 0, 3)
-    leader_round = jax.random.randint(ks[3], (G,), 0, 3)
-    slot_value = jax.random.randint(ks[4], (G, W), 0, 1000)
-    vote_round = jax.random.randint(ks[5], (A, G, W), -1, 3)
+
+def _assert_trees_equal(ref, got, names=None):
+    ref = jax.tree_util.tree_leaves(ref)
+    got = jax.tree_util.tree_leaves(got)
+    assert len(ref) == len(got)
+    names = names or [str(i) for i in range(len(ref))]
+    for name, r, g in zip(names, ref, got):
+        r, g = np.asarray(r), np.asarray(g)
+        assert r.dtype == g.dtype, f"{name}: {r.dtype} != {g.dtype}"
+        np.testing.assert_array_equal(r, g, err_msg=name)
+
+
+def _clock(key, shape, p=0.3):
+    """Random offset clock: INF16 = never, else an offset in [-1, 5)."""
+    ks = jax.random.split(key, 2)
+    return jnp.where(
+        jax.random.uniform(ks[0], shape) < p,
+        jax.random.randint(ks[1], shape, -1, 5),
+        INF16,
+    ).astype(I16)
+
+
+def vote_quorum_args(key, A=3, G=8, W=16):
+    ks = jax.random.split(key, 10)
+    p2a = _clock(ks[0], (A, G, W))
+    acc_round = jax.random.randint(ks[1], (A, G), 0, 3).astype(I16)
+    leader_round = jax.random.randint(ks[2], (G,), 0, 3).astype(I16)
+    slot_value = jax.random.randint(ks[3], (G, W), 0, 1000)
+    vote_round = jax.random.randint(ks[4], (A, G, W), -1, 3).astype(I16)
     vote_value = jnp.where(
-        vote_round >= 0, jax.random.randint(ks[6], (A, G, W), 0, 1000), -1
+        vote_round >= 0, jax.random.randint(ks[5], (A, G, W), 0, 1000), -1
     )
-    p2b = jnp.where(
-        vote_round >= 0,
-        jax.random.randint(ks[7], (A, G, W), t - 3, t + 4),
-        INF,
-    )
-    lat = jax.random.randint(jax.random.fold_in(key, 9), (A, G, W), 1, 4)
-    delivered = jax.random.uniform(jax.random.fold_in(key, 10), (A, G, W)) < 0.9
+    p2b = jnp.where(vote_round >= 0, _clock(ks[6], (A, G, W), p=0.7), INF16)
+    lat = jax.random.randint(ks[7], (A, G, W), 1, 4).astype(I16)
+    delivered = jax.random.uniform(ks[8], (A, G, W)) < 0.9
     return (
         p2a, acc_round, leader_round, slot_value,
-        vote_round, vote_value, p2b, lat, delivered, jnp.int32(t),
+        vote_round, vote_value, p2b, lat, delivered,
     )
 
 
@@ -45,24 +71,190 @@ def random_state(key, A=3, G=8, W=16, t=7):
 @pytest.mark.parametrize("shape", [(3, 8, 16), (5, 4, 32)])
 def test_fused_vote_quorum_matches_reference(seed, shape):
     A, G, W = shape
-    args = random_state(jax.random.PRNGKey(seed), A=A, G=G, W=W)
+    args = vote_quorum_args(jax.random.PRNGKey(seed), A=A, G=G, W=W)
     ref = reference_vote_quorum(*args)
-    got = fused_vote_quorum(*args, block_g=G // 2, interpret=True)
-    names = [
-        "vote_round", "vote_value", "p2b_arrival", "acc_round", "nvotes",
-        "nsends",
-    ]
-    assert len(ref) == len(got) == len(names)
-    for name, r, g in zip(names, ref, got):
-        np.testing.assert_array_equal(np.asarray(r), np.asarray(g), err_msg=name)
+    got = fused_vote_quorum(*args, block=max(G // 2, 1), interpret=True)
+    _assert_trees_equal(
+        ref, got,
+        ["vote_round", "vote_value", "p2b", "acc_round", "nvotes", "nsends"],
+    )
+
+
+def p1_promise_args(key, A=3, G=8, W=16):
+    ks = jax.random.split(key, 12)
+    status = jax.random.randint(ks[0], (G, W), 0, 3).astype(I8)
+    vote_round = jax.random.randint(ks[1], (A, G, W), -1, 3).astype(I16)
+    vote_value = jnp.where(
+        vote_round >= 0, jax.random.randint(ks[2], (A, G, W), 0, 1000), -1
+    )
+    slot_value = jax.random.randint(ks[3], (G, W), 0, 1000)
+    p2a = _clock(ks[4], (A, G, W))
+    p2b = _clock(ks[5], (A, G, W))
+    last_send = jax.random.randint(ks[6], (G, W), 0, 50)
+    mask = jax.random.uniform(ks[7], (G,)) < 0.6
+    learned = jax.random.uniform(ks[8], (A, G)) < 0.7
+    lat = jax.random.randint(ks[9], (A, G, W), 1, 4).astype(I16)
+    return (
+        status, vote_round, vote_value, slot_value, p2a, p2b,
+        last_send, mask, learned, lat, jnp.int32(33),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shape", [(3, 8, 16), (5, 6, 32)])
+def test_fused_p1_promise_matches_reference(seed, shape):
+    A, G, W = shape
+    args = p1_promise_args(jax.random.PRNGKey(seed), A=A, G=G, W=W)
+    ref = reference_p1_promise(*args)
+    got = fused_p1_promise(*args, block=max(G // 2, 1), interpret=True)
+    _assert_trees_equal(
+        ref, got, ["slot_value", "p2a", "p2b", "last_send"]
+    )
+
+
+def mp_dispatch_args(key, A=3, G=8, W=16):
+    ks = jax.random.split(key, 20)
+    status = jax.random.randint(ks[0], (G, W), 0, 3).astype(I8)
+    slot_value = jnp.where(
+        status > 0, jax.random.randint(ks[1], (G, W), 0, 1000), -1
+    )
+    propose_tick = jnp.where(
+        status > 0, jax.random.randint(ks[2], (G, W), 0, 30), INF
+    )
+    last_send = jnp.where(
+        status > 0, jax.random.randint(ks[3], (G, W), 0, 33), INF
+    )
+    chosen_tick = jnp.where(
+        status == 2, jax.random.randint(ks[4], (G, W), 0, 33), INF
+    )
+    chosen_round = jnp.where(
+        status == 2, jax.random.randint(ks[5], (G, W), 0, 3), -1
+    ).astype(I16)
+    chosen_value = jnp.where(status == 2, slot_value, -1)
+    replica_arrival = jnp.where(
+        status == 2, jax.random.randint(ks[6], (G, W), 30, 40), INF
+    )
+    p2a = _clock(ks[7], (A, G, W))
+    p2b = _clock(ks[8], (A, G, W))
+    vote_round = jax.random.randint(ks[9], (A, G, W), -1, 3).astype(I16)
+    vote_value = jnp.where(
+        vote_round >= 0, jax.random.randint(ks[10], (A, G, W), 0, 1000), -1
+    )
+    nvotes = jax.random.randint(ks[11], (G, W), 0, A + 1)
+    head = jax.random.randint(ks[12], (G,), 0, 100)
+    next_slot = head + jax.random.randint(ks[13], (G,), 0, W + 1)
+    leader_round = jax.random.randint(ks[14], (G,), 0, 3).astype(I16)
+    cap = jax.random.randint(ks[15], (G,), 0, 5)
+    retry_ok = jax.random.uniform(ks[16], (G,)) < 0.8
+    send_ok = jax.random.uniform(ks[17], (A, G, W)) < 0.6
+    retry_deliv = jax.random.uniform(ks[18], (A, G, W)) < 0.9
+    kl = jax.random.split(ks[19], 3)
+    p2a_lat = jax.random.randint(kl[0], (A, G, W), 1, 4).astype(I16)
+    retry_lat = jax.random.randint(kl[1], (A, G, W), 1, 4).astype(I16)
+    rep_lat = jax.random.randint(kl[2], (G, W), 1, 4)
+    return (
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a, p2b, vote_round, vote_value,
+        nvotes, head, next_slot, leader_round, cap, retry_ok,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, jnp.int32(33),
+    )
+
+
+MP_DISPATCH_OUTS = [
+    "status", "slot_value", "propose_tick", "last_send",
+    "chosen_tick", "chosen_round", "chosen_value", "replica_arrival",
+    "p2a", "p2b", "vote_round", "vote_value",
+    "head", "next_slot", "count", "n_retire",
+    "newly_chosen", "retire_mask", "is_new", "timed_out", "latency",
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shape", [(3, 8, 16), (5, 6, 32)])
+def test_fused_mp_dispatch_matches_reference(seed, shape):
+    A, G, W = shape
+    args = mp_dispatch_args(jax.random.PRNGKey(seed), A=A, G=G, W=W)
+    statics = dict(f=1, retry_timeout=8, num_groups=G)
+    ref = reference_mp_dispatch(*args, **statics)
+    got = fused_mp_dispatch(
+        *args, block=max(G // 2, 1), interpret=True, **statics
+    )
+    _assert_trees_equal(ref, got, MP_DISPATCH_OUTS)
+
+
+def mencius_args(key, L=8, W=16, A=3, t=9):
+    ks = jax.random.split(key, 6)
+    p2a = jnp.where(
+        jax.random.uniform(ks[0], (L, W, A)) < 0.3,
+        jax.random.randint(ks[1], (L, W, A), t - 2, t + 3),
+        INF,
+    )
+    voted = jax.random.uniform(ks[2], (L, W, A)) < 0.3
+    p2b = jnp.where(
+        voted, jax.random.randint(ks[3], (L, W, A), t - 3, t + 4), INF
+    )
+    lat = jax.random.randint(ks[4], (L, W, A), 1, 4)
+    delivered = jax.random.uniform(ks[5], (L, W, A)) < 0.9
+    return p2a, voted, p2b, lat, delivered, jnp.int32(t)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shape", [(8, 16, 3), (6, 32, 5)])
+def test_fused_mencius_vote_matches_reference(seed, shape):
+    L, W, A = shape
+    args = mencius_args(jax.random.PRNGKey(seed), L=L, W=W, A=A)
+    ref = reference_mencius_vote(*args)
+    got = fused_mencius_vote(*args, block=max(L // 2, 1), interpret=True)
+    _assert_trees_equal(ref, got, ["voted", "p2b", "nvotes"])
+
+
+def craq_args(key, N=8, L=3, KV=4, W=8, t=9):
+    tail = L - 1
+    ks = jax.random.split(key, 8)
+    w_status = jax.random.randint(ks[0], (N, W), 0, 3).astype(I8)
+    w_key = jax.random.randint(ks[1], (N, W), 0, KV)
+    w_version = jax.random.randint(ks[2], (N, W), 0, 50)
+    w_node = jnp.where(
+        w_status == 2,  # UP acks live on nodes [0, tail)
+        jax.random.randint(ks[3], (N, W), 0, max(tail, 1)),
+        jax.random.randint(ks[3], (N, W), 0, tail + 1),
+    )
+    w_arrival = jnp.where(
+        w_status > 0, jax.random.randint(ks[4], (N, W), t - 1, t + 3), INF
+    )
+    w_issue = jax.random.randint(ks[5], (N, W), 0, t)
+    dirty = jax.random.randint(ks[6], (N, L * KV), 0, 3)
+    version = jax.random.randint(ks[7], (N, L * KV), -1, 40)
+    hop_lat = jax.random.randint(jax.random.fold_in(key, 9), (N, W), 1, 4)
+    return (
+        w_status, w_key, w_version, w_node, w_arrival, w_issue,
+        dirty, version, hop_lat, jnp.int32(t),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("dims", [(8, 3, 4, 8), (6, 4, 3, 16)])
+def test_fused_craq_chain_matches_reference(seed, dims):
+    N, L, KV, W = dims
+    args = craq_args(jax.random.PRNGKey(seed), N=N, L=L, KV=KV, W=W)
+    statics = dict(tail=L - 1, num_keys=KV)
+    ref = reference_craq_chain(*args, **statics)
+    got = fused_craq_chain(
+        *args, block=max(N // 2, 1), interpret=True, **statics
+    )
+    _assert_trees_equal(
+        ref, got,
+        ["w_status", "w_node", "w_arrival", "dirty", "version",
+         "at_tail", "wlat"],
+    )
 
 
 def test_reference_matches_tick_phase():
-    """The spec equals the tick's vote/count phase (both acceptor-major),
-    replicating the tick's OWN bit-derived latency and drop samples so
-    every spec output (votes, phase2b schedule, promised rounds, quorum
-    counts) is compared."""
-    from frankenpaxos_tpu.tpu.common import bit_delivered, bit_latency
+    """The vote/quorum spec equals the tick's own vote phase, replicating
+    the tick's bit-derived latency and drop samples AND its clock aging
+    (offsets age once at tick start, so the spec sees aged clocks)."""
+    from frankenpaxos_tpu.tpu.common import age_clock, bit_delivered, bit_latency
     from frankenpaxos_tpu.tpu.multipaxos_batched import (
         CHOSEN,
         PROPOSED,
@@ -78,29 +270,28 @@ def test_reference_matches_tick_phase():
     key = jax.random.PRNGKey(2)
     state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
     # Recompute the tick's own per-message samples for t=1 (same key
-    # derivation as multipaxos_batched.tick steps 0-1).
+    # derivation as multipaxos_batched.tick steps 0-1). Split into FIVE
+    # like tick does: threefry split derives key i from counters
+    # (i, num+i), so split(key, 3)[0] != split(key, 5)[0].
     tkey = jax.random.fold_in(key, 1)
-    # Split into FIVE like tick does: threefry split derives key i from
-    # counters (i, num+i), so split(key, 3)[0] != split(key, 5)[0] — a
-    # 3-way split here would replay different latency/drop bits than the
-    # tick actually used.
     k3, k2, k_extra, k_read, k_fail = jax.random.split(tkey, 5)
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     bits3 = jax.random.bits(k3, (A, G, W))
-    p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max)
+    p2b_lat = bit_latency(bits3, 0, cfg.lat_min, cfg.lat_max).astype(
+        state.p2b_arrival.dtype
+    )
     p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
 
     vr, vv, p2b, accr, nvotes, nsends = reference_vote_quorum(
-        state.p2a_arrival,
+        age_clock(state.p2a_arrival),
         state.acc_round,
         state.leader_round,
         state.slot_value,
         state.vote_round,
         state.vote_value,
-        state.p2b_arrival,
+        age_clock(state.p2b_arrival),
         p2b_lat,
         p2b_delivered,
-        jnp.int32(1),
     )
     after = tick(cfg, state, jnp.int32(1), tkey)
     np.testing.assert_array_equal(np.asarray(vr), np.asarray(after.vote_round))
@@ -122,9 +313,10 @@ def test_reference_matches_tick_phase():
 
 @pytest.mark.parametrize("drop", [0.0, 0.2])
 def test_tick_with_use_pallas_is_bit_identical(drop):
-    """The whole simulation with tick steps 1-2 routed through the fused
-    Pallas kernel (interpret mode on CPU) equals the XLA path bit for bit
-    — state arrays, stats, and invariants."""
+    """The whole simulation with the hot planes routed through the fused
+    kernels (interpret mode on CPU via the legacy use_pallas knob, which
+    folds into KernelPolicy(mode='on')) equals the reference path bit
+    for bit — state arrays, stats, and invariants."""
     import dataclasses as dc
 
     from frankenpaxos_tpu.tpu.multipaxos_batched import (

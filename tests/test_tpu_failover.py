@@ -19,7 +19,7 @@ from frankenpaxos_tpu.tpu import (
     run_ticks,
     tick,
 )
-from frankenpaxos_tpu.tpu.multipaxos_batched import INF, NOOP_VALUE, PROPOSED
+from frankenpaxos_tpu.tpu.multipaxos_batched import INF, INF16, NOOP_VALUE, PROPOSED
 
 
 def make(**kw):
@@ -72,8 +72,8 @@ def test_deterministic_kill_elects_and_preserves_voted_value():
     state = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
     # Let exactly acceptor 0 of group 0 receive the Phase2a; block others.
     p2a = np.asarray(state.p2a_arrival).copy()
-    p2a[1:, :, :] = int(INF)
-    p2a[:, 1, :] = int(INF)
+    p2a[1:, :, :] = INF16
+    p2a[:, 1, :] = INF16
     state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
     state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
     assert int(state.committed) == 0
@@ -102,8 +102,8 @@ def test_deterministic_kill_elects_and_preserves_voted_value():
     # via a fresh run asserting before retirement instead:
     state2 = tick(cfg, init_state(cfg), jnp.int32(0), jax.random.fold_in(key, 0))
     p2a = np.asarray(state2.p2a_arrival).copy()
-    p2a[1:, :, :] = int(INF)
-    p2a[:, 1, :] = int(INF)
+    p2a[1:, :, :] = INF16
+    p2a[:, 1, :] = INF16
     state2 = dataclasses.replace(state2, p2a_arrival=jnp.asarray(p2a))
     state2 = tick(cfg, state2, jnp.int32(1), jax.random.fold_in(key, 1))
     alive = np.asarray(state2.leader_alive).copy()

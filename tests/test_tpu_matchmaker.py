@@ -21,6 +21,7 @@ from frankenpaxos_tpu.tpu import (
 )
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     INF,
+    INF16,
     RC_NORMAL,
     CHOSEN,
     PROPOSED,
@@ -88,7 +89,7 @@ def test_possibly_chosen_value_survives_via_quorum_intersection():
     # Phase2as reach acceptors 0 and 1 only (a full f+1 write quorum:
     # the value is possibly-chosen); acceptor 2 never hears of it.
     p2a = np.asarray(state.p2a_arrival).copy()
-    p2a[2, :, :] = int(INF)
+    p2a[2, :, :] = INF16
     state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
     values = {}
     epoch1 = False
@@ -99,7 +100,7 @@ def test_possibly_chosen_value_survives_via_quorum_intersection():
             # the slot is voted-but-never-chosen in the old config.
             state = dataclasses.replace(
                 state,
-                p2b_arrival=jnp.full_like(state.p2b_arrival, int(INF)),
+                p2b_arrival=jnp.full_like(state.p2b_arrival, INF16),
             )
             if t == 1:
                 vr = np.asarray(state.vote_round)
@@ -244,7 +245,7 @@ def test_election_midflight_reconfiguration_keeps_promises_monotone():
     # single round-0 vote, so the election's phase-1 repair later
     # re-proposes it at the election round.
     p2a = np.asarray(state.p2a_arrival).copy()
-    p2a[1:, :, 0] = int(INF)
+    p2a[1:, :, 0] = INF16
     state = freeze(dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a)))
 
     injected = False

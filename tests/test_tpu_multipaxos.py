@@ -21,6 +21,7 @@ from frankenpaxos_tpu.tpu import (
     run_ticks,
     tick,
 )
+from frankenpaxos_tpu.tpu.common import INF16
 
 
 def make(drop=0.0, **kw):
@@ -204,7 +205,8 @@ def test_invariant_checker_has_teeth():
     state, t = run_ticks(cfg, state, jnp.zeros((), jnp.int32), 30, jax.random.PRNGKey(9))
     bad = dataclasses.replace(
         state, status=state.status.at[0, 0].set(2),  # CHOSEN
-        p2b_arrival=jnp.full_like(state.p2b_arrival, 2**30),
+        # Offset clocks: INF16 = "never arrives" (no vote counted).
+        p2b_arrival=jnp.full_like(state.p2b_arrival, INF16),
     )
     inv = check_invariants(cfg, bad, t)
     assert not bool(inv["quorum_ok"])
@@ -220,7 +222,7 @@ def test_reconfiguration_churn_preserves_safety_and_values():
     import numpy as np
 
     from frankenpaxos_tpu.tpu.multipaxos_batched import (
-        INF,
+        INF16,
         NOOP_VALUE,
         BatchedMultiPaxosConfig,
         check_invariants,
@@ -239,9 +241,9 @@ def test_reconfiguration_churn_preserves_safety_and_values():
     # Let exactly one acceptor of group 0 slot 0 vote; block the rest.
     # Layout: [A, G, W].
     p2a = np.asarray(state.p2a_arrival).copy()
-    p2a[1:, :, :] = int(INF)  # acceptors 1.. never hear the Phase2a
-    p2a[:, 1, :] = int(INF)  # group 1 blocked entirely
-    p2a[:, 0, 1] = int(INF)  # group 0 slot 1 blocked
+    p2a[1:, :, :] = INF16  # acceptors 1.. never hear the Phase2a
+    p2a[:, 1, :] = INF16  # group 1 blocked entirely
+    p2a[:, 0, 1] = INF16  # group 0 slot 1 blocked
     state = dc.replace(state, p2a_arrival=jnp.asarray(p2a))
     state = tick(cfg, state, jnp.int32(1), jax.random.fold_in(key, 1))
     assert int(state.committed) == 0

@@ -20,6 +20,7 @@ from frankenpaxos_tpu.core import wire
 from frankenpaxos_tpu.protocols.multipaxos.messages import Phase2a, Phase2b
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     INF,
+    INF16,
     NOOP_VALUE,
     BatchedMultiPaxosConfig,
     check_invariants,
@@ -117,9 +118,9 @@ def test_multipaxos_repair_family(seed):
     for s in range(n):
         g, w = s % 2, s // 2
         if fates[s] == "empty":
-            p2a[:, g, w] = int(INF)
+            p2a[:, g, w] = INF16
         elif fates[s] == "voted":
-            p2a[vote_counts[s]:, g, w] = int(INF)
+            p2a[vote_counts[s]:, g, w] = INF16
     state = dataclasses.replace(state, p2a_arrival=jnp.asarray(p2a))
     log = {}
     state, t_ = run_batched_collecting(cfg, state, 1, 3, key, log)
