@@ -200,6 +200,17 @@ def _inner_main() -> None:
         for backend, planes in _registry.coverage().items()
     }
 
+    # Static-analysis provenance (frankenpaxos_tpu/analysis): which
+    # contract-rule registry version, and how many rules, were in force
+    # when this artifact was captured — so a future reader knows what a
+    # "clean" repo meant at capture time.
+    from frankenpaxos_tpu import analysis as _analysis
+
+    result["analysis"] = {
+        "version": _analysis.ANALYSIS_VERSION,
+        "rule_count": _analysis.rule_count(),
+    }
+
     # Telemetry overhead budget (--telemetry): the device-side metric
     # ring (tpu/telemetry.py) must cost <2% ticks/sec on this flagship
     # config. Measured head-to-head: the shipped default ring vs a
@@ -552,6 +563,7 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
         "faults": cpu_live.get("faults"),
         "kernel_policy": cpu_live.get("kernel_policy"),
         "kernel_coverage": cpu_live.get("kernel_coverage"),
+        "analysis": cpu_live.get("analysis"),
     }
     notes.append(
         "headline is the last-known-good real-TPU capture; "

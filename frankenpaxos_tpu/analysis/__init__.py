@@ -1,0 +1,44 @@
+"""Unified static-analysis subsystem for the batched backends.
+
+Two layers behind one rule registry (``core.RULES``):
+
+* **AST layer** (``rules_ast.py``) — the repo-wide source contracts:
+  buffer donation on every jitted ``*State`` entry point, the telemetry
+  carry/record contract, the FaultPlan accept/validate/apply contract,
+  Pallas containment + kernel-registry coverage, transitive host-sync
+  purity of every tick body, and a State-field dead-write detector.
+* **Trace layer** (``rules_trace.py``) — jits every backend at its
+  ``analysis_config()`` and inspects the artifact: jaxpr dtype-policy
+  (no unallowlisted narrow->wide conversions), compiled-HLO donation
+  effectiveness (``input_output_alias`` covers the State buffers), and
+  a retrace guard (equal configs hit the jit cache).
+
+Diagnostics are structured (:class:`~.core.Finding`: rule id,
+file:line, message, stable allowlist key); every exemption lives in
+``allowlists.py`` with a mandatory reason, and stale entries are
+findings themselves. CLI::
+
+    python -m frankenpaxos_tpu.analysis [--rule ID] [--layer ast|trace]
+        [--backends a,b] [--json] [--list]
+
+Exit code = finding count. The tier-1 lint tests
+(``tests/test_*_lint.py``) are thin wrappers invoking rules by id, so
+``pytest -m lint`` and the CLI enforce the same registry.
+"""
+
+from frankenpaxos_tpu.analysis.core import (  # noqa: F401
+    ANALYSIS_VERSION,
+    Context,
+    Finding,
+    Report,
+    Rule,
+    RULES,
+    run,
+)
+
+
+def rule_count() -> int:
+    """Number of registered rules (imports the rule modules)."""
+    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+
+    return len(RULES)

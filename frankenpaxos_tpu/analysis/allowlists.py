@@ -1,0 +1,55 @@
+"""Every intentional exemption from a static-analysis rule, in one
+place, each with a mandatory human reason.
+
+Two shapes:
+
+* ``SUPPRESS[rule_id][finding_key] = reason`` — suppresses a finding by
+  its stable key. The engine REJECTS stale entries: a key matching no
+  current raw finding becomes an ``allowlist-stale`` finding (see
+  ``core.run``), so a typo'd or outdated entry can never silently
+  exempt nothing.
+* ``DTYPE_WIDENING[(backend, conversion)] = (count, reason)`` — the
+  trace-layer dtype-policy rule pins the EXACT number of narrow->wide
+  integer conversions each backend's compiled tick may contain. Any
+  drift in either direction (a new silent upcast, or a removed widening
+  leaving budget for a future one) is a finding telling you to update
+  the pin. Widening is legitimate ONLY at accumulation/indexing points
+  per the dtype policy in ``tpu/common.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+SUPPRESS: Dict[str, Dict[str, str]] = {
+    # rule id -> {finding key -> reason}. Nothing is currently exempt.
+    # Example:
+    # "donation-jit": {
+    #     "foo_batched.py:replay_ticks":
+    #         "replay keeps the input state for post-hoc divergence "
+    #         "dumps",
+    # },
+}
+
+# (backend, "src->dst") -> (exact count, reason). Counts are taken at
+# the backend's analysis_config() — the same deterministic small config
+# the trace layer jits.
+DTYPE_WIDENING: Dict[Tuple[str, str], Tuple[int, str]] = {
+    ("fasterpaxos", "int16->int32"): (
+        5,
+        "int16 seat/ballot epochs feed jnp.mod + take_along_axis "
+        "delegate-seating index math ([G,1]-scale control plane, "
+        "_seat_server/seating_ok) — index arithmetic widens at the "
+        "consumption point per the tpu/common.py dtype policy",
+    ),
+    ("horizontal", "int16->int32"): (
+        5,
+        "int16 config epochs feed jnp.mod bank-parity compares against "
+        "the int32 row iota ([P,G]/[P,G,W] masks in tick steps 5-6) — "
+        "tiny control planes widened at the compare, not state storage",
+    ),
+}
+
+
+def suppressions(rule_id: str) -> Dict[str, str]:
+    return SUPPRESS.get(rule_id, {})

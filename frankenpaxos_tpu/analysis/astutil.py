@@ -1,0 +1,252 @@
+"""Shared AST-walking helpers for the static-analysis rules.
+
+Every matcher that used to be copy-pasted across the four lint test
+files (``tests/test_donation_lint.py``, ``test_telemetry_lint.py``,
+``test_fault_lint.py``, ``test_kernel_lint.py``) lives here exactly
+once: file enumeration, ``@jax.jit`` decorator recognition, ``*State``
+parameter detection, dataclass field extraction, and the
+``dispatch("plane", ...)`` literal scraper. The rule modules
+(``rules_ast.py`` / ``rules_trace.py``) and the thin test wrappers all
+import from here.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import pathlib
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent
+REPO_ROOT = PKG_ROOT.parent
+
+# Function names whose bodies run INSIDE the compiled scan (subject to
+# trace-purity constraints).
+IN_GRAPH_FUNCS = ("tick", "run_ticks", "step")
+
+
+def py_files(base: pathlib.Path) -> List[pathlib.Path]:
+    """All ``*.py`` files under ``base``, excluding ``__pycache__``."""
+    return sorted(
+        p for p in base.rglob("*.py") if "__pycache__" not in p.parts
+    )
+
+
+def batched_files(root: pathlib.Path) -> List[pathlib.Path]:
+    """The ``tpu/*_batched.py`` backend modules under a package root."""
+    return sorted((root / "tpu").glob("*_batched.py"))
+
+
+@functools.lru_cache(maxsize=None)
+def _parse_cached(path: str, mtime: float) -> ast.Module:
+    p = pathlib.Path(path)
+    return ast.parse(p.read_text(), filename=path)
+
+
+def parse_file(path: pathlib.Path) -> ast.Module:
+    """Parse ``path``, cached on (path, mtime) so one CLI run parses
+    each file once even when many rules visit it."""
+    return _parse_cached(str(path), path.stat().st_mtime)
+
+
+def is_jax_jit(node: ast.AST) -> bool:
+    """Matches the ``jax.jit`` attribute expression."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def jit_decorator_info(dec: ast.AST) -> Tuple[bool, bool]:
+    """(is_jit, has_donate) for one decorator expression, matching
+    ``@jax.jit``, ``@functools.partial(jax.jit, ...)`` /
+    ``@partial(jax.jit, ...)``, and ``@jax.jit(...)`` shapes."""
+    if is_jax_jit(dec):
+        return True, False
+    if isinstance(dec, ast.Call):
+        callee = dec.func
+        is_partial = (
+            isinstance(callee, ast.Attribute) and callee.attr == "partial"
+        ) or (isinstance(callee, ast.Name) and callee.id == "partial")
+        if is_partial and dec.args and is_jax_jit(dec.args[0]):
+            has_donate = any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in dec.keywords
+            )
+            return True, has_donate
+        if is_jax_jit(callee):
+            has_donate = any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in dec.keywords
+            )
+            return True, has_donate
+    return False, False
+
+
+def threads_state(func: ast.FunctionDef) -> bool:
+    """True iff some parameter annotation names a ``*State`` dataclass
+    (or, for unannotated entry points, the repo-wide convention names
+    the threaded parameter ``state``)."""
+    for arg in func.args.args + func.args.posonlyargs + func.args.kwonlyargs:
+        ann = arg.annotation
+        if ann is None:
+            continue
+        if "State" in ast.unparse(ann):
+            return True
+    return any(
+        a.arg == "state" for a in func.args.args + func.args.posonlyargs
+    )
+
+
+def classes_with_suffix(
+    tree: ast.Module, suffix: str
+) -> List[ast.ClassDef]:
+    """ClassDef nodes whose names end with ``suffix`` (``"State"`` /
+    ``"Config"`` dataclasses by repo convention)."""
+    return [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ClassDef) and node.name.endswith(suffix)
+    ]
+
+
+def ann_fields(cls: ast.ClassDef) -> Dict[str, str]:
+    """Annotated dataclass fields of ``cls``: name -> unparsed
+    annotation text."""
+    return {
+        stmt.target.id: ast.unparse(stmt.annotation)
+        for stmt in cls.body
+        if isinstance(stmt, ast.AnnAssign)
+        and isinstance(stmt.target, ast.Name)
+    }
+
+
+def functions_named(
+    tree: ast.Module, names: Sequence[str]
+) -> List[ast.FunctionDef]:
+    """All (possibly nested) FunctionDefs in ``tree`` with a name in
+    ``names``."""
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in names
+    ]
+
+
+def module_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """name -> FunctionDef for every function defined in ``tree``
+    (nested defs included; later definitions win, matching runtime
+    shadowing)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[n.name] = n
+    return out
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local alias -> dotted module path for every module import
+    (``import x.y as z`` and ``from x import y [as z]`` both map the
+    bound name to the module/attribute path)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def called_names(func: ast.AST) -> Set[Tuple[str, str]]:
+    """(base, name) pairs for every call inside ``func``'s body: a bare
+    ``helper(...)`` call yields ``("", "helper")``; ``mod.helper(...)``
+    yields ``("mod", "helper")``. Deeper attribute chains keep only the
+    innermost base name."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.add(("", f.id))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            out.add((f.value.id, f.attr))
+    return out
+
+
+def dispatched_plane_names(tree: ast.Module) -> Set[str]:
+    """Literal plane names passed to a ``*.dispatch(...)`` call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_dispatch = (
+            isinstance(func, ast.Attribute) and func.attr == "dispatch"
+        ) or (isinstance(func, ast.Name) and func.id == "dispatch")
+        if not is_dispatch or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            names.add(first.value)
+    return names
+
+
+def attribute_reads(trees: Iterable[ast.Module]) -> Set[str]:
+    """Every attribute name read (Load context) across ``trees``."""
+    reads: Set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                reads.add(node.attr)
+    return reads
+
+
+def consumed_attribute_reads(trees: Iterable[ast.Module]) -> Set[str]:
+    """Attribute names that are genuinely CONSUMED somewhere in
+    ``trees`` — like :func:`attribute_reads`, except that a read of
+    field ``f`` appearing inside the ``f=...`` keyword of a
+    ``replace(...)`` / ``*State(...)`` update does not count: a field
+    that only ever feeds its own next value (``replace(state,
+    acc=state.acc + 1)``) is a dead write nobody observes."""
+    excluded: Set[int] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_update = (
+                (isinstance(f, ast.Attribute) and f.attr == "replace")
+                or (isinstance(f, ast.Name) and f.id == "replace")
+                or (
+                    isinstance(f, ast.Name) and f.id.endswith("State")
+                )
+            )
+            if not is_update:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                for sub in ast.walk(kw.value):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and sub.attr == kw.arg
+                    ):
+                        excluded.add(id(sub))
+    reads: Set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and id(node) not in excluded
+            ):
+                reads.add(node.attr)
+    return reads

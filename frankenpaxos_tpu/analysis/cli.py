@@ -1,0 +1,94 @@
+"""Command-line entry point: ``python -m frankenpaxos_tpu.analysis``.
+
+Runs the full rule registry (or a ``--rule`` / ``--layer`` /
+``--backends`` selection) over the repository and exits with the
+finding count (0 = clean; capped at 100 so the code never wraps mod
+256). ``--json`` emits the structured report on stdout for CI
+artifacts; ``scripts/lint.sh`` is a thin wrapper around this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+EXIT_CAP = 100
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m frankenpaxos_tpu.analysis",
+        description=(
+            "Static analysis for the batched backends: AST contract "
+            "rules + jaxpr/HLO trace rules. Exit code = finding count."
+        ),
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        metavar="ID",
+        help="run only this rule id (repeatable; see --list)",
+    )
+    parser.add_argument(
+        "--layer",
+        choices=("ast", "trace"),
+        action="append",
+        help="run only this layer (repeatable; default: both)",
+    )
+    parser.add_argument(
+        "--backends",
+        metavar="A,B,...",
+        help="comma-separated backend subset for the trace layer",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the structured report as JSON on stdout",
+    )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        help="list registered rules and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    from frankenpaxos_tpu.analysis import core
+
+    # Import for side effects: rule registration (before --list).
+    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+
+    if args.list:
+        for r in sorted(core.RULES.values(), key=lambda r: (r.layer, r.id)):
+            print(f"{r.id:28s} [{r.layer}]  {r.doc}")
+        return 0
+
+    ctx = core.Context()
+    if args.backends:
+        ctx.backends = tuple(
+            b.strip() for b in args.backends.split(",") if b.strip()
+        )
+    layers = tuple(args.layer) if args.layer else ("ast", "trace")
+    try:
+        report = core.run(rule_ids=args.rule, layers=layers, ctx=ctx)
+    except KeyError as e:
+        parser.error(str(e))  # unknown rule/backend: usage error, exit 2
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        if report.findings:
+            print(report.format())
+        print(
+            f"{len(report.findings)} finding(s) from "
+            f"{len(report.rules_run)} rule(s) "
+            f"({len(report.allowlisted)} allowlisted), analysis "
+            f"version {report.version}",
+            file=sys.stderr,
+        )
+    return min(len(report.findings), EXIT_CAP)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""Rule registry, structured diagnostics, and the analysis engine.
+
+A :class:`Rule` is a named check over the repository: AST-layer rules
+parse source files, trace-layer rules jit the batched backends at a
+small config and inspect what XLA actually compiles. Every violation is
+a :class:`Finding` with a stable ``key``; allowlists
+(``allowlists.py``) suppress findings BY KEY and must carry a reason —
+and the engine rejects stale entries (an allowlist key matching no
+current raw finding becomes an ``allowlist-stale`` finding itself, so a
+typo'd or outdated exemption can never silently exempt nothing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+from frankenpaxos_tpu.analysis import astutil
+
+# Bumped whenever a rule is added/removed or a rule's semantics change;
+# recorded by bench.py for artifact provenance.
+ANALYSIS_VERSION = "1.0"
+
+# Rule id reserved for the engine's own stale-allowlist findings.
+STALE_RULE = "allowlist-stale"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured diagnostic."""
+
+    rule: str  # rule id that produced it
+    path: str  # repo-relative file (or backend name for trace rules)
+    line: int  # 1-based line, 0 when the finding is not line-anchored
+    message: str
+    key: str  # stable id allowlist entries match against
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered check. ``check(ctx)`` returns RAW findings — the
+    engine applies the rule's allowlist afterwards."""
+
+    id: str
+    layer: str  # "ast" | "trace"
+    doc: str  # one-line description (CLI --list, README table)
+    check: Callable[["Context"], List[Finding]]
+
+
+@dataclasses.dataclass
+class Context:
+    """What rules run against. ``root`` is the package directory the
+    AST rules scan (the real ``frankenpaxos_tpu/`` in production, a
+    synthetic fixture tree in the engine's own tests)."""
+
+    root: pathlib.Path = astutil.PKG_ROOT
+    repo: pathlib.Path = astutil.REPO_ROOT
+    # Backends the trace layer runs (None = all registered). Names must
+    # match rules_trace.BACKENDS.
+    backends: Optional[Sequence[str]] = None
+    # Floor the backend-inventory rule enforces; fixture trees override.
+    min_backends: int = 13
+    # Fixture trees are not importable packages: rules that must import
+    # repo modules (kernel registry introspection) skip when False.
+    importable: bool = True
+
+    def is_real_tree(self) -> bool:
+        return self.root == astutil.PKG_ROOT
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, layer: str, doc: str):
+    """Decorator registering a check function as a :class:`Rule`."""
+
+    def register(fn: Callable[[Context], List[Finding]]):
+        assert id not in RULES, f"duplicate rule id {id}"
+        RULES[id] = Rule(id=id, layer=layer, doc=doc, check=fn)
+        return fn
+
+    return register
+
+
+@dataclasses.dataclass
+class Report:
+    """Engine output: surviving findings + suppressed-by-allowlist
+    findings (kept for transparency) + the rules that ran."""
+
+    findings: List[Finding]
+    allowlisted: List[dict]  # finding dict + its allowlist reason
+    rules_run: List[str]
+    version: str = ANALYSIS_VERSION
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "rules_run": self.rules_run,
+            "finding_count": len(self.findings),
+            "findings": [f.to_dict() for f in self.findings],
+            "allowlisted": self.allowlisted,
+        }
+
+    def format(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(f"{f.rule}: {f.location()}: {f.message}")
+        return "\n".join(lines)
+
+
+def run(
+    rule_ids: Optional[Sequence[str]] = None,
+    layers: Sequence[str] = ("ast", "trace"),
+    ctx: Optional[Context] = None,
+) -> Report:
+    """Run the selected rules and apply/validate their allowlists.
+
+    ``rule_ids=None`` runs every registered rule in ``layers``. Unknown
+    rule ids raise (a CI invocation of a renamed rule must fail loudly,
+    not silently check nothing).
+    """
+    # Import for side effects: rule registration.
+    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+    from frankenpaxos_tpu.analysis import allowlists
+
+    ctx = ctx or Context()
+    if rule_ids is None:
+        selected = [r for r in RULES.values() if r.layer in layers]
+    else:
+        unknown = [rid for rid in rule_ids if rid not in RULES]
+        if unknown:
+            raise KeyError(
+                f"unknown rule id(s) {unknown}; known: {sorted(RULES)}"
+            )
+        selected = [RULES[rid] for rid in rule_ids]
+
+    findings: List[Finding] = []
+    suppressed: List[dict] = []
+    for r in selected:
+        raw = r.check(ctx)
+        assert all(f.rule == r.id for f in raw), (
+            f"rule {r.id} emitted findings under a different rule id"
+        )
+        allow = allowlists.suppressions(r.id)
+        matched = set()
+        for f in raw:
+            if f.key in allow:
+                matched.add(f.key)
+                suppressed.append(
+                    {**f.to_dict(), "reason": allow[f.key]}
+                )
+            else:
+                findings.append(f)
+        # Stale-allowlist hygiene: an entry that matches no raw finding
+        # exempts nothing — it is a typo or a leftover from removed
+        # code, and keeping it around silently erodes coverage.
+        for key in sorted(set(allow) - matched):
+            findings.append(
+                Finding(
+                    rule=STALE_RULE,
+                    path="frankenpaxos_tpu/analysis/allowlists.py",
+                    line=0,
+                    message=(
+                        f"allowlist entry {key!r} for rule {r.id!r} "
+                        "matches no current finding — remove it (stale "
+                        "entries silently exempt nothing)"
+                    ),
+                    key=f"{r.id}:{key}",
+                )
+            )
+    # A SUPPRESS block keyed by a rule id that is not registered at all
+    # (typo, or the rule was renamed) would otherwise never be examined
+    # — the intended exemption doesn't apply AND nothing flags it.
+    for rid in sorted(set(allowlists.SUPPRESS) - set(RULES)):
+        findings.append(
+            Finding(
+                rule=STALE_RULE,
+                path="frankenpaxos_tpu/analysis/allowlists.py",
+                line=0,
+                message=(
+                    f"SUPPRESS block for unknown rule id {rid!r} — "
+                    f"no such rule is registered (known: "
+                    f"{sorted(RULES)})"
+                ),
+                key=f"{rid}:<unknown-rule>",
+            )
+        )
+    return Report(
+        findings=findings,
+        allowlisted=suppressed,
+        rules_run=[r.id for r in selected],
+    )

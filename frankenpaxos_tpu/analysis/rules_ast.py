@@ -1,0 +1,791 @@
+"""AST-layer rules: the four repo-wide contracts the old lint test
+files enforced (donation, telemetry, faults, kernel containment), each
+now a registry rule with structured findings — plus the new checks the
+ad-hoc lints never had: a TRANSITIVE host-sync purity walk (a sync
+smuggled into a helper called from ``tick`` is caught, not just a sync
+written inline), and a State-field dead-write detector.
+
+Rules parse source only; nothing here executes backend code (the two
+registry-introspection kernel rules import ``ops.registry``, which is
+why they skip on non-importable fixture trees).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Set, Tuple
+
+from frankenpaxos_tpu.analysis import astutil
+from frankenpaxos_tpu.analysis.core import Context, Finding, rule
+
+# ---------------------------------------------------------------------------
+# Inventory
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "backend-inventory",
+    "ast",
+    "tpu/ holds at least the expected number of *_batched.py backends",
+)
+def check_backend_inventory(ctx: Context) -> List[Finding]:
+    files = astutil.batched_files(ctx.root)
+    if len(files) < ctx.min_backends:
+        return [
+            Finding(
+                rule="backend-inventory",
+                path=str((ctx.root / "tpu").relative_to(ctx.repo))
+                if ctx.root.is_relative_to(ctx.repo)
+                else str(ctx.root / "tpu"),
+                line=0,
+                message=(
+                    f"expected >= {ctx.min_backends} batched backends, "
+                    f"found {len(files)}: {[f.name for f in files]}"
+                ),
+                key="count",
+            )
+        ]
+    return []
+
+
+def _rel(ctx: Context, path: pathlib.Path) -> str:
+    try:
+        return str(path.relative_to(ctx.repo))
+    except ValueError:
+        return str(path.relative_to(ctx.root.parent))
+
+
+# ---------------------------------------------------------------------------
+# Donation (PR 1 contract)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "donation-jit",
+    "ast",
+    "every jitted *State-threading entry point in tpu/ donates its "
+    "state buffers (single-buffer HBM contract)",
+)
+def check_donation(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.py_files(ctx.root / "tpu"):
+        tree = astutil.parse_file(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jitted = donated = False
+            for dec in node.decorator_list:
+                is_jit, has_donate = astutil.jit_decorator_info(dec)
+                jitted = jitted or is_jit
+                donated = donated or has_donate
+            if not jitted or not astutil.threads_state(node):
+                continue
+            if donated:
+                continue
+            out.append(
+                Finding(
+                    rule="donation-jit",
+                    path=_rel(ctx, path),
+                    line=node.lineno,
+                    message=(
+                        f"jitted state-threading entry point "
+                        f"{node.name!r} lacks donate_argnums/"
+                        "donate_argnames — the cluster state "
+                        "double-buffers in device memory (see "
+                        "tpu/common.py donation policy)"
+                    ),
+                    key=f"{path.name}:{node.name}",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (PR 2 contract)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "telemetry-state-carry",
+    "ast",
+    "every batched *State dataclass threads a `telemetry: Telemetry` "
+    "field through the scan carry",
+)
+def check_telemetry_state(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        classes = astutil.classes_with_suffix(tree, "State")
+        if not classes:
+            out.append(
+                Finding(
+                    rule="telemetry-state-carry",
+                    path=_rel(ctx, path),
+                    line=0,
+                    message="no *State dataclass found",
+                    key=f"{path.name}:<missing>",
+                )
+            )
+            continue
+        for cls in classes:
+            ann = astutil.ann_fields(cls).get("telemetry")
+            if ann is None or "Telemetry" not in ann:
+                out.append(
+                    Finding(
+                        rule="telemetry-state-carry",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name} lacks a `telemetry: Telemetry` "
+                            "field (tpu/telemetry.py carry contract)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+@rule(
+    "telemetry-tick-records",
+    "ast",
+    "every batched backend's tick calls telemetry record() — no dead "
+    "metric rings",
+)
+def check_tick_records(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        ticks = astutil.functions_named(tree, ("tick",))
+        if not ticks:
+            out.append(
+                Finding(
+                    rule="telemetry-tick-records",
+                    path=_rel(ctx, path),
+                    line=0,
+                    message="no tick function found",
+                    key=f"{path.name}:<missing>",
+                )
+            )
+            continue
+        for func in ticks:
+            calls_record = any(
+                isinstance(n, ast.Call)
+                and (
+                    (isinstance(n.func, ast.Name) and n.func.id == "record")
+                    or (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "record"
+                    )
+                )
+                for n in ast.walk(func)
+            )
+            if not calls_record:
+                out.append(
+                    Finding(
+                        rule="telemetry-tick-records",
+                        path=_rel(ctx, path),
+                        line=func.lineno,
+                        message=(
+                            "tick never calls telemetry record() — a "
+                            "dead ring ships no observability"
+                        ),
+                        key=path.name,
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-sync / trace purity (generalized + transitive)
+# ---------------------------------------------------------------------------
+
+# Attribute/name references that serialize the compiled loop against
+# the host. `asarray` is special-cased below: numpy's blocks, jnp's is
+# traced.
+_SYNC_NAMES = (
+    "block_until_ready",
+    "device_get",
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+)
+
+_NUMPY_BASES = ("np", "numpy", "onp")
+_JNP_BASES = ("jnp", "jaxnp")
+
+
+def _sync_offenses_in(func: ast.AST) -> List[Tuple[str, int]]:
+    """(primitive, line) pairs for host-sync constructs inside ``func``
+    (nested defs included)."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SYNC_NAMES:
+                out.append((node.attr, node.lineno))
+            elif node.attr == "asarray":
+                base = (
+                    node.value.id
+                    if isinstance(node.value, ast.Name)
+                    else None
+                )
+                # jnp.asarray is traced; numpy's (or an unknown base,
+                # conservatively) materializes on the host.
+                if base not in _JNP_BASES:
+                    out.append(("asarray", node.lineno))
+        elif isinstance(node, ast.Name) and node.id in _SYNC_NAMES + (
+            "asarray",
+        ):
+            # A bare `asarray` name is a from-import of numpy's (jnp
+            # users write jnp.asarray by repo convention) — host
+            # materialization either way.
+            out.append((node.id, node.lineno))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+        ):
+            out.append(("item", node.lineno))
+    return out
+
+
+def _module_index(ctx: Context) -> Dict[str, dict]:
+    """dotted module name -> {path, tree, functions, aliases} for every
+    module under tpu/ and ops/ (the in-graph universe)."""
+    index: Dict[str, dict] = {}
+    pkg = ctx.root.name
+    for sub in ("tpu", "ops"):
+        base = ctx.root / sub
+        if not base.exists():
+            continue
+        for path in astutil.py_files(base):
+            tree = astutil.parse_file(path)
+            dotted = f"{pkg}.{sub}.{path.stem}"
+            index[dotted] = {
+                "path": path,
+                "tree": tree,
+                "functions": astutil.module_functions(tree),
+                "aliases": astutil.import_aliases(tree),
+            }
+    return index
+
+
+def _resolve_call(
+    index: Dict[str, dict], mod: str, base: str, name: str
+):
+    """Resolve a ``base.name(...)`` / ``name(...)`` call made inside
+    module ``mod`` to a (module, function-name) pair inside the index,
+    or None for externals (jax, jnp, stdlib, methods)."""
+    entry = index[mod]
+    if base == "":
+        if name in entry["functions"]:
+            return (mod, name)
+        target = entry["aliases"].get(name)
+        if target and "." in target:
+            tmod, tname = target.rsplit(".", 1)
+            if tmod in index and tname in index[tmod]["functions"]:
+                return (tmod, tname)
+        return None
+    target = entry["aliases"].get(base)
+    if target and target in index and name in index[target]["functions"]:
+        return (target, name)
+    return None
+
+
+@rule(
+    "host-sync-purity",
+    "ast",
+    "no host-sync primitive is reachable from any tick/run_ticks/step "
+    "body — transitively, through helpers in tpu/ and ops/",
+)
+def check_host_sync(ctx: Context) -> List[Finding]:
+    index = _module_index(ctx)
+    # Roots: every in-graph function in every tpu module.
+    queue: List[Tuple[str, str, ast.AST]] = []
+    seen: Set[Tuple[str, str]] = set()
+    for mod, entry in index.items():
+        if entry["path"].parent.name != "tpu":
+            continue
+        for func in astutil.functions_named(
+            entry["tree"], astutil.IN_GRAPH_FUNCS
+        ):
+            if (mod, func.name) not in seen:
+                seen.add((mod, func.name))
+                queue.append((mod, func.name, func))
+
+    out: List[Finding] = []
+    emitted: Set[str] = set()
+    while queue:
+        mod, fname, func = queue.pop()
+        entry = index[mod]
+        for prim, line in _sync_offenses_in(func):
+            key = f"{entry['path'].name}:{fname}:{prim}"
+            if key in emitted:
+                continue
+            emitted.add(key)
+            out.append(
+                Finding(
+                    rule="host-sync-purity",
+                    path=_rel(ctx, entry["path"]),
+                    line=line,
+                    message=(
+                        f"host-sync primitive {prim!r} in {fname!r}, "
+                        "which is reachable from a compiled "
+                        "tick/run_ticks body — it serializes the scan "
+                        "against the host (use the telemetry ring / "
+                        "post-hoc stats instead)"
+                    ),
+                    key=key,
+                )
+            )
+        for base, name in astutil.called_names(func):
+            resolved = _resolve_call(index, mod, base, name)
+            if resolved and resolved not in seen:
+                seen.add(resolved)
+                tmod, tname = resolved
+                queue.append(
+                    (tmod, tname, index[tmod]["functions"][tname])
+                )
+    return sorted(out, key=lambda f: f.key)
+
+
+# ---------------------------------------------------------------------------
+# Faults (PR 3 contract)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "fault-config-field",
+    "ast",
+    "every batched *Config accepts a `faults: FaultPlan` field",
+)
+def check_fault_config(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        classes = astutil.classes_with_suffix(tree, "Config")
+        if not classes:
+            out.append(
+                Finding(
+                    rule="fault-config-field",
+                    path=_rel(ctx, path),
+                    line=0,
+                    message="no *Config dataclass found",
+                    key=f"{path.name}:<missing>",
+                )
+            )
+            continue
+        for cls in classes:
+            ann = astutil.ann_fields(cls).get("faults")
+            if ann is None or "FaultPlan" not in ann:
+                out.append(
+                    Finding(
+                        rule="fault-config-field",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name} lacks a `faults: FaultPlan` "
+                            "field (tpu/faults.py contract)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+@rule(
+    "fault-validate",
+    "ast",
+    "every batched *Config.__post_init__ calls faults.validate(...) "
+    "so malformed plans fail at config time",
+)
+def check_fault_validate(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            post = [
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "__post_init__"
+            ]
+            if not post:
+                out.append(
+                    Finding(
+                        rule="fault-validate",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=f"{cls.name} has no __post_init__",
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+                continue
+            calls_validate = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "validate"
+                and "faults" in ast.unparse(n.func.value)
+                for n in ast.walk(post[0])
+            )
+            if not calls_validate:
+                out.append(
+                    Finding(
+                        rule="fault-validate",
+                        path=_rel(ctx, path),
+                        line=post[0].lineno,
+                        message=(
+                            f"{cls.name}.__post_init__ never calls "
+                            "self.faults.validate(...)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+def _tick_applies_faults(func: ast.FunctionDef) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute) and node.attr == "faults":
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("faults_mod", "faults")
+        ):
+            return True
+    return False
+
+
+@rule(
+    "fault-apply",
+    "ast",
+    "every batched tick actually applies the configured FaultPlan",
+)
+def check_fault_apply(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for func in astutil.functions_named(tree, ("tick",)):
+            if not _tick_applies_faults(func):
+                out.append(
+                    Finding(
+                        rule="fault-apply",
+                        path=_rel(ctx, path),
+                        line=func.lineno,
+                        message=(
+                            "tick accepts a FaultPlan via config but "
+                            "never applies it"
+                        ),
+                        key=path.name,
+                    )
+                )
+    return out
+
+
+@rule(
+    "fault-rate-validated",
+    "ast",
+    "every float *_rate config field is range-checked in __post_init__",
+)
+def check_rate_validated(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            rate_fields = [
+                name
+                for name, ann in astutil.ann_fields(cls).items()
+                if name.endswith("_rate") and "float" in ann
+            ]
+            post = [
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "__post_init__"
+            ]
+            body_src = ast.unparse(post[0]) if post else ""
+            for name in rate_fields:
+                if f"self.{name}" not in body_src:
+                    out.append(
+                        Finding(
+                            rule="fault-rate-validated",
+                            path=_rel(ctx, path),
+                            line=cls.lineno,
+                            message=(
+                                f"{cls.name}.{name} is never "
+                                "range-checked in __post_init__ — an "
+                                "out-of-range rate simulates a "
+                                "different protocol regime"
+                            ),
+                            key=f"{path.name}:{cls.name}:{name}",
+                        )
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Kernel layer (PR 4 contract)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "kernel-pallas-containment",
+    "ast",
+    "pallas_call appears only inside ops/ — the registry is the single "
+    "kernel dispatch point",
+)
+def check_pallas_containment(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.py_files(ctx.root):
+        rel = path.relative_to(ctx.root)
+        if rel.parts and rel.parts[0] == "ops":
+            continue
+        tree = astutil.parse_file(path)
+        lines = []
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr == "pallas_call"
+            ) or (
+                isinstance(node, ast.Name) and node.id == "pallas_call"
+            ):
+                lines.append(node.lineno)
+        if lines:
+            out.append(
+                Finding(
+                    rule="kernel-pallas-containment",
+                    path=_rel(ctx, path),
+                    line=lines[0],
+                    message=(
+                        f"pallas_call outside ops/ at line(s) {lines} "
+                        "— route the plane through "
+                        "ops.registry.dispatch instead"
+                    ),
+                    key=str(rel),
+                )
+            )
+    return out
+
+
+@rule(
+    "kernel-dispatch-coverage",
+    "ast",
+    "every registered kernel plane is dispatched by its backend's tick "
+    "(and nothing dispatches an unregistered plane)",
+)
+def check_dispatch_coverage(ctx: Context) -> List[Finding]:
+    if not (ctx.importable and ctx.is_real_tree()):
+        return []
+    from frankenpaxos_tpu.ops import registry
+
+    out: List[Finding] = []
+    for backend, planes in registry.coverage().items():
+        module = ctx.root / "tpu" / f"{backend}_batched.py"
+        if not module.exists():
+            out.append(
+                Finding(
+                    rule="kernel-dispatch-coverage",
+                    path=f"frankenpaxos_tpu/tpu/{backend}_batched.py",
+                    line=0,
+                    message=(
+                        f"registry covers backend {backend!r} but no "
+                        "such batched module exists"
+                    ),
+                    key=f"{backend}:<missing>",
+                )
+            )
+            continue
+        dispatched = astutil.dispatched_plane_names(
+            astutil.parse_file(module)
+        )
+        for plane in set(planes) - dispatched:
+            out.append(
+                Finding(
+                    rule="kernel-dispatch-coverage",
+                    path=_rel(ctx, module),
+                    line=0,
+                    message=(
+                        f"registered plane {plane!r} is never "
+                        "dispatched by this backend — dead kernel"
+                    ),
+                    key=f"{backend}:{plane}",
+                )
+            )
+        for plane in dispatched - set(registry.PLANES):
+            out.append(
+                Finding(
+                    rule="kernel-dispatch-coverage",
+                    path=_rel(ctx, module),
+                    line=0,
+                    message=(
+                        f"dispatches unregistered plane {plane!r} — "
+                        "KeyError at trace time"
+                    ),
+                    key=f"{backend}:{plane}:unregistered",
+                )
+            )
+    return out
+
+
+@rule(
+    "kernel-reference-twin",
+    "ast",
+    "every registered kernel has a reference_* twin with the same "
+    "positional signature (plus block/interpret)",
+)
+def check_reference_twin(ctx: Context) -> List[Finding]:
+    if not (ctx.importable and ctx.is_real_tree()):
+        return []
+    import inspect
+
+    from frankenpaxos_tpu.ops import registry
+
+    out: List[Finding] = []
+    for name, plane in registry.PLANES.items():
+        if not plane.reference.__name__.startswith("reference_"):
+            out.append(
+                Finding(
+                    rule="kernel-reference-twin",
+                    path="frankenpaxos_tpu/ops/registry.py",
+                    line=0,
+                    message=(
+                        f"plane {name!r}: reference twin "
+                        f"{plane.reference.__name__!r} is not named "
+                        "reference_*"
+                    ),
+                    key=f"{name}:name",
+                )
+            )
+        ref_params = list(
+            inspect.signature(plane.reference).parameters
+        )
+        ker_params = [
+            p
+            for p in inspect.signature(plane.kernel).parameters
+            if p not in ("block", "interpret")
+        ]
+        if ker_params != ref_params:
+            out.append(
+                Finding(
+                    rule="kernel-reference-twin",
+                    path="frankenpaxos_tpu/ops/registry.py",
+                    line=0,
+                    message=(
+                        f"plane {name!r}: kernel signature must be the "
+                        f"reference's plus block/interpret (got "
+                        f"{ker_params} vs {ref_params})"
+                    ),
+                    key=f"{name}:signature",
+                )
+            )
+    return out
+
+
+@rule(
+    "kernel-policy-knob",
+    "ast",
+    "every kernel-covered backend's *Config carries a validated "
+    "`kernels: KernelPolicy` knob",
+)
+def check_policy_knob(ctx: Context) -> List[Finding]:
+    if not (ctx.importable and ctx.is_real_tree()):
+        return []
+    from frankenpaxos_tpu.ops import registry
+
+    out: List[Finding] = []
+    for backend in registry.coverage():
+        module = ctx.root / "tpu" / f"{backend}_batched.py"
+        if not module.exists():
+            continue  # kernel-dispatch-coverage already reports this
+        tree = astutil.parse_file(module)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            fields = astutil.ann_fields(cls)
+            if "kernels" not in fields:
+                out.append(
+                    Finding(
+                        rule="kernel-policy-knob",
+                        path=_rel(ctx, module),
+                        line=cls.lineno,
+                        message=f"{cls.name} lacks a `kernels` field",
+                        key=f"{module.name}:{cls.name}:field",
+                    )
+                )
+                continue
+            post = next(
+                (
+                    stmt
+                    for stmt in cls.body
+                    if isinstance(stmt, ast.FunctionDef)
+                    and stmt.name == "__post_init__"
+                ),
+                None,
+            )
+            validates = post is not None and any(
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "validate"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "kernels"
+                for node in ast.walk(post)
+            )
+            if not validates:
+                out.append(
+                    Finding(
+                        rule="kernel-policy-knob",
+                        path=_rel(ctx, module),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name}.__post_init__ must call "
+                            "self.kernels.validate()"
+                        ),
+                        key=f"{module.name}:{cls.name}:validate",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# State-field dead writes (new)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "state-dead-write",
+    "ast",
+    "every batched *State field is read somewhere (package, scripts, "
+    "bench) — a field carried and updated but never consumed is dead "
+    "HBM traffic on every tick sweep",
+)
+def check_dead_writes(ctx: Context) -> List[Finding]:
+    scope = [astutil.parse_file(p) for p in astutil.py_files(ctx.root)]
+    if ctx.is_real_tree():
+        extra = [ctx.repo / "bench.py", *sorted(
+            (ctx.repo / "scripts").glob("*.py")
+        )]
+        scope += [
+            astutil.parse_file(p) for p in extra if p.exists()
+        ]
+    reads = astutil.consumed_attribute_reads(scope)
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "State"):
+            for field in astutil.ann_fields(cls):
+                if field not in reads:
+                    out.append(
+                        Finding(
+                            rule="state-dead-write",
+                            path=_rel(ctx, path),
+                            line=cls.lineno,
+                            message=(
+                                f"{cls.name}.{field} is carried in the "
+                                "scan state but never read anywhere — "
+                                "dead bytes on every bandwidth-bound "
+                                "tick sweep (drop it, or read it)"
+                            ),
+                            key=f"{path.name}:{field}",
+                        )
+                    )
+    return out

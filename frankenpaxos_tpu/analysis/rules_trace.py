@@ -1,0 +1,355 @@
+"""Trace-layer rules: jit every batched backend at its canonical small
+config (``<backend>_batched.analysis_config()``) and inspect what JAX
+actually traces and XLA actually compiles — the contract surface the
+AST layer structurally cannot see.
+
+* ``trace-dtype-policy`` — walks the tick jaxpr's
+  ``convert_element_type``/``iota`` equations and pins the exact
+  multiset of narrow->wide signed-integer conversions per backend
+  against ``allowlists.DTYPE_WIDENING`` (64-bit conversions are never
+  allowed: x64 is off repo-wide). A silent int16->int32 upcast eats the
+  HBM-bandwidth pass even though every AST lint still passes.
+* ``trace-donation-alias`` — compiles ``run_ticks`` and checks the HLO
+  ``input_output_alias`` table actually aliases every State buffer: a
+  donation that fails to alias double-buffers the cluster state.
+* ``trace-retrace-guard`` — calls ``run_ticks`` twice with fresh but
+  EQUAL configs/states and asserts the second call hits the jit cache
+  (hashability/`__eq__`/static-argnum regressions recompile every
+  segment in production).
+
+All jax imports live inside the checks so the AST layer stays
+importable without jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import importlib
+import re
+from typing import Dict, List
+
+from frankenpaxos_tpu.analysis.core import Context, Finding, rule
+
+# backend name -> tpu module stem. The trace layer runs each backend's
+# analysis_config(); adding a backend here (and its analysis_config)
+# is the entire integration cost.
+BACKENDS = (
+    "caspaxos",
+    "craq",
+    "epaxos",
+    "fasterpaxos",
+    "fastmultipaxos",
+    "fastpaxos",
+    "grid",
+    "horizontal",
+    "mencius",
+    "multipaxos",
+    "scalog",
+    "unreplicated",
+    "vanillamencius",
+)
+
+_TICKS = 2  # run_ticks horizon for the compiled-artifact rules
+
+
+def _jax_cache_setup() -> None:
+    """Enable the persistent XLA compilation cache (same knob as
+    tests/conftest.py) so repeated CLI/CI runs skip the backend
+    compiles the donation/retrace rules trigger."""
+    import os
+
+    import jax
+
+    cache_dir = os.environ.get(
+        "FRANKENPAXOS_JAX_CACHE", "/tmp/frankenpaxos_jax_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
+    except Exception:
+        pass  # older jax without the persistent cache: run uncached
+
+
+def _module(backend: str):
+    return importlib.import_module(
+        f"frankenpaxos_tpu.tpu.{backend}_batched"
+    )
+
+
+def _selected(ctx: Context) -> List[str]:
+    if ctx.backends is None:
+        return list(BACKENDS)
+    unknown = [b for b in ctx.backends if b not in BACKENDS]
+    if unknown:
+        raise KeyError(
+            f"unknown backend(s) {unknown}; known: {sorted(BACKENDS)}"
+        )
+    return list(ctx.backends)
+
+
+def _walk_eqns(jaxpr, out: list) -> None:
+    """All equations of ``jaxpr`` including every nested sub-jaxpr
+    (pjit/scan/while/cond bodies)."""
+    for eqn in jaxpr.eqns:
+        out.append(eqn)
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                sub = getattr(item, "jaxpr", None)
+                if sub is not None:
+                    _walk_eqns(sub, out)
+                elif hasattr(item, "eqns"):
+                    _walk_eqns(item, out)
+
+
+def _tick_eqns(backend: str) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    mod = _module(backend)
+    cfg = mod.analysis_config()
+    state = mod.init_state(cfg)
+    closed = jax.make_jaxpr(
+        lambda s, t, k: mod.tick(cfg, s, t, k)
+    )(state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+    eqns: list = []
+    _walk_eqns(closed.jaxpr, eqns)
+    return eqns
+
+
+@rule(
+    "trace-dtype-policy",
+    "trace",
+    "the compiled tick contains exactly the allowlisted narrow->wide "
+    "integer conversions, and no 64-bit conversions/iotas at all",
+)
+def check_dtype_policy(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.analysis.allowlists import DTYPE_WIDENING
+
+    out: List[Finding] = []
+    # A pin keyed by a backend name that does not exist at all is a
+    # typo or a leftover from a renamed/deleted backend — it can never
+    # match any trace, so it silently exempts nothing (pins for real
+    # backends simply not selected this run are fine).
+    for b, conv in sorted(set(DTYPE_WIDENING) - {
+        (b, c) for (b, c) in DTYPE_WIDENING if b in BACKENDS
+    }):
+        out.append(
+            Finding(
+                rule="trace-dtype-policy",
+                path="frankenpaxos_tpu/analysis/allowlists.py",
+                line=0,
+                message=(
+                    f"DTYPE_WIDENING pin ({b!r}, {conv!r}) names an "
+                    "unknown backend — remove or fix it (known: "
+                    f"{sorted(BACKENDS)})"
+                ),
+                key=f"{b}:{conv}:unknown-backend",
+            )
+        )
+    for backend in _selected(ctx):
+        observed: Dict[str, int] = collections.Counter()
+        for eqn in _tick_eqns(backend):
+            name = eqn.primitive.name
+            if name == "convert_element_type":
+                src = eqn.invars[0].aval.dtype
+                dst = jnp.dtype(eqn.params["new_dtype"])
+                if dst.itemsize > 4:
+                    out.append(
+                        Finding(
+                            rule="trace-dtype-policy",
+                            path=backend,
+                            line=0,
+                            message=(
+                                f"tick jaxpr converts {src} -> "
+                                f"{dst.name} (64-bit is never allowed; "
+                                "x64 must stay off)"
+                            ),
+                            key=f"{backend}:{src}->{dst.name}:64bit",
+                        )
+                    )
+                elif (
+                    jnp.issubdtype(src, jnp.signedinteger)
+                    and jnp.issubdtype(dst, jnp.signedinteger)
+                    and dst.itemsize > src.itemsize
+                ):
+                    observed[f"{src}->{dst.name}"] += 1
+            elif name == "iota":
+                d = jnp.dtype(eqn.params["dtype"])
+                if d.itemsize > 4:
+                    out.append(
+                        Finding(
+                            rule="trace-dtype-policy",
+                            path=backend,
+                            line=0,
+                            message=f"tick jaxpr builds a {d.name} iota",
+                            key=f"{backend}:iota:{d.name}",
+                        )
+                    )
+        expected = {
+            conv: spec
+            for (b, conv), spec in DTYPE_WIDENING.items()
+            if b == backend
+        }
+        for conv in sorted(set(observed) | set(expected)):
+            got = observed.get(conv, 0)
+            want = expected.get(conv, (0, ""))[0]
+            if got != want:
+                out.append(
+                    Finding(
+                        rule="trace-dtype-policy",
+                        path=backend,
+                        line=0,
+                        message=(
+                            f"tick jaxpr has {got} {conv} widening "
+                            f"conversion(s), allowlist pins {want} — "
+                            "a new widening is a silent HBM "
+                            "regression; a removed one must shrink "
+                            "the DTYPE_WIDENING pin (allowlists.py) "
+                            "so the budget can't absorb a future "
+                            "regression"
+                        ),
+                        key=f"{backend}:{conv}",
+                    )
+                )
+    # Pins for backends this run never traced are NOT stale — only
+    # flag pins whose backend ran and whose conversion never appeared
+    # in either direction (handled above via want != got == 0).
+    return out
+
+
+def _alias_param_indices(hlo_text: str) -> set:
+    """Parameter numbers that appear as alias SOURCES in the compiled
+    module's ``input_output_alias={ {out}: (param, {}, kind), ... }``
+    table (balanced-brace scan: the table nests ``{}`` index paths)."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = hlo_text.index("{", start)
+    depth = 0
+    for j in range(i, len(hlo_text)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    table = hlo_text[i : j + 1]
+    return {int(p) for p in re.findall(r":\s*\((\d+),", table)}
+
+
+@rule(
+    "trace-donation-alias",
+    "trace",
+    "the compiled run_ticks HLO input_output_alias table aliases every "
+    "State buffer (donation actually took effect)",
+)
+def check_donation_alias(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+    import jax.numpy as jnp
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        mod = _module(backend)
+        cfg = mod.analysis_config()
+        state = mod.init_state(cfg)
+        n_leaves = len(jax.tree_util.tree_leaves(state))
+        lowered = mod.run_ticks.lower(
+            cfg,
+            state,
+            jnp.zeros((), jnp.int32),
+            _TICKS,
+            jax.random.PRNGKey(0),
+        )
+        hlo = lowered.compile().as_text()
+        aliased = _alias_param_indices(hlo)
+        # jit flattens (state, t0, key) in order, so the donated state
+        # leaves are exactly parameters [0, n_leaves).
+        missing = sorted(set(range(n_leaves)) - aliased)
+        if missing:
+            out.append(
+                Finding(
+                    rule="trace-donation-alias",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"{len(missing)} of {n_leaves} donated State "
+                        f"buffers are NOT aliased in the compiled HLO "
+                        f"(parameter indices {missing[:8]}...) — "
+                        "donation silently fell back to "
+                        "double-buffering"
+                    ),
+                    key=backend,
+                )
+            )
+    return out
+
+
+@rule(
+    "trace-retrace-guard",
+    "trace",
+    "a second run_ticks call with a fresh but equal config hits the "
+    "jit cache — no hashability/static-arg retrace regressions",
+)
+def check_retrace_guard(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+    import jax.numpy as jnp
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        mod = _module(backend)
+
+        def call():
+            cfg = mod.analysis_config()  # fresh object each call
+            state = mod.init_state(cfg)
+            st, t = mod.run_ticks(
+                cfg,
+                state,
+                jnp.zeros((), jnp.int32),
+                _TICKS,
+                jax.random.PRNGKey(0),
+            )
+            jax.block_until_ready(t)
+
+        try:
+            call()
+        except TypeError as e:
+            out.append(
+                Finding(
+                    rule="trace-retrace-guard",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"run_ticks rejected its analysis_config as a "
+                        f"static argument (unhashable?): {e}"
+                    ),
+                    key=f"{backend}:unhashable",
+                )
+            )
+            continue
+        before = mod.run_ticks._cache_size()
+        call()
+        after = mod.run_ticks._cache_size()
+        if after > before:
+            out.append(
+                Finding(
+                    rule="trace-retrace-guard",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "a second run_ticks call with an EQUAL config "
+                        f"missed the jit cache ({before} -> {after} "
+                        "entries) — the config's __eq__/__hash__ or a "
+                        "non-hashable field retraces every segment"
+                    ),
+                    key=backend,
+                )
+            )
+    return out

@@ -102,18 +102,12 @@ def _specs() -> Dict[str, SimSpec]:
     entries = [
         SimSpec(
             "multipaxos", mp,
-            lambda f: mp.BatchedMultiPaxosConfig(
-                f=1, num_groups=4, window=16, slots_per_tick=2,
-                retry_timeout=8, faults=f,
-            ),
+            mp.analysis_config,
             lambda st: st.committed, partition_axis=3,
         ),
         SimSpec(
             "mencius", me,
-            lambda f: me.BatchedMenciusConfig(
-                f=1, num_leaders=4, window=16, slots_per_tick=2,
-                retry_timeout=8, faults=f,
-            ),
+            me.analysis_config,
             lambda st: st.committed, partition_axis=3,
             # A crashed mencius leader pins the global watermark (plain
             # Mencius has no revocation); commits still advance, but a
@@ -123,57 +117,38 @@ def _specs() -> Dict[str, SimSpec]:
         ),
         SimSpec(
             "vanillamencius", vm,
-            lambda f: vm.BatchedVanillaMenciusConfig(
-                num_servers=4, window=16, slots_per_tick=2,
-                retry_timeout=8, faults=f,
-            ),
+            vm.analysis_config,
             lambda st: st.committed, partition_axis=3,
         ),
         SimSpec(
             "fasterpaxos", fx,
-            lambda f: fx.BatchedFasterPaxosConfig(
-                num_groups=4, window=8, slots_per_tick=2,
-                retry_timeout=8, faults=f,
-            ),
+            fx.analysis_config,
             lambda st: st.committed, partition_axis=3,
         ),
         SimSpec(
             "horizontal", hz,
-            lambda f: hz.BatchedHorizontalConfig(
-                num_groups=4, window=16, slots_per_tick=2, alpha=8,
-                retry_timeout=8, faults=f,
-            ),
+            hz.analysis_config,
             lambda st: st.committed, partition_axis=6,
         ),
         SimSpec(
             "grid", gr,
-            lambda f: gr.GridBatchedConfig(
-                rows=3, cols=3, window=16, slots_per_tick=2,
-                retry_timeout=8, faults=f,
-            ),
+            gr.analysis_config,
             lambda st: st.committed, partition_axis=9, crash_ok=False,
         ),
         SimSpec(
             "fastmultipaxos", fm,
-            lambda f: fm.BatchedFastMultiPaxosConfig(
-                num_groups=4, window=16, cmd_window=16, cmds_per_tick=2,
-                faults=f,
-            ),
+            fm.analysis_config,
             lambda st: st.committed_slots, partition_axis=3,
             crash_ok=False,
         ),
         SimSpec(
             "fastpaxos", fpx,
-            lambda f: fpx.BatchedFastPaxosConfig(
-                num_groups=4, window=16, instances_per_tick=2, faults=f,
-            ),
+            fpx.analysis_config,
             lambda st: st.chosen_total, partition_axis=3, crash_ok=False,
         ),
         SimSpec(
             "caspaxos", cp,
-            lambda f: cp.BatchedCasPaxosConfig(
-                num_registers=4, num_leaders=2, op_rate=0.3, faults=f,
-            ),
+            cp.analysis_config,
             lambda st: st.commits, partition_axis=3, crash_ok=False,
             # CASPaxos leaders stall while a quorum is cut and their
             # exchanges buffer to the heal tick; commits resume, but a
@@ -182,33 +157,24 @@ def _specs() -> Dict[str, SimSpec]:
         ),
         SimSpec(
             "craq", cr,
-            lambda f: cr.BatchedCraqConfig(
-                num_chains=4, chain_len=3, num_keys=8, window=8,
-                writes_per_tick=2, reads_per_tick=2, read_window=8,
-                faults=f,
-            ),
+            cr.analysis_config,
             lambda st: st.writes_done, partition_axis=3, crash_ok=False,
         ),
         SimSpec(
             "epaxos", ep,
-            lambda f: ep.BatchedEPaxosConfig(
-                num_columns=5, window=32, instances_per_tick=2,
-                num_exec_replicas=3, faults=f,
-            ),
+            ep.analysis_config,
             lambda st: st.committed_total, partition_axis=5,
             # frontier_history=256, lat_max=3: span + 24 < 256.
             max_partition_span=200,
         ),
         SimSpec(
             "scalog", sc,
-            lambda f: sc.BatchedScalogConfig(num_shards=4, faults=f),
+            sc.analysis_config,
             lambda st: st.committed_cuts, partition_axis=4,
         ),
         SimSpec(
             "unreplicated", ur,
-            lambda f: ur.BatchedUnreplicatedConfig(
-                num_servers=4, window=16, ops_per_tick=2, faults=f,
-            ),
+            ur.analysis_config,
             lambda st: st.done, partition_axis=4, crash_ok=False,
         ),
     ]

@@ -538,3 +538,17 @@ def stats(cfg: BatchedCasPaxosConfig, state: BatchedCasPaxosState, t) -> dict:
         "bit_latency_p50_ticks": p50,
         "chain_violations": int(state.chain_violations),
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedCasPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedCasPaxosConfig(
+        num_registers=4, num_leaders=2, op_rate=0.3, faults=faults,
+    )

@@ -567,3 +567,19 @@ def stats(cfg: BatchedCraqConfig, state: BatchedCraqState, t) -> dict:
         "clean_fraction": clean / max(1, clean + dirty),
         "read_lin_violations": int(state.read_lin_violations),
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedCraqConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedCraqConfig(
+        num_chains=4, chain_len=3, num_keys=8, window=8,
+        writes_per_tick=2, reads_per_tick=2, read_window=8,
+        faults=faults,
+    )

@@ -728,3 +728,18 @@ def check_invariants(
             state.rep_exec <= state.exec_wm[None, :]
         )
     return out
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedEPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedEPaxosConfig(
+        num_columns=5, window=32, instances_per_tick=2,
+        num_exec_replicas=3, faults=faults,
+    )

@@ -560,3 +560,18 @@ def stats(
         "safety_violations": int(state.safety_violations),
         "cmd_latency_p50_ticks": p50,
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedFastMultiPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedFastMultiPaxosConfig(
+        num_groups=4, window=16, cmd_window=16, cmds_per_tick=2,
+        faults=faults,
+    )

@@ -559,3 +559,17 @@ def stats(cfg: BatchedFastPaxosConfig, state: BatchedFastPaxosState, t) -> dict:
         ),
         "safety_violations": int(state.safety_violations),
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedFastPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedFastPaxosConfig(
+        num_groups=4, window=16, instances_per_tick=2, faults=faults,
+    )

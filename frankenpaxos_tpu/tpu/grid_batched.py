@@ -388,3 +388,18 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> GridBatchedConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return GridBatchedConfig(
+        rows=3, cols=3, window=16, slots_per_tick=2,
+        retry_timeout=8, faults=faults,
+    )

@@ -569,3 +569,18 @@ def stats(
         ),
         "bank_violations": int(state.bank_violations),
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedHorizontalConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedHorizontalConfig(
+        num_groups=4, window=16, slots_per_tick=2, alpha=8,
+        retry_timeout=8, faults=faults,
+    )

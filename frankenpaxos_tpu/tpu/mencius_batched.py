@@ -446,3 +446,18 @@ def check_invariants(
         "quorum_ok": quorum_ok,
         "head_ok": head_ok,
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedMenciusConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedMenciusConfig(
+        f=1, num_leaders=4, window=16, slots_per_tick=2,
+        retry_timeout=8, faults=faults,
+    )

@@ -1586,3 +1586,18 @@ def check_invariants(
         "rc_books_ok": rc_books_ok,
         "mm_ok": mm_ok,
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedMultiPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2,
+        retry_timeout=8, faults=faults,
+    )

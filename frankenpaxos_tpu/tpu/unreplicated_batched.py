@@ -215,3 +215,17 @@ def stats(cfg, state, t) -> dict:
         "latency_p50_ticks": p50,
         "latency_mean_ticks": float(state.lat_sum) / done if done else -1.0,
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedUnreplicatedConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedUnreplicatedConfig(
+        num_servers=4, window=16, ops_per_tick=2, faults=faults,
+    )

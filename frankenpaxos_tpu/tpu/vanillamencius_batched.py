@@ -584,3 +584,18 @@ def stats(
         "choose_violations": int(state.choose_violations),
         "latency_p50_ticks": p50,
     }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedVanillaMenciusConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every protocol plane, small enough to trace and compile in
+    well under a second."""
+    return BatchedVanillaMenciusConfig(
+        num_servers=4, window=16, slots_per_tick=2,
+        retry_timeout=8, faults=faults,
+    )

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Repo-wide static analysis: runs the full frankenpaxos_tpu.analysis
+# rule registry (AST contract rules + jaxpr/HLO trace rules) and exits
+# with the finding count — 0 means every contract from PRs 1-4 holds in
+# both the source and what XLA actually compiles. This is the one-shot
+# CI entry point; `pytest -m lint` enforces the same registry per-rule.
+#
+# Usage:
+#   scripts/lint.sh              # human-readable findings, exit = count
+#   scripts/lint.sh --json       # structured report on stdout
+#   scripts/lint.sh --rule ID    # any frankenpaxos_tpu.analysis flag
+set -u
+cd "$(dirname "$0")/.."
+exec python -m frankenpaxos_tpu.analysis "$@"

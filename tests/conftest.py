@@ -44,3 +44,8 @@ def pytest_configure(config):
         "slow: heavy cases excluded from the tier-1 budget "
         "(run with -m slow or no marker filter)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lint: static-analysis suite (frankenpaxos_tpu.analysis rule "
+        "wrappers + engine tests); `pytest -m lint` runs just these",
+    )

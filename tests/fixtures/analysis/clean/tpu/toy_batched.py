@@ -1,0 +1,61 @@
+# Synthetic CLEAN backend for the analysis-engine tests: satisfies
+# every AST-layer contract rule. Parsed only, never imported — names
+# like FaultPlan/Telemetry need not resolve.
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyConfig:
+    n: int = 4
+    loss_rate: float = 0.0
+    faults: FaultPlan = None  # noqa: F821
+
+    def __post_init__(self):
+        assert 0.0 <= self.loss_rate <= 1.0, self.loss_rate
+        self.faults.validate(self.n)
+
+
+@dataclasses.dataclass
+class ToyState:
+    counter: jnp.ndarray
+    telemetry: Telemetry  # noqa: F821
+
+
+def init_state(cfg: ToyConfig) -> ToyState:
+    return ToyState(
+        counter=jnp.zeros((cfg.n,), jnp.int32),
+        telemetry=make_telemetry(),  # noqa: F821
+    )
+
+
+def tick(cfg: ToyConfig, state: ToyState, t, key):
+    drop = faults_mod.message_faults(cfg.faults, key)  # noqa: F821
+    tel = record(state.telemetry, commits=state.counter)  # noqa: F821
+    return dataclasses.replace(
+        state, counter=state.counter + (1 - drop), telemetry=tel
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+def run_ticks(cfg: ToyConfig, state: ToyState, t0, num_ticks: int, key):
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks)
+    )
+    return state, t
+
+
+def stats(cfg, state, t) -> dict:
+    # Reads every State field, so nothing is a dead write.
+    return {
+        "counter": int(state.counter.sum()),
+        "telemetry": state.telemetry,
+    }

@@ -1,0 +1,12 @@
+# Helper module for the DIRTY fixture tree: the host sync lives one
+# module away from the tick that calls it, so only a TRANSITIVE purity
+# walk (not the old inline-only lint) can catch it.
+import jax
+from numpy import asarray
+
+
+def pull(x):
+    jax.block_until_ready(x)
+    # host-sync-purity: a BARE from-imported asarray (numpy's) is a
+    # host materialization just like np.asarray.
+    return asarray(x)

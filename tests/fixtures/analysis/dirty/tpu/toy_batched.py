@@ -1,0 +1,79 @@
+# Synthetic DIRTY backend for the analysis-engine tests: violates every
+# AST-layer contract rule at least once (the expected finding set is
+# asserted in test_analysis_engine.py). Parsed only, never imported.
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dirty.tpu import helpers
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyConfig:
+    # fault-config-field: no `faults: FaultPlan` field.
+    n: int = 4
+    # fault-rate-validated: never range-checked below.
+    loss_rate: float = 0.0
+
+    def __post_init__(self):
+        # fault-validate: no faults.validate(...) call.
+        pass
+
+
+@dataclasses.dataclass
+class ToyState:
+    # telemetry-state-carry: no `telemetry: Telemetry` field.
+    counter: jnp.ndarray
+    # state-dead-write: written in tick, read nowhere.
+    ghost: jnp.ndarray
+
+
+def init_state(cfg: ToyConfig) -> ToyState:
+    return ToyState(
+        counter=jnp.zeros((cfg.n,), jnp.int32),
+        ghost=jnp.zeros((cfg.n,), jnp.int32),
+    )
+
+
+def _inline_sync(x):
+    # host-sync-purity (transitive, same module): reached from tick.
+    return jax.device_get(x)
+
+
+def tick(cfg: ToyConfig, state: ToyState, t, key):
+    # telemetry-tick-records: no record() call.
+    # fault-apply: never touches cfg.faults / faults_mod.
+    snapshot = _inline_sync(state.counter)
+    remote = helpers.pull(state.counter)
+    del snapshot, remote
+    return dataclasses.replace(
+        state, counter=state.counter + 1, ghost=state.ghost + 1
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_ticks(cfg: ToyConfig, state: ToyState, t0, num_ticks: int, key):
+    # donation-jit: jitted *State entry point without donate_argnums.
+    # host-sync-purity (inline): numpy materialization in-graph.
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks)
+    )
+    return state, np.asarray(t)
+
+
+def reach_for_pallas(x):
+    # kernel-pallas-containment: pallas_call outside ops/.
+    return pl.pallas_call(lambda ref: ref, out_shape=x)  # noqa: F821
+
+
+def stats(cfg, state, t) -> dict:
+    # Reads `counter` but NOT `ghost` — ghost stays a dead write.
+    return {"counter": int(state.counter.sum())}

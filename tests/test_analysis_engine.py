@@ -1,0 +1,225 @@
+"""The static-analysis engine's own tests: per-rule positive/negative
+synthetic fixtures (``tests/fixtures/analysis/{clean,dirty}/``),
+allowlist application + stale-entry rejection, and the CLI contract
+(exit code = finding count, ``--json`` schema, ``--list``).
+
+The fixture trees are PARSED, never imported — they are mini package
+roots with a ``tpu/`` directory, so every AST rule runs against them
+exactly as it runs against ``frankenpaxos_tpu/``.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from frankenpaxos_tpu import analysis
+from frankenpaxos_tpu.analysis import allowlists, core
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+# Every pure-AST rule (the registry-introspection kernel rules and the
+# trace layer need an importable real tree and are covered by their own
+# wrappers/tests).
+FIXTURE_RULES = [
+    "donation-jit",
+    "telemetry-state-carry",
+    "telemetry-tick-records",
+    "host-sync-purity",
+    "fault-config-field",
+    "fault-validate",
+    "fault-apply",
+    "fault-rate-validated",
+    "kernel-pallas-containment",
+    "state-dead-write",
+]
+
+
+def run_on(root: str, rule_ids, min_backends: int = 1) -> core.Report:
+    ctx = core.Context(
+        root=FIXTURES / root,
+        repo=FIXTURES,
+        min_backends=min_backends,
+        importable=False,
+    )
+    return core.run(rule_ids=rule_ids, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# Fixture coverage: every rule passes on clean, fires on dirty
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", FIXTURE_RULES)
+def test_rule_negative_on_clean_fixture(rule_id):
+    report = run_on("clean", [rule_id])
+    assert not report.findings, "\n" + report.format()
+
+
+@pytest.mark.parametrize("rule_id", FIXTURE_RULES)
+def test_rule_positive_on_dirty_fixture(rule_id):
+    report = run_on("dirty", [rule_id])
+    assert report.findings, f"rule {rule_id} has no teeth on dirty tree"
+    assert all(f.rule == rule_id for f in report.findings)
+
+
+def test_dirty_fixture_expected_keys():
+    """The dirty tree produces exactly the violations it documents —
+    pinned by key so a matcher regression (missing OR spurious
+    findings) is visible."""
+    report = run_on("dirty", FIXTURE_RULES)
+    keys = {(f.rule, f.key) for f in report.findings}
+    expected = {
+        ("donation-jit", "toy_batched.py:run_ticks"),
+        ("telemetry-state-carry", "toy_batched.py:ToyState"),
+        ("telemetry-tick-records", "toy_batched.py"),
+        ("host-sync-purity", "toy_batched.py:_inline_sync:device_get"),
+        ("host-sync-purity", "helpers.py:pull:block_until_ready"),
+        ("host-sync-purity", "helpers.py:pull:asarray"),
+        ("host-sync-purity", "toy_batched.py:run_ticks:asarray"),
+        ("fault-config-field", "toy_batched.py:ToyConfig"),
+        ("fault-validate", "toy_batched.py:ToyConfig"),
+        ("fault-apply", "toy_batched.py"),
+        ("fault-rate-validated", "toy_batched.py:ToyConfig:loss_rate"),
+        ("kernel-pallas-containment", "tpu/toy_batched.py"),
+        ("state-dead-write", "toy_batched.py:ghost"),
+    }
+    assert keys == expected, keys.symmetric_difference(expected)
+
+
+def test_transitive_host_sync_is_the_new_coverage():
+    """The smuggled-through-a-helper syncs (same-module helper and a
+    cross-module helpers.py call) are exactly what the old inline-only
+    lint could not see."""
+    report = run_on("dirty", ["host-sync-purity"])
+    keys = {f.key for f in report.findings}
+    assert "toy_batched.py:_inline_sync:device_get" in keys
+    assert "helpers.py:pull:block_until_ready" in keys
+
+
+def test_backend_inventory_floor():
+    assert not run_on("clean", ["backend-inventory"]).findings
+    report = run_on("clean", ["backend-inventory"], min_backends=2)
+    assert [f.key for f in report.findings] == ["count"]
+
+
+# ---------------------------------------------------------------------------
+# Allowlist semantics
+# ---------------------------------------------------------------------------
+
+
+def test_allowlist_suppresses_by_key(monkeypatch):
+    monkeypatch.setitem(
+        allowlists.SUPPRESS,
+        "donation-jit",
+        {"toy_batched.py:run_ticks": "fixture exercise"},
+    )
+    report = run_on("dirty", ["donation-jit"])
+    assert not report.findings
+    assert [s["key"] for s in report.allowlisted] == [
+        "toy_batched.py:run_ticks"
+    ]
+    assert report.allowlisted[0]["reason"] == "fixture exercise"
+
+
+def test_stale_allowlist_entry_is_a_finding(monkeypatch):
+    """A typo'd/outdated allowlist key silently exempts nothing — the
+    engine turns it into an `allowlist-stale` finding."""
+    monkeypatch.setitem(
+        allowlists.SUPPRESS,
+        "donation-jit",
+        {"gone_batched.py:no_such_fn": "stale reason"},
+    )
+    report = run_on("clean", ["donation-jit"])
+    assert [f.rule for f in report.findings] == [core.STALE_RULE]
+    assert "gone_batched.py:no_such_fn" in report.findings[0].message
+
+
+def test_suppress_block_for_unknown_rule_id_is_a_finding(monkeypatch):
+    """A SUPPRESS block keyed by a rule id that is not registered
+    (typo, renamed rule) would never be examined by any rule's
+    suppression pass — the engine flags the block itself."""
+    monkeypatch.setitem(
+        allowlists.SUPPRESS,
+        "donation_jit",  # underscore typo for donation-jit
+        {"toy_batched.py:run_ticks": "misrouted exemption"},
+    )
+    report = run_on("clean", ["donation-jit"])
+    assert [f.rule for f in report.findings] == [core.STALE_RULE]
+    assert report.findings[0].key == "donation_jit:<unknown-rule>"
+
+
+def test_dtype_pin_for_unknown_backend_is_a_finding(monkeypatch):
+    """A DTYPE_WIDENING pin naming a nonexistent backend can never
+    match a trace — it is a typo/rename leftover and must be flagged
+    even on runs that trace no backends at all."""
+    monkeypatch.setitem(
+        allowlists.DTYPE_WIDENING,
+        ("fasterpaxo", "int16->int32"),  # typo for fasterpaxos
+        (5, "typo'd pin"),
+    )
+    ctx = core.Context(backends=())  # stale-pin scan only, no compiles
+    report = core.run(rule_ids=["trace-dtype-policy"], ctx=ctx)
+    assert [f.key for f in report.findings] == [
+        "fasterpaxo:int16->int32:unknown-backend"
+    ]
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(KeyError, match="no-such-rule"):
+        analysis.run(rule_ids=["no-such-rule"])
+
+
+def test_rule_registry_shape():
+    n = analysis.rule_count()
+    assert n >= 17, sorted(core.RULES)
+    layers = {r.layer for r in core.RULES.values()}
+    assert layers == {"ast", "trace"}
+    assert all(r.doc for r in core.RULES.values())
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "frankenpaxos_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        timeout=600,
+    )
+
+
+def test_cli_ast_layer_json_smoke():
+    """`--layer ast --json`: exit 0 on the clean repo, structured
+    report on stdout."""
+    proc = _cli("--layer", "ast", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["version"] == analysis.ANALYSIS_VERSION
+    assert report["finding_count"] == 0
+    assert report["findings"] == []
+    assert set(report["rules_run"]) >= set(FIXTURE_RULES)
+    for entry in report["allowlisted"]:
+        assert {"rule", "path", "line", "message", "key", "reason"} <= set(
+            entry
+        )
+
+
+def test_cli_single_rule_and_list():
+    proc = _cli("--rule", "donation-jit")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    listing = _cli("--list")
+    assert listing.returncode == 0
+    for rid in ("donation-jit", "trace-dtype-policy", "host-sync-purity"):
+        assert rid in listing.stdout
+
+    bogus = _cli("--rule", "no-such-rule")
+    assert bogus.returncode == 2  # usage error, not a finding count

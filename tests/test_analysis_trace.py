@@ -1,0 +1,81 @@
+"""Trace-layer rules over every batched backend: what XLA actually
+compiles must honor the dtype policy (no unallowlisted narrow->wide
+conversions in the tick jaxpr), donation must actually alias the State
+buffers in the compiled HLO, and an equal config must hit the jit
+cache. One test per backend so a regression localizes immediately.
+
+These are the checks the AST lints structurally cannot make — a silent
+``int16 -> int32`` upcast, a donation that fails to alias, or a
+config-hashability retrace all pass every syntax lint while eating the
+HBM/throughput wins. Compile cost is bounded by the tiny
+``analysis_config()`` shapes plus the persistent XLA compilation cache
+(conftest.py / rules_trace._jax_cache_setup).
+"""
+
+import pytest
+
+from frankenpaxos_tpu.analysis import allowlists, core, rules_trace
+
+pytestmark = pytest.mark.lint
+
+TRACE_RULES = [
+    "trace-dtype-policy",
+    "trace-donation-alias",
+    "trace-retrace-guard",
+]
+
+
+@pytest.mark.parametrize("backend", rules_trace.BACKENDS)
+def test_trace_rules_clean(backend):
+    ctx = core.Context(backends=(backend,))
+    report = core.run(rule_ids=TRACE_RULES, ctx=ctx)
+    assert not report.findings, "\n" + report.format()
+
+
+def test_all_backends_registered():
+    """The trace layer covers every batched backend module."""
+    import pathlib
+
+    from frankenpaxos_tpu.analysis import astutil
+
+    stems = {
+        p.name[: -len("_batched.py")]
+        for p in astutil.batched_files(astutil.PKG_ROOT)
+    }
+    assert stems == set(rules_trace.BACKENDS)
+    assert len(rules_trace.BACKENDS) >= 13
+    del pathlib
+
+
+def test_dtype_pin_has_teeth(monkeypatch):
+    """A DTYPE_WIDENING pin that the jaxpr does not satisfy (here: a
+    conversion that never happens) must produce a mismatch finding —
+    the exact-count pin rejects drift in BOTH directions."""
+    monkeypatch.setitem(
+        allowlists.DTYPE_WIDENING,
+        ("unreplicated", "int8->int32"),
+        (3, "synthetic pin for the teeth test"),
+    )
+    ctx = core.Context(backends=("unreplicated",))
+    report = core.run(rule_ids=["trace-dtype-policy"], ctx=ctx)
+    assert [f.key for f in report.findings] == ["unreplicated:int8->int32"]
+    assert "pins 3" in report.findings[0].message
+
+
+def test_alias_table_parser():
+    """The HLO input_output_alias scraper handles the nested-brace
+    table format (balanced-brace scan, not a fragile regex)."""
+    hlo = (
+        "HloModule jit_run_ticks, is_scheduled=true, "
+        "input_output_alias={ {0}: (0, {}, may-alias), "
+        "{1}: (2, {}, must-alias), {12}: (11, {}, may-alias) }, "
+        "entry_computation_layout={(s8[4,16]{1,0})->(s8[4,16]{1,0})}"
+    )
+    assert rules_trace._alias_param_indices(hlo) == {0, 2, 11}
+    assert rules_trace._alias_param_indices("HloModule bare") == set()
+
+
+def test_unknown_backend_raises():
+    ctx = core.Context(backends=("no-such-backend",))
+    with pytest.raises(KeyError, match="no-such-backend"):
+        core.run(rule_ids=["trace-dtype-policy"], ctx=ctx)

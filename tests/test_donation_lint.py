@@ -1,158 +1,26 @@
-"""AST lint: every jitted state-threading entry point in
-``frankenpaxos_tpu/tpu/`` must donate its state buffers.
+"""Donation contract (thin wrapper): every jitted *State-threading
+entry point in ``tpu/`` must donate its state buffers.
 
-The HBM-bandwidth pass made buffer donation the repo-wide contract: a
-``@jax.jit``-decorated function that threads a ``*State`` dataclass
-(parameter annotated ``...State``) without ``donate_argnums`` silently
-double-buffers the whole cluster state in device memory — exactly the
-regression this lint exists to catch. New backends get the contract for
-free: add the backend, forget the donation, this test fails.
+The actual checker is the ``donation-jit`` rule in
+``frankenpaxos_tpu/analysis`` (plus ``backend-inventory`` for the
+13-backend floor); this file just binds it into tier-1. The rule's
+teeth — that the decorator matcher really parses ``@functools.partial
+(jax.jit, ...)`` shapes and that violations are flagged — are exercised
+against synthetic fixture trees in ``test_analysis_engine.py``. The
+COMPILED counterpart (donation actually aliasing in the HLO) is the
+``trace-donation-alias`` rule in ``test_analysis_trace.py``.
 
-Intentional exceptions go in ALLOWLIST with a reason.
+Intentional exceptions go in ``analysis/allowlists.py`` with a reason.
 """
-
-import ast
-import pathlib
 
 import pytest
 
-TPU_DIR = (
-    pathlib.Path(__file__).resolve().parent.parent
-    / "frankenpaxos_tpu"
-    / "tpu"
-)
+from frankenpaxos_tpu import analysis
 
-# (filename, function name) -> reason the exception is intentional.
-ALLOWLIST = {
-    # Nothing is currently exempt. Example entry:
-    # ("foo_batched.py", "replay_ticks"):
-    #     "replay keeps the input state for post-hoc divergence dumps",
-}
+pytestmark = pytest.mark.lint
 
 
-def _jit_decorator_info(dec):
-    """(is_jit, has_donate) for one decorator expression, matching
-    ``@jax.jit`` and ``@functools.partial(jax.jit, ...)`` shapes."""
-
-    def is_jax_jit(node):
-        return (
-            isinstance(node, ast.Attribute)
-            and node.attr == "jit"
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "jax"
-        )
-
-    if is_jax_jit(dec):
-        return True, False
-    if isinstance(dec, ast.Call):
-        callee = dec.func
-        # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
-        is_partial = (
-            isinstance(callee, ast.Attribute) and callee.attr == "partial"
-        ) or (isinstance(callee, ast.Name) and callee.id == "partial")
-        if is_partial and dec.args and is_jax_jit(dec.args[0]):
-            has_donate = any(
-                kw.arg in ("donate_argnums", "donate_argnames")
-                for kw in dec.keywords
-            )
-            return True, has_donate
-        # jax.jit(...) called directly as a decorator factory
-        if is_jax_jit(callee):
-            has_donate = any(
-                kw.arg in ("donate_argnums", "donate_argnames")
-                for kw in dec.keywords
-            )
-            return True, has_donate
-    return False, False
-
-
-def _threads_state(func: ast.FunctionDef) -> bool:
-    """True iff some parameter annotation names a *State dataclass."""
-    for arg in func.args.args + func.args.posonlyargs + func.args.kwonlyargs:
-        ann = arg.annotation
-        if ann is None:
-            continue
-        text = ast.unparse(ann)
-        if "State" in text:
-            return True
-    # Fallback for unannotated entry points (e.g. grid_batched.run_ticks):
-    # the repo-wide convention names the threaded state parameter
-    # ``state``.
-    return any(
-        a.arg == "state"
-        for a in func.args.args + func.args.posonlyargs
-    )
-
-
-def _lint_file(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        jitted = False
-        donated = False
-        for dec in node.decorator_list:
-            is_jit, has_donate = _jit_decorator_info(dec)
-            jitted = jitted or is_jit
-            donated = donated or has_donate
-        if not jitted or not _threads_state(node):
-            continue
-        if donated:
-            continue
-        if (path.name, node.name) in ALLOWLIST:
-            continue
-        offenders.append((path.name, node.name, node.lineno))
-    return offenders
-
-
-def test_tpu_backends_exist():
-    files = sorted(TPU_DIR.glob("*_batched.py"))
-    assert len(files) >= 13, [f.name for f in files]
-
-
-def test_every_jitted_state_entry_point_donates():
-    offenders = []
-    for path in sorted(TPU_DIR.glob("*.py")):
-        offenders.extend(_lint_file(path))
-    assert not offenders, (
-        "jitted *State-threading entry points without donate_argnums "
-        "(single-buffer contract, see tpu/common.py dtype/donation "
-        f"policy) — add donation or an ALLOWLIST entry: {offenders}"
-    )
-
-
-def test_allowlist_entries_still_exist():
-    """Stale allowlist entries (renamed/removed functions) must be
-    pruned, or the lint silently loses coverage."""
-    for (fname, func), _reason in ALLOWLIST.items():
-        path = TPU_DIR / fname
-        assert path.exists(), f"allowlisted file gone: {fname}"
-        tree = ast.parse(path.read_text())
-        names = {
-            n.name
-            for n in ast.walk(tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        }
-        assert func in names, f"allowlisted function gone: {fname}:{func}"
-
-
-@pytest.mark.parametrize(
-    "fname,expected",
-    [("multipaxos_batched.py", "run_ticks")],
-)
-def test_lint_sees_known_entry_points(fname, expected):
-    """The lint actually parses the decorators it claims to check: the
-    flagship run_ticks must be detected as jitted + donated (not skipped
-    by a matcher bug)."""
-    tree = ast.parse((TPU_DIR / fname).read_text())
-    found = None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == expected:
-            jitted = donated = False
-            for dec in node.decorator_list:
-                is_jit, has_donate = _jit_decorator_info(dec)
-                jitted |= is_jit
-                donated |= has_donate
-            found = (jitted, donated, _threads_state(node))
-    assert found == (True, True, True), found
+@pytest.mark.parametrize("rule_id", ["backend-inventory", "donation-jit"])
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()

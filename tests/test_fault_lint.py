@@ -1,204 +1,30 @@
-"""AST lint: the fault-injection contract across every batched backend
-(the tpu/faults.py repo-wide contract, sibling of the telemetry lint in
-test_telemetry_lint.py and the donation lint in test_donation_lint.py).
+"""Fault-injection contract (thin wrapper): every batched *Config
+accepts a ``faults: FaultPlan`` field, validates it in
+``__post_init__``, applies it in ``tick``, and range-checks every float
+``*_rate`` knob.
 
-Three clauses, enforced for every ``tpu/*_batched.py``:
-
- 1. The backend's ``*Config`` dataclass accepts a ``faults`` field
-    (annotated ``FaultPlan``), so every backend can run under a fault
-    schedule — and ``FaultPlan.none()`` as the default keeps ordinary
-    runs bit-identical.
- 2. Its ``__post_init__`` validates the plan (``self.faults.validate``
-    with the backend's partition axis), so malformed rates/masks fail
-    at config time, not as silent mis-simulation.
- 3. Its ``tick`` actually APPLIES the plan: the body references
-    ``faults`` (via ``cfg.faults`` or a ``faults_mod``/``faults``
-    helper call), so a new backend can't accept a plan and ignore it.
-
-Intentional exceptions go in the ALLOWLISTs with a reason.
+The checkers are the ``fault-*`` rules in ``frankenpaxos_tpu/analysis``;
+synthetic positive/negative fixtures for them live in
+``test_analysis_engine.py``. Intentional exceptions go in
+``analysis/allowlists.py`` with a reason.
 """
 
-import ast
-import pathlib
+import pytest
 
-TPU_DIR = (
-    pathlib.Path(__file__).resolve().parent.parent
-    / "frankenpaxos_tpu"
-    / "tpu"
+from frankenpaxos_tpu import analysis
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    [
+        "fault-config-field",
+        "fault-validate",
+        "fault-apply",
+        "fault-rate-validated",
+    ],
 )
-
-# Files exempt from a clause, with reasons.
-CONFIG_ALLOWLIST = {
-    # Nothing is currently exempt.
-}
-VALIDATE_ALLOWLIST = {
-    # Nothing is currently exempt.
-}
-APPLY_ALLOWLIST = {
-    # Nothing is currently exempt.
-}
-
-
-def _batched_files():
-    files = sorted(TPU_DIR.glob("*_batched.py"))
-    assert len(files) >= 13, [f.name for f in files]
-    return files
-
-
-def _config_classes(tree):
-    return [
-        node
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ClassDef) and node.name.endswith("Config")
-    ]
-
-
-def _ann_fields(cls):
-    return {
-        stmt.target.id: ast.unparse(stmt.annotation)
-        for stmt in cls.body
-        if isinstance(stmt, ast.AnnAssign)
-        and isinstance(stmt.target, ast.Name)
-    }
-
-
-def test_every_batched_config_accepts_a_fault_plan():
-    offenders = []
-    for path in _batched_files():
-        if path.name in CONFIG_ALLOWLIST:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        classes = _config_classes(tree)
-        assert classes, f"{path.name}: no *Config dataclass found"
-        for cls in classes:
-            ann = _ann_fields(cls).get("faults")
-            if ann is None or "FaultPlan" not in ann:
-                offenders.append((path.name, cls.name))
-    assert not offenders, (
-        "batched *Config dataclasses without a `faults: FaultPlan` "
-        f"field (the tpu/faults.py contract): {offenders}"
-    )
-
-
-def test_every_post_init_validates_the_fault_plan():
-    """__post_init__ must call ``self.faults.validate(...)`` — and every
-    fault-rate field must thereby be range-checked at config time."""
-    offenders = []
-    for path in _batched_files():
-        if path.name in VALIDATE_ALLOWLIST:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for cls in _config_classes(tree):
-            post = [
-                n
-                for n in cls.body
-                if isinstance(n, ast.FunctionDef)
-                and n.name == "__post_init__"
-            ]
-            if not post:
-                offenders.append((path.name, cls.name, "no __post_init__"))
-                continue
-            calls_validate = any(
-                isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr == "validate"
-                and "faults" in ast.unparse(n.func.value)
-                for n in ast.walk(post[0])
-            )
-            if not calls_validate:
-                offenders.append(
-                    (path.name, cls.name, "no faults.validate call")
-                )
-    assert not offenders, (
-        "batched configs whose __post_init__ never validates the fault "
-        f"plan: {offenders}"
-    )
-
-
-def _tick_applies_faults(func: ast.FunctionDef) -> bool:
-    for node in ast.walk(func):
-        # cfg.faults (any attribute path ending in .faults).
-        if isinstance(node, ast.Attribute) and node.attr == "faults":
-            return True
-        # faults_mod.<helper>(...) / faults.<helper>(...).
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id in ("faults_mod", "faults")
-        ):
-            return True
-    return False
-
-
-def test_every_tick_applies_the_fault_plan():
-    offenders = []
-    for path in _batched_files():
-        if path.name in APPLY_ALLOWLIST:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        ticks = [
-            n
-            for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef) and n.name == "tick"
-        ]
-        assert ticks, f"{path.name}: no tick function"
-        for func in ticks:
-            if not _tick_applies_faults(func):
-                offenders.append(path.name)
-    assert not offenders, (
-        "tick functions that accept a FaultPlan via config but never "
-        f"apply it: {offenders}"
-    )
-
-
-def test_lint_detects_a_violation():
-    """Teeth: a tick that never touches faults must be flagged."""
-    src = (
-        "def tick(cfg, state, t, key):\n"
-        "    x = cfg.drop_rate\n"
-        "    return state\n"
-    )
-    func = ast.parse(src).body[0]
-    assert not _tick_applies_faults(func)
-    src2 = (
-        "def tick(cfg, state, t, key):\n"
-        "    fp = cfg.faults\n"
-        "    return state\n"
-    )
-    assert _tick_applies_faults(ast.parse(src2).body[0])
-
-
-def test_fault_rate_fields_are_validated_everywhere():
-    """Every *_rate field on a batched config must be range-checked in
-    __post_init__ (an assert mentioning the field) — rates silently out
-    of range would simulate a different protocol regime. The FaultPlan's
-    own rates are covered by validate() (clause 2)."""
-    offenders = []
-    for path in _batched_files():
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for cls in _config_classes(tree):
-            rate_fields = [
-                name
-                for name, ann in _ann_fields(cls).items()
-                if name.endswith("_rate") and "float" in ann
-            ]
-            post = [
-                n
-                for n in cls.body
-                if isinstance(n, ast.FunctionDef)
-                and n.name == "__post_init__"
-            ]
-            body_src = ast.unparse(post[0]) if post else ""
-            for name in rate_fields:
-                if f"self.{name}" not in body_src:
-                    offenders.append((path.name, cls.name, name))
-    assert not offenders, (
-        f"unvalidated *_rate config fields: {offenders}"
-    )
-
-
-def test_allowlists_reference_existing_code():
-    for allow in (CONFIG_ALLOWLIST, VALIDATE_ALLOWLIST, APPLY_ALLOWLIST):
-        for fname in allow:
-            assert (TPU_DIR / fname).exists(), f"stale allowlist {fname}"
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()

@@ -1,165 +1,38 @@
-"""AST lint for the kernel layer (ops/): three structural contracts.
+"""Kernel-layer contract (thin wrapper): ``pallas_call`` only inside
+``ops/``, every registered plane dispatched by its backend, every
+kernel signature-twinned with a ``reference_*`` function, and every
+covered config carrying a validated ``kernels: KernelPolicy`` knob.
 
-1. ``pallas_call`` appears ONLY inside ``frankenpaxos_tpu/ops/`` — the
-   registry is the single dispatch point; a backend reaching for Pallas
-   directly bypasses the policy knob, the autotune table, and the
-   bit-identity test matrix.
-2. Every plane registered for a backend is actually dispatched by that
-   backend's tick (a ``...dispatch("<plane>", cfg, ...)`` call with the
-   plane name as a literal) — registering a kernel nobody calls is dead
-   weight; calling one that isn't registered is a KeyError at trace
-   time, caught here at lint time instead.
-3. Every registered kernel declares a reference twin with the SAME
-   positional signature (kernel = reference + block/interpret), and the
-   owning config carries a validated ``kernels: KernelPolicy`` knob.
-
-Intentional exceptions go in ALLOWLIST with a reason.
+The checkers are the ``kernel-*`` rules in ``frankenpaxos_tpu/analysis``
+(the registry-introspection rules import ``ops.registry``, so this
+wrapper doubles as their import smoke test). Intentional exceptions go
+in ``analysis/allowlists.py`` with a reason.
 """
-
-import ast
-import inspect
-import pathlib
 
 import pytest
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent / "frankenpaxos_tpu"
+from frankenpaxos_tpu import analysis
 
-ALLOWLIST: dict = {
-    # Nothing is currently exempt.
-}
+pytestmark = pytest.mark.lint
 
 
-def _py_files(base: pathlib.Path):
-    return sorted(p for p in base.rglob("*.py") if "__pycache__" not in p.parts)
+@pytest.mark.parametrize(
+    "rule_id",
+    [
+        "kernel-pallas-containment",
+        "kernel-dispatch-coverage",
+        "kernel-reference-twin",
+        "kernel-policy-knob",
+    ],
+)
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()
 
 
-def test_pallas_call_only_inside_ops():
-    offenders = []
-    for path in _py_files(ROOT):
-        rel = path.relative_to(ROOT)
-        if rel.parts[0] == "ops":
-            continue
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            name = None
-            if isinstance(node, ast.Attribute) and node.attr == "pallas_call":
-                name = "pallas_call"
-            elif isinstance(node, ast.Name) and node.id == "pallas_call":
-                name = "pallas_call"
-            if name and (str(rel), name) not in ALLOWLIST:
-                offenders.append(f"{rel}:{node.lineno}")
-    assert not offenders, (
-        "pallas_call outside frankenpaxos_tpu/ops/ — route the plane "
-        f"through ops.registry.dispatch instead: {offenders}"
-    )
-
-
-def _dispatched_plane_names(module_path: pathlib.Path) -> set:
-    """Literal plane names passed to a ``*.dispatch(...)`` call."""
-    tree = ast.parse(module_path.read_text())
-    names = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        is_dispatch = (
-            isinstance(func, ast.Attribute) and func.attr == "dispatch"
-        ) or (isinstance(func, ast.Name) and func.id == "dispatch")
-        if not is_dispatch or not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            names.add(first.value)
-    return names
-
-
-# backend key in the registry -> the tpu module that owns it.
-BACKEND_MODULES = {
-    "multipaxos": "multipaxos_batched.py",
-    "mencius": "mencius_batched.py",
-    "craq": "craq_batched.py",
-}
-
-
-def test_every_registered_plane_is_dispatched_by_its_backend():
-    from frankenpaxos_tpu.ops import registry
-
-    covered = registry.coverage()
-    assert set(covered) == set(BACKEND_MODULES), (
-        "registry backends and lint BACKEND_MODULES drifted apart — "
-        "teach the lint about the new backend"
-    )
-    for backend, planes in covered.items():
-        module = ROOT / "tpu" / BACKEND_MODULES[backend]
-        dispatched = _dispatched_plane_names(module)
-        missing = set(planes) - dispatched
-        assert not missing, (
-            f"{BACKEND_MODULES[backend]} never dispatches registered "
-            f"plane(s) {sorted(missing)}"
-        )
-        unknown = dispatched - set(registry.PLANES)
-        assert not unknown, (
-            f"{BACKEND_MODULES[backend]} dispatches unregistered "
-            f"plane(s) {sorted(unknown)}"
-        )
-
-
-def test_every_kernel_declares_a_reference_twin():
-    from frankenpaxos_tpu.ops import registry
-
-    for name, plane in registry.PLANES.items():
-        assert plane.reference.__name__.startswith("reference_"), name
-        ref_params = list(inspect.signature(plane.reference).parameters)
-        ker_params = list(inspect.signature(plane.kernel).parameters)
-        extras = {"block", "interpret"}
-        assert [p for p in ker_params if p not in extras] == [
-            p for p in ref_params
-        ], (
-            f"plane {name}: kernel signature must be the reference's "
-            f"plus block/interpret (got {ker_params} vs {ref_params})"
-        )
-
-
-def test_covered_configs_carry_validated_kernel_policy():
-    """Each covered backend's config declares ``kernels: KernelPolicy``
-    and its __post_init__ validates it (so a bad policy fails at config
-    construction, not at trace time)."""
-    for backend, fname in BACKEND_MODULES.items():
-        path = ROOT / "tpu" / fname
-        tree = ast.parse(path.read_text())
-        cfg_classes = [
-            node
-            for node in ast.walk(tree)
-            if isinstance(node, ast.ClassDef) and node.name.endswith("Config")
-        ]
-        assert cfg_classes, fname
-        for cls in cfg_classes:
-            fields = {
-                stmt.target.id
-                for stmt in cls.body
-                if isinstance(stmt, ast.AnnAssign)
-                and isinstance(stmt.target, ast.Name)
-            }
-            assert "kernels" in fields, f"{fname}:{cls.name} lacks kernels"
-            post = next(
-                (
-                    stmt
-                    for stmt in cls.body
-                    if isinstance(stmt, ast.FunctionDef)
-                    and stmt.name == "__post_init__"
-                ),
-                None,
-            )
-            assert post is not None, f"{fname}:{cls.name}"
-            validates = any(
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "validate"
-                and isinstance(node.func.value, ast.Attribute)
-                and node.func.value.attr == "kernels"
-                for node in ast.walk(post)
-            )
-            assert validates, (
-                f"{fname}:{cls.name}.__post_init__ must call "
-                "self.kernels.validate()"
-            )
+def test_state_dead_write_clean():
+    """The dead-write detector (new in the analysis subsystem) rides
+    with the kernel lint wrapper: every State field must be consumed
+    somewhere, or it is dead bytes on every tick sweep."""
+    report = analysis.run(rule_ids=["state-dead-write"])
+    assert not report.findings, "\n" + report.format()

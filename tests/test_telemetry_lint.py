@@ -1,178 +1,26 @@
-"""AST lint: the telemetry contract across every batched backend
-(the tpu/telemetry.py repo-wide contract, sibling of the donation lint
-in test_donation_lint.py).
+"""Telemetry contract (thin wrapper): every batched *State threads the
+Telemetry carry, every tick records into it, and no host-sync primitive
+is reachable from any compiled tick/run_ticks/step body — TRANSITIVELY,
+through helpers in ``tpu/`` and ``ops/`` (the old ad-hoc lint only saw
+syncs written inline in the tick body itself).
 
-Three clauses, enforced for every ``tpu/*_batched.py``:
-
- 1. The backend's ``*State`` dataclass carries a ``telemetry`` field
-    (annotated ``Telemetry``), so the ring threads through every
-    ``run_ticks`` scan carry, donation, sharding, and vmap for free.
- 2. Its ``tick`` function actually records — a ``record(...)`` call —
-    so new backends can't silently ship a dead ring.
- 3. NO host-sync primitive appears inside any tick/step/run_ticks body
-    in ``tpu/``: ``block_until_ready``, ``device_get``, ``np.asarray``
-    / ``numpy.asarray``, or ``.item()`` would serialize the compiled
-    loop against the host — exactly what the device-side ring exists to
-    avoid. (Top-level helpers like ``stats()``/``sweep()`` may sync;
-    only the in-graph functions are constrained.)
-
-Intentional exceptions go in the ALLOWLISTs with a reason.
+The checkers are the ``telemetry-*`` and ``host-sync-purity`` rules in
+``frankenpaxos_tpu/analysis``; synthetic positive/negative fixtures for
+them live in ``test_analysis_engine.py``. Intentional exceptions go in
+``analysis/allowlists.py`` with a reason.
 """
 
-import ast
-import pathlib
+import pytest
 
-TPU_DIR = (
-    pathlib.Path(__file__).resolve().parent.parent
-    / "frankenpaxos_tpu"
-    / "tpu"
+from frankenpaxos_tpu import analysis
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    ["telemetry-state-carry", "telemetry-tick-records", "host-sync-purity"],
 )
-
-# Files exempt from the State-carries-telemetry clause, with reasons.
-STATE_ALLOWLIST = {
-    # Nothing is currently exempt.
-}
-
-# (filename, function) -> reason a host-sync primitive is intentional.
-HOST_SYNC_ALLOWLIST = {
-    # Nothing is currently exempt.
-}
-
-# Function names whose bodies run INSIDE the compiled scan and are
-# therefore subject to the no-host-sync clause.
-IN_GRAPH_FUNCS = ("tick", "run_ticks", "step")
-
-HOST_SYNC_ATTRS = ("block_until_ready", "device_get", "asarray", "item")
-
-
-def _batched_files():
-    files = sorted(TPU_DIR.glob("*_batched.py"))
-    assert len(files) >= 13, [f.name for f in files]
-    return files
-
-
-def _state_classes(tree):
-    """ClassDef nodes that look like registered *State dataclasses."""
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name.endswith("State"):
-            out.append(node)
-    return out
-
-
-def test_every_backend_state_threads_the_telemetry_carry():
-    offenders = []
-    for path in _batched_files():
-        if path.name in STATE_ALLOWLIST:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        classes = _state_classes(tree)
-        assert classes, f"{path.name}: no *State dataclass found"
-        for cls in classes:
-            fields = {
-                stmt.target.id: ast.unparse(stmt.annotation)
-                for stmt in cls.body
-                if isinstance(stmt, ast.AnnAssign)
-                and isinstance(stmt.target, ast.Name)
-            }
-            ann = fields.get("telemetry")
-            if ann is None or "Telemetry" not in ann:
-                offenders.append((path.name, cls.name))
-    assert not offenders, (
-        "batched *State dataclasses without a `telemetry: Telemetry` "
-        f"field (the tpu/telemetry.py carry contract): {offenders}"
-    )
-
-
-def test_every_backend_tick_records_telemetry():
-    offenders = []
-    for path in _batched_files():
-        tree = ast.parse(path.read_text(), filename=str(path))
-        tick_funcs = [
-            n
-            for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef) and n.name == "tick"
-        ]
-        assert tick_funcs, f"{path.name}: no tick function"
-        for func in tick_funcs:
-            calls_record = any(
-                isinstance(n, ast.Call)
-                and (
-                    (isinstance(n.func, ast.Name) and n.func.id == "record")
-                    or (
-                        isinstance(n.func, ast.Attribute)
-                        and n.func.attr == "record"
-                    )
-                )
-                for n in ast.walk(func)
-            )
-            if not calls_record:
-                offenders.append(path.name)
-    assert not offenders, (
-        "tick functions that never call telemetry.record() — a dead "
-        f"ring ships no observability: {offenders}"
-    )
-
-
-def _host_sync_offenses(func: ast.FunctionDef, fname: str):
-    """Host-sync attribute/name references anywhere in ``func``'s body
-    (including nested ``step`` closures)."""
-    offenders = []
-    for node in ast.walk(func):
-        attr = None
-        if isinstance(node, ast.Attribute) and node.attr in HOST_SYNC_ATTRS:
-            attr = node.attr
-        elif (
-            isinstance(node, ast.Name) and node.id in HOST_SYNC_ATTRS
-        ):
-            attr = node.id
-        if attr is None:
-            continue
-        if (fname, func.name) in HOST_SYNC_ALLOWLIST:
-            continue
-        offenders.append((fname, func.name, attr, node.lineno))
-    return offenders
-
-
-def test_no_host_sync_inside_tick_bodies():
-    offenders = []
-    for path in sorted(TPU_DIR.glob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.FunctionDef)
-                and node.name in IN_GRAPH_FUNCS
-            ):
-                offenders.extend(_host_sync_offenses(node, path.name))
-    assert not offenders, (
-        "host-sync primitives inside compiled tick/run_ticks bodies "
-        "(they serialize the scan against the host — use the telemetry "
-        f"ring instead): {offenders}"
-    )
-
-
-def test_lint_detects_a_violation():
-    """The host-sync matcher has teeth: a synthetic tick body using
-    jax.device_get must be flagged."""
-    src = (
-        "def tick(cfg, state, t, key):\n"
-        "    x = jax.device_get(state.committed)\n"
-        "    return state\n"
-    )
-    func = ast.parse(src).body[0]
-    assert _host_sync_offenses(func, "synthetic.py")
-
-
-def test_allowlists_reference_existing_code():
-    for fname in STATE_ALLOWLIST:
-        assert (TPU_DIR / fname).exists(), f"stale allowlist file {fname}"
-    for fname, func in HOST_SYNC_ALLOWLIST:
-        path = TPU_DIR / fname
-        assert path.exists(), f"stale allowlist file {fname}"
-        tree = ast.parse(path.read_text())
-        names = {
-            n.name
-            for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef)
-        }
-        assert func in names, f"stale allowlist entry {fname}:{func}"
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()
