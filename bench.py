@@ -194,6 +194,13 @@ def _inner_main() -> None:
             for name, plane in _registry.PLANES.items()
             if plane.backend == "multipaxos"
         },
+        # The whole-tick megakernel's resolution, surfaced separately:
+        # "pallas" here means the flagship tick runs as ONE fused grid
+        # program (no per-plane HBM round trips); "reference" means the
+        # pure-jnp multi-plane path (the CPU fallback's fastest mode).
+        "fused_tick": _registry.resolve_mode(
+            "multipaxos_fused_tick", cfg
+        ),
     }
     result["kernel_coverage"] = {
         backend: list(planes)

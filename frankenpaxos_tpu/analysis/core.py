@@ -20,7 +20,7 @@ from frankenpaxos_tpu.analysis import astutil
 
 # Bumped whenever a rule is added/removed or a rule's semantics change;
 # recorded by bench.py for artifact provenance.
-ANALYSIS_VERSION = "1.0"
+ANALYSIS_VERSION = "1.1"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

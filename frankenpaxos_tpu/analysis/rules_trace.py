@@ -16,6 +16,12 @@ AST layer structurally cannot see.
   EQUAL configs/states and asserts the second call hits the jit cache
   (hashability/`__eq__`/static-argnum regressions recompile every
   segment in production).
+* ``trace-fused-tick`` — traces the FLAGSHIP-shaped MultiPaxos tick
+  with the kernel policy engaged and asserts the hot path is exactly
+  ONE ``pallas_call`` (the whole-tick megakernel): a second call means
+  the tick regressed to per-plane dispatch (an HBM round trip between
+  planes); zero means the megakernel silently fell back to the
+  reference. The reference-mode trace is asserted pallas-free.
 
 All jax imports live inside the checks so the AST layer stays
 importable without jax.
@@ -104,12 +110,13 @@ def _walk_eqns(jaxpr, out: list) -> None:
                     _walk_eqns(item, out)
 
 
-def _tick_eqns(backend: str) -> list:
+def _tick_eqns(backend: str, cfg=None) -> list:
     import jax
     import jax.numpy as jnp
 
     mod = _module(backend)
-    cfg = mod.analysis_config()
+    if cfg is None:
+        cfg = mod.analysis_config()
     state = mod.init_state(cfg)
     closed = jax.make_jaxpr(
         lambda s, t, k: mod.tick(cfg, s, t, k)
@@ -288,6 +295,73 @@ def check_donation_alias(ctx: Context) -> List[Finding]:
                     key=backend,
                 )
             )
+    return out
+
+
+def _count_pallas_calls(eqns) -> int:
+    return sum(1 for e in eqns if e.primitive.name == "pallas_call")
+
+
+@rule(
+    "trace-fused-tick",
+    "trace",
+    "the flagship MultiPaxos tick with the kernel policy engaged "
+    "compiles its hot path to exactly ONE pallas_call (the whole-tick "
+    "megakernel, no per-plane HBM round trips); reference mode to none",
+)
+def check_fused_tick(ctx: Context) -> List[Finding]:
+    if ctx.backends is not None and "multipaxos" not in ctx.backends:
+        return []
+    _jax_cache_setup()
+    from frankenpaxos_tpu.ops.registry import KernelPolicy
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+
+    out: List[Finding] = []
+    # The bench.py flagship shape (10k simulated acceptors). Tracing is
+    # shape-cheap: make_jaxpr never materializes the arrays.
+    flagship = dict(
+        f=1, num_groups=3334, window=64, slots_per_tick=8,
+        lat_min=1, lat_max=3, retry_timeout=16, thrifty=True,
+    )
+    cfg_on = mb.BatchedMultiPaxosConfig(
+        **flagship, kernels=KernelPolicy(mode="interpret")
+    )
+    n_on = _count_pallas_calls(_tick_eqns("multipaxos", cfg_on))
+    if n_on != 1:
+        out.append(
+            Finding(
+                rule="trace-fused-tick",
+                path="multipaxos",
+                line=0,
+                message=(
+                    f"flagship tick with the kernel policy engaged "
+                    f"traces {n_on} pallas_call(s), expected exactly 1 "
+                    "(the whole-tick megakernel): >1 means the tick "
+                    "regressed to per-plane dispatch (an HBM round "
+                    "trip between planes), 0 means the megakernel "
+                    "silently fell back to the reference path"
+                ),
+                key=f"multipaxos:on:{n_on}",
+            )
+        )
+    cfg_ref = mb.BatchedMultiPaxosConfig(
+        **flagship, kernels=KernelPolicy.reference()
+    )
+    n_ref = _count_pallas_calls(_tick_eqns("multipaxos", cfg_ref))
+    if n_ref != 0:
+        out.append(
+            Finding(
+                rule="trace-fused-tick",
+                path="multipaxos",
+                line=0,
+                message=(
+                    f"flagship tick in reference mode traces {n_ref} "
+                    "pallas_call(s), expected none — the reference "
+                    "path must stay pure jnp"
+                ),
+                key=f"multipaxos:reference:{n_ref}",
+            )
+        )
     return out
 
 

@@ -8,14 +8,28 @@ kernel SUITE with a dispatch registry:
     pallas/interpret/reference dispatch, and the checked-in autotune
     table (``ops/autotune.json``) block-size lookup.
   * :mod:`frankenpaxos_tpu.ops.multipaxos` — the MultiPaxos planes:
-    ``multipaxos_vote_quorum`` (acceptor votes + quorum count),
+    ``multipaxos_fused_tick`` (the WHOLE-TICK MEGAKERNEL: clock aging +
+    vote/quorum + dispatch as one Pallas grid program — State never
+    round-trips HBM between planes),
+    ``multipaxos_vote_quorum`` (acceptor votes + quorum count + the
+    read path's max-voted-slot feed),
     ``multipaxos_p1_promise`` (phase-1 safe-value aggregation + re-send),
     ``multipaxos_dispatch`` (choose + commit-watermark advance +
     proposals + retries).
+  * :mod:`frankenpaxos_tpu.ops.fastmultipaxos` — ``fastmultipaxos_vote``
+    (census/pairwise-match counting, fast choose, recovery triggers,
+    the classic round, chosen stamps).
+  * :mod:`frankenpaxos_tpu.ops.horizontal` — ``horizontal_vote``
+    (bank-masked acceptor votes, in-bank quorum count, choose, the
+    bank-isolation violation ledger).
   * :mod:`frankenpaxos_tpu.ops.mencius` — ``mencius_vote`` (per-slot
     vote/skip aggregation).
+  * :mod:`frankenpaxos_tpu.ops.scalog` — ``scalog_cut_commit`` (the
+    in-order cut-commit scan, newest-cut projection, per-cut record
+    latency accounting).
   * :mod:`frankenpaxos_tpu.ops.craq` — ``craq_chain`` (chain
-    propagate/ack with scatter-free pending-set accounting).
+    propagate/ack with scatter-free pending-set accounting; partitioned
+    plans defer cut hops to the heal tick in-kernel).
 
 Every kernel is dtype-polymorphic (int16 rounds / int16 offset clocks /
 int8 statuses native — no widen/narrow casts at the boundary) and has a
@@ -40,14 +54,28 @@ from frankenpaxos_tpu.ops.registry import (  # noqa: F401
 from frankenpaxos_tpu.ops.multipaxos import (  # noqa: F401
     fused_mp_dispatch,
     fused_p1_promise,
+    fused_tick,
     fused_vote_quorum,
+    reference_fused_tick,
     reference_mp_dispatch,
     reference_p1_promise,
     reference_vote_quorum,
 )
+from frankenpaxos_tpu.ops.fastmultipaxos import (  # noqa: F401
+    fused_fmp_vote,
+    reference_fmp_vote,
+)
+from frankenpaxos_tpu.ops.horizontal import (  # noqa: F401
+    fused_horizontal_vote,
+    reference_horizontal_vote,
+)
 from frankenpaxos_tpu.ops.mencius import (  # noqa: F401
     fused_mencius_vote,
     reference_mencius_vote,
+)
+from frankenpaxos_tpu.ops.scalog import (  # noqa: F401
+    fused_scalog_cut_commit,
+    reference_scalog_cut_commit,
 )
 from frankenpaxos_tpu.ops.craq import (  # noqa: F401
     fused_craq_chain,

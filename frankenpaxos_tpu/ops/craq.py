@@ -14,11 +14,14 @@ commute, so the accumulation is bit-identical to the reference's
 scatter order. The whole plane — both scatter families plus the
 advance/retire logic — runs in one VMEM-resident pass per chain block.
 
-Partitions buffer hops until the heal tick (``faults.defer_to_heal``),
-a data-dependent arrival rewrite the kernel does not model: the
-registry routes partitioned configs to the reference
-(``supported=not has_partition``). Drop/jitter fault penalties land in
-``hop_lat`` BEFORE dispatch, so they ride the kernel unchanged.
+Partitions buffer hops until the heal tick (``faults.defer_to_heal``):
+the plan's side bits, start, and heal tick enter as STATICS (``side``,
+``partition_start``, ``partition_heal``) and the kernel rewrites every
+hop into a cut-side node to ``max(arrival, heal)`` while the cut is
+live — the node-side lookup is a static unrolled loop over the tiny
+chain length, so partitioned plans ride the kernel instead of routing
+to the reference (the carried PR 4 follow-up (c)). Drop/jitter fault
+penalties land in ``hop_lat`` BEFORE dispatch, as before.
 """
 
 from __future__ import annotations
@@ -45,6 +48,25 @@ W_DOWN = 1
 W_UP = 2
 
 
+def _hop_fn(side, partition_start, partition_heal, t):
+    """The partition hop-deferral closure (faults.defer_to_heal
+    semantics): arrivals at cut-side nodes while the cut is live wait
+    for the heal tick. Identity when no partition sides are given."""
+    if not (side and any(side)):
+        return lambda arrival, node: arrival
+    sides = jnp.array(side, jnp.int32)
+    heal = jnp.int32(partition_heal if partition_heal >= 0 else int(INF))
+    active = t >= jnp.int32(partition_start)
+    if partition_heal >= 0:
+        active = active & (t < jnp.int32(partition_heal))
+
+    def hop(arrival, node):
+        cut = active & (sides[node] == 1)
+        return jnp.where(cut, jnp.maximum(arrival, heal), arrival)
+
+    return hop
+
+
 def reference_craq_chain(
     w_status: jnp.ndarray,  # [N, W] int8
     w_key: jnp.ndarray,  # [N, W]
@@ -59,15 +81,21 @@ def reference_craq_chain(
     *,
     tail: int,
     num_keys: int,
+    side: tuple = (),
+    partition_start: int = 0,
+    partition_heal: int = -1,
 ):
-    """The pure-jnp specification (tick steps 1-2 of craq_batched,
-    lossless/healed links). Returns ``(w_status', w_node', w_arrival',
-    node_dirty', node_version', at_tail, wlat)`` — ``at_tail`` [N, W]
-    marks tail applies (client-visible write completions) and ``wlat``
-    their latencies, for the stats the tick keeps outside."""
+    """The pure-jnp specification (tick steps 1-2 of craq_batched).
+    Returns ``(w_status', w_node', w_arrival', node_dirty',
+    node_version', at_tail, wlat)`` — ``at_tail`` [N, W] marks tail
+    applies (client-visible write completions) and ``wlat`` their
+    latencies, for the stats the tick keeps outside. With ``side``
+    bits, hops INTO cut-side nodes defer to the heal tick
+    (``faults.defer_to_heal`` TCP partition semantics)."""
     N, W = w_status.shape
     KV = num_keys
     n_rows = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[:, None], (N, W))
+    _hop = _hop_fn(side, partition_start, partition_heal, t)
 
     # ---- DOWN arrivals (ChainNode._process_write_batch).
     arrive_down = (w_status == W_DOWN) & (w_arrival == t)
@@ -84,7 +112,9 @@ def reference_craq_chain(
     w_node = jnp.where(at_mid, w_node + 1, w_node)
     w_node = jnp.where(at_tail, tail - 1, w_node)
     w_status = jnp.where(at_tail, W_UP, w_status)
-    w_arrival = jnp.where(arrive_down, t + hop_lat, w_arrival)
+    w_arrival = jnp.where(
+        arrive_down, _hop(t + hop_lat, w_node), w_arrival
+    )
 
     # ---- UP (ack) arrivals (ChainNode._handle_ack).
     arrive_up = (w_status == W_UP) & (w_arrival == t)
@@ -100,15 +130,19 @@ def reference_craq_chain(
     w_arrival = jnp.where(retire, INF, w_arrival)
     keep_up = arrive_up & ~retire
     w_node = jnp.where(keep_up, w_node - 1, w_node)
-    w_arrival = jnp.where(keep_up, t + hop_lat, w_arrival)
+    w_arrival = jnp.where(keep_up, _hop(t + hop_lat, w_node), w_arrival)
     return (
         w_status, w_node, w_arrival, node_dirty_flat, node_version_flat,
         at_tail, wlat,
     )
 
 
-def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
+def _craq_chain_kernel_factory(
+    tail, num_keys, W, LKV, side=(), partition_start=0, partition_heal=-1
+):
     KV = num_keys
+    partitioned = bool(side and any(side))
+    heal_v = partition_heal if partition_heal >= 0 else INF_I
 
     def kernel(
         t_ref,  # SMEM (1,)
@@ -128,6 +162,30 @@ def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
         wv = wv_ref[:]
         lat = lat_ref[:]
 
+        if partitioned:
+            # Hop deferral (faults.defer_to_heal): the side bits are
+            # STATIC, so the node-side lookup unrolls over the tiny
+            # chain length and the cut-liveness test is two compares
+            # against compile-time ticks.
+            cut_live = t >= partition_start
+            if partition_heal >= 0:
+                cut_live = cut_live & (t < partition_heal)
+
+            def _hop(arrival, node):
+                is_cut = jnp.zeros(node.shape, bool)
+                for l, s in enumerate(side):
+                    if s:
+                        is_cut = is_cut | (node == l)
+                return jnp.where(
+                    cut_live & is_cut,
+                    jnp.maximum(arrival, heal_v),
+                    arrival,
+                )
+        else:
+
+            def _hop(arrival, node):
+                return arrival
+
         arrive_down = (ws == W_DOWN) & (wa == t)
         at_mid = arrive_down & (wn < tail)
         at_tail = arrive_down & (wn == tail)
@@ -138,7 +196,7 @@ def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
         wn1 = jnp.where(at_mid, wn + 1, wn)
         wn1 = jnp.where(at_tail, tail - 1, wn1)
         ws1 = jnp.where(at_tail, W_UP, ws)
-        wa1 = jnp.where(arrive_down, t + lat, wa)
+        wa1 = jnp.where(arrive_down, _hop(t + lat, wn1), wa)
 
         arrive_up = (ws1 == W_UP) & (wa1 == t)
         uslot = wn1 * KV + wk
@@ -147,7 +205,7 @@ def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
         wa2 = jnp.where(retire, INF_I, wa1)
         keep_up = arrive_up & ~retire
         wn2 = jnp.where(keep_up, wn1 - 1, wn1)
-        wa2 = jnp.where(keep_up, t + lat, wa2)
+        wa2 = jnp.where(keep_up, _hop(t + lat, wn2), wa2)
         out_ws[:] = ws2
         out_wn[:] = wn2
         out_wa[:] = wa2
@@ -185,7 +243,11 @@ def _craq_chain_kernel_factory(tail, num_keys, W, LKV):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "interpret", "tail", "num_keys")
+    jax.jit,
+    static_argnames=(
+        "block", "interpret", "tail", "num_keys", "side",
+        "partition_start", "partition_heal",
+    ),
 )
 def fused_craq_chain(
     w_status,
@@ -202,8 +264,12 @@ def fused_craq_chain(
     interpret: bool = False,
     tail: int = 1,
     num_keys: int = 1,
+    side: tuple = (),
+    partition_start: int = 0,
+    partition_heal: int = -1,
 ):
-    """Fused :func:`reference_craq_chain`, gridded over chain blocks."""
+    """Fused :func:`reference_craq_chain`, gridded over chain blocks;
+    partition plans ride along via the static side/start/heal knobs."""
     from jax.experimental import pallas as pl
 
     N, W = w_status.shape
@@ -237,7 +303,9 @@ def fused_craq_chain(
         jax.ShapeDtypeStruct((Np, W), jnp.int8),  # at_tail
         jax.ShapeDtypeStruct((Np, W), jnp.int32),  # wlat
     ]
-    kernel = _craq_chain_kernel_factory(tail, num_keys, W, LKV)
+    kernel = _craq_chain_kernel_factory(
+        tail, num_keys, W, LKV, side, partition_start, partition_heal
+    )
     ws, wn, wa, dirty, ver, at_tail, wlat = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -266,9 +334,6 @@ registry.register(
             args[6].shape[1],  # L*KV
             args[0].shape[1],  # W
         ),
-        # Hop deferral to the heal tick is reference-only (module
-        # docstring); everything else rides the kernel.
-        supported=lambda cfg: not cfg.faults.has_partition,
         default_block=256,
     )
 )

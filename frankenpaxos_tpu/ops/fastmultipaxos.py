@@ -1,0 +1,378 @@
+"""Fused Pallas kernel for the batched Fast MultiPaxos vote plane.
+
+``fastmultipaxos_vote`` covers tick steps 2-3 of
+``tpu/fastmultipaxos_batched.py``: the leader observes per-slot vote
+censuses (pairwise same-value counts over the tiny acceptor axis), the
+fast-committed ledger records unobserved fast quorums, slots choose on
+a fast quorum of identical visible votes or fall to classic recovery
+(census-full / timeout triggers, Leader.scala:545, 721-730), the
+classic round's acceptor votes and f+1 quorum complete, and chosen
+slots stamp value + replica arrival. In XLA this is ~a dozen
+elementwise passes plus two [A, A, G, W] pairwise reductions over the
+[A, G, W] vote arrays; here it is ONE VMEM-resident pass per group
+block with the pairwise counts as an unrolled A x A loop.
+
+The acceptor-append scatter (tick step 1) and the [G, W, CW] command
+completion join (step 4) stay in XLA — scatters and the cross-ring join
+don't vectorize in a Pallas grid over groups; this plane is the
+vote-traffic half that scales with [A, G, W].
+
+Argmax tie-breaks replicate ``jnp.argmax`` (first max) via strict-``>``
+first-max scans, so the kernel is bit-identical to the reference twin.
+FaultPlans compose from OUTSIDE: broadcast-plane drops/cuts land in
+step 1's arrival arrays and recovery-round TCP penalties land in
+``rv_lat`` before dispatch, so faulty runs ride the kernel unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import (
+    INF_I,
+    balanced_block,
+    pad_axis,
+    t_arr,
+    t_space,
+)
+from frankenpaxos_tpu.tpu.common import INF
+
+# Mirrors of the backend's slot codes (ops must not import the backend).
+# Cross-checked by tests/test_kernel_registry.
+S_OPEN = 0
+S_RECOVER = 1
+S_CHOSEN = 2
+NO_VALUE = -1
+
+
+def reference_fmp_vote(
+    vote_value: jnp.ndarray,  # [A, G, W] fast-round votes (NO_VALUE none)
+    vote_seen: jnp.ndarray,  # [A, G, W] tick the leader sees the vote (INF)
+    status: jnp.ndarray,  # [G, W] int8 S_*
+    open_tick: jnp.ndarray,  # [G, W] first visible vote tick (INF)
+    fast_committed: jnp.ndarray,  # [G, W] ledger (NO_VALUE none)
+    rv_value: jnp.ndarray,  # [G, W] classic-round proposal value
+    rv_p2a_arrival: jnp.ndarray,  # [A, G, W]
+    rv_p2b_arrival: jnp.ndarray,  # [A, G, W]
+    rv_voted: jnp.ndarray,  # [A, G, W] bool
+    chosen_value: jnp.ndarray,  # [G, W]
+    replica_arrival: jnp.ndarray,  # [G, W]
+    rv_lat: jnp.ndarray,  # [G, W] classic-round hop latencies
+    reply_lat: jnp.ndarray,  # [G, W] chosen -> replica latencies
+    t: jnp.ndarray,  # []
+    *,
+    fq: int,
+    f: int,
+    recovery_timeout: int,
+):
+    """The pure-jnp specification (tick steps 2-3 of
+    fastmultipaxos_batched). Returns the updated slot/vote arrays plus
+    the ``newly_chosen`` / ``fast_ok`` / ``start_rec`` / ``safety``
+    masks the tick's stat counters reduce outside."""
+    A = vote_value.shape[0]
+
+    # ---- 2. Leader observes votes per slot.
+    visible = vote_seen <= t  # [A, G, W]
+    n_visible = jnp.sum(visible, axis=0)
+    open_tick = jnp.where(
+        (open_tick == INF) & (n_visible > 0) & (status == S_OPEN),
+        t,
+        open_tick,
+    )
+    same = (
+        (vote_value[:, None] == vote_value[None, :])
+        & (vote_value[None, :] != NO_VALUE)
+        & visible[:, None]
+        & visible[None, :]
+    )  # [A, A, G, W]
+    match_count = jnp.sum(same, axis=1)  # [A, G, W]
+    best_count = jnp.max(match_count, axis=0)  # [G, W]
+    best_a = jnp.argmax(match_count, axis=0)
+    best_value = jnp.take_along_axis(
+        vote_value, best_a[None, :, :], axis=0
+    )[0]
+    same_all = (
+        (vote_value[:, None] == vote_value[None, :])
+        & (vote_value[None, :] != NO_VALUE)
+    )
+    full_count = jnp.max(jnp.sum(same_all, axis=1), axis=0)
+    full_a = jnp.argmax(jnp.sum(same_all, axis=1), axis=0)
+    full_value = jnp.take_along_axis(
+        vote_value, full_a[None, :, :], axis=0
+    )[0]
+    fast_committed = jnp.where(
+        (fast_committed == NO_VALUE) & (full_count >= fq),
+        full_value,
+        fast_committed,
+    )
+
+    fast_ok = (status == S_OPEN) & (best_count >= fq)
+    census_full = n_visible >= A
+    timed_out = (
+        (open_tick < INF)
+        & (t - open_tick >= recovery_timeout)
+        & (n_visible >= A - f)
+    )
+    start_rec = (status == S_OPEN) & ~fast_ok & (census_full | timed_out)
+    new_rv_value = jnp.where(start_rec, best_value, rv_value)
+    status = jnp.where(start_rec, S_RECOVER, status)
+    new_rv_p2a = jnp.where(
+        start_rec[None, :, :],
+        t + jnp.broadcast_to(rv_lat[None], vote_value.shape),
+        rv_p2a_arrival,
+    )
+
+    # ---- 3. Classic round at acceptors + choose.
+    rv_now = new_rv_p2a == t
+    new_rv_voted = rv_voted | rv_now
+    new_rv_p2b = jnp.where(rv_now, t + rv_lat[None], rv_p2b_arrival)
+    new_rv_p2a = jnp.where(rv_now, INF, new_rv_p2a)
+    n_rv = jnp.sum(new_rv_voted & (new_rv_p2b <= t), axis=0)
+    rec_ok = (status == S_RECOVER) & (n_rv >= f + 1)
+
+    newly_chosen = fast_ok | rec_ok
+    # rec_ok slots were recovering before this tick (a freshly started
+    # recovery has no classic votes yet), so the PRE-update rv_value is
+    # the value their round proposed — exactly what the tick read.
+    value_now = jnp.where(fast_ok, best_value, rv_value)
+    safety = (
+        newly_chosen
+        & (fast_committed != NO_VALUE)
+        & (value_now != fast_committed)
+    )
+    new_chosen_value = jnp.where(newly_chosen, value_now, chosen_value)
+    status = jnp.where(newly_chosen, S_CHOSEN, status)
+    new_replica_arrival = jnp.where(
+        newly_chosen, t + reply_lat, replica_arrival
+    )
+    return (
+        status, open_tick, fast_committed, new_rv_value,
+        new_rv_p2a, new_rv_p2b, new_rv_voted,
+        new_chosen_value, new_replica_arrival,
+        newly_chosen, fast_ok, start_rec, safety,
+    )
+
+
+def _fmp_vote_kernel_factory(fq, f, recovery_timeout, A):
+    def kernel(
+        t_ref,  # SMEM (1,)
+        vv_ref, vs_ref,  # [A, BG, W]
+        status_ref, ot_ref, fc_ref, rvv_ref,  # [BG, W]
+        rp2a_ref, rp2b_ref, rvoted_ref,  # [A, BG, W]
+        cv_ref, ra_ref, rvlat_ref, replylat_ref,  # [BG, W]
+        out_status, out_ot, out_fc, out_rvv,
+        out_rp2a, out_rp2b, out_rvoted,
+        out_cv, out_ra,
+        out_newly, out_fast, out_rec, out_safety,
+    ):
+        t = t_ref[0]
+        status = status_ref[:]
+        rv_lat = rvlat_ref[:]
+        vv = [vv_ref[a] for a in range(A)]
+        visible = [vs_ref[a] <= t for a in range(A)]
+
+        n_visible = jnp.zeros(status.shape, jnp.int32)
+        for a in range(A):
+            n_visible = n_visible + visible[a].astype(jnp.int32)
+        open_tick = jnp.where(
+            (ot_ref[:] == INF_I) & (n_visible > 0) & (status == S_OPEN),
+            t,
+            ot_ref[:],
+        )
+
+        # Pairwise same-value counts + first-max scans (the reference's
+        # argmax picks the FIRST max; strict > replicates it exactly).
+        best_count = None
+        best_value = None
+        full_count = None
+        full_value = None
+        for a in range(A):
+            cnt = jnp.zeros(status.shape, jnp.int32)
+            cnt_all = jnp.zeros(status.shape, jnp.int32)
+            # The != NO_VALUE test is on vv[b] — the reference's
+            # `vote_value[None, :] != NO_VALUE` broadcasts over b.
+            for b in range(A):
+                pair = (vv[a] == vv[b]) & (vv[b] != NO_VALUE)
+                cnt_all = cnt_all + pair.astype(jnp.int32)
+                cnt = cnt + (pair & visible[a] & visible[b]).astype(
+                    jnp.int32
+                )
+            if a == 0:
+                best_count, best_value = cnt, vv[0]
+                full_count, full_value = cnt_all, vv[0]
+            else:
+                upd = cnt > best_count
+                best_count = jnp.where(upd, cnt, best_count)
+                best_value = jnp.where(upd, vv[a], best_value)
+                upd_f = cnt_all > full_count
+                full_count = jnp.where(upd_f, cnt_all, full_count)
+                full_value = jnp.where(upd_f, vv[a], full_value)
+        fast_committed = jnp.where(
+            (fc_ref[:] == NO_VALUE) & (full_count >= fq),
+            full_value,
+            fc_ref[:],
+        )
+
+        fast_ok = (status == S_OPEN) & (best_count >= fq)
+        census_full = n_visible >= A
+        timed_out = (
+            (open_tick < INF_I)
+            & (t - open_tick >= recovery_timeout)
+            & (n_visible >= A - f)
+        )
+        start_rec = (status == S_OPEN) & ~fast_ok & (census_full | timed_out)
+        out_rvv[:] = jnp.where(start_rec, best_value, rvv_ref[:])
+        status = jnp.where(start_rec, S_RECOVER, status)
+
+        n_rv = jnp.zeros(status.shape, jnp.int32)
+        for a in range(A):
+            rp2a = jnp.where(start_rec, t + rv_lat, rp2a_ref[a])
+            rv_now = rp2a == t
+            rvoted = (rvoted_ref[a] != 0) | rv_now
+            rp2b = jnp.where(rv_now, t + rv_lat, rp2b_ref[a])
+            out_rp2a[a] = jnp.where(rv_now, INF_I, rp2a)
+            out_rp2b[a] = rp2b
+            out_rvoted[a] = rvoted.astype(jnp.int8)
+            n_rv = n_rv + (rvoted & (rp2b <= t)).astype(jnp.int32)
+        rec_ok = (status == S_RECOVER) & (n_rv >= f + 1)
+
+        newly_chosen = fast_ok | rec_ok
+        value_now = jnp.where(fast_ok, best_value, rvv_ref[:])
+        out_safety[:] = (
+            newly_chosen
+            & (fast_committed != NO_VALUE)
+            & (value_now != fast_committed)
+        ).astype(jnp.int8)
+        out_cv[:] = jnp.where(newly_chosen, value_now, cv_ref[:])
+        out_status[:] = jnp.where(newly_chosen, S_CHOSEN, status)
+        out_ra[:] = jnp.where(newly_chosen, t + replylat_ref[:], ra_ref[:])
+        out_ot[:] = open_tick
+        out_fc[:] = fast_committed
+        out_newly[:] = newly_chosen.astype(jnp.int8)
+        out_fast[:] = fast_ok.astype(jnp.int8)
+        out_rec[:] = start_rec.astype(jnp.int8)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block", "interpret", "fq", "f", "recovery_timeout"),
+)
+def fused_fmp_vote(
+    vote_value,
+    vote_seen,
+    status,
+    open_tick,
+    fast_committed,
+    rv_value,
+    rv_p2a_arrival,
+    rv_p2b_arrival,
+    rv_voted,
+    chosen_value,
+    replica_arrival,
+    rv_lat,
+    reply_lat,
+    t,
+    block: int = 256,
+    interpret: bool = False,
+    fq: int = 2,
+    f: int = 1,
+    recovery_timeout: int = 10,
+):
+    """Fused :func:`reference_fmp_vote`, gridded over group blocks."""
+    from jax.experimental import pallas as pl
+
+    A, G, W = vote_value.shape
+    bg, pad = balanced_block(G, block)
+    agw = [vote_value, vote_seen, rv_p2a_arrival, rv_p2b_arrival, rv_voted]
+    gw = [
+        status, open_tick, fast_committed, rv_value, chosen_value,
+        replica_arrival, rv_lat, reply_lat,
+    ]
+    if pad:
+        agw = [pad_axis(x, 1, pad) for x in agw]
+        gw = [pad_axis(x, 0, pad) for x in gw]
+    vote_value, vote_seen, rv_p2a_arrival, rv_p2b_arrival, rv_voted = agw
+    (status, open_tick, fast_committed, rv_value, chosen_value,
+     replica_arrival, rv_lat, reply_lat) = gw
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=(
+            [pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret))]
+            + [spec3] * 2  # vote_value, vote_seen
+            + [spec_gw] * 4  # status, open_tick, fast_committed, rv_value
+            + [spec3] * 3  # rv_p2a, rv_p2b, rv_voted
+            + [spec_gw] * 4  # chosen_value, replica_arrival, rv_lat, reply
+        ),
+        out_specs=(
+            [spec_gw] * 4  # status, open_tick, fast_committed, rv_value
+            + [spec3] * 3  # rv_p2a, rv_p2b, rv_voted
+            + [spec_gw] * 2  # chosen_value, replica_arrival
+            + [spec_gw] * 4  # newly, fast_ok, start_rec, safety
+        ),
+    )
+    i8 = jnp.int8
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct((Gp, W), status.dtype),
+            jax.ShapeDtypeStruct((Gp, W), open_tick.dtype),
+            jax.ShapeDtypeStruct((Gp, W), fast_committed.dtype),
+            jax.ShapeDtypeStruct((Gp, W), rv_value.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), rv_p2a_arrival.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), rv_p2b_arrival.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), i8),  # rv_voted
+            jax.ShapeDtypeStruct((Gp, W), chosen_value.dtype),
+            jax.ShapeDtypeStruct((Gp, W), replica_arrival.dtype),
+        ]
+        + [jax.ShapeDtypeStruct((Gp, W), i8)] * 4
+    )
+    kernel = _fmp_vote_kernel_factory(fq, f, recovery_timeout, A)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        vote_value, vote_seen,
+        status, open_tick, fast_committed, rv_value,
+        rv_p2a_arrival, rv_p2b_arrival, rv_voted.astype(i8),
+        chosen_value, replica_arrival, rv_lat, reply_lat,
+    )
+    if pad:
+        axis1 = {4, 5, 6}  # the [A, G, W] outputs pad axis 1
+        outs = [
+            x[:, :G] if i in axis1 else x[:G] for i, x in enumerate(outs)
+        ]
+    (status, open_tick, fast_committed, rv_value, rv_p2a, rv_p2b,
+     rv_voted, chosen_value, replica_arrival, newly, fast_ok, start_rec,
+     safety) = outs
+    return (
+        status, open_tick, fast_committed, rv_value,
+        rv_p2a, rv_p2b, rv_voted.astype(bool),
+        chosen_value, replica_arrival,
+        newly.astype(bool), fast_ok.astype(bool), start_rec.astype(bool),
+        safety.astype(bool),
+    )
+
+
+registry.register(
+    registry.Plane(
+        name="fastmultipaxos_vote",
+        backend="fastmultipaxos",
+        reference=reference_fmp_vote,
+        kernel=fused_fmp_vote,
+        key_of=lambda args: args[0].shape,  # vote_value: (A, G, W)
+        batch_axis=1,  # grids over G
+        default_block=256,
+    )
+)

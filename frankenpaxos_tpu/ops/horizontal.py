@@ -1,0 +1,244 @@
+"""Fused Pallas kernel for the batched Horizontal MultiPaxos vote plane.
+
+``horizontal_vote`` covers tick steps 1-2 of
+``tpu/horizontal_batched.py``: acceptors of the slot's BANK process
+Phase2a arrivals (the pool is two banks of ``n = 2f+1`` rows; epoch
+parity picks the active bank — votes only land where
+``bank_of_row == slot_epoch % 2``), schedule Phase2b replies, the
+per-slot in-bank quorum count chooses, and the bank-isolation ledger
+counts any vote sitting in the WRONG bank (the horizontal analog of
+"no value chosen by the wrong configuration"). Five elementwise
+[P, G, W] passes plus a reduction in XLA; one VMEM-resident pass here,
+with the pool axis as a static unrolled loop (bank membership of each
+row is a compile-time constant, so the bank masks cost nothing).
+
+The chunk machinery (watermark walk, phase-1 handover, the
+configuration-as-log-value proposal driver) stays in XLA — it is
+[G]-space control, exactly the split the flagship planes use.
+FaultPlans compose from OUTSIDE: the pool-axis delivery masks
+(drops/cuts) enter as the ``p2b_delivered`` input, identical to the
+flagship vote plane's contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import (
+    INF_I,
+    balanced_block,
+    pad_axis,
+    t_arr,
+    t_space,
+)
+from frankenpaxos_tpu.tpu.common import INF
+
+# Mirrors of the backend's slot codes (ops must not import the backend).
+# Cross-checked by tests/test_kernel_registry.
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+NO_VALUE = -1
+
+
+def reference_horizontal_vote(
+    slot_epoch: jnp.ndarray,  # [G, W] chunk epoch stamped at proposal (-1)
+    status: jnp.ndarray,  # [G, W] int8
+    propose_tick: jnp.ndarray,  # [G, W]
+    p2a_arrival: jnp.ndarray,  # [P, G, W] absolute arrival ticks (INF)
+    p2b_arrival: jnp.ndarray,  # [P, G, W]
+    voted: jnp.ndarray,  # [P, G, W] bool
+    vote_epoch: jnp.ndarray,  # [P, G, W] epoch the vote was cast under
+    p2b_lat: jnp.ndarray,  # [P, G, W] sampled latencies
+    p2b_delivered: jnp.ndarray,  # [P, G, W] bool (fault delivery mask)
+    t: jnp.ndarray,  # []
+    *,
+    n: int,
+    quorum: int,
+):
+    """The pure-jnp specification (tick steps 1-2 of horizontal_batched).
+    Returns the updated vote/arrival arrays plus ``newly_chosen``, the
+    per-slot commit latencies, and the per-slot wrong-bank vote counts
+    the tick's ledger reduces outside."""
+    P = p2a_arrival.shape[0]
+    bank_of_row = (jnp.arange(P, dtype=jnp.int32) >= n).astype(jnp.int32)
+
+    # ---- 1. Acceptors vote on arriving Phase2as — but ONLY rows in the
+    # bank the slot's chunk owns.
+    slot_bank = jnp.mod(slot_epoch, 2)  # [G, W]
+    row_matches = bank_of_row[:, None, None] == slot_bank[None, :, :]
+    p2a_now = p2a_arrival == t
+    may_vote = p2a_now & row_matches & (status == PROPOSED)[None, :, :]
+    new_voted = voted | may_vote
+    new_vote_epoch = jnp.where(
+        may_vote, slot_epoch[None, :, :], vote_epoch
+    )
+    # Under a fault plan the VOTE lands but the Phase2b reply may be
+    # dropped or cut (the retry plane re-solicits it after a heal).
+    p2b_send = may_vote & p2b_delivered
+    new_p2b = jnp.where(p2b_send, t + p2b_lat, p2b_arrival)
+    new_p2a = jnp.where(p2a_now, INF, p2a_arrival)
+
+    # ---- 2. Quorums form: f+1 arrived Phase2bs within the slot's bank.
+    arrived = (new_p2b <= t) & new_voted & row_matches
+    votes_in_bank = jnp.sum(arrived, axis=0)  # [G, W]
+    newly_chosen = (status == PROPOSED) & (votes_in_bank >= quorum)
+    new_status = jnp.where(newly_chosen, CHOSEN, status)
+    lat = jnp.where(newly_chosen, t - propose_tick, 0)
+    # Bank isolation ledger: votes observed OUTSIDE their slot's bank.
+    viol = jnp.sum(
+        (new_voted & ~row_matches & (slot_epoch >= 0)[None, :, :]).astype(
+            jnp.int32
+        ),
+        axis=0,
+    )  # [G, W]
+    return (
+        new_status, new_p2a, new_p2b, new_voted, new_vote_epoch,
+        newly_chosen, lat, viol,
+    )
+
+
+def _horizontal_vote_kernel_factory(n, quorum, P):
+    def kernel(
+        t_ref,  # SMEM (1,)
+        se_ref, status_ref, pt_ref,  # [BG, W]
+        p2a_ref, p2b_ref, voted_ref, ve_ref,  # [P, BG, W]
+        lat_ref, deliv_ref,  # [P, BG, W]
+        out_status, out_p2a, out_p2b, out_voted, out_ve,
+        out_newly, out_lat, out_viol,
+    ):
+        t = t_ref[0]
+        slot_epoch = se_ref[:]
+        status = status_ref[:]
+        slot_bank = jnp.mod(slot_epoch, 2)
+        proposed = status == PROPOSED
+        epoch_set = slot_epoch >= 0
+        votes_in = jnp.zeros(status.shape, jnp.int32)
+        viol = jnp.zeros(status.shape, jnp.int32)
+        # The pool axis is static (2n rows): bank membership of each row
+        # is a compile-time constant, so the bank masks are plain
+        # comparisons against a Python int.
+        for p in range(P):
+            row_matches = slot_bank == (1 if p >= n else 0)
+            p2a_now = p2a_ref[p] == t
+            may_vote = p2a_now & row_matches & proposed
+            new_voted = (voted_ref[p] != 0) | may_vote
+            p2b_send = may_vote & (deliv_ref[p] != 0)
+            new_p2b = jnp.where(p2b_send, t + lat_ref[p], p2b_ref[p])
+            out_voted[p] = new_voted.astype(jnp.int8)
+            out_ve[p] = jnp.where(may_vote, slot_epoch, ve_ref[p])
+            out_p2b[p] = new_p2b
+            out_p2a[p] = jnp.where(p2a_now, INF_I, p2a_ref[p])
+            votes_in = votes_in + (
+                (new_p2b <= t) & new_voted & row_matches
+            ).astype(jnp.int32)
+            viol = viol + (
+                new_voted & ~row_matches & epoch_set
+            ).astype(jnp.int32)
+        newly_chosen = proposed & (votes_in >= quorum)
+        out_status[:] = jnp.where(newly_chosen, CHOSEN, status)
+        out_lat[:] = jnp.where(newly_chosen, t - pt_ref[:], 0)
+        out_newly[:] = newly_chosen.astype(jnp.int8)
+        out_viol[:] = viol
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "n", "quorum")
+)
+def fused_horizontal_vote(
+    slot_epoch,
+    status,
+    propose_tick,
+    p2a_arrival,
+    p2b_arrival,
+    voted,
+    vote_epoch,
+    p2b_lat,
+    p2b_delivered,
+    t,
+    block: int = 256,
+    interpret: bool = False,
+    n: int = 3,
+    quorum: int = 2,
+):
+    """Fused :func:`reference_horizontal_vote`, gridded over group
+    blocks with the 2n-row pool axis unrolled."""
+    from jax.experimental import pallas as pl
+
+    P, G, W = p2a_arrival.shape
+    bg, pad = balanced_block(G, block)
+    pgw = [p2a_arrival, p2b_arrival, voted, vote_epoch, p2b_lat, p2b_delivered]
+    gw = [slot_epoch, status, propose_tick]
+    if pad:
+        pgw = [pad_axis(x, 1, pad) for x in pgw]
+        gw = [pad_axis(x, 0, pad) for x in gw]
+    p2a_arrival, p2b_arrival, voted, vote_epoch, p2b_lat, p2b_delivered = pgw
+    slot_epoch, status, propose_tick = gw
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((P, bg, W), lambda i: (0, i, 0))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=(
+            [pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret))]
+            + [spec_gw] * 3  # slot_epoch, status, propose_tick
+            + [spec3] * 6  # p2a, p2b, voted, vote_epoch, lat, delivered
+        ),
+        out_specs=(
+            [spec_gw]  # status
+            + [spec3] * 4  # p2a, p2b, voted, vote_epoch
+            + [spec_gw] * 3  # newly_chosen, lat, viol
+        ),
+    )
+    i8 = jnp.int8
+    out_shape = [
+        jax.ShapeDtypeStruct((Gp, W), status.dtype),
+        jax.ShapeDtypeStruct((P, Gp, W), p2a_arrival.dtype),
+        jax.ShapeDtypeStruct((P, Gp, W), p2b_arrival.dtype),
+        jax.ShapeDtypeStruct((P, Gp, W), i8),  # voted
+        jax.ShapeDtypeStruct((P, Gp, W), vote_epoch.dtype),
+        jax.ShapeDtypeStruct((Gp, W), i8),  # newly_chosen
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # lat
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # viol
+    ]
+    kernel = _horizontal_vote_kernel_factory(n, quorum, P)
+    (st, p2a, p2b, vtd, ve, newly, lat, viol) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        slot_epoch, status, propose_tick,
+        p2a_arrival, p2b_arrival, voted.astype(i8), vote_epoch,
+        p2b_lat, p2b_delivered.astype(i8),
+    )
+    if pad:
+        st, newly, lat, viol = st[:G], newly[:G], lat[:G], viol[:G]
+        p2a, p2b, vtd, ve = (
+            p2a[:, :G], p2b[:, :G], vtd[:, :G], ve[:, :G]
+        )
+    return (
+        st, p2a, p2b, vtd.astype(bool), ve,
+        newly.astype(bool), lat, viol,
+    )
+
+
+registry.register(
+    registry.Plane(
+        name="horizontal_vote",
+        backend="horizontal",
+        reference=reference_horizontal_vote,
+        kernel=fused_horizontal_vote,
+        key_of=lambda args: args[3].shape,  # p2a_arrival: (P, G, W)
+        batch_axis=1,  # grids over G
+        default_block=256,
+    )
+)

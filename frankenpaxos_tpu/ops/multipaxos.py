@@ -1,13 +1,28 @@
 """Fused Pallas kernels for the batched MultiPaxos hot planes.
 
-Three planes of ``tpu/multipaxos_batched.py`` dispatch here (see
+Four planes of ``tpu/multipaxos_batched.py`` dispatch here (see
 ``ops/registry.py`` for the policy machinery):
 
+  * ``multipaxos_fused_tick`` — the WHOLE-TICK MEGAKERNEL: offset-clock
+    aging, the vote/quorum plane, and the dispatch plane (quorum ->
+    Chosen -> commit-watermark -> propose -> retry) as ONE Pallas grid
+    program. Between the per-plane kernels below, State still
+    round-trips HBM (the vote plane's [A, G, W] outputs are written,
+    then re-read by the dispatch kernel, with a separate aging pass in
+    front); here every array is read from HBM exactly once per tick and
+    the intermediate vote state never leaves VMEM. The tick routes to
+    this plane whenever the policy resolves it off the reference path
+    (``disable=("multipaxos_fused_tick",)`` restores the per-plane
+    kernels); elections/reconfiguration repairs compose by aging
+    outside (``age=False``) and feeding the repaired arrays in.
   * ``multipaxos_vote_quorum`` — tick steps 1-2: acceptors process
     Phase2a arrivals, record votes, schedule Phase2b arrivals, count
     per-slot quorums (Acceptor.scala:184-220 + ProxyLeader.scala:
     217-258). Six elementwise passes plus a reduction over [A, G, W]
-    arrays in the XLA version, ONE VMEM-resident pass here.
+    arrays in the XLA version, ONE VMEM-resident pass here. Also folds
+    the per-acceptor max-voted-slot bookkeeping (``max_ord``, the
+    Acceptor.scala:222-237 ``maxVotedSlot`` the read path serves) into
+    the same pass, so ``use_pallas + reads`` is single-pass again.
   * ``multipaxos_p1_promise`` — phase-1 promise/max-vote aggregation
     (startPhase1 / safeValue, Leader.scala:314-329, 409-459): per slot,
     the max-round visible vote across the acceptor axis decides the
@@ -54,7 +69,12 @@ from frankenpaxos_tpu.ops.blocks import (
     t_arr,
     t_space,
 )
-from frankenpaxos_tpu.tpu.common import INF, INF16, ring_retire_pos
+from frankenpaxos_tpu.tpu.common import (
+    INF,
+    INF16,
+    age_clock,
+    ring_retire_pos,
+)
 
 # Mirrors of the backend's slot codes (ops must not import the backend:
 # the backend imports ops). Cross-checked by tests/test_kernel_registry.
@@ -63,6 +83,10 @@ PROPOSED = 1
 CHOSEN = 2
 NO_VALUE = -1
 NOOP_VALUE = -2
+# Saturation floor of the head-relative acc_max_slot delta (the
+# backend's AMS_FLOOR): max_ord entries of acceptors with no vote this
+# tick saturate here so the outside maximum() leaves them untouched.
+AMS_FLOOR = -(2**14)
 
 
 # ---------------------------------------------------------------------------
@@ -80,6 +104,7 @@ def reference_vote_quorum(
     p2b_off: jnp.ndarray,  # [A, G, W] offset clocks (INF16 = none pending)
     p2b_lat: jnp.ndarray,  # [A, G, W] sampled latencies (clock dtype)
     p2b_delivered: jnp.ndarray,  # [A, G, W] bool
+    head: jnp.ndarray,  # [G] ring heads (max_ord's ordinal origin)
 ):
     """The pure-jnp specification (tick steps 1-2 of multipaxos_batched,
     Acceptor.scala:184-220 + ProxyLeader.scala:217-258), acceptor-major.
@@ -87,7 +112,12 @@ def reference_vote_quorum(
     The sixth output ``nsends`` [G, W] counts the Phase2b messages the
     acceptors SENT this tick (votes cast whose reply was delivered) —
     the vote predicate is otherwise plane-internal, and the telemetry
-    phase-2 message accounting needs it to be exact on every path."""
+    phase-2 message accounting needs it to be exact on every path. The
+    seventh, ``max_ord`` [A, G], is each acceptor's max voted ring
+    ordinal this tick (AMS_FLOOR when it cast none) — the read path's
+    ``acc_max_slot`` feed (Acceptor.scala:222-237 maxVotedSlot), folded
+    in so reads don't recompute the vote predicate in a second pass."""
+    W = p2a_off.shape[2]
     lr = leader_round[None, :, None]  # [1, G, 1]
     arrived = p2a_off == 0
     may_vote = arrived & (lr >= acc_round[:, :, None])
@@ -101,7 +131,35 @@ def reference_vote_quorum(
     votes_in = (new_p2b <= 0) & (new_vote_round == lr)
     nvotes = jnp.sum(votes_in.astype(jnp.int32), axis=0)  # [G, W]
     nsends = jnp.sum(sends.astype(jnp.int32), axis=0)  # [G, W]
-    return new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes, nsends
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    ord_of_pos = (w_iota[None, :] - head[:, None]) % W  # [G, W]
+    max_ord = jnp.max(
+        jnp.where(may_vote, ord_of_pos[None, :, :], AMS_FLOOR), axis=2
+    )  # [A, G]
+    return (
+        new_vote_round, new_vote_value, new_p2b, new_acc_round, nvotes,
+        nsends, max_ord,
+    )
+
+
+def _vote_step(lr, sv, acc_r, p2a, vr, vv, p2b, lat, deliv, ord_of_pos):
+    """ONE acceptor's vote step on [BG, W] values — the shared in-kernel
+    body of the vote plane and the megakernel (a fix to the vote
+    semantics lands in both paths by construction). ``lr`` is [BG, 1],
+    ``acc_r`` [BG], ``deliv`` an int8 mask. Returns ``(vote_round',
+    vote_value', p2b', acc_round', max_ord, votes_in, sends)``."""
+    arrived = p2a == 0
+    may_vote = arrived & (lr >= acc_r[:, None])
+    new_vr = jnp.where(may_vote, lr, vr)
+    new_vv = jnp.where(may_vote, sv, vv)
+    sends = may_vote & (deliv != 0)
+    new_p2b = jnp.where(sends, jnp.minimum(p2b, lat), p2b)
+    new_accr = jnp.maximum(
+        acc_r, jnp.max(jnp.where(may_vote, lr, -1), axis=1)
+    )
+    max_ord = jnp.max(jnp.where(may_vote, ord_of_pos, AMS_FLOOR), axis=1)
+    votes_in = ((new_p2b <= 0) & (new_vr == lr)).astype(jnp.int32)
+    return new_vr, new_vv, new_p2b, new_accr, max_ord, votes_in, sends
 
 
 def _vote_quorum_kernel(
@@ -114,37 +172,41 @@ def _vote_quorum_kernel(
     p2b_ref,  # [A, BG, W]
     lat_ref,  # [A, BG, W]
     deliv_ref,  # [A, BG, W] int8 (0/1)
+    head_ref,  # [BG]
     out_vr_ref,
     out_vv_ref,
     out_p2b_ref,
     out_accr_ref,
     out_nv_ref,  # [BG, W]
     out_ns_ref,  # [BG, W] Phase2b sends this tick
+    out_maxord_ref,  # [A, BG] max voted ordinal (AMS_FLOOR = none)
 ):
+    import jax.lax as lax
+
     A = p2a_ref.shape[0]
+    W = p2a_ref.shape[2]
     lr = lr_ref[:][:, None]  # [BG, 1]
     sv = sv_ref[:]  # [BG, W]
+    w_iota = lax.broadcasted_iota(jnp.int32, sv.shape, 1)
+    ord_of_pos = (w_iota - head_ref[:][:, None]) % W
     nvotes = jnp.zeros(sv.shape, jnp.int32)
     nsends = jnp.zeros(sv.shape, jnp.int32)
     # The acceptor axis is tiny (2f+1): a static loop keeps every slice a
     # well-tiled [BG, W] block, with values resident in VMEM across the
     # vote update AND the quorum count.
     for a in range(A):
-        arrived = p2a_ref[a] == 0
-        may_vote = arrived & (lr >= accr_ref[a][:, None])
-        new_vr = jnp.where(may_vote, lr, vr_ref[a])
-        new_vv = jnp.where(may_vote, sv, vv_ref[a])
-        sends = may_vote & (deliv_ref[a] != 0)
-        new_p2b = jnp.where(
-            sends, jnp.minimum(p2b_ref[a], lat_ref[a]), p2b_ref[a]
+        new_vr, new_vv, new_p2b, new_accr, max_ord, votes, sends = (
+            _vote_step(
+                lr, sv, accr_ref[a], p2a_ref[a], vr_ref[a], vv_ref[a],
+                p2b_ref[a], lat_ref[a], deliv_ref[a], ord_of_pos,
+            )
         )
         out_vr_ref[a] = new_vr
         out_vv_ref[a] = new_vv
         out_p2b_ref[a] = new_p2b
-        out_accr_ref[a] = jnp.maximum(
-            accr_ref[a], jnp.max(jnp.where(may_vote, lr, -1), axis=1)
-        )
-        nvotes = nvotes + ((new_p2b <= 0) & (new_vr == lr)).astype(jnp.int32)
+        out_accr_ref[a] = new_accr
+        out_maxord_ref[a] = max_ord
+        nvotes = nvotes + votes
         nsends = nsends + sends.astype(jnp.int32)
     out_nv_ref[:] = nvotes
     out_ns_ref[:] = nsends
@@ -161,6 +223,7 @@ def fused_vote_quorum(
     p2b_off,
     p2b_lat,
     p2b_delivered,
+    head,
     block: int = 256,
     interpret: bool = False,
 ):
@@ -178,6 +241,7 @@ def fused_vote_quorum(
         leader_round = pad_axis(leader_round, 0, pad)
         slot_value = pad_axis(slot_value, 0, pad)
         p2b_delivered = pad_axis(p2b_delivered, 1, pad)
+        head = pad_axis(head, 0, pad)
     p2a_off, vote_round, vote_value, p2b_off, p2b_lat = args3
     Gp = G + pad
 
@@ -198,8 +262,9 @@ def fused_vote_quorum(
             spec3,  # p2b
             spec3,  # p2b_lat
             spec3,  # delivered
+            spec_g,  # head
         ],
-        out_specs=[spec3, spec3, spec3, spec2, spec_gw, spec_gw],
+        out_specs=[spec3, spec3, spec3, spec2, spec_gw, spec_gw, spec2],
     )
     out_shape = [
         jax.ShapeDtypeStruct((A, Gp, W), vote_round.dtype),
@@ -208,8 +273,9 @@ def fused_vote_quorum(
         jax.ShapeDtypeStruct((A, Gp), acc_round.dtype),
         jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nvotes
         jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # Phase2b sends
+        jax.ShapeDtypeStruct((A, Gp), jnp.int32),  # max voted ordinal
     ]
-    vr, vv, p2b, accr, nv, ns = pl.pallas_call(
+    vr, vv, p2b, accr, nv, ns, maxord = pl.pallas_call(
         _vote_quorum_kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -224,11 +290,13 @@ def fused_vote_quorum(
         p2b_off,
         p2b_lat,
         p2b_delivered.astype(jnp.int8),
+        head,
     )
     if pad:
         vr, vv, p2b = vr[:, :G], vv[:, :G], p2b[:, :G]
         accr, nv, ns = accr[:, :G], nv[:G], ns[:G]
-    return vr, vv, p2b, accr, nv, ns
+        maxord = maxord[:, :G]
+    return vr, vv, p2b, accr, nv, ns, maxord
 
 
 # ---------------------------------------------------------------------------
@@ -505,6 +573,92 @@ def reference_mp_dispatch(
     )
 
 
+def _dispatch_slots(
+    t, base, status, sv_in, pt, ls, ct, cr, cv, ra, rep_lat,
+    nvotes, head, next_slot, lr, cap, rok,
+    *, f, retry_timeout, num_groups, bg, W,
+):
+    """The dispatch plane's slot-space body on [BG, W] values — the
+    shared in-kernel program of the dispatch kernel and the megakernel.
+    ``lr`` is [BG, 1]; ``rok`` an int8 [BG] mask; ``base`` the block's
+    first group id (``pl.program_id(0) * bg``). Returns the updated slot
+    arrays plus the masks the per-acceptor writes and the tick's stat
+    reductions need."""
+    import jax.lax as lax
+
+    newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+    ct = jnp.where(newly_chosen, t, ct)
+    cr = jnp.where(newly_chosen, lr, cr)
+    cv = jnp.where(newly_chosen, sv_in, cv)
+    ra = jnp.where(newly_chosen, t + rep_lat, ra)
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    latency = jnp.where(newly_chosen, t - pt, 0)
+
+    w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
+    ord_of_pos = (w_iota - head[:, None]) % W
+    executable = (
+        (status == CHOSEN)
+        & (ra <= t)
+        & (ord_of_pos < (next_slot - head)[:, None])
+    )
+    blocked = jnp.where(executable, W, ord_of_pos)
+    n_retire = jnp.min(blocked, axis=1)  # [BG]
+    retire_mask = ord_of_pos < n_retire[:, None]
+    new_head = head + n_retire
+
+    status = jnp.where(retire_mask, EMPTY, status)
+    sv = jnp.where(retire_mask, NO_VALUE, sv_in)
+    ct = jnp.where(retire_mask, INF_I, ct)
+    cr = jnp.where(retire_mask, -1, cr)
+    cv = jnp.where(retire_mask, NO_VALUE, cv)
+    ra = jnp.where(retire_mask, INF_I, ra)
+    pt = jnp.where(retire_mask, INF_I, pt)
+    ls = jnp.where(retire_mask, INF_I, ls)
+
+    space = W - (next_slot - new_head)
+    count = jnp.minimum(cap, space)
+    delta = (w_iota - next_slot[:, None]) % W
+    is_new = delta < count[:, None]
+    new_next = next_slot + count
+    status = jnp.where(is_new, PROPOSED, status)
+    g_ids = base + lax.broadcasted_iota(jnp.int32, (bg, W), 0)
+    new_value = (
+        (next_slot[:, None] + delta) * num_groups + g_ids
+    ) & 0x7FFFFFFF
+    sv = jnp.where(is_new, new_value, sv)
+    pt = jnp.where(is_new, t, pt)
+    ls = jnp.where(is_new, t, ls)
+
+    timed_out = (
+        (status == PROPOSED)
+        & (t - ls >= retry_timeout)
+        & (rok[:, None] != 0)
+    )
+    ls = jnp.where(timed_out, t, ls)
+    return (
+        status, sv, pt, ls, ct, cr, cv, ra,
+        new_head, new_next, count, n_retire,
+        newly_chosen, retire_mask, is_new, timed_out, latency,
+    )
+
+
+def _dispatch_acceptor(
+    retire_mask, is_new, timed_out, p2a, p2b, vr, vv, sok, rdel,
+    p2a_lat, retry_lat,
+):
+    """One acceptor's dispatch-plane writes on [BG, W] values (shared
+    by the dispatch kernel and the megakernel): retire-clears plus the
+    Phase2a fan-out of fresh proposals and timeout resends. ``sok`` /
+    ``rdel`` are int8 masks."""
+    p2a = jnp.where(retire_mask, INF16, p2a)
+    p2a = jnp.where(is_new & (sok != 0), p2a_lat, p2a)
+    p2a = jnp.where(timed_out & (rdel != 0), retry_lat, p2a)
+    p2b = jnp.where(retire_mask, INF16, p2b)
+    vr = jnp.where(retire_mask, -1, vr)
+    vv = jnp.where(retire_mask, NO_VALUE, vv)
+    return p2a, p2b, vr, vv
+
+
 def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
     def kernel(
         t_ref,  # SMEM (1,)
@@ -520,86 +674,51 @@ def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
         out_head, out_next, out_count, out_nret,
         out_newly, out_retire, out_isnew, out_timed, out_lat,
     ):
-        import jax.lax as lax
         from jax.experimental import pallas as pl
 
         t = t_ref[0]
         A = p2a_ref.shape[0]
-        status = status_ref[:]
-        nvotes = nv_ref[:]
-        head = head_ref[:]
-        next_slot = next_ref[:]
-        newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
-        ct = jnp.where(newly_chosen, t, ct_ref[:])
-        cr = jnp.where(newly_chosen, lr_ref[:][:, None], cr_ref[:])
-        cv = jnp.where(newly_chosen, sv_ref[:], cv_ref[:])
-        ra = jnp.where(newly_chosen, t + rep_lat_ref[:], ra_ref[:])
-        status = jnp.where(newly_chosen, CHOSEN, status)
-        out_lat[:] = jnp.where(newly_chosen, t - pt_ref[:], 0)
-
-        w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
-        ord_of_pos = (w_iota - head[:, None]) % W
-        executable = (
-            (status == CHOSEN)
-            & (ra <= t)
-            & (ord_of_pos < (next_slot - head)[:, None])
-        )
-        blocked = jnp.where(executable, W, ord_of_pos)
-        n_retire = jnp.min(blocked, axis=1)  # [BG]
-        retire_mask = ord_of_pos < n_retire[:, None]
-        out_nret[:] = n_retire
-        new_head = head + n_retire
-        out_head[:] = new_head
-
-        status = jnp.where(retire_mask, EMPTY, status)
-        sv = jnp.where(retire_mask, NO_VALUE, sv_ref[:])
-        out_ct[:] = jnp.where(retire_mask, INF_I, ct)
-        out_cr[:] = jnp.where(retire_mask, -1, cr)
-        out_cv[:] = jnp.where(retire_mask, NO_VALUE, cv)
-        out_ra[:] = jnp.where(retire_mask, INF_I, ra)
-        pt = jnp.where(retire_mask, INF_I, pt_ref[:])
-        ls = jnp.where(retire_mask, INF_I, ls_ref[:])
-
-        space = W - (next_slot - new_head)
-        count = jnp.minimum(cap_ref[:], space)
-        out_count[:] = count
-        delta = (w_iota - next_slot[:, None]) % W
-        is_new = delta < count[:, None]
-        out_next[:] = next_slot + count
-        status = jnp.where(is_new, PROPOSED, status)
-        base = pl.program_id(0) * bg
-        g_ids = base + lax.broadcasted_iota(jnp.int32, (bg, W), 0)
-        new_value = (
-            (next_slot[:, None] + delta) * num_groups + g_ids
-        ) & 0x7FFFFFFF
-        sv = jnp.where(is_new, new_value, sv)
-        pt = jnp.where(is_new, t, pt)
-        ls = jnp.where(is_new, t, ls)
-
-        timed_out = (
-            (status == PROPOSED)
-            & (t - ls >= retry_timeout)
-            & (rok_ref[:][:, None] != 0)
+        (
+            status, sv, pt, ls, ct, cr, cv, ra,
+            new_head, new_next, count, n_retire,
+            newly_chosen, retire_mask, is_new, timed_out, latency,
+        ) = _dispatch_slots(
+            t, pl.program_id(0) * bg,
+            status_ref[:], sv_ref[:], pt_ref[:], ls_ref[:],
+            ct_ref[:], cr_ref[:], cv_ref[:], ra_ref[:], rep_lat_ref[:],
+            nv_ref[:], head_ref[:], next_ref[:], lr_ref[:][:, None],
+            cap_ref[:], rok_ref[:],
+            f=f, retry_timeout=retry_timeout, num_groups=num_groups,
+            bg=bg, W=W,
         )
         out_status[:] = status
         out_sv[:] = sv
         out_pt[:] = pt
-        out_ls[:] = jnp.where(timed_out, t, ls)
+        out_ls[:] = ls
+        out_ct[:] = ct
+        out_cr[:] = cr
+        out_cv[:] = cv
+        out_ra[:] = ra
+        out_head[:] = new_head
+        out_next[:] = new_next
+        out_count[:] = count
+        out_nret[:] = n_retire
         out_newly[:] = newly_chosen.astype(jnp.int8)
         out_retire[:] = retire_mask.astype(jnp.int8)
         out_isnew[:] = is_new.astype(jnp.int8)
         out_timed[:] = timed_out.astype(jnp.int8)
+        out_lat[:] = latency
 
         for a in range(A):
-            p2a = jnp.where(retire_mask, INF16, p2a_ref[a])
-            p2a = jnp.where(is_new & (sok_ref[a] != 0), p2a_lat_ref[a], p2a)
-            p2a = jnp.where(
-                timed_out & (rdel_ref[a] != 0), retry_lat_ref[a], p2a
+            p2a, p2b, vr, vv = _dispatch_acceptor(
+                retire_mask, is_new, timed_out,
+                p2a_ref[a], p2b_ref[a], vr_ref[a], vv_ref[a],
+                sok_ref[a], rdel_ref[a], p2a_lat_ref[a], retry_lat_ref[a],
             )
             out_p2a[a] = p2a
-            out_p2b[a] = jnp.where(retire_mask, INF16, p2b_ref[a])
-            out_vr[a] = jnp.where(retire_mask, -1, vr_ref[a])
-            out_vv[a] = jnp.where(retire_mask, NO_VALUE, vv_ref[a])
+            out_p2b[a] = p2b
+            out_vr[a] = vr
+            out_vv[a] = vv
 
     return kernel
 
@@ -726,6 +845,319 @@ def fused_mp_dispatch(
 
 
 # ---------------------------------------------------------------------------
+# Plane: multipaxos_fused_tick (the whole-tick megakernel: clock aging +
+# vote/quorum + dispatch in ONE grid program — State never round-trips
+# HBM between planes)
+# ---------------------------------------------------------------------------
+
+
+def reference_fused_tick(
+    p2a_off,  # [A, G, W] offset clocks (raw when age=True, aged otherwise)
+    acc_round,  # [A, G]
+    leader_round,  # [G]
+    slot_value,  # [G, W]
+    vote_round,  # [A, G, W]
+    vote_value,  # [A, G, W]
+    p2b_off,  # [A, G, W]
+    p2b_lat,  # [A, G, W] clock dtype
+    p2b_delivered,  # [A, G, W] bool
+    head,  # [G]
+    status,  # [G, W] int8
+    propose_tick,  # [G, W]
+    last_send,  # [G, W]
+    chosen_tick,  # [G, W]
+    chosen_round,  # [G, W]
+    chosen_value,  # [G, W]
+    replica_arrival,  # [G, W]
+    next_slot,  # [G]
+    cap,  # [G] int32
+    retry_ok,  # [G] bool
+    send_ok,  # [A, G, W] bool
+    retry_deliv,  # [A, G, W] bool
+    p2a_lat,  # [A, G, W] clock dtype
+    retry_lat,  # [A, G, W] clock dtype
+    rep_lat,  # [G, W] int32
+    t,  # []
+    *,
+    f: int,
+    retry_timeout: int,
+    num_groups: int,
+    age: bool,
+):
+    """The megakernel's pure-jnp specification: EXACTLY the multi-plane
+    path — optional clock aging, then :func:`reference_vote_quorum`,
+    then :func:`reference_mp_dispatch` — so kernel-vs-reference
+    bit-identity IS megakernel-vs-multi-plane bit-identity. ``age=True``
+    folds the per-tick offset-clock aging in (the fast path, where
+    nothing between aging and the planes touches the clocks);
+    elections/reconfiguration repairs pass ``age=False`` and pre-aged
+    arrays. Returns the 21 dispatch outputs plus ``(acc_round, nsends,
+    max_ord)`` from the vote plane."""
+    if age:
+        p2a_off = age_clock(p2a_off)
+        p2b_off = age_clock(p2b_off)
+    vr, vv, p2b, accr, nvotes, nsends, max_ord = reference_vote_quorum(
+        p2a_off, acc_round, leader_round, slot_value, vote_round,
+        vote_value, p2b_off, p2b_lat, p2b_delivered, head,
+    )
+    outs = reference_mp_dispatch(
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a_off, p2b, vr, vv,
+        nvotes, head, next_slot, leader_round, cap, retry_ok,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+        f=f, retry_timeout=retry_timeout, num_groups=num_groups,
+    )
+    return (*outs, accr, nsends, max_ord)
+
+
+def _fused_tick_kernel_factory(f, retry_timeout, num_groups, age, bg, W):
+    def kernel(
+        t_ref,  # SMEM (1,)
+        p2a_ref, accr_ref, lr_ref, sv_ref,  # vote-plane inputs
+        vr_ref, vv_ref, p2b_ref, p2b_lat_ref, deliv_ref, head_ref,
+        status_ref, pt_ref, ls_ref, ct_ref,  # dispatch-plane inputs
+        cr_ref, cv_ref, ra_ref, next_ref, cap_ref, rok_ref,
+        sok_ref, rdel_ref, p2a_lat_ref, retry_lat_ref, rep_lat_ref,
+        out_status, out_sv, out_pt, out_ls,
+        out_ct, out_cr, out_cv, out_ra,
+        out_p2a, out_p2b, out_vr, out_vv,
+        out_head, out_next, out_count, out_nret,
+        out_newly, out_retire, out_isnew, out_timed, out_lat,
+        out_accr, out_ns, out_maxord,
+    ):
+        import jax.lax as lax
+        from jax.experimental import pallas as pl
+
+        t = t_ref[0]
+        A = p2a_ref.shape[0]
+        lr = lr_ref[:][:, None]  # [BG, 1]
+        sv_in = sv_ref[:]
+        head = head_ref[:]
+        w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
+        ord_of_pos = (w_iota - head[:, None]) % W
+
+        # ---- Vote/quorum (the shared _vote_step body, with the
+        # per-tick clock aging folded in on the fast path). The
+        # per-acceptor vote state lives in VMEM registers across BOTH
+        # planes — this is the HBM round trip the megakernel deletes.
+        nvotes = jnp.zeros((bg, W), jnp.int32)
+        nsends = jnp.zeros((bg, W), jnp.int32)
+        p2a_a, p2b_a, vr_a, vv_a = [], [], [], []
+        for a in range(A):
+            p2a = p2a_ref[a]
+            p2b = p2b_ref[a]
+            if age:
+                p2a = age_clock(p2a)
+                p2b = age_clock(p2b)
+            new_vr, new_vv, new_p2b, new_accr, max_ord, votes, sends = (
+                _vote_step(
+                    lr, sv_in, accr_ref[a], p2a, vr_ref[a], vv_ref[a],
+                    p2b, p2b_lat_ref[a], deliv_ref[a], ord_of_pos,
+                )
+            )
+            out_accr[a] = new_accr
+            out_maxord[a] = max_ord
+            nvotes = nvotes + votes
+            nsends = nsends + sends.astype(jnp.int32)
+            p2a_a.append(p2a)
+            p2b_a.append(new_p2b)
+            vr_a.append(new_vr)
+            vv_a.append(new_vv)
+        out_ns[:] = nsends
+
+        # ---- Dispatch (the shared _dispatch_slots body: quorum ->
+        # Chosen, watermark + retire-clears, propose, retry), reading
+        # the vote step's outputs straight out of VMEM.
+        (
+            status, sv, pt, ls, ct, cr, cv, ra,
+            new_head, new_next, count, n_retire,
+            newly_chosen, retire_mask, is_new, timed_out, latency,
+        ) = _dispatch_slots(
+            t, pl.program_id(0) * bg,
+            status_ref[:], sv_in, pt_ref[:], ls_ref[:],
+            ct_ref[:], cr_ref[:], cv_ref[:], ra_ref[:], rep_lat_ref[:],
+            nvotes, head, next_ref[:], lr, cap_ref[:], rok_ref[:],
+            f=f, retry_timeout=retry_timeout, num_groups=num_groups,
+            bg=bg, W=W,
+        )
+        out_status[:] = status
+        out_sv[:] = sv
+        out_pt[:] = pt
+        out_ls[:] = ls
+        out_ct[:] = ct
+        out_cr[:] = cr
+        out_cv[:] = cv
+        out_ra[:] = ra
+        out_head[:] = new_head
+        out_next[:] = new_next
+        out_count[:] = count
+        out_nret[:] = n_retire
+        out_newly[:] = newly_chosen.astype(jnp.int8)
+        out_retire[:] = retire_mask.astype(jnp.int8)
+        out_isnew[:] = is_new.astype(jnp.int8)
+        out_timed[:] = timed_out.astype(jnp.int8)
+        out_lat[:] = latency
+
+        for a in range(A):
+            p2a, p2b, vr, vv = _dispatch_acceptor(
+                retire_mask, is_new, timed_out,
+                p2a_a[a], p2b_a[a], vr_a[a], vv_a[a],
+                sok_ref[a], rdel_ref[a], p2a_lat_ref[a], retry_lat_ref[a],
+            )
+            out_p2a[a] = p2a
+            out_p2b[a] = p2b
+            out_vr[a] = vr
+            out_vv[a] = vv
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block", "interpret", "f", "retry_timeout", "num_groups", "age",
+    ),
+)
+def fused_tick(
+    p2a_off, acc_round, leader_round, slot_value,
+    vote_round, vote_value, p2b_off, p2b_lat, p2b_delivered, head,
+    status, propose_tick, last_send, chosen_tick,
+    chosen_round, chosen_value, replica_arrival, next_slot, cap, retry_ok,
+    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+    block: int = 128,
+    interpret: bool = False,
+    f: int = 1,
+    retry_timeout: int = 16,
+    num_groups: int = 1,
+    age: bool = True,
+):
+    """Fused :func:`reference_fused_tick`: the whole MultiPaxos tick hot
+    path — aging + vote/quorum + dispatch — as one ``pallas_call`` per
+    tick, gridded over group blocks with everything VMEM-resident."""
+    from jax.experimental import pallas as pl
+
+    A, G, W = p2a_off.shape
+    bg, pad = balanced_block(G, block)
+    agw = [
+        p2a_off, vote_round, vote_value, p2b_off, p2b_lat, p2b_delivered,
+        send_ok, retry_deliv, p2a_lat, retry_lat,
+    ]
+    gw = [
+        slot_value, status, propose_tick, last_send, chosen_tick,
+        chosen_round, chosen_value, replica_arrival, rep_lat,
+    ]
+    gv = [leader_round, head, next_slot, cap, retry_ok]
+    ag = [acc_round]
+    if pad:
+        agw = [pad_axis(x, 1, pad) for x in agw]
+        gw = [pad_axis(x, 0, pad) for x in gw]
+        gv = [pad_axis(x, 0, pad) for x in gv]
+        ag = [pad_axis(x, 1, pad) for x in ag]
+    (p2a_off, vote_round, vote_value, p2b_off, p2b_lat, p2b_delivered,
+     send_ok, retry_deliv, p2a_lat, retry_lat) = agw
+    (slot_value, status, propose_tick, last_send, chosen_tick,
+     chosen_round, chosen_value, replica_arrival, rep_lat) = gw
+    leader_round, head, next_slot, cap, retry_ok = gv
+    (acc_round,) = ag
+    Gp = G + pad
+
+    spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
+    spec2 = pl.BlockSpec((A, bg), lambda i: (0, i))
+    spec_g = pl.BlockSpec((bg,), lambda i: (i,))
+    spec_gw = pl.BlockSpec((bg, W), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=(
+            [pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret))]
+            + [spec3, spec2, spec_g, spec_gw]  # p2a, acc_round, lr, sv
+            + [spec3] * 4  # vote_round, vote_value, p2b, p2b_lat
+            + [spec3, spec_g]  # delivered, head
+            + [spec_gw] * 7  # status .. replica_arrival
+            + [spec_g] * 3  # next_slot, cap, retry_ok
+            + [spec3] * 4  # send_ok, retry_deliv, p2a_lat, retry_lat
+            + [spec_gw]  # rep_lat
+        ),
+        out_specs=(
+            [spec_gw] * 8
+            + [spec3] * 4
+            + [spec_g] * 4  # head, next, count, n_retire
+            + [spec_gw] * 5  # newly, retire, is_new, timed_out, latency
+            + [spec2, spec_gw, spec2]  # acc_round, nsends, max_ord
+        ),
+    )
+    i8 = jnp.int8
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct((Gp, W), status.dtype),
+            jax.ShapeDtypeStruct((Gp, W), slot_value.dtype),
+            jax.ShapeDtypeStruct((Gp, W), propose_tick.dtype),
+            jax.ShapeDtypeStruct((Gp, W), last_send.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_tick.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_round.dtype),
+            jax.ShapeDtypeStruct((Gp, W), chosen_value.dtype),
+            jax.ShapeDtypeStruct((Gp, W), replica_arrival.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), p2a_off.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), p2b_off.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), vote_round.dtype),
+            jax.ShapeDtypeStruct((A, Gp, W), vote_value.dtype),
+            jax.ShapeDtypeStruct((Gp,), head.dtype),
+            jax.ShapeDtypeStruct((Gp,), next_slot.dtype),
+            jax.ShapeDtypeStruct((Gp,), jnp.int32),  # count
+            jax.ShapeDtypeStruct((Gp,), jnp.int32),  # n_retire
+        ]
+        + [jax.ShapeDtypeStruct((Gp, W), i8)] * 4
+        + [
+            jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # latency
+            jax.ShapeDtypeStruct((A, Gp), acc_round.dtype),
+            jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # nsends
+            jax.ShapeDtypeStruct((A, Gp), jnp.int32),  # max_ord
+        ]
+    )
+    kernel = _fused_tick_kernel_factory(
+        f, retry_timeout, num_groups, age, bg, W
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        p2a_off, acc_round, leader_round, slot_value,
+        vote_round, vote_value, p2b_off, p2b_lat,
+        p2b_delivered.astype(i8), head,
+        status, propose_tick, last_send, chosen_tick,
+        chosen_round, chosen_value, replica_arrival,
+        next_slot, cap, retry_ok.astype(i8),
+        send_ok.astype(i8), retry_deliv.astype(i8), p2a_lat, retry_lat,
+        rep_lat,
+    )
+    if pad:
+        # Slice the G padding off by position: [A, G, W] and [A, G]
+        # arrays pad axis 1; [G, W] and [G] arrays pad axis 0.
+        axis1 = {8, 9, 10, 11, 21, 23}  # p2a/p2b/vr/vv, acc_round, max_ord
+        outs = [
+            x[:, :G] if i in axis1 else x[:G] for i, x in enumerate(outs)
+        ]
+    (status, slot_value, propose_tick, last_send,
+     chosen_tick, chosen_round, chosen_value, replica_arrival,
+     p2a_off, p2b_off, vote_round, vote_value,
+     new_head, new_next, count, n_retire,
+     newly, retire, is_new, timed, latency,
+     accr, nsends, max_ord) = outs
+    return (
+        status, slot_value, propose_tick, last_send,
+        chosen_tick, chosen_round, chosen_value, replica_arrival,
+        p2a_off, p2b_off, vote_round, vote_value,
+        new_head, new_next, count, n_retire,
+        newly.astype(bool), retire.astype(bool), is_new.astype(bool),
+        timed.astype(bool), latency,
+        accr, nsends, max_ord,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Registration
 # ---------------------------------------------------------------------------
 
@@ -762,5 +1194,20 @@ registry.register(
         key_of=lambda args: args[8].shape,  # p2a_off: (A, G, W)
         batch_axis=1,  # grids over G
         default_block=256,
+    )
+)
+
+registry.register(
+    registry.Plane(
+        name="multipaxos_fused_tick",
+        backend="multipaxos",
+        reference=reference_fused_tick,
+        kernel=fused_tick,
+        key_of=lambda args: args[0].shape,  # p2a_off: (A, G, W)
+        batch_axis=1,  # grids over G
+        # More live VMEM per block than any per-plane kernel (the whole
+        # tick's arrays at once): a smaller default block; the autotune
+        # table overrides per shape.
+        default_block=128,
     )
 )

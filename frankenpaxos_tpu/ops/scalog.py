@@ -1,0 +1,278 @@
+"""Fused Pallas kernel for the batched Scalog cut-commit plane.
+
+``scalog_cut_commit`` covers tick step 2 of ``tpu/scalog_batched.py``:
+the in-order commit scan over the in-flight cut ring (a cut commits
+only once every earlier cut has — the running max over issue order
+models the Paxos log of cuts), the newest-committed-cut projection onto
+the global log, the per-cut record/latency attribution (each committing
+cut's records waited from ITS OWN snapshot — head-of-line blocking
+stays visible), and the ring-slot frees. In XLA this is an
+associative_scan plus half a dozen gathers over the [P, S] ring; here
+the ring walk is a static unrolled loop over the tiny pipeline depth P
+with the [S] shard axis gridded, and the cross-shard record counts
+accumulate across grid blocks (integer adds — order-exact).
+
+The aggregator's snapshot issue (tick step 3, PRNG + FaultPlan gating)
+stays in XLA: it is [P]-space control. FaultPlans compose from OUTSIDE
+— partition/crash gate the issue, drops/jitter stretch the ordering
+round's latency — so faulty runs ride the kernel unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import INF_I, balanced_block, pad_axis, t_space
+from frankenpaxos_tpu.tpu.common import INF
+
+
+def reference_scalog_cut_commit(
+    cut_vec: jnp.ndarray,  # [P, S] in-flight cut vectors
+    cut_commit_tick: jnp.ndarray,  # [P] commit tick per ring slot (INF)
+    cut_snap_tick: jnp.ndarray,  # [P] snapshot tick per ring slot
+    cut_prev_snap: jnp.ndarray,  # [P] the PREVIOUS cut's snapshot tick
+    last_committed_cut: jnp.ndarray,  # [S]
+    committed_cuts: jnp.ndarray,  # [] cuts committed so far
+    next_cut: jnp.ndarray,  # [] cuts issued so far
+    t: jnp.ndarray,  # []
+):
+    """The pure-jnp specification (tick step 2 of scalog_batched).
+    Returns ``(new_cut [S], committed_now_asc [P], recs_asc [P],
+    lag_asc [P], slot_committed [P], cut_commit_tick' [P],
+    cut_snap_tick' [P])`` — the issue-order commit mask, per-cut record
+    counts and lags (for the latency stats the tick keeps outside), and
+    the freed ring-slot clocks."""
+    P = cut_vec.shape[0]
+    ids_asc = committed_cuts + jnp.arange(P, dtype=jnp.int32)
+    live = ids_asc < next_cut
+    slots_asc = ids_asc % P
+    ticks_asc = jnp.where(live, cut_commit_tick[slots_asc], INF)
+    eff_asc = jax.lax.associative_scan(jnp.maximum, ticks_asc)
+    committed_now_asc = live & (eff_asc <= t)
+    n_new_commits = jnp.sum(committed_now_asc.astype(jnp.int32))
+
+    any_commit = n_new_commits > 0
+    newest_idx = jnp.clip(n_new_commits - 1, 0, P - 1)
+    newest_slot = slots_asc[newest_idx]
+    new_cut = jnp.where(
+        any_commit, cut_vec[newest_slot], last_committed_cut
+    )
+
+    vec_asc = cut_vec[slots_asc]  # [P, S] in issue order
+    prev_vec_asc = jnp.concatenate(
+        [last_committed_cut[None, :], vec_asc[:-1]], axis=0
+    )
+    recs_asc = jnp.where(
+        committed_now_asc, jnp.sum(vec_asc - prev_vec_asc, axis=1), 0
+    )
+    snap_wait_asc = (
+        cut_snap_tick[slots_asc] - cut_prev_snap[slots_asc] + 1
+    ) // 2
+    lag_asc = jnp.where(
+        committed_now_asc,
+        (t - cut_snap_tick[slots_asc]) + snap_wait_asc,
+        0,
+    )
+
+    slot_committed = jnp.zeros((P,), bool)
+    slot_committed = slot_committed.at[slots_asc].set(committed_now_asc)
+    new_commit_tick = jnp.where(slot_committed, INF, cut_commit_tick)
+    new_snap_tick = jnp.where(slot_committed, INF, cut_snap_tick)
+    return (
+        new_cut, committed_now_asc, recs_asc, lag_asc, slot_committed,
+        new_commit_tick, new_snap_tick,
+    )
+
+
+def _scalog_kernel_factory(P):
+    def kernel(
+        s_ref,  # SMEM (3,): [t, committed_cuts, next_cut]
+        vec_ref,  # [P, BS]
+        commit_ref, snap_ref, prev_ref,  # [P]
+        last_ref,  # [BS]
+        out_cut,  # [BS]
+        out_committed,  # [P] int8 (issue order)
+        out_recs,  # [P] int32 (accumulated across shard blocks)
+        out_lag,  # [P] int32
+        out_slotc,  # [P] int8 (ring order)
+        out_commit_tick, out_snap_tick,  # [P]
+    ):
+        from jax.experimental import pallas as pl
+
+        t = s_ref[0]
+        cc = s_ref[1]
+        nc = s_ref[2]
+
+        # The [P]-space ring walk (recomputed per block — P is the tiny
+        # static pipeline depth, so this costs scalar ops only). The
+        # commit predicate avoids the reference's associative cummax:
+        # eff_i <= t  <=>  every tick up to i is <= t  <=>  i precedes
+        # the first in-order cut whose decision is still out — the same
+        # masked-min trick as the ring-retire helpers, value-identical.
+        live = []
+        slot = []
+        ok = []
+        for i in range(P):
+            idx = cc + i
+            live_i = idx < nc
+            slot_i = idx % P
+            tick_i = jnp.int32(INF_I)
+            for j in range(P):
+                tick_i = jnp.where(slot_i == j, commit_ref[j], tick_i)
+            tick_i = jnp.where(live_i, tick_i, INF_I)
+            live.append(live_i)
+            slot.append(slot_i)
+            ok.append(tick_i <= t)
+        committed = []
+        prefix_ok = None
+        for i in range(P):
+            prefix_ok = ok[i] if prefix_ok is None else prefix_ok & ok[i]
+            committed.append(live[i] & prefix_ok)
+
+        # Newest committed cut projection + per-cut record deltas, in
+        # ascending issue order (committed cuts form a prefix, so the
+        # last where() write is the newest committed vector).
+        new_cut = last_ref[:]
+        prev_vec = last_ref[:]
+        init = pl.program_id(0) == 0
+        for i in range(P):
+            vec_i = jnp.zeros(new_cut.shape, vec_ref.dtype)
+            for j in range(P):
+                vec_i = jnp.where(slot[i] == j, vec_ref[j], vec_i)
+            new_cut = jnp.where(committed[i], vec_i, new_cut)
+            partial = jnp.where(
+                committed[i], jnp.sum(vec_i - prev_vec), 0
+            )
+            # recs accumulates across shard blocks: zero on the first
+            # grid step, then integer adds (order-exact).
+            prior = jnp.where(init, 0, out_recs[i])
+            out_recs[i] = prior + partial
+            prev_vec = vec_i
+        out_cut[:] = new_cut
+
+        # [P]-space outputs (identical from every block; the last grid
+        # step's write wins with the same values).
+        for i in range(P):
+            snap_i = jnp.int32(0)
+            prevs_i = jnp.int32(0)
+            for j in range(P):
+                snap_i = jnp.where(slot[i] == j, snap_ref[j], snap_i)
+                prevs_i = jnp.where(slot[i] == j, prev_ref[j], prevs_i)
+            lag_i = jnp.where(
+                committed[i],
+                (t - snap_i) + (snap_i - prevs_i + 1) // 2,
+                0,
+            )
+            out_lag[i] = lag_i
+            out_committed[i] = committed[i].astype(jnp.int8)
+        for j in range(P):
+            sc_j = jnp.asarray(False)
+            for i in range(P):
+                sc_j = jnp.where(slot[i] == j, committed[i], sc_j)
+            out_slotc[j] = sc_j.astype(jnp.int8)
+            out_commit_tick[j] = jnp.where(sc_j, INF_I, commit_ref[j])
+            out_snap_tick[j] = jnp.where(sc_j, INF_I, snap_ref[j])
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_scalog_cut_commit(
+    cut_vec,
+    cut_commit_tick,
+    cut_snap_tick,
+    cut_prev_snap,
+    last_committed_cut,
+    committed_cuts,
+    next_cut,
+    t,
+    block: int = 512,
+    interpret: bool = False,
+):
+    """Fused :func:`reference_scalog_cut_commit`, gridded over shard
+    blocks with the pipeline-depth ring walk unrolled per block."""
+    from jax.experimental import pallas as pl
+
+    P, S = cut_vec.shape
+    bs, pad = balanced_block(S, block)
+    if pad:
+        cut_vec = pad_axis(cut_vec, 1, pad)
+        last_committed_cut = pad_axis(last_committed_cut, 0, pad)
+    Sp = S + pad
+
+    spec_ps = pl.BlockSpec((P, bs), lambda i: (0, i))
+    spec_p = pl.BlockSpec((P,), lambda i: (0,))
+    spec_s = pl.BlockSpec((bs,), lambda i: (i,))
+    grid_spec = pl.GridSpec(
+        grid=(Sp // bs,),
+        in_specs=[
+            pl.BlockSpec((3,), lambda i: (0,), memory_space=t_space(interpret)),
+            spec_ps,  # cut_vec
+            spec_p,  # cut_commit_tick
+            spec_p,  # cut_snap_tick
+            spec_p,  # cut_prev_snap
+            spec_s,  # last_committed_cut
+        ],
+        out_specs=[
+            spec_s,  # new_cut
+            spec_p,  # committed_now (issue order)
+            spec_p,  # recs (accumulated)
+            spec_p,  # lag
+            spec_p,  # slot_committed
+            spec_p,  # commit_tick'
+            spec_p,  # snap_tick'
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((Sp,), cut_vec.dtype),
+        jax.ShapeDtypeStruct((P,), jnp.int8),
+        jax.ShapeDtypeStruct((P,), jnp.int32),
+        jax.ShapeDtypeStruct((P,), jnp.int32),
+        jax.ShapeDtypeStruct((P,), jnp.int8),
+        jax.ShapeDtypeStruct((P,), cut_commit_tick.dtype),
+        jax.ShapeDtypeStruct((P,), cut_snap_tick.dtype),
+    ]
+    scalars = jnp.stack(
+        [
+            jnp.asarray(t, jnp.int32),
+            jnp.asarray(committed_cuts, jnp.int32),
+            jnp.asarray(next_cut, jnp.int32),
+        ]
+    )
+    kernel = _scalog_kernel_factory(P)
+    new_cut, committed, recs, lag, slotc, commit2, snap2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        scalars,
+        cut_vec,
+        cut_commit_tick,
+        cut_snap_tick,
+        cut_prev_snap,
+        last_committed_cut,
+    )
+    if pad:
+        new_cut = new_cut[:S]
+    return (
+        new_cut, committed.astype(bool), recs, lag, slotc.astype(bool),
+        commit2, snap2,
+    )
+
+
+registry.register(
+    registry.Plane(
+        name="scalog_cut_commit",
+        backend="scalog",
+        reference=reference_scalog_cut_commit,
+        kernel=fused_scalog_cut_commit,
+        key_of=lambda args: args[0].shape,  # cut_vec: (P, S)
+        batch_axis=1,  # grids over S (shards)
+        default_block=512,
+    )
+)

@@ -90,9 +90,9 @@ class BatchedCraqConfig:
     faults: FaultPlan = FaultPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the chain
     # propagate/ack plane (tick steps 1-2) routes through
-    # ops.registry.dispatch. Partitioned plans keep the in-tick
-    # hop-deferral path (the kernel does not model heal buffering —
-    # see ops/craq.py).
+    # ops.registry.dispatch. Partitioned plans ride the kernel too —
+    # the plan's side bits enter the plane as statics and hops into cut
+    # nodes defer to the heal tick IN-KERNEL (ops/craq.py).
     kernels: KernelPolicy = KernelPolicy()
 
     def __post_init__(self):
@@ -225,13 +225,6 @@ def tick(
         def _hop(arrival, node):
             return arrival
 
-    n_rows_w = jnp.broadcast_to(
-        jnp.arange(N, dtype=jnp.int32)[:, None], (N, W)
-    )
-    n_rows_r = jnp.broadcast_to(
-        jnp.arange(N, dtype=jnp.int32)[:, None], (N, RW)
-    )
-
     w_status = state.w_status
     w_node = state.w_node
     w_arrival = state.w_arrival
@@ -245,86 +238,45 @@ def tick(
     # batch + ChainNode._handle_ack): DOWN writes join pending sets and
     # forward, the tail applies + replies + starts the ack, UP acks
     # apply locally and propagate, the head ack retires the ring slot.
-    # One registry plane (ops/craq.py) on lossless/healed links: the
-    # kernel recasts the four scatters as one-hot accumulations in one
-    # VMEM-resident pass. Partitioned plans keep the in-tick path below
-    # — its `_hop` defers hops into cut nodes to the heal tick, a
-    # data-dependent rewrite the kernel does not model.
-    if not fp.has_partition:
-        (
-            w_status,
-            w_node,
-            w_arrival,
-            node_dirty_flat,
-            node_version_flat,
-            at_tail,
-            wlat,
-        ) = ops_registry.dispatch(
-            "craq_chain",
-            cfg,
-            w_status,
-            state.w_key,
-            state.w_version,
-            w_node,
-            w_arrival,
-            state.w_issue,
-            node_dirty_flat,
-            node_version_flat,
-            hop_lat_w,
-            t,
-            tail=tail,
-            num_keys=KV,
-        )
-        writes_done = writes_done + jnp.sum(at_tail)
-        write_lat_sum = write_lat_sum + jnp.sum(wlat)
-        wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
-        write_lat_hist = write_lat_hist + jax.ops.segment_sum(
-            at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
-        )
-    else:
-        arrive_down = (w_status == W_DOWN) & (w_arrival == t)
-        at_mid = arrive_down & (w_node < tail)
-        at_tail = arrive_down & (w_node == tail)
-        wslot = w_node * KV + state.w_key  # [N, W] flattened (node, key)
-        node_dirty_flat = node_dirty_flat.at[n_rows_w, wslot].add(
-            at_mid.astype(jnp.int32)
-        )
-        node_version_flat = node_version_flat.at[n_rows_w, wslot].max(
-            jnp.where(at_tail, state.w_version, -1)
-        )
-        # Tail reply: the write is done for the client one hop later.
-        wlat = jnp.where(at_tail, t + hop_lat_w - state.w_issue, 0)
-        writes_done = writes_done + jnp.sum(at_tail)
-        write_lat_sum = write_lat_sum + jnp.sum(wlat)
-        wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
-        write_lat_hist = write_lat_hist + jax.ops.segment_sum(
-            at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
-        )
-        # Advance: mid-chain writes head to the next node; the tail's
-        # ack heads back to node L-2.
-        w_node = jnp.where(at_mid, w_node + 1, w_node)
-        w_node = jnp.where(at_tail, tail - 1, w_node)
-        w_status = jnp.where(at_tail, W_UP, w_status)
-        w_arrival = jnp.where(
-            arrive_down, _hop(t + hop_lat_w, w_node), w_arrival
-        )
-
-        arrive_up = (w_status == W_UP) & (w_arrival == t)
-        uslot = w_node * KV + state.w_key
-        node_version_flat = node_version_flat.at[n_rows_w, uslot].max(
-            jnp.where(arrive_up, state.w_version, -1)
-        )
-        node_dirty_flat = node_dirty_flat.at[n_rows_w, uslot].add(
-            -arrive_up.astype(jnp.int32)
-        )
-        retire = arrive_up & (w_node == 0)
-        w_status = jnp.where(retire, W_EMPTY, w_status)
-        w_arrival = jnp.where(retire, INF, w_arrival)
-        keep_up = arrive_up & ~retire
-        w_node = jnp.where(keep_up, w_node - 1, w_node)
-        w_arrival = jnp.where(
-            keep_up, _hop(t + hop_lat_w, w_node), w_arrival
-        )
+    # One registry plane (ops/craq.py): the kernel recasts the four
+    # scatters as one-hot accumulations in one VMEM-resident pass, and
+    # partitioned plans ride it too — the plan's side bits enter as
+    # statics and hops into cut nodes defer to the heal tick in-kernel
+    # (the same `faults.defer_to_heal` rewrite `_hop` applies to the
+    # read/issue sites below).
+    (
+        w_status,
+        w_node,
+        w_arrival,
+        node_dirty_flat,
+        node_version_flat,
+        at_tail,
+        wlat,
+    ) = ops_registry.dispatch(
+        "craq_chain",
+        cfg,
+        w_status,
+        state.w_key,
+        state.w_version,
+        w_node,
+        w_arrival,
+        state.w_issue,
+        node_dirty_flat,
+        node_version_flat,
+        hop_lat_w,
+        t,
+        tail=tail,
+        num_keys=KV,
+        side=tuple(fp.partition) if fp.has_partition else (),
+        partition_start=fp.partition_start,
+        partition_heal=fp.partition_heal,
+    )
+    writes_done = writes_done + jnp.sum(at_tail)
+    write_lat_sum = write_lat_sum + jnp.sum(wlat)
+    wbins = jnp.clip(wlat, 0, LAT_BINS - 1)
+    write_lat_hist = write_lat_hist + jax.ops.segment_sum(
+        at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
+    )
 
     # ---- 3. Reads (apportioned queries, ChainNode._process_read_batch).
     r_status = state.r_status

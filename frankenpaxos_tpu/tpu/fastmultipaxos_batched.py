@@ -40,6 +40,10 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -78,6 +82,11 @@ class BatchedFastMultiPaxosConfig:
     # TCP (delay-only), so a recovering slot cannot deadlock.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # Kernel-layer dispatch policy (ops/registry.py): the vote plane —
+    # census/pairwise-match counting, fast choose, recovery triggers,
+    # the classic round, and the chosen stamps (tick steps 2-3) — routes
+    # through ops.registry.dispatch as `fastmultipaxos_vote`.
+    kernels: KernelPolicy = KernelPolicy()
 
     @property
     def n(self) -> int:
@@ -99,6 +108,7 @@ class BatchedFastMultiPaxosConfig:
         assert self.jitter >= 0
         assert self.recovery_timeout >= 2 * (self.lat_max + self.jitter)
         self.faults.validate(axis=self.n)
+        self.kernels.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -257,97 +267,54 @@ def tick(
     acc_next = state.acc_next + jnp.sum(take, axis=2)
     cmd_arrival = jnp.where(take, INF, state.cmd_arrival)
 
-    # ---- 2. Leader observes votes per slot. A slot EXISTS once any
-    # acceptor's vote is visible; census = votes visible among acceptors
-    # whose nextSlot passed the slot.
-    visible = vote_seen <= t  # [A, G, W]
-    n_visible = jnp.sum(visible, axis=0)
-    open_tick = jnp.where(
-        (state.open_tick == INF) & (n_visible > 0) & (status == S_OPEN),
-        t,
+    # ---- 2+3. The vote plane (one registry kernel, ops/fastmultipaxos.
+    # py): the leader observes per-slot vote censuses (pairwise
+    # same-value counts over the tiny acceptor axis), the fast-committed
+    # ledger records any value that ever held FQ actual votes (visible
+    # or not), slots choose on FQ identical VISIBLE votes or fall to
+    # classic recovery (full census without a fast quorum, or a timeout
+    # with a quorum of the census visible — the O4 popular-items rule
+    # picks best_value, which a fast-committed value always dominates),
+    # the classic round's acceptor votes and f+1 quorum complete, and
+    # chosen slots stamp value + replica arrival. Scalar stat counters
+    # reduce the plane's masks out here.
+    (
+        status,
+        open_tick,
+        fast_committed,
+        rv_value,
+        rv_p2a_arrival,
+        rv_p2b_arrival,
+        rv_voted,
+        chosen_value,
+        replica_arrival,
+        newly_chosen,
+        fast_ok,
+        start_rec,
+        safety_mask,
+    ) = ops_registry.dispatch(
+        "fastmultipaxos_vote",
+        cfg,
+        vote_value,
+        vote_seen,
+        status,
         state.open_tick,
-    )
-    # Pairwise same-value counts (A is tiny).
-    same = (
-        (vote_value[:, None] == vote_value[None, :])
-        & (vote_value[None, :] != NO_VALUE)
-        & visible[:, None]
-        & visible[None, :]
-    )  # [A, A, G, W]
-    match_count = jnp.sum(same, axis=1)  # [A, G, W] per acceptor's value
-    best_count = jnp.max(match_count, axis=0)  # [G, W]
-    best_a = jnp.argmax(match_count, axis=0)  # [G, W]
-    best_value = jnp.take_along_axis(
-        vote_value, best_a[None, :, :], axis=0
-    )[0]  # [G, W]
-
-    # Fast-committed ledger (unobserved quorums included): a value with
-    # FQ actual votes, visible or not.
-    same_all = (
-        (vote_value[:, None] == vote_value[None, :])
-        & (vote_value[None, :] != NO_VALUE)
-    )
-    full_count = jnp.max(jnp.sum(same_all, axis=1), axis=0)
-    full_a = jnp.argmax(jnp.sum(same_all, axis=1), axis=0)
-    full_value = jnp.take_along_axis(
-        vote_value, full_a[None, :, :], axis=0
-    )[0]
-    fast_committed = jnp.where(
-        (state.fast_committed == NO_VALUE) & (full_count >= FQ),
-        full_value,
         state.fast_committed,
-    )
-
-    # (a) Fast choose: FQ identical visible votes.
-    fast_ok = (status == S_OPEN) & (best_count >= FQ)
-    # (b) Recovery trigger: full census visible with no fast quorum, or
-    # the slot timed out (Leader.scala phase2b waiting logic).
-    census_full = n_visible >= A
-    # Timeout recovery additionally needs a QUORUM of the census visible
-    # (n_visible >= A - f): that guarantees at least quorum_majority
-    # votes of any unobserved fast-committed value are visible, so the
-    # O4 argmax below cannot contradict it.
-    timed_out = (
-        (open_tick < INF)
-        & (t - open_tick >= cfg.recovery_timeout)
-        & (n_visible >= A - f)
-    )
-    start_rec = (
-        (status == S_OPEN) & ~fast_ok & (census_full | timed_out)
-    )
-    # O4: a popular value (>= MAJ among visible votes) must be picked;
-    # best_count >= MAJ implies best_value is it (a fast-committed value
-    # dominates all others). With no votes visible... recovery only
-    # starts when votes exist (open_tick set), so best_value is real.
-    rv_value = jnp.where(start_rec, best_value, state.rv_value)
-    status = jnp.where(start_rec, S_RECOVER, status)
-    recoveries = state.recoveries + jnp.sum(start_rec)
-    rv_p2a_arrival = jnp.where(
-        start_rec[None, :, :],
-        t + jnp.broadcast_to(rv_lat[None], (A, G, W)),
+        state.rv_value,
         state.rv_p2a_arrival,
+        state.rv_p2b_arrival,
+        state.rv_voted,
+        state.chosen_value,
+        state.replica_arrival,
+        rv_lat,
+        reply_lat,
+        t,
+        fq=FQ,
+        f=f,
+        recovery_timeout=cfg.recovery_timeout,
     )
-
-    # ---- 3. Classic round at acceptors + choose.
-    rv_now = rv_p2a_arrival == t
-    rv_voted = state.rv_voted | rv_now
-    rv_p2b_arrival = jnp.where(rv_now, t + rv_lat[None], state.rv_p2b_arrival)
-    rv_p2a_arrival = jnp.where(rv_now, INF, rv_p2a_arrival)
-    n_rv = jnp.sum(rv_voted & (rv_p2b_arrival <= t), axis=0)
-    rec_ok = (status == S_RECOVER) & (n_rv >= f + 1)
-
-    newly_chosen = fast_ok | rec_ok
-    value_now = jnp.where(fast_ok, best_value, state.rv_value)
-    safety_violations = state.safety_violations + jnp.sum(
-        newly_chosen
-        & (fast_committed != NO_VALUE)
-        & (value_now != fast_committed)
-    )
-    chosen_value = jnp.where(newly_chosen, value_now, state.chosen_value)
-    status = jnp.where(newly_chosen, S_CHOSEN, status)
-    replica_arrival = jnp.where(
-        newly_chosen, t + reply_lat, state.replica_arrival
-    )
+    recoveries = state.recoveries + jnp.sum(start_rec)
+    safety_violations = state.safety_violations + jnp.sum(safety_mask)
     committed_slots = state.committed_slots + jnp.sum(newly_chosen)
     fast_chosen = state.fast_chosen + jnp.sum(fast_ok)
 

@@ -47,6 +47,10 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
     ring_retire,
 )
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -85,6 +89,11 @@ class BatchedHorizontalConfig:
     # Crash/revive stalls a group's leader (no proposals while down).
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # Kernel-layer dispatch policy (ops/registry.py): the vote plane —
+    # bank-masked acceptor votes, in-bank quorum count, choose, and the
+    # bank-isolation ledger (tick steps 1-2) — routes through
+    # ops.registry.dispatch as `horizontal_vote`.
+    kernels: KernelPolicy = KernelPolicy()
 
     @property
     def n(self) -> int:
@@ -108,6 +117,7 @@ class BatchedHorizontalConfig:
         if self.reconfigure_every:
             assert self.reconfigure_every >= 2
         self.faults.validate(axis=self.pool)
+        self.kernels.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -237,44 +247,45 @@ def tick(
             fp, faults_mod.fault_key(key, 9), fault_alive
         )
 
-    # ---- 1. Acceptors vote on arriving Phase2as — but ONLY rows in the
-    # bank the slot's chunk owns (Acceptor.scala votes only for chunks it
-    # belongs to; a Phase2a is only ever SENT to the right bank, so the
-    # mask is defense in depth feeding the bank_violations check).
-    slot_bank = jnp.mod(state.slot_epoch, 2)  # [G, W] (-1 stays -1)
-    row_matches = bank_of_row[:, None, None] == slot_bank[None, :, :]
-    p2a_now = state.p2a_arrival == t
-    may_vote = p2a_now & row_matches & (state.status == PROPOSED)[None, :, :]
-    voted = state.voted | may_vote
-    vote_epoch = jnp.where(
-        may_vote, state.slot_epoch[None, :, :], state.vote_epoch
+    # ---- 1+2. The vote plane (one registry kernel, ops/horizontal.py):
+    # acceptors of the slot's BANK process Phase2a arrivals (Acceptor.
+    # scala votes only for chunks it belongs to; a Phase2a is only ever
+    # SENT to the right bank, so the mask is defense in depth feeding
+    # the bank_violations check), Phase2b replies schedule, the per-slot
+    # in-bank quorum count chooses, and the bank-isolation ledger counts
+    # wrong-bank votes. Scalar stats reduce the plane's masks out here.
+    (
+        status,
+        p2a_arrival,
+        p2b_arrival,
+        voted,
+        vote_epoch,
+        newly_chosen,
+        lat,
+        viol,
+    ) = ops_registry.dispatch(
+        "horizontal_vote",
+        cfg,
+        state.slot_epoch,
+        state.status,
+        state.propose_tick,
+        state.p2a_arrival,
+        state.p2b_arrival,
+        state.voted,
+        state.vote_epoch,
+        p2b_lat,
+        p2b_del if p2b_del is not None else jnp.ones((P, G, W), bool),
+        t,
+        n=n,
+        quorum=cfg.quorum,
     )
-    # Under a fault plan the VOTE lands but the Phase2b reply may be
-    # dropped or cut (the retry plane re-solicits it after a heal).
-    p2b_send = may_vote if p2b_del is None else may_vote & p2b_del
-    p2b_arrival = jnp.where(p2b_send, t + p2b_lat, state.p2b_arrival)
-    p2a_arrival = jnp.where(p2a_now, INF, state.p2a_arrival)
-
-    # ---- 2. Quorums form: f+1 arrived Phase2bs within the slot's bank.
-    arrived = (p2b_arrival <= t) & voted & row_matches
-    votes_in_bank = jnp.sum(arrived, axis=0)  # [G, W]
-    newly_chosen = (state.status == PROPOSED) & (
-        votes_in_bank >= cfg.quorum
-    )
-    status = jnp.where(newly_chosen, CHOSEN, state.status)
     committed = state.committed + jnp.sum(newly_chosen)
-    lat = jnp.where(newly_chosen, t - state.propose_tick, 0)
     lat_sum = state.lat_sum + jnp.sum(lat)
     bins = jnp.clip(lat, 0, LAT_BINS - 1)
     lat_hist = state.lat_hist + jax.ops.segment_sum(
         newly_chosen.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
     )
-    # Bank isolation ledger: any vote not in the slot's bank is a safety
-    # violation (can only happen through a bug — the check has teeth via
-    # tests that forge votes).
-    bank_violations = state.bank_violations + jnp.sum(
-        voted & ~row_matches & (state.slot_epoch >= 0)[None, :, :]
-    )
+    bank_violations = state.bank_violations + jnp.sum(viol)
 
     # ---- 3. Watermark advance (choose(), Leader.scala:459-498): walk
     # the contiguous CHOSEN prefix. A Configuration value crossing the

@@ -127,8 +127,12 @@ class BatchedMultiPaxosConfig:
     # the tick — vote/quorum, phase-1 promise aggregation, and the
     # choose/watermark/propose/retry dispatch plane — routes through
     # ops.dispatch, which picks the fused Pallas kernel, interpret mode,
-    # or the pure-jnp reference per this knob. The default ("auto") is
-    # Pallas on TPU backends and the reference elsewhere.
+    # or the pure-jnp reference per this knob. Off the reference path
+    # the vote + dispatch planes additionally fuse into the whole-tick
+    # MEGAKERNEL (multipaxos_fused_tick: one Pallas grid program per
+    # tick, offset clocks aged in-kernel on the fast path); disable=
+    # ("multipaxos_fused_tick",) restores the per-plane kernels. The
+    # default ("auto") is Pallas on TPU backends, reference elsewhere.
     kernels: KernelPolicy = KernelPolicy()
     # Legacy flags, folded into the policy by ops.registry.policy_of:
     # use_pallas=True ⇒ mode="on" (kernel on TPU, interpret elsewhere)
@@ -522,15 +526,53 @@ def tick(
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
 
+    # FaultPlan crash/revive merges into the leader-candidate machinery
+    # (independent death sources compose); a none plan returns the
+    # native rates unchanged, keeping this path bit-identical. Computed
+    # HERE (it is pure Python over the static config) because the
+    # megakernel routing below needs to know whether elections run.
+    eff_fail, eff_revive = faults_mod.effective_process_rates(
+        fp, cfg.fail_rate, cfg.revive_rate
+    )
+
+    # Megakernel routing (ops/multipaxos.py multipaxos_fused_tick): when
+    # the policy resolves the fused-tick plane off the reference path,
+    # the vote/quorum + dispatch planes below run as ONE Pallas grid
+    # program — and on the fast path (no elections, no reconfiguration:
+    # nothing between aging and the planes touches the clocks) the
+    # per-tick offset-clock aging folds into the same kernel, so the two
+    # largest [A, G, W] arrays are read from HBM exactly once per tick.
+    # The megakernel SUBSUMES the vote/dispatch planes, so disabling
+    # either of them must also force the multi-plane path (the disable
+    # knob's "reference regardless of mode" contract).
+    use_mega = all(
+        ops_registry.resolve_mode(name, cfg) != "reference"
+        for name in (
+            "multipaxos_fused_tick",
+            "multipaxos_vote_quorum",
+            "multipaxos_dispatch",
+        )
+    )
+    fuse_age = (
+        use_mega
+        and not (eff_fail > 0.0 or cfg.device_elections)
+        and not cfg.reconfigure_every
+    )
+
     # Age the offset clocks ONCE, up front: after aging, an offset is
     # exactly ``arrival - t`` for the current tick (0 = arrives now),
     # the invariant every plane below tests against. Writes during this
     # tick store raw latencies (>= lat_min >= 1), which the next tick's
     # aging rebases — so a message written with latency L arrives
     # exactly L ticks later, matching the absolute-clock semantics bit
-    # for bit.
-    p2a_aged = age_clock(state.p2a_arrival)
-    p2b_aged = age_clock(state.p2b_arrival)
+    # for bit. When the megakernel owns the aging, the raw clocks flow
+    # straight into it (age=True) and XLA never emits a separate pass.
+    if fuse_age:
+        p2a_aged = state.p2a_arrival
+        p2b_aged = state.p2b_arrival
+    else:
+        p2a_aged = age_clock(state.p2a_arrival)
+        p2b_aged = age_clock(state.p2b_arrival)
 
     # ---- 0. Device-side failure detection + election (Participant.scala:
     # 72-209 heartbeat silence detection; ClassicRoundRobin round
@@ -546,12 +588,6 @@ def tick(
     heartbeat_miss = state.heartbeat_miss
     elections = state.elections
     owner_alive_now = None  # None = feature off, everyone alive
-    # FaultPlan crash/revive merges into the leader-candidate machinery
-    # (independent death sources compose); a none plan returns the
-    # native rates unchanged, keeping this path bit-identical.
-    eff_fail, eff_revive = faults_mod.effective_process_rates(
-        fp, cfg.fail_rate, cfg.revive_rate
-    )
     if eff_fail > 0.0 or cfg.device_elections:
         C = cfg.num_leader_candidates
         if eff_fail > 0.0:
@@ -752,46 +788,12 @@ def tick(
             + jnp.sum(p1a_now)
         )
 
-    # ---- 1+2. Acceptors process Phase2a arrivals (Acceptor.handlePhase2a,
-    # Acceptor.scala:184-220): vote iff the message round >= promised round;
-    # on vote, promise the round and schedule the Phase2b arrival. Then
-    # quorum counting (ProxyLeader.handlePhase2b, ProxyLeader.scala:217-258):
-    # a slot is chosen when f+1 Phase2bs for the current round have arrived
-    # — a sum over the acceptor axis. One registry plane: the fused Pallas
-    # kernel reads every [A, G, W] array from HBM exactly once, dtype-native
-    # (int16 offset clocks, int16 rounds — no boundary casts); the reference
-    # twin is the exact pure-jnp program this tick ran before the fusion.
-    # The sixth output counts the Phase2b sends (the vote predicate is
-    # plane-internal; telemetry needs it exact on every path).
-    (
-        vote_round,
-        vote_value,
-        p2b_arrival,
-        new_acc_round,
-        nvotes,
-        ns_plane,
-    ) = ops_registry.dispatch(
-        "multipaxos_vote_quorum",
-        cfg,
-        p2a_in,
-        acc_round_in,
-        leader_round,
-        slot_value_in,
-        vote_round_in,
-        vote_value_in,
-        p2b_in,
-        p2b_lat,
-        p2b_delivered,
-    )
-    p2b_sends = jnp.sum(ns_plane)
-
-    # ---- 2-5. The dispatch plane (quorum -> Chosen, the commit-watermark
-    # advance with its retire-clears, leader proposals with their Phase2a
-    # fan-out, and timeout resends) fuses into one registry plane. The
-    # [G]-space CONTROL decisions — proposal caps under elections /
-    # reconfiguration / closed workloads, retry gates, thrifty quorum
-    # membership — are decided HERE and enter as tiny per-group vectors,
-    # so every feature composes with the fused kernel unchanged.
+    # ---- [G]-space CONTROL for the planes below: proposal caps under
+    # elections / reconfiguration / closed workloads, retry gates,
+    # thrifty quorum membership. Decided OUTSIDE the planes and entering
+    # as tiny per-group vectors (or [A, G, W] masks the PRNG already
+    # produced), so every feature composes with the fused kernels — and
+    # the whole-tick megakernel — unchanged.
     cap = jnp.full((G,), cfg.slots_per_tick, jnp.int32)
     if cfg.max_slots_per_group is not None:
         cap = jnp.minimum(
@@ -826,59 +828,159 @@ def tick(
         if retry_delivered is not None
         else jnp.ones((A, G, W), bool)
     )
-    (
-        status,
-        slot_value,
-        propose_tick,
-        last_send,
-        chosen_tick,
-        chosen_round,
-        chosen_value,
-        replica_arrival,
-        p2a_arrival,
-        p2b_arrival,
-        vote_round,
-        vote_value,
-        head,
-        next_slot,
-        count,
-        n_retire,
-        newly_chosen,
-        retire_mask,
-        is_new,
-        timed_out,
-        latency,
-    ) = ops_registry.dispatch(
-        "multipaxos_dispatch",
-        cfg,
-        status,
-        slot_value_in,
-        state.propose_tick,
-        last_send_in,
-        state.chosen_tick,
-        state.chosen_round,
-        state.chosen_value,
-        state.replica_arrival,
-        p2a_in,
-        p2b_arrival,
-        vote_round,
-        vote_value,
-        nvotes,
-        state.head,
-        state.next_slot,
-        leader_round,
-        cap,
-        retry_ok,
-        send_ok,
-        retry_deliv,
-        p2a_lat,
-        retry_lat,
-        rep_lat,
-        t,
-        f=f,
-        retry_timeout=cfg.retry_timeout,
-        num_groups=G,
-    )
+
+    # ---- 1-5. The tick hot path: acceptors vote on Phase2a arrivals
+    # (Acceptor.handlePhase2a, Acceptor.scala:184-220), quorums form
+    # (ProxyLeader.handlePhase2b, ProxyLeader.scala:217-258), then the
+    # dispatch plane (quorum -> Chosen, the commit-watermark advance
+    # with its retire-clears, leader proposals with their Phase2a
+    # fan-out, and timeout resends). Under the megakernel policy this is
+    # ONE registry plane — one Pallas grid program per tick, clocks aged
+    # in-kernel on the fast path, vote state never leaving VMEM between
+    # the vote and dispatch halves; otherwise the two per-plane kernels
+    # (or their pure-jnp references) run back to back, which is the
+    # exact pre-megakernel program the fused path is pinned against.
+    # Either way the planes are dtype-native (int16 offset clocks, int16
+    # rounds — no boundary casts) and emit the vote plane's Phase2b-send
+    # counts plus each acceptor's max voted ordinal (the read path's
+    # acc_max_slot feed), so telemetry and reads stay single-pass.
+    if use_mega:
+        (
+            status,
+            slot_value,
+            propose_tick,
+            last_send,
+            chosen_tick,
+            chosen_round,
+            chosen_value,
+            replica_arrival,
+            p2a_arrival,
+            p2b_arrival,
+            vote_round,
+            vote_value,
+            head,
+            next_slot,
+            count,
+            n_retire,
+            newly_chosen,
+            retire_mask,
+            is_new,
+            timed_out,
+            latency,
+            new_acc_round,
+            ns_plane,
+            max_ord,
+        ) = ops_registry.dispatch(
+            "multipaxos_fused_tick",
+            cfg,
+            p2a_in,
+            acc_round_in,
+            leader_round,
+            slot_value_in,
+            vote_round_in,
+            vote_value_in,
+            p2b_in,
+            p2b_lat,
+            p2b_delivered,
+            state.head,
+            status,
+            state.propose_tick,
+            last_send_in,
+            state.chosen_tick,
+            state.chosen_round,
+            state.chosen_value,
+            state.replica_arrival,
+            state.next_slot,
+            cap,
+            retry_ok,
+            send_ok,
+            retry_deliv,
+            p2a_lat,
+            retry_lat,
+            rep_lat,
+            t,
+            f=f,
+            retry_timeout=cfg.retry_timeout,
+            num_groups=G,
+            age=fuse_age,
+        )
+    else:
+        (
+            vote_round,
+            vote_value,
+            p2b_arrival,
+            new_acc_round,
+            nvotes,
+            ns_plane,
+            max_ord,
+        ) = ops_registry.dispatch(
+            "multipaxos_vote_quorum",
+            cfg,
+            p2a_in,
+            acc_round_in,
+            leader_round,
+            slot_value_in,
+            vote_round_in,
+            vote_value_in,
+            p2b_in,
+            p2b_lat,
+            p2b_delivered,
+            state.head,
+        )
+        (
+            status,
+            slot_value,
+            propose_tick,
+            last_send,
+            chosen_tick,
+            chosen_round,
+            chosen_value,
+            replica_arrival,
+            p2a_arrival,
+            p2b_arrival,
+            vote_round,
+            vote_value,
+            head,
+            next_slot,
+            count,
+            n_retire,
+            newly_chosen,
+            retire_mask,
+            is_new,
+            timed_out,
+            latency,
+        ) = ops_registry.dispatch(
+            "multipaxos_dispatch",
+            cfg,
+            status,
+            slot_value_in,
+            state.propose_tick,
+            last_send_in,
+            state.chosen_tick,
+            state.chosen_round,
+            state.chosen_value,
+            state.replica_arrival,
+            p2a_in,
+            p2b_arrival,
+            vote_round,
+            vote_value,
+            nvotes,
+            state.head,
+            state.next_slot,
+            leader_round,
+            cap,
+            retry_ok,
+            send_ok,
+            retry_deliv,
+            p2a_lat,
+            retry_lat,
+            rep_lat,
+            t,
+            f=f,
+            retry_timeout=cfg.retry_timeout,
+            num_groups=G,
+        )
+    p2b_sends = jnp.sum(ns_plane)
 
     # Commit latency stats (from the plane's newly_chosen/latency masks).
     n_new = jnp.sum(newly_chosen)
@@ -1067,23 +1169,15 @@ def tick(
 
         # (a) Acceptor bookkeeping: a vote on per-group slot s raises that
         # acceptor's maxVotedSlot (Acceptor.scala:222-237 serves it from
-        # vote state). Votes happened against the PRE-retire ring —
-        # ord_of_pos is exactly that (it uses state.head), and the
-        # HEAD-RELATIVE delta of a vote at ordinal o is simply o.
-        # NOTE: this recomputes the vote predicate outside the vote
-        # plane (one extra pass over p2a_arrival when reads are on);
-        # folding acc_max_slot into the kernel outputs would restore the
-        # single-pass property — reference-path runs fuse this anyway.
-        may_vote_r = (p2a_in == 0) & (
-            leader_round[None, :, None] >= acc_round_in[:, :, None]
-        )
+        # vote state). Votes happened against the PRE-retire ring, and
+        # the HEAD-RELATIVE delta of a vote at ordinal o is simply o —
+        # which is exactly the vote plane's ``max_ord`` output (computed
+        # inside the kernel pass, AMS_FLOOR where no vote), so reads no
+        # longer re-derive the vote predicate in a second [A, G, W]
+        # sweep: ``use_pallas + reads`` is single-pass again.
         slot_of_pos = state.head[:, None] + ord_of_pos  # [G, W] per-group slot
         acc_max_slot = jnp.maximum(
-            acc_max_slot,
-            jnp.max(
-                jnp.where(may_vote_r, ord_of_pos[None, :, :], AMS_FLOOR),
-                axis=2,
-            ).astype(acc_max_slot.dtype),
+            acc_max_slot, max_ord.astype(acc_max_slot.dtype)
         )
         # Global floor for the linearizability check: the largest global
         # slot chosen so far (any read issued after this point must bind

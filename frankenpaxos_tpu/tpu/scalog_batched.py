@@ -32,6 +32,10 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
@@ -56,6 +60,11 @@ class BatchedScalogConfig:
     # the heal tick; crash/revive flaps the aggregator itself.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # Kernel-layer dispatch policy (ops/registry.py): the cut-commit
+    # plane — the in-order commit scan, newest-cut projection, and
+    # per-cut latency attribution (tick step 2) — routes through
+    # ops.registry.dispatch as `scalog_cut_commit`.
+    kernels: KernelPolicy = KernelPolicy()
 
     def __post_init__(self):
         assert self.num_shards >= 2
@@ -65,6 +74,7 @@ class BatchedScalogConfig:
         assert 0 <= self.append_jitter <= self.appends_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         self.faults.validate(axis=self.num_shards)
+        self.kernels.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -154,63 +164,41 @@ def tick(
     # landed. Commit ORDER is cut-issue order (the Paxos log of cuts),
     # so a cut only commits once all earlier cuts have; model: a cut's
     # effective commit tick is the max over itself and predecessors
-    # (cumulative max over the ring in issue order).
-    # Live cut ids are [committed_cuts, next_cut); the slot of cut k is
-    # k % P. Walk them in ascending id order: a cut's EFFECTIVE commit
-    # tick is the running max of its own and every predecessor's
-    # (associative cumulative max — the Paxos log of cuts commits in
-    # order).
-    ids_asc = state.committed_cuts + jnp.arange(P, dtype=jnp.int32)
-    live = ids_asc < state.next_cut
-    slots_asc = ids_asc % P
-    ticks_asc = jnp.where(live, state.cut_commit_tick[slots_asc], INF)
-    eff_asc = jax.lax.associative_scan(jnp.maximum, ticks_asc)
-    committed_now_asc = live & (eff_asc <= t)
+    # (cumulative max over the ring in issue order). One registry plane
+    # (ops/scalog.py): the in-order commit scan, the newest-cut
+    # projection, the PER-CUT record/latency attribution (each
+    # committing cut's records waited from its own snapshot —
+    # attributing everything to the newest cut would hide exactly the
+    # head-of-line blocking the cumulative max models), and the
+    # ring-slot frees; the scalar stats reduce the plane's outputs here.
+    (
+        new_cut,
+        committed_now_asc,
+        recs_asc,
+        lag_asc,
+        slot_committed,
+        cut_commit_tick,
+        cut_snap_tick,
+    ) = ops_registry.dispatch(
+        "scalog_cut_commit",
+        cfg,
+        state.cut_vec,
+        state.cut_commit_tick,
+        state.cut_snap_tick,
+        state.cut_prev_snap,
+        state.last_committed_cut,
+        state.committed_cuts,
+        state.next_cut,
+        t,
+    )
     n_new_commits = jnp.sum(committed_now_asc.astype(jnp.int32))
     committed_cuts = state.committed_cuts + n_new_commits
-
-    # Newest committed cut vector (if any committed this tick).
-    any_commit = n_new_commits > 0
-    newest_idx = jnp.clip(n_new_commits - 1, 0, P - 1)
-    newest_slot = slots_asc[newest_idx]
-    new_cut = jnp.where(
-        any_commit, state.cut_vec[newest_slot], state.last_committed_cut
-    )
     global_len = jnp.sum(new_cut)
-
-    # Record-ordering latency, PER CUT: each committing cut's records
-    # (its vector minus its predecessor's) waited from that cut's own
-    # snapshot — attributing everything to the newest cut would hide
-    # exactly the head-of-line blocking the cumulative-max models.
-    vec_asc = state.cut_vec[slots_asc]  # [P, S] in issue order
-    prev_vec_asc = jnp.concatenate(
-        [state.last_committed_cut[None, :], vec_asc[:-1]], axis=0
-    )
-    recs_asc = jnp.where(
-        committed_now_asc, jnp.sum(vec_asc - prev_vec_asc, axis=1), 0
-    )
-    # A record's append->ordered latency = wait for its cut's snapshot
-    # (uniform over the snapshot interval: half of it in expectation)
-    # + the cut's snapshot->commit lag.
-    snap_wait_asc = (
-        state.cut_snap_tick[slots_asc] - state.cut_prev_snap[slots_asc] + 1
-    ) // 2
-    lag_asc = jnp.where(
-        committed_now_asc,
-        (t - state.cut_snap_tick[slots_asc]) + snap_wait_asc,
-        0,
-    )
     lat_sum = state.lat_sum + jnp.sum(lag_asc * recs_asc)
     lat_count = state.lat_count + jnp.sum(recs_asc)
     lat_hist = state.lat_hist + jax.ops.segment_sum(
         recs_asc, jnp.clip(lag_asc, 0, LAT_BINS - 1), LAT_BINS
     )
-
-    # Free committed slots.
-    slot_committed = jnp.zeros((P,), bool)
-    slot_committed = slot_committed.at[slots_asc].set(committed_now_asc)
-    cut_commit_tick = jnp.where(slot_committed, INF, state.cut_commit_tick)
-    cut_snap_tick = jnp.where(slot_committed, INF, state.cut_snap_tick)
 
     # ---- 3. Aggregator snapshots a new cut on its period, if the
     # pipeline has room (ShardInfo -> proposed cut -> Paxos; commit after

@@ -79,3 +79,27 @@ def test_unknown_backend_raises():
     ctx = core.Context(backends=("no-such-backend",))
     with pytest.raises(KeyError, match="no-such-backend"):
         core.run(rule_ids=["trace-dtype-policy"], ctx=ctx)
+
+
+def test_fused_tick_rule_clean():
+    """The flagship tick with the kernel policy engaged traces exactly
+    ONE pallas_call — the whole-tick megakernel, no per-plane HBM round
+    trips — and the reference-mode trace is pallas-free."""
+    report = core.run(rule_ids=["trace-fused-tick"])
+    assert not report.findings, "\n" + report.format()
+
+
+def test_fused_tick_rule_has_teeth():
+    """Disabling the fused-tick plane (per-plane dispatch: two
+    pallas_calls) must trip the single-pallas_call pin."""
+    from frankenpaxos_tpu.ops.registry import KernelPolicy
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+
+    cfg = mb.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2,
+        kernels=KernelPolicy(
+            mode="interpret", disable=("multipaxos_fused_tick",)
+        ),
+    )
+    eqns = rules_trace._tick_eqns("multipaxos", cfg)
+    assert rules_trace._count_pallas_calls(eqns) == 2

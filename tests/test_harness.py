@@ -308,6 +308,22 @@ def test_microbench_kernels_smoke():
         pathlib.Path(__file__).resolve().parent.parent / "bench.py"
     ).read_text()
     assert '"kernel_policy"' in bench_src and '"kernel_coverage"' in bench_src
+    assert '"fused_tick"' in bench_src
+
+
+def test_microbench_fused_tick_smoke():
+    """The megakernel-vs-multiplane race at toy size (guards
+    ``microbench fused_tick``): both sides sweep blocks, outputs are
+    bit-identical, and the summary row carries the speedup."""
+    from frankenpaxos_tpu.harness import microbench
+
+    rows = microbench.bench_fused_tick(
+        iters=1, rounds=1, A=3, G=32, W=16, N=32, L=3, KV=4, CW=8
+    )
+    summary = next(r for r in rows if r["case"] == "summary")
+    assert summary["bit_identical"] is True
+    assert summary["speedup"] > 0
+    assert {r["case"] for r in rows} == {"fused", "multiplane", "summary"}
 
 
 def test_deploy_smoke_profiles_a_role(tmp_path):

@@ -15,7 +15,14 @@ import pytest
 
 from frankenpaxos_tpu.ops import registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
-from frankenpaxos_tpu.tpu import craq_batched, mencius_batched, multipaxos_batched
+from frankenpaxos_tpu.tpu import (
+    craq_batched,
+    fastmultipaxos_batched,
+    horizontal_batched,
+    mencius_batched,
+    multipaxos_batched,
+    scalog_batched,
+)
 
 
 def _hash(state, fields):
@@ -93,9 +100,13 @@ def test_registry_coverage_names_all_backends():
         "multipaxos_vote_quorum",
         "multipaxos_p1_promise",
         "multipaxos_dispatch",
+        "multipaxos_fused_tick",
     }
     assert cov["mencius"] == ("mencius_vote",)
     assert cov["craq"] == ("craq_chain",)
+    assert cov["fastmultipaxos"] == ("fastmultipaxos_vote",)
+    assert cov["horizontal"] == ("horizontal_vote",)
+    assert cov["scalog"] == ("scalog_cut_commit",)
 
 
 def test_block_for_exact_nearest_and_default():
@@ -133,6 +144,8 @@ def test_write_table_merges(tmp_path):
 
 def test_ops_constant_mirrors_match_backends():
     from frankenpaxos_tpu.ops import craq as ops_craq
+    from frankenpaxos_tpu.ops import fastmultipaxos as ops_fmp
+    from frankenpaxos_tpu.ops import horizontal as ops_hz
     from frankenpaxos_tpu.ops import multipaxos as ops_mp
     from frankenpaxos_tpu.tpu.common import INF
 
@@ -141,11 +154,22 @@ def test_ops_constant_mirrors_match_backends():
     assert ops_mp.CHOSEN == multipaxos_batched.CHOSEN
     assert ops_mp.NO_VALUE == multipaxos_batched.NO_VALUE
     assert ops_mp.NOOP_VALUE == multipaxos_batched.NOOP_VALUE
+    assert ops_mp.AMS_FLOOR == multipaxos_batched.AMS_FLOOR
     assert ops_mp.INF_I == int(INF)
     assert ops_craq.W_EMPTY == craq_batched.W_EMPTY
     assert ops_craq.W_DOWN == craq_batched.W_DOWN
     assert ops_craq.W_UP == craq_batched.W_UP
     assert ops_craq.INF_I == int(INF)
+    assert ops_fmp.S_OPEN == fastmultipaxos_batched.S_OPEN
+    assert ops_fmp.S_RECOVER == fastmultipaxos_batched.S_RECOVER
+    assert ops_fmp.S_CHOSEN == fastmultipaxos_batched.S_CHOSEN
+    assert ops_fmp.NO_VALUE == fastmultipaxos_batched.NO_VALUE
+    assert ops_fmp.INF_I == int(INF)
+    assert ops_hz.EMPTY == horizontal_batched.EMPTY
+    assert ops_hz.PROPOSED == horizontal_batched.PROPOSED
+    assert ops_hz.CHOSEN == horizontal_batched.CHOSEN
+    assert ops_hz.NO_VALUE == horizontal_batched.NO_VALUE
+    assert ops_hz.INF_I == int(INF)
 
 
 # ---------------------------------------------------------------------------
@@ -227,10 +251,12 @@ def test_craq_interpret_matches_reference(seed):
     assert hashes["interpret"] == hashes["reference"]
 
 
-def test_craq_partitioned_plan_routes_to_reference():
-    """A partition plan must not reach the kernel (it does not model
-    heal deferral): the registry reports reference mode, and the run
-    matches the same config in explicit reference mode bit for bit."""
+def test_craq_partitioned_plan_rides_the_kernel():
+    """Partitioned plans ride the kernel (in-kernel defer-to-heal: the
+    side bits enter as statics and hops into cut nodes wait for the
+    heal tick): the registry resolves the kernel path, and the run
+    matches explicit reference mode bit for bit through the partition
+    window AND after the heal."""
     from frankenpaxos_tpu.tpu.faults import FaultPlan
 
     cr = craq_batched
@@ -247,7 +273,219 @@ def test_craq_partitioned_plan_routes_to_reference():
 
     assert (
         registry.resolve_mode("craq_chain", make_cfg(KernelPolicy("interpret")))
-        == "reference"
+        == "interpret"
     )
     hashes = _run_both(cr, make_cfg, 25, 0, CRAQ_FIELDS)
     assert hashes["interpret"] == hashes["reference"]
+
+
+def test_craq_never_healing_partition_rides_the_kernel():
+    """partition_heal = -1 (never heals): cut hops defer forever (INF)
+    in-kernel, still bit-identical to the reference path."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    cr = craq_batched
+    plan = FaultPlan(partition=(0, 0, 1), partition_start=3)
+
+    def make_cfg(pol):
+        return cr.BatchedCraqConfig(
+            num_chains=3, chain_len=3, num_keys=4, window=8,
+            writes_per_tick=2, reads_per_tick=0, read_window=8,
+            faults=plan, kernels=pol,
+        )
+
+    hashes = _run_both(cr, make_cfg, 20, 1, CRAQ_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+# ---------------------------------------------------------------------------
+# New backend planes: interpret-vs-reference whole runs (3 seeds)
+# ---------------------------------------------------------------------------
+
+FMP_FIELDS = (
+    "head", "acc_next", "cmd_seq", "status", "chosen_value",
+    "fast_committed", "vote_value", "vote_seen", "rv_value", "rv_voted",
+    "cmd_status", "cmd_id", "committed_slots", "fast_chosen",
+    "recoveries", "cmds_done", "dups", "safety_violations", "lat_hist",
+)
+HORIZONTAL_FIELDS = (
+    "next_slot", "head", "status", "is_config", "slot_epoch",
+    "p2a_arrival", "p2b_arrival", "voted", "vote_epoch", "epoch",
+    "boundary", "committed", "executed", "reconfigs_done",
+    "bank_violations", "lat_hist",
+)
+SCALOG_FIELDS = (
+    "local_len", "cut_vec", "cut_commit_tick", "cut_snap_tick",
+    "next_cut", "committed_cuts", "global_len", "last_committed_cut",
+    "lat_sum", "lat_count", "lat_hist",
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fastmultipaxos_interpret_matches_reference(seed):
+    fm = fastmultipaxos_batched
+
+    def make_cfg(pol):
+        # Jitter drives slot conflicts, so the fast path, the recovery
+        # path, and the classic round all exercise through the plane.
+        return fm.BatchedFastMultiPaxosConfig(
+            f=1, num_groups=4, window=8, cmd_window=8, cmds_per_tick=2,
+            jitter=2, recovery_timeout=10, retry_timeout=12, kernels=pol,
+        )
+
+    hashes = _run_both(fm, make_cfg, 30, seed, FMP_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_horizontal_interpret_matches_reference(seed):
+    hz = horizontal_batched
+
+    def make_cfg(pol):
+        # Periodic reconfiguration exercises both banks and the chunk
+        # handover around the vote plane.
+        return hz.BatchedHorizontalConfig(
+            f=1, num_groups=4, window=16, slots_per_tick=2, alpha=8,
+            retry_timeout=8, reconfigure_every=9, kernels=pol,
+        )
+
+    hashes = _run_both(hz, make_cfg, 30, seed, HORIZONTAL_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_scalog_interpret_matches_reference(seed):
+    sc = scalog_batched
+
+    def make_cfg(pol):
+        return sc.BatchedScalogConfig(
+            num_shards=5, max_inflight_cuts=4, cut_every=2, kernels=pol,
+        )
+
+    hashes = _run_both(sc, make_cfg, 30, seed, SCALOG_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+# ---------------------------------------------------------------------------
+# The whole-tick megakernel: sha256 bit-identity vs the multi-plane path
+# (disable=("multipaxos_fused_tick",) restores the per-plane kernels) and
+# vs the pure reference, 3 seeds, with and without faults — full state
+# INCLUDING the telemetry ring.
+# ---------------------------------------------------------------------------
+
+
+def _mp_full_state_hash(st):
+    m = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(st):
+        m.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return m.hexdigest()[:16]
+
+
+def _mega_cfg(pol, faults=None, **kw):
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    base = dict(
+        f=1, num_groups=5, window=8, slots_per_tick=2, lat_min=1,
+        lat_max=3, drop_rate=0.1, retry_timeout=6,
+    )
+    base.update(kw)
+    return multipaxos_batched.BatchedMultiPaxosConfig(
+        **base, faults=faults or FaultPlan.none(), kernels=pol,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("faulty", [False, True])
+def test_megakernel_matches_multiplane_and_reference(seed, faulty):
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    mp = multipaxos_batched
+    faults = (
+        FaultPlan(
+            drop_rate=0.1, dup_rate=0.1, jitter=1, partition=(0, 0, 1),
+            partition_start=5, partition_heal=15,
+        )
+        if faulty
+        else None
+    )
+    policies = {
+        "mega": KernelPolicy(mode="interpret"),
+        "multiplane": KernelPolicy(
+            mode="interpret", disable=("multipaxos_fused_tick",)
+        ),
+        "reference": KernelPolicy.reference(),
+    }
+    hashes = {}
+    for name, pol in policies.items():
+        cfg = _mega_cfg(pol, faults=faults)
+        st, _ = mp.run_ticks(
+            cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 30,
+            jax.random.PRNGKey(seed),
+        )
+        assert int(st.committed) > 0
+        hashes[name] = _mp_full_state_hash(st)
+    assert hashes["mega"] == hashes["multiplane"] == hashes["reference"]
+
+
+def test_megakernel_resolution_and_age_routing():
+    """The fused-tick plane resolves exactly like any other plane, and
+    disabling it restores the per-plane dispatch path (both paths are
+    live source code — the analysis dispatch-coverage rule sees both)."""
+    mk = multipaxos_batched.BatchedMultiPaxosConfig
+    assert (
+        registry.resolve_mode("multipaxos_fused_tick", mk()) == "reference"
+    )
+    assert (
+        registry.resolve_mode(
+            "multipaxos_fused_tick", mk(kernels=KernelPolicy(mode="interpret"))
+        )
+        == "interpret"
+    )
+    cfg = mk(
+        kernels=KernelPolicy(
+            mode="interpret", disable=("multipaxos_fused_tick",)
+        )
+    )
+    assert registry.resolve_mode("multipaxos_fused_tick", cfg) == "reference"
+    assert registry.resolve_mode("multipaxos_vote_quorum", cfg) == "interpret"
+
+
+def test_disabling_a_subsumed_plane_forces_the_multiplane_path():
+    """The megakernel subsumes vote_quorum + dispatch: disabling EITHER
+    must route the tick off the megakernel so the disable knob's
+    reference-regardless-of-mode contract holds for the sub-plane (the
+    traced tick then carries exactly one pallas_call — the remaining
+    per-plane kernel — instead of the fused one running both halves)."""
+    from frankenpaxos_tpu.analysis import rules_trace
+
+    mk = multipaxos_batched.BatchedMultiPaxosConfig
+    for disabled in ("multipaxos_dispatch", "multipaxos_vote_quorum"):
+        cfg = mk(
+            num_groups=8, window=16,
+            kernels=KernelPolicy(mode="interpret", disable=(disabled,)),
+        )
+        eqns = rules_trace._tick_eqns("multipaxos", cfg)
+        assert rules_trace._count_pallas_calls(eqns) == 1, disabled
+
+
+def test_megakernel_with_elections_and_reads(seed=1):
+    """Feature axes that re-route the megakernel's aging (elections:
+    repairs write into pre-aged clocks, so the kernel runs age=False)
+    and consume its max_ord output (reads): still bit-identical."""
+    mp = multipaxos_batched
+    kw = dict(
+        device_elections=True, fail_rate=0.02, heartbeat_timeout=4,
+        read_rate=2, read_window=10, num_groups=4,
+    )
+    hashes = {}
+    for name, pol in (
+        ("mega", KernelPolicy(mode="interpret")),
+        ("reference", KernelPolicy.reference()),
+    ):
+        cfg = _mega_cfg(pol, **kw)
+        st, _ = mp.run_ticks(
+            cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 30,
+            jax.random.PRNGKey(seed),
+        )
+        hashes[name] = _mp_full_state_hash(st)
+    assert hashes["mega"] == hashes["reference"]

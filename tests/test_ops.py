@@ -12,14 +12,22 @@ from frankenpaxos_tpu.ops import (
     INF,
     INF16,
     fused_craq_chain,
+    fused_fmp_vote,
+    fused_horizontal_vote,
     fused_mencius_vote,
     fused_mp_dispatch,
     fused_p1_promise,
+    fused_scalog_cut_commit,
+    fused_tick,
     fused_vote_quorum,
     reference_craq_chain,
+    reference_fmp_vote,
+    reference_fused_tick,
+    reference_horizontal_vote,
     reference_mencius_vote,
     reference_mp_dispatch,
     reference_p1_promise,
+    reference_scalog_cut_commit,
     reference_vote_quorum,
 )
 
@@ -61,10 +69,17 @@ def vote_quorum_args(key, A=3, G=8, W=16):
     p2b = jnp.where(vote_round >= 0, _clock(ks[6], (A, G, W), p=0.7), INF16)
     lat = jax.random.randint(ks[7], (A, G, W), 1, 4).astype(I16)
     delivered = jax.random.uniform(ks[8], (A, G, W)) < 0.9
+    head = jax.random.randint(ks[9], (G,), 0, 100)
     return (
         p2a, acc_round, leader_round, slot_value,
-        vote_round, vote_value, p2b, lat, delivered,
+        vote_round, vote_value, p2b, lat, delivered, head,
     )
+
+
+VOTE_QUORUM_OUTS = [
+    "vote_round", "vote_value", "p2b", "acc_round", "nvotes", "nsends",
+    "max_ord",
+]
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
@@ -74,10 +89,7 @@ def test_fused_vote_quorum_matches_reference(seed, shape):
     args = vote_quorum_args(jax.random.PRNGKey(seed), A=A, G=G, W=W)
     ref = reference_vote_quorum(*args)
     got = fused_vote_quorum(*args, block=max(G // 2, 1), interpret=True)
-    _assert_trees_equal(
-        ref, got,
-        ["vote_round", "vote_value", "p2b", "acc_round", "nvotes", "nsends"],
-    )
+    _assert_trees_equal(ref, got, VOTE_QUORUM_OUTS)
 
 
 def p1_promise_args(key, A=3, G=8, W=16):
@@ -183,6 +195,257 @@ def test_fused_mp_dispatch_matches_reference(seed, shape):
     _assert_trees_equal(ref, got, MP_DISPATCH_OUTS)
 
 
+def fused_tick_args(key, A=3, G=8, W=16, aged=True):
+    """Megakernel inputs = the vote-plane args + the dispatch-only args
+    (same distributions as the per-plane helpers). ``aged=False`` draws
+    clocks one tick earlier so the in-kernel aging path has arrivals to
+    consume."""
+    kv, kd = jax.random.split(key)
+    (p2a, acc_round, leader_round, slot_value, vote_round, vote_value,
+     p2b, p2b_lat, delivered, _head) = vote_quorum_args(kv, A=A, G=G, W=W)
+    if not aged:
+        # Pre-aged clocks: +1 so that one in-kernel aging step lands the
+        # same arrivals (0 stays "arrives now" after the kernel's age).
+        p2a = jnp.where(p2a == INF16, INF16, p2a + 1).astype(p2a.dtype)
+        p2b = jnp.where(p2b == INF16, INF16, p2b + 1).astype(p2b.dtype)
+    d = mp_dispatch_args(kd, A=A, G=G, W=W)
+    (status, d_slot_value, propose_tick, last_send, chosen_tick,
+     chosen_round, chosen_value, replica_arrival, _p2a, _p2b, _vr, _vv,
+     _nvotes, head, next_slot, d_leader_round, cap, retry_ok,
+     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t) = d
+    del d_slot_value, d_leader_round
+    return (
+        p2a, acc_round, leader_round, slot_value, vote_round, vote_value,
+        p2b, p2b_lat, delivered, head,
+        status, propose_tick, last_send, chosen_tick, chosen_round,
+        chosen_value, replica_arrival, next_slot, cap, retry_ok,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+    )
+
+
+FUSED_TICK_OUTS = MP_DISPATCH_OUTS + ["acc_round", "nsends", "max_ord"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("age", [True, False])
+# Padding edges: G not a multiple of the block, odd A, W untouched by
+# blocking (the grid tiles G only).
+@pytest.mark.parametrize("shape", [(3, 8, 16), (5, 7, 32)])
+def test_fused_tick_matches_reference(seed, age, shape):
+    """The megakernel vs its composition reference (aging + vote/quorum
+    + dispatch), both aging modes, padding-edge shapes."""
+    A, G, W = shape
+    args = fused_tick_args(
+        jax.random.PRNGKey(seed), A=A, G=G, W=W, aged=not age
+    )
+    statics = dict(f=1, retry_timeout=8, num_groups=G, age=age)
+    ref = reference_fused_tick(*args, **statics)
+    got = fused_tick(*args, block=max(G // 2, 1), interpret=True, **statics)
+    _assert_trees_equal(ref, got, FUSED_TICK_OUTS)
+
+
+def test_fused_tick_composition_equals_planes():
+    """reference_fused_tick(age=True) IS age_clock + vote plane +
+    dispatch plane: the megakernel's reference twin reproduces the exact
+    multi-plane program, so kernel-vs-reference bit-identity doubles as
+    megakernel-vs-multi-plane bit-identity."""
+    from frankenpaxos_tpu.tpu.common import age_clock
+
+    A, G, W = 3, 6, 16
+    args = fused_tick_args(jax.random.PRNGKey(9), A=A, G=G, W=W, aged=False)
+    (p2a, acc_round, leader_round, slot_value, vote_round, vote_value,
+     p2b, p2b_lat, delivered, head,
+     status, propose_tick, last_send, chosen_tick, chosen_round,
+     chosen_value, replica_arrival, next_slot, cap, retry_ok,
+     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t) = args
+    fused = reference_fused_tick(
+        *args, f=1, retry_timeout=8, num_groups=G, age=True
+    )
+    p2a_aged, p2b_aged = age_clock(p2a), age_clock(p2b)
+    vr, vv, p2b2, accr, nvotes, nsends, max_ord = reference_vote_quorum(
+        p2a_aged, acc_round, leader_round, slot_value, vote_round,
+        vote_value, p2b_aged, p2b_lat, delivered, head,
+    )
+    planes = reference_mp_dispatch(
+        status, slot_value, propose_tick, last_send, chosen_tick,
+        chosen_round, chosen_value, replica_arrival, p2a_aged, p2b2,
+        vr, vv, nvotes, head, next_slot, leader_round, cap, retry_ok,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+        f=1, retry_timeout=8, num_groups=G,
+    )
+    _assert_trees_equal(
+        (*planes, accr, nsends, max_ord), fused, FUSED_TICK_OUTS
+    )
+
+
+def fmp_vote_args(key, A=3, G=8, W=16, t=20):
+    ks = jax.random.split(key, 14)
+    vote_value = jnp.where(
+        jax.random.uniform(ks[0], (A, G, W)) < 0.6,
+        jax.random.randint(ks[1], (A, G, W), 0, 6),  # few values: conflicts
+        -1,
+    )
+    vote_seen = jnp.where(
+        vote_value >= 0, jax.random.randint(ks[2], (A, G, W), 0, t + 4), INF
+    )
+    status = jax.random.randint(ks[3], (G, W), 0, 3).astype(I8)
+    open_tick = jnp.where(
+        status > 0, jax.random.randint(ks[4], (G, W), 0, t), INF
+    )
+    fast_committed = jnp.where(
+        jax.random.uniform(ks[5], (G, W)) < 0.2,
+        jax.random.randint(ks[6], (G, W), 0, 6),
+        -1,
+    )
+    rv_value = jnp.where(
+        status == 1, jax.random.randint(ks[7], (G, W), 0, 6), -1
+    )
+    rv_p2a = jnp.where(
+        (status == 1)[None] & (jax.random.uniform(ks[8], (A, G, W)) < 0.5),
+        jax.random.randint(ks[9], (A, G, W), t - 1, t + 3),
+        INF,
+    )
+    rv_voted = (status == 1)[None] & (
+        jax.random.uniform(ks[10], (A, G, W)) < 0.4
+    )
+    rv_p2b = jnp.where(
+        rv_voted, jax.random.randint(ks[11], (A, G, W), t - 2, t + 3), INF
+    )
+    chosen_value = jnp.where(status == 2, 1, -1)
+    replica_arrival = jnp.where(
+        status == 2, jax.random.randint(ks[12], (G, W), t, t + 5), INF
+    )
+    kl = jax.random.split(ks[13], 2)
+    rv_lat = jax.random.randint(kl[0], (G, W), 1, 4)
+    reply_lat = jax.random.randint(kl[1], (G, W), 1, 4)
+    return (
+        vote_value, vote_seen, status, open_tick, fast_committed,
+        rv_value, rv_p2a, rv_p2b, rv_voted, chosen_value,
+        replica_arrival, rv_lat, reply_lat, jnp.int32(t),
+    )
+
+
+FMP_VOTE_OUTS = [
+    "status", "open_tick", "fast_committed", "rv_value",
+    "rv_p2a", "rv_p2b", "rv_voted", "chosen_value", "replica_arrival",
+    "newly_chosen", "fast_ok", "start_rec", "safety",
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shape", [(3, 8, 16), (5, 7, 32)])
+def test_fused_fmp_vote_matches_reference(seed, shape):
+    A, G, W = shape
+    args = fmp_vote_args(jax.random.PRNGKey(seed), A=A, G=G, W=W)
+    statics = dict(fq=2 if A == 3 else 4, f=(A - 1) // 2,
+                   recovery_timeout=8)
+    ref = reference_fmp_vote(*args, **statics)
+    got = fused_fmp_vote(
+        *args, block=max(G // 2, 1), interpret=True, **statics
+    )
+    _assert_trees_equal(ref, got, FMP_VOTE_OUTS)
+
+
+def horizontal_vote_args(key, P=6, G=8, W=16, t=20):
+    ks = jax.random.split(key, 10)
+    status = jax.random.randint(ks[0], (G, W), 0, 3).astype(I8)
+    slot_epoch = jnp.where(
+        status > 0, jax.random.randint(ks[1], (G, W), 0, 4), -1
+    ).astype(I16)
+    propose_tick = jnp.where(
+        status > 0, jax.random.randint(ks[2], (G, W), 0, t), INF
+    )
+    p2a = jnp.where(
+        (status == 1)[None] & (jax.random.uniform(ks[3], (P, G, W)) < 0.5),
+        jax.random.randint(ks[4], (P, G, W), t - 1, t + 3),
+        INF,
+    )
+    voted = (status > 0)[None] & (
+        jax.random.uniform(ks[5], (P, G, W)) < 0.4
+    )
+    vote_epoch = jnp.where(voted, slot_epoch[None], -1).astype(I16)
+    p2b = jnp.where(
+        voted, jax.random.randint(ks[6], (P, G, W), t - 2, t + 3), INF
+    )
+    p2b_lat = jax.random.randint(ks[7], (P, G, W), 1, 4)
+    delivered = jax.random.uniform(ks[8], (P, G, W)) < 0.9
+    return (
+        slot_epoch, status, propose_tick, p2a, p2b, voted, vote_epoch,
+        p2b_lat, delivered, jnp.int32(t),
+    )
+
+
+HORIZONTAL_VOTE_OUTS = [
+    "status", "p2a", "p2b", "voted", "vote_epoch",
+    "newly_chosen", "lat", "viol",
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("dims", [(6, 8, 16), (6, 7, 32)])
+def test_fused_horizontal_vote_matches_reference(seed, dims):
+    P, G, W = dims
+    args = horizontal_vote_args(jax.random.PRNGKey(seed), P=P, G=G, W=W)
+    statics = dict(n=P // 2, quorum=P // 4 + 1)
+    ref = reference_horizontal_vote(*args, **statics)
+    got = fused_horizontal_vote(
+        *args, block=max(G // 2, 1), interpret=True, **statics
+    )
+    _assert_trees_equal(ref, got, HORIZONTAL_VOTE_OUTS)
+
+
+def scalog_args(key, P=8, S=16, t=30):
+    ks = jax.random.split(key, 6)
+    committed_cuts = jnp.int32(5)
+    live_n = int(jax.random.randint(ks[0], (), 0, P + 1))
+    next_cut = committed_cuts + live_n
+    # Monotone live cut vectors (cuts dominate their predecessors).
+    grow = jax.random.randint(ks[1], (P, S), 0, 5)
+    base = jax.random.randint(ks[2], (S,), 0, 20)
+    # Issue-order rows mapped back onto ring slots.
+    ids = committed_cuts + jnp.arange(P)
+    vec_asc = base[None, :] + jnp.cumsum(grow, axis=0)
+    cut_vec = jnp.zeros((P, S), jnp.int32).at[ids % P].set(vec_asc)
+    cut_commit_tick = jnp.full((P,), INF, jnp.int32).at[ids % P].set(
+        jnp.where(
+            jnp.arange(P) < live_n,
+            jax.random.randint(ks[3], (P,), t - 3, t + 4),
+            INF,
+        )
+    )
+    cut_snap_tick = jnp.full((P,), INF, jnp.int32).at[ids % P].set(
+        jnp.where(
+            jnp.arange(P) < live_n,
+            jax.random.randint(ks[4], (P,), t - 10, t - 3),
+            INF,
+        )
+    )
+    cut_prev_snap = jnp.maximum(cut_snap_tick - 2, 0)
+    last_committed = base
+    return (
+        cut_vec, cut_commit_tick, cut_snap_tick, cut_prev_snap,
+        last_committed, committed_cuts, next_cut, jnp.int32(t),
+    )
+
+
+SCALOG_OUTS = [
+    "new_cut", "committed_now", "recs", "lag", "slot_committed",
+    "commit_tick", "snap_tick",
+]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("dims", [(8, 16), (4, 23)])
+def test_fused_scalog_cut_commit_matches_reference(seed, dims):
+    P, S = dims
+    args = scalog_args(jax.random.PRNGKey(seed), P=P, S=S)
+    ref = reference_scalog_cut_commit(*args)
+    got = fused_scalog_cut_commit(
+        *args, block=max(S // 2, 1), interpret=True
+    )
+    _assert_trees_equal(ref, got, SCALOG_OUTS)
+
+
 def mencius_args(key, L=8, W=16, A=3, t=9):
     ks = jax.random.split(key, 6)
     p2a = jnp.where(
@@ -282,7 +545,7 @@ def test_reference_matches_tick_phase():
     )
     p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
 
-    vr, vv, p2b, accr, nvotes, nsends = reference_vote_quorum(
+    vr, vv, p2b, accr, nvotes, nsends, max_ord = reference_vote_quorum(
         age_clock(state.p2a_arrival),
         state.acc_round,
         state.leader_round,
@@ -292,6 +555,7 @@ def test_reference_matches_tick_phase():
         age_clock(state.p2b_arrival),
         p2b_lat,
         p2b_delivered,
+        state.head,
     )
     after = tick(cfg, state, jnp.int32(1), tkey)
     np.testing.assert_array_equal(np.asarray(vr), np.asarray(after.vote_round))
