@@ -31,6 +31,19 @@ under the standard fault plan (tpu/faults.py; extra drops + duplication
 ticks/sec and committed/sec plus the faulty run's telemetry ring capture
 (drops/retries/leader_changes actually injected). Evidence file:
 results/fault_overhead_r08.json.
+
+``--multichip`` is a SEPARATE mode: it measures the multi-chip GSPMD
+scaling matrix of the compartmentalized backend
+(tpu/compartmentalized_batched.py sharded via parallel/sharding.py) on
+1/2/4/8 simulated host devices (clean subprocess with
+``--xla_force_host_platform_device_count=8``), prints one JSON line,
+and records per-leg ``n_devices``/``mesh_shape``/``collective_bytes``
+plus an HLO collective census verifying the group-local write path.
+Simulated-domain throughput (committed entries per tick at fixed
+per-device load) is the scaling headline on a CPU host — wall-clock
+columns are honest about the host's physical core count, and the
+real-TPU leg is flagged ``pending_tpu_remeasure``. Capture artifact:
+MULTICHIP_r06.json.
 """
 
 from __future__ import annotations
@@ -388,6 +401,246 @@ def _inner_main() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+_SIGNED_COLLECTIVES = (
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter",
+)
+_DTYPE_BYTES = {"s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+                "pred": 1}
+
+
+def _collective_census(hlo_text: str) -> dict:
+    """Census of the collectives XLA's SPMD partitioner emitted: total
+    payload bytes, split signed/pred (simulation state + stat
+    reductions) vs unsigned (threefry PRNG-sweep assembly artifacts),
+    plus the largest signed collective — the number that must stay at
+    stat-reduction scale for the group-local claim to hold.
+
+    Result shapes are parsed from the segment between '=' and the
+    collective's op name, and EVERY shape there is counted: XLA's
+    all-reduce combiner merges several reductions into one tuple-shaped
+    op, so reading only the first element would let a large state
+    reduction hide behind a combined scalar."""
+    import re
+
+    shape_re = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+    signed_bytes = unsigned_bytes = 0
+    signed_ops = unsigned_ops = 0
+    max_signed_elems = 0
+    for line in hlo_text.splitlines():
+        op_at = [
+            line.index(tok)
+            for op in _SIGNED_COLLECTIVES
+            for tok in (op + "(", op + "-start(")
+            if tok in line
+        ]
+        eq_at = line.find("=")
+        if not op_at or eq_at < 0:
+            continue
+        result_part = line[eq_at: min(op_at)]
+        shapes = shape_re.findall(result_part)
+        if not shapes:
+            continue
+        any_signed = False
+        for dtype, dims in shapes:
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            nbytes = elems * _DTYPE_BYTES.get(dtype, 4)
+            if dtype.startswith("u"):
+                unsigned_bytes += nbytes
+            else:
+                any_signed = True
+                signed_bytes += nbytes
+                max_signed_elems = max(max_signed_elems, elems)
+        if any_signed:
+            signed_ops += 1
+        else:
+            unsigned_ops += 1
+    return {
+        "state_collective_ops": signed_ops,
+        "state_collective_bytes": signed_bytes,
+        "prng_collective_ops": unsigned_ops,
+        "prng_collective_bytes": unsigned_bytes,
+        "max_state_collective_elems": max_signed_elems,
+        # Stat reductions (scalars + LAT_BINS=64 histograms) only.
+        "group_local_ok": max_signed_elems <= 64,
+    }
+
+
+def _multichip_inner() -> None:
+    """The multichip scaling measurement; runs in a subprocess with 8
+    virtual CPU devices. One JSON line on stdout (BENCH_JSON ...)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.parallel import sharding as sh
+    from frankenpaxos_tpu.tpu import compartmentalized_batched as cbk
+
+    devices = jax.devices()
+    assert len(devices) >= 8, (
+        f"need 8 virtual devices, have {len(devices)}"
+    )
+    G_PER_DEV = 3125  # x (2x2 grid) = 12,500 simulated acceptors/device
+
+    def make_cfg(G: int) -> "cbk.BatchedCompartmentalizedConfig":
+        return cbk.BatchedCompartmentalizedConfig(
+            num_groups=G, grid_rows=2, grid_cols=2,
+            num_proxy_leaders=8, num_batchers=2, num_unbatchers=2,
+            num_replicas=3, window=32, batch_size=8,
+            arrivals_per_tick=4, lat_min=1, lat_max=3, retry_timeout=16,
+        )
+
+    def leg_census(cfg, mesh) -> dict:
+        """Collective census of THIS leg's own lowered program — every
+        row carries bytes measured at its own mesh size, not a copy of
+        the 8-device number."""
+        st = sh.shard_state("compartmentalized", cbk.init_state(cfg), mesh)
+        hlo = sh.lower_sharded(
+            "compartmentalized", cfg, mesh, st,
+            jnp.zeros((), jnp.int32), 4, jax.random.PRNGKey(0),
+        ).compile().as_text()
+        return _collective_census(hlo)
+
+    def measure(n_dev: int, G: int, warm: int = 60, ticks: int = 60):
+        cfg = make_cfg(G)
+        mesh = sh.make_mesh(devices[:n_dev])
+        census = leg_census(cfg, mesh)
+        state = sh.shard_state("compartmentalized",
+                               cbk.init_state(cfg), mesh)
+        key = jax.random.PRNGKey(0)
+        state, t = sh.run_ticks_sharded(
+            "compartmentalized", cfg, mesh, state,
+            jnp.zeros((), jnp.int32), warm, key,
+        )
+        jax.block_until_ready(state)  # compile + ramp to steady state
+        c0 = int(state.committed)
+        start = time.perf_counter()
+        state, t = sh.run_ticks_sharded(
+            "compartmentalized", cfg, mesh, state, t, ticks,
+            jax.random.fold_in(key, 1),
+        )
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - start
+        committed = int(state.committed) - c0
+        inv_ok = all(
+            bool(v)
+            for v in cbk.check_invariants(cfg, state, t).values()
+        )
+        return {
+            "n_devices": n_dev,
+            "mesh_shape": [n_dev],
+            "num_groups": G,
+            "num_acceptors": cfg.num_acceptors,
+            "ticks": ticks,
+            "committed_entries": committed,
+            "committed_per_tick": round(committed / ticks, 1),
+            "ticks_per_sec": round(ticks / dt, 2),
+            "committed_per_sec": round(committed / dt, 1),
+            "invariants_ok": inv_ok,
+            # This leg's own census (4-tick program at THIS mesh size).
+            "collective_bytes": census["state_collective_bytes"],
+            "group_local_ok": census["group_local_ok"],
+        }
+
+    # Weak scaling: fixed per-device load (the scale-out axis the
+    # compartmentalization paper adds nodes along) — 12.5k simulated
+    # acceptors per device, 100k at the full 8-device mesh.
+    weak = [measure(d, G_PER_DEV * d) for d in (1, 2, 4, 8)]
+    # Strong scaling: the SAME 100k-acceptor model on 1 vs 8 devices
+    # (fixed total work; on a CPU host this isolates partitioning
+    # overhead rather than speedup).
+    strong = [measure(d, G_PER_DEV * 8, warm=40, ticks=40)
+              for d in (1, 8)]
+
+    # Headline census: the full 8-device, 100k-acceptor program — the
+    # group-local-write-path claim as a compile-time fact.
+    census = leg_census(make_cfg(G_PER_DEV * 8), sh.make_mesh(devices[:8]))
+
+    base = weak[0]
+    top = weak[-1]
+    result = {
+        "metric": (
+            "compartmentalized committed entries/sec scaling, "
+            "1 -> 8 devices"
+        ),
+        "backend": "compartmentalized",
+        "device": str(devices[0]),
+        "n_devices": 8,
+        "mesh_shape": [8],
+        "host_physical_cores": os.cpu_count(),
+        "weak_scaling": weak,
+        "strong_scaling_100k": strong,
+        "collective_census_8dev_100k": census,
+        "scaling": {
+            "basis": (
+                "committed entries per tick at fixed per-device load "
+                "(12.5k simulated acceptors per device; 100k at 8 "
+                "devices) — the simulated-domain throughput a "
+                "group-local program sustains per added device"
+            ),
+            "x_at_8_devices": round(
+                top["committed_per_tick"] / base["committed_per_tick"], 2
+            ),
+            "wallclock_x_at_8_devices": round(
+                top["committed_per_sec"] / base["committed_per_sec"], 2
+            ),
+            "wallclock_note": (
+                "virtual 8-device mesh shares this host's physical "
+                "cores, so wall-clock scaling is bounded by the core "
+                "count; real-chip wall-clock scaling is the reserved "
+                "TPU leg (group-locality verified by the collective "
+                "census above)"
+            ),
+            "group_local_ok": census["group_local_ok"],
+        },
+        "invariants_ok": all(
+            r["invariants_ok"] for r in weak + strong
+        ),
+        # Real-hardware leg reserved: this capture is a virtual-mesh
+        # (CPU) measurement.
+        "measured_live": True,
+        "pending_tpu_remeasure": True,
+    }
+    print("BENCH_JSON " + json.dumps(result))
+
+
+def _multichip_main() -> None:
+    """Orchestrate the multichip measurement in a clean 8-virtual-device
+    CPU subprocess; print exactly one JSON line, exit 0."""
+    env = _cpu_env()
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    argv = [sys.executable, os.path.abspath(__file__), "--inner-multichip"]
+    try:
+        proc = subprocess.run(
+            argv, env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=900.0,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "compartmentalized multichip scaling",
+            "ok": False, "notes": "timeout after 900s",
+        }))
+        sys.exit(0)
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_JSON "):
+            print(line[len("BENCH_JSON "):])
+            sys.exit(0)
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    print(json.dumps({
+        "metric": "compartmentalized multichip scaling",
+        "ok": False,
+        "notes": f"rc={proc.returncode}: " + " | ".join(tail),
+    }))
+    sys.exit(0)
+
+
 def _cpu_env() -> dict:
     env = {
         k: v
@@ -548,6 +801,10 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
         return cpu_live
     result = dict(last_good)
     result["measured_live"] = False
+    # Explicit machine-readable staleness flag (not just the free-text
+    # note): the headline is a replayed TPU capture that predates the
+    # current code and must be re-measured on hardware.
+    result["pending_tpu_remeasure"] = True
     result["staleness_hours"] = _staleness_hours(
         result.get("captured_at", "")
     )
@@ -647,7 +904,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--inner" in sys.argv:
+    if "--inner-multichip" in sys.argv:
+        _multichip_inner()
+    elif "--inner" in sys.argv:
         _inner_main()
+    elif "--multichip" in sys.argv:
+        _multichip_main()
     else:
         main()

@@ -19,8 +19,11 @@ from typing import Callable, Dict, List, Optional, Sequence
 from frankenpaxos_tpu.analysis import astutil
 
 # Bumped whenever a rule is added/removed or a rule's semantics change;
-# recorded by bench.py for artifact provenance.
-ANALYSIS_VERSION = "1.1"
+# recorded by bench.py for artifact provenance. 1.2: trace-donation-alias
+# also compiles the sharded run_ticks wrappers (parallel/sharding.py
+# registry) and requires alias coverage under a mesh; the backend
+# inventory floor rose to 14 (compartmentalized).
+ANALYSIS_VERSION = "1.2"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"
@@ -66,7 +69,7 @@ class Context:
     # match rules_trace.BACKENDS.
     backends: Optional[Sequence[str]] = None
     # Floor the backend-inventory rule enforces; fixture trees override.
-    min_backends: int = 13
+    min_backends: int = 14
     # Fixture trees are not importable packages: rules that must import
     # repo modules (kernel registry introspection) skip when False.
     importable: bool = True

@@ -41,6 +41,7 @@ from frankenpaxos_tpu.analysis.core import Context, Finding, rule
 # is the entire integration cost.
 BACKENDS = (
     "caspaxos",
+    "compartmentalized",
     "craq",
     "epaxos",
     "fasterpaxos",
@@ -254,7 +255,8 @@ def _alias_param_indices(hlo_text: str) -> set:
     "trace-donation-alias",
     "trace",
     "the compiled run_ticks HLO input_output_alias table aliases every "
-    "State buffer (donation actually took effect)",
+    "State buffer (donation actually took effect) — both unsharded and, "
+    "for backends in the sharding registry, under a device mesh",
 )
 def check_donation_alias(ctx: Context) -> List[Finding]:
     _jax_cache_setup()
@@ -262,19 +264,10 @@ def check_donation_alias(ctx: Context) -> List[Finding]:
     import jax.numpy as jnp
 
     out: List[Finding] = []
-    for backend in _selected(ctx):
-        mod = _module(backend)
-        cfg = mod.analysis_config()
-        state = mod.init_state(cfg)
-        n_leaves = len(jax.tree_util.tree_leaves(state))
-        lowered = mod.run_ticks.lower(
-            cfg,
-            state,
-            jnp.zeros((), jnp.int32),
-            _TICKS,
-            jax.random.PRNGKey(0),
-        )
-        hlo = lowered.compile().as_text()
+
+    def check_alias(
+        backend: str, hlo: str, n_leaves: int, where: str, key: str
+    ):
         aliased = _alias_param_indices(hlo)
         # jit flattens (state, t0, key) in order, so the donated state
         # leaves are exactly parameters [0, n_leaves).
@@ -287,14 +280,74 @@ def check_donation_alias(ctx: Context) -> List[Finding]:
                     line=0,
                     message=(
                         f"{len(missing)} of {n_leaves} donated State "
-                        f"buffers are NOT aliased in the compiled HLO "
-                        f"(parameter indices {missing[:8]}...) — "
-                        "donation silently fell back to "
-                        "double-buffering"
+                        f"buffers are NOT aliased in the compiled "
+                        f"{where} HLO (parameter indices "
+                        f"{missing[:8]}...) — donation silently fell "
+                        "back to double-buffering"
                     ),
-                    key=backend,
+                    key=key,
                 )
             )
+
+    selected = _selected(ctx)
+    for backend in selected:
+        mod = _module(backend)
+        cfg = mod.analysis_config()
+        state = mod.init_state(cfg)
+        n_leaves = len(jax.tree_util.tree_leaves(state))
+        lowered = mod.run_ticks.lower(
+            cfg,
+            state,
+            jnp.zeros((), jnp.int32),
+            _TICKS,
+            jax.random.PRNGKey(0),
+        )
+        check_alias(backend, lowered.compile().as_text(), n_leaves,
+                    "run_ticks", backend)
+
+    # The sharded wrappers (parallel/sharding.py registry): donation
+    # must survive GSPMD partitioning too — a sharded run that
+    # double-buffers pays 2x HBM on EVERY device. Compiled under the
+    # widest mesh the host's devices allow for the analysis shape.
+    from frankenpaxos_tpu.parallel import sharding as _sharding
+
+    for backend, spec in sorted(_sharding.SHARDINGS.items()):
+        if backend not in selected:
+            continue
+        mod = _module(backend)
+        cfg = mod.analysis_config()
+        # Pin the kernel policy to the reference twins: that is the
+        # only policy validate_policy admits at mesh > 1 (and therefore
+        # the program a sharded run would actually compile) — with the
+        # default "auto" policy this rule would otherwise ValueError on
+        # any multi-device TPU host, where auto resolves to Pallas.
+        if hasattr(cfg, "kernels"):
+            import dataclasses as _dc
+
+            from frankenpaxos_tpu.ops.registry import KernelPolicy
+
+            cfg = _dc.replace(cfg, kernels=KernelPolicy.reference())
+        state = mod.init_state(cfg)
+        n_leaves = len(jax.tree_util.tree_leaves(state))
+        axis_len = spec.axis_len(state)
+        # A 2-device mesh is the cheapest configuration that makes
+        # aliasing non-trivial under GSPMD (wider meshes only grow the
+        # compile bill; tests/test_multichip.py covers the full mesh).
+        n_dev = 1
+        for d in range(min(len(jax.devices()), axis_len, 2), 0, -1):
+            if axis_len % d == 0:
+                n_dev = d
+                break
+        mesh = _sharding.make_mesh(jax.devices()[:n_dev])
+        sharded = _sharding.shard_state(backend, state, mesh)
+        lowered = _sharding.lower_sharded(
+            backend, cfg, mesh, sharded, jnp.zeros((), jnp.int32),
+            _TICKS, jax.random.PRNGKey(0),
+        )
+        check_alias(
+            backend, lowered.compile().as_text(), n_leaves,
+            f"sharded[{n_dev}dev]", f"{backend}:sharded",
+        )
     return out
 
 

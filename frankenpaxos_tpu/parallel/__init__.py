@@ -7,129 +7,52 @@ contiguous block of acceptor groups with NO cross-device traffic. The only
 global quantity is the executed-watermark/commit statistics, which XLA
 reduces over ICI when read. This is the map of SURVEY.md §2.7's
 "scale-out by role decoupling" onto a TPU mesh.
+
+The machinery lives in :mod:`frankenpaxos_tpu.parallel.sharding` — a
+GENERIC per-backend registry of mesh specs + sharded ``run_ticks``
+wrappers (donation preserved, kernel-policy validation under a mesh).
+This module keeps the original flagship/EPaxos-specific names as thin
+wrappers over that registry, so existing callers (``__graft_entry__``,
+``scripts/multichip_scaling.py``, the HLO tests) are unchanged; new
+code — including the compartmentalized backend — should call the
+registry API directly with a backend name.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
+from frankenpaxos_tpu.parallel.sharding import (  # noqa: F401
+    GROUP_AXIS,
+    SHARDINGS,
+    ShardingSpec,
+    lower_sharded,
+    make_mesh,
+    register_sharding,
+    validate_policy,
+)
+from frankenpaxos_tpu.parallel import sharding as _sharding
 from frankenpaxos_tpu.tpu.multipaxos_batched import (
     BatchedMultiPaxosConfig,
     BatchedMultiPaxosState,
-    run_ticks,
 )
 
-GROUP_AXIS = "groups"
 
-
-def make_mesh(devices=None, axis_name: str = GROUP_AXIS) -> Mesh:
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices).reshape(-1), (axis_name,))
-
-
-def state_shardings(mesh: Mesh) -> BatchedMultiPaxosState:
-    """A pytree of NamedShardings: every [G, ...] array shards along G;
-    scalars and the latency histogram replicate."""
-
-    def spec_for(leaf_name: str):
-        # Scalars, stats, and the shared wave clock ([NW] wave_issue —
-        # one probe wave per tick is global by construction). The
-        # per-group batcher rings (rb_*: [G, NW]) and the wave's
-        # per-acceptor request/response arrays ([A, G, NW]) SHARD with
-        # the group axis: read state lives with the groups it serves.
-        scalar_or_global = {
-            "committed", "retired", "lat_sum", "lat_hist",
-            "max_chosen_global", "client_watermark", "wave_issue",
-            "reads_done", "reads_shed", "read_lat_sum", "read_lat_hist",
-            "read_lin_violations", "elections", "reconfigs", "configs_gcd",
-            "sm_applied", "dups_filtered", "dups_seen",
-            # The telemetry ring holds cluster-wide per-tick reductions
-            # ([K, NUM_COLS] + histograms) — replicated; device_put
-            # broadcasts the spec over the nested pytree's leaves.
-            "telemetry",
-        }
-        # Acceptor-major arrays ([A, G, W] / [A, G] / [A, G, RW]) carry
-        # the group axis second; everything else ([G, W] / [G]) first.
-        acceptor_major = {
-            "acc_round", "p2a_arrival", "p2b_arrival", "vote_round",
-            "vote_value", "acc_max_slot", "req_arrival", "resp_slot",
-            "resp_arrival", "leader_alive",  # [C, G] candidates
-            # [M, G] matchmakers / [A, G] old-config phase-1 exchanges.
-            "mm_epoch", "matcha_arrival", "matchb_arrival",
-            "rc_p1a_arrival", "rc_p1b_arrival",
-        }
-        if leaf_name in scalar_or_global:
-            return NamedSharding(mesh, P())
-        if leaf_name in acceptor_major:
-            return NamedSharding(mesh, P(None, GROUP_AXIS))
-        return NamedSharding(mesh, P(GROUP_AXIS))
-
-    import dataclasses as _dc
-
-    from frankenpaxos_tpu.tpu import multipaxos_batched as mb
-
-    fields = [f.name for f in _dc.fields(mb.BatchedMultiPaxosState)]
-    return {name: spec_for(name) for name in fields}
-
-
-def _shard_dataclass(state, specs, mesh: Mesh, axis_len: int, what: str):
-    """Place a struct-of-arrays state dataclass on the mesh per-field;
-    the sharded axis length must divide evenly over the devices."""
-    import dataclasses as _dc
-
-    n_devices = mesh.devices.size
-    if axis_len % n_devices != 0:
-        raise ValueError(
-            f"{what} ({axis_len}) must be divisible by the mesh size "
-            f"({n_devices}) to shard that axis; pick a multiple of the "
-            f"device count."
-        )
-    out = {}
-    for f in _dc.fields(state):
-        out[f.name] = jax.device_put(getattr(state, f.name), specs[f.name])
-    return type(state)(**out)
+def state_shardings(mesh: Mesh) -> dict:
+    """A pytree of NamedShardings for the flagship: every [G, ...]
+    array shards along G; scalars and the latency histogram replicate
+    (legacy wrapper: ``sharding.state_shardings("multipaxos", mesh)``)."""
+    return _sharding.state_shardings("multipaxos", mesh)
 
 
 def shard_state(
     state: BatchedMultiPaxosState, mesh: Mesh
 ) -> BatchedMultiPaxosState:
-    """Place the state on the mesh with the group axis sharded."""
-    return _shard_dataclass(
-        state, state_shardings(mesh), mesh,
-        state.leader_round.shape[-1], "num_groups",
-    )
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 4), donate_argnums=(2,))
-def _run_ticks_sharded(
-    cfg: BatchedMultiPaxosConfig,
-    mesh: Mesh,
-    state: BatchedMultiPaxosState,
-    t0: jnp.ndarray,
-    num_ticks: int,
-    key: jnp.ndarray,
-):
-    # ``state`` is donated (single-buffered per shard), mirroring
-    # run_ticks: callers rebind the returned state and must not reuse
-    # the argument.
-    # The write path is elementwise over groups; with the G axis sharded,
-    # XLA partitions the whole scan and the only cross-device traffic is
-    # scalar/ring-stat reductions (psum over ICI): commit stats, and —
-    # when reads are enabled — the read path's global reductions (the
-    # executed-watermark min over G, the bind max over (A, G), and the
-    # chosen-floor max), all of which land on the replicated [RW]/scalar
-    # read arrays. We rely on GSPMD propagation from the input shardings
-    # rather than hand-writing shard_map: every contraction either stays
-    # within a group or reduces to a replicated scalar/ring, so
-    # propagation is exact (test_reads_sharded_matches_unsharded pins
-    # bit-identity).
-    return run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+    """Place the flagship state on the mesh with the group axis sharded."""
+    return _sharding.shard_state("multipaxos", state, mesh)
 
 
 def run_ticks_sharded(
@@ -140,55 +63,31 @@ def run_ticks_sharded(
     num_ticks: int,
     key,
 ) -> Tuple[BatchedMultiPaxosState, jnp.ndarray]:
-    return _run_ticks_sharded(cfg, mesh, state, t0, num_ticks, key)
+    """Sharded flagship run. ``state`` is donated (single-buffered per
+    shard): callers rebind the returned state and must not reuse the
+    argument. The write path partitions group-locally; only the
+    scalar/ring stat and read-wave reductions cross devices (pinned by
+    tests/test_hlo_sharding.py)."""
+    return _sharding.run_ticks_sharded(
+        "multipaxos", cfg, mesh, state, t0, num_ticks, key
+    )
 
 
-def epaxos_shardings(mesh: Mesh):
-    """NamedShardings for the batched EPaxos state: every [C, ...] array
-    shards along the column axis (the docstring's "shardable over a
-    device mesh along C"); the frontier history ([H, C]) and per-replica
-    GC watermarks ([R, C]) shard on their SECOND axis; scalars and the
-    latency histogram replicate. The closure's only cross-device traffic
-    is the [H]-sized tick scores and scalar stats (all-reduces over the
-    column axis)."""
-    import dataclasses as _dc
-
-    from frankenpaxos_tpu.tpu import epaxos_batched as eb
-
-    second_axis = {"fpre", "fpost", "rep_exec"}
-    replicated = {
-        "committed_total", "fast_path_total", "executed_total",
-        "retired_total", "coexecuted", "lat_sum", "lat_hist",
-        "snapshots_served", "rep_crashes", "rep_down", "telemetry",
-    }
-    specs = {}
-    for f in _dc.fields(eb.BatchedEPaxosState):
-        if f.name in replicated:
-            specs[f.name] = NamedSharding(mesh, P())
-        elif f.name in second_axis:
-            specs[f.name] = NamedSharding(mesh, P(None, GROUP_AXIS))
-        else:
-            specs[f.name] = NamedSharding(mesh, P(GROUP_AXIS))
-    return specs
+def epaxos_shardings(mesh: Mesh) -> dict:
+    """NamedShardings for the batched EPaxos state (legacy wrapper:
+    ``sharding.state_shardings("epaxos", mesh)``)."""
+    return _sharding.state_shardings("epaxos", mesh)
 
 
 def shard_epaxos_state(state, mesh: Mesh):
     """Place batched EPaxos state on the mesh, columns sharded."""
-    return _shard_dataclass(
-        state, epaxos_shardings(mesh), mesh,
-        state.head.shape[0], "num_columns",
-    )
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 4), donate_argnums=(2,))
-def _run_epaxos_sharded(cfg, mesh, state, t0, num_ticks, key):
-    # ``state`` is donated; rebind the result, never reuse the argument.
-    from frankenpaxos_tpu.tpu import epaxos_batched as eb
-
-    return eb.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+    return _sharding.shard_state("epaxos", state, mesh)
 
 
 def run_epaxos_ticks_sharded(cfg, mesh, state, t0, num_ticks: int, key):
     """Sharded batched-EPaxos run (GSPMD propagation from the input
-    shardings, like run_ticks_sharded for the flagship)."""
-    return _run_epaxos_sharded(cfg, mesh, state, t0, num_ticks, key)
+    shardings, like run_ticks_sharded for the flagship). ``state`` is
+    donated; rebind the result, never reuse the argument."""
+    return _sharding.run_ticks_sharded(
+        "epaxos", cfg, mesh, state, t0, num_ticks, key
+    )

@@ -1,0 +1,299 @@
+"""Generic multi-chip GSPMD layer: a per-backend registry of mesh
+sharding specs + sharded ``run_ticks`` wrappers.
+
+Every batched backend whose simulation carries a data-parallel
+group/column axis registers a :class:`ShardingSpec` here: which axis of
+each State field is the shard axis (``axis_pos``), which fields
+replicate (``replicated``), and how long the sharded axis is. The layer
+then provides, uniformly for every registered backend:
+
+  * :func:`state_shardings` — the ``NamedSharding`` pytree for a mesh,
+  * :func:`shard_state` — place a state on the mesh (with an axis
+    divisibility check),
+  * :func:`run_ticks_sharded` — a jitted multi-tick runner with
+    ``donate_argnums`` preserved per shard (single-buffered state on
+    every device), and
+  * :func:`lower_sharded` — the lowering hook the static-analysis
+    ``trace-donation-alias`` rule compiles to verify the HLO
+    ``input_output_alias`` table under a mesh.
+
+Partitioning model: the wrappers run the backend's OWN ``run_ticks``
+body under input ``NamedSharding``s and let XLA's SPMD partitioner
+propagate — the GSPMD equivalent of a hand-written ``shard_map`` over
+the group axis, with the collectives inserted exactly where the tick's
+reductions demand them. This is deliberate: the tick bodies compute
+global quantities (commit counters, watermark minima, histogram
+accumulations) inline, and under GSPMD each becomes one small psum over
+ICI while every ``[..., G/n, ...]`` elementwise sweep stays group-local
+— hand-writing shard_map would mean re-deriving every reduction site
+per backend. The group-locality claim is pinned as a compile-time fact
+by ``tests/test_multichip.py`` / ``tests/test_hlo_sharding.py`` (no
+all-gather/all-to-all of signed state, stat reductions bounded by
+``LAT_BINS`` elements) and re-checked by ``bench.py --multichip``'s
+collective census. All simulation state is integer, and integer psums
+are associative exactly, so sharded runs are BIT-IDENTICAL to
+unsharded runs at any mesh size (also pinned by the tests).
+
+Kernel policy x mesh: Pallas planes have no SPMD partitioning rule, so
+a config whose :class:`KernelPolicy` resolves any plane off the
+reference path under a mesh of >1 devices would silently mis-lower (the
+kernel runs replicated or partitions wrong). :func:`validate_policy`
+rejects that combination with a ``ValueError`` instead; at mesh size 1
+any policy is allowed (sharded-vs-unsharded bit-identity with the
+kernels engaged is pinned by ``tests/test_multichip.py``). On CPU the
+default ``auto`` policy already resolves every plane to its reference
+twin, so sharded CPU runs need no config change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import importlib
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+GROUP_AXIS = "groups"
+
+
+def make_mesh(devices=None, axis_name: str = GROUP_AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices).reshape(-1), (axis_name,))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingSpec:
+    """One backend's entry in the sharding registry.
+
+    ``axis_pos`` maps State field name -> index of the sharded
+    (group/column) axis in that field's shape; fields in ``replicated``
+    replicate on every device; any field in neither defaults to axis 0.
+    ``axis_len`` reads the sharded-axis extent off a live state (for
+    the divisibility check); ``planes_backend`` names the kernel
+    registry backend whose planes :func:`validate_policy` must check
+    (None = no registered planes can apply).
+    """
+
+    backend: str
+    module: str  # dotted module path of the tpu/*_batched.py backend
+    state_class: str  # the module's State dataclass name
+    replicated: frozenset
+    axis_pos: Mapping[str, int]
+    axis_len: Callable[[object], int]
+    axis_desc: str  # e.g. "num_groups" — for error messages
+    planes_backend: Optional[str] = None
+
+    def mod(self):
+        return importlib.import_module(self.module)
+
+    def spec_for(self, field: str) -> P:
+        if field in self.replicated:
+            return P()
+        pos = self.axis_pos.get(field, 0)
+        return P(*([None] * pos + [GROUP_AXIS]))
+
+
+SHARDINGS: Dict[str, ShardingSpec] = {}
+
+
+def register_sharding(spec: ShardingSpec) -> ShardingSpec:
+    assert spec.backend not in SHARDINGS, f"duplicate {spec.backend}"
+    SHARDINGS[spec.backend] = spec
+    return spec
+
+
+def state_shardings(backend: str, mesh: Mesh) -> Dict[str, NamedSharding]:
+    """field name -> NamedSharding for the backend's State dataclass."""
+    spec = SHARDINGS[backend]
+    state_cls = getattr(spec.mod(), spec.state_class)
+    assert dataclasses.is_dataclass(state_cls), spec.state_class
+    return {
+        f.name: NamedSharding(mesh, spec.spec_for(f.name))
+        for f in dataclasses.fields(state_cls)
+    }
+
+
+def shard_state(backend: str, state, mesh: Mesh):
+    """Place a state dataclass on the mesh per the backend's spec; the
+    sharded axis must divide evenly over the devices."""
+    spec = SHARDINGS[backend]
+    n_devices = mesh.devices.size
+    axis_len = spec.axis_len(state)
+    if axis_len % n_devices != 0:
+        raise ValueError(
+            f"{spec.axis_desc} ({axis_len}) must be divisible by the "
+            f"mesh size ({n_devices}) to shard that axis; pick a "
+            "multiple of the device count."
+        )
+    shardings = state_shardings(backend, mesh)
+    out = {}
+    for f in dataclasses.fields(state):
+        out[f.name] = jax.device_put(getattr(state, f.name), shardings[f.name])
+    return type(state)(**out)
+
+
+def validate_policy(backend: str, cfg, mesh: Mesh) -> None:
+    """Reject kernel policies that would silently mis-lower under a
+    real mesh: with >1 devices, every registered plane of the backend
+    must resolve to its reference twin (Pallas has no SPMD partitioning
+    rule). Mesh size 1 allows any policy."""
+    if mesh.devices.size <= 1:
+        return
+    spec = SHARDINGS[backend]
+    if spec.planes_backend is None:
+        return
+    from frankenpaxos_tpu.ops import registry
+
+    offending = {
+        name: registry.resolve_mode(name, cfg)
+        for name, plane in registry.PLANES.items()
+        if plane.backend == spec.planes_backend
+        and registry.resolve_mode(name, cfg) != "reference"
+    }
+    if offending:
+        raise ValueError(
+            f"KernelPolicy resolves plane(s) {offending} off the "
+            f"reference path under a {mesh.devices.size}-device mesh — "
+            "Pallas kernels have no SPMD partitioning rule, so the "
+            "sharded program would silently mis-lower. Use "
+            "kernels=KernelPolicy.reference() (or mode='auto' on a "
+            "non-TPU backend) for sharded runs."
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _runner(backend: str):
+    """The jitted sharded multi-tick runner for one backend. The
+    backend's own ``run_ticks`` body runs under the input shardings
+    (GSPMD propagation, module docstring); ``state`` is DONATED —
+    single-buffered per shard — so callers rebind the returned state
+    and must not reuse the argument."""
+    mod = SHARDINGS[backend].mod()
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+    def run(cfg, state, t0, num_ticks: int, key):
+        return mod.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+
+    return run
+
+
+def run_ticks_sharded(
+    backend: str, cfg, mesh: Mesh, state, t0, num_ticks: int, key
+) -> Tuple[object, jnp.ndarray]:
+    """Run ``num_ticks`` of the backend's simulation with the state
+    sharded per the registry spec (see :func:`shard_state`). The mesh
+    argument is used for policy validation; the partitioning itself
+    rides the state's shardings."""
+    validate_policy(backend, cfg, mesh)
+    return _runner(backend)(cfg, state, t0, num_ticks, key)
+
+
+def lower_sharded(
+    backend: str, cfg, mesh: Mesh, state, t0, num_ticks: int, key
+):
+    """Lower (don't run) the sharded runner — the static-analysis
+    ``trace-donation-alias`` rule compiles this to check that every
+    donated State leaf is aliased in the HLO under a mesh."""
+    validate_policy(backend, cfg, mesh)
+    return _runner(backend).lower(cfg, state, t0, num_ticks, key)
+
+
+# ---------------------------------------------------------------------------
+# Registry entries
+# ---------------------------------------------------------------------------
+
+# Flagship batched MultiPaxos: every [G, ...] array shards along G;
+# scalars, stats, the shared read wave, and the telemetry ring
+# replicate. Acceptor-major arrays ([A, G, W] / [A, G] / [M, G] /
+# [A, G, RW]) carry the group axis SECOND.
+register_sharding(
+    ShardingSpec(
+        backend="multipaxos",
+        module="frankenpaxos_tpu.tpu.multipaxos_batched",
+        state_class="BatchedMultiPaxosState",
+        replicated=frozenset({
+            "committed", "retired", "lat_sum", "lat_hist",
+            "max_chosen_global", "client_watermark", "wave_issue",
+            "reads_done", "reads_shed", "read_lat_sum", "read_lat_hist",
+            "read_lin_violations", "elections", "reconfigs", "configs_gcd",
+            "sm_applied", "dups_filtered", "dups_seen",
+            # The telemetry ring holds cluster-wide per-tick reductions
+            # ([K, NUM_COLS] + histograms) — replicated; device_put
+            # broadcasts the spec over the nested pytree's leaves.
+            "telemetry",
+        }),
+        axis_pos={
+            name: 1
+            for name in (
+                "acc_round", "p2a_arrival", "p2b_arrival", "vote_round",
+                "vote_value", "acc_max_slot", "req_arrival", "resp_slot",
+                "resp_arrival", "leader_alive",  # [C, G] candidates
+                # [M, G] matchmakers / [A, G] old-config phase-1.
+                "mm_epoch", "matcha_arrival", "matchb_arrival",
+                "rc_p1a_arrival", "rc_p1b_arrival",
+            )
+        },
+        axis_len=lambda st: st.leader_round.shape[-1],
+        axis_desc="num_groups",
+        planes_backend="multipaxos",
+    )
+)
+
+# Batched EPaxos: every [C, ...] array shards along the column axis;
+# the frontier history ([H, C]) and per-replica GC watermarks ([R, C])
+# shard on their SECOND axis; scalars and histograms replicate. The
+# closure's only cross-device traffic is the [H]-sized tick scores and
+# scalar stats.
+register_sharding(
+    ShardingSpec(
+        backend="epaxos",
+        module="frankenpaxos_tpu.tpu.epaxos_batched",
+        state_class="BatchedEPaxosState",
+        replicated=frozenset({
+            "committed_total", "fast_path_total", "executed_total",
+            "retired_total", "coexecuted", "lat_sum", "lat_hist",
+            "snapshots_served", "rep_crashes", "rep_down", "telemetry",
+        }),
+        axis_pos={name: 1 for name in ("fpre", "fpost", "rep_exec")},
+        axis_len=lambda st: st.head.shape[0],
+        axis_desc="num_columns",
+        planes_backend=None,
+    )
+)
+
+# Compartmentalized MultiPaxos: role-major planes with (G, W) minor.
+# Grid planes ([R, C, G, W]) carry the group axis THIRD, replica planes
+# ([NR, G, W] / [NR, G] / [NR, G, RW]) SECOND, everything else
+# ([G, ...]) first; scalar stats, histograms, and the telemetry ring
+# replicate. The whole write path (batchers -> leader -> proxies ->
+# grid -> replicas -> unbatchers) is group-local; only the commit/
+# watermark/histogram reductions cross devices.
+register_sharding(
+    ShardingSpec(
+        backend="compartmentalized",
+        module="frankenpaxos_tpu.tpu.compartmentalized_batched",
+        state_class="BatchedCompartmentalizedState",
+        replicated=frozenset({
+            "bat_shed", "committed", "batches_committed", "retired",
+            "writes_done", "lat_sum", "lat_hist", "reads_done",
+            "reads_shed", "read_lat_sum", "read_lat_hist", "telemetry",
+        }),
+        axis_pos={
+            **{name: 2 for name in ("p2a_arrival", "p2b_arrival")},
+            **{
+                name: 1
+                for name in (
+                    "rep_arrival", "rep_exec", "rd_issue", "rd_bound",
+                    "rd_count", "rd_probe", "rd_row",
+                )
+            },
+        },
+        axis_len=lambda st: st.head.shape[0],
+        axis_desc="num_groups",
+        planes_backend="compartmentalized",
+    )
+)

@@ -10,6 +10,7 @@ reductions, and whole-cluster simulation ticks run under ``jax.jit`` +
 
 from frankenpaxos_tpu.tpu import (
     caspaxos_batched,
+    compartmentalized_batched,
     craq_batched,
     epaxos_batched,
     fasterpaxos_batched,
@@ -22,6 +23,10 @@ from frankenpaxos_tpu.tpu import (
     scalog_batched,
     unreplicated_batched,
     vanillamencius_batched,
+)
+from frankenpaxos_tpu.tpu.compartmentalized_batched import (
+    BatchedCompartmentalizedConfig,
+    BatchedCompartmentalizedState,
 )
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.caspaxos_batched import (
@@ -59,7 +64,10 @@ from frankenpaxos_tpu.tpu.transport import TpuSimTransport
 __all__ = [
     "BatchedCasPaxosConfig",
     "BatchedCasPaxosState",
+    "BatchedCompartmentalizedConfig",
+    "BatchedCompartmentalizedState",
     "caspaxos_batched",
+    "compartmentalized_batched",
     "BatchedCraqConfig",
     "BatchedCraqState",
     "craq_batched",

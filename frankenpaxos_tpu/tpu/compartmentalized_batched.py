@@ -1,0 +1,805 @@
+"""Batched Compartmentalized MultiPaxos: every role its own array plane.
+
+The Compartmentalization technical report (PAPERS.md, arxiv 2012.15762)
+decouples every MultiPaxos bottleneck into an independently-scalable
+role; HT-Paxos (arxiv 1407.1237) motivates the batching planes as the
+high-throughput staging shape. This backend is that decomposition
+rebuilt TPU-first — each role is a separate struct-of-arrays plane of
+one compiled tick, and the role-count knobs scale the planes the way
+the paper adds nodes:
+
+  * **Batchers** (``[G, B]``): client commands accumulate at ``B``
+    batchers per group (``arrivals_per_tick`` each); a full batch of
+    ``batch_size`` commands ships to the leader as ONE message
+    (multipaxos/Batcher.scala). The leader processes batches, not
+    commands — the HT-Paxos/batching amplification: committed ENTRIES
+    per tick = batches chosen x batch_size.
+  * **Leader + proxy leaders** (``[G, P]``): the leader sequences a
+    batch into a ring slot and hands the Phase2a broadcast to proxy
+    leader ``slot % P`` (ProxyLeader.scala:190); the proxy fans out to
+    the write quorum, collects Phase2b votes, and broadcasts the commit
+    — the leader never touches the wide planes. Per-proxy message
+    counters (``proxy_msgs``) expose the load the role absorbs; proxies
+    are the crash axis of the fault plan (a dead proxy stalls exactly
+    its ``slot % P`` residue class until revival).
+  * **Acceptor grid** (``[R, C, G, W]``): each group's acceptors form an
+    R x C grid (quorums/Grid.scala). A WRITE quorum is a random column
+    transversal — one acceptor per row — and a slot is chosen when
+    every row has a vote in; a READ quorum is one full row (any row
+    intersects any transversal). Retries re-send to the full grid.
+  * **Replicas** (``[NR, G, W]`` commits, ``[NR, G]`` watermarks):
+    chosen batches broadcast to NR replicas; each replica advances its
+    OWN executed watermark over the contiguous arrived prefix
+    (Replica.executeLog). Replica 0 answers the client.
+  * **Unbatchers / proxy replicas** (``[G, W]`` reply clocks +
+    ``[G, U]`` counters): the executing replica hands the reply batch
+    to unbatcher ``slot % U``, which fans the ``batch_size`` replies
+    out to clients (ProxyReplica.scala). Write latency is measured
+    from LEADER SEQUENCING (``propose_tick``) to the client reply —
+    the consensus + execution + unbatch span; the batcher-side front
+    half (accumulation, batch flight, leader-inbox wait) is kept out
+    of the histogram because the pending queue carries counts, not
+    per-batch identities.
+  * **Read replicas** (``[NR, G, RW]``): each replica hosts a read
+    batcher; a batch of ``read_rate`` reads probes a read-quorum row
+    for the commit bound, then serves once the replica's own watermark
+    passes it — reads scale with NR * G while never touching the write
+    quorums (the paper's "reads scale with replicas" axis).
+
+Array layout is role-major with ``(G, W)`` minor (the repo's
+acceptor-major tiling rule): grid planes are ``[R, C, G, W]``, replica
+planes ``[NR, G, W]`` — R/C/NR are tiny static leading axes and the
+group axis shards over a device mesh (``frankenpaxos_tpu/parallel``)
+with the whole write path group-local; only scalar stats and histogram
+reductions cross devices.
+
+Message clocks are wrap-safe int16 offsets (tpu/common.py DTYPE_CLOCK),
+aged once per tick; ``== 0`` fires an event exactly once, ``<= 0``
+tests "already arrived". Fault semantics: UDP (drop + retry) on the
+Phase2a/Phase2b planes with the partition cut over the flattened R*C
+grid cells, TCP (retransmit penalty) on the batcher/commit/reply
+pipelines, crash/revive on the proxy-leader plane, and read probes
+buffer across a cut row until the heal tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import (
+    DTYPE_CLOCK,
+    DTYPE_STATUS,
+    INF,
+    INF16,
+    LAT_BINS,
+    age_clock,
+    bit_latency,
+)
+from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
+
+# Ring slot status codes (a slot holds one BATCH of batch_size commands).
+EMPTY = 0
+PROPOSED = 1  # Phase2a out via the slot's proxy leader
+CHOSEN = 2  # write quorum formed; commit broadcast in flight
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedCompartmentalizedConfig:
+    """Static (compile-time) parameters. Every role count is its own
+    knob — the compartmentalization scaling axes."""
+
+    num_groups: int = 4  # G: acceptor groups (the shard axis)
+    grid_rows: int = 2  # R: write quorum = one acceptor per row
+    grid_cols: int = 2  # C: read quorum = one full row
+    num_proxy_leaders: int = 4  # P: slot s rides proxy s % P
+    num_batchers: int = 2  # B batchers per group
+    num_unbatchers: int = 2  # U unbatchers (proxy replicas) per group
+    num_replicas: int = 3  # NR replicas (execution + read serving)
+    window: int = 16  # W: in-flight batch slots per group
+    batch_size: int = 4  # commands per batch (the HT-Paxos knob)
+    arrivals_per_tick: int = 1  # client commands per batcher per tick
+    lat_min: int = 1  # per-hop message latency (ticks, uniform)
+    lat_max: int = 3
+    retry_timeout: int = 8  # re-send Phase2a to the FULL grid after this
+    # Read plane: each replica's read batcher forms one batch of
+    # read_rate reads per tick (0 = reads off); RW ring slots pipeline
+    # the probe round trips.
+    read_rate: int = 0
+    read_window: int = 0  # RW (0 = reads off)
+    # Kernel-layer dispatch policy (ops/registry.py). No fused plane is
+    # registered for this backend yet — the knob is carried (and
+    # validated) so the sharding layer's policy checks and a future
+    # grid-vote kernel compose without a config change.
+    kernels: KernelPolicy = KernelPolicy()
+    # Unified in-graph fault injection (tpu/faults.py): UDP drop/dup/
+    # jitter + an R*C acceptor-cell partition on the Phase2a/Phase2b
+    # planes (the leader's retry timers restore liveness after heal),
+    # TCP retransmit penalties on the batcher/commit/reply pipelines,
+    # crash/revive on the proxy-leader plane, and read probes defer
+    # across a cut row. FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
+
+    @property
+    def acceptors_per_group(self) -> int:
+        return self.grid_rows * self.grid_cols
+
+    @property
+    def num_acceptors(self) -> int:
+        return self.num_groups * self.acceptors_per_group
+
+    def __post_init__(self):
+        assert self.num_groups >= 1
+        assert self.grid_rows >= 1 and self.grid_cols >= 1
+        assert self.num_proxy_leaders >= 1
+        assert self.num_batchers >= 1 and self.num_unbatchers >= 1
+        assert self.num_replicas >= 1
+        assert self.batch_size >= 1 and self.arrivals_per_tick >= 1
+        assert self.window >= 4
+        assert 1 <= self.lat_min <= self.lat_max
+        assert self.retry_timeout >= 1
+        # Offset clocks must hold any pending arrival: the reply chain
+        # is the longest (2 hops), plus the fault plan's jitter/penalty
+        # per hop.
+        hop = self.lat_max + self.faults.jitter + self.faults.drop_penalty
+        assert 2 * hop < INF16
+        if self.read_rate:
+            assert self.read_window >= 2, "read ring needs >= 2 slots"
+        else:
+            assert self.read_window == 0
+        self.faults.validate(axis=self.acceptors_per_group)
+        self.kernels.validate()
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedCompartmentalizedState:
+    """Struct-of-arrays cluster state, one plane per role (module
+    docstring). Shapes: [G] groups, [G, W] batch ring, [G, B] batchers,
+    [G, P] proxies, [G, U] unbatchers, [R, C, G, W] acceptor grid,
+    [NR, G, *] replicas."""
+
+    # Batcher plane.
+    bat_fill: jnp.ndarray  # [G, B] commands accumulated (< 2*batch_size)
+    bat_arrival: jnp.ndarray  # [G, B] batch->leader offset clock (INF16)
+    bat_shed: jnp.ndarray  # [] commands shed by batcher backpressure
+    pending: jnp.ndarray  # [G] batches at the leader awaiting a ring slot
+
+    # Leader / batch ring.
+    next_slot: jnp.ndarray  # [G] next per-group batch sequence number
+    head: jnp.ndarray  # [G] lowest non-retired batch slot
+    status: jnp.ndarray  # [G, W] EMPTY | PROPOSED | CHOSEN
+    propose_tick: jnp.ndarray  # [G, W] proposal tick (latency base)
+    last_send: jnp.ndarray  # [G, W] last Phase2a send tick (retries)
+
+    # Proxy-leader plane.
+    proxy_alive: jnp.ndarray  # [G, P] liveness (crash/revive axis)
+    proxy_msgs: jnp.ndarray  # [G, P] messages handled per proxy (load)
+
+    # Acceptor grid (offset clocks).
+    p2a_arrival: jnp.ndarray  # [R, C, G, W] Phase2a offset clock (INF16)
+    p2b_arrival: jnp.ndarray  # [R, C, G, W] Phase2b offset clock at proxy
+
+    # Replica plane.
+    rep_arrival: jnp.ndarray  # [NR, G, W] commit-broadcast offset clock
+    rep_exec: jnp.ndarray  # [NR, G] per-replica executed watermark (slots)
+
+    # Unbatcher / client completion.
+    reply_arrival: jnp.ndarray  # [G, W] reply-chain offset clock (INF16)
+    unbat_msgs: jnp.ndarray  # [G, U] reply batches fanned per unbatcher
+
+    # Read plane (all zero-sized when read_window == 0).
+    rd_issue: jnp.ndarray  # [NR, G, RW] batch formation tick (INF = free)
+    rd_bound: jnp.ndarray  # [NR, G, RW] commit-prefix bound (slot count)
+    rd_count: jnp.ndarray  # [NR, G, RW] reads carried by the batch
+    rd_probe: jnp.ndarray  # [NR, G, RW] read-quorum probe offset clock
+    rd_row: jnp.ndarray  # [NR, G, RW] probed grid row (partition defer)
+
+    # Stats (entries = commands; a batch is batch_size entries).
+    committed: jnp.ndarray  # [] entries in chosen batches (cumulative)
+    batches_committed: jnp.ndarray  # [] batches chosen (cumulative)
+    retired: jnp.ndarray  # [] batches retired (cumulative)
+    writes_done: jnp.ndarray  # [] entries fully round-tripped to clients
+    lat_sum: jnp.ndarray  # [] entry-weighted client write latency sum
+    lat_hist: jnp.ndarray  # [LAT_BINS] client write latency histogram
+    reads_done: jnp.ndarray  # [] reads served (cumulative)
+    reads_shed: jnp.ndarray  # [] reads shed by read-batcher backpressure
+    read_lat_sum: jnp.ndarray  # [] read-weighted latency sum
+    read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
+
+    # Device-side per-tick metric ring (tpu/telemetry.py contract).
+    telemetry: Telemetry
+
+
+def init_state(
+    cfg: BatchedCompartmentalizedConfig,
+) -> BatchedCompartmentalizedState:
+    G, W = cfg.num_groups, cfg.window
+    R, C = cfg.grid_rows, cfg.grid_cols
+    P, B, U = cfg.num_proxy_leaders, cfg.num_batchers, cfg.num_unbatchers
+    NR, RW = cfg.num_replicas, cfg.read_window
+    return BatchedCompartmentalizedState(
+        bat_fill=jnp.zeros((G, B), jnp.int32),
+        bat_arrival=jnp.full((G, B), INF16, DTYPE_CLOCK),
+        bat_shed=jnp.zeros((), jnp.int32),
+        pending=jnp.zeros((G,), jnp.int32),
+        next_slot=jnp.zeros((G,), jnp.int32),
+        head=jnp.zeros((G,), jnp.int32),
+        status=jnp.zeros((G, W), DTYPE_STATUS),
+        propose_tick=jnp.full((G, W), INF, jnp.int32),
+        last_send=jnp.full((G, W), INF, jnp.int32),
+        proxy_alive=jnp.ones((G, P), bool),
+        proxy_msgs=jnp.zeros((G, P), jnp.int32),
+        p2a_arrival=jnp.full((R, C, G, W), INF16, DTYPE_CLOCK),
+        p2b_arrival=jnp.full((R, C, G, W), INF16, DTYPE_CLOCK),
+        rep_arrival=jnp.full((NR, G, W), INF16, DTYPE_CLOCK),
+        rep_exec=jnp.zeros((NR, G), jnp.int32),
+        reply_arrival=jnp.full((G, W), INF16, DTYPE_CLOCK),
+        unbat_msgs=jnp.zeros((G, U), jnp.int32),
+        rd_issue=jnp.full((NR, G, RW), INF, jnp.int32),
+        rd_bound=jnp.full((NR, G, RW), -1, jnp.int32),
+        rd_count=jnp.zeros((NR, G, RW), jnp.int32),
+        rd_probe=jnp.full((NR, G, RW), INF16, DTYPE_CLOCK),
+        rd_row=jnp.zeros((NR, G, RW), jnp.int32),
+        committed=jnp.zeros((), jnp.int32),
+        batches_committed=jnp.zeros((), jnp.int32),
+        retired=jnp.zeros((), jnp.int32),
+        writes_done=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        reads_done=jnp.zeros((), jnp.int32),
+        reads_shed=jnp.zeros((), jnp.int32),
+        read_lat_sum=jnp.zeros((), jnp.int32),
+        read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        telemetry=make_telemetry(),
+    )
+
+
+def tick(
+    cfg: BatchedCompartmentalizedConfig,
+    state: BatchedCompartmentalizedState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedCompartmentalizedState:
+    G, W = cfg.num_groups, cfg.window
+    R, C = cfg.grid_rows, cfg.grid_cols
+    P, B, U = cfg.num_proxy_leaders, cfg.num_batchers, cfg.num_unbatchers
+    NR, RW = cfg.num_replicas, cfg.read_window
+    BS = cfg.batch_size
+    fp = cfg.faults
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+
+    # 0. Age every offset clock by one tick (one fused elementwise op
+    # per plane; "fires now" is == 0, "already arrived" is <= 0).
+    bat_arrival = age_clock(state.bat_arrival)
+    p2a_arrival = age_clock(state.p2a_arrival)
+    p2b_arrival = age_clock(state.p2b_arrival)
+    rep_arrival = age_clock(state.rep_arrival)
+    reply_arrival = age_clock(state.reply_arrival)
+    rd_probe = age_clock(state.rd_probe) if RW else state.rd_probe
+
+    # PRNG sweeps: one threefry draw per plane family, bit-packed fields
+    # (tpu/common.py idiom). Grid sweep fields: [0:8) p2a leg latency,
+    # [8:16) p2b leg, [16:24) retry, [24:32) column transversal choice.
+    k_grid, k_rep, k_misc, k_read = jax.random.split(key, 4)
+    bits_grid = jax.random.bits(k_grid, (R, C, G, W))
+    p2a_lat = bit_latency(bits_grid, 0, cfg.lat_min, cfg.lat_max)
+    p2b_lat = bit_latency(bits_grid, 8, cfg.lat_min, cfg.lat_max)
+    retry_lat = bit_latency(bits_grid, 16, cfg.lat_min, cfg.lat_max)
+    # One quorum column per (row, group, slot): the write transversal.
+    q_col = (
+        ((bits_grid[:, 0] >> 24) & jnp.uint32(0xFF)).astype(jnp.int32) % C
+    )  # [R, G, W]
+    # Replica sweep: [0:8) commit-broadcast leg, [8:16) reply chain leg
+    # (row 0), [16:24) reply chain second hop (row 0).
+    bits_rep = jax.random.bits(k_rep, (NR, G, W))
+    rep_lat = bit_latency(bits_rep, 0, cfg.lat_min, cfg.lat_max)
+    reply_lat = bit_latency(bits_rep[0], 8, cfg.lat_min, cfg.lat_max) + (
+        bit_latency(bits_rep[0], 16, cfg.lat_min, cfg.lat_max)
+    )  # [G, W]: replica->unbatcher + unbatcher->client
+    # Batcher sweep: [0:8) batch->leader latency.
+    bits_bat = jax.random.bits(k_misc, (G, B))
+    bat_lat = bit_latency(bits_bat, 0, cfg.lat_min, cfg.lat_max)
+
+    # Fault transforms (structural no-ops under FaultPlan.none()).
+    # UDP on the grid planes: extra drop/dup/jitter + the R*C cell cut;
+    # TCP (retransmit penalties) on the batcher/commit/reply pipelines.
+    p2a_del = jnp.ones((R, C, G, W), bool)
+    p2b_del = jnp.ones((R, C, G, W), bool)
+    retry_del = jnp.ones((R, C, G, W), bool)
+    if fp.messages_active:
+        kf = faults_mod.fault_key(key)
+        link_up = faults_mod.partition_row(fp, t, R * C).reshape(R, C, 1, 1)
+        p2a_del, p2a_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 0), (R, C, G, W), p2a_lat, link_up
+        )
+        p2b_del, p2b_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 1), (R, C, G, W), p2b_lat, link_up
+        )
+        retry_del, retry_lat = faults_mod.message_faults(
+            fp, jax.random.fold_in(kf, 2), (R, C, G, W), retry_lat, link_up
+        )
+    if fp.active:
+        kf = faults_mod.fault_key(key, 1)
+        bat_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 0), (G, B), bat_lat
+        )
+        rep_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 1), (NR, G, W), rep_lat
+        )
+        reply_lat = faults_mod.tcp_latency(
+            fp, jax.random.fold_in(kf, 2), (G, W), reply_lat
+        )
+
+    # 1. Proxy-leader crash/revive (the role's fault axis).
+    proxy_alive = state.proxy_alive
+    if fp.has_crash:
+        proxy_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 2), proxy_alive
+        )
+
+    # 2. Batchers: admit client commands (shed past 2*batch_size — the
+    # batcher's own backpressure), receive fired batches at the leader,
+    # and ship full batches (one message each) when idle and the leader
+    # inbox has room.
+    fill = state.bat_fill + cfg.arrivals_per_tick
+    cap = 2 * BS
+    shed = jnp.maximum(fill - cap, 0)
+    fill = fill - shed
+    admitted = G * B * cfg.arrivals_per_tick - jnp.sum(shed)
+    bat_shed = state.bat_shed + jnp.sum(shed)
+    fired_b = bat_arrival == 0  # batch lands at the leader now
+    pending = state.pending + jnp.sum(fired_b, axis=1)
+    bat_arrival = jnp.where(fired_b, INF16, bat_arrival)
+    can_emit = (
+        (fill >= BS)
+        & (bat_arrival == INF16)
+        & (state.pending < B)[:, None]
+    )
+    bat_arrival = jnp.where(
+        can_emit, bat_lat.astype(bat_arrival.dtype), bat_arrival
+    )
+    fill = jnp.where(can_emit, fill - BS, fill)
+
+    # 3. Acceptors vote on Phase2a arrivals; votes fly back to the
+    # slot's proxy leader. Idempotent min-write dedups duplicates.
+    voted_now = p2a_arrival == 0
+    p2b_arrival = jnp.where(
+        voted_now & p2b_del,
+        jnp.minimum(p2b_arrival, p2b_lat.astype(p2b_arrival.dtype)),
+        p2b_arrival,
+    )
+
+    # 4. Proxy leaders count quorums: a slot is chosen when EVERY row
+    # has a vote in (the column-transversal write quorum). A dead proxy
+    # cannot collect — its slots defer until revival.
+    s_of_pos = state.head[:, None] + (w_iota[None, :] - state.head[:, None]) % W
+    p_of_pos = s_of_pos % P  # [G, W] proxy owning each ring position
+    alive_of_pos = jnp.take_along_axis(proxy_alive, p_of_pos, axis=1)
+    votes_in = p2b_arrival <= 0  # [R, C, G, W]
+    quorum = jnp.all(jnp.any(votes_in, axis=1), axis=0)  # [G, W]
+    newly_chosen = (state.status == PROPOSED) & quorum & alive_of_pos
+    status = jnp.where(newly_chosen, CHOSEN, state.status)
+    n_chosen = jnp.sum(newly_chosen)
+    batches_committed = state.batches_committed + n_chosen
+    committed = state.committed + BS * n_chosen
+    # Commit broadcast: proxy -> every replica; the reply chain
+    # (replica 0 -> unbatcher -> client) is armed when replica 0
+    # actually executes the batch (step 6).
+    rep_arrival = jnp.where(
+        newly_chosen[None, :, :],
+        rep_lat.astype(rep_arrival.dtype),
+        rep_arrival,
+    )
+
+    # 5. Replicas execute their contiguous arrived prefix, each
+    # advancing its OWN watermark (per-replica read serving depends on
+    # exactly this decoupling).
+    ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
+    live_ord = (w_iota[None, :] < (state.next_slot - state.head)[:, None])
+    exec_ready = (status == CHOSEN)[None] & (rep_arrival <= 0)  # [NR,G,W]
+    ord_ready = exec_ready & live_ord[None]
+    # Prefix length per replica = the minimum ordinal that is NOT ready
+    # (W when every position is) — a masked min-reduction, no gather.
+    first_gap = jnp.min(
+        jnp.where(ord_ready, W, ord_of_pos[None]), axis=2
+    )  # [NR, G]
+    rep_exec = jnp.maximum(state.rep_exec, state.head[None, :] + first_gap)
+
+    # 6. Replica 0 hands newly-executed batches to the unbatcher, which
+    # fans replies to clients (one combined 2-hop clock).
+    exec0_ord = (rep_exec[0] - state.head)  # [G] prefix length, replica 0
+    newly_exec0 = (
+        (ord_of_pos < exec0_ord[:, None])
+        & (reply_arrival == INF16)
+        & (status == CHOSEN)
+    )
+    reply_arrival = jnp.where(
+        newly_exec0, reply_lat.astype(reply_arrival.dtype), reply_arrival
+    )
+    # Client completion: the reply lands — entry-weighted latency.
+    replied_now = reply_arrival == 0
+    n_replied = jnp.sum(replied_now)
+    writes_done = state.writes_done + BS * n_replied
+    w_lat = jnp.where(replied_now, t - state.propose_tick, 0)
+    lat_sum = state.lat_sum + BS * jnp.sum(w_lat)
+    bins = jnp.clip(w_lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + BS * jax.ops.segment_sum(
+        replied_now.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+    # Unbatcher load accounting (one-hot over U: stays group-local
+    # under the mesh, unlike a flattened scatter-add).
+    u_of_pos = s_of_pos % U
+    unbat_msgs = state.unbat_msgs + jnp.sum(
+        replied_now[:, :, None]
+        & (u_of_pos[:, :, None] == jnp.arange(U, dtype=jnp.int32)),
+        axis=1,
+    )
+
+    # 7. Retire the contiguous prefix that every replica executed AND
+    # whose client reply has landed.
+    min_exec_ord = jnp.min(rep_exec, axis=0) - state.head  # [G]
+    done_pos = (
+        (ord_of_pos < min_exec_ord[:, None])
+        & (reply_arrival <= 0)
+        & (status == CHOSEN)
+    )
+    n_retire = jnp.min(
+        jnp.where(done_pos, W, ord_of_pos), axis=1
+    )  # first not-done ordinal
+    retire = ord_of_pos < n_retire[:, None]
+    head = state.head + n_retire
+    retired = state.retired + jnp.sum(n_retire)
+    status = jnp.where(retire, EMPTY, status)
+    propose_tick = jnp.where(retire, INF, state.propose_tick)
+    last_send = jnp.where(retire, INF, state.last_send)
+    reply_arrival = jnp.where(retire, INF16, reply_arrival)
+    p2a_arrival = jnp.where(retire[None, None], INF16, p2a_arrival)
+    p2b_arrival = jnp.where(retire[None, None], INF16, p2b_arrival)
+    rep_arrival = jnp.where(retire[None], INF16, rep_arrival)
+
+    # 8. Leader sequences pending batches into free ring slots and
+    # hands the Phase2a broadcast to proxy `slot % P` — sent to the
+    # write transversal (one acceptor per row) when the proxy is alive.
+    space = W - (state.next_slot - head)
+    k_new = jnp.minimum(pending, space)
+    delta = (w_iota[None, :] - state.next_slot[:, None]) % W
+    is_new = delta < k_new[:, None]
+    pending = pending - k_new
+    next_slot = state.next_slot + k_new
+    status = jnp.where(is_new, PROPOSED, status)
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    last_send = jnp.where(is_new, t, last_send)
+    # Recompute slot->proxy for the NEW occupancy (positions beyond the
+    # old next_slot now hold fresh slots).
+    s_of_pos = head[:, None] + (w_iota[None, :] - head[:, None]) % W
+    p_of_pos = s_of_pos % P
+    alive_of_pos = jnp.take_along_axis(proxy_alive, p_of_pos, axis=1)
+    in_quorum = (
+        jnp.arange(C, dtype=jnp.int32)[None, :, None, None]
+        == q_col[:, None, :, :]
+    )  # [R, C, G, W]
+    send = (is_new & alive_of_pos)[None, None] & in_quorum
+    p2a_arrival = jnp.where(
+        send & p2a_del, p2a_lat.astype(p2a_arrival.dtype), p2a_arrival
+    )
+
+    # 9. Proxy retries: a timed-out PROPOSED slot re-broadcasts to the
+    # FULL grid (liveness under drops, dead transversal members, and
+    # healed partitions).
+    timed_out = (
+        (status == PROPOSED)
+        & (t - last_send >= cfg.retry_timeout)
+        & alive_of_pos
+    )
+    resend = timed_out[None, None] & retry_del
+    # OVERWRITE (not min-write): an acceptor whose Phase2b was dropped
+    # has an already-arrived (saturated) p2a clock — only a fresh
+    # arrival makes it re-vote; re-votes dedup via the p2b min-write.
+    p2a_arrival = jnp.where(
+        resend, retry_lat.astype(p2a_arrival.dtype), p2a_arrival
+    )
+    last_send = jnp.where(timed_out, t, last_send)
+
+    # Proxy load accounting (one-hot over P, group-local).
+    p_onehot = p_of_pos[:, :, None] == jnp.arange(P, dtype=jnp.int32)
+    per_pos_msgs = (
+        R * is_new.astype(jnp.int32)  # transversal Phase2a
+        + (R * C) * timed_out.astype(jnp.int32)  # full-grid retry
+        + jnp.sum(voted_now, axis=(0, 1))  # Phase2b votes collected
+        + NR * newly_chosen.astype(jnp.int32)  # commit broadcast
+    )
+    proxy_msgs = state.proxy_msgs + jnp.sum(
+        per_pos_msgs[:, :, None] * p_onehot, axis=1
+    )
+
+    # 10. Read plane: each replica's read batcher forms one batch per
+    # tick, probes a read-quorum row for the commit-prefix bound, and
+    # serves once its OWN watermark passes the bound.
+    reads_done = state.reads_done
+    reads_shed = state.reads_shed
+    read_lat_sum = state.read_lat_sum
+    read_lat_hist = state.read_lat_hist
+    rd_issue, rd_bound = state.rd_issue, state.rd_bound
+    rd_count, rd_row = state.rd_count, state.rd_row
+    probes_sent = jnp.zeros((), jnp.int32)
+    if RW:
+        bits_read = jax.random.bits(k_read, (NR, G, RW))
+        probe_lat = bit_latency(bits_read, 0, cfg.lat_min, cfg.lat_max) + (
+            bit_latency(bits_read, 8, cfg.lat_min, cfg.lat_max)
+        )
+        probe_row = (
+            ((bits_read >> 16) & jnp.uint32(0xFF)).astype(jnp.int32) % R
+        )
+        if fp.active:
+            probe_lat = faults_mod.tcp_latency(
+                fp, faults_mod.fault_key(key, 3), (NR, G, RW), probe_lat
+            )
+        if fp.has_partition:
+            # An in-flight probe to a row with any cut cell buffers to
+            # the heal tick (TCP read-quorum semantics): re-deferred
+            # every tick the cut is active, so it can never fire early.
+            sides = jnp.asarray(fp.partition, jnp.int32).reshape(R, C)
+            row_cut_static = jnp.any(sides == 1, axis=1)  # [R]
+            in_flight = (rd_issue < INF) & (rd_probe > 0)
+            cut = (
+                row_cut_static[rd_row]
+                & in_flight
+                & faults_mod.partition_active(fp, t)
+            )
+            rd_probe = faults_mod.defer_to_heal_offset(
+                fp, rd_probe, cut, t
+            )
+        # Serve: probe returned and the replica's watermark passed the
+        # bound (bound is a commit-prefix slot count; every slot below
+        # it is chosen, so execution reaches it).
+        served = (
+            (rd_issue < INF)
+            & (rd_probe <= 0)
+            & (rep_exec[:, :, None] >= rd_bound)
+        )
+        n_served = jnp.sum(jnp.where(served, rd_count, 0))
+        reads_done = reads_done + n_served
+        r_lat = jnp.where(served, t - rd_issue, 0)
+        read_lat_sum = read_lat_sum + jnp.sum(
+            jnp.where(served, rd_count * r_lat, 0)
+        )
+        r_bins = jnp.clip(r_lat, 0, LAT_BINS - 1)
+        # Transpose the sharded group axis to the FRONT before
+        # linearizing: reshaping [NR, G, RW] with G sharded in the
+        # middle would force an all-gather, while [G, NR, RW] -> flat
+        # partitions into contiguous per-device blocks.
+        read_lat_hist = read_lat_hist + jax.ops.segment_sum(
+            jnp.where(served, rd_count, 0).transpose(1, 0, 2).ravel(),
+            r_bins.transpose(1, 0, 2).ravel(),
+            LAT_BINS,
+        )
+        rd_issue = jnp.where(served, INF, rd_issue)
+        rd_bound = jnp.where(served, -1, rd_bound)
+        rd_count = jnp.where(served, 0, rd_count)
+        # Form one new batch per (replica, group): first free ring slot.
+        free = rd_issue >= INF
+        rank = jnp.cumsum(free.astype(jnp.int32), axis=2)
+        form = free & (rank == 1)
+        any_free = jnp.any(free, axis=2)
+        reads_shed = reads_shed + cfg.read_rate * jnp.sum(~any_free)
+        # The bound: this group's chosen-prefix watermark (every slot
+        # below it is chosen) — what the read-quorum row reports.
+        # Ordinals are recomputed against the POST-RETIREMENT head
+        # (ord_of_pos is ordinal space of the old head — on a tick that
+        # retires, mixing it with the new head/status would collapse
+        # the bound to the new head); positions beyond the live range
+        # read as gaps, capping the prefix at the allocated frontier.
+        ord_now = (w_iota[None, :] - head[:, None]) % W
+        chosen_prefix = jnp.min(
+            jnp.where(
+                (status == CHOSEN)
+                & (ord_now < (next_slot - head)[:, None]),
+                W,
+                ord_now,
+            ),
+            axis=1,
+        )
+        pw = head + chosen_prefix  # [G]
+        rd_issue = jnp.where(form, t, rd_issue)
+        rd_bound = jnp.where(form, pw[None, :, None], rd_bound)
+        rd_count = jnp.where(form, cfg.read_rate, rd_count)
+        rd_row = jnp.where(form, probe_row, rd_row)
+        rd_probe = jnp.where(
+            form, probe_lat.astype(rd_probe.dtype), rd_probe
+        )
+        probes_sent = C * jnp.sum(form)
+
+    # 11. Telemetry (tpu/telemetry.py): counters the tick already
+    # computed for its own bookkeeping.
+    drops = jnp.sum(send & ~p2a_del) + jnp.sum(voted_now & ~p2b_del)
+    tel = record(
+        state.telemetry,
+        proposals=admitted,
+        phase1_msgs=probes_sent,
+        phase2_msgs=(
+            R * jnp.sum(is_new)
+            + (R * C) * jnp.sum(timed_out)
+            + jnp.sum(voted_now)
+        ),
+        commits=committed - state.committed,
+        executes=BS * jnp.sum(n_retire),
+        drops=drops,
+        retries=jnp.sum(timed_out),
+        queue_depth=jnp.sum(next_slot - head) + jnp.sum(pending),
+        queue_capacity=G * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+
+    return BatchedCompartmentalizedState(
+        bat_fill=fill,
+        bat_arrival=bat_arrival,
+        bat_shed=bat_shed,
+        pending=pending,
+        next_slot=next_slot,
+        head=head,
+        status=status,
+        propose_tick=propose_tick,
+        last_send=last_send,
+        proxy_alive=proxy_alive,
+        proxy_msgs=proxy_msgs,
+        p2a_arrival=p2a_arrival,
+        p2b_arrival=p2b_arrival,
+        rep_arrival=rep_arrival,
+        rep_exec=rep_exec,
+        reply_arrival=reply_arrival,
+        unbat_msgs=unbat_msgs,
+        rd_issue=rd_issue,
+        rd_bound=rd_bound,
+        rd_count=rd_count,
+        rd_probe=rd_probe,
+        rd_row=rd_row,
+        committed=committed,
+        batches_committed=batches_committed,
+        retired=retired,
+        writes_done=writes_done,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+        reads_done=reads_done,
+        reads_shed=reads_shed,
+        read_lat_sum=read_lat_sum,
+        read_lat_hist=read_lat_hist,
+        telemetry=tel,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+def run_ticks(
+    cfg: BatchedCompartmentalizedConfig,
+    state: BatchedCompartmentalizedState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedCompartmentalizedState, jnp.ndarray]:
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(
+        step, (state, t0), jnp.arange(num_ticks), unroll=1
+    )
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedCompartmentalizedConfig,
+    state: BatchedCompartmentalizedState,
+    t,
+) -> dict:
+    """Device-side safety checks; returns traced boolean scalars so the
+    checks also run under jit/vmap (the simtest harness vmaps them)."""
+    W = cfg.window
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W
+    live = ord_of_pos < (state.next_slot - state.head)[:, None]
+    chosen = (state.status == CHOSEN) & live
+    # Every chosen slot holds a full column-transversal quorum (every
+    # row voted); votes saturate "arrived" until retirement clears them.
+    votes_in = state.p2b_arrival <= 0
+    quorum = jnp.all(jnp.any(votes_in, axis=1), axis=0)
+    checks = {
+        "quorum_ok": jnp.all(jnp.where(chosen, quorum, True)),
+        "window_ok": jnp.all(
+            (state.head <= state.next_slot)
+            & (state.next_slot - state.head <= W)
+        ),
+        # Each replica's watermark sits between the retired prefix and
+        # the allocated frontier.
+        "watermark_ok": jnp.all(
+            (state.rep_exec >= state.head[None, :])
+            & (state.rep_exec <= state.next_slot[None, :])
+        ),
+        # Conservation: retired <= chosen batches; client completions
+        # never exceed committed entries.
+        "conserved": (
+            (state.retired <= state.batches_committed)
+            & (state.writes_done <= state.committed)
+        ),
+        "batcher_ok": jnp.all(
+            (state.bat_fill >= 0) & (state.bat_fill <= 2 * cfg.batch_size)
+        )
+        & jnp.all(state.pending >= 0),
+    }
+    if cfg.read_window:
+        occupied = state.rd_issue < INF
+        # A bound is a commit-prefix watermark taken at issue; it can
+        # never exceed the group's allocated frontier.
+        checks["read_bound_ok"] = jnp.all(
+            jnp.where(
+                occupied,
+                (state.rd_bound >= 0)
+                & (state.rd_bound <= state.next_slot[None, :, None]),
+                True,
+            )
+        )
+    return checks
+
+
+def stats(cfg, state, t) -> dict:
+    """Host-side summary (one coalesced transfer via device_get of the
+    fields it touches; never called inside the compiled loop)."""
+    committed = int(state.committed)
+    done = int(state.writes_done)
+    hist = jax.device_get(state.lat_hist)
+    cum = hist.cumsum()
+    weight = int(hist.sum())
+    p50 = int((cum >= max(1, (weight + 1) // 2)).argmax()) if weight else -1
+    pm = jax.device_get(state.proxy_msgs)
+    um = jax.device_get(state.unbat_msgs)
+    reads = int(state.reads_done)
+    return {
+        "ticks": int(t),
+        "committed_entries": committed,
+        "batches_committed": int(state.batches_committed),
+        "writes_done": done,
+        "commit_latency_p50_ticks": p50,
+        "latency_mean_ticks": (
+            round(float(state.lat_sum) / done, 2) if done else -1.0
+        ),
+        "entries_per_batch": cfg.batch_size,
+        "batcher_shed": int(state.bat_shed),
+        "proxy_msgs_total": int(pm.sum()),
+        # Load-balance factor over proxies: 1.0 = perfectly even.
+        "proxy_imbalance": (
+            round(float(pm.max()) / max(float(pm.mean()), 1e-9), 3)
+            if pm.size
+            else -1.0
+        ),
+        "unbatcher_replies_total": int(um.sum()),
+        "reads_done": reads,
+        "reads_shed": int(state.reads_shed),
+        "read_latency_mean_ticks": (
+            round(float(state.read_lat_sum) / reads, 2) if reads else -1.0
+        ),
+    }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+) -> BatchedCompartmentalizedConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every role plane — batchers, proxies, the 2x2 acceptor
+    grid, replicas, unbatchers, and the read path — small enough to
+    trace and compile in well under a second."""
+    return BatchedCompartmentalizedConfig(
+        num_groups=4, grid_rows=2, grid_cols=2, num_proxy_leaders=4,
+        num_batchers=2, num_unbatchers=2, num_replicas=3, window=16,
+        batch_size=2, arrivals_per_tick=1, retry_timeout=8,
+        read_rate=2, read_window=6, faults=faults,
+    )

@@ -225,6 +225,29 @@ def defer_to_heal(plan: FaultPlan, arrival: jnp.ndarray, cut) -> jnp.ndarray:
     return jnp.where(cut, jnp.maximum(arrival, heal), arrival)
 
 
+def defer_to_heal_offset(
+    plan: FaultPlan, off: jnp.ndarray, cut, t
+) -> jnp.ndarray:
+    """:func:`defer_to_heal` for OFFSET clocks (tpu/common.py
+    DTYPE_CLOCK): arrivals flagged ``cut`` are pushed to the heal tick
+    expressed as an offset from ``t`` — ``max(off, heal - t)``, clamped
+    into the int16 clock range, or the INF16 sentinel if the partition
+    never heals. Identity when no partition is configured. All
+    arithmetic is weakly typed so the widen_state() int32 reference
+    path replays bit-identically."""
+    from frankenpaxos_tpu.tpu.common import INF16
+
+    if not plan.has_partition:
+        return off
+    if plan.partition_heal < 0:
+        heal_off = INF16
+    else:
+        heal_off = jnp.minimum(
+            jnp.int32(plan.partition_heal) - t, INF16
+        ).astype(off.dtype)
+    return jnp.where(cut, jnp.maximum(off, heal_off), off)
+
+
 # ---------------------------------------------------------------------------
 # Message planes
 # ---------------------------------------------------------------------------

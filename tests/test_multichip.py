@@ -1,0 +1,201 @@
+"""Tier-1 multi-chip smoke: the generic sharding layer
+(``frankenpaxos_tpu/parallel/sharding.py``) runs the sharded flagship
+AND the compartmentalized backend on the 8-virtual-device CPU mesh
+(conftest sets ``--xla_force_host_platform_device_count=8``), with
+
+  * per-device GROUP LOCALITY pinned as a compile-time fact — no
+    collective moves signed (simulation-state) data beyond the small
+    commit/watermark/histogram reductions,
+  * seed-stable, sharded-vs-unsharded BIT-IDENTICAL results (integer
+    psums are exact, so mesh size cannot change a single bit),
+  * donation surviving GSPMD partitioning (single-buffered per shard),
+  * and the KernelPolicy x mesh validation: a policy that would lower
+    Pallas inside a >1-device mesh is a loud ``ValueError``, never a
+    silent mis-lowering; at mesh=1 the engaged kernels stay
+    bit-identical to the unsharded run.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.parallel import sharding as sh
+from frankenpaxos_tpu.tpu import compartmentalized_batched as cb
+from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+
+# HLO collective census helpers shared with the flagship sharding tests.
+from test_hlo_sharding import (
+    _all_reduce_sizes,
+    _prng_collective_sizes,
+    _state_collectives,
+)
+
+_BIG = ("all-gather", "collective-permute", "all-to-all")
+
+
+def _mesh(n=None):
+    devices = jax.devices()
+    return sh.make_mesh(devices[: n or len(devices)])
+
+
+def _ccfg(**kw):
+    return dataclasses.replace(
+        cb.analysis_config(), num_groups=8, **kw
+    )
+
+
+def _compiled_sharded_text(backend, cfg, state_fn, mesh, ticks=40):
+    # Default 40 ticks: the SAME (config, ticks) signature as the
+    # bit-identity run below, so the census/donation tests reuse one
+    # compiled 8-device program instead of paying a second GSPMD
+    # compile (num_ticks is static — a new count is a new program).
+    state = sh.shard_state(backend, state_fn(cfg), mesh)
+    lowered = sh.lower_sharded(
+        backend, cfg, mesh, state, jnp.zeros((), jnp.int32), ticks,
+        jax.random.PRNGKey(0),
+    )
+    return lowered.compile().as_text()
+
+
+def test_compartmentalized_write_and_read_paths_are_group_local():
+    """The whole role pipeline — batchers, proxies, the [R, C, G, W]
+    grid, replicas, unbatchers, read probes — partitions group-locally:
+    no collective carries signed state, and every stat all-reduce is
+    bounded by the LAT_BINS histogram."""
+    cfg = _ccfg()
+    txt = _compiled_sharded_text(
+        "compartmentalized", cfg, cb.init_state, _mesh()
+    )
+    offenders = _state_collectives(txt, _BIG)
+    assert not offenders, f"compartmentalized moved state: {offenders}"
+    sizes = _all_reduce_sizes(txt)
+    assert sizes, "stat reductions must exist (commit/watermark/hist)"
+    assert all(s <= 64 for s in sizes), sizes
+    # PRNG sweep assembly stays bounded by one tick's largest draw.
+    R, C, G, W = (cfg.grid_rows, cfg.grid_cols, cfg.num_groups, cfg.window)
+    assert all(s <= R * C * G * W for s in _prng_collective_sizes(txt))
+
+
+def test_flagship_via_generic_registry_is_group_local():
+    """The registry-driven wrapper compiles the flagship write path
+    with the same zero-state-movement property the legacy wrapper had
+    (exact config + tick count of test_hlo_sharding's write-path test,
+    so the two files share one compiled program)."""
+    cfg = mb.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, drop_rate=0.1,
+        retry_timeout=8,
+    )
+    txt = _compiled_sharded_text("multipaxos", cfg, mb.init_state,
+                                 _mesh(), ticks=4)
+    offenders = _state_collectives(txt, _BIG)
+    assert not offenders, f"flagship moved state: {offenders}"
+    assert all(s <= 64 for s in _all_reduce_sizes(txt))
+
+
+def test_donation_aliases_survive_the_mesh():
+    """Sharded donation stays single-buffered: the compiled sharded
+    module aliases every donated State leaf (double-buffering under a
+    mesh would pay 2x HBM on EVERY device)."""
+    from frankenpaxos_tpu.analysis.rules_trace import _alias_param_indices
+
+    cfg = _ccfg()
+    state = cb.init_state(cfg)
+    n_leaves = len(jax.tree_util.tree_leaves(state))
+    txt = _compiled_sharded_text(
+        "compartmentalized", cfg, cb.init_state, _mesh()
+    )
+    aliased = _alias_param_indices(txt)
+    missing = sorted(set(range(n_leaves)) - aliased)
+    assert not missing, f"unaliased sharded State leaves: {missing}"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_vs_unsharded_bit_identity(seed):
+    """8-device sharded run == unsharded run, bit for bit, per seed —
+    and the sharded run is seed-stable across invocations."""
+    cfg = _ccfg()
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+
+    st = sh.shard_state("compartmentalized", cb.init_state(cfg), mesh)
+    st, t = sh.run_ticks_sharded(
+        "compartmentalized", cfg, mesh, st, t0, 40, key
+    )
+    jax.block_until_ready(st)
+
+    st2 = sh.shard_state("compartmentalized", cb.init_state(cfg), mesh)
+    st2, _ = sh.run_ticks_sharded(
+        "compartmentalized", cfg, mesh, st2, t0, 40, key
+    )
+    assert int(st.committed) == int(st2.committed)  # seed-stable
+
+    ust, _ = cb.run_ticks(cfg, cb.init_state(cfg), t0, 40, key)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(ust)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_policy_sharded_mesh1_bit_identity():
+    """Mesh of ONE device: any kernel policy is allowed, and the
+    sharded wrapper with the kernels ENGAGED (interpret mode — the
+    actual kernel path, executable on CPU) replays the unsharded run
+    bit for bit."""
+    cfg = dataclasses.replace(
+        mb.analysis_config(), kernels=KernelPolicy(mode="interpret")
+    )
+    mesh1 = sh.make_mesh(jax.devices()[:1])
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    st = sh.shard_state("multipaxos", mb.init_state(cfg), mesh1)
+    st, _ = sh.run_ticks_sharded("multipaxos", cfg, mesh1, st, t0, 3, key)
+    ust, _ = mb.run_ticks(cfg, mb.init_state(cfg), t0, 3, key)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(ust)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_policy_mesh_gt1_is_a_validation_error():
+    """A policy that resolves any plane off the reference path under a
+    >1-device mesh raises instead of silently mis-lowering the Pallas
+    body. The default auto policy resolves to the reference twins on
+    CPU, so it passes."""
+    mesh = _mesh()
+    bad = dataclasses.replace(
+        mb.analysis_config(), num_groups=8,
+        kernels=KernelPolicy(mode="interpret"),
+    )
+    with pytest.raises(ValueError, match="SPMD partitioning rule"):
+        sh.validate_policy("multipaxos", bad, mesh)
+    legacy = dataclasses.replace(
+        mb.analysis_config(), num_groups=8, use_pallas=True
+    )
+    with pytest.raises(ValueError, match="SPMD partitioning rule"):
+        sh.validate_policy("multipaxos", legacy, mesh)
+    ok = dataclasses.replace(mb.analysis_config(), num_groups=8)
+    sh.validate_policy("multipaxos", ok, mesh)  # auto -> reference on CPU
+    sh.validate_policy("compartmentalized", _ccfg(), mesh)
+
+
+def test_axis_divisibility_is_checked():
+    with pytest.raises(ValueError, match="divisible by the mesh size"):
+        sh.shard_state(
+            "compartmentalized",
+            cb.init_state(dataclasses.replace(cb.analysis_config(),
+                                              num_groups=6)),
+            _mesh(4),
+        )
+
+
+def test_registry_covers_the_sharded_families():
+    assert set(sh.SHARDINGS) >= {"multipaxos", "epaxos", "compartmentalized"}
+    for spec in sh.SHARDINGS.values():
+        # Every spec resolves its module and builds shardings.
+        shardings = sh.state_shardings(spec.backend, _mesh())
+        assert shardings

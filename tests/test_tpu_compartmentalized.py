@@ -1,0 +1,206 @@
+"""Batched Compartmentalized MultiPaxos: role-decoupled planes
+(batchers / proxy leaders / acceptor grid / replicas / unbatchers /
+read replicas), dtype-policy bit-identity, and fault semantics.
+
+Compile budget: tests share ONE canonical 120-tick run of the
+analysis_config (module fixture) wherever possible, and every
+run_ticks call sticks to tick counts already compiled for its config
+(num_ticks is a static argument — a new count is a new XLA program).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.tpu import compartmentalized_batched as cb
+from frankenpaxos_tpu.tpu.common import widen_state
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+
+def _run(cfg, ticks, seed=0, state=None, t0=None):
+    state = cb.init_state(cfg) if state is None else state
+    t0 = jnp.zeros((), jnp.int32) if t0 is None else t0
+    return cb.run_ticks(cfg, state, t0, ticks, jax.random.PRNGKey(seed))
+
+
+def _assert_invariants(cfg, state, t):
+    inv = {k: bool(v) for k, v in cb.check_invariants(cfg, state, t).items()}
+    assert all(inv.values()), inv
+
+
+@pytest.fixture(scope="module")
+def base_run():
+    """One 120-tick run of the canonical config, shared by every test
+    that only needs to OBSERVE a healthy pipeline."""
+    cfg = cb.analysis_config()
+    state, t = _run(cfg, 120)
+    jax.block_until_ready(state)
+    return cfg, state, t
+
+
+def test_pipeline_progress_and_invariants(base_run):
+    """The full pipeline moves: commands batch, batches commit through
+    the grid, replicas execute, unbatchers reply, reads serve — and
+    every invariant holds."""
+    cfg, state, t = base_run
+    _assert_invariants(cfg, state, t)
+    s = cb.stats(cfg, state, t)
+    assert s["committed_entries"] > 0
+    assert s["batches_committed"] * cfg.batch_size == s["committed_entries"]
+    assert 0 < s["writes_done"] <= s["committed_entries"]
+    assert s["reads_done"] > 0
+    assert s["proxy_msgs_total"] > 0
+    assert s["unbatcher_replies_total"] > 0
+    assert int(state.retired) <= int(state.batches_committed)
+
+
+def test_roles_absorb_load_evenly(base_run):
+    """Slot % P round-robin keeps proxy-leader load balanced (the
+    compartmentalization premise: the role scales by adding members,
+    none of which becomes the new bottleneck)."""
+    _, state, _ = base_run
+    pm = np.asarray(jax.device_get(state.proxy_msgs), dtype=np.float64)
+    assert pm.min() > 0
+    assert pm.max() / pm.mean() < 1.5, pm
+    um = np.asarray(jax.device_get(state.unbat_msgs), dtype=np.float64)
+    assert um.min() > 0
+
+
+def test_telemetry_ring_records_pipeline(base_run):
+    """The device-side ring sees the role planes: proposals (admitted
+    commands), phase2 traffic, commits, executes, and read probes as
+    phase1 messages."""
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    _, state, _ = base_run
+    totals = jax.device_get(state.telemetry.totals)
+    assert int(state.telemetry.ticks) == 120
+    assert totals[COL["proposals"]] > 0
+    assert totals[COL["phase1_msgs"]] > 0  # read-quorum probes
+    assert totals[COL["phase2_msgs"]] > 0
+    assert int(totals[COL["commits"]]) == int(state.committed)
+    assert totals[COL["executes"]] > 0
+
+
+def test_none_plan_matches_explicit_default(base_run):
+    """FaultPlan.none() is structural: a config built with an explicit
+    none() equals the default-plan config (same jit cache entry) and
+    replays identically."""
+    cfg, state, _ = base_run
+    cfg_b = cb.analysis_config(faults=FaultPlan.none())
+    assert cfg_b == cfg and hash(cfg_b) == hash(cfg)
+    sb, _ = _run(cfg_b, 120)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(sb)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_narrow_vs_widened_bit_identity_three_seeds():
+    """The dtype policy is storage-only: running the SAME tick on a
+    widen_state()-upcast state replays bit for bit (int16 offset
+    clocks, int8 statuses). Ticks are jitted once per dtype path."""
+    cfg = cb.analysis_config()
+    step = jax.jit(lambda s, t, k: cb.tick(cfg, s, t, k))
+    for seed in (0, 1, 2):
+        key = jax.random.PRNGKey(seed)
+        narrow = cb.init_state(cfg)
+        wide = widen_state(cb.init_state(cfg))
+        t = jnp.zeros((), jnp.int32)
+        for i in range(40):
+            k = jax.random.fold_in(key, i)
+            narrow = step(narrow, t, k)
+            wide = step(wide, t, k)
+            t = t + 1
+        for a, b in zip(
+            jax.tree_util.tree_leaves(widen_state(narrow)),
+            jax.tree_util.tree_leaves(wide),
+        ):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_partition_defers_and_heals_writes_and_reads():
+    """Cutting grid cells degrades the write path (retries route around
+    the cut transversal members) and defers read probes on cut rows;
+    after the scheduled heal BOTH planes resume, and invariants hold
+    throughout. (One 80-tick program, invoked twice.)"""
+    plan = FaultPlan(
+        partition=(0, 1, 1, 0), partition_start=10, partition_heal=80
+    )
+    cfg = cb.analysis_config(faults=plan)
+    state, t = _run(cfg, 80)
+    _assert_invariants(cfg, state, t)
+    mid_committed = int(state.committed)
+    mid_reads = int(state.reads_done)
+    state, t = _run(cfg, 80, seed=99, state=state, t0=t)
+    _assert_invariants(cfg, state, t)
+    assert int(state.committed) > mid_committed, "writes did not resume"
+    assert int(state.reads_done) > mid_reads, "reads did not resume"
+
+
+def test_dead_proxies_stall_their_slots_until_revival():
+    """Proxy leaders are the crash axis: with every proxy dead nothing
+    new commits (votes cannot be collected, Phase2a cannot fan out);
+    restoring the plane resumes progress. (Reuses the fixture's
+    120-tick program — no extra compile.)"""
+    cfg = cb.analysis_config()
+    state, t = _run(cfg, 120)
+    base = int(state.committed)
+    dead = dataclasses.replace(
+        state, proxy_alive=jnp.zeros_like(state.proxy_alive)
+    )
+    dead, t = _run(cfg, 120, seed=5, state=dead, t0=t)
+    _assert_invariants(cfg, dead, t)
+    assert int(dead.committed) == base, "commits advanced with proxies dead"
+    revived = dataclasses.replace(
+        dead, proxy_alive=jnp.ones_like(dead.proxy_alive)
+    )
+    revived, t = _run(cfg, 120, seed=6, state=revived, t0=t)
+    _assert_invariants(cfg, revived, t)
+    assert int(revived.committed) > base, "commits did not resume"
+
+
+@pytest.mark.slow
+def test_reads_scale_with_replicas_and_batching_amplifies():
+    """The two compartmentalization scaling axes, measured head to
+    head: doubling the read-replica count ~doubles served reads (reads
+    never touch the write quorums), and 4x the batch size moves ~4x
+    the entries through the SAME number of protocol messages
+    (HT-Paxos batching economics)."""
+    few = dataclasses.replace(cb.analysis_config(), num_replicas=2)
+    many = dataclasses.replace(cb.analysis_config(), num_replicas=4)
+    sf, _ = _run(few, 80)
+    sm, _ = _run(many, 80)
+    ratio = int(sm.reads_done) / max(int(sf.reads_done), 1)
+    assert 1.6 < ratio < 2.4, (int(sf.reads_done), int(sm.reads_done))
+
+    small = dataclasses.replace(
+        cb.analysis_config(), batch_size=1, arrivals_per_tick=1
+    )
+    big = dataclasses.replace(
+        cb.analysis_config(), batch_size=4, arrivals_per_tick=4
+    )
+    ss, _ = _run(small, 80)
+    sb, _ = _run(big, 80)
+    entries_ratio = int(sb.committed) / max(int(ss.committed), 1)
+    assert entries_ratio > 3.0, (int(ss.committed), int(sb.committed))
+    batches_ratio = int(sb.batches_committed) / max(
+        int(ss.batches_committed), 1
+    )
+    assert 0.7 < batches_ratio < 1.4, (
+        int(ss.batches_committed), int(sb.batches_committed),
+    )
+
+
+def test_analysis_config_traces_fast_and_is_hashable():
+    """The canonical small config is a valid static jit argument (the
+    retrace-guard contract) and reaches every plane."""
+    cfg_a = cb.analysis_config()
+    cfg_b = cb.analysis_config()
+    assert cfg_a == cfg_b and hash(cfg_a) == hash(cfg_b)
+    assert cfg_a.read_rate > 0 and cfg_a.num_replicas > 1
+    assert cfg_a.acceptors_per_group == 4
