@@ -505,8 +505,41 @@ def _multichip_inner() -> None:
         ).compile().as_text()
         return _collective_census(hlo)
 
-    def measure(n_dev: int, G: int, warm: int = 60, ticks: int = 60):
+    def kernel_fields(cfg) -> dict:
+        """Per-row kernel accounting: the policy mode + the per-plane
+        resolution on THIS backend — so a leg where the kernels stayed
+        off says `kernels_engaged: false` explicitly instead of
+        staying silent."""
+        from frankenpaxos_tpu.ops import registry as reg
+
+        pol = reg.policy_of(cfg)
+        resolved = {
+            n: reg.resolve_mode(n, cfg)
+            for n, p in reg.PLANES.items()
+            if p.backend == "compartmentalized"
+        }
+        return {
+            "kernel_policy": {
+                "mode": pol.mode,
+                "block": pol.block,
+                "resolved": resolved,
+            },
+            "kernels_engaged": any(
+                m != "reference" for m in resolved.values()
+            ),
+        }
+
+    def measure(
+        n_dev: int, G: int, warm: int = 60, ticks: int = 60,
+        kernels_mode: "str | None" = None,
+    ):
+        import dataclasses as _dc
+
+        from frankenpaxos_tpu.ops.registry import KernelPolicy
+
         cfg = make_cfg(G)
+        if kernels_mode is not None:
+            cfg = _dc.replace(cfg, kernels=KernelPolicy(mode=kernels_mode))
         mesh = sh.make_mesh(devices[:n_dev])
         census = leg_census(cfg, mesh)
         state = sh.shard_state("compartmentalized",
@@ -544,6 +577,7 @@ def _multichip_inner() -> None:
             # This leg's own census (4-tick program at THIS mesh size).
             "collective_bytes": census["state_collective_bytes"],
             "group_local_ok": census["group_local_ok"],
+            **kernel_fields(cfg),
         }
 
     # Weak scaling: fixed per-device load (the scale-out axis the
@@ -555,6 +589,24 @@ def _multichip_inner() -> None:
     # overhead rather than speedup).
     strong = [measure(d, G_PER_DEV * 8, warm=40, ticks=40)
               for d in (1, 8)]
+    # Kernels-ON legs per mesh size: the same simulation with the
+    # grid-vote plane ENGAGED, shard_map-lowered per device (interpret
+    # mode on this CPU host — the actual kernel path, priced by the
+    # Pallas interpreter, so these rows measure COMPOSITION not speed;
+    # the compiled wall clock is the reserved TPU leg). Short ticks:
+    # the interpreter costs ~2 orders of magnitude per tick.
+    kernels_on = [
+        measure(d, G_PER_DEV * d, warm=8, ticks=8,
+                kernels_mode="interpret")
+        for d in (1, 2, 4, 8)
+    ]
+    # Cross-check at the full mesh: the kernels-on leg must commit
+    # EXACTLY what the reference program commits over the same
+    # (seed, ticks) history — sharded kernels == sharded reference.
+    ref_check = measure(8, G_PER_DEV * 8, warm=8, ticks=8)
+    kernels_match = (
+        kernels_on[-1]["committed_entries"] == ref_check["committed_entries"]
+    )
 
     # Headline census: the full 8-device, 100k-acceptor program — the
     # group-local-write-path claim as a compile-time fact.
@@ -574,6 +626,11 @@ def _multichip_inner() -> None:
         "host_physical_cores": os.cpu_count(),
         "weak_scaling": weak,
         "strong_scaling_100k": strong,
+        # The kernels x mesh legs (PR 8): grid-vote plane engaged under
+        # shard_map at every mesh size, plus the bit-exactness
+        # cross-check against the reference program.
+        "kernels_on_matrix": kernels_on,
+        "kernels_vs_reference_committed_match": kernels_match,
         "collective_census_8dev_100k": census,
         "scaling": {
             "basis": (
@@ -620,12 +677,12 @@ def _multichip_main() -> None:
     try:
         proc = subprocess.run(
             argv, env=env, cwd=_REPO, capture_output=True, text=True,
-            timeout=900.0,
+            timeout=1800.0,
         )
     except subprocess.TimeoutExpired:
         print(json.dumps({
             "metric": "compartmentalized multichip scaling",
-            "ok": False, "notes": "timeout after 900s",
+            "ok": False, "notes": "timeout after 1800s",
         }))
         sys.exit(0)
     for line in reversed(proc.stdout.splitlines()):

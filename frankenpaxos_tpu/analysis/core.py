@@ -23,7 +23,7 @@ from frankenpaxos_tpu.analysis import astutil
 # also compiles the sharded run_ticks wrappers (parallel/sharding.py
 # registry) and requires alias coverage under a mesh; the backend
 # inventory floor rose to 14 (compartmentalized).
-ANALYSIS_VERSION = "1.2"
+ANALYSIS_VERSION = "1.3"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

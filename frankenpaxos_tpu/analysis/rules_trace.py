@@ -22,6 +22,17 @@ AST layer structurally cannot see.
   the tick regressed to per-plane dispatch (an HBM round trip between
   planes); zero means the megakernel silently fell back to the
   reference. The reference-mode trace is asserted pallas-free.
+* ``trace-shardmap-kernel`` — the kernels x mesh composition contract
+  (parallel/sharding.py): for every sharding-registry backend with
+  registered planes, the SHARDED wrapper traced with the kernel policy
+  engaged must contain the Pallas call(s) (shard_map actually lowered
+  the kernels — zero means a silent reference fallback), the compiled
+  kernels-engaged program must introduce NO signed-state collective
+  beyond the <=64-element stat reductions tests/test_multichip.py
+  already allowlists (a bigger one means a ShardSpec mis-declared an
+  axis and shard_map is gathering state), and the reference-mode trace
+  must stay pallas-free. Needs >=2 devices (scripts/lint.sh forces an
+  8-virtual-device CPU host; pytest's conftest does the same).
 
 All jax imports live inside the checks so the AST layer stays
 importable without jax.
@@ -316,11 +327,11 @@ def check_donation_alias(ctx: Context) -> List[Finding]:
             continue
         mod = _module(backend)
         cfg = mod.analysis_config()
-        # Pin the kernel policy to the reference twins: that is the
-        # only policy validate_policy admits at mesh > 1 (and therefore
-        # the program a sharded run would actually compile) — with the
-        # default "auto" policy this rule would otherwise ValueError on
-        # any multi-device TPU host, where auto resolves to Pallas.
+        # Pin the kernel policy to the reference twins: the donation
+        # contract must hold on the plain-GSPMD program independent of
+        # the shard_map kernel lowering (whose own contract is
+        # trace-shardmap-kernel's job; donation under kernels-engaged
+        # meshes is pinned by tests/test_multichip.py).
         if hasattr(cfg, "kernels"):
             import dataclasses as _dc
 
@@ -415,6 +426,188 @@ def check_fused_tick(ctx: Context) -> List[Finding]:
                 key=f"multipaxos:reference:{n_ref}",
             )
         )
+    return out
+
+
+def _sharded_wrapper_eqns(backend: str, cfg, mesh) -> list:
+    """Jaxpr equations of the backend's run_ticks body traced exactly
+    as ``parallel.sharding.run_ticks_sharded`` traces it: under the
+    registry's shard_lowering context, so engaged kernel planes lower
+    through jax.shard_map (tracing is shape-only — no device memory)."""
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.ops import registry as _registry
+    from frankenpaxos_tpu.parallel import sharding as _sh
+
+    mod = _module(backend)
+    state = mod.init_state(cfg)
+    wrap = _sh._wrap_mesh(backend, cfg, mesh)
+
+    def run(s, t0, k):
+        with _registry.shard_lowering(wrap, _sh.GROUP_AXIS):
+            return mod.run_ticks.__wrapped__(cfg, s, t0, _TICKS, k)
+
+    closed = jax.make_jaxpr(run)(
+        state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0)
+    )
+    eqns: list = []
+    _walk_eqns(closed.jaxpr, eqns)
+    return eqns
+
+
+_COLLECTIVE_TOKENS = (
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter",
+)
+
+
+def _max_signed_collective_elems(hlo_text: str) -> int:
+    """Largest signed/pred result element count across the compiled
+    module's collectives (unsigned u32 shapes are threefry PRNG-sweep
+    assembly, counted by the multichip tests separately). Every shape
+    of a combined tuple-shaped collective is counted — XLA's combiner
+    can hide a large reduction behind a scalar first element."""
+    shape_re = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+    worst = 0
+    for line in hlo_text.splitlines():
+        op_at = [
+            line.index(tok + suffix)
+            for tok in _COLLECTIVE_TOKENS
+            for suffix in ("(", "-start(")
+            if (tok + suffix) in line
+        ]
+        eq_at = line.find("=")
+        if not op_at or eq_at < 0:
+            continue
+        for dtype, dims in shape_re.findall(line[eq_at: min(op_at)]):
+            if dtype.startswith("u"):
+                continue
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            worst = max(worst, elems)
+    return worst
+
+
+@rule(
+    "trace-shardmap-kernel",
+    "trace",
+    "sharded wrappers with the kernel policy engaged lower their "
+    "planes through shard_map (pallas_call present, no signed-state "
+    "collective beyond the <=64-element stat reductions); reference "
+    "mode stays pallas-free",
+)
+def check_shardmap_kernel(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.ops.registry import KernelPolicy
+    from frankenpaxos_tpu.parallel import sharding as _sh
+
+    out: List[Finding] = []
+    if len(jax.devices()) < 2:
+        # Single-device host: shard_map lowering never engages, so the
+        # contract is untestable here. scripts/lint.sh and the pytest
+        # conftest both force an 8-virtual-device CPU mesh, so the
+        # standard entry points always run the full check — but say so
+        # loudly when skipping, so a pre-set 1-device XLA_FLAGS can't
+        # silently disable the rule.
+        import sys
+
+        print(
+            "trace-shardmap-kernel: SKIPPED (needs >=2 jax devices; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "or run via scripts/lint.sh)",
+            file=sys.stderr,
+        )
+        return out
+    selected = _selected(ctx)
+    for backend, spec in sorted(_sh.SHARDINGS.items()):
+        if backend not in selected or spec.planes_backend is None:
+            continue
+        mod = _module(backend)
+        base = mod.analysis_config()
+        state = mod.init_state(base)
+        axis_len = spec.axis_len(state)
+        n_dev = max(
+            (
+                d
+                for d in range(1, min(len(jax.devices()), axis_len) + 1)
+                if axis_len % d == 0
+            ),
+            default=1,
+        )
+        if n_dev < 2:
+            continue
+        mesh = _sh.make_mesh(jax.devices()[:n_dev])
+
+        cfg_on = _dc.replace(base, kernels=KernelPolicy(mode="interpret"))
+        n_on = _count_pallas_calls(
+            _sharded_wrapper_eqns(backend, cfg_on, mesh)
+        )
+        if n_on < 1:
+            out.append(
+                Finding(
+                    rule="trace-shardmap-kernel",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"sharded {n_dev}-device wrapper with the "
+                        "kernel policy engaged traces 0 pallas_calls — "
+                        "the kernels silently fell back to the "
+                        "reference path instead of shard_map-lowering"
+                    ),
+                    key=f"{backend}:on:none",
+                )
+            )
+        # The compiled kernels-engaged program: no signed-state
+        # collective beyond the stat reductions (a bigger one means a
+        # ShardSpec axis is wrong and shard_map is moving state).
+        sharded = _sh.shard_state(backend, mod.init_state(cfg_on), mesh)
+        hlo = _sh.lower_sharded(
+            backend, cfg_on, mesh, sharded, jnp.zeros((), jnp.int32),
+            _TICKS, jax.random.PRNGKey(0),
+        ).compile().as_text()
+        worst = _max_signed_collective_elems(hlo)
+        if worst > 64:
+            out.append(
+                Finding(
+                    rule="trace-shardmap-kernel",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"kernels-engaged sharded program emits a "
+                        f"{worst}-element signed collective (allowed: "
+                        "<=64-element stat reductions) — a ShardSpec "
+                        "axis is mis-declared and shard_map is "
+                        "gathering simulation state"
+                    ),
+                    key=f"{backend}:collective:{worst}",
+                )
+            )
+        cfg_ref = _dc.replace(base, kernels=KernelPolicy.reference())
+        n_ref = _count_pallas_calls(
+            _sharded_wrapper_eqns(backend, cfg_ref, mesh)
+        )
+        if n_ref != 0:
+            out.append(
+                Finding(
+                    rule="trace-shardmap-kernel",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"sharded reference-mode wrapper traces {n_ref} "
+                        "pallas_call(s) — the reference path must stay "
+                        "pure jnp"
+                    ),
+                    key=f"{backend}:reference:{n_ref}",
+                )
+            )
     return out
 
 

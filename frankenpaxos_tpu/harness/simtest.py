@@ -138,10 +138,15 @@ def _specs() -> Dict[str, SimSpec]:
             lambda st: st.committed, partition_axis=9, crash_ok=False,
         ),
         SimSpec(
+            # Crash/revive drives the per-group proposer: a dead
+            # proposer admits nothing and re-sends nothing; a revival
+            # triggers the recovery election (instant re-broadcast of
+            # every pending command), so commits resume after revival
+            # — the liveness-after-revive schedule in
+            # tests/test_tpu_fastmultipaxos.py pins exactly that.
             "fastmultipaxos", fm,
             fm.analysis_config,
             lambda st: st.committed_slots, partition_axis=3,
-            crash_ok=False,
         ),
         SimSpec(
             "fastpaxos", fpx,

@@ -30,6 +30,11 @@ kernel SUITE with a dispatch registry:
   * :mod:`frankenpaxos_tpu.ops.craq` — ``craq_chain`` (chain
     propagate/ack with scatter-free pending-set accounting; partitioned
     plans defer cut hops to the heal tick in-kernel).
+  * :mod:`frankenpaxos_tpu.ops.compartmentalized` —
+    ``compartmentalized_grid_vote`` (the acceptor-grid hot path:
+    offset-clock aging, column-transversal write votes, every-row-voted
+    chosen detection, per-replica watermark advance, full-grid retry
+    re-sends — one VMEM-resident pass over the [R, C, G, W] grid).
 
 Every kernel is dtype-polymorphic (int16 rounds / int16 offset clocks /
 int8 statuses native — no widen/narrow casts at the boundary) and has a
@@ -80,4 +85,8 @@ from frankenpaxos_tpu.ops.scalog import (  # noqa: F401
 from frankenpaxos_tpu.ops.craq import (  # noqa: F401
     fused_craq_chain,
     reference_craq_chain,
+)
+from frankenpaxos_tpu.ops.compartmentalized import (  # noqa: F401
+    fused_grid_vote,
+    reference_grid_vote,
 )

@@ -1,0 +1,648 @@
+"""Fused Pallas kernel for the Compartmentalized MultiPaxos acceptor
+grid — the backend's hot path (``tpu/compartmentalized_batched.py``).
+
+One plane, ``compartmentalized_grid_vote``, covers the whole per-tick
+sweep over the wide ``[R, C, G, W]`` grid arrays plus the ``[NR, G, W]``
+replica commit plane:
+
+  * offset-clock AGING of the Phase2a / Phase2b / commit-broadcast
+    clocks (tpu/common.py delta encoding: 0 = arrives now),
+  * column-transversal WRITE VOTES: acceptors with a Phase2a arriving
+    now send Phase2b to the slot's proxy leader (idempotent min-write),
+  * EVERY-ROW-VOTED CHOSEN detection: a slot is chosen when every grid
+    row has a vote in (quorums/Grid.scala — any write transversal
+    intersects any read row), gated on the slot's proxy being alive,
+  * the commit broadcast arming (proxy -> every replica) and each
+    replica's PER-REPLICA WATERMARK advance (masked min over the
+    contiguous arrived prefix — no gather),
+  * RETRY RE-SEND: timed-out PROPOSED slots re-broadcast Phase2a to the
+    FULL grid (overwrite, not min-write — see the backend).
+
+In the unfused tick these steps are ~10 separate XLA sweeps that each
+re-read the two largest state arrays from HBM; here every ``[R, C, G,
+W]`` cell is read once and the vote/quorum intermediates never leave
+VMEM. The reference twin is EXACTLY the tick composition the backend
+executed before the plane was fused (the retry step commutes with the
+retire/sequencing steps between them — their write masks are disjoint
+by construction: retries touch only slots that stay PROPOSED, retires
+only CHOSEN ones, fresh sends only newly-allocated ones), pinned bit
+for bit by tests/test_ops.py and tests/test_kernel_registry.py.
+
+The grid cells R x C and the replica count NR are tiny static leading
+axes (static in-kernel loops, like the multipaxos acceptor axis); the
+group axis G grids over blocks and W rides the VPU lanes. Every array
+keeps its state dtype (int16 offset clocks, int8 statuses) — no
+boundary casts. The plane is group-local (no cross-group dataflow), so
+it declares a :class:`registry.ShardSpec` and lowers per-device under
+``jax.shard_map`` on a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import balanced_block, pad_axis, t_arr, t_space
+from frankenpaxos_tpu.tpu.common import age_clock
+
+# Mirrors of the backend's batch-slot codes (ops must not import the
+# backend). Cross-checked by tests/test_kernel_registry.py.
+EMPTY = 0
+PROPOSED = 1
+CHOSEN = 2
+
+# Group-axis position per positional argument / output of the plane
+# (None = scalar). ONE table drives the wrappers' padding, the output
+# slicing, AND the registration's ShardSpec, so the three can never
+# drift apart. Argument order: p2a, p2b, rep_arrival, status,
+# last_send, rep_exec, head, next_slot, alive_of_pos, p2b_del,
+# retry_del, p2b_lat, retry_lat, rep_lat, t. Output order: p2a, p2b,
+# rep_arrival, status, last_send, rep_exec, newly_chosen, timed_out,
+# votes_cast, votes_dropped.
+ARG_G_AXES = (2, 2, 1, 0, 0, 1, 0, 0, 0, 2, 2, 2, 2, 1, None)
+OUT_G_AXES = (2, 2, 1, 0, 0, 1, 0, 0, 0, 0)
+
+
+def _pad_args(args, pad):
+    """Pad every array argument's group axis up to a block multiple
+    (scalars pass through)."""
+    if not pad:
+        return args
+    return tuple(
+        x if ax is None else pad_axis(x, ax, pad)
+        for x, ax in zip(args, ARG_G_AXES)
+    )
+
+
+def _slice_outs(outs, G, pad):
+    """Slice the group-axis padding back off every output."""
+    if not pad:
+        return list(outs)
+    return [
+        x[(slice(None),) * ax + (slice(0, G),)]
+        for x, ax in zip(outs, OUT_G_AXES)
+    ]
+
+
+def _specs(pl, R, C, NR, bg, W, interpret):
+    """The shared BlockSpec vocabulary of the fused and unfused
+    wrappers: t (SMEM scalar), 4-D grid cells, replica planes, replica
+    watermarks, [G] vectors, [G, W] slot planes."""
+    return dict(
+        t=pl.BlockSpec((1,), lambda i: (0,), memory_space=t_space(interpret)),
+        rcgw=pl.BlockSpec((R, C, bg, W), lambda i: (0, 0, i, 0)),
+        ngw=pl.BlockSpec((NR, bg, W), lambda i: (0, i, 0)),
+        ng=pl.BlockSpec((NR, bg), lambda i: (0, i)),
+        g=pl.BlockSpec((bg,), lambda i: (i,)),
+        gw=pl.BlockSpec((bg, W), lambda i: (i, 0)),
+    )
+
+
+def reference_grid_vote(
+    p2a,  # [R, C, G, W] Phase2a offset clocks (RAW: aged in-plane)
+    p2b,  # [R, C, G, W] Phase2b offset clocks (RAW: aged in-plane)
+    rep_arrival,  # [NR, G, W] commit-broadcast clocks (RAW: aged in-plane)
+    status,  # [G, W] int8 EMPTY | PROPOSED | CHOSEN
+    last_send,  # [G, W] absolute ticks
+    rep_exec,  # [NR, G] per-replica executed watermarks
+    head,  # [G] ring heads
+    next_slot,  # [G] allocation frontiers
+    alive_of_pos,  # [G, W] bool: the slot's proxy leader is alive
+    p2b_del,  # [R, C, G, W] bool Phase2b fault-delivery mask
+    retry_del,  # [R, C, G, W] bool retry fault-delivery mask
+    p2b_lat,  # [R, C, G, W] int32 sampled latencies
+    retry_lat,  # [R, C, G, W] int32
+    rep_lat,  # [NR, G, W] int32
+    t,  # [] current tick
+    *,
+    retry_timeout: int,
+):
+    """The pure-jnp specification: exactly the backend's in-tick
+    composition of aging + votes + quorum/chosen + replica watermark +
+    retry (module docstring). Returns ``(p2a, p2b, rep_arrival, status,
+    last_send, rep_exec, newly_chosen, timed_out, votes_cast,
+    votes_dropped)`` — the two ``[G, W]`` vote counts feed the tick's
+    proxy-load and telemetry reductions without re-materializing the
+    ``[R, C, G, W]`` vote mask outside the plane."""
+    W = status.shape[1]
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    p2a = age_clock(p2a)
+    p2b = age_clock(p2b)
+    rep_arrival = age_clock(rep_arrival)
+
+    # Acceptors vote on Phase2a arrivals; votes fly back to the slot's
+    # proxy leader (idempotent min-write dedups duplicates).
+    voted_now = p2a == 0
+    p2b = jnp.where(
+        voted_now & p2b_del,
+        jnp.minimum(p2b, p2b_lat.astype(p2b.dtype)),
+        p2b,
+    )
+
+    # Chosen when EVERY row has a vote in (column-transversal quorum),
+    # collected by a live proxy.
+    votes_in = p2b <= 0
+    quorum = jnp.all(jnp.any(votes_in, axis=1), axis=0)  # [G, W]
+    newly_chosen = (status == PROPOSED) & quorum & alive_of_pos
+    status = jnp.where(newly_chosen, CHOSEN, status)
+    rep_arrival = jnp.where(
+        newly_chosen[None, :, :],
+        rep_lat.astype(rep_arrival.dtype),
+        rep_arrival,
+    )
+
+    # Per-replica watermark: each replica executes its contiguous
+    # arrived prefix (masked min-reduction, no gather).
+    ord_of_pos = (w_iota[None, :] - head[:, None]) % W  # [G, W]
+    live_ord = w_iota[None, :] < (next_slot - head)[:, None]
+    exec_ready = (status == CHOSEN)[None] & (rep_arrival <= 0)
+    ord_ready = exec_ready & live_ord[None]
+    first_gap = jnp.min(
+        jnp.where(ord_ready, W, ord_of_pos[None]), axis=2
+    )  # [NR, G]
+    rep_exec = jnp.maximum(rep_exec, head[None, :] + first_gap)
+
+    # Proxy retries: a timed-out PROPOSED slot re-broadcasts to the
+    # FULL grid. OVERWRITE (not min-write): an acceptor whose Phase2b
+    # was dropped has an already-arrived (saturated) p2a clock — only a
+    # fresh arrival makes it re-vote.
+    timed_out = (
+        (status == PROPOSED)
+        & (t - last_send >= retry_timeout)
+        & alive_of_pos
+    )
+    resend = timed_out[None, None] & retry_del
+    p2a = jnp.where(resend, retry_lat.astype(p2a.dtype), p2a)
+    last_send = jnp.where(timed_out, t, last_send)
+
+    votes_cast = jnp.sum(voted_now.astype(jnp.int32), axis=(0, 1))
+    votes_dropped = jnp.sum(
+        (voted_now & ~p2b_del).astype(jnp.int32), axis=(0, 1)
+    )
+    return (
+        p2a, p2b, rep_arrival, status, last_send, rep_exec,
+        newly_chosen, timed_out, votes_cast, votes_dropped,
+    )
+
+
+def _grid_vote_kernel_factory(retry_timeout, R, C, NR, bg, W):
+    def kernel(
+        t_ref,  # SMEM (1,)
+        p2a_ref,  # [R, C, BG, W]
+        p2b_ref,  # [R, C, BG, W]
+        rep_ref,  # [NR, BG, W]
+        status_ref,  # [BG, W] int8
+        ls_ref,  # [BG, W]
+        repexec_ref,  # [NR, BG]
+        head_ref,  # [BG]
+        next_ref,  # [BG]
+        alive_ref,  # [BG, W] int8
+        p2bdel_ref,  # [R, C, BG, W] int8
+        retrydel_ref,  # [R, C, BG, W] int8
+        p2blat_ref,  # [R, C, BG, W] int32
+        retrylat_ref,  # [R, C, BG, W] int32
+        replat_ref,  # [NR, BG, W] int32
+        out_p2a, out_p2b, out_rep, out_status, out_ls, out_repexec,
+        out_newly, out_timed, out_votes, out_dropped,
+    ):
+        import jax.lax as lax
+
+        t = t_ref[0]
+        head = head_ref[:]
+        alive = alive_ref[:] != 0
+        w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
+        ord_of_pos = (w_iota - head[:, None]) % W
+
+        # The R x C grid cells are tiny static loops: every [BG, W]
+        # cell slice is aged, voted, and quorum-accumulated while
+        # resident in VMEM — the HBM round trips of the ~10 unfused
+        # sweeps collapse into this one pass. The Phase2b result is
+        # final after the min-write, so it stores immediately; the
+        # aged p2a cells stay live across the choose section for the
+        # retry loop (aging happens exactly once per cell).
+        votes = jnp.zeros((bg, W), jnp.int32)
+        dropped = jnp.zeros((bg, W), jnp.int32)
+        quorum = None
+        p2a_aged = [[None] * C for _ in range(R)]
+        for r in range(R):
+            row_any = None
+            for c in range(C):
+                p2a = age_clock(p2a_ref[r, c])
+                p2b = age_clock(p2b_ref[r, c])
+                voted = p2a == 0
+                deliv = p2bdel_ref[r, c] != 0
+                p2b = jnp.where(
+                    voted & deliv,
+                    jnp.minimum(p2b, p2blat_ref[r, c].astype(p2b.dtype)),
+                    p2b,
+                )
+                votes = votes + voted.astype(jnp.int32)
+                dropped = dropped + (voted & ~deliv).astype(jnp.int32)
+                vin = p2b <= 0
+                row_any = vin if row_any is None else (row_any | vin)
+                out_p2b[r, c] = p2b
+                p2a_aged[r][c] = p2a
+            quorum = row_any if quorum is None else (quorum & row_any)
+
+        status = status_ref[:]
+        newly = (status == PROPOSED) & quorum & alive
+        status = jnp.where(newly, CHOSEN, status)
+
+        live_ord = w_iota < (next_ref[:] - head)[:, None]
+        chosen = status == CHOSEN
+        for n in range(NR):
+            rep = age_clock(rep_ref[n])
+            rep = jnp.where(newly, replat_ref[n].astype(rep.dtype), rep)
+            ready = chosen & (rep <= 0) & live_ord
+            first_gap = jnp.min(jnp.where(ready, W, ord_of_pos), axis=1)
+            out_repexec[n] = jnp.maximum(repexec_ref[n], head + first_gap)
+            out_rep[n] = rep
+
+        timed = (
+            (status == PROPOSED)
+            & (t - ls_ref[:] >= retry_timeout)
+            & alive
+        )
+        for r in range(R):
+            for c in range(C):
+                resend = timed & (retrydel_ref[r, c] != 0)
+                p2a = p2a_aged[r][c]
+                out_p2a[r, c] = jnp.where(
+                    resend, retrylat_ref[r, c].astype(p2a.dtype), p2a
+                )
+        out_status[:] = status
+        out_ls[:] = jnp.where(timed, t, ls_ref[:])
+        out_newly[:] = newly.astype(jnp.int8)
+        out_timed[:] = timed.astype(jnp.int8)
+        out_votes[:] = votes
+        out_dropped[:] = dropped
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "retry_timeout")
+)
+def fused_grid_vote(
+    p2a, p2b, rep_arrival, status, last_send, rep_exec, head, next_slot,
+    alive_of_pos, p2b_del, retry_del, p2b_lat, retry_lat, rep_lat, t,
+    block: int = 256,
+    interpret: bool = False,
+    retry_timeout: int = 8,
+):
+    """Fused :func:`reference_grid_vote`: aging + votes + quorum/chosen
+    + per-replica watermark + retry in ONE VMEM-resident pass per group
+    block."""
+    from jax.experimental import pallas as pl
+
+    R, C, G, W = p2a.shape
+    NR = rep_arrival.shape[0]
+    bg, pad = balanced_block(G, block)
+    (p2a, p2b, rep_arrival, status, last_send, rep_exec, head, next_slot,
+     alive_of_pos, p2b_del, retry_del, p2b_lat, retry_lat, rep_lat,
+     t) = _pad_args(
+        (p2a, p2b, rep_arrival, status, last_send, rep_exec, head,
+         next_slot, alive_of_pos, p2b_del, retry_del, p2b_lat,
+         retry_lat, rep_lat, t),
+        pad,
+    )
+    Gp = G + pad
+
+    i8 = jnp.int8
+    sp = _specs(pl, R, C, NR, bg, W, interpret)
+    grid_spec = pl.GridSpec(
+        grid=(Gp // bg,),
+        in_specs=(
+            [sp["t"]]
+            + [sp["rcgw"], sp["rcgw"], sp["ngw"]]  # p2a, p2b, rep_arrival
+            + [sp["gw"], sp["gw"], sp["ng"]]  # status, last_send, rep_exec
+            + [sp["g"], sp["g"], sp["gw"]]  # head, next_slot, alive
+            + [sp["rcgw"]] * 4  # p2b_del, retry_del, p2b_lat, retry_lat
+            + [sp["ngw"]]  # rep_lat
+        ),
+        out_specs=(
+            [sp["rcgw"], sp["rcgw"], sp["ngw"]]  # p2a, p2b, rep_arrival
+            + [sp["gw"], sp["gw"], sp["ng"]]  # status, last_send, rep_exec
+            + [sp["gw"]] * 4  # newly, timed, votes, dropped
+        ),
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((R, C, Gp, W), p2a.dtype),
+        jax.ShapeDtypeStruct((R, C, Gp, W), p2b.dtype),
+        jax.ShapeDtypeStruct((NR, Gp, W), rep_arrival.dtype),
+        jax.ShapeDtypeStruct((Gp, W), status.dtype),
+        jax.ShapeDtypeStruct((Gp, W), last_send.dtype),
+        jax.ShapeDtypeStruct((NR, Gp), rep_exec.dtype),
+        jax.ShapeDtypeStruct((Gp, W), i8),  # newly_chosen
+        jax.ShapeDtypeStruct((Gp, W), i8),  # timed_out
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # votes_cast
+        jax.ShapeDtypeStruct((Gp, W), jnp.int32),  # votes_dropped
+    ]
+    kernel = _grid_vote_kernel_factory(retry_timeout, R, C, NR, bg, W)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        t_arr(t),
+        p2a, p2b, rep_arrival,
+        status, last_send, rep_exec,
+        head, next_slot, alive_of_pos.astype(i8),
+        p2b_del.astype(i8), retry_del.astype(i8), p2b_lat, retry_lat,
+        rep_lat,
+    )
+    (p2a, p2b, rep_arrival, status, last_send, rep_exec,
+     newly, timed, votes_cast, votes_dropped) = _slice_outs(outs, G, pad)
+    return (
+        p2a, p2b, rep_arrival, status, last_send, rep_exec,
+        newly.astype(bool), timed.astype(bool), votes_cast, votes_dropped,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The UNFUSED kernel-path twin — the race baseline for the microbench
+# (harness/microbench.py `grid_vote`). Same semantics as
+# :func:`fused_grid_vote`, but split into the SIX passes the
+# reference tick's dataflow implies — clock aging, vote, the vote-count
+# re-read (the tick's proxy-load/telemetry reductions), quorum/choose,
+# replica watermark, retry — each its own ``pallas_call``, so the
+# [R, C, G, W] arrays round-trip HBM between passes exactly where the
+# unfused tick re-reads them. Racing fused against this through the
+# SAME execution vehicle (interpret mode on CPU, compiled on TPU)
+# prices the fusion itself — the discipline the whole-tick megakernel
+# race established (results/kernel_microbench_r10.json). Not a
+# registered plane: nothing dispatches it; it exists to be beaten.
+# ---------------------------------------------------------------------------
+
+
+def _uf_age_kernel_factory(R, C, NR):
+    def kernel(p2a_ref, p2b_ref, rep_ref, out_p2a, out_p2b, out_rep):
+        for r in range(R):
+            for c in range(C):
+                out_p2a[r, c] = age_clock(p2a_ref[r, c])
+                out_p2b[r, c] = age_clock(p2b_ref[r, c])
+        for n in range(NR):
+            out_rep[n] = age_clock(rep_ref[n])
+
+    return kernel
+
+
+def _uf_vote_kernel_factory(R, C):
+    def kernel(p2a_ref, p2b_ref, p2bdel_ref, p2blat_ref, out_p2b):
+        for r in range(R):
+            for c in range(C):
+                voted = p2a_ref[r, c] == 0
+                deliv = p2bdel_ref[r, c] != 0
+                p2b = p2b_ref[r, c]
+                out_p2b[r, c] = jnp.where(
+                    voted & deliv,
+                    jnp.minimum(p2b, p2blat_ref[r, c].astype(p2b.dtype)),
+                    p2b,
+                )
+
+    return kernel
+
+
+def _uf_counts_kernel_factory(R, C):
+    # The unfused tick re-derives the vote mask for its proxy-load and
+    # telemetry reductions (the fused plane exports votes_cast/
+    # votes_dropped precisely to delete this re-read — the max_ord
+    # argument of the megakernel): a full second sweep over the p2a
+    # plane.
+    def kernel(p2a_ref, p2bdel_ref, out_votes, out_dropped):
+        votes = None
+        dropped = None
+        for r in range(R):
+            for c in range(C):
+                voted = p2a_ref[r, c] == 0
+                deliv = p2bdel_ref[r, c] != 0
+                v = voted.astype(jnp.int32)
+                d = (voted & ~deliv).astype(jnp.int32)
+                votes = v if votes is None else votes + v
+                dropped = d if dropped is None else dropped + d
+        out_votes[:] = votes
+        out_dropped[:] = dropped
+
+    return kernel
+
+
+def _uf_choose_kernel_factory(R, C):
+    def kernel(p2b_ref, status_ref, alive_ref, out_status, out_newly):
+        quorum = None
+        for r in range(R):
+            row_any = None
+            for c in range(C):
+                vin = p2b_ref[r, c] <= 0
+                row_any = vin if row_any is None else (row_any | vin)
+            quorum = row_any if quorum is None else (quorum & row_any)
+        status = status_ref[:]
+        newly = (status == PROPOSED) & quorum & (alive_ref[:] != 0)
+        out_status[:] = jnp.where(newly, CHOSEN, status)
+        out_newly[:] = newly.astype(jnp.int8)
+
+    return kernel
+
+
+def _uf_replica_kernel_factory(NR, bg, W):
+    def kernel(rep_ref, status_ref, newly_ref, repexec_ref,
+               head_ref, next_ref, replat_ref, out_rep, out_repexec):
+        import jax.lax as lax
+
+        head = head_ref[:]
+        w_iota = lax.broadcasted_iota(jnp.int32, (bg, W), 1)
+        ord_of_pos = (w_iota - head[:, None]) % W
+        live_ord = w_iota < (next_ref[:] - head)[:, None]
+        newly = newly_ref[:] != 0
+        chosen = status_ref[:] == CHOSEN
+        for n in range(NR):
+            rep = rep_ref[n]
+            rep = jnp.where(newly, replat_ref[n].astype(rep.dtype), rep)
+            ready = chosen & (rep <= 0) & live_ord
+            first_gap = jnp.min(jnp.where(ready, W, ord_of_pos), axis=1)
+            out_repexec[n] = jnp.maximum(repexec_ref[n], head + first_gap)
+            out_rep[n] = rep
+
+    return kernel
+
+
+def _uf_retry_kernel_factory(retry_timeout, R, C):
+    def kernel(t_ref, p2a_ref, status_ref, ls_ref, alive_ref,
+               retrydel_ref, retrylat_ref, out_p2a, out_ls, out_timed):
+        t = t_ref[0]
+        timed = (
+            (status_ref[:] == PROPOSED)
+            & (t - ls_ref[:] >= retry_timeout)
+            & (alive_ref[:] != 0)
+        )
+        for r in range(R):
+            for c in range(C):
+                resend = timed & (retrydel_ref[r, c] != 0)
+                p2a = p2a_ref[r, c]
+                out_p2a[r, c] = jnp.where(
+                    resend, retrylat_ref[r, c].astype(p2a.dtype), p2a
+                )
+        out_ls[:] = jnp.where(timed, t, ls_ref[:])
+        out_timed[:] = timed.astype(jnp.int8)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "interpret", "retry_timeout")
+)
+def unfused_grid_vote(
+    p2a, p2b, rep_arrival, status, last_send, rep_exec, head, next_slot,
+    alive_of_pos, p2b_del, retry_del, p2b_lat, retry_lat, rep_lat, t,
+    block: int = 256,
+    interpret: bool = False,
+    retry_timeout: int = 8,
+):
+    """Six-pass kernel-path twin of :func:`fused_grid_vote` (race
+    baseline; module comment above). Identical signature and outputs."""
+    from jax.experimental import pallas as pl
+
+    R, C, G, W = p2a.shape
+    NR = rep_arrival.shape[0]
+    bg, pad = balanced_block(G, block)
+    (p2a, p2b, rep_arrival, status, last_send, rep_exec, head, next_slot,
+     alive_of_pos, p2b_del, retry_del, p2b_lat, retry_lat, rep_lat,
+     t) = _pad_args(
+        (p2a, p2b, rep_arrival, status, last_send, rep_exec, head,
+         next_slot, alive_of_pos, p2b_del, retry_del, p2b_lat,
+         retry_lat, rep_lat, t),
+        pad,
+    )
+    Gp = G + pad
+
+    i8 = jnp.int8
+    sp = _specs(pl, R, C, NR, bg, W, interpret)
+    spec4, spec3, spec2 = sp["rcgw"], sp["ngw"], sp["ng"]
+    spec_g, spec_gw, spec_t = sp["g"], sp["gw"], sp["t"]
+    grid = (Gp // bg,)
+
+    # Pass 1: clock aging (the tick's step-0 sweep).
+    p2a_aged, p2b_aged, rep_aged = pl.pallas_call(
+        _uf_age_kernel_factory(R, C, NR),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec4, spec4, spec3],
+            out_specs=[spec4, spec4, spec3],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C, Gp, W), p2a.dtype),
+            jax.ShapeDtypeStruct((R, C, Gp, W), p2b.dtype),
+            jax.ShapeDtypeStruct((NR, Gp, W), rep_arrival.dtype),
+        ],
+        interpret=interpret,
+    )(p2a, p2b, rep_arrival)
+
+    # Pass 2: acceptor votes (Phase2b min-write).
+    i8_p2b_del = p2b_del.astype(i8)
+    p2b_new = pl.pallas_call(
+        _uf_vote_kernel_factory(R, C),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec4, spec4, spec4, spec4],
+            out_specs=spec4,
+        ),
+        out_shape=jax.ShapeDtypeStruct((R, C, Gp, W), p2b.dtype),
+        interpret=interpret,
+    )(p2a_aged, p2b_aged, i8_p2b_del, p2b_lat)
+
+    # Pass 2b: the vote-mask re-read the unfused tick pays for its
+    # proxy-load/telemetry reductions.
+    votes, dropped = pl.pallas_call(
+        _uf_counts_kernel_factory(R, C),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec4, spec4],
+            out_specs=[spec_gw, spec_gw],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((Gp, W), jnp.int32),
+            jax.ShapeDtypeStruct((Gp, W), jnp.int32),
+        ],
+        interpret=interpret,
+    )(p2a_aged, i8_p2b_del)
+
+    # Pass 3: quorum count -> Chosen (re-reads the whole p2b plane).
+    alive8 = alive_of_pos.astype(i8)
+    status_new, newly = pl.pallas_call(
+        _uf_choose_kernel_factory(R, C),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec4, spec_gw, spec_gw],
+            out_specs=[spec_gw, spec_gw],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((Gp, W), status.dtype),
+            jax.ShapeDtypeStruct((Gp, W), i8),
+        ],
+        interpret=interpret,
+    )(p2b_new, status, alive8)
+
+    # Pass 4: commit-broadcast arming + per-replica watermark.
+    rep_new, rep_exec_new = pl.pallas_call(
+        _uf_replica_kernel_factory(NR, bg, W),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec3, spec_gw, spec_gw, spec2,
+                      spec_g, spec_g, spec3],
+            out_specs=[spec3, spec2],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((NR, Gp, W), rep_arrival.dtype),
+            jax.ShapeDtypeStruct((NR, Gp), rep_exec.dtype),
+        ],
+        interpret=interpret,
+    )(rep_aged, status_new, newly, rep_exec, head, next_slot, rep_lat)
+
+    # Pass 5: retry re-send (re-reads the whole p2a plane).
+    p2a_final, ls_new, timed = pl.pallas_call(
+        _uf_retry_kernel_factory(retry_timeout, R, C),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[spec_t, spec4, spec_gw, spec_gw, spec_gw,
+                      spec4, spec4],
+            out_specs=[spec4, spec_gw, spec_gw],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C, Gp, W), p2a.dtype),
+            jax.ShapeDtypeStruct((Gp, W), last_send.dtype),
+            jax.ShapeDtypeStruct((Gp, W), i8),
+        ],
+        interpret=interpret,
+    )(t_arr(t), p2a_aged, status_new, last_send, alive8,
+      retry_del.astype(i8), retry_lat)
+
+    outs = [p2a_final, p2b_new, rep_new, status_new, ls_new,
+            rep_exec_new, newly, timed, votes, dropped]
+    (p2a_final, p2b_new, rep_new, status_new, ls_new, rep_exec_new,
+     newly, timed, votes, dropped) = _slice_outs(outs, G, pad)
+    return (
+        p2a_final, p2b_new, rep_new, status_new, ls_new, rep_exec_new,
+        newly.astype(bool), timed.astype(bool), votes, dropped,
+    )
+
+
+registry.register(
+    registry.Plane(
+        name="compartmentalized_grid_vote",
+        backend="compartmentalized",
+        reference=reference_grid_vote,
+        kernel=fused_grid_vote,
+        key_of=lambda args: args[0].shape,  # p2a: (R, C, G, W)
+        batch_axis=2,  # grids over G
+        default_block=256,
+        # Group-local end to end: grid cells, replica planes, and every
+        # [G, W] mask shard along G — per-device lowering is exact. The
+        # axes are the same tables the wrappers pad/slice with.
+        shard=registry.ShardSpec(
+            arg_axes=ARG_G_AXES, out_axes=OUT_G_AXES
+        ),
+    )
+)

@@ -497,6 +497,7 @@ def reference_mp_dispatch(
     p2a_lat,  # [A, G, W] clock dtype
     retry_lat,  # [A, G, W] clock dtype
     rep_lat,  # [G, W] int32
+    group_ids,  # [G] int32 GLOBAL group ids (fresh proposal values)
     t,  # [] current tick
     *,
     f: int,
@@ -510,6 +511,11 @@ def reference_mp_dispatch(
     into the freed window (Leader.scala:331-407) with their Phase2a
     fan-out, and timeout resends. [G]-space control (proposal caps,
     retry gates) is decided OUTSIDE and enters via ``cap``/``retry_ok``.
+    ``group_ids`` carries each row's GLOBAL group id (the tick passes
+    ``arange(G)``): fresh proposals encode ``slot * num_groups + g``,
+    and under ``jax.shard_map`` a device sees only its slice of the
+    arange — deriving ids from local positions would re-number every
+    shard from zero.
 
     Returns a 21-tuple; see the wrapper for the order."""
     G, W = num_groups, status.shape[1]
@@ -550,7 +556,7 @@ def reference_mp_dispatch(
     is_new = delta < count[:, None]
     new_next = next_slot + count
     status = jnp.where(is_new, PROPOSED, status)
-    g_ids = jnp.arange(G, dtype=jnp.int32)[:, None]
+    g_ids = group_ids[:, None]
     new_value = ((next_slot[:, None] + delta) * G + g_ids) & 0x7FFFFFFF
     slot_value = jnp.where(is_new, new_value, slot_value)
     propose_tick = jnp.where(is_new, t, propose_tick)
@@ -574,16 +580,18 @@ def reference_mp_dispatch(
 
 
 def _dispatch_slots(
-    t, base, status, sv_in, pt, ls, ct, cr, cv, ra, rep_lat,
+    t, gids, status, sv_in, pt, ls, ct, cr, cv, ra, rep_lat,
     nvotes, head, next_slot, lr, cap, rok,
     *, f, retry_timeout, num_groups, bg, W,
 ):
     """The dispatch plane's slot-space body on [BG, W] values — the
     shared in-kernel program of the dispatch kernel and the megakernel.
-    ``lr`` is [BG, 1]; ``rok`` an int8 [BG] mask; ``base`` the block's
-    first group id (``pl.program_id(0) * bg``). Returns the updated slot
-    arrays plus the masks the per-acceptor writes and the tick's stat
-    reductions need."""
+    ``lr`` is [BG, 1]; ``rok`` an int8 [BG] mask; ``gids`` the block's
+    [BG] GLOBAL group ids (the wrapper's ``group_ids`` input sliced by
+    the grid — under shard_map these are the device's slice of the
+    global arange, which block-local iotas could not reconstruct).
+    Returns the updated slot arrays plus the masks the per-acceptor
+    writes and the tick's stat reductions need."""
     import jax.lax as lax
 
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
@@ -621,7 +629,7 @@ def _dispatch_slots(
     is_new = delta < count[:, None]
     new_next = next_slot + count
     status = jnp.where(is_new, PROPOSED, status)
-    g_ids = base + lax.broadcasted_iota(jnp.int32, (bg, W), 0)
+    g_ids = gids[:, None]
     new_value = (
         (next_slot[:, None] + delta) * num_groups + g_ids
     ) & 0x7FFFFFFF
@@ -666,7 +674,7 @@ def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
         ct_ref, cr_ref, cv_ref, ra_ref,  # [BG, W]
         p2a_ref, p2b_ref, vr_ref, vv_ref,  # [A, BG, W]
         nv_ref, rep_lat_ref,  # [BG, W]
-        head_ref, next_ref, lr_ref, cap_ref, rok_ref,  # [BG]
+        head_ref, next_ref, lr_ref, cap_ref, rok_ref, gid_ref,  # [BG]
         sok_ref, rdel_ref, p2a_lat_ref, retry_lat_ref,  # [A, BG, W]
         out_status, out_sv, out_pt, out_ls,
         out_ct, out_cr, out_cv, out_ra,
@@ -674,8 +682,6 @@ def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
         out_head, out_next, out_count, out_nret,
         out_newly, out_retire, out_isnew, out_timed, out_lat,
     ):
-        from jax.experimental import pallas as pl
-
         t = t_ref[0]
         A = p2a_ref.shape[0]
         (
@@ -683,7 +689,7 @@ def _mp_dispatch_kernel_factory(f, retry_timeout, num_groups, bg, W):
             new_head, new_next, count, n_retire,
             newly_chosen, retire_mask, is_new, timed_out, latency,
         ) = _dispatch_slots(
-            t, pl.program_id(0) * bg,
+            t, gid_ref[:],
             status_ref[:], sv_ref[:], pt_ref[:], ls_ref[:],
             ct_ref[:], cr_ref[:], cv_ref[:], ra_ref[:], rep_lat_ref[:],
             nv_ref[:], head_ref[:], next_ref[:], lr_ref[:][:, None],
@@ -734,7 +740,7 @@ def fused_mp_dispatch(
     chosen_tick, chosen_round, chosen_value, replica_arrival,
     p2a_off, p2b_off, vote_round, vote_value,
     nvotes, head, next_slot, leader_round, cap, retry_ok,
-    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t,
     block: int = 256,
     interpret: bool = False,
     f: int = 1,
@@ -755,7 +761,7 @@ def fused_mp_dispatch(
         p2a_off, p2b_off, vote_round, vote_value, send_ok, retry_deliv,
         p2a_lat, retry_lat,
     ]
-    gv = [head, next_slot, leader_round, cap, retry_ok]
+    gv = [head, next_slot, leader_round, cap, retry_ok, group_ids]
     if pad:
         gw = [pad_axis(x, 0, pad) for x in gw]
         agw = [pad_axis(x, 1, pad) for x in agw]
@@ -764,7 +770,7 @@ def fused_mp_dispatch(
      chosen_round, chosen_value, replica_arrival, nvotes, rep_lat) = gw
     (p2a_off, p2b_off, vote_round, vote_value, send_ok, retry_deliv,
      p2a_lat, retry_lat) = agw
-    head, next_slot, leader_round, cap, retry_ok = gv
+    head, next_slot, leader_round, cap, retry_ok, group_ids = gv
     Gp = G + pad
 
     spec3 = pl.BlockSpec((A, bg, W), lambda i: (0, i, 0))
@@ -777,7 +783,7 @@ def fused_mp_dispatch(
             + [spec_gw] * 8  # status..replica_arrival
             + [spec3] * 4  # p2a, p2b, vote_round, vote_value
             + [spec_gw] * 2  # nvotes, rep_lat
-            + [spec_g] * 5  # head, next_slot, leader_round, cap, retry_ok
+            + [spec_g] * 6  # head, next_slot, lr, cap, retry_ok, gids
             + [spec3] * 4  # send_ok, retry_deliv, p2a_lat, retry_lat
         ),
         out_specs=(
@@ -823,6 +829,7 @@ def fused_mp_dispatch(
         p2a_off, p2b_off, vote_round, vote_value,
         nvotes, rep_lat,
         head, next_slot, leader_round, cap, retry_ok.astype(i8),
+        group_ids,
         send_ok.astype(i8), retry_deliv.astype(i8), p2a_lat, retry_lat,
     )
     if pad:
@@ -877,6 +884,7 @@ def reference_fused_tick(
     p2a_lat,  # [A, G, W] clock dtype
     retry_lat,  # [A, G, W] clock dtype
     rep_lat,  # [G, W] int32
+    group_ids,  # [G] int32 GLOBAL group ids (fresh proposal values)
     t,  # []
     *,
     f: int,
@@ -905,7 +913,7 @@ def reference_fused_tick(
         chosen_tick, chosen_round, chosen_value, replica_arrival,
         p2a_off, p2b, vr, vv,
         nvotes, head, next_slot, leader_round, cap, retry_ok,
-        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t,
         f=f, retry_timeout=retry_timeout, num_groups=num_groups,
     )
     return (*outs, accr, nsends, max_ord)
@@ -917,7 +925,7 @@ def _fused_tick_kernel_factory(f, retry_timeout, num_groups, age, bg, W):
         p2a_ref, accr_ref, lr_ref, sv_ref,  # vote-plane inputs
         vr_ref, vv_ref, p2b_ref, p2b_lat_ref, deliv_ref, head_ref,
         status_ref, pt_ref, ls_ref, ct_ref,  # dispatch-plane inputs
-        cr_ref, cv_ref, ra_ref, next_ref, cap_ref, rok_ref,
+        cr_ref, cv_ref, ra_ref, next_ref, cap_ref, rok_ref, gid_ref,
         sok_ref, rdel_ref, p2a_lat_ref, retry_lat_ref, rep_lat_ref,
         out_status, out_sv, out_pt, out_ls,
         out_ct, out_cr, out_cv, out_ra,
@@ -927,7 +935,6 @@ def _fused_tick_kernel_factory(f, retry_timeout, num_groups, age, bg, W):
         out_accr, out_ns, out_maxord,
     ):
         import jax.lax as lax
-        from jax.experimental import pallas as pl
 
         t = t_ref[0]
         A = p2a_ref.shape[0]
@@ -974,7 +981,7 @@ def _fused_tick_kernel_factory(f, retry_timeout, num_groups, age, bg, W):
             new_head, new_next, count, n_retire,
             newly_chosen, retire_mask, is_new, timed_out, latency,
         ) = _dispatch_slots(
-            t, pl.program_id(0) * bg,
+            t, gid_ref[:],
             status_ref[:], sv_in, pt_ref[:], ls_ref[:],
             ct_ref[:], cr_ref[:], cv_ref[:], ra_ref[:], rep_lat_ref[:],
             nvotes, head, next_ref[:], lr, cap_ref[:], rok_ref[:],
@@ -1024,7 +1031,7 @@ def fused_tick(
     vote_round, vote_value, p2b_off, p2b_lat, p2b_delivered, head,
     status, propose_tick, last_send, chosen_tick,
     chosen_round, chosen_value, replica_arrival, next_slot, cap, retry_ok,
-    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+    send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t,
     block: int = 128,
     interpret: bool = False,
     f: int = 1,
@@ -1047,7 +1054,7 @@ def fused_tick(
         slot_value, status, propose_tick, last_send, chosen_tick,
         chosen_round, chosen_value, replica_arrival, rep_lat,
     ]
-    gv = [leader_round, head, next_slot, cap, retry_ok]
+    gv = [leader_round, head, next_slot, cap, retry_ok, group_ids]
     ag = [acc_round]
     if pad:
         agw = [pad_axis(x, 1, pad) for x in agw]
@@ -1058,7 +1065,7 @@ def fused_tick(
      send_ok, retry_deliv, p2a_lat, retry_lat) = agw
     (slot_value, status, propose_tick, last_send, chosen_tick,
      chosen_round, chosen_value, replica_arrival, rep_lat) = gw
-    leader_round, head, next_slot, cap, retry_ok = gv
+    leader_round, head, next_slot, cap, retry_ok, group_ids = gv
     (acc_round,) = ag
     Gp = G + pad
 
@@ -1074,7 +1081,7 @@ def fused_tick(
             + [spec3] * 4  # vote_round, vote_value, p2b, p2b_lat
             + [spec3, spec_g]  # delivered, head
             + [spec_gw] * 7  # status .. replica_arrival
-            + [spec_g] * 3  # next_slot, cap, retry_ok
+            + [spec_g] * 4  # next_slot, cap, retry_ok, gids
             + [spec3] * 4  # send_ok, retry_deliv, p2a_lat, retry_lat
             + [spec_gw]  # rep_lat
         ),
@@ -1129,7 +1136,7 @@ def fused_tick(
         p2b_delivered.astype(i8), head,
         status, propose_tick, last_send, chosen_tick,
         chosen_round, chosen_value, replica_arrival,
-        next_slot, cap, retry_ok.astype(i8),
+        next_slot, cap, retry_ok.astype(i8), group_ids,
         send_ok.astype(i8), retry_deliv.astype(i8), p2a_lat, retry_lat,
         rep_lat,
     )
@@ -1161,6 +1168,12 @@ def fused_tick(
 # Registration
 # ---------------------------------------------------------------------------
 
+# ShardSpecs (registry.ShardSpec): every MultiPaxos plane is group-local
+# — no cross-group dataflow anywhere — so each declares, per positional
+# arg/output, where the group axis sits ([A, G, W] -> 1, [G, ...] -> 0,
+# scalars -> None) and the sharding layer lowers the kernel per-device
+# via jax.shard_map instead of rejecting the policy at mesh > 1.
+
 registry.register(
     registry.Plane(
         name="multipaxos_vote_quorum",
@@ -1170,6 +1183,10 @@ registry.register(
         key_of=lambda args: args[0].shape,  # (A, G, W)
         batch_axis=1,  # grids over G
         default_block=256,
+        shard=registry.ShardSpec(
+            arg_axes=(1, 1, 0, 0, 1, 1, 1, 1, 1, 0),
+            out_axes=(1, 1, 1, 1, 0, 0, 1),
+        ),
     )
 )
 
@@ -1182,6 +1199,10 @@ registry.register(
         key_of=lambda args: args[1].shape,  # vote_round: (A, G, W)
         batch_axis=1,  # grids over G
         default_block=256,
+        shard=registry.ShardSpec(
+            arg_axes=(0, 1, 1, 0, 1, 1, 0, 0, 1, 1, None),
+            out_axes=(0, 1, 1, 0),
+        ),
     )
 )
 
@@ -1194,6 +1215,21 @@ registry.register(
         key_of=lambda args: args[8].shape,  # p2a_off: (A, G, W)
         batch_axis=1,  # grids over G
         default_block=256,
+        shard=registry.ShardSpec(
+            arg_axes=(
+                0, 0, 0, 0, 0, 0, 0, 0,  # status..replica_arrival
+                1, 1, 1, 1,  # p2a, p2b, vote_round, vote_value
+                0, 0, 0, 0, 0, 0,  # nvotes, head, next, lr, cap, retry_ok
+                1, 1, 1, 1,  # send_ok, retry_deliv, p2a_lat, retry_lat
+                0, 0, None,  # rep_lat, group_ids, t
+            ),
+            out_axes=(
+                0, 0, 0, 0, 0, 0, 0, 0,  # status..replica_arrival
+                1, 1, 1, 1,  # p2a, p2b, vote_round, vote_value
+                0, 0, 0, 0,  # head, next, count, n_retire
+                0, 0, 0, 0, 0,  # newly, retire, is_new, timed, latency
+            ),
+        ),
     )
 )
 
@@ -1209,5 +1245,22 @@ registry.register(
         # tick's arrays at once): a smaller default block; the autotune
         # table overrides per shape.
         default_block=128,
+        shard=registry.ShardSpec(
+            arg_axes=(
+                1, 1, 0, 0,  # p2a, acc_round, leader_round, slot_value
+                1, 1, 1, 1, 1, 0,  # vr, vv, p2b, p2b_lat, deliv, head
+                0, 0, 0, 0, 0, 0, 0,  # status..replica_arrival
+                0, 0, 0,  # next_slot, cap, retry_ok
+                1, 1, 1, 1,  # send_ok, retry_deliv, p2a_lat, retry_lat
+                0, 0, None,  # rep_lat, group_ids, t
+            ),
+            out_axes=(
+                0, 0, 0, 0, 0, 0, 0, 0,  # status..replica_arrival
+                1, 1, 1, 1,  # p2a, p2b, vote_round, vote_value
+                0, 0, 0, 0,  # head, next, count, n_retire
+                0, 0, 0, 0, 0,  # newly, retire, is_new, timed, latency
+                1, 0, 1,  # acc_round, nsends, max_ord
+            ),
+        ),
     )
 )

@@ -34,15 +34,24 @@ collective census. All simulation state is integer, and integer psums
 are associative exactly, so sharded runs are BIT-IDENTICAL to
 unsharded runs at any mesh size (also pinned by the tests).
 
-Kernel policy x mesh: Pallas planes have no SPMD partitioning rule, so
-a config whose :class:`KernelPolicy` resolves any plane off the
-reference path under a mesh of >1 devices would silently mis-lower (the
-kernel runs replicated or partitions wrong). :func:`validate_policy`
-rejects that combination with a ``ValueError`` instead; at mesh size 1
-any policy is allowed (sharded-vs-unsharded bit-identity with the
-kernels engaged is pinned by ``tests/test_multichip.py``). On CPU the
-default ``auto`` policy already resolves every plane to its reference
-twin, so sharded CPU runs need no config change.
+Kernel policy x mesh — the kernels x mesh COMPOSITION layer: Pallas
+planes have no SPMD partitioning rule, so GSPMD alone cannot partition
+an engaged kernel. Instead of rejecting the combination, the sharded
+runners trace under ``ops.registry.shard_lowering(mesh)``: every
+engaged plane that declares a ``ShardSpec`` (all planes are group-local
+— no cross-group dataflow) lowers through ``jax.shard_map`` over the
+group axis, so each device runs the kernel on its local ``[*, G/D, *]``
+shard with the block size autotuned for the PER-DEVICE shape (the
+table's nearest-G fallback). Sharded+kernels runs are BIT-IDENTICAL to
+unsharded+kernels and to the reference (pinned 3-seed by
+``tests/test_multichip.py``; the ``trace-shardmap-kernel`` analysis
+rule pins the lowering shape). :func:`validate_policy` still raises,
+but only for planes whose registration declares them NON-shardable
+(``shard=None`` — e.g. a future cross-group reduction that would need
+in-kernel collectives); at mesh size 1 nothing wraps and any policy is
+allowed. On CPU the default ``auto`` policy resolves every plane to
+its reference twin, so sharded CPU runs engage kernels only when a
+policy asks for them (mode="interpret"/"on").
 """
 
 from __future__ import annotations
@@ -136,47 +145,78 @@ def shard_state(backend: str, state, mesh: Mesh):
     return type(state)(**out)
 
 
-def validate_policy(backend: str, cfg, mesh: Mesh) -> None:
-    """Reject kernel policies that would silently mis-lower under a
-    real mesh: with >1 devices, every registered plane of the backend
-    must resolve to its reference twin (Pallas has no SPMD partitioning
-    rule). Mesh size 1 allows any policy."""
-    if mesh.devices.size <= 1:
-        return
+def _engaged_planes(backend: str, cfg) -> Dict[str, str]:
+    """Registered planes of ``backend`` the policy resolves OFF the
+    reference path on the current jax backend: name -> mode."""
     spec = SHARDINGS[backend]
     if spec.planes_backend is None:
-        return
+        return {}
     from frankenpaxos_tpu.ops import registry
 
-    offending = {
+    return {
         name: registry.resolve_mode(name, cfg)
         for name, plane in registry.PLANES.items()
         if plane.backend == spec.planes_backend
         and registry.resolve_mode(name, cfg) != "reference"
     }
-    if offending:
+
+
+def validate_policy(backend: str, cfg, mesh: Mesh) -> None:
+    """Validate the KernelPolicy x mesh combination. Engaged planes
+    with a :class:`registry.ShardSpec` lower per-device via
+    ``jax.shard_map`` (module docstring) — allowed at any mesh size.
+    Engaged planes WITHOUT one (declared non-shardable: they would need
+    in-kernel collectives) raise a ``ValueError`` at mesh > 1 instead
+    of silently mis-lowering. Mesh size 1 allows any policy."""
+    if mesh.devices.size <= 1:
+        return
+    from frankenpaxos_tpu.ops import registry
+
+    unshardable = {
+        name: mode
+        for name, mode in _engaged_planes(backend, cfg).items()
+        if registry.PLANES[name].shard is None
+    }
+    if unshardable:
         raise ValueError(
-            f"KernelPolicy resolves plane(s) {offending} off the "
-            f"reference path under a {mesh.devices.size}-device mesh — "
-            "Pallas kernels have no SPMD partitioning rule, so the "
-            "sharded program would silently mis-lower. Use "
-            "kernels=KernelPolicy.reference() (or mode='auto' on a "
-            "non-TPU backend) for sharded runs."
+            f"KernelPolicy resolves non-shardable plane(s) {unshardable} "
+            f"off the reference path under a {mesh.devices.size}-device "
+            "mesh — these planes declare no ShardSpec (they would need "
+            "in-kernel collectives), so shard_map cannot lower them "
+            "per-device. Use kernels=KernelPolicy.reference() or "
+            "disable=(...) for sharded runs."
         )
 
 
+def _wrap_mesh(backend: str, cfg, mesh: Mesh) -> Optional[Mesh]:
+    """The mesh the runner must trace its kernels under: the real mesh
+    when any plane is engaged at >1 devices (shard_map lowering), else
+    None (plain GSPMD propagation — the reference path partitions
+    itself, and a 1-device mesh needs no wrapping)."""
+    if mesh.devices.size <= 1:
+        return None
+    return mesh if _engaged_planes(backend, cfg) else None
+
+
 @functools.lru_cache(maxsize=None)
-def _runner(backend: str):
+def _runner(backend: str, wrap_mesh: Optional[Mesh] = None):
     """The jitted sharded multi-tick runner for one backend. The
     backend's own ``run_ticks`` body runs under the input shardings
-    (GSPMD propagation, module docstring); ``state`` is DONATED —
-    single-buffered per shard — so callers rebind the returned state
-    and must not reuse the argument."""
+    (GSPMD propagation, module docstring); with ``wrap_mesh`` set, the
+    trace additionally runs under ``registry.shard_lowering`` so every
+    engaged kernel plane lowers through ``jax.shard_map`` on that mesh
+    (one jitted runner per mesh — a cached executable never leaks
+    across meshes). ``state`` is DONATED — single-buffered per shard —
+    so callers rebind the returned state and must not reuse the
+    argument."""
+    from frankenpaxos_tpu.ops import registry
+
     mod = SHARDINGS[backend].mod()
 
     @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
     def run(cfg, state, t0, num_ticks: int, key):
-        return mod.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+        with registry.shard_lowering(wrap_mesh, GROUP_AXIS):
+            return mod.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
 
     return run
 
@@ -186,20 +226,23 @@ def run_ticks_sharded(
 ) -> Tuple[object, jnp.ndarray]:
     """Run ``num_ticks`` of the backend's simulation with the state
     sharded per the registry spec (see :func:`shard_state`). The mesh
-    argument is used for policy validation; the partitioning itself
-    rides the state's shardings."""
+    argument drives policy validation and the shard_map lowering of any
+    engaged kernel planes; the GSPMD partitioning itself rides the
+    state's shardings."""
     validate_policy(backend, cfg, mesh)
-    return _runner(backend)(cfg, state, t0, num_ticks, key)
+    wrap = _wrap_mesh(backend, cfg, mesh)
+    return _runner(backend, wrap)(cfg, state, t0, num_ticks, key)
 
 
 def lower_sharded(
     backend: str, cfg, mesh: Mesh, state, t0, num_ticks: int, key
 ):
     """Lower (don't run) the sharded runner — the static-analysis
-    ``trace-donation-alias`` rule compiles this to check that every
-    donated State leaf is aliased in the HLO under a mesh."""
+    ``trace-donation-alias`` / ``trace-shardmap-kernel`` rules compile
+    this to check aliasing and kernel lowering under a mesh."""
     validate_policy(backend, cfg, mesh)
-    return _runner(backend).lower(cfg, state, t0, num_ticks, key)
+    wrap = _wrap_mesh(backend, cfg, mesh)
+    return _runner(backend, wrap).lower(cfg, state, t0, num_ticks, key)
 
 
 # ---------------------------------------------------------------------------

@@ -80,6 +80,9 @@ from frankenpaxos_tpu.tpu.common import (
     age_clock,
     bit_latency,
 )
+# Submodule import (see multipaxos_batched: package-attr access on
+# frankenpaxos_tpu.ops would be circular during tpu package init).
+from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
@@ -114,10 +117,14 @@ class BatchedCompartmentalizedConfig:
     # the probe round trips.
     read_rate: int = 0
     read_window: int = 0  # RW (0 = reads off)
-    # Kernel-layer dispatch policy (ops/registry.py). No fused plane is
-    # registered for this backend yet — the knob is carried (and
-    # validated) so the sharding layer's policy checks and a future
-    # grid-vote kernel compose without a config change.
+    # Kernel-layer dispatch policy (ops/registry.py): the acceptor-grid
+    # hot path — clock aging, column-transversal write votes,
+    # every-row-voted chosen detection, the per-replica watermark
+    # advance, and full-grid retry re-sends — routes through
+    # ops.registry.dispatch as `compartmentalized_grid_vote` (one fused
+    # Pallas pass over the [R, C, G, W] grid off the reference path;
+    # group-local, so it also lowers per-device under a mesh via
+    # jax.shard_map — see parallel/sharding.py).
     kernels: KernelPolicy = KernelPolicy()
     # Unified in-graph fault injection (tpu/faults.py): UDP drop/dup/
     # jitter + an R*C acceptor-cell partition on the Phase2a/Phase2b
@@ -276,12 +283,12 @@ def tick(
     fp = cfg.faults
     w_iota = jnp.arange(W, dtype=jnp.int32)
 
-    # 0. Age every offset clock by one tick (one fused elementwise op
-    # per plane; "fires now" is == 0, "already arrived" is <= 0).
+    # 0. Age the narrow offset clocks by one tick ("fires now" is == 0,
+    # "already arrived" is <= 0). The WIDE planes — the [R, C, G, W]
+    # grid clocks and the [NR, G, W] commit broadcast — age inside the
+    # grid-vote plane below (ops/compartmentalized.py), so off the
+    # reference path they are read from HBM exactly once per tick.
     bat_arrival = age_clock(state.bat_arrival)
-    p2a_arrival = age_clock(state.p2a_arrival)
-    p2b_arrival = age_clock(state.p2b_arrival)
-    rep_arrival = age_clock(state.rep_arrival)
     reply_arrival = age_clock(state.reply_arrival)
     rd_probe = age_clock(state.rd_probe) if RW else state.rd_probe
 
@@ -368,50 +375,58 @@ def tick(
     )
     fill = jnp.where(can_emit, fill - BS, fill)
 
-    # 3. Acceptors vote on Phase2a arrivals; votes fly back to the
-    # slot's proxy leader. Idempotent min-write dedups duplicates.
-    voted_now = p2a_arrival == 0
-    p2b_arrival = jnp.where(
-        voted_now & p2b_del,
-        jnp.minimum(p2b_arrival, p2b_lat.astype(p2b_arrival.dtype)),
-        p2b_arrival,
-    )
-
-    # 4. Proxy leaders count quorums: a slot is chosen when EVERY row
-    # has a vote in (the column-transversal write quorum). A dead proxy
-    # cannot collect — its slots defer until revival.
+    # 3-5 + 9. The acceptor-grid HOT PATH as one registry plane
+    # (ops/compartmentalized.py `compartmentalized_grid_vote`): aging
+    # of the grid + commit-broadcast clocks, acceptor votes on Phase2a
+    # arrivals (idempotent Phase2b min-write), the every-row-voted
+    # column-transversal quorum gated on the slot's proxy being alive,
+    # the commit broadcast arming + per-replica watermark advance, and
+    # the full-grid retry re-send of timed-out PROPOSED slots. Off the
+    # reference path this is ONE Pallas grid program per tick (the two
+    # [R, C, G, W] arrays are read from HBM once); the reference twin
+    # is exactly this composition in pure jnp, so kernel-vs-reference
+    # bit-identity doubles as fused-vs-unfused bit-identity. The retry
+    # half runs BEFORE retirement/sequencing here where the old tick
+    # ran it after — the write masks are disjoint (retries touch only
+    # slots that stay PROPOSED), so the composition is bit-identical.
     s_of_pos = state.head[:, None] + (w_iota[None, :] - state.head[:, None]) % W
     p_of_pos = s_of_pos % P  # [G, W] proxy owning each ring position
     alive_of_pos = jnp.take_along_axis(proxy_alive, p_of_pos, axis=1)
-    votes_in = p2b_arrival <= 0  # [R, C, G, W]
-    quorum = jnp.all(jnp.any(votes_in, axis=1), axis=0)  # [G, W]
-    newly_chosen = (state.status == PROPOSED) & quorum & alive_of_pos
-    status = jnp.where(newly_chosen, CHOSEN, state.status)
+    (
+        p2a_arrival,
+        p2b_arrival,
+        rep_arrival,
+        status,
+        last_send,
+        rep_exec,
+        newly_chosen,
+        timed_out,
+        votes_cast,
+        votes_dropped,
+    ) = ops_registry.dispatch(
+        "compartmentalized_grid_vote",
+        cfg,
+        state.p2a_arrival,
+        state.p2b_arrival,
+        state.rep_arrival,
+        state.status,
+        state.last_send,
+        state.rep_exec,
+        state.head,
+        state.next_slot,
+        alive_of_pos,
+        p2b_del,
+        retry_del,
+        p2b_lat,
+        retry_lat,
+        rep_lat,
+        t,
+        retry_timeout=cfg.retry_timeout,
+    )
     n_chosen = jnp.sum(newly_chosen)
     batches_committed = state.batches_committed + n_chosen
     committed = state.committed + BS * n_chosen
-    # Commit broadcast: proxy -> every replica; the reply chain
-    # (replica 0 -> unbatcher -> client) is armed when replica 0
-    # actually executes the batch (step 6).
-    rep_arrival = jnp.where(
-        newly_chosen[None, :, :],
-        rep_lat.astype(rep_arrival.dtype),
-        rep_arrival,
-    )
-
-    # 5. Replicas execute their contiguous arrived prefix, each
-    # advancing its OWN watermark (per-replica read serving depends on
-    # exactly this decoupling).
     ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
-    live_ord = (w_iota[None, :] < (state.next_slot - state.head)[:, None])
-    exec_ready = (status == CHOSEN)[None] & (rep_arrival <= 0)  # [NR,G,W]
-    ord_ready = exec_ready & live_ord[None]
-    # Prefix length per replica = the minimum ordinal that is NOT ready
-    # (W when every position is) — a masked min-reduction, no gather.
-    first_gap = jnp.min(
-        jnp.where(ord_ready, W, ord_of_pos[None]), axis=2
-    )  # [NR, G]
-    rep_exec = jnp.maximum(state.rep_exec, state.head[None, :] + first_gap)
 
     # 6. Replica 0 hands newly-executed batches to the unbatcher, which
     # fans replies to clients (one combined 2-hop clock).
@@ -459,7 +474,7 @@ def tick(
     retired = state.retired + jnp.sum(n_retire)
     status = jnp.where(retire, EMPTY, status)
     propose_tick = jnp.where(retire, INF, state.propose_tick)
-    last_send = jnp.where(retire, INF, state.last_send)
+    last_send = jnp.where(retire, INF, last_send)
     reply_arrival = jnp.where(retire, INF16, reply_arrival)
     p2a_arrival = jnp.where(retire[None, None], INF16, p2a_arrival)
     p2b_arrival = jnp.where(retire[None, None], INF16, p2b_arrival)
@@ -491,29 +506,17 @@ def tick(
         send & p2a_del, p2a_lat.astype(p2a_arrival.dtype), p2a_arrival
     )
 
-    # 9. Proxy retries: a timed-out PROPOSED slot re-broadcasts to the
-    # FULL grid (liveness under drops, dead transversal members, and
-    # healed partitions).
-    timed_out = (
-        (status == PROPOSED)
-        & (t - last_send >= cfg.retry_timeout)
-        & alive_of_pos
-    )
-    resend = timed_out[None, None] & retry_del
-    # OVERWRITE (not min-write): an acceptor whose Phase2b was dropped
-    # has an already-arrived (saturated) p2a clock — only a fresh
-    # arrival makes it re-vote; re-votes dedup via the p2b min-write.
-    p2a_arrival = jnp.where(
-        resend, retry_lat.astype(p2a_arrival.dtype), p2a_arrival
-    )
-    last_send = jnp.where(timed_out, t, last_send)
+    # (Step 9, proxy retries, now lives inside the grid-vote plane:
+    # timed-out PROPOSED slots already re-broadcast to the full grid
+    # and stamped last_send = t before retirement/sequencing — the
+    # masks are disjoint from retire/is_new, so the order commutes.)
 
     # Proxy load accounting (one-hot over P, group-local).
     p_onehot = p_of_pos[:, :, None] == jnp.arange(P, dtype=jnp.int32)
     per_pos_msgs = (
         R * is_new.astype(jnp.int32)  # transversal Phase2a
         + (R * C) * timed_out.astype(jnp.int32)  # full-grid retry
-        + jnp.sum(voted_now, axis=(0, 1))  # Phase2b votes collected
+        + votes_cast  # Phase2b votes collected
         + NR * newly_chosen.astype(jnp.int32)  # commit broadcast
     )
     proxy_msgs = state.proxy_msgs + jnp.sum(
@@ -618,8 +621,9 @@ def tick(
         probes_sent = C * jnp.sum(form)
 
     # 11. Telemetry (tpu/telemetry.py): counters the tick already
-    # computed for its own bookkeeping.
-    drops = jnp.sum(send & ~p2a_del) + jnp.sum(voted_now & ~p2b_del)
+    # computed for its own bookkeeping (the grid-vote plane's [G, W]
+    # vote counts stand in for the [R, C, G, W] vote mask it fused).
+    drops = jnp.sum(send & ~p2a_del) + jnp.sum(votes_dropped)
     tel = record(
         state.telemetry,
         proposals=admitted,
@@ -627,7 +631,7 @@ def tick(
         phase2_msgs=(
             R * jnp.sum(is_new)
             + (R * C) * jnp.sum(timed_out)
-            + jnp.sum(voted_now)
+            + jnp.sum(votes_cast)
         ),
         commits=committed - state.committed,
         executes=BS * jnp.sum(n_retire),

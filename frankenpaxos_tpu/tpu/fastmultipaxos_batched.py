@@ -79,8 +79,14 @@ class BatchedFastMultiPaxosConfig:
     # duplicates/jitter + an acceptor-axis partition on the client
     # broadcast plane (UDP semantics — the command re-broadcast timer
     # restores liveness after a heal); the classic recovery round is
-    # TCP (delay-only), so a recovering slot cannot deadlock.
-    # FaultPlan.none() is a structural no-op.
+    # TCP (delay-only), so a recovering slot cannot deadlock. Crash/
+    # revive drives the per-group PROPOSER (the client-facing
+    # sequencer): a dead proposer admits no new commands and re-sends
+    # nothing, and a revival triggers a RECOVERY ELECTION — the revived
+    # proposer immediately re-broadcasts every pending command (counted
+    # as a leader change) while the vote plane's timeout-based classic
+    # recovery clears any slots stranded mid-choose. FaultPlan.none()
+    # is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the vote plane —
     # census/pairwise-match counting, fast choose, recovery triggers,
@@ -119,6 +125,7 @@ class BatchedFastMultiPaxosState:
     head: jnp.ndarray  # [G] lowest non-retired slot
     acc_next: jnp.ndarray  # [A, G] each acceptor's nextSlot
     cmd_seq: jnp.ndarray  # [G] next command id (global = seq * G + g)
+    prop_alive: jnp.ndarray  # [G] proposer liveness (crash/revive axis)
 
     # Slots.
     status: jnp.ndarray  # [G, W] S_*
@@ -164,6 +171,7 @@ def init_state(
         head=jnp.zeros((G,), jnp.int32),
         acc_next=jnp.zeros((A, G), jnp.int32),
         cmd_seq=jnp.zeros((G,), jnp.int32),
+        prop_alive=jnp.ones((G,), bool),
         status=jnp.zeros((G, W), DTYPE_STATUS),
         open_tick=jnp.full((G, W), INF, jnp.int32),
         chosen_value=jnp.full((G, W), NO_VALUE, jnp.int32),
@@ -240,6 +248,18 @@ def tick(
     status = state.status
     vote_value = state.vote_value
     vote_seen = state.vote_seen
+
+    # Proposer crash/revive (PR 3 follow-up (b)): the per-group
+    # proposer is the crash axis. Guarded on has_crash so a none/
+    # crash-free plan traces the exact pre-crash program.
+    prop_alive = state.prop_alive
+    revived = None
+    if fp.has_crash:
+        new_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 9), prop_alive
+        )
+        revived = new_alive & ~prop_alive
+        prop_alive = new_alive
 
     # ---- 1. Acceptors append pending command arrivals to their own
     # nextSlot in command-ring order (Acceptor.scala:229-238). Ring
@@ -376,10 +396,15 @@ def tick(
 
     # ---- 6. New client commands (K per group into free ring slots) +
     # retries of long-pending commands (re-broadcast; the retry may be
-    # chosen in a second slot — the dup path).
+    # chosen in a second slot — the dup path). A dead proposer admits
+    # no new commands and re-sends nothing (Leader.scala inactive
+    # state); the tick it revives, it re-broadcasts EVERY pending
+    # command at once — the recovery election's log-refill sweep.
     empty = cmd_status == C_EMPTY
     crank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
     is_new = empty & (crank <= cfg.cmds_per_tick)
+    if fp.has_crash:
+        is_new = is_new & prop_alive[:, None]
     n_new = jnp.sum(is_new, axis=1)
     new_id = (state.cmd_seq[:, None] + crank - 1) * G + jnp.arange(
         G, dtype=jnp.int32
@@ -393,6 +418,11 @@ def tick(
         & ~is_new
         & (t - cmd_last_send >= cfg.retry_timeout)
     )
+    if fp.has_crash:
+        retry = retry & prop_alive[:, None]
+        retry = retry | (
+            (cmd_status == C_PENDING) & ~is_new & revived[:, None]
+        )
     send = is_new | retry
     cmd_last_send = jnp.where(send, t, cmd_last_send)
     bcast_send = send[None, :, :]
@@ -408,7 +438,8 @@ def tick(
 
     # Telemetry: client broadcasts straight to acceptors ARE the fast
     # (phase-2) plane; classic recoveries the phase-1 plane; acceptor
-    # ring backpressure the drop counter.
+    # ring backpressure the drop counter; proposer revivals (recovery
+    # elections) the leader-change counter.
     tel = record(
         state.telemetry,
         proposals=jnp.sum(n_new),
@@ -418,6 +449,7 @@ def tick(
         executes=cmds_done - state.cmds_done,
         drops=dropped_votes - state.dropped_votes,
         retries=jnp.sum(retry),
+        leader_changes=jnp.sum(revived) if revived is not None else 0,
         queue_depth=jnp.sum(cmd_status != C_EMPTY),
         queue_capacity=G * CW,
         lat_hist_delta=lat_hist - state.lat_hist,
@@ -427,6 +459,7 @@ def tick(
         head=head,
         acc_next=acc_next,
         cmd_seq=cmd_seq,
+        prop_alive=prop_alive,
         status=status,
         open_tick=open_tick,
         chosen_value=chosen_value,

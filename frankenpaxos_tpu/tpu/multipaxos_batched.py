@@ -525,6 +525,11 @@ def tick(
 
     status = state.status
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
+    # Global group ids, fed to the dispatch planes (fresh proposal
+    # values encode slot * G + g): an explicit input rather than an
+    # in-kernel iota, so a shard_map-lowered kernel sees ITS slice of
+    # the global numbering instead of renumbering every shard from 0.
+    g_ids_vec = jnp.arange(G, dtype=jnp.int32)
 
     # FaultPlan crash/revive merges into the leader-candidate machinery
     # (independent death sources compose); a none plan returns the
@@ -898,6 +903,7 @@ def tick(
             p2a_lat,
             retry_lat,
             rep_lat,
+            g_ids_vec,
             t,
             f=f,
             retry_timeout=cfg.retry_timeout,
@@ -975,6 +981,7 @@ def tick(
             p2a_lat,
             retry_lat,
             rep_lat,
+            g_ids_vec,
             t,
             f=f,
             retry_timeout=cfg.retry_timeout,
@@ -1087,7 +1094,7 @@ def tick(
         dups_seen = dups_seen + jnp.sum(retire_mask & slot_is_dup & (cmd >= 0))
         slot_is_dup = slot_is_dup & ~retire_mask
 
-    group_ids = jnp.arange(G, dtype=jnp.int32)[:, None]  # [G, 1]
+    group_ids = g_ids_vec[:, None]  # [G, 1]
     if cfg.state_machine == "kv":
         # Dup injection rides AFTER the dispatch plane: commands
         # round-robin over client pseudonyms, and a dup proposal

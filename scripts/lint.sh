@@ -11,4 +11,10 @@
 #   scripts/lint.sh --rule ID    # any frankenpaxos_tpu.analysis flag
 set -u
 cd "$(dirname "$0")/.."
+# The trace-shardmap-kernel rule compiles sharded wrappers: give the
+# CLI the same 8-virtual-device CPU mesh the pytest conftest uses, so
+# the kernels x mesh contract is checked on single-device hosts too.
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
 exec python -m frankenpaxos_tpu.analysis "$@"

@@ -22,6 +22,11 @@ TRACE_RULES = [
     "trace-dtype-policy",
     "trace-donation-alias",
     "trace-retrace-guard",
+    # Kernels x mesh: sharded wrappers with the policy engaged must
+    # shard_map-lower the Pallas planes (no silent reference fallback,
+    # no signed-state collectives beyond the stat reductions); no-op
+    # for backends outside the sharding registry.
+    "trace-shardmap-kernel",
 ]
 
 
@@ -60,6 +65,23 @@ def test_dtype_pin_has_teeth(monkeypatch):
     report = core.run(rule_ids=["trace-dtype-policy"], ctx=ctx)
     assert [f.key for f in report.findings] == ["unreplicated:int8->int32"]
     assert "pins 3" in report.findings[0].message
+
+
+def test_shardmap_kernel_rule_has_teeth(monkeypatch):
+    """Simulate the silent-fallback regression the rule exists for: if
+    every plane resolves to the reference under a sharded trace (here:
+    resolve_mode forced), the kernels-engaged wrapper traces zero
+    pallas_calls and the rule must flag it."""
+    from frankenpaxos_tpu.ops import registry
+
+    monkeypatch.setattr(
+        registry, "resolve_mode", lambda name, cfg: "reference"
+    )
+    ctx = core.Context(backends=("compartmentalized",))
+    report = core.run(rule_ids=["trace-shardmap-kernel"], ctx=ctx)
+    assert any(
+        "fell back" in f.message for f in report.findings
+    ), report.format()
 
 
 def test_alias_table_parser():

@@ -326,6 +326,40 @@ def test_microbench_fused_tick_smoke():
     assert {r["case"] for r in rows} == {"fused", "multiplane", "summary"}
 
 
+def test_microbench_grid_vote_smoke():
+    """The grid-vote fused-vs-unfused race at toy size (guards
+    ``microbench grid_vote``): the interleaved (side x block) matrix
+    runs, outputs are bit-identical, and the summary carries both the
+    dispatch-block and best-vs-best ratios plus the sweep table."""
+    from frankenpaxos_tpu.harness import microbench
+
+    rows = microbench.bench_grid_vote(
+        iters=1, rounds=1, A=3, G=32, W=16, N=32, L=3, KV=4, CW=8
+    )
+    summary = next(r for r in rows if r["case"] == "summary")
+    assert summary["bit_identical"] is True
+    assert summary["speedup"] > 0
+    assert summary["speedup_best_vs_best"] > 0
+    assert set(summary["block_sweep_seconds"]) == {"fused", "unfused"}
+    assert summary["shape"][:2] == [2, 2]  # [R, C, G, W]
+
+
+def test_microbench_mesh_kernels_smoke():
+    """The sharded kernels-vs-reference race at toy size (guards
+    ``microbench mesh_kernels``): compiles on the conftest mesh, the
+    two sharded programs replay each other bit for bit, and the
+    off-TPU row is flagged pending_tpu_remeasure."""
+    from frankenpaxos_tpu.harness import microbench
+
+    rows = microbench.bench_mesh_kernels(
+        ticks=6, rounds=1, groups_per_device=8
+    )
+    summary = next(r for r in rows if r["case"] == "summary")
+    assert summary["bit_identical"] is True
+    assert summary["committed"] > 0
+    assert summary["pending_tpu_remeasure"] is True
+
+
 def test_deploy_smoke_profiles_a_role(tmp_path):
     """profile_role wraps one role with cProfile and the pstats dump
     lands in the bench dir (perf_util.py capability)."""

@@ -16,6 +16,7 @@ import pytest
 from frankenpaxos_tpu.ops import registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import (
+    compartmentalized_batched,
     craq_batched,
     fastmultipaxos_batched,
     horizontal_batched,
@@ -107,6 +108,7 @@ def test_registry_coverage_names_all_backends():
     assert cov["fastmultipaxos"] == ("fastmultipaxos_vote",)
     assert cov["horizontal"] == ("horizontal_vote",)
     assert cov["scalog"] == ("scalog_cut_commit",)
+    assert cov["compartmentalized"] == ("compartmentalized_grid_vote",)
 
 
 def test_block_for_exact_nearest_and_default():
@@ -127,6 +129,89 @@ def test_block_for_exact_nearest_and_default():
     )
 
 
+def test_per_device_autotune_resolution():
+    """The kernels x mesh layer keys the block lookup on the PER-DEVICE
+    shape (G/D): with no exact entry at the local G, the nearest-G
+    fallback resolves deterministically to a recorded block — so
+    shard-local block picks never crash and never drift between
+    devices (every device computes the same lookup)."""
+    name = "multipaxos_vote_quorum"
+    table = registry._table()
+    recorded = {
+        v for k, v in table.items() if k.startswith(name + "|")
+    }
+    for n_dev in (2, 4, 8):
+        per_dev = (3, 3334 // n_dev, 64)
+        assert registry.table_key(name, per_dev) not in table
+        got = registry.block_for(name, per_dev)
+        assert got in recorded
+        assert registry.block_for(name, per_dev) == got  # deterministic
+
+
+def test_shard_specs_cover_reference_signatures():
+    """Every plane of a backend in the sharding registry declares a
+    ShardSpec whose arg_axes arity matches the reference twin's
+    positional signature — the structural contract the shard_map
+    lowering relies on (a miscounted spec would mis-partition)."""
+    import inspect
+
+    from frankenpaxos_tpu.parallel import sharding as sh
+
+    sharded_backends = {
+        s.planes_backend for s in sh.SHARDINGS.values() if s.planes_backend
+    }
+    checked = 0
+    for name, plane in registry.PLANES.items():
+        if plane.backend not in sharded_backends:
+            continue
+        assert plane.shard is not None, f"{name} lost its ShardSpec"
+        n_params = sum(
+            1
+            for p in inspect.signature(plane.reference).parameters.values()
+            if p.kind is not inspect.Parameter.KEYWORD_ONLY  # statics
+        )
+        assert len(plane.shard.arg_axes) == n_params, name
+        assert len(plane.shard.out_axes) >= 1, name
+        checked += 1
+    assert checked >= 5  # 4 multipaxos planes + the grid-vote plane
+
+
+def test_sharded_dispatch_keys_per_device_shape(monkeypatch):
+    """Tracing a tick under shard_lowering consults the autotune table
+    with the batch axis DIVIDED by the mesh size (the per-device shard
+    the kernel actually sees)."""
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.parallel import sharding as sh
+
+    seen = []
+    real = registry.block_for
+
+    def spy(name, key):
+        seen.append((name, tuple(key)))
+        return real(name, key)
+
+    monkeypatch.setattr(registry, "block_for", spy)
+    mesh = sh.make_mesh(jax.devices())
+    n_dev = mesh.devices.size
+    mp = multipaxos_batched
+    cfg = dataclasses.replace(
+        mp.analysis_config(), num_groups=8,
+        kernels=KernelPolicy(mode="interpret"),
+    )
+    state = mp.init_state(cfg)
+
+    def run(s, t, k):
+        with registry.shard_lowering(mesh, sh.GROUP_AXIS):
+            return mp.tick(cfg, s, t, k)
+
+    jax.make_jaxpr(run)(
+        state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0)
+    )
+    assert ("multipaxos_fused_tick", (3, 8 // n_dev, 16)) in seen
+
+
 def test_write_table_merges(tmp_path):
     path = str(tmp_path / "autotune.json")
     payload = registry.write_table({"x|1|2|3": 128}, path=path)
@@ -143,6 +228,7 @@ def test_write_table_merges(tmp_path):
 
 
 def test_ops_constant_mirrors_match_backends():
+    from frankenpaxos_tpu.ops import compartmentalized as ops_cz
     from frankenpaxos_tpu.ops import craq as ops_craq
     from frankenpaxos_tpu.ops import fastmultipaxos as ops_fmp
     from frankenpaxos_tpu.ops import horizontal as ops_hz
@@ -170,6 +256,9 @@ def test_ops_constant_mirrors_match_backends():
     assert ops_hz.CHOSEN == horizontal_batched.CHOSEN
     assert ops_hz.NO_VALUE == horizontal_batched.NO_VALUE
     assert ops_hz.INF_I == int(INF)
+    assert ops_cz.EMPTY == compartmentalized_batched.EMPTY
+    assert ops_cz.PROPOSED == compartmentalized_batched.PROPOSED
+    assert ops_cz.CHOSEN == compartmentalized_batched.CHOSEN
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +427,28 @@ def test_fastmultipaxos_interpret_matches_reference(seed):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fastmultipaxos_crash_plan_interpret_matches_reference(seed):
+    """The newly-kerneled fastmultipaxos_vote plane under CRASHES: the
+    proposer crash/revive axis (PR 3 follow-up (b)) gates proposing
+    outside the plane, so the kernel path must replay the reference
+    bit for bit through dead windows and revival re-broadcasts."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    fm = fastmultipaxos_batched
+    plan = FaultPlan(drop_rate=0.05, crash_rate=0.05, revive_rate=0.3)
+
+    def make_cfg(pol):
+        return fm.BatchedFastMultiPaxosConfig(
+            f=1, num_groups=4, window=8, cmd_window=8, cmds_per_tick=2,
+            jitter=2, recovery_timeout=10, retry_timeout=6,
+            faults=plan, kernels=pol,
+        )
+
+    hashes = _run_both(fm, make_cfg, 40, seed, FMP_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
 def test_horizontal_interpret_matches_reference(seed):
     hz = horizontal_batched
 
@@ -350,6 +461,37 @@ def test_horizontal_interpret_matches_reference(seed):
         )
 
     hashes = _run_both(hz, make_cfg, 30, seed, HORIZONTAL_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]
+
+
+CZ_FIELDS = (
+    "status", "head", "next_slot", "p2a_arrival", "p2b_arrival",
+    "rep_arrival", "rep_exec", "last_send", "propose_tick", "committed",
+    "batches_committed", "writes_done", "reads_done", "lat_hist",
+    "proxy_msgs", "unbat_msgs",
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_compartmentalized_interpret_matches_reference(seed):
+    """The grid-vote plane through a whole faulty run: drops + jitter +
+    proxy crashes + a grid-cell partition with a scheduled heal all
+    route through the fused kernel (interpret) and replay the pure-jnp
+    reference bit for bit."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    cz = compartmentalized_batched
+    plan = FaultPlan(
+        drop_rate=0.1, jitter=1, crash_rate=0.02, revive_rate=0.2,
+        partition=(0, 0, 0, 1), partition_start=5, partition_heal=25,
+    )
+
+    def make_cfg(pol):
+        return dataclasses.replace(
+            cz.analysis_config(faults=plan), kernels=pol
+        )
+
+    hashes = _run_both(cz, make_cfg, 30, seed, CZ_FIELDS)
     assert hashes["interpret"] == hashes["reference"]
 
 

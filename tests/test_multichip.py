@@ -8,11 +8,14 @@ AND the compartmentalized backend on the 8-virtual-device CPU mesh
     commit/watermark/histogram reductions,
   * seed-stable, sharded-vs-unsharded BIT-IDENTICAL results (integer
     psums are exact, so mesh size cannot change a single bit),
-  * donation surviving GSPMD partitioning (single-buffered per shard),
-  * and the KernelPolicy x mesh validation: a policy that would lower
-    Pallas inside a >1-device mesh is a loud ``ValueError``, never a
-    silent mis-lowering; at mesh=1 the engaged kernels stay
-    bit-identical to the unsharded run.
+  * donation surviving GSPMD partitioning (single-buffered per shard)
+    — with AND without the kernel planes engaged,
+  * and the kernels x mesh COMPOSITION: a policy that engages the
+    Pallas planes under a >1-device mesh lowers them per-device via
+    ``jax.shard_map`` (3-seed sharded+kernels == unsharded+kernels ==
+    reference, full state), while a plane declared non-shardable
+    (no ShardSpec) stays a loud ``ValueError``, never a silent
+    mis-lowering.
 """
 
 import dataclasses
@@ -22,9 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.parallel import sharding as sh
 from frankenpaxos_tpu.tpu import compartmentalized_batched as cb
+from frankenpaxos_tpu.tpu import epaxos_batched as eb
 from frankenpaxos_tpu.tpu import multipaxos_batched as mb
 
 # HLO collective census helpers shared with the flagship sharding tests.
@@ -161,26 +166,154 @@ def test_kernel_policy_sharded_mesh1_bit_identity():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_kernel_policy_mesh_gt1_is_a_validation_error():
-    """A policy that resolves any plane off the reference path under a
-    >1-device mesh raises instead of silently mis-lowering the Pallas
-    body. The default auto policy resolves to the reference twins on
-    CPU, so it passes."""
+def test_kernel_policy_mesh_gt1_validates_shardable_planes():
+    """Engaged planes WITH a ShardSpec now validate under a >1-device
+    mesh (the shard_map composition layer lowers them per-device);
+    only a plane declared NON-shardable still raises — loudly, never a
+    silent mis-lowering."""
     mesh = _mesh()
+    engaged = dataclasses.replace(
+        mb.analysis_config(), num_groups=8,
+        kernels=KernelPolicy(mode="interpret"),
+    )
+    sh.validate_policy("multipaxos", engaged, mesh)
+    legacy = dataclasses.replace(
+        mb.analysis_config(), num_groups=8, use_pallas=True
+    )
+    sh.validate_policy("multipaxos", legacy, mesh)
+    sh.validate_policy("multipaxos",
+                       dataclasses.replace(mb.analysis_config(),
+                                           num_groups=8), mesh)
+    sh.validate_policy("compartmentalized", _ccfg(), mesh)
+    sh.validate_policy(
+        "compartmentalized",
+        dataclasses.replace(_ccfg(), kernels=KernelPolicy(mode="interpret")),
+        mesh,
+    )
+
+
+def test_non_shardable_plane_mesh_gt1_is_a_validation_error(monkeypatch):
+    """Strip one plane's ShardSpec: engaging it under a mesh must be a
+    ValueError again (the guard retired for shardable planes, not for
+    cross-group ones)."""
+    mesh = _mesh()
+    plane = ops_registry.PLANES["multipaxos_vote_quorum"]
+    monkeypatch.setitem(
+        ops_registry.PLANES,
+        "multipaxos_vote_quorum",
+        dataclasses.replace(plane, shard=None),
+    )
     bad = dataclasses.replace(
         mb.analysis_config(), num_groups=8,
         kernels=KernelPolicy(mode="interpret"),
     )
-    with pytest.raises(ValueError, match="SPMD partitioning rule"):
+    with pytest.raises(ValueError, match="non-shardable"):
         sh.validate_policy("multipaxos", bad, mesh)
-    legacy = dataclasses.replace(
-        mb.analysis_config(), num_groups=8, use_pallas=True
+    # Disabling the stripped plane (and the megakernel that subsumes
+    # it) restores validity: the remaining engaged planes all shard.
+    ok = dataclasses.replace(
+        bad,
+        kernels=KernelPolicy(
+            mode="interpret",
+            disable=("multipaxos_vote_quorum", "multipaxos_fused_tick"),
+        ),
     )
-    with pytest.raises(ValueError, match="SPMD partitioning rule"):
-        sh.validate_policy("multipaxos", legacy, mesh)
-    ok = dataclasses.replace(mb.analysis_config(), num_groups=8)
-    sh.validate_policy("multipaxos", ok, mesh)  # auto -> reference on CPU
-    sh.validate_policy("compartmentalized", _ccfg(), mesh)
+    sh.validate_policy("multipaxos", ok, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Kernels x mesh composition: every (backend x kernels on/off) cell is
+# 3-seed full-state bit-identical — sharded+kernels == unsharded+kernels
+# == sharded reference. epaxos has no registered planes, so its kernels
+# cell degenerates to the reference program (still pinned 3-seed).
+# ---------------------------------------------------------------------------
+
+
+def _full_leaves(st):
+    return jax.tree_util.tree_leaves(st)
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(_full_leaves(a), _full_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _cell(backend):
+    if backend == "multipaxos":
+        mod = mb
+        cfg = dataclasses.replace(mb.analysis_config(), num_groups=8)
+    elif backend == "compartmentalized":
+        mod = cb
+        cfg = _ccfg()
+    else:
+        mod = eb
+        cfg = dataclasses.replace(eb.analysis_config(), num_columns=8)
+    return mod, cfg
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("backend", ["multipaxos", "compartmentalized"])
+def test_sharded_kernels_bit_identity(backend, seed):
+    """The flagship acceptance cell: mesh>1 with the KernelPolicy
+    ENGAGED (interpret — the actual shard_map-lowered kernel path,
+    executable on CPU; for multipaxos this includes the
+    multipaxos_fused_tick megakernel) compiles, runs, and replays both
+    the unsharded kernel run and the sharded reference bit for bit,
+    full state including the telemetry ring."""
+    mod, base = _cell(backend)
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    cfg_k = dataclasses.replace(base, kernels=KernelPolicy(mode="interpret"))
+    cfg_r = dataclasses.replace(base, kernels=KernelPolicy.reference())
+
+    st = sh.shard_state(backend, mod.init_state(cfg_k), mesh)
+    st, _ = sh.run_ticks_sharded(backend, cfg_k, mesh, st, t0, 20, key)
+    assert int(st.committed) > 0
+
+    ust, _ = mod.run_ticks(cfg_k, mod.init_state(cfg_k), t0, 20, key)
+    _assert_states_equal(st, ust)
+
+    rst = sh.shard_state(backend, mod.init_state(cfg_r), mesh)
+    rst, _ = sh.run_ticks_sharded(backend, cfg_r, mesh, rst, t0, 20, key)
+    _assert_states_equal(st, rst)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_epaxos_sharded_cell_bit_identity(seed):
+    """epaxos rides the registry with no registered planes: the
+    kernels-on and kernels-off cells are the same program; sharded ==
+    unsharded per seed."""
+    mod, cfg = _cell("epaxos")
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    st = sh.shard_state("epaxos", mod.init_state(cfg), mesh)
+    st, _ = sh.run_ticks_sharded("epaxos", cfg, mesh, st, t0, 20, key)
+    ust, _ = mod.run_ticks(cfg, mod.init_state(cfg), t0, 20, key)
+    _assert_states_equal(st, ust)
+
+
+@pytest.mark.parametrize("backend", ["multipaxos", "compartmentalized"])
+def test_donation_survives_mesh_with_kernels_engaged(backend):
+    """Donation under the mesh with the shard_map-lowered kernels
+    live: the compiled kernels-engaged sharded module still aliases
+    every donated State leaf (the kernel lowering must not break
+    single-buffering)."""
+    from frankenpaxos_tpu.analysis.rules_trace import _alias_param_indices
+
+    mod, base = _cell(backend)
+    cfg = dataclasses.replace(base, kernels=KernelPolicy(mode="interpret"))
+    mesh = _mesh()
+    state = sh.shard_state(backend, mod.init_state(cfg), mesh)
+    n_leaves = len(_full_leaves(state))
+    txt = sh.lower_sharded(
+        backend, cfg, mesh, state, jnp.zeros((), jnp.int32), 20,
+        jax.random.PRNGKey(0),
+    ).compile().as_text()
+    aliased = _alias_param_indices(txt)
+    missing = sorted(set(range(n_leaves)) - aliased)
+    assert not missing, f"unaliased sharded State leaves: {missing}"
 
 
 def test_axis_divisibility_is_checked():

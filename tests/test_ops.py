@@ -169,7 +169,8 @@ def mp_dispatch_args(key, A=3, G=8, W=16):
         chosen_tick, chosen_round, chosen_value, replica_arrival,
         p2a, p2b, vote_round, vote_value,
         nvotes, head, next_slot, leader_round, cap, retry_ok,
-        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, jnp.int32(33),
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat,
+        jnp.arange(G, dtype=jnp.int32), jnp.int32(33),
     )
 
 
@@ -212,14 +213,14 @@ def fused_tick_args(key, A=3, G=8, W=16, aged=True):
     (status, d_slot_value, propose_tick, last_send, chosen_tick,
      chosen_round, chosen_value, replica_arrival, _p2a, _p2b, _vr, _vv,
      _nvotes, head, next_slot, d_leader_round, cap, retry_ok,
-     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t) = d
+     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t) = d
     del d_slot_value, d_leader_round
     return (
         p2a, acc_round, leader_round, slot_value, vote_round, vote_value,
         p2b, p2b_lat, delivered, head,
         status, propose_tick, last_send, chosen_tick, chosen_round,
         chosen_value, replica_arrival, next_slot, cap, retry_ok,
-        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t,
     )
 
 
@@ -257,7 +258,7 @@ def test_fused_tick_composition_equals_planes():
      p2b, p2b_lat, delivered, head,
      status, propose_tick, last_send, chosen_tick, chosen_round,
      chosen_value, replica_arrival, next_slot, cap, retry_ok,
-     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t) = args
+     send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t) = args
     fused = reference_fused_tick(
         *args, f=1, retry_timeout=8, num_groups=G, age=True
     )
@@ -270,7 +271,7 @@ def test_fused_tick_composition_equals_planes():
         status, slot_value, propose_tick, last_send, chosen_tick,
         chosen_round, chosen_value, replica_arrival, p2a_aged, p2b2,
         vr, vv, nvotes, head, next_slot, leader_round, cap, retry_ok,
-        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, t,
+        send_ok, retry_deliv, p2a_lat, retry_lat, rep_lat, group_ids, t,
         f=1, retry_timeout=8, num_groups=G,
     )
     _assert_trees_equal(

@@ -127,3 +127,104 @@ def test_dense_acceptor_logs():
         for g in range(cfg.num_groups):
             for s_ in range(int(head[g]), int(nxt[a, g])):
                 assert vote[a, g, s_ % W] >= 0, (a, g, s_)
+
+
+# ---------------------------------------------------------------------------
+# Proposer crash semantics (PR 3 follow-up (b)): crash gates proposing,
+# revival triggers the recovery election (instant re-broadcast of every
+# pending command).
+# ---------------------------------------------------------------------------
+
+
+def _crash_cfg(**fault_kw):
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    return fm.BatchedFastMultiPaxosConfig(
+        f=1, num_groups=4, window=16, cmd_window=16, cmds_per_tick=2,
+        lat_min=1, lat_max=2, jitter=1, recovery_timeout=10,
+        retry_timeout=6, faults=FaultPlan(**fault_kw),
+    )
+
+
+def test_dead_proposers_stall_and_manual_revival_resumes():
+    """Every proposer dead: in-flight work drains, then commits STOP
+    (no new commands, no re-broadcasts); reviving the proposers
+    restores commit progress via the retry timers — the
+    liveness-after-revive contract a crashed sequencer must honor.
+    Deaths/revivals are forced by editing prop_alive (revive_rate=0
+    keeps the PRNG process from resurrecting anyone mid-stall)."""
+    cfg = _crash_cfg(crash_rate=0.001, revive_rate=0.0)
+    key = jax.random.PRNGKey(2)
+    state, t = fm.run_ticks(cfg, fm.init_state(cfg), jnp.int32(0), 30, key)
+    assert int(state.committed_slots) > 0
+
+    # Kill every proposer; the pipeline drains, then progress stops
+    # (revive_rate=0: nobody comes back until we say so).
+    state = dataclasses.replace(
+        state, prop_alive=jnp.zeros((cfg.num_groups,), bool)
+    )
+    state, t = fm.run_ticks(cfg, state, t, 30, jax.random.fold_in(key, 1))
+    c_drained = int(state.committed_slots)
+    state, t = fm.run_ticks(cfg, state, t, 25, jax.random.fold_in(key, 2))
+    assert int(state.committed_slots) == c_drained  # fully stalled
+    assert not bool(np.asarray(state.prop_alive).any())
+
+    # Revive: pending commands re-broadcast on the retry timers and
+    # commits resume (the low crash_rate may fell an odd proposer
+    # again; the cluster as a whole must still progress).
+    state = dataclasses.replace(
+        state, prop_alive=jnp.ones((cfg.num_groups,), bool)
+    )
+    state, t = fm.run_ticks(cfg, state, t, 40, jax.random.fold_in(key, 3))
+    assert int(state.committed_slots) > c_drained
+    inv = fm.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_revival_triggers_recovery_election_rebroadcast():
+    """High revive_rate: the tick after the proposers are killed, the
+    crash/revive process brings (almost surely all of) them back, and
+    each revival transition re-broadcasts EVERY pending command of its
+    group at once (cmd_last_send stamps to the revival tick, ahead of
+    the retry timers) and records a recovery election as a telemetry
+    leader change."""
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    cfg = _crash_cfg(crash_rate=0.001, revive_rate=0.99)
+    key = jax.random.PRNGKey(2)
+    state, t = fm.run_ticks(cfg, fm.init_state(cfg), jnp.int32(0), 20, key)
+    lc0 = int(state.telemetry.totals[COL["leader_changes"]])
+    assert int(jnp.sum(state.cmd_status == 1)) > 0
+
+    state = dataclasses.replace(
+        state, prop_alive=jnp.zeros((cfg.num_groups,), bool)
+    )
+    # ONE tick: the revive draw fires per group with p=0.99.
+    state, t = fm.run_ticks(cfg, state, t, 1, jax.random.fold_in(key, 1))
+    alive = np.asarray(state.prop_alive)
+    assert alive.any()  # p(all four stay dead) = 1e-8
+    lc1 = int(state.telemetry.totals[COL["leader_changes"]])
+    assert lc1 - lc0 == int(alive.sum())  # one election per revival
+    # Every pending command of a revived group was re-stamped at the
+    # revival tick.
+    ls = np.asarray(state.cmd_last_send)
+    pending = np.asarray(state.cmd_status) == 1
+    mask = pending & alive[:, None]
+    assert mask.any()
+    assert (ls[mask] == int(t) - 1).all()
+
+
+def test_crash_plan_randomized_schedules_hold_invariants():
+    """The simtest axis the satellite adds: randomized crash/revive
+    schedules over the proposer plane keep every invariant and make
+    progress (liveness after revival — revive_rate keeps dead windows
+    finite)."""
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    spec = simtest.SPECS["fastmultipaxos"]
+    assert spec.crash_ok  # the crash axis is enabled for this backend
+    plan = FaultPlan(crash_rate=0.05, revive_rate=0.3)
+    out = simtest.run_many_seeds(spec, plan, seeds=(0, 1, 2, 3), ticks=80)
+    assert out["ok"], out
+    assert all(p > 0 for p in out["progress"])  # commits despite crashes
