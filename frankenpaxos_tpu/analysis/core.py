@@ -22,8 +22,14 @@ from frankenpaxos_tpu.analysis import astutil
 # recorded by bench.py for artifact provenance. 1.2: trace-donation-alias
 # also compiles the sharded run_ticks wrappers (parallel/sharding.py
 # registry) and requires alias coverage under a mesh; the backend
-# inventory floor rose to 14 (compartmentalized).
-ANALYSIS_VERSION = "1.3"
+# inventory floor rose to 14 (compartmentalized). 1.4: the workload
+# engine contracts — four AST rules mirroring the fault contracts
+# (workload-config-field/validate/apply + workload-rate-validated on
+# the plan itself) and two trace rules (trace-workload-noop: the none
+# plan is all-empty state feeding zero tick equations;
+# trace-workload-retrace: the traced [rate x fault-rate] sweep never
+# grows the jit cache).
+ANALYSIS_VERSION = "1.4"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

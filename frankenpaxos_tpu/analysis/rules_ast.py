@@ -526,6 +526,198 @@ def check_rate_validated(ctx: Context) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Workload engine (the faults contracts mirrored for tpu/workload.py)
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "workload-config-field",
+    "ast",
+    "every batched *Config accepts a `workload: WorkloadPlan` field",
+)
+def check_workload_config(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        classes = astutil.classes_with_suffix(tree, "Config")
+        if not classes:
+            out.append(
+                Finding(
+                    rule="workload-config-field",
+                    path=_rel(ctx, path),
+                    line=0,
+                    message="no *Config dataclass found",
+                    key=f"{path.name}:<missing>",
+                )
+            )
+            continue
+        for cls in classes:
+            ann = astutil.ann_fields(cls).get("workload")
+            if ann is None or "WorkloadPlan" not in ann:
+                out.append(
+                    Finding(
+                        rule="workload-config-field",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name} lacks a `workload: WorkloadPlan`"
+                            " field (tpu/workload.py contract)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+@rule(
+    "workload-validate",
+    "ast",
+    "every batched *Config.__post_init__ calls workload.validate(...) "
+    "so malformed traffic shapes fail at config time",
+)
+def check_workload_validate(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            post = [
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "__post_init__"
+            ]
+            if not post:
+                out.append(
+                    Finding(
+                        rule="workload-validate",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=f"{cls.name} has no __post_init__",
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+                continue
+            calls_validate = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "validate"
+                and "workload" in ast.unparse(n.func.value)
+                for n in ast.walk(post[0])
+            )
+            if not calls_validate:
+                out.append(
+                    Finding(
+                        rule="workload-validate",
+                        path=_rel(ctx, path),
+                        line=post[0].lineno,
+                        message=(
+                            f"{cls.name}.__post_init__ never calls "
+                            "self.workload.validate(...)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+def _tick_applies_workload(func: ast.FunctionDef) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute) and node.attr == "workload":
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("workload_mod", "workload")
+        ):
+            return True
+    return False
+
+
+@rule(
+    "workload-apply",
+    "ast",
+    "every batched tick actually applies the configured WorkloadPlan "
+    "(admission gates its propose path)",
+)
+def check_workload_apply(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in astutil.batched_files(ctx.root):
+        tree = astutil.parse_file(path)
+        for func in astutil.functions_named(tree, ("tick",)):
+            if not _tick_applies_workload(func):
+                out.append(
+                    Finding(
+                        rule="workload-apply",
+                        path=_rel(ctx, path),
+                        line=func.lineno,
+                        message=(
+                            "tick accepts a WorkloadPlan via config but "
+                            "never applies it"
+                        ),
+                        key=path.name,
+                    )
+                )
+    return out
+
+
+@rule(
+    "workload-rate-validated",
+    "ast",
+    "every float field of the WorkloadPlan dataclass is range-checked "
+    "in its validate() body",
+)
+def check_workload_rate_validated(ctx: Context) -> List[Finding]:
+    path = ctx.root / "tpu" / "workload.py"
+    if not path.exists():
+        return [
+            Finding(
+                rule="workload-rate-validated",
+                path="tpu/workload.py",
+                line=0,
+                message=(
+                    "no tpu/workload.py module found — the workload "
+                    "engine is missing"
+                ),
+                key="workload.py:<missing>",
+            )
+        ]
+    out: List[Finding] = []
+    tree = astutil.parse_file(path)
+    for cls in astutil.classes_with_suffix(tree, "Plan"):
+        float_fields = [
+            name
+            for name, ann in astutil.ann_fields(cls).items()
+            if "float" in ann
+        ]
+        validate = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "validate"
+            ),
+            None,
+        )
+        body_src = ast.unparse(validate) if validate else ""
+        for name in float_fields:
+            if f"self.{name}" not in body_src:
+                out.append(
+                    Finding(
+                        rule="workload-rate-validated",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name}.{name} is never range-checked "
+                            "in validate() — an out-of-range rate "
+                            "shapes a different traffic regime"
+                        ),
+                        key=f"{path.name}:{cls.name}:{name}",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Kernel layer (PR 4 contract)
 # ---------------------------------------------------------------------------
 

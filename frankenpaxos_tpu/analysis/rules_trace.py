@@ -122,17 +122,43 @@ def _walk_eqns(jaxpr, out: list) -> None:
                     _walk_eqns(item, out)
 
 
+# Default-config tick traces are shared across trace rules in one
+# process (trace-dtype-policy and trace-workload-noop both want the
+# SAME analysis_config() jaxpr; re-tracing a big tick body costs
+# seconds per backend on a small host). Keyed by backend name; rules
+# that trace a NON-default config bypass the cache.
+_TICK_TRACE_CACHE: Dict[str, tuple] = {}
+
+
+def _tick_closed(backend: str):
+    """(closed_jaxpr, state) of ``tick`` at the backend's default
+    analysis_config(), memoized per process."""
+    if backend not in _TICK_TRACE_CACHE:
+        import jax
+        import jax.numpy as jnp
+
+        mod = _module(backend)
+        cfg = mod.analysis_config()
+        state = mod.init_state(cfg)
+        closed = jax.make_jaxpr(
+            lambda s, t, k: mod.tick(cfg, s, t, k)
+        )(state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+        _TICK_TRACE_CACHE[backend] = (closed, state)
+    return _TICK_TRACE_CACHE[backend]
+
+
 def _tick_eqns(backend: str, cfg=None) -> list:
     import jax
     import jax.numpy as jnp
 
-    mod = _module(backend)
     if cfg is None:
-        cfg = mod.analysis_config()
-    state = mod.init_state(cfg)
-    closed = jax.make_jaxpr(
-        lambda s, t, k: mod.tick(cfg, s, t, k)
-    )(state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+        closed, _ = _tick_closed(backend)
+    else:
+        mod = _module(backend)
+        state = mod.init_state(cfg)
+        closed = jax.make_jaxpr(
+            lambda s, t, k: mod.tick(cfg, s, t, k)
+        )(state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
     eqns: list = []
     _walk_eqns(closed.jaxpr, eqns)
     return eqns
@@ -606,6 +632,195 @@ def check_shardmap_kernel(ctx: Context) -> List[Finding]:
                         "pure jnp"
                     ),
                     key=f"{backend}:reference:{n_ref}",
+                )
+            )
+    return out
+
+
+@rule(
+    "trace-workload-noop",
+    "trace",
+    "under WorkloadPlan.none() every workload State leaf is zero-sized "
+    "and feeds no tick equation — the structural no-op contract that "
+    "keeps default runs bit-identical to the pre-workload program",
+)
+def check_workload_noop(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+    import jax.numpy as jnp
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        # Shared with trace-dtype-policy: ONE default-config tick trace
+        # per backend per process (_tick_closed).
+        closed, state = _tick_closed(backend)
+        # (a) Structure: an all-empty shaping state under the default
+        # none plan — a sized leaf is carried HBM bytes on every tick.
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        wl_idx = [
+            i
+            for i, (path, leaf) in enumerate(flat)
+            if path
+            and getattr(path[0], "name", None) == "workload"
+        ]
+        if not wl_idx:
+            out.append(
+                Finding(
+                    rule="trace-workload-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "State carries no workload field — the engine "
+                        "is not threaded through this backend"
+                    ),
+                    key=f"{backend}:missing",
+                )
+            )
+            continue
+        sized = [
+            flat[i][1].size for i in wl_idx if flat[i][1].size != 0
+        ]
+        if sized:
+            out.append(
+                Finding(
+                    rule="trace-workload-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"WorkloadPlan.none() state carries "
+                        f"{len(sized)} NON-empty leaf/leaves — the "
+                        "none plan must be structurally empty"
+                    ),
+                    key=f"{backend}:sized",
+                )
+            )
+        # (b) Zero ops: no tick equation may consume a workload leaf —
+        # they must pass straight through the carry untouched.
+        invars = closed.jaxpr.invars
+        wl_vars = {id(invars[i]) for i in wl_idx}
+        consumed = sum(
+            1
+            for eqn in closed.jaxpr.eqns
+            for v in eqn.invars
+            if id(v) in wl_vars
+        )
+        if consumed:
+            out.append(
+                Finding(
+                    rule="trace-workload-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"{consumed} tick equation input(s) consume a "
+                        "workload leaf under WorkloadPlan.none() — the "
+                        "none plan must add ZERO ops (XLA cannot DCE a "
+                        "consumed carry)"
+                    ),
+                    key=f"{backend}:consumed",
+                )
+            )
+    return out
+
+
+# Backends whose traced sweep gets the COMPILE-backed jit-cache check
+# (the XLA-compile half of the retrace rule). The cheap trace-only
+# coverage below still runs for every backend — the traced-rate
+# plumbing is the shared faults.py helper surface, and the helpers'
+# own "rates= required" assert fires at TRACE time for any backend
+# that missed the threading; compiling all 14 would only re-prove the
+# cache behavior the representative set already pins, at ~10 extra
+# XLA compiles per lint run.
+RETRACE_COMPILE_BACKENDS = (
+    "compartmentalized", "craq", "multipaxos", "unreplicated",
+)
+
+
+@rule(
+    "trace-workload-retrace",
+    "trace",
+    "sweeping the traced offered rate AND the traced FaultPlan rates "
+    "replays ONE compiled program — every backend traces the "
+    "[workload x fault-rate] config cleanly, and the representative "
+    "set's jit cache must not grow across the grid",
+)
+def check_workload_retrace(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import workload as _workload
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        mod = _module(backend)
+        cfg = mod.analysis_config(
+            faults=FaultPlan(traced=True),
+            workload=WorkloadPlan(arrival="constant", rate=1.0),
+        )
+        # (a) Every backend: the traced [workload x fault] config must
+        # TRACE cleanly — the fault helpers assert rates= was threaded
+        # (tpu/faults.py _rate), so a backend that accepted a traced
+        # plan but never passed its rate state fails right here, no
+        # compile needed.
+        try:
+            state = mod.init_state(cfg)
+            jax.make_jaxpr(lambda s, t, k: mod.tick(cfg, s, t, k))(
+                state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0)
+            )
+        except AssertionError as e:
+            out.append(
+                Finding(
+                    rule="trace-workload-retrace",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "tick failed to trace the traced [workload x "
+                        f"fault-rate] config: {e}"
+                    ),
+                    key=f"{backend}:trace",
+                )
+            )
+            continue
+        if backend not in RETRACE_COMPILE_BACKENDS:
+            continue
+        # (b) Representative set: the compile-backed cache check.
+
+        def run(st):
+            st, t = mod.run_ticks(
+                cfg, st, jnp.zeros((), jnp.int32), _TICKS,
+                jax.random.PRNGKey(0),
+            )
+            jax.block_until_ready(t)
+
+        run(mod.init_state(cfg))
+        before = mod.run_ticks._cache_size()
+        swept = mod.init_state(cfg)
+        swept = _dc.replace(
+            swept,
+            workload=_workload.set_fault_rates(
+                _workload.set_rate(swept.workload, 2.5),
+                drop=0.2, dup=0.1, crash=0.01, revive=0.2,
+            ),
+        )
+        run(swept)
+        after = mod.run_ticks._cache_size()
+        if after > before:
+            out.append(
+                Finding(
+                    rule="trace-workload-retrace",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "sweeping the traced offered rate + fault "
+                        f"rates missed the jit cache ({before} -> "
+                        f"{after} entries) — a rate landed in a static "
+                        "argument and the grid recompiles per point"
+                    ),
+                    key=backend,
                 )
             )
     return out

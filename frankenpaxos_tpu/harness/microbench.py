@@ -528,6 +528,112 @@ def bench_faults(
     return rows
 
 
+def measure_workload_overhead(cfg, ticks: int, rounds: int = 3) -> dict:
+    """Shaping-overhead benchmark: the SAME flagship config run at
+    saturation (``WorkloadPlan.none()`` — the structural no-op
+    baseline) vs under each workload-engine machinery tier
+    (tpu/workload.py): ``constant`` (deterministic fixed-point
+    arrivals + Zipf skew + FIFO backlog + exact wait binning),
+    ``poisson`` (adds the per-tick Poisson draw), and ``closed``
+    (outstanding-request window + think ring). Rates are pinned at the
+    config's own slots_per_tick so every variant moves comparable
+    protocol work per tick and the ratio prices the SHAPING machinery,
+    not a lighter load.
+
+    Timed via :func:`_interleaved_best`. Returns ``{"plans",
+    "seconds", "rates" (ticks/sec), "ratios" (case/none — the <2%
+    budget gate is the `constant` tier, the matrix's default
+    process), "committed", "sims"}``. Shared by the ``workload``
+    device bench and ``bench.py --workload``."""
+    import dataclasses as _dc
+
+    from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    rate = float(cfg.slots_per_tick)
+    plans = {
+        "none": WorkloadPlan.none(),
+        "constant": WorkloadPlan(
+            arrival="constant", rate=rate, zipf_s=0.8
+        ),
+        "poisson": WorkloadPlan(
+            arrival="poisson", rate=rate, zipf_s=0.8
+        ),
+        "closed": WorkloadPlan(
+            closed_window=2 * cfg.slots_per_tick, think_time=2
+        ),
+    }
+    sims = {
+        case: TpuSimTransport(_dc.replace(cfg, workload=p), seed=0)
+        for case, p in plans.items()
+    }
+    best = _interleaved_best(sims, ticks, rounds)
+    rates = {case: ticks / s for case, s in best.items()}
+    return {
+        "plans": {case: p.to_dict() for case, p in plans.items()},
+        "seconds": best,
+        "rates": rates,
+        "ratios": {
+            case: rates[case] / rates["none"]
+            for case in plans
+            if case != "none"
+        },
+        "committed": {case: sims[case].committed() for case in sims},
+        "sims": sims,
+    }
+
+
+def bench_workload(
+    num_groups: int = 3334,
+    window: int = 64,
+    slots_per_tick: int = 8,
+    ticks: int = 200,
+) -> List[dict]:
+    """The workload-engine device bench on the flagship 10k-acceptor
+    config: saturation vs each shaping tier, ticks/sec + committed,
+    with the overhead ratios and the <2% budget verdict (on the
+    ``constant`` tier) on a ``WORKLOAD_JSON`` line. Evidence artifact:
+    ``results/workload_overhead_r12.json``."""
+    import json
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=num_groups,
+        window=window,
+        slots_per_tick=slots_per_tick,
+        lat_min=1,
+        lat_max=3,
+        retry_timeout=16,
+        thrifty=True,
+    )
+    measured = measure_workload_overhead(cfg, ticks)
+    rows = []
+    for case in ("none", "constant", "poisson", "closed"):
+        row = _report("workload", case, ticks, measured["seconds"][case])
+        row["committed"] = measured["committed"][case]
+        if case != "none":
+            row["overhead_ratio"] = round(measured["ratios"][case], 4)
+        rows.append(row)
+    payload = {
+        "num_acceptors": cfg.num_acceptors,
+        "ticks": ticks,
+        "ticks_per_sec": {
+            case: round(r, 2) for case, r in measured["rates"].items()
+        },
+        "committed": measured["committed"],
+        "ratios": {
+            case: round(r, 4) for case, r in measured["ratios"].items()
+        },
+        # The budget tier: the matrix's default (constant) machinery.
+        "budget_ok": measured["ratios"]["constant"] >= 0.98,
+        "plans": measured["plans"],
+    }
+    print("WORKLOAD_JSON " + json.dumps(payload))
+    return rows
+
+
 def _kernel_cases(A=3, G=3334, W=64, N=3334, L=3, KV=16, CW=16, seed=0):
     """Random dtype-policy-native inputs for every registered kernel
     plane (flagship-shaped by default): ``{plane: (args, statics)}``.
@@ -1319,6 +1425,7 @@ DEVICE_BENCHES = {
     "hbm": bench_hbm,
     "telemetry": bench_telemetry,
     "faults": bench_faults,
+    "workload": bench_workload,
     "kernels": bench_kernels,
     "fused_tick": bench_fused_tick,
     "grid_vote": bench_grid_vote,

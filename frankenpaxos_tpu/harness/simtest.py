@@ -8,7 +8,11 @@ small config factory, a progress (liveness) counter, and its partition
 axis; the harness then
 
   * draws randomized :class:`FaultPlan` schedules (:func:`random_plan` —
-    deterministic from a ``random.Random`` seed),
+    deterministic from a ``random.Random`` seed) and, JOINTLY, randomized
+    :class:`WorkloadPlan` traffic shapes (:func:`random_workload`:
+    open-loop arrival processes with Zipf skew, read/write mixes where
+    the backend has a read path, and closed-loop client windows — the
+    [workload x fault] axis of tpu/workload.py),
   * runs them while checking ``check_invariants`` after every segment
     (:func:`run_schedule`),
   * fans the SEED axis out on-device: one compiled scan, vmapped over
@@ -59,6 +63,7 @@ from frankenpaxos_tpu.tpu import (
     vanillamencius_batched,
 )
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
 
 # Segment grid: schedule boundaries (partition start/heal) snap to
 # multiples of this so run_schedule's per-segment compiles are reused
@@ -84,6 +89,9 @@ class SimSpec:
     # cut column's instances must still fit the frontier-history ring
     # at the heal tick, or its config assertion fires).
     max_partition_span: Optional[int] = None
+    # The backend's analysis config has a device read path, so
+    # random_workload may draw a read/write mix for it.
+    read_mix_ok: bool = False
 
 
 def _specs() -> Dict[str, SimSpec]:
@@ -166,6 +174,7 @@ def _specs() -> Dict[str, SimSpec]:
             "craq", cr,
             cr.analysis_config,
             lambda st: st.writes_done, partition_axis=3, crash_ok=False,
+            read_mix_ok=True,
         ),
         SimSpec(
             "epaxos", ep,
@@ -194,6 +203,7 @@ def _specs() -> Dict[str, SimSpec]:
             "compartmentalized", cz,
             cz.analysis_config,
             lambda st: st.committed + st.reads_done, partition_axis=4,
+            read_mix_ok=True,
         ),
     ]
     return {s.name: s for s in entries}
@@ -244,6 +254,50 @@ def random_plan(
     return FaultPlan(**kw)
 
 
+def random_workload(
+    rng: _random.Random, spec: SimSpec, horizon: int
+) -> WorkloadPlan:
+    """One randomized traffic shape, deterministic from ``rng``'s
+    state — the workload half of the joint [workload x fault]
+    randomization. ~30% saturation (the pre-workload behavior), ~15%
+    pure closed loop, else an open-loop arrival process with optional
+    Zipf skew, closed window, and (where the backend has a read path)
+    a read/write mix. Rates are sized for the SMALL analysis configs
+    (1-3 proposals per lane per tick)."""
+    r = rng.random()
+    if r < 0.30:
+        return WorkloadPlan.none()
+    if r < 0.45:  # pure closed loop (admission gated on completions)
+        return WorkloadPlan(
+            closed_window=rng.randint(2, 8),
+            think_time=rng.randint(0, 3),
+        )
+    kw: dict = {
+        "arrival": rng.choice(
+            ["constant", "poisson", "bursty", "diurnal"]
+        ),
+        "rate": round(rng.uniform(0.3, 2.5), 2),
+    }
+    if kw["arrival"] == "bursty":
+        kw["burst_every"] = rng.choice([16, 32, 64])
+        kw["burst_len"] = rng.randint(2, 8)
+        kw["burst_mult"] = round(rng.uniform(2.0, 5.0), 1)
+    elif kw["arrival"] == "diurnal":
+        kw["phases"] = tuple(
+            round(rng.uniform(0.3, 3.0), 2)
+            for _ in range(rng.randint(2, 4))
+        )
+        kw["phase_len"] = rng.choice([8, 16, 32])
+    if rng.random() < 0.5:
+        kw["zipf_s"] = round(rng.uniform(0.3, 1.2), 2)
+    if spec.read_mix_ok and rng.random() < 0.4:
+        kw["read_fraction"] = round(rng.uniform(0.1, 0.5), 2)
+    if rng.random() < 0.35:
+        kw["closed_window"] = rng.randint(2, 8)
+        kw["think_time"] = rng.randint(0, 3)
+    return WorkloadPlan(**kw)
+
+
 # ---------------------------------------------------------------------------
 # Running schedules
 # ---------------------------------------------------------------------------
@@ -275,6 +329,7 @@ def run_schedule(
     seed: int,
     ticks: int = 3 * SEGMENT,
     segment: int = SEGMENT,
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> dict:
     """Run one (plan, seed) schedule in segments, checking invariants at
     every segment boundary. Per-tick keys fold the global tick index, so
@@ -285,7 +340,7 @@ def run_schedule(
     segment-end tick it was seen at; ``progress`` is the liveness
     counter at each boundary."""
     mod = spec.module
-    cfg = spec.make_config(plan)
+    cfg = spec.make_config(plan, workload=workload)
     state = mod.init_state(cfg)
     t = jnp.zeros((), jnp.int32)
     key = jax.random.PRNGKey(seed)
@@ -309,6 +364,7 @@ def run_schedule(
         "violations": violations,  # first-seen segment-end tick per check
         "progress": progress,
         "plan": plan.to_dict(),
+        "workload": workload.to_dict(),
         "seed": seed,
         "ticks": ticks,
     }
@@ -319,6 +375,7 @@ def run_many_seeds(
     plan: FaultPlan,
     seeds: Sequence[int],
     ticks: int = 2 * SEGMENT,
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> dict:
     """The device-scale axis: ONE compiled scan, vmapped over the seed
     axis, returning per-seed invariant verdicts and progress counters.
@@ -327,7 +384,7 @@ def run_many_seeds(
     seed-driven, so N seeds are N distinct fault histories for one
     compile."""
     mod = spec.module
-    cfg = spec.make_config(plan)
+    cfg = spec.make_config(plan, workload=workload)
 
     def one(key):
         def step(carry, i):
@@ -358,6 +415,7 @@ def run_many_seeds(
     return {
         "backend": spec.name,
         "plan": plan.to_dict(),
+        "workload": workload.to_dict(),
         "seeds": list(seeds),
         "ticks": ticks,
         "ok": all(per_seed_ok),
@@ -374,12 +432,13 @@ def check_liveness_after_heal(
     plan: FaultPlan,
     seed: int,
     recovery: int = 2 * SEGMENT,
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> dict:
     """For a plan with a scheduled heal: progress measured at the heal
     tick must strictly grow over the recovery window after it."""
     assert plan.has_partition and plan.partition_heal >= 0, plan
     mod = spec.module
-    cfg = spec.make_config(plan)
+    cfg = spec.make_config(plan, workload=workload)
     state = mod.init_state(cfg)
     t = jnp.zeros((), jnp.int32)
     key = jax.random.PRNGKey(seed)
@@ -573,9 +632,12 @@ def dump_reproducer(
     seed: int,
     ticks: int,
     note: str = "",
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> dict:
     """Write a minimized reproducer as JSON (the bad-history artifact):
-    backend + seed + tick horizon + the shrunk FaultPlan."""
+    backend + seed + tick horizon + the shrunk FaultPlan (+ the
+    workload shape the failure was found under; shrinking minimizes
+    the FAULT knobs — the workload rides along verbatim)."""
     payload = {
         "backend": spec.name,
         "seed": seed,
@@ -583,19 +645,27 @@ def dump_reproducer(
         "fault_plan": plan.to_dict(),
         "note": note,
     }
+    if workload.active:
+        payload["workload_plan"] = workload.to_dict()
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
     return payload
 
 
 def load_reproducer(path: str):
-    """Load a reproducer JSON: returns ``(spec, plan, seed, ticks)`` —
-    feed straight back into :func:`run_schedule`."""
+    """Load a reproducer JSON: returns ``(spec, plan, seed, ticks)``
+    (+ a 5th ``workload`` element when the artifact recorded an ACTIVE
+    workload shape) — feed straight back into :func:`run_schedule`."""
     with open(path) as f:
         payload = json.load(f)
     spec = SPECS[payload["backend"]]
     plan = FaultPlan.from_dict(payload["fault_plan"])
-    return spec, plan, int(payload["seed"]), int(payload["ticks"])
+    base = (spec, plan, int(payload["seed"]), int(payload["ticks"]))
+    if "workload_plan" in payload:
+        return base + (
+            WorkloadPlan.from_dict(payload["workload_plan"]),
+        )
+    return base
 
 
 # ---------------------------------------------------------------------------
@@ -611,11 +681,14 @@ def sweep(
     base_seed: int = 0,
     check_liveness: bool = True,
 ) -> dict:
-    """Randomized fault-schedule sweep over the registry: per backend,
-    ``schedules`` random plans x ``seeds_per_schedule`` vmapped seeds,
-    invariants checked on every run; plans with a scheduled heal also
-    get a liveness-after-heal assertion (where the spec supports it).
-    Returns a JSON-ready summary with every failure's (plan, seed)."""
+    """Randomized JOINT [workload x fault] sweep over the registry:
+    per backend, ``schedules`` random (FaultPlan, WorkloadPlan) pairs x
+    ``seeds_per_schedule`` vmapped seeds, invariants (incl. the
+    workload window-conservation check) on every run; plans with a
+    scheduled heal also get a liveness-after-heal assertion (where the
+    spec supports it; asserted under the drawn workload too — shaped
+    rates are sized so progress always resumes). Returns a JSON-ready
+    summary with every failure's (plan, workload, seed)."""
     names = list(backends) if backends else list(SPECS)
     out: dict = {"schedules": schedules, "seeds_per_schedule":
                  seeds_per_schedule, "ticks": ticks, "backends": {}}
@@ -631,13 +704,15 @@ def sweep(
         ran = 0
         for i in range(schedules):
             plan = random_plan(rng, spec, ticks)
+            wplan = random_workload(rng, spec, ticks)
             seeds = [base_seed + i * seeds_per_schedule + j
                      for j in range(seeds_per_schedule)]
-            res = run_many_seeds(spec, plan, seeds, ticks)
+            res = run_many_seeds(spec, plan, seeds, ticks, workload=wplan)
             ran += len(seeds)
             if not res["ok"]:
                 failures.append(
                     {"plan": plan.to_dict(),
+                     "workload": wplan.to_dict(),
                      "failing_seeds": res["failing_seeds"]}
                 )
             if (
@@ -647,7 +722,9 @@ def sweep(
                 and plan.partition_heal >= 0
                 and not plan.has_crash
             ):
-                lv = check_liveness_after_heal(spec, plan, seeds[0])
+                lv = check_liveness_after_heal(
+                    spec, plan, seeds[0], workload=wplan
+                )
                 liveness_rows.append(lv)
         resumed = sum(r["resumed"] for r in liveness_rows)
         out["backends"][name] = {

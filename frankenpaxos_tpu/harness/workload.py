@@ -1,6 +1,16 @@
 """Workload generators (the analog of ``jvm/.../Workload.scala`` and
 ``benchmarks/workload.py``): each workload produces state-machine command
-bytes; parsed from JSON dicts the way the reference parses pbtxt."""
+bytes; parsed from JSON dicts the way the reference parses pbtxt.
+
+ONE config surface with the device engine: every generator here and the
+in-graph :class:`frankenpaxos_tpu.tpu.workload.WorkloadPlan` share the
+same ``{"type": ..., ...}`` JSON dict schema and round-trip through
+:func:`workload_from_dict` (a ``"device_plan"`` dict deserializes to
+the device plan), and the skewed generators draw their key weights from
+the SAME :func:`frankenpaxos_tpu.tpu.workload.zipf_weights` vector the
+device engine skews its lane arrivals with — so a host command-byte
+workload and a device traffic shape describing the same experiment are
+one JSON document apart, not two vocabularies."""
 
 from __future__ import annotations
 
@@ -10,6 +20,7 @@ import string
 from typing import Dict
 
 from frankenpaxos_tpu.statemachine import kv_get, kv_set
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, zipf_weights
 
 
 @dataclasses.dataclass
@@ -112,8 +123,44 @@ class ReadWriteWorkload:
         }
 
 
+@dataclasses.dataclass
+class ZipfSingleKeyWorkload:
+    """KV sets over a Zipf-skewed choice of num_keys keys — the host
+    command-byte twin of the device engine's hot-key axis: the key
+    weights are exactly ``tpu.workload.zipf_weights(num_keys, zipf_s)``
+    (key 0 is the hot key), so a host run and a device ``WorkloadPlan``
+    with the same ``zipf_s`` skew the same distribution."""
+
+    num_keys: int = 100
+    zipf_s: float = 1.0
+    size_mean: int = 8
+
+    def __post_init__(self):
+        self._weights = list(zipf_weights(self.num_keys, self.zipf_s))
+
+    def get(self, rng: random.Random) -> bytes:
+        key = f"k{rng.choices(range(self.num_keys), self._weights)[0]}"
+        value = "".join(
+            rng.choice(string.ascii_lowercase) for _ in range(self.size_mean)
+        )
+        return kv_set((key, value))
+
+    def to_dict(self) -> Dict:
+        return {
+            "type": "zipf_single_key",
+            "num_keys": self.num_keys,
+            "zipf_s": self.zipf_s,
+            "size_mean": self.size_mean,
+        }
+
+
 def workload_from_dict(data: Dict):
+    """The shared deserializer: host command-byte generators AND the
+    device :class:`WorkloadPlan` (``type: "device_plan"``) come back
+    from the same JSON dict schema."""
     kind = data.get("type")
+    if kind == "device_plan":
+        return WorkloadPlan.from_dict(data)
     data = {k: v for k, v in data.items() if k != "type"}
     if kind == "string":
         return StringWorkload(**data)
@@ -121,6 +168,8 @@ def workload_from_dict(data: Dict):
         return UniformSingleKeyWorkload(**data)
     if kind == "bernoulli_single_key":
         return BernoulliSingleKeyWorkload(**data)
+    if kind == "zipf_single_key":
+        return ZipfSingleKeyWorkload(**data)
     if kind == "read_write":
         return ReadWriteWorkload(**data)
     raise ValueError(f"unknown workload type {kind!r}")

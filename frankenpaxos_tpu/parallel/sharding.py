@@ -266,8 +266,11 @@ register_sharding(
             "sm_applied", "dups_filtered", "dups_seen",
             # The telemetry ring holds cluster-wide per-tick reductions
             # ([K, NUM_COLS] + histograms) — replicated; device_put
-            # broadcasts the spec over the nested pytree's leaves.
-            "telemetry",
+            # broadcasts the spec over the nested pytree's leaves. The
+            # workload shaping state replicates the same way (all-empty
+            # under WorkloadPlan.none(); tiny [G]-sized bookkeeping
+            # otherwise).
+            "telemetry", "workload",
         }),
         axis_pos={
             name: 1
@@ -300,6 +303,7 @@ register_sharding(
             "committed_total", "fast_path_total", "executed_total",
             "retired_total", "coexecuted", "lat_sum", "lat_hist",
             "snapshots_served", "rep_crashes", "rep_down", "telemetry",
+            "workload",
         }),
         axis_pos={name: 1 for name in ("fpre", "fpost", "rep_exec")},
         axis_len=lambda st: st.head.shape[0],
@@ -324,6 +328,7 @@ register_sharding(
             "bat_shed", "committed", "batches_committed", "retired",
             "writes_done", "lat_sum", "lat_hist", "reads_done",
             "reads_shed", "read_lat_sum", "read_lat_hist", "telemetry",
+            "workload",
         }),
         axis_pos={
             **{name: 2 for name in ("p2a_arrival", "p2b_arrival")},

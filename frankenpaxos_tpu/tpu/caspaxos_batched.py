@@ -48,7 +48,9 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Leader status.
@@ -79,6 +81,12 @@ class BatchedCasPaxosConfig:
     # quorum permanently stalls affected leaders — that is the real
     # failure mode). FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): a shaping plan
+    # replaces the Bernoulli op_rate draw with the engine's per-lane
+    # admission (lane axis = the L x G leaders; an op is one register
+    # bit, so each lane admits at most one op per tick and the FIFO
+    # backlog carries the rest). WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def n(self) -> int:
@@ -95,6 +103,7 @@ class BatchedCasPaxosConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert 1 <= self.backoff_min <= self.backoff_max
         self.faults.validate(axis=self.n)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -143,6 +152,7 @@ class BatchedCasPaxosState:
     chain_violations: jnp.ndarray  # [] THE safety counter
     lat_sum: jnp.ndarray  # [] per-bit issue -> chosen latency
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -185,6 +195,9 @@ def init_state(cfg: BatchedCasPaxosConfig) -> BatchedCasPaxosState:
         chain_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_leaders * cfg.num_registers, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -211,12 +224,15 @@ def tick(
     # arrival offsets below replace every `t + *_lat` write; under a
     # none plan they ARE `t + *_lat` (structural no-op).
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     if fp.active:
         kf = faults_mod.fault_key(key)
         dn_lat = faults_mod.tcp_latency(fp, jax.random.fold_in(kf, 0),
-                                        (A, L, G), dn_lat)
+                                        (A, L, G), dn_lat, rates=frates)
         up_lat = faults_mod.tcp_latency(fp, jax.random.fold_in(kf, 1),
-                                        (A, L, G), up_lat)
+                                        (A, L, G), up_lat, rates=frates)
     dn_arr = t + dn_lat
     up_arr = t + up_lat
     if fp.has_partition:
@@ -354,9 +370,10 @@ def tick(
 
     # Committed pending bits retire (idempotent union: anything of ours
     # now in the register needs no re-proposal).
-    l_pending = state.l_pending & ~jnp.where(
+    cleared_bits = state.l_pending & jnp.where(
         committed_mask, state.l_value, jnp.uint32(0)
     )
+    l_pending = state.l_pending & ~cleared_bits
 
     # ---- 4. Leader transitions.
     l_status = state.l_status
@@ -382,9 +399,28 @@ def tick(
     # probability op_rate (CasClient.propose: a singleton int-set).
     # The shared never-quantize-nonzero-to-zero rule, via the shared
     # helper (bit_delivered returns True w.p. 1 - rate).
-    new_op = ~bit_delivered(bits2, 8, cfg.op_rate)
+    if wl.active:
+        # Workload admission (tpu/workload.py): the engine's per-lane
+        # cap replaces the Bernoulli op_rate draw (>=1 queued/ready op
+        # admits one bit this tick). A drawn bit already pending on the
+        # lane is absorbed idempotently and NOT counted admitted, so
+        # the closed-loop window stays conserved.
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, L * G)
+        adm = workload_mod.admission(wl, wls, wl_writes).reshape(L, G)
+        new_op = adm >= 1
+    else:
+        new_op = ~bit_delivered(bits2, 8, cfg.op_rate)
     new_bit_idx = ((bits2 >> 16) & jnp.uint32(0x1F)).astype(jnp.uint32)
     new_bit = jnp.where(new_op, jnp.uint32(1) << new_bit_idx, jnp.uint32(0))
+    if wl.active:
+        fresh_bit = new_bit & ~l_pending
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes,
+            jax.lax.population_count(fresh_bit)
+            .astype(jnp.int32).reshape(L * G),
+            jax.lax.population_count(cleared_bits)
+            .astype(jnp.int32).reshape(L * G),
+        )
     l_pending = l_pending | new_bit
     # Per-bit issue bookkeeping (first issue wins).
     issued_now = jnp.zeros((G, NBITS), bool)
@@ -467,6 +503,7 @@ def tick(
         chain_violations=chain_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -513,6 +550,9 @@ def check_invariants(
     status_ok = jnp.all((state.l_status >= L_IDLE) & (state.l_status <= L_BACK))
     return {
         "chain_ok": chain_ok,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "owned_ok": owned_ok,
         "promise_ok": promise_ok,
         "books_ok": books_ok,
@@ -542,6 +582,7 @@ def stats(cfg: BatchedCasPaxosConfig, state: BatchedCasPaxosState, t) -> dict:
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedCasPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -551,4 +592,5 @@ def analysis_config(
     well under a second."""
     return BatchedCasPaxosConfig(
         num_registers=4, num_leaders=2, op_rate=0.3, faults=faults,
+        workload=workload,
     )

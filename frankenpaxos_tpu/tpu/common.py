@@ -146,15 +146,26 @@ def bit_latency(
 
 
 def bit_delivered(
-    bits: jnp.ndarray, shift: int, drop_rate: float
+    bits: jnp.ndarray, shift: int, drop_rate
 ) -> jnp.ndarray:
     """Bernoulli delivery mask from an 8-bit field (loss quantized to
-    multiples of 1/256 — a sim parameter, not a measured quantity)."""
-    if drop_rate == 0.0:
-        return jnp.ones(bits.shape, bool)
-    # Never round a requested nonzero loss down to zero loss.
-    threshold = max(1, int(round(drop_rate * 256)))
-    field = (bits >> shift) & jnp.uint32(0xFF)
+    multiples of 1/256 — a sim parameter, not a measured quantity).
+
+    ``drop_rate`` is a Python float (the static path, unchanged bit for
+    bit) or a TRACED float32 scalar (a ``FaultPlan(traced=True)``
+    state-side rate, tpu/faults.py): the traced path applies the same
+    1/256 quantization and never-round-nonzero-to-zero floor, so a
+    traced rate r reproduces the static plan's mask for the same r."""
+    if isinstance(drop_rate, (int, float)):
+        if drop_rate == 0.0:
+            return jnp.ones(bits.shape, bool)
+        # Never round a requested nonzero loss down to zero loss.
+        threshold = max(1, int(round(drop_rate * 256)))
+        field = (bits >> shift) & jnp.uint32(0xFF)
+        return field >= threshold
+    q = jnp.round(drop_rate * 256.0).astype(jnp.int32)
+    threshold = jnp.where(drop_rate > 0.0, jnp.maximum(q, 1), 0)
+    field = ((bits >> shift) & jnp.uint32(0xFF)).astype(jnp.int32)
     return field >= threshold
 
 

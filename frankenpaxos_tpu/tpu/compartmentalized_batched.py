@@ -85,7 +85,9 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Ring slot status codes (a slot holds one BATCH of batch_size commands).
@@ -133,6 +135,16 @@ class BatchedCompartmentalizedConfig:
     # crash/revive on the proxy-leader plane, and read probes defer
     # across a cut row. FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-GROUP
+    # client arrivals into the batcher plane (split across the group's
+    # B batchers, bounded by batcher headroom — the engine's FIFO
+    # backlog replaces the batcher shed under a shaping plan); a
+    # read/write mix routes the read share to the read batchers.
+    # Completions are client-counted committed ENTRIES. Closed loop
+    # needs closed_window >= batch_size (a lane must be able to fill a
+    # batch, else a partial batch deadlocks the window).
+    # WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def acceptors_per_group(self) -> int:
@@ -162,6 +174,12 @@ class BatchedCompartmentalizedConfig:
         else:
             assert self.read_window == 0
         self.faults.validate(axis=self.acceptors_per_group)
+        self.workload.validate(reads_supported=self.read_rate > 0)
+        if self.workload.closed:
+            assert self.workload.closed_window >= self.batch_size, (
+                "compartmentalized closed loop needs closed_window >= "
+                "batch_size (a partial batch would strand the window)"
+            )
         self.kernels.validate()
 
 
@@ -220,6 +238,7 @@ class BatchedCompartmentalizedState:
     reads_shed: jnp.ndarray  # [] reads shed by read-batcher backpressure
     read_lat_sum: jnp.ndarray  # [] read-weighted latency sum
     read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
+    workload: WorkloadState  # shaping state (tpu/workload.py)
 
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
@@ -265,6 +284,9 @@ def init_state(
         reads_shed=jnp.zeros((), jnp.int32),
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_groups, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -321,47 +343,69 @@ def tick(
     p2a_del = jnp.ones((R, C, G, W), bool)
     p2b_del = jnp.ones((R, C, G, W), bool)
     retry_del = jnp.ones((R, C, G, W), bool)
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, R * C).reshape(R, C, 1, 1)
         p2a_del, p2a_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (R, C, G, W), p2a_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (R, C, G, W), p2a_lat, link_up,
+            rates=frates,
         )
         p2b_del, p2b_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (R, C, G, W), p2b_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (R, C, G, W), p2b_lat, link_up,
+            rates=frates,
         )
         retry_del, retry_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 2), (R, C, G, W), retry_lat, link_up
+            fp, jax.random.fold_in(kf, 2), (R, C, G, W), retry_lat, link_up,
+            rates=frates,
         )
     if fp.active:
         kf = faults_mod.fault_key(key, 1)
         bat_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 0), (G, B), bat_lat
+            fp, jax.random.fold_in(kf, 0), (G, B), bat_lat, rates=frates
         )
         rep_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 1), (NR, G, W), rep_lat
+            fp, jax.random.fold_in(kf, 1), (NR, G, W), rep_lat,
+            rates=frates,
         )
         reply_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 2), (G, W), reply_lat
+            fp, jax.random.fold_in(kf, 2), (G, W), reply_lat, rates=frates
         )
 
     # 1. Proxy-leader crash/revive (the role's fault axis).
     proxy_alive = state.proxy_alive
     if fp.has_crash:
         proxy_alive = faults_mod.crash_step(
-            fp, faults_mod.fault_key(key, 2), proxy_alive
+            fp, faults_mod.fault_key(key, 2), proxy_alive, rates=frates
         )
 
     # 2. Batchers: admit client commands (shed past 2*batch_size — the
     # batcher's own backpressure), receive fired batches at the leader,
     # and ship full batches (one message each) when idle and the leader
     # inbox has room.
-    fill = state.bat_fill + cfg.arrivals_per_tick
     cap = 2 * BS
-    shed = jnp.maximum(fill - cap, 0)
-    fill = fill - shed
-    admitted = G * B * cfg.arrivals_per_tick - jnp.sum(shed)
-    bat_shed = state.bat_shed + jnp.sum(shed)
+    if wl.active:
+        # Workload admission (tpu/workload.py): the engine's per-group
+        # cap splits across the group's B batchers, bounded by batcher
+        # headroom; residual demand stays in the engine's FIFO backlog
+        # (the engine sheds at its own bound, so bat_shed stays 0).
+        wl_writes, wl_reads, wls = workload_mod.begin(wl, wls, key, t, G)
+        adm = workload_mod.admission(wl, wls, wl_writes)  # [G]
+        b_iota = jnp.arange(B, dtype=jnp.int32)[None, :]
+        want_b = (adm // B)[:, None] + (b_iota < (adm % B)[:, None])
+        take_b = jnp.minimum(want_b, cap - state.bat_fill)
+        fill = state.bat_fill + take_b
+        adm_g = jnp.sum(take_b, axis=1)  # [G] actual entries admitted
+        admitted = jnp.sum(adm_g)
+        bat_shed = state.bat_shed
+    else:
+        fill = state.bat_fill + cfg.arrivals_per_tick
+        shed = jnp.maximum(fill - cap, 0)
+        fill = fill - shed
+        admitted = G * B * cfg.arrivals_per_tick - jnp.sum(shed)
+        bat_shed = state.bat_shed + jnp.sum(shed)
     fired_b = bat_arrival == 0  # batch lands at the leader now
     pending = state.pending + jnp.sum(fired_b, axis=1)
     bat_arrival = jnp.where(fired_b, INF16, bat_arrival)
@@ -426,6 +470,13 @@ def tick(
     n_chosen = jnp.sum(newly_chosen)
     batches_committed = state.batches_committed + n_chosen
     committed = state.committed + BS * n_chosen
+    if wl.active:
+        # Completions: committed ENTRIES per group (batches x BS — the
+        # client-counted unit the batchers admitted).
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, adm_g,
+            BS * jnp.sum(newly_chosen, axis=1),
+        )
     ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
 
     # 6. Replica 0 hands newly-executed batches to the unbatcher, which
@@ -543,7 +594,8 @@ def tick(
         )
         if fp.active:
             probe_lat = faults_mod.tcp_latency(
-                fp, faults_mod.fault_key(key, 3), (NR, G, RW), probe_lat
+                fp, faults_mod.fault_key(key, 3), (NR, G, RW), probe_lat,
+                rates=frates,
             )
         if fp.has_partition:
             # An in-flight probe to a row with any cut cell buffers to
@@ -592,7 +644,19 @@ def tick(
         rank = jnp.cumsum(free.astype(jnp.int32), axis=2)
         form = free & (rank == 1)
         any_free = jnp.any(free, axis=2)
-        reads_shed = reads_shed + cfg.read_rate * jnp.sum(~any_free)
+        if wl.has_reads:
+            # Workload read mix: the group's read arrivals split across
+            # its NR read batchers; empty shares form no batch.
+            nr_iota = jnp.arange(NR, dtype=jnp.int32)[:, None]
+            rcount = (wl_reads // NR)[None, :] + (
+                nr_iota < (wl_reads % NR)[None, :]
+            )  # [NR, G]
+            form = form & (rcount[:, :, None] > 0)
+            reads_shed = reads_shed + jnp.sum(
+                jnp.where(~any_free, rcount, 0)
+            )
+        else:
+            reads_shed = reads_shed + cfg.read_rate * jnp.sum(~any_free)
         # The bound: this group's chosen-prefix watermark (every slot
         # below it is chosen) — what the read-quorum row reports.
         # Ordinals are recomputed against the POST-RETIREMENT head
@@ -613,7 +677,10 @@ def tick(
         pw = head + chosen_prefix  # [G]
         rd_issue = jnp.where(form, t, rd_issue)
         rd_bound = jnp.where(form, pw[None, :, None], rd_bound)
-        rd_count = jnp.where(form, cfg.read_rate, rd_count)
+        if wl.has_reads:
+            rd_count = jnp.where(form, rcount[:, :, None], rd_count)
+        else:
+            rd_count = jnp.where(form, cfg.read_rate, rd_count)
         rd_row = jnp.where(form, probe_row, rd_row)
         rd_probe = jnp.where(
             form, probe_lat.astype(rd_probe.dtype), rd_probe
@@ -675,6 +742,7 @@ def tick(
         reads_shed=reads_shed,
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -765,6 +833,9 @@ def stats(cfg, state, t) -> dict:
     um = jax.device_get(state.unbat_msgs)
     reads = int(state.reads_done)
     return {
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "ticks": int(t),
         "committed_entries": committed,
         "batches_committed": int(state.batches_committed),
@@ -793,6 +864,7 @@ def stats(cfg, state, t) -> dict:
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedCompartmentalizedConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -805,5 +877,5 @@ def analysis_config(
         num_groups=4, grid_rows=2, grid_cols=2, num_proxy_leaders=4,
         num_batchers=2, num_unbatchers=2, num_replicas=3, window=16,
         batch_size=2, arrivals_per_tick=1, retry_timeout=8,
-        read_rate=2, read_window=6, faults=faults,
+        read_rate=2, read_window=6, faults=faults, workload=workload,
     )

@@ -52,7 +52,9 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Write slot status.
@@ -88,6 +90,11 @@ class BatchedCraqConfig:
     # pending-set conservation invariants hold throughout.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-chain
+    # write admission; a read/write mix routes the read share to the
+    # apportioned-read ring (needs reads_per_tick > 0). Completions
+    # are tail applies. WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the chain
     # propagate/ack plane (tick steps 1-2) routes through
     # ops.registry.dispatch. Partitioned plans ride the kernel too —
@@ -104,6 +111,7 @@ class BatchedCraqConfig:
             assert self.read_window >= 2 * self.reads_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         self.faults.validate(axis=self.chain_len)
+        self.workload.validate(reads_supported=self.reads_per_tick > 0)
         self.kernels.validate()
 
 
@@ -144,6 +152,7 @@ class BatchedCraqState:
     reads_dirty: jnp.ndarray  # [] forwarded to the tail
     read_lat_sum: jnp.ndarray  # []
     read_lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     read_lin_violations: jnp.ndarray  # [] reads below their floor
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
@@ -176,6 +185,9 @@ def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
         reads_dirty=jnp.zeros((), jnp.int32),
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_chains, cfg.faults
+        ),
         read_lin_violations=jnp.zeros((), jnp.int32),
         telemetry=make_telemetry(),
     )
@@ -205,13 +217,16 @@ def tick(
     # partition until the heal tick. Under a none plan `_hop` is the
     # identity and the latencies are untouched (structural no-op).
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     if fp.active:
         kf = faults_mod.fault_key(key)
         hop_lat_w = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 0), (N, W), hop_lat_w
+            fp, jax.random.fold_in(kf, 0), (N, W), hop_lat_w, rates=frates
         )
         hop_lat_r = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 1), (N, RW), hop_lat_r
+            fp, jax.random.fold_in(kf, 1), (N, RW), hop_lat_r, rates=frates
         )
     if fp.has_partition:
         _side = faults_mod.partition_sides(fp)
@@ -292,6 +307,10 @@ def tick(
     read_lat_sum = state.read_lat_sum
     read_lat_hist = state.read_lat_hist
     read_lin_violations = state.read_lin_violations
+    # Workload arrivals (tpu/workload.py): drawn before the read block
+    # so the read share of the mix feeds the apportioned-read ring.
+    if wl.active:
+        wl_writes, wl_reads, wls = workload_mod.begin(wl, wls, key, t, N)
     # Gate on the ring EXISTING (not on the issue rate): tests inject
     # reads by hand with reads_per_tick == 0 and still need routing.
     if cfg.read_window:
@@ -346,7 +365,10 @@ def tick(
         # committed version for the key right now.
         empty_r = r_status == R_EMPTY
         rank_r = jnp.cumsum(empty_r.astype(jnp.int32), axis=1)
-        issue_r = empty_r & (rank_r <= cfg.reads_per_tick)
+        if wl.has_reads:
+            issue_r = empty_r & (rank_r <= wl_reads[:, None])
+        else:
+            issue_r = empty_r & (rank_r <= cfg.reads_per_tick)
         new_key_r = (
             ((bits_r >> 8) & jnp.uint32(0xFFF)).astype(jnp.int32) % KV
         )
@@ -370,8 +392,18 @@ def tick(
     # ---- 4. New writes into empty ring slots (CraqClient.write -> head).
     empty_w = w_status == W_EMPTY
     rank_w = jnp.cumsum(empty_w.astype(jnp.int32), axis=1)
-    issue_w = empty_w & (rank_w <= cfg.writes_per_tick)
+    # Workload admission (tpu/workload.py): under a shaping plan the
+    # static writes_per_tick knob becomes the per-chain cap.
+    if wl.active:
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        issue_w = empty_w & (rank_w <= adm[:, None])
+    else:
+        issue_w = empty_w & (rank_w <= cfg.writes_per_tick)
     count_w = jnp.sum(issue_w, axis=1)  # [N]
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count_w, jnp.sum(at_tail, axis=1)
+        )
     new_key_w = (
         ((bits_w >> 8) & jnp.uint32(0xFFFF)).astype(jnp.int32) % KV
     )
@@ -423,6 +455,7 @@ def tick(
         reads_dirty=reads_dirty,
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
+        workload=wls,
         read_lin_violations=read_lin_violations,
         telemetry=tel,
     )
@@ -482,6 +515,9 @@ def check_invariants(
     read_books = state.reads_clean + state.reads_dirty >= state.reads_done
     return {
         "dirty_conserved": dirty_conserved,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "dirty_nonneg": dirty_nonneg,
         "node_behind_tail": node_behind_tail,
         "ver_issued": ver_issued,
@@ -523,6 +559,7 @@ def stats(cfg: BatchedCraqConfig, state: BatchedCraqState, t) -> dict:
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedCraqConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -533,5 +570,6 @@ def analysis_config(
     return BatchedCraqConfig(
         num_chains=4, chain_len=3, num_keys=8, window=8,
         writes_per_tick=2, reads_per_tick=2, read_window=8,
+        workload=workload,
         faults=faults,
     )

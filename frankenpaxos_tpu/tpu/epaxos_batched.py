@@ -64,7 +64,9 @@ from frankenpaxos_tpu.tpu.common import (
     sample_latency,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 _LANES = 32  # columns per packed visibility word
@@ -133,6 +135,12 @@ class BatchedEPaxosConfig:
     # Crash/revive merges into the GC replica churn when that layer is
     # on. FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-column
+    # instance admission (bounded by instances_per_tick per tick — the
+    # fresh-visibility draw is K-shaped; the FIFO backlog carries the
+    # rest). Completions are instance commits. WorkloadPlan.none() =
+    # saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def num_replicas(self) -> int:
@@ -141,6 +149,7 @@ class BatchedEPaxosConfig:
     def __post_init__(self):
         assert self.num_columns >= 2
         assert self.window >= 2 * self.instances_per_tick
+        self.workload.validate()
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.slow_path_rate <= 1.0
         assert 0.0 <= self.see_same_tick_rate <= 1.0
@@ -223,6 +232,7 @@ class BatchedEPaxosState:
     # against TarjanDependencyGraph in tests/test_tpu_epaxos.py)
     lat_sum: jnp.ndarray  # [] sum of propose->execute latencies
     lat_hist: jnp.ndarray  # [LAT_BINS] execute latency histogram
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -251,6 +261,9 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         coexecuted=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_columns, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -409,11 +422,15 @@ def tick(
     k_vis, k_slow, k_lat = jax.random.split(key, 3)
     w_iota = jnp.arange(W, dtype=jnp.int32)
     fp = cfg.faults  # unified fault plan (tpu/faults.py)
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
 
     # ---- 1. Commits land (EpCommit arrival at the replica).
     landing = state.commit_tick <= t
     committed = state.committed | (state.proposed & landing)
-    n_new_commits = jnp.sum(committed & ~state.committed)
+    new_commit_mask = committed & ~state.committed
+    n_new_commits = jnp.sum(new_commit_mask)
 
     # ---- 2. Dependency-graph execute pass (TarjanDependencyGraph
     # execute: all eligible vertices, SCCs together). Without the GC
@@ -476,7 +493,7 @@ def tick(
         # A FaultPlan crash schedule composes with the native GC-replica
         # churn rates (identity under a none plan).
         eff_crash, eff_revive = faults_mod.effective_process_rates(
-            fp, cfg.rep_crash_rate, cfg.rep_revive_rate
+            fp, cfg.rep_crash_rate, cfg.rep_revive_rate, rates=frates
         )
         crash = ~state.rep_down & (
             jax.random.uniform(k_crash, (R,)) < eff_crash
@@ -540,11 +557,29 @@ def tick(
     # protocol. Own-column bits are masked off (own-column order is the
     # ring structure itself).
     space = W - (state.next_instance - head)
-    count = jnp.minimum(cfg.instances_per_tick, space)
+    # Workload admission (tpu/workload.py): the cap clamps the K
+    # candidate slots per column (the fresh-visibility draw below is
+    # K-shaped, so per-tick admission is bounded by instances_per_tick).
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, C)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        count = jnp.minimum(
+            jnp.minimum(adm, cfg.instances_per_tick), space
+        )
+    else:
+        count = jnp.minimum(cfg.instances_per_tick, space)
     if cfg.max_instances_per_column is not None:
         count = jnp.minimum(
             count,
             jnp.maximum(cfg.max_instances_per_column - state.next_instance, 0),
+        )
+    if wl.active:
+        # Accounted AFTER every clamp: finish() must see the ACTUAL
+        # per-column issue count, or the backlog drains entries the
+        # ring never admitted.
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count,
+            jnp.sum(new_commit_mask, axis=1),
         )
     delta = jnp.mod(w_iota[None, :] - state.next_instance[:, None], W)
     is_new = delta < count[:, None]
@@ -608,9 +643,10 @@ def tick(
     # column's commits defer to the partition's heal tick. none() skips
     # this at trace time.
     commit_arr = t + commit_lat
-    if fp.drop_rate > 0.0 or fp.jitter > 0:
+    if fp.traced or fp.drop_rate > 0.0 or fp.jitter > 0:
         commit_lat = faults_mod.tcp_latency(
-            fp, faults_mod.fault_key(key), (C, W), commit_lat
+            fp, faults_mod.fault_key(key), (C, W), commit_lat,
+            rates=frates,
         )
         commit_arr = t + commit_lat
     if fp.has_partition:
@@ -659,6 +695,7 @@ def tick(
         coexecuted=coexecuted,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -691,6 +728,9 @@ def check_invariants(
     # state, so a miscounted closure pass fails here.
     exec_base = state.exec_wm if cfg.num_exec_replicas else state.head
     conserved = state.executed_total == jnp.sum(exec_base)
+    workload_ok = workload_mod.invariants_ok(
+        cfg.workload, state.workload
+    )
     books_ok = state.executed_total <= state.committed_total
     # Window bookkeeping: bounded state. With the GC layer this is THE
     # claim — the ring never outgrows W even though pruning waits for
@@ -716,6 +756,7 @@ def check_invariants(
     )
     out = {
         "conserved": conserved,
+        "workload_ok": workload_ok,
         "books_ok": books_ok,
         "window_ok": window_ok,
         "ring_ok": ring_ok,
@@ -732,6 +773,7 @@ def check_invariants(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedEPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -741,5 +783,5 @@ def analysis_config(
     well under a second."""
     return BatchedEPaxosConfig(
         num_columns=5, window=32, instances_per_tick=2,
-        num_exec_replicas=3, faults=faults,
+        num_exec_replicas=3, faults=faults, workload=workload,
     )

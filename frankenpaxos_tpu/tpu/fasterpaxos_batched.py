@@ -46,7 +46,9 @@ from frankenpaxos_tpu.tpu.common import (
     ring_retire,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -80,6 +82,10 @@ class BatchedFasterPaxosConfig:
     # that drives dead-seat leader changes. FaultPlan.none() is a
     # structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-SEAT
+    # admission (lane axis = the G x D delegate seats); noop fills stay
+    # protocol traffic. WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def num_servers(self) -> int:
@@ -98,6 +104,7 @@ class BatchedFasterPaxosConfig:
         assert 0.0 <= self.revive_rate <= 1.0
         assert self.detect_timeout >= 1
         self.faults.validate(axis=self.num_servers)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -140,6 +147,7 @@ class BatchedFasterPaxosState:
     choose_violations: jnp.ndarray  # []
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -176,6 +184,9 @@ def init_state(cfg: BatchedFasterPaxosConfig) -> BatchedFasterPaxosState:
         choose_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_groups * cfg.num_delegates, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -217,11 +228,15 @@ def tick(
     # shared Phase2a delivered plane (partition cuts the server axis);
     # crash merges into the native churn below. none() skips all of it.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[:, None, None, None]
         f_del, fwd_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (A, G, D, W), fwd_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (A, G, D, W), fwd_lat, link_up,
+            rates=frates,
         )
         delivered = delivered & f_del
 
@@ -231,7 +246,7 @@ def tick(
     # ---- 0. Server liveness churn (a FaultPlan crash schedule composes
     # with the native rates).
     eff_fail, eff_revive = faults_mod.effective_process_rates(
-        fp, cfg.fail_rate, cfg.revive_rate
+        fp, cfg.fail_rate, cfg.revive_rate, rates=frates
     )
     die = state.server_alive & ~bit_delivered(bits1, 0, eff_fail)
     revive = ~state.server_alive & ~bit_delivered(bits1, 8, eff_revive)
@@ -420,9 +435,17 @@ def tick(
     can = (
         (phase == PH_NORMAL)[:, None] & seat_alive2
     )
-    count = jnp.where(
-        can, jnp.minimum(cfg.slots_per_tick, space2), 0
-    )
+    # Workload admission (tpu/workload.py): the lane axis is the G x D
+    # delegate seats; under a shaping plan the static knob becomes the
+    # per-seat admission cap.
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, G * D)
+        adm = workload_mod.admission(wl, wls, wl_writes).reshape(G, D)
+        count = jnp.where(can, jnp.minimum(adm, space2), 0)
+    else:
+        count = jnp.where(
+            can, jnp.minimum(cfg.slots_per_tick, space2), 0
+        )
     delta2 = jnp.mod(w_iota[None, None, :] - next_ord[:, :, None], W)
     is_new = delta2 < count[:, :, None]
     new_ord = next_ord[:, :, None] + delta2
@@ -431,6 +454,15 @@ def tick(
         (new_ord * D + d_iota[None, :, None]) * G + g_ids
     ) & jnp.int32(0x7FFFFFFF)
     next_ord = next_ord + count
+    if wl.active:
+        # Completions: an admitted (real-valued) slot resolves at its
+        # choose, even when a repair chose a noop over it.
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count.reshape(G * D),
+            jnp.sum(
+                newly_chosen & (state.slot_value != NOOP_VALUE), axis=2
+            ).reshape(G * D),
+        )
     status = jnp.where(is_new, PROPOSED, status)
     slot_value = jnp.where(is_new, new_val, slot_value)
     propose_tick = jnp.where(is_new, t, propose_tick)
@@ -504,6 +536,7 @@ def tick(
         choose_violations=choose_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -550,6 +583,9 @@ def check_invariants(
     books_ok = state.committed_real <= state.committed
     return {
         "choose_once": choose_once,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "window_ok": window_ok,
         "round_ok": round_ok,
         "vote_ok": vote_ok,
@@ -582,6 +618,7 @@ def stats(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedFasterPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -590,6 +627,6 @@ def analysis_config(
     exercise every protocol plane, small enough to trace and compile in
     well under a second."""
     return BatchedFasterPaxosConfig(
-        num_groups=4, window=8, slots_per_tick=2,
+        num_groups=4, window=8, slots_per_tick=2, workload=workload,
         retry_timeout=8, faults=faults,
     )

@@ -45,7 +45,9 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
@@ -88,6 +90,10 @@ class BatchedFastMultiPaxosConfig:
     # recovery clears any slots stranded mid-choose. FaultPlan.none()
     # is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-group
+    # client-command admission into the command ring; completions are
+    # client-observed replies. WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the vote plane —
     # census/pairwise-match counting, fast choose, recovery triggers,
     # the classic round, and the chosen stamps (tick steps 2-3) — routes
@@ -114,6 +120,7 @@ class BatchedFastMultiPaxosConfig:
         assert self.jitter >= 0
         assert self.recovery_timeout >= 2 * (self.lat_max + self.jitter)
         self.faults.validate(axis=self.n)
+        self.workload.validate()
         self.kernels.validate()
 
 
@@ -160,6 +167,7 @@ class BatchedFastMultiPaxosState:
     safety_violations: jnp.ndarray  # [] choice contradicted the ledger
     lat_sum: jnp.ndarray  # [] command issue -> done
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -198,6 +206,9 @@ def init_state(
         safety_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_groups, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -234,15 +245,19 @@ def tick(
     # the re-broadcast timer recovers), TCP delay-only on the classic
     # recovery round. none() skips all of it at trace time.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     bcast_delivered = None
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
         bcast_delivered, bcast_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (A, G, CW), bcast_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (A, G, CW), bcast_lat, link_up,
+            rates=frates,
         )
         rv_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 1), (G, W), rv_lat
+            fp, jax.random.fold_in(kf, 1), (G, W), rv_lat, rates=frates
         )
 
     status = state.status
@@ -256,7 +271,7 @@ def tick(
     revived = None
     if fp.has_crash:
         new_alive = faults_mod.crash_step(
-            fp, faults_mod.fault_key(key, 9), prop_alive
+            fp, faults_mod.fault_key(key, 9), prop_alive, rates=frates
         )
         revived = new_alive & ~prop_alive
         prop_alive = new_alive
@@ -402,10 +417,21 @@ def tick(
     # command at once — the recovery election's log-refill sweep.
     empty = cmd_status == C_EMPTY
     crank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
-    is_new = empty & (crank <= cfg.cmds_per_tick)
+    # Workload admission (tpu/workload.py): under a shaping plan the
+    # static cmds_per_tick knob becomes the per-group admission cap.
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, G)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        is_new = empty & (crank <= adm[:, None])
+    else:
+        is_new = empty & (crank <= cfg.cmds_per_tick)
     if fp.has_crash:
         is_new = is_new & prop_alive[:, None]
     n_new = jnp.sum(is_new, axis=1)
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, n_new, jnp.sum(done_now, axis=1)
+        )
     new_id = (state.cmd_seq[:, None] + crank - 1) * G + jnp.arange(
         G, dtype=jnp.int32
     )[:, None]
@@ -486,6 +512,7 @@ def tick(
         safety_violations=safety_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -530,6 +557,9 @@ def check_invariants(
     )
     return {
         "safety_ok": safety_ok,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "window_ok": window_ok,
         "value_ok": value_ok,
         "books_ok": books_ok,
@@ -564,6 +594,7 @@ def stats(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedFastMultiPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -573,5 +604,6 @@ def analysis_config(
     well under a second."""
     return BatchedFastMultiPaxosConfig(
         num_groups=4, window=16, cmd_window=16, cmds_per_tick=2,
+        workload=workload,
         faults=faults,
     )

@@ -45,7 +45,9 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Instance status.
@@ -78,6 +80,10 @@ class BatchedFastPaxosConfig:
     # exchange is TCP (delay-only + defer-to-heal), so recovery itself
     # cannot deadlock. FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-group
+    # instance admission; a completion is a learned decision.
+    # WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def n(self) -> int:
@@ -102,6 +108,7 @@ class BatchedFastPaxosConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert self.recovery_timeout >= 2 * self.lat_max
         self.faults.validate(axis=self.n)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -146,6 +153,7 @@ class BatchedFastPaxosState:
     safety_violations: jnp.ndarray  # [] chosen != fp_committed ledger
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -177,6 +185,9 @@ def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
         safety_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_groups, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -209,6 +220,9 @@ def tick(
     # round-0 proposal planes, TCP (delay + defer-to-heal) on the
     # classic dn/up exchange. none() skips everything at trace time.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     p0_del = p1_del = None
     dn_arr = t + dn_lat
     up_arr = t + up_lat
@@ -216,16 +230,18 @@ def tick(
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
         p0_del, p0_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (A, G, W), p0_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (A, G, W), p0_lat, link_up,
+            rates=frates,
         )
         p1_del, p1_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (A, G, W), p1_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (A, G, W), p1_lat, link_up,
+            rates=frates,
         )
         dn_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 2), (A, G, W), dn_lat
+            fp, jax.random.fold_in(kf, 2), (A, G, W), dn_lat, rates=frates
         )
         up_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 3), (A, G, W), up_lat
+            fp, jax.random.fold_in(kf, 3), (A, G, W), up_lat, rates=frates
         )
         dn_arr = t + dn_lat
         up_arr = t + up_lat
@@ -409,8 +425,20 @@ def tick(
     # conflict_rate both proposers race, else proposer 0 alone.
     empty = status == I_EMPTY
     rank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
-    issue = empty & (rank <= cfg.instances_per_tick)
+    # Workload admission (tpu/workload.py): under a shaping plan the
+    # static instances_per_tick knob becomes the per-group cap.
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, G)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        issue = empty & (rank <= adm[:, None])
+    else:
+        issue = empty & (rank <= cfg.instances_per_tick)
     count = jnp.sum(issue, axis=1)
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count,
+            jnp.sum(newly_chosen, axis=1),
+        )
     # Globally unique id: (per-group sequence number) * G + group.
     new_id = (state.next_inst[:, None] + rank - 1) * G + jnp.arange(
         G, dtype=jnp.int32
@@ -478,6 +506,7 @@ def tick(
         safety_violations=safety_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -531,6 +560,9 @@ def check_invariants(
     books_ok = state.chosen_fast_total <= state.chosen_total
     return {
         "safety_ok": safety_ok,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "value_ok": value_ok,
         "clean_value_ok": clean_value_ok,
         "round_ok": round_ok,
@@ -563,6 +595,7 @@ def stats(cfg: BatchedFastPaxosConfig, state: BatchedFastPaxosState, t) -> dict:
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedFastPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -572,4 +605,5 @@ def analysis_config(
     well under a second."""
     return BatchedFastPaxosConfig(
         num_groups=4, window=16, instances_per_tick=2, faults=faults,
+        workload=workload,
     )

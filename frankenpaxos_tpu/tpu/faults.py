@@ -59,6 +59,21 @@ constants (the ``bit_delivered`` 1/256 quantization applies), and a new
 plan compiles a new program. The schedule-randomization axis that must
 be cheap — the SEED — is free: one compile serves any number of seeds,
 vmapped (``harness.simtest.run_many_seeds``).
+
+TRACED rates (``traced=True``): the Bernoulli knobs — drop, dup,
+crash, revive — move from compile-time constants to STATE-SIDE float32
+scalars (``tpu/workload.py`` ``WorkloadState.fault_rates``, initialized
+from this plan's fields by :func:`make_rates`), so a fault-RATE grid
+sweeps one compiled program via :func:`frankenpaxos_tpu.tpu.workload
+.set_fault_rates` / vmap instead of recompiling per rate (the
+``trace-workload-retrace`` analysis rule pins that the jit cache does
+not grow across the sweep). A traced plan is structurally ACTIVE on
+every Bernoulli plane regardless of its static field values (the
+program must be able to realize any swept rate); the structural knobs —
+jitter, partition, drop_penalty — stay compile-time static. The
+helpers take the traced scalars via their ``rates=`` argument and
+assert it is supplied, so a backend that threads a traced plan without
+its rate state fails loudly at trace time, never silently at rate 0.
 """
 
 from __future__ import annotations
@@ -77,6 +92,9 @@ from frankenpaxos_tpu.tpu.common import INF, bit_delivered, bit_latency
 FAULT_SALT = 0x5EED
 
 _RATE_FIELDS = ("drop_rate", "dup_rate", "crash_rate", "revive_rate")
+
+# Slot order of the traced-rate vector (make_rates / workload state).
+R_DROP, R_DUP, R_CRASH, R_REVIVE = 0, 1, 2, 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +115,11 @@ class FaultPlan:
     # TCP-plane retransmission penalty per dropped transmission (ticks);
     # only read by :func:`tcp_latency`.
     drop_penalty: int = 6
+    # Bernoulli rates become TRACED state-side scalars (module
+    # docstring): the static rate fields above seed the state vector
+    # (:func:`make_rates`) and every Bernoulli plane is structurally
+    # active so a rate sweep replays one compiled program.
+    traced: bool = False
 
     # -- structural predicates (all trace-time Python bools) ------------
 
@@ -106,14 +129,19 @@ class FaultPlan:
 
     @property
     def has_crash(self) -> bool:
-        return self.crash_rate > 0.0
+        return self.traced or self.crash_rate > 0.0
+
+    @property
+    def dup_active(self) -> bool:
+        return self.traced or self.dup_rate > 0.0
 
     @property
     def messages_active(self) -> bool:
         """Any message-plane knob engaged (the send-path helpers draw
         PRNG sweeps iff this holds)."""
         return (
-            self.drop_rate > 0.0
+            self.traced
+            or self.drop_rate > 0.0
             or self.dup_rate > 0.0
             or self.jitter > 0
             or self.has_partition
@@ -178,6 +206,52 @@ def fault_key(key: jnp.ndarray, salt: int = 0) -> jnp.ndarray:
     per-plane salt into the tick key. Callers must only derive this when
     the plan is active so the inactive path touches no keys at all."""
     return jax.random.fold_in(key, FAULT_SALT + salt)
+
+
+# ---------------------------------------------------------------------------
+# Traced rates (the state-side sweep axis of ``traced=True`` plans)
+# ---------------------------------------------------------------------------
+
+
+def make_rates(plan: FaultPlan) -> jnp.ndarray:
+    """The plan's Bernoulli rates as the traced state vector
+    ``[drop, dup, crash, revive]`` (float32) — zero-sized for untraced
+    plans so the default state carries nothing. Lives inside each
+    backend's ``WorkloadState`` (``tpu/workload.py make_state``)."""
+    if not plan.traced:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.asarray(
+        [plan.drop_rate, plan.dup_rate, plan.crash_rate,
+         plan.revive_rate],
+        jnp.float32,
+    )
+
+
+def traced_rates(plan: FaultPlan, workload_state):
+    """The ``rates=`` argument every fault helper wants: the workload
+    state's traced ``[4]`` rate vector for a traced plan, None
+    otherwise (static plans read their compile-time fields)."""
+    if not plan.traced:
+        return None
+    rates = workload_state.fault_rates
+    assert rates.shape == (4,), (
+        "FaultPlan(traced=True) but the state carries no fault_rates "
+        "vector — init_state must build its WorkloadState with "
+        "workload.make_state(plan, lanes, cfg.faults)"
+    )
+    return rates
+
+
+def _rate(plan: FaultPlan, rates, slot: int, static_value: float):
+    """One Bernoulli rate: the traced scalar for traced plans (rates
+    is then mandatory), the static field otherwise."""
+    if not plan.traced:
+        return static_value
+    assert rates is not None, (
+        "FaultPlan(traced=True) requires the traced rates= argument "
+        "(faults.traced_rates(plan, state.workload))"
+    )
+    return rates[slot]
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +333,7 @@ def message_faults(
     shape: Tuple[int, ...],
     lat: jnp.ndarray,
     link_up=None,
+    rates=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """UDP-plane fault transform for one batch of messages sent this
     tick with base latency ``lat``: returns ``(delivered, lat')``.
@@ -273,20 +348,23 @@ def message_faults(
     their native ``drop_rate``.
 
     Inactive plan: ``(all-True, lat)`` with no PRNG draw (the
-    structural no-op path)."""
+    structural no-op path). Traced plan: drop/dup read the traced
+    ``rates`` vector instead of the static fields."""
     if not plan.messages_active:
         return jnp.ones(shape, bool), lat
+    drop = _rate(plan, rates, R_DROP, plan.drop_rate)
+    dup = _rate(plan, rates, R_DUP, plan.dup_rate)
     bits = jax.random.bits(key, shape)
     # [0:8) drop of the original, [8:16) duplicate decision,
     # [16:24) jitter of the original.
-    delivered = bit_delivered(bits, 0, plan.drop_rate)
+    delivered = bit_delivered(bits, 0, drop)
     lat_eff = (
         lat + bit_latency(bits, 16, 0, plan.jitter) if plan.jitter else lat
     )
-    if plan.dup_rate > 0.0:
+    if plan.dup_active:
         bits2 = jax.random.bits(jax.random.fold_in(key, 1), shape)
-        dup_sent = ~bit_delivered(bits, 8, plan.dup_rate)
-        dup_delivered = dup_sent & bit_delivered(bits2, 0, plan.drop_rate)
+        dup_sent = ~bit_delivered(bits, 8, dup)
+        dup_delivered = dup_sent & bit_delivered(bits2, 0, drop)
         dup_lat = lat + 1 + (
             bit_latency(bits2, 8, 0, plan.jitter) if plan.jitter else 0
         )
@@ -302,22 +380,26 @@ def message_faults(
 
 
 def tcp_latency(
-    plan: FaultPlan, key: jnp.ndarray, shape: Tuple[int, ...], lat
+    plan: FaultPlan, key: jnp.ndarray, shape: Tuple[int, ...], lat,
+    rates=None,
 ) -> jnp.ndarray:
     """TCP-plane fault transform of a latency array: drops become
     retransmission penalties (``drop_penalty`` extra ticks — the link
     redelivers, it never loses), jitter adds its uniform delay, and
     duplicates are absorbed by the transport. Conservation invariants
     (chain pending-sets, cut pipelines) survive because every message
-    still arrives exactly once. Identity when neither knob is set."""
-    if plan.drop_rate <= 0.0 and plan.jitter <= 0:
+    still arrives exactly once. Identity when neither knob is set;
+    traced plans read the traced drop rate from ``rates``."""
+    if not plan.traced and plan.drop_rate <= 0.0 and plan.jitter <= 0:
         return lat
     bits = jax.random.bits(key, shape)
     out = lat
     if plan.jitter:
         out = out + bit_latency(bits, 8, 0, plan.jitter)
-    if plan.drop_rate > 0.0:
-        lost = ~bit_delivered(bits, 0, plan.drop_rate)
+    if plan.traced or plan.drop_rate > 0.0:
+        lost = ~bit_delivered(
+            bits, 0, _rate(plan, rates, R_DROP, plan.drop_rate)
+        )
         out = out + jnp.where(lost, jnp.int32(plan.drop_penalty), 0)
     return out
 
@@ -327,28 +409,49 @@ def tcp_latency(
 # ---------------------------------------------------------------------------
 
 
-def crash_step(plan: FaultPlan, key: jnp.ndarray, alive: jnp.ndarray):
+def crash_step(
+    plan: FaultPlan, key: jnp.ndarray, alive: jnp.ndarray, rates=None
+):
     """One tick of the crash/revive process over a liveness mask (any
     shape): alive processes die with ``crash_rate``, dead ones revive
-    with ``revive_rate``. Identity (no PRNG) when crash is off."""
+    with ``revive_rate``. Identity (no PRNG) when crash is off; traced
+    plans read both rates from ``rates``."""
     if not plan.has_crash:
         return alive
     bits = jax.random.bits(key, alive.shape)
-    dies = ~bit_delivered(bits, 0, plan.crash_rate)
-    revives = ~bit_delivered(bits, 8, plan.revive_rate)
+    dies = ~bit_delivered(
+        bits, 0, _rate(plan, rates, R_CRASH, plan.crash_rate)
+    )
+    revives = ~bit_delivered(
+        bits, 8, _rate(plan, rates, R_REVIVE, plan.revive_rate)
+    )
     return jnp.where(alive, ~dies, revives)
 
 
 def effective_process_rates(
-    plan: FaultPlan, fail_rate: float, revive_rate: float
-) -> Tuple[float, float]:
+    plan: FaultPlan, fail_rate: float, revive_rate: float, rates=None
+):
     """Merge the plan's crash knobs into a backend's native
     fail/revive machinery: independent death sources compose as
     ``1 - (1-a)(1-b)``; the plan's revive rate (when set) overrides the
     native one. Returns the native rates unchanged when crash is off,
-    so the merged machinery stays bit-identical under a none plan."""
+    so the merged machinery stays bit-identical under a none plan.
+
+    Traced plans return TRACED scalars (the same composition over the
+    state-side rates; the revive override becomes a traced select) —
+    ``bit_delivered`` accepts either, but trace-time Python branches
+    must gate on ``plan.has_crash`` / the native rate, never compare
+    the returned values."""
     if not plan.has_crash:
         return fail_rate, revive_rate
+    if plan.traced:
+        crash = _rate(plan, rates, R_CRASH, plan.crash_rate)
+        revive = _rate(plan, rates, R_REVIVE, plan.revive_rate)
+        eff_fail = 1.0 - (1.0 - fail_rate) * (1.0 - crash)
+        eff_revive = jnp.where(revive > 0.0, revive, revive_rate).astype(
+            jnp.float32
+        )
+        return eff_fail, eff_revive
     eff_fail = 1.0 - (1.0 - fail_rate) * (1.0 - plan.crash_rate)
     eff_revive = plan.revive_rate if plan.revive_rate > 0.0 else revive_rate
     return eff_fail, eff_revive

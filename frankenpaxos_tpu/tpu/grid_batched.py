@@ -40,7 +40,9 @@ from frankenpaxos_tpu.tpu.common import (
     sample_latency,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.multipaxos_batched import CHOSEN, EMPTY, PROPOSED
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
@@ -70,6 +72,10 @@ class GridBatchedConfig:
     # semantics — the full-grid retries restore liveness after a heal.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): the grid runs ONE
+    # log, so the lane axis is a single lane shaping its per-tick
+    # proposal admission. WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def num_acceptors(self) -> int:
@@ -86,6 +92,7 @@ class GridBatchedConfig:
         assert 1 <= self.lat_min <= self.lat_max
         assert 0.0 <= self.drop_rate < 1.0
         self.faults.validate(axis=self.num_acceptors)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -110,6 +117,7 @@ class GridBatchedState:
     # margin, so under drops the modes also diverge in retry traffic and
     # commit latency. int32: fine below ~2G sends per run.
     msgs_sent: jnp.ndarray  # []
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -130,6 +138,7 @@ def init_state(cfg: GridBatchedConfig) -> GridBatchedState:
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
+        workload=workload_mod.make_state(cfg.workload, 1, cfg.faults),
         telemetry=make_telemetry(),
     )
 
@@ -151,20 +160,26 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
     p2a_lat = _lat(cfg, k_lat2, (W, R, C))
     retry_lat = _lat(cfg, k_retry, (W, R, C))
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     retry_del = None
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, R * C).reshape(1, R, C)
         f_del, p2b_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (W, R, C), p2b_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (W, R, C), p2b_lat, link_up,
+            rates=frates,
         )
         p2b_del = p2b_del & f_del
         f_del, p2a_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (W, R, C), p2a_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (W, R, C), p2a_lat, link_up,
+            rates=frates,
         )
         p2a_del = p2a_del & f_del
         retry_del, retry_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 2), (W, R, C), retry_lat, link_up
+            fp, jax.random.fold_in(kf, 2), (W, R, C), retry_lat, link_up,
+            rates=frates,
         )
 
     # 1. Acceptors vote on Phase2a arrivals.
@@ -218,9 +233,19 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
     p2a_arrival = jnp.where(retire[:, None, None], INF, state.p2a_arrival)
     p2b_arrival = jnp.where(retire[:, None, None], INF, p2b_arrival)
 
-    # 4. Propose up to K new slots.
+    # 4. Propose up to K new slots (one lane: the single grid log;
+    # under a workload plan the static knob becomes the admission cap).
     space = W - (state.next_slot - head)
-    count = jnp.minimum(cfg.slots_per_tick, space)
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, 1)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        count = jnp.minimum(adm[0], space)
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count[None],
+            jnp.sum(newly_chosen)[None],
+        )
+    else:
+        count = jnp.minimum(cfg.slots_per_tick, space)
     delta = (w_iota - state.next_slot) % W
     is_new = delta < count
     next_slot = state.next_slot + count
@@ -282,6 +307,7 @@ def tick(cfg: GridBatchedConfig, state: GridBatchedState, t, key):
         lat_sum=lat_sum,
         lat_hist=lat_hist,
         msgs_sent=msgs_sent,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -309,6 +335,9 @@ def check_invariants(cfg: GridBatchedConfig, state: GridBatchedState, t) -> dict
         quorum = jnp.sum(votes_in, axis=(1, 2)) >= cfg.majority_size
     return {
         "quorum_ok": jnp.all(jnp.where(chosen, quorum, True)),
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "window_ok": (
             (state.head <= state.next_slot)
             & (state.next_slot - state.head <= cfg.window)
@@ -392,6 +421,7 @@ if __name__ == "__main__":
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> GridBatchedConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -401,5 +431,5 @@ def analysis_config(
     well under a second."""
     return GridBatchedConfig(
         rows=3, cols=3, window=16, slots_per_tick=2,
-        retry_timeout=8, faults=faults,
+        retry_timeout=8, faults=faults, workload=workload,
     )

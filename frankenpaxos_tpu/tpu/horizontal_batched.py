@@ -52,7 +52,9 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Slot status.
@@ -89,6 +91,11 @@ class BatchedHorizontalConfig:
     # Crash/revive stalls a group's leader (no proposals while down).
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes the per-group
+    # admission over the K candidate slots (admission <= slots_per_tick
+    # per tick; the FIFO backlog holds the rest). WorkloadPlan.none() =
+    # saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the vote plane —
     # bank-masked acceptor votes, in-bank quorum count, choose, and the
     # bank-isolation ledger (tick steps 1-2) — routes through
@@ -117,6 +124,7 @@ class BatchedHorizontalConfig:
         if self.reconfigure_every:
             assert self.reconfigure_every >= 2
         self.faults.validate(axis=self.pool)
+        self.workload.validate()
         self.kernels.validate()
 
 
@@ -160,6 +168,7 @@ class BatchedHorizontalState:
     bank_violations: jnp.ndarray  # [] votes observed in the WRONG bank
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -192,6 +201,9 @@ def init_state(cfg: BatchedHorizontalConfig) -> BatchedHorizontalState:
         bank_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_groups, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -228,23 +240,29 @@ def tick(
     # over the POOL axis; crash stalls a group's leader. none() skips
     # all of it at trace time.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     p2a_del = p2b_del = retry_del = None
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, P)[:, None, None]
         p2a_del, p2a_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (P, G, W), p2a_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (P, G, W), p2a_lat, link_up,
+            rates=frates,
         )
         p2b_del, p2b_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (P, G, W), p2b_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (P, G, W), p2b_lat, link_up,
+            rates=frates,
         )
         retry_del, retry_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 2), (P, G, W), retry_lat, link_up
+            fp, jax.random.fold_in(kf, 2), (P, G, W), retry_lat, link_up,
+            rates=frates,
         )
     fault_alive = state.fault_alive
     if fp.has_crash:
         fault_alive = faults_mod.crash_step(
-            fp, faults_mod.fault_key(key, 9), fault_alive
+            fp, faults_mod.fault_key(key, 9), fault_alive, rates=frates
         )
 
     # ---- 1+2. The vote plane (one registry kernel, ops/horizontal.py):
@@ -366,6 +384,13 @@ def tick(
     k_iota = jnp.arange(K, dtype=jnp.int32)
     abs_k = state.next_slot[:, None] + k_iota[None, :]  # [G, K]
     want_k = jnp.ones((G, K), bool)
+    # Workload admission (tpu/workload.py): the cap gates the K
+    # candidate slots (per-tick admission is bounded by slots_per_tick;
+    # the FIFO backlog carries the residual demand).
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, G)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        want_k = want_k & (k_iota[None, :] < adm[:, None])
     if fp.has_crash:
         # A crashed group leader proposes nothing until revival.
         want_k = want_k & fault_alive[:, None]
@@ -382,6 +407,11 @@ def tick(
     boundary_stalls = state.boundary_stalls + jnp.sum(
         want_k & alpha_ok_k & ~chunk_ok_k
     )
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count,
+            jnp.sum(newly_chosen, axis=1),
+        )
     delta = jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
     abs_slot = state.next_slot[:, None] + delta  # [G, W]
     is_new = delta < count[:, None]
@@ -479,6 +509,7 @@ def tick(
         bank_violations=bank_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -547,6 +578,9 @@ def check_invariants(
     )
     return {
         "votes_in_place": votes_in_place,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "ledger_ok": ledger_ok,
         "vote_epoch_ok": vote_epoch_ok,
         "alpha_ok": alpha_ok,
@@ -584,6 +618,7 @@ def stats(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedHorizontalConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -593,5 +628,6 @@ def analysis_config(
     well under a second."""
     return BatchedHorizontalConfig(
         num_groups=4, window=16, slots_per_tick=2, alpha=8,
+        workload=workload,
         retry_timeout=8, faults=faults,
     )

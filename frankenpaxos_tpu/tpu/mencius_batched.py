@@ -47,7 +47,9 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -84,6 +86,10 @@ class BatchedMenciusConfig:
     # after a heal); crash/revive stops a dead leader's stripe (skips
     # catch it up after revival). FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes each ACTIVE
+    # leader's per-tick proposal admission (skip fills are protocol
+    # noops, not workload entries). WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the per-slot
     # vote/skip aggregation plane (tick steps 1-2) routes through
     # ops.registry.dispatch — fused Pallas on TPU, pure-jnp reference
@@ -104,6 +110,7 @@ class BatchedMenciusConfig:
         assert 0 <= self.num_idle_leaders < self.num_leaders
         assert self.skip_threshold >= 1
         self.faults.validate(axis=self.group_size)
+        self.workload.validate()
         self.kernels.validate()
 
 
@@ -138,6 +145,7 @@ class BatchedMenciusState:
     skips: jnp.ndarray  # [] cumulative noop skip proposals
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -163,6 +171,7 @@ def init_state(cfg: BatchedMenciusConfig) -> BatchedMenciusState:
         skips=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(cfg.workload, L, cfg.faults),
         telemetry=make_telemetry(),
     )
 
@@ -200,25 +209,31 @@ def tick(
     # (minor axis), crash stops a leader's stripe. none() is skipped at
     # trace time entirely.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     retry_delivered = None
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[None, None, :]
         f_del, p2a_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (L, W, A), p2a_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (L, W, A), p2a_lat, link_up,
+            rates=frates,
         )
         p2a_delivered = p2a_delivered & f_del
         f_del, p2b_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (L, W, A), p2b_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (L, W, A), p2b_lat, link_up,
+            rates=frates,
         )
         p2b_delivered = p2b_delivered & f_del
         retry_delivered, retry_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 2), (L, W, A), retry_lat, link_up
+            fp, jax.random.fold_in(kf, 2), (L, W, A), retry_lat, link_up,
+            rates=frates,
         )
     fault_alive = state.fault_alive
     if fp.has_crash:
         fault_alive = faults_mod.crash_step(
-            fp, faults_mod.fault_key(key, 9), fault_alive
+            fp, faults_mod.fault_key(key, 9), fault_alive, rates=frates
         )
 
     status = state.status
@@ -319,10 +334,18 @@ def tick(
         skipping = skipping & fault_alive
 
     space = W - (state.next_slot - head)
+    # Workload admission (tpu/workload.py): under a shaping plan the
+    # static slots_per_tick knob becomes the per-leader admission cap;
+    # skip fills stay protocol noops outside the workload accounting.
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, L)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+    else:
+        adm = cfg.slots_per_tick
     want = jnp.where(
         skipping,
         jnp.minimum(lag, W),  # fill the backlog with noops
-        jnp.where(idle, 0, cfg.slots_per_tick),
+        jnp.where(idle, 0, adm),
     )
     count = jnp.minimum(want, space)
     if cfg.max_slots_per_leader is not None:
@@ -333,6 +356,12 @@ def tick(
     is_new = delta < count[:, None]
     next_slot = state.next_slot + count
     skips = state.skips + jnp.sum(jnp.where(skipping, count, 0))
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes,
+            jnp.where(skipping, 0, count),
+            jnp.sum(real_chosen, axis=1),
+        )
 
     new_ord = state.next_slot[:, None] + delta
     new_value = jnp.where(
@@ -391,6 +420,7 @@ def tick(
         skips=skips,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -442,6 +472,9 @@ def check_invariants(
     head_ok = jnp.all(state.head <= state.committed_prefix)
     return {
         "watermark_ok": watermark_ok,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "window_ok": window_ok,
         "quorum_ok": quorum_ok,
         "head_ok": head_ok,
@@ -450,6 +483,7 @@ def check_invariants(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedMenciusConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -459,5 +493,6 @@ def analysis_config(
     well under a second."""
     return BatchedMenciusConfig(
         f=1, num_leaders=4, window=16, slots_per_tick=2,
+        workload=workload,
         retry_timeout=8, faults=faults,
     )

@@ -71,8 +71,10 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 
 # Slot status codes.
 EMPTY = 0
@@ -210,6 +212,14 @@ class BatchedMultiPaxosConfig:
     # FaultPlan.none() is a structural no-op: XLA emits the exact
     # pre-fault program and runs stay bit-identical.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): open-loop arrival
+    # processes + Zipf lane skew + read/write mix shaping the per-group
+    # admission cap, and closed-loop clients with an outstanding-request
+    # window per group. The traced offered rate (and a traced
+    # FaultPlan's rates) live in State.workload, so [workload x fault]
+    # grids sweep one compiled program. WorkloadPlan.none() is a
+    # structural no-op (saturation — the pre-plan behavior).
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def num_matchmakers(self) -> int:
@@ -241,6 +251,7 @@ class BatchedMultiPaxosConfig:
         assert 0.0 <= self.fail_rate < 1.0
         assert 0.0 <= self.revive_rate <= 1.0
         self.faults.validate(axis=self.group_size)
+        self.workload.validate(reads_supported=self.read_rate > 0)
         self.kernels.validate()
         assert self.read_mode in READ_MODES
         assert self.state_machine in ("none", "kv")
@@ -365,6 +376,10 @@ class BatchedMultiPaxosState:
     read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
     read_lin_violations: jnp.ndarray  # [] reads bound below their floor
 
+    # Workload-engine shaping state (tpu/workload.py: backlog, closed
+    # window, traced rate scalars; all-empty under WorkloadPlan.none()).
+    workload: WorkloadState
+
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
 
@@ -450,6 +465,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         read_lat_sum=jnp.zeros((), jnp.int32),
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         read_lin_violations=jnp.zeros((), jnp.int32),
+        workload=workload_mod.make_state(cfg.workload, G, cfg.faults),
         telemetry=make_telemetry(),
     )
 
@@ -497,20 +513,26 @@ def tick(
     # (the reference retries them like writes). FaultPlan.none() skips
     # everything here at trace time: no PRNG draw, no extra ops.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     retry_delivered = None
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[:, None, None]
         f_del, p2a_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (A, G, W), p2a_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (A, G, W), p2a_lat, link_up,
+            rates=frates,
         )
         p2a_delivered = p2a_delivered & f_del
         f_del, p2b_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (A, G, W), p2b_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (A, G, W), p2b_lat, link_up,
+            rates=frates,
         )
         p2b_delivered = p2b_delivered & f_del
         retry_delivered, retry_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 2), (A, G, W), retry_lat, link_up
+            fp, jax.random.fold_in(kf, 2), (A, G, W), retry_lat, link_up,
+            rates=frates,
         )
 
     # Message-plane latencies are written into OFFSET CLOCKS, so they
@@ -533,11 +555,13 @@ def tick(
 
     # FaultPlan crash/revive merges into the leader-candidate machinery
     # (independent death sources compose); a none plan returns the
-    # native rates unchanged, keeping this path bit-identical. Computed
-    # HERE (it is pure Python over the static config) because the
-    # megakernel routing below needs to know whether elections run.
+    # native rates unchanged, keeping this path bit-identical. The
+    # STRUCTURAL gate is crash_on (a trace-time Python bool — traced
+    # plans return traced eff rates, which must never be compared at
+    # trace time); the megakernel routing below needs it too.
+    crash_on = fp.has_crash or cfg.fail_rate > 0.0
     eff_fail, eff_revive = faults_mod.effective_process_rates(
-        fp, cfg.fail_rate, cfg.revive_rate
+        fp, cfg.fail_rate, cfg.revive_rate, rates=frates
     )
 
     # Megakernel routing (ops/multipaxos.py multipaxos_fused_tick): when
@@ -560,7 +584,7 @@ def tick(
     )
     fuse_age = (
         use_mega
-        and not (eff_fail > 0.0 or cfg.device_elections)
+        and not (crash_on or cfg.device_elections)
         and not cfg.reconfigure_every
     )
 
@@ -593,9 +617,9 @@ def tick(
     heartbeat_miss = state.heartbeat_miss
     elections = state.elections
     owner_alive_now = None  # None = feature off, everyone alive
-    if eff_fail > 0.0 or cfg.device_elections:
+    if crash_on or cfg.device_elections:
         C = cfg.num_leader_candidates
-        if eff_fail > 0.0:
+        if crash_on:
             bits_f = jax.random.bits(k_fail, (C, G))  # [0:8) death, [8:16) rev
             dies = ~bit_delivered(bits_f, 0, eff_fail)
             revives = ~bit_delivered(bits_f, 8, eff_revive)
@@ -798,8 +822,19 @@ def tick(
     # thrifty quorum membership. Decided OUTSIDE the planes and entering
     # as tiny per-group vectors (or [A, G, W] masks the PRNG already
     # produced), so every feature composes with the fused kernels — and
-    # the whole-tick megakernel — unchanged.
-    cap = jnp.full((G,), cfg.slots_per_tick, jnp.int32)
+    # the whole-tick megakernel — unchanged. The WORKLOAD ENGINE
+    # (tpu/workload.py) plugs in exactly here: under a shaping plan the
+    # static slots_per_tick knob is replaced by the per-group admission
+    # cap (arrival process x Zipf skew, FIFO backlog, closed-loop
+    # window), and every other gate below composes on top.
+    wl_writes = wl_reads = None
+    if wl.active:
+        wl_writes, wl_reads, wls = workload_mod.begin(
+            wl, wls, key, t, G
+        )
+        cap = workload_mod.admission(wl, wls, wl_writes)
+    else:
+        cap = jnp.full((G,), cfg.slots_per_tick, jnp.int32)
     if cfg.max_slots_per_group is not None:
         cap = jnp.minimum(
             cap, jnp.maximum(cfg.max_slots_per_group - state.next_slot, 0)
@@ -1000,6 +1035,19 @@ def tick(
     ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W  # [G, W]
     executed = state.executed + n_retire
     retired_total = state.retired + jnp.sum(n_retire)
+
+    # Workload accounting: the plane's ACTUAL per-group admissions
+    # (count — the ring may take fewer than the cap) drain the FIFO
+    # backlog and occupy the closed-loop window; this tick's quorum
+    # completions (the commit the client observes) release it. The
+    # admitted entries' admission->commit latency is exactly the
+    # newly_chosen/latency stats above — already accumulated into
+    # lat_hist and the telemetry ring.
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count,
+            jnp.sum(newly_chosen, axis=1),
+        )
 
     if cfg.reconfigure_every:
         # GC: once the executed watermark passes every slot the old
@@ -1285,10 +1333,22 @@ def tick(
         empty_rb = rb_status == R_EMPTY  # [G, NW]
         rank_rb = jnp.cumsum(empty_rb.astype(jnp.int32), axis=1)
         can_batch = empty_rb & (rank_rb == 1)  # first free row per group
-        reads_shed = reads_shed + cfg.read_rate * (
-            G - jnp.sum(can_batch)
-        )
-        rb_count = jnp.where(can_batch, cfg.read_rate, rb_count)
+        if wl.has_reads:
+            # Workload read/write mix: the batch carries this tick's
+            # ACTUAL read arrivals for the group (Zipf-skewed, process-
+            # shaped) instead of the static read_rate; groups with no
+            # read arrivals form no batch, and arrivals to a backlogged
+            # batcher shed as before.
+            can_batch = can_batch & (wl_reads[:, None] > 0)
+            reads_shed = reads_shed + jnp.sum(
+                jnp.where(jnp.any(can_batch, axis=1), 0, wl_reads)
+            )
+            rb_count = jnp.where(can_batch, wl_reads[:, None], rb_count)
+        else:
+            reads_shed = reads_shed + cfg.read_rate * (
+                G - jnp.sum(can_batch)
+            )
+            rb_count = jnp.where(can_batch, cfg.read_rate, rb_count)
         rb_issue = jnp.where(can_batch, t, rb_issue)
         rb_floor = jnp.where(can_batch, max_chosen_global, rb_floor)
         if cfg.read_mode == "linearizable":
@@ -1424,6 +1484,7 @@ def tick(
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
         read_lin_violations=read_lin_violations,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -1671,6 +1732,9 @@ def check_invariants(
     )
     return {
         "quorum_ok": quorum_ok,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "window_ok": window_ok,
         "conserved": conserved,
         "round_ok": round_ok,
@@ -1691,6 +1755,7 @@ def check_invariants(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedMultiPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -1700,5 +1765,5 @@ def analysis_config(
     well under a second."""
     return BatchedMultiPaxosConfig(
         f=1, num_groups=4, window=16, slots_per_tick=2,
-        retry_timeout=8, faults=faults,
+        retry_timeout=8, faults=faults, workload=workload,
     )

@@ -37,8 +37,10 @@ from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, bit_latency
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +62,12 @@ class BatchedScalogConfig:
     # the heal tick; crash/revive flaps the aggregator itself.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): a shaping plan
+    # replaces the stochastic append draw with the engine's per-shard
+    # arrivals (shards absorb appends locally, so open-loop admission
+    # is immediate); completions are records entering the global log.
+    # WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
     # Kernel-layer dispatch policy (ops/registry.py): the cut-commit
     # plane — the in-order commit scan, newest-cut projection, and
     # per-cut latency attribution (tick step 2) — routes through
@@ -74,6 +82,7 @@ class BatchedScalogConfig:
         assert 0 <= self.append_jitter <= self.appends_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         self.faults.validate(axis=self.num_shards)
+        self.workload.validate()
         self.kernels.validate()
 
 
@@ -100,6 +109,7 @@ class BatchedScalogState:
     lat_sum: jnp.ndarray  # [] sum of record ordering latencies (ticks)
     lat_count: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -120,6 +130,9 @@ def init_state(cfg: BatchedScalogConfig) -> BatchedScalogState:
         lat_sum=jnp.zeros((), jnp.int32),
         lat_count=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_shards, cfg.faults
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -148,11 +161,23 @@ def tick(
     global log extends to the newest committed cut."""
     S, P = cfg.num_shards, cfg.max_inflight_cuts
     bits = jax.random.bits(key, (S,))
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(cfg.faults, wls)
 
-    # ---- 1. Shards append records (stochastic load skew).
-    appends = cfg.appends_per_tick - cfg.append_jitter + bit_latency(
-        bits, 0, 0, 2 * cfg.append_jitter
-    ) if cfg.append_jitter else jnp.full((S,), cfg.appends_per_tick, jnp.int32)
+    # ---- 1. Shards append records (stochastic load skew). Under a
+    # workload plan the engine's per-shard arrivals replace the native
+    # draw (tpu/workload.py); shards absorb appends locally, so the
+    # open-loop cap admits everything queued.
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, S)
+        appends = workload_mod.admission(wl, wls, wl_writes)
+    else:
+        appends = cfg.appends_per_tick - cfg.append_jitter + bit_latency(
+            bits, 0, 0, 2 * cfg.append_jitter
+        ) if cfg.append_jitter else jnp.full(
+            (S,), cfg.appends_per_tick, jnp.int32
+        )
     if cfg.max_records_per_shard is not None:
         appends = jnp.minimum(
             appends,
@@ -199,6 +224,13 @@ def tick(
     lat_hist = state.lat_hist + jax.ops.segment_sum(
         recs_asc, jnp.clip(lag_asc, 0, LAT_BINS - 1), LAT_BINS
     )
+    if wl.active:
+        # Completions: records entering the GLOBAL log this tick
+        # (new_cut is the per-shard committed prefix vector).
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, appends,
+            new_cut - state.last_committed_cut,
+        )
 
     # ---- 3. Aggregator snapshots a new cut on its period, if the
     # pipeline has room (ShardInfo -> proposed cut -> Paxos; commit after
@@ -218,15 +250,15 @@ def tick(
         issue = issue & ~faults_mod.partition_active(fp, t)
     if fp.has_crash:
         agg_alive = faults_mod.crash_step(
-            fp, faults_mod.fault_key(key, 9), agg_alive
+            fp, faults_mod.fault_key(key, 9), agg_alive, rates=frates
         )
         issue = issue & agg_alive
     slot = state.next_cut % P
     paxos_lat = bit_latency(jax.random.bits(jax.random.fold_in(key, 1), ()), 0,
                             2 * cfg.lat_min, 2 * cfg.lat_max + 2)
-    if fp.drop_rate > 0.0 or fp.jitter > 0:
+    if fp.traced or fp.drop_rate > 0.0 or fp.jitter > 0:
         paxos_lat = faults_mod.tcp_latency(
-            fp, faults_mod.fault_key(key, 1), (), paxos_lat
+            fp, faults_mod.fault_key(key, 1), (), paxos_lat, rates=frates
         )
     cut_vec = jnp.where(
         issue,
@@ -277,6 +309,7 @@ def tick(
         lat_sum=lat_sum,
         lat_count=lat_count,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -325,6 +358,9 @@ def check_invariants(
         )
     )
     return {
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "cut_le_local": cut_le_local,
         "global_is_sum": global_is_sum,
         "pipeline_ok": pipeline_ok,
@@ -334,6 +370,7 @@ def check_invariants(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedScalogConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -342,5 +379,5 @@ def analysis_config(
     exercise every protocol plane, small enough to trace and compile in
     well under a second."""
     return BatchedScalogConfig(
-        num_shards=4, faults=faults,
+        num_shards=4, faults=faults, workload=workload,
     )

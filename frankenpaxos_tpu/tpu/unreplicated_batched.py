@@ -29,8 +29,10 @@ from frankenpaxos_tpu.tpu.common import (
     bit_latency,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 
 U_EMPTY = 0
 U_REQ = 1  # request in flight to the server
@@ -52,11 +54,17 @@ class BatchedUnreplicatedConfig:
     # message planes, keeping ceiling_fraction apples-to-apples.
     # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes the per-server
+    # admission of new ops (arrival process x Zipf skew, FIFO backlog,
+    # closed-loop client window). WorkloadPlan.none() is a structural
+    # no-op (saturation).
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     def __post_init__(self):
         assert self.window >= 2 * self.ops_per_tick
         assert 1 <= self.lat_min <= self.lat_max
         self.faults.validate(axis=self.num_servers)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +77,7 @@ class BatchedUnreplicatedState:
     done: jnp.ndarray  # [] completed round trips
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -82,6 +91,7 @@ def init_state(cfg: BatchedUnreplicatedConfig) -> BatchedUnreplicatedState:
         done=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(cfg.workload, G, cfg.faults),
         telemetry=make_telemetry(),
     )
 
@@ -101,15 +111,18 @@ def tick(
     # penalties + jitter on both hops; a cut server's ops buffer until
     # the heal tick. none() skips everything at trace time.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     req_arr = t + req_lat
     rep_arr = t + rep_lat
     if fp.active:
         kf = faults_mod.fault_key(key)
         req_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 0), (G, W), req_lat
+            fp, jax.random.fold_in(kf, 0), (G, W), req_lat, rates=frates
         )
         rep_lat = faults_mod.tcp_latency(
-            fp, jax.random.fold_in(kf, 1), (G, W), rep_lat
+            fp, jax.random.fold_in(kf, 1), (G, W), rep_lat, rates=frates
         )
         req_arr = t + req_lat
         rep_arr = t + rep_lat
@@ -137,13 +150,25 @@ def tick(
     arrival = jnp.where(done_now, INF, arrival)
     issue = jnp.where(done_now, INF, state.issue)
 
-    # New ops.
+    # New ops. Under a workload plan the static ops_per_tick knob is
+    # replaced by the per-server admission cap; the client observes a
+    # completion at the reply (done_now).
     empty = status == U_EMPTY
     rank = jnp.cumsum(empty.astype(jnp.int32), axis=1)
-    new = empty & (rank <= cfg.ops_per_tick)
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, G)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        new = empty & (rank <= adm[:, None])
+    else:
+        new = empty & (rank <= cfg.ops_per_tick)
     status = jnp.where(new, U_REQ, status)
     issue = jnp.where(new, t, issue)
     arrival = jnp.where(new, req_arr, arrival)
+    if wl.active:
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes,
+            jnp.sum(new, axis=1), jnp.sum(done_now, axis=1),
+        )
 
     # Telemetry: request hops are this backend's "phase 2" plane
     # (client -> server -> client; no consensus phases exist).
@@ -165,6 +190,7 @@ def tick(
         done=done,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -198,6 +224,9 @@ def check_invariants(
         # Executed counts every request arrival; done lags by in-flight
         # replies.
         "books_ok": state.done <= jnp.sum(state.executed),
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
     }
 
 
@@ -219,6 +248,7 @@ def stats(cfg, state, t) -> dict:
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedUnreplicatedConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -228,4 +258,5 @@ def analysis_config(
     well under a second."""
     return BatchedUnreplicatedConfig(
         num_servers=4, window=16, ops_per_tick=2, faults=faults,
+        workload=workload,
     )

@@ -42,7 +42,9 @@ from frankenpaxos_tpu.tpu.common import (
     ring_retire,
 )
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -84,6 +86,10 @@ class BatchedVanillaMenciusConfig:
     # native server fail/revive machinery — which is exactly what
     # drives revocation. FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes each LIVE
+    # owner's per-tick proposal admission; revocation noops stay
+    # protocol traffic. WorkloadPlan.none() = saturation.
+    workload: WorkloadPlan = WorkloadPlan.none()
 
     @property
     def group_size(self) -> int:
@@ -100,6 +106,7 @@ class BatchedVanillaMenciusConfig:
         assert self.revoke_threshold >= 1
         assert self.revoke_slots_per_tick >= 1
         self.faults.validate(axis=self.group_size)
+        self.workload.validate()
 
 
 @jax.tree_util.register_dataclass
@@ -144,6 +151,7 @@ class BatchedVanillaMenciusState:
     choose_violations: jnp.ndarray  # [] slot re-chosen with a new value
     lat_sum: jnp.ndarray  # []
     lat_hist: jnp.ndarray  # [LAT_BINS]
+    workload: WorkloadState  # shaping state (tpu/workload.py)
     telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
 
 
@@ -183,6 +191,7 @@ def init_state(
         choose_violations=jnp.zeros((), jnp.int32),
         lat_sum=jnp.zeros((), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(cfg.workload, L, cfg.faults),
         telemetry=make_telemetry(),
     )
 
@@ -217,15 +226,20 @@ def tick(
     # shared delivered plane and the revocation-round latency; crash
     # merges into the native server churn below. none() skips all of it.
     fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
     rv_delivered = delivered  # revocation-plane delivery (same native draw)
     if fp.messages_active:
         kf = faults_mod.fault_key(key)
         link_up = faults_mod.partition_row(fp, t, A)[None, None, :]
         f_del, fwd_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 0), (L, W, A), fwd_lat, link_up
+            fp, jax.random.fold_in(kf, 0), (L, W, A), fwd_lat, link_up,
+            rates=frates,
         )
         f_del2, rv_lat = faults_mod.message_faults(
-            fp, jax.random.fold_in(kf, 1), (L, W, A), rv_lat, link_up
+            fp, jax.random.fold_in(kf, 1), (L, W, A), rv_lat, link_up,
+            rates=frates,
         )
         delivered = delivered & f_del
         rv_delivered = rv_delivered & f_del2
@@ -237,7 +251,7 @@ def tick(
     # is True with probability p — the guarded 8-bit Bernoulli). A
     # FaultPlan crash schedule composes with the native rates.
     eff_fail, eff_revive = faults_mod.effective_process_rates(
-        fp, cfg.fail_rate, cfg.revive_rate
+        fp, cfg.fail_rate, cfg.revive_rate, rates=frates
     )
     die = state.alive & ~bit_delivered(bits1, 0, eff_fail)
     revive = ~state.alive & ~bit_delivered(bits1, 8, eff_revive)
@@ -303,6 +317,13 @@ def tick(
     rv_phase = jnp.where(chosen1, RV_NONE, state.rv_phase)
 
     real_chosen = newly_chosen & (slot_value != NOOP_VALUE)
+    # Workload completions: an ADMITTED (real-valued owner) slot is
+    # resolved when it gets chosen — even if revocation chose a noop
+    # over it (the client observes the failure; the window must drain).
+    if wl.active:
+        wl_done = jnp.sum(
+            newly_chosen & (state.slot_value != NOOP_VALUE), axis=1
+        )
     latency = jnp.where(real_chosen, t - state.propose_tick, 0)
     committed = state.committed + jnp.sum(newly_chosen)
     committed_real = state.committed_real + jnp.sum(real_chosen)
@@ -375,15 +396,24 @@ def tick(
     rv_p2a_arrival = jnp.where(clear3, INF, rv_p2a_arrival)
     rv_p2b_arrival = jnp.where(clear3, INF, rv_p2b_arrival)
 
-    # ---- 5. Owner proposals (LIVE owners only; K per tick).
+    # ---- 5. Owner proposals (LIVE owners only; K per tick). Under a
+    # workload plan the static knob becomes the per-stripe admission
+    # cap (tpu/workload.py).
     space = W - (state.next_slot - head)
-    count = jnp.where(
-        alive, jnp.minimum(cfg.slots_per_tick, space), 0
-    )
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, L)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        count = jnp.where(alive, jnp.minimum(adm, space), 0)
+    else:
+        count = jnp.where(
+            alive, jnp.minimum(cfg.slots_per_tick, space), 0
+        )
     delta = jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
     is_new = delta < count[:, None]
     new_ord = state.next_slot[:, None] + delta
     next_slot = state.next_slot + count
+    if wl.active:
+        wls = workload_mod.finish(wl, wls, t, wl_writes, count, wl_done)
     status = jnp.where(is_new, PROPOSED, status)
     slot_value = jnp.where(
         is_new, _owner_value(new_ord, stripe_ids[:, None], L), slot_value
@@ -507,6 +537,7 @@ def tick(
         choose_violations=choose_violations,
         lat_sum=lat_sum,
         lat_hist=lat_hist,
+        workload=wls,
         telemetry=tel,
     )
 
@@ -553,6 +584,9 @@ def check_invariants(
     ) & (state.revoked_discovered <= state.revocations)
     return {
         "choose_once": choose_once,
+        "workload_ok": workload_mod.invariants_ok(
+            cfg.workload, state.workload
+        ),
         "promise_ok": promise_ok,
         "watermark_ok": watermark_ok,
         "window_ok": window_ok,
@@ -588,6 +622,7 @@ def stats(
 
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
 ) -> BatchedVanillaMenciusConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -597,5 +632,5 @@ def analysis_config(
     well under a second."""
     return BatchedVanillaMenciusConfig(
         num_servers=4, window=16, slots_per_tick=2,
-        retry_timeout=8, faults=faults,
+        retry_timeout=8, faults=faults, workload=workload,
     )

@@ -1,0 +1,596 @@
+"""In-graph workload engine: open/closed-loop traffic shaping for the
+batched backends.
+
+Every driver used to commit at whatever rate the tick sustained — pure
+saturation throughput, no latency-vs-load story. The reference framework
+treats workloads as first-class (``benchmarks/``: read/write mixes, key
+skew, client think time), and the Compartmentalization report (arxiv
+2012.15762) evaluates every design point as a latency-vs-throughput
+curve under shaped load. This module is that vocabulary rebuilt
+TPU-first, the traffic-shape twin of :mod:`frankenpaxos_tpu.tpu.faults`:
+a single :class:`WorkloadPlan` accepted by EVERY ``tpu/*_batched.py``
+config, applied INSIDE the compiled tick, so millions of simulated
+clients are just a vmapped client axis and a whole [workload x fault]
+grid sweeps under one compile.
+
+Model: each backend exposes a LANE axis (its proposer axis — groups,
+servers, leaders, columns ...). Per tick, the engine
+
+  * draws per-lane request ARRIVALS from the plan's arrival process
+    (``constant`` — a deterministic 16-bit fixed-point accumulator with
+    exact long-run rate; ``poisson``; ``bursty`` — Poisson with a
+    square-wave rate multiplier; ``diurnal`` — Poisson with a phase
+    schedule of rate multipliers), skewed across lanes by a Zipfian
+    weight vector (:func:`zipf_weights` — lane 0 is the hot key),
+  * splits arrivals into writes and reads by ``read_fraction`` (a
+    second fixed-point accumulator; reads feed the backend's read path
+    where one exists),
+  * queues writes in a bounded per-lane FIFO BACKLOG and computes the
+    tick's per-lane ADMISSION CAP — the cap simply clamps the backend's
+    existing proposals-per-tick knob, so admission composes ahead of
+    the kernel planes with no kernel-plane signature changes,
+  * models CLOSED-LOOP clients as an outstanding-request window per
+    lane: ``closed_window`` clients each issue one request, wait for
+    its commit, think for ``think_time`` ticks (a ring of expiry
+    counts — the offset-clock encoding of think time), then re-issue.
+    Admission is gated on completions: ``in_flight`` never exceeds the
+    window, conserved exactly (``tests/test_workload.py``),
+  * accounts per-entry queue WAIT exactly (arrival tick -> admission
+    tick) into :data:`WAIT_BINS` histogram bins via the cumulative-
+    arrival ring trick: FIFO admission means the entries admitted this
+    tick with wait ``j`` are exactly the overlap of the admission index
+    interval with the arrival-count interval of tick ``t - j`` — an
+    O(lanes x WAIT_BINS) computation, no per-entry timestamps. The
+    admission-tick -> commit-tick latency of every admitted entry lands
+    in the existing telemetry/lat_hist bins (admission IS the propose
+    tick), so the two histograms together are the client-visible
+    latency decomposition.
+
+The OFFERED RATE is a TRACED state-side scalar (``WorkloadState.rate``,
+initialized from ``plan.rate``): sweeping the offered-load axis — the
+whole latency-vs-load matrix — replays ONE compiled program with a
+different scalar, and vmapping the scalar fans the grid out on-device.
+:class:`WorkloadState` also carries the traced Bernoulli rates of a
+``FaultPlan(traced=True)`` (:func:`frankenpaxos_tpu.tpu.faults
+.make_rates`), so one compile sweeps a [workload x fault-rate] grid.
+
+Determinism contract: all workload randomness derives from the tick's
+own threefry key via ``fold_in`` with :data:`WORKLOAD_SALT` (disjoint
+from the fault stream). ``WorkloadPlan.none()`` (the default on every
+config) is a STRUCTURAL no-op: every :class:`WorkloadState` leaf is
+zero-sized, every helper returns its inputs untouched at trace time,
+no key is ever derived — XLA emits the exact pre-workload program and
+runs stay bit-identical to the pre-PR goldens (pinned by
+``tests/test_workload.py`` against the ``tests/test_faults.py`` golden
+values; the ``trace-workload-noop`` analysis rule pins the structure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+# Stream id folded into a tick's key before drawing any workload
+# randomness. Distinct from faults.FAULT_SALT and every backend salt.
+WORKLOAD_SALT = 0x10AD
+
+# Queue-wait histogram bins (== the cumulative-arrival ring length):
+# waits of WAIT_BINS-1 ticks and beyond saturate into the last bin.
+WAIT_BINS = 32
+
+# 16-bit fixed point for the deterministic arrival/read accumulators.
+_FP_ONE = 65536
+
+ARRIVALS = ("saturate", "constant", "poisson", "bursty", "diurnal")
+
+_RATE_FIELDS = ("rate", "burst_mult", "zipf_s", "read_fraction")
+
+
+def zipf_weights(n: int, s: float):
+    """Zipfian lane weights, shared by the device plan and the host
+    command-byte generators (``harness/workload.py``): rank ``i`` gets
+    weight ``(i+1)^-s``, normalized to MEAN 1 over ``n`` lanes (so the
+    plan's ``rate`` stays the per-lane mean regardless of skew). Lane 0
+    is the hot key; ``s == 0`` is uniform."""
+    import numpy as np
+
+    assert n >= 1 and s >= 0.0
+    w = (np.arange(1, n + 1, dtype=np.float64)) ** (-float(s))
+    return (w * (n / w.sum())).astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadPlan:
+    """One traffic shape. Frozen + hashable: lives inside the static
+    backend config (a ``jax.jit`` static argument). The plan fixes the
+    STRUCTURE (process kind, window, think time, skew); the offered
+    rate itself is traced state (:class:`WorkloadState`), initialized
+    from ``rate``, so rate sweeps never recompile."""
+
+    # Arrival process over the lane axis. "saturate" = no shaping (the
+    # pre-plan behavior: the backend proposes at its static per-tick
+    # knob); the other four draw per-tick per-lane arrival counts.
+    arrival: str = "saturate"
+    rate: float = 0.0  # mean arrivals per lane per tick (traced default)
+    # "bursty": rate multiplies by burst_mult for the first burst_len
+    # ticks of every burst_every-tick period.
+    burst_every: int = 64
+    burst_len: int = 8
+    burst_mult: float = 4.0
+    # "diurnal": a phase schedule of rate multipliers — phase p covers
+    # ticks [p*phase_len, (p+1)*phase_len) mod the full period.
+    phases: Tuple[float, ...] = ()
+    phase_len: int = 64
+    # Zipfian skew of arrivals across the lane axis (0 = uniform; lane
+    # 0 is the hot key). Static: the skew vector is a trace constant.
+    zipf_s: float = 0.0
+    # Fraction of arrivals that are READS, split deterministically by a
+    # fixed-point accumulator. Only backends with a device read path
+    # accept a nonzero mix (they pass reads_supported=True below).
+    read_fraction: float = 0.0
+    # Closed-loop clients per lane: each issues one request, waits for
+    # its commit, thinks think_time ticks, re-issues. 0 = open loop.
+    closed_window: int = 0
+    think_time: int = 0
+    # Per-lane FIFO backlog bound (open-loop shaping): arrivals beyond
+    # it are SHED (counted, never silently queued without bound).
+    backlog_cap: int = 1024
+
+    # -- structural predicates (all trace-time Python bools) ------------
+
+    @property
+    def shaped(self) -> bool:
+        """An arrival process is configured (arrivals are drawn)."""
+        return self.arrival != "saturate"
+
+    @property
+    def closed(self) -> bool:
+        return self.closed_window > 0
+
+    @property
+    def active(self) -> bool:
+        """Any shaping engaged (the tick helpers run iff this holds)."""
+        return self.shaped or self.closed
+
+    @property
+    def has_reads(self) -> bool:
+        return self.shaped and self.read_fraction > 0.0
+
+    @classmethod
+    def none(cls) -> "WorkloadPlan":
+        """The structural no-op plan: every helper compiles to the
+        identity, every state leaf is zero-sized, and XLA emits the
+        exact pre-workload program."""
+        return cls()
+
+    def validate(self, reads_supported: bool = False) -> None:
+        """Config-time validation; every backend's ``__post_init__``
+        calls this (backends with a device read path pass
+        ``reads_supported=True`` when the read ring is configured)."""
+        assert self.arrival in ARRIVALS, (
+            f"workload.arrival={self.arrival!r} not in {ARRIVALS}"
+        )
+        assert self.rate >= 0.0
+        if self.shaped:
+            assert self.rate > 0.0, (
+                "a shaped arrival process needs workload.rate > 0"
+            )
+            # The fixed-point accumulator and the Poisson sampler both
+            # want per-lane-per-tick means far below the int32 emission
+            # bound; 2^14 is orders beyond any sane per-lane load.
+            assert self.rate * max(self.burst_mult, 1.0) < 2**14
+        assert 0.0 <= self.read_fraction < 1.0
+        if self.read_fraction > 0.0:
+            assert self.shaped, "read_fraction needs an arrival process"
+            assert reads_supported, (
+                "workload.read_fraction > 0 but this backend/config has "
+                "no device read path (enable its read ring, or mix 0)"
+            )
+        if self.arrival == "bursty":
+            assert 1 <= self.burst_len <= self.burst_every
+            assert self.burst_mult > 0.0
+        if self.arrival == "diurnal":
+            assert len(self.phases) >= 1 and self.phase_len >= 1
+            assert all(p > 0.0 for p in self.phases)
+        assert self.closed_window >= 0
+        assert 0 <= self.think_time < 2**14
+        assert self.backlog_cap >= 1
+        assert self.zipf_s >= 0.0
+
+    # -- serialization (one schema with harness/workload.py) ------------
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["phases"] = list(self.phases)
+        d["type"] = "device_plan"
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadPlan":
+        d = {k: v for k, v in d.items() if k != "type"}
+        d["phases"] = tuple(d.get("phases", ()))
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# State
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WorkloadState:
+    """Device-resident shaping state, carried in every batched
+    backend's ``*State`` (lane axis L = the backend's proposer axis).
+    Every leaf is ZERO-SIZED for the features a plan leaves off — a
+    ``WorkloadPlan.none()`` state is all-empty, adds zero ops, and
+    keeps the scan carry bit-identical to the pre-workload program.
+    Counters are int32 (the dtype policy's accumulator width); the two
+    traced sweep scalars are float32 (``widen_state`` passes floats
+    through, so narrow/widened replays stay bit-identical)."""
+
+    # Traced sweep axes: the offered rate, and a traced FaultPlan's
+    # [drop, dup, crash, revive] Bernoulli rates (faults.make_rates).
+    rate: jnp.ndarray  # [] float32 offered rate (shaped) | [0]
+    fault_rates: jnp.ndarray  # [4] float32 (faults.traced) | [0]
+    # Arrival bookkeeping (shaped).
+    acc: jnp.ndarray  # [L] int32 16-bit fixed-point accumulator
+    racc: jnp.ndarray  # [L] int32 read-split accumulator | [0]
+    backlog: jnp.ndarray  # [L] int32 queued (arrived, unadmitted) writes
+    cum_ring: jnp.ndarray  # [L, WAIT_BINS] int32 cumulative-arrival ring
+    adm_total: jnp.ndarray  # [L] int32 cumulative admissions
+    # Closed loop (closed_window > 0).
+    in_flight: jnp.ndarray  # [L] int32 outstanding requests | [0]
+    idle: jnp.ndarray  # [L] int32 clients ready to issue | [0]
+    ready_ring: jnp.ndarray  # [L, think_time] int32 think expiries | [L, 0]
+    # Cumulative accounting (plan.active).
+    offered: jnp.ndarray  # [] int32 write arrivals drawn | [0]
+    admitted: jnp.ndarray  # [] int32 admissions | [0]
+    completed: jnp.ndarray  # [] int32 completions | [0]
+    shed: jnp.ndarray  # [] int32 arrivals shed at backlog_cap | [0]
+    wait_sum: jnp.ndarray  # [] int32 total queue-wait ticks | [0]
+    wait_hist: jnp.ndarray  # [WAIT_BINS] int32 queue-wait bins | [0]
+
+
+def make_state(
+    plan: WorkloadPlan,
+    lanes: int,
+    faults: FaultPlan = FaultPlan.none(),
+) -> WorkloadState:
+    """The backend's per-lane shaping state (+ the traced fault-rate
+    scalars when ``faults.traced``). Leaves for disabled features are
+    zero-sized so the none plan carries nothing."""
+    z32 = jnp.int32
+    Ls = lanes if plan.shaped else 0
+    Lc = lanes if plan.closed else 0
+    TH = plan.think_time if (plan.closed and plan.think_time) else 0
+    scalar = () if plan.active else (0,)
+    sh_scalar = () if plan.shaped else (0,)
+    return WorkloadState(
+        rate=(
+            jnp.full((), plan.rate, jnp.float32)
+            if plan.shaped
+            else jnp.zeros((0,), jnp.float32)
+        ),
+        fault_rates=faults_mod.make_rates(faults),
+        acc=jnp.zeros((Ls,), z32),
+        racc=jnp.zeros((Ls if plan.has_reads else 0,), z32),
+        backlog=jnp.zeros((Ls,), z32),
+        cum_ring=jnp.zeros((Ls, WAIT_BINS if Ls else 0), z32),
+        adm_total=jnp.zeros((Ls,), z32),
+        in_flight=jnp.zeros((Lc,), z32),
+        idle=jnp.full((Lc,), plan.closed_window, z32),
+        ready_ring=jnp.zeros((Lc, TH), z32),
+        offered=jnp.zeros(scalar, z32),
+        admitted=jnp.zeros(scalar, z32),
+        completed=jnp.zeros(scalar, z32),
+        shed=jnp.zeros(sh_scalar, z32),
+        wait_sum=jnp.zeros(sh_scalar, z32),
+        wait_hist=jnp.zeros((WAIT_BINS if plan.shaped else 0,), z32),
+    )
+
+
+def workload_key(key: jnp.ndarray) -> jnp.ndarray:
+    """The per-tick workload stream. Callers must only derive this when
+    the plan is active so the inactive path touches no keys at all."""
+    return jax.random.fold_in(key, WORKLOAD_SALT)
+
+
+# ---------------------------------------------------------------------------
+# Tick-side helpers. Call order inside a backend's tick:
+#     writes, reads, wls = begin(plan, wls, key, t, lanes)
+#     cap = admission(plan, wls, writes)            # clamp the propose knob
+#     ... existing propose path admits `actual` [L] entries ...
+#     wls = finish(plan, wls, t, writes, actual, completed_per_lane)
+# ---------------------------------------------------------------------------
+
+
+def _modulation(plan: WorkloadPlan, t) -> jnp.ndarray:
+    """Traced scalar rate multiplier at tick ``t`` (1.0 for the
+    unmodulated processes)."""
+    if plan.arrival == "bursty":
+        in_burst = jnp.mod(t, plan.burst_every) < plan.burst_len
+        return jnp.where(in_burst, plan.burst_mult, 1.0).astype(
+            jnp.float32
+        )
+    if plan.arrival == "diurnal":
+        sched = jnp.asarray(plan.phases, jnp.float32)
+        phase = jnp.mod(t // plan.phase_len, len(plan.phases))
+        return jnp.take(sched, phase)
+    return jnp.float32(1.0)
+
+
+def begin(
+    plan: WorkloadPlan,
+    wls: WorkloadState,
+    key: jnp.ndarray,
+    t,
+    lanes: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, WorkloadState]:
+    """Draw this tick's per-lane arrivals and release think-expired
+    closed-loop clients. Returns ``(writes [L], reads [L], wls')``.
+    Inactive plan: zero-sized arrays, state untouched, no PRNG."""
+    if not plan.active:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, wls
+    acc, racc = wls.acc, wls.racc
+    if plan.shaped:
+        lam = (
+            wls.rate
+            * _modulation(plan, t)
+            * jnp.asarray(zipf_weights(lanes, plan.zipf_s))
+        )  # [L] float32
+        if plan.arrival == "constant":
+            # Deterministic 16-bit fixed-point emission: exact long-run
+            # rate, zero variance, no PRNG.
+            lam_fp = jnp.round(lam * _FP_ONE).astype(jnp.int32)
+            acc = acc + lam_fp
+            arrivals = acc >> 16
+            acc = acc & (_FP_ONE - 1)
+        else:
+            arrivals = jax.random.poisson(
+                workload_key(key), lam, (lanes,), dtype=jnp.int32
+            )
+    else:
+        arrivals = jnp.zeros((lanes,), jnp.int32)
+    if plan.has_reads:
+        rf_fp = max(1, int(round(plan.read_fraction * _FP_ONE)))
+        racc = racc + arrivals * rf_fp
+        reads = racc >> 16
+        racc = racc & (_FP_ONE - 1)
+        writes = arrivals - reads
+    else:
+        reads = jnp.zeros((0,), jnp.int32)
+        writes = arrivals
+    idle, ready_ring = wls.idle, wls.ready_ring
+    if plan.closed and plan.think_time:
+        # Think-expiry release: clients whose think clock lands on this
+        # ring slot become ready to issue (the offset-clock encoding of
+        # think_time — one ring column per residual tick).
+        TH = plan.think_time
+        slot = (jnp.arange(TH, dtype=jnp.int32) == jnp.mod(t, TH))
+        idle = idle + jnp.sum(
+            jnp.where(slot[None, :], ready_ring, 0), axis=1
+        )
+        ready_ring = jnp.where(slot[None, :], 0, ready_ring)
+    return writes, reads, dataclasses.replace(
+        wls, acc=acc, racc=racc, idle=idle, ready_ring=ready_ring
+    )
+
+
+def admission(
+    plan: WorkloadPlan, wls: WorkloadState, writes: jnp.ndarray
+) -> jnp.ndarray:
+    """[L] int32 admission cap for this tick — the max entries each
+    lane's propose path may take. Backends clamp their static
+    proposals-per-tick knob with it (``rank <= cap[:, None]`` /
+    ``minimum(cap, space)``): the backend ring may still admit fewer;
+    :func:`finish` accounts the ACTUAL count. Callers only reach this
+    when the plan is active."""
+    assert plan.active
+    if plan.shaped:
+        demand = wls.backlog + writes
+        if plan.closed:
+            demand = jnp.minimum(demand, wls.idle)
+        return demand
+    # Pure closed loop: every idle client issues immediately.
+    return wls.idle
+
+
+def finish(
+    plan: WorkloadPlan,
+    wls: WorkloadState,
+    t,
+    writes: jnp.ndarray,
+    admitted: jnp.ndarray,
+    completed: jnp.ndarray,
+) -> WorkloadState:
+    """End-of-tick accounting: backlog/shed, the exact FIFO queue-wait
+    histogram, and the closed-loop window. ``admitted`` is the ACTUAL
+    per-lane count the propose path took this tick (``<= admission``);
+    ``completed`` is the per-lane count of workload entries whose
+    commit the client observed this tick."""
+    if not plan.active:
+        return wls
+    new = {}
+    admitted = admitted.astype(jnp.int32)
+    completed = completed.astype(jnp.int32)
+    if plan.shaped:
+        # Backlog update: admission drains the FIFO head; arrivals
+        # beyond backlog_cap shed from the tail (newest first), so the
+        # FIFO indexing of everything that stays is untouched.
+        backlog_mid = wls.backlog + writes - admitted
+        shed_l = jnp.maximum(backlog_mid - plan.backlog_cap, 0)
+        new["backlog"] = backlog_mid - shed_l
+        arr_eff = writes - shed_l
+        new["offered"] = wls.offered + jnp.sum(arr_eff)
+        new["shed"] = wls.shed + jnp.sum(shed_l)
+        # Cumulative-arrival ring: slot t % WAIT_BINS holds the total
+        # surviving arrivals through tick t.
+        prev_total = wls.adm_total + wls.backlog  # == old cum total
+        cum_now = prev_total + arr_eff  # [L]
+        wslot = (
+            jnp.arange(WAIT_BINS, dtype=jnp.int32) == jnp.mod(t, WAIT_BINS)
+        )
+        cum_ring = jnp.where(
+            wslot[None, :], cum_now[:, None], wls.cum_ring
+        )
+        new["cum_ring"] = cum_ring
+        # Exact FIFO wait binning: the admitted index interval
+        # [adm_before, adm_after) intersected with each past tick's
+        # arrival-count interval (C_{j+1}, C_j] gives the count of
+        # entries admitted now that waited exactly j ticks (j ==
+        # WAIT_BINS-1 saturates: it absorbs everything older than the
+        # ring).
+        adm_before = wls.adm_total
+        adm_after = adm_before + admitted
+        new["adm_total"] = adm_after
+        j = jnp.arange(WAIT_BINS, dtype=jnp.int32)
+        Cs = jnp.take(cum_ring, jnp.mod(t - j, WAIT_BINS), axis=1)
+        lo = jnp.concatenate(
+            [Cs[:, 1:], jnp.zeros_like(Cs[:, :1])], axis=1
+        )
+        counts = jnp.clip(
+            jnp.minimum(adm_after[:, None], Cs)
+            - jnp.maximum(adm_before[:, None], lo),
+            0,
+            None,
+        )  # [L, WAIT_BINS]
+        new["wait_hist"] = wls.wait_hist + jnp.sum(counts, axis=0)
+        new["wait_sum"] = wls.wait_sum + jnp.sum(counts * j[None, :])
+    else:
+        new["offered"] = wls.offered + jnp.sum(admitted)
+    new["admitted"] = wls.admitted + jnp.sum(admitted)
+    new["completed"] = wls.completed + jnp.sum(completed)
+    if plan.closed:
+        new["in_flight"] = wls.in_flight + admitted - completed
+        idle = wls.idle - admitted
+        if plan.think_time:
+            TH = plan.think_time
+            slot2 = (
+                jnp.arange(TH, dtype=jnp.int32)
+                == jnp.mod(t + TH, TH)  # == t % TH: released NEXT lap
+            )
+            new["ready_ring"] = wls.ready_ring + jnp.where(
+                slot2[None, :], completed[:, None], 0
+            )
+        else:
+            idle = idle + completed
+        new["idle"] = idle
+    return dataclasses.replace(wls, **new)
+
+
+def invariants_ok(plan: WorkloadPlan, wls: WorkloadState) -> jnp.ndarray:
+    """Traced scalar bool: the shaping bookkeeping is conserved —
+    closed-loop lanes never exceed their window (in_flight + idle +
+    thinking == closed_window, all nonnegative) and open-loop backlogs
+    respect their bound. True (a constant) when the plan is inactive;
+    every backend merges this into ``check_invariants``."""
+    ok = jnp.asarray(True)
+    if plan.closed:
+        thinking = jnp.sum(wls.ready_ring, axis=1)
+        ok = (
+            ok
+            & jnp.all(wls.in_flight >= 0)
+            & jnp.all(wls.idle >= 0)
+            & jnp.all(
+                wls.in_flight + wls.idle + thinking == plan.closed_window
+            )
+        )
+    if plan.shaped:
+        ok = (
+            ok
+            & jnp.all(wls.backlog >= 0)
+            & jnp.all(wls.backlog <= plan.backlog_cap)
+            & jnp.all(wls.adm_total >= 0)
+        )
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Host-side sweep + reporting helpers.
+# ---------------------------------------------------------------------------
+
+
+def set_rate(wls: WorkloadState, rate: float) -> WorkloadState:
+    """The offered-load sweep axis: a new traced rate, same compile."""
+    assert wls.rate.shape == (), (
+        "set_rate needs a shaped plan (arrival != 'saturate')"
+    )
+    return dataclasses.replace(
+        wls, rate=jnp.full((), rate, jnp.float32)
+    )
+
+
+def set_fault_rates(
+    wls: WorkloadState,
+    drop: float = 0.0,
+    dup: float = 0.0,
+    crash: float = 0.0,
+    revive: float = 0.0,
+) -> WorkloadState:
+    """The fault-rate sweep axis of a ``FaultPlan(traced=True)`` config:
+    new traced Bernoulli rates, same compile."""
+    assert wls.fault_rates.shape == (4,), (
+        "set_fault_rates needs a FaultPlan(traced=True) config"
+    )
+    return dataclasses.replace(
+        wls,
+        fault_rates=jnp.asarray(
+            [drop, dup, crash, revive], jnp.float32
+        ),
+    )
+
+
+def hist_percentile(hist, q: float) -> int:
+    """Nearest-rank percentile of an integer histogram (bin index =
+    value). -1 on an empty histogram."""
+    import numpy as np
+
+    h = np.asarray(jax.device_get(hist), np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return -1
+    rank = max(1, int(np.ceil(q * total)))
+    return int((h.cumsum() >= rank).argmax())
+
+
+def summary(plan: WorkloadPlan, wls: WorkloadState) -> dict:
+    """Host roll-up of the shaping state (one coalesced pull):
+    cumulative offered/admitted/completed/shed, queue depth, window
+    occupancy, and queue-wait percentiles."""
+    wls = jax.device_get(wls)
+    out = {"active": plan.active, "arrival": plan.arrival}
+    if not plan.active:
+        return out
+    out.update(
+        offered=int(wls.offered),
+        admitted=int(wls.admitted),
+        completed=int(wls.completed),
+    )
+    if plan.shaped:
+        import numpy as np
+
+        out.update(
+            rate=float(wls.rate),
+            shed=int(wls.shed),
+            wait_sum_ticks=int(wls.wait_sum),
+            queue_depth=int(np.sum(wls.backlog)),
+            queue_wait_p50_ticks=hist_percentile(wls.wait_hist, 0.50),
+            queue_wait_p99_ticks=hist_percentile(wls.wait_hist, 0.99),
+        )
+    if plan.closed:
+        import numpy as np
+
+        out.update(
+            closed_window=plan.closed_window,
+            in_flight=int(np.sum(wls.in_flight)),
+            idle=int(np.sum(wls.idle)),
+        )
+    return out

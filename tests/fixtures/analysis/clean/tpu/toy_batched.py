@@ -13,10 +13,12 @@ class ToyConfig:
     n: int = 4
     loss_rate: float = 0.0
     faults: FaultPlan = None  # noqa: F821
+    workload: WorkloadPlan = None  # noqa: F821
 
     def __post_init__(self):
         assert 0.0 <= self.loss_rate <= 1.0, self.loss_rate
         self.faults.validate(self.n)
+        self.workload.validate()
 
 
 @dataclasses.dataclass
@@ -34,9 +36,10 @@ def init_state(cfg: ToyConfig) -> ToyState:
 
 def tick(cfg: ToyConfig, state: ToyState, t, key):
     drop = faults_mod.message_faults(cfg.faults, key)  # noqa: F821
+    cap = workload_mod.admission(cfg.workload, state, drop)  # noqa: F821
     tel = record(state.telemetry, commits=state.counter)  # noqa: F821
     return dataclasses.replace(
-        state, counter=state.counter + (1 - drop), telemetry=tel
+        state, counter=state.counter + cap - drop, telemetry=tel
     )
 
 

@@ -1,0 +1,15 @@
+# Synthetic CLEAN workload module for the analysis-engine tests:
+# every float field of the Plan is range-checked in validate().
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyWorkloadPlan:
+    rate: float = 0.0
+    read_fraction: float = 0.0
+    closed_window: int = 0
+
+    def validate(self) -> None:
+        assert self.rate >= 0.0
+        assert 0.0 <= self.read_fraction < 1.0
+        assert self.closed_window >= 0

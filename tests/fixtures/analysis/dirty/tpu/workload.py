@@ -1,0 +1,12 @@
+# Synthetic DIRTY workload module: `bad_fraction` is a float field the
+# validate() body never range-checks (workload-rate-validated fires).
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyWorkloadPlan:
+    rate: float = 0.0
+    bad_fraction: float = 0.0
+
+    def validate(self) -> None:
+        assert self.rate >= 0.0

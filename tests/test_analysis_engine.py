@@ -34,6 +34,10 @@ FIXTURE_RULES = [
     "fault-validate",
     "fault-apply",
     "fault-rate-validated",
+    "workload-config-field",
+    "workload-validate",
+    "workload-apply",
+    "workload-rate-validated",
     "kernel-pallas-containment",
     "state-dead-write",
 ]
@@ -85,6 +89,10 @@ def test_dirty_fixture_expected_keys():
         ("fault-validate", "toy_batched.py:ToyConfig"),
         ("fault-apply", "toy_batched.py"),
         ("fault-rate-validated", "toy_batched.py:ToyConfig:loss_rate"),
+        ("workload-config-field", "toy_batched.py:ToyConfig"),
+        ("workload-validate", "toy_batched.py:ToyConfig"),
+        ("workload-apply", "toy_batched.py"),
+        ("workload-rate-validated", "workload.py:ToyWorkloadPlan:bad_fraction"),
         ("kernel-pallas-containment", "tpu/toy_batched.py"),
         ("state-dead-write", "toy_batched.py:ghost"),
     }

@@ -286,6 +286,68 @@ def test_microbench_faults_smoke():
     assert '"--faults"' in bench_src and '"faults"' in bench_src
 
 
+def test_microbench_workload_smoke():
+    """The workload-engine bench at toy size (guards ``microbench
+    workload`` and ``bench.py --workload``): every shaping tier runs,
+    shaped runs commit less than saturation (the load really shapes),
+    the closed tier stays window-bound, and the overhead ratios come
+    back finite."""
+    from frankenpaxos_tpu.harness import microbench
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, retry_timeout=8,
+    )
+    measured = microbench.measure_workload_overhead(
+        cfg, ticks=50, rounds=1
+    )
+    assert set(measured["ratios"]) == {"constant", "poisson", "closed"}
+    assert all(r > 0 for r in measured["ratios"].values())
+    c = measured["committed"]
+    assert c["none"] > 0
+    # rate == slots_per_tick but backlog warm-up + Zipf skew keep the
+    # shaped tiers at or under saturation throughput.
+    assert 0 < c["constant"] <= c["none"]
+    assert 0 < c["poisson"] <= c["none"]
+    assert 0 < c["closed"] < c["none"]
+    for case in ("constant", "poisson", "closed"):
+        sim = measured["sims"][case]
+        assert all(sim.check_invariants().values()), case
+
+    # bench.py exposes the separate --workload mode + its inner half.
+    import pathlib
+
+    bench_src = (
+        pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    ).read_text()
+    assert '"--workload"' in bench_src
+    assert "--inner-workload" in bench_src
+
+
+def test_simtest_joint_randomization_smoke():
+    """The joint [workload x fault] schedule axis (guards the simtest
+    sweep): a randomized workload + fault pair runs green with the
+    workload invariant merged into the per-segment checks."""
+    import random as _random
+
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    spec = simtest.SPECS["unreplicated"]
+    rng = _random.Random(42)
+    # Draw until a genuinely active workload comes up (deterministic).
+    wplan = simtest.random_workload(rng, spec, 80)
+    while not wplan.active:
+        wplan = simtest.random_workload(rng, spec, 80)
+    res = simtest.run_schedule(
+        spec, FaultPlan(drop_rate=0.05), seed=1, ticks=80, segment=40,
+        workload=wplan,
+    )
+    assert res["ok"], res["violations"]
+    assert res["progress"][-1] > 0
+    assert res["workload"]["type"] == "device_plan"
+
+
 def test_microbench_kernels_smoke():
     """The kernel-layer bench at toy size (guards ``microbench
     kernels``): every registered plane reports a reference timing and —

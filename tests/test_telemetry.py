@@ -159,11 +159,15 @@ def test_disabled_telemetry_does_not_change_simulation():
     for f in dataclasses.fields(on):
         if f.name == "telemetry":
             continue
-        np.testing.assert_array_equal(
-            np.asarray(getattr(on, f.name)),
-            np.asarray(getattr(off, f.name)),
-            err_msg=f.name,
-        )
+        # Pytree-valued fields (the workload shaping state) compare
+        # leaf-by-leaf; array fields directly.
+        on_leaves = jax.tree_util.tree_leaves(getattr(on, f.name))
+        off_leaves = jax.tree_util.tree_leaves(getattr(off, f.name))
+        assert len(on_leaves) == len(off_leaves), f.name
+        for a, b in zip(on_leaves, off_leaves):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
 
 
 # -- Transport integration ----------------------------------------------------

@@ -1,0 +1,591 @@
+"""The in-graph workload engine (tpu/workload.py): traffic shaping,
+closed-loop window conservation, Zipf skew, traced [workload x
+fault-rate] sweeps, and the WorkloadPlan.none() structural no-op.
+
+The load-bearing guarantee first: ``WorkloadPlan.none()`` (the default
+on every batched config) is a STRUCTURAL no-op. The golden values below
+are the ``tests/test_faults.py`` pre-fault-subsystem captures (PR 2
+head, commit f899c3f) — the same fixed configs/seeds, now constructed
+with an EXPLICIT none plan — so any workload-threading change that
+perturbs a default run by even one bit fails here against the true
+pre-PR behavior."""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.harness import simtest
+from frankenpaxos_tpu.tpu import (
+    craq_batched,
+    multipaxos_batched,
+    unreplicated_batched,
+    vanillamencius_batched,
+)
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as wl
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+
+def _hash(state, fields):
+    m = hashlib.sha256()
+    for f in fields:
+        m.update(np.asarray(jax.device_get(getattr(state, f))).tobytes())
+    return m.hexdigest()[:16]
+
+
+def _full_hash(state):
+    m = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state)):
+        m.update(np.asarray(leaf).tobytes())
+    return m.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# none() bit-identity against the pre-PR goldens (4 backends x 3 seeds;
+# values identical to tests/test_faults.py — the workload default must
+# not move them by a bit)
+# ---------------------------------------------------------------------------
+
+GOLDEN_MULTIPAXOS = {
+    0: (582, 562, 3426, "dd70eeb17ab45de2"),
+    1: (581, 530, 3487, "c665a10d449618ae"),
+    2: (583, 551, 3340, "ec2d56f23217dda9"),
+}
+GOLDEN_CRAQ = {
+    0: (374, 743, 251, "b6fe4b6285011bda"),
+    1: (368, 747, 231, "0025adf193587ca4"),
+    2: (370, 750, 219, "d9c0363c64b1db0c"),
+}
+GOLDEN_UNREPLICATED = {
+    0: (929, 3663, "589abaf0933332b2"),
+    1: (929, 3705, "bbd795f9ce1b7c01"),
+    2: (928, 3692, "f8fe3872c1751c1a"),
+}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_multipaxos(seed):
+    mp = multipaxos_batched
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, lat_min=1,
+        lat_max=3, drop_rate=0.05, retry_timeout=8,
+        workload=WorkloadPlan.none(),
+    )
+    assert cfg.workload == WorkloadPlan.none()
+    # The default IS the none plan (an implicit default must be the
+    # same structural no-op as the explicit one).
+    assert mp.BatchedMultiPaxosConfig().workload == cfg.workload
+    st, _ = mp.run_ticks(
+        cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.committed), int(st.retired), int(st.lat_sum),
+        _hash(st, ("status", "slot_value", "chosen_round", "head",
+                   "next_slot", "acc_round", "vote_round", "vote_value")),
+    )
+    assert got == GOLDEN_MULTIPAXOS[seed]
+    # And the carried shaping state is structurally EMPTY.
+    assert all(
+        leaf.size == 0
+        for leaf in jax.tree_util.tree_leaves(st.workload)
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_craq(seed):
+    cr = craq_batched
+    cfg = cr.BatchedCraqConfig(
+        num_chains=4, chain_len=3, num_keys=8, window=8,
+        writes_per_tick=2, reads_per_tick=2, read_window=8,
+        workload=WorkloadPlan.none(),
+    )
+    st, _ = cr.run_ticks(
+        cfg, cr.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.writes_done), int(st.reads_done), int(st.reads_dirty),
+        _hash(st, ("w_status", "w_version", "node_version", "node_dirty",
+                   "r_status")),
+    )
+    assert got == GOLDEN_CRAQ[seed]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_unreplicated(seed):
+    ur = unreplicated_batched
+    cfg = ur.BatchedUnreplicatedConfig(
+        num_servers=4, window=16, ops_per_tick=2,
+        workload=WorkloadPlan.none(),
+    )
+    st, _ = ur.run_ticks(
+        cfg, ur.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+        jax.random.PRNGKey(seed),
+    )
+    got = (
+        int(st.done), int(st.lat_sum),
+        _hash(st, ("status", "issue", "arrival", "executed")),
+    )
+    assert got == GOLDEN_UNREPLICATED[seed]
+
+
+def test_none_plan_bit_identical_vanillamencius():
+    """4th backend for the >=4-backend pin: the none plan replays the
+    exact same history as a default config (self-consistency across
+    two separately-traced programs on a churn-heavy backend)."""
+    vm = vanillamencius_batched
+    base = vm.analysis_config()
+    explicit = vm.analysis_config(workload=WorkloadPlan.none())
+    key = jax.random.PRNGKey(4)
+    a, _ = vm.run_ticks(
+        base, vm.init_state(base), jnp.zeros((), jnp.int32), 120, key
+    )
+    b, _ = vm.run_ticks(
+        explicit, vm.init_state(explicit), jnp.zeros((), jnp.int32),
+        120, key,
+    )
+    assert _full_hash(a) == _full_hash(b)
+    assert int(a.committed) > 0
+
+
+# ---------------------------------------------------------------------------
+# Plan semantics
+# ---------------------------------------------------------------------------
+
+
+def test_plan_validation_rejects_malformed_plans():
+    with pytest.raises(AssertionError):
+        WorkloadPlan(arrival="weibull").validate()
+    with pytest.raises(AssertionError):
+        WorkloadPlan(arrival="poisson", rate=0.0).validate()
+    with pytest.raises(AssertionError):
+        WorkloadPlan(arrival="poisson", rate=1.0, read_fraction=0.3
+                     ).validate(reads_supported=False)
+    with pytest.raises(AssertionError):
+        WorkloadPlan(read_fraction=0.3).validate(reads_supported=True)
+    with pytest.raises(AssertionError):
+        WorkloadPlan(arrival="bursty", rate=1.0, burst_len=0).validate()
+    with pytest.raises(AssertionError):
+        WorkloadPlan(arrival="diurnal", rate=1.0, phases=()).validate()
+    with pytest.raises(AssertionError):
+        WorkloadPlan(closed_window=-1).validate()
+    WorkloadPlan(
+        arrival="diurnal", rate=1.5, phases=(0.5, 2.0), phase_len=8,
+        zipf_s=0.9, closed_window=4, think_time=2,
+    ).validate()
+    # The config path rejects a read mix without a read ring.
+    with pytest.raises(AssertionError):
+        multipaxos_batched.BatchedMultiPaxosConfig(
+            workload=WorkloadPlan(
+                arrival="poisson", rate=1.0, read_fraction=0.2
+            )
+        )
+
+
+def test_plan_round_trips_through_json_and_host_dispatcher():
+    plan = WorkloadPlan(
+        arrival="diurnal", rate=2.5, phases=(0.5, 1.5, 3.0),
+        phase_len=16, zipf_s=0.8, read_fraction=0.25,
+        closed_window=6, think_time=3, backlog_cap=512,
+    )
+    again = WorkloadPlan.from_dict(json.loads(json.dumps(plan.to_dict())))
+    assert again == plan
+    # One config surface: the HOST workload dispatcher deserializes the
+    # device plan from the same schema, and the host Zipf generator
+    # shares the device skew vector.
+    from frankenpaxos_tpu.harness.workload import (
+        ZipfSingleKeyWorkload,
+        workload_from_dict,
+    )
+
+    assert workload_from_dict(plan.to_dict()) == plan
+    host = ZipfSingleKeyWorkload(num_keys=16, zipf_s=0.8)
+    again_host = workload_from_dict(host.to_dict())
+    assert again_host == host
+    np.testing.assert_allclose(
+        host._weights, wl.zipf_weights(16, 0.8), rtol=1e-6
+    )
+
+
+def test_zipf_weights_normalized_and_skewed():
+    w = wl.zipf_weights(64, 1.0)
+    assert w.shape == (64,)
+    assert abs(float(w.mean()) - 1.0) < 1e-5
+    assert w[0] > w[10] > w[63] > 0
+    u = wl.zipf_weights(64, 0.0)
+    np.testing.assert_allclose(u, np.ones(64), rtol=1e-6)
+
+
+def test_constant_arrivals_are_exact_and_deterministic():
+    """The fixed-point accumulator emits the exact long-run rate with
+    zero drift: over T ticks each lane emits floor-error < 1."""
+    plan = WorkloadPlan(arrival="constant", rate=1.75)
+    plan.validate()
+    s = wl.make_state(plan, 8)
+    key = jax.random.PRNGKey(0)
+    total = np.zeros(8, np.int64)
+    for t in range(64):
+        writes, _, s = wl.begin(
+            plan, s, jax.random.fold_in(key, t), jnp.int32(t), 8
+        )
+        total += np.asarray(writes)
+    expected = 1.75 * 64
+    assert np.all(np.abs(total - expected) <= 1.0), total
+
+
+def test_bursty_and_diurnal_modulation():
+    bursty = WorkloadPlan(
+        arrival="bursty", rate=2.0, burst_every=16, burst_len=4,
+        burst_mult=3.0,
+    )
+    assert float(wl._modulation(bursty, jnp.int32(1))) == 3.0
+    assert float(wl._modulation(bursty, jnp.int32(10))) == 1.0
+    diurnal = WorkloadPlan(
+        arrival="diurnal", rate=1.0, phases=(0.5, 2.0, 1.0), phase_len=8
+    )
+    assert float(wl._modulation(diurnal, jnp.int32(0))) == 0.5
+    assert float(wl._modulation(diurnal, jnp.int32(9))) == 2.0
+    assert float(wl._modulation(diurnal, jnp.int32(17))) == 1.0
+    assert float(wl._modulation(diurnal, jnp.int32(24))) == 0.5  # wraps
+
+
+def test_read_split_accumulator_tracks_fraction():
+    plan = WorkloadPlan(arrival="constant", rate=4.0, read_fraction=0.25)
+    plan.validate(reads_supported=True)
+    s = wl.make_state(plan, 4)
+    key = jax.random.PRNGKey(1)
+    w_tot = r_tot = 0
+    for t in range(64):
+        writes, reads, s = wl.begin(
+            plan, s, jax.random.fold_in(key, t), jnp.int32(t), 4
+        )
+        w_tot += int(writes.sum())
+        r_tot += int(reads.sum())
+    total = w_tot + r_tot
+    assert abs(total - 4.0 * 4 * 64) <= 4
+    assert abs(r_tot / total - 0.25) < 0.02
+
+
+def test_fifo_wait_histogram_is_exact():
+    """Hand-run scenario: 3 arrivals at t=0 on one lane, drained one
+    per tick — waits must be exactly {0, 1, 2}."""
+    plan = WorkloadPlan(arrival="constant", rate=1.0)
+    s = wl.make_state(plan, 1)
+    key = jax.random.PRNGKey(0)
+    # Tick 0: inject 3 arrivals by hand (bypass begin's draw), admit 1.
+    writes = jnp.asarray([3], jnp.int32)
+    s = wl.finish(plan, s, jnp.int32(0), writes,
+                  jnp.asarray([1], jnp.int32), jnp.zeros((1,), jnp.int32))
+    for t in (1, 2):
+        s = wl.finish(plan, s, jnp.int32(t), jnp.zeros((1,), jnp.int32),
+                      jnp.asarray([1], jnp.int32),
+                      jnp.zeros((1,), jnp.int32))
+    hist = np.asarray(s.wait_hist)
+    assert hist[0] == 1 and hist[1] == 1 and hist[2] == 1
+    assert int(s.wait_sum) == 0 + 1 + 2
+    assert int(s.backlog[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop window conservation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("think", [0, 3])
+def test_closed_loop_window_conservation(think):
+    """in_flight <= closed_window at EVERY segment boundary, the
+    in_flight + idle + thinking partition is exact, and the engine's
+    own books (admitted - completed == sum in_flight) balance."""
+    mp = multipaxos_batched
+    cfg = mp.analysis_config(
+        workload=WorkloadPlan(closed_window=3, think_time=think)
+    )
+    st = mp.init_state(cfg)
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    for seg in range(6):
+        st, t = mp.run_ticks(cfg, st, t, 20, jax.random.fold_in(key, seg))
+        inflight = np.asarray(st.workload.in_flight)
+        assert np.all(inflight >= 0)
+        assert np.all(inflight <= 3)
+        inv = mp.check_invariants(cfg, st, t)
+        assert all(bool(v) for v in inv.values()), {
+            k: bool(v) for k, v in inv.items() if not bool(v)
+        }
+        assert int(st.workload.admitted) - int(st.workload.completed) == int(
+            inflight.sum()
+        )
+    assert int(st.committed) > 0
+    assert int(st.workload.completed) > 0
+
+
+def test_closed_loop_throughput_is_window_bound():
+    """Little's law sanity: halving the window roughly halves the
+    committed throughput of an otherwise-saturating run."""
+    mp = multipaxos_batched
+
+    def run(window):
+        cfg = mp.analysis_config(
+            workload=WorkloadPlan(closed_window=window)
+        )
+        st, _ = mp.run_ticks(
+            cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 120,
+            jax.random.PRNGKey(2),
+        )
+        return int(st.committed)
+
+    c1, c4 = run(1), run(4)
+    assert 0 < c1 < c4
+    assert c4 > 2 * c1
+
+
+def test_epaxos_admission_accounts_post_clamp_count():
+    """Regression: finish() must see the ACTUAL issue count — with
+    max_instances_per_column active, the pre-clamp cap would drain
+    phantom entries from the backlog and strand the closed-loop
+    window. Every admission must correspond to a real issued
+    instance (admitted == sum(next_instance)) and the window must
+    fully drain once the columns hit their cap."""
+    from frankenpaxos_tpu.tpu import epaxos_batched as ep
+
+    cfg = dataclasses.replace(
+        ep.analysis_config(
+            workload=WorkloadPlan(closed_window=4, think_time=1)
+        ),
+        max_instances_per_column=20,
+    )
+    st, t = ep.run_ticks(
+        cfg, ep.init_state(cfg), jnp.zeros((), jnp.int32), 150,
+        jax.random.PRNGKey(0),
+    )
+    inv = ep.check_invariants(cfg, st, t)
+    assert all(bool(v) for v in inv.values())
+    adm = int(st.workload.admitted)
+    assert adm == int(st.next_instance.sum())
+    assert adm - int(st.workload.completed) == int(
+        st.workload.in_flight.sum()
+    )
+    assert int(st.workload.in_flight.sum()) == 0  # capped run drains
+
+
+# ---------------------------------------------------------------------------
+# Zipf skew on a live backend (3 seeds)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_zipf_skew_shapes_per_lane_admissions(seed):
+    """Empirical per-lane admission frequency tracks the configured
+    Zipf weights: the hot lane strictly leads, the ordering follows
+    rank, and the hot/cold ratio lands near the analytic weight ratio."""
+    ur = unreplicated_batched
+    cfg = ur.BatchedUnreplicatedConfig(
+        num_servers=8, window=64, ops_per_tick=4,
+        workload=WorkloadPlan(
+            arrival="poisson", rate=1.0, zipf_s=1.0, backlog_cap=4096
+        ),
+    )
+    st, _ = ur.run_ticks(
+        cfg, ur.init_state(cfg), jnp.zeros((), jnp.int32), 400,
+        jax.random.PRNGKey(seed),
+    )
+    # Per-lane admissions = executed + still-in-ring (every admitted op
+    # stays counted); with a large window nothing backlogs away.
+    per_lane = np.asarray(st.executed) + np.asarray(
+        jax.device_get((st.status != 0).sum(axis=1))
+    )
+    w = wl.zipf_weights(8, 1.0)
+    assert per_lane[0] == per_lane.max()
+    assert per_lane[0] > per_lane[3] > per_lane[7]
+    ratio = per_lane[0] / max(per_lane[7], 1)
+    expected = w[0] / w[7]
+    assert 0.5 * expected < ratio < 2.0 * expected, (ratio, expected)
+
+
+# ---------------------------------------------------------------------------
+# Determinism + the traced [workload x fault-rate] sweep
+# ---------------------------------------------------------------------------
+
+
+def test_shaped_run_replays_bit_identically_across_seeds():
+    mp = multipaxos_batched
+    cfg = mp.analysis_config(
+        faults=FaultPlan(drop_rate=0.1, jitter=1),
+        workload=WorkloadPlan(
+            arrival="poisson", rate=1.5, zipf_s=0.6, closed_window=6
+        ),
+    )
+    hashes = {}
+    for seed in (0, 1):
+        for attempt in range(2):
+            st, _ = mp.run_ticks(
+                cfg, mp.init_state(cfg), jnp.zeros((), jnp.int32), 100,
+                jax.random.PRNGKey(seed),
+            )
+            hashes.setdefault(seed, set()).add(_full_hash(st))
+    assert len(hashes[0]) == 1 and len(hashes[1]) == 1  # replays exact
+    assert hashes[0] != hashes[1]  # seeds differ
+
+
+def test_traced_fault_rates_match_static_plan_results():
+    """A traced plan with swept rate r commits exactly what the static
+    plan with compile-time rate r commits (same 1/256 quantization,
+    same PRNG streams) — and zero traced rates reproduce the none-plan
+    VALUES (the program differs; the results must not)."""
+    mp = multipaxos_batched
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def run_static(drop):
+        cfg = mp.analysis_config(
+            faults=FaultPlan(drop_rate=drop) if drop else FaultPlan.none()
+        )
+        st, _ = mp.run_ticks(cfg, mp.init_state(cfg), t0, 100, key)
+        return int(st.committed)
+
+    def run_traced(drop):
+        cfg = mp.analysis_config(faults=FaultPlan(traced=True))
+        st = mp.init_state(cfg)
+        st = dataclasses.replace(
+            st, workload=wl.set_fault_rates(st.workload, drop=drop)
+        )
+        st, _ = mp.run_ticks(cfg, st, t0, 100, key)
+        return int(st.committed)
+
+    assert run_traced(0.0) == run_static(0.0)
+    # A traced nonzero drop really degrades (and the cache never grows
+    # across the rate sweep — one compile serves the whole grid).
+    before = mp.run_ticks._cache_size()
+    degraded = run_traced(0.2)
+    assert mp.run_ticks._cache_size() == before
+    assert degraded < run_traced(0.0)
+
+
+def test_traced_rate_grid_vmaps_in_one_compile():
+    """The device-scale grid: vmap over stacked fault_rates vectors
+    fans a whole drop-rate sweep out of one compiled program, and the
+    committed counts decrease monotonically with the drop rate."""
+    ur = unreplicated_batched
+    cfg = ur.analysis_config(faults=FaultPlan(traced=True))
+    base = ur.init_state(cfg)
+    drops = jnp.asarray([0.0, 0.1, 0.3], jnp.float32)
+    rates = jnp.stack(
+        [jnp.asarray([d, 0.0, 0.0, 0.0], jnp.float32) for d in drops]
+    )
+
+    def one(rate_vec):
+        st = dataclasses.replace(
+            base,
+            workload=dataclasses.replace(
+                base.workload, fault_rates=rate_vec
+            ),
+        )
+        out, _ = ur.run_ticks.__wrapped__(
+            cfg, st, jnp.zeros((), jnp.int32), 80, jax.random.PRNGKey(0)
+        )
+        return out.done
+
+    done = jax.jit(jax.vmap(one))(rates)
+    done = [int(x) for x in done]
+    assert done[0] > done[1] > done[2] > 0, done
+
+
+def test_traced_plan_without_rate_state_fails_loudly():
+    """The enforcement half of the traced contract: helpers reject a
+    traced plan whose rates were not threaded."""
+    fp = FaultPlan(traced=True)
+    with pytest.raises(AssertionError, match="traced"):
+        faults_mod.message_faults(
+            fp, jax.random.PRNGKey(0), (4,), jnp.zeros((4,), jnp.int32)
+        )
+    with pytest.raises(AssertionError, match="traced"):
+        faults_mod.tcp_latency(
+            fp, jax.random.PRNGKey(0), (4,), jnp.zeros((4,), jnp.int32)
+        )
+
+
+def test_offered_rate_sweep_hits_one_compile():
+    """The latency-vs-load matrix contract: sweeping the traced
+    offered rate replays one compiled program and higher offered load
+    commits more (below saturation)."""
+    mp = multipaxos_batched
+    cfg = mp.analysis_config(
+        workload=WorkloadPlan(arrival="constant", rate=0.5)
+    )
+
+    def run(rate):
+        st = mp.init_state(cfg)
+        st = dataclasses.replace(
+            st, workload=wl.set_rate(st.workload, rate)
+        )
+        st, _ = mp.run_ticks(
+            cfg, st, jnp.zeros((), jnp.int32), 100, jax.random.PRNGKey(0)
+        )
+        return int(st.committed)
+
+    lo = run(0.5)
+    before = mp.run_ticks._cache_size()
+    hi = run(1.5)
+    assert mp.run_ticks._cache_size() == before
+    assert 0 < lo < hi
+
+
+# ---------------------------------------------------------------------------
+# Joint randomization (simtest)
+# ---------------------------------------------------------------------------
+
+
+def test_random_workload_is_deterministic_and_well_formed():
+    import random
+
+    spec = simtest.SPECS["compartmentalized"]
+    rng_a, rng_b = random.Random(11), random.Random(11)
+    a = [simtest.random_workload(rng_a, spec, 120) for _ in range(16)]
+    b = [simtest.random_workload(rng_b, spec, 120) for _ in range(16)]
+    assert a == b
+    kinds = {p.arrival for p in a} | {
+        "closed" for p in a if p.closed
+    }
+    assert len(kinds) >= 3  # the draw actually diversifies
+    for plan in a:
+        plan.validate(reads_supported=True)
+    # A backend WITHOUT a read path never draws a mix.
+    spec_nr = simtest.SPECS["multipaxos"]
+    for i in range(16):
+        p = simtest.random_workload(random.Random(100 + i), spec_nr, 120)
+        assert p.read_fraction == 0.0
+        p.validate()
+
+
+def test_joint_schedule_runs_and_reproducer_round_trips(tmp_path):
+    spec = simtest.SPECS["multipaxos"]
+    fplan = FaultPlan(drop_rate=0.1)
+    wplan = WorkloadPlan(arrival="poisson", rate=1.0, closed_window=5)
+    res = simtest.run_schedule(
+        spec, fplan, seed=2, ticks=80, segment=40, workload=wplan
+    )
+    assert res["ok"], res["violations"]
+    assert res["progress"][-1] > 0
+    assert WorkloadPlan.from_dict(res["workload"]) == wplan
+    path = tmp_path / "repro.json"
+    simtest.dump_reproducer(
+        str(path), spec, fplan, 2, 80, workload=wplan
+    )
+    loaded = simtest.load_reproducer(str(path))
+    assert len(loaded) == 5
+    assert loaded[1] == fplan and loaded[4] == wplan
+
+
+def test_joint_sweep_smoke():
+    res = simtest.sweep(
+        backends=["unreplicated"], schedules=2, seeds_per_schedule=2,
+        ticks=80, base_seed=5, check_liveness=False,
+    )
+    assert res["ok"], res
