@@ -179,6 +179,48 @@ def called_names(func: ast.AST) -> Set[Tuple[str, str]]:
     return out
 
 
+def dispatch_tables(tree: ast.Module) -> Dict[str, List[Tuple[str, str]]]:
+    """SWITCH TABLES: dict literals bound at module or class level
+    whose values reference functions — the ``HANDLERS = {...}`` /
+    ``HANDLERS[kind](x)`` dispatch idiom a call-graph walk cannot see
+    through a direct call edge. Returns table name -> list of
+    ``(base, name)`` callee refs (the :func:`called_names` shape) for
+    every Name / ``mod.attr`` value in the dict; non-reference values
+    (literals, lambdas, comprehensions) are skipped. Only module- and
+    class-level bindings count — a dict local to one function is that
+    function's business, and matching it repo-wide by bare name would
+    drag unreachable helpers into the host-sync frontier."""
+    out: Dict[str, List[Tuple[str, str]]] = {}
+    scopes = [tree.body] + [
+        n.body for n in tree.body if isinstance(n, ast.ClassDef)
+    ]
+    for node in (stmt for body in scopes for stmt in body):
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        else:
+            continue
+        if not isinstance(value, ast.Dict):
+            continue
+        refs: List[Tuple[str, str]] = []
+        for v in value.values:
+            if isinstance(v, ast.Name):
+                refs.append(("", v.id))
+            elif isinstance(v, ast.Attribute) and isinstance(
+                v.value, ast.Name
+            ):
+                refs.append((v.value.id, v.attr))
+        if not refs:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name):
+                out.setdefault(target.id, []).extend(refs)
+    return out
+
+
 def dispatched_plane_names(tree: ast.Module) -> Set[str]:
     """Literal plane names passed to a ``*.dispatch(...)`` call."""
     names: Set[str] = set()

@@ -266,6 +266,7 @@ def _module_index(ctx: Context) -> Dict[str, dict]:
                 "tree": tree,
                 "functions": astutil.module_functions(tree),
                 "aliases": astutil.import_aliases(tree),
+                "tables": astutil.dispatch_tables(tree),
             }
     return index
 
@@ -289,6 +290,14 @@ def _resolve_call(
     target = entry["aliases"].get(base)
     if target and target in index and name in index[target]["functions"]:
         return (target, name)
+    # METHOD calls (``driver.helper(...)`` / ``self.helper(...)``):
+    # the base is an object, not a module alias, but the walk can still
+    # follow a def with that name in the SAME module —
+    # module_functions() indexes class bodies, so methods resolve like
+    # any other def. Array/stdlib method names (.sum(), .astype(), ...)
+    # match no local def and fall through to None exactly as before.
+    if name in entry["functions"]:
+        return (mod, name)
     return None
 
 
@@ -296,7 +305,8 @@ def _resolve_call(
     "host-sync-purity",
     "ast",
     "no host-sync primitive is reachable from any tick/run_ticks/step "
-    "body — transitively, through helpers in tpu/ and ops/",
+    "body — transitively, through helpers in tpu/ and ops/, including "
+    "method calls and dict switch-table dispatch",
 )
 def check_host_sync(ctx: Context) -> List[Finding]:
     index = _module_index(ctx)
@@ -338,7 +348,21 @@ def check_host_sync(ctx: Context) -> List[Finding]:
                     key=key,
                 )
             )
-        for base, name in astutil.called_names(func):
+        callees = set(astutil.called_names(func))
+        # SWITCH TABLES: a read of a module/class-level dict of function
+        # refs inside a walked body dispatches to every function in the
+        # table (HANDLERS[kind](x) — the call edge the direct walk
+        # cannot see); all its entries join the frontier.
+        tables = entry["tables"]
+        if tables:
+            for node in ast.walk(func):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in tables
+                ):
+                    callees.update(tables[node.id])
+        for base, name in callees:
             resolved = _resolve_call(index, mod, base, name)
             if resolved and resolved not in seen:
                 seen.add(resolved)

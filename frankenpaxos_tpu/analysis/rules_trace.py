@@ -888,3 +888,95 @@ def check_retrace_guard(ctx: Context) -> List[Finding]:
                 )
             )
     return out
+
+
+@rule(
+    "trace-serve-nosync",
+    "trace",
+    "the serve loop's chunked-dispatch hot path (run_ticks + the "
+    "telemetry snapshot, harness/serve.py) compiles free of blocking "
+    "host transfers — no host callbacks/infeed/outfeed, and the "
+    "snapshot COPIES (aliases nothing), so draining it after the next "
+    "chunk donates the state never reads donated buffers",
+)
+def check_serve_nosync(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    from frankenpaxos_tpu.harness import serve as serve_mod
+    from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+
+    backend = "multipaxos"  # the flagship serve target
+    if ctx.backends is not None and backend not in ctx.backends:
+        return []
+    out: List[Finding] = []
+
+    def scan_blocking(hlo: str, where: str):
+        """Host-rendezvous constructs in a compiled hot-path artifact.
+        Matched per-line so variable names in metadata (last_send ...)
+        can't false-positive: callbacks lower to custom-calls whose
+        TARGET names a python/host callback; infeed/outfeed appear as
+        the op itself."""
+        for i, line in enumerate(hlo.splitlines()):
+            lowered = line.lower()
+            hit = None
+            if "custom-call" in lowered and (
+                "callback" in lowered or "host_compute" in lowered
+            ):
+                hit = "host callback custom-call"
+            elif " infeed(" in lowered or " outfeed(" in lowered:
+                hit = "infeed/outfeed"
+            if hit:
+                out.append(
+                    Finding(
+                        rule="trace-serve-nosync",
+                        path=backend,
+                        line=i + 1,
+                        message=(
+                            f"{hit} in the compiled {where} — the "
+                            "serve hot path would block on the host "
+                            "every chunk"
+                        ),
+                        key=f"{backend}:{where}:{hit}",
+                    )
+                )
+
+    mod = _module(backend)
+    cfg = mod.analysis_config()
+    # Two legs: the plain serve state, and a span-sampler-enabled state
+    # (the reservoir + completion ring must not smuggle a callback or
+    # break the snapshot-copies contract either).
+    for label, spans in (("", 0), ("spans", 4)):
+        state = mod.init_state(cfg)
+        state = _dc.replace(
+            state,
+            telemetry=telemetry_mod.make_telemetry(
+                telemetry_mod.TELEM_WINDOW, spans=spans
+            ),
+        )
+        run_lowered, snap_lowered = serve_mod.lower_chunk_path(
+            mod, cfg, state=state
+        )
+        where_run = f"run_ticks{('+' + label) if label else ''}"
+        where_snap = f"snapshot{('+' + label) if label else ''}"
+        scan_blocking(run_lowered.compile().as_text(), where_run)
+        snap_hlo = snap_lowered.compile().as_text()
+        scan_blocking(snap_hlo, where_snap)
+        aliased = _alias_param_indices(snap_hlo)
+        if aliased:
+            out.append(
+                Finding(
+                    rule="trace-serve-nosync",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"the compiled telemetry snapshot ALIASES "
+                        f"{len(aliased)} input buffer(s) — the serve "
+                        "drain would read buffers the next chunk's "
+                        "donation already reused; the snapshot must "
+                        "copy"
+                    ),
+                    key=f"{backend}:{where_snap}:aliased",
+                )
+            )
+    return out

@@ -1,0 +1,424 @@
+"""Continuous serve mode: a long-lived driver over any batched backend
+with STREAMING observability — the serving shape ROADMAP asks for,
+replacing the batch-mode compile/run-N-ticks/dump-JSON lifecycle.
+
+Chunked dispatch with a double-buffered, non-blocking telemetry drain:
+
+    dispatch chunk i          (run_ticks — donated state, async)
+    snapshot telemetry_i      (a tiny jitted device-side COPY of the
+                               telemetry ring + live workload gauges,
+                               enqueued right behind chunk i; the copy
+                               is what makes the buffers survive chunk
+                               i+1's donation of the state)
+    drain snapshot_{i-1}      (jax.device_get on the PREVIOUS chunk's
+                               snapshot — it only waits for chunk i-1,
+                               which already finished or is finishing,
+                               while chunk i keeps computing)
+
+The hot path therefore never syncs: no ``block_until_ready`` on the
+state, no ``device_get`` of anything a pending chunk still owns —
+spy-asserted by ``tests/test_serve.py`` and pinned structurally by the
+``trace-serve-nosync`` analysis rule (the snapshot program must COPY,
+i.e. alias nothing, and neither compiled artifact may contain a host
+callback). Drains go through a :class:`telemetry.DrainCursor`, so
+chunked drains are EXACT: summed chunk rows equal the one-shot capture
+bit for bit, no sample lost or double-counted.
+
+On top of the drain sit the streaming consumers:
+
+  * the SLO engine (``monitoring/slo.py``): rolling p99-vs-target and
+    shed-rate alarms from the live histograms, with a host control
+    plane that CLAMPS admission on alarm through ``workload.set_rate``
+    (a traced-state update between chunks — never a recompile) and
+    recovers it after the alarm clears;
+  * the span sampler (``telemetry.record_spans``, flagship backend):
+    sampled per-slot lifecycle tick-stamps, exported together with the
+    host dispatch/drain wall-clock spans as ONE Perfetto-loadable
+    Chrome trace (``monitoring/traceviz.py``); host spans are also
+    wrapped in ``jax.profiler`` annotations so a concurrent profiler
+    capture shows them next to the device trace;
+  * the scrape CSV (``monitoring/scrape.py`` schema): one device
+    sample batch + host span batch per drain, tailed LIVE by
+    ``python -m frankenpaxos_tpu.monitoring.dashboard <csv> --live``.
+
+CLI (a bounded run of the flagship)::
+
+    python -m frankenpaxos_tpu.harness.serve --seconds 10 \\
+        --out-dir /tmp/serve [--rate-x 1.1] [--spans 16] \\
+        [--slo-p99 24] [--groups 64] [--chunk 32]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.monitoring import scrape as scrape_mod
+from frankenpaxos_tpu.monitoring import traceviz
+from frankenpaxos_tpu.monitoring.slo import SloEngine, SloPolicy
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serve-mode knobs (orthogonal to the backend's protocol config)."""
+
+    chunk_ticks: int = 32  # ticks per dispatched chunk
+    telemetry_window: int = telemetry_mod.TELEM_WINDOW
+    spans: int = 0  # span-sampler reservoir (0 = off)
+    slo: Optional[SloPolicy] = None
+    scrape_csv: Optional[str] = None  # live CSV (dashboard --live tails it)
+    trace_path: Optional[str] = None  # Perfetto trace written at shutdown
+    max_chunks: Optional[int] = None
+    max_seconds: Optional[float] = None
+
+    def __post_init__(self):
+        assert self.chunk_ticks >= 1
+        # Exact drains need the ring to retain at least one full chunk.
+        assert self.telemetry_window >= self.chunk_ticks, (
+            "telemetry_window must cover a chunk or drains drop ticks"
+        )
+        assert self.max_chunks is not None or self.max_seconds is not None, (
+            "bound the loop with max_chunks and/or max_seconds"
+        )
+
+
+def _copy_tree(tree):
+    """Jit-compiled device-side copy: outputs are FRESH buffers (the
+    inputs are not donated, so XLA must materialize copies), which is
+    what lets the drain read them after the next chunk donates the
+    state they were copied from."""
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+_SNAP = jax.jit(_copy_tree)
+
+
+def snapshot_leaves(state) -> Dict[str, Any]:
+    """The sub-pytree the serve loop snapshots per chunk: the telemetry
+    ring + the live workload gauges the SLO engine reads. Tiny (a few
+    KB) next to the protocol state."""
+    wls = state.workload
+    return {
+        "telemetry": state.telemetry,
+        "wait_hist": wls.wait_hist,
+        "offered": wls.offered,
+        "shed": wls.shed,
+        "backlog": wls.backlog,
+    }
+
+
+def lower_chunk_path(mod, cfg, state=None, chunk_ticks: int = 4):
+    """Lower the two compiled artifacts of the serve hot path at a
+    given config — (run_ticks, snapshot) — for inspection. Used by the
+    ``trace-serve-nosync`` analysis rule and the harness tests; keeping
+    it HERE means the rule checks exactly what the loop runs."""
+    if state is None:
+        state = mod.init_state(cfg)
+    run_lowered = mod.run_ticks.lower(
+        cfg, state, jnp.zeros((), jnp.int32), chunk_ticks,
+        jax.random.PRNGKey(0),
+    )
+    snap_lowered = _SNAP.lower(snapshot_leaves(state))
+    return run_lowered, snap_lowered
+
+
+class ServeLoop:
+    """A long-lived serve driver over one backend module (anything
+    exposing the repo's ``init_state(cfg)`` / ``run_ticks(cfg, state,
+    t0, n, key)`` protocol — all 14 ``tpu/*_batched.py`` backends)."""
+
+    def __init__(
+        self,
+        mod,
+        cfg,
+        serve: ServeConfig,
+        seed: int = 0,
+    ):
+        self.mod = mod
+        self.cfg = cfg
+        self.serve = serve
+        self.key = jax.random.PRNGKey(seed)
+        self.state = mod.init_state(cfg)
+        self.state = dataclasses.replace(
+            self.state,
+            telemetry=telemetry_mod.make_telemetry(
+                serve.telemetry_window, spans=serve.spans
+            ),
+        )
+        self.t = jnp.zeros((), jnp.int32)
+        self.cursor = telemetry_mod.DrainCursor()
+        self.clock = traceviz.TickClock()
+        self.host_spans: List[dict] = []
+        self.spans: List[dict] = []
+        self.drains: List[dict] = []
+        self.slo: Optional[SloEngine] = (
+            SloEngine(serve.slo) if serve.slo else None
+        )
+        plan = getattr(cfg, "workload", None)
+        self._base_rate = (
+            float(plan.rate) if plan is not None and plan.shaped else None
+        )
+        self._prev: Dict[str, Any] = {}  # previous drain's cumulatives
+        self._spans_scraped = 0  # host spans already appended to CSV
+        self._chunks = 0
+        self._epoch = 0
+        self.clean_shutdown = False
+
+    # -- host-side trace spans (also jax.profiler-annotated) ---------------
+
+    def _span(self, name: str, start_unix: float, t0: float, **meta):
+        self.host_spans.append(
+            {
+                "name": name,
+                "start_unix": start_unix,
+                "duration_s": time.perf_counter() - t0,
+                **meta,
+            }
+        )
+
+    # -- the hot path -------------------------------------------------------
+
+    def _dispatch_chunk(self):
+        """Dispatch one chunk + enqueue its telemetry snapshot; returns
+        the snapshot (a pytree of futures). NO blocking call here."""
+        key = jax.random.fold_in(self.key, self._epoch)
+        self._epoch += 1
+        start, t0 = time.time(), time.perf_counter()
+        with jax.profiler.TraceAnnotation("serve:dispatch"):
+            self.state, self.t = self.mod.run_ticks(
+                self.cfg, self.state, self.t, self.serve.chunk_ticks, key
+            )
+            snap = _SNAP(snapshot_leaves(self.state))
+        self._span(
+            "dispatch", start, t0,
+            num_ticks=self.serve.chunk_ticks,
+            compile=self._chunks == 0,
+        )
+        self._chunks += 1
+        return snap
+
+    def _drain(self, snap) -> dict:
+        """Drain one chunk's snapshot (the ONLY device_get on the hot
+        path — and only ever on a snapshot, never on the live state)."""
+        start, t0 = time.time(), time.perf_counter()
+        with jax.profiler.TraceAnnotation("serve:drain"):
+            host = jax.device_get(snap)
+        drain = self.cursor.drain(host["telemetry"])
+        self._span("drain", start, t0, ticks=drain["ticks_total"])
+        self.clock.add_mark(drain["ticks_total"], time.time())
+        self.spans.extend(drain["spans"])
+
+        # Streaming consumers: SLO engine + admission control plane.
+        if self.slo is not None:
+            prev = self._prev
+            lat = drain["lat_hist"]
+            wait = host["wait_hist"]
+            offered = (
+                int(host["offered"]) if host["offered"].size else 0
+            )
+            shed = int(host["shed"]) if host["shed"].size else 0
+            status = self.slo.observe(
+                lat_hist_delta=lat - prev.get("lat", 0),
+                wait_hist_delta=(
+                    wait - prev.get("wait", 0) if wait.size else None
+                ),
+                offered_delta=offered - prev.get("offered", 0),
+                shed_delta=shed - prev.get("shed", 0),
+            )
+            self._prev = {
+                "lat": lat, "wait": wait, "offered": offered,
+                "shed": shed,
+            }
+            drain["slo"] = status
+            if self._base_rate is not None:
+                # The control-plane hook: clamp/recover the offered
+                # rate through the TRACED state scalar — the same
+                # compiled program keeps running.
+                self.state = dataclasses.replace(
+                    self.state,
+                    workload=workload_mod.set_rate(
+                        self.state.workload,
+                        self._base_rate * self.slo.scale,
+                    ),
+                )
+        if self.serve.scrape_csv:
+            scrape_mod.append_device_samples(
+                self.serve.scrape_csv, host["telemetry"],
+                instance="serve",
+            )
+            # Every span exactly once (a fixed [-2:] window would skip
+            # the compile-marked first dispatch and double-write the
+            # previous drain at shutdown).
+            scrape_mod.append_host_spans(
+                self.serve.scrape_csv,
+                self.host_spans[self._spans_scraped:],
+                instance="serve",
+            )
+            self._spans_scraped = len(self.host_spans)
+        self.drains.append(drain)
+        return drain
+
+    def run(self) -> dict:
+        """Serve until the configured bound, then shut down cleanly
+        (final drain + trace export). Returns the serve report."""
+        serve = self.serve
+        deadline = (
+            time.monotonic() + serve.max_seconds
+            if serve.max_seconds is not None
+            else None
+        )
+        start_wall = time.perf_counter()
+        self.clock.add_mark(int(jax.device_get(self.t)), time.time())
+        prev_snap = None
+        while True:
+            if serve.max_chunks is not None and (
+                self._chunks >= serve.max_chunks
+            ):
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            snap = self._dispatch_chunk()
+            if prev_snap is not None:
+                self._drain(prev_snap)
+            prev_snap = snap
+        # Shutdown: the last snapshot drains AFTER its chunk completes
+        # (the one place a wait is correct), then the trace exports.
+        if prev_snap is not None:
+            self._drain(prev_snap)
+        jax.block_until_ready(self.state)
+        wall = time.perf_counter() - start_wall
+        self.clean_shutdown = True
+        if serve.trace_path:
+            traceviz.write_chrome_trace(
+                serve.trace_path,
+                device_spans=self.spans,
+                host_spans=self.host_spans,
+                clock=self.clock,
+            )
+        return self.report(wall)
+
+    def report(self, wall_s: float) -> dict:
+        ticks = self.cursor.tick
+        totals = (
+            self.drains[-1]["totals"] if self.drains else {}
+        )
+        out = {
+            "backend": self.mod.__name__.rsplit(".", 1)[-1].replace(
+                "_batched", ""
+            ),
+            "chunks": self._chunks,
+            "chunk_ticks": self.serve.chunk_ticks,
+            "ticks": ticks,
+            "wall_s": round(wall_s, 4),
+            "ticks_per_sec": round(ticks / wall_s, 2) if wall_s else 0.0,
+            "dropped_ticks": sum(
+                d["dropped_ticks"] for d in self.drains
+            ),
+            "dropped_spans": sum(
+                d["dropped_spans"] for d in self.drains
+            ),
+            "spans_exported": len(self.spans),
+            "totals": totals,
+            "clean_shutdown": self.clean_shutdown,
+        }
+        if self.slo is not None:
+            out["slo"] = self.slo.summary()
+        if self.serve.trace_path:
+            out["trace_path"] = self.serve.trace_path
+        if self.serve.scrape_csv:
+            out["scrape_csv"] = self.serve.scrape_csv
+        return out
+
+
+def serve_flagship(
+    seconds: float = 10.0,
+    out_dir: str = ".",
+    num_groups: int = 64,
+    chunk_ticks: int = 32,
+    spans: int = 16,
+    rate_x: Optional[float] = None,
+    slo_p99: Optional[int] = None,
+    seed: int = 0,
+    window: int = 32,
+    slots_per_tick: int = 4,
+    max_chunks: Optional[int] = None,
+) -> dict:
+    """A bounded serve run of the flagship MultiPaxos backend — the CLI
+    + smoke entry point. ``rate_x`` shapes the workload at that
+    multiple of the config's nominal per-lane admission rate (enabling
+    the queue-wait histograms the SLO engine reads); ``slo_p99`` arms
+    the SLO engine + admission control plane."""
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+
+    kw: dict = {}
+    if rate_x is not None:
+        kw["workload"] = workload_mod.WorkloadPlan(
+            arrival="constant",
+            rate=rate_x * slots_per_tick,
+            backlog_cap=256,
+        )
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=num_groups, window=window,
+        slots_per_tick=slots_per_tick, retry_timeout=16, **kw
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    serve_cfg = ServeConfig(
+        chunk_ticks=chunk_ticks,
+        telemetry_window=max(
+            chunk_ticks * 2, telemetry_mod.TELEM_WINDOW
+        ),
+        spans=spans,
+        slo=(
+            SloPolicy(p99_target_ticks=slo_p99, source="queue_wait")
+            if slo_p99 is not None
+            else None
+        ),
+        scrape_csv=os.path.join(out_dir, "serve_metrics.csv"),
+        trace_path=os.path.join(out_dir, "serve_trace.json"),
+        max_seconds=seconds,
+        max_chunks=max_chunks,
+    )
+    loop = ServeLoop(mp, cfg, serve_cfg, seed=seed)
+    report = loop.run()
+    with open(os.path.join(out_dir, "serve_report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="frankenpaxos_tpu.harness.serve")
+    p.add_argument("--seconds", type=float, default=10.0)
+    p.add_argument("--out-dir", default="serve_out")
+    p.add_argument("--groups", type=int, default=64)
+    p.add_argument("--chunk", type=int, default=32)
+    p.add_argument("--spans", type=int, default=16)
+    p.add_argument("--rate-x", type=float, default=None)
+    p.add_argument("--slo-p99", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    report = serve_flagship(
+        seconds=args.seconds,
+        out_dir=args.out_dir,
+        num_groups=args.groups,
+        chunk_ticks=args.chunk,
+        spans=args.spans,
+        rate_x=args.rate_x,
+        slo_p99=args.slo_p99,
+        seed=args.seed,
+    )
+    print(json.dumps(report))
+    return 0 if report["clean_shutdown"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,7 +39,18 @@ def render_dashboard(
     names = set(capture.names())
     panels = []
     for name in sorted(names):
-        if name.endswith("_requests_total"):
+        # Host-role request counters AND the device-side telemetry
+        # counters a serve loop streams into the same CSV schema
+        # (fpx_device_*_total, monitoring/scrape.append_device_samples)
+        # both render as rate panels — the --live serve view.
+        # queue_depth is a GAUGE (its exposition total is a sum of
+        # end-of-tick depths, not an event count): rate() of it is
+        # meaningless, so it stays off the panel list.
+        if name.endswith("_requests_total") or (
+            name.startswith("fpx_device_")
+            and name.endswith("_total")
+            and "queue_depth" not in name
+        ):
             panels.append(("rate", name))
     for count_name in sorted(names):
         if not count_name.endswith("_handler_latency_seconds_count"):
@@ -155,6 +166,51 @@ def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
     return output
 
 
+def tail_live(
+    path: str,
+    output: str,
+    interval_s: float = 1.0,
+    max_seconds: float = 30.0,
+    window_ms: float = 1000.0,
+    idle_exit_s: float = 10.0,
+) -> int:
+    """LIVE mode: tail a scrape CSV that a serve loop (or
+    ``MetricsScraper``) is still appending to, re-rendering the
+    dashboard whenever the file grows — watching a long-lived run
+    instead of waiting for a finished capture. Returns the number of
+    renders. Exits after ``max_seconds``, or once the file has been
+    idle for ``idle_exit_s`` (the run ended)."""
+    import time
+
+    renders = 0
+    last_size = -1
+    last_growth = time.monotonic()
+    deadline = time.monotonic() + max_seconds
+    while time.monotonic() < deadline:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = -1  # not written yet — keep waiting
+        if size != last_size and size > 0:
+            last_growth = time.monotonic()
+            try:
+                if render_dashboard(
+                    MetricsCapture(path), output, window_ms=window_ms
+                ):
+                    renders += 1
+                    print(f"live: rendered {output} ({size} bytes)")
+                # Mark this size consumed only on a clean render: a
+                # torn mid-append read leaves last_size stale, so the
+                # next poll retries even if the file stopped growing.
+                last_size = size
+            except Exception as e:
+                print(f"live: render skipped ({e})", file=sys.stderr)
+        elif time.monotonic() - last_growth > idle_exit_s:
+            break
+        time.sleep(interval_s)
+    return renders
+
+
 def _load_telemetry_capture(path: str) -> Optional[dict]:
     """The telemetry dict if ``path`` is a telemetry JSON capture (bare
     ``to_dict()`` output, or any JSON object carrying one under a
@@ -186,6 +242,20 @@ def main() -> None:
         "capture (tpu/telemetry.py to_dict / bench.py --telemetry)",
     )
     parser.add_argument("-o", "--output", default=None)
+    parser.add_argument(
+        "--live",
+        action="store_true",
+        help="tail the scrape CSV of a still-running serve loop, "
+        "re-rendering as it grows (instead of one post-hoc render)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=1.0,
+        help="--live poll interval (seconds)",
+    )
+    parser.add_argument(
+        "--max-seconds", type=float, default=30.0,
+        help="--live wall-clock bound",
+    )
     args = parser.parse_args()
 
     path = args.path
@@ -194,6 +264,16 @@ def main() -> None:
     output = args.output or os.path.join(
         os.path.dirname(os.path.abspath(path)), "dashboard.png"
     )
+    if args.live:
+        renders = tail_live(
+            path, output, interval_s=args.interval,
+            max_seconds=args.max_seconds,
+        )
+        if renders == 0:
+            print("no plottable metrics in capture", file=sys.stderr)
+            sys.exit(1)
+        print(output)
+        return
     telemetry = _load_telemetry_capture(path)
     if telemetry is not None:
         result = render_telemetry_dashboard(telemetry, output)

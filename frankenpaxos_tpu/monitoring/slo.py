@@ -1,0 +1,212 @@
+"""SLO engine for the live serve loop: rolling p99-vs-target and
+shed-rate alarms computed from the streaming drains, plus the admission
+clamp recommendation the serve loop's control plane applies through
+``workload.set_rate`` (the autoscaler seed ROADMAP's serving-shape item
+asks for).
+
+Everything here is pure host-side arithmetic over the histogram DELTAS
+the :class:`frankenpaxos_tpu.tpu.telemetry.DrainCursor` drains hand
+over — the engine never touches the device. Per drain:
+
+  * the commit-latency and queue-wait histograms' deltas are pushed
+    into a rolling window of the last ``window_chunks`` drains;
+  * the windowed p99 (nearest-rank over the summed window histogram)
+    compares against ``p99_target_ticks`` — an alarm fires only when
+    the p99 is STRICTLY above target (exactly-at-target is within SLO),
+    and an empty window histogram (no samples) never alarms;
+  * the windowed shed fraction (shed / offered over the window)
+    compares against ``shed_rate_target`` the same way;
+  * alarms latch: once fired, an alarm clears only after
+    ``clear_after`` consecutive in-SLO drains (hysteresis, so a p99
+    oscillating at the boundary doesn't flap the admission clamp);
+  * while an alarm is latched, the recommended admission scale decays
+    multiplicatively by ``clamp_factor`` per alarmed drain (floored at
+    ``min_scale``); after it clears, the scale recovers by
+    ``recover_factor`` per clean drain back up to 1.0 (the plan rate).
+
+The serve loop multiplies the workload plan's offered rate by
+``scale`` between chunks — a traced-state update, never a recompile.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Deque, Optional
+
+import numpy as np
+
+
+def hist_p99(hist, q: float = 0.99) -> int:
+    """Nearest-rank percentile of an integer histogram (bin index =
+    value in ticks); -1 on an empty histogram."""
+    h = np.asarray(hist, np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return -1
+    rank = max(1, int(np.ceil(q * total)))
+    return int((h.cumsum() >= rank).argmax())
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """The SLO + clamp configuration (JSON-roundtrippable via
+    ``to_dict``/``from_dict`` so serve configs serialize)."""
+
+    p99_target_ticks: int  # windowed p99 must stay <= this
+    # Which latency histogram the p99 tracks: the queue-wait histogram
+    # (arrival -> admission, the load signal), the commit-latency
+    # histogram (admission -> chosen, the protocol signal), or their
+    # conservative sum of p99s ("client").
+    source: str = "queue_wait"
+    shed_rate_target: float = 1.0  # windowed shed fraction above = alarm
+    window_chunks: int = 4  # rolling window length (drains)
+    clear_after: int = 2  # consecutive in-SLO drains to clear a latch
+    clamp_factor: float = 0.5  # scale *= this per alarmed drain
+    recover_factor: float = 1.25  # scale *= this per clean drain
+    min_scale: float = 0.05  # clamp floor
+
+    def __post_init__(self):
+        assert self.p99_target_ticks >= 0
+        assert self.source in ("queue_wait", "commit_latency", "client")
+        assert 0.0 < self.shed_rate_target <= 1.0
+        assert self.window_chunks >= 1
+        assert self.clear_after >= 1
+        assert 0.0 < self.clamp_factor < 1.0
+        assert self.recover_factor > 1.0
+        assert 0.0 < self.min_scale <= 1.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SloPolicy":
+        return cls(**d)
+
+
+class SloEngine:
+    """Feed one :meth:`observe` per drain; read ``alarm``/``scale``."""
+
+    def __init__(self, policy: SloPolicy):
+        self.policy = policy
+        self.alarm = False  # latched alarm state
+        self.scale = 1.0  # recommended admission scale (0, 1]
+        self.alarms_fired = 0  # latch transitions off -> on
+        self.clamps_applied = 0  # alarmed drains (scale decays each)
+        self._clean_streak = 0
+        self._lat: Deque[np.ndarray] = collections.deque(
+            maxlen=policy.window_chunks
+        )
+        self._wait: Deque[np.ndarray] = collections.deque(
+            maxlen=policy.window_chunks
+        )
+        self._flow: Deque[tuple] = collections.deque(
+            maxlen=policy.window_chunks
+        )  # (offered, shed) deltas
+        self.history: list = []  # one status dict per observe()
+
+    # -- windowed signals ---------------------------------------------------
+
+    def _window_sum(self, dq: Deque[np.ndarray]) -> Optional[np.ndarray]:
+        if not dq:
+            return None
+        out = np.zeros_like(dq[0])
+        for h in dq:
+            out = out + h
+        return out
+
+    def windowed_p99(self) -> int:
+        """The policy-source p99 over the rolling window (-1 when the
+        window holds no samples)."""
+        lat = self._window_sum(self._lat)
+        wait = self._window_sum(self._wait)
+        if self.policy.source == "commit_latency":
+            return hist_p99(lat) if lat is not None else -1
+        if self.policy.source == "queue_wait":
+            return hist_p99(wait) if wait is not None else -1
+        # "client": conservative sum of the two stage p99s.
+        p_l = hist_p99(lat) if lat is not None else -1
+        p_w = hist_p99(wait) if wait is not None else -1
+        if p_l < 0 and p_w < 0:
+            return -1
+        return max(p_l, 0) + max(p_w, 0)
+
+    def windowed_shed_rate(self) -> float:
+        offered = sum(f[0] for f in self._flow)
+        shed = sum(f[1] for f in self._flow)
+        if offered + shed <= 0:
+            return 0.0
+        return shed / float(offered + shed)
+
+    # -- the per-drain step -------------------------------------------------
+
+    def observe(
+        self,
+        *,
+        lat_hist_delta=None,
+        wait_hist_delta=None,
+        offered_delta: int = 0,
+        shed_delta: int = 0,
+    ) -> dict:
+        """One drain's deltas in; the updated alarm/scale status out.
+        A missing histogram (None) contributes nothing to the window;
+        an all-zero delta window (no samples yet) never alarms."""
+        if lat_hist_delta is not None:
+            self._lat.append(np.asarray(lat_hist_delta, np.int64))
+        if wait_hist_delta is not None:
+            self._wait.append(np.asarray(wait_hist_delta, np.int64))
+        self._flow.append((int(offered_delta), int(shed_delta)))
+
+        p99 = self.windowed_p99()
+        shed_rate = self.windowed_shed_rate()
+        # Strictly-above-target fires; exactly-at-target and an empty
+        # window (p99 == -1) are in SLO.
+        p99_breach = p99 > self.policy.p99_target_ticks
+        shed_breach = shed_rate > self.policy.shed_rate_target
+        breach = p99_breach or shed_breach
+
+        fired = cleared = False
+        if breach:
+            self._clean_streak = 0
+            if not self.alarm:
+                self.alarm = True
+                fired = True
+                self.alarms_fired += 1
+            # Decay the admission scale while the alarm is latched.
+            self.scale = max(
+                self.policy.min_scale,
+                self.scale * self.policy.clamp_factor,
+            )
+            self.clamps_applied += 1
+        else:
+            self._clean_streak += 1
+            if self.alarm and self._clean_streak >= self.policy.clear_after:
+                self.alarm = False
+                cleared = True
+            if not self.alarm and self.scale < 1.0:
+                self.scale = min(
+                    1.0, self.scale * self.policy.recover_factor
+                )
+        status = {
+            "p99": p99,
+            "p99_target": self.policy.p99_target_ticks,
+            "p99_breach": p99_breach,
+            "shed_rate": round(shed_rate, 6),
+            "shed_breach": shed_breach,
+            "alarm": self.alarm,
+            "fired": fired,
+            "cleared": cleared,
+            "scale": round(self.scale, 6),
+        }
+        self.history.append(status)
+        return status
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.policy.to_dict(),
+            "alarm": self.alarm,
+            "scale": round(self.scale, 6),
+            "alarms_fired": self.alarms_fired,
+            "clamps_applied": self.clamps_applied,
+            "observations": len(self.history),
+        }

@@ -1,0 +1,213 @@
+"""Perfetto-loadable Chrome trace export: device lifecycle spans and
+host dispatch spans in ONE timeline.
+
+The device half comes from the in-graph span sampler
+(``tpu/telemetry.py`` ``record_spans`` / ``completed_spans``): each
+completed span carries per-stage tick stamps (proposed /
+phase1-promised / phase2-voted / committed / executed). The host half
+comes from ``TpuSimTransport.trace()`` wall-clock spans (dispatch /
+wait / transfer) — the same records the serve loop wraps in
+``jax.profiler`` annotations so a concurrent profiler capture sees
+them too.
+
+Ticks are a device-side clock; wall time is the host's. The
+:class:`TickClock` maps between them from (tick, unix-time) marks the
+serve loop records at every chunk boundary (linear interpolation
+inside a chunk, extrapolation from the nearest segment outside), so
+both halves land on one microsecond timeline that Perfetto or
+``chrome://tracing`` loads directly:
+
+    python -m frankenpaxos_tpu.monitoring.dashboard ... (metrics)
+    # trace: open ui.perfetto.dev -> "Open trace file" -> serve_trace.json
+
+Format: the Chrome Trace Event JSON object form
+(``{"traceEvents": [...], "displayTimeUnit": "ms"}``) — "X" complete
+events for spans, "M" metadata events for process/thread names.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEVICE_PID = 1
+HOST_PID = 2
+
+
+class TickClock:
+    """tick -> microsecond mapping from (tick, unix_seconds) marks."""
+
+    def __init__(self, marks: Optional[Sequence[Tuple[int, float]]] = None):
+        self.marks: List[Tuple[int, float]] = list(marks or [])
+
+    def add_mark(self, tick: int, unix_s: float) -> None:
+        self.marks.append((int(tick), float(unix_s)))
+
+    def to_us(self, tick) -> float:
+        """Interpolated wall-clock microseconds for a device tick.
+        With fewer than two marks, ticks map 1 tick == 1 us from the
+        single mark (or from zero) — still a valid relative timeline."""
+        import numpy as np
+
+        marks = sorted(set(self.marks))
+        if len(marks) < 2:
+            base_t, base_s = marks[0] if marks else (0, 0.0)
+            return (float(tick) - base_t) + base_s * 1e6
+        xs = np.asarray([m[0] for m in marks], np.float64)
+        ys = np.asarray([m[1] for m in marks], np.float64) * 1e6
+        t = float(tick)
+        if t <= xs[0]:  # extrapolate from the first segment
+            slope = (ys[1] - ys[0]) / max(xs[1] - xs[0], 1.0)
+            return float(ys[0] + (t - xs[0]) * slope)
+        if t >= xs[-1]:  # extrapolate from the last segment
+            slope = (ys[-1] - ys[-2]) / max(xs[-1] - xs[-2], 1.0)
+            return float(ys[-1] + (t - xs[-1]) * slope)
+        return float(np.interp(t, xs, ys))
+
+
+def metadata_events(
+    device_name: str = "device (ticks)",
+    host_name: str = "host (transport)",
+) -> List[dict]:
+    return [
+        {
+            "ph": "M",
+            "pid": DEVICE_PID,
+            "name": "process_name",
+            "args": {"name": device_name},
+        },
+        {
+            "ph": "M",
+            "pid": HOST_PID,
+            "name": "process_name",
+            "args": {"name": host_name},
+        },
+    ]
+
+
+def device_span_events(
+    spans: Sequence[Dict],
+    clock: Optional[TickClock] = None,
+) -> List[dict]:
+    """Chrome events for completed device spans (the dict rows
+    ``telemetry.completed_spans`` / ``DrainCursor.drain()["spans"]``
+    return). Each span becomes one whole-lifecycle "X" slice on the
+    track of its group (tid = group) plus nested stage slices for the
+    stamped stages; unstamped stages (-1) are skipped."""
+    clock = clock or TickClock()
+    events: List[dict] = []
+    for s in spans:
+        proposed = s.get("proposed", -1)
+        executed = s.get("executed", -1)
+        if proposed < 0 or executed < proposed:
+            continue  # incomplete row (ring overwrite mid-drain)
+        tid = int(s.get("group", 0))
+        ts = clock.to_us(proposed)
+        dur = max(clock.to_us(executed) - ts, 1.0)
+        args = {k: int(v) for k, v in s.items()}
+        events.append(
+            {
+                "name": f"slot g{s.get('group', 0)}/{s.get('slot_id', 0)}",
+                "cat": "lifecycle",
+                "ph": "X",
+                "pid": DEVICE_PID,
+                "tid": tid,
+                "ts": ts,
+                "dur": dur,
+                "args": args,
+            }
+        )
+        # Nested stage slices: [proposed -> voted -> committed ->
+        # executed], with the optional phase-1 repair as its own slice.
+        stages = []
+        voted = s.get("phase2_voted", -1)
+        committed = s.get("committed", -1)
+        if voted >= 0:
+            stages.append(("phase2_vote", proposed, voted))
+        if committed >= 0:
+            stages.append(
+                ("commit", voted if voted >= 0 else proposed, committed)
+            )
+            stages.append(("execute", committed, executed))
+        p1 = s.get("phase1_promised", -1)
+        if p1 >= 0:
+            stages.append(("phase1_repair", p1, min(p1 + 1, executed)))
+        for name, t0, t1 in stages:
+            if t1 < t0:
+                continue
+            u0 = clock.to_us(t0)
+            events.append(
+                {
+                    "name": name,
+                    "cat": "stage",
+                    "ph": "X",
+                    "pid": DEVICE_PID,
+                    "tid": tid,
+                    "ts": u0,
+                    "dur": max(clock.to_us(t1) - u0, 1.0),
+                }
+            )
+    return events
+
+
+def host_span_events(trace_spans: Sequence[Dict]) -> List[dict]:
+    """Chrome events for host-side wall-clock spans (the dict records
+    ``TpuSimTransport.trace()`` returns: name/start_unix/duration_s +
+    metadata)."""
+    events: List[dict] = []
+    for s in trace_spans:
+        args = {
+            k: v
+            for k, v in s.items()
+            if k not in ("name", "start_unix", "duration_s")
+        }
+        events.append(
+            {
+                "name": str(s["name"]),
+                "cat": "host",
+                "ph": "X",
+                "pid": HOST_PID,
+                "tid": 0,
+                "ts": float(s["start_unix"]) * 1e6,
+                "dur": max(float(s["duration_s"]) * 1e6, 1.0),
+                "args": args,
+            }
+        )
+    return events
+
+
+def write_chrome_trace(
+    path: str,
+    device_spans: Sequence[Dict] = (),
+    host_spans: Sequence[Dict] = (),
+    clock: Optional[TickClock] = None,
+    extra_events: Sequence[Dict] = (),
+) -> str:
+    """Assemble + write one Perfetto-loadable trace file; returns the
+    path. Either half may be empty (a device-only or host-only
+    capture is still loadable)."""
+    events = (
+        metadata_events()
+        + device_span_events(device_spans, clock)
+        + host_span_events(host_spans)
+        + list(extra_events)
+    )
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def load_chrome_trace(path: str) -> dict:
+    """Load + structurally validate a trace file written by
+    :func:`write_chrome_trace` (used by the serve smoke + tests):
+    asserts the object form, that every event carries the required
+    keys, and that "X" events have nonnegative durations."""
+    with open(path) as f:
+        payload = json.load(f)
+    assert isinstance(payload, dict) and "traceEvents" in payload
+    for ev in payload["traceEvents"]:
+        assert "ph" in ev and "pid" in ev and "name" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and "ts" in ev and "tid" in ev
+    return payload

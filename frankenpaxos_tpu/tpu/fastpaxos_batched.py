@@ -78,7 +78,12 @@ class BatchedFastPaxosConfig:
     # proposal planes (UDP semantics — the recovery timeout rescues
     # stuck instances through the classic round); the classic dn/up
     # exchange is TCP (delay-only + defer-to-heal), so recovery itself
-    # cannot deadlock. FaultPlan.none() is a structural no-op.
+    # cannot deadlock. crash/revive drives the per-group round-0
+    # proposer pair (which is also the vote-counting client role):
+    # dead proposers issue nothing and observe nothing; replies
+    # persist, so a revival resumes the gated transitions and the
+    # recovery timeout rescues instances that starved while dead.
+    # FaultPlan.none() is a structural no-op.
     faults: FaultPlan = FaultPlan.none()
     # In-graph workload engine (tpu/workload.py): shapes per-group
     # instance admission; a completion is a learned decision.
@@ -145,6 +150,14 @@ class BatchedFastPaxosState:
     # votes (set once, device-side).
     fp_committed_value: jnp.ndarray  # [G, W]
 
+    # Round-0 proposer liveness (the crash/revive axis of PR 3
+    # follow-up (b), matching the fastmultipaxos treatment): the
+    # per-group proposer pair + its counter role. Dead proposers issue
+    # nothing and observe nothing; arrived replies persist, so a
+    # revival resumes every gated transition and the recovery timeout
+    # rescues instances that starved while dead.
+    prop_alive: jnp.ndarray  # [G]
+
     # Stats.
     chosen_total: jnp.ndarray  # []
     chosen_fast_total: jnp.ndarray  # []
@@ -178,6 +191,7 @@ def init_state(cfg: BatchedFastPaxosConfig) -> BatchedFastPaxosState:
         dn_phase=jnp.zeros((A, G, W), DTYPE_STATUS),
         up_arrival=jnp.full((A, G, W), INF, jnp.int32),
         fp_committed_value=jnp.full((G, W), NO_VALUE, jnp.int32),
+        prop_alive=jnp.ones((G,), bool),
         chosen_total=jnp.zeros((), jnp.int32),
         chosen_fast_total=jnp.zeros((), jnp.int32),
         conflicts_total=jnp.zeros((), jnp.int32),
@@ -249,6 +263,20 @@ def tick(
             cut = ~link_up
             dn_arr = faults_mod.defer_to_heal(fp, dn_arr, cut)
             up_arr = faults_mod.defer_to_heal(fp, up_arr, cut)
+
+    # Proposer crash/revive (PR 3 follow-up (b), the fastmultipaxos
+    # treatment): the per-group round-0 proposer pair (which is also
+    # the vote-counting client role) is the crash axis. Guarded on
+    # has_crash so a none/crash-free plan traces the exact pre-crash
+    # program.
+    prop_alive = state.prop_alive
+    revived = None
+    if fp.has_crash:
+        new_alive = faults_mod.crash_step(
+            fp, faults_mod.fault_key(key, 9), prop_alive, rates=frates
+        )
+        revived = new_alive & ~prop_alive
+        prop_alive = new_alive
 
     status = state.status
     v0, v1 = _values_of(state.inst_id)
@@ -324,12 +352,24 @@ def tick(
         | (t - state.issue_tick >= cfg.recovery_timeout)
     )
 
+    # A dead proposer/counter observes nothing: no fast choice, no
+    # recovery kickoff, no phase completions. Replies persist in
+    # up_arrival, so revival resumes every gated transition on the
+    # spot, and the recovery timeout (issue_tick is untouched by the
+    # crash) rescues instances that starved while the group was dead.
+    if fp.has_crash:
+        alive_gw = prop_alive[:, None]
+        fast_ok = fast_ok & alive_gw
+        stuck = stuck & alive_gw
+
     # (c) Phase-1 completion (FpLeader.handlePhase1b): a classic quorum
     # of replies; k = max vote round among them; k == 1 -> that value;
     # k == 0 -> the O4 rule (a popular value — MAJ votes — must be
     # picked; argmax count is safe because a fast-committed value
     # dominates every other); no votes -> proposer 0's value.
     rec1_done = (status == I_REC1) & (n_arrived >= CQ)
+    if fp.has_crash:
+        rec1_done = rec1_done & prop_alive[:, None]
     any_r1 = jnp.any(arrived & (vote_round == 1), axis=0)
     # All round-1 votes in an instance carry rec_value, so "the value of
     # the max-round vote" is rec_value itself when any round-1 vote is
@@ -358,6 +398,8 @@ def tick(
         axis=0,
     )
     rec2_done = (status == I_REC2) & (a_r1 >= CQ)
+    if fp.has_crash:
+        rec2_done = rec2_done & prop_alive[:, None]
 
     # ---- 5. Transitions.
     newly_chosen = fast_ok | rec2_done
@@ -433,6 +475,10 @@ def tick(
         issue = empty & (rank <= adm[:, None])
     else:
         issue = empty & (rank <= cfg.instances_per_tick)
+    if fp.has_crash:
+        # Dead proposers issue nothing (the workload FIFO keeps the
+        # unadmitted arrivals queued — finish() sees zero admissions).
+        issue = issue & prop_alive[:, None]
     count = jnp.sum(issue, axis=1)
     if wl.active:
         wls = workload_mod.finish(
@@ -475,6 +521,9 @@ def tick(
         commits=chosen_total - state.chosen_total,
         executes=jnp.sum(retire),
         retries=recoveries - state.recoveries,
+        # A revival is the recovery handoff of the crash axis — counted
+        # like the other backends' recovery elections.
+        leader_changes=jnp.sum(revived) if revived is not None else 0,
         queue_depth=jnp.sum(status != I_EMPTY),
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
@@ -499,6 +548,7 @@ def tick(
         dn_phase=dn_phase,
         up_arrival=up_arrival,
         fp_committed_value=fp_committed_value,
+        prop_alive=prop_alive,
         chosen_total=chosen_total,
         chosen_fast_total=chosen_fast_total,
         conflicts_total=conflicts_total,

@@ -73,6 +73,7 @@ from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 
@@ -1419,6 +1420,41 @@ def tick(
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+
+    # ---- 7.5 Span sampler (telemetry.record_spans): lifecycle
+    # tick-stamps of a sampled reservoir of in-flight slots, recorded
+    # from the masks the planes already emitted (is_new / Phase2b
+    # offset clocks / newly_chosen / retire_mask — no extra protocol
+    # work). Structurally OFF unless the serve loop sized the reservoir
+    # (span_slots == 0 default: a trace-time no-op, like window=0).
+    if telemetry_mod.span_slots(tel):
+        p1_mark = jnp.zeros((G,), bool)
+        if crash_on or cfg.device_elections:
+            p1_mark = p1_mark | elect
+        if cfg.reconfigure_every:
+            p1_mark = p1_mark | p1_done
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=is_new,
+            # Per-group slot number at each ring position (OLD head +
+            # ordinal — valid for every cell occupied at tick start,
+            # including the ones retiring this tick).
+            slot_ids=state.head[:, None] + ord_of_pos,
+            # Cells proposed THIS tick carry a slot one window past the
+            # old-head formula when they were retired + re-proposed in
+            # one tick: their numbering is OLD next_slot + ordinal.
+            new_slot_ids=state.next_slot[:, None]
+            + jnp.mod(
+                w_iota[None, :] - state.next_slot[:, None], W
+            ),
+            phase1_mark=p1_mark,
+            # A Phase2b vote is visible at the counter: the same
+            # offset-clock predicate check_invariants uses.
+            voted=jnp.any(p2b_arrival <= 0, axis=0),
+            newly_chosen=newly_chosen,
+            retire_mask=retire_mask,
+        )
 
     return BatchedMultiPaxosState(
         leader_round=leader_round,

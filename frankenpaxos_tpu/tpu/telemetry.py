@@ -73,6 +73,28 @@ COL = {name: i for i, name in enumerate(COUNTER_FIELDS)}
 TELEM_WINDOW = 128  # default ring size K (ticks)
 QUEUE_BINS = 32  # queue-depth histogram bins (occupancy fractions)
 
+# -- Span sampler (the serve loop's device-side lifecycle tracer) -----------
+# A reservoir of S sampled in-flight slots whose lifecycle tick-stamps
+# are recorded INSIDE the tick (reusing the masks the tick already
+# computes); completed spans roll into a completion ring the host
+# drains with a cursor, exactly like the counter ring. ``spans=0``
+# (the default) zero-sizes every leaf — a structural no-op, like
+# ``window=0`` for the counters.
+SPAN_STAGES = (
+    "proposed",
+    "phase1_promised",
+    "phase2_voted",
+    "committed",
+    "executed",
+)
+NUM_STAGES = len(SPAN_STAGES)
+# Completion-ring columns: identity (group, per-group slot id) + the
+# five stage stamps.
+SPAN_COLS = ("group", "slot_id") + SPAN_STAGES
+NUM_SPAN_COLS = len(SPAN_COLS)
+SPAN_RING_FACTOR = 8  # completion-ring rows per reservoir slot
+NO_STAMP = -1  # unstamped stage marker
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -91,25 +113,51 @@ class Telemetry:
     totals: jnp.ndarray  # [NUM_COLS] cumulative sums of every column
     lat_hist: jnp.ndarray  # [LAT_BINS] commit-latency histogram (ticks)
     queue_hist: jnp.ndarray  # [QUEUE_BINS] occupancy-fraction histogram
+    # Span sampler (all zero-sized when spans == 0): the live reservoir
+    # tracks (group, ring position, per-group slot id, stage stamps);
+    # completed spans roll into span_ring (slot = spans_done % SR).
+    span_group: jnp.ndarray  # [S] tracked group (-1 = slot free)
+    span_pos: jnp.ndarray  # [S] ring position of the tracked slot
+    span_id: jnp.ndarray  # [S] per-group slot sequence number
+    span_t: jnp.ndarray  # [S, NUM_STAGES] stage tick stamps (NO_STAMP)
+    span_ring: jnp.ndarray  # [SR, NUM_SPAN_COLS] completed-span ring
+    spans_done: jnp.ndarray  # [] completed spans (cumulative)
 
 
-def make_telemetry(window: int = TELEM_WINDOW) -> Telemetry:
+def make_telemetry(
+    window: int = TELEM_WINDOW, spans: int = 0
+) -> Telemetry:
     """A zeroed telemetry ring of ``window`` ticks; ``window=0`` turns
     the subsystem off structurally (record() becomes a trace-time
-    no-op and XLA removes the feeding computations)."""
-    assert window >= 0
+    no-op and XLA removes the feeding computations). ``spans`` is the
+    span-sampler reservoir size (``spans=0`` — the default — disables
+    the sampler structurally the same way)."""
+    assert window >= 0 and spans >= 0
+    SR = spans * SPAN_RING_FACTOR
     return Telemetry(
         ticks=jnp.zeros((), jnp.int32),
         counters=jnp.zeros((window, NUM_COLS), jnp.int32),
         totals=jnp.zeros((NUM_COLS,), jnp.int32),
         lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         queue_hist=jnp.zeros((QUEUE_BINS,), jnp.int32),
+        span_group=jnp.full((spans,), -1, jnp.int32),
+        span_pos=jnp.zeros((spans,), jnp.int32),
+        span_id=jnp.full((spans,), -1, jnp.int32),
+        span_t=jnp.full((spans, NUM_STAGES), NO_STAMP, jnp.int32),
+        span_ring=jnp.full((SR, NUM_SPAN_COLS), NO_STAMP, jnp.int32),
+        spans_done=jnp.zeros((), jnp.int32),
     )
 
 
 def window(tel: Telemetry) -> int:
     """The ring size K — a static shape, readable at trace time."""
     return tel.counters.shape[0]
+
+
+def span_slots(tel: Telemetry) -> int:
+    """The span-sampler reservoir size S — a static shape. 0 = the
+    sampler is off structurally (record_spans no-ops at trace time)."""
+    return tel.span_group.shape[0]
 
 
 def record(
@@ -171,12 +219,136 @@ def record(
             QUEUE_BINS - 1,
         )
         queue_hist = queue_hist.at[qbin].add(1)
-    return Telemetry(
+    return dataclasses.replace(
+        tel,
         ticks=ticks,
         counters=counters,
         totals=tel.totals + row,
         lat_hist=lat_hist,
         queue_hist=queue_hist,
+    )
+
+
+def record_spans(
+    tel: Telemetry,
+    *,
+    t,
+    is_new: jnp.ndarray,
+    slot_ids: jnp.ndarray,
+    new_slot_ids: Optional[jnp.ndarray] = None,
+    phase1_mark: jnp.ndarray,
+    voted: jnp.ndarray,
+    newly_chosen: jnp.ndarray,
+    retire_mask: jnp.ndarray,
+) -> Telemetry:
+    """One tick of the in-graph span sampler. All mask args are the
+    ``[G, W]`` masks the tick already computed for its own bookkeeping
+    (``is_new`` = newly proposed, ``voted`` = a Phase2b vote is visible
+    at the counter, ``newly_chosen`` / ``retire_mask`` = the dispatch
+    plane's outputs); ``slot_ids`` is the per-group slot number at each
+    ring position (OLD head + ordinal — valid for every cell that was
+    occupied at tick START, including cells retiring this tick);
+    ``new_slot_ids`` is the slot number a cell proposed THIS tick
+    carries (OLD next_slot + ordinal — a cell can retire and be
+    re-proposed in one tick, in which case its new slot is one full
+    window past the old-head formula; defaults to ``slot_ids`` for
+    backends where the two never diverge). ``phase1_mark`` is the
+    ``[G]`` mask of groups the phase-1 plane touched this tick
+    (election or reconfiguration repair).
+
+    Per tick: at most ONE new span is adopted (the first ``is_new``
+    cell into the first free reservoir slot — a cheap deterministic
+    reservoir; serve-loop chunks are long enough that the reservoir
+    samples continuously), live spans gather their cell's masks and
+    stamp each stage's FIRST occurrence, and spans whose slot retires
+    roll into the completion ring (slot = spans_done % SR) and free
+    their reservoir entry. With ``spans == 0`` this is a trace-time
+    no-op (the structural-disable contract of the counter ring)."""
+    S = span_slots(tel)
+    if S == 0:
+        return tel
+    G, W = is_new.shape
+    SR = tel.span_ring.shape[0]
+    t32 = jnp.asarray(t, jnp.int32)
+    s_iota = jnp.arange(S, dtype=jnp.int32)
+
+    # -- adopt: first free reservoir slot takes one new proposal. The
+    # group scan start rotates per tick so the reservoir samples across
+    # the whole group axis, not just group 0's hot cell. Cost: ONE
+    # [G, W] any-reduction plus [G]/[W]-sized bookkeeping per tick —
+    # never a [G*W]-wide argmax (which would be visible tick work at
+    # flagship shapes).
+    any_new = jnp.any(is_new, axis=1)  # [G]
+    g_off = jnp.mod(t32, G)
+    g_new = jnp.mod(
+        jnp.argmax(jnp.roll(any_new, -g_off)).astype(jnp.int32) + g_off,
+        G,
+    )  # a group with a new proposal (0 if none — gated below)
+    w_new = jnp.argmax(is_new[g_new]).astype(jnp.int32)
+    free = tel.span_group < 0
+    adopt = jnp.any(any_new) & jnp.any(free)
+    adopt_s = (s_iota == jnp.argmax(free)) & adopt  # [S] one-hot
+    id_new = (
+        new_slot_ids if new_slot_ids is not None else slot_ids
+    )[g_new, w_new]
+
+    # -- stamp live spans (pre-adopt occupancy: a span adopted this
+    # tick gets only its "proposed" stamp below; latencies are >= 1
+    # tick so no later stage can fire the same tick it was proposed).
+    occ = tel.span_group >= 0
+    gg = jnp.clip(tel.span_group, 0, G - 1)
+    ww = jnp.clip(tel.span_pos, 0, W - 1)
+
+    def gat(arr2d):
+        return arr2d[gg, ww]
+
+    match = occ & (gat(slot_ids) == tel.span_id)
+    stamps = jnp.stack(
+        [
+            jnp.zeros((S,), bool),  # proposed: stamped at adoption
+            match & phase1_mark[gg],
+            match & gat(voted),
+            match & gat(newly_chosen),
+            match & gat(retire_mask),
+        ],
+        axis=1,
+    )  # [S, NUM_STAGES]
+    span_t = jnp.where(
+        stamps & (tel.span_t == NO_STAMP), t32, tel.span_t
+    )
+    span_t = jnp.where(
+        adopt_s[:, None] & (jnp.arange(NUM_STAGES) == 0)[None, :],
+        t32,
+        span_t,
+    )
+    span_group = jnp.where(adopt_s, g_new, tel.span_group)
+    span_pos = jnp.where(adopt_s, w_new, tel.span_pos)
+    span_id = jnp.where(adopt_s, id_new, tel.span_id)
+
+    # -- complete: spans whose slot retired this tick roll into the
+    # completion ring and free their reservoir entry. mode="drop"
+    # parks non-completing rows at the out-of-range index SR.
+    done = match & gat(retire_mask)
+    rank = jnp.cumsum(done.astype(jnp.int32)) - 1  # [S]
+    ring_slot = jnp.where(
+        done, (tel.spans_done + rank) % SR, SR
+    )
+    rows = jnp.concatenate(
+        [span_group[:, None], span_id[:, None], span_t], axis=1
+    )  # [S, NUM_SPAN_COLS]
+    span_ring = tel.span_ring.at[ring_slot].set(rows, mode="drop")
+    spans_done = tel.spans_done + jnp.sum(done)
+    span_group = jnp.where(done, -1, span_group)
+    span_id = jnp.where(done, -1, span_id)
+    span_t = jnp.where(done[:, None], NO_STAMP, span_t)
+    return dataclasses.replace(
+        tel,
+        span_group=span_group,
+        span_pos=span_pos,
+        span_id=span_id,
+        span_t=span_t,
+        span_ring=span_ring,
+        spans_done=spans_done,
     )
 
 
@@ -214,6 +386,94 @@ def series(tel: Telemetry) -> Dict[str, "jnp.ndarray"]:
     for name, col in COL.items():
         out[name] = rows[:, col]
     return out
+
+
+def completed_spans(tel: Telemetry, cursor: int = 0):
+    """Completed spans with sequence number >= ``cursor``, as a list of
+    dicts (``{"group", "slot_id", "seq", <stage>: tick | -1}``), plus
+    the count of spans that aged out of the completion ring before this
+    drain (lost) and the new cursor. Works on a fetched or
+    device-resident Telemetry."""
+    import numpy as np
+
+    tel = jax.device_get(tel)
+    SR = tel.span_ring.shape[0]
+    total = int(tel.spans_done)
+    n = total - int(cursor)
+    if n <= 0 or SR == 0:
+        return [], max(0, n if SR == 0 else 0), total
+    dropped = max(0, n - SR)
+    keep = n - dropped
+    order = (total - keep + np.arange(keep)) % SR
+    rows = np.asarray(tel.span_ring)[order]
+    out = []
+    for i, row in enumerate(rows):
+        d = {"seq": total - keep + i}
+        for col, name in enumerate(SPAN_COLS):
+            d[name] = int(row[col])
+        out.append(d)
+    return out, dropped, total
+
+
+class DrainCursor:
+    """Host-side cursor for EXACT partial drains of a telemetry ring:
+    each :meth:`drain` call returns precisely the per-tick rows (and
+    completed spans) recorded since the previous call — no sample lost
+    or double-counted as long as drains happen at least once per ring
+    period (``window`` ticks for counters, ``spans * SPAN_RING_FACTOR``
+    completions for spans; slower drains report the overrun in
+    ``dropped_*`` instead of silently double-counting).
+
+    The serve loop (``harness/serve.py``) drains the PREVIOUS chunk's
+    telemetry snapshot through one of these while the next chunk
+    computes — the cursor is what makes chunked drains sum to exactly
+    the one-shot capture (pinned bit-identical by
+    ``tests/test_serve.py``)."""
+
+    def __init__(self, tick: int = 0, span: int = 0):
+        self.tick = int(tick)
+        self.span = int(span)
+
+    def drain(self, tel: Telemetry) -> dict:
+        """Drain everything recorded since the last call. ``tel`` may
+        be device-resident (one coalesced pull happens here) or already
+        fetched (e.g. a serve-loop snapshot). Returns per-tick series
+        for the new ticks, the new completed spans, the cumulative
+        totals at this drain point, and drop counts for ring overruns."""
+        import numpy as np
+
+        tel = jax.device_get(tel)
+        K = tel.counters.shape[0]
+        total = int(tel.ticks)
+        n = total - self.tick
+        dropped = max(0, n - K) if K else max(0, n)
+        keep = max(0, n - dropped) if K else 0
+        out: Dict[str, object] = {
+            "ticks_total": total,
+            "tick_from": total - keep,
+            "dropped_ticks": dropped,
+            "totals": {
+                name: _unsigned_total(tel.totals[i])
+                for i, name in enumerate(COUNTER_FIELDS)
+            },
+            "lat_hist": np.asarray(tel.lat_hist).copy(),
+            "queue_hist": np.asarray(tel.queue_hist).copy(),
+        }
+        if keep:
+            order = (total - keep + np.arange(keep)) % K
+            rows = np.asarray(tel.counters)[order]
+            out["tick"] = np.arange(total - keep, total, dtype=np.int64)
+            for name, col in COL.items():
+                out[name] = rows[:, col]
+        else:
+            out["tick"] = np.zeros((0,), np.int64)
+            for name in COUNTER_FIELDS:
+                out[name] = np.zeros((0,), np.int32)
+        self.tick = total
+        spans, span_dropped, self.span = completed_spans(tel, self.span)
+        out["spans"] = spans
+        out["dropped_spans"] = span_dropped
+        return out
 
 
 def _unsigned_total(value) -> int:

@@ -50,6 +50,7 @@ class TpuSimTransport:
         seed: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
         telemetry_window: Optional[int] = None,
+        telemetry_spans: int = 0,
     ):
         self.config = config
         self.key = jax.random.PRNGKey(seed)
@@ -62,10 +63,17 @@ class TpuSimTransport:
         self.trace_spans: List[dict] = []
         self._dispatched_lengths: set = set()
         state = init_state(config)
-        if telemetry_window is not None:
+        if telemetry_window is not None or telemetry_spans:
+            window = (
+                telemetry_window
+                if telemetry_window is not None
+                else telemetry_mod.TELEM_WINDOW
+            )
             state = dataclasses.replace(
                 state,
-                telemetry=telemetry_mod.make_telemetry(telemetry_window),
+                telemetry=telemetry_mod.make_telemetry(
+                    window, spans=telemetry_spans
+                ),
             )
         if mesh is not None:
             from frankenpaxos_tpu.parallel import shard_state

@@ -550,15 +550,13 @@ def set_fault_rates(
 
 def hist_percentile(hist, q: float) -> int:
     """Nearest-rank percentile of an integer histogram (bin index =
-    value). -1 on an empty histogram."""
-    import numpy as np
+    value). -1 on an empty histogram. One algorithm repo-wide: this is
+    the device_get wrapper over the pure-numpy core the SLO engine
+    alarms on (``monitoring/slo.py`` — lazily imported; the monitoring
+    layer stays jax-free)."""
+    from frankenpaxos_tpu.monitoring.slo import hist_p99
 
-    h = np.asarray(jax.device_get(hist), np.int64)
-    total = int(h.sum())
-    if total == 0:
-        return -1
-    rank = max(1, int(np.ceil(q * total)))
-    return int((h.cumsum() >= rank).argmax())
+    return hist_p99(jax.device_get(hist), q)
 
 
 def summary(plan: WorkloadPlan, wls: WorkloadState) -> dict:

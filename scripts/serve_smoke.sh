@@ -1,0 +1,50 @@
+#!/bin/bash
+# Bounded serve smoke (CI): a ~10 s LIVE serve run of the flagship
+# backend through harness/serve.py on CPU, asserting
+#   1. clean shutdown (final drain + block_until_ready + report),
+#   2. a non-empty Perfetto-loadable trace export carrying BOTH device
+#      lifecycle spans and host dispatch spans,
+#   3. a non-empty scrape CSV (the live-dashboard feed), and
+#   4. static analysis exiting 0 with the trace-serve-nosync rule
+#      registered (the chunked dispatch path stays free of blocking
+#      transfers).
+#
+# Usage: scripts/serve_smoke.sh [out_dir]   (SERVE_SMOKE_SECONDS=10)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/fpx_serve_smoke}
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+JAX_PLATFORMS=cpu python -m frankenpaxos_tpu.harness.serve \
+  --seconds "${SERVE_SMOKE_SECONDS:-10}" --out-dir "$OUT" \
+  --groups 64 --chunk 32 --spans 16 --rate-x 1.1 --slo-p99 24 \
+  > "$OUT/report_line.json"
+
+JAX_PLATFORMS=cpu python - "$OUT" <<'EOF'
+import json, os, sys
+
+out = sys.argv[1]
+report = json.load(open(os.path.join(out, "serve_report.json")))
+assert report["clean_shutdown"], report
+assert report["ticks"] > 0, report
+assert report["dropped_ticks"] == 0, report
+
+from frankenpaxos_tpu.monitoring import traceviz
+
+tr = traceviz.load_chrome_trace(os.path.join(out, "serve_trace.json"))
+xs = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+assert any(e["pid"] == traceviz.DEVICE_PID for e in xs), "no device spans"
+assert any(e["pid"] == traceviz.HOST_PID for e in xs), "no host spans"
+assert os.path.getsize(os.path.join(out, "serve_metrics.csv")) > 0
+print(
+    "serve smoke OK:", report["ticks"], "ticks,",
+    report["spans_exported"], "device spans,",
+    len(xs), "trace events"
+)
+EOF
+
+# The full registry must exit 0 and know the serve rule.
+python -m frankenpaxos_tpu.analysis --list | grep -q trace-serve-nosync
+scripts/lint.sh --rule trace-serve-nosync
+echo "serve_smoke: PASS"

@@ -34,9 +34,26 @@ def init_state(cfg: ToyConfig) -> ToyState:
     )
 
 
+class ToyShaper:
+    def scale(self, x):
+        # A traced method — the method-following walk must NOT flag
+        # pure helpers reached through attribute calls.
+        return x * 2
+
+
+def _double(x):
+    return x + x
+
+
+# A switch table of traced helpers: dispatching through it is clean.
+_SHAPERS = {"double": _double}
+
+
 def tick(cfg: ToyConfig, state: ToyState, t, key):
     drop = faults_mod.message_faults(cfg.faults, key)  # noqa: F821
     cap = workload_mod.admission(cfg.workload, state, drop)  # noqa: F821
+    cap = ToyShaper().scale(cap)
+    cap = _SHAPERS["double"](cap)
     tel = record(state.telemetry, commits=state.counter)  # noqa: F821
     return dataclasses.replace(
         state, counter=state.counter + cap - drop, telemetry=tel

@@ -43,12 +43,31 @@ def _inline_sync(x):
     return jax.device_get(x)
 
 
+class ToyDriver:
+    def method_sync(self, x):
+        # host-sync-purity (through a METHOD call): only the
+        # method-resolving walk follows driver.method_sync(...).
+        return jax.block_until_ready(x)
+
+
+def _table_sync(x):
+    # host-sync-purity (through a SWITCH TABLE): dispatched via
+    # _HANDLERS[...] below — no direct call edge exists.
+    return x.item()
+
+
+_HANDLERS = {"sync": _table_sync}
+
+
 def tick(cfg: ToyConfig, state: ToyState, t, key):
     # telemetry-tick-records: no record() call.
     # fault-apply: never touches cfg.faults / faults_mod.
     snapshot = _inline_sync(state.counter)
     remote = helpers.pull(state.counter)
-    del snapshot, remote
+    driver = ToyDriver()
+    via_method = driver.method_sync(state.counter)
+    via_table = _HANDLERS["sync"](state.counter)
+    del snapshot, remote, via_method, via_table
     return dataclasses.replace(
         state, counter=state.counter + 1, ghost=state.ghost + 1
     )

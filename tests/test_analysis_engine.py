@@ -85,6 +85,11 @@ def test_dirty_fixture_expected_keys():
         ("host-sync-purity", "helpers.py:pull:block_until_ready"),
         ("host-sync-purity", "helpers.py:pull:asarray"),
         ("host-sync-purity", "toy_batched.py:run_ticks:asarray"),
+        (
+            "host-sync-purity",
+            "toy_batched.py:method_sync:block_until_ready",
+        ),
+        ("host-sync-purity", "toy_batched.py:_table_sync:item"),
         ("fault-config-field", "toy_batched.py:ToyConfig"),
         ("fault-validate", "toy_batched.py:ToyConfig"),
         ("fault-apply", "toy_batched.py"),
@@ -107,6 +112,18 @@ def test_transitive_host_sync_is_the_new_coverage():
     keys = {f.key for f in report.findings}
     assert "toy_batched.py:_inline_sync:device_get" in keys
     assert "helpers.py:pull:block_until_ready" in keys
+
+
+def test_method_and_switch_table_sync_coverage():
+    """The PR 5 (b) depth extension: syncs reached only through a
+    METHOD call (driver.method_sync) or a dict SWITCH TABLE
+    (_HANDLERS[...]) are found, and the clean tree's traced method +
+    table dispatch stay finding-free (no false positives)."""
+    report = run_on("dirty", ["host-sync-purity"])
+    keys = {f.key for f in report.findings}
+    assert "toy_batched.py:method_sync:block_until_ready" in keys
+    assert "toy_batched.py:_table_sync:item" in keys
+    assert not run_on("clean", ["host-sync-purity"]).findings
 
 
 def test_backend_inventory_floor():

@@ -27,6 +27,10 @@ TRACE_RULES = [
     # no signed-state collectives beyond the stat reductions); no-op
     # for backends outside the sharding registry.
     "trace-shardmap-kernel",
+    # Serve hot path: run_ticks + the telemetry snapshot compile free
+    # of host callbacks, and the snapshot copies (aliases nothing);
+    # no-op for every backend except the flagship serve target.
+    "trace-serve-nosync",
 ]
 
 
@@ -109,6 +113,34 @@ def test_fused_tick_rule_clean():
     trips — and the reference-mode trace is pallas-free."""
     report = core.run(rule_ids=["trace-fused-tick"])
     assert not report.findings, "\n" + report.format()
+
+
+def test_serve_nosync_rule_clean():
+    """The serve chunk path (run_ticks + the jitted telemetry
+    snapshot, with and without the span sampler) compiles free of
+    host callbacks, and the snapshot aliases nothing."""
+    report = core.run(rule_ids=["trace-serve-nosync"])
+    assert not report.findings, "\n" + report.format()
+
+
+def test_serve_nosync_rule_has_teeth(monkeypatch):
+    """Simulate the regression the alias check exists for: a snapshot
+    that DONATES its input aliases the output to the donated buffer —
+    draining it after the next chunk would read reused memory — and
+    the rule must flag it."""
+    import jax
+
+    from frankenpaxos_tpu.harness import serve as serve_mod
+
+    monkeypatch.setattr(
+        serve_mod,
+        "_SNAP",
+        jax.jit(serve_mod._copy_tree, donate_argnums=(0,)),
+    )
+    report = core.run(rule_ids=["trace-serve-nosync"])
+    assert any("ALIASES" in f.message for f in report.findings), (
+        report.format()
+    )
 
 
 def test_fused_tick_rule_has_teeth():

@@ -441,3 +441,30 @@ def test_deploy_smoke_profiles_a_role(tmp_path):
 
     with pytest.raises(ValueError):
         smoke.deploy_smoke("unreplicated", bench, profile_role="bogus")
+
+
+def test_serve_smoke(tmp_path):
+    """The serve-mode smoke (guards scripts/serve_smoke.sh + bench.py
+    --serve): a bounded serve run of the flagship backend through the
+    chunked-dispatch loop shuts down cleanly with zero drop, exports a
+    Perfetto-loadable trace carrying BOTH device lifecycle spans and
+    host dispatch spans, and feeds the live scrape CSV the dashboard's
+    --live mode tails."""
+    from frankenpaxos_tpu.harness.serve import serve_flagship
+    from frankenpaxos_tpu.monitoring import traceviz
+
+    report = serve_flagship(
+        seconds=120.0, out_dir=str(tmp_path), num_groups=32,
+        chunk_ticks=16, spans=8, rate_x=1.1, slo_p99=24,
+        max_chunks=8,
+    )
+    assert report["clean_shutdown"]
+    assert report["ticks"] == 8 * 16
+    assert report["dropped_ticks"] == 0
+    assert report["spans_exported"] > 0
+    tr = traceviz.load_chrome_trace(str(tmp_path / "serve_trace.json"))
+    xs = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["pid"] == traceviz.DEVICE_PID for e in xs)
+    assert any(e["pid"] == traceviz.HOST_PID for e in xs)
+    assert (tmp_path / "serve_metrics.csv").stat().st_size > 0
+    assert report["slo"]["observations"] == 8

@@ -1,0 +1,458 @@
+"""Tests of the streaming observability stack: the serve loop's
+chunked dispatch + double-buffered drain (harness/serve.py), the
+telemetry drain cursor and span sampler (tpu/telemetry.py), the SLO
+engine (monitoring/slo.py), and the Perfetto trace export
+(monitoring/traceviz.py)."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.harness.serve import ServeConfig, ServeLoop
+from frankenpaxos_tpu.monitoring import traceviz
+from frankenpaxos_tpu.monitoring.slo import SloEngine, SloPolicy
+from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+from frankenpaxos_tpu.tpu import telemetry as T
+from frankenpaxos_tpu.tpu import workload as wl_mod
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+
+def _cfg(**kw):
+    return mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, retry_timeout=8,
+        **kw
+    )
+
+
+def _with_telemetry(state, window, spans=0):
+    return dataclasses.replace(
+        state, telemetry=T.make_telemetry(window, spans=spans)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Drain-cursor exactness
+# ---------------------------------------------------------------------------
+
+
+def _run_chunks(cfg, chunks, chunk_ticks, window, spans=0):
+    """The serve dispatch shape: per-chunk run_ticks with per-chunk
+    fold_in keys — deterministic, replayable."""
+    key = jax.random.PRNGKey(7)
+    state = _with_telemetry(mp.init_state(cfg), window, spans)
+    t = jnp.zeros((), jnp.int32)
+    for i in range(chunks):
+        state, t = mp.run_ticks(
+            cfg, state, t, chunk_ticks, jax.random.fold_in(key, i)
+        )
+        yield state
+
+
+def test_drain_cursor_chunked_equals_one_shot():
+    """Partial drains across chunk boundaries are EXACT: the per-chunk
+    rows concatenate to the full per-tick history, their sums equal the
+    cumulative totals, and an identical run drained once at the end
+    reports bit-identical totals — nothing lost, nothing
+    double-counted."""
+    cfg = _cfg()
+    CH, N, W = 13, 5, 32  # chunk < window; boundaries never align
+
+    cur = T.DrainCursor()
+    rows = {name: [] for name in T.COUNTER_FIELDS}
+    ticks_seen = []
+    for state in _run_chunks(cfg, N, CH, W):
+        d = cur.drain(state.telemetry)
+        assert d["dropped_ticks"] == 0
+        ticks_seen.extend(d["tick"].tolist())
+        for name in T.COUNTER_FIELDS:
+            rows[name].extend(d[name].tolist())
+    chunked_totals = d["totals"]
+
+    assert ticks_seen == list(range(N * CH))  # every tick exactly once
+
+    # One-shot capture of the IDENTICAL run (same chunked dispatch,
+    # drained once): bit-identical cumulative totals.
+    for state2 in _run_chunks(cfg, N, CH, W):
+        pass
+    one_shot = T.DrainCursor().drain(state2.telemetry)
+    assert one_shot["totals"] == chunked_totals
+    # And the drained per-tick rows SUM to the cumulative totals for
+    # every counter column (queue_depth is a gauge, not a counter).
+    for name in T.COUNTER_FIELDS:
+        if name == "queue_depth":
+            continue
+        assert sum(rows[name]) == chunked_totals[name], name
+
+
+def test_drain_cursor_reports_overrun_instead_of_double_count():
+    """A drain slower than the ring period reports the overrun in
+    dropped_ticks and returns only the retained rows — never a
+    double-count, never a silent gap."""
+    cfg = _cfg()
+    W = 16
+    key = jax.random.PRNGKey(0)
+    state = _with_telemetry(mp.init_state(cfg), W)
+    t = jnp.zeros((), jnp.int32)
+    state, t = mp.run_ticks(cfg, state, t, 40, key)  # 40 > W
+    d = T.DrainCursor().drain(state.telemetry)
+    assert d["ticks_total"] == 40
+    assert d["dropped_ticks"] == 40 - W
+    assert d["tick"].tolist() == list(range(40 - W, 40))
+
+
+def test_span_sampler_lifecycle_stamps_ordered():
+    """Sampled spans carry ordered stage stamps (proposed < voted <=
+    committed < executed), cover multiple groups, and drain exactly
+    once through the span cursor."""
+    cfg = _cfg()
+    seen = []
+    cur = T.DrainCursor()
+    for state in _run_chunks(cfg, 4, 20, 64, spans=8):
+        d = cur.drain(state.telemetry)
+        seen.extend(d["spans"])
+        assert d["dropped_spans"] == 0
+    assert len(seen) >= 10
+    assert len({s["seq"] for s in seen}) == len(seen)  # no double-drain
+    for s in seen:
+        assert 0 <= s["proposed"] <= s["committed"] < s["executed"], s
+        if s["phase2_voted"] >= 0:
+            assert s["proposed"] < s["phase2_voted"] <= s["committed"], s
+    assert len({s["group"] for s in seen}) > 1  # samples across groups
+
+
+def test_spans_disabled_is_structural_noop():
+    """spans=0 (every backend's default) adds nothing: the protocol
+    state replays bit-identically with and without a sized reservoir
+    (the sampler only observes), and the zero-sized leaves survive the
+    scan carry."""
+    cfg = _cfg()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+    on, _ = mp.run_ticks(
+        cfg, _with_telemetry(mp.init_state(cfg), 32, spans=8), t0, 30, key
+    )
+    off, _ = mp.run_ticks(
+        cfg, _with_telemetry(mp.init_state(cfg), 32, spans=0), t0, 30, key
+    )
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(on, f.name)),
+            jax.tree_util.tree_leaves(getattr(off, f.name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
+    # The observer halves agree too (counters are span-independent).
+    np.testing.assert_array_equal(
+        np.asarray(on.telemetry.totals), np.asarray(off.telemetry.totals)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The serve loop
+# ---------------------------------------------------------------------------
+
+
+def test_serve_loop_matches_manual_chunked_run():
+    """The serve loop is OBSERVABILITY only: its chunked dispatch
+    replays the exact same program as manual run_ticks segments with
+    the same keys — final committed/retired and telemetry totals are
+    bit-identical."""
+    cfg = _cfg()
+    serve = ServeConfig(chunk_ticks=10, telemetry_window=32, spans=4,
+                        max_chunks=4)
+    loop = ServeLoop(mp, cfg, serve, seed=5)
+    report = loop.run()
+    assert report["clean_shutdown"] and report["ticks"] == 40
+
+    key = jax.random.PRNGKey(5)
+    state = _with_telemetry(mp.init_state(cfg), 32, spans=4)
+    t = jnp.zeros((), jnp.int32)
+    for i in range(4):
+        state, t = mp.run_ticks(
+            cfg, state, t, 10, jax.random.fold_in(key, i)
+        )
+    assert int(state.committed) == int(loop.state.committed)
+    assert int(state.retired) == int(loop.state.retired)
+    np.testing.assert_array_equal(
+        np.asarray(state.telemetry.totals),
+        np.asarray(loop.state.telemetry.totals),
+    )
+    # The drains saw every tick and every completed span exactly once.
+    assert report["dropped_ticks"] == 0
+    assert report["spans_exported"] == int(state.telemetry.spans_done)
+
+
+def test_serve_hot_path_never_blocks_on_state(monkeypatch):
+    """The no-blocking-transfer spy: during the loop, device_get only
+    ever touches tiny snapshot pytrees (never the protocol state), and
+    block_until_ready runs exactly once — at shutdown, after the last
+    chunk was dispatched."""
+    gets, waits, dispatched = [], [], []
+
+    real_get = jax.device_get
+    real_wait = jax.block_until_ready
+
+    def spy_get(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        nbytes = sum(getattr(a, "nbytes", 0) for a in leaves)
+        assert not isinstance(tree, mp.BatchedMultiPaxosState), (
+            "serve loop pulled the full protocol state"
+        )
+        gets.append(nbytes)
+        return real_get(tree)
+
+    def spy_wait(tree):
+        assert isinstance(tree, mp.BatchedMultiPaxosState)
+        waits.append(len(dispatched))
+        return real_wait(tree)
+
+    real_run_ticks = mp.run_ticks
+
+    def spy_run_ticks(*a, **kw):
+        dispatched.append(1)
+        return real_run_ticks(*a, **kw)
+
+    monkeypatch.setattr(jax, "device_get", spy_get)
+    monkeypatch.setattr(jax, "block_until_ready", spy_wait)
+    monkeypatch.setattr(mp, "run_ticks", spy_run_ticks)
+
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=64, window=16, slots_per_tick=2,
+        retry_timeout=8,
+    )
+    serve = ServeConfig(chunk_ticks=8, telemetry_window=32, spans=4,
+                        max_chunks=5)
+    loop = ServeLoop(mp, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["clean_shutdown"]
+    assert len(dispatched) == 5
+    # Exactly one wait, at shutdown — after every chunk went out.
+    assert waits == [5]
+    # Every hot-path transfer is snapshot-sized — a fixed few KB that
+    # does NOT scale with the protocol state (already ~25x here at
+    # G=64; ~10^4x at the flagship shape).
+    state_bytes = sum(
+        a.nbytes for a in jax.tree_util.tree_leaves(loop.state)
+    )
+    assert gets and max(gets) < state_bytes / 10
+
+
+def test_serve_slo_alarm_clamps_and_p99_recovers():
+    """The control-plane loop: offered load ~2x saturation backs the
+    queue up, the windowed queue-wait p99 breaches the target, the
+    alarm fires, admission clamps through the traced rate (no
+    recompile — the jit cache stays flat), the backlog drains, and the
+    windowed p99 recovers to the target."""
+    cfg = _cfg(
+        workload=WorkloadPlan(
+            arrival="constant", rate=2.0 * 2, backlog_cap=64
+        )
+    )
+    serve = ServeConfig(
+        chunk_ticks=16, telemetry_window=64,
+        slo=SloPolicy(
+            p99_target_ticks=4, source="queue_wait",
+            window_chunks=2, clear_after=2, clamp_factor=0.4,
+        ),
+        max_chunks=30,
+    )
+    loop = ServeLoop(mp, cfg, serve, seed=1)
+    cache0 = None
+    report = loop.run()
+    hist = loop.slo.history
+    assert loop.slo.alarms_fired >= 1
+    fired_at = next(i for i, h in enumerate(hist) if h["fired"])
+    assert hist[fired_at]["p99"] > 4
+    # The clamp engaged (scale dropped) ...
+    assert min(h["scale"] for h in hist) < 1.0
+    # ... and after it, the windowed p99 recovered to the target and
+    # the alarm cleared (p99 == -1 means the queue fully drained; the
+    # controller may probe upward again afterwards).
+    assert any(
+        h["cleared"] and h["p99"] <= 4 for h in hist[fired_at + 1:]
+    ), [(h["p99"], h["scale"]) for h in hist]
+    assert report["slo"]["clamps_applied"] >= 1
+    del cache0
+
+
+def test_serve_rate_clamp_does_not_recompile():
+    """set_rate between chunks rides the traced scalar: the whole SLO
+    serve run compiles run_ticks exactly once for its chunk length."""
+    cfg = _cfg(
+        workload=WorkloadPlan(arrival="constant", rate=4.0,
+                              backlog_cap=64)
+    )
+    serve = ServeConfig(
+        chunk_ticks=12, telemetry_window=32,
+        slo=SloPolicy(p99_target_ticks=2, source="queue_wait",
+                      window_chunks=1),
+        max_chunks=3,
+    )
+    loop = ServeLoop(mp, cfg, serve, seed=2)
+    loop._dispatch_chunk()  # first compile
+    before = mp.run_ticks._cache_size()
+    loop2 = ServeLoop(mp, cfg, serve, seed=3)
+    loop2.run()
+    assert mp.run_ticks._cache_size() == before
+
+
+# ---------------------------------------------------------------------------
+# SLO engine edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_slo_empty_histogram_never_alarms():
+    eng = SloEngine(SloPolicy(p99_target_ticks=0))
+    for _ in range(5):
+        s = eng.observe(wait_hist_delta=np.zeros(8, np.int64))
+        assert not s["alarm"] and s["p99"] == -1
+    assert eng.scale == 1.0 and eng.alarms_fired == 0
+
+
+def test_slo_exactly_at_target_is_in_slo():
+    """p99 == target must NOT alarm (strictly-above fires)."""
+    eng = SloEngine(SloPolicy(p99_target_ticks=5))
+    h = np.zeros(8, np.int64)
+    h[5] = 100  # every sample at exactly 5 ticks -> p99 == 5
+    s = eng.observe(wait_hist_delta=h)
+    assert s["p99"] == 5 and not s["alarm"]
+    h2 = np.zeros(8, np.int64)
+    h2[6] = 100  # one bin above -> breach
+    s = eng.observe(wait_hist_delta=h2)
+    assert s["alarm"] and s["fired"]
+
+
+def test_slo_hysteresis_and_scale_recovery():
+    pol = SloPolicy(
+        p99_target_ticks=3, window_chunks=1, clear_after=2,
+        clamp_factor=0.5, recover_factor=2.0,
+    )
+    eng = SloEngine(pol)
+    bad = np.zeros(8, np.int64)
+    bad[7] = 10
+    good = np.zeros(8, np.int64)
+    good[1] = 10
+    s = eng.observe(wait_hist_delta=bad)
+    assert s["fired"] and eng.scale == 0.5
+    s = eng.observe(wait_hist_delta=bad)
+    assert s["alarm"] and not s["fired"] and eng.scale == 0.25
+    s = eng.observe(wait_hist_delta=good)
+    assert s["alarm"]  # one clean drain < clear_after: still latched
+    s = eng.observe(wait_hist_delta=good)
+    assert s["cleared"] and not s["alarm"]
+    assert eng.scale == 0.5  # recovery starts the drain it clears
+    s = eng.observe(wait_hist_delta=good)
+    assert eng.scale == 1.0  # multiplicative recovery, capped
+    s = eng.observe(wait_hist_delta=good)
+    assert eng.scale == 1.0  # stays at the plan rate
+    assert eng.alarms_fired == 1
+
+
+def test_slo_shed_rate_alarm():
+    eng = SloEngine(
+        SloPolicy(p99_target_ticks=100, shed_rate_target=0.1,
+                  window_chunks=1)
+    )
+    s = eng.observe(offered_delta=90, shed_delta=10)  # exactly 0.1
+    assert not s["alarm"]
+    s = eng.observe(offered_delta=80, shed_delta=20)  # 0.2 > 0.1
+    assert s["alarm"] and s["shed_breach"] and not s["p99_breach"]
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_loads_and_carries_both_halves(tmp_path):
+    cfg = _cfg()
+    out = tmp_path / "trace.json"
+    serve = ServeConfig(
+        chunk_ticks=16, telemetry_window=64, spans=8,
+        trace_path=str(out), max_chunks=4,
+    )
+    loop = ServeLoop(mp, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["spans_exported"] > 0
+    payload = traceviz.load_chrome_trace(str(out))
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    device = [e for e in xs if e["pid"] == traceviz.DEVICE_PID]
+    host = [e for e in xs if e["pid"] == traceviz.HOST_PID]
+    assert device and host
+    # Device lifecycle slices carry the stage stamps as args and map
+    # onto the host wall clock (ts within the run's span envelope).
+    lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
+    assert lifecycles
+    assert all("committed" in e["args"] for e in lifecycles)
+    host_lo = min(e["ts"] for e in host)
+    host_hi = max(e["ts"] + e["dur"] for e in host)
+    for e in lifecycles:
+        assert host_lo - 5e6 <= e["ts"] <= host_hi + 5e6
+    # Host spans include the dispatch/drain pair of the serve loop.
+    assert {e["name"] for e in host} >= {"dispatch", "drain"}
+    # The whole file is plain JSON — Perfetto's loader needs no more.
+    json.loads(out.read_text())
+
+
+def test_tick_clock_interpolates_and_extrapolates():
+    clock = traceviz.TickClock([(0, 100.0), (100, 101.0)])
+    assert clock.to_us(50) == pytest.approx(100.5e6)
+    assert clock.to_us(200) == pytest.approx(102.0e6)
+    assert clock.to_us(-100) == pytest.approx(99.0e6)
+
+
+def test_dashboard_live_tails_serve_csv(tmp_path):
+    """The dashboard's --live mode: a scrape CSV that a serve loop fed
+    renders (device counters become rate panels) and the tail exits on
+    idle — watching a run without waiting for a finished capture."""
+    from frankenpaxos_tpu.monitoring import dashboard, scrape
+
+    cfg = _cfg()
+    csv_path = str(tmp_path / "serve_metrics.csv")
+    serve = ServeConfig(chunk_ticks=8, telemetry_window=32,
+                        scrape_csv=csv_path, max_chunks=3)
+    loop = ServeLoop(mp, cfg, serve, seed=0)
+    loop.run()
+    # Host spans land in the CSV EXACTLY once each — including the
+    # compile-marked first dispatch, with no double-write at shutdown.
+    import csv as _csv
+
+    with open(csv_path) as f:
+        span_rows = [
+            r for r in _csv.DictReader(f)
+            if r["name"] == "fpx_host_span_seconds"
+        ]
+    assert len(span_rows) == len(loop.host_spans)
+    assert sum("compile=true" in r["labels"] for r in span_rows) == 1
+    out = str(tmp_path / "live.png")
+    renders = dashboard.tail_live(
+        csv_path, out, interval_s=0.1, max_seconds=5.0, idle_exit_s=0.5
+    )
+    assert renders >= 1
+    assert os.path.getsize(out) > 0
+    del scrape
+
+
+# ---------------------------------------------------------------------------
+# CI wiring
+# ---------------------------------------------------------------------------
+
+
+def test_serve_smoke_script_and_bench_mode_exist():
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    script = repo / "scripts" / "serve_smoke.sh"
+    assert script.exists() and os.access(script, os.X_OK)
+    src = script.read_text()
+    assert "harness.serve" in src and "trace-serve-nosync" in src
+    bench_src = (repo / "bench.py").read_text()
+    assert '"--serve"' in bench_src and "--inner-serve" in bench_src

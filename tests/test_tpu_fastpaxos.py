@@ -270,3 +270,86 @@ def test_straggler_phase1a_reports_vote_instead_of_casting():
     # cast a round-1 vote for the recovery value.
     assert int(st.vote_round[0, 0, 0]) == 0
     assert int(st.vote_value[0, 0, 0]) == v0
+
+
+# ---------------------------------------------------------------------------
+# Proposer crash semantics (PR 3 follow-up (b)): crash gates issuing and
+# the counter-side transitions; revival restores liveness through the
+# persisted replies + the recovery timeout.
+# ---------------------------------------------------------------------------
+
+
+def _crash_cfg(**fault_kw):
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    return fb.BatchedFastPaxosConfig(
+        f=1, num_groups=4, window=16, instances_per_tick=2,
+        conflict_rate=0.2, lat_min=1, lat_max=2, recovery_timeout=8,
+        faults=FaultPlan(**fault_kw),
+    )
+
+
+def test_dead_proposers_stall_and_manual_revival_resumes():
+    """Every round-0 proposer dead: in-flight instances drain (their
+    replies persist but nobody counts them), then progress STOPS — no
+    new instances, no recoveries; reviving the proposers restores
+    choices via the persisted replies and the recovery timeout — the
+    liveness-after-revive contract (revive_rate=0 keeps the PRNG from
+    resurrecting anyone mid-stall)."""
+    cfg = _crash_cfg(crash_rate=0.001, revive_rate=0.0)
+    key = jax.random.PRNGKey(2)
+    state, t = fb.run_ticks(cfg, fb.init_state(cfg), jnp.int32(0), 30, key)
+    assert int(state.chosen_total) > 0
+
+    state = dataclasses.replace(
+        state, prop_alive=jnp.zeros((cfg.num_groups,), bool)
+    )
+    state, t = fb.run_ticks(cfg, state, t, 30, jax.random.fold_in(key, 1))
+    c_drained = int(state.chosen_total)
+    state, t = fb.run_ticks(cfg, state, t, 25, jax.random.fold_in(key, 2))
+    assert int(state.chosen_total) == c_drained  # fully stalled
+    assert not bool(np.asarray(state.prop_alive).any())
+
+    state = dataclasses.replace(
+        state, prop_alive=jnp.ones((cfg.num_groups,), bool)
+    )
+    state, t = fb.run_ticks(cfg, state, t, 40, jax.random.fold_in(key, 3))
+    assert int(state.chosen_total) > c_drained
+    inv = fb.check_invariants(cfg, state, t)
+    assert all(bool(v) for v in inv.values()), inv
+
+
+def test_revival_counts_recovery_handoffs_in_telemetry():
+    """High revive_rate: the tick after every proposer is killed, the
+    revive draw brings (almost surely all of) them back, and each
+    revival lands in the telemetry ring as one leader change."""
+    from frankenpaxos_tpu.tpu.telemetry import COL
+
+    cfg = _crash_cfg(crash_rate=0.001, revive_rate=0.99)
+    key = jax.random.PRNGKey(2)
+    state, t = fb.run_ticks(cfg, fb.init_state(cfg), jnp.int32(0), 20, key)
+    state = dataclasses.replace(
+        state, prop_alive=jnp.zeros((cfg.num_groups,), bool)
+    )
+    lc0 = int(state.telemetry.totals[COL["leader_changes"]])
+    state, t = fb.run_ticks(cfg, state, t, 1, jax.random.fold_in(key, 5))
+    alive = np.asarray(state.prop_alive)
+    assert alive.any()  # p(all four stay dead) = 1e-8
+    lc1 = int(state.telemetry.totals[COL["leader_changes"]])
+    assert lc1 - lc0 == int(alive.sum())  # one handoff per revival
+
+
+def test_crash_plan_randomized_schedules_hold_invariants():
+    """The simtest axis the satellite enables: randomized crash/revive
+    schedules over the proposer plane keep every invariant (incl. the
+    fast-committed safety ledger) and make progress — liveness after
+    revival, with revive_rate keeping dead windows finite."""
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    spec = simtest.SPECS["fastpaxos"]
+    assert spec.crash_ok  # the crash axis is now enabled
+    plan = FaultPlan(crash_rate=0.05, revive_rate=0.3)
+    out = simtest.run_many_seeds(spec, plan, seeds=(0, 1, 2, 3), ticks=80)
+    assert out["ok"], out
+    assert all(p > 0 for p in out["progress"])  # chooses despite crashes
