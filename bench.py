@@ -1103,6 +1103,261 @@ def _serve_inner() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+def _lifecycle_inner() -> None:
+    """The production-lifecycle measurement (``--lifecycle``): the
+    flagship under tpu/lifecycle.py. Three legs:
+
+      1. unbounded-horizon leg: a run crossing >= 20x the slot-window
+         length with window rotation on — the slot horizon (max head)
+         stays bounded by one rotation quantum + W and the state byte
+         footprint is flat across every segment, while the protocol
+         history stays BIT-IDENTICAL to the unrotated twin (rebased);
+      2. overhead leg: rotation + session table engaged vs
+         LifecyclePlan.none() at the same shape — budget < 2%;
+      3. reconfiguration leg: a mid-serve acceptor swap through the
+         traced epoch axis — per-chunk commit throughput dips and
+         recovers, with the jit cache pinned flat across both epoch
+         changes.
+
+    One JSON line on stdout (BENCH_JSON ...). Capture artifact:
+    LIFECYCLE_r01.json."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.common import state_nbytes
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+    G, W, K = 256, 32, 4
+    CHUNK, CHUNKS, WARM = 25, 8, 2
+    ROT = 2 * W  # rotation quantum (align = W)
+
+    def base_cfg(**kw) -> "mp.BatchedMultiPaxosConfig":
+        return mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=G, window=W, slots_per_tick=K,
+            lat_min=1, lat_max=3, retry_timeout=16, thrifty=True, **kw
+        )
+
+    def run_segments(cfg, n_chunks, seed=0, state=None, t=None,
+                     per_chunk=None):
+        key = jax.random.PRNGKey(seed)
+        state = mp.init_state(cfg) if state is None else state
+        t = jnp.zeros((), jnp.int32) if t is None else t
+        for i in range(n_chunks):
+            state, t = mp.run_ticks(
+                cfg, state, t, CHUNK, jax.random.fold_in(key, i)
+            )
+            if per_chunk is not None:
+                per_chunk(state)
+        return state, t
+
+    # ---- 1. Unbounded-horizon leg: >= 20x the window in constant
+    # horizon + flat bytes, bit-identical to the unrotated twin.
+    plan = LifecyclePlan(rotate_every=ROT, sessions=8,
+                         resubmit_rate=0.05)
+    cfg_l = base_cfg(lifecycle=plan)
+    cfg_n = base_cfg()
+    horizon_chunks = 26  # 650 ticks: next_slot crosses ~20x W easily
+    max_heads, live_series = [], []
+
+    def probe(st):
+        max_heads.append(int(jax.device_get(jnp.max(st.head))))
+        # LIVE process-wide buffer bytes (jax.live_arrays): the state
+        # shapes are static, so the real constant-memory claim is that
+        # nothing accumulates across rotations — donation keeps the
+        # state single-buffered and no roll materializes extra
+        # buffers. (state_nbytes alone is shape-derived and could
+        # never vary.)
+        live_series.append(
+            sum(int(x.nbytes) for x in jax.live_arrays())
+        )
+
+    st_l, t_l = run_segments(cfg_l, horizon_chunks, seed=0,
+                             per_chunk=probe)
+    st_n, _ = run_segments(cfg_n, horizon_chunks, seed=0)
+    base = int(jax.device_get(st_l.lifecycle.rot_base))
+    twin_next = int(jax.device_get(jnp.max(st_n.next_slot)))
+    # Bit-identity modulo the rebase, on the headline planes.
+    ident = (
+        bool(np.array_equal(
+            jax.device_get(st_l.head) + base, jax.device_get(st_n.head)
+        ))
+        and bool(np.array_equal(
+            jax.device_get(st_l.status), jax.device_get(st_n.status)
+        ))
+        and int(st_l.committed) == int(st_n.committed)
+        and bool(np.array_equal(
+            jax.device_get(st_l.lat_hist), jax.device_get(st_n.lat_hist)
+        ))
+    )
+    inv = {
+        k: bool(v)
+        for k, v in mp.check_invariants(cfg_l, st_l, t_l).items()
+    }
+    horizon_leg = {
+        "ticks": horizon_chunks * CHUNK,
+        "window": W,
+        "rotate_every": ROT,
+        "rotations": int(jax.device_get(st_l.lifecycle.rot_count)),
+        "rotated_slots": base,
+        "slots_allocated_x_window": round(twin_next / W, 1),
+        "max_head_rotated": max(max_heads),
+        "horizon_bound": ROT + 2 * W,
+        "horizon_constant": max(max_heads) < ROT + 2 * W,
+        "state_bytes": state_nbytes(st_l),
+        "live_bytes_first": live_series[0],
+        "live_bytes_peak": max(live_series),
+        # Flat = no growth across rotations beyond transient slack
+        # (keys/probe scalars); a rotation path that materialized
+        # extra buffers per roll would trip this.
+        "device_bytes_flat": max(live_series)
+        <= int(1.25 * live_series[0]),
+        "bit_identical_to_unrotated_twin": ident,
+        "session_cache_hits": int(
+            jax.device_get(st_l.lifecycle.cache_hits)
+        ),
+        "invariants_ok": all(inv.values()),
+    }
+
+    # ---- 2. Overhead leg: lifecycle engaged vs none at the FLAGSHIP
+    # shape (the budget is a serve-deployment claim — at toy shapes
+    # the subsystem's fixed per-tick scalars dominate a sub-ms tick
+    # and the fraction is meaningless). The rotation quantum is the
+    # production-ish 8x window (the horizon leg above stresses an
+    # aggressive 2x quantum — ~80 rolls in 650 ticks — to pin
+    # exactness; the lax.cond rebase costs only on roll ticks).
+    FG = 3334  # the bench.py flagship group count (10k acceptors)
+    overhead_plan = LifecyclePlan(
+        rotate_every=8 * W, sessions=8, resubmit_rate=0.05
+    )
+
+    def fcfg(**kw):
+        return mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=FG, window=W, slots_per_tick=K,
+            lat_min=1, lat_max=3, retry_timeout=16, thrifty=True, **kw
+        )
+
+    def warm(cfg, seed):
+        key = jax.random.PRNGKey(seed)
+        state = mp.init_state(cfg)
+        t = jnp.zeros((), jnp.int32)
+        for i in range(WARM):  # compile + steady-state warmup
+            state, t = mp.run_ticks(
+                cfg, state, t, CHUNK, jax.random.fold_in(key, i)
+            )
+        jax.block_until_ready(state.committed)
+        return key, state, t
+
+    def timed_pass(cfg, run, rep):
+        key, state, t = run
+        start = time.perf_counter()
+        for i in range(4):
+            state, t = mp.run_ticks(
+                cfg, state, t, CHUNK,
+                jax.random.fold_in(key, 10 + 4 * rep + i),
+            )
+        jax.block_until_ready(state.committed)
+        return time.perf_counter() - start, (key, state, t)
+
+    # INTERLEAVED best-of-5: the two configs' timed passes alternate,
+    # so slow drift on a small shared-CPU host hits both columns
+    # instead of biasing whichever ran second, and the min-of-5
+    # converges on each program's true floor (run-to-run noise on this
+    # box is on the order of the budget itself).
+    cfg_none, cfg_lc = fcfg(), fcfg(lifecycle=overhead_plan)
+    run_none = warm(cfg_none, seed=10)
+    run_lc = warm(cfg_lc, seed=10)
+    best_none = best_lc = float("inf")
+    for rep in range(5):
+        dt, run_none = timed_pass(cfg_none, run_none, rep)
+        best_none = min(best_none, dt)
+        dt, run_lc = timed_pass(cfg_lc, run_lc, rep)
+        best_lc = min(best_lc, dt)
+    none_tps = 4 * CHUNK / best_none
+    lc_tps = 4 * CHUNK / best_lc
+    overhead = 1.0 - lc_tps / none_tps
+
+    # ---- 3. Reconfiguration leg: mid-serve acceptor swap, zero
+    # recompiles, throughput dips and recovers.
+    cfg_r = base_cfg(
+        lifecycle=LifecyclePlan(rotate_every=ROT, reconfig=True)
+    )
+    st, t = run_segments(cfg_r, WARM, seed=20)
+    cache0 = mp.run_ticks._cache_size()
+
+    def commits_over(n, seed, state, t):
+        c0 = int(jax.device_get(state.committed))
+        state, t = run_segments(cfg_r, n, seed=seed, state=state, t=t)
+        return (
+            (int(jax.device_get(state.committed)) - c0) / (n * CHUNK),
+            state, t,
+        )
+
+    healthy, st, t = commits_over(4, 21, st, t)
+    st = dataclasses.replace(
+        st, lifecycle=lifecycle_mod.swap_acceptor(st.lifecycle, 1)
+    )
+    degraded, st, t = commits_over(2, 22, st, t)
+    st = dataclasses.replace(
+        st, lifecycle=lifecycle_mod.set_membership(st.lifecycle, True)
+    )
+    recovered, st, t = commits_over(4, 23, st, t)
+    cache_flat = mp.run_ticks._cache_size() == cache0
+    inv_r = {
+        k: bool(v)
+        for k, v in mp.check_invariants(cfg_r, st, t).items()
+    }
+    reconfig_leg = {
+        "healthy_commits_per_tick": round(healthy, 2),
+        "swapped_commits_per_tick": round(degraded, 2),
+        "recovered_commits_per_tick": round(recovered, 2),
+        "dipped": degraded < healthy,
+        "recovered": recovered > 0.9 * healthy,
+        "jit_cache_flat_across_epochs": cache_flat,
+        "epochs_applied": int(jax.device_get(st.lifecycle.applied)),
+        "old_epochs_gcd": int(jax.device_get(st.lifecycle.epochs_gcd)),
+        "invariants_ok": all(inv_r.values()),
+    }
+
+    result = {
+        "metric": "flagship production lifecycle: window rotation + "
+        "session table + traced reconfiguration",
+        "backend": "multipaxos",
+        "device": str(jax.devices()[0]),
+        "num_acceptors": cfg_l.num_acceptors,
+        "horizon_leg": horizon_leg,
+        "overhead_leg": {
+            "num_groups": FG,
+            "rotate_every": overhead_plan.rotate_every,
+            "sessions": overhead_plan.sessions,
+            "none_ticks_per_sec": round(none_tps, 2),
+            "lifecycle_ticks_per_sec": round(lc_tps, 2),
+            "overhead_fraction": round(overhead, 4),
+            "overhead_under_2pct": overhead < 0.02,
+        },
+        "reconfig_leg": reconfig_leg,
+        "ok": (
+            horizon_leg["horizon_constant"]
+            and horizon_leg["device_bytes_flat"]
+            and horizon_leg["bit_identical_to_unrotated_twin"]
+            and horizon_leg["slots_allocated_x_window"] >= 20
+            and horizon_leg["invariants_ok"]
+            and overhead < 0.02
+            and reconfig_leg["dipped"]
+            and reconfig_leg["recovered"]
+            and reconfig_leg["jit_cache_flat_across_epochs"]
+            and reconfig_leg["invariants_ok"]
+        ),
+        "measured_live": True,
+    }
+    print("BENCH_JSON " + json.dumps(result))
+
+
 def _subprocess_mode_main(inner_flag: str, metric: str, env: dict) -> None:
     """Shared orchestrator for the standalone bench modes (--workload,
     --multichip): run this script's inner mode in a clean subprocess,
@@ -1147,6 +1402,17 @@ def _serve_main() -> None:
         "--inner-serve",
         "flagship serve mode: chunked dispatch with non-blocking "
         "telemetry drain",
+        _cpu_env(),
+    )
+
+
+def _lifecycle_main() -> None:
+    """Orchestrate the lifecycle measurement in a clean CPU subprocess;
+    print exactly one JSON line, exit 0."""
+    _subprocess_mode_main(
+        "--inner-lifecycle",
+        "flagship production lifecycle: window rotation + session "
+        "table + traced reconfiguration",
         _cpu_env(),
     )
 
@@ -1433,6 +1699,8 @@ if __name__ == "__main__":
         _workload_inner()
     elif "--inner-serve" in sys.argv:
         _serve_inner()
+    elif "--inner-lifecycle" in sys.argv:
+        _lifecycle_inner()
     elif "--inner" in sys.argv:
         _inner_main()
     elif "--multichip" in sys.argv:
@@ -1441,5 +1709,7 @@ if __name__ == "__main__":
         _workload_main()
     elif "--serve" in sys.argv:
         _serve_main()
+    elif "--lifecycle" in sys.argv:
+        _lifecycle_main()
     else:
         main()

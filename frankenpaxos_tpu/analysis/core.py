@@ -29,7 +29,7 @@ from frankenpaxos_tpu.analysis import astutil
 # plan is all-empty state feeding zero tick equations;
 # trace-workload-retrace: the traced [rate x fault-rate] sweep never
 # grows the jit cache).
-ANALYSIS_VERSION = "1.5"
+ANALYSIS_VERSION = "1.6"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

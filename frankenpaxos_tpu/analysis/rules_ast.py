@@ -742,6 +742,137 @@ def check_workload_rate_validated(ctx: Context) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Production lifecycle (the fault/workload contracts mirrored for
+# tpu/lifecycle.py). Scoped to the backends that thread the subsystem —
+# the plan rolls out flagship-first, so the contract names its coverage
+# explicitly instead of demanding all backends at once.
+# ---------------------------------------------------------------------------
+
+LIFECYCLE_BACKEND_FILES = (
+    "multipaxos_batched.py",
+    "compartmentalized_batched.py",
+)
+
+
+def _lifecycle_files(ctx: Context) -> List[pathlib.Path]:
+    return [
+        p
+        for p in astutil.batched_files(ctx.root)
+        if p.name in LIFECYCLE_BACKEND_FILES
+    ]
+
+
+@rule(
+    "lifecycle-config-field",
+    "ast",
+    "every lifecycle-threaded batched *Config accepts a "
+    "`lifecycle: LifecyclePlan` field",
+)
+def check_lifecycle_config(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in _lifecycle_files(ctx):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            ann = astutil.ann_fields(cls).get("lifecycle")
+            if ann is None or "LifecyclePlan" not in ann:
+                out.append(
+                    Finding(
+                        rule="lifecycle-config-field",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name} lacks a `lifecycle: "
+                            "LifecyclePlan` field (tpu/lifecycle.py "
+                            "contract)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+@rule(
+    "lifecycle-validate",
+    "ast",
+    "every lifecycle-threaded *Config.__post_init__ calls "
+    "lifecycle.validate(...) so malformed plans (misaligned rotation "
+    "quanta, cacheless resubmit rates) fail at config time",
+)
+def check_lifecycle_validate(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in _lifecycle_files(ctx):
+        tree = astutil.parse_file(path)
+        for cls in astutil.classes_with_suffix(tree, "Config"):
+            post = [
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "__post_init__"
+            ]
+            calls_validate = post and any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "validate"
+                and "lifecycle" in ast.unparse(n.func.value)
+                for n in ast.walk(post[0])
+            )
+            if not calls_validate:
+                out.append(
+                    Finding(
+                        rule="lifecycle-validate",
+                        path=_rel(ctx, path),
+                        line=cls.lineno,
+                        message=(
+                            f"{cls.name}.__post_init__ never calls "
+                            "self.lifecycle.validate(...)"
+                        ),
+                        key=f"{path.name}:{cls.name}",
+                    )
+                )
+    return out
+
+
+@rule(
+    "lifecycle-apply",
+    "ast",
+    "every lifecycle-threaded tick actually applies the configured "
+    "LifecyclePlan (rotation/sessions/reconfig legs reachable)",
+)
+def check_lifecycle_apply(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for path in _lifecycle_files(ctx):
+        tree = astutil.parse_file(path)
+        for func in astutil.functions_named(tree, ("tick",)):
+            applies = any(
+                (
+                    isinstance(n, ast.Attribute)
+                    and n.attr == "lifecycle"
+                )
+                or (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ("lifecycle_mod", "lifecycle")
+                )
+                for n in ast.walk(func)
+            )
+            if not applies:
+                out.append(
+                    Finding(
+                        rule="lifecycle-apply",
+                        path=_rel(ctx, path),
+                        line=func.lineno,
+                        message=(
+                            "tick accepts a LifecyclePlan via config "
+                            "but never applies it"
+                        ),
+                        key=path.name,
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Kernel layer (PR 4 contract)
 # ---------------------------------------------------------------------------
 

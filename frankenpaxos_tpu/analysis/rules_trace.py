@@ -722,6 +722,167 @@ def check_workload_noop(ctx: Context) -> List[Finding]:
     return out
 
 
+# Backends that thread the production-lifecycle subsystem
+# (tpu/lifecycle.py); the lifecycle-noop / trace-lifecycle-retrace
+# rules cover exactly these (the subsystem rolls out flagship-first).
+LIFECYCLE_BACKENDS = ("multipaxos", "compartmentalized")
+
+
+@rule(
+    "lifecycle-noop",
+    "trace",
+    "under LifecyclePlan.none() every lifecycle State leaf is "
+    "zero-sized and feeds no tick equation — the structural no-op "
+    "contract that keeps default runs bit-identical to the "
+    "pre-lifecycle program",
+)
+def check_lifecycle_noop(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        if backend not in LIFECYCLE_BACKENDS:
+            continue
+        # Shared with trace-dtype-policy / trace-workload-noop: ONE
+        # default-config tick trace per backend per process.
+        closed, state = _tick_closed(backend)
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        lc_idx = [
+            i
+            for i, (path, leaf) in enumerate(flat)
+            if path and getattr(path[0], "name", None) == "lifecycle"
+        ]
+        if not lc_idx:
+            out.append(
+                Finding(
+                    rule="lifecycle-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "State carries no lifecycle field — the "
+                        "subsystem is not threaded through this backend"
+                    ),
+                    key=f"{backend}:missing",
+                )
+            )
+            continue
+        sized = [
+            flat[i][1].size for i in lc_idx if flat[i][1].size != 0
+        ]
+        if sized:
+            out.append(
+                Finding(
+                    rule="lifecycle-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"LifecyclePlan.none() state carries "
+                        f"{len(sized)} NON-empty leaf/leaves — the "
+                        "none plan must be structurally empty"
+                    ),
+                    key=f"{backend}:sized",
+                )
+            )
+        invars = closed.jaxpr.invars
+        lc_vars = {id(invars[i]) for i in lc_idx}
+        consumed = sum(
+            1
+            for eqn in closed.jaxpr.eqns
+            for v in eqn.invars
+            if id(v) in lc_vars
+        )
+        if consumed:
+            out.append(
+                Finding(
+                    rule="lifecycle-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"{consumed} tick equation input(s) consume a "
+                        "lifecycle leaf under LifecyclePlan.none() — "
+                        "the none plan must add ZERO ops"
+                    ),
+                    key=f"{backend}:consumed",
+                )
+            )
+    return out
+
+
+@rule(
+    "trace-lifecycle-retrace",
+    "trace",
+    "acceptor reconfiguration is recompile-free: swapping membership "
+    "and bumping the traced epoch (plus a force-rotation latch) "
+    "between run_ticks segments replays ONE compiled program — the "
+    "jit cache stays flat across epoch changes",
+)
+def check_lifecycle_retrace(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import lifecycle as _lifecycle
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        if backend not in LIFECYCLE_BACKENDS:
+            continue
+        mod = _module(backend)
+        cfg = mod.analysis_config(
+            lifecycle=LifecyclePlan(
+                rotate_every=16, sessions=4, resubmit_rate=0.1,
+                reconfig=True,
+            )
+        )
+
+        def run(st):
+            st, t = mod.run_ticks(
+                cfg, st, jnp.zeros((), jnp.int32), _TICKS,
+                jax.random.PRNGKey(0),
+            )
+            jax.block_until_ready(t)
+
+        run(mod.init_state(cfg))
+        before = mod.run_ticks._cache_size()
+        steered = mod.init_state(cfg)
+        # Mask one acceptor CELL out (shape-generic: flat element 0 —
+        # swap_acceptor is the flat-[A, G]-axis convenience and
+        # rejects grid-shaped axes by design).
+        shape = steered.lifecycle.acc_mask.shape
+        mask = (
+            jnp.ones(shape, bool).ravel().at[0].set(False).reshape(shape)
+        )
+        steered = _dc.replace(
+            steered,
+            lifecycle=_lifecycle.request_rotation(
+                _lifecycle.set_membership(steered.lifecycle, mask)
+            ),
+        )
+        run(steered)
+        after = mod.run_ticks._cache_size()
+        if after > before:
+            out.append(
+                Finding(
+                    rule="trace-lifecycle-retrace",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "a membership swap + epoch bump missed the jit "
+                        f"cache ({before} -> {after} entries) — the "
+                        "membership/epoch landed in a static argument "
+                        "and every reconfiguration recompiles the "
+                        "serve loop"
+                    ),
+                    key=backend,
+                )
+            )
+    return out
+
+
 # Backends whose traced sweep gets the COMPILE-backed jit-cache check
 # (the XLA-compile half of the retrace rule). The cheap trace-only
 # coverage below still runs for every backend — the traced-rate

@@ -39,7 +39,14 @@ On top of the drain sit the streaming consumers:
     capture shows them next to the device trace;
   * the scrape CSV (``monitoring/scrape.py`` schema): one device
     sample batch + host span batch per drain, tailed LIVE by
-    ``python -m frankenpaxos_tpu.monitoring.dashboard <csv> --live``.
+    ``python -m frankenpaxos_tpu.monitoring.dashboard <csv> --live``;
+  * the CONTROL PLANE verbs (all zero-recompile edits of traced state
+    between chunks): ``set_rate`` (the SLO clamp's knob),
+    ``set_fault_rates`` (live fault-leg swaps on a
+    ``FaultPlan(traced=True)`` config), and the production-lifecycle
+    verbs ``reconfigure``/``swap_acceptor``/``rotate``
+    (tpu/lifecycle.py: traced acceptor-membership epochs + forced
+    window rolls).
 
 CLI (a bounded run of the flagship)::
 
@@ -62,6 +69,7 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.monitoring import scrape as scrape_mod
 from frankenpaxos_tpu.monitoring import traceviz
 from frankenpaxos_tpu.monitoring.slo import SloEngine, SloPolicy
+from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 
@@ -183,6 +191,83 @@ class ServeLoop:
                 **meta,
             }
         )
+
+    # -- the control plane: verbs steering TRACED state between chunks.
+    # Every verb is a host-side dataclasses.replace of a traced leaf —
+    # the compiled program never changes (the jit cache stays flat,
+    # pinned by tests/test_lifecycle.py and the trace-lifecycle-retrace
+    # analysis rule), so a live serve loop turns fault legs on/off,
+    # swaps acceptors, and forces window rolls with zero recompiles.
+
+    def set_rate(self, rate: float):
+        """Steer the traced offered rate (tpu/workload.py set_rate) —
+        the same knob the SLO engine's admission clamp drives."""
+        self.state = dataclasses.replace(
+            self.state,
+            workload=workload_mod.set_rate(self.state.workload, rate),
+        )
+        self._span("verb:set_rate", time.time(), time.perf_counter(),
+                   rate=rate)
+
+    def set_fault_rates(
+        self,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        crash: float = 0.0,
+        revive: float = 0.0,
+    ):
+        """Live FaultPlan swap: drive the traced Bernoulli rates of a
+        ``FaultPlan(traced=True)`` config mid-serve — fault legs turn
+        on/off between chunks with no recompile (the PR 10 follow-up:
+        the control plane used to drive only the offered rate)."""
+        self.state = dataclasses.replace(
+            self.state,
+            workload=workload_mod.set_fault_rates(
+                self.state.workload,
+                drop=drop, dup=dup, crash=crash, revive=revive,
+            ),
+        )
+        self._span("verb:set_fault_rates", time.time(),
+                   time.perf_counter(), drop=drop, dup=dup,
+                   crash=crash, revive=revive)
+
+    def reconfigure(self, mask):
+        """Acceptor-set reconfiguration: install a new membership mask
+        over the backend's acceptor axis and bump the traced epoch —
+        the next chunk runs the in-graph i/i+1 handoff
+        (tpu/lifecycle.py; needs a LifecyclePlan(reconfig=True)
+        config). ``mask`` broadcasts (``True`` restores everyone)."""
+        self.state = dataclasses.replace(
+            self.state,
+            lifecycle=lifecycle_mod.set_membership(
+                self.state.lifecycle, mask
+            ),
+        )
+        self._span("verb:reconfigure", time.time(), time.perf_counter())
+
+    def swap_acceptor(self, index: int):
+        """Reconfigure out the acceptor at ``index`` of the leading
+        acceptor axis (the crashed-node swap)."""
+        self.state = dataclasses.replace(
+            self.state,
+            lifecycle=lifecycle_mod.swap_acceptor(
+                self.state.lifecycle, index
+            ),
+        )
+        self._span("verb:swap_acceptor", time.time(),
+                   time.perf_counter(), index=index)
+
+    def rotate(self):
+        """Latch a force-rotation: the next chunk rolls the slot
+        window down to the retired quantum (needs a
+        LifecyclePlan(rotate_every > 0) config)."""
+        self.state = dataclasses.replace(
+            self.state,
+            lifecycle=lifecycle_mod.request_rotation(
+                self.state.lifecycle
+            ),
+        )
+        self._span("verb:rotate", time.time(), time.perf_counter())
 
     # -- the hot path -------------------------------------------------------
 
@@ -331,6 +416,14 @@ class ServeLoop:
         }
         if self.slo is not None:
             out["slo"] = self.slo.summary()
+        lc_plan = getattr(self.cfg, "lifecycle", None)
+        if lc_plan is not None and lc_plan.active:
+            # Rotation / session-table / reconfiguration roll-up (one
+            # coalesced pull of the tiny lifecycle leaves; the run is
+            # already synced at shutdown).
+            out["lifecycle"] = lifecycle_mod.summary(
+                lc_plan, self.state.lifecycle
+            )
         if self.serve.trace_path:
             out["trace_path"] = self.serve.trace_path
         if self.serve.scrape_csv:
@@ -350,13 +443,23 @@ def serve_flagship(
     window: int = 32,
     slots_per_tick: int = 4,
     max_chunks: Optional[int] = None,
+    rotate_every: int = 0,
+    sessions: int = 0,
+    resubmit_rate: float = 0.0,
+    reconfig: bool = False,
 ) -> dict:
     """A bounded serve run of the flagship MultiPaxos backend — the CLI
     + smoke entry point. ``rate_x`` shapes the workload at that
     multiple of the config's nominal per-lane admission rate (enabling
     the queue-wait histograms the SLO engine reads); ``slo_p99`` arms
-    the SLO engine + admission control plane."""
+    the SLO engine + admission control plane; ``rotate_every`` /
+    ``sessions`` / ``reconfig`` engage the production-lifecycle legs
+    (tpu/lifecycle.py) — window rotation keeps an unbounded run in a
+    constant slot horizon, the session table answers duplicate
+    re-submissions from cache, and ``reconfig`` arms the traced
+    membership axis the ``reconfigure``/``swap_acceptor`` verbs steer."""
     from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
 
     kw: dict = {}
     if rate_x is not None:
@@ -364,6 +467,16 @@ def serve_flagship(
             arrival="constant",
             rate=rate_x * slots_per_tick,
             backlog_cap=256,
+        )
+    if rotate_every or sessions or resubmit_rate or reconfig:
+        # resubmit_rate included so a lone --resubmit-rate reaches
+        # LifecyclePlan.validate and fails LOUDLY (it needs sessions)
+        # instead of being silently dropped.
+        kw["lifecycle"] = LifecyclePlan(
+            rotate_every=rotate_every,
+            sessions=sessions,
+            resubmit_rate=resubmit_rate,
+            reconfig=reconfig,
         )
     cfg = mp.BatchedMultiPaxosConfig(
         f=1, num_groups=num_groups, window=window,
@@ -405,6 +518,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--rate-x", type=float, default=None)
     p.add_argument("--slo-p99", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rotate-every", type=int, default=0,
+                   help="window-rotation quantum in slots (multiple of "
+                   "the window; 0 = off)")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="client session-table sessions per group")
+    p.add_argument("--resubmit-rate", type=float, default=0.0)
+    p.add_argument("--reconfig", action="store_true",
+                   help="arm the traced acceptor-membership axis")
     args = p.parse_args(argv)
     report = serve_flagship(
         seconds=args.seconds,
@@ -415,6 +536,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         rate_x=args.rate_x,
         slo_p99=args.slo_p99,
         seed=args.seed,
+        rotate_every=args.rotate_every,
+        sessions=args.sessions,
+        resubmit_rate=args.resubmit_rate,
+        reconfig=args.reconfig,
     )
     print(json.dumps(report))
     return 0 if report["clean_shutdown"] else 1

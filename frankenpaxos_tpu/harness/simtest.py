@@ -62,7 +62,9 @@ from frankenpaxos_tpu.tpu import (
     unreplicated_batched,
     vanillamencius_batched,
 )
+from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan
 
 # Segment grid: schedule boundaries (partition start/heal) snap to
@@ -92,6 +94,10 @@ class SimSpec:
     # The backend's analysis config has a device read path, so
     # random_workload may draw a read/write mix for it.
     read_mix_ok: bool = False
+    # The backend threads the production-lifecycle subsystem
+    # (tpu/lifecycle.py), so the reconfiguration-epoch axis
+    # (run_reconfig_schedule / random_lifecycle) applies.
+    lifecycle_ok: bool = False
 
 
 def _specs() -> Dict[str, SimSpec]:
@@ -114,6 +120,7 @@ def _specs() -> Dict[str, SimSpec]:
             "multipaxos", mp,
             mp.analysis_config,
             lambda st: st.committed, partition_axis=3,
+            lifecycle_ok=True,
         ),
         SimSpec(
             "mencius", me,
@@ -209,7 +216,7 @@ def _specs() -> Dict[str, SimSpec]:
             "compartmentalized", cz,
             cz.analysis_config,
             lambda st: st.committed + st.reads_done, partition_axis=4,
-            read_mix_ok=True,
+            read_mix_ok=True, lifecycle_ok=True,
         ),
     ]
     return {s.name: s for s in entries}
@@ -304,6 +311,44 @@ def random_workload(
     return WorkloadPlan(**kw)
 
 
+def random_lifecycle(
+    rng: _random.Random, spec: SimSpec, horizon: int
+) -> LifecyclePlan:
+    """One randomized lifecycle shape for a lifecycle-threaded backend
+    (deterministic from ``rng``): the reconfiguration axis is always
+    armed (it is what :func:`run_reconfig_schedule` churns), window
+    rotation and the session table ride along ~half the time. Rotation
+    quanta are sized against the HORIZON (the analysis configs retire
+    roughly a slot per lane-tick, align 16), so a drawn rotation leg
+    actually fires within the schedule instead of being dead weight."""
+    if not spec.lifecycle_ok:
+        return LifecyclePlan.none()
+    kw: dict = {"reconfig": True}
+    if rng.random() < 0.7 and horizon >= 80:
+        kw["rotate_every"] = 16 * rng.randint(
+            1, max(1, min(4, horizon // 80))
+        )
+    if rng.random() < 0.5:
+        kw["sessions"] = rng.choice([2, 4, 8])
+        kw["resubmit_rate"] = round(rng.uniform(0.05, 0.3), 3)
+    return LifecyclePlan(**kw)
+
+
+def _random_membership(rng: _random.Random, shape):
+    """A SAFE random membership mask over the backend's acceptor axis:
+    cut one acceptor row of a [A, G] flagship mask (a strict minority
+    at f=1) or one cell of a [R, C, G] grid mask (every row keeps a
+    live cell), so quorums can still form and liveness is recoverable."""
+    import numpy as np
+
+    m = np.ones(shape, bool)
+    if len(shape) == 2:
+        m[rng.randrange(shape[0])] = False
+    else:
+        m[rng.randrange(shape[0]), rng.randrange(shape[1])] = False
+    return m
+
+
 # ---------------------------------------------------------------------------
 # Running schedules
 # ---------------------------------------------------------------------------
@@ -371,6 +416,97 @@ def run_schedule(
         "progress": progress,
         "plan": plan.to_dict(),
         "workload": workload.to_dict(),
+        "seed": seed,
+        "ticks": ticks,
+    }
+
+
+def run_reconfig_schedule(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    ticks: int = 4 * SEGMENT,
+    segment: int = SEGMENT,
+    workload: WorkloadPlan = WorkloadPlan.none(),
+    lifecycle: Optional[LifecyclePlan] = None,
+    epoch_seed: int = 0,
+) -> dict:
+    """The reconfiguration-epoch axis of simulation testing: one
+    (fault plan, seed) schedule run in segments with RANDOMIZED
+    membership churn at the segment boundaries — the serve control
+    plane's ``set_membership`` verb driven by a deterministic rng, so
+    traced epoch switches interleave the crash/partition schedule
+    in-graph. Invariants check at every boundary; before the FINAL
+    segment full membership is restored (the heal), and the schedule
+    passes only if progress strictly resumes across that recovery
+    segment — liveness-after-heal under [faults x epochs] churn.
+
+    The compiled program never changes across epochs: every segment of
+    a given length reuses ONE jitted ``_run_segment`` (membership and
+    epoch are traced state), which is itself the recompile-free
+    contract the ``trace-lifecycle-retrace`` rule pins."""
+    assert spec.lifecycle_ok, spec.name
+    lifecycle = lifecycle if lifecycle is not None else LifecyclePlan(
+        reconfig=True
+    )
+    assert lifecycle.reconfig
+    mod = spec.module
+    cfg = spec.make_config(plan, workload=workload, lifecycle=lifecycle)
+    state = mod.init_state(cfg)
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    rng = _random.Random(epoch_seed * 7919 + seed)
+    mask_shape = state.lifecycle.acc_mask.shape
+    violations: Dict[str, int] = {}
+    progress: List[int] = []
+    epochs = 0
+    done = 0
+    while done < ticks:
+        n = min(segment, ticks - done)
+        state, t = _run_segment(
+            mod, cfg, state, t, jnp.int32(done), n, key
+        )
+        done += n
+        inv = mod.check_invariants(cfg, state, t)
+        for k, v in inv.items():
+            if not bool(v):
+                violations.setdefault(k, done)
+        progress.append(int(spec.progress(state)))
+        remaining = ticks - done
+        if remaining > segment and rng.random() < 0.6:
+            # Churn: swap one acceptor/cell out, or restore everyone.
+            mask = (
+                _random_membership(rng, mask_shape)
+                if rng.random() < 0.6
+                else True
+            )
+            state = dataclasses.replace(
+                state,
+                lifecycle=lifecycle_mod.set_membership(
+                    state.lifecycle, mask
+                ),
+            )
+            epochs += 1
+        elif 0 < remaining <= segment:
+            # The heal before the recovery segment: full membership.
+            state = dataclasses.replace(
+                state,
+                lifecycle=lifecycle_mod.set_membership(
+                    state.lifecycle, True
+                ),
+            )
+            epochs += 1
+    resumed = len(progress) >= 2 and progress[-1] > progress[-2]
+    return {
+        "backend": spec.name,
+        "ok": not violations and resumed,
+        "violations": violations,
+        "progress": progress,
+        "epochs": epochs,
+        "resumed": resumed,
+        "plan": plan.to_dict(),
+        "workload": workload.to_dict(),
+        "lifecycle": lifecycle.to_dict(),
         "seed": seed,
         "ticks": ticks,
     }

@@ -269,8 +269,12 @@ register_sharding(
             # broadcasts the spec over the nested pytree's leaves. The
             # workload shaping state replicates the same way (all-empty
             # under WorkloadPlan.none(); tiny [G]-sized bookkeeping
-            # otherwise).
-            "telemetry", "workload",
+            # otherwise), as does the lifecycle state (all-empty under
+            # LifecyclePlan.none(); rotation scalars + the [G, S]
+            # session table + the [A, G] membership mask otherwise —
+            # the rotation predicate's min-head reduction is the only
+            # cross-device traffic it adds, a scalar).
+            "telemetry", "workload", "lifecycle",
         }),
         axis_pos={
             name: 1
@@ -328,7 +332,7 @@ register_sharding(
             "bat_shed", "committed", "batches_committed", "retired",
             "writes_done", "lat_sum", "lat_hist", "reads_done",
             "reads_shed", "read_lat_sum", "read_lat_hist", "telemetry",
-            "workload",
+            "workload", "lifecycle",
         }),
         axis_pos={
             **{name: 2 for name in ("p2a_arrival", "p2b_arrival")},

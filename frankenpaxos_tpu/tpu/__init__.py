@@ -29,6 +29,7 @@ from frankenpaxos_tpu.tpu.compartmentalized_batched import (
     BatchedCompartmentalizedState,
 )
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan
 from frankenpaxos_tpu.tpu.caspaxos_batched import (
     BatchedCasPaxosConfig,
@@ -84,6 +85,7 @@ __all__ = [
     "BatchedMultiPaxosConfig",
     "BatchedMultiPaxosState",
     "FaultPlan",
+    "LifecyclePlan",
     "WorkloadPlan",
     "TpuSimTransport",
     "check_invariants",

@@ -85,8 +85,11 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan, LifecycleState
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
@@ -145,6 +148,16 @@ class BatchedCompartmentalizedConfig:
     # batch, else a partial batch deadlocks the window).
     # WorkloadPlan.none() = saturation.
     workload: WorkloadPlan = WorkloadPlan.none()
+    # Production-lifecycle subsystem (tpu/lifecycle.py): watermark-
+    # driven window rotation (the batch-slot numbering rebases once
+    # every replica's executed watermark clears the quantum — serve
+    # runs of unbounded duration in a constant int32 horizon), the
+    # exactly-once client session table, and the traced grid-cell
+    # membership epoch axis (the serve control plane swaps a crashed
+    # acceptor cell mid-run with zero recompiles; ballot-free grid
+    # handoff — the full-grid retry timers re-form quorums on the new
+    # membership). LifecyclePlan.none() is a structural no-op.
+    lifecycle: LifecyclePlan = LifecyclePlan.none()
 
     @property
     def acceptors_per_group(self) -> int:
@@ -153,6 +166,15 @@ class BatchedCompartmentalizedConfig:
     @property
     def num_acceptors(self) -> int:
         return self.num_groups * self.acceptors_per_group
+
+    @property
+    def rotation_alignment(self) -> int:
+        """Smallest rotation shift that is an EXACT renumbering: the
+        lcm of every slot-mod role assignment — ring positions (mod W),
+        proxy-leader ownership (mod P), and unbatcher fan-out (mod U)."""
+        return lifecycle_mod.alignment(
+            self.window, self.num_proxy_leaders, self.num_unbatchers
+        )
 
     def __post_init__(self):
         assert self.num_groups >= 1
@@ -175,6 +197,7 @@ class BatchedCompartmentalizedConfig:
             assert self.read_window == 0
         self.faults.validate(axis=self.acceptors_per_group)
         self.workload.validate(reads_supported=self.read_rate > 0)
+        self.lifecycle.validate(align=self.rotation_alignment)
         if self.workload.closed:
             assert self.workload.closed_window >= self.batch_size, (
                 "compartmentalized closed loop needs closed_window >= "
@@ -239,6 +262,10 @@ class BatchedCompartmentalizedState:
     read_lat_sum: jnp.ndarray  # [] read-weighted latency sum
     read_lat_hist: jnp.ndarray  # [LAT_BINS] read latency histogram
     workload: WorkloadState  # shaping state (tpu/workload.py)
+    # Production-lifecycle state (tpu/lifecycle.py: rotation counters,
+    # the [G, S] session table, the traced [R, C, G] grid membership
+    # mask + epoch; all-empty under LifecyclePlan.none()).
+    lifecycle: LifecycleState
 
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
@@ -286,6 +313,9 @@ def init_state(
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         workload=workload_mod.make_state(
             cfg.workload, cfg.num_groups, cfg.faults
+        ),
+        lifecycle=lifecycle_mod.make_state(
+            cfg.lifecycle, G, acceptor_shape=(R, C, G)
         ),
         telemetry=make_telemetry(),
     )
@@ -419,6 +449,37 @@ def tick(
     )
     fill = jnp.where(can_emit, fill - BS, fill)
 
+    # 2.5 Traced grid-cell reconfiguration (tpu/lifecycle.py): the
+    # membership mask + epoch live in state, steered by the serve
+    # control plane with zero recompiles. This backend's handoff is
+    # BALLOT-FREE (the grid has no rounds): on an epoch switch,
+    # departed cells' pending Phase2as clear every tick (they never
+    # receive again — the mask also gates the new-send/retry planes)
+    # and their in-flight votes on UNCHOSEN slots drop, so the
+    # full-grid retry timers re-form each quorum on the live cells —
+    # the visible commit dip-and-recover. Chosen slots keep their
+    # old-epoch vote records until retirement (quorum certificates
+    # stay intact); the old epoch GCs behind the lifecycle watermark.
+    lc = cfg.lifecycle
+    lcs = state.lifecycle
+    p2a_state = state.p2a_arrival
+    p2b_state = state.p2b_arrival
+    cell_mask = None
+    if lc.reconfig:
+        lc_switch = lifecycle_mod.reconfig_switch(lc, lcs)
+        lcs = lifecycle_mod.reconfig_applied(
+            lc, lcs, lc_switch, state.next_slot, state.head
+        )
+        cell_mask = lcs.acc_mask  # [R, C, G], post-switch
+        not_member = ~cell_mask[:, :, :, None]
+        p2a_state = jnp.where(not_member, INF16, p2a_state)
+        p2b_state = jnp.where(
+            lc_switch & not_member & (state.status != CHOSEN)[None, None],
+            INF16,
+            p2b_state,
+        )
+        retry_del = retry_del & cell_mask[:, :, :, None]
+
     # 3-5 + 9. The acceptor-grid HOT PATH as one registry plane
     # (ops/compartmentalized.py `compartmentalized_grid_vote`): aging
     # of the grid + commit-broadcast clocks, acceptor votes on Phase2a
@@ -450,8 +511,8 @@ def tick(
     ) = ops_registry.dispatch(
         "compartmentalized_grid_vote",
         cfg,
-        state.p2a_arrival,
-        state.p2b_arrival,
+        p2a_state,
+        p2b_state,
         state.rep_arrival,
         state.status,
         state.last_send,
@@ -553,6 +614,11 @@ def tick(
         == q_col[:, None, :, :]
     )  # [R, C, G, W]
     send = (is_new & alive_of_pos)[None, None] & in_quorum
+    if cell_mask is not None:
+        # Membership gating: fresh Phase2as reach live cells only. A
+        # transversal that sampled a departed cell leaves its row
+        # unvoted until the full-grid retry re-forms the quorum.
+        send = send & cell_mask[:, :, :, None]
     p2a_arrival = jnp.where(
         send & p2a_del, p2a_lat.astype(p2a_arrival.dtype), p2a_arrival
     )
@@ -687,6 +753,26 @@ def tick(
         )
         probes_sent = C * jnp.sum(form)
 
+    # 10.5 Production lifecycle (tpu/lifecycle.py). Session table:
+    # this tick's client-counted committed ENTRIES (batches x BS — the
+    # same quantity the workload engine's finish() receives) record
+    # into the [G, S] table; duplicate re-submissions answer from the
+    # cache on a disjoint PRNG stream, never entering the batcher
+    # plane. Rotation: the shift is computed here (post-retirement
+    # head) so the telemetry row records it and the span sampler stays
+    # on the pre-roll base; the slot planes rebase at tick end.
+    if lc.has_sessions:
+        lcs = lifecycle_mod.sessions_step(
+            lc, lcs, key, t, BS * jnp.sum(newly_chosen, axis=1)
+        )
+    lc_shift = None
+    lc_base = 0
+    if lc.compaction:
+        lc_base = lcs.rot_base
+        lc_shift, lcs = lifecycle_mod.rotation_shift(
+            lc, lcs, jnp.min(head), cfg.rotation_alignment
+        )
+
     # 11. Telemetry (tpu/telemetry.py): counters the tick already
     # computed for its own bookkeeping (the grid-vote plane's [G, W]
     # vote counts stand in for the [R, C, G, W] vote mask it fused).
@@ -704,10 +790,92 @@ def tick(
         executes=BS * jnp.sum(n_retire),
         drops=drops,
         retries=jnp.sum(timed_out),
+        rotations=(
+            (lc_shift > 0).astype(jnp.int32)
+            if lc_shift is not None
+            else 0
+        ),
         queue_depth=jnp.sum(next_slot - head) + jnp.sum(pending),
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+
+    # 11.5 Span sampler (telemetry.record_spans): per-slot lifecycle
+    # tick-stamps through the proxy-leader/grid/replica planes,
+    # recorded from the masks this tick already computed (is_new /
+    # grid votes / newly_chosen / retire). A traced-epoch switch marks
+    # phase1 on every live span, so reconfiguration pauses are visible
+    # in the Perfetto trace. Structurally OFF at spans=0 (the serve
+    # loop sizes the reservoir).
+    if telemetry_mod.span_slots(tel):
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=is_new,
+            # Per-group batch-slot number at each ring position (OLD
+            # head + ordinal); under rotation the pre-roll base makes
+            # the numbering absolute, stable across rolls.
+            slot_ids=(
+                lc_base + state.head[:, None] + ord_of_pos
+                if lc.compaction
+                else state.head[:, None] + ord_of_pos
+            ),
+            # Cells sequenced THIS tick: OLD next_slot + ordinal (a
+            # cell can retire and be re-sequenced in one tick).
+            new_slot_ids=(
+                lc_base
+                + state.next_slot[:, None]
+                + jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
+                if lc.compaction
+                else state.next_slot[:, None]
+                + jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
+            ),
+            phase1_mark=(
+                jnp.broadcast_to(lc_switch, (G,))
+                if lc.reconfig
+                else jnp.zeros((G,), bool)
+            ),
+            # A grid vote is visible once any cell's Phase2b arrived.
+            voted=jnp.any(p2b_arrival <= 0, axis=(0, 1)),
+            newly_chosen=newly_chosen,
+            retire_mask=retire,
+        )
+
+    # 12. Window rotation rebase (tpu/lifecycle.py): when this tick's
+    # shift fired, every absolute batch-slot number rebases in place —
+    # ring positions (mod W), proxy ownership (mod P), and unbatcher
+    # fan-out (mod U) are invariant under the aligned shift, and the
+    # offset clocks are already relative. Absent at trace time under
+    # LifecyclePlan.none().
+    if lc.compaction:
+
+        def _rebase(args):
+            hd, ns, re_, rb, lgw = args
+            return (
+                lifecycle_mod.shift_counts(hd, lc_shift),
+                lifecycle_mod.shift_counts(ns, lc_shift),
+                lifecycle_mod.shift_counts(re_, lc_shift),
+                # floor=0: a probe deferred across the roll (partition)
+                # can hold a bound below the rotation threshold —
+                # already satisfied by every watermark, so the clamp
+                # is behavior-preserving.
+                lifecycle_mod.shift_ids(rb, lc_shift, floor=0),
+                lifecycle_mod.shift_ids(lgw, lc_shift),
+            )
+
+        # lax.cond: rebase sweeps only on the tick the roll fires.
+        head, next_slot, rep_exec, rd_bound, lc_gcw = jax.lax.cond(
+            lc_shift > 0,
+            _rebase,
+            lambda args: args,
+            (
+                head, next_slot, rep_exec, rd_bound,
+                lcs.gc_watermark if lc.reconfig
+                else jnp.zeros((0,), jnp.int32),
+            ),
+        )
+        if lc.reconfig:
+            lcs = dataclasses.replace(lcs, gc_watermark=lc_gcw)
 
     return BatchedCompartmentalizedState(
         bat_fill=fill,
@@ -743,6 +911,7 @@ def tick(
         read_lat_sum=read_lat_sum,
         read_lat_hist=read_lat_hist,
         workload=wls,
+        lifecycle=lcs,
         telemetry=tel,
     )
 
@@ -804,6 +973,19 @@ def check_invariants(
             (state.bat_fill >= 0) & (state.bat_fill <= 2 * cfg.batch_size)
         )
         & jnp.all(state.pending >= 0),
+        # Lifecycle books: session ids conserved against completion
+        # counts (and against the workload engine's totals when both
+        # are active), rotation counters monotone, reconfiguration GC
+        # armed (tpu/lifecycle.py).
+        "lifecycle_ok": lifecycle_mod.invariants_ok(
+            cfg.lifecycle,
+            state.lifecycle,
+            workload_completed=(
+                state.workload.completed
+                if cfg.lifecycle.has_sessions and cfg.workload.active
+                else None
+            ),
+        ),
     }
     if cfg.read_window:
         occupied = state.rd_issue < INF
@@ -865,6 +1047,7 @@ def stats(cfg, state, t) -> dict:
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
     workload: WorkloadPlan = WorkloadPlan.none(),
+    lifecycle: LifecyclePlan = LifecyclePlan.none(),
 ) -> BatchedCompartmentalizedConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -878,4 +1061,5 @@ def analysis_config(
         num_batchers=2, num_unbatchers=2, num_replicas=3, window=16,
         batch_size=2, arrivals_per_tick=1, retry_timeout=8,
         read_rate=2, read_window=6, faults=faults, workload=workload,
+        lifecycle=lifecycle,
     )

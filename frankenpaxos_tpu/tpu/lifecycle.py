@@ -1,0 +1,617 @@
+"""Production-lifecycle subsystem: in-graph log compaction, exactly-once
+client session tables, and traced acceptor reconfiguration — the layer
+that lets a serve-mode run of a batched backend run for UNBOUNDED
+durations (the ROADMAP "production lifecycle" item; the reference's
+protocol-agnostic ``compact/`` and ``clienttable/`` libraries and the
+matchmakermultipaxos online-reconfiguration protocol, rebuilt TPU-first
+as one plan object).
+
+Every backend's slot ring already recycles ring POSITIONS (position =
+slot mod W), so device memory is constant by construction — what is
+bounded is the NUMBERING horizon: absolute per-group slot numbers
+(``head``/``next_slot``), the global read-path numbering ``slot*G + g``,
+and the command-id space all live in int32 and a long-lived serve loop
+marches them toward the ``slot_horizon_ok`` wall, where the backend
+fails loudly rather than silently mis-ordering. The three legs of
+:class:`LifecyclePlan` close that and the two other open lifecycle
+gaps, all INSIDE the compiled tick:
+
+  * **Watermark-driven window rotation** (``rotate_every > 0``) — when
+    every replica's executed watermark (the minimum group head) clears
+    the threshold, every absolute slot number and slot-derived command
+    id REBASES down by a multiple of the backend's alignment quantum
+    (a masked subtract over the slot planes, in place: the batched
+    analog of ``compact/`` garbage-collecting the retired log prefix).
+    Ring positions are slot mod W and every role assignment is slot mod
+    {W, NC, P, U}, so a shift that is a multiple of the backend's
+    alignment (:meth:`LifecyclePlan.validate` ``align=``) is an EXACT
+    renumbering: the rotated run replays the unrotated run bit for bit
+    modulo the shift (pinned by ``tests/test_lifecycle.py``
+    rotation-exactness), the log is logically infinite in constant
+    int32 horizon, and offset clocks — already head-relative — never
+    move. A rotation counter feeds the telemetry ring's ``rotations``
+    column, and the span sampler's slot ids stay stable across rolls
+    because backends stamp spans with ``rot_base``-absolute numbering.
+    (Two caveats. First, inherited from the read path's AMS_FLOOR
+    saturation: an acceptor whose last vote is >2^14 retired slots
+    stale reconstructs its MaxSlot differently across a roll — the
+    same approximation class the saturation floor already accepts.
+    Second, the PROTOCOL state is horizon-free but the cumulative
+    BOOKKEEPING is not: ``rot_base`` (total rebased slots), the
+    rot_base-absolute span ids, and the session-table completion ids
+    are int32 accumulators like ``committed`` and the telemetry
+    totals, so they wrap mod 2^32 after ~2^31 retired slots — the
+    exported numbering aliases there while the rebased protocol state
+    stays exact, the same accepted-wrap contract the dtype policy
+    documents for every other cumulative counter.)
+
+  * **Client session table** (``sessions > 0``) — a ``[L, S]`` per-lane
+    table of ``(last_command_id, cached_result)`` (the batched
+    ``clienttable/``), recording every client-visible completion:
+    per-lane completion ``i`` is command id ``i`` owned by session
+    ``i mod S``, and the table keeps each session's LARGEST completed
+    id plus its cached result (the completion tick). Duplicate
+    submissions — a client re-sending an op whose reply was lost,
+    drawn per lane at ``resubmit_rate`` from the lifecycle PRNG stream
+    — are answered FROM THE CACHE without re-proposing: they never
+    enter the admission path, so the protocol history is bit-identical
+    to the resubmit-free twin (exactly-once by construction, not by
+    filtering), and the workload engine's conservation invariant
+    (``workload_ok``) still holds exactly — when both subsystems are
+    active the table's completion totals reconcile against
+    ``WorkloadState.completed`` one for one. This composes with (not
+    replaces) the two lower dedup layers: ``FaultPlan.dup_rate``'s
+    eager message duplicates (receivers dedup by arrival-clock
+    min-write) and the flagship ``state_machine="kv"`` client table
+    (re-ISSUED ids filtered at execution).
+
+  * **Traced acceptor reconfiguration** (``reconfig=True``) — the
+    acceptor membership mask and its epoch live in STATE, like the
+    workload engine's traced rate: the serve control plane swaps a
+    crashed acceptor, or grows/shrinks the live set, between chunks
+    with ZERO recompiles (:func:`set_membership` bumps the traced
+    epoch; the jit cache stays flat — pinned by the
+    ``trace-lifecycle-retrace`` analysis rule). Inside the tick an
+    epoch switch is the matchmaker i/i+1 handoff collapsed to one
+    tick: the flagship bumps the round and re-promises via the
+    existing ``multipaxos_p1_promise`` kernel plane (an oracle
+    all-acceptor read, a superset of any f+1 read quorum), in-flight
+    votes clear and re-propose to the new membership, and OLD-EPOCH GC
+    clears pending traffic to departed acceptors immediately while the
+    epoch's in-flight slots drain behind a GC watermark (the
+    Reconfigurer pipeline). Departed acceptors never receive another
+    message (the mask gates the Phase2a/retry sends); chosen slots
+    keep their old-epoch vote records until they retire, so quorum
+    certificates stay intact.
+
+``LifecyclePlan.none()`` (the default on every lifecycle-threaded
+config) is a STRUCTURAL no-op: every :class:`LifecycleState` leaf is
+zero-sized, no tick equation consumes them, no PRNG key is ever
+derived — XLA emits the exact pre-lifecycle program and default runs
+stay bit-identical to the pre-PR goldens (pinned by
+``tests/test_lifecycle.py``; the ``lifecycle-noop`` analysis rule pins
+the structure, mirroring ``trace-workload-noop``).
+
+Determinism contract: all lifecycle randomness derives from the tick's
+own threefry key via ``fold_in`` with :data:`LIFECYCLE_SALT`, disjoint
+from the fault (0x5EED) and workload (0x10AD) streams — which is what
+makes the exactly-once test EXACT: a resubmitting run's protocol
+history equals the resubmit-free twin's bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import bit_delivered
+
+# Stream id folded into a tick's key before drawing any lifecycle
+# randomness (the session-table resubmission draw). Distinct from
+# faults.FAULT_SALT and workload.WORKLOAD_SALT.
+LIFECYCLE_SALT = 0x11FE
+
+
+@dataclasses.dataclass(frozen=True)
+class LifecyclePlan:
+    """One production-lifecycle shape. Frozen + hashable: lives inside
+    the static backend config (a ``jax.jit`` static argument), exactly
+    like :class:`~frankenpaxos_tpu.tpu.faults.FaultPlan` and
+    :class:`~frankenpaxos_tpu.tpu.workload.WorkloadPlan`. The plan
+    fixes STRUCTURE (rotation quantum, table geometry, whether the
+    membership axis exists); the sweepable/steerable quantities —
+    membership, epoch, the force-rotation latch — are traced state
+    (:class:`LifecycleState`), so the serve control plane steers them
+    with zero recompiles."""
+
+    # Window rotation: rebase the slot numbering once every group's
+    # executed watermark (head) clears this many slots. 0 = off. Must
+    # be a positive multiple of the backend's alignment quantum (the
+    # lcm of every "slot mod k" role assignment — ``validate(align=)``).
+    rotate_every: int = 0
+    # Client session table: sessions per lane (0 = off) and the
+    # per-lane per-tick probability that a client re-submits its most
+    # recent completed command (reply-loss model; the duplicate is
+    # answered from the cache, never re-proposed).
+    sessions: int = 0
+    resubmit_rate: float = 0.0
+    # Traced acceptor reconfiguration: carry a traced membership mask +
+    # epoch over the backend's acceptor axis. False = the axis does not
+    # exist (no mask gating, no epoch compare — the pre-plan program).
+    reconfig: bool = False
+
+    # -- structural predicates (all trace-time Python bools) ------------
+
+    @property
+    def compaction(self) -> bool:
+        return self.rotate_every > 0
+
+    @property
+    def has_sessions(self) -> bool:
+        return self.sessions > 0
+
+    @property
+    def active(self) -> bool:
+        return self.compaction or self.has_sessions or self.reconfig
+
+    @classmethod
+    def none(cls) -> "LifecyclePlan":
+        """The structural no-op plan: every helper compiles to the
+        identity, every state leaf is zero-sized, and XLA emits the
+        exact pre-lifecycle program."""
+        return cls()
+
+    def validate(self, align: int = 1) -> None:
+        """Config-time validation; every lifecycle-threaded backend's
+        ``__post_init__`` calls this with its alignment quantum
+        ``align`` (the lcm of every modulus its tick applies to
+        absolute slot numbers/ids — ring width, client round-robin,
+        proxy/unbatcher assignment). A rotation shift that is a
+        multiple of ``align`` is an exact renumbering; anything else
+        would silently remap roles mid-run."""
+        assert self.rotate_every >= 0
+        if self.compaction:
+            assert align >= 1
+            assert self.rotate_every % align == 0, (
+                f"lifecycle.rotate_every={self.rotate_every} must be a "
+                f"multiple of this backend's rotation alignment "
+                f"({align}: the lcm of its slot-mod role assignments)"
+            )
+        assert self.sessions >= 0
+        assert 0.0 <= self.resubmit_rate < 1.0
+        if self.resubmit_rate > 0.0:
+            assert self.has_sessions, (
+                "lifecycle.resubmit_rate needs sessions > 0 (the cache "
+                "that answers the duplicate)"
+            )
+
+    # -- serialization (one schema with the fault/workload plans) --------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LifecyclePlan":
+        return cls(**d)
+
+
+def alignment(*moduli: int) -> int:
+    """The rotation alignment quantum: the lcm of every ``slot mod k``
+    role assignment a backend's tick applies to absolute slot numbers.
+    Backends compute this once in ``__post_init__`` and pass it to
+    :meth:`LifecyclePlan.validate`."""
+    out = 1
+    for m in moduli:
+        if m and m > 1:
+            out = math.lcm(out, m)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# State
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LifecycleState:
+    """Device-resident lifecycle state, carried in a lifecycle-threaded
+    backend's ``*State`` (lane axis L = the backend's proposer axis,
+    matching the workload engine's). Every leaf is ZERO-SIZED for the
+    legs a plan leaves off — a ``LifecyclePlan.none()`` state is
+    all-empty, adds zero ops, and keeps the scan carry bit-identical to
+    the pre-lifecycle program. Counters are int32 (the dtype policy's
+    accumulator width); masks are bool."""
+
+    # Window rotation (compaction).
+    rot_count: jnp.ndarray  # [] rotations fired (cumulative) | [0]
+    rot_base: jnp.ndarray  # [] cumulative rebased slots (absolute base) | [0]
+    rot_force: jnp.ndarray  # [] host-latched force-rotation request | [0]
+    # Client session table (sessions > 0). S = plan.sessions.
+    sess_total: jnp.ndarray  # [L] client-visible completions per lane | [0]
+    sess_last: jnp.ndarray  # [L, S] largest completed id per session (-1)
+    sess_res: jnp.ndarray  # [L, S] cached result (completion tick; -1)
+    resubmits: jnp.ndarray  # [] duplicate submissions drawn | [0]
+    cache_hits: jnp.ndarray  # [] duplicates answered from the cache | [0]
+    # Traced acceptor reconfiguration (reconfig=True).
+    epoch: jnp.ndarray  # [] target epoch (host-bumped, traced) | [0]
+    applied: jnp.ndarray  # [] epoch the tick has applied | [0]
+    acc_mask: jnp.ndarray  # [acceptor axis...] live membership | [0]
+    next_mask: jnp.ndarray  # [acceptor axis...] target membership | [0]
+    gc_watermark: jnp.ndarray  # [L] old epoch retired once head >= | [0]
+    old_live: jnp.ndarray  # [L] old epoch not yet GCd | [0]
+    epochs_gcd: jnp.ndarray  # [] per-lane old-epoch GCs (cumulative) | [0]
+
+
+def make_state(
+    plan: LifecyclePlan,
+    lanes: int,
+    acceptor_shape: Tuple[int, ...] = (),
+) -> LifecycleState:
+    """The backend's lifecycle state. ``acceptor_shape`` is the shape
+    of the backend's acceptor membership axis (e.g. ``(A, G)`` for the
+    flagship, ``(R, C, G)`` for the compartmentalized grid); only read
+    when ``plan.reconfig``. Leaves for disabled legs are zero-sized so
+    the none plan carries nothing."""
+    z32 = jnp.int32
+    scalar_rot = () if plan.compaction else (0,)
+    Ls = lanes if plan.has_sessions else 0
+    S = plan.sessions if plan.has_sessions else 0
+    scalar_sess = () if plan.has_sessions else (0,)
+    scalar_rc = () if plan.reconfig else (0,)
+    mask_shape = acceptor_shape if plan.reconfig else (0,)
+    Lr = lanes if plan.reconfig else 0
+    if plan.reconfig:
+        assert acceptor_shape, (
+            "LifecyclePlan(reconfig=True) needs the backend's acceptor "
+            "axis shape (init_state must pass acceptor_shape=)"
+        )
+    return LifecycleState(
+        rot_count=jnp.zeros(scalar_rot, z32),
+        rot_base=jnp.zeros(scalar_rot, z32),
+        rot_force=jnp.zeros(scalar_rot, z32),
+        sess_total=jnp.zeros((Ls,), z32),
+        sess_last=jnp.full((Ls, S), -1, z32),
+        sess_res=jnp.full((Ls, S), -1, z32),
+        resubmits=jnp.zeros(scalar_sess, z32),
+        cache_hits=jnp.zeros(scalar_sess, z32),
+        epoch=jnp.zeros(scalar_rc, z32),
+        applied=jnp.zeros(scalar_rc, z32),
+        acc_mask=jnp.ones(mask_shape, bool),
+        next_mask=jnp.ones(mask_shape, bool),
+        gc_watermark=jnp.full((Lr,), -1, z32),
+        old_live=jnp.zeros((Lr,), bool),
+        epochs_gcd=jnp.zeros(scalar_rc, z32),
+    )
+
+
+def lifecycle_key(key: jnp.ndarray) -> jnp.ndarray:
+    """The per-tick lifecycle stream. Callers must only derive this
+    when the session leg draws (resubmit_rate > 0) so every other path
+    touches no keys at all — the disjoint-stream contract that keeps
+    the exactly-once twin comparison bit-exact."""
+    return jax.random.fold_in(key, LIFECYCLE_SALT)
+
+
+# ---------------------------------------------------------------------------
+# Window rotation (compaction). Call order inside a backend's tick:
+#     shift, lcs = rotation_shift(plan, lcs, min_head)     # after planes
+#     ... telemetry record(rotations=(shift > 0)) ...
+#     head = head - shift; ids = shift_ids(ids, shift * G) # rebase
+# ---------------------------------------------------------------------------
+
+
+def rotation_shift(
+    plan: LifecyclePlan,
+    lcs: LifecycleState,
+    min_head,
+    align: int,
+    margin: int = 0,
+) -> Tuple[jnp.ndarray, LifecycleState]:
+    """This tick's rotation shift: a traced scalar multiple of the
+    backend's alignment quantum ``align`` (0 = no rotation), plus the
+    updated counters. Fires when the global executed watermark
+    (``min_head``, the minimum group head AFTER this tick's
+    retirement) clears ``rotate_every`` — or EARLY, when the host
+    latched :func:`request_rotation` (the latch persists until at
+    least one alignment quantum has retired). The roll rebases by the
+    largest whole multiple of ``align`` that keeps ``margin`` retired
+    slots behind the watermark UNROLLED: ``margin`` is the backend's
+    id-staleness bound (for the flagship, W — the furthest back any
+    LIVE id record, e.g. a client's last issued command, can point),
+    so the rebase never drives a live id negative and stays an exact
+    renumbering. Post-roll heads are bounded by margin + align + W."""
+    assert plan.compaction
+    # Whole alignment quanta retired beyond the staleness margin.
+    quanta = jnp.maximum(min_head - margin, 0) // align
+    fire = (min_head >= plan.rotate_every) | (lcs.rot_force > 0)
+    shift = jnp.where(fire & (quanta > 0), quanta * align, 0)
+    fired = (shift > 0).astype(jnp.int32)
+    lcs = dataclasses.replace(
+        lcs,
+        rot_count=lcs.rot_count + fired,
+        rot_base=lcs.rot_base + shift,
+        rot_force=jnp.where(fired > 0, 0, lcs.rot_force),
+    )
+    return shift, lcs
+
+
+def shift_counts(x: jnp.ndarray, shift) -> jnp.ndarray:
+    """Rebase an always-nonnegative absolute-slot field (heads,
+    frontiers, per-replica watermarks) by the rotation shift."""
+    return (x - shift).astype(x.dtype)
+
+
+def shift_ids(x: jnp.ndarray, shift, floor=None) -> jnp.ndarray:
+    """Rebase a slot-derived id/number field that uses negative
+    sentinels (-1 unset, -2 noop): only nonnegative entries move, so
+    sentinels survive the roll. ``floor`` clamps the rebased value —
+    for STALE watermark-style bounds (e.g. a read bound deferred
+    across the roll by a partition): any bound below the rotation
+    threshold is already satisfied by every live watermark, so
+    clamping it to the floor leaves the serve condition's outcome
+    unchanged while keeping the field's nonnegativity invariant."""
+    shifted = x - shift
+    if floor is not None:
+        shifted = jnp.maximum(shifted, floor)
+    return jnp.where(x >= 0, shifted, x).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Client session table
+# ---------------------------------------------------------------------------
+
+
+def sessions_step(
+    plan: LifecyclePlan,
+    lcs: LifecycleState,
+    key: jnp.ndarray,
+    t,
+    completions: jnp.ndarray,
+) -> LifecycleState:
+    """One tick of the session table. ``completions`` is the per-lane
+    count of CLIENT-VISIBLE completions this tick (the same quantity
+    the workload engine's ``finish`` receives — which is what makes the
+    cross-subsystem conservation check exact).
+
+    Two halves, both exact array math (no per-entry loops):
+
+      * resubmissions: per lane, with ``resubmit_rate``, the client
+        whose command completed MOST RECENTLY re-submits it (the
+        reply-was-lost model). Its id is ``sess_total - 1``, which by
+        construction is the table entry of session ``(sess_total-1) %
+        S`` — a guaranteed cache hit once the lane has completed
+        anything. The duplicate is answered from the cache: counted,
+        never admitted, so the protocol planes never see it.
+      * recording: per-lane completion ``i`` (0-based, cumulative) is
+        command id ``i`` owned by session ``i % S``; each session
+        entry keeps the LARGEST id that landed on it this tick (the
+        per-session last-writer over the batch, computed closed-form
+        from the cumulative interval) and caches its result — the
+        completion tick ``t``."""
+    assert plan.has_sessions
+    L, S = lcs.sess_last.shape
+    completions = completions.astype(jnp.int32)
+    resubmits = lcs.resubmits
+    cache_hits = lcs.cache_hits
+    if plan.resubmit_rate > 0.0:
+        bits = jax.random.bits(lifecycle_key(key), (L,))
+        resub = ~bit_delivered(bits, 0, plan.resubmit_rate)  # [L]
+        has_done = lcs.sess_total > 0
+        last_sess = jnp.where(
+            has_done, (lcs.sess_total - 1) % S, 0
+        )  # [L]
+        cached = (
+            jnp.take_along_axis(lcs.sess_last, last_sess[:, None], axis=1)[
+                :, 0
+            ]
+            == lcs.sess_total - 1
+        )
+        hit = resub & has_done & cached
+        resubmits = resubmits + jnp.sum(resub)
+        cache_hits = cache_hits + jnp.sum(hit)
+    # Record this tick's completions: session j's candidate id is the
+    # largest c < after with c % S == j; it lands iff c >= before.
+    before = lcs.sess_total  # [L]
+    after = before + completions
+    j = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    cand = after[:, None] - 1 - jnp.mod(after[:, None] - 1 - j, S)
+    wrote = (cand >= before[:, None]) & (cand >= 0)
+    sess_last = jnp.where(wrote, cand, lcs.sess_last)
+    sess_res = jnp.where(wrote, jnp.asarray(t, jnp.int32), lcs.sess_res)
+    return dataclasses.replace(
+        lcs,
+        sess_total=after,
+        sess_last=sess_last,
+        sess_res=sess_res,
+        resubmits=resubmits,
+        cache_hits=cache_hits,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Traced acceptor reconfiguration
+# ---------------------------------------------------------------------------
+
+
+def reconfig_switch(
+    plan: LifecyclePlan, lcs: LifecycleState
+) -> jnp.ndarray:
+    """Traced scalar bool: a host-requested epoch change is pending
+    this tick. Backends run their i/i+1 handoff (round bump + phase-1
+    re-promise + vote clear + old-epoch GC) under it."""
+    assert plan.reconfig
+    return lcs.epoch != lcs.applied
+
+
+def reconfig_applied(
+    plan: LifecyclePlan,
+    lcs: LifecycleState,
+    switch,
+    next_slot: jnp.ndarray,
+    head: jnp.ndarray,
+) -> LifecycleState:
+    """Commit an epoch switch: install the target membership, arm the
+    old epoch's GC watermark at the allocation frontier (every slot the
+    old membership may have voted on retires before the epoch is
+    collected — the Reconfigurer GC pipeline), and advance the applied
+    epoch. Also runs the per-tick GC check itself (head passing the
+    watermark retires the old epoch), so backends call this once per
+    tick unconditionally when ``plan.reconfig``."""
+    assert plan.reconfig
+    acc_mask = jnp.where(switch, lcs.next_mask, lcs.acc_mask)
+    gc_watermark = jnp.where(switch, next_slot, lcs.gc_watermark)
+    old_live = lcs.old_live | jnp.broadcast_to(switch, lcs.old_live.shape)
+    applied = jnp.where(switch, lcs.epoch, lcs.applied)
+    gc_now = old_live & (head >= gc_watermark)
+    return dataclasses.replace(
+        lcs,
+        acc_mask=acc_mask,
+        gc_watermark=gc_watermark,
+        old_live=old_live & ~gc_now,
+        applied=applied,
+        epochs_gcd=lcs.epochs_gcd + jnp.sum(gc_now),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side control verbs (the serve control plane; zero recompiles).
+# ---------------------------------------------------------------------------
+
+
+def set_membership(lcs: LifecycleState, mask) -> LifecycleState:
+    """The reconfiguration verb: install a new target membership and
+    bump the traced epoch — the next compiled tick runs the i/i+1
+    handoff. ``mask`` broadcasts over the acceptor axis (so a scalar
+    ``True`` restores full membership); membership and epoch are
+    traced state, so the SAME compiled program keeps running (pinned
+    by the ``trace-lifecycle-retrace`` rule)."""
+    assert lcs.acc_mask.ndim >= 1 and lcs.acc_mask.size > 0, (
+        "set_membership needs a LifecyclePlan(reconfig=True) config"
+    )
+    new = jnp.broadcast_to(
+        jnp.asarray(mask, bool), lcs.acc_mask.shape
+    )
+    return dataclasses.replace(
+        lcs, next_mask=new, epoch=lcs.epoch + 1
+    )
+
+
+def swap_acceptor(lcs: LifecycleState, index: int) -> LifecycleState:
+    """Convenience verb: swap the acceptor at ``index`` of a flat
+    ``[A, G]`` acceptor axis out (the crashed node leaves the
+    configuration; re-enable later with ``set_membership(lcs, True)``
+    or a full mask). Only meaningful on a 2-D axis: on a grid-shaped
+    axis (``[R, C, G]``) masking a whole leading ROW would cut every
+    column-transversal write quorum — address a single cell with an
+    explicit :func:`set_membership` mask instead."""
+    assert lcs.acc_mask.ndim == 2, (
+        "swap_acceptor addresses a flat [A, G] acceptor axis; this "
+        f"backend's axis is {lcs.acc_mask.shape} — masking a whole "
+        "leading row would kill every write quorum. Pass an explicit "
+        "single-cell mask to set_membership instead."
+    )
+    mask = jnp.ones(lcs.acc_mask.shape, bool).at[index].set(False)
+    return set_membership(lcs, mask)
+
+
+def request_rotation(lcs: LifecycleState) -> LifecycleState:
+    """The rotation verb: latch a force-rotation request — the next
+    compiled tick rolls the window down to the largest whole quantum
+    the executed watermark has cleared (a no-op until at least one
+    quantum retired; the latch persists until a roll fires)."""
+    assert lcs.rot_force.ndim == 0, (
+        "request_rotation needs a LifecyclePlan(rotate_every > 0) config"
+    )
+    return dataclasses.replace(
+        lcs, rot_force=jnp.ones((), jnp.int32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Invariants + host reporting
+# ---------------------------------------------------------------------------
+
+
+def invariants_ok(
+    plan: LifecyclePlan,
+    lcs: LifecycleState,
+    workload_completed: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Traced scalar bool: the lifecycle bookkeeping is conserved.
+    Session ids never run ahead of the lane's completion count, every
+    cached result is stamped exactly when its id is, duplicates
+    answered never exceed duplicates drawn — and, when the caller also
+    runs the workload engine, the table's completion totals reconcile
+    against ``WorkloadState.completed`` exactly (the extended
+    conservation contract: exactly-once accounting and window
+    conservation are the same books). True (a constant) when the plan
+    is inactive."""
+    ok = jnp.asarray(True)
+    if plan.has_sessions:
+        ok = (
+            ok
+            & jnp.all(lcs.sess_last < lcs.sess_total[:, None])
+            & jnp.all(lcs.sess_last >= -1)
+            & jnp.all((lcs.sess_last >= 0) == (lcs.sess_res >= 0))
+            & (lcs.cache_hits <= lcs.resubmits)
+        )
+        if workload_completed is not None:
+            ok = ok & (jnp.sum(lcs.sess_total) == workload_completed)
+    if plan.compaction:
+        # rot_base is a CUMULATIVE counter (total rebased slots — see
+        # the wrap note in the module docstring), so like every int32
+        # accumulator under the dtype policy it wraps at extreme
+        # horizons; only the wrap-safe half is asserted.
+        ok = ok & (lcs.rot_count >= 0)
+    if plan.reconfig:
+        # epochs_gcd counts PER-LANE collections (lanes drain their
+        # old epoch independently behind their own heads), so it is
+        # bounded by applied switches x lanes.
+        ok = (
+            ok
+            & (lcs.applied <= lcs.epoch)
+            & jnp.all(~lcs.old_live | (lcs.gc_watermark >= 0))
+            & (lcs.epochs_gcd <= lcs.applied * lcs.old_live.shape[0])
+        )
+    return ok
+
+
+def summary(plan: LifecyclePlan, lcs: LifecycleState) -> dict:
+    """Host roll-up of the lifecycle state (one coalesced pull):
+    rotation count/base, session-table totals and cache hits, and the
+    reconfiguration epoch/GC counters."""
+    out = {"active": plan.active}
+    if not plan.active:
+        return out
+    lcs = jax.device_get(lcs)
+    if plan.compaction:
+        out.update(
+            rotations=int(lcs.rot_count),
+            rotated_slots=int(lcs.rot_base),
+            rotate_every=plan.rotate_every,
+        )
+    if plan.has_sessions:
+        import numpy as np
+
+        out.update(
+            sessions=plan.sessions,
+            completions_recorded=int(np.sum(lcs.sess_total)),
+            resubmits=int(lcs.resubmits),
+            cache_hits=int(lcs.cache_hits),
+        )
+    if plan.reconfig:
+        import numpy as np
+
+        out.update(
+            epoch=int(lcs.epoch),
+            epoch_applied=int(lcs.applied),
+            live_acceptors=int(np.sum(lcs.acc_mask)),
+            acceptor_axis=int(lcs.acc_mask.size),
+            epochs_gcd=int(lcs.epochs_gcd),
+        )
+    return out

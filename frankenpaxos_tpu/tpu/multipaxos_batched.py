@@ -71,8 +71,10 @@ from frankenpaxos_tpu.tpu.common import (
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan, LifecycleState
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
@@ -221,6 +223,17 @@ class BatchedMultiPaxosConfig:
     # grids sweep one compiled program. WorkloadPlan.none() is a
     # structural no-op (saturation — the pre-plan behavior).
     workload: WorkloadPlan = WorkloadPlan.none()
+    # Production-lifecycle subsystem (tpu/lifecycle.py): watermark-
+    # driven window rotation (the slot numbering rebases in place once
+    # every group's head clears the quantum — unbounded serve runs in
+    # a constant int32 horizon), the exactly-once client session table
+    # (duplicate re-submissions answered from the per-lane cache
+    # without re-proposing), and the traced acceptor-membership epoch
+    # axis (the serve control plane swaps/shrinks/grows the live
+    # acceptor set with zero recompiles; the i/i+1 handoff rides the
+    # multipaxos_p1_promise plane). LifecyclePlan.none() is a
+    # structural no-op: default runs stay bit-identical.
+    lifecycle: LifecyclePlan = LifecyclePlan.none()
 
     @property
     def num_matchmakers(self) -> int:
@@ -233,6 +246,22 @@ class BatchedMultiPaxosConfig:
     @property
     def num_acceptors(self) -> int:
         return self.num_groups * self.group_size
+
+    @property
+    def rotation_alignment(self) -> int:
+        """Smallest rotation shift that is an EXACT renumbering: a
+        multiple of the ring width W (ring positions and the client
+        round-robin are slot mod W / mod NC with NC | W) and — under
+        the kv state machine — sized so the id shift ``align * G`` is a
+        multiple of kv_keys (key residency is id mod KV)."""
+        import math as _math
+
+        align = self.window
+        if self.state_machine == "kv":
+            align *= self.kv_keys // _math.gcd(
+                self.kv_keys, self.window * self.num_groups
+            )
+        return align
 
     def __post_init__(self):
         assert self.f >= 1
@@ -253,6 +282,22 @@ class BatchedMultiPaxosConfig:
         assert 0.0 <= self.revive_rate <= 1.0
         self.faults.validate(axis=self.group_size)
         self.workload.validate(reads_supported=self.read_rate > 0)
+        self.lifecycle.validate(align=self.rotation_alignment)
+        if self.lifecycle.reconfig:
+            # Both machineries bump rounds and re-promise; the traced
+            # epoch axis replaces the static schedule, not joins it.
+            assert self.reconfigure_every == 0, (
+                "lifecycle.reconfig and reconfigure_every are mutually "
+                "exclusive reconfiguration machineries"
+            )
+        if self.lifecycle.compaction:
+            # The closed-workload cap compares next_slot against an
+            # absolute budget; rebasing next_slot would silently extend
+            # it.
+            assert self.max_slots_per_group is None, (
+                "lifecycle.rotate_every needs an open workload "
+                "(max_slots_per_group=None)"
+            )
         self.kernels.validate()
         assert self.read_mode in READ_MODES
         assert self.state_machine in ("none", "kv")
@@ -381,6 +426,11 @@ class BatchedMultiPaxosState:
     # window, traced rate scalars; all-empty under WorkloadPlan.none()).
     workload: WorkloadState
 
+    # Production-lifecycle state (tpu/lifecycle.py: rotation counters,
+    # the [G, S] session table, the traced membership mask + epoch;
+    # all-empty under LifecyclePlan.none()).
+    lifecycle: LifecycleState
+
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
 
@@ -467,6 +517,9 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         read_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
         read_lin_violations=jnp.zeros((), jnp.int32),
         workload=workload_mod.make_state(cfg.workload, G, cfg.faults),
+        lifecycle=lifecycle_mod.make_state(
+            cfg.lifecycle, G, acceptor_shape=(A, G)
+        ),
         telemetry=make_telemetry(),
     )
 
@@ -587,6 +640,10 @@ def tick(
         use_mega
         and not (crash_on or cfg.device_elections)
         and not cfg.reconfigure_every
+        # The traced-epoch leg (and its membership gating) writes the
+        # clocks between aging and the planes, so the aging cannot
+        # fold into the megakernel.
+        and not cfg.lifecycle.reconfig
     )
 
     # Age the offset clocks ONCE, up front: after aging, an offset is
@@ -818,6 +875,71 @@ def tick(
             + jnp.sum(p1a_now)
         )
 
+    # ---- 0.75 Traced acceptor reconfiguration (tpu/lifecycle.py): the
+    # matchmaker i/i+1 handoff collapsed to one tick, driven by the
+    # TRACED epoch + membership the serve control plane steers between
+    # chunks (set_membership — zero recompiles). On an epoch switch:
+    # round bump + phase-1 re-promise over the SAME p1_promise kernel
+    # plane the elections use (oracle all-acceptor read, a superset of
+    # any f+1 read quorum), in-flight votes clear and re-propose, and
+    # old-epoch GC clears pending traffic to departed acceptors while
+    # the epoch's slots drain behind the lifecycle GC watermark. Every
+    # tick, the live mask gates the Phase2a/retry sends below, so
+    # departed acceptors never receive (or cast) anything.
+    lc = cfg.lifecycle
+    lcs = state.lifecycle
+    acc_mask_live = None
+    if lc.reconfig:
+        lc_switch = lifecycle_mod.reconfig_switch(lc, lcs)
+        sw_g = jnp.broadcast_to(lc_switch, (G,))
+        (
+            slot_value_in,
+            p2a_in,
+            p2b_in,
+            last_send_in,
+        ) = ops_registry.dispatch(
+            "multipaxos_p1_promise",
+            cfg,
+            status, vote_round_in, vote_value_in, slot_value_in,
+            p2a_in, p2b_in, last_send_in, sw_g,
+            jnp.ones((A, G), bool), retry_lat, t,
+        )
+        in_flight_lc = (status == PROPOSED) & sw_g[:, None]  # [G, W]
+        vote_round_in = jnp.where(
+            in_flight_lc[None, :, :], -1, vote_round_in
+        )
+        vote_value_in = jnp.where(
+            in_flight_lc[None, :, :], NO_VALUE, vote_value_in
+        )
+        # i/i+1: the new epoch binds to the next round; promises stay
+        # monotone (max), mirroring the matchmaker install step.
+        leader_round = jnp.where(sw_g, leader_round + 1, leader_round)
+        acc_round_in = jnp.where(
+            lc_switch,
+            jnp.maximum(acc_round_in, leader_round[None, :]),
+            acc_round_in,
+        )
+        lcs = lifecycle_mod.reconfig_applied(
+            lc, lcs, lc_switch, state.next_slot, state.head
+        )
+        acc_mask_live = lcs.acc_mask  # [A, G], post-switch
+        not_member = ~acc_mask_live[:, :, None]
+        # Old-epoch GC: departed acceptors' pending traffic clears —
+        # the p2a blanket holds EVERY tick (a non-member never holds a
+        # pending Phase2a, whatever plane wrote it), the p2b sweep on
+        # the switch tick drops their in-flight replies on UNCHOSEN
+        # slots only: chosen slots keep their old-epoch vote
+        # certificates (p2b + vote state) until they retire, so
+        # quorum_ok stays countable mid-handoff.
+        p2a_in = jnp.where(not_member, INF16, p2a_in)
+        p2b_in = jnp.where(
+            lc_switch & not_member & (status != CHOSEN)[None, :, :],
+            INF16,
+            p2b_in,
+        )
+        # The re-promise fan-out is phase-1-plane traffic.
+        telem_phase1 = telem_phase1 + A * G * lc_switch.astype(jnp.int32)
+
     # ---- [G]-space CONTROL for the planes below: proposal caps under
     # elections / reconfiguration / closed workloads, retry gates,
     # thrifty quorum membership. Decided OUTSIDE the planes and entering
@@ -869,6 +991,13 @@ def tick(
         if retry_delivered is not None
         else jnp.ones((A, G, W), bool)
     )
+    if acc_mask_live is not None:
+        # Membership gating: Phase2a fan-outs and full-group retries
+        # reach live members only. A thrifty quorum that sampled a
+        # departed acceptor stalls its slot until the full-group retry
+        # (the reconfiguration throughput dip the serve bench records).
+        send_ok = send_ok & acc_mask_live[:, :, None]
+        retry_deliv = retry_deliv & acc_mask_live[:, :, None]
 
     # ---- 1-5. The tick hot path: acceptors vote on Phase2a arrivals
     # (Acceptor.handlePhase2a, Acceptor.scala:184-220), quorums form
@@ -1381,6 +1510,36 @@ def tick(
             acc_max_slot - n_retire[None, :], AMS_FLOOR
         ).astype(acc_max_slot.dtype)
 
+    # ---- 6.5 Production lifecycle (tpu/lifecycle.py). Session table:
+    # this tick's client-visible completions (the same per-group
+    # quorum counts the workload engine's finish() receives — the
+    # shared books behind the extended conservation invariant) record
+    # into the [G, S] table, and duplicate re-submissions answer from
+    # the cache on a DISJOINT PRNG stream — the protocol planes above
+    # never see them, so exactly-once holds by construction. Rotation:
+    # once every group's head clears the quantum (or the host latched
+    # a force-rotation), this tick's shift is computed HERE — feeding
+    # the telemetry ring's rotations column and leaving the span
+    # sampler on the pre-roll base — and the slot planes rebase at the
+    # very end of the tick.
+    if lc.has_sessions:
+        lcs = lifecycle_mod.sessions_step(
+            lc, lcs, key, t, jnp.sum(newly_chosen, axis=1)
+        )
+    lc_shift = None
+    lc_base = 0
+    if lc.compaction:
+        lc_base = lcs.rot_base
+        # margin=W: the furthest back a LIVE id record can point
+        # (client_last_issued references slots >= next_slot - NC with
+        # NC | W), so every in-flight id survives the rebase exactly;
+        # only the HISTORICAL tables (ct_last / kv_val) can reference
+        # older slots, and those demote to the unset sentinel below.
+        lc_shift, lcs = lifecycle_mod.rotation_shift(
+            lc, lcs, jnp.min(head), cfg.rotation_alignment,
+            margin=cfg.window,
+        )
+
     # ---- 7. Telemetry (tpu/telemetry.py contract): every count is an
     # int32 reduction of a mask/counter the tick already computed for
     # its own bookkeeping, so with the default ring this adds register
@@ -1416,6 +1575,11 @@ def tick(
         drops=p2a_drops,
         retries=n_retries,
         leader_changes=elections - state.elections,
+        rotations=(
+            (lc_shift > 0).astype(jnp.int32)
+            if lc_shift is not None
+            else 0
+        ),
         queue_depth=jnp.sum(next_slot - head),
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
@@ -1433,20 +1597,36 @@ def tick(
             p1_mark = p1_mark | elect
         if cfg.reconfigure_every:
             p1_mark = p1_mark | p1_done
+        if lc.reconfig:
+            # Traced-epoch switches repair through the phase-1 plane:
+            # the reconfiguration pause is a phase1_promised stamp on
+            # every live span (visible in the Perfetto trace).
+            p1_mark = p1_mark | sw_g
         tel = telemetry_mod.record_spans(
             tel,
             t=t,
             is_new=is_new,
             # Per-group slot number at each ring position (OLD head +
             # ordinal — valid for every cell occupied at tick start,
-            # including the ones retiring this tick).
-            slot_ids=state.head[:, None] + ord_of_pos,
+            # including the ones retiring this tick). Under window
+            # rotation, the pre-roll rotation base makes the numbering
+            # ABSOLUTE, so span ids stay stable across rolls (the
+            # Python-level gate keeps the none-plan trace untouched).
+            slot_ids=(
+                lc_base + state.head[:, None] + ord_of_pos
+                if lc.compaction
+                else state.head[:, None] + ord_of_pos
+            ),
             # Cells proposed THIS tick carry a slot one window past the
             # old-head formula when they were retired + re-proposed in
             # one tick: their numbering is OLD next_slot + ordinal.
-            new_slot_ids=state.next_slot[:, None]
-            + jnp.mod(
-                w_iota[None, :] - state.next_slot[:, None], W
+            new_slot_ids=(
+                lc_base
+                + state.next_slot[:, None]
+                + jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
+                if lc.compaction
+                else state.next_slot[:, None]
+                + jnp.mod(w_iota[None, :] - state.next_slot[:, None], W)
             ),
             phase1_mark=p1_mark,
             # A Phase2b vote is visible at the counter: the same
@@ -1455,6 +1635,75 @@ def tick(
             newly_chosen=newly_chosen,
             retire_mask=retire_mask,
         )
+
+    # ---- 8. Window rotation (tpu/lifecycle.py): the in-place roll.
+    # When this tick's shift fired (a whole number of rotate_every
+    # quanta, itself a multiple of the backend's alignment), every
+    # absolute slot number and every slot-derived id rebases by the
+    # shift — ring positions (slot mod W), client residues (mod NC),
+    # and kv key residues (id mod KV) are all invariant under an
+    # aligned shift, the offset clocks are already relative, and the
+    # head-relative read deltas never move: the rebased run replays
+    # the unrotated run bit for bit (the rotation-exactness pin). A
+    # zero shift is the identity; the whole leg is absent at trace
+    # time under LifecyclePlan.none().
+    if lc.compaction:
+        gshift = lc_shift * G  # the id/global-numbering shift
+
+        def _rebase(args):
+            # Historical tables (kv_val / ct_last): an id stale beyond
+            # the margin (possible only through long noop-repair /
+            # duplicate streaks) demotes to the unset sentinel.
+            # Outcome-preserving: commands only ever carry RECENT ids
+            # (fresh slots or client_last_issued re-issues, both
+            # margin-protected), and any recent id beats a stale table
+            # entry whether it reads as its true stale value or as -1
+            # — the compact/ GC analog of a session record aging out
+            # of the retained log.
+            (hd, ns, sv, cv, vv, gw, kv, ctl, cli, mcg, cw, rs, rt,
+             rf, lgw) = args
+            return (
+                lifecycle_mod.shift_counts(hd, lc_shift),
+                lifecycle_mod.shift_counts(ns, lc_shift),
+                lifecycle_mod.shift_ids(sv, gshift),
+                lifecycle_mod.shift_ids(cv, gshift),
+                lifecycle_mod.shift_ids(vv, gshift),
+                lifecycle_mod.shift_ids(gw, lc_shift),
+                lifecycle_mod.shift_ids(kv, gshift, floor=-1),
+                lifecycle_mod.shift_ids(ctl, gshift, floor=-1),
+                lifecycle_mod.shift_ids(cli, gshift),
+                lifecycle_mod.shift_ids(mcg, gshift),
+                lifecycle_mod.shift_ids(cw, gshift),
+                lifecycle_mod.shift_ids(rs, gshift),
+                lifecycle_mod.shift_ids(rt, gshift),
+                lifecycle_mod.shift_ids(rf, gshift),
+                lifecycle_mod.shift_ids(lgw, lc_shift),
+            )
+
+        # lax.cond: the rebase sweeps run ONLY on a tick whose shift
+        # fired (one tick in a quantum) — every other tick pays a
+        # branch, not len(fields) identity wheres over the slot planes
+        # (the <2% overhead budget of bench.py --lifecycle).
+        (
+            head, next_slot, slot_value, chosen_value, vote_value,
+            gc_watermark, kv_val, ct_last, client_last_issued,
+            max_chosen_global, client_watermark, resp_slot, rb_target,
+            rb_floor, lc_gcw,
+        ) = jax.lax.cond(
+            lc_shift > 0,
+            _rebase,
+            lambda args: args,
+            (
+                head, next_slot, slot_value, chosen_value, vote_value,
+                gc_watermark, kv_val, ct_last, client_last_issued,
+                max_chosen_global, client_watermark, resp_slot,
+                rb_target, rb_floor,
+                lcs.gc_watermark if lc.reconfig
+                else jnp.zeros((0,), jnp.int32),
+            ),
+        )
+        if lc.reconfig:
+            lcs = dataclasses.replace(lcs, gc_watermark=lc_gcw)
 
     return BatchedMultiPaxosState(
         leader_round=leader_round,
@@ -1521,6 +1770,7 @@ def tick(
         read_lat_hist=read_lat_hist,
         read_lin_violations=read_lin_violations,
         workload=wls,
+        lifecycle=lcs,
         telemetry=tel,
     )
 
@@ -1771,6 +2021,20 @@ def check_invariants(
         "workload_ok": workload_mod.invariants_ok(
             cfg.workload, state.workload
         ),
+        # Lifecycle books: session ids conserved against the lane's
+        # completion counts (and, when the workload engine is also
+        # active, against ITS completion totals — exactly-once
+        # accounting and window conservation are the same books),
+        # rotation counters monotone, reconfiguration GC armed.
+        "lifecycle_ok": lifecycle_mod.invariants_ok(
+            cfg.lifecycle,
+            state.lifecycle,
+            workload_completed=(
+                state.workload.completed
+                if cfg.lifecycle.has_sessions and cfg.workload.active
+                else None
+            ),
+        ),
         "window_ok": window_ok,
         "conserved": conserved,
         "round_ok": round_ok,
@@ -1792,6 +2056,7 @@ def check_invariants(
 def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
     workload: WorkloadPlan = WorkloadPlan.none(),
+    lifecycle: LifecyclePlan = LifecyclePlan.none(),
 ) -> BatchedMultiPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -1802,4 +2067,5 @@ def analysis_config(
     return BatchedMultiPaxosConfig(
         f=1, num_groups=4, window=16, slots_per_tick=2,
         retry_timeout=8, faults=faults, workload=workload,
+        lifecycle=lifecycle,
     )

@@ -53,9 +53,10 @@ import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu.common import LAT_BINS
 
-# Per-tick ring columns. The first eight are event counters (events that
-# happened THIS tick); queue_depth is a gauge sampled at tick end
-# (in-flight work items — ring occupancy / window backlog, per backend).
+# Per-tick ring columns. All but queue_depth are event counters (events
+# that happened THIS tick — rotations counts tpu/lifecycle.py window
+# rolls); queue_depth is a gauge sampled at tick end (in-flight work
+# items — ring occupancy / window backlog, per backend).
 COUNTER_FIELDS = (
     "proposals",
     "phase1_msgs",
@@ -65,6 +66,7 @@ COUNTER_FIELDS = (
     "drops",
     "retries",
     "leader_changes",
+    "rotations",
     "queue_depth",
 )
 NUM_COLS = len(COUNTER_FIELDS)
@@ -171,6 +173,7 @@ def record(
     drops=0,
     retries=0,
     leader_changes=0,
+    rotations=0,
     queue_depth=0,
     queue_capacity: int = 0,
     lat_hist_delta: Optional[jnp.ndarray] = None,
@@ -199,6 +202,7 @@ def record(
                 drops,
                 retries,
                 leader_changes,
+                rotations,
                 queue_depth,
             )
         ]

@@ -16,9 +16,13 @@ OUT=${1:-/tmp/fpx_serve_smoke}
 rm -rf "$OUT"
 mkdir -p "$OUT"
 
+# The lifecycle legs ride the smoke: window rotation keeps the run's
+# slot horizon constant and the session table answers duplicate
+# re-submissions from cache (tpu/lifecycle.py; both asserted below).
 JAX_PLATFORMS=cpu python -m frankenpaxos_tpu.harness.serve \
   --seconds "${SERVE_SMOKE_SECONDS:-10}" --out-dir "$OUT" \
   --groups 64 --chunk 32 --spans 16 --rate-x 1.1 --slo-p99 24 \
+  --rotate-every 64 --sessions 8 --resubmit-rate 0.05 \
   > "$OUT/report_line.json"
 
 JAX_PLATFORMS=cpu python - "$OUT" <<'EOF'
@@ -29,6 +33,9 @@ report = json.load(open(os.path.join(out, "serve_report.json")))
 assert report["clean_shutdown"], report
 assert report["ticks"] > 0, report
 assert report["dropped_ticks"] == 0, report
+lc = report["lifecycle"]
+assert lc["rotations"] >= 1, lc  # the window rolled at least once
+assert lc["cache_hits"] > 0, lc  # duplicates answered from the table
 
 from frankenpaxos_tpu.monitoring import traceviz
 

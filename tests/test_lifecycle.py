@@ -1,0 +1,474 @@
+"""The production-lifecycle subsystem (tpu/lifecycle.py): in-graph
+window rotation, the exactly-once client session table, and traced
+acceptor reconfiguration.
+
+The load-bearing guarantees, in order:
+
+  * ``LifecyclePlan.none()`` (the default on both lifecycle-threaded
+    configs) is a STRUCTURAL no-op — the multipaxos pin reuses the
+    ``tests/test_workload.py`` pre-PR golden captures verbatim (3
+    seeds), so any lifecycle-threading change that perturbs a default
+    run by one bit fails against the true pre-lifecycle behavior.
+  * Rotation is an EXACT renumbering: a run crossing >= 3 window
+    rotations commits the same entry sequence — the ENTIRE protocol
+    state replays bit for bit modulo the rebased slot numbering — as
+    its unrotated twin, on both backends, while the rotated run's slot
+    horizon stays constant.
+  * Exactly-once is by construction: duplicate re-submissions are
+    answered from the session-table cache on a disjoint PRNG stream
+    and never re-propose — the resubmitting run's protocol history is
+    bit-identical to the resubmit-free twin's.
+  * Reconfiguration is recompile-free: membership/epoch are traced
+    state, so a mid-run acceptor swap (and heal) replays the same
+    compiled program, invariants and liveness intact — randomized
+    against crash/partition schedules via the simtest axis.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.harness import simtest
+from frankenpaxos_tpu.harness.serve import ServeConfig, ServeLoop
+from frankenpaxos_tpu.tpu import compartmentalized_batched as cz
+from frankenpaxos_tpu.tpu import lifecycle as lc_mod
+from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+
+def _hash(state, fields):
+    m = hashlib.sha256()
+    for f in fields:
+        m.update(np.asarray(jax.device_get(getattr(state, f))).tobytes())
+    return m.hexdigest()[:16]
+
+
+def _run(mod, cfg, ticks, seed, state=None, t=None):
+    state = mod.init_state(cfg) if state is None else state
+    t = jnp.zeros((), jnp.int32) if t is None else t
+    return mod.run_ticks(cfg, state, t, ticks, jax.random.PRNGKey(seed))
+
+
+def _assert_invariants(mod, cfg, state, t):
+    bad = {
+        k: bool(v)
+        for k, v in mod.check_invariants(cfg, state, t).items()
+        if not bool(v)
+    }
+    assert not bad, bad
+
+
+# ---------------------------------------------------------------------------
+# none() bit-identity: the multipaxos goldens are the pre-PR captures
+# from tests/test_workload.py (same fixed config/seeds, explicit none
+# plan); the compartmentalized pin freezes the current default run.
+# ---------------------------------------------------------------------------
+
+GOLDEN_MULTIPAXOS = {
+    0: (582, 562, 3426, "dd70eeb17ab45de2"),
+    1: (581, 530, 3487, "c665a10d449618ae"),
+    2: (583, 551, 3340, "ec2d56f23217dda9"),
+}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_multipaxos(seed):
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, lat_min=1,
+        lat_max=3, drop_rate=0.05, retry_timeout=8,
+        lifecycle=LifecyclePlan.none(),
+    )
+    assert mp.BatchedMultiPaxosConfig().lifecycle == cfg.lifecycle
+    st, _ = _run(mp, cfg, 120, seed)
+    got = (
+        int(st.committed), int(st.retired), int(st.lat_sum),
+        _hash(st, ("status", "slot_value", "chosen_round", "head",
+                   "next_slot", "acc_round", "vote_round", "vote_value")),
+    )
+    assert got == GOLDEN_MULTIPAXOS[seed]
+    # The carried lifecycle state is structurally EMPTY.
+    assert all(
+        leaf.size == 0
+        for leaf in jax.tree_util.tree_leaves(st.lifecycle)
+    )
+
+
+GOLDEN_COMPARTMENTALIZED = {
+    0: (818, 368, "3e99b934cf6a8cad"),
+    1: (824, 372, "cfcdda6b246a824a"),
+    2: (796, 365, "7809ddf78dad6fa3"),
+}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_none_plan_bit_identical_compartmentalized(seed):
+    cfg = cz.analysis_config(lifecycle=LifecyclePlan.none())
+    assert cz.analysis_config().lifecycle == cfg.lifecycle
+    st, _ = _run(cz, cfg, 120, seed)
+    got = (
+        int(st.committed), int(st.retired),
+        _hash(st, ("status", "head", "next_slot", "rep_exec",
+                   "p2b_arrival", "rd_bound")),
+    )
+    assert got == GOLDEN_COMPARTMENTALIZED[seed]
+    assert all(
+        leaf.size == 0
+        for leaf in jax.tree_util.tree_leaves(st.lifecycle)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rotation exactness: >= 3 rotations == the unrotated twin, rebased.
+# ---------------------------------------------------------------------------
+
+# Field -> the rebased-shift multiplier (in units of the per-group
+# rotation base): slot counts shift by 1x, id/global-numbering fields
+# by G (the global sequence is slot * G + g). Unlisted fields must be
+# bitwise EQUAL between the rotated run and its twin.
+def _mp_shift_mults(G):
+    out = {f: 1 for f in ("head", "next_slot", "gc_watermark")}
+    out.update({
+        f: G
+        for f in (
+            "slot_value", "chosen_value", "vote_value", "kv_val",
+            "ct_last", "client_last_issued", "max_chosen_global",
+            "client_watermark", "resp_slot", "rb_target", "rb_floor",
+        )
+    })
+    return out
+
+
+def _cz_shift_mults(G):
+    return {f: 1 for f in ("head", "next_slot", "rep_exec", "rd_bound")}
+
+
+# Historical-table fields where an entry stale beyond the rotation
+# margin demotes to the unset sentinel (outcome-preserving; see the
+# rebase comment in multipaxos_batched.tick) — the twin comparison
+# allows EXACTLY that: rotated == -1 where the twin's id predates the
+# cumulative rebase, bitwise equality everywhere else.
+_DEMOTABLE = {"kv_val", "ct_last"}
+
+
+def _assert_rotated_equals_twin(rot_state, twin_state, shift_mults):
+    base = int(rot_state.lifecycle.rot_base)
+    assert base > 0
+    for f in dataclasses.fields(twin_state):
+        name = f.name
+        if name in ("lifecycle", "telemetry"):
+            continue  # rotation counters / the rotations ring column
+        mult = shift_mults.get(name, 0)
+        a_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(getattr(rot_state, name))
+        )
+        b_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(getattr(twin_state, name))
+        )
+        for a, b in zip(a_leaves, b_leaves):
+            a, b = np.asarray(a), np.asarray(b)
+            if mult:
+                raw = a
+                a = np.where(a >= 0, a + base * mult, a)
+                if name in _DEMOTABLE:
+                    demoted = (raw == -1) & (b >= 0) & (b < base * mult)
+                    a = np.where(demoted, b, a)
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_rotation_exactness_multipaxos():
+    """A flagship run with kv dedup + reads crossing >= 3 rotations
+    replays its unrotated twin bit for bit modulo the rebase — the
+    commit sequence, the KV shards, the client tables, and the read
+    path are all identical — while the rotated run's slot horizon
+    stays bounded by one quantum + window."""
+    kw = dict(
+        f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=8,
+        state_machine="kv", kv_keys=64, num_clients=8, dup_rate=0.1,
+        read_rate=2, read_window=8,
+    )
+    plan = LifecyclePlan(rotate_every=32)
+    cfg_r = mp.BatchedMultiPaxosConfig(lifecycle=plan, **kw)
+    cfg_n = mp.BatchedMultiPaxosConfig(**kw)
+    sr, tr = _run(mp, cfg_r, 250, 7)
+    sn, _ = _run(mp, cfg_n, 250, 7)
+    assert int(sr.lifecycle.rot_count) >= 3
+    # Constant horizon: heads never run past a quantum + margin + W...
+    assert int(jnp.max(sr.head)) < plan.rotate_every + 2 * cfg_r.window
+    # ...while the twin's marched on unboundedly.
+    assert int(jnp.max(sn.head)) > 3 * plan.rotate_every
+    _assert_rotated_equals_twin(sr, sn, _mp_shift_mults(cfg_r.num_groups))
+    _assert_invariants(mp, cfg_r, sr, tr)
+    # The rotations telemetry column recorded every roll.
+    assert int(
+        sr.telemetry.totals[telemetry_mod.COL["rotations"]]
+    ) == int(sr.lifecycle.rot_count)
+
+
+def test_rotation_exactness_compartmentalized():
+    plan = LifecyclePlan(rotate_every=16)
+    cfg_r = cz.analysis_config(lifecycle=plan)
+    cfg_n = cz.analysis_config()
+    sr, tr = _run(cz, cfg_r, 300, 5)
+    sn, _ = _run(cz, cfg_n, 300, 5)
+    assert int(sr.lifecycle.rot_count) >= 3
+    assert int(jnp.max(sr.head)) < plan.rotate_every + 2 * cfg_r.window
+    _assert_rotated_equals_twin(sr, sn, _cz_shift_mults(cfg_r.num_groups))
+    _assert_invariants(cz, cfg_r, sr, tr)
+
+
+def test_rotation_span_ids_stable_across_rolls():
+    """The span sampler records ABSOLUTE slot ids (local + rotation
+    base): the rotated run exports the exact same completed spans as
+    the unrotated twin — ids never jump at a roll."""
+    plan = LifecyclePlan(rotate_every=32)
+    cfg_r = mp.analysis_config(lifecycle=plan)
+    cfg_n = mp.analysis_config()
+
+    def spans_of(cfg):
+        st = mp.init_state(cfg)
+        st = dataclasses.replace(
+            st, telemetry=telemetry_mod.make_telemetry(128, spans=8)
+        )
+        st, _ = mp.run_ticks(
+            cfg, st, jnp.zeros((), jnp.int32), 200, jax.random.PRNGKey(3)
+        )
+        return st
+
+    sr, sn = spans_of(cfg_r), spans_of(cfg_n)
+    assert int(sr.lifecycle.rot_count) >= 3
+    assert int(sr.telemetry.spans_done) > 0
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(sr.telemetry.span_ring)),
+        np.asarray(jax.device_get(sn.telemetry.span_ring)),
+    )
+
+
+def test_force_rotation_verb():
+    """request_rotation rolls EARLY — down to the largest retired
+    alignment quantum — without waiting for rotate_every."""
+    plan = LifecyclePlan(rotate_every=64)  # 4 quanta of the W=16 align
+    cfg = mp.analysis_config(lifecycle=plan)
+    st, t = _run(mp, cfg, 40, 0)  # heads well inside [16, 64)
+    assert int(st.lifecycle.rot_count) == 0
+    head_before = int(jnp.min(st.head))
+    assert 16 <= head_before < 64, "test setup: one retired quantum"
+    st = dataclasses.replace(
+        st, lifecycle=lc_mod.request_rotation(st.lifecycle)
+    )
+    st, t = mp.run_ticks(cfg, st, t, 1, jax.random.PRNGKey(1))
+    assert int(st.lifecycle.rot_count) == 1
+    assert int(st.lifecycle.rot_base) % 16 == 0
+    assert int(jnp.min(st.head)) < head_before
+    _assert_invariants(mp, cfg, st, t)
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once session table
+# ---------------------------------------------------------------------------
+
+
+def _protocol_hash(state):
+    m = hashlib.sha256()
+    for f in dataclasses.fields(state):
+        if f.name == "lifecycle":
+            continue
+        for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(getattr(state, f.name))
+        ):
+            m.update(np.asarray(leaf).tobytes())
+    return m.hexdigest()[:16]
+
+
+@pytest.mark.parametrize("mod,cfg_fn", [
+    (mp, mp.analysis_config), (cz, cz.analysis_config),
+])
+def test_exactly_once_duplicates_never_touch_protocol(mod, cfg_fn):
+    """Duplicate submissions are answered from the cache and NEVER
+    re-propose: the resubmitting run's protocol history (every field
+    but the lifecycle books) is bit-identical to the resubmit-free
+    twin's — exactly-once by construction, on both backends."""
+    cfg_s = cfg_fn(
+        lifecycle=LifecyclePlan(sessions=4, resubmit_rate=0.2)
+    )
+    cfg_0 = cfg_fn()
+    ss, ts = _run(mod, cfg_s, 150, 3)
+    s0, _ = _run(mod, cfg_0, 150, 3)
+    assert _protocol_hash(ss) == _protocol_hash(s0)
+    assert int(ss.lifecycle.cache_hits) > 0
+    assert int(ss.lifecycle.resubmits) >= int(ss.lifecycle.cache_hits)
+    _assert_invariants(mod, cfg_s, ss, ts)
+    # The table recorded every client-visible completion (committed
+    # entries on both backends).
+    assert int(jnp.sum(ss.lifecycle.sess_total)) == int(ss.committed)
+
+
+def test_sessions_compose_with_kv_dup_injection():
+    """The session table layers ON TOP of the kv client-table dedup:
+    fault-injected eager duplicates (FaultPlan.dup_rate), re-issued
+    command ids (cfg.dup_rate -> ct_last filtering), and session-level
+    re-submissions all together — every dedup invariant holds."""
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=8,
+        state_machine="kv", kv_keys=64, num_clients=8, dup_rate=0.2,
+        faults=FaultPlan(dup_rate=0.1),
+        lifecycle=LifecyclePlan(
+            rotate_every=32, sessions=8, resubmit_rate=0.15
+        ),
+    )
+    st, t = _run(mp, cfg, 200, 1)
+    _assert_invariants(mp, cfg, st, t)
+    assert int(st.lifecycle.rot_count) >= 2
+    assert int(st.dups_filtered) > 0  # ct_last filtered re-issues
+    assert int(st.lifecycle.cache_hits) > 0  # cache answered resubmits
+
+
+def test_sessions_conserve_with_workload_engine():
+    """The extended conservation contract: with the closed-loop
+    workload engine active, the session table's completion totals
+    reconcile against WorkloadState.completed exactly (checked inside
+    lifecycle_ok every segment), and workload_ok still holds."""
+    cfg = mp.analysis_config(
+        workload=WorkloadPlan(
+            arrival="constant", rate=1.5, closed_window=6, think_time=2
+        ),
+        lifecycle=LifecyclePlan(sessions=4, resubmit_rate=0.1),
+    )
+    st, t = _run(mp, cfg, 150, 2)
+    _assert_invariants(mp, cfg, st, t)
+    assert int(jnp.sum(st.lifecycle.sess_total)) == int(
+        st.workload.completed
+    )
+
+
+# ---------------------------------------------------------------------------
+# Traced reconfiguration
+# ---------------------------------------------------------------------------
+
+
+def test_reconfig_swap_is_recompile_free_and_live_multipaxos():
+    """A mid-run acceptor swap + heal through the traced epoch axis:
+    the jit cache stays flat, invariants hold at every boundary, and
+    commits keep flowing in every regime (the dip-and-recover)."""
+    cfg = mp.analysis_config(
+        lifecycle=LifecyclePlan(rotate_every=16, reconfig=True)
+    )
+    st, t = _run(mp, cfg, 80, 0)
+    before_cache = mp.run_ticks._cache_size()
+    c0 = int(st.committed)
+    st = dataclasses.replace(
+        st, lifecycle=lc_mod.swap_acceptor(st.lifecycle, 1)
+    )
+    st, t = mp.run_ticks(cfg, st, t, 80, jax.random.PRNGKey(1))
+    _assert_invariants(mp, cfg, st, t)
+    c1 = int(st.committed)
+    assert c1 > c0, "commits stalled under the swapped-out acceptor"
+    assert int(st.lifecycle.applied) == 1
+    assert int(jnp.sum(st.lifecycle.acc_mask)) == 2 * cfg.num_groups
+    st = dataclasses.replace(
+        st, lifecycle=lc_mod.set_membership(st.lifecycle, True)
+    )
+    st, t = mp.run_ticks(cfg, st, t, 80, jax.random.PRNGKey(2))
+    _assert_invariants(mp, cfg, st, t)
+    assert int(st.committed) > c1
+    assert int(st.lifecycle.applied) == 2
+    assert mp.run_ticks._cache_size() == before_cache, (
+        "reconfiguration recompiled the serve program"
+    )
+    # Old epochs were garbage-collected behind the watermark.
+    assert int(st.lifecycle.epochs_gcd) > 0
+
+
+def test_reconfig_grid_cell_swap_compartmentalized():
+    cfg = cz.analysis_config(lifecycle=LifecyclePlan(reconfig=True))
+    st, t = _run(cz, cfg, 80, 0)
+    before_cache = cz.run_ticks._cache_size()
+    c0 = int(st.committed)
+    mask = np.ones((2, 2, cfg.num_groups), bool)
+    mask[1, 0, :] = False  # swap one grid cell out (rows stay live)
+    st = dataclasses.replace(
+        st,
+        lifecycle=lc_mod.set_membership(st.lifecycle, jnp.asarray(mask)),
+    )
+    st, t = cz.run_ticks(cfg, st, t, 80, jax.random.PRNGKey(1))
+    _assert_invariants(cz, cfg, st, t)
+    assert int(st.committed) > c0
+    st = dataclasses.replace(
+        st, lifecycle=lc_mod.set_membership(st.lifecycle, True)
+    )
+    st, t = cz.run_ticks(cfg, st, t, 80, jax.random.PRNGKey(2))
+    _assert_invariants(cz, cfg, st, t)
+    assert cz.run_ticks._cache_size() == before_cache
+
+
+def test_simtest_reconfig_axis():
+    """The randomized [faults x epochs] axis: reconfiguration epochs
+    churn against crash/partition schedules at segment boundaries;
+    invariants hold throughout and progress resumes after the final
+    heal (liveness-after-heal under churn), on both backends."""
+    import random as _random
+
+    for name in ("multipaxos", "compartmentalized"):
+        spec = simtest.SPECS[name]
+        rng = _random.Random(42)
+        for i in range(2):
+            plan = simtest.random_plan(rng, spec, 160)
+            if plan.has_partition and (
+                plan.partition_heal < 0 or plan.partition_heal > 120
+            ):
+                plan = dataclasses.replace(
+                    plan,
+                    partition_heal=80,
+                    partition_start=min(plan.partition_start, 79),
+                )
+            lplan = simtest.random_lifecycle(rng, spec, 160)
+            res = simtest.run_reconfig_schedule(
+                spec, plan, seed=i, ticks=160, lifecycle=lplan,
+                epoch_seed=i,
+            )
+            assert res["ok"], (name, i, res["violations"], res)
+
+
+def test_serve_loop_lifecycle_verbs():
+    """The serve control plane end to end: a live loop swaps an
+    acceptor, heals, and force-rotates between chunks — zero
+    recompiles — and the report carries the lifecycle summary."""
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=8,
+        lifecycle=LifecyclePlan(
+            rotate_every=16, sessions=4, resubmit_rate=0.1,
+            reconfig=True,
+        ),
+    )
+    serve = ServeConfig(chunk_ticks=20, telemetry_window=64,
+                        max_chunks=6)
+    loop = ServeLoop(mp, cfg, serve, seed=0)
+    # Drive chunks manually so verbs land between them.
+    snap = loop._dispatch_chunk()
+    loop.swap_acceptor(2)
+    snap2 = loop._dispatch_chunk()
+    loop._drain(snap)
+    cache = mp.run_ticks._cache_size()
+    loop.reconfigure(True)  # heal
+    loop.rotate()
+    snap3 = loop._dispatch_chunk()
+    loop._drain(snap2)
+    loop._drain(snap3)
+    assert mp.run_ticks._cache_size() == cache
+    report = loop.report(1.0)
+    lc = report["lifecycle"]
+    assert lc["epoch"] == 2 and lc["epoch_applied"] == 2
+    assert lc["rotations"] >= 1
+    assert lc["live_acceptors"] == 3 * cfg.num_groups
+    _assert_invariants(mp, cfg, loop.state, loop.t)
+    verb_names = {
+        s["name"] for s in loop.host_spans if s["name"].startswith("verb:")
+    }
+    assert {"verb:swap_acceptor", "verb:reconfigure",
+            "verb:rotate"} <= verb_names

@@ -1,0 +1,40 @@
+"""Production-lifecycle contract (thin wrapper): every
+lifecycle-threaded batched *Config accepts a ``lifecycle:
+LifecyclePlan`` field, validates it (rotation alignment, resubmit
+cache) in ``__post_init__``, and applies it in ``tick``; under
+``LifecyclePlan.none()`` the carried state is structurally empty and
+feeds no tick equation; and steering the traced membership/epoch (the
+serve reconfiguration verbs) never recompiles.
+
+The checkers are the ``lifecycle-*`` / ``trace-lifecycle-*`` rules in
+``frankenpaxos_tpu/analysis``; the behavioral pins live in
+``tests/test_lifecycle.py``.
+"""
+
+import pytest
+
+from frankenpaxos_tpu import analysis
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    [
+        "lifecycle-config-field",
+        "lifecycle-validate",
+        "lifecycle-apply",
+    ],
+)
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    ["lifecycle-noop", "trace-lifecycle-retrace"],
+)
+def test_trace_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()

@@ -283,6 +283,44 @@ def test_serve_slo_alarm_clamps_and_p99_recovers():
     del cache0
 
 
+def test_serve_live_fault_plan_swap_recovers():
+    """Live FaultPlan swaps through the serve control plane (the PR 10
+    follow-up): a FaultPlan(traced=True) config serves healthy, the
+    set_fault_rates verb drives the drop rate UP mid-run (per-chunk
+    commit throughput collapses), then back DOWN — throughput recovers
+    to the healthy band, with zero recompiles across both swaps."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    cfg = _cfg(faults=FaultPlan(traced=True))
+    serve = ServeConfig(chunk_ticks=20, telemetry_window=64,
+                        max_chunks=100)
+    loop = ServeLoop(mp, cfg, serve, seed=4)
+
+    def commits_over(chunks):
+        c0 = int(jax.device_get(loop.state.committed))
+        snaps = [loop._dispatch_chunk() for _ in range(chunks)]
+        for s in snaps:
+            loop._drain(s)
+        return int(jax.device_get(loop.state.committed)) - c0
+
+    healthy = commits_over(4)
+    cache = mp.run_ticks._cache_size()
+    # Fault leg ON: heavy drops eat the vote/quorum planes.
+    loop.set_fault_rates(drop=0.6)
+    degraded = commits_over(4)
+    # Fault leg OFF: the same compiled program recovers.
+    loop.set_fault_rates(drop=0.0)
+    commits_over(1)  # flush in-flight retries
+    recovered = commits_over(4)
+    assert mp.run_ticks._cache_size() == cache, "fault swap recompiled"
+    assert degraded < 0.7 * healthy, (healthy, degraded)
+    assert recovered > 0.9 * healthy, (healthy, recovered)
+    # The verb landed in the host span stream (trace-visible).
+    assert any(
+        s["name"] == "verb:set_fault_rates" for s in loop.host_spans
+    )
+
+
 def test_serve_rate_clamp_does_not_recompile():
     """set_rate between chunks rides the traced scalar: the whole SLO
     serve run compiles run_ticks exactly once for its chunk length."""
