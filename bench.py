@@ -51,6 +51,14 @@ dispatch spans), and a fault-injected SLO leg (shaped load + degraded
 FaultPlan -> queue-wait p99 breach -> alarm -> admission clamp via the
 traced rate -> p99 recovery). Capture artifact: SERVE_r01.json.
 
+``--checkpoint`` is a SEPARATE mode: the crash-tolerance budget — the
+serve loop at the same flagship shape with async alias-free
+checkpointing (tpu/checkpoint.py: the State copy enqueues behind chunk
+i, the disk write rides a writer thread overlapping later chunks),
+overhead vs the no-checkpoint serve budgeted < 2%; plus a recovery-evidence leg
+(bit-exact resume vs the uninterrupted twin, corrupt-newest-checkpoint
+fallback). Capture artifact: CHECKPOINT_r01.json.
+
 ``--multichip`` is a SEPARATE mode: it measures the multi-chip GSPMD
 scaling matrix of the compartmentalized backend
 (tpu/compartmentalized_batched.py sharded via parallel/sharding.py) on
@@ -1103,6 +1111,163 @@ def _serve_inner() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+def _checkpoint_inner() -> None:
+    """The crash-tolerance measurement (``--checkpoint``): the flagship
+    under the serve loop with async checkpointing (tpu/checkpoint.py).
+    Three legs:
+
+      1. no-checkpoint serve baseline: ServeLoop at the 10k-acceptor
+         flagship shape, checkpointing off;
+      2. checkpointed serve: the same ticks with an async on-disk
+         checkpoint at a production cadence (the alias-free snapshot
+         enqueues behind chunk i; the device_get + serialization +
+         disk write ride a writer thread overlapping later chunks) —
+         checkpoint overhead is the ticks/sec gap, budgeted < 2%;
+      3. recovery-evidence leg (small shape): an interrupted run
+         resumes from its checkpoint and replays the uninterrupted
+         twin sha256-identically, and a corrupted NEWEST checkpoint
+         falls back to the previous valid one.
+
+    One JSON line on stdout (BENCH_JSON ...). Capture artifact:
+    CHECKPOINT_r01.json."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+
+    from frankenpaxos_tpu.harness.serve import ServeConfig, ServeLoop
+    from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    G, W, K = 3334, 64, 8
+    CHUNK, CHUNKS, WARM_CHUNKS = 25, 30, 2
+    EVERY = 10  # chunks per checkpoint (~6 s of serve at this shape)
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=G, window=W, slots_per_tick=K,
+        lat_min=1, lat_max=3, retry_timeout=16, thrifty=True,
+    )
+
+    def timed_serve(ckpt_dir, every):
+        serve = ServeConfig(
+            chunk_ticks=CHUNK,
+            telemetry_window=max(2 * CHUNK, 128),
+            spans=0,
+            max_chunks=WARM_CHUNKS + CHUNKS,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=every,
+        )
+        loop = ServeLoop(mp, cfg, serve, seed=0)
+        report = loop.run()
+        dspans = [s for s in loop.host_spans if s["name"] == "dispatch"]
+        drains = [s for s in loop.host_spans if s["name"] == "drain"]
+        t0 = dspans[WARM_CHUNKS]["start_unix"]
+        t1 = drains[-1]["start_unix"] + drains[-1]["duration_s"]
+        ticks = report["ticks"] - WARM_CHUNKS * CHUNK
+        return report, ticks / max(t1 - t0, 1e-9)
+
+    # ---- 1+2. Overhead: no-checkpoint vs checkpointed serve at a
+    # production cadence (one durable snapshot every ~6 s at this
+    # shape; the alias-free copy is the only device-side cost, the
+    # serialization + disk write rides the writer thread).
+    base_report, base_tps = timed_serve(None, 0)
+    ck_dir = tempfile.mkdtemp(prefix="fpx_ckpt_bench_")
+    ck_report, ck_tps = timed_serve(ck_dir, EVERY)
+    overhead = 1.0 - ck_tps / base_tps
+    state_bytes = sum(
+        os.path.getsize(os.path.join(ck_dir, fn))
+        for fn in os.listdir(ck_dir)
+    )
+    steps_on_disk = len(os.listdir(ck_dir)) // 2
+    shutil.rmtree(ck_dir, ignore_errors=True)
+
+    # ---- 3. Recovery evidence at a small shape: bit-exact resume +
+    # corrupt-newest fallback (the same assertions the tier-1 tests
+    # pin; repeated here so the capture artifact carries them).
+    small = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, retry_timeout=8,
+        workload=WorkloadPlan(arrival="constant", rate=1.5),
+    )
+    d = tempfile.mkdtemp(prefix="fpx_ckpt_rec_")
+    try:
+        sv = dict(chunk_ticks=10, telemetry_window=32)
+        twin = ServeLoop(
+            mp, small,
+            ServeConfig(max_chunks=8, **sv), seed=1,
+        )
+        twin.run()
+        twin_digest = checkpoint_mod.state_digest(twin.state)
+        ck2 = os.path.join(d, "ck")
+        a = ServeLoop(
+            mp, small,
+            ServeConfig(
+                max_chunks=5, checkpoint_dir=ck2, checkpoint_every=2,
+                **sv,
+            ),
+            seed=1,
+        )
+        a.run()
+        b = ServeLoop.resume(
+            mp, small,
+            ServeConfig(
+                max_chunks=8, checkpoint_dir=ck2, checkpoint_every=2,
+                **sv,
+            ),
+        )
+        b.run()
+        bit_exact = checkpoint_mod.state_digest(b.state) == twin_digest
+        # Corrupt the newest checkpoint: flip bytes mid-npz; the loader
+        # must fall back to the previous valid step.
+        steps = checkpoint_mod.list_steps(ck2)
+        newest = os.path.join(d, "ck", f"ckpt_{steps[-1]:08d}.npz")
+        blob = bytearray(open(newest, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(newest, "wb").write(bytes(blob))
+        found = checkpoint_mod.latest_valid(
+            ck2, config_hash=checkpoint_mod.config_fingerprint(mp, small)
+        )
+        fallback_ok = (
+            found is not None
+            and found[0]["step"] == steps[-2]
+            and found[0].get("skipped")
+        )
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    result = {
+        "metric": "flagship serve mode: async checkpoint overhead + "
+        "bit-exact crash recovery",
+        "backend": "multipaxos",
+        "device": str(jax.devices()[0]),
+        "num_acceptors": cfg.num_acceptors,
+        "chunk_ticks": CHUNK,
+        "checkpoint_every_chunks": EVERY,
+        "checkpoint_period_s": round(EVERY * CHUNK / base_tps, 2),
+        "base_ticks_per_sec": round(base_tps, 2),
+        "checkpoint_ticks_per_sec": round(ck_tps, 2),
+        "checkpoint_overhead_fraction": round(overhead, 4),
+        "checkpoint_overhead_under_2pct": overhead < 0.02,
+        "checkpoints_written": ck_report["checkpoints_written"],
+        "checkpoint_steps_retained": steps_on_disk,
+        "checkpoint_bytes_on_disk": state_bytes,
+        "dropped_ticks": ck_report["dropped_ticks"],
+        "recovery_leg": {
+            "bit_exact_resume": bool(bit_exact),
+            "corrupt_newest_falls_back": bool(fallback_ok),
+        },
+        "ok": (
+            overhead < 0.02
+            and bool(bit_exact)
+            and bool(fallback_ok)
+            and ck_report["dropped_ticks"] == 0
+            and ck_report["checkpoints_written"] >= 3
+        ),
+        "measured_live": True,
+    }
+    print("BENCH_JSON " + json.dumps(result))
+
+
 def _lifecycle_inner() -> None:
     """The production-lifecycle measurement (``--lifecycle``): the
     flagship under tpu/lifecycle.py. Three legs:
@@ -1406,6 +1571,17 @@ def _serve_main() -> None:
     )
 
 
+def _checkpoint_main() -> None:
+    """Orchestrate the checkpoint measurement in a clean CPU
+    subprocess; print exactly one JSON line, exit 0."""
+    _subprocess_mode_main(
+        "--inner-checkpoint",
+        "flagship serve mode: async checkpoint overhead + bit-exact "
+        "crash recovery",
+        _cpu_env(),
+    )
+
+
 def _lifecycle_main() -> None:
     """Orchestrate the lifecycle measurement in a clean CPU subprocess;
     print exactly one JSON line, exit 0."""
@@ -1699,6 +1875,8 @@ if __name__ == "__main__":
         _workload_inner()
     elif "--inner-serve" in sys.argv:
         _serve_inner()
+    elif "--inner-checkpoint" in sys.argv:
+        _checkpoint_inner()
     elif "--inner-lifecycle" in sys.argv:
         _lifecycle_inner()
     elif "--inner" in sys.argv:
@@ -1709,6 +1887,8 @@ if __name__ == "__main__":
         _workload_main()
     elif "--serve" in sys.argv:
         _serve_main()
+    elif "--checkpoint" in sys.argv:
+        _checkpoint_main()
     elif "--lifecycle" in sys.argv:
         _lifecycle_main()
     else:

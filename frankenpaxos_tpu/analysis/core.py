@@ -28,8 +28,12 @@ from frankenpaxos_tpu.analysis import astutil
 # the plan itself) and two trace rules (trace-workload-noop: the none
 # plan is all-empty state feeding zero tick equations;
 # trace-workload-retrace: the traced [rate x fault-rate] sweep never
-# grows the jit cache).
-ANALYSIS_VERSION = "1.6"
+# grows the jit cache). 1.7: the crash-tolerance contracts —
+# checkpoint-alias-free (the serve loop's jitted full-State snapshot
+# aliases no input and carries no host callback) and
+# trace-checkpoint-restore (save -> load -> restore is bit-exact and
+# replays the existing compiled run_ticks with a flat jit cache).
+ANALYSIS_VERSION = "1.7"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

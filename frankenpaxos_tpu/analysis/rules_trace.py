@@ -1141,3 +1141,144 @@ def check_serve_nosync(ctx: Context) -> List[Finding]:
                 )
             )
     return out
+
+
+@rule(
+    "checkpoint-alias-free",
+    "trace",
+    "the crash-tolerance snapshot (tpu/checkpoint.py snapshot_tree: "
+    "the jitted full-State copy the serve loop enqueues every N "
+    "chunks) compiles alias-free — no output aliases an input buffer "
+    "(the next chunk's donation would reuse it while the disk drain "
+    "still reads it) and no host callback rides the hot path",
+)
+def check_checkpoint_alias_free(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
+
+    backend = "multipaxos"  # the flagship serve target
+    if ctx.backends is not None and backend not in ctx.backends:
+        return []
+    out: List[Finding] = []
+    mod = _module(backend)
+    cfg = mod.analysis_config()
+    tree = {"state": mod.init_state(cfg), "t": jnp.zeros((), jnp.int32)}
+    hlo = checkpoint_mod.lower_snapshot(tree).compile().as_text()
+    aliased = _alias_param_indices(hlo)
+    if aliased:
+        out.append(
+            Finding(
+                rule="checkpoint-alias-free",
+                path=backend,
+                line=0,
+                message=(
+                    f"the compiled checkpoint snapshot ALIASES "
+                    f"{len(aliased)} input buffer(s) — the disk drain "
+                    "would read buffers the next chunk's donation "
+                    "already reused; the snapshot must copy"
+                ),
+                key=f"{backend}:aliased",
+            )
+        )
+    for i, line in enumerate(hlo.splitlines()):
+        lowered = line.lower()
+        hit = None
+        if "custom-call" in lowered and (
+            "callback" in lowered or "host_compute" in lowered
+        ):
+            hit = "host callback custom-call"
+        elif " infeed(" in lowered or " outfeed(" in lowered:
+            hit = "infeed/outfeed"
+        if hit:
+            out.append(
+                Finding(
+                    rule="checkpoint-alias-free",
+                    path=backend,
+                    line=i + 1,
+                    message=(
+                        f"{hit} in the compiled checkpoint snapshot — "
+                        "the serve hot path would block on the host "
+                        "every checkpoint"
+                    ),
+                    key=f"{backend}:{hit}",
+                )
+            )
+    return out
+
+
+@rule(
+    "trace-checkpoint-restore",
+    "trace",
+    "checkpoint restore is recompile-free: a State saved to disk "
+    "(tpu/checkpoint.py), loaded back, and rebuilt onto a fresh "
+    "template replays the EXISTING compiled run_ticks — the restore "
+    "path preserves every leaf's dtype/shape/commitment so the jit "
+    "cache stays flat (no cold recompile beyond process start)",
+)
+def check_checkpoint_restore(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
+
+    backend = "multipaxos"  # the flagship serve target
+    if ctx.backends is not None and backend not in ctx.backends:
+        return []
+    out: List[Finding] = []
+    mod = _module(backend)
+    cfg = mod.analysis_config()
+
+    def run(st, t0):
+        st, t = mod.run_ticks(
+            cfg, st, t0, _TICKS, jax.random.PRNGKey(0)
+        )
+        jax.block_until_ready(t)
+        return st, t
+
+    state, t = run(mod.init_state(cfg), jnp.zeros((), jnp.int32))
+    before = mod.run_ticks._cache_size()
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint_mod.save_state(d, mod, cfg, state, t, step=0)
+        restored, t_r, manifest = checkpoint_mod.restore_state(
+            d, mod, cfg, mod.init_state(cfg)
+        )
+    if checkpoint_mod.state_digest(restored) != (
+        checkpoint_mod.state_digest(state)
+    ):
+        out.append(
+            Finding(
+                rule="trace-checkpoint-restore",
+                path=backend,
+                line=0,
+                message=(
+                    "save -> load -> restore is not bit-exact: the "
+                    "restored State's digest differs from the saved "
+                    "one"
+                ),
+                key=f"{backend}:digest",
+            )
+        )
+    run(restored, t_r)
+    after = mod.run_ticks._cache_size()
+    if after > before:
+        out.append(
+            Finding(
+                rule="trace-checkpoint-restore",
+                path=backend,
+                line=0,
+                message=(
+                    "run_ticks on a RESTORED state missed the jit "
+                    f"cache ({before} -> {after} entries) — the "
+                    "restore path changed a leaf's dtype/shape/weak "
+                    "type and every crash recovery recompiles the "
+                    "serve loop"
+                ),
+                key=backend,
+            )
+        )
+    return out

@@ -1,0 +1,573 @@
+"""Kill-and-recover harness: SIGKILL a live serve process at randomized
+chunk boundaries, restart it from the latest checkpoint, and assert the
+crash-tolerance contract end to end.
+
+This is the HOST-process half of simulation testing (the device-side
+half is ``harness/simtest.py``; the in-graph kill-restart twin is
+``simtest.run_crash_restart_schedule``): a real subprocess runs the
+serve loop (``harness/serve.py``) with async checkpointing
+(``tpu/checkpoint.py``), a supervisor SIGKILLs it at chunk boundaries
+drawn from a deterministic rng — the new schedule axis — and restarts
+it with ``ServeLoop.resume``. After the final restart completes the run,
+the harness asserts
+
+  * **liveness** — the run reaches its full chunk budget despite every
+    kill (progress strictly resumes after each restart);
+  * **invariants** — the backend's full ``check_invariants`` suite
+    (conservation, quorum safety, lifecycle books) holds on the final
+    state;
+  * **exactly-once client effects** — the PR 11 session table's books
+    reconcile (``lifecycle_ok``: cache hits <= resubmits, completion
+    totals == the workload engine's) across every restart: a crash
+    never double-applies a client command because the table IS state
+    and restores with it;
+  * **bit-exact recovery** — the final State digest equals an
+    uninterrupted twin's (the resume replays the twin sha256-identical).
+
+A supervising WATCHDOG covers the hang failure mode SIGKILL testing
+can't: the worker heartbeats a progress file every chunk; if the file
+goes stale for longer than the hang timeout (a hung dispatch — e.g. a
+wedged device runtime), the supervisor SIGKILLs and restarts it the
+same way, with CAPPED EXPONENTIAL BACKOFF between restarts so a
+crash-looping worker can't spin the host.
+
+CLI::
+
+    # the supervised worker (what the supervisor spawns):
+    python -m frankenpaxos_tpu.harness.recovery --worker \\
+        --out-dir /tmp/rec --chunks 12 --every 2 [--resume]
+
+    # one SIGKILL-mid-serve + recover + verify (the CI smoke leg):
+    python -m frankenpaxos_tpu.harness.recovery --smoke --out-dir /tmp/rec
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random as _random
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+# NOTE: jax is imported lazily inside worker/twin code paths so the
+# supervisor process stays light (it only spawns/kills subprocesses).
+
+HEARTBEAT_FILE = "progress.json"
+FINAL_FILE = "final.json"
+CKPT_SUBDIR = "checkpoints"
+
+
+# ---------------------------------------------------------------------------
+# Worker: the supervised serve process
+# ---------------------------------------------------------------------------
+
+
+def _worker_cfg(args):
+    """The worker's backend config: small flagship (or
+    compartmentalized) shape with the session table + shaped workload
+    engaged, so the exactly-once and conservation assertions have
+    teeth."""
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    workload = WorkloadPlan(
+        arrival="constant", rate=1.5, backlog_cap=128
+    )
+    lifecycle = LifecyclePlan(
+        sessions=args.sessions, resubmit_rate=args.resubmit_rate
+    )
+    if args.backend == "compartmentalized":
+        from frankenpaxos_tpu.tpu import compartmentalized_batched as mod
+
+        cfg = mod.analysis_config(workload=workload, lifecycle=lifecycle)
+    else:
+        from frankenpaxos_tpu.tpu import multipaxos_batched as mod
+
+        cfg = mod.BatchedMultiPaxosConfig(
+            f=1, num_groups=args.groups, window=16, slots_per_tick=2,
+            retry_timeout=8, workload=workload, lifecycle=lifecycle,
+        )
+    return mod, cfg
+
+
+class _SupervisedLoop:
+    """A ServeLoop wrapper that heartbeats a progress file after every
+    drained chunk (the watchdog's liveness signal), optionally paces
+    chunks (so a supervisor's kill schedule lands mid-serve rather than
+    after a toy run finishes), and can simulate a hung dispatch for the
+    watchdog tests."""
+
+    def __init__(
+        self,
+        loop,
+        out_dir: str,
+        hang_after: Optional[int],
+        chunk_delay: float = 0.0,
+    ):
+        self.loop = loop
+        self.out_dir = out_dir
+        self.hang_after = hang_after
+        self.chunk_delay = chunk_delay
+        loop_drain = loop._drain
+
+        def drain_and_heartbeat(snap):
+            out = loop_drain(snap)
+            self._heartbeat()
+            if (
+                self.hang_after is not None
+                and self.loop._chunks >= self.hang_after
+            ):
+                while True:  # a hung dispatch: heartbeats stop cold
+                    time.sleep(3600)
+            if self.chunk_delay:
+                time.sleep(self.chunk_delay)
+            return out
+
+        loop._drain = drain_and_heartbeat
+
+    def _heartbeat(self, phase: str = "serving"):
+        # phase="startup" marks the pre-run heartbeat (imports done,
+        # first chunk may still be COLD-COMPILING): the watchdog
+        # exempts it from hang_timeout — a long cold jit compile is
+        # not a hung dispatch (the supervisor's spawn_timeout still
+        # bounds a worker truly wedged in compile).
+        payload = {
+            "chunks": int(self.loop._chunks),
+            "ticks": int(self.loop.cursor.tick),
+            "time": time.time(),
+            "phase": phase,
+        }
+        tmp = os.path.join(self.out_dir, HEARTBEAT_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.out_dir, HEARTBEAT_FILE))
+
+
+def run_worker(args) -> int:
+    """The worker body: fresh start or resume from the newest valid
+    checkpoint, serve to the chunk budget, then write the final report
+    (state digest + invariants + lifecycle books)."""
+    import jax
+
+    from frankenpaxos_tpu.harness.serve import ServeConfig, ServeLoop
+    from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
+    from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+
+    # Persistent XLA compilation cache: a restarted worker recompiles
+    # nothing the killed one already built — across restarts the one
+    # true cold start is the only compile (the serve-session analog of
+    # the tests' conftest cache).
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "FRANKENPAXOS_JAX_CACHE", "/tmp/frankenpaxos_jax_cache"
+            ),
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
+    except Exception:
+        pass  # older jax without the persistent cache: run uncached
+
+    mod, cfg = _worker_cfg(args)
+    os.makedirs(args.out_dir, exist_ok=True)
+    ckpt_dir = os.path.join(args.out_dir, CKPT_SUBDIR)
+    serve = ServeConfig(
+        chunk_ticks=args.chunk_ticks,
+        telemetry_window=max(2 * args.chunk_ticks, 64),
+        max_chunks=args.chunks,
+        checkpoint_dir=None if args.no_checkpoint else ckpt_dir,
+        checkpoint_every=0 if args.no_checkpoint else args.every,
+    )
+    resumed = False
+    loop = None
+    if args.resume:
+        # ONE load+verify: resume raises CheckpointError when no valid
+        # checkpoint exists for this config (fresh dir, all torn, or
+        # stale fingerprints) — the fresh-start fallback. Probing with
+        # latest_valid first would read + CRC the whole npz twice.
+        try:
+            loop = ServeLoop.resume(mod, cfg, serve)
+            resumed = True
+        except checkpoint_mod.CheckpointError:
+            pass
+    if loop is None:
+        loop = ServeLoop(mod, cfg, serve, seed=args.seed)
+    sup = _SupervisedLoop(
+        loop, args.out_dir,
+        hang_after=args.hang_after if args.hang_after >= 0 else None,
+        chunk_delay=args.chunk_delay,
+    )
+    sup._heartbeat(phase="startup")
+    report = loop.run()
+    inv = {
+        k: bool(v)
+        for k, v in mod.check_invariants(cfg, loop.state, loop.t).items()
+    }
+    lc_plan = getattr(cfg, "lifecycle", None)
+    final = {
+        "digest": checkpoint_mod.state_digest(loop.state),
+        "invariants": inv,
+        "invariants_ok": all(inv.values()),
+        "ticks": report["ticks"],
+        "chunks": loop._chunks,
+        "resumed": resumed,
+        "resumed_from": loop.resumed_from,
+        "report": {k: v for k, v in report.items() if k != "totals"},
+        "totals": report["totals"],
+        "lifecycle": (
+            lifecycle_mod.summary(lc_plan, loop.state.lifecycle)
+            if lc_plan is not None and lc_plan.active
+            else None
+        ),
+    }
+    jax.block_until_ready(loop.state)
+    tmp = os.path.join(args.out_dir, FINAL_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(final, f, indent=1)
+    os.replace(tmp, os.path.join(args.out_dir, FINAL_FILE))
+    return 0 if final["invariants_ok"] else 3
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: randomized SIGKILL schedule + watchdog + capped backoff
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    ok: bool
+    kills: List[int]
+    watchdog_kills: int
+    restarts: int
+    backoffs: List[float]
+    final: Optional[dict]
+    notes: List[str]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _read_progress(out_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(out_dir, HEARTBEAT_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _spawn_worker(out_dir: str, argv_extra: List[str], resume: bool):
+    # Clear the PREVIOUS worker's heartbeat: the watchdog must never
+    # judge a fresh worker (still importing/compiling) by its
+    # predecessor's stale timestamps.
+    try:
+        os.unlink(os.path.join(out_dir, HEARTBEAT_FILE))
+    except OSError:
+        pass
+    argv = [
+        sys.executable, "-m", "frankenpaxos_tpu.harness.recovery",
+        "--worker", "--out-dir", out_dir, *argv_extra,
+    ]
+    if resume:
+        argv.append("--resume")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(out_dir, "worker.log"), "a")
+    return subprocess.Popen(argv, stdout=log, stderr=log, env=env), log
+
+
+def run_kill_recover(
+    out_dir: str,
+    *,
+    chunks: int = 12,
+    every: int = 2,
+    chunk_ticks: int = 10,
+    seed: int = 0,
+    backend: str = "multipaxos",
+    kill_seed: int = 0,
+    max_kills: int = 2,
+    chunk_delay: float = 0.0,
+    hang_after: int = -1,
+    hang_timeout: float = 20.0,
+    backoff_base: float = 0.2,
+    backoff_cap: float = 5.0,
+    max_restarts: int = 8,
+    poll: float = 0.2,
+    spawn_timeout: float = 600.0,
+) -> SupervisorResult:
+    """Run the supervised worker to completion under a randomized
+    SIGKILL schedule. Kill points (chunk counts) are drawn from a
+    deterministic rng over the checkpointed boundaries; each restart
+    resumes from the latest valid checkpoint, with capped exponential
+    backoff between restarts; a heartbeat staler than ``hang_timeout``
+    triggers a watchdog SIGKILL + restart (the hung-dispatch path).
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    for fn in (HEARTBEAT_FILE, FINAL_FILE):
+        try:
+            os.unlink(os.path.join(out_dir, fn))
+        except OSError:
+            pass
+    rng = _random.Random(kill_seed * 9973 + seed)
+    # Randomized kill points: chunk boundaries strictly AFTER the first
+    # checkpoint is durable (the boundary-`every` write lands while
+    # chunk every+1 computes, so the first killable heartbeat is
+    # every+1 — killing earlier would leave an empty checkpoint dir and
+    # the 'recovery' would silently degrade to a fresh bit-exact rerun),
+    # strictly increasing, never the final boundary.
+    candidates = list(range(every + 1, chunks - 1))
+    kill_points = sorted(
+        rng.sample(candidates, min(max_kills, len(candidates)))
+    ) if candidates else []
+    argv_extra = [
+        "--chunks", str(chunks), "--every", str(every),
+        "--chunk-ticks", str(chunk_ticks), "--seed", str(seed),
+        "--backend", backend,
+    ]
+    if chunk_delay:
+        argv_extra += ["--chunk-delay", str(chunk_delay)]
+    if hang_after >= 0:
+        argv_extra += ["--hang-after", str(hang_after)]
+
+    kills: List[int] = []
+    backoffs: List[float] = []
+    notes: List[str] = []
+    watchdog_kills = 0
+    restarts = 0
+    resume = False
+    final = None
+    proc, log = _spawn_worker(out_dir, argv_extra, resume)
+    deadline = time.monotonic() + spawn_timeout
+
+    def restart_worker() -> bool:
+        """Capped-exponential-backoff restart (shared by the crash-exit,
+        scheduled-kill, and watchdog paths). False = budget exhausted."""
+        nonlocal proc, log, restarts, resume
+        if restarts >= max_restarts:
+            notes.append("restart budget exhausted")
+            return False
+        delay = min(backoff_cap, backoff_base * (2 ** restarts))
+        backoffs.append(delay)
+        time.sleep(delay)
+        restarts += 1
+        resume = True
+        log.close()
+        proc, log = _spawn_worker(out_dir, argv_extra, resume)
+        return True
+
+    try:
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                final_path = os.path.join(out_dir, FINAL_FILE)
+                if rc == 0 and os.path.exists(final_path):
+                    with open(final_path) as f:
+                        final = json.load(f)
+                    break
+                notes.append(f"worker exited rc={rc} without a report")
+                if not restart_worker():
+                    break
+                continue
+            if time.monotonic() > deadline:
+                notes.append("supervisor timeout")
+                proc.kill()
+                break
+            prog = _read_progress(out_dir)
+            now = time.time()
+            if (
+                kill_points
+                and len(kills) < len(kill_points)
+                and prog is not None
+                and prog["chunks"] >= kill_points[len(kills)]
+            ):
+                # The scheduled SIGKILL: no shutdown path runs, the OS
+                # reaps the process mid-serve.
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait()
+                kills.append(prog["chunks"])
+                if not restart_worker():
+                    break
+                continue
+            if (
+                prog is not None
+                and prog.get("phase") != "startup"
+                and now - prog["time"] > hang_timeout
+            ):
+                # Watchdog: heartbeats went stale — a hung dispatch.
+                # Startup-phase heartbeats are exempt: the worker may
+                # be cold-compiling its first chunk (spawn_timeout is
+                # that phase's bound).
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait()
+                watchdog_kills += 1
+                notes.append(
+                    f"watchdog killed a hung worker at chunk "
+                    f"{prog['chunks']}"
+                )
+                # A deliberately-hung worker (--hang-after) would hang
+                # again: drop the hang flag for the restart, exactly
+                # like an operator rolling a bad build back.
+                argv_extra = [
+                    a for i, a in enumerate(argv_extra)
+                    if a != "--hang-after"
+                    and (i == 0 or argv_extra[i - 1] != "--hang-after")
+                ]
+                if not restart_worker():
+                    break
+                continue
+            time.sleep(poll)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        log.close()
+    ok = (
+        final is not None
+        and final["invariants_ok"]
+        and final["chunks"] == chunks
+    )
+    return SupervisorResult(
+        ok=ok,
+        kills=kills,
+        watchdog_kills=watchdog_kills,
+        restarts=restarts,
+        backoffs=backoffs,
+        final=final,
+        notes=notes,
+    )
+
+
+def uninterrupted_digest(
+    *,
+    chunks: int,
+    every: int,
+    chunk_ticks: int,
+    seed: int,
+    backend: str,
+    out_dir: str,
+) -> dict:
+    """The twin: the same worker run IN PROCESS with no kills — its
+    final digest is what a killed-and-recovered run must reproduce
+    bit for bit. Checkpointing stays ON (same config, same hot path;
+    checkpoints are observationally free — the copy is alias-free and
+    the State never reads the disk)."""
+    import argparse
+
+    args = argparse.Namespace(
+        out_dir=out_dir, chunks=chunks, every=every,
+        chunk_ticks=chunk_ticks, seed=seed, backend=backend,
+        resume=False, hang_after=-1, no_checkpoint=False,
+        sessions=4, resubmit_rate=0.1, groups=8, chunk_delay=0.0,
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    rc = run_worker(args)
+    assert rc == 0, f"twin worker failed rc={rc}"
+    with open(os.path.join(out_dir, FINAL_FILE)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="frankenpaxos_tpu.harness.recovery")
+    p.add_argument("--worker", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="one SIGKILL-mid-serve + recover + bit-exact "
+                   "verify (the CI leg)")
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--chunks", type=int, default=12)
+    p.add_argument("--every", type=int, default=2)
+    p.add_argument("--chunk-ticks", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--backend", default="multipaxos",
+                   choices=("multipaxos", "compartmentalized"))
+    p.add_argument("--groups", type=int, default=8)
+    p.add_argument("--sessions", type=int, default=4)
+    p.add_argument("--resubmit-rate", type=float, default=0.1)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--no-checkpoint", action="store_true")
+    p.add_argument("--chunk-delay", type=float, default=0.0,
+                   help="worker: seconds slept per chunk (paces the "
+                   "run so supervisor kill points land mid-serve)")
+    p.add_argument("--hang-after", type=int, default=-1,
+                   help="worker: stop heartbeating after this many "
+                   "chunks (watchdog test)")
+    p.add_argument("--kill-seed", type=int, default=0)
+    p.add_argument("--max-kills", type=int, default=2)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+
+    if args.smoke:
+        kill_dir = os.path.join(args.out_dir, "killed")
+        twin_dir = os.path.join(args.out_dir, "twin")
+        res = run_kill_recover(
+            kill_dir,
+            chunks=args.chunks, every=args.every,
+            chunk_ticks=args.chunk_ticks, seed=args.seed,
+            backend=args.backend, kill_seed=args.kill_seed,
+            max_kills=1,
+            chunk_delay=args.chunk_delay or 0.15,
+            poll=0.05,
+        )
+        assert res.ok, res.to_dict()
+        assert res.kills, "smoke drew no kill point"
+        # The final worker must have RESUMED from a checkpoint — a
+        # fresh rerun would reproduce the twin digest too (same seed,
+        # deterministic), so without this the smoke could pass without
+        # ever exercising ServeLoop.resume.
+        assert res.final.get("resumed"), (
+            "killed worker restarted fresh instead of resuming "
+            f"(no durable checkpoint at kill time?): {res.to_dict()}"
+        )
+        twin = uninterrupted_digest(
+            chunks=args.chunks, every=args.every,
+            chunk_ticks=args.chunk_ticks, seed=args.seed,
+            backend=args.backend, out_dir=twin_dir,
+        )
+        assert res.final["digest"] == twin["digest"], (
+            "recovered run diverged from the uninterrupted twin:\n"
+            f"  recovered {res.final['digest']}\n"
+            f"  twin      {twin['digest']}"
+        )
+        lc = res.final.get("lifecycle") or {}
+        assert lc.get("cache_hits", 0) <= lc.get("resubmits", 0)
+        print(json.dumps({
+            "recovery_smoke": "PASS",
+            "kills": res.kills,
+            "restarts": res.restarts,
+            "digest": res.final["digest"],
+            "bit_exact_vs_twin": True,
+            "invariants_ok": res.final["invariants_ok"],
+            "lifecycle": lc,
+        }))
+        return 0
+
+    res = run_kill_recover(
+        args.out_dir,
+        chunks=args.chunks, every=args.every,
+        chunk_ticks=args.chunk_ticks, seed=args.seed,
+        backend=args.backend, kill_seed=args.kill_seed,
+        max_kills=args.max_kills, chunk_delay=args.chunk_delay,
+    )
+    print(json.dumps(res.to_dict()))
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,7 +46,18 @@ On top of the drain sit the streaming consumers:
     ``FaultPlan(traced=True)`` config), and the production-lifecycle
     verbs ``reconfigure``/``swap_acceptor``/``rotate``
     (tpu/lifecycle.py: traced acceptor-membership epochs + forced
-    window rolls).
+    window rolls);
+  * CRASH TOLERANCE (tpu/checkpoint.py): every ``checkpoint_every``
+    chunks an ALIAS-FREE jitted copy of the full State enqueues behind
+    the chunk (the same double-buffer discipline as the drain — no
+    added block_until_ready) and drains to a versioned, checksummed,
+    torn-write-safe on-disk checkpoint on a writer thread;
+    :meth:`ServeLoop.resume` restores it BIT-EXACTLY (state, tick,
+    PRNG position, cursors, SLO context) so a killed run's resumed
+    twin replays the uninterrupted run sha256-identically — pinned by
+    ``tests/test_checkpoint.py`` and the ``checkpoint-alias-free`` /
+    ``trace-checkpoint-restore`` rules, exercised for real by
+    ``harness/recovery.py`` (SIGKILL + watchdog + backoff).
 
 CLI (a bounded run of the flagship)::
 
@@ -60,6 +71,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
@@ -69,6 +81,7 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.monitoring import scrape as scrape_mod
 from frankenpaxos_tpu.monitoring import traceviz
 from frankenpaxos_tpu.monitoring.slo import SloEngine, SloPolicy
+from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
@@ -86,6 +99,14 @@ class ServeConfig:
     trace_path: Optional[str] = None  # Perfetto trace written at shutdown
     max_chunks: Optional[int] = None
     max_seconds: Optional[float] = None
+    # Crash tolerance (tpu/checkpoint.py): every checkpoint_every
+    # chunks, enqueue a jitted ALIAS-FREE copy of the full State and
+    # drain it to a versioned on-disk checkpoint while the next chunk
+    # computes (the telemetry drain's double-buffer discipline — zero
+    # added block_until_ready). checkpoint_keep prunes old steps.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
+    checkpoint_keep: int = 3
 
     def __post_init__(self):
         assert self.chunk_ticks >= 1
@@ -96,17 +117,23 @@ class ServeConfig:
         assert self.max_chunks is not None or self.max_seconds is not None, (
             "bound the loop with max_chunks and/or max_seconds"
         )
+        assert self.checkpoint_every >= 0
+        if self.checkpoint_dir is not None:
+            assert self.checkpoint_every >= 1, (
+                "checkpoint_dir needs checkpoint_every >= 1"
+            )
+        assert self.checkpoint_keep >= 1
 
 
-def _copy_tree(tree):
-    """Jit-compiled device-side copy: outputs are FRESH buffers (the
-    inputs are not donated, so XLA must materialize copies), which is
-    what lets the drain read them after the next chunk donates the
-    state they were copied from."""
-    return jax.tree_util.tree_map(jnp.copy, tree)
-
-
-_SNAP = jax.jit(_copy_tree)
+# The jitted device-side copy whose outputs are FRESH buffers (inputs
+# not donated, so XLA must materialize copies) — what lets a drain read
+# them after the next chunk donates the state they were copied from.
+# ONE implementation, shared with tpu/checkpoint.py: the telemetry
+# snapshot and the full-State checkpoint snapshot run the same program,
+# so the trace-serve-nosync and checkpoint-alias-free rules pin the
+# same copy machinery.
+_copy_tree = checkpoint_mod._copy_tree
+_SNAP = checkpoint_mod._SNAP
 
 
 def snapshot_leaves(state) -> Dict[str, Any]:
@@ -153,6 +180,7 @@ class ServeLoop:
         self.mod = mod
         self.cfg = cfg
         self.serve = serve
+        self.seed = seed
         self.key = jax.random.PRNGKey(seed)
         self.state = mod.init_state(cfg)
         self.state = dataclasses.replace(
@@ -179,6 +207,15 @@ class ServeLoop:
         self._chunks = 0
         self._epoch = 0
         self.clean_shutdown = False
+        # Crash tolerance (tpu/checkpoint.py).
+        self._ckpt_step = 0  # next on-disk checkpoint step
+        self._last_ckpt_chunks = -1  # chunk count of the last snapshot
+        self._pending_ckpt = None  # (snapshot futures, manifest meta)
+        self._resume_snap = None  # pending drain of the restored chunk
+        self.checkpoints_written = 0
+        self.checkpoint_errors: List[str] = []  # failed writer steps
+        self.restores = 0
+        self.resumed_from: Optional[dict] = None
 
     # -- host-side trace spans (also jax.profiler-annotated) ---------------
 
@@ -268,6 +305,244 @@ class ServeLoop:
             ),
         )
         self._span("verb:rotate", time.time(), time.perf_counter())
+
+    # -- crash tolerance: async checkpoint + bit-exact resume --------------
+    # Every checkpoint_every chunks the loop enqueues a jitted
+    # alias-free copy of the FULL state (+ tick scalar) right behind the
+    # just-dispatched chunk, then writes it to disk while the NEXT
+    # chunk computes — the telemetry drain's double-buffer discipline
+    # applied to durability: the hot path gains no block_until_ready
+    # (the disk drain's device_get waits only for work that already
+    # finished or is finishing). Because the PRNG is counter-based and
+    # fully in-state, restoring the checkpoint plus the small host
+    # context below resumes the run BIT-EXACTLY: the resumed run's
+    # final State is sha256-identical to the uninterrupted twin's
+    # (tests/test_checkpoint.py pins 3-seed twins for the flagship and
+    # compartmentalized backends with kernels + FaultPlans engaged).
+
+    def _host_context(self) -> dict:
+        """Everything OUTSIDE the State pytree that bit-exact resume
+        needs: the PRNG seed + chunk epoch (per-chunk keys are
+        fold_in(PRNGKey(seed), epoch)), the drain-cursor position, and
+        the SLO engine's full decision state + previous-drain
+        cumulatives (so post-resume clamp decisions replay the twin's)."""
+        ctx = {
+            "seed": int(self.seed),
+            "epoch": int(self._epoch),
+            "chunks": int(self._chunks),
+            "ckpt_step": int(self._ckpt_step),
+            "cursor_tick": int(self.cursor.tick),
+            "cursor_span": int(self.cursor.span),
+            "prev": checkpoint_mod.jsonable(self._prev),
+            "slo": self.slo.to_state() if self.slo is not None else None,
+        }
+        return ctx
+
+    def _should_checkpoint(self) -> bool:
+        serve = self.serve
+        return (
+            serve.checkpoint_dir is not None
+            and serve.checkpoint_every > 0
+            and self._chunks > 0
+            and self._chunks % serve.checkpoint_every == 0
+            and self._chunks != self._last_ckpt_chunks
+        )
+
+    def _begin_checkpoint(self):
+        """Enqueue the alias-free snapshot + capture the host context
+        NOW (before the next dispatch mutates epoch/chunks). No
+        blocking call."""
+        start, t0 = time.time(), time.perf_counter()
+        snap = checkpoint_mod.snapshot_tree(
+            {"state": self.state, "t": self.t}
+        )
+        self._pending_ckpt = (snap, self._host_context())
+        self._last_ckpt_chunks = self._chunks
+        self._span("checkpoint:snapshot", start, t0,
+                   step=self._ckpt_step)
+
+    def _finish_checkpoint(self):
+        """Drain the pending snapshot to a versioned on-disk checkpoint
+        (write-to-temp-then-rename, per-leaf checksums) — called right
+        after the NEXT chunk dispatches. The device_get waits only for
+        the alias-free copy (already finished or finishing behind the
+        checkpointed chunk); the serialization + disk write then runs
+        on a WRITER THREAD so it overlaps the new chunk's compute
+        instead of delaying its successor's dispatch. At most one
+        writer is in flight (joined here and at shutdown), so steps
+        land on disk in order."""
+        snap, ctx = self._pending_ckpt
+        self._pending_ckpt = None
+        # The pull waits only for the alias-free copy (enqueued behind
+        # the checkpointed chunk — already finished or finishing). On
+        # the CPU backend device_get returns zero-copy VIEWS of the XLA
+        # buffers, so the writer closure captures ``snap`` too: the jax
+        # Arrays stay strongly referenced until the write lands, and
+        # the buffers the views point into cannot be reclaimed under
+        # the writer thread (the snapshot is never donated — dropping
+        # the last reference is the only way they'd be freed). The big
+        # flatten/serialize work stays OFF the loop thread so it
+        # overlaps the next chunk's compute.
+        host = jax.device_get(snap)
+        tick = int(host["t"])
+        meta = {
+            "config_hash": checkpoint_mod.config_fingerprint(
+                self.mod, self.cfg
+            ),
+            "backend": self.mod.__name__.rsplit(".", 1)[-1],
+            "tick": tick,
+            "chunk_ticks": self.serve.chunk_ticks,
+            "telemetry_window": telemetry_mod.window(
+                host["state"].telemetry
+            ),
+            "spans": telemetry_mod.span_slots(host["state"].telemetry),
+            "host": ctx,
+        }
+        step = self._ckpt_step
+        self._ckpt_step += 1
+        self._join_ckpt_writer()
+
+        def write(_snap_keepalive=snap):
+            # The writer touches NO loop-thread state directly: its
+            # span and any error are stashed and merged by the loop
+            # thread at join time (a direct host_spans.append would
+            # race _drain's scrape cursor and drop spans from the CSV).
+            start, t0 = time.time(), time.perf_counter()
+            try:
+                leaves = checkpoint_mod.flatten_state(host["state"])
+                leaves["__t__"] = host["t"]
+                checkpoint_mod.save_checkpoint(
+                    self.serve.checkpoint_dir,
+                    leaves=leaves,
+                    meta=meta,
+                    step=step,
+                    keep=self.serve.checkpoint_keep,
+                )
+            except BaseException as e:  # noqa: BLE001 — a durability
+                # failure (ENOSPC, lost permissions, torn dir) must
+                # surface in the report, not die silently with the
+                # daemon thread.
+                self._ckpt_writer_result = (
+                    None, f"checkpoint step {step}: {e!r}"
+                )
+                return
+            self._ckpt_writer_result = (
+                {
+                    "name": "checkpoint:write",
+                    "start_unix": start,
+                    "duration_s": time.perf_counter() - t0,
+                    "step": step,
+                    "tick": tick,
+                },
+                None,
+            )
+
+        import threading
+
+        self._ckpt_writer_result = None
+        self._ckpt_writer = threading.Thread(
+            target=write, name=f"ckpt-writer-{step}", daemon=True
+        )
+        self._ckpt_writer.start()
+
+    def _join_ckpt_writer(self):
+        writer = getattr(self, "_ckpt_writer", None)
+        if writer is not None:
+            writer.join()
+            self._ckpt_writer = None
+            span, err = (
+                getattr(self, "_ckpt_writer_result", None) or (None, None)
+            )
+            self._ckpt_writer_result = None
+            if err is not None:
+                self.checkpoint_errors.append(err)
+                print(f"serve: checkpoint write FAILED: {err}",
+                      file=sys.stderr)
+            elif span is not None:
+                self.checkpoints_written += 1
+                self.host_spans.append(span)
+
+    @classmethod
+    def resume(
+        cls,
+        mod,
+        cfg,
+        serve: ServeConfig,
+        ckpt_dir: Optional[str] = None,
+    ) -> "ServeLoop":
+        """Restore the newest VALID checkpoint (torn/corrupt/stale
+        manifests are skipped — the automatic fallback) and return a
+        loop that continues the run bit-exactly: State, tick, PRNG
+        position, drain cursors, and the SLO/clamp context all resume
+        where the checkpoint froze them. The restored state reuses the
+        template's exact dtypes/shapes, so in-process the next
+        run_ticks hits the existing jit cache (the
+        ``trace-checkpoint-restore`` rule); across a process restart
+        the one cold-start compile is the only compile."""
+        ckpt_dir = ckpt_dir or serve.checkpoint_dir
+        assert ckpt_dir, "resume needs a checkpoint directory"
+        found = checkpoint_mod.latest_valid(
+            ckpt_dir,
+            config_hash=checkpoint_mod.config_fingerprint(mod, cfg),
+        )
+        if found is None:
+            raise checkpoint_mod.CheckpointError(
+                f"no valid checkpoint for this config under {ckpt_dir}"
+            )
+        manifest, arrays = found
+        ctx = manifest["host"]
+        self = cls(mod, cfg, serve, seed=int(ctx["seed"]))
+        # Per-chunk PRNG keys are fold_in(seed, epoch): a different
+        # chunk size would replay the SAME key sequence over a
+        # different tick stream and silently diverge from the twin.
+        assert manifest["chunk_ticks"] == serve.chunk_ticks, (
+            f"resume chunk_ticks {serve.chunk_ticks} != checkpointed "
+            f"{manifest['chunk_ticks']} — bit-exact replay needs the "
+            "same chunking"
+        )
+        assert manifest["telemetry_window"] == telemetry_mod.window(
+            self.state.telemetry
+        ) and manifest["spans"] == telemetry_mod.span_slots(
+            self.state.telemetry
+        ), "serve telemetry sizing differs from the checkpointed run"
+        t_arr = arrays.pop("__t__")
+        self.state = checkpoint_mod.restore_leaves(self.state, arrays)
+        self.t = jnp.asarray(t_arr, jnp.int32)
+        self._epoch = int(ctx["epoch"])
+        self._chunks = int(ctx["chunks"])
+        self._last_ckpt_chunks = self._chunks
+        self._ckpt_step = int(ctx["ckpt_step"]) + 1
+        self.checkpoints_written = 0
+        self.cursor = telemetry_mod.DrainCursor(
+            tick=int(ctx["cursor_tick"]), span=int(ctx["cursor_span"])
+        )
+        prev = ctx.get("prev") or {}
+        import numpy as _np
+
+        self._prev = {
+            k: (_np.asarray(v) if isinstance(v, list) else v)
+            for k, v in prev.items()
+        }
+        if self.slo is not None and ctx.get("slo") is not None:
+            self.slo.restore_state(ctx["slo"])
+        # The checkpoint froze the loop BETWEEN chunks: the last chunk's
+        # telemetry was still undrained (its rows sit in the restored
+        # ring, ahead of the restored cursor), so re-snapshot it as the
+        # pending drain — chunked drains stay EXACT across the restart.
+        self._resume_snap = _SNAP(snapshot_leaves(self.state))
+        self.restores = 1
+        self.resumed_from = {
+            "step": int(manifest["step"]),
+            "tick": int(manifest["tick"]),
+            "chunks": self._chunks,
+            "skipped": manifest.get("skipped", []),
+        }
+        # Restart marker: an instant event on the Perfetto timeline
+        # (host track) + a span so the scrape CSV records it too.
+        self._span("restore", time.time(), time.perf_counter(),
+                   instant=True, step=int(manifest["step"]),
+                   tick=int(manifest["tick"]))
+        return self
 
     # -- the hot path -------------------------------------------------------
 
@@ -362,7 +637,8 @@ class ServeLoop:
         )
         start_wall = time.perf_counter()
         self.clock.add_mark(int(jax.device_get(self.t)), time.time())
-        prev_snap = None
+        prev_snap = self._resume_snap  # pending drain after a resume
+        self._resume_snap = None
         while True:
             if serve.max_chunks is not None and (
                 self._chunks >= serve.max_chunks
@@ -370,14 +646,25 @@ class ServeLoop:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
+            if self._should_checkpoint():
+                # Enqueue the alias-free state copy BEFORE the next
+                # dispatch (it snapshots the exact input of the next
+                # chunk); the disk write happens after the dispatch so
+                # it overlaps that chunk's compute.
+                self._begin_checkpoint()
             snap = self._dispatch_chunk()
+            if self._pending_ckpt is not None:
+                self._finish_checkpoint()
             if prev_snap is not None:
                 self._drain(prev_snap)
             prev_snap = snap
         # Shutdown: the last snapshot drains AFTER its chunk completes
-        # (the one place a wait is correct), then the trace exports.
+        # (the one place a wait is correct), the in-flight checkpoint
+        # writer lands (durability before clean_shutdown), then the
+        # trace exports.
         if prev_snap is not None:
             self._drain(prev_snap)
+        self._join_ckpt_writer()
         jax.block_until_ready(self.state)
         wall = time.perf_counter() - start_wall
         self.clean_shutdown = True
@@ -414,6 +701,15 @@ class ServeLoop:
             "totals": totals,
             "clean_shutdown": self.clean_shutdown,
         }
+        if self.serve.checkpoint_dir is not None:
+            out["checkpoints_written"] = self.checkpoints_written
+            out["checkpoint_dir"] = self.serve.checkpoint_dir
+            # Durability failures surface HERE (and on stderr at join
+            # time) — a serve run whose writer died of ENOSPC must not
+            # read as healthily checkpointed.
+            out["checkpoint_errors"] = list(self.checkpoint_errors)
+        if self.resumed_from is not None:
+            out["resumed_from"] = self.resumed_from
         if self.slo is not None:
             out["slo"] = self.slo.summary()
         lc_plan = getattr(self.cfg, "lifecycle", None)
@@ -446,7 +742,10 @@ def serve_flagship(
     rotate_every: int = 0,
     sessions: int = 0,
     resubmit_rate: float = 0.0,
+    session_ttl: int = 0,
     reconfig: bool = False,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ) -> dict:
     """A bounded serve run of the flagship MultiPaxos backend — the CLI
     + smoke entry point. ``rate_x`` shapes the workload at that
@@ -468,14 +767,15 @@ def serve_flagship(
             rate=rate_x * slots_per_tick,
             backlog_cap=256,
         )
-    if rotate_every or sessions or resubmit_rate or reconfig:
-        # resubmit_rate included so a lone --resubmit-rate reaches
-        # LifecyclePlan.validate and fails LOUDLY (it needs sessions)
+    if rotate_every or sessions or resubmit_rate or session_ttl or reconfig:
+        # resubmit_rate/session_ttl included so a lone flag reaches
+        # LifecyclePlan.validate and fails LOUDLY (both need sessions)
         # instead of being silently dropped.
         kw["lifecycle"] = LifecyclePlan(
             rotate_every=rotate_every,
             sessions=sessions,
             resubmit_rate=resubmit_rate,
+            session_ttl=session_ttl,
             reconfig=reconfig,
         )
     cfg = mp.BatchedMultiPaxosConfig(
@@ -498,8 +798,17 @@ def serve_flagship(
         trace_path=os.path.join(out_dir, "serve_trace.json"),
         max_seconds=seconds,
         max_chunks=max_chunks,
+        checkpoint_dir=(
+            os.path.join(out_dir, "checkpoints")
+            if checkpoint_every
+            else None
+        ),
+        checkpoint_every=checkpoint_every,
     )
-    loop = ServeLoop(mp, cfg, serve_cfg, seed=seed)
+    if resume:
+        loop = ServeLoop.resume(mp, cfg, serve_cfg)
+    else:
+        loop = ServeLoop(mp, cfg, serve_cfg, seed=seed)
     report = loop.run()
     with open(os.path.join(out_dir, "serve_report.json"), "w") as f:
         json.dump(report, f, indent=1)
@@ -524,8 +833,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--sessions", type=int, default=0,
                    help="client session-table sessions per group")
     p.add_argument("--resubmit-rate", type=float, default=0.0)
+    p.add_argument("--session-ttl", type=int, default=0,
+                   help="demote idle session records after this many "
+                   "ticks (0 = only at rotation margin)")
     p.add_argument("--reconfig", action="store_true",
                    help="arm the traced acceptor-membership axis")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="async on-disk checkpoint every N chunks "
+                   "(tpu/checkpoint.py; 0 = off)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid checkpoint in "
+                   "<out-dir>/checkpoints (bit-exact)")
     args = p.parse_args(argv)
     report = serve_flagship(
         seconds=args.seconds,
@@ -539,7 +857,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         rotate_every=args.rotate_every,
         sessions=args.sessions,
         resubmit_rate=args.resubmit_rate,
+        session_ttl=args.session_ttl,
         reconfig=args.reconfig,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
     print(json.dumps(report))
     return 0 if report["clean_shutdown"] else 1

@@ -104,6 +104,7 @@ class SloEngine:
             maxlen=policy.window_chunks
         )  # (offered, shed) deltas
         self.history: list = []  # one status dict per observe()
+        self._observations_restored = 0  # pre-resume observe() count
 
     # -- windowed signals ---------------------------------------------------
 
@@ -208,5 +209,41 @@ class SloEngine:
             "scale": round(self.scale, 6),
             "alarms_fired": self.alarms_fired,
             "clamps_applied": self.clamps_applied,
-            "observations": len(self.history),
+            "observations": self._observations_restored + len(self.history),
         }
+
+    # -- checkpoint/restore (tpu/checkpoint.py manifests) -------------------
+    # The engine is pure host arithmetic, so its FULL decision state is
+    # a small JSON blob: restoring it makes a resumed serve loop's
+    # clamp decisions replay the uninterrupted twin's exactly (the
+    # bit-exact-resume contract extends through the control plane).
+
+    def to_state(self) -> dict:
+        return {
+            "alarm": bool(self.alarm),
+            "scale": float(self.scale),
+            "alarms_fired": int(self.alarms_fired),
+            "clamps_applied": int(self.clamps_applied),
+            "clean_streak": int(self._clean_streak),
+            "observations": self._observations_restored + len(self.history),
+            "lat": [h.tolist() for h in self._lat],
+            "wait": [h.tolist() for h in self._wait],
+            "flow": [list(f) for f in self._flow],
+        }
+
+    def restore_state(self, s: dict) -> None:
+        self.alarm = bool(s["alarm"])
+        self.scale = float(s["scale"])
+        self.alarms_fired = int(s["alarms_fired"])
+        self.clamps_applied = int(s["clamps_applied"])
+        self._clean_streak = int(s["clean_streak"])
+        self._lat.clear()
+        self._lat.extend(np.asarray(h, np.int64) for h in s["lat"])
+        self._wait.clear()
+        self._wait.extend(np.asarray(h, np.int64) for h in s["wait"])
+        self._flow.clear()
+        self._flow.extend(tuple(f) for f in s["flow"])
+        # history is reporting, not decision state: a resumed process
+        # starts a fresh log but keeps the observation count.
+        self.history = []
+        self._observations_restored = int(s.get("observations", 0))

@@ -159,8 +159,25 @@ def host_span_events(trace_spans: Sequence[Dict]) -> List[dict]:
         args = {
             k: v
             for k, v in s.items()
-            if k not in ("name", "start_unix", "duration_s")
+            if k not in ("name", "start_unix", "duration_s", "instant")
         }
+        if s.get("instant"):
+            # Marker spans (e.g. the serve loop's crash-recovery
+            # "restore" record) render as global instant events — a
+            # vertical restart marker across the whole timeline.
+            events.append(
+                {
+                    "name": str(s["name"]),
+                    "cat": "marker",
+                    "ph": "i",
+                    "s": "g",
+                    "pid": HOST_PID,
+                    "tid": 0,
+                    "ts": float(s["start_unix"]) * 1e6,
+                    "args": args,
+                }
+            )
+            continue
         events.append(
             {
                 "name": str(s["name"]),

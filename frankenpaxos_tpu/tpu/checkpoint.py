@@ -1,0 +1,500 @@
+"""Crash-tolerant checkpoint/restore for the batched backends: async
+alias-free State snapshots, versioned on-disk checkpoints with
+torn-write defense, and bit-exact restore.
+
+The serve loop (``harness/serve.py``) can now run forever in-graph —
+window rotation keeps the slot horizon constant, the session table
+gives exactly-once semantics, and ``FaultPlan`` injects every
+device-side failure — but the HOST process driving the loop was still a
+single point of failure: a preemption, OOM, or SIGKILL lost the whole
+run. This module closes that: because every piece of protocol,
+workload, telemetry, and lifecycle state — including the counter-based
+PRNG position and the drain cursors — lives in one donated State
+pytree, a checkpoint of that pytree plus a small host-context manifest
+is sufficient to resume a run BIT-EXACTLY: the resumed run replays the
+uninterrupted twin sha256-identically (a stronger guarantee than the
+reference's TCP reconnect story, and pinned the same way every prior
+subsystem is — by digest twins in ``tests/test_checkpoint.py``).
+
+Three layers:
+
+  * **Async snapshot** — :func:`snapshot_tree` is a jitted, ALIAS-FREE
+    device-side copy of the full State (+ tick scalar). The serve loop
+    enqueues it right behind a chunk's ``run_ticks`` and drains it to
+    disk while the NEXT chunk computes — the same double-buffer
+    discipline as the telemetry drain: the copy is what makes the
+    buffers survive the next chunk's donation, and the loop never adds
+    a ``block_until_ready``. The ``checkpoint-alias-free`` analysis
+    rule pins that the compiled snapshot program aliases no input (an
+    aliased output would be reused by the donation while the disk
+    write still reads it) and smuggles no host callback.
+  * **Versioned on-disk format** — one checkpoint is a pair
+    ``ckpt_<step>.npz`` (flat leaf arrays, keys = dotted State paths)
+    + ``ckpt_<step>.json`` (the manifest: format version, config
+    fingerprint, tick count, DrainCursor position, host context, and
+    per-leaf CRC32 checksums + shapes + dtypes). Both are written to a
+    temp name and atomically renamed, ARRAYS FIRST: the manifest is
+    the commit point, so a crash mid-write leaves either a complete
+    checkpoint or a torn one the loader rejects.
+  * **Torn/corrupt-snapshot defense** — :func:`load_checkpoint`
+    verifies the format version, every leaf's presence, shape, dtype,
+    and checksum, and the manifest's own structure;
+    :func:`latest_valid` walks checkpoints newest-first and returns
+    the first that fully verifies, so a torn or bit-flipped newest
+    checkpoint falls back to the previous valid one (corruption
+    injection is tested: truncated npz, flipped bytes, missing
+    manifest, stale config hash).
+
+Restore (:func:`restore_leaves`) rebuilds the State onto a freshly
+constructed template with EXACT dtypes and shapes, so the first
+``run_ticks`` after a same-process restore hits the existing jit cache
+— no recompile (pinned by the ``trace-checkpoint-restore`` analysis
+rule); across a process restart the one cold-start compile is the only
+compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import tempfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Bumped whenever the on-disk layout changes; a manifest carrying a
+# different version is rejected (stale-format defense).
+CHECKPOINT_FORMAT = 1
+
+_CKPT_RE = re.compile(r"^ckpt_(\d{8})\.json$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint failed to load or verify (torn write, corrupt leaf,
+    stale manifest, wrong config). ``latest_valid`` catches these and
+    falls back; explicit loads surface them."""
+
+
+# ---------------------------------------------------------------------------
+# Device side: the async alias-free snapshot
+# ---------------------------------------------------------------------------
+
+
+def _copy_tree(tree):
+    """Outputs are FRESH buffers (inputs are not donated, so XLA must
+    materialize copies) — the disk drain can read them after the next
+    chunk donates the state they were copied from."""
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+_SNAP = jax.jit(_copy_tree)
+
+
+def snapshot_tree(tree):
+    """Enqueue a jitted alias-free device-side copy of ``tree`` (the
+    full State + tick scalar). Returns a pytree of futures — NO
+    blocking call happens here; ``jax.device_get`` it after dispatching
+    the next chunk."""
+    return _SNAP(tree)
+
+
+def lower_snapshot(tree):
+    """Lower the snapshot program for inspection — used by the
+    ``checkpoint-alias-free`` analysis rule so the rule checks exactly
+    the program the serve loop runs."""
+    return _SNAP.lower(tree)
+
+
+# ---------------------------------------------------------------------------
+# Naming, fingerprints, digests
+# ---------------------------------------------------------------------------
+
+
+def config_fingerprint(mod, cfg) -> str:
+    """A stable fingerprint of (backend, config): restoring a
+    checkpoint under a DIFFERENT config would silently mis-shape the
+    run, so the manifest carries this and resume rejects a mismatch
+    (the stale-manifest defense). Frozen dataclass reprs are
+    deterministic and cover every nested plan."""
+    text = f"{getattr(mod, '__name__', mod)}|{cfg!r}"
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def flatten_state(state) -> Dict[str, Any]:
+    """The State pytree as an ordered ``{dotted-path: leaf}`` dict —
+    the npz key schema. Paths come from the registered-dataclass field
+    names, so they are stable across processes."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    out: Dict[str, Any] = {}
+    for path, leaf in flat:
+        name = ".".join(
+            str(getattr(p, "name", getattr(p, "key", getattr(p, "idx", p))))
+            for p in path
+        ) or "_root"
+        assert name not in out, f"duplicate leaf path {name}"
+        out[name] = leaf
+    return out
+
+
+def state_digest(state) -> str:
+    """sha256 over every leaf's path, dtype, shape, and raw bytes — the
+    twin-comparison digest the resume==uninterrupted tests pin. One
+    coalesced ``device_get``."""
+    import numpy as np
+
+    host = jax.device_get(state)
+    h = hashlib.sha256()
+    for name, leaf in sorted(flatten_state(host).items()):
+        arr = np.asarray(leaf)
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _names(step: int) -> Tuple[str, str]:
+    return f"ckpt_{step:08d}.npz", f"ckpt_{step:08d}.json"
+
+
+# ---------------------------------------------------------------------------
+# Writing
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Write-to-temp-then-rename in the target directory (same
+    filesystem, so the rename is atomic): a crash mid-write leaves a
+    ``.tmp`` orphan, never a half-written checkpoint under the real
+    name."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    *,
+    leaves: Dict[str, Any],
+    meta: Dict[str, Any],
+    step: int,
+    keep: int = 0,
+) -> str:
+    """Write one versioned checkpoint: the flat leaf arrays as an npz,
+    then the manifest (format version + ``meta`` + per-leaf CRC32
+    checksums/shapes/dtypes). Arrays first, manifest last — the
+    manifest rename is the commit point. ``keep > 0`` prunes all but
+    the newest ``keep`` checkpoints afterwards (never the one just
+    written). Returns the manifest path."""
+    import numpy as np
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays = {name: np.asarray(leaf) for name, leaf in leaves.items()}
+    npz_name, man_name = _names(step)
+
+    def write_npz(f):
+        np.savez(f, **arrays)
+
+    _atomic_write(os.path.join(ckpt_dir, npz_name), write_npz)
+
+    manifest = {
+        "format": CHECKPOINT_FORMAT,
+        "step": int(step),
+        "arrays_file": npz_name,
+        "leaves": {
+            name: {
+                "crc32": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+            }
+            for name, arr in arrays.items()
+        },
+        **meta,
+    }
+    payload = json.dumps(manifest, indent=1).encode()
+
+    def write_man(f):
+        f.write(payload)
+
+    man_path = os.path.join(ckpt_dir, man_name)
+    _atomic_write(man_path, write_man)
+    if keep > 0:
+        prune(ckpt_dir, keep=keep)
+    return man_path
+
+
+def prune(ckpt_dir: str, keep: int) -> List[int]:
+    """Remove all but the newest ``keep`` checkpoints (by step);
+    returns the pruned steps. Orphan ``.tmp`` files are swept too."""
+    steps = sorted(list_steps(ckpt_dir))
+    pruned = steps[:-keep] if keep > 0 else []
+    for step in pruned:
+        for name in _names(step):
+            try:
+                os.unlink(os.path.join(ckpt_dir, name))
+            except OSError:
+                pass
+    for fn in os.listdir(ckpt_dir):
+        if fn.endswith(".tmp"):
+            try:
+                os.unlink(os.path.join(ckpt_dir, fn))
+            except OSError:
+                pass
+    return pruned
+
+
+def list_steps(ckpt_dir: str) -> List[int]:
+    """Steps that have a COMMITTED manifest (arrays may still be torn —
+    the loader verifies)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for fn in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(fn)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Loading + verification (the torn/corrupt-snapshot defense)
+# ---------------------------------------------------------------------------
+
+
+def load_checkpoint(
+    ckpt_dir: str, step: int
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load + fully verify one checkpoint; returns
+    ``(manifest, arrays)``. Raises :class:`CheckpointError` on ANY
+    defect: unreadable/structurally-wrong manifest, format-version
+    mismatch, missing arrays file, missing/extra leaves, shape or
+    dtype drift, or a checksum mismatch (torn or bit-flipped write)."""
+    import numpy as np
+
+    _, man_name = _names(step)
+    man_path = os.path.join(ckpt_dir, man_name)
+    try:
+        with open(man_path, "rb") as f:
+            manifest = json.loads(f.read().decode())
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(f"unreadable manifest {man_path}: {e}")
+    if not isinstance(manifest, dict) or "leaves" not in manifest:
+        raise CheckpointError(f"malformed manifest {man_path}")
+    if manifest.get("format") != CHECKPOINT_FORMAT:
+        raise CheckpointError(
+            f"{man_path}: format {manifest.get('format')} != "
+            f"{CHECKPOINT_FORMAT}"
+        )
+    npz_path = os.path.join(
+        ckpt_dir, manifest.get("arrays_file", _names(step)[0])
+    )
+    try:
+        with np.load(npz_path) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as e:  # noqa: BLE001 — any read failure IS the
+        # defect this loader defends against (torn zip members raise
+        # zipfile.BadZipFile, truncated streams EOFError/OSError,
+        # garbage ValueError — all mean: reject, fall back).
+        raise CheckpointError(f"unreadable arrays {npz_path}: {e}")
+    want = manifest["leaves"]
+    missing = sorted(set(want) - set(arrays))
+    extra = sorted(set(arrays) - set(want))
+    if missing or extra:
+        raise CheckpointError(
+            f"{npz_path}: leaf set mismatch (missing {missing[:4]}, "
+            f"extra {extra[:4]})"
+        )
+    for name, spec in want.items():
+        arr = arrays[name]
+        if str(arr.dtype) != spec["dtype"] or list(arr.shape) != list(
+            spec["shape"]
+        ):
+            raise CheckpointError(
+                f"{npz_path}:{name}: dtype/shape drift "
+                f"({arr.dtype}{arr.shape} != "
+                f"{spec['dtype']}{tuple(spec['shape'])})"
+            )
+        crc = zlib.crc32(np.asarray(arr).tobytes()) & 0xFFFFFFFF
+        if crc != spec["crc32"]:
+            raise CheckpointError(
+                f"{npz_path}:{name}: checksum mismatch (torn or "
+                "corrupt write)"
+            )
+    return manifest, arrays
+
+
+def latest_valid(
+    ckpt_dir: str, config_hash: Optional[str] = None
+) -> Optional[Tuple[Dict[str, Any], Dict[str, Any]]]:
+    """The newest checkpoint that fully verifies (and, when
+    ``config_hash`` is given, matches it) — the automatic-fallback
+    entry point: a torn/corrupt/stale newest checkpoint is skipped and
+    the previous valid one restores instead. Returns None when no
+    valid checkpoint exists. Skipped defects are recorded on the
+    returned manifest under ``"skipped"``."""
+    skipped: List[str] = []
+    for step in reversed(list_steps(ckpt_dir)):
+        try:
+            manifest, arrays = load_checkpoint(ckpt_dir, step)
+        except CheckpointError as e:
+            skipped.append(str(e))
+            continue
+        if config_hash is not None and manifest.get("config_hash") != (
+            config_hash
+        ):
+            skipped.append(
+                f"step {step}: config fingerprint mismatch (stale "
+                "manifest — checkpoint belongs to a different config)"
+            )
+            continue
+        if skipped:
+            manifest = dict(manifest, skipped=skipped)
+        return manifest, arrays
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Restore
+# ---------------------------------------------------------------------------
+
+
+def restore_leaves(template_state, arrays: Dict[str, Any]):
+    """Rebuild a State pytree from flat checkpoint arrays onto a
+    template (a freshly constructed ``init_state`` with the same
+    config + telemetry sizing): every template leaf must be present
+    with the exact shape and dtype, and the restored leaves are
+    committed device arrays with the template's dtypes — so the first
+    ``run_ticks`` after a same-process restore HITS the existing jit
+    cache (no recompile; the ``trace-checkpoint-restore`` rule pins
+    this)."""
+    import numpy as np
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
+    names = list(flatten_state(template_state))
+    assert len(names) == len(flat)
+    leaves = []
+    for name, (path, tmpl) in zip(names, flat):
+        if name not in arrays:
+            raise CheckpointError(f"restore: leaf {name} missing")
+        arr = arrays[name]
+        t_dtype = jnp.asarray(tmpl).dtype
+        if tuple(arr.shape) != tuple(jnp.shape(tmpl)):
+            raise CheckpointError(
+                f"restore: {name} shape {tuple(arr.shape)} != template "
+                f"{tuple(jnp.shape(tmpl))} (config drift?)"
+            )
+        if str(arr.dtype) != str(t_dtype):
+            raise CheckpointError(
+                f"restore: {name} dtype {arr.dtype} != template "
+                f"{t_dtype} (dtype-policy drift?)"
+            )
+        # An XLA-OWNED copy — never bare jnp.asarray/device_put: on the
+        # CPU backend those can alias the host numpy buffer zero-copy,
+        # and the first donated run_ticks would then hand XLA memory it
+        # doesn't own (observed as glibc heap corruption under the
+        # warm-compile-cache timing). jnp.copy stages a real device
+        # copy whose output buffer XLA allocates itself.
+        leaves.append(jnp.copy(jnp.asarray(np.asarray(arr), t_dtype)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Convenience: whole-state save/restore (the simtest + analysis-rule
+# entry points; the serve loop drives the pieces directly for the
+# async overlap).
+# ---------------------------------------------------------------------------
+
+
+def save_state(
+    ckpt_dir: str,
+    mod,
+    cfg,
+    state,
+    t,
+    *,
+    step: int,
+    extra_meta: Optional[Dict[str, Any]] = None,
+    keep: int = 0,
+) -> str:
+    """One-call synchronous checkpoint of (state, t): snapshot, pull,
+    write. The serve loop instead splits these steps around the next
+    chunk's dispatch (the async path); this form serves the harnesses
+    and the analysis rules."""
+    host = jax.device_get(snapshot_tree({"state": state, "t": t}))
+    leaves = flatten_state(host["state"])
+    leaves["__t__"] = host["t"]
+    meta = {
+        "config_hash": config_fingerprint(mod, cfg),
+        "backend": getattr(mod, "__name__", str(mod)).rsplit(".", 1)[-1],
+        "tick": int(host["t"]),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return save_checkpoint(
+        ckpt_dir, leaves=leaves, meta=meta, step=step, keep=keep
+    )
+
+
+def restore_state(ckpt_dir: str, mod, cfg, template_state):
+    """Restore the newest valid checkpoint matching (mod, cfg):
+    returns ``(state, t, manifest)``. Raises :class:`CheckpointError`
+    when no valid checkpoint exists."""
+    found = latest_valid(
+        ckpt_dir, config_hash=config_fingerprint(mod, cfg)
+    )
+    if found is None:
+        raise CheckpointError(
+            f"no valid checkpoint for this config under {ckpt_dir}"
+        )
+    manifest, arrays = found
+    t = jnp.asarray(arrays.pop("__t__"), jnp.int32)
+    state = restore_leaves(template_state, arrays)
+    return state, t, manifest
+
+
+# ---------------------------------------------------------------------------
+# Host-context serialization helpers (numpy arrays <-> JSON lists)
+# ---------------------------------------------------------------------------
+
+
+def jsonable(obj):
+    """Recursively convert numpy scalars/arrays (and dataclasses) into
+    JSON-serializable values — the manifest's host-context fields."""
+    import numpy as np
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return jsonable(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {str(k): jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if hasattr(obj, "item") and not isinstance(obj, (str, bytes)):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    return obj

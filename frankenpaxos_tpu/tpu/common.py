@@ -174,6 +174,7 @@ def sample_quorum(
     shift: int,
     f: int,
     group_size: int,
+    live=None,
 ) -> jnp.ndarray:
     """Uniform random (f+1)-of-(2f+1) member selection over the leading
     acceptor axis, from bit fields of a shared random sweep (the batched
@@ -185,12 +186,23 @@ def sample_quorum(
     General f: rank 16-bit score fields with the acceptor index mixed into
     the low bits, so score ties break deterministically and the quorum is
     exactly f+1 — never more.
+
+    ``live`` (membership-aware thrifty, the lifecycle follow-up): a bool
+    mask broadcastable against ``bits`` over the leading acceptor axis.
+    Dead members rank strictly LAST (a high bit above the 21-bit score
+    range, uniqueness preserved), so the f+1 selection samples only
+    acceptors alive in the current membership whenever enough are live —
+    a swapped-out acceptor no longer costs a full-group-retry round.
+    With fewer than f+1 live members the selection tops up from the dead
+    (their sends are membership-masked by the caller, and the slot
+    correctly stalls: no live quorum exists). Requires full [A, ...]
+    bits (the ranking path, even at f == 1).
     """
     A = group_size
     a_iota = jnp.arange(A, dtype=jnp.int32).reshape(
         (A,) + (1,) * (bits.ndim - 1)
     )
-    if f == 1:
+    if f == 1 and live is None:
         excl = (
             ((bits[0] >> shift) & jnp.uint32(0xFF)).astype(jnp.int32) % A
         )
@@ -200,6 +212,8 @@ def sample_quorum(
     scores = ((bits >> shift) & jnp.uint32(0xFFFF)) << 5 | a_iota.astype(
         jnp.uint32
     )
+    if live is not None:
+        scores = jnp.where(live, scores, scores | jnp.uint32(1 << 25))
     kth = jnp.sort(scores, axis=0)[f : f + 1]  # (f+1)-th smallest
     return scores <= kth
 

@@ -30,6 +30,32 @@ never talks to another chain). "The network" is device memory:
     linearizability floor (the tail's committed version at issue)
     checked on completion, exactly like the batched MultiPaxos read
     invariant.
+
+Chain-node crash semantics (``FaultPlan.crash_rate``/``revive_rate`` —
+the carried PR 3 (b) fault-coverage gap): MIDDLE nodes crash and revive
+per tick (head and tail are pinned alive — their replacement is a
+chain-membership-service event outside this model, exactly as in the
+reference where the coordination service reconfigures the chain).
+While a node is dead,
+
+  * the chain RE-STITCHES around it in-tick: a write or read hop whose
+    target is dead redirects to the next alive node toward the tail
+    (its predecessor links to its successor — ChainNode repair), so
+    writes keep flowing and reads keep completing;
+  * each write carries a VISITED bitmask of the nodes whose pending
+    sets it joined, so acks propagate back only through nodes that
+    actually saw the write (pending-set conservation stays EXACT under
+    crashes: total dirty == popcount of in-flight visited masks) — an
+    ack whose next visited node is currently dead BUFFERS (its arrival
+    slides tick by tick) and re-propagates the moment the node
+    revives;
+  * a dead-then-revived node is SUSPECT until every in-flight write
+    that bypassed it has drained; suspect nodes forward all reads to
+    the tail (apportioned-query safety: a bypassed write would
+    otherwise make a stale key look clean), and on clearing they bulk
+    catch up by copying the tail's versions (the buffered
+    re-propagation of everything they missed) — after which they serve
+    clean reads again, exactly as if they had never crashed.
 """
 
 from __future__ import annotations
@@ -105,6 +131,13 @@ class BatchedCraqConfig:
     def __post_init__(self):
         assert self.num_chains >= 1
         assert self.chain_len >= 2
+        if self.faults.has_crash:
+            # The per-write pending-set bitmask packs node bits into
+            # int32; crashes only drive MIDDLE nodes, so L >= 3 is
+            # where the axis does anything (L == 2 no-ops harmlessly).
+            assert self.chain_len <= 31, (
+                "chain crash axis packs the visited set in int32 bits"
+            )
         assert self.num_keys >= 1
         assert self.window >= 2 * self.writes_per_tick
         if self.reads_per_tick:
@@ -143,6 +176,13 @@ class BatchedCraqState:
     r_floor: jnp.ndarray  # [N, RW] tail version at issue (lin floor)
     r_version: jnp.ndarray  # [N, RW] served version
 
+    # Chain-node crash axis (all zero-sized unless faults.has_crash).
+    node_alive: jnp.ndarray  # [N, L] node liveness (head/tail pinned) | [N, 0]
+    node_suspect: jnp.ndarray  # [N, L] revived-but-not-caught-up | [N, 0]
+    w_visited: jnp.ndarray  # [N, W] bitmask of nodes in the pending set | [N, 0]
+    crashes: jnp.ndarray  # [] node deaths (cumulative) | [0]
+    resyncs: jnp.ndarray  # [] suspect nodes caught up (cumulative) | [0]
+
     # Stats.
     writes_done: jnp.ndarray  # [] writes applied at the tail (replied)
     write_lat_sum: jnp.ndarray  # []
@@ -177,6 +217,17 @@ def init_state(cfg: BatchedCraqConfig) -> BatchedCraqState:
         r_issue=jnp.full((N, RW), INF, jnp.int32),
         r_floor=jnp.full((N, RW), -1, jnp.int32),
         r_version=jnp.full((N, RW), -1, jnp.int32),
+        node_alive=jnp.ones(
+            (N, L if cfg.faults.has_crash else 0), bool
+        ),
+        node_suspect=jnp.zeros(
+            (N, L if cfg.faults.has_crash else 0), bool
+        ),
+        w_visited=jnp.zeros(
+            (N, W if cfg.faults.has_crash else 0), jnp.int32
+        ),
+        crashes=jnp.zeros(() if cfg.faults.has_crash else (0,), jnp.int32),
+        resyncs=jnp.zeros(() if cfg.faults.has_crash else (0,), jnp.int32),
         writes_done=jnp.zeros((), jnp.int32),
         write_lat_sum=jnp.zeros((), jnp.int32),
         write_lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
@@ -249,6 +300,84 @@ def tick(
     write_lat_sum = state.write_lat_sum
     write_lat_hist = state.write_lat_hist
 
+    # ---- 0.5 Chain-node crash axis (module docstring; structurally
+    # absent unless faults.has_crash — a none plan draws no keys and
+    # adds no ops). Order matters: crash/revive draws land FIRST, then
+    # in-flight hops re-stitch/buffer against the updated liveness, and
+    # only then does the chain plane process arrivals — so every
+    # processing event this tick happens at an alive node, and the
+    # visited bookkeeping below mirrors the plane's arrival predicates
+    # exactly.
+    crash_on = fp.has_crash
+    node_alive = state.node_alive
+    node_suspect = state.node_suspect
+    w_visited = state.w_visited
+    crashes = state.crashes
+    resyncs = state.resyncs
+    if crash_on:
+        # (a) Crash/revive (middle nodes only; head + tail pinned —
+        # chain-membership replacement is the coordination service's
+        # job, outside this model). Newly dead nodes become SUSPECT:
+        # they will miss writes until they catch up after reviving.
+        kc = faults_mod.fault_key(key, salt=7)
+        alive2 = faults_mod.crash_step(fp, kc, node_alive, rates=frates)
+        pin = (jnp.arange(L, dtype=jnp.int32) == 0) | (
+            jnp.arange(L, dtype=jnp.int32) == tail
+        )
+        alive2 = alive2 | pin[None, :]
+        crashes = crashes + jnp.sum(node_alive & ~alive2)
+        node_suspect = node_suspect | (node_alive & ~alive2)
+        node_alive = alive2
+
+        def _at_node(arr2d, node):
+            return jnp.take_along_axis(
+                arr2d, jnp.clip(node, 0, tail), axis=1
+            )
+
+        # (b) DOWN re-stitch: a write heading to a dead node redirects
+        # to the next alive node toward the tail (tail pinned alive, so
+        # the static unrolled scan terminates). Arrival unchanged — the
+        # hop is already in flight; the stitch redirects it.
+        down = w_status == W_DOWN
+        for _ in range(L - 1):
+            w_node = jnp.where(
+                down
+                & ~_at_node(node_alive, w_node)
+                & (w_node < tail),
+                w_node + 1,
+                w_node,
+            )
+        # (c) UP targeting: acks only visit nodes whose pending set the
+        # write actually joined (its visited bit) — bit 0 is always set
+        # (every write processes at the alive head), so the scan
+        # terminates at the retire point.
+        up = w_status == W_UP
+        for _ in range(L - 1):
+            bit = (
+                jnp.right_shift(w_visited, jnp.clip(w_node, 0, tail))
+                & 1
+            ) == 1
+            w_node = jnp.where(up & ~bit & (w_node > 0), w_node - 1, w_node)
+        # (d) Buffered re-propagation: an ack whose (visited) target is
+        # currently dead waits — its arrival slides one tick at a time
+        # and the ack delivers the moment the node revives. Conservation
+        # is why acks wait instead of skipping: the dead node's dirty
+        # count still holds this write.
+        stall = up & (w_arrival == t) & ~_at_node(node_alive, w_node)
+        w_arrival = jnp.where(stall, t + 1, w_arrival)
+        # (e) Visited bookkeeping, mirroring the plane's arrival
+        # predicates exactly (post-redirect, post-stall): DOWN mid-chain
+        # processing joins the pending set; UP processing leaves it.
+        proc_down_mid = down & (w_arrival == t) & (w_node < tail)
+        proc_up = up & (w_arrival == t)
+        one_hot = jnp.left_shift(
+            jnp.int32(1), jnp.clip(w_node, 0, tail)
+        )
+        w_visited = jnp.where(
+            proc_down_mid, w_visited | one_hot, w_visited
+        )
+        w_visited = jnp.where(proc_up, w_visited & ~one_hot, w_visited)
+
     # ---- 1+2. The chain propagate/ack plane (ChainNode._process_write_
     # batch + ChainNode._handle_ack): DOWN writes join pending sets and
     # forward, the tail applies + replies + starts the ack, UP acks
@@ -293,6 +422,33 @@ def tick(
         at_tail.astype(jnp.int32).ravel(), wbins.ravel(), LAT_BINS
     )
 
+    # ---- 2.5 Suspect resync (crash axis): a revived node stays
+    # suspect while ANY in-flight write has bypassed it (passed its
+    # position without joining its pending set). Once the last such
+    # write drains, the node bulk-catches-up by copying the tail's
+    # versions — the buffered re-propagation of everything it missed —
+    # and serves clean reads again as if it never crashed.
+    if crash_on:
+        l_iota = jnp.arange(L, dtype=jnp.int32)[None, None, :]
+        bit_l = (
+            (w_visited[:, :, None] >> l_iota) & 1
+        ) == 1  # [N, W, L]
+        up3 = (w_status == W_UP)[:, :, None]
+        down3 = (w_status == W_DOWN)[:, :, None]
+        passed = up3 | (down3 & (w_node[:, :, None] > l_iota))
+        in_flight3 = (w_status != W_EMPTY)[:, :, None]
+        missed = jnp.any(in_flight3 & passed & ~bit_l, axis=1)  # [N, L]
+        clear = node_suspect & node_alive & ~missed
+        nv = node_version_flat.reshape(N, L, KV)
+        nv = jnp.where(
+            clear[:, :, None],
+            jnp.maximum(nv, nv[:, tail : tail + 1, :]),
+            nv,
+        )
+        node_version_flat = nv.reshape(N, L * KV)
+        resyncs = resyncs + jnp.sum(clear)
+        node_suspect = node_suspect & ~clear
+
     # ---- 3. Reads (apportioned queries, ChainNode._process_read_batch).
     r_status = state.r_status
     r_key = state.r_key
@@ -314,6 +470,22 @@ def tick(
     # Gate on the ring EXISTING (not on the issue rate): tests inject
     # reads by hand with reads_per_tick == 0 and still need routing.
     if cfg.read_window:
+        if crash_on:
+            # Crash re-stitch for reads: an in-flight read heading to a
+            # dead node redirects to the next alive node toward the
+            # tail (apportioned queries go to ANY node; the chain
+            # membership just shrank). Suspect/dead serving is handled
+            # at the clean check below.
+            pending_at = r_status == R_AT_NODE
+            for _ in range(L - 1):
+                alive_at = jnp.take_along_axis(
+                    node_alive, jnp.clip(r_node, 0, tail), axis=1
+                )
+                r_node = jnp.where(
+                    pending_at & ~alive_at & (r_node < tail),
+                    r_node + 1,
+                    r_node,
+                )
         # (a) Completions free their slots (and check the lin floor).
         done = (r_status == R_REPLY) & (r_arrival <= t)
         rlat = jnp.where(done, t - r_issue, 0)
@@ -335,6 +507,17 @@ def tick(
         dirty_here = (
             jnp.take_along_axis(node_dirty_flat, rslot, axis=1) > 0
         )
+        if crash_on:
+            # A suspect node may have been bypassed by a write it never
+            # saw — a stale key would look clean there. Until the
+            # resync clears it, every read it receives takes the dirty
+            # path to the tail (always correct).
+            unsafe = jnp.take_along_axis(
+                node_suspect | ~node_alive,
+                jnp.clip(r_node, 0, tail),
+                axis=1,
+            )
+            dirty_here = dirty_here | unsafe
         clean = at_node & ~dirty_here
         dirty = at_node & dirty_here
         local_ver = jnp.take_along_axis(node_version_flat, rslot, axis=1)
@@ -407,6 +590,10 @@ def tick(
     new_key_w = (
         ((bits_w >> 8) & jnp.uint32(0xFFFF)).astype(jnp.int32) % KV
     )
+    if crash_on:
+        # Fresh writes start with an empty pending set (bit 0 joins on
+        # arrival at the always-alive head).
+        w_visited = jnp.where(issue_w, 0, w_visited)
     new_version = state.next_version[:, None] + rank_w - 1
     w_key = jnp.where(issue_w, new_key_w, state.w_key)
     w_version = jnp.where(issue_w, new_version, state.w_version)
@@ -447,6 +634,11 @@ def tick(
         r_issue=r_issue,
         r_floor=r_floor,
         r_version=r_version,
+        node_alive=node_alive,
+        node_suspect=node_suspect,
+        w_visited=w_visited,
+        crashes=crashes,
+        resyncs=resyncs,
         writes_done=writes_done,
         write_lat_sum=write_lat_sum,
         write_lat_hist=write_lat_hist,
@@ -487,14 +679,47 @@ def check_invariants(
     L, KV = cfg.chain_len, cfg.num_keys
     down = state.w_status == W_DOWN
     up = state.w_status == W_UP
-    # Pending-set conservation: a DOWN write heading to node m is pending
-    # at nodes 0..m-1 (m entries); an UP ack heading to node m has been
-    # acked at m+1..L-2, so the write is still pending at 0..m (m+1).
-    expected_dirty = jnp.sum(
-        jnp.where(down, state.w_node, 0) + jnp.where(up, state.w_node + 1, 0)
-    )
+    if cfg.faults.has_crash:
+        # Under the crash axis the pending set is EXACTLY the write's
+        # visited bitmask (bypassed nodes never joined; acked nodes
+        # left), so conservation is the popcount over in-flight writes.
+        pc = jax.lax.population_count(
+            state.w_visited.astype(jnp.uint32)
+        ).astype(jnp.int32)
+        expected_dirty = jnp.sum(
+            jnp.where(state.w_status != W_EMPTY, pc, 0)
+        )
+    else:
+        # Pending-set conservation: a DOWN write heading to node m is
+        # pending at nodes 0..m-1 (m entries); an UP ack heading to
+        # node m has been acked at m+1..L-2, so the write is still
+        # pending at 0..m (m+1).
+        expected_dirty = jnp.sum(
+            jnp.where(down, state.w_node, 0)
+            + jnp.where(up, state.w_node + 1, 0)
+        )
     dirty_conserved = jnp.sum(state.node_dirty) == expected_dirty
     dirty_nonneg = jnp.all(state.node_dirty >= 0)
+    # Crash-axis books (trivially true when the axis is off — empty
+    # arrays): head and tail stay pinned alive, suspicion only ever
+    # covers middle nodes, and acks only target pending-set members.
+    if cfg.faults.has_crash:
+        chain_alive_ok = (
+            jnp.all(state.node_alive[:, 0])
+            & jnp.all(state.node_alive[:, L - 1])
+            & jnp.all(~state.node_suspect[:, 0])
+            & jnp.all(~state.node_suspect[:, L - 1])
+        )
+        # Every in-flight ack still holds its head membership (bit 0
+        # joins at the alive head and only the retiring arrival at node
+        # 0 clears it). The plane may leave an ack transiently pointed
+        # at a bypassed node between ticks — the next tick's pre-plane
+        # redirect fixes the target before any processing — so the
+        # invariant pins the stable bit, not the in-motion target.
+        ack_target_ok = jnp.all(~up | ((state.w_visited & 1) == 1))
+    else:
+        chain_alive_ok = jnp.asarray(True)
+        ack_target_ok = jnp.asarray(True)
     # A node never applies ahead of the tail (acks follow the tail apply).
     tail_ver = state.node_version[:, L - 1 : L, :]
     node_behind_tail = jnp.all(state.node_version <= tail_ver)
@@ -524,6 +749,8 @@ def check_invariants(
         "write_books": write_books,
         "read_lin_ok": read_lin_ok,
         "read_books": read_books,
+        "chain_alive_ok": chain_alive_ok,
+        "ack_target_ok": ack_target_ok,
     }
 
 

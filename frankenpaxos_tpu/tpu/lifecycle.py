@@ -139,6 +139,14 @@ class LifecyclePlan:
     # answered from the cache, never re-proposed).
     sessions: int = 0
     resubmit_rate: float = 0.0
+    # Session expiry: a cached record idle for more than this many
+    # ticks (t - completion tick > ttl, a TRACED comparison) demotes
+    # to the unset sentinel — the real expiry knob the PR 11 follow-up
+    # asked for (records used to demote only at rotation margin). A
+    # resubmission that finds its record expired counts as a resubmit
+    # WITHOUT a cache hit (the reply-loss client would re-propose in a
+    # real deployment; here the miss is counted honestly). 0 = never.
+    session_ttl: int = 0
     # Traced acceptor reconfiguration: carry a traced membership mask +
     # epoch over the backend's acceptor axis. False = the axis does not
     # exist (no mask gating, no epoch compare — the pre-plan program).
@@ -187,6 +195,12 @@ class LifecyclePlan:
             assert self.has_sessions, (
                 "lifecycle.resubmit_rate needs sessions > 0 (the cache "
                 "that answers the duplicate)"
+            )
+        assert self.session_ttl >= 0
+        if self.session_ttl > 0:
+            assert self.has_sessions, (
+                "lifecycle.session_ttl needs sessions > 0 (the table "
+                "whose records expire)"
             )
 
     # -- serialization (one schema with the fault/workload plans) --------
@@ -237,6 +251,7 @@ class LifecycleState:
     sess_res: jnp.ndarray  # [L, S] cached result (completion tick; -1)
     resubmits: jnp.ndarray  # [] duplicate submissions drawn | [0]
     cache_hits: jnp.ndarray  # [] duplicates answered from the cache | [0]
+    expired: jnp.ndarray  # [] records demoted by session_ttl | [0]
     # Traced acceptor reconfiguration (reconfig=True).
     epoch: jnp.ndarray  # [] target epoch (host-bumped, traced) | [0]
     applied: jnp.ndarray  # [] epoch the tick has applied | [0]
@@ -279,6 +294,7 @@ def make_state(
         sess_res=jnp.full((Ls, S), -1, z32),
         resubmits=jnp.zeros(scalar_sess, z32),
         cache_hits=jnp.zeros(scalar_sess, z32),
+        expired=jnp.zeros(() if plan.session_ttl > 0 else (0,), z32),
         epoch=jnp.zeros(scalar_rc, z32),
         applied=jnp.zeros(scalar_rc, z32),
         acc_mask=jnp.ones(mask_shape, bool),
@@ -423,6 +439,21 @@ def sessions_step(
     wrote = (cand >= before[:, None]) & (cand >= 0)
     sess_last = jnp.where(wrote, cand, lcs.sess_last)
     sess_res = jnp.where(wrote, jnp.asarray(t, jnp.int32), lcs.sess_res)
+    expired = lcs.expired
+    if plan.session_ttl > 0:
+        # Expiry (the traced-threshold knob): records idle past the
+        # ttl demote to the unset sentinel, AFTER this tick's
+        # recording so a just-completed record is never expired by the
+        # same tick that wrote it. sess_total is untouched — it is the
+        # cumulative completion count the workload reconciliation
+        # reads, so conservation (sum(sess_total) == completed) holds
+        # across expiries exactly.
+        idle = (sess_res >= 0) & (
+            jnp.asarray(t, jnp.int32) - sess_res > plan.session_ttl
+        )
+        expired = expired + jnp.sum(idle)
+        sess_last = jnp.where(idle, -1, sess_last)
+        sess_res = jnp.where(idle, -1, sess_res)
     return dataclasses.replace(
         lcs,
         sess_total=after,
@@ -430,6 +461,7 @@ def sessions_step(
         sess_res=sess_res,
         resubmits=resubmits,
         cache_hits=cache_hits,
+        expired=expired,
     )
 
 
@@ -553,14 +585,26 @@ def invariants_ok(
     is inactive."""
     ok = jnp.asarray(True)
     if plan.has_sessions:
+        S = lcs.sess_last.shape[1]
         ok = (
             ok
             & jnp.all(lcs.sess_last < lcs.sess_total[:, None])
             & jnp.all(lcs.sess_last >= -1)
             & jnp.all((lcs.sess_last >= 0) == (lcs.sess_res >= 0))
             & (lcs.cache_hits <= lcs.resubmits)
+            # Live records never exceed what the lane has completed (or
+            # the table width) — expiry only ever SHRINKS the live set,
+            # so this holds with and without a ttl.
+            & jnp.all(
+                jnp.sum((lcs.sess_last >= 0).astype(jnp.int32), axis=1)
+                <= jnp.minimum(lcs.sess_total, S)
+            )
         )
+        if plan.session_ttl > 0:
+            ok = ok & (lcs.expired >= 0)
         if workload_completed is not None:
+            # Conservation reconciles ACROSS expiries: sess_total is
+            # cumulative and expiry never touches it.
             ok = ok & (jnp.sum(lcs.sess_total) == workload_completed)
     if plan.compaction:
         # rot_base is a CUMULATIVE counter (total rebased slots — see
@@ -604,6 +648,11 @@ def summary(plan: LifecyclePlan, lcs: LifecycleState) -> dict:
             resubmits=int(lcs.resubmits),
             cache_hits=int(lcs.cache_hits),
         )
+        if plan.session_ttl > 0:
+            out.update(
+                session_ttl=plan.session_ttl,
+                expired=int(lcs.expired),
+            )
     if plan.reconfig:
         import numpy as np
 

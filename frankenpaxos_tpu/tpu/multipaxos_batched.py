@@ -549,9 +549,14 @@ def tick(
     rep_lat = bit_latency(bits2, 0, cfg.lat_min, cfg.lat_max)
     p2b_delivered = bit_delivered(bits3, 24, cfg.drop_rate)
     # The extra sweep (drawn only when some feature needs it) feeds the
-    # p2a drop field [0:8) AND, for general-f thrifty, the quorum ranking
-    # scores [8:32) — disjoint fields, one generation.
-    need_extra = cfg.drop_rate > 0.0 or (cfg.thrifty and cfg.f > 1)
+    # p2a drop field [0:8) AND, for general-f or membership-aware
+    # thrifty, the quorum ranking scores [8:24) — disjoint fields, one
+    # generation. The traced-membership axis (lifecycle.reconfig) needs
+    # the ranking path even at f == 1: thrifty sampling must rank the
+    # LIVE members first (see sample_quorum's live=).
+    need_extra = cfg.drop_rate > 0.0 or (
+        cfg.thrifty and (cfg.f > 1 or cfg.lifecycle.reconfig)
+    )
     bits_extra = (
         jax.random.bits(k_extra, (A, G, W))
         if need_extra
@@ -979,10 +984,20 @@ def tick(
     # Phase2a goes to f+1 random acceptors of the slot's group. f==1 draws
     # from the always-generated bits2 sweep (bits_extra is all-zeros when
     # drop_rate == 0 and f == 1); general f ranks bits_extra fields [8:24)
-    # (disjoint from its p2a drop field [0:8)).
+    # (disjoint from its p2a drop field [0:8)). Under the traced
+    # membership axis the sampling is MEMBERSHIP-AWARE: dead members
+    # rank last, so a swapped-out acceptor is only sampled when fewer
+    # than f+1 live members exist — commits/tick no longer dips by a
+    # retry round across a swap (pinned by
+    # tests/test_checkpoint.py::test_membership_aware_thrifty_no_dip).
     if cfg.thrifty:
-        bits_q = bits2[None] if f == 1 else bits_extra
-        in_quorum = sample_quorum(bits_q, 8, f, A)
+        if acc_mask_live is not None:
+            in_quorum = sample_quorum(
+                bits_extra, 8, f, A, live=acc_mask_live[:, :, None]
+            )
+        else:
+            bits_q = bits2[None] if f == 1 else bits_extra
+            in_quorum = sample_quorum(bits_q, 8, f, A)
     else:
         in_quorum = jnp.ones((A, G, W), bool)
     send_ok = in_quorum & p2a_delivered
@@ -993,9 +1008,10 @@ def tick(
     )
     if acc_mask_live is not None:
         # Membership gating: Phase2a fan-outs and full-group retries
-        # reach live members only. A thrifty quorum that sampled a
-        # departed acceptor stalls its slot until the full-group retry
-        # (the reconfiguration throughput dip the serve bench records).
+        # reach live members only. The membership-aware sampling above
+        # already ranks live members first, so this mask only bites
+        # when fewer than f+1 members are live (no quorum exists and
+        # the slot correctly stalls until the membership heals).
         send_ok = send_ok & acc_mask_live[:, :, None]
         retry_deliv = retry_deliv & acc_mask_live[:, :, None]
 

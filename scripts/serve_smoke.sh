@@ -4,10 +4,17 @@
 #   1. clean shutdown (final drain + block_until_ready + report),
 #   2. a non-empty Perfetto-loadable trace export carrying BOTH device
 #      lifecycle spans and host dispatch spans,
-#   3. a non-empty scrape CSV (the live-dashboard feed), and
-#   4. static analysis exiting 0 with the trace-serve-nosync rule
+#   3. a non-empty scrape CSV (the live-dashboard feed),
+#   4. a SIGKILL-mid-serve leg (harness/recovery.py): the serve worker
+#      is killed at a randomized chunk boundary, restarts from the
+#      latest checkpoint, and must recover — invariants + exactly-once
+#      session books hold and the final state is sha256-identical to
+#      the uninterrupted twin, and
+#   5. static analysis exiting 0 with the trace-serve-nosync,
+#      checkpoint-alias-free, and trace-checkpoint-restore rules
 #      registered (the chunked dispatch path stays free of blocking
-#      transfers).
+#      transfers; the checkpoint snapshot aliases nothing; restore
+#      never recompiles).
 #
 # Usage: scripts/serve_smoke.sh [out_dir]   (SERVE_SMOKE_SECONDS=10)
 set -euo pipefail
@@ -51,7 +58,21 @@ print(
 )
 EOF
 
-# The full registry must exit 0 and know the serve rule.
-python -m frankenpaxos_tpu.analysis --list | grep -q trace-serve-nosync
-scripts/lint.sh --rule trace-serve-nosync
+# Kill-and-recover leg: SIGKILL the serve worker mid-run at a
+# randomized chunk boundary, restart from the newest valid checkpoint,
+# and verify liveness + invariants + exactly-once books + a final
+# state digest bit-identical to the uninterrupted twin.
+JAX_PLATFORMS=cpu python -m frankenpaxos_tpu.harness.recovery \
+  --smoke --out-dir "$OUT/recovery" --chunks 10 --every 2 \
+  --chunk-ticks 8
+
+# The full registry must exit 0 and know the serve + checkpoint rules.
+# (grep WITHOUT -q: -q exits at first match and the listing dies on
+# EPIPE under pipefail once the registry outgrew the pipe buffer.)
+RULES=$(python -m frankenpaxos_tpu.analysis --list)
+echo "$RULES" | grep trace-serve-nosync >/dev/null
+echo "$RULES" | grep checkpoint-alias-free >/dev/null
+echo "$RULES" | grep trace-checkpoint-restore >/dev/null
+scripts/lint.sh --rule trace-serve-nosync \
+  --rule checkpoint-alias-free --rule trace-checkpoint-restore
 echo "serve_smoke: PASS"
