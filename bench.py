@@ -66,11 +66,28 @@ scaling matrix of the compartmentalized backend
 ``--xla_force_host_platform_device_count=8``), prints one JSON line,
 and records per-leg ``n_devices``/``mesh_shape``/``collective_bytes``
 plus an HLO collective census verifying the group-local write path.
-Simulated-domain throughput (committed entries per tick at fixed
-per-device load) is the scaling headline on a CPU host — wall-clock
-columns are honest about the host's physical core count, and the
-real-TPU leg is flagged ``pending_tpu_remeasure``. Capture artifact:
-MULTICHIP_r06.json.
+Since the fleet PR it also carries per-mesh-size OFFERED-LOAD matrices
+(``shaped_load_matrix``): the traced rate swept through one compiled
+program per mesh size, so every scaling row has latency-vs-load, not
+just committed/tick. Simulated-domain throughput (committed entries
+per tick at fixed per-device load) is the scaling headline on a CPU
+host — wall-clock columns are honest about the host's physical core
+count, and the real-TPU leg is flagged ``pending_tpu_remeasure``.
+Capture artifact: MULTICHIP_r08.json.
+
+``--fleet`` is a SEPARATE mode: the fleet-axis capacity planner
+(parallel/sharding.py two-axis ``('fleet', 'groups')`` mesh). It maps
+the full [offered-load x fault-rate] saturation surface of the
+flagship in ONE compiled executable per mesh — every cell is a fleet
+instance whose traced offered rate and traced Bernoulli fault rates
+are state, so the whole surface is one ``run_ticks_fleet`` call
+(per-cell committed/sec + p99 commit latency + queue-wait p99 + shed;
+the runner's jit cache is asserted flat and the kernels-engaged
+lowering's per-device autotune block resolutions are recorded) — plus
+the device-rate fuzzing leg: ``simtest.run_fleet`` packs a whole
+[seeds x schedules] brick into one executable and is timed against
+the sequential per-config loop (one compile per schedule — the cost
+the fleet axis amortizes). Capture artifact: FLEET_r01.json.
 """
 
 from __future__ import annotations
@@ -513,12 +530,13 @@ def _multichip_inner() -> None:
     )
     G_PER_DEV = 3125  # x (2x2 grid) = 12,500 simulated acceptors/device
 
-    def make_cfg(G: int) -> "cbk.BatchedCompartmentalizedConfig":
+    def make_cfg(G: int, **kw) -> "cbk.BatchedCompartmentalizedConfig":
         return cbk.BatchedCompartmentalizedConfig(
             num_groups=G, grid_rows=2, grid_cols=2,
             num_proxy_leaders=8, num_batchers=2, num_unbatchers=2,
             num_replicas=3, window=32, batch_size=8,
             arrivals_per_tick=4, lat_min=1, lat_max=3, retry_timeout=16,
+            **kw,
         )
 
     def leg_census(cfg, mesh) -> dict:
@@ -635,6 +653,99 @@ def _multichip_inner() -> None:
         kernels_on[-1]["committed_entries"] == ref_check["committed_entries"]
     )
 
+    # Shaped-load legs (ROADMAP PR 9 follow-up (b)): per-mesh-size
+    # offered-load matrices. Each mesh size anchors the rate scale at
+    # its own measured saturation (the weak-scaling row), then sweeps
+    # 0.5x/0.9x/1.1x of it as the TRACED state-side rate — every leg of
+    # a mesh size replays ONE compiled program, so the scaling rows
+    # carry latency-vs-load, not just committed/tick.
+    from frankenpaxos_tpu.monitoring.slo import hist_p99
+    from frankenpaxos_tpu.tpu import workload as wl_mod
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    import dataclasses as _dcl
+
+    def shaped_matrix(n_dev: int, sat_row: dict, warm=30, ticks=30):
+        G = sat_row["num_groups"]
+        sat_lane = sat_row["committed_per_tick"] / G
+        cfg = make_cfg(
+            G,
+            workload=WorkloadPlan(
+                arrival="constant", rate=sat_lane, backlog_cap=256
+            ),
+        )
+        mesh = sh.make_mesh(devices[:n_dev])
+        rows = []
+        cache_before = None
+        for frac in (0.5, 0.9, 1.1):
+            state = sh.shard_state(
+                "compartmentalized", cbk.init_state(cfg), mesh
+            )
+            state = _dcl.replace(
+                state,
+                workload=wl_mod.set_rate(
+                    state.workload, frac * sat_lane
+                ),
+            )
+            key = jax.random.PRNGKey(int(frac * 100))
+            state, t = sh.run_ticks_sharded(
+                "compartmentalized", cfg, mesh, state,
+                jnp.zeros((), jnp.int32), warm, key,
+            )
+            jax.block_until_ready(state.committed)
+            c0 = int(state.committed)
+            lat0 = jax.device_get(state.lat_hist)
+            wait0 = jax.device_get(state.workload.wait_hist)
+            start = time.perf_counter()
+            state, t = sh.run_ticks_sharded(
+                "compartmentalized", cfg, mesh, state, t, ticks,
+                jax.random.fold_in(key, 1),
+            )
+            jax.block_until_ready(state.committed)
+            dt = time.perf_counter() - start
+            lat_d = jax.device_get(state.lat_hist) - lat0
+            wait_d = jax.device_get(state.workload.wait_hist) - wait0
+            summ = wl_mod.summary(cfg.workload, state.workload)
+            rows.append({
+                "load_fraction": frac,
+                "offered_rate_per_lane": round(frac * sat_lane, 4),
+                "committed": int(state.committed) - c0,
+                "committed_per_tick": round(
+                    (int(state.committed) - c0) / ticks, 1
+                ),
+                "ticks_per_sec": round(ticks / dt, 2),
+                "p99_commit_latency_ticks": hist_p99(lat_d, 0.99),
+                "queue_wait_p99_ticks": hist_p99(wait_d, 0.99),
+                "shed_total": summ["shed"],
+                "invariants_ok": all(
+                    bool(v)
+                    for v in cbk.check_invariants(cfg, state, t).values()
+                ),
+            })
+            if cache_before is None:
+                # After the first leg the program is compiled; the
+                # remaining rate legs must hit the same executable.
+                cache_before = sh._runner(
+                    "compartmentalized",
+                    sh._wrap_mesh("compartmentalized", cfg, mesh),
+                )._cache_size()
+        cache_after = sh._runner(
+            "compartmentalized",
+            sh._wrap_mesh("compartmentalized", cfg, mesh),
+        )._cache_size()
+        return {
+            "n_devices": n_dev,
+            "num_groups": G,
+            "saturation_rate_per_lane_per_tick": round(sat_lane, 4),
+            "legs": rows,
+            "one_compile_per_mesh_size": cache_after == cache_before,
+        }
+
+    shaped_load = [
+        shaped_matrix(d, row)
+        for d, row in zip((1, 2, 4, 8), weak)
+    ]
+
     # Headline census: the full 8-device, 100k-acceptor program — the
     # group-local-write-path claim as a compile-time fact.
     census = leg_census(make_cfg(G_PER_DEV * 8), sh.make_mesh(devices[:8]))
@@ -658,6 +769,9 @@ def _multichip_inner() -> None:
         # cross-check against the reference program.
         "kernels_on_matrix": kernels_on,
         "kernels_vs_reference_committed_match": kernels_match,
+        # Per-mesh-size offered-load matrices (traced-rate sweeps, one
+        # compile per mesh size): latency-vs-load at every scale.
+        "shaped_load_matrix": shaped_load,
         "collective_census_8dev_100k": census,
         "scaling": {
             "basis": (
@@ -689,6 +803,248 @@ def _multichip_inner() -> None:
         "measured_live": True,
         "pending_tpu_remeasure": True,
     }
+    print("BENCH_JSON " + json.dumps(result))
+
+
+def _fleet_inner() -> None:
+    """The fleet-axis measurement (``--fleet``); runs in a subprocess
+    with 8 virtual CPU devices. Two legs (module docstring): the
+    one-compile-per-mesh [offered-load x fault-rate] saturation
+    surface, and the simtest fleet fuzzer timed against the sequential
+    per-config loop. One JSON line on stdout (BENCH_JSON ...).
+    Capture artifact: FLEET_r01.json."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.monitoring.slo import hist_p99
+    from frankenpaxos_tpu.ops.registry import KernelPolicy
+    from frankenpaxos_tpu.parallel import sharding as sh
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    # Multi-host entry: a no-op on this single-process virtual mesh,
+    # the jax.distributed init + barrier on a real pod (the same code
+    # path runs both — the T5X pattern parallel/sharding.py documents).
+    sh_multihost = sh.maybe_init_distributed()
+    sh.host_sync("fleet-bench-start")
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"need 8 virtual devices, have {len(devices)}"
+    G, W, K = 512, 32, 4
+    WARM, MEAS = 60, 120
+    key = jax.random.PRNGKey(0)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def base_cfg(**kw) -> "mp.BatchedMultiPaxosConfig":
+        return mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=G, window=W, slots_per_tick=K,
+            lat_min=1, lat_max=3, retry_timeout=16, thrifty=True, **kw
+        )
+
+    # 1. Saturation anchor (single instance, none plan): fixes the
+    # offered-load scale for the surface, exactly as --workload does.
+    cfg0 = base_cfg()
+    st = mp.init_state(cfg0)
+    st, t = mp.run_ticks(cfg0, st, t0, WARM, key)
+    jax.block_until_ready(st.committed)
+    c0 = int(st.committed)
+    start = time.perf_counter()
+    st, t = mp.run_ticks(cfg0, st, t, MEAS, jax.random.fold_in(key, 1))
+    jax.block_until_ready(st.committed)
+    sat_dt = time.perf_counter() - start
+    sat_committed = int(st.committed) - c0
+    sat_rate_lane = sat_committed / MEAS / G
+
+    # 2. The saturation surface: [offered-load x fault-rate] as ONE
+    # fleet brick — each cell an instance with its own traced offered
+    # rate and traced drop rate, the whole surface one executable.
+    loads = (0.25, 0.5, 0.9, 1.1)
+    drops = (0.0, 0.05, 0.15, 0.3)
+    cells = [(ld, dr) for ld in loads for dr in drops]
+    F = len(cells)
+    cfg = base_cfg(
+        workload=WorkloadPlan(
+            arrival="constant", rate=sat_rate_lane, backlog_cap=256
+        ),
+        faults=FaultPlan(traced=True),
+    )
+    mesh = sh.make_fleet_mesh(fleet=2)
+    rates = [ld * sat_rate_lane for ld, _ in cells]
+    frates = [[dr, 0.0, 0.0, 0.0] for _, dr in cells]
+    states = sh.shard_fleet_state(
+        "multipaxos",
+        sh.fleet_states("multipaxos", cfg, F, rates=rates,
+                        fault_rates=frates),
+        mesh,
+    )
+    keys = sh.fleet_keys(range(F))
+    # Warm and measure share ONE static tick count, so the whole
+    # surface — warm-up included — is one compiled executable.
+    SWEEP = 100
+    states, tf = sh.run_ticks_fleet(
+        "multipaxos", cfg, mesh, states, t0, SWEEP, keys
+    )
+    jax.block_until_ready(states.committed)
+    c0s = np.asarray(states.committed).copy()
+    lat0 = np.asarray(states.lat_hist).copy()
+    wait0 = np.asarray(states.workload.wait_hist).copy()
+    shed0 = np.asarray(states.workload.shed).copy()
+    start = time.perf_counter()
+    # Fresh per-segment keys (run_ticks folds the scan index, not the
+    # absolute tick): the measured window draws an independent random
+    # stream instead of replaying the warm-up's, same executable.
+    keys2 = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+    states, tf = sh.run_ticks_fleet(
+        "multipaxos", cfg, mesh, states, tf, SWEEP, keys2
+    )
+    jax.block_until_ready(states.committed)
+    dt = time.perf_counter() - start
+    committed = np.asarray(states.committed) - c0s
+    lat_d = np.asarray(states.lat_hist) - lat0
+    wait_d = np.asarray(states.workload.wait_hist) - wait0
+    shed_d = np.asarray(states.workload.shed) - shed0
+    inv = jax.device_get(
+        jax.jit(
+            jax.vmap(lambda s, tt: mp.check_invariants(cfg, s, tt))
+        )(states, tf)
+    )
+    surface = []
+    for i, (ld, dr) in enumerate(cells):
+        surface.append({
+            "load_fraction": ld,
+            "drop_rate": dr,
+            "committed": int(committed[i]),
+            "committed_per_tick": round(float(committed[i]) / SWEEP, 2),
+            "committed_per_sec": round(float(committed[i]) / dt, 1),
+            "p99_commit_latency_ticks": hist_p99(lat_d[i], 0.99),
+            "queue_wait_p99_ticks": hist_p99(wait_d[i], 0.99),
+            "shed": int(shed_d[i]),
+            "invariants_ok": all(bool(inv[k][i]) for k in inv),
+        })
+    wrap = sh._fleet_wrap_mesh("multipaxos", cfg, mesh)
+    runner = sh._fleet_runner("multipaxos", mesh, wrap)
+    one_compile = runner._cache_size() == 1
+
+    # Kernels-engaged LOWERING of the same brick: populates the
+    # registry's per-device block resolutions (the autotune table keyed
+    # at the true per-device shape under the product mesh) for the
+    # JSON record; the compiled-wall-clock kernels leg stays on the
+    # TPU-hardware-debt list.
+    cfg_k = dataclasses.replace(cfg, kernels=KernelPolicy(mode="interpret"))
+    states_k = sh.fleet_states(
+        "multipaxos", cfg_k, F, rates=rates, fault_rates=frates
+    )
+    states_k = sh.shard_fleet_state("multipaxos", states_k, mesh)
+    sh.lower_fleet("multipaxos", cfg_k, mesh, states_k, t0, 2, keys)
+    resolved_blocks = sh.fleet_block_plan("multipaxos", cfg_k, mesh)
+
+    # 3. Device-rate fuzzing: a [seeds x schedules] brick through ONE
+    # executable (simtest.run_fleet on a second, (2, 2) mesh — its own
+    # cached program) vs the sequential per-config loop (one compile
+    # per schedule: static rates, the pre-fleet cost model).
+    import random as _random
+
+    spec = simtest.SPECS["multipaxos"]
+    n_sched, n_seeds, ticks = 16, 2, 80
+    rng = _random.Random(0)
+    fuzz_cells = [
+        simtest.random_rate_cell(rng, spec) for _ in range(n_sched)
+    ]
+    # Brick on the default device: on this 1-core host the product
+    # mesh only adds partitioning overhead (all virtual devices share
+    # the core), so the fuzzer's headline is the unmeshed brick; the
+    # meshed brick is timed alongside it for the composition record.
+    start = time.perf_counter()
+    fleet_res = simtest.run_fleet(
+        spec, cells=fuzz_cells, seeds_per_schedule=n_seeds, ticks=ticks,
+    )
+    fleet_dt = time.perf_counter() - start
+    fuzz_mesh = sh.make_fleet_mesh(fleet=2, devices=devices[:4])
+    start = time.perf_counter()
+    fleet_mesh_res = simtest.run_fleet(
+        spec, cells=fuzz_cells, seeds_per_schedule=n_seeds,
+        ticks=ticks, mesh=fuzz_mesh,
+    )
+    fleet_mesh_dt = time.perf_counter() - start
+    start = time.perf_counter()
+    seq_ok = True
+    for cell in fuzz_cells:
+        plan = FaultPlan(
+            drop_rate=cell["drop"], dup_rate=cell["dup"],
+            crash_rate=cell["crash"], revive_rate=cell["revive"],
+        )
+        wplan = WorkloadPlan(arrival="constant", rate=cell["rate"])
+        res = simtest.run_many_seeds(
+            spec, plan, list(range(n_seeds)), ticks, workload=wplan
+        )
+        seq_ok = seq_ok and res["ok"]
+    seq_dt = time.perf_counter() - start
+    n_runs = n_sched * n_seeds
+    fuzz = {
+        "schedules": n_sched,
+        "seeds_per_schedule": n_seeds,
+        "ticks": ticks,
+        "instances": n_runs,
+        "fleet_seconds": round(fleet_dt, 2),
+        "fleet_mesh": [int(s) for s in dict(fuzz_mesh.shape).values()],
+        "fleet_mesh_seconds": round(fleet_mesh_dt, 2),
+        "sequential_seconds": round(seq_dt, 2),
+        "fleet_schedules_per_sec": round(n_runs / fleet_dt, 1),
+        "sequential_schedules_per_sec": round(n_runs / seq_dt, 1),
+        # Wall-clock INCLUDING compiles on both sides: the sequential
+        # loop pays one compile per schedule (static rates), the fleet
+        # brick pays one total — exactly the cost the fleet amortizes.
+        "speedup_x": round(seq_dt / fleet_dt, 2),
+        "speedup_x_meshed": round(seq_dt / fleet_mesh_dt, 2),
+        "fleet_ok": fleet_res["ok"] and fleet_mesh_res["ok"],
+        "sequential_ok": seq_ok,
+        "verdicts_match_across_meshes": (
+            fleet_res["per_instance_ok"]
+            == fleet_mesh_res["per_instance_ok"]
+        ),
+        "host_physical_cores": os.cpu_count(),
+        "note": (
+            "single-physical-core host: the virtual-device mesh adds "
+            "partitioning overhead without parallelism, so the "
+            "default-device brick is the throughput headline; real "
+            "multi-chip meshes multiply it (pending_tpu_remeasure)"
+        ),
+    }
+
+    result = {
+        "metric": (
+            "fleet-axis capacity surface + device-rate fuzzing "
+            "throughput (one compiled executable per mesh)"
+        ),
+        "backend": "multipaxos",
+        "device": str(devices[0]),
+        "n_devices": len(devices[:8]),
+        "mesh_shape": [int(s) for s in dict(mesh.shape).values()],
+        "num_groups": G,
+        "saturation": {
+            "committed_per_tick": round(sat_committed / MEAS, 2),
+            "committed_per_sec": round(sat_committed / sat_dt, 1),
+            "rate_per_lane_per_tick": round(sat_rate_lane, 4),
+        },
+        "surface_cells": F,
+        "surface_ticks": SWEEP,
+        "surface_wall_seconds": round(dt, 2),
+        "saturation_surface": surface,
+        "one_compile_per_mesh": one_compile,
+        "resolved_blocks": resolved_blocks,
+        "fuzz": fuzz,
+        "invariants_ok": all(r["invariants_ok"] for r in surface),
+        "multi_host": sh_multihost,
+        "measured_live": True,
+        "pending_tpu_remeasure": True,
+    }
+    sh.host_sync("fleet-bench-done")
     print("BENCH_JSON " + json.dumps(result))
 
 
@@ -1606,6 +1962,21 @@ def _multichip_main() -> None:
     )
 
 
+def _fleet_main() -> None:
+    """Orchestrate the fleet measurement in a clean 8-virtual-device
+    CPU subprocess; print exactly one JSON line, exit 0."""
+    env = _cpu_env()
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    _subprocess_mode_main(
+        "--inner-fleet",
+        "fleet-axis capacity surface + device-rate fuzzing throughput",
+        env,
+    )
+
+
 def _cpu_env() -> dict:
     env = {
         k: v
@@ -1871,6 +2242,8 @@ def main() -> None:
 if __name__ == "__main__":
     if "--inner-multichip" in sys.argv:
         _multichip_inner()
+    elif "--inner-fleet" in sys.argv:
+        _fleet_inner()
     elif "--inner-workload" in sys.argv:
         _workload_inner()
     elif "--inner-serve" in sys.argv:
@@ -1883,6 +2256,8 @@ if __name__ == "__main__":
         _inner_main()
     elif "--multichip" in sys.argv:
         _multichip_main()
+    elif "--fleet" in sys.argv:
+        _fleet_main()
     elif "--workload" in sys.argv:
         _workload_main()
     elif "--serve" in sys.argv:

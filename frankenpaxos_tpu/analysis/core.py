@@ -33,7 +33,11 @@ from frankenpaxos_tpu.analysis import astutil
 # aliases no input and carries no host callback) and
 # trace-checkpoint-restore (save -> load -> restore is bit-exact and
 # replays the existing compiled run_ticks with a flat jit cache).
-ANALYSIS_VERSION = "1.7"
+# 1.8: trace-fleet-onecompile — a [seeds x workload x fault] fleet
+# brick is one compiled executable per product mesh (flat jit cache
+# across traced-rate re-sweeps) and no signed collective crosses the
+# fleet axis (replica-group census) or moves state at all.
+ANALYSIS_VERSION = "1.8"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

@@ -33,6 +33,17 @@ AST layer structurally cannot see.
   axis and shard_map is gathering state), and the reference-mode trace
   must stay pallas-free. Needs >=2 devices (scripts/lint.sh forces an
   8-virtual-device CPU host; pytest's conftest does the same).
+* ``trace-fleet-onecompile`` — the fleet-axis contract
+  (parallel/sharding.py product mesh): a whole [seeds x workload x
+  fault] brick — per-instance traced offered rates + traced Bernoulli
+  fault rates — compiles to exactly ONE executable per mesh (a
+  traced-rate re-sweep keeps the fleet runner's jit cache flat), and
+  the compiled program's signed collectives all stay INSIDE one fleet
+  row (replica-group census over both the explicit and iota HLO
+  formats) with no signed state-moving collective at all — protocol
+  instances are provably independent along the fleet axis. Needs >=4
+  devices (the 2-row product mesh); scripts/lint.sh forces the same
+  8-virtual-device host as the pytest conftest, which covers it.
 
 All jax imports live inside the checks so the AST layer stays
 importable without jax.
@@ -488,32 +499,45 @@ _COLLECTIVE_TOKENS = (
 )
 
 
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _collective_line_shapes(line: str):
+    """``(dtype, elems)`` result shapes of one HLO collective line, or
+    None when the line is not a collective / has no parseable result
+    shapes. Every shape of a combined tuple-shaped collective is
+    returned — XLA's combiner can hide a large reduction behind a
+    scalar first element. ONE scanner shared by the multichip-era
+    signed-size census and the fleet replica-group census, so a parser
+    fix never has to land twice."""
+    op_at = [
+        line.index(tok + suffix)
+        for tok in _COLLECTIVE_TOKENS
+        for suffix in ("(", "-start(")
+        if (tok + suffix) in line
+    ]
+    eq_at = line.find("=")
+    if not op_at or eq_at < 0:
+        return None
+    shapes = []
+    for dtype, dims in _SHAPE_RE.findall(line[eq_at: min(op_at)]):
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        shapes.append((dtype, elems))
+    return shapes or None
+
+
 def _max_signed_collective_elems(hlo_text: str) -> int:
     """Largest signed/pred result element count across the compiled
     module's collectives (unsigned u32 shapes are threefry PRNG-sweep
-    assembly, counted by the multichip tests separately). Every shape
-    of a combined tuple-shaped collective is counted — XLA's combiner
-    can hide a large reduction behind a scalar first element."""
-    shape_re = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+    assembly, counted by the multichip tests separately)."""
     worst = 0
     for line in hlo_text.splitlines():
-        op_at = [
-            line.index(tok + suffix)
-            for tok in _COLLECTIVE_TOKENS
-            for suffix in ("(", "-start(")
-            if (tok + suffix) in line
-        ]
-        eq_at = line.find("=")
-        if not op_at or eq_at < 0:
-            continue
-        for dtype, dims in shape_re.findall(line[eq_at: min(op_at)]):
-            if dtype.startswith("u"):
-                continue
-            elems = 1
-            for d in dims.split(","):
-                if d:
-                    elems *= int(d)
-            worst = max(worst, elems)
+        for dtype, elems in _collective_line_shapes(line) or ():
+            if not dtype.startswith("u"):
+                worst = max(worst, elems)
     return worst
 
 
@@ -1281,4 +1305,237 @@ def check_checkpoint_restore(ctx: Context) -> List[Finding]:
                 key=backend,
             )
         )
+    return out
+
+
+_RG_EXPLICIT = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+_RG_IOTA = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+
+
+def _collective_groups(line: str):
+    """The replica groups of one HLO collective line as a list of
+    device-id lists, handling the explicit ``{{0,1},{2,3}}`` form and
+    the iota ``[2,4]<=[8]`` / ``[4,2]<=[2,4]T(1,0)`` forms. Returns
+    None when the format is unrecognized (the caller treats
+    unparseable as a finding — never a silent pass)."""
+    m = _RG_EXPLICIT.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            groups.append(ids)
+        return groups
+    m = _RG_IOTA.search(line)
+    if m:
+        import numpy as np
+
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):  # iota with a transpose: reshape + permute
+            perm = [int(d) for d in m.group(4).split(",")]
+            ids = ids.transpose(perm)
+        ids = [int(x) for x in ids.ravel()]
+        if len(ids) != n_groups * group_size:
+            return None
+        return [
+            ids[i * group_size: (i + 1) * group_size]
+            for i in range(n_groups)
+        ]
+    if "replica_groups=" in line:
+        return None
+    return [[0]]  # no groups attribute: a degenerate single-device op
+
+
+def _fleet_rows(n_fleet: int, n_group: int):
+    """Device-id rows of a ``(fleet, group)`` product mesh built from
+    the devices in order (``parallel.sharding.make_fleet_mesh``): row i
+    owns flat ids [i*n_group, (i+1)*n_group) — the sets no protocol
+    collective may cross."""
+    return [
+        set(range(i * n_group, (i + 1) * n_group))
+        for i in range(n_fleet)
+    ]
+
+
+@rule(
+    "trace-fleet-onecompile",
+    "trace",
+    "a whole [seeds x workload x fault] fleet brick is ONE compiled "
+    "executable per mesh (a traced-rate re-sweep keeps the fleet "
+    "runner's jit cache flat), and the compiled program's collectives "
+    "never cross the fleet axis: every signed-state replica group "
+    "stays inside one fleet row, with no signed all-gather/"
+    "all-to-all/collective-permute of state at all",
+)
+def check_fleet_onecompile(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.parallel import sharding as _sh
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    out: List[Finding] = []
+    if len(jax.devices()) < 4:
+        import sys
+
+        print(
+            "trace-fleet-onecompile: SKIPPED (needs >=4 jax devices "
+            "for a 2x2 product mesh; set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 or run via "
+            "scripts/lint.sh)",
+            file=sys.stderr,
+        )
+        return out
+    selected = _selected(ctx)
+    for backend, spec in sorted(_sh.SHARDINGS.items()):
+        if backend not in selected or spec.planes_backend is None:
+            continue
+        mod = _module(backend)
+        base = mod.analysis_config(
+            faults=FaultPlan(traced=True),
+            workload=WorkloadPlan(arrival="constant", rate=1.0),
+        )
+        state = mod.init_state(base)
+        axis_len = spec.axis_len(state)
+        n_group = max(
+            (
+                d
+                for d in range(
+                    1, min(len(jax.devices()) // 2, axis_len) + 1
+                )
+                if axis_len % d == 0
+            ),
+            default=1,
+        )
+        mesh = _sh.make_fleet_mesh(
+            fleet=2, devices=jax.devices()[: 2 * n_group]
+        )
+        F = 4
+        rates_a = [0.5, 1.0, 1.5, 2.0]
+        frates_a = [[0.05 * i, 0.0, 0.0, 0.0] for i in range(F)]
+        keys = _sh.fleet_keys(range(F))
+        t0 = jnp.zeros((), jnp.int32)
+
+        def brick(rates, frates):
+            states = _sh.fleet_states(
+                backend, base, F, rates=rates, fault_rates=frates
+            )
+            return _sh.shard_fleet_state(backend, states, mesh)
+
+        wrap = _sh._fleet_wrap_mesh(backend, base, mesh)
+        runner = _sh._fleet_runner(backend, mesh, wrap)
+        before = runner._cache_size()
+        sts, _ = _sh.run_ticks_fleet(
+            backend, base, mesh, brick(rates_a, frates_a), t0, _TICKS,
+            keys,
+        )
+        jax.block_until_ready(jax.tree_util.tree_leaves(sts)[0])
+        after_first = runner._cache_size()
+        # The re-sweep: new traced rates through the SAME executable.
+        sts2, _ = _sh.run_ticks_fleet(
+            backend, base, mesh,
+            brick([2.0, 0.25, 0.75, 1.25], [[0.2, 0.1, 0.0, 0.0]] * F),
+            t0, _TICKS, keys,
+        )
+        jax.block_until_ready(jax.tree_util.tree_leaves(sts2)[0])
+        # A pre-warmed runner (another brick of this process already
+        # compiled this signature) legally starts at a cache hit; the
+        # contract is: at most ONE compile for the first brick, and the
+        # re-sweep NEVER compiles.
+        if runner._cache_size() != after_first or (
+            after_first > before + 1
+        ):
+            out.append(
+                Finding(
+                    rule="trace-fleet-onecompile",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "the [seeds x workload x fault] brick is not "
+                        "one executable per mesh: fleet-runner jit "
+                        f"cache went {before} -> {after_first} -> "
+                        f"{runner._cache_size()} across a traced-rate "
+                        "re-sweep (a rate or fault knob regressed to "
+                        "compile-time static)"
+                    ),
+                    key=f"{backend}:cache",
+                )
+            )
+        # Collective census of the compiled brick: nothing crosses the
+        # fleet axis, and no signed state moves at all.
+        hlo = _sh.lower_fleet(
+            backend, base, mesh, brick(rates_a, frates_a), t0, _TICKS,
+            keys,
+        ).compile().as_text()
+        rows = _fleet_rows(2, n_group)
+        for line in hlo.splitlines():
+            # Signed/pred shapes only: u32 collectives are threefry
+            # PRNG-sweep assembly (bounded separately by the multichip
+            # census); protocol state is all signed/pred.
+            shapes = _collective_line_shapes(line)
+            if not shapes or all(d.startswith("u") for d, _ in shapes):
+                continue
+            big = [
+                tok
+                for tok in (
+                    "all-gather", "all-to-all", "collective-permute"
+                )
+                if tok + "(" in line or tok + "-start(" in line
+            ]
+            if big:
+                out.append(
+                    Finding(
+                        rule="trace-fleet-onecompile",
+                        path=backend,
+                        line=0,
+                        message=(
+                            f"signed {big[0]} in the compiled fleet "
+                            "brick — simulation state is moving "
+                            "between devices (allowed: all-reduce "
+                            "stat reductions only)"
+                        ),
+                        key=f"{backend}:move:{big[0]}",
+                    )
+                )
+            groups = _collective_groups(line)
+            if groups is None:
+                out.append(
+                    Finding(
+                        rule="trace-fleet-onecompile",
+                        path=backend,
+                        line=0,
+                        message=(
+                            "unparseable replica_groups on a signed "
+                            f"collective: {line.strip()[:160]}"
+                        ),
+                        key=f"{backend}:unparseable",
+                    )
+                )
+                continue
+            for grp in groups:
+                if not any(set(grp) <= row for row in rows):
+                    out.append(
+                        Finding(
+                            rule="trace-fleet-onecompile",
+                            path=backend,
+                            line=0,
+                            message=(
+                                f"signed collective spans fleet rows "
+                                f"{sorted(grp)} (rows are "
+                                f"{[sorted(r) for r in rows]}) — "
+                                "protocol state is crossing the fleet "
+                                "axis; instances are no longer "
+                                "independent"
+                            ),
+                            key=f"{backend}:crossfleet",
+                        )
+                    )
+                    break
     return out

@@ -1306,6 +1306,102 @@ def bench_mesh_kernels(
     return rows
 
 
+def bench_fleet(
+    ticks: int = 40,
+    schedules: int = 6,
+    seeds_per_schedule: int = 2,
+    rounds: int = 2,
+) -> List[dict]:
+    """Fleet brick vs sequential per-config loop at toy size (guards
+    the ``bench.py --fleet`` fuzz leg): the SAME randomized traced-rate
+    cells run (a) as one ``simtest.run_fleet`` brick — one compiled
+    executable for all [schedules x seeds] instances — and (b) as the
+    sequential loop of per-cell static-rate ``run_many_seeds`` calls
+    (one compile per cell — the pre-fleet cost). Rows time both sides
+    end to end INCLUDING compiles (that is the cost the fleet axis
+    amortizes); a ``FLEET_JSON`` line carries the summary, with the
+    verdict agreement pinned."""
+    import json
+    import random as _random
+
+    import jax
+
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    assert rounds >= 1, "bench_fleet needs at least one round"
+    spec = simtest.SPECS["multipaxos"]
+    rng = _random.Random(0)
+    cells = [
+        simtest.random_rate_cell(rng, spec) for _ in range(schedules)
+    ]
+    n_runs = schedules * seeds_per_schedule
+
+    fleet_ok = True
+
+    def run_fleet_side() -> int:
+        nonlocal fleet_ok
+        res = simtest.run_fleet(
+            spec, cells=cells, seeds_per_schedule=seeds_per_schedule,
+            ticks=ticks,
+        )
+        fleet_ok = fleet_ok and res["ok"]
+        return n_runs
+
+    seq_ok = True
+
+    def run_seq_side() -> int:
+        nonlocal seq_ok
+        for cell in cells:
+            plan = FaultPlan(
+                drop_rate=cell["drop"], dup_rate=cell["dup"],
+                crash_rate=cell["crash"], revive_rate=cell["revive"],
+            )
+            res = simtest.run_many_seeds(
+                spec, plan, list(range(seeds_per_schedule)), ticks,
+                workload=WorkloadPlan(
+                    arrival="constant", rate=cell["rate"]
+                ),
+            )
+            seq_ok = seq_ok and res["ok"]
+        return n_runs
+
+    best = {"fleet_brick": float("inf"), "sequential": float("inf")}
+    for i in range(rounds):
+        # Round 0 pays the compiles on both sides; later rounds are
+        # warm. best-of keeps the warm number, the FLEET_JSON carries
+        # the cold one too (the amortization story lives in round 0).
+        _, s = _timed(run_fleet_side)
+        if i == 0:
+            cold_fleet = s
+        best["fleet_brick"] = min(best["fleet_brick"], s)
+        _, s = _timed(run_seq_side)
+        if i == 0:
+            cold_seq = s
+        best["sequential"] = min(best["sequential"], s)
+    rows = [
+        _report("fleet", case, n_runs, best[case]) for case in best
+    ]
+    payload = {
+        "backend": jax.default_backend(),
+        "schedules": schedules,
+        "seeds_per_schedule": seeds_per_schedule,
+        "ticks": ticks,
+        "cold_fleet_seconds": round(cold_fleet, 3),
+        "cold_sequential_seconds": round(cold_seq, 3),
+        "cold_speedup_x": round(cold_seq / cold_fleet, 2),
+        "warm_speedup_x": round(
+            best["sequential"] / best["fleet_brick"], 2
+        ),
+        "fleet_ok": fleet_ok,
+        "sequential_ok": seq_ok,
+    }
+    print("FLEET_JSON " + json.dumps(payload))
+    rows.append({"name": "fleet", "case": "summary", **payload})
+    return rows
+
+
 def bench_kernels(iters: int = 20, **sizes) -> List[dict]:
     """Per-plane kernel microbenchmark + autotuner: the jitted pure-jnp
     reference of EVERY registered plane is timed at flagship shapes; on
@@ -1430,6 +1526,7 @@ DEVICE_BENCHES = {
     "fused_tick": bench_fused_tick,
     "grid_vote": bench_grid_vote,
     "mesh_kernels": bench_mesh_kernels,
+    "fleet": bench_fleet,
 }
 
 

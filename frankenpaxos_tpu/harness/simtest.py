@@ -20,6 +20,16 @@ axis; the harness then
     (:func:`run_many_seeds` — the "thousands of randomized schedules
     per compiled scan" axis; a schedule's rates are static, its
     randomness is the seed),
+  * packs a whole [seeds x schedules] BRICK into ONE compiled
+    executable (:func:`run_fleet` — the fleet axis of
+    ``parallel/sharding.py``): with ``FaultPlan(traced=True)`` and a
+    shaped workload, every Bernoulli fault rate and the offered load
+    are per-instance STATE, so N randomized schedules x M seeds are
+    N*M fleet instances of one program — device-rate fuzzing at
+    thousands of schedules/sec instead of one python loop iteration
+    per config, invariants reduced per-instance in-graph. Runs on the
+    default device (``mesh=None``) or any ``('fleet', 'groups')``
+    product mesh, one executable per mesh,
   * asserts liveness resumes after a scheduled partition heal
     (:func:`check_liveness_after_heal`), and
   * greedily SHRINKS a failing plan to a minimized reproducer dumped as
@@ -237,6 +247,33 @@ SPECS: Dict[str, SimSpec] = _specs()
 # ---------------------------------------------------------------------------
 
 
+def _draw_drop_dup(rng: _random.Random) -> Tuple[float, float]:
+    """The fuzz distribution of the drop/dup Bernoulli rates — ONE
+    definition shared by :func:`random_plan` (static plans) and
+    :func:`random_rate_cell` (traced fleet bricks), so retuning the
+    ranges keeps both fuzzers sampling the same space. 0.0 = knob off."""
+    drop = (
+        round(rng.uniform(0.02, 0.25), 3) if rng.random() < 0.7 else 0.0
+    )
+    dup = (
+        round(rng.uniform(0.02, 0.2), 3) if rng.random() < 0.4 else 0.0
+    )
+    return drop, dup
+
+
+def _draw_crash(
+    rng: _random.Random, spec: SimSpec
+) -> Tuple[float, float]:
+    """The shared crash/revive fuzz distribution ((0, 0) = off; always
+    off for ``crash_ok=False`` backends)."""
+    if spec.crash_ok and rng.random() < 0.35:
+        return (
+            round(rng.uniform(0.005, 0.05), 3),
+            round(rng.uniform(0.1, 0.3), 3),
+        )
+    return 0.0, 0.0
+
+
 def random_plan(
     rng: _random.Random, spec: SimSpec, horizon: int
 ) -> FaultPlan:
@@ -245,15 +282,17 @@ def random_plan(
     so every schedule's liveness-after-heal is checkable and the
     per-segment compiles are shared across schedules."""
     kw: dict = {}
-    if rng.random() < 0.7:
-        kw["drop_rate"] = round(rng.uniform(0.02, 0.25), 3)
-    if rng.random() < 0.4:
-        kw["dup_rate"] = round(rng.uniform(0.02, 0.2), 3)
+    drop, dup = _draw_drop_dup(rng)
+    if drop:
+        kw["drop_rate"] = drop
+    if dup:
+        kw["dup_rate"] = dup
     if rng.random() < 0.5:
         kw["jitter"] = rng.randint(1, 3)
-    if spec.crash_ok and rng.random() < 0.35:
-        kw["crash_rate"] = round(rng.uniform(0.005, 0.05), 3)
-        kw["revive_rate"] = round(rng.uniform(0.1, 0.3), 3)
+    crash, revive = _draw_crash(rng, spec)
+    if crash:
+        kw["crash_rate"] = crash
+        kw["revive_rate"] = revive
     if rng.random() < 0.5:
         n = spec.partition_axis
         # Cut a strict minority of the replica axis (side 1).
@@ -339,6 +378,23 @@ def random_lifecycle(
         kw["sessions"] = rng.choice([2, 4, 8])
         kw["resubmit_rate"] = round(rng.uniform(0.05, 0.3), 3)
     return LifecyclePlan(**kw)
+
+
+def random_rate_cell(rng: _random.Random, spec: SimSpec) -> dict:
+    """One randomized TRACED-rate cell of a fleet brick, deterministic
+    from ``rng``: the workload offered rate plus the four Bernoulli
+    fault rates. These are exactly the knobs that are per-instance
+    STATE under ``FaultPlan(traced=True)`` + a shaped plan, so every
+    drawn cell replays the same compiled program (:func:`run_fleet`);
+    the structural knobs (partition windows, jitter, arrival kind)
+    stay compile-time static and ride :func:`random_plan` instead."""
+    rate = round(rng.uniform(0.3, 2.5), 2)
+    drop, dup = _draw_drop_dup(rng)
+    crash, revive = _draw_crash(rng, spec)
+    return {
+        "rate": rate, "drop": drop, "dup": dup,
+        "crash": crash, "revive": revive,
+    }
 
 
 def _random_membership(rng: _random.Random, shape):
@@ -702,6 +758,164 @@ def run_many_seeds(
     }
 
 
+@functools.lru_cache(maxsize=None)
+def _fleet_program(name: str, mesh, wrap):
+    """The ONE compiled executable a whole [seeds x schedules] brick
+    runs through for a given (backend, mesh): jit of the vmapped
+    (scan + in-graph invariant reduction) body. ``spmd_axis_name``
+    maps the instance axis onto the fleet mesh axis and ``wrap``
+    shard_map-lowers any engaged kernel planes over the group axis,
+    exactly as ``parallel.sharding._fleet_runner`` does. Outputs are
+    the per-instance verdicts only (states never leave the device, so
+    nothing to donate into — the state-returning fleet runner with
+    donation lives in ``parallel/sharding.py``). Keyed per mesh — a
+    cached program never leaks across fleet shapes (the jit-cache
+    isolation ``tests/test_fleet.py`` spies on, and the flat-cache
+    contract the ``trace-fleet-onecompile`` rule pins)."""
+    from frankenpaxos_tpu.ops import registry
+    from frankenpaxos_tpu.parallel import sharding
+
+    spec = SPECS[name]
+    mod = spec.module
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def run(cfg, states, t0, num_ticks: int, keys):
+        def one(state, key):
+            with registry.shard_lowering(wrap, sharding.GROUP_AXIS):
+                st, t = mod.run_ticks.__wrapped__(
+                    cfg, state, t0, num_ticks, key
+                )
+            inv = mod.check_invariants(cfg, st, t)
+            return (
+                {k: jnp.asarray(v) for k, v in inv.items()},
+                jnp.asarray(spec.progress(st)),
+            )
+
+        return jax.vmap(one, spmd_axis_name=sharding.FLEET_AXIS)(
+            states, keys
+        )
+
+    return run
+
+
+def _brick_states(name: str, mod, cfg, cells, seeds_per_schedule: int):
+    """The brick's fleet-state pytree: one fresh instance per
+    (cell, seed) with that cell's traced offered rate and Bernoulli
+    fault-rate vector installed per instance — the sharding layer's
+    ``fleet_states`` with the module passed explicitly, so backends
+    outside the sharding registry brick up too (mesh=None runs)."""
+    from frankenpaxos_tpu.parallel import sharding
+
+    return sharding.fleet_states(
+        name,
+        cfg,
+        len(cells) * seeds_per_schedule,
+        rates=[
+            c["rate"] for c in cells for _ in range(seeds_per_schedule)
+        ],
+        fault_rates=[
+            [c["drop"], c["dup"], c["crash"], c["revive"]]
+            for c in cells
+            for _ in range(seeds_per_schedule)
+        ],
+        module=mod,
+    )
+
+
+def run_fleet(
+    spec: SimSpec,
+    cells: Optional[Sequence[dict]] = None,
+    schedules: int = 8,
+    seeds_per_schedule: int = 4,
+    ticks: int = 2 * SEGMENT,
+    base_seed: int = 0,
+    mesh=None,
+    arrival: str = "constant",
+    kernels=None,
+) -> dict:
+    """The FLEET axis of simulation testing: one compiled executable
+    runs an entire [schedules x seeds] brick of randomized traced-rate
+    schedules (:func:`random_rate_cell`) as data-parallel instances —
+    per-instance PRNG seeds, per-instance offered loads, per-instance
+    fault-rate vectors — and reduces every backend invariant
+    PER-INSTANCE in-graph. Schedule (c, s) is bit-identical to a
+    sequential single-instance run of the same traced config with that
+    cell's rates installed (``tests/test_fleet.py``).
+
+    ``mesh=None`` runs the brick on the default device (pure vmap);
+    a ``('fleet', 'groups')`` product mesh shards instances over the
+    fleet axis and each instance's group axis over the group axis
+    (the backend must be in the sharding registry). ``kernels``
+    optionally installs a :class:`ops.registry.KernelPolicy` (fleet x
+    kernels composition). Returns per-instance verdicts plus the
+    failing (cell, seed) pairs, ``sweep``-style."""
+    from frankenpaxos_tpu.parallel import sharding
+
+    if cells is None:
+        rng = _random.Random(
+            base_seed * 7919 + zlib.crc32(spec.name.encode())
+        )
+        cells = [random_rate_cell(rng, spec) for _ in range(schedules)]
+    cells = list(cells)
+    plan = FaultPlan(traced=True)
+    wplan = WorkloadPlan(arrival=arrival, rate=1.0)
+    cfg = spec.make_config(plan, workload=wplan)
+    if kernels is not None:
+        cfg = dataclasses.replace(cfg, kernels=kernels)
+    mod = spec.module
+    n = len(cells) * seeds_per_schedule
+    states = _brick_states(spec.name, mod, cfg, cells, seeds_per_schedule)
+    seeds = [
+        base_seed + c * seeds_per_schedule + s
+        for c in range(len(cells))
+        for s in range(seeds_per_schedule)
+    ]
+    keys = sharding.fleet_keys(seeds)
+    wrap = None
+    if mesh is not None:
+        if spec.name not in sharding.SHARDINGS:
+            raise ValueError(
+                f"backend {spec.name!r} is not in the sharding "
+                "registry; run its brick with mesh=None"
+            )
+        sharding.validate_policy(spec.name, cfg, mesh)
+        states = sharding.shard_fleet_state(spec.name, states, mesh)
+        keys = sharding.place_fleet_keys(keys, mesh)
+        wrap = sharding._fleet_wrap_mesh(spec.name, cfg, mesh)
+    invs, progress = _fleet_program(spec.name, mesh, wrap)(
+        cfg, states, jnp.zeros((), jnp.int32), ticks, keys
+    )
+    invs = jax.device_get(invs)
+    progress = jax.device_get(progress)
+    per_ok = [all(bool(invs[k][i]) for k in invs) for i in range(n)]
+    failures = []
+    for i, ok in enumerate(per_ok):
+        if not ok:
+            c, s = divmod(i, seeds_per_schedule)
+            failures.append({
+                "cell": cells[c],
+                "seed": seeds[i],
+                "failed_checks": sorted(
+                    k for k in invs if not bool(invs[k][i])
+                ),
+            })
+    return {
+        "backend": spec.name,
+        "cells": cells,
+        "seeds_per_schedule": seeds_per_schedule,
+        "instances": n,
+        "ticks": ticks,
+        "mesh": None if mesh is None else [
+            int(s) for s in dict(mesh.shape).values()
+        ],
+        "kernels": None if kernels is None else kernels.mode,
+        "ok": all(per_ok),
+        "per_instance_ok": per_ok,
+        "failures": failures,
+        "progress": [int(p) for p in progress],
+    }
+
+
 def check_liveness_after_heal(
     spec: SimSpec,
     plan: FaultPlan,
@@ -1026,18 +1240,72 @@ def main() -> None:
     p.add_argument("--seeds", type=int, default=4)
     p.add_argument("--ticks", type=int, default=3 * SEGMENT)
     p.add_argument("--base-seed", type=int, default=0)
+    p.add_argument("--fleet", type=int, default=0, metavar="ROWS",
+                   help="run one-compile [seeds x schedules] fleet "
+                   "bricks instead of the per-config sweep; ROWS is "
+                   "the fleet-axis extent (0 = sweep mode, 1 = brick "
+                   "on the default device)")
     p.add_argument("--out", default="")
     args = p.parse_args()
     backends = (
         [b for b in args.backends.split(",") if b] or None
     )
-    result = sweep(
-        backends=backends,
-        schedules=args.schedules,
-        seeds_per_schedule=args.seeds,
-        ticks=args.ticks,
-        base_seed=args.base_seed,
-    )
+    if args.fleet:
+        import jax
+
+        from frankenpaxos_tpu.parallel import sharding as _sh
+
+        # Product mesh only when everything divides (device count by
+        # fleet rows, brick instances by fleet rows); otherwise the
+        # brick falls back to the default device instead of dying on a
+        # divisibility assert mid-sweep.
+        n_inst = args.schedules * args.seeds
+        mesh = (
+            _sh.make_fleet_mesh(fleet=args.fleet)
+            if args.fleet > 1
+            and len(jax.devices()) % args.fleet == 0
+            and n_inst % args.fleet == 0
+            else None
+        )
+        def fleet_one(name: str) -> dict:
+            m = mesh if name in _sh.SHARDINGS else None
+            kw = dict(
+                schedules=args.schedules,
+                seeds_per_schedule=args.seeds,
+                ticks=args.ticks,
+                base_seed=args.base_seed,
+            )
+            if m is not None:
+                try:
+                    return run_fleet(SPECS[name], mesh=m, **kw)
+                except ValueError as e:
+                    # A backend whose group axis doesn't divide this
+                    # mesh (e.g. epaxos' 5 columns on a 4-wide group
+                    # axis) bricks up on the default device instead of
+                    # killing the sweep; real errors stay loud.
+                    if "divisible" not in str(e):
+                        raise
+            return run_fleet(SPECS[name], **kw)
+
+        result = {
+            "mode": "fleet",
+            "fleet_rows": args.fleet,
+            "backends": {
+                name: fleet_one(name)
+                for name in (backends or list(SPECS))
+            },
+        }
+        result["ok"] = all(
+            b["ok"] for b in result["backends"].values()
+        )
+    else:
+        result = sweep(
+            backends=backends,
+            schedules=args.schedules,
+            seeds_per_schedule=args.seeds,
+            ticks=args.ticks,
+            base_seed=args.base_seed,
+        )
     text = json.dumps(result, indent=1)
     if args.out:
         with open(args.out, "w") as f:

@@ -52,6 +52,38 @@ in-kernel collectives); at mesh size 1 nothing wraps and any policy is
 allowed. On CPU the default ``auto`` policy resolves every plane to
 its reference twin, so sharded CPU runs engage kernels only when a
 policy asks for them (mode="interpret"/"on").
+
+FLEET axis — the two-axis product mesh (``('fleet', 'groups')``): the
+whole layer is MESH-SHAPE-AGNOSTIC. The group axis keeps sharding one
+protocol instance's group/column planes exactly as above (a 2-D mesh
+with a trivial fleet axis behaves identically to the old 1-D mesh);
+the NEW fleet axis data-parallels INDEPENDENT protocol instances —
+whole clusters are embarrassingly parallel along it (the
+compartmentalization thesis applied one level up: nothing ever crosses
+the fleet axis, pinned by the ``trace-fleet-onecompile`` rule's
+replica-group census). Fleet states carry one LEADING instance axis on
+every State leaf (:func:`fleet_states`): per-instance PRNG seeds,
+per-instance traced ``WorkloadState.rate`` offered loads, and
+per-instance ``FaultPlan(traced=True)`` Bernoulli rates all enter as
+fleet-sharded arrays, so a whole [seeds x workload x fault] brick is
+ONE compiled executable per mesh (:func:`run_ticks_fleet` — jit of
+``vmap(run_ticks)`` with ``spmd_axis_name=FLEET_AXIS``, donation
+preserved). Engaged kernel planes still lower through ``jax.shard_map``
+over the GROUP axis; the vmap batching rule maps the instance axis onto
+the fleet mesh axis via ``spmd_axis_name``, and the autotune lookup
+resolves at the true PER-DEVICE shape (the group-axis mesh extent, not
+the total device count — a product mesh changes the divisor).
+
+Multi-host: :func:`maybe_init_distributed` initializes
+``jax.distributed`` from the standard env/args and
+:func:`make_fleet_mesh` builds the product mesh via
+``mesh_utils.create_hybrid_device_mesh`` when more than one process is
+attached (the T5X partitioner pattern — ICI-adjacent devices land on
+the group axis, the slower DCN links carry only the fleet axis, which
+moves NO data), with :func:`host_sync` (``multihost_utils``) as the
+cross-host barrier. On a single process it degrades to a plain reshape
+of the local devices, which is how the 8-virtual-device CPU CI runs
+it; the real-pod leg stays on the hardware-debt list.
 """
 
 from __future__ import annotations
@@ -67,11 +99,107 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 GROUP_AXIS = "groups"
+FLEET_AXIS = "fleet"
 
 
 def make_mesh(devices=None, axis_name: str = GROUP_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices).reshape(-1), (axis_name,))
+
+
+def group_size(mesh: Mesh) -> int:
+    """Extent of the group axis — mesh-shape-agnostic (a 1-D group
+    mesh, the 2-D product mesh, and a degenerate fleet-only mesh all
+    answer correctly)."""
+    return dict(mesh.shape).get(GROUP_AXIS, 1)
+
+
+def fleet_size(mesh: Mesh) -> int:
+    return dict(mesh.shape).get(FLEET_AXIS, 1)
+
+
+def maybe_init_distributed(**kwargs) -> bool:
+    """Initialize ``jax.distributed`` for a multi-host fleet when the
+    standard coordination env is present (``JAX_COORDINATOR_ADDRESS``
+    or explicit kwargs — the same contract ``jax.distributed
+    .initialize`` reads). Single-host runs (CI's virtual-device mesh)
+    are a no-op returning False; calling twice is harmless. Returns
+    True when a multi-process runtime is attached.
+
+    Order matters: ``initialize`` must run before ANYTHING touches the
+    jax backend (including ``jax.process_count()``), so the env check
+    gates first and only genuinely-already-initialized errors are
+    swallowed — a bad coordinator address or a too-late call stays
+    loud instead of silently degrading a pod to N disconnected
+    hosts."""
+    import os
+
+    if not (kwargs or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        # No coordination config: single-host, or a launcher already
+        # initialized the runtime before importing us.
+        return jax.process_count() > 1
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" not in str(e).lower():
+            raise  # misconfiguration / called after backend init
+    return jax.process_count() > 1
+
+
+def host_sync(tag: str) -> None:
+    """Cross-host barrier (``multihost_utils.sync_global_devices``):
+    fleet consumers call it around checkpoint/bench boundaries so every
+    host observes the same brick. No-op on a single process, so the
+    call sites stay portable down to the CPU CI mesh."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def make_fleet_mesh(fleet: int = 1, devices=None) -> Mesh:
+    """The two-axis product mesh: ``fleet`` rows of independent
+    protocol instances x the group axis sharding each instance. The
+    device count must divide into ``fleet`` evenly; ``fleet=1``
+    degenerates to the old single-axis behavior (with the axis present,
+    so one code path serves every mesh shape).
+
+    Multi-host: with >1 jax processes attached (see
+    :func:`maybe_init_distributed`), the mesh comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so the group axis stays
+    ICI-local per slice and only the data-parallel fleet axis — which
+    carries zero protocol traffic — crosses DCN."""
+    if devices is None and jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        nproc = jax.process_count()
+        n_local = jax.local_device_count()
+        # The fleet axis factors as (hosts x rows-per-host): whole rows
+        # never straddle DCN, and each host's ICI-local devices carry
+        # its rows' group shards. Both divisibility constraints are
+        # asserted HERE (a violation inside create_hybrid_device_mesh
+        # surfaces as an opaque reshape error).
+        assert fleet % nproc == 0, (
+            f"fleet rows ({fleet}) must divide over the {nproc} hosts "
+            "(whole rows never straddle DCN)"
+        )
+        rows_per_host = fleet // nproc
+        assert n_local % rows_per_host == 0, (
+            f"{n_local} local devices do not divide into "
+            f"{rows_per_host} fleet rows per host"
+        )
+        dev_grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(rows_per_host, n_local // rows_per_host),
+            dcn_mesh_shape=(nproc, 1),
+        )
+        return Mesh(dev_grid, (FLEET_AXIS, GROUP_AXIS))
+    devices = devices if devices is not None else jax.devices()
+    arr = np.asarray(devices)
+    assert arr.size % fleet == 0, (
+        f"{arr.size} devices do not divide into a {fleet}-row fleet axis"
+    )
+    return Mesh(arr.reshape(fleet, -1), (FLEET_AXIS, GROUP_AXIS))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,11 +227,17 @@ class ShardingSpec:
     def mod(self):
         return importlib.import_module(self.module)
 
-    def spec_for(self, field: str) -> P:
+    def spec_for(self, field: str, fleet: bool = False) -> P:
+        """The field's PartitionSpec. ``fleet=True`` is the fleet-state
+        layout: every leaf gains a LEADING instance axis sharded over
+        ``FLEET_AXIS``, and the group axis (where the field has one)
+        shifts one position right. Single-instance specs on a 2-D mesh
+        simply replicate over the fleet axis — mesh-shape-agnostic."""
+        lead = [FLEET_AXIS] if fleet else []
         if field in self.replicated:
-            return P()
+            return P(*lead)
         pos = self.axis_pos.get(field, 0)
-        return P(*([None] * pos + [GROUP_AXIS]))
+        return P(*(lead + [None] * pos + [GROUP_AXIS]))
 
 
 SHARDINGS: Dict[str, ShardingSpec] = {}
@@ -115,27 +249,50 @@ def register_sharding(spec: ShardingSpec) -> ShardingSpec:
     return spec
 
 
-def state_shardings(backend: str, mesh: Mesh) -> Dict[str, NamedSharding]:
+def state_shardings(
+    backend: str, mesh: Mesh, fleet: bool = False
+) -> Dict[str, NamedSharding]:
     """field name -> NamedSharding for the backend's State dataclass."""
     spec = SHARDINGS[backend]
     state_cls = getattr(spec.mod(), spec.state_class)
     assert dataclasses.is_dataclass(state_cls), spec.state_class
     return {
-        f.name: NamedSharding(mesh, spec.spec_for(f.name))
+        f.name: NamedSharding(mesh, spec.spec_for(f.name, fleet=fleet))
         for f in dataclasses.fields(state_cls)
     }
 
 
+def _reject_fleet_axis(mesh: Mesh) -> None:
+    """Single-INSTANCE wrappers only ride the group axis. A >1 fleet
+    axis under a single instance is rejected loudly: with the repo's
+    non-partitionable threefry (the golden-pinned PRNG), XLA's SPMD
+    partitioner makes an unbatched PRNG sweep's VALUES depend on how
+    the spare mesh axis tiles it — a silent bit-drift, demonstrated by
+    the guard test in tests/test_fleet.py. Fleet instances go through
+    :func:`fleet_states` / :func:`run_ticks_fleet`, whose explicit
+    instance axis (vmap + ``spmd_axis_name``) is pinned bit-identical
+    across mesh shapes."""
+    if fleet_size(mesh) > 1:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has a >1 fleet axis: "
+            "single-instance states shard the group axis only — use "
+            "the fleet API (fleet_states/shard_fleet_state/"
+            "run_ticks_fleet) for data-parallel instances"
+        )
+
+
 def shard_state(backend: str, state, mesh: Mesh):
     """Place a state dataclass on the mesh per the backend's spec; the
-    sharded axis must divide evenly over the devices."""
+    sharded axis must divide evenly over the GROUP-axis extent. Meshes
+    with a >1 fleet axis are rejected (:func:`_reject_fleet_axis`)."""
     spec = SHARDINGS[backend]
-    n_devices = mesh.devices.size
+    _reject_fleet_axis(mesh)
+    n_group = group_size(mesh)
     axis_len = spec.axis_len(state)
-    if axis_len % n_devices != 0:
+    if axis_len % n_group != 0:
         raise ValueError(
             f"{spec.axis_desc} ({axis_len}) must be divisible by the "
-            f"mesh size ({n_devices}) to shard that axis; pick a "
+            f"mesh size ({n_group}) to shard that axis; pick a "
             "multiple of the device count."
         )
     shardings = state_shardings(backend, mesh)
@@ -229,6 +386,7 @@ def run_ticks_sharded(
     argument drives policy validation and the shard_map lowering of any
     engaged kernel planes; the GSPMD partitioning itself rides the
     state's shardings."""
+    _reject_fleet_axis(mesh)
     validate_policy(backend, cfg, mesh)
     wrap = _wrap_mesh(backend, cfg, mesh)
     return _runner(backend, wrap)(cfg, state, t0, num_ticks, key)
@@ -240,9 +398,322 @@ def lower_sharded(
     """Lower (don't run) the sharded runner — the static-analysis
     ``trace-donation-alias`` / ``trace-shardmap-kernel`` rules compile
     this to check aliasing and kernel lowering under a mesh."""
+    _reject_fleet_axis(mesh)
     validate_policy(backend, cfg, mesh)
     wrap = _wrap_mesh(backend, cfg, mesh)
     return _runner(backend, wrap).lower(cfg, state, t0, num_ticks, key)
+
+
+# ---------------------------------------------------------------------------
+# Fleet execution: the seed/replica data-parallel axis
+# ---------------------------------------------------------------------------
+
+
+def fleet_states(
+    backend: str,
+    cfg,
+    n: int,
+    rates=None,
+    fault_rates=None,
+    module=None,
+):
+    """``n`` independent instances of the backend's fresh state as ONE
+    pytree with a leading instance axis on every leaf (the fleet-state
+    layout :func:`ShardingSpec.spec_for` shards).
+
+    ``rates`` ([n] floats) seeds each instance's TRACED offered load
+    (needs a shaped ``WorkloadPlan``); ``fault_rates`` ([n, 4] floats,
+    ``[drop, dup, crash, revive]`` per row) seeds each instance's
+    traced Bernoulli fault rates (needs ``FaultPlan(traced=True)``).
+    Both are state-side, so a whole brick of distinct (workload, fault)
+    cells shares one compiled executable.
+
+    ``module`` overrides the sharding-registry lookup with an explicit
+    ``tpu/*_batched`` module — how ``simtest.run_fleet`` builds bricks
+    for backends outside the registry (mesh=None runs need no specs)."""
+    mod = module if module is not None else SHARDINGS[backend].mod()
+    base = mod.init_state(cfg)
+    states = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), base
+    )
+    wls = getattr(states, "workload", None)
+    if rates is not None:
+        rates = jnp.asarray(rates, jnp.float32)
+        assert wls is not None and wls.rate.shape == (n,), (
+            "per-instance rates need a shaped WorkloadPlan "
+            "(arrival != 'saturate') on the config"
+        )
+        assert rates.shape == (n,), (rates.shape, n)
+        wls = dataclasses.replace(wls, rate=rates)
+    if fault_rates is not None:
+        fault_rates = jnp.asarray(fault_rates, jnp.float32)
+        assert wls is not None and wls.fault_rates.shape == (n, 4), (
+            "per-instance fault rates need FaultPlan(traced=True) "
+            "on the config"
+        )
+        assert fault_rates.shape == (n, 4), (fault_rates.shape, n)
+        wls = dataclasses.replace(wls, fault_rates=fault_rates)
+    if wls is not None:
+        states = dataclasses.replace(states, workload=wls)
+    return states
+
+
+def fleet_keys(seeds) -> jnp.ndarray:
+    """[n, 2] per-instance PRNG keys from a sequence of integer seeds —
+    instance i of the fleet replays EXACTLY the program a sequential
+    run of seed i replays (the bit-identity contract of
+    ``tests/test_fleet.py``)."""
+    return jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(list(seeds), jnp.uint32)
+    )
+
+
+# Workload-state fields whose axis 1 (after the leading instance axis)
+# is the backend's LANE axis — the same axis the group sharding splits,
+# since every registered backend's lanes are its groups/columns. In the
+# fleet layout these shard over BOTH mesh axes: GSPMD propagation
+# re-shards them that way anyway (the admission cap clamps group-sharded
+# propose planes elementwise), and placing them pre-sharded keeps the
+# donation aliases intact (a resharded input cannot alias its output).
+_WORKLOAD_LANE_FIELDS = frozenset({
+    "acc", "racc", "backlog", "cum_ring", "adm_total",
+    "in_flight", "idle", "ready_ring",
+})
+
+
+def _fleet_field_sharding(spec, field: str, value, mesh: Mesh, lanes: int):
+    """The fleet sharding of one State field — a single NamedSharding,
+    except the nested workload pytree, which gets per-leaf shardings so
+    its lane-axis bookkeeping rides the group axis."""
+    if field != "workload" or not dataclasses.is_dataclass(value):
+        return NamedSharding(mesh, spec.spec_for(field, fleet=True))
+
+    def leaf_spec(name: str, leaf) -> NamedSharding:
+        lane_sharded = (
+            name in _WORKLOAD_LANE_FIELDS
+            and leaf.ndim >= 2
+            and leaf.shape[1] == lanes
+            and lanes % group_size(mesh) == 0
+        )
+        p = P(FLEET_AXIS, GROUP_AXIS) if lane_sharded else P(FLEET_AXIS)
+        return NamedSharding(mesh, p)
+
+    return type(value)(**{
+        f.name: leaf_spec(f.name, getattr(value, f.name))
+        for f in dataclasses.fields(value)
+    })
+
+
+def shard_fleet_state(backend: str, states, mesh: Mesh):
+    """Place a fleet-state pytree on the product mesh: the leading
+    instance axis shards over ``FLEET_AXIS``, the group axis over
+    ``GROUP_AXIS`` (both must divide their mesh extents)."""
+    spec = SHARDINGS[backend]
+    n = jax.tree_util.tree_leaves(states)[0].shape[0]
+    n_fleet = fleet_size(mesh)
+    if n % n_fleet != 0:
+        raise ValueError(
+            f"{n} fleet instances must divide over the fleet axis "
+            f"({n_fleet} rows); pick a multiple."
+        )
+    # axis_len reads the group extent off a single instance's shapes:
+    # peel the leading instance axis with a shape-only view.
+    one = jax.tree_util.tree_map(lambda a: a[0], states)
+    axis_len = spec.axis_len(one)
+    n_group = group_size(mesh)
+    if axis_len % n_group != 0:
+        raise ValueError(
+            f"{spec.axis_desc} ({axis_len}) must be divisible by the "
+            f"group-axis extent ({n_group}); pick a multiple."
+        )
+    out = {}
+    for f in dataclasses.fields(states):
+        value = getattr(states, f.name)
+        out[f.name] = jax.device_put(
+            value,
+            _fleet_field_sharding(spec, f.name, value, mesh, axis_len),
+        )
+    return type(states)(**out)
+
+
+def _constrain_fleet_out(backend: str, mesh: Mesh, states, t):
+    """Pin the fleet runner's OUTPUT shardings to the canonical fleet
+    layout (``with_sharding_constraint`` per field, the workload
+    subtree per leaf). Without this, XLA assigns zero-sized and
+    feature-off leaves a fully-replicated output sharding, so feeding
+    segment 1's result into segment 2 presents DIFFERENT input
+    shardings and recompiles — the constraint keeps every segment on
+    ONE executable (the ``trace-fleet-onecompile`` contract)."""
+    spec = SHARDINGS[backend]
+    shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), states
+    )
+    lanes = spec.axis_len(shapes)
+    out = {}
+    for f in dataclasses.fields(states):
+        v = getattr(states, f.name)
+        sharding = _fleet_field_sharding(spec, f.name, v, mesh, lanes)
+        if dataclasses.is_dataclass(sharding):
+            v = type(v)(**{
+                g.name: jax.lax.with_sharding_constraint(
+                    getattr(v, g.name), getattr(sharding, g.name)
+                )
+                for g in dataclasses.fields(v)
+            })
+        else:
+            v = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, sharding),
+                v,
+            )
+        out[f.name] = v
+    t = jax.lax.with_sharding_constraint(
+        t, NamedSharding(mesh, P(FLEET_AXIS))
+    )
+    return type(states)(**out), t
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_runner(backend: str, mesh: Mesh, wrap: Optional[Mesh]):
+    """The jitted fleet runner for one (backend, mesh): ``vmap`` over
+    the leading instance axis of ``run_ticks``'s own body, jitted with
+    the states DONATED. ``spmd_axis_name=FLEET_AXIS`` maps the vmapped
+    instance axis onto the fleet mesh axis, so every collective and
+    every ``shard_map``-lowered kernel plane (the ``wrap`` mesh, pushed
+    while tracing exactly as :func:`_runner` does) partitions inside
+    one fleet row — instances never talk across the fleet axis, and a
+    whole [seeds x workload x fault] brick is ONE executable for this
+    mesh. Keyed per (backend, mesh): a cached runner (and its jit
+    cache) never leaks across fleet shapes — the isolation the
+    ``trace-fleet-onecompile`` rule and ``tests/test_fleet.py`` spy
+    pin."""
+    from frankenpaxos_tpu.ops import registry
+
+    mod = SHARDINGS[backend].mod()
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+    def run(cfg, states, t0s, num_ticks: int, keys):
+        def one(state, t0, key):
+            with registry.shard_lowering(wrap, GROUP_AXIS):
+                return mod.run_ticks.__wrapped__(
+                    cfg, state, t0, num_ticks, key
+                )
+
+        out, t = jax.vmap(one, spmd_axis_name=FLEET_AXIS)(
+            states, t0s, keys
+        )
+        if mesh is not None:
+            out, t = _constrain_fleet_out(backend, mesh, out, t)
+        return out, t
+
+    return run
+
+
+def _fleet_wrap_mesh(backend: str, cfg, mesh: Optional[Mesh]):
+    """The mesh engaged kernel planes must shard_map-lower under in a
+    fleet run: the product mesh whenever any plane is engaged on a >1
+    device mesh (even a 1-wide group axis — the fleet axis still needs
+    ``spmd_axis_name`` routing through shard_map's batching rule), else
+    None (pure GSPMD propagation / single device)."""
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    return mesh if _engaged_planes(backend, cfg) else None
+
+
+def _fleet_t0s(states, t0, mesh: Optional[Mesh]) -> jnp.ndarray:
+    """Per-instance tick counters: a scalar ``t0`` broadcasts over the
+    fleet (a fresh brick), a ``[n]`` vector (the ``t`` a previous fleet
+    call returned) passes through — segmented fleet runs just rebind
+    ``states, t = run_ticks_fleet(...)`` like every other runner. On a
+    mesh the vector is placed fleet-sharded either way, so segment 1
+    (host-built t0s) and segment 2 (the device vector segment 1
+    returned) present the SAME input sharding — one executable serves
+    every segment."""
+    n = jax.tree_util.tree_leaves(states)[0].shape[0]
+    t0 = jnp.asarray(t0, jnp.int32)
+    t0s = jnp.broadcast_to(t0, (n,)) if t0.ndim == 0 else t0
+    if mesh is not None:
+        t0s = jax.device_put(t0s, NamedSharding(mesh, P(FLEET_AXIS)))
+    return t0s
+
+
+def place_fleet_keys(keys, mesh: Optional[Mesh]):
+    """Fleet-shard a ``[n, 2]`` key array on the product mesh (no-op
+    without a mesh): keys ride the instance axis like every state
+    leaf."""
+    if mesh is None:
+        return keys
+    return jax.device_put(keys, NamedSharding(mesh, P(FLEET_AXIS)))
+
+
+def run_ticks_fleet(
+    backend: str, cfg, mesh: Optional[Mesh], states, t0, num_ticks: int,
+    keys,
+):
+    """Run ``num_ticks`` of EVERY fleet instance (leading axis of
+    ``states`` / ``keys``) in one compiled call. ``t0`` is a scalar
+    (fresh brick) or the per-instance ``[n]`` vector a previous call
+    returned. Per-tick keys fold the SCAN index (``run_ticks``
+    semantics), so segmented runs must pass fresh per-segment keys
+    (``vmap(fold_in)`` the previous ones) or the next segment replays
+    the same random stream. ``mesh=None`` runs the brick on the
+    default device (pure vmap — the small-host path); otherwise the
+    states should be placed via :func:`shard_fleet_state` first.
+    States are DONATED — rebind the result."""
+    if mesh is not None:
+        validate_policy(backend, cfg, mesh)
+    wrap = _fleet_wrap_mesh(backend, cfg, mesh)
+    return _fleet_runner(backend, mesh, wrap)(
+        cfg, states, _fleet_t0s(states, t0, mesh), num_ticks,
+        place_fleet_keys(keys, mesh),
+    )
+
+
+def lower_fleet(
+    backend: str, cfg, mesh: Optional[Mesh], states, t0, num_ticks: int,
+    keys,
+):
+    """Lower (don't run) the fleet runner — the
+    ``trace-fleet-onecompile`` analysis rule compiles this to census
+    the collectives (nothing may cross the fleet axis) and the
+    donation aliases under the product mesh."""
+    if mesh is not None:
+        validate_policy(backend, cfg, mesh)
+    wrap = _fleet_wrap_mesh(backend, cfg, mesh)
+    return _fleet_runner(backend, mesh, wrap).lower(
+        cfg, states, _fleet_t0s(states, t0, mesh), num_ticks,
+        place_fleet_keys(keys, mesh),
+    )
+
+
+def fleet_block_plan(backend: str, cfg, mesh: Mesh) -> dict:
+    """plane -> {mode, block resolution} for a fleet run on ``mesh`` —
+    the bench JSON's record of WHICH autotuned block each engaged plane
+    resolved at the true per-device shape (``ops.registry`` stashes the
+    resolution in ``RESOLVED_BLOCKS`` while the shard_map wrapper
+    traces). A stashed resolution is reported only when its recorded
+    mesh axes match ``mesh`` — a stale entry from some other mesh's
+    lowering never masquerades as this one's. Planes that resolved to
+    the reference, never dispatched (e.g. subsumed by the megakernel),
+    or last resolved under a different mesh report ``block=None``."""
+    from frankenpaxos_tpu.ops import registry
+
+    spec = SHARDINGS[backend]
+    mesh_axes = {str(a): int(s) for a, s in dict(mesh.shape).items()}
+    out = {}
+    for name, plane in registry.PLANES.items():
+        if plane.backend != spec.planes_backend:
+            continue
+        mode = registry.resolve_mode(name, cfg)
+        row = {"mode": mode, "block": None, "per_device_key": None}
+        resolved = registry.RESOLVED_BLOCKS.get(name)
+        if (
+            mode != "reference"
+            and resolved is not None
+            and resolved.get("mesh_axes") == mesh_axes
+        ):
+            row.update(resolved)
+        out[name] = row
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -11,9 +11,12 @@
 #   scripts/lint.sh --rule ID    # any frankenpaxos_tpu.analysis flag
 set -u
 cd "$(dirname "$0")/.."
-# The trace-shardmap-kernel rule compiles sharded wrappers: give the
-# CLI the same 8-virtual-device CPU mesh the pytest conftest uses, so
-# the kernels x mesh contract is checked on single-device hosts too.
+# The trace-shardmap-kernel rule compiles sharded wrappers and the
+# trace-fleet-onecompile rule compiles whole fleet bricks on a 2-row
+# ('fleet', 'groups') PRODUCT mesh: give the CLI the same
+# 8-virtual-device CPU mesh the pytest conftest uses, so the
+# kernels x mesh and fleet-axis contracts are checked on
+# single-device hosts too.
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 fi

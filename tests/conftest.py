@@ -38,6 +38,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+import pytest
+
+
+@pytest.fixture
+def fleet_mesh():
+    """The canonical 2x4 ``('fleet', 'groups')`` product mesh over the
+    conftest's 8 virtual devices — the fleet-axis tests
+    (tests/test_fleet.py, the test_harness brick smoke) run on it;
+    mesh-shape-agnostic tests build their own variants."""
+    from frankenpaxos_tpu.parallel import sharding as sh
+
+    return sh.make_fleet_mesh(fleet=2)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

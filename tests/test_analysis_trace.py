@@ -31,6 +31,11 @@ TRACE_RULES = [
     # of host callbacks, and the snapshot copies (aliases nothing);
     # no-op for every backend except the flagship serve target.
     "trace-serve-nosync",
+    # Fleet axis: a [seeds x workload x fault] brick is one compiled
+    # executable per product mesh (flat jit cache across traced-rate
+    # re-sweeps) and no signed collective crosses the fleet axis;
+    # no-op for backends outside the sharding registry.
+    "trace-fleet-onecompile",
 ]
 
 
@@ -86,6 +91,43 @@ def test_shardmap_kernel_rule_has_teeth(monkeypatch):
     assert any(
         "fell back" in f.message for f in report.findings
     ), report.format()
+
+
+def test_fleet_onecompile_rule_has_teeth(monkeypatch):
+    """Simulate the cross-fleet regression the census exists for: with
+    the fleet-row map deliberately wrong (columns instead of rows), the
+    brick's in-row stat reductions no longer fit any row and the rule
+    must flag them — proving it actually reads every collective's
+    replica groups."""
+    def wrong_rows(n_fleet, n_group):
+        return [
+            {i + j * n_group for j in range(n_fleet)}
+            for i in range(n_group)
+        ]
+
+    monkeypatch.setattr(rules_trace, "_fleet_rows", wrong_rows)
+    ctx = core.Context(backends=("multipaxos",))
+    report = core.run(rule_ids=["trace-fleet-onecompile"], ctx=ctx)
+    assert any(
+        "crossing the fleet axis" in f.message for f in report.findings
+    ), report.format()
+
+
+def test_fleet_replica_group_parser():
+    """The replica-group scraper handles the explicit brace format, the
+    iota format, and the transposed-iota format."""
+    assert rules_trace._collective_groups(
+        "x = s32[2] all-reduce(y), replica_groups={{0,1,2,3},{4,5,6,7}}"
+    ) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert rules_trace._collective_groups(
+        "x = s32[2] all-reduce(y), replica_groups=[2,4]<=[8], to_apply=%r"
+    ) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert rules_trace._collective_groups(
+        "x = s32[2] all-reduce(y), replica_groups=[4,2]<=[2,4]T(1,0)"
+    ) == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert rules_trace._collective_groups(
+        "x = s32[2] all-reduce(y), replica_groups=<unknown-fmt>"
+    ) is None
 
 
 def test_alias_table_parser():

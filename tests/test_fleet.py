@@ -1,0 +1,340 @@
+"""Fleet-axis tests (``parallel/sharding.py`` two-axis product mesh):
+
+  * DETERMINISM — per-instance runs on the fleet mesh are bit-identical
+    (3 seeds, full state) to the same configs run sequentially on a
+    mesh WITHOUT the fleet axis, with the kernel planes ENGAGED
+    (interpret mode — the actual shard_map-lowered kernel path,
+    executable on CPU) and on the reference path,
+  * MESH-SHAPE AGNOSTICISM — the same brick on (2, 4), (4, 2), and
+    (1, 8) product meshes replays bit for bit,
+  * JIT-CACHE ISOLATION — ``_runner``s are keyed per (backend, mesh):
+    a brick on one fleet shape never touches another shape's cache,
+    and a traced-rate re-sweep keeps every cache FLAT (one compiled
+    executable per mesh — the fleet contract the
+    ``trace-fleet-onecompile`` analysis rule also pins),
+  * AUTOTUNE — the per-device block lookup under the product mesh
+    divides the batch axis by the GROUP-axis extent, never the total
+    device count (the fleet axis changes the divisor),
+  * donation aliases surviving the product mesh, and the divisibility
+    guards.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.parallel import sharding as sh
+from frankenpaxos_tpu.tpu import multipaxos_batched as mb
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+# A 4-instance brick of distinct traced cells: offered rates and
+# [drop, dup, crash, revive] fault-rate rows.
+RATES = (0.5, 1.0, 1.5, 2.0)
+FRATES = (
+    (0.0, 0.0, 0.0, 0.0),
+    (0.05, 0.0, 0.0, 0.0),
+    (0.1, 0.05, 0.0, 0.0),
+    (0.2, 0.0, 0.01, 0.2),
+)
+
+
+def _traced_cfg(**kw):
+    """The flagship analysis config with both sweep axes state-side:
+    traced Bernoulli fault rates + a shaped (traced-rate) workload."""
+    cfg = mb.analysis_config(
+        faults=FaultPlan(traced=True),
+        workload=WorkloadPlan(arrival="constant", rate=1.0),
+    )
+    return dataclasses.replace(cfg, num_groups=8, **kw)
+
+
+def _brick(cfg, n=4):
+    return sh.fleet_states(
+        "multipaxos", cfg, n, rates=RATES[:n], fault_rates=FRATES[:n]
+    )
+
+
+def _seq_state(cfg, rate, frate):
+    st = mb.init_state(cfg)
+    return dataclasses.replace(
+        st,
+        workload=dataclasses.replace(
+            st.workload,
+            rate=jnp.float32(rate),
+            fault_rates=jnp.asarray(frate, jnp.float32),
+        ),
+    )
+
+
+def _assert_instance_equals(states, i, ref_state):
+    got = jax.tree_util.tree_map(lambda a: a[i], states)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(ref_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed_base", [0, 7, 21])
+def test_fleet_vs_sequential_bit_identity_reference(seed_base, fleet_mesh):
+    """Every fleet instance == the sequential single-instance run of
+    the same (traced config, rates, seed), full state, reference path,
+    20 ticks on the (2, 4) product mesh."""
+    cfg = _traced_cfg()
+    t0 = jnp.zeros((), jnp.int32)
+    seeds = [seed_base + i for i in range(4)]
+    states = sh.shard_fleet_state("multipaxos", _brick(cfg), fleet_mesh)
+    states, t = sh.run_ticks_fleet(
+        "multipaxos", cfg, fleet_mesh, states, t0, 20, sh.fleet_keys(seeds)
+    )
+    assert list(np.asarray(t)) == [20] * 4
+    for i, seed in enumerate(seeds):
+        ref, _ = mb.run_ticks(
+            cfg, _seq_state(cfg, RATES[i], FRATES[i]), t0, 20,
+            jax.random.PRNGKey(seed),
+        )
+        _assert_instance_equals(states, i, ref)
+
+
+@pytest.mark.parametrize("seed_base", [0, 7, 21])
+def test_fleet_vs_sequential_bit_identity_kernels(seed_base, fleet_mesh):
+    """The fleet x kernels composition cell: the same brick with the
+    kernel planes ENGAGED (interpret — shard_map-lowered over the group
+    axis, the fleet axis routed via spmd_axis_name) replays the
+    sequential kernels-engaged runs bit for bit, 3 seeds."""
+    cfg = _traced_cfg(kernels=KernelPolicy(mode="interpret"))
+    t0 = jnp.zeros((), jnp.int32)
+    seeds = [seed_base + i for i in range(4)]
+    states = sh.shard_fleet_state("multipaxos", _brick(cfg), fleet_mesh)
+    states, _ = sh.run_ticks_fleet(
+        "multipaxos", cfg, fleet_mesh, states, t0, 6, sh.fleet_keys(seeds)
+    )
+    assert int(np.sum(np.asarray(states.committed))) > 0
+    for i, seed in enumerate(seeds):
+        ref, _ = mb.run_ticks(
+            cfg, _seq_state(cfg, RATES[i], FRATES[i]), t0, 6,
+            jax.random.PRNGKey(seed),
+        )
+        _assert_instance_equals(states, i, ref)
+
+
+def test_fleet_mesh_shape_agnostic():
+    """One brick, three mesh shapes — (2, 4), (4, 2), (1, 8) — all
+    bit-identical: the sharding layer is mesh-shape-agnostic and the
+    fleet axis never changes a value."""
+    cfg = _traced_cfg()
+    t0 = jnp.zeros((), jnp.int32)
+    keys = sh.fleet_keys(range(4))
+    results = []
+    for fleet in (2, 4, 1):
+        mesh = sh.make_fleet_mesh(fleet=fleet)
+        states = sh.shard_fleet_state("multipaxos", _brick(cfg), mesh)
+        states, _ = sh.run_ticks_fleet(
+            "multipaxos", cfg, mesh, states, t0, 20, keys
+        )
+        results.append(jax.device_get(states))
+    for other in results[1:]:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(results[0]),
+            jax.tree_util.tree_leaves(other),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fleet_runner_cache_isolated_across_meshes():
+    """The jit-cache isolation spy: ``_fleet_runner`` is keyed per
+    (backend, mesh) — running a brick on one fleet shape never grows
+    another shape's cache — and a TRACED-rate re-sweep keeps each
+    mesh's cache flat at ONE executable."""
+    cfg = _traced_cfg()
+    t0 = jnp.zeros((), jnp.int32)
+    keys = sh.fleet_keys(range(4))
+    mesh_a = sh.make_fleet_mesh(fleet=2)
+    mesh_b = sh.make_fleet_mesh(fleet=4)
+    runner_a = sh._fleet_runner("multipaxos", mesh_a, None)
+    runner_b = sh._fleet_runner("multipaxos", mesh_b, None)
+    assert runner_a is not runner_b
+
+    # Delta-based: the runner is lru-cached per (backend, mesh), so
+    # other tests in this process may already hold entries.
+    size_a0 = runner_a._cache_size()
+    size_b0 = runner_b._cache_size()
+    sa = sh.shard_fleet_state("multipaxos", _brick(cfg), mesh_a)
+    sa, _ = sh.run_ticks_fleet("multipaxos", cfg, mesh_a, sa, t0, 9, keys)
+    jax.block_until_ready(sa.committed)
+    assert runner_a._cache_size() == size_a0 + 1
+
+    # A fresh brick with DIFFERENT traced rates: same executable.
+    sa2 = sh.fleet_states(
+        "multipaxos", cfg, 4,
+        rates=(2.0, 0.25, 0.75, 1.25),
+        fault_rates=((0.3, 0.0, 0.0, 0.0),) * 4,
+    )
+    sa2 = sh.shard_fleet_state("multipaxos", sa2, mesh_a)
+    sa2, _ = sh.run_ticks_fleet("multipaxos", cfg, mesh_a, sa2, t0, 9, keys)
+    jax.block_until_ready(sa2.committed)
+    assert runner_a._cache_size() == size_a0 + 1, "rate re-sweep recompiled"
+
+    # Mesh B runs its own brick: its own runner compiles, mesh A's
+    # cache does not move.
+    sb = sh.shard_fleet_state("multipaxos", _brick(cfg), mesh_b)
+    sb, _ = sh.run_ticks_fleet("multipaxos", cfg, mesh_b, sb, t0, 9, keys)
+    jax.block_until_ready(sb.committed)
+    assert runner_b._cache_size() == size_b0 + 1
+    assert runner_a._cache_size() == size_a0 + 1, (
+        "mesh B leaked into mesh A"
+    )
+
+
+def test_fleet_donation_aliases_under_product_mesh():
+    """Donation stays single-buffered per shard under the product mesh:
+    the compiled fleet program aliases every donated State leaf."""
+    from frankenpaxos_tpu.analysis.rules_trace import _alias_param_indices
+
+    cfg = _traced_cfg()
+    mesh = sh.make_fleet_mesh(fleet=2)
+    states = sh.shard_fleet_state("multipaxos", _brick(cfg), mesh)
+    n_leaves = len(jax.tree_util.tree_leaves(states))
+    txt = sh.lower_fleet(
+        "multipaxos", cfg, mesh, states, jnp.zeros((), jnp.int32), 4,
+        sh.fleet_keys(range(4)),
+    ).compile().as_text()
+    missing = sorted(set(range(n_leaves)) - _alias_param_indices(txt))
+    assert not missing, f"unaliased fleet State leaves: {missing}"
+
+
+def test_autotune_resolves_at_per_device_shape_under_product_mesh():
+    """The nearest-G fallback keys on the PER-DEVICE shape: under a
+    (2, 4) product mesh the batch-axis extent divides by the GROUP-axis
+    extent (4), not the total device count (8) — the fleet axis changes
+    the divisor and must not leak into the lookup."""
+    cfg = _traced_cfg(kernels=KernelPolicy(mode="interpret"))
+    mesh = sh.make_fleet_mesh(fleet=2)
+    states = sh.shard_fleet_state("multipaxos", _brick(cfg), mesh)
+    ops_registry.RESOLVED_BLOCKS.clear()
+    sh.lower_fleet(
+        "multipaxos", cfg, mesh, states, jnp.zeros((), jnp.int32), 2,
+        sh.fleet_keys(range(4)),
+    )
+    resolved = ops_registry.RESOLVED_BLOCKS
+    assert resolved, "kernels-engaged lowering recorded no blocks"
+    G = cfg.num_groups
+    for name, row in resolved.items():
+        plane = ops_registry.PLANES[name]
+        ax = plane.batch_axis
+        assert row["group_axis_devices"] == 4, (name, row)
+        assert row["per_device_key"][ax] == G // 4, (name, row)
+        assert row["mesh_axes"] == {"fleet": 2, "groups": 4}
+    plan = sh.fleet_block_plan("multipaxos", cfg, mesh)
+    # Planes that actually dispatched (the megakernel subsumes the
+    # per-plane twins, so not every engaged plane runs) carry a block.
+    dispatched = {n: plan[n] for n in resolved}
+    assert dispatched
+    for row in dispatched.values():
+        assert row["block"] is not None and row["block"] > 0
+
+
+def test_fleet_divisibility_and_registry_guards():
+    cfg = _traced_cfg()
+    mesh = sh.make_fleet_mesh(fleet=2)
+    with pytest.raises(ValueError, match="fleet instances"):
+        sh.shard_fleet_state("multipaxos", _brick(cfg, n=3), mesh)
+    cfg6 = dataclasses.replace(_traced_cfg(), num_groups=6)
+    states6 = sh.fleet_states(
+        "multipaxos", cfg6, 4, rates=RATES, fault_rates=FRATES
+    )
+    with pytest.raises(ValueError, match="divisible by the group-axis"):
+        sh.shard_fleet_state("multipaxos", states6, mesh)
+    with pytest.raises(AssertionError, match="devices do not divide"):
+        sh.make_fleet_mesh(fleet=3)
+
+
+def test_fleet_states_requires_traced_axes():
+    """Per-instance rates demand the traced plumbing: a none-workload
+    config cannot take per-instance offered rates, an untraced fault
+    plan cannot take per-instance fault rates."""
+    cfg = mb.analysis_config()
+    with pytest.raises(AssertionError, match="shaped WorkloadPlan"):
+        sh.fleet_states("multipaxos", cfg, 2, rates=(1.0, 2.0))
+    cfg2 = mb.analysis_config(
+        workload=WorkloadPlan(arrival="constant", rate=1.0)
+    )
+    with pytest.raises(AssertionError, match="traced"):
+        sh.fleet_states(
+            "multipaxos", cfg2, 2,
+            fault_rates=((0.1, 0, 0, 0), (0.2, 0, 0, 0)),
+        )
+
+
+def test_multihost_helpers_single_process_behavior(monkeypatch):
+    """The multi-host entry points on a single process (the only leg CI
+    can run): ``maybe_init_distributed`` is a no-op returning False
+    with no coordination config, raises on a BAD config instead of
+    silently degrading, and ``host_sync`` is a no-op barrier."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert sh.maybe_init_distributed() is False
+    sh.host_sync("test-noop")  # must not raise or block
+    # A coordination config on an already-running single-process
+    # backend must surface loudly (jax.distributed.initialize raises
+    # once computations have run), never pass silently.
+    with pytest.raises(RuntimeError):
+        sh.maybe_init_distributed(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=0,
+        )
+
+
+def test_simtest_fleet_brick_mesh_invariance(fleet_mesh):
+    """``simtest.run_fleet``'s verdicts and progress are identical with
+    and without a mesh (the brick is ONE program either way), and the
+    per-mesh program cache holds exactly one executable."""
+    from frankenpaxos_tpu.harness import simtest
+
+    spec = simtest.SPECS["multipaxos"]
+    a = simtest.run_fleet(
+        spec, schedules=3, seeds_per_schedule=2, ticks=40
+    )
+    b = simtest.run_fleet(
+        spec, schedules=3, seeds_per_schedule=2, ticks=40,
+        mesh=fleet_mesh,
+    )
+    assert a["ok"] and b["ok"]
+    assert a["per_instance_ok"] == b["per_instance_ok"]
+    assert a["progress"] == b["progress"]
+    assert simtest._fleet_program(
+        "multipaxos", fleet_mesh, None
+    )._cache_size() == 1
+
+
+def test_single_instance_rejects_fleet_axis(fleet_mesh):
+    """The non-partitionable-threefry guard: a SINGLE-instance state on
+    a >1-fleet-axis mesh is a loud ValueError, never a silent bit
+    drift. (XLA's partitioner makes an unbatched PRNG sweep's values
+    depend on how the spare mesh axis tiles it — the fleet API's
+    explicit instance axis is the supported route, pinned bit-identical
+    above.) A TRIVIAL fleet axis stays allowed and bit-identical: one
+    mesh type serves both layers."""
+    cfg = dataclasses.replace(mb.analysis_config(), num_groups=8)
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError, match="fleet axis"):
+        sh.shard_state("multipaxos", mb.init_state(cfg), fleet_mesh)
+    with pytest.raises(ValueError, match="fleet axis"):
+        sh.run_ticks_sharded(
+            "multipaxos", cfg, fleet_mesh, mb.init_state(cfg), t0, 4, key
+        )
+    mesh1 = sh.make_fleet_mesh(fleet=1)
+    st = sh.shard_state("multipaxos", mb.init_state(cfg), mesh1)
+    st, _ = sh.run_ticks_sharded(
+        "multipaxos", cfg, mesh1, st, t0, 20, key
+    )
+    ust, _ = mb.run_ticks(cfg, mb.init_state(cfg), t0, 20, key)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(ust)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
