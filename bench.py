@@ -811,8 +811,11 @@ def _fleet_inner() -> None:
     with 8 virtual CPU devices. Two legs (module docstring): the
     one-compile-per-mesh [offered-load x fault-rate] saturation
     surface, and the simtest fleet fuzzer timed against the sequential
-    per-config loop. One JSON line on stdout (BENCH_JSON ...).
-    Capture artifact: FLEET_r01.json."""
+    per-config loop — plus the fleet OBSERVABILITY legs (drain
+    overhead vs the drain-off brick, hostile-instance straggler
+    detection + per-instance clamp). One JSON line on stdout
+    (BENCH_JSON ...). Capture artifacts: FLEET_r01.json (pre-
+    observability), FLEET_r02.json (with the telemetry legs)."""
     import dataclasses
     import time
 
@@ -1017,6 +1020,160 @@ def _fleet_inner() -> None:
         ),
     }
 
+    # 4. Telemetry-engaged legs (the fleet observability plane,
+    # harness/serve.FleetServeLoop). (a) Drain overhead: the
+    # double-buffered non-blocking fleet drain (snapshot + in-graph
+    # fleet_summary + per-instance DrainCursor) raced against the
+    # drain-OFF brick — same compiled run_ticks_fleet, same chunking,
+    # interleaved best-of-N, <2% budget. (b) The straggler-detection
+    # demo: a homogeneous fleet below saturation with ONE instance on
+    # a hostile traced drop rate — the per-instance summary flags it,
+    # the per-instance SLO clamps it, and its siblings' p99 stays
+    # flat (the differential-failure loop the fleet plane exists for).
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop, _fleet_snap_fn,
+    )
+    from frankenpaxos_tpu.monitoring.slo import SloPolicy
+    from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+    from frankenpaxos_tpu.harness import serve as serve_harness
+
+    DF, CHUNK, CHUNKS, REPS = 8, 32, 8, 3
+    drain_cfg = base_cfg(
+        workload=WorkloadPlan(
+            arrival="constant", rate=0.9 * sat_rate_lane,
+            backlog_cap=256,
+        ),
+        faults=FaultPlan(traced=True),
+    )
+    d_rates = [0.9 * sat_rate_lane] * DF
+    d_frates = [[0.0, 0.0, 0.0, 0.0]] * DF
+    snap_fn = _fleet_snap_fn(4, 0, True)
+
+    def fresh_states():
+        return sh.fleet_states(
+            "multipaxos", drain_cfg, DF, rates=d_rates,
+            fault_rates=d_frates,
+        )
+
+    snap_sum = _fleet_snap_fn(4, 0, False)
+    d_keys = sh.fleet_keys(range(DF))
+
+    def run_leg(mode: str):
+        """One bounded fleet run. "off" = the drain-off brick (same
+        compiled chunks, no snapshot/drain); "rings" = the full
+        exact-drain discipline (snapshot + per-instance DrainCursor);
+        "summary" = the O(F)-scalars summary-only drain."""
+        st, tt = fresh_states(), t0
+        cur = telemetry_mod.DrainCursor()
+        prev = None
+        for c in range(CHUNKS):
+            kk = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                d_keys, c
+            )
+            st, tt = sh.run_ticks_fleet(
+                "multipaxos", drain_cfg, None, st, tt, CHUNK, kk
+            )
+            if mode == "off":
+                continue
+            fn = snap_fn if mode == "rings" else snap_sum
+            snap = fn(serve_harness.snapshot_leaves(st))
+            if prev is not None:
+                host = jax.device_get(prev)
+                if mode == "rings":
+                    cur.drain(host["telemetry"])
+            prev = snap
+        if prev is not None:
+            host = jax.device_get(prev)
+            if mode == "rings":
+                cur.drain(host["telemetry"])
+        jax.block_until_ready(st.committed)
+
+    import gc
+
+    modes = ("off", "rings", "summary")
+    for _ in range(2):  # warm compiles + allocator on every path
+        for mode in modes:
+            run_leg(mode)
+    gc.collect()
+    best = {m: float("inf") for m in modes}
+    for _ in range(REPS):  # fully interleaved best-of-N
+        for mode in modes:
+            start = time.perf_counter()
+            run_leg(mode)
+            best[mode] = min(best[mode], time.perf_counter() - start)
+    drain_overhead = {
+        "instances": DF,
+        "chunks": CHUNKS,
+        "chunk_ticks": CHUNK,
+        "reps_interleaved_best_of": REPS,
+        "drain_off_seconds": round(best["off"], 4),
+        "drain_rings_seconds": round(best["rings"], 4),
+        "drain_summary_seconds": round(best["summary"], 4),
+        "overhead_fraction_rings": round(
+            best["rings"] / best["off"] - 1.0, 4
+        ),
+        "overhead_fraction_summary": round(
+            best["summary"] / best["off"] - 1.0, 4
+        ),
+        "budget_fraction": 0.02,
+        "within_budget": (
+            best["rings"] / best["off"] - 1.0 < 0.02
+        ),
+    }
+
+    HOSTILE = 5
+    demo_frates = [[0.0, 0.0, 0.0, 0.0] for _ in range(DF)]
+    demo_frates[HOSTILE][0] = 0.6
+    demo_loop = FleetServeLoop(
+        "multipaxos", drain_cfg,
+        FleetServeConfig(
+            chunk_ticks=CHUNK, telemetry_window=2 * CHUNK,
+            slo=SloPolicy(p99_target_ticks=8, source="queue_wait"),
+            max_chunks=10,
+        ),
+        DF,
+        rates=d_rates,
+        fault_rates=demo_frates,
+    )
+    wrap0 = sh._fleet_wrap_mesh("multipaxos", drain_cfg, None)
+    demo_runner = sh._fleet_runner("multipaxos", None, wrap0)
+    # Delta-based cache pin: the demo's own ring shape may add AT MOST
+    # one entry (its first chunk's compile); every SLO clamp inside the
+    # run must add none.
+    demo_cache0 = demo_runner._cache_size()
+    demo_rep = demo_loop.run()
+    flagged = demo_rep["stragglers_flagged"]
+    scales = demo_rep["slo"]["scales"]
+    sibling_p99 = [
+        row["p99_queue_wait"]
+        for i, row in enumerate(demo_rep["summary"])
+        if i != HOSTILE
+    ]
+    straggler_demo = {
+        "instances": DF,
+        "hostile_instance": HOSTILE,
+        "hostile_drop_rate": 0.6,
+        "flagged": flagged,
+        "only_hostile_flagged": flagged == [HOSTILE],
+        "scales": scales,
+        "only_hostile_clamped": all(
+            (s < 1.0) == (i == HOSTILE) for i, s in enumerate(scales)
+        ),
+        "hostile_p99_queue_wait": (
+            demo_rep["summary"][HOSTILE]["p99_queue_wait"]
+        ),
+        "sibling_p99_queue_wait_max": max(sibling_p99),
+        "sibling_p99_flat": max(sibling_p99) <= 8,
+        "dropped_ticks": demo_rep["dropped_ticks"],
+        "jit_cache_flat": (
+            demo_runner._cache_size() <= demo_cache0 + 1
+        ),
+        "markers": demo_rep["markers"][:8],
+    }
+    assert straggler_demo["only_hostile_flagged"], straggler_demo
+    assert straggler_demo["only_hostile_clamped"], straggler_demo
+    assert straggler_demo["sibling_p99_flat"], straggler_demo
+
     result = {
         "metric": (
             "fleet-axis capacity surface + device-rate fuzzing "
@@ -1039,6 +1196,11 @@ def _fleet_inner() -> None:
         "one_compile_per_mesh": one_compile,
         "resolved_blocks": resolved_blocks,
         "fuzz": fuzz,
+        # Fleet observability legs (harness/serve.FleetServeLoop):
+        # the non-blocking fleet drain's cost vs the drain-off brick,
+        # and the hostile-instance differential-detection demo.
+        "telemetry_drain_overhead": drain_overhead,
+        "straggler_demo": straggler_demo,
         "invariants_ok": all(r["invariants_ok"] for r in surface),
         "multi_host": sh_multihost,
         "measured_live": True,

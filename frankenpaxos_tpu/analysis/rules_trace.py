@@ -1075,6 +1075,26 @@ def check_retrace_guard(ctx: Context) -> List[Finding]:
     return out
 
 
+def _blocking_hlo_hits(hlo_text: str):
+    """``(1-based line, description)`` for every host-rendezvous
+    construct in a compiled hot-path artifact — ONE scanner shared by
+    the serve/checkpoint/fleet nosync rules, so a detection fix never
+    has to land three times. Matched per-line so variable names in
+    metadata (last_send ...) can't false-positive: callbacks lower to
+    custom-calls whose TARGET names a python/host callback;
+    infeed/outfeed appear as the op itself."""
+    hits = []
+    for i, line in enumerate(hlo_text.splitlines()):
+        lowered = line.lower()
+        if "custom-call" in lowered and (
+            "callback" in lowered or "host_compute" in lowered
+        ):
+            hits.append((i + 1, "host callback custom-call"))
+        elif " infeed(" in lowered or " outfeed(" in lowered:
+            hits.append((i + 1, "infeed/outfeed"))
+    return hits
+
+
 @rule(
     "trace-serve-nosync",
     "trace",
@@ -1097,34 +1117,20 @@ def check_serve_nosync(ctx: Context) -> List[Finding]:
     out: List[Finding] = []
 
     def scan_blocking(hlo: str, where: str):
-        """Host-rendezvous constructs in a compiled hot-path artifact.
-        Matched per-line so variable names in metadata (last_send ...)
-        can't false-positive: callbacks lower to custom-calls whose
-        TARGET names a python/host callback; infeed/outfeed appear as
-        the op itself."""
-        for i, line in enumerate(hlo.splitlines()):
-            lowered = line.lower()
-            hit = None
-            if "custom-call" in lowered and (
-                "callback" in lowered or "host_compute" in lowered
-            ):
-                hit = "host callback custom-call"
-            elif " infeed(" in lowered or " outfeed(" in lowered:
-                hit = "infeed/outfeed"
-            if hit:
-                out.append(
-                    Finding(
-                        rule="trace-serve-nosync",
-                        path=backend,
-                        line=i + 1,
-                        message=(
-                            f"{hit} in the compiled {where} — the "
-                            "serve hot path would block on the host "
-                            "every chunk"
-                        ),
-                        key=f"{backend}:{where}:{hit}",
-                    )
+        for line_no, hit in _blocking_hlo_hits(hlo):
+            out.append(
+                Finding(
+                    rule="trace-serve-nosync",
+                    path=backend,
+                    line=line_no,
+                    message=(
+                        f"{hit} in the compiled {where} — the "
+                        "serve hot path would block on the host "
+                        "every chunk"
+                    ),
+                    key=f"{backend}:{where}:{hit}",
                 )
+            )
 
     mod = _module(backend)
     cfg = mod.analysis_config()
@@ -1206,29 +1212,20 @@ def check_checkpoint_alias_free(ctx: Context) -> List[Finding]:
                 key=f"{backend}:aliased",
             )
         )
-    for i, line in enumerate(hlo.splitlines()):
-        lowered = line.lower()
-        hit = None
-        if "custom-call" in lowered and (
-            "callback" in lowered or "host_compute" in lowered
-        ):
-            hit = "host callback custom-call"
-        elif " infeed(" in lowered or " outfeed(" in lowered:
-            hit = "infeed/outfeed"
-        if hit:
-            out.append(
-                Finding(
-                    rule="checkpoint-alias-free",
-                    path=backend,
-                    line=i + 1,
-                    message=(
-                        f"{hit} in the compiled checkpoint snapshot — "
-                        "the serve hot path would block on the host "
-                        "every checkpoint"
-                    ),
-                    key=f"{backend}:{hit}",
-                )
+    for line_no, hit in _blocking_hlo_hits(hlo):
+        out.append(
+            Finding(
+                rule="checkpoint-alias-free",
+                path=backend,
+                line=line_no,
+                message=(
+                    f"{hit} in the compiled checkpoint snapshot — "
+                    "the serve hot path would block on the host "
+                    "every checkpoint"
+                ),
+                key=f"{backend}:{hit}",
             )
+        )
     return out
 
 
@@ -1538,4 +1535,202 @@ def check_fleet_onecompile(ctx: Context) -> List[Finding]:
                         )
                     )
                     break
+    return out
+
+
+# The largest signed collective the FLEET SNAPSHOT program may emit:
+# the in-graph fleet_summary's median/MAD sorts move [F]-sized summary
+# columns across fleet rows (a legitimate tiny cross-row stat), never
+# ring blocks or protocol state. 256 elements is ~25x the widest
+# summary column at the rule's brick width and ~3 orders of magnitude
+# under the smallest per-instance ring block.
+_FLEET_SNAP_COLLECTIVE_MAX = 256
+
+
+@rule(
+    "trace-fleet-drain-nosync",
+    "trace",
+    "the fleet serve hot path (run_ticks_fleet + the jitted fleet "
+    "snapshot with the in-graph summary, harness/serve.py) compiles "
+    "free of host callbacks/infeed/outfeed, the snapshot COPIES "
+    "(aliases nothing), the summary reduction moves no signed state "
+    "across the fleet axis (collectives bounded at summary size), and "
+    "a per-instance SLO clamp re-entry keeps the fleet runner's jit "
+    "cache flat",
+)
+def check_fleet_drain_nosync(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.harness import serve as serve_mod
+    from frankenpaxos_tpu.parallel import sharding as _sh
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    backend = "multipaxos"  # the flagship fleet serve target
+    if ctx.backends is not None and backend not in ctx.backends:
+        return []
+    out: List[Finding] = []
+    if len(jax.devices()) < 4:
+        import sys
+
+        print(
+            "trace-fleet-drain-nosync: SKIPPED (needs >=4 jax devices "
+            "for a 2x2 product mesh; set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 or run via "
+            "scripts/lint.sh)",
+            file=sys.stderr,
+        )
+        return out
+
+    mod = _module(backend)
+    cfg = mod.analysis_config(
+        faults=FaultPlan(traced=True),
+        workload=WorkloadPlan(arrival="constant", rate=1.0),
+    )
+    spec = _sh.SHARDINGS[backend]
+    state = mod.init_state(cfg)
+    axis_len = spec.axis_len(state)
+    n_group = max(
+        (
+            d
+            for d in range(1, min(len(jax.devices()) // 2, axis_len) + 1)
+            if axis_len % d == 0
+        ),
+        default=1,
+    )
+    mesh = _sh.make_fleet_mesh(
+        fleet=2, devices=jax.devices()[: 2 * n_group]
+    )
+    F = 4
+    rates = [0.5, 1.0, 1.5, 2.0]
+    frates = [[0.05 * i, 0.0, 0.0, 0.0] for i in range(F)]
+
+    def scan_blocking(hlo: str, where: str):
+        for line_no, hit in _blocking_hlo_hits(hlo):
+            out.append(
+                Finding(
+                    rule="trace-fleet-drain-nosync",
+                    path=backend,
+                    line=line_no,
+                    message=(
+                        f"{hit} in the compiled fleet {where} — "
+                        "the fleet serve hot path would block on "
+                        "the host every chunk"
+                    ),
+                    key=f"{backend}:{where}:{hit}",
+                )
+            )
+
+    run_lowered, snap_lowered = serve_mod.lower_fleet_chunk_path(
+        backend, cfg, mesh, n=F, rates=rates, fault_rates=frates
+    )
+    scan_blocking(run_lowered.compile().as_text(), "run_ticks_fleet")
+    snap_hlo = snap_lowered.compile().as_text()
+    scan_blocking(snap_hlo, "snapshot")
+
+    # (a) The snapshot must COPY: draining it after the next chunk
+    # donates the fleet state must never read reused buffers.
+    aliased = _alias_param_indices(snap_hlo)
+    if aliased:
+        out.append(
+            Finding(
+                rule="trace-fleet-drain-nosync",
+                path=backend,
+                line=0,
+                message=(
+                    f"the compiled fleet snapshot ALIASES {len(aliased)} "
+                    "input buffer(s) — the fleet drain would read "
+                    "buffers the next chunk's donation already reused; "
+                    "the snapshot must copy"
+                ),
+                key=f"{backend}:snapshot:aliased",
+            )
+        )
+    # (b) Summary-reduction census (the PR 14 replica-group machinery
+    # reused): the in-graph fleet_summary may sort tiny summary
+    # columns across fleet rows (median/MAD), but any signed
+    # collective above summary size means the snapshot is moving ring
+    # blocks or protocol state between instances.
+    for line in snap_hlo.splitlines():
+        shapes = _collective_line_shapes(line)
+        if not shapes or all(d.startswith("u") for d, _ in shapes):
+            continue
+        worst = max(e for d, e in shapes if not d.startswith("u"))
+        if worst > _FLEET_SNAP_COLLECTIVE_MAX:
+            out.append(
+                Finding(
+                    rule="trace-fleet-drain-nosync",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"the compiled fleet snapshot emits a "
+                        f"{worst}-element signed collective (allowed: "
+                        f"<={_FLEET_SNAP_COLLECTIVE_MAX}-element "
+                        "summary stats) — the summary reduction is "
+                        "moving per-instance state across the fleet "
+                        "axis"
+                    ),
+                    key=f"{backend}:snapshot:collective:{worst}",
+                )
+            )
+        if _collective_groups(line) is None:
+            out.append(
+                Finding(
+                    rule="trace-fleet-drain-nosync",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "unparseable replica_groups on a signed "
+                        f"snapshot collective: {line.strip()[:160]}"
+                    ),
+                    key=f"{backend}:snapshot:unparseable",
+                )
+            )
+
+    # (c) Clamp re-entry is recompile-free: run a chunk, steer the
+    # per-instance traced rates (the SLO control plane's verb —
+    # sharding.set_fleet_rates), run another chunk — the fleet
+    # runner's jit cache must not grow.
+    wrap = _sh._fleet_wrap_mesh(backend, cfg, mesh)
+    runner = _sh._fleet_runner(backend, mesh, wrap)
+    states = _sh.shard_fleet_state(
+        backend,
+        _sh.fleet_states(
+            backend, cfg, F, rates=rates, fault_rates=frates
+        ),
+        mesh,
+    )
+    keys = _sh.place_fleet_keys(_sh.fleet_keys(range(F)), mesh)
+    states, t = _sh.run_ticks_fleet(
+        backend, cfg, mesh, states, jnp.zeros((), jnp.int32), _TICKS,
+        keys,
+    )
+    jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
+    before = runner._cache_size()
+    clamped = [r * s for r, s in zip(rates, (1.0, 0.05, 1.0, 1.0))]
+    states = _sh.set_fleet_rates(states, clamped, mesh)
+    states, t = _sh.run_ticks_fleet(
+        backend, cfg, mesh, states, t, _TICKS,
+        jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, 1),
+    )
+    jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
+    after = runner._cache_size()
+    if after > before:
+        out.append(
+            Finding(
+                rule="trace-fleet-drain-nosync",
+                path=backend,
+                line=0,
+                message=(
+                    "a per-instance SLO clamp (set_fleet_rates between "
+                    f"chunks) missed the jit cache ({before} -> {after} "
+                    "entries) — the clamp vector landed in a static or "
+                    "re-sharded argument and every control-plane action "
+                    "recompiles the fleet serve loop"
+                ),
+                key=f"{backend}:clamp-retrace",
+            )
+        )
     return out

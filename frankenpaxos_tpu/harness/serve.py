@@ -69,6 +69,7 @@ CLI (a bounded run of the flagship)::
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import sys
@@ -80,7 +81,11 @@ import jax.numpy as jnp
 
 from frankenpaxos_tpu.monitoring import scrape as scrape_mod
 from frankenpaxos_tpu.monitoring import traceviz
-from frankenpaxos_tpu.monitoring.slo import SloEngine, SloPolicy
+from frankenpaxos_tpu.monitoring.slo import (
+    FleetSloEngine,
+    SloEngine,
+    SloPolicy,
+)
 from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
@@ -727,6 +732,518 @@ class ServeLoop:
         return out
 
 
+# ---------------------------------------------------------------------------
+# Fleet serving: the observability plane over a PR 14 fleet brick.
+# ---------------------------------------------------------------------------
+# A fleet brick (parallel/sharding.py: dozens of independent protocol
+# instances vmapped into ONE compiled executable) used to be a black
+# box — final invariant reductions and nothing else. FleetServeLoop
+# extends the double-buffered non-blocking drain discipline to the
+# brick: dispatch chunk i (run_ticks_fleet, donated), enqueue the
+# jitted FLEET snapshot behind it (an aliased-nothing copy of the
+# whole [F, K, cols] ring block plus the in-graph per-instance
+# fleet_summary + straggler flags), drain chunk i-1 while i computes —
+# ONE block_until_ready total, at shutdown. Per-instance SloEngines
+# evaluate the drained per-instance histogram deltas and drive
+# PER-INSTANCE admission clamps through the fleet-sharded traced
+# WorkloadState.rate (sharding.set_fleet_rates — zero recompiles, the
+# jit cache stays flat), closing the loop from "instance 7 is
+# saturating" to "instance 7 got clamped" without touching its
+# siblings. The ``trace-fleet-drain-nosync`` analysis rule pins the
+# compiled shape of all of it (no host callbacks, snapshot aliases
+# nothing, summary collectives bounded, clamp re-entry cache-flat).
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetServeConfig:
+    """Fleet serve-mode knobs (the fleet twin of :class:`ServeConfig`;
+    spans stay a single-instance feature — the reservoir sampler is
+    per-instance state the fleet loop does not size)."""
+
+    chunk_ticks: int = 32
+    telemetry_window: int = telemetry_mod.TELEM_WINDOW
+    slo: Optional[SloPolicy] = None
+    scrape_csv: Optional[str] = None
+    trace_path: Optional[str] = None
+    max_chunks: Optional[int] = None
+    max_seconds: Optional[float] = None
+    # True: the snapshot carries the full per-instance rings and every
+    # drain is EXACT (DrainCursor per instance — the scrape CSV gets
+    # per-tick rows). False: summary-only drains — the host pulls the
+    # O(F) summary vectors + small gauges per chunk, the scalable mode
+    # for wide fleets.
+    drain_rings: bool = True
+    # Straggler test knobs (telemetry.fleet_summary): k x MAD deviation
+    # from the fleet median, plus the optional analytical expected-rate
+    # anchor (commits/tick/instance; 0 = off). The MAD test presumes a
+    # HOMOGENEOUS fleet (same plan rate per instance) — heterogeneous
+    # offered loads make deviation the expected signal, not an anomaly.
+    k_mad: int = 4
+    expected_rate_per_tick: float = 0.0
+
+    def __post_init__(self):
+        assert self.chunk_ticks >= 1
+        assert self.telemetry_window >= self.chunk_ticks, (
+            "telemetry_window must cover a chunk or drains drop ticks"
+        )
+        assert self.max_chunks is not None or self.max_seconds is not None, (
+            "bound the loop with max_chunks and/or max_seconds"
+        )
+        assert self.k_mad >= 1
+        assert self.expected_rate_per_tick >= 0.0
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_snap_fn(k_mad: int, expected_x1000: int, rings: bool):
+    """The jitted fleet snapshot program, cached per knob tuple so
+    every FleetServeLoop with the same knobs shares ONE executable per
+    shape: an aliased-nothing copy of the small per-instance gauges
+    (+ the full rings when ``rings``) plus the in-graph
+    ``telemetry.fleet_summary`` reduction — the only cross-instance
+    collectives a product mesh sees are its tiny median/MAD sorts,
+    bounded by the ``trace-fleet-drain-nosync`` census."""
+
+    @jax.jit
+    def snap(leaves):
+        tel = leaves["telemetry"]
+        out = {
+            "summary": telemetry_mod.fleet_summary(
+                tel,
+                wait_hist=leaves["wait_hist"],
+                shed=leaves["shed"],
+                k_mad=k_mad,
+                expected_rate_x1000=expected_x1000,
+            )
+        }
+        small = {
+            "ticks": tel.ticks,
+            "totals": tel.totals,
+            "lat_hist": tel.lat_hist,
+            "wait_hist": leaves["wait_hist"],
+            "offered": leaves["offered"],
+            "shed": leaves["shed"],
+        }
+        out.update(_copy_tree(small))
+        if rings:
+            out["telemetry"] = _copy_tree(tel)
+        return out
+
+    return snap
+
+
+def lower_fleet_chunk_path(
+    backend: str,
+    cfg,
+    mesh,
+    n: int = 4,
+    chunk_ticks: int = 4,
+    rates=None,
+    fault_rates=None,
+    k_mad: int = 4,
+    rings: bool = True,
+):
+    """Lower the two compiled artifacts of the FLEET serve hot path —
+    (run_ticks_fleet, fleet snapshot) — for inspection. The
+    ``trace-fleet-drain-nosync`` analysis rule compiles these; keeping
+    the hook HERE means the rule checks exactly what the loop runs."""
+    from frankenpaxos_tpu.parallel import sharding as sharding_mod
+
+    states = sharding_mod.fleet_states(
+        backend, cfg, n, rates=rates, fault_rates=fault_rates
+    )
+    if mesh is not None:
+        states = sharding_mod.shard_fleet_state(backend, states, mesh)
+    keys = sharding_mod.place_fleet_keys(
+        sharding_mod.fleet_keys(range(n)), mesh
+    )
+    run_lowered = sharding_mod.lower_fleet(
+        backend, cfg, mesh, states, jnp.zeros((), jnp.int32),
+        chunk_ticks, keys,
+    )
+    snap_lowered = _fleet_snap_fn(k_mad, 0, rings).lower(
+        snapshot_leaves(states)
+    )
+    return run_lowered, snap_lowered
+
+
+class FleetServeLoop:
+    """A long-lived serve driver over one FLEET brick of a
+    sharding-registry backend: ``n`` independent instances with
+    per-instance seeds / traced offered rates / traced fault rates,
+    dispatched through ``parallel.sharding.run_ticks_fleet`` (ONE
+    compiled executable per mesh) with the non-blocking drain
+    discipline and a per-instance SLO control plane. Instance i of the
+    fleet replays EXACTLY the program ``ServeLoop(seed=seeds[i])``
+    replays at the same traced rates (the PR 14 bit-identity contract
+    extended to the drains — pinned by ``tests/test_fleet.py``)."""
+
+    def __init__(
+        self,
+        backend: str,
+        cfg,
+        fleet: FleetServeConfig,
+        n: int,
+        seeds=None,
+        rates=None,
+        fault_rates=None,
+        mesh=None,
+    ):
+        from frankenpaxos_tpu.parallel import sharding as sharding_mod
+
+        self.sharding = sharding_mod
+        self.backend = backend
+        self.mod = sharding_mod.SHARDINGS[backend].mod()
+        self.cfg = cfg
+        self.fleet = fleet
+        self.n = int(n)
+        self.mesh = mesh
+        self.seeds = list(seeds) if seeds is not None else list(range(n))
+        assert len(self.seeds) == self.n
+        base = self.mod.init_state(cfg)
+        base = dataclasses.replace(
+            base,
+            telemetry=telemetry_mod.make_telemetry(
+                fleet.telemetry_window
+            ),
+        )
+        self.states = sharding_mod.fleet_states(
+            backend, cfg, self.n, rates=rates, fault_rates=fault_rates,
+            base=base,
+        )
+        if mesh is not None:
+            sharding_mod.validate_policy(backend, cfg, mesh)
+            self.states = sharding_mod.shard_fleet_state(
+                backend, self.states, mesh
+            )
+        self.base_keys = sharding_mod.place_fleet_keys(
+            sharding_mod.fleet_keys(self.seeds), mesh
+        )
+        self.t = jnp.zeros((), jnp.int32)
+        self.base_rates = (
+            [float(r) for r in rates] if rates is not None else None
+        )
+        self._snap = _fleet_snap_fn(
+            fleet.k_mad,
+            int(round(fleet.expected_rate_per_tick * 1000)),
+            fleet.drain_rings,
+        )
+        self.cursor = telemetry_mod.DrainCursor()
+        self.clock = traceviz.TickClock()
+        self.host_spans: List[dict] = []
+        self.drains: List[dict] = []
+        self.markers: List[dict] = []  # per-instance alarm/clamp marks
+        self.straggler_drains: List[List[int]] = []  # flags per drain
+        self.slo: Optional[FleetSloEngine] = (
+            FleetSloEngine(fleet.slo, self.n) if fleet.slo else None
+        )
+        self._prev: List[Dict[str, Any]] = [{} for _ in range(self.n)]
+        self._spans_scraped = 0
+        self._chunks = 0
+        self._epoch = 0
+        self.clean_shutdown = False
+
+    def _span(self, name: str, start_unix: float, t0: float, **meta):
+        self.host_spans.append(
+            {
+                "name": name,
+                "start_unix": start_unix,
+                "duration_s": time.perf_counter() - t0,
+                **meta,
+            }
+        )
+
+    def set_rates(self, rates):
+        """The per-instance control-plane verb: a new [n] traced-rate
+        vector, same compiled executable (sharding.set_fleet_rates)."""
+        self.states = self.sharding.set_fleet_rates(
+            self.states, rates, self.mesh
+        )
+        self._span("verb:set_rates", time.time(), time.perf_counter())
+
+    # -- the hot path -------------------------------------------------------
+
+    def _dispatch_chunk(self):
+        """Dispatch one fleet chunk + enqueue its snapshot; NO blocking
+        call here (the run_ticks_fleet donation rebinds the states, the
+        snapshot copies what the drain will read)."""
+        keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            self.base_keys, self._epoch
+        )
+        self._epoch += 1
+        start, t0 = time.time(), time.perf_counter()
+        with jax.profiler.TraceAnnotation("fleet-serve:dispatch"):
+            self.states, self.t = self.sharding.run_ticks_fleet(
+                self.backend, self.cfg, self.mesh, self.states, self.t,
+                self.fleet.chunk_ticks, keys,
+            )
+            snap = self._snap(snapshot_leaves(self.states))
+        self._span(
+            "dispatch", start, t0,
+            num_ticks=self.fleet.chunk_ticks,
+            compile=self._chunks == 0,
+        )
+        self._chunks += 1
+        return snap
+
+    def _drain(self, snap) -> dict:
+        """Drain one fleet chunk's snapshot: the only device_get on the
+        hot path — O(F) summary scalars + small gauges (plus the rings
+        when ``drain_rings``), never the protocol state."""
+        import numpy as np
+
+        start, t0 = time.time(), time.perf_counter()
+        with jax.profiler.TraceAnnotation("fleet-serve:drain"):
+            host = jax.device_get(snap)
+        summary = np.asarray(host["summary"])
+        ticks_total = int(np.max(host["ticks"]))
+        drain: Dict[str, Any] = {
+            "ticks_total": ticks_total,
+            "summary": [
+                telemetry_mod.summary_row_dict(summary[i])
+                for i in range(self.n)
+            ],
+            "stragglers": [
+                i
+                for i in range(self.n)
+                if summary[i][telemetry_mod.SUMMARY_COL["straggler"]]
+            ],
+            "dropped_ticks": 0,
+        }
+        if self.fleet.drain_rings:
+            ring = self.cursor.drain(host["telemetry"])
+            drain["instances"] = ring["instances"]
+            drain["dropped_ticks"] = ring["dropped_ticks"]
+        self._span("drain", start, t0, ticks=ticks_total)
+        self.clock.add_mark(ticks_total, time.time())
+        self.straggler_drains.append(drain["stragglers"])
+
+        # Per-instance SLO -> per-instance clamp (the control plane).
+        if self.slo is not None:
+            per = []
+            for i in range(self.n):
+                prev = self._prev[i]
+                lat = np.asarray(host["lat_hist"][i])
+                wait = np.asarray(host["wait_hist"][i])
+                offered = (
+                    int(host["offered"][i])
+                    if np.size(host["offered"][i])
+                    else 0
+                )
+                shed = (
+                    int(host["shed"][i])
+                    if np.size(host["shed"][i])
+                    else 0
+                )
+                per.append(dict(
+                    lat_hist_delta=lat - prev.get("lat", 0),
+                    wait_hist_delta=(
+                        wait - prev.get("wait", 0) if wait.size else None
+                    ),
+                    offered_delta=offered - prev.get("offered", 0),
+                    shed_delta=shed - prev.get("shed", 0),
+                ))
+                self._prev[i] = {
+                    "lat": lat, "wait": wait, "offered": offered,
+                    "shed": shed,
+                }
+            statuses = self.slo.observe(per)
+            drain["slo"] = statuses
+            for i, st in enumerate(statuses):
+                if st["fired"]:
+                    self.markers.append({
+                        "instance": i, "tick": ticks_total,
+                        "kind": "alarm", "p99": st["p99"],
+                    })
+                if st["cleared"]:
+                    self.markers.append({
+                        "instance": i, "tick": ticks_total,
+                        "kind": "clear",
+                    })
+            if self.base_rates is not None:
+                scales = self.slo.scales
+                if any(s < 1.0 for s in scales):
+                    for i, st in enumerate(statuses):
+                        if st["alarm"] and st["scale"] < 1.0:
+                            self.markers.append({
+                                "instance": i, "tick": ticks_total,
+                                "kind": "clamp",
+                                "scale": st["scale"],
+                            })
+                # One state-side vector update per drain (also when a
+                # scale RECOVERS toward 1.0) — never a recompile.
+                self.states = self.sharding.set_fleet_rates(
+                    self.states,
+                    [r * s for r, s in zip(self.base_rates, scales)],
+                    self.mesh,
+                )
+
+        if self.fleet.scrape_csv:
+            ts = time.time()
+            scrape_mod.append_fleet_summary(
+                self.fleet.scrape_csv, drain["summary"], ts=ts,
+                scales=(self.slo.scales if self.slo else None),
+            )
+            if self.fleet.drain_rings:
+                for i in range(self.n):
+                    scrape_mod.append_device_samples(
+                        self.fleet.scrape_csv,
+                        telemetry_mod.instance_view(
+                            host["telemetry"], i
+                        ),
+                        instance=str(i),
+                        ts=ts,
+                    )
+            scrape_mod.append_host_spans(
+                self.fleet.scrape_csv,
+                self.host_spans[self._spans_scraped:],
+                instance="fleet",
+            )
+            self._spans_scraped = len(self.host_spans)
+        self.drains.append(drain)
+        return drain
+
+    def run(self) -> dict:
+        """Serve until the configured bound, then shut down cleanly
+        (final drain + ONE block_until_ready + trace export)."""
+        fleet = self.fleet
+        deadline = (
+            time.monotonic() + fleet.max_seconds
+            if fleet.max_seconds is not None
+            else None
+        )
+        start_wall = time.perf_counter()
+        self.clock.add_mark(0, time.time())
+        prev_snap = None
+        while True:
+            if fleet.max_chunks is not None and (
+                self._chunks >= fleet.max_chunks
+            ):
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            snap = self._dispatch_chunk()
+            if prev_snap is not None:
+                self._drain(prev_snap)
+            prev_snap = snap
+        if prev_snap is not None:
+            self._drain(prev_snap)
+        jax.block_until_ready(self.states)
+        wall = time.perf_counter() - start_wall
+        self.clean_shutdown = True
+        if fleet.trace_path:
+            traceviz.write_chrome_trace(
+                fleet.trace_path,
+                host_spans=self.host_spans,
+                clock=self.clock,
+                extra_events=(
+                    traceviz.fleet_metadata_events(self.n)
+                    + traceviz.fleet_marker_events(
+                        self.markers, self.clock
+                    )
+                ),
+            )
+        return self.report(wall)
+
+    def report(self, wall_s: float) -> dict:
+        last = self.drains[-1] if self.drains else {}
+        flagged = sorted({
+            i for flags in self.straggler_drains for i in flags
+        })
+        out = {
+            "backend": self.backend,
+            "instances": self.n,
+            "mesh": (
+                None
+                if self.mesh is None
+                else [int(s) for s in dict(self.mesh.shape).values()]
+            ),
+            "chunks": self._chunks,
+            "chunk_ticks": self.fleet.chunk_ticks,
+            "ticks": last.get("ticks_total", 0),
+            "wall_s": round(wall_s, 4),
+            "dropped_ticks": sum(
+                d["dropped_ticks"] for d in self.drains
+            ),
+            "summary": last.get("summary", []),
+            "stragglers_flagged": flagged,
+            "markers": list(self.markers),
+            "clean_shutdown": self.clean_shutdown,
+        }
+        if self.slo is not None:
+            out["slo"] = self.slo.summary()
+        if self.fleet.trace_path:
+            out["trace_path"] = self.fleet.trace_path
+        if self.fleet.scrape_csv:
+            out["scrape_csv"] = self.fleet.scrape_csv
+        return out
+
+
+def serve_fleet(
+    seconds: float = 10.0,
+    out_dir: str = ".",
+    n: int = 4,
+    num_groups: int = 64,
+    chunk_ticks: int = 32,
+    rate_x: float = 1.0,
+    slo_p99: Optional[int] = None,
+    hostile_instance: Optional[int] = None,
+    hostile_drop: float = 0.5,
+    seed: int = 0,
+    window: int = 16,
+    slots_per_tick: int = 2,
+    max_chunks: Optional[int] = None,
+) -> dict:
+    """A bounded FLEET serve run of the flagship backend — the CLI +
+    smoke entry point (``--fleet N``). All instances serve the same
+    shaped plan at ``rate_x`` x the nominal per-lane admission rate
+    (homogeneous, so the straggler test is meaningful);
+    ``hostile_instance`` gives ONE instance a hostile traced drop rate
+    — the differential-failure demo the fleet observability plane
+    exists for."""
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    plan_rate = rate_x * slots_per_tick
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=num_groups, window=window,
+        slots_per_tick=slots_per_tick, retry_timeout=16,
+        workload=workload_mod.WorkloadPlan(
+            arrival="constant", rate=plan_rate, backlog_cap=256,
+        ),
+        faults=FaultPlan(traced=True),
+    )
+    frates = [[0.0, 0.0, 0.0, 0.0] for _ in range(n)]
+    if hostile_instance is not None:
+        assert 0 <= hostile_instance < n
+        frates[hostile_instance][0] = hostile_drop
+    os.makedirs(out_dir, exist_ok=True)
+    fleet_cfg = FleetServeConfig(
+        chunk_ticks=chunk_ticks,
+        telemetry_window=max(
+            chunk_ticks * 2, telemetry_mod.TELEM_WINDOW
+        ),
+        slo=(
+            SloPolicy(p99_target_ticks=slo_p99, source="queue_wait")
+            if slo_p99 is not None
+            else None
+        ),
+        scrape_csv=os.path.join(out_dir, "fleet_metrics.csv"),
+        trace_path=os.path.join(out_dir, "fleet_trace.json"),
+        max_seconds=seconds,
+        max_chunks=max_chunks,
+    )
+    loop = FleetServeLoop(
+        "multipaxos", cfg, fleet_cfg, n,
+        seeds=[seed + i for i in range(n)],
+        rates=[plan_rate] * n,
+        fault_rates=frates,
+    )
+    report = loop.run()
+    with open(os.path.join(out_dir, "fleet_report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    return report
+
+
 def serve_flagship(
     seconds: float = 10.0,
     out_dir: str = ".",
@@ -844,7 +1361,50 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the newest valid checkpoint in "
                    "<out-dir>/checkpoints (bit-exact)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="serve an N-instance FLEET brick instead of a "
+                   "single instance (FleetServeLoop: per-instance "
+                   "telemetry drains, straggler flags, per-instance "
+                   "SLO clamps; 0 = single-instance mode)")
+    p.add_argument("--hostile-instance", type=int, default=None,
+                   help="--fleet only: give this instance a hostile "
+                   "traced drop rate (the differential-failure demo)")
+    p.add_argument("--hostile-drop", type=float, default=0.5)
     args = p.parse_args(argv)
+    if args.fleet:
+        # Single-instance-only knobs are rejected loudly instead of
+        # silently dropped (spans are per-instance reservoir state the
+        # fleet loop does not size; checkpoint/resume stay
+        # single-instance features).
+        ignored = [
+            name for name, on in (
+                ("--spans", args.spans != 16),
+                ("--checkpoint-every", bool(args.checkpoint_every)),
+                ("--resume", args.resume),
+                ("--rotate-every", bool(args.rotate_every)),
+                ("--sessions", bool(args.sessions)),
+                ("--reconfig", args.reconfig),
+            ) if on
+        ]
+        if ignored:
+            p.error(
+                f"{', '.join(ignored)} are single-instance serve "
+                "knobs; drop them for --fleet runs"
+            )
+        report = serve_fleet(
+            seconds=args.seconds,
+            out_dir=args.out_dir,
+            n=args.fleet,
+            num_groups=args.groups,
+            chunk_ticks=args.chunk,
+            rate_x=(args.rate_x if args.rate_x is not None else 1.0),
+            slo_p99=args.slo_p99,
+            hostile_instance=args.hostile_instance,
+            hostile_drop=args.hostile_drop,
+            seed=args.seed,
+        )
+        print(json.dumps(report))
+        return 0 if report["clean_shutdown"] else 1
     report = serve_flagship(
         seconds=args.seconds,
         out_dir=args.out_dir,

@@ -166,6 +166,85 @@ def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
     return output
 
 
+def render_fleet_dashboard(
+    capture: MetricsCapture,
+    output: str,
+) -> Optional[str]:
+    """FLEET view (``--fleet``): instance x time heatmaps of the
+    per-instance summary metrics a ``FleetServeLoop`` streams into the
+    scrape CSV (``scrape.append_fleet_summary``) — commit rate, p99
+    commit latency, shed — plus the STRAGGLER LANE (the in-graph
+    outlier flags) and, when present, the per-instance admission-scale
+    lane the SLO control plane drove. Instance indices come from the
+    ``instance`` column (``scrape.instance_index``: legacy
+    single-instance names parse as instance 0, so a pre-fleet capture
+    renders as a one-row fleet). Returns the output path, or None when
+    the capture holds no fleet metrics."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    from frankenpaxos_tpu.monitoring.scrape import instance_index
+
+    panels = [
+        ("fpx_fleet_commit_rate_x1000", "commit rate (x1000/tick)"),
+        ("fpx_fleet_p99_commit_latency_ticks", "p99 commit latency (ticks)"),
+        ("fpx_fleet_shed_total", "shed (cumulative)"),
+        ("fpx_fleet_straggler", "straggler lane (flagged drains)"),
+        ("fpx_fleet_admission_scale", "admission scale (x1000)"),
+    ]
+
+    def matrix(name):
+        """(instances x drains) value matrix for one fleet metric, or
+        None when the capture has no samples of it."""
+        df = capture.df[capture.df["name"] == name]
+        if not len(df):
+            return None
+        df = df.copy()
+        df["inst"] = df["instance"].map(instance_index)
+        wide = df.pivot_table(
+            index="inst", columns="ts", values="value", aggfunc="last"
+        ).sort_index()
+        return np.asarray(wide.ffill(axis=1).fillna(0.0))
+
+    mats = []
+    for name, title in panels:
+        m = matrix(name)
+        if m is not None:
+            mats.append((m, title, name))
+    if not mats:
+        return None
+
+    fig, axes = plt.subplots(
+        len(mats), 1, figsize=(9, 2.2 * len(mats)), squeeze=False
+    )
+    for ax_row, (m, title, name) in zip(axes, mats):
+        ax = ax_row[0]
+        binary = name in (
+            "fpx_fleet_straggler",
+        )
+        im = ax.imshow(
+            m,
+            aspect="auto",
+            interpolation="nearest",
+            cmap="Reds" if binary else "viridis",
+            vmin=0.0 if binary else None,
+            vmax=1.0 if binary else None,
+        )
+        ax.set_title(title, fontsize=9)
+        ax.set_ylabel("instance")
+        ax.set_yticks(range(m.shape[0]))
+        if not binary:
+            fig.colorbar(im, ax=ax, fraction=0.03, pad=0.01)
+    axes[-1][0].set_xlabel("drain (scrape order)")
+    fig.tight_layout()
+    fig.savefig(output)
+    plt.close(fig)
+    return output
+
+
 def tail_live(
     path: str,
     output: str,
@@ -249,6 +328,14 @@ def main() -> None:
         "re-rendering as it grows (instead of one post-hoc render)",
     )
     parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="render the FLEET view: instance x time heatmaps "
+        "(commit rate, p99, shed) + the straggler lane from a "
+        "FleetServeLoop scrape CSV (legacy single-instance captures "
+        "render as a one-row fleet)",
+    )
+    parser.add_argument(
         "--interval", type=float, default=1.0,
         help="--live poll interval (seconds)",
     )
@@ -264,6 +351,13 @@ def main() -> None:
     output = args.output or os.path.join(
         os.path.dirname(os.path.abspath(path)), "dashboard.png"
     )
+    if args.fleet:
+        result = render_fleet_dashboard(MetricsCapture(path), output)
+        if result is None:
+            print("no fleet metrics in capture", file=sys.stderr)
+            sys.exit(1)
+        print(result)
+        return
     if args.live:
         renders = tail_live(
             path, output, interval_s=args.interval,

@@ -22,6 +22,27 @@ import time
 import urllib.request
 from typing import Dict, Iterable, List, Optional, Tuple
 
+# CSV schema: version 1 was ``ts,job,name,labels,value`` (no instance
+# column); version 2 — every writer below — is
+# ``ts,job,instance,name,labels,value``. Readers (``MetricsCapture``)
+# accept BOTH: a v1 capture parses with every sample on instance 0, so
+# old single-instance captures keep rendering (``dashboard --live``)
+# while fleet captures carry one instance per fleet row.
+CSV_SCHEMA_VERSION = 2
+CSV_COLUMNS = ["ts", "job", "instance", "name", "labels", "value"]
+
+
+def instance_index(value) -> int:
+    """The FLEET instance index of a CSV ``instance`` cell: numeric
+    strings are fleet rows; legacy single-instance names ("serve",
+    "sim", a host:port target, a missing v1 column) all map to
+    instance 0 — the backward-compat rule the fleet dashboard and the
+    round-trip test pin."""
+    try:
+        return int(str(value).strip())
+    except (TypeError, ValueError):
+        return 0
+
 
 def scrape_config(scrape_interval_ms: int, jobs: Dict[str, List[str]]) -> dict:
     """A prometheus.yml-shaped dict (prometheus.py:10-25), kept for config
@@ -136,6 +157,59 @@ def append_host_spans(
     return n
 
 
+# The per-instance summary metrics a FLEET serve loop appends each
+# drain (telemetry.fleet_summary columns worth exposing): the
+# instance x time matrices ``dashboard --fleet`` renders as heatmaps,
+# plus the straggler lane and the per-instance admission scale.
+FLEET_SUMMARY_METRICS = {
+    "commit_rate_x1000": "fpx_fleet_commit_rate_x1000",
+    "p50_commit_latency": "fpx_fleet_p50_commit_latency_ticks",
+    "p99_commit_latency": "fpx_fleet_p99_commit_latency_ticks",
+    "p50_queue_wait": "fpx_fleet_queue_wait_p50_ticks",
+    "p99_queue_wait": "fpx_fleet_queue_wait_p99_ticks",
+    "shed": "fpx_fleet_shed_total",
+    "rotations": "fpx_fleet_rotations",
+    "straggler": "fpx_fleet_straggler",
+}
+
+
+def append_fleet_summary(
+    csv_path: str,
+    summary_rows: List[dict],
+    job: str = "fleet",
+    ts: Optional[float] = None,
+    scales: Optional[List[float]] = None,
+) -> int:
+    """Append one fleet drain's per-instance summary vectors
+    (``telemetry.summary_row_dict`` dicts, one per instance) to the
+    scraper CSV — instance column = the fleet row index, so the
+    ``--fleet`` dashboard pivots instance x time directly. ``scales``
+    optionally adds the per-instance admission scale
+    (``fpx_fleet_admission_scale``, x1000). Returns rows appended."""
+    import os
+
+    ts = time.time() if ts is None else ts
+    new_file = not os.path.exists(csv_path)
+    n = 0
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(CSV_COLUMNS)
+        for i, row in enumerate(summary_rows):
+            for col, metric in FLEET_SUMMARY_METRICS.items():
+                writer.writerow(
+                    [ts, job, str(i), metric, "", row[col]]
+                )
+                n += 1
+            if scales is not None:
+                writer.writerow([
+                    ts, job, str(i), "fpx_fleet_admission_scale",
+                    "", int(round(scales[i] * 1000)),
+                ])
+                n += 1
+    return n
+
+
 class MetricsScraper:
     """Polls each job's targets and appends samples to a CSV with columns
     ``ts,job,instance,name,labels,value`` (labels as ``k=v;k=v``)."""
@@ -209,6 +283,17 @@ class MetricsCapture:
         import pandas as pd
 
         self.df = pd.read_csv(path, header=0)
+        # Schema-version shim (CSV_SCHEMA_VERSION): a v1 capture has no
+        # ``instance`` column — parse it as instance 0 so old
+        # single-instance captures keep answering every query and
+        # ``dashboard --live`` unchanged (round-trip-pinned by
+        # tests/test_metrics_capture.py).
+        if "instance" not in self.df.columns:
+            self.df["instance"] = "0"
+        # Fleet captures carry NUMERIC instance cells (the fleet row
+        # index) which pandas infers as int64 — normalize to str so
+        # query()'s series labels concatenate for every schema.
+        self.df["instance"] = self.df["instance"].astype(str)
         if len(self.df):
             self.df["ts"] = pd.to_datetime(self.df["ts"], unit="s")
 

@@ -247,3 +247,53 @@ class SloEngine:
         # starts a fresh log but keeps the observation count.
         self.history = []
         self._observations_restored = int(s.get("observations", 0))
+
+
+class FleetSloEngine:
+    """Per-instance SLO evaluation for a FLEET serve loop: one
+    independent :class:`SloEngine` per instance, fed that instance's
+    own histogram deltas each drain — instance 7 breaching its p99
+    clamps instance 7's admission scale and NOBODY else's (the
+    per-instance control loop ``harness/serve.FleetServeLoop`` closes
+    through ``parallel.sharding.set_fleet_rates``). Pure host
+    arithmetic, like the single-instance engine."""
+
+    def __init__(self, policy: SloPolicy, n: int):
+        assert n >= 1
+        self.policy = policy
+        self.engines = [SloEngine(policy) for _ in range(n)]
+
+    def __len__(self) -> int:
+        return len(self.engines)
+
+    def observe(self, per_instance: list) -> list:
+        """One drain: ``per_instance`` is a list of n kwarg dicts for
+        :meth:`SloEngine.observe` (lat_hist_delta / wait_hist_delta /
+        offered_delta / shed_delta). Returns the n status dicts."""
+        assert len(per_instance) == len(self.engines)
+        return [
+            eng.observe(**kw)
+            for eng, kw in zip(self.engines, per_instance)
+        ]
+
+    @property
+    def scales(self) -> list:
+        """The per-instance admission scales (the clamp vector the
+        serve loop multiplies into the base rates)."""
+        return [eng.scale for eng in self.engines]
+
+    @property
+    def alarms(self) -> list:
+        return [eng.alarm for eng in self.engines]
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.policy.to_dict(),
+            "instances": len(self.engines),
+            "alarms": [eng.alarm for eng in self.engines],
+            "scales": [round(eng.scale, 6) for eng in self.engines],
+            "alarms_fired": [eng.alarms_fired for eng in self.engines],
+            "clamps_applied": [
+                eng.clamps_applied for eng in self.engines
+            ],
+        }

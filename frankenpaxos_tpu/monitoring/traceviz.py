@@ -32,6 +32,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 DEVICE_PID = 1
 HOST_PID = 2
+# Fleet serving: one Perfetto track GROUP (process) per fleet
+# instance, pids FLEET_PID0, FLEET_PID0+1, ... — the per-instance
+# lanes that carry the control plane's alarm/clamp/clear instant
+# markers (FleetServeLoop, harness/serve.py).
+FLEET_PID0 = 100
 
 
 class TickClock:
@@ -147,6 +152,53 @@ def device_span_events(
                     "dur": max(clock.to_us(t1) - u0, 1.0),
                 }
             )
+    return events
+
+
+def fleet_metadata_events(n: int) -> List[dict]:
+    """Process-name metadata for ``n`` per-instance track groups
+    (pid = FLEET_PID0 + i) — Perfetto renders each fleet instance as
+    its own collapsible group."""
+    return [
+        {
+            "ph": "M",
+            "pid": FLEET_PID0 + i,
+            "name": "process_name",
+            "args": {"name": f"fleet instance {i}"},
+        }
+        for i in range(n)
+    ]
+
+
+def fleet_marker_events(
+    markers: Sequence[Dict],
+    clock: Optional[TickClock] = None,
+) -> List[dict]:
+    """Instant events for the fleet control plane's per-instance
+    marks (``FleetServeLoop.markers``: dicts with ``instance``,
+    ``tick``, ``kind`` in {alarm, clamp, clear} + extras). Each lands
+    on its instance's track group, thread-scoped, at the tick's
+    interpolated wall clock."""
+    clock = clock or TickClock()
+    events: List[dict] = []
+    for m in markers:
+        args = {
+            k: v
+            for k, v in m.items()
+            if k not in ("instance", "tick", "kind")
+        }
+        events.append(
+            {
+                "name": str(m["kind"]),
+                "cat": "fleet-control",
+                "ph": "i",
+                "s": "t",
+                "pid": FLEET_PID0 + int(m["instance"]),
+                "tid": 0,
+                "ts": clock.to_us(int(m["tick"])),
+                "args": args,
+            }
+        )
     return events
 
 

@@ -416,6 +416,7 @@ def fleet_states(
     rates=None,
     fault_rates=None,
     module=None,
+    base=None,
 ):
     """``n`` independent instances of the backend's fresh state as ONE
     pytree with a leading instance axis on every leaf (the fleet-state
@@ -430,9 +431,12 @@ def fleet_states(
 
     ``module`` overrides the sharding-registry lookup with an explicit
     ``tpu/*_batched`` module — how ``simtest.run_fleet`` builds bricks
-    for backends outside the registry (mesh=None runs need no specs)."""
+    for backends outside the registry (mesh=None runs need no specs).
+    ``base`` overrides the fresh ``init_state(cfg)`` template — how the
+    fleet serve loop installs a SIZED telemetry ring (and span
+    reservoir) on every instance before broadcasting."""
     mod = module if module is not None else SHARDINGS[backend].mod()
-    base = mod.init_state(cfg)
+    base = base if base is not None else mod.init_state(cfg)
     states = jax.tree_util.tree_map(
         lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), base
     )
@@ -643,6 +647,31 @@ def place_fleet_keys(keys, mesh: Optional[Mesh]):
     if mesh is None:
         return keys
     return jax.device_put(keys, NamedSharding(mesh, P(FLEET_AXIS)))
+
+
+def set_fleet_rates(states, rates, mesh: Optional[Mesh] = None):
+    """Per-instance admission control for a fleet brick: install a new
+    ``[n]`` vector of traced offered rates (the fleet-sharded twin of
+    ``workload.set_rate``) — clamping instance i's admission never
+    touches its siblings, and because the rate is STATE the same
+    compiled fleet executable keeps running (the jit-cache-flat
+    contract the ``trace-fleet-drain-nosync`` rule pins). Under a
+    product mesh the vector is placed fleet-sharded so the next
+    ``run_ticks_fleet`` call presents the SAME input sharding (a
+    replicated host array would silently recompile)."""
+    wls = getattr(states, "workload", None)
+    assert wls is not None and wls.rate.ndim == 1, (
+        "set_fleet_rates needs a fleet state with per-instance traced "
+        "rates (a shaped WorkloadPlan)"
+    )
+    n = wls.rate.shape[0]
+    arr = jnp.asarray(rates, jnp.float32)
+    assert arr.shape == (n,), (arr.shape, n)
+    if mesh is not None:
+        arr = jax.device_put(arr, NamedSharding(mesh, P(FLEET_AXIS)))
+    return dataclasses.replace(
+        states, workload=dataclasses.replace(wls, rate=arr)
+    )
 
 
 def run_ticks_fleet(

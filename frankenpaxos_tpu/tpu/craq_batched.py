@@ -81,6 +81,7 @@ from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Write slot status.
@@ -573,6 +574,10 @@ def tick(
         )
 
     # ---- 4. New writes into empty ring slots (CraqClient.write -> head).
+    # Ring slots the chain plane retired THIS tick (head ack arrived):
+    # captured before new issues overwrite the status — the span
+    # sampler's "executed" stage below.
+    w_retired = (state.w_status != W_EMPTY) & (w_status == W_EMPTY)
     empty_w = w_status == W_EMPTY
     rank_w = jnp.cumsum(empty_w.astype(jnp.int32), axis=1)
     # Workload admission (tpu/workload.py): under a shaping plan the
@@ -616,6 +621,30 @@ def tick(
         queue_capacity=N * W,
         lat_hist_delta=write_lat_hist - state.write_lat_hist,
     )
+
+    # Span sampler (telemetry.record_spans — the generic plumbing, PR
+    # 10): write lifecycles through the chain, recorded from the masks
+    # this tick already computed. Mapping: group = chain, ring pos =
+    # write slot, slot id = the per-chain monotone VERSION (stable for
+    # a write's whole life; a retire + re-issue in one tick carries the
+    # new version via new_slot_ids). Stages: proposed = issued at the
+    # client, phase2_voted = committed = the tail apply (the chain's
+    # commit point), executed = the head ack retiring the slot (>= one
+    # hop later, so executed > committed always). No phase-1 plane on a
+    # chain. Structurally OFF at spans=0 (the serve loop sizes the
+    # reservoir), like every other backend.
+    if telemetry_mod.span_slots(tel):
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=issue_w,
+            slot_ids=state.w_version,
+            new_slot_ids=w_version,
+            phase1_mark=jnp.zeros((N,), bool),
+            voted=at_tail,
+            newly_chosen=at_tail,
+            retire_mask=w_retired,
+        )
 
     return BatchedCraqState(
         w_status=w_status,

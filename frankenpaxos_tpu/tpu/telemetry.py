@@ -357,6 +357,211 @@ def record_spans(
 
 
 # ---------------------------------------------------------------------------
+# Fleet axis: per-instance views + the in-graph summary reduction.
+# ---------------------------------------------------------------------------
+# A FLEET telemetry pytree is an ordinary Telemetry whose every leaf
+# carries one LEADING instance axis ([F], [F, K, cols], ...) — exactly
+# what ``parallel.sharding.fleet_states`` broadcasts and
+# ``run_ticks_fleet`` carries through the vmapped scan. Host drains
+# slice it per instance (:func:`instance_view`) so every single-
+# instance code path below works unchanged; the in-graph
+# :func:`fleet_summary` reduces each instance's ring window to a small
+# fixed summary vector + a straggler flag, so a fleet serve loop can
+# pull O(F) scalars per chunk instead of F full rings.
+
+# Columns of the per-instance summary vector ``fleet_summary`` emits.
+# All int32 (commit rate in x1000 fixed point), so summaries are
+# bit-deterministic across hosts and mesh shapes.
+FLEET_SUMMARY_COLS = (
+    "ticks",  # cumulative ticks recorded
+    "window_ticks",  # ring window the rates below cover (min(ticks, K))
+    "commits",  # commits in the window
+    "commit_rate_x1000",  # commits/tick over the window, x1000
+    "rotations",  # lifecycle window rolls in the window
+    "p50_commit_latency",  # cumulative-hist percentiles (bins; -1 empty)
+    "p99_commit_latency",
+    "p50_queue_wait",
+    "p99_queue_wait",
+    "shed",  # cumulative arrivals shed (0 when unshaped)
+    "straggler",  # 1 = flagged by the in-graph outlier test
+)
+NUM_SUMMARY_COLS = len(FLEET_SUMMARY_COLS)
+SUMMARY_COL = {name: i for i, name in enumerate(FLEET_SUMMARY_COLS)}
+
+
+def is_fleet(tel: Telemetry) -> bool:
+    """True when the telemetry carries a leading instance axis (the
+    fleet-state layout: ``ticks`` is [F] instead of a scalar)."""
+    return jnp.ndim(tel.ticks) == 1
+
+
+def fleet_size_of(tel: Telemetry) -> int:
+    assert is_fleet(tel), "not a fleet telemetry (scalar ticks)"
+    return tel.ticks.shape[0]
+
+
+def instance_view(tel: Telemetry, i: int) -> Telemetry:
+    """Instance ``i``'s slice of a fleet telemetry — shaped exactly
+    like a single-instance Telemetry, so every host view (series /
+    summary / DrainCursor) applies unchanged. Works on a fetched
+    (numpy) or device-resident pytree."""
+    return jax.tree_util.tree_map(lambda a: a[i], tel)
+
+
+def _hist_percentile_rows(hist, q_num: int, q_den: int):
+    """Nearest-rank percentile per ROW of an integer histogram batch
+    ``[F, B]`` (bin index = value), in-graph: ``ceil(q * total)`` rank,
+    -1 on an empty row. Overflow-safe split ceil (totals * q_num can
+    pass int32 on long runs)."""
+    F = hist.shape[0]
+    if hist.ndim != 2 or hist.shape[1] == 0:
+        return jnp.full((F,), -1, jnp.int32)
+    h = hist.astype(jnp.int32)
+    total = jnp.sum(h, axis=1)
+    # ceil(total * q_num / q_den) without the int32 overflow of the
+    # naive product: total = a * q_den + b.
+    a, b = total // q_den, total % q_den
+    rank = jnp.maximum(1, a * q_num + (b * q_num + q_den - 1) // q_den)
+    cum = jnp.cumsum(h, axis=1)
+    idx = jnp.argmax(cum >= rank[:, None], axis=1).astype(jnp.int32)
+    return jnp.where(total > 0, idx, -1)
+
+
+def _int_median(x):
+    """Lower median of an int32 vector (sort + pick) — integer
+    arithmetic end to end, so the straggler test below is
+    bit-deterministic (no float median)."""
+    n = x.shape[0]
+    return jnp.sort(x)[(n - 1) // 2]
+
+
+def fleet_summary(
+    tel: Telemetry,
+    wait_hist=None,
+    shed=None,
+    k_mad: int = 4,
+    expected_rate_x1000: int = 0,
+):
+    """The in-graph fleet reduction: one ``[F, NUM_SUMMARY_COLS]``
+    int32 summary vector per instance from the fleet telemetry (plus
+    the workload gauges), computed ON DEVICE so the host pulls O(F)
+    scalars per drain instead of F full rings.
+
+    Per instance: commits + rotations over the retained ring window
+    (a true XLA segmented reduction over the ``[F, K]`` ring block —
+    the BASELINE aggregation shape), the commit-rate x1000 over that
+    window, and nearest-rank p50/p99 of the cumulative commit-latency
+    and queue-wait histograms.
+
+    Straggler flagging (in-graph, directional): an instance is flagged
+    when its windowed commit rate falls BELOW the fleet median by more
+    than ``k_mad * MAD`` plus a noise floor (an eighth of the median,
+    min 25 x1000-units), or its latency/wait p99 rises ABOVE the
+    fleet median p99 by more than ``k_mad * MAD + 2`` bins — median/
+    MAD, not mean/stddev, so one hostile instance cannot drag the
+    baseline toward itself. ``expected_rate_x1000 > 0`` adds the
+    analytical anchor (the SCALE-Sim-style expected commit rate from
+    config, arxiv 2603.22535): an instance below HALF the anchor is
+    flagged even if the whole fleet sank together (a fleet-wide
+    brownout has no outlier for MAD to see).
+
+    ``wait_hist``/``shed`` are the fleet workload gauges ([F, WB] /
+    [F]; zero-sized or None when the workload engine is off). Pure
+    jnp — jit it (the fleet serve snapshot does) or call it inside a
+    larger program."""
+    assert is_fleet(tel), "fleet_summary needs a leading instance axis"
+    F = fleet_size_of(tel)
+    K = window_of_fleet(tel)
+    assert K > 0, "fleet_summary needs a sized telemetry ring"
+    ticks = tel.ticks.astype(jnp.int32)  # [F]
+    n_win = jnp.minimum(ticks, K)  # [F] valid ring rows
+    # Ring-row validity: before the first wrap, slots [0, ticks) hold
+    # data; afterwards every slot does.
+    slot_valid = (
+        jnp.arange(K, dtype=jnp.int32)[None, :] < n_win[:, None]
+    )  # [F, K]
+    seg_ids = jnp.broadcast_to(
+        jnp.arange(F, dtype=jnp.int32)[:, None], (F, K)
+    ).ravel()
+
+    def window_sum(col: str):
+        vals = jnp.where(
+            slot_valid, tel.counters[:, :, COL[col]], 0
+        ).ravel()
+        return jax.ops.segment_sum(vals, seg_ids, num_segments=F)
+
+    commits = window_sum("commits")
+    rotations = window_sum("rotations")
+    denom = jnp.maximum(n_win, 1)
+    rate = commits * 1000 // denom  # commit_rate_x1000
+
+    p50_lat = _hist_percentile_rows(tel.lat_hist, 50, 100)
+    p99_lat = _hist_percentile_rows(tel.lat_hist, 99, 100)
+    if wait_hist is not None and wait_hist.ndim == 2 and (
+        wait_hist.shape[1] > 0
+    ):
+        p50_wait = _hist_percentile_rows(wait_hist, 50, 100)
+        p99_wait = _hist_percentile_rows(wait_hist, 99, 100)
+    else:
+        p50_wait = jnp.full((F,), -1, jnp.int32)
+        p99_wait = jnp.full((F,), -1, jnp.int32)
+    if shed is not None and shed.ndim == 1 and shed.shape[0] == F:
+        shed_col = shed.astype(jnp.int32)
+    else:
+        shed_col = jnp.zeros((F,), jnp.int32)
+
+    # -- straggler test: median/MAD deviation, directional.
+    med_r = _int_median(rate)
+    mad_r = _int_median(jnp.abs(rate - med_r))
+    floor_r = jnp.maximum(med_r // 8, 25)
+    low_rate = (med_r - rate) > (k_mad * mad_r + floor_r)
+
+    def high_tail(p):
+        med = _int_median(p)
+        mad = _int_median(jnp.abs(p - med))
+        return (p - med) > (k_mad * mad + 2)
+
+    straggler = low_rate | high_tail(p99_lat) | high_tail(p99_wait)
+    if expected_rate_x1000 > 0:
+        straggler = straggler | (rate < expected_rate_x1000 // 2)
+
+    return jnp.stack(
+        [
+            ticks,
+            n_win,
+            commits,
+            rate,
+            rotations,
+            p50_lat,
+            p99_lat,
+            p50_wait,
+            p99_wait,
+            shed_col,
+            straggler.astype(jnp.int32),
+        ],
+        axis=1,
+    )
+
+
+def window_of_fleet(tel: Telemetry) -> int:
+    """The ring size K of a fleet telemetry (axis 1 — axis 0 is the
+    instance axis)."""
+    assert is_fleet(tel)
+    return tel.counters.shape[1]
+
+
+def summary_row_dict(row) -> dict:
+    """One instance's summary vector as a name -> int dict (the host
+    report / scrape-CSV shape)."""
+    import numpy as np
+
+    row = np.asarray(row)
+    return {
+        name: int(row[i]) for i, name in enumerate(FLEET_SUMMARY_COLS)
+    }
+
+
+# ---------------------------------------------------------------------------
 # Host side: one coalesced transfer, then pure-numpy views.
 # ---------------------------------------------------------------------------
 
@@ -432,21 +637,60 @@ class DrainCursor:
     telemetry snapshot through one of these while the next chunk
     computes — the cursor is what makes chunked drains sum to exactly
     the one-shot capture (pinned bit-identical by
-    ``tests/test_serve.py``)."""
+    ``tests/test_serve.py``).
+
+    FLEET telemetry (a leading instance axis, :func:`is_fleet`) drains
+    through the SAME cursor: the first fleet drain grows one
+    sub-cursor per instance and every drain slices the fetched pytree
+    per instance through the unchanged single-instance path — chunked
+    fleet drains are therefore bit-identical to sequential
+    per-instance drains BY CONSTRUCTION (and pinned so by
+    ``tests/test_fleet.py``). The fleet result is
+    ``{"fleet": F, "instances": [per-instance drain dicts],
+    "ticks_total", "dropped_ticks", "dropped_spans"}`` with the
+    aggregates summed over instances."""
 
     def __init__(self, tick: int = 0, span: int = 0):
         self.tick = int(tick)
         self.span = int(span)
+        self._fleet: Optional[List["DrainCursor"]] = None
+
+    def _drain_fleet(self, tel: Telemetry) -> dict:
+        """One coalesced pull already happened (``tel`` is fetched);
+        slice per instance and drain each through its own sub-cursor."""
+        F = fleet_size_of(tel)
+        if self._fleet is None:
+            self._fleet = [
+                DrainCursor(self.tick, self.span) for _ in range(F)
+            ]
+        assert len(self._fleet) == F, (
+            f"fleet width changed mid-cursor: {len(self._fleet)} -> {F}"
+        )
+        insts = [
+            self._fleet[i].drain(instance_view(tel, i))
+            for i in range(F)
+        ]
+        return {
+            "fleet": F,
+            "instances": insts,
+            "ticks_total": max(d["ticks_total"] for d in insts),
+            "dropped_ticks": sum(d["dropped_ticks"] for d in insts),
+            "dropped_spans": sum(d["dropped_spans"] for d in insts),
+        }
 
     def drain(self, tel: Telemetry) -> dict:
         """Drain everything recorded since the last call. ``tel`` may
         be device-resident (one coalesced pull happens here) or already
         fetched (e.g. a serve-loop snapshot). Returns per-tick series
         for the new ticks, the new completed spans, the cumulative
-        totals at this drain point, and drop counts for ring overruns."""
+        totals at this drain point, and drop counts for ring overruns.
+        Fleet telemetry returns the per-instance form (class
+        docstring)."""
         import numpy as np
 
         tel = jax.device_get(tel)
+        if is_fleet(tel):
+            return self._drain_fleet(tel)
         K = tel.counters.shape[0]
         total = int(tel.ticks)
         n = total - self.tick

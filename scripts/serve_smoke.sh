@@ -58,6 +58,41 @@ print(
 )
 EOF
 
+# Fleet-serve leg: a bounded 4-instance FLEET brick through
+# FleetServeLoop (per-instance telemetry drains, in-graph straggler
+# flags, per-instance SLO clamps) with one hostile instance — clean
+# shutdown, non-empty per-instance scrape rows, the straggler column
+# present, and only the hostile instance flagged.
+JAX_PLATFORMS=cpu python -m frankenpaxos_tpu.harness.serve \
+  --fleet 4 --seconds "${SERVE_SMOKE_SECONDS:-10}" \
+  --out-dir "$OUT/fleet" --chunk 16 --rate-x 0.9 --slo-p99 8 \
+  --hostile-instance 2 --hostile-drop 0.6 \
+  > "$OUT/fleet_report_line.json"
+
+JAX_PLATFORMS=cpu python - "$OUT/fleet" <<'EOF'
+import csv, json, os, sys
+
+out = sys.argv[1]
+report = json.load(open(os.path.join(out, "fleet_report.json")))
+assert report["clean_shutdown"], report
+assert report["ticks"] > 0, report
+assert report["dropped_ticks"] == 0, report
+assert report["stragglers_flagged"] == [2], report["stragglers_flagged"]
+with open(os.path.join(out, "fleet_metrics.csv")) as f:
+    rows = list(csv.DictReader(f))
+insts = {r["instance"] for r in rows if r["job"] == "fleet"}
+assert insts == {"0", "1", "2", "3"}, insts  # per-instance rows
+strag = [r for r in rows if r["name"] == "fpx_fleet_straggler"]
+assert strag, "straggler column missing from the scrape CSV"
+assert any(
+    float(r["value"]) == 1.0 and r["instance"] == "2" for r in strag
+), "hostile instance never hit the straggler lane"
+print(
+    "fleet smoke OK:", report["ticks"], "ticks,",
+    len(strag), "straggler samples, scales", report["slo"]["scales"]
+)
+EOF
+
 # Kill-and-recover leg: SIGKILL the serve worker mid-run at a
 # randomized chunk boundary, restart from the newest valid checkpoint,
 # and verify liveness + invariants + exactly-once books + a final
@@ -73,6 +108,10 @@ RULES=$(python -m frankenpaxos_tpu.analysis --list)
 echo "$RULES" | grep trace-serve-nosync >/dev/null
 echo "$RULES" | grep checkpoint-alias-free >/dev/null
 echo "$RULES" | grep trace-checkpoint-restore >/dev/null
+echo "$RULES" | grep trace-fleet-drain-nosync >/dev/null
+# lint.sh forces the 8-virtual-device product mesh, so the fleet rule
+# runs its full census here even on single-device hosts.
 scripts/lint.sh --rule trace-serve-nosync \
-  --rule checkpoint-alias-free --rule trace-checkpoint-restore
+  --rule checkpoint-alias-free --rule trace-checkpoint-restore \
+  --rule trace-fleet-drain-nosync
 echo "serve_smoke: PASS"

@@ -36,6 +36,12 @@ TRACE_RULES = [
     # re-sweeps) and no signed collective crosses the fleet axis;
     # no-op for backends outside the sharding registry.
     "trace-fleet-onecompile",
+    # Fleet serve hot path: run_ticks_fleet + the fleet snapshot (with
+    # the in-graph summary) compile callback-free, the snapshot
+    # aliases nothing, summary collectives stay summary-sized, and a
+    # per-instance SLO clamp re-entry keeps the runner's jit cache
+    # flat; no-op for every backend except the flagship serve target.
+    "trace-fleet-drain-nosync",
 ]
 
 
@@ -199,3 +205,50 @@ def test_fused_tick_rule_has_teeth():
     )
     eqns = rules_trace._tick_eqns("multipaxos", cfg)
     assert rules_trace._count_pallas_calls(eqns) == 2
+
+
+def test_fleet_drain_nosync_rule_clean():
+    """The fleet serve chunk path (run_ticks_fleet + the jitted fleet
+    snapshot with the in-graph summary) compiles free of host
+    callbacks, the snapshot aliases nothing, the summary reduction
+    moves nothing state-sized across the fleet axis, and a per-
+    instance clamp re-entry keeps the fleet runner's jit cache flat."""
+    report = core.run(rule_ids=["trace-fleet-drain-nosync"])
+    assert not report.findings, "\n" + report.format()
+
+
+def test_fleet_drain_nosync_rule_has_teeth(monkeypatch):
+    """Simulate the regression the alias check exists for: a fleet
+    snapshot that DONATES its input aliases the output buffers — the
+    drain would read memory the next chunk's donation reused — and the
+    rule must flag it."""
+    import functools
+
+    import jax
+
+    from frankenpaxos_tpu.harness import serve as serve_mod
+    from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+
+    def donated_snap_fn(k_mad, expected_x1000, rings):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def snap(leaves):
+            tel = leaves["telemetry"]
+            return {
+                "summary": telemetry_mod.fleet_summary(
+                    tel,
+                    wait_hist=leaves["wait_hist"],
+                    shed=leaves["shed"],
+                ),
+                "telemetry": tel,
+            }
+
+        return snap
+
+    monkeypatch.setattr(serve_mod, "_fleet_snap_fn", donated_snap_fn)
+    report = core.run(
+        rule_ids=["trace-fleet-drain-nosync"],
+        ctx=core.Context(backends=("multipaxos",)),
+    )
+    assert any("ALIASES" in f.message for f in report.findings), (
+        report.format()
+    )

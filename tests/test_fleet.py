@@ -338,3 +338,213 @@ def test_single_instance_rejects_fleet_axis(fleet_mesh):
         jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(ust)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Fleet observability: DrainCursor under the fleet axis + fleet_summary
+# ---------------------------------------------------------------------------
+
+from frankenpaxos_tpu.tpu import telemetry as T  # noqa: E402
+
+
+def _telemetry_brick(cfg, n=4, window=32, rates=None, frates=None):
+    """A fleet brick whose every instance carries a SIZED telemetry
+    ring (the fleet serve layout: fleet_states with a base template)."""
+    base = dataclasses.replace(
+        mb.init_state(cfg), telemetry=T.make_telemetry(window)
+    )
+    return sh.fleet_states(
+        "multipaxos", cfg, n,
+        rates=RATES[:n] if rates is None else rates,
+        fault_rates=FRATES[:n] if frates is None else frates,
+        base=base,
+    )
+
+
+def _run_fleet_chunks(cfg, states, mesh, chunks, chunk_ticks, seeds):
+    """The fleet serve dispatch shape: per-chunk run_ticks_fleet with
+    per-chunk vmapped fold_in keys — instance i replays exactly the
+    single-instance serve chunking of seed i."""
+    base_keys = sh.place_fleet_keys(sh.fleet_keys(seeds), mesh)
+    t = jnp.zeros((), jnp.int32)
+    for e in range(chunks):
+        keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            base_keys, e
+        )
+        states, t = sh.run_ticks_fleet(
+            "multipaxos", cfg, mesh, states, t, chunk_ticks, keys
+        )
+        yield states
+
+
+def _drain_rows(d):
+    """The comparable payload of one single-instance drain dict."""
+    return (
+        d["tick"].tolist(),
+        {k: d[k].tolist() for k in T.COUNTER_FIELDS},
+        d["totals"],
+        d["lat_hist"].tolist(),
+        d["dropped_ticks"],
+    )
+
+
+@pytest.mark.parametrize("seed_base", [0, 7, 21])
+def test_fleet_drain_chunked_equals_one_shot_and_sequential(
+    seed_base, fleet_mesh
+):
+    """The fleet drain exactness contract, kernels ENGAGED: chunked
+    fleet drains are bit-identical PER INSTANCE to (a) a one-shot
+    capture of the identical fleet run, (b) sequential per-config
+    single-instance runs drained at the same chunk boundaries, and
+    (c) the same brick on the transposed (4, 2) mesh — 3 seeds."""
+    cfg = _traced_cfg(kernels=KernelPolicy(mode="interpret"))
+    CH, NCH, W = 13, 3, 32
+    seeds = [seed_base + i for i in range(4)]
+
+    def chunked_drains(mesh):
+        states = _telemetry_brick(cfg, window=W)
+        if mesh is not None:
+            states = sh.shard_fleet_state("multipaxos", states, mesh)
+        cur = T.DrainCursor()
+        per_inst = [[] for _ in range(4)]
+        for states in _run_fleet_chunks(cfg, states, mesh, NCH, CH, seeds):
+            d = cur.drain(states.telemetry)
+            assert d["fleet"] == 4 and d["dropped_ticks"] == 0
+            for i, di in enumerate(d["instances"]):
+                per_inst[i].append(_drain_rows(di))
+        return per_inst, states
+
+    chunked, final_states = chunked_drains(fleet_mesh)
+
+    # (a) One-shot capture of the identical run.
+    for states in _run_fleet_chunks(
+        cfg,
+        sh.shard_fleet_state(
+            "multipaxos", _telemetry_brick(cfg, window=W), fleet_mesh
+        ),
+        fleet_mesh, NCH, CH, seeds,
+    ):
+        pass
+    one_shot = T.DrainCursor().drain(states.telemetry)
+    for i in range(4):
+        assert (
+            chunked[i][-1][2] == one_shot["instances"][i]["totals"]
+        ), i
+        # Every tick seen exactly once across the chunked drains.
+        ticks = [t for rows in chunked[i] for t in rows[0]]
+        assert ticks == list(range(NCH * CH)), i
+
+    # (b) Sequential per-config single-instance runs, same chunking,
+    # drained at the same boundaries — bit-identical rows per chunk.
+    for i, seed in enumerate(seeds):
+        st = dataclasses.replace(
+            _seq_state(cfg, RATES[i], FRATES[i]),
+            telemetry=T.make_telemetry(W),
+        )
+        t = jnp.zeros((), jnp.int32)
+        cur = T.DrainCursor()
+        key = jax.random.PRNGKey(seed)
+        for e in range(NCH):
+            st, t = mb.run_ticks(
+                cfg, st, t, CH, jax.random.fold_in(key, e)
+            )
+            d = cur.drain(st.telemetry)
+            assert _drain_rows(d) == chunked[i][e], (i, e)
+
+    # (c) Mesh-shape agnosticism: the transposed product mesh.
+    chunked_t, _ = chunked_drains(sh.make_fleet_mesh(fleet=4))
+    assert chunked_t == chunked
+
+
+def test_fleet_drain_overrun_honest_per_instance():
+    """A fleet drain slower than the ring period reports the overrun
+    PER INSTANCE in dropped_ticks and returns only the retained rows —
+    never double-counted across instances or drains."""
+    cfg = _traced_cfg()
+    W, TICKS = 16, 40
+    states = _telemetry_brick(cfg, window=W)
+    states, _ = sh.run_ticks_fleet(
+        "multipaxos", cfg, None, states, jnp.zeros((), jnp.int32),
+        TICKS, sh.fleet_keys(range(4)),
+    )
+    cur = T.DrainCursor()
+    d = cur.drain(states.telemetry)
+    assert d["dropped_ticks"] == 4 * (TICKS - W)
+    for di in d["instances"]:
+        assert di["ticks_total"] == TICKS
+        assert di["dropped_ticks"] == TICKS - W
+        assert di["tick"].tolist() == list(range(TICKS - W, TICKS))
+    # A second drain sees nothing new (no double count).
+    d2 = cur.drain(states.telemetry)
+    assert d2["dropped_ticks"] == 0
+    for di in d2["instances"]:
+        assert di["tick"].tolist() == []
+
+
+def test_fleet_summary_flags_only_the_hostile_instance():
+    """The in-graph straggler test on a HOMOGENEOUS fleet: identical
+    plan rates, one instance with a hostile traced drop rate — the
+    summary flags it (and only it), and the summary columns carry the
+    windowed commit rate + histogram percentiles."""
+    cfg = _traced_cfg()
+    n = 4
+    rate = 2.0
+    frates = [[0.0, 0.0, 0.0, 0.0] for _ in range(n)]
+    frates[2][0] = 0.6
+    states = _telemetry_brick(
+        cfg, n=n, window=64, rates=[rate] * n, frates=frates
+    )
+    states, _ = sh.run_ticks_fleet(
+        "multipaxos", cfg, None, states, jnp.zeros((), jnp.int32), 60,
+        sh.fleet_keys(range(n)),
+    )
+    s = np.asarray(T.fleet_summary(
+        states.telemetry,
+        wait_hist=states.workload.wait_hist,
+        shed=states.workload.shed,
+    ))
+    col = T.SUMMARY_COL
+    assert s.shape == (n, T.NUM_SUMMARY_COLS)
+    assert [int(x) for x in s[:, col["straggler"]]] == [0, 0, 1, 0]
+    assert all(s[:, col["ticks"]] == 60)
+    assert all(s[:, col["window_ticks"]] == 60)
+    # The hostile instance's p99 exceeds its siblings'.
+    p99 = s[:, col["p99_commit_latency"]]
+    assert p99[2] > max(p99[i] for i in (0, 1, 3))
+    # The analytical anchor: an expected rate far above everyone flags
+    # the whole fleet (a fleet-wide brownout has no MAD outlier).
+    s2 = np.asarray(T.fleet_summary(
+        states.telemetry,
+        wait_hist=states.workload.wait_hist,
+        shed=states.workload.shed,
+        expected_rate_x1000=10_000_000,
+    ))
+    assert all(s2[:, col["straggler"]] == 1)
+
+
+def test_set_fleet_rates_applies_per_instance_without_recompile():
+    """sharding.set_fleet_rates: the clamp vector lands per instance
+    (sibling rates untouched) and the SAME fleet executable keeps
+    running — the jit cache stays flat across the clamp."""
+    cfg = _traced_cfg()
+    states = _brick(cfg)
+    t0 = jnp.zeros((), jnp.int32)
+    keys = sh.fleet_keys(range(4))
+    runner = sh._fleet_runner("multipaxos", None, None)
+    states, t = sh.run_ticks_fleet(
+        "multipaxos", cfg, None, states, t0, 6, keys
+    )
+    jax.block_until_ready(states.committed)
+    before = runner._cache_size()
+    states = sh.set_fleet_rates(states, [0.5, 0.05, 1.5, 2.0])
+    np.testing.assert_allclose(
+        np.asarray(states.workload.rate), [0.5, 0.05, 1.5, 2.0]
+    )
+    states, _ = sh.run_ticks_fleet(
+        "multipaxos", cfg, None, states, t, 6,
+        jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys),
+    )
+    jax.block_until_ready(states.committed)
+    assert runner._cache_size() == before, "clamp recompiled"
+    with pytest.raises(AssertionError, match="fleet state"):
+        sh.set_fleet_rates(mb.init_state(cfg), [1.0])

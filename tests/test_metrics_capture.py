@@ -113,3 +113,68 @@ def test_dashboard_renders(tmp_path):
         assert os.path.getsize(out) > 1000
     finally:
         server.shutdown()
+
+
+def test_v1_capture_without_instance_column_parses_as_instance_0(tmp_path):
+    """CSV schema versioning (scrape.CSV_SCHEMA_VERSION): a v1 capture
+    — no ``instance`` column — round-trips through MetricsCapture with
+    every sample on instance 0, so pre-fleet captures keep answering
+    queries (and ``dashboard --live`` keeps rendering) unchanged."""
+    from frankenpaxos_tpu.monitoring.scrape import (
+        MetricsCapture,
+        instance_index,
+    )
+
+    path = tmp_path / "old_metrics.csv"
+    path.write_text(
+        "ts,job,name,labels,value\n"
+        "1000.0,device,fpx_device_commits_total,,5\n"
+        "1001.0,device,fpx_device_commits_total,,11\n"
+    )
+    cap = MetricsCapture(str(path))
+    assert set(cap.df["instance"]) == {"0"}
+    wide = cap.query("fpx_device_commits_total")
+    assert len(wide) == 2
+    assert cap.total("fpx_device_commits_total") == 11.0
+    # The fleet dashboard's instance mapping: numeric strings are fleet
+    # rows, every legacy name is instance 0.
+    assert instance_index("3") == 3
+    assert instance_index("serve") == 0
+    assert instance_index("127.0.0.1:9090") == 0
+    assert instance_index(None) == 0
+
+
+def test_v2_fleet_summary_rows_round_trip(tmp_path):
+    """append_fleet_summary writes the v2 schema (instance = fleet row
+    index) and MetricsCapture pivots it back per instance."""
+    from frankenpaxos_tpu.monitoring.scrape import (
+        CSV_COLUMNS,
+        MetricsCapture,
+        append_fleet_summary,
+    )
+
+    path = str(tmp_path / "fleet.csv")
+    rows = [
+        {
+            "commit_rate_x1000": 1000 * (i + 1),
+            "p50_commit_latency": 2,
+            "p99_commit_latency": 4 + i,
+            "p50_queue_wait": 0,
+            "p99_queue_wait": i,
+            "shed": 0,
+            "rotations": 0,
+            "straggler": int(i == 2),
+        }
+        for i in range(3)
+    ]
+    n = append_fleet_summary(path, rows, ts=1000.0, scales=[1.0, 1.0, 0.5])
+    assert n == 3 * 9
+    with open(path) as f:
+        header = f.readline().strip().split(",")
+    assert header == CSV_COLUMNS
+    cap = MetricsCapture(path)
+    strag = cap.query("fpx_fleet_straggler")
+    assert set(strag.columns) == {"0{}", "1{}", "2{}"}
+    assert float(strag["2{}"].iloc[0]) == 1.0
+    scale = cap.query("fpx_fleet_admission_scale")
+    assert float(scale["2{}"].iloc[0]) == 500.0

@@ -494,3 +494,306 @@ def test_serve_smoke_script_and_bench_mode_exist():
     assert "harness.serve" in src and "trace-serve-nosync" in src
     bench_src = (repo / "bench.py").read_text()
     assert '"--serve"' in bench_src and "--inner-serve" in bench_src
+
+
+# ---------------------------------------------------------------------------
+# Fleet serving (FleetServeLoop)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(num_groups=8, rate=2.0, **kw):
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    return mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=num_groups, window=16, slots_per_tick=2,
+        retry_timeout=8,
+        workload=WorkloadPlan(
+            arrival="constant", rate=rate, backlog_cap=256
+        ),
+        faults=FaultPlan(traced=True),
+        **kw,
+    )
+
+
+def test_fleet_serve_loop_matches_manual_chunked_run():
+    """The fleet serve loop is OBSERVABILITY only: its chunked
+    dispatch replays the exact same fleet program as manual
+    run_ticks_fleet segments with the same vmapped fold_in keys —
+    per-instance committed totals and telemetry are bit-identical, and
+    the drains saw every tick of every instance exactly once."""
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop,
+    )
+    from frankenpaxos_tpu.parallel import sharding as sh
+
+    cfg = _fleet_cfg()
+    n, CH, NCH = 4, 10, 4
+    rates = [2.0] * n
+    frates = [[0.0] * 4] * n
+    loop = FleetServeLoop(
+        "multipaxos", cfg,
+        FleetServeConfig(chunk_ticks=CH, telemetry_window=32,
+                         max_chunks=NCH),
+        n, seeds=[5 + i for i in range(n)], rates=rates,
+        fault_rates=frates,
+    )
+    report = loop.run()
+    assert report["clean_shutdown"] and report["ticks"] == NCH * CH
+    assert report["dropped_ticks"] == 0
+
+    base = dataclasses.replace(
+        mp.init_state(cfg), telemetry=T.make_telemetry(32)
+    )
+    states = sh.fleet_states(
+        "multipaxos", cfg, n, rates=rates, fault_rates=frates,
+        base=base,
+    )
+    keys = sh.fleet_keys([5 + i for i in range(n)])
+    t = jnp.zeros((), jnp.int32)
+    for e in range(NCH):
+        kk = jax.vmap(jax.random.fold_in, in_axes=(0, None))(keys, e)
+        states, t = sh.run_ticks_fleet(
+            "multipaxos", cfg, None, states, t, CH, kk
+        )
+    np.testing.assert_array_equal(
+        np.asarray(states.committed), np.asarray(loop.states.committed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(states.telemetry.totals),
+        np.asarray(loop.states.telemetry.totals),
+    )
+
+
+def test_fleet_serve_hot_path_single_wait(monkeypatch):
+    """The fleet no-blocking spy: block_until_ready runs exactly once
+    (at shutdown, on the fleet state), and every hot-path device_get
+    touches only snapshot-sized pytrees, never the protocol state."""
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop,
+    )
+
+    gets, waits = [], []
+    real_get = jax.device_get
+    real_wait = jax.block_until_ready
+
+    def spy_get(tree):
+        assert not isinstance(tree, mp.BatchedMultiPaxosState), (
+            "fleet loop pulled the full protocol state"
+        )
+        gets.append(
+            sum(
+                getattr(a, "nbytes", 0)
+                for a in jax.tree_util.tree_leaves(tree)
+            )
+        )
+        return real_get(tree)
+
+    def spy_wait(tree):
+        assert isinstance(tree, mp.BatchedMultiPaxosState)
+        waits.append(1)
+        return real_wait(tree)
+
+    monkeypatch.setattr(jax, "device_get", spy_get)
+    monkeypatch.setattr(jax, "block_until_ready", spy_wait)
+
+    cfg = _fleet_cfg(num_groups=32)
+    n = 4
+    loop = FleetServeLoop(
+        "multipaxos", cfg,
+        FleetServeConfig(chunk_ticks=8, telemetry_window=32,
+                         max_chunks=5),
+        n, rates=[2.0] * n, fault_rates=[[0.0] * 4] * n,
+    )
+    report = loop.run()
+    assert report["clean_shutdown"]
+    assert waits == [1], "hot path must wait exactly once, at shutdown"
+    state_bytes = sum(
+        a.nbytes for a in jax.tree_util.tree_leaves(loop.states)
+    )
+    assert gets and max(gets) < state_bytes / 4
+
+
+def test_fleet_serve_hostile_instance_flagged_clamped_siblings_flat():
+    """The differential-failure loop end to end: a homogeneous fleet
+    below saturation, ONE instance on a hostile traced drop rate — the
+    in-graph summary flags it (and only it), its per-instance SLO
+    alarm clamps it (and only it) through the fleet-sharded traced
+    rate with the jit cache FLAT, and every sibling's p99 stays within
+    target."""
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop,
+    )
+    from frankenpaxos_tpu.parallel import sharding as sh
+
+    cfg = _fleet_cfg(num_groups=16, rate=1.8)
+    n, HOSTILE = 4, 2
+    frates = [[0.0] * 4 for _ in range(n)]
+    frates[HOSTILE][0] = 0.6
+    loop = FleetServeLoop(
+        "multipaxos", cfg,
+        FleetServeConfig(
+            chunk_ticks=16, telemetry_window=32,
+            slo=SloPolicy(p99_target_ticks=8, source="queue_wait"),
+            max_chunks=10,
+        ),
+        n, rates=[1.8] * n, fault_rates=frates,
+    )
+    runner = sh._fleet_runner(
+        "multipaxos", None,
+        sh._fleet_wrap_mesh("multipaxos", cfg, None),
+    )
+    # Delta-based: the runner is lru-cached per (backend, mesh), so
+    # other tests in this process may already hold entries; this run
+    # may add AT MOST its own one compile (chunk length), and the SLO
+    # clamps inside it must add none.
+    cache0 = runner._cache_size()
+    report = loop.run()
+    assert report["stragglers_flagged"] == [HOSTILE], report["summary"]
+    scales = report["slo"]["scales"]
+    assert scales[HOSTILE] < 1.0
+    assert all(
+        s == 1.0 for i, s in enumerate(scales) if i != HOSTILE
+    ), scales
+    for i, row in enumerate(report["summary"]):
+        if i != HOSTILE:
+            assert row["p99_queue_wait"] <= 8, (i, row)
+    # Alarm + clamp markers landed on the hostile instance's lane only.
+    kinds = {(m["instance"], m["kind"]) for m in report["markers"]}
+    assert (HOSTILE, "alarm") in kinds and (HOSTILE, "clamp") in kinds
+    assert all(m["instance"] == HOSTILE for m in report["markers"])
+    assert runner._cache_size() <= cache0 + 1, (
+        "control plane recompiled"
+    )
+
+
+def test_fleet_trace_and_csv_carry_per_instance_lanes(tmp_path):
+    """Presentation plumbing: the Perfetto export carries one track
+    group per instance with the control plane's instant markers, and
+    the scrape CSV carries per-instance summary rows (straggler lane
+    included) that the --fleet dashboard pivots."""
+    import csv as _csv
+
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop,
+    )
+    from frankenpaxos_tpu.monitoring import dashboard
+    from frankenpaxos_tpu.monitoring.scrape import MetricsCapture
+
+    cfg = _fleet_cfg(num_groups=16, rate=1.8)
+    n, HOSTILE = 4, 1
+    frates = [[0.0] * 4 for _ in range(n)]
+    frates[HOSTILE][0] = 0.6
+    csv_path = str(tmp_path / "fleet_metrics.csv")
+    trace_path = str(tmp_path / "fleet_trace.json")
+    loop = FleetServeLoop(
+        "multipaxos", cfg,
+        FleetServeConfig(
+            chunk_ticks=16, telemetry_window=32,
+            slo=SloPolicy(p99_target_ticks=8, source="queue_wait"),
+            scrape_csv=csv_path, trace_path=trace_path, max_chunks=8,
+        ),
+        n, rates=[1.8] * n, fault_rates=frates,
+    )
+    loop.run()
+    payload = traceviz.load_chrome_trace(trace_path)
+    events = payload["traceEvents"]
+    group_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M"
+        and str(e["args"].get("name", "")).startswith("fleet instance")
+    }
+    assert group_pids == {traceviz.FLEET_PID0 + i for i in range(n)}
+    marks = [e for e in events if e.get("cat") == "fleet-control"]
+    assert marks and all(
+        e["pid"] == traceviz.FLEET_PID0 + HOSTILE for e in marks
+    )
+    with open(csv_path) as f:
+        rows = list(_csv.DictReader(f))
+    strag = [r for r in rows if r["name"] == "fpx_fleet_straggler"]
+    assert {r["instance"] for r in strag} == {str(i) for i in range(n)}
+    assert any(
+        float(r["value"]) == 1.0 and r["instance"] == str(HOSTILE)
+        for r in strag
+    )
+    # Per-instance device counter rows (the exact-drain CSV half).
+    assert {
+        r["instance"] for r in rows
+        if r["name"] == "fpx_device_commits_total"
+    } == {str(i) for i in range(n)}
+    out = str(tmp_path / "fleet.png")
+    assert dashboard.render_fleet_dashboard(
+        MetricsCapture(csv_path), out
+    ) == out
+    assert os.path.getsize(out) > 0
+
+
+# ---------------------------------------------------------------------------
+# Span sampler on craq (the third spans backend)
+# ---------------------------------------------------------------------------
+
+
+def test_craq_span_sampler_stamps_and_structural_noop():
+    """craq records spans through the generic telemetry plumbing:
+    ordered stage stamps (proposed < tail-apply commit < head-ack
+    execute), spans=0 stays a structural no-op (bit-identical protocol
+    state), and the counter halves agree across both modes."""
+    from frankenpaxos_tpu.tpu import craq_batched as cq
+
+    cfg = cq.analysis_config()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def run(spans):
+        st = dataclasses.replace(
+            cq.init_state(cfg), telemetry=T.make_telemetry(64, spans=spans)
+        )
+        st, _ = cq.run_ticks(cfg, st, t0, 50, key)
+        return st
+
+    on, off = run(8), run(0)
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(on, f.name)),
+            jax.tree_util.tree_leaves(getattr(off, f.name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
+    np.testing.assert_array_equal(
+        np.asarray(on.telemetry.totals), np.asarray(off.telemetry.totals)
+    )
+    spans, dropped, _ = T.completed_spans(on.telemetry)
+    assert spans and dropped == 0
+    for s in spans:
+        assert 0 <= s["proposed"] < s["committed"] <= s["executed"], s
+        assert s["executed"] > s["committed"], s  # head ack >= 1 hop
+        assert s["phase2_voted"] == s["committed"], s  # tail apply
+        assert s["phase1_promised"] == -1, s  # no phase-1 on a chain
+    assert len({s["group"] for s in spans}) > 1
+
+
+def test_craq_serve_perfetto_round_trip(tmp_path):
+    """The serve loop over craq with the span sampler on: the Perfetto
+    export round-trips with DEVICE lifecycle slices (craq spans) and
+    host dispatch spans in one timeline."""
+    from frankenpaxos_tpu.tpu import craq_batched as cq
+
+    cfg = cq.analysis_config()
+    out = tmp_path / "craq_trace.json"
+    serve = ServeConfig(
+        chunk_ticks=16, telemetry_window=64, spans=8,
+        trace_path=str(out), max_chunks=4,
+    )
+    loop = ServeLoop(cq, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["clean_shutdown"] and report["spans_exported"] > 0
+    payload = traceviz.load_chrome_trace(str(out))
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    device = [e for e in xs if e["pid"] == traceviz.DEVICE_PID]
+    host = [e for e in xs if e["pid"] == traceviz.HOST_PID]
+    assert device and host
+    lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
+    assert lifecycles
+    assert all("committed" in e["args"] for e in lifecycles)
