@@ -213,11 +213,30 @@ def _inner_main() -> None:
         # ru_maxrss is KiB on Linux (bytes on macOS — not this box).
         peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
         mem_source = "rss"
+    # Packed-plane accounting (tpu/common.PACKED_PLANES via
+    # tpu/packing.py): what the headline config's hot narrow planes
+    # cost as stored vs bit-packed, regardless of whether this run
+    # packed them — the saved-bytes column of the memory story.
+    from frankenpaxos_tpu.harness.microbench import _packed_plane_bytes
+    from frankenpaxos_tpu.tpu import multipaxos_batched as _mp
+
+    _pp = {
+        case: _packed_plane_bytes(
+            _mp.init_state(dataclasses.replace(cfg, pack_planes=packed))
+        )
+        for case, packed in (("unpacked", False), ("packed", True))
+    }
     memory = {
         "peak_bytes_in_use": peak,
         "source": mem_source,
         "bytes_in_use": mem_stats.get("bytes_in_use"),
         "state_bytes": state_nbytes(sim.state),
+        "packed_planes": {
+            "enabled": bool(cfg.pack_planes),
+            "plane_bytes": _pp,
+            "bytes_saved": sum(_pp["unpacked"].values())
+            - sum(_pp["packed"].values()),
+        },
     }
     result = {
         "metric": METRIC,
@@ -2041,6 +2060,271 @@ def _lifecycle_inner() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+def _sessions_inner() -> None:
+    """The million-session serving measurement (``--sessions``): one
+    flagship brick at the [L=1024 lanes x S=1024 sessions] shape —
+    1,048,576 distinct session-table slots — with bit-packed planes
+    (tpu/packing.py) and the trace-driven open-loop arrival source.
+    Four legs:
+
+      1. headline trace leg: a recorded 1,048,576-event trace replays
+         through ONE compiled brick — every event admitted exactly
+         once (offered == cursor == trace_len), >= 1e6 DISTINCT
+         sessions live at drain, duplicate re-submissions answered
+         from the cache, the conservation books exact (lifecycle_ok),
+         at measured entries/sec;
+      2. packing leg: packed vs unpacked twins interleave-timed at the
+         same shape — per-plane stored bytes (packed / unpacked /
+         widened int32 reference) + the throughput ratio, committed
+         counts equal (the bit-identity spot check);
+      3. saturation matrix: the traced-rate axis swept on the SAME
+         executable (workload.set_rate — zero recompiles) — offered
+         vs committed per tick at the 1M-session shape;
+      4. sharded leg (8-virtual-device 'groups' mesh): the session
+         table partitions P('groups') instead of replicating, a
+         mid-run checkpoint/restore (PR 13) replays the uninterrupted
+         sharded twin bit-exactly.
+
+    One JSON line on stdout (BENCH_JSON ...). Capture artifact:
+    SESSIONS_r01.json."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frankenpaxos_tpu.harness.microbench import (
+        _packed_plane_bytes,
+        measure_packing_overhead,
+    )
+    from frankenpaxos_tpu.tpu import checkpoint as ck
+    from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu import packing
+    from frankenpaxos_tpu.tpu import workload as workload_mod
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    L, S = 1024, 1024  # lanes x sessions = 1,048,576 distinct slots
+    N = L * S  # one trace event per session slot
+    CHUNK = 1024  # trace decode chunk (the validate() ceiling)
+
+    def base_cfg(**kw):
+        return mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=L, window=16, slots_per_tick=2,
+            lat_min=1, lat_max=3, retry_timeout=16, thrifty=True,
+            pack_planes=True, **kw
+        )
+
+    # ---- 1. Headline: the 1M-event trace through one brick.
+    # Arrivals spread at CHUNK/tick (the decode-chunk ceiling);
+    # admission at 2/lane/tick outpaces them, so the drain tail is
+    # short. Lane ids round-robin so every lane receives exactly S
+    # events == S distinct sessions.
+    ev = np.arange(N, dtype=np.int64)
+    words = packing.encode_trace(ev // CHUNK, ev % L)
+    plan = WorkloadPlan(
+        arrival="trace", trace_len=N, trace_chunk=CHUNK
+    )
+    cfg = base_cfg(
+        workload=plan,
+        lifecycle=LifecyclePlan(sessions=S, resubmit_rate=0.02),
+    )
+    st = mp.init_state(cfg)
+    st = dataclasses.replace(
+        st, workload=workload_mod.load_trace(st.workload, words)
+    )
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    seg = 120
+    st, t = mp.run_ticks(cfg, st, t, seg, key)  # compile + first leg
+    jax.block_until_ready(st.committed)
+    cache0 = mp.run_ticks._cache_size()
+    start = time.perf_counter()
+    ticks = seg
+    # Drain criterion: the whole trace fired AND every one of the N
+    # commands committed into its session record.
+    while (
+        int(jax.device_get(st.workload.trace_cursor)) < N
+        or int(jax.device_get(jnp.sum(st.lifecycle.sess_total))) < N
+    ) and ticks < 4096:
+        st, t = mp.run_ticks(
+            cfg, st, t, seg, jax.random.fold_in(key, ticks)
+        )
+        ticks += seg
+    jax.block_until_ready(st.committed)
+    elapsed = time.perf_counter() - start
+    inv = {
+        k: bool(v) for k, v in mp.check_invariants(cfg, st, t).items()
+    }
+    distinct = int(
+        jax.device_get(lifecycle_mod.live_sessions(cfg.lifecycle,
+                                                   st.lifecycle))
+    )
+    trace_leg = {
+        "lanes": L,
+        "sessions_per_lane": S,
+        "trace_events": N,
+        "ticks": ticks,
+        "entries_per_sec": round(N / elapsed, 1),
+        "offered": int(jax.device_get(st.workload.offered)),
+        "trace_cursor": int(jax.device_get(st.workload.trace_cursor)),
+        "distinct_sessions_live": distinct,
+        "cache_hits": int(jax.device_get(st.lifecycle.cache_hits)),
+        "resubmits": int(jax.device_get(st.lifecycle.resubmits)),
+        "books_reconciled": int(
+            jax.device_get(jnp.sum(st.lifecycle.sess_total))
+        ) == int(jax.device_get(st.committed)),
+        "exactly_once": (
+            int(jax.device_get(st.workload.offered)) == N
+            and int(jax.device_get(st.workload.trace_cursor)) == N
+            and int(jax.device_get(jnp.sum(st.workload.adm_total))) == N
+        ),
+        "one_compile_per_mesh": mp.run_ticks._cache_size() == cache0,
+        "invariants_ok": all(inv.values()),
+    }
+
+    # ---- 2. Packing leg: packed vs unpacked twins, plus the widened
+    # int32 reference the dtype policy debugs against.
+    cfg_pk = base_cfg(
+        lifecycle=LifecyclePlan(sessions=S, resubmit_rate=0.02)
+    )
+    pk = measure_packing_overhead(cfg_pk, 60, rounds=3)
+    st_w = mp.init_state(dataclasses.replace(cfg_pk, pack_planes=False))
+    # The widened reference: every logical element stored as int32 (the
+    # dtype a naive lane-major layout would pick).
+    widened = {
+        "status": int(st_w.status.size) * 4,
+        "rb_status": int(st_w.rb_status.size) * 4,
+        "sess_occ": L * S * 4,
+    }
+    packing_leg = {
+        "ticks_per_sec": {
+            c: round(r, 2) for c, r in pk["rates"].items()
+        },
+        "throughput_ratio": round(pk["ratio"], 4),
+        "plane_bytes": {**pk["plane_bytes"], "widened": widened},
+        "bytes_saved_vs_unpacked": pk["bytes_saved"],
+        "bytes_saved_vs_widened": sum(widened.values())
+        - sum(pk["plane_bytes"]["packed"].values()),
+        "committed_equal": pk["committed"]["packed"]
+        == pk["committed"]["unpacked"],
+    }
+
+    # ---- 3. Saturation matrix: traced-rate sweep, one executable.
+    cfg_m = base_cfg(
+        workload=WorkloadPlan(arrival="constant", rate=1.0, zipf_s=0.8),
+        lifecycle=LifecyclePlan(sessions=S, resubmit_rate=0.02),
+    )
+    st_m = mp.init_state(cfg_m)
+    t_m = jnp.zeros((), jnp.int32)
+    # Warm with the SAME static segment length the sweep uses —
+    # num_ticks is a static arg, so a different length is a recompile.
+    st_m, t_m = mp.run_ticks(cfg_m, st_m, t_m, 60, key)
+    cache_m = mp.run_ticks._cache_size()
+    matrix = []
+    for rate in (0.5, 1.0, 2.0, 4.0):
+        st_m = dataclasses.replace(
+            st_m,
+            workload=workload_mod.set_rate(st_m.workload, rate),
+        )
+        c0 = int(jax.device_get(st_m.committed))
+        o0 = int(jax.device_get(st_m.workload.offered))
+        st_m, t_m = mp.run_ticks(
+            cfg_m, st_m, t_m, 60, jax.random.fold_in(key, int(rate * 8))
+        )
+        matrix.append({
+            "rate_per_lane": rate,
+            "offered_per_tick": round((int(
+                jax.device_get(st_m.workload.offered)) - o0) / 60, 1),
+            "committed_per_tick": round((int(
+                jax.device_get(st_m.committed)) - c0) / 60, 1),
+        })
+    matrix_leg = {
+        "rows": matrix,
+        "one_compile_per_mesh": mp.run_ticks._cache_size() == cache_m,
+        # Saturation: the highest swept rate runs into the admission
+        # ceiling (committed/tick stops tracking offered/tick).
+        "saturated": matrix[-1]["committed_per_tick"]
+        < matrix[-1]["offered_per_tick"],
+    }
+
+    # ---- 4. Sharded leg: groups-partitioned session table +
+    # checkpoint/resume == the uninterrupted sharded twin.
+    sharded_leg = {"devices": jax.device_count()}
+    if jax.device_count() >= 2:
+        import tempfile
+
+        from frankenpaxos_tpu.parallel import sharding as sh
+
+        mesh = sh.make_mesh(jax.devices())
+        seg_s = 60
+
+        def fresh():
+            s0 = mp.init_state(cfg)
+            s0 = dataclasses.replace(
+                s0,
+                workload=workload_mod.load_trace(s0.workload, words),
+            )
+            return sh.shard_state("multipaxos", s0, mesh)
+
+        tw, tt = sh.run_ticks_sharded(
+            "multipaxos", cfg, mesh, fresh(), jnp.zeros((), jnp.int32),
+            seg_s, key,
+        )
+        tw, tt = sh.run_ticks_sharded(
+            "multipaxos", cfg, mesh, tw, tt, seg_s, key
+        )
+        s1, t1 = sh.run_ticks_sharded(
+            "multipaxos", cfg, mesh, fresh(), jnp.zeros((), jnp.int32),
+            seg_s, key,
+        )
+        with tempfile.TemporaryDirectory() as d:
+            ck.save_state(d, mp, cfg, s1, t1, step=0)
+            s2, t2, _ = ck.restore_state(d, mp, cfg, fresh())
+        s2 = sh.shard_state("multipaxos", s2, mesh)
+        s2, t2 = sh.run_ticks_sharded(
+            "multipaxos", cfg, mesh, s2, t2, seg_s, key
+        )
+        occ = sh.shard_state("multipaxos", mp.init_state(cfg), mesh)
+        sharded_leg.update({
+            "session_table_partitioned": sh.GROUP_AXIS in tuple(
+                occ.lifecycle.sess_occ.sharding.spec
+            ),
+            "resume_bit_exact": ck.state_digest(s2)
+            == ck.state_digest(tw),
+            "resume_tick_equal": int(t2) == int(tt),
+        })
+
+    result = {
+        "metric": "million-session serving: packed planes + "
+        "group-sharded session table + trace-driven open loop",
+        "backend": "multipaxos",
+        "device": str(jax.devices()[0]),
+        "num_acceptors": cfg.num_acceptors,
+        "trace_leg": trace_leg,
+        "packing_leg": packing_leg,
+        "saturation_matrix": matrix_leg,
+        "sharded_leg": sharded_leg,
+        "ok": (
+            trace_leg["distinct_sessions_live"] >= 1_000_000
+            and trace_leg["exactly_once"]
+            and trace_leg["books_reconciled"]
+            and trace_leg["cache_hits"] > 0
+            and trace_leg["one_compile_per_mesh"]
+            and trace_leg["invariants_ok"]
+            and packing_leg["committed_equal"]
+            and packing_leg["bytes_saved_vs_widened"] > 0
+            and matrix_leg["one_compile_per_mesh"]
+            and sharded_leg.get("resume_bit_exact", True)
+            and sharded_leg.get("session_table_partitioned", True)
+        ),
+        "measured_live": True,
+    }
+    print("BENCH_JSON " + json.dumps(result))
+
+
 def _subprocess_mode_main(inner_flag: str, metric: str, env: dict) -> None:
     """Shared orchestrator for the standalone bench modes (--workload,
     --multichip): run this script's inner mode in a clean subprocess,
@@ -2135,6 +2419,23 @@ def _fleet_main() -> None:
     _subprocess_mode_main(
         "--inner-fleet",
         "fleet-axis capacity surface + device-rate fuzzing throughput",
+        env,
+    )
+
+
+def _sessions_main() -> None:
+    """Orchestrate the million-session measurement in a clean
+    8-virtual-device CPU subprocess (the sharded leg needs a 'groups'
+    mesh); print exactly one JSON line, exit 0."""
+    env = _cpu_env()
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    _subprocess_mode_main(
+        "--inner-sessions",
+        "million-session serving: packed planes + group-sharded "
+        "session table + trace-driven open loop",
         env,
     )
 
@@ -2414,6 +2715,8 @@ if __name__ == "__main__":
         _checkpoint_inner()
     elif "--inner-lifecycle" in sys.argv:
         _lifecycle_inner()
+    elif "--inner-sessions" in sys.argv:
+        _sessions_inner()
     elif "--inner" in sys.argv:
         _inner_main()
     elif "--multichip" in sys.argv:
@@ -2428,5 +2731,7 @@ if __name__ == "__main__":
         _checkpoint_main()
     elif "--lifecycle" in sys.argv:
         _lifecycle_main()
+    elif "--sessions" in sys.argv:
+        _sessions_main()
     else:
         main()

@@ -37,7 +37,7 @@ from frankenpaxos_tpu.analysis import astutil
 # brick is one compiled executable per product mesh (flat jit cache
 # across traced-rate re-sweeps) and no signed collective crosses the
 # fleet axis (replica-group census) or moves state at all.
-ANALYSIS_VERSION = "1.9"
+ANALYSIS_VERSION = "2.0"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

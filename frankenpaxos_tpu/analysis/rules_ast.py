@@ -1136,3 +1136,75 @@ def check_dead_writes(ctx: Context) -> List[Finding]:
                         )
                     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Packing layer (PR 16 contract)
+# ---------------------------------------------------------------------------
+
+# Keys of tpu/common.PACKED_PLANES — the planes a backend may store
+# bit-packed. Mirrored here as literals: the analysis layer parses the
+# tree without importing it (fixtures are parse-only).
+_PACKED_PLANE_ATTRS = frozenset({"status", "rb_status", "sess_occ"})
+_BIT_OPS = (ast.BitOr, ast.BitAnd, ast.BitXor, ast.LShift, ast.RShift)
+
+
+@rule(
+    "packing-containment",
+    "ast",
+    "raw bit-twiddling on packed planes (tpu/common.PACKED_PLANES) "
+    "lives only in tpu/packing.py — backends route through the "
+    "pack/unpack helpers",
+)
+def check_packing_containment(ctx: Context) -> List[Finding]:
+    """A packed plane is an opaque word array outside tpu/packing.py:
+    shifting or masking ``<x>.status`` / ``<x>.rb_status`` /
+    ``<x>.sess_occ`` inline re-implements the codec and silently
+    diverges from the pinned bit layout the twin tests certify.
+    Only a DIRECT operand counts (modulo subscripting): a plane
+    nested in a comparison (``(state.status == CHOSEN) & live`` —
+    boolean mask logic on the unpacked view) or handed to a helper
+    call (``cached & packing.occ_get(...)``) is not twiddling the
+    stored words."""
+
+    def _packed_operand(expr: ast.expr) -> bool:
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        return (
+            isinstance(expr, ast.Attribute)
+            and expr.attr in _PACKED_PLANE_ATTRS
+        )
+
+    out: List[Finding] = []
+    for path in astutil.py_files(ctx.root):
+        rel = path.relative_to(ctx.root)
+        if rel.parts[-1] == "packing.py":
+            continue
+        tree = astutil.parse_file(path)
+        hits: List[int] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, _BIT_OPS):
+                operands = (node.left, node.right)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.op, _BIT_OPS
+            ):
+                operands = (node.target, node.value)
+            else:
+                continue
+            if any(_packed_operand(op) for op in operands):
+                hits.append(node.lineno)
+        if hits:
+            out.append(
+                Finding(
+                    rule="packing-containment",
+                    path=_rel(ctx, path),
+                    line=hits[0],
+                    message=(
+                        f"bitwise op on a packed plane at line(s) {hits} "
+                        "— use the tpu/packing.py helpers "
+                        "(pack/unpack/occ_set/occ_clear/occ_get)"
+                    ),
+                    key=str(rel),
+                )
+            )
+    return out

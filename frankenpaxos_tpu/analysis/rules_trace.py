@@ -363,7 +363,26 @@ def check_donation_alias(ctx: Context) -> List[Finding]:
         if backend not in selected:
             continue
         mod = _module(backend)
-        cfg = mod.analysis_config()
+        # Engage the client planes the registry lane-shards
+        # (_NESTED_LANE_FIELDS): per-lane workload bookkeeping and the
+        # [L, S] session table must keep their donation aliases under
+        # the group-sharded layout too (a replicated->sharded reshard
+        # would silently double-buffer the million-session plane).
+        import inspect as _inspect
+
+        _params = _inspect.signature(mod.analysis_config).parameters
+        _kw = {}
+        if "workload" in _params:
+            from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+            _kw["workload"] = WorkloadPlan(arrival="constant", rate=1.0)
+        if "lifecycle" in _params:
+            from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+            _kw["lifecycle"] = LifecyclePlan(
+                sessions=8, resubmit_rate=0.1
+            )
+        cfg = mod.analysis_config(**_kw)
         # Pin the kernel policy to the reference twins: the donation
         # contract must hold on the plain-GSPMD program independent of
         # the shard_map kernel lowering (whose own contract is
@@ -1395,9 +1414,15 @@ def check_fleet_onecompile(ctx: Context) -> List[Finding]:
         if backend not in selected or spec.planes_backend is None:
             continue
         mod = _module(backend)
+        from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+        # Sessions engaged: the [L, S] session table (group+fleet
+        # sharded client state) joins the collective census — exactly-
+        # once bookkeeping must stay inside one fleet row too.
         base = mod.analysis_config(
             faults=FaultPlan(traced=True),
             workload=WorkloadPlan(arrival="constant", rate=1.0),
+            lifecycle=LifecyclePlan(sessions=8, resubmit_rate=0.1),
         )
         state = mod.init_state(base)
         axis_len = spec.axis_len(state)

@@ -634,6 +634,107 @@ def bench_workload(
     return rows
 
 
+def _packed_plane_bytes(state) -> dict:
+    """Stored bytes of each PACKED_PLANES plane on a live state: the
+    status/rb_status words and the lifecycle occupancy bitmap (zero
+    when sessions are off or the unpacked twin carries occupancy in
+    the ``sess_last`` sentinel instead)."""
+    return {
+        "status": int(state.status.nbytes),
+        "rb_status": int(state.rb_status.nbytes),
+        "sess_occ": int(state.lifecycle.sess_occ.nbytes),
+    }
+
+
+def measure_packing_overhead(cfg, ticks: int, rounds: int = 3) -> dict:
+    """Head-to-head bit-packing price on one config: ``unpacked`` (the
+    int8 status planes + sentinel occupancy) vs ``packed``
+    (``pack_planes=True`` — 2-bit status codes 16/word, 1-bit
+    occupancy 32/word, tpu/packing.py). Same seed, and the twin-state
+    contract (tests/test_packing.py) makes the two runs bit-identical,
+    so the ratio prices ONLY the unpack-at-entry/pack-at-exit shift
+    arithmetic against the smaller HBM resident set. Timed via
+    :func:`_interleaved_best`. Returns ``{"seconds", "rates"
+    (ticks/sec), "ratio" (packed/unpacked), "plane_bytes" (per case),
+    "bytes_saved", "committed"}``. Shared by the ``packing`` device
+    bench and ``bench.py --sessions``."""
+    import dataclasses as _dc
+
+    from frankenpaxos_tpu.tpu.transport import TpuSimTransport
+
+    sims = {
+        case: TpuSimTransport(
+            _dc.replace(cfg, pack_planes=packed), seed=0
+        )
+        for case, packed in (("unpacked", False), ("packed", True))
+    }
+    best = _interleaved_best(sims, ticks, rounds)
+    rates = {case: ticks / s for case, s in best.items()}
+    plane_bytes = {
+        case: _packed_plane_bytes(sim.state) for case, sim in sims.items()
+    }
+    return {
+        "seconds": best,
+        "rates": rates,
+        "ratio": rates["packed"] / rates["unpacked"],
+        "plane_bytes": plane_bytes,
+        "bytes_saved": sum(plane_bytes["unpacked"].values())
+        - sum(plane_bytes["packed"].values()),
+        "committed": {case: sim.committed() for case, sim in sims.items()},
+    }
+
+
+def bench_packing(
+    num_groups: int = 3334,
+    window: int = 64,
+    slots_per_tick: int = 8,
+    ticks: int = 200,
+) -> List[dict]:
+    """The bit-packing device bench on the flagship 10k-acceptor
+    config with the session table engaged (the occupancy bitmap is the
+    1-bit plane): packed vs unpacked ticks/sec, per-plane stored
+    bytes, and the committed-count equality spot check on a
+    ``PACKING_JSON`` line. Evidence artifact: the packing block of
+    ``results/SESSIONS_r01.json``."""
+    import json
+
+    from frankenpaxos_tpu.tpu import BatchedMultiPaxosConfig
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+    cfg = BatchedMultiPaxosConfig(
+        f=1,
+        num_groups=num_groups,
+        window=window,
+        slots_per_tick=slots_per_tick,
+        lat_min=1,
+        lat_max=3,
+        retry_timeout=16,
+        thrifty=True,
+        lifecycle=LifecyclePlan(sessions=64, resubmit_rate=0.05),
+    )
+    measured = measure_packing_overhead(cfg, ticks)
+    rows = []
+    for case in ("unpacked", "packed"):
+        row = _report("packing", case, ticks, measured["seconds"][case])
+        row["committed"] = measured["committed"][case]
+        row["plane_bytes"] = sum(measured["plane_bytes"][case].values())
+        rows.append(row)
+    payload = {
+        "num_acceptors": cfg.num_acceptors,
+        "ticks": ticks,
+        "ticks_per_sec": {
+            case: round(r, 2) for case, r in measured["rates"].items()
+        },
+        "ratio": round(measured["ratio"], 4),
+        "plane_bytes": measured["plane_bytes"],
+        "bytes_saved": measured["bytes_saved"],
+        "committed_equal": measured["committed"]["packed"]
+        == measured["committed"]["unpacked"],
+    }
+    print("PACKING_JSON " + json.dumps(payload))
+    return rows
+
+
 def _kernel_cases(A=3, G=3334, W=64, N=3334, L=3, KV=16, CW=16, seed=0):
     """Random dtype-policy-native inputs for every registered kernel
     plane (flagship-shaped by default): ``{plane: (args, statics)}``.
@@ -1522,6 +1623,7 @@ DEVICE_BENCHES = {
     "telemetry": bench_telemetry,
     "faults": bench_faults,
     "workload": bench_workload,
+    "packing": bench_packing,
     "kernels": bench_kernels,
     "fused_tick": bench_fused_tick,
     "grid_vote": bench_grid_vote,

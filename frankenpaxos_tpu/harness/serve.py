@@ -311,6 +311,25 @@ class ServeLoop:
         )
         self._span("verb:rotate", time.time(), time.perf_counter())
 
+    def install_trace(self, words):
+        """Install a recorded arrival trace (tpu/packing.py delta
+        codec) into the open-loop workload cursor — a pure state swap
+        (the trace words are a WorkloadState leaf sized by the plan's
+        ``trace_len``), so serving a different recorded day never
+        recompiles the brick. Needs a ``WorkloadPlan(arrival="trace")``
+        config; rejects length/lane mismatches host-side before any
+        device transfer."""
+        plan = getattr(self.cfg, "workload", None)
+        assert plan is not None and plan.arrival == "trace", (
+            "install_trace needs a WorkloadPlan(arrival='trace') config"
+        )
+        self.state = dataclasses.replace(
+            self.state,
+            workload=workload_mod.load_trace(self.state.workload, words),
+        )
+        self._span("verb:install_trace", time.time(),
+                   time.perf_counter(), events=int(len(words)))
+
     # -- crash tolerance: async checkpoint + bit-exact resume --------------
     # Every checkpoint_every chunks the loop enqueues a jitted
     # alias-free copy of the FULL state (+ tick scalar) right behind the

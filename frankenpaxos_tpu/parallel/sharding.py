@@ -295,10 +295,15 @@ def shard_state(backend: str, state, mesh: Mesh):
             f"mesh size ({n_group}) to shard that axis; pick a "
             "multiple of the device count."
         )
-    shardings = state_shardings(backend, mesh)
     out = {}
     for f in dataclasses.fields(state):
-        out[f.name] = jax.device_put(getattr(state, f.name), shardings[f.name])
+        value = getattr(state, f.name)
+        out[f.name] = jax.device_put(
+            value,
+            _nested_field_sharding(
+                spec, f.name, value, mesh, axis_len, fleet=False
+            ),
+        )
     return type(state)(**out)
 
 
@@ -355,17 +360,53 @@ def _wrap_mesh(backend: str, cfg, mesh: Mesh) -> Optional[Mesh]:
     return mesh if _engaged_planes(backend, cfg) else None
 
 
+def _constrain_client_out(backend: str, mesh: Mesh, state):
+    """Pin the single-instance runner's client-plane OUTPUT shardings
+    (the nested workload/lifecycle subtrees, per leaf) to the layout
+    :func:`shard_state` placed the inputs in. Without this, XLA may
+    assign feature-off (zero-sized) leaves a different output sharding
+    than the input's, so rebinding segment 1's result into segment 2
+    presents new input shardings and recompiles — and a re-replicated
+    session table would break the donation alias on the [L, S] planes.
+    Only the nested client subtrees are constrained; every protocol
+    plane keeps pure GSPMD propagation (the HLO the census rules pin)."""
+    spec = SHARDINGS[backend]
+    lanes = spec.axis_len(state)
+    out = {}
+    for f in dataclasses.fields(state):
+        v = getattr(state, f.name)
+        if f.name not in _NESTED_LANE_FIELDS or not dataclasses.is_dataclass(v):
+            out[f.name] = v
+            continue
+        sharding = _nested_field_sharding(
+            spec, f.name, v, mesh, lanes, fleet=False
+        )
+        out[f.name] = type(v)(**{
+            g.name: jax.lax.with_sharding_constraint(
+                getattr(v, g.name), getattr(sharding, g.name)
+            )
+            for g in dataclasses.fields(v)
+        })
+    return type(state)(**out)
+
+
 @functools.lru_cache(maxsize=None)
-def _runner(backend: str, wrap_mesh: Optional[Mesh] = None):
+def _runner(
+    backend: str,
+    wrap_mesh: Optional[Mesh] = None,
+    mesh: Optional[Mesh] = None,
+):
     """The jitted sharded multi-tick runner for one backend. The
     backend's own ``run_ticks`` body runs under the input shardings
     (GSPMD propagation, module docstring); with ``wrap_mesh`` set, the
     trace additionally runs under ``registry.shard_lowering`` so every
     engaged kernel plane lowers through ``jax.shard_map`` on that mesh
     (one jitted runner per mesh — a cached executable never leaks
-    across meshes). ``state`` is DONATED — single-buffered per shard —
-    so callers rebind the returned state and must not reuse the
-    argument."""
+    across meshes). With ``mesh`` set (any >1-device run), the client
+    planes' output shardings are pinned (:func:`_constrain_client_out`)
+    so segmented runs stay on one executable. ``state`` is DONATED —
+    single-buffered per shard — so callers rebind the returned state
+    and must not reuse the argument."""
     from frankenpaxos_tpu.ops import registry
 
     mod = SHARDINGS[backend].mod()
@@ -373,9 +414,21 @@ def _runner(backend: str, wrap_mesh: Optional[Mesh] = None):
     @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
     def run(cfg, state, t0, num_ticks: int, key):
         with registry.shard_lowering(wrap_mesh, GROUP_AXIS):
-            return mod.run_ticks.__wrapped__(cfg, state, t0, num_ticks, key)
+            state, t = mod.run_ticks.__wrapped__(
+                cfg, state, t0, num_ticks, key
+            )
+        if mesh is not None:
+            state = _constrain_client_out(backend, mesh, state)
+        return state, t
 
     return run
+
+
+def _constrain_mesh(mesh: Mesh) -> Optional[Mesh]:
+    """The mesh :func:`_constrain_client_out` pins outputs on: any real
+    multi-device mesh. Single-device runs skip the constraints (nothing
+    to pin, and the unsharded HLO stays byte-stable)."""
+    return mesh if mesh.devices.size > 1 else None
 
 
 def run_ticks_sharded(
@@ -389,7 +442,9 @@ def run_ticks_sharded(
     _reject_fleet_axis(mesh)
     validate_policy(backend, cfg, mesh)
     wrap = _wrap_mesh(backend, cfg, mesh)
-    return _runner(backend, wrap)(cfg, state, t0, num_ticks, key)
+    return _runner(backend, wrap, _constrain_mesh(mesh))(
+        cfg, state, t0, num_ticks, key
+    )
 
 
 def lower_sharded(
@@ -401,7 +456,9 @@ def lower_sharded(
     _reject_fleet_axis(mesh)
     validate_policy(backend, cfg, mesh)
     wrap = _wrap_mesh(backend, cfg, mesh)
-    return _runner(backend, wrap).lower(cfg, state, t0, num_ticks, key)
+    return _runner(backend, wrap, _constrain_mesh(mesh)).lower(
+        cfg, state, t0, num_ticks, key
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -472,40 +529,64 @@ def fleet_keys(seeds) -> jnp.ndarray:
     )
 
 
-# Workload-state fields whose axis 1 (after the leading instance axis)
-# is the backend's LANE axis — the same axis the group sharding splits,
-# since every registered backend's lanes are its groups/columns. In the
-# fleet layout these shard over BOTH mesh axes: GSPMD propagation
-# re-shards them that way anyway (the admission cap clamps group-sharded
-# propose planes elementwise), and placing them pre-sharded keeps the
-# donation aliases intact (a resharded input cannot alias its output).
+# Client-plane fields whose leading (post-instance) axis is the
+# backend's LANE axis — the same axis the group sharding splits, since
+# every registered backend's lanes are its groups/columns. These shard
+# over the GROUP axis (and the fleet axis, in the fleet layout) instead
+# of replicating: GSPMD propagation re-shards them that way anyway (the
+# admission cap clamps group-sharded propose planes elementwise, the
+# session table joins group-sharded completion counts), and placing
+# them pre-sharded keeps the donation aliases intact (a resharded input
+# cannot alias its output). At production cardinality the session table
+# ([L, S] — a million sessions) is the client plane that MUST partition.
 _WORKLOAD_LANE_FIELDS = frozenset({
     "acc", "racc", "backlog", "cum_ring", "adm_total",
     "in_flight", "idle", "ready_ring",
 })
+_LIFECYCLE_LANE_FIELDS = frozenset({
+    "sess_total", "sess_last", "sess_res", "sess_occ",
+    "gc_watermark", "old_live",
+})
+# Nested State fields that get PER-LEAF shardings (everything else in
+# them — traced sweep scalars, counters, the arrival trace, the
+# acceptor-axis membership masks — replicates).
+_NESTED_LANE_FIELDS = {
+    "workload": _WORKLOAD_LANE_FIELDS,
+    "lifecycle": _LIFECYCLE_LANE_FIELDS,
+}
 
 
-def _fleet_field_sharding(spec, field: str, value, mesh: Mesh, lanes: int):
-    """The fleet sharding of one State field — a single NamedSharding,
-    except the nested workload pytree, which gets per-leaf shardings so
-    its lane-axis bookkeeping rides the group axis."""
-    if field != "workload" or not dataclasses.is_dataclass(value):
-        return NamedSharding(mesh, spec.spec_for(field, fleet=True))
+def _nested_field_sharding(
+    spec, field: str, value, mesh: Mesh, lanes: int, fleet: bool
+):
+    """The sharding of one State field — a single NamedSharding, except
+    the nested workload/lifecycle pytrees, which get per-leaf shardings
+    so their lane-axis client state (per-lane bookkeeping, the [L, S]
+    session table) rides the group axis instead of replicating."""
+    lane_fields = _NESTED_LANE_FIELDS.get(field)
+    if lane_fields is None or not dataclasses.is_dataclass(value):
+        return NamedSharding(mesh, spec.spec_for(field, fleet=fleet))
+    pos = 1 if fleet else 0  # lane axis, past any leading instance axis
+    lead = [FLEET_AXIS] if fleet else []
 
     def leaf_spec(name: str, leaf) -> NamedSharding:
         lane_sharded = (
-            name in _WORKLOAD_LANE_FIELDS
-            and leaf.ndim >= 2
-            and leaf.shape[1] == lanes
+            name in lane_fields
+            and leaf.ndim >= pos + 1
+            and leaf.shape[pos] == lanes
             and lanes % group_size(mesh) == 0
         )
-        p = P(FLEET_AXIS, GROUP_AXIS) if lane_sharded else P(FLEET_AXIS)
+        p = P(*(lead + [GROUP_AXIS])) if lane_sharded else P(*lead)
         return NamedSharding(mesh, p)
 
     return type(value)(**{
         f.name: leaf_spec(f.name, getattr(value, f.name))
         for f in dataclasses.fields(value)
     })
+
+
+def _fleet_field_sharding(spec, field: str, value, mesh: Mesh, lanes: int):
+    return _nested_field_sharding(spec, field, value, mesh, lanes, True)
 
 
 def shard_fleet_state(backend: str, states, mesh: Mesh):
@@ -766,14 +847,14 @@ register_sharding(
             "sm_applied", "dups_filtered", "dups_seen",
             # The telemetry ring holds cluster-wide per-tick reductions
             # ([K, NUM_COLS] + histograms) — replicated; device_put
-            # broadcasts the spec over the nested pytree's leaves. The
-            # workload shaping state replicates the same way (all-empty
-            # under WorkloadPlan.none(); tiny [G]-sized bookkeeping
-            # otherwise), as does the lifecycle state (all-empty under
-            # LifecyclePlan.none(); rotation scalars + the [G, S]
-            # session table + the [A, G] membership mask otherwise —
-            # the rotation predicate's min-head reduction is the only
-            # cross-device traffic it adds, a scalar).
+            # broadcasts the spec over the nested pytree's leaves.
+            # "workload"/"lifecycle" here is the DEFAULT for their
+            # non-lane leaves only (traced sweep scalars, counters, the
+            # arrival trace, the [A, G] membership masks): the nested
+            # client planes — per-lane shaping bookkeeping and the
+            # [G, S] session table — shard over the group axis per
+            # _NESTED_LANE_FIELDS (production session cardinality
+            # cannot replicate per device).
             "telemetry", "workload", "lifecycle",
         }),
         axis_pos={

@@ -68,6 +68,22 @@ DTYPE_CLOCK = jnp.int16
 # "arrives exactly now" unambiguous).
 CLOCK_FLOOR = -1
 
+# ---------------------------------------------------------------------------
+# Packed-plane descriptor (the policy's sub-byte tier, PR 16): State
+# fields narrower than int8 that backends may carry BIT-PACKED into
+# int32 words (field name -> bit width). Backends opt in per config
+# (`pack_planes=True`), unpack once at tick entry and pack once at tick
+# exit through tpu/packing.py — the ONLY module allowed to bit-twiddle
+# these fields (the `packing-containment` analysis rule). widen_state()
+# passes packed words through (already int32); the bench memory block
+# prices packed vs unpacked bytes per plane from this table.
+# ---------------------------------------------------------------------------
+PACKED_PLANES = {
+    "status": 2,  # slot ring status codes (EMPTY | PROPOSED | CHOSEN)
+    "rb_status": 2,  # read-batcher ring phases (R_EMPTY..R_SENT)
+    "sess_occ": 1,  # session-table occupancy bits ([L, S] liveness)
+}
+
 
 def age_clock(off: jnp.ndarray) -> jnp.ndarray:
     """Advance an offset clock by one tick: real offsets decrement

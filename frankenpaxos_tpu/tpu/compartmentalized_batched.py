@@ -86,6 +86,7 @@ from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
@@ -158,6 +159,13 @@ class BatchedCompartmentalizedConfig:
     # handoff — the full-grid retry timers re-form quorums on the new
     # membership). LifecyclePlan.none() is a structural no-op.
     lifecycle: LifecyclePlan = LifecyclePlan.none()
+    # Bit-packed storage for the narrow hot planes (tpu/packing.py,
+    # common.PACKED_PLANES): the [G, W] batch-ring status plane packs
+    # 16 2-bit codes per int32 word and the [G, S] session table packs
+    # a 1-bit occupancy bitmap. Pure storage transform — the tick
+    # unpacks once at entry and packs once at exit, so packed runs are
+    # bit-identical to unpacked runs (tests/test_packing.py).
+    pack_planes: bool = False
 
     @property
     def acceptors_per_group(self) -> int:
@@ -271,6 +279,16 @@ class BatchedCompartmentalizedState:
     telemetry: Telemetry
 
 
+def _pack_status(cfg, plane: jnp.ndarray) -> jnp.ndarray:
+    """Storage form of a status plane under this config's policy."""
+    return packing.pack_status(plane) if cfg.pack_planes else plane
+
+
+def _unpack_status(cfg, words: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Compute form (the int8 twin) of a stored status plane."""
+    return packing.unpack_status(words, size) if cfg.pack_planes else words
+
+
 def init_state(
     cfg: BatchedCompartmentalizedConfig,
 ) -> BatchedCompartmentalizedState:
@@ -285,7 +303,7 @@ def init_state(
         pending=jnp.zeros((G,), jnp.int32),
         next_slot=jnp.zeros((G,), jnp.int32),
         head=jnp.zeros((G,), jnp.int32),
-        status=jnp.zeros((G, W), DTYPE_STATUS),
+        status=_pack_status(cfg, jnp.zeros((G, W), DTYPE_STATUS)),
         propose_tick=jnp.full((G, W), INF, jnp.int32),
         last_send=jnp.full((G, W), INF, jnp.int32),
         proxy_alive=jnp.ones((G, P), bool),
@@ -315,7 +333,8 @@ def init_state(
             cfg.workload, cfg.num_groups, cfg.faults
         ),
         lifecycle=lifecycle_mod.make_state(
-            cfg.lifecycle, G, acceptor_shape=(R, C, G)
+            cfg.lifecycle, G, acceptor_shape=(R, C, G),
+            packed=cfg.pack_planes,
         ),
         telemetry=make_telemetry(),
     )
@@ -334,6 +353,10 @@ def tick(
     BS = cfg.batch_size
     fp = cfg.faults
     w_iota = jnp.arange(W, dtype=jnp.int32)
+    # Packed storage: unpack ONCE into the int8 plane every tick
+    # equation (and the grid-vote kernel) reads; re-packed at the
+    # write-back below. The unpacked twin reads the same array.
+    status_in = _unpack_status(cfg, state.status, W)
 
     # 0. Age the narrow offset clocks by one tick ("fires now" is == 0,
     # "already arrived" is <= 0). The WIDE planes — the [R, C, G, W]
@@ -474,7 +497,7 @@ def tick(
         not_member = ~cell_mask[:, :, :, None]
         p2a_state = jnp.where(not_member, INF16, p2a_state)
         p2b_state = jnp.where(
-            lc_switch & not_member & (state.status != CHOSEN)[None, None],
+            lc_switch & not_member & (status_in != CHOSEN)[None, None],
             INF16,
             p2b_state,
         )
@@ -514,7 +537,7 @@ def tick(
         p2a_state,
         p2b_state,
         state.rep_arrival,
-        state.status,
+        status_in,
         state.last_send,
         state.rep_exec,
         state.head,
@@ -884,7 +907,7 @@ def tick(
         pending=pending,
         next_slot=next_slot,
         head=head,
-        status=status,
+        status=_pack_status(cfg, status),
         propose_tick=propose_tick,
         last_send=last_send,
         proxy_alive=proxy_alive,
@@ -946,7 +969,8 @@ def check_invariants(
     w_iota = jnp.arange(W, dtype=jnp.int32)
     ord_of_pos = (w_iota[None, :] - state.head[:, None]) % W
     live = ord_of_pos < (state.next_slot - state.head)[:, None]
-    chosen = (state.status == CHOSEN) & live
+    # Packed storage: invariants read the unpacked (int8) view.
+    chosen = (_unpack_status(cfg, state.status, W) == CHOSEN) & live
     # Every chosen slot holds a full column-transversal quorum (every
     # row voted); votes saturate "arrived" until retirement clears them.
     votes_in = state.p2b_arrival <= 0

@@ -108,6 +108,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu.common import bit_delivered
 
 # Stream id folded into a tick's key before drawing any lifecycle
@@ -249,6 +250,12 @@ class LifecycleState:
     sess_total: jnp.ndarray  # [L] client-visible completions per lane | [0]
     sess_last: jnp.ndarray  # [L, S] largest completed id per session (-1)
     sess_res: jnp.ndarray  # [L, S] cached result (completion tick; -1)
+    # Bit-packed occupancy (make_state(packed=True)): liveness moves to
+    # a [L, S/32] int32 bitmap (tpu/packing.py) and the -1 sentinel
+    # sweeps over the two int32 planes above stop — dead cells keep
+    # stale values, masked back to -1 by canonical_sessions(). [L, 0]
+    # when sessions are on but unpacked; [0, 0] when sessions are off.
+    sess_occ: jnp.ndarray
     resubmits: jnp.ndarray  # [] duplicate submissions drawn | [0]
     cache_hits: jnp.ndarray  # [] duplicates answered from the cache | [0]
     expired: jnp.ndarray  # [] records demoted by session_ttl | [0]
@@ -266,12 +273,15 @@ def make_state(
     plan: LifecyclePlan,
     lanes: int,
     acceptor_shape: Tuple[int, ...] = (),
+    packed: bool = False,
 ) -> LifecycleState:
     """The backend's lifecycle state. ``acceptor_shape`` is the shape
     of the backend's acceptor membership axis (e.g. ``(A, G)`` for the
     flagship, ``(R, C, G)`` for the compartmentalized grid); only read
     when ``plan.reconfig``. Leaves for disabled legs are zero-sized so
-    the none plan carries nothing."""
+    the none plan carries nothing. ``packed`` (the backend's
+    ``pack_planes`` knob) carries session liveness as the
+    ``sess_occ`` bitmap instead of -1 sentinel sweeps."""
     z32 = jnp.int32
     scalar_rot = () if plan.compaction else (0,)
     Ls = lanes if plan.has_sessions else 0
@@ -292,6 +302,11 @@ def make_state(
         sess_total=jnp.zeros((Ls,), z32),
         sess_last=jnp.full((Ls, S), -1, z32),
         sess_res=jnp.full((Ls, S), -1, z32),
+        sess_occ=(
+            packing.make_occ(Ls, S)
+            if (packed and plan.has_sessions)
+            else jnp.zeros((Ls, 0), z32)
+        ),
         resubmits=jnp.zeros(scalar_sess, z32),
         cache_hits=jnp.zeros(scalar_sess, z32),
         expired=jnp.zeros(() if plan.session_ttl > 0 else (0,), z32),
@@ -411,6 +426,10 @@ def sessions_step(
         completion tick ``t``."""
     assert plan.has_sessions
     L, S = lcs.sess_last.shape
+    # Packed occupancy (make_state(packed=True)) is a STRUCTURAL
+    # trace-time predicate, read off the bitmap's shape like every
+    # other plan gate.
+    packed = lcs.sess_occ.shape[-1] > 0
     completions = completions.astype(jnp.int32)
     resubmits = lcs.resubmits
     cache_hits = lcs.cache_hits
@@ -427,6 +446,11 @@ def sessions_step(
             ]
             == lcs.sess_total - 1
         )
+        if packed:
+            # Dead cells keep stale ids under the bitmap scheme, so
+            # the cache test must ALSO see the bit live — exactly the
+            # sentinel test the unpacked twin's -1 write performs.
+            cached = cached & packing.occ_get(lcs.sess_occ, last_sess)
         hit = resub & has_done & cached
         resubmits = resubmits + jnp.sum(resub)
         cache_hits = cache_hits + jnp.sum(hit)
@@ -439,6 +463,9 @@ def sessions_step(
     wrote = (cand >= before[:, None]) & (cand >= 0)
     sess_last = jnp.where(wrote, cand, lcs.sess_last)
     sess_res = jnp.where(wrote, jnp.asarray(t, jnp.int32), lcs.sess_res)
+    sess_occ = lcs.sess_occ
+    if packed:
+        sess_occ = packing.occ_set(sess_occ, wrote)
     expired = lcs.expired
     if plan.session_ttl > 0:
         # Expiry (the traced-threshold knob): records idle past the
@@ -448,21 +475,69 @@ def sessions_step(
         # cumulative completion count the workload reconciliation
         # reads, so conservation (sum(sess_total) == completed) holds
         # across expiries exactly.
-        idle = (sess_res >= 0) & (
-            jnp.asarray(t, jnp.int32) - sess_res > plan.session_ttl
-        )
+        if packed:
+            # The bitmap scheme's HBM win: expiry clears 1-bit flags
+            # and never rewrites the two [L, S] int32 planes (their
+            # stale values are masked by canonical_sessions on every
+            # read path). sess_res is only consulted under a live bit,
+            # where it is always current — same idle set as unpacked.
+            live = packing.occ_unpack(sess_occ, S)
+            idle = live & (
+                jnp.asarray(t, jnp.int32) - sess_res > plan.session_ttl
+            )
+            sess_occ = packing.occ_clear(sess_occ, idle)
+        else:
+            idle = (sess_res >= 0) & (
+                jnp.asarray(t, jnp.int32) - sess_res > plan.session_ttl
+            )
+            sess_last = jnp.where(idle, -1, sess_last)
+            sess_res = jnp.where(idle, -1, sess_res)
         expired = expired + jnp.sum(idle)
-        sess_last = jnp.where(idle, -1, sess_last)
-        sess_res = jnp.where(idle, -1, sess_res)
     return dataclasses.replace(
         lcs,
         sess_total=after,
         sess_last=sess_last,
         sess_res=sess_res,
+        sess_occ=sess_occ,
         resubmits=resubmits,
         cache_hits=cache_hits,
         expired=expired,
     )
+
+
+def canonical_sessions(
+    plan: LifecyclePlan, lcs: LifecycleState
+) -> LifecycleState:
+    """The UNPACKED-EQUIVALENT view of a session table: under the
+    packed occupancy bitmap, dead cells keep stale ``sess_last`` /
+    ``sess_res`` values (expiry clears only their bit); this masks
+    them back to the -1 sentinels, so ``canonical_sessions(packed
+    run) == unpacked run`` EXACTLY — the bit-identity contract
+    ``tests/test_packing.py`` pins 3-seed. Identity on unpacked (and
+    session-less) states."""
+    if not plan.has_sessions or lcs.sess_occ.shape[-1] == 0:
+        return lcs
+    S = lcs.sess_last.shape[1]
+    live = packing.occ_unpack(lcs.sess_occ, S)
+    return dataclasses.replace(
+        lcs,
+        sess_last=jnp.where(live, lcs.sess_last, -1),
+        sess_res=jnp.where(live, lcs.sess_res, -1),
+    )
+
+
+def live_sessions(plan: LifecyclePlan, lcs: LifecycleState) -> jnp.ndarray:
+    """Traced scalar: DISTINCT sessions currently live in the table
+    (the denominator of the million-session bench leg). Popcount of
+    the occupancy bitmap when packed, the sentinel census otherwise."""
+    if not plan.has_sessions:
+        return jnp.zeros((), jnp.int32)
+    if lcs.sess_occ.shape[-1] > 0:
+        S = lcs.sess_last.shape[1]
+        return jnp.sum(
+            packing.occ_unpack(lcs.sess_occ, S).astype(jnp.int32)
+        )
+    return jnp.sum((lcs.sess_last >= 0).astype(jnp.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -585,6 +660,10 @@ def invariants_ok(
     is inactive."""
     ok = jnp.asarray(True)
     if plan.has_sessions:
+        # Under the packed bitmap the conservation laws hold of the
+        # canonical (sentinel-masked) view — dead cells' stale values
+        # are storage noise, not bookkeeping.
+        lcs = canonical_sessions(plan, lcs)
         S = lcs.sess_last.shape[1]
         ok = (
             ok
@@ -645,6 +724,8 @@ def summary(plan: LifecyclePlan, lcs: LifecycleState) -> dict:
         out.update(
             sessions=plan.sessions,
             completions_recorded=int(np.sum(lcs.sess_total)),
+            distinct_live=int(live_sessions(plan, lcs)),
+            packed_occupancy=bool(lcs.sess_occ.shape[-1] > 0),
             resubmits=int(lcs.resubmits),
             cache_hits=int(lcs.cache_hits),
         )

@@ -50,6 +50,7 @@ from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 EMPTY = 0
@@ -256,6 +257,10 @@ def tick(
         t,
     )
     newly_chosen = (status == PROPOSED) & (nvotes >= f + 1)
+    # Span sampler input, captured BEFORE retirement wipes the vote
+    # plane: mencius runs on ABSOLUTE message clocks, so a vote is
+    # visible exactly when the quorum counter sees it (arrival <= t).
+    span_voted = jnp.any(voted & (p2b_arrival <= t), axis=2)
     chosen_tick = jnp.where(newly_chosen, t, state.chosen_tick)
     replica_arrival = jnp.where(newly_chosen, t + rep_lat, state.replica_arrival)
     status = jnp.where(newly_chosen, CHOSEN, status)
@@ -399,6 +404,30 @@ def tick(
         queue_capacity=L * W,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+
+    # Span sampler (telemetry.record_spans — the generic plumbing):
+    # slot lifecycles in the striped log, recorded from the masks this
+    # tick already computed. Mapping: group = leader stripe, slot id =
+    # the owned ordinal at each ring position (OLD head — valid for
+    # every cell occupied at tick start, including this tick's
+    # retirees); a cell proposed THIS tick carries the OLD next_slot
+    # ordinal (``new_ord`` — retire + re-propose in one tick crosses a
+    # full window). No phase-1 plane in steady-state Mencius (each
+    # leader owns its stripe, so there is nothing to promise).
+    # Structurally OFF at spans=0, like the counter ring.
+    if telemetry_mod.span_slots(tel):
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=is_new,
+            slot_ids=state.head[:, None]
+            + (w_iota[None, :] - state.head[:, None]) % W,
+            new_slot_ids=new_ord,
+            phase1_mark=jnp.zeros((L,), bool),
+            voted=span_voted,
+            newly_chosen=newly_chosen,
+            retire_mask=retire_mask,
+        )
 
     return BatchedMenciusState(
         next_slot=next_slot,

@@ -72,6 +72,7 @@ from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan, LifecycleState
@@ -234,6 +235,14 @@ class BatchedMultiPaxosConfig:
     # multipaxos_p1_promise plane). LifecyclePlan.none() is a
     # structural no-op: default runs stay bit-identical.
     lifecycle: LifecyclePlan = LifecyclePlan.none()
+    # Bit-packed hot narrow planes (tpu/packing.py, the dtype policy's
+    # sub-byte tier): carry the 2-bit status/rb_status planes and the
+    # session-table occupancy bits packed into int32 words in the scan
+    # carry. The tick unpacks ONCE at entry and packs ONCE at exit, so
+    # every tick equation (and kernel plane) sees the identical int8
+    # arrays — packed runs are bit-identical to the unpacked twin by
+    # construction (tests/test_packing.py, 3 seeds).
+    pack_planes: bool = False
 
     @property
     def num_matchmakers(self) -> int:
@@ -435,6 +444,19 @@ class BatchedMultiPaxosState:
     telemetry: Telemetry
 
 
+def _pack_status(cfg, plane: jnp.ndarray) -> jnp.ndarray:
+    """Status-plane storage form: packed int32 words under
+    ``cfg.pack_planes``, the int8 plane itself otherwise."""
+    return packing.pack_status(plane) if cfg.pack_planes else plane
+
+
+def _unpack_status(cfg, words: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Inverse of :func:`_pack_status` (identity when unpacked)."""
+    return (
+        packing.unpack_status(words, size) if cfg.pack_planes else words
+    )
+
+
 def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
     G, W, A = cfg.num_groups, cfg.window, cfg.group_size
     RW = cfg.read_window
@@ -442,7 +464,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         leader_round=jnp.zeros((G,), DTYPE_ROUND),
         next_slot=jnp.zeros((G,), jnp.int32),
         head=jnp.zeros((G,), jnp.int32),
-        status=jnp.zeros((G, W), DTYPE_STATUS),
+        status=_pack_status(cfg, jnp.zeros((G, W), DTYPE_STATUS)),
         slot_value=jnp.full((G, W), NO_VALUE, jnp.int32),
         propose_tick=jnp.full((G, W), INF, jnp.int32),
         last_send=jnp.full((G, W), INF, jnp.int32),
@@ -504,7 +526,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         req_arrival=jnp.full((A, G, RW), INF16, DTYPE_CLOCK),
         resp_slot=jnp.full((A, G, RW), -1, jnp.int32),
         resp_arrival=jnp.full((A, G, RW), INF16, DTYPE_CLOCK),
-        rb_status=jnp.zeros((G, RW), DTYPE_STATUS),
+        rb_status=_pack_status(cfg, jnp.zeros((G, RW), DTYPE_STATUS)),
         rb_count=jnp.zeros((G, RW), jnp.int32),
         rb_wave=jnp.full((G, RW), -1, jnp.int32),
         rb_issue=jnp.full((G, RW), INF, jnp.int32),
@@ -518,7 +540,8 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
         read_lin_violations=jnp.zeros((), jnp.int32),
         workload=workload_mod.make_state(cfg.workload, G, cfg.faults),
         lifecycle=lifecycle_mod.make_state(
-            cfg.lifecycle, G, acceptor_shape=(A, G)
+            cfg.lifecycle, G, acceptor_shape=(A, G),
+            packed=cfg.pack_planes,
         ),
         telemetry=make_telemetry(),
     )
@@ -604,7 +627,11 @@ def tick(
     p2b_lat = p2b_lat.astype(clock_dtype)
     retry_lat = retry_lat.astype(clock_dtype)
 
-    status = state.status
+    # Packed planes unpack ONCE here (identity when cfg.pack_planes is
+    # off): every equation below reads the same int8 [G, W] array the
+    # unpacked twin reads, so the two configs are bit-identical by
+    # construction and only the scan carry's HBM bytes differ.
+    status = _unpack_status(cfg, state.status, W)
     w_iota = jnp.arange(W, dtype=jnp.int32)  # ring positions
     # Global group ids, fed to the dispatch planes (fresh proposal
     # values encode slot * G + g): an explicit input rather than an
@@ -1338,7 +1365,7 @@ def tick(
     req_arrival = state.req_arrival
     resp_slot = state.resp_slot
     resp_arrival = state.resp_arrival
-    rb_status = state.rb_status
+    rb_status = _unpack_status(cfg, state.rb_status, cfg.read_window)
     rb_count = state.rb_count
     rb_wave = state.rb_wave
     rb_issue = state.rb_issue
@@ -1725,7 +1752,7 @@ def tick(
         leader_round=leader_round,
         next_slot=next_slot,
         head=head,
-        status=status,
+        status=_pack_status(cfg, status),
         slot_value=slot_value,
         propose_tick=propose_tick,
         last_send=last_send,
@@ -1773,7 +1800,7 @@ def tick(
         req_arrival=req_arrival,
         resp_slot=resp_slot,
         resp_arrival=resp_arrival,
-        rb_status=rb_status,
+        rb_status=_pack_status(cfg, rb_status),
         rb_count=rb_count,
         rb_wave=rb_wave,
         rb_issue=rb_issue,
@@ -1815,7 +1842,7 @@ def leader_change(
     slot_value, p2a_arrival, p2b_arrival, last_send = ops_registry.dispatch(
         "multipaxos_p1_promise",
         cfg,
-        state.status,
+        _unpack_status(cfg, state.status, W),
         state.vote_round,
         state.vote_value,
         state.slot_value,
@@ -1859,7 +1886,9 @@ def reconfigure(
     the analog of old configurations being garbage collected only once
     the chosen watermark passes them (Reconfigurer/GC pipeline)."""
     state = leader_change(cfg, state, t, key)  # also clears pending Phase2bs
-    in_flight = (state.status == PROPOSED)[None, :, :]
+    in_flight = (
+        _unpack_status(cfg, state.status, cfg.window) == PROPOSED
+    )[None, :, :]
     return dataclasses.replace(
         state,
         acc_round=jnp.broadcast_to(
@@ -1903,7 +1932,10 @@ def check_invariants(
     """Device-side safety checks (the batched analog of the sim invariants).
     Returns a dict of boolean scalars; all must be True."""
     f = cfg.f
-    chosen = state.status == CHOSEN
+    # Packed storage: invariants read the unpacked (int8) view.
+    status = _unpack_status(cfg, state.status, cfg.window)
+    rb_status = _unpack_status(cfg, state.rb_status, cfg.read_window)
+    chosen = status == CHOSEN
     # Chosen slots have a quorum of votes at (or, after a repair
     # re-proposal bumped vote_round, above) the round they were chosen in.
     # Offset clocks: "arrived" is offset <= 0 (INF16 = never).
@@ -1947,14 +1979,12 @@ def check_invariants(
     # Trivially true when reads are off (empty arrays).
     read_lin_ok = state.read_lin_violations == 0
     read_ring_ok = (
-        jnp.all(
-            (state.rb_status >= R_EMPTY) & (state.rb_status <= R_SENT)
-        )
+        jnp.all((rb_status >= R_EMPTY) & (rb_status <= R_SENT))
         # A batch carries reads iff it exists (count bookkeeping).
-        & jnp.all((state.rb_count == 0) == (state.rb_status == R_EMPTY))
+        & jnp.all((state.rb_count == 0) == (rb_status == R_EMPTY))
         & jnp.all(state.rb_count >= 0)
         # A waiting batch always references the wave it rides.
-        & jnp.all(jnp.where(state.rb_status == R_WAIT, state.rb_wave >= 0, True))
+        & jnp.all(jnp.where(rb_status == R_WAIT, state.rb_wave >= 0, True))
     )
     # Global slot numbering (s*G + g) is int32: it overflows once any
     # group's head passes 2^31/G (~644k slots at G=3334), after which the

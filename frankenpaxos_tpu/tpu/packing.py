@@ -1,0 +1,213 @@
+"""Bit-packed plane codecs: the dtype policy's sub-byte tier.
+
+The PR 1 dtype policy (tpu/common.py) stopped at int8 — the narrowest
+dtype XLA stores natively. But the hot narrow planes are narrower than
+that: a slot status is one of three codes (2 bits), a session-table
+occupancy flag is one bit. On a bandwidth-bound tick (the whole
+simulation is elementwise sweeps over carried state) an int8 plane
+still moves a full byte per 2-bit value, so the scan carry pays 4x the
+bytes the information content demands. This module packs those planes
+into int32 WORDS (the natural XLA storage/vector width): 16 status
+codes or 32 occupancy bits per word, little-endian within the word.
+
+Contract — the packed plane is a pure STORAGE transform:
+
+  * ``unpack_*(pack_*(x)) == x`` exactly (values must fit their bit
+    width; packers mask defensively).
+  * Backends adopting a packed plane unpack ONCE at tick entry into
+    the same local the unpacked twin reads, and pack ONCE at tick
+    exit — every tick equation (and every kernel plane) sees the
+    identical unpacked array, so packed runs are bit-identical to
+    unpacked runs BY CONSTRUCTION (pinned 3-seed by
+    ``tests/test_packing.py``). Only the scan-carry HBM traffic
+    changes.
+  * ALL bit-twiddling on packed planes lives HERE. The
+    ``packing-containment`` analysis rule rejects raw shift/mask
+    arithmetic on packed-plane fields (``common.PACKED_PLANES``)
+    anywhere else in ``tpu/`` — the same single-dispatch-point
+    discipline ``kernel-pallas-containment`` enforces for Pallas.
+  * ``widen_state()`` passes packed words through untouched (they are
+    int32 already): the widen twin of a packed run replays the packed
+    program, and the packed-vs-unpacked comparison is pinned by its
+    own twin tests instead.
+
+The trace codec at the bottom serves the workload engine's
+trace-driven open-loop mode (``WorkloadPlan(arrival="trace")``): one
+int32 word per arrival event, ``(dt << 16) | lane`` — delta-encoded
+ticks so a million-event trace is device-resident in 4 MB and replayed
+by an in-graph cursor with no host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import DTYPE_STATUS
+
+# Bit widths of the packed planes (mirrored by common.PACKED_PLANES,
+# the policy descriptor the analysis rule and the bench memory block
+# read).
+STATUS_BITS = 2  # EMPTY | PROPOSED | CHOSEN and the read-ring phases
+OCC_BITS = 1  # session-table occupancy flags
+
+_WORD_BITS = 32
+
+
+def words_for(size: int, bits: int) -> int:
+    """int32 words needed to pack ``size`` values of ``bits`` bits."""
+    assert bits in (1, 2, 4, 8, 16) and size >= 0
+    per = _WORD_BITS // bits
+    return (size + per - 1) // per
+
+
+def _as_u32(words: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.bitcast_convert_type(words, jnp.uint32)
+
+
+def _as_i32(words: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.bitcast_convert_type(words, jnp.int32)
+
+
+def pack_plane(x: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Pack the LAST axis of a small-nonnegative-integer (or bool)
+    plane into int32 words, ``32 // bits`` values per word,
+    little-endian within the word (value ``i`` occupies bits
+    ``[bits*(i % per), bits*(i % per + 1))`` of word ``i // per``).
+    The tail word zero-pads. Values are masked to ``bits`` bits."""
+    per = _WORD_BITS // bits
+    size = x.shape[-1]
+    nw = words_for(size, bits)
+    mask = jnp.uint32((1 << bits) - 1)
+    xu = x.astype(jnp.uint32) & mask
+    pad = nw * per - size
+    if pad:
+        xu = jnp.pad(xu, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xu = xu.reshape(x.shape[:-1] + (nw, per))
+    shifts = (jnp.arange(per, dtype=jnp.uint32) * bits).astype(jnp.uint32)
+    # Disjoint bit fields: the sum IS the bitwise-or of the shifted
+    # lanes, and XLA fuses it into the surrounding elementwise sweep.
+    words = jnp.sum(xu << shifts, axis=-1, dtype=jnp.uint32)
+    return _as_i32(words)
+
+
+def unpack_plane(
+    words: jnp.ndarray, bits: int, size: int, dtype=jnp.int32
+) -> jnp.ndarray:
+    """Inverse of :func:`pack_plane`: expand int32 words back to
+    ``size`` values of ``dtype`` along the last axis."""
+    per = _WORD_BITS // bits
+    mask = jnp.uint32((1 << bits) - 1)
+    wu = _as_u32(words)
+    shifts = (jnp.arange(per, dtype=jnp.uint32) * bits).astype(jnp.uint32)
+    vals = (wu[..., None] >> shifts) & mask
+    vals = vals.reshape(words.shape[:-1] + (words.shape[-1] * per,))
+    return vals[..., :size].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Status planes (2-bit codes, int8 unpacked twin)
+# ---------------------------------------------------------------------------
+
+
+def pack_status(status: jnp.ndarray) -> jnp.ndarray:
+    """Pack an ``[..., W]`` status/phase plane (codes < 4) into
+    ``[..., words_for(W, 2)]`` int32 words."""
+    return pack_plane(status, STATUS_BITS)
+
+
+def unpack_status(words: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Unpack a packed status plane back to its ``DTYPE_STATUS``
+    (int8) twin — the array every tick equation and kernel plane
+    reads, byte-identical to the unpacked backend's."""
+    return unpack_plane(words, STATUS_BITS, size, DTYPE_STATUS)
+
+
+# ---------------------------------------------------------------------------
+# Occupancy bitmaps (1-bit liveness, bool unpacked twin)
+# ---------------------------------------------------------------------------
+
+
+def make_occ(lanes: int, size: int) -> jnp.ndarray:
+    """An all-dead ``[lanes, words_for(size, 1)]`` occupancy bitmap."""
+    return jnp.zeros((lanes, words_for(size, OCC_BITS)), jnp.int32)
+
+
+def occ_unpack(occ: jnp.ndarray, size: int) -> jnp.ndarray:
+    """``[..., size]`` bool liveness view of a packed bitmap."""
+    return unpack_plane(occ, OCC_BITS, size, jnp.int32).astype(bool)
+
+
+def occ_set(occ: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Set the bits where ``mask`` (``[..., size]`` bool) holds."""
+    return _as_i32(_as_u32(occ) | _as_u32(pack_plane(mask, OCC_BITS)))
+
+
+def occ_clear(occ: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Clear the bits where ``mask`` (``[..., size]`` bool) holds."""
+    return _as_i32(_as_u32(occ) & ~_as_u32(pack_plane(mask, OCC_BITS)))
+
+
+def occ_get(occ: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane single-bit gather: ``idx`` is ``[L]`` (one position per
+    lane of an ``[L, words]`` bitmap); returns ``[L]`` bool."""
+    word = jnp.take_along_axis(
+        occ, (idx // _WORD_BITS)[:, None], axis=1
+    )[:, 0]
+    bit = (_as_u32(word) >> (idx % _WORD_BITS).astype(jnp.uint32)) & 1
+    return bit.astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# Trace codec (the workload engine's open-loop arrival trace)
+# ---------------------------------------------------------------------------
+
+# One event per int32 word: (delta-tick << 16) | lane. Both fields are
+# 16-bit — inter-arrival gaps beyond 65535 ticks and lane axes beyond
+# 65536 lanes need a wider codec than a million-session brick does.
+TRACE_DT_BITS = 16
+TRACE_LANE_MASK = (1 << TRACE_DT_BITS) - 1
+
+
+def encode_trace(ticks, lane_ids):
+    """HOST-side trace encoder: absolute arrival ``ticks``
+    (nondecreasing) + ``lane_ids`` -> one int32 word per event,
+    delta-encoded against the previous event (the first event's delta
+    is its absolute tick). Returns a numpy int32 array sized for
+    ``WorkloadState.trace``."""
+    import numpy as np
+
+    ticks = np.asarray(ticks, np.int64)
+    lane_ids = np.asarray(lane_ids, np.int64)
+    assert ticks.shape == lane_ids.shape and ticks.ndim == 1
+    assert ticks.size > 0, "an empty trace has no arrival process"
+    dts = np.diff(ticks, prepend=np.int64(0))
+    assert (dts >= 0).all(), "trace ticks must be nondecreasing"
+    assert (dts <= TRACE_LANE_MASK).all(), (
+        "inter-arrival gap exceeds the 16-bit delta field"
+    )
+    assert (lane_ids >= 0).all() and (lane_ids <= TRACE_LANE_MASK).all()
+    words = (dts.astype(np.uint32) << TRACE_DT_BITS) | lane_ids.astype(
+        np.uint32
+    )
+    return words.view(np.int32)
+
+
+def decode_trace(words: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """In-graph decoder: packed trace words -> ``(dts, lanes)`` int32
+    pairs (delta ticks against the previous event, lane ids)."""
+    wu = _as_u32(words)
+    dts = (wu >> TRACE_DT_BITS).astype(jnp.int32)
+    lanes = (wu & jnp.uint32(TRACE_LANE_MASK)).astype(jnp.int32)
+    return dts, lanes
+
+
+def trace_first_time(words) -> int:
+    """HOST-side: the absolute tick of a trace's first event (what
+    ``workload.load_trace`` seeds the in-graph cursor clock with)."""
+    import numpy as np
+
+    w0 = np.asarray(words, np.int32).reshape(-1)[0]
+    return int(np.uint32(w0) >> TRACE_DT_BITS)

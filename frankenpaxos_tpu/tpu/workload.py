@@ -74,6 +74,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 
 # Stream id folded into a tick's key before drawing any workload
@@ -87,9 +88,14 @@ WAIT_BINS = 32
 # 16-bit fixed point for the deterministic arrival/read accumulators.
 _FP_ONE = 65536
 
-ARRIVALS = ("saturate", "constant", "poisson", "bursty", "diurnal")
+ARRIVALS = ("saturate", "constant", "poisson", "bursty", "diurnal", "trace")
 
 _RATE_FIELDS = ("rate", "burst_mult", "zipf_s", "read_fraction")
+
+# Backends with a device read path (a read ring the engine's read split
+# can feed). The read-mix validation names these so a misconfigured run
+# fails with the fix in the message, not just the symptom.
+READ_BACKENDS = ("craq", "compartmentalized", "multipaxos")
 
 
 def zipf_weights(n: int, s: float):
@@ -141,6 +147,15 @@ class WorkloadPlan:
     # Per-lane FIFO backlog bound (open-loop shaping): arrivals beyond
     # it are SHED (counted, never silently queued without bound).
     backlog_cap: int = 1024
+    # "trace": a recorded open-loop arrival schedule replayed by an
+    # in-graph cursor — trace_len events, one int32 word per event
+    # (``packing.encode_trace``: delta-encoded tick << 16 | lane), the
+    # words themselves installed as STATE (``load_trace``) so swapping
+    # traces never recompiles. Up to trace_chunk events fire per tick;
+    # a hotter instant defers the excess to the next tick (FIFO order
+    # and exactly-once accounting preserved — the backlog absorbs it).
+    trace_len: int = 0
+    trace_chunk: int = 8
 
     # -- structural predicates (all trace-time Python bools) ------------
 
@@ -177,7 +192,17 @@ class WorkloadPlan:
             f"workload.arrival={self.arrival!r} not in {ARRIVALS}"
         )
         assert self.rate >= 0.0
-        if self.shaped:
+        if self.arrival == "trace":
+            assert self.trace_len > 0, (
+                "workload.arrival='trace' needs trace_len > 0 (the "
+                "event count load_trace will install)"
+            )
+            assert 1 <= self.trace_chunk <= 2**10
+            assert self.closed_window == 0, (
+                "a recorded trace IS the arrival schedule — closed-loop "
+                "gating would rewrite it (use an open-loop trace)"
+            )
+        elif self.shaped:
             assert self.rate > 0.0, (
                 "a shaped arrival process needs workload.rate > 0"
             )
@@ -190,7 +215,9 @@ class WorkloadPlan:
             assert self.shaped, "read_fraction needs an arrival process"
             assert reads_supported, (
                 "workload.read_fraction > 0 but this backend/config has "
-                "no device read path (enable its read ring, or mix 0)"
+                "no device read path; backends with one: "
+                + ", ".join(READ_BACKENDS)
+                + " (enable its read ring, or set read_fraction=0)"
             )
         if self.arrival == "bursty":
             assert 1 <= self.burst_len <= self.burst_every
@@ -249,6 +276,13 @@ class WorkloadState:
     in_flight: jnp.ndarray  # [L] int32 outstanding requests | [0]
     idle: jnp.ndarray  # [L] int32 clients ready to issue | [0]
     ready_ring: jnp.ndarray  # [L, think_time] int32 think expiries | [L, 0]
+    # Trace replay (arrival == "trace"): the recorded schedule itself is
+    # STATE — packing.encode_trace words installed by load_trace, the
+    # cursor and its absolute clock advanced in-graph — so swapping a
+    # million-event trace never recompiles.
+    trace: jnp.ndarray  # [trace_len] int32 (dt << 16 | lane) | [0]
+    trace_cursor: jnp.ndarray  # [] int32 next unfired event | [0]
+    trace_next: jnp.ndarray  # [] int32 absolute tick of that event | [0]
     # Cumulative accounting (plan.active).
     offered: jnp.ndarray  # [] int32 write arrivals drawn | [0]
     admitted: jnp.ndarray  # [] int32 admissions | [0]
@@ -270,8 +304,10 @@ def make_state(
     Ls = lanes if plan.shaped else 0
     Lc = lanes if plan.closed else 0
     TH = plan.think_time if (plan.closed and plan.think_time) else 0
+    NT = plan.trace_len if plan.arrival == "trace" else 0
     scalar = () if plan.active else (0,)
     sh_scalar = () if plan.shaped else (0,)
+    tr_scalar = () if NT else (0,)
     return WorkloadState(
         rate=(
             jnp.full((), plan.rate, jnp.float32)
@@ -287,6 +323,9 @@ def make_state(
         in_flight=jnp.zeros((Lc,), z32),
         idle=jnp.full((Lc,), plan.closed_window, z32),
         ready_ring=jnp.zeros((Lc, TH), z32),
+        trace=jnp.zeros((NT,), z32),
+        trace_cursor=jnp.zeros(tr_scalar, z32),
+        trace_next=jnp.zeros(tr_scalar, z32),
         offered=jnp.zeros(scalar, z32),
         admitted=jnp.zeros(scalar, z32),
         completed=jnp.zeros(scalar, z32),
@@ -340,7 +379,31 @@ def begin(
         z = jnp.zeros((0,), jnp.int32)
         return z, z, wls
     acc, racc = wls.acc, wls.racc
-    if plan.shaped:
+    trace_cursor, trace_next = wls.trace_cursor, wls.trace_next
+    if plan.arrival == "trace":
+        # Replay the recorded schedule: decode up to trace_chunk events
+        # at the cursor, fire the prefix whose absolute clocks have
+        # arrived, scatter-add them onto their lanes. No PRNG; the
+        # extra (+1-th) decode seeds the post-advance cursor clock.
+        CH, NT = plan.trace_chunk, plan.trace_len
+        idx = trace_cursor + jnp.arange(CH + 1, dtype=jnp.int32)
+        valid = idx < NT
+        words = jnp.take(wls.trace, jnp.clip(idx, 0, NT - 1))
+        dt, lane = packing.decode_trace(words)
+        # The cursor event's delta is already folded into trace_next
+        # (load_trace seeds it; each advance re-seeds it below).
+        times = trace_next + jnp.cumsum(dt.at[0].set(0))
+        # Nondecreasing times + prefix validity => fire is a PREFIX, so
+        # the cursor advance keeps FIFO order and fires each event
+        # exactly once. A tick hotter than the chunk defers the tail.
+        fire = valid & (times <= t)
+        n_fire = jnp.sum(fire[:CH].astype(jnp.int32))
+        arrivals = jnp.zeros((lanes,), jnp.int32).at[
+            jnp.where(fire[:CH], lane[:CH], 0)
+        ].add(fire[:CH].astype(jnp.int32))
+        trace_cursor = trace_cursor + n_fire
+        trace_next = jnp.take(times, n_fire)  # stable when exhausted
+    elif plan.shaped:
         lam = (
             wls.rate
             * _modulation(plan, t)
@@ -380,7 +443,8 @@ def begin(
         )
         ready_ring = jnp.where(slot[None, :], 0, ready_ring)
     return writes, reads, dataclasses.replace(
-        wls, acc=acc, racc=racc, idle=idle, ready_ring=ready_ring
+        wls, acc=acc, racc=racc, idle=idle, ready_ring=ready_ring,
+        trace_cursor=trace_cursor, trace_next=trace_next,
     )
 
 
@@ -510,6 +574,12 @@ def invariants_ok(plan: WorkloadPlan, wls: WorkloadState) -> jnp.ndarray:
             & jnp.all(wls.backlog <= plan.backlog_cap)
             & jnp.all(wls.adm_total >= 0)
         )
+    if plan.arrival == "trace":
+        ok = (
+            ok
+            & (wls.trace_cursor >= 0)
+            & (wls.trace_cursor <= plan.trace_len)
+        )
     return ok
 
 
@@ -548,6 +618,35 @@ def set_fault_rates(
     )
 
 
+def load_trace(wls: WorkloadState, words) -> WorkloadState:
+    """Install a host-encoded arrival trace (``packing.encode_trace``
+    words) into a trace-plan state and rewind the cursor. The trace is
+    STATE, not a trace constant: every install replays the same
+    compiled program (pinned by ``tests/test_workload.py``)."""
+    import numpy as np
+
+    words = np.asarray(words, np.int32)
+    assert wls.trace.shape == words.shape, (
+        f"trace has {words.shape[0]} events but the plan was built "
+        f"with trace_len={wls.trace.shape[0]} (the event count is "
+        "static; size the plan to the trace)"
+    )
+    lanes = wls.backlog.shape[0]
+    lane_ids = words.view(np.uint32) & np.uint32(packing.TRACE_LANE_MASK)
+    assert int(lane_ids.max()) < lanes, (
+        f"trace lane id {int(lane_ids.max())} out of range for "
+        f"{lanes} lanes"
+    )
+    return dataclasses.replace(
+        wls,
+        trace=jnp.asarray(words),
+        trace_cursor=jnp.zeros((), jnp.int32),
+        trace_next=jnp.full(
+            (), packing.trace_first_time(words), jnp.int32
+        ),
+    )
+
+
 def hist_percentile(hist, q: float) -> int:
     """Nearest-rank percentile of an integer histogram (bin index =
     value). -1 on an empty histogram. One algorithm repo-wide: this is
@@ -582,6 +681,11 @@ def summary(plan: WorkloadPlan, wls: WorkloadState) -> dict:
             queue_depth=int(np.sum(wls.backlog)),
             queue_wait_p50_ticks=hist_percentile(wls.wait_hist, 0.50),
             queue_wait_p99_ticks=hist_percentile(wls.wait_hist, 0.99),
+        )
+    if plan.arrival == "trace":
+        out.update(
+            trace_len=plan.trace_len,
+            trace_cursor=int(wls.trace_cursor),
         )
     if plan.closed:
         import numpy as np

@@ -20,4 +20,11 @@ cd "$(dirname "$0")/.."
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 fi
+# Fast pre-step: the per-rule lint suite (fixture teeth + rule
+# wrappers, seconds not minutes) fails fast before the full-registry
+# CLI compiles trace rules. Report goes to stderr so `--json` stdout
+# stays machine-parseable.
+if [[ "${LINT_SKIP_PYTEST:-0}" != 1 ]]; then
+  python -m pytest tests/ -m lint -q -p no:cacheprovider 1>&2 || exit $?
+fi
 exec python -m frankenpaxos_tpu.analysis "$@"

@@ -96,3 +96,9 @@ def reach_for_pallas(x):
 def stats(cfg, state, t) -> dict:
     # Reads `counter` but NOT `ghost` — ghost stays a dead write.
     return {"counter": int(state.counter.sum())}
+
+
+def twiddle_packed(state, idx):
+    # packing-containment: raw bit-twiddling on a packed plane (the
+    # sess_occ occupancy bitmap) outside tpu/packing.py.
+    return state.sess_occ | (1 << idx)

@@ -39,6 +39,7 @@ FIXTURE_RULES = [
     "workload-apply",
     "workload-rate-validated",
     "kernel-pallas-containment",
+    "packing-containment",
     "state-dead-write",
 ]
 
@@ -99,6 +100,7 @@ def test_dirty_fixture_expected_keys():
         ("workload-apply", "toy_batched.py"),
         ("workload-rate-validated", "workload.py:ToyWorkloadPlan:bad_fraction"),
         ("kernel-pallas-containment", "tpu/toy_batched.py"),
+        ("packing-containment", "tpu/toy_batched.py"),
         ("state-dead-write", "toy_batched.py:ghost"),
     }
     assert keys == expected, keys.symmetric_difference(expected)
@@ -201,7 +203,7 @@ def test_unknown_rule_id_raises():
 
 def test_rule_registry_shape():
     n = analysis.rule_count()
-    assert n >= 17, sorted(core.RULES)
+    assert n >= 18, sorted(core.RULES)
     layers = {r.layer for r in core.RULES.values()}
     assert layers == {"ast", "trace"}
     assert all(r.doc for r in core.RULES.values())

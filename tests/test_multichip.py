@@ -332,3 +332,128 @@ def test_registry_covers_the_sharded_families():
         # Every spec resolves its module and builds shardings.
         shardings = sh.state_shardings(spec.backend, _mesh())
         assert shardings
+
+
+# ---------------------------------------------------------------------------
+# Million-session layout (PR 16): the session table + workload lane
+# bookkeeping partition over the groups axis instead of replicating.
+# ---------------------------------------------------------------------------
+
+
+def _session_cfg(**kw):
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    kw.setdefault(
+        "workload", WorkloadPlan(arrival="constant", rate=1.5, zipf_s=0.8)
+    )
+    return mb.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, retry_timeout=8,
+        pack_planes=True,
+        lifecycle=LifecyclePlan(sessions=8, resubmit_rate=0.15),
+        **kw,
+    )
+
+
+def test_session_lane_state_is_group_sharded():
+    """The [L, S] session table (packed occupancy included) and the
+    workload engine's per-lane bookkeeping land P('groups') on the
+    mesh — NOT replicated (replication is what caps the distinct-
+    session count at one device's HBM). Non-lane client leaves (the
+    traced rate scalar, the rotation books) stay replicated."""
+    cfg = _session_cfg()
+    mesh = _mesh()
+    st = sh.shard_state("multipaxos", mb.init_state(cfg), mesh)
+
+    def spec(leaf):
+        return tuple(leaf.sharding.spec)
+
+    assert sh.GROUP_AXIS in spec(st.lifecycle.sess_last)
+    assert sh.GROUP_AXIS in spec(st.lifecycle.sess_res)
+    assert sh.GROUP_AXIS in spec(st.lifecycle.sess_occ)
+    assert sh.GROUP_AXIS in spec(st.workload.backlog)
+    assert sh.GROUP_AXIS in spec(st.workload.adm_total)
+    assert sh.GROUP_AXIS not in spec(st.lifecycle.rot_count)
+    assert sh.GROUP_AXIS not in spec(st.workload.rate)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_sessions_bit_identity(seed):
+    """Sharded sessions + packed planes == the unsharded run, bit for
+    bit, across TWO segments (the output constraint keeps the layout
+    pinned between executables) — exactly-once books included."""
+    cfg = _session_cfg()
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+
+    st = sh.shard_state("multipaxos", mb.init_state(cfg), mesh)
+    st, t = sh.run_ticks_sharded("multipaxos", cfg, mesh, st, t0, 30, key)
+    st, t = sh.run_ticks_sharded("multipaxos", cfg, mesh, st, t, 30, key)
+
+    ust = mb.init_state(cfg)
+    ust, ut = mb.run_ticks(cfg, ust, t0, 30, key)
+    ust, ut = mb.run_ticks(cfg, ust, ut, 30, key)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(ust)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(st.lifecycle.cache_hits) > 0  # exactly-once exercised
+
+
+def test_sharded_trace_mode_checkpoint_resume_bit_exact(tmp_path):
+    """The full PR 16 composition: a trace-driven open-loop run with
+    packed planes and group-sharded sessions checkpoints mid-flight
+    (PR 13 subsystem) and resumes to the uninterrupted twin's exact
+    bits — the cursor, the session table, and every protocol plane."""
+    from frankenpaxos_tpu.tpu import checkpoint as ck
+    from frankenpaxos_tpu.tpu import packing
+    from frankenpaxos_tpu.tpu import workload as workload_mod
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    n = 64
+    rng = np.random.default_rng(5)
+    words = packing.encode_trace(
+        np.sort(rng.integers(0, 40, size=n)).astype(np.int64),
+        rng.integers(0, 8, size=n).astype(np.int64),
+    )
+    cfg = _session_cfg(
+        workload=WorkloadPlan(arrival="trace", trace_len=n)
+    )
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def fresh():
+        st = mb.init_state(cfg)
+        st = dataclasses.replace(
+            st, workload=workload_mod.load_trace(st.workload, words)
+        )
+        return sh.shard_state("multipaxos", st, mesh)
+
+    # Uninterrupted twin: two 30-tick segments.
+    tw, tt = sh.run_ticks_sharded(
+        "multipaxos", cfg, mesh, fresh(), t0, 30, key
+    )
+    tw, tt = sh.run_ticks_sharded("multipaxos", cfg, mesh, tw, tt, 30, key)
+
+    # Checkpointed run: segment, save, restore into a FRESH sharded
+    # state, second segment.
+    st, t = sh.run_ticks_sharded(
+        "multipaxos", cfg, mesh, fresh(), t0, 30, key
+    )
+    d = str(tmp_path / "ck")
+    ck.save_state(d, mb, cfg, st, t, step=0)
+    restored, t_r, _ = ck.restore_state(d, mb, cfg, fresh())
+    restored = sh.shard_state("multipaxos", restored, mesh)
+    restored, t_r = sh.run_ticks_sharded(
+        "multipaxos", cfg, mesh, restored, t_r, 30, key
+    )
+
+    assert int(t_r) == int(tt)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(tw)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.workload.trace_cursor) == n

@@ -797,3 +797,77 @@ def test_craq_serve_perfetto_round_trip(tmp_path):
     lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
     assert lifecycles
     assert all("committed" in e["args"] for e in lifecycles)
+
+
+# ---------------------------------------------------------------------------
+# Span sampler on mencius (the fourth spans backend)
+# ---------------------------------------------------------------------------
+
+
+def test_mencius_span_sampler_stamps_and_structural_noop():
+    """mencius records spans through the generic telemetry plumbing:
+    ordered stage stamps on the striped log (proposed < quorum vote <=
+    chosen <= global-watermark retire), spans=0 stays a structural
+    no-op (bit-identical protocol state), no phase-1 stamps (each
+    leader owns its stripe), and every stripe gets sampled."""
+    from frankenpaxos_tpu.tpu import mencius_batched as mc
+
+    cfg = mc.analysis_config()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def run(spans):
+        st = dataclasses.replace(
+            mc.init_state(cfg), telemetry=T.make_telemetry(64, spans=spans)
+        )
+        st, _ = mc.run_ticks(cfg, st, t0, 50, key)
+        return st
+
+    on, off = run(8), run(0)
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(on, f.name)),
+            jax.tree_util.tree_leaves(getattr(off, f.name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
+    np.testing.assert_array_equal(
+        np.asarray(on.telemetry.totals), np.asarray(off.telemetry.totals)
+    )
+    spans, dropped, _ = T.completed_spans(on.telemetry)
+    assert spans and dropped == 0
+    for s in spans:
+        assert 0 <= s["proposed"] < s["committed"] <= s["executed"], s
+        assert s["proposed"] < s["phase2_voted"] <= s["committed"], s
+        assert s["phase1_promised"] == -1, s  # no phase-1 on a stripe
+    # The round-robin stripes all commit, so the reservoir sees all of
+    # them (slot ids are owned ordinals: distinct mod num_leaders).
+    assert {s["group"] for s in spans} == set(range(cfg.num_leaders))
+
+
+def test_mencius_serve_perfetto_round_trip(tmp_path):
+    """The serve loop over mencius with the span sampler on: the
+    Perfetto export round-trips with DEVICE lifecycle slices (mencius
+    striped-log spans) and host dispatch spans in one timeline."""
+    from frankenpaxos_tpu.tpu import mencius_batched as mc
+
+    cfg = mc.analysis_config()
+    out = tmp_path / "mencius_trace.json"
+    serve = ServeConfig(
+        chunk_ticks=16, telemetry_window=64, spans=8,
+        trace_path=str(out), max_chunks=4,
+    )
+    loop = ServeLoop(mc, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["clean_shutdown"] and report["spans_exported"] > 0
+    payload = traceviz.load_chrome_trace(str(out))
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    device = [e for e in xs if e["pid"] == traceviz.DEVICE_PID]
+    host = [e for e in xs if e["pid"] == traceviz.HOST_PID]
+    assert device and host
+    lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
+    assert lifecycles
+    assert all("committed" in e["args"] for e in lifecycles)
