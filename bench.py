@@ -1408,6 +1408,33 @@ def _workload_inner() -> None:
         "closed_loop",
     )
 
+    # Predicted-vs-observed: the roofline model's pre-run saturation
+    # forecast (ops/costmodel.py) recorded next to the measurement —
+    # the observatory's ground-truth anchor. On the CPU host the
+    # acceptance bar is within-2x; TPU exactness is hardware debt.
+    from frankenpaxos_tpu.ops import costmodel
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    predicted = costmodel.predict_saturation(
+        G, W, K, lat_min=cfg0.lat_min, lat_max=cfg0.lat_max,
+        params=costmodel.TPU_V5E if on_tpu else costmodel.CPU_JIT,
+    )
+    predicted_vs_observed = {
+        "predicted": predicted,
+        "observed_committed_per_tick": sat["committed_per_tick"],
+        "observed_committed_per_sec": sat["committed_per_sec"],
+        "per_tick_ratio": round(
+            sat["committed_per_tick"]
+            / max(predicted["committed_per_tick"], 1e-9), 4
+        ),
+        "per_sec_ratio": round(
+            sat["committed_per_sec"]
+            / max(predicted["committed_per_sec"], 1e-9), 4
+        ),
+        "constants_version": costmodel.CONSTANTS_VERSION,
+        "tpu_exactness_is_hardware_debt": on_tpu,
+    }
+
     result = {
         "metric": (
             "flagship latency vs offered load under the in-graph "
@@ -1417,6 +1444,7 @@ def _workload_inner() -> None:
         "device": str(jax.devices()[0]),
         "num_acceptors": cfg0.num_acceptors,
         "saturation": sat,
+        "predicted_saturation": predicted_vs_observed,
         "saturation_rate_per_lane_per_tick": round(sat_rate_lane, 4),
         "arrival_process": plan.arrival,
         "offered_load_matrix": matrix,
@@ -2607,6 +2635,18 @@ def _prefer_last_good(cpu_live: dict, notes: list) -> dict:
     result["staleness_hours"] = _staleness_hours(
         result.get("captured_at", "")
     )
+    # Model plausibility check (ops/costmodel.py): the promoted
+    # headline gets an explicit ``model_flagged`` provenance field
+    # when its rate is implausible against the roofline's predicted
+    # saturation for the capture's device class — e.g. the
+    # pre-kernel-layer BENCH_r05 4.0M entries/sec TPU capture sits
+    # ~50x under the hardware ceiling the model predicts for the
+    # current tree, so it surfaces flagged, never silently.
+    from frankenpaxos_tpu.ops import costmodel
+
+    costmodel.flag_capture(result)
+    if result.get("model_flagged"):
+        notes.append(result["model_flag_reason"])
     result["live_cpu_fallback"] = {
         "value": cpu_live.get("value"),
         "unit": cpu_live.get("unit"),

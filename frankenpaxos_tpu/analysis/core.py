@@ -37,7 +37,14 @@ from frankenpaxos_tpu.analysis import astutil
 # brick is one compiled executable per product mesh (flat jit cache
 # across traced-rate re-sweeps) and no signed collective crosses the
 # fleet axis (replica-group census) or moves state at all.
-ANALYSIS_VERSION = "2.0"
+# 2.1: the performance-observatory gates — costmodel-coverage (every
+# registered plane, every PACKED_PLANES entry, and the unfused
+# reference tick carry stated byte/FLOP terms in ops/costmodel.py)
+# and costmodel-drift (every recorded kernel microbench capture sits
+# inside the model's measured/predicted envelope, no round-over-round
+# ratio regression, and results/costmodel_envelope.json matches the
+# in-tree model constants).
+ANALYSIS_VERSION = "2.1"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

@@ -1208,3 +1208,223 @@ def check_packing_containment(ctx: Context) -> List[Finding]:
                 )
             )
     return out
+
+
+@rule(
+    "costmodel-coverage",
+    "ast",
+    "every registered kernel plane (and every PACKED_PLANES entry, and "
+    "the unfused reference tick) has a cost-model entry with stated "
+    "byte/FLOP terms (ops/costmodel.py)",
+)
+def check_costmodel_coverage(ctx: Context) -> List[Finding]:
+    if not (ctx.importable and ctx.is_real_tree()):
+        return []
+    from frankenpaxos_tpu.ops import costmodel, registry
+    from frankenpaxos_tpu.tpu import common
+
+    PATH = "frankenpaxos_tpu/ops/costmodel.py"
+    out: List[Finding] = []
+    required = sorted(set(registry.PLANES) | {"multipaxos_unfused_tick"})
+    for name in required:
+        model = costmodel.MODELS.get(name)
+        if model is None:
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"plane {name!r} has no cost-model entry — "
+                        "state its byte/FLOP terms in costmodel.MODELS"
+                    ),
+                    key=name,
+                )
+            )
+            continue
+        key = costmodel.CAPTURE_KEYS.get(name)
+        if key is None and name in registry.PLANES:
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"plane {name!r} has no CAPTURE_KEYS flagship "
+                        "shape — microbench captures of it cannot be "
+                        "validated"
+                    ),
+                    key=f"{name}:capture-key",
+                )
+            )
+        # The stated terms must be live at SOME shape: the flagship
+        # capture key when recorded, else a synthetic small key of the
+        # right arity (probed via the model's own input spec).
+        if key is None:
+            key = costmodel.CAPTURE_KEYS["multipaxos_fused_tick"]
+        try:
+            ok = (
+                costmodel.bytes_moved(name, key) > 0
+                and costmodel.flops(name, key) > 0
+            )
+        except Exception as e:  # stated terms crash = no coverage
+            ok = False
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"plane {name!r}: byte/FLOP terms raise at key "
+                        f"{key}: {e}"
+                    ),
+                    key=f"{name}:raises",
+                )
+            )
+        if ok is False and not any(f.key.startswith(name) for f in out):
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"plane {name!r}: stated byte/FLOP terms are "
+                        f"non-positive at key {key}"
+                    ),
+                    key=f"{name}:terms",
+                )
+            )
+    for pname, bits in sorted(common.PACKED_PLANES.items()):
+        pm = costmodel.PACKED_MODELS.get(pname)
+        if pm is None:
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"packed plane {pname!r} (common.PACKED_PLANES) "
+                        "has no PACKED_MODELS entry"
+                    ),
+                    key=f"packed:{pname}",
+                )
+            )
+        elif pm.bits != bits:
+            out.append(
+                Finding(
+                    rule="costmodel-coverage",
+                    path=PATH,
+                    line=0,
+                    message=(
+                        f"packed plane {pname!r}: model states "
+                        f"{pm.bits}-bit packing but common.PACKED_PLANES "
+                        f"says {bits} — byte terms are wrong"
+                    ),
+                    key=f"packed:{pname}:bits",
+                )
+            )
+    return out
+
+
+@rule(
+    "costmodel-drift",
+    "ast",
+    "every recorded kernel microbench capture sits inside the cost "
+    "model's measured/predicted envelope, no capture's ratio regressed "
+    "vs the previous round, and the committed envelope artifact is "
+    "fresh (results/costmodel_envelope.json)",
+)
+def check_costmodel_drift(ctx: Context) -> List[Finding]:
+    if not (ctx.importable and ctx.is_real_tree()):
+        return []
+    import json
+
+    from frankenpaxos_tpu.ops import costmodel
+
+    results = ctx.repo / "results"
+    out: List[Finding] = []
+    labeled = []
+    for path in sorted(results.glob("kernel_microbench_*.json")):
+        try:
+            labeled.append((path.name, json.loads(path.read_text())))
+        except (OSError, json.JSONDecodeError) as e:
+            out.append(
+                Finding(
+                    rule="costmodel-drift",
+                    path=f"results/{path.name}",
+                    line=0,
+                    message=f"unreadable capture: {e}",
+                    key=f"{path.name}:unreadable",
+                )
+            )
+    for f in costmodel.drift_findings(labeled):
+        out.append(
+            Finding(
+                rule="costmodel-drift",
+                path=f"results/{f['capture']}",
+                line=0,
+                message=f["message"],
+                key=f"{f['capture']}:{f['plane']}:{f['kind']}",
+            )
+        )
+    # Envelope artifact freshness: the committed verdict file must
+    # exist and match the model constants that live in the tree —
+    # a refit without a regenerated artifact (or vice versa) is drift.
+    env_path = results / "costmodel_envelope.json"
+    ENV = "results/costmodel_envelope.json"
+    try:
+        payload = json.loads(env_path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        out.append(
+            Finding(
+                rule="costmodel-drift",
+                path=ENV,
+                line=0,
+                message=(
+                    f"missing/unreadable envelope artifact ({e}) — "
+                    "regenerate: FPX_WRITE_ENVELOPE=1 python -m "
+                    "frankenpaxos_tpu.harness.microbench costmodel"
+                ),
+                key="envelope:missing",
+            )
+        )
+        return out
+    stale = []
+    if payload.get("constants_version") != costmodel.CONSTANTS_VERSION:
+        stale.append(
+            f"constants_version {payload.get('constants_version')} != "
+            f"model {costmodel.CONSTANTS_VERSION}"
+        )
+    if payload.get("envelope") != list(costmodel.ENVELOPE):
+        stale.append(
+            f"envelope {payload.get('envelope')} != model "
+            f"{list(costmodel.ENVELOPE)}"
+        )
+    if payload.get("regression_factor") != costmodel.REGRESSION_FACTOR:
+        stale.append("regression_factor mismatch")
+    if not payload.get("bytes_exact", False):
+        stale.append("recorded byte terms were not exact")
+    if payload.get("uncovered_planes"):
+        stale.append(
+            f"recorded uncovered planes {payload['uncovered_planes']}"
+        )
+    if payload.get("drift_findings"):
+        stale.append(
+            f"{len(payload['drift_findings'])} drift finding(s) "
+            "recorded in the artifact"
+        )
+    for reason in stale:
+        out.append(
+            Finding(
+                rule="costmodel-drift",
+                path=ENV,
+                line=0,
+                message=(
+                    f"stale envelope artifact: {reason} — regenerate: "
+                    "FPX_WRITE_ENVELOPE=1 python -m "
+                    "frankenpaxos_tpu.harness.microbench costmodel"
+                ),
+                key=f"envelope:{reason[:40]}",
+            )
+        )
+    return out

@@ -1519,7 +1519,7 @@ def bench_kernels(iters: int = 20, **sizes) -> List[dict]:
 
     import jax
 
-    from frankenpaxos_tpu.ops import registry
+    from frankenpaxos_tpu.ops import costmodel, registry
 
     on_tpu = jax.default_backend() in registry.TPU_BACKENDS
     cases = _kernel_cases(**sizes)
@@ -1542,6 +1542,19 @@ def bench_kernels(iters: int = 20, **sizes) -> List[dict]:
         ops, ref_s = _timed(run_ref)
         rows.append(_report("kernels", f"{name}:reference", ops, ref_s))
         entry = {"reference_per_sec": round(iters / ref_s, 2)}
+        # Efficiency telemetry: the measured/predicted ratio against
+        # the roofline cost model (ops/costmodel.py) under the
+        # parameter set matching where the timing ran. ratio >> 1 or
+        # << 1 is the costmodel-drift signal; the capture JSON records
+        # it so later rounds diff against it.
+        if name in costmodel.MODELS:
+            cm_params = costmodel.TPU_V5E if on_tpu else costmodel.CPU_JIT
+            predicted = costmodel.predict_per_sec(
+                name, plane.key_of(args), cm_params
+            )
+            entry["predicted_per_sec"] = round(predicted, 2)
+            entry["efficiency"] = round((iters / ref_s) / predicted, 4)
+            entry["costmodel_params"] = cm_params.name
         if on_tpu:
             fused = functools.partial(plane.kernel, **statics)
             best = None
@@ -1607,6 +1620,99 @@ def bench_kernels(iters: int = 20, **sizes) -> List[dict]:
     return rows
 
 
+def bench_costmodel(**sizes) -> List[dict]:
+    """Cost-model observatory pass (no kernels run — seconds, not
+    minutes): (1) validates every registered plane's STATED byte terms
+    against live argument arrays + ``jax.eval_shape`` outputs at the
+    flagship shapes, (2) replays every committed
+    ``results/kernel_microbench_*.json`` capture through the model's
+    drift engine, and (3) emits the envelope verdict JSON the
+    ``costmodel-drift`` analysis rule consumes — write it to
+    ``results/costmodel_envelope.json`` with ``FPX_WRITE_ENVELOPE=1``.
+    A ``COSTMODEL_JSON`` stdout line carries the payload either way."""
+    import functools
+    import json
+    import math
+    import os
+    import pathlib
+
+    import jax
+
+    from frankenpaxos_tpu.ops import costmodel, registry
+
+    cases = _kernel_cases(**sizes)
+    rows: List[dict] = []
+    planes_out: Dict[str, dict] = {}
+    exact = True
+    for name, (args, statics) in cases.items():
+        plane = registry.PLANES[name]
+        key = plane.key_of(args)
+        model_in = costmodel.input_bytes(name, key)
+        actual_in = sum(a.nbytes for a in jax.tree_util.tree_leaves(args))
+        outs = jax.eval_shape(
+            functools.partial(plane.reference, **statics), *args
+        )
+        actual_out = sum(
+            math.prod(o.shape) * o.dtype.itemsize
+            for o in jax.tree_util.tree_leaves(outs)
+        )
+        model_out = costmodel.output_bytes(name, key)
+        ok = model_in == actual_in and model_out == actual_out
+        exact = exact and ok
+        planes_out[name] = {
+            "key": list(key),
+            "in_bytes": actual_in,
+            "out_bytes": actual_out,
+            "model_in_bytes": model_in,
+            "model_out_bytes": model_out,
+            "bytes_exact": ok,
+            "flops": costmodel.flops(name, key),
+            "predicted_per_sec_cpu": round(
+                costmodel.predict_per_sec(name, key, costmodel.CPU_JIT), 2
+            ),
+            "predicted_per_sec_tpu": round(
+                costmodel.predict_per_sec(name, key, costmodel.TPU_V5E), 2
+            ),
+        }
+        rows.append(
+            _report(
+                "costmodel",
+                f"{name}:predicted",
+                1,
+                costmodel.predict_seconds(name, key, costmodel.CPU_JIT),
+            )
+        )
+    uncovered = sorted(set(registry.PLANES) - set(costmodel.MODELS))
+    results_dir = pathlib.Path(__file__).resolve().parents[2] / "results"
+    captures = sorted(results_dir.glob("kernel_microbench_*.json"))
+    verdicts = {}
+    labeled = []
+    for path in captures:
+        try:
+            labeled.append((path.name, json.loads(path.read_text())))
+        except (OSError, json.JSONDecodeError):
+            continue
+    for label, cap in labeled:
+        verdicts[label] = costmodel.validate_capture(cap)
+    findings = costmodel.drift_findings(labeled)
+    payload = {
+        "constants_version": costmodel.CONSTANTS_VERSION,
+        "envelope": list(costmodel.ENVELOPE),
+        "regression_factor": costmodel.REGRESSION_FACTOR,
+        "bytes_exact": exact,
+        "uncovered_planes": uncovered,
+        "planes": planes_out,
+        "captures": verdicts,
+        "drift_findings": findings,
+    }
+    if os.environ.get("FPX_WRITE_ENVELOPE"):
+        out = results_dir / "costmodel_envelope.json"
+        out.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+        payload["envelope_written"] = str(out)
+    print("COSTMODEL_JSON " + json.dumps(payload))
+    return rows
+
+
 BENCHES = {
     "depgraph": bench_depgraph,
     "int_prefix_set": bench_int_prefix_set,
@@ -1625,6 +1731,7 @@ DEVICE_BENCHES = {
     "workload": bench_workload,
     "packing": bench_packing,
     "kernels": bench_kernels,
+    "costmodel": bench_costmodel,
     "fused_tick": bench_fused_tick,
     "grid_vote": bench_grid_vote,
     "mesh_kernels": bench_mesh_kernels,

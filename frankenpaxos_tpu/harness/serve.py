@@ -80,6 +80,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.monitoring import scrape as scrape_mod
+from frankenpaxos_tpu.ops import costmodel
 from frankenpaxos_tpu.monitoring import traceviz
 from frankenpaxos_tpu.monitoring.slo import (
     FleetSloEngine,
@@ -209,6 +210,11 @@ class ServeLoop:
         )
         self._prev: Dict[str, Any] = {}  # previous drain's cumulatives
         self._spans_scraped = 0  # host spans already appended to CSV
+        # Efficiency telemetry: the cost model's expected commits/tick
+        # for THIS config (0.0 = shape not covered, gauges off) and the
+        # previous drain's (ticks, commits) cumulatives for deltas.
+        self._model_rate = costmodel.expected_commit_rate_per_tick(cfg)
+        self._eff_prev = (0, 0)
         self._chunks = 0
         self._epoch = 0
         self.clean_shutdown = False
@@ -647,6 +653,21 @@ class ServeLoop:
                 instance="serve",
             )
             self._spans_scraped = len(self.host_spans)
+            # Efficiency gauges: this drain's observed commits/tick
+            # against the cost model's expected rate for the config.
+            if self._model_rate > 0.0:
+                ticks = drain["ticks_total"]
+                commits = drain["totals"]["commits"]
+                pt, pc = self._eff_prev
+                self._eff_prev = (ticks, commits)
+                if ticks > pt:
+                    scrape_mod.append_efficiency_samples(
+                        self.serve.scrape_csv,
+                        observed_per_tick=(commits - pc) / (ticks - pt),
+                        predicted_per_tick=self._model_rate,
+                        params=costmodel.CPU_JIT.name,
+                        instance="serve",
+                    )
         self.drains.append(drain)
         return drain
 
@@ -799,6 +820,13 @@ class FleetServeConfig:
     # offered loads make deviation the expected signal, not an anomaly.
     k_mad: int = 4
     expected_rate_per_tick: float = 0.0
+    # Where the straggler anchor comes from: "manual" (the hand-fed
+    # expected_rate_per_tick constant above — the PR 15 behavior, and
+    # what partial-load tests pin) or "model" (ops/costmodel.py
+    # derives commits/tick/instance from the backend config at loop
+    # construction; expected_rate_per_tick is then ignored). The
+    # production entry point (serve_fleet) uses "model".
+    expected_rate_source: str = "manual"
 
     def __post_init__(self):
         assert self.chunk_ticks >= 1
@@ -810,6 +838,7 @@ class FleetServeConfig:
         )
         assert self.k_mad >= 1
         assert self.expected_rate_per_tick >= 0.0
+        assert self.expected_rate_source in ("manual", "model")
 
 
 @functools.lru_cache(maxsize=None)
@@ -941,9 +970,25 @@ class FleetServeLoop:
         self.base_rates = (
             [float(r) for r in rates] if rates is not None else None
         )
+        # The straggler anchor: either the hand-fed constant or the
+        # cost model's expected commits/tick for this backend config
+        # (capped by the slowest instance's offered rate when the fleet
+        # runs heterogeneous plans — the anchor must not flag an
+        # instance for committing exactly what it was offered).
+        if fleet.expected_rate_source == "model":
+            self._expected_rate = costmodel.expected_commit_rate_per_tick(
+                cfg
+            )
+            if self.base_rates and self._expected_rate > 0.0:
+                G = getattr(cfg, "num_groups", 0) or 0
+                self._expected_rate = min(
+                    self._expected_rate, min(self.base_rates) * G
+                )
+        else:
+            self._expected_rate = fleet.expected_rate_per_tick
         self._snap = _fleet_snap_fn(
             fleet.k_mad,
-            int(round(fleet.expected_rate_per_tick * 1000)),
+            int(round(self._expected_rate * 1000)),
             fleet.drain_rings,
         )
         self.cursor = telemetry_mod.DrainCursor()
@@ -1118,6 +1163,22 @@ class FleetServeLoop:
                 instance="fleet",
             )
             self._spans_scraped = len(self.host_spans)
+            # Per-instance efficiency gauges against the straggler
+            # anchor (model-fed or manual; 0 = anchor off, gauges off).
+            # The summary's windowed commit rate is already x1000.
+            if self._expected_rate > 0.0:
+                for i, row in enumerate(drain["summary"]):
+                    scrape_mod.append_efficiency_samples(
+                        self.fleet.scrape_csv,
+                        observed_per_tick=(
+                            row["commit_rate_x1000"] / 1000.0
+                        ),
+                        predicted_per_tick=self._expected_rate,
+                        params=costmodel.CPU_JIT.name,
+                        job="fleet",
+                        instance=str(i),
+                        ts=ts,
+                    )
         self.drains.append(drain)
         return drain
 
@@ -1250,6 +1311,10 @@ def serve_fleet(
         trace_path=os.path.join(out_dir, "fleet_trace.json"),
         max_seconds=seconds,
         max_chunks=max_chunks,
+        # Production path: the straggler anchor comes from the cost
+        # model (capped by the offered plan rate inside the loop), not
+        # a hand-fed constant.
+        expected_rate_source="model",
     )
     loop = FleetServeLoop(
         "multipaxos", cfg, fleet_cfg, n,

@@ -58,6 +58,12 @@ def render_dashboard(
         base = count_name[: -len("_count")]
         if f"{base}_sum" in names:
             panels.append(("latency", base))
+    # Efficiency lane (the roofline observatory's serve view): the
+    # fpx_efficiency_* gauges a serve loop appends each drain —
+    # observed vs model-predicted commits/tick plus their ratio, all
+    # x1000 fixed-point (scrape.append_efficiency_samples).
+    if "fpx_efficiency_ratio_x1000" in names:
+        panels.append(("efficiency", "fpx_efficiency"))
     if not panels:
         return None
 
@@ -69,6 +75,35 @@ def render_dashboard(
         if kind == "rate":
             wide = capture.rate(name, window_ms=window_ms)
             title = f"{name} (rate/s, {int(window_ms)}ms windows)"
+        elif kind == "efficiency":
+            for gauge, label in (
+                ("fpx_efficiency_observed_commits_per_tick_x1000",
+                 "observed/tick"),
+                ("fpx_efficiency_predicted_commits_per_tick_x1000",
+                 "model predicted/tick"),
+            ):
+                if gauge not in names:
+                    continue
+                g = capture.query(gauge).sum(axis=1) / 1000.0
+                ax.plot(g.index, g.values, label=label)
+            ratio = (
+                capture.query("fpx_efficiency_ratio_x1000").sum(axis=1)
+                / 1000.0
+            )
+            ax2 = ax.twinx()
+            ax2.plot(
+                ratio.index, ratio.values, color="tab:red", ls="--",
+                label="efficiency ratio",
+            )
+            ax2.axhline(1.0, color="tab:red", lw=0.5, alpha=0.5)
+            ax2.set_ylabel("measured/predicted", fontsize=7)
+            ax.set_title(
+                "efficiency: commits/tick vs cost model", fontsize=9
+            )
+            ax.set_ylabel("commits/tick")
+            ax.grid(True)
+            ax.legend(fontsize=6, loc="upper left")
+            continue
         else:
             # Mean handler latency = d(sum)/d(count) over the window.
             total = capture.query(f"{name}_sum")
@@ -160,6 +195,14 @@ def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
         inset.set_title("occupancy hist", fontsize=6)
         inset.tick_params(labelsize=5)
 
+    if capture.get("model_flagged"):
+        fig.suptitle(
+            "MODEL-FLAGGED CAPTURE: "
+            + (capture.get("model_flag_reason") or "implausible vs "
+               "the cost model — re-measure")[:160],
+            fontsize=8, color="red",
+        )
+
     fig.tight_layout()
     fig.savefig(output)
     plt.close(fig)
@@ -194,6 +237,8 @@ def render_fleet_dashboard(
         ("fpx_fleet_shed_total", "shed (cumulative)"),
         ("fpx_fleet_straggler", "straggler lane (flagged drains)"),
         ("fpx_fleet_admission_scale", "admission scale (x1000)"),
+        ("fpx_efficiency_ratio_x1000",
+         "efficiency vs cost model (x1000)"),
     ]
 
     def matrix(name):
@@ -239,6 +284,89 @@ def render_fleet_dashboard(
         if not binary:
             fig.colorbar(im, ax=ax, fraction=0.03, pad=0.01)
     axes[-1][0].set_xlabel("drain (scrape order)")
+    fig.tight_layout()
+    fig.savefig(output)
+    plt.close(fig)
+    return output
+
+
+def render_roofline(envelope: dict, output: str) -> Optional[str]:
+    """ROOFLINE view (``--roofline``): the performance observatory's
+    predicted-vs-measured picture from a ``costmodel_envelope.json``
+    payload (``microbench costmodel`` with ``FPX_WRITE_ENVELOPE=1``):
+
+      1. per-plane measured/predicted ratio lanes, one point per
+         recorded microbench capture, with the model envelope band —
+         anything outside the band is what ``costmodel-drift`` flags;
+      2. the roofline scatter: bytes-moved vs predicted and measured
+         throughput per plane (call-overhead-bound planes sit left,
+         bandwidth-bound planes right).
+
+    Returns the output path, or None when the payload carries no
+    capture verdicts."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    captures = envelope.get("captures", {})
+    planes = envelope.get("planes", {})
+    rows = [
+        (label, r) for label in sorted(captures)
+        for r in captures[label]
+    ]
+    if not rows:
+        return None
+    names = sorted({r["plane"] for _, r in rows})
+    lo, hi = envelope.get("envelope", [0.0, 0.0])
+
+    fig, (ax, ax2) = plt.subplots(2, 1, figsize=(9, 8))
+    ax.axhspan(lo, hi, color="tab:green", alpha=0.12,
+               label=f"model envelope [{lo}, {hi}]")
+    ax.axhline(1.0, color="tab:green", lw=0.6)
+    for label in sorted(captures):
+        xs, ys = [], []
+        for r in captures[label]:
+            xs.append(names.index(r["plane"]))
+            ys.append(r["ratio"])
+        ax.plot(xs, ys, "o", ms=4, label=label)
+    ax.set_xticks(range(len(names)))
+    ax.set_xticklabels(names, rotation=30, ha="right", fontsize=6)
+    ax.set_yscale("log")
+    ax.set_ylabel("measured / predicted")
+    ax.set_title(
+        "per-plane efficiency lanes vs the cost-model envelope "
+        f"(constants v{envelope.get('constants_version', '?')})",
+        fontsize=9,
+    )
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend(fontsize=6, loc="best")
+
+    for name in names:
+        p = planes.get(name)
+        if not p:
+            continue
+        x = p["in_bytes"] + p["out_bytes"]
+        ax2.plot(x, p["predicted_per_sec_cpu"], "s", color="tab:blue",
+                 ms=5)
+        measured = [
+            r["measured_per_sec"] for _, r in rows if r["plane"] == name
+        ]
+        ax2.plot([x] * len(measured), measured, "o", color="tab:orange",
+                 ms=4, alpha=0.7)
+        ax2.annotate(name.replace("multipaxos_", "mp_"), (x, measured[0]),
+                     fontsize=5, textcoords="offset points",
+                     xytext=(3, 3))
+    ax2.plot([], [], "s", color="tab:blue", label="predicted (cpu_jit)")
+    ax2.plot([], [], "o", color="tab:orange", label="measured captures")
+    ax2.set_xscale("log")
+    ax2.set_yscale("log")
+    ax2.set_xlabel("bytes moved per dispatch")
+    ax2.set_ylabel("dispatches / s")
+    ax2.set_title("roofline: traffic vs throughput per plane", fontsize=9)
+    ax2.grid(True, which="both", alpha=0.3)
+    ax2.legend(fontsize=6, loc="best")
+
     fig.tight_layout()
     fig.savefig(output)
     plt.close(fig)
@@ -307,6 +435,16 @@ def _load_telemetry_capture(path: str) -> Optional[dict]:
         return payload
     nested = payload.get("telemetry")
     if isinstance(nested, dict) and "series" in nested:
+        # Stale-capture honesty (bench._prefer_last_good /
+        # costmodel.flag_capture): a capture whose headline failed the
+        # model plausibility check renders with an explicit banner,
+        # never silently.
+        if payload.get("model_flagged"):
+            nested = dict(nested)
+            nested["model_flagged"] = True
+            nested["model_flag_reason"] = payload.get(
+                "model_flag_reason", ""
+            )
         return nested
     return None
 
@@ -336,6 +474,14 @@ def main() -> None:
         "render as a one-row fleet)",
     )
     parser.add_argument(
+        "--roofline",
+        action="store_true",
+        help="render the cost-model roofline view (per-plane "
+        "efficiency lanes vs the model envelope + traffic-vs-"
+        "throughput scatter) from a costmodel_envelope.json payload "
+        "(microbench costmodel, FPX_WRITE_ENVELOPE=1)",
+    )
+    parser.add_argument(
         "--interval", type=float, default=1.0,
         help="--live poll interval (seconds)",
     )
@@ -351,6 +497,19 @@ def main() -> None:
     output = args.output or os.path.join(
         os.path.dirname(os.path.abspath(path)), "dashboard.png"
     )
+    if args.roofline:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read envelope payload: {e}", file=sys.stderr)
+            sys.exit(1)
+        result = render_roofline(payload, output)
+        if result is None:
+            print("no capture verdicts in payload", file=sys.stderr)
+            sys.exit(1)
+        print(result)
+        return
     if args.fleet:
         result = render_fleet_dashboard(MetricsCapture(path), output)
         if result is None:

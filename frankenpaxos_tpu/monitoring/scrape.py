@@ -157,6 +157,56 @@ def append_host_spans(
     return n
 
 
+# Efficiency gauges: measured-vs-model commit throughput, the serve
+# loop's MFU analog. One row each per drain, labels carrying the
+# parameter-set name so a capture replays against the exact model
+# that judged it. Values follow the fleet x1000 fixed-point
+# convention (the value column stays integer-friendly and the
+# dashboard divides back out).
+EFFICIENCY_METRICS = (
+    "fpx_efficiency_observed_commits_per_tick_x1000",
+    "fpx_efficiency_predicted_commits_per_tick_x1000",
+    "fpx_efficiency_ratio_x1000",
+)
+
+
+def append_efficiency_samples(
+    csv_path: str,
+    *,
+    observed_per_tick: float,
+    predicted_per_tick: float,
+    params: str,
+    job: str = "device",
+    instance: str = "serve",
+    ts: Optional[float] = None,
+) -> int:
+    """Append one drain's efficiency gauges (observed and
+    model-predicted commits/tick plus their ratio, x1000) to the
+    scraper CSV under schema v2 — same ``instance`` semantics as
+    ``append_device_samples`` (per-serve-loop name, or the fleet row
+    index). Returns rows appended."""
+    import os
+
+    ts = time.time() if ts is None else ts
+    ratio = (
+        observed_per_tick / predicted_per_tick
+        if predicted_per_tick > 0
+        else 0.0
+    )
+    values = (observed_per_tick, predicted_per_tick, ratio)
+    new_file = not os.path.exists(csv_path)
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(CSV_COLUMNS)
+        for metric, value in zip(EFFICIENCY_METRICS, values):
+            writer.writerow([
+                ts, job, instance, metric,
+                f"params={params}", int(round(value * 1000)),
+            ])
+    return len(EFFICIENCY_METRICS)
+
+
 # The per-instance summary metrics a FLEET serve loop appends each
 # drain (telemetry.fleet_summary columns worth exposing): the
 # instance x time matrices ``dashboard --fleet`` renders as heatmaps,

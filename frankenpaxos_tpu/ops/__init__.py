@@ -30,6 +30,13 @@ kernel SUITE with a dispatch registry:
   * :mod:`frankenpaxos_tpu.ops.craq` — ``craq_chain`` (chain
     propagate/ack with scatter-free pending-set accounting; partitioned
     plans defer cut hops to the heal tick in-kernel).
+  * :mod:`frankenpaxos_tpu.ops.costmodel` — the analytical roofline
+    cost model over every plane above (stated bytes-moved + FLOP terms
+    per autotune key, CPU/TPU parameter sets): predicted time feeds
+    the registry's block fallback for unseen shapes, the
+    ``costmodel-coverage`` / ``costmodel-drift`` lint gates, the
+    ``fpx_efficiency_*`` serve gauges, and ``bench.py`` saturation
+    prediction.
   * :mod:`frankenpaxos_tpu.ops.compartmentalized` —
     ``compartmentalized_grid_vote`` (the acceptor-grid hot path:
     offset-clock aging, column-transversal write votes, every-row-voted
@@ -50,6 +57,7 @@ Microbenchmark + autotuner:
 
 from frankenpaxos_tpu.tpu.common import INF, INF16  # noqa: F401 (re-export)
 
+from frankenpaxos_tpu.ops import costmodel  # noqa: F401
 from frankenpaxos_tpu.ops import registry  # noqa: F401
 from frankenpaxos_tpu.ops.registry import (  # noqa: F401
     KernelPolicy,

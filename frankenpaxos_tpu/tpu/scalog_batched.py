@@ -39,6 +39,7 @@ from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
 
@@ -293,6 +294,39 @@ def tick(
         queue_capacity=P,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+
+    # Span sampler (telemetry.record_spans — the generic plumbing):
+    # CUT lifecycles through the ordering layer. Mapping: one pseudo-
+    # group (the aggregator), ring pos = the in-flight cut ring slot,
+    # slot id = the monotone CUT NUMBER (cut c lives at ring pos c % P
+    # for its whole life; computed from the PRE-TICK committed floor so
+    # a cut committing this tick still matches). Stages: proposed =
+    # the aggregator snapshots the cut (step 3's issue), phase2_voted =
+    # committed = executed = the Paxos decision lands and the global
+    # log extends (step 2's in-order commit scan — one tick, by
+    # construction), no phase-1 round on the cut log, retire same tick
+    # (record_spans stamps completion before rolling the ring slot, so
+    # commit + retire in one tick is the normal path). The commit is
+    # >= 2*lat_min ticks after the snapshot, so proposed < committed
+    # always. Structurally OFF at spans=0 (the serve loop sizes the
+    # reservoir), like every other backend.
+    if telemetry_mod.span_slots(tel):
+        ring = jnp.arange(P, dtype=state.next_cut.dtype)
+        commit_mask = slot_committed[None, :]
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=((ring == slot) & issue)[None, :],
+            slot_ids=(
+                state.committed_cuts
+                + ((ring - state.committed_cuts) % P)
+            )[None, :],
+            new_slot_ids=jnp.full((1, P), state.next_cut),
+            phase1_mark=jnp.zeros((1,), bool),
+            voted=commit_mask,
+            newly_chosen=commit_mask,
+            retire_mask=commit_mask,
+        )
 
     return BatchedScalogState(
         local_len=local_len,

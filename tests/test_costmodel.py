@@ -1,0 +1,229 @@
+"""The analytical cost model's contracts (ops/costmodel.py, the
+performance observatory): stated byte terms are EXACT against live
+dispatch arguments and ``jax.eval_shape`` outputs (including the
+bit-packed planes at packed widths), predictions are monotone in every
+key axis, the committed microbench captures sit inside the envelope
+the drift gate enforces, and the model-ranked block fallback never
+predicts worse than the legacy nearest-recorded-G guess it replaced.
+"""
+
+import functools
+import json
+import math
+import pathlib
+
+import jax
+import pytest
+
+from frankenpaxos_tpu.harness import microbench
+from frankenpaxos_tpu.ops import costmodel, registry
+from frankenpaxos_tpu.tpu import packing
+
+RESULTS = pathlib.Path(__file__).resolve().parents[1] / "results"
+
+# Small-but-irregular shapes: byte exactness must hold away from the
+# flagship key, not just at it (the specs are closed-form in the key).
+SMALL = dict(A=3, G=37, W=8, N=29, L=3, KV=4, CW=5)
+
+CASES = microbench._kernel_cases(**SMALL)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_plane_bytes_exact(name):
+    """Model input bytes == live argument bytes; model output bytes ==
+    eval_shape of the reference twin. Zero-cost to keep exact, and it
+    pins the spec tables to the dispatch signatures forever."""
+    args, statics = CASES[name]
+    plane = registry.PLANES[name]
+    key = plane.key_of(args)
+    assert costmodel.input_bytes(name, key) == sum(
+        a.nbytes for a in jax.tree_util.tree_leaves(args)
+    )
+    outs = jax.eval_shape(
+        functools.partial(plane.reference, **statics), *args
+    )
+    assert costmodel.output_bytes(name, key) == sum(
+        math.prod(o.shape) * o.dtype.itemsize
+        for o in jax.tree_util.tree_leaves(outs)
+    )
+
+
+def test_unfused_tick_is_the_three_planes():
+    """The unfused reference entry prices exactly the three multipaxos
+    planes run back to back — same flops total, byte terms the literal
+    concatenation (every intermediate round-trips through memory)."""
+    key = costmodel.CAPTURE_KEYS["multipaxos_fused_tick"]
+    parts = (
+        "multipaxos_vote_quorum",
+        "multipaxos_p1_promise",
+        "multipaxos_dispatch",
+    )
+    assert costmodel.input_bytes("multipaxos_unfused_tick", key) == sum(
+        costmodel.input_bytes(p, key) for p in parts
+    )
+    assert costmodel.output_bytes("multipaxos_unfused_tick", key) == sum(
+        costmodel.output_bytes(p, key) for p in parts
+    )
+    assert costmodel.flops("multipaxos_unfused_tick", key) == sum(
+        costmodel.flops(p, key) for p in parts
+    )
+    # ...and the fused plane moves strictly fewer bytes at equal or
+    # fewer flops: the fusion win the microbench measures is priced in.
+    assert costmodel.bytes_moved(
+        "multipaxos_fused_tick", key
+    ) < costmodel.bytes_moved("multipaxos_unfused_tick", key)
+
+
+def test_packed_plane_bytes_exact():
+    """Packed-plane terms match tpu/packing.py at packed widths: the
+    word-count formula is ``words_for`` exactly, and a live
+    ``pack_plane`` array stores the predicted bytes."""
+    for name, bits in [("status", 2), ("rb_status", 2), ("sess_occ", 1)]:
+        pm = costmodel.PACKED_MODELS[name]
+        assert pm.bits == bits
+        for n in (0, 1, 15, 16, 17, 31, 32, 33, 64, 1000):
+            assert pm.packed_bytes(n) == packing.words_for(n, bits) * 4
+            assert pm.unpacked_bytes(n) == n
+            assert pm.crossing_flops(n) == pm.flops_per_value * n
+    # Live array: a [G, W] 2-bit plane packs the last axis.
+    import jax.numpy as jnp
+
+    G, W = 7, 37
+    x = jax.random.randint(jax.random.PRNGKey(0), (G, W), 0, 3).astype(
+        jnp.int8
+    )
+    packed = packing.pack_plane(x, 2)
+    assert packed.nbytes == G * costmodel.PACKED_MODELS[
+        "status"
+    ].packed_bytes(W)
+
+
+@pytest.mark.parametrize("name", sorted(costmodel.MODELS))
+def test_prediction_monotone_in_every_key_axis(name):
+    """Doubling any key extent never shrinks bytes, flops, or
+    predicted seconds — the model can rank shapes, not just score
+    one."""
+    base = costmodel.CAPTURE_KEYS.get(
+        name, costmodel.CAPTURE_KEYS["multipaxos_fused_tick"]
+    )
+    for axis in range(len(base)):
+        grown = tuple(
+            v * 2 if i == axis else v for i, v in enumerate(base)
+        )
+        assert costmodel.bytes_moved(name, grown) >= costmodel.bytes_moved(
+            name, base
+        ), (name, axis)
+        assert costmodel.flops(name, grown) >= costmodel.flops(
+            name, base
+        ), (name, axis)
+        for params in costmodel.PARAM_SETS.values():
+            assert costmodel.predict_seconds(
+                name, grown, params
+            ) >= costmodel.predict_seconds(name, base, params), (
+                name, axis, params.name,
+            )
+
+
+@pytest.mark.parametrize(
+    "capture", ["kernel_microbench_r10.json", "kernel_microbench_r11.json"]
+)
+def test_recorded_captures_inside_envelope(capture):
+    """Every plane rate in the committed microbench rounds lands
+    inside the measured/predicted envelope under the CPU-jit
+    parameter set — the fit the drift gate freezes."""
+    payload = json.loads((RESULTS / capture).read_text())
+    rows = costmodel.validate_capture(payload)
+    assert rows, "capture carried no modeled plane rates"
+    bad = [r for r in rows if not r["ok"]]
+    assert not bad, bad
+    lo, hi = costmodel.ENVELOPE
+    for r in rows:
+        assert lo <= r["ratio"] <= hi, r
+
+
+def test_model_block_beats_or_ties_nearest_g():
+    """The model-ranked fallback for unseen shapes: on every recorded
+    plane at an off-table key, the model's block choice predicts a
+    time <= the legacy nearest-recorded-G guess under the same
+    parameter set (it replaced that heuristic and must dominate it)."""
+    params = costmodel.CPU_INTERPRET
+    checked = 0
+    for name, key in costmodel.CAPTURE_KEYS.items():
+        if name not in registry.PLANES:
+            continue
+        m = costmodel.MODELS[name]
+        off_table = tuple(
+            v * 3 if i == m.batch_axis else v for i, v in enumerate(key)
+        )
+        legacy = registry.nearest_block(name, off_table)
+        if legacy is None:
+            continue
+        model = costmodel.model_block(name, off_table, params)
+        assert model in costmodel.CANDIDATE_BLOCKS
+        assert costmodel.predict_seconds(
+            name, off_table, params, model
+        ) <= costmodel.predict_seconds(name, off_table, params, legacy), (
+            name, off_table, model, legacy,
+        )
+        checked += 1
+    assert checked >= 8  # every recorded plane participated
+
+
+def test_registry_block_for_prefers_table_then_model():
+    """Dispatch-time resolution order: an exact table hit wins; an
+    unseen key gets the model's ranked choice (never a crash, never
+    the bare default when a model exists)."""
+    key = (3, 3334, 64)
+    table = registry._table()
+    assert registry.block_for("multipaxos_fused_tick", key) == table[
+        registry.table_key("multipaxos_fused_tick", key)
+    ]
+    unseen = (3, 500, 64)
+    assert registry.table_key("multipaxos_fused_tick", unseen) not in table
+    assert registry.block_for(
+        "multipaxos_fused_tick", unseen
+    ) == costmodel.model_block(
+        "multipaxos_fused_tick", unseen, costmodel.params_for_backend()
+    )
+
+
+def test_candidate_blocks_match_autotune_sweep():
+    """The model ranks exactly the blocks the autotuner sweeps — a
+    drifted candidate list would rank blocks the table can never
+    record (or miss ones it does)."""
+    assert costmodel.CANDIDATE_BLOCKS == microbench.AUTOTUNE_BLOCKS
+
+
+def test_rank_blocks_vmem_filter_and_tie_break():
+    """TPU ranking excludes VMEM-infeasible blocks; ties resolve to
+    the smaller block (less VMEM pressure at equal predicted time)."""
+    name, key = "multipaxos_fused_tick", (3, 100000, 64)
+    ranked = costmodel.rank_blocks(name, key, costmodel.TPU_V5E)
+    assert ranked  # never empty — the smallest block survives
+    for blk, _ in ranked:
+        assert (
+            costmodel.block_bytes(name, key, blk)
+            <= costmodel.TPU_V5E.vmem_bytes
+            or blk == min(costmodel.CANDIDATE_BLOCKS)
+        )
+    times = [t for _, t in ranked]
+    assert times == sorted(times)
+
+
+def test_capacity_and_saturation_shapes():
+    """The whole-protocol predictions stay self-consistent: capacity
+    scales linearly in role counts, saturation in groups (until the
+    window caps), and unknown roles raise."""
+    one = costmodel.capacity({"leader": 1, "acceptor": 3, "replica": 2})
+    two = costmodel.capacity({"leader": 2, "acceptor": 6, "replica": 4})
+    assert two["commands_per_sec"] == pytest.approx(
+        2 * one["commands_per_sec"]
+    )
+    assert one["bottleneck_role"] == two["bottleneck_role"]
+    with pytest.raises(KeyError):
+        costmodel.capacity({"mystery_role": 1})
+    s1 = costmodel.predict_saturation(100, 64, 8)
+    s2 = costmodel.predict_saturation(200, 64, 8)
+    assert s2["committed_per_tick"] == pytest.approx(
+        2 * s1["committed_per_tick"]
+    )

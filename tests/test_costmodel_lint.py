@@ -1,0 +1,117 @@
+"""Performance-observatory gates (thin wrapper + teeth): every
+registered plane / packed plane / the unfused reference tick carries
+stated cost-model terms (``costmodel-coverage``), every recorded
+microbench capture sits inside the model's measured/predicted envelope
+with a fresh committed verdict artifact (``costmodel-drift``), and —
+the teeth — a deliberately corrupted timing or a round-over-round
+ratio regression actually trips the drift engine the rule delegates to
+(``costmodel.drift_findings`` is pure data-in/data-out exactly so the
+rule and this test share one engine).
+"""
+
+import copy
+import json
+import pathlib
+
+import pytest
+
+from frankenpaxos_tpu import analysis
+from frankenpaxos_tpu.ops import costmodel
+
+pytestmark = pytest.mark.lint
+
+RESULTS = pathlib.Path(__file__).resolve().parents[1] / "results"
+
+
+def _load(name: str) -> dict:
+    return json.loads((RESULTS / name).read_text())
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    ["costmodel-coverage", "costmodel-drift"],
+)
+def test_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()
+
+
+def test_corrupted_timing_trips_drift():
+    """Teeth: multiply one plane's recorded rate by 100 in a copy of
+    the committed r11 capture — the drift engine must flag it BOTH as
+    outside the absolute envelope and as a regression vs r10, and must
+    name the corrupted plane."""
+    r10 = _load("kernel_microbench_r10.json")
+    r11 = copy.deepcopy(_load("kernel_microbench_r11.json"))
+    planes = r11["kernels"]["planes"]
+    planes["mencius_vote"]["reference_per_sec"] *= 100.0
+    findings = costmodel.drift_findings(
+        [("r10.json", r10), ("r11-corrupt.json", r11)]
+    )
+    kinds = {(f["plane"], f["kind"]) for f in findings}
+    assert ("mencius_vote", "envelope") in kinds, findings
+    assert ("mencius_vote", "regression") in kinds, findings
+    # ...and ONLY the corrupted plane: the committed timings around it
+    # stay clean, so the gate points at the culprit, not the capture.
+    assert {f["plane"] for f in findings} == {"mencius_vote"}
+
+
+def test_slow_regression_trips_drift_inside_envelope():
+    """Teeth: a ratio move bigger than REGRESSION_FACTOR is a finding
+    even when both captures sit inside the absolute envelope — the
+    gate catches relative rot, not just absolute corruption."""
+    key = list(costmodel.CAPTURE_KEYS["multipaxos_fused_tick"])
+    pred = costmodel.predict_per_sec(
+        "multipaxos_fused_tick", tuple(key)
+    )
+    lo, hi = costmodel.ENVELOPE
+    mk = lambda ratio: {
+        "kernels": {
+            "planes": {
+                "multipaxos_fused_tick": {
+                    "reference_per_sec": ratio * pred
+                }
+            }
+        }
+    }
+    # both inside the envelope, but the move exceeds the factor
+    r_a, r_b = lo * 1.1, lo * 1.1 * costmodel.REGRESSION_FACTOR * 1.2
+    assert lo <= r_a <= hi and lo <= r_b <= hi
+    findings = costmodel.drift_findings([("a", mk(r_a)), ("b", mk(r_b))])
+    assert [f["kind"] for f in findings] == ["regression"], findings
+
+
+def test_stale_envelope_artifact_is_drift():
+    """Teeth for the artifact-freshness half: the committed
+    results/costmodel_envelope.json must carry the in-tree constants
+    version — the rule flags a refit whose artifact was not
+    regenerated. (Checked directly against the committed file so the
+    invariant the rule enforces is also pinned here.)"""
+    payload = _load("costmodel_envelope.json")
+    assert payload["constants_version"] == costmodel.CONSTANTS_VERSION
+    assert payload["envelope"] == list(costmodel.ENVELOPE)
+    assert payload["regression_factor"] == costmodel.REGRESSION_FACTOR
+    assert payload["bytes_exact"] is True
+    assert payload["uncovered_planes"] == []
+    assert payload["drift_findings"] == []
+
+
+def test_flag_capture_teeth():
+    """The stale-capture plausibility check: the committed pre-kernel-
+    layer TPU headline (BENCH_r05 lineage, 4.0M entries/sec) is far
+    under the model's TPU saturation prediction and MUST flag; a
+    headline near the CPU prediction must NOT."""
+    stale = dict(_load("bench_tpu_last_good.json"))
+    flagged = costmodel.flag_capture(stale)
+    assert flagged["model_flagged"] is True
+    assert "re-measured" in flagged["model_flag_reason"]
+    sane = costmodel.flag_capture(
+        {
+            "value": costmodel.predict_saturation(3334, 64, 8)[
+                "committed_per_sec"
+            ],
+            "device": "cpu",
+        }
+    )
+    assert sane["model_flagged"] is False
+    assert "model_check" in sane

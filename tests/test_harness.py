@@ -413,6 +413,32 @@ def test_microbench_kernels_smoke():
     assert '"fused_tick"' in bench_src
 
 
+def test_microbench_costmodel_smoke(capsys):
+    """The cost-model observatory pass at toy size (guards
+    ``microbench costmodel``): byte terms exact against live arrays,
+    every registered plane covered, the committed captures replay
+    clean through the drift engine, and the COSTMODEL_JSON line
+    carries the envelope-artifact payload."""
+    import json as _json
+
+    from frankenpaxos_tpu.harness import microbench
+    from frankenpaxos_tpu.ops import costmodel, registry
+
+    rows = microbench.bench_costmodel(A=3, G=32, W=16, N=32, L=3, KV=4, CW=8)
+    assert rows and all(r["ops_per_sec"] > 0 for r in rows)
+    line = next(
+        ln for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("COSTMODEL_JSON ")
+    )
+    payload = _json.loads(line[len("COSTMODEL_JSON "):])
+    assert payload["bytes_exact"] is True
+    assert payload["uncovered_planes"] == []
+    assert payload["drift_findings"] == []
+    assert payload["constants_version"] == costmodel.CONSTANTS_VERSION
+    assert set(registry.PLANES) <= set(payload["planes"])
+    assert "costmodel" in microbench.DEVICE_BENCHES
+
+
 def test_microbench_fused_tick_smoke():
     """The megakernel-vs-multiplane race at toy size (guards
     ``microbench fused_tick``): both sides sweep blocks, outputs are

@@ -111,7 +111,7 @@ def test_registry_coverage_names_all_backends():
     assert cov["compartmentalized"] == ("compartmentalized_grid_vote",)
 
 
-def test_block_for_exact_nearest_and_default():
+def test_block_for_exact_model_and_legacy():
     name = "multipaxos_vote_quorum"
     table = registry._table()
     exact_key = (3, 3334, 64)  # checked-in flagship entry
@@ -119,32 +119,46 @@ def test_block_for_exact_nearest_and_default():
     assert registry.block_for(name, exact_key) == table[
         registry.table_key(name, exact_key)
     ]
-    # Nearest-G fallback: an unseen G resolves to some recorded entry,
-    # never to a crash; an unseen plane shape falls back to the default.
+    # Unseen shape: the cost model ranks the autotune candidates
+    # (ops/costmodel.py) — never a crash, always a sweepable block; a
+    # key arity the model's spec tables cannot evaluate degrades to
+    # the plane default (the dispatch path must never raise).
+    from frankenpaxos_tpu.ops import costmodel
+
     got = registry.block_for(name, (3, 3000, 64))
-    assert got > 0
+    assert got in costmodel.CANDIDATE_BLOCKS
+    assert got == costmodel.model_block(
+        name, (3, 3000, 64), costmodel.params_for_backend()
+    )
     assert (
         registry.block_for("craq_chain", (7, 7, 7, 7))
         == registry.PLANES["craq_chain"].default_block
     )
+    # The legacy nearest-batch-extent heuristic survives as
+    # nearest_block() (the baseline the model dominates in
+    # tests/test_costmodel.py): same-arity keys resolve to a recorded
+    # entry, alien arities to None.
+    assert registry.nearest_block(name, (3, 3000, 64)) in {
+        v for k, v in table.items() if k.startswith(name + "|")
+    }
+    assert registry.nearest_block("craq_chain", (7, 7, 7, 7)) is None
 
 
 def test_per_device_autotune_resolution():
     """The kernels x mesh layer keys the block lookup on the PER-DEVICE
-    shape (G/D): with no exact entry at the local G, the nearest-G
-    fallback resolves deterministically to a recorded block — so
+    shape (G/D): with no exact entry at the local G, the model-ranked
+    fallback resolves deterministically to a sweepable candidate — so
     shard-local block picks never crash and never drift between
     devices (every device computes the same lookup)."""
+    from frankenpaxos_tpu.ops import costmodel
+
     name = "multipaxos_vote_quorum"
     table = registry._table()
-    recorded = {
-        v for k, v in table.items() if k.startswith(name + "|")
-    }
     for n_dev in (2, 4, 8):
         per_dev = (3, 3334 // n_dev, 64)
         assert registry.table_key(name, per_dev) not in table
         got = registry.block_for(name, per_dev)
-        assert got in recorded
+        assert got in costmodel.CANDIDATE_BLOCKS
         assert registry.block_for(name, per_dev) == got  # deterministic
 
 

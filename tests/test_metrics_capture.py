@@ -178,3 +178,44 @@ def test_v2_fleet_summary_rows_round_trip(tmp_path):
     assert float(strag["2{}"].iloc[0]) == 1.0
     scale = cap.query("fpx_fleet_admission_scale")
     assert float(scale["2{}"].iloc[0]) == 500.0
+
+
+def test_v2_efficiency_rows_round_trip(tmp_path):
+    """append_efficiency_samples writes the three fpx_efficiency_*
+    gauges (x1000 fixed point, params label) under schema v2 and
+    MetricsCapture pivots them back — the serve/fleet drain path the
+    dashboard's efficiency panel reads."""
+    from frankenpaxos_tpu.monitoring.scrape import (
+        CSV_COLUMNS,
+        EFFICIENCY_METRICS,
+        MetricsCapture,
+        append_efficiency_samples,
+    )
+
+    path = str(tmp_path / "eff.csv")
+    n = append_efficiency_samples(
+        path,
+        observed_per_tick=12.0,
+        predicted_per_tick=16.0,
+        params="cpu_jit",
+        ts=1000.0,
+    )
+    n += append_efficiency_samples(
+        path,
+        observed_per_tick=15.0,
+        predicted_per_tick=16.0,
+        params="cpu_jit",
+        ts=2000.0,
+    )
+    assert n == 2 * len(EFFICIENCY_METRICS)
+    with open(path) as f:
+        header = f.readline().strip().split(",")
+    assert header == CSV_COLUMNS
+    cap = MetricsCapture(path)
+    assert set(EFFICIENCY_METRICS) <= set(cap.names())
+    obs = cap.query("fpx_efficiency_observed_commits_per_tick_x1000")
+    col = obs.columns[0]
+    assert "params=cpu_jit" in col
+    assert list(obs[col]) == [12000.0, 15000.0]
+    ratio = cap.query("fpx_efficiency_ratio_x1000")
+    assert list(ratio[ratio.columns[0]]) == [750.0, 938.0]
