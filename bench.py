@@ -2353,6 +2353,221 @@ def _sessions_inner() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+def _elastic_inner() -> None:
+    """The elastic-capacity measurement (``--elastic``): the serve
+    loop under the SLO-driven autoscaler ladder (tpu/elastic.py +
+    monitoring/autoscaler.py). Two legs over the flagship with a
+    padded 8-group elastic plane and the session-table lifecycle on:
+
+      1. diurnal leg: a 24h-compressed day (night trough -> morning
+         ramp -> midday saturating burst -> evening trough) served
+         with role counts seeded at the floor — the burst's p99 alarm
+         GROWS active groups (traced resize verbs, zero recompiles),
+         the evening trough drains and shrinks them back, p99 returns
+         under target, and the exactly-once session books stay exact
+         across every resize;
+      2. fault leg: a degraded FaultPlan eats protocol capacity
+         mid-run — the ladder first absorbs the breach by scaling out
+         (what a clamp alone could not: admission is never refused
+         while padded capacity remains), engages the admission clamp
+         only once the role plane is exhausted, and on recovery
+         releases the clamp BEFORE giving capacity back.
+
+    One JSON line on stdout (BENCH_JSON ...). Capture artifact:
+    results/ELASTIC_r01.json."""
+    import dataclasses
+
+    import jax
+
+    from frankenpaxos_tpu.harness import serve as serve_mod
+    from frankenpaxos_tpu.monitoring.autoscaler import AutoscalerPolicy
+    from frankenpaxos_tpu.monitoring.slo import SloPolicy
+    from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+    from frankenpaxos_tpu.tpu.elastic import ElasticPlan
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    G, CAP, FLOOR = 8, 8, 2
+    P99_TARGET = 12
+
+    def build_loop(seed, faults=None, out_tag="diurnal"):
+        cfg = mp.BatchedMultiPaxosConfig(
+            f=1, num_groups=G, window=16, slots_per_tick=2,
+            retry_timeout=16,
+            workload=WorkloadPlan(
+                arrival="constant", rate=0.5, backlog_cap=256
+            ),
+            elastic=ElasticPlan(roles=(("groups", CAP, FLOOR),)),
+            lifecycle=LifecyclePlan(sessions=64, resubmit_rate=0.02),
+            **({"faults": faults} if faults is not None else {}),
+        )
+        serve_cfg = serve_mod.ServeConfig(
+            chunk_ticks=16, telemetry_window=64,
+            max_chunks=1,  # run_phase extends this per phase
+            slo=SloPolicy(
+                p99_target_ticks=P99_TARGET, source="queue_wait"
+            ),
+            autoscaler=AutoscalerPolicy(
+                cooldown_drains=0, trough_after=3
+            ),
+            scrape_csv=os.path.join(
+                _REPO, "results", f"elastic_{out_tag}_metrics.csv"
+            ),
+        )
+        try:
+            os.remove(serve_cfg.scrape_csv)
+        except OSError:
+            pass
+        return serve_mod.ServeLoop(
+            mp, cfg, serve_cfg, seed=seed,
+            elastic_initial={"groups": FLOOR},
+        )
+
+    def run_phase(loop, chunks, rate):
+        loop.set_base_rate(rate)
+        loop.serve = dataclasses.replace(
+            loop.serve, max_chunks=loop._chunks + chunks
+        )
+        return loop.run()
+
+    def drains_of(loop, n_last):
+        return loop.drains[-n_last:]
+
+    # ---- 1. Diurnal leg: the fleet breathes with the compressed day.
+    # Total offered load is rate x G lanes rerouted onto the ACTIVE
+    # groups, so the burst (1.75 x 8 = 14/tick) saturates 2 groups
+    # (admission cap 2/lane/tick) but fits 8 comfortably.
+    loop = build_loop(seed=0)
+    run_phase(loop, 6, 0.4)  # 00-06h: night trough at the floor
+    cache_after_warm = mp.run_ticks._cache_size()
+    run_phase(loop, 6, 1.0)   # 06-12h: morning ramp
+    run_phase(loop, 14, 1.75)  # 12-18h: saturating burst
+    burst_tail = [
+        d["slo"]["p99"] for d in drains_of(loop, 3)
+    ]
+    report = run_phase(loop, 14, 0.4)  # 18-24h: evening trough
+    trough_tail = [
+        d["slo"]["p99"] for d in drains_of(loop, 3)
+    ]
+    cache_at_end = mp.run_ticks._cache_size()
+    asum = report["autoscaler"]
+    inv = {
+        k: bool(v)
+        for k, v in jax.device_get(
+            mp.check_invariants(loop.cfg, loop.state, loop.t)
+        ).items()
+    }
+    diurnal_leg = {
+        "phases_hours": [[0, 6, 0.4], [6, 12, 1.0], [12, 18, 1.75],
+                         [18, 24, 0.4]],
+        "scale_up_events": asum["scale_up_events"],
+        "scale_down_events": asum["scale_down_events"],
+        "events": asum["events"],
+        "elastic": report["elastic"],
+        "p99_target_ticks": P99_TARGET,
+        "burst_steady_p99": burst_tail,
+        "trough_steady_p99": trough_tail,
+        "p99_under_target_steady": all(
+            0 <= p <= P99_TARGET for p in burst_tail + trough_tail
+        ),
+        "one_compile_per_mesh": (
+            cache_after_warm == cache_at_end == 1
+        ),
+        "invariants": inv,
+        "session_books_exact": bool(
+            inv.get("lifecycle_ok", False)
+            and inv.get("elastic_ok", False)
+            and inv.get("workload_ok", False)
+        ),
+        "lifecycle": report.get("lifecycle", {}),
+        "slo": report["slo"],
+    }
+
+    # ---- 2. Fault leg: the ladder in order. Drop faults eat protocol
+    # capacity mid-burst; scale-out absorbs what it can, the clamp
+    # binds only at capacity exhaustion, release precedes shrink.
+    loop = build_loop(seed=1, faults=FaultPlan(traced=True),
+                      out_tag="fault")
+    run_phase(loop, 6, 1.0)  # healthy warmup below target
+    loop.set_fault_rates(drop=0.5)  # the injected degradation
+    run_phase(loop, 18, 1.75)  # burst under faults: grow, then clamp
+    loop.set_fault_rates(drop=0.0)  # fault clears
+    report_f = run_phase(loop, 18, 0.4)  # recovery + trough
+    fsum = report_f["autoscaler"]
+    kinds = [e["kind"] for e in fsum["events"]]
+
+    def first(kind):
+        return kinds.index(kind) if kind in kinds else None
+
+    def last(kind):
+        return (
+            len(kinds) - 1 - kinds[::-1].index(kind)
+            if kind in kinds
+            else None
+        )
+
+    ladder_in_order = (
+        first("scale_up") is not None
+        and first("clamp_engage") is not None
+        and first("clamp_release") is not None
+        and first("scale_up") < first("clamp_engage")
+        # The clamp binds only after the role plane is exhausted: every
+        # scale-up that precedes the first engage happened first.
+        and all(
+            k != "scale_up" or i < first("clamp_engage")
+            for i, k in enumerate(kinds[: first("clamp_engage")])
+        )
+        and (
+            first("scale_down") is None
+            or first("clamp_release") < first("scale_down")
+        )
+    )
+    fault_leg = {
+        "events": fsum["events"],
+        "event_kinds": kinds,
+        "scale_up_events": fsum["scale_up_events"],
+        "clamp_engagements": fsum["clamp_engagements"],
+        "clamp_releases": fsum["clamp_releases"],
+        "scale_down_events": fsum["scale_down_events"],
+        "ladder_in_order": ladder_in_order,
+        "clamp_alone_could_not": (
+            # Capacity the clamp cannot create: the scale-outs that
+            # absorbed load before ANY admission was refused.
+            first("scale_up") is not None
+            and first("clamp_engage") is not None
+            and fsum["scale_up_events"] > 0
+        ),
+        "elastic": report_f["elastic"],
+        "slo": report_f["slo"],
+    }
+
+    result = {
+        "metric": "elastic capacity: SLO-driven live resize of role "
+        "planes (scale out under duress, clamp as last resort)",
+        "backend": "multipaxos",
+        "device": str(jax.devices()[0]),
+        "elastic_plan": {"groups": {"capacity": CAP, "floor": FLOOR}},
+        "diurnal_leg": diurnal_leg,
+        "fault_leg": fault_leg,
+        "ok": (
+            diurnal_leg["scale_up_events"] >= 2
+            and diurnal_leg["scale_down_events"] >= 2
+            and diurnal_leg["p99_under_target_steady"]
+            and diurnal_leg["one_compile_per_mesh"]
+            and diurnal_leg["session_books_exact"]
+            and all(diurnal_leg["invariants"].values())
+            and fault_leg["ladder_in_order"]
+        ),
+        "measured_live": True,
+    }
+    with open(
+        os.path.join(_REPO, "results", "ELASTIC_r01.json"), "w"
+    ) as f:
+        json.dump(result, f, indent=1)
+    print("BENCH_JSON " + json.dumps(result))
+
+
 def _subprocess_mode_main(inner_flag: str, metric: str, env: dict) -> None:
     """Shared orchestrator for the standalone bench modes (--workload,
     --multichip): run this script's inner mode in a clean subprocess,
@@ -2448,6 +2663,17 @@ def _fleet_main() -> None:
         "--inner-fleet",
         "fleet-axis capacity surface + device-rate fuzzing throughput",
         env,
+    )
+
+
+def _elastic_main() -> None:
+    """Orchestrate the elastic-capacity measurement in a clean CPU
+    subprocess; print exactly one JSON line, exit 0."""
+    _subprocess_mode_main(
+        "--inner-elastic",
+        "elastic capacity: SLO-driven live resize of role planes "
+        "(scale out under duress, clamp as last resort)",
+        _cpu_env(),
     )
 
 
@@ -2757,6 +2983,8 @@ if __name__ == "__main__":
         _lifecycle_inner()
     elif "--inner-sessions" in sys.argv:
         _sessions_inner()
+    elif "--inner-elastic" in sys.argv:
+        _elastic_inner()
     elif "--inner" in sys.argv:
         _inner_main()
     elif "--multichip" in sys.argv:
@@ -2773,5 +3001,7 @@ if __name__ == "__main__":
         _lifecycle_main()
     elif "--sessions" in sys.argv:
         _sessions_main()
+    elif "--elastic" in sys.argv:
+        _elastic_main()
     else:
         main()

@@ -44,7 +44,12 @@ from frankenpaxos_tpu.analysis import astutil
 # inside the model's measured/predicted envelope, no round-over-round
 # ratio regression, and results/costmodel_envelope.json matches the
 # in-tree model constants).
-ANALYSIS_VERSION = "2.1"
+# 2.2: the elastic-capacity gates — elastic-noop (ElasticPlan.none()
+# is a structural no-op: zero-sized State leaves feeding no tick
+# equation) and trace-elastic-retrace (role-count resizes ride the
+# traced membership scalars, so every autoscaler scale-up/down
+# replays ONE compiled program; the jit cache stays flat).
+ANALYSIS_VERSION = "2.2"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"

@@ -926,6 +926,173 @@ def check_lifecycle_retrace(ctx: Context) -> List[Finding]:
     return out
 
 
+# Backends that thread the elastic-capacity subsystem
+# (tpu/elastic.py); the elastic-noop / trace-elastic-retrace rules
+# cover exactly these (padded role planes roll out flagship +
+# compartmentalized first — the two backends the autoscaler ladder
+# serves).
+ELASTIC_BACKENDS = ("multipaxos", "compartmentalized")
+
+
+def _elastic_plan_for(backend: str):
+    """An ElasticPlan matching the backend's analysis_config axes."""
+    from frankenpaxos_tpu.tpu.elastic import ElasticPlan
+
+    if backend == "multipaxos":
+        return ElasticPlan(roles=(("groups", 4, 1),))
+    return ElasticPlan(roles=(
+        ("proxies", 4, 1), ("batchers", 2, 1),
+        ("unbatchers", 2, 1), ("replicas", 3, 1),
+    ))
+
+
+@rule(
+    "elastic-noop",
+    "trace",
+    "under ElasticPlan.none() every elastic State leaf is zero-sized "
+    "and feeds no tick equation — the structural no-op contract that "
+    "keeps default runs bit-identical to the pre-elastic program",
+)
+def check_elastic_noop(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import jax
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        if backend not in ELASTIC_BACKENDS:
+            continue
+        # Shared with trace-dtype-policy / trace-workload-noop: ONE
+        # default-config tick trace per backend per process.
+        closed, state = _tick_closed(backend)
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        el_idx = [
+            i
+            for i, (path, leaf) in enumerate(flat)
+            if path and getattr(path[0], "name", None) == "elastic"
+        ]
+        if not el_idx:
+            out.append(
+                Finding(
+                    rule="elastic-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "State carries no elastic field — the "
+                        "subsystem is not threaded through this backend"
+                    ),
+                    key=f"{backend}:missing",
+                )
+            )
+            continue
+        sized = [
+            flat[i][1].size for i in el_idx if flat[i][1].size != 0
+        ]
+        if sized:
+            out.append(
+                Finding(
+                    rule="elastic-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"ElasticPlan.none() state carries "
+                        f"{len(sized)} NON-empty leaf/leaves — the "
+                        "none plan must be structurally empty"
+                    ),
+                    key=f"{backend}:sized",
+                )
+            )
+        invars = closed.jaxpr.invars
+        el_vars = {id(invars[i]) for i in el_idx}
+        consumed = sum(
+            1
+            for eqn in closed.jaxpr.eqns
+            for v in eqn.invars
+            if id(v) in el_vars
+        )
+        if consumed:
+            out.append(
+                Finding(
+                    rule="elastic-noop",
+                    path=backend,
+                    line=0,
+                    message=(
+                        f"{consumed} tick equation input(s) consume an "
+                        "elastic leaf under ElasticPlan.none() — the "
+                        "none plan must add ZERO ops"
+                    ),
+                    key=f"{backend}:consumed",
+                )
+            )
+    return out
+
+
+@rule(
+    "trace-elastic-retrace",
+    "trace",
+    "live resize is recompile-free: steering the traced role-count "
+    "targets (ServeLoop.resize -> elastic.set_target) between "
+    "run_ticks segments replays ONE compiled program — the serve/"
+    "fleet jit caches stay FLAT across every scale-up and scale-down",
+)
+def check_elastic_retrace(ctx: Context) -> List[Finding]:
+    _jax_cache_setup()
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.tpu import elastic as _elastic
+
+    out: List[Finding] = []
+    for backend in _selected(ctx):
+        if backend not in ELASTIC_BACKENDS:
+            continue
+        mod = _module(backend)
+        plan = _elastic_plan_for(backend)
+        cfg = mod.analysis_config(elastic=plan)
+
+        def run(st):
+            st, t = mod.run_ticks(
+                cfg, st, jnp.zeros((), jnp.int32), _TICKS,
+                jax.random.PRNGKey(0),
+            )
+            jax.block_until_ready(t)
+            return st
+
+        st = run(mod.init_state(cfg))
+        before = mod.run_ticks._cache_size()
+        # Shrink every role toward its floor, run a segment, grow back
+        # to capacity, run again — two resize generations through the
+        # same executable.
+        es = st.elastic
+        for name in plan.names:
+            es = _elastic.set_target(plan, es, name, plan.floor_of(name))
+        st = run(_dc.replace(st, elastic=es))
+        es = st.elastic
+        for name in plan.names:
+            es = _elastic.set_target(
+                plan, es, name, plan.capacity_of(name)
+            )
+        run(_dc.replace(st, elastic=es))
+        after = mod.run_ticks._cache_size()
+        if after > before:
+            out.append(
+                Finding(
+                    rule="trace-elastic-retrace",
+                    path=backend,
+                    line=0,
+                    message=(
+                        "a role-count resize missed the jit cache "
+                        f"({before} -> {after} entries) — a target "
+                        "count landed in a static argument and every "
+                        "autoscaler action recompiles the serve loop"
+                    ),
+                    key=backend,
+                )
+            )
+    return out
+
+
 # Backends whose traced sweep gets the COMPILE-backed jit-cache check
 # (the XLA-compile half of the retrace rule). The cheap trace-only
 # coverage below still runs for every backend — the traced-rate

@@ -60,6 +60,14 @@ HEARTBEAT_FILE = "progress.json"
 FINAL_FILE = "final.json"
 CKPT_SUBDIR = "checkpoints"
 
+# Worker shapes beyond the two serve-grade backends: any of these runs
+# the kill-and-recover contract at its canonical analysis_config shape
+# with the shaped workload engaged. They carry no session table or
+# elastic plane (those thread only multipaxos/compartmentalized), so
+# the assertions reduce to liveness + invariants + bit-exact digest —
+# which is exactly what host-process death must preserve everywhere.
+GENERIC_BACKENDS = ("mencius", "epaxos", "scalog", "craq")
+
 
 # ---------------------------------------------------------------------------
 # Worker: the supervised serve process
@@ -70,26 +78,60 @@ def _worker_cfg(args):
     """The worker's backend config: small flagship (or
     compartmentalized) shape with the session table + shaped workload
     engaged, so the exactly-once and conservation assertions have
-    teeth."""
+    teeth; or one of ``GENERIC_BACKENDS`` at its canonical
+    analysis_config shape (workload on, no session/elastic planes).
+    ``--elastic`` arms the THIRD serve-grade worker shape:
+    padded role planes (tpu/elastic.py) + the reconfig membership
+    masks + the SLO/autoscaler ladder, started at the FLOOR so the
+    overloaded workload forces live scale-ups — a SIGKILL then lands
+    mid-resize and the resume must restore masks, role counts, and the
+    autoscaler's ladder position bit-exactly."""
+    from frankenpaxos_tpu.tpu.elastic import ElasticPlan
     from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
     from frankenpaxos_tpu.tpu.workload import WorkloadPlan
 
     workload = WorkloadPlan(
         arrival="constant", rate=1.5, backlog_cap=128
     )
+    if args.backend in GENERIC_BACKENDS:
+        assert not args.elastic, (
+            f"--elastic threads only the serve-grade backends, "
+            f"not {args.backend}"
+        )
+        import importlib
+
+        mod = importlib.import_module(
+            f"frankenpaxos_tpu.tpu.{args.backend}_batched"
+        )
+        return mod, mod.analysis_config(workload=workload)
     lifecycle = LifecyclePlan(
-        sessions=args.sessions, resubmit_rate=args.resubmit_rate
+        sessions=args.sessions, resubmit_rate=args.resubmit_rate,
+        reconfig=bool(args.elastic),
     )
     if args.backend == "compartmentalized":
         from frankenpaxos_tpu.tpu import compartmentalized_batched as mod
 
-        cfg = mod.analysis_config(workload=workload, lifecycle=lifecycle)
+        elastic = (
+            ElasticPlan(roles=(
+                ("proxies", 4, 1), ("batchers", 2, 1),
+                ("unbatchers", 2, 1), ("replicas", 3, 1),
+            ))
+            if args.elastic else ElasticPlan.none()
+        )
+        cfg = mod.analysis_config(
+            workload=workload, lifecycle=lifecycle, elastic=elastic
+        )
     else:
         from frankenpaxos_tpu.tpu import multipaxos_batched as mod
 
+        elastic = (
+            ElasticPlan(roles=(("groups", args.groups, 2),))
+            if args.elastic else ElasticPlan.none()
+        )
         cfg = mod.BatchedMultiPaxosConfig(
             f=1, num_groups=args.groups, window=16, slots_per_tick=2,
             retry_timeout=8, workload=workload, lifecycle=lifecycle,
+            elastic=elastic,
         )
     return mod, cfg
 
@@ -107,15 +149,28 @@ class _SupervisedLoop:
         out_dir: str,
         hang_after: Optional[int],
         chunk_delay: float = 0.0,
+        membership_script: bool = False,
     ):
         self.loop = loop
         self.out_dir = out_dir
         self.hang_after = hang_after
         self.chunk_delay = chunk_delay
+        self.membership_script = membership_script
         loop_drain = loop._drain
 
         def drain_and_heartbeat(snap):
             out = loop_drain(snap)
+            if self.membership_script:
+                # Deterministic membership churn keyed on the chunk
+                # count: a resumed worker replays from a checkpoint
+                # BOUNDARY strictly before the kill, so each verb
+                # fires exactly once per (replayed) history and the
+                # killed run's masks match the uninterrupted twin's.
+                c = self.loop._chunks
+                if c == 3:
+                    self.loop.swap_acceptor(1)
+                elif c == 7:
+                    self.loop.reconfigure(True)  # the heal
             self._heartbeat()
             if (
                 self.hang_after is not None
@@ -177,12 +232,26 @@ def run_worker(args) -> int:
     mod, cfg = _worker_cfg(args)
     os.makedirs(args.out_dir, exist_ok=True)
     ckpt_dir = os.path.join(args.out_dir, CKPT_SUBDIR)
+    slo = autoscaler = None
+    if args.elastic:
+        from frankenpaxos_tpu.monitoring.autoscaler import (
+            AutoscalerPolicy,
+        )
+        from frankenpaxos_tpu.monitoring.slo import SloPolicy
+
+        # A tight p99 target over queue wait + the floor-sized start
+        # below guarantee the ladder ACTS (scale-ups march while the
+        # backlog clears), so the SIGKILL schedule lands mid-resize.
+        slo = SloPolicy(p99_target_ticks=4, source="queue_wait")
+        autoscaler = AutoscalerPolicy(cooldown_drains=0, trough_after=4)
     serve = ServeConfig(
         chunk_ticks=args.chunk_ticks,
         telemetry_window=max(2 * args.chunk_ticks, 64),
         max_chunks=args.chunks,
         checkpoint_dir=None if args.no_checkpoint else ckpt_dir,
         checkpoint_every=0 if args.no_checkpoint else args.every,
+        slo=slo,
+        autoscaler=autoscaler,
     )
     resumed = False
     loop = None
@@ -197,11 +266,22 @@ def run_worker(args) -> int:
         except checkpoint_mod.CheckpointError:
             pass
     if loop is None:
-        loop = ServeLoop(mod, cfg, serve, seed=args.seed)
+        eplan = getattr(cfg, "elastic", None)
+        loop = ServeLoop(
+            mod, cfg, serve, seed=args.seed,
+            elastic_initial=(
+                {n: eplan.floor_of(n) for n in eplan.names}
+                if args.elastic and eplan is not None and eplan.active
+                else None
+            ),
+        )
     sup = _SupervisedLoop(
         loop, args.out_dir,
         hang_after=args.hang_after if args.hang_after >= 0 else None,
         chunk_delay=args.chunk_delay,
+        membership_script=(
+            args.elastic and args.backend == "multipaxos"
+        ),
     )
     sup._heartbeat(phase="startup")
     report = loop.run()
@@ -226,6 +306,16 @@ def run_worker(args) -> int:
             else None
         ),
     }
+    # The elastic leg's extra books: the device-side role counts and
+    # the autoscaler's FULL host-side ladder context (the smoke
+    # asserts both equal the uninterrupted twin's).
+    el_plan = getattr(cfg, "elastic", None)
+    if el_plan is not None and el_plan.active:
+        from frankenpaxos_tpu.tpu import elastic as elastic_mod
+
+        final["elastic"] = elastic_mod.summary(el_plan, loop.state.elastic)
+    if getattr(loop, "autoscaler", None) is not None:
+        final["autoscaler"] = loop.autoscaler.to_state()
     jax.block_until_ready(loop.state)
     tmp = os.path.join(args.out_dir, FINAL_FILE + ".tmp")
     with open(tmp, "w") as f:
@@ -293,6 +383,7 @@ def run_kill_recover(
     chunk_ticks: int = 10,
     seed: int = 0,
     backend: str = "multipaxos",
+    elastic: bool = False,
     kill_seed: int = 0,
     max_kills: int = 2,
     chunk_delay: float = 0.0,
@@ -333,6 +424,8 @@ def run_kill_recover(
         "--chunk-ticks", str(chunk_ticks), "--seed", str(seed),
         "--backend", backend,
     ]
+    if elastic:
+        argv_extra.append("--elastic")
     if chunk_delay:
         argv_extra += ["--chunk-delay", str(chunk_delay)]
     if hang_after >= 0:
@@ -454,6 +547,7 @@ def uninterrupted_digest(
     seed: int,
     backend: str,
     out_dir: str,
+    elastic: bool = False,
 ) -> dict:
     """The twin: the same worker run IN PROCESS with no kills — its
     final digest is what a killed-and-recovered run must reproduce
@@ -467,6 +561,7 @@ def uninterrupted_digest(
         chunk_ticks=chunk_ticks, seed=seed, backend=backend,
         resume=False, hang_after=-1, no_checkpoint=False,
         sessions=4, resubmit_rate=0.1, groups=8, chunk_delay=0.0,
+        elastic=elastic,
     )
     os.makedirs(out_dir, exist_ok=True)
     rc = run_worker(args)
@@ -494,10 +589,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--chunk-ticks", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--backend", default="multipaxos",
-                   choices=("multipaxos", "compartmentalized"))
+                   choices=("multipaxos", "compartmentalized")
+                   + GENERIC_BACKENDS)
     p.add_argument("--groups", type=int, default=8)
     p.add_argument("--sessions", type=int, default=4)
     p.add_argument("--resubmit-rate", type=float, default=0.1)
+    p.add_argument("--elastic", action="store_true",
+                   help="the elastic worker shape: padded role planes "
+                   "+ reconfig masks + the SLO/autoscaler ladder "
+                   "(kills land mid-resize)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--no-checkpoint", action="store_true")
     p.add_argument("--chunk-delay", type=float, default=0.0,
@@ -520,7 +620,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             kill_dir,
             chunks=args.chunks, every=args.every,
             chunk_ticks=args.chunk_ticks, seed=args.seed,
-            backend=args.backend, kill_seed=args.kill_seed,
+            backend=args.backend, elastic=args.elastic,
+            kill_seed=args.kill_seed,
             max_kills=1,
             chunk_delay=args.chunk_delay or 0.15,
             poll=0.05,
@@ -539,6 +640,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             chunks=args.chunks, every=args.every,
             chunk_ticks=args.chunk_ticks, seed=args.seed,
             backend=args.backend, out_dir=twin_dir,
+            elastic=args.elastic,
         )
         assert res.final["digest"] == twin["digest"], (
             "recovered run diverged from the uninterrupted twin:\n"
@@ -547,6 +649,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         lc = res.final.get("lifecycle") or {}
         assert lc.get("cache_hits", 0) <= lc.get("resubmits", 0)
+        if args.elastic:
+            # Mid-resize recovery: the ladder context (targets, latch,
+            # streaks) and the device-side role books both replay the
+            # twin's, and the run actually resized (the kill had a
+            # resize in flight to land on).
+            assert res.final["autoscaler"] == twin["autoscaler"], (
+                res.final["autoscaler"], twin["autoscaler"],
+            )
+            assert res.final["elastic"] == twin["elastic"]
+            assert res.final["elastic"]["scale_ups"] >= 1
         print(json.dumps({
             "recovery_smoke": "PASS",
             "kills": res.kills,
@@ -555,6 +667,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "bit_exact_vs_twin": True,
             "invariants_ok": res.final["invariants_ok"],
             "lifecycle": lc,
+            "elastic": res.final.get("elastic"),
         }))
         return 0
 
@@ -562,7 +675,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.out_dir,
         chunks=args.chunks, every=args.every,
         chunk_ticks=args.chunk_ticks, seed=args.seed,
-        backend=args.backend, kill_seed=args.kill_seed,
+        backend=args.backend, elastic=args.elastic,
+        kill_seed=args.kill_seed,
         max_kills=args.max_kills, chunk_delay=args.chunk_delay,
     )
     print(json.dumps(res.to_dict()))

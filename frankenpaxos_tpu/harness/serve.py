@@ -82,12 +82,17 @@ import jax.numpy as jnp
 from frankenpaxos_tpu.monitoring import scrape as scrape_mod
 from frankenpaxos_tpu.ops import costmodel
 from frankenpaxos_tpu.monitoring import traceviz
+from frankenpaxos_tpu.monitoring.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+)
 from frankenpaxos_tpu.monitoring.slo import (
     FleetSloEngine,
     SloEngine,
     SloPolicy,
 )
 from frankenpaxos_tpu.tpu import checkpoint as checkpoint_mod
+from frankenpaxos_tpu.tpu import elastic as elastic_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
@@ -113,9 +118,22 @@ class ServeConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
     checkpoint_keep: int = 3
+    # Elastic capacity (tpu/elastic.py + monitoring/autoscaler.py):
+    # arming a policy puts the graceful-degradation LADDER between the
+    # SLO alarms and the admission clamp — alarms first GROW the
+    # bottleneck role's traced count (ServeLoop.resize, zero
+    # recompiles) and only clamp admission once every padded role
+    # plane is exhausted. Needs slo armed and an ElasticPlan-active
+    # backend config.
+    autoscaler: Optional[AutoscalerPolicy] = None
 
     def __post_init__(self):
         assert self.chunk_ticks >= 1
+        if self.autoscaler is not None:
+            assert self.slo is not None, (
+                "the autoscaler ladder is driven by SLO alarms — arm "
+                "ServeConfig.slo"
+            )
         # Exact drains need the ring to retain at least one full chunk.
         assert self.telemetry_window >= self.chunk_ticks, (
             "telemetry_window must cover a chunk or drains drop ticks"
@@ -182,6 +200,7 @@ class ServeLoop:
         cfg,
         serve: ServeConfig,
         seed: int = 0,
+        elastic_initial: Optional[Dict[str, int]] = None,
     ):
         self.mod = mod
         self.cfg = cfg
@@ -195,6 +214,36 @@ class ServeLoop:
                 serve.telemetry_window, spans=serve.spans
             ),
         )
+        # Elastic capacity: seed the traced role counts below their
+        # padded capacities (the plane the autoscaler grows INTO), and
+        # stand up the ladder's policy engine. The autoscaler tracks
+        # targets host-side — it is the loop's single writer of them —
+        # so the hot path never reads elastic state off the device.
+        eplan = getattr(cfg, "elastic", None)
+        if elastic_initial:
+            assert eplan is not None and eplan.active, (
+                "elastic_initial needs an ElasticPlan-active config"
+            )
+            self.state = dataclasses.replace(
+                self.state,
+                elastic=elastic_mod.make_state(eplan, elastic_initial),
+            )
+        self.autoscaler: Optional[Autoscaler] = None
+        if serve.autoscaler is not None:
+            assert eplan is not None and eplan.active, (
+                "ServeConfig.autoscaler needs an ElasticPlan-active "
+                "backend config"
+            )
+            self.autoscaler = Autoscaler(
+                serve.autoscaler,
+                {
+                    name: (
+                        eplan.capacity_of(name), eplan.floor_of(name)
+                    )
+                    for name in eplan.names
+                },
+                initial=elastic_initial,
+            )
         self.t = jnp.zeros((), jnp.int32)
         self.cursor = telemetry_mod.DrainCursor()
         self.clock = traceviz.TickClock()
@@ -210,6 +259,7 @@ class ServeLoop:
         )
         self._prev: Dict[str, Any] = {}  # previous drain's cumulatives
         self._spans_scraped = 0  # host spans already appended to CSV
+        self._cap_scraped = 0  # capacity events already appended
         # Efficiency telemetry: the cost model's expected commits/tick
         # for THIS config (0.0 = shape not covered, gauges off) and the
         # previous drain's (ticks, commits) cumulatives for deltas.
@@ -317,6 +367,41 @@ class ServeLoop:
         )
         self._span("verb:rotate", time.time(), time.perf_counter())
 
+    def resize(self, role: str, n: int):
+        """Elastic-capacity verb: steer ``role``'s traced TARGET count
+        (tpu/elastic.py set_target). Scale-ups take effect next chunk;
+        scale-downs drain first (the backend deactivates the tail only
+        once its in-flight work lands — no command is lost). A pure
+        traced-state edit, so the jit cache stays flat across every
+        resize (the ``trace-elastic-retrace`` rule); the span is a
+        Perfetto INSTANT marker, so capacity events land on the
+        timeline next to the alarm/clamp marks."""
+        plan = getattr(self.cfg, "elastic", None)
+        assert plan is not None and plan.declares(role), (
+            f"config's ElasticPlan does not declare role {role!r}"
+        )
+        self.state = dataclasses.replace(
+            self.state,
+            elastic=elastic_mod.set_target(
+                plan, self.state.elastic, role, n
+            ),
+        )
+        self._span("verb:resize", time.time(), time.perf_counter(),
+                   instant=True, role=role, to=int(n))
+
+    def set_base_rate(self, rate: float):
+        """Re-anchor the offered-load BASE rate the SLO clamp scales —
+        the diurnal driver's knob (bench.py --elastic sweeps it across
+        the compressed day). Applies immediately through the same
+        traced ``workload.set_rate`` scalar."""
+        self._base_rate = float(rate)
+        scale = 1.0
+        if self.slo is not None and (
+            self.autoscaler is None or self.autoscaler.clamp_engaged
+        ):
+            scale = self.slo.scale
+        self.set_rate(self._base_rate * scale)
+
     def install_trace(self, words):
         """Install a recorded arrival trace (tpu/packing.py delta
         codec) into the open-loop workload cursor — a pure state swap
@@ -365,6 +450,14 @@ class ServeLoop:
             "cursor_span": int(self.cursor.span),
             "prev": checkpoint_mod.jsonable(self._prev),
             "slo": self.slo.to_state() if self.slo is not None else None,
+            # The ladder's full decision state (targets, clamp latch,
+            # cooldown, trough streak): a SIGKILL mid-resize resumes
+            # with the autoscaler context restored bit-exactly.
+            "autoscaler": (
+                self.autoscaler.to_state()
+                if self.autoscaler is not None
+                else None
+            ),
         }
         return ctx
 
@@ -555,6 +648,11 @@ class ServeLoop:
         }
         if self.slo is not None and ctx.get("slo") is not None:
             self.slo.restore_state(ctx["slo"])
+        if (
+            self.autoscaler is not None
+            and ctx.get("autoscaler") is not None
+        ):
+            self.autoscaler.restore_state(ctx["autoscaler"])
         # The checkpoint froze the loop BETWEEN chunks: the last chunk's
         # telemetry was still undrained (its rows sit in the restored
         # ring, ahead of the restored cursor), so re-snapshot it as the
@@ -628,6 +726,20 @@ class ServeLoop:
                 "shed": shed,
             }
             drain["slo"] = status
+            scale = self.slo.scale
+            if self.autoscaler is not None:
+                # The graceful-degradation LADDER sits between the
+                # alarm and the clamp: an alarm first GROWS the
+                # bottleneck role's traced count (resize verb — zero
+                # recompiles); the admission clamp binds only once
+                # every padded role plane is exhausted
+                # (decision["effective_scale"] stays 1.0 until then);
+                # recovery releases the clamp before any role shrinks.
+                decision = self.autoscaler.decide(status)
+                drain["autoscaler"] = decision
+                for act in decision["actions"]:
+                    self.resize(act["role"], act["to"])
+                scale = decision["effective_scale"]
             if self._base_rate is not None:
                 # The control-plane hook: clamp/recover the offered
                 # rate through the TRACED state scalar — the same
@@ -636,7 +748,7 @@ class ServeLoop:
                     self.state,
                     workload=workload_mod.set_rate(
                         self.state.workload,
-                        self._base_rate * self.slo.scale,
+                        self._base_rate * scale,
                     ),
                 )
         if self.serve.scrape_csv:
@@ -653,6 +765,15 @@ class ServeLoop:
                 instance="serve",
             )
             self._spans_scraped = len(self.host_spans)
+            if self.autoscaler is not None:
+                # Capacity events, exactly once each (the host-span
+                # cursor discipline).
+                scrape_mod.append_capacity_events(
+                    self.serve.scrape_csv,
+                    self.autoscaler.events[self._cap_scraped:],
+                    instance="serve",
+                )
+                self._cap_scraped = len(self.autoscaler.events)
             # Efficiency gauges: this drain's observed commits/tick
             # against the cost model's expected rate for the config.
             if self._model_rate > 0.0:
@@ -757,6 +878,15 @@ class ServeLoop:
             out["resumed_from"] = self.resumed_from
         if self.slo is not None:
             out["slo"] = self.slo.summary()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.summary()
+        eplan = getattr(self.cfg, "elastic", None)
+        if eplan is not None and eplan.active:
+            # Device-side resize roll-up (the run is already synced at
+            # shutdown, so this tiny pull is off the hot path).
+            out["elastic"] = elastic_mod.summary(
+                eplan, self.state.elastic
+            )
         lc_plan = getattr(self.cfg, "lifecycle", None)
         if lc_plan is not None and lc_plan.active:
             # Rotation / session-table / reconfiguration roll-up (one
@@ -970,6 +1100,14 @@ class FleetServeLoop:
         self.base_rates = (
             [float(r) for r in rates] if rates is not None else None
         )
+        # Fleet elasticity: the brick's F instances ARE the padded
+        # role plane; activation is the traced per-instance rate
+        # vector (set_active_instances redistributes the total offered
+        # load over the first k instances, zeroing the tail).
+        self._active_n = self.n
+        self._effective_rates = (
+            list(self.base_rates) if self.base_rates is not None else None
+        )
         # The straggler anchor: either the hand-fed constant or the
         # cost model's expected commits/tick for this backend config
         # (capped by the slowest instance's offered rate when the fleet
@@ -1023,6 +1161,46 @@ class FleetServeLoop:
             self.states, rates, self.mesh
         )
         self._span("verb:set_rates", time.time(), time.perf_counter())
+
+    def set_active_instances(self, k: int):
+        """Fleet elasticity over the padded instance axis: serve the
+        whole fleet's offered load from the first ``k`` instances
+        (instance i >= k gets traced rate 0 — deactivated but still
+        ticking bit-live, so scaling back up is the same verb). The
+        rate redistribution rides ``sharding.set_fleet_rates`` — the
+        ONE compiled executable per mesh never changes, and the
+        per-instance SLO clamps keep multiplying into the NEW
+        effective rates on every drain."""
+        assert self.base_rates is not None, (
+            "fleet elasticity needs explicit base rates"
+        )
+        k = int(k)
+        assert 1 <= k <= self.n
+        prev = self._active_n
+        self._active_n = k
+        total = sum(self.base_rates)
+        self._effective_rates = [
+            (total / k if i < k else 0.0) for i in range(self.n)
+        ]
+        scales = (
+            self.slo.scales if self.slo is not None else [1.0] * self.n
+        )
+        self.states = self.sharding.set_fleet_rates(
+            self.states,
+            [r * s for r, s in zip(self._effective_rates, scales)],
+            self.mesh,
+        )
+        tick = (
+            self.drains[-1]["ticks_total"] if self.drains else 0
+        )
+        if k != prev:
+            self.markers.append({
+                "instance": -1, "tick": tick,
+                "kind": "scale_up" if k > prev else "scale_down",
+                "from": prev, "to": k,
+            })
+        self._span("verb:set_active_instances", time.time(),
+                   time.perf_counter(), instant=True, to=k)
 
     # -- the hot path -------------------------------------------------------
 
@@ -1134,10 +1312,15 @@ class FleetServeLoop:
                                 "scale": st["scale"],
                             })
                 # One state-side vector update per drain (also when a
-                # scale RECOVERS toward 1.0) — never a recompile.
+                # scale RECOVERS toward 1.0) — never a recompile. The
+                # effective rates fold in any set_active_instances
+                # redistribution on top of the base rates.
                 self.states = self.sharding.set_fleet_rates(
                     self.states,
-                    [r * s for r, s in zip(self.base_rates, scales)],
+                    [
+                        r * s
+                        for r, s in zip(self._effective_rates, scales)
+                    ],
                     self.mesh,
                 )
 
@@ -1247,6 +1430,7 @@ class FleetServeLoop:
             "summary": last.get("summary", []),
             "stragglers_flagged": flagged,
             "markers": list(self.markers),
+            "active_instances": self._active_n,
             "clean_shutdown": self.clean_shutdown,
         }
         if self.slo is not None:

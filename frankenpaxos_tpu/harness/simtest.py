@@ -72,7 +72,9 @@ from frankenpaxos_tpu.tpu import (
     unreplicated_batched,
     vanillamencius_batched,
 )
+from frankenpaxos_tpu.tpu import elastic as elastic_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
+from frankenpaxos_tpu.tpu.elastic import ElasticPlan
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan
@@ -108,6 +110,10 @@ class SimSpec:
     # (tpu/lifecycle.py), so the reconfiguration-epoch axis
     # (run_reconfig_schedule / random_lifecycle) applies.
     lifecycle_ok: bool = False
+    # The backend threads the elastic-capacity subsystem
+    # (tpu/elastic.py), so the [faults x resize] churn axis
+    # (run_elastic_schedule / random_elastic) applies.
+    elastic_ok: bool = False
 
 
 def _specs() -> Dict[str, SimSpec]:
@@ -130,7 +136,7 @@ def _specs() -> Dict[str, SimSpec]:
             "multipaxos", mp,
             mp.analysis_config,
             lambda st: st.committed, partition_axis=3,
-            lifecycle_ok=True,
+            lifecycle_ok=True, elastic_ok=True,
         ),
         SimSpec(
             "mencius", me,
@@ -233,7 +239,7 @@ def _specs() -> Dict[str, SimSpec]:
             "compartmentalized", cz,
             cz.analysis_config,
             lambda st: st.committed + st.reads_done, partition_axis=4,
-            read_mix_ok=True, lifecycle_ok=True,
+            read_mix_ok=True, lifecycle_ok=True, elastic_ok=True,
         ),
     ]
     return {s.name: s for s in entries}
@@ -378,6 +384,34 @@ def random_lifecycle(
         kw["sessions"] = rng.choice([2, 4, 8])
         kw["resubmit_rate"] = round(rng.uniform(0.05, 0.3), 3)
     return LifecyclePlan(**kw)
+
+
+# Padded-capacity axes per elastic-threaded backend, matching the
+# analysis_config shapes (the capacity IS the structural count — the
+# plan pads nothing extra at analysis scale; floors of 1 leave every
+# role shrinkable).
+_ELASTIC_AXES: Dict[str, Tuple[Tuple[str, int, int], ...]] = {
+    "multipaxos": (("groups", 4, 1),),
+    "compartmentalized": (
+        ("proxies", 4, 1), ("batchers", 2, 1),
+        ("unbatchers", 2, 1), ("replicas", 3, 1),
+    ),
+}
+
+
+def random_elastic(rng: _random.Random, spec: SimSpec) -> ElasticPlan:
+    """One randomized elastic shape for an elastic-threaded backend
+    (deterministic from ``rng``): the full role set half the time, a
+    random non-empty subset otherwise — the subset draw exercises
+    configs where only SOME roles are resizable while the rest stay
+    structural."""
+    if not spec.elastic_ok:
+        return ElasticPlan.none()
+    axes = _ELASTIC_AXES[spec.name]
+    if rng.random() < 0.5 or len(axes) == 1:
+        return ElasticPlan(roles=axes)
+    keep = [a for a in axes if rng.random() < 0.6]
+    return ElasticPlan(roles=tuple(keep) if keep else (axes[0],))
 
 
 def random_rate_cell(rng: _random.Random, spec: SimSpec) -> dict:
@@ -570,6 +604,110 @@ def run_reconfig_schedule(
         "plan": plan.to_dict(),
         "workload": workload.to_dict(),
         "lifecycle": lifecycle.to_dict(),
+        "seed": seed,
+        "ticks": ticks,
+    }
+
+
+def run_elastic_schedule(
+    spec: SimSpec,
+    plan: FaultPlan,
+    seed: int,
+    ticks: int = 4 * SEGMENT,
+    segment: int = SEGMENT,
+    workload: WorkloadPlan = WorkloadPlan.none(),
+    elastic: Optional[ElasticPlan] = None,
+    churn_seed: int = 0,
+) -> dict:
+    """The elastic-capacity axis of simulation testing: one (fault
+    plan, seed) schedule run in segments with RANDOMIZED role resizes
+    at the segment boundaries — the serve control plane's ``resize``
+    verb (``elastic.set_target``) driven by a deterministic rng, so
+    traced role-count churn interleaves the crash/partition schedule
+    in-graph. Invariants (including the elastic books and workload
+    conservation) check at every boundary; before the FINAL segment
+    every role is pinned to its FLOOR (the deepest scale-down), and
+    the schedule passes only if progress strictly resumes across that
+    recovery segment — liveness-after-scale-down under
+    [faults x resize] churn.
+
+    The compiled program never changes across resizes: every segment
+    of a given length reuses ONE jitted ``_run_segment`` (the role
+    counts are traced state), which is itself the recompile-free
+    contract the ``trace-elastic-retrace`` rule pins."""
+    assert spec.elastic_ok, spec.name
+    elastic = (
+        elastic if elastic is not None
+        else ElasticPlan(roles=_ELASTIC_AXES[spec.name])
+    )
+    assert elastic.active
+    mod = spec.module
+    cfg = spec.make_config(plan, workload=workload, elastic=elastic)
+    state = mod.init_state(cfg)
+    t = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    rng = _random.Random(churn_seed * 6271 + seed)
+    violations: Dict[str, int] = {}
+    progress: List[int] = []
+    resizes = 0
+    done = 0
+    while done < ticks:
+        n = min(segment, ticks - done)
+        state, t = _run_segment(
+            mod, cfg, state, t, jnp.int32(done), n, key
+        )
+        done += n
+        inv = mod.check_invariants(cfg, state, t)
+        for k, v in inv.items():
+            if not bool(v):
+                violations.setdefault(k, done)
+        if not bool(elastic_mod.invariants_ok(elastic, state.elastic)):
+            violations.setdefault("elastic_books", done)
+        progress.append(int(spec.progress(state)))
+        remaining = ticks - done
+        if remaining > segment and rng.random() < 0.7:
+            # Churn: retarget one role anywhere in [floor, capacity].
+            name = rng.choice(elastic.names)
+            to = rng.randint(
+                elastic.floor_of(name), elastic.capacity_of(name)
+            )
+            state = dataclasses.replace(
+                state,
+                elastic=elastic_mod.set_target(
+                    elastic, state.elastic, name, to
+                ),
+            )
+            resizes += 1
+        elif 0 < remaining <= segment:
+            # The deepest scale-down before the recovery segment:
+            # every role at its floor — progress must still resume.
+            es = state.elastic
+            for name in elastic.names:
+                es = elastic_mod.set_target(
+                    elastic, es, name, elastic.floor_of(name)
+                )
+            state = dataclasses.replace(state, elastic=es)
+            resizes += 1
+    resumed = len(progress) >= 2 and progress[-1] > progress[-2]
+    return {
+        "backend": spec.name,
+        "ok": not violations and resumed,
+        "violations": violations,
+        "progress": progress,
+        "resizes": resizes,
+        "resumed": resumed,
+        # Final ACTIVE counts (drain-then-deactivate may still be
+        # draining a lane) and the pinned TARGETS (the floors).
+        "counts": elastic_mod.counts(elastic, state.elastic),
+        "targets": {
+            name: int(tgt)
+            for name, tgt in zip(
+                elastic.names, jax.device_get(state.elastic.target)
+            )
+        },
+        "plan": plan.to_dict(),
+        "workload": workload.to_dict(),
+        "elastic": elastic.to_dict(),
         "seed": seed,
         "ticks": ticks,
     }
@@ -1122,11 +1260,16 @@ def dump_reproducer(
     ticks: int,
     note: str = "",
     workload: WorkloadPlan = WorkloadPlan.none(),
+    elastic: ElasticPlan = ElasticPlan.none(),
+    churn_seed: int = 0,
 ) -> dict:
     """Write a minimized reproducer as JSON (the bad-history artifact):
     backend + seed + tick horizon + the shrunk FaultPlan (+ the
     workload shape the failure was found under; shrinking minimizes
-    the FAULT knobs — the workload rides along verbatim)."""
+    the FAULT knobs — the workload rides along verbatim). An elastic
+    schedule's artifact also records the ElasticPlan and the churn
+    seed, so the exact [faults x resize] interleaving replays through
+    :func:`run_elastic_schedule`."""
     payload = {
         "backend": spec.name,
         "seed": seed,
@@ -1136,6 +1279,9 @@ def dump_reproducer(
     }
     if workload.active:
         payload["workload_plan"] = workload.to_dict()
+    if elastic.active:
+        payload["elastic_plan"] = elastic.to_dict()
+        payload["churn_seed"] = int(churn_seed)
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
     return payload
@@ -1144,12 +1290,26 @@ def dump_reproducer(
 def load_reproducer(path: str):
     """Load a reproducer JSON: returns ``(spec, plan, seed, ticks)``
     (+ a 5th ``workload`` element when the artifact recorded an ACTIVE
-    workload shape) — feed straight back into :func:`run_schedule`."""
+    workload shape) — feed straight back into :func:`run_schedule`.
+    An elastic artifact instead returns ``(spec, plan, seed, ticks,
+    workload, elastic, churn_seed)`` for
+    :func:`run_elastic_schedule`."""
     with open(path) as f:
         payload = json.load(f)
     spec = SPECS[payload["backend"]]
     plan = FaultPlan.from_dict(payload["fault_plan"])
     base = (spec, plan, int(payload["seed"]), int(payload["ticks"]))
+    if "elastic_plan" in payload:
+        workload = (
+            WorkloadPlan.from_dict(payload["workload_plan"])
+            if "workload_plan" in payload
+            else WorkloadPlan.none()
+        )
+        return base + (
+            workload,
+            ElasticPlan.from_dict(payload["elastic_plan"]),
+            int(payload.get("churn_seed", 0)),
+        )
     if "workload_plan" in payload:
         return base + (
             WorkloadPlan.from_dict(payload["workload_plan"]),

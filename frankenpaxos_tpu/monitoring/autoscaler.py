@@ -1,0 +1,361 @@
+"""SLO-driven elastic-capacity policy engine: the graceful degradation
+LADDER over the serve loop's control plane.
+
+PR 10/15 gave the control plane exactly one response to a p99 or shed
+alarm: clamp admission (``monitoring/slo.py`` decays the offered-rate
+scale) — the fleet degrades by refusing work. The Compartmentalization
+report (arXiv 2012.15762) is a thesis about scaling each bottleneck
+ROLE independently instead; ``tpu/elastic.py`` gives the backends
+pre-allocated padded role planes behind traced membership counts, so
+growing a role is a zero-recompile state edit. This module is the
+policy that decides WHEN and WHICH:
+
+    alarm fires
+      -> scale UP the bottleneck role          (capacity first)
+      -> admission clamp ONLY once every role
+         is already at its padded capacity     (refusal last)
+    alarm clears
+      -> release the clamp FIRST               (restore admission)
+      -> shrink roles only after a sustained
+         in-SLO trough                         (drain-then-deactivate)
+
+The bottleneck pick is FEEDFORWARD, not trial-and-error: each elastic
+role maps onto an ``ops/costmodel.py`` role (``ROLE_COSTS``), and
+``costmodel.capacity(role_counts)`` names the role whose aggregate
+commands/sec ceiling is lowest — that is the one worth growing (HT-
+Paxos, arXiv 1407.1237: the batching/dissemination roles saturate
+first, so adding acceptors to a batcher-bound deployment buys
+nothing). The same ceilings rank shrink candidates in reverse: the
+trough releases the MOST over-provisioned role first.
+
+Everything here is pure host arithmetic over the per-drain SLO status
+dicts — the engine never touches the device. The serve loop applies
+its decisions through two traced-state verbs (``ServeLoop.resize`` ->
+``elastic.set_target`` and ``workload.set_rate``), so the compiled
+program never changes. Like the SLO engine, the autoscaler's FULL
+decision state round-trips through ``to_state``/``restore_state`` — a
+SIGKILLed serve run resumes with the ladder position (targets,
+cooldowns, clamp latch, trough streak) restored bit-exactly and its
+subsequent decisions replay the uninterrupted twin's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from frankenpaxos_tpu.ops import costmodel
+
+
+# Elastic role axis (tpu/elastic.py names) -> cost-model role
+# (ops/costmodel.py ROLE_COSTS names). "groups" are flagship proposer
+# groups (a leader lane each); fleet "instances" are whole replicas of
+# the leader-bound flagship program.
+DEFAULT_ROLE_MAP: Tuple[Tuple[str, str], ...] = (
+    ("proxies", "proxy_leader"),
+    ("batchers", "batcher"),
+    ("unbatchers", "unbatcher"),
+    ("replicas", "replica"),
+    ("groups", "leader"),
+    ("instances", "leader"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerPolicy:
+    """The ladder's knobs (JSON-roundtrippable so serve configs and
+    checkpoint manifests serialize it)."""
+
+    # Drains that must pass between consecutive resize ACTIONS (scale
+    # up or down) — resizing every drain would outrun the drains that
+    # measure the previous resize's effect.
+    cooldown_drains: int = 1
+    # Consecutive deeply-in-SLO drains (p99 <= trough_frac * target,
+    # no shed breach, clamp released) before the first scale-down: the
+    # diurnal-trough detector. Large enough that a burst's tail lull
+    # does not shed capacity the next burst needs.
+    trough_after: int = 6
+    trough_frac: float = 0.5
+    # Role-count step per action (padded capacities are small — the
+    # ladder climbs one instance at a time so each drain measures one
+    # increment's effect).
+    step: int = 1
+    # Elastic role -> cost-model role for the capacity feedforward
+    # (tuple-of-pairs so the policy stays hashable).
+    role_map: Tuple[Tuple[str, str], ...] = DEFAULT_ROLE_MAP
+
+    def __post_init__(self):
+        assert self.cooldown_drains >= 0
+        assert self.trough_after >= 1
+        assert 0.0 < self.trough_frac <= 1.0
+        assert self.step >= 1
+        seen = set()
+        for role, cm in self.role_map:
+            assert role not in seen, f"duplicate role_map entry {role!r}"
+            seen.add(role)
+            assert cm in costmodel.ROLE_COSTS, (
+                f"role_map target {cm!r} unknown to costmodel.ROLE_COSTS"
+            )
+
+    def to_dict(self) -> dict:
+        return {
+            "cooldown_drains": self.cooldown_drains,
+            "trough_after": self.trough_after,
+            "trough_frac": self.trough_frac,
+            "step": self.step,
+            "role_map": [list(p) for p in self.role_map],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutoscalerPolicy":
+        d = dict(d)
+        if "role_map" in d:
+            d["role_map"] = tuple(tuple(p) for p in d["role_map"])
+        return cls(**d)
+
+
+class Autoscaler:
+    """Feed one :meth:`decide` per drain (the SLO engine's status dict
+    in); read the resize actions + the effective admission scale out.
+
+    The autoscaler is the serve loop's single writer of elastic
+    targets, so it tracks them HOST-side (``self.targets``) — reading
+    them back off the device would sync the hot path against the
+    in-flight chunk, exactly what the double-buffered drain exists to
+    avoid. ``roles`` fixes each role's (capacity, floor) from the
+    ElasticPlan; ``initial`` seeds the targets (defaults to capacity,
+    matching ``elastic.make_state``)."""
+
+    def __init__(
+        self,
+        policy: AutoscalerPolicy,
+        roles: Dict[str, Tuple[int, int]],  # role -> (capacity, floor)
+        initial: Optional[Dict[str, int]] = None,
+    ):
+        assert roles, "an autoscaler needs at least one elastic role"
+        self.policy = policy
+        rm = dict(policy.role_map)
+        for r, (cap, floor) in roles.items():
+            assert r in rm, f"no role_map entry for elastic role {r!r}"
+            assert 1 <= floor <= cap, (r, cap, floor)
+        self.roles = {r: (int(c), int(f)) for r, (c, f) in roles.items()}
+        self.targets: Dict[str, int] = {
+            r: int((initial or {}).get(r, cap))
+            for r, (cap, _) in self.roles.items()
+        }
+        for r, n in self.targets.items():
+            cap, floor = self.roles[r]
+            assert floor <= n <= cap, (r, n)
+        self.clamp_engaged = False
+        self.drains = 0
+        self.scale_up_events = 0
+        self.scale_down_events = 0
+        self.clamp_engagements = 0
+        self.clamp_releases = 0
+        self.events: List[dict] = []  # the ordered ladder record
+        self._last_action_drain = -(10**9)
+        self._trough_streak = 0
+        self._events_restored = 0
+
+    # -- the capacity feedforward -------------------------------------------
+
+    def _ceilings(self) -> Dict[str, float]:
+        """Per-ELASTIC-role aggregate commands/sec ceilings at the
+        current targets (count x the mapped cost-model role's
+        per-instance roofline rate)."""
+        rm = dict(self.policy.role_map)
+        return {
+            r: n * costmodel.role_rate(rm[r])
+            for r, n in self.targets.items()
+        }
+
+    def feedforward(self) -> dict:
+        """The ``costmodel.capacity`` report at the current targets —
+        the observability blob attached to every capacity event (the
+        bottleneck pick is derived from the same ceilings)."""
+        rm = dict(self.policy.role_map)
+        counts: Dict[str, int] = {}
+        for r, n in self.targets.items():
+            # Two elastic roles never share a cost-model role within
+            # one backend, but be safe: capacity() keys by cost-model
+            # role, so a collision sums the counts.
+            counts[rm[r]] = counts.get(rm[r], 0) + n
+        return costmodel.capacity(counts)
+
+    def _pick_grow(self) -> Optional[str]:
+        """The bottleneck role that still has padded headroom (lowest
+        ceiling wins — growing anything else moves no bottleneck)."""
+        ceil = self._ceilings()
+        grow = [
+            r for r, n in self.targets.items() if n < self.roles[r][0]
+        ]
+        if not grow:
+            return None
+        return min(grow, key=lambda r: (ceil[r], r))
+
+    def _pick_shrink(self) -> Optional[str]:
+        """The most over-provisioned role above its floor (highest
+        ceiling releases first)."""
+        ceil = self._ceilings()
+        shrink = [
+            r for r, n in self.targets.items() if n > self.roles[r][1]
+        ]
+        if not shrink:
+            return None
+        return max(shrink, key=lambda r: (ceil[r], r))
+
+    # -- the per-drain ladder step ------------------------------------------
+
+    def _event(self, kind: str, **meta) -> dict:
+        ev = {"event": self._events_restored + len(self.events),
+              "drain": self.drains, "kind": kind, **meta}
+        self.events.append(ev)
+        return ev
+
+    def decide(self, status: dict) -> dict:
+        """One SLO status dict in (``SloEngine.observe``'s return);
+        the ladder's decision out:
+
+        * ``actions`` — resize verbs to apply, as
+          ``{"role", "from", "to"}`` dicts (empty most drains);
+        * ``clamp_engaged`` — whether the admission clamp may bind
+          this drain (False while padded capacity remains);
+        * ``effective_scale`` — what the loop multiplies into the base
+          rate: the SLO engine's decayed scale when the clamp is
+          engaged, 1.0 otherwise (the scale keeps decaying inside the
+          SLO engine either way, so an engage applies the full decay
+          accumulated while scale-ups were being tried first).
+        """
+        self.drains += 1
+        pol = self.policy
+        actions: List[dict] = []
+        cooled = (
+            self.drains - self._last_action_drain > pol.cooldown_drains
+        )
+
+        if status["alarm"]:
+            # Rung 1: the alarm is latched — try capacity first.
+            self._trough_streak = 0
+            role = self._pick_grow() if cooled else None
+            if role is not None:
+                cap, _ = self.roles[role]
+                frm = self.targets[role]
+                to = min(cap, frm + pol.step)
+                self.targets[role] = to
+                self._last_action_drain = self.drains
+                self.scale_up_events += 1
+                actions.append({"role": role, "from": frm, "to": to})
+                self._event(
+                    "scale_up", role=role, frm=frm, to=to,
+                    p99=status["p99"], feedforward=self.feedforward(),
+                )
+            elif self._pick_grow() is None and not self.clamp_engaged:
+                # Rung 2: every role is at padded capacity — only now
+                # may the admission clamp bind (the last resort).
+                self.clamp_engaged = True
+                self.clamp_engagements += 1
+                self._event(
+                    "clamp_engage", p99=status["p99"],
+                    scale=status["scale"],
+                )
+        else:
+            if self.clamp_engaged:
+                # Recovery rung 1: release the clamp BEFORE shrinking
+                # anything — admission is restored first, capacity is
+                # given back only after the trough proves itself.
+                self.clamp_engaged = False
+                self.clamp_releases += 1
+                self._trough_streak = 0
+                self._event("clamp_release", p99=status["p99"])
+            else:
+                deep = (
+                    status["p99"] < 0
+                    or status["p99"]
+                    <= pol.trough_frac * status["p99_target"]
+                ) and not status["shed_breach"]
+                self._trough_streak = (
+                    self._trough_streak + 1 if deep else 0
+                )
+                if self._trough_streak >= pol.trough_after and cooled:
+                    role = self._pick_shrink()
+                    if role is not None:
+                        _, floor = self.roles[role]
+                        frm = self.targets[role]
+                        to = max(floor, frm - pol.step)
+                        self.targets[role] = to
+                        self._last_action_drain = self.drains
+                        self.scale_down_events += 1
+                        actions.append(
+                            {"role": role, "from": frm, "to": to}
+                        )
+                        self._event(
+                            "scale_down", role=role, frm=frm, to=to,
+                            p99=status["p99"],
+                            feedforward=self.feedforward(),
+                        )
+
+        return {
+            "actions": actions,
+            "clamp_engaged": self.clamp_engaged,
+            "effective_scale": (
+                float(status["scale"]) if self.clamp_engaged else 1.0
+            ),
+            "targets": dict(self.targets),
+        }
+
+    # -- reporting / checkpoint-restore -------------------------------------
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.policy.to_dict(),
+            "roles": {
+                r: {
+                    "target": self.targets[r],
+                    "capacity": self.roles[r][0],
+                    "floor": self.roles[r][1],
+                }
+                for r in sorted(self.roles)
+            },
+            "clamp_engaged": self.clamp_engaged,
+            "scale_up_events": self.scale_up_events,
+            "scale_down_events": self.scale_down_events,
+            "clamp_engagements": self.clamp_engagements,
+            "clamp_releases": self.clamp_releases,
+            "events": list(self.events),
+            "feedforward": self.feedforward(),
+        }
+
+    def to_state(self) -> dict:
+        """The FULL decision state (the bit-exact-resume contract the
+        SLO engine set: a resumed run's ladder decisions replay the
+        uninterrupted twin's)."""
+        return {
+            "targets": dict(self.targets),
+            "clamp_engaged": bool(self.clamp_engaged),
+            "drains": int(self.drains),
+            "scale_up_events": int(self.scale_up_events),
+            "scale_down_events": int(self.scale_down_events),
+            "clamp_engagements": int(self.clamp_engagements),
+            "clamp_releases": int(self.clamp_releases),
+            "last_action_drain": int(self._last_action_drain),
+            "trough_streak": int(self._trough_streak),
+            "events": self._events_restored + len(self.events),
+        }
+
+    def restore_state(self, s: dict) -> None:
+        assert set(s["targets"]) == set(self.targets), (
+            "restored autoscaler targets name different roles"
+        )
+        self.targets = {r: int(n) for r, n in s["targets"].items()}
+        self.clamp_engaged = bool(s["clamp_engaged"])
+        self.drains = int(s["drains"])
+        self.scale_up_events = int(s["scale_up_events"])
+        self.scale_down_events = int(s["scale_down_events"])
+        self.clamp_engagements = int(s["clamp_engagements"])
+        self.clamp_releases = int(s["clamp_releases"])
+        self._last_action_drain = int(s["last_action_drain"])
+        self._trough_streak = int(s["trough_streak"])
+        # events is reporting, not decision state (the SLO history
+        # convention): a resumed process logs fresh but keeps the count.
+        self.events = []
+        self._events_restored = int(s.get("events", 0))

@@ -154,6 +154,17 @@ def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
     ax = axes[0]
     for name in ("commits", "executes", "proposals"):
         ax.plot(ticks, series.get(name, []), label=name)
+    # Capacity events (tpu/elastic.py applied resizes) as vertical
+    # marks on the rate panel — the dashboard's view of the fleet
+    # breathing with load.
+    resizes = series.get("resizes", [])
+    marked = False
+    for tk, n in zip(ticks, resizes):
+        if n:
+            ax.axvline(tk, color="tab:purple", linestyle="--",
+                       linewidth=0.8, alpha=0.7,
+                       label=None if marked else "resize")
+            marked = True
     ax.set_title(
         f"device commit rate per tick (last {len(ticks)} of "
         f"{capture.get('ticks', '?')} ticks)",
@@ -165,7 +176,7 @@ def render_telemetry_dashboard(capture: dict, output: str) -> Optional[str]:
 
     ax = axes[1]
     for name in ("phase1_msgs", "phase2_msgs", "retries", "drops",
-                 "leader_changes"):
+                 "leader_changes", "resizes"):
         vals = series.get(name, [])
         if any(vals):
             ax.plot(ticks, vals, label=name)

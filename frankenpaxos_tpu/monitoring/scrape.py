@@ -157,6 +157,42 @@ def append_host_spans(
     return n
 
 
+def append_capacity_events(
+    csv_path: str,
+    events: List[dict],
+    job: str = "autoscaler",
+    instance: str = "serve",
+) -> int:
+    """Append elastic-capacity ladder events (``monitoring/autoscaler
+    .py`` ``Autoscaler.events``: scale_up / scale_down / clamp_engage /
+    clamp_release dicts) as ``fpx_capacity_event`` samples — one row
+    per event, value 1, labels carrying the rung, role, and the
+    from/to counts, so a capture queries the ladder's history the same
+    way it queries any other counter."""
+    import os
+
+    new_file = not os.path.exists(csv_path)
+    n = 0
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.writer(f)
+        if new_file:
+            writer.writerow(
+                ["ts", "job", "instance", "name", "labels", "value"]
+            )
+        for ev in events:
+            labels = f"kind={ev['kind']}"
+            if "role" in ev:
+                labels += (
+                    f";role={ev['role']};from={ev['frm']};to={ev['to']}"
+                )
+            writer.writerow(
+                [time.time(), job, instance, "fpx_capacity_event",
+                 labels, 1]
+            )
+            n += 1
+    return n
+
+
 # Efficiency gauges: measured-vs-model commit throughput, the serve
 # loop's MFU analog. One row each per drain, labels carrying the
 # parameter-set name so a capture replays against the exact model

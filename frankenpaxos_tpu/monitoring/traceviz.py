@@ -176,9 +176,11 @@ def fleet_marker_events(
 ) -> List[dict]:
     """Instant events for the fleet control plane's per-instance
     marks (``FleetServeLoop.markers``: dicts with ``instance``,
-    ``tick``, ``kind`` in {alarm, clamp, clear} + extras). Each lands
-    on its instance's track group, thread-scoped, at the tick's
-    interpolated wall clock."""
+    ``tick``, ``kind`` in {alarm, clamp, clear, scale_up, scale_down}
+    + extras). Each lands on its instance's track group, thread-
+    scoped, at the tick's interpolated wall clock; FLEET-WIDE marks
+    (``instance`` < 0 — the elastic set_active_instances capacity
+    events) land on the host control track instead."""
     clock = clock or TickClock()
     events: List[dict] = []
     for m in markers:
@@ -187,13 +189,16 @@ def fleet_marker_events(
             for k, v in m.items()
             if k not in ("instance", "tick", "kind")
         }
+        instance = int(m["instance"])
         events.append(
             {
                 "name": str(m["kind"]),
                 "cat": "fleet-control",
                 "ph": "i",
                 "s": "t",
-                "pid": FLEET_PID0 + int(m["instance"]),
+                "pid": (
+                    FLEET_PID0 + instance if instance >= 0 else HOST_PID
+                ),
                 "tid": 0,
                 "ts": clock.to_us(int(m["tick"])),
                 "args": args,

@@ -974,6 +974,11 @@ ROLE_COSTS: Dict[str, Tuple[int, int]] = {
     "proxy_leader": (256, 120),
     "acceptor": (128, 60),
     "replica": (192, 100),
+    # Unbatchers split replica result batches back into per-client
+    # replies — pure dissemination, the cheapest role on the path
+    # (HT-Paxos arxiv 1407.1237 puts the batch/unbatch pair on
+    # opposite ends of the amortization).
+    "unbatcher": (48, 16),
 }
 
 

@@ -855,7 +855,10 @@ register_sharding(
             # [G, S] session table — shard over the group axis per
             # _NESTED_LANE_FIELDS (production session cardinality
             # cannot replicate per device).
-            "telemetry", "workload", "lifecycle",
+            # The elastic membership counts ([R] role-count scalars,
+            # tpu/elastic.py) are control-plane state every device
+            # reads — replicated, like the lifecycle masks.
+            "telemetry", "workload", "lifecycle", "elastic",
         }),
         axis_pos={
             name: 1
@@ -914,6 +917,8 @@ register_sharding(
             "writes_done", "lat_sum", "lat_hist", "reads_done",
             "reads_shed", "read_lat_sum", "read_lat_hist", "telemetry",
             "workload", "lifecycle",
+            # Elastic role-count state replicates (see multipaxos).
+            "elastic",
         }),
         axis_pos={
             **{name: 2 for name in ("p2a_arrival", "p2b_arrival")},

@@ -84,11 +84,13 @@ from frankenpaxos_tpu.tpu.common import (
 # frankenpaxos_tpu.ops would be circular during tpu package init).
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.tpu import elastic as elastic_mod
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
+from frankenpaxos_tpu.tpu.elastic import ElasticPlan, ElasticState
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan, LifecycleState
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
@@ -159,6 +161,18 @@ class BatchedCompartmentalizedConfig:
     # handoff — the full-grid retry timers re-form quorums on the new
     # membership). LifecyclePlan.none() is a structural no-op.
     lifecycle: LifecyclePlan = LifecyclePlan.none()
+    # Elastic capacity (tpu/elastic.py): the paper's thesis made live —
+    # each bottleneck role resizes INDEPENDENTLY behind traced
+    # active-count scalars. Declarable roles: "proxies" / "unbatchers"
+    # (slot-ownership moduli become `slot % min(active, target)` —
+    # handoff is immediate, ownership is recomputed per tick, exactly
+    # like a rotation rebase), "batchers" (the admission split narrows
+    # to the live columns; a deactivating batcher's in-flight batch
+    # lands first and residual partial fill migrates to batcher 0 at
+    # the switch), and "replicas" (READ-serving capacity only — every
+    # replica keeps executing writes, so re-activation needs no state
+    # catch-up). ElasticPlan.none() is a structural no-op.
+    elastic: ElasticPlan = ElasticPlan.none()
     # Bit-packed storage for the narrow hot planes (tpu/packing.py,
     # common.PACKED_PLANES): the [G, W] batch-ring status plane packs
     # 16 2-bit codes per int32 word and the [G, S] session table packs
@@ -206,6 +220,22 @@ class BatchedCompartmentalizedConfig:
         self.faults.validate(axis=self.acceptors_per_group)
         self.workload.validate(reads_supported=self.read_rate > 0)
         self.lifecycle.validate(align=self.rotation_alignment)
+        self.elastic.validate(
+            {
+                "proxies": self.num_proxy_leaders,
+                "batchers": self.num_batchers,
+                "unbatchers": self.num_unbatchers,
+                "replicas": self.num_replicas,
+            }
+        )
+        if self.elastic.active:
+            # The batcher admission split (and the SLO signals that
+            # drive resizes) live on the workload engine's cap.
+            assert self.workload.active, (
+                "compartmentalized elastic roles need an active "
+                "workload plan (the admission split is the resize "
+                "surface)"
+            )
         if self.workload.closed:
             assert self.workload.closed_window >= self.batch_size, (
                 "compartmentalized closed loop needs closed_window >= "
@@ -274,6 +304,9 @@ class BatchedCompartmentalizedState:
     # the [G, S] session table, the traced [R, C, G] grid membership
     # mask + epoch; all-empty under LifecyclePlan.none()).
     lifecycle: LifecycleState
+    # Elastic-capacity state (tpu/elastic.py: traced active/target
+    # role counts + resize books; all-empty under ElasticPlan.none()).
+    elastic: ElasticState
 
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
@@ -336,6 +369,7 @@ def init_state(
             cfg.lifecycle, G, acceptor_shape=(R, C, G),
             packed=cfg.pack_planes,
         ),
+        elastic=elastic_mod.make_state(cfg.elastic),
         telemetry=make_telemetry(),
     )
 
@@ -434,6 +468,50 @@ def tick(
             fp, faults_mod.fault_key(key, 2), proxy_alive, rates=frates
         )
 
+    # 1.5 Elastic capacity (tpu/elastic.py): apply any pending role
+    # resize, then route this tick's work over the live instances.
+    # Scale-up is a mask flip; scale-down waits for the deactivating
+    # tail to drain (batchers: no in-flight batch; read replicas: no
+    # in-flight read batch; proxies/unbatchers hand off immediately —
+    # slot ownership is recomputed per tick, like a rotation rebase).
+    ela = cfg.elastic
+    els = state.elastic
+    n_resized = 0
+    bat_fill = state.bat_fill
+    if ela.active:
+        drained = {}
+        if ela.declares("batchers"):
+            b_cols = jnp.arange(B, dtype=jnp.int32)[None, :]
+            b_tgt = elastic_mod.target_count(ela, els, "batchers", B)
+            drained["batchers"] = jnp.all(
+                jnp.where(
+                    b_cols >= b_tgt, state.bat_arrival == INF16, True
+                )
+            )
+        if ela.declares("replicas") and RW:
+            nr_col = jnp.arange(NR, dtype=jnp.int32)[:, None, None]
+            nr_tgt = elastic_mod.target_count(ela, els, "replicas", NR)
+            drained["replicas"] = jnp.all(
+                jnp.where(nr_col >= nr_tgt, state.rd_issue >= INF, True)
+            )
+        old_b = elastic_mod.count(ela, els, "batchers", B)
+        els, n_resized = elastic_mod.apply(ela, els, drained)
+        if ela.declares("batchers"):
+            # Residual partial fill of batchers freed THIS tick
+            # migrates to batcher 0: the commands were already admitted
+            # (client-counted), so conservation needs them to batch.
+            new_b = elastic_mod.count(ela, els, "batchers", B)
+            b_cols = jnp.arange(B, dtype=jnp.int32)[None, :]
+            freed = (b_cols >= new_b) & (b_cols < old_b)
+            mig = jnp.where(freed, bat_fill, 0)
+            bat_fill = (bat_fill - mig).at[:, 0].add(
+                jnp.sum(mig, axis=1)
+            )
+    # Slot-ownership moduli for this tick (static P/U when the role is
+    # not elastic — the exact pre-elastic program).
+    p_mod = elastic_mod.routing_count(ela, els, "proxies", P)
+    u_mod = elastic_mod.routing_count(ela, els, "unbatchers", U)
+
     # 2. Batchers: admit client commands (shed past 2*batch_size — the
     # batcher's own backpressure), receive fired batches at the leader,
     # and ship full batches (one message each) when idle and the leader
@@ -441,20 +519,30 @@ def tick(
     cap = 2 * BS
     if wl.active:
         # Workload admission (tpu/workload.py): the engine's per-group
-        # cap splits across the group's B batchers, bounded by batcher
-        # headroom; residual demand stays in the engine's FIFO backlog
-        # (the engine sheds at its own bound, so bat_shed stays 0).
+        # cap splits across the group's live batchers, bounded by
+        # batcher headroom; residual demand stays in the engine's FIFO
+        # backlog (the engine sheds at its own bound, so bat_shed
+        # stays 0).
         wl_writes, wl_reads, wls = workload_mod.begin(wl, wls, key, t, G)
         adm = workload_mod.admission(wl, wls, wl_writes)  # [G]
         b_iota = jnp.arange(B, dtype=jnp.int32)[None, :]
-        want_b = (adm // B)[:, None] + (b_iota < (adm % B)[:, None])
-        take_b = jnp.minimum(want_b, cap - state.bat_fill)
-        fill = state.bat_fill + take_b
+        if ela.declares("batchers"):
+            b_act = elastic_mod.routing_count(ela, els, "batchers", B)
+            want_b = jnp.where(
+                b_iota < b_act,
+                (adm // b_act)[:, None]
+                + (b_iota < (adm % b_act)[:, None]),
+                0,
+            )
+        else:
+            want_b = (adm // B)[:, None] + (b_iota < (adm % B)[:, None])
+        take_b = jnp.minimum(want_b, cap - bat_fill)
+        fill = bat_fill + take_b
         adm_g = jnp.sum(take_b, axis=1)  # [G] actual entries admitted
         admitted = jnp.sum(adm_g)
         bat_shed = state.bat_shed
     else:
-        fill = state.bat_fill + cfg.arrivals_per_tick
+        fill = bat_fill + cfg.arrivals_per_tick
         shed = jnp.maximum(fill - cap, 0)
         fill = fill - shed
         admitted = G * B * cfg.arrivals_per_tick - jnp.sum(shed)
@@ -518,7 +606,7 @@ def tick(
     # ran it after — the write masks are disjoint (retries touch only
     # slots that stay PROPOSED), so the composition is bit-identical.
     s_of_pos = state.head[:, None] + (w_iota[None, :] - state.head[:, None]) % W
-    p_of_pos = s_of_pos % P  # [G, W] proxy owning each ring position
+    p_of_pos = s_of_pos % p_mod  # [G, W] proxy owning each ring position
     alive_of_pos = jnp.take_along_axis(proxy_alive, p_of_pos, axis=1)
     (
         p2a_arrival,
@@ -586,7 +674,7 @@ def tick(
     )
     # Unbatcher load accounting (one-hot over U: stays group-local
     # under the mesh, unlike a flattened scatter-add).
-    u_of_pos = s_of_pos % U
+    u_of_pos = s_of_pos % u_mod
     unbat_msgs = state.unbat_msgs + jnp.sum(
         replied_now[:, :, None]
         & (u_of_pos[:, :, None] == jnp.arange(U, dtype=jnp.int32)),
@@ -630,7 +718,7 @@ def tick(
     # Recompute slot->proxy for the NEW occupancy (positions beyond the
     # old next_slot now hold fresh slots).
     s_of_pos = head[:, None] + (w_iota[None, :] - head[:, None]) % W
-    p_of_pos = s_of_pos % P
+    p_of_pos = s_of_pos % p_mod
     alive_of_pos = jnp.take_along_axis(proxy_alive, p_of_pos, axis=1)
     in_quorum = (
         jnp.arange(C, dtype=jnp.int32)[None, :, None, None]
@@ -735,16 +823,36 @@ def tick(
         any_free = jnp.any(free, axis=2)
         if wl.has_reads:
             # Workload read mix: the group's read arrivals split across
-            # its NR read batchers; empty shares form no batch.
+            # its LIVE read batchers (replicas keep executing writes
+            # when elastically deactivated — only read serving
+            # narrows); empty shares form no batch.
             nr_iota = jnp.arange(NR, dtype=jnp.int32)[:, None]
-            rcount = (wl_reads // NR)[None, :] + (
-                nr_iota < (wl_reads % NR)[None, :]
-            )  # [NR, G]
+            if ela.declares("replicas"):
+                nr_act = elastic_mod.routing_count(
+                    ela, els, "replicas", NR
+                )
+                rcount = jnp.where(
+                    nr_iota < nr_act,
+                    (wl_reads // nr_act)[None, :]
+                    + (nr_iota < (wl_reads % nr_act)[None, :]),
+                    0,
+                )  # [NR, G]
+            else:
+                rcount = (wl_reads // NR)[None, :] + (
+                    nr_iota < (wl_reads % NR)[None, :]
+                )  # [NR, G]
             form = form & (rcount[:, :, None] > 0)
             reads_shed = reads_shed + jnp.sum(
                 jnp.where(~any_free, rcount, 0)
             )
         else:
+            if ela.declares("replicas"):
+                # Static read batches form on live replicas only.
+                nr_iota = jnp.arange(NR, dtype=jnp.int32)[:, None]
+                nr_act = elastic_mod.routing_count(
+                    ela, els, "replicas", NR
+                )
+                form = form & (nr_iota[:, :, None] < nr_act)
             reads_shed = reads_shed + cfg.read_rate * jnp.sum(~any_free)
         # The bound: this group's chosen-prefix watermark (every slot
         # below it is chosen) — what the read-quorum row reports.
@@ -818,6 +926,7 @@ def tick(
             if lc_shift is not None
             else 0
         ),
+        resizes=n_resized,
         queue_depth=jnp.sum(next_slot - head) + jnp.sum(pending),
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
@@ -935,6 +1044,7 @@ def tick(
         read_lat_hist=read_lat_hist,
         workload=wls,
         lifecycle=lcs,
+        elastic=els,
         telemetry=tel,
     )
 
@@ -1010,6 +1120,11 @@ def check_invariants(
                 else None
             ),
         ),
+        # Elastic books: active/target counts inside [floor, capacity],
+        # resize generation and event counters monotone.
+        "elastic_ok": elastic_mod.invariants_ok(
+            cfg.elastic, state.elastic
+        ),
     }
     if cfg.read_window:
         occupied = state.rd_issue < INF
@@ -1072,6 +1187,7 @@ def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
     workload: WorkloadPlan = WorkloadPlan.none(),
     lifecycle: LifecyclePlan = LifecyclePlan.none(),
+    elastic: ElasticPlan = ElasticPlan.none(),
 ) -> BatchedCompartmentalizedConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -1080,10 +1196,14 @@ def analysis_config(
     exercise every role plane — batchers, proxies, the 2x2 acceptor
     grid, replicas, unbatchers, and the read path — small enough to
     trace and compile in well under a second."""
+    if elastic.active and not workload.active:
+        # Elastic roles resize the admission split: an elastic
+        # analysis config needs an active workload plan.
+        workload = WorkloadPlan(arrival="constant", rate=2.0)
     return BatchedCompartmentalizedConfig(
         num_groups=4, grid_rows=2, grid_cols=2, num_proxy_leaders=4,
         num_batchers=2, num_unbatchers=2, num_replicas=3, window=16,
         batch_size=2, arrivals_per_tick=1, retry_timeout=8,
         read_rate=2, read_window=6, faults=faults, workload=workload,
-        lifecycle=lifecycle,
+        lifecycle=lifecycle, elastic=elastic,
     )

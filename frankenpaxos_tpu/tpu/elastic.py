@@ -1,0 +1,386 @@
+"""Elastic capacity: live resize of pre-allocated role planes.
+
+Compartmentalized MultiPaxos (PAPERS: arxiv 2012.15762) is a thesis
+about scaling each bottleneck role INDEPENDENTLY — more proxy leaders
+when the broadcast fan-out saturates, more batchers when admission
+does. Until now the repo's control plane could only react to duress by
+clamping admission (``monitoring/slo.py``): the fleet degraded by
+refusing work. This module gives it the other lever: role planes are
+allocated at a PADDED static capacity and gated behind traced
+active-count scalars, so the SLO engine grows or shrinks the live
+role count between serve chunks with ZERO recompiles — the same
+plan-static/state-traced split the fault, workload, and lifecycle
+engines already prove (``tpu/faults.py``, ``tpu/workload.py``,
+``tpu/lifecycle.py`` — the PR 11 membership masks are the direct
+ancestor of the masks here).
+
+Design contract (the subsystem trio's, verbatim):
+
+  * :class:`ElasticPlan` is FROZEN + hashable and lives inside the
+    static backend config: it fixes the STRUCTURE — which roles are
+    elastic, their padded capacities (== the static axis sizes) and
+    floors. Changing the plan recompiles; nothing else does.
+  * :class:`ElasticState` carries the traced knobs: per-role ``active``
+    and ``target`` counts, a resize generation, and cumulative
+    scale-up/scale-down event counters. Host verbs set ``target``;
+    the tick applies it via :func:`apply`.
+  * ``ElasticPlan.none()`` is the STRUCTURAL no-op: every state leaf
+    is zero-sized, every helper returns the caller's static default
+    (a Python int), and the compiled program is bit-identical to the
+    pre-elastic one (the ``elastic-noop`` analysis rule pins this).
+
+Resize semantics (the drain-then-deactivate ladder):
+
+  * SCALE-UP is immediate: ``apply`` raises ``active`` to ``target``
+    the tick after the verb lands — the padded plane is already
+    allocated, activation is a mask flip.
+  * SCALE-DOWN is two-phase. The moment ``target`` drops below
+    ``active``, ROUTING of new work switches to the first
+    ``min(active, target)`` instances (:func:`routing_count`), so the
+    deactivating tail stops receiving; ``active`` itself only drops
+    once the backend's per-role drain predicate reports the tail idle
+    (:func:`apply`'s ``drained`` argument). No in-flight work is lost:
+    the exactly-once session books and ``workload_ok`` conservation
+    reconcile across every resize, and a SIGKILL between the verb and
+    the switch resumes mid-drain bit-exactly (the counts are ordinary
+    checkpointed state leaves).
+
+Role semantics are the BACKEND's: the flagship declares ``groups``
+(arrivals re-route over the first N proposer lanes via
+:func:`route_lanes`'s traced modulus); compartmentalized declares
+``proxies``/``unbatchers`` (slot-ownership moduli — handoff is
+immediate, ownership is recomputed per tick), ``batchers`` (admission
+split; residual partial fill migrates to batcher 0 at the switch), and
+``replicas`` (READ-serving capacity only — every replica keeps
+executing writes, so reactivation needs no catch-up transfer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "ElasticPlan",
+    "ElasticState",
+    "make_state",
+    "apply",
+    "set_target",
+    "count",
+    "target_count",
+    "routing_count",
+    "route_lanes",
+    "counts",
+    "invariants_ok",
+    "summary",
+]
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """Which role planes resize, and between what bounds. Frozen +
+    hashable: lives inside the static backend config (a ``jax.jit``
+    static argument). Each entry is ``(role, capacity, floor)`` —
+    ``capacity`` is the PADDED static axis size the backend allocates
+    (validated to match), ``floor`` the minimum active count the
+    control plane may shrink to."""
+
+    roles: Tuple[Tuple[str, int, int], ...] = ()
+
+    # -- structural predicates (trace-time Python values) ----------------
+
+    @property
+    def active(self) -> bool:
+        """Any role declared (the tick helpers run iff this holds)."""
+        return len(self.roles) > 0
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _, _ in self.roles)
+
+    def declares(self, name: str) -> bool:
+        return any(n == name for n, _, _ in self.roles)
+
+    def index(self, name: str) -> int:
+        for i, (n, _, _) in enumerate(self.roles):
+            if n == name:
+                return i
+        raise KeyError(f"role {name!r} not in elastic plan {self.names}")
+
+    def capacity_of(self, name: str) -> int:
+        return self.roles[self.index(name)][1]
+
+    def floor_of(self, name: str) -> int:
+        return self.roles[self.index(name)][2]
+
+    @classmethod
+    def none(cls) -> "ElasticPlan":
+        """The structural no-op plan: zero-sized state leaves, every
+        helper returns its static default, and XLA emits the exact
+        pre-elastic program."""
+        return cls()
+
+    def validate(self, capacities: Dict[str, int]) -> None:
+        """Config-time validation; the backend passes the static axis
+        size of every role it SUPPORTS — a plan naming an unknown role
+        or mismatching the allocated capacity is a config bug."""
+        seen = set()
+        for name, cap, floor in self.roles:
+            assert name not in seen, f"duplicate elastic role {name!r}"
+            seen.add(name)
+            assert name in capacities, (
+                f"elastic role {name!r} not supported by this backend "
+                f"(supported: {sorted(capacities)})"
+            )
+            assert cap == capacities[name], (
+                f"elastic role {name!r}: plan capacity {cap} != the "
+                f"backend's allocated axis {capacities[name]} — the "
+                "padded plane IS the static axis"
+            )
+            assert 1 <= floor <= cap, (
+                f"elastic role {name!r}: need 1 <= floor <= capacity, "
+                f"got floor={floor} capacity={cap}"
+            )
+
+    # -- serialization (autoscaler context / reproducers) ----------------
+
+    def to_dict(self) -> dict:
+        return {"roles": [list(r) for r in self.roles]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ElasticPlan":
+        return cls(
+            roles=tuple(tuple(r) for r in d.get("roles", ()))
+        )
+
+
+# ---------------------------------------------------------------------------
+# State
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ElasticState:
+    """Device-resident resize state, carried in the backend's
+    ``*State`` dataclass. Every leaf is ZERO-SIZED under
+    ``ElasticPlan.none()`` — the none state is structurally empty and
+    keeps the scan carry bit-identical to the pre-elastic program.
+    All leaves int32 (the dtype policy's accumulator width), so
+    ``widen_state`` passes them through untouched."""
+
+    active: jnp.ndarray  # [R] int32 live instance count per role
+    target: jnp.ndarray  # [R] int32 verb-set desired count
+    gen: jnp.ndarray  # [] int32 applied-resize generation | [0]
+    scale_ups: jnp.ndarray  # [] int32 cumulative role grow events | [0]
+    scale_downs: jnp.ndarray  # [] int32 cumulative shrink events | [0]
+
+
+def make_state(
+    plan: ElasticPlan, initial: Optional[Dict[str, int]] = None
+) -> ElasticState:
+    """The per-role count state. Roles start at their padded CAPACITY
+    (a resize-free run is bit-identical in OUTPUT to the static
+    program — the 3-seed identity tests pin that) unless ``initial``
+    names a smaller starting count."""
+    R = len(plan.roles)
+    scalar = () if plan.active else (0,)
+    start = [
+        (initial or {}).get(name, cap) for name, cap, _ in plan.roles
+    ]
+    for (name, cap, floor), s in zip(plan.roles, start):
+        assert floor <= s <= cap, (
+            f"elastic role {name!r}: initial count {s} outside "
+            f"[{floor}, {cap}]"
+        )
+    # Distinct buffers for active/target (donated carries must never
+    # alias two leaves to one buffer).
+    return ElasticState(
+        active=jnp.asarray(start, jnp.int32).reshape(R),
+        target=jnp.asarray(list(start), jnp.int32).reshape(R),
+        gen=jnp.zeros(scalar, jnp.int32),
+        scale_ups=jnp.zeros(scalar, jnp.int32),
+        scale_downs=jnp.zeros(scalar, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tick-side helpers. Call order inside a backend's tick:
+#     es, n_resized = apply(plan, es, {role: drained_bool, ...})
+#     n_act = routing_count(plan, es, "proxies", P)   # traced | int P
+#     ... route new work by `% n_act` / `iota < n_act` masks ...
+# and `n_resized` feeds telemetry.record(resizes=...).
+# ---------------------------------------------------------------------------
+
+
+def count(
+    plan: ElasticPlan, es: ElasticState, name: str, default: int
+) -> "jnp.ndarray | int":
+    """The role's live instance count: a traced [] int32 when the plan
+    declares the role, the static Python ``default`` otherwise — so an
+    undeclared role compiles to the exact pre-elastic program."""
+    if not plan.declares(name):
+        return default
+    return es.active[plan.index(name)]
+
+
+def target_count(
+    plan: ElasticPlan, es: ElasticState, name: str, default: int
+) -> "jnp.ndarray | int":
+    """The role's verb-set target count (static default when the role
+    is undeclared)."""
+    if not plan.declares(name):
+        return default
+    return es.target[plan.index(name)]
+
+
+def routing_count(
+    plan: ElasticPlan, es: ElasticState, name: str, default: int
+) -> "jnp.ndarray | int":
+    """The count NEW work routes over: ``min(active, target)``. During
+    a drain (target < active) the deactivating tail stops receiving
+    immediately while ``active`` holds until the tail is idle — the
+    first half of drain-then-deactivate."""
+    if not plan.declares(name):
+        return default
+    i = plan.index(name)
+    return jnp.minimum(es.active[i], es.target[i])
+
+
+def route_lanes(per_lane: jnp.ndarray, n_act) -> jnp.ndarray:
+    """Re-route a per-lane count vector onto the first ``n_act``
+    lanes: lane ``i``'s entries land on lane ``i % n_act`` (identity
+    for live lanes). Conservation is exact — the sum is untouched, so
+    workload offered/admitted books reconcile across resizes. Cheap:
+    one [L] traced modulus + one segment-sum."""
+    L = per_lane.shape[0]
+    iota = jnp.arange(L, dtype=jnp.int32)
+    dst = iota % jnp.maximum(jnp.asarray(n_act, jnp.int32), 1)
+    return jax.ops.segment_sum(per_lane, dst, num_segments=L)
+
+
+def apply(
+    plan: ElasticPlan,
+    es: ElasticState,
+    drained: Optional[Dict[str, jnp.ndarray]] = None,
+):
+    """One tick of resize application. ``drained`` maps role name ->
+    traced bool: True when every DEACTIVATING instance of that role is
+    idle (roles absent from the dict — immediate-handoff roles whose
+    ownership is recomputed per tick — default True). Scale-ups apply
+    unconditionally; scale-downs wait for the drain predicate.
+    Returns ``(es', n_resized)`` where ``n_resized`` counts roles
+    whose active count changed this tick (feeds the telemetry ring's
+    ``resizes`` column); 0 (a Python int) under the none plan."""
+    if not plan.active:
+        return es, 0
+    dr = jnp.stack(
+        [
+            jnp.asarray((drained or {}).get(name, True), bool).reshape(())
+            for name, _, _ in plan.roles
+        ]
+    )  # [R]
+    grow = es.target > es.active
+    shrink = (es.target < es.active) & dr
+    new_active = jnp.where(grow | shrink, es.target, es.active)
+    changed = new_active != es.active
+    n_resized = jnp.sum(changed.astype(jnp.int32))
+    return (
+        dataclasses.replace(
+            es,
+            active=new_active,
+            gen=es.gen + (n_resized > 0).astype(jnp.int32),
+            scale_ups=es.scale_ups
+            + jnp.sum((grow & changed).astype(jnp.int32)),
+            scale_downs=es.scale_downs
+            + jnp.sum((shrink & changed).astype(jnp.int32)),
+        ),
+        n_resized,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host verbs (serve-loop control plane; dataclasses.replace of traced
+# leaves — never a recompile).
+# ---------------------------------------------------------------------------
+
+
+def set_target(
+    plan: ElasticPlan, es: ElasticState, name: str, n: int
+) -> ElasticState:
+    """The resize verb: set the role's target count, clipped to
+    ``[floor, capacity]``. The tick applies it (immediately for a
+    grow, after the drain for a shrink)."""
+    i = plan.index(name)
+    _, cap, floor = plan.roles[i]
+    n = int(min(max(int(n), floor), cap))
+    return dataclasses.replace(
+        es, target=es.target.at[i].set(jnp.int32(n))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Invariants + host views
+# ---------------------------------------------------------------------------
+
+
+def invariants_ok(plan: ElasticPlan, es: ElasticState) -> jnp.ndarray:
+    """Traced bool: every count within its declared bounds and the
+    event books non-negative — ANDed into the backend's
+    ``check_invariants`` as ``elastic_ok``."""
+    if not plan.active:
+        return jnp.bool_(True)
+    caps = jnp.asarray([c for _, c, _ in plan.roles], jnp.int32)
+    floors = jnp.asarray([f for _, _, f in plan.roles], jnp.int32)
+    ok = jnp.all(
+        (es.active >= floors)
+        & (es.active <= caps)
+        & (es.target >= floors)
+        & (es.target <= caps)
+    )
+    return (
+        ok
+        & (es.gen >= 0)
+        & (es.scale_ups >= 0)
+        & (es.scale_downs >= 0)
+    )
+
+
+def counts(plan: ElasticPlan, es: ElasticState) -> Dict[str, int]:
+    """Host view of the live role counts — the shape
+    ``ops.costmodel.capacity`` takes as its ``role_counts``
+    feedforward term (one device_get of the [R] vector)."""
+    if not plan.active:
+        return {}
+    act = jax.device_get(es.active)
+    return {name: int(act[i]) for i, (name, _, _) in enumerate(plan.roles)}
+
+
+def summary(plan: ElasticPlan, es: ElasticState) -> dict:
+    """Host roll-up for reports / capacity-event markers."""
+    if not plan.active:
+        return {"active": False}
+    es = jax.device_get(es)
+    return {
+        "active": True,
+        "roles": {
+            name: {
+                "active": int(es.active[i]),
+                "target": int(es.target[i]),
+                "capacity": cap,
+                "floor": floor,
+            }
+            for i, (name, cap, floor) in enumerate(plan.roles)
+        },
+        "generation": int(es.gen),
+        "scale_ups": int(es.scale_ups),
+        "scale_downs": int(es.scale_downs),
+    }

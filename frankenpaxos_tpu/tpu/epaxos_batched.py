@@ -67,6 +67,7 @@ from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 _LANES = 32  # columns per packed visibility word
@@ -672,6 +673,33 @@ def tick(
         queue_capacity=C * W,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+
+    # Span sampler (telemetry.record_spans — the generic plumbing):
+    # instance lifecycles on the per-column rings, from the masks this
+    # tick already computed. Mapping: group = column, slot id = the
+    # instance ordinal at each ring position (OLD head — valid for
+    # every cell occupied at tick start, including this tick's GC
+    # retirees); a cell proposed THIS tick carries the OLD
+    # next_instance ordinal (retire + re-propose in one tick crosses a
+    # full window). The PreAccept quorum and the commit are one event
+    # in this model, so the vote and chosen stamps coincide; the
+    # "executed" stamp is the ring retirement — the snapshot-barrier
+    # prune under the GC layer, the execute pass itself without it.
+    # No phase-1 plane: EPaxos is leaderless (nothing to promise).
+    # Structurally OFF at spans=0, like the counter ring.
+    if telemetry_mod.span_slots(tel):
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=is_new,
+            slot_ids=state.head[:, None]
+            + jnp.mod(w_iota[None, :] - state.head[:, None], W),
+            new_slot_ids=state.next_instance[:, None] + delta,
+            phase1_mark=jnp.zeros((C,), bool),
+            voted=new_commit_mask,
+            newly_chosen=new_commit_mask,
+            retire_mask=clear,
+        )
 
     return BatchedEPaxosState(
         next_instance=next_instance,

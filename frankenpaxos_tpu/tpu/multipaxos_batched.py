@@ -70,10 +70,12 @@ from frankenpaxos_tpu.tpu.common import (
 # cleanly from either entry point.
 from frankenpaxos_tpu.ops import registry as ops_registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.tpu import elastic as elastic_mod
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import lifecycle as lifecycle_mod
 from frankenpaxos_tpu.tpu import packing
 from frankenpaxos_tpu.tpu import workload as workload_mod
+from frankenpaxos_tpu.tpu.elastic import ElasticPlan, ElasticState
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan, LifecycleState
 from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
@@ -235,6 +237,14 @@ class BatchedMultiPaxosConfig:
     # multipaxos_p1_promise plane). LifecyclePlan.none() is a
     # structural no-op: default runs stay bit-identical.
     lifecycle: LifecyclePlan = LifecyclePlan.none()
+    # Elastic capacity (tpu/elastic.py): the proposer-group axis is a
+    # PADDED plane behind a traced active-count — arrivals re-route
+    # over the first N live lanes (a traced modulus, zero recompiles),
+    # so the SLO autoscaler grows admission capacity under duress and
+    # shrinks it on the trough (drain-then-deactivate: a deactivating
+    # group first stops receiving, then drops out once its window and
+    # backlog are empty). ElasticPlan.none() is a structural no-op.
+    elastic: ElasticPlan = ElasticPlan.none()
     # Bit-packed hot narrow planes (tpu/packing.py, the dtype policy's
     # sub-byte tier): carry the 2-bit status/rb_status planes and the
     # session-table occupancy bits packed into int32 words in the scan
@@ -292,6 +302,15 @@ class BatchedMultiPaxosConfig:
         self.faults.validate(axis=self.group_size)
         self.workload.validate(reads_supported=self.read_rate > 0)
         self.lifecycle.validate(align=self.rotation_alignment)
+        self.elastic.validate({"groups": self.num_groups})
+        if self.elastic.active:
+            # Elastic routing steers ARRIVALS over the live lanes: it
+            # needs an open-loop shaped arrival process (closed-loop
+            # clients are lane-pinned; saturation has no arrivals).
+            assert self.workload.shaped and not self.workload.closed, (
+                "elastic 'groups' needs an open-loop shaped workload "
+                "(arrival process on, closed_window=0)"
+            )
         if self.lifecycle.reconfig:
             # Both machineries bump rounds and re-promise; the traced
             # epoch axis replaces the static schedule, not joins it.
@@ -440,6 +459,10 @@ class BatchedMultiPaxosState:
     # all-empty under LifecyclePlan.none()).
     lifecycle: LifecycleState
 
+    # Elastic-capacity state (tpu/elastic.py: traced active/target
+    # group counts + resize books; all-empty under ElasticPlan.none()).
+    elastic: ElasticState
+
     # Device-side per-tick metric ring (tpu/telemetry.py contract).
     telemetry: Telemetry
 
@@ -543,6 +566,7 @@ def init_state(cfg: BatchedMultiPaxosConfig) -> BatchedMultiPaxosState:
             cfg.lifecycle, G, acceptor_shape=(A, G),
             packed=cfg.pack_planes,
         ),
+        elastic=elastic_mod.make_state(cfg.elastic),
         telemetry=make_telemetry(),
     )
 
@@ -982,11 +1006,38 @@ def tick(
     # static slots_per_tick knob is replaced by the per-group admission
     # cap (arrival process x Zipf skew, FIFO backlog, closed-loop
     # window), and every other gate below composes on top.
+    # ---- 0.8 Elastic capacity (tpu/elastic.py): apply any pending
+    # resize, then re-route this tick's arrivals over the first
+    # `min(active, target)` proposer lanes (a traced modulus — zero
+    # recompiles). A deactivating group drops out of `active` only
+    # once its window and backlog are EMPTY (drain-then-deactivate:
+    # routing already steered new work away, so both drain naturally
+    # and no in-flight work is lost).
+    ela = cfg.elastic
+    els = state.elastic
+    n_resized = 0
+    if ela.active:
+        g_iota_e = jnp.arange(G, dtype=jnp.int32)
+        g_tgt = elastic_mod.target_count(ela, els, "groups", G)
+        deactivating = g_iota_e >= g_tgt
+        lane_idle = (state.head == state.next_slot) & (
+            wls.backlog == 0
+        )
+        els, n_resized = elastic_mod.apply(
+            ela,
+            els,
+            {"groups": jnp.all(jnp.where(deactivating, lane_idle, True))},
+        )
+        g_route = elastic_mod.routing_count(ela, els, "groups", G)
     wl_writes = wl_reads = None
     if wl.active:
         wl_writes, wl_reads, wls = workload_mod.begin(
             wl, wls, key, t, G
         )
+        if ela.active:
+            wl_writes = elastic_mod.route_lanes(wl_writes, g_route)
+            if wl.has_reads:
+                wl_reads = elastic_mod.route_lanes(wl_reads, g_route)
         cap = workload_mod.admission(wl, wls, wl_writes)
     else:
         cap = jnp.full((G,), cfg.slots_per_tick, jnp.int32)
@@ -1623,6 +1674,7 @@ def tick(
             if lc_shift is not None
             else 0
         ),
+        resizes=n_resized,
         queue_depth=jnp.sum(next_slot - head),
         queue_capacity=G * W,
         lat_hist_delta=lat_hist - state.lat_hist,
@@ -1814,6 +1866,7 @@ def tick(
         read_lin_violations=read_lin_violations,
         workload=wls,
         lifecycle=lcs,
+        elastic=els,
         telemetry=tel,
     )
 
@@ -2081,6 +2134,11 @@ def check_invariants(
                 else None
             ),
         ),
+        # Elastic books: active/target counts inside [floor, capacity],
+        # resize generation and event counters monotone.
+        "elastic_ok": elastic_mod.invariants_ok(
+            cfg.elastic, state.elastic
+        ),
         "window_ok": window_ok,
         "conserved": conserved,
         "round_ok": round_ok,
@@ -2103,6 +2161,7 @@ def analysis_config(
     faults: FaultPlan = FaultPlan.none(),
     workload: WorkloadPlan = WorkloadPlan.none(),
     lifecycle: LifecyclePlan = LifecyclePlan.none(),
+    elastic: ElasticPlan = ElasticPlan.none(),
 ) -> BatchedMultiPaxosConfig:
     """The backend's canonical SMALL config: shared by the
     static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
@@ -2110,8 +2169,12 @@ def analysis_config(
     simulation-testing registry (``harness/simtest.py``). Big enough to
     exercise every protocol plane, small enough to trace and compile in
     well under a second."""
+    if elastic.active and not workload.shaped:
+        # The elastic 'groups' role routes ARRIVALS: an elastic
+        # analysis config needs an open-loop shaped workload.
+        workload = WorkloadPlan(arrival="constant", rate=2.0)
     return BatchedMultiPaxosConfig(
         f=1, num_groups=4, window=16, slots_per_tick=2,
         retry_timeout=8, faults=faults, workload=workload,
-        lifecycle=lifecycle,
+        lifecycle=lifecycle, elastic=elastic,
     )

@@ -55,7 +55,8 @@ from frankenpaxos_tpu.tpu.common import LAT_BINS
 
 # Per-tick ring columns. All but queue_depth are event counters (events
 # that happened THIS tick — rotations counts tpu/lifecycle.py window
-# rolls); queue_depth is a gauge sampled at tick end (in-flight work
+# rolls, resizes counts tpu/elastic.py applied role-count changes);
+# queue_depth is a gauge sampled at tick end (in-flight work
 # items — ring occupancy / window backlog, per backend).
 COUNTER_FIELDS = (
     "proposals",
@@ -67,6 +68,7 @@ COUNTER_FIELDS = (
     "retries",
     "leader_changes",
     "rotations",
+    "resizes",
     "queue_depth",
 )
 NUM_COLS = len(COUNTER_FIELDS)
@@ -174,6 +176,7 @@ def record(
     retries=0,
     leader_changes=0,
     rotations=0,
+    resizes=0,
     queue_depth=0,
     queue_capacity: int = 0,
     lat_hist_delta: Optional[jnp.ndarray] = None,
@@ -203,6 +206,7 @@ def record(
                 retries,
                 leader_changes,
                 rotations,
+                resizes,
                 queue_depth,
             )
         ]

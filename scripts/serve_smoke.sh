@@ -10,11 +10,17 @@
 #      latest checkpoint, and must recover — invariants + exactly-once
 #      session books hold and the final state is sha256-identical to
 #      the uninterrupted twin, and
-#   5. static analysis exiting 0 with the trace-serve-nosync,
-#      checkpoint-alias-free, and trace-checkpoint-restore rules
-#      registered (the chunked dispatch path stays free of blocking
-#      transfers; the checkpoint snapshot aliases nothing; restore
-#      never recompiles).
+#   5. an elastic-capacity leg (tpu/elastic.py + the autoscaler
+#      ladder): a compressed trough->burst->trough day over a padded
+#      role plane must scale OUT at least once under the burst's p99
+#      alarm and scale back IN on the trough, shut down cleanly, and
+#      never recompile across the resizes, and
+#   6. static analysis exiting 0 with the trace-serve-nosync,
+#      checkpoint-alias-free, trace-checkpoint-restore, elastic-noop,
+#      and trace-elastic-retrace rules registered (the chunked
+#      dispatch path stays free of blocking transfers; the checkpoint
+#      snapshot aliases nothing; restore never recompiles; resizes
+#      ride traced membership scalars).
 #
 # Usage: scripts/serve_smoke.sh [out_dir]   (SERVE_SMOKE_SECONDS=10)
 set -euo pipefail
@@ -93,6 +99,74 @@ print(
 )
 EOF
 
+# Elastic-capacity leg: a compressed trough->burst->trough day over a
+# padded 4-group role plane with the SLO-driven autoscaler ladder on.
+# The burst's p99 alarm must GROW the active group count (traced
+# resize verbs — the jit cache stays flat), the trough must SHRINK it
+# back (drain-then-deactivate), and the exactly-once books must hold
+# across every resize.
+JAX_PLATFORMS=cpu python - "$OUT/elastic" <<'EOF'
+import dataclasses, json, os, sys
+
+import jax
+
+from frankenpaxos_tpu.harness import serve as serve_mod
+from frankenpaxos_tpu.monitoring.autoscaler import AutoscalerPolicy
+from frankenpaxos_tpu.monitoring.slo import SloPolicy
+from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+from frankenpaxos_tpu.tpu.elastic import ElasticPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+out = sys.argv[1]
+os.makedirs(out, exist_ok=True)
+cfg = mp.BatchedMultiPaxosConfig(
+    f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=16,
+    workload=WorkloadPlan(arrival="constant", rate=0.4, backlog_cap=64),
+    elastic=ElasticPlan(roles=(("groups", 4, 1),)),
+)
+serve_cfg = serve_mod.ServeConfig(
+    chunk_ticks=16, telemetry_window=64, max_chunks=1,
+    slo=SloPolicy(p99_target_ticks=12, source="queue_wait"),
+    autoscaler=AutoscalerPolicy(cooldown_drains=0, trough_after=3),
+    scrape_csv=os.path.join(out, "elastic_metrics.csv"),
+)
+loop = serve_mod.ServeLoop(
+    mp, cfg, serve_cfg, seed=0, elastic_initial={"groups": 1}
+)
+
+def phase(chunks, rate):
+    loop.set_base_rate(rate)
+    loop.serve = dataclasses.replace(
+        loop.serve, max_chunks=loop._chunks + chunks
+    )
+    return loop.run()
+
+phase(3, 0.3)                      # night trough at the floor
+cache_after_warm = mp.run_ticks._cache_size()
+phase(6, 1.6)                      # saturating burst: scale out
+report = phase(16, 0.2)            # evening trough: scale back in
+assert report["clean_shutdown"], report
+asum = report["autoscaler"]
+assert asum["scale_up_events"] >= 1, asum
+assert asum["scale_down_events"] >= 1, asum
+assert mp.run_ticks._cache_size() == cache_after_warm == 1, (
+    "a resize recompiled"
+)
+inv = {
+    k: bool(v)
+    for k, v in jax.device_get(
+        mp.check_invariants(loop.cfg, loop.state, loop.t)
+    ).items()
+}
+assert inv.get("elastic_ok") and inv.get("workload_ok"), inv
+assert os.path.getsize(serve_cfg.scrape_csv) > 0
+print(
+    "elastic smoke OK:", asum["scale_up_events"], "ups,",
+    asum["scale_down_events"], "downs, roles",
+    json.dumps(report["elastic"]["roles"]),
+)
+EOF
+
 # Kill-and-recover leg: SIGKILL the serve worker mid-run at a
 # randomized chunk boundary, restart from the newest valid checkpoint,
 # and verify liveness + invariants + exactly-once books + a final
@@ -109,9 +183,12 @@ echo "$RULES" | grep trace-serve-nosync >/dev/null
 echo "$RULES" | grep checkpoint-alias-free >/dev/null
 echo "$RULES" | grep trace-checkpoint-restore >/dev/null
 echo "$RULES" | grep trace-fleet-drain-nosync >/dev/null
+echo "$RULES" | grep elastic-noop >/dev/null
+echo "$RULES" | grep trace-elastic-retrace >/dev/null
 # lint.sh forces the 8-virtual-device product mesh, so the fleet rule
 # runs its full census here even on single-device hosts.
 scripts/lint.sh --rule trace-serve-nosync \
   --rule checkpoint-alias-free --rule trace-checkpoint-restore \
-  --rule trace-fleet-drain-nosync
+  --rule trace-fleet-drain-nosync --rule elastic-noop \
+  --rule trace-elastic-retrace
 echo "serve_smoke: PASS"

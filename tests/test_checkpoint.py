@@ -390,6 +390,53 @@ def test_kill_and_recover_subprocess(tmp_path):
     assert res.final["digest"] == twin["digest"]
 
 
+def test_kill_and_recover_generic_backend(tmp_path):
+    """Lifecycle breadth: kill-and-recover beyond the two serve-grade
+    worker configs. A GENERIC_BACKENDS worker (epaxos here — leaderless,
+    GC-replica churn, no session table) runs the same contract at its
+    canonical analysis shape: SIGKILL at a checkpointed boundary,
+    resume, liveness + invariants + a digest bit-identical to the
+    uninterrupted twin."""
+    from frankenpaxos_tpu.harness import recovery
+
+    assert "epaxos" in recovery.GENERIC_BACKENDS
+    res = recovery.run_kill_recover(
+        str(tmp_path / "killed"), chunks=8, every=2, chunk_ticks=8,
+        seed=0, backend="epaxos", kill_seed=1, max_kills=1,
+        chunk_delay=0.15, poll=0.05, backoff_base=0.05,
+    )
+    assert res.ok, res.to_dict()
+    assert res.kills and res.restarts >= 1
+    assert res.final["invariants_ok"]
+    assert res.final["lifecycle"] is None  # no session table here
+    twin = recovery.uninterrupted_digest(
+        chunks=8, every=2, chunk_ticks=8, seed=0,
+        backend="epaxos", out_dir=str(tmp_path / "twin"),
+    )
+    assert res.final["digest"] == twin["digest"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["mencius", "scalog", "craq"])
+def test_kill_and_recover_generic_breadth(tmp_path, backend):
+    """The rest of the GENERIC_BACKENDS sweep (slow tier): every
+    registered generic worker shape recovers bit-exactly."""
+    from frankenpaxos_tpu.harness import recovery
+
+    res = recovery.run_kill_recover(
+        str(tmp_path / "killed"), chunks=8, every=2, chunk_ticks=8,
+        seed=0, backend=backend, kill_seed=1, max_kills=1,
+        chunk_delay=0.15, poll=0.05, backoff_base=0.05,
+    )
+    assert res.ok, res.to_dict()
+    assert res.kills, "no SIGKILL landed"
+    twin = recovery.uninterrupted_digest(
+        chunks=8, every=2, chunk_ticks=8, seed=0,
+        backend=backend, out_dir=str(tmp_path / "twin"),
+    )
+    assert res.final["digest"] == twin["digest"]
+
+
 @pytest.mark.slow
 def test_watchdog_restarts_hung_worker(tmp_path):
     """The watchdog half: a worker whose dispatch hangs (heartbeats

@@ -1,0 +1,458 @@
+"""The elastic-capacity subsystem (tpu/elastic.py +
+monitoring/autoscaler.py): pre-allocated padded role planes behind
+traced membership counts, and the SLO-driven policy ladder that grows
+them under duress instead of only shedding load.
+
+The load-bearing guarantees, in order:
+
+  * A resize-free run with an ACTIVE ElasticPlan (every role at its
+    initial count) is bit-identical to the ``ElasticPlan.none()`` twin
+    (3 seeds, both backends): threading the padded planes costs
+    default runs nothing, so elasticity is free until used.
+  * The autoscaler ladder fires in ORDER: alarm -> scale-up of the
+    feedforward bottleneck role -> admission clamp only once every
+    role sits at padded capacity; on recovery the clamp releases
+    FIRST, and capacity shrinks only after a sustained in-SLO trough.
+  * Resizing is recompile-free at the serve layer: the resize verb
+    edits traced state, the jit cache stays flat, invariants (books,
+    conservation) hold across every generation.
+  * The autoscaler's full decision state round-trips through
+    ``to_state``/``restore_state`` — a restored engine replays the
+    uninterrupted twin's decisions bit-exactly.
+  * Fleet elasticity (``set_active_instances``) redistributes the
+    total offered load over the first k instances through the traced
+    rate vector — same executable, deactivated tail, capacity markers
+    recorded.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frankenpaxos_tpu.harness.serve import ServeConfig, ServeLoop
+from frankenpaxos_tpu.monitoring.autoscaler import (
+    Autoscaler, AutoscalerPolicy,
+)
+from frankenpaxos_tpu.tpu import compartmentalized_batched as cz
+from frankenpaxos_tpu.tpu import elastic as el_mod
+from frankenpaxos_tpu.tpu import multipaxos_batched as mp
+from frankenpaxos_tpu.tpu.elastic import ElasticPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+
+def _hash(state, fields):
+    m = hashlib.sha256()
+    for f in fields:
+        m.update(np.asarray(jax.device_get(getattr(state, f))).tobytes())
+    return m.hexdigest()[:16]
+
+
+def _run(mod, cfg, ticks, seed, state=None, t=None):
+    state = mod.init_state(cfg) if state is None else state
+    t = jnp.zeros((), jnp.int32) if t is None else t
+    return mod.run_ticks(cfg, state, t, ticks, jax.random.PRNGKey(seed))
+
+
+def _assert_invariants(mod, cfg, state, t):
+    bad = {
+        k: bool(v)
+        for k, v in mod.check_invariants(cfg, state, t).items()
+        if not bool(v)
+    }
+    assert not bad, bad
+
+
+# ---------------------------------------------------------------------------
+# Resize-free bit-identity: an active plan at full initial counts IS
+# the none() program (3 seeds, both backends).
+# ---------------------------------------------------------------------------
+
+_OPEN_LOOP = WorkloadPlan(arrival="constant", rate=2.0)
+
+_MP_FIELDS = ("status", "slot_value", "chosen_round", "head",
+              "next_slot", "acc_round", "vote_round", "vote_value")
+_CZ_FIELDS = ("status", "head", "next_slot", "rep_exec",
+              "p2b_arrival", "rd_bound")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_resize_free_bit_identical_multipaxos(seed):
+    el = mp.analysis_config(
+        elastic=ElasticPlan(roles=(("groups", 4, 1),))
+    )
+    none = mp.analysis_config(workload=_OPEN_LOOP)
+    assert el.workload == none.workload  # the open-loop substitution
+    st_el, _ = _run(mp, el, 120, seed)
+    st_none, _ = _run(mp, none, 120, seed)
+    assert (int(st_el.committed), int(st_el.retired),
+            _hash(st_el, _MP_FIELDS)) == (
+        int(st_none.committed), int(st_none.retired),
+        _hash(st_none, _MP_FIELDS))
+    # none() carries structurally EMPTY elastic state.
+    assert all(
+        leaf.size == 0
+        for leaf in jax.tree_util.tree_leaves(st_none.elastic)
+    )
+    assert int(st_el.elastic.gen) == 0  # resize-free: generation 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_resize_free_bit_identical_compartmentalized(seed):
+    el = cz.analysis_config(
+        elastic=ElasticPlan(roles=(
+            ("proxies", 4, 1), ("batchers", 2, 1),
+            ("unbatchers", 2, 1), ("replicas", 3, 1),
+        ))
+    )
+    none = cz.analysis_config(workload=el.workload)
+    st_el, _ = _run(cz, el, 120, seed)
+    st_none, _ = _run(cz, none, 120, seed)
+    assert (int(st_el.committed), int(st_el.retired),
+            _hash(st_el, _CZ_FIELDS)) == (
+        int(st_none.committed), int(st_none.retired),
+        _hash(st_none, _CZ_FIELDS))
+    assert all(
+        leaf.size == 0
+        for leaf in jax.tree_util.tree_leaves(st_none.elastic)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The ladder, at the policy layer: exact ordering over synthetic SLO
+# statuses.
+# ---------------------------------------------------------------------------
+
+
+def _status(alarm, p99, scale=1.0, shed_breach=False):
+    return {
+        "p99": p99, "p99_target": 10.0, "p99_breach": p99 > 10.0,
+        "shed_rate": 0.0, "shed_breach": shed_breach, "alarm": alarm,
+        "fired": False, "cleared": False, "scale": scale,
+    }
+
+
+def test_ladder_order_scale_up_then_clamp_then_release_then_shrink():
+    asc = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=0, trough_after=2),
+        {"groups": (3, 1)}, initial={"groups": 1},
+    )
+    # Duress: capacity first, one step per drain.
+    d = asc.decide(_status(True, 40.0, scale=0.9))
+    assert d["actions"] == [{"role": "groups", "from": 1, "to": 2}]
+    assert not d["clamp_engaged"] and d["effective_scale"] == 1.0
+    d = asc.decide(_status(True, 40.0, scale=0.8))
+    assert d["actions"] == [{"role": "groups", "from": 2, "to": 3}]
+    # At padded capacity: ONLY now may the clamp bind, applying the
+    # decay the SLO engine accumulated while capacity was tried first.
+    d = asc.decide(_status(True, 40.0, scale=0.7))
+    assert not d["actions"] and d["clamp_engaged"]
+    assert d["effective_scale"] == pytest.approx(0.7)
+    d = asc.decide(_status(True, 40.0, scale=0.6))  # latched, no re-fire
+    assert d["clamp_engaged"] and asc.clamp_engagements == 1
+    # Recovery: release FIRST (no shrink on the same drain).
+    d = asc.decide(_status(False, 4.0, scale=0.6))
+    assert not d["actions"] and not d["clamp_engaged"]
+    assert d["effective_scale"] == 1.0
+    # Trough: two consecutive deep drains before the first shrink.
+    d = asc.decide(_status(False, 4.0))
+    assert not d["actions"]
+    d = asc.decide(_status(False, 4.0))
+    assert d["actions"] == [{"role": "groups", "from": 3, "to": 2}]
+    d = asc.decide(_status(False, 4.0))
+    assert d["actions"] == [{"role": "groups", "from": 2, "to": 1}]
+    d = asc.decide(_status(False, 4.0))  # at floor: nothing to give
+    assert not d["actions"]
+    kinds = [e["kind"] for e in asc.events]
+    assert kinds == ["scale_up", "scale_up", "clamp_engage",
+                     "clamp_release", "scale_down", "scale_down"]
+    assert (asc.scale_up_events, asc.scale_down_events,
+            asc.clamp_engagements, asc.clamp_releases) == (2, 2, 1, 1)
+    # Every resize event carries the costmodel feedforward blob.
+    for e in asc.events:
+        if e["kind"] in ("scale_up", "scale_down"):
+            assert "bottleneck_role" in e["feedforward"]
+
+
+def test_ladder_shallow_lull_and_shed_breach_reset_the_trough():
+    asc = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=0, trough_after=2),
+        {"groups": (3, 1)}, initial={"groups": 2},
+    )
+    asc.decide(_status(False, 4.0))  # deep: streak 1
+    asc.decide(_status(False, 9.0))  # in SLO but SHALLOW: reset
+    asc.decide(_status(False, 4.0))
+    d = asc.decide(_status(False, 4.0, shed_breach=True))  # reset again
+    assert not d["actions"]
+    asc.decide(_status(False, 4.0))
+    d = asc.decide(_status(False, 4.0))
+    assert d["actions"] == [{"role": "groups", "from": 2, "to": 1}]
+
+
+def test_cooldown_spaces_actions():
+    asc = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=2, trough_after=1),
+        {"groups": (4, 1)}, initial={"groups": 1},
+    )
+    ups = sum(
+        len(asc.decide(_status(True, 40.0, scale=0.9))["actions"])
+        for _ in range(5)
+    )
+    assert ups == 2  # drains 1 and 4 act; 2, 3, 5 cool down
+
+
+def test_feedforward_picks_the_bottleneck_role():
+    """The grow pick is the lowest aggregate ceiling with headroom —
+    with batchers the scarce role (HT-Paxos: the dissemination roles
+    saturate first), proxies never grow first."""
+    asc = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=0),
+        {"proxies": (4, 1), "batchers": (2, 1)},
+        initial={"proxies": 4, "batchers": 1},
+    )
+    d = asc.decide(_status(True, 40.0))
+    assert d["actions"][0]["role"] == "batchers"
+    # Shrink releases the MOST over-provisioned (highest ceiling).
+    asc2 = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=0, trough_after=1),
+        {"proxies": (4, 1), "batchers": (2, 1)},
+        initial={"proxies": 4, "batchers": 1},
+    )
+    d = asc2.decide(_status(False, 1.0))
+    assert d["actions"][0]["role"] == "proxies"
+
+
+def test_autoscaler_state_round_trip_replays_bit_exactly():
+    seq = (
+        [_status(True, 40.0, scale=0.9)] * 4
+        + [_status(False, 3.0)] * 6
+        + [_status(True, 30.0, scale=0.8)] * 2
+    )
+    mk = lambda: Autoscaler(  # noqa: E731
+        AutoscalerPolicy(cooldown_drains=0, trough_after=2),
+        {"groups": (3, 1)}, initial={"groups": 1},
+    )
+    a, b = mk(), mk()
+    decisions_a = [a.decide(s) for s in seq]
+    cut = 5
+    for s in seq[:cut]:
+        b.decide(s)
+    resumed = mk()
+    resumed.restore_state(b.to_state())
+    decisions_b = [b.decide(s) for s in seq[cut:]]
+    decisions_r = [resumed.decide(s) for s in seq[cut:]]
+    assert decisions_r == decisions_b == decisions_a[cut:]
+    assert resumed.to_state() == a.to_state() == b.to_state()
+
+
+# ---------------------------------------------------------------------------
+# The serve layer: resize verbs are recompile-free and book-exact.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_loop_resize_verbs_recompile_free():
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=4, window=16, slots_per_tick=2, retry_timeout=8,
+        workload=_OPEN_LOOP,
+        elastic=ElasticPlan(roles=(("groups", 4, 1),)),
+    )
+    serve = ServeConfig(chunk_ticks=20, telemetry_window=64,
+                        max_chunks=6)
+    loop = ServeLoop(mp, cfg, serve, seed=0,
+                     elastic_initial={"groups": 2})
+    snap = loop._dispatch_chunk()
+    loop.resize("groups", 4)  # scale up: applies immediately
+    snap2 = loop._dispatch_chunk()
+    loop._drain(snap)
+    cache = mp.run_ticks._cache_size()
+    loop.resize("groups", 1)  # scale down: drain-then-deactivate
+    snap3 = loop._dispatch_chunk()
+    loop._drain(snap2)
+    loop._drain(snap3)
+    # Deactivation waits for the retiring lanes to drain — give the
+    # loop a few more chunks to empty them and apply the generation.
+    for _ in range(3):
+        loop._drain(loop._dispatch_chunk())
+    assert mp.run_ticks._cache_size() == cache, "resize recompiled"
+    _assert_invariants(mp, cfg, loop.state, loop.t)
+    assert int(loop.state.elastic.gen) >= 2  # both generations applied
+    report = loop.report(1.0)
+    groups = report["elastic"]["roles"]["groups"]
+    assert groups["target"] == 1 and groups["capacity"] == 4
+    verb_names = {
+        s["name"] for s in loop.host_spans
+        if s["name"].startswith("verb:")
+    }
+    assert "verb:resize" in verb_names
+    # Resize spans are Perfetto INSTANT markers.
+    assert all(
+        s.get("instant") for s in loop.host_spans
+        if s["name"] == "verb:resize"
+    )
+
+
+def test_serve_config_autoscaler_requires_slo():
+    from frankenpaxos_tpu.monitoring.slo import SloPolicy
+
+    with pytest.raises(AssertionError):
+        ServeConfig(chunk_ticks=8, telemetry_window=32, max_chunks=1,
+                    autoscaler=AutoscalerPolicy())
+    ServeConfig(chunk_ticks=8, telemetry_window=32, max_chunks=1,
+                slo=SloPolicy(p99_target_ticks=12),
+                autoscaler=AutoscalerPolicy())
+
+
+# ---------------------------------------------------------------------------
+# The randomized [faults x resize] churn axis (harness/simtest.py).
+# ---------------------------------------------------------------------------
+
+
+def test_simtest_elastic_axis():
+    """Randomized role-count churn against crash/partition schedules
+    at segment boundaries; invariants and the elastic books hold
+    throughout, and progress resumes across the final floor-pinned
+    segment (liveness-after-scale-down under churn), on both
+    backends."""
+    import random as _random
+
+    from frankenpaxos_tpu.harness import simtest
+
+    for name in ("multipaxos", "compartmentalized"):
+        spec = simtest.SPECS[name]
+        assert spec.elastic_ok
+        rng = _random.Random(7)
+        for i in range(2):
+            plan = simtest.random_plan(rng, spec, 160)
+            if plan.has_partition and (
+                plan.partition_heal < 0 or plan.partition_heal > 120
+            ):
+                plan = dataclasses.replace(
+                    plan,
+                    partition_heal=80,
+                    partition_start=min(plan.partition_start, 79),
+                )
+            eplan = simtest.random_elastic(rng, spec)
+            res = simtest.run_elastic_schedule(
+                spec, plan, seed=i, ticks=160, elastic=eplan,
+                churn_seed=i,
+            )
+            assert res["ok"], (name, i, res["violations"], res)
+            assert res["resizes"] >= 1  # the floor pin always lands
+            for role, tgt in res["targets"].items():
+                assert tgt == eplan.floor_of(role), (role, tgt)
+            for role, n in res["counts"].items():
+                # Active counts sit between the pinned floor and cap
+                # (a retiring lane may still be draining).
+                assert (
+                    eplan.floor_of(role) <= n <= eplan.capacity_of(role)
+                ), (role, n)
+
+
+def test_kill_and_recover_mid_resize(tmp_path):
+    """The elastic worker shape of the kill-and-recover harness: a
+    real serve subprocess with the SLO/autoscaler ladder scaling out
+    from the floor is SIGKILLed mid-resize, restarts from the latest
+    checkpoint, and finishes with the state digest, the device-side
+    role books, AND the autoscaler's host-side ladder context all
+    bit-identical to the uninterrupted twin's."""
+    from frankenpaxos_tpu.harness import recovery
+
+    res = recovery.run_kill_recover(
+        str(tmp_path / "killed"), chunks=10, every=2, chunk_ticks=8,
+        seed=0, backend="multipaxos", elastic=True, kill_seed=2,
+        max_kills=1, chunk_delay=0.15, poll=0.05, backoff_base=0.05,
+    )
+    assert res.ok, res.to_dict()
+    assert res.kills and res.restarts >= 1
+    assert res.final["resumed"], "worker restarted fresh, not resumed"
+    twin = recovery.uninterrupted_digest(
+        chunks=10, every=2, chunk_ticks=8, seed=0,
+        backend="multipaxos", out_dir=str(tmp_path / "twin"),
+        elastic=True,
+    )
+    assert res.final["digest"] == twin["digest"]
+    assert res.final["autoscaler"] == twin["autoscaler"]
+    assert res.final["elastic"] == twin["elastic"]
+    # The run actually climbed the ladder — the kill had resizes in
+    # flight to land on.
+    assert res.final["elastic"]["scale_ups"] >= 1
+    assert res.final["autoscaler"]["targets"]["groups"] == 8
+
+
+def test_elastic_reproducer_round_trip(tmp_path):
+    from frankenpaxos_tpu.harness import simtest
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    spec = simtest.SPECS["multipaxos"]
+    eplan = ElasticPlan(roles=(("groups", 4, 2),))
+    plan = FaultPlan(drop_rate=0.05)
+    path = str(tmp_path / "repro.json")
+    simtest.dump_reproducer(
+        path, spec, plan, seed=3, ticks=120,
+        workload=_OPEN_LOOP, elastic=eplan, churn_seed=9,
+    )
+    loaded = simtest.load_reproducer(path)
+    assert len(loaded) == 7
+    lspec, lplan, lseed, lticks, lwork, lel, lchurn = loaded
+    assert (lspec.name, lseed, lticks, lchurn) == (
+        "multipaxos", 3, 120, 9
+    )
+    assert lplan == plan and lwork == _OPEN_LOOP and lel == eplan
+    a = simtest.run_elastic_schedule(
+        lspec, lplan, seed=lseed, ticks=lticks, workload=lwork,
+        elastic=lel, churn_seed=lchurn,
+    )
+    b = simtest.run_elastic_schedule(
+        spec, plan, seed=3, ticks=120, workload=_OPEN_LOOP,
+        elastic=eplan, churn_seed=9,
+    )
+    assert a == b and a["ok"], a  # the artifact replays bit-exactly
+
+
+# ---------------------------------------------------------------------------
+# Fleet elasticity: the padded instance axis.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_set_active_instances_redistributes_and_marks():
+    from frankenpaxos_tpu.harness.serve import (
+        FleetServeConfig, FleetServeLoop,
+    )
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    cfg = mp.BatchedMultiPaxosConfig(
+        f=1, num_groups=8, window=16, slots_per_tick=2, retry_timeout=8,
+        workload=WorkloadPlan(arrival="constant", rate=2.0,
+                              backlog_cap=256),
+        faults=FaultPlan(traced=True),
+    )
+    n = 4
+    loop = FleetServeLoop(
+        "multipaxos", cfg,
+        FleetServeConfig(chunk_ticks=10, telemetry_window=32,
+                         max_chunks=2),
+        n, seeds=list(range(n)), rates=[2.0] * n,
+        fault_rates=[[0.0] * 4] * n,
+    )
+    snap = loop._dispatch_chunk()
+    loop._drain(snap)
+    runner = loop.sharding._fleet_runner("multipaxos", None, None)
+    before = runner._cache_size()
+    loop.set_active_instances(2)  # scale DOWN to 2 of 4
+    np.testing.assert_allclose(
+        np.asarray(loop.states.workload.rate), [4.0, 4.0, 0.0, 0.0]
+    )
+    snap = loop._dispatch_chunk()
+    loop._drain(snap)
+    loop.set_active_instances(4)  # back up: same verb
+    np.testing.assert_allclose(
+        np.asarray(loop.states.workload.rate), [2.0] * 4
+    )
+    assert runner._cache_size() == before, "fleet resize recompiled"
+    kinds = [m["kind"] for m in loop.markers if m["instance"] == -1]
+    assert kinds == ["scale_down", "scale_up"]
+    report = loop.report(1.0)
+    assert report["active_instances"] == 4

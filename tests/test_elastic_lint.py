@@ -1,0 +1,77 @@
+"""Elastic-capacity contract (thin wrapper): under
+``ElasticPlan.none()`` the carried role-count state is structurally
+empty and feeds no tick equation (default runs stay bit-identical to
+the pre-elastic program), and steering the traced membership targets
+(the autoscaler's resize verbs) never recompiles — one executable
+serves every scale-up and scale-down.
+
+The checkers are the ``elastic-noop`` / ``trace-elastic-retrace``
+rules in ``frankenpaxos_tpu/analysis``; the behavioral pins live in
+``tests/test_elastic.py``. The teeth tests simulate the two
+regressions the rules exist for: a backend that drops the elastic
+field, and a resize whose traced signature drifts (the
+target-in-a-static-argument failure mode).
+"""
+
+import dataclasses
+
+import pytest
+
+from frankenpaxos_tpu import analysis
+from frankenpaxos_tpu.analysis import core, rules_trace
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.mark.parametrize(
+    "rule_id",
+    ["elastic-noop", "trace-elastic-retrace"],
+)
+def test_trace_rule_clean(rule_id):
+    report = analysis.run(rule_ids=[rule_id])
+    assert not report.findings, "\n" + report.format()
+
+
+def test_elastic_backends_are_traced_backends():
+    assert set(rules_trace.ELASTIC_BACKENDS) <= set(rules_trace.BACKENDS)
+    # The elastic rollout mirrors the lifecycle rollout: same two
+    # serve-grade backends thread both subsystems.
+    assert set(rules_trace.ELASTIC_BACKENDS) == set(
+        rules_trace.LIFECYCLE_BACKENDS
+    )
+
+
+def test_noop_rule_has_teeth(monkeypatch):
+    """Point the rule at a backend that does NOT thread the elastic
+    state: the missing-field finding must fire, proving the rule
+    actually reads the flattened State tree rather than vacuously
+    passing."""
+    monkeypatch.setattr(rules_trace, "ELASTIC_BACKENDS", ("epaxos",))
+    ctx = core.Context(backends=("epaxos",))
+    report = core.run(rule_ids=["elastic-noop"], ctx=ctx)
+    assert [f.key for f in report.findings] == ["epaxos:missing"]
+
+
+def test_retrace_rule_has_teeth(monkeypatch):
+    """Simulate the signature-drift regression: a ``set_target`` whose
+    result perturbs a carried leaf's dtype (stand-in for a target
+    count landing in a static argument) must miss the jit cache, and
+    the rule must flag the growth."""
+    from frankenpaxos_tpu.tpu import elastic
+
+    import jax.numpy as jnp
+
+    real = elastic.set_target
+
+    def drifting(plan, es, role, n):
+        out = real(plan, es, role, n)
+        return dataclasses.replace(
+            out, scale_ups=out.scale_ups.astype(jnp.float32)
+        )
+
+    monkeypatch.setattr(elastic, "set_target", drifting)
+    ctx = core.Context(backends=("multipaxos",))
+    report = core.run(rule_ids=["trace-elastic-retrace"], ctx=ctx)
+    assert any(
+        "missed the jit cache" in f.message for f in report.findings
+    ), report.format()

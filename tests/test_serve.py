@@ -874,6 +874,84 @@ def test_mencius_serve_perfetto_round_trip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Span sampler on epaxos (the sixth spans backend)
+# ---------------------------------------------------------------------------
+
+
+def test_epaxos_span_sampler_stamps_and_structural_noop():
+    """epaxos records instance lifecycles through the generic telemetry
+    plumbing: group = column, slot id = the instance ordinal, the
+    PreAccept quorum and the commit are one modeled event (vote ==
+    chosen stamp), and the "executed" stamp is the snapshot-barrier GC
+    prune — strictly downstream of the commit. spans=0 stays a
+    structural no-op (bit-identical protocol state), and there are no
+    phase-1 stamps (EPaxos is leaderless)."""
+    from frankenpaxos_tpu.tpu import epaxos_batched as ep
+
+    cfg = ep.analysis_config()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def run(spans):
+        st = dataclasses.replace(
+            ep.init_state(cfg), telemetry=T.make_telemetry(64, spans=spans)
+        )
+        st, _ = ep.run_ticks(cfg, st, t0, 100, key)
+        return st
+
+    on, off = run(8), run(0)
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(on, f.name)),
+            jax.tree_util.tree_leaves(getattr(off, f.name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
+    np.testing.assert_array_equal(
+        np.asarray(on.telemetry.totals), np.asarray(off.telemetry.totals)
+    )
+    spans, dropped, _ = T.completed_spans(on.telemetry)
+    assert spans and dropped == 0
+    for s in spans:
+        # The commit round is >= 2 one-way hops of lat_min >= 1 each,
+        # so the commit strictly follows the proposal; the GC prune
+        # waits for the quorum watermark's snapshot barrier, so
+        # retirement never precedes the commit.
+        assert 0 <= s["proposed"] < s["committed"] <= s["executed"], s
+        assert s["phase2_voted"] == s["committed"], s
+        assert s["phase1_promised"] == -1, s  # leaderless: no phase 1
+        assert 0 <= s["group"] < cfg.num_columns, s
+
+
+def test_epaxos_serve_perfetto_round_trip(tmp_path):
+    """The serve loop over epaxos with the span sampler on: the
+    Perfetto export round-trips with DEVICE lifecycle slices (epaxos
+    instance spans) and host dispatch spans in one timeline."""
+    from frankenpaxos_tpu.tpu import epaxos_batched as ep
+
+    cfg = ep.analysis_config()
+    out = tmp_path / "epaxos_trace.json"
+    serve = ServeConfig(
+        chunk_ticks=32, telemetry_window=64, spans=8,
+        trace_path=str(out), max_chunks=4,
+    )
+    loop = ServeLoop(ep, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["clean_shutdown"] and report["spans_exported"] > 0
+    payload = traceviz.load_chrome_trace(str(out))
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    device = [e for e in xs if e["pid"] == traceviz.DEVICE_PID]
+    host = [e for e in xs if e["pid"] == traceviz.HOST_PID]
+    assert device and host
+    lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
+    assert lifecycles
+    assert all("committed" in e["args"] for e in lifecycles)
+
+
+# ---------------------------------------------------------------------------
 # Span sampler on scalog (the fifth spans backend)
 # ---------------------------------------------------------------------------
 
