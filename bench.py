@@ -2568,6 +2568,136 @@ def _elastic_inner() -> None:
     print("BENCH_JSON " + json.dumps(result))
 
 
+def _depgraph_inner() -> None:
+    """The dependency-graph measurement (``--depgraph``): the
+    XLA-native bitmask SCC executor (ops/depgraph.py) in two legs.
+
+      1. executor leg: batched bitmask closure vs the sequential
+         pointer-walk twin at the flagship window shape
+         (harness/microbench.bench_depgraph — interleaved best-of-N,
+         bit-identity asserted against the host Tarjan oracle before
+         any timing; the ISSUE floor is a 1.3x CPU speedup, the TPU
+         number stays on the hardware-debt list);
+      2. surface leg: the [conflict x Zipf] density surface on the
+         bpaxos backend — conflict_rate rides WorkloadState as a
+         traced scalar, so the whole conflict axis replays ONE
+         compiled program per Zipf level (set_conflict_rate, no
+         retrace), and the executed/co-executed totals show dense
+         graphs batching into SCC closures instead of stalling.
+
+    One JSON line on stdout (BENCH_JSON ...). Capture artifact:
+    results/DEPGRAPH_r01.json."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from frankenpaxos_tpu.harness import microbench
+    from frankenpaxos_tpu.tpu import bpaxos_batched as bp
+    from frankenpaxos_tpu.tpu import workload as workload_mod
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    # ---- 1. Executor leg (flagship shape: batch 208 x window 64).
+    rows = microbench.bench_depgraph()
+    by_case = {r["case"]: r for r in rows if r["name"] == "depgraph"}
+    closure = by_case["bitmask_closure"]["ops_per_sec"]
+    walk = by_case["pointer_walk"]["ops_per_sec"]
+    speedup = closure / walk
+    executor_leg = {
+        "rows": rows,
+        "closure_ops_per_sec": closure,
+        "pointer_walk_ops_per_sec": walk,
+        "speedup": round(speedup, 4),
+        "floor": 1.3,
+        "bit_identity": "asserted in bench_depgraph before timing",
+    }
+
+    # ---- 2. Surface leg: [conflict x Zipf] on bpaxos. Zipf skew is a
+    # trace-time plan constant (one compile per level); the conflict
+    # axis is traced state (zero recompiles along it).
+    CONFLICTS = (0.0, 0.25, 0.5, 0.75, 1.0)
+    ZIPFS = (0.0, 1.0)
+    TICKS = 200
+    surface = []
+    one_compile_per_zipf = True
+    for zipf_s in ZIPFS:
+        plan = WorkloadPlan(
+            arrival="constant", rate=1.5, zipf_s=zipf_s,
+            conflict_rate=CONFLICTS[0],
+        )
+        cfg = bp.analysis_config(workload=plan)
+        cache0 = bp.run_ticks._cache_size()
+        for conflict in CONFLICTS:
+            st = bp.init_state(cfg)
+            st = dataclasses.replace(
+                st,
+                workload=workload_mod.set_conflict_rate(
+                    st.workload, conflict
+                ),
+            )
+            st, t = bp.run_ticks(
+                cfg, st, jnp.zeros((), jnp.int32), TICKS,
+                jax.random.PRNGKey(7),
+            )
+            inv = bp.check_invariants(cfg, st, t)
+            surface.append({
+                "zipf_s": zipf_s,
+                "conflict_rate": conflict,
+                "committed": int(st.committed_total),
+                "executed": int(st.executed_total),
+                "coexecuted": int(st.coexecuted),
+                "retired": int(st.retired_total),
+                "invariants_ok": all(bool(v) for v in inv.values()),
+            })
+        one_compile_per_zipf &= (
+            bp.run_ticks._cache_size() == cache0 + 1
+        )
+
+    def cell(zipf_s, conflict):
+        return next(
+            r for r in surface
+            if r["zipf_s"] == zipf_s and r["conflict_rate"] == conflict
+        )
+
+    density_ordered = all(
+        cell(z, 0.0)["executed"] > cell(z, 1.0)["executed"] > 0
+        for z in ZIPFS
+    )
+    scc_fires_when_dense = all(
+        cell(z, 1.0)["coexecuted"] > cell(z, 0.0)["coexecuted"]
+        for z in ZIPFS
+    )
+    surface_leg = {
+        "backend": "bpaxos",
+        "ticks_per_cell": TICKS,
+        "cells": surface,
+        "one_compile_per_zipf_level": one_compile_per_zipf,
+        "density_ordered": density_ordered,
+        "scc_fires_when_dense": scc_fires_when_dense,
+    }
+
+    result = {
+        "metric": "depgraph: batched bitmask SCC closure vs "
+        "sequential pointer walk + the [conflict x Zipf] surface",
+        "device": str(jax.devices()[0]),
+        "executor_leg": executor_leg,
+        "surface_leg": surface_leg,
+        "ok": (
+            speedup >= 1.3
+            and all(r["invariants_ok"] for r in surface)
+            and density_ordered
+            and scc_fires_when_dense
+            and one_compile_per_zipf
+        ),
+        "measured_live": True,
+    }
+    with open(
+        os.path.join(_REPO, "results", "DEPGRAPH_r01.json"), "w"
+    ) as f:
+        json.dump(result, f, indent=1)
+    print("BENCH_JSON " + json.dumps(result))
+
+
 def _subprocess_mode_main(inner_flag: str, metric: str, env: dict) -> None:
     """Shared orchestrator for the standalone bench modes (--workload,
     --multichip): run this script's inner mode in a clean subprocess,
@@ -2673,6 +2803,17 @@ def _elastic_main() -> None:
         "--inner-elastic",
         "elastic capacity: SLO-driven live resize of role planes "
         "(scale out under duress, clamp as last resort)",
+        _cpu_env(),
+    )
+
+
+def _depgraph_main() -> None:
+    """Orchestrate the depgraph measurement in a clean CPU subprocess;
+    print exactly one JSON line, exit 0."""
+    _subprocess_mode_main(
+        "--inner-depgraph",
+        "depgraph: batched bitmask SCC closure vs sequential pointer "
+        "walk + the [conflict x Zipf] surface",
         _cpu_env(),
     )
 
@@ -2985,6 +3126,8 @@ if __name__ == "__main__":
         _sessions_inner()
     elif "--inner-elastic" in sys.argv:
         _elastic_inner()
+    elif "--inner-depgraph" in sys.argv:
+        _depgraph_inner()
     elif "--inner" in sys.argv:
         _inner_main()
     elif "--multichip" in sys.argv:
@@ -3003,5 +3146,7 @@ if __name__ == "__main__":
         _sessions_main()
     elif "--elastic" in sys.argv:
         _elastic_main()
+    elif "--depgraph" in sys.argv:
+        _depgraph_main()
     else:
         main()

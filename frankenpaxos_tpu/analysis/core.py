@@ -49,7 +49,12 @@ from frankenpaxos_tpu.analysis import astutil
 # equation) and trace-elastic-retrace (role-count resizes ride the
 # traced membership scalars, so every autoscaler scale-up/down
 # replays ONE compiled program; the jit cache stays flat).
-ANALYSIS_VERSION = "2.2"
+# 2.3: the dependency-graph gates — depgraph-containment (packed
+# adjacency bit twiddling stays inside ops/depgraph.py; consumers go
+# through its helpers or jnp.where writes) and the backend-inventory
+# floor rises to 15 with the bpaxos backend (the depgraph_execute
+# plane's home).
+ANALYSIS_VERSION = "2.3"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"
@@ -95,7 +100,7 @@ class Context:
     # match rules_trace.BACKENDS.
     backends: Optional[Sequence[str]] = None
     # Floor the backend-inventory rule enforces; fixture trees override.
-    min_backends: int = 14
+    min_backends: int = 15
     # Fixture trees are not importable packages: rules that must import
     # repo modules (kernel registry introspection) skip when False.
     importable: bool = True

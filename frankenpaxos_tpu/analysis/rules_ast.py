@@ -1210,6 +1210,74 @@ def check_packing_containment(ctx: Context) -> List[Finding]:
     return out
 
 
+# Packed dependency-graph planes (ops/depgraph.py adjacency layout):
+# [V, ceil(V/32)] uint32 rows, little-endian lanes — the layout the
+# kernel/reference/oracle bit-identity tests certify.
+_DEPGRAPH_ATTRS = frozenset({"adj"})
+
+
+@rule(
+    "depgraph-containment",
+    "ast",
+    "raw bit-twiddling on the packed dependency-graph adjacency "
+    "(State.adj) lives only in ops/depgraph.py — consumers route "
+    "through its pack/clear/subset helpers",
+)
+def check_depgraph_containment(ctx: Context) -> List[Finding]:
+    """The packed adjacency is an opaque word array outside
+    ops/depgraph.py: shifting or masking ``<x>.adj`` inline
+    re-implements the bitmask layout (lane order, padding-word
+    hygiene) and silently diverges from the closure the
+    kernel-vs-oracle tests certify. Same operand discipline as
+    packing-containment: only a DIRECT ``.adj`` operand (modulo
+    subscripting) of a bitwise op counts — comparisons against it and
+    helper calls over it are reads of the opaque value, and local
+    word arrays a helper returned are the helper's business."""
+
+    def _adj_operand(expr: ast.expr) -> bool:
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        return (
+            isinstance(expr, ast.Attribute)
+            and expr.attr in _DEPGRAPH_ATTRS
+        )
+
+    out: List[Finding] = []
+    for path in astutil.py_files(ctx.root):
+        rel = path.relative_to(ctx.root)
+        if rel.parts[-1] == "depgraph.py":
+            continue
+        tree = astutil.parse_file(path)
+        hits: List[int] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, _BIT_OPS):
+                operands = (node.left, node.right)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.op, _BIT_OPS
+            ):
+                operands = (node.target, node.value)
+            else:
+                continue
+            if any(_adj_operand(op) for op in operands):
+                hits.append(node.lineno)
+        if hits:
+            out.append(
+                Finding(
+                    rule="depgraph-containment",
+                    path=_rel(ctx, path),
+                    line=hits[0],
+                    message=(
+                        f"bitwise op on the packed adjacency at line(s) "
+                        f"{hits} — use the ops/depgraph.py helpers "
+                        "(pack_mask/unpack_mask/clear_vertices/"
+                        "rows_subset)"
+                    ),
+                    key=str(rel),
+                )
+            )
+    return out
+
+
 @rule(
     "costmodel-coverage",
     "ast",

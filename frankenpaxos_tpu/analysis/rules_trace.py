@@ -62,6 +62,7 @@ from frankenpaxos_tpu.analysis.core import Context, Finding, rule
 # analysis_config(); adding a backend here (and its analysis_config)
 # is the entire integration cost.
 BACKENDS = (
+    "bpaxos",
     "caspaxos",
     "compartmentalized",
     "craq",

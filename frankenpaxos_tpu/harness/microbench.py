@@ -40,9 +40,30 @@ def _report(name: str, case: str, ops: int, seconds: float) -> dict:
     return row
 
 
-def bench_depgraph(num_commands: int = 5_000, num_leaders: int = 5) -> List[dict]:
+def bench_depgraph(
+    num_commands: int = 5_000,
+    num_leaders: int = 5,
+    batch: int = 208,
+    window: int = 64,
+    rounds: int = 3,
+    closure_iters: int = 25,
+) -> List[dict]:
     """Commit+execute through every dependency-graph variant on the same
-    EPaxos-shaped workload (DependencyGraphBench.scala)."""
+    EPaxos-shaped workload (DependencyGraphBench.scala), then race the
+    device-side ``depgraph_execute`` plane against its host twin:
+
+    - ``bitmask_closure``: the jitted pure-jnp reference (log-depth
+      matmul doubling over the whole [batch, window] brick at once),
+    - ``pointer_walk``: ``ops.depgraph.oracle_execute`` — the
+      sequential iterative-Tarjan pointer walk, one vertex at a time,
+      one graph at a time (TarjanDependencyGraph.scala's control flow).
+
+    Both sides consume the SAME random windowed graphs and their
+    outputs are asserted bit-identical before any clock starts; the
+    timed segments interleave across the two sides with
+    best-of-``rounds`` kept, so neither wins by machine drift. Ops are
+    graphs executed, so the two rows' ops/sec ratio IS the
+    batched-closure speedup (bench.py --depgraph records it)."""
     from frankenpaxos_tpu.depgraph import (
         IncrementalTarjanDependencyGraph,
         NaiveDependencyGraph,
@@ -98,6 +119,64 @@ def bench_depgraph(num_commands: int = 5_000, num_leaders: int = 5) -> List[dict
             return executed
 
         ops, seconds = _timed(run)
+        rows.append(_report("depgraph", case, ops, seconds))
+
+    # ---- Batched bitmask closure vs sequential pointer walk.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frankenpaxos_tpu.ops import depgraph as dg
+
+    np_rng = np.random.RandomState(0)
+    bits = np_rng.random_sample((batch, window, window)) < 0.06
+    adj = np.asarray(dg.pack_mask(jnp.asarray(bits)))
+    committed = np_rng.random_sample((batch, window)) < 0.5
+    active = np_rng.random_sample((batch, window)) < 0.8
+
+    ref = jax.jit(dg.reference_depgraph_execute)
+    adj_j = jnp.asarray(adj)
+    com_j = jnp.asarray(committed)
+    act_j = jnp.asarray(active)
+    got = jax.block_until_ready(ref(adj_j, com_j, act_j))  # compile
+    got = tuple(np.asarray(x) for x in got)
+    # Bit-identity gate: the throughput ratio below is meaningless
+    # unless both sides compute EXACTLY the same answer.
+    want = [
+        dg.oracle_execute(adj[b], committed[b], active[b])
+        for b in range(batch)
+    ]
+    for i, field in enumerate(("eligible", "order", "scc_root")):
+        w = np.stack([np.asarray(x[i]) for x in want])
+        assert np.array_equal(got[i], w.astype(got[i].dtype)), (
+            f"bitmask closure != pointer walk on {field}"
+        )
+
+    best = {"bitmask_closure": None, "pointer_walk": None}
+    for _ in range(rounds):
+
+        def run_closure() -> int:
+            out = None
+            for _ in range(closure_iters):
+                out = ref(adj_j, com_j, act_j)
+            jax.block_until_ready(out)
+            return closure_iters * batch
+
+        def run_walk() -> int:
+            for b in range(batch):
+                dg.oracle_execute(adj[b], committed[b], active[b])
+            return batch
+
+        for case, run in (
+            ("bitmask_closure", run_closure),
+            ("pointer_walk", run_walk),
+        ):
+            ops, seconds = _timed(run)
+            prev = best[case]
+            if prev is None or seconds / ops < prev[1] / prev[0]:
+                best[case] = (ops, seconds)
+    for case in ("bitmask_closure", "pointer_walk"):
+        ops, seconds = best[case]
         rows.append(_report("depgraph", case, ops, seconds))
     return rows
 
@@ -1041,6 +1120,25 @@ def _kernel_cases(A=3, G=3334, W=64, N=3334, L=3, KV=16, CW=16, seed=0):
             t,
         ),
         dict(retry_timeout=8),
+    )
+
+    # ---- Dependency-graph execution plane, [B, V, VW] windowed graph
+    # views (B batched graphs, V = W window vertices). Sparse random
+    # digraphs (avg out-degree ~4) so the closure sees real SCC
+    # structure rather than one giant component; at the default sizes
+    # the key (max(8, G // 16), W, ceil(W/32)) = (208, 64, 2) is
+    # exactly CAPTURE_KEYS["depgraph_execute"] in ops/costmodel.py.
+    from frankenpaxos_tpu.ops import depgraph as _dg
+
+    Bd = max(8, G // 16)
+    dg_bits = jax.random.uniform(nxt(), (Bd, W, W)) < 0.06
+    cases["depgraph_execute"] = (
+        (
+            _dg.pack_mask(dg_bits),
+            jax.random.uniform(nxt(), (Bd, W)) < 0.5,  # committed
+            jax.random.uniform(nxt(), (Bd, W)) < 0.8,  # active
+        ),
+        {},
     )
     return cases
 

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from frankenpaxos_tpu.tpu import (
+    bpaxos_batched,
     caspaxos_batched,
     compartmentalized_batched,
     craq_batched,
@@ -106,6 +107,11 @@ class SimSpec:
     # The backend's analysis config has a device read path, so
     # random_workload may draw a read/write mix for it.
     read_mix_ok: bool = False
+    # The backend consumes the traced conflict-density knob
+    # (WorkloadPlan.conflict_rate -> WorkloadState.conflict), so
+    # random_workload may draw a conflict rate — the [faults x
+    # conflict] joint axis of the dependency-graph backends.
+    conflict_ok: bool = False
     # The backend threads the production-lifecycle subsystem
     # (tpu/lifecycle.py), so the reconfiguration-epoch axis
     # (run_reconfig_schedule / random_lifecycle) applies.
@@ -218,6 +224,37 @@ def _specs() -> Dict[str, SimSpec]:
             lambda st: st.committed_total, partition_axis=5,
             # frontier_history=256, lat_max=3: span + 24 < 256.
             max_partition_span=200,
+            conflict_ok=True,
+        ),
+        SimSpec(
+            # TRUE EPaxos execution: the factored snapshot MATERIALIZED
+            # into the packed adjacency and executed through the
+            # depgraph_execute plane (general_deps=True). Same shape
+            # and liveness envelope as "epaxos"; the dep_safety_ok
+            # invariant (no instance executes before its committed
+            # dependencies, checked against the live bitmask) joins
+            # every boundary check.
+            "epaxos_dg", ep,
+            ep.analysis_config_general,
+            lambda st: st.committed_total, partition_axis=5,
+            max_partition_span=200,
+            conflict_ok=True,
+        ),
+        SimSpec(
+            # Leaderless BPaxos over the explicit dependency-graph
+            # plane: a cut leader lane's consensus rounds defer to the
+            # heal tick, and every dependency chain through its
+            # vertices stalls with it — liveness resumes after heal
+            # once the deferred commits land and the closure drains.
+            # dep_safety_ok asserts per-replica execution order against
+            # the live adjacency at every segment boundary; the traced
+            # conflict knob (conflict_ok) randomizes graph density per
+            # schedule without recompiling.
+            "bpaxos", bpaxos_batched,
+            bpaxos_batched.analysis_config,
+            lambda st: st.committed_total, partition_axis=3,
+            crash_ok=False,
+            conflict_ok=True,
         ),
         SimSpec(
             "scalog", sc,
@@ -328,7 +365,21 @@ def random_workload(
     pure closed loop, else an open-loop arrival process with optional
     Zipf skew, closed window, and (where the backend has a read path)
     a read/write mix. Rates are sized for the SMALL analysis configs
-    (1-3 proposals per lane per tick)."""
+    (1-3 proposals per lane per tick). Dependency-graph backends
+    (``conflict_ok``) additionally draw a traced conflict density most
+    of the time — the [faults x conflict-rate] joint axis — riding the
+    same plan (one compile per schedule shape, the rate is state)."""
+    plan = _random_workload_shape(rng, spec, horizon)
+    if spec.conflict_ok and rng.random() < 0.65:
+        plan = dataclasses.replace(
+            plan, conflict_rate=round(rng.uniform(0.0, 0.9), 3)
+        )
+    return plan
+
+
+def _random_workload_shape(
+    rng: _random.Random, spec: SimSpec, horizon: int
+) -> WorkloadPlan:
     r = rng.random()
     if r < 0.30:
         return WorkloadPlan.none()

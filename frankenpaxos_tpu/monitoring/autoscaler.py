@@ -26,7 +26,12 @@ commands/sec ceiling is lowest — that is the one worth growing (HT-
 Paxos, arXiv 1407.1237: the batching/dissemination roles saturate
 first, so adding acceptors to a batcher-bound deployment buys
 nothing). The same ceilings rank shrink candidates in reverse: the
-trough releases the MOST over-provisioned role first.
+trough releases the MOST over-provisioned role first. The stride is
+CONFIDENCE-WEIGHTED: ``costmodel.envelope_confidence`` condenses the
+committed capture record's measured/predicted envelope spread into
+[0, 1], and the scale-up step is ``max_step`` scaled by it (floored
+at ``step``) — a model with a tight envelope earns multi-instance
+strides, a drifting one is trusted for single probes only.
 
 Everything here is pure host arithmetic over the per-drain SLO status
 dicts — the engine never touches the device. The serve loop applies
@@ -80,6 +85,16 @@ class AutoscalerPolicy:
     # ladder climbs one instance at a time so each drain measures one
     # increment's effect).
     step: int = 1
+    # Confidence-weighted scale-UP stride ceiling: when the cost
+    # model's capture record proves its predictions tight
+    # (costmodel.envelope_confidence ~1.0), the ladder trusts the
+    # feedforward bottleneck pick enough to climb
+    # ``round(max_step * confidence)`` instances per action instead of
+    # probing one at a time; a wide envelope spread (or no capture
+    # evidence) decays the stride back to ``step``. Scale-DOWN always
+    # gives capacity back one ``step`` at a time — shedding on a
+    # model's word is how the next burst finds the fleet short.
+    max_step: int = 1
     # Elastic role -> cost-model role for the capacity feedforward
     # (tuple-of-pairs so the policy stays hashable).
     role_map: Tuple[Tuple[str, str], ...] = DEFAULT_ROLE_MAP
@@ -89,6 +104,7 @@ class AutoscalerPolicy:
         assert self.trough_after >= 1
         assert 0.0 < self.trough_frac <= 1.0
         assert self.step >= 1
+        assert self.max_step >= self.step
         seen = set()
         for role, cm in self.role_map:
             assert role not in seen, f"duplicate role_map entry {role!r}"
@@ -103,6 +119,7 @@ class AutoscalerPolicy:
             "trough_after": self.trough_after,
             "trough_frac": self.trough_frac,
             "step": self.step,
+            "max_step": self.max_step,
             "role_map": [list(p) for p in self.role_map],
         }
 
@@ -131,9 +148,18 @@ class Autoscaler:
         policy: AutoscalerPolicy,
         roles: Dict[str, Tuple[int, int]],  # role -> (capacity, floor)
         initial: Optional[Dict[str, int]] = None,
+        envelope: Optional[dict] = None,
     ):
         assert roles, "an autoscaler needs at least one elastic role"
         self.policy = policy
+        # Confidence weighting for the scale-up stride: the envelope
+        # spread of the committed costmodel capture record (pass a
+        # parsed costmodel_envelope.json payload, or None to read the
+        # committed one). Construction-time config, like the policy —
+        # a checkpoint-resumed twin re-derives it from the same file.
+        self.feedforward_confidence = costmodel.envelope_confidence(
+            envelope
+        )
         rm = dict(policy.role_map)
         for r, (cap, floor) in roles.items():
             assert r in rm, f"no role_map entry for elastic role {r!r}"
@@ -180,7 +206,19 @@ class Autoscaler:
             # one backend, but be safe: capacity() keys by cost-model
             # role, so a collision sums the counts.
             counts[rm[r]] = counts.get(rm[r], 0) + n
-        return costmodel.capacity(counts)
+        out = costmodel.capacity(counts)
+        out["envelope_confidence"] = dict(self.feedforward_confidence)
+        out["up_step"] = self._up_step()
+        return out
+
+    def _up_step(self) -> int:
+        """The confidence-weighted scale-up stride: ``max_step``
+        scaled by how tightly the model's capture record tracks
+        measurement, never below the base ``step``."""
+        conf = self.feedforward_confidence["confidence"]
+        return max(
+            self.policy.step, int(round(self.policy.max_step * conf))
+        )
 
     def _pick_grow(self) -> Optional[str]:
         """The bottleneck role that still has padded headroom (lowest
@@ -240,7 +278,7 @@ class Autoscaler:
             if role is not None:
                 cap, _ = self.roles[role]
                 frm = self.targets[role]
-                to = min(cap, frm + pol.step)
+                to = min(cap, frm + self._up_step())
                 self.targets[role] = to
                 self._last_action_drain = self.drains
                 self.scale_up_events += 1

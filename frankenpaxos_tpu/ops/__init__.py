@@ -30,6 +30,13 @@ kernel SUITE with a dispatch registry:
   * :mod:`frankenpaxos_tpu.ops.craq` — ``craq_chain`` (chain
     propagate/ack with scatter-free pending-set accounting; partitioned
     plans defer cut hops to the heal tick in-kernel).
+  * :mod:`frankenpaxos_tpu.ops.depgraph` — ``depgraph_execute`` (the
+    bounded-window dependency-graph executor: packed-bitmask transitive
+    closure by log-depth matrix doubling, SCC condensation, eligibility,
+    deterministic batch execution order — the device-side replacement
+    for pointer-chasing Tarjan execution, batched over per-replica
+    graph views; plus the packed-adjacency helpers and the host
+    pointer-walk oracle twin).
   * :mod:`frankenpaxos_tpu.ops.costmodel` — the analytical roofline
     cost model over every plane above (stated bytes-moved + FLOP terms
     per autotune key, CPU/TPU parameter sets): predicted time feeds
@@ -97,4 +104,8 @@ from frankenpaxos_tpu.ops.craq import (  # noqa: F401
 from frankenpaxos_tpu.ops.compartmentalized import (  # noqa: F401
     fused_grid_vote,
     reference_grid_vote,
+)
+from frankenpaxos_tpu.ops.depgraph import (  # noqa: F401
+    fused_depgraph_execute,
+    reference_depgraph_execute,
 )

@@ -525,6 +525,24 @@ def _grid_vote_out(key):
     ]
 
 
+def _depgraph_execute(key):
+    B, V, VW = key
+    return [
+        ((B, V, VW), "uint32"),
+        ((B, V), "bool"),
+        ((B, V), "bool"),
+    ]
+
+
+def _depgraph_execute_out(key):
+    B, V, VW = key
+    return [
+        ((B, V), "bool"),
+        ((B, V), "int32"),
+        ((B, V), "int32"),
+    ]
+
+
 MODELS: Dict[str, PlaneModel] = {}
 
 
@@ -588,6 +606,23 @@ _model(PlaneModel(
     batch_axis=0,
     note="chain propagation + version-vector apply over the write "
          "ring [N, CW] and kv log [N, L*KV] columns",
+))
+_model(PlaneModel(
+    "depgraph_execute", _depgraph_execute, _depgraph_execute_out,
+    # The closure dominates: ceil(log2(Vp)) boolean matmul squarings
+    # over the [Vp, Vp] reachability matrix (Vp = 32*VW padded
+    # vertices) per batch row — a cell here is one multiply-add LANE of
+    # one squaring (Vp^3 lanes per matmul), so flops_per_cell is the
+    # mul+add pair. The SCC/order epilogue is O(Vp^2) — inside the
+    # matmul term's margin.
+    cells=lambda k: (
+        k[0] * (32 * k[2]) ** 3 * max(1, (32 * k[2] - 1).bit_length())
+    ),
+    flops_per_cell=2,
+    batch_axis=0,
+    note="log-depth bitmask transitive closure: ceil(log2(Vp)) f32 "
+         "matmul squarings of the [Vp, Vp] reachability seed + SCC "
+         "root/order epilogue, batched over graph views",
 ))
 _model(PlaneModel(
     "compartmentalized_grid_vote", _grid_vote, _grid_vote_out,
@@ -822,6 +857,7 @@ CAPTURE_KEYS: Dict[str, Tuple[int, ...]] = {
     "mencius_vote": (3334, 64, 3),
     "craq_chain": (3334, 48, 16),
     "compartmentalized_grid_vote": (2, 2, 3334, 64),
+    "depgraph_execute": (208, 64, 2),
 }
 
 
@@ -909,6 +945,55 @@ def drift_findings(
                     )
             prev[plane] = (label, ratio)
     return out
+
+
+def envelope_confidence(payload: Optional[dict] = None) -> dict:
+    """Confidence in the model's capacity feedforward, derived from
+    the ENVELOPE SPREAD of the committed capture verdicts: the ratio
+    between the widest and tightest measured/predicted ratio across
+    every capture row in ``results/costmodel_envelope.json`` (or a
+    payload passed in directly). A model whose predictions track the
+    measurements inside a narrow band earns confidence ~1.0; a wide
+    spread decays it as ``1/spread``; no capture evidence at all is
+    0.0 — consumers (``monitoring/autoscaler.py`` weighting its
+    scale-up stride) then fall back to their conservative
+    one-increment behaviour. Returns ``{samples, spread, confidence,
+    source}``."""
+    import json
+    import pathlib
+
+    source = "payload"
+    if payload is None:
+        path = (
+            pathlib.Path(__file__).resolve().parents[2]
+            / "results"
+            / "costmodel_envelope.json"
+        )
+        source = path.name
+        try:
+            payload = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            payload = {}
+    ratios = [
+        row["ratio"]
+        for rows in payload.get("captures", {}).values()
+        for row in rows
+        if row.get("ratio")
+    ]
+    if not ratios:
+        return {
+            "samples": 0,
+            "spread": None,
+            "confidence": 0.0,
+            "source": source,
+        }
+    spread = max(ratios) / min(ratios)
+    return {
+        "samples": len(ratios),
+        "spread": round(spread, 4),
+        "confidence": round(min(1.0, 1.0 / spread), 4),
+        "source": source,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,473 @@
+"""Bounded-window dependency-graph execution as one XLA-native plane.
+
+The reference executes committed commands through a dependency graph by
+POINTER CHASING: ``depgraph/TarjanDependencyGraph.scala`` walks
+vertices one at a time, pushes Tarjan frames, pops strongly-connected
+components, and appends them in reverse topological order. That shape
+is hostile to an accelerator — serial, branchy, allocation-heavy — and
+it is why ``tpu/epaxos_batched.py`` only ever supported FACTORED
+dependency vectors (per-column watermarks): arbitrary dependency sets
+and SCC cycles had no device-side path at all.
+
+``depgraph_execute`` is that path. The per-replica graph over a bounded
+instance window of ``V`` vertices is a ``[V, ceil(V/32)]`` uint32
+adjacency bitmask (bit ``j`` of row ``i`` = instance ``i`` depends on
+instance ``j``; vertex -> word ``j // 32``, lane ``j % 32`` — the same
+little-endian packing as ``tpu/packing.py``). One call computes, for a
+batch of ``B`` graphs at once:
+
+  * **transitive closure** by iterated masked AND/OR matrix squaring:
+    ``R <- R | R@R`` on the active subgraph, ``ceil(log2(V))`` times
+    (log-depth doubling — no pointer chasing, every step one
+    MXU-shaped 0/1 matmul);
+  * **eligibility**: a vertex executes iff every vertex in its closure
+    is committed — exactly the ELIGIBLE set of
+    ``DependencyGraph.scala``, cycles included (an SCC's members share
+    a closure, so they become eligible together);
+  * **SCC condensation**: ``scc_root`` = the smallest vertex id
+    mutually reachable with each vertex (``R & R^T``) — members of a
+    component agree on the root, which is how consumers count
+    co-executed components without a Tarjan stack;
+  * **deterministic batch order**: eligible vertices are ranked by
+    ``(closure size, vertex id)``. A dependency's closure is a strict
+    subset of its dependents' closures, so dependencies always rank
+    first; SCC members (equal closures) order by id. The rank is a
+    dense ``order`` permutation — the execution schedule.
+
+Eligible-set closure property (what makes the order safe): if ``v`` is
+eligible, every vertex in ``closure(v)`` is also eligible — its own
+closure is a subset, so the all-committed test it passed is inherited.
+
+All arithmetic is exact: the 0/1 closure matmuls run in float32 (counts
+bounded by ``V <= 2**24``), every comparison is integral, so the Pallas
+kernel and the pure-jnp reference are bit-identical by construction
+(pinned 3-seed in ``tests/test_kernel_registry.py``).
+
+The module also owns every helper that touches packed adjacency words —
+the ``depgraph-containment`` analysis rule keeps bitmask twiddling on
+``.adj`` planes inside this file, exactly like ``packing-containment``
+does for ``tpu/packing.py``:
+
+  * :func:`pack_mask` / :func:`clear_vertices` / :func:`rows_subset` —
+    build, retire (row AND column clears — a freed ring slot must not
+    leave stale dependency bits pointing at its future tenant), and
+    audit adjacency rows;
+  * :func:`bernoulli_words_k16` — the bit-sliced Bernoulli sampler of
+    ``epaxos_batched`` generalized to a TRACED ``k/16`` rate, so the
+    workload engine's ``conflict_rate`` knob sweeps conflict density
+    without retracing (one compile for the whole [conflict x load]
+    surface);
+  * :func:`oracle_execute` — the host-side sequential pointer-walk
+    twin (iterative Tarjan + condensation reach sets), the equivalence
+    oracle for tests AND the baseline the ``depgraph`` microbench
+    times the batched closure against.
+
+Consumers: ``tpu/bpaxos_batched.py`` (the Bipartisan Paxos backend —
+leaderless proposers whose consensus-chosen dependency sets form
+exactly these graphs) and ``tpu/epaxos_batched.py`` under
+``general_deps=True`` (factored snapshots materialized as adjacency
+rows and executed through the same plane).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.ops import registry
+from frankenpaxos_tpu.ops.blocks import balanced_block, pad_axis
+
+_LANES = 32
+
+
+def num_words(n: int) -> int:
+    """Packed uint32 words covering ``n`` vertices."""
+    return -(-n // _LANES)
+
+
+# ---------------------------------------------------------------------------
+# Packed-word helpers (the only legal home for .adj bit twiddling)
+# ---------------------------------------------------------------------------
+
+
+def pack_mask(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., n] bool -> [..., ceil(n/32)] uint32 (vertex v -> word
+    v // 32, lane v % 32)."""
+    n = b.shape[-1]
+    nw = num_words(n)
+    pad = nw * _LANES - n
+    if pad:
+        b = jnp.concatenate(
+            [b, jnp.zeros(b.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    lanes = jnp.uint32(1) << jnp.arange(_LANES, dtype=jnp.uint32)
+    words = b.reshape(b.shape[:-1] + (nw, _LANES))
+    return jnp.sum(words.astype(jnp.uint32) * lanes, axis=-1)
+
+
+def unpack_mask(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[..., ceil(n/32)] uint32 -> [..., n] bool — pack_mask's inverse
+    (consumers turn packed visibility words back into per-vertex flags
+    without doing their own lane arithmetic)."""
+    vw = words.shape[-1]
+    assert vw * _LANES >= n
+    bits = _unpack_bits(words[..., None, :])[..., 0, :n]
+    return bits.astype(bool)
+
+
+def clear_vertices(adj: jnp.ndarray, vmask: jnp.ndarray) -> jnp.ndarray:
+    """Retire vertices from a graph: zero their ROWS (the retired
+    instance's own dependencies) and their COLUMNS (every other row's
+    edges onto them). The column clear is what makes ring-slot reuse
+    safe — a stale bit would otherwise point at the slot's next tenant
+    and fabricate a dependency on a future instance.
+
+    ``adj``: [..., V, VW] uint32; ``vmask``: [..., V] bool."""
+    words = pack_mask(vmask)  # [..., VW]
+    rows_cleared = jnp.where(vmask[..., :, None], jnp.uint32(0), adj)
+    return rows_cleared & ~words[..., None, :]
+
+
+def rows_subset(adj: jnp.ndarray, allowed: jnp.ndarray) -> jnp.ndarray:
+    """[..., V] bool: every dependency bit of each row lies inside the
+    ``allowed`` packed word mask ([..., VW]) — the dep-graph safety
+    audit (an executed instance's deps must all be executed or
+    retired)."""
+    return jnp.all(
+        (adj & ~allowed[..., None, :]) == jnp.uint32(0), axis=-1
+    )
+
+
+def bernoulli_words_k16(
+    key: jnp.ndarray, k16: jnp.ndarray, shape: Tuple[int, ...]
+) -> jnp.ndarray:
+    """Per-BIT Bernoulli(k16/16) over packed uint32 words, with a
+    TRACED rate: ``k16`` is an int32 scalar in [0, 16] (the workload
+    engine's conflict knob quantized to 16ths). A bit-sliced 4-bit
+    comparator — each of 4 random planes is one bit of a per-lane
+    4-bit value; the lane sets iff value < k16 — so one sweep of 4
+    words replaces 32 uniform draws, and the data-dependent rate costs
+    four selects instead of a retrace (``epaxos_batched`` keeps the
+    static-rate variant; this one rides ``WorkloadState``)."""
+    k16 = jnp.asarray(k16, jnp.int32)
+    planes = jax.random.bits(key, (4,) + tuple(shape))  # uint32
+    lt = jnp.zeros(shape, jnp.uint32)
+    eq = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+    for i in (3, 2, 1, 0):  # MSB -> LSB of the 4-bit value
+        b = planes[i]
+        take = ((k16 >> i) & 1) == 1
+        lt = jnp.where(take, lt | (eq & ~b), lt)
+        eq = jnp.where(take, eq & b, eq & ~b)
+    full = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+    return jnp.where(k16 >= 16, full, lt)
+
+
+# ---------------------------------------------------------------------------
+# The execute pass (shared math: reference and kernel trace this code)
+# ---------------------------------------------------------------------------
+
+
+def _unpack_bits(adj: jnp.ndarray) -> jnp.ndarray:
+    """[..., V, VW] uint32 -> [..., V, VW*32] int32 0/1 bits."""
+    vw = adj.shape[-1]
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (vw, _LANES), 1)
+    bits = (adj[..., :, :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(adj.shape[:-1] + (vw * _LANES,)).astype(jnp.int32)
+
+
+def _pad_tail(x: jnp.ndarray, axis: int, pad: int) -> jnp.ndarray:
+    if not pad:
+        return x
+    shape = list(x.shape)
+    shape[axis] = pad
+    return jnp.concatenate(
+        [x, jnp.zeros(shape, x.dtype)], axis=axis
+    )
+
+
+def _execute_math(bits, com, act):
+    """The whole pass on an UNPACKED padded square graph. ``bits``:
+    [..., Vp, Vp] int32 0/1 (Vp = VW*32); ``com`` / ``act``: [..., Vp]
+    int32 0/1. Returns (eligible int32 0/1, order int32, scc_root
+    int32), each [..., Vp]. Exact arithmetic only — float32 carries 0/1
+    values and counts bounded by Vp, so every compare is integral and
+    the result is schedule-independent (kernel == reference bitwise)."""
+    vp = bits.shape[-1]
+    # 2**steps >= longest simple path (<= Vp - 1 edges).
+    steps = max(1, int(vp - 1).bit_length()) if vp > 1 else 1
+    act_f = act.astype(jnp.float32)
+    rid = jax.lax.broadcasted_iota(jnp.int32, (vp, vp), 0)
+    cid = jax.lax.broadcasted_iota(jnp.int32, (vp, vp), 1)
+    eye = (rid == cid).astype(jnp.float32)
+    # Edges restricted to the active subgraph: a dependency on an
+    # inactive vertex (executed / retired / empty) is satisfied, and
+    # closure never flows THROUGH an inactive vertex either — its
+    # transitive deps were satisfied before it executed.
+    m = bits.astype(jnp.float32) * act_f[..., None, :] * act_f[..., :, None]
+    r = jnp.minimum(m + eye, 1.0)  # reflexive closure seed
+    for _ in range(steps):  # log-depth doubling: R <- R | R@R
+        r = jnp.minimum(
+            r + jnp.matmul(r, r, preferred_element_type=jnp.float32), 1.0
+        )
+    # Eligible: active, and NO vertex in the closure is an active
+    # uncommitted one (the closure includes self, so own commitment is
+    # part of the same test).
+    uncommitted = act_f * (1.0 - com.astype(jnp.float32))
+    bad = jnp.sum(r * uncommitted[..., None, :], axis=-1) > 0.0
+    eligible = (act == 1) & ~bad
+    # Closure size (incl. self): strict-subset ordering across SCCs.
+    n = jnp.sum(r, axis=-1).astype(jnp.int32)
+    # SCC root: least id with MUTUAL reachability (diagonal is always
+    # mutual, so root <= id; equal roots <=> same component).
+    mutual = (r * jnp.swapaxes(r, -1, -2)) > 0.0
+    root = jnp.min(jnp.where(mutual, cid, vp), axis=-1)
+    root = jnp.where(act == 1, root, -1)
+    # Dense rank of eligible vertices by (closure size, id): deps rank
+    # strictly before dependents, SCC members tie-break by id.
+    n_i = n[..., :, None]
+    n_k = n[..., None, :]
+    less = (n_k < n_i) | ((n_k == n_i) & (cid < rid))
+    rank = jnp.sum(
+        (less & eligible[..., None, :]).astype(jnp.int32), axis=-1
+    )
+    order = jnp.where(eligible, rank, -1)
+    return eligible.astype(jnp.int32), order, root
+
+
+def _execute_padded(adj, com, act):
+    """Unpack + pad to the word-aligned square and run the pass.
+    ``adj``: [..., V, VW] uint32; ``com`` / ``act``: [..., V] int32.
+    Outputs sliced back to V. Lanes >= V are forced inactive, so
+    garbage bits in the padding lanes of ``adj`` cannot influence the
+    result (the padding-edge contract ``tests/test_ops.py`` pins)."""
+    v = adj.shape[-2]
+    vp = adj.shape[-1] * _LANES
+    bits = _pad_tail(_unpack_bits(adj), adj.ndim - 2, vp - v)
+    comp = _pad_tail(com, com.ndim - 1, vp - v)
+    actp = _pad_tail(act, act.ndim - 1, vp - v)
+    elig, order, root = _execute_math(bits, comp, actp)
+    return elig[..., :v], order[..., :v], root[..., :v]
+
+
+def reference_depgraph_execute(
+    adj: jnp.ndarray,  # [B, V, VW] uint32 packed adjacency
+    committed: jnp.ndarray,  # [B, V] bool
+    active: jnp.ndarray,  # [B, V] bool
+):
+    """Pure-jnp twin. Returns ``(eligible [B, V] bool, order [B, V]
+    int32 — dense execution rank, -1 for non-eligible, scc_root [B, V]
+    int32 — least mutual-reach id, -1 for inactive)``."""
+    elig, order, root = _execute_padded(
+        adj, committed.astype(jnp.int32), active.astype(jnp.int32)
+    )
+    return elig.astype(bool), order, root
+
+
+def _depgraph_kernel_factory(V, VW):
+    def kernel(adj_ref, com_ref, act_ref, out_e, out_o, out_r):
+        elig, order, root = _execute_padded(
+            adj_ref[...], com_ref[...], act_ref[...]
+        )
+        out_e[...] = elig
+        out_o[...] = order
+        out_r[...] = root
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_depgraph_execute(
+    adj,
+    committed,
+    active,
+    block: int = 8,
+    interpret: bool = False,
+):
+    """Fused :func:`reference_depgraph_execute`: the batch axis grids
+    over blocks of whole graphs (each step keeps one block's [V, Vp]
+    closure VMEM-resident; the doubling matmuls are the MXU shape the
+    plane exists for)."""
+    from jax.experimental import pallas as pl
+
+    B, V, VW = adj.shape
+    bs, pad = balanced_block(B, block)
+    com = committed.astype(jnp.int32)
+    act = active.astype(jnp.int32)
+    if pad:
+        adj = pad_axis(adj, 0, pad)
+        com = pad_axis(com, 0, pad)
+        act = pad_axis(act, 0, pad)
+    Bp = B + pad
+    spec3 = pl.BlockSpec((bs, V, VW), lambda i: (i, 0, 0))
+    spec2 = pl.BlockSpec((bs, V), lambda i: (i, 0))
+    grid_spec = pl.GridSpec(
+        grid=(Bp // bs,),
+        in_specs=[spec3, spec2, spec2],
+        out_specs=[spec2, spec2, spec2],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((Bp, V), jnp.int32),
+        jax.ShapeDtypeStruct((Bp, V), jnp.int32),
+        jax.ShapeDtypeStruct((Bp, V), jnp.int32),
+    ]
+    elig, order, root = pl.pallas_call(
+        _depgraph_kernel_factory(V, VW),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(adj, com, act)
+    if pad:
+        elig, order, root = elig[:B], order[:B], root[:B]
+    return elig.astype(bool), order, root
+
+
+# ---------------------------------------------------------------------------
+# Host-side sequential pointer-walk twin (oracle + microbench baseline)
+# ---------------------------------------------------------------------------
+
+
+def oracle_execute(adj, committed, active):
+    """The reference semantics by SEQUENTIAL POINTER WALK — an
+    iterative Tarjan over the active subgraph plus condensation reach
+    sets, one vertex at a time, exactly the control flow of
+    ``TarjanDependencyGraph.scala``. Host-only (numpy/python ints).
+
+    Single graph: ``adj`` [V, VW] uint32, ``committed`` / ``active``
+    [V] bool. Returns ``(eligible, order, scc_root)`` as numpy arrays
+    with EXACTLY the plane's values — the equivalence oracle for
+    ``tests/test_ops.py`` and the baseline the ``depgraph`` microbench
+    times the batched closure against."""
+    import numpy as np
+
+    adj = np.asarray(adj, dtype=np.uint32)
+    committed = np.asarray(committed, dtype=bool)
+    active = np.asarray(active, dtype=bool)
+    V = adj.shape[0]
+
+    # Dependency sets as python int bitmasks, restricted to active.
+    act_int = 0
+    for v in range(V):
+        if active[v]:
+            act_int |= 1 << v
+    deps = []
+    for v in range(V):
+        row = 0
+        for w in range(adj.shape[1]):
+            row |= int(adj[v, w]) << (w * _LANES)
+        row &= (1 << V) - 1
+        deps.append(row & act_int if active[v] else 0)
+
+    # Iterative Tarjan over active vertices.
+    index = [-1] * V
+    lowlink = [0] * V
+    on_stack = [False] * V
+    stack: list = []
+    comp_of = [-1] * V
+    comps: list = []  # per component: member bitmask (pop order =
+    # reverse topological: successors pop first)
+    counter = 0
+    for start in range(V):
+        if not active[start] or index[start] >= 0:
+            continue
+        work = [(start, iter_bits(deps[start]))]
+        index[start] = lowlink[start] = counter
+        counter += 1
+        stack.append(start)
+        on_stack[start] = True
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for u in it:
+                if index[u] < 0:
+                    index[u] = lowlink[u] = counter
+                    counter += 1
+                    stack.append(u)
+                    on_stack[u] = True
+                    work.append((u, iter_bits(deps[u])))
+                    advanced = True
+                    break
+                if on_stack[u]:
+                    lowlink[v] = min(lowlink[v], index[u])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[v])
+            if lowlink[v] == index[v]:
+                members = 0
+                while True:
+                    u = stack.pop()
+                    on_stack[u] = False
+                    comp_of[u] = len(comps)
+                    members |= 1 << u
+                    if u == v:
+                        break
+                comps.append(members)
+
+    # Condensation reach sets: components pop in reverse topological
+    # order, so every successor's reach is final when a component pops.
+    reach = []
+    for ci, members in enumerate(comps):
+        r = members
+        succ = 0
+        for u in iter_bits(members):
+            succ |= deps[u]
+        for u in iter_bits(succ & ~members):
+            r |= reach[comp_of[u]]
+        reach.append(r)
+
+    committed_int = 0
+    for v in range(V):
+        if committed[v]:
+            committed_int |= 1 << v
+
+    eligible = np.zeros((V,), bool)
+    n = np.zeros((V,), np.int64)
+    root = np.full((V,), -1, np.int32)
+    for v in range(V):
+        if not active[v]:
+            continue
+        rv = reach[comp_of[v]]
+        eligible[v] = (rv & ~committed_int) == 0
+        n[v] = bin(rv).count("1")
+        root[v] = _lowest_bit(comps[comp_of[v]])
+    order = np.full((V,), -1, np.int32)
+    elig_ids = [v for v in range(V) if eligible[v]]
+    for rank, v in enumerate(sorted(elig_ids, key=lambda v: (n[v], v))):
+        order[v] = rank
+    return eligible, order, root
+
+
+def iter_bits(mask: int):
+    """Iterate set-bit positions of a python int, lowest first."""
+    while mask:
+        low = mask & -mask
+        yield low.bit_length() - 1
+        mask ^= low
+
+
+def _lowest_bit(mask: int) -> int:
+    return (mask & -mask).bit_length() - 1
+
+
+registry.register(
+    registry.Plane(
+        name="depgraph_execute",
+        backend="bpaxos",
+        reference=reference_depgraph_execute,
+        kernel=fused_depgraph_execute,
+        key_of=lambda args: args[0].shape,  # adj: (B, V, VW)
+        batch_axis=0,  # grids over whole graphs
+        default_block=8,
+        # Every array is graph-local: the batch axis shards with no
+        # cross-device dataflow (bpaxos batches per-replica graphs
+        # along it, so a replica-axis mesh tiles the closure).
+        shard=registry.ShardSpec(
+            arg_axes=(0, 0, 0), out_axes=(0, 0, 0)
+        ),
+    )
+)

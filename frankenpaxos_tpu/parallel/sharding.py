@@ -900,6 +900,36 @@ register_sharding(
     )
 )
 
+# Batched BPaxos: the execution plane is REPLICA-parallel — every
+# replica runs the same dependency-graph closure over its own
+# (committed-visibility, watermark) view — so the per-replica planes
+# ([R, L] watermarks, [R, L, W] commit visibility) shard along R and
+# everything consensus-global (the lane rings, the packed adjacency,
+# scalar stats) replicates. The tick's cross-device traffic is the
+# gc_head minimum ([L]-sized) and the scalar stat reductions; the
+# depgraph_execute plane batches OVER the replica axis, so the sharded
+# batched closure stays device-local. planes_backend stays None like
+# epaxos: kernel shard_map lowering needs the lifecycle-threaded fleet
+# contract the client-plane backends carry; CPU/lint runs resolve the
+# plane to its reference twin either way.
+register_sharding(
+    ShardingSpec(
+        backend="bpaxos",
+        module="frankenpaxos_tpu.tpu.bpaxos_batched",
+        state_class="BatchedBPaxosState",
+        replicated=frozenset({
+            "next_cmd", "gc_head", "proposed", "propose_tick",
+            "commit_tick", "committed", "adj", "committed_total",
+            "executed_total", "retired_total", "coexecuted", "lat_sum",
+            "lat_hist", "workload", "telemetry",
+        }),
+        axis_pos={"head_r": 0, "rep_commit_tick": 0},
+        axis_len=lambda st: st.head_r.shape[0],
+        axis_desc="num_replicas",
+        planes_backend=None,
+    )
+)
+
 # Compartmentalized MultiPaxos: role-major planes with (G, W) minor.
 # Grid planes ([R, C, G, W]) carry the group axis THIRD, replica planes
 # ([NR, G, W] / [NR, G] / [NR, G, RW]) SECOND, everything else

@@ -1,0 +1,554 @@
+"""Batched Bipartisan Paxos (BPaxos) as a single XLA program — the
+dependency-graph protocol family on the device-side executor.
+
+BPaxos (PAPERS: arXiv 2003.00331) is state machine replication DISAGGREGATED
+into single-purpose modules: leaderless PROPOSERS take client commands,
+a DEPENDENCY SERVICE computes each command's conflict set, per-vertex
+CONSENSUS (one Paxos instance per (leader, index) vertex) makes the
+``(command, deps)`` pair durable, and REPLICAS execute the resulting
+dependency graph — eligible strongly-connected components in reverse
+topological order (``bpaxos/DependencyGraph.scala``). The modules scale
+independently; the graph is the protocol.
+
+TPU-first redesign, one plane per module:
+
+  * PROPOSER plane: ``L`` leader lanes, each owning a ring of ``W``
+    in-flight vertices (vertex id = lane * W + ring slot — the bounded
+    (leader, index) instance space). Up to ``K`` commands per lane per
+    tick, shaped by the workload engine (lane = the Zipf axis: hot-key
+    skew piles arrivals — and therefore conflicts — onto lane 0).
+  * DEP-SERVICE plane: the conflict relation drawn at propose time as
+    ADJACENCY ROWS of the ``[V, V/32]`` uint32 bitmask
+    (``ops/depgraph.py`` owns the packing). Every vertex depends on its
+    own-lane predecessor (a leader serializes its lane), and on each
+    LIVE vertex of another lane with probability ``conflict_rate`` —
+    including vertices proposed the SAME tick, whose mutual draws are
+    exactly the interfering-command races that create SCC cycles in the
+    real protocol. The knob is traced when the workload plan carries
+    ``conflict_rate`` (``workload.conflict_k16``): the whole
+    [conflict x load] surface is ONE compile.
+  * CONSENSUS plane: per-vertex commit latency = dep-service RTT +
+    Paxos accept RTT + the replica broadcast hop, sampled per vertex;
+    the unified fault layer stretches it (TCP retransmit semantics) and
+    a LEADER-axis partition defers cut lanes' commits to the heal tick.
+  * REPLICA plane: ``R`` executing replicas, each seeing a commit at
+    its own broadcast-delayed tick (``rep_commit_tick``). Each tick
+    every replica runs the ``depgraph_execute`` plane over the SHARED
+    adjacency with its OWN (committed, active) view — a [R, V, V/32]
+    batched closure, the kernel's natural batch axis (and the mesh
+    shard axis: ``parallel/sharding.py`` tiles replicas over devices).
+    Eligibility is closed under dependencies and own-lane chain edges
+    make it a per-lane PREFIX, so each replica's executed state is just
+    a [L] watermark (``head_r``); slots retire — and their adjacency
+    rows AND columns clear — once every replica has executed them
+    (``gc_head = min_r head_r``), which is what makes ring-slot reuse
+    safe in a bounded window.
+
+The dep-graph SAFETY claim (no instance executes before its committed
+dependencies) is checked two ways: in-graph every tick
+(``check_invariants``'s ``dep_safety_ok`` audits executed vertices' dep
+rows via ``depgraph.rows_subset``) and against the host Tarjan oracle in
+``tests/test_tpu_bpaxos.py`` / ``harness/simtest.py``'s randomized
+[faults x conflict-rate] schedules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.common import INF, LAT_BINS, sample_latency
+# Submodule imports (package-attr access on frankenpaxos_tpu.ops would
+# be circular during tpu package init). Importing ops.depgraph is what
+# registers the `depgraph_execute` plane before the first dispatch.
+from frankenpaxos_tpu.ops import depgraph as depgraph_mod
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
+from frankenpaxos_tpu.tpu import faults as faults_mod
+from frankenpaxos_tpu.tpu import workload as workload_mod
+from frankenpaxos_tpu.tpu.faults import FaultPlan
+from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
+from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedBPaxosConfig:
+    """Static (compile-time) simulation parameters."""
+
+    num_leaders: int = 3  # L: leaderless proposer lanes
+    window: int = 32  # W: in-flight vertices per lane (ring capacity)
+    cmds_per_tick: int = 2  # K: new commands per lane per tick
+    lat_min: int = 1  # one-way message latency in ticks (uniform sample)
+    lat_max: int = 3
+    # P(a new command conflicts with a given live command of another
+    # lane) — the dependency-graph edge density. Quantized to multiples
+    # of 1/16 by the bit-sliced sampler; a WorkloadPlan carrying
+    # ``conflict_rate`` overrides this with a TRACED value (the
+    # [conflict x load] sweep axis).
+    conflict_rate: float = 0.25
+    # Module fan-outs (message accounting + the consensus RTT hops).
+    num_dep_nodes: int = 3  # dependency-service nodes per command
+    num_acceptors: int = 3  # per-vertex Paxos acceptors
+    num_replicas: int = 4  # R: executing replicas (the plane batch axis)
+    # Closed workload: stop proposing once each lane has allocated this
+    # many vertices (None = open workload).
+    max_cmds_per_leader: Optional[int] = None
+    # Kernel-layer dispatch policy (ops/registry.py): the batched
+    # dependency-graph closure — eligibility, SCC roots, deterministic
+    # execution order for all R replica views at once — routes through
+    # ops.registry.dispatch as `depgraph_execute`.
+    kernels: KernelPolicy = KernelPolicy()
+    # Unified in-graph fault injection (tpu/faults.py): the commit round
+    # is modeled end-to-end, so drops/jitter stretch it and a
+    # LEADER-axis partition defers cut lanes' commits to the heal tick
+    # (dependency chains through the cut lane stall at every replica
+    # until then). FaultPlan.none() is a structural no-op.
+    faults: FaultPlan = FaultPlan.none()
+    # In-graph workload engine (tpu/workload.py): shapes per-lane
+    # command admission (bounded by cmds_per_tick; the FIFO backlog
+    # carries the rest). Completions are command commits.
+    workload: WorkloadPlan = WorkloadPlan.none()
+
+    @property
+    def num_vertices(self) -> int:
+        return self.num_leaders * self.window
+
+    def __post_init__(self):
+        assert self.num_leaders >= 2
+        assert self.window >= 2 * self.cmds_per_tick
+        self.workload.validate()
+        self.kernels.validate()
+        assert 1 <= self.lat_min <= self.lat_max
+        assert 0.0 <= self.conflict_rate <= 1.0
+        # The bit-sliced sampler quantizes to 16ths; a rate that
+        # silently degrades to 0 or 1 would simulate a different
+        # conflict regime (same contract as epaxos.see_same_tick_rate).
+        k16 = round(self.conflict_rate * 16)
+        assert (k16 == 0) == (self.conflict_rate == 0.0) and (
+            k16 == 16
+        ) == (self.conflict_rate == 1.0), (
+            f"conflict_rate={self.conflict_rate} quantizes to "
+            f"{k16}/16; pick a multiple of 1/16 (or >= 1/32) instead"
+        )
+        assert self.num_dep_nodes >= 1
+        assert self.num_acceptors >= 1
+        assert self.num_replicas >= 1
+        self.faults.validate(axis=self.num_leaders)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedBPaxosState:
+    """Struct-of-arrays vertex state. Shapes: [L] lanes, [L, W] ring
+    vertices, [V, VW] packed adjacency (V = L*W, VW = ceil(V/32)),
+    [R, ...] per-replica views."""
+
+    next_cmd: jnp.ndarray  # [L] next per-lane command number
+    gc_head: jnp.ndarray  # [L] lowest unretired command number
+    # (= min over replicas of head_r: every slot below it has executed
+    # everywhere, so its ring cell and adjacency row/column are clear)
+    head_r: jnp.ndarray  # [R, L] per-replica executed watermark
+
+    proposed: jnp.ndarray  # [L, W] ring slot holds a live vertex
+    propose_tick: jnp.ndarray  # [L, W] proposal tick (INF = empty)
+    commit_tick: jnp.ndarray  # [L, W] consensus-chosen tick (INF = empty)
+    committed: jnp.ndarray  # [L, W] bool: the commit is durable
+    rep_commit_tick: jnp.ndarray  # [R, L, W] tick the commit REACHES
+    # each replica (broadcast hop; INF = empty)
+    # The dependency graph itself: row v's bits are the vertices v
+    # depends on (ops/depgraph.py owns every bit-level operation).
+    adj: jnp.ndarray  # [V, VW] uint32 packed adjacency
+
+    # Stats.
+    committed_total: jnp.ndarray  # [] cumulative commits (global)
+    executed_total: jnp.ndarray  # [] cumulative per-replica executions
+    retired_total: jnp.ndarray  # [] cumulative retired ring slots
+    coexecuted: jnp.ndarray  # [] replica-0 executions that shared their
+    # closure pass with an SCC partner (>= 2 members on one scc_root)
+    lat_sum: jnp.ndarray  # [] sum of replica-0 propose->execute latencies
+    lat_hist: jnp.ndarray  # [LAT_BINS] replica-0 execute latency histogram
+    workload: WorkloadState  # shaping state (tpu/workload.py)
+    telemetry: Telemetry  # device-side metric ring (tpu/telemetry.py)
+
+
+def init_state(cfg: BatchedBPaxosConfig) -> BatchedBPaxosState:
+    L, W, R = cfg.num_leaders, cfg.window, cfg.num_replicas
+    V = cfg.num_vertices
+    VW = depgraph_mod.num_words(V)
+    return BatchedBPaxosState(
+        next_cmd=jnp.zeros((L,), jnp.int32),
+        gc_head=jnp.zeros((L,), jnp.int32),
+        head_r=jnp.zeros((R, L), jnp.int32),
+        proposed=jnp.zeros((L, W), bool),
+        propose_tick=jnp.full((L, W), INF, jnp.int32),
+        commit_tick=jnp.full((L, W), INF, jnp.int32),
+        committed=jnp.zeros((L, W), bool),
+        rep_commit_tick=jnp.full((R, L, W), INF, jnp.int32),
+        adj=jnp.zeros((V, VW), jnp.uint32),
+        committed_total=jnp.zeros((), jnp.int32),
+        executed_total=jnp.zeros((), jnp.int32),
+        retired_total=jnp.zeros((), jnp.int32),
+        coexecuted=jnp.zeros((), jnp.int32),
+        lat_sum=jnp.zeros((), jnp.int32),
+        lat_hist=jnp.zeros((LAT_BINS,), jnp.int32),
+        workload=workload_mod.make_state(
+            cfg.workload, cfg.num_leaders, cfg.faults
+        ),
+        telemetry=make_telemetry(),
+    )
+
+
+def _abs_slot(base: jnp.ndarray, W: int) -> jnp.ndarray:
+    """[L, W] absolute command number at each ring position, valid for
+    every cell occupied while ``base`` is the retire watermark."""
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    return base[:, None] + jnp.mod(w_iota[None, :] - base[:, None], W)
+
+
+def tick(
+    cfg: BatchedBPaxosConfig,
+    state: BatchedBPaxosState,
+    t: jnp.ndarray,
+    key: jnp.ndarray,
+) -> BatchedBPaxosState:
+    """One simulation tick: commits land per replica, every replica runs
+    the dependency-graph closure plane and executes its eligible prefix,
+    fully-executed slots retire (adjacency rows AND columns clear), and
+    proposers admit new commands with dep-service-drawn conflict edges
+    and consensus-sampled commit latencies."""
+    L, W, R = cfg.num_leaders, cfg.window, cfg.num_replicas
+    V = cfg.num_vertices
+    VW = depgraph_mod.num_words(V)
+    K = cfg.cmds_per_tick
+    k_conf, k_lat, k_rep = jax.random.split(key, 3)
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    fp = cfg.faults
+    wl = cfg.workload
+    wls = state.workload
+    frates = faults_mod.traced_rates(fp, wls)
+
+    # ---- 1. Commits land. Globally (consensus chose the vertex — the
+    # stats/telemetry view) and per replica (the broadcast arrived —
+    # what execution at that replica may act on).
+    landing = state.proposed & (state.commit_tick <= t)
+    committed = state.committed | landing
+    new_commit_mask = committed & ~state.committed
+    n_new_commits = jnp.sum(new_commit_mask)
+    com_r = state.proposed[None] & (state.rep_commit_tick <= t)  # [R, L, W]
+
+    # ---- 2. REPLICA plane: every replica runs the batched closure
+    # over the SHARED graph with its OWN (committed, active) view.
+    # active = live and not yet executed BY THIS replica; a dependency
+    # on an inactive vertex is satisfied (this replica already executed
+    # it, or it retired everywhere).
+    abs_now = _abs_slot(state.gc_head, W)  # [L, W]
+    act_r = state.proposed[None] & (
+        abs_now[None] >= state.head_r[:, :, None]
+    )  # [R, L, W]
+    adj_b = jnp.broadcast_to(state.adj, (R, V, VW))
+    eligible_b, _order_b, root_b = ops_registry.dispatch(
+        "depgraph_execute",
+        cfg,
+        adj_b,
+        com_r.reshape(R, V),
+        act_r.reshape(R, V),
+    )
+    eligible_r = eligible_b.reshape(R, L, W)
+    # Own-lane chain edges make each replica's eligible set a per-lane
+    # PREFIX from head_r; the cumprod run is the executed advance.
+    pos_of_ord = jnp.mod(
+        state.head_r[:, :, None] + w_iota[None, None, :], W
+    )  # [R, L, W]
+    elig_ord = jnp.take_along_axis(eligible_r, pos_of_ord, axis=2)
+    run_r = jnp.sum(
+        jnp.cumprod(elig_ord.astype(jnp.int32), axis=2), axis=2
+    )  # [R, L]
+    head_r = state.head_r + run_r
+    executed_total = state.executed_total + jnp.sum(run_r)
+
+    # Replica-0 accounting: execute latency, and SCC co-execution (>= 2
+    # newly executed members sharing one scc_root — the closure pass
+    # committed a cycle together, the case the plane exists for).
+    newly0 = (
+        state.proposed
+        & (abs_now >= state.head_r[0][:, None])
+        & (abs_now < head_r[0][:, None])
+    )  # [L, W]
+    newly0_v = newly0.reshape(V)
+    root0 = root_b[0]  # [V]
+    members = jax.ops.segment_sum(
+        newly0_v.astype(jnp.int32),
+        jnp.where(newly0_v, root0, V),
+        num_segments=V + 1,
+    )
+    in_scc = newly0_v & (
+        jnp.take(members, jnp.where(newly0_v, root0, 0)) >= 2
+    )
+    coexecuted = state.coexecuted + jnp.sum(in_scc)
+    lat = jnp.where(newly0, t - state.propose_tick, 0)
+    lat_sum = state.lat_sum + jnp.sum(lat)
+    bins = jnp.clip(lat, 0, LAT_BINS - 1)
+    lat_hist = state.lat_hist + jax.ops.segment_sum(
+        newly0.astype(jnp.int32).ravel(), bins.ravel(), LAT_BINS
+    )
+
+    # ---- 3. Retire (GC): slots every replica has executed leave the
+    # ring; their adjacency row AND column bits clear (clear_vertices —
+    # a stale column bit would fabricate a dependency on the slot's
+    # next tenant).
+    gc_head = jnp.min(head_r, axis=0)  # [L]
+    run_gc = gc_head - state.gc_head
+    retired_total = state.retired_total + jnp.sum(run_gc)
+    ordinal_gc = jnp.mod(w_iota[None, :] - state.gc_head[:, None], W)
+    clear = ordinal_gc < run_gc[:, None]  # [L, W]
+    adj = depgraph_mod.clear_vertices(state.adj, clear.reshape(V))
+    proposed = state.proposed & ~clear
+    committed = committed & ~clear
+    propose_tick = jnp.where(clear, INF, state.propose_tick)
+    commit_tick = jnp.where(clear, INF, state.commit_tick)
+    rep_commit_tick = jnp.where(clear[None], INF, state.rep_commit_tick)
+
+    # ---- 4. PROPOSER plane: up to K new commands per lane if the ring
+    # has room, shaped by workload admission.
+    space = W - (state.next_cmd - gc_head)
+    if wl.active:
+        wl_writes, _, wls = workload_mod.begin(wl, wls, key, t, L)
+        adm = workload_mod.admission(wl, wls, wl_writes)
+        count = jnp.minimum(jnp.minimum(adm, K), space)
+    else:
+        count = jnp.minimum(K, space)
+    if cfg.max_cmds_per_leader is not None:
+        count = jnp.minimum(
+            count,
+            jnp.maximum(cfg.max_cmds_per_leader - state.next_cmd, 0),
+        )
+    if wl.active:
+        # Accounted AFTER every clamp: finish() must see the ACTUAL
+        # per-lane issue count, or the backlog drains entries the ring
+        # never admitted.
+        wls = workload_mod.finish(
+            wl, wls, t, wl_writes, count,
+            jnp.sum(new_commit_mask, axis=1),
+        )
+    delta = jnp.mod(w_iota[None, :] - state.next_cmd[:, None], W)
+    is_new = delta < count[:, None]
+    next_cmd = state.next_cmd + count
+    abs_new = state.next_cmd[:, None] + delta  # [L, W] new command nums
+
+    # ---- 5. DEP-SERVICE plane: the new vertices' adjacency rows.
+    # (a) Own-lane chain edge to the immediate predecessor, unless it
+    # already retired everywhere (then the dependency is vacuous — and
+    # its ring slot may already host a FUTURE vertex, so no bit).
+    v_iota = jnp.arange(V, dtype=jnp.int32)
+    lane_of_v = v_iota // W
+    prev_id = (
+        jnp.arange(L, dtype=jnp.int32)[:, None] * W
+        + jnp.mod(w_iota[None, :] - 1, W)
+    )  # [L, W] vertex id of the predecessor slot
+    chain_ok = abs_new - 1 >= gc_head[:, None]  # [L, W]
+    chain_bool = (
+        (v_iota[None, None, :] == prev_id[:, :, None])
+        & chain_ok[:, :, None]
+    )  # [L, W, V]
+    chain_words = depgraph_mod.pack_mask(chain_bool)  # [L, W, VW]
+    # (b) Conflict edges: Bernoulli(conflict) per live OTHER-lane
+    # vertex, drawn K-shaped (the full-ring draw would dominate the
+    # tick at wide V) and gathered onto ring positions via delta.
+    # "Live" includes vertices proposed THIS tick — mutual same-tick
+    # draws are the SCC-forming races. The knob is traced when the
+    # workload plan carries conflict_rate.
+    k16 = workload_mod.conflict_k16(wl, wls, cfg.conflict_rate)
+    sees_k = depgraph_mod.bernoulli_words_k16(k_conf, k16, (L, K, VW))
+    live_after = (proposed | is_new).reshape(V)  # [V]
+    live_words = depgraph_mod.pack_mask(live_after)  # [VW]
+    own_lane_words = depgraph_mod.pack_mask(
+        lane_of_v[None, :] == jnp.arange(L, dtype=jnp.int32)[:, None]
+    )  # [L, VW]
+    sees_k = sees_k & live_words[None, None, :] & ~own_lane_words[:, None, :]
+    sees = jnp.take_along_axis(
+        sees_k, jnp.clip(delta, 0, K - 1)[:, :, None], axis=1
+    )  # [L, W, VW]
+    new_rows = (chain_words | sees).reshape(V, VW)
+    adj = jnp.where(is_new.reshape(V)[:, None], new_rows, adj)
+
+    # ---- 6. CONSENSUS plane: commit latency = dep-service RTT (2
+    # one-way hops) + Paxos accept RTT (2) + the replica broadcast hop
+    # the per-replica arrival adds below. Faults stretch the round
+    # end-to-end; a cut leader lane's commits defer to the heal tick.
+    commit_lat = jnp.sum(
+        sample_latency(cfg.lat_min, cfg.lat_max, k_lat, (4, L, W)),
+        axis=0,
+    )  # [L, W]
+    if fp.traced or fp.drop_rate > 0.0 or fp.jitter > 0:
+        commit_lat = faults_mod.tcp_latency(
+            fp, faults_mod.fault_key(key), (L, W), commit_lat,
+            rates=frates,
+        )
+    commit_arr = t + commit_lat
+    if fp.has_partition:
+        cut_lane = (~faults_mod.partition_row(fp, t, L))[:, None]
+        commit_arr = faults_mod.defer_to_heal(fp, commit_arr, cut_lane)
+    # Per-replica arrival: the commit broadcast hop, sampled per
+    # replica (replica skew is what makes head_r a vector).
+    rep_arr = commit_arr[None] + sample_latency(
+        cfg.lat_min, cfg.lat_max, k_rep, (R, L, W)
+    )  # [R, L, W]
+    proposed = proposed | is_new
+    propose_tick = jnp.where(is_new, t, propose_tick)
+    commit_tick = jnp.where(is_new, commit_arr, commit_tick)
+    rep_commit_tick = jnp.where(is_new[None], rep_arr, rep_commit_tick)
+    committed = committed & ~is_new
+
+    # ---- 7. Telemetry: dep-service + acceptor + replica fan-outs are
+    # the phase-2 message plane (BPaxos is leaderless — no phase 1).
+    n_new = jnp.sum(is_new)
+    tel = record(
+        state.telemetry,
+        proposals=jnp.sum(count),
+        phase2_msgs=(
+            cfg.num_dep_nodes + cfg.num_acceptors + R
+        ) * n_new,
+        commits=n_new_commits,
+        executes=jnp.sum(run_r[0]),
+        queue_depth=jnp.sum(next_cmd - gc_head),
+        queue_capacity=L * W,
+        lat_hist_delta=lat_hist - state.lat_hist,
+    )
+    # Span sampler (telemetry.record_spans — the generic plumbing):
+    # vertex lifecycles on the per-lane rings. Mapping: group = leader
+    # lane, slot id = the command number at each ring position (OLD
+    # gc_head — valid for every cell occupied at tick start, including
+    # this tick's retirees); a cell proposed THIS tick carries the OLD
+    # next_cmd number. Consensus choice is one event (vote == chosen);
+    # the "executed" stamp is ring retirement (all replicas executed).
+    # No phase-1 plane: BPaxos proposers are leaderless. Structurally
+    # OFF at spans=0, like the counter ring.
+    if telemetry_mod.span_slots(tel):
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=is_new,
+            slot_ids=abs_now,
+            new_slot_ids=abs_new,
+            phase1_mark=jnp.zeros((L,), bool),
+            voted=new_commit_mask,
+            newly_chosen=new_commit_mask,
+            retire_mask=clear,
+        )
+
+    return BatchedBPaxosState(
+        next_cmd=next_cmd,
+        gc_head=gc_head,
+        head_r=head_r,
+        proposed=proposed,
+        propose_tick=propose_tick,
+        commit_tick=commit_tick,
+        committed=committed,
+        rep_commit_tick=rep_commit_tick,
+        adj=adj,
+        committed_total=state.committed_total + n_new_commits,
+        executed_total=executed_total,
+        retired_total=retired_total,
+        coexecuted=coexecuted,
+        lat_sum=lat_sum,
+        lat_hist=lat_hist,
+        workload=wls,
+        telemetry=tel,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+def run_ticks(
+    cfg: BatchedBPaxosConfig,
+    state: BatchedBPaxosState,
+    t0: jnp.ndarray,
+    num_ticks: int,
+    key: jnp.ndarray,
+) -> Tuple[BatchedBPaxosState, jnp.ndarray]:
+    """Run ``num_ticks`` ticks under lax.scan; returns (state, t0+num_ticks)."""
+
+    def step(carry, i):
+        st, t = carry
+        st = tick(cfg, st, t, jax.random.fold_in(key, i))
+        return (st, t + 1), ()
+
+    (state, t), _ = jax.lax.scan(step, (state, t0), jnp.arange(num_ticks))
+    return state, t
+
+
+def check_invariants(
+    cfg: BatchedBPaxosConfig, state: BatchedBPaxosState, t
+) -> dict:
+    """Device-side safety checks; all returned booleans must be True."""
+    W = cfg.window
+    V = cfg.num_vertices
+    # Execution is per-lane prefix at every replica, so the cumulative
+    # counter is exactly the total watermark advance.
+    conserved = state.executed_total == jnp.sum(state.head_r)
+    workload_ok = workload_mod.invariants_ok(cfg.workload, state.workload)
+    # A replica only executes commits it has seen; commits are global
+    # events counted once.
+    books_ok = jnp.all(
+        jnp.sum(state.head_r, axis=1) <= state.committed_total
+    )
+    retired_ok = state.retired_total == jnp.sum(state.gc_head)
+    # Window bookkeeping: bounded state around the retire watermark.
+    window_ok = (
+        jnp.all(
+            (state.gc_head[None] <= state.head_r)
+            & (state.head_r <= state.next_cmd[None])
+        )
+        & jnp.all(state.next_cmd - state.gc_head <= W)
+    )
+    # Committed implies proposed (a commit can only land on a live slot).
+    ring_ok = jnp.all(~state.committed | state.proposed)
+    # THE dep-graph safety invariant: no vertex executed before its
+    # committed dependencies. For every replica, each vertex it has
+    # executed (live, abs < head_r) must have an adjacency row pointing
+    # ONLY at vertices that replica also executed (bits to retired
+    # vertices were cleared with them; bits to unexecuted ones would be
+    # an ordering violation).
+    abs_v = _abs_slot(state.gc_head, W).reshape(V)  # [V]
+    lane_of_v = jnp.arange(V, dtype=jnp.int32) // W
+    head_per_v = state.head_r[:, lane_of_v]  # [R, V]
+    exec_r = state.proposed.reshape(V)[None, :] & (
+        abs_v[None, :] < head_per_v
+    )  # [R, V]
+    deps_ok_rows = depgraph_mod.rows_subset(
+        state.adj[None], depgraph_mod.pack_mask(exec_r)
+    )  # [R, V]
+    dep_safety_ok = jnp.all(~exec_r | deps_ok_rows)
+    # Per-replica commit visibility never precedes the global commit.
+    vis_ok = jnp.all(state.rep_commit_tick >= state.commit_tick[None])
+    return {
+        "conserved": conserved,
+        "workload_ok": workload_ok,
+        "books_ok": books_ok,
+        "retired_ok": retired_ok,
+        "window_ok": window_ok,
+        "ring_ok": ring_ok,
+        "dep_safety_ok": dep_safety_ok,
+        "vis_ok": vis_ok,
+    }
+
+
+def analysis_config(
+    faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
+) -> BatchedBPaxosConfig:
+    """The backend's canonical SMALL config: shared by the
+    static-analysis trace layer (``frankenpaxos_tpu.analysis`` jits and
+    inspects ``tick``/``run_ticks`` at exactly this shape) and the
+    simulation-testing registry (``harness/simtest.py``). Big enough to
+    exercise every module plane (V = 48 vertices, 2 packed words, 4
+    replicas so the mesh leg shards 2-way), small enough to trace and
+    compile in well under a second."""
+    return BatchedBPaxosConfig(
+        num_leaders=3, window=16, cmds_per_tick=2, num_replicas=4,
+        conflict_rate=0.25, faults=faults, workload=workload,
+    )

@@ -47,6 +47,19 @@ Re-designed TPU-first:
     dependency snapshot (Bernoulli ``see_same_tick_rate``, quantized to
     16ths by the bit-sliced sampler), forming SCCs that the closure
     executes together.
+  * ``general_deps=True`` switches the execute pass to TRUE EPaxos
+    execution through the ``depgraph_execute`` kernel plane
+    (:mod:`frankenpaxos_tpu.ops.depgraph`): at propose time the factored
+    snapshot is MATERIALIZED into per-vertex adjacency rows of a packed
+    ``[C*W, ceil(C*W/32)]`` bitmask (watermark edges to every live peer
+    instance below the dependency watermark, plus the own-column chain
+    bit), and eligibility/SCC condensation run as the plane's log-depth
+    transitive closure instead of the factored greatest fixpoint. The
+    two paths are state-equal tick for tick
+    (``tests/test_tpu_epaxos.py``) — the factored fixpoint is the
+    compressed special case — but the general path accepts NON-FACTORED
+    dependency snapshots (arbitrary row edits), which the watermark
+    encoding cannot represent.
 """
 
 from __future__ import annotations
@@ -63,6 +76,9 @@ from frankenpaxos_tpu.tpu.common import (
     LAT_BINS,
     sample_latency,
 )
+from frankenpaxos_tpu.ops import depgraph as depgraph_mod
+from frankenpaxos_tpu.ops import registry as ops_registry
+from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
@@ -123,6 +139,17 @@ class BatchedEPaxosConfig:
     # prefix recovers from the snapshot, not by replay. 0 = GC layer off
     # (slots prune the tick they execute).
     num_exec_replicas: int = 0  # R (use 2f+1-style odd counts)
+    # TRUE EPaxos execution: materialize the factored snapshot into a
+    # packed [C*W, ceil(C*W/32)] adjacency bitmask at propose time and
+    # run the execute pass through the ``depgraph_execute`` kernel plane
+    # (transitive closure + SCC condensation) instead of the factored
+    # greatest fixpoint. Bit-identical state evolution to the factored
+    # path (tests/test_tpu_epaxos.py), but the dependency snapshot is no
+    # longer required to be watermark-shaped.
+    general_deps: bool = False
+    # Per-plane kernel dispatch policy (ops/registry.py) for the
+    # depgraph_execute plane the general path runs through.
+    kernels: KernelPolicy = KernelPolicy()
     replica_lag: int = 2  # mean ticks between a replica's watermark pulls
     rep_crash_rate: float = 0.0  # per-replica per-tick crash probability
     rep_revive_rate: float = 0.1  # per-crashed-replica revival probability
@@ -173,6 +200,7 @@ class BatchedEPaxosConfig:
             assert self.snapshot_every >= 1
             assert 0.0 <= self.rep_crash_rate <= 1.0
             assert 0.0 <= self.rep_revive_rate <= 1.0
+        self.kernels.validate()
         self.faults.validate(axis=self.num_columns)
         if self.faults.has_partition:
             # A cut column's instances commit only at the heal tick, and
@@ -210,6 +238,13 @@ class BatchedEPaxosState:
     vis_bits: jnp.ndarray  # [C, W, CW] uint32 same-tick visibility mask
     fpre: jnp.ndarray  # [H, C] frontier BEFORE tick h's proposals
     fpost: jnp.ndarray  # [H, C] frontier AFTER tick h's proposals
+    # Materialized adjacency for the general (non-factored) execute path:
+    # [V, VW] uint32 with V = C*W ring-slot vertices (vertex = c*W + w)
+    # and VW = ceil(V/32) packed dependency words per row. Zero-sized
+    # when cfg.general_deps is off. Written only via jnp.where /
+    # ops.depgraph helpers (the depgraph-containment lint keeps raw bit
+    # twiddling of this leaf inside ops/depgraph.py).
+    adj: jnp.ndarray  # [V, VW] uint32 (or [0, 0] when general_deps off)
 
     # GC layer (zero-width when cfg.num_exec_replicas == 0). With GC on,
     # ``head`` is the SNAPSHOT BARRIER (= prune watermark / ring base —
@@ -250,6 +285,12 @@ def init_state(cfg: BatchedEPaxosConfig) -> BatchedEPaxosState:
         vis_bits=jnp.zeros((C, W, CW), jnp.uint32),
         fpre=jnp.zeros((H, C), jnp.int32),
         fpost=jnp.zeros((H, C), jnp.int32),
+        adj=jnp.zeros(
+            (C * W, depgraph_mod.num_words(C * W))
+            if cfg.general_deps
+            else (0, 0),
+            jnp.uint32,
+        ),
         exec_wm=jnp.zeros((C if cfg.num_exec_replicas else 0,), jnp.int32),
         rep_exec=jnp.zeros((cfg.num_exec_replicas, C), jnp.int32),
         rep_down=jnp.zeros((cfg.num_exec_replicas,), bool),
@@ -439,10 +480,43 @@ def tick(
     # it, execution advances exec_wm and pruning waits for the quorum
     # watermark's snapshot barrier in step 2b.
     exec_base = state.exec_wm if cfg.num_exec_replicas else state.head
-    newly, run = eligible_closure(
-        committed, state.proposed, state.propose_tick, state.vis_bits,
-        state.fpre, state.fpost, exec_base, state.next_instance,
-    )
+    if cfg.general_deps:
+        # TRUE EPaxos execution: the eligible set comes from the
+        # depgraph_execute plane's transitive closure over the
+        # MATERIALIZED adjacency (written at propose time in step 3),
+        # not from the factored fixpoint. Active = live and not yet
+        # executed; executed-but-unpruned slots (GC layer) are inactive,
+        # so their cleared-by-commitment rows never block a dependent.
+        V = C * W
+        abs_slot0 = state.head[:, None] + jnp.mod(
+            w_iota[None, :] - state.head[:, None], W
+        )
+        active = state.proposed & (abs_slot0 >= exec_base[:, None])
+        elig_b, _order_b, _root_b = ops_registry.dispatch(
+            "depgraph_execute", cfg,
+            state.adj[None],
+            committed.reshape(1, V),
+            active.reshape(1, V),
+        )
+        eligible = elig_b.reshape(C, W)
+        # Own-column chain edges make per-column eligibility a prefix
+        # from the execution watermark; the run length recovers the
+        # factored path's watermark advance exactly.
+        ordinal_e = jnp.mod(w_iota[None, :] - exec_base[:, None], W)
+        in_ring_e = ordinal_e < (state.next_instance - exec_base)[:, None]
+        pos_of_ord_e = jnp.mod(exec_base[:, None] + w_iota[None, :], W)
+        elig_ord = jnp.take_along_axis(
+            eligible & in_ring_e, pos_of_ord_e, axis=1
+        )
+        run = jnp.sum(
+            jnp.cumprod(elig_ord.astype(jnp.int32), axis=1), axis=1
+        )
+        newly = in_ring_e & (ordinal_e < run[:, None])
+    else:
+        newly, run = eligible_closure(
+            committed, state.proposed, state.propose_tick, state.vis_bits,
+            state.fpre, state.fpost, exec_base, state.next_instance,
+        )
     n_exec = jnp.sum(run)
     # Co-execution accounting: a newly executed instance whose deps were
     # not all executed BEFORE this pass (i.e. not a base instance with
@@ -548,6 +622,13 @@ def tick(
     propose_tick = jnp.where(clear, INF, state.propose_tick)
     commit_tick = jnp.where(clear, INF, state.commit_tick)
     vis_bits = jnp.where(clear[:, :, None], jnp.uint32(0), state.vis_bits)
+    if cfg.general_deps:
+        # Retired vertices leave the graph entirely: rows AND columns
+        # zeroed, so a ring slot reused by a later instance never
+        # inherits stale incoming edges.
+        adj = depgraph_mod.clear_vertices(state.adj, clear.reshape(C * W))
+    else:
+        adj = state.adj
 
     # ---- 3. Propose new instances (EpReplica handleClientRequest): up
     # to K per column if the window has room. The dependency snapshot is
@@ -594,7 +675,20 @@ def tick(
     # full-ring draw would make threefry generation the dominant tick
     # cost at wide C), gathered back onto ring positions via delta.
     K = cfg.instances_per_tick
-    sees_k = _bernoulli_words(k_vis, cfg.see_same_tick_rate, (C, K, CW))
+    if wl.has_conflict:
+        # Traced conflict density (WorkloadState.conflict) overrides
+        # the static see_same_tick_rate: [conflict x load] sweeps are
+        # one compile. Same 4-plane bit-sliced comparator, so a traced
+        # rate equal to the static one draws the identical stream.
+        sees_k = depgraph_mod.bernoulli_words_k16(
+            k_vis,
+            workload_mod.conflict_k16(wl, wls, cfg.see_same_tick_rate),
+            (C, K, CW),
+        )
+    else:
+        sees_k = _bernoulli_words(
+            k_vis, cfg.see_same_tick_rate, (C, K, CW)
+        )
     col = jnp.arange(C, dtype=jnp.int32)
     own_mask = _pack_bool(col[:, None] == col[None, :])  # [C, CW]
     valid_mask = _pack_bool(jnp.ones((C,), bool))  # [CW] lanes < C
@@ -658,6 +752,54 @@ def tick(
     commit_tick = jnp.where(is_new, commit_arr, commit_tick)
     committed = committed & ~is_new
 
+    if cfg.general_deps:
+        # Materialize the factored snapshot into adjacency rows for the
+        # K candidate slots per column. The k-th new instance of column
+        # c (abs = next_pre[c] + k) depends on every LIVE instance of
+        # column e strictly below its dependency watermark d_e — the
+        # pre-tick frontier, bumped to the post-tick frontier for the
+        # peers its (post-widening) visibility draw saw — plus its
+        # immediate own-column predecessor (chain bit), which carries
+        # same-tick own-column ordering transitively. Edges to already
+        # retired instances are simply absent (their vertices left the
+        # graph); edges to executed-but-unpruned ones are satisfied by
+        # inactivity in the plane.
+        V = C * W
+        K = cfg.instances_per_tick
+        seen_k = depgraph_mod.unpack_mask(sees_k, C)  # [C, K, C] bool
+        d = jnp.where(
+            seen_k, next_instance[None, None, :],
+            state.next_instance[None, None, :],
+        )  # [C, K, C] per-peer dependency watermarks
+        abs_after = head[:, None] + jnp.mod(
+            w_iota[None, :] - head[:, None], W
+        )  # [C, W] (post-clear base: pruned slots already excluded)
+        dep_mask = (
+            proposed[None, None, :, :]
+            & (abs_after[None, None, :, :] < d[:, :, :, None])
+        )  # [C, K, C, W]
+        abs_new_k = state.next_instance[:, None] + jnp.arange(
+            K, dtype=jnp.int32
+        )  # [C, K]
+        prev_id = (
+            jnp.arange(C, dtype=jnp.int32)[:, None] * W
+            + jnp.mod(abs_new_k - 1, W)
+        )  # [C, K] vertex id of the immediate own-column predecessor
+        chain_mask = (
+            jnp.arange(V, dtype=jnp.int32)[None, None, :]
+            == prev_id[:, :, None]
+        ) & (abs_new_k - 1 >= head[:, None])[:, :, None]  # [C, K, V]
+        rows_k = depgraph_mod.pack_mask(
+            dep_mask.reshape(C, K, V) | chain_mask
+        )  # [C, K, VW]
+        rows = jnp.take_along_axis(
+            rows_k, jnp.clip(delta, 0, K - 1)[:, :, None], axis=1
+        )  # [C, W, VW]
+        VW = rows.shape[-1]
+        adj = jnp.where(
+            is_new.reshape(V)[:, None], rows.reshape(V, VW), adj
+        )
+
     # Telemetry: PreAccept fan-outs are the phase-2 plane; slow-path
     # Accept rounds show up as "retries" (the extra RTT the fast path
     # avoids); replica crash events land in leader_changes.
@@ -711,6 +853,7 @@ def tick(
         vis_bits=vis_bits,
         fpre=fpre,
         fpost=fpost,
+        adj=adj,
         exec_wm=exec_wm,
         rep_exec=rep_exec,
         rep_down=rep_down,
@@ -796,6 +939,30 @@ def check_invariants(
         out["gc_ok"] = jnp.all(state.head <= state.exec_wm) & jnp.all(
             state.rep_exec <= state.exec_wm[None, :]
         )
+    if cfg.general_deps:
+        # Dependency-graph safety: no executed instance has a remaining
+        # edge to an unexecuted one (every dependency was executed with
+        # or before it — retired deps' bits were cleared, executed-live
+        # deps are themselves below the watermark); and vertices outside
+        # the live ring carry no stale rows.
+        V = cfg.num_columns * cfg.window
+        exec_mask = (
+            state.proposed & (abs_slot < exec_base[:, None])
+        ).reshape(V)
+        deps_ok = depgraph_mod.rows_subset(
+            state.adj, depgraph_mod.pack_mask(exec_mask)
+        )  # [V]
+        rows_clear = jnp.all(
+            jnp.where(
+                state.proposed.reshape(V)[:, None],
+                jnp.uint32(0),
+                state.adj,
+            )
+            == jnp.uint32(0)
+        )
+        out["dep_safety_ok"] = (
+            jnp.all(~exec_mask | deps_ok) & rows_clear
+        )
     return out
 
 
@@ -812,4 +979,20 @@ def analysis_config(
     return BatchedEPaxosConfig(
         num_columns=5, window=32, instances_per_tick=2,
         num_exec_replicas=3, faults=faults, workload=workload,
+    )
+
+
+def analysis_config_general(
+    faults: FaultPlan = FaultPlan.none(),
+    workload: WorkloadPlan = WorkloadPlan.none(),
+) -> BatchedEPaxosConfig:
+    """The canonical small config for the GENERAL (materialized
+    dependency-graph) execute path — same shape as
+    :func:`analysis_config` with ``general_deps=True``, so the simtest
+    registry exercises the ``depgraph_execute`` plane consumer under
+    randomized fault/workload schedules."""
+    return BatchedEPaxosConfig(
+        num_columns=5, window=32, instances_per_tick=2,
+        num_exec_replicas=3, general_deps=True,
+        faults=faults, workload=workload,
     )

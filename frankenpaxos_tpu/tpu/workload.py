@@ -147,6 +147,15 @@ class WorkloadPlan:
     # Per-lane FIFO backlog bound (open-loop shaping): arrivals beyond
     # it are SHED (counted, never silently queued without bound).
     backlog_cap: int = 1024
+    # Traced CONFLICT-DENSITY knob for the dependency-graph backends
+    # (bpaxos; epaxos under general_deps): the probability that two
+    # concurrent commands interfere, i.e. the edge density of the
+    # adjacency bitmask ``ops/depgraph.py`` executes. None = the
+    # backend's own static knob (no state leaf). Set, it rides
+    # :class:`WorkloadState` like ``rate`` — quantized to 16ths on
+    # device (:func:`conflict_k16`), so the whole [conflict x load]
+    # surface is ONE compile, swept by :func:`set_conflict_rate`.
+    conflict_rate: Optional[float] = None
     # "trace": a recorded open-loop arrival schedule replayed by an
     # in-graph cursor — trace_len events, one int32 word per event
     # (``packing.encode_trace``: delta-encoded tick << 16 | lane), the
@@ -176,6 +185,13 @@ class WorkloadPlan:
     @property
     def has_reads(self) -> bool:
         return self.shaped and self.read_fraction > 0.0
+
+    @property
+    def has_conflict(self) -> bool:
+        """The traced conflict knob is engaged (a state leaf exists).
+        Independent of ``active``: conflict density shapes the
+        DEPENDENCY structure, not the arrival process."""
+        return self.conflict_rate is not None
 
     @classmethod
     def none(cls) -> "WorkloadPlan":
@@ -229,6 +245,10 @@ class WorkloadPlan:
         assert 0 <= self.think_time < 2**14
         assert self.backlog_cap >= 1
         assert self.zipf_s >= 0.0
+        if self.conflict_rate is not None:
+            assert 0.0 <= self.conflict_rate <= 1.0, (
+                "workload.conflict_rate is a probability"
+            )
 
     # -- serialization (one schema with harness/workload.py) ------------
 
@@ -266,6 +286,7 @@ class WorkloadState:
     # [drop, dup, crash, revive] Bernoulli rates (faults.make_rates).
     rate: jnp.ndarray  # [] float32 offered rate (shaped) | [0]
     fault_rates: jnp.ndarray  # [4] float32 (faults.traced) | [0]
+    conflict: jnp.ndarray  # [] float32 conflict density (has_conflict) | [0]
     # Arrival bookkeeping (shaped).
     acc: jnp.ndarray  # [L] int32 16-bit fixed-point accumulator
     racc: jnp.ndarray  # [L] int32 read-split accumulator | [0]
@@ -315,6 +336,11 @@ def make_state(
             else jnp.zeros((0,), jnp.float32)
         ),
         fault_rates=faults_mod.make_rates(faults),
+        conflict=(
+            jnp.full((), plan.conflict_rate, jnp.float32)
+            if plan.has_conflict
+            else jnp.zeros((0,), jnp.float32)
+        ),
         acc=jnp.zeros((Ls,), z32),
         racc=jnp.zeros((Ls if plan.has_reads else 0,), z32),
         backlog=jnp.zeros((Ls,), z32),
@@ -598,6 +624,31 @@ def set_rate(wls: WorkloadState, rate: float) -> WorkloadState:
     )
 
 
+def set_conflict_rate(wls: WorkloadState, rate: float) -> WorkloadState:
+    """The conflict-density sweep axis: a new traced conflict rate,
+    same compile (the [conflict x load] surface of the depgraph
+    backends replays one program)."""
+    assert wls.conflict.shape == (), (
+        "set_conflict_rate needs a plan with conflict_rate set"
+    )
+    return dataclasses.replace(
+        wls, conflict=jnp.full((), rate, jnp.float32)
+    )
+
+
+def conflict_k16(plan: WorkloadPlan, wls: WorkloadState, static_rate: float):
+    """The conflict knob as an int32 numerator over 16 — the shape the
+    bit-sliced sampler (``ops/depgraph.bernoulli_words_k16``) consumes.
+    Traced (from ``wls.conflict``) when the plan carries a conflict
+    rate; otherwise the backend's static knob, quantized the same way,
+    as a trace-time Python int."""
+    if plan.has_conflict:
+        return jnp.clip(
+            jnp.round(wls.conflict * 16.0), 0, 16
+        ).astype(jnp.int32)
+    return int(round(static_rate * 16))
+
+
 def set_fault_rates(
     wls: WorkloadState,
     drop: float = 0.0,
@@ -664,6 +715,8 @@ def summary(plan: WorkloadPlan, wls: WorkloadState) -> dict:
     occupancy, and queue-wait percentiles."""
     wls = jax.device_get(wls)
     out = {"active": plan.active, "arrival": plan.arrival}
+    if plan.has_conflict:
+        out["conflict_rate"] = float(wls.conflict)
     if not plan.active:
         return out
     out.update(

@@ -225,6 +225,69 @@ def test_feedforward_picks_the_bottleneck_role():
     assert d["actions"][0]["role"] == "proxies"
 
 
+def _envelope_payload(ratios):
+    return {
+        "captures": {
+            "kernel_microbench_rX.json": [
+                {"plane": f"p{i}", "ratio": r}
+                for i, r in enumerate(ratios)
+            ]
+        }
+    }
+
+
+def test_confidence_weighted_step_scales_the_up_stride():
+    """A tight capture envelope earns multi-instance scale-up strides
+    (``max_step`` x ``costmodel.envelope_confidence``); a wide or
+    missing record decays back to single probes; scale-down always
+    gives back one ``step`` regardless."""
+    pol = AutoscalerPolicy(
+        cooldown_drains=0, trough_after=1, max_step=4
+    )
+    tight = _envelope_payload([1.0, 1.05, 0.98])
+    asc = Autoscaler(
+        pol, {"groups": (9, 1)}, initial={"groups": 1}, envelope=tight
+    )
+    conf = asc.feedforward_confidence
+    assert conf["samples"] == 3 and conf["confidence"] > 0.9
+    assert asc._up_step() == 4
+    d = asc.decide(_status(True, 40.0))
+    assert d["actions"] == [{"role": "groups", "from": 1, "to": 5}]
+    # The feedforward blob carries the confidence evidence.
+    ff = asc.events[-1]["feedforward"]
+    assert ff["up_step"] == 4
+    assert ff["envelope_confidence"]["spread"] == conf["spread"]
+    # Shrink stays one step however confident the model is.
+    d = asc.decide(_status(False, 1.0))
+    assert d["actions"] == [{"role": "groups", "from": 5, "to": 4}]
+
+    # Wide spread (10x): confidence 0.1, stride floors at step.
+    wide = Autoscaler(
+        pol, {"groups": (9, 1)}, initial={"groups": 1},
+        envelope=_envelope_payload([0.3, 3.0]),
+    )
+    assert wide.feedforward_confidence["confidence"] == pytest.approx(
+        0.1
+    )
+    assert wide._up_step() == 1
+    # No capture evidence at all: zero confidence, conservative probe.
+    bare = Autoscaler(
+        pol, {"groups": (9, 1)}, initial={"groups": 1},
+        envelope={"captures": {}},
+    )
+    assert bare.feedforward_confidence["confidence"] == 0.0
+    assert bare._up_step() == 1
+    # The default policy (max_step=1) keeps the bit-identical
+    # single-step ladder whatever the committed envelope says.
+    dflt = Autoscaler(
+        AutoscalerPolicy(cooldown_drains=0),
+        {"groups": (3, 1)}, initial={"groups": 1},
+    )
+    assert dflt._up_step() == 1
+    # max_step rides the policy's JSON round trip.
+    assert AutoscalerPolicy.from_dict(pol.to_dict()) == pol
+
+
 def test_autoscaler_state_round_trip_replays_bit_exactly():
     seq = (
         [_status(True, 40.0, scale=0.9)] * 4

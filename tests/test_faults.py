@@ -444,12 +444,12 @@ def test_sweep_smoke():
 
 
 def test_registry_covers_every_backend_and_reps_run():
-    """Registry sanity, tier-1 sized: all 14 backends are registered
+    """Registry sanity, tier-1 sized: all 16 backends are registered
     with valid config factories (construction exercises every
     __post_init__ + FaultPlan.validate), and four representative specs
     run a none-plan schedule with green invariants and progress. The
-    full 14-backend run is the slow-marked test below."""
-    assert len(simtest.SPECS) == 14
+    full 16-backend run is the slow-marked test below."""
+    assert len(simtest.SPECS) == 16
     for spec in simtest.SPECS.values():
         cfg = spec.make_config(FaultPlan.none())
         assert cfg.faults == FaultPlan.none()
@@ -464,7 +464,7 @@ def test_registry_covers_every_backend_and_reps_run():
 
 @pytest.mark.slow
 def test_every_registered_spec_runs_a_plain_schedule():
-    """Full-fleet variant: all 14 backends run one none-plan schedule
+    """Full-fleet variant: all 16 backends run one none-plan schedule
     with green invariants and nonzero progress."""
     for name, spec in simtest.SPECS.items():
         res = simtest.run_schedule(

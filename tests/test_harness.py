@@ -213,7 +213,9 @@ def test_microbench_smoke():
     from frankenpaxos_tpu.harness import microbench
 
     rows = []
-    rows += microbench.bench_depgraph(num_commands=300)
+    rows += microbench.bench_depgraph(
+        num_commands=300, batch=16, window=16, rounds=1, closure_iters=4
+    )
     rows += microbench.bench_int_prefix_set(num_ops=2000)
     rows += microbench.bench_buffer_map(num_ops=2000)
     rows += microbench.bench_conflict_index(num_ops=500)
@@ -222,6 +224,7 @@ def test_microbench_smoke():
     }
     assert {r["case"] for r in rows if r["name"] == "depgraph"} == {
         "Tarjan", "IncrementalTarjan", "Naive", "Zigzag",
+        "bitmask_closure", "pointer_walk",
     }
     assert all(r["ops_per_sec"] > 0 for r in rows)
 

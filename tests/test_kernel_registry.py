@@ -16,6 +16,7 @@ import pytest
 from frankenpaxos_tpu.ops import registry
 from frankenpaxos_tpu.ops.registry import KernelPolicy
 from frankenpaxos_tpu.tpu import (
+    bpaxos_batched,
     compartmentalized_batched,
     craq_batched,
     fastmultipaxos_batched,
@@ -109,6 +110,7 @@ def test_registry_coverage_names_all_backends():
     assert cov["horizontal"] == ("horizontal_vote",)
     assert cov["scalog"] == ("scalog_cut_commit",)
     assert cov["compartmentalized"] == ("compartmentalized_grid_vote",)
+    assert cov["bpaxos"] == ("depgraph_execute",)
 
 
 def test_block_for_exact_model_and_legacy():
@@ -645,3 +647,48 @@ def test_megakernel_with_elections_and_reads(seed=1):
         )
         hashes[name] = _mp_full_state_hash(st)
     assert hashes["mega"] == hashes["reference"]
+
+
+# ---------------------------------------------------------------------------
+# BPaxos: the depgraph_execute plane through the registry (3 seeds,
+# faults engaged)
+# ---------------------------------------------------------------------------
+
+BPAXOS_FIELDS = (
+    "next_cmd", "gc_head", "head_r", "proposed", "propose_tick",
+    "commit_tick", "committed", "rep_commit_tick", "adj",
+    "committed_total", "executed_total", "retired_total", "coexecuted",
+    "lat_sum", "lat_hist",
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bpaxos_interpret_matches_reference(seed):
+    """The batched dependency-graph closure routed through the fused
+    kernel (interpret mode) equals the reference path bit for bit over
+    whole faulty runs — drops + jitter stretch the commit round and a
+    healing leader partition stalls dependency chains, so the closure
+    sees stalled, cyclic, and bursty graphs."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    bp = bpaxos_batched
+    plan = FaultPlan(
+        drop_rate=0.05, jitter=2,
+        partition=(0, 0, 1), partition_start=10, partition_heal=25,
+    )
+
+    def make_cfg(pol):
+        return bp.BatchedBPaxosConfig(
+            num_leaders=3, window=16, cmds_per_tick=2,
+            conflict_rate=0.375, num_replicas=4, faults=plan,
+            kernels=pol,
+        )
+
+    assert (
+        registry.resolve_mode(
+            "depgraph_execute", make_cfg(KernelPolicy("interpret"))
+        )
+        == "interpret"
+    )
+    hashes = _run_both(bp, make_cfg, 40, seed, BPAXOS_FIELDS)
+    assert hashes["interpret"] == hashes["reference"]

@@ -506,3 +506,87 @@ def test_epaxos_sharded_matches_unsharded():
         b = np.asarray(jax.device_get(getattr(sharded, field)))
         assert (a == b).all(), field
     assert int(plain.executed_total) > 1000
+
+
+def test_general_deps_matches_factored_bit_exactly():
+    """``general_deps=True`` swaps the factored watermark fixpoint for
+    a materialized [C*W, ceil(C*W/32)] adjacency driven through the
+    ``depgraph_execute`` plane — and the run stays state-equal tick
+    for tick to the factored twin on every leaf except the adjacency
+    itself, with GC replicas and faults engaged, and the dep-graph
+    safety invariant (nothing executes before its dependency rows are
+    contained in the executed set) holding at the end."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+
+    base = dict(
+        num_columns=3, window=8, instances_per_tick=2,
+        see_same_tick_rate=0.5,
+    )
+    variants = {
+        "plain": {},
+        "gc": dict(num_exec_replicas=2),
+        "faulty": dict(
+            faults=FaultPlan(
+                drop_rate=0.1, jitter=1, partition=(0, 1, 0),
+                partition_start=10, partition_heal=30,
+            )
+        ),
+    }
+    for name, kw in variants.items():
+        for seed in (0, 1):
+            cfg_f = BatchedEPaxosConfig(**base, **kw)
+            cfg_g = dataclasses.replace(cfg_f, general_deps=True)
+            key = jax.random.PRNGKey(seed)
+            t0 = jnp.zeros((), jnp.int32)
+            sf, tf = run_ticks(cfg_f, init_state(cfg_f), t0, 60, key)
+            sg, tg = run_ticks(cfg_g, init_state(cfg_g), t0, 60, key)
+            assert int(sf.executed_total) > 0, (name, seed)
+            for field in dataclasses.fields(sf):
+                if field.name == "adj":
+                    continue
+                la = jax.tree_util.tree_leaves(getattr(sf, field.name))
+                lb = jax.tree_util.tree_leaves(getattr(sg, field.name))
+                assert len(la) == len(lb), (name, field.name)
+                for a, b in zip(la, lb):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b),
+                        err_msg=f"{name}[{seed}].{field.name}",
+                    )
+            inv = check_invariants(cfg_g, sg, tg)
+            assert "dep_safety_ok" in inv
+            assert all(bool(v) for v in inv.values()), (name, inv)
+
+
+def test_general_deps_traced_conflict_knob_sweeps_density():
+    """A WorkloadPlan carrying ``conflict_rate`` turns the same-tick
+    visibility density into TRACED state: the general path still
+    matches the factored twin under it, and re-tracing is not needed
+    to sweep it (set_conflict_rate edits state, the compiled program
+    replays)."""
+    from frankenpaxos_tpu.tpu import workload as workload_mod
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    plan = WorkloadPlan(
+        arrival="constant", rate=1.5, conflict_rate=0.5
+    )
+    cfg_f = BatchedEPaxosConfig(
+        num_columns=3, window=8, instances_per_tick=2, workload=plan,
+    )
+    cfg_g = dataclasses.replace(cfg_f, general_deps=True)
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+    sf, _ = run_ticks(cfg_f, init_state(cfg_f), t0, 50, key)
+    sg, _ = run_ticks(cfg_g, init_state(cfg_g), t0, 50, key)
+    np.testing.assert_array_equal(
+        np.asarray(sf.vis_bits), np.asarray(sg.vis_bits)
+    )
+    assert int(sf.executed_total) == int(sg.executed_total) > 0
+    # The knob is state, not structure: resweep the density on the
+    # SAME compiled run_ticks via set_conflict_rate.
+    st = init_state(cfg_g)
+    st = dataclasses.replace(
+        st, workload=workload_mod.set_conflict_rate(st.workload, 0.9)
+    )
+    s9, t9 = run_ticks(cfg_g, st, t0, 50, key)
+    inv = check_invariants(cfg_g, s9, t9)
+    assert all(bool(v) for v in inv.values()), inv
