@@ -1,25 +1,32 @@
 """Unified static-analysis subsystem for the batched backends.
 
-Two layers behind one rule registry (``core.RULES``):
+Three layers behind one rule registry (``core.RULES``):
 
 * **AST layer** (``rules_ast.py``) — the repo-wide source contracts:
   buffer donation on every jitted ``*State`` entry point, the telemetry
   carry/record contract, the FaultPlan accept/validate/apply contract,
-  Pallas containment + kernel-registry coverage, transitive host-sync
-  purity of every tick body, and a State-field dead-write detector.
+  Pallas containment + kernel-registry coverage, and transitive
+  host-sync purity of every tick body.
 * **Trace layer** (``rules_trace.py``) — jits every backend at its
   ``analysis_config()`` and inspects the artifact: jaxpr dtype-policy
   (no unallowlisted narrow->wide conversions), compiled-HLO donation
   effectiveness (``input_output_alias`` covers the State buffers), and
   a retrace guard (equal configs hit the jit cache).
+* **Dataflow layer** (``rules_dataflow.py`` over ``dataflow.py``'s
+  abstract interpreter) — semantic facts inside the traced tick
+  jaxpr: PRNG key lineage (one declared salt family per draw, no
+  stream reuse, salt disjointness under the traced fold arithmetic),
+  reaching-definitions dead-write detection over State leaves, and
+  donation use-after-alias ordering.
 
 Diagnostics are structured (:class:`~.core.Finding`: rule id,
 file:line, message, stable allowlist key); every exemption lives in
 ``allowlists.py`` with a mandatory reason, and stale entries are
 findings themselves. CLI::
 
-    python -m frankenpaxos_tpu.analysis [--rule ID] [--layer ast|trace]
-        [--backends a,b] [--json] [--list]
+    python -m frankenpaxos_tpu.analysis [--rule ID]
+        [--layer ast|trace|dataflow] [--backends a,b] [--json]
+        [--list] [--budget SECONDS]
 
 Exit code = finding count. The tier-1 lint tests
 (``tests/test_*_lint.py``) are thin wrappers invoking rules by id, so
@@ -39,6 +46,10 @@ from frankenpaxos_tpu.analysis.core import (  # noqa: F401
 
 def rule_count() -> int:
     """Number of registered rules (imports the rule modules)."""
-    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+    from frankenpaxos_tpu.analysis import (  # noqa: F401
+        rules_ast,
+        rules_dataflow,
+        rules_trace,
+    )
 
     return len(RULES)

@@ -22,13 +22,55 @@ from __future__ import annotations
 from typing import Dict, Tuple
 
 SUPPRESS: Dict[str, Dict[str, str]] = {
-    # rule id -> {finding key -> reason}. Nothing is currently exempt.
+    # rule id -> {finding key -> reason}.
     # Example:
     # "donation-jit": {
     #     "foo_batched.py:replay_ticks":
     #         "replay keeps the input state for post-hoc divergence "
     #         "dumps",
     # },
+    "state-dead-write-reachable": {
+        # Leaves below carry real protocol observability that today is
+        # read only by the test suites (or by a plan-gated path the
+        # analysis-config trace structurally omits) — they are kept
+        # deliberately, not dead by accident. Surfacing them through
+        # stats()/telemetry removes the entry.
+        "compartmentalized:rd_row":
+            "read-path partition-defer plane: consumed by the grid-row "
+            "re-probe only when the fault plan carries an active "
+            "partition cut, which the analysis trace (partition=()) "
+            "structurally omits",
+        "craq:crashes":
+            "crash census pinned by the checkpoint/restore suite "
+            "(tests/test_checkpoint.py); not yet surfaced in stats()",
+        "craq:resyncs":
+            "tail-resync census pinned by the checkpoint/restore suite "
+            "(tests/test_checkpoint.py); not yet surfaced in stats()",
+        "epaxos:snapshots_served":
+            "snapshot-read census cross-validated by "
+            "tests/test_tpu_epaxos.py; not yet surfaced in a host "
+            "summary",
+        "epaxos:fast_path_total":
+            "fast-path commit census cross-validated by "
+            "tests/test_tpu_epaxos.py; not yet surfaced in a host "
+            "summary",
+        "fastpaxos:chosen_fast":
+            "fast-round commit census pinned by "
+            "tests/test_tpu_fastpaxos.py; not yet surfaced in a host "
+            "summary",
+        "grid:chosen_tick":
+            "per-slot quorum-formation tick read by the randomized-"
+            "family and cross-validation suites to check commit "
+            "ordering; not yet surfaced in a host summary",
+        "mencius:chosen_tick":
+            "per-slot quorum-formation tick read by the randomized-"
+            "family and cross-validation suites to check commit "
+            "ordering; not yet surfaced in a host summary",
+        "multipaxos:chosen_tick":
+            "per-slot quorum-formation tick read by the randomized-"
+            "family and cross-validation suites to check commit "
+            "ordering; not yet surfaced in a host summary",
+    },
 }
 
 # (backend, "src->dst") -> (exact count, reason). Counts are taken at

@@ -1,0 +1,213 @@
+"""Flagship-shape analysis under a wall-clock budget (``--budget``).
+
+The default lint leg runs every rule at each backend's tiny
+``analysis_config()`` shape — deterministic and fast, but some
+contracts are worth re-checking at the shapes bench.py actually
+serves. ``run_budget(seconds)`` re-points the shared tick-trace
+caches (``rules_trace.CFG_FACTORY``) at per-backend FLAGSHIP shapes
+and runs the trace + dataflow layers rule by rule, with:
+
+* per-rule wall-clock accounting (printed and in the JSON report);
+* a hard start-gate: a rule only STARTS while budget remains, and
+  every rule that never started is listed in the skipped-rules
+  report (cheap pure-graph dataflow rules run first, compile-heavy
+  trace rules last, so small budgets still buy real coverage);
+* no allowlist hygiene: the budget leg applies suppressions but does
+  not emit ``allowlist-stale`` findings — the default leg owns
+  hygiene, and a flagship re-run must not double-report it.
+
+Shape-calibrated rules are excluded (see ``EXCLUDE``):
+``trace-dtype-policy`` pins exact widening counts at the analysis
+shapes, and ``donation-hazard``'s control-plane size exemption is
+calibrated there too — running either at flagship shapes would
+report calibration drift that is really shape drift. Everything
+else in the trace/dataflow layers runs
+unmodified — rules that trace through the shared caches see flagship
+jaxprs; rules that build their own configs keep their own shapes.
+
+This is opt-in (CLI ``--budget SECONDS``, ``LINT_BUDGET=N`` in
+scripts/lint.sh) and never part of the default fail-fast path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+# Per-backend flagship size overrides, applied with dataclasses.replace
+# on top of analysis_config(**plans) — plan structure (traced faults,
+# shaped workload, lifecycle) comes from the caller, shapes from here.
+# multipaxos matches the bench.py flagship (10k simulated acceptors:
+# f=1 -> 3 acceptors x 3334 groups); the others scale their primary
+# lane axis into the thousands with serving-sized windows.
+FLAGSHIP: Dict[str, dict] = {
+    "bpaxos": dict(num_leaders=64, window=64, cmds_per_tick=8),
+    "caspaxos": dict(num_registers=1024, num_leaders=3),
+    "compartmentalized": dict(
+        num_groups=64, num_proxy_leaders=16, num_batchers=8,
+        num_unbatchers=8, window=32, batch_size=4,
+        arrivals_per_tick=4,
+    ),
+    "craq": dict(
+        num_chains=256, num_keys=64, window=16, writes_per_tick=4,
+        reads_per_tick=4,
+    ),
+    "epaxos": dict(num_columns=64, window=64, instances_per_tick=8),
+    "fasterpaxos": dict(num_groups=1024, window=32, slots_per_tick=8),
+    "fastmultipaxos": dict(
+        num_groups=1024, window=32, cmd_window=32, cmds_per_tick=8,
+    ),
+    "fastpaxos": dict(num_groups=1024, window=32, instances_per_tick=8),
+    "grid": dict(rows=16, cols=16, window=32, slots_per_tick=8),
+    "horizontal": dict(
+        num_groups=1024, window=32, slots_per_tick=8, alpha=16,
+    ),
+    "mencius": dict(num_leaders=64, window=64, slots_per_tick=8),
+    "multipaxos": dict(
+        num_groups=3334, window=64, slots_per_tick=8, retry_timeout=16,
+    ),
+    "scalog": dict(num_shards=4096),
+    "unreplicated": dict(num_servers=4096, window=32, ops_per_tick=8),
+    "vanillamencius": dict(num_servers=64, window=64, slots_per_tick=8),
+}
+
+# Rules whose semantics are calibrated to the analysis shapes: running
+# them at flagship would report calibration drift, not new facts.
+# trace-dtype-policy: DTYPE_WIDENING pins are count-exact at the
+# analysis shapes. donation-hazard: its control-plane size exemption
+# (DONATION_MIN_ELEMS) is likewise calibrated at the analysis shapes —
+# at flagship sizes the repo-wide delta-read idiom (telemetry/
+# accounting deltas computed from the pre-update value after the
+# update exists, which XLA's buffer assigner orders safely) crosses
+# the threshold and reports idiom, not hazard.
+EXCLUDE = ("trace-dtype-policy", "donation-hazard")
+
+# Rules that COMPILE (jit caches, HLO, checkpoint replay, meshes) —
+# scheduled last so a small budget spends itself on the cheap
+# trace-the-jaxpr rules first.
+COMPILE_HEAVY = (
+    "trace-retrace-guard",
+    "trace-workload-retrace",
+    "trace-elastic-retrace",
+    "trace-checkpoint-restore",
+    "trace-shardmap-kernel",
+    "trace-donation-alias",
+    "trace-fleet-onecompile",
+)
+
+
+def flagship_config(backend: str, **plan_kwargs):
+    """analysis_config(**plans) resized to the backend's flagship
+    shape — the CFG_FACTORY the budget leg installs."""
+    from frankenpaxos_tpu.analysis import rules_trace as _rt
+
+    cfg = _rt._module(backend).analysis_config(**plan_kwargs)
+    return dataclasses.replace(cfg, **FLAGSHIP.get(backend, {}))
+
+
+def _schedule(layers: Sequence[str]) -> List[str]:
+    from frankenpaxos_tpu.analysis import core
+
+    ids = sorted(
+        r.id for r in core.RULES.values()
+        if r.layer in layers and r.id not in EXCLUDE
+    )
+    df = [i for i in ids if core.RULES[i].layer == "dataflow"]
+    cheap = [
+        i for i in ids
+        if core.RULES[i].layer != "dataflow" and i not in COMPILE_HEAVY
+    ]
+    heavy = [i for i in COMPILE_HEAVY if i in ids]
+    return df + cheap + heavy
+
+
+def run_budget(
+    seconds: float,
+    backends: Optional[Sequence[str]] = None,
+    json_out: bool = False,
+) -> int:
+    """Run the trace + dataflow layers at flagship shapes until the
+    budget is spent. Returns the exit code (finding count, capped)."""
+    import json as _json
+    import sys
+
+    from frankenpaxos_tpu.analysis import (
+        allowlists,
+        cli,
+        core,
+        rules_dataflow,
+        rules_trace,
+    )
+
+    ctx = core.Context()
+    if backends:
+        ctx.backends = tuple(backends)
+    order = _schedule(("trace", "dataflow"))
+    deadline = time.monotonic() + float(seconds)
+
+    findings = []
+    rows = []  # (rule_id, status, elapsed, n_findings)
+    rules_trace.CFG_FACTORY = flagship_config
+    rules_trace._TICK_TRACE_CACHE.clear()
+    rules_dataflow.clear_cache()
+    try:
+        for rid in order:
+            if time.monotonic() >= deadline:
+                rows.append((rid, "skipped", None, None))
+                continue
+            t0 = time.monotonic()
+            try:
+                raw = core.RULES[rid].check(ctx)
+            except Exception as e:  # a flagship shape a rule rejects
+                rows.append((rid, f"error: {e}", time.monotonic() - t0,
+                             None))
+                continue
+            allow = allowlists.suppressions(rid)
+            kept = [f for f in raw if f.key not in allow]
+            findings.extend(kept)
+            rows.append((rid, "ok", time.monotonic() - t0, len(kept)))
+    finally:
+        rules_trace.CFG_FACTORY = None
+        rules_trace._TICK_TRACE_CACHE.clear()
+        rules_dataflow.clear_cache()
+
+    ran = [r for r in rows if r[1] == "ok"]
+    skipped = [r for r in rows if r[1] == "skipped"]
+    if json_out:
+        print(_json.dumps({
+            "version": core.ANALYSIS_VERSION,
+            "mode": "budget",
+            "budget_seconds": float(seconds),
+            "rules": [
+                {
+                    "rule": rid, "status": status,
+                    "seconds": None if dt is None else round(dt, 3),
+                    "findings": n,
+                }
+                for rid, status, dt, n in rows
+            ],
+            "finding_count": len(findings),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=1))
+    else:
+        for rid, status, dt, n in rows:
+            clock = "      -" if dt is None else f"{dt:7.2f}s"
+            extra = "" if n is None else f"  {n} finding(s)"
+            print(f"{rid:30s} {status:8s} {clock}{extra}")
+        for f in findings:
+            print(f"{f.rule}: {f.location()}: {f.message}")
+        print(
+            f"budget {float(seconds):.0f}s: {len(ran)} rule(s) ran, "
+            f"{len(skipped)} skipped, {len(findings)} finding(s) at "
+            f"flagship shapes, analysis version "
+            f"{core.ANALYSIS_VERSION}",
+            file=sys.stderr,
+        )
+        if skipped:
+            print(
+                "skipped (budget exhausted): "
+                + ", ".join(r[0] for r in skipped),
+                file=sys.stderr,
+            )
+    return min(len(findings), cli.EXIT_CAP)

@@ -5,6 +5,11 @@ Runs the full rule registry (or a ``--rule`` / ``--layer`` /
 finding count (0 = clean; capped at 100 so the code never wraps mod
 256). ``--json`` emits the structured report on stdout for CI
 artifacts; ``scripts/lint.sh`` is a thin wrapper around this module.
+
+``--budget SECONDS`` is a separate opt-in mode (never part of the
+default path): it re-runs the trace + dataflow layers at each
+backend's FLAGSHIP shape with per-rule wall-clock accounting and a
+skipped-rules report — see ``analysis/budget.py``.
 """
 
 from __future__ import annotations
@@ -22,7 +27,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m frankenpaxos_tpu.analysis",
         description=(
             "Static analysis for the batched backends: AST contract "
-            "rules + jaxpr/HLO trace rules. Exit code = finding count."
+            "rules, jaxpr/HLO trace rules, and jaxpr dataflow rules. "
+            "Exit code = finding count."
         ),
     )
     parser.add_argument(
@@ -33,9 +39,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--layer",
-        choices=("ast", "trace"),
+        choices=("ast", "trace", "dataflow"),
         action="append",
-        help="run only this layer (repeatable; default: both)",
+        help="run only this layer (repeatable; default: all three)",
     )
     parser.add_argument(
         "--backends",
@@ -50,26 +56,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--list",
         action="store_true",
-        help="list registered rules and exit 0",
+        help="list registered rules (grouped by layer) and exit 0",
+    )
+    parser.add_argument(
+        "--budget",
+        type=float,
+        metavar="SECONDS",
+        help=(
+            "opt-in flagship-shape mode: run the trace + dataflow "
+            "layers at production shapes under this wall-clock "
+            "budget, with per-rule timings and a skipped-rules "
+            "report (bypasses the default lint path entirely)"
+        ),
     )
     args = parser.parse_args(argv)
 
     from frankenpaxos_tpu.analysis import core
 
     # Import for side effects: rule registration (before --list).
-    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+    from frankenpaxos_tpu.analysis import (  # noqa: F401
+        rules_ast,
+        rules_dataflow,
+        rules_trace,
+    )
 
     if args.list:
-        for r in sorted(core.RULES.values(), key=lambda r: (r.layer, r.id)):
-            print(f"{r.id:28s} [{r.layer}]  {r.doc}")
+        for layer in ("ast", "trace", "dataflow"):
+            rules = sorted(
+                (r for r in core.RULES.values() if r.layer == layer),
+                key=lambda r: r.id,
+            )
+            print(f"[{layer}] ({len(rules)} rules)")
+            for r in rules:
+                print(f"  {r.id:28s} {r.doc}")
         return 0
+
+    if args.budget is not None:
+        from frankenpaxos_tpu.analysis import budget
+
+        backends = None
+        if args.backends:
+            backends = tuple(
+                b.strip() for b in args.backends.split(",") if b.strip()
+            )
+        return budget.run_budget(
+            args.budget, backends=backends, json_out=args.json
+        )
 
     ctx = core.Context()
     if args.backends:
         ctx.backends = tuple(
             b.strip() for b in args.backends.split(",") if b.strip()
         )
-    layers = tuple(args.layer) if args.layer else ("ast", "trace")
+    layers = (
+        tuple(args.layer) if args.layer else ("ast", "trace", "dataflow")
+    )
     try:
         report = core.run(rule_ids=args.rule, layers=layers, ctx=ctx)
     except KeyError as e:

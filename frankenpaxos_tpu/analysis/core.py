@@ -54,7 +54,17 @@ from frankenpaxos_tpu.analysis import astutil
 # through its helpers or jnp.where writes) and the backend-inventory
 # floor rises to 15 with the bpaxos backend (the depgraph_execute
 # plane's home).
-ANALYSIS_VERSION = "2.3"
+# 2.4: the dataflow layer (rules_dataflow.py over dataflow.py's
+# abstract interpreter): prng-stream-lineage + prng-salt-disjoint
+# (key provenance through fold_in/split/random_bits — one declared
+# salt family per draw, no stream reuse, declared salts disjoint
+# under the traced fold arithmetic), state-dead-write-reachable
+# (reaching definitions over State leaves; RETIRES the AST
+# state-dead-write rule and its self-feed heuristic), and
+# donation-hazard (no donated input consumed after its aliased
+# output exists). The CLI gains --budget SECONDS (flagship-shape
+# trace+dataflow leg with per-rule wall clocks, analysis/budget.py).
+ANALYSIS_VERSION = "2.4"
 
 # Rule id reserved for the engine's own stale-allowlist findings.
 STALE_RULE = "allowlist-stale"
@@ -83,7 +93,7 @@ class Rule:
     engine applies the rule's allowlist afterwards."""
 
     id: str
-    layer: str  # "ast" | "trace"
+    layer: str  # "ast" | "trace" | "dataflow"
     doc: str  # one-line description (CLI --list, README table)
     check: Callable[["Context"], List[Finding]]
 
@@ -104,6 +114,10 @@ class Context:
     # Fixture trees are not importable packages: rules that must import
     # repo modules (kernel registry introspection) skip when False.
     importable: bool = True
+    # Dataflow-layer targets: None = the real backend registry; the
+    # engine's own tests point the rules at importable fixture modules
+    # (entries are modules, or (name, module) pairs).
+    dataflow_targets: Optional[Sequence] = None
 
     def is_real_tree(self) -> bool:
         return self.root == astutil.PKG_ROOT
@@ -151,17 +165,24 @@ class Report:
 
 def run(
     rule_ids: Optional[Sequence[str]] = None,
-    layers: Sequence[str] = ("ast", "trace"),
+    layers: Sequence[str] = ("ast", "trace", "dataflow"),
     ctx: Optional[Context] = None,
 ) -> Report:
     """Run the selected rules and apply/validate their allowlists.
 
     ``rule_ids=None`` runs every registered rule in ``layers``. Unknown
     rule ids raise (a CI invocation of a renamed rule must fail loudly,
-    not silently check nothing).
+    not silently check nothing). The default layer set includes
+    ``dataflow`` so stale allowlist entries for dataflow-layer rules
+    are examined (and rejected) by exactly the same walk as every
+    other layer's.
     """
     # Import for side effects: rule registration.
-    from frankenpaxos_tpu.analysis import rules_ast, rules_trace  # noqa: F401
+    from frankenpaxos_tpu.analysis import (  # noqa: F401
+        rules_ast,
+        rules_dataflow,
+        rules_trace,
+    )
     from frankenpaxos_tpu.analysis import allowlists
 
     ctx = ctx or Context()

@@ -1,0 +1,594 @@
+"""Dataflow analysis over closed jaxprs.
+
+The AST layer sees source text and the trace layer sees whole-artifact
+facts (alias maps, cache sizes, equation censuses); neither can answer
+*dataflow* questions about the compiled tick — "which PRNG stream does
+this draw descend from?", "does this State leaf ever reach an output
+anyone reads?", "is a donated input consumed after its aliased output
+exists?". This module is the shared machinery the ``dataflow`` rule
+layer (``rules_dataflow.py``) stands on:
+
+* :func:`linearize` flattens a closed jaxpr into a single ordered list
+  of :class:`Node` records, inlining every sub-jaxpr it meets —
+  ``pjit``/call bodies verbatim, ``scan``/``while`` bodies ONCE with
+  explicit phi nodes modelling the carry feedback edge, and **every**
+  ``cond`` branch tagged with a branch context so mutually-exclusive
+  paths stay distinguishable. Values get dense integer ids; known
+  scalar literals (fold-in salts!) are kept in a side table and
+  propagated through dtype/shape-preserving ops.
+
+* :func:`key_lineage` abstractly interprets the linearized program
+  over a key-provenance lattice (:class:`KeyProv`): a provenance is a
+  root id plus the exact ``fold_in``/``split`` path applied to it,
+  with fold constants >= :data:`FAMILY_MIN` recorded as salt-family
+  markers. Loop-carried keys are *widened* (fresh root, markers kept)
+  so one inlined iteration never fabricates equalities across
+  iterations; keys built from non-key data are *foreign*. Every
+  ``random_bits`` draw is collected with its provenance and branch
+  context.
+
+* :func:`reach_analysis` computes forward reachability from the tick's
+  input State leaves to every value (bitmasks over leaf indices,
+  iterated to fixpoint across phi feedback), plus per-value producer
+  and consumer node indices. ``rules_dataflow`` turns that into
+  reaching-definitions over State leaves (dead-write detection) and
+  donation-hazard ordering checks.
+
+Everything here is pure graph walking over already-traced jaxprs — no
+compilation, no device work — so it is cheap enough to run against all
+fifteen backends inside the default lint leg.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Fold-in constants at or above this value are salt-FAMILY markers
+# (FAULT_SALT = 0x5EED, WORKLOAD_SALT = 0x10AD, LIFECYCLE_SALT =
+# 0x11FE all clear it); smaller constants are per-plane or per-sweep
+# offsets folded INSIDE a family (``fault_key(key, salt=2)``,
+# ``fold_in(key, lane)``) and never establish family membership.
+FAMILY_MIN = 4096
+
+# Width of one salt family's private offset interval: a family base B
+# owns [B, B + FAMILY_SPAN). Plane salts folded on top of a family
+# base must stay below this, or two families' effective fold constants
+# could collide (the prng-salt-disjoint rule enforces both halves).
+FAMILY_SPAN = 256
+
+# Primitives whose output carries its (single key-ish) input's
+# provenance unchanged: pure dtype/layout plumbing the PRNG helpers
+# thread keys through (``random_unwrap`` -> u32[2] -> ``random_wrap``
+# round trips, scalar converts ahead of fold_in).
+_TRANSPARENT = frozenset({
+    "squeeze",
+    "reshape",
+    "broadcast_in_dim",
+    "convert_element_type",
+    "transpose",
+    "copy",
+    "rev",
+    "stop_gradient",
+    "device_put",
+})
+
+# Call-like primitives whose single sub-jaxpr is inlined verbatim.
+_CALL_PRIMS = frozenset({
+    "pjit",
+    "closed_call",
+    "core_call",
+    "xla_call",
+    "remat",
+    "remat2",
+    "checkpoint",
+    "custom_jvp_call",
+    "custom_vjp_call",
+    "custom_jvp_call_jaxpr",
+    "custom_vjp_call_jaxpr",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """One linearized equation (or synthetic merge point)."""
+
+    idx: int  # position in program order
+    prim: str  # primitive name; synthetic: "phi" | "cond_merge" | ...
+    invars: Tuple[int, ...]  # value ids consumed
+    outvars: Tuple[int, ...]  # value ids produced
+    params: dict  # primitive params (sub-jaxprs stripped)
+    branch: Tuple[Tuple[int, int], ...]  # ((cond_uid, branch_idx), ...)
+
+
+@dataclasses.dataclass
+class Graph:
+    """Linearized program + side tables."""
+
+    nodes: List[Node]
+    invar_ids: List[int]  # value ids of the closed jaxpr's inputs
+    outvar_ids: List[int]  # value ids of its outputs
+    literals: Dict[int, object]  # value id -> known Python scalar
+    # Phi feedback edges: (phi_id, init_id, loopback_id). The phi NODE
+    # only lists init_id as an invar (program order); reachability
+    # iterates the loopback edge to fixpoint separately.
+    phis: List[Tuple[int, int, int]]
+    nvals: int
+
+    def producers(self) -> Dict[int, int]:
+        """value id -> index of the node producing it."""
+        out: Dict[int, int] = {}
+        for n in self.nodes:
+            for v in n.outvars:
+                out[v] = n.idx
+        return out
+
+    def consumers(self) -> Dict[int, List[int]]:
+        """value id -> indices of nodes consuming it."""
+        out: Dict[int, List[int]] = {}
+        for n in self.nodes:
+            for v in n.invars:
+                out.setdefault(v, []).append(n.idx)
+        return out
+
+
+def _scalar_of(val) -> Optional[object]:
+    """``val`` as a Python int/float if it is a known scalar."""
+    try:
+        import numpy as np
+
+        arr = np.asarray(val)
+        if arr.ndim == 0 and arr.dtype.kind in "iuf":
+            return arr.item()
+    except Exception:
+        pass
+    return None
+
+
+def linearize(closed) -> Graph:
+    """Flatten ``closed`` (a ClosedJaxpr) into one ordered node list.
+
+    Sub-jaxprs are inlined: calls verbatim; ``scan``/``while`` bodies
+    once with phi nodes feeding the carry (init -> phi in program
+    order, carry-out -> phi as a recorded feedback edge); every
+    ``cond`` branch with a per-branch context tag, merged afterwards
+    by a synthetic ``cond_merge`` node.
+    """
+    g = Graph(
+        nodes=[], invar_ids=[], outvar_ids=[], literals={}, phis=[],
+        nvals=0,
+    )
+    cond_uids = [0]
+
+    def fresh() -> int:
+        g.nvals += 1
+        return g.nvals - 1
+
+    def add(prim, invars, n_out, params, branch) -> List[int]:
+        outs = [fresh() for _ in range(n_out)]
+        g.nodes.append(Node(
+            idx=len(g.nodes), prim=prim, invars=tuple(invars),
+            outvars=tuple(outs), params=params, branch=branch,
+        ))
+        return outs
+
+    def atom_id(v, env) -> int:
+        # Literal operands get their own id + recorded value; variables
+        # resolve through the current environment.
+        if hasattr(v, "val") and not hasattr(v, "count"):
+            i = fresh()
+            s = _scalar_of(v.val)
+            if s is not None:
+                g.literals[i] = s
+            return i
+        return env[v]
+
+    def strip(params: dict) -> dict:
+        return {
+            k: v for k, v in params.items()
+            if not hasattr(v, "jaxpr") and not hasattr(v, "eqns")
+            and not (
+                isinstance(v, (list, tuple))
+                and any(hasattr(x, "jaxpr") for x in v)
+            )
+        }
+
+    def inline(jaxpr, consts, arg_ids, branch) -> List[int]:
+        env: Dict[object, int] = {}
+        for cv, cval in zip(jaxpr.constvars, consts):
+            i = fresh()
+            s = _scalar_of(cval)
+            if s is not None:
+                g.literals[i] = s
+            env[cv] = i
+        for v, a in zip(jaxpr.invars, arg_ids):
+            env[v] = a
+        for eqn in jaxpr.eqns:
+            handle(eqn, env, branch)
+        return [atom_id(v, env) for v in jaxpr.outvars]
+
+    def handle(eqn, env, branch) -> None:
+        name = eqn.primitive.name
+        in_ids = [atom_id(v, env) for v in eqn.invars]
+        params = eqn.params
+
+        if name in _CALL_PRIMS and "jaxpr" in params:
+            sub = params["jaxpr"]
+            inner = getattr(sub, "jaxpr", sub)
+            consts = getattr(sub, "consts", ())
+            outs = inline(inner, consts, in_ids, branch)
+            for v, o in zip(eqn.outvars, outs):
+                env[v] = o
+            return
+
+        if name == "scan":
+            sub = params["jaxpr"]
+            inner, consts = sub.jaxpr, sub.consts
+            nc = params.get("num_consts", 0)
+            ncar = params.get("num_carry", 0)
+            const_ids = in_ids[:nc]
+            init_ids = in_ids[nc:nc + ncar]
+            xs_ids = in_ids[nc + ncar:]
+            # Per-iteration xs element: a slice of the stacked input.
+            elt_ids = [
+                add("scan_slice", [x], 1, {}, branch)[0] for x in xs_ids
+            ]
+            phi_ids = []
+            for init in init_ids:
+                (p,) = add("phi", [init], 1, {}, branch)
+                phi_ids.append(p)
+            outs = inline(
+                inner, consts, const_ids + phi_ids + elt_ids, branch
+            )
+            carry_out, ys = outs[:ncar], outs[ncar:]
+            for p, init, co in zip(phi_ids, init_ids, carry_out):
+                g.phis.append((p, init, co))
+            stacked = [
+                add("scan_stack", [y], 1, {}, branch)[0] for y in ys
+            ]
+            for v, o in zip(eqn.outvars, carry_out + stacked):
+                env[v] = o
+            return
+
+        if name == "while":
+            cond_j = params["cond_jaxpr"]
+            body_j = params["body_jaxpr"]
+            cn = params.get("cond_nconsts", 0)
+            bn = params.get("body_nconsts", 0)
+            c_const = in_ids[:cn]
+            b_const = in_ids[cn:cn + bn]
+            init_ids = in_ids[cn + bn:]
+            phi_ids = []
+            for init in init_ids:
+                (p,) = add("phi", [init], 1, {}, branch)
+                phi_ids.append(p)
+            inline(cond_j.jaxpr, cond_j.consts, c_const + phi_ids,
+                   branch)
+            outs = inline(body_j.jaxpr, body_j.consts,
+                          b_const + phi_ids, branch)
+            for p, init, co in zip(phi_ids, init_ids, outs):
+                g.phis.append((p, init, co))
+            # The loop's outputs ARE the (widened) carries.
+            for v, p in zip(eqn.outvars, phi_ids):
+                env[v] = p
+            return
+
+        if name == "cond":
+            uid = cond_uids[0]
+            cond_uids[0] += 1
+            idx_id, op_ids = in_ids[0], in_ids[1:]
+            branch_outs = []
+            for bi, bj in enumerate(params["branches"]):
+                branch_outs.append(inline(
+                    bj.jaxpr, bj.consts, op_ids,
+                    branch + ((uid, bi),),
+                ))
+            for k, v in enumerate(eqn.outvars):
+                ins = [idx_id] + [outs[k] for outs in branch_outs]
+                (m,) = add("cond_merge", ins, 1, {}, branch)
+                env[v] = m
+            return
+
+        outs = add(name, in_ids, len(eqn.outvars), strip(params),
+                   branch)
+        # Constant-fold scalar plumbing so fold_in salts that pass
+        # through a convert_element_type stay visible as literals.
+        if (
+            name in _TRANSPARENT
+            and len(in_ids) == 1
+            and in_ids[0] in g.literals
+        ):
+            g.literals[outs[0]] = g.literals[in_ids[0]]
+        for v, o in zip(eqn.outvars, outs):
+            env[v] = o
+
+    jaxpr = closed.jaxpr
+    env: Dict[object, int] = {}
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        i = fresh()
+        s = _scalar_of(cval)
+        if s is not None:
+            g.literals[i] = s
+        env[cv] = i
+        g.invar_ids.append(i)  # consts count as inputs for reach
+    n_consts = len(jaxpr.constvars)
+    for v in jaxpr.invars:
+        i = fresh()
+        env[v] = i
+        g.invar_ids.append(i)
+    for eqn in jaxpr.eqns:
+        handle(eqn, env, ())
+    g.outvar_ids = [atom_id(v, env) for v in jaxpr.outvars]
+    # Real (non-const) inputs come FIRST for callers indexing by the
+    # traced function's argument order.
+    g.invar_ids = g.invar_ids[n_consts:] + g.invar_ids[:n_consts]
+    return g
+
+
+# ---------------------------------------------------------------------------
+# PRNG key lineage
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyProv:
+    """Provenance of one key value: a root plus the exact derivation
+    path. Two keys with equal (root, path) hold the SAME key value —
+    drawing from both is stream reuse. ``markers`` are the >=
+    :data:`FAMILY_MIN` fold constants seen on the path (salt-family
+    membership); ``widened`` keys crossed a loop-carry merge (identity
+    no longer exact); ``foreign`` keys were built from non-key data
+    inside the tick (a constant ``PRNGKey(0)`` smuggled past the
+    declared key argument)."""
+
+    root: int
+    path: Tuple[Tuple[str, object], ...] = ()
+    markers: frozenset = frozenset()
+    pending_split: bool = False
+    widened: bool = False
+    foreign: bool = False
+
+    def fold(self, const: Optional[int], var_id: Optional[int]):
+        if const is not None:
+            ev = ("fold", int(const))
+            marks = (
+                self.markers | {int(const)}
+                if int(const) >= FAMILY_MIN else self.markers
+            )
+        else:
+            ev = ("fold", ("var", var_id))
+            marks = self.markers
+        return dataclasses.replace(
+            self, path=self.path + (ev,), markers=marks,
+            pending_split=False,
+        )
+
+    def split_child(self, i: object):
+        return dataclasses.replace(
+            self, path=self.path + (("split", i),), pending_split=False,
+        )
+
+    def identity(self) -> Tuple:
+        return (self.root, self.path, self.pending_split)
+
+    def describe(self) -> str:
+        bits = []
+        for kind, arg in self.path:
+            if kind == "fold":
+                bits.append(
+                    f"fold({arg:#x})" if isinstance(arg, int)
+                    else "fold(<traced>)"
+                )
+            else:
+                bits.append(
+                    f"split[{arg}]" if isinstance(arg, int)
+                    else "split[<traced>]"
+                )
+        head = "key" if self.root == 0 else f"key#{self.root}"
+        return ".".join([head] + bits) if bits else head
+
+
+@dataclasses.dataclass(frozen=True)
+class Draw:
+    """One ``random_bits`` site."""
+
+    node: int
+    prov: KeyProv
+    branch: Tuple[Tuple[int, int], ...]
+    shape: Tuple[int, ...]
+
+
+def branches_exclusive(
+    a: Tuple[Tuple[int, int], ...], b: Tuple[Tuple[int, int], ...]
+) -> bool:
+    """True when the two branch contexts cannot both execute: they
+    disagree on the branch index of at least one shared cond."""
+    da, db = dict(a), dict(b)
+    return any(
+        uid in db and db[uid] != bi for uid, bi in da.items()
+    )
+
+
+def key_lineage(
+    g: Graph, key_id: int
+) -> Tuple[List[Draw], Dict[int, KeyProv]]:
+    """Abstractly interpret ``g`` over the key-provenance lattice.
+
+    ``key_id`` is the value id of the tick's declared key argument
+    (root 0). Returns every draw site plus the final provenance map.
+    """
+    prov: Dict[int, KeyProv] = {key_id: KeyProv(root=0)}
+    draws: List[Draw] = []
+    next_root = [1]
+
+    def fresh_prov(**kw) -> KeyProv:
+        r = next_root[0]
+        next_root[0] += 1
+        return KeyProv(root=r, **kw)
+
+    for node in g.nodes:
+        name = node.prim
+        ins = node.invars
+        p0 = prov.get(ins[0]) if ins else None
+
+        if name in ("random_wrap", "random_unwrap"):
+            if p0 is not None:
+                prov[node.outvars[0]] = p0
+            elif name == "random_wrap":
+                # A key minted from raw uint32 data that never came
+                # from the declared key argument.
+                prov[node.outvars[0]] = fresh_prov(foreign=True)
+        elif name == "random_split":
+            if p0 is not None:
+                prov[node.outvars[0]] = dataclasses.replace(
+                    p0, pending_split=True
+                )
+        elif name == "random_fold_in":
+            if p0 is not None:
+                const = g.literals.get(ins[1]) if len(ins) > 1 else None
+                const = int(const) if isinstance(const, int) else (
+                    None if const is None else int(const)
+                )
+                var_id = ins[1] if len(ins) > 1 else None
+                prov[node.outvars[0]] = p0.fold(
+                    const if const is not None else None,
+                    var_id,
+                )
+        elif name in ("random_bits", "threefry2x32"):
+            kp = None
+            for i in ins:
+                if i in prov:
+                    kp = prov[i]
+                    break
+            if kp is None:
+                kp = fresh_prov(foreign=True)
+            shape = tuple(node.params.get("shape", ()) or ())
+            draws.append(Draw(
+                node=node.idx, prov=kp, branch=node.branch, shape=shape,
+            ))
+            if name == "threefry2x32" and node.outvars:
+                prov[node.outvars[0]] = kp
+        elif name in ("slice", "dynamic_slice") and p0 is not None:
+            if p0.pending_split:
+                start = None
+                if name == "slice":
+                    si = node.params.get("start_indices", ())
+                    start = int(si[0]) if si else None
+                else:
+                    lit = (
+                        g.literals.get(ins[1]) if len(ins) > 1 else None
+                    )
+                    start = int(lit) if lit is not None else None
+                prov[node.outvars[0]] = p0.split_child(
+                    start if start is not None else ("var", node.idx)
+                )
+            else:
+                prov[node.outvars[0]] = p0
+        elif name == "phi":
+            pi = prov.get(node.invars[0])
+            if pi is not None:
+                # A key threaded through a loop carry: widen. Markers
+                # survive (family membership is path-stable), exact
+                # identity does not.
+                prov[node.outvars[0]] = dataclasses.replace(
+                    fresh_prov(), markers=pi.markers, widened=True,
+                    foreign=pi.foreign,
+                )
+        elif name == "cond_merge":
+            ps = [prov[i] for i in ins[1:] if i in prov]
+            if ps:
+                if all(p == ps[0] for p in ps) and len(ps) == len(
+                    ins
+                ) - 1:
+                    prov[node.outvars[0]] = ps[0]
+                else:
+                    marks = frozenset().union(
+                        *[p.markers for p in ps]
+                    )
+                    prov[node.outvars[0]] = dataclasses.replace(
+                        fresh_prov(), markers=marks, widened=True,
+                        foreign=all(p.foreign for p in ps),
+                    )
+        elif name in _TRANSPARENT or name in (
+            "scan_slice", "scan_stack"
+        ):
+            if p0 is not None and len(ins) >= 1:
+                prov[node.outvars[0]] = p0
+        else:
+            # Any other primitive consuming a key-tracked value
+            # produces data, not a key — no propagation. But a
+            # MULTI-key-input op (concatenate of keys, select between
+            # keys) yields an unknown key: widen defensively so a
+            # later draw is not misattributed.
+            keyish = [i for i in ins if i in prov]
+            if keyish and name in ("concatenate", "select_n", "gather",
+                                   "dynamic_slice", "add", "xor",
+                                   "pad"):
+                marks = frozenset().union(
+                    *[prov[i].markers for i in keyish]
+                )
+                for o in node.outvars:
+                    prov[o] = dataclasses.replace(
+                        fresh_prov(), markers=marks, widened=True,
+                        foreign=all(prov[i].foreign for i in keyish),
+                    )
+    return draws, prov
+
+
+# ---------------------------------------------------------------------------
+# Reachability (reaching definitions over input leaves)
+# ---------------------------------------------------------------------------
+
+
+def reach_analysis(
+    g: Graph, source_ids: Sequence[int]
+) -> Dict[int, int]:
+    """Forward reachability: for every value id, a bitmask over
+    ``source_ids`` indices of the sources with a dataflow path to it.
+    Phi feedback edges are iterated to fixpoint, so a leaf that feeds
+    another leaf only via the NEXT loop iteration still reaches it.
+    """
+    src: Dict[int, int] = {}
+    for bit, vid in enumerate(source_ids):
+        src[vid] = src.get(vid, 0) | (1 << bit)
+
+    feedback = {p: co for p, _init, co in g.phis}
+
+    def sweep() -> bool:
+        changed = False
+        for n in g.nodes:
+            acc = 0
+            for i in n.invars:
+                acc |= src.get(i, 0)
+            if n.prim == "phi":
+                co = feedback.get(n.outvars[0])
+                if co is not None:
+                    acc |= src.get(co, 0)
+            for o in n.outvars:
+                base = src.get(o, 0)
+                if base | acc != base:
+                    src[o] = base | acc
+                    changed = True
+        return changed
+
+    # One pass reaches everything acyclic; feedback needs fixpoint.
+    for _ in range(len(g.phis) + 2):
+        if not sweep():
+            break
+    return src
+
+
+def closure(adjacency: Dict[int, int], live: int, n: int) -> int:
+    """Backward closure of a liveness bitmask over a one-step leaf
+    adjacency (``adjacency[j]`` = mask of leaves feeding leaf ``j``):
+    a leaf feeding a live leaf is live, across any number of ticks."""
+    changed = True
+    while changed:
+        changed = False
+        for j in range(n):
+            if live >> j & 1:
+                feed = adjacency.get(j, 0)
+                if live | feed != live:
+                    live |= feed
+                    changed = True
+    return live

@@ -1094,48 +1094,17 @@ def check_policy_knob(ctx: Context) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# State-field dead writes (new)
+# State-field dead writes — RETIRED (ANALYSIS_VERSION 2.4)
 # ---------------------------------------------------------------------------
-
-
-@rule(
-    "state-dead-write",
-    "ast",
-    "every batched *State field is read somewhere (package, scripts, "
-    "bench) — a field carried and updated but never consumed is dead "
-    "HBM traffic on every tick sweep",
-)
-def check_dead_writes(ctx: Context) -> List[Finding]:
-    scope = [astutil.parse_file(p) for p in astutil.py_files(ctx.root)]
-    if ctx.is_real_tree():
-        extra = [ctx.repo / "bench.py", *sorted(
-            (ctx.repo / "scripts").glob("*.py")
-        )]
-        scope += [
-            astutil.parse_file(p) for p in extra if p.exists()
-        ]
-    reads = astutil.consumed_attribute_reads(scope)
-    out: List[Finding] = []
-    for path in astutil.batched_files(ctx.root):
-        tree = astutil.parse_file(path)
-        for cls in astutil.classes_with_suffix(tree, "State"):
-            for field in astutil.ann_fields(cls):
-                if field not in reads:
-                    out.append(
-                        Finding(
-                            rule="state-dead-write",
-                            path=_rel(ctx, path),
-                            line=cls.lineno,
-                            message=(
-                                f"{cls.name}.{field} is carried in the "
-                                "scan state but never read anywhere — "
-                                "dead bytes on every bandwidth-bound "
-                                "tick sweep (drop it, or read it)"
-                            ),
-                            key=f"{path.name}:{field}",
-                        )
-                    )
-    return out
+# The AST-approximate `state-dead-write` rule (any attribute read
+# anywhere in the package counted as consumption, with a replace()
+# self-feed exclusion) is replaced by the dataflow layer's
+# `state-dead-write-reachable` (rules_dataflow.py): reaching
+# definitions over the traced tick jaxpr, where a leaf is live only
+# if some dataflow path — across any number of ticks — carries it to
+# telemetry, a traced invariant, or a host-read output. The jaxpr
+# rule is strictly stronger: a field whose value only ever feeds
+# itself is dead no matter how the Python spells the update.
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,525 @@
+"""Dataflow-layer rules: semantic checks over the traced tick jaxpr.
+
+Four rules built on :mod:`frankenpaxos_tpu.analysis.dataflow`:
+
+* ``prng-stream-lineage`` — every random draw inside a tick descends
+  from exactly one declared salt family (fault / workload / lifecycle
+  / backend), no key value feeds two independent draws, and no key is
+  minted from non-key data.
+* ``prng-salt-disjoint`` — the declared salt-family constants are
+  pairwise disjoint under the fold-in arithmetic ACTUALLY traced: the
+  observed fold constants each land inside exactly one family's
+  private interval.
+* ``state-dead-write-reachable`` — reaching definitions over State
+  leaves: a leaf the tick writes that no jaxpr path carries (across
+  any number of ticks) to a telemetry feed, a traced invariant, or a
+  host-read output is dead HBM traffic.
+* ``donation-hazard`` — a donated input State leaf consumed after its
+  aliased output has been produced is a latent use-after-donate.
+
+All four trace each backend's tick ONCE (with the fault / workload /
+lifecycle plans structurally active, so the salt folds appear in the
+jaxpr) and share the linearized graph; the work is pure Python graph
+walking, cheap enough for the default lint leg. Engine tests and the
+dataflow teeth tests point the rules at fixture modules via
+``Context.dataflow_targets`` instead of the real backend registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import inspect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from frankenpaxos_tpu.analysis import astutil, dataflow
+from frankenpaxos_tpu.analysis.core import Context, Finding, rule
+from frankenpaxos_tpu.analysis import rules_trace as _rt
+
+# Declared salt families: name -> base constant. The "backend" family
+# is implicit — a draw with NO family marker on its fold path belongs
+# to the backend's own per-plane stream (small fold constants below
+# dataflow.FAMILY_MIN).
+def declared_families() -> Dict[str, int]:
+    from frankenpaxos_tpu.tpu import faults, lifecycle, workload
+
+    return {
+        "fault": faults.FAULT_SALT,
+        "workload": workload.WORKLOAD_SALT,
+        "lifecycle": lifecycle.LIFECYCLE_SALT,
+    }
+
+
+# Donated leaves smaller than this (elements) are control-plane
+# scalars / histograms / per-register rings whose post-production
+# reads are delta computations (``lat_hist - state.lat_hist``, the
+# telemetry-delta idiom every backend uses) on tiny buffers; the
+# hazard the rule hunts is a LARGE donated data plane consumed after
+# its replacement exists. 256 clears the repo-wide idioms (lat_hist
+# is 64 bins, the caspaxos bit-issue ring is G x 32 = 128) while any
+# real [G, W] protocol plane is thousands of elements.
+DONATION_MIN_ELEMS = 256
+
+
+# ---------------------------------------------------------------------------
+# Shared per-target trace cache
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Traced:
+    name: str
+    mod: object
+    cfg: object
+    graph: dataflow.Graph
+    leaf_names: List[str]
+    leaf_sizes: List[int]
+    leaf_in_ids: List[int]
+    leaf_out_ids: List[int]
+    key_id: int
+    draws: List[dataflow.Draw]
+    prov: Dict[int, dataflow.KeyProv]
+
+
+_GRAPH_CACHE: Dict[Tuple[str, int], _Traced] = {}
+
+
+def _plan_kwargs(mod) -> dict:
+    """Plans structurally active so the salt-family folds (and the
+    workload/lifecycle state planes) appear in the traced jaxpr."""
+    from frankenpaxos_tpu.tpu.faults import FaultPlan
+    from frankenpaxos_tpu.tpu.workload import WorkloadPlan
+
+    kw: dict = {}
+    params = inspect.signature(mod.analysis_config).parameters
+    if "faults" in params:
+        kw["faults"] = FaultPlan(traced=True)
+    if "workload" in params:
+        kw["workload"] = WorkloadPlan(arrival="constant", rate=1.0)
+    if "lifecycle" in params:
+        from frankenpaxos_tpu.tpu.lifecycle import LifecyclePlan
+
+        kw["lifecycle"] = LifecyclePlan(sessions=8, resubmit_rate=0.1)
+    return kw
+
+
+def _targets(ctx: Context) -> List[Tuple[str, object]]:
+    if ctx.dataflow_targets is not None:
+        out = []
+        for entry in ctx.dataflow_targets:
+            if isinstance(entry, tuple):
+                out.append(entry)
+            else:
+                out.append(
+                    (entry.__name__.rsplit(".", 1)[-1], entry)
+                )
+        return out
+    if not ctx.importable:
+        return []
+    return [(b, _rt._module(b)) for b in _rt._selected(ctx)]
+
+
+def _traced(name: str, mod) -> _Traced:
+    ck = (name, id(mod))
+    if ck in _GRAPH_CACHE:
+        return _GRAPH_CACHE[ck]
+    _rt._jax_cache_setup()
+    import jax
+    import jax.numpy as jnp
+
+    kw = _plan_kwargs(mod)
+    if _rt.CFG_FACTORY is not None and name in _rt.BACKENDS:
+        cfg = _rt.CFG_FACTORY(name, **kw)
+    else:
+        cfg = mod.analysis_config(**kw)
+    state = mod.init_state(cfg)
+    leaves_kp = jax.tree_util.tree_flatten_with_path(state)[0]
+    leaf_names = [
+        jax.tree_util.keystr(kp).lstrip(".") for kp, _ in leaves_kp
+    ]
+    leaf_sizes = [int(getattr(v, "size", 1)) for _, v in leaves_kp]
+    closed = jax.make_jaxpr(
+        lambda s, t, k: mod.tick(cfg, s, t, k)
+    )(state, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
+    g = dataflow.linearize(closed)
+    n = len(leaf_names)
+    assert len(g.outvar_ids) == n, (
+        f"{name}: tick must return exactly the State "
+        f"({n} leaves, traced {len(g.outvar_ids)} outputs)"
+    )
+    key_id = g.invar_ids[n + 1]
+    draws, prov = dataflow.key_lineage(g, key_id)
+    t = _Traced(
+        name=name, mod=mod, cfg=cfg, graph=g, leaf_names=leaf_names,
+        leaf_sizes=leaf_sizes, leaf_in_ids=list(g.invar_ids[:n]),
+        leaf_out_ids=list(g.outvar_ids), key_id=key_id, draws=draws,
+        prov=prov,
+    )
+    _GRAPH_CACHE[ck] = t
+    return t
+
+
+def clear_cache() -> None:
+    """Budget mode swaps the config factory: drop memoized graphs."""
+    _GRAPH_CACHE.clear()
+
+
+def _family_of(c: int, fams: Dict[str, int]) -> Optional[str]:
+    for fam, base in fams.items():
+        if base <= c < base + dataflow.FAMILY_SPAN:
+            return fam
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rule: prng-stream-lineage
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "prng-stream-lineage",
+    "dataflow",
+    "every traced random draw descends from exactly one declared salt "
+    "family, no key value feeds two independent draws, and no key is "
+    "minted from non-key data inside the tick",
+)
+def check_prng_lineage(ctx: Context) -> List[Finding]:
+    fams = declared_families()
+    out: List[Finding] = []
+    for name, mod in _targets(ctx):
+        tr = _traced(name, mod)
+        foreign_n = 0
+        for d in tr.draws:
+            if d.prov.foreign:
+                out.append(Finding(
+                    rule="prng-stream-lineage", path=name, line=0,
+                    message=(
+                        "a random draw uses a key minted inside the "
+                        "tick from non-key data (not derived from the "
+                        "tick's key argument) — its stream is fixed "
+                        "across seeds and correlated with nothing the "
+                        "harness controls"
+                    ),
+                    key=f"{name}:foreign:{foreign_n}",
+                ))
+                foreign_n += 1
+                continue
+            d_fams = sorted({
+                f for f in (
+                    _family_of(c, fams) for c in d.prov.markers
+                ) if f
+            })
+            undeclared = sorted(
+                c for c in d.prov.markers
+                if _family_of(c, fams) is None
+            )
+            if len(d_fams) >= 2:
+                out.append(Finding(
+                    rule="prng-stream-lineage", path=name, line=0,
+                    message=(
+                        f"draw at {d.prov.describe()} folds salts "
+                        f"from {len(d_fams)} families "
+                        f"({', '.join(d_fams)}) — a stream must "
+                        "belong to exactly one"
+                    ),
+                    key=f"{name}:mixed:{d.prov.describe()}",
+                ))
+            for c in undeclared:
+                out.append(Finding(
+                    rule="prng-stream-lineage", path=name, line=0,
+                    message=(
+                        f"draw at {d.prov.describe()} folds "
+                        f"{c:#x}, a family-sized salt that belongs "
+                        "to no declared family (fault/workload/"
+                        "lifecycle) — declare it or fold a "
+                        "family base first"
+                    ),
+                    key=f"{name}:undeclared:{c:#x}",
+                ))
+        # Stream reuse: the same exact key value feeding two draws
+        # that can both execute.
+        by_id: Dict[tuple, List[dataflow.Draw]] = {}
+        for d in tr.draws:
+            if d.prov.widened or d.prov.foreign:
+                continue
+            by_id.setdefault(d.prov.identity(), []).append(d)
+        for ident, group in sorted(by_id.items(), key=str):
+            if len(group) < 2:
+                continue
+            live_pairs = [
+                (a, b)
+                for i, a in enumerate(group)
+                for b in group[i + 1:]
+                if not dataflow.branches_exclusive(a.branch, b.branch)
+            ]
+            if live_pairs:
+                p = group[0].prov
+                out.append(Finding(
+                    rule="prng-stream-lineage", path=name, line=0,
+                    message=(
+                        f"key {p.describe()} feeds {len(group)} "
+                        "independent draws — stream reuse makes "
+                        '"independent" randomness correlated '
+                        "(split or fold a fresh salt per draw)"
+                    ),
+                    key=f"{name}:reuse:{p.describe()}",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule: prng-salt-disjoint
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "prng-salt-disjoint",
+    "dataflow",
+    "the declared salt-family constants are pairwise disjoint under "
+    "the fold-in arithmetic actually traced (every observed "
+    "family-sized fold constant lands inside exactly one family's "
+    "private interval)",
+)
+def check_salt_disjoint(ctx: Context) -> List[Finding]:
+    fams = declared_families()
+    out: List[Finding] = []
+    span = dataflow.FAMILY_SPAN
+    # Declared intervals pairwise disjoint — from the constants the
+    # modules export, not their comments.
+    items = sorted(fams.items(), key=lambda kv: kv[1])
+    for (fa, ba), (fb, bb) in zip(items, items[1:]):
+        if ba + span > bb:
+            out.append(Finding(
+                rule="prng-salt-disjoint",
+                path="frankenpaxos_tpu/tpu", line=0,
+                message=(
+                    f"declared salt families overlap: {fa} "
+                    f"[{ba:#x}, {ba + span:#x}) reaches into {fb} "
+                    f"base {bb:#x}"
+                ),
+                key=f"declared:{fa}:{fb}",
+            ))
+    # Observed fold constants: every literal random_fold_in operand in
+    # every traced tick. Family-sized constants must sit inside one
+    # declared interval; an offset escaping its family's span can
+    # collide with the next family.
+    for name, mod in _targets(ctx):
+        tr = _traced(name, mod)
+        seen = set()
+        for n in tr.graph.nodes:
+            if n.prim != "random_fold_in" or len(n.invars) < 2:
+                continue
+            lit = tr.graph.literals.get(n.invars[1])
+            if lit is None:
+                continue
+            c = int(lit)
+            if c < dataflow.FAMILY_MIN or c in seen:
+                continue
+            seen.add(c)
+            fam = _family_of(c, fams)
+            if fam is None:
+                below = [
+                    (f, b) for f, b in fams.items() if b <= c
+                ]
+                if below:
+                    f, b = max(below, key=lambda kv: kv[1])
+                    out.append(Finding(
+                        rule="prng-salt-disjoint", path=name, line=0,
+                        message=(
+                            f"traced fold constant {c:#x} is "
+                            f"{c - b} past the {f} family base "
+                            f"{b:#x} — offsets must stay below the "
+                            f"family span ({span}) or streams from "
+                            "adjacent families collide"
+                        ),
+                        key=f"{name}:escape:{c:#x}",
+                    ))
+                else:
+                    out.append(Finding(
+                        rule="prng-salt-disjoint", path=name, line=0,
+                        message=(
+                            f"traced fold constant {c:#x} is "
+                            "family-sized but below every declared "
+                            "family base — declare the family"
+                        ),
+                        key=f"{name}:undeclared:{c:#x}",
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule: state-dead-write-reachable
+# ---------------------------------------------------------------------------
+
+# Host-side surfaces whose attribute reads count as observation sinks.
+# Deliberately EXCLUDES the tpu/ package itself: in-graph consumption
+# is what the jaxpr reachability below computes exactly, and counting
+# a tick's own reads would re-admit the self-feed blind spot the
+# retired AST rule had.
+_HOST_GLOBS = (
+    ("", "bench.py"),
+    ("scripts", "*.py"),
+    ("frankenpaxos_tpu/harness", "*.py"),
+    ("frankenpaxos_tpu/monitoring", "*.py"),
+    ("frankenpaxos_tpu/viz", "*.py"),
+)
+
+# Host-facing functions INSIDE the tpu package: ``stats`` (backend
+# bench summaries) and ``summary`` (the workload/lifecycle host
+# roll-ups) run in Python on fetched state, so their reads are real
+# sinks even though their modules otherwise hold in-graph code.
+_HOST_FUNCS = ("stats", "summary")
+
+_HOST_READS_CACHE: Dict[str, frozenset] = {}
+
+
+def _host_reads(ctx: Context) -> frozenset:
+    key = str(ctx.repo)
+    if key in _HOST_READS_CACHE:
+        return _HOST_READS_CACHE[key]
+    trees = []
+    for sub, pat in _HOST_GLOBS:
+        base = ctx.repo / sub if sub else ctx.repo
+        if not base.exists():
+            continue
+        paths = [base] if base.is_file() else sorted(base.glob(pat))
+        for p in paths:
+            if p.suffix == ".py" and p.exists():
+                trees.append(astutil.parse_file(p))
+    for p in astutil.py_files(ctx.root):
+        tree = astutil.parse_file(p)
+        host_fns = [
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name in _HOST_FUNCS
+        ]
+        if host_fns:
+            trees.append(ast.Module(body=host_fns, type_ignores=[]))
+    reads = frozenset(astutil.consumed_attribute_reads(trees))
+    _HOST_READS_CACHE[key] = reads
+    return reads
+
+
+def _invariant_leaves(tr: _Traced) -> int:
+    """Bitmask of State-leaf indices the backend's traced
+    ``check_invariants`` actually consumes."""
+    if not hasattr(tr.mod, "check_invariants"):
+        return 0
+    import jax
+    import jax.numpy as jnp
+
+    state = tr.mod.init_state(tr.cfg)
+    try:
+        closed = jax.make_jaxpr(
+            lambda s, t: tr.mod.check_invariants(tr.cfg, s, t)
+        )(state, jnp.zeros((), jnp.int32))
+    except Exception:
+        return 0
+    g = dataflow.linearize(closed)
+    n = len(tr.leaf_names)
+    consumed = g.consumers()
+    outs = set(g.outvar_ids)
+    mask = 0
+    for j in range(n):
+        vid = g.invar_ids[j]
+        if consumed.get(vid) or vid in outs:
+            mask |= 1 << j
+    return mask
+
+
+@rule(
+    "state-dead-write-reachable",
+    "dataflow",
+    "reaching definitions over State leaves: a leaf the tick writes "
+    "that no jaxpr path carries (across ticks) to telemetry, a traced "
+    "invariant, or a host-read output is dead HBM traffic",
+)
+def check_dead_write_reachable(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for name, mod in _targets(ctx):
+        tr = _traced(name, mod)
+        n = len(tr.leaf_names)
+        src = dataflow.reach_analysis(tr.graph, tr.leaf_in_ids)
+        adj = {
+            j: src.get(tr.leaf_out_ids[j], 0) for j in range(n)
+        }
+        live = _invariant_leaves(tr)
+        host = (
+            _host_reads(ctx)
+            if ctx.is_real_tree() and ctx.dataflow_targets is None
+            else frozenset()
+        )
+        for j, lname in enumerate(tr.leaf_names):
+            parts = lname.replace("[", ".").replace("]", "").split(".")
+            top, last = parts[0], parts[-1]
+            # Telemetry is drained by the host scrape every chunk;
+            # the whole subtree is an observation sink.
+            if top == "telemetry":
+                live |= 1 << j
+            elif last in host or top in (host & {"checkpoint"}):
+                live |= 1 << j
+        live = dataflow.closure(adj, live, n)
+        for j, lname in enumerate(tr.leaf_names):
+            if live >> j & 1:
+                continue
+            if tr.leaf_sizes[j] == 0:
+                continue  # structurally-off plan leaves
+            if tr.leaf_out_ids[j] == tr.leaf_in_ids[j]:
+                continue  # pass-through, never written
+            out.append(Finding(
+                rule="state-dead-write-reachable", path=name, line=0,
+                message=(
+                    f"State leaf {lname!r} is written every tick but "
+                    "no dataflow path carries it to telemetry, a "
+                    "traced invariant, or any host-read output — "
+                    "dead HBM traffic on every bandwidth-bound sweep "
+                    "(drop it, or read it)"
+                ),
+                key=f"{name}:{lname}",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule: donation-hazard
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "donation-hazard",
+    "dataflow",
+    "no donated input State leaf is consumed after its aliased output "
+    "has been produced within the tick (latent use-after-donate once "
+    "XLA reuses the buffer in place)",
+)
+def check_donation_hazard(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for name, mod in _targets(ctx):
+        tr = _traced(name, mod)
+        producers = tr.graph.producers()
+        consumers = tr.graph.consumers()
+        for j, lname in enumerate(tr.leaf_names):
+            a, o = tr.leaf_in_ids[j], tr.leaf_out_ids[j]
+            if a == o:
+                continue  # pass-through: no fresh buffer to alias
+            if tr.leaf_sizes[j] < DONATION_MIN_ELEMS:
+                continue  # control-plane scalars/rings (see const)
+            p = producers.get(o)
+            if p is None:
+                continue
+            late = [u for u in consumers.get(a, ()) if u > p]
+            if late:
+                prim = tr.graph.nodes[late[-1]].prim
+                out.append(Finding(
+                    rule="donation-hazard", path=name, line=0,
+                    message=(
+                        f"donated State leaf {lname!r} "
+                        f"({tr.leaf_sizes[j]} elems) is consumed by "
+                        f"{len(late)} equation(s) (last: {prim}) "
+                        "AFTER its aliased output is produced — a "
+                        "latent use-after-donate once XLA writes the "
+                        "output in place (reorder the update so every "
+                        "read of the old value precedes the new one)"
+                    ),
+                    key=f"{name}:{lname}",
+                ))
+    return out

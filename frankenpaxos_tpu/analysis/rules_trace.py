@@ -141,16 +141,29 @@ def _walk_eqns(jaxpr, out: list) -> None:
 # that trace a NON-default config bypass the cache.
 _TICK_TRACE_CACHE: Dict[str, tuple] = {}
 
+# Analysis-config factory override: ``analysis/budget.py`` installs a
+# flagship-shape factory here (signature ``(backend, **plan_kwargs) ->
+# config``) so the shared tick-trace caches — this one and the
+# dataflow layer's — re-trace at production shapes during a --budget
+# run. None = each backend's own analysis_config(). Installers must
+# clear both caches around install/uninstall.
+CFG_FACTORY = None
+
 
 def _tick_closed(backend: str):
     """(closed_jaxpr, state) of ``tick`` at the backend's default
-    analysis_config(), memoized per process."""
+    analysis_config() (or CFG_FACTORY's shape), memoized per
+    process."""
     if backend not in _TICK_TRACE_CACHE:
         import jax
         import jax.numpy as jnp
 
         mod = _module(backend)
-        cfg = mod.analysis_config()
+        cfg = (
+            CFG_FACTORY(backend)
+            if CFG_FACTORY is not None
+            else mod.analysis_config()
+        )
         state = mod.init_state(cfg)
         closed = jax.make_jaxpr(
             lambda s, t, k: mod.tick(cfg, s, t, k)

@@ -900,32 +900,35 @@ register_sharding(
     )
 )
 
-# Batched BPaxos: the execution plane is REPLICA-parallel — every
-# replica runs the same dependency-graph closure over its own
-# (committed-visibility, watermark) view — so the per-replica planes
-# ([R, L] watermarks, [R, L, W] commit visibility) shard along R and
-# everything consensus-global (the lane rings, the packed adjacency,
-# scalar stats) replicates. The tick's cross-device traffic is the
-# gc_head minimum ([L]-sized) and the scalar stat reductions; the
-# depgraph_execute plane batches OVER the replica axis, so the sharded
-# batched closure stays device-local. planes_backend stays None like
-# epaxos: kernel shard_map lowering needs the lifecycle-threaded fleet
-# contract the client-plane backends carry; CPU/lint runs resolve the
-# plane to its reference twin either way.
+# Batched BPaxos: LANE-sharded. Every [L, ...] lane ring shards along
+# its leader axis, the per-replica views ([R, L] watermarks, [R, L, W]
+# commit visibility) shard on their SECOND axis — the replica axis is
+# a small fixed fan-out (every device holds all R views of ITS lanes),
+# while the leader axis is the one production scales — and the packed
+# adjacency ([V, VW], V = L*W with vertex id = lane * W + slot, i.e.
+# lane-major) shards on its row axis, which divides exactly when L
+# does. Scalar stats, the latency histogram, and the telemetry ring
+# replicate; the workload client planes ride the lane axis through
+# _NESTED_LANE_FIELDS as everywhere else. Cross-device traffic is the
+# dependency closure's column reads (a vertex may depend on another
+# lane's rows), the [L]-sized gc_head minimum, and the scalar stat
+# reductions. planes_backend stays None like epaxos: kernel shard_map
+# lowering needs the lifecycle-threaded fleet contract the
+# client-plane backends carry; CPU/lint runs resolve the plane to its
+# reference twin either way.
 register_sharding(
     ShardingSpec(
         backend="bpaxos",
         module="frankenpaxos_tpu.tpu.bpaxos_batched",
         state_class="BatchedBPaxosState",
         replicated=frozenset({
-            "next_cmd", "gc_head", "proposed", "propose_tick",
-            "commit_tick", "committed", "adj", "committed_total",
-            "executed_total", "retired_total", "coexecuted", "lat_sum",
-            "lat_hist", "workload", "telemetry",
+            "committed_total", "executed_total", "retired_total",
+            "coexecuted", "lat_sum", "lat_hist", "workload",
+            "telemetry",
         }),
-        axis_pos={"head_r": 0, "rep_commit_tick": 0},
-        axis_len=lambda st: st.head_r.shape[0],
-        axis_desc="num_replicas",
+        axis_pos={"head_r": 1, "rep_commit_tick": 1},
+        axis_len=lambda st: st.next_cmd.shape[0],
+        axis_desc="num_leaders",
         planes_backend=None,
     )
 )

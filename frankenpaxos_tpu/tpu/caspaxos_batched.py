@@ -51,6 +51,7 @@ from frankenpaxos_tpu.tpu import faults as faults_mod
 from frankenpaxos_tpu.tpu import workload as workload_mod
 from frankenpaxos_tpu.tpu.faults import FaultPlan
 from frankenpaxos_tpu.tpu.workload import WorkloadPlan, WorkloadState
+from frankenpaxos_tpu.tpu import telemetry as telemetry_mod
 from frankenpaxos_tpu.tpu.telemetry import Telemetry, make_telemetry, record
 
 # Leader status.
@@ -470,6 +471,35 @@ def tick(
         queue_capacity=G * NBITS,
         lat_hist_delta=lat_hist - state.lat_hist,
     )
+    # Span sampler (telemetry.record_spans — the generic plumbing):
+    # register-bit lifecycles. Mapping: group = register, "ring" axis =
+    # the NBITS bit positions, slot id = the bit index (bits are
+    # issue-once — ids never recycle, so slot_ids needs no head
+    # arithmetic). "proposed" = a bit's first issue into a leader's
+    # pending set; phase-1 mark = any leader finished phase 1 on the
+    # register; "voted" = an acceptor's vote value carries the bit;
+    # choice and execution are ONE event (a bit first visible in the
+    # chosen register value — CASPaxos has no separate dispatch plane).
+    # Structurally OFF at spans=0, like the counter ring.
+    if telemetry_mod.span_slots(tel):
+        bit_ids = jnp.broadcast_to(
+            jnp.arange(NBITS, dtype=jnp.int32)[None, :], (G, NBITS)
+        )
+        tel = telemetry_mod.record_spans(
+            tel,
+            t=t,
+            is_new=first_issue,
+            slot_ids=bit_ids,
+            phase1_mark=jnp.any(p1_done, axis=0),
+            voted=jnp.any(
+                vote_now[:, :, None]
+                & ((a_vote_value[:, :, None] & bit_mat[None, None, :])
+                   != 0),
+                axis=0,
+            ),
+            newly_chosen=newly_done,
+            retire_mask=newly_done,
+        )
 
     return BatchedCasPaxosState(
         l_status=l_status,

@@ -1,14 +1,22 @@
 #!/bin/bash
 # Repo-wide static analysis: runs the full frankenpaxos_tpu.analysis
-# rule registry (AST contract rules + jaxpr/HLO trace rules) and exits
-# with the finding count — 0 means every contract from PRs 1-4 holds in
-# both the source and what XLA actually compiles. This is the one-shot
-# CI entry point; `pytest -m lint` enforces the same registry per-rule.
+# rule registry (AST contract rules + jaxpr/HLO trace rules + jaxpr
+# DATAFLOW rules: PRNG stream lineage, salt disjointness, reachability
+# dead writes, donation hazards) and exits with the finding count — 0
+# means every contract from PRs 1-4 + 20 holds in both the source and
+# what XLA actually compiles. This is the one-shot CI entry point;
+# `pytest -m lint` enforces the same registry per-rule.
 #
 # Usage:
 #   scripts/lint.sh              # human-readable findings, exit = count
 #   scripts/lint.sh --json       # structured report on stdout
 #   scripts/lint.sh --rule ID    # any frankenpaxos_tpu.analysis flag
+#   LINT_BUDGET=45 scripts/lint.sh
+#                                # opt-in EXTRA leg: re-run the trace +
+#                                # dataflow layers at flagship shapes
+#                                # under a 45s wall-clock budget
+#                                # (per-rule timings + skipped-rules
+#                                # report), never the default path
 set -u
 cd "$(dirname "$0")/.."
 # The trace-shardmap-kernel rule compiles sharded wrappers and the
@@ -27,4 +35,12 @@ fi
 if [[ "${LINT_SKIP_PYTEST:-0}" != 1 ]]; then
   python -m pytest tests/ -m lint -q -p no:cacheprovider 1>&2 || exit $?
 fi
+# Opt-in flagship-shape leg: a wall-clock budget (seconds) re-runs the
+# trace + dataflow layers with every backend resized to its bench-scale
+# flagship config. Runs BEFORE the default all-layer pass so its
+# findings fail fast; it never replaces the default leg.
+if [[ "${LINT_BUDGET:-}" != "" ]]; then
+  python -m frankenpaxos_tpu.analysis --budget "${LINT_BUDGET}" || exit $?
+fi
+# Default fail-fast leg: all three layers (ast + trace + dataflow).
 exec python -m frankenpaxos_tpu.analysis "$@"

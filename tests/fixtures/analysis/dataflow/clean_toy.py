@@ -1,0 +1,74 @@
+"""Clean dataflow-rule fixture: a toy backend whose tick is a model
+citizen of every dataflow-layer contract.
+
+* PRNG: one draw per derived key — the fault/workload draws fold their
+  declared family salts, the backend draw uses a split child; no key
+  value feeds two draws, no key is minted from non-key data.
+* State: every leaf the tick writes reaches ``check_invariants``.
+* Donation: every read of a pre-update leaf value happens before the
+  updated value is produced.
+
+Loaded by ``tests/test_analysis_dataflow.py`` via importlib and handed
+to the rules through ``Context.dataflow_targets``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.faults import FAULT_SALT
+from frankenpaxos_tpu.tpu.workload import WORKLOAD_SALT
+
+N = 32  # lanes
+W = 16  # window (plane = N x W = 512 elems, above DONATION_MIN_ELEMS)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ToyState:
+    plane: jnp.ndarray  # [N, W] the "data plane"
+    count: jnp.ndarray  # [] admitted census
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyConfig:
+    lanes: int = N
+    window: int = W
+
+
+def analysis_config() -> ToyConfig:
+    return ToyConfig()
+
+
+def init_state(cfg: ToyConfig) -> ToyState:
+    return ToyState(
+        plane=jnp.zeros((cfg.lanes, cfg.window), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def tick(cfg, state: ToyState, t, key) -> ToyState:
+    kf = jax.random.fold_in(key, FAULT_SALT)
+    kw = jax.random.fold_in(key, WORKLOAD_SALT)
+    kb, _ = jax.random.split(key)
+    drop = jax.random.bernoulli(kf, 0.25, (cfg.lanes, cfg.window))
+    arrive = jax.random.bernoulli(kw, 0.5, (cfg.lanes,))
+    pick = jax.random.bits(kb, (cfg.lanes,)) % jnp.uint32(cfg.window)
+    # Read old values BEFORE producing the new ones (donation-clean).
+    inc = jnp.where(
+        drop, 0, (jnp.arange(cfg.window)[None, :] == pick[:, None])
+        * arrive[:, None]
+    ).astype(jnp.int32)
+    new_count = state.count + jnp.sum(arrive.astype(jnp.int32))
+    new_plane = state.plane + inc
+    return ToyState(plane=new_plane, count=new_count)
+
+
+def check_invariants(cfg, state: ToyState, t) -> dict:
+    return {
+        "plane_nonneg": jnp.all(state.plane >= 0),
+        "count_bounds": state.count >= 0,
+    }

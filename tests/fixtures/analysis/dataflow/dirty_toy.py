@@ -1,0 +1,98 @@
+"""Dirty dataflow-rule fixture: one seeded violation per dataflow
+rule family, each of which the teeth tests prove produces its named
+finding.
+
+* ``prng-stream-lineage``: the same split child feeds TWO draws
+  (stream reuse); a key is minted from ``PRNGKey(0)`` inside the tick
+  (foreign root); one draw folds both the fault and workload family
+  salts (mixed lineage).
+* ``prng-salt-disjoint``: a fold constant 300 past the workload base
+  escapes the family span.
+* ``state-dead-write-reachable``: ``ghost`` is written every tick via
+  a local alias (invisible to the retired AST rule's replace()
+  heuristic) but read by nothing.
+* ``donation-hazard``: the 512-element ``big`` plane's old value is
+  consumed AFTER its replacement is produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from frankenpaxos_tpu.tpu.faults import FAULT_SALT
+from frankenpaxos_tpu.tpu.workload import WORKLOAD_SALT
+
+N = 32
+W = 16
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DirtyState:
+    big: jnp.ndarray  # [N, W]
+    echo: jnp.ndarray  # [N, W] stale copy of big (post-alias read)
+    ghost: jnp.ndarray  # [N] written every tick, read nowhere
+    count: jnp.ndarray  # []
+
+
+@dataclasses.dataclass(frozen=True)
+class DirtyConfig:
+    lanes: int = N
+    window: int = W
+
+
+def analysis_config() -> DirtyConfig:
+    return DirtyConfig()
+
+
+def init_state(cfg: DirtyConfig) -> DirtyState:
+    return DirtyState(
+        big=jnp.zeros((cfg.lanes, cfg.window), jnp.int32),
+        echo=jnp.zeros((cfg.lanes, cfg.window), jnp.int32),
+        ghost=jnp.zeros((cfg.lanes,), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def tick(cfg, state: DirtyState, t, key) -> DirtyState:
+    k1, _k2 = jax.random.split(key)
+    # Seeded violation: k1 feeds TWO independent draws (stream reuse).
+    d1 = jax.random.bits(k1, (cfg.lanes,))
+    d2 = jax.random.uniform(k1, (cfg.lanes,))
+    # Seeded violation: a key minted inside the tick (foreign root).
+    smuggled = jax.random.bits(jax.random.PRNGKey(0), (cfg.lanes,))
+    # Seeded violation: fold constants from TWO declared families.
+    kmix = jax.random.fold_in(
+        jax.random.fold_in(key, FAULT_SALT), WORKLOAD_SALT
+    )
+    d3 = jax.random.bits(kmix, (cfg.lanes,))
+    # Seeded violation: offset escapes the workload family span.
+    kesc = jax.random.fold_in(key, WORKLOAD_SALT + 300)
+    d4 = jax.random.bits(kesc, (cfg.lanes,))
+    mix = (d1 + smuggled + d3 + d4).astype(jnp.int32) % 7 + (
+        d2 > 0.5
+    ).astype(jnp.int32)
+    # Producer of the new plane FIRST...
+    new_big = state.big + mix[:, None]
+    # ...then the seeded post-alias read of the OLD plane.
+    echo = state.big * 2
+    # Seeded violation: self-feeding write through a local alias.
+    g = state.ghost + 1
+    return DirtyState(
+        big=new_big,
+        echo=echo,
+        ghost=g,
+        count=state.count + jnp.sum(mix),
+    )
+
+
+def check_invariants(cfg, state: DirtyState, t) -> dict:
+    # Reads big/echo/count — but never ghost.
+    return {
+        "big_nonneg": jnp.all(state.big >= 0),
+        "echo_even": jnp.all(state.echo % 2 == 0),
+        "count_bounds": state.count >= 0,
+    }

@@ -40,7 +40,6 @@ FIXTURE_RULES = [
     "workload-rate-validated",
     "kernel-pallas-containment",
     "packing-containment",
-    "state-dead-write",
 ]
 
 
@@ -101,7 +100,6 @@ def test_dirty_fixture_expected_keys():
         ("workload-rate-validated", "workload.py:ToyWorkloadPlan:bad_fraction"),
         ("kernel-pallas-containment", "tpu/toy_batched.py"),
         ("packing-containment", "tpu/toy_batched.py"),
-        ("state-dead-write", "toy_batched.py:ghost"),
     }
     assert keys == expected, keys.symmetric_difference(expected)
 
@@ -180,6 +178,33 @@ def test_suppress_block_for_unknown_rule_id_is_a_finding(monkeypatch):
     assert report.findings[0].key == "donation_jit:<unknown-rule>"
 
 
+def test_stale_dataflow_allowlist_entry_is_a_finding(monkeypatch):
+    """The stale-rejection hygiene covers the dataflow layer too: a
+    suppression key no dataflow rule currently raises is itself a
+    finding, even though dataflow rules derive keys from traced jaxprs
+    rather than source locations."""
+    import importlib.util
+    import sys
+
+    path = FIXTURES / "dataflow" / "clean_toy.py"
+    spec = importlib.util.spec_from_file_location("clean_toy", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["clean_toy"] = mod
+    spec.loader.exec_module(mod)
+
+    monkeypatch.setitem(
+        allowlists.SUPPRESS,
+        "donation-hazard",
+        {"gone_backend:gone_leaf": "stale reason"},
+    )
+    ctx = core.Context(dataflow_targets=[("clean_toy", mod)])
+    report = core.run(rule_ids=["donation-hazard"], ctx=ctx)
+    assert [f.rule for f in report.findings] == [core.STALE_RULE]
+    assert [f.key for f in report.findings] == [
+        "donation-hazard:gone_backend:gone_leaf"
+    ]
+
+
 def test_dtype_pin_for_unknown_backend_is_a_finding(monkeypatch):
     """A DTYPE_WIDENING pin naming a nonexistent backend can never
     match a trace — it is a typo/rename leftover and must be flagged
@@ -203,9 +228,9 @@ def test_unknown_rule_id_raises():
 
 def test_rule_registry_shape():
     n = analysis.rule_count()
-    assert n >= 18, sorted(core.RULES)
+    assert n >= 44, sorted(core.RULES)
     layers = {r.layer for r in core.RULES.values()}
-    assert layers == {"ast", "trace"}
+    assert layers == {"ast", "trace", "dataflow"}
     assert all(r.doc for r in core.RULES.values())
 
 

@@ -31,8 +31,11 @@ def test_rule_clean(rule_id):
 
 
 def test_state_dead_write_clean():
-    """The dead-write detector (new in the analysis subsystem) rides
-    with the kernel lint wrapper: every State field must be consumed
-    somewhere, or it is dead bytes on every tick sweep."""
-    report = analysis.run(rule_ids=["state-dead-write"])
+    """The dead-write detector rides with the kernel lint wrapper:
+    every State leaf a tick writes must reach an invariant, telemetry,
+    or host-summary sink, or it is dead bytes on every tick sweep.
+    Since ANALYSIS_VERSION 2.4 this is the jaxpr-reachability rule
+    (``state-dead-write-reachable``, analysis/rules_dataflow.py) — the
+    AST ``replace()``-pattern heuristic it replaced is retired."""
+    report = analysis.run(rule_ids=["state-dead-write-reachable"])
     assert not report.findings, "\n" + report.format()

@@ -280,6 +280,41 @@ def test_sharded_kernels_bit_identity(backend, seed):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bpaxos_sharded_lane_bit_identity(seed):
+    """bpaxos is LANE-sharded: the [L, ...] rings and the lane-major
+    packed adjacency split over the leader axis, the per-replica views
+    on their second axis. Sharded == unsharded bit for bit per seed,
+    full state including the dependency graph."""
+    from frankenpaxos_tpu.tpu import bpaxos_batched as bp
+
+    cfg = dataclasses.replace(bp.analysis_config(), num_leaders=8)
+    mesh = _mesh()
+    t0 = jnp.zeros((), jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    st = sh.shard_state("bpaxos", bp.init_state(cfg), mesh)
+    st, _ = sh.run_ticks_sharded("bpaxos", cfg, mesh, st, t0, 24, key)
+    assert int(st.committed_total) > 0
+    ust, _ = bp.run_ticks(cfg, bp.init_state(cfg), t0, 24, key)
+    _assert_states_equal(st, ust)
+
+
+def test_bpaxos_lane_planes_are_lane_sharded():
+    """The registered bpaxos layout: lane rings and adjacency rows ride
+    the group axis, replica views shard their SECOND axis, stats and
+    telemetry replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh()
+    specs = sh.state_shardings("bpaxos", mesh)
+    for f in ("next_cmd", "gc_head", "proposed", "committed", "adj"):
+        assert specs[f].spec == P(sh.GROUP_AXIS), f
+    for f in ("head_r", "rep_commit_tick"):
+        assert specs[f].spec == P(None, sh.GROUP_AXIS), f
+    for f in ("committed_total", "lat_hist", "telemetry"):
+        assert specs[f].spec == P(), f
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
 def test_epaxos_sharded_cell_bit_identity(seed):
     """epaxos rides the registry with no registered planes: the
     kernels-on and kernels-off cells are the same program; sharded ==

@@ -1104,3 +1104,79 @@ def test_bpaxos_serve_perfetto_round_trip(tmp_path):
     lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
     assert lifecycles
     assert all("committed" in e["args"] for e in lifecycles)
+
+
+def test_caspaxos_span_sampler_stamps_and_structural_noop():
+    """caspaxos records register-BIT lifecycles through the generic
+    telemetry plumbing: group = register, slot id = bit index (bits
+    issue once, ids never recycle), "voted" = an acceptor vote value
+    carries the bit, and choice == execution (a bit first visible in
+    the chosen value — no separate dispatch plane). spans=0 stays a
+    structural no-op (bit-identical protocol state) and the counter
+    halves agree across both modes."""
+    from frankenpaxos_tpu.tpu import caspaxos_batched as cp
+
+    cfg = cp.analysis_config()
+    key = jax.random.PRNGKey(3)
+    t0 = jnp.zeros((), jnp.int32)
+
+    def run(spans):
+        st = dataclasses.replace(
+            cp.init_state(cfg), telemetry=T.make_telemetry(64, spans=spans)
+        )
+        st, _ = cp.run_ticks(cfg, st, t0, 40, key)
+        return st
+
+    on, off = run(8), run(0)
+    for f in dataclasses.fields(on):
+        if f.name == "telemetry":
+            continue
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(on, f.name)),
+            jax.tree_util.tree_leaves(getattr(off, f.name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f.name
+            )
+    np.testing.assert_array_equal(
+        np.asarray(on.telemetry.totals), np.asarray(off.telemetry.totals)
+    )
+    spans, dropped, _ = T.completed_spans(on.telemetry)
+    assert spans and dropped == 0
+    for s in spans:
+        # A CAS round trip (phase 1 + phase 2 quorums) separates issue
+        # from visibility; choice and execution are ONE event.
+        assert 0 <= s["proposed"] < s["committed"] == s["executed"], s
+        if s["phase2_voted"] != -1:
+            # The acceptor vote lands before the leader learns quorum.
+            assert s["proposed"] < s["phase2_voted"] < s["committed"], s
+        if s["phase1_promised"] != -1:
+            assert s["phase1_promised"] > s["proposed"], s
+        assert 0 <= s["group"] < cfg.num_registers, s
+    # The rotating reservoir samples across the register axis.
+    assert len({s["group"] for s in spans}) > 1
+
+
+def test_caspaxos_serve_perfetto_round_trip(tmp_path):
+    """The serve loop over caspaxos with the span sampler on: the
+    Perfetto export round-trips with DEVICE lifecycle slices (register-
+    bit spans) and host dispatch spans in one timeline."""
+    from frankenpaxos_tpu.tpu import caspaxos_batched as cp
+
+    cfg = cp.analysis_config()
+    out = tmp_path / "caspaxos_trace.json"
+    serve = ServeConfig(
+        chunk_ticks=16, telemetry_window=64, spans=8,
+        trace_path=str(out), max_chunks=4,
+    )
+    loop = ServeLoop(cp, cfg, serve, seed=0)
+    report = loop.run()
+    assert report["clean_shutdown"] and report["spans_exported"] > 0
+    payload = traceviz.load_chrome_trace(str(out))
+    xs = [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+    device = [e for e in xs if e["pid"] == traceviz.DEVICE_PID]
+    host = [e for e in xs if e["pid"] == traceviz.HOST_PID]
+    assert device and host
+    lifecycles = [e for e in device if e.get("cat") == "lifecycle"]
+    assert lifecycles
+    assert all("committed" in e["args"] for e in lifecycles)
